@@ -1,0 +1,75 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's
+capabilities (reference: ZhouFengMing03/Paddle, see SURVEY.md), built from
+scratch on JAX/XLA idioms: programs lower to single jitted XLA computations,
+collectives ride ICI via mesh axes, autodiff is jax.vjp.
+
+Import as a drop-in `paddle` namespace:
+    import paddle_tpu as paddle
+    import paddle_tpu.fluid as fluid
+"""
+__version__ = "0.1.0"
+
+from . import ops  # noqa: F401  (registers all operators)
+from . import fluid  # noqa: F401
+from .core.place import (  # noqa: F401
+    CPUPlace, CUDAPlace, CUDAPinnedPlace, TPUPlace, XPUPlace,
+)
+from .fluid.framework import (  # noqa: F401
+    Program, program_guard, default_main_program, default_startup_program,
+    in_dygraph_mode, name_scope, cpu_places, cuda_places, tpu_places,
+    is_compiled_with_cuda, is_compiled_with_tpu,
+)
+from .fluid.executor import Executor  # noqa: F401
+from .fluid.param_attr import ParamAttr  # noqa: F401
+from .fluid.dygraph.base import (  # noqa: F401
+    to_variable, no_grad, grad, enable_dygraph, disable_dygraph,
+)
+from .fluid.dygraph.base import Tensor  # noqa: F401
+from .fluid import initializer  # noqa: F401
+from .fluid import regularizer  # noqa: F401
+from .fluid import metrics  # noqa: F401
+
+from . import distributed  # noqa: F401
+from . import parallel  # noqa: F401
+from . import nn  # noqa: F401
+from . import tensor  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import models  # noqa: F401
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    import numpy as np
+
+    from .core.types import to_numpy_dtype
+    from .fluid.dygraph.base import Tensor as _T
+
+    arr = np.asarray(data)
+    if dtype is not None:
+        arr = arr.astype(to_numpy_dtype(dtype))
+    return _T(arr, stop_gradient=stop_gradient)
+
+
+def seed(value):
+    import numpy as np
+
+    np.random.seed(value)
+    default_main_program().random_seed = value
+    default_startup_program().random_seed = value
+    return value
+
+
+def set_device(device):
+    return device
+
+
+def get_device():
+    import jax
+
+    return jax.default_backend()
+
+
+# fluid-style save/load at top level (2.0 API surface)
+from .fluid.dygraph.checkpoint import (  # noqa: F401,E402
+    save_dygraph, load_dygraph,
+)
+from .fluid.io import save, load  # noqa: F401,E402

@@ -1,0 +1,7 @@
+"""paddle_tpu.core — device/dtype/scope primitives (reference: the pybind
+`core` module, `paddle/fluid/pybind/pybind.cc:321`)."""
+from .place import (  # noqa: F401
+    Place, CPUPlace, CUDAPlace, CUDAPinnedPlace, TPUPlace, XPUPlace,
+)
+from .scope import Scope, global_scope, scope_guard  # noqa: F401
+from .types import VarDesc, normalize_dtype, to_numpy_dtype  # noqa: F401

@@ -1,0 +1,76 @@
+"""Scope: hierarchical name -> value symbol table.
+
+Reference parity: `paddle/fluid/framework/scope.h:46` / `variable.h:26`.
+Values here are jax Arrays resident on device HBM (persistables: parameters,
+optimizer state, running stats) plus host-side metadata (LoD info).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+
+class Scope:
+    def __init__(self, parent: Optional["Scope"] = None):
+        self._vars: Dict[str, object] = {}
+        self._parent = parent
+        self._kids = []
+
+    def var(self, name: str):
+        """Find-or-declare (reference: Scope::Var)."""
+        if name not in self._vars:
+            self._vars[name] = None
+        return name
+
+    def find_var(self, name: str):
+        if name in self._vars:
+            return self._vars[name]
+        if self._parent is not None:
+            return self._parent.find_var(name)
+        return None
+
+    def has_var(self, name: str) -> bool:
+        if name in self._vars:
+            return True
+        return self._parent.has_var(name) if self._parent else False
+
+    def set_var(self, name: str, value):
+        self._vars[name] = value
+
+    def erase(self, name: str):
+        self._vars.pop(name, None)
+
+    def local_var_names(self):
+        return list(self._vars)
+
+    def new_scope(self) -> "Scope":
+        kid = Scope(self)
+        self._kids.append(kid)
+        return kid
+
+    def drop_kids(self):
+        self._kids = []
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+class _ScopeGuard:
+    def __init__(self, scope):
+        self._scope = scope
+
+    def __enter__(self):
+        global _global_scope
+        self._old = _global_scope
+        _global_scope = self._scope
+
+    def __exit__(self, *a):
+        global _global_scope
+        _global_scope = self._old
+
+
+def scope_guard(scope: Scope):
+    return _ScopeGuard(scope)

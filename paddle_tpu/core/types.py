@@ -1,0 +1,109 @@
+"""Dtype system: VarType enum names <-> numpy/jax dtypes.
+
+Reference parity: `paddle/fluid/framework/framework.proto:104-162` (VarType),
+`paddle/fluid/platform/float16.h` (software fp16). On TPU, bfloat16 is the
+native 16-bit type (MXU-friendly); fp16 is kept for API compatibility.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    import ml_dtypes
+
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except Exception:  # pragma: no cover
+    _BF16 = np.dtype("float32")
+
+# Canonical string names used throughout the framework.
+_STR_TO_NP = {
+    "bool": np.dtype("bool"),
+    "int8": np.dtype("int8"),
+    "uint8": np.dtype("uint8"),
+    "int16": np.dtype("int16"),
+    "int32": np.dtype("int32"),
+    "int64": np.dtype("int64"),
+    "float16": np.dtype("float16"),
+    "bfloat16": _BF16,
+    "float32": np.dtype("float32"),
+    "float64": np.dtype("float64"),
+    "complex64": np.dtype("complex64"),
+    "complex128": np.dtype("complex128"),
+}
+
+# Reference framework.proto VarType.Type integer codes (framework.proto:106-131)
+# kept so serialized programs stay interchangeable.
+_STR_TO_PROTO = {
+    "bool": 0,
+    "int16": 1,
+    "int32": 2,
+    "int64": 3,
+    "float16": 4,
+    "float32": 5,
+    "float64": 6,
+    "int8": 21,
+    "uint8": 20,
+    "bfloat16": 22,
+    "complex64": 23,
+    "complex128": 24,
+}
+_PROTO_TO_STR = {v: k for k, v in _STR_TO_PROTO.items()}
+
+
+class VarDesc:
+    class VarType:
+        BOOL = 0
+        INT16 = 1
+        INT32 = 2
+        INT64 = 3
+        FP16 = 4
+        FP32 = 5
+        FP64 = 6
+        UINT8 = 20
+        INT8 = 21
+        BF16 = 22
+        COMPLEX64 = 23
+        COMPLEX128 = 24
+        # container kinds
+        LOD_TENSOR = 7
+        SELECTED_ROWS = 8
+        FEED_MINIBATCH = 9
+        FETCH_LIST = 10
+        STEP_SCOPES = 11
+        LOD_RANK_TABLE = 12
+        LOD_TENSOR_ARRAY = 13
+        RAW = 17
+
+
+def normalize_dtype(dtype) -> str:
+    """Accept str / numpy dtype / jax dtype / VarType int -> canonical str."""
+    if dtype is None:
+        return "float32"
+    if isinstance(dtype, str):
+        s = {"float": "float32", "double": "float64", "int": "int32",
+             "half": "float16", "long": "int64"}.get(dtype, dtype)
+        if s not in _STR_TO_NP:
+            raise ValueError("unknown dtype %r" % (dtype,))
+        return s
+    if isinstance(dtype, int):
+        return _PROTO_TO_STR[dtype]
+    npdt = np.dtype(dtype)
+    if npdt == _BF16:
+        return "bfloat16"
+    name = npdt.name
+    if name not in _STR_TO_NP:
+        raise ValueError("unsupported dtype %r" % (dtype,))
+    return name
+
+
+def to_numpy_dtype(dtype) -> np.dtype:
+    return _STR_TO_NP[normalize_dtype(dtype)]
+
+
+def to_proto(dtype) -> int:
+    return _STR_TO_PROTO[normalize_dtype(dtype)]
+
+
+def is_floating(dtype) -> bool:
+    return normalize_dtype(dtype) in (
+        "float16", "bfloat16", "float32", "float64")

@@ -1,0 +1,95 @@
+"""Cluster launcher (reference: `python/paddle/distributed/launch.py:193`,
+env contract set at `distributed/utils.py:356-360`).
+
+On GPU the launcher spawns one process per device. On TPU one process
+drives all local chips (SPMD over the mesh), so the launcher spawns one
+process per HOST, keeping the same PADDLE_* env contract:
+  PADDLE_TRAINER_ID, PADDLE_CURRENT_ENDPOINT, PADDLE_TRAINERS_NUM,
+  PADDLE_TRAINER_ENDPOINTS.
+
+Usage: python -m paddle_tpu.distributed.launch --hosts h1:port,h2:port
+       train.py [args...]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+
+class ParallelEnvArgs:
+    def __init__(self):
+        self.cluster_node_ips = None
+        self.node_ip = None
+        self.use_paddlecloud = False
+        self.started_port = None
+        self.print_config = True
+        self.selected_devices = None
+
+
+def _parse_args(argv):
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--hosts", type=str, default="127.0.0.1:6170",
+                   help="comma-separated host:port endpoints (one per host)")
+    p.add_argument("--host_id", type=int, default=None,
+                   help="index of this host in --hosts (default: derive "
+                        "from matching local address or 0)")
+    p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def launch(argv=None):
+    args = _parse_args(argv if argv is not None else sys.argv[1:])
+    endpoints = args.hosts.split(",")
+    nhosts = len(endpoints)
+    host_id = args.host_id if args.host_id is not None else 0
+
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+
+    procs = []
+    # On a single-host invocation with multiple endpoints we spawn them all
+    # locally (test/dev mode, mirrors multi-process-on-localhost testing —
+    # SURVEY.md §4.5). On real clusters each host runs launch with its
+    # --host_id.
+    local_ids = range(nhosts) if args.host_id is None and nhosts > 1 and \
+        all(e.split(":")[0] in ("127.0.0.1", "localhost")
+            for e in endpoints) else [host_id]
+
+    for tid in local_ids:
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(tid),
+            "PADDLE_CURRENT_ENDPOINT": endpoints[tid],
+            "PADDLE_TRAINERS_NUM": str(nhosts),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+        })
+        cmd = [sys.executable, "-u", args.training_script] \
+            + args.training_script_args
+        if args.log_dir:
+            out = open(os.path.join(args.log_dir,
+                                    "workerlog.%d" % tid), "w")
+        else:
+            out = None
+        procs.append(subprocess.Popen(cmd, env=env, stdout=out,
+                                      stderr=subprocess.STDOUT
+                                      if out else None))
+
+    def _term(signum, frame):
+        for p in procs:
+            p.terminate()
+
+    signal.signal(signal.SIGTERM, _term)
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    launch()

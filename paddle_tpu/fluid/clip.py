@@ -1,0 +1,165 @@
+"""Gradient clipping (reference: `python/paddle/fluid/clip.py`)."""
+from __future__ import annotations
+
+from typing import List
+
+
+class BaseGradientClipAttr:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class GradientClipByValue(BaseGradientClipAttr):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def __call__(self, params_grads):
+        from .framework import in_dygraph_mode
+
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            if in_dygraph_mode():
+                from .dygraph import base as dy_base
+
+                ng = dy_base.raw_op("clip", {"X": [g._value()]},
+                                    {"min": self.min, "max": self.max},
+                                    ["Out"])[0]
+                out.append((p, dy_base.wrap_raw(ng)))
+            else:
+                g.block.append_op(type="clip", inputs={"X": [g]},
+                                  outputs={"Out": [g]},
+                                  attrs={"min": self.min, "max": self.max})
+                out.append((p, g))
+        return out
+
+
+class GradientClipByNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        from .framework import in_dygraph_mode
+
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            if in_dygraph_mode():
+                from .dygraph import base as dy_base
+
+                ng = dy_base.raw_op("clip_by_norm", {"X": [g._value()]},
+                                    {"max_norm": self.clip_norm}, ["Out"])[0]
+                out.append((p, dy_base.wrap_raw(ng)))
+            else:
+                g.block.append_op(type="clip_by_norm", inputs={"X": [g]},
+                                  outputs={"Out": [g]},
+                                  attrs={"max_norm": self.clip_norm})
+                out.append((p, g))
+        return out
+
+
+class GradientClipByGlobalNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def __call__(self, params_grads):
+        from .framework import in_dygraph_mode
+
+        if in_dygraph_mode():
+            return self._eager(params_grads)
+        return self._static(params_grads)
+
+    def _static(self, params_grads):
+        from .layers import nn, tensor
+        from .framework import unique_name
+
+        grads = [g for _, g in params_grads if g is not None]
+        if not grads:
+            return params_grads
+        block = grads[0].block
+        sq_sums = []
+        for g in grads:
+            sq = block.create_var(name=unique_name("gsq"), shape=(1,),
+                                  dtype="float32")
+            block.append_op(type="squared_l2_norm", inputs={"X": [g]},
+                            outputs={"Out": [sq]})
+            sq_sums.append(sq)
+        total = block.create_var(name=unique_name("global_norm_sq"),
+                                 shape=(1,), dtype="float32")
+        block.append_op(type="sum", inputs={"X": sq_sums},
+                        outputs={"Out": [total]})
+        gnorm = block.create_var(name=unique_name("global_norm"),
+                                 shape=(1,), dtype="float32")
+        block.append_op(type="sqrt", inputs={"X": [total]},
+                        outputs={"Out": [gnorm]})
+        clip_var = tensor.fill_constant([1], "float32", self.clip_norm)
+        # scale = clip / max(gnorm, clip)
+        maxed = block.create_var(name=unique_name("gn_max"), shape=(1,),
+                                 dtype="float32")
+        block.append_op(type="elementwise_max",
+                        inputs={"X": [gnorm], "Y": [clip_var]},
+                        outputs={"Out": [maxed]}, attrs={"axis": -1})
+        scale = block.create_var(name=unique_name("gn_scale"), shape=(1,),
+                                 dtype="float32")
+        block.append_op(type="elementwise_div",
+                        inputs={"X": [clip_var], "Y": [maxed]},
+                        outputs={"Out": [scale]}, attrs={"axis": -1})
+        for p, g in params_grads:
+            if g is None:
+                continue
+            block.append_op(type="elementwise_mul",
+                            inputs={"X": [g], "Y": [scale]},
+                            outputs={"Out": [g]}, attrs={"axis": -1})
+        return params_grads
+
+    def _eager(self, params_grads):
+        import jax.numpy as jnp
+
+        from .dygraph import base as dy_base
+
+        grads = [(p, g) for p, g in params_grads if g is not None]
+        total = sum(float(jnp.sum(jnp.square(
+            g._value().astype(jnp.float32)))) for _, g in grads)
+        gnorm = total ** 0.5
+        scale = self.clip_norm / max(gnorm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+            else:
+                out.append((p, dy_base.wrap_raw(g._value() * scale)))
+        return out
+
+
+_clip_attr = {}
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    _clip_attr["default"] = clip
+
+
+def append_gradient_clip_ops(params_grads):
+    clip = _clip_attr.get("default")
+    per_param = any(getattr(p, "gradient_clip_attr", None) is not None
+                    for p, _ in params_grads)
+    if clip is None and not per_param:
+        return params_grads
+    if clip is not None:
+        return clip(params_grads)
+    out = []
+    for p, g in params_grads:
+        attr = getattr(p, "gradient_clip_attr", None)
+        if attr is not None and g is not None:
+            out.extend(attr([(p, g)]))
+        else:
+            out.append((p, g))
+    return out
+
+
+ErrorClipByValue = GradientClipByValue

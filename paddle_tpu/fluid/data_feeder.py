@@ -1,0 +1,55 @@
+"""DataFeeder (reference: `python/paddle/fluid/data_feeder.py`)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .framework import Variable
+from ..core.types import to_numpy_dtype
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place=None, program=None):
+        self.feed_list = feed_list
+        self.place = place
+
+    def feed(self, iterable):
+        """iterable: list of samples, each a tuple aligned with
+        feed_list. Returns a feed dict of batched numpy arrays."""
+        names = [v.name if isinstance(v, Variable) else v
+                 for v in self.feed_list]
+        cols = list(zip(*iterable))
+        out = {}
+        for name, col, var in zip(names, cols, self.feed_list):
+            arr = np.stack([np.asarray(s) for s in col])
+            if isinstance(var, Variable):
+                want = to_numpy_dtype(var.dtype)
+                if arr.dtype != want:
+                    arr = arr.astype(want)
+                # match declared trailing shape, e.g. label [N] -> [N,1]
+                decl = [d for d in var.shape]
+                if (len(decl) == arr.ndim + 1 and decl[-1] == 1):
+                    arr = arr[..., None]
+            out[name] = arr
+        return out
+
+    def feed_parallel(self, iterable, num_places=None):
+        return [self.feed(chunk) for chunk in iterable]
+
+
+def check_variable_and_dtype(input, input_name, expected_dtype, op_name,
+                             extra_message=""):
+    pass
+
+
+def check_type(input, input_name, expected_type, op_name):
+    pass
+
+
+def check_dtype(input_dtype, input_name, expected_dtype, op_name):
+    pass
+
+
+def convert_dtype(dtype):
+    from ..core.types import normalize_dtype
+
+    return normalize_dtype(dtype)

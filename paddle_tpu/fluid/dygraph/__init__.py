@@ -1,0 +1,23 @@
+"""paddle_tpu.fluid.dygraph — imperative mode (reference:
+`python/paddle/fluid/dygraph/`)."""
+from . import base  # noqa: F401
+from .base import (  # noqa: F401
+    guard, no_grad, to_variable, enable_dygraph, disable_dygraph, Tracer,
+    Tensor, VarBase, grad,
+)
+from .layers import Layer, Sequential, LayerList, ParameterList  # noqa: F401
+from . import nn  # noqa: F401
+from .nn import (  # noqa: F401
+    Linear, Conv2D, Pool2D, BatchNorm, LayerNorm, Embedding, Dropout,
+    GRUUnit,
+)
+from .parallel import (  # noqa: F401
+    ParallelEnv, DataParallel, prepare_context, ParallelStrategy,
+)
+from .checkpoint import save_dygraph, load_dygraph  # noqa: F401
+from .learning_rate_scheduler import (  # noqa: F401
+    NoamDecay, PiecewiseDecay, NaturalExpDecay, ExponentialDecay,
+    InverseTimeDecay, PolynomialDecay, CosineDecay, LinearLrWarmup,
+    ReduceLROnPlateau,
+)
+from .jit import TracedLayer, declarative  # noqa: F401

@@ -1,0 +1,39 @@
+"""Dygraph save/load (reference:
+`python/paddle/fluid/dygraph/checkpoint.py:33,98`)."""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+
+def save_dygraph(state_dict, model_path):
+    """Save a state dict (param name -> Tensor) to <model_path>.pdparams."""
+    d = {}
+    is_opt = False
+    for k, v in state_dict.items():
+        if hasattr(v, "numpy"):
+            d[k] = v.numpy()
+        else:
+            d[k] = np.asarray(v)
+            is_opt = True
+    suffix = ".pdopt" if is_opt else ".pdparams"
+    path = model_path + suffix
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(d, f, protocol=2)
+
+
+def load_dygraph(model_path):
+    """Returns (param_dict, optimizer_dict)."""
+    params, opt = None, None
+    if os.path.exists(model_path + ".pdparams"):
+        with open(model_path + ".pdparams", "rb") as f:
+            params = pickle.load(f)
+    if os.path.exists(model_path + ".pdopt"):
+        with open(model_path + ".pdopt", "rb") as f:
+            opt = pickle.load(f)
+    if params is None and opt is None:
+        raise ValueError("no checkpoint found at %r" % model_path)
+    return params, opt

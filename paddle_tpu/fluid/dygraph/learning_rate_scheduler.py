@@ -1,0 +1,184 @@
+"""Dygraph LR schedulers (reference:
+`python/paddle/fluid/dygraph/learning_rate_scheduler.py`). Each is a python
+object whose __call__/step() yields the current lr; optimizers accept one as
+learning_rate."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+class LearningRateDecay:
+    def __init__(self, begin=0, step=1, dtype="float32"):
+        self.step_num = begin
+        self.step_size = step
+        self.dtype = dtype
+
+    def step(self):
+        self.step_num += self.step_size
+
+    def __call__(self):
+        lr = self.get_lr()
+        self.step()
+        return float(lr)
+
+    def get_lr(self):
+        raise NotImplementedError
+
+    # optimizers call float() on learning_rate each step
+    def __float__(self):
+        return float(self.get_lr())
+
+
+class NoamDecay(LearningRateDecay):
+    def __init__(self, d_model, warmup_steps, begin=1, step=1,
+                 dtype="float32", learning_rate=1.0):
+        super().__init__(begin, step, dtype)
+        self.d_model = d_model
+        self.warmup_steps = warmup_steps
+        self.learning_rate = learning_rate
+
+    def get_lr(self):
+        step = max(self.step_num, 1)
+        a = step ** -0.5
+        b = step * self.warmup_steps ** -1.5
+        return self.learning_rate * (self.d_model ** -0.5) * min(a, b)
+
+
+class PiecewiseDecay(LearningRateDecay):
+    def __init__(self, boundaries, values, begin=0, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.boundaries = boundaries
+        self.values = values
+
+    def get_lr(self):
+        for b, v in zip(self.boundaries, self.values):
+            if self.step_num < b:
+                return v
+        return self.values[len(self.boundaries)]
+
+
+class NaturalExpDecay(LearningRateDecay):
+    def __init__(self, learning_rate, decay_steps, decay_rate,
+                 staircase=False, begin=0, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.decay_steps = decay_steps
+        self.decay_rate = decay_rate
+        self.staircase = staircase
+
+    def get_lr(self):
+        r = self.step_num / self.decay_steps
+        if self.staircase:
+            r = math.floor(r)
+        return self.learning_rate * math.exp(-self.decay_rate * r)
+
+
+class ExponentialDecay(NaturalExpDecay):
+    def get_lr(self):
+        r = self.step_num / self.decay_steps
+        if self.staircase:
+            r = math.floor(r)
+        return self.learning_rate * (self.decay_rate ** r)
+
+
+class InverseTimeDecay(NaturalExpDecay):
+    def get_lr(self):
+        r = self.step_num / self.decay_steps
+        if self.staircase:
+            r = math.floor(r)
+        return self.learning_rate / (1 + self.decay_rate * r)
+
+
+class PolynomialDecay(LearningRateDecay):
+    def __init__(self, learning_rate, decay_steps, end_learning_rate=0.0001,
+                 power=1.0, cycle=False, begin=0, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.decay_steps = decay_steps
+        self.end_learning_rate = end_learning_rate
+        self.power = power
+        self.cycle = cycle
+
+    def get_lr(self):
+        step = self.step_num
+        decay_steps = self.decay_steps
+        if self.cycle and step > 0:
+            decay_steps = decay_steps * math.ceil(step / decay_steps)
+        step = min(step, decay_steps)
+        frac = (1 - step / decay_steps) ** self.power
+        return ((self.learning_rate - self.end_learning_rate) * frac
+                + self.end_learning_rate)
+
+
+class CosineDecay(LearningRateDecay):
+    def __init__(self, learning_rate, step_each_epoch, epochs, begin=0,
+                 step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.step_each_epoch = step_each_epoch
+        self.epochs = epochs
+
+    def get_lr(self):
+        epoch = self.step_num // self.step_each_epoch
+        return self.learning_rate * 0.5 * (
+            math.cos(epoch * math.pi / self.epochs) + 1)
+
+
+class LinearLrWarmup(LearningRateDecay):
+    def __init__(self, learning_rate, warmup_steps, start_lr, end_lr,
+                 begin=1, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.lr = learning_rate
+        self.warmup_steps = warmup_steps
+        self.start_lr = start_lr
+        self.end_lr = end_lr
+
+    def get_lr(self):
+        if self.step_num < self.warmup_steps:
+            return (self.start_lr + (self.end_lr - self.start_lr)
+                    * self.step_num / self.warmup_steps)
+        base = self.lr
+        return float(base.get_lr() if isinstance(base, LearningRateDecay)
+                     else base)
+
+
+class ReduceLROnPlateau(LearningRateDecay):
+    def __init__(self, learning_rate, mode="min", decay_rate=0.1,
+                 patience=10, verbose=False, threshold=1e-4,
+                 threshold_mode="rel", cooldown=0, min_lr=0, eps=1e-8,
+                 dtype="float32"):
+        super().__init__(0, 1, dtype)
+        self.lr = float(learning_rate)
+        self.mode = mode
+        self.decay_rate = decay_rate
+        self.patience = patience
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.threshold = threshold
+        self.best = None
+        self.num_bad = 0
+        self.cooldown_counter = 0
+
+    def get_lr(self):
+        return self.lr
+
+    def step(self, metric=None):
+        if metric is None:
+            return
+        m = float(np.asarray(metric).reshape(-1)[0])
+        better = (self.best is None
+                  or (self.mode == "min" and m < self.best - self.threshold)
+                  or (self.mode == "max" and m > self.best + self.threshold))
+        if better:
+            self.best = m
+            self.num_bad = 0
+        elif self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+        else:
+            self.num_bad += 1
+            if self.num_bad > self.patience:
+                self.lr = max(self.lr * self.decay_rate, self.min_lr)
+                self.cooldown_counter = self.cooldown
+                self.num_bad = 0

@@ -1,0 +1,244 @@
+"""Dygraph layer library (reference: `python/paddle/fluid/dygraph/nn.py` —
+Conv2D, Linear, BatchNorm, Embedding, LayerNorm, Pool2D, Dropout, ...)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import framework
+from ..initializer import ConstantInitializer, NormalInitializer
+from ..param_attr import ParamAttr
+from . import base
+from .base import trace_op
+from .layers import Layer
+
+
+class Linear(Layer):
+    def __init__(self, input_dim, output_dim, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__()
+        self.weight = self.create_parameter(
+            shape=[input_dim, output_dim], attr=param_attr, dtype=dtype)
+        self.bias = self.create_parameter(
+            shape=[output_dim], attr=bias_attr, dtype=dtype, is_bias=True)
+        self._act = act
+
+    def forward(self, input):
+        out = trace_op("matmul", {"X": [input], "Y": [self.weight]},
+                       {"transpose_X": False, "transpose_Y": False,
+                        "alpha": 1.0}, ["Out"])[0]
+        if self.bias is not None:
+            out = trace_op("elementwise_add",
+                           {"X": [out], "Y": [self.bias]},
+                           {"axis": out.ndim - 1}, ["Out"])[0]
+        if self._act:
+            out = trace_op(self._act, {"X": [out]}, {}, ["Out"])[0]
+        return out
+
+
+class Conv2D(Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None, dtype="float32"):
+        super().__init__()
+        if isinstance(filter_size, int):
+            filter_size = [filter_size, filter_size]
+        self._stride = ([stride, stride] if isinstance(stride, int)
+                        else list(stride))
+        self._padding = ([padding, padding] if isinstance(padding, int)
+                         else list(padding))
+        self._dilation = ([dilation, dilation] if isinstance(dilation, int)
+                          else list(dilation))
+        self._groups = groups
+        self._act = act
+        fan_in = (num_channels // groups) * filter_size[0] * filter_size[1]
+        std = (2.0 / fan_in) ** 0.5
+        self.weight = self.create_parameter(
+            shape=[num_filters, num_channels // groups] + filter_size,
+            attr=param_attr, dtype=dtype,
+            default_initializer=NormalInitializer(0.0, std))
+        self.bias = self.create_parameter(
+            shape=[num_filters], attr=bias_attr, dtype=dtype, is_bias=True)
+
+    def forward(self, input):
+        out = trace_op("conv2d",
+                       {"Input": [input], "Filter": [self.weight]},
+                       {"strides": self._stride, "paddings": self._padding,
+                        "dilations": self._dilation, "groups": self._groups},
+                       ["Output"])[0]
+        if self.bias is not None:
+            out = trace_op("elementwise_add",
+                           {"X": [out], "Y": [self.bias]}, {"axis": 1},
+                           ["Out"])[0]
+        if self._act:
+            out = trace_op(self._act, {"X": [out]}, {}, ["Out"])[0]
+        return out
+
+
+class Pool2D(Layer):
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, use_cudnn=True,
+                 ceil_mode=False, exclusive=True):
+        super().__init__()
+        self._attrs = {
+            "pooling_type": pool_type,
+            "ksize": [pool_size, pool_size] if isinstance(pool_size, int)
+            else list(pool_size),
+            "strides": [pool_stride, pool_stride]
+            if isinstance(pool_stride, int) else list(pool_stride),
+            "paddings": [pool_padding, pool_padding]
+            if isinstance(pool_padding, int) else list(pool_padding),
+            "global_pooling": global_pooling,
+            "ceil_mode": ceil_mode,
+            "exclusive": exclusive,
+        }
+
+    def forward(self, input):
+        return trace_op("pool2d", {"X": [input]}, dict(self._attrs),
+                        ["Out"])[0]
+
+
+class BatchNorm(Layer):
+    def __init__(self, num_channels, act=None, is_test=False, momentum=0.9,
+                 epsilon=1e-5, param_attr=None, bias_attr=None,
+                 dtype="float32", data_layout="NCHW", in_place=False,
+                 moving_mean_name=None, moving_variance_name=None,
+                 do_model_average_for_mean_and_var=True,
+                 use_global_stats=False, trainable_statistics=False):
+        super().__init__()
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_layout = data_layout
+        self._use_global_stats = use_global_stats
+        self._act = act
+        self.weight = self.create_parameter(
+            shape=[num_channels], attr=param_attr, dtype=dtype,
+            default_initializer=ConstantInitializer(1.0))
+        self.bias = self.create_parameter(
+            shape=[num_channels], attr=bias_attr, dtype=dtype, is_bias=True)
+        self._mean = base.create_eager_parameter(
+            None, [num_channels], dtype, ConstantInitializer(0.0),
+            trainable=False, name=moving_mean_name)
+        self._variance = base.create_eager_parameter(
+            None, [num_channels], dtype, ConstantInitializer(1.0),
+            trainable=False, name=moving_variance_name)
+        self.register_buffer("_mean_buf", self._mean)
+        self.register_buffer("_var_buf", self._variance)
+
+    def forward(self, input):
+        outs = trace_op(
+            "batch_norm",
+            {"X": [input], "Scale": [self.weight], "Bias": [self.bias],
+             "Mean": [self._mean], "Variance": [self._variance]},
+            {"momentum": self._momentum, "epsilon": self._epsilon,
+             "is_test": not self.training,
+             "data_layout": self._data_layout,
+             "use_global_stats": self._use_global_stats},
+            ["Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"])
+        self._mean._assign_raw(outs[1]._val)
+        self._variance._assign_raw(outs[2]._val)
+        y = outs[0]
+        if self._act:
+            y = trace_op(self._act, {"X": [y]}, {}, ["Out"])[0]
+        return y
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, scale=True, shift=True,
+                 epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+                 dtype="float32"):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        n = int(np.prod(normalized_shape))
+        self._epsilon = epsilon
+        self._act = act
+        self.weight = self.create_parameter(
+            shape=[n], attr=param_attr, dtype=dtype,
+            default_initializer=ConstantInitializer(1.0)) if scale else None
+        self.bias = self.create_parameter(
+            shape=[n], attr=bias_attr, dtype=dtype,
+            is_bias=True) if shift else None
+
+    def forward(self, input):
+        ins = {"X": [input]}
+        if self.weight is not None:
+            ins["Scale"] = [self.weight]
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        outs = trace_op("layer_norm", ins,
+                        {"begin_norm_axis": input.ndim - 1,
+                         "epsilon": self._epsilon},
+                        ["Y", "Mean", "Variance"])
+        y = outs[0]
+        if self._act:
+            y = trace_op(self._act, {"X": [y]}, {}, ["Out"])[0]
+        return y
+
+
+class Embedding(Layer):
+    def __init__(self, size, is_sparse=False, is_distributed=False,
+                 padding_idx=None, param_attr=None, dtype="float32"):
+        super().__init__()
+        self._padding_idx = (-1 if padding_idx is None else
+                             padding_idx if padding_idx >= 0
+                             else size[0] + padding_idx)
+        self.weight = self.create_parameter(
+            shape=list(size), attr=param_attr, dtype=dtype)
+
+    def forward(self, input):
+        return trace_op("lookup_table_v2",
+                        {"W": [self.weight], "Ids": [input]},
+                        {"padding_idx": self._padding_idx}, ["Out"])[0]
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, seed=None,
+                 dropout_implementation="downgrade_in_infer",
+                 is_test=False):
+        super().__init__()
+        self._p = p
+        self._impl = dropout_implementation
+
+    def forward(self, input):
+        return trace_op("dropout", {"X": [input]},
+                        {"dropout_prob": self._p,
+                         "is_test": not self.training,
+                         "dropout_implementation": self._impl},
+                        ["Out", "Mask"])[0]
+
+
+class GRUUnit(Layer):
+    def __init__(self, size, param_attr=None, bias_attr=None,
+                 activation="tanh", gate_activation="sigmoid",
+                 origin_mode=False, dtype="float32"):
+        super().__init__()
+        self._size = size // 3
+        d = self._size
+        self.weight = self.create_parameter(shape=[d, d * 3],
+                                            attr=param_attr, dtype=dtype)
+        self.bias = self.create_parameter(shape=[1, d * 3], attr=bias_attr,
+                                          dtype=dtype, is_bias=True)
+        self._activation = activation
+        self._gate_activation = gate_activation
+
+    def forward(self, input, hidden):
+        # gates = input + hidden @ weight + bias
+        d = self._size
+        hw = trace_op("matmul", {"X": [hidden], "Y": [self.weight]},
+                      {"transpose_X": False, "transpose_Y": False,
+                       "alpha": 1.0}, ["Out"])[0]
+        g = input + hw
+        if self.bias is not None:
+            g = g + self.bias
+        # split: update, reset, candidate
+        parts = trace_op("split", {"X": [g]},
+                         {"num": 3, "sections": [], "axis": 1},
+                         {"Out": 3})
+        u = trace_op(self._gate_activation, {"X": [parts[0]]}, {},
+                     ["Out"])[0]
+        r = trace_op(self._gate_activation, {"X": [parts[1]]}, {},
+                     ["Out"])[0]
+        c = trace_op(self._activation, {"X": [parts[2] * r]}, {}, ["Out"])[0]
+        new_h = u * hidden + (base.wrap_raw(
+            np.asarray(1.0, "float32")) - u) * c
+        return new_h, new_h, g

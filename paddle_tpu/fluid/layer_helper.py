@@ -1,0 +1,184 @@
+"""LayerHelper: shared plumbing for layers.* builders (reference:
+`python/paddle/fluid/layer_helper.py`). Creates parameters (appending their
+init op to the startup program), intermediate output vars, and dispatches
+append_op; in dygraph mode ops execute eagerly through the tracer."""
+from __future__ import annotations
+
+from . import framework
+from .framework import Variable, unique_name, in_dygraph_mode
+from .initializer import (
+    ConstantInitializer, XavierInitializer, _global_weight_initializer,
+    _global_bias_initializer,
+)
+from .param_attr import ParamAttr
+
+
+class LayerHelper:
+    def __init__(self, layer_type, **kwargs):
+        self.kwargs = kwargs
+        self.layer_type = layer_type
+        name = kwargs.get("name")
+        self.name = name if name else unique_name(layer_type)
+
+    @property
+    def main_program(self):
+        return framework.default_main_program()
+
+    @property
+    def startup_program(self):
+        return framework.default_startup_program()
+
+    @property
+    def main_block(self):
+        return self.main_program.current_block()
+
+    @property
+    def param_attr(self):
+        return ParamAttr._to_attr(self.kwargs.get("param_attr"))
+
+    @property
+    def bias_attr(self):
+        return ParamAttr._to_attr(self.kwargs.get("bias_attr"))
+
+    def multiple_param_attr(self, length):
+        attr = self.param_attr
+        if isinstance(attr, ParamAttr):
+            attr = [attr] + [ParamAttr(**{
+                k: v for k, v in attr.__dict__.items() if k != "name"})
+                for _ in range(length - 1)]
+        return attr
+
+    # -- creation ----------------------------------------------------------
+    def create_parameter(self, attr, shape, dtype="float32", is_bias=False,
+                         default_initializer=None, stop_gradient=False):
+        if attr is False:
+            return None
+        attr = ParamAttr._to_attr(attr)
+        if attr.name is None:
+            attr.name = unique_name(".".join([self.name, "w" if not is_bias
+                                              else "b"]))
+        init = attr.initializer or default_initializer or (
+            _global_bias_initializer() if is_bias
+            else _global_weight_initializer())
+
+        if in_dygraph_mode():
+            from .dygraph import base as dy_base
+
+            return dy_base.create_eager_parameter(
+                attr, shape, dtype, init, trainable=attr.trainable)
+
+        main_block = self.main_program.global_block()
+        param = main_block.create_parameter(
+            shape=shape, dtype=dtype, **attr._to_kwargs())
+        # mirror var in startup program + init op there
+        startup_block = self.startup_program.global_block()
+        s_param = startup_block.create_var(
+            name=param.name, shape=shape, dtype=dtype, persistable=True)
+        init(s_param, startup_block)
+        return param
+
+    def create_variable_for_type_inference(self, dtype="float32",
+                                           stop_gradient=False):
+        return self.main_block.create_var(
+            name=unique_name(".".join([self.name, "tmp"])),
+            dtype=dtype, shape=(), stop_gradient=stop_gradient)
+
+    def create_variable(self, **kwargs):
+        return self.main_block.create_var(**kwargs)
+
+    def create_global_variable(self, persistable=True, **kwargs):
+        return self.main_program.global_block().create_var(
+            persistable=persistable, **kwargs)
+
+    def set_variable_initializer(self, var, initializer):
+        startup_block = self.startup_program.global_block()
+        s_var = startup_block.create_var(
+            name=var.name, shape=var.shape, dtype=var.dtype,
+            persistable=True)
+        initializer(s_var, startup_block)
+
+    # -- op dispatch -------------------------------------------------------
+    def append_op(self, **kwargs):
+        return self.main_block.append_op(**kwargs)
+
+    def append_bias_op(self, input_var, dim_start=1, dim_end=None):
+        bias_attr = self.bias_attr
+        if bias_attr is False or bias_attr is None:
+            return input_var
+        size = list(input_var.shape[dim_start:dim_end])
+        b = self.create_parameter(bias_attr, shape=size,
+                                  dtype=input_var.dtype, is_bias=True)
+        if in_dygraph_mode():
+            from .dygraph import base as dy_base
+
+            return dy_base.trace_op(
+                "elementwise_add", {"X": [input_var], "Y": [b]},
+                {"axis": dim_start}, ["Out"])[0]
+        out = self.create_variable_for_type_inference(input_var.dtype)
+        self.append_op(
+            type="elementwise_add",
+            inputs={"X": [input_var], "Y": [b]},
+            outputs={"Out": [out]},
+            attrs={"axis": dim_start})
+        return out
+
+    def append_activation(self, input_var):
+        act = self.kwargs.get("act")
+        if act is None:
+            return input_var
+        if isinstance(act, str):
+            act = {"type": act}
+        act_type = act.pop("type")
+        if in_dygraph_mode():
+            from .dygraph import base as dy_base
+
+            return dy_base.trace_op(act_type, {"X": [input_var]}, act,
+                                    ["Out"])[0]
+        out = self.create_variable_for_type_inference(input_var.dtype)
+        self.append_op(type=act_type, inputs={"X": [input_var]},
+                       outputs={"Out": [out]}, attrs=act)
+        return out
+
+    def input(self, name="Input"):
+        v = self.kwargs.get(name.lower(), self.kwargs.get("input"))
+        return v
+
+    def input_dtype(self, name="input"):
+        v = self.kwargs.get(name)
+        if isinstance(v, (list, tuple)):
+            v = v[0]
+        return v.dtype
+
+
+def apply_op(helper_or_type, op_type, inputs, attrs, out_slots,
+             out_dtype=None):
+    """Mode-polymorphic op application used by functional layers.
+
+    out_slots: list of output slot names (each one var) or dict slot->count.
+    Returns list of output vars/tensors in slot order.
+    """
+    if in_dygraph_mode():
+        from .dygraph import base as dy_base
+
+        slots = (list(out_slots) if not isinstance(out_slots, dict)
+                 else out_slots)
+        return dy_base.trace_op(op_type, inputs, attrs, slots)
+
+    helper = (helper_or_type if isinstance(helper_or_type, LayerHelper)
+              else LayerHelper(op_type))
+    outs = {}
+    flat = []
+    if isinstance(out_slots, dict):
+        for slot, n in out_slots.items():
+            vs = [helper.create_variable_for_type_inference(
+                out_dtype or "float32") for _ in range(n)]
+            outs[slot] = vs
+            flat.extend(vs)
+    else:
+        for slot in out_slots:
+            v = helper.create_variable_for_type_inference(
+                out_dtype or "float32")
+            outs[slot] = [v]
+            flat.append(v)
+    helper.append_op(type=op_type, inputs=inputs, outputs=outs, attrs=attrs)
+    return flat

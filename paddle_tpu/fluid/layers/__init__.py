@@ -1,0 +1,14 @@
+"""fluid.layers — op wrapper namespace (reference:
+`python/paddle/fluid/layers/`)."""
+from . import nn, tensor, loss, collective, math_op_patch  # noqa: F401
+from . import learning_rate_scheduler  # noqa: F401
+from .nn import *  # noqa: F401,F403
+from .tensor import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .learning_rate_scheduler import (  # noqa: F401
+    noam_decay, exponential_decay, natural_exp_decay, inverse_time_decay,
+    polynomial_decay, piecewise_decay, cosine_decay, linear_lr_warmup,
+)
+
+# `data` also lives at layers top level in the reference
+from .tensor import data  # noqa: F401

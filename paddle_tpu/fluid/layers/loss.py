@@ -1,0 +1,71 @@
+"""Loss layers (reference: `python/paddle/fluid/layers/loss.py`)."""
+from __future__ import annotations
+
+from ..layer_helper import apply_op
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy",
+    "sigmoid_cross_entropy_with_logits", "square_error_cost", "huber_loss",
+    "smooth_l1", "kldiv_loss", "mse_loss",
+]
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    return apply_op("cross_entropy", "cross_entropy",
+                    {"X": [input], "Label": [label]},
+                    {"soft_label": soft_label, "ignore_index": ignore_index},
+                    ["Y"], out_dtype=input.dtype)[0]
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    outs = apply_op("softmax_with_cross_entropy",
+                    "softmax_with_cross_entropy",
+                    {"Logits": [logits], "Label": [label]},
+                    {"soft_label": soft_label, "ignore_index": ignore_index,
+                     "axis": axis},
+                    ["Softmax", "Loss"], out_dtype=logits.dtype)
+    softmax, loss = outs[0], outs[1]
+    if return_softmax:
+        return loss, softmax
+    return loss
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100,
+                                      name=None, normalize=False):
+    return apply_op("sigmoid_cross_entropy_with_logits",
+                    "sigmoid_cross_entropy_with_logits",
+                    {"X": [x], "Label": [label]},
+                    {"ignore_index": ignore_index, "normalize": normalize},
+                    ["Out"], out_dtype=x.dtype)[0]
+
+
+def square_error_cost(input, label):
+    return apply_op("square_error_cost", "square_error_cost",
+                    {"X": [input], "Y": [label]}, {}, ["Out"],
+                    out_dtype=input.dtype)[0]
+
+
+def mse_loss(input, label):
+    from . import nn
+
+    return nn.reduce_mean(square_error_cost(input, label))
+
+
+def huber_loss(input, label, delta):
+    return apply_op("huber_loss", "huber_loss",
+                    {"X": [input], "Y": [label]}, {"delta": float(delta)},
+                    ["Out", "Residual"], out_dtype=input.dtype)[0]
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    return apply_op("smooth_l1_loss", "smooth_l1_loss",
+                    {"X": [x], "Y": [y]}, {"sigma": sigma or 1.0},
+                    ["Out", "Diff"], out_dtype=x.dtype)[0]
+
+
+def kldiv_loss(x, target, reduction="mean", name=None):
+    return apply_op("kldiv_loss", "kldiv_loss",
+                    {"X": [x], "Target": [target]}, {"reduction": reduction},
+                    ["Loss"], out_dtype=x.dtype)[0]

@@ -1,0 +1,247 @@
+"""Tensor creation/manipulation layers (reference:
+`python/paddle/fluid/layers/tensor.py`)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import framework
+from ..framework import Variable, in_dygraph_mode
+from ..layer_helper import LayerHelper, apply_op
+from ...core.types import normalize_dtype
+
+__all__ = [
+    "data", "fill_constant", "fill_constant_batch_size_like", "cast",
+    "concat", "assign", "create_tensor", "create_global_var", "argmax",
+    "argmin", "argsort", "zeros", "ones", "zeros_like", "ones_like",
+    "reverse", "range", "linspace", "reshape", "transpose", "scale",
+    "shape", "cumsum", "increment", "eye", "diag", "tril", "triu",
+]
+
+
+def _single(op_type, inputs, attrs, dtype=None):
+    return apply_op(op_type, op_type, inputs, attrs, ["Out"],
+                    out_dtype=dtype)[0]
+
+
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
+         stop_gradient=True, type=None):
+    """Declare an input variable (reference: layers/io.py data /
+    fluid.data). With append_batch_size, -1 is prepended."""
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    block = framework.default_main_program().current_block()
+    return block.create_var(
+        name=name, shape=shape, dtype=dtype, is_data=True,
+        stop_gradient=stop_gradient, persistable=False)
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
+    dtype = normalize_dtype(dtype)
+    if in_dygraph_mode():
+        from ..dygraph import base as dy_base
+
+        return dy_base.trace_op(
+            "fill_constant", {}, {"shape": list(shape), "dtype": dtype,
+                                  "value": float(value)}, ["Out"])[0]
+    helper = LayerHelper("fill_constant")
+    if out is None:
+        out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="fill_constant", outputs={"Out": [out]},
+                     attrs={"shape": list(shape), "dtype": dtype,
+                            "value": float(value)})
+    out.stop_gradient = True
+    return out
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0):
+    return _single("fill_constant_batch_size_like", {"Input": [input]},
+                   {"shape": list(shape), "dtype": normalize_dtype(dtype),
+                    "value": float(value), "input_dim_idx": input_dim_idx,
+                    "output_dim_idx": output_dim_idx}, dtype=dtype)
+
+
+def cast(x, dtype):
+    dtype = normalize_dtype(dtype)
+    return _single("cast", {"X": [x]}, {"out_dtype": dtype}, dtype=dtype)
+
+
+def concat(input, axis=0, name=None):
+    return _single("concat", {"X": list(input)}, {"axis": axis},
+                   dtype=input[0].dtype)
+
+
+def assign(input, output=None):
+    if isinstance(input, np.ndarray):
+        attrs = {"shape": list(input.shape),
+                 "dtype": normalize_dtype(input.dtype)}
+        key = ("fp32_values" if input.dtype in (np.float32, np.float64)
+               else "int32_values" if input.dtype == np.int32
+               else "int64_values")
+        attrs[key] = input.astype(
+            "float64" if "fp" in key else input.dtype).flatten().tolist()
+        if in_dygraph_mode():
+            from ..dygraph import base as dy_base
+
+            return dy_base.trace_op("assign_value", {}, attrs, ["Out"])[0]
+        helper = LayerHelper("assign_value")
+        out = output or helper.create_variable_for_type_inference(
+            normalize_dtype(input.dtype))
+        helper.append_op(type="assign_value", outputs={"Out": [out]},
+                         attrs=attrs)
+        return out
+    if in_dygraph_mode():
+        from ..dygraph import base as dy_base
+
+        return dy_base.trace_op("assign", {"X": [input]}, {}, ["Out"])[0]
+    helper = LayerHelper("assign")
+    out = output or helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="assign", inputs={"X": [input]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    block = framework.default_main_program().current_block()
+    return block.create_var(name=name, dtype=dtype, persistable=persistable,
+                            shape=())
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    helper = LayerHelper("global_var", name=name)
+    var = helper.create_global_variable(
+        name=name or framework.unique_name("global_var"),
+        shape=list(shape), dtype=dtype, persistable=persistable)
+    from ..initializer import ConstantInitializer
+
+    helper.set_variable_initializer(var, ConstantInitializer(value))
+    return var
+
+
+def argmax(x, axis=0, name=None):
+    return _single("arg_max", {"X": [x]}, {"axis": axis}, dtype="int64")
+
+
+def argmin(x, axis=0, name=None):
+    return _single("arg_min", {"X": [x]}, {"axis": axis}, dtype="int64")
+
+
+def argsort(input, axis=-1, descending=False, name=None):
+    outs = apply_op("argsort", "argsort", {"X": [input]},
+                    {"axis": axis, "descending": descending},
+                    ["Out", "Indices"], out_dtype=input.dtype)
+    return outs[0], outs[1]
+
+
+def zeros(shape, dtype="float32", force_cpu=False):
+    return fill_constant(shape, dtype, 0.0)
+
+
+def ones(shape, dtype="float32", force_cpu=False):
+    return fill_constant(shape, dtype, 1.0)
+
+
+def zeros_like(x, out=None):
+    return _single("fill_any_like", {"X": [x]}, {"value": 0.0, "dtype": -1},
+                   dtype=x.dtype)
+
+
+def ones_like(x, out=None):
+    return _single("fill_any_like", {"X": [x]}, {"value": 1.0, "dtype": -1},
+                   dtype=x.dtype)
+
+
+def reverse(x, axis):
+    return _single("flip", {"X": [x]},
+                   {"axis": axis if isinstance(axis, (list, tuple))
+                    else [axis]}, dtype=x.dtype)
+
+
+def range(start, end, step, dtype="float32"):
+    s = fill_constant([1], dtype, start) if not isinstance(
+        start, Variable) else start
+    e = fill_constant([1], dtype, end) if not isinstance(
+        end, Variable) else end
+    st = fill_constant([1], dtype, step) if not isinstance(
+        step, Variable) else step
+    return _single("range", {"Start": [s], "End": [e], "Step": [st]}, {},
+                   dtype=dtype)
+
+
+def linspace(start, stop, num, dtype="float32"):
+    s = fill_constant([1], dtype, start)
+    e = fill_constant([1], dtype, stop)
+    n = fill_constant([1], "int32", num)
+    return _single("linspace", {"Start": [s], "Stop": [e], "Num": [n]},
+                   {"dtype": dtype}, dtype=dtype)
+
+
+def reshape(x, shape, actual_shape=None, act=None, inplace=False, name=None):
+    outs = apply_op("reshape2", "reshape2", {"X": [x]},
+                    {"shape": [int(s) for s in shape]}, ["Out", "XShape"],
+                    out_dtype=x.dtype)
+    return outs[0]
+
+
+def transpose(x, perm, name=None):
+    outs = apply_op("transpose2", "transpose2", {"X": [x]},
+                    {"axis": list(perm)}, ["Out", "XShape"],
+                    out_dtype=x.dtype)
+    return outs[0]
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None,
+          name=None):
+    out = _single("scale", {"X": [x]},
+                  {"scale": float(scale), "bias": float(bias),
+                   "bias_after_scale": bias_after_scale}, dtype=x.dtype)
+    if act:
+        out = _single(act, {"X": [out]}, {}, dtype=x.dtype)
+    return out
+
+
+def shape(input):
+    return _single("shape", {"Input": [input]}, {}, dtype="int32")
+
+
+def cumsum(x, axis=-1, exclusive=False, reverse=False):
+    return _single("cumsum", {"X": [x]},
+                   {"axis": axis, "exclusive": exclusive, "reverse": reverse},
+                   dtype=x.dtype)
+
+
+def increment(x, value=1.0, in_place=True):
+    if in_dygraph_mode():
+        from ..dygraph import base as dy_base
+
+        return dy_base.trace_op("increment", {"X": [x]}, {"step": value},
+                                ["Out"])[0]
+    helper = LayerHelper("increment")
+    out = x if in_place else helper.create_variable_for_type_inference(
+        x.dtype)
+    helper.append_op(type="increment", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"step": float(value)})
+    return out
+
+
+def eye(num_rows, num_columns=None, batch_shape=None, dtype="float32"):
+    return _single("eye", {}, {"num_rows": num_rows,
+                               "num_columns": num_columns or -1,
+                               "dtype": normalize_dtype(dtype)}, dtype=dtype)
+
+
+def diag(diagonal):
+    return _single("diag_v2", {"X": [diagonal]}, {"offset": 0},
+                   dtype=diagonal.dtype)
+
+
+def tril(x, diagonal=0, name=None):
+    return _single("tril_triu", {"X": [x]},
+                   {"diagonal": diagonal, "lower": True}, dtype=x.dtype)
+
+
+def triu(x, diagonal=0, name=None):
+    return _single("tril_triu", {"X": [x]},
+                   {"diagonal": diagonal, "lower": False}, dtype=x.dtype)

@@ -1,0 +1,276 @@
+"""Block lowering: a Program block -> ONE jitted XLA computation.
+
+This replaces the reference's entire execution stack — the op-by-op C++
+Executor loop (`framework/executor.cc:471`), kernel dispatch
+(`operator.cc:908-1030`), data transforms, memory-reuse passes
+(`ir/memory_optimize_pass/`), fusion passes (`ir/*fuse*`), and the SSA
+multi-device executors (`details/fast_threaded_ssa_graph_executor.cc`).
+TPU-first: trace the op list once into a single jax function, let XLA fuse
+and schedule it, cache the compiled executable keyed by
+(program version, feed shapes); data-parallel programs wrap the same
+function in `jax.shard_map` over a Mesh so collective ops emit ICI
+collectives (SURVEY.md §3B "the whole SSA machinery collapses into XLA SPMD
+partitioning").
+
+Autodiff: `append_backward` plants a single `backward` pseudo-op; lowering
+runs the forward segment under `jax.vjp` and binds each requested `X@GRAD`
+(replacing per-op GradOpMakers, `grad_op_desc_maker.h`).
+
+Mutable Scope semantics vs XLA purity (SURVEY.md §7 hard part (c)): the
+lowered function is pure — scope-resident state (params, optimizer moments,
+BN running stats) enters as inputs and leaves as outputs; variable rebinding
+inside the block is SSA-ified by the name->value environment.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from . import framework
+from .. import ops as ops_lib
+from ..core.types import to_numpy_dtype
+
+# Ops that exist only for runtime bookkeeping in the reference; under XLA
+# they are no-ops (stream sync is dataflow; comm init is mesh construction).
+_SKIP_OPS = frozenset({
+    "feed", "fetch", "c_gen_nccl_id", "gen_nccl_id", "c_comm_init",
+    "c_comm_init_all", "c_wait_compute", "c_wait_comm", "barrier",
+    "print", "nop",
+})
+
+
+class LoweredFunction:
+    """A compiled block: callable (feeds, states, seed) -> (fetches, states').
+    """
+
+    __slots__ = ("jitted", "state_in_names", "state_out_names",
+                 "fetch_names", "feed_names", "mesh", "dp_axis")
+
+    def __init__(self, jitted, feed_names, state_in_names, state_out_names,
+                 fetch_names, mesh=None, dp_axis=None):
+        self.jitted = jitted
+        self.feed_names = feed_names
+        self.state_in_names = state_in_names
+        self.state_out_names = state_out_names
+        self.fetch_names = fetch_names
+        self.mesh = mesh
+        self.dp_axis = dp_axis
+
+
+def analyze_block(block, feed_names, fetch_names):
+    """Dataflow analysis: which names are scope state in/out."""
+    produced = set(feed_names)
+    state_in: List[str] = []
+    state_in_set = set()
+    for op in block.ops:
+        for name in op.input_arg_names:
+            if name not in produced and name not in state_in_set:
+                state_in.append(name)
+                state_in_set.add(name)
+        for name in op.output_arg_names:
+            produced.add(name)
+    for name in fetch_names:
+        if name not in produced and name not in state_in_set:
+            state_in.append(name)
+            state_in_set.add(name)
+
+    # state outputs: names written by ops that are persistable vars or
+    # rebind scope-resident inputs (param updates, running stats, ...)
+    state_out: List[str] = []
+    seen = set()
+    for op in block.ops:
+        for name in op.output_arg_names:
+            if name in seen:
+                continue
+            persistable = False
+            v = block._find_var_recursive(name)
+            if v is not None and v.persistable:
+                persistable = True
+            if persistable or name in state_in_set:
+                seen.add(name)
+                state_out.append(name)
+    return state_in, state_out
+
+
+def _exec_op(op, env, key0, op_idx):
+    import jax
+
+    t = op.type
+    if t in _SKIP_OPS:
+        return
+    opdef = ops_lib.get_op(t)
+    ins = {}
+    for slot, names in op.input_names.items():
+        if not names:
+            continue
+        try:
+            ins[slot] = [env[n] for n in names]
+        except KeyError as e:
+            raise RuntimeError(
+                "op %s: input var %s not materialized (feed it or run the "
+                "startup program)" % (t, e)) from None
+    attrs = dict(op.attrs)
+    if opdef.needs_rng:
+        attrs["_rng_key"] = jax.random.fold_in(key0, op_idx)
+    outs = ops_lib.normalize_outs(opdef.compute(ins, attrs))
+    for slot, names in op.output_names.items():
+        vals = outs.get(slot, [])
+        for n, v in zip(names, vals):
+            env[n] = v
+
+
+def _run_ops(ops, env, key0, base_idx=0):
+    for i, op in enumerate(ops):
+        _exec_op(op, env, key0, base_idx + i)
+
+
+def _diffable(block, name, env):
+    v = block._find_var_recursive(name)
+    if v is None or v.stop_gradient:
+        return False
+    import jax.numpy as jnp
+
+    val = env.get(name)
+    return val is not None and jnp.issubdtype(
+        np.asarray(val).dtype if not hasattr(val, "dtype") else val.dtype,
+        jnp.floating)
+
+
+def build_block_fn(program, block, feed_names, fetch_names,
+                   state_in, state_out):
+    """Build the pure python fn to be jitted."""
+    import jax
+    import jax.numpy as jnp
+
+    ops = list(block.ops)
+    bwd_indices = [i for i, op in enumerate(ops) if op.type == "backward"]
+    if len(bwd_indices) > 1:
+        raise NotImplementedError("multiple backward sections in one block")
+    bwd_idx = bwd_indices[0] if bwd_indices else None
+
+    def fn(feeds: Dict, states: Dict, seed):
+        env = {}
+        env.update(states)
+        env.update(feeds)
+        key0 = jax.random.PRNGKey(seed)
+
+        if bwd_idx is None:
+            _run_ops(ops, env, key0)
+        else:
+            fwd_ops = ops[:bwd_idx]
+            bop = ops[bwd_idx]
+            loss_name = bop.attrs["loss_name"]
+            requested = bop.attrs.get("diff_names", [])
+            loss_scale = bop.attrs.get("loss_scale", 1.0)
+            diff_names = [n for n in requested
+                          if n in env and _diffable(block, n, env)]
+
+            def fseg(dvars):
+                e = dict(env)
+                e.update(dvars)
+                _run_ops(fwd_ops, e, key0)
+                loss_sum = jnp.sum(e[loss_name].astype(jnp.float32))
+                return loss_sum, e
+
+            diff_in = {n: env[n] for n in diff_names}
+            _, vjp_fn, env_after = jax.vjp(fseg, diff_in, has_aux=True)
+            ct = jnp.asarray(loss_scale, jnp.float32)
+            grads = vjp_fn(ct)[0]
+            env = dict(env_after)
+            for n in diff_names:
+                g = grads[n]
+                env[framework.grad_var_name(n)] = g.astype(env[n].dtype)
+            loss_val = env[loss_name]
+            env[framework.grad_var_name(loss_name)] = jnp.full(
+                loss_val.shape, loss_scale, loss_val.dtype)
+            _run_ops(ops[bwd_idx + 1:], env, key0, base_idx=bwd_idx + 1)
+
+        fetches = []
+        for n in fetch_names:
+            if n not in env:
+                raise RuntimeError("fetch var %r was never computed" % n)
+            fetches.append(env[n])
+        new_states = {n: env[n] for n in state_out if n in env}
+        return fetches, new_states
+
+    return fn
+
+
+def compile_block(program, block, feed_specs, fetch_names, state_specs,
+                  donate=False):
+    """feed_specs/state_specs: name -> concrete arrays or ShapeDtypeStructs
+    (only shapes/dtypes are read). Returns a LoweredFunction."""
+    import jax
+
+    feed_names = list(feed_specs)
+    state_in, state_out = analyze_block(block, feed_names, fetch_names)
+    missing = [n for n in state_in if n not in state_specs]
+    if missing:
+        raise RuntimeError(
+            "variables %s are read by the program but absent from the scope "
+            "— run the startup program (or feed them)" % (missing,))
+
+    fn = build_block_fn(program, block, feed_names, fetch_names,
+                        state_in, state_out)
+
+    mesh = getattr(program, "_mesh", None)
+    dp_axis = getattr(program, "_dp_axis", "dp")
+    if getattr(program, "_data_parallel", False) and mesh is None:
+        mesh = _default_mesh(dp_axis)
+        program._mesh = mesh
+
+    if mesh is not None and getattr(program, "_data_parallel", False):
+        jitted = _compile_dp(fn, mesh, dp_axis, program, block,
+                             feed_names, fetch_names, state_in, donate)
+    else:
+        jitted = jax.jit(fn, donate_argnums=(1,) if donate else ())
+
+    return LoweredFunction(jitted, feed_names, state_in, state_out,
+                           fetch_names, mesh=mesh, dp_axis=dp_axis)
+
+
+def _default_mesh(dp_axis):
+    import jax
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices())
+    return Mesh(devs, (dp_axis,))
+
+
+def _compile_dp(fn, mesh, dp_axis, program, block, feed_names, fetch_names,
+                state_in, donate):
+    """Data-parallel lowering: shard_map over the mesh; feeds sharded on
+    axis 0, state replicated. Collective ops inside see the live axis and
+    emit psum over ICI (reference flow: transpiler/collective.py:178-268 +
+    c_allreduce kernels -> here SURVEY.md §3C TPU mapping)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel import env as penv
+
+    ndev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+    axes = {a: mesh.shape[a] for a in mesh.axis_names}
+
+    def wrapped(feeds, states, seed):
+        with penv.collective_scope(axes):
+            return fn(feeds, states, seed)
+
+    feed_specs = {n: P(dp_axis) for n in feed_names}
+    state_specs_in = {n: P() for n in state_in}
+
+    def out_spec_for_fetch(n):
+        v = block._find_var_recursive(n)
+        if v is not None and v.persistable:
+            return P()
+        return P(dp_axis)
+
+    # state_out names are discovered inside fn; all replicated
+    fetch_specs = [out_spec_for_fetch(n) for n in fetch_names]
+
+    smapped = jax.shard_map(
+        wrapped, mesh=mesh,
+        in_specs=(feed_specs, state_specs_in, P()),
+        out_specs=(fetch_specs, P()),
+        check_vma=False)
+    return jax.jit(smapped, donate_argnums=(1,) if donate else ())

@@ -1,0 +1,727 @@
+"""Optimizers (reference: `python/paddle/fluid/optimizer.py:55-4847`).
+
+`minimize = append_backward + apply_gradients`: gradients come from the
+jax.vjp-backed backward section (backward.py); each optimizer then appends
+its update op per parameter (kernels in ops/optimizer_ops.py). Accumulators
+(moments, beta pows) are persistable scope vars initialized via the startup
+program — on TPU the whole step (forward, backward, every param update)
+compiles into one XLA executable with donated param buffers.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from . import framework
+from .framework import Variable, Parameter, unique_name, in_dygraph_mode
+from .backward import append_backward
+from .initializer import ConstantInitializer
+from .layer_helper import LayerHelper
+from .clip import append_gradient_clip_ops
+from .regularizer import append_regularization_ops
+
+__all__ = [
+    "Optimizer", "SGDOptimizer", "MomentumOptimizer", "AdamOptimizer",
+    "AdamaxOptimizer", "AdagradOptimizer", "DecayedAdagradOptimizer",
+    "AdadeltaOptimizer", "RMSPropOptimizer", "FtrlOptimizer",
+    "LambOptimizer", "LarsMomentumOptimizer", "DGCMomentumOptimizer",
+    "DpsgdOptimizer", "ModelAverage", "ExponentialMovingAverage",
+    "RecomputeOptimizer", "LookaheadOptimizer", "PipelineOptimizer",
+    "SGD", "Momentum", "Adam", "Adamax", "Adagrad", "DecayedAdagrad",
+    "Adadelta", "RMSProp", "Ftrl", "Lamb", "LarsMomentum", "Dpsgd",
+]
+
+
+class Optimizer:
+    def __init__(self, learning_rate, parameter_list=None,
+                 regularization=None, grad_clip=None, name=None):
+        self._learning_rate = learning_rate
+        self._parameter_list = parameter_list
+        self.regularization = regularization
+        self._grad_clip = grad_clip
+        self._name = name or unique_name(type(self).__name__)
+        self._accumulators: Dict[str, Dict[str, Variable]] = {}
+        self._lr_var_per_program = {}
+        self.helper = None
+
+    # -- learning rate -----------------------------------------------------
+    def _global_learning_rate(self, program=None):
+        program = program or framework.default_main_program()
+        if isinstance(self._learning_rate, Variable):
+            return self._learning_rate
+        key = id(program)
+        if key not in self._lr_var_per_program:
+            helper = LayerHelper("learning_rate")
+            var = helper.create_global_variable(
+                name=unique_name("learning_rate"), shape=[1],
+                dtype="float32", persistable=True)
+            helper.set_variable_initializer(
+                var, ConstantInitializer(float(self._learning_rate)))
+            self._lr_var_per_program[key] = var
+        return self._lr_var_per_program[key]
+
+    def _create_param_lr(self, param_and_grad):
+        param = param_and_grad[0]
+        base = self._global_learning_rate()
+        plr = getattr(param, "optimize_attr", {}).get("learning_rate", 1.0)
+        if plr == 1.0:
+            return base
+        from .layers import tensor as t
+
+        return t.scale(base, plr, 0.0)
+
+    def current_step_lr(self):
+        if isinstance(self._learning_rate, (int, float)):
+            return float(self._learning_rate)
+        return self._learning_rate
+
+    # -- accumulators ------------------------------------------------------
+    def _add_accumulator(self, name, param, dtype=None, fill_value=0.0,
+                         shape=None):
+        accs = self._accumulators.setdefault(name, {})
+        if param.name in accs:
+            return accs[param.name]
+        if in_dygraph_mode():
+            from .dygraph import base as dy_base
+
+            var = dy_base.create_eager_parameter(
+                None, list(shape or param.shape), dtype or "float32",
+                ConstantInitializer(fill_value), trainable=False,
+                name=unique_name("%s_%s_%s" % (self._name, param.name,
+                                               name)))
+            accs[param.name] = var
+            return var
+        helper = LayerHelper(self._name)
+        var = helper.create_global_variable(
+            name=unique_name("%s_%s_%s" % (self._name, param.name, name)),
+            shape=list(shape or param.shape), dtype=dtype or "float32",
+            persistable=True)
+        helper.set_variable_initializer(var,
+                                        ConstantInitializer(fill_value))
+        accs[param.name] = var
+        return var
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][param.name]
+
+    # -- core --------------------------------------------------------------
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    def _finish_update(self, block, params_grads):
+        pass
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        if in_dygraph_mode():
+            from .dygraph import base as dy_base
+
+            loss.backward()
+            params = parameter_list or self._parameter_list
+            return [(p, p._grad_tensor()) for p in params
+                    if p.trainable and p._grad_tensor() is not None]
+        return append_backward(loss, parameter_list or self._parameter_list,
+                               no_grad_set)
+
+    def apply_gradients(self, params_grads):
+        params_grads = sorted(params_grads, key=lambda pg: pg[0].name)
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        else:
+            params_grads = append_gradient_clip_ops(params_grads)
+        params_grads = append_regularization_ops(params_grads,
+                                                 self.regularization)
+        block = framework.default_main_program().global_block()
+        self._create_accumulators(
+            block, [pg[0] for pg in params_grads])
+        ops = []
+        for pg in params_grads:
+            ops.append(self._append_optimize_op(block, pg))
+        self._finish_update(block, params_grads)
+        return ops
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        if in_dygraph_mode():
+            return self._minimize_dygraph(loss, parameter_list, no_grad_set)
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        optimize_ops = self.apply_gradients(params_grads)
+        return optimize_ops, params_grads
+
+    # -- dygraph eager path ------------------------------------------------
+    def _minimize_dygraph(self, loss, parameter_list=None, no_grad_set=None):
+        from .dygraph import base as dy_base
+
+        params = parameter_list or self._parameter_list
+        if params is None:
+            raise ValueError(
+                "dygraph optimizer needs parameter_list (pass "
+                "parameter_list=layer.parameters())")
+        if not getattr(loss, "_backward_ran", False):
+            loss.backward()
+        params_grads = [(p, p._grad_tensor()) for p in params
+                        if getattr(p, "trainable", True)
+                        and p._grad_tensor() is not None]
+        self._dygraph_step(params_grads)
+        return [], params_grads
+
+    def _dygraph_step(self, params_grads):
+        from .dygraph import base as dy_base
+
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = self.current_step_lr()
+        lr_t = dy_base.to_tensor_value(np.asarray([lr], np.float32))
+        for p, g in params_grads:
+            if self.regularization is not None:
+                g = self.regularization._eager_apply(p, g)
+            self._eager_update(p, g, lr_t)
+
+    def _eager_update(self, param, grad, lr_t):
+        raise NotImplementedError(
+            "%s: dygraph update not implemented" % type(self).__name__)
+
+    def clear_gradients(self):
+        pass
+
+    def state_dict(self):
+        out = {}
+        for name, accs in self._accumulators.items():
+            for pname, var in accs.items():
+                out["%s_%s" % (pname, name)] = var
+        return out
+
+    def set_state_dict(self, d):
+        pass
+
+
+# ---------------------------------------------------------------------------
+
+class SGDOptimizer(Optimizer):
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            type="sgd",
+            inputs={"Param": [p], "Grad": [g],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [p]})
+
+    def _eager_update(self, p, g, lr_t):
+        from .dygraph import base as dy_base
+
+        out = dy_base.raw_op("sgd",
+                             {"Param": [p._value()], "Grad": [g._value()],
+                              "LearningRate": [lr_t]}, {},
+                             ["ParamOut"])
+        p._assign_raw(out[0])
+
+
+class MomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate, momentum=0.9, use_nesterov=False,
+                 **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        v = self._get_accumulator("velocity", p)
+        return block.append_op(
+            type="momentum",
+            inputs={"Param": [p], "Grad": [g], "Velocity": [v],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [p], "VelocityOut": [v]},
+            attrs={"mu": self._momentum,
+                   "use_nesterov": self._use_nesterov})
+
+    def _eager_update(self, p, g, lr_t):
+        from .dygraph import base as dy_base
+
+        v = self._add_accumulator("velocity", p)
+        out = dy_base.raw_op(
+            "momentum",
+            {"Param": [p._value()], "Grad": [g._value()],
+             "Velocity": [v._value()], "LearningRate": [lr_t]},
+            {"mu": self._momentum, "use_nesterov": self._use_nesterov},
+            ["ParamOut", "VelocityOut"])
+        p._assign_raw(out[0])
+        v._assign_raw(out[1])
+
+
+class LarsMomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, epsilon=0.0, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        v = self._get_accumulator("velocity", p)
+        return block.append_op(
+            type="lars_momentum",
+            inputs={"Param": [p], "Grad": [g], "Velocity": [v],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [p], "VelocityOut": [v]},
+            attrs={"mu": self._momentum, "lars_coeff": self._lars_coeff,
+                   "lars_weight_decay": self._lars_weight_decay,
+                   "epsilon": self._epsilon})
+
+
+class AdamOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_mode=False, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow_acc", p, shape=[1],
+                                  fill_value=self._beta1)
+            self._add_accumulator("beta2_pow_acc", p, shape=[1],
+                                  fill_value=self._beta2)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m1 = self._get_accumulator("moment1", p)
+        m2 = self._get_accumulator("moment2", p)
+        b1p = self._get_accumulator("beta1_pow_acc", p)
+        b2p = self._get_accumulator("beta2_pow_acc", p)
+        return block.append_op(
+            type=self._op_type(),
+            inputs={"Param": [p], "Grad": [g], "Moment1": [m1],
+                    "Moment2": [m2], "Beta1Pow": [b1p], "Beta2Pow": [b2p],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [p], "Moment1Out": [m1],
+                     "Moment2Out": [m2], "Beta1PowOut": [b1p],
+                     "Beta2PowOut": [b2p]},
+            attrs=self._op_attrs(p))
+
+    def _op_type(self):
+        return "adam"
+
+    def _op_attrs(self, p):
+        return {"beta1": self._beta1, "beta2": self._beta2,
+                "epsilon": self._epsilon}
+
+    def _eager_update(self, p, g, lr_t):
+        from .dygraph import base as dy_base
+
+        m1 = self._add_accumulator("moment1", p)
+        m2 = self._add_accumulator("moment2", p)
+        b1p = self._add_accumulator("beta1_pow_acc", p, shape=[1],
+                                    fill_value=self._beta1)
+        b2p = self._add_accumulator("beta2_pow_acc", p, shape=[1],
+                                    fill_value=self._beta2)
+        out = dy_base.raw_op(
+            self._op_type(),
+            {"Param": [p._value()], "Grad": [g._value()],
+             "Moment1": [m1._value()], "Moment2": [m2._value()],
+             "Beta1Pow": [b1p._value()], "Beta2Pow": [b2p._value()],
+             "LearningRate": [lr_t]},
+            self._op_attrs(p),
+            ["ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut",
+             "Beta2PowOut"])
+        p._assign_raw(out[0])
+        m1._assign_raw(out[1])
+        m2._assign_raw(out[2])
+        b1p._assign_raw(out[3])
+        b2p._assign_raw(out[4])
+
+
+class AdamWOptimizer(AdamOptimizer):
+    def __init__(self, learning_rate=0.001, weight_decay=0.01, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._coeff = weight_decay
+
+    def _op_type(self):
+        return "adamw"
+
+    def _op_attrs(self, p):
+        a = super()._op_attrs(p)
+        a["coeff"] = self._coeff
+        return a
+
+
+class LambOptimizer(AdamOptimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6,
+                 exclude_from_weight_decay_fn=None, **kwargs):
+        super().__init__(learning_rate, beta1=beta1, beta2=beta2,
+                         epsilon=epsilon, **kwargs)
+        self._weight_decay = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _op_type(self):
+        return "lamb"
+
+    def _op_attrs(self, p):
+        wd = self._weight_decay
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            wd = 0.0
+        return {"beta1": self._beta1, "beta2": self._beta2,
+                "epsilon": self._epsilon, "weight_decay": wd}
+
+
+class AdamaxOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+            self._add_accumulator("inf_norm", p)
+            self._add_accumulator("beta1_pow_acc", p, shape=[1],
+                                  fill_value=self._beta1)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            type="adamax",
+            inputs={"Param": [p], "Grad": [g],
+                    "Moment": [self._get_accumulator("moment", p)],
+                    "InfNorm": [self._get_accumulator("inf_norm", p)],
+                    "Beta1Pow": [self._get_accumulator("beta1_pow_acc", p)],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [p],
+                     "MomentOut": [self._get_accumulator("moment", p)],
+                     "InfNormOut": [self._get_accumulator("inf_norm", p)]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon})
+
+    def _finish_update(self, block, params_grads):
+        for p, g in params_grads:
+            b1p = self._get_accumulator("beta1_pow_acc", p)
+            block.append_op(
+                type="scale", inputs={"X": [b1p]}, outputs={"Out": [b1p]},
+                attrs={"scale": self._beta1, "bias": 0.0,
+                       "bias_after_scale": True})
+
+
+class AdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, initial_accumulator_value=0.0,
+                 **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._epsilon = epsilon
+        self._initial = initial_accumulator_value
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p, fill_value=self._initial)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._get_accumulator("moment", p)
+        return block.append_op(
+            type="adagrad",
+            inputs={"Param": [p], "Grad": [g], "Moment": [m],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [p], "MomentOut": [m]},
+            attrs={"epsilon": self._epsilon})
+
+
+class DecayedAdagradOptimizer(AdagradOptimizer):
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kwargs):
+        super().__init__(learning_rate, epsilon=epsilon, **kwargs)
+        self._decay = decay
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._get_accumulator("moment", p)
+        return block.append_op(
+            type="decayed_adagrad",
+            inputs={"Param": [p], "Grad": [g], "Moment": [m],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [p], "MomentOut": [m]},
+            attrs={"decay": self._decay, "epsilon": self._epsilon})
+
+
+class AdadeltaOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("avg_squared_grad", p)
+            self._add_accumulator("avg_squared_update", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        asg = self._get_accumulator("avg_squared_grad", p)
+        asu = self._get_accumulator("avg_squared_update", p)
+        return block.append_op(
+            type="adadelta",
+            inputs={"Param": [p], "Grad": [g], "AvgSquaredGrad": [asg],
+                    "AvgSquaredUpdate": [asu]},
+            outputs={"ParamOut": [p], "AvgSquaredGradOut": [asg],
+                     "AvgSquaredUpdateOut": [asu]},
+            attrs={"epsilon": self._epsilon, "rho": self._rho})
+
+
+class RMSPropOptimizer(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("mean_square", p)
+            self._add_accumulator("momentum_acc", p)
+            if self._centered:
+                self._add_accumulator("mean_grad", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        ms = self._get_accumulator("mean_square", p)
+        mom = self._get_accumulator("momentum_acc", p)
+        ins = {"Param": [p], "Grad": [g], "MeanSquare": [ms],
+               "Moment": [mom],
+               "LearningRate": [self._create_param_lr(param_and_grad)]}
+        outs = {"ParamOut": [p], "MeanSquareOut": [ms], "MomentOut": [mom]}
+        if self._centered:
+            mg = self._get_accumulator("mean_grad", p)
+            ins["MeanGrad"] = [mg]
+            outs["MeanGradOut"] = [mg]
+        return block.append_op(
+            type="rmsprop", inputs=ins, outputs=outs,
+            attrs={"decay": self._rho, "epsilon": self._epsilon,
+                   "momentum": self._momentum, "centered": self._centered})
+
+
+class FtrlOptimizer(Optimizer):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
+                 **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("squared", p)
+            self._add_accumulator("linear", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        sq = self._get_accumulator("squared", p)
+        lin = self._get_accumulator("linear", p)
+        return block.append_op(
+            type="ftrl",
+            inputs={"Param": [p], "Grad": [g], "SquaredAccumulator": [sq],
+                    "LinearAccumulator": [lin],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [p], "SquaredAccumOut": [sq],
+                     "LinearAccumOut": [lin]},
+            attrs={"l1": self._l1, "l2": self._l2,
+                   "lr_power": self._lr_power})
+
+
+class DpsgdOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, clip=10.0, batch_size=16.0,
+                 sigma=1.0, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._clip, self._batch_size, self._sigma = clip, batch_size, sigma
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            type="dpsgd",
+            inputs={"Param": [p], "Grad": [g],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [p]},
+            attrs={"clip": self._clip, "batch_size": self._batch_size,
+                   "sigma": self._sigma})
+
+
+class DGCMomentumOptimizer(MomentumOptimizer):
+    """API-parity stub: DGC top-k grad compression targets PCIe-bound GPU
+    clusters (reference: optimizers/dgc_momentum_op.cc); on TPU the ICI
+    fabric makes dense psum faster, so this degrades to Momentum
+    (SURVEY.md §2.3 marks DGC low-priority on TPU)."""
+
+    def __init__(self, learning_rate, momentum, rampup_begin_step=0,
+                 **kwargs):
+        kwargs.pop("rampup_step", None)
+        kwargs.pop("sparsity", None)
+        super().__init__(learning_rate, momentum, **kwargs)
+
+
+class ModelAverage(Optimizer):
+    """Running average of params (reference: optimizer.py:3075)."""
+
+    def __init__(self, average_window_rate, min_average_window=10000,
+                 max_average_window=10000, **kwargs):
+        super().__init__(0.0, **kwargs)
+        self.average_window = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+
+    def minimize(self, *a, **k):
+        raise NotImplementedError(
+            "ModelAverage wraps an inner optimizer; use apply()")
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            yield
+
+        return ctx()
+
+    def restore(self, executor=None):
+        pass
+
+
+class ExponentialMovingAverage:
+    """EMA of params (reference: optimizer.py:3384)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._name = name or "ema"
+        self._shadow = {}
+
+    def update(self):
+        program = framework.default_main_program()
+        block = program.global_block()
+        helper = LayerHelper(self._name)
+        for p in block.all_parameters():
+            if not p.trainable:
+                continue
+            if p.name not in self._shadow:
+                shadow = helper.create_global_variable(
+                    name=unique_name(p.name + "_ema"), shape=list(p.shape),
+                    dtype=p.dtype, persistable=True)
+                helper.set_variable_initializer(shadow,
+                                                ConstantInitializer(0.0))
+                self._shadow[p.name] = shadow
+            shadow = self._shadow[p.name]
+            # shadow = decay*shadow + (1-decay)*param
+            block.append_op(
+                type="scale", inputs={"X": [shadow]},
+                outputs={"Out": [shadow]},
+                attrs={"scale": self._decay, "bias": 0.0,
+                       "bias_after_scale": True})
+            tmp = block.create_var(name=unique_name("ema_tmp"),
+                                   shape=p.shape, dtype=p.dtype)
+            block.append_op(
+                type="scale", inputs={"X": [p]}, outputs={"Out": [tmp]},
+                attrs={"scale": 1.0 - self._decay, "bias": 0.0,
+                       "bias_after_scale": True})
+            block.append_op(type="elementwise_add",
+                            inputs={"X": [shadow], "Y": [tmp]},
+                            outputs={"Out": [shadow]}, attrs={"axis": -1})
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            yield
+
+        return ctx()
+
+    def restore(self, executor=None):
+        pass
+
+
+class RecomputeOptimizer(Optimizer):
+    """Activation checkpointing (reference: optimizer.py:4485). TPU-native:
+    gradient rematerialisation is jax.checkpoint applied during the vjp
+    section; checkpoint vars are recorded on the backward op so lowering
+    can segment the forward into remat blocks."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+        self._checkpoints = None
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = checkpoints
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        result = self._optimizer.minimize(loss, startup_program,
+                                          parameter_list, no_grad_set)
+        if self._checkpoints:
+            block = loss.block
+            for op in block.ops:
+                if op.type == "backward":
+                    op.attrs["checkpoints"] = [
+                        v.name if isinstance(v, Variable) else v
+                        for v in self._checkpoints]
+        return result
+
+
+class LookaheadOptimizer:
+    """Lookahead wrapper (reference: optimizer.py:4777)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+
+    def minimize(self, loss, startup_program=None):
+        return self.inner_optimizer.minimize(
+            loss, startup_program=startup_program)
+
+
+class PipelineOptimizer:
+    """Pipeline-parallel program splitter (reference: optimizer.py:3634 +
+    pipeline_trainer.cc). The TPU-native pipeline engine lives in
+    paddle_tpu.parallel.pipeline (shard_map + ppermute microbatching);
+    this wrapper keeps the fluid API and trains non-pipelined on one mesh
+    until stage annotations are present."""
+
+    def __init__(self, optimizer, cut_list=None, place_list=None,
+                 concurrency_list=None, queue_size=30, sync_steps=1,
+                 start_cpu_core_id=0, num_microbatches=1):
+        self._optimizer = optimizer
+        self._cut_list = cut_list
+        self._num_microbatches = num_microbatches
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self._optimizer.minimize(loss, startup_program,
+                                        parameter_list, no_grad_set)
+
+
+# paddle 2.0-style aliases
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adam = AdamOptimizer
+AdamW = AdamWOptimizer
+Adamax = AdamaxOptimizer
+Adagrad = AdagradOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+Adadelta = AdadeltaOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer
+Lamb = LambOptimizer
+LarsMomentum = LarsMomentumOptimizer
+Dpsgd = DpsgdOptimizer

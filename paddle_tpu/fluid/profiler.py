@@ -1,0 +1,109 @@
+"""Profiler (reference: `python/paddle/fluid/profiler.py:39-255` over
+`platform/profiler.cc` + CUPTI DeviceTracer).
+
+TPU-native: the device tracer is jax.profiler (XPlane/perfetto, viewable in
+TensorBoard or chrome://tracing); the `profiler(state, tracer_option,
+profile_path)` context-manager API is preserved. RecordEvent maps to
+jax.profiler.TraceAnnotation.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from collections import defaultdict
+
+_host_events = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
+
+
+class RecordEvent:
+    """Host-side RAII event (reference: platform/profiler.h:126);
+    also emits a device trace annotation when a jax trace is active."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._t0 = None
+        self._ann = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        try:
+            import jax.profiler
+
+            self._ann = jax.profiler.TraceAnnotation(self.name)
+            self._ann.__enter__()
+        except Exception:
+            self._ann = None
+        return self
+
+    def __exit__(self, *a):
+        dt = time.perf_counter() - self._t0
+        ev = _host_events[self.name]
+        ev[0] += 1
+        ev[1] += dt
+        if self._ann is not None:
+            self._ann.__exit__(*a)
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/profile",
+             tracer_option="Default"):
+    """Context manager (reference: profiler.py:255). Writes a jax trace to
+    profile_path (a directory) viewable in TensorBoard."""
+    started = False
+    try:
+        import jax.profiler
+
+        os.makedirs(profile_path, exist_ok=True)
+        jax.profiler.start_trace(profile_path)
+        started = True
+    except Exception:
+        pass
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        wall = time.perf_counter() - t0
+        if started:
+            import jax.profiler
+
+            jax.profiler.stop_trace()
+        if sorted_key:
+            print_profiler_summary(wall)
+
+
+def start_profiler(state="All", tracer_option="Default",
+                   profile_path="/tmp/profile"):
+    import jax.profiler
+
+    os.makedirs(profile_path, exist_ok=True)
+    jax.profiler.start_trace(profile_path)
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    import jax.profiler
+
+    jax.profiler.stop_trace()
+
+
+def reset_profiler():
+    _host_events.clear()
+
+
+def print_profiler_summary(wall=None):
+    rows = sorted(_host_events.items(), key=lambda kv: -kv[1][1])
+    print("%-40s %10s %14s" % ("Event", "Calls", "Total(ms)"))
+    for name, (cnt, total) in rows[:50]:
+        print("%-40s %10d %14.3f" % (name, cnt, total * 1e3))
+    if wall is not None:
+        print("wall: %.3f s" % wall)
+
+
+@contextlib.contextmanager
+def cuda_profiler(*a, **k):
+    """nvprof shim — no-op on TPU; kept for script compatibility."""
+    yield
+
+
+def npu_profiler(*a, **k):
+    return cuda_profiler()

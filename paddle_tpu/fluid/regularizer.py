@@ -1,0 +1,82 @@
+"""Weight decay regularizers (reference:
+`python/paddle/fluid/regularizer.py`)."""
+from __future__ import annotations
+
+
+class WeightDecayRegularizer:
+    def _append(self, block, param, grad):
+        raise NotImplementedError
+
+    def _eager_apply(self, param, grad):
+        raise NotImplementedError
+
+
+class L2DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def _append(self, block, param, grad):
+        # grad = grad + coeff * param  (written back onto the grad name; the
+        # SSA env in lowering rebinds it)
+        from .framework import unique_name
+
+        tmp = block.create_var(name=unique_name("l2_decay"),
+                               shape=param.shape, dtype=param.dtype)
+        block.append_op(type="scale", inputs={"X": [param]},
+                        outputs={"Out": [tmp]},
+                        attrs={"scale": self._coeff, "bias": 0.0,
+                               "bias_after_scale": True})
+        block.append_op(type="elementwise_add",
+                        inputs={"X": [grad], "Y": [tmp]},
+                        outputs={"Out": [grad]}, attrs={"axis": -1})
+        return grad
+
+    def _eager_apply(self, param, grad):
+        from .dygraph import base as dy_base
+
+        out = dy_base.raw_op(
+            "scale", {"X": [param._value()]},
+            {"scale": self._coeff, "bias": 0.0, "bias_after_scale": True},
+            ["Out"])
+        summed = dy_base.raw_op(
+            "elementwise_add", {"X": [grad._value()], "Y": [out[0]]},
+            {"axis": -1}, ["Out"])
+        return dy_base.wrap_raw(summed[0])
+
+
+class L1DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def _append(self, block, param, grad):
+        from .framework import unique_name
+
+        sign = block.create_var(name=unique_name("l1_sign"),
+                                shape=param.shape, dtype=param.dtype)
+        block.append_op(type="sign", inputs={"X": [param]},
+                        outputs={"Out": [sign]})
+        block.append_op(type="scale", inputs={"X": [sign]},
+                        outputs={"Out": [sign]},
+                        attrs={"scale": self._coeff, "bias": 0.0,
+                               "bias_after_scale": True})
+        block.append_op(type="elementwise_add",
+                        inputs={"X": [grad], "Y": [sign]},
+                        outputs={"Out": [grad]}, attrs={"axis": -1})
+        return grad
+
+
+def append_regularization_ops(params_grads, regularization=None):
+    out = []
+    for p, g in params_grads:
+        reg = getattr(p, "regularizer", None) or regularization
+        if reg is not None and g is not None:
+            from .framework import in_dygraph_mode
+
+            if not in_dygraph_mode():
+                reg._append(g.block, p, g)
+        out.append((p, g))
+    return out
+
+
+L1Decay = L1DecayRegularizer
+L2Decay = L2DecayRegularizer

@@ -1,0 +1,25 @@
+"""Dataset-training entry points (reference: `Executor::RunFromDataset`
+`framework/executor.cc:170`, MultiTrainer/HogwildWorker loops
+`framework/hogwild_worker.cc`).
+
+TPU-native: the per-thread Hogwild op loop is replaced by iterating the
+dataset's batch stream through the same compiled train step; XLA pipelines
+host feeding against device compute.
+"""
+from __future__ import annotations
+
+
+def train_from_dataset(executor, program, dataset, scope=None,
+                       fetch_list=None, print_period=100):
+    if dataset is None:
+        raise ValueError("dataset is required")
+    from . import framework
+
+    program = program or framework.default_main_program()
+    it = 0
+    results = None
+    for feed in dataset._iter_batches():
+        results = executor.run(program, feed=feed,
+                               fetch_list=fetch_list, scope=scope)
+        it += 1
+    return results

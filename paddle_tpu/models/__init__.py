@@ -1,0 +1,1 @@
+"""Model zoo: reference workloads from BASELINE.json configs."""

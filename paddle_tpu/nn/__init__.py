@@ -1,0 +1,81 @@
+"""paddle.nn 2.0-style surface (reference: `python/paddle/nn/`) — thin
+re-exports over the fluid dygraph layer library."""
+from ..fluid.dygraph.layers import (  # noqa: F401
+    Layer, Sequential, LayerList, ParameterList,
+)
+from ..fluid.dygraph.nn import (  # noqa: F401
+    Linear, Conv2D, Pool2D, BatchNorm, LayerNorm, Embedding, Dropout,
+)
+from . import functional  # noqa: F401
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return functional.relu(x)
+
+
+class GELU(Layer):
+    def __init__(self, approximate=False):
+        super().__init__()
+        self._approx = approximate
+
+    def forward(self, x):
+        return functional.gelu(x, approximate=self._approx)
+
+
+class Sigmoid(Layer):
+    def forward(self, x):
+        return functional.sigmoid(x)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return functional.softmax(x, axis=self._axis)
+
+
+class Tanh(Layer):
+    def forward(self, x):
+        return functional.tanh(x)
+
+
+class CrossEntropyLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", ignore_index=-100,
+                 soft_label=False):
+        super().__init__()
+        self._reduction = reduction
+        self._ignore_index = ignore_index
+        self._soft_label = soft_label
+
+    def forward(self, input, label):
+        from ..fluid.layers import loss as L
+        from ..fluid.layers import nn as N
+
+        out = L.softmax_with_cross_entropy(
+            input, label, soft_label=self._soft_label,
+            ignore_index=self._ignore_index)
+        if self._reduction == "mean":
+            return N.mean(out)
+        if self._reduction == "sum":
+            return N.reduce_sum(out)
+        return out
+
+
+class MSELoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        from ..fluid.layers import loss as L
+        from ..fluid.layers import nn as N
+
+        out = L.square_error_cost(input, label)
+        if self._reduction == "mean":
+            return N.mean(out)
+        if self._reduction == "sum":
+            return N.reduce_sum(out)
+        return out

@@ -1,0 +1,12 @@
+"""paddle.nn.functional (reference: `python/paddle/nn/functional/`) — the
+mode-polymorphic layer functions re-exported."""
+from ..fluid.layers.nn import (  # noqa: F401
+    relu, sigmoid, tanh, gelu, leaky_relu, elu, relu6, softplus, softsign,
+    swish, hard_sigmoid, hard_swish, logsigmoid, erf, softmax, log_softmax,
+    dropout, matmul, one_hot, pad, pad2d, clip,
+)
+from ..fluid.layers.loss import (  # noqa: F401
+    cross_entropy, softmax_with_cross_entropy,
+    sigmoid_cross_entropy_with_logits, square_error_cost, mse_loss,
+    kldiv_loss,
+)

@@ -1,0 +1,18 @@
+"""paddle_tpu.ops — the operator library (pure jax compute functions).
+
+Importing this package registers all operators. Reference parity:
+`paddle/fluid/operators/` (~435 op types); coverage grows per SURVEY.md §2.
+"""
+from .registry import (  # noqa: F401
+    register_op, get_op, has_op, registered_ops, run_op, eager_run,
+    infer_outputs, normalize_outs,
+)
+
+from . import math_ops  # noqa: F401
+from . import nn_ops  # noqa: F401
+from . import tensor_ops  # noqa: F401
+from . import rng_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import metric_ops  # noqa: F401
+from . import collective_ops  # noqa: F401
+from . import sequence_ops  # noqa: F401

@@ -1,0 +1,177 @@
+"""Collective communication operators — ICI-native.
+
+Reference parity: `paddle/fluid/operators/collective/` — c_allreduce_{sum,
+max,min,prod}, c_broadcast, c_allgather, c_reducescatter, c_comm_init,
+c_gen_nccl_id, c_sync_calc_stream, c_sync_comm_stream (kernels call
+ncclAllReduce etc., `c_allreduce_op.h:58-105`).
+
+TPU-native design: there is no NCCL communicator object. A `ring_id` maps to
+a *mesh axis name* (registry in `paddle_tpu.parallel.env`); when the program
+is lowered under `shard_map` over a `jax.sharding.Mesh`, these ops emit XLA
+collectives (`lax.psum` / `all_gather` / `psum_scatter`) which XLA schedules
+over ICI. Outside any mesh (single chip) they are identities, matching
+single-process semantics. Stream-sync ops are no-ops: XLA's dataflow
+schedule replaces explicit stream synchronisation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+
+def _axis_for(attrs):
+    from ..parallel import env
+
+    ring_id = attrs.get("ring_id", 0)
+    return env.axis_name_for_ring(ring_id)
+
+
+def _register_allreduce(suffix, monoid):
+    @register_op("c_allreduce_" + suffix)
+    def _c_allreduce(ins, attrs, _monoid=monoid):
+        x = ins["X"][0]
+        axis = _axis_for(attrs)
+        if axis is None:
+            return {"Out": x}
+        return {"Out": _monoid(x, axis)}
+
+
+_register_allreduce("sum", lambda x, ax: lax.psum(x, ax))
+_register_allreduce("max", lambda x, ax: lax.pmax(x, ax))
+_register_allreduce("min", lambda x, ax: lax.pmin(x, ax))
+_register_allreduce("prod", lambda x, ax: jnp.exp(
+    lax.psum(jnp.log(x), ax)))
+
+
+@register_op("c_broadcast")
+def _c_broadcast(ins, attrs):
+    x = ins["X"][0]
+    axis = _axis_for(attrs)
+    if axis is None:
+        return {"Out": x}
+    root = attrs.get("root", 0)
+    idx = lax.axis_index(axis)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return {"Out": lax.psum(masked, axis)}
+
+
+@register_op("c_allgather")
+def _c_allgather(ins, attrs):
+    x = ins["X"][0]
+    axis = _axis_for(attrs)
+    if axis is None:
+        return {"Out": x}
+    return {"Out": lax.all_gather(x, axis, tiled=True)}
+
+
+@register_op("c_reducescatter")
+def _c_reducescatter(ins, attrs):
+    x = ins["X"][0]
+    axis = _axis_for(attrs)
+    if axis is None:
+        return {"Out": x}
+    return {"Out": lax.psum_scatter(x, axis, tiled=True)}
+
+
+@register_op("c_reduce_sum")
+def _c_reduce_sum(ins, attrs):
+    x = ins["X"][0]
+    axis = _axis_for(attrs)
+    if axis is None:
+        return {"Out": x}
+    # reduce-to-root: root keeps the sum, others keep their input (the
+    # reference only defines the root's output).
+    total = lax.psum(x, axis)
+    idx = lax.axis_index(axis)
+    return {"Out": jnp.where(idx == attrs.get("root_id", 0), total, x)}
+
+
+@register_op("alltoall")
+def _alltoall(ins, attrs):
+    x = ins["X"][0]
+    axis = _axis_for(attrs)
+    if axis is None:
+        return {"Out": x}
+    from ..parallel import env
+
+    n = env.axis_size_for_ring(attrs.get("ring_id", 0))
+    xs = x.reshape((n, x.shape[0] // n) + x.shape[1:])
+    out = lax.all_to_all(xs, axis, split_axis=0, concat_axis=0, tiled=False)
+    return {"Out": out.reshape(x.shape)}
+
+
+@register_op("c_concat")
+def _c_concat(ins, attrs):
+    x = ins["X"][0]
+    axis = _axis_for(attrs)
+    if axis is None:
+        return {"Out": x}
+    return {"Out": lax.all_gather(x, axis, axis=x.ndim - 1, tiled=True)}
+
+
+@register_op("c_split")
+def _c_split(ins, attrs):
+    x = ins["X"][0]
+    axis = _axis_for(attrs)
+    if axis is None:
+        return {"Out": x}
+    from ..parallel import env
+
+    n = env.axis_size_for_ring(attrs.get("ring_id", 0))
+    idx = lax.axis_index(axis)
+    piece = x.shape[-1] // n
+    return {"Out": lax.dynamic_slice_in_dim(x, idx * piece, piece, x.ndim - 1)}
+
+
+@register_op("c_embedding")
+def _c_embedding(ins, attrs):
+    # vocab-sharded embedding lookup: local partial lookup + psum
+    w, ids = ins["W"][0], ins["Ids"][0]
+    axis = _axis_for(attrs)
+    start = attrs.get("start_index", 0)
+    local_ids = ids.astype(jnp.int32) - start
+    valid = (local_ids >= 0) & (local_ids < w.shape[0])
+    out = jnp.take(w, jnp.clip(local_ids, 0, w.shape[0] - 1), axis=0)
+    out = jnp.where(valid[..., None], out, jnp.zeros_like(out))
+    if axis is not None:
+        out = lax.psum(out, axis)
+    return {"Out": out}
+
+
+@register_op("c_identity")
+def _c_identity(ins, attrs):
+    return {"Out": ins["X"][0]}
+
+
+@register_op("c_sync_calc_stream")
+def _c_sync_calc(ins, attrs):
+    # XLA's dataflow schedule subsumes stream sync — identity.
+    return {"Out": ins["X"][0]}
+
+
+@register_op("c_sync_comm_stream")
+def _c_sync_comm(ins, attrs):
+    return {"Out": [x for x in ins["X"]]}
+
+
+@register_op("allreduce")
+def _legacy_allreduce(ins, attrs):
+    # legacy operators/distributed_ops/allreduce_op.cc
+    x = ins["X"][0]
+    axis = _axis_for({"ring_id": 0})
+    red = attrs.get("reduce_type", 0)
+    if axis is None:
+        return {"Out": x}
+    fns = {0: lax.psum, 1: lax.pmax, 2: lax.pmin}
+    if red in fns:
+        return {"Out": fns[red](x, axis)}
+    return {"Out": jnp.exp(lax.psum(jnp.log(x), axis))}
+
+
+@register_op("broadcast")
+def _legacy_broadcast(ins, attrs):
+    return _c_broadcast({"X": ins["X"]},
+                        {"ring_id": 0, "root": attrs.get("root", 0)})

@@ -1,0 +1,72 @@
+"""Metric operators. Reference: `paddle/fluid/operators/metrics/`
+(accuracy_op.cc, auc_op.cc, precision_recall_op.cc).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+@register_op("accuracy")
+def _accuracy(ins, attrs):
+    # reference: metrics/accuracy_op.cc — inputs Out (topk values),
+    # Indices (topk indices [N,k]), Label [N,1]
+    indices = ins["Indices"][0]
+    label = ins["Label"][0].reshape((-1, 1)).astype(indices.dtype)
+    correct_row = jnp.any(indices == label, axis=1)
+    num_correct = jnp.sum(correct_row.astype(jnp.int32))
+    total = indices.shape[0]
+    acc = (num_correct.astype(jnp.float32) / total).reshape((1,))
+    return {"Accuracy": acc,
+            "Correct": num_correct.reshape((1,)),
+            "Total": jnp.full((1,), total, jnp.int32)}
+
+
+@register_op("auc")
+def _auc(ins, attrs):
+    # streaming AUC with histogram stat buffers (reference: metrics/auc_op.cc)
+    predict = ins["Predict"][0]
+    label = ins["Label"][0].reshape((-1,))
+    stat_pos = ins["StatPos"][0]
+    stat_neg = ins["StatNeg"][0]
+    num_thresholds = attrs.get("num_thresholds", 4095)
+    pos_prob = predict[:, -1] if predict.ndim == 2 else predict
+    bucket = jnp.clip((pos_prob * num_thresholds).astype(jnp.int32),
+                      0, num_thresholds)
+    is_pos = (label > 0)
+    pos_hist = jnp.zeros_like(stat_pos).at[bucket].add(
+        is_pos.astype(stat_pos.dtype))
+    neg_hist = jnp.zeros_like(stat_neg).at[bucket].add(
+        (~is_pos).astype(stat_neg.dtype))
+    new_pos = stat_pos + pos_hist
+    new_neg = stat_neg + neg_hist
+    # integrate trapezoid over descending threshold
+    tot_pos = jnp.cumsum(new_pos[::-1])
+    tot_neg = jnp.cumsum(new_neg[::-1])
+    area = jnp.sum((tot_neg - jnp.concatenate([jnp.zeros(1, tot_neg.dtype),
+                                               tot_neg[:-1]]))
+                   * (jnp.concatenate([jnp.zeros(1, tot_pos.dtype),
+                                       tot_pos[:-1]]) + tot_pos) / 2.0)
+    denom = tot_pos[-1] * tot_neg[-1]
+    auc = jnp.where(denom > 0, area / jnp.maximum(denom, 1), 0.0)
+    return {"AUC": auc.astype(jnp.float64).reshape((1,)),
+            "StatPosOut": new_pos, "StatNegOut": new_neg}
+
+
+@register_op("mean_iou")
+def _mean_iou(ins, attrs):
+    pred = ins["Predictions"][0].reshape((-1,)).astype(jnp.int32)
+    label = ins["Labels"][0].reshape((-1,)).astype(jnp.int32)
+    n = attrs["num_classes"]
+    inter = jnp.zeros((n,), jnp.float32).at[
+        jnp.where(pred == label, pred, n - 1)].add(
+        (pred == label).astype(jnp.float32))
+    pred_cnt = jnp.zeros((n,), jnp.float32).at[pred].add(1.0)
+    label_cnt = jnp.zeros((n,), jnp.float32).at[label].add(1.0)
+    union = pred_cnt + label_cnt - inter
+    iou = jnp.where(union > 0, inter / jnp.maximum(union, 1e-9), 0.0)
+    valid = (union > 0).astype(jnp.float32)
+    mean = jnp.sum(iou) / jnp.maximum(jnp.sum(valid), 1.0)
+    return {"OutMeanIou": mean.reshape((1,)), "OutWrong": pred_cnt - inter,
+            "OutCorrect": inter}

@@ -1,0 +1,514 @@
+"""Neural-network operators: conv/pool/norm/activation/softmax/dropout/embedding.
+
+Reference parity: `paddle/fluid/operators/conv_op.cc`+`conv_cudnn_op.cu`,
+`pool_op.cc`, `batch_norm_op.{cc,cu}`, `layer_norm_op.{cc,cu}`,
+`softmax_with_cross_entropy_op.cu`, `activation_op.*`, `dropout_op.*`,
+`lookup_table(_v2)_op.*`. TPU-native notes: convs/matmuls map to the MXU via
+`lax.conv_general_dilated`/`jnp.matmul`; the cudnn algorithm-search attrs
+(exhaustive_search, workspace limits) are obsolete — XLA autotunes; dropout
+uses counter-based stateless PRNG (threefry) instead of the reference's
+curand states.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+
+# ---------------------------------------------------------------------------
+# Activations (reference: operators/activation_op.cc lists ~30)
+# ---------------------------------------------------------------------------
+
+def _register_act(name, fn):
+    @register_op(name)
+    def _act(ins, attrs, _fn=fn):
+        return {"Out": _fn(ins["X"][0], attrs)}
+
+
+_register_act("relu", lambda x, a: jax.nn.relu(x))
+_register_act("sigmoid", lambda x, a: jax.nn.sigmoid(x))
+_register_act("tanh", lambda x, a: jnp.tanh(x))
+_register_act("sqrt", lambda x, a: jnp.sqrt(x))
+_register_act("rsqrt", lambda x, a: lax.rsqrt(x))
+_register_act("square", lambda x, a: jnp.square(x))
+_register_act("exp", lambda x, a: jnp.exp(x))
+_register_act("log", lambda x, a: jnp.log(x))
+_register_act("log2", lambda x, a: jnp.log2(x))
+_register_act("log10", lambda x, a: jnp.log10(x))
+_register_act("log1p", lambda x, a: jnp.log1p(x))
+_register_act("abs", lambda x, a: jnp.abs(x))
+_register_act("ceil", lambda x, a: jnp.ceil(x))
+_register_act("floor", lambda x, a: jnp.floor(x))
+_register_act("round", lambda x, a: jnp.round(x))
+_register_act("reciprocal", lambda x, a: 1.0 / x)
+_register_act("sin", lambda x, a: jnp.sin(x))
+_register_act("cos", lambda x, a: jnp.cos(x))
+_register_act("asin", lambda x, a: jnp.arcsin(x))
+_register_act("acos", lambda x, a: jnp.arccos(x))
+_register_act("atan", lambda x, a: jnp.arctan(x))
+_register_act("sinh", lambda x, a: jnp.sinh(x))
+_register_act("cosh", lambda x, a: jnp.cosh(x))
+_register_act("erf", lambda x, a: jax.scipy.special.erf(x))
+_register_act("softplus", lambda x, a: jax.nn.softplus(x))
+_register_act("softsign", lambda x, a: x / (1 + jnp.abs(x)))
+_register_act("relu6", lambda x, a: jnp.clip(x, 0.0, a.get("threshold", 6.0)))
+_register_act("leaky_relu", lambda x, a: jnp.where(
+    x >= 0, x, x * a.get("alpha", 0.02)))
+_register_act("elu", lambda x, a: jnp.where(
+    x >= 0, x, a.get("alpha", 1.0) * (jnp.exp(x) - 1)))
+_register_act("gelu", lambda x, a: jax.nn.gelu(
+    x, approximate=a.get("approximate", False)))
+_register_act("swish", lambda x, a: x * jax.nn.sigmoid(
+    a.get("beta", 1.0) * x))
+_register_act("silu", lambda x, a: jax.nn.silu(x))
+_register_act("mish", lambda x, a: x * jnp.tanh(jax.nn.softplus(x)))
+_register_act("hard_sigmoid", lambda x, a: jnp.clip(
+    a.get("slope", 0.2) * x + a.get("offset", 0.5), 0.0, 1.0))
+_register_act("hard_swish", lambda x, a: x * jnp.clip(
+    x + a.get("offset", 3.0), 0.0, a.get("threshold", 6.0))
+    / a.get("scale", 6.0))
+_register_act("thresholded_relu", lambda x, a: jnp.where(
+    x > a.get("threshold", 1.0), x, jnp.zeros_like(x)))
+_register_act("logsigmoid", lambda x, a: jax.nn.log_sigmoid(x))
+_register_act("sign", lambda x, a: jnp.sign(x))
+_register_act("stanh", lambda x, a: a.get("scale_b", 1.7159)
+              * jnp.tanh(a.get("scale_a", 0.67) * x))
+
+
+@register_op("prelu")
+def _prelu(ins, attrs):
+    x, alpha = ins["X"][0], ins["Alpha"][0]
+    mode = attrs.get("mode", "all")
+    if mode == "channel":
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return {"Out": jnp.where(x >= 0, x, x * alpha)}
+
+
+@register_op("softmax")
+def _softmax(ins, attrs):
+    return {"Out": jax.nn.softmax(ins["X"][0], axis=attrs.get("axis", -1))}
+
+
+@register_op("log_softmax")
+def _log_softmax(ins, attrs):
+    return {"Out": jax.nn.log_softmax(ins["X"][0], axis=attrs.get("axis", -1))}
+
+
+# ---------------------------------------------------------------------------
+# Losses
+# ---------------------------------------------------------------------------
+
+@register_op("cross_entropy")
+def _cross_entropy(ins, attrs):
+    # reference: operators/cross_entropy_op.cc — input X is probabilities.
+    x, label = ins["X"][0], ins["Label"][0]
+    eps = 1e-9
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(x + eps), axis=-1, keepdims=True)
+    else:
+        idx = label.reshape(label.shape[:-1]).astype(jnp.int32)
+        picked = jnp.take_along_axis(x, idx[..., None], axis=-1)
+        loss = -jnp.log(picked + eps)
+        ignore = attrs.get("ignore_index", -100)
+        loss = jnp.where(idx[..., None] == ignore, jnp.zeros_like(loss), loss)
+    return {"Y": loss}
+
+
+@register_op("softmax_with_cross_entropy")
+def _softmax_ce(ins, attrs):
+    logits, label = ins["Logits"][0], ins["Label"][0]
+    axis = attrs.get("axis", -1)
+    softmax = jax.nn.softmax(logits, axis=axis)
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        idx = label.astype(jnp.int32)
+        squeeze = (idx.ndim == logits.ndim and idx.shape[axis] == 1)
+        if squeeze:
+            idx = jnp.squeeze(idx, axis=axis)
+        loss = -jnp.take_along_axis(logp, idx[..., None], axis=axis)
+        ignore = attrs.get("ignore_index", -100)
+        loss = jnp.where(idx[..., None] == ignore, jnp.zeros_like(loss), loss)
+    return {"Softmax": softmax, "Loss": loss}
+
+
+@register_op("sigmoid_cross_entropy_with_logits")
+def _sigmoid_ce(ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0]
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    ignore = attrs.get("ignore_index", -100)
+    loss = jnp.where(label == ignore, jnp.zeros_like(loss), loss)
+    if attrs.get("normalize", False):
+        n = jnp.sum((label != ignore).astype(loss.dtype))
+        loss = loss / jnp.maximum(n, 1.0)
+    return {"Out": loss}
+
+
+@register_op("square_error_cost")
+def _square_error(ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": jnp.square(x - y)}
+
+
+@register_op("huber_loss")
+def _huber(ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    delta = attrs.get("delta", 1.0)
+    r = y - x
+    a = jnp.abs(r)
+    loss = jnp.where(a <= delta, 0.5 * r * r, delta * (a - 0.5 * delta))
+    return {"Out": loss, "Residual": r}
+
+
+@register_op("smooth_l1_loss")
+def _smooth_l1(ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    sigma = attrs.get("sigma", 1.0)
+    s2 = sigma * sigma
+    diff = x - y
+    a = jnp.abs(diff)
+    elem = jnp.where(a < 1.0 / s2, 0.5 * s2 * diff * diff, a - 0.5 / s2)
+    return {"Out": jnp.sum(elem, axis=-1, keepdims=True), "Diff": diff}
+
+
+@register_op("kldiv_loss")
+def _kldiv(ins, attrs):
+    x, target = ins["X"][0], ins["Target"][0]
+    loss = jnp.where(target > 0, target * (jnp.log(target) - x),
+                     jnp.zeros_like(target))
+    red = attrs.get("reduction", "mean")
+    if red == "mean":
+        loss = jnp.mean(loss).reshape((1,))
+    elif red == "sum":
+        loss = jnp.sum(loss).reshape((1,))
+    elif red == "batchmean":
+        loss = (jnp.sum(loss) / x.shape[0]).reshape((1,))
+    return {"Loss": loss}
+
+
+# ---------------------------------------------------------------------------
+# Convolution / pooling
+# ---------------------------------------------------------------------------
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v] * n
+
+
+@register_op("conv2d")
+def _conv2d(ins, attrs):
+    # reference: operators/conv_op.cc (NCHW input, OIHW filter)
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = _pair(attrs.get("strides", [1, 1]))
+    paddings = _pair(attrs.get("paddings", [0, 0]))
+    dilations = _pair(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1)
+    if len(paddings) == 2:
+        pads = [(paddings[0], paddings[0]), (paddings[1], paddings[1])]
+    else:
+        pads = [(paddings[0], paddings[1]), (paddings[2], paddings[3])]
+    out = lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=pads,
+        rhs_dilation=dilations, feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=None)
+    return {"Output": out}
+
+
+@register_op("depthwise_conv2d")
+def _depthwise_conv2d(ins, attrs):
+    return _conv2d(ins, attrs)
+
+
+@register_op("conv2d_transpose")
+def _conv2d_transpose(ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = _pair(attrs.get("strides", [1, 1]))
+    paddings = _pair(attrs.get("paddings", [0, 0]))
+    dilations = _pair(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1)
+    pads = [(paddings[0], paddings[0]), (paddings[1], paddings[1])]
+    # gradient-of-conv formulation: transposed conv = lhs-dilated conv.
+    out = lax.conv_transpose(
+        x, w, strides=strides, padding=pads, rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        transpose_kernel=True)
+    if groups != 1:
+        raise NotImplementedError("grouped conv2d_transpose")
+    return {"Output": out}
+
+
+@register_op("pool2d")
+def _pool2d(ins, attrs):
+    x = ins["X"][0]
+    ptype = attrs.get("pooling_type", "max")
+    ksize = _pair(attrs.get("ksize", [2, 2]))
+    strides = _pair(attrs.get("strides", ksize))
+    paddings = _pair(attrs.get("paddings", [0, 0]))
+    ceil_mode = attrs.get("ceil_mode", False)
+    exclusive = attrs.get("exclusive", True)
+    adaptive = attrs.get("adaptive", False)
+    if attrs.get("global_pooling", False) or (
+            adaptive and tuple(ksize) == (1, 1)):
+        if ptype == "max":
+            return {"Out": jnp.max(x, axis=(2, 3), keepdims=True)}
+        return {"Out": jnp.mean(x, axis=(2, 3), keepdims=True)}
+    if adaptive:
+        # adaptive pooling to output size ksize: split into equal windows
+        n, c, h, wdt = x.shape
+        oh, ow = ksize
+        assert h % oh == 0 and wdt % ow == 0, "adaptive pool needs divisible"
+        xr = x.reshape(n, c, oh, h // oh, ow, wdt // ow)
+        red = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": red(xr, axis=(3, 5))}
+
+    h, w_ = x.shape[2], x.shape[3]
+    pads = []
+    for dim, k, s, p in ((h, ksize[0], strides[0], paddings[0]),
+                         (w_, ksize[1], strides[1], paddings[1])):
+        if ceil_mode:
+            out_d = -(-(dim + 2 * p - k) // s) + 1
+        else:
+            out_d = (dim + 2 * p - k) // s + 1
+        extra = max(0, (out_d - 1) * s + k - dim - p)
+        pads.append((p, extra))
+    window = (1, 1) + tuple(ksize)
+    strides4 = (1, 1) + tuple(strides)
+    pad4 = [(0, 0), (0, 0)] + pads
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        out = lax.reduce_window(x, init, lax.max, window, strides4, pad4)
+    else:
+        ssum = lax.reduce_window(x, 0.0, lax.add, window, strides4, pad4)
+        if exclusive:
+            ones = jnp.ones(x.shape[2:], x.dtype)
+            cnt = lax.reduce_window(ones, 0.0, lax.add, tuple(ksize),
+                                    tuple(strides), pads)
+            out = ssum / cnt[None, None]
+        else:
+            out = ssum / float(ksize[0] * ksize[1])
+    return {"Out": out}
+
+
+# ---------------------------------------------------------------------------
+# Normalisation
+# ---------------------------------------------------------------------------
+
+@register_op("batch_norm")
+def _batch_norm(ins, attrs):
+    # reference: operators/batch_norm_op.cc — running stats update:
+    # mean_out = mean * momentum + batch_mean * (1 - momentum)
+    x = ins["X"][0]
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    mean, var = ins["Mean"][0], ins["Variance"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    is_test = attrs.get("is_test", False)
+    layout = attrs.get("data_layout", "NCHW")
+    axes = tuple(i for i in range(x.ndim)
+                 if i != (1 if layout == "NCHW" else x.ndim - 1))
+    cshape = [1] * x.ndim
+    cshape[1 if layout == "NCHW" else -1] = -1
+    cshape = tuple(cshape)
+
+    if is_test or attrs.get("use_global_stats", False):
+        use_mean, use_var = mean, var
+        saved_mean, saved_var = mean, 1.0 / jnp.sqrt(var + eps)
+        mean_out, var_out = mean, var
+    else:
+        f32 = x.astype(jnp.float32)
+        bmean = jnp.mean(f32, axis=axes)
+        bvar = jnp.mean(jnp.square(f32), axis=axes) - jnp.square(bmean)
+        use_mean, use_var = bmean, bvar
+        mean_out = mean * momentum + bmean.astype(mean.dtype) * (1 - momentum)
+        var_out = var * momentum + bvar.astype(var.dtype) * (1 - momentum)
+        saved_mean = bmean
+        saved_var = 1.0 / jnp.sqrt(bvar + eps)
+
+    inv = (1.0 / jnp.sqrt(use_var.astype(jnp.float32) + eps))
+    y = (x.astype(jnp.float32) - use_mean.reshape(cshape)) \
+        * inv.reshape(cshape) * scale.astype(jnp.float32).reshape(cshape) \
+        + bias.astype(jnp.float32).reshape(cshape)
+    return {"Y": y.astype(x.dtype), "MeanOut": mean_out,
+            "VarianceOut": var_out, "SavedMean": saved_mean,
+            "SavedVariance": saved_var}
+
+
+@register_op("layer_norm")
+def _layer_norm(ins, attrs):
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    begin = attrs.get("begin_norm_axis", 1)
+    axes = tuple(range(begin, x.ndim))
+    f32 = x.astype(jnp.float32)
+    mean = jnp.mean(f32, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(f32 - mean), axis=axes, keepdims=True)
+    y = (f32 - mean) / jnp.sqrt(var + eps)
+    norm_shape = x.shape[begin:]
+    if ins.get("Scale"):
+        y = y * ins["Scale"][0].astype(jnp.float32).reshape(norm_shape)
+    if ins.get("Bias"):
+        y = y + ins["Bias"][0].astype(jnp.float32).reshape(norm_shape)
+    red_shape = tuple(x.shape[:begin])
+    return {"Y": y.astype(x.dtype),
+            "Mean": mean.reshape(red_shape).astype(jnp.float32),
+            "Variance": var.reshape(red_shape).astype(jnp.float32)}
+
+
+@register_op("instance_norm")
+def _instance_norm(ins, attrs):
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) / jnp.sqrt(var + eps)
+    cshape = (1, -1) + (1,) * (x.ndim - 2)
+    if ins.get("Scale"):
+        y = y * ins["Scale"][0].reshape(cshape)
+    if ins.get("Bias"):
+        y = y + ins["Bias"][0].reshape(cshape)
+    return {"Y": y, "SavedMean": mean.reshape(x.shape[:2]),
+            "SavedVariance": (1.0 / jnp.sqrt(var + eps)).reshape(x.shape[:2])}
+
+
+@register_op("group_norm")
+def _group_norm(ins, attrs):
+    x = ins["X"][0]
+    groups = attrs.get("groups", 1)
+    eps = attrs.get("epsilon", 1e-5)
+    n, c = x.shape[0], x.shape[1]
+    xg = x.reshape((n, groups, c // groups) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    y = ((xg - mean) / jnp.sqrt(var + eps)).reshape(x.shape)
+    cshape = (1, -1) + (1,) * (x.ndim - 2)
+    if ins.get("Scale"):
+        y = y * ins["Scale"][0].reshape(cshape)
+    if ins.get("Bias"):
+        y = y + ins["Bias"][0].reshape(cshape)
+    return {"Y": y, "Mean": mean.reshape(n, groups),
+            "Variance": var.reshape(n, groups)}
+
+
+# ---------------------------------------------------------------------------
+# Dropout (stateless threefry PRNG; reference uses curand states)
+# ---------------------------------------------------------------------------
+
+@register_op("dropout", needs_rng=True)
+def _dropout(ins, attrs):
+    x = ins["X"][0]
+    p = attrs.get("dropout_prob", 0.5)
+    is_test = attrs.get("is_test", False)
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if is_test:
+        out = x if impl == "upscale_in_train" else x * (1.0 - p)
+        return {"Out": out, "Mask": jnp.ones(x.shape, jnp.uint8)}
+    key = attrs["_rng_key"]
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    if impl == "upscale_in_train":
+        out = jnp.where(keep, x / (1.0 - p), jnp.zeros_like(x))
+    else:
+        out = jnp.where(keep, x, jnp.zeros_like(x))
+    return {"Out": out, "Mask": keep.astype(jnp.uint8)}
+
+
+# ---------------------------------------------------------------------------
+# Embedding
+# ---------------------------------------------------------------------------
+
+def _lookup(w, ids, padding_idx):
+    out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids == padding_idx)[..., None]
+        out = jnp.where(mask, jnp.zeros_like(out), out)
+    return out
+
+
+@register_op("lookup_table")
+def _lookup_table(ins, attrs):
+    w, ids = ins["W"][0], ins["Ids"][0]
+    # v1 requires ids shape [..., 1]
+    ids = ids.reshape(ids.shape[:-1])
+    return {"Out": _lookup(w, ids, attrs.get("padding_idx", -1))}
+
+
+@register_op("lookup_table_v2")
+def _lookup_table_v2(ins, attrs):
+    w, ids = ins["W"][0], ins["Ids"][0]
+    return {"Out": _lookup(w, ids, attrs.get("padding_idx", -1))}
+
+
+@register_op("embedding")
+def _embedding(ins, attrs):
+    return _lookup_table_v2(ins, attrs)
+
+
+@register_op("one_hot")
+def _one_hot(ins, attrs):
+    x = ins["X"][0]
+    depth = attrs["depth"]
+    ids = x.reshape(x.shape[:-1]).astype(jnp.int32)
+    return {"Out": jax.nn.one_hot(ids, depth, dtype=jnp.float32)}
+
+
+@register_op("one_hot_v2")
+def _one_hot_v2(ins, attrs):
+    x = ins["X"][0].astype(jnp.int32)
+    return {"Out": jax.nn.one_hot(x, attrs["depth"], dtype=jnp.float32)}
+
+
+# ---------------------------------------------------------------------------
+# Misc NN
+# ---------------------------------------------------------------------------
+
+@register_op("label_smooth")
+def _label_smooth(ins, attrs):
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 0.0)
+    if ins.get("PriorDist"):
+        prior = ins["PriorDist"][0]
+        out = (1 - eps) * x + eps * prior
+    else:
+        out = (1 - eps) * x + eps / x.shape[-1]
+    return {"Out": out}
+
+
+@register_op("interp_nearest")
+def _interp_nearest(ins, attrs):
+    x = ins["X"][0]
+    oh, ow = attrs["out_h"], attrs["out_w"]
+    n, c, h, w = x.shape
+    ridx = (jnp.arange(oh) * (h / oh)).astype(jnp.int32)
+    cidx = (jnp.arange(ow) * (w / ow)).astype(jnp.int32)
+    return {"Out": x[:, :, ridx][:, :, :, cidx]}
+
+
+@register_op("pad")
+def _pad(ins, attrs):
+    x = ins["X"][0]
+    paddings = attrs["paddings"]
+    value = attrs.get("pad_value", 0.0)
+    cfg = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": jnp.pad(x, cfg, constant_values=value)}
+
+
+@register_op("pad2d")
+def _pad2d(ins, attrs):
+    x = ins["X"][0]
+    p = attrs["paddings"]  # [top, bottom, left, right]
+    mode = attrs.get("mode", "constant")
+    cfg = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    if mode == "constant":
+        return {"Out": jnp.pad(x, cfg,
+                               constant_values=attrs.get("pad_value", 0.0))}
+    jmode = {"reflect": "reflect", "edge": "edge"}[mode]
+    return {"Out": jnp.pad(x, cfg, mode=jmode)}

@@ -1,0 +1,243 @@
+"""Optimizer update operators.
+
+Reference parity: `paddle/fluid/operators/optimizers/` — sgd, momentum
+(+nesterov, lars), adam/adamax/adamw, adagrad/adadelta/decayed_adagrad,
+rmsprop, ftrl, lamb, dpsgd — each with .cc+.cu kernels there; here each is a
+pure functional update XLA fuses into one kernel per parameter (or one fused
+update when the whole train step is jitted).
+
+All follow the framework convention: Param/Grad/<state> inputs,
+ParamOut/<state>Out outputs; the lowering aliases ParamOut back onto the
+Param variable name (donated buffers — in-place on TPU).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _lr(ins):
+    return ins["LearningRate"][0].reshape(()).astype(jnp.float32)
+
+
+@register_op("sgd")
+def _sgd(ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    lr = _lr(ins).astype(p.dtype)
+    return {"ParamOut": p - lr * g.astype(p.dtype)}
+
+
+@register_op("momentum")
+def _momentum(ins, attrs):
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    lr = _lr(ins).astype(p.dtype)
+    mu = attrs.get("mu", 0.9)
+    g = g.astype(p.dtype)
+    v_out = mu * v + g
+    if attrs.get("use_nesterov", False):
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    return {"ParamOut": p_out, "VelocityOut": v_out}
+
+
+@register_op("lars_momentum")
+def _lars_momentum(ins, attrs):
+    # reference: optimizers/lars_momentum_op.cc — layer-wise adaptive LR
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    lr = _lr(ins)
+    mu = attrs.get("mu", 0.9)
+    coeff = attrs.get("lars_coeff", 0.001)
+    wd = attrs.get("lars_weight_decay", 0.0005)
+    eps = attrs.get("epsilon", 0.0)
+    pf, gf = p.astype(jnp.float32), g.astype(jnp.float32)
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(pf)))
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(gf)))
+    local_lr = jnp.where(
+        (p_norm > 0) & (g_norm > 0),
+        lr * coeff * p_norm / (g_norm + wd * p_norm + eps), lr)
+    v_out = mu * v.astype(jnp.float32) + local_lr * (gf + wd * pf)
+    p_out = pf - v_out
+    return {"ParamOut": p_out.astype(p.dtype),
+            "VelocityOut": v_out.astype(v.dtype)}
+
+
+@register_op("adam")
+def _adam(ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    lr = _lr(ins)
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    gf = g.astype(jnp.float32)
+    m1o = b1 * m1 + (1 - b1) * gf
+    m2o = b2 * m2 + (1 - b2) * jnp.square(gf)
+    b1pf = b1p.reshape(()).astype(jnp.float32)
+    b2pf = b2p.reshape(()).astype(jnp.float32)
+    alpha = lr * jnp.sqrt(1 - b2pf * b2) / (1 - b1pf * b1)
+    p_out = p.astype(jnp.float32) - alpha * m1o / (jnp.sqrt(m2o) + eps)
+    return {"ParamOut": p_out.astype(p.dtype), "Moment1Out": m1o,
+            "Moment2Out": m2o, "Beta1PowOut": b1p * b1,
+            "Beta2PowOut": b2p * b2}
+
+
+@register_op("adamw")
+def _adamw(ins, attrs):
+    coeff = attrs.get("coeff", attrs.get("weight_decay", 0.01))
+    outs = _adam(ins, attrs)
+    p = ins["Param"][0]
+    lr = _lr(ins).astype(jnp.float32)
+    if attrs.get("with_decay", True):
+        decayed = outs["ParamOut"].astype(jnp.float32) \
+            - lr * coeff * p.astype(jnp.float32)
+        outs["ParamOut"] = decayed.astype(p.dtype)
+    return outs
+
+
+@register_op("adamax")
+def _adamax(ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m, n = ins["Moment"][0], ins["InfNorm"][0]
+    b1p = ins["Beta1Pow"][0].reshape(()).astype(jnp.float32)
+    lr = _lr(ins)
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    gf = g.astype(jnp.float32)
+    m_out = b1 * m + (1 - b1) * gf
+    n_out = jnp.maximum(b2 * n, jnp.abs(gf))
+    p_out = p.astype(jnp.float32) - (lr / (1 - b1p)) * (m_out / (n_out + eps))
+    return {"ParamOut": p_out.astype(p.dtype), "MomentOut": m_out,
+            "InfNormOut": n_out}
+
+
+@register_op("adagrad")
+def _adagrad(ins, attrs):
+    p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    lr = _lr(ins)
+    eps = attrs.get("epsilon", 1e-6)
+    gf = g.astype(jnp.float32)
+    m_out = m + jnp.square(gf)
+    p_out = p.astype(jnp.float32) - lr * gf / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": p_out.astype(p.dtype), "MomentOut": m_out}
+
+
+@register_op("decayed_adagrad")
+def _decayed_adagrad(ins, attrs):
+    p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    lr = _lr(ins)
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    gf = g.astype(jnp.float32)
+    m_out = decay * m + (1 - decay) * jnp.square(gf)
+    p_out = p.astype(jnp.float32) - lr * gf / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": p_out.astype(p.dtype), "MomentOut": m_out}
+
+
+@register_op("adadelta")
+def _adadelta(ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    avg_sq_g, avg_sq_u = ins["AvgSquaredGrad"][0], ins["AvgSquaredUpdate"][0]
+    rho = attrs.get("rho", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    gf = g.astype(jnp.float32)
+    g_acc = rho * avg_sq_g + (1 - rho) * jnp.square(gf)
+    update = -jnp.sqrt((avg_sq_u + eps) / (g_acc + eps)) * gf
+    u_acc = rho * avg_sq_u + (1 - rho) * jnp.square(update)
+    p_out = p.astype(jnp.float32) + update
+    return {"ParamOut": p_out.astype(p.dtype),
+            "AvgSquaredGradOut": g_acc, "AvgSquaredUpdateOut": u_acc}
+
+
+@register_op("rmsprop")
+def _rmsprop(ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    ms, mom = ins["MeanSquare"][0], ins["Moment"][0]
+    lr = _lr(ins)
+    rho = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    momentum = attrs.get("momentum", 0.0)
+    centered = attrs.get("centered", False)
+    gf = g.astype(jnp.float32)
+    ms_out = rho * ms + (1 - rho) * jnp.square(gf)
+    if centered:
+        mg = ins["MeanGrad"][0]
+        mg_out = rho * mg + (1 - rho) * gf
+        denom = ms_out - jnp.square(mg_out) + eps
+    else:
+        mg_out = None
+        denom = ms_out + eps
+    mom_out = momentum * mom + lr * gf / jnp.sqrt(denom)
+    p_out = p.astype(jnp.float32) - mom_out
+    outs = {"ParamOut": p_out.astype(p.dtype), "MeanSquareOut": ms_out,
+            "MomentOut": mom_out}
+    if mg_out is not None:
+        outs["MeanGradOut"] = mg_out
+    return outs
+
+
+@register_op("ftrl")
+def _ftrl(ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    sq, lin = ins["SquaredAccumulator"][0], ins["LinearAccumulator"][0]
+    lr = _lr(ins)
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    lr_power = attrs.get("lr_power", -0.5)
+    gf = g.astype(jnp.float32)
+    new_sq = sq + jnp.square(gf)
+    sigma = (new_sq ** (-lr_power) - sq ** (-lr_power)) / lr
+    lin_out = lin + gf - sigma * p.astype(jnp.float32)
+    x = jnp.clip(lin_out, -l1, l1) - lin_out
+    y = new_sq ** (-lr_power) / lr + 2 * l2
+    p_out = x / y
+    return {"ParamOut": p_out.astype(p.dtype), "SquaredAccumOut": new_sq,
+            "LinearAccumOut": lin_out}
+
+
+@register_op("lamb")
+def _lamb(ins, attrs):
+    # reference: optimizers/lamb_op.cc — layer-adaptive large-batch Adam
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1p = ins["Beta1Pow"][0].reshape(()).astype(jnp.float32)
+    b2p = ins["Beta2Pow"][0].reshape(()).astype(jnp.float32)
+    lr = _lr(ins)
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-6)
+    wd = attrs.get("weight_decay", 0.01)
+    gf = g.astype(jnp.float32)
+    pf = p.astype(jnp.float32)
+    m1o = b1 * m1 + (1 - b1) * gf
+    m2o = b2 * m2 + (1 - b2) * jnp.square(gf)
+    m1hat = m1o / (1 - b1p * b1)
+    m2hat = m2o / (1 - b2p * b2)
+    r = m1hat / (jnp.sqrt(m2hat) + eps) + wd * pf
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(pf)))
+    r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+    trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+    p_out = pf - lr * trust * r
+    return {"ParamOut": p_out.astype(p.dtype), "Moment1Out": m1o,
+            "Moment2Out": m2o, "Beta1PowOut": ins["Beta1Pow"][0] * b1,
+            "Beta2PowOut": ins["Beta2Pow"][0] * b2}
+
+
+@register_op("dpsgd", needs_rng=True)
+def _dpsgd(ins, attrs):
+    import jax
+
+    p, g = ins["Param"][0], ins["Grad"][0]
+    lr = _lr(ins)
+    clip = attrs.get("clip", 10.0)
+    sigma = attrs.get("sigma", 1.0)
+    batch_size = attrs.get("batch_size", 16.0)
+    gf = g.astype(jnp.float32)
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(gf)))
+    gf = gf / jnp.maximum(1.0, g_norm / clip)
+    noise = jax.random.normal(attrs["_rng_key"], g.shape) * sigma * clip
+    p_out = p.astype(jnp.float32) - lr / batch_size * (gf + noise)
+    return {"ParamOut": p_out.astype(p.dtype)}

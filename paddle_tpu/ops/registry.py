@@ -1,0 +1,199 @@
+"""Operator registry: the single compute layer shared by static graph
+lowering, the eager (dygraph) engine, and OpTest golden tests.
+
+Reference parity: `paddle/fluid/framework/op_registry.h:223-295` registers
+each op type with CPU/CUDA kernels, and `OperatorWithKernel::RunImpl`
+(`operator.cc:908-1030`) dispatches on (place, dtype, layout). TPU-native
+design: every op is ONE pure jax function `compute(ins, attrs) -> outs`;
+device dispatch, layout, fusion, and memory planning all belong to XLA.
+Shape/dtype inference (reference: `shape_inference.h`) falls out for free
+via `jax.eval_shape` over the same compute function — no per-op InferShape
+code to keep in sync with kernels.
+
+Autodiff: the reference hand-writes a GradOpMaker per op
+(`grad_op_desc_maker.h`); here gradients come from jax.vjp over the traced
+forward segment (see fluid/backward.py), so no per-op grad rules exist to
+get wrong.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+_REGISTRY: Dict[str, "OpDef"] = {}
+
+# Sentinel dimension used in place of -1 ("any batch") during compile-time
+# shape inference; mapped back to -1 in inferred output shapes.
+_DYN_SENTINEL = 97
+
+
+class OpDef:
+    __slots__ = ("type", "compute", "needs_rng", "infer_shape", "n_outputs")
+
+    def __init__(self, type_: str, compute: Callable, needs_rng: bool = False,
+                 infer_shape: Optional[Callable] = None):
+        self.type = type_
+        self.compute = compute
+        self.needs_rng = needs_rng
+        self.infer_shape = infer_shape
+
+
+def register_op(type_: str, needs_rng: bool = False,
+                infer_shape: Optional[Callable] = None):
+    """Decorator: register `compute(ins, attrs) -> {slot: [array, ...]}`.
+
+    `ins` maps input slot name -> list of jax arrays (possibly empty).
+    Returned dict values may be a single array or a list of arrays.
+    RNG ops receive a jax PRNG key in attrs['_rng_key'].
+    """
+
+    def deco(fn):
+        _REGISTRY[type_] = OpDef(type_, fn, needs_rng=needs_rng,
+                                 infer_shape=infer_shape)
+        return fn
+
+    return deco
+
+
+def get_op(type_: str) -> OpDef:
+    try:
+        return _REGISTRY[type_]
+    except KeyError:
+        raise NotImplementedError(
+            "op %r is not registered in paddle_tpu.ops (have %d ops)"
+            % (type_, len(_REGISTRY)))
+
+
+def has_op(type_: str) -> bool:
+    return type_ in _REGISTRY
+
+
+def registered_ops() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+def normalize_outs(outs) -> Dict[str, list]:
+    normed = {}
+    for slot, v in outs.items():
+        if isinstance(v, (list, tuple)):
+            normed[slot] = list(v)
+        else:
+            normed[slot] = [v]
+    return normed
+
+
+def run_op(type_: str, ins: Dict[str, list], attrs: dict) -> Dict[str, list]:
+    """Execute an op's compute function (inside or outside a trace)."""
+    op = get_op(type_)
+    return normalize_outs(op.compute(ins, dict(attrs)))
+
+
+# ---------------------------------------------------------------------------
+# Compile-time shape/dtype inference via jax.eval_shape.
+# ---------------------------------------------------------------------------
+
+def infer_outputs(type_: str, input_specs: Dict[str, list], attrs: dict):
+    """input_specs: slot -> list of (shape_tuple_with_-1, dtype_str).
+
+    Returns slot -> list of (shape_tuple_with_-1, dtype_str).
+    """
+    import jax
+
+    op = get_op(type_)
+    if op.infer_shape is not None:
+        return op.infer_shape(input_specs, attrs)
+
+    dyn_axes = set()
+
+    def to_struct(spec):
+        shape, dtype = spec
+        concrete = []
+        for d in shape:
+            if d is None or d < 0:
+                concrete.append(_DYN_SENTINEL)
+                dyn_axes.add(_DYN_SENTINEL)
+            else:
+                concrete.append(int(d))
+        from ..core.types import to_numpy_dtype
+        return jax.ShapeDtypeStruct(tuple(concrete), to_numpy_dtype(dtype))
+
+    struct_ins = {
+        slot: [to_struct(s) for s in specs]
+        for slot, specs in input_specs.items()
+    }
+    run_attrs = dict(attrs)
+    if op.needs_rng:
+        run_attrs["_rng_key"] = jax.ShapeDtypeStruct((2,), np.uint32)
+
+    def fn(tree_ins, key):
+        a = dict(run_attrs)
+        if op.needs_rng:
+            a["_rng_key"] = key
+        return normalize_outs(op.compute(tree_ins, a))
+
+    key_struct = jax.ShapeDtypeStruct((2,), np.uint32)
+    out_struct = jax.eval_shape(fn, struct_ins, key_struct)
+
+    from ..core.types import normalize_dtype
+
+    result = {}
+    for slot, structs in out_struct.items():
+        specs = []
+        for s in structs:
+            shape = tuple(
+                -1 if (dyn_axes and d == _DYN_SENTINEL) else int(d)
+                for d in s.shape)
+            specs.append((shape, normalize_dtype(s.dtype)))
+        result[slot] = specs
+    return result
+
+
+# ---------------------------------------------------------------------------
+# Per-op jitted eager execution cache (dygraph fast path).  Reference parity:
+# the generated `core.ops.*` fast entry points
+# (`pybind/op_function_generator.cc:131-341`).
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=4096)
+def _jitted(type_: str, attr_items: tuple, slot_layout: tuple, rng: bool):
+    import jax
+
+    op = get_op(type_)
+    attrs = dict(attr_items)
+
+    def fn(flat_args, key):
+        ins, i = {}, 0
+        for slot, n in slot_layout:
+            ins[slot] = list(flat_args[i:i + n])
+            i += n
+        a = dict(attrs)
+        if rng:
+            a["_rng_key"] = key
+        return normalize_outs(op.compute(ins, a))
+
+    return jax.jit(fn)
+
+
+def _hashable_attr(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_hashable_attr(x) for x in v)
+    if isinstance(v, np.ndarray):
+        return (v.dtype.str, v.shape, v.tobytes())
+    return v
+
+
+def eager_run(type_: str, ins: Dict[str, list], attrs: dict, rng_key=None):
+    """Run one op eagerly through a cached per-op jitted function."""
+    import jax
+
+    op = get_op(type_)
+    slot_layout = tuple((slot, len(vals)) for slot, vals in sorted(ins.items()))
+    flat = [v for _, vals in sorted(ins.items()) for v in vals]
+    attr_items = tuple(sorted((k, _hashable_attr(v)) for k, v in attrs.items()
+                              if not k.startswith("_")))
+    jfn = _jitted(type_, attr_items, slot_layout, op.needs_rng)
+    if op.needs_rng and rng_key is None:
+        rng_key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+    return jfn(flat, rng_key)

@@ -1,0 +1,99 @@
+"""Random / initializer operators (stateless threefry PRNG).
+
+Reference parity: `paddle/fluid/operators/uniform_random_op.cc`,
+`gaussian_random_op.cc`, `truncated_gaussian_random_op.cc`,
+`randperm_op.cc`, `randint_op.cc`, initializer kernels used by
+`python/paddle/fluid/initializer.py`. TPU-native: counter-based stateless
+PRNG keys are threaded by the lowering (deterministic given
+program.random_seed + op index), instead of the reference's per-device
+curand generator state.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+from ..core.types import to_numpy_dtype
+
+
+@register_op("uniform_random", needs_rng=True)
+def _uniform_random(ins, attrs):
+    shape = tuple(int(d) for d in attrs["shape"])
+    dtype = to_numpy_dtype(attrs.get("dtype", "float32"))
+    lo = attrs.get("min", -1.0)
+    hi = attrs.get("max", 1.0)
+    out = jax.random.uniform(attrs["_rng_key"], shape, jnp.float32, lo, hi)
+    return {"Out": out.astype(dtype)}
+
+
+@register_op("uniform_random_batch_size_like", needs_rng=True)
+def _uniform_random_bsl(ins, attrs):
+    ref = ins["Input"][0]
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = ref.shape[
+        attrs.get("input_dim_idx", 0)]
+    attrs = dict(attrs, shape=shape)
+    return _uniform_random({}, attrs)
+
+
+@register_op("gaussian_random", needs_rng=True)
+def _gaussian_random(ins, attrs):
+    shape = tuple(int(d) for d in attrs["shape"])
+    dtype = to_numpy_dtype(attrs.get("dtype", "float32"))
+    mean = attrs.get("mean", 0.0)
+    std = attrs.get("std", 1.0)
+    out = jax.random.normal(attrs["_rng_key"], shape, jnp.float32) * std + mean
+    return {"Out": out.astype(dtype)}
+
+
+@register_op("truncated_gaussian_random", needs_rng=True)
+def _truncated_gaussian(ins, attrs):
+    shape = tuple(int(d) for d in attrs["shape"])
+    dtype = to_numpy_dtype(attrs.get("dtype", "float32"))
+    mean = attrs.get("mean", 0.0)
+    std = attrs.get("std", 1.0)
+    out = jax.random.truncated_normal(
+        attrs["_rng_key"], -2.0, 2.0, shape, jnp.float32) * std + mean
+    return {"Out": out.astype(dtype)}
+
+
+@register_op("randint", needs_rng=True)
+def _randint(ins, attrs):
+    shape = tuple(int(d) for d in attrs["shape"])
+    dtype = to_numpy_dtype(attrs.get("dtype", "int64"))
+    out = jax.random.randint(attrs["_rng_key"], shape,
+                             attrs.get("low", 0), attrs.get("high", 100))
+    return {"Out": out.astype(dtype)}
+
+
+@register_op("randperm", needs_rng=True)
+def _randperm(ins, attrs):
+    n = attrs["n"]
+    dtype = to_numpy_dtype(attrs.get("dtype", "int64"))
+    return {"Out": jax.random.permutation(attrs["_rng_key"], n).astype(dtype)}
+
+
+@register_op("bernoulli", needs_rng=True)
+def _bernoulli(ins, attrs):
+    x = ins["X"][0]
+    out = jax.random.bernoulli(attrs["_rng_key"], x)
+    return {"Out": out.astype(x.dtype)}
+
+
+@register_op("multinomial", needs_rng=True)
+def _multinomial(ins, attrs):
+    x = ins["X"][0]
+    num = attrs.get("num_samples", 1)
+    logits = jnp.log(jnp.maximum(x, 1e-30))
+    out = jax.random.categorical(attrs["_rng_key"], logits,
+                                 shape=x.shape[:-1] + (num,), axis=-1)
+    return {"Out": out.astype(jnp.int64)}
+
+
+@register_op("sampling_id", needs_rng=True)
+def _sampling_id(ins, attrs):
+    x = ins["X"][0]
+    logits = jnp.log(jnp.maximum(x, 1e-30))
+    out = jax.random.categorical(attrs["_rng_key"], logits, axis=-1)
+    return {"Out": out.astype(jnp.int64)}

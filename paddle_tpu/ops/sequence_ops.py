@@ -1,0 +1,120 @@
+"""Sequence operators over padded [batch, time, ...] tensors + length masks.
+
+Reference parity: `paddle/fluid/operators/sequence_ops/` operate on
+LoDTensors (ragged rows, `lod_tensor.h:52-104`). XLA wants static shapes, so
+the TPU-native representation is dense padding + an explicit SeqLen tensor
+(SURVEY.md §7 hard part (a)); ops take an optional "Length" input.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _mask(x, ins, time_axis=1):
+    if not ins.get("Length"):
+        return None
+    length = ins["Length"][0].reshape((-1,))
+    t = x.shape[time_axis]
+    return (jnp.arange(t)[None, :] < length[:, None])
+
+
+@register_op("sequence_mask")
+def _sequence_mask(ins, attrs):
+    x = ins["X"][0].reshape((-1,))
+    maxlen = attrs.get("maxlen", -1)
+    if maxlen < 0:
+        maxlen = int(jnp.max(x)) if not hasattr(x, "aval") else x.shape[0]
+    from ..core.types import to_numpy_dtype
+
+    dtype = to_numpy_dtype(attrs.get("out_dtype", "int64"))
+    out = (jnp.arange(maxlen)[None, :] < x[:, None]).astype(dtype)
+    return {"Y": out}
+
+
+@register_op("sequence_pool")
+def _sequence_pool(ins, attrs):
+    # padded [B, T, D] + Length → pooled [B, D]
+    x = ins["X"][0]
+    ptype = attrs.get("pooltype", "SUM").upper()
+    m = _mask(x, ins)
+    if m is not None:
+        mf = m.astype(x.dtype)[..., None]
+        x_masked = x * mf
+        denom = jnp.maximum(jnp.sum(mf, axis=1), 1.0)
+    else:
+        x_masked = x
+        denom = jnp.asarray(x.shape[1], x.dtype)
+    if ptype == "SUM":
+        out = jnp.sum(x_masked, axis=1)
+    elif ptype in ("AVERAGE", "MEAN"):
+        out = jnp.sum(x_masked, axis=1) / denom
+    elif ptype == "MAX":
+        neg = jnp.finfo(x.dtype).min if jnp.issubdtype(
+            x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        xm = jnp.where(m[..., None], x, neg) if m is not None else x
+        out = jnp.max(xm, axis=1)
+    elif ptype == "SQRT":
+        out = jnp.sum(x_masked, axis=1) / jnp.sqrt(denom)
+    elif ptype == "LAST":
+        if m is not None:
+            idx = jnp.maximum(
+                jnp.sum(m.astype(jnp.int32), axis=1) - 1, 0)
+            out = jnp.take_along_axis(
+                x, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+        else:
+            out = x[:, -1]
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise NotImplementedError(ptype)
+    return {"Out": out, "MaxIndex": jnp.zeros(out.shape, jnp.int32)}
+
+
+@register_op("sequence_softmax")
+def _sequence_softmax(ins, attrs):
+    x = ins["X"][0]
+    m = _mask(x, ins)
+    if m is None:
+        return {"Out": jnp.exp(x) / jnp.sum(jnp.exp(x), axis=1,
+                                            keepdims=True)}
+    neg = jnp.finfo(x.dtype).min
+    xm = jnp.where(m, x, neg)
+    e = jnp.exp(xm - jnp.max(xm, axis=1, keepdims=True))
+    e = jnp.where(m, e, 0.0)
+    return {"Out": e / jnp.maximum(jnp.sum(e, axis=1, keepdims=True), 1e-9)}
+
+
+@register_op("sequence_expand")
+def _sequence_expand(ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    reps = y.shape[1] if y.ndim > 1 else 1
+    return {"Out": jnp.repeat(x, reps, axis=0)}
+
+
+@register_op("sequence_reshape")
+def _sequence_reshape(ins, attrs):
+    x = ins["X"][0]
+    dim = attrs["new_dim"]
+    return {"Out": x.reshape((-1, dim))}
+
+
+@register_op("sequence_concat")
+def _sequence_concat(ins, attrs):
+    return {"Out": jnp.concatenate(ins["X"], axis=1)}
+
+
+@register_op("sequence_reverse")
+def _sequence_reverse(ins, attrs):
+    x = ins["X"][0]
+    m = _mask(x, ins)
+    if m is None:
+        return {"Y": jnp.flip(x, axis=1)}
+    length = jnp.sum(m.astype(jnp.int32), axis=1)
+    t = x.shape[1]
+    idx = jnp.arange(t)[None, :]
+    rev_idx = jnp.where(idx < length[:, None], length[:, None] - 1 - idx, idx)
+    return {"Y": jnp.take_along_axis(
+        x, rev_idx[..., None].astype(jnp.int32), axis=1)
+        if x.ndim == 3 else jnp.take_along_axis(x, rev_idx, axis=1)}

@@ -1,0 +1,463 @@
+"""Tensor creation / manipulation operators.
+
+Reference parity: `paddle/fluid/operators/` — fill_constant_op, reshape_op
+(v2 emits XShape for grad bookkeeping; kept for program compatibility),
+transpose_op, concat_op, split_op, slice_op, gather_op, stack_op, expand_op,
+squeeze/unsqueeze, top_k_op, arg_max/min, assign_op, shape_op, range_op,
+cumsum, where/masked ops, tril_triu.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+from ..core.types import to_numpy_dtype, normalize_dtype
+
+
+def _xshape(x):
+    # XShape carries the pre-op shape prefixed with 0 (framework convention,
+    # reference: operators/reshape_op.cc Reshape2Op). No data.
+    return jnp.zeros((0,) + x.shape, x.dtype)
+
+
+@register_op("fill_constant")
+def _fill_constant(ins, attrs):
+    shape = attrs.get("shape", [1])
+    dtype = to_numpy_dtype(attrs.get("dtype", "float32"))
+    value = attrs.get("value", 0.0)
+    if isinstance(value, str):
+        value = float(value)
+    return {"Out": jnp.full(tuple(int(d) for d in shape), value, dtype)}
+
+
+@register_op("fill_constant_batch_size_like")
+def _fill_constant_bsl(ins, attrs):
+    ref = ins["Input"][0]
+    shape = list(attrs["shape"])
+    in_idx = attrs.get("input_dim_idx", 0)
+    out_idx = attrs.get("output_dim_idx", 0)
+    shape[out_idx] = ref.shape[in_idx]
+    dtype = to_numpy_dtype(attrs.get("dtype", "float32"))
+    return {"Out": jnp.full(tuple(shape), attrs.get("value", 0.0), dtype)}
+
+
+@register_op("fill_zeros_like")
+def _fill_zeros_like(ins, attrs):
+    return {"Out": jnp.zeros_like(ins["X"][0])}
+
+
+@register_op("fill_any_like")
+def _fill_any_like(ins, attrs):
+    x = ins["X"][0]
+    dtype = attrs.get("dtype", None)
+    np_dtype = x.dtype if dtype in (None, -1) else to_numpy_dtype(dtype)
+    return {"Out": jnp.full(x.shape, attrs.get("value", 0.0), np_dtype)}
+
+
+@register_op("assign")
+def _assign(ins, attrs):
+    return {"Out": ins["X"][0]}
+
+
+@register_op("assign_value")
+def _assign_value(ins, attrs):
+    dtype = to_numpy_dtype(attrs.get("dtype", "float32"))
+    shape = tuple(attrs["shape"])
+    values = attrs.get("fp32_values") or attrs.get("int32_values") \
+        or attrs.get("int64_values") or attrs.get("values")
+    return {"Out": jnp.asarray(np.asarray(values, dtype).reshape(shape))}
+
+
+@register_op("shape")
+def _shape(ins, attrs):
+    x = ins["Input"][0]
+    return {"Out": jnp.asarray(np.asarray(x.shape, np.int32))}
+
+
+@register_op("reshape")
+def _reshape(ins, attrs):
+    return {"Out": _do_reshape(ins["X"][0], attrs["shape"])}
+
+
+def _do_reshape(x, shape):
+    shape = [int(s) for s in shape]
+    # Paddle rule: 0 means copy the input dim at that position.
+    shape = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)
+             ] if 0 in shape else shape
+    return x.reshape(tuple(shape))
+
+
+@register_op("reshape2")
+def _reshape2(ins, attrs):
+    x = ins["X"][0]
+    if ins.get("Shape"):
+        shape = [int(v) for v in np.asarray(ins["Shape"][0])]
+    else:
+        shape = attrs["shape"]
+    return {"Out": _do_reshape(x, shape), "XShape": _xshape(x)}
+
+
+@register_op("transpose")
+def _transpose(ins, attrs):
+    return {"Out": jnp.transpose(ins["X"][0], attrs["axis"])}
+
+
+@register_op("transpose2")
+def _transpose2(ins, attrs):
+    x = ins["X"][0]
+    return {"Out": jnp.transpose(x, attrs["axis"]), "XShape": _xshape(x)}
+
+
+@register_op("squeeze")
+def _squeeze(ins, attrs):
+    x = ins["X"][0]
+    axes = attrs.get("axes", [])
+    if not axes:
+        return {"Out": jnp.squeeze(x)}
+    axes = tuple(a % x.ndim for a in axes if x.shape[a % x.ndim] == 1)
+    return {"Out": jnp.squeeze(x, axis=axes)}
+
+
+@register_op("squeeze2")
+def _squeeze2(ins, attrs):
+    out = _squeeze(ins, attrs)
+    out["XShape"] = _xshape(ins["X"][0])
+    return out
+
+
+@register_op("unsqueeze")
+def _unsqueeze(ins, attrs):
+    x = ins["X"][0]
+    for a in sorted(attrs["axes"]):
+        x = jnp.expand_dims(x, a)
+    return {"Out": x}
+
+
+@register_op("unsqueeze2")
+def _unsqueeze2(ins, attrs):
+    out = _unsqueeze(ins, attrs)
+    out["XShape"] = _xshape(ins["X"][0])
+    return out
+
+
+@register_op("flatten")
+def _flatten(ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 1)
+    lead = int(np.prod(x.shape[:axis])) if axis > 0 else 1
+    return {"Out": x.reshape((lead, -1))}
+
+
+@register_op("flatten2")
+def _flatten2(ins, attrs):
+    out = _flatten(ins, attrs)
+    out["XShape"] = _xshape(ins["X"][0])
+    return out
+
+
+@register_op("flatten_contiguous_range")
+def _flatten_range(ins, attrs):
+    x = ins["X"][0]
+    start = attrs.get("start_axis", 1) % max(x.ndim, 1)
+    stop = attrs.get("stop_axis", -1) % max(x.ndim, 1)
+    shape = x.shape[:start] + (-1,) + x.shape[stop + 1:]
+    return {"Out": x.reshape(shape), "XShape": _xshape(x)}
+
+
+@register_op("concat")
+def _concat(ins, attrs):
+    return {"Out": jnp.concatenate(ins["X"], axis=attrs.get("axis", 0))}
+
+
+@register_op("split")
+def _split(ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    num = attrs.get("num", 0)
+    sections = attrs.get("sections", [])
+    if sections:
+        idx = np.cumsum(sections[:-1]).tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    return {"Out": list(outs)}
+
+
+@register_op("stack")
+def _stack(ins, attrs):
+    return {"Y": jnp.stack(ins["X"], axis=attrs.get("axis", 0))}
+
+
+@register_op("unstack")
+def _unstack(ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    n = x.shape[axis]
+    return {"Y": [jnp.squeeze(s, axis)
+                  for s in jnp.split(x, n, axis=axis)]}
+
+
+@register_op("slice")
+def _slice(ins, attrs):
+    x = ins["Input"][0]
+    axes = attrs["axes"]
+    starts = attrs["starts"]
+    ends = attrs["ends"]
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[a] = slice(s, e)
+    out = x[tuple(idx)]
+    for a in sorted(attrs.get("decrease_axis", []), reverse=True):
+        out = jnp.squeeze(out, axis=a)
+    return {"Out": out}
+
+
+@register_op("strided_slice")
+def _strided_slice(ins, attrs):
+    x = ins["Input"][0]
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(attrs["axes"], attrs["starts"], attrs["ends"],
+                           attrs["strides"]):
+        idx[a] = slice(s, e, st)
+    return {"Out": x[tuple(idx)]}
+
+
+@register_op("gather")
+def _gather(ins, attrs):
+    x, idx = ins["X"][0], ins["Index"][0]
+    axis = attrs.get("axis", 0)
+    return {"Out": jnp.take(x, idx.astype(jnp.int32), axis=axis)}
+
+
+@register_op("gather_nd")
+def _gather_nd(ins, attrs):
+    x, idx = ins["X"][0], ins["Index"][0]
+    nd = idx.shape[-1]
+    flat_idx = tuple(idx[..., i] for i in range(nd))
+    return {"Out": x[flat_idx]}
+
+
+@register_op("scatter")
+def _scatter(ins, attrs):
+    x, ids, updates = ins["X"][0], ins["Ids"][0], ins["Updates"][0]
+    ids = ids.astype(jnp.int32).reshape((-1,))
+    if attrs.get("overwrite", True):
+        return {"Out": x.at[ids].set(updates)}
+    return {"Out": x.at[ids].add(updates)}
+
+
+@register_op("scatter_nd_add")
+def _scatter_nd_add(ins, attrs):
+    x, idx, updates = ins["X"][0], ins["Index"][0], ins["Updates"][0]
+    nd = idx.shape[-1]
+    flat_idx = tuple(idx[..., i] for i in range(nd))
+    return {"Out": x.at[flat_idx].add(updates)}
+
+
+@register_op("index_select")
+def _index_select(ins, attrs):
+    x, idx = ins["X"][0], ins["Index"][0]
+    return {"Out": jnp.take(x, idx.astype(jnp.int32),
+                            axis=attrs.get("dim", 0))}
+
+
+@register_op("expand")
+def _expand(ins, attrs):
+    x = ins["X"][0]
+    times = attrs["expand_times"]
+    return {"Out": jnp.tile(x, tuple(times))}
+
+
+@register_op("expand_v2")
+def _expand_v2(ins, attrs):
+    x = ins["X"][0]
+    shape = list(attrs["shape"])
+    # -1 keeps the input dim
+    ndiff = len(shape) - x.ndim
+    xs = (1,) * ndiff + x.shape
+    tgt = tuple(xs[i] if s == -1 else s for i, s in enumerate(shape))
+    return {"Out": jnp.broadcast_to(x.reshape(xs), tgt)}
+
+
+@register_op("expand_as_v2")
+def _expand_as(ins, attrs):
+    x = ins["X"][0]
+    shape = attrs.get("target_shape")
+    if shape is None:
+        shape = ins["Y"][0].shape
+    return {"Out": jnp.broadcast_to(x, tuple(shape))}
+
+
+@register_op("tile")
+def _tile(ins, attrs):
+    return {"Out": jnp.tile(ins["X"][0], tuple(attrs["repeat_times"]))}
+
+
+@register_op("top_k")
+def _top_k(ins, attrs):
+    x = ins["X"][0]
+    k = attrs.get("k", 1)
+    vals, idx = lax.top_k(x, k)
+    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+
+
+@register_op("top_k_v2")
+def _top_k_v2(ins, attrs):
+    x = ins["X"][0]
+    k = attrs.get("k", 1)
+    axis = attrs.get("axis", -1) % x.ndim
+    largest = attrs.get("largest", True)
+    xm = jnp.moveaxis(x, axis, -1)
+    if not largest:
+        xm = -xm
+    vals, idx = lax.top_k(xm, k)
+    if not largest:
+        vals = -vals
+    return {"Out": jnp.moveaxis(vals, -1, axis),
+            "Indices": jnp.moveaxis(idx.astype(jnp.int64), -1, axis)}
+
+
+@register_op("arg_max")
+def _arg_max(ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    out = jnp.argmax(x, axis=axis)
+    if attrs.get("keepdims", False):
+        out = jnp.expand_dims(out, axis)
+    return {"Out": out.astype(jnp.int64)}
+
+
+@register_op("arg_min")
+def _arg_min(ins, attrs):
+    x = ins["X"][0]
+    out = jnp.argmin(x, axis=attrs.get("axis", -1))
+    return {"Out": out.astype(jnp.int64)}
+
+
+@register_op("argsort")
+def _argsort(ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    desc = attrs.get("descending", False)
+    idx = jnp.argsort(-x if desc else x, axis=axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return {"Out": out, "Indices": idx.astype(jnp.int64)}
+
+
+@register_op("range")
+def _range(ins, attrs):
+    start = float(np.asarray(ins["Start"][0]).reshape(()))
+    end = float(np.asarray(ins["End"][0]).reshape(()))
+    step = float(np.asarray(ins["Step"][0]).reshape(()))
+    dtype = ins["Start"][0].dtype
+    return {"Out": jnp.arange(start, end, step).astype(dtype)}
+
+
+@register_op("cumsum")
+def _cumsum(ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    if attrs.get("flatten", False):
+        x = x.reshape((-1,))
+        axis = 0
+    out = jnp.cumsum(x, axis=axis)
+    if attrs.get("exclusive", False):
+        out = out - x
+    if attrs.get("reverse", False):
+        out = jnp.flip(jnp.cumsum(jnp.flip(x, axis), axis=axis), axis)
+    return {"Out": out}
+
+
+@register_op("where")
+def _where(ins, attrs):
+    return {"Out": jnp.where(ins["Condition"][0], ins["X"][0], ins["Y"][0])}
+
+
+@register_op("where_index")
+def _where_index(ins, attrs):
+    # dynamic output shape: only usable eagerly (outside jit)
+    cond = np.asarray(ins["Condition"][0])
+    return {"Out": jnp.asarray(np.argwhere(cond).astype(np.int64))}
+
+
+@register_op("masked_select")
+def _masked_select(ins, attrs):
+    x = np.asarray(ins["X"][0])
+    mask = np.asarray(ins["Mask"][0])
+    return {"Y": jnp.asarray(x[mask])}
+
+
+@register_op("tril_triu")
+def _tril_triu(ins, attrs):
+    x = ins["X"][0]
+    diag = attrs.get("diagonal", 0)
+    if attrs.get("lower", True):
+        return {"Out": jnp.tril(x, diag)}
+    return {"Out": jnp.triu(x, diag)}
+
+
+@register_op("diag_v2")
+def _diag(ins, attrs):
+    return {"Out": jnp.diag(ins["X"][0], k=attrs.get("offset", 0))}
+
+
+@register_op("eye")
+def _eye(ins, attrs):
+    dtype = to_numpy_dtype(attrs.get("dtype", "float32"))
+    rows = attrs["num_rows"]
+    cols = attrs.get("num_columns", -1)
+    return {"Out": jnp.eye(rows, cols if cols > 0 else rows, dtype=dtype)}
+
+
+@register_op("linspace")
+def _linspace(ins, attrs):
+    start = np.asarray(ins["Start"][0]).reshape(())
+    stop = np.asarray(ins["Stop"][0]).reshape(())
+    num = int(np.asarray(ins["Num"][0]).reshape(()))
+    dtype = to_numpy_dtype(attrs.get("dtype", "float32"))
+    return {"Out": jnp.linspace(start, stop, num, dtype=dtype)}
+
+
+@register_op("roll")
+def _roll(ins, attrs):
+    x = ins["X"][0]
+    shifts = attrs["shifts"]
+    axis = attrs.get("axis", None)
+    return {"Out": jnp.roll(x, shifts, axis=tuple(axis) if axis else None)}
+
+
+@register_op("flip")
+def _flip(ins, attrs):
+    return {"Out": jnp.flip(ins["X"][0], axis=tuple(attrs["axis"]))}
+
+
+@register_op("unique")
+def _unique(ins, attrs):
+    x = np.asarray(ins["X"][0])
+    out, index = np.unique(x, return_inverse=True)
+    return {"Out": jnp.asarray(out), "Index": jnp.asarray(index.astype(np.int32))}
+
+
+@register_op("take_along_axis")
+def _take_along_axis(ins, attrs):
+    x, idx = ins["Input"][0], ins["Index"][0]
+    return {"Result": jnp.take_along_axis(
+        x, idx.astype(jnp.int32), axis=attrs.get("Axis", 0))}
+
+
+@register_op("meshgrid")
+def _meshgrid(ins, attrs):
+    outs = jnp.meshgrid(*ins["X"], indexing="ij")
+    return {"Out": list(outs)}
+
+
+@register_op("increment")
+def _increment(ins, attrs):
+    x = ins["X"][0]
+    return {"Out": x + jnp.asarray(attrs.get("step", 1.0), x.dtype)}

@@ -1,0 +1,7 @@
+"""paddle.optimizer 2.0-style namespace (reference:
+`python/paddle/optimizer/`)."""
+from ..fluid.optimizer import (  # noqa: F401
+    Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Adagrad, RMSProp, Lamb,
+    SGDOptimizer, MomentumOptimizer, AdamOptimizer, AdamaxOptimizer,
+    AdagradOptimizer, RMSPropOptimizer, LambOptimizer,
+)

@@ -1,0 +1,10 @@
+"""paddle_tpu.parallel — mesh management, SPMD trainers, pipeline engine.
+
+TPU-native heart of the framework's distribution story (reference
+counterparts: ParallelExecutor/SSA graphs, Fleet transpilers, NCCL comm
+registry — SURVEY.md §2.3).
+"""
+from . import env  # noqa: F401
+from .env import (  # noqa: F401
+    register_ring, set_global_mesh, global_mesh, collective_scope,
+)

@@ -1,0 +1,100 @@
+"""Mesh / collective-context registry.
+
+Reference parity: `paddle/fluid/platform/collective_helper.h:50-108` keys
+NCCL communicators by `ring_id`; `nccl_helper.h:92` holds the context map.
+TPU-native: a ring is a *named mesh axis* of a `jax.sharding.Mesh`. During
+shard_map lowering the active axis map is pushed here so collective ops can
+emit `lax.psum(..., axis_name)`; outside any mesh they degrade to identity
+(single-chip semantics).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Dict, Optional
+
+_tls = threading.local()
+
+# ring_id -> (axis_name, axis_size). Global registry, mirrors
+# NCCLCommContext's ring registry.
+_RINGS: Dict[int, tuple] = {}
+
+_GLOBAL_MESH = None
+
+
+def set_global_mesh(mesh):
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+
+
+def global_mesh():
+    return _GLOBAL_MESH
+
+
+def register_ring(ring_id: int, axis_name: str, axis_size: int):
+    """TPU analogue of CCommInitOp: bind a ring id to a mesh axis."""
+    _RINGS[int(ring_id)] = (axis_name, int(axis_size))
+
+
+def ring_info(ring_id: int):
+    return _RINGS.get(int(ring_id))
+
+
+@contextlib.contextmanager
+def collective_scope(active_axes):
+    """Mark mesh axes as live (inside shard_map) for collective lowering.
+
+    active_axes: dict axis_name -> axis_size.
+    """
+    prev = getattr(_tls, "axes", None)
+    _tls.axes = dict(active_axes)
+    try:
+        yield
+    finally:
+        _tls.axes = prev
+
+
+def active_axes() -> Optional[dict]:
+    return getattr(_tls, "axes", None)
+
+
+def axis_name_for_ring(ring_id: int) -> Optional[str]:
+    axes = active_axes()
+    if not axes:
+        return None
+    info = _RINGS.get(int(ring_id))
+    if info is None:
+        # Default ring 0 = the sole active axis if unambiguous.
+        if int(ring_id) == 0 and len(axes) == 1:
+            return next(iter(axes))
+        return None
+    name = info[0]
+    return name if name in axes else None
+
+
+def axis_size_for_ring(ring_id: int) -> int:
+    axes = active_axes() or {}
+    name = axis_name_for_ring(ring_id)
+    if name is None:
+        return 1
+    return axes[name]
+
+
+# -- launch env contract (reference: distributed/utils.py:356-360) ----------
+
+def trainer_id() -> int:
+    return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+
+def trainer_num() -> int:
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+
+def trainer_endpoints():
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+    return eps.split(",") if eps else []
+
+
+def current_endpoint() -> str:
+    return os.environ.get("PADDLE_CURRENT_ENDPOINT", "")

@@ -1,0 +1,14 @@
+"""paddle.tensor 2.0-style namespace (reference: `python/paddle/tensor/`)
+— math/manipulation/creation re-exports over fluid.layers."""
+from ..fluid.layers.nn import (  # noqa: F401
+    matmul, elementwise_add as add, elementwise_sub as subtract,
+    elementwise_mul as multiply, elementwise_div as divide,
+    reduce_sum as sum, reduce_mean as mean, reduce_max as max,
+    reduce_min as min, reduce_prod as prod, clip, topk, squeeze, unsqueeze,
+    stack, split, gather, gather_nd, scatter, where, expand,
+    maximum, minimum, sqrt, square, exp, log, abs, sin, cos,
+)
+from ..fluid.layers.tensor import (  # noqa: F401
+    cast, concat, reshape, transpose, zeros, ones, zeros_like, ones_like,
+    argmax, argmin, argsort, cumsum, linspace, eye, tril, triu, fill_constant,
+)

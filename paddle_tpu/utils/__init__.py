@@ -1,0 +1,1 @@
+from . import flags  # noqa: F401

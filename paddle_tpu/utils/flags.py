@@ -1,0 +1,71 @@
+"""Global flags system (reference: `platform/flags.cc:33-407` ~40 gflags,
+surfaced to python via `pybind/global_value_getter_setter.cc` and
+`fluid.set_flags`). Flags ingest `FLAGS_*` environment variables at import,
+matching the reference's init behavior (`platform/init.cc`)."""
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+_FLAGS: Dict[str, object] = {
+    # numerics / debugging (reference: flags.cc check_nan_inf)
+    "FLAGS_check_nan_inf": False,
+    "FLAGS_fast_check_nan_inf": False,
+    "FLAGS_benchmark": False,
+    "FLAGS_enable_unused_var_check": False,
+    # determinism
+    "FLAGS_cpu_deterministic": False,
+    "FLAGS_cudnn_deterministic": False,
+    # memory (fraction knobs are PJRT's on TPU; kept for compat)
+    "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
+    "FLAGS_allocator_strategy": "auto_growth",
+    "FLAGS_eager_delete_tensor_gb": 0.0,
+    # device selection
+    "FLAGS_selected_gpus": "",
+    "FLAGS_selected_tpus": "",
+    # comm
+    "FLAGS_sync_nccl_allreduce": True,
+    "FLAGS_communicator_max_merge_var_num": 20,
+    "FLAGS_communicator_send_queue_size": 20,
+    # rng
+    "FLAGS_seed": 0,
+    # lowering controls (TPU-specific additions)
+    "FLAGS_tpu_donate_buffers": True,
+    "FLAGS_tpu_compile_cache_size": 128,
+}
+
+
+def _ingest_env():
+    for k in list(_FLAGS):
+        if k in os.environ:
+            v = os.environ[k]
+            cur = _FLAGS[k]
+            if isinstance(cur, bool):
+                _FLAGS[k] = v.lower() in ("1", "true", "yes")
+            elif isinstance(cur, int):
+                _FLAGS[k] = int(v)
+            elif isinstance(cur, float):
+                _FLAGS[k] = float(v)
+            else:
+                _FLAGS[k] = v
+
+
+_ingest_env()
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    return {f: _FLAGS.get(f) for f in flags}
+
+
+def set_flags(flags_dict):
+    for k, v in flags_dict.items():
+        if k not in _FLAGS:
+            # accept unknown flags (reference tolerates unknown gflags too)
+            pass
+        _FLAGS[k] = v
+
+
+def get_flag(name, default=None):
+    return _FLAGS.get(name, default)

@@ -1,0 +1,63 @@
+"""End-to-end: MNIST-style MLP trains in the fluid static-graph mode
+(BASELINE.json config 1; reference test:
+`python/paddle/fluid/tests/book/test_recognize_digits.py:65`)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def _synthetic_mnist(rng, n=512):
+    # separable synthetic data so loss must drop fast
+    x = rng.rand(n, 784).astype("float32") * 0.1
+    y = rng.randint(0, 10, size=(n, 1)).astype("int64")
+    for i in range(n):
+        x[i, y[i, 0] * 78:(y[i, 0] + 1) * 78] += 1.0
+    return x, y
+
+
+def build_mlp():
+    img = fluid.layers.data(name="img", shape=[784], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(input=img, size=128, act="relu")
+    h = fluid.layers.fc(input=h, size=64, act="relu")
+    logits = fluid.layers.fc(input=h, size=10, act=None)
+    loss = fluid.layers.softmax_with_cross_entropy(logits, label)
+    avg_loss = fluid.layers.mean(loss)
+    acc = fluid.layers.accuracy(input=fluid.layers.softmax(logits),
+                                label=label)
+    return avg_loss, acc
+
+
+def test_mnist_mlp_trains(rng):
+    avg_loss, acc = build_mlp()
+    opt = fluid.optimizer.SGDOptimizer(learning_rate=0.5)
+    opt.minimize(avg_loss)
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+
+    x, y = _synthetic_mnist(rng)
+    losses = []
+    for step in range(30):
+        i = (step * 64) % 448
+        out = exe.run(fluid.default_main_program(),
+                      feed={"img": x[i:i + 64], "label": y[i:i + 64]},
+                      fetch_list=[avg_loss, acc])
+        losses.append(float(out[0]))
+    assert losses[-1] < losses[0] * 0.5, losses
+    assert losses[-1] < 0.7, losses
+
+
+def test_mnist_eval_and_fetch_params(rng):
+    avg_loss, acc = build_mlp()
+    opt = fluid.optimizer.AdamOptimizer(learning_rate=1e-3)
+    opt.minimize(avg_loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    x, y = _synthetic_mnist(rng, 64)
+    l0 = exe.run(feed={"img": x, "label": y}, fetch_list=[avg_loss])[0]
+    for _ in range(20):
+        exe.run(feed={"img": x, "label": y}, fetch_list=[avg_loss])
+    l1 = exe.run(feed={"img": x, "label": y}, fetch_list=[avg_loss])[0]
+    assert float(l1) < float(l0)
