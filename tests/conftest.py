@@ -3,11 +3,25 @@ sharding/collective tests run without TPU hardware (SURVEY.md §4.4:
 CI runs on CPU with xla_force_host_platform_device_count)."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+# jaxtyping's pytest plugin imports jax before this conftest runs, which can
+# initialize the accelerator backend (axon/TPU). Reset so the env above
+# (cpu + 8 virtual devices) takes effect for all tests.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.clear_caches()
+    jax.extend.backend.clear_backends()
+except Exception:
+    pass
+assert jax.default_backend() == "cpu", jax.devices()
+assert len(jax.devices()) == 8, jax.devices()
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,170 @@
+"""OpTest fixture — the golden-test workhorse (reference:
+`python/paddle/fluid/tests/unittests/op_test.py:170`): declare op_type /
+inputs / attrs / expected numpy outputs; check_output builds a one-op
+program and compares; check_grad compares jax.vjp analytic grads against
+central-difference numeric grads (reference: get_numeric_gradient
+op_test.py:57)."""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework
+from paddle_tpu import ops as ops_lib
+
+
+def _as_list(v):
+    return v if isinstance(v, (list, tuple)) else [v]
+
+
+class OpTest:
+    op_type: str = None
+    inputs: dict = {}
+    attrs: dict = {}
+    outputs: dict = {}
+
+    # -- forward -----------------------------------------------------------
+    def _run_forward(self, ins_np=None):
+        ins_np = ins_np if ins_np is not None else self.inputs
+        import jax.numpy as jnp
+
+        raw = {slot: [jnp.asarray(a) for a in _as_list(v)]
+               for slot, v in ins_np.items()}
+        return ops_lib.run_op(self.op_type, raw, self.attrs)
+
+    def check_output(self, atol=1e-5, rtol=1e-4, no_check_set=()):
+        outs = self._run_forward()
+        for slot, expect in self.outputs.items():
+            if slot in no_check_set:
+                continue
+            got = outs[slot]
+            for g, e in zip(got, _as_list(expect)):
+                e = np.asarray(e)
+                g = np.asarray(g)
+                assert g.shape == tuple(e.shape), (
+                    "%s.%s shape %s != %s" % (self.op_type, slot, g.shape,
+                                              e.shape))
+                np.testing.assert_allclose(
+                    g.astype("float64") if g.dtype.kind == "f" else g,
+                    e.astype("float64") if e.dtype.kind == "f" else e,
+                    atol=atol, rtol=rtol,
+                    err_msg="%s output %s" % (self.op_type, slot))
+
+    # -- gradient ----------------------------------------------------------
+    def _loss_of(self, outs, output_name):
+        total = None
+        for slot, vals in outs.items():
+            for i, v in enumerate(vals):
+                nm = slot if len(vals) == 1 else "%s[%d]" % (slot, i)
+                if output_name in (slot, nm):
+                    s = np.sum(np.asarray(v, dtype="float64"))
+                    total = s if total is None else total + s
+        assert total is not None, "output %r not found" % output_name
+        return total
+
+    def check_grad(self, inputs_to_check, output_name, delta=5e-3,
+                   max_relative_error=5e-3):
+        import jax
+        import jax.numpy as jnp
+
+        flat_slots = sorted(self.inputs)
+        raw = {slot: [jnp.asarray(a) for a in _as_list(self.inputs[slot])]
+               for slot in flat_slots}
+
+        def f(check_vals):
+            ins = {s: list(vs) for s, vs in raw.items()}
+            for slot, v in check_vals.items():
+                ins[slot] = [v]
+            outs = ops_lib.run_op(self.op_type, ins, self.attrs)
+            total = None
+            for slot, vals in outs.items():
+                if slot != output_name:
+                    continue
+                for v in vals:
+                    if jnp.issubdtype(v.dtype, jnp.floating):
+                        s = jnp.sum(v.astype(jnp.float32))
+                        total = s if total is None else total + s
+            return total
+
+        check_vals = {s: raw[s][0] for s in inputs_to_check}
+        analytic = jax.grad(f)(check_vals)
+
+        for slot in inputs_to_check:
+            a = np.asarray(analytic[slot], dtype="float64")
+            n = self._numeric_grad(slot, output_name, delta)
+            denom = np.maximum(np.maximum(np.abs(a), np.abs(n)), 1e-3)
+            rel = np.abs(a - n) / denom
+            rel = np.where(np.abs(a - n) < 1e-4, 0.0, rel)  # fp-noise floor
+            assert rel.max() <= max_relative_error, (
+                "%s grad wrt %s: max rel err %.4g\nanalytic=%s\nnumeric=%s"
+                % (self.op_type, slot, rel.max(), a.ravel()[:8],
+                   n.ravel()[:8]))
+
+    def _numeric_grad(self, slot, output_name, delta):
+        base = {s: [np.asarray(a, dtype="float32") for a in _as_list(v)]
+                for s, v in self.inputs.items()}
+        x = base[slot][0]
+        grad = np.zeros_like(x, dtype="float64")
+        it = np.nditer(x, flags=["multi_index"])
+        while not it.finished:
+            idx = it.multi_index
+            orig = x[idx]
+            x[idx] = orig + delta
+            hi = self._loss_of(self._run_forward(base), output_name)
+            x[idx] = orig - delta
+            lo = self._loss_of(self._run_forward(base), output_name)
+            x[idx] = orig
+            grad[idx] = (hi - lo) / (2 * delta)
+            it.iternext()
+        return grad
+
+
+class ProgramOpTest(OpTest):
+    """Variant that goes through the FULL static-graph pipeline (program
+    build -> Executor -> lowering), not just the registry."""
+
+    def check_output_with_program(self, atol=1e-5, rtol=1e-4):
+        from paddle_tpu.fluid.layer_helper import LayerHelper
+
+        main, startup = framework.Program(), framework.Program()
+        with framework.program_guard(main, startup):
+            feed = {}
+            in_vars = {}
+            for slot, v in self.inputs.items():
+                vars_ = []
+                for i, arr in enumerate(_as_list(v)):
+                    arr = np.asarray(arr)
+                    name = "%s_%d" % (slot.lower(), i)
+                    var = main.global_block().create_var(
+                        name=name, shape=arr.shape,
+                        dtype=str(arr.dtype), is_data=True,
+                        stop_gradient=True)
+                    vars_.append(var)
+                    feed[name] = arr
+                in_vars[slot] = vars_
+            helper = LayerHelper(self.op_type)
+            out_vars = {}
+            fetch = []
+            for slot, expect in self.outputs.items():
+                vs = [helper.create_variable_for_type_inference()
+                      for _ in _as_list(expect)]
+                out_vars[slot] = vs
+                fetch.extend(vs)
+            main.global_block().append_op(
+                type=self.op_type, inputs=in_vars, outputs=out_vars,
+                attrs=self.attrs)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            results = exe.run(main, feed=feed, fetch_list=fetch)
+        i = 0
+        for slot, expect in self.outputs.items():
+            for e in _as_list(expect):
+                e = np.asarray(e)
+                g = results[i]
+                i += 1
+                np.testing.assert_allclose(
+                    np.asarray(g, dtype="float64")
+                    if g.dtype.kind == "f" else g,
+                    e.astype("float64") if e.dtype.kind == "f" else e,
+                    atol=atol, rtol=rtol,
+                    err_msg="%s output %s" % (self.op_type, slot))

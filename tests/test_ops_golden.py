@@ -1,0 +1,415 @@
+"""Op-level golden tests vs numpy references (reference test strategy:
+SURVEY.md §4.1, op_test.py fixture)."""
+import numpy as np
+import pytest
+
+from op_test import OpTest, ProgramOpTest
+
+
+def rngf(*shape, seed=7, scale=1.0):
+    r = np.random.RandomState(seed)
+    return (r.rand(*shape).astype("float32") - 0.5) * 2 * scale
+
+
+class TestMatmul(OpTest):
+    op_type = "matmul"
+
+    def test(self):
+        x, y = rngf(3, 4, 5), rngf(3, 5, 6, seed=8)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"transpose_X": False, "transpose_Y": False,
+                      "alpha": 1.0}
+        self.outputs = {"Out": np.matmul(x, y)}
+        self.check_output()
+
+    def test_transpose(self):
+        x, y = rngf(4, 3), rngf(4, 6, seed=9)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"transpose_X": True, "transpose_Y": False,
+                      "alpha": 2.0}
+        self.outputs = {"Out": 2.0 * (x.T @ y)}
+        self.check_output()
+
+    def test_grad(self):
+        x, y = rngf(3, 4), rngf(4, 5, seed=8)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"transpose_X": False, "transpose_Y": False,
+                      "alpha": 1.0}
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestMul(OpTest):
+    op_type = "mul"
+
+    def test(self):
+        x, y = rngf(2, 3, 4), rngf(12, 5, seed=8)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"x_num_col_dims": 1, "y_num_col_dims": 1}
+        self.outputs = {"Out": x.reshape(2, 12) @ y}
+        self.check_output()
+
+
+class TestElementwiseAxis(OpTest):
+    op_type = "elementwise_add"
+
+    def test_axis_broadcast(self):
+        x, y = rngf(2, 3, 4, 5), rngf(3, 4, seed=8)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": x + y.reshape(1, 3, 4, 1)}
+        self.check_output()
+
+    def test_same_shape(self):
+        x, y = rngf(4, 5), rngf(4, 5, seed=8)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": -1}
+        self.outputs = {"Out": x + y}
+        self.check_output()
+
+
+class TestSoftmax(OpTest):
+    op_type = "softmax"
+
+    def test(self):
+        x = rngf(4, 10)
+        e = np.exp(x - x.max(-1, keepdims=True))
+        self.inputs = {"X": x}
+        self.attrs = {"axis": -1}
+        self.outputs = {"Out": e / e.sum(-1, keepdims=True)}
+        self.check_output()
+
+    def test_grad(self):
+        self.inputs = {"X": rngf(3, 6)}
+        self.attrs = {"axis": -1}
+        self.check_grad(["X"], "Out")
+
+
+class TestSoftmaxCE(OpTest):
+    op_type = "softmax_with_cross_entropy"
+
+    def test(self):
+        logits = rngf(5, 7)
+        label = np.array([[0], [3], [6], [2], [1]], dtype="int64")
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        sm = e / e.sum(-1, keepdims=True)
+        loss = -np.log(sm[np.arange(5), label[:, 0]])[:, None]
+        self.inputs = {"Logits": logits, "Label": label}
+        self.attrs = {"soft_label": False, "axis": -1}
+        self.outputs = {"Softmax": sm, "Loss": loss}
+        self.check_output()
+
+    def test_grad(self):
+        self.inputs = {"Logits": rngf(4, 5),
+                       "Label": np.array([[0], [1], [4], [2]], "int64")}
+        self.attrs = {"soft_label": False, "axis": -1}
+        self.check_grad(["Logits"], "Loss")
+
+
+class TestLayerNorm(OpTest):
+    op_type = "layer_norm"
+
+    def test(self):
+        x = rngf(4, 6)
+        scale = rngf(6, seed=8) + 1.0
+        bias = rngf(6, seed=9)
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        y = (x - mu) / np.sqrt(var + 1e-5) * scale + bias
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.attrs = {"begin_norm_axis": 1, "epsilon": 1e-5}
+        self.outputs = {"Y": y, "Mean": mu.reshape(4),
+                        "Variance": var.reshape(4)}
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.inputs = {"X": rngf(3, 5), "Scale": rngf(5, seed=8) + 1.0,
+                       "Bias": rngf(5, seed=9)}
+        self.attrs = {"begin_norm_axis": 1, "epsilon": 1e-5}
+        self.check_grad(["X", "Scale", "Bias"], "Y",
+                        max_relative_error=1e-2)
+
+
+class TestBatchNormTrain(OpTest):
+    op_type = "batch_norm"
+
+    def test(self):
+        x = rngf(4, 3, 2, 2)
+        scale = np.ones(3, "float32") * 1.5
+        bias = np.zeros(3, "float32")
+        mean = np.zeros(3, "float32")
+        var = np.ones(3, "float32")
+        bm = x.mean(axis=(0, 2, 3))
+        bv = x.var(axis=(0, 2, 3))
+        y = ((x - bm.reshape(1, 3, 1, 1))
+             / np.sqrt(bv.reshape(1, 3, 1, 1) + 1e-5)) * 1.5
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias,
+                       "Mean": mean, "Variance": var}
+        self.attrs = {"momentum": 0.9, "epsilon": 1e-5, "is_test": False,
+                      "data_layout": "NCHW"}
+        self.outputs = {
+            "Y": y,
+            "MeanOut": mean * 0.9 + bm * 0.1,
+            "VarianceOut": var * 0.9 + bv * 0.1,
+            "SavedMean": bm,
+            "SavedVariance": 1.0 / np.sqrt(bv + 1e-5),
+        }
+        self.check_output(atol=1e-4)
+
+
+class TestConv2D(OpTest):
+    op_type = "conv2d"
+
+    @staticmethod
+    def _ref_conv(x, w, stride, pad):
+        n, c, h, wd = x.shape
+        oc, ic, kh, kw = w.shape
+        xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+        oh = (h + 2 * pad - kh) // stride + 1
+        ow = (wd + 2 * pad - kw) // stride + 1
+        out = np.zeros((n, oc, oh, ow), "float32")
+        for i in range(oh):
+            for j in range(ow):
+                patch = xp[:, :, i * stride:i * stride + kh,
+                           j * stride:j * stride + kw]
+                out[:, :, i, j] = np.tensordot(
+                    patch, w, axes=([1, 2, 3], [1, 2, 3]))
+        return out
+
+    def test(self):
+        x = rngf(2, 3, 5, 5)
+        w = rngf(4, 3, 3, 3, seed=8)
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 1}
+        self.outputs = {"Output": self._ref_conv(x, w, 1, 1)}
+        self.check_output(atol=1e-4)
+
+    def test_stride2(self):
+        x = rngf(1, 2, 6, 6)
+        w = rngf(3, 2, 3, 3, seed=8)
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [2, 2], "paddings": [0, 0],
+                      "dilations": [1, 1], "groups": 1}
+        self.outputs = {"Output": self._ref_conv(x, w, 2, 0)}
+        self.check_output(atol=1e-4)
+
+
+class TestPool2D(OpTest):
+    op_type = "pool2d"
+
+    def test_max(self):
+        x = rngf(2, 3, 4, 4)
+        ref = x.reshape(2, 3, 2, 2, 2, 2).max(axis=(3, 5))
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "max", "ksize": [2, 2],
+                      "strides": [2, 2], "paddings": [0, 0]}
+        self.outputs = {"Out": ref}
+        self.check_output()
+
+    def test_avg(self):
+        x = rngf(2, 3, 4, 4)
+        ref = x.reshape(2, 3, 2, 2, 2, 2).mean(axis=(3, 5))
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "avg", "ksize": [2, 2],
+                      "strides": [2, 2], "paddings": [0, 0],
+                      "exclusive": True}
+        self.outputs = {"Out": ref}
+        self.check_output()
+
+    def test_global(self):
+        x = rngf(2, 3, 4, 4)
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "avg", "ksize": [1, 1],
+                      "global_pooling": True}
+        self.outputs = {"Out": x.mean(axis=(2, 3), keepdims=True)}
+        self.check_output()
+
+
+class TestReduce(OpTest):
+    op_type = "reduce_sum"
+
+    def test_dim(self):
+        x = rngf(3, 4, 5)
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [1], "keep_dim": False, "reduce_all": False}
+        self.outputs = {"Out": x.sum(1)}
+        self.check_output()
+
+    def test_all(self):
+        x = rngf(3, 4)
+        self.inputs = {"X": x}
+        self.attrs = {"reduce_all": True, "dim": [0], "keep_dim": False}
+        self.outputs = {"Out": np.asarray([x.sum()], "float32").reshape(())}
+        # reduce_all produces shape (1,)
+        self.outputs = {"Out": x.sum().reshape(1)}
+        self.check_output()
+
+
+class TestLookupTable(OpTest):
+    op_type = "lookup_table_v2"
+
+    def test(self):
+        w = rngf(10, 4)
+        ids = np.array([[1, 2], [3, 0]], "int64")
+        self.inputs = {"W": w, "Ids": ids}
+        self.attrs = {"padding_idx": -1}
+        self.outputs = {"Out": w[ids]}
+        self.check_output()
+
+    def test_padding(self):
+        w = rngf(10, 4)
+        ids = np.array([[1, 2], [3, 2]], "int64")
+        ref = w[ids].copy()
+        ref[ids == 2] = 0.0
+        self.inputs = {"W": w, "Ids": ids}
+        self.attrs = {"padding_idx": 2}
+        self.outputs = {"Out": ref}
+        self.check_output()
+
+
+class TestTopK(OpTest):
+    op_type = "top_k"
+
+    def test(self):
+        x = np.array([[1.0, 3.0, 2.0], [5.0, 4.0, 6.0]], "float32")
+        self.inputs = {"X": x}
+        self.attrs = {"k": 2}
+        self.outputs = {"Out": np.array([[3.0, 2.0], [6.0, 5.0]],
+                                        "float32"),
+                        "Indices": np.array([[1, 2], [2, 0]], "int64")}
+        self.check_output()
+
+
+class TestAccuracy(OpTest):
+    op_type = "accuracy"
+
+    def test(self):
+        indices = np.array([[0, 1], [2, 3], [4, 5]], "int64")
+        label = np.array([[1], [0], [4]], "int64")
+        self.inputs = {"Out": rngf(3, 2), "Indices": indices,
+                       "Label": label}
+        self.outputs = {"Accuracy": np.array([2.0 / 3], "float32"),
+                        "Correct": np.array([2], "int32"),
+                        "Total": np.array([3], "int32")}
+        self.check_output()
+
+
+class TestCast(OpTest):
+    op_type = "cast"
+
+    def test(self):
+        x = rngf(3, 4)
+        self.inputs = {"X": x}
+        self.attrs = {"out_dtype": "int32"}
+        self.outputs = {"Out": x.astype("int32")}
+        self.check_output()
+
+
+class TestOneHot(OpTest):
+    op_type = "one_hot_v2"
+
+    def test(self):
+        x = np.array([1, 0, 3], "int64")
+        ref = np.zeros((3, 4), "float32")
+        ref[np.arange(3), x] = 1.0
+        self.inputs = {"X": x}
+        self.attrs = {"depth": 4}
+        self.outputs = {"Out": ref}
+        self.check_output()
+
+
+class TestDropoutInfer(OpTest):
+    op_type = "dropout"
+
+    def test_is_test(self):
+        x = rngf(4, 5)
+        self.inputs = {"X": x}
+        self.attrs = {"dropout_prob": 0.3, "is_test": True,
+                      "dropout_implementation": "downgrade_in_infer"}
+        self.outputs = {"Out": x * 0.7}
+        self.check_output(no_check_set=("Mask",))
+
+    def test_upscale_infer(self):
+        x = rngf(4, 5)
+        self.inputs = {"X": x}
+        self.attrs = {"dropout_prob": 0.3, "is_test": True,
+                      "dropout_implementation": "upscale_in_train"}
+        self.outputs = {"Out": x}
+        self.check_output(no_check_set=("Mask",))
+
+    def test_train_stats(self):
+        # statistical check: ~p zeros, upscale preserves mean
+        from paddle_tpu import ops as ops_lib
+        import jax.numpy as jnp
+        import jax
+
+        x = np.ones((100, 100), "float32")
+        out = ops_lib.run_op(
+            "dropout", {"X": [jnp.asarray(x)]},
+            {"dropout_prob": 0.4, "is_test": False,
+             "dropout_implementation": "upscale_in_train",
+             "_rng_key": jax.random.PRNGKey(0)})
+        o = np.asarray(out["Out"][0])
+        frac_zero = (o == 0).mean()
+        assert abs(frac_zero - 0.4) < 0.03
+        assert abs(o.mean() - 1.0) < 0.05
+
+
+class TestGather(OpTest):
+    op_type = "gather"
+
+    def test(self):
+        x = rngf(5, 3)
+        idx = np.array([0, 2, 4], "int64")
+        self.inputs = {"X": x, "Index": idx}
+        self.attrs = {"axis": 0}
+        self.outputs = {"Out": x[idx]}
+        self.check_output()
+
+
+class TestConcatSplit(OpTest):
+    op_type = "concat"
+
+    def test_concat(self):
+        xs = [rngf(2, 3), rngf(2, 4, seed=8), rngf(2, 1, seed=9)]
+        self.inputs = {"X": xs}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": np.concatenate(xs, 1)}
+        self.check_output()
+
+
+class TestSliceOp(OpTest):
+    op_type = "slice"
+
+    def test(self):
+        x = rngf(4, 5, 6)
+        self.inputs = {"Input": x}
+        self.attrs = {"axes": [0, 2], "starts": [1, 2], "ends": [3, 5],
+                      "decrease_axis": []}
+        self.outputs = {"Out": x[1:3, :, 2:5]}
+        self.check_output()
+
+
+class TestActivationGrads(OpTest):
+    op_type = "tanh"
+
+    @pytest.mark.parametrize("op", ["relu", "sigmoid", "tanh", "gelu",
+                                    "softplus", "square", "exp"])
+    def test_grads(self, op):
+        self.op_type = op
+        self.inputs = {"X": rngf(3, 4) + 0.1}
+        self.attrs = {}
+        self.check_grad(["X"], "Out", max_relative_error=1e-2)
+
+
+class TestProgramPath(ProgramOpTest):
+    """One op through the whole program->Executor->XLA pipeline."""
+
+    op_type = "elementwise_mul"
+
+    def test(self):
+        x, y = rngf(3, 4), rngf(3, 4, seed=8)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": -1}
+        self.outputs = {"Out": x * y}
+        self.check_output_with_program()

@@ -1,0 +1,132 @@
+"""Optimizer update kernels vs numpy references (reference:
+`tests/unittests/test_adam_op.py` etc.)."""
+import numpy as np
+
+from op_test import OpTest
+
+
+def rngf(*shape, seed=3):
+    r = np.random.RandomState(seed)
+    return (r.rand(*shape).astype("float32") - 0.5)
+
+
+class TestSGD(OpTest):
+    op_type = "sgd"
+
+    def test(self):
+        p, g = rngf(4, 3), rngf(4, 3, seed=4)
+        lr = np.array([0.1], "float32")
+        self.inputs = {"Param": p, "Grad": g, "LearningRate": lr}
+        self.outputs = {"ParamOut": p - 0.1 * g}
+        self.check_output()
+
+
+class TestMomentum(OpTest):
+    op_type = "momentum"
+
+    def test(self):
+        p, g, v = rngf(4), rngf(4, seed=4), rngf(4, seed=5)
+        lr = np.array([0.2], "float32")
+        v_out = 0.9 * v + g
+        self.inputs = {"Param": p, "Grad": g, "Velocity": v,
+                       "LearningRate": lr}
+        self.attrs = {"mu": 0.9, "use_nesterov": False}
+        self.outputs = {"ParamOut": p - 0.2 * v_out, "VelocityOut": v_out}
+        self.check_output()
+
+    def test_nesterov(self):
+        p, g, v = rngf(4), rngf(4, seed=4), rngf(4, seed=5)
+        lr = np.array([0.2], "float32")
+        v_out = 0.9 * v + g
+        self.inputs = {"Param": p, "Grad": g, "Velocity": v,
+                       "LearningRate": lr}
+        self.attrs = {"mu": 0.9, "use_nesterov": True}
+        self.outputs = {"ParamOut": p - (g + 0.9 * v_out) * 0.2,
+                        "VelocityOut": v_out}
+        self.check_output()
+
+
+class TestAdam(OpTest):
+    op_type = "adam"
+
+    def test(self):
+        p, g = rngf(5), rngf(5, seed=4)
+        m1, m2 = rngf(5, seed=5) * 0.1, np.abs(rngf(5, seed=6)) * 0.1
+        b1p = np.array([0.9], "float32")
+        b2p = np.array([0.999], "float32")
+        lr = np.array([0.01], "float32")
+        b1, b2, eps = 0.9, 0.999, 1e-8
+        m1o = b1 * m1 + (1 - b1) * g
+        m2o = b2 * m2 + (1 - b2) * g * g
+        alpha = 0.01 * np.sqrt(1 - b2p * b2) / (1 - b1p * b1)
+        p_out = p - alpha * m1o / (np.sqrt(m2o) + eps)
+        self.inputs = {"Param": p, "Grad": g, "Moment1": m1, "Moment2": m2,
+                       "Beta1Pow": b1p, "Beta2Pow": b2p,
+                       "LearningRate": lr}
+        self.attrs = {"beta1": b1, "beta2": b2, "epsilon": eps}
+        self.outputs = {"ParamOut": p_out, "Moment1Out": m1o,
+                        "Moment2Out": m2o,
+                        "Beta1PowOut": b1p * b1, "Beta2PowOut": b2p * b2}
+        self.check_output(atol=1e-6)
+
+
+class TestAdagrad(OpTest):
+    op_type = "adagrad"
+
+    def test(self):
+        p, g, m = rngf(4), rngf(4, seed=4), np.abs(rngf(4, seed=5))
+        lr = np.array([0.05], "float32")
+        m_out = m + g * g
+        self.inputs = {"Param": p, "Grad": g, "Moment": m,
+                       "LearningRate": lr}
+        self.attrs = {"epsilon": 1e-6}
+        self.outputs = {"ParamOut": p - 0.05 * g / (np.sqrt(m_out) + 1e-6),
+                        "MomentOut": m_out}
+        self.check_output()
+
+
+class TestRmsprop(OpTest):
+    op_type = "rmsprop"
+
+    def test(self):
+        p, g = rngf(4), rngf(4, seed=4)
+        ms, mom = np.abs(rngf(4, seed=5)), rngf(4, seed=6) * 0.1
+        lr = np.array([0.01], "float32")
+        rho, eps, mu = 0.95, 1e-6, 0.9
+        ms_out = rho * ms + (1 - rho) * g * g
+        mom_out = mu * mom + 0.01 * g / np.sqrt(ms_out + eps)
+        self.inputs = {"Param": p, "Grad": g, "MeanSquare": ms,
+                       "Moment": mom, "LearningRate": lr}
+        self.attrs = {"decay": rho, "epsilon": eps, "momentum": mu,
+                      "centered": False}
+        self.outputs = {"ParamOut": p - mom_out, "MeanSquareOut": ms_out,
+                        "MomentOut": mom_out}
+        self.check_output(atol=1e-6)
+
+
+class TestLamb(OpTest):
+    op_type = "lamb"
+
+    def test(self):
+        p, g = rngf(6) + 1.0, rngf(6, seed=4)
+        m1, m2 = np.zeros(6, "float32"), np.zeros(6, "float32")
+        b1p = np.array([1.0], "float32")
+        b2p = np.array([1.0], "float32")
+        lr = np.array([0.01], "float32")
+        b1, b2, eps, wd = 0.9, 0.999, 1e-6, 0.01
+        m1o = b1 * m1 + (1 - b1) * g
+        m2o = b2 * m2 + (1 - b2) * g * g
+        m1hat = m1o / (1 - 1.0 * b1)
+        m2hat = m2o / (1 - 1.0 * b2)
+        r = m1hat / (np.sqrt(m2hat) + eps) + wd * p
+        trust = np.linalg.norm(p) / np.linalg.norm(r)
+        p_out = p - 0.01 * trust * r
+        self.inputs = {"Param": p, "Grad": g, "Moment1": m1, "Moment2": m2,
+                       "Beta1Pow": b1p, "Beta2Pow": b2p,
+                       "LearningRate": lr}
+        self.attrs = {"beta1": b1, "beta2": b2, "epsilon": eps,
+                      "weight_decay": wd}
+        self.outputs = {"ParamOut": p_out, "Moment1Out": m1o,
+                        "Moment2Out": m2o, "Beta1PowOut": b1p * b1,
+                        "Beta2PowOut": b2p * b2}
+        self.check_output(atol=1e-5)
