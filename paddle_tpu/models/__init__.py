@@ -1,1 +1,11 @@
-"""Model zoo: reference workloads from BASELINE.json configs."""
+"""Model zoo: the reference workloads from BASELINE.json configs.
+
+1. MNIST MLP/conv (mnist.py)        — static graph smoke model
+2. ResNet-{18,34,50,101,152} (resnet.py) — ImageNet classification
+3. BERT-base pretraining (bert.py)  — MLM + NSP
+4. Transformer WMT en-de (transformer.py) — + jittable beam search
+"""
+from . import mnist  # noqa: F401
+from . import resnet  # noqa: F401
+from . import bert  # noqa: F401
+from . import transformer  # noqa: F401

@@ -1,0 +1,213 @@
+"""BERT pretraining model (BASELINE.json config 3) in the fluid static
+graph API — matmul / layer_norm / softmax / dropout stacks; masked-LM +
+next-sentence heads, Adam/LAMB training.
+
+Reference-era counterpart: the ERNIE/BERT models built on fluid layers
+(multi-head attention per `layers/nn.py` primitives). TPU-native: the whole
+encoder lowers to one XLA computation; attention matmuls are MXU-shaped
+[B*H, S, S]; bf16-friendly (use amp.decorate for mixed precision).
+"""
+from __future__ import annotations
+
+import math
+
+from .. import fluid
+from ..fluid import layers
+from ..fluid.param_attr import ParamAttr
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=3072, max_position_embeddings=512,
+                 type_vocab_size=2, hidden_dropout_prob=0.1,
+                 attention_probs_dropout_prob=0.1, initializer_range=0.02):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.initializer_range = initializer_range
+
+    @staticmethod
+    def base():
+        return BertConfig()
+
+    @staticmethod
+    def tiny():
+        return BertConfig(vocab_size=1024, hidden_size=64,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          intermediate_size=128,
+                          max_position_embeddings=64)
+
+
+def _init(cfg):
+    return fluid.initializer.TruncatedNormal(0.0, cfg.initializer_range)
+
+
+def multi_head_attention(x, attn_bias, cfg, name, is_test=False):
+    """x: [B, S, H]; attn_bias: [B, 1, 1, S] additive mask."""
+    h = cfg.hidden_size
+    n_head = cfg.num_attention_heads
+    d_head = h // n_head
+
+    def proj(inp, pname):
+        return layers.fc(input=inp, size=h, num_flatten_dims=2,
+                         param_attr=ParamAttr(name=name + pname + ".w",
+                                              initializer=_init(cfg)),
+                         bias_attr=ParamAttr(name=name + pname + ".b"))
+
+    q, k, v = proj(x, "_q"), proj(x, "_k"), proj(x, "_v")
+
+    def to_heads(t):
+        t = layers.reshape(t, [0, 0, n_head, d_head])
+        return layers.transpose(t, [0, 2, 1, 3])  # [B, nH, S, dH]
+
+    q, k, v = to_heads(q), to_heads(k), to_heads(v)
+    scores = layers.matmul(q, k, transpose_y=True,
+                           alpha=1.0 / math.sqrt(d_head))
+    scores = layers.elementwise_add(scores, attn_bias)
+    probs = layers.softmax(scores)
+    probs = layers.dropout(
+        probs, cfg.attention_probs_dropout_prob, is_test=is_test,
+        dropout_implementation="upscale_in_train")
+    ctx = layers.matmul(probs, v)  # [B, nH, S, dH]
+    ctx = layers.transpose(ctx, [0, 2, 1, 3])
+    ctx = layers.reshape(ctx, [0, 0, h])
+    return proj(ctx, "_out")
+
+
+def encoder_layer(x, attn_bias, cfg, name, is_test=False):
+    attn = multi_head_attention(x, attn_bias, cfg, name + "_attn",
+                                is_test=is_test)
+    attn = layers.dropout(attn, cfg.hidden_dropout_prob, is_test=is_test,
+                          dropout_implementation="upscale_in_train")
+    x = layers.layer_norm(
+        layers.elementwise_add(x, attn), begin_norm_axis=2,
+        param_attr=ParamAttr(name=name + "_post_att_ln.scale"),
+        bias_attr=ParamAttr(name=name + "_post_att_ln.bias"))
+    ffn = layers.fc(input=x, size=cfg.intermediate_size, num_flatten_dims=2,
+                    act="gelu",
+                    param_attr=ParamAttr(name=name + "_ffn0.w",
+                                         initializer=_init(cfg)),
+                    bias_attr=ParamAttr(name=name + "_ffn0.b"))
+    ffn = layers.fc(input=ffn, size=cfg.hidden_size, num_flatten_dims=2,
+                    param_attr=ParamAttr(name=name + "_ffn1.w",
+                                         initializer=_init(cfg)),
+                    bias_attr=ParamAttr(name=name + "_ffn1.b"))
+    ffn = layers.dropout(ffn, cfg.hidden_dropout_prob, is_test=is_test,
+                         dropout_implementation="upscale_in_train")
+    return layers.layer_norm(
+        layers.elementwise_add(x, ffn), begin_norm_axis=2,
+        param_attr=ParamAttr(name=name + "_post_ffn_ln.scale"),
+        bias_attr=ParamAttr(name=name + "_post_ffn_ln.bias"))
+
+
+def bert_encoder(src_ids, pos_ids, sent_ids, input_mask, cfg,
+                 is_test=False):
+    """Returns [B, S, H] sequence output."""
+    emb = layers.embedding(src_ids, size=[cfg.vocab_size, cfg.hidden_size],
+                           param_attr=ParamAttr(name="word_embedding",
+                                                initializer=_init(cfg)))
+    pos = layers.embedding(pos_ids,
+                           size=[cfg.max_position_embeddings,
+                                 cfg.hidden_size],
+                           param_attr=ParamAttr(name="pos_embedding",
+                                                initializer=_init(cfg)))
+    sent = layers.embedding(sent_ids,
+                            size=[cfg.type_vocab_size, cfg.hidden_size],
+                            param_attr=ParamAttr(name="sent_embedding",
+                                                 initializer=_init(cfg)))
+    x = layers.elementwise_add(layers.elementwise_add(emb, pos), sent)
+    x = layers.layer_norm(x, begin_norm_axis=2,
+                          param_attr=ParamAttr(name="pre_encoder_ln.scale"),
+                          bias_attr=ParamAttr(name="pre_encoder_ln.bias"))
+    x = layers.dropout(x, cfg.hidden_dropout_prob, is_test=is_test,
+                       dropout_implementation="upscale_in_train")
+
+    # additive attention bias from [B, S] mask: (1-m) * -1e4 -> [B,1,1,S]
+    neg = layers.scale(input_mask, scale=-10000.0, bias=10000.0)
+    attn_bias = layers.unsqueeze(layers.unsqueeze(neg, [1]), [1])
+
+    for i in range(cfg.num_hidden_layers):
+        x = encoder_layer(x, attn_bias, cfg, "layer_%d" % i,
+                          is_test=is_test)
+    return x
+
+
+def bert_pretrain_loss(cfg, seq_len, is_test=False):
+    """Masked-LM + next-sentence pretraining loss over feed vars.
+
+    Masked positions are a dense [B, max_pred] index tensor with a weight
+    mask (padded, XLA-friendly — SURVEY.md §7 hard part (a))."""
+    src = layers.data(name="src_ids", shape=[seq_len], dtype="int64")
+    pos = layers.data(name="pos_ids", shape=[seq_len], dtype="int64")
+    sent = layers.data(name="sent_ids", shape=[seq_len], dtype="int64")
+    mask = layers.data(name="input_mask", shape=[seq_len], dtype="float32")
+    mask_pos = layers.data(name="mask_pos", shape=[None], dtype="int64",
+                           append_batch_size=False)
+    mask_label = layers.data(name="mask_label", shape=[None],
+                             dtype="int64", append_batch_size=False)
+    nsp_label = layers.data(name="nsp_label", shape=[1], dtype="int64")
+
+    seq_out = bert_encoder(src, pos, sent, mask, cfg, is_test=is_test)
+
+    # -- masked LM head (flattened gather of masked positions) --
+    flat = layers.reshape(seq_out, [-1, cfg.hidden_size])
+    picked = layers.gather(flat, mask_pos)
+    trans = layers.fc(input=picked, size=cfg.hidden_size, act="gelu",
+                      param_attr=ParamAttr(name="mlm_trans.w",
+                                           initializer=_init(cfg)),
+                      bias_attr=ParamAttr(name="mlm_trans.b"))
+    trans = layers.layer_norm(trans, begin_norm_axis=1,
+                              param_attr=ParamAttr(name="mlm_ln.scale"),
+                              bias_attr=ParamAttr(name="mlm_ln.bias"))
+    mlm_logits = layers.fc(input=trans, size=cfg.vocab_size,
+                           param_attr=ParamAttr(name="mlm_out.w",
+                                                initializer=_init(cfg)),
+                           bias_attr=ParamAttr(name="mlm_out.b"))
+    mlm_label2d = layers.reshape(mask_label, [-1, 1])
+    mlm_loss = layers.mean(
+        layers.softmax_with_cross_entropy(mlm_logits, mlm_label2d))
+
+    # -- next sentence head over [CLS] --
+    cls = layers.slice(seq_out, axes=[1], starts=[0], ends=[1])
+    cls = layers.reshape(cls, [-1, cfg.hidden_size])
+    pooled = layers.fc(input=cls, size=cfg.hidden_size, act="tanh",
+                       param_attr=ParamAttr(name="pooler.w",
+                                            initializer=_init(cfg)),
+                       bias_attr=ParamAttr(name="pooler.b"))
+    nsp_logits = layers.fc(input=pooled, size=2,
+                           param_attr=ParamAttr(name="nsp.w",
+                                                initializer=_init(cfg)),
+                           bias_attr=ParamAttr(name="nsp.b"))
+    nsp_loss = layers.mean(
+        layers.softmax_with_cross_entropy(nsp_logits, nsp_label))
+
+    total = layers.elementwise_add(mlm_loss, nsp_loss)
+    feeds = ["src_ids", "pos_ids", "sent_ids", "input_mask", "mask_pos",
+             "mask_label", "nsp_label"]
+    return total, mlm_loss, nsp_loss, feeds
+
+
+def build_bert_pretrain(cfg=None, seq_len=128, lr=1e-4, use_lamb=False,
+                        weight_decay=0.01, is_test=False):
+    cfg = cfg or BertConfig.base()
+    total, mlm_loss, nsp_loss, feeds = bert_pretrain_loss(
+        cfg, seq_len, is_test=is_test)
+    if not is_test:
+        def exclude(p):
+            return "ln" in p.name or ".b" in p.name
+
+        if use_lamb:
+            opt = fluid.optimizer.LambOptimizer(
+                learning_rate=lr, lamb_weight_decay=weight_decay,
+                exclude_from_weight_decay_fn=exclude)
+        else:
+            opt = fluid.optimizer.AdamOptimizer(learning_rate=lr)
+        opt.minimize(total)
+    return total, mlm_loss, nsp_loss, feeds

@@ -1,0 +1,39 @@
+"""MNIST models (BASELINE.json config 1; reference:
+`python/paddle/fluid/tests/book/test_recognize_digits.py`)."""
+from __future__ import annotations
+
+from .. import fluid
+from ..fluid import layers
+
+
+def mlp(img, hidden_sizes=(200, 200), class_dim=10):
+    h = img
+    for size in hidden_sizes:
+        h = layers.fc(input=h, size=size, act="relu")
+    return layers.fc(input=h, size=class_dim)
+
+
+def conv_net(img, class_dim=10):
+    """LeNet-ish conv net (reference: test_recognize_digits.py:65)."""
+    conv1 = layers.conv2d(input=img, num_filters=20, filter_size=5,
+                          act="relu")
+    pool1 = layers.pool2d(conv1, pool_size=2, pool_stride=2)
+    conv2 = layers.conv2d(input=pool1, num_filters=50, filter_size=5,
+                          act="relu")
+    pool2 = layers.pool2d(conv2, pool_size=2, pool_stride=2)
+    return layers.fc(input=pool2, size=class_dim)
+
+
+def build_mnist_train(arch="mlp", lr=0.01):
+    if arch == "conv":
+        img = layers.data(name="img", shape=[1, 28, 28], dtype="float32")
+        logits = conv_net(img)
+    else:
+        img = layers.data(name="img", shape=[784], dtype="float32")
+        logits = mlp(img)
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    loss = layers.mean(
+        layers.softmax_with_cross_entropy(logits, label))
+    acc = layers.accuracy(input=layers.softmax(logits), label=label)
+    fluid.optimizer.AdamOptimizer(learning_rate=lr).minimize(loss)
+    return loss, acc, ["img", "label"]

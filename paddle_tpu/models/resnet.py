@@ -1,0 +1,113 @@
+"""ResNet for ImageNet classification (BASELINE.json config 2).
+
+Built with the fluid static-graph layers API the way reference users do
+(cf. the model zoo style used by `tests/book` and `dist_se_resnext.py`
+in `python/paddle/fluid/tests/unittests/`): conv2d + batch_norm + pool2d
+bottleneck stacks. On TPU the whole train step lowers to one XLA
+computation; convs hit the MXU via lax.conv_general_dilated.
+"""
+from __future__ import annotations
+
+from .. import fluid
+from ..fluid import layers
+from ..fluid.param_attr import ParamAttr
+
+DEPTH_CFG = {
+    18: ("basic", [2, 2, 2, 2]),
+    34: ("basic", [3, 4, 6, 3]),
+    50: ("bottleneck", [3, 4, 6, 3]),
+    101: ("bottleneck", [3, 4, 23, 3]),
+    152: ("bottleneck", [3, 8, 36, 3]),
+}
+
+
+def conv_bn_layer(input, num_filters, filter_size, stride=1, groups=1,
+                  act=None, name=None, is_test=False):
+    conv = layers.conv2d(
+        input=input, num_filters=num_filters, filter_size=filter_size,
+        stride=stride, padding=(filter_size - 1) // 2, groups=groups,
+        act=None, bias_attr=False,
+        param_attr=ParamAttr(name=name + "_weights" if name else None))
+    return layers.batch_norm(
+        input=conv, act=act, is_test=is_test,
+        param_attr=ParamAttr(name=name + "_bn_scale" if name else None),
+        bias_attr=ParamAttr(name=name + "_bn_offset" if name else None),
+        moving_mean_name=name + "_bn_mean" if name else None,
+        moving_variance_name=name + "_bn_var" if name else None)
+
+
+def shortcut(input, ch_out, stride, name, is_test=False):
+    ch_in = input.shape[1]
+    if ch_in != ch_out or stride != 1:
+        return conv_bn_layer(input, ch_out, 1, stride, name=name,
+                             is_test=is_test)
+    return input
+
+
+def bottleneck_block(input, num_filters, stride, name, is_test=False):
+    conv0 = conv_bn_layer(input, num_filters, 1, act="relu",
+                          name=name + "_branch2a", is_test=is_test)
+    conv1 = conv_bn_layer(conv0, num_filters, 3, stride=stride, act="relu",
+                          name=name + "_branch2b", is_test=is_test)
+    conv2 = conv_bn_layer(conv1, num_filters * 4, 1, act=None,
+                          name=name + "_branch2c", is_test=is_test)
+    short = shortcut(input, num_filters * 4, stride,
+                     name=name + "_branch1", is_test=is_test)
+    return layers.relu(layers.elementwise_add(short, conv2))
+
+
+def basic_block(input, num_filters, stride, name, is_test=False):
+    conv0 = conv_bn_layer(input, num_filters, 3, stride=stride, act="relu",
+                          name=name + "_branch2a", is_test=is_test)
+    conv1 = conv_bn_layer(conv0, num_filters, 3, act=None,
+                          name=name + "_branch2b", is_test=is_test)
+    short = shortcut(input, num_filters, stride, name=name + "_branch1",
+                     is_test=is_test)
+    return layers.relu(layers.elementwise_add(short, conv1))
+
+
+def resnet(input, class_dim=1000, depth=50, is_test=False):
+    """Build the logits head over `input` (NCHW float)."""
+    block_type, counts = DEPTH_CFG[depth]
+    block_fn = bottleneck_block if block_type == "bottleneck" \
+        else basic_block
+    conv = conv_bn_layer(input, 64, 7, stride=2, act="relu", name="conv1",
+                         is_test=is_test)
+    conv = layers.pool2d(conv, pool_size=3, pool_stride=2, pool_padding=1,
+                         pool_type="max")
+    num_filters = [64, 128, 256, 512]
+    for stage, count in enumerate(counts):
+        for blk in range(count):
+            stride = 2 if blk == 0 and stage != 0 else 1
+            conv = block_fn(conv, num_filters[stage], stride,
+                            name="res%d_%d" % (stage + 2, blk),
+                            is_test=is_test)
+    pool = layers.pool2d(conv, pool_type="avg", global_pooling=True)
+    import math
+
+    stdv = 1.0 / math.sqrt(pool.shape[1] * 1.0)
+    return layers.fc(
+        input=pool, size=class_dim,
+        param_attr=ParamAttr(
+            name="fc_weights",
+            initializer=fluid.initializer.Uniform(-stdv, stdv)),
+        bias_attr=ParamAttr(name="fc_offset"))
+
+
+def build_resnet_train(image_shape=(3, 224, 224), class_dim=1000, depth=50,
+                       lr=0.1, momentum=0.9, weight_decay=1e-4,
+                       is_test=False):
+    """Full training program: returns (loss, acc, feeds)."""
+    img = layers.data(name="image", shape=list(image_shape),
+                      dtype="float32")
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    logits = resnet(img, class_dim=class_dim, depth=depth, is_test=is_test)
+    loss = layers.softmax_with_cross_entropy(logits, label)
+    avg_loss = layers.mean(loss)
+    acc = layers.accuracy(input=layers.softmax(logits), label=label)
+    if not is_test:
+        opt = fluid.optimizer.MomentumOptimizer(
+            learning_rate=lr, momentum=momentum,
+            regularization=fluid.regularizer.L2Decay(weight_decay))
+        opt.minimize(avg_loss)
+    return avg_loss, acc, ["image", "label"]

@@ -1,0 +1,370 @@
+"""Transformer seq2seq for WMT en-de (BASELINE.json config 4): fluid
+static-graph training + jittable beam-search inference.
+
+Reference counterparts: the fluid Transformer model
+(`dist_transformer.py` test model, `layers/nn.py` primitives) and the
+beam-search ops (`operators/beam_search_op.cc`,
+`layers/rnn.py` dynamic_decode). TPU-native inference: beam search is a
+`lax.while_loop` with a static-shape KV cache (SURVEY.md §3F TPU mapping —
+"beam-search decode needs a jit-able while-loop implementation"), reading
+trained parameters straight from the Scope (device-resident arrays), so
+train->decode needs no format conversion.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .. import fluid
+from ..fluid import layers
+from ..fluid.param_attr import ParamAttr
+
+
+class TransformerConfig:
+    def __init__(self, src_vocab=32000, tgt_vocab=32000, max_len=256,
+                 d_model=512, n_head=8, d_ff=2048, n_layer=6, dropout=0.1):
+        self.src_vocab = src_vocab
+        self.tgt_vocab = tgt_vocab
+        self.max_len = max_len
+        self.d_model = d_model
+        self.n_head = n_head
+        self.d_ff = d_ff
+        self.n_layer = n_layer
+        self.dropout = dropout
+
+    @staticmethod
+    def big():
+        return TransformerConfig(d_model=1024, n_head=16, d_ff=4096)
+
+    @staticmethod
+    def tiny():
+        return TransformerConfig(src_vocab=128, tgt_vocab=128, max_len=16,
+                                 d_model=32, n_head=4, d_ff=64, n_layer=2,
+                                 dropout=0.0)
+
+
+def _init():
+    return fluid.initializer.Xavier(uniform=True)
+
+
+def _proj(x, size, name, act=None):
+    return layers.fc(input=x, size=size, num_flatten_dims=2, act=act,
+                     param_attr=ParamAttr(name=name + ".w",
+                                          initializer=_init()),
+                     bias_attr=ParamAttr(name=name + ".b"))
+
+
+def _attention(q_in, kv_in, bias, cfg, name, is_test):
+    d_head = cfg.d_model // cfg.n_head
+    q = _proj(q_in, cfg.d_model, name + "_q")
+    k = _proj(kv_in, cfg.d_model, name + "_k")
+    v = _proj(kv_in, cfg.d_model, name + "_v")
+
+    def heads(t):
+        t = layers.reshape(t, [0, 0, cfg.n_head, d_head])
+        return layers.transpose(t, [0, 2, 1, 3])
+
+    q, k, v = heads(q), heads(k), heads(v)
+    scores = layers.matmul(q, k, transpose_y=True,
+                           alpha=1.0 / math.sqrt(d_head))
+    if bias is not None:
+        scores = layers.elementwise_add(scores, bias)
+    probs = layers.softmax(scores)
+    if cfg.dropout and not is_test:
+        probs = layers.dropout(probs, cfg.dropout, is_test=is_test,
+                               dropout_implementation="upscale_in_train")
+    ctx = layers.transpose(layers.matmul(probs, v), [0, 2, 1, 3])
+    ctx = layers.reshape(ctx, [0, 0, cfg.d_model])
+    return _proj(ctx, cfg.d_model, name + "_o")
+
+
+def _ln(x, name):
+    return layers.layer_norm(
+        x, begin_norm_axis=2,
+        param_attr=ParamAttr(name=name + ".scale"),
+        bias_attr=ParamAttr(name=name + ".bias"))
+
+
+def _ffn(x, cfg, name):
+    h = _proj(x, cfg.d_ff, name + "_fc0", act="relu")
+    return _proj(h, cfg.d_model, name + "_fc1")
+
+
+def _embed(ids, vocab, cfg, name, pos_name="pos_enc"):
+    emb = layers.embedding(ids, size=[vocab, cfg.d_model],
+                           param_attr=ParamAttr(name=name,
+                                                initializer=_init()))
+    emb = layers.scale(emb, scale=math.sqrt(cfg.d_model))
+    seq_len = emb.shape[1]
+    pe = _positional_encoding(cfg.max_len, cfg.d_model)
+    pe_var = layers.assign(pe[:seq_len])
+    return layers.elementwise_add(emb, layers.unsqueeze(pe_var, [0]))
+
+
+def _positional_encoding(max_len, d_model):
+    pos = np.arange(max_len)[:, None].astype("float32")
+    i = np.arange(d_model // 2)[None, :].astype("float32")
+    angle = pos / np.power(10000.0, 2 * i / d_model)
+    pe = np.zeros((max_len, d_model), "float32")
+    pe[:, 0::2] = np.sin(angle)
+    pe[:, 1::2] = np.cos(angle)
+    return pe
+
+
+def encoder(src_ids, src_bias, cfg, is_test=False):
+    x = _embed(src_ids, cfg.src_vocab, cfg, "src_word_emb")
+    for i in range(cfg.n_layer):
+        nm = "enc_%d" % i
+        attn = _attention(x, x, src_bias, cfg, nm + "_selfattn", is_test)
+        x = _ln(layers.elementwise_add(x, attn), nm + "_ln0")
+        ffn = _ffn(x, cfg, nm + "_ffn")
+        x = _ln(layers.elementwise_add(x, ffn), nm + "_ln1")
+    return x
+
+
+def decoder(tgt_ids, enc_out, self_bias, cross_bias, cfg, is_test=False):
+    x = _embed(tgt_ids, cfg.tgt_vocab, cfg, "tgt_word_emb")
+    for i in range(cfg.n_layer):
+        nm = "dec_%d" % i
+        attn = _attention(x, x, self_bias, cfg, nm + "_selfattn", is_test)
+        x = _ln(layers.elementwise_add(x, attn), nm + "_ln0")
+        cross = _attention(x, enc_out, cross_bias, cfg, nm + "_crossattn",
+                           is_test)
+        x = _ln(layers.elementwise_add(x, cross), nm + "_ln1")
+        ffn = _ffn(x, cfg, nm + "_ffn")
+        x = _ln(layers.elementwise_add(x, ffn), nm + "_ln2")
+    return _proj(x, cfg.tgt_vocab, "dec_out_proj")
+
+
+def build_transformer_train(cfg=None, src_len=32, tgt_len=32, lr=1e-3,
+                            warmup=4000, label_smooth_eps=0.1,
+                            is_test=False):
+    """Teacher-forced training graph. Returns (avg_loss, feeds)."""
+    cfg = cfg or TransformerConfig()
+    src = layers.data(name="src_ids", shape=[src_len], dtype="int64")
+    tgt = layers.data(name="tgt_ids", shape=[tgt_len], dtype="int64")
+    lbl = layers.data(name="lbl_ids", shape=[tgt_len], dtype="int64")
+    src_mask = layers.data(name="src_mask", shape=[src_len],
+                           dtype="float32")
+    tgt_mask = layers.data(name="tgt_mask", shape=[tgt_len],
+                           dtype="float32")
+
+    src_bias = layers.unsqueeze(layers.unsqueeze(
+        layers.scale(src_mask, scale=-1e4, bias=1e4), [1]), [1])
+    # causal + padding bias for decoder self-attention
+    causal = np.triu(np.full((tgt_len, tgt_len), -1e4, "float32"), k=1)
+    causal_var = layers.assign(causal)
+    pad_bias = layers.unsqueeze(layers.unsqueeze(
+        layers.scale(tgt_mask, scale=-1e4, bias=1e4), [1]), [1])
+    self_bias = layers.elementwise_add(pad_bias, causal_var)
+    cross_bias = src_bias
+
+    enc_out = encoder(src, src_bias, cfg, is_test)
+    logits = decoder(tgt, enc_out, self_bias, cross_bias, cfg, is_test)
+
+    if label_smooth_eps:
+        oh = layers.one_hot(layers.unsqueeze(lbl, [2]), cfg.tgt_vocab)
+        smoothed = layers.label_smooth(oh, epsilon=label_smooth_eps)
+        loss = layers.softmax_with_cross_entropy(logits, smoothed,
+                                                 soft_label=True)
+    else:
+        loss = layers.softmax_with_cross_entropy(
+            logits, layers.unsqueeze(lbl, [2]))
+    w = layers.unsqueeze(tgt_mask, [2])
+    loss = layers.elementwise_mul(loss, w)
+    avg_loss = layers.elementwise_div(
+        layers.reduce_sum(loss), layers.reduce_sum(w) + 1e-9)
+    if not is_test:
+        lr_var = layers.noam_decay(cfg.d_model, warmup, lr)
+        opt = fluid.optimizer.AdamOptimizer(
+            learning_rate=lr_var, beta1=0.9, beta2=0.997, epsilon=1e-9)
+        opt.minimize(avg_loss)
+    return avg_loss, ["src_ids", "tgt_ids", "lbl_ids", "src_mask",
+                      "tgt_mask"]
+
+
+# ---------------------------------------------------------------------------
+# jittable beam-search inference (lax.while_loop, static shapes)
+# ---------------------------------------------------------------------------
+
+def _np_params(scope, names):
+    out = {}
+    for n in names:
+        v = scope.find_var(n)
+        if v is None:
+            raise RuntimeError("param %r missing from scope" % n)
+        out[n] = v
+    return out
+
+
+def _collect_param_names(cfg):
+    names = ["src_word_emb", "tgt_word_emb"]
+    for pre, n in (("enc", cfg.n_layer), ("dec", cfg.n_layer)):
+        for i in range(n):
+            nm = "%s_%d" % (pre, i)
+            kinds = ["_selfattn"] + (["_crossattn"] if pre == "dec" else [])
+            for a in kinds:
+                for p in ("_q", "_k", "_v", "_o"):
+                    names += [nm + a + p + ".w", nm + a + p + ".b"]
+            for f in ("_ffn_fc0", "_ffn_fc1"):
+                names += [nm + f + ".w", nm + f + ".b"]
+            lns = ("_ln0", "_ln1") if pre == "enc" else ("_ln0", "_ln1",
+                                                         "_ln2")
+            for l in lns:
+                names += [nm + l + ".scale", nm + l + ".bias"]
+    names += ["dec_out_proj.w", "dec_out_proj.b"]
+    return names
+
+
+def beam_search_decode(scope, src_ids, src_mask, cfg, beam_size=4,
+                       max_out_len=32, bos_id=0, eos_id=1, alpha=0.6):
+    """Jittable beam search over the trained scope params.
+
+    src_ids: [B, S] int; src_mask: [B, S] float. Returns
+    (seqs [B, beam, T], scores [B, beam]).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    p = _np_params(scope, _collect_param_names(cfg))
+    d_head = cfg.d_model // cfg.n_head
+
+    def ln(x, nm):
+        mu = jnp.mean(x, -1, keepdims=True)
+        var = jnp.mean(jnp.square(x - mu), -1, keepdims=True)
+        return (x - mu) / jnp.sqrt(var + 1e-5) * p[nm + ".scale"] \
+            + p[nm + ".bias"]
+
+    def proj(x, nm):
+        return x @ p[nm + ".w"] + p[nm + ".b"]
+
+    def heads(t):
+        return t.reshape(t.shape[:-1] + (cfg.n_head, d_head)) \
+            .swapaxes(-3, -2)
+
+    def attn(q_in, k, v, bias, nm):
+        q = heads(proj(q_in, nm + "_q"))
+        s = q @ k.swapaxes(-1, -2) / math.sqrt(d_head)
+        if bias is not None:
+            s = s + bias
+        probs = jax.nn.softmax(s, -1)
+        ctx = (probs @ v).swapaxes(-3, -2)
+        ctx = ctx.reshape(ctx.shape[:-2] + (cfg.d_model,))
+        return proj(ctx, nm + "_o")
+
+    pe = jnp.asarray(_positional_encoding(cfg.max_len, cfg.d_model))
+
+    def embed(ids, table, offset):
+        e = jnp.take(p[table], ids, axis=0) * math.sqrt(cfg.d_model)
+        return e + jax.lax.dynamic_slice_in_dim(pe, offset,
+                                                ids.shape[-1], 0)
+
+    def run_encoder(src, src_bias):
+        x = embed(src, "src_word_emb", 0)
+        for i in range(cfg.n_layer):
+            nm = "enc_%d" % i
+            k = heads(proj(x, nm + "_selfattn_k"))
+            v = heads(proj(x, nm + "_selfattn_v"))
+            x = ln(x + attn(x, k, v, src_bias, nm + "_selfattn"),
+                   nm + "_ln0")
+            h = jax.nn.relu(proj(x, nm + "_ffn_fc0"))
+            x = ln(x + proj(h, nm + "_ffn_fc1"), nm + "_ln1")
+        return x
+
+    @jax.jit
+    def decode(src, mask):
+        B, S = src.shape
+        K = beam_size
+        T = max_out_len
+        src_bias = ((1.0 - mask) * -1e4)[:, None, None, :]
+        enc = run_encoder(src, src_bias)
+
+        # expand to beams: [B*K, ...]
+        enc_b = jnp.repeat(enc, K, axis=0)
+        bias_b = jnp.repeat(src_bias, K, axis=0)
+        # precompute cross K/V per layer
+        cross_kv = []
+        for i in range(cfg.n_layer):
+            nm = "dec_%d_crossattn" % i
+            cross_kv.append((heads(proj(enc_b, nm + "_k")),
+                             heads(proj(enc_b, nm + "_v"))))
+
+        seqs = jnp.full((B * K, T + 1), eos_id, jnp.int32)
+        seqs = seqs.at[:, 0].set(bos_id)
+        # beam scores: first beam 0, rest -inf so step 1 picks distinct
+        scores = jnp.tile(jnp.asarray([0.0] + [-1e9] * (K - 1),
+                                      jnp.float32), (B,))
+        finished = jnp.zeros((B * K,), bool)
+        # static KV cache [B*K, nH, T, dH] per layer
+        cache = [(jnp.zeros((B * K, cfg.n_head, T, d_head)),
+                  jnp.zeros((B * K, cfg.n_head, T, d_head)))
+                 for _ in range(cfg.n_layer)]
+
+        def step(t, carry):
+            seqs, scores, finished, cache = carry
+            tok = jax.lax.dynamic_slice_in_dim(seqs, t, 1, 1)  # [B*K,1]
+            x = embed(tok, "tgt_word_emb", t)
+            new_cache = []
+            for i in range(cfg.n_layer):
+                nm = "dec_%d" % i
+                k_new = heads(proj(x, nm + "_selfattn_k"))  # [B*K,nH,1,dH]
+                v_new = heads(proj(x, nm + "_selfattn_v"))
+                ck, cv = cache[i]
+                ck = jax.lax.dynamic_update_slice_in_dim(ck, k_new, t, 2)
+                cv = jax.lax.dynamic_update_slice_in_dim(cv, v_new, t, 2)
+                new_cache.append((ck, cv))
+                # causal: positions > t are masked
+                tmask = (jnp.arange(T) > t) * -1e9
+                a = attn(x, ck, cv, tmask[None, None, None, :],
+                         nm + "_selfattn")
+                x = ln(x + a, nm + "_ln0")
+                ki, vi = cross_kv[i]
+                x = ln(x + attn(x, ki, vi, bias_b, nm + "_crossattn"),
+                       nm + "_ln1")
+                h = jax.nn.relu(proj(x, nm + "_ffn_fc0"))
+                x = ln(x + proj(h, nm + "_ffn_fc1"), nm + "_ln2")
+            logits = proj(x[:, 0], "dec_out_proj")  # [B*K, V]
+            logp = jax.nn.log_softmax(logits, -1)
+            # finished beams only extend with eos at zero cost
+            V = cfg.tgt_vocab
+            eos_only = jnp.full((V,), -1e9).at[eos_id].set(0.0)
+            logp = jnp.where(finished[:, None], eos_only[None, :], logp)
+
+            cand = scores[:, None] + logp  # [B*K, V]
+            cand = cand.reshape(B, K * V)
+            top_scores, top_idx = jax.lax.top_k(cand, K)  # [B, K]
+            beam_idx = top_idx // V + jnp.arange(B)[:, None] * K
+            tok_idx = (top_idx % V).astype(jnp.int32)
+            flat_beam = beam_idx.reshape(-1)
+            seqs = seqs[flat_beam]
+            seqs = jax.lax.dynamic_update_slice_in_dim(
+                seqs, tok_idx.reshape(-1, 1), t + 1, 1)
+            scores = top_scores.reshape(-1)
+            finished = finished[flat_beam] | (tok_idx.reshape(-1) == eos_id)
+            cache = [(ck[flat_beam], cv[flat_beam])
+                     for ck, cv in new_cache]
+            return seqs, scores, finished, cache
+
+        def cond(state):
+            t, carry = state
+            return (t < T) & ~jnp.all(carry[2])
+
+        def body(state):
+            t, carry = state
+            return t + 1, step(t, carry)
+
+        _, (seqs, scores, finished, _) = jax.lax.while_loop(
+            cond, body, (0, (seqs, scores, finished, cache)))
+        # length penalty (GNMT alpha)
+        lengths = jnp.sum((seqs[:, 1:] != eos_id).astype(jnp.float32), -1) \
+            + 1.0
+        lp = jnp.power((5.0 + lengths) / 6.0, alpha)
+        final = (seqs.reshape(B, K, T + 1),
+                 (scores / lp).reshape(B, K))
+        return final
+
+    import jax.numpy as jnp2
+
+    return decode(jnp2.asarray(np.asarray(src_ids, "int32")),
+                  jnp2.asarray(np.asarray(src_mask, "float32")))

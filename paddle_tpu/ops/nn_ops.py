@@ -436,8 +436,9 @@ def _lookup(w, ids, padding_idx):
 @register_op("lookup_table")
 def _lookup_table(ins, attrs):
     w, ids = ins["W"][0], ins["Ids"][0]
-    # v1 requires ids shape [..., 1]
-    ids = ids.reshape(ids.shape[:-1])
+    # v1 ids carry a trailing [..., 1] dim (LoD heritage); squeeze it.
+    if ids.ndim > 1 and ids.shape[-1] == 1:
+        ids = ids.reshape(ids.shape[:-1])
     return {"Out": _lookup(w, ids, attrs.get("padding_idx", -1))}
 
 

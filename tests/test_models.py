@@ -1,0 +1,98 @@
+"""End-to-end model tests on tiny shapes (reference strategy: SURVEY.md
+§4.2 program-level integration tests asserting loss decrease)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.models import resnet, bert, transformer, mnist
+
+
+def _train(feeds_fn, loss_var, feeds, steps=8):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    losses = []
+    for s in range(steps):
+        out = exe.run(feed=feeds_fn(s), fetch_list=[loss_var])
+        losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+    return losses
+
+
+def test_mnist_conv_trains(rng):
+    loss, acc, _ = mnist.build_mnist_train(arch="conv", lr=0.01)
+    x = rng.rand(16, 1, 28, 28).astype("float32")
+    y = rng.randint(0, 10, (16, 1)).astype("int64")
+    losses = _train(lambda s: {"img": x, "label": y}, loss, None, steps=10)
+    assert losses[-1] < losses[0]
+
+
+def test_resnet18_trains(rng):
+    loss, acc, _ = resnet.build_resnet_train(
+        image_shape=(3, 32, 32), class_dim=10, depth=18, lr=0.05)
+    x = rng.rand(8, 3, 32, 32).astype("float32")
+    y = rng.randint(0, 10, (8, 1)).astype("int64")
+    losses = _train(lambda s: {"image": x, "label": y}, loss, None,
+                    steps=8)
+    assert losses[-1] < losses[0], losses
+
+
+def test_resnet50_builds_and_steps(rng):
+    loss, acc, _ = resnet.build_resnet_train(
+        image_shape=(3, 64, 64), class_dim=10, depth=50, lr=0.01)
+    x = rng.rand(2, 3, 64, 64).astype("float32")
+    y = rng.randint(0, 10, (2, 1)).astype("int64")
+    losses = _train(lambda s: {"image": x, "label": y}, loss, None,
+                    steps=2)
+    assert np.isfinite(losses).all()
+
+
+def _bert_batch(rng, cfg, bsz, seq, n_mask):
+    src = rng.randint(0, cfg.vocab_size, (bsz, seq)).astype("int64")
+    pos = np.tile(np.arange(seq), (bsz, 1)).astype("int64")
+    sent = np.zeros((bsz, seq), "int64")
+    mask = np.ones((bsz, seq), "float32")
+    mask_pos = rng.choice(bsz * seq, n_mask, replace=False).astype("int64")
+    mask_label = rng.randint(0, cfg.vocab_size, (n_mask,)).astype("int64")
+    nsp = rng.randint(0, 2, (bsz, 1)).astype("int64")
+    return {"src_ids": src, "pos_ids": pos, "sent_ids": sent,
+            "input_mask": mask, "mask_pos": mask_pos,
+            "mask_label": mask_label, "nsp_label": nsp}
+
+
+def test_bert_tiny_trains(rng):
+    cfg = bert.BertConfig.tiny()
+    total, mlm, nsp, feeds = bert.build_bert_pretrain(
+        cfg, seq_len=16, lr=1e-3)
+    batch = _bert_batch(rng, cfg, 4, 16, 8)
+    losses = _train(lambda s: batch, total, None, steps=10)
+    assert losses[-1] < losses[0], losses
+
+
+def test_transformer_tiny_trains_and_decodes(rng):
+    cfg = transformer.TransformerConfig.tiny()
+    loss, feeds = transformer.build_transformer_train(
+        cfg, src_len=8, tgt_len=8, lr=1e-2, warmup=10,
+        label_smooth_eps=0.0)
+    bsz = 4
+    batch = {
+        "src_ids": rng.randint(2, cfg.src_vocab, (bsz, 8)).astype("int64"),
+        "tgt_ids": rng.randint(2, cfg.tgt_vocab, (bsz, 8)).astype("int64"),
+        "lbl_ids": rng.randint(2, cfg.tgt_vocab, (bsz, 8)).astype("int64"),
+        "src_mask": np.ones((bsz, 8), "float32"),
+        "tgt_mask": np.ones((bsz, 8), "float32"),
+    }
+    losses = _train(lambda s: batch, loss, None, steps=10)
+    assert losses[-1] < losses[0], losses
+
+    # beam-search decode (jittable while_loop) off the trained scope
+    from paddle_tpu.core.scope import global_scope
+
+    seqs, scores = transformer.beam_search_decode(
+        global_scope(), batch["src_ids"][:2], batch["src_mask"][:2], cfg,
+        beam_size=3, max_out_len=6, bos_id=0, eos_id=1)
+    seqs = np.asarray(seqs)
+    scores = np.asarray(scores)
+    assert seqs.shape == (2, 3, 7)
+    assert scores.shape == (2, 3)
+    # beams sorted by score
+    assert (np.diff(scores, axis=1) <= 1e-5).all()
+    assert (seqs[:, :, 0] == 0).all()
