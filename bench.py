@@ -1,0 +1,86 @@
+"""Benchmark: BERT-base pretraining throughput (tokens/sec/chip) on the
+real TPU chip, through the full framework path (fluid static graph ->
+single jitted XLA computation, bf16 AMP, donated buffers).
+
+Baseline: BASELINE.md target is >=0.8x per-chip V100. In-repo reference
+publishes no numbers (BASELINE.json "published": {}); we use the widely
+reported V100 FP16 BERT-base phase-1 (seq128) pretraining throughput of
+~25k tokens/sec/GPU as the baseline denominator, so vs_baseline >= 0.8
+meets the north star.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+V100_BASELINE_TOKENS_PER_SEC = 25000.0
+
+BATCH = 128
+SEQ_LEN = 128
+WARMUP = 3
+STEPS = 10
+
+
+def main():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework
+    from paddle_tpu.fluid.contrib import mixed_precision
+    from paddle_tpu.models import bert
+
+    cfg = bert.BertConfig.base()
+    main_p, startup_p = framework.Program(), framework.Program()
+    with framework.program_guard(main_p, startup_p):
+        with framework.unique_name_guard():
+            total, mlm, nsp, feeds = bert.bert_pretrain_loss(
+                cfg, SEQ_LEN, is_test=False)
+            opt = mixed_precision.decorate(
+                fluid.optimizer.AdamOptimizer(learning_rate=1e-4),
+                use_dynamic_loss_scaling=False)
+            opt.minimize(total)
+
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(startup_p)
+
+            r = np.random.RandomState(0)
+            n_mask = BATCH * SEQ_LEN * 15 // 100
+            feed = {
+                "src_ids": r.randint(0, cfg.vocab_size,
+                                     (BATCH, SEQ_LEN)).astype("int64"),
+                "pos_ids": np.tile(np.arange(SEQ_LEN),
+                                   (BATCH, 1)).astype("int64"),
+                "sent_ids": np.zeros((BATCH, SEQ_LEN), "int64"),
+                "input_mask": np.ones((BATCH, SEQ_LEN), "float32"),
+                "mask_pos": r.choice(BATCH * SEQ_LEN, n_mask,
+                                     replace=False).astype("int64"),
+                "mask_label": r.randint(0, cfg.vocab_size,
+                                        (n_mask,)).astype("int64"),
+                "nsp_label": r.randint(0, 2, (BATCH, 1)).astype("int64"),
+            }
+
+            for _ in range(WARMUP):
+                out = exe.run(main_p, feed=feed, fetch_list=[total])
+            np.asarray(out[0])
+
+            t0 = time.perf_counter()
+            for _ in range(STEPS):
+                out = exe.run(main_p, feed=feed, fetch_list=[total])
+            np.asarray(out[0])  # block on the final step
+            dt = time.perf_counter() - t0
+
+    tokens_per_sec = BATCH * SEQ_LEN * STEPS / dt
+    print(json.dumps({
+        "metric": "bert_base_pretrain_throughput",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(tokens_per_sec
+                             / V100_BASELINE_TOKENS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,244 @@
+"""Fleet — unified distributed training API.
+
+Reference parity: `python/paddle/fleet/base/fleet_base.py:25-233` (2.0 API)
+and `python/paddle/fluid/incubate/fleet/collective/__init__.py:64-468`
+(CollectiveOptimizer + transpiler flow, SURVEY.md §3C):
+
+  fleet.init(role_maker) ; opt = fleet.distributed_optimizer(opt, strategy)
+  opt.minimize(loss) ; exe.run(...)
+
+TPU-native: `minimize` runs the normal backward+optimizer build, then the
+collective transpiler marks the program data-parallel over the device mesh,
+scales the loss cotangent by 1/nranks (reference: transpiler/collective.py
+:190 scale op) and inserts `c_allreduce_sum` on every gradient (reference:
+:209-260); lowering executes them as `lax.psum` over ICI inside one
+shard_map'd XLA program. `c_gen_nccl_id`/`c_comm_init` collapse into mesh
+construction (ring 0 -> axis "dp").
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..fluid import framework
+from ..fluid.framework import Operator
+from ..parallel import env as penv
+from .role_maker import (  # noqa: F401
+    RoleMakerBase, PaddleCloudRoleMaker, UserDefinedRoleMaker, Role,
+)
+
+
+class DistributedStrategy:
+    """Strategy knobs (reference: `framework/distributed_strategy.proto:25`
+    backing `fleet/base/distributed_strategy.py:57`). Knobs that exist to
+    work around GPU limits (fuse_all_reduce, nccl_comm_num, hierarchical
+    allreduce) are accepted but XLA's collective scheduler owns them."""
+
+    def __init__(self):
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": []}
+        self.pipeline = False
+        self.pipeline_configs = {"micro_batch": 1}
+        self.localsgd = False
+        self.localsgd_configs = {"k_steps": 1}
+        self.dgc = False
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.lars = False
+        self.lamb = False
+        self.sync_nccl_allreduce = True
+        self.nccl_comm_num = 1
+        self.use_hierarchical_allreduce = False
+        self.hierarchical_allreduce_inter_nranks = 1
+        self.sync_batch_norm = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.elastic = False
+        self.auto = False
+        self.a_sync = False
+        self.a_sync_configs = {}
+
+    # fluid-era aliases (incubate DistributedStrategy fields)
+    @property
+    def forward_recompute(self):
+        return self.recompute
+
+    @forward_recompute.setter
+    def forward_recompute(self, v):
+        self.recompute = v
+
+
+class _Fleet:
+    def __init__(self):
+        self._role_maker = None
+        self._is_collective = False
+        self._inited = False
+        self._strategy = None
+
+    # -- init / topology ---------------------------------------------------
+    def init(self, role_maker=None, is_collective=True):
+        self._role_maker = role_maker or PaddleCloudRoleMaker(
+            is_collective=is_collective)
+        self._is_collective = is_collective
+        self._inited = True
+        # multi-host bootstrap over DCN (replaces nccl-id TCP exchange)
+        if self.worker_num() > 1:
+            from ..distributed import init_parallel_env
+
+            init_parallel_env()
+        return self
+
+    def is_first_worker(self):
+        return self.worker_index() == 0
+
+    def worker_index(self):
+        return self._role_maker.worker_index() if self._role_maker else 0
+
+    def worker_num(self):
+        return self._role_maker.worker_num() if self._role_maker else 1
+
+    def is_worker(self):
+        return True
+
+    def worker_endpoints(self, to_string=False):
+        eps = penv.trainer_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def is_server(self):
+        return False
+
+    def server_num(self):
+        return 0
+
+    def barrier_worker(self):
+        pass
+
+    def stop_worker(self):
+        pass
+
+    # -- optimizer ---------------------------------------------------------
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._strategy = strategy or DistributedStrategy()
+        return CollectiveOptimizer(optimizer, self._strategy)
+
+    # -- checkpoint (reference: fleet/collective/__init__.py:236,294) ------
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None,
+                             export_for_deployment=True):
+        from ..fluid import io
+
+        return io.save_inference_model(dirname, feeded_var_names,
+                                       target_vars, executor, main_program)
+
+    def save_persistables(self, executor, dirname, main_program=None):
+        from ..fluid import io
+
+        return io.save_persistables(executor, dirname, main_program)
+
+    def init_worker(self):
+        pass
+
+    def init_server(self, *a, **k):
+        pass
+
+    def run_server(self):
+        pass
+
+
+fleet = _Fleet()
+
+# module-level 2.0-style API
+init = fleet.init
+distributed_optimizer = fleet.distributed_optimizer
+worker_index = fleet.worker_index
+worker_num = fleet.worker_num
+is_first_worker = fleet.is_first_worker
+
+
+class CollectiveOptimizer:
+    """Reference: CollectiveOptimizer (incubate/fleet/collective:393) +
+    GradAllReduce transpiler (transpiler/collective.py:178)."""
+
+    def __init__(self, optimizer, strategy=None):
+        self._optimizer = optimizer
+        self._strategy = strategy or DistributedStrategy()
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def backward(self, *a, **k):
+        return self._optimizer.backward(*a, **k)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        inner = self._optimizer
+        if self._strategy.recompute and hasattr(
+                self._strategy, "recompute_configs"):
+            ckpts = self._strategy.recompute_configs.get("checkpoints", [])
+            if ckpts:
+                from ..fluid.optimizer import RecomputeOptimizer
+
+                inner = RecomputeOptimizer(inner)
+                inner._set_checkpoints(ckpts)
+        if self._strategy.amp:
+            from ..fluid.contrib import mixed_precision
+
+            inner = mixed_precision.decorate(
+                inner, **self._strategy.amp_configs)
+        optimize_ops, params_grads = inner.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+        transpile_collective(loss.block.program,
+                             k_steps_localsgd=(
+                                 self._strategy.localsgd_configs["k_steps"]
+                                 if self._strategy.localsgd else 0))
+        return optimize_ops, params_grads
+
+
+def transpile_collective(program, nranks=None, k_steps_localsgd=0):
+    """GradAllReduce program rewrite (reference: transpiler/collective.py:
+    178-268). Marks the program DP over the local mesh, scales the loss
+    cotangent 1/nranks, inserts c_allreduce_sum per gradient."""
+    import jax
+
+    if nranks is None:
+        nranks = len(jax.devices())
+    if nranks <= 1:
+        return program
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:nranks]), ("dp",))
+    program._data_parallel = True
+    program._dp_axis = "dp"
+    program._mesh = mesh
+    penv.set_global_mesh(mesh)
+    penv.register_ring(0, "dp", nranks)
+
+    block = program.global_block()
+    bwd_idx = None
+    for i, op in enumerate(block.ops):
+        if op.type == "backward":
+            bwd_idx = i
+            break
+    if bwd_idx is None:
+        return program
+    bop = block.ops[bwd_idx]
+    # loss-grad scaling (reference: transpiler/collective.py:190)
+    bop.attrs["loss_scale"] = bop.attrs.get("loss_scale", 1.0) / nranks
+
+    grad_names = list(bop.output_names.get("Grad", []))
+    ar_ops = []
+    for g in grad_names:
+        op = Operator(block, "c_allreduce_sum",
+                      inputs={"X": [g]}, outputs={"Out": [g]},
+                      attrs={"ring_id": 0, "use_calc_stream": True})
+        ar_ops.append(op)
+    block.ops[bwd_idx + 1:bwd_idx + 1] = ar_ops
+    program._version += 1
+    return program

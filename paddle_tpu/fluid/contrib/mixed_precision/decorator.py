@@ -1,0 +1,58 @@
+"""AMP decorator (reference:
+`python/paddle/fluid/contrib/mixed_precision/decorator.py:27-218`:
+OptimizerWithMixedPrecision rewrites the program inserting casts + dynamic
+loss scaling via amp_check_finite_and_scale).
+
+TPU-native: bfloat16 shares fp32's exponent range, so no loss scaling is
+needed — `decorate()` marks the program with a bf16 compute policy that the
+lowering applies per-op (white list ops run on the MXU in bf16; black list
+ops compute in fp32; master weights stay fp32 in the Scope). The dynamic
+loss-scaling arguments are accepted for API parity and unused unless
+use_fp16_guard-style fp16 semantics are explicitly requested.
+"""
+from __future__ import annotations
+
+from ... import framework
+from .fp16_lists import AutoMixedPrecisionLists
+
+
+class OptimizerWithMixedPrecision:
+    def __init__(self, optimizer, amp_lists=None, init_loss_scaling=2.**15,
+                 use_dynamic_loss_scaling=True, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, incr_ratio=2.0,
+                 decr_ratio=0.8):
+        self._optimizer = optimizer
+        self._amp_lists = amp_lists or AutoMixedPrecisionLists()
+        self._loss_scaling = init_loss_scaling
+        self._use_dynamic_loss_scaling = use_dynamic_loss_scaling
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def get_loss_scaling(self):
+        return self._loss_scaling
+
+    def backward(self, loss, **kwargs):
+        return self._optimizer.backward(loss, **kwargs)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        program = loss.block.program
+        program._amp = True
+        program._amp_lists = self._amp_lists
+        program._version += 1
+        return self._optimizer.minimize(loss, startup_program,
+                                        parameter_list, no_grad_set)
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=2.**15,
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.8,
+             use_dynamic_loss_scaling=True):
+    """Reference: decorator.py:218."""
+    return OptimizerWithMixedPrecision(
+        optimizer, amp_lists, init_loss_scaling, use_dynamic_loss_scaling,
+        incr_every_n_steps, decr_every_n_nan_or_inf, incr_ratio, decr_ratio)

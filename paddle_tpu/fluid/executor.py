@@ -62,10 +62,12 @@ class Executor:
             if use_program_cache:
                 self._cache[key] = entry
 
-        states = {n: scope.find_var(n) for n in entry.state_in_names}
+        states_mut = {n: scope.find_var(n) for n in entry.state_mut_names}
+        states_ro = {n: scope.find_var(n) for n in entry.state_ro_names}
         seed = framework._global_seed_and_bump(program)
         feeds_dev = self._shard_feeds(entry, feed_arrays)
-        fetches, new_states = entry.jitted(feeds_dev, states,
+        fetches, new_states = entry.jitted(feeds_dev, states_mut,
+                                           states_ro,
                                            np.uint32(seed % (2**31)))
         for n, v in new_states.items():
             scope.set_var(n, v)

@@ -436,6 +436,9 @@ class Program:
         p._data_parallel = self._data_parallel
         p._dp_axis = self._dp_axis
         p._mesh = self._mesh
+        if getattr(self, "_amp", False):
+            p._amp = self._amp
+            p._amp_lists = self._amp_lists
         p.blocks = []
         for b in self.blocks:
             nb = Block(p, b.idx, b.parent_idx)

@@ -41,18 +41,23 @@ _SKIP_OPS = frozenset({
 
 
 class LoweredFunction:
-    """A compiled block: callable (feeds, states, seed) -> (fetches, states').
-    """
+    """A compiled block: callable (feeds, states_mut, states_ro, seed) ->
+    (fetches, states'). states_mut (rebound by the block: params, moments,
+    running stats) are donated so XLA updates them in place on HBM."""
 
     __slots__ = ("jitted", "state_in_names", "state_out_names",
+                 "state_mut_names", "state_ro_names",
                  "fetch_names", "feed_names", "mesh", "dp_axis")
 
     def __init__(self, jitted, feed_names, state_in_names, state_out_names,
-                 fetch_names, mesh=None, dp_axis=None):
+                 state_mut_names, state_ro_names, fetch_names, mesh=None,
+                 dp_axis=None):
         self.jitted = jitted
         self.feed_names = feed_names
         self.state_in_names = state_in_names
         self.state_out_names = state_out_names
+        self.state_mut_names = state_mut_names
+        self.state_ro_names = state_ro_names
         self.fetch_names = fetch_names
         self.mesh = mesh
         self.dp_axis = dp_axis
@@ -93,8 +98,9 @@ def analyze_block(block, feed_names, fetch_names):
     return state_in, state_out
 
 
-def _exec_op(op, env, key0, op_idx):
+def _exec_op(op, env, key0, op_idx, amp_lists=None):
     import jax
+    import jax.numpy as jnp
 
     t = op.type
     if t in _SKIP_OPS:
@@ -110,6 +116,18 @@ def _exec_op(op, env, key0, op_idx):
             raise RuntimeError(
                 "op %s: input var %s not materialized (feed it or run the "
                 "startup program)" % (t, e)) from None
+    # bf16 AMP policy (reference: fp16_utils.py cast insertion; here the
+    # casts are applied at trace time and fused by XLA)
+    if amp_lists is not None:
+        def cast_ins(src, dst):
+            return {s: [v.astype(dst)
+                        if hasattr(v, "dtype") and v.dtype == src else v
+                        for v in vs] for s, vs in ins.items()}
+
+        if t in amp_lists.white_list:
+            ins = cast_ins(jnp.float32, jnp.bfloat16)
+        elif t in amp_lists.black_list:
+            ins = cast_ins(jnp.bfloat16, jnp.float32)
     attrs = dict(op.attrs)
     if opdef.needs_rng:
         attrs["_rng_key"] = jax.random.fold_in(key0, op_idx)
@@ -120,9 +138,9 @@ def _exec_op(op, env, key0, op_idx):
             env[n] = v
 
 
-def _run_ops(ops, env, key0, base_idx=0):
+def _run_ops(ops, env, key0, base_idx=0, amp_lists=None):
     for i, op in enumerate(ops):
-        _exec_op(op, env, key0, base_idx + i)
+        _exec_op(op, env, key0, base_idx + i, amp_lists=amp_lists)
 
 
 def _diffable(block, name, env):
@@ -148,15 +166,18 @@ def build_block_fn(program, block, feed_names, fetch_names,
     if len(bwd_indices) > 1:
         raise NotImplementedError("multiple backward sections in one block")
     bwd_idx = bwd_indices[0] if bwd_indices else None
+    amp_lists = getattr(program, "_amp_lists", None) \
+        if getattr(program, "_amp", False) else None
 
-    def fn(feeds: Dict, states: Dict, seed):
+    def fn(feeds: Dict, states_mut: Dict, states_ro: Dict, seed):
         env = {}
-        env.update(states)
+        env.update(states_ro)
+        env.update(states_mut)
         env.update(feeds)
         key0 = jax.random.PRNGKey(seed)
 
         if bwd_idx is None:
-            _run_ops(ops, env, key0)
+            _run_ops(ops, env, key0, amp_lists=amp_lists)
         else:
             fwd_ops = ops[:bwd_idx]
             bop = ops[bwd_idx]
@@ -169,7 +190,7 @@ def build_block_fn(program, block, feed_names, fetch_names,
             def fseg(dvars):
                 e = dict(env)
                 e.update(dvars)
-                _run_ops(fwd_ops, e, key0)
+                _run_ops(fwd_ops, e, key0, amp_lists=amp_lists)
                 loss_sum = jnp.sum(e[loss_name].astype(jnp.float32))
                 return loss_sum, e
 
@@ -184,7 +205,8 @@ def build_block_fn(program, block, feed_names, fetch_names,
             loss_val = env[loss_name]
             env[framework.grad_var_name(loss_name)] = jnp.full(
                 loss_val.shape, loss_scale, loss_val.dtype)
-            _run_ops(ops[bwd_idx + 1:], env, key0, base_idx=bwd_idx + 1)
+            _run_ops(ops[bwd_idx + 1:], env, key0, base_idx=bwd_idx + 1,
+                     amp_lists=amp_lists)
 
         fetches = []
         for n in fetch_names:
@@ -198,7 +220,7 @@ def build_block_fn(program, block, feed_names, fetch_names,
 
 
 def compile_block(program, block, feed_specs, fetch_names, state_specs,
-                  donate=False):
+                  donate=None):
     """feed_specs/state_specs: name -> concrete arrays or ShapeDtypeStructs
     (only shapes/dtypes are read). Returns a LoweredFunction."""
     import jax
@@ -214,20 +236,31 @@ def compile_block(program, block, feed_specs, fetch_names, state_specs,
     fn = build_block_fn(program, block, feed_names, fetch_names,
                         state_in, state_out)
 
+    state_out_set = set(state_out)
+    state_mut = [n for n in state_in if n in state_out_set]
+    state_ro = [n for n in state_in if n not in state_out_set]
+
     mesh = getattr(program, "_mesh", None)
     dp_axis = getattr(program, "_dp_axis", "dp")
     if getattr(program, "_data_parallel", False) and mesh is None:
         mesh = _default_mesh(dp_axis)
         program._mesh = mesh
 
+    if donate is None:  # None = follow the global flag
+        from ..utils.flags import get_flag
+
+        donate = bool(get_flag("FLAGS_tpu_donate_buffers", True))
+
     if mesh is not None and getattr(program, "_data_parallel", False):
         jitted = _compile_dp(fn, mesh, dp_axis, program, block,
-                             feed_names, fetch_names, state_in, donate)
+                             feed_names, fetch_names, state_mut, state_ro,
+                             donate)
     else:
         jitted = jax.jit(fn, donate_argnums=(1,) if donate else ())
 
     return LoweredFunction(jitted, feed_names, state_in, state_out,
-                           fetch_names, mesh=mesh, dp_axis=dp_axis)
+                           state_mut, state_ro, fetch_names, mesh=mesh,
+                           dp_axis=dp_axis)
 
 
 def _default_mesh(dp_axis):
@@ -239,7 +272,7 @@ def _default_mesh(dp_axis):
 
 
 def _compile_dp(fn, mesh, dp_axis, program, block, feed_names, fetch_names,
-                state_in, donate):
+                state_mut, state_ro, donate):
     """Data-parallel lowering: shard_map over the mesh; feeds sharded on
     axis 0, state replicated. Collective ops inside see the live axis and
     emit psum over ICI (reference flow: transpiler/collective.py:178-268 +
@@ -252,12 +285,13 @@ def _compile_dp(fn, mesh, dp_axis, program, block, feed_names, fetch_names,
     ndev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
     axes = {a: mesh.shape[a] for a in mesh.axis_names}
 
-    def wrapped(feeds, states, seed):
+    def wrapped(feeds, states_mut, states_ro, seed):
         with penv.collective_scope(axes):
-            return fn(feeds, states, seed)
+            return fn(feeds, states_mut, states_ro, seed)
 
     feed_specs = {n: P(dp_axis) for n in feed_names}
-    state_specs_in = {n: P() for n in state_in}
+    state_specs_mut = {n: P() for n in state_mut}
+    state_specs_ro = {n: P() for n in state_ro}
 
     def out_spec_for_fetch(n):
         v = block._find_var_recursive(n)
@@ -270,7 +304,7 @@ def _compile_dp(fn, mesh, dp_axis, program, block, feed_names, fetch_names,
 
     smapped = jax.shard_map(
         wrapped, mesh=mesh,
-        in_specs=(feed_specs, state_specs_in, P()),
+        in_specs=(feed_specs, state_specs_mut, state_specs_ro, P()),
         out_specs=(fetch_specs, P()),
         check_vma=False)
     return jax.jit(smapped, donate_argnums=(1,) if donate else ())
