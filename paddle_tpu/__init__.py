@@ -30,6 +30,7 @@ from .fluid import regularizer  # noqa: F401
 from .fluid import metrics  # noqa: F401
 
 from . import distributed  # noqa: F401
+from . import inference  # noqa: F401
 from . import parallel  # noqa: F401
 from . import nn  # noqa: F401
 from . import tensor  # noqa: F401

@@ -1,0 +1,320 @@
+"""ctypes bindings to the paddle_tpu C++ native runtime.
+
+Native pieces (see src/capi.h for the C ABI and the reference files each
+mirrors):
+
+- :class:`NativeChannel`   — bounded blocking byte-buffer queue
+  (ref: operators/reader/lod_tensor_blocking_queue.h).
+- :class:`NativeAllocator` — auto-growth best-fit caching host allocator
+  (ref: memory/allocation/auto_growth_best_fit_allocator.cc).
+- :class:`MultiSlotDataFeed` — threaded text parser + shuffle + batcher
+  (ref: framework/data_feed.cc MultiSlotDataFeed).
+- :func:`stat_add` etc.    — global counter registry
+  (ref: platform/monitor.h).
+"""
+from __future__ import annotations
+
+import ctypes
+import pickle
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import build as _build
+
+_lib = None
+
+PTQ_OK = 0
+PTQ_CLOSED = -1
+PTQ_TIMEOUT = -2
+PTQ_ERR = -3
+
+SLOT_FLOAT32 = 0
+SLOT_INT64 = 1
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    so = _build.build()
+    lib = ctypes.CDLL(so)
+    i64, i32, u64 = ctypes.c_int64, ctypes.c_int32, ctypes.c_uint64
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+
+    lib.ptq_chan_create.restype = i64
+    lib.ptq_chan_create.argtypes = [i64]
+    lib.ptq_chan_push.restype = i32
+    lib.ptq_chan_push.argtypes = [i64, ctypes.c_char_p, i64, i64]
+    lib.ptq_chan_pop.restype = i32
+    lib.ptq_chan_pop.argtypes = [i64, ctypes.POINTER(u8p),
+                                 ctypes.POINTER(i64), i64]
+    lib.ptq_chan_close.argtypes = [i64]
+    lib.ptq_chan_reopen.argtypes = [i64]
+    lib.ptq_chan_size.restype = i64
+    lib.ptq_chan_size.argtypes = [i64]
+    lib.ptq_chan_destroy.argtypes = [i64]
+    lib.ptq_buf_free.argtypes = [u8p]
+
+    lib.ptq_alloc_create.restype = i64
+    lib.ptq_alloc_create.argtypes = [i64]
+    lib.ptq_alloc_malloc.restype = ctypes.c_void_p
+    lib.ptq_alloc_malloc.argtypes = [i64, i64]
+    lib.ptq_alloc_free.argtypes = [i64, ctypes.c_void_p]
+    lib.ptq_alloc_stats.argtypes = [i64, ctypes.POINTER(i64)]
+    lib.ptq_alloc_release_cache.argtypes = [i64]
+    lib.ptq_alloc_destroy.argtypes = [i64]
+
+    lib.ptq_feed_create.restype = i64
+    lib.ptq_feed_create.argtypes = [i32, ctypes.POINTER(i32), i64, i64]
+    lib.ptq_feed_set_files.restype = i32
+    lib.ptq_feed_set_files.argtypes = [i64, ctypes.c_char_p]
+    lib.ptq_feed_start.restype = i32
+    lib.ptq_feed_start.argtypes = [i64, i32, i32, u64, i64]
+    lib.ptq_feed_next.restype = i32
+    lib.ptq_feed_next.argtypes = [i64, ctypes.POINTER(u8p),
+                                  ctypes.POINTER(i64), i64]
+    lib.ptq_feed_examples.restype = i64
+    lib.ptq_feed_examples.argtypes = [i64]
+    lib.ptq_feed_join.argtypes = [i64]
+    lib.ptq_feed_destroy.argtypes = [i64]
+
+    lib.ptq_stat_add.argtypes = [ctypes.c_char_p, i64]
+    lib.ptq_stat_get.restype = i64
+    lib.ptq_stat_get.argtypes = [ctypes.c_char_p]
+    lib.ptq_stat_reset.argtypes = [ctypes.c_char_p]
+    lib.ptq_stat_names.restype = i64
+    lib.ptq_stat_names.argtypes = [ctypes.c_char_p, i64]
+
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    try:
+        _load()
+        return True
+    except Exception:
+        return False
+
+
+class Closed(Exception):
+    """Channel/feed is closed and drained."""
+
+
+class Timeout(Exception):
+    pass
+
+
+def _check(rc: int) -> None:
+    if rc == PTQ_OK:
+        return
+    if rc == PTQ_CLOSED:
+        raise Closed()
+    if rc == PTQ_TIMEOUT:
+        raise Timeout()
+    raise RuntimeError("native runtime error (rc=%d)" % rc)
+
+
+class NativeChannel:
+    """Bounded blocking queue of python objects (pickled to byte buffers
+    on the C++ side). push/pop block; close() drains then raises Closed."""
+
+    def __init__(self, capacity: int = 8):
+        self._lib = _load()
+        self._h = self._lib.ptq_chan_create(capacity)
+
+    def push(self, obj, timeout_ms: int = -1) -> None:
+        data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        _check(self._lib.ptq_chan_push(self._h, data, len(data), timeout_ms))
+
+    def pop(self, timeout_ms: int = -1):
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = ctypes.c_int64()
+        _check(self._lib.ptq_chan_pop(self._h, ctypes.byref(out),
+                                      ctypes.byref(n), timeout_ms))
+        try:
+            data = ctypes.string_at(out, n.value)
+        finally:
+            self._lib.ptq_buf_free(out)
+        return pickle.loads(data)
+
+    def close(self) -> None:
+        self._lib.ptq_chan_close(self._h)
+
+    def reopen(self) -> None:
+        self._lib.ptq_chan_reopen(self._h)
+
+    def __len__(self) -> int:
+        return int(self._lib.ptq_chan_size(self._h))
+
+    def __del__(self):
+        try:
+            self._lib.ptq_chan_destroy(self._h)
+        except Exception:
+            pass
+
+    def __iter__(self):
+        while True:
+            try:
+                yield self.pop()
+            except Closed:
+                return
+
+
+class NativeAllocator:
+    """Best-fit caching host allocator; returns numpy views over native
+    buffers for zero-copy staging."""
+
+    def __init__(self, alignment: int = 64):
+        self._lib = _load()
+        self._h = self._lib.ptq_alloc_create(alignment)
+        self._live = {}
+
+    def alloc(self, nbytes: int) -> int:
+        p = self._lib.ptq_alloc_malloc(self._h, nbytes)
+        if not p:
+            raise MemoryError(nbytes)
+        self._live[p] = nbytes
+        return p
+
+    def alloc_array(self, shape, dtype) -> Tuple[int, np.ndarray]:
+        dtype = np.dtype(dtype)
+        nbytes = int(np.prod(shape)) * dtype.itemsize
+        p = self.alloc(max(nbytes, 1))
+        buf = (ctypes.c_uint8 * max(nbytes, 1)).from_address(p)
+        arr = np.frombuffer(buf, dtype=dtype, count=int(np.prod(shape)))
+        return p, arr.reshape(shape)
+
+    def free(self, p: int) -> None:
+        self._live.pop(p, None)
+        self._lib.ptq_alloc_free(self._h, p)
+
+    def stats(self) -> dict:
+        s = (ctypes.c_int64 * 4)()
+        self._lib.ptq_alloc_stats(self._h, s)
+        return {"bytes_in_use": s[0], "bytes_cached": s[1],
+                "n_alloc": s[2], "n_cache_hit": s[3]}
+
+    def release_cache(self) -> None:
+        self._lib.ptq_alloc_release_cache(self._h)
+
+    def __del__(self):
+        try:
+            self._lib.ptq_alloc_destroy(self._h)
+        except Exception:
+            pass
+
+
+class MultiSlotDataFeed:
+    """Threaded MultiSlot-format text reader.
+
+    ``next_batch()`` returns ``[(array, lod), ...]`` per slot where lod is
+    the per-batch cumulative offsets (ref LoD level-0); raises
+    :class:`Closed` at end of data.
+    """
+
+    def __init__(self, slot_types: Sequence[str], batch_size: int,
+                 queue_capacity: int = 16):
+        self._lib = _load()
+        codes = []
+        for t in slot_types:
+            if t in ("float32", "float", SLOT_FLOAT32):
+                codes.append(SLOT_FLOAT32)
+            elif t in ("int64", "int", SLOT_INT64):
+                codes.append(SLOT_INT64)
+            else:
+                raise ValueError("unsupported slot type %r" % (t,))
+        arr = (ctypes.c_int32 * len(codes))(*codes)
+        self._h = self._lib.ptq_feed_create(len(codes), arr, batch_size,
+                                            queue_capacity)
+        if self._h < 0:
+            raise ValueError("bad feed config")
+        self._n_slots = len(codes)
+
+    def set_filelist(self, files: Sequence[str]) -> None:
+        joined = "\n".join(files).encode()
+        _check(self._lib.ptq_feed_set_files(self._h, joined))
+
+    def start(self, n_threads: int = 1, shuffle: bool = False,
+              seed: int = 0, buffer_size: int = 1024) -> None:
+        _check(self._lib.ptq_feed_start(self._h, n_threads,
+                                        1 if shuffle else 0, seed,
+                                        buffer_size))
+
+    def next_batch(self, timeout_ms: int = -1) -> List[Tuple[np.ndarray,
+                                                             np.ndarray]]:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = ctypes.c_int64()
+        _check(self._lib.ptq_feed_next(self._h, ctypes.byref(out),
+                                       ctypes.byref(n), timeout_ms))
+        try:
+            data = ctypes.string_at(out, n.value)
+        finally:
+            self._lib.ptq_buf_free(out)
+        return self._decode(data)
+
+    def _decode(self, data: bytes):
+        off = 0
+
+        def rd_i64():
+            nonlocal off
+            v = int(np.frombuffer(data, "<i8", 1, off)[0])
+            off += 8
+            return v
+
+        n_slots = rd_i64()
+        assert n_slots == self._n_slots, (n_slots, self._n_slots)
+        slots = []
+        for _ in range(n_slots):
+            t = int(np.frombuffer(data, "<i4", 1, off)[0])
+            off += 4
+            n_lod = rd_i64()
+            lod = np.frombuffer(data, "<i8", n_lod, off).copy()
+            off += 8 * n_lod
+            n_vals = rd_i64()
+            dt = "<f4" if t == SLOT_FLOAT32 else "<i8"
+            vals = np.frombuffer(data, dt, n_vals, off).copy()
+            off += n_vals * np.dtype(dt).itemsize
+            slots.append((vals, lod))
+        return slots
+
+    def examples_parsed(self) -> int:
+        return int(self._lib.ptq_feed_examples(self._h))
+
+    def join(self) -> None:
+        self._lib.ptq_feed_join(self._h)
+
+    def __iter__(self):
+        while True:
+            try:
+                yield self.next_batch()
+            except Closed:
+                return
+
+    def __del__(self):
+        try:
+            self._lib.ptq_feed_destroy(self._h)
+        except Exception:
+            pass
+
+
+def stat_add(name: str, delta: int = 1) -> None:
+    _load().ptq_stat_add(name.encode(), delta)
+
+
+def stat_get(name: str) -> int:
+    return int(_load().ptq_stat_get(name.encode()))
+
+
+def stat_reset(name: str) -> None:
+    _load().ptq_stat_reset(name.encode())
+
+
+def stat_names() -> List[str]:
+    lib = _load()
+    n = lib.ptq_stat_names(None, 0)
+    buf = ctypes.create_string_buffer(int(n) + 1)
+    lib.ptq_stat_names(buf, n + 1)
+    s = buf.value.decode()
+    return s.split("\n") if s else []

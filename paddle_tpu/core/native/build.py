@@ -1,0 +1,44 @@
+"""Builds libpaddle_tpu_native.so from the C++ sources with g++.
+
+No pybind11 in this image, so the library exposes a plain C ABI
+(src/capi.h) consumed via ctypes. Rebuilds only when a source is newer
+than the .so. Importing paddle_tpu.core.native triggers this lazily; the
+build is a single g++ invocation (< 10s).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src")
+_SO = os.path.join(_DIR, "libpaddle_tpu_native.so")
+_SOURCES = ["channel.cc", "allocator.cc", "data_feed.cc", "monitor.cc"]
+_lock = threading.Lock()
+
+
+def _stale() -> bool:
+    if not os.path.exists(_SO):
+        return True
+    so_mtime = os.path.getmtime(_SO)
+    deps = [os.path.join(_SRC, s) for s in _SOURCES]
+    deps.append(os.path.join(_SRC, "capi.h"))
+    return any(os.path.getmtime(d) > so_mtime for d in deps)
+
+
+def build(force: bool = False) -> str:
+    """Returns the path to the built shared library."""
+    with _lock:
+        if not force and not _stale():
+            return _SO
+        cmd = [
+            "g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
+            "-Wall", "-o", _SO,
+        ] + [os.path.join(_SRC, s) for s in _SOURCES]
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        return _SO
+
+
+if __name__ == "__main__":
+    print(build(force=True))

@@ -1,0 +1,149 @@
+// Auto-growth best-fit caching host allocator.
+//
+// TPU-native counterpart of the reference's strategy allocator
+// (paddle/fluid/memory/allocation/auto_growth_best_fit_allocator.cc and
+// allocator_facade.h:32). On TPU the device heap belongs to XLA/PJRT, so
+// the native allocator's job is the HOST side: reusable aligned staging
+// buffers for feed/fetch and the data pipeline, avoiding malloc churn in
+// the hot input loop. Freed blocks go to a size-keyed free list and are
+// handed back best-fit (smallest block >= request).
+#include "capi.h"
+
+#include <atomic>
+#include <cstdlib>
+#include <map>
+#include <mutex>
+#include <unordered_map>
+
+namespace {
+
+struct Block {
+  void* raw;        // base pointer returned by aligned alloc
+  int64_t size;     // usable size
+};
+
+class BestFitAllocator {
+ public:
+  explicit BestFitAllocator(int64_t alignment)
+      : align_(alignment < 64 ? 64 : alignment) {}
+
+  ~BestFitAllocator() {
+    ReleaseCache();
+    for (auto& kv : in_use_) free(kv.second.raw);  // unfreed allocations
+    in_use_.clear();
+  }
+
+  void* Malloc(int64_t size) {
+    if (size <= 0) size = 1;
+    std::lock_guard<std::mutex> g(mu_);
+    n_alloc_++;
+    // best fit: smallest cached block that can hold `size`
+    auto it = free_.lower_bound(size);
+    if (it != free_.end()) {
+      Block b = it->second;
+      free_.erase(it);
+      cached_bytes_ -= b.size;
+      in_use_[b.raw] = b;
+      in_use_bytes_ += b.size;
+      n_hit_++;
+      return b.raw;
+    }
+    void* p = nullptr;
+    if (posix_memalign(&p, (size_t)align_, (size_t)size) != 0) return nullptr;
+    Block b{p, size};
+    in_use_[p] = b;
+    in_use_bytes_ += size;
+    return p;
+  }
+
+  void Free(void* p) {
+    if (!p) return;
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = in_use_.find(p);
+    if (it == in_use_.end()) return;
+    Block b = it->second;
+    in_use_.erase(it);
+    in_use_bytes_ -= b.size;
+    free_.emplace(b.size, b);
+    cached_bytes_ += b.size;
+  }
+
+  void ReleaseCache() {
+    std::lock_guard<std::mutex> g(mu_);
+    for (auto& kv : free_) free(kv.second.raw);
+    free_.clear();
+    cached_bytes_ = 0;
+  }
+
+  void Stats(int64_t* s) {
+    std::lock_guard<std::mutex> g(mu_);
+    s[0] = in_use_bytes_;
+    s[1] = cached_bytes_;
+    s[2] = n_alloc_;
+    s[3] = n_hit_;
+  }
+
+ private:
+  const int64_t align_;
+  std::mutex mu_;
+  std::multimap<int64_t, Block> free_;          // size -> block (best fit)
+  std::unordered_map<void*, Block> in_use_;
+  int64_t in_use_bytes_ = 0, cached_bytes_ = 0;
+  int64_t n_alloc_ = 0, n_hit_ = 0;
+};
+
+std::mutex g_mu;
+std::unordered_map<int64_t, BestFitAllocator*> g_allocs;
+std::atomic<int64_t> g_next{1};
+
+BestFitAllocator* Get(int64_t h) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_allocs.find(h);
+  return it == g_allocs.end() ? nullptr : it->second;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t ptq_alloc_create(int64_t alignment) {
+  int64_t id = g_next.fetch_add(1);
+  std::lock_guard<std::mutex> g(g_mu);
+  g_allocs[id] = new BestFitAllocator(alignment);
+  return id;
+}
+
+void* ptq_alloc_malloc(int64_t h, int64_t size) {
+  BestFitAllocator* a = Get(h);
+  return a ? a->Malloc(size) : nullptr;
+}
+
+void ptq_alloc_free(int64_t h, void* p) {
+  BestFitAllocator* a = Get(h);
+  if (a) a->Free(p);
+}
+
+void ptq_alloc_stats(int64_t h, int64_t* stats) {
+  BestFitAllocator* a = Get(h);
+  if (a) a->Stats(stats);
+}
+
+void ptq_alloc_release_cache(int64_t h) {
+  BestFitAllocator* a = Get(h);
+  if (a) a->ReleaseCache();
+}
+
+void ptq_alloc_destroy(int64_t h) {
+  BestFitAllocator* a = nullptr;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_allocs.find(h);
+    if (it != g_allocs.end()) {
+      a = it->second;
+      g_allocs.erase(it);
+    }
+  }
+  delete a;
+}
+
+}  // extern "C"

@@ -1,0 +1,87 @@
+// C API surface of the paddle_tpu native runtime.
+//
+// TPU-native counterpart of the reference's native runtime plumbing:
+//   - blocking byte-buffer channel   (ref: paddle/fluid/operators/reader/
+//     lod_tensor_blocking_queue.h, framework/channel.h)
+//   - auto-growth best-fit host allocator (ref: paddle/fluid/memory/
+//     allocation/auto_growth_best_fit_allocator.cc)
+//   - MultiSlot text data feed        (ref: paddle/fluid/framework/
+//     data_feed.cc:639 MultiSlotDataFeed)
+//   - global stats monitor            (ref: paddle/fluid/platform/monitor.h)
+//
+// Everything is extern "C" and loaded from python via ctypes (no pybind11
+// in this image). Handles are opaque int64 ids; buffers returned by the
+// library are owned by the library and freed with ptq_buf_free.
+#pragma once
+#include <stdint.h>
+#include <stddef.h>
+
+extern "C" {
+
+// ---- error codes ----
+enum {
+  PTQ_OK = 0,
+  PTQ_CLOSED = -1,   // channel closed and drained
+  PTQ_TIMEOUT = -2,
+  PTQ_ERR = -3,
+};
+
+// ---- blocking channel of byte buffers ----
+int64_t ptq_chan_create(int64_t capacity);
+// Copies buf[0:len] into the channel. Blocks while full (up to timeout_ms;
+// timeout_ms < 0 means wait forever).
+int ptq_chan_push(int64_t h, const uint8_t* buf, int64_t len,
+                  int64_t timeout_ms);
+// On PTQ_OK, *out is a library-owned buffer of *out_len bytes; free it
+// with ptq_buf_free.
+int ptq_chan_pop(int64_t h, uint8_t** out, int64_t* out_len,
+                 int64_t timeout_ms);
+void ptq_chan_close(int64_t h);   // wakes all waiters; pops drain then CLOSED
+void ptq_chan_reopen(int64_t h);
+int64_t ptq_chan_size(int64_t h);
+void ptq_chan_destroy(int64_t h);
+void ptq_buf_free(uint8_t* buf);
+
+// ---- auto-growth best-fit host allocator ----
+int64_t ptq_alloc_create(int64_t alignment);
+void* ptq_alloc_malloc(int64_t h, int64_t size);
+void ptq_alloc_free(int64_t h, void* p);
+// stats[0]=bytes_in_use stats[1]=bytes_cached stats[2]=n_alloc
+// stats[3]=n_cache_hit
+void ptq_alloc_stats(int64_t h, int64_t* stats);
+void ptq_alloc_release_cache(int64_t h);
+void ptq_alloc_destroy(int64_t h);
+
+// ---- MultiSlot data feed ----
+// Slot types: 0 = float32, 1 = int64.
+// Text format (one example per line, same as the reference MultiSlot
+// format): for each slot in order, "<n> v_1 ... v_n" fields separated by
+// whitespace.
+int64_t ptq_feed_create(int32_t n_slots, const int32_t* slot_types,
+                        int64_t batch_size, int64_t queue_capacity);
+int ptq_feed_set_files(int64_t h, const char* paths_nl_joined);
+// Starts n_threads parser threads. shuffle: 0 = none, 1 = within-buffer
+// local shuffle with the given seed and buffer_size examples.
+int ptq_feed_start(int64_t h, int32_t n_threads, int32_t shuffle,
+                   uint64_t seed, int64_t buffer_size);
+// Pops one serialized batch (wire format below). PTQ_CLOSED at end of data.
+// Wire format: [i64 n_slots] then per slot:
+//   [i32 type][i64 n_lod][i64 lod_0..lod_n][i64 n_vals][vals...]
+// lod offsets are per-batch cumulative example offsets (lod_0 == 0,
+// lod_{n-1} == n_vals for var-length slots).
+int ptq_feed_next(int64_t h, uint8_t** out, int64_t* out_len,
+                  int64_t timeout_ms);
+// number of examples parsed so far (for progress/metrics)
+int64_t ptq_feed_examples(int64_t h);
+void ptq_feed_join(int64_t h);   // wait for parser threads to finish
+void ptq_feed_destroy(int64_t h);
+
+// ---- global stats monitor ----
+void ptq_stat_add(const char* name, int64_t delta);
+int64_t ptq_stat_get(const char* name);
+void ptq_stat_reset(const char* name);
+// Writes '\n'-joined stat names into buf (truncated to cap); returns the
+// full length needed.
+int64_t ptq_stat_names(char* buf, int64_t cap);
+
+}  // extern "C"

@@ -1,0 +1,168 @@
+// Bounded MPMC blocking channel of byte buffers.
+//
+// TPU-native stand-in for the reference's reader queue
+// (paddle/fluid/operators/reader/lod_tensor_blocking_queue.h:30 and
+// framework/blocking_queue.h): python feeder threads push serialized
+// batches, the device-prefetch consumer pops them. Close() wakes all
+// waiters and lets pops drain remaining items before reporting CLOSED —
+// the same drain semantics the reference queue has.
+#include "capi.h"
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Buf {
+  uint8_t* data;
+  int64_t len;
+};
+
+class Channel {
+ public:
+  explicit Channel(int64_t cap) : cap_(cap < 1 ? 1 : cap) {}
+
+  ~Channel() {
+    std::lock_guard<std::mutex> g(mu_);
+    for (auto& b : q_) free(b.data);
+    q_.clear();
+  }
+
+  int Push(const uint8_t* buf, int64_t len, int64_t timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (!Wait(lk, not_full_, timeout_ms,
+              [&] { return closed_ || (int64_t)q_.size() < cap_; }))
+      return PTQ_TIMEOUT;
+    if (closed_) return PTQ_CLOSED;
+    Buf b;
+    b.data = (uint8_t*)malloc(len > 0 ? len : 1);
+    if (!b.data) return PTQ_ERR;
+    if (len > 0) memcpy(b.data, buf, len);
+    b.len = len;
+    q_.push_back(b);
+    not_empty_.notify_one();
+    return PTQ_OK;
+  }
+
+  int Pop(uint8_t** out, int64_t* out_len, int64_t timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (!Wait(lk, not_empty_, timeout_ms,
+              [&] { return closed_ || !q_.empty(); }))
+      return PTQ_TIMEOUT;
+    if (q_.empty()) return PTQ_CLOSED;  // closed and drained
+    Buf b = q_.front();
+    q_.pop_front();
+    *out = b.data;
+    *out_len = b.len;
+    not_full_.notify_one();
+    return PTQ_OK;
+  }
+
+  void Close() {
+    std::lock_guard<std::mutex> g(mu_);
+    closed_ = true;
+    not_empty_.notify_all();
+    not_full_.notify_all();
+  }
+
+  void Reopen() {
+    std::lock_guard<std::mutex> g(mu_);
+    closed_ = false;
+  }
+
+  int64_t Size() {
+    std::lock_guard<std::mutex> g(mu_);
+    return (int64_t)q_.size();
+  }
+
+ private:
+  template <typename Pred>
+  bool Wait(std::unique_lock<std::mutex>& lk, std::condition_variable& cv,
+            int64_t timeout_ms, Pred pred) {
+    if (timeout_ms < 0) {
+      cv.wait(lk, pred);
+      return true;
+    }
+    return cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), pred);
+  }
+
+  const int64_t cap_;
+  std::mutex mu_;
+  std::condition_variable not_empty_, not_full_;
+  std::deque<Buf> q_;
+  bool closed_ = false;
+};
+
+std::mutex g_reg_mu;
+std::unordered_map<int64_t, Channel*> g_channels;
+std::atomic<int64_t> g_next_id{1};
+
+Channel* Get(int64_t h) {
+  std::lock_guard<std::mutex> g(g_reg_mu);
+  auto it = g_channels.find(h);
+  return it == g_channels.end() ? nullptr : it->second;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t ptq_chan_create(int64_t capacity) {
+  int64_t id = g_next_id.fetch_add(1);
+  std::lock_guard<std::mutex> g(g_reg_mu);
+  g_channels[id] = new Channel(capacity);
+  return id;
+}
+
+int ptq_chan_push(int64_t h, const uint8_t* buf, int64_t len,
+                  int64_t timeout_ms) {
+  Channel* c = Get(h);
+  return c ? c->Push(buf, len, timeout_ms) : PTQ_ERR;
+}
+
+int ptq_chan_pop(int64_t h, uint8_t** out, int64_t* out_len,
+                 int64_t timeout_ms) {
+  Channel* c = Get(h);
+  return c ? c->Pop(out, out_len, timeout_ms) : PTQ_ERR;
+}
+
+void ptq_chan_close(int64_t h) {
+  Channel* c = Get(h);
+  if (c) c->Close();
+}
+
+void ptq_chan_reopen(int64_t h) {
+  Channel* c = Get(h);
+  if (c) c->Reopen();
+}
+
+int64_t ptq_chan_size(int64_t h) {
+  Channel* c = Get(h);
+  return c ? c->Size() : -1;
+}
+
+void ptq_chan_destroy(int64_t h) {
+  Channel* c = nullptr;
+  {
+    std::lock_guard<std::mutex> g(g_reg_mu);
+    auto it = g_channels.find(h);
+    if (it != g_channels.end()) {
+      c = it->second;
+      g_channels.erase(it);
+    }
+  }
+  if (c) {
+    c->Close();
+    delete c;
+  }
+}
+
+void ptq_buf_free(uint8_t* buf) { free(buf); }
+
+}  // extern "C"

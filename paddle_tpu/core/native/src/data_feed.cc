@@ -1,0 +1,267 @@
+// MultiSlot text data feed: multithreaded parse -> local shuffle ->
+// batch -> serialized batches on a blocking channel.
+//
+// TPU-native counterpart of the reference's C++ ingestion tier
+// (paddle/fluid/framework/data_feed.cc:639 MultiSlotDataFeed,
+// data_feed.h:108/291; dataset shuffle in data_set.h:111). Same text
+// format: one example per line; for each slot in declared order, a count
+// followed by that many values. Variable-length slots produce per-batch
+// LoD offsets exactly like the reference's LoDTensor batches; python
+// decodes the wire format into numpy arrays + lod without touching the
+// parse loop.
+#include "capi.h"
+
+#include <atomic>
+#include <algorithm>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Example {
+  // per-slot payload; only one of f/i used depending on slot type
+  std::vector<std::vector<float>> f;
+  std::vector<std::vector<int64_t>> i;
+};
+
+struct Feed {
+  std::vector<int32_t> slot_types;  // 0=float32 1=int64
+  int64_t batch_size;
+  int64_t chan;                     // ptq channel handle of serialized batches
+  std::vector<std::string> files;
+  std::vector<std::thread> threads;
+  std::atomic<int64_t> active{0};
+  std::atomic<int64_t> next_file{0};
+  std::atomic<int64_t> n_examples{0};
+  bool started = false;
+};
+
+std::mutex g_mu;
+std::unordered_map<int64_t, Feed*> g_feeds;
+std::atomic<int64_t> g_next{1};
+
+Feed* Get(int64_t h) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_feeds.find(h);
+  return it == g_feeds.end() ? nullptr : it->second;
+}
+
+// Parse one line into an example. Returns false on malformed input
+// (wrong slot count / non-numeric field) — the caller skips the line,
+// matching the reference's tolerant CheckFile behavior.
+bool ParseLine(const char* line, size_t line_len,
+               const std::vector<int32_t>& types, Example* ex) {
+  const char* p = line;
+  char* end = nullptr;
+  ex->f.assign(types.size(), {});
+  ex->i.assign(types.size(), {});
+  // a value needs >= 2 chars ("1 "), so any honest count is < line_len;
+  // this bound keeps a corrupt count from aborting on reserve()
+  const long long max_vals = (long long)line_len;
+  for (size_t s = 0; s < types.size(); ++s) {
+    long long n = strtoll(p, &end, 10);
+    if (end == p || n < 0 || n > max_vals) return false;
+    p = end;
+    if (types[s] == 0) {
+      auto& v = ex->f[s];
+      v.reserve(n);
+      for (long long k = 0; k < n; ++k) {
+        float x = strtof(p, &end);
+        if (end == p) return false;
+        p = end;
+        v.push_back(x);
+      }
+    } else {
+      auto& v = ex->i[s];
+      v.reserve(n);
+      for (long long k = 0; k < n; ++k) {
+        long long x = strtoll(p, &end, 10);
+        if (end == p) return false;
+        p = end;
+        v.push_back((int64_t)x);
+      }
+    }
+  }
+  return true;
+}
+
+void AppendI64(std::vector<uint8_t>* out, int64_t v) {
+  const uint8_t* p = (const uint8_t*)&v;
+  out->insert(out->end(), p, p + 8);
+}
+
+void AppendI32(std::vector<uint8_t>* out, int32_t v) {
+  const uint8_t* p = (const uint8_t*)&v;
+  out->insert(out->end(), p, p + 4);
+}
+
+// Wire format documented in capi.h: n_slots, then per slot
+// type / lod offsets / flat values.
+void SerializeBatch(const std::vector<Example>& batch,
+                    const std::vector<int32_t>& types,
+                    std::vector<uint8_t>* out) {
+  out->clear();
+  AppendI64(out, (int64_t)types.size());
+  for (size_t s = 0; s < types.size(); ++s) {
+    AppendI32(out, types[s]);
+    std::vector<int64_t> lod{0};
+    int64_t total = 0;
+    for (auto& ex : batch) {
+      total += types[s] == 0 ? (int64_t)ex.f[s].size()
+                             : (int64_t)ex.i[s].size();
+      lod.push_back(total);
+    }
+    AppendI64(out, (int64_t)lod.size());
+    for (int64_t o : lod) AppendI64(out, o);
+    AppendI64(out, total);
+    if (types[s] == 0) {
+      for (auto& ex : batch) {
+        const uint8_t* p = (const uint8_t*)ex.f[s].data();
+        out->insert(out->end(), p, p + ex.f[s].size() * sizeof(float));
+      }
+    } else {
+      for (auto& ex : batch) {
+        const uint8_t* p = (const uint8_t*)ex.i[s].data();
+        out->insert(out->end(), p, p + ex.i[s].size() * sizeof(int64_t));
+      }
+    }
+  }
+}
+
+void EmitBatches(Feed* f, std::vector<Example>* buf, bool flush,
+                 std::vector<uint8_t>* scratch) {
+  size_t i = 0;
+  while (buf->size() - i >= (size_t)f->batch_size ||
+         (flush && i < buf->size())) {
+    size_t n = std::min((size_t)f->batch_size, buf->size() - i);
+    std::vector<Example> batch(buf->begin() + i, buf->begin() + i + n);
+    i += n;
+    SerializeBatch(batch, f->slot_types, scratch);
+    ptq_chan_push(f->chan, scratch->data(), (int64_t)scratch->size(), -1);
+  }
+  buf->erase(buf->begin(), buf->begin() + i);
+}
+
+void ParserThread(Feed* f, int32_t shuffle, uint64_t seed, int64_t buf_size,
+                  int tid) {
+  std::mt19937_64 rng(seed + (uint64_t)tid * 0x9E3779B97F4A7C15ULL);
+  std::vector<Example> buf;
+  std::vector<uint8_t> scratch;
+  char* line = nullptr;
+  size_t cap = 0;
+  for (;;) {
+    int64_t fi = f->next_file.fetch_add(1);
+    if (fi >= (int64_t)f->files.size()) break;
+    FILE* fp = fopen(f->files[fi].c_str(), "r");
+    if (!fp) continue;
+    ssize_t got;
+    while ((got = getline(&line, &cap, fp)) != -1) {
+      if (got <= 1) continue;
+      Example ex;
+      if (!ParseLine(line, (size_t)got, f->slot_types, &ex)) continue;
+      f->n_examples.fetch_add(1);
+      buf.push_back(std::move(ex));
+      if ((int64_t)buf.size() >= (shuffle ? buf_size : f->batch_size)) {
+        if (shuffle) std::shuffle(buf.begin(), buf.end(), rng);
+        EmitBatches(f, &buf, /*flush=*/false, &scratch);
+      }
+    }
+    fclose(fp);
+  }
+  if (shuffle) std::shuffle(buf.begin(), buf.end(), rng);
+  EmitBatches(f, &buf, /*flush=*/true, &scratch);
+  free(line);
+  // last parser out closes the channel so consumers see end-of-data
+  if (f->active.fetch_sub(1) == 1) ptq_chan_close(f->chan);
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t ptq_feed_create(int32_t n_slots, const int32_t* slot_types,
+                        int64_t batch_size, int64_t queue_capacity) {
+  if (n_slots <= 0 || batch_size <= 0) return -1;
+  Feed* f = new Feed();
+  f->slot_types.assign(slot_types, slot_types + n_slots);
+  f->batch_size = batch_size;
+  f->chan = ptq_chan_create(queue_capacity < 2 ? 2 : queue_capacity);
+  int64_t id = g_next.fetch_add(1);
+  std::lock_guard<std::mutex> g(g_mu);
+  g_feeds[id] = f;
+  return id;
+}
+
+int ptq_feed_set_files(int64_t h, const char* paths_nl_joined) {
+  Feed* f = Get(h);
+  if (!f || f->started) return PTQ_ERR;
+  f->files.clear();
+  std::string s(paths_nl_joined ? paths_nl_joined : "");
+  size_t pos = 0;
+  while (pos < s.size()) {
+    size_t nl = s.find('\n', pos);
+    if (nl == std::string::npos) nl = s.size();
+    if (nl > pos) f->files.push_back(s.substr(pos, nl - pos));
+    pos = nl + 1;
+  }
+  return PTQ_OK;
+}
+
+int ptq_feed_start(int64_t h, int32_t n_threads, int32_t shuffle,
+                   uint64_t seed, int64_t buffer_size) {
+  Feed* f = Get(h);
+  if (!f || f->started || f->files.empty()) return PTQ_ERR;
+  f->started = true;
+  if (n_threads < 1) n_threads = 1;
+  if (buffer_size < f->batch_size) buffer_size = f->batch_size * 16;
+  f->active.store(n_threads);
+  for (int t = 0; t < n_threads; ++t)
+    f->threads.emplace_back(ParserThread, f, shuffle, seed, buffer_size, t);
+  return PTQ_OK;
+}
+
+int ptq_feed_next(int64_t h, uint8_t** out, int64_t* out_len,
+                  int64_t timeout_ms) {
+  Feed* f = Get(h);
+  if (!f) return PTQ_ERR;
+  return ptq_chan_pop(f->chan, out, out_len, timeout_ms);
+}
+
+int64_t ptq_feed_examples(int64_t h) {
+  Feed* f = Get(h);
+  return f ? f->n_examples.load() : -1;
+}
+
+void ptq_feed_join(int64_t h) {
+  Feed* f = Get(h);
+  if (!f) return;
+  for (auto& t : f->threads)
+    if (t.joinable()) t.join();
+  f->threads.clear();
+}
+
+void ptq_feed_destroy(int64_t h) {
+  Feed* f = nullptr;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_feeds.find(h);
+    if (it != g_feeds.end()) {
+      f = it->second;
+      g_feeds.erase(it);
+    }
+  }
+  if (!f) return;
+  ptq_chan_close(f->chan);
+  for (auto& t : f->threads)
+    if (t.joinable()) t.join();
+  ptq_chan_destroy(f->chan);
+  delete f;
+}
+
+}  // extern "C"

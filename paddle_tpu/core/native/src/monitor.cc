@@ -1,0 +1,65 @@
+// Global named-counter registry.
+//
+// TPU-native counterpart of the reference's runtime stat registry
+// (paddle/fluid/platform/monitor.h STAT_ADD / StatRegistry): cheap
+// process-wide counters (bytes fed, batches produced, cache hits...)
+// readable from python for observability without a profiler session.
+#include "capi.h"
+
+#include <atomic>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+
+namespace {
+
+std::mutex g_mu;
+// std::map keeps names sorted for stable ptq_stat_names output
+std::map<std::string, std::atomic<int64_t>*> g_stats;
+
+std::atomic<int64_t>* GetOrCreate(const char* name) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_stats.find(name);
+  if (it != g_stats.end()) return it->second;
+  auto* v = new std::atomic<int64_t>(0);
+  g_stats[name] = v;
+  return v;
+}
+
+}  // namespace
+
+extern "C" {
+
+void ptq_stat_add(const char* name, int64_t delta) {
+  GetOrCreate(name)->fetch_add(delta);
+}
+
+int64_t ptq_stat_get(const char* name) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_stats.find(name);
+  return it == g_stats.end() ? 0 : it->second->load();
+}
+
+void ptq_stat_reset(const char* name) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_stats.find(name);
+  if (it != g_stats.end()) it->second->store(0);
+}
+
+int64_t ptq_stat_names(char* buf, int64_t cap) {
+  std::lock_guard<std::mutex> g(g_mu);
+  std::string all;
+  for (auto& kv : g_stats) {
+    if (!all.empty()) all += '\n';
+    all += kv.first;
+  }
+  if (buf && cap > 0) {
+    int64_t n = (int64_t)all.size() < cap - 1 ? (int64_t)all.size() : cap - 1;
+    memcpy(buf, all.data(), (size_t)n);
+    buf[n] = '\0';
+  }
+  return (int64_t)all.size();
+}
+
+}  // extern "C"

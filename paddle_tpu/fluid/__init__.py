@@ -39,7 +39,9 @@ from .io import (  # noqa: F401
     save_inference_model, load_inference_model,
 )
 from . import reader  # noqa: F401
-from .reader import DataLoader  # noqa: F401
+from .reader import DataLoader, BatchSampler  # noqa: F401
+from . import dataset  # noqa: F401
+from .dataset import DatasetFactory, InMemoryDataset, QueueDataset  # noqa: F401
 from . import metrics  # noqa: F401
 from . import profiler  # noqa: F401
 from .data_feeder import DataFeeder  # noqa: F401

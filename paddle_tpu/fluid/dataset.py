@@ -1,0 +1,257 @@
+"""Dataset API over the C++ native data feed.
+
+Reference: `python/paddle/fluid/dataset.py` (DatasetFactory,
+InMemoryDataset, QueueDataset) driving the C++ MultiSlotDataFeed
+(`framework/data_feed.cc:639`) and Dataset shuffle (`data_set.h:111`).
+
+TPU-native: parsing/shuffle/batching run in C++ threads
+(paddle_tpu.core.native.MultiSlotDataFeed); batches surface as numpy
+arrays which the executor device_puts — XLA overlaps the transfer with
+compute. Variable-length slots are padded dense + a `<name>.lod` offsets
+array (LoD kept as host metadata; see SURVEY.md §7 hard part (a)).
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..core import native
+
+
+class DatasetFactory:
+    """Reference: dataset.py DatasetFactory.create_dataset."""
+
+    def create_dataset(self, datafeed_class: str = "QueueDataset"):
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        if datafeed_class == "QueueDataset":
+            return QueueDataset()
+        raise ValueError("unknown dataset class %r" % datafeed_class)
+
+
+class DatasetBase:
+    def __init__(self):
+        self._batch_size = 1
+        self._thread = 1
+        self._filelist: List[str] = []
+        self._use_vars = []
+        self._shuffle_seed = 0
+        self._pipe_command = None
+        self._queue_capacity = 16
+
+    # -- configuration (reference dataset.py setters) ----------------------
+    def set_batch_size(self, batch_size: int):
+        self._batch_size = int(batch_size)
+
+    def set_thread(self, thread_num: int):
+        self._thread = max(1, int(thread_num))
+
+    def set_filelist(self, filelist: Sequence[str]):
+        self._filelist = list(filelist)
+
+    def set_use_var(self, var_list):
+        """Declares the slot order: one var per slot, dtype float32/int64."""
+        self._use_vars = list(var_list)
+
+    def set_pipe_command(self, pipe_command: str):
+        """Each input file is piped through this shell command before
+        MultiSlot parsing (reference: data_feed.proto pipe_command,
+        applied per-file in DataFeed). Applied in _make_feed."""
+        self._pipe_command = pipe_command
+
+    def set_queue_num(self, queue_num: int):
+        self._queue_capacity = max(2, int(queue_num))
+
+    # -- derived -----------------------------------------------------------
+    def _slot_types(self) -> List[str]:
+        types = []
+        for v in self._use_vars:
+            dt = str(getattr(v, "dtype", "float32"))
+            types.append("int64" if "int" in dt else "float32")
+        return types
+
+    def _effective_filelist(self) -> List[str]:
+        """Applies pipe_command (if set) by piping each file through the
+        shell command into temp files handed to the native parser. Piped
+        files are cached (one run per source file, reused across epochs)
+        and unlinked when the dataset is dropped."""
+        if not self._pipe_command:
+            return self._filelist
+        import subprocess
+        import tempfile
+
+        key = (self._pipe_command, tuple(self._filelist))
+        if getattr(self, "_piped_key", None) == key:
+            return self._piped_files
+        self._cleanup_piped()
+        out_files = []
+        for path in self._filelist:
+            tmp = tempfile.NamedTemporaryFile(
+                mode="wb", suffix=".multislot", delete=False)
+            try:
+                with open(path, "rb") as fin:
+                    subprocess.run(self._pipe_command, shell=True,
+                                   stdin=fin, stdout=tmp, check=True)
+            except BaseException:
+                tmp.close()
+                os.unlink(tmp.name)
+                for f in out_files:
+                    os.unlink(f)
+                raise
+            tmp.close()
+            out_files.append(tmp.name)
+        self._piped_files = out_files
+        self._piped_key = key
+        return out_files
+
+    def _cleanup_piped(self):
+        for f in getattr(self, "_piped_files", []):
+            try:
+                os.unlink(f)
+            except OSError:
+                pass
+        self._piped_files = []
+        self._piped_key = None
+
+    def __del__(self):
+        try:
+            self._cleanup_piped()
+        except Exception:
+            pass
+
+    def _make_feed(self) -> native.MultiSlotDataFeed:
+        if not self._use_vars:
+            raise ValueError("set_use_var must be called before use")
+        if not self._filelist:
+            raise ValueError("set_filelist must be called before use")
+        feed = native.MultiSlotDataFeed(self._slot_types(),
+                                        self._batch_size,
+                                        self._queue_capacity)
+        feed.set_filelist(self._effective_filelist())
+        return feed
+
+    def _batches_from_feed(self, feed: native.MultiSlotDataFeed,
+                           shuffle: bool):
+        feed.start(n_threads=self._thread, shuffle=shuffle,
+                   seed=self._shuffle_seed)
+        for slots in feed:
+            yield self._decode_batch(
+                [(vals, lod) for vals, lod in slots])
+        feed.join()
+
+    def _decode_batch(self, slots):
+        """Slot arrays -> feed dict. The output schema is keyed on the
+        DECLARED var (lod_level), not per-batch data, so every batch of a
+        lod slot carries `<name>.lod` even when lengths align."""
+        out = {}
+        for v, (vals, lod) in zip(self._use_vars, slots):
+            name = v.name
+            shape = tuple(getattr(v, "shape", ()) or ())
+            lod_level = getattr(v, "lod_level", 0) or 0
+            n_examples = len(lod) - 1
+            counts = np.diff(lod)
+            if lod_level > 0:
+                # sequence slot -> pad with 0, expose offsets as .lod
+                width = int(counts.max()) if counts.size else 0
+                arr = np.zeros((n_examples, width), vals.dtype)
+                for i in range(n_examples):
+                    arr[i, :counts[i]] = vals[lod[i]:lod[i + 1]]
+                out[name + ".lod"] = np.asarray(lod)
+            else:
+                if counts.size and not (counts == counts[0]).all():
+                    raise ValueError(
+                        "slot %r has ragged lengths %s but var %s declares "
+                        "lod_level=0 — declare lod_level=1 for sequence "
+                        "slots" % (name, sorted(set(counts.tolist())), name))
+                arr = vals.reshape(n_examples, int(counts[0])
+                                   if counts.size else 0)
+                if arr.shape[1] == 1 and len(shape) <= 1:
+                    arr = arr[:, 0]
+            out[name] = arr
+        return out
+
+    def _iter_batches(self):
+        raise NotImplementedError
+
+
+class QueueDataset(DatasetBase):
+    """Streaming dataset: files are parsed on demand each epoch, no
+    global shuffle (reference: dataset.py QueueDataset)."""
+
+    def local_shuffle(self):
+        raise RuntimeError("QueueDataset does not support local_shuffle; "
+                           "use InMemoryDataset")
+
+    def global_shuffle(self, fleet=None):
+        raise RuntimeError("QueueDataset does not support global_shuffle; "
+                           "use InMemoryDataset")
+
+    def _iter_batches(self):
+        yield from self._batches_from_feed(self._make_feed(), shuffle=False)
+
+
+class InMemoryDataset(DatasetBase):
+    """Loads all examples into memory once; supports local/global shuffle
+    (reference: dataset.py InMemoryDataset, data_set.h:111)."""
+
+    def __init__(self):
+        super().__init__()
+        self._examples: Optional[list] = None
+        self._do_shuffle = False
+
+    def load_into_memory(self):
+        # materialize per-example records by draining the native feed with
+        # batch_size 1 semantics kept at batch level: store raw batches of
+        # size 1 example for exact reshuffling
+        feed = native.MultiSlotDataFeed(self._slot_types(), 1,
+                                        self._queue_capacity)
+        feed.set_filelist(self._effective_filelist())
+        feed.start(n_threads=self._thread, shuffle=False)
+        self._examples = list(feed)
+        feed.join()
+
+    def local_shuffle(self):
+        self._do_shuffle = True
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        # single-host: same as local shuffle; multi-host exchange is done
+        # by sharding the filelist per worker at set_filelist time
+        self._do_shuffle = True
+
+    def release_memory(self):
+        self._examples = None
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._examples) if getattr(self, "_examples", None) \
+            is not None else 0
+
+    def get_shuffle_data_size(self, fleet=None):
+        return self.get_memory_data_size(fleet)
+
+    def set_fleet_send_batch_size(self, fleet_send_batch_size=1024):
+        pass
+
+    def _iter_batches(self):
+        if getattr(self, "_examples", None) is None:
+            # not preloaded: stream like QueueDataset (with shuffle if set)
+            yield from self._batches_from_feed(self._make_feed(),
+                                               shuffle=self._do_shuffle)
+            return
+        order = np.arange(len(self._examples))
+        if self._do_shuffle:
+            rng = np.random.RandomState(self._shuffle_seed)
+            rng.shuffle(order)
+            self._shuffle_seed += 1
+        bs = self._batch_size
+        n_slots = len(self._use_vars)
+        for start in range(0, len(order), bs):
+            sel = order[start:start + bs]
+            slots = []
+            for s in range(n_slots):
+                vals_list = [self._examples[i][s][0] for i in sel]
+                counts = np.array([len(v) for v in vals_list])
+                lod = np.concatenate([[0], np.cumsum(counts)])
+                slots.append((np.concatenate(vals_list), lod))
+            yield self._decode_batch(slots)

@@ -101,7 +101,7 @@ class Variable:
 
     def __init__(self, block, name=None, shape=None, dtype="float32",
                  persistable=False, stop_gradient=False, is_data=False,
-                 trainable=True, type=None, **kwargs):
+                 trainable=True, type=None, lod_level=0, **kwargs):
         self.block = block
         self.name = name or unique_name("_generated_var")
         self.shape = tuple(shape) if shape is not None else ()
@@ -111,6 +111,9 @@ class Variable:
         self.is_data = is_data
         self.trainable = trainable
         self.type = type or "LOD_TENSOR"
+        # LoD (ragged-sequence) nesting depth; sequences are padded dense
+        # on TPU with offsets kept as host metadata (SURVEY.md §7 (a))
+        self.lod_level = lod_level
         self.op = None  # producing Operator (set by append_op)
 
     # -- info --------------------------------------------------------------

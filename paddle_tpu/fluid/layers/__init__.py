@@ -1,10 +1,15 @@
 """fluid.layers — op wrapper namespace (reference:
 `python/paddle/fluid/layers/`)."""
 from . import nn, tensor, loss, collective, math_op_patch  # noqa: F401
+from . import control_flow  # noqa: F401
 from . import learning_rate_scheduler  # noqa: F401
 from .nn import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
+from .control_flow import (  # noqa: F401
+    While, while_loop, cond, case, switch_case, increment,
+    less_than, less_equal, greater_than, greater_equal, equal, not_equal,
+)
 from .learning_rate_scheduler import (  # noqa: F401
     noam_decay, exponential_decay, natural_exp_decay, inverse_time_decay,
     polynomial_decay, piecewise_decay, cosine_decay, linear_lr_warmup,

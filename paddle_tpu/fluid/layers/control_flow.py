@@ -1,0 +1,283 @@
+"""Control-flow layers: While / while_loop / cond / case / switch_case.
+
+Reference: `python/paddle/fluid/layers/control_flow.py` (While:1020, cond,
+case, switch_case) over the C++ control-flow ops
+(`operators/controlflow/while_op.cc:42`,
+`operators/controlflow/conditional_block_op.cc`).
+
+TPU-native: sub-blocks lower to `lax.while_loop` / `lax.cond` /
+`lax.switch` with an explicit functional carry (SURVEY.md §7 hard part
+(b)): the reference's scope-mutation loop model becomes "carry = the
+sub-block's writes that pre-exist in the enclosing env". Loop-carried
+values must keep static shape/dtype across iterations — the XLA contract.
+Loop bodies run under the same op registry, so everything composes
+(collectives inside a while, AMP casts, etc.).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+from .. import framework
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+from . import tensor as tensor_layers
+
+
+def _flatten(x):
+    if isinstance(x, (list, tuple)):
+        out = []
+        for e in x:
+            out.extend(_flatten(e))
+        return out
+    return [x]
+
+
+def _pack_like(template, flat):
+    it = iter(flat)
+
+    def rec(t):
+        if isinstance(t, (list, tuple)):
+            return type(t)(rec(e) for e in t)
+        return next(it)
+
+    return rec(template)
+
+
+# ---------------------------------------------------------------------------
+# While (1.x context-manager form)
+# ---------------------------------------------------------------------------
+
+class While:
+    """``while cond_var:`` over a sub-block (reference:
+    control_flow.py While / while_op.cc:42).
+
+    All loop-carried vars must be created AND initialized before the loop;
+    writes inside the block to pre-existing vars are carried functionally.
+    """
+
+    def __init__(self, cond, is_test=False, name=None):
+        if not isinstance(cond, Variable):
+            raise TypeError("While cond must be a Variable")
+        self.cond_var = cond
+        self.helper = LayerHelper("while", name=name)
+        self._main = framework.default_main_program()
+
+    def block(self):
+        return _WhileGuard(self)
+
+
+class _WhileGuard:
+    def __init__(self, while_op: While):
+        self._w = while_op
+
+    def __enter__(self):
+        prog = self._w._main
+        self._sub = prog._create_block()
+        return self
+
+    def __exit__(self, exc_type, exc_val, tb):
+        prog = self._w._main
+        prog._rollback()
+        if exc_type is not None:
+            return False
+        parent = prog.current_block()
+        parent.append_op(
+            type="while",
+            inputs={"Condition": [self._w.cond_var]},
+            outputs={},
+            attrs={"sub_block": self._sub.idx,
+                   "cond_name": self._w.cond_var.name})
+        return True
+
+
+def while_loop(cond: Callable, body: Callable, loop_vars: Sequence,
+               is_test: bool = False, name: Optional[str] = None):
+    """Functional while (reference: control_flow.py while_loop): runs
+    ``body`` while ``cond(*loop_vars)`` holds; returns the final vars."""
+    loop_list = list(loop_vars)
+    pre_cond = cond(*loop_list)
+    w = While(pre_cond, is_test=is_test, name=name)
+    with w.block():
+        out = body(*loop_list)
+        out_list = out if isinstance(out, (list, tuple)) else [out]
+        flat_in = _flatten(loop_list)
+        flat_out = _flatten(list(out_list))
+        if len(flat_in) != len(flat_out):
+            raise ValueError(
+                "body returned %d vars, expected %d (the loop_vars "
+                "structure)" % (len(flat_out), len(flat_in)))
+        for lv, ov in zip(flat_in, flat_out):
+            if ov is not lv:
+                tensor_layers.assign(ov, output=lv)
+        new_cond = cond(*loop_list)
+        tensor_layers.assign(new_cond, output=pre_cond)
+    return loop_vars
+
+
+# ---------------------------------------------------------------------------
+# cond / case / switch_case
+# ---------------------------------------------------------------------------
+
+def _trace_branch(prog, fn, out_vars=None):
+    """Runs fn inside a fresh sub-block; assigns its returns onto out_vars
+    (created in the parent on the first branch). Returns (block_idx,
+    out_vars, template)."""
+    sub = prog._create_block()
+    try:
+        ret = fn() if fn is not None else None
+    except BaseException:
+        prog._rollback()
+        raise
+    flat = _flatten(ret) if ret is not None else []
+    if out_vars is None:
+        parent = prog.block(sub.parent_idx)
+        out_vars = []
+        for i, r in enumerate(flat):
+            if not isinstance(r, Variable):
+                r = tensor_layers.fill_constant([1], "float32", float(r))
+                flat[i] = r
+            out_vars.append(parent.create_var(
+                name=framework.unique_name("cond_out"),
+                shape=r.shape, dtype=r.dtype))
+    if len(flat) != len(out_vars):
+        prog._rollback()
+        raise ValueError("branches must return the same structure "
+                         "(%d vs %d leaves)" % (len(flat), len(out_vars)))
+    for r, ov in zip(flat, out_vars):
+        if not isinstance(r, Variable):
+            r = tensor_layers.fill_constant(ov.shape, ov.dtype, float(r))
+        tensor_layers.assign(r, output=ov)
+    prog._rollback()
+    return sub.idx, out_vars, ret
+
+
+def cond(pred, true_fn: Optional[Callable] = None,
+         false_fn: Optional[Callable] = None, name: Optional[str] = None):
+    """Two-way branch (reference: control_flow.py cond /
+    conditional_block_op.cc). Both branches must return the same
+    structure of vars with matching shapes/dtypes."""
+    prog = framework.default_main_program()
+    t_idx, out_vars, template = _trace_branch(prog, true_fn)
+    f_idx, _, _ = _trace_branch(prog, false_fn, out_vars)
+    parent = prog.current_block()
+    parent.append_op(
+        type="cond",
+        inputs={"Cond": [pred]},
+        outputs={"Out": list(out_vars)},
+        attrs={"sub_block_t": t_idx, "sub_block_f": f_idx,
+               "out_names": [v.name for v in out_vars],
+               "cond_name": pred.name})
+    if template is None:
+        return None
+    if isinstance(template, (list, tuple)):
+        return _pack_like(template, out_vars)
+    return out_vars[0]
+
+
+def switch_case(branch_index, branch_fns, default=None,
+                name: Optional[str] = None):
+    """N-way branch on an integer index (reference: control_flow.py
+    switch_case) -> lax.switch."""
+    prog = framework.default_main_program()
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = list(enumerate(branch_fns))
+    keys = [int(k) for k, _ in items]
+    fns = [f for _, f in items]
+    if default is None:
+        # promote the last branch to default (and drop it from the match
+        # list so it isn't traced twice)
+        default = fns.pop()
+        keys.pop()
+
+    out_vars = None
+    blocks = []
+    template = None
+    for f in fns:
+        idx, out_vars, tmpl = _trace_branch(prog, f, out_vars)
+        template = template if template is not None else tmpl
+        blocks.append(idx)
+    d_idx, out_vars, _ = _trace_branch(prog, default, out_vars)
+    blocks.append(d_idx)
+
+    parent = prog.current_block()
+    parent.append_op(
+        type="switch_case",
+        inputs={"Index": [branch_index]},
+        outputs={"Out": list(out_vars)},
+        attrs={"sub_blocks": blocks, "keys": keys,
+               "out_names": [v.name for v in out_vars],
+               "index_name": branch_index.name})
+    if isinstance(template, (list, tuple)):
+        return _pack_like(template, out_vars)
+    return out_vars[0]
+
+
+def case(pred_fn_pairs, default=None, name: Optional[str] = None):
+    """First-match-wins chain of (pred, fn) (reference: control_flow.py
+    case), built from nested cond."""
+    pairs = list(pred_fn_pairs)
+    if not pairs:
+        raise ValueError("pred_fn_pairs must be non-empty")
+    if default is None:
+        default = pairs[-1][1]
+        pairs = pairs[:-1]
+        if not pairs:
+            return default()
+
+    def build(i):
+        if i == len(pairs):
+            return default
+        pred, fn = pairs[i]
+        return lambda: cond(pred, fn, build(i + 1))
+
+    return build(0)()
+
+
+# ---------------------------------------------------------------------------
+# misc control-flow helpers the reference exposes alongside While
+# ---------------------------------------------------------------------------
+
+def increment(x, value=1.0, in_place=True):
+    """Reference: control_flow.py increment — x += value, in place by
+    rebinding the same var name."""
+    helper = LayerHelper("increment")
+    out = x if in_place else helper.create_variable_for_type_inference(
+        dtype=x.dtype)
+    helper.append_op(type="increment", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"step": float(value)})
+    return out
+
+
+def less_than(x, y, force_cpu=None, cond=None):
+    return _compare("less_than", x, y, cond)
+
+
+def less_equal(x, y, cond=None):
+    return _compare("less_equal", x, y, cond)
+
+
+def greater_than(x, y, cond=None):
+    return _compare("greater_than", x, y, cond)
+
+
+def greater_equal(x, y, cond=None):
+    return _compare("greater_equal", x, y, cond)
+
+
+def equal(x, y, cond=None):
+    return _compare("equal", x, y, cond)
+
+
+def not_equal(x, y, cond=None):
+    return _compare("not_equal", x, y, cond)
+
+
+def _compare(op_type, x, y, out):
+    helper = LayerHelper(op_type)
+    if out is None:
+        out = helper.create_variable_for_type_inference(dtype="bool")
+    helper.append_op(type=op_type, inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out

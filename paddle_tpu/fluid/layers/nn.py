@@ -20,7 +20,8 @@ __all__ = [
     "softplus", "softsign", "swish", "hard_sigmoid", "hard_swish", "prelu",
     "softmax", "log_softmax", "matmul", "mul", "elementwise_add",
     "elementwise_sub", "elementwise_mul", "elementwise_div",
-    "elementwise_max", "elementwise_min", "elementwise_pow", "reduce_sum",
+    "elementwise_max", "elementwise_min", "elementwise_pow",
+    "elementwise_mod", "elementwise_floordiv", "reduce_sum",
     "reduce_mean", "reduce_max", "reduce_min", "reduce_prod", "reduce_all",
     "reduce_any", "mean", "accuracy", "topk", "one_hot", "clip",
     "clip_by_norm", "l2_normalize", "label_smooth", "pad", "pad2d",
@@ -396,6 +397,8 @@ elementwise_div = _make_elementwise("elementwise_div")
 elementwise_max = _make_elementwise("elementwise_max")
 elementwise_min = _make_elementwise("elementwise_min")
 elementwise_pow = _make_elementwise("elementwise_pow")
+elementwise_mod = _make_elementwise("elementwise_mod")
+elementwise_floordiv = _make_elementwise("elementwise_floordiv")
 
 
 def maximum(x, y, name=None):

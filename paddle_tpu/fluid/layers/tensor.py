@@ -11,7 +11,8 @@ from ...core.types import normalize_dtype
 
 __all__ = [
     "data", "fill_constant", "fill_constant_batch_size_like", "cast",
-    "concat", "assign", "create_tensor", "create_global_var", "argmax",
+    "concat", "assign", "create_tensor", "create_parameter",
+    "create_global_var", "argmax",
     "argmin", "argsort", "zeros", "ones", "zeros_like", "ones_like",
     "reverse", "range", "linspace", "reshape", "transpose", "scale",
     "shape", "cumsum", "increment", "eye", "diag", "tril", "triu",
@@ -33,7 +34,8 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
     block = framework.default_main_program().current_block()
     return block.create_var(
         name=name, shape=shape, dtype=dtype, is_data=True,
-        stop_gradient=stop_gradient, persistable=False)
+        stop_gradient=stop_gradient, persistable=False,
+        lod_level=lod_level)
 
 
 def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
@@ -106,6 +108,20 @@ def create_tensor(dtype, name=None, persistable=False):
     block = framework.default_main_program().current_block()
     return block.create_var(name=name, dtype=dtype, persistable=persistable,
                             shape=())
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Reference: layers/tensor.py create_parameter."""
+    from ..param_attr import ParamAttr
+
+    helper = LayerHelper("create_parameter")
+    if attr is None:
+        attr = ParamAttr(name=name)
+    elif name is not None and attr.name is None:
+        attr.name = name
+    return helper.create_parameter(attr, list(shape), dtype, is_bias,
+                                   default_initializer)
 
 
 def create_global_var(shape, value, dtype, persistable=False,

@@ -63,17 +63,53 @@ class LoweredFunction:
         self.dp_axis = dp_axis
 
 
+def _sub_block_idxs(op):
+    idxs = []
+    for a in ("sub_block", "sub_block_t", "sub_block_f"):
+        if a in op.attrs:
+            idxs.append(op.attrs[a])
+    idxs.extend(op.attrs.get("sub_blocks", []))
+    return idxs
+
+
+def _op_reads_writes(op):
+    """(reads, writes) of an op, looking through control-flow sub-blocks
+    (a var read only inside a while body is still block-level state).
+    Sub-block writes to persistable vars also count as reads: the carry
+    needs their incoming value so the functional loop can thread them."""
+    reads = list(op.input_arg_names)
+    writes = list(op.output_arg_names)
+    prog = op.block.program
+    for bi in _sub_block_idxs(op):
+        blk = prog.block(bi)
+        produced_local = set()
+        for sop in blk.ops:
+            sr, sw = _op_reads_writes(sop)
+            for n in sr:
+                if n not in produced_local:
+                    reads.append(n)
+            for n in sw:
+                v = blk._find_var_recursive(n)
+                if v is not None and v.persistable \
+                        and n not in produced_local:
+                    reads.append(n)
+                produced_local.add(n)
+                writes.append(n)
+    return reads, writes
+
+
 def analyze_block(block, feed_names, fetch_names):
     """Dataflow analysis: which names are scope state in/out."""
     produced = set(feed_names)
     state_in: List[str] = []
     state_in_set = set()
     for op in block.ops:
-        for name in op.input_arg_names:
+        op_reads, op_writes = _op_reads_writes(op)
+        for name in op_reads:
             if name not in produced and name not in state_in_set:
                 state_in.append(name)
                 state_in_set.add(name)
-        for name in op.output_arg_names:
+        for name in op_writes:
             produced.add(name)
     for name in fetch_names:
         if name not in produced and name not in state_in_set:
@@ -85,7 +121,7 @@ def analyze_block(block, feed_names, fetch_names):
     state_out: List[str] = []
     seen = set()
     for op in block.ops:
-        for name in op.output_arg_names:
+        for name in _op_reads_writes(op)[1]:
             if name in seen:
                 continue
             persistable = False
@@ -105,6 +141,12 @@ def _exec_op(op, env, key0, op_idx, amp_lists=None):
     t = op.type
     if t in _SKIP_OPS:
         return
+    if t == "while":
+        return _exec_while(op, env, key0, op_idx, amp_lists)
+    if t == "cond":
+        return _exec_cond(op, env, key0, op_idx, amp_lists)
+    if t == "switch_case":
+        return _exec_switch_case(op, env, key0, op_idx, amp_lists)
     opdef = ops_lib.get_op(t)
     ins = {}
     for slot, names in op.input_names.items():
@@ -141,6 +183,124 @@ def _exec_op(op, env, key0, op_idx, amp_lists=None):
 def _run_ops(ops, env, key0, base_idx=0, amp_lists=None):
     for i, op in enumerate(ops):
         _exec_op(op, env, key0, base_idx + i, amp_lists=amp_lists)
+
+
+# -- control-flow lowering (reference: operators/controlflow/while_op.cc:42,
+# conditional_block_op.cc -> lax.while_loop / lax.cond / lax.switch;
+# SURVEY.md §7 hard part (b): scope mutation becomes an explicit carry) --
+
+def _sub_block_carry(sub_block, env):
+    """Loop carry = sub-block writes that pre-exist in the enclosing env
+    (paddle requires loop vars be created+initialized before the While).
+    Includes writes made in NESTED control flow (a cond inside the while
+    body assigning a loop var). Writes to loop-local temps are not
+    carried."""
+    carry, seen = [], set()
+    for sop in sub_block.ops:
+        for n in _op_reads_writes(sop)[1]:
+            if n in env and n not in seen:
+                carry.append(n)
+                seen.add(n)
+    return carry
+
+
+def _exec_while(op, env, key0, op_idx, amp_lists):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    prog = op.block.program
+    sub = prog.block(op.attrs["sub_block"])
+    cond_name = op.attrs["cond_name"]
+    carry_names = _sub_block_carry(sub, env)
+    if cond_name not in carry_names:
+        raise RuntimeError(
+            "while: the loop body never rebinds condition var %r — the "
+            "loop would not terminate" % cond_name)
+    base_key = jax.random.fold_in(key0, op_idx)
+    cond_pos = carry_names.index(cond_name)
+
+    def cond_f(carry):
+        return jnp.all(carry[1 + cond_pos])
+
+    def body_f(carry):
+        it = carry[0]
+        e = dict(env)
+        e.update(zip(carry_names, carry[1:]))
+        # per-iteration rng so dropout etc. differs across iterations
+        _run_ops(sub.ops, e, jax.random.fold_in(base_key, it),
+                 amp_lists=amp_lists)
+        return (it + 1,) + tuple(e[n] for n in carry_names)
+
+    init = (jnp.int32(0),) + tuple(env[n] for n in carry_names)
+    final = lax.while_loop(cond_f, body_f, init)
+    env.update(zip(carry_names, final[1:]))
+
+
+def _branch_out_names(op, env, blocks):
+    """Names a branch op must return: its declared outputs PLUS any writes
+    (incl. nested) to vars that pre-exist in env — so a branch assigning
+    an outer var (e.g. a loop var from an enclosing While) propagates.
+    Branches that don't write a given name return env's value unchanged,
+    keeping lax.cond/switch branch signatures identical."""
+    names = list(op.attrs["out_names"])
+    seen = set(names)
+    for blk in blocks:
+        for sop in blk.ops:
+            for n in _op_reads_writes(sop)[1]:
+                if n in env and n not in seen:
+                    names.append(n)
+                    seen.add(n)
+    return names
+
+
+def _branch_fn(block, env, key, out_names, amp_lists):
+    def f(_):
+        e = dict(env)
+        _run_ops(block.ops, e, key, amp_lists=amp_lists)
+        return tuple(e[n] for n in out_names)
+
+    return f
+
+
+def _exec_cond(op, env, key0, op_idx, amp_lists):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    prog = op.block.program
+    blk_t = prog.block(op.attrs["sub_block_t"])
+    blk_f = prog.block(op.attrs["sub_block_f"])
+    out_names = _branch_out_names(op, env, [blk_t, blk_f])
+    pred = jnp.all(env[op.attrs["cond_name"]])
+    key = jax.random.fold_in(key0, op_idx)
+    outs = lax.cond(
+        pred,
+        _branch_fn(blk_t, env, key, out_names, amp_lists),
+        _branch_fn(blk_f, env, key, out_names, amp_lists),
+        None)
+    env.update(zip(out_names, outs))
+
+
+def _exec_switch_case(op, env, key0, op_idx, amp_lists):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    prog = op.block.program
+    keys = op.attrs["keys"]
+    blocks = [prog.block(b) for b in op.attrs["sub_blocks"]]  # default last
+    out_names = _branch_out_names(op, env, blocks)
+    key = jax.random.fold_in(key0, op_idx)
+    idx_val = jnp.reshape(env[op.attrs["index_name"]], ()).astype(jnp.int32)
+    # map the user's branch keys to positions; no match -> default (last)
+    sel = jnp.full((), len(blocks) - 1, jnp.int32)
+    for pos, k in enumerate(keys):
+        sel = jnp.where(idx_val == k, jnp.int32(pos), sel)
+    fns = [_branch_fn(blk, env, key, out_names, amp_lists)
+           for blk in blocks]
+    outs = lax.switch(sel, fns, None)
+    env.update(zip(out_names, outs))
 
 
 def _diffable(block, name, env):

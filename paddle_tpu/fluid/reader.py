@@ -1,14 +1,17 @@
 """Data pipeline (reference: `python/paddle/fluid/reader.py:113-954` —
-DataLoader.from_generator feeding a C++ blocking queue, multiprocess
-dataloader in dataloader/).
+DataLoader.from_generator feeding a C++ blocking queue; multiprocess
+dataloader in `fluid/dataloader/dataloader_iter.py`).
 
 TPU-native: the bottleneck to hide is host->HBM transfer; DataLoader
-prefetches batches on a background thread and (optionally) device_puts
-ahead of consumption — the analogue of the double-buffered
-`operators/reader/buffered_reader.cc`.
+prefetches batches through the C++ native blocking channel
+(paddle_tpu.core.native.NativeChannel — the analogue of the reference's
+lod_tensor_blocking_queue) on a background thread, and map-style loading
+fans out to multiprocess workers like the reference's _DataLoaderIter.
 """
 from __future__ import annotations
 
+import itertools
+import multiprocessing as mp
 import queue as _queue
 import threading
 from typing import Callable, List, Optional
@@ -16,9 +19,50 @@ from typing import Callable, List, Optional
 import numpy as np
 
 
+class _ReaderError:
+    """Wraps an exception raised in the producer thread so the consumer
+    re-raises it instead of seeing a silently truncated epoch."""
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
+def _default_collate(samples):
+    first = samples[0]
+    if isinstance(first, (list, tuple)):
+        return [np.stack([np.asarray(s[i]) for s in samples])
+                for i in range(len(first))]
+    return np.stack([np.asarray(s) for s in samples])
+
+
 class DataLoaderBase:
     def __iter__(self):
         raise NotImplementedError
+
+
+class _PrefetchQueue:
+    """Bounded blocking handoff between the producer thread and the
+    consumer. Same-process, so items pass by reference through a python
+    queue — the C++ NativeChannel is reserved for paths that cross a
+    language/process boundary (the native MultiSlotDataFeed uses it
+    internally), where its byte-buffer semantics pay for themselves."""
+
+    def __init__(self, capacity: int):
+        self._q = _queue.Queue(maxsize=capacity)
+        self._stop = object()
+
+    def push(self, item):
+        self._q.put(item)
+
+    def close(self):
+        self._q.put(self._stop)
+
+    def __iter__(self):
+        while True:
+            item = self._q.get()
+            if item is self._stop:
+                return
+            yield item
 
 
 class _GeneratorLoader(DataLoaderBase):
@@ -72,24 +116,25 @@ class _GeneratorLoader(DataLoaderBase):
     def __iter__(self):
         if self._batch_reader is None:
             raise RuntimeError("DataLoader: no generator set")
-        q: _queue.Queue = _queue.Queue(maxsize=self._capacity)
-        stop = object()
+        q = _PrefetchQueue(self._capacity)
 
         def produce():
             try:
                 for batch in self._batch_reader():
-                    q.put(batch)
+                    q.push(batch)
+            except BaseException as e:  # surface reader errors downstream
+                q.push(_ReaderError(e))
             finally:
-                q.put(stop)
+                q.close()
 
         t = threading.Thread(target=produce, daemon=True)
         t.start()
 
         feed_names = [getattr(v, "name", v) for v in self._feed_list]
-        while True:
-            item = q.get()
-            if item is stop:
-                break
+        for item in q:
+            if isinstance(item, _ReaderError):
+                raise RuntimeError(
+                    "DataLoader generator raised") from item.exc
             if isinstance(item, dict):
                 yield item
             elif feed_names and not self._return_list:
@@ -102,6 +147,26 @@ class _GeneratorLoader(DataLoaderBase):
 
     def reset(self):
         pass
+
+
+def _worker_loop(dataset, collate_fn, index_queue, result_queue,
+                 worker_init_fn, worker_id):
+    """Runs in a child process: pull index batches, push collated arrays
+    (reference: dataloader/dataloader_iter.py _worker_loop)."""
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    collate = collate_fn or _default_collate
+    while True:
+        job = index_queue.get()
+        if job is None:
+            break
+        batch_idx, indices = job
+        try:
+            samples = [dataset[int(i)] for i in indices]
+            result_queue.put((batch_idx, collate(samples), None))
+        except Exception as e:  # surface worker errors to the parent
+            result_queue.put((batch_idx, None, repr(e)))
+    result_queue.put((None, worker_id, None))  # worker-done marker
 
 
 class DataLoader:
@@ -124,40 +189,166 @@ class DataLoader:
         # map-style dataset loader (2.0 API)
         self._dataset = dataset
         self._batch_size = batch_size
+        self._batch_sampler = batch_sampler
         self._shuffle = shuffle
         self._drop_last = drop_last
         self._return_list = return_list
         self._feed_list = feed_list or []
         self._collate = collate_fn
+        self._num_workers = max(0, int(num_workers))
+        self._timeout = timeout
+        self._worker_init_fn = worker_init_fn
 
-    def __iter__(self):
+    def _batches(self):
+        if self._batch_sampler is not None:
+            yield from self._batch_sampler
+            return
         n = len(self._dataset)
         idx = np.arange(n)
         if self._shuffle:
             np.random.shuffle(idx)
-        batches = []
         for i in range(0, n, self._batch_size):
             sel = idx[i:i + self._batch_size]
             if len(sel) < self._batch_size and self._drop_last:
                 continue
-            batches.append(sel)
-        for sel in batches:
-            samples = [self._dataset[int(j)] for j in sel]
-            if self._collate:
-                yield self._collate(samples)
-                continue
-            first = samples[0]
-            if isinstance(first, (list, tuple)):
-                yield [np.stack([np.asarray(s[i]) for s in samples])
-                       for i in range(len(first))]
-            else:
-                yield np.stack([np.asarray(s) for s in samples])
+            yield sel
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            collate = self._collate or _default_collate
+            for sel in self._batches():
+                yield collate([self._dataset[int(j)] for j in sel])
+            return
+        yield from self._iter_multiprocess()
+
+    def _iter_multiprocess(self):
+        """Fan out to worker processes; results are reordered so batch
+        order matches the single-process loader."""
+        ctx = mp.get_context("fork")
+        n_workers = self._num_workers
+        index_queues = [ctx.Queue() for _ in range(n_workers)]
+        result_queue = ctx.Queue()
+        workers = [
+            ctx.Process(target=_worker_loop,
+                        args=(self._dataset, self._collate, index_queues[w],
+                              result_queue, self._worker_init_fn, w),
+                        daemon=True)
+            for w in range(n_workers)
+        ]
+        for w in workers:
+            w.start()
+        try:
+            # bounded dispatch: at most prefetch_window index batches are
+            # outstanding, so results (and the reorder buffer) stay
+            # O(window) rather than O(epoch) when the consumer is slower
+            # than the workers (reference: _DataLoaderIter prefetch depth)
+            prefetch_window = 2 * n_workers
+            batch_iter = enumerate(self._batches())
+            sent = 0
+            exhausted = False
+
+            def dispatch_one():
+                nonlocal sent, exhausted
+                if exhausted:
+                    return
+                try:
+                    batch_idx, sel = next(batch_iter)
+                except StopIteration:
+                    exhausted = True
+                    for q in index_queues:
+                        q.put(None)
+                    return
+                index_queues[batch_idx % n_workers].put(
+                    (batch_idx, [int(i) for i in sel]))
+                sent += 1
+
+            for _ in range(prefetch_window):
+                dispatch_one()
+
+            reorder = {}
+            next_idx = 0
+            done_ids = set()
+            timeout = self._timeout if self._timeout else None
+            while not (exhausted and next_idx >= sent):
+                if next_idx in reorder:
+                    yield reorder.pop(next_idx)
+                    next_idx += 1
+                    dispatch_one()
+                    continue
+                try:
+                    batch_idx, data, err = result_queue.get(
+                        timeout=timeout or 5.0)
+                except _queue.Empty:
+                    if timeout:
+                        raise RuntimeError(
+                            "DataLoader timed out after %ss" % timeout)
+                    dead = [w.pid for wid, w in enumerate(workers)
+                            if wid not in done_ids and not w.is_alive()]
+                    if dead:
+                        raise RuntimeError(
+                            "DataLoader worker(s) %s died unexpectedly "
+                            "(killed / crashed) before finishing" % dead)
+                    continue
+                if batch_idx is None:
+                    done_ids.add(data)  # data slot carries the worker id
+                    if len(done_ids) == n_workers and next_idx < sent \
+                            and not reorder:
+                        raise RuntimeError("DataLoader workers exited "
+                                           "before producing all batches")
+                    continue
+                if err is not None:
+                    raise RuntimeError("DataLoader worker failed: " + err)
+                reorder[batch_idx] = data
+        finally:
+            for w in workers:
+                if w.is_alive():
+                    w.terminate()
+            for w in workers:
+                w.join()
 
     def __len__(self):
+        if self._batch_sampler is not None:
+            return len(self._batch_sampler)
         n = len(self._dataset)
         if self._drop_last:
             return n // self._batch_size
         return (n + self._batch_size - 1) // self._batch_size
+
+
+class BatchSampler:
+    """Reference: fluid/dataloader/batch_sampler.py BatchSampler."""
+
+    def __init__(self, dataset=None, sampler=None, shuffle=False,
+                 batch_size=1, drop_last=False):
+        self._n = len(dataset) if dataset is not None else None
+        # materialize once: a generator sampler must survive repeated
+        # __len__/__iter__ calls
+        self._indices = list(sampler) if sampler is not None else None
+        self._shuffle = shuffle
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+
+    def __iter__(self):
+        if self._indices is not None:
+            idx = self._indices
+        else:
+            idx = np.arange(self._n)
+            if self._shuffle:
+                np.random.shuffle(idx)
+        batch = []
+        for i in idx:
+            batch.append(int(i))
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = self._n if self._indices is None else len(self._indices)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
 
 
 class PyReader(_GeneratorLoader):

@@ -1,0 +1,206 @@
+"""Control-flow op tests (reference test model: test_while_op.py,
+test_cond.py, test_switch_case.py in fluid/tests/unittests)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework
+from paddle_tpu.fluid import layers
+
+
+def _fresh():
+    main, startup = framework.Program(), framework.Program()
+    return main, startup
+
+
+def test_while_op_accumulates():
+    main, startup = _fresh()
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            i = layers.fill_constant([1], "int64", 0)
+            ten = layers.fill_constant([1], "int64", 10)
+            acc = layers.fill_constant([1], "float32", 0.0)
+            cond_var = layers.less_than(i, ten)
+            w = layers.While(cond_var)
+            with w.block():
+                acc2 = layers.elementwise_add(
+                    acc, layers.fill_constant([1], "float32", 2.0))
+                layers.assign(acc2, output=acc)
+                layers.increment(i, value=1)
+                layers.less_than(i, ten, cond=cond_var)
+            exe = fluid.Executor()
+            exe.run(startup)
+            out = exe.run(main, feed={}, fetch_list=[acc.name, i.name])
+    assert float(np.asarray(out[0])[0]) == 20.0
+    assert int(np.asarray(out[1])[0]) == 10
+
+
+def test_while_loop_functional():
+    main, startup = _fresh()
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            i = layers.fill_constant([1], "int64", 1)
+            limit = layers.fill_constant([1], "int64", 6)
+            fact = layers.fill_constant([1], "int64", 1)
+
+            def cond_fn(i, fact):
+                return layers.less_than(i, limit)
+
+            def body_fn(i, fact):
+                fact2 = layers.elementwise_mul(fact, i)
+                i2 = layers.elementwise_add(
+                    i, layers.fill_constant([1], "int64", 1))
+                return i2, fact2
+
+            i, fact = layers.while_loop(cond_fn, body_fn, [i, fact])
+            exe = fluid.Executor()
+            exe.run(startup)
+            out = exe.run(main, feed={}, fetch_list=[fact.name])
+    assert int(np.asarray(out[0])[0]) == 120  # 5!
+
+
+def test_cond_both_branches():
+    for flag, expect in [(1.0, 30.0), (-1.0, -8.0)]:
+        main, startup = _fresh()
+        with framework.program_guard(main, startup):
+            with framework.unique_name_guard():
+                x = fluid.layers.data("x", shape=[1], dtype="float32")
+                zero = layers.fill_constant([1], "float32", 0.0)
+                pred = layers.greater_than(x, zero)
+
+                out = layers.cond(
+                    pred,
+                    lambda: layers.scale(x, scale=30.0),
+                    lambda: layers.scale(x, scale=8.0))
+                exe = fluid.Executor()
+                exe.run(startup)
+                res = exe.run(main, feed={"x": np.full((1,), flag, "float32")},
+                              fetch_list=[out.name])
+        assert float(np.asarray(res[0])[0]) == expect
+
+
+def test_cond_multiple_returns():
+    main, startup = _fresh()
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data("x", shape=[2], dtype="float32")
+            zero = layers.fill_constant([1], "float32", 0.0)
+            pred = layers.greater_than(layers.reduce_sum(x), zero)
+            a, b = layers.cond(
+                pred,
+                lambda: (layers.scale(x, scale=2.0),
+                         layers.scale(x, scale=3.0)),
+                lambda: (layers.scale(x, scale=-2.0),
+                         layers.scale(x, scale=-3.0)))
+            exe = fluid.Executor()
+            exe.run(startup)
+            xs = np.array([1.0, 2.0], "float32")
+            ra, rb = exe.run(main, feed={"x": xs},
+                             fetch_list=[a.name, b.name])
+    np.testing.assert_allclose(np.asarray(ra), xs * 2)
+    np.testing.assert_allclose(np.asarray(rb), xs * 3)
+
+
+def test_switch_case_with_default():
+    for idx, expect in [(0, 1.0), (1, 2.0), (7, 99.0)]:
+        main, startup = _fresh()
+        with framework.program_guard(main, startup):
+            with framework.unique_name_guard():
+                index = fluid.layers.data("i", shape=[1], dtype="int64")
+                out = layers.switch_case(
+                    index,
+                    branch_fns=[
+                        lambda: layers.fill_constant([1], "float32", 1.0),
+                        lambda: layers.fill_constant([1], "float32", 2.0),
+                    ],
+                    default=lambda: layers.fill_constant([1], "float32",
+                                                         99.0))
+                exe = fluid.Executor()
+                exe.run(startup)
+                res = exe.run(main, feed={"i": np.full((1,), idx, "int64")},
+                              fetch_list=[out.name])
+        assert float(np.asarray(res[0])[0]) == expect
+
+
+def test_case_chain():
+    main, startup = _fresh()
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data("x", shape=[1], dtype="float32")
+            one = layers.fill_constant([1], "float32", 1.0)
+            two = layers.fill_constant([1], "float32", 2.0)
+            out = layers.case(
+                [(layers.less_than(x, one),
+                  lambda: layers.fill_constant([1], "float32", 10.0)),
+                 (layers.less_than(x, two),
+                  lambda: layers.fill_constant([1], "float32", 20.0))],
+                default=lambda: layers.fill_constant([1], "float32", 30.0))
+            exe = fluid.Executor()
+            exe.run(startup)
+            for v, expect in [(0.5, 10.0), (1.5, 20.0), (2.5, 30.0)]:
+                res = exe.run(main, feed={"x": np.full((1,), v, "float32")},
+                              fetch_list=[out.name])
+                assert float(np.asarray(res[0])[0]) == expect
+
+
+def test_cond_inside_while_updates_loop_var():
+    # regression: a write to a loop var made inside a nested cond branch
+    # must be part of the while carry (collatz-ish: add 3 if odd, else 1)
+    main, startup = _fresh()
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            i = layers.fill_constant([1], "int64", 0)
+            six = layers.fill_constant([1], "int64", 6)
+            acc = layers.fill_constant([1], "float32", 0.0)
+            two = layers.fill_constant([1], "int64", 2)
+            cond_var = layers.less_than(i, six)
+            w = layers.While(cond_var)
+            with w.block():
+                is_odd = layers.equal(
+                    layers.elementwise_mod(i, two),
+                    layers.fill_constant([1], "int64", 1))
+
+                def odd():
+                    layers.assign(
+                        layers.elementwise_add(
+                            acc, layers.fill_constant([1], "float32", 3.0)),
+                        output=acc)
+                    return layers.fill_constant([1], "float32", 0.0)
+
+                def even():
+                    layers.assign(
+                        layers.elementwise_add(
+                            acc, layers.fill_constant([1], "float32", 1.0)),
+                        output=acc)
+                    return layers.fill_constant([1], "float32", 0.0)
+
+                layers.cond(is_odd, odd, even)
+                layers.increment(i, value=1)
+                layers.less_than(i, six, cond=cond_var)
+            exe = fluid.Executor()
+            exe.run(startup)
+            out = exe.run(main, feed={}, fetch_list=[acc.name])
+    # i = 0..5: even,odd,even,odd,even,odd -> 1+3+1+3+1+3 = 12
+    assert float(np.asarray(out[0])[0]) == 12.0
+
+
+def test_while_reads_param_state():
+    # a param read only inside the loop body must be pulled from the scope
+    main, startup = _fresh()
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            w = fluid.layers.create_parameter([1], "float32", name="wp",
+                                              default_initializer=fluid
+                                              .initializer.Constant(3.0))
+            i = layers.fill_constant([1], "int64", 0)
+            three = layers.fill_constant([1], "int64", 3)
+            acc = layers.fill_constant([1], "float32", 0.0)
+            cond_var = layers.less_than(i, three)
+            wh = layers.While(cond_var)
+            with wh.block():
+                layers.assign(layers.elementwise_add(acc, w), output=acc)
+                layers.increment(i, value=1)
+                layers.less_than(i, three, cond=cond_var)
+            exe = fluid.Executor()
+            exe.run(startup)
+            out = exe.run(main, feed={}, fetch_list=[acc.name])
+    assert float(np.asarray(out[0])[0]) == 9.0

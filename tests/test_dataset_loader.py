@@ -1,0 +1,209 @@
+"""Dataset API + DataLoader tests (reference test model:
+test_dataset.py / test_multiprocess_dataloader_* in
+python/paddle/fluid/tests/unittests/)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework
+
+
+def _write_multislot(tmp_path, n_lines=32, dim=4):
+    p = os.path.join(str(tmp_path), "data.txt")
+    with open(p, "w") as f:
+        for i in range(n_lines):
+            feats = " ".join("%f" % (i + k * 0.1) for k in range(dim))
+            f.write("%d %s 1 %d\n" % (dim, feats, i % 10))
+    return p
+
+
+def test_queue_dataset_feeds_executor(tmp_path):
+    path = _write_multislot(tmp_path)
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="int64")
+            h = fluid.layers.fc(x, 16, act="relu")
+            pred = fluid.layers.fc(h, 10)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(pred, y))
+            fluid.optimizer.SGDOptimizer(learning_rate=0.01).minimize(loss)
+
+            ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+            ds.set_batch_size(8)
+            ds.set_thread(2)
+            ds.set_filelist([path])
+            ds.set_use_var([x, y])
+
+            exe = fluid.Executor()
+            exe.run(startup)
+            out = exe.train_from_dataset(main, ds, fetch_list=[loss.name])
+            assert out is not None
+            assert np.isfinite(float(np.asarray(out[0])))
+
+
+def test_inmemory_dataset_shuffle_and_batches(tmp_path):
+    path = _write_multislot(tmp_path, n_lines=20)
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="int64")
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(4)
+    ds.set_filelist([path])
+    ds.set_use_var([x, y])
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 20
+    plain = [b["y"].ravel().tolist() for b in ds._iter_batches()]
+    ds.local_shuffle()
+    shuffled = [b["y"].ravel().tolist() for b in ds._iter_batches()]
+    flat = sorted(sum(plain, []))
+    assert flat == sorted(sum(shuffled, []))
+    assert plain != shuffled  # shuffled order differs
+    for b in ds._iter_batches():
+        assert b["x"].shape == (4, 4)
+        assert b["y"].shape[0] == 4
+
+
+def test_dataset_ragged_slot_pads_and_keeps_lod(tmp_path):
+    p = os.path.join(str(tmp_path), "ragged.txt")
+    with open(p, "w") as f:
+        f.write("1 7 1 0.0\n2 8 9 1 1.0\n3 1 2 3 1 2.0\n1 4 1 3.0\n")
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            ids = fluid.layers.data("ids", shape=[1], dtype="int64",
+                                    lod_level=1)
+            lab = fluid.layers.data("lab", shape=[1], dtype="float32")
+    ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+    ds.set_batch_size(4)
+    ds.set_filelist([p])
+    ds.set_use_var([ids, lab])
+    batches = list(ds._iter_batches())
+    assert len(batches) == 1
+    b = batches[0]
+    assert b["ids"].shape == (4, 3)  # padded to max len 3
+    assert b["ids.lod"].tolist() == [0, 1, 3, 6, 7]
+    np.testing.assert_array_equal(b["ids"][2], [1, 2, 3])
+    assert b["ids"][0, 1] == 0  # padding
+
+
+def test_dataset_lod_slot_uniform_batch_still_emits_lod(tmp_path):
+    # schema must be keyed on the declared lod_level, not per-batch data:
+    # a coincidentally-uniform batch of a sequence slot keeps its .lod
+    p = os.path.join(str(tmp_path), "uniform.txt")
+    with open(p, "w") as f:
+        f.write("2 7 8 1 0.0\n2 9 10 1 1.0\n")
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            ids = fluid.layers.data("ids", shape=[1], dtype="int64",
+                                    lod_level=1)
+            lab = fluid.layers.data("lab", shape=[1], dtype="float32")
+    ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+    ds.set_batch_size(2)
+    ds.set_filelist([p])
+    ds.set_use_var([ids, lab])
+    (b,) = list(ds._iter_batches())
+    assert "ids.lod" in b
+    assert b["ids.lod"].tolist() == [0, 2, 4]
+    assert b["ids"].shape == (2, 2)
+
+
+def test_dataset_pipe_command(tmp_path):
+    # pipe_command preprocesses each file before MultiSlot parsing
+    p = os.path.join(str(tmp_path), "raw.txt")
+    with open(p, "w") as f:
+        f.write("5,0\n6,1\n7,2\n8,0\n")
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data("xs", shape=[1], dtype="float32")
+            y = fluid.layers.data("ys", shape=[1], dtype="int64")
+    ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+    ds.set_batch_size(4)
+    ds.set_filelist([p])
+    ds.set_use_var([x, y])
+    ds.set_pipe_command(
+        "awk -F, '{print \"1 \" $1 \" 1 \" $2}'")
+    (b,) = list(ds._iter_batches())
+    np.testing.assert_array_equal(b["xs"].ravel(), [5, 6, 7, 8])
+    np.testing.assert_array_equal(b["ys"].ravel(), [0, 1, 2, 0])
+    piped = list(ds._piped_files)
+    assert all(os.path.exists(f) for f in piped)
+    ds._cleanup_piped()
+    assert not any(os.path.exists(f) for f in piped)
+
+
+def test_generator_loader_propagates_reader_error():
+    loader = fluid.DataLoader.from_generator(feed_list=["a"], capacity=2)
+
+    def gen():
+        yield [np.zeros((2,), "float32")]
+        raise ValueError("corrupt record")
+
+    loader.set_batch_generator(gen)
+    it = iter(loader)
+    next(it)
+    with pytest.raises(RuntimeError, match="generator raised"):
+        list(it)
+
+
+class _SquareDataset:
+    """Picklable map-style dataset for multiprocess workers."""
+
+    def __len__(self):
+        return 37
+
+    def __getitem__(self, i):
+        x = np.full((3,), i, dtype="float32")
+        return x, np.int64(i * i)
+
+
+def test_dataloader_multiprocess_matches_single_process():
+    ds = _SquareDataset()
+    single = list(fluid.DataLoader(ds, batch_size=5, num_workers=0))
+    multi = list(fluid.DataLoader(ds, batch_size=5, num_workers=3))
+    assert len(single) == len(multi) == 8
+    for (xs, ys), (xm, ym) in zip(single, multi):
+        np.testing.assert_array_equal(xs, xm)
+        np.testing.assert_array_equal(ys, ym)
+
+
+def test_dataloader_worker_error_surfaces():
+    class Bad(_SquareDataset):
+        def __getitem__(self, i):
+            if i == 11:
+                raise ValueError("boom")
+            return super().__getitem__(i)
+
+    with pytest.raises(RuntimeError, match="boom"):
+        list(fluid.DataLoader(Bad(), batch_size=4, num_workers=2))
+
+
+def test_batch_sampler():
+    bs = fluid.BatchSampler(dataset=_SquareDataset(), batch_size=10,
+                            drop_last=True)
+    batches = list(bs)
+    assert len(batches) == 3 == len(bs)
+    assert all(len(b) == 10 for b in batches)
+
+
+def test_generator_loader_prefetch():
+    loader = fluid.DataLoader.from_generator(feed_list=["a", "b"],
+                                             capacity=4)
+
+    def gen():
+        for i in range(6):
+            yield [np.full((2, 2), i, "float32"),
+                   np.full((2,), -i, "float32")]
+
+    loader.set_batch_generator(gen)
+    got = list(loader)
+    assert len(got) == 6
+    assert set(got[0]) == {"a", "b"}
+    assert got[3]["a"][0, 0] == 3

@@ -1,0 +1,59 @@
+"""Inference predictor API tests (reference test model:
+test_analysis_predictor / inference api tests)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework
+from paddle_tpu import inference
+
+
+def _export_model(tmp_path):
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data("x", shape=[6], dtype="float32")
+            h = fluid.layers.fc(x, 8, act="relu")
+            out = fluid.layers.fc(h, 3, act="softmax")
+            exe = fluid.Executor()
+            exe.run(startup)
+            fluid.io.save_inference_model(str(tmp_path), ["x"], [out], exe,
+                                          main_program=main)
+            # reference result computed through the raw executor
+            xs = np.random.RandomState(0).randn(4, 6).astype("float32")
+            ref = exe.run(main, feed={"x": xs}, fetch_list=[out.name])[0]
+    return xs, np.asarray(ref), out.name
+
+
+def test_predictor_zero_copy_matches_executor(tmp_path):
+    xs, ref, out_name = _export_model(tmp_path)
+    config = inference.Config(str(tmp_path))
+    pred = inference.create_predictor(config)
+    assert pred.get_input_names() == ["x"]
+    assert pred.get_output_names() == [out_name]
+
+    inp = pred.get_input_handle("x")
+    inp.copy_from_cpu(xs)
+    pred.run()
+    got = pred.get_output_handle(out_name).copy_to_cpu()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(got.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_predictor_positional_run_and_shape_cache(tmp_path):
+    xs, ref, out_name = _export_model(tmp_path)
+    pred = inference.create_paddle_predictor(
+        inference.AnalysisConfig(str(tmp_path)))
+    outs = pred.run([xs])
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-6)
+    # different batch size: recompiles under a new shape key, still correct
+    outs2 = pred.run([xs[:2]])
+    np.testing.assert_allclose(outs2[0], ref[:2], rtol=1e-5, atol=1e-6)
+
+
+def test_two_predictors_are_isolated(tmp_path):
+    xs, ref, out_name = _export_model(tmp_path / "m1")
+    p1 = inference.create_predictor(inference.Config(str(tmp_path / "m1")))
+    p2 = inference.create_predictor(inference.Config(str(tmp_path / "m1")))
+    o1 = p1.run([xs])[0]
+    o2 = p2.run([xs])[0]
+    np.testing.assert_allclose(o1, o2, rtol=1e-6)
