@@ -183,6 +183,10 @@ class NativeAllocator:
         nbytes = int(np.prod(shape)) * dtype.itemsize
         p = self.alloc(max(nbytes, 1))
         buf = (ctypes.c_uint8 * max(nbytes, 1)).from_address(p)
+        # The view aliases native memory: pin the allocator (and thereby
+        # the block) to the buffer object so GC of `self` can't free the
+        # memory under a live view.
+        buf._ptq_owner = self
         arr = np.frombuffer(buf, dtype=dtype, count=int(np.prod(shape)))
         return p, arr.reshape(shape)
 

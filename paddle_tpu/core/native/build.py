@@ -36,7 +36,11 @@ def build(force: bool = False) -> str:
             "g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
             "-Wall", "-o", _SO,
         ] + [os.path.join(_SRC, s) for s in _SOURCES]
-        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                "native runtime build failed (%s):\n%s"
+                % (" ".join(cmd), proc.stderr))
         return _SO
 
 

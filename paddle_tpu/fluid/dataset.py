@@ -153,8 +153,13 @@ class DatasetBase:
             n_examples = len(lod) - 1
             counts = np.diff(lod)
             if lod_level > 0:
-                # sequence slot -> pad with 0, expose offsets as .lod
+                # sequence slot -> pad with 0, expose offsets as .lod.
+                # Pad width is bucketed to the next power of two so batch
+                # shapes repeat and the executor's shape-keyed compile
+                # cache stays warm (SURVEY.md §7 hard part (d)).
                 width = int(counts.max()) if counts.size else 0
+                if width > 0:
+                    width = 1 << (width - 1).bit_length()
                 arr = np.zeros((n_examples, width), vals.dtype)
                 for i in range(n_examples):
                     arr[i, :counts[i]] = vals[lod[i]:lod[i + 1]]

@@ -181,6 +181,10 @@ def switch_case(branch_index, branch_fns, default=None,
     prog = framework.default_main_program()
     if isinstance(branch_fns, dict):
         items = sorted(branch_fns.items())
+    elif branch_fns and all(isinstance(f, (list, tuple)) and len(f) == 2
+                            for f in branch_fns):
+        # reference API also accepts a list of (index, callable) pairs
+        items = sorted((int(k), f) for k, f in branch_fns)
     else:
         items = list(enumerate(branch_fns))
     keys = [int(k) for k, _ in items]

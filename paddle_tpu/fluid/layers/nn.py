@@ -31,6 +31,7 @@ __all__ = [
     "sequence_mask", "sequence_expand", "sequence_reshape",
     "sequence_reverse", "image_resize", "resize_nearest", "flatten",
     "logsigmoid", "erf", "sin", "cos", "maximum", "minimum",
+    "scaled_dot_product_attention",
 ]
 
 
@@ -649,3 +650,20 @@ def image_resize(input, out_shape=None, scale=None, resample="NEAREST",
 
 
 resize_nearest = image_resize
+
+
+def scaled_dot_product_attention(q, k, v, key_bias=None, causal=False,
+                                 sm_scale=None, attn_dropout_prob=0.0,
+                                 is_test=False, name=None):
+    """Fused attention over [B, H, S, D] q/k/v; optional [B, Sk] additive
+    key bias. Lowers to the Pallas flash-attention kernel on TPU
+    (paddle_tpu/ops/pallas/); reference fuses only inference attention
+    (`operators/fused/multihead_matmul_op.cu`)."""
+    ins = {"Q": [q], "K": [k], "V": [v]}
+    if key_bias is not None:
+        ins["KeyBias"] = [key_bias]
+    return _single("scaled_dot_product_attention", ins,
+                   {"causal": causal,
+                    "sm_scale": -1.0 if sm_scale is None else float(sm_scale),
+                    "attn_dropout_prob": float(attn_dropout_prob),
+                    "is_test": is_test}, dtype=q.dtype)

@@ -58,7 +58,16 @@ class Config:
 
     # -- model location ----------------------------------------------------
     def set_model(self, model_dir: str, params_path: Optional[str] = None):
-        self.__init__(model_dir, params_path)
+        # only update the model location (reference AnalysisConfig.SetModel);
+        # previously configured knobs (ir_optim, ...) must survive
+        if params_path is not None:
+            self._model_dir = None
+            self._prog_file = model_dir
+            self._params_file = params_path
+        else:
+            self._model_dir = model_dir
+            self._prog_file = None
+            self._params_file = None
 
     def model_dir(self) -> Optional[str]:
         return self._model_dir

@@ -50,7 +50,8 @@ def _init(cfg):
 
 
 def multi_head_attention(x, attn_bias, cfg, name, is_test=False):
-    """x: [B, S, H]; attn_bias: [B, 1, 1, S] additive mask."""
+    """x: [B, S, H]; attn_bias: [B, S] additive key bias (0 for live
+    tokens, -1e4 for padding)."""
     h = cfg.hidden_size
     n_head = cfg.num_attention_heads
     d_head = h // n_head
@@ -68,14 +69,13 @@ def multi_head_attention(x, attn_bias, cfg, name, is_test=False):
         return layers.transpose(t, [0, 2, 1, 3])  # [B, nH, S, dH]
 
     q, k, v = to_heads(q), to_heads(k), to_heads(v)
-    scores = layers.matmul(q, k, transpose_y=True,
-                           alpha=1.0 / math.sqrt(d_head))
-    scores = layers.elementwise_add(scores, attn_bias)
-    probs = layers.softmax(scores)
-    probs = layers.dropout(
-        probs, cfg.attention_probs_dropout_prob, is_test=is_test,
-        dropout_implementation="upscale_in_train")
-    ctx = layers.matmul(probs, v)  # [B, nH, S, dH]
+    # Fused attention: flash kernel on TPU when prob-dropout is off
+    # (paddle_tpu/ops/pallas/flash_attention.py).
+    ctx = layers.scaled_dot_product_attention(
+        q, k, v, key_bias=attn_bias, causal=False,
+        sm_scale=1.0 / math.sqrt(d_head),
+        attn_dropout_prob=cfg.attention_probs_dropout_prob,
+        is_test=is_test)  # [B, nH, S, dH]
     ctx = layers.transpose(ctx, [0, 2, 1, 3])
     ctx = layers.reshape(ctx, [0, 0, h])
     return proj(ctx, "_out")
@@ -129,9 +129,8 @@ def bert_encoder(src_ids, pos_ids, sent_ids, input_mask, cfg,
     x = layers.dropout(x, cfg.hidden_dropout_prob, is_test=is_test,
                        dropout_implementation="upscale_in_train")
 
-    # additive attention bias from [B, S] mask: (1-m) * -1e4 -> [B,1,1,S]
-    neg = layers.scale(input_mask, scale=-10000.0, bias=10000.0)
-    attn_bias = layers.unsqueeze(layers.unsqueeze(neg, [1]), [1])
+    # additive [B, S] key bias from the [B, S] mask: (1-m) * -1e4
+    attn_bias = layers.scale(input_mask, scale=-10000.0, bias=10000.0)
 
     for i in range(cfg.num_hidden_layers):
         x = encoder_layer(x, attn_bias, cfg, "layer_%d" % i,

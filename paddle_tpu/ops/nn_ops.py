@@ -513,3 +513,62 @@ def _pad2d(ins, attrs):
                                constant_values=attrs.get("pad_value", 0.0))}
     jmode = {"reflect": "reflect", "edge": "edge"}[mode]
     return {"Out": jnp.pad(x, cfg, mode=jmode)}
+
+
+# ---------------------------------------------------------------------------
+# Fused scaled-dot-product attention (flash attention on TPU)
+# ---------------------------------------------------------------------------
+
+@register_op("scaled_dot_product_attention", needs_rng=True)
+def _sdpa(ins, attrs):
+    """Fused attention. Q,K,V: [B, H, S, D]; optional KeyBias: [B, Sk]
+    additive key bias. On TPU with no attention-prob dropout this lowers
+    to the Pallas flash kernel (paddle_tpu/ops/pallas/flash_attention.py);
+    otherwise the XLA reference path (identical semantics) runs, with
+    upscale_in_train dropout on the normalized probs.
+
+    Reference parity: fused CUDA attention in
+    `paddle/fluid/operators/fused/multihead_matmul_op.cu` and
+    `operators/math/bert_encoder_functor.cu` (inference-only there; this
+    op also trains)."""
+    from .pallas import flash_attention as _flash
+    from .pallas import reference_attention as _ref_attn
+
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    bias = ins.get("KeyBias", [None])
+    bias = bias[0] if bias else None
+    causal = attrs.get("causal", False)
+    sm_scale = attrs.get("sm_scale", None)
+    if sm_scale is not None and sm_scale <= 0:
+        sm_scale = None
+    p_drop = attrs.get("attn_dropout_prob", 0.0)
+    is_test = attrs.get("is_test", False)
+    drop_active = (not is_test) and p_drop > 0.0
+
+    if not drop_active and jax.default_backend() == "tpu":
+        return {"Out": _flash(q, k, v, key_bias=bias, causal=causal,
+                              sm_scale=sm_scale)}
+
+    if not drop_active:
+        return {"Out": _ref_attn(q, k, v, key_bias=bias, causal=causal,
+                                 sm_scale=sm_scale)}
+
+    # Unfused path with dropout on probs (matches layers.softmax+dropout).
+    import math as _math
+    D = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else 1.0 / _math.sqrt(D)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if bias is not None:
+        s = s + bias[:, None, None, :].astype(jnp.float32)
+    if causal:
+        Sq, Sk = s.shape[-2], s.shape[-1]
+        rows = lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
+        cols = lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1)
+        s = jnp.where(rows >= cols, s, -1e30)
+    probs = jax.nn.softmax(s, axis=-1)
+    keep = jax.random.bernoulli(attrs["_rng_key"], 1.0 - p_drop,
+                                probs.shape)
+    probs = jnp.where(keep, probs / (1.0 - p_drop), 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v.astype(jnp.float32))
+    return {"Out": out.astype(q.dtype)}

@@ -1,0 +1,7 @@
+"""Pallas TPU kernels for the hot ops (flash attention, ...).
+
+These are the hand-scheduled kernels sitting below the XLA-lowered op
+registry — the TPU-native counterpart of the reference's hand-written
+CUDA in `paddle/fluid/operators/fused/` and `operators/math/`.
+"""
+from .flash_attention import flash_attention, reference_attention  # noqa: F401

@@ -48,6 +48,7 @@ class SPMDConfig:
     dropout: float = 0.0
     dtype: str = "bfloat16"     # compute dtype (params/opt state fp32)
     remat: bool = True          # jax.checkpoint each layer
+    use_flash: bool = None      # Pallas flash attention (None = on TPU)
 
     @property
     def layers_per_stage(self):
@@ -179,11 +180,17 @@ def _layer_fn(cfg, x_seq, lp, dropout_key):
         return t.reshape(B, S, heads_local, dh).transpose(0, 2, 1, 3)
 
     q, k_, v = to_heads(q), to_heads(k_), to_heads(v)
-    scores = (q.astype(jnp.float32) @ k_.astype(jnp.float32)
-              .transpose(0, 1, 3, 2)) / math.sqrt(dh)
-    causal = jnp.triu(jnp.full((S, S), -1e9, jnp.float32), k=1)
-    probs = jax.nn.softmax(scores + causal, axis=-1).astype(cdt)
-    ctx = (probs @ v).transpose(0, 2, 1, 3).reshape(B, S, D // cfg.tp)
+    if cfg.use_flash:
+        from ..ops.pallas import flash_attention
+        ctx = flash_attention(q, k_, v, causal=True,
+                              sm_scale=1.0 / math.sqrt(dh)).astype(cdt)
+    else:
+        scores = (q.astype(jnp.float32) @ k_.astype(jnp.float32)
+                  .transpose(0, 1, 3, 2)) / math.sqrt(dh)
+        causal = jnp.triu(jnp.full((S, S), -1e9, jnp.float32), k=1)
+        probs = jax.nn.softmax(scores + causal, axis=-1).astype(cdt)
+        ctx = (probs @ v).astype(cdt)
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, D // cfg.tp)
     partial = ctx @ lp["wo"].astype(cdt)  # [B, S, D] partial over tp
     # reduce over tp AND scatter back to sequence shards (SP)
     attn_out = lax.psum_scatter(partial, "tp", scatter_dimension=1,
@@ -259,6 +266,13 @@ def make_train_step(cfg, mesh):
     import jax.numpy as jnp
     from jax import lax
     from jax.sharding import PartitionSpec as P
+
+    if cfg.use_flash is None:
+        # Auto: only when no mesh device is a CPU — the dryrun path builds
+        # the mesh from host-platform (CPU) devices while the process
+        # default backend can still report TPU.
+        cfg = dataclasses.replace(cfg, use_flash=all(
+            d.platform != "cpu" for d in np.asarray(mesh.devices).flat))
 
     specs = param_specs(cfg)
     n_stages = cfg.pp

@@ -86,9 +86,9 @@ def test_dataset_ragged_slot_pads_and_keeps_lod(tmp_path):
     batches = list(ds._iter_batches())
     assert len(batches) == 1
     b = batches[0]
-    assert b["ids"].shape == (4, 3)  # padded to max len 3
+    assert b["ids"].shape == (4, 4)  # padded to bucket width 4
     assert b["ids.lod"].tolist() == [0, 1, 3, 6, 7]
-    np.testing.assert_array_equal(b["ids"][2], [1, 2, 3])
+    np.testing.assert_array_equal(b["ids"][2], [1, 2, 3, 0])
     assert b["ids"][0, 1] == 0  # padding
 
 
