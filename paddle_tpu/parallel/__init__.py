@@ -8,3 +8,6 @@ from . import env  # noqa: F401
 from .env import (  # noqa: F401
     register_ring, set_global_mesh, global_mesh, collective_scope,
 )
+from .ring_attention import (  # noqa: F401
+    ring_attention, ring_attention_sharded,
+)

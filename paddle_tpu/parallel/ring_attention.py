@@ -1,0 +1,103 @@
+"""Ring attention: context/sequence parallelism over a mesh axis.
+
+The reference snapshot has NO sequence/context parallelism (SURVEY.md §5
+"Long-context: Absent" — verified no ring/blockwise/Ulysses anywhere);
+long sequences there rely on LoD ragged batching plus recompute. The
+TPU-native framework makes long context first-class: the sequence axis is
+sharded over a mesh axis and KV shards rotate around the ring with
+`lax.ppermute` (one ICI hop per step, overlapped by XLA with the local
+blockwise attention), while each device maintains flash-style online
+softmax statistics (m, l, acc) in fp32. Peak memory per device is
+O(S_local^2) for one score block — global attention over sequences far
+beyond single-chip HBM.
+
+Used inside `shard_map` (see `ring_attention_sharded` for the pjit-level
+wrapper). Composable with data/tensor parallelism on the other mesh axes.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def ring_attention(q, k, v, axis_name, causal=False, sm_scale=None):
+    """Global attention over a sequence sharded along `axis_name`.
+
+    Call inside shard_map/pmap. q, k, v: [B, H, S_local, D] — this
+    device's sequence shard. Returns [B, H, S_local, D] in q.dtype: the
+    rows of the GLOBAL attention output owned by this device.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    B, H, S_loc, D = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(D)
+
+    rows = idx * S_loc + lax.broadcasted_iota(jnp.int32, (S_loc, S_loc), 0)
+
+    def block(m, l, acc, k_cur, v_cur, src):
+        # one blockwise online-softmax update against the KV chunk
+        # originally owned by device `src`; inputs stay in their compute
+        # dtype (bf16 on TPU) with fp32 MXU accumulation, stats in fp32.
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_cur,
+                       preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            cols = src * S_loc + lax.broadcasted_iota(
+                jnp.int32, (S_loc, S_loc), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        m_curr = jnp.max(s, axis=-1, keepdims=True)
+        m_next = jnp.maximum(m, m_curr)
+        p = jnp.exp(s - m_next)
+        alpha = jnp.exp(m - m_next)
+        l_next = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_next = acc * alpha + jnp.einsum(
+            "bhqk,bhkd->bhqd", p.astype(v_cur.dtype), v_cur,
+            preferred_element_type=jnp.float32)
+        return m_next, l_next, acc_next
+
+    # step t (t = 1..n-1): rotate KV one hop around the ring
+    # (device i -> i+1) FIRST, then attend — so after t rotations this
+    # device holds the chunk originally owned by (idx - t) mod n, and the
+    # final iteration issues no wasted collective.
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, t):
+        m, l, acc, k_cur, v_cur = carry
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        m, l, acc = block(m, l, acc, k_cur, v_cur, (idx - t) % n)
+        return (m, l, acc, k_cur, v_cur), None
+
+    m0 = jnp.full((B, H, S_loc, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, S_loc, 1), jnp.float32)
+    a0 = jnp.zeros((B, H, S_loc, D), jnp.float32)
+    # step 0: this device's own chunk, no rotation needed
+    m0, l0, a0 = block(m0, l0, a0, k, v, idx)
+    # remat the step so backward re-forms each score block instead of
+    # keeping n O(S_loc^2) blocks alive
+    (m, l, acc, _, _), _ = lax.scan(jax.checkpoint(step),
+                                    (m0, l0, a0, k, v),
+                                    jnp.arange(1, n))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    return (acc / l_safe).astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh, seq_axis="sp", causal=False,
+                           sm_scale=None):
+    """pjit-level wrapper: q, k, v are GLOBAL [B, H, S, D] arrays with the
+    S axis sharded over `mesh` axis `seq_axis`; runs ring_attention via
+    shard_map and returns the global [B, H, S, D] output (S sharded the
+    same way)."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, None, seq_axis, None)
+    fn = functools.partial(ring_attention, axis_name=seq_axis,
+                           causal=causal, sm_scale=sm_scale)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
