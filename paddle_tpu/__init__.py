@@ -36,6 +36,10 @@ from . import nn  # noqa: F401
 from . import tensor  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import models  # noqa: F401
+from . import hapi  # noqa: F401
+from . import incubate  # noqa: F401
+from .hapi import Model  # noqa: F401
+from .hapi.model import Input as static_Input  # noqa: F401
 
 
 def to_tensor(data, dtype=None, place=None, stop_gradient=True):

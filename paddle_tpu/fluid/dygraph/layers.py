@@ -43,6 +43,11 @@ class Layer:
                                            name=name)
 
     def add_parameter(self, name, parameter):
+        if name in self._buffers:
+            raise KeyError(
+                "attribute %r is already a buffer of this layer; "
+                "state-dict keys are attribute paths and must be unique"
+                % name)
         self._parameters[name] = parameter
         return parameter
 
@@ -51,6 +56,11 @@ class Layer:
         return sublayer
 
     def register_buffer(self, name, tensor, persistable=True):
+        if name in self._parameters:
+            raise KeyError(
+                "attribute %r is already a parameter of this layer; "
+                "state-dict keys are attribute paths and must be unique"
+                % name)
         tensor.persistable = persistable
         self._buffers[name] = tensor
         return tensor
@@ -105,26 +115,38 @@ class Layer:
     # -- state dict ----------------------------------------------------------
     def state_dict(self, destination=None, include_sublayers=True,
                    prefix=""):
+        """Keys are structured attribute paths ("fc.weight") so that two
+        independently built instances of the same architecture agree —
+        the reference derives keys the same way (dygraph/layers.py
+        state_dict via hierarchy traversal)."""
         dest = destination if destination is not None else \
             collections.OrderedDict()
         for name, p in self._parameters.items():
-            dest[p.name] = p
+            dest[prefix + name] = p
         for name, b in self._buffers.items():
-            dest[b.name] = b
+            dest[prefix + name] = b
         if include_sublayers:
-            for l in self._sub_layers.values():
-                l.state_dict(dest)
+            for name, l in self._sub_layers.items():
+                l.state_dict(dest, prefix=prefix + name + ".")
         return dest
 
     def set_dict(self, state_dict, include_sublayers=True):
         import jax.numpy as jnp
 
         own = self.state_dict()
+        # fallback: checkpoints written before structured keys were keyed
+        # by the globally-unique runtime param name
+        by_pname = {t.name: key for key, t in own.items()}
         for name, t in own.items():
-            if name in state_dict:
-                v = state_dict[name]
+            v = state_dict.get(name)
+            if v is None:
+                continue
+            arr = v.numpy() if hasattr(v, "numpy") else np.asarray(v)
+            t._assign_raw(jnp.asarray(arr))
+        for name, v in state_dict.items():
+            if name not in own and name in by_pname:
                 arr = v.numpy() if hasattr(v, "numpy") else np.asarray(v)
-                t._assign_raw(jnp.asarray(arr))
+                own[by_pname[name]]._assign_raw(jnp.asarray(arr))
 
     load_dict = set_dict
     set_state_dict = set_dict

@@ -1,0 +1,20 @@
+"""High-level Model API (hapi).
+
+Reference parity: `python/paddle/incubate/hapi/` — `Model.fit/evaluate/
+predict` (`model.py:652,1128,1337,1443`), callbacks (`callbacks.py`),
+progress bar (`progressbar.py`), metrics (`metrics.py`), datasets
+(`datasets/`). TPU-native: the training loop drives the dygraph engine
+(eager ops dispatch through per-op jitted XLA computations), so `fit`
+gets XLA-compiled steps without a static graph.
+"""
+from .model import Model, Input  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback, ProgBarLogger, ModelCheckpoint, EarlyStopping, LRScheduler,
+)
+from .metrics import Metric, Accuracy  # noqa: F401
+from . import datasets  # noqa: F401
+
+__all__ = [
+    "Model", "Input", "Callback", "ProgBarLogger", "ModelCheckpoint",
+    "EarlyStopping", "LRScheduler", "Metric", "Accuracy", "datasets",
+]

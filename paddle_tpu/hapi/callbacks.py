@@ -1,0 +1,238 @@
+"""Training callbacks (reference:
+`python/paddle/incubate/hapi/callbacks.py` — Callback, CallbackList,
+ProgBarLogger, ModelCheckpoint)."""
+from __future__ import annotations
+
+from .progressbar import ProgressBar
+
+
+class Callback:
+    """Base class; hapi fires these hooks around fit/evaluate/predict."""
+
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_params(self, params):
+        self.params = params or {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+    def on_predict_begin(self, logs=None):
+        pass
+
+    def on_predict_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_batch_begin(self, step, logs=None):
+        pass
+
+    def on_eval_batch_end(self, step, logs=None):
+        pass
+
+    def on_predict_batch_begin(self, step, logs=None):
+        pass
+
+    def on_predict_batch_end(self, step, logs=None):
+        pass
+
+
+class CallbackList:
+    def __init__(self, callbacks=None):
+        self.callbacks = list(callbacks or [])
+
+    def append(self, cb):
+        self.callbacks.append(cb)
+
+    def set_params(self, params):
+        for cb in self.callbacks:
+            cb.set_params(params)
+
+    def set_model(self, model):
+        for cb in self.callbacks:
+            cb.set_model(model)
+
+    def _call(self, name, *args):
+        for cb in self.callbacks:
+            getattr(cb, name)(*args)
+
+    def __getattr__(self, name):
+        if name.startswith("on_"):
+            return lambda *args: self._call(name, *args)
+        raise AttributeError(name)
+
+
+def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
+                     log_freq=2, verbose=2, save_freq=1, save_dir=None,
+                     metrics=None, mode="train"):
+    cbks = list(callbacks or [])
+    if not any(isinstance(c, ProgBarLogger) for c in cbks):
+        cbks = [ProgBarLogger(log_freq, verbose=verbose)] + cbks
+    if save_dir and not any(isinstance(c, ModelCheckpoint) for c in cbks):
+        cbks = cbks + [ModelCheckpoint(save_freq, save_dir)]
+    lst = CallbackList(cbks)
+    lst.set_model(model)
+    lst.set_params({
+        "epochs": epochs, "steps": steps, "verbose": verbose,
+        "metrics": metrics or ["loss"],
+    })
+    return lst
+
+
+class ProgBarLogger(Callback):
+    """Per-step metric logging with a progress bar (reference:
+    callbacks.py ProgBarLogger)."""
+
+    def __init__(self, log_freq=1, verbose=2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+        self._progbar = None
+
+    def on_train_begin(self, logs=None):
+        self.epochs = self.params.get("epochs")
+        self.steps = self.params.get("steps")
+
+    def on_epoch_begin(self, epoch, logs=None):
+        if self.verbose:
+            print("Epoch %d/%s" % (epoch + 1, self.epochs or "?"))
+        self._progbar = ProgressBar(num=self.steps, verbose=self.verbose)
+        self._step = 0
+
+    def _updates(self, logs):
+        metrics = self.params.get("metrics") or []
+        return [(k, logs[k]) for k in metrics if k in (logs or {})]
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        if self.verbose and self._step % self.log_freq == 0:
+            self._progbar.update(self._step, self._updates(logs or {}))
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose and self._progbar is not None:
+            self._progbar.update(self._step, self._updates(logs or {}))
+
+    def on_eval_begin(self, logs=None):
+        self._eval_step = 0
+
+    def on_eval_batch_end(self, step, logs=None):
+        self._eval_step += 1
+
+    def on_eval_end(self, logs=None):
+        if self.verbose and logs:
+            print("Eval - " + " - ".join(
+                "%s: %s" % (k, v) for k, v in logs.items()))
+
+
+class ModelCheckpoint(Callback):
+    """Save `<save_dir>/<epoch>` every `save_freq` epochs and
+    `<save_dir>/final` at train end (reference: callbacks.py
+    ModelCheckpoint)."""
+
+    def __init__(self, save_freq=1, save_dir=None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.model is not None and self.save_dir and \
+                epoch % self.save_freq == 0:
+            self.model.save("%s/%d" % (self.save_dir, epoch))
+
+    def on_train_end(self, logs=None):
+        if self.model is not None and self.save_dir:
+            self.model.save("%s/final" % self.save_dir)
+
+
+class EarlyStopping(Callback):
+    """Stop fit() when a monitored metric stops improving."""
+
+    def __init__(self, monitor="loss", mode="min", patience=0,
+                 min_delta=0.0, baseline=None):
+        super().__init__()
+        self.monitor = monitor
+        self.mode = mode
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.best = None
+        self.wait = 0
+
+    def _value(self, logs):
+        v = (logs or {}).get(self.monitor)
+        if isinstance(v, (list, tuple)):
+            v = v[0]
+        return None if v is None else float(v)
+
+    def _improved(self, value):
+        if self.best is None:
+            return True
+        if self.mode == "min":
+            return value < self.best - self.min_delta
+        return value > self.best + self.min_delta
+
+    def on_train_begin(self, logs=None):
+        self.best = self.baseline
+        self.wait = 0
+
+    def on_epoch_end(self, epoch, logs=None):
+        value = self._value(logs)
+        if value is None:
+            return
+        if self._improved(value):
+            self.best = value
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait > self.patience and self.model is not None:
+                self.model.stop_training = True
+
+
+class LRScheduler(Callback):
+    """Step a learning-rate scheduler each epoch (or each batch with
+    by_step=True)."""
+
+    def __init__(self, by_step=False, by_epoch=True):
+        super().__init__()
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        opt = getattr(self.model, "_optimizer", None)
+        lr = getattr(opt, "_learning_rate", None)
+        return lr if hasattr(lr, "step") else None
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if self.by_epoch and s is not None:
+            s.step()
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if self.by_step and s is not None:
+            s.step()

@@ -1,0 +1,110 @@
+"""hapi datasets (reference:
+`python/paddle/incubate/hapi/datasets/` — map-style Dataset base,
+MNIST idx-file parser). No network egress: MNIST reads local idx
+files; downloads are not supported."""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+
+class Dataset:
+    """Map-style dataset (reference: datasets/folder.py base usage +
+    fluid/dataloader/dataset.py Dataset)."""
+
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class TensorDataset(Dataset):
+    def __init__(self, *tensors):
+        arrays = [np.asarray(t) for t in tensors]
+        n = len(arrays[0])
+        assert all(len(a) == n for a in arrays)
+        self.tensors = arrays
+
+    def __getitem__(self, idx):
+        return tuple(a[idx] for a in self.tensors)
+
+    def __len__(self):
+        return len(self.tensors[0])
+
+
+def _read_idx(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data.reshape(dims)
+
+
+class MNIST(Dataset):
+    """MNIST from local idx(.gz) files (reference: datasets/mnist.py;
+    download path removed — this environment has no egress)."""
+
+    _FILES = {
+        "train": ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+        "test": ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"),
+    }
+
+    def __init__(self, root=None, mode="train", transform=None,
+                 backend="numpy", download=False):
+        assert mode in self._FILES, mode
+        if download:
+            raise RuntimeError(
+                "MNIST download is unavailable (no network egress); "
+                "place the idx files under `root` instead")
+        root = root or os.path.join(
+            os.path.expanduser("~"), ".cache", "paddle_tpu", "mnist")
+        img_name, lbl_name = self._FILES[mode]
+        img_path = self._find(root, img_name)
+        lbl_path = self._find(root, lbl_name)
+        self.images = _read_idx(img_path).astype("float32") / 255.0
+        self.labels = _read_idx(lbl_path).astype("int64")
+        self.transform = transform
+
+    @staticmethod
+    def _find(root, base):
+        for cand in (os.path.join(root, base),
+                     os.path.join(root, base + ".gz")):
+            if os.path.exists(cand):
+                return cand
+        raise FileNotFoundError(
+            "MNIST file %s(.gz) not found under %s" % (base, root))
+
+    def __getitem__(self, idx):
+        img = self.images[idx][None, ...]  # 1xHxW
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray([self.labels[idx]], dtype="int64")
+
+    def __len__(self):
+        return len(self.images)
+
+
+class SyntheticImages(Dataset):
+    """Deterministic synthetic classification dataset for tests and
+    smoke runs (label is derived from the image so it is learnable)."""
+
+    def __init__(self, num_samples=256, image_shape=(1, 8, 8),
+                 num_classes=10, seed=0):
+        r = np.random.RandomState(seed)
+        self.images = r.rand(num_samples, *image_shape).astype("float32")
+        proj = r.rand(int(np.prod(image_shape)), num_classes)
+        logits = self.images.reshape(num_samples, -1) @ proj
+        self.labels = logits.argmax(-1).astype("int64")
+        self.num_classes = num_classes
+
+    def __getitem__(self, idx):
+        return self.images[idx], np.asarray([self.labels[idx]], "int64")
+
+    def __len__(self):
+        return len(self.images)

@@ -1,0 +1,73 @@
+"""hapi Metric API (reference:
+`python/paddle/incubate/hapi/metrics.py` — Metric base with
+compute/update/reset/accumulate/name, Accuracy with top-k)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _to_numpy(x):
+    if hasattr(x, "numpy"):
+        return x.numpy()
+    return np.asarray(x)
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        raise NotImplementedError
+
+    def compute(self, *args):
+        """Optional device-side pre-computation; the returned values are
+        handed to update() as numpy."""
+        return args
+
+
+class Accuracy(Metric):
+    """Top-k accuracy (reference: metrics.py Accuracy)."""
+
+    def __init__(self, topk=(1,), name=None):
+        self.topk = tuple(topk)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def compute(self, pred, label, *args):
+        pred = _to_numpy(pred)
+        label = _to_numpy(label)
+        order = np.argsort(-pred, axis=-1)[..., :self.maxk]
+        if label.ndim == pred.ndim:
+            label = label.squeeze(-1)
+        correct = (order == label[..., None]).astype("float32")
+        return correct
+
+    def update(self, correct, *args):
+        correct = _to_numpy(correct)
+        num = correct.shape[0]
+        for i, k in enumerate(self.topk):
+            c = correct[..., :k].max(axis=-1).sum()
+            self.total[i] += float(c)
+        self.count += num
+        return [self.total[i] / max(1, self.count)
+                for i in range(len(self.topk))]
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = 0
+
+    def accumulate(self):
+        res = [t / max(1, self.count) for t in self.total]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        if len(self.topk) == 1:
+            return [self._name]
+        return ["%s_top%d" % (self._name, k) for k in self.topk]

@@ -1,0 +1,309 @@
+"""`Model` — Keras-like fit/evaluate/predict over the dygraph engine.
+
+Reference parity: `python/paddle/incubate/hapi/model.py` — `Model.fit`
+(`model.py:1128`), `evaluate` (`:1337`), `predict` (`:1443`),
+`train_batch/eval_batch/test_batch` (`:652` DynamicGraphAdapter), and
+`save/load` (`:907,960`). TPU-native: batches run through the eager
+engine whose ops are per-signature jitted XLA computations, so the hot
+loop is compiled after the first step; there is no separate static
+adapter because `paddle_tpu.fluid` programs already lower to one XLA
+computation when needed.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import pickle
+from typing import List, Optional
+
+import numpy as np
+
+from ..fluid import framework
+from ..fluid.dygraph import base as dy_base
+from ..fluid.dygraph.checkpoint import save_dygraph
+from ..fluid.reader import DataLoader
+from .callbacks import config_callbacks
+from .metrics import Metric
+
+
+class Input:
+    """Input spec (reference: hapi/input.py Input(shape, dtype, name))."""
+
+    def __init__(self, shape=None, dtype="float32", name=None):
+        self.shape = tuple(shape or ())
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return "Input(shape=%s, dtype=%s, name=%s)" % (
+            self.shape, self.dtype, self.name)
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def _as_variables(arrays):
+    out = []
+    for a in arrays:
+        if isinstance(a, dy_base.Tensor):
+            out.append(a)
+        else:
+            out.append(dy_base.to_variable(np.asarray(a)))
+    return out
+
+
+class Model:
+    """Wraps a dygraph `Layer` network with train/eval/predict loops."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = _to_list(inputs)
+        self._labels = _to_list(labels)
+        self._optimizer = None
+        self._loss_function = None
+        self._metrics: List[Metric] = []
+        self.stop_training = False
+        # own tracer, activated only inside batch methods — fit() must not
+        # flip the process-global dygraph mode for unrelated static code
+        self._tracer = framework._dygraph_tracer() or dy_base.Tracer()
+
+    @contextlib.contextmanager
+    def _dygraph_guard(self):
+        if framework.in_dygraph_mode():
+            yield
+            return
+        old = framework._switch_tracer(self._tracer)
+        try:
+            yield
+        finally:
+            framework._switch_tracer(old)
+
+    # -- setup -------------------------------------------------------------
+    def prepare(self, optimizer=None, loss_function=None, metrics=None):
+        self._optimizer = optimizer
+        self._loss_function = loss_function
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            assert isinstance(m, Metric), (
+                "metrics must be hapi.Metric instances, got %r" % (m,))
+        return self
+
+    def parameters(self):
+        return self.network.parameters()
+
+    # -- single-batch entry points ----------------------------------------
+    def _split_batch(self, data):
+        data = _to_list(data)
+        if self._inputs:
+            n_in = len(self._inputs)
+        elif self._labels:
+            n_in = len(data) - len(self._labels)
+        else:
+            n_in = max(1, len(data) - 1)
+        return data[:n_in], data[n_in:]
+
+    def _compute_loss(self, outputs, labels):
+        if self._loss_function is None:
+            return outputs[0]
+        losses = self._loss_function(*(outputs + labels))
+        losses = _to_list(losses)
+        total = losses[0]
+        for x in losses[1:]:
+            total = total + x
+        return total
+
+    def train_batch(self, inputs, labels=None):
+        assert self._optimizer is not None, "call prepare() first"
+        with self._dygraph_guard():
+            self.network.train()
+            inputs = _as_variables(_to_list(inputs))
+            labels = _as_variables(_to_list(labels))
+            outputs = _to_list(self.network(*inputs))
+            loss = self._compute_loss(outputs, labels)
+            loss.backward()
+            self._optimizer.minimize(
+                loss, parameter_list=self.network.parameters())
+            self.network.clear_gradients()
+        metrics = []
+        for m in self._metrics:
+            res = m.update(*_to_list(m.compute(outputs[0], *labels)))
+            metrics.append(res)
+        return ([float(np.asarray(loss.numpy()).reshape(-1)[0])], metrics)
+
+    def eval_batch(self, inputs, labels=None):
+        with self._dygraph_guard():
+            self.network.eval()
+            with dy_base.no_grad():
+                inputs = _as_variables(_to_list(inputs))
+                labels = _as_variables(_to_list(labels))
+                outputs = _to_list(self.network(*inputs))
+                loss = self._compute_loss(outputs, labels) \
+                    if labels else None
+        metrics = []
+        for m in self._metrics:
+            res = m.update(*_to_list(m.compute(outputs[0], *labels)))
+            metrics.append(res)
+        lv = [float(np.asarray(loss.numpy()).reshape(-1)[0])] \
+            if loss is not None else []
+        return (lv, metrics)
+
+    def test_batch(self, inputs):
+        with self._dygraph_guard():
+            self.network.eval()
+            with dy_base.no_grad():
+                inputs = _as_variables(_to_list(inputs))
+                outputs = _to_list(self.network(*inputs))
+        return [o.numpy() for o in outputs]
+
+    predict_batch = test_batch
+
+    # -- loops -------------------------------------------------------------
+    def _make_loader(self, data, batch_size, shuffle, drop_last,
+                     num_workers):
+        if data is None or isinstance(data, DataLoader) or (
+                hasattr(data, "__iter__") and
+                not hasattr(data, "__getitem__")):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=drop_last, num_workers=num_workers)
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1,
+            epochs=1, eval_freq=1, log_freq=10, save_dir=None,
+            save_freq=1, verbose=2, drop_last=False, shuffle=True,
+            num_workers=0, callbacks=None):
+        loader = self._make_loader(train_data, batch_size, shuffle,
+                                   drop_last, num_workers)
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        metric_names = ["loss"]
+        for m in self._metrics:
+            metric_names.extend(_to_list(m.name()))
+        cbks = config_callbacks(
+            callbacks, model=self, epochs=epochs, steps=steps,
+            log_freq=log_freq, verbose=verbose, save_freq=save_freq,
+            save_dir=save_dir, metrics=metric_names)
+
+        self.stop_training = False
+        cbks.on_train_begin({})
+        history = []
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch, {})
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step, {})
+                inputs, labels = self._split_batch(batch)
+                losses, _ = self.train_batch(inputs, labels)
+                logs = self._merge_logs(losses)
+                logs["step"] = step
+                cbks.on_train_batch_end(step, logs)
+            cbks.on_epoch_end(epoch, logs)
+            history.append(dict(logs))
+
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size,
+                              log_freq=log_freq, verbose=verbose,
+                              num_workers=num_workers, callbacks=cbks)
+            if self.stop_training:
+                break
+        cbks.on_train_end({})
+        return history
+
+    def _merge_logs(self, losses):
+        logs = {"loss": losses[0] if losses else None}
+        for m in self._metrics:
+            names = _to_list(m.name())
+            vals = _to_list(m.accumulate())
+            for n, v in zip(names, vals):
+                logs[n] = float(v)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        loader = self._make_loader(eval_data, batch_size, False, False,
+                                   num_workers)
+        own_cbks = callbacks is None
+        cbks = callbacks if callbacks is not None else config_callbacks(
+            None, model=self, steps=len(loader) if hasattr(
+                loader, "__len__") else None,
+            log_freq=log_freq, verbose=verbose, mode="eval")
+        for m in self._metrics:
+            m.reset()
+        cbks.on_eval_begin({})
+        losses = []
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step, {})
+            inputs, labels = self._split_batch(batch)
+            lv, _ = self.eval_batch(inputs, labels)
+            if lv:
+                losses.append(lv[0])
+            cbks.on_eval_batch_end(step, {"loss": lv})
+        result = {}
+        if losses:
+            result["loss"] = [float(np.mean(losses))]
+        for m in self._metrics:
+            names = _to_list(m.name())
+            vals = _to_list(m.accumulate())
+            for n, v in zip(names, vals):
+                result[n] = float(v)
+        cbks.on_eval_end(result)
+        return result
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None):
+        loader = self._make_loader(test_data, batch_size, False, False,
+                                   num_workers)
+        cbks = callbacks if callbacks is not None else config_callbacks(
+            None, model=self, verbose=0, mode="predict")
+        cbks.on_predict_begin({})
+        outputs = None
+        for step, batch in enumerate(loader):
+            cbks.on_predict_batch_begin(step, {})
+            inputs, _ = self._split_batch(batch)
+            outs = self.test_batch(inputs)
+            if outputs is None:
+                outputs = [[] for _ in outs]
+            for i, o in enumerate(outs):
+                outputs[i].append(o)
+            cbks.on_predict_batch_end(step, {})
+        cbks.on_predict_end({})
+        if outputs is None:
+            return []
+        if stack_outputs:
+            return [np.concatenate(chunks, axis=0) for chunks in outputs]
+        return outputs
+
+    # -- persistence -------------------------------------------------------
+    def save(self, path):
+        """Write `<path>.pdparams` (+ `<path>.pdopt` when an optimizer
+        with state is attached) — reference: model.py:907."""
+        save_dygraph(self.network.state_dict(), path)
+        if self._optimizer is not None:
+            opt_state = {}
+            for k, v in self._optimizer.state_dict().items():
+                opt_state[k] = v.numpy() if hasattr(v, "numpy") \
+                    else np.asarray(v)
+            if opt_state:
+                os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+                with open(path + ".pdopt", "wb") as f:
+                    pickle.dump(opt_state, f, protocol=2)
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        with open(path + ".pdparams", "rb") as f:
+            state = pickle.load(f)
+        self.network.set_dict(state)
+        return self
+
+    def summary(self):
+        total = 0
+        rows = []
+        for name, p in self.network.named_parameters():
+            n = int(np.prod(p.shape)) if p.shape else 1
+            total += n
+            rows.append((name, tuple(p.shape), n))
+        return {"total_params": total, "layers": rows}

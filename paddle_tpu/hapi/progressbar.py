@@ -1,0 +1,51 @@
+"""Terminal progress bar (reference:
+`python/paddle/incubate/hapi/progressbar.py` ProgressBar)."""
+from __future__ import annotations
+
+import sys
+import time
+
+
+class ProgressBar:
+    def __init__(self, num=None, width=30, verbose=1, file=sys.stdout):
+        self._num = num
+        self._width = width if num else 0
+        self._verbose = verbose
+        self._file = file
+        self._start = time.time()
+        self._last_update = 0
+
+    def _format_values(self, values):
+        parts = []
+        for k, v in values:
+            if isinstance(v, (float,)):
+                parts.append("%s: %.4f" % (k, v))
+            elif isinstance(v, (list, tuple)):
+                parts.append("%s: %s" % (
+                    k, "/".join("%.4f" % float(x) for x in v)))
+            else:
+                parts.append("%s: %s" % (k, v))
+        return " - ".join(parts)
+
+    def update(self, current_num, values=None):
+        values = values or []
+        now = time.time()
+        msg = self._format_values(values)
+        if self._verbose == 1:
+            if self._num is not None:
+                frac = min(1.0, current_num / max(1, self._num))
+                filled = int(frac * self._width)
+                bar = "=" * filled + ">" + "." * (self._width - filled)
+                line = "step %d/%d [%s] - %s" % (
+                    current_num, self._num, bar, msg)
+            else:
+                line = "step %d - %s" % (current_num, msg)
+            self._file.write("\r" + line)
+            if self._num is not None and current_num >= self._num:
+                self._file.write("\n")
+            self._file.flush()
+            self._last_update = now
+        elif self._verbose == 2:
+            self._file.write("step %d/%s - %s\n" % (
+                current_num, self._num or "?", msg))
+            self._file.flush()

@@ -1,0 +1,100 @@
+"""hapi Model.fit/evaluate/predict tests (reference test shape:
+python/paddle/incubate/hapi/tests/test_model.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.hapi import Model, Accuracy, EarlyStopping
+from paddle_tpu.hapi.datasets import SyntheticImages, TensorDataset
+
+
+def make_model():
+    net = paddle.nn.Sequential(
+        FlattenLinear(),
+    )
+    return Model(net)
+
+
+class FlattenLinear(paddle.nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = paddle.nn.Linear(64, 10)
+
+    def forward(self, x):
+        x = x.reshape((x.shape[0], 64))
+        return self.fc(x)
+
+
+@pytest.fixture
+def prepared_model():
+    m = make_model()
+    opt = paddle.fluid.optimizer.AdamOptimizer(learning_rate=1e-2)
+    m.prepare(optimizer=opt,
+              loss_function=paddle.nn.CrossEntropyLoss(),
+              metrics=Accuracy())
+    return m
+
+
+def test_fit_reduces_loss(prepared_model):
+    data = SyntheticImages(num_samples=128)
+    hist = prepared_model.fit(data, batch_size=32, epochs=3, verbose=0,
+                              shuffle=True)
+    assert len(hist) == 3
+    assert hist[-1]["loss"] < hist[0]["loss"]
+    assert hist[-1]["acc"] > 0.2
+
+
+def test_evaluate_and_predict(prepared_model):
+    data = SyntheticImages(num_samples=64)
+    prepared_model.fit(data, batch_size=32, epochs=2, verbose=0)
+    res = prepared_model.evaluate(data, batch_size=32, verbose=0)
+    assert "loss" in res and "acc" in res
+    preds = prepared_model.predict(data, batch_size=32,
+                                   stack_outputs=True)
+    assert preds[0].shape == (64, 10)
+
+
+def test_save_load(tmp_path, prepared_model):
+    data = SyntheticImages(num_samples=64)
+    prepared_model.fit(data, batch_size=32, epochs=1, verbose=0)
+    path = os.path.join(str(tmp_path), "ckpt")
+    prepared_model.save(path)
+    assert os.path.exists(path + ".pdparams")
+
+    m2 = make_model()
+    m2.prepare(loss_function=paddle.nn.CrossEntropyLoss(),
+               metrics=Accuracy())
+    m2.load(path)
+    r1 = prepared_model.evaluate(data, batch_size=32, verbose=0)
+    r2 = m2.evaluate(data, batch_size=32, verbose=0)
+    np.testing.assert_allclose(r1["loss"], r2["loss"], rtol=1e-5)
+
+
+def test_checkpoint_callback(tmp_path, prepared_model):
+    data = SyntheticImages(num_samples=64)
+    sd = str(tmp_path / "ckpts")
+    prepared_model.fit(data, batch_size=32, epochs=2, verbose=0,
+                       save_dir=sd, save_freq=1)
+    assert os.path.exists(os.path.join(sd, "0.pdparams"))
+    assert os.path.exists(os.path.join(sd, "final.pdparams"))
+
+
+def test_early_stopping(prepared_model):
+    data = SyntheticImages(num_samples=64)
+    es = EarlyStopping(monitor="loss", patience=0, mode="min",
+                       baseline=-1e9)  # nothing beats baseline -> stop
+    hist = prepared_model.fit(data, batch_size=32, epochs=5, verbose=0,
+                              callbacks=[es])
+    assert len(hist) == 1
+
+
+def test_tensor_dataset_and_train_batch(prepared_model):
+    x = np.random.rand(8, 1, 8, 8).astype("float32")
+    y = np.random.randint(0, 10, (8, 1)).astype("int64")
+    ds = TensorDataset(x, y)
+    xi, yi = ds[0]
+    assert xi.shape == (1, 8, 8)
+    loss, metrics = prepared_model.train_batch([x], [y])
+    assert np.isfinite(loss[0])
