@@ -20,4 +20,6 @@ from .learning_rate_scheduler import (  # noqa: F401
     InverseTimeDecay, PolynomialDecay, CosineDecay, LinearLrWarmup,
     ReduceLROnPlateau,
 )
-from .jit import TracedLayer, declarative  # noqa: F401
+from . import jit  # noqa: F401
+from .jit import TracedLayer, declarative, to_static  # noqa: F401
+from .dygraph_to_static import ProgramTranslator  # noqa: F401

@@ -196,6 +196,42 @@ class Tensor:
                         {"transpose_X": False, "transpose_Y": False,
                          "alpha": 1.0}, ["Out"])[0]
 
+    def _compare(self, other, op_type):
+        import jax.numpy as jnp
+
+        if not isinstance(other, Tensor):
+            other = Tensor(jnp.asarray(
+                np.asarray(other, to_numpy_dtype(self.dtype))),
+                stop_gradient=True)
+        return trace_op(op_type, {"X": [self], "Y": [other]}, {},
+                        ["Out"])[0]
+
+    def __lt__(self, o):
+        return self._compare(o, "less_than")
+
+    def __le__(self, o):
+        return self._compare(o, "less_equal")
+
+    def __gt__(self, o):
+        return self._compare(o, "greater_than")
+
+    def __ge__(self, o):
+        return self._compare(o, "greater_equal")
+
+    def __eq__(self, o):
+        if o is None:
+            return False
+        return self._compare(o, "equal")
+
+    def __ne__(self, o):
+        if o is None:
+            return True
+        return self._compare(o, "not_equal")
+
+    # identity hash (elementwise __eq__ would otherwise make Tensors
+    # unhashable; matches VarBase semantics)
+    __hash__ = object.__hash__
+
     def __getitem__(self, idx):
         out = self._val[idx]
         t = Tensor(out, stop_gradient=self.stop_gradient)
@@ -240,9 +276,16 @@ def to_tensor_value(arr):
 
 
 def trace_op(op_type, ins: Dict[str, list], attrs, out_slots):
-    """Eager execution + tape recording. `ins` maps slot -> [Tensor...]."""
+    """Eager execution + tape recording. `ins` maps slot -> [Tensor...].
+    Under @declarative capture this choke point redirects to the static
+    front end instead (the TPU-native ProgramDescTracer, see
+    dygraph_to_static/)."""
     tracer = _tracer()
     if tracer is None:
+        from .dygraph_to_static import program_translator as _pt
+
+        if _pt.current_ctx() is not None:
+            return _pt.capture_trace_op(op_type, ins, attrs, out_slots)
         raise RuntimeError("trace_op called outside dygraph mode")
     opdef = ops_lib.get_op(op_type)
     attrs = {k: v for k, v in attrs.items() if v is not None}

@@ -1,0 +1,20 @@
+"""Dygraph -> static-graph translation (reference:
+`python/paddle/fluid/dygraph/dygraph_to_static/` — ProgramTranslator
+`program_translator.py:349`, the AST transformer suite, and the C++
+`ProgramDescTracer` `imperative/jit/program_desc_tracer.h:47`).
+
+TPU-native design: instead of a ProgramDesc tape hook inside the C++
+tracer, eager ops all funnel through one python choke point
+(`dygraph.base.trace_op`); capture mode redirects that choke point to
+`Block.append_op`, so the dygraph network re-executes symbolically and
+builds a real static `Program` (which then lowers to ONE XLA
+computation, the same path Executor uses). Data-dependent `if`/`while`
+are AST-rewritten onto the static `cond`/`while_loop` layers, which
+lower to `lax.cond`/`lax.while_loop`.
+"""
+from .program_translator import (  # noqa: F401
+    ProgramTranslator, StaticFunction, ConcreteProgram, SymbolicTensor,
+    capture_program,
+)
+from .ast_transformer import convert_to_static  # noqa: F401
+from . import convert_operators  # noqa: F401

@@ -1,0 +1,281 @@
+"""AST conversion of python control flow onto the convert_* runtime
+helpers (reference: the ~20 transformer files in
+`dygraph_to_static/` — IfElseTransformer, LoopTransformer,
+LogicalTransformer). Scope kept to the constructs that matter for
+model code:
+
+- `if` / `elif` / `else`  -> convert_ifelse (lax.cond when the test is
+  a tensor). Branches either assign variables (rewritten to an output
+  tuple) or are both single `return` statements.
+- `while`                 -> convert_while_loop (lax.while_loop when
+  the test is a tensor); loop-carried vars = names assigned in the body.
+- `and` / `or` / `not`    -> convert_logical_* (short-circuit preserved
+  for python values via thunks).
+- `for i in range(...)` and python-value `if`/`while` keep plain python
+  semantics (they unroll / run at capture time, exactly like jax.jit).
+
+Unsupported in converted code: `break`/`continue` inside a tensor
+`while`, early `return` from inside a tensor `if` branch that also
+assigns — these raise with a clear message at conversion time.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+
+
+_JST = "_paddle_jst"
+
+
+class _AssignedNames(ast.NodeVisitor):
+    """Names bound by statements in a body (assign/augassign/for/with)."""
+
+    def __init__(self):
+        self.names = []
+
+    def _add(self, node):
+        if isinstance(node, ast.Name):
+            if node.id not in self.names:
+                self.names.append(node.id)
+        elif isinstance(node, (ast.Tuple, ast.List)):
+            for e in node.elts:
+                self._add(e)
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._add(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._add(node.target)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        self._add(node.target)
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        self._add(node.target)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        if node.name not in self.names:
+            self.names.append(node.name)
+        # don't descend: inner defs have their own scope
+
+
+def _assigned(stmts):
+    v = _AssignedNames()
+    for s in stmts:
+        v.visit(s)
+    return v.names
+
+
+class _HasCtl(ast.NodeVisitor):
+    def __init__(self):
+        self.found = False
+
+    def visit_Break(self, node):
+        self.found = True
+
+    def visit_Continue(self, node):
+        self.found = True
+
+    def visit_Return(self, node):
+        self.found = True
+
+    def visit_While(self, node):
+        pass  # nested loops own their break/continue
+
+    def visit_For(self, node):
+        pass
+
+    def visit_FunctionDef(self, node):
+        pass
+
+
+def _has_ctl(stmts):
+    v = _HasCtl()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _jst_call(fn_name, args):
+    return ast.Call(
+        func=ast.Attribute(value=_name(_JST), attr=fn_name,
+                           ctx=ast.Load()),
+        args=args, keywords=[])
+
+
+def _out_tuple(names, ctx):
+    return ast.Tuple(elts=[_name(n, ctx) for n in names], ctx=ctx)
+
+
+class DygraphToStaticTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self._counter = 0
+
+    def _fresh(self, base):
+        self._counter += 1
+        return "__%s_%d" % (base, self._counter)
+
+    # -- boolean operators --------------------------------------------------
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        fn = "convert_logical_and" if isinstance(node.op, ast.And) \
+            else "convert_logical_or"
+        expr = node.values[-1]
+        for v in reversed(node.values[:-1]):
+            expr = _jst_call(fn, [
+                ast.Lambda(args=_no_args(), body=v),
+                ast.Lambda(args=_no_args(), body=expr)])
+        return expr
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return _jst_call("convert_logical_not", [node.operand])
+        return node
+
+    # -- if ------------------------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        body, orelse = node.body, node.orelse
+        both_return = (
+            len(body) == 1 and isinstance(body[0], ast.Return) and
+            len(orelse) == 1 and isinstance(orelse[0], ast.Return))
+        if both_return:
+            return ast.Return(value=_jst_call("convert_ifelse", [
+                node.test,
+                ast.Lambda(args=_no_args(), body=body[0].value),
+                ast.Lambda(args=_no_args(), body=orelse[0].value)]))
+        if _has_ctl(body) or _has_ctl(orelse):
+            # guard clauses (`if flag: return x`) keep python semantics;
+            # python_only raises at capture time if the test is a tensor
+            node.test = _jst_call("python_only", [
+                node.test,
+                ast.Constant(value="if-with-return/break/continue")])
+            return node
+        names = sorted(set(_assigned(body)) | set(_assigned(orelse)))
+        t_name, f_name = self._fresh("true_fn"), self._fresh("false_fn")
+        # branch functions take the pre-branch values as PARAMETERS —
+        # python scoping would otherwise treat every assigned name as a
+        # fresh local and break reads of the incoming value
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in names],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[])
+        ret = ast.Return(value=_out_tuple(names, ast.Load()))
+        t_def = ast.FunctionDef(
+            name=t_name, args=args,
+            body=(body + [ret]) if names else (body + [_pass()]),
+            decorator_list=[], returns=None)
+        f_def = ast.FunctionDef(
+            name=f_name, args=args,
+            body=(orelse + [ret]) if names
+            else ((orelse or [_pass()]) + []),
+            decorator_list=[], returns=None)
+        init = ast.Tuple(
+            elts=[_jst_call("try_get", [
+                ast.Lambda(args=_no_args(), body=_name(n))])
+                for n in names],
+            ctx=ast.Load())
+        call = _jst_call("convert_ifelse", [
+            node.test, _name(t_name), _name(f_name), init])
+        if names:
+            assign = ast.Assign(
+                targets=[_out_tuple(names, ast.Store())], value=call)
+        else:
+            assign = ast.Expr(value=call)
+        return [t_def, f_def, assign]
+
+    # -- while ---------------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _has_ctl(node.body):
+            # python-valued loops with break/continue/else keep python
+            # semantics; tensor tests in that shape are rejected at
+            # capture time by python_only
+            node.test = _jst_call("python_only", [
+                node.test,
+                ast.Constant(value="while-with-break/continue/else")])
+            return node
+        names = sorted(set(_assigned(node.body)))
+        if not names:
+            raise NotImplementedError(
+                "@declarative: `while` body assigns no variables")
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in names],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[])
+        c_name, b_name = self._fresh("cond_fn"), self._fresh("body_fn")
+        c_def = ast.FunctionDef(
+            name=c_name, args=args,
+            body=[ast.Return(value=node.test)], decorator_list=[],
+            returns=None)
+        b_def = ast.FunctionDef(
+            name=b_name, args=args,
+            body=node.body + [ast.Return(
+                value=_out_tuple(names, ast.Load()))],
+            decorator_list=[], returns=None)
+        init = ast.Tuple(
+            elts=[_jst_call("try_get", [
+                ast.Lambda(args=_no_args(), body=_name(n))])
+                for n in names],
+            ctx=ast.Load())
+        call = _jst_call("convert_while_loop", [
+            _name(c_name), _name(b_name), init])
+        assign = ast.Assign(targets=[_out_tuple(names, ast.Store())],
+                            value=call)
+        return [c_def, b_def, assign]
+
+
+def _no_args():
+    return ast.arguments(posonlyargs=[], args=[], vararg=None,
+                         kwonlyargs=[], kw_defaults=[], kwarg=None,
+                         defaults=[])
+
+
+def _pass():
+    return ast.Pass()
+
+
+@functools.lru_cache(maxsize=512)
+def _convert_cached(fn):
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError):
+        return fn  # no source (builtins, lambdas in REPL) — run as-is
+    tree = ast.parse(src)
+    fd = tree.body[0]
+    fd.decorator_list = []
+    tree = DygraphToStaticTransformer().visit(tree)
+    ast.fix_missing_locations(tree)
+    code = compile(tree, filename="<declarative:%s>" % fn.__qualname__,
+                   mode="exec")
+    from . import convert_operators
+
+    glb = dict(fn.__globals__)
+    glb[_JST] = convert_operators
+    if fn.__closure__:
+        # rebind free variables by wrapping in a maker function
+        free = fn.__code__.co_freevars
+        cells = {n: c.cell_contents for n, c in
+                 zip(free, fn.__closure__)}
+        glb.update(cells)
+    exec(code, glb)
+    new_fn = glb[fd.name]
+    functools.update_wrapper(new_fn, fn)
+    return new_fn
+
+
+def convert_to_static(fn):
+    """Return the AST-converted twin of `fn` (cached per function)."""
+    return _convert_cached(fn)

@@ -1,0 +1,189 @@
+"""Runtime conversion helpers the AST transformer targets (reference:
+`dygraph_to_static/convert_operators.py` — convert_ifelse,
+convert_while_loop, convert_logical_*). Each helper checks at runtime
+whether its operands are symbolic: python values keep plain python
+semantics; symbolic tensors lower to the static `cond`/`while_loop`
+layers (-> lax.cond / lax.while_loop)."""
+from __future__ import annotations
+
+from ... import framework
+from .program_translator import SymbolicTensor, current_ctx
+
+
+def _is_sym(x):
+    return isinstance(x, (SymbolicTensor, framework.Variable))
+
+
+def _unwrap(x):
+    if isinstance(x, SymbolicTensor):
+        return x._var
+    if isinstance(x, framework.Variable):
+        return x
+    # concrete eager Tensor captured as a constant; python scalars pass
+    # through untouched (they stay python inside branch lambdas)
+    from ..base import Tensor as EagerTensor
+
+    if isinstance(x, EagerTensor) and current_ctx() is not None:
+        return current_ctx().to_var(x)
+    return x
+
+
+def _loop_carry(x):
+    """Loop-carried init value as a FRESH in-program var: constants are
+    copied via `assign` so the captured const is never mutated between
+    runs (loop vars are written in the body)."""
+    from ...layers import tensor as static_t
+
+    if isinstance(x, SymbolicTensor):
+        return x._var
+    if isinstance(x, framework.Variable):
+        return x
+    from ..base import Tensor as EagerTensor
+
+    if isinstance(x, EagerTensor):
+        return static_t.assign(current_ctx().to_var(x))
+    return static_t.fill_constant([1], "float32", float(x))
+
+
+def _wrap(x):
+    return SymbolicTensor(x) if isinstance(x, framework.Variable) else x
+
+
+def _wrap_struct(x):
+    if isinstance(x, (list, tuple)):
+        return type(x)(_wrap_struct(e) for e in x)
+    return _wrap(x)
+
+
+def _unwrap_struct(x):
+    if isinstance(x, (list, tuple)):
+        return type(x)(_unwrap_struct(e) for e in x)
+    return _unwrap(x)
+
+
+def _to_bool_var(pred):
+    """Scalar bool var for cond/while (cast + reshape to ())."""
+    from ...layers import nn as static_nn
+    from ...layers import tensor as static_t
+
+    v = _unwrap(pred)
+    if str(v.dtype) != "bool":
+        v = static_t.cast(v, "bool")
+    if tuple(v.shape) not in ((), (1,)):
+        v = static_nn.reduce_all(v) if hasattr(static_nn, "reduce_all") \
+            else v
+    return v
+
+
+class _Undefined:
+    """Sentinel for branch variables not yet bound before the `if`."""
+
+    def __repr__(self):
+        return "<undefined before branch>"
+
+
+UNDEFINED = _Undefined()
+
+
+def try_get(thunk):
+    """Current value of an enclosing-scope name, or UNDEFINED when the
+    name is not bound yet (it is only created inside the branch)."""
+    try:
+        return thunk()
+    except (NameError, UnboundLocalError):
+        return UNDEFINED
+
+
+def convert_ifelse(pred, true_fn, false_fn, init_args=()):
+    """`if pred:` — python branch for concrete preds, lax.cond-backed
+    static cond for symbolic ones. Both branches take the pre-branch
+    values of every assigned name as parameters and return them
+    (the transformer guarantees matching structures)."""
+    if not _is_sym(pred):
+        return true_fn(*init_args) if pred else false_fn(*init_args)
+    if current_ctx() is None:
+        raise RuntimeError(
+            "symbolic `if` outside @declarative capture")
+    from ...layers import control_flow as cf
+
+    out = cf.cond(_to_bool_var(pred),
+                  lambda: _unwrap_struct(true_fn(*init_args)),
+                  lambda: _unwrap_struct(false_fn(*init_args)))
+    return _wrap_struct(out)
+
+
+def convert_while_loop(cond_fn, body_fn, loop_vars):
+    """`while cond:` — loop-carried vars are the names the body assigns;
+    symbolic condition lowers to the static while_loop layer."""
+    if any(v is UNDEFINED for v in loop_vars):
+        raise NameError(
+            "@declarative `while`: every loop-carried variable must be "
+            "bound before the loop (the loop may run zero times)")
+    pred = cond_fn(*loop_vars)
+    if not _is_sym(pred):
+        while pred:
+            loop_vars = body_fn(*loop_vars)
+            pred = cond_fn(*loop_vars)
+        return loop_vars
+    if current_ctx() is None:
+        raise RuntimeError(
+            "symbolic `while` outside @declarative capture")
+    from ...layers import control_flow as cf
+
+    out = cf.while_loop(
+        lambda *vs: _to_bool_var(cond_fn(*_wrap_struct(tuple(vs)))),
+        lambda *vs: _unwrap_struct(tuple(body_fn(
+            *_wrap_struct(tuple(vs))))),
+        tuple(_loop_carry(v) for v in loop_vars))
+    return tuple(_wrap_struct(tuple(out)))
+
+
+def convert_logical_and(x_fn, y_fn):
+    x = x_fn()
+    if not _is_sym(x):
+        return y_fn() if x else x
+    y = y_fn()
+    if not _is_sym(y):
+        raise TypeError("cannot mix symbolic and python bool in `and`")
+    from ...layers import nn as static_nn
+
+    return _wrap(static_nn.logical_and(_unwrap(x), _unwrap(y)))
+
+
+def convert_logical_or(x_fn, y_fn):
+    x = x_fn()
+    if not _is_sym(x):
+        return x if x else y_fn()
+    y = y_fn()
+    if not _is_sym(y):
+        raise TypeError("cannot mix symbolic and python bool in `or`")
+    from ...layers import nn as static_nn
+
+    return _wrap(static_nn.logical_or(_unwrap(x), _unwrap(y)))
+
+
+def convert_logical_not(x):
+    if not _is_sym(x):
+        return not x
+    from ...layers import nn as static_nn
+
+    return _wrap(static_nn.logical_not(_unwrap(x)))
+
+
+def convert_len(x):
+    if _is_sym(x):
+        return int(_unwrap(x).shape[0])
+    return len(x)
+
+
+def python_only(value, construct):
+    """Marks a control-flow test position that must stay python: raises
+    when a tensor reaches it (e.g. `if tensor: return ...` — only
+    supported shapes lower to lax.cond/while_loop)."""
+    if _is_sym(value):
+        raise NotImplementedError(
+            "@declarative: a tensor condition reached a %s construct, "
+            "which keeps python semantics — restructure so both "
+            "branches are a single `return`, or assign instead of "
+            "returning/breaking" % construct)
+    return value

@@ -1,0 +1,403 @@
+"""Capture machinery: eager network -> static Program.
+
+Reference parity: `dygraph_to_static/program_translator.py:349`
+(ProgramTranslator + per-signature ConcreteProgram cache) and
+`imperative/jit/program_desc_tracer.h:47` (op capture). Here capture
+reuses the static front end: each eager `trace_op` call is appended to
+the default Program via `Block.append_op`, which also runs compile-time
+shape inference (the reference's InferShape pass), so `x.shape` works
+in user code during tracing.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+import weakref
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ... import framework
+from ....core.scope import global_scope
+from ....core.types import normalize_dtype
+
+
+# ---------------------------------------------------------------------------
+# capture context
+# ---------------------------------------------------------------------------
+
+_state = threading.local()
+
+
+def current_ctx() -> Optional["CaptureContext"]:
+    return getattr(_state, "ctx", None)
+
+
+class CaptureContext:
+    """Maps eager tensors (parameters / captured constants) to static
+    persistable vars while a capture is active."""
+
+    def __init__(self, main: framework.Program):
+        self.main = main
+        self.var_map: Dict[int, framework.Variable] = {}
+        self.params: List[tuple] = []  # (eager Tensor, static Variable)
+
+    def to_var(self, t):
+        """Static var for any trace_op input."""
+        if isinstance(t, SymbolicTensor):
+            return t._var
+        if isinstance(t, framework.Variable):
+            return t
+        key = id(t)
+        v = self.var_map.get(key)
+        if v is not None:
+            return v
+        gb = self.main.global_block()
+        trainable = getattr(t, "trainable", False) and not t.stop_gradient
+        if t.persistable and trainable:
+            var = gb.create_parameter(
+                name=t.name, shape=list(t.shape), dtype=t.dtype,
+                trainable=True)
+        else:
+            var = gb.create_var(
+                name=t.name if t.persistable
+                else framework.unique_name("capture_const"),
+                shape=list(t.shape), dtype=t.dtype, persistable=True,
+                stop_gradient=True)
+        global_scope().set_var(var.name, t._val)
+        self.var_map[key] = var
+        self.params.append((t, var))
+        return var
+
+    def refresh_scope(self):
+        """Re-publish current eager values (params train between calls)."""
+        scope = global_scope()
+        for t, var in self.params:
+            scope.set_var(var.name, t._val)
+
+
+def capture_trace_op(op_type, ins, attrs, out_slots):
+    """The symbolic twin of dygraph trace_op: append a static op (one
+    output var per declared slot) to the current block."""
+    ctx = current_ctx()
+    prog = framework.default_main_program()
+    block = prog.current_block()
+    attrs = {k: v for k, v in attrs.items() if v is not None}
+    in_vars = {}
+    for slot, ts in ins.items():
+        vs = [ctx.to_var(t) for t in ts if t is not None]
+        if vs:
+            in_vars[slot] = vs
+    out_vars = {}
+    flat = []
+    for slot in out_slots:
+        ov = block.create_var(
+            name=framework.unique_name("%s.%s" % (op_type, slot.lower())))
+        out_vars[slot] = [ov]
+        flat.append(ov)
+    block.append_op(type=op_type, inputs=in_vars, outputs=out_vars,
+                    attrs=attrs)
+    return [SymbolicTensor(v) for v in flat]
+
+
+# ---------------------------------------------------------------------------
+# SymbolicTensor — dygraph Tensor interface over a static Variable
+# ---------------------------------------------------------------------------
+
+from .. import base as dy_base  # noqa: E402  (cycle-safe: late import)
+
+
+class SymbolicTensor(dy_base.Tensor):
+    """Stands in for an eager Tensor during capture: all the operator
+    sugar on Tensor funnels through trace_op, which the capture hook
+    redirects here, so user dygraph code runs unmodified."""
+
+    def __init__(self, var):
+        self._var = var
+        self.name = var.name
+        self.stop_gradient = var.stop_gradient
+        self.persistable = var.persistable
+        self.trainable = getattr(var, "trainable", True)
+        self._grad = None
+        self._backward_ran = False
+
+    @property
+    def shape(self):
+        return tuple(self._var.shape)
+
+    @property
+    def dtype(self):
+        return self._var.dtype
+
+    @property
+    def ndim(self):
+        return len(self._var.shape)
+
+    def __len__(self):
+        return int(self._var.shape[0])
+
+    def numpy(self):
+        raise RuntimeError(
+            "Tensor %r is symbolic (inside @declarative capture); concrete "
+            "values are only available at run time" % self.name)
+
+    item = numpy
+
+    def __bool__(self):
+        raise RuntimeError(
+            "cannot convert a symbolic Tensor to bool — data-dependent "
+            "python control flow must go through the @declarative AST "
+            "conversion (if/while) or layers.cond/while_loop")
+
+    def detach(self):
+        t = SymbolicTensor(self._var)
+        t.stop_gradient = True
+        return t
+
+    def backward(self, retain_graph=False):
+        raise RuntimeError("backward() is not available on symbolic "
+                           "tensors; differentiate the @declarative "
+                           "function's program instead")
+
+    def __repr__(self):
+        return "SymbolicTensor(%s, shape=%s, dtype=%s)" % (
+            self.name, self.shape, self.dtype)
+
+    def __getitem__(self, idx):
+        from ...layers import nn as static_nn
+
+        if isinstance(idx, int):
+            out = static_nn.slice(self._var, axes=[0], starts=[idx],
+                                  ends=[idx + 1])
+            out = static_nn.squeeze(out, axes=[0]) \
+                if hasattr(static_nn, "squeeze") else out
+            return SymbolicTensor(out)
+        if isinstance(idx, slice):
+            start = idx.start or 0
+            stop = idx.stop if idx.stop is not None else self.shape[0]
+            if idx.step not in (None, 1):
+                raise NotImplementedError("strided symbolic slicing")
+            return SymbolicTensor(static_nn.slice(
+                self._var, axes=[0], starts=[int(start)],
+                ends=[int(stop)]))
+        raise NotImplementedError(
+            "symbolic __getitem__ supports int and contiguous slice only")
+
+
+# ---------------------------------------------------------------------------
+# capture + ConcreteProgram
+# ---------------------------------------------------------------------------
+
+def _spec_of(a):
+    if isinstance(a, dy_base.Tensor):
+        return (tuple(a.shape), str(a.dtype))
+    if isinstance(a, np.ndarray):
+        return (tuple(a.shape), str(a.dtype))
+    return ("pyval", repr(a))
+
+
+def _is_tensor_arg(a):
+    return isinstance(a, (dy_base.Tensor, np.ndarray))
+
+
+class ConcreteProgram:
+    """One captured (program, feeds, fetches) per input signature
+    (reference: program_translator.py ConcreteProgram)."""
+
+    def __init__(self, main, startup, feed_names, fetch_vars, template,
+                 ctx):
+        self.main = main
+        self.startup = startup
+        self.feed_names = feed_names
+        self.fetch_vars = fetch_vars
+        self.template = template  # output structure
+        self.ctx = ctx
+        self._exe = None
+
+    def run(self, tensor_args):
+        from ...executor import Executor
+
+        if self._exe is None:
+            self._exe = Executor()
+        self.ctx.refresh_scope()
+        feed = {}
+        for name, a in zip(self.feed_names, tensor_args):
+            feed[name] = a._val if isinstance(a, dy_base.Tensor) \
+                else np.asarray(a)
+        outs = self._exe.run(self.main, feed=feed,
+                             fetch_list=list(self.fetch_vars),
+                             return_numpy=False)
+        wrapped = [dy_base.wrap_raw(o) for o in outs]
+        return _pack_like(self.template, wrapped)
+
+
+def _flatten_outs(x, acc):
+    if isinstance(x, (list, tuple)):
+        for e in x:
+            _flatten_outs(e, acc)
+    else:
+        acc.append(x)
+    return acc
+
+
+def _pack_like(template, flat):
+    it = iter(flat)
+
+    def rec(t):
+        if isinstance(t, (list, tuple)):
+            return type(t)(rec(e) for e in t)
+        return next(it)
+
+    return rec(template)
+
+
+def capture_program(fn, args, kwargs=None):
+    """Trace `fn` (already AST-converted) into a fresh static Program.
+    Tensor/ndarray args become feed vars; everything else is baked in."""
+    kwargs = kwargs or {}
+    main = framework.Program()
+    startup = framework.Program()
+    ctx = CaptureContext(main)
+    feed_names = []
+    sym_args = []
+    with framework.program_guard(main, startup):
+        gb = main.global_block()
+        for i, a in enumerate(args):
+            if _is_tensor_arg(a):
+                shape = tuple(a.shape)
+                dtype = a.dtype if isinstance(a, dy_base.Tensor) \
+                    else normalize_dtype(a.dtype)
+                name = "declarative_in_%d" % i
+                var = gb.create_var(name=name, shape=shape, dtype=dtype,
+                                    is_data=True, stop_gradient=True)
+                feed_names.append(name)
+                sym_args.append(SymbolicTensor(var))
+            else:
+                sym_args.append(a)
+        prev = current_ctx()
+        _state.ctx = ctx
+        # leave dygraph mode: Block.append_op refuses to run under an
+        # active eager tracer, and capture must not hit the eager path
+        old_tracer = framework._switch_tracer(None)
+        try:
+            out = fn(*sym_args, **kwargs)
+        finally:
+            framework._switch_tracer(old_tracer)
+            _state.ctx = prev
+    flat = _flatten_outs(out, [])
+    fetch_vars = []
+    for o in flat:
+        if isinstance(o, SymbolicTensor):
+            fetch_vars.append(o._var)
+        elif isinstance(o, framework.Variable):
+            fetch_vars.append(o)
+        else:
+            raise TypeError(
+                "@declarative function returned a non-Tensor leaf %r" % (o,))
+    return ConcreteProgram(main, startup, feed_names, fetch_vars, out, ctx)
+
+
+# ---------------------------------------------------------------------------
+# ProgramTranslator + StaticFunction (the @declarative wrapper)
+# ---------------------------------------------------------------------------
+
+class ProgramTranslator:
+    """Process-wide switch + cache owner (reference:
+    program_translator.py:349; singleton via get_instance)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance.enable_to_static = True
+        return cls._instance
+
+    @classmethod
+    def get_instance(cls):
+        return cls()
+
+    def enable(self, enable_to_static=True):
+        self.enable_to_static = bool(enable_to_static)
+
+    def get_program(self, fn, *args, **kwargs):
+        sf = fn if isinstance(fn, StaticFunction) else StaticFunction(fn)
+        concrete = sf.concrete_program(*args, **kwargs)
+        return concrete.main, concrete.startup, concrete.feed_names, \
+            concrete.fetch_vars
+
+    def get_func(self, fn):
+        from .ast_transformer import convert_to_static
+
+        return convert_to_static(fn)
+
+    def get_output(self, fn, *args, **kwargs):
+        sf = fn if isinstance(fn, StaticFunction) else StaticFunction(fn)
+        return sf(*args, **kwargs)
+
+
+class StaticFunction:
+    """Callable produced by @declarative: per-signature capture cache;
+    falls back to plain eager execution when translation is disabled."""
+
+    def __init__(self, fn):
+        functools.update_wrapper(self, fn)
+        self._fn = fn
+        self._converted = None
+        self._cache: Dict[tuple, ConcreteProgram] = {}
+        self._bound_to = None
+        # per-Layer-instance caches: a ConcreteProgram pins the
+        # instance's parameters, so its lifetime must follow the instance
+        self._instance_caches = weakref.WeakKeyDictionary()
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        bound = StaticFunction.__new__(StaticFunction)
+        bound.__dict__.update(self.__dict__)
+        bound._bound_to = obj
+        try:
+            cache = self._instance_caches.get(obj)
+            if cache is None:
+                cache = {}
+                self._instance_caches[obj] = cache
+        except TypeError:  # unweakrefable instance: uncached per call
+            cache = {}
+        bound._cache = cache
+        return bound
+
+    @property
+    def converted(self):
+        if self._converted is None:
+            from .ast_transformer import convert_to_static
+
+            self._converted = convert_to_static(self._fn)
+        return self._converted
+
+    def _full_args(self, args):
+        if self._bound_to is not None:
+            return (self._bound_to,) + tuple(args)
+        return tuple(args)
+
+    def concrete_program(self, *args, **kwargs):
+        # the bound instance is identified by its per-instance cache, so
+        # the key covers only the call arguments
+        key = tuple(_spec_of(a) for a in args) + tuple(
+            sorted((k, _spec_of(v)) for k, v in kwargs.items()))
+        cp = self._cache.get(key)
+        if cp is None:
+            cp = capture_program(self.converted, self._full_args(args),
+                                 kwargs)
+            self._cache[key] = cp
+        return cp
+
+    def __call__(self, *args, **kwargs):
+        if current_ctx() is not None:
+            # nested @declarative: inline into the enclosing capture
+            return self.converted(*self._full_args(args), **kwargs)
+        if not ProgramTranslator.get_instance().enable_to_static:
+            return self._fn(*self._full_args(args), **kwargs)
+        cp = self.concrete_program(*args, **kwargs)
+        tensor_args = [a for a in self._full_args(args)
+                       if _is_tensor_arg(a)]
+        return cp.run(tensor_args)

@@ -186,6 +186,13 @@ class Layer:
         if isinstance(value, base.Tensor) and value.persistable:
             params = self.__dict__.get("_parameters")
             if params is not None:
+                buffers = self.__dict__.get("_buffers")
+                if buffers is not None and name in buffers:
+                    # re-point the existing buffer slot rather than
+                    # shadowing it in _parameters: state-dict keys are
+                    # attribute paths and must stay unique
+                    buffers[name] = value
+                    return
                 params[name] = value
                 return
         if isinstance(value, Layer):

@@ -217,6 +217,13 @@ class Parameter(Variable):
 # Operator
 # ---------------------------------------------------------------------------
 
+def _var_name(v):
+    """Var name for IR storage; unwraps SymbolicTensor (dygraph capture
+    wrapper around a static Variable) so static layers accept either."""
+    v = getattr(v, "_var", v)
+    return v.name if isinstance(v, Variable) else v
+
+
 class Operator:
     """One op in a block: type + slot->var-name maps + attrs
     (reference: framework.py:1822 / framework.proto OpDesc)."""
@@ -229,11 +236,11 @@ class Operator:
         self.output_names: Dict[str, List[str]] = {}
         for slot, vs in (inputs or {}).items():
             self.input_names[slot] = [
-                v.name if isinstance(v, Variable) else v
+                _var_name(v)
                 for v in (vs if isinstance(vs, (list, tuple)) else [vs])]
         for slot, vs in (outputs or {}).items():
             self.output_names[slot] = [
-                v.name if isinstance(v, Variable) else v
+                _var_name(v)
                 for v in (vs if isinstance(vs, (list, tuple)) else [vs])]
         self.attrs = dict(attrs or {})
 
@@ -355,6 +362,7 @@ class Block:
             vs = vs if isinstance(vs, (list, tuple)) else [vs]
             specs = []
             for v in vs:
+                v = getattr(v, "_var", v)
                 var = v if isinstance(v, Variable) else self.var(v)
                 specs.append((var.shape, var.dtype))
             in_specs[slot] = specs

@@ -410,6 +410,22 @@ def minimum(x, y, name=None):
     return _single("minimum", {"X": [x], "Y": [y]}, {}, dtype=x.dtype)
 
 
+def logical_and(x, y, out=None, name=None):
+    return _single("logical_and", {"X": [x], "Y": [y]}, {}, dtype="bool")
+
+
+def logical_or(x, y, out=None, name=None):
+    return _single("logical_or", {"X": [x], "Y": [y]}, {}, dtype="bool")
+
+
+def logical_xor(x, y, out=None, name=None):
+    return _single("logical_xor", {"X": [x], "Y": [y]}, {}, dtype="bool")
+
+
+def logical_not(x, out=None, name=None):
+    return _single("logical_not", {"X": [x]}, {}, dtype="bool")
+
+
 def _make_reduce(op_type):
     def f(input, dim=None, keep_dim=False, name=None):
         if dim is None:

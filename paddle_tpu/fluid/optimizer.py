@@ -44,6 +44,9 @@ class Optimizer:
         self._accumulators: Dict[str, Dict[str, Variable]] = {}
         self._lr_var_per_program = {}
         self.helper = None
+        # checkpoint state applied lazily as accumulators are created
+        # ("<param_name>_<acc_name>" -> ndarray)
+        self._loaded_state: Dict[str, object] = {}
 
     # -- learning rate -----------------------------------------------------
     def _global_learning_rate(self, program=None):
@@ -83,6 +86,8 @@ class Optimizer:
         if param.name in accs:
             return accs[param.name]
         if in_dygraph_mode():
+            import jax.numpy as jnp
+
             from .dygraph import base as dy_base
 
             var = dy_base.create_eager_parameter(
@@ -90,6 +95,10 @@ class Optimizer:
                 ConstantInitializer(fill_value), trainable=False,
                 name=unique_name("%s_%s_%s" % (self._name, param.name,
                                                name)))
+            loaded = self._loaded_state.pop(
+                "%s_%s" % (param.name, name), None)
+            if loaded is not None:
+                var._assign_raw(jnp.asarray(loaded))
             accs[param.name] = var
             return var
         helper = LayerHelper(self._name)
@@ -200,7 +209,26 @@ class Optimizer:
         return out
 
     def set_state_dict(self, d):
-        pass
+        """Restore accumulator values (keys "<param_name>_<acc_name>").
+        Existing accumulators are overwritten in place; not-yet-created
+        ones are applied lazily at creation (reference: optimizer
+        state_dict round trip, dygraph/checkpoint.py:98)."""
+        import jax.numpy as jnp
+
+        remaining = dict(d)
+        for name, accs in self._accumulators.items():
+            for pname, var in accs.items():
+                key = "%s_%s" % (pname, name)
+                if key in remaining:
+                    val = remaining.pop(key)
+                    if hasattr(var, "_assign_raw"):
+                        var._assign_raw(jnp.asarray(np.asarray(val)))
+                    else:
+                        from ..core.scope import global_scope
+
+                        global_scope().set_var(var.name,
+                                               jnp.asarray(np.asarray(val)))
+        self._loaded_state.update(remaining)
 
 
 # ---------------------------------------------------------------------------

@@ -51,7 +51,8 @@ class Accuracy(Metric):
 
     def update(self, correct, *args):
         correct = _to_numpy(correct)
-        num = correct.shape[0]
+        # samples = every batch position (all dims except the top-k one)
+        num = int(np.prod(correct.shape[:-1])) if correct.ndim else 1
         for i, k in enumerate(self.topk):
             c = correct[..., :k].max(axis=-1).sum()
             self.total[i] += float(c)

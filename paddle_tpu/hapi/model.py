@@ -227,7 +227,6 @@ class Model:
                  num_workers=0, callbacks=None):
         loader = self._make_loader(eval_data, batch_size, False, False,
                                    num_workers)
-        own_cbks = callbacks is None
         cbks = callbacks if callbacks is not None else config_callbacks(
             None, model=self, steps=len(loader) if hasattr(
                 loader, "__len__") else None,
@@ -281,22 +280,48 @@ class Model:
     # -- persistence -------------------------------------------------------
     def save(self, path):
         """Write `<path>.pdparams` (+ `<path>.pdopt` when an optimizer
-        with state is attached) — reference: model.py:907."""
+        with state is attached) — reference: model.py:907. Optimizer
+        accumulators are keyed `<structured param key>||<acc name>` so
+        they restore into a freshly built network."""
         save_dygraph(self.network.state_dict(), path)
-        if self._optimizer is not None:
-            opt_state = {}
-            for k, v in self._optimizer.state_dict().items():
-                opt_state[k] = v.numpy() if hasattr(v, "numpy") \
-                    else np.asarray(v)
-            if opt_state:
-                os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-                with open(path + ".pdopt", "wb") as f:
-                    pickle.dump(opt_state, f, protocol=2)
+        if self._optimizer is None:
+            return
+        name_map = {p.name: structured for structured, p
+                    in self.network.state_dict().items()}
+        opt_state = {}
+        for accname, accs in self._optimizer._accumulators.items():
+            for pname, var in accs.items():
+                key = "%s||%s" % (name_map.get(pname, pname), accname)
+                opt_state[key] = var.numpy() if hasattr(var, "numpy") \
+                    else np.asarray(var)
+        if opt_state:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            with open(path + ".pdopt", "wb") as f:
+                pickle.dump(opt_state, f, protocol=2)
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):
         with open(path + ".pdparams", "rb") as f:
             state = pickle.load(f)
         self.network.set_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path):
+            with open(opt_path, "rb") as f:
+                opt_state = pickle.load(f)
+            rev = {structured: p.name for structured, p
+                   in self.network.state_dict().items()}
+            runtime = {}
+            for key, val in opt_state.items():
+                structured, _, accname = key.rpartition("||")
+                pname = rev.get(structured)
+                if pname is None:
+                    if not skip_mismatch:
+                        raise KeyError(
+                            "optimizer state %r has no matching "
+                            "parameter" % key)
+                    continue
+                runtime["%s_%s" % (pname, accname)] = val
+            self._optimizer.set_state_dict(runtime)
         return self
 
     def summary(self):

@@ -1,0 +1,146 @@
+"""@declarative / ProgramTranslator / TracedLayer / jit save-load tests
+(reference test shape: tests/unittests/dygraph_to_static/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import dygraph
+from paddle_tpu.fluid.dygraph import declarative, TracedLayer
+from paddle_tpu.fluid.dygraph.dygraph_to_static import ProgramTranslator
+
+
+class SimpleNet(paddle.nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = paddle.nn.Linear(8, 4)
+
+    @declarative
+    def forward(self, x):
+        y = self.fc(x)
+        return y * 2.0
+
+
+def test_declarative_matches_eager():
+    with dygraph.guard():
+        net = SimpleNet()
+        x = np.random.rand(3, 8).astype("float32")
+        out_static = net(paddle.to_tensor(x))
+        # eager twin through the same weights
+        ProgramTranslator.get_instance().enable(False)
+        try:
+            out_eager = net(paddle.to_tensor(x))
+        finally:
+            ProgramTranslator.get_instance().enable(True)
+        np.testing.assert_allclose(out_static.numpy(), out_eager.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_declarative_signature_cache():
+    calls = []
+
+    @declarative
+    def f(x):
+        calls.append(1)
+        return x + 1.0
+
+    with dygraph.guard():
+        a = f(paddle.to_tensor(np.zeros((2, 3), "float32")))
+        b = f(paddle.to_tensor(np.ones((2, 3), "float32")))
+        c = f(paddle.to_tensor(np.ones((4, 3), "float32")))
+    assert np.allclose(a.numpy(), 1.0) and np.allclose(b.numpy(), 2.0)
+    assert c.shape == (4, 3)
+    # capture ran once per signature, not per call
+    assert len(calls) == 2
+
+
+def test_declarative_tensor_if():
+    @declarative
+    def f(x):
+        if paddle.fluid.layers.reduce_sum(x) > 0.0:
+            y = x * 2.0
+        else:
+            y = x - 1.0
+        return y
+
+    with dygraph.guard():
+        pos = f(paddle.to_tensor(np.ones((2, 2), "float32")))
+        neg = f(paddle.to_tensor(-np.ones((2, 2), "float32")))
+    np.testing.assert_allclose(pos.numpy(), 2.0 * np.ones((2, 2)))
+    np.testing.assert_allclose(neg.numpy(), -2.0 * np.ones((2, 2)))
+
+
+def test_declarative_tensor_while():
+    @declarative
+    def f(x):
+        i = paddle.to_tensor(np.asarray([0.0], "float32"))
+        while i < 3.0:
+            x = x + 1.0
+            i = i + 1.0
+        return x
+
+    with dygraph.guard():
+        out = f(paddle.to_tensor(np.zeros((2,), "float32")))
+    np.testing.assert_allclose(out.numpy(), 3.0 * np.ones((2,)))
+
+
+def test_declarative_return_branches():
+    @declarative
+    def f(x):
+        s = paddle.fluid.layers.reduce_sum(x)
+        if s > 0.0:
+            return x * 10.0
+        else:
+            return x * -10.0
+
+    with dygraph.guard():
+        out = f(paddle.to_tensor(np.ones((2,), "float32")))
+    np.testing.assert_allclose(out.numpy(), 10.0 * np.ones((2,)))
+
+
+def test_traced_layer_and_inference_export(tmp_path):
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(6, 3)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    with dygraph.guard():
+        net = Net()
+        x = paddle.to_tensor(np.random.rand(2, 6).astype("float32"))
+        eager_out, traced = TracedLayer.trace(net, [x])
+        static_out = traced(x)[0]
+        np.testing.assert_allclose(eager_out.numpy(), static_out.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        d = str(tmp_path / "inf")
+        traced.save_inference_model(d)
+
+    loaded = dygraph.jit.load(d)
+    out2 = loaded(np.asarray(x.numpy()))
+    np.testing.assert_allclose(out2.numpy(), eager_out.numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_jit_save_load(tmp_path):
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(5, 2)
+
+        @declarative
+        def forward(self, x):
+            return self.fc(x)
+
+    with dygraph.guard():
+        net = Net()
+        x = np.random.rand(4, 5).astype("float32")
+        want = net(paddle.to_tensor(x)).numpy()
+        d = str(tmp_path / "jit_model")
+        dygraph.jit.save(net, d, input_spec=[
+            paddle.hapi.Input(shape=[4, 5], dtype="float32")])
+
+    loaded = dygraph.jit.load(d)
+    got = loaded(x).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)

@@ -98,3 +98,28 @@ def test_tensor_dataset_and_train_batch(prepared_model):
     assert xi.shape == (1, 8, 8)
     loss, metrics = prepared_model.train_batch([x], [y])
     assert np.isfinite(loss[0])
+
+
+def test_save_load_optimizer_state(tmp_path, prepared_model):
+    data = SyntheticImages(num_samples=64)
+    prepared_model.fit(data, batch_size=32, epochs=1, verbose=0)
+    path = str(tmp_path / "resume")
+    prepared_model.save(path)
+    import os
+    assert os.path.exists(path + ".pdopt")
+
+    m2 = make_model()
+    opt2 = paddle.fluid.optimizer.AdamOptimizer(learning_rate=1e-2)
+    m2.prepare(optimizer=opt2,
+               loss_function=paddle.nn.CrossEntropyLoss(),
+               metrics=Accuracy())
+    m2.load(path)
+    # run one batch so lazily-created accumulators pick up loaded state
+    x = np.stack([data[i][0] for i in range(8)])
+    y = np.stack([data[i][1] for i in range(8)])
+    m2.train_batch([x], [y])
+    # moment1 must not be all-zero after restore+step from checkpoint
+    accs = opt2._accumulators.get("moment1", {})
+    assert accs, "Adam accumulators missing"
+    total = sum(float(np.abs(v.numpy()).sum()) for v in accs.values())
+    assert total > 0.0
