@@ -41,6 +41,31 @@ from . import incubate  # noqa: F401
 from .hapi import Model  # noqa: F401
 from .hapi.model import Input as static_Input  # noqa: F401
 
+# 2.0 functional surface: paddle.add / paddle.matmul / ... (reference:
+# python/paddle/__init__.py re-exporting paddle.tensor)
+from .tensor import (  # noqa: F401
+    add, subtract, multiply, divide, floor_divide, mod, remainder, pow,
+    maximum, minimum, sqrt, rsqrt, square, abs, sign, ceil, floor, round,
+    reciprocal, exp, log, log2, log10, log1p, sin, cos, tan, asin, acos,
+    atan, sinh, cosh, tanh, erf, sum, mean, max, min, prod, all, any,
+    cumsum, clip, isnan, isinf, isfinite, add_n, increment, scale, stanh,
+    matmul, bmm, dot, norm, t, dist, var, std,
+    zeros, ones, full, zeros_like, ones_like, full_like, arange, linspace,
+    eye, diag, meshgrid, tril, triu, clone, empty, numel,
+    reshape, transpose, concat, stack, unstack, split, chunk, squeeze,
+    unsqueeze, flatten, flip, roll, tile, expand, broadcast_to, expand_as,
+    gather, gather_nd, scatter, scatter_nd_add, slice, strided_slice,
+    cast, unique, take_along_axis,
+    equal, not_equal, less_than, less_equal, greater_than, greater_equal,
+    logical_and, logical_or, logical_xor, logical_not, equal_all, allclose,
+    argmax, argmin, argsort, sort, topk, where, nonzero, index_select,
+    masked_select,
+)
+from .tensor.random import (  # noqa: F401
+    uniform, normal, rand, randn, randint, randperm, bernoulli,
+    multinomial,
+)
+
 
 def to_tensor(data, dtype=None, place=None, stop_gradient=True):
     import numpy as np

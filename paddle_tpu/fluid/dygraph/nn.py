@@ -242,3 +242,49 @@ class GRUUnit(Layer):
         new_h = u * hidden + (base.wrap_raw(
             np.asarray(1.0, "float32")) - u) * c
         return new_h, new_h, g
+
+
+class Conv2DTranspose(Layer):
+    """Transposed conv (reference: dygraph/nn.py Conv2DTranspose over
+    operators/conv_transpose_op.cc); lowers to lax.conv_transpose."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None,
+                 dtype="float32"):
+        super().__init__()
+        if isinstance(filter_size, int):
+            filter_size = [filter_size, filter_size]
+        self._stride = ([stride, stride] if isinstance(stride, int)
+                        else list(stride))
+        self._padding = ([padding, padding] if isinstance(padding, int)
+                         else list(padding))
+        self._dilation = ([dilation, dilation]
+                          if isinstance(dilation, int) else list(dilation))
+        self._groups = groups
+        self._act = act
+        fan_in = num_channels * filter_size[0] * filter_size[1]
+        std = (2.0 / fan_in) ** 0.5
+        # IOHW layout: (in_channels, out_channels/groups, kh, kw)
+        self.weight = self.create_parameter(
+            shape=[num_channels, num_filters // groups] + filter_size,
+            attr=param_attr, dtype=dtype,
+            default_initializer=NormalInitializer(0.0, std))
+        self.bias = self.create_parameter(
+            shape=[num_filters], attr=bias_attr, dtype=dtype, is_bias=True)
+
+    def forward(self, input):
+        out = trace_op("conv2d_transpose",
+                       {"Input": [input], "Filter": [self.weight]},
+                       {"strides": self._stride,
+                        "paddings": self._padding,
+                        "dilations": self._dilation,
+                        "groups": self._groups},
+                       ["Output"])[0]
+        if self.bias is not None:
+            out = trace_op("elementwise_add",
+                           {"X": [out], "Y": [self.bias]}, {"axis": 1},
+                           ["Out"])[0]
+        if self._act:
+            out = trace_op(self._act, {"X": [out]}, {}, ["Out"])[0]
+        return out

@@ -35,9 +35,17 @@ __all__ = [
 
 class Optimizer:
     def __init__(self, learning_rate, parameter_list=None,
-                 regularization=None, grad_clip=None, name=None):
+                 regularization=None, grad_clip=None, name=None,
+                 parameters=None, weight_decay=None):
         self._learning_rate = learning_rate
-        self._parameter_list = parameter_list
+        # `parameters`/`weight_decay` are the 2.0-API spellings
+        self._parameter_list = parameter_list if parameter_list is not None \
+            else parameters
+        if regularization is None and weight_decay is not None:
+            from .regularizer import L2Decay
+
+            regularization = weight_decay if not isinstance(
+                weight_decay, (int, float)) else L2Decay(float(weight_decay))
         self.regularization = regularization
         self._grad_clip = grad_clip
         self._name = name or unique_name(type(self).__name__)
@@ -164,6 +172,22 @@ class Optimizer:
                                      no_grad_set)
         optimize_ops = self.apply_gradients(params_grads)
         return optimize_ops, params_grads
+
+    # -- 2.0 dygraph API ---------------------------------------------------
+    def step(self):
+        """Apply gradients accumulated by loss.backward() (2.0 API)."""
+        params = self._parameter_list
+        if params is None:
+            raise ValueError("step() needs the optimizer constructed with "
+                             "parameters=layer.parameters()")
+        params_grads = [(p, p._grad_tensor()) for p in params
+                        if getattr(p, "trainable", True)
+                        and p._grad_tensor() is not None]
+        self._dygraph_step(params_grads)
+
+    def clear_grad(self):
+        for p in self._parameter_list or []:
+            p.clear_gradient()
 
     # -- dygraph eager path ------------------------------------------------
     def _minimize_dygraph(self, loss, parameter_list=None, no_grad_set=None):

@@ -1,5 +1,6 @@
 """paddle.nn 2.0-style surface (reference: `python/paddle/nn/`) — thin
 re-exports over the fluid dygraph layer library."""
+from ..fluid.initializer import ConstantInitializer
 from ..fluid.dygraph.layers import (  # noqa: F401
     Layer, Sequential, LayerList, ParameterList,
 )
@@ -79,3 +80,212 @@ class MSELoss(Layer):
         if self._reduction == "sum":
             return N.reduce_sum(out)
         return out
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        from ..fluid.layers import nn as N
+
+        return N.leaky_relu(x, alpha=self._slope)
+
+
+class Hardswish(Layer):
+    def forward(self, x):
+        from ..fluid.layers import nn as N
+
+        return N.hard_swish(x)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [num_parameters],
+            default_initializer=ConstantInitializer(init))
+
+    def forward(self, x):
+        from .. import tensor as T
+
+        pos = T.maximum(x, T.zeros_like(x))
+        neg = T.minimum(x, T.zeros_like(x)) * self.weight
+        return pos + neg
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis=1, stop_axis=-1):
+        super().__init__()
+        self._start, self._stop = start_axis, stop_axis
+
+    def forward(self, x):
+        from ..tensor import manipulation as M
+
+        return M.flatten(x, self._start, self._stop)
+
+
+class MaxPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__()
+        self._k, self._s, self._p = kernel_size, stride or kernel_size, \
+            padding
+
+    def forward(self, x):
+        from ..fluid.layers import nn as N
+
+        return N.pool2d(x, pool_size=self._k, pool_type="max",
+                        pool_stride=self._s, pool_padding=self._p)
+
+
+class AvgPool2D(MaxPool2D):
+    def forward(self, x):
+        from ..fluid.layers import nn as N
+
+        return N.pool2d(x, pool_size=self._k, pool_type="avg",
+                        pool_stride=self._s, pool_padding=self._p)
+
+
+class AdaptiveAvgPool2D(Layer):
+    def __init__(self, output_size):
+        super().__init__()
+        self._out = output_size
+
+    def forward(self, x):
+        from ..fluid.layers import nn as N
+
+        return N.adaptive_pool2d(x, self._out, pool_type="avg")
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self._groups = num_groups
+        self._eps = epsilon
+        self.weight = self.create_parameter(
+            [num_channels], attr=weight_attr,
+            default_initializer=ConstantInitializer(1.0))
+        self.bias = self.create_parameter([num_channels], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x):
+        from ..fluid.layer_helper import apply_op
+
+        return apply_op("group_norm", "group_norm",
+                        {"X": [x], "Scale": [self.weight],
+                         "Bias": [self.bias]},
+                        {"groups": self._groups, "epsilon": self._eps},
+                        ["Y"], out_dtype="float32")[0]
+
+
+class InstanceNorm2D(Layer):
+    def __init__(self, num_features, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        self._eps = epsilon
+        self.weight = self.create_parameter(
+            [num_features], attr=weight_attr,
+            default_initializer=ConstantInitializer(1.0))
+        self.bias = self.create_parameter([num_features], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x):
+        from ..fluid.layer_helper import apply_op
+
+        return apply_op("instance_norm", "instance_norm",
+                        {"X": [x], "Scale": [self.weight],
+                         "Bias": [self.bias]},
+                        {"epsilon": self._eps}, ["Y"],
+                        out_dtype="float32")[0]
+
+
+class L1Loss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        from .. import tensor as T
+
+        out = T.abs(T.subtract(input, label))
+        if self._reduction == "mean":
+            return T.mean(out)
+        if self._reduction == "sum":
+            return T.sum(out)
+        return out
+
+
+class BCEWithLogitsLoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, logit, label):
+        from ..fluid.layers import loss as L
+        from .. import tensor as T
+
+        out = L.sigmoid_cross_entropy_with_logits(logit, label)
+        if self._reduction == "mean":
+            return T.mean(out)
+        if self._reduction == "sum":
+            return T.sum(out)
+        return out
+
+
+class KLDivLoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        from ..fluid.layers import loss as L
+        from .. import tensor as T
+
+        out = L.kldiv_loss(input, label, reduction="none")
+        if self._reduction == "mean":
+            return T.mean(out)
+        if self._reduction == "sum":
+            return T.sum(out)
+        return out
+
+
+class SmoothL1Loss(Layer):
+    def __init__(self, reduction="mean", delta=1.0):
+        super().__init__()
+        self._reduction = reduction
+        self._delta = delta
+
+    def forward(self, input, label):
+        from ..fluid.layer_helper import apply_op
+        from .. import tensor as T
+
+        out = apply_op("huber_loss", "huber_loss",
+                       {"X": [input], "Y": [label]},
+                       {"delta": self._delta}, ["Out"],
+                       out_dtype="float32")[0]
+        if self._reduction == "mean":
+            return T.mean(out)
+        if self._reduction == "sum":
+            return T.sum(out)
+        return out
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        from ..fluid.dygraph import nn as dnn
+
+        self._impl = dnn.Conv2DTranspose(
+            in_channels, out_channels, kernel_size, stride=stride,
+            padding=padding, dilation=dilation, groups=groups,
+            param_attr=weight_attr, bias_attr=bias_attr)
+
+    def forward(self, x):
+        return self._impl(x)
+
+
+from .rnn import LSTM, GRU  # noqa: F401,E402

@@ -1,5 +1,6 @@
 """paddle.nn.functional (reference: `python/paddle/nn/functional/`) — the
-mode-polymorphic layer functions re-exported."""
+mode-polymorphic layer functions re-exported plus 2.0-only entry
+points."""
 from ..fluid.layers.nn import (  # noqa: F401
     relu, sigmoid, tanh, gelu, leaky_relu, elu, relu6, softplus, softsign,
     swish, hard_sigmoid, hard_swish, logsigmoid, erf, softmax, log_softmax,
@@ -10,3 +11,92 @@ from ..fluid.layers.loss import (  # noqa: F401
     sigmoid_cross_entropy_with_logits, square_error_cost, mse_loss,
     kldiv_loss,
 )
+from ..fluid.layer_helper import apply_op as _apply_op
+from ..fluid.layers import nn as _nn
+
+
+def linear(x, weight, bias=None, name=None):
+    out = _nn.matmul(x, weight)
+    if bias is not None:
+        ndim = len(getattr(out, "shape", ())) or 1
+        out = _apply_op("elementwise_add", "elementwise_add",
+                        {"X": [out], "Y": [bias]}, {"axis": ndim - 1},
+                        ["Out"],
+                        out_dtype=getattr(x, "dtype", "float32"))[0]
+    return out
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, name=None):
+    def _pair(v):
+        return [v, v] if isinstance(v, int) else list(v)
+
+    out = _apply_op("conv2d", "conv2d",
+                    {"Input": [x], "Filter": [weight]},
+                    {"strides": _pair(stride), "paddings": _pair(padding),
+                     "dilations": _pair(dilation), "groups": groups},
+                    ["Output"],
+                    out_dtype=getattr(x, "dtype", "float32"))[0]
+    if bias is not None:
+        out = _apply_op("elementwise_add", "elementwise_add",
+                        {"X": [out], "Y": [bias]}, {"axis": 1}, ["Out"],
+                        out_dtype=getattr(x, "dtype", "float32"))[0]
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, name=None):
+    return _nn.pool2d(x, pool_size=kernel_size, pool_type="max",
+                      pool_stride=stride or kernel_size,
+                      pool_padding=padding)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, name=None):
+    return _nn.pool2d(x, pool_size=kernel_size, pool_type="avg",
+                      pool_stride=stride or kernel_size,
+                      pool_padding=padding)
+
+
+def adaptive_avg_pool2d(x, output_size, name=None):
+    return _nn.adaptive_pool2d(x, output_size, pool_type="avg")
+
+
+def embedding(x, weight, padding_idx=None, name=None):
+    return _apply_op("lookup_table_v2", "lookup_table_v2",
+                     {"Ids": [x], "W": [weight]},
+                     {"padding_idx": -1 if padding_idx is None
+                      else padding_idx}, ["Out"],
+                     out_dtype=getattr(weight, "dtype", "float32"))[0]
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return _nn.l2_normalize(x, axis=axis, epsilon=epsilon)
+
+
+def binary_cross_entropy_with_logits(logit, label, reduction="mean",
+                                     name=None):
+    out = sigmoid_cross_entropy_with_logits(logit, label)
+    if reduction == "mean":
+        return _nn.mean(out)
+    if reduction == "sum":
+        return _nn.reduce_sum(out)
+    return out
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    out = _nn.abs(_nn.elementwise_sub(input, label))
+    if reduction == "mean":
+        return _nn.mean(out)
+    if reduction == "sum":
+        return _nn.reduce_sum(out)
+    return out
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    out = _apply_op("huber_loss", "huber_loss",
+                    {"X": [input], "Y": [label]}, {"delta": delta},
+                    ["Out"], out_dtype="float32")[0]
+    if reduction == "mean":
+        return _nn.mean(out)
+    if reduction == "sum":
+        return _nn.reduce_sum(out)
+    return out

@@ -1,0 +1,169 @@
+"""Recurrent layers (reference: `python/paddle/fluid/layers/rnn.py`
+LSTMCell/GRUCell/dynamic_rnn + paddle.nn.LSTM/GRU). TPU-native: each
+layer-direction is ONE `lstm_seq`/`gru_seq` op, scanned by lax.scan
+with the input projection hoisted out of the loop onto the MXU."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..fluid.dygraph.layers import Layer
+from ..fluid.initializer import UniformInitializer
+from ..fluid.layer_helper import apply_op
+from ..fluid.layers import tensor as _t
+
+
+def _uniform(hidden_size):
+    k = 1.0 / np.sqrt(hidden_size)
+    return UniformInitializer(-k, k)
+
+
+class _RNNBase(Layer):
+    GATES = None  # 4 for LSTM, 3 for GRU
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dtype="float32"):
+        super().__init__()
+        assert direction in ("forward", "bidirect", "bidirectional")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.bidirectional = direction != "forward"
+        self.time_major = time_major
+        self._dirs = 2 if self.bidirectional else 1
+        g = self.GATES
+        self._weights = []
+        for layer in range(num_layers):
+            in_dim = input_size if layer == 0 \
+                else hidden_size * self._dirs
+            for d in range(self._dirs):
+                tag = "l%d%s" % (layer, "_rev" if d else "")
+                w = {
+                    "w_ih": self.create_parameter(
+                        [g * hidden_size, in_dim],
+                        default_initializer=_uniform(hidden_size)),
+                    "w_hh": self.create_parameter(
+                        [g * hidden_size, hidden_size],
+                        default_initializer=_uniform(hidden_size)),
+                }
+                for k, v in list(w.items()):
+                    self.add_parameter("%s_%s" % (k, tag), v)
+                w.update(self._make_biases(g, hidden_size, tag))
+                self._weights.append(w)
+
+    def _make_biases(self, g, hidden_size, tag):
+        raise NotImplementedError
+
+    def _zeros_state(self, x, batch):
+        return _t.fill_constant([batch, self.hidden_size],
+                                "float32", 0.0)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = inputs
+        if self.time_major:
+            x = _t.transpose(x, [1, 0, 2])
+        batch = x.shape[0]
+        states = self._init_states(x, batch, initial_states)
+        outs, last_states = self._run_stack(x, states)
+        if self.time_major:
+            outs = _t.transpose(outs, [1, 0, 2])
+        return outs, last_states
+
+
+class LSTM(_RNNBase):
+    GATES = 4
+
+    def _make_biases(self, g, hidden_size, tag):
+        b = self.create_parameter([g * hidden_size], is_bias=True,
+                                  default_initializer=_uniform(
+                                      hidden_size))
+        self.add_parameter("b_%s" % tag, b)
+        return {"b": b}
+
+    def _init_states(self, x, batch, initial_states):
+        n = self.num_layers * self._dirs
+        if initial_states is None:
+            zeros = [self._zeros_state(x, batch) for _ in range(n)]
+            return list(zip(zeros, [self._zeros_state(x, batch)
+                                    for _ in range(n)]))
+        h0, c0 = initial_states
+        hs = _split_state(h0, n)
+        cs = _split_state(c0, n)
+        return list(zip(hs, cs))
+
+    def _run_stack(self, x, states):
+        idx = 0
+        hs, cs = [], []
+        for layer in range(self.num_layers):
+            dir_outs = []
+            for d in range(self._dirs):
+                w = self._weights[idx]
+                h0, c0 = states[idx]
+                out, h, c = apply_op(
+                    "lstm_seq", "lstm_seq",
+                    {"Input": [x], "WeightIh": [w["w_ih"]],
+                     "WeightHh": [w["w_hh"]], "Bias": [w["b"]],
+                     "InitH": [h0], "InitC": [c0]},
+                    {"is_reverse": bool(d)},
+                    ["Out", "LastH", "LastC"], out_dtype="float32")
+                dir_outs.append(out)
+                hs.append(h)
+                cs.append(c)
+                idx += 1
+            x = dir_outs[0] if len(dir_outs) == 1 else \
+                _t.concat(dir_outs, axis=-1)
+        return x, (_stack_state(hs), _stack_state(cs))
+
+
+class GRU(_RNNBase):
+    GATES = 3
+
+    def _make_biases(self, g, hidden_size, tag):
+        b_ih = self.create_parameter([g * hidden_size], is_bias=True,
+                                     default_initializer=_uniform(
+                                         hidden_size))
+        b_hh = self.create_parameter([g * hidden_size], is_bias=True,
+                                     default_initializer=_uniform(
+                                         hidden_size))
+        self.add_parameter("b_ih_%s" % tag, b_ih)
+        self.add_parameter("b_hh_%s" % tag, b_hh)
+        return {"b_ih": b_ih, "b_hh": b_hh}
+
+    def _init_states(self, x, batch, initial_states):
+        n = self.num_layers * self._dirs
+        if initial_states is None:
+            return [self._zeros_state(x, batch) for _ in range(n)]
+        return _split_state(initial_states, n)
+
+    def _run_stack(self, x, states):
+        idx = 0
+        hs = []
+        for layer in range(self.num_layers):
+            dir_outs = []
+            for d in range(self._dirs):
+                w = self._weights[idx]
+                out, h = apply_op(
+                    "gru_seq", "gru_seq",
+                    {"Input": [x], "WeightIh": [w["w_ih"]],
+                     "WeightHh": [w["w_hh"]], "BiasIh": [w["b_ih"]],
+                     "BiasHh": [w["b_hh"]], "InitH": [states[idx]]},
+                    {"is_reverse": bool(d)},
+                    ["Out", "LastH"], out_dtype="float32")
+                dir_outs.append(out)
+                hs.append(h)
+                idx += 1
+            x = dir_outs[0] if len(dir_outs) == 1 else \
+                _t.concat(dir_outs, axis=-1)
+        return x, _stack_state(hs)
+
+
+def _split_state(state, n):
+    """(n, B, H) -> list of n (B, H)."""
+    from ..fluid.layers import nn as _nn
+
+    return _nn.unstack(state, axis=0, num=n)
+
+
+def _stack_state(states):
+    from ..fluid.layers import nn as _nn
+
+    return _nn.stack(states, axis=0)

@@ -228,19 +228,24 @@ def _depthwise_conv2d(ins, attrs):
 
 @register_op("conv2d_transpose")
 def _conv2d_transpose(ins, attrs):
+    """Transposed conv as the gradient-of-conv: lhs-dilated conv with
+    the spatially flipped kernel (weight layout (in, out/groups, kh, kw)
+    matching operators/conv_transpose_op.cc). Verified against
+    torch.conv_transpose2d for stride/padding/dilation combinations."""
     x, w = ins["Input"][0], ins["Filter"][0]
     strides = _pair(attrs.get("strides", [1, 1]))
     paddings = _pair(attrs.get("paddings", [0, 0]))
     dilations = _pair(attrs.get("dilations", [1, 1]))
     groups = attrs.get("groups", 1)
-    pads = [(paddings[0], paddings[0]), (paddings[1], paddings[1])]
-    # gradient-of-conv formulation: transposed conv = lhs-dilated conv.
-    out = lax.conv_transpose(
-        x, w, strides=strides, padding=pads, rhs_dilation=dilations,
-        dimension_numbers=("NCHW", "IOHW", "NCHW"),
-        transpose_kernel=True)
     if groups != 1:
         raise NotImplementedError("grouped conv2d_transpose")
+    kh, kw = w.shape[2], w.shape[3]
+    pads = [(dilations[0] * (kh - 1) - paddings[0],) * 2,
+            (dilations[1] * (kw - 1) - paddings[1],) * 2]
+    out = lax.conv_general_dilated(
+        x, jnp.flip(w, (2, 3)), window_strides=(1, 1), padding=pads,
+        lhs_dilation=tuple(strides), rhs_dilation=tuple(dilations),
+        dimension_numbers=("NCHW", "IOHW", "NCHW"))
     return {"Output": out}
 
 
@@ -572,3 +577,75 @@ def _sdpa(ins, attrs):
     probs = jnp.where(keep, probs / (1.0 - p_drop), 0.0)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, v.astype(jnp.float32))
     return {"Out": out.astype(q.dtype)}
+
+
+# ---------------------------------------------------------------------------
+# recurrent cells over lax.scan (reference: operators/lstm_op.cc /
+# gru_op.cc + python/paddle/fluid/layers/rnn.py LSTMCell/GRUCell).
+# TPU-native: one op = the FULL sequence, scanned by XLA (static trip
+# count -> unrolled/pipelined on device), gates fused into two matmuls
+# per step that land on the MXU.
+# ---------------------------------------------------------------------------
+
+@register_op("lstm_seq")
+def _lstm_seq(ins, attrs):
+    """Single-layer LSTM over a [B,T,D] batch-major sequence.
+    Gate layout i,f,g,o in the 4H weight axis."""
+    x = ins["Input"][0]
+    w_ih = ins["WeightIh"][0]   # (4H, D)
+    w_hh = ins["WeightHh"][0]   # (4H, H)
+    b = ins["Bias"][0]          # (4H,)
+    h0 = ins["InitH"][0]        # (B, H)
+    c0 = ins["InitC"][0]        # (B, H)
+    reverse = attrs.get("is_reverse", False)
+    xs = jnp.swapaxes(x, 0, 1)  # (T,B,D) scan axis first
+    if reverse:
+        xs = xs[::-1]
+    x_proj = jnp.einsum("tbd,gd->tbg", xs, w_ih) + b  # hoisted MXU matmul
+
+    def step(carry, xp):
+        h, c = carry
+        gates = xp + h @ w_hh.T
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c = f * c + i * g
+        h = o * jnp.tanh(c)
+        return (h, c), h
+
+    (h_last, c_last), ys = lax.scan(step, (h0, c0), x_proj)
+    if reverse:
+        ys = ys[::-1]
+    return {"Out": jnp.swapaxes(ys, 0, 1), "LastH": h_last,
+            "LastC": c_last}
+
+
+@register_op("gru_seq")
+def _gru_seq(ins, attrs):
+    """Single-layer GRU over [B,T,D]; gate layout r,z,n in the 3H axis."""
+    x = ins["Input"][0]
+    w_ih = ins["WeightIh"][0]   # (3H, D)
+    w_hh = ins["WeightHh"][0]   # (3H, H)
+    b_ih = ins["BiasIh"][0]     # (3H,)
+    b_hh = ins["BiasHh"][0]     # (3H,)
+    h0 = ins["InitH"][0]
+    reverse = attrs.get("is_reverse", False)
+    xs = jnp.swapaxes(x, 0, 1)
+    if reverse:
+        xs = xs[::-1]
+    x_proj = jnp.einsum("tbd,gd->tbg", xs, w_ih) + b_ih
+
+    def step(h, xp):
+        hp = h @ w_hh.T + b_hh
+        xr, xz, xn = jnp.split(xp, 3, axis=-1)
+        hr, hz, hn = jnp.split(hp, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        h = (1.0 - z) * n + z * h
+        return h, h
+
+    h_last, ys = lax.scan(step, h0, x_proj)
+    if reverse:
+        ys = ys[::-1]
+    return {"Out": jnp.swapaxes(ys, 0, 1), "LastH": h_last}

@@ -30,18 +30,23 @@ _DYN_SENTINEL = 97
 
 
 class OpDef:
-    __slots__ = ("type", "compute", "needs_rng", "infer_shape", "n_outputs")
+    __slots__ = ("type", "compute", "needs_rng", "infer_shape", "n_outputs",
+                 "no_jit")
 
     def __init__(self, type_: str, compute: Callable, needs_rng: bool = False,
-                 infer_shape: Optional[Callable] = None):
+                 infer_shape: Optional[Callable] = None,
+                 no_jit: bool = False):
         self.type = type_
         self.compute = compute
         self.needs_rng = needs_rng
         self.infer_shape = infer_shape
+        # dynamic-output-shape ops run un-jitted on host (eager only)
+        self.no_jit = no_jit
 
 
 def register_op(type_: str, needs_rng: bool = False,
-                infer_shape: Optional[Callable] = None):
+                infer_shape: Optional[Callable] = None,
+                no_jit: bool = False):
     """Decorator: register `compute(ins, attrs) -> {slot: [array, ...]}`.
 
     `ins` maps input slot name -> list of jax arrays (possibly empty).
@@ -51,7 +56,7 @@ def register_op(type_: str, needs_rng: bool = False,
 
     def deco(fn):
         _REGISTRY[type_] = OpDef(type_, fn, needs_rng=needs_rng,
-                                 infer_shape=infer_shape)
+                                 infer_shape=infer_shape, no_jit=no_jit)
         return fn
 
     return deco
@@ -193,7 +198,13 @@ def eager_run(type_: str, ins: Dict[str, list], attrs: dict, rng_key=None):
     flat = [v for _, vals in sorted(ins.items()) for v in vals]
     attr_items = tuple(sorted((k, _hashable_attr(v)) for k, v in attrs.items()
                               if not k.startswith("_")))
-    jfn = _jitted(type_, attr_items, slot_layout, op.needs_rng)
     if op.needs_rng and rng_key is None:
         rng_key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+    if op.no_jit:
+        ins_l = {slot: list(vals) for slot, vals in ins.items()}
+        a = dict(attrs)
+        if op.needs_rng:
+            a["_rng_key"] = rng_key
+        return normalize_outs(op.compute(ins_l, a))
+    jfn = _jitted(type_, attr_items, slot_layout, op.needs_rng)
     return jfn(flat, rng_key)

@@ -350,12 +350,21 @@ def _argsort(ins, attrs):
     return {"Out": out, "Indices": idx.astype(jnp.int64)}
 
 
-@register_op("range")
+@register_op("range", no_jit=True)
 def _range(ins, attrs):
-    start = float(np.asarray(ins["Start"][0]).reshape(()))
-    end = float(np.asarray(ins["End"][0]).reshape(()))
-    step = float(np.asarray(ins["Step"][0]).reshape(()))
-    dtype = ins["Start"][0].dtype
+    # output length depends on VALUES -> host-eval, never jitted
+    if ins.get("Start"):
+        start = float(np.asarray(ins["Start"][0]).reshape(()))
+        end = float(np.asarray(ins["End"][0]).reshape(()))
+        step = float(np.asarray(ins["Step"][0]).reshape(()))
+        dtype = ins["Start"][0].dtype
+    else:  # attr form (paddle.arange 2.0 API)
+        from ..core.types import to_numpy_dtype, normalize_dtype
+
+        start, end = attrs["start"], attrs["end"]
+        step = attrs["step"]
+        dtype = to_numpy_dtype(normalize_dtype(attrs.get("dtype",
+                                                         "int64")))
     return {"Out": jnp.arange(start, end, step).astype(dtype)}
 
 
@@ -379,14 +388,14 @@ def _where(ins, attrs):
     return {"Out": jnp.where(ins["Condition"][0], ins["X"][0], ins["Y"][0])}
 
 
-@register_op("where_index")
+@register_op("where_index", no_jit=True)
 def _where_index(ins, attrs):
     # dynamic output shape: only usable eagerly (outside jit)
     cond = np.asarray(ins["Condition"][0])
     return {"Out": jnp.asarray(np.argwhere(cond).astype(np.int64))}
 
 
-@register_op("masked_select")
+@register_op("masked_select", no_jit=True)
 def _masked_select(ins, attrs):
     x = np.asarray(ins["X"][0])
     mask = np.asarray(ins["Mask"][0])
@@ -437,7 +446,7 @@ def _flip(ins, attrs):
     return {"Out": jnp.flip(ins["X"][0], axis=tuple(attrs["axis"]))}
 
 
-@register_op("unique")
+@register_op("unique", no_jit=True)
 def _unique(ins, attrs):
     x = np.asarray(ins["X"][0])
     out, index = np.unique(x, return_inverse=True)

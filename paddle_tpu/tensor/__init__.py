@@ -1,14 +1,37 @@
 """paddle.tensor 2.0-style namespace (reference: `python/paddle/tensor/`)
-— math/manipulation/creation re-exports over fluid.layers."""
-from ..fluid.layers.nn import (  # noqa: F401
-    matmul, elementwise_add as add, elementwise_sub as subtract,
-    elementwise_mul as multiply, elementwise_div as divide,
-    reduce_sum as sum, reduce_mean as mean, reduce_max as max,
-    reduce_min as min, reduce_prod as prod, clip, topk, squeeze, unsqueeze,
-    stack, split, gather, gather_nd, scatter, where, expand,
-    maximum, minimum, sqrt, square, exp, log, abs, sin, cos,
+— math/linalg/manipulation/creation/search/stat/random/logic over the
+mode-polymorphic fluid layer builders."""
+from . import (  # noqa: F401
+    creation, linalg, logic, manipulation, math, random, search, stat,
 )
-from ..fluid.layers.tensor import (  # noqa: F401
-    cast, concat, reshape, transpose, zeros, ones, zeros_like, ones_like,
-    argmax, argmin, argsort, cumsum, linspace, eye, tril, triu, fill_constant,
+from .creation import (  # noqa: F401
+    zeros, ones, full, zeros_like, ones_like, full_like, arange, linspace,
+    eye, diag, meshgrid, tril, triu, assign, clone, empty, numel,
 )
+from .linalg import (  # noqa: F401
+    matmul, bmm, dot, norm, t, dist,
+)
+from .logic import (  # noqa: F401
+    equal, not_equal, less_than, less_equal, greater_than, greater_equal,
+    logical_and, logical_or, logical_xor, logical_not, equal_all, allclose,
+)
+from .manipulation import (  # noqa: F401
+    reshape, transpose, concat, stack, unstack, split, chunk, squeeze,
+    unsqueeze, flatten, flip, roll, tile, expand, broadcast_to, expand_as,
+    gather, gather_nd, scatter, scatter_nd_add, slice, strided_slice,
+    cast, unique, take_along_axis,
+)
+from .math import (  # noqa: F401
+    add, subtract, multiply, divide, floor_divide, mod, remainder, pow,
+    maximum, minimum, sqrt, rsqrt, square, abs, sign, ceil, floor, round,
+    reciprocal, exp, log, log2, log10, log1p, sin, cos, tan, asin, acos,
+    atan, sinh, cosh, tanh, erf, sum, max, min, prod,
+    all, any, cumsum, clip, isnan, isinf, isfinite, add_n, increment,
+    scale, stanh,
+)
+from .search import (  # noqa: F401
+    argmax, argmin, argsort, sort, topk, where, nonzero, index_select,
+    masked_select,
+)
+from .stat import mean, var, std  # noqa: F401
+from ..fluid.layers.tensor import fill_constant  # noqa: F401

@@ -1,0 +1,97 @@
+"""paddle.tensor creation ops (reference:
+`python/paddle/tensor/creation.py`)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.types import normalize_dtype
+from ..fluid.layer_helper import apply_op
+from ..fluid.layers import tensor as _t
+
+
+def zeros(shape, dtype="float32", name=None):
+    return _t.zeros(shape, dtype)
+
+
+def ones(shape, dtype="float32", name=None):
+    return _t.ones(shape, dtype)
+
+
+def full(shape, fill_value, dtype="float32", name=None):
+    return _t.fill_constant(list(shape), dtype, fill_value)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return _t.zeros_like(x)
+
+
+def ones_like(x, dtype=None, name=None):
+    return _t.ones_like(x)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return apply_op("fill_any_like", "fill_any_like", {"X": [x]},
+                    {"value": float(fill_value),
+                     "dtype": normalize_dtype(dtype) if dtype else None},
+                    ["Out"],
+                    out_dtype=normalize_dtype(dtype) if dtype
+                    else getattr(x, "dtype", "float32"))[0]
+
+
+def arange(start=0, end=None, step=1, dtype="int64", name=None):
+    if end is None:
+        start, end = 0, start
+    return apply_op("range", "range", {}, {
+        "start": float(start), "end": float(end), "step": float(step),
+        "dtype": normalize_dtype(dtype)}, ["Out"],
+        out_dtype=normalize_dtype(dtype))[0]
+
+
+def linspace(start, stop, num, dtype="float32", name=None):
+    return _t.linspace(start, stop, num, dtype)
+
+
+def eye(num_rows, num_columns=None, dtype="float32", name=None):
+    return _t.eye(num_rows, num_columns, dtype=dtype)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    return apply_op("diag_v2", "diag_v2", {"X": [x]},
+                    {"offset": offset, "padding_value": padding_value},
+                    ["Out"], out_dtype=getattr(x, "dtype", "float32"))[0]
+
+
+def meshgrid(*args, **kwargs):
+    inputs = list(args[0]) if len(args) == 1 and \
+        isinstance(args[0], (list, tuple)) else list(args)
+    return apply_op("meshgrid", "meshgrid", {"X": inputs}, {},
+                    {"Out": len(inputs)},
+                    out_dtype=getattr(inputs[0], "dtype", "float32"))
+
+
+def tril(x, diagonal=0, name=None):
+    return _t.tril(x, diagonal)
+
+
+def triu(x, diagonal=0, name=None):
+    return _t.triu(x, diagonal)
+
+
+def assign(x, output=None):
+    return _t.assign(np.asarray(x) if not hasattr(x, "dtype") else x,
+                     output=output)
+
+
+def clone(x, name=None):
+    return _t.assign(x)
+
+
+def empty(shape, dtype="float32", name=None):
+    return zeros(shape, dtype)
+
+
+def numel(x, name=None):
+    n = 1
+    for s in getattr(x, "shape", ()):
+        n *= int(s)
+    return full([1], n, dtype="int64")

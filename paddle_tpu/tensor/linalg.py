@@ -1,0 +1,55 @@
+"""paddle.tensor linalg ops (reference:
+`python/paddle/tensor/linalg.py`)."""
+from __future__ import annotations
+
+from ..fluid.layer_helper import apply_op
+from ..fluid.layers import nn as _nn
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return _nn.matmul(x, y, transpose_x, transpose_y)
+
+
+def bmm(x, y, name=None):
+    return _nn.matmul(x, y)
+
+
+def dot(x, y, name=None):
+    prod = _nn.elementwise_mul(x, y)
+    ndim = len(getattr(prod, "shape", ())) or 1
+    return _nn.reduce_sum(prod, dim=ndim - 1, keep_dim=False)
+
+
+def norm(x, p=2, axis=None, keepdim=False, name=None):
+    if p in ("fro", 2) and axis is None:
+        sq = _nn.reduce_sum(_nn.square(x))
+        return _nn.sqrt(sq)
+    axis = -1 if axis is None else axis
+    return apply_op("p_norm", "p_norm", {"X": [x]},
+                    {"porder": float(p), "axis": int(axis),
+                     "keepdim": keepdim}, ["Out"],
+                    out_dtype=getattr(x, "dtype", "float32"))[0]
+
+
+def t(x, name=None):
+    from ..fluid.layers import tensor as _t
+
+    ndim = len(getattr(x, "shape", ()))
+    if ndim <= 1:
+        return x
+    return _t.transpose(x, [1, 0])
+
+
+def transpose(x, perm, name=None):
+    from ..fluid.layers import tensor as _t
+
+    return _t.transpose(x, perm)
+
+
+def dist(x, y, p=2, name=None):
+    diff = _nn.elementwise_sub(x, y)
+    if p == 2:
+        return _nn.sqrt(_nn.reduce_sum(_nn.square(diff)))
+    return apply_op("p_norm", "p_norm", {"X": [diff]},
+                    {"porder": float(p), "axis": -1, "keepdim": False},
+                    ["Out"], out_dtype=getattr(x, "dtype", "float32"))[0]

@@ -1,0 +1,63 @@
+"""paddle.tensor logic ops (reference:
+`python/paddle/tensor/logic.py`)."""
+from __future__ import annotations
+
+from ..fluid.layer_helper import apply_op
+from ..fluid.layers import nn as _nn
+
+
+def _cmp(op_type, x, y):
+    return apply_op(op_type, op_type, {"X": [x], "Y": [y]}, {}, ["Out"],
+                    out_dtype="bool")[0]
+
+
+def equal(x, y, name=None):
+    return _cmp("equal", x, y)
+
+
+def not_equal(x, y, name=None):
+    return _cmp("not_equal", x, y)
+
+
+def less_than(x, y, name=None):
+    return _cmp("less_than", x, y)
+
+
+def less_equal(x, y, name=None):
+    return _cmp("less_equal", x, y)
+
+
+def greater_than(x, y, name=None):
+    return _cmp("greater_than", x, y)
+
+
+def greater_equal(x, y, name=None):
+    return _cmp("greater_equal", x, y)
+
+
+def logical_and(x, y, out=None, name=None):
+    return _nn.logical_and(x, y)
+
+
+def logical_or(x, y, out=None, name=None):
+    return _nn.logical_or(x, y)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return _nn.logical_xor(x, y)
+
+
+def logical_not(x, out=None, name=None):
+    return _nn.logical_not(x)
+
+
+def equal_all(x, y, name=None):
+    return _nn.reduce_all(equal(x, y))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    from ..fluid.layers import tensor as _t
+
+    diff = _nn.abs(_nn.elementwise_sub(x, y))
+    tol = _t.scale(_nn.abs(y), float(rtol), float(atol))  # atol + rtol*|y|
+    return _nn.reduce_all(less_equal(diff, tol))

@@ -1,0 +1,136 @@
+"""paddle.tensor manipulation ops (reference:
+`python/paddle/tensor/manipulation.py`)."""
+from __future__ import annotations
+
+from ..fluid.layer_helper import apply_op
+from ..fluid.layers import nn as _nn
+from ..fluid.layers import tensor as _t
+
+
+def reshape(x, shape, name=None):
+    return _t.reshape(x, shape)
+
+
+def transpose(x, perm, name=None):
+    return _t.transpose(x, perm)
+
+
+def concat(x, axis=0, name=None):
+    return _t.concat(x, axis)
+
+
+def stack(x, axis=0, name=None):
+    return _nn.stack(x, axis)
+
+
+def unstack(x, axis=0, num=None):
+    return _nn.unstack(x, axis, num)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    return _nn.split(x, num_or_sections, dim=axis)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return _nn.split(x, chunks, dim=axis)
+
+
+def squeeze(x, axis=None, name=None):
+    axes = [] if axis is None else (
+        list(axis) if isinstance(axis, (list, tuple)) else [axis])
+    return _nn.squeeze(x, axes)
+
+
+def unsqueeze(x, axis, name=None):
+    axes = list(axis) if isinstance(axis, (list, tuple)) else [axis]
+    return _nn.unsqueeze(x, axes)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    return apply_op("flatten_contiguous_range",
+                    "flatten_contiguous_range", {"X": [x]},
+                    {"start_axis": start_axis, "stop_axis": stop_axis},
+                    ["Out"], out_dtype=getattr(x, "dtype", "float32"))[0]
+
+
+def flip(x, axis, name=None):
+    axes = list(axis) if isinstance(axis, (list, tuple)) else [axis]
+    return apply_op("flip", "flip", {"X": [x]}, {"axis": axes}, ["Out"],
+                    out_dtype=getattr(x, "dtype", "float32"))[0]
+
+
+def roll(x, shifts, axis=None, name=None):
+    shifts = list(shifts) if isinstance(shifts, (list, tuple)) \
+        else [shifts]
+    axes = ([] if axis is None else
+            list(axis) if isinstance(axis, (list, tuple)) else [axis])
+    return apply_op("roll", "roll", {"X": [x]},
+                    {"shifts": shifts, "axis": axes}, ["Out"],
+                    out_dtype=getattr(x, "dtype", "float32"))[0]
+
+
+def tile(x, repeat_times, name=None):
+    return apply_op("tile", "tile", {"X": [x]},
+                    {"repeat_times": list(repeat_times)}, ["Out"],
+                    out_dtype=getattr(x, "dtype", "float32"))[0]
+
+
+def expand(x, shape, name=None):
+    return apply_op("expand_v2", "expand_v2", {"X": [x]},
+                    {"shape": list(shape)}, ["Out"],
+                    out_dtype=getattr(x, "dtype", "float32"))[0]
+
+
+broadcast_to = expand
+
+
+def expand_as(x, y, name=None):
+    return _nn.expand_as(x, y)
+
+
+def gather(x, index, axis=None, name=None):
+    return _nn.gather(x, index)
+
+
+def gather_nd(x, index, name=None):
+    return _nn.gather_nd(x, index)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    return _nn.scatter(x, index, updates, overwrite=overwrite)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    return apply_op("scatter_nd_add", "scatter_nd_add",
+                    {"X": [x], "Index": [index], "Updates": [updates]},
+                    {}, ["Out"],
+                    out_dtype=getattr(x, "dtype", "float32"))[0]
+
+
+def slice(x, axes, starts, ends):
+    return _nn.slice(x, axes, starts, ends)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    return _nn.strided_slice(x, axes, starts, ends, strides)
+
+
+def cast(x, dtype):
+    return _t.cast(x, dtype)
+
+
+def unique(x, return_index=False, return_inverse=False,
+           return_counts=False, axis=None, dtype="int64", name=None):
+    outs = apply_op("unique", "unique", {"X": [x]}, {},
+                    ["Out", "Index"],
+                    out_dtype=getattr(x, "dtype", "float32"))
+    if return_inverse or return_index:
+        return outs[0], outs[1]
+    return outs[0]
+
+
+def take_along_axis(x, indices, axis, name=None):
+    return apply_op("take_along_axis", "take_along_axis",
+                    {"Input": [x], "Index": [indices]}, {"Axis": axis},
+                    ["Result"],
+                    out_dtype=getattr(x, "dtype", "float32"))[0]

@@ -1,0 +1,232 @@
+"""paddle.tensor math ops (reference: `python/paddle/tensor/math.py`) —
+thin mode-polymorphic wrappers over the op registry."""
+from __future__ import annotations
+
+from ..fluid.layer_helper import apply_op
+from ..fluid.layers import nn as _nn
+from ..fluid.layers import tensor as _t
+
+
+def _unary(op_type, x, attrs=None):
+    return apply_op(op_type, op_type, {"X": [x]}, attrs or {}, ["Out"],
+                    out_dtype=getattr(x, "dtype", "float32"))[0]
+
+
+def add(x, y, name=None):
+    return _nn.elementwise_add(x, y)
+
+
+def subtract(x, y, name=None):
+    return _nn.elementwise_sub(x, y)
+
+
+def multiply(x, y, name=None):
+    return _nn.elementwise_mul(x, y)
+
+
+def divide(x, y, name=None):
+    return _nn.elementwise_div(x, y)
+
+
+def floor_divide(x, y, name=None):
+    return _nn.elementwise_floordiv(x, y)
+
+
+def mod(x, y, name=None):
+    return _nn.elementwise_mod(x, y)
+
+
+remainder = mod
+
+
+def pow(x, y, name=None):
+    if isinstance(y, (int, float)):
+        return apply_op("pow", "pow", {"X": [x]}, {"factor": float(y)},
+                        ["Out"], out_dtype=getattr(x, "dtype",
+                                                   "float32"))[0]
+    return _nn.elementwise_pow(x, y)
+
+
+def maximum(x, y, name=None):
+    return _nn.maximum(x, y)
+
+
+def minimum(x, y, name=None):
+    return _nn.minimum(x, y)
+
+
+def sqrt(x, name=None):
+    return _nn.sqrt(x)
+
+
+def rsqrt(x, name=None):
+    return _unary("rsqrt", x)
+
+
+def square(x, name=None):
+    return _nn.square(x)
+
+
+def abs(x, name=None):
+    return _nn.abs(x)
+
+
+def sign(x, name=None):
+    return _unary("sign", x)
+
+
+def ceil(x, name=None):
+    return _nn.ceil(x)
+
+
+def floor(x, name=None):
+    return _nn.floor(x)
+
+
+def round(x, name=None):
+    return _nn.round(x)
+
+
+def reciprocal(x, name=None):
+    return _nn.reciprocal(x)
+
+
+def exp(x, name=None):
+    return _nn.exp(x)
+
+
+def log(x, name=None):
+    return _nn.log(x)
+
+
+def log2(x, name=None):
+    return _unary("log2", x)
+
+
+def log10(x, name=None):
+    return _unary("log10", x)
+
+
+def log1p(x, name=None):
+    return _unary("log1p", x)
+
+
+def sin(x, name=None):
+    return _nn.sin(x)
+
+
+def cos(x, name=None):
+    return _nn.cos(x)
+
+
+def tan(x, name=None):
+    return divide(sin(x), cos(x))
+
+
+def asin(x, name=None):
+    return _unary("asin", x)
+
+
+def acos(x, name=None):
+    return _unary("acos", x)
+
+
+def atan(x, name=None):
+    return _unary("atan", x)
+
+
+def sinh(x, name=None):
+    return _unary("sinh", x)
+
+
+def cosh(x, name=None):
+    return _unary("cosh", x)
+
+
+def tanh(x, name=None):
+    return apply_op("tanh", "tanh", {"X": [x]}, {}, ["Out"],
+                    out_dtype=getattr(x, "dtype", "float32"))[0]
+
+
+def erf(x, name=None):
+    return _nn.erf(x)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return _nn.reduce_sum(x, dim=axis, keep_dim=keepdim)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return _nn.reduce_mean(x, dim=axis, keep_dim=keepdim)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return _nn.reduce_max(x, dim=axis, keep_dim=keepdim)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return _nn.reduce_min(x, dim=axis, keep_dim=keepdim)
+
+
+def prod(x, axis=None, keepdim=False, name=None):
+    return _nn.reduce_prod(x, dim=axis, keep_dim=keepdim)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return _nn.reduce_all(x, dim=axis, keep_dim=keepdim)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return _nn.reduce_any(x, dim=axis, keep_dim=keepdim)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    return _t.cumsum(x, axis=axis)
+
+
+def clip(x, min=None, max=None, name=None):
+    import numpy as np
+
+    lo = -np.inf if min is None else float(min)
+    hi = np.inf if max is None else float(max)
+    return _nn.clip(x, lo, hi)
+
+
+def isnan(x, name=None):
+    return apply_op("isnan_v2", "isnan_v2", {"X": [x]}, {}, ["Out"],
+                    out_dtype="bool")[0]
+
+
+def isinf(x, name=None):
+    return apply_op("isinf_v2", "isinf_v2", {"X": [x]}, {}, ["Out"],
+                    out_dtype="bool")[0]
+
+
+def isfinite(x, name=None):
+    return apply_op("isfinite_v2", "isfinite_v2", {"X": [x]}, {}, ["Out"],
+                    out_dtype="bool")[0]
+
+
+def add_n(inputs, name=None):
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    return apply_op("sum", "sum", {"X": list(inputs)}, {}, ["Out"],
+                    out_dtype=getattr(inputs[0], "dtype", "float32"))[0]
+
+
+def increment(x, value=1.0, name=None):
+    return apply_op("increment", "increment", {"X": [x]},
+                    {"step": float(value)}, ["Out"],
+                    out_dtype=getattr(x, "dtype", "float32"))[0]
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None,
+          name=None):
+    return _t.scale(x, scale, bias)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return _unary("stanh", x, {"scale_a": scale_a, "scale_b": scale_b})
+
+
+def kron(x, y, name=None):
+    raise NotImplementedError("kron: not yet implemented on TPU build")

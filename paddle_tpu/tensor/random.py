@@ -1,0 +1,55 @@
+"""paddle.tensor random ops (reference:
+`python/paddle/tensor/random.py`). All sample through the seeded
+stateless op registry (uniform_random/gaussian_random/...)."""
+from __future__ import annotations
+
+from ..core.types import normalize_dtype
+from ..fluid.layer_helper import apply_op
+
+
+def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0, name=None):
+    return apply_op("uniform_random", "uniform_random", {}, {
+        "shape": list(shape), "min": float(min), "max": float(max),
+        "seed": seed, "dtype": normalize_dtype(dtype)}, ["Out"],
+        out_dtype=normalize_dtype(dtype))[0]
+
+
+def rand(shape, dtype="float32", name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    return apply_op("gaussian_random", "gaussian_random", {}, {
+        "shape": list(shape), "mean": float(mean), "std": float(std),
+        "seed": 0, "dtype": "float32"}, ["Out"], out_dtype="float32")[0]
+
+
+def randn(shape, dtype="float32", name=None):
+    return normal(0.0, 1.0, shape)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return apply_op("randint", "randint", {}, {
+        "low": int(low), "high": int(high), "shape": list(shape),
+        "seed": 0, "dtype": normalize_dtype(dtype)}, ["Out"],
+        out_dtype=normalize_dtype(dtype))[0]
+
+
+def randperm(n, dtype="int64", name=None):
+    return apply_op("randperm", "randperm", {}, {
+        "n": int(n), "seed": 0, "dtype": normalize_dtype(dtype)},
+        ["Out"], out_dtype=normalize_dtype(dtype))[0]
+
+
+def bernoulli(x, name=None):
+    return apply_op("bernoulli", "bernoulli", {"X": [x]}, {}, ["Out"],
+                    out_dtype=getattr(x, "dtype", "float32"))[0]
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    return apply_op("multinomial", "multinomial", {"X": [x]},
+                    {"num_samples": int(num_samples),
+                     "replacement": replacement}, ["Out"],
+                    out_dtype="int64")[0]

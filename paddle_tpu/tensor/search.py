@@ -1,0 +1,68 @@
+"""paddle.tensor search/sort ops (reference:
+`python/paddle/tensor/search.py`)."""
+from __future__ import annotations
+
+from ..fluid.layer_helper import apply_op
+from ..fluid.layers import nn as _nn
+from ..fluid.layers import tensor as _t
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return _t.argmax(x, axis=-1 if axis is None else axis)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return _t.argmin(x, axis=-1 if axis is None else axis)
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    outs = apply_op("argsort", "argsort", {"X": [x]},
+                    {"axis": axis, "descending": descending},
+                    ["Out", "Indices"],
+                    out_dtype=getattr(x, "dtype", "float32"))
+    return outs[1]
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    outs = apply_op("argsort", "argsort", {"X": [x]},
+                    {"axis": axis, "descending": descending},
+                    ["Out", "Indices"],
+                    out_dtype=getattr(x, "dtype", "float32"))
+    return outs[0]
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    outs = apply_op("top_k_v2", "top_k_v2", {"X": [x]},
+                    {"k": int(k), "axis": -1 if axis is None else axis,
+                     "largest": largest},
+                    ["Out", "Indices"],
+                    out_dtype=getattr(x, "dtype", "float32"))
+    return outs[0], outs[1]
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=False)
+    return _nn.where(condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    out = apply_op("where_index", "where_index", {"Condition": [x]}, {},
+                   ["Out"], out_dtype="int64")[0]
+    if as_tuple:
+        ndim = len(getattr(x, "shape", ())) or 1
+        return tuple(_nn.slice(out, axes=[1], starts=[i], ends=[i + 1])
+                     for i in range(ndim))
+    return out
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply_op("index_select", "index_select",
+                    {"X": [x], "Index": [index]}, {"dim": axis}, ["Out"],
+                    out_dtype=getattr(x, "dtype", "float32"))[0]
+
+
+def masked_select(x, mask, name=None):
+    return apply_op("masked_select", "masked_select",
+                    {"X": [x], "Mask": [mask]}, {}, ["Y"],
+                    out_dtype=getattr(x, "dtype", "float32"))[0]

@@ -1,0 +1,42 @@
+"""paddle.tensor stat ops (reference: `python/paddle/tensor/stat.py`)."""
+from __future__ import annotations
+
+from ..fluid.layers import nn as _nn
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return _nn.reduce_mean(x, dim=axis, keep_dim=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    m = _nn.reduce_mean(x, dim=axis, keep_dim=True)
+    sq = _nn.square(_nn.elementwise_sub(x, m))
+    v = _nn.reduce_mean(sq, dim=axis, keep_dim=keepdim)
+    if unbiased:
+        shape = getattr(x, "shape", ())
+        if axis is None:
+            n = 1
+            for s in shape:
+                n *= int(s)
+        elif isinstance(axis, (list, tuple)):
+            n = 1
+            for a in axis:
+                n *= int(shape[a])
+        else:
+            n = int(shape[axis])
+        if n > 1:
+            from ..fluid.layers import tensor as _t
+
+            v = _t.scale(v, float(n) / (n - 1), 0.0)
+    return v
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _nn.sqrt(var(x, axis=axis, unbiased=unbiased,
+                        keepdim=keepdim))
+
+
+def numel(x, name=None):
+    from .creation import numel as _numel
+
+    return _numel(x)

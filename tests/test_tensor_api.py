@@ -1,0 +1,247 @@
+"""paddle.tensor / paddle.nn 2.0 API surface tests (dygraph mode, vs
+numpy golden)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.fluid import dygraph
+
+
+@pytest.fixture(autouse=True)
+def dyg():
+    with dygraph.guard():
+        yield
+
+
+def T(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def test_math_unary():
+    x = np.random.rand(3, 4).astype("float32") + 0.5
+    t = T(x)
+    np.testing.assert_allclose(paddle.sqrt(t).numpy(), np.sqrt(x),
+                               rtol=1e-6)
+    np.testing.assert_allclose(paddle.rsqrt(t).numpy(), 1 / np.sqrt(x),
+                               rtol=1e-5)
+    np.testing.assert_allclose(paddle.log1p(t).numpy(), np.log1p(x),
+                               rtol=1e-6)
+    np.testing.assert_allclose(paddle.floor(t).numpy(), np.floor(x))
+    np.testing.assert_allclose(paddle.sign(T([-2.0, 0.0, 3.0])).numpy(),
+                               [-1.0, 0.0, 1.0])
+    np.testing.assert_allclose(paddle.tan(t).numpy(), np.tan(x),
+                               rtol=1e-4)
+
+
+def test_math_binary_and_reduce():
+    x = np.random.rand(2, 3).astype("float32")
+    y = np.random.rand(2, 3).astype("float32") + 1.0
+    np.testing.assert_allclose(paddle.add(T(x), T(y)).numpy(), x + y,
+                               rtol=1e-6)
+    np.testing.assert_allclose(paddle.pow(T(x), 2.0).numpy(), x ** 2,
+                               rtol=1e-5)
+    np.testing.assert_allclose(paddle.mod(T(y), T(x + 0.3)).numpy(),
+                               np.mod(y, x + 0.3), rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.sum(T(x), axis=1).numpy().squeeze(),
+        x.sum(1), rtol=1e-6)
+    np.testing.assert_allclose(
+        paddle.std(T(x)).numpy().squeeze(), x.std(ddof=1), rtol=1e-4)
+    np.testing.assert_allclose(
+        paddle.var(T(x), unbiased=False).numpy().squeeze(),
+        x.var(), rtol=1e-4)
+
+
+def test_manipulation():
+    x = np.arange(24).reshape(2, 3, 4).astype("float32")
+    np.testing.assert_allclose(
+        paddle.flip(T(x), axis=1).numpy(), np.flip(x, 1))
+    np.testing.assert_allclose(
+        paddle.roll(T(x), 1, axis=0).numpy(), np.roll(x, 1, 0))
+    np.testing.assert_allclose(
+        paddle.tile(T(x), [1, 2, 1]).numpy(), np.tile(x, (1, 2, 1)))
+    np.testing.assert_allclose(
+        paddle.flatten(T(x), 1, 2).numpy(), x.reshape(2, 12))
+    np.testing.assert_allclose(
+        paddle.broadcast_to(T(np.ones((1, 4), "float32")),
+                            [3, 4]).numpy(), np.ones((3, 4)))
+    np.testing.assert_allclose(
+        paddle.chunk(T(x), 3, axis=1)[1].numpy(), x[:, 1:2, :])
+
+
+def test_linalg():
+    a = np.random.rand(3, 4).astype("float32")
+    b = np.random.rand(4, 5).astype("float32")
+    np.testing.assert_allclose(paddle.matmul(T(a), T(b)).numpy(), a @ b,
+                               rtol=1e-5)
+    np.testing.assert_allclose(paddle.t(T(a)).numpy(), a.T)
+    v = np.random.rand(2, 6).astype("float32")
+    w = np.random.rand(2, 6).astype("float32")
+    np.testing.assert_allclose(paddle.dot(T(v), T(w)).numpy().squeeze(),
+                               (v * w).sum(-1), rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.norm(T(a)).numpy().squeeze(),
+        np.linalg.norm(a), rtol=1e-5)
+    ba = np.random.rand(2, 3, 4).astype("float32")
+    bb = np.random.rand(2, 4, 5).astype("float32")
+    np.testing.assert_allclose(paddle.bmm(T(ba), T(bb)).numpy(),
+                               ba @ bb, rtol=1e-5)
+
+
+def test_search_sort():
+    x = np.asarray([[3.0, 1.0, 2.0], [6.0, 5.0, 4.0]], "float32")
+    np.testing.assert_allclose(paddle.sort(T(x), axis=1).numpy(),
+                               np.sort(x, 1))
+    np.testing.assert_allclose(paddle.argsort(T(x), axis=1).numpy(),
+                               np.argsort(x, 1))
+    vals, idx = paddle.topk(T(x), 2, axis=-1)
+    np.testing.assert_allclose(vals.numpy(), [[3.0, 2.0], [6.0, 5.0]])
+    sel = paddle.index_select(T(x), T(np.asarray([1, 0], "int64")),
+                              axis=0)
+    np.testing.assert_allclose(sel.numpy(), x[[1, 0]])
+    nz = paddle.nonzero(T(np.asarray([0.0, 1.0, 0.0, 2.0], "float32")))
+    np.testing.assert_allclose(nz.numpy().squeeze(-1), [1, 3])
+    m = paddle.masked_select(
+        T(x), T(np.asarray(x > 2.5)))
+    np.testing.assert_allclose(np.sort(m.numpy()), [3.0, 4.0, 5.0, 6.0])
+
+
+def test_creation_and_logic():
+    np.testing.assert_allclose(paddle.arange(5).numpy(),
+                               np.arange(5))
+    np.testing.assert_allclose(paddle.full([2, 2], 7.0).numpy(),
+                               np.full((2, 2), 7.0))
+    np.testing.assert_allclose(
+        paddle.diag(T(np.asarray([1.0, 2.0], "float32"))).numpy(),
+        np.diag([1.0, 2.0]))
+    x = np.asarray([1.0, 2.0], "float32")
+    assert bool(paddle.equal_all(T(x), T(x)).numpy())
+    assert bool(paddle.allclose(T(x), T(x + 1e-7)).numpy())
+    assert not bool(paddle.allclose(T(x), T(x + 1.0)).numpy())
+
+
+def test_random_shapes():
+    u = paddle.uniform([3, 4])
+    assert u.shape == (3, 4)
+    r = paddle.randint(0, 10, [5])
+    assert r.numpy().min() >= 0 and r.numpy().max() < 10
+    p = paddle.randperm(8)
+    np.testing.assert_allclose(np.sort(p.numpy()), np.arange(8))
+
+
+def test_nn_layers():
+    x = np.random.rand(2, 3, 8, 8).astype("float32")
+    pool = paddle.nn.MaxPool2D(2)
+    out = pool(T(x))
+    assert out.shape == (2, 3, 4, 4)
+    gn = paddle.nn.GroupNorm(3, 3)
+    assert gn(T(x)).shape == x.shape
+    fl = paddle.nn.Flatten()
+    assert fl(T(x)).shape == (2, 3 * 64)
+    ct = paddle.nn.Conv2DTranspose(3, 5, 3, stride=2)
+    y = ct(T(x))
+    assert y.shape[0] == 2 and y.shape[1] == 5
+
+
+def test_nn_functional():
+    import paddle_tpu.nn.functional as F
+
+    x = np.random.rand(4, 6).astype("float32")
+    w = np.random.rand(6, 3).astype("float32")
+    b = np.random.rand(3).astype("float32")
+    out = F.linear(T(x), T(w), T(b))
+    np.testing.assert_allclose(out.numpy(), x @ w + b, rtol=1e-5)
+    lab = np.random.rand(4, 6).astype("float32")
+    np.testing.assert_allclose(
+        F.l1_loss(T(x), T(lab)).numpy().squeeze(),
+        np.abs(x - lab).mean(), rtol=1e-5)
+
+
+def test_lstm_gru():
+    B, Tn, D, H = 2, 5, 4, 6
+    x = np.random.rand(B, Tn, D).astype("float32")
+    lstm = paddle.nn.LSTM(D, H, num_layers=2)
+    out, (h, c) = lstm(T(x))
+    assert out.shape == (B, Tn, H)
+    assert h.shape == (2, B, H) and c.shape == (2, B, H)
+
+    bi = paddle.nn.LSTM(D, H, direction="bidirectional")
+    out2, _ = bi(T(x))
+    assert out2.shape == (B, Tn, 2 * H)
+
+    gru = paddle.nn.GRU(D, H)
+    out3, h3 = gru(T(x))
+    assert out3.shape == (B, Tn, H) and h3.shape == (1, B, H)
+
+
+def test_lstm_matches_numpy():
+    """Golden check of the scan cell math vs a numpy step loop."""
+    B, Tn, D, H = 2, 3, 3, 4
+    rng = np.random.RandomState(0)
+    x = rng.rand(B, Tn, D).astype("float32")
+    lstm = paddle.nn.LSTM(D, H)
+    out, (h, c) = lstm(T(x))
+
+    w_ih = lstm._weights[0]["w_ih"].numpy()
+    w_hh = lstm._weights[0]["w_hh"].numpy()
+    b = lstm._weights[0]["b"].numpy()
+
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+
+    hh = np.zeros((B, H), "float32")
+    cc = np.zeros((B, H), "float32")
+    for step in range(Tn):
+        g = x[:, step] @ w_ih.T + hh @ w_hh.T + b
+        i, f, gg, o = np.split(g, 4, axis=-1)
+        cc = sig(f) * cc + sig(i) * np.tanh(gg)
+        hh = sig(o) * np.tanh(cc)
+    np.testing.assert_allclose(out.numpy()[:, -1], hh, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_optimizer_step_api():
+    lin = paddle.nn.Linear(4, 2)
+    opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                parameters=lin.parameters())
+    x = T(np.random.rand(3, 4).astype("float32"))
+    before = lin.weight.numpy().copy()
+    loss = paddle.mean(lin(x))
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    assert not np.allclose(before, lin.weight.numpy())
+    assert lin.weight.grad is None
+
+
+def test_conv2d_transpose_golden():
+    """Numpy scatter-accumulate golden for the grad-of-conv formulation."""
+    rng = np.random.RandomState(1)
+    B, Cin, Cout, H, W, K = 1, 2, 3, 4, 4, 3
+    for stride, padding in [(1, 0), (2, 0), (2, 1)]:
+        x = rng.rand(B, Cin, H, W).astype("float32")
+        w = rng.rand(Cin, Cout, K, K).astype("float32")
+        Ho = (H - 1) * stride - 2 * padding + K
+        Wo = (W - 1) * stride - 2 * padding + K
+        want = np.zeros((B, Cout, Ho + 2 * padding, Wo + 2 * padding),
+                        "float32")
+        for b in range(B):
+            for ci in range(Cin):
+                for i in range(H):
+                    for j in range(W):
+                        want[b, :, i * stride:i * stride + K,
+                             j * stride:j * stride + K] += \
+                            x[b, ci, i, j] * w[ci]
+        if padding:
+            want = want[:, :, padding:-padding, padding:-padding]
+
+        from paddle_tpu.fluid.layer_helper import apply_op
+
+        out = apply_op("conv2d_transpose", "conv2d_transpose",
+                       {"Input": [T(x)], "Filter": [T(w)]},
+                       {"strides": [stride, stride],
+                        "paddings": [padding, padding],
+                        "dilations": [1, 1], "groups": 1},
+                       ["Output"], out_dtype="float32")[0]
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-4,
+                                   atol=1e-5)
