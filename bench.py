@@ -8,25 +8,102 @@ reported V100 FP16 BERT-base phase-1 (seq128) pretraining throughput of
 ~25k tokens/sec/GPU as the baseline denominator, so vs_baseline >= 0.8
 meets the north star.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+Resilience (round-1 failure mode: the TPU plugin blocked/errored during
+backend init and bench.py crashed with no JSON): the parent process here
+NEVER imports jax. It re-execs this file as a --child subprocess with a
+hard wall-clock budget, retries the TPU attempt on failure with backoff,
+then falls back to a CPU-platform child (accelerator plugin env stripped
+so backend init cannot block), and on total failure still emits the JSON
+line with an "error" field. Extra fields: steps_per_sec, compile_time_s,
+mfu_pct, platform, params_m.
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-import numpy as np
-
 V100_BASELINE_TOKENS_PER_SEC = 25000.0
+TPU_PEAK_BF16_FLOPS = 197e12  # v5e per-chip
 
 BATCH = 128
 SEQ_LEN = 128
 WARMUP = 3
 STEPS = 10
 
+# (platform, wall budget seconds, batch, steps, warmup)
+_ATTEMPTS = [
+    ("tpu", 480, BATCH, STEPS, WARMUP),
+    ("tpu", 300, BATCH, STEPS, WARMUP),
+    ("cpu", 420, 8, 2, 1),
+]
 
-def main():
+_RESULT_TAG = "BENCH_RESULT_JSON:"
+
+
+def _child_env(platform: str) -> dict:
+    env = dict(os.environ)
+    if platform == "cpu":
+        # shared with __graft_entry__ so the plugin-trigger prefix list
+        # (whose completeness the no-hang guarantee depends on) has one
+        # home; __graft_entry__'s module top level is stdlib+numpy only,
+        # keeping this parent jax-free
+        from __graft_entry__ import _strip_accel_env
+
+        env = _strip_accel_env(env)
+        env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def main() -> int:
+    errors = []
+    for i, (platform, budget, batch, steps, warmup) in enumerate(_ATTEMPTS):
+        if i > 0:
+            time.sleep(min(15.0 * i, 30.0))  # backoff before retry
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child",
+                 platform, str(batch), str(steps), str(warmup)],
+                env=_child_env(platform),
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, timeout=budget)
+            out = proc.stdout or ""
+            result = None
+            for line in out.splitlines():
+                if line.startswith(_RESULT_TAG):
+                    result = json.loads(line[len(_RESULT_TAG):])
+            if proc.returncode == 0 and result is not None:
+                if errors:
+                    result["error"] = "; ".join(errors)[:500]
+                print(json.dumps(result))
+                return 0
+            errors.append("%s attempt %d rc=%d: %s"
+                          % (platform, i, proc.returncode,
+                             out.strip().splitlines()[-1][-200:]
+                             if out.strip() else "no output"))
+        except subprocess.TimeoutExpired:
+            errors.append("%s attempt %d: timeout after %ds"
+                          % (platform, i, budget))
+        except Exception as e:  # noqa: BLE001 - must always emit JSON
+            errors.append("%s attempt %d: %r" % (platform, i, e))
+    print(json.dumps({
+        "metric": "bert_base_pretrain_throughput",
+        "value": 0.0,
+        "unit": "tokens/sec/chip",
+        "vs_baseline": 0.0,
+        "error": "; ".join(errors)[:1500],
+    }))
+    return 0
+
+
+def _bench_child(platform: str, batch: int, steps: int, warmup: int) -> None:
+    import numpy as np
+
     import paddle_tpu.fluid as fluid
     from paddle_tpu.fluid import framework
     from paddle_tpu.fluid.contrib import mixed_precision
@@ -43,44 +120,68 @@ def main():
                 use_dynamic_loss_scaling=False)
             opt.minimize(total)
 
+            n_params = sum(
+                int(np.prod(p.shape)) for p in main_p.all_parameters())
+
             exe = fluid.Executor(fluid.TPUPlace())
             exe.run(startup_p)
 
             r = np.random.RandomState(0)
-            n_mask = BATCH * SEQ_LEN * 15 // 100
+            n_mask = batch * SEQ_LEN * 15 // 100
             feed = {
                 "src_ids": r.randint(0, cfg.vocab_size,
-                                     (BATCH, SEQ_LEN)).astype("int64"),
+                                     (batch, SEQ_LEN)).astype("int64"),
                 "pos_ids": np.tile(np.arange(SEQ_LEN),
-                                   (BATCH, 1)).astype("int64"),
-                "sent_ids": np.zeros((BATCH, SEQ_LEN), "int64"),
-                "input_mask": np.ones((BATCH, SEQ_LEN), "float32"),
-                "mask_pos": r.choice(BATCH * SEQ_LEN, n_mask,
+                                   (batch, 1)).astype("int64"),
+                "sent_ids": np.zeros((batch, SEQ_LEN), "int64"),
+                "input_mask": np.ones((batch, SEQ_LEN), "float32"),
+                "mask_pos": r.choice(batch * SEQ_LEN, n_mask,
                                      replace=False).astype("int64"),
                 "mask_label": r.randint(0, cfg.vocab_size,
                                         (n_mask,)).astype("int64"),
-                "nsp_label": r.randint(0, 2, (BATCH, 1)).astype("int64"),
+                "nsp_label": r.randint(0, 2, (batch, 1)).astype("int64"),
             }
 
-            for _ in range(WARMUP):
+            t_compile0 = time.perf_counter()
+            out = exe.run(main_p, feed=feed, fetch_list=[total])
+            np.asarray(out[0])
+            compile_time = time.perf_counter() - t_compile0
+
+            for _ in range(max(warmup - 1, 0)):
                 out = exe.run(main_p, feed=feed, fetch_list=[total])
             np.asarray(out[0])
 
             t0 = time.perf_counter()
-            for _ in range(STEPS):
+            for _ in range(steps):
                 out = exe.run(main_p, feed=feed, fetch_list=[total])
             np.asarray(out[0])  # block on the final step
             dt = time.perf_counter() - t0
 
-    tokens_per_sec = BATCH * SEQ_LEN * STEPS / dt
-    print(json.dumps({
+    tokens_per_sec = batch * SEQ_LEN * steps / dt
+    # training step ~ 6 FLOPs per param per token (fwd 2x + bwd 4x)
+    flops_per_sec = 6.0 * n_params * tokens_per_sec
+    result = {
         "metric": "bert_base_pretrain_throughput",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(tokens_per_sec
                              / V100_BASELINE_TOKENS_PER_SEC, 3),
-    }))
+        "platform": platform,
+        "steps_per_sec": round(steps / dt, 3),
+        "compile_time_s": round(compile_time, 1),
+        "params_m": round(n_params / 1e6, 1),
+        "batch": batch,
+        "loss": round(float(np.asarray(out[0]).reshape(-1)[0]), 4),
+    }
+    if platform == "tpu":
+        result["mfu_pct"] = round(
+            100.0 * flops_per_sec / TPU_PEAK_BF16_FLOPS, 2)
+    print(_RESULT_TAG + json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 6 and sys.argv[1] == "--child":
+        _bench_child(sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
+                     int(sys.argv[5]))
+        sys.exit(0)
     sys.exit(main())

@@ -47,10 +47,10 @@ class Tracer:
 
 class TapeEntry:
     __slots__ = ("op_type", "attrs", "in_slots", "in_tensors", "out_slots",
-                 "out_tensors", "rng_key")
+                 "out_tensors", "rng_key", "custom_vjp")
 
     def __init__(self, op_type, attrs, in_slots, in_tensors, out_slots,
-                 out_tensors, rng_key):
+                 out_tensors, rng_key, custom_vjp=None):
         self.op_type = op_type
         self.attrs = attrs
         self.in_slots = in_slots      # ((slot, count), ...) flat layout
@@ -58,6 +58,9 @@ class TapeEntry:
         self.out_slots = out_slots    # ((slot, count), ...) flat layout
         self.out_tensors = out_tensors  # flat list of Tensor
         self.rng_key = rng_key
+        # custom_vjp(cotangents) -> flat grads aligned with in_tensors;
+        # used by whole-subgraph entries (@declarative ConcreteProgram)
+        self.custom_vjp = custom_vjp
 
 
 def _tracer() -> Optional[Tracer]:
@@ -374,18 +377,21 @@ class BackwardEngine:
                                                    jnp.inexact):
                     g = jnp.zeros_like(t._val)
                 cotangents.append(g)
-            attr_items = tuple(sorted(
-                (k, ops_lib.registry._hashable_attr(v))
-                for k, v in entry.attrs.items() if not k.startswith("_")))
-            in_shapes = tuple((t._val.shape, str(t._val.dtype))
-                              for t in entry.in_tensors)
-            fn = _vjp_fn(entry.op_type, attr_items, entry.in_slots,
-                         in_shapes, entry.out_slots,
-                         entry.rng_key is not None)
-            key = entry.rng_key if entry.rng_key is not None else \
-                jax.random.PRNGKey(0)
-            in_grads = fn([t._val for t in entry.in_tensors], key,
-                          cotangents)
+            if entry.custom_vjp is not None:
+                in_grads = entry.custom_vjp(cotangents)
+            else:
+                attr_items = tuple(sorted(
+                    (k, ops_lib.registry._hashable_attr(v))
+                    for k, v in entry.attrs.items() if not k.startswith("_")))
+                in_shapes = tuple((t._val.shape, str(t._val.dtype))
+                                  for t in entry.in_tensors)
+                fn = _vjp_fn(entry.op_type, attr_items, entry.in_slots,
+                             in_shapes, entry.out_slots,
+                             entry.rng_key is not None)
+                key = entry.rng_key if entry.rng_key is not None else \
+                    jax.random.PRNGKey(0)
+                in_grads = fn([t._val for t in entry.in_tensors], key,
+                              cotangents)
             for t, g in zip(entry.in_tensors, in_grads):
                 if t.stop_gradient:
                     continue

@@ -42,6 +42,23 @@ def _loop_carry(x):
 
     if isinstance(x, EagerTensor):
         return static_t.assign(current_ctx().to_var(x))
+    return _scalar_const(x)
+
+
+def _scalar_const(x):
+    """Python scalar -> typed in-program constant. Ints carry as int32
+    (JAX's default x64-disabled config truncates int64 anyway); values
+    outside int32 range raise instead of silently wrapping."""
+    from ...layers import tensor as static_t
+
+    if isinstance(x, bool):
+        return static_t.fill_constant([1], "bool", x)
+    if isinstance(x, int):
+        if not -2**31 <= x < 2**31:
+            raise OverflowError(
+                "@declarative while: python int %d carried through a "
+                "symbolic loop exceeds int32 range" % x)
+        return static_t.fill_constant([1], "int32", x)
     return static_t.fill_constant([1], "float32", float(x))
 
 
@@ -114,26 +131,36 @@ def convert_ifelse(pred, true_fn, false_fn, init_args=()):
 
 def convert_while_loop(cond_fn, body_fn, loop_vars):
     """`while cond:` — loop-carried vars are the names the body assigns;
-    symbolic condition lowers to the static while_loop layer."""
-    if any(v is UNDEFINED for v in loop_vars):
-        raise NameError(
-            "@declarative `while`: every loop-carried variable must be "
-            "bound before the loop (the loop may run zero times)")
+    symbolic condition lowers to the static while_loop layer. A plain
+    python-valued loop keeps python semantics even when a body-local
+    temporary is unbound before the loop (UNDEFINED only forbids the
+    lax.while_loop path, which needs a typed init for every carry)."""
     pred = cond_fn(*loop_vars)
     if not _is_sym(pred):
         while pred:
             loop_vars = body_fn(*loop_vars)
             pred = cond_fn(*loop_vars)
         return loop_vars
+    if any(v is UNDEFINED for v in loop_vars):
+        raise NameError(
+            "@declarative symbolic `while`: every loop-carried variable "
+            "must be bound before the loop (the loop may run zero times)")
     if current_ctx() is None:
         raise RuntimeError(
             "symbolic `while` outside @declarative capture")
     from ...layers import control_flow as cf
 
+    def body(*vs):
+        outs = _unwrap_struct(tuple(body_fn(*_wrap_struct(tuple(vs)))))
+        # a body may assign a python literal to a carried name (e.g.
+        # `done = True`); coerce it like the carry init so the loop's
+        # per-iteration signature stays (Variable, ...) throughout
+        return tuple(o if isinstance(o, framework.Variable)
+                     else _scalar_const(o) for o in outs)
+
     out = cf.while_loop(
         lambda *vs: _to_bool_var(cond_fn(*_wrap_struct(tuple(vs)))),
-        lambda *vs: _unwrap_struct(tuple(body_fn(
-            *_wrap_struct(tuple(vs))))),
+        body,
         tuple(_loop_carry(v) for v in loop_vars))
     return tuple(_wrap_struct(tuple(out)))
 

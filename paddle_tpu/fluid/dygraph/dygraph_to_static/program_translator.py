@@ -202,20 +202,50 @@ def _is_tensor_arg(a):
 
 class ConcreteProgram:
     """One captured (program, feeds, fetches) per input signature
-    (reference: program_translator.py ConcreteProgram)."""
+    (reference: program_translator.py ConcreteProgram).
+
+    Training support (TPU-native replacement for the reference's
+    ProgramTranslator train-to-static path): when called under an
+    active tracer with trainable captured parameters, the captured
+    Program is lowered to a pure jax function of (params, feeds); the
+    forward runs jitted and ONE tape entry with a whole-program
+    custom vjp (rematerializing jax.vjp, itself jitted) is recorded,
+    so `loss.backward()` delivers gradients into the eager parameter
+    tensors and optimizer.minimize()/step() trains them."""
 
     def __init__(self, main, startup, feed_names, fetch_vars, template,
-                 ctx):
+                 ctx, kw_feed_keys=()):
         self.main = main
         self.startup = startup
         self.feed_names = feed_names
         self.fetch_vars = fetch_vars
         self.template = template  # output structure
         self.ctx = ctx
+        # kwarg keys that became feed vars (sorted); their feed names come
+        # after the positional ones in feed_names
+        self.kw_feed_keys = tuple(kw_feed_keys)
         self._exe = None
+        self._pure = None       # (fn, state_mut, state_ro)
+        self._diff_cache = {}   # frozenset(diff names) -> jit entry
+
+    def _writeback(self, new_values):
+        """Publish program-updated persistable state (BN running stats,
+        inplace-assigned buffers) back into the captured eager tensors so
+        eager<->static state stays coherent across calls."""
+        for t, var in self.ctx.params:
+            nv = new_values.get(var.name)
+            if nv is not None:
+                t._val = nv
 
     def run(self, tensor_args):
+        tracer = framework._dygraph_tracer()
+        diff_names = self._diff_names(tensor_args) \
+            if tracer is not None and tracer._has_grad else []
+        if diff_names:
+            return self._run_diff(tensor_args, tracer, diff_names)
+
         from ...executor import Executor
+        from ....core.scope import global_scope as _gs
 
         if self._exe is None:
             self._exe = Executor()
@@ -227,8 +257,130 @@ class ConcreteProgram:
         outs = self._exe.run(self.main, feed=feed,
                              fetch_list=list(self.fetch_vars),
                              return_numpy=False)
+        scope = _gs()
+        self._writeback({var.name: scope.find_var(var.name)
+                         for _, var in self.ctx.params})
         wrapped = [dy_base.wrap_raw(o) for o in outs]
         return _pack_like(self.template, wrapped)
+
+    # -- differentiable path ----------------------------------------------
+    def _diff_names(self, tensor_args):
+        import jax.numpy as jnp
+
+        def is_float(t):
+            return jnp.issubdtype(t._val.dtype, jnp.inexact)
+
+        names = [var.name for t, var in self.ctx.params
+                 if getattr(t, "trainable", False)
+                 and not t.stop_gradient and is_float(t)]
+        for name, a in zip(self.feed_names, tensor_args):
+            if isinstance(a, dy_base.Tensor) and not a.stop_gradient \
+                    and is_float(a):
+                names.append(name)
+        return names
+
+    def _build_pure(self):
+        from ... import lowering
+
+        block = self.main.global_block()
+        fetch_names = [v.name for v in self.fetch_vars]
+        state_in, state_out = lowering.analyze_block(
+            block, list(self.feed_names), fetch_names)
+        fn = lowering.build_block_fn(self.main, block,
+                                     list(self.feed_names), fetch_names,
+                                     state_in, state_out)
+        sout = set(state_out)
+        mut = [n for n in state_in if n in sout]
+        ro = [n for n in state_in if n not in sout]
+        return fn, mut, ro
+
+    def _run_diff(self, tensor_args, tracer, diff_names):
+        import jax
+        import jax.numpy as jnp
+
+        if self._pure is None:
+            self._pure = self._build_pure()
+        fn, mut, ro = self._pure
+
+        values = {}
+        eager_of = {}
+        for t, var in self.ctx.params:
+            values[var.name] = t._val
+            eager_of[var.name] = t
+        for name, a in zip(self.feed_names, tensor_args):
+            values[name] = a._val if isinstance(a, dy_base.Tensor) \
+                else dy_base.to_tensor_value(np.asarray(a))
+            if isinstance(a, dy_base.Tensor):
+                eager_of[name] = a
+        missing = [n for n in (list(self.feed_names) + mut + ro)
+                   if n not in values]
+        if missing:
+            from ....core.scope import global_scope as _gs
+
+            for n in list(missing):
+                v = _gs().find_var(n)
+                if v is not None:
+                    values[n] = v
+                    missing.remove(n)
+        if missing:
+            raise RuntimeError(
+                "@declarative training: vars %s are read by the captured "
+                "program but have no captured eager value (create layers "
+                "outside the declarative function)" % (missing,))
+
+        key = frozenset(diff_names)
+        entry = self._diff_cache.get(key)
+        if entry is None:
+            feed_names = list(self.feed_names)
+
+            def pure(diff, nondiff, seed):
+                env = dict(nondiff)
+                env.update(diff)
+                return fn({n: env[n] for n in feed_names},
+                          {n: env[n] for n in mut},
+                          {n: env[n] for n in ro}, seed)
+
+            entry = {"pure": pure, "fwd": jax.jit(pure), "bwd": None}
+            self._diff_cache[key] = entry
+
+        diff_vals = {n: values[n] for n in diff_names}
+        nondiff_vals = {n: v for n, v in values.items()
+                        if n not in diff_vals}
+        seed = np.uint32(tracer._seed_counter % (2**31))
+        tracer._seed_counter += 1
+        fetches, new_states = entry["fwd"](diff_vals, nondiff_vals, seed)
+        self._writeback(new_states)
+
+        float_idx = tuple(i for i, v in enumerate(fetches)
+                          if jnp.issubdtype(v.dtype, jnp.inexact))
+        if entry["bwd"] is None:
+            pure = entry["pure"]
+
+            def bwd(diff, nondiff, seed_, cts):
+                def f(d):
+                    fs, _ = pure(d, nondiff, seed_)
+                    return [fs[i] for i in float_idx]
+
+                _, vjp_fn = jax.vjp(f, diff)
+                return vjp_fn(list(cts))[0]
+
+            entry["bwd"] = jax.jit(bwd)
+        bwd_jit = entry["bwd"]
+
+        out_tensors = [
+            dy_base.Tensor(v, stop_gradient=i not in float_idx)
+            for i, v in enumerate(fetches)]
+        in_tensors = [eager_of[n] for n in diff_names]
+
+        def custom_vjp(cotangents):
+            cts = [cotangents[i] for i in float_idx]
+            gd = bwd_jit(diff_vals, nondiff_vals, seed, cts)
+            return [gd[n] for n in diff_names]
+
+        tracer.record(dy_base.TapeEntry(
+            "concrete_program", {}, (), in_tensors, (), out_tensors,
+            None, custom_vjp=custom_vjp))
+        return _pack_like(self.template, out_tensors)
 
 
 def _flatten_outs(x, acc):
@@ -253,34 +405,48 @@ def _pack_like(template, flat):
 
 def capture_program(fn, args, kwargs=None):
     """Trace `fn` (already AST-converted) into a fresh static Program.
-    Tensor/ndarray args become feed vars; everything else is baked in."""
+    Tensor/ndarray args — positional AND keyword — become feed vars;
+    everything else is baked in. (Round-1 advisory fix: tensor kwargs
+    used to be captured as constants bound to the first call's value
+    while still participating in the cache key, silently computing with
+    stale data on later calls.)"""
     kwargs = kwargs or {}
     main = framework.Program()
     startup = framework.Program()
     ctx = CaptureContext(main)
     feed_names = []
     sym_args = []
+    kw_feed_keys = []
+    sym_kwargs = {}
     with framework.program_guard(main, startup):
         gb = main.global_block()
+
+        def feed_var(a, name):
+            shape = tuple(a.shape)
+            dtype = a.dtype if isinstance(a, dy_base.Tensor) \
+                else normalize_dtype(a.dtype)
+            var = gb.create_var(name=name, shape=shape, dtype=dtype,
+                                is_data=True, stop_gradient=True)
+            feed_names.append(name)
+            return SymbolicTensor(var)
+
         for i, a in enumerate(args):
+            sym_args.append(feed_var(a, "declarative_in_%d" % i)
+                            if _is_tensor_arg(a) else a)
+        for k in sorted(kwargs):
+            a = kwargs[k]
             if _is_tensor_arg(a):
-                shape = tuple(a.shape)
-                dtype = a.dtype if isinstance(a, dy_base.Tensor) \
-                    else normalize_dtype(a.dtype)
-                name = "declarative_in_%d" % i
-                var = gb.create_var(name=name, shape=shape, dtype=dtype,
-                                    is_data=True, stop_gradient=True)
-                feed_names.append(name)
-                sym_args.append(SymbolicTensor(var))
+                sym_kwargs[k] = feed_var(a, "declarative_kw_%s" % k)
+                kw_feed_keys.append(k)
             else:
-                sym_args.append(a)
+                sym_kwargs[k] = a
         prev = current_ctx()
         _state.ctx = ctx
         # leave dygraph mode: Block.append_op refuses to run under an
         # active eager tracer, and capture must not hit the eager path
         old_tracer = framework._switch_tracer(None)
         try:
-            out = fn(*sym_args, **kwargs)
+            out = fn(*sym_args, **sym_kwargs)
         finally:
             framework._switch_tracer(old_tracer)
             _state.ctx = prev
@@ -294,7 +460,8 @@ def capture_program(fn, args, kwargs=None):
         else:
             raise TypeError(
                 "@declarative function returned a non-Tensor leaf %r" % (o,))
-    return ConcreteProgram(main, startup, feed_names, fetch_vars, out, ctx)
+    return ConcreteProgram(main, startup, feed_names, fetch_vars, out, ctx,
+                           kw_feed_keys=kw_feed_keys)
 
 
 # ---------------------------------------------------------------------------
@@ -400,4 +567,5 @@ class StaticFunction:
         cp = self.concrete_program(*args, **kwargs)
         tensor_args = [a for a in self._full_args(args)
                        if _is_tensor_arg(a)]
+        tensor_args += [kwargs[k] for k in cp.kw_feed_keys]
         return cp.run(tensor_args)

@@ -448,9 +448,16 @@ def _flip(ins, attrs):
 
 @register_op("unique", no_jit=True)
 def _unique(ins, attrs):
+    """Slots follow the 2.0 unique op: Index = inverse mapping (the
+    fluid-era output), Indices = first-occurrence positions, Counts.
+    Host-side (no_jit): output shape is data-dependent."""
     x = np.asarray(ins["X"][0])
-    out, index = np.unique(x, return_inverse=True)
-    return {"Out": jnp.asarray(out), "Index": jnp.asarray(index.astype(np.int32))}
+    out, first_idx, inverse, counts = np.unique(
+        x, return_index=True, return_inverse=True, return_counts=True)
+    return {"Out": jnp.asarray(out),
+            "Index": jnp.asarray(inverse.astype(np.int64)),
+            "Indices": jnp.asarray(first_idx.astype(np.int64)),
+            "Counts": jnp.asarray(counts.astype(np.int64))}
 
 
 @register_op("take_along_axis")

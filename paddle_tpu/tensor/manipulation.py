@@ -121,12 +121,22 @@ def cast(x, dtype):
 
 def unique(x, return_index=False, return_inverse=False,
            return_counts=False, axis=None, dtype="int64", name=None):
+    """paddle.unique: Out [, first-occurrence Indices][, Inverse]
+    [, Counts] (reference: python/paddle/tensor/manipulation.py unique)."""
+    if axis is not None:
+        raise NotImplementedError("unique(axis=...) is not supported yet")
     outs = apply_op("unique", "unique", {"X": [x]}, {},
-                    ["Out", "Index"],
+                    ["Out", "Index", "Indices", "Counts"],
                     out_dtype=getattr(x, "dtype", "float32"))
-    if return_inverse or return_index:
-        return outs[0], outs[1]
-    return outs[0]
+    out, inverse, first_idx, counts = outs
+    result = [out]
+    if return_index:
+        result.append(first_idx)
+    if return_inverse:
+        result.append(inverse)
+    if return_counts:
+        result.append(counts)
+    return tuple(result) if len(result) > 1 else out
 
 
 def take_along_axis(x, indices, axis, name=None):

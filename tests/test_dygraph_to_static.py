@@ -144,3 +144,92 @@ def test_jit_save_load(tmp_path):
     loaded = dygraph.jit.load(d)
     got = loaded(x).numpy()
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_declarative_training_updates_params():
+    """Round-1 advisory (high): training a @declarative forward used to be
+    a silent no-op (outputs never reached the tape)."""
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(4, 1)
+
+        @declarative
+        def forward(self, x):
+            return self.fc(x)
+
+    with dygraph.guard():
+        net = Net()
+        opt = fluid.optimizer.SGDOptimizer(
+            learning_rate=0.2, parameter_list=net.parameters())
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .rand(8, 4).astype("float32"))
+        y = paddle.to_tensor(np.random.RandomState(1)
+                             .rand(8, 1).astype("float32"))
+        w0 = net.fc.weight.numpy().copy()
+        losses = []
+        for _ in range(5):
+            diff = net(x) - y
+            loss = paddle.fluid.dygraph.base.trace_op(
+                "mean", {"X": [diff * diff]}, {}, ["Out"])[0]
+            loss.backward()
+            opt.minimize(loss)
+            net.clear_gradients()
+            losses.append(float(loss.numpy().reshape(-1)[0]))
+        assert losses[-1] < losses[0] * 0.8, losses
+        assert not np.allclose(net.fc.weight.numpy(), w0)
+
+
+def test_declarative_tensor_kwarg_not_stale():
+    """Round-1 advisory (medium): a tensor kwarg used to be baked in as a
+    constant from the first call while still hitting the signature cache."""
+    @declarative
+    def f(x, bias=None):
+        return x + bias
+
+    with dygraph.guard():
+        x = paddle.to_tensor(np.ones((2, 3), "float32"))
+        b1 = paddle.to_tensor(np.full((2, 3), 1.0, "float32"))
+        b2 = paddle.to_tensor(np.full((2, 3), 5.0, "float32"))
+        out1 = f(x, bias=b1).numpy()
+        out2 = f(x, bias=b2).numpy()
+        np.testing.assert_allclose(out1, np.full((2, 3), 2.0))
+        np.testing.assert_allclose(out2, np.full((2, 3), 6.0))
+
+
+def test_declarative_python_while_with_body_temp():
+    """Round-1 advisory (medium): python-valued while whose body assigns a
+    temporary not bound before the loop must keep python semantics."""
+    @declarative
+    def f(x):
+        i = 0
+        while i < 3:
+            tmp = x + 1.0
+            x = tmp
+            i = i + 1
+        return x
+
+    with dygraph.guard():
+        x = paddle.to_tensor(np.zeros((2,), "float32"))
+        np.testing.assert_allclose(f(x).numpy(), np.full((2,), 3.0))
+
+
+def test_declarative_while_bool_and_int_carry():
+    """Body assigning python literals (bool flag, int counter) to carried
+    names in a SYMBOLIC while must coerce like the carry init (review
+    finding, round 2)."""
+    @declarative
+    def f(x, n):
+        i = 0
+        flag = True
+        while i < n:
+            x = x + 1.0
+            i = i + 1
+            flag = False
+        return x
+
+    with dygraph.guard():
+        x = paddle.to_tensor(np.zeros((2,), "float32"))
+        n = paddle.to_tensor(np.array([3], "int32"))
+        out = f(x, n)
+        np.testing.assert_allclose(out.numpy(), np.full((2,), 3.0))

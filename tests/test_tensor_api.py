@@ -245,3 +245,21 @@ def test_conv2d_transpose_golden():
                        ["Output"], out_dtype="float32")[0]
         np.testing.assert_allclose(out.numpy(), want, rtol=1e-4,
                                    atol=1e-5)
+
+
+def test_unique_index_inverse_counts():
+    """Round-1 advisory (low): return_index used to return the inverse
+    mapping; counts were silently ignored."""
+    with dygraph.guard():
+        x = paddle.to_tensor(np.array([3, 1, 3, 2, 1, 1], "int64"))
+        out, idx, inv, cnt = paddle.unique(
+            x, return_index=True, return_inverse=True, return_counts=True)
+        e_out, e_idx, e_inv, e_cnt = np.unique(
+            np.array([3, 1, 3, 2, 1, 1]), return_index=True,
+            return_inverse=True, return_counts=True)
+        np.testing.assert_array_equal(out.numpy(), e_out)
+        np.testing.assert_array_equal(idx.numpy(), e_idx)
+        np.testing.assert_array_equal(inv.numpy(), e_inv)
+        np.testing.assert_array_equal(cnt.numpy(), e_cnt)
+        with pytest.raises(NotImplementedError):
+            paddle.unique(x, axis=0)
