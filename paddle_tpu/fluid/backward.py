@@ -74,20 +74,24 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
         name=grad_var_name(loss.name), shape=loss.shape,
         dtype=loss.dtype, stop_gradient=True)
 
+    attrs = {
+        "loss_name": loss.name,
+        "diff_names": diff_names,
+        "loss_scale": 1.0,
+        "_is_backward": True,
+    }
+    # recompute segments (reference backward.py:629): checkpoint names
+    # recorded on the backward op; lowering splits the forward at each
+    # checkpoint and wraps the segments in jax.checkpoint (remat).
+    if checkpoints:
+        attrs["checkpoints"] = [v.name if isinstance(v, Variable) else v
+                                for v in checkpoints]
     block.append_op(
         type="backward",
         inputs={"Loss": [loss]},
         outputs={"Grad": [grad_var_name(n) for n in diff_names],
                  "LossGrad": [loss_grad]},
-        attrs={
-            "loss_name": loss.name,
-            "diff_names": diff_names,
-            "loss_scale": 1.0,
-            "_is_backward": True,
-        })
-    # recompute segments (reference backward.py:629): jax.remat is applied
-    # per-layer by RecomputeOptimizer instead; checkpoints accepted for API
-    # compatibility.
+        attrs=attrs)
     return params_grads
 
 

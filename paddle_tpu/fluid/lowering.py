@@ -303,6 +303,47 @@ def _exec_switch_case(op, env, key0, op_idx, amp_lists):
     env.update(zip(out_names, outs))
 
 
+def _split_at_checkpoints(ops, ckpt_names):
+    """Segment boundaries for activation recompute: a segment ends right
+    after the (last) op that writes each checkpoint variable. Returns a
+    list of (start, stop) index pairs covering `ops`."""
+    cuts = set()
+    for cn in ckpt_names:
+        last = None
+        for i, op in enumerate(ops):
+            if cn in op.output_arg_names:
+                last = i
+        if last is not None and last + 1 < len(ops):
+            cuts.add(last + 1)
+    bounds, prev = [], 0
+    for c in sorted(cuts):
+        bounds.append((prev, c))
+        prev = c
+    bounds.append((prev, len(ops)))
+    return bounds
+
+
+def _remat_segments(fwd_ops, ckpt_names, live_out):
+    """Plan jax.checkpoint segments (reference: backward.py:629 recompute
+    segments + optimizer.py:4485 RecomputeOptimizer). Each entry is
+    (start, stop, needed_after): `needed_after` is the set of names still
+    read by later forward segments or by anything downstream (loss, post-
+    backward ops, fetches, state outputs) — the only values a checkpointed
+    segment must emit, so XLA stores just the boundary residuals and
+    rematerializes segment interiors during the backward pass."""
+    bounds = _split_at_checkpoints(fwd_ops, ckpt_names)
+    if len(bounds) <= 1:
+        return None
+    out = []
+    needed = set(live_out)
+    for start, stop in reversed(bounds):
+        out.append((start, stop, frozenset(needed)))
+        for op in fwd_ops[start:stop]:
+            needed.update(_op_reads_writes(op)[0])
+    out.reverse()
+    return out
+
+
 def _diffable(block, name, env):
     v = block._find_var_recursive(name)
     if v is None or v.stop_gradient:
@@ -347,10 +388,29 @@ def build_block_fn(program, block, feed_names, fetch_names,
             diff_names = [n for n in requested
                           if n in env and _diffable(block, n, env)]
 
+            ckpt_names = list(bop.attrs.get("checkpoints", []) or [])
+            segments = None
+            if ckpt_names:
+                live_out = set(fetch_names) | set(state_out) | {loss_name}
+                for post_op in ops[bwd_idx + 1:]:
+                    live_out.update(_op_reads_writes(post_op)[0])
+                segments = _remat_segments(fwd_ops, ckpt_names, live_out)
+
             def fseg(dvars):
                 e = dict(env)
                 e.update(dvars)
-                _run_ops(fwd_ops, e, key0, amp_lists=amp_lists)
+                if segments is None:
+                    _run_ops(fwd_ops, e, key0, amp_lists=amp_lists)
+                else:
+                    for start, stop, needed in segments:
+                        def seg_fn(carry, _ops=fwd_ops[start:stop],
+                                   _start=start, _needed=needed):
+                            ee = dict(carry)
+                            _run_ops(_ops, ee, key0, base_idx=_start,
+                                     amp_lists=amp_lists)
+                            return {n: ee[n] for n in _needed if n in ee}
+
+                        e.update(jax.checkpoint(seg_fn)(e))
                 loss_sum = jnp.sum(e[loss_name].astype(jnp.float32))
                 return loss_sum, e
 

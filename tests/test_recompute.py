@@ -1,0 +1,121 @@
+"""RecomputeOptimizer: activation checkpointing is REAL in the fluid
+path — lowering splits the forward at checkpoint vars and wraps each
+segment in jax.checkpoint (reference: backward.py:629 recompute
+segments + optimizer.py:4485 RecomputeOptimizer)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework, lowering
+
+
+def _build(recompute):
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = startup.random_seed = 7
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data(name="x", shape=[64], dtype="float32")
+            label = fluid.layers.data(name="label", shape=[1],
+                                      dtype="int64")
+            h1 = fluid.layers.fc(input=x, size=128, act="relu")
+            h2 = fluid.layers.fc(input=h1, size=128, act="relu")
+            h3 = fluid.layers.fc(input=h2, size=128, act="relu")
+            logits = fluid.layers.fc(input=h3, size=10)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, label))
+            opt = fluid.optimizer.SGDOptimizer(learning_rate=0.1)
+            if recompute:
+                opt = fluid.optimizer.RecomputeOptimizer(opt)
+                opt._set_checkpoints([h1, h2])
+            opt.minimize(loss)
+    return main, startup, loss
+
+
+def _run(recompute, steps=4):
+    main, startup, loss = _build(recompute)
+    scope = __import__("paddle_tpu.core.scope",
+                       fromlist=["Scope"]).Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    r = np.random.RandomState(0)
+    x = r.rand(32, 64).astype("float32")
+    y = r.randint(0, 10, (32, 1)).astype("int64")
+    exe.run(startup, scope=scope)
+    losses = []
+    for _ in range(steps):
+        out = exe.run(main, feed={"x": x, "label": y},
+                      fetch_list=[loss], scope=scope)
+        losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+    return main, losses
+
+
+def test_recompute_loss_parity():
+    """Recompute changes memory behaviour, not numerics: identical loss
+    curves with and without checkpoints."""
+    _, base = _run(recompute=False)
+    _, rc = _run(recompute=True)
+    np.testing.assert_allclose(rc, base, rtol=1e-6, atol=1e-6)
+    assert rc[-1] < rc[0]  # it actually trains
+
+
+def test_recompute_sets_backward_attr_and_remats():
+    """The backward op carries the checkpoints attr and the lowered
+    computation contains remat regions (jax.checkpoint engaged)."""
+    import jax
+
+    main, startup, loss = _build(recompute=True)
+    bops = [op for op in main.global_block().ops
+            if op.type == "backward"]
+    assert bops and bops[0].attrs.get("checkpoints"), \
+        "checkpoints attr missing from backward op"
+
+    block = main.global_block()
+    feed_specs = {
+        "x": np.zeros((32, 64), "float32"),
+        "label": np.zeros((32, 1), "int64"),
+    }
+    state_in, state_out = lowering.analyze_block(
+        block, list(feed_specs), [loss.name])
+    fn = lowering.build_block_fn(main, block, list(feed_specs),
+                                 [loss.name], state_in, state_out)
+
+    # materialize the states by running startup in a scope
+    from paddle_tpu.core.scope import Scope
+
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    states = {n: scope.find_var(n) for n in state_in}
+    jaxpr = jax.make_jaxpr(
+        lambda f, s: fn(f, s, {}, np.uint32(0)))(feed_specs, states)
+    assert "remat" in str(jaxpr), "no remat regions in lowered jaxpr"
+
+
+def test_recompute_replays_forward_in_backward():
+    """Rematerialization signature in the lowered computation: with
+    checkpoints the forward matmuls are REPLAYED inside the backward
+    (more dot_general ops in the HLO), which is what trades FLOPs for
+    activation memory. Without checkpoints the counts stay at
+    fwd + bwd only."""
+    import jax
+
+    from paddle_tpu.core.scope import Scope
+
+    counts = {}
+    for recompute in (False, True):
+        main, startup, loss = _build(recompute)
+        block = main.global_block()
+        feed_specs = {
+            "x": np.zeros((32, 64), "float32"),
+            "label": np.zeros((32, 1), "int64"),
+        }
+        state_in, state_out = lowering.analyze_block(
+            block, list(feed_specs), [loss.name])
+        scope = Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup, scope=scope)
+        states = {n: scope.find_var(n) for n in state_in}
+        fn = lowering.build_block_fn(main, block, list(feed_specs),
+                                     [loss.name], state_in, state_out)
+        txt = jax.jit(fn).lower(feed_specs, states, {},
+                                np.uint32(0)).as_text()
+        counts[recompute] = txt.count("dot_general")
+    assert counts[True] > counts[False], counts
