@@ -445,6 +445,12 @@ def compile_block(program, block, feed_specs, fetch_names, state_specs,
     (only shapes/dtypes are read). Returns a LoweredFunction."""
     import jax
 
+    if getattr(program, "_pipeline_cfg", None):
+        from ..parallel.pipeline import compile_pipeline
+
+        return compile_pipeline(program, block, feed_specs, fetch_names,
+                                state_specs)
+
     feed_names = list(feed_specs)
     state_in, state_out = analyze_block(block, feed_names, fetch_names)
     missing = [n for n in state_in if n not in state_specs]

@@ -745,22 +745,38 @@ class LookaheadOptimizer:
 
 class PipelineOptimizer:
     """Pipeline-parallel program splitter (reference: optimizer.py:3634 +
-    pipeline_trainer.cc). The TPU-native pipeline engine lives in
-    paddle_tpu.parallel.pipeline (shard_map + ppermute microbatching);
-    this wrapper keeps the fluid API and trains non-pipelined on one mesh
-    until stage annotations are present."""
+    pipeline_trainer.cc section_worker.cc:82). The program is cut at
+    `cut_list` variables into per-stage subprograms; lowering dispatches
+    to the paddle_tpu.parallel.pipeline GPipe engine (shard_map over a
+    'pp' mesh axis, lax.scan fill-drain with ppermute boundary handoff,
+    num_microbatches gradient accumulation)."""
 
     def __init__(self, optimizer, cut_list=None, place_list=None,
                  concurrency_list=None, queue_size=30, sync_steps=1,
                  start_cpu_core_id=0, num_microbatches=1):
         self._optimizer = optimizer
-        self._cut_list = cut_list
+        self._cut_list = cut_list or []
         self._num_microbatches = num_microbatches
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
-        return self._optimizer.minimize(loss, startup_program,
-                                        parameter_list, no_grad_set)
+        result = self._optimizer.minimize(loss, startup_program,
+                                          parameter_list, no_grad_set)
+        cut_names = []
+        for cut in self._cut_list:
+            vars_ = cut if isinstance(cut, (list, tuple)) else [cut]
+            for v in vars_:
+                cut_names.append(v.name if isinstance(v, Variable)
+                                 else str(v))
+        program = loss.block.program
+        program._pipeline_cfg = {
+            "cut_names": cut_names,
+            "n_micro": int(self._num_microbatches),
+        }
+        return result
 
 
 # paddle 2.0-style aliases

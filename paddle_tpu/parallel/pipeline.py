@@ -1,0 +1,337 @@
+"""Fluid pipeline parallelism: GPipe over a 'pp' mesh axis.
+
+Reference parity: `python/paddle/fluid/optimizer.py:3634` PipelineOptimizer
+splits the program into per-device "sections" executed by SectionWorkers
+linked with microbatch queues (`framework/pipeline_trainer.cc:24`,
+`framework/section_worker.cc:82`). TPU-native design: the cut subprograms
+become pure per-stage functions; one `jax.shard_map` over a 'pp' mesh axis
+runs a `lax.scan` fill-drain schedule where each device executes its stage
+(`lax.switch`) on the flowing microbatch and hands the boundary activations
+to the next stage with `lax.ppermute` — the same proven loop as the SPMD
+transformer trainer (`parallel/transformer.py` pipe_body), generalized to
+heterogeneous stages by packing each boundary into a fixed-size padded
+float32 ring buffer. Gradients come from `jax.grad` straight through the
+scanned ppermute loop (XLA transposes the permute), so microbatch gradient
+accumulation is exact GPipe: loss and grads match the non-pipelined program.
+
+Limitations (v1, documented): forward-section state updates (e.g. BN
+running stats) and non-float boundary activations are not supported in
+pipeline mode; gradients are produced for parameters (not leaf feeds).
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from ..fluid import framework
+from ..fluid.framework import grad_var_name
+
+
+def _stage_bounds(fwd_ops, cut_names):
+    from ..fluid import lowering
+
+    return lowering._split_at_checkpoints(fwd_ops, cut_names)
+
+
+def _stage_io(stage_ops_list, feed_names, state_names):
+    """Per-stage (inputs, writes): inputs are names read before being
+    produced within the stage."""
+    ins, writes = [], []
+    from ..fluid import lowering
+
+    for ops in stage_ops_list:
+        produced = set()
+        reads_s, writes_s = [], set()
+        for op in ops:
+            r, w = lowering._op_reads_writes(op)
+            for n in r:
+                if n not in produced and n not in reads_s:
+                    reads_s.append(n)
+            for n in w:
+                produced.add(n)
+                writes_s.add(n)
+        ins.append(reads_s)
+        writes.append(writes_s)
+    return ins, writes
+
+
+class _BoundarySpec:
+    """Packing layout of one pp edge: ordered (name, shape, dtype)."""
+
+    def __init__(self, entries):
+        self.entries = entries  # list of (name, shape, np.dtype)
+        self.sizes = [int(np.prod(s)) if s else 1 for _, s, _ in entries]
+        self.total = sum(self.sizes)
+
+    def pack(self, env, total_padded):
+        import jax.numpy as jnp
+
+        if not self.entries:
+            return jnp.zeros((total_padded,), jnp.float32)
+        parts = []
+        for (name, shape, dtype), size in zip(self.entries, self.sizes):
+            v = env[name]
+            parts.append(jnp.reshape(v, (-1,)).astype(jnp.float32))
+        flat = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+        pad = total_padded - self.total
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+        return flat
+
+    def unpack(self, buf):
+        import jax.numpy as jnp
+
+        out, off = {}, 0
+        for (name, shape, dtype), size in zip(self.entries, self.sizes):
+            piece = buf[off:off + size]
+            out[name] = jnp.reshape(piece, shape).astype(dtype)
+            off += size
+        return out
+
+
+def compile_pipeline(program, block, feed_specs, fetch_names, state_specs):
+    """Lower a backward-carrying program with program._pipeline_cfg into a
+    LoweredFunction running the GPipe engine. Same call contract as
+    lowering.compile_block."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh
+
+    from ..fluid import lowering
+
+    cfg = program._pipeline_cfg
+    cut_names: List[str] = list(cfg.get("cut_names") or [])
+    n_micro = int(cfg.get("n_micro", 1))
+
+    ops = list(block.ops)
+    bwd_idxs = [i for i, op in enumerate(ops) if op.type == "backward"]
+    if not bwd_idxs:
+        raise NotImplementedError(
+            "PipelineOptimizer requires a training program (backward op)")
+    bwd_idx = bwd_idxs[0]
+    fwd_ops, bop, post_ops = ops[:bwd_idx], ops[bwd_idx], ops[bwd_idx + 1:]
+    loss_name = bop.attrs["loss_name"]
+    loss_scale = bop.attrs.get("loss_scale", 1.0)
+
+    feed_names = list(feed_specs)
+    state_in, state_out = lowering.analyze_block(block, feed_names,
+                                                 fetch_names)
+    state_names = set(state_in)
+
+    bounds = _stage_bounds(fwd_ops, cut_names)
+    S = len(bounds)
+    stage_ops = [fwd_ops[a:b] for a, b in bounds]
+    stage_base = [a for a, _ in bounds]
+    stage_ins, stage_writes = _stage_io(stage_ops, feed_names, state_names)
+
+    # v1 restriction: no persistable writes inside forward sections
+    fwd_state_writes = sorted(
+        n for ws in stage_writes for n in ws
+        if (v := block._find_var_recursive(n)) is not None and v.persistable)
+    if fwd_state_writes:
+        raise NotImplementedError(
+            "pipeline mode does not support in-forward state updates "
+            "(e.g. batch_norm running stats): %s" % fwd_state_writes)
+
+    produced_upto = []  # names produced by stages <= s
+    acc = set()
+    for ws in stage_writes:
+        acc |= ws
+        produced_upto.append(set(acc))
+
+    batch0 = next(iter(feed_specs.values())).shape[0]
+    if batch0 % n_micro:
+        raise ValueError("batch size %d not divisible by num_microbatches "
+                         "%d" % (batch0, n_micro))
+    mb = batch0 // n_micro
+
+    params_by_stage = []
+    for s in range(S):
+        ps = {n for n in stage_ins[s] if n in state_names}
+        params_by_stage.append(sorted(ps))
+    feeds_by_stage = [sorted(n for n in stage_ins[s] if n in feed_names)
+                      for s in range(S)]
+
+    state_vals = {n: state_specs[n] for n in state_in}
+
+    def run_stage(s, env, key):
+        lowering._run_ops(stage_ops[s], env, key, base_idx=stage_base[s],
+                          amp_lists=None)
+        return env
+
+    # Learn each pp edge's boundary entry shapes by abstractly
+    # interpreting one microbatch through the stages (jax.eval_shape —
+    # no FLOPs, no devices touched).
+    feeds_struct = {}
+    for n, a in feed_specs.items():
+        shp = (mb,) + tuple(np.asarray(a).shape[1:])
+        dt = a.dtype if hasattr(a, "dtype") else np.asarray(a).dtype
+        feeds_struct[n] = jax.ShapeDtypeStruct(shp, dt)
+    env_struct = {}
+    env_struct.update({n: jax.ShapeDtypeStruct(np.asarray(v).shape,
+                                               np.asarray(v).dtype)
+                       for n, v in state_vals.items()})
+    env_struct.update(feeds_struct)
+    edge_entry_lists = []
+    for s in range(S):
+        def one_stage(env_in, _s=s):
+            e = dict(env_in)
+            run_stage(_s, e, jax.random.PRNGKey(0))
+            return e
+
+        env_struct = jax.eval_shape(one_stage, env_struct)
+        carry = sorted(
+            n for n in produced_upto[s]
+            if any(n in stage_ins[t] for t in range(s + 1, S)))
+        entries = []
+        for n in carry:
+            st = env_struct[n]
+            if not np.issubdtype(np.dtype(str(st.dtype)), np.floating):
+                raise NotImplementedError(
+                    "pipeline boundary value %r has non-float dtype %s"
+                    % (n, st.dtype))
+            entries.append((n, tuple(st.shape), np.dtype(str(st.dtype))))
+        edge_entry_lists.append(entries)
+
+    edge_specs = [_BoundarySpec(e) for e in edge_entry_lists]
+    buf_elems = max([es.total for es in edge_specs] + [1])
+
+    diff_names = [n for n in bop.attrs.get("diff_names", [])
+                  if n in state_names]
+
+    # device mesh over the first S devices
+    devices = jax.devices()
+    if len(devices) < S:
+        raise RuntimeError(
+            "pipeline has %d stages but only %d devices" % (S,
+                                                            len(devices)))
+    mesh = Mesh(np.array(devices[:S]), ("pp",))
+
+    from jax.sharding import PartitionSpec as P
+
+    def fn(feeds: Dict, states_mut: Dict, states_ro: Dict, seed):
+        env0 = {}
+        env0.update(states_ro)
+        env0.update(states_mut)
+        key0 = jax.random.PRNGKey(seed)
+
+        # [n_micro, mb, ...] microbatched feeds
+        feeds_mb = {
+            n: jnp.reshape(jnp.asarray(a),
+                           (n_micro, mb) + tuple(a.shape[1:]))
+            for n, a in feeds.items()}
+
+        params = {n: env0[n] for n in state_names if n in env0}
+        diff_params = {n: params[n] for n in diff_names}
+        other_state = {n: v for n, v in params.items()
+                       if n not in diff_params}
+
+        def device_step(diff_p, other_st, f_mb):
+            stage = lax.axis_index("pp")
+
+            def fwd_loss(dp):
+                st_all = dict(other_st)
+                st_all.update(dp)
+
+                def pipe_body(carry, t):
+                    buf, loss_acc = carry
+
+                    def make_branch(s):
+                        def br(b):
+                            mb_idx = jnp.clip(t - s, 0, n_micro - 1)
+                            e = {}
+                            for n in params_by_stage[s]:
+                                e[n] = st_all[n]
+                            for n in feeds_by_stage[s]:
+                                e[n] = f_mb[n][mb_idx]
+                            if s > 0:
+                                e.update(edge_specs[s - 1].unpack(b))
+                            key = jax.random.fold_in(key0, mb_idx)
+                            run_stage(s, e, key)
+                            out_buf = edge_specs[s].pack(e, buf_elems) \
+                                if s < S - 1 else \
+                                jnp.zeros((buf_elems,), jnp.float32)
+                            if s == S - 1:
+                                l = jnp.mean(
+                                    e[loss_name].astype(jnp.float32))
+                            else:
+                                l = jnp.float32(0.0)
+                            return out_buf, l
+
+                        return br
+
+                    out_buf, l = lax.switch(
+                        stage, [make_branch(s) for s in range(S)], buf)
+                    valid = jnp.logical_and(stage == S - 1,
+                                            t >= S - 1)
+                    loss_acc = loss_acc + jnp.where(valid, l, 0.0)
+                    if S > 1:
+                        perm = [(i, (i + 1) % S) for i in range(S)]
+                        out_buf = lax.ppermute(out_buf, "pp", perm)
+                    return (out_buf, loss_acc), None
+
+                buf0 = jnp.zeros((buf_elems,), jnp.float32)
+                (_, loss_acc), _ = lax.scan(
+                    pipe_body, (buf0, jnp.float32(0.0)),
+                    jnp.arange(n_micro + S - 1))
+                # local mean-of-microbatch losses; nonzero only on the
+                # last stage. Do NOT psum here: psum's transpose is psum,
+                # so a collective inside the differentiated function would
+                # multiply every cotangent by the pp group size.
+                return loss_acc / n_micro
+
+            loss_local, grads = jax.value_and_grad(fwd_loss)(diff_p)
+            # each device now holds exactly its own stage's grads (the
+            # ppermute transpose routed the last stage's cotangent back
+            # through the ring); one psum replicates the full gradient
+            # and the scalar loss everywhere.
+            loss = lax.psum(loss_local, "pp")
+            grads = jax.tree.map(lambda g: lax.psum(g, "pp"), grads)
+            return loss, grads
+
+        smapped = jax.shard_map(
+            device_step, mesh=mesh,
+            in_specs=(P(), P(), P()),
+            out_specs=(P(), P()),
+            check_vma=False)
+        loss, grads = smapped(diff_params, other_state, feeds_mb)
+
+        env = dict(env0)
+        env.update(feeds)  # full-batch feeds stay visible downstream
+        loss_var = block._find_var_recursive(loss_name)
+        loss_shaped = jnp.reshape(
+            loss, loss_var.shape if loss_var is not None
+            and loss_var.shape else ())
+        env[loss_name] = loss_shaped.astype(
+            np.dtype("float32"))
+        env[grad_var_name(loss_name)] = jnp.full_like(
+            loss_shaped, loss_scale)
+        for n in diff_names:
+            env[grad_var_name(n)] = (grads[n] * loss_scale).astype(
+                env[n].dtype)
+
+        lowering._run_ops(post_ops, env, key0, base_idx=bwd_idx + 1)
+
+        fetches = []
+        for n in fetch_names:
+            if n not in env:
+                raise RuntimeError(
+                    "fetch var %r is not available in pipeline mode (only "
+                    "loss, state and post-backward outputs are)" % n)
+            fetches.append(env[n])
+        new_states = {n: env[n] for n in state_out if n in env}
+        return fetches, new_states
+
+    from ..fluid.lowering import LoweredFunction
+    from ..utils.flags import get_flag
+
+    donate = bool(get_flag("FLAGS_tpu_donate_buffers", True))
+    state_out_set = set(state_out)
+    state_mut = [n for n in state_in if n in state_out_set]
+    state_ro = [n for n in state_in if n not in state_out_set]
+    jitted = jax.jit(fn, donate_argnums=(1,) if donate else ())
+    return LoweredFunction(jitted, feed_names, state_in, state_out,
+                           state_mut, state_ro, fetch_names, mesh=None,
+                           dp_axis=None)

@@ -84,7 +84,7 @@ def param_specs(cfg):
         "ln_f": {"scale": P(None), "bias": P(None)},
         "layers": {
             "ln1_s": P("pp", None, None), "ln1_b": P("pp", None, None),
-            "wqkv": P("pp", None, None, "tp"),
+            "wqkv": P("pp", None, None, None, "tp"),
             "wo": P("pp", None, "tp", None),
             "ln2_s": P("pp", None, None), "ln2_b": P("pp", None, None),
             "w1": P("pp", None, None, "tp"),
@@ -115,7 +115,7 @@ def init_params(cfg, seed=0):
         "layers": {
             "ln1_s": np.ones((P_, L, D), np.float32),
             "ln1_b": np.zeros((P_, L, D), np.float32),
-            "wqkv": nrm(ks[2], (P_, L, D, 3 * D)),
+            "wqkv": nrm(ks[2], (P_, L, D, 3, D)),
             "wo": nrm(ks[3], (P_, L, D, D),
                       scale=std / math.sqrt(2 * cfg.n_layers)),
             "ln2_s": np.ones((P_, L, D), np.float32),
@@ -173,8 +173,13 @@ def _layer_fn(cfg, x_seq, lp, dropout_key):
     h = ln(x_seq, lp["ln1_s"], lp["ln1_b"])
     h_full = lax.all_gather(h, "tp", axis=1, tiled=True)  # [B, S, D]
     S = h_full.shape[1]
-    qkv = h_full @ lp["wqkv"].astype(cdt)  # [B, S, 3*D/tp]
-    q, k_, v = jnp.split(qkv, 3, axis=-1)
+    # wqkv is [D, 3, D] with the FINAL head dim tp-sharded: a plain
+    # [D, 3D] column shard would hand each device a contiguous block that
+    # mixes q/k/v columns, silently pairing mismatched q/k head slices
+    # across tp (caught by test_spmd_transformer_grad_parity).
+    qkv = jnp.einsum("bsd,dke->bske", h_full,
+                     lp["wqkv"].astype(cdt))  # [B, S, 3, D/tp]
+    q, k_, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
 
     def to_heads(t):
         return t.reshape(B, S, heads_local, dh).transpose(0, 2, 1, 3)
@@ -259,9 +264,11 @@ def _loss_fn(cfg, params, y_seq, labels):
     return jnp.sum(nll) / (labels.shape[0] * labels.shape[1])
 
 
-def make_train_step(cfg, mesh):
+def make_train_step(cfg, mesh, with_grads=False):
     """Returns jitted step: (params, opt_state, tokens, labels, step)
-    -> (params, opt_state, loss). tokens/labels: [n_micro, B_global, S]."""
+    -> (params, opt_state, loss) — or (params, opt_state, loss, grads)
+    when with_grads (used by the grad-parity tests).
+    tokens/labels: [n_micro, B_global, S]."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -314,15 +321,20 @@ def make_train_step(cfg, mesh):
             (state, loss_acc), _ = lax.scan(
                 pipe_body, (state0, jnp.float32(0.0)),
                 jnp.arange(n_micro + n_stages - 1))
-            # average over microbatches; sum partial token-means over tp;
-            # broadcast from last stage to all via psum over pp
-            loss = loss_acc / n_micro
-            loss = lax.psum(loss, "tp")
-            loss = lax.psum(loss, "pp")  # only last stage nonzero
-            loss = lax.pmean(loss, "dp")
-            return loss
+            # LOCAL loss only — no psum inside the differentiated
+            # function: psum's transpose is psum, so a replicating
+            # collective here would multiply every cotangent by the
+            # group size (grads inflated by tp*pp; masked by Adam's
+            # scale invariance but wrong, e.g. for SGD or weight decay).
+            # 1/dp scaling makes the cross-device sum a dp-mean so the
+            # replicated-axis grad psum below yields the batch mean.
+            return loss_acc / (n_micro * cfg.dp)
 
-        loss, grads = jax.value_and_grad(fwd_loss)(params)
+        loss_local, grads = jax.value_and_grad(fwd_loss)(params)
+        # value for reporting: sum the partial token-means over tp, take
+        # the last pp stage's value, and average over dp (the 1/dp is
+        # already inside fwd_loss)
+        loss = lax.psum(loss_local, ("tp", "pp", "dp"))
         # reduce each grad leaf over the axes its param is replicated on
         grads = jax.tree.map(
             lambda g, s: lax.psum(g, _replicated_axes(s))
@@ -349,19 +361,22 @@ def make_train_step(cfg, mesh):
                              is_leaf=lambda x: isinstance(x, tuple))
         new_v = jax.tree.map(lambda o: o[2], out,
                              is_leaf=lambda x: isinstance(x, tuple))
-        return new_p, new_m, new_v, loss
+        return new_p, new_m, new_v, loss, grads
 
     data_spec = P(None, "dp", None)
     smapped = jax.shard_map(
         device_step, mesh=mesh,
         in_specs=(specs, specs, specs, data_spec, data_spec, P()),
-        out_specs=(specs, specs, specs, P()),
+        out_specs=(specs, specs, specs, P(), specs),
         check_vma=False)
 
     @jax.jit
     def train_step(params, opt_state, tokens, labels, step):
         m, v = opt_state
-        p2, m2, v2, loss = smapped(params, m, v, tokens, labels, step)
+        p2, m2, v2, loss, grads = smapped(params, m, v, tokens, labels,
+                                          step)
+        if with_grads:
+            return p2, (m2, v2), loss, grads
         return p2, (m2, v2), loss
 
     return train_step
