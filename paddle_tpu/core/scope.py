@@ -10,10 +10,14 @@ from typing import Dict, Optional
 
 
 class Scope:
+    _uid_counter = 0
+
     def __init__(self, parent: Optional["Scope"] = None):
         self._vars: Dict[str, object] = {}
         self._parent = parent
         self._kids = []
+        Scope._uid_counter += 1
+        self._uid = Scope._uid_counter  # never-reused compile-cache id
 
     def var(self, name: str):
         """Find-or-declare (reference: Scope::Var)."""

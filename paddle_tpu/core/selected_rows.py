@@ -1,0 +1,100 @@
+"""SelectedRows: sparse row-set gradient container.
+
+Reference parity: `paddle/fluid/framework/selected_rows.h` — the (rows,
+value, height) triple produced by embedding backward and consumed by the
+optimizers' sparse kernels (`operators/optimizers/adam_op.h` sparse path,
+`sgd_op.h` SelectedRows branch).
+
+TPU-native placement: INSIDE a jitted XLA computation, dense scatter-add
+fused by XLA is the optimal embedding-gradient form (MXU/HBM work is the
+same and there is no host round-trip), so the static lowering keeps dense
+grads. SelectedRows exists for the tiers where sparsity pays on HOSTS:
+the eager (dygraph) engine (is_sparse=True embeddings avoid densifying a
+vocab-sized grad per microstep) and the parameter-server tier (push only
+the touched rows over DCN, `distributed/ps.py` sparse_grad_sgd)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class SelectedRows:
+    __slots__ = ("rows", "values", "height")
+
+    def __init__(self, rows, values, height: int):
+        self.rows = rows          # int array [k]
+        self.values = values      # [k, ...] row payloads
+        self.height = int(height)  # dense dim-0 extent
+
+    # -- framework duck-typing --------------------------------------------
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def __repr__(self):
+        return "SelectedRows(rows=%d, height=%d, dim=%s)" % (
+            len(np.asarray(self.rows)), self.height,
+            tuple(self.values.shape[1:]))
+
+    # -- algebra -----------------------------------------------------------
+    def merge(self) -> "SelectedRows":
+        """Deduplicate rows via segment-sum (reference:
+        operators/math/selected_rows_functor.cc MergeAdd)."""
+        import jax
+        import jax.numpy as jnp
+
+        rows = jnp.asarray(self.rows)
+        uniq, inv = jnp.unique(rows, return_inverse=True,
+                               size=rows.shape[0], fill_value=-1)
+        summed = jax.ops.segment_sum(jnp.asarray(self.values),
+                                     inv.reshape(-1),
+                                     num_segments=rows.shape[0])
+        keep = uniq >= 0
+        # keep static shapes: invalid slots get row -1 with zero values
+        summed = jnp.where(keep.reshape((-1,) + (1,) *
+                                        (summed.ndim - 1)), summed, 0)
+        return SelectedRows(uniq, summed, self.height)
+
+    def to_dense(self):
+        import jax.numpy as jnp
+
+        dense = jnp.zeros((self.height,) + tuple(self.values.shape[1:]),
+                          jnp.asarray(self.values).dtype)
+        rows = jnp.asarray(self.rows)
+        valid = rows >= 0
+        safe_rows = jnp.where(valid, rows, 0)
+        vals = jnp.where(valid.reshape((-1,) + (1,) *
+                                       (self.values.ndim - 1)),
+                         jnp.asarray(self.values), 0)
+        return dense.at[safe_rows].add(vals)
+
+    def __add__(self, other):
+        import jax.numpy as jnp
+
+        if isinstance(other, SelectedRows):
+            assert other.height == self.height, (other.height, self.height)
+            return SelectedRows(
+                jnp.concatenate([jnp.asarray(self.rows),
+                                 jnp.asarray(other.rows)]),
+                jnp.concatenate([jnp.asarray(self.values),
+                                 jnp.asarray(other.values)]),
+                self.height)
+        if other is None or (np.isscalar(other) and other == 0):
+            return self
+        return self.to_dense() + other
+
+    __radd__ = __add__
+
+
+def sr_add(a, b):
+    """acc-aware add where either side may be SelectedRows or dense."""
+    if isinstance(a, SelectedRows) or isinstance(b, SelectedRows):
+        if isinstance(a, SelectedRows):
+            return a + b
+        return b + a
+    return a + b

@@ -1,0 +1,305 @@
+"""Parameter-server runtime: Communicator (trainer side) and
+listen_and_serv (server side).
+
+Reference parity:
+- `operators/distributed/communicator.h:176-395` — Async/HalfAsync/Sync/
+  GeoSgd Communicator background send/recv machinery on the trainer;
+- `operators/distributed_ops/listen_and_serv_op.cc:336` — the pserver
+  main loop (sync loop at :112) executing per-param optimizer blocks;
+- `operators/distributed/parameter_send.cc / parameter_recv.cc`.
+
+TPU-native shape: the accelerator runs fwd+bwd as one jitted computation
+that also yields the param grads; the Communicator then pushes grads /
+pulls params over the host TCP RPC (distributed/rpc.py). The pserver
+applies updates by executing the transpiled update program through the
+normal fluid Executor (REAL optimizer ops, not a re-implementation), with
+sync mode aggregating all trainers' grads behind a barrier whose action
+runs the update exactly once per global step.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .rpc import RpcClient, RpcServer, _Stop
+
+
+class PSCommunicator:
+    """Trainer-side push/pull around each executor step."""
+
+    def __init__(self, ps_cfg):
+        self.cfg = ps_cfg
+        self.mode = ps_cfg["mode"]
+        self.tid = int(ps_cfg["trainer_id"])
+        self._clients: Dict[str, RpcClient] = {}
+        self._geo_step = 0
+        self._geo_snapshots: Dict[str, np.ndarray] = {}
+
+    def _client(self, ep) -> RpcClient:
+        if ep not in self._clients:
+            self._clients[ep] = RpcClient(ep)
+        return self._clients[ep]
+
+    def init_params(self, scope):
+        """Seed the pserver tables with this trainer's initial params
+        (first write wins server-side). Replaces the reference's
+        trainer->pserver initial broadcast so both tiers start from the
+        SAME values regardless of each process's RNG stream."""
+        targets = dict(self.cfg["param_endpoint"])
+        for w, meta in self.cfg.get("sparse_tables", {}).items():
+            targets[w] = meta["endpoint"]
+        for pname, ep in targets.items():
+            val = scope.find_var(pname)
+            if val is not None:
+                self._client(ep).call("init_param", pname,
+                                      np.asarray(val))
+                if self.mode == "geo":
+                    # geo deltas are measured from the seed values; a
+                    # lazy first snapshot at push time would make the
+                    # first delta zero and then overwrite local progress
+                    # with the server's seed
+                    self._geo_snapshots[pname] = np.asarray(val).copy()
+
+    # -- distributed_lookup_table prefetch (reference:
+    # distributed/parameter_prefetch.cc) --------------------------------
+    def prefetch(self, feed_arrays, scope):
+        """Before the jitted step: fetch this batch's unique embedding
+        rows from the pserver into the fixed-size @PREFETCH feed and the
+        host-remapped ids into @REMAP."""
+        self._last_uniq = {}
+        for wname, meta in self.cfg.get("sparse_tables", {}).items():
+            ids = np.asarray(feed_arrays[meta["ids_feed"]])
+            flat = ids.reshape(-1).astype(np.int64)
+            uniq, inverse = np.unique(flat, return_inverse=True)
+            n = int(flat.size)
+            uniq_p = np.zeros((n,), np.int64)
+            uniq_p[:len(uniq)] = uniq
+            (rows,) = self._client(meta["endpoint"]).call(
+                "lookup_rows", wname, uniq_p)
+            feed_arrays[meta["prefetch"]] = np.asarray(rows)
+            feed_arrays[meta["remap"]] = inverse.reshape(
+                ids.shape).astype(np.int64)
+            self._last_uniq[wname] = uniq_p
+
+    def push_sparse(self, sparse_grads):
+        """Push SelectedRows-shaped (rows, values) grads of the
+        prefetched rows back to the hosting pserver."""
+        for wname, gvals in sparse_grads.items():
+            meta = self.cfg["sparse_tables"][wname]
+            rows = self._last_uniq[wname]
+            self._client(meta["endpoint"]).call(
+                "sparse_push", wname, rows,
+                np.asarray(gvals, dtype=np.float32), self.tid)
+
+    # -- dense sync/async --------------------------------------------------
+    def step(self, grads: Dict[str, np.ndarray], scope):
+        """grads: param name -> grad value for this step."""
+        pe = self.cfg["param_endpoint"]
+        if self.mode in ("sync", "async"):
+            for pname, g in grads.items():
+                self._client(pe[pname]).call(
+                    "send_grad", pname, np.asarray(g), self.tid)
+            if self.mode == "sync":
+                eps = sorted(set(pe.values()))
+                # barrier releases once every trainer reported; its action
+                # applies the aggregated update exactly once
+                for ep in eps:
+                    self._client(ep).call("send_barrier", self.tid)
+            for pname in pe:
+                (val,) = self._client(pe[pname]).call("get_param", pname)
+                scope.set_var(pname, val)
+        elif self.mode == "geo":
+            self._geo_step += 1
+            if self._geo_step % max(self.cfg["geo_push_every"], 1):
+                return
+            for pname in pe:
+                cur = np.asarray(scope.find_var(pname))
+                snap = self._geo_snapshots.get(pname)
+                if snap is None:  # init_params not called (no local var)
+                    self._geo_snapshots[pname] = cur.copy()
+                    continue
+                delta = cur - snap
+                (merged,) = self._client(pe[pname]).call(
+                    "geo_delta", pname, delta.astype(np.float32))
+                scope.set_var(pname, merged)
+                self._geo_snapshots[pname] = np.asarray(merged).copy()
+
+    def complete(self):
+        for ep in sorted(set(self.cfg["param_endpoint"].values())):
+            try:
+                self._client(ep).call("complete", self.tid)
+            except Exception:  # noqa: BLE001 - server may already be down
+                pass
+        for c in self._clients.values():
+            c.close()
+
+
+class ParameterServer:
+    """listen_and_serv state: tables + aggregation + update execution."""
+
+    def __init__(self, pserver_prog, startup_prog, trainers, mode):
+        from ..core.scope import Scope
+        from ..fluid.executor import Executor
+        from ..fluid.framework import CPUPlace
+
+        self.prog = pserver_prog
+        self.mode = mode
+        self.trainers = int(trainers)
+        self.scope = Scope()
+        self.exe = Executor(CPUPlace())
+        if startup_prog is not None and startup_prog.global_block().ops:
+            self.exe.run(startup_prog, scope=self.scope)
+        self.grad_of = dict(getattr(pserver_prog, "_ps_grad_of", {}))
+        self.hosted = list(getattr(pserver_prog, "_ps_hosted_params", []))
+        self._pending: Dict[str, Dict[int, np.ndarray]] = {}
+        self._pending_sparse: Dict[str, Dict[int, tuple]] = {}
+        self._sparse_lr = dict(getattr(pserver_prog, "_ps_sparse", {}))
+        self._inited: set = set()
+        self._lock = threading.Lock()
+        # per-param update programs (reference: listen_and_serv per-param
+        # optimize sub-blocks) — async mode applies one grad at a time
+        from ..fluid import framework as fw
+
+        self._per_param_prog: Dict[str, object] = {}
+        src_blk = pserver_prog.global_block()
+        for op in src_blk.ops:
+            if "Param" not in op.input_names or not op.input_names["Param"]:
+                continue
+            pname = op.input_names["Param"][0]
+            prog = fw.Program()
+            blk = prog.global_block()
+            for n in sorted(set(op.input_arg_names)
+                            | set(op.output_arg_names)):
+                v = src_blk._find_var_recursive(n)
+                if v is not None:
+                    blk.create_var(name=n, shape=v.shape, dtype=v.dtype,
+                                   persistable=v.persistable,
+                                   stop_gradient=True)
+            blk.append_op(
+                type=op.type,
+                inputs={s: list(ns) for s, ns in op.input_names.items()},
+                outputs={s: list(ns) for s, ns in op.output_names.items()},
+                attrs=dict(op.attrs))
+            self._per_param_prog[pname] = prog
+        self._completed: set = set()
+        self._barrier = threading.Barrier(self.trainers,
+                                          action=self._apply_sync)
+
+    # sync: barrier action runs in exactly one thread
+    def _apply_sync(self):
+        with self._lock:
+            feed = {}
+            for gname, pname in self.grad_of.items():
+                per_t = self._pending.pop(pname, {})
+                if not per_t:
+                    continue
+                agg = np.sum(list(per_t.values()), axis=0) / self.trainers
+                feed[gname] = agg
+            if feed:
+                self.exe.run(self.prog, feed=feed, fetch_list=[],
+                             scope=self.scope)
+            for pname, per_t in list(self._pending_sparse.items()):
+                if not per_t:
+                    continue
+                self._pending_sparse[pname] = {}
+                self._apply_sparse(
+                    pname,
+                    np.concatenate([rv[0] for rv in per_t.values()]),
+                    np.concatenate([rv[1] for rv in per_t.values()])
+                    / self.trainers)
+
+    def _apply_sparse(self, pname, rows, values):
+        # sparse SGD row update (reference: sgd_op.h SelectedRows branch)
+        lr = float(self._sparse_lr.get(pname, 1.0))
+        table = np.asarray(self.scope.find_var(pname)).copy()
+        np.subtract.at(table, rows, lr * values.astype(table.dtype))
+        self.scope.set_var(pname, table)
+
+    def _apply_one(self, pname, grad):
+        gname = next(g for g, p in self.grad_of.items() if p == pname)
+        self.exe.run(self._per_param_prog[pname], feed={gname: grad},
+                     fetch_list=[], scope=self.scope)
+
+    def handle(self, method, args):
+        if method == "init_param":
+            pname, val = args[0], args[1]
+            with self._lock:
+                if pname not in self._inited:
+                    self.scope.set_var(pname, val)
+                    self._inited.add(pname)
+            return []
+        if method == "send_grad":
+            pname, grad, tid = args[0], args[1], int(args[2])
+            if self.mode == "async":
+                with self._lock:
+                    self._apply_one(pname, grad)
+            else:
+                with self._lock:
+                    self._pending.setdefault(pname, {})[tid] = grad
+            return []
+        if method == "send_barrier":
+            self._barrier.wait()
+            return []
+        if method == "get_param":
+            with self._lock:
+                return [np.asarray(self.scope.find_var(args[0]))]
+        if method == "lookup_rows":
+            pname, rows = args[0], np.asarray(args[1]).astype(np.int64)
+            with self._lock:
+                table = np.asarray(self.scope.find_var(pname))
+            return [table[rows]]
+        if method == "sparse_push":
+            pname, rows, values, tid = (args[0],
+                                        np.asarray(args[1]),
+                                        np.asarray(args[2]),
+                                        int(args[3]))
+            if self.mode == "async":
+                with self._lock:
+                    self._apply_sparse(pname, rows, values)
+            else:
+                with self._lock:
+                    self._pending_sparse.setdefault(pname, {})[tid] = (
+                        rows, values)
+            return []
+        if method == "sparse_grad_sgd":
+            # direct sparse SGD row update (reference: sgd_op.h sparse
+            # SelectedRows path; avoids densifying the whole table)
+            pname, rows, values, lr = (args[0],
+                                       np.asarray(args[1]).astype(np.int64),
+                                       np.asarray(args[2]), float(args[3]))
+            with self._lock:
+                table = np.asarray(self.scope.find_var(pname)).copy()
+                np.subtract.at(table, rows, lr * values)
+                self.scope.set_var(pname, table)
+            return []
+        if method == "geo_delta":
+            pname, delta = args[0], args[1]
+            with self._lock:
+                table = np.asarray(self.scope.find_var(pname)) + delta
+                self.scope.set_var(pname, table)
+                return [table]
+        if method == "complete":
+            self._completed.add(int(args[0]))
+            if len(self._completed) >= self.trainers:
+                raise _Stop()
+            return []
+        raise ValueError("unknown rpc method %r" % method)
+
+
+def listen_and_serv(pserver_prog, pserver_startup=None,
+                    endpoint="127.0.0.1:0", trainers=1, mode="sync"):
+    """Run the pserver loop until every trainer calls complete().
+    Returns after serving (reference: listen_and_serv_op.cc:336)."""
+    host, port = endpoint.rsplit(":", 1)
+    server_state = ParameterServer(pserver_prog, pserver_startup,
+                                   trainers, mode)
+    srv = RpcServer(host, int(port), server_state.handle)
+    srv.start()
+    try:
+        server_state.served_port = srv.port
+        srv.wait_stopped()
+    finally:
+        srv.shutdown()
+    return server_state

@@ -1,0 +1,214 @@
+"""Minimal host RPC for the parameter-server tier.
+
+Reference parity: `paddle/fluid/operators/distributed/` gRPC/BRPC client+
+server with `send_recv.proto.in` variable serialization (SURVEY.md §2.1
+"Parameter-server RPC"). TPU-native scope: the PS tier is host-side CPU
+machinery (the dense/sparse tables never touch the accelerator), so a
+length-prefixed binary protocol over TCP sockets replaces the gRPC stack;
+tensors travel as raw ndarray bytes with a tiny header — no pickle, no
+third-party deps.
+
+Wire format per message (little-endian):
+  [u32 total_len][u8 n_fields] then per field:
+  [u8 kind][u32 len][payload]
+    kind 0: utf-8 string
+    kind 1: ndarray — payload is [u8 dtype_len][dtype str][u8 ndim]
+            [u64 x ndim shape][raw bytes]
+    kind 2: int64
+A request is (method:str, *fields); the response is a plain field list
+(first field "ok" or "err:<msg>").
+"""
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+from typing import List, Tuple
+
+import numpy as np
+
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+
+
+def _enc_field(buf: bytearray, v):
+    if isinstance(v, str):
+        b = v.encode("utf-8")
+        buf.append(0)
+        buf += _U32.pack(len(b))
+        buf += b
+    elif isinstance(v, (int, np.integer)):
+        buf.append(2)
+        buf += _U32.pack(8)
+        buf += struct.pack("<q", int(v))
+    else:
+        a = np.ascontiguousarray(v)
+        dt = a.dtype.str.encode()
+        payload = bytearray()
+        payload.append(len(dt))
+        payload += dt
+        payload.append(a.ndim)
+        for d in a.shape:
+            payload += _U64.pack(d)
+        payload += a.tobytes()
+        buf.append(1)
+        buf += _U32.pack(len(payload))
+        buf += payload
+
+
+def encode(fields) -> bytes:
+    body = bytearray()
+    body.append(len(fields))
+    for f in fields:
+        _enc_field(body, f)
+    return _U32.pack(len(body)) + bytes(body)
+
+
+def _dec_field(mv, off):
+    kind = mv[off]
+    off += 1
+    (ln,) = _U32.unpack_from(mv, off)
+    off += 4
+    payload = mv[off:off + ln]
+    off += ln
+    if kind == 0:
+        return bytes(payload).decode("utf-8"), off
+    if kind == 2:
+        return struct.unpack("<q", payload)[0], off
+    p = 0
+    dt_len = payload[p]
+    p += 1
+    dtype = np.dtype(bytes(payload[p:p + dt_len]).decode())
+    p += dt_len
+    ndim = payload[p]
+    p += 1
+    shape = []
+    for _ in range(ndim):
+        (d,) = _U64.unpack_from(payload, p)
+        shape.append(d)
+        p += 8
+    arr = np.frombuffer(payload, dtype=dtype, offset=p,
+                        count=int(np.prod(shape)) if shape else 1)
+    if not shape:
+        arr = arr.reshape(())
+    else:
+        arr = arr.reshape(shape)
+    return arr.copy(), off
+
+
+def decode(body: bytes) -> List:
+    mv = memoryview(body)
+    n = mv[0]
+    off = 1
+    out = []
+    for _ in range(n):
+        v, off = _dec_field(mv, off)
+        out.append(v)
+    return out
+
+
+def _read_exact(sock, n):
+    chunks = []
+    while n:
+        c = sock.recv(min(n, 1 << 20))
+        if not c:
+            raise ConnectionError("peer closed")
+        chunks.append(c)
+        n -= len(c)
+    return b"".join(chunks)
+
+
+def read_msg(sock) -> List:
+    (ln,) = _U32.unpack(_read_exact(sock, 4))
+    return decode(_read_exact(sock, ln))
+
+
+def write_msg(sock, fields):
+    sock.sendall(encode(fields))
+
+
+class RpcServer:
+    """Threaded TCP server dispatching (method, *args) -> fields."""
+
+    def __init__(self, host, port, handler):
+        outer = self
+
+        class _H(socketserver.BaseRequestHandler):
+            def handle(self):
+                sock = self.request
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                try:
+                    while True:
+                        fields = read_msg(sock)
+                        method = fields[0]
+                        try:
+                            resp = handler(method, fields[1:])
+                            write_msg(sock, ["ok"] + list(resp or []))
+                        except _Stop:
+                            write_msg(sock, ["ok"])
+                            outer._stop_evt.set()
+                            return
+                        except Exception as e:  # noqa: BLE001
+                            write_msg(sock, ["err:%s" % e])
+                except (ConnectionError, OSError):
+                    return
+
+        class _Srv(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Srv((host, port), _H)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._stop_evt = threading.Event()
+
+    def start(self):
+        self._thread.start()
+
+    def wait_stopped(self, timeout=None):
+        self._stop_evt.wait(timeout)
+
+    def shutdown(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class _Stop(Exception):
+    """Raised by a handler to acknowledge then stop the server."""
+
+
+class RpcClient:
+    def __init__(self, endpoint: str, timeout=60.0, retries=60):
+        host, port = endpoint.rsplit(":", 1)
+        last = None
+        for _ in range(retries):
+            try:
+                self._sock = socket.create_connection(
+                    (host, int(port)), timeout=timeout)
+                break
+            except OSError as e:
+                last = e
+                import time
+
+                time.sleep(0.25)
+        else:
+            raise ConnectionError("cannot reach pserver %s: %s"
+                                  % (endpoint, last))
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+
+    def call(self, method: str, *args) -> List:
+        with self._lock:
+            write_msg(self._sock, [method] + list(args))
+            resp = read_msg(self._sock)
+        if isinstance(resp[0], str) and resp[0].startswith("err:"):
+            raise RuntimeError("rpc %s failed: %s" % (method, resp[0][4:]))
+        return resp[1:]
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass

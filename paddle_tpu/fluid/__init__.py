@@ -57,3 +57,9 @@ def data(name, shape, dtype="float32", lod_level=0):
 # flags system (reference: platform/flags.cc surfaced via
 # global_value_getter_setter.cc)
 from ..utils.flags import get_flags, set_flags  # noqa: F401,E402
+
+# parameter-server transpiler (reference: fluid.DistributeTranspiler)
+from . import transpiler  # noqa: F401,E402
+from .transpiler import (  # noqa: F401,E402
+    DistributeTranspiler, DistributeTranspilerConfig,
+)

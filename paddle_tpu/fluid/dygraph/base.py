@@ -20,6 +20,7 @@ import numpy as np
 
 from .. import framework
 from ...core.types import normalize_dtype, to_numpy_dtype
+from ...core.selected_rows import SelectedRows, sr_add
 from ... import ops as ops_lib
 
 
@@ -141,11 +142,17 @@ class Tensor:
             _tracer().tape.clear()
 
     def gradient(self):
-        return None if self._grad is None else np.asarray(self._grad)
+        if self._grad is None:
+            return None
+        if isinstance(self._grad, SelectedRows):
+            return np.asarray(self._grad.to_dense())
+        return np.asarray(self._grad)
 
     def _grad_tensor(self):
         if self._grad is None:
             return None
+        if isinstance(self._grad, SelectedRows):
+            return self._grad  # duck-typed; optimizers take sparse path
         return Tensor(self._grad, stop_gradient=True)
 
     def clear_gradient(self):
@@ -316,9 +323,44 @@ def trace_op(op_type, ins: Dict[str, list], attrs, out_slots):
         in_layout = tuple((slot, len(ts))
                           for slot, ts in sorted(ins_clean.items()))
         in_flat = [t for _, ts in sorted(ins_clean.items()) for t in ts]
+        custom_vjp = None
+        if attrs.get("is_sparse") and op_type in ("lookup_table",
+                                                  "lookup_table_v2"):
+            custom_vjp = _sparse_lookup_vjp(ins_clean, in_flat, attrs)
         tracer.record(TapeEntry(op_type, dict(attrs), in_layout, in_flat,
-                                tuple(slot_counts), flat_out, rng_key))
+                                tuple(slot_counts), flat_out, rng_key,
+                                custom_vjp=custom_vjp))
     return flat_out
+
+
+def _sparse_lookup_vjp(ins_clean, in_flat, attrs):
+    """is_sparse embedding backward: the weight grad is a SelectedRows
+    (rows=ids, values=output cotangent rows) instead of a dense
+    vocab-sized scatter-add (reference: lookup_table_grad sparse path,
+    `operators/lookup_table_op.h` + `framework/selected_rows.h`)."""
+    ids_t = ins_clean["Ids"][0]
+    w_t = ins_clean["W"][0]
+    padding_idx = attrs.get("padding_idx", -1)
+
+    def vjp(cotangents):
+        import jax.numpy as jnp
+
+        from ...core.selected_rows import SelectedRows
+
+        ct = cotangents[0]
+        dim = w_t._val.shape[-1]
+        rows = jnp.reshape(ids_t._val, (-1,)).astype(jnp.int64)
+        values = jnp.reshape(ct, (-1, dim)).astype(w_t._val.dtype)
+        if padding_idx is not None and padding_idx >= 0:
+            mask = rows != padding_idx
+            values = jnp.where(mask[:, None], values, 0)
+        sr = SelectedRows(rows, values, w_t._val.shape[0])
+        grads = []
+        for t in in_flat:
+            grads.append(sr if t is w_t else None)
+        return grads
+
+    return vjp
 
 
 # ---------------------------------------------------------------------------
@@ -393,24 +435,25 @@ class BackwardEngine:
                 in_grads = fn([t._val for t in entry.in_tensors], key,
                               cotangents)
             for t, g in zip(entry.in_tensors, in_grads):
-                if t.stop_gradient:
+                if t.stop_gradient or g is None:
                     continue
                 if not jnp.issubdtype(t._val.dtype, jnp.inexact):
                     continue
                 if hasattr(g, "dtype") and str(g.dtype) == "float0":
                     continue
                 acc = grads.get(id(t))
-                grads[id(t)] = g if acc is None else acc + g
+                grads[id(t)] = g if acc is None else sr_add(acc, g)
                 tensors[id(t)] = t
 
         # publish: accumulate into persistent .grad (reference:
         # GradientAccumulator semantics — grads sum across backward calls
-        # until clear_gradient)
+        # until clear_gradient; SelectedRows grads concatenate rows,
+        # imperative/gradient_accumulator.cc sparse branch)
         for tid, g in grads.items():
             t = tensors.get(tid)
             if t is None:
                 continue
-            t._grad = g if t._grad is None else t._grad + g
+            t._grad = g if t._grad is None else sr_add(t._grad, g)
 
 
 # ---------------------------------------------------------------------------

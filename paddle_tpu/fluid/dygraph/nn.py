@@ -182,13 +182,18 @@ class Embedding(Layer):
         self._padding_idx = (-1 if padding_idx is None else
                              padding_idx if padding_idx >= 0
                              else size[0] + padding_idx)
+        # is_sparse: backward yields a SelectedRows (rows, values) grad
+        # instead of a dense vocab-sized scatter-add (reference:
+        # lookup_table_op.h sparse path; core/selected_rows.py)
+        self._is_sparse = bool(is_sparse)
         self.weight = self.create_parameter(
             shape=list(size), attr=param_attr, dtype=dtype)
 
     def forward(self, input):
         return trace_op("lookup_table_v2",
                         {"W": [self.weight], "Ids": [input]},
-                        {"padding_idx": self._padding_idx}, ["Out"])[0]
+                        {"padding_idx": self._padding_idx,
+                         "is_sparse": self._is_sparse}, ["Out"])[0]
 
 
 class Dropout(Layer):

@@ -22,9 +22,14 @@ from ..core.types import to_numpy_dtype
 
 class Executor:
     def __init__(self, place=None):
+        from collections import OrderedDict
+
         self.place = place if place is not None else \
             framework._current_expected_place()
-        self._cache = {}
+        # LRU of compiled executables, bounded by
+        # FLAGS_tpu_compile_cache_size (dead programs no longer pin
+        # compiled artifacts forever)
+        self._cache = OrderedDict()
 
     # -- public API --------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
@@ -44,11 +49,31 @@ class Executor:
             f.name if isinstance(f, framework.Variable) else str(f)
             for f in fetch_list]
 
+        # PS mode: the communicator needs this step's grads — extend the
+        # fetch list internally (reference: send ops read the grad vars)
+        ps_cfg = getattr(program, "_ps_cfg", None)
+        n_user_fetches = len(fetch_names)
+        if ps_cfg is not None and ps_cfg["mode"] in ("sync", "async"):
+            fetch_names = fetch_names + [
+                g for g in sorted(ps_cfg["grad_of"])
+                if g not in fetch_names]
+            fetch_names = fetch_names + [
+                m["grad"] for m in ps_cfg.get("sparse_tables",
+                                              {}).values()
+                if m["grad"] not in fetch_names]
+
         block = program.global_block()
         feed_arrays = self._prepare_feed(block, feed)
+        if ps_cfg is not None and ps_cfg.get("sparse_tables"):
+            # distributed_lookup_table: fetch this batch's unique rows
+            # into the @PREFETCH/@REMAP feeds before compiling/running
+            comm = self._ps_communicator(program, ps_cfg, scope)
+            comm.prefetch(feed_arrays, scope)
 
         key = self._cache_key(program, feed_arrays, fetch_names, scope)
         entry = self._cache.get(key) if use_program_cache else None
+        if entry is not None:
+            self._cache.move_to_end(key)
         if entry is None:
             state_in, _ = lowering.analyze_block(
                 block, list(feed_arrays), fetch_names)
@@ -59,8 +84,27 @@ class Executor:
                     state_specs[n] = v
             entry = lowering.compile_block(
                 program, block, feed_arrays, fetch_names, state_specs)
+            from ..utils.flags import get_flag
+
+            if get_flag("FLAGS_enable_unused_var_check"):
+                # reference: framework/unused_var_check.cc (op inputs
+                # declared but never read); block-level equivalent here
+                import warnings
+
+                used = set()
+                for op in block.ops:
+                    used.update(lowering._op_reads_writes(op)[0])
+                unused = [n for n in feed_arrays if n not in used]
+                if unused:
+                    warnings.warn(
+                        "feed variables never read by the program: %s"
+                        % unused)
             if use_program_cache:
                 self._cache[key] = entry
+                limit = int(get_flag("FLAGS_tpu_compile_cache_size", 128)
+                            or 128)
+                while len(self._cache) > limit:
+                    self._cache.popitem(last=False)
 
         states_mut = {n: scope.find_var(n) for n in entry.state_mut_names}
         states_ro = {n: scope.find_var(n) for n in entry.state_ro_names}
@@ -71,9 +115,64 @@ class Executor:
                                            np.uint32(seed % (2**31)))
         for n, v in new_states.items():
             scope.set_var(n, v)
+
+        from ..utils.flags import get_flag
+
+        if get_flag("FLAGS_check_nan_inf"):
+            self._check_nan_inf(fetch_names, fetches, new_states)
+        if get_flag("FLAGS_benchmark"):
+            # per-step device sync (reference: operator.cc:997)
+            import jax
+
+            jax.block_until_ready(fetches)
+
+        if ps_cfg is not None:
+            comm = self._ps_communicator(program, ps_cfg, scope)
+            if ps_cfg["mode"] in ("sync", "async"):
+                sparse_gvals = {
+                    w: np.asarray(fetches[fetch_names.index(m["grad"])])
+                    for w, m in ps_cfg.get("sparse_tables", {}).items()}
+                if sparse_gvals:
+                    comm.push_sparse(sparse_gvals)
+                gvals = {}
+                for g, p in ps_cfg["grad_of"].items():
+                    gvals[p] = np.asarray(fetches[fetch_names.index(g)])
+                comm.step(gvals, scope)
+            else:
+                comm.step({}, scope)
+            fetches = fetches[:n_user_fetches]
         if return_numpy:
             return [np.asarray(v) for v in fetches]
         return list(fetches)
+
+    def _ps_communicator(self, program, ps_cfg, scope=None):
+        if not hasattr(self, "_ps_comms"):
+            self._ps_comms = {}
+        key = program._uid
+        if key not in self._ps_comms:
+            from ..distributed.ps import PSCommunicator
+
+            comm = PSCommunicator(ps_cfg)
+            if scope is not None:
+                comm.init_params(scope)
+            self._ps_comms[key] = comm
+        return self._ps_comms[key]
+
+    def _check_nan_inf(self, fetch_names, fetches, new_states):
+        """FLAGS_check_nan_inf (reference: operator.cc:1020
+        CheckOpHasNanOrInf + details/nan_inf_utils_detail.cc): host-side
+        scan of every fetch and updated state var, error names the var."""
+        bad = []
+        for n, v in list(zip(fetch_names, fetches)) + \
+                list(new_states.items()):
+            a = np.asarray(v)
+            if np.issubdtype(a.dtype, np.floating) and \
+                    not np.all(np.isfinite(a)):
+                bad.append(n)
+        if bad:
+            raise RuntimeError(
+                "Operator output contains Inf/Nan (FLAGS_check_nan_inf): "
+                "%s" % bad)
 
     # -- helpers -----------------------------------------------------------
     def _prepare_feed(self, block, feed) -> Dict[str, np.ndarray]:
@@ -104,10 +203,16 @@ class Executor:
     def _cache_key(self, program, feed_arrays, fetch_names, scope):
         feed_key = tuple(sorted(
             (n, a.shape, str(a.dtype)) for n, a in feed_arrays.items()))
-        return (id(program), program._version, feed_key, tuple(fetch_names),
-                id(scope))
+        # never-reused uids (not id()) so GC'd programs/scopes cannot
+        # alias a stale compiled executable
+        return (program._uid, program._version, feed_key,
+                tuple(fetch_names), getattr(scope, "_uid", 0))
 
     def close(self):
+        for comm in getattr(self, "_ps_comms", {}).values():
+            comm.complete()
+        if hasattr(self, "_ps_comms"):
+            self._ps_comms.clear()
         self._cache.clear()
 
     # dataset-training entry points (reference: executor.py:1454) are

@@ -76,7 +76,8 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
     pad = -1 if padding_idx is None else (
         padding_idx if padding_idx >= 0 else size[0] + padding_idx)
     return _single("lookup_table", {"W": [w], "Ids": [input]},
-                   {"padding_idx": pad, "is_sparse": is_sparse},
+                   {"padding_idx": pad, "is_sparse": is_sparse,
+                    "is_distributed": is_distributed},
                    dtype=dtype, helper=helper)
 
 

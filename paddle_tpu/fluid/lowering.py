@@ -37,6 +37,9 @@ _SKIP_OPS = frozenset({
     "feed", "fetch", "c_gen_nccl_id", "gen_nccl_id", "c_comm_init",
     "c_comm_init_all", "c_wait_compute", "c_wait_comm", "barrier",
     "print", "nop",
+    # PS-mode markers: the host-side PSCommunicator performs the actual
+    # RPC around each jitted step (distributed/ps.py)
+    "send", "recv", "send_barrier", "fetch_barrier", "checkpoint_notify",
 })
 
 
@@ -303,6 +306,59 @@ def _exec_switch_case(op, env, key0, op_idx, amp_lists):
     env.update(zip(out_names, outs))
 
 
+def _run_gradient_merge(ops, bwd_idx, gm, env, key0, amp_lists):
+    """k-step gradient accumulation (reference: gradient_merge strategy,
+    `framework/ir/multi_batch_merge_pass.cc` / fleet 2.0 GradientMerge
+    meta-optimizer). Each step adds the fresh grads into persistable
+    accumulators; the optimizer section runs under lax.cond only on every
+    k-th step (with the averaged accumulated grads), then the
+    accumulators reset to zero. Off steps leave params/moments untouched."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    k = int(gm["k_steps"])
+    avg = bool(gm.get("avg", True))
+    acc_map = dict(gm["acc_map"])  # grad name -> accumulator name
+    counter_n = gm["counter"]
+    post_ops = ops[bwd_idx + 1:]
+
+    cnt = jnp.reshape(env[counter_n], ()).astype(jnp.int32)
+    new_cnt = cnt + 1
+    do_apply = (new_cnt % k) == 0
+    for g, acc in acc_map.items():
+        env[acc] = env[acc] + env[g].astype(env[acc].dtype)
+
+    # cond-uniform outputs: post-section writes that pre-exist in env
+    # (param/moment/lr updates), plus the accumulators
+    out_names, seen = [], set()
+    for op in post_ops:
+        for n in _op_reads_writes(op)[1]:
+            if n in env and n not in seen:
+                out_names.append(n)
+                seen.add(n)
+    out_names.extend(a for a in acc_map.values() if a not in seen)
+
+    def apply_branch(_):
+        e = dict(env)
+        for g, acc in acc_map.items():
+            merged = e[acc] / k if avg else e[acc]
+            e[g] = merged.astype(e[g].dtype)
+        _run_ops(post_ops, e, key0, base_idx=bwd_idx + 1,
+                 amp_lists=amp_lists)
+        for acc in acc_map.values():
+            e[acc] = jnp.zeros_like(e[acc])
+        return tuple(e[n] for n in out_names)
+
+    def skip_branch(_):
+        return tuple(env[n] for n in out_names)
+
+    outs = lax.cond(do_apply, apply_branch, skip_branch, None)
+    env.update(zip(out_names, outs))
+    env[counter_n] = jnp.reshape(new_cnt % k,
+                                 env[counter_n].shape).astype(
+                                     env[counter_n].dtype)
+
+
 def _split_at_checkpoints(ops, ckpt_names):
     """Segment boundaries for activation recompute: a segment ends right
     after the (last) op that writes each checkpoint variable. Returns a
@@ -425,8 +481,12 @@ def build_block_fn(program, block, feed_names, fetch_names,
             loss_val = env[loss_name]
             env[framework.grad_var_name(loss_name)] = jnp.full(
                 loss_val.shape, loss_scale, loss_val.dtype)
-            _run_ops(ops[bwd_idx + 1:], env, key0, base_idx=bwd_idx + 1,
-                     amp_lists=amp_lists)
+            gm = bop.attrs.get("gradient_merge")
+            if gm is None:
+                _run_ops(ops[bwd_idx + 1:], env, key0,
+                         base_idx=bwd_idx + 1, amp_lists=amp_lists)
+            else:
+                _run_gradient_merge(ops, bwd_idx, gm, env, key0, amp_lists)
 
         fetches = []
         for n in fetch_names:

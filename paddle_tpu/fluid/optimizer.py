@@ -28,6 +28,7 @@ __all__ = [
     "LambOptimizer", "LarsMomentumOptimizer", "DGCMomentumOptimizer",
     "DpsgdOptimizer", "ModelAverage", "ExponentialMovingAverage",
     "RecomputeOptimizer", "LookaheadOptimizer", "PipelineOptimizer",
+    "GradientMergeOptimizer",
     "SGD", "Momentum", "Adam", "Adamax", "Adagrad", "DecayedAdagrad",
     "Adadelta", "RMSProp", "Ftrl", "Lamb", "LarsMomentum", "Dpsgd",
 ]
@@ -208,12 +209,22 @@ class Optimizer:
 
     def _dygraph_step(self, params_grads):
         from .dygraph import base as dy_base
+        from ..core.selected_rows import SelectedRows
 
+        if self._grad_clip is not None or self.regularization is not None:
+            # clip/regularization need dense values; densify sparse grads
+            params_grads = [
+                (p, dy_base.Tensor(g.to_dense(), stop_gradient=True)
+                 if isinstance(g, SelectedRows) else g)
+                for p, g in params_grads]
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
         lr = self.current_step_lr()
         lr_t = dy_base.to_tensor_value(np.asarray([lr], np.float32))
         for p, g in params_grads:
+            if isinstance(g, SelectedRows):
+                self._eager_sparse_update(p, g, lr_t)
+                continue
             if self.regularization is not None:
                 g = self.regularization._eager_apply(p, g)
             self._eager_update(p, g, lr_t)
@@ -221,6 +232,32 @@ class Optimizer:
     def _eager_update(self, param, grad, lr_t):
         raise NotImplementedError(
             "%s: dygraph update not implemented" % type(self).__name__)
+
+    def _eager_sparse_update(self, param, grad_sr, lr_t):
+        """SelectedRows grad: optimizers without a dedicated sparse
+        kernel densify (reference behavior for most ops; SGD/Adam
+        override with true row-wise updates)."""
+        from .dygraph import base as dy_base
+
+        self._eager_update(
+            param, dy_base.Tensor(grad_sr.to_dense(),
+                                  stop_gradient=True), lr_t)
+
+    @staticmethod
+    def _sparse_rows_values(grad_sr, dtype):
+        """Merged (safe_rows, valid_mask, values) for row-wise kernels.
+        Invalid (padding) slots get row index == height, which JAX
+        scatter DROPS (out-of-bounds default) — never aliasing row 0."""
+        import jax.numpy as jnp
+
+        m = grad_sr.merge()
+        rows = jnp.asarray(m.rows)
+        valid = rows >= 0
+        safe = jnp.where(valid, rows, m.height)
+        vals = jnp.where(
+            valid.reshape((-1,) + (1,) * (m.values.ndim - 1)),
+            jnp.asarray(m.values), 0).astype(dtype)
+        return safe, valid, vals
 
     def clear_gradients(self):
         pass
@@ -265,6 +302,16 @@ class SGDOptimizer(Optimizer):
             inputs={"Param": [p], "Grad": [g],
                     "LearningRate": [self._create_param_lr(param_and_grad)]},
             outputs={"ParamOut": [p]})
+
+    def _eager_sparse_update(self, p, grad_sr, lr_t):
+        # reference: sgd_op.h SelectedRows branch — update touched rows
+        # only via scatter-add (segment-summed duplicates)
+        import jax.numpy as jnp
+
+        safe, valid, vals = self._sparse_rows_values(grad_sr,
+                                                     p._val.dtype)
+        lr = jnp.reshape(jnp.asarray(lr_t), ()).astype(p._val.dtype)
+        p._assign_raw(p._val.at[safe].add(-lr * vals))
 
     def _eager_update(self, p, g, lr_t):
         from .dygraph import base as dy_base
@@ -375,6 +422,40 @@ class AdamOptimizer(Optimizer):
     def _op_attrs(self, p):
         return {"beta1": self._beta1, "beta2": self._beta2,
                 "epsilon": self._epsilon}
+
+    def _eager_sparse_update(self, p, grad_sr, lr_t):
+        """Lazy-mode sparse Adam (reference: adam_op.h SparseAdamFunctor
+        with lazy_mode) — moments and params update only on touched rows;
+        beta-pow accumulators advance globally per step."""
+        import jax.numpy as jnp
+
+        m1 = self._add_accumulator("moment1", p)
+        m2 = self._add_accumulator("moment2", p)
+        b1p = self._add_accumulator("beta1_pow_acc", p, shape=[1],
+                                    fill_value=self._beta1)
+        b2p = self._add_accumulator("beta2_pow_acc", p, shape=[1],
+                                    fill_value=self._beta2)
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        safe, valid, vals = self._sparse_rows_values(grad_sr, jnp.float32)
+        lr = jnp.reshape(jnp.asarray(lr_t), ()).astype(jnp.float32)
+
+        m1v, m2v = m1._value(), m2._value()
+        m1_rows = b1 * m1v[safe] + (1 - b1) * vals
+        m2_rows = b2 * m2v[safe] + (1 - b2) * jnp.square(vals)
+        b1pf = jnp.reshape(b1p._value(), ()).astype(jnp.float32)
+        b2pf = jnp.reshape(b2p._value(), ()).astype(jnp.float32)
+        alpha = lr * jnp.sqrt(1 - b2pf * b2) / (1 - b1pf * b1)
+        upd = alpha * m1_rows / (jnp.sqrt(m2_rows) + eps)
+        mask = valid.reshape((-1,) + (1,) * (vals.ndim - 1))
+        pv = p._val
+        p._assign_raw(pv.at[safe].add(
+            jnp.where(mask, -upd, 0).astype(pv.dtype)))
+        m1._assign_raw(m1v.at[safe].set(
+            jnp.where(mask, m1_rows, m1v[safe])))
+        m2._assign_raw(m2v.at[safe].set(
+            jnp.where(mask, m2_rows, m2v[safe])))
+        b1p._assign_raw(b1p._value() * b1)
+        b2p._assign_raw(b2p._value() * b2)
 
     def _eager_update(self, p, g, lr_t):
         from .dygraph import base as dy_base
@@ -731,16 +812,117 @@ class RecomputeOptimizer(Optimizer):
 
 
 class LookaheadOptimizer:
-    """Lookahead wrapper (reference: optimizer.py:4777)."""
+    """Lookahead (reference: optimizer.py:4777): keeps a persistable slow
+    copy of every parameter; every k steps the slow weights interpolate
+    toward the fast weights (slow += alpha*(fast-slow)) and the fast
+    weights snap back to the slow ones. Implemented with a step counter
+    plus one `lookahead_step` op per parameter appended after the inner
+    optimizer's updates."""
 
     def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        assert 0.0 <= alpha <= 1.0, alpha
+        assert k >= 1, k
         self.inner_optimizer = inner_optimizer
         self.alpha = alpha
         self.k = k
 
-    def minimize(self, loss, startup_program=None):
-        return self.inner_optimizer.minimize(
-            loss, startup_program=startup_program)
+    def __getattr__(self, item):
+        return getattr(self.inner_optimizer, item)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        if in_dygraph_mode():
+            raise NotImplementedError(
+                "LookaheadOptimizer is static-graph only (dygraph loss "
+                "has no program to append the slow-weight ops to)")
+        result = self.inner_optimizer.minimize(
+            loss, startup_program=startup_program,
+            parameter_list=parameter_list, no_grad_set=no_grad_set)
+        block = loss.block
+        startup = startup_program or framework.default_startup_program()
+        helper = LayerHelper("lookahead")
+
+        counter = helper.create_global_variable(
+            name=unique_name("lookahead_counter"), shape=[1],
+            dtype="int64", persistable=True)
+        helper.set_variable_initializer(counter, ConstantInitializer(0))
+        block.append_op(type="increment", inputs={"X": [counter]},
+                        outputs={"Out": [counter]}, attrs={"step": 1.0})
+
+        for param, _ in result[1]:
+            slow = helper.create_global_variable(
+                name=unique_name(param.name + "@SLOW"),
+                shape=param.shape, dtype=param.dtype, persistable=True)
+            # slow weights start as a copy of the (initialized) params
+            startup.global_block().create_var(
+                name=slow.name, shape=slow.shape, dtype=slow.dtype,
+                persistable=True)
+            startup.global_block().append_op(
+                type="assign", inputs={"X": [param.name]},
+                outputs={"Out": [slow.name]}, attrs={})
+            block.append_op(
+                type="lookahead_step",
+                inputs={"Param": [param], "SlowParam": [slow],
+                        "Step": [counter]},
+                outputs={"ParamOut": [param], "SlowParamOut": [slow]},
+                attrs={"alpha": float(self.alpha), "k": int(self.k)})
+        return result
+
+
+class GradientMergeOptimizer:
+    """k-step gradient accumulation (reference: gradient_merge strategy,
+    `framework/ir/multi_batch_merge_pass.cc`; fleet 2.0 GradientMerge
+    meta-optimizer). Grads accumulate into persistable buffers and the
+    optimizer section runs only every k-th call (lowering executes it
+    under lax.cond — see lowering._run_gradient_merge)."""
+
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        assert k_steps >= 1, k_steps
+        self.inner_optimizer = inner_optimizer
+        self.k_steps = k_steps
+        self.avg = avg
+
+    def __getattr__(self, item):
+        return getattr(self.inner_optimizer, item)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        if in_dygraph_mode():
+            raise NotImplementedError(
+                "GradientMergeOptimizer is static-graph only; in dygraph "
+                "accumulate grads by calling backward() k times before "
+                "minimize (grads sum until clear_gradients)")
+        result = self.inner_optimizer.minimize(
+            loss, startup_program=startup_program,
+            parameter_list=parameter_list, no_grad_set=no_grad_set)
+        if self.k_steps <= 1:
+            return result
+        block = loss.block
+        bops = [op for op in block.ops if op.type == "backward"]
+        assert bops, "gradient merge requires a backward section"
+        helper = LayerHelper("gradient_merge")
+        acc_map = {}
+        for param, grad in result[1]:
+            acc = helper.create_global_variable(
+                name=unique_name(param.name + "@GRAD@MERGE"),
+                shape=param.shape, dtype="float32", persistable=True)
+            helper.set_variable_initializer(acc, ConstantInitializer(0.0))
+            acc_map[grad.name] = acc.name
+        counter = helper.create_global_variable(
+            name=unique_name("gradient_merge_counter"), shape=[1],
+            dtype="int64", persistable=True)
+        helper.set_variable_initializer(counter, ConstantInitializer(0))
+        bops[0].attrs["gradient_merge"] = {
+            "k_steps": int(self.k_steps), "avg": bool(self.avg),
+            "acc_map": acc_map, "counter": counter.name,
+        }
+        # declare the accumulators/counter on the backward op so the
+        # dataflow analysis (lowering.analyze_block) threads them as
+        # mutable scope state
+        extra = list(acc_map.values()) + [counter.name]
+        bops[0].input_names["GradMergeState"] = extra
+        bops[0].output_names["GradMergeState"] = extra
+        return result
 
 
 class PipelineOptimizer:

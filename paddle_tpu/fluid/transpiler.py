@@ -1,0 +1,306 @@
+"""DistributeTranspiler: parameter-server program rewrite.
+
+Reference parity: `python/paddle/fluid/transpiler/distribute_transpiler.py`
+(:256 class, :545 transpile) — params are assigned across pservers, the
+trainer's optimizer ops move into per-param blocks of a pserver program
+executed by `listen_and_serv` (`operators/distributed_ops/
+listen_and_serv_op.cc:336`), and the trainer pushes grads / pulls params
+through send/recv ops driven by a Communicator
+(`operators/distributed/communicator.h:176-395`).
+
+TPU-native split: the dense fwd/bwd stays ONE jitted XLA computation on
+the accelerator; the PS tier is host machinery — a TCP RPC server
+(distributed/rpc.py) holding the tables, applying the REAL optimizer ops
+by running the transpiled pserver program through the normal fluid
+Executor. send/recv/barrier ops appear in the trainer program for API
+parity but lower to no-ops inside jit; the host-side PSCommunicator
+(distributed/ps.py) performs the actual push/pull around each step.
+
+Modes (reference DistributedMode): sync (barrier-aggregated grads, one
+update per global step), async (grads applied on arrival), geo (trainers
+push param deltas every k local steps).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from . import framework
+from .framework import Operator, Variable, grad_var_name
+
+
+class DistributeTranspilerConfig:
+    """Reference: transpiler/distribute_transpiler.py
+    DistributeTranspilerConfig. slice_var_up is accepted but the TPU build
+    assigns whole vars round-robin (no block slicing — PJRT hosts don't
+    need balanced message sizes the way gRPC did)."""
+
+    def __init__(self):
+        self.slice_var_up = True
+        self.split_method = None
+        self.min_block_size = 8192
+        self.sync_mode = True
+        self.runtime_split_send_recv = False
+        self.geo_sgd_mode = False
+        self.geo_sgd_need_push_nums = 100
+        self.completely_not_async = False
+        self.mode = "pserver"
+        self.print_log = False
+        self.wait_port = True
+
+
+class DistributeTranspiler:
+    def __init__(self, config=None):
+        self.config = config or DistributeTranspilerConfig()
+        self._param_endpoint: Dict[str, str] = {}
+        self._opt_ops_per_param: Dict[str, Operator] = {}
+        self._lr_and_aux_vars: List[str] = []
+        self._origin_program = None
+        self._origin_startup = None
+        self._trainer_id = 0
+        self._trainers = 1
+        self._eplist: List[str] = []
+        self._mode = "sync"
+
+    # -- public API (reference :545) --------------------------------------
+    def transpile(self, trainer_id, program=None, pservers="127.0.0.1:6174",
+                  trainers=1, sync_mode=True, startup_program=None,
+                  current_endpoint="127.0.0.1:6174"):
+        program = program or framework.default_main_program()
+        startup_program = startup_program or \
+            framework.default_startup_program()
+        self._origin_program = program
+        self._origin_startup = startup_program
+        self._trainer_id = int(trainer_id)
+        self._trainers = int(trainers)
+        self._eplist = [e.strip() for e in pservers.split(",") if e.strip()]
+        if self.config.geo_sgd_mode:
+            self._mode = "geo"
+        elif sync_mode:
+            self._mode = "sync"
+        else:
+            self._mode = "async"
+
+        block = program.global_block()
+        bops = [op for op in block.ops if op.type == "backward"]
+        if not bops:
+            raise ValueError("transpile() needs a program with a backward "
+                             "section (run optimizer.minimize first)")
+
+        # optimizer ops: post-backward ops updating a Param input slot
+        bwd_idx = block.ops.index(bops[0])
+        opt_ops = []
+        for op in block.ops[bwd_idx + 1:]:
+            if "Param" in op.input_names and op.input_names["Param"]:
+                opt_ops.append(op)
+
+        # round-robin whole-var placement (reference RoundRobin splitter)
+        for i, op in enumerate(opt_ops):
+            pname = op.input_names["Param"][0]
+            self._param_endpoint[pname] = self._eplist[i % len(self._eplist)]
+            self._opt_ops_per_param[pname] = op
+
+        # aux vars the pserver update needs (lr, accumulators, ...): every
+        # persistable non-param input of the optimizer ops
+        aux = []
+        for op in opt_ops:
+            for slot, names in op.input_names.items():
+                if slot in ("Param", "Grad"):
+                    continue
+                for n in names:
+                    v = block._find_var_recursive(n)
+                    if v is not None and v.persistable and n not in aux:
+                        aux.append(n)
+        self._lr_and_aux_vars = aux
+
+        # sparse distributed tables: lookup_table ops with
+        # is_distributed=True prefetch rows from the pserver instead of
+        # holding/pulling the dense table (reference:
+        # operators/distributed_ops/distributed_lookup_table_op.cc +
+        # distributed/parameter_prefetch.cc)
+        self._sparse_tables = {}
+        if self._mode in ("sync", "async"):
+            self._rewrite_sparse_lookups(block, bops[0])
+
+        # trainer rewrite: optimizer ops for remote params are replaced by
+        # send/recv markers (no-ops under jit; the PSCommunicator does the
+        # host RPC around each step)
+        if self._mode == "geo":
+            # geo: trainers keep optimizing locally; only the periodic
+            # delta push is added, so optimizer ops stay
+            pass
+        else:
+            for op in opt_ops:
+                block.ops.remove(op)
+        send_inputs = []
+        for pname, op in self._opt_ops_per_param.items():
+            gname = op.input_names["Grad"][0]
+            send_inputs.append(gname)
+            block.append_op(
+                type="send", inputs={"X": [gname]}, outputs={},
+                attrs={"endpoints": [self._param_endpoint[pname]],
+                       "sync_mode": self._mode == "sync"})
+        if self._mode == "sync":
+            block.append_op(type="send_barrier", inputs={}, outputs={},
+                            attrs={"endpoints": self._eplist})
+        for pname in self._opt_ops_per_param:
+            block.append_op(
+                type="recv", inputs={}, outputs={"Out": [pname]},
+                attrs={"epmap": [self._param_endpoint[pname]]})
+        if self._mode == "sync":
+            block.append_op(type="fetch_barrier", inputs={}, outputs={},
+                            attrs={"endpoints": self._eplist})
+
+        program._ps_cfg = {
+            "mode": self._mode,
+            "trainer_id": self._trainer_id,
+            "trainers": self._trainers,
+            "param_endpoint": dict(self._param_endpoint),
+            "grad_of": {self._opt_ops_per_param[p].input_names["Grad"][0]:
+                        p for p in self._opt_ops_per_param},
+            "geo_push_every": self.config.geo_sgd_need_push_nums
+            if self._mode == "geo" else 0,
+            "sparse_tables": dict(self._sparse_tables),
+        }
+        program._version += 1
+
+    def _rewrite_sparse_lookups(self, block, bop):
+        """Rewrite `lookup_table(is_distributed=True)` into a prefetch
+        gather: the executor fetches the step's unique rows from the
+        pserver into a fixed-size PREFETCH feed, the op gathers with
+        host-remapped ids, and the prefetch grad rows are pushed back
+        sparsely (SelectedRows over DCN — never the dense table)."""
+        for op in list(block.ops):
+            if op.type not in ("lookup_table", "lookup_table_v2"):
+                continue
+            if not op.attrs.get("is_distributed"):
+                continue
+            wname = op.input_names["W"][0]
+            ids_name = op.input_names["Ids"][0]
+            if wname not in self._param_endpoint:
+                continue
+            wvar = block._find_var_recursive(wname)
+            ids_var = block._find_var_recursive(ids_name)
+            # one prefetch slot per id in the batch (duplicates padded);
+            # the batch dim is dynamic, so the actual extent comes from
+            # the runtime feed (communicator pads unique rows up to it)
+            prefetch = block.create_var(
+                name=wname + "@PREFETCH",
+                shape=[-1, wvar.shape[-1]], dtype=wvar.dtype,
+                persistable=False, stop_gradient=False)
+            remap = block.create_var(
+                name=ids_name + "@REMAP", shape=list(ids_var.shape),
+                dtype="int64", persistable=False, stop_gradient=True)
+            op.input_names["W"] = [prefetch.name]
+            op.input_names["Ids"] = [remap.name]
+            # grad of the prefetch rows = the sparse push payload
+            bop.attrs.setdefault("diff_names", []).append(prefetch.name)
+            bop.output_names.setdefault("Grad", []).append(
+                grad_var_name(prefetch.name))
+            block.create_var(name=grad_var_name(prefetch.name),
+                             shape=prefetch.shape, dtype=prefetch.dtype,
+                             stop_gradient=True)
+            # lr for the server-side sparse sgd: the removed optimizer
+            # op's LearningRate initial value
+            opt_op = self._opt_ops_per_param[wname]
+            lr_name = opt_op.input_names.get("LearningRate", [None])[0]
+            lr_val = self._startup_const_value(lr_name)
+            self._sparse_tables[wname] = {
+                "endpoint": self._param_endpoint[wname],
+                "ids_feed": ids_name,
+                "prefetch": prefetch.name,
+                "remap": remap.name,
+                "grad": grad_var_name(prefetch.name),
+                "lr": lr_val if lr_val is not None else 1.0,
+            }
+            # the table itself is no longer a dense send/recv param
+            del self._param_endpoint[wname]
+            del self._opt_ops_per_param[wname]
+            self._sparse_host = getattr(self, "_sparse_host", {})
+            self._sparse_host[wname] = self._sparse_tables[wname][
+                "endpoint"]
+
+    def _startup_const_value(self, name):
+        if name is None:
+            return None
+        for op in self._origin_startup.global_block().ops:
+            if name in op.output_arg_names and "value" in op.attrs:
+                return float(op.attrs["value"])
+        return None
+
+    def get_trainer_program(self, wait_port=True):
+        return self._origin_program
+
+    def get_pserver_program(self, endpoint):
+        """Per-endpoint update program: param/grad/aux vars + the original
+        optimizer ops for params hosted here (reference builds
+        listen_and_serv with per-param sub-blocks; here the whole update
+        is one block executed per aggregated step)."""
+        prog = framework.Program()
+        pblock = prog.global_block()
+        src_block = self._origin_program.global_block()
+
+        hosted = [p for p, ep in self._param_endpoint.items()
+                  if ep == endpoint]
+        sparse_here = {w: meta for w, meta in self._sparse_tables.items()
+                       if meta["endpoint"] == endpoint}
+        for wname in sparse_here:
+            v = src_block._find_var_recursive(wname)
+            pblock.create_var(name=wname, shape=v.shape, dtype=v.dtype,
+                              persistable=True, stop_gradient=True)
+        prog._ps_sparse = {w: m["lr"] for w, m in sparse_here.items()}
+        needed_vars = set()
+        for pname in hosted:
+            op = self._opt_ops_per_param[pname]
+            for names in list(op.input_names.values()) + \
+                    list(op.output_names.values()):
+                needed_vars.update(names)
+        for n in sorted(needed_vars):
+            v = src_block._find_var_recursive(n)
+            if v is None:
+                continue
+            pblock.create_var(
+                name=n, shape=v.shape, dtype=v.dtype,
+                persistable=v.persistable, stop_gradient=True)
+        for pname in hosted:
+            op = self._opt_ops_per_param[pname]
+            pblock.append_op(type=op.type,
+                             inputs={s: list(ns) for s, ns
+                                     in op.input_names.items()},
+                             outputs={s: list(ns) for s, ns
+                                      in op.output_names.items()},
+                             attrs=dict(op.attrs))
+        prog._ps_hosted_params = hosted + sorted(sparse_here)
+        prog._ps_grad_of = {self._opt_ops_per_param[p].input_names
+                            ["Grad"][0]: p for p in hosted}
+        return prog
+
+    def get_startup_program(self, endpoint, pserver_program=None):
+        """Init ops (fill_constant/gaussian/...) for vars hosted on this
+        endpoint, copied from the original startup program."""
+        hosted = set(p for p, ep in self._param_endpoint.items()
+                     if ep == endpoint)
+        hosted |= {w for w, m in self._sparse_tables.items()
+                   if m["endpoint"] == endpoint}
+        hosted |= set(self._lr_and_aux_vars)
+        prog = framework.Program()
+        pblock = prog.global_block()
+        src = self._origin_startup.global_block()
+        for op in src.ops:
+            outs = op.output_arg_names
+            if not outs or not all(o in hosted for o in outs):
+                continue
+            for n in set(op.input_arg_names) | set(outs):
+                if pblock._find_var_recursive(n) is None:
+                    v = src._find_var_recursive(n)
+                    if v is not None:
+                        pblock.create_var(name=n, shape=v.shape,
+                                          dtype=v.dtype, persistable=True)
+            pblock.append_op(type=op.type,
+                             inputs={s: list(ns) for s, ns
+                                     in op.input_names.items()},
+                             outputs={s: list(ns) for s, ns
+                                      in op.output_names.items()},
+                             attrs=dict(op.attrs))
+        return prog

@@ -241,3 +241,20 @@ def _dpsgd(ins, attrs):
     noise = jax.random.normal(attrs["_rng_key"], g.shape) * sigma * clip
     p_out = p.astype(jnp.float32) - lr / batch_size * (gf + noise)
     return {"ParamOut": p_out.astype(p.dtype)}
+
+
+@register_op("lookahead_step")
+def _lookahead_step(ins, attrs):
+    """Lookahead slow-weight update (reference: optimizer.py:4777
+    LookaheadOptimizer). Runs every step; the interpolation + snap-back
+    applies only when the step counter hits a multiple of k."""
+    p, slow = ins["Param"][0], ins["SlowParam"][0]
+    step = ins["Step"][0]
+    alpha = attrs.get("alpha", 0.5)
+    k = int(attrs.get("k", 5))
+    do = (jnp.reshape(step, ()).astype(jnp.int32) % k) == 0
+    pf, sf = p.astype(jnp.float32), slow.astype(jnp.float32)
+    slow2 = jnp.where(do, sf + alpha * (pf - sf), sf)
+    p2 = jnp.where(do, slow2, pf)
+    return {"ParamOut": p2.astype(p.dtype),
+            "SlowParamOut": slow2.astype(slow.dtype)}

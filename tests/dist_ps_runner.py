@@ -1,0 +1,207 @@
+"""PS-mode runner script (spawned as subprocesses by test_dist_ps.py;
+reference pattern: test_dist_base.py dist_mnist.py runners). Roles via
+argv: pserver <endpoint> <all_pserver_eps> <n_trainers>
+     trainer <trainer_id> <all_pserver_eps> <n_trainers> <mode>
+Prints one line per step: LOSS <v> (trainer) or SERVED (pserver)."""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu.fluid as fluid  # noqa: E402
+from paddle_tpu.fluid import framework  # noqa: E402
+
+LR = 0.5
+STEPS = 5
+BATCH = 32  # global; each trainer sees half
+
+
+def build(seed=11):
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = startup.random_seed = seed
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+            label = fluid.layers.data(name="label", shape=[1],
+                                      dtype="int64")
+            h = fluid.layers.fc(input=x, size=32, act="relu")
+            logits = fluid.layers.fc(input=h, size=4)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, label))
+            opt = fluid.optimizer.SGDOptimizer(learning_rate=LR)
+            opt.minimize(loss)
+    return main, startup, loss
+
+
+def data():
+    r = np.random.RandomState(2)
+    x = r.rand(BATCH, 16).astype("float32")
+    y = r.randint(0, 4, (BATCH, 1)).astype("int64")
+    return x, y
+
+
+def run_single():
+    from paddle_tpu.core.scope import Scope
+
+    main, startup, loss = build()
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    x, y = data()
+    for _ in range(STEPS):
+        out = exe.run(main, feed={"x": x, "label": y},
+                      fetch_list=[loss], scope=scope)
+        print("LOSS %.6f" % float(np.asarray(out[0]).reshape(-1)[0]),
+              flush=True)
+
+
+def run_pserver(endpoint, eplist, n_trainers, mode):
+    from paddle_tpu.distributed.ps import listen_and_serv
+
+    main, startup, loss = build()
+    t = _transpiler(mode)
+    t.transpile(0, program=main, pservers=eplist, trainers=n_trainers,
+                sync_mode=(mode == "sync"), startup_program=startup)
+    pprog = t.get_pserver_program(endpoint)
+    pstartup = t.get_startup_program(endpoint, pprog)
+    print("SERVING", flush=True)
+    listen_and_serv(pprog, pstartup, endpoint=endpoint,
+                    trainers=n_trainers, mode=mode)
+    print("SERVED", flush=True)
+
+
+def _transpiler(mode):
+    cfg = fluid.DistributeTranspilerConfig()
+    if mode == "geo":
+        cfg.geo_sgd_mode = True
+        cfg.geo_sgd_need_push_nums = 2
+    return fluid.DistributeTranspiler(config=cfg)
+
+
+def run_trainer(tid, eplist, n_trainers, mode):
+    from paddle_tpu.core.scope import Scope
+
+    main, startup, loss = build()
+    t = _transpiler(mode)
+    t.transpile(tid, program=main, pservers=eplist, trainers=n_trainers,
+                sync_mode=(mode == "sync"), startup_program=startup)
+    main = t.get_trainer_program()
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    x, y = data()
+    half = BATCH // n_trainers
+    xs = x[tid * half:(tid + 1) * half]
+    ys = y[tid * half:(tid + 1) * half]
+    for _ in range(STEPS):
+        out = exe.run(main, feed={"x": xs, "label": ys},
+                      fetch_list=[loss], scope=scope)
+        print("LOSS %.6f" % float(np.asarray(out[0]).reshape(-1)[0]),
+              flush=True)
+    exe.close()  # sends complete() so pservers exit
+
+
+
+def build_emb(seed=13):
+    """distributed_lookup_table model: sparse embedding + fc."""
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = startup.random_seed = seed
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            ids = fluid.layers.data(name="ids", shape=[4], dtype="int64")
+            label = fluid.layers.data(name="label", shape=[1],
+                                      dtype="int64")
+            emb = fluid.layers.embedding(
+                ids, size=[100, 8], is_sparse=True, is_distributed=True)
+            emb = fluid.layers.reshape(emb, [-1, 32])
+            h = fluid.layers.fc(input=emb, size=16, act="relu")
+            logits = fluid.layers.fc(input=h, size=4)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, label))
+            opt = fluid.optimizer.SGDOptimizer(learning_rate=LR)
+            opt.minimize(loss)
+    return main, startup, loss
+
+
+def data_emb():
+    r = np.random.RandomState(4)
+    ids = r.randint(0, 100, (BATCH, 4)).astype("int64")
+    y = r.randint(0, 4, (BATCH, 1)).astype("int64")
+    return ids, y
+
+
+def run_single_emb():
+    from paddle_tpu.core.scope import Scope
+
+    main, startup, loss = build_emb()
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    ids, y = data_emb()
+    for _ in range(STEPS):
+        out = exe.run(main, feed={"ids": ids, "label": y},
+                      fetch_list=[loss], scope=scope)
+        print("LOSS %.6f" % float(np.asarray(out[0]).reshape(-1)[0]),
+              flush=True)
+
+
+def run_pserver_emb(endpoint, eplist, n_trainers, mode):
+    from paddle_tpu.distributed.ps import listen_and_serv
+
+    main, startup, loss = build_emb()
+    t = _transpiler(mode)
+    t.transpile(0, program=main, pservers=eplist, trainers=n_trainers,
+                sync_mode=(mode == "sync"), startup_program=startup)
+    pprog = t.get_pserver_program(endpoint)
+    pstartup = t.get_startup_program(endpoint, pprog)
+    print("SERVING", flush=True)
+    listen_and_serv(pprog, pstartup, endpoint=endpoint,
+                    trainers=n_trainers, mode=mode)
+    print("SERVED", flush=True)
+
+
+def run_trainer_emb(tid, eplist, n_trainers, mode):
+    from paddle_tpu.core.scope import Scope
+
+    main, startup, loss = build_emb()
+    t = _transpiler(mode)
+    t.transpile(tid, program=main, pservers=eplist, trainers=n_trainers,
+                sync_mode=(mode == "sync"), startup_program=startup)
+    main = t.get_trainer_program()
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    ids, y = data_emb()
+    half = BATCH // n_trainers
+    for _ in range(STEPS):
+        out = exe.run(main,
+                      feed={"ids": ids[tid * half:(tid + 1) * half],
+                            "label": y[tid * half:(tid + 1) * half]},
+                      fetch_list=[loss], scope=scope)
+        print("LOSS %.6f" % float(np.asarray(out[0]).reshape(-1)[0]),
+              flush=True)
+    exe.close()
+
+
+if __name__ == "__main__":
+    role = sys.argv[1]
+    if role == "single":
+        run_single()
+    elif role == "single_emb":
+        run_single_emb()
+    elif role == "pserver":
+        run_pserver(sys.argv[2], sys.argv[3], int(sys.argv[4]),
+                    sys.argv[5])
+    elif role == "pserver_emb":
+        run_pserver_emb(sys.argv[2], sys.argv[3], int(sys.argv[4]),
+                        sys.argv[5])
+    elif role == "trainer_emb":
+        run_trainer_emb(int(sys.argv[2]), sys.argv[3], int(sys.argv[4]),
+                        sys.argv[5])
+    else:
+        run_trainer(int(sys.argv[2]), sys.argv[3], int(sys.argv[4]),
+                    sys.argv[5])
