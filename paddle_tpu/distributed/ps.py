@@ -127,7 +127,10 @@ class PSCommunicator:
                 self._geo_snapshots[pname] = np.asarray(merged).copy()
 
     def complete(self):
-        for ep in sorted(set(self.cfg["param_endpoint"].values())):
+        eps = set(self.cfg["param_endpoint"].values())
+        eps |= {m["endpoint"]
+                for m in self.cfg.get("sparse_tables", {}).values()}
+        for ep in sorted(eps):
             try:
                 self._client(ep).call("complete", self.tid)
             except Exception:  # noqa: BLE001 - server may already be down

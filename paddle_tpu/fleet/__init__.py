@@ -181,83 +181,12 @@ class CollectiveOptimizer:
         import warnings
 
         st = self._strategy
-        inner = self._optimizer
+        # fleet 2.0 meta-optimizer composition (reference:
+        # fleet/base/strategy_compiler.py + meta_optimizers/): each knob
+        # maps to a wrapper; unimplementable knobs warn loudly
+        from .meta_optimizers import compose
 
-        # knobs with no TPU implementation must be LOUD, not silent
-        # (VERDICT r1 weak #5): reference configs would otherwise "run"
-        # with different semantics.
-        if st.dgc:
-            warnings.warn(
-                "DistributedStrategy.dgc: gradient compression is a GPU-"
-                "bandwidth optimization; on TPU the dense psum over ICI "
-                "is used instead (DGCMomentumOptimizer degrades to "
-                "Momentum). Ignoring dgc.")
-        if st.a_sync:
-            warnings.warn(
-                "DistributedStrategy.a_sync: async parameter-server mode "
-                "is not wired through fleet yet; use "
-                "fluid.transpiler.DistributeTranspiler for PS training. "
-                "Running collective (sync) instead.")
-        if st.elastic:
-            warnings.warn("DistributedStrategy.elastic is not "
-                          "implemented; ignoring.")
-        if st.auto:
-            warnings.warn("DistributedStrategy.auto (auto-parallel "
-                          "search) is not implemented; ignoring.")
-        if st.sync_batch_norm:
-            warnings.warn("DistributedStrategy.sync_batch_norm is not "
-                          "implemented; BN stats stay per-replica.")
-
-        if st.lamb and not type(inner).__name__.startswith("Lamb"):
-            from ..fluid.optimizer import AdamOptimizer, LambOptimizer
-
-            kw = {}
-            if isinstance(inner, AdamOptimizer):
-                kw = {"beta1": inner._beta1, "beta2": inner._beta2,
-                      "epsilon": inner._epsilon}
-            inner = LambOptimizer(
-                learning_rate=inner._learning_rate,
-                regularization=getattr(inner, "regularization", None),
-                grad_clip=getattr(inner, "_grad_clip", None), **kw)
-        if st.lars and type(inner).__name__.startswith("Momentum"):
-            from ..fluid.optimizer import LarsMomentumOptimizer
-
-            inner = LarsMomentumOptimizer(
-                learning_rate=inner._learning_rate,
-                momentum=getattr(inner, "_momentum", 0.9),
-                regularization=getattr(inner, "regularization", None),
-                grad_clip=getattr(inner, "_grad_clip", None))
-
-        if st.recompute and hasattr(st, "recompute_configs"):
-            ckpts = st.recompute_configs.get("checkpoints", [])
-            if ckpts:
-                from ..fluid.optimizer import RecomputeOptimizer
-
-                inner = RecomputeOptimizer(inner)
-                inner._set_checkpoints(ckpts)
-        if st.gradient_merge and st.pipeline:
-            warnings.warn("gradient_merge + pipeline both set; pipeline's "
-                          "own microbatching wins, gradient_merge "
-                          "ignored.")
-        elif st.gradient_merge:
-            from ..fluid.optimizer import GradientMergeOptimizer
-
-            inner = GradientMergeOptimizer(
-                inner,
-                k_steps=int(st.gradient_merge_configs.get("k_steps", 1)),
-                avg=bool(st.gradient_merge_configs.get("avg", True)))
-        if st.pipeline:
-            from ..fluid.optimizer import PipelineOptimizer
-
-            inner = PipelineOptimizer(
-                inner,
-                cut_list=st.pipeline_configs.get("cut_list"),
-                num_microbatches=int(
-                    st.pipeline_configs.get("micro_batch", 1)))
-        if st.amp:
-            from ..fluid.contrib import mixed_precision
-
-            inner = mixed_precision.decorate(inner, **st.amp_configs)
+        inner, self._applied_meta_list = compose(st, self._optimizer)
         optimize_ops, params_grads = inner.minimize(
             loss, startup_program, parameter_list, no_grad_set)
         if st.pipeline:

@@ -1,0 +1,187 @@
+"""Fleet 2.0 meta-optimizer composition.
+
+Reference parity: `python/paddle/fleet/meta_optimizers/` +
+`fleet/base/strategy_compiler.py` — each DistributedStrategy knob maps to
+a meta-optimizer that wraps the user optimizer; the StrategyCompiler
+resolves which apply and in what order. TPU-native: the wrappers reuse
+the real fluid machinery (RecomputeOptimizer -> jax.checkpoint segments,
+GradientMergeOptimizer -> lax.cond accumulation, PipelineOptimizer ->
+shard_map GPipe engine, AMP -> bf16 cast insertion), so composition is
+pure configuration, not new execution paths.
+"""
+from __future__ import annotations
+
+import warnings
+from typing import List
+
+
+class MetaOptimizerBase:
+    """One strategy knob -> one wrapper (reference:
+    meta_optimizers/meta_optimizer_base.py)."""
+
+    name = "base"
+
+    def can_apply(self, strategy, optimizer) -> bool:
+        raise NotImplementedError
+
+    def apply(self, strategy, optimizer):
+        raise NotImplementedError
+
+
+class RecomputeMetaOptimizer(MetaOptimizerBase):
+    name = "recompute"
+
+    def can_apply(self, strategy, optimizer):
+        return strategy.recompute and \
+            strategy.recompute_configs.get("checkpoints")
+
+    def apply(self, strategy, optimizer):
+        from ..fluid.optimizer import RecomputeOptimizer
+
+        inner = RecomputeOptimizer(optimizer)
+        inner._set_checkpoints(
+            strategy.recompute_configs["checkpoints"])
+        return inner
+
+
+class GradientMergeMetaOptimizer(MetaOptimizerBase):
+    name = "gradient_merge"
+
+    def can_apply(self, strategy, optimizer):
+        if strategy.gradient_merge and strategy.pipeline:
+            warnings.warn("gradient_merge + pipeline both set; pipeline's "
+                          "own microbatching wins, gradient_merge "
+                          "ignored.")
+            return False
+        return strategy.gradient_merge
+
+    def apply(self, strategy, optimizer):
+        from ..fluid.optimizer import GradientMergeOptimizer
+
+        cfg = strategy.gradient_merge_configs
+        return GradientMergeOptimizer(
+            optimizer, k_steps=int(cfg.get("k_steps", 1)),
+            avg=bool(cfg.get("avg", True)))
+
+
+class PipelineMetaOptimizer(MetaOptimizerBase):
+    name = "pipeline"
+
+    def can_apply(self, strategy, optimizer):
+        return strategy.pipeline
+
+    def apply(self, strategy, optimizer):
+        from ..fluid.optimizer import PipelineOptimizer
+
+        cfg = strategy.pipeline_configs
+        return PipelineOptimizer(
+            optimizer, cut_list=cfg.get("cut_list"),
+            num_microbatches=int(cfg.get("micro_batch", 1)))
+
+
+class AMPMetaOptimizer(MetaOptimizerBase):
+    name = "amp"
+
+    def can_apply(self, strategy, optimizer):
+        return strategy.amp
+
+    def apply(self, strategy, optimizer):
+        from ..fluid.contrib import mixed_precision
+
+        return mixed_precision.decorate(optimizer,
+                                        **strategy.amp_configs)
+
+
+class LambMetaOptimizer(MetaOptimizerBase):
+    name = "lamb"
+
+    def can_apply(self, strategy, optimizer):
+        return strategy.lamb and \
+            not type(optimizer).__name__.startswith("Lamb")
+
+    def apply(self, strategy, optimizer):
+        from ..fluid.optimizer import AdamOptimizer, LambOptimizer
+
+        kw = {}
+        if isinstance(optimizer, AdamOptimizer):
+            kw = {"beta1": optimizer._beta1, "beta2": optimizer._beta2,
+                  "epsilon": optimizer._epsilon}
+        return LambOptimizer(
+            learning_rate=optimizer._learning_rate,
+            regularization=getattr(optimizer, "regularization", None),
+            grad_clip=getattr(optimizer, "_grad_clip", None), **kw)
+
+
+class LarsMetaOptimizer(MetaOptimizerBase):
+    name = "lars"
+
+    def can_apply(self, strategy, optimizer):
+        return strategy.lars and \
+            type(optimizer).__name__.startswith("Momentum")
+
+    def apply(self, strategy, optimizer):
+        from ..fluid.optimizer import LarsMomentumOptimizer
+
+        return LarsMomentumOptimizer(
+            learning_rate=optimizer._learning_rate,
+            momentum=getattr(optimizer, "_momentum", 0.9),
+            regularization=getattr(optimizer, "regularization", None),
+            grad_clip=getattr(optimizer, "_grad_clip", None))
+
+
+class _WarnOnlyMeta(MetaOptimizerBase):
+    def __init__(self, knob, message):
+        self.name = knob
+        self._message = message
+
+    def can_apply(self, strategy, optimizer):
+        if getattr(strategy, self.name, False):
+            warnings.warn(self._message)
+        return False
+
+    def apply(self, strategy, optimizer):  # pragma: no cover
+        return optimizer
+
+
+_WARN_ONLY = [
+    _WarnOnlyMeta("dgc",
+                  "DistributedStrategy.dgc: gradient compression is a "
+                  "GPU-bandwidth optimization; on TPU the dense psum over "
+                  "ICI is used instead (DGCMomentumOptimizer degrades to "
+                  "Momentum). Ignoring dgc."),
+    _WarnOnlyMeta("a_sync",
+                  "DistributedStrategy.a_sync: async parameter-server "
+                  "mode is not wired through fleet yet; use "
+                  "fluid.transpiler.DistributeTranspiler for PS "
+                  "training. Running collective (sync) instead."),
+    _WarnOnlyMeta("elastic",
+                  "DistributedStrategy.elastic is not implemented; "
+                  "ignoring."),
+    _WarnOnlyMeta("auto",
+                  "DistributedStrategy.auto (auto-parallel search) is "
+                  "not implemented; ignoring."),
+    _WarnOnlyMeta("sync_batch_norm",
+                  "DistributedStrategy.sync_batch_norm is not "
+                  "implemented; BN stats stay per-replica."),
+]
+
+# application order matters: optimizer swaps first, then recompute /
+# gradient_merge wrap, pipeline cuts the program, AMP decorates last so
+# the cast policy sees the final graph (reference: strategy_compiler
+# ordering)
+_META_ORDER: List[MetaOptimizerBase] = _WARN_ONLY + [
+    LambMetaOptimizer(), LarsMetaOptimizer(), RecomputeMetaOptimizer(),
+    GradientMergeMetaOptimizer(), PipelineMetaOptimizer(),
+    AMPMetaOptimizer(),
+]
+
+
+def compose(strategy, optimizer):
+    """StrategyCompiler: fold the applicable meta-optimizers over the
+    user optimizer; returns (wrapped_optimizer, applied_names)."""
+    applied = []
+    for meta in _META_ORDER:
+        if meta.can_apply(strategy, optimizer):
+            optimizer = meta.apply(strategy, optimizer)
+            applied.append(meta.name)
+    return optimizer, applied

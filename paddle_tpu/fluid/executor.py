@@ -142,8 +142,23 @@ class Executor:
                 comm.step({}, scope)
             fetches = fetches[:n_user_fetches]
         if return_numpy:
-            return [np.asarray(v) for v in fetches]
+            return [self._fetch_to_numpy(v) for v in fetches]
         return list(fetches)
+
+    @staticmethod
+    def _fetch_to_numpy(v):
+        """Multi-host: a fetch sharded over remote processes is not fully
+        addressable; return the locally-addressable shards concatenated
+        (reference analogue: each trainer fetches its own scope)."""
+        try:
+            return np.asarray(v)
+        except Exception:
+            shards = getattr(v, "addressable_shards", None)
+            if not shards:
+                raise
+            datas = [np.asarray(s.data) for s in shards]
+            return np.concatenate(datas, axis=0) if len(datas) > 1 \
+                else datas[0]
 
     def _ps_communicator(self, program, ps_cfg, scope=None):
         if not hasattr(self, "_ps_comms"):

@@ -2,6 +2,11 @@
 `python/paddle/fluid/layers/`)."""
 from . import nn, tensor, loss, collective, math_op_patch  # noqa: F401
 from . import control_flow  # noqa: F401
+from . import distributions  # noqa: F401
+from . import rnn_decode  # noqa: F401
+from .rnn_decode import (  # noqa: F401
+    RNNCell, GRUCell, BeamSearchDecoder, dynamic_decode,
+)
 from . import learning_rate_scheduler  # noqa: F401
 from .nn import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403

@@ -1,0 +1,164 @@
+"""Probability distributions.
+
+Reference parity: `python/paddle/fluid/layers/distributions.py` —
+Uniform, Normal, Categorical, MultivariateNormalDiag with
+sample/entropy/log_prob/kl_divergence built from layers ops. TPU-native:
+pure jnp math usable in eager mode and under the static tracer (the ops
+go through the same registry; sampling uses the seeded uniform/gaussian
+RNG ops so static-graph runs stay deterministic per program seed).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .. import framework
+from ..framework import Variable, in_dygraph_mode
+from ..layer_helper import LayerHelper
+from . import tensor as tensor_layers
+from . import nn as nn_layers
+
+__all__ = ["Uniform", "Normal", "Categorical", "MultivariateNormalDiag"]
+
+
+def _to_var(value, name_hint="dist_const"):
+    """Accept floats / numpy / Variables / eager Tensors uniformly."""
+    if isinstance(value, Variable):
+        return value
+    if in_dygraph_mode():
+        from ..dygraph import base as dy_base
+        import jax.numpy as jnp
+
+        if isinstance(value, dy_base.Tensor):
+            return value
+        return dy_base.Tensor(jnp.asarray(np.asarray(value, "float32")),
+                              stop_gradient=True)
+    arr = np.asarray(value, "float32")
+    return tensor_layers.assign(arr)
+
+
+class Distribution:
+    def sample(self, shape, seed=0):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    """Uniform[low, high) (reference: distributions.py Uniform)."""
+
+    def __init__(self, low, high):
+        self.low = _to_var(low)
+        self.high = _to_var(high)
+
+    def sample(self, shape, seed=0):
+        u = nn_layers.uniform_random(shape, min=0.0, max=1.0, seed=seed)
+        return self.low + (self.high - self.low) * u
+
+    def entropy(self):
+        return nn_layers.log(self.high - self.low)
+
+    def log_prob(self, value):
+        lb = tensor_layers.cast(value > self.low, "float32")
+        ub = tensor_layers.cast(value < self.high, "float32")
+        return nn_layers.log(lb * ub) - nn_layers.log(
+            self.high - self.low)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _to_var(loc)
+        self.scale = _to_var(scale)
+
+    def sample(self, shape, seed=0):
+        z = nn_layers.gaussian_random(shape, mean=0.0, std=1.0,
+                                       seed=seed)
+        return self.loc + self.scale * z
+
+    def entropy(self):
+        c = 0.5 + 0.5 * math.log(2.0 * math.pi)
+        return c + nn_layers.log(self.scale)
+
+    def log_prob(self, value):
+        var = self.scale * self.scale
+        log_scale = nn_layers.log(self.scale)
+        return (-1.0 * ((value - self.loc) * (value - self.loc))
+                / (2.0 * var) - log_scale
+                - math.log(math.sqrt(2.0 * math.pi)))
+
+    def kl_divergence(self, other):
+        var_ratio = self.scale / other.scale
+        var_ratio = var_ratio * var_ratio
+        t1 = (self.loc - other.loc) / other.scale
+        t1 = t1 * t1
+        return 0.5 * (var_ratio + t1 - 1.0 - nn_layers.log(var_ratio))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits):
+        self.logits = logits if isinstance(logits, Variable) or \
+            in_dygraph_mode() else _to_var(logits)
+
+    def _probs(self):
+        return nn_layers.softmax(self.logits)
+
+    def entropy(self):
+        p = self._probs()
+        lp = nn_layers.log(p + 1e-12)
+        neg = nn_layers.reduce_sum(p * lp, dim=-1)
+        return -1.0 * neg
+
+    def log_prob(self, value):
+        p = self._probs()
+        onehot = nn_layers.one_hot(value,
+                                   depth=int(self.logits.shape[-1]))
+        return nn_layers.log(
+            nn_layers.reduce_sum(p * onehot, dim=-1) + 1e-12)
+
+    def kl_divergence(self, other):
+        p = self._probs()
+        lp = nn_layers.log(p + 1e-12)
+        lq = nn_layers.log(other._probs() + 1e-12)
+        return nn_layers.reduce_sum(p * (lp - lq), dim=-1)
+
+
+class MultivariateNormalDiag(Distribution):
+    """Diagonal-covariance multivariate normal (reference:
+    distributions.py MultivariateNormalDiag; loc [d], scale diag [d,d])."""
+
+    def __init__(self, loc, scale):
+        self.loc = _to_var(loc)
+        self.scale = _to_var(scale)  # diagonal matrix [d, d]
+
+    def _diag(self):
+        d = int(self.scale.shape[-1])
+        eye = tensor_layers.assign(np.eye(d, dtype="float32"))
+        return nn_layers.reduce_sum(self.scale * eye, dim=-1)
+
+    def entropy(self):
+        d = int(self.scale.shape[-1])
+        diag = self._diag()
+        logdet = nn_layers.reduce_sum(nn_layers.log(diag + 1e-12))
+        return 0.5 * d * (1.0 + math.log(2.0 * math.pi)) + logdet
+
+    def kl_divergence(self, other):
+        d1 = self._diag()
+        d2 = other._diag()
+        var1 = d1 * d1
+        var2 = d2 * d2
+        t = nn_layers.reduce_sum(var1 / var2
+                                     + (self.loc - other.loc)
+                                     * (self.loc - other.loc) / var2,
+                                     dim=-1)
+        k = int(self.scale.shape[-1])
+        logdet = nn_layers.reduce_sum(
+            nn_layers.log(var2 + 1e-12) - nn_layers.log(var1 + 1e-12))
+        return 0.5 * (t - k + logdet)

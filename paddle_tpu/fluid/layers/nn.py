@@ -684,3 +684,21 @@ def scaled_dot_product_attention(q, k, v, key_bias=None, causal=False,
                     "sm_scale": -1.0 if sm_scale is None else float(sm_scale),
                     "attn_dropout_prob": float(attn_dropout_prob),
                     "is_test": is_test}, dtype=q.dtype)
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0,
+                   name=None):
+    """Reference: layers/nn.py uniform_random -> uniform_random op."""
+    return _single("uniform_random", {},
+                   {"shape": list(shape), "min": float(min),
+                    "max": float(max), "seed": seed, "dtype": dtype},
+                   dtype=dtype)
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32",
+                    name=None):
+    """Reference: layers/nn.py gaussian_random -> gaussian_random op."""
+    return _single("gaussian_random", {},
+                   {"shape": list(shape), "mean": float(mean),
+                    "std": float(std), "seed": seed, "dtype": dtype},
+                   dtype=dtype)

@@ -13,7 +13,11 @@ import os
 import time
 from collections import defaultdict
 
-_host_events = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
+_host_events = defaultdict(lambda: [0, 0.0, 0.0])  # name -> [count, total_s, max_s]
+
+# chrome://tracing buffer: (name, start_us, dur_us, tid)
+_trace_events = []
+_trace_enabled = False
 
 
 class RecordEvent:
@@ -41,6 +45,12 @@ class RecordEvent:
         ev = _host_events[self.name]
         ev[0] += 1
         ev[1] += dt
+        ev[2] = max(ev[2], dt)
+        if _trace_enabled:
+            import threading
+
+            _trace_events.append((self.name, self._t0 * 1e6, dt * 1e6,
+                                  threading.get_ident() % 100000))
         if self._ann is not None:
             self._ann.__exit__(*a)
 
@@ -59,15 +69,20 @@ def profiler(state="All", sorted_key=None, profile_path="/tmp/profile",
         started = True
     except Exception:
         pass
+    global _trace_enabled
+    _trace_enabled = True
     t0 = time.perf_counter()
     try:
         yield
     finally:
         wall = time.perf_counter() - t0
+        _trace_enabled = False
         if started:
             import jax.profiler
 
             jax.profiler.stop_trace()
+        export_chrome_tracing(os.path.join(profile_path,
+                                           "paddle_tpu_trace.json"))
         if sorted_key:
             print_profiler_summary(wall)
 
@@ -88,13 +103,41 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
 
 def reset_profiler():
     _host_events.clear()
+    del _trace_events[:]
+
+
+def export_chrome_tracing(path):
+    """chrome://tracing JSON export (reference: tools/timeline.py:32
+    converting profiler.proto records; here the host RecordEvent buffer
+    plus per-event complete ("ph":"X") entries)."""
+    import json
+
+    events = [{"name": name, "ph": "X", "pid": 0, "tid": tid,
+               "ts": ts, "dur": dur, "cat": "host"}
+              for name, ts, dur, tid in _trace_events]
+    data = {"traceEvents": events, "displayTimeUnit": "ms"}
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(data, f)
+    return path
+
+
+def profiler_summary_rows():
+    """Per-event (name, calls, total_ms, avg_ms, max_ms) rows."""
+    rows = []
+    for name, (cnt, total, mx) in sorted(_host_events.items(),
+                                         key=lambda kv: -kv[1][1]):
+        rows.append((name, cnt, total * 1e3, total * 1e3 / max(cnt, 1),
+                     mx * 1e3))
+    return rows
 
 
 def print_profiler_summary(wall=None):
-    rows = sorted(_host_events.items(), key=lambda kv: -kv[1][1])
-    print("%-40s %10s %14s" % ("Event", "Calls", "Total(ms)"))
-    for name, (cnt, total) in rows[:50]:
-        print("%-40s %10d %14.3f" % (name, cnt, total * 1e3))
+    print("%-40s %10s %12s %12s %12s" % ("Event", "Calls", "Total(ms)",
+                                         "Avg(ms)", "Max(ms)"))
+    for name, cnt, total, avg, mx in profiler_summary_rows()[:50]:
+        print("%-40s %10d %12.3f %12.3f %12.3f" % (name, cnt, total,
+                                                   avg, mx))
     if wall is not None:
         print("wall: %.3f s" % wall)
 

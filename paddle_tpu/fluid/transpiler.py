@@ -133,10 +133,8 @@ class DistributeTranspiler:
         else:
             for op in opt_ops:
                 block.ops.remove(op)
-        send_inputs = []
         for pname, op in self._opt_ops_per_param.items():
             gname = op.input_names["Grad"][0]
-            send_inputs.append(gname)
             block.append_op(
                 type="send", inputs={"X": [gname]}, outputs={},
                 attrs={"endpoints": [self._param_endpoint[pname]],
@@ -217,9 +215,6 @@ class DistributeTranspiler:
             # the table itself is no longer a dense send/recv param
             del self._param_endpoint[wname]
             del self._opt_ops_per_param[wname]
-            self._sparse_host = getattr(self, "_sparse_host", {})
-            self._sparse_host[wname] = self._sparse_tables[wname][
-                "endpoint"]
 
     def _startup_const_value(self, name):
         if name is None:

@@ -16,3 +16,7 @@ from . import optimizer_ops  # noqa: F401
 from . import metric_ops  # noqa: F401
 from . import collective_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401
+from . import loss_ops  # noqa: F401
+from . import linalg_ops  # noqa: F401
+from . import detection_ops  # noqa: F401
+from . import beam_search_ops  # noqa: F401

@@ -1,0 +1,92 @@
+"""Beam-search operators.
+
+Reference parity: `paddle/fluid/operators/beam_search_op.cc` (one search
+step over candidate ids/scores), `beam_search_decode_op.cc` (backtrack
+the beam lattice into full hypotheses), and `gather_tree` (2.0). The
+reference walks LoD levels on the host; TPU-native form is static-shape
+[batch, beam, ...] tensors — one jit-able step usable inside
+lax.while_loop (layers.dynamic_decode drives it).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+@register_op("beam_search")
+def _beam_search(ins, attrs):
+    """One step. ids/scores: [batch, beam, K] candidates (K=vocab or
+    pre-topk), pre_ids [batch, beam], pre_scores [batch, beam].
+    Outputs: selected_ids/selected_scores [batch, beam], parent_idx
+    [batch, beam] (which source beam each winner came from)."""
+    pre_ids = ins["pre_ids"][0]
+    pre_scores = ins["pre_scores"][0]
+    ids = ins["ids"][0] if ins.get("ids") else None
+    scores = ins["scores"][0]
+    beam_size = attrs.get("beam_size", scores.shape[1])
+    end_id = attrs.get("end_id", 0)
+
+    batch, beam, k = scores.shape
+    # finished beams only propagate themselves (score frozen)
+    finished = pre_ids == end_id
+    total = pre_scores[..., None] + jnp.where(finished[..., None],
+                                              0.0, scores)
+    # a finished beam keeps exactly one candidate (its end token)
+    cand_mask = jnp.where(
+        finished[..., None],
+        jnp.arange(k)[None, None, :] == 0,
+        jnp.ones((1, 1, k), bool))
+    neg = jnp.finfo(total.dtype).min
+    total = jnp.where(cand_mask, total, neg)
+
+    flat = total.reshape(batch, beam * k)
+    top_scores, top_idx = jax.lax.top_k(flat, beam_size)
+    parent = (top_idx // k).astype(jnp.int64)
+    cand_pos = top_idx % k
+    if ids is None:
+        sel_ids = cand_pos.astype(jnp.int64)
+    else:
+        sel_ids = jnp.take_along_axis(
+            ids.reshape(batch, beam * k),
+            top_idx, axis=1).astype(jnp.int64)
+    parent_fin = jnp.take_along_axis(finished, parent, axis=1)
+    sel_ids = jnp.where(parent_fin, end_id, sel_ids)
+    return {"selected_ids": sel_ids, "selected_scores": top_scores,
+            "parent_idx": parent}
+
+
+@register_op("gather_tree")
+def _gather_tree(ins, attrs):
+    """ids/parents: [T, batch, beam] -> backtracked full sequences."""
+    ids, parents = ins["Ids"][0], ins["Parents"][0]
+    t = ids.shape[0]
+
+    def body(carry, xs):
+        beam_idx = carry  # [batch, beam]
+        step_ids, step_parents = xs
+        out = jnp.take_along_axis(step_ids, beam_idx, axis=1)
+        nxt = jnp.take_along_axis(step_parents, beam_idx, axis=1)
+        return nxt.astype(beam_idx.dtype), out
+
+    init = jnp.broadcast_to(
+        jnp.arange(ids.shape[2], dtype=jnp.int64)[None, :],
+        ids.shape[1:]).astype(jnp.int64)
+    _, outs = jax.lax.scan(body, init, (ids[::-1], parents[::-1]))
+    return {"Out": outs[::-1]}
+
+
+@register_op("beam_search_decode")
+def _beam_search_decode(ins, attrs):
+    """Backtrack stacked per-step ids/parents into final sequences.
+    Inputs Ids/ParentIdx: [T, batch, beam]; SentenceIds = backtracked
+    token lattice, SentenceScores = final beam scores broadcast."""
+    ids = ins["Ids"][0]
+    parents = ins["ParentIdx"][0]
+    scores = ins["Scores"][0] if ins.get("Scores") else None
+    out = _gather_tree({"Ids": [ids], "Parents": [parents]}, {})["Out"]
+    res = {"SentenceIds": out}
+    if scores is not None:
+        res["SentenceScores"] = scores
+    return res

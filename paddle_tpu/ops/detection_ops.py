@@ -1,0 +1,354 @@
+"""Detection operators.
+
+Reference parity: `paddle/fluid/operators/detection/` — prior_box,
+density_prior_box, box_coder, yolo_box, iou_similarity, box_clip,
+anchor_generator, roi_align, roi_pool; multiclass_nms runs un-jitted on
+host (dynamic output count, reference returns a LoDTensor).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+@register_op("iou_similarity")
+def _iou_similarity(ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]  # [n,4], [m,4] xyxy
+    area_x = (x[:, 2] - x[:, 0]) * (x[:, 3] - x[:, 1])
+    area_y = (y[:, 2] - y[:, 0]) * (y[:, 3] - y[:, 1])
+    lt = jnp.maximum(x[:, None, :2], y[None, :, :2])
+    rb = jnp.minimum(x[:, None, 2:], y[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    return {"Out": inter / (area_x[:, None] + area_y[None, :] - inter)}
+
+
+@register_op("box_clip")
+def _box_clip(ins, attrs):
+    boxes, im_info = ins["Input"][0], ins["ImInfo"][0]
+    h = im_info[0, 0] - 1.0
+    w = im_info[0, 1] - 1.0
+    x1 = jnp.clip(boxes[..., 0], 0, w)
+    y1 = jnp.clip(boxes[..., 1], 0, h)
+    x2 = jnp.clip(boxes[..., 2], 0, w)
+    y2 = jnp.clip(boxes[..., 3], 0, h)
+    return {"Output": jnp.stack([x1, y1, x2, y2], axis=-1)}
+
+
+@register_op("box_coder")
+def _box_coder(ins, attrs):
+    # reference: box_coder_op.cc — encode/decode center-size
+    prior, tb = ins["PriorBox"][0], ins["TargetBox"][0]
+    code_type = attrs.get("code_type", "encode_center_size")
+    norm = attrs.get("box_normalized", True)
+    var = ins["PriorBoxVar"][0] if ins.get("PriorBoxVar") else None
+    one = 0.0 if norm else 1.0
+    pw = prior[:, 2] - prior[:, 0] + one
+    ph = prior[:, 3] - prior[:, 1] + one
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    if code_type.startswith("encode"):
+        tw = tb[:, 2] - tb[:, 0] + one
+        th = tb[:, 3] - tb[:, 1] + one
+        tcx = tb[:, 0] + tw * 0.5
+        tcy = tb[:, 1] + th * 0.5
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        dw = jnp.log(tw[:, None] / pw[None, :])
+        dh = jnp.log(th[:, None] / ph[None, :])
+        out = jnp.stack([dx, dy, dw, dh], axis=-1)
+        if var is not None:
+            out = out / var[None, :, :]
+        return {"OutputBox": out}
+    # decode: tb [n, p, 4]
+    d = tb
+    if var is not None:
+        d = d * var[None, :, :]
+    cx = d[..., 0] * pw[None, :] + pcx[None, :]
+    cy = d[..., 1] * ph[None, :] + pcy[None, :]
+    w = jnp.exp(d[..., 2]) * pw[None, :]
+    h = jnp.exp(d[..., 3]) * ph[None, :]
+    return {"OutputBox": jnp.stack(
+        [cx - w * 0.5, cy - h * 0.5, cx + w * 0.5 - one,
+         cy + h * 0.5 - one], axis=-1)}
+
+
+@register_op("prior_box")
+def _prior_box(ins, attrs):
+    inp, image = ins["Input"][0], ins["Image"][0]
+    min_sizes = attrs["min_sizes"]
+    max_sizes = attrs.get("max_sizes", [])
+    ars_in = attrs.get("aspect_ratios", [1.0])
+    flip = attrs.get("flip", False)
+    clip = attrs.get("clip", False)
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    offset = attrs.get("offset", 0.5)
+    step_w = attrs.get("step_w", 0.0)
+    step_h = attrs.get("step_h", 0.0)
+    fh, fw = inp.shape[2], inp.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    sw = step_w or iw / fw
+    sh = step_h or ih / fh
+    ars = [1.0]
+    for ar in ars_in:
+        if all(abs(ar - a) > 1e-6 for a in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    boxes = []
+    for ms in min_sizes:
+        for ar in ars:
+            bw = ms * np.sqrt(ar) / 2.0
+            bh = ms / np.sqrt(ar) / 2.0
+            boxes.append((bw, bh))
+        if max_sizes:
+            mx = max_sizes[min_sizes.index(ms)]
+            s = np.sqrt(ms * mx) / 2.0
+            boxes.append((s, s))
+    num_priors = len(boxes)
+    cx = (jnp.arange(fw) + offset) * sw
+    cy = (jnp.arange(fh) + offset) * sh
+    gy, gx = jnp.meshgrid(cy, cx, indexing="ij")
+    out = []
+    for bw, bh in boxes:
+        out.append(jnp.stack([(gx - bw) / iw, (gy - bh) / ih,
+                              (gx + bw) / iw, (gy + bh) / ih], -1))
+    out = jnp.stack(out, axis=2)  # [fh, fw, np, 4]
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, out.dtype),
+                           out.shape)
+    return {"Boxes": out, "Variances": var}
+
+
+@register_op("density_prior_box")
+def _density_prior_box(ins, attrs):
+    inp, image = ins["Input"][0], ins["Image"][0]
+    fixed_sizes = attrs.get("fixed_sizes", [])
+    fixed_ratios = attrs.get("fixed_ratios", [1.0])
+    densities = attrs.get("densities", [1])
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    offset = attrs.get("offset", 0.5)
+    clip = attrs.get("clip", False)
+    fh, fw = inp.shape[2], inp.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    sw, sh = iw / fw, ih / fh
+    boxes = []
+    for size, density in zip(fixed_sizes, densities):
+        for ratio in fixed_ratios:
+            bw = size * np.sqrt(ratio)
+            bh = size / np.sqrt(ratio)
+            step = size / density
+            for di in range(density):
+                for dj in range(density):
+                    ox = -size / 2.0 + step / 2.0 + dj * step
+                    oy = -size / 2.0 + step / 2.0 + di * step
+                    boxes.append((ox, oy, bw / 2.0, bh / 2.0))
+    cx = (jnp.arange(fw) + offset) * sw
+    cy = (jnp.arange(fh) + offset) * sh
+    gy, gx = jnp.meshgrid(cy, cx, indexing="ij")
+    out = []
+    for ox, oy, bw, bh in boxes:
+        ccx, ccy = gx + ox, gy + oy
+        out.append(jnp.stack([(ccx - bw) / iw, (ccy - bh) / ih,
+                              (ccx + bw) / iw, (ccy + bh) / ih], -1))
+    out = jnp.stack(out, axis=2)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, out.dtype), out.shape)
+    return {"Boxes": out, "Variances": var}
+
+
+@register_op("anchor_generator")
+def _anchor_generator(ins, attrs):
+    inp = ins["Input"][0]
+    anchor_sizes = attrs.get("anchor_sizes", [64.0])
+    ars = attrs.get("aspect_ratios", [1.0])
+    stride = attrs.get("stride", [16.0, 16.0])
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    offset = attrs.get("offset", 0.5)
+    fh, fw = inp.shape[2], inp.shape[3]
+    boxes = []
+    for size in anchor_sizes:
+        area = size * size
+        for ar in ars:
+            w = np.sqrt(area / ar)
+            h = w * ar
+            boxes.append((w / 2.0, h / 2.0))
+    cx = (jnp.arange(fw) + offset) * stride[0]
+    cy = (jnp.arange(fh) + offset) * stride[1]
+    gy, gx = jnp.meshgrid(cy, cx, indexing="ij")
+    out = []
+    for bw, bh in boxes:
+        out.append(jnp.stack([gx - bw, gy - bh, gx + bw, gy + bh], -1))
+    out = jnp.stack(out, axis=2)
+    var = jnp.broadcast_to(jnp.asarray(variances, out.dtype), out.shape)
+    return {"Anchors": out, "Variances": var}
+
+
+@register_op("yolo_box")
+def _yolo_box(ins, attrs):
+    # reference: yolo_box_op.cc
+    x, img_size = ins["X"][0], ins["ImgSize"][0]
+    anchors = attrs["anchors"]
+    class_num = attrs["class_num"]
+    conf_thresh = attrs.get("conf_thresh", 0.01)
+    downsample = attrs.get("downsample_ratio", 32)
+    n, c, h, w = x.shape
+    an_num = len(anchors) // 2
+    x5 = x.reshape(n, an_num, 5 + class_num, h, w)
+    grid_x = jnp.arange(w).reshape(1, 1, 1, w)
+    grid_y = jnp.arange(h).reshape(1, 1, h, 1)
+    pred_x = (jax.nn.sigmoid(x5[:, :, 0]) + grid_x) / w
+    pred_y = (jax.nn.sigmoid(x5[:, :, 1]) + grid_y) / h
+    aw = jnp.asarray(anchors[0::2], x.dtype).reshape(1, an_num, 1, 1)
+    ah = jnp.asarray(anchors[1::2], x.dtype).reshape(1, an_num, 1, 1)
+    input_h = downsample * h
+    input_w = downsample * w
+    pred_w = jnp.exp(x5[:, :, 2]) * aw / input_w
+    pred_h = jnp.exp(x5[:, :, 3]) * ah / input_h
+    conf = jax.nn.sigmoid(x5[:, :, 4])
+    keep = (conf >= conf_thresh).astype(x.dtype)
+    imh = img_size[:, 0].reshape(n, 1, 1, 1).astype(x.dtype)
+    imw = img_size[:, 1].reshape(n, 1, 1, 1).astype(x.dtype)
+    x1 = (pred_x - pred_w / 2.0) * imw
+    y1 = (pred_y - pred_h / 2.0) * imh
+    x2 = (pred_x + pred_w / 2.0) * imw
+    y2 = (pred_y + pred_h / 2.0) * imh
+    boxes = jnp.stack([x1, y1, x2, y2], -1).reshape(n, -1, 4)
+    probs = jax.nn.sigmoid(x5[:, :, 5:]) * (conf * keep)[:, :, None]
+    scores = probs.transpose(0, 1, 3, 4, 2).reshape(n, -1, class_num)
+    return {"Boxes": boxes, "Scores": scores}
+
+
+@register_op("roi_align")
+def _roi_align(ins, attrs):
+    # reference: roi_align_op.cc — average of 4 bilinear samples per bin
+    x, rois = ins["X"][0], ins["ROIs"][0]
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    scale = attrs.get("spatial_scale", 1.0)
+    ratio = attrs.get("sampling_ratio", -1)
+    ratio = 2 if ratio <= 0 else ratio
+    n, c, h, w = x.shape
+    num_rois = rois.shape[0]
+    batch_idx = ins["RoisNum"][0] if ins.get("RoisNum") else None
+
+    x1 = rois[:, 0] * scale
+    y1 = rois[:, 1] * scale
+    x2 = rois[:, 2] * scale
+    y2 = rois[:, 3] * scale
+    rw = jnp.maximum(x2 - x1, 1.0)
+    rh = jnp.maximum(y2 - y1, 1.0)
+    bw = rw / pw
+    bh = rh / ph
+
+    iy = (jnp.arange(ph)[:, None] + (jnp.arange(ratio)[None, :] + 0.5)
+          / ratio).reshape(-1)  # [ph*ratio]
+    ix = (jnp.arange(pw)[:, None] + (jnp.arange(ratio)[None, :] + 0.5)
+          / ratio).reshape(-1)
+    sy = y1[:, None] + bh[:, None] * iy[None, :]  # [R, ph*ratio]
+    sx = x1[:, None] + bw[:, None] * ix[None, :]
+
+    y0f = jnp.floor(sy)
+    x0f = jnp.floor(sx)
+    wy1 = sy - y0f
+    wx1 = sx - x0f
+
+    def gather(yy, xx):
+        yi = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xi = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        # x[0] batch assumed (single image) unless RoisNum given
+        feat = x[0] if batch_idx is None else x[0]
+        return feat[:, yi[:, :, None], xi[:, None, :]]
+
+    v00 = gather(y0f, x0f)
+    v01 = gather(y0f, x0f + 1)
+    v10 = gather(y0f + 1, x0f)
+    v11 = gather(y0f + 1, x0f + 1)
+    wy1e = wy1[None, :, :, None]
+    wx1e = wx1[None, :, None, :]
+    val = (v00 * (1 - wy1e) * (1 - wx1e) + v01 * (1 - wy1e) * wx1e
+           + v10 * wy1e * (1 - wx1e) + v11 * wy1e * wx1e)
+    # [c, R, ph*ratio, pw*ratio] -> bins
+    val = val.reshape(c, num_rois, ph, ratio, pw, ratio)
+    out = jnp.mean(val, axis=(3, 5)).transpose(1, 0, 2, 3)
+    return {"Out": out}
+
+
+@register_op("roi_pool")
+def _roi_pool(ins, attrs):
+    x, rois = ins["X"][0], ins["ROIs"][0]
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    scale = attrs.get("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+    num_rois = rois.shape[0]
+    x1 = jnp.round(rois[:, 0] * scale)
+    y1 = jnp.round(rois[:, 1] * scale)
+    x2 = jnp.round(rois[:, 2] * scale)
+    y2 = jnp.round(rois[:, 3] * scale)
+    rw = jnp.maximum(x2 - x1 + 1, 1.0)
+    rh = jnp.maximum(y2 - y1 + 1, 1.0)
+    # sample a dense grid then max-pool per bin (approximation-free for
+    # integer bin edges when grid covers every cell)
+    gh, gw = ph * 8, pw * 8
+    yy = y1[:, None] + (jnp.arange(gh)[None, :] + 0.5) * rh[:, None] / gh
+    xx = x1[:, None] + (jnp.arange(gw)[None, :] + 0.5) * rw[:, None] / gw
+    yi = jnp.clip(jnp.floor(yy), 0, h - 1).astype(jnp.int32)
+    xi = jnp.clip(jnp.floor(xx), 0, w - 1).astype(jnp.int32)
+    feat = x[0]
+    vals = feat[:, yi[:, :, None], xi[:, None, :]]
+    vals = vals.reshape(c, num_rois, ph, 8, pw, 8)
+    out = jnp.max(vals, axis=(3, 5)).transpose(1, 0, 2, 3)
+    return {"Out": out, "Argmax": jnp.zeros(out.shape, jnp.int64)}
+
+
+@register_op("multiclass_nms", no_jit=True)
+def _multiclass_nms(ins, attrs):
+    # host-side (dynamic output count; reference outputs a LoDTensor)
+    boxes = np.asarray(ins["BBoxes"][0])
+    scores = np.asarray(ins["Scores"][0])
+    score_threshold = attrs.get("score_threshold", 0.0)
+    nms_threshold = attrs.get("nms_threshold", 0.3)
+    nms_top_k = attrs.get("nms_top_k", 400)
+    keep_top_k = attrs.get("keep_top_k", 200)
+    background = attrs.get("background_label", 0)
+    n = boxes.shape[0]
+    results = []
+    for b in range(n):
+        dets = []
+        for cls in range(scores.shape[1]):
+            if cls == background:
+                continue
+            s = scores[b, cls]
+            keep = np.where(s > score_threshold)[0]
+            order = keep[np.argsort(-s[keep])][:nms_top_k]
+            bb = list(boxes[b, order])
+            ss = list(s[order])
+            while bb:
+                b0, s0 = bb.pop(0), ss.pop(0)
+                dets.append([cls, s0] + list(b0))
+                nbb, nss = [], []
+                for bi, si in zip(bb, ss):
+                    x1 = max(b0[0], bi[0])
+                    y1 = max(b0[1], bi[1])
+                    x2 = min(b0[2], bi[2])
+                    y2 = min(b0[3], bi[3])
+                    inter = max(x2 - x1, 0) * max(y2 - y1, 0)
+                    a0 = (b0[2] - b0[0]) * (b0[3] - b0[1])
+                    a1 = (bi[2] - bi[0]) * (bi[3] - bi[1])
+                    iou = inter / max(a0 + a1 - inter, 1e-10)
+                    if iou <= nms_threshold:
+                        nbb.append(bi)
+                        nss.append(si)
+                bb, ss = nbb, nss
+        dets.sort(key=lambda d: -d[1])
+        results.append(np.asarray(dets[:keep_top_k], np.float32).reshape(
+            -1, 6))
+    out = np.concatenate(results, axis=0) if results else \
+        np.zeros((0, 6), np.float32)
+    return {"Out": out}

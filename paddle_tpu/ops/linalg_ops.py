@@ -1,0 +1,196 @@
+"""Linear-algebra and extended math operators.
+
+Reference parity: `paddle/fluid/operators/` — bmm_op, dot_op, kron_op,
+cross_op, trace_op, cholesky_op, inverse_op, matrix_power_op, addmm_op,
+addcmul (contrib), logsumexp (reduce variant), bilinear_tensor_product_op,
+histogram/bincount (2.0), cumprod. MXU note: bmm/addmm/bilinear map to
+dot_general; factorizations lower to XLA's native cholesky/triangular
+solves.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+@register_op("bmm")
+def _bmm(ins, attrs):
+    return {"Out": jnp.matmul(ins["X"][0], ins["Y"][0])}
+
+
+@register_op("dot")
+def _dot(ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": jnp.sum(x * y, axis=-1, keepdims=x.ndim == 1)}
+
+
+@register_op("kron")
+def _kron(ins, attrs):
+    return {"Out": jnp.kron(ins["X"][0], ins["Y"][0])}
+
+
+@register_op("cross")
+def _cross(ins, attrs):
+    axis = attrs.get("dim", attrs.get("axis", 9))
+    x, y = ins["X"][0], ins["Y"][0]
+    if axis == 9:  # reference sentinel: first dim of size 3
+        axis = next(i for i, d in enumerate(x.shape) if d == 3)
+    return {"Out": jnp.cross(x, y, axis=axis)}
+
+
+@register_op("trace")
+def _trace(ins, attrs):
+    x = ins["Input"][0] if ins.get("Input") else ins["X"][0]
+    return {"Out": jnp.trace(x, offset=attrs.get("offset", 0),
+                             axis1=attrs.get("axis1", 0),
+                             axis2=attrs.get("axis2", 1))}
+
+
+@register_op("cholesky")
+def _cholesky(ins, attrs):
+    x = ins["X"][0]
+    upper = attrs.get("upper", False)
+    l = jnp.linalg.cholesky(x)
+    return {"Out": jnp.swapaxes(l, -1, -2) if upper else l}
+
+
+@register_op("inverse")
+def _inverse(ins, attrs):
+    x = ins["Input"][0] if ins.get("Input") else ins["X"][0]
+    return {"Output": jnp.linalg.inv(x)}
+
+
+@register_op("matrix_power")
+def _matrix_power(ins, attrs):
+    x = ins["X"][0]
+    return {"Out": jnp.linalg.matrix_power(x, attrs.get("n", 1))}
+
+
+@register_op("addmm")
+def _addmm(ins, attrs):
+    inp, x, y = ins["Input"][0], ins["X"][0], ins["Y"][0]
+    alpha = attrs.get("Alpha", attrs.get("alpha", 1.0))
+    beta = attrs.get("Beta", attrs.get("beta", 1.0))
+    return {"Out": beta * inp + alpha * (x @ y)}
+
+
+@register_op("addcmul")
+def _addcmul(ins, attrs):
+    inp = ins["Input"][0]
+    t1, t2 = ins["Tensor1"][0], ins["Tensor2"][0]
+    return {"Out": inp + attrs.get("value", 1.0) * t1 * t2}
+
+
+@register_op("logsumexp")
+def _logsumexp(ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", attrs.get("dim", None))
+    keepdim = attrs.get("keepdim", False)
+    if axis in (None, [], ()):
+        axis = tuple(range(x.ndim))
+    elif isinstance(axis, int):
+        axis = (axis,)
+    else:
+        axis = tuple(axis)
+    return {"Out": jax.scipy.special.logsumexp(x, axis=axis,
+                                               keepdims=keepdim)}
+
+
+@register_op("bilinear_tensor_product")
+def _bilinear_tensor_product(ins, attrs):
+    # reference: bilinear_tensor_product_op.cc — out[b,k] = x W_k y^T + b
+    x, y, w = ins["X"][0], ins["Y"][0], ins["Weight"][0]
+    out = jnp.einsum("bi,kij,bj->bk", x, w, y)
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0]
+    return {"Out": out}
+
+
+@register_op("histogram")
+def _histogram(ins, attrs):
+    x = ins["X"][0].reshape(-1)
+    bins = attrs.get("bins", 100)
+    lo, hi = attrs.get("min", 0), attrs.get("max", 0)
+    if lo == 0 and hi == 0:
+        lo, hi = jnp.min(x), jnp.max(x)
+    h, _ = jnp.histogram(x, bins=bins, range=(lo, hi))
+    return {"Out": h.astype(jnp.int64)}
+
+
+@register_op("bincount")
+def _bincount(ins, attrs):
+    x = ins["X"][0].reshape(-1).astype(jnp.int32)
+    minlength = attrs.get("minlength", 0)
+    length = max(minlength, 1)
+    # static-shape bincount: length must come from attrs for jit; the
+    # eager path can size dynamically
+    try:
+        n = int(jnp.max(x)) + 1
+        length = max(length, n)
+    except Exception:  # traced: rely on minlength
+        pass
+    if ins.get("Weights"):
+        w = ins["Weights"][0].reshape(-1)
+        out = jnp.zeros((length,), w.dtype).at[x].add(w)
+    else:
+        out = jnp.zeros((length,), jnp.int64).at[x].add(1)
+    return {"Out": out}
+
+
+@register_op("cumprod")
+def _cumprod(ins, attrs):
+    x = ins["X"][0]
+    dim = attrs.get("dim", attrs.get("axis", -1))
+    return {"Out": jnp.cumprod(x, axis=dim)}
+
+
+@register_op("mv")
+def _mv(ins, attrs):
+    return {"Out": ins["X"][0] @ ins["Vec"][0]}
+
+
+@register_op("outer")
+def _outer(ins, attrs):
+    return {"Out": jnp.outer(ins["X"][0], ins["Y"][0])}
+
+
+@register_op("matmul_transpose")  # helper used by some fused paths
+def _matmul_t(ins, attrs):
+    return {"Out": ins["X"][0] @ jnp.swapaxes(ins["Y"][0], -1, -2)}
+
+
+@register_op("triangular_solve")
+def _triangular_solve(ins, attrs):
+    import jax.scipy.linalg as jsl
+
+    x, y = ins["X"][0], ins["Y"][0]
+    upper = attrs.get("upper", True)
+    transpose = attrs.get("transpose", False)
+    unitriangular = attrs.get("unitriangular", False)
+    return {"Out": jsl.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)}
+
+
+@register_op("cholesky_solve")
+def _cholesky_solve(ins, attrs):
+    import jax.scipy.linalg as jsl
+
+    x, y = ins["X"][0], ins["Y"][0]
+    upper = attrs.get("upper", False)
+    return {"Out": jsl.cho_solve((y, not upper), x)}
+
+
+@register_op("determinant")
+def _determinant(ins, attrs):
+    x = ins["Input"][0] if ins.get("Input") else ins["X"][0]
+    return {"Out": jnp.linalg.det(x)}
+
+
+@register_op("slogdeterminant")
+def _slogdet(ins, attrs):
+    x = ins["Input"][0] if ins.get("Input") else ins["X"][0]
+    sign, logdet = jnp.linalg.slogdet(x)
+    return {"Out": jnp.stack([sign, logdet])}

@@ -1,0 +1,175 @@
+"""Loss operators.
+
+Reference parity: `paddle/fluid/operators/` loss kernels — hinge_loss_op,
+rank_loss_op, margin_rank_loss_op, bpr_loss_op, log_loss_op,
+sigmoid_focal_loss_op, center_loss_op, teacher_student_sigmoid_loss_op,
+cos_sim_op, npair (layer-level), dice (layer-level). Pure jnp; XLA fuses
+these into surrounding computations.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+@register_op("hinge_loss")
+def _hinge_loss(ins, attrs):
+    # reference: hinge_loss_op.cc — labels in {0,1}
+    logits, labels = ins["Logits"][0], ins["Labels"][0]
+    y = 2.0 * labels.astype(logits.dtype) - 1.0
+    return {"Loss": jnp.maximum(0.0, 1.0 - y * logits)}
+
+
+@register_op("rank_loss")
+def _rank_loss(ins, attrs):
+    # reference: rank_loss_op.cc — RankNet pairwise loss
+    label = ins["Label"][0]
+    left, right = ins["Left"][0], ins["Right"][0]
+    d = left - right
+    return {"Out": jnp.logaddexp(0.0, d) - label.astype(d.dtype) * d}
+
+
+@register_op("margin_rank_loss")
+def _margin_rank_loss(ins, attrs):
+    label = ins["Label"][0]
+    x1, x2 = ins["X1"][0], ins["X2"][0]
+    margin = attrs.get("margin", 0.0)
+    out = jnp.maximum(0.0, -label.astype(x1.dtype) * (x1 - x2) + margin)
+    return {"Out": out, "Activated": (out > 0).astype(x1.dtype)}
+
+
+@register_op("bpr_loss")
+def _bpr_loss(ins, attrs):
+    # reference: bpr_loss_op.cc — Bayesian Personalized Ranking
+    x, label = ins["X"][0], ins["Label"][0]
+    n, c = x.shape
+    pos = jnp.take_along_axis(x, label.reshape(n, 1).astype(jnp.int32), 1)
+    d = x - pos  # [n, c]
+    lse = jnp.log1p(jnp.exp(d))
+    mask = jnp.ones((n, c), x.dtype).at[
+        jnp.arange(n), label.reshape(-1).astype(jnp.int32)].set(0.0)
+    loss = jnp.sum(lse * mask, axis=1, keepdims=True) / jnp.maximum(
+        c - 1, 1)
+    return {"Y": loss}
+
+
+@register_op("log_loss")
+def _log_loss(ins, attrs):
+    p, label = ins["Predicted"][0], ins["Labels"][0]
+    eps = attrs.get("epsilon", 1e-4)
+    lf = label.astype(p.dtype)
+    return {"Loss": -lf * jnp.log(p + eps)
+            - (1.0 - lf) * jnp.log(1.0 - p + eps)}
+
+
+@register_op("sigmoid_focal_loss")
+def _sigmoid_focal_loss(ins, attrs):
+    # reference: sigmoid_focal_loss_op.cu — per-class focal loss with
+    # integer labels (0 = background) and fg normalizer
+    x, label = ins["X"][0], ins["Label"][0]
+    fg = ins["FgNum"][0].reshape(()).astype(x.dtype) if ins.get("FgNum") \
+        else jnp.asarray(1.0, x.dtype)
+    gamma = attrs.get("gamma", 2.0)
+    alpha = attrs.get("alpha", 0.25)
+    n, c = x.shape
+    lbl = label.reshape(-1).astype(jnp.int32)
+    target = (lbl[:, None] == (jnp.arange(c)[None, :] + 1)).astype(x.dtype)
+    p = jax.nn.sigmoid(x)
+    ce = jnp.logaddexp(0.0, x) - x * target
+    p_t = p * target + (1 - p) * (1 - target)
+    alpha_t = alpha * target + (1 - alpha) * (1 - target)
+    loss = alpha_t * jnp.power(1 - p_t, gamma) * ce
+    return {"Out": loss / jnp.maximum(fg, 1.0)}
+
+
+@register_op("teacher_student_sigmoid_loss")
+def _ts_sigmoid_loss(ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0]
+    soft_max_up = attrs.get("soft_max_up_bound", 15.0)
+    soft_max_lo = attrs.get("soft_max_lower_bound", -15.0)
+    lf = label.astype(x.dtype)
+    xc = jnp.clip(x, soft_max_lo, soft_max_up)
+    # teacher (soft) part when label in (0,1); student hard part
+    loss = jnp.logaddexp(0.0, xc) - xc * lf
+    return {"Y": loss}
+
+
+@register_op("cos_sim")
+def _cos_sim(ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), -1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), -1, keepdims=True))
+    out = jnp.sum(x * y, -1, keepdims=True) / jnp.maximum(xn * yn, 1e-12)
+    return {"Out": out, "XNorm": xn, "YNorm": yn}
+
+
+@register_op("center_loss")
+def _center_loss(ins, attrs):
+    # reference: center_loss_op.cc — pulls features toward class centers
+    x, label = ins["X"][0], ins["Label"][0]
+    centers = ins["Centers"][0]
+    lr = ins["CenterUpdateRate"][0].reshape(()) if \
+        ins.get("CenterUpdateRate") else jnp.asarray(0.5, x.dtype)
+    alpha = attrs.get("alpha", lr)
+    lbl = label.reshape(-1).astype(jnp.int32)
+    diff = x - centers[lbl]
+    loss = 0.5 * jnp.sum(jnp.square(diff), axis=-1, keepdims=True)
+    if attrs.get("need_update", True):
+        counts = jnp.zeros((centers.shape[0],), x.dtype).at[lbl].add(1.0)
+        upd = jnp.zeros_like(centers).at[lbl].add(diff)
+        centers_out = centers + alpha * upd / (counts[:, None] + 1.0)
+    else:
+        centers_out = centers
+    return {"Loss": loss, "SampleCenterDiff": diff,
+            "CentersOut": centers_out}
+
+
+@register_op("npair_loss")
+def _npair_loss(ins, attrs):
+    anchor, positive = ins["Anchor"][0], ins["Positive"][0]
+    labels = ins["Labels"][0].reshape(-1)
+    l2_reg = attrs.get("l2_reg", 0.002)
+    sim = anchor @ positive.T
+    tgt = (labels[:, None] == labels[None, :]).astype(sim.dtype)
+    tgt = tgt / jnp.sum(tgt, -1, keepdims=True)
+    logp = jax.nn.log_softmax(sim, -1)
+    ce = -jnp.sum(tgt * logp, -1)
+    reg = l2_reg * (jnp.mean(jnp.sum(jnp.square(anchor), -1))
+                    + jnp.mean(jnp.sum(jnp.square(positive), -1))) / 2
+    return {"Out": jnp.mean(ce) + reg}
+
+
+@register_op("dice_loss")
+def _dice_loss(ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    lf = label.astype(x.dtype)
+    reduce_dims = tuple(range(1, x.ndim))
+    inter = 2.0 * jnp.sum(x * lf, reduce_dims)
+    union = jnp.sum(x, reduce_dims) + jnp.sum(lf, reduce_dims)
+    return {"Out": 1.0 - (inter + eps) / (union + eps)}
+
+
+@register_op("mse_loss")
+def _mse_loss(ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": jnp.square(x - y)}
+
+
+@register_op("l1_loss")
+def _l1_loss(ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": jnp.abs(x - y)}
+
+
+@register_op("cross_entropy2")
+def _cross_entropy2(ins, attrs):
+    # reference: cross_entropy_op.cc (soft_label=False index variant 2)
+    x, label = ins["X"][0], ins["Label"][0]
+    lbl = label.reshape(label.shape[:-1]).astype(jnp.int32)
+    p = jnp.take_along_axis(x, lbl[..., None], -1)
+    xent = -jnp.log(jnp.maximum(p, 1e-20))
+    return {"Y": xent, "XShape": jnp.zeros_like(x),
+            "MatchX": p}

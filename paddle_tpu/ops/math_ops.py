@@ -307,3 +307,84 @@ def _update_loss_scaling(ins, attrs):
     outs = [jnp.where(found_inf, jnp.zeros_like(x), x) for x in ins["X"]]
     return {"Out": outs, "LossScaling": new_scale,
             "OutGoodSteps": new_good, "OutBadSteps": new_bad}
+
+
+@register_op("lgamma")
+def _lgamma(ins, attrs):
+    import jax.scipy.special as jsp
+
+    return {"Out": jsp.gammaln(ins["X"][0])}
+
+
+@register_op("digamma")
+def _digamma(ins, attrs):
+    import jax.scipy.special as jsp
+
+    return {"Out": jsp.digamma(ins["X"][0])}
+
+
+@register_op("erfinv")
+def _erfinv(ins, attrs):
+    import jax.scipy.special as jsp
+
+    return {"Out": jsp.erfinv(ins["X"][0])}
+
+
+@register_op("lerp")
+def _lerp(ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    w = ins["Weight"][0] if ins.get("Weight") else attrs.get("weight", 0.5)
+    return {"Out": x + w * (y - x)}
+
+
+@register_op("frac")
+def _frac(ins, attrs):
+    x = ins["X"][0]
+    return {"Out": x - jnp.trunc(x)}
+
+
+@register_op("trunc")
+def _trunc(ins, attrs):
+    return {"Out": jnp.trunc(ins["X"][0])}
+
+
+@register_op("take")
+def _take(ins, attrs):
+    x, idx = ins["X"][0], ins["Index"][0]
+    return {"Out": jnp.take(x.reshape(-1), idx.astype(jnp.int32))}
+
+
+@register_op("put_along_axis")
+def _put_along_axis(ins, attrs):
+    x, idx, v = ins["Input"][0], ins["Index"][0], ins["Value"][0]
+    axis = attrs.get("Axis", attrs.get("axis", 0))
+    reduce = attrs.get("Reduce", attrs.get("reduce", "assign"))
+    idx = idx.astype(jnp.int32)
+    return {"Result": _scatter_along(x, idx, v, axis,
+                                     add=reduce == "add")}
+
+
+def _scatter_along(x, idx, v, axis, add):
+    # build full index grids for scatter along one axis
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape],
+                         indexing="ij")
+    grids[axis] = idx
+    vv = jnp.broadcast_to(v, idx.shape)
+    if add:
+        return x.at[tuple(grids)].add(vv)
+    return x.at[tuple(grids)].set(vv)
+
+
+@register_op("masked_fill")
+def _masked_fill(ins, attrs):
+    x, mask = ins["X"][0], ins["Mask"][0]
+    value = attrs.get("value", 0.0)
+    return {"Out": jnp.where(mask.astype(bool), value, x)}
+
+
+@register_op("searchsorted")
+def _searchsorted(ins, attrs):
+    sorted_seq, values = ins["SortedSequence"][0], ins["Values"][0]
+    side = "right" if attrs.get("right", False) else "left"
+    return {"Out": jnp.searchsorted(sorted_seq.reshape(-1), values,
+                                    side=side).astype(jnp.int64)}

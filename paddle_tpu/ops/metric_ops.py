@@ -3,6 +3,7 @@
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from .registry import register_op
@@ -70,3 +71,44 @@ def _mean_iou(ins, attrs):
     mean = jnp.sum(iou) / jnp.maximum(jnp.sum(valid), 1.0)
     return {"OutMeanIou": mean.reshape((1,)), "OutWrong": pred_cnt - inter,
             "OutCorrect": inter}
+
+
+@register_op("precision_recall")
+def _precision_recall(ins, attrs):
+    """Reference: operators/metrics/precision_recall_op.cc — per-class
+    macro/micro precision/recall/F1 with streaming state accumulation."""
+    cls_num = attrs["class_number"]
+    preds = ins["MaxProbs"][1] if len(ins.get("MaxProbs", [])) > 1 else \
+        ins["Indices"][0]
+    labels = ins["Labels"][0]
+    prev = ins["StatesInfo"][0] if ins.get("StatesInfo") else \
+        jnp.zeros((cls_num, 4), jnp.float32)
+    p = preds.reshape(-1).astype(jnp.int32)
+    l = labels.reshape(-1).astype(jnp.int32)
+    correct = (p == l)
+    onehot_p = jax.nn.one_hot(p, cls_num, dtype=jnp.float32)
+    onehot_l = jax.nn.one_hot(l, cls_num, dtype=jnp.float32)
+    tp = jnp.sum(onehot_p * correct[:, None].astype(jnp.float32), 0)
+    fp = jnp.sum(onehot_p, 0) - tp
+    fn = jnp.sum(onehot_l, 0) - tp
+    tn = p.shape[0] - tp - fp - fn
+    batch_states = jnp.stack([tp, fp, tn, fn], axis=1)
+    acc_states = prev + batch_states
+
+    def metrics(states):
+        tp_, fp_, tn_, fn_ = (states[:, 0], states[:, 1], states[:, 2],
+                              states[:, 3])
+        prec = jnp.where(tp_ + fp_ > 0, tp_ / (tp_ + fp_ + 1e-12), 0.0)
+        rec = jnp.where(tp_ + fn_ > 0, tp_ / (tp_ + fn_ + 1e-12), 0.0)
+        f1 = jnp.where(prec + rec > 0,
+                       2 * prec * rec / (prec + rec + 1e-12), 0.0)
+        macro = jnp.stack([jnp.mean(prec), jnp.mean(rec), jnp.mean(f1)])
+        tps, fps, fns = jnp.sum(tp_), jnp.sum(fp_), jnp.sum(fn_)
+        mp = jnp.where(tps + fps > 0, tps / (tps + fps + 1e-12), 0.0)
+        mr = jnp.where(tps + fns > 0, tps / (tps + fns + 1e-12), 0.0)
+        mf = jnp.where(mp + mr > 0, 2 * mp * mr / (mp + mr + 1e-12), 0.0)
+        return jnp.concatenate([macro, jnp.stack([mp, mr, mf])])
+
+    return {"BatchMetrics": metrics(batch_states),
+            "AccumMetrics": metrics(acc_states),
+            "AccumStatesInfo": acc_states}

@@ -649,3 +649,424 @@ def _gru_seq(ins, attrs):
     if reverse:
         ys = ys[::-1]
     return {"Out": jnp.swapaxes(ys, 0, 1), "LastH": h_last}
+
+
+# ---------------------------------------------------------------------------
+# extended activations (reference: operators/activation_op.cc registrations)
+# ---------------------------------------------------------------------------
+
+@register_op("selu")
+def _selu(ins, attrs):
+    x = ins["X"][0]
+    scale = attrs.get("scale", 1.0507009873554805)
+    alpha = attrs.get("alpha", 1.6732632423543772)
+    return {"Out": scale * jnp.where(x > 0, x,
+                                     alpha * (jnp.exp(x) - 1.0))}
+
+
+@register_op("softshrink")
+def _softshrink(ins, attrs):
+    x = ins["X"][0]
+    l = attrs.get("lambda", attrs.get("threshold", 0.5))
+    return {"Out": jnp.where(x > l, x - l, jnp.where(x < -l, x + l, 0.0))}
+
+
+@register_op("hard_shrink")
+def _hard_shrink(ins, attrs):
+    x = ins["X"][0]
+    t = attrs.get("threshold", 0.5)
+    return {"Out": jnp.where(jnp.abs(x) > t, x, 0.0)}
+
+
+@register_op("tanh_shrink")
+def _tanh_shrink(ins, attrs):
+    x = ins["X"][0]
+    return {"Out": x - jnp.tanh(x)}
+
+
+@register_op("brelu")
+def _brelu(ins, attrs):
+    x = ins["X"][0]
+    t_min = attrs.get("t_min", 0.0)
+    t_max = attrs.get("t_max", 24.0)
+    return {"Out": jnp.clip(x, t_min, t_max)}
+
+
+@register_op("soft_relu")
+def _soft_relu(ins, attrs):
+    x = ins["X"][0]
+    t = attrs.get("threshold", 40.0)
+    return {"Out": jnp.log1p(jnp.exp(jnp.clip(x, -t, t)))}
+
+
+@register_op("expm1")
+def _expm1(ins, attrs):
+    return {"Out": jnp.expm1(ins["X"][0])}
+
+
+@register_op("tan")
+def _tan(ins, attrs):
+    return {"Out": jnp.tan(ins["X"][0])}
+
+
+@register_op("acosh")
+def _acosh(ins, attrs):
+    return {"Out": jnp.arccosh(ins["X"][0])}
+
+
+@register_op("asinh")
+def _asinh(ins, attrs):
+    return {"Out": jnp.arcsinh(ins["X"][0])}
+
+
+@register_op("atanh")
+def _atanh(ins, attrs):
+    return {"Out": jnp.arctanh(ins["X"][0])}
+
+
+@register_op("maxout")
+def _maxout(ins, attrs):
+    # reference: maxout_op.cc — NCHW channel groups
+    x = ins["X"][0]
+    groups = attrs["groups"]
+    axis = attrs.get("axis", 1)
+    c = x.shape[axis]
+    new_shape = (x.shape[:axis] + (c // groups, groups)
+                 + x.shape[axis + 1:])
+    return {"Out": jnp.max(x.reshape(new_shape), axis=axis + 1)}
+
+
+@register_op("logit")
+def _logit(ins, attrs):
+    x = ins["X"][0]
+    eps = attrs.get("eps", 1e-6)
+    xc = jnp.clip(x, eps, 1.0 - eps)
+    return {"Out": jnp.log(xc / (1.0 - xc))}
+
+
+@register_op("celu")
+def _celu(ins, attrs):
+    x = ins["X"][0]
+    alpha = attrs.get("alpha", 1.0)
+    return {"Out": jnp.where(x > 0, x,
+                             alpha * (jnp.exp(x / alpha) - 1.0))}
+
+
+# ---------------------------------------------------------------------------
+# extended norm / conv / pool (reference: operators/*norm*, conv3d, pool3d,
+# lrn_op, spectral_norm_op, data_norm_op, row_conv_op)
+# ---------------------------------------------------------------------------
+
+@register_op("norm")
+def _norm(ins, attrs):
+    # l2_normalize (reference: norm_op.cc)
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-10)
+    n = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {"Out": x / n, "Norm": n}
+
+
+@register_op("lrn")
+def _lrn(ins, attrs):
+    # reference: lrn_op.cc — local response norm across channels (NCHW)
+    x = ins["X"][0]
+    n = attrs.get("n", 5)
+    k = attrs.get("k", 2.0)
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    sq = jnp.square(x)
+    half = n // 2
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = sum(pad[:, i:i + x.shape[1]] for i in range(n))
+    mid = k + alpha * acc
+    return {"Out": x / jnp.power(mid, beta), "MidOut": mid}
+
+
+@register_op("spectral_norm")
+def _spectral_norm(ins, attrs):
+    # reference: spectral_norm_op.cc — power-iteration weight norm
+    w, u, v = ins["Weight"][0], ins["U"][0], ins["V"][0]
+    dim = attrs.get("dim", 0)
+    power_iters = attrs.get("power_iters", 1)
+    eps = attrs.get("eps", 1e-12)
+    wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+    for _ in range(max(power_iters, 0)):
+        v = wm.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = wm @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    sigma = u @ wm @ v
+    return {"Out": w / sigma}
+
+
+@register_op("data_norm")
+def _data_norm(ins, attrs):
+    # reference: data_norm_op.cc — normalization by accumulated stats
+    x = ins["X"][0]
+    size = ins["BatchSize"][0]
+    sums = ins["BatchSum"][0]
+    sqs = ins["BatchSquareSum"][0]
+    eps = attrs.get("epsilon", 1e-4)
+    mean = sums / size
+    scale = jnp.sqrt(size / (sqs - size * jnp.square(mean) + eps))
+    y = (x - mean) * scale
+    return {"Y": y, "Means": jnp.broadcast_to(mean, x.shape),
+            "Scales": jnp.broadcast_to(scale, x.shape)}
+
+
+@register_op("row_conv")
+def _row_conv(ins, attrs):
+    # reference: row_conv_op.cc — lookahead row convolution [B, T, D]
+    x, filt = ins["X"][0], ins["Filter"][0]
+    future = filt.shape[0]
+    pad = jnp.pad(x, ((0, 0), (0, future - 1), (0, 0)))
+    out = sum(pad[:, i:i + x.shape[1]] * filt[i] for i in range(future))
+    return {"Out": out}
+
+
+@register_op("conv3d")
+def _conv3d(ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    stride = attrs.get("strides", [1, 1, 1])
+    pad = attrs.get("paddings", [0, 0, 0])
+    dil = attrs.get("dilations", [1, 1, 1])
+    groups = attrs.get("groups", 1)
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dil, feature_group_count=groups,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    return {"Output": out}
+
+
+@register_op("pool3d")
+def _pool3d(ins, attrs):
+    x = ins["X"][0]
+    ksize = attrs.get("ksize", [2, 2, 2])
+    stride = attrs.get("strides", ksize)
+    pad = attrs.get("paddings", [0, 0, 0])
+    ptype = attrs.get("pooling_type", "max")
+    dims = (1, 1) + tuple(ksize)
+    strides = (1, 1) + tuple(stride)
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        out = jax.lax.reduce_window(x, init, jax.lax.max, dims, strides,
+                                    pads)
+    else:
+        s = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides,
+                                  pads)
+        cnt = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
+                                    dims, strides, pads)
+        out = s / cnt
+    return {"Out": out}
+
+
+@register_op("max_pool2d_with_index")
+def _max_pool2d_with_index(ins, attrs):
+    x = ins["X"][0]
+    ksize = attrs.get("ksize", [2, 2])
+    stride = attrs.get("strides", ksize)
+    pad = attrs.get("paddings", [0, 0])
+    n, c, h, w = x.shape
+    kh, kw = ksize
+    xp = jnp.pad(x, ((0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1])),
+                 constant_values=-jnp.inf)
+    oh = (h + 2 * pad[0] - kh) // stride[0] + 1
+    ow = (w + 2 * pad[1] - kw) // stride[1] + 1
+    # unfold windows: [n, c, oh, ow, kh*kw]
+    idx_h = (jnp.arange(oh)[:, None] * stride[0]
+             + jnp.arange(kh)[None, :])  # [oh, kh]
+    idx_w = (jnp.arange(ow)[:, None] * stride[1]
+             + jnp.arange(kw)[None, :])  # [ow, kw]
+    wins = xp[:, :, idx_h[:, :, None, None], idx_w[None, None, :, :]]
+    wins = wins.transpose(0, 1, 2, 4, 3, 5).reshape(n, c, oh, ow, kh * kw)
+    out = jnp.max(wins, -1)
+    amax = jnp.argmax(wins, -1)
+    # flat index in the UNPADDED input (reference semantics)
+    rh = amax // kw + idx_h[:, 0][None, None, :, None] - pad[0]
+    rw = amax % kw + idx_w[:, 0][None, None, None, :] - pad[1]
+    flat = (rh * w + rw).astype(jnp.int64)
+    return {"Out": out, "Mask": flat}
+
+
+@register_op("conv3d_transpose")
+def _conv3d_transpose(ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    stride = attrs.get("strides", [1, 1, 1])
+    pad = attrs.get("paddings", [0, 0, 0])
+    out = jax.lax.conv_transpose(
+        x, jnp.swapaxes(w, 0, 1), strides=stride,
+        padding=[(p, p) for p in pad],
+        dimension_numbers=("NCDHW", "IODHW", "NCDHW"),
+        transpose_kernel=True)
+    return {"Output": out}
+
+
+@register_op("affine_channel")
+def _affine_channel(ins, attrs):
+    x = ins["X"][0]
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    layout = attrs.get("data_layout", "NCHW")
+    if layout == "NCHW":
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+    else:
+        shape = (1,) * (x.ndim - 1) + (-1,)
+    return {"Out": x * scale.reshape(shape) + bias.reshape(shape)}
+
+
+@register_op("fsp")
+def _fsp(ins, attrs):
+    # reference: fsp_op.cc — flow of solution procedure matrix (distill)
+    x, y = ins["X"][0], ins["Y"][0]
+    n, cx = x.shape[0], x.shape[1]
+    cy = y.shape[1]
+    hw = x.shape[2] * x.shape[3]
+    xf = x.reshape(n, cx, hw)
+    yf = y.reshape(n, cy, hw)
+    return {"Out": jnp.einsum("nch,ndh->ncd", xf, yf) / hw}
+
+
+@register_op("pixel_shuffle")
+def _pixel_shuffle(ins, attrs):
+    x = ins["X"][0]
+    r = attrs.get("upscale_factor", 1)
+    n, c, h, w = x.shape
+    oc = c // (r * r)
+    out = x.reshape(n, oc, r, r, h, w).transpose(0, 1, 4, 2, 5, 3)
+    return {"Out": out.reshape(n, oc, h * r, w * r)}
+
+
+@register_op("shuffle_channel")
+def _shuffle_channel(ins, attrs):
+    x = ins["X"][0]
+    group = attrs.get("group", 1)
+    n, c, h, w = x.shape
+    out = x.reshape(n, group, c // group, h, w).transpose(0, 2, 1, 3, 4)
+    return {"Out": out.reshape(n, c, h, w)}
+
+
+@register_op("space_to_depth")
+def _space_to_depth(ins, attrs):
+    x = ins["X"][0]
+    b = attrs.get("blocksize", 1)
+    n, c, h, w = x.shape
+    out = x.reshape(n, c, h // b, b, w // b, b)
+    out = out.transpose(0, 3, 5, 1, 2, 4)
+    return {"Out": out.reshape(n, c * b * b, h // b, w // b)}
+
+
+@register_op("temporal_shift")
+def _temporal_shift(ins, attrs):
+    # reference: temporal_shift_op.cc — shift 1/4 channels +/-1 in time
+    x = ins["X"][0]
+    seg = attrs.get("seg_num", 1)
+    ratio = attrs.get("shift_ratio", 0.25)
+    nt, c, h, w = x.shape
+    n = nt // seg
+    xr = x.reshape(n, seg, c, h, w)
+    c1 = int(c * ratio)
+    c2 = int(c * 2 * ratio)
+    fwd = jnp.concatenate([xr[:, 1:, :c1], jnp.zeros_like(xr[:, :1, :c1])],
+                          axis=1)
+    back = jnp.concatenate([jnp.zeros_like(xr[:, :1, c1:c2]),
+                            xr[:, :-1, c1:c2]], axis=1)
+    keep = xr[:, :, c2:]
+    out = jnp.concatenate([fwd, back, keep], axis=2)
+    return {"Out": out.reshape(nt, c, h, w)}
+
+
+@register_op("grid_sampler")
+def _grid_sampler(ins, attrs):
+    # reference: grid_sampler_op.cc — bilinear sampling, align_corners
+    x, grid = ins["X"][0], ins["Grid"][0]
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1.0) * (w - 1) / 2.0
+    gy = (grid[..., 1] + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    x1, y1 = x0 + 1, y0 + 1
+    wa = (x1 - gx) * (y1 - gy)
+    wb = (x1 - gx) * (gy - y0)
+    wc = (gx - x0) * (y1 - gy)
+    wd = (gx - x0) * (gy - y0)
+
+    def sample(yy, xx):
+        yi = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xi = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        valid = ((yy >= 0) & (yy <= h - 1) & (xx >= 0)
+                 & (xx <= w - 1)).astype(x.dtype)
+        ni = jnp.arange(n)[:, None, None]
+        v = x[ni, :, yi, xi]  # [n, gh, gw, c]
+        return v * valid[..., None]
+
+    out = (sample(y0, x0) * wa[..., None] + sample(y1, x0) * wb[..., None]
+           + sample(y0, x1) * wc[..., None]
+           + sample(y1, x1) * wd[..., None])
+    return {"Output": out.transpose(0, 3, 1, 2)}
+
+
+@register_op("affine_grid")
+def _affine_grid(ins, attrs):
+    theta = ins["Theta"][0]
+    out_shape = attrs.get("output_shape")
+    if ins.get("OutputShape"):
+        try:
+            out_shape = [int(v) for v in ins["OutputShape"][0]]
+        except Exception:  # traced under jit: static attr required
+            pass
+    n, _, h, w = out_shape
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], -1).reshape(1, h * w, 3)
+    grid = base @ jnp.swapaxes(theta, 1, 2)  # [n, h*w, 2]
+    return {"Output": grid.reshape(theta.shape[0], h, w, 2)}
+
+
+@register_op("unfold")
+def _unfold(ins, attrs):
+    # reference: unfold_op.cc (im2col); out [N, C*kh*kw, L]
+    x = ins["X"][0]
+    k = attrs["kernel_sizes"]
+    s = attrs.get("strides", [1, 1])
+    p = attrs.get("paddings", [0, 0, 0, 0])
+    d = attrs.get("dilations", [1, 1])
+    n, c, h, w = x.shape
+    if len(p) == 2:
+        p = [p[0], p[1], p[0], p[1]]
+    xp = jnp.pad(x, ((0, 0), (0, 0), (p[0], p[2]), (p[1], p[3])))
+    kh, kw = k
+    oh = (xp.shape[2] - (d[0] * (kh - 1) + 1)) // s[0] + 1
+    ow = (xp.shape[3] - (d[1] * (kw - 1) + 1)) // s[1] + 1
+    ih = jnp.arange(oh)[:, None] * s[0] + jnp.arange(kh)[None, :] * d[0]
+    iw = jnp.arange(ow)[:, None] * s[1] + jnp.arange(kw)[None, :] * d[1]
+    cols = xp[:, :, ih[:, :, None, None], iw[None, None, :, :]]
+    # [n, c, oh, kh, ow, kw] -> [n, c*kh*kw, oh*ow]
+    cols = cols.transpose(0, 1, 3, 5, 2, 4).reshape(n, c * kh * kw,
+                                                    oh * ow)
+    return {"Y": cols}
+
+
+@register_op("im2sequence")
+def _im2sequence(ins, attrs):
+    # reference: im2sequence_op.cc — image patches to sequence rows
+    x = ins["X"][0]
+    k = attrs["kernels"]
+    s = attrs.get("strides", [1, 1])
+    p = attrs.get("paddings", [0, 0, 0, 0])
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (p[0], p[2]), (p[1], p[3])))
+    kh, kw = k
+    oh = (xp.shape[2] - kh) // s[0] + 1
+    ow = (xp.shape[3] - kw) // s[1] + 1
+    ih = jnp.arange(oh)[:, None] * s[0] + jnp.arange(kh)[None, :]
+    iw = jnp.arange(ow)[:, None] * s[1] + jnp.arange(kw)[None, :]
+    patches = xp[:, :, ih[:, :, None, None], iw[None, None, :, :]]
+    # [n, c, oh, kh, ow, kw] -> [n*oh*ow, c*kh*kw]
+    patches = patches.transpose(0, 2, 4, 1, 3, 5).reshape(
+        n * oh * ow, c * kh * kw)
+    return {"Out": patches}

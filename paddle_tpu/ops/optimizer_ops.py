@@ -258,3 +258,30 @@ def _lookahead_step(ins, attrs):
     p2 = jnp.where(do, slow2, pf)
     return {"ParamOut": p2.astype(p.dtype),
             "SlowParamOut": slow2.astype(slow.dtype)}
+
+
+@register_op("proximal_gd")
+def _proximal_gd(ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    lr = _lr(ins)
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    prox = p.astype(jnp.float32) - lr * g.astype(jnp.float32)
+    out = jnp.sign(prox) * jnp.maximum(
+        jnp.abs(prox) - lr * l1, 0.0) / (1.0 + lr * l2)
+    return {"ParamOut": out.astype(p.dtype)}
+
+
+@register_op("proximal_adagrad")
+def _proximal_adagrad(ins, attrs):
+    p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    lr = _lr(ins)
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    gf = g.astype(jnp.float32)
+    m_out = m + jnp.square(gf)
+    alr = lr / jnp.sqrt(m_out)
+    prox = p.astype(jnp.float32) - alr * gf
+    out = jnp.sign(prox) * jnp.maximum(
+        jnp.abs(prox) - alr * l1, 0.0) / (1.0 + alr * l2)
+    return {"ParamOut": out.astype(p.dtype), "MomentOut": m_out}

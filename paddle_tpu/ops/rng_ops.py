@@ -97,3 +97,43 @@ def _sampling_id(ins, attrs):
     logits = jnp.log(jnp.maximum(x, 1e-30))
     out = jax.random.categorical(attrs["_rng_key"], logits, axis=-1)
     return {"Out": out.astype(jnp.int64)}
+
+
+@register_op("exponential", needs_rng=True)
+def _exponential(ins, attrs):
+    import jax as _jax
+
+    x = ins["X"][0]
+    lam = attrs.get("lambda", 1.0)
+    u = _jax.random.uniform(attrs["_rng_key"], x.shape,
+                            minval=1e-7, maxval=1.0)
+    return {"Out": (-jnp.log(u) / lam).astype(x.dtype)}
+
+
+@register_op("poisson", needs_rng=True)
+def _poisson(ins, attrs):
+    import jax as _jax
+
+    x = ins["X"][0]
+    return {"Out": _jax.random.poisson(
+        attrs["_rng_key"], x.astype(jnp.float32)).astype(x.dtype)}
+
+
+@register_op("gumbel_softmax", needs_rng=True)
+def _gumbel_softmax(ins, attrs):
+    import jax as _jax
+
+    x = ins["X"][0]
+    temperature = attrs.get("temperature", 1.0)
+    hard = attrs.get("hard", False)
+    axis = attrs.get("axis", -1)
+    g = _jax.random.gumbel(attrs["_rng_key"], x.shape, x.dtype)
+    y = _jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        onehot = jnp.where(
+            jnp.arange(y.shape[axis]).reshape(
+                [-1 if i == (axis % y.ndim) else 1
+                 for i in range(y.ndim)]) == idx, 1.0, 0.0)
+        y = onehot + y - _jax.lax.stop_gradient(y)
+    return {"Out": y}

@@ -118,3 +118,130 @@ def _sequence_reverse(ins, attrs):
     return {"Y": jnp.take_along_axis(
         x, rev_idx[..., None].astype(jnp.int32), axis=1)
         if x.ndim == 3 else jnp.take_along_axis(x, rev_idx, axis=1)}
+
+
+@register_op("sequence_pad")
+def _sequence_pad(ins, attrs):
+    # padded-representation identity + Length passthrough (reference:
+    # sequence_pad_op.cc converts LoD->padded; here data is already
+    # padded, so this materializes the pad value + emits lengths)
+    x = ins["X"][0]
+    m = _mask(x, ins)
+    pad_value = ins["PadValue"][0].reshape(()) if ins.get("PadValue") \
+        else jnp.asarray(0, x.dtype)
+    if m is None:
+        length = jnp.full((x.shape[0],), x.shape[1], jnp.int64)
+        return {"Out": x, "Length": length}
+    mm = m.reshape(m.shape + (1,) * (x.ndim - 2))
+    out = jnp.where(mm, x, pad_value.astype(x.dtype))
+    return {"Out": out,
+            "Length": jnp.sum(m.astype(jnp.int64), axis=1)}
+
+
+@register_op("sequence_unpad")
+def _sequence_unpad(ins, attrs):
+    # keeps the padded layout (static shapes); zeroes the tail
+    x = ins["X"][0]
+    length = ins["Length"][0].reshape((-1,))
+    t = x.shape[1]
+    m = jnp.arange(t)[None, :] < length[:, None]
+    mm = m.reshape(m.shape + (1,) * (x.ndim - 2))
+    return {"Out": jnp.where(mm, x, 0)}
+
+
+@register_op("sequence_slice")
+def _sequence_slice(ins, attrs):
+    x = ins["X"][0]
+    offset = ins["Offset"][0].reshape((-1,))
+    length = ins["Length"][0].reshape((-1,))
+    t = x.shape[1]
+    idx = jnp.arange(t)[None, :]
+    sel = (idx >= offset[:, None]) & (idx < (offset + length)[:, None])
+    # gather each row's slice to the front, zero-pad the tail
+    order = jnp.argsort(~sel, axis=1, stable=True)
+    g = jnp.take_along_axis(
+        x, order.reshape(order.shape + (1,) * (x.ndim - 2)), axis=1)
+    keep = jnp.arange(t)[None, :] < length[:, None]
+    return {"Out": jnp.where(
+        keep.reshape(keep.shape + (1,) * (x.ndim - 2)), g, 0)}
+
+
+@register_op("sequence_erase")
+def _sequence_erase(ins, attrs):
+    # tokens in `tokens` are removed; survivors compact to the front
+    x = ins["X"][0]
+    tokens = jnp.asarray(attrs.get("tokens", []), x.dtype)
+    keep = jnp.logical_not(
+        jnp.any(x[..., None] == tokens[None, None, :], axis=-1)) \
+        if tokens.size else jnp.ones_like(x, bool)
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    g = jnp.take_along_axis(x, order, axis=1)
+    count = jnp.sum(keep, axis=1)
+    mask = jnp.arange(x.shape[1])[None, :] < count[:, None]
+    return {"Out": jnp.where(mask, g, 0),
+            "Length": count.astype(jnp.int64)}
+
+
+@register_op("sequence_expand_as")
+def _sequence_expand_as(ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    reps = y.shape[0] // x.shape[0]
+    return {"Out": jnp.repeat(x, reps, axis=0)}
+
+
+@register_op("sequence_enumerate")
+def _sequence_enumerate(ins, attrs):
+    x = ins["X"][0]
+    win = attrs.get("win_size", 2)
+    pad_value = attrs.get("pad_value", 0)
+    t = x.shape[-1] if x.ndim > 1 else x.shape[0]
+    x2 = x.reshape(-1, t)
+    cols = []
+    for i in range(win):
+        shifted = jnp.concatenate(
+            [x2[:, i:], jnp.full((x2.shape[0], i), pad_value, x.dtype)],
+            axis=1)
+        cols.append(shifted)
+    return {"Out": jnp.stack(cols, axis=-1)}
+
+
+@register_op("sequence_conv")
+def _sequence_conv(ins, attrs):
+    # reference: sequence_conv_op.cc — context-window conv over time
+    x, filt = ins["X"][0], ins["Filter"][0]
+    ctx_len = attrs.get("contextLength", 3)
+    ctx_start = attrs.get("contextStart", -(ctx_len // 2))
+    b, t, d = x.shape
+    cols = []
+    for i in range(ctx_len):
+        off = ctx_start + i
+        if off < 0:
+            shifted = jnp.concatenate(
+                [jnp.zeros((b, -off, d), x.dtype), x[:, :t + off]], axis=1)
+        elif off > 0:
+            shifted = jnp.concatenate(
+                [x[:, off:], jnp.zeros((b, off, d), x.dtype)], axis=1)
+        else:
+            shifted = x
+        cols.append(shifted)
+    ctx = jnp.concatenate(cols, axis=-1)  # [b, t, ctx_len*d]
+    return {"Out": ctx @ filt}
+
+
+@register_op("sequence_scatter")
+def _sequence_scatter(ins, attrs):
+    x, ids, updates = ins["X"][0], ins["Ids"][0], ins["Updates"][0]
+    return {"Out": x.at[ids.reshape(-1).astype(jnp.int32)].add(
+        updates.reshape((-1,) + x.shape[1:]))}
+
+
+@register_op("lod_reset")
+def _lod_reset(ins, attrs):
+    # LoD is host metadata in this framework; data passes through
+    return {"Out": ins["X"][0]}
+
+
+@register_op("sequence_number_count")
+def _sequence_number_count(ins, attrs):
+    x = ins["X"][0]
+    return {"Out": jnp.sum(jnp.ones_like(x, jnp.int64))}

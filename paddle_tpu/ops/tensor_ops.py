@@ -477,3 +477,260 @@ def _meshgrid(ins, attrs):
 def _increment(ins, attrs):
     x = ins["X"][0]
     return {"Out": x + jnp.asarray(attrs.get("step", 1.0), x.dtype)}
+
+
+# ---------------------------------------------------------------------------
+# extended manipulation ops (reference: operators/ pad_constant_like_op,
+# crop_op, shard_index_op, index_sample_op, scatter_nd, unbind, unique_v2,
+# diag/diag_embed, reverse, partial_*)
+# ---------------------------------------------------------------------------
+
+@register_op("pad_constant_like")
+def _pad_constant_like(ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    pad_value = attrs.get("pad_value", 0.0)
+    pads = [(0, int(xd - yd)) for xd, yd in zip(x.shape, y.shape)]
+    return {"Out": jnp.pad(y, pads, constant_values=pad_value)}
+
+
+@register_op("crop")
+def _crop(ins, attrs):
+    x = ins["X"][0]
+    offsets = attrs.get("offsets")
+    if ins.get("Offsets"):
+        offsets = [int(v) for v in ins["Offsets"][0]]
+    shape = attrs.get("shape")
+    if ins.get("Y"):
+        shape = ins["Y"][0].shape
+    starts = offsets or [0] * x.ndim
+    return {"Out": jax.lax.slice(
+        x, starts, [s + d for s, d in zip(starts, shape)])}
+
+
+@register_op("crop_tensor")
+def _crop_tensor(ins, attrs):
+    return _crop(ins, attrs)
+
+
+@register_op("shard_index")
+def _shard_index(ins, attrs):
+    x = ins["X"][0]
+    index_num = attrs["index_num"]
+    nshards = attrs["nshards"]
+    shard_id = attrs["shard_id"]
+    ignore_value = attrs.get("ignore_value", -1)
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (x // shard_size) == shard_id
+    return {"Out": jnp.where(in_shard, x % shard_size, ignore_value)}
+
+
+@register_op("index_sample")
+def _index_sample(ins, attrs):
+    x, index = ins["X"][0], ins["Index"][0]
+    return {"Out": jnp.take_along_axis(x, index.astype(jnp.int32),
+                                       axis=1)}
+
+
+@register_op("scatter_nd")
+def _scatter_nd(ins, attrs):
+    index, updates = ins["Index"][0], ins["Updates"][0]
+    shape = attrs["shape"]
+    zeros = jnp.zeros(shape, updates.dtype)
+    return {"Out": zeros.at[tuple(jnp.moveaxis(
+        index.astype(jnp.int32), -1, 0))].add(updates)}
+
+
+@register_op("unbind")
+def _unbind(ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    n = x.shape[axis]
+    return {"Out": [jnp.squeeze(s, axis)
+                    for s in jnp.split(x, n, axis=axis)]}
+
+
+@register_op("diag")
+def _diag(ins, attrs):
+    x = ins["Diagonal"][0] if ins.get("Diagonal") else ins["X"][0]
+    return {"Out": jnp.diag(x.reshape(-1))}
+
+
+@register_op("diag_embed")
+def _diag_embed(ins, attrs):
+    x = ins["Input"][0] if ins.get("Input") else ins["X"][0]
+    offset = attrs.get("offset", 0)
+    d1 = attrs.get("dim1", -2)
+    d2 = attrs.get("dim2", -1)
+    n = x.shape[-1] + abs(offset)
+    out = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    i = jnp.arange(x.shape[-1])
+    r = i + max(-offset, 0)
+    c = i + max(offset, 0)
+    out = out.at[..., r, c].set(x)
+    if (d1, d2) not in ((-2, -1), (x.ndim - 1, x.ndim)):
+        out = jnp.moveaxis(out, (-2, -1), (d1, d2))
+    return {"Out": out}
+
+
+@register_op("diagonal")
+def _diagonal(ins, attrs):
+    x = ins["Input"][0] if ins.get("Input") else ins["X"][0]
+    return {"Out": jnp.diagonal(x, offset=attrs.get("offset", 0),
+                                axis1=attrs.get("axis1", 0),
+                                axis2=attrs.get("axis2", 1))}
+
+
+@register_op("reverse")
+def _reverse(ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", [0])
+    if isinstance(axis, int):
+        axis = [axis]
+    return {"Out": jnp.flip(x, axis=tuple(axis))}
+
+
+@register_op("partial_sum")
+def _partial_sum(ins, attrs):
+    start = attrs.get("start_index", 0)
+    length = attrs.get("length", -1)
+    xs = ins["X"]
+    end = start + length if length > 0 else xs[0].shape[1]
+    return {"Out": sum(x[:, start:end] for x in xs)}
+
+
+@register_op("partial_concat")
+def _partial_concat(ins, attrs):
+    start = attrs.get("start_index", 0)
+    length = attrs.get("length", -1)
+    xs = ins["X"]
+    end = start + length if length > 0 else xs[0].shape[1]
+    return {"Out": jnp.concatenate([x[:, start:end] for x in xs],
+                                   axis=1)}
+
+
+@register_op("unique_with_counts", no_jit=True)
+def _unique_with_counts(ins, attrs):
+    x = np.asarray(ins["X"][0]).reshape(-1)
+    out, index, inverse, counts = np.unique(
+        x, return_index=True, return_inverse=True, return_counts=True)
+    return {"Out": out, "Index": inverse.astype(np.int64),
+            "Count": counts.astype(np.int64)}
+
+
+@register_op("size")
+def _size(ins, attrs):
+    x = ins["Input"][0] if ins.get("Input") else ins["X"][0]
+    return {"Out": jnp.asarray(int(np.prod(x.shape)), jnp.int64)}
+
+
+@register_op("allclose")
+def _allclose(ins, attrs):
+    x, y = ins["Input"][0], ins["Other"][0]
+    rtol = float(attrs.get("rtol", 1e-5))
+    atol = float(attrs.get("atol", 1e-8))
+    return {"Out": jnp.allclose(x, y, rtol=rtol, atol=atol,
+                                equal_nan=attrs.get("equal_nan", False))}
+
+
+@register_op("isclose")
+def _isclose(ins, attrs):
+    x, y = ins["Input"][0], ins["Other"][0]
+    rtol = float(attrs.get("rtol", 1e-5))
+    atol = float(attrs.get("atol", 1e-8))
+    return {"Out": jnp.isclose(x, y, rtol=rtol, atol=atol,
+                               equal_nan=attrs.get("equal_nan", False))}
+
+
+@register_op("logspace")
+def _logspace(ins, attrs):
+    start = ins["Start"][0].reshape(()) if ins.get("Start") else \
+        attrs["start"]
+    stop = ins["Stop"][0].reshape(()) if ins.get("Stop") else \
+        attrs["stop"]
+    try:
+        num = int(ins["Num"][0]) if ins.get("Num") else attrs["num"]
+    except Exception:  # traced under jit: static attr required
+        num = attrs["num"]
+    base = attrs.get("base", 10.0)
+    return {"Out": jnp.power(base, jnp.linspace(start, stop, num))}
+
+
+@register_op("split_ids")
+def _split_ids(ins, attrs):
+    # PS helper (reference: operators/distributed_ops/split_ids_op.cc):
+    # route ids to N shards by modulo
+    ids = ins["Ids"][0].reshape(-1)
+    n = len(ins.get("Out_shapes", [])) or attrs.get("num_shards", 1)
+    outs = []
+    for shard in range(n):
+        mask = (ids % n) == shard
+        order = jnp.argsort(~mask, stable=True)
+        g = ids[order]
+        cnt = jnp.sum(mask)
+        outs.append(jnp.where(jnp.arange(g.shape[0]) < cnt, g, 0))
+    return {"Out": outs}
+
+
+@register_op("merge_ids")
+def _merge_ids(ins, attrs):
+    rows = jnp.concatenate([r.reshape(-1) for r in ins["Ids"]])
+    vals = jnp.concatenate([v for v in ins["X"]], axis=0)
+    order = jnp.argsort(rows, stable=True)
+    return {"Out": vals[order]}
+
+
+@register_op("numel")
+def _numel(ins, attrs):
+    x = ins["Input"][0] if ins.get("Input") else ins["X"][0]
+    return {"Out": jnp.asarray(int(np.prod(x.shape)), jnp.int64)}
+
+
+@register_op("rank")  # helper: ndim as scalar
+def _rank(ins, attrs):
+    x = ins["X"][0]
+    return {"Out": jnp.asarray(x.ndim, jnp.int32)}
+
+
+@register_op("pad3d")
+def _pad3d(ins, attrs):
+    x = ins["X"][0]
+    p = attrs.get("paddings", [0] * 6)
+    mode = attrs.get("mode", "constant")
+    value = attrs.get("value", 0.0)
+    pads = ((0, 0), (0, 0), (p[4], p[5]), (p[2], p[3]), (p[0], p[1]))
+    if mode == "constant":
+        return {"Out": jnp.pad(x, pads, constant_values=value)}
+    jmode = {"reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    return {"Out": jnp.pad(x, pads, mode=jmode)}
+
+
+@register_op("broadcast_to")
+def _broadcast_to(ins, attrs):
+    x = ins["X"][0]
+    return {"Out": jnp.broadcast_to(x, attrs["shape"])}
+
+
+@register_op("expand_as")
+def _expand_as(ins, attrs):
+    x, y = ins["X"][0], ins["target_tensor"][0] if \
+        ins.get("target_tensor") else ins["Y"][0]
+    return {"Out": jnp.broadcast_to(x, y.shape)}
+
+
+@register_op("gaussian_random_batch_size_like", needs_rng=True)
+def _gaussian_random_bsl(ins, attrs):
+    import jax as _jax
+
+    ref = ins["Input"][0]
+    shape = list(attrs.get("shape", ref.shape))
+    shape[attrs.get("input_dim_idx", 0)] = ref.shape[
+        attrs.get("input_dim_idx", 0)]
+    mean = attrs.get("mean", 0.0)
+    std = attrs.get("std", 1.0)
+    from ..core.types import to_numpy_dtype
+
+    dtype = to_numpy_dtype(attrs.get("dtype", "float32"))
+    out = mean + std * _jax.random.normal(attrs["_rng_key"],
+                                          tuple(shape))
+    return {"Out": out.astype(dtype)}

@@ -1,0 +1,96 @@
+"""layers.dynamic_decode + BeamSearchDecoder (reference: layers/rnn.py)
+and layers.distributions (reference: layers/distributions.py)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework, dygraph
+
+
+def test_dynamic_decode_beam_search():
+    """Deterministic cell: logits independent of state, so the best beam
+    must repeat the argmax token until max steps."""
+    from paddle_tpu.fluid.layers.rnn_decode import (
+        BeamSearchDecoder, dynamic_decode, RNNCell)
+
+    with dygraph.guard():
+        vocab = 6
+        logits_row = np.log(np.array(
+            [0.01, 0.02, 0.6, 0.17, 0.1, 0.1], "float32"))
+
+        class FixedCell(RNNCell):
+            def call(self, inputs, states):
+                b = inputs.shape[0]
+                out = dygraph.to_variable(
+                    np.tile(logits_row, (b, 1)))
+                return out, states
+
+        dec = BeamSearchDecoder(FixedCell(), start_token=1, end_token=0,
+                                beam_size=2,
+                                embedding_fn=lambda ids:
+                                fluid.layers.one_hot(
+                                    fluid.layers.unsqueeze(ids, [1]),
+                                    depth=vocab),
+                                output_fn=None)
+        init = dygraph.to_variable(np.zeros((2, vocab), "float32"))
+        outs, scores = dynamic_decode(dec, inits=init, max_step_num=4)
+        ids = np.asarray(outs._val if hasattr(outs, "_val") else outs)
+        assert ids.shape == (2, 4, 2)
+        # best beam = token 2 at every step for every batch row
+        np.testing.assert_array_equal(ids[:, :, 0], 2)
+
+
+def test_distributions_normal_categorical():
+    from paddle_tpu.fluid.layers.distributions import (
+        Normal, Uniform, Categorical)
+
+    with dygraph.guard():
+        n1 = Normal(0.0, 1.0)
+        n2 = Normal(1.0, 2.0)
+        ent = np.asarray(n1.entropy()._val)
+        np.testing.assert_allclose(
+            ent, 0.5 + 0.5 * np.log(2 * np.pi), rtol=1e-5)
+        kl = np.asarray(n1.kl_divergence(n2)._val)
+        expect = np.log(2.0) + (0.25 + 0.25 * 1.0) - 0.5
+        # KL(N(0,1)||N(1,2)) = log(2) + (1+1)/(2*4) - 1/2
+        np.testing.assert_allclose(kl, np.log(2.0) + 2.0 / 8.0 - 0.5,
+                                   rtol=1e-5)
+        lp = np.asarray(n1.log_prob(
+            dygraph.to_variable(np.array([0.0], "float32")))._val)
+        np.testing.assert_allclose(lp, -0.5 * np.log(2 * np.pi),
+                                   rtol=1e-5)
+
+        u = Uniform(0.0, 2.0)
+        np.testing.assert_allclose(np.asarray(u.entropy()._val),
+                                   np.log(2.0), rtol=1e-5)
+
+        logits = np.log(np.array([[0.5, 0.25, 0.25]], "float32"))
+        c = Categorical(dygraph.to_variable(logits))
+        ent = np.asarray(c.entropy()._val)
+        expect = -(0.5 * np.log(0.5) + 2 * 0.25 * np.log(0.25))
+        np.testing.assert_allclose(ent, [expect], rtol=1e-4)
+
+        c2 = Categorical(dygraph.to_variable(
+            np.log(np.array([[1 / 3, 1 / 3, 1 / 3]], "float32"))))
+        kl = np.asarray(c.kl_divergence(c2)._val)
+        assert kl[0] > 0
+
+
+def test_distributions_sample_static():
+    """Sampling works in the static graph via the seeded RNG ops."""
+    from paddle_tpu.fluid.layers.distributions import Normal
+
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = startup.random_seed = 3
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            d = Normal(0.0, 1.0)
+            s = d.sample([1000])
+    exe = fluid.Executor(fluid.CPUPlace())
+    from paddle_tpu.core.scope import Scope
+
+    scope = Scope()
+    exe.run(startup, scope=scope)
+    out = exe.run(main, feed={}, fetch_list=[s], scope=scope)
+    arr = np.asarray(out[0])
+    assert arr.shape == (1000,)
+    assert abs(arr.mean()) < 0.2 and 0.8 < arr.std() < 1.2

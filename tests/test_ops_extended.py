@@ -1,0 +1,485 @@
+"""Golden + grad tests for the round-2 ops sprint (sequence, loss,
+linalg, detection, beam search, manipulation, activations) — OpTest
+pattern per SURVEY.md §4.1."""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+# -- losses -----------------------------------------------------------------
+
+class TestHingeLoss(OpTest):
+    def setup(self):
+        r = np.random.RandomState(0)
+        self.op_type = "hinge_loss"
+        logits = r.randn(8, 1).astype("float32")
+        labels = r.randint(0, 2, (8, 1)).astype("float32")
+        self.inputs = {"Logits": logits, "Labels": labels}
+        self.outputs = {"Loss": np.maximum(
+            0.0, 1.0 - (2 * labels - 1) * logits)}
+
+    def test(self):
+        self.setup()
+        self.check_output()
+        self.check_grad(["Logits"], "Loss")
+
+
+class TestRankLoss(OpTest):
+    def test(self):
+        r = np.random.RandomState(1)
+        self.op_type = "rank_loss"
+        label = r.randint(0, 2, (6, 1)).astype("float32")
+        left = r.randn(6, 1).astype("float32")
+        right = r.randn(6, 1).astype("float32")
+        self.inputs = {"Label": label, "Left": left, "Right": right}
+        d = left - right
+        self.outputs = {"Out": np.log1p(np.exp(d)) - label * d}
+        self.check_output()
+        self.check_grad(["Left", "Right"], "Out")
+
+
+class TestLogLoss(OpTest):
+    def test(self):
+        r = np.random.RandomState(2)
+        self.op_type = "log_loss"
+        p = r.uniform(0.1, 0.9, (8, 1)).astype("float32")
+        label = r.randint(0, 2, (8, 1)).astype("float32")
+        self.inputs = {"Predicted": p, "Labels": label}
+        eps = 1e-4
+        self.outputs = {"Loss": -label * np.log(p + eps)
+                        - (1 - label) * np.log(1 - p + eps)}
+        self.check_output()
+        self.check_grad(["Predicted"], "Loss")
+
+
+class TestCosSim(OpTest):
+    def test(self):
+        r = np.random.RandomState(3)
+        self.op_type = "cos_sim"
+        x = r.randn(4, 8).astype("float32")
+        y = r.randn(4, 8).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        xn = np.linalg.norm(x, axis=1, keepdims=True)
+        yn = np.linalg.norm(y, axis=1, keepdims=True)
+        self.outputs = {"Out": np.sum(x * y, 1, keepdims=True)
+                        / (xn * yn), "XNorm": xn, "YNorm": yn}
+        self.check_output()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestDiceLoss(OpTest):
+    def test(self):
+        r = np.random.RandomState(4)
+        self.op_type = "dice_loss"
+        x = r.uniform(0.1, 0.9, (4, 10)).astype("float32")
+        label = (r.rand(4, 10) > 0.5).astype("float32")
+        self.inputs = {"X": x, "Label": label}
+        eps = 1e-5
+        inter = 2 * np.sum(x * label, 1)
+        union = np.sum(x, 1) + np.sum(label, 1)
+        self.outputs = {"Out": 1.0 - (inter + eps) / (union + eps)}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+# -- linalg -----------------------------------------------------------------
+
+class TestBmm(OpTest):
+    def test(self):
+        r = np.random.RandomState(5)
+        self.op_type = "bmm"
+        x = r.randn(3, 4, 5).astype("float32")
+        y = r.randn(3, 5, 6).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x @ y}
+        self.check_output()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestKron(OpTest):
+    def test(self):
+        r = np.random.RandomState(6)
+        self.op_type = "kron"
+        x = r.randn(2, 3).astype("float32")
+        y = r.randn(3, 2).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": np.kron(x, y)}
+        self.check_output()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestTrace(OpTest):
+    def test(self):
+        r = np.random.RandomState(7)
+        self.op_type = "trace"
+        x = r.randn(4, 4).astype("float32")
+        self.inputs = {"Input": x}
+        self.outputs = {"Out": np.trace(x)}
+        self.check_output()
+        self.check_grad(["Input"], "Out")
+
+
+class TestCholeskyInverse(OpTest):
+    def test(self):
+        r = np.random.RandomState(8)
+        a = r.randn(4, 4).astype("float32")
+        spd = a @ a.T + 4 * np.eye(4, dtype="float32")
+        self.op_type = "cholesky"
+        self.inputs = {"X": spd}
+        self.outputs = {"Out": np.linalg.cholesky(spd)}
+        self.check_output(atol=1e-4)
+
+        self.op_type = "inverse"
+        self.inputs = {"Input": spd}
+        self.outputs = {"Output": np.linalg.inv(spd)}
+        self.check_output(atol=1e-4)
+
+
+class TestAddmmLogsumexp(OpTest):
+    def test(self):
+        r = np.random.RandomState(9)
+        self.op_type = "addmm"
+        inp = r.randn(3, 5).astype("float32")
+        x = r.randn(3, 4).astype("float32")
+        y = r.randn(4, 5).astype("float32")
+        self.inputs = {"Input": inp, "X": x, "Y": y}
+        self.attrs = {"Alpha": 2.0, "Beta": 0.5}
+        self.outputs = {"Out": 0.5 * inp + 2.0 * (x @ y)}
+        self.check_output()
+        self.check_grad(["X", "Y", "Input"], "Out")
+
+        self.op_type = "logsumexp"
+        x = r.randn(4, 6).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"axis": [1], "keepdim": False}
+        self.outputs = {"Out": np.log(np.sum(np.exp(x), axis=1))}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestBilinearTensorProduct(OpTest):
+    def test(self):
+        r = np.random.RandomState(10)
+        self.op_type = "bilinear_tensor_product"
+        x = r.randn(3, 4).astype("float32")
+        y = r.randn(3, 5).astype("float32")
+        w = r.randn(6, 4, 5).astype("float32")
+        b = r.randn(1, 6).astype("float32")
+        self.inputs = {"X": x, "Y": y, "Weight": w, "Bias": b}
+        self.outputs = {"Out": np.einsum("bi,kij,bj->bk", x, w, y) + b}
+        self.check_output(atol=1e-4)
+        self.check_grad(["X", "Y", "Weight"], "Out")
+
+
+# -- sequence ---------------------------------------------------------------
+
+class TestSequencePadUnpad(OpTest):
+    def test(self):
+        r = np.random.RandomState(11)
+        x = r.randn(3, 5, 2).astype("float32")
+        length = np.array([2, 5, 3], "int64")
+        self.op_type = "sequence_pad"
+        self.inputs = {"X": x, "Length": length,
+                       "PadValue": np.array([9.0], "float32")}
+        expect = x.copy()
+        for i, l in enumerate(length):
+            expect[i, l:] = 9.0
+        self.outputs = {"Out": expect, "Length": length}
+        self.check_output()
+
+        self.op_type = "sequence_unpad"
+        self.inputs = {"X": x, "Length": length}
+        expect = x.copy()
+        for i, l in enumerate(length):
+            expect[i, l:] = 0.0
+        self.outputs = {"Out": expect}
+        self.check_output()
+
+
+class TestSequenceErase(OpTest):
+    def test(self):
+        self.op_type = "sequence_erase"
+        x = np.array([[1, 2, 3, 2, 5]], "int64")
+        self.inputs = {"X": x}
+        self.attrs = {"tokens": [2]}
+        self.outputs = {"Out": np.array([[1, 3, 5, 0, 0]], "int64"),
+                        "Length": np.array([3], "int64")}
+        self.check_output()
+
+
+class TestSequenceConv(OpTest):
+    def test(self):
+        r = np.random.RandomState(12)
+        self.op_type = "sequence_conv"
+        x = r.randn(2, 6, 4).astype("float32")
+        filt = r.randn(12, 8).astype("float32")
+        self.inputs = {"X": x, "Filter": filt}
+        self.attrs = {"contextLength": 3, "contextStart": -1}
+        # golden: shifted concat then matmul
+        cols = []
+        for off in (-1, 0, 1):
+            s = np.zeros_like(x)
+            if off < 0:
+                s[:, -off:] = x[:, :off]
+            elif off > 0:
+                s[:, :-off] = x[:, off:]
+            else:
+                s = x
+            cols.append(s)
+        ctx = np.concatenate(cols, -1)
+        self.outputs = {"Out": ctx @ filt}
+        self.check_output(atol=1e-5)
+        self.check_grad(["X", "Filter"], "Out")
+
+
+# -- detection --------------------------------------------------------------
+
+class TestIouSimilarity(OpTest):
+    def test(self):
+        self.op_type = "iou_similarity"
+        x = np.array([[0, 0, 10, 10], [5, 5, 15, 15]], "float32")
+        y = np.array([[0, 0, 10, 10]], "float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": np.array([[1.0], [25.0 / 175.0]],
+                                        "float32")}
+        self.check_output()
+
+
+class TestBoxCoderRoundTrip(OpTest):
+    def test(self):
+        import paddle_tpu.ops as ops_lib
+        import jax.numpy as jnp
+
+        prior = np.array([[0, 0, 10, 10], [10, 10, 30, 30]], "float32")
+        target = np.array([[1, 1, 9, 9], [12, 8, 28, 32]], "float32")
+        enc = ops_lib.run_op(
+            "box_coder",
+            {"PriorBox": [jnp.asarray(prior)],
+             "TargetBox": [jnp.asarray(target)]},
+            {"code_type": "encode_center_size",
+             "box_normalized": True})["OutputBox"][0]
+        # decode expects [n, p, 4] deltas aligned per prior
+        deltas = np.stack([np.asarray(enc)[i, i] for i in range(2)])
+        dec = ops_lib.run_op(
+            "box_coder",
+            {"PriorBox": [jnp.asarray(prior)],
+             "TargetBox": [jnp.asarray(deltas[:, None, :].repeat(
+                 2, axis=1))]},
+            {"code_type": "decode_center_size",
+             "box_normalized": True})["OutputBox"][0]
+        got = np.stack([np.asarray(dec)[i, i] for i in range(2)])
+        np.testing.assert_allclose(got, target, rtol=1e-5, atol=1e-4)
+
+
+class TestYoloBoxShapes(OpTest):
+    def test(self):
+        r = np.random.RandomState(13)
+        self.op_type = "yolo_box"
+        x = r.randn(1, 3 * 7, 4, 4).astype("float32")
+        img = np.array([[128, 128]], "int32")
+        self.inputs = {"X": x, "ImgSize": img}
+        self.attrs = {"anchors": [10, 13, 16, 30, 33, 23],
+                      "class_num": 2, "conf_thresh": 0.0,
+                      "downsample_ratio": 32}
+        outs = self._run_forward()
+        assert np.asarray(outs["Boxes"][0]).shape == (1, 48, 4)
+        assert np.asarray(outs["Scores"][0]).shape == (1, 48, 2)
+
+
+class TestRoiAlign(OpTest):
+    def test(self):
+        self.op_type = "roi_align"
+        x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+        rois = np.array([[0, 0, 3, 3]], "float32")
+        self.inputs = {"X": x, "ROIs": rois}
+        self.attrs = {"pooled_height": 2, "pooled_width": 2,
+                      "spatial_scale": 1.0, "sampling_ratio": 2}
+        outs = self._run_forward()
+        got = np.asarray(outs["Out"][0])
+        assert got.shape == (1, 1, 2, 2)
+        # average over the ROI quadrants of a linear ramp
+        assert got[0, 0, 0, 0] < got[0, 0, 0, 1] < got[0, 0, 1, 1]
+
+
+class TestMulticlassNMS(OpTest):
+    def test(self):
+        self.op_type = "multiclass_nms"
+        boxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10, 10],
+                           [20, 20, 30, 30]]], "float32")
+        scores = np.array([[[0.0, 0.9, 0.8],
+                            [0.0, 0.05, 0.9]]], "float32").transpose(
+                                0, 1, 2)
+        # scores layout [N, class, M]
+        scores = np.array([[[0.9, 0.85, 0.1],
+                            [0.1, 0.05, 0.9]]], "float32")
+        self.inputs = {"BBoxes": boxes, "Scores": scores}
+        self.attrs = {"score_threshold": 0.3, "nms_threshold": 0.5,
+                      "background_label": -1, "keep_top_k": 10}
+        outs = self._run_forward()
+        got = np.asarray(outs["Out"][0])
+        # cls0: boxes 0,1 overlap (IoU 0.9) -> box1 suppressed, box2
+        # under threshold; cls1: box2 kept -> 2 detections total
+        assert got.shape == (2, 6), got
+        assert got[0][1] >= got[1][1]  # sorted by score desc
+
+
+# -- beam search ------------------------------------------------------------
+
+class TestBeamSearchStep(OpTest):
+    def test(self):
+        import jax.numpy as jnp
+        import paddle_tpu.ops as ops_lib
+
+        pre_ids = np.array([[1, 2]], "int64")
+        pre_scores = np.array([[0.0, -1.0]], "float32")
+        # beam 0 candidates better than beam 1
+        scores = np.log(np.array(
+            [[[0.7, 0.2, 0.1], [0.1, 0.1, 0.8]]], "float32"))
+        outs = ops_lib.run_op(
+            "beam_search",
+            {"pre_ids": [jnp.asarray(pre_ids)],
+             "pre_scores": [jnp.asarray(pre_scores)],
+             "scores": [jnp.asarray(scores)]},
+            {"beam_size": 2, "end_id": 0})
+        sel = np.asarray(outs["selected_ids"][0])
+        par = np.asarray(outs["parent_idx"][0])
+        # best: beam0 token0 (0.0 + log .7); second: beam1 token2
+        assert sel.shape == (1, 2)
+        assert par[0, 0] == 0 and sel[0, 0] == 0
+        assert par[0, 1] in (0, 1)
+
+
+class TestGatherTree(OpTest):
+    def test(self):
+        self.op_type = "gather_tree"
+        ids = np.array([[[2, 3]], [[4, 5]], [[6, 7]]], "int64")
+        parents = np.array([[[0, 0]], [[1, 0]], [[0, 1]]], "int64")
+        self.inputs = {"Ids": ids, "Parents": parents}
+        # backtrack: t2 beam0 <- parent 0 at t2 -> t1 beam0's parent=1
+        out = self._run_forward()
+        got = np.asarray(out["Out"][0])
+        assert got.shape == (3, 1, 2)
+        np.testing.assert_array_equal(got[2], [[6, 7]])
+        np.testing.assert_array_equal(got[1], [[4, 5]])
+        np.testing.assert_array_equal(got[0], [[3, 2]])
+
+
+# -- manipulation / activations --------------------------------------------
+
+class TestManipulationOps(OpTest):
+    def test_shard_index(self):
+        self.op_type = "shard_index"
+        x = np.array([[1], [6], [12], [19]], "int64")
+        self.inputs = {"X": x}
+        self.attrs = {"index_num": 20, "nshards": 2, "shard_id": 0,
+                      "ignore_value": -1}
+        self.outputs = {"Out": np.array([[1], [6], [-1], [-1]], "int64")}
+        self.check_output()
+
+    def test_index_sample(self):
+        r = np.random.RandomState(14)
+        self.op_type = "index_sample"
+        x = r.randn(3, 5).astype("float32")
+        idx = np.array([[0, 2], [1, 3], [4, 4]], "int32")
+        self.inputs = {"X": x, "Index": idx}
+        self.outputs = {"Out": np.take_along_axis(x, idx, 1)}
+        self.attrs = {}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+    def test_pixel_shuffle(self):
+        r = np.random.RandomState(15)
+        self.op_type = "pixel_shuffle"
+        x = r.randn(1, 8, 2, 2).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"upscale_factor": 2}
+        out = self._run_forward()
+        assert np.asarray(out["Out"][0]).shape == (1, 2, 4, 4)
+
+    def test_unfold(self):
+        self.op_type = "unfold"
+        x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+        self.inputs = {"X": x}
+        self.attrs = {"kernel_sizes": [2, 2], "strides": [2, 2],
+                      "paddings": [0, 0], "dilations": [1, 1]}
+        out = np.asarray(self._run_forward()["Y"][0])
+        assert out.shape == (1, 4, 4)
+        np.testing.assert_array_equal(out[0, :, 0], [0, 1, 4, 5])
+
+    def test_maxout(self):
+        r = np.random.RandomState(16)
+        self.op_type = "maxout"
+        x = r.randn(2, 6, 3, 3).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"groups": 2, "axis": 1}
+        expect = x.reshape(2, 3, 2, 3, 3).max(axis=2)
+        self.outputs = {"Out": expect}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+    def test_selu_grad(self):
+        r = np.random.RandomState(17)
+        self.op_type = "selu"
+        x = r.randn(4, 5).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {}
+        scale, alpha = 1.0507009873554805, 1.6732632423543772
+        self.outputs = {"Out": scale * np.where(
+            x > 0, x, alpha * (np.exp(x) - 1))}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+    def test_lrn(self):
+        r = np.random.RandomState(18)
+        self.op_type = "lrn"
+        x = r.rand(2, 8, 3, 3).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"n": 5, "k": 2.0, "alpha": 1e-4, "beta": 0.75}
+        out = self._run_forward()
+        sq = np.square(x)
+        pad = np.pad(sq, ((0, 0), (2, 2), (0, 0), (0, 0)))
+        acc = sum(pad[:, i:i + 8] for i in range(5))
+        expect = x / np.power(2.0 + 1e-4 * acc, 0.75)
+        np.testing.assert_allclose(np.asarray(out["Out"][0]), expect,
+                                   rtol=1e-5)
+
+    def test_put_along_axis(self):
+        self.op_type = "put_along_axis"
+        x = np.zeros((2, 3), "float32")
+        idx = np.array([[1], [2]], "int64")
+        v = np.array([[5.0], [7.0]], "float32")
+        self.inputs = {"Input": x, "Index": idx, "Value": v}
+        self.attrs = {"Axis": 1, "Reduce": "assign"}
+        out = np.asarray(self._run_forward()["Result"][0])
+        np.testing.assert_array_equal(
+            out, [[0, 5, 0], [0, 0, 7]])
+
+
+class TestPrecisionRecall(OpTest):
+    def test(self):
+        self.op_type = "precision_recall"
+        preds = np.array([0, 1, 1, 2, 2, 0], "int32").reshape(-1, 1)
+        labels = np.array([0, 1, 0, 2, 1, 0], "int32").reshape(-1, 1)
+        self.inputs = {"Indices": preds, "Labels": labels}
+        self.attrs = {"class_number": 3}
+        outs = self._run_forward()
+        batch = np.asarray(outs["BatchMetrics"][0])
+        # micro precision == accuracy == 4/6
+        np.testing.assert_allclose(batch[3], 4.0 / 6.0, rtol=1e-5)
+
+
+class TestProximalOps(OpTest):
+    def test(self):
+        r = np.random.RandomState(19)
+        self.op_type = "proximal_gd"
+        p = r.randn(5).astype("float32")
+        g = r.randn(5).astype("float32")
+        lr = np.array([0.1], "float32")
+        self.inputs = {"Param": p, "Grad": g, "LearningRate": lr}
+        self.attrs = {"l1": 0.01, "l2": 0.01}
+        prox = p - 0.1 * g
+        expect = np.sign(prox) * np.maximum(
+            np.abs(prox) - 0.1 * 0.01, 0) / (1 + 0.1 * 0.01)
+        self.outputs = {"ParamOut": expect}
+        self.check_output()

@@ -1,0 +1,67 @@
+"""Profiler chrome-trace export + per-op summary (reference:
+tools/timeline.py:32, profiler.proto) and fleet 2.0 meta-optimizer
+composition (reference: fleet/base/strategy_compiler.py)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework, profiler
+
+
+def test_chrome_trace_export(tmp_path):
+    profiler.reset_profiler()
+    with profiler.profiler(sorted_key="total",
+                           profile_path=str(tmp_path)):
+        with profiler.RecordEvent("step"):
+            with profiler.RecordEvent("forward"):
+                np.dot(np.ones((64, 64)), np.ones((64, 64)))
+            with profiler.RecordEvent("backward"):
+                pass
+    trace = os.path.join(str(tmp_path), "paddle_tpu_trace.json")
+    assert os.path.exists(trace)
+    data = json.load(open(trace))
+    names = [e["name"] for e in data["traceEvents"]]
+    assert "step" in names and "forward" in names
+    for e in data["traceEvents"]:
+        assert e["ph"] == "X" and "ts" in e and "dur" in e
+
+    rows = profiler.profiler_summary_rows()
+    byname = {r[0]: r for r in rows}
+    assert byname["step"][1] == 1  # calls
+    assert byname["step"][2] >= byname["forward"][2]  # total ms ordering
+
+
+def test_meta_optimizer_composition():
+    from paddle_tpu import fleet as fleet_mod
+    from paddle_tpu.fleet.meta_optimizers import compose
+
+    st = fleet_mod.DistributedStrategy()
+    st.recompute = True
+    st.recompute_configs = {"checkpoints": ["x"]}
+    st.gradient_merge = True
+    st.gradient_merge_configs = {"k_steps": 2, "avg": True}
+    st.amp = True
+    st.amp_configs = {"use_dynamic_loss_scaling": False}
+    opt, applied = compose(st, fluid.optimizer.AdamOptimizer(1e-3))
+    assert applied == ["recompute", "gradient_merge", "amp"], applied
+    # composition order: amp outermost, then gradient_merge, recompute
+    inner1 = opt._optimizer if hasattr(opt, "_optimizer") else \
+        opt.inner_optimizer
+    assert type(opt).__name__ == "OptimizerWithMixedPrecision"
+
+
+def test_meta_optimizer_lamb_swap():
+    from paddle_tpu import fleet as fleet_mod
+    from paddle_tpu.fleet.meta_optimizers import compose
+
+    st = fleet_mod.DistributedStrategy()
+    st.lamb = True
+    base = fluid.optimizer.AdamOptimizer(2e-3, beta1=0.8)
+    opt, applied = compose(st, base)
+    assert applied == ["lamb"]
+    assert type(opt).__name__ == "LambOptimizer"
+    assert opt._beta1 == 0.8
+    assert opt._learning_rate == 2e-3
