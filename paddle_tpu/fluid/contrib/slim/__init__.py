@@ -1,0 +1,4 @@
+"""fluid.contrib.slim — model compression (reference:
+`python/paddle/fluid/contrib/slim/`). Quantization (QAT + PTQ) is
+implemented; pruning/NAS/distillation are descoped per SURVEY.md §7.9."""
+from . import quantization  # noqa: F401

@@ -1,0 +1,6 @@
+from .quantization_pass import (  # noqa: F401
+    QuantizationTransformPass, QuantizationFreezePass,
+)
+from .post_training_quantization import (  # noqa: F401
+    PostTrainingQuantization,
+)

@@ -1,0 +1,135 @@
+"""QAT program rewrite.
+
+Reference parity: `contrib/slim/quantization/quantization_pass.py` —
+QuantizationTransformPass inserts fake_quantize/dequantize ops on the
+weights and activations of quantizable ops (conv2d, mul, matmul, ...);
+QuantizationFreezePass converts a trained QAT program for int8 inference.
+TPU-native: the fake-quant ops carry straight-through gradients for free
+(ops/quant_ops.py), and the whole QAT step still lowers to ONE jitted XLA
+computation — no separate quant kernels to schedule.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ....framework import Operator
+from .... import framework
+
+
+_QUANTIZABLE = ("conv2d", "depthwise_conv2d", "mul", "matmul",
+                "matmul_v2")
+_WEIGHT_SLOTS = {"conv2d": "Filter", "depthwise_conv2d": "Filter",
+                 "mul": "Y", "matmul": "Y", "matmul_v2": "Y"}
+_INPUT_SLOTS = {"conv2d": "Input", "depthwise_conv2d": "Input",
+                "mul": "X", "matmul": "X", "matmul_v2": "X"}
+
+
+class QuantizationTransformPass:
+    def __init__(self, scope=None, place=None, weight_bits=8,
+                 activation_bits=8,
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_quantize_type="abs_max",
+                 quantizable_op_type=_QUANTIZABLE, moving_rate=0.9,
+                 skip_pattern="skip_quant"):
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._act_type = activation_quantize_type
+        self._w_type = weight_quantize_type
+        self._ops = tuple(quantizable_op_type)
+        self._rate = moving_rate
+        self._skip = skip_pattern
+
+    def apply(self, program, startup_program=None):
+        """Insert fake quant/dequant before each quantizable op's weight
+        and activation inputs. Returns the (mutated) program."""
+        startup = startup_program or framework.default_startup_program()
+        block = program.global_block()
+        new_ops: List[Operator] = []
+        quantized_acts = {}
+        for op in list(block.ops):
+            if op.type in self._ops and not op.attrs.get(self._skip) \
+                    and not op.attrs.get("skip_quant"):
+                for slot, maker in (
+                        (_INPUT_SLOTS[op.type], self._quant_act),
+                        (_WEIGHT_SLOTS[op.type], self._quant_weight)):
+                    names = op.input_names.get(slot)
+                    if not names:
+                        continue
+                    src = names[0]
+                    v = block._find_var_recursive(src)
+                    if v is None or str(v.dtype) not in (
+                            "float32", "float16", "bfloat16"):
+                        continue
+                    key = (src, maker is self._quant_weight)
+                    if key not in quantized_acts:
+                        quantized_acts[key] = maker(
+                            block, startup, src, v, new_ops)
+                    op.input_names[slot] = [quantized_acts[key]]
+            new_ops.append(op)
+        block.ops[:] = new_ops
+        program._version += 1
+        return program
+
+    def _quant_weight(self, block, startup, src, v, new_ops):
+        out = block.create_var(name=src + ".quantized",
+                               shape=v.shape, dtype=v.dtype,
+                               stop_gradient=False)
+        scale = block.create_var(name=src + ".quant_scale", shape=[1],
+                                 dtype="float32", stop_gradient=True)
+        op_type = ("fake_channel_wise_quantize_abs_max"
+                   if self._w_type == "channel_wise_abs_max"
+                   else "fake_quantize_abs_max")
+        new_ops.append(Operator(
+            block, op_type, inputs={"X": [src]},
+            outputs={"Out": [out.name], "OutScale": [scale.name]},
+            attrs={"bit_length": self._wbits}))
+        return out.name
+
+    def _quant_act(self, block, startup, src, v, new_ops):
+        out = block.create_var(name=src + ".quantized",
+                               shape=v.shape, dtype=v.dtype,
+                               stop_gradient=False)
+        scale = block.create_var(name=src + ".quant_scale", shape=[1],
+                                 dtype="float32", stop_gradient=True)
+        if self._act_type == "moving_average_abs_max":
+            state = block.create_var(name=src + ".quant_state",
+                                     shape=[1], dtype="float32",
+                                     persistable=True,
+                                     stop_gradient=True)
+            sblock = startup.global_block()
+            sblock.create_var(name=state.name, shape=[1],
+                              dtype="float32", persistable=True)
+            sblock.append_op(type="fill_constant", inputs={},
+                             outputs={"Out": [state.name]},
+                             attrs={"shape": [1], "dtype": "float32",
+                                    "value": 0.0})
+            new_ops.append(Operator(
+                block, "fake_quantize_moving_average_abs_max",
+                inputs={"X": [src], "InScale": [state.name]},
+                outputs={"Out": [out.name], "OutScale": [state.name]},
+                attrs={"bit_length": self._abits,
+                       "moving_rate": self._rate}))
+        else:
+            new_ops.append(Operator(
+                block, "fake_quantize_abs_max", inputs={"X": [src]},
+                outputs={"Out": [out.name], "OutScale": [scale.name]},
+                attrs={"bit_length": self._abits}))
+        return out.name
+
+
+class QuantizationFreezePass:
+    """Reference: QuantizationFreezePass — after QAT, bake the learned
+    scales in as attrs for inference. TPU-native: XLA has no int8 matmul
+    path worth hand-scheduling here, so freezing keeps the qdq ops with
+    is_test=True (fixed scales); the numerics match int8 deployment."""
+
+    def __init__(self, scope=None, place=None, weight_bits=8,
+                 activation_bits=8, weight_quantize_type="abs_max"):
+        pass
+
+    def apply(self, program):
+        for op in program.global_block().ops:
+            if op.type.startswith("fake_quantize"):
+                op.attrs["is_test"] = True
+        program._version += 1
+        return program

@@ -1,25 +1,83 @@
 """Dataset-training entry points (reference: `Executor::RunFromDataset`
 `framework/executor.cc:170`, MultiTrainer/HogwildWorker loops
-`framework/hogwild_worker.cc`).
+`framework/hogwild_worker.cc`, double-buffered reader
+`operators/reader/buffered_reader.cc`).
 
-TPU-native: the per-thread Hogwild op loop is replaced by iterating the
-dataset's batch stream through the same compiled train step; XLA pipelines
-host feeding against device compute.
+TPU-native: the per-thread Hogwild op loop is replaced by ONE compiled
+train step; throughput comes from overlap, not host threads racing on a
+shared scope:
+- a feeder thread parses/prepares batches into a bounded queue while the
+  device computes (the reference's DataFeed channel);
+- steps run with device-resident results (no per-step host sync) — jax's
+  async dispatch queues step N+1's transfer while step N executes, so
+  feeding, H2D copy and compute pipeline like the reference's
+  double-buffered reader. Fetched values materialize on host only every
+  `print_period` steps and at the end.
 """
 from __future__ import annotations
 
+import queue
+import threading
+
+import numpy as np
+
+_SENTINEL = object()
+
 
 def train_from_dataset(executor, program, dataset, scope=None,
-                       fetch_list=None, print_period=100):
+                       fetch_list=None, print_period=100,
+                       queue_size=4):
     if dataset is None:
         raise ValueError("dataset is required")
     from . import framework
 
     program = program or framework.default_main_program()
+
+    q: "queue.Queue" = queue.Queue(maxsize=max(int(queue_size), 1))
+    feeder_err = []
+
+    def _feeder():
+        try:
+            for feed in dataset._iter_batches():
+                q.put(feed)
+        except BaseException as e:  # noqa: BLE001 - surface in main thread
+            feeder_err.append(e)
+        finally:
+            q.put(_SENTINEL)
+
+    t = threading.Thread(target=_feeder, daemon=True,
+                         name="paddle_tpu-data-feeder")
+    t.start()
+
     it = 0
     results = None
-    for feed in dataset._iter_batches():
-        results = executor.run(program, feed=feed,
-                               fetch_list=fetch_list, scope=scope)
-        it += 1
-    return results
+    try:
+        while True:
+            feed = q.get()
+            if feed is _SENTINEL:
+                break
+            # return_numpy=False keeps results device-resident: no host
+            # sync per step, so the feeder and the next H2D overlap this
+            # compute
+            results = executor.run(program, feed=feed,
+                                   fetch_list=fetch_list, scope=scope,
+                                   return_numpy=False)
+            it += 1
+            if print_period and fetch_list and it % print_period == 0:
+                vals = [np.asarray(v) for v in results]
+                print("step %d: %s" % (it, [float(np.ravel(v)[0])
+                                            for v in vals]))
+    finally:
+        # unblock a feeder stuck on q.put if the step loop errored out
+        while t.is_alive():
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(timeout=0.2)
+    if feeder_err:
+        raise feeder_err[0]
+    if results is not None:
+        return [np.asarray(v) for v in results]
+    return None

@@ -289,3 +289,6 @@ class Conv2DTranspose(Layer):
 
 
 from .rnn import LSTM, GRU  # noqa: F401,E402
+from .transformer import (  # noqa: F401,E402
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+)

@@ -100,3 +100,26 @@ def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
     if reduction == "sum":
         return _nn.reduce_sum(out)
     return out
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """Fused attention over [B, H, S, D] heads; lowers to the Pallas
+    flash kernel on TPU (ops/nn_ops.py scaled_dot_product_attention)."""
+    ins = {"Q": [query], "K": [key], "V": [value]}
+    if attn_mask is not None:
+        shp = tuple(attn_mask.shape)
+        if len(shp) != 2:
+            raise NotImplementedError(
+                "scaled_dot_product_attention takes an additive KEY bias "
+                "of shape [batch, seq_k]; got mask shape %s. Full "
+                "[B,H,Sq,Sk] masks are not supported by the fused "
+                "kernel — fold them into is_causal or a key bias."
+                % (shp,))
+        ins["KeyBias"] = [attn_mask]
+    return _apply_op("scaled_dot_product_attention",
+                     "scaled_dot_product_attention", ins,
+                     {"causal": is_causal,
+                      "attn_dropout_prob": dropout_p,
+                      "is_test": not training}, ["Out"])[0]

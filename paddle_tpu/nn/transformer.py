@@ -1,0 +1,133 @@
+"""paddle.nn transformer layers (reference: `python/paddle/nn/layer/
+transformer.py` in 2.0; in this 1.8-era snapshot the equivalent surface
+is the incubate transformer models). MXU note: attention and FFN are
+plain matmul chains — XLA fuses the bias/activation/dropout elementwise
+work into them; on real TPU configs the Pallas flash-attention kernel
+(ops/pallas/flash_attention.py) takes over via
+functional.scaled_dot_product_attention."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..fluid.dygraph.layers import Layer, LayerList
+from ..fluid.dygraph.nn import Linear, LayerNorm, Dropout
+from ..fluid.dygraph import base as dy_base
+from . import functional as F
+
+__all__ = ["MultiHeadAttention", "TransformerEncoderLayer",
+           "TransformerEncoder"]
+
+
+class MultiHeadAttention(Layer):
+    def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None,
+                 vdim=None, need_weights=False, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.dropout = dropout
+        if need_weights:
+            raise NotImplementedError(
+                "need_weights=True is not supported: the fused attention "
+                "path never materializes the [B,H,Sq,Sk] prob matrix "
+                "(that is the point of the flash kernel)")
+        self.q_proj = Linear(embed_dim, embed_dim)
+        self.k_proj = Linear(kdim or embed_dim, embed_dim)
+        self.v_proj = Linear(vdim or embed_dim, embed_dim)
+        self.out_proj = Linear(embed_dim, embed_dim)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        key = query if key is None else key
+        value = key if value is None else value
+        q = self.q_proj(query)
+        k = self.k_proj(key)
+        v = self.v_proj(value)
+
+        import jax.numpy as jnp
+
+        def heads(t):
+            b, s, _ = t._val.shape
+            return dy_base.trace_op(
+                "transpose2",
+                {"X": [dy_base.trace_op(
+                    "reshape2", {"X": [t]},
+                    {"shape": [b, s, self.num_heads, self.head_dim]},
+                    ["Out", "XShape"])[0]]},
+                {"axis": [0, 2, 1, 3]}, ["Out", "XShape"])[0]
+
+        qh, kh, vh = heads(q), heads(k), heads(v)
+        ctx = F.scaled_dot_product_attention(
+            qh, kh, vh, attn_mask=attn_mask,
+            dropout_p=self.dropout if self.training else 0.0)
+        b, h, s, d = ctx._val.shape
+        ctx = dy_base.trace_op("transpose2", {"X": [ctx]},
+                               {"axis": [0, 2, 1, 3]},
+                               ["Out", "XShape"])[0]
+        ctx = dy_base.trace_op("reshape2", {"X": [ctx]},
+                               {"shape": [b, s, h * d]},
+                               ["Out", "XShape"])[0]
+        return self.out_proj(ctx)
+
+
+class TransformerEncoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.self_attn = MultiHeadAttention(
+            d_model, nhead, dropout=attn_dropout
+            if attn_dropout is not None else dropout)
+        self.linear1 = Linear(d_model, dim_feedforward)
+        self.linear2 = Linear(dim_feedforward, d_model)
+        self.norm1 = LayerNorm(d_model)
+        self.norm2 = LayerNorm(d_model)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(dropout)
+        self.act_dropout = Dropout(act_dropout
+                                   if act_dropout is not None else dropout)
+        self._act = activation
+
+    def forward(self, src, src_mask=None):
+        residual = src
+        if self.normalize_before:
+            src = self.norm1(src)
+        src = self.self_attn(src, attn_mask=src_mask)
+        src = residual + self.dropout1(src)
+        if not self.normalize_before:
+            src = self.norm1(src)
+        residual = src
+        if self.normalize_before:
+            src = self.norm2(src)
+        h = self.linear1(src)
+        h = F.relu(h) if self._act == "relu" else F.gelu(h)
+        h = self.act_dropout(h)
+        src = residual + self.dropout2(self.linear2(h))
+        if not self.normalize_before:
+            src = self.norm2(src)
+        return src
+
+
+class TransformerEncoder(Layer):
+    def __init__(self, encoder_layer, num_layers, norm=None):
+        super().__init__()
+        import copy
+
+        self.layers = LayerList([encoder_layer] + [
+            copy.deepcopy(encoder_layer) for _ in range(num_layers - 1)])
+        self.num_layers = num_layers
+        self.norm = norm
+
+    def forward(self, src, src_mask=None):
+        out = src
+        for layer in self.layers:
+            out = layer(out, src_mask=src_mask)
+        if self.norm is not None:
+            out = self.norm(out)
+        return out

@@ -20,3 +20,4 @@ from . import loss_ops  # noqa: F401
 from . import linalg_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import beam_search_ops  # noqa: F401
+from . import quant_ops  # noqa: F401

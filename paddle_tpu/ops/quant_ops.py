@@ -1,0 +1,95 @@
+"""Fake-quantization operators (QAT/PTQ).
+
+Reference parity: `paddle/fluid/operators/fake_quantize_op.cc` —
+fake_quantize_abs_max, fake_quantize_moving_average_abs_max,
+fake_channel_wise_quantize_abs_max, fake_quantize_dequantize variants,
+moving_average_abs_max_scale. TPU-native autodiff note: the reference
+hand-writes straight-through-estimator grad kernels; here STE falls out
+of expressing quantization as `x + stop_gradient(q(x) - x)` — jax.vjp
+then yields identity gradients through the rounding automatically.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _qdq(x, scale, bit_length):
+    """quantize->dequantize with STE."""
+    bnt = (1 << (bit_length - 1)) - 1
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / s * bnt), -bnt, bnt) * s / bnt
+    return x + jax.lax.stop_gradient(q - x)
+
+
+@register_op("fake_quantize_abs_max")
+def _fake_quantize_abs_max(ins, attrs):
+    x = ins["X"][0]
+    bits = attrs.get("bit_length", 8)
+    static = attrs.get("static_scale")
+    # static_scale: PTQ binds the CALIBRATED scale here, overriding the
+    # dynamic per-batch abs-max (QAT's default)
+    scale = jnp.float32(static) if static is not None \
+        else jnp.max(jnp.abs(x))
+    return {"Out": _qdq(x, scale, bits),
+            "OutScale": jnp.reshape(scale, (1,))}
+
+
+@register_op("fake_quantize_dequantize_abs_max")
+def _fake_qdq_abs_max(ins, attrs):
+    return _fake_quantize_abs_max(ins, attrs)
+
+
+@register_op("fake_channel_wise_quantize_abs_max")
+def _fake_cw_quantize_abs_max(ins, attrs):
+    x = ins["X"][0]
+    bits = attrs.get("bit_length", 8)
+    axis = attrs.get("quant_axis", 0)
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    scale = jnp.max(jnp.abs(x), axis=red, keepdims=True)
+    out = _qdq(x, scale, bits)
+    return {"Out": out, "OutScale": jnp.reshape(scale, (-1,))}
+
+
+@register_op("fake_quantize_moving_average_abs_max")
+def _fake_quantize_ma_abs_max(ins, attrs):
+    x = ins["X"][0]
+    bits = attrs.get("bit_length", 8)
+    rate = attrs.get("moving_rate", 0.9)
+    in_scale = ins["InScale"][0].reshape(())
+    is_test = attrs.get("is_test", False)
+    cur = jnp.max(jnp.abs(x))
+    if is_test:
+        scale = in_scale
+    else:
+        scale = jnp.where(in_scale > 0,
+                          rate * in_scale + (1 - rate) * cur, cur)
+    return {"Out": _qdq(x, scale, bits),
+            "OutScale": jnp.reshape(scale, (1,))}
+
+
+@register_op("fake_quantize_dequantize_moving_average_abs_max")
+def _fake_qdq_ma_abs_max(ins, attrs):
+    return _fake_quantize_ma_abs_max(ins, attrs)
+
+
+@register_op("fake_dequantize_max_abs")
+def _fake_dequantize_max_abs(ins, attrs):
+    x = ins["X"][0]
+    scale = ins["Scale"][0].reshape(())
+    max_range = attrs.get("max_range", 127.0)
+    return {"Out": x.astype(jnp.float32) * scale / max_range}
+
+
+@register_op("moving_average_abs_max_scale")
+def _ma_abs_max_scale(ins, attrs):
+    x = ins["X"][0]
+    rate = attrs.get("moving_rate", 0.9)
+    in_scale = ins["InScale"][0].reshape(()) if ins.get("InScale") \
+        else jnp.float32(0.0)
+    cur = jnp.max(jnp.abs(x))
+    scale = jnp.where(in_scale > 0, rate * in_scale + (1 - rate) * cur,
+                      cur)
+    return {"Out": x, "OutScale": jnp.reshape(scale, (1,))}

@@ -1,0 +1,42 @@
+"""paddle.nn MultiHeadAttention / TransformerEncoder (reference 2.0
+nn.layer.transformer surface) running in dygraph with autograd."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.fluid import dygraph
+from paddle_tpu import nn
+
+
+def test_multihead_attention_shapes_and_grads():
+    with dygraph.guard():
+        mha = nn.MultiHeadAttention(embed_dim=16, num_heads=4)
+        x = dygraph.to_variable(
+            np.random.RandomState(0).randn(2, 5, 16).astype("float32"))
+        out = mha(x)
+        assert tuple(out._val.shape) == (2, 5, 16)
+        loss = paddle.fluid.layers.mean(out)
+        loss.backward()
+        g = mha.q_proj.weight._grad
+        assert g is not None and np.isfinite(np.asarray(g)).all()
+
+
+def test_transformer_encoder_trains():
+    with dygraph.guard():
+        layer = nn.TransformerEncoderLayer(
+            d_model=16, nhead=4, dim_feedforward=32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, num_layers=2)
+        opt = paddle.fluid.optimizer.AdamOptimizer(
+            1e-2, parameter_list=enc.parameters())
+        r = np.random.RandomState(1)
+        x = r.randn(4, 6, 16).astype("float32")
+        tgt = r.randn(4, 6, 16).astype("float32")
+        losses = []
+        for _ in range(8):
+            out = enc(dygraph.to_variable(x))
+            diff = out - dygraph.to_variable(tgt)
+            loss = paddle.fluid.layers.mean(diff * diff)
+            opt.minimize(loss, parameter_list=enc.parameters())
+            enc.clear_gradients()
+            losses.append(float(np.asarray(loss._val).reshape(-1)[0]))
+        assert losses[-1] < losses[0], losses
+        assert len(enc.parameters()) > 10

@@ -1,0 +1,114 @@
+"""slim quantization: QAT pass (fake quant ops w/ STE grads) and PTQ
+calibration (reference: contrib/slim/quantization/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework
+
+
+def _mlp():
+    x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(input=x, size=32, act="relu")
+    logits = fluid.layers.fc(input=h, size=4)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, label))
+    return loss
+
+
+def _data():
+    r = np.random.RandomState(8)
+    return (r.rand(32, 16).astype("float32"),
+            r.randint(0, 4, (32, 1)).astype("int64"))
+
+
+def test_fake_quant_ops_golden():
+    import jax.numpy as jnp
+    import paddle_tpu.ops as ops_lib
+
+    x = np.array([[-1.0, 0.5, 0.25, 1.0]], "float32")
+    out = ops_lib.run_op("fake_quantize_abs_max",
+                         {"X": [jnp.asarray(x)]}, {"bit_length": 8})
+    got = np.asarray(out["Out"][0])
+    scale = float(np.asarray(out["OutScale"][0])[0])
+    assert scale == 1.0
+    np.testing.assert_allclose(
+        got, np.round(x * 127) / 127, atol=1e-6)
+
+    # STE: gradient of sum(qdq(x)) wrt x is all-ones
+    import jax
+
+    g = jax.grad(lambda v: jnp.sum(ops_lib.run_op(
+        "fake_quantize_abs_max", {"X": [v]},
+        {"bit_length": 8})["Out"][0]))(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(g), np.ones_like(x))
+
+
+def test_qat_trains():
+    from paddle_tpu.fluid.contrib.slim.quantization import (
+        QuantizationTransformPass)
+    from paddle_tpu.core.scope import Scope
+
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = startup.random_seed = 4
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            loss = _mlp()
+            QuantizationTransformPass().apply(main, startup)
+            fluid.optimizer.SGDOptimizer(0.5).minimize(loss)
+    qops = [op.type for op in main.global_block().ops
+            if op.type.startswith("fake_quantize")]
+    assert len(qops) >= 4, qops  # 2 weights + 2 activations
+
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    x, y = _data()
+    losses = []
+    for _ in range(15):
+        out = exe.run(main, feed={"x": x, "label": y},
+                      fetch_list=[loss], scope=scope)
+        losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+    assert losses[-1] < losses[0], losses
+
+
+def test_ptq_calibration():
+    from paddle_tpu.fluid.contrib.slim.quantization import (
+        PostTrainingQuantization)
+    from paddle_tpu.core.scope import Scope
+
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = startup.random_seed = 4
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            loss = _mlp()
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    x, y = _data()
+
+    def samples():
+        for _ in range(3):
+            yield {"x": x, "label": y}
+
+    ptq = PostTrainingQuantization(
+        exe, main, feed_list=["x", "label"], fetch_list=[loss],
+        sample_generator=samples, batch_nums=3, scope=scope)
+    qprog = ptq.quantize()
+    assert ptq.scales, "no calibration scales collected"
+    assert abs(list(ptq.scales.values())[0]
+               - float(np.abs(x).max())) < 1e-5
+    qops = [op for op in qprog.global_block().ops
+            if op.type.startswith("fake_quantize")]
+    assert qops
+    # calibrated static scales are BOUND into the activation quant ops
+    bound = [op.attrs.get("static_scale") for op in qops
+             if op.type == "fake_quantize_abs_max"
+             and op.input_names["X"][0] in ptq.scales]
+    assert bound and all(b is not None for b in bound), qops
+    # quantized program still runs (on different data: static scales)
+    x2 = x * 0.5
+    out = exe.run(qprog, feed={"x": x2, "label": y},
+                  fetch_list=[loss], scope=scope)
+    assert np.isfinite(float(np.asarray(out[0]).reshape(-1)[0]))
