@@ -1,0 +1,99 @@
+"""Memory facade + stats surface.
+
+Reference parity: `paddle/fluid/memory/malloc.h:32-37` (memory::Alloc /
+AllocShared) and `memory/allocation/allocator_facade.h:32` with the
+gflags-selectable strategies, plus the STAT registry GPU-memory gauges
+(`platform/monitor.h`). TPU-native split: HBM allocation belongs to
+PJRT/XLA (buffer donation + arena planning beat any hand allocator —
+SURVEY.md §2 row "Memory"); this facade exposes the reference-shaped
+API over (a) the native best-fit HOST allocator
+(core/native/src/allocator.cc) for pinned staging buffers and (b) the
+per-device PJRT memory statistics."""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from .place import CPUPlace, TPUPlace
+
+
+class Allocation:
+    """Handle returned by Alloc (reference: memory::Allocation)."""
+
+    __slots__ = ("ptr", "size", "place", "_buf")
+
+    def __init__(self, ptr, size, place, buf=None):
+        self.ptr = ptr
+        self.size = size
+        self.place = place
+        self._buf = buf
+
+
+def Alloc(place, size: int) -> Allocation:
+    """memory::Alloc (reference: malloc.h:32). Host places use the
+    native caching allocator when built; device places raise — HBM
+    buffers are created by XLA, not by user code."""
+    name = type(place).__name__
+    if isinstance(place, TPUPlace) or (
+            name.startswith(("CUDA", "XPU")) and "Pinned" not in name):
+        from .errors import UnavailableError
+
+        raise UnavailableError(
+            "device HBM is managed by PJRT/XLA (donated buffers, arena "
+            "planning); allocate through tensors, not memory.Alloc")
+    try:
+        alloc = _host_allocator()
+        ptr = alloc.alloc(max(int(size), 1))
+        return Allocation(ptr, int(size), place)
+    except Exception:
+        buf = np.empty((max(int(size), 1),), np.uint8)
+        return Allocation(buf.ctypes.data, int(size), place, buf=buf)
+
+
+_HOST_ALLOCATOR = None
+
+
+def _host_allocator():
+    global _HOST_ALLOCATOR
+    if _HOST_ALLOCATOR is None:
+        from .native import NativeAllocator
+
+        _HOST_ALLOCATOR = NativeAllocator()
+    return _HOST_ALLOCATOR
+
+
+def Free(allocation: Allocation):
+    if allocation._buf is not None:
+        allocation._buf = None
+        return
+    try:
+        _host_allocator().free(allocation.ptr)
+    except Exception:
+        pass
+
+
+def memory_stats(device=None) -> Dict[str, int]:
+    """Per-device memory statistics via PJRT (reference: the
+    STAT_ADD/gpu_mem monitor gauges, platform/monitor.h)."""
+    import jax
+
+    dev = device if device is not None else jax.devices()[0]
+    stats = {}
+    try:
+        raw = dev.memory_stats() or {}
+        for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                  "largest_alloc_size", "num_allocs"):
+            if k in raw:
+                stats[k] = int(raw[k])
+    except Exception:
+        pass
+    return stats
+
+
+def max_memory_allocated(device=None) -> int:
+    return memory_stats(device).get("peak_bytes_in_use", 0)
+
+
+def memory_allocated(device=None) -> int:
+    return memory_stats(device).get("bytes_in_use", 0)

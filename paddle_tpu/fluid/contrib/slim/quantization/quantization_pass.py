@@ -89,8 +89,6 @@ class QuantizationTransformPass:
         out = block.create_var(name=src + ".quantized",
                                shape=v.shape, dtype=v.dtype,
                                stop_gradient=False)
-        scale = block.create_var(name=src + ".quant_scale", shape=[1],
-                                 dtype="float32", stop_gradient=True)
         if self._act_type == "moving_average_abs_max":
             state = block.create_var(name=src + ".quant_state",
                                      shape=[1], dtype="float32",
@@ -110,6 +108,9 @@ class QuantizationTransformPass:
                 attrs={"bit_length": self._abits,
                        "moving_rate": self._rate}))
         else:
+            scale = block.create_var(name=src + ".quant_scale",
+                                     shape=[1], dtype="float32",
+                                     stop_gradient=True)
             new_ops.append(Operator(
                 block, "fake_quantize_abs_max", inputs={"X": [src]},
                 outputs={"Out": [out.name], "OutScale": [scale.name]},

@@ -243,6 +243,11 @@ class Operator:
                 _var_name(v)
                 for v in (vs if isinstance(vs, (list, tuple)) else [vs])]
         self.attrs = dict(attrs or {})
+        # creation-site frames for error attribution (reference:
+        # framework/op_call_stack.cc); cheap: top user frames only
+        from ..core.errors import capture_user_callstack
+
+        self._creation_stack = capture_user_callstack()
 
     def input(self, slot):
         return self.input_names.get(slot, [])
@@ -467,6 +472,9 @@ class Program:
                 if for_test and op.type in ("backward",):
                     continue
                 nop = Operator(nb, op.type)
+                # keep the ORIGINAL creation site for error attribution
+                # (rebuilding here would blame the clone() call)
+                nop._creation_stack = op._creation_stack
                 nop.input_names = {k: list(v)
                                    for k, v in op.input_names.items()}
                 nop.output_names = {k: list(v)

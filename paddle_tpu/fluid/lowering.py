@@ -158,9 +158,11 @@ def _exec_op(op, env, key0, op_idx, amp_lists=None):
         try:
             ins[slot] = [env[n] for n in names]
         except KeyError as e:
-            raise RuntimeError(
-                "op %s: input var %s not materialized (feed it or run the "
-                "startup program)" % (t, e)) from None
+            from ..core.errors import NotFoundError, attach_op_callstack
+
+            attach_op_callstack(NotFoundError(
+                "op %s: input var %s not materialized (feed it or run "
+                "the startup program)" % (t, e)), op)
     # bf16 AMP policy (reference: fp16_utils.py cast insertion; here the
     # casts are applied at trace time and fused by XLA)
     if amp_lists is not None:
@@ -176,7 +178,12 @@ def _exec_op(op, env, key0, op_idx, amp_lists=None):
     attrs = dict(op.attrs)
     if opdef.needs_rng:
         attrs["_rng_key"] = jax.random.fold_in(key0, op_idx)
-    outs = ops_lib.normalize_outs(opdef.compute(ins, attrs))
+    try:
+        outs = ops_lib.normalize_outs(opdef.compute(ins, attrs))
+    except Exception as e:  # attach the op's python creation site
+        from ..core.errors import attach_op_callstack
+
+        attach_op_callstack(e, op)
     for slot, names in op.output_names.items():
         vals = outs.get(slot, [])
         for n, v in zip(names, vals):

@@ -35,15 +35,26 @@ def train_from_dataset(executor, program, dataset, scope=None,
 
     q: "queue.Queue" = queue.Queue(maxsize=max(int(queue_size), 1))
     feeder_err = []
+    stop = threading.Event()
 
     def _feeder():
         try:
             for feed in dataset._iter_batches():
-                q.put(feed)
+                while not stop.is_set():
+                    try:
+                        q.put(feed, timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
         except BaseException as e:  # noqa: BLE001 - surface in main thread
             feeder_err.append(e)
         finally:
-            q.put(_SENTINEL)
+            try:
+                q.put_nowait(_SENTINEL)
+            except queue.Full:
+                pass
 
     t = threading.Thread(target=_feeder, daemon=True,
                          name="paddle_tpu-data-feeder")
@@ -68,14 +79,14 @@ def train_from_dataset(executor, program, dataset, scope=None,
                 print("step %d: %s" % (it, [float(np.ravel(v)[0])
                                             for v in vals]))
     finally:
-        # unblock a feeder stuck on q.put if the step loop errored out
-        while t.is_alive():
-            try:
-                while True:
-                    q.get_nowait()
-            except queue.Empty:
-                pass
-            t.join(timeout=0.2)
+        # signal the feeder to stop (don't drain the whole dataset just
+        # to surface a step error) and unblock any pending put
+        stop.set()
+        try:
+            q.get_nowait()
+        except queue.Empty:
+            pass
+        t.join(timeout=5.0)
     if feeder_err:
         raise feeder_err[0]
     if results is not None:

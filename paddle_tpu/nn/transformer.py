@@ -42,6 +42,10 @@ class MultiHeadAttention(Layer):
 
     def forward(self, query, key=None, value=None, attn_mask=None,
                 cache=None):
+        if cache is not None:
+            raise NotImplementedError(
+                "incremental decoding cache is not supported by the "
+                "fused attention path yet")
         key = query if key is None else key
         value = key if value is None else value
         q = self.q_proj(query)
