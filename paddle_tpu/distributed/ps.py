@@ -93,9 +93,20 @@ class PSCommunicator:
                 "sparse_push", wname, rows,
                 np.asarray(gvals, dtype=np.float32), self.tid)
 
+    def _beat_all(self):
+        eps = set(self.cfg["param_endpoint"].values())
+        eps |= {m["endpoint"]
+                for m in self.cfg.get("sparse_tables", {}).values()}
+        for ep in eps:
+            try:
+                self._client(ep).call("heartbeat", self.tid)
+            except Exception:  # noqa: BLE001 - liveness only
+                pass
+
     # -- dense sync/async --------------------------------------------------
     def step(self, grads: Dict[str, np.ndarray], scope):
         """grads: param name -> grad value for this step."""
+        self._beat_all()
         pe = self.cfg["param_endpoint"]
         if self.mode in ("sync", "async"):
             for pname, g in grads.items():
@@ -139,6 +150,64 @@ class PSCommunicator:
             c.close()
 
 
+class HeartBeatMonitor:
+    """Lost-worker detection (reference:
+    `operators/distributed/heart_beat_monitor.h:54` — the pserver-side
+    LostWorkerMonitor thread watching per-worker update timestamps)."""
+
+    def __init__(self, trainers, timeout_s=60.0, on_lost=None):
+        import time
+
+        self.trainers = int(trainers)
+        self.timeout_s = float(timeout_s)
+        self._clock = time.monotonic
+        # pre-seed every expected worker so one that dies BEFORE its
+        # first RPC is still detected
+        now = self._clock()
+        self._last_beat: Dict[int, float] = {
+            tid: now for tid in range(self.trainers)}
+        self._lost: set = set()
+        self._on_lost = on_lost
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._beat_lock = threading.Lock()
+
+    def beat(self, tid: int):
+        now = self._clock()
+        with self._beat_lock:
+            self._last_beat[int(tid)] = now
+            self._lost.discard(int(tid))
+
+    def lost_workers(self):
+        now = self._clock()
+        with self._beat_lock:
+            items = list(self._last_beat.items())
+        for tid, t in items:
+            if now - t > self.timeout_s and tid not in self._lost:
+                self._lost.add(tid)
+                if self._on_lost:
+                    self._on_lost(tid)
+                else:
+                    import logging
+
+                    logging.getLogger("paddle_tpu.ps").warning(
+                        "trainer %d lost (no heartbeat for %.0fs)",
+                        tid, now - t)
+        return sorted(self._lost)
+
+    def start(self, interval_s=10.0):
+        def loop():
+            while not self._stop.wait(interval_s):
+                self.lost_workers()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="ps-heartbeat-monitor")
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+
+
 class ParameterServer:
     """listen_and_serv state: tables + aggregation + update execution."""
 
@@ -161,6 +230,8 @@ class ParameterServer:
         self._sparse_lr = dict(getattr(pserver_prog, "_ps_sparse", {}))
         self._inited: set = set()
         self._lock = threading.Lock()
+        self.heartbeat = HeartBeatMonitor(self.trainers)
+        self.heartbeat.start()
         # per-param update programs (reference: listen_and_serv per-param
         # optimize sub-blocks) — async mode applies one grad at a time
         from ..fluid import framework as fw
@@ -233,8 +304,12 @@ class ParameterServer:
                     self.scope.set_var(pname, val)
                     self._inited.add(pname)
             return []
+        if method == "heartbeat":
+            self.heartbeat.beat(int(args[0]))
+            return []
         if method == "send_grad":
             pname, grad, tid = args[0], args[1], int(args[2])
+            self.heartbeat.beat(tid)
             if self.mode == "async":
                 with self._lock:
                     self._apply_one(pname, grad)
@@ -258,6 +333,7 @@ class ParameterServer:
                                         np.asarray(args[1]),
                                         np.asarray(args[2]),
                                         int(args[3]))
+            self.heartbeat.beat(tid)
             if self.mode == "async":
                 with self._lock:
                     self._apply_sparse(pname, rows, values)
@@ -304,5 +380,6 @@ def listen_and_serv(pserver_prog, pserver_startup=None,
         server_state.served_port = srv.port
         srv.wait_stopped()
     finally:
+        server_state.heartbeat.stop()
         srv.shutdown()
     return server_state

@@ -63,3 +63,6 @@ from . import transpiler  # noqa: F401,E402
 from .transpiler import (  # noqa: F401,E402
     DistributeTranspiler, DistributeTranspilerConfig,
 )
+
+# composite network builders (reference: python/paddle/fluid/nets.py)
+from . import nets  # noqa: F401,E402

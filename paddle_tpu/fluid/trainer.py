@@ -51,10 +51,16 @@ def train_from_dataset(executor, program, dataset, scope=None,
         except BaseException as e:  # noqa: BLE001 - surface in main thread
             feeder_err.append(e)
         finally:
-            try:
-                q.put_nowait(_SENTINEL)
-            except queue.Full:
-                pass
+            # the sentinel must not be dropped on a full queue (the
+            # consumer would hang at end-of-dataset); retry like the
+            # data puts, bailing only when the consumer said stop
+            while True:
+                try:
+                    q.put(_SENTINEL, timeout=0.2)
+                    break
+                except queue.Full:
+                    if stop.is_set():
+                        break
 
     t = threading.Thread(target=_feeder, daemon=True,
                          name="paddle_tpu-data-feeder")

@@ -121,3 +121,26 @@ def test_ps_distributed_lookup_table_sync():
     all_ls = [_losses(out) for out in touts]
     avg = np.mean(all_ls, axis=0)
     np.testing.assert_allclose(avg, base, rtol=1e-4, atol=1e-4)
+
+
+def test_heartbeat_monitor_detects_lost_worker():
+    """Reference: heart_beat_monitor.cc LostWorkerMonitor — a worker
+    whose beats stop past the timeout is flagged."""
+    from paddle_tpu.distributed.ps import HeartBeatMonitor
+
+    lost = []
+    m = HeartBeatMonitor(trainers=2, timeout_s=5.0,
+                         on_lost=lost.append)
+    t = [0.0]
+    m._clock = lambda: t[0]
+    m.beat(0)
+    m.beat(1)
+    t[0] = 3.0
+    m.beat(1)  # worker 1 keeps beating
+    assert m.lost_workers() == []
+    t[0] = 7.0  # worker 0 silent for 7s > 5s; worker 1 only 4s
+    assert m.lost_workers() == [0]
+    assert lost == [0]
+    m.beat(0)  # recovery clears the flag
+    t[0] = 8.0
+    assert m.lost_workers() == []

@@ -1,0 +1,51 @@
+"""fluid.nets composite builders (reference: python/paddle/fluid/nets.py)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework
+
+
+def test_simple_img_conv_pool_and_glu(rng):
+    from paddle_tpu.core.scope import Scope
+
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = startup.random_seed = 2
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            img = fluid.layers.data(name="img", shape=[1, 8, 8],
+                                    dtype="float32",)
+            conv_pool = fluid.nets.simple_img_conv_pool(
+                input=img, num_filters=4, filter_size=3, pool_size=2,
+                pool_stride=2, act="relu")
+            g = fluid.nets.glu(fluid.layers.reshape(conv_pool, [-1, 36]))
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    out = exe.run(main, feed={"img": rng.rand(2, 1, 8, 8).astype(
+        "float32")}, fetch_list=[conv_pool, g], scope=scope)
+    assert np.asarray(out[0]).shape == (2, 4, 3, 3)
+    assert np.asarray(out[1]).shape == (2, 18)
+    assert np.isfinite(np.asarray(out[1])).all()
+
+
+def test_nets_attention_and_seq_conv_pool(rng):
+    from paddle_tpu.core.scope import Scope
+
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = startup.random_seed = 2
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data(name="x", shape=[6, 16],
+                                  dtype="float32")
+            att = fluid.nets.scaled_dot_product_attention(
+                x, x, x, num_heads=4)
+            scp = fluid.nets.sequence_conv_pool(
+                input=x, num_filters=8, filter_size=3, act="sigmoid",
+                pool_type="max")
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    out = exe.run(main, feed={"x": rng.rand(2, 6, 16).astype("float32")},
+                  fetch_list=[att, scp], scope=scope)
+    assert np.asarray(out[0]).shape == (2, 6, 16)
+    assert np.asarray(out[1]).shape == (2, 8)
