@@ -1,0 +1,182 @@
+"""Host-side (CPU) collectives: barrier / allreduce / allgather /
+broadcast over TCP.
+
+Reference parity: `paddle/fluid/framework/fleet/gloo_wrapper.h:106` —
+GlooWrapper's Barrier (:146) and AllReduce (:157) used by dataset global
+shuffle and the GeneralRoleMaker, with an HdfsStore rendezvous (:45).
+TPU-native scope: device collectives ride ICI via XLA; this tier exists
+for HOST coordination (dataset shuffle, role-maker barriers) where the
+accelerator isn't involved. Rendezvous is rank-0-hosts-a-store over the
+same binary RPC as the PS tier (distributed/rpc.py) instead of HDFS.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .rpc import RpcClient, RpcServer, _Stop
+
+
+class _StoreState:
+    """Rank-0 store: keyed blobs + counting barriers. Wait timeout is
+    configurable (PADDLE_HC_TIMEOUT_S env or ctor arg) — dataset-sized
+    collectives legitimately wait minutes for slow ranks."""
+
+    def __init__(self, world_size, timeout_s=None):
+        import os
+
+        self.world = int(world_size)
+        self.timeout_s = float(
+            timeout_s if timeout_s is not None
+            else os.environ.get("PADDLE_HC_TIMEOUT_S", 600))
+        self._kv: Dict[str, object] = {}
+        self._counts: Dict[str, int] = {}
+        self._cv = threading.Condition()
+
+    def handle(self, method, args):
+        if method == "hc_put":
+            key, val = args[0], args[1]
+            with self._cv:
+                self._kv[key] = val
+                self._counts[key] = self._counts.get(key, 0) + 1
+                self._cv.notify_all()
+            return []
+        if method == "hc_get":
+            key, need = args[0], int(args[1])
+            with self._cv:
+                self._cv.wait_for(
+                    lambda: self._counts.get(key, 0) >= need,
+                    timeout=self.timeout_s)
+                if self._counts.get(key, 0) < need:
+                    raise TimeoutError("hc_get %s: %d/%d contributions"
+                                       % (key, self._counts.get(key, 0),
+                                          need))
+                return [self._kv[key]]
+        if method == "hc_take":
+            # blocking fetch that REMOVES the blob: point-to-point
+            # exchange keys pass through the store exactly once, so the
+            # store's peak memory stays bounded by in-flight data
+            key = args[0]
+            with self._cv:
+                self._cv.wait_for(lambda: key in self._kv,
+                                  timeout=self.timeout_s)
+                if key not in self._kv:
+                    raise TimeoutError("hc_take %s" % key)
+                val = self._kv.pop(key)
+                self._counts.pop(key, None)
+                return [val]
+        if method == "hc_put_part":
+            key, rank, val = args[0], int(args[1]), args[2]
+            with self._cv:
+                self._kv["%s/%d" % (key, rank)] = val
+                self._counts[key] = self._counts.get(key, 0) + 1
+                self._cv.notify_all()
+            return []
+        if method == "hc_gather":
+            key = args[0]
+            with self._cv:
+                self._cv.wait_for(
+                    lambda: self._counts.get(key, 0) >= self.world,
+                    timeout=self.timeout_s)
+                if self._counts.get(key, 0) < self.world:
+                    raise TimeoutError("hc_gather %s" % key)
+                return [self._kv["%s/%d" % (key, r)]
+                        for r in range(self.world)]
+        if method == "hc_shutdown":
+            raise _Stop()
+        raise ValueError("unknown host-collective method %r" % method)
+
+
+class HostCollectiveGroup:
+    """Gloo-equivalent group. rank 0 hosts the store; everyone (incl.
+    rank 0) talks to it through the same client path."""
+
+    def __init__(self, rank, world_size, store_endpoint,
+                 timeout_s=None):
+        self.rank = int(rank)
+        self.world = int(world_size)
+        self._seq = 0
+        self._server: Optional[RpcServer] = None
+        host, port = store_endpoint.rsplit(":", 1)
+        if self.rank == 0:
+            state = _StoreState(world_size, timeout_s=timeout_s)
+            self._server = RpcServer(host, int(port), state.handle)
+            self._server.start()
+            port = self._server.port
+        self._client = RpcClient("%s:%s" % (host, port))
+
+    def _key(self, tag):
+        self._seq += 1
+        return "%s#%d" % (tag, self._seq)
+
+    def barrier(self):
+        key = self._key("barrier")
+        self._client.call("hc_put_part", key, self.rank,
+                          np.zeros((1,), np.int8))
+        self._client.call("hc_gather", key)
+
+    def all_reduce(self, array, op="sum"):
+        key = self._key("allreduce")
+        self._client.call("hc_put_part", key, self.rank,
+                          np.ascontiguousarray(array))
+        parts = self._client.call("hc_gather", key)
+        stack = np.stack([np.asarray(p) for p in parts])
+        if op == "sum":
+            return stack.sum(axis=0)
+        if op == "max":
+            return stack.max(axis=0)
+        if op == "min":
+            return stack.min(axis=0)
+        if op in ("mean", "avg"):
+            return stack.mean(axis=0)
+        raise ValueError(op)
+
+    def all_gather(self, array) -> List[np.ndarray]:
+        key = self._key("allgather")
+        self._client.call("hc_put_part", key, self.rank,
+                          np.ascontiguousarray(array))
+        return [np.asarray(p) for p in
+                self._client.call("hc_gather", key)]
+
+    def put(self, key, array):
+        """Point-to-point send half (paired with take)."""
+        self._client.call("hc_put", key, np.ascontiguousarray(array))
+
+    def take(self, key) -> np.ndarray:
+        """Blocking receive that removes the blob from the store."""
+        (val,) = self._client.call("hc_take", key)
+        return np.asarray(val)
+
+    def broadcast(self, array, root=0):
+        key = self._key("bcast")
+        if self.rank == root:
+            self._client.call("hc_put", key, np.ascontiguousarray(array))
+        (val,) = self._client.call("hc_get", key, 1)
+        return np.asarray(val)
+
+    def shutdown(self):
+        try:
+            if self.rank == 0 and self._server is not None:
+                self._client.call("hc_shutdown")
+        except Exception:  # noqa: BLE001
+            pass
+        self._client.close()
+        if self._server is not None:
+            self._server.shutdown()
+
+
+def group_from_env() -> Optional[HostCollectiveGroup]:
+    """Build the group from the PADDLE_* launch env (role-maker path);
+    the store binds on trainer 0's endpoint port + 1."""
+    import os
+
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+    n = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if n <= 1 or not eps:
+        return None
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    host, port = eps.split(",")[0].rsplit(":", 1)
+    return HostCollectiveGroup(rank, n, "%s:%d" % (host, int(port) + 1))

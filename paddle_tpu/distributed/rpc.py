@@ -9,8 +9,9 @@ tensors travel as raw ndarray bytes with a tiny header — no pickle, no
 third-party deps.
 
 Wire format per message (little-endian):
-  [u32 total_len][u8 n_fields] then per field:
-  [u8 kind][u32 len][payload]
+  [u64 total_len][u8 n_fields] then per field:
+  [u8 kind][u64 len][payload]  (u64 frames: multi-GB dataset blobs must
+  not overflow the length prefix)
     kind 0: utf-8 string
     kind 1: ndarray — payload is [u8 dtype_len][dtype str][u8 ndim]
             [u64 x ndim shape][raw bytes]
@@ -36,11 +37,11 @@ def _enc_field(buf: bytearray, v):
     if isinstance(v, str):
         b = v.encode("utf-8")
         buf.append(0)
-        buf += _U32.pack(len(b))
+        buf += _U64.pack(len(b))
         buf += b
     elif isinstance(v, (int, np.integer)):
         buf.append(2)
-        buf += _U32.pack(8)
+        buf += _U64.pack(8)
         buf += struct.pack("<q", int(v))
     else:
         a = np.ascontiguousarray(v)
@@ -53,7 +54,7 @@ def _enc_field(buf: bytearray, v):
             payload += _U64.pack(d)
         payload += a.tobytes()
         buf.append(1)
-        buf += _U32.pack(len(payload))
+        buf += _U64.pack(len(payload))
         buf += payload
 
 
@@ -62,14 +63,14 @@ def encode(fields) -> bytes:
     body.append(len(fields))
     for f in fields:
         _enc_field(body, f)
-    return _U32.pack(len(body)) + bytes(body)
+    return _U64.pack(len(body)) + bytes(body)
 
 
 def _dec_field(mv, off):
     kind = mv[off]
     off += 1
-    (ln,) = _U32.unpack_from(mv, off)
-    off += 4
+    (ln,) = _U64.unpack_from(mv, off)
+    off += 8
     payload = mv[off:off + ln]
     off += ln
     if kind == 0:
@@ -120,7 +121,7 @@ def _read_exact(sock, n):
 
 
 def read_msg(sock) -> List:
-    (ln,) = _U32.unpack(_read_exact(sock, 4))
+    (ln,) = _U64.unpack(_read_exact(sock, 8))
     return decode(_read_exact(sock, ln))
 
 
@@ -197,6 +198,10 @@ class RpcClient:
             raise ConnectionError("cannot reach pserver %s: %s"
                                   % (endpoint, last))
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # blocking after connect: barrier/collective waits legitimately
+        # exceed any fixed recv timeout (first-step compiles, slow ranks);
+        # the SERVER side owns wait timeouts and always answers
+        self._sock.settimeout(None)
         self._lock = threading.Lock()
 
     def call(self, method: str, *args) -> List:

@@ -221,9 +221,50 @@ class InMemoryDataset(DatasetBase):
         self._do_shuffle = True
 
     def global_shuffle(self, fleet=None, thread_num=12):
-        # single-host: same as local shuffle; multi-host exchange is done
-        # by sharding the filelist per worker at set_filelist time
+        """Cross-trainer record exchange (reference: data_set.h:111
+        Dataset::GlobalShuffle over Gloo). Multi-host: every trainer
+        allgathers the record set over the host-collective store
+        (distributed/host_collectives.py — the Gloo-equivalent tier),
+        applies one shared global permutation, and keeps its
+        rank-strided slice. Single-host: local shuffle."""
         self._do_shuffle = True
+        from ..distributed.host_collectives import group_from_env
+
+        group = group_from_env()
+        if group is None:
+            return
+        if self._examples is None:
+            self.load_into_memory()
+        try:
+            # sharded exchange (reference Dataset::GlobalShuffle routes
+            # each record to exactly ONE target): never materialize the
+            # whole dataset on any rank. Each rank permutes its local
+            # records and deals them round-robin to targets; the store
+            # holds only in-flight per-edge blobs (removed on take).
+            seed = int(group.broadcast(
+                np.asarray([np.random.randint(0, 2**31 - 1)], np.int64),
+                root=0)[0])
+            rng = np.random.RandomState((seed + 131 * group.rank)
+                                        % (2**31 - 1))
+            perm = rng.permutation(len(self._examples))
+            buckets = [[] for _ in range(group.world)]
+            for pos, idx in enumerate(perm):
+                buckets[pos % group.world].append(self._examples[idx])
+            for dst in range(group.world):
+                group.put("shuf/%d/%d" % (group.rank, dst),
+                          _encode_examples(buckets[dst]))
+            received = []
+            for src in range(group.world):
+                received.extend(_decode_examples(
+                    group.take("shuf/%d/%d" % (src, group.rank))))
+            np.random.RandomState((seed * 7 + group.rank)
+                                  % (2**31 - 1)).shuffle(received)
+            self._examples = received
+            # all ranks must finish their takes before rank 0 tears the
+            # store down (slow-rank race otherwise)
+            group.barrier()
+        finally:
+            group.shutdown()
 
     def release_memory(self):
         self._examples = None
@@ -260,3 +301,52 @@ class InMemoryDataset(DatasetBase):
                 lod = np.concatenate([[0], np.cumsum(counts)])
                 slots.append((np.concatenate(vals_list), lod))
             yield self._decode_batch(slots)
+
+
+def _encode_examples(examples) -> "np.ndarray":
+    """Serialize [example][slot] = (vals, lod) into one uint8 blob.
+    Layout is per-SLOT concatenation (vals concat + per-example value
+    counts + lod concat + per-example lod lengths): 4 npz members per
+    slot regardless of example count, instead of 2 members per
+    (example, slot) — zip-member overhead stays O(slots), not
+    O(records)."""
+    import io
+
+    n_slots = len(examples[0]) if examples else 0
+    arrays = {"__n__": np.asarray([len(examples), n_slots], np.int64)}
+    for s_i in range(n_slots):
+        vals_list = [np.asarray(ex[s_i][0]) for ex in examples]
+        lods_list = [np.asarray(ex[s_i][1]) for ex in examples]
+        arrays["v%d" % s_i] = np.concatenate(vals_list) if vals_list \
+            else np.zeros((0,), np.float32)
+        arrays["vc%d" % s_i] = np.asarray(
+            [v.size for v in vals_list], np.int64)
+        arrays["l%d" % s_i] = np.concatenate(lods_list) if lods_list \
+            else np.zeros((0,), np.int64)
+        arrays["lc%d" % s_i] = np.asarray(
+            [l.size for l in lods_list], np.int64)
+    bio = io.BytesIO()
+    np.savez(bio, **arrays)
+    return np.frombuffer(bio.getvalue(), dtype=np.uint8)
+
+
+def _decode_examples(blob: "np.ndarray"):
+    import io
+
+    with np.load(io.BytesIO(blob.tobytes())) as z:
+        n_examples, n_slots = (int(v) for v in z["__n__"])
+        per_slot = []
+        for s_i in range(n_slots):
+            vals = z["v%d" % s_i]
+            vc = np.cumsum(np.concatenate([[0], z["vc%d" % s_i]]))
+            lods = z["l%d" % s_i]
+            lc = np.cumsum(np.concatenate([[0], z["lc%d" % s_i]]))
+            per_slot.append((vals, vc, lods, lc))
+        out = []
+        for i in range(n_examples):
+            ex = []
+            for vals, vc, lods, lc in per_slot:
+                ex.append((vals[vc[i]:vc[i + 1]],
+                           lods[lc[i]:lc[i + 1]]))
+            out.append(ex)
+    return out

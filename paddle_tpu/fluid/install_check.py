@@ -1,0 +1,47 @@
+"""fluid.install_check (reference: python/paddle/fluid/install_check.py):
+run_check() trains a tiny linear model in both execution modes and over
+the local device mesh, printing a success message."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def run_check():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.fluid import framework
+
+    # static
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+            y = fluid.layers.fc(input=x, size=1)
+            loss = fluid.layers.mean(y)
+            fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    scope = Scope()
+    exe = fluid.Executor()
+    exe.run(startup, scope=scope)
+    out = exe.run(main, feed={"x": np.ones((4, 2), "float32")},
+                  fetch_list=[loss], scope=scope)
+    assert np.isfinite(np.asarray(out[0])).all()
+
+    # dygraph
+    from paddle_tpu.fluid import dygraph
+
+    with dygraph.guard():
+        lin = dygraph.nn.Linear(2, 1)
+        t = dygraph.to_variable(np.ones((4, 2), "float32"))
+        l = fluid.layers.mean(lin(t))
+        l.backward()
+        assert lin.weight._grad is not None
+
+    import jax
+
+    print("Your paddle-tpu works well on %d %s device(s)."
+          % (len(jax.devices()), jax.default_backend().upper()))
+    print("install_check passed.")
+
+
+if __name__ == "__main__":
+    run_check()

@@ -1,0 +1,9 @@
+"""paddle.jit 2.0-style namespace (reference: python/paddle/fluid/dygraph/
+jit.py surfaced as paddle.jit in 2.0): to_static compilation, TracedLayer
+capture, save/load of translated programs."""
+from .fluid.dygraph.jit import (  # noqa: F401
+    declarative, to_static, TracedLayer, save, load,
+)
+from .fluid.dygraph.dygraph_to_static.program_translator import (  # noqa: F401
+    ProgramTranslator,
+)

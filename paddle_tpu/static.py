@@ -1,0 +1,15 @@
+"""paddle.static 2.0-style namespace (reference: the 2.0 re-export of the
+fluid static-graph API)."""
+from .fluid.framework import (  # noqa: F401
+    Program, program_guard, default_main_program,
+    default_startup_program, name_scope,
+)
+from .fluid.executor import Executor  # noqa: F401
+from .fluid.compiler import CompiledProgram  # noqa: F401
+from .fluid.backward import append_backward, gradients  # noqa: F401
+from .fluid.io import (  # noqa: F401
+    save_inference_model, load_inference_model, save, load,
+)
+from .fluid.layers.tensor import data  # noqa: F401
+from .fluid import nets  # noqa: F401
+from . import nn  # noqa: F401

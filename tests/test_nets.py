@@ -49,3 +49,17 @@ def test_nets_attention_and_seq_conv_pool(rng):
                   fetch_list=[att, scp], scope=scope)
     assert np.asarray(out[0]).shape == (2, 6, 16)
     assert np.asarray(out[1]).shape == (2, 8)
+
+
+def test_jit_static_namespaces_and_install_check(capsys):
+    import paddle_tpu.jit as jit
+    import paddle_tpu.static as static
+
+    assert callable(jit.to_static) and callable(jit.declarative)
+    assert static.Program is not None and callable(static.data)
+
+    from paddle_tpu.fluid import install_check
+
+    install_check.run_check()
+    out = capsys.readouterr().out
+    assert "install_check passed" in out
