@@ -21,3 +21,5 @@ from . import linalg_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import beam_search_ops  # noqa: F401
 from . import quant_ops  # noqa: F401
+from . import fused_ops  # noqa: F401
+from . import array_ops  # noqa: F401

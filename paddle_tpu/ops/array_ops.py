@@ -1,0 +1,118 @@
+"""LoDTensorArray operators.
+
+Reference parity: `paddle/fluid/operators/controlflow/
+tensor_array_read_write_op.cc` (array_write/array_read),
+`lod_array_length_op.cc`, `array_to_lod_tensor_op.cc`,
+`lod_rank_table_op.cc`. TPU-native: a tensor array with a STATIC max
+length is a stacked [T, ...] buffer (XLA-friendly); write = dynamic
+update slice, read = dynamic slice — the representation lax.scan uses
+internally. The python TensorArray helper in layers/control_flow wraps
+these for While bodies."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+@register_op("array_write")
+def _array_write(ins, attrs):
+    # A stacked buffer [T, ...]; I scalar index; X the value. OutLen
+    # tracks the logical length (max written index + 1) so
+    # lod_array_length can answer reference semantics; under jit an
+    # out-of-range CONCRETE index raises (traced indices follow
+    # dynamic_update_slice clamping, documented).
+    arr = ins["Array"][0] if ins.get("Array") else None
+    x = ins["X"][0]
+    i = jnp.reshape(ins["I"][0], ()).astype(jnp.int32)
+    if arr is None:
+        max_len = attrs.get("max_len", 64)
+        arr = jnp.zeros((max_len,) + x.shape, x.dtype)
+    try:
+        ci = int(i)
+        if ci >= arr.shape[0]:
+            raise IndexError(
+                "array_write index %d out of range for TensorArray of "
+                "max_len %d" % (ci, arr.shape[0]))
+    except (TypeError, jax.errors.TracerIntegerConversionError,
+            jax.errors.ConcretizationTypeError):
+        pass
+    prev_len = jnp.reshape(ins["Len"][0], ()).astype(jnp.int32) \
+        if ins.get("Len") else jnp.int32(0)
+    return {"Out": jax.lax.dynamic_update_slice(
+        arr, x[None], (i,) + (0,) * x.ndim),
+        "OutLen": jnp.maximum(prev_len, i + 1)}
+
+
+@register_op("array_read")
+def _array_read(ins, attrs):
+    arr = ins["Array"][0] if ins.get("Array") else ins["X"][0]
+    i = jnp.reshape(ins["I"][0], ()).astype(jnp.int32)
+    out = jax.lax.dynamic_slice(
+        arr, (i,) + (0,) * (arr.ndim - 1), (1,) + arr.shape[1:])
+    return {"Out": out[0]}
+
+
+@register_op("lod_array_length")
+def _lod_array_length(ins, attrs):
+    # reference: number of elements WRITTEN; thread array_write's OutLen
+    # through the Len input to get it. Without it, the static buffer
+    # capacity is the only answer available (documented fallback).
+    if ins.get("Len"):
+        return {"Out": jnp.reshape(ins["Len"][0], (1,)).astype(
+            jnp.int64)}
+    arr = ins["X"][0]
+    return {"Out": jnp.asarray([arr.shape[0]], jnp.int64)}
+
+
+@register_op("array_to_lod_tensor")
+def _array_to_lod_tensor(ins, attrs):
+    # stacked [T, B, ...] -> concat over time into [T*B, ...]
+    arr = ins["X"][0]
+    return {"Out": arr.reshape((-1,) + arr.shape[2:])}
+
+
+@register_op("lod_tensor_to_array")
+def _lod_tensor_to_array(ins, attrs):
+    x = ins["X"][0]
+    t = attrs.get("max_len", x.shape[0])
+    return {"Out": x.reshape((t, -1) + x.shape[1:])}
+
+
+@register_op("lod_rank_table")
+def _lod_rank_table(ins, attrs):
+    # rank table = sequence indices sorted by length desc; with padded
+    # representation + Length input
+    if ins.get("Length"):
+        length = ins["Length"][0].reshape(-1)
+    else:
+        x = ins["X"][0]
+        length = jnp.full((x.shape[0],), x.shape[1]
+                          if x.ndim > 1 else 1, jnp.int64)
+    order = jnp.argsort(-length, stable=True)
+    return {"Out": order.astype(jnp.int64)}
+
+
+@register_op("max_sequence_len")
+def _max_sequence_len(ins, attrs):
+    if ins.get("Length"):
+        return {"Out": jnp.max(ins["Length"][0]).astype(jnp.int64)}
+    x = ins["RankTable"][0] if ins.get("RankTable") else ins["X"][0]
+    return {"Out": jnp.asarray(x.shape[0], jnp.int64)}
+
+
+@register_op("shrink_rnn_memory")
+def _shrink_rnn_memory(ins, attrs):
+    # reference: shrink_rnn_memory_op.cc — keep the first k rows (the
+    # still-active sequences at this timestep); static-shape version
+    # masks instead of shrinking
+    x = ins["X"][0]
+    i = jnp.reshape(ins["I"][0], ()).astype(jnp.int32) if ins.get("I") \
+        else 0
+    if ins.get("Length"):
+        length = ins["Length"][0].reshape(-1)
+        active = (length > i).astype(x.dtype)
+        return {"Out": x * active.reshape(
+            (-1,) + (1,) * (x.ndim - 1))}
+    return {"Out": x}

@@ -1,0 +1,200 @@
+"""Fused operators.
+
+Reference parity: `paddle/fluid/operators/fused/` — CUDA kernels that
+hand-fuse chains the GPU compiler can't (fused_elemwise_activation,
+fused_embedding_seq_pool, fusion_gru/fusion_lstm, multihead_matmul,
+fused_fc_elementwise_layernorm, fused_embedding_eltwise_layernorm).
+TPU-native: these register the same op TYPES for program compatibility
+but compose the unfused jnp pieces — XLA's fusion pass produces the
+fused kernels the reference wrote by hand (SURVEY.md §7: fusion passes
+become thin layers over the compiler)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op, get_op
+
+
+_UNARY = {
+    "relu": jax.nn.relu, "sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+    "identity": lambda x: x, "": lambda x: x,
+    "gelu": jax.nn.gelu,
+}
+
+
+def _unary(name, attrs):
+    if name == "scale":
+        sc = attrs.get("scale", 1.0)
+        return lambda x: x * sc
+    return _UNARY[name]
+
+
+def _layernorm(h, eps, scale=None, bias=None):
+    # shared epilogue (f32 stats like the registered layer_norm op)
+    hf = h.astype(jnp.float32)
+    mu = jnp.mean(hf, -1, keepdims=True)
+    var = jnp.mean(jnp.square(hf - mu), -1, keepdims=True)
+    out = (hf - mu) / jnp.sqrt(var + eps)
+    if scale is not None:
+        out = out * scale
+    if bias is not None:
+        out = out + bias
+    return out.astype(h.dtype), mu, var
+
+_BINARY = {
+    "elementwise_add": jnp.add, "elementwise_mul": jnp.multiply,
+    "elementwise_sub": jnp.subtract,
+}
+
+
+def _bcast(x, y, axis):
+    if x.ndim == y.ndim:
+        return x, y
+    if axis < 0:
+        axis = x.ndim - y.ndim
+    return x, y.reshape((1,) * axis + y.shape
+                        + (1,) * (x.ndim - axis - y.ndim))
+
+
+@register_op("fused_elemwise_activation")
+def _fused_elemwise_activation(ins, attrs):
+    # reference: fused_elemwise_activation_op.cc — functor_list like
+    # ["elementwise_add", "relu"] (binary then unary) or reversed
+    x, y = ins["X"][0], ins["Y"][0]
+    functors = [f.strip() for f in attrs["functor_list"]]
+    axis = attrs.get("axis", -1)
+    if functors[0] in _BINARY:
+        xb, yb = _bcast(x, y, axis)
+        mid = _BINARY[functors[0]](xb, yb)
+        out = _unary(functors[1], attrs)(mid)
+    else:
+        mid = _unary(functors[0], attrs)(y)
+        xb, yb = _bcast(x, mid, axis)
+        out = _BINARY[functors[1]](xb, yb)
+    return {"Out": out, "IntermediateOut": mid}
+
+
+@register_op("fused_embedding_seq_pool")
+def _fused_embedding_seq_pool(ins, attrs):
+    # reference: fused_embedding_seq_pool_op.cc — lookup + sum pool
+    w, ids = ins["W"][0], ins["Ids"][0]
+    emb = jnp.take(w, ids.reshape(ids.shape[:2] + (-1,))[..., 0]
+                   if ids.ndim > 2 else ids, axis=0)
+    return {"Out": jnp.sum(emb, axis=1)}
+
+
+@register_op("fused_fc_elementwise_layernorm")
+def _fused_fc_eltwise_ln(ins, attrs):
+    x, w = ins["X"][0], ins["W"][0]
+    y = ins["Y"][0]
+    h = x.reshape(x.shape[0], -1) @ w
+    if ins.get("Bias0"):
+        h = h + ins["Bias0"][0]
+    h = h + y
+    out, mu, var = _layernorm(
+        h, attrs.get("epsilon", 1e-5),
+        scale=ins["Scale"][0] if ins.get("Scale") else None,
+        bias=ins["Bias1"][0] if ins.get("Bias1") else None)
+    return {"Out": out, "Mean": mu[..., 0], "Variance": var[..., 0]}
+
+
+@register_op("fused_embedding_eltwise_layernorm")
+def _fused_embedding_eltwise_ln(ins, attrs):
+    # reference: fused/fused_embedding_eltwise_layernorm_op.cc — sum of
+    # N embeddings + layernorm (BERT input block)
+    embs = []
+    for w, ids in zip(ins["Embs"], ins["Ids"]):
+        idx = ids.reshape(ids.shape[:2]) if ids.ndim == 3 else ids
+        embs.append(jnp.take(w, idx, axis=0))
+    h = sum(embs)
+    out, _, _ = _layernorm(h, attrs.get("epsilon", 1e-5),
+                           scale=ins["Scale"][0], bias=ins["Bias"][0])
+    return {"Out": out}
+
+
+@register_op("multihead_matmul")
+def _multihead_matmul(ins, attrs):
+    # reference: fused/multihead_matmul_op.cu — fused QKV attention for
+    # inference; Input [B, S, 3*H*D] packed or separate W path
+    x = ins["Input"][0]
+    w = ins["W"][0]          # [D_in, 3, H, D_h]
+    bias = ins["Bias"][0]    # [3, H, D_h]
+    bias_qk = ins["BiasQK"][0] if ins.get("BiasQK") else None
+    n_head = attrs["head_number"]
+    b, s, d_in = x.shape
+    qkv = jnp.einsum("bsd,dkhe->bkhse", x,
+                     w.reshape(d_in, 3, n_head, -1))
+    qkv = qkv + bias.reshape(1, 3, n_head, 1, -1)
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # [b, h, s, dh]
+    dh = q.shape[-1]
+    # reference op carries the QK scale in `alpha` (exporters bake the
+    # chosen scale in; do NOT override it with 1/sqrt(dh))
+    alpha = attrs.get("alpha", 1.0 / math.sqrt(dh))
+    scores = (q @ jnp.swapaxes(k, -1, -2)) * alpha
+    if bias_qk is not None:
+        scores = scores + bias_qk
+    probs = jax.nn.softmax(scores, -1)
+    ctx = probs @ v
+    return {"Out": ctx.transpose(0, 2, 1, 3).reshape(b, s, -1)}
+
+
+@register_op("fusion_gru")
+def _fusion_gru(ins, attrs):
+    # reference: fused/fusion_gru_op.cc — inputs {X, WeightX (D,3H),
+    # WeightH (H,3H), Bias (1,3H), H0}; adapt layouts to the scanned
+    # gru_seq kernel (WeightIh/WeightHh are (3H,*), split biases)
+    x = ins["X"][0]
+    wx = ins["WeightX"][0]
+    wh = ins["WeightH"][0]
+    bias = ins["Bias"][0].reshape(-1) if ins.get("Bias") else \
+        jnp.zeros((wx.shape[1],), x.dtype)
+    h0 = ins["H0"][0] if ins.get("H0") else \
+        jnp.zeros((x.shape[0], wh.shape[0]), x.dtype)
+    out = get_op("gru_seq").compute(
+        {"Input": [x], "WeightIh": [wx.T], "WeightHh": [wh.T],
+         "BiasIh": [bias], "BiasHh": [jnp.zeros_like(bias)],
+         "InitH": [h0]}, attrs)
+    return {"Hidden": out["Out"], "XX": out["Out"]}
+
+
+@register_op("fusion_lstm")
+def _fusion_lstm(ins, attrs):
+    # reference: fused/fusion_lstm_op.cc — {X, WeightX (D,4H),
+    # WeightH (H,4H), Bias (1,4H), H0, C0}
+    x = ins["X"][0]
+    wx = ins["WeightX"][0]
+    wh = ins["WeightH"][0]
+    bias = ins["Bias"][0].reshape(-1)[:wx.shape[1]] if ins.get("Bias") \
+        else jnp.zeros((wx.shape[1],), x.dtype)
+    h0 = ins["H0"][0] if ins.get("H0") else \
+        jnp.zeros((x.shape[0], wh.shape[0]), x.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros_like(h0)
+    out = get_op("lstm_seq").compute(
+        {"Input": [x], "WeightIh": [wx.T], "WeightHh": [wh.T],
+         "Bias": [bias], "InitH": [h0], "InitC": [c0]}, attrs)
+    return {"Hidden": out["Out"], "Cell": out.get("CellOut",
+                                                  out["Out"])}
+
+
+@register_op("fusion_seqconv_eltadd_relu")
+def _fusion_seqconv_eltadd_relu(ins, attrs):
+    conv = get_op("sequence_conv").compute(
+        {"X": ins["X"], "Filter": ins["Filter"]}, attrs)["Out"]
+    if ins.get("Bias"):
+        conv = conv + ins["Bias"][0]
+    return {"Out": jax.nn.relu(conv)}
+
+
+@register_op("fused_gemm_epilogue")
+def _fused_gemm_epilogue(ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    out = x @ y
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0]
+    act = attrs.get("activation", "none")
+    if act in _UNARY:
+        out = _UNARY[act](out)
+    return {"Out": out}

@@ -483,3 +483,130 @@ class TestProximalOps(OpTest):
             np.abs(prox) - 0.1 * 0.01, 0) / (1 + 0.1 * 0.01)
         self.outputs = {"ParamOut": expect}
         self.check_output()
+
+
+class TestFusedOps(OpTest):
+    def test_fused_elemwise_activation(self):
+        r = np.random.RandomState(20)
+        self.op_type = "fused_elemwise_activation"
+        x = r.randn(4, 6).astype("float32")
+        y = r.randn(6).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"functor_list": ["elementwise_add", "relu"],
+                      "axis": -1}
+        outs = self._run_forward()
+        np.testing.assert_allclose(np.asarray(outs["Out"][0]),
+                                   np.maximum(x + y, 0), rtol=1e-6)
+
+    def test_multihead_matmul(self):
+        r = np.random.RandomState(21)
+        self.op_type = "multihead_matmul"
+        b, s, d, h = 2, 5, 8, 2
+        x = r.randn(b, s, d).astype("float32")
+        w = r.randn(d, 3, h, d // h).astype("float32")
+        bias = np.zeros((3, h, d // h), "float32")
+        self.inputs = {"Input": x, "W": w, "Bias": bias}
+        self.attrs = {"head_number": h}
+        out = np.asarray(self._run_forward()["Out"][0])
+        assert out.shape == (b, s, d)
+        assert np.isfinite(out).all()
+
+    def test_fused_gemm_epilogue(self):
+        r = np.random.RandomState(22)
+        self.op_type = "fused_gemm_epilogue"
+        x = r.randn(3, 4).astype("float32")
+        y = r.randn(4, 5).astype("float32")
+        bias = r.randn(5).astype("float32")
+        self.inputs = {"X": x, "Y": y, "Bias": bias}
+        self.attrs = {"activation": "relu"}
+        self.outputs = {"Out": np.maximum(x @ y + bias, 0)}
+        self.check_output()
+
+
+class TestArrayOps(OpTest):
+    def test_write_read_roundtrip(self):
+        import jax.numpy as jnp
+        import paddle_tpu.ops as ops_lib
+
+        arr = None
+        vals = [np.full((2, 3), float(i), "float32") for i in range(3)]
+        length = None
+        for i, v in enumerate(vals):
+            ins = {"X": [jnp.asarray(v)],
+                   "I": [jnp.asarray([i], jnp.int32)]}
+            if arr is not None:
+                ins["Array"] = [arr]
+            if length is not None:
+                ins["Len"] = [length]
+            outs = ops_lib.run_op("array_write", ins, {"max_len": 4})
+            arr = outs["Out"][0]
+            length = outs["OutLen"][0]
+        for i, v in enumerate(vals):
+            got = ops_lib.run_op(
+                "array_read",
+                {"Array": [arr], "I": [jnp.asarray([i], jnp.int32)]},
+                {})["Out"][0]
+            np.testing.assert_allclose(np.asarray(got), v)
+        # reference semantics: number WRITTEN (3), not capacity (4)
+        ln = ops_lib.run_op("lod_array_length",
+                            {"X": [arr], "Len": [length]}, {})
+        assert int(np.asarray(ln["Out"][0])[0]) == 3
+        # concrete out-of-range write raises
+        import pytest
+
+        with pytest.raises(IndexError):
+            ops_lib.run_op("array_write",
+                           {"Array": [arr], "X": [jnp.ones((2, 3))],
+                            "I": [jnp.asarray([9], jnp.int32)]}, {})
+
+    def test_lod_rank_table(self):
+        import jax.numpy as jnp
+        import paddle_tpu.ops as ops_lib
+
+        out = ops_lib.run_op(
+            "lod_rank_table",
+            {"X": [jnp.zeros((3, 5))],
+             "Length": [jnp.asarray([2, 5, 3])]}, {})
+        np.testing.assert_array_equal(np.asarray(out["Out"][0]),
+                                      [1, 2, 0])
+
+
+class TestFusionRNNSignatures(OpTest):
+    def test_fusion_gru_reference_layout(self):
+        import jax.numpy as jnp
+        import paddle_tpu.ops as ops_lib
+
+        r = np.random.RandomState(23)
+        b, t, d, h = 2, 4, 3, 5
+        x = r.randn(b, t, d).astype("float32")
+        wx = r.randn(d, 3 * h).astype("float32")   # reference (D, 3H)
+        wh = r.randn(h, 3 * h).astype("float32")   # reference (H, 3H)
+        bias = r.randn(1, 3 * h).astype("float32")
+        out = ops_lib.run_op(
+            "fusion_gru",
+            {"X": [jnp.asarray(x)], "WeightX": [jnp.asarray(wx)],
+             "WeightH": [jnp.asarray(wh)], "Bias": [jnp.asarray(bias)]},
+            {})
+        hid = np.asarray(out["Hidden"][0])
+        assert hid.shape == (b, t, h)
+        assert np.isfinite(hid).all()
+
+    def test_fusion_lstm_reference_layout(self):
+        import jax.numpy as jnp
+        import paddle_tpu.ops as ops_lib
+
+        r = np.random.RandomState(24)
+        b, t, d, h = 2, 4, 3, 5
+        out = ops_lib.run_op(
+            "fusion_lstm",
+            {"X": [jnp.asarray(r.randn(b, t, d).astype("float32"))],
+             "WeightX": [jnp.asarray(
+                 r.randn(d, 4 * h).astype("float32"))],
+             "WeightH": [jnp.asarray(
+                 r.randn(h, 4 * h).astype("float32"))],
+             "Bias": [jnp.asarray(
+                 r.randn(1, 4 * h).astype("float32"))]},
+            {})
+        hid = np.asarray(out["Hidden"][0])
+        assert hid.shape == (b, t, h)
+        assert np.isfinite(hid).all()
