@@ -91,15 +91,22 @@ def _lod_rank_table(ins, attrs):
         length = jnp.full((x.shape[0],), x.shape[1]
                           if x.ndim > 1 else 1, jnp.int64)
     order = jnp.argsort(-length, stable=True)
-    return {"Out": order.astype(jnp.int64)}
+    return {"Out": order.astype(jnp.int64),
+            "Lengths": length[order].astype(jnp.int64)}
 
 
 @register_op("max_sequence_len")
 def _max_sequence_len(ins, attrs):
+    # the rank table alone holds ORDER indices, not lengths; demand a
+    # real length source rather than silently returning batch size
     if ins.get("Length"):
         return {"Out": jnp.max(ins["Length"][0]).astype(jnp.int64)}
-    x = ins["RankTable"][0] if ins.get("RankTable") else ins["X"][0]
-    return {"Out": jnp.asarray(x.shape[0], jnp.int64)}
+    if ins.get("Lengths"):
+        return {"Out": jnp.max(ins["Lengths"][0]).astype(jnp.int64)}
+    raise NotImplementedError(
+        "max_sequence_len needs a Length/Lengths input (feed "
+        "lod_rank_table's Lengths output); the rank-table order alone "
+        "does not carry sequence lengths in the padded representation")
 
 
 @register_op("shrink_rnn_memory")

@@ -66,14 +66,17 @@ def _fused_elemwise_activation(ins, attrs):
     x, y = ins["X"][0], ins["Y"][0]
     functors = [f.strip() for f in attrs["functor_list"]]
     axis = attrs.get("axis", -1)
+    # reference fused_elemwise_activation_op.h: the FIRST functor is the
+    # OUTER one — ["elementwise_add","scale"] = add(X, scale(Y)),
+    # ["scale","elementwise_add"] = scale(add(X, Y))
     if functors[0] in _BINARY:
-        xb, yb = _bcast(x, y, axis)
-        mid = _BINARY[functors[0]](xb, yb)
-        out = _unary(functors[1], attrs)(mid)
-    else:
-        mid = _unary(functors[0], attrs)(y)
+        mid = _unary(functors[1], attrs)(y)
         xb, yb = _bcast(x, mid, axis)
-        out = _BINARY[functors[1]](xb, yb)
+        out = _BINARY[functors[0]](xb, yb)
+    else:
+        xb, yb = _bcast(x, y, axis)
+        mid = _BINARY[functors[1]](xb, yb)
+        out = _unary(functors[0], attrs)(mid)
     return {"Out": out, "IntermediateOut": mid}
 
 
@@ -143,40 +146,93 @@ def _multihead_matmul(ins, attrs):
 
 @register_op("fusion_gru")
 def _fusion_gru(ins, attrs):
-    # reference: fused/fusion_gru_op.cc — inputs {X, WeightX (D,3H),
-    # WeightH (H,3H), Bias (1,3H), H0}; adapt layouts to the scanned
-    # gru_seq kernel (WeightIh/WeightHh are (3H,*), split biases)
+    """Reference: fused/fusion_gru_op.cc — inputs {X, WeightX (D,3H),
+    WeightH (H,3H), Bias (1,3H), H0}. Paddle GRU semantics (NOT the
+    torch-style r,z,n cell): gate columns are [update, reset |
+    candidate]; candidate = act(x_c + (r (.) h_prev) @ W_c);
+    h_t = u (.) h_prev + (1-u) (.) candidate. XX is the input
+    projection x @ WeightX (+bias), as the reference emits."""
+    import jax as _jax
+
     x = ins["X"][0]
-    wx = ins["WeightX"][0]
-    wh = ins["WeightH"][0]
+    wx = ins["WeightX"][0]          # (D, 3H)
+    wh = ins["WeightH"][0]          # (H, 3H)
+    H = wh.shape[0]
     bias = ins["Bias"][0].reshape(-1) if ins.get("Bias") else \
-        jnp.zeros((wx.shape[1],), x.dtype)
+        jnp.zeros((3 * H,), x.dtype)
     h0 = ins["H0"][0] if ins.get("H0") else \
-        jnp.zeros((x.shape[0], wh.shape[0]), x.dtype)
-    out = get_op("gru_seq").compute(
-        {"Input": [x], "WeightIh": [wx.T], "WeightHh": [wh.T],
-         "BiasIh": [bias], "BiasHh": [jnp.zeros_like(bias)],
-         "InitH": [h0]}, attrs)
-    return {"Hidden": out["Out"], "XX": out["Out"]}
+        jnp.zeros((x.shape[0], H), x.dtype)
+    act = _UNARY.get(attrs.get("activation", "tanh"), jnp.tanh)
+    gate_act = _UNARY.get(attrs.get("gate_activation", "sigmoid"),
+                          jax.nn.sigmoid)
+    reverse = attrs.get("is_reverse", False)
+
+    xx = x @ wx + bias              # [B, T, 3H]
+    xs = jnp.swapaxes(xx, 0, 1)
+    if reverse:
+        xs = xs[::-1]
+    wh_g = wh[:, :2 * H]            # update|reset recurrence
+    wh_c = wh[:, 2 * H:]            # candidate recurrence
+
+    def step(h, xp):
+        g = gate_act(xp[:, :2 * H] + h @ wh_g)
+        u, r = g[:, :H], g[:, H:]
+        c = act(xp[:, 2 * H:] + (r * h) @ wh_c)
+        h_new = u * h + (1.0 - u) * c
+        return h_new, h_new
+
+    _, hs = _jax.lax.scan(step, h0, xs)
+    if reverse:
+        hs = hs[::-1]
+    return {"Hidden": jnp.swapaxes(hs, 0, 1), "XX": xx}
 
 
 @register_op("fusion_lstm")
 def _fusion_lstm(ins, attrs):
-    # reference: fused/fusion_lstm_op.cc — {X, WeightX (D,4H),
-    # WeightH (H,4H), Bias (1,4H), H0, C0}
+    """Reference: fused/fusion_lstm_op.cc — {X, WeightX (D,4H),
+    WeightH (H,4H), Bias (1,4H), H0, C0}; gate columns [i, c, f, o]
+    (Paddle lstm order: input, candidate, forget, output). Emits BOTH
+    the hidden and cell sequences."""
+    import jax as _jax
+
     x = ins["X"][0]
     wx = ins["WeightX"][0]
     wh = ins["WeightH"][0]
-    bias = ins["Bias"][0].reshape(-1)[:wx.shape[1]] if ins.get("Bias") \
-        else jnp.zeros((wx.shape[1],), x.dtype)
+    H = wh.shape[0]
+    bias = ins["Bias"][0].reshape(-1)[:4 * H] if ins.get("Bias") else \
+        jnp.zeros((4 * H,), x.dtype)
     h0 = ins["H0"][0] if ins.get("H0") else \
-        jnp.zeros((x.shape[0], wh.shape[0]), x.dtype)
+        jnp.zeros((x.shape[0], H), x.dtype)
     c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros_like(h0)
-    out = get_op("lstm_seq").compute(
-        {"Input": [x], "WeightIh": [wx.T], "WeightHh": [wh.T],
-         "Bias": [bias], "InitH": [h0], "InitC": [c0]}, attrs)
-    return {"Hidden": out["Out"], "Cell": out.get("CellOut",
-                                                  out["Out"])}
+    act = _UNARY.get(attrs.get("candidate_activation", "tanh"),
+                     jnp.tanh)
+    gate_act = _UNARY.get(attrs.get("gate_activation", "sigmoid"),
+                          jax.nn.sigmoid)
+    cell_act = _UNARY.get(attrs.get("cell_activation", "tanh"),
+                          jnp.tanh)
+    reverse = attrs.get("is_reverse", False)
+
+    xx = x @ wx + bias
+    xs = jnp.swapaxes(xx, 0, 1)
+    if reverse:
+        xs = xs[::-1]
+
+    def step(carry, xp):
+        h, c = carry
+        proj = xp + h @ wh
+        i = gate_act(proj[:, :H])
+        cand = act(proj[:, H:2 * H])
+        f = gate_act(proj[:, 2 * H:3 * H])
+        o = gate_act(proj[:, 3 * H:])
+        c_new = f * c + i * cand
+        h_new = o * cell_act(c_new)
+        return (h_new, c_new), (h_new, c_new)
+
+    _, (hs, cs) = _jax.lax.scan(step, (h0, c0), xs)
+    if reverse:
+        hs, cs = hs[::-1], cs[::-1]
+    return {"Hidden": jnp.swapaxes(hs, 0, 1),
+            "Cell": jnp.swapaxes(cs, 0, 1), "XX": xx}
 
 
 @register_op("fusion_seqconv_eltadd_relu")

@@ -112,3 +112,106 @@ def _precision_recall(ins, attrs):
     return {"BatchMetrics": metrics(batch_states),
             "AccumMetrics": metrics(acc_states),
             "AccumStatesInfo": acc_states}
+
+
+@register_op("edit_distance", no_jit=True)
+def _edit_distance(ins, attrs):
+    """Levenshtein distance between hypothesis and reference token
+    sequences (reference: operators/edit_distance_op.cc). Host-side:
+    dynamic-programming over ragged rows."""
+    import numpy as np
+
+    hyp = np.asarray(ins["Hyps"][0])
+    ref = np.asarray(ins["Refs"][0])
+    hyp_len = np.asarray(ins["HypsLength"][0]).reshape(-1) \
+        if ins.get("HypsLength") else np.full((hyp.shape[0],),
+                                              hyp.shape[1])
+    ref_len = np.asarray(ins["RefsLength"][0]).reshape(-1) \
+        if ins.get("RefsLength") else np.full((ref.shape[0],),
+                                              ref.shape[1])
+    normalized = attrs.get("normalized", False)
+    out = np.zeros((hyp.shape[0], 1), np.float32)
+    for b in range(hyp.shape[0]):
+        h = hyp[b, :int(hyp_len[b])]
+        r = ref[b, :int(ref_len[b])]
+        m, n = len(h), len(r)
+        dp = np.arange(n + 1, dtype=np.int64)
+        for i in range(1, m + 1):
+            prev = dp.copy()
+            dp[0] = i
+            for j in range(1, n + 1):
+                dp[j] = min(prev[j] + 1, dp[j - 1] + 1,
+                            prev[j - 1] + (h[i - 1] != r[j - 1]))
+        d = float(dp[n])
+        out[b, 0] = d / max(n, 1) if normalized else d
+    return {"Out": out,
+            "SequenceNum": np.asarray([hyp.shape[0]], np.int64)}
+
+
+@register_op("chunk_eval", no_jit=True)
+def _chunk_eval(ins, attrs):
+    """Chunk-level precision/recall/F1 for sequence labeling
+    (reference: operators/metrics/chunk_eval_op.cc). Schemes: IOB
+    (default), IOE, plain; others raise."""
+    import numpy as np
+
+    inference = np.asarray(ins["Inference"][0]).reshape(-1)
+    label = np.asarray(ins["Label"][0]).reshape(-1)
+    num_chunk_types = attrs["num_chunk_types"]
+    scheme = attrs.get("chunk_scheme", "IOB")
+    if scheme not in ("IOB", "IOE", "plain"):
+        raise NotImplementedError(
+            "chunk_scheme %r not supported (IOB, IOE, plain)" % scheme)
+
+    def chunks(tags):
+        out = []
+        start, ctype = None, None
+        for i, t in enumerate(tags):
+            t = int(t)
+            is_outside = (t >= num_chunk_types if scheme == "plain"
+                          else t >= num_chunk_types * 2)
+            if is_outside:
+                if start is not None:
+                    out.append((start, i, ctype))
+                start, ctype = None, None
+                continue
+            if scheme == "plain":
+                if ctype != t:
+                    if start is not None:
+                        out.append((start, i, ctype))
+                    start, ctype = i, t
+                continue
+            ct, mark = divmod(t, 2)  # IOB: mark=1 is I; IOE: mark=1 is E
+            if scheme == "IOB":
+                if mark == 0:  # B starts a chunk
+                    if start is not None:
+                        out.append((start, i, ctype))
+                    start, ctype = i, ct
+                elif start is None or ctype != ct:
+                    if start is not None:
+                        out.append((start, i, ctype))
+                    start, ctype = i, ct
+            else:  # IOE
+                if start is None or ctype != ct:
+                    if start is not None:
+                        out.append((start, i, ctype))
+                    start, ctype = i, ct
+                if mark == 1:  # E closes the chunk
+                    out.append((start, i + 1, ctype))
+                    start, ctype = None, None
+        if start is not None:
+            out.append((start, len(tags), ctype))
+        return set(out)
+
+    pred = chunks(inference)
+    gold = chunks(label)
+    correct = len(pred & gold)
+    prec = correct / len(pred) if pred else 0.0
+    rec = correct / len(gold) if gold else 0.0
+    f1 = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
+    return {"Precision": np.asarray([prec], np.float32),
+            "Recall": np.asarray([rec], np.float32),
+            "F1-Score": np.asarray([f1], np.float32),
+            "NumInferChunks": np.asarray([len(pred)], np.int64),
+            "NumLabelChunks": np.asarray([len(gold)], np.int64),
+            "NumCorrectChunks": np.asarray([correct], np.int64)}

@@ -245,3 +245,31 @@ def _lod_reset(ins, attrs):
 def _sequence_number_count(ins, attrs):
     x = ins["X"][0]
     return {"Out": jnp.sum(jnp.ones_like(x, jnp.int64))}
+
+
+@register_op("ctc_align", no_jit=True)
+def _ctc_align(ins, attrs):
+    """CTC decode alignment: merge repeats then drop blanks (reference:
+    operators/ctc_align_op.cc). Host-side (ragged output compacted to
+    padded-with-zeros rows)."""
+    import numpy as np
+
+    x = np.asarray(ins["Input"][0])
+    blank = attrs.get("blank", 0)
+    merge = attrs.get("merge_repeated", True)
+    padding_value = attrs.get("padding_value", 0)
+    out = np.full_like(x, padding_value)
+    lengths = np.zeros((x.shape[0],), np.int64)
+    for b in range(x.shape[0]):
+        prev = None
+        k = 0
+        for t in x[b]:
+            t = int(t)
+            if merge and prev == t:
+                continue
+            prev = t
+            if t != blank:
+                out[b, k] = t
+                k += 1
+        lengths[b] = k
+    return {"Output": out, "OutputLength": lengths.reshape(-1, 1)}

@@ -492,11 +492,18 @@ class TestFusedOps(OpTest):
         x = r.randn(4, 6).astype("float32")
         y = r.randn(6).astype("float32")
         self.inputs = {"X": x, "Y": y}
+        # reference semantics: FIRST functor is OUTER —
+        # ["elementwise_add","relu"] = x + relu(y)
         self.attrs = {"functor_list": ["elementwise_add", "relu"],
                       "axis": -1}
         outs = self._run_forward()
         np.testing.assert_allclose(np.asarray(outs["Out"][0]),
-                                   np.maximum(x + y, 0), rtol=1e-6)
+                                   x + np.maximum(y, 0), rtol=1e-6)
+        self.attrs = {"functor_list": ["scale", "elementwise_add"],
+                      "scale": 0.5, "axis": -1}
+        outs = self._run_forward()
+        np.testing.assert_allclose(np.asarray(outs["Out"][0]),
+                                   0.5 * (x + y), rtol=1e-6)
 
     def test_multihead_matmul(self):
         r = np.random.RandomState(21)
@@ -589,7 +596,22 @@ class TestFusionRNNSignatures(OpTest):
             {})
         hid = np.asarray(out["Hidden"][0])
         assert hid.shape == (b, t, h)
-        assert np.isfinite(hid).all()
+        xx = np.asarray(out["XX"][0])
+        assert xx.shape == (b, t, 3 * h)
+        # golden: paddle GRU recurrence [u, r | c],
+        # c = tanh(x_c + (r*h) Wc), h = u*h + (1-u)*c
+        def sigmoid(v):
+            return 1.0 / (1.0 + np.exp(-v))
+
+        hh = np.zeros((b, h), "float32")
+        xproj = x @ wx + bias.reshape(-1)
+        for ti in range(t):
+            g = sigmoid(xproj[:, ti, :2 * h] + hh @ wh[:, :2 * h])
+            u, r = g[:, :h], g[:, h:]
+            c = np.tanh(xproj[:, ti, 2 * h:] + (r * hh) @ wh[:, 2 * h:])
+            hh = u * hh + (1 - u) * c
+            np.testing.assert_allclose(hid[:, ti], hh, rtol=2e-5,
+                                       atol=1e-5)
 
     def test_fusion_lstm_reference_layout(self):
         import jax.numpy as jnp
@@ -608,5 +630,43 @@ class TestFusionRNNSignatures(OpTest):
                  r.randn(1, 4 * h).astype("float32"))]},
             {})
         hid = np.asarray(out["Hidden"][0])
-        assert hid.shape == (b, t, h)
+        cell = np.asarray(out["Cell"][0])
+        assert hid.shape == cell.shape == (b, t, h)
         assert np.isfinite(hid).all()
+        assert not np.allclose(hid, cell)  # cell is the c-sequence
+
+
+class TestEditDistanceChunkEvalCtc(OpTest):
+    def test_edit_distance(self):
+        self.op_type = "edit_distance"
+        hyp = np.array([[1, 2, 3, 0]], "int64")
+        ref = np.array([[1, 3, 3, 4]], "int64")
+        self.inputs = {"Hyps": hyp, "Refs": ref,
+                       "HypsLength": np.array([3], "int64"),
+                       "RefsLength": np.array([4], "int64")}
+        outs = self._run_forward()
+        # "123" vs "1334": sub 2->3, insert 4 => distance 2
+        assert float(np.asarray(outs["Out"][0])[0, 0]) == 2.0
+
+    def test_chunk_eval(self):
+        self.op_type = "chunk_eval"
+        # IOB with 2 types: B0=0 I0=1 B1=2 I1=3 O=4
+        label = np.array([0, 1, 4, 2, 3, 4], "int64")
+        pred = np.array([0, 1, 4, 2, 4, 4], "int64")
+        self.inputs = {"Inference": pred, "Label": label}
+        self.attrs = {"num_chunk_types": 2}
+        outs = self._run_forward()
+        # gold: (0,2,t0),(3,5,t1); pred: (0,2,t0),(3,4,t1) -> 1 correct
+        np.testing.assert_allclose(
+            np.asarray(outs["Precision"][0]), [0.5])
+        np.testing.assert_allclose(np.asarray(outs["Recall"][0]), [0.5])
+
+    def test_ctc_align(self):
+        self.op_type = "ctc_align"
+        x = np.array([[0, 1, 1, 0, 2, 2, 0, 3]], "int32")
+        self.inputs = {"Input": x}
+        self.attrs = {"blank": 0, "merge_repeated": True}
+        outs = self._run_forward()
+        got = np.asarray(outs["Output"][0])[0]
+        np.testing.assert_array_equal(got[:3], [1, 2, 3])
+        assert int(np.asarray(outs["OutputLength"][0])[0, 0]) == 3
