@@ -1,1 +1,2 @@
 from . import mixed_precision  # noqa: F401
+from . import model_stats  # noqa: F401

@@ -1,0 +1,89 @@
+"""Model statistics tools.
+
+Reference parity: `python/paddle/fluid/contrib/model_stat.py` (summary
+of params/FLOPs per layer) and `contrib/memory_usage_calc.py` (estimate
+of a program's memory footprint). TPU note: the real device numbers come
+from `core.memory.memory_stats()` (PJRT); these static estimates mirror
+the reference's var-size walk for pre-run sizing."""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+from .. import framework
+
+_DTYPE_BYTES = {"float32": 4, "float64": 8, "float16": 2, "bfloat16": 2,
+                "int64": 8, "int32": 4, "int16": 2, "int8": 1, "uint8": 1,
+                "bool": 1}
+
+
+def summary(program=None, batch_size=1) -> Dict:
+    """Per-op param/FLOPs table (reference: model_stat.summary).
+    Returns {"total_params", "total_flops", "rows": [...]}. FLOPs are
+    counted for the matmul-bearing ops (mul/matmul/conv2d) the way the
+    reference does; elementwise work is omitted (XLA fuses it anyway)."""
+    program = program or framework.default_main_program()
+    block = program.global_block()
+    rows = []
+    total_params = 0
+    total_flops = 0
+    for v in block.vars.values():
+        if isinstance(v, framework.Parameter):
+            n = int(np.prod([d for d in v.shape if d > 0])) \
+                if v.shape else 1
+            total_params += n
+    for op in block.ops:
+        flops = _op_flops(block, op, batch_size)
+        if flops:
+            rows.append((op.type, flops))
+            total_flops += flops
+    return {"total_params": total_params, "total_flops": total_flops,
+            "rows": rows}
+
+
+def _shape_of(block, name, batch_size=1) -> Tuple[int, ...]:
+    v = block._find_var_recursive(name)
+    if v is None or not v.shape:
+        return ()
+    return tuple(int(d) if d > 0 else int(batch_size)
+                 for d in v.shape)
+
+
+def _op_flops(block, op, batch_size):
+    t = op.type
+    if t in ("mul", "matmul", "matmul_v2"):
+        xs = _shape_of(block, op.input_names["X"][0], batch_size)
+        ys = _shape_of(block, op.input_names["Y"][0])
+        if len(xs) >= 2 and len(ys) >= 2:
+            m = int(np.prod(xs[:-1]))
+            return 2 * m * xs[-1] * ys[-1]
+    if t in ("conv2d", "depthwise_conv2d"):
+        out = _shape_of(block, op.output_names["Output"][0],
+                        batch_size)
+        w = _shape_of(block, op.input_names["Filter"][0])
+        if len(out) == 4 and len(w) == 4:
+            return 2 * int(np.prod(out)) * w[1] * w[2] * w[3]
+    return 0
+
+
+def memory_usage(program=None, batch_size=1) -> Dict:
+    """Static estimate of a program's variable footprint (reference:
+    memory_usage_calc.memory_usage). The batch dim (-1) is filled with
+    batch_size."""
+    program = program or framework.default_main_program()
+    block = program.global_block()
+    persistable = 0
+    activations = 0
+    for v in block.vars.values():
+        if not v.shape:
+            continue
+        n = int(np.prod([d if d > 0 else batch_size for d in v.shape]))
+        nbytes = n * _DTYPE_BYTES.get(str(v.dtype), 4)
+        if v.persistable:
+            persistable += nbytes
+        else:
+            activations += nbytes
+    return {"persistable_bytes": persistable,
+            "activation_bytes": activations,
+            "total_bytes": persistable + activations}

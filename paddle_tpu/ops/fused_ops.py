@@ -150,10 +150,9 @@ def _fusion_gru(ins, attrs):
     WeightH (H,3H), Bias (1,3H), H0}. Paddle GRU semantics (NOT the
     torch-style r,z,n cell): gate columns are [update, reset |
     candidate]; candidate = act(x_c + (r (.) h_prev) @ W_c);
-    h_t = u (.) h_prev + (1-u) (.) candidate. XX is the input
-    projection x @ WeightX (+bias), as the reference emits."""
-    import jax as _jax
-
+    h_t = u (.) candidate + (1-u) (.) h_prev (jit/refer/refer.h
+    GRUHtPart2: out = zt*ht~ + (1-zt)*ht_1). XX is the input projection
+    x @ WeightX (+bias), as the reference emits."""
     x = ins["X"][0]
     wx = ins["WeightX"][0]          # (D, 3H)
     wh = ins["WeightH"][0]          # (H, 3H)
@@ -178,10 +177,10 @@ def _fusion_gru(ins, attrs):
         g = gate_act(xp[:, :2 * H] + h @ wh_g)
         u, r = g[:, :H], g[:, H:]
         c = act(xp[:, 2 * H:] + (r * h) @ wh_c)
-        h_new = u * h + (1.0 - u) * c
+        h_new = u * c + (1.0 - u) * h
         return h_new, h_new
 
-    _, hs = _jax.lax.scan(step, h0, xs)
+    _, hs = jax.lax.scan(step, h0, xs)
     if reverse:
         hs = hs[::-1]
     return {"Hidden": jnp.swapaxes(hs, 0, 1), "XX": xx}
@@ -189,12 +188,11 @@ def _fusion_gru(ins, attrs):
 
 @register_op("fusion_lstm")
 def _fusion_lstm(ins, attrs):
-    """Reference: fused/fusion_lstm_op.cc — {X, WeightX (D,4H),
-    WeightH (H,4H), Bias (1,4H), H0, C0}; gate columns [i, c, f, o]
-    (Paddle lstm order: input, candidate, forget, output). Emits BOTH
-    the hidden and cell sequences."""
-    import jax as _jax
-
+    """Reference: fused/fusion_lstm_op.cc:162 — {X, WeightX (D,4H),
+    WeightH (H,4H), Bias (1,4H), H0, C0}; gate columns [c, i, f, o]
+    (CANDIDATE first: W = {W_cx, W_ix, W_fx, W_ox}, confirmed by
+    jit/refer/refer.h:170). Emits BOTH the hidden and cell
+    sequences."""
     x = ins["X"][0]
     wx = ins["WeightX"][0]
     wh = ins["WeightH"][0]
@@ -220,15 +218,15 @@ def _fusion_lstm(ins, attrs):
     def step(carry, xp):
         h, c = carry
         proj = xp + h @ wh
-        i = gate_act(proj[:, :H])
-        cand = act(proj[:, H:2 * H])
+        cand = act(proj[:, :H])
+        i = gate_act(proj[:, H:2 * H])
         f = gate_act(proj[:, 2 * H:3 * H])
         o = gate_act(proj[:, 3 * H:])
         c_new = f * c + i * cand
         h_new = o * cell_act(c_new)
         return (h_new, c_new), (h_new, c_new)
 
-    _, (hs, cs) = _jax.lax.scan(step, (h0, c0), xs)
+    _, (hs, cs) = jax.lax.scan(step, (h0, c0), xs)
     if reverse:
         hs, cs = hs[::-1], cs[::-1]
     return {"Hidden": jnp.swapaxes(hs, 0, 1),

@@ -155,13 +155,22 @@ def _chunk_eval(ins, attrs):
     (default), IOE, plain; others raise."""
     import numpy as np
 
-    inference = np.asarray(ins["Inference"][0]).reshape(-1)
-    label = np.asarray(ins["Label"][0]).reshape(-1)
+    inference = np.asarray(ins["Inference"][0])
+    label = np.asarray(ins["Label"][0])
     num_chunk_types = attrs["num_chunk_types"]
     scheme = attrs.get("chunk_scheme", "IOB")
+    excluded = set(attrs.get("excluded_chunk_types", []) or [])
     if scheme not in ("IOB", "IOE", "plain"):
         raise NotImplementedError(
             "chunk_scheme %r not supported (IOB, IOE, plain)" % scheme)
+    # batched [B, T] input: segment per sequence (SeqLength bounds each
+    # row; without it, the full row). 1-D input = one sequence.
+    if inference.ndim == 1:
+        inference = inference[None, :]
+        label = label[None, :]
+    seq_len = np.asarray(ins["SeqLength"][0]).reshape(-1) \
+        if ins.get("SeqLength") else np.full((inference.shape[0],),
+                                             inference.shape[1])
 
     def chunks(tags):
         out = []
@@ -203,8 +212,13 @@ def _chunk_eval(ins, attrs):
             out.append((start, len(tags), ctype))
         return set(out)
 
-    pred = chunks(inference)
-    gold = chunks(label)
+    pred, gold = set(), set()
+    for b in range(inference.shape[0]):
+        n = int(seq_len[b])
+        pred |= {(b,) + c for c in chunks(inference[b, :n])
+                 if c[2] not in excluded}
+        gold |= {(b,) + c for c in chunks(label[b, :n])
+                 if c[2] not in excluded}
     correct = len(pred & gold)
     prec = correct / len(pred) if pred else 0.0
     rec = correct / len(gold) if gold else 0.0

@@ -259,11 +259,13 @@ def _ctc_align(ins, attrs):
     merge = attrs.get("merge_repeated", True)
     padding_value = attrs.get("padding_value", 0)
     out = np.full_like(x, padding_value)
+    in_len = np.asarray(ins["InputLength"][0]).reshape(-1) \
+        if ins.get("InputLength") else np.full((x.shape[0],), x.shape[1])
     lengths = np.zeros((x.shape[0],), np.int64)
     for b in range(x.shape[0]):
         prev = None
         k = 0
-        for t in x[b]:
+        for t in x[b, :int(in_len[b])]:
             t = int(t)
             if merge and prev == t:
                 continue

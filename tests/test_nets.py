@@ -63,3 +63,21 @@ def test_jit_static_namespaces_and_install_check(capsys):
     install_check.run_check()
     out = capsys.readouterr().out
     assert "install_check passed" in out
+
+
+def test_model_stats_summary_and_memory(rng):
+    from paddle_tpu.fluid.contrib import model_stats
+
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+            h = fluid.layers.fc(input=x, size=32)
+            y = fluid.layers.fc(input=h, size=8)
+    st = model_stats.summary(main, batch_size=4)
+    # params: 16*32 + 32 + 32*8 + 8
+    assert st["total_params"] == 16 * 32 + 32 + 32 * 8 + 8
+    assert st["total_flops"] > 0
+    mem = model_stats.memory_usage(main, batch_size=4)
+    assert mem["persistable_bytes"] >= st["total_params"] * 4
+    assert mem["total_bytes"] > mem["persistable_bytes"]

@@ -599,7 +599,8 @@ class TestFusionRNNSignatures(OpTest):
         xx = np.asarray(out["XX"][0])
         assert xx.shape == (b, t, 3 * h)
         # golden: paddle GRU recurrence [u, r | c],
-        # c = tanh(x_c + (r*h) Wc), h = u*h + (1-u)*c
+        # c = tanh(x_c + (r*h) Wc), h = u*c + (1-u)*h
+        # (jit/refer/refer.h GRUHtPart2: out = zt*ht~ + (1-zt)*ht_1)
         def sigmoid(v):
             return 1.0 / (1.0 + np.exp(-v))
 
@@ -609,7 +610,7 @@ class TestFusionRNNSignatures(OpTest):
             g = sigmoid(xproj[:, ti, :2 * h] + hh @ wh[:, :2 * h])
             u, r = g[:, :h], g[:, h:]
             c = np.tanh(xproj[:, ti, 2 * h:] + (r * hh) @ wh[:, 2 * h:])
-            hh = u * hh + (1 - u) * c
+            hh = u * c + (1 - u) * hh
             np.testing.assert_allclose(hid[:, ti], hh, rtol=2e-5,
                                        atol=1e-5)
 
@@ -661,6 +662,22 @@ class TestEditDistanceChunkEvalCtc(OpTest):
             np.asarray(outs["Precision"][0]), [0.5])
         np.testing.assert_allclose(np.asarray(outs["Recall"][0]), [0.5])
 
+    def test_chunk_eval_batched_seqlength(self):
+        self.op_type = "chunk_eval"
+        # two rows; row0 valid len 2, row1 valid len 2: a chunk must NOT
+        # span the row boundary and padding must not be scored
+        pred = np.array([[0, 1, 0, 0], [1, 4, 0, 0]], "int64")
+        label = np.array([[0, 1, 4, 4], [0, 4, 0, 0]], "int64")
+        self.inputs = {"Inference": pred, "Label": label,
+                       "SeqLength": np.array([2, 2], "int64")}
+        self.attrs = {"num_chunk_types": 2}
+        outs = self._run_forward()
+        # row0: gold {(0,2,t0)} pred {(0,2,t0)} correct;
+        # row1: gold {(0,1,t0)} pred {(0,1,t0)} (I at start opens chunk)
+        np.testing.assert_allclose(
+            np.asarray(outs["Precision"][0]), [1.0])
+        assert int(np.asarray(outs["NumInferChunks"][0])[0]) == 2
+
     def test_ctc_align(self):
         self.op_type = "ctc_align"
         x = np.array([[0, 1, 1, 0, 2, 2, 0, 3]], "int32")
@@ -670,3 +687,12 @@ class TestEditDistanceChunkEvalCtc(OpTest):
         got = np.asarray(outs["Output"][0])[0]
         np.testing.assert_array_equal(got[:3], [1, 2, 3])
         assert int(np.asarray(outs["OutputLength"][0])[0, 0]) == 3
+        # InputLength bounds decoding; padding_value fills the tail
+        self.inputs = {"Input": x,
+                       "InputLength": np.array([4], "int64")}
+        self.attrs = {"blank": 0, "merge_repeated": True,
+                      "padding_value": -1}
+        outs = self._run_forward()
+        got = np.asarray(outs["Output"][0])[0]
+        assert int(np.asarray(outs["OutputLength"][0])[0, 0]) == 1
+        np.testing.assert_array_equal(got[:2], [1, -1])
