@@ -23,3 +23,6 @@ from . import beam_search_ops  # noqa: F401
 from . import quant_ops  # noqa: F401
 from . import fused_ops  # noqa: F401
 from . import array_ops  # noqa: F401
+from . import interp_ops  # noqa: F401
+from . import rnn_unit_ops  # noqa: F401
+from . import vision_extra_ops  # noqa: F401

@@ -1,0 +1,165 @@
+"""Interpolation (resize) operators.
+
+Reference parity: `paddle/fluid/operators/interpolate_op.cc` — the
+{linear,bilinear,trilinear,nearest,bicubic}_interp op family with the
+reference's `align_corners` / `align_mode` source-index conventions:
+
+- align_corners=True:          src = dst * (in - 1) / (out - 1)
+- align_corners=False, mode 0: src = (dst + 0.5) * in / out - 0.5
+- align_corners=False, mode 1: src = dst * in / out
+- nearest (align_corners=False): src = floor(dst * in / out)
+- bicubic always uses the half-pixel rule when align_corners=False.
+
+TPU-native design: each resize is a separable per-axis gather + weighted
+sum built from static output sizes (attrs `out_{d,h,w}` or `scale`), so
+XLA sees static shapes and fuses the gathers; there is no dynamic-shape
+OutSize path inside jit (an eager OutSize tensor is folded to static ints
+before tracing by the layer wrapper).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _src_index(out_size, in_size, align_corners, align_mode):
+    """Fractional source coordinates for one axis (linear-family).
+    out_size == 1 forces ratio 0 (source index 0) like the reference
+    (`interpolate_op.h` sets ratio only when out > 1)."""
+    i = jnp.arange(out_size, dtype=jnp.float32)
+    if out_size <= 1:
+        return jnp.zeros((out_size,), jnp.float32)
+    if align_corners:
+        ratio = (in_size - 1.0) / (out_size - 1.0)
+        src = i * ratio
+    elif align_mode == 1:
+        src = i * (in_size / out_size)
+    else:
+        src = (i + 0.5) * (in_size / out_size) - 0.5
+    return jnp.clip(src, 0.0, in_size - 1.0)
+
+
+def _linear_axis(x, axis, out_size, align_corners, align_mode):
+    in_size = x.shape[axis]
+    src = _src_index(out_size, in_size, align_corners, align_mode)
+    i0 = jnp.floor(src).astype(jnp.int32)
+    i1 = jnp.minimum(i0 + 1, in_size - 1)
+    w1 = (src - i0).astype(x.dtype)
+    w0 = (1.0 - w1).astype(x.dtype)
+    shape = [1] * x.ndim
+    shape[axis] = out_size
+    g0 = jnp.take(x, i0, axis=axis)
+    g1 = jnp.take(x, i1, axis=axis)
+    return g0 * w0.reshape(shape) + g1 * w1.reshape(shape)
+
+
+def _cubic_weight(t):
+    """Cubic convolution kernel, a=-0.75 (reference bicubic_interp)."""
+    a = -0.75
+    t = jnp.abs(t)
+    w_inner = ((a + 2.0) * t - (a + 3.0)) * t * t + 1.0
+    w_outer = ((a * t - 5.0 * a) * t + 8.0 * a) * t - 4.0 * a
+    return jnp.where(t <= 1.0, w_inner,
+                     jnp.where(t < 2.0, w_outer, 0.0))
+
+
+def _cubic_axis(x, axis, out_size, align_corners):
+    in_size = x.shape[axis]
+    i = jnp.arange(out_size, dtype=jnp.float32)
+    if out_size <= 1:
+        src = jnp.zeros((out_size,), jnp.float32)
+    elif align_corners:
+        src = i * ((in_size - 1.0) / (out_size - 1.0))
+    else:
+        src = (i + 0.5) * (in_size / out_size) - 0.5
+    i0 = jnp.floor(src).astype(jnp.int32)
+    frac = src - i0
+    shape = [1] * x.ndim
+    shape[axis] = out_size
+    out = 0.0
+    for k in range(-1, 3):
+        idx = jnp.clip(i0 + k, 0, in_size - 1)
+        w = _cubic_weight(frac - k).astype(x.dtype)
+        out = out + jnp.take(x, idx, axis=axis) * w.reshape(shape)
+    return out
+
+
+def _nearest_axis(x, axis, out_size, align_corners):
+    in_size = x.shape[axis]
+    i = jnp.arange(out_size, dtype=jnp.float32)
+    if out_size <= 1:
+        idx = jnp.zeros((out_size,), jnp.float32)
+    elif align_corners:
+        # reference rounds half UP: static_cast<int>(ratio * k + 0.5)
+        idx = jnp.floor(i * ((in_size - 1.0) / (out_size - 1.0)) + 0.5)
+    else:
+        idx = jnp.floor(i * (in_size / out_size))
+    return jnp.take(x, jnp.clip(idx.astype(jnp.int32), 0, in_size - 1),
+                    axis=axis)
+
+
+def _layout_axes(x, attrs, n_spatial):
+    """Spatial axes + requested output sizes for NCX / NXC layouts."""
+    layout = attrs.get("data_layout", "NCHW")
+    channel_last = layout in ("NHWC", "NDHWC", "NWC")
+    axes = list(range(1, 1 + n_spatial)) if channel_last else \
+        list(range(2, 2 + n_spatial))
+    keys = {1: ["out_w"], 2: ["out_h", "out_w"],
+            3: ["out_d", "out_h", "out_w"]}[n_spatial]
+    sizes = []
+    scale = attrs.get("scale", 0.0)
+    for key, ax in zip(keys, axes):
+        out = int(attrs.get(key, -1) or -1)
+        if out <= 0:
+            if not scale or scale <= 0:
+                raise ValueError(
+                    "interp op needs %s or a positive scale attr" % key)
+            out = int(x.shape[ax] * scale)
+        sizes.append(out)
+    return axes, sizes
+
+
+def _linear_family(ins, attrs, n_spatial):
+    x = ins["X"][0]
+    axes, sizes = _layout_axes(x, attrs, n_spatial)
+    ac = bool(attrs.get("align_corners", True))
+    am = int(attrs.get("align_mode", 1))
+    for ax, size in zip(axes, sizes):
+        x = _linear_axis(x, ax, size, ac, am)
+    return {"Out": x}
+
+
+@register_op("linear_interp")
+def _linear_interp(ins, attrs):
+    return _linear_family(ins, attrs, 1)
+
+
+@register_op("bilinear_interp")
+def _bilinear_interp(ins, attrs):
+    return _linear_family(ins, attrs, 2)
+
+
+@register_op("trilinear_interp")
+def _trilinear_interp(ins, attrs):
+    return _linear_family(ins, attrs, 3)
+
+
+@register_op("bicubic_interp")
+def _bicubic_interp(ins, attrs):
+    x = ins["X"][0]
+    axes, sizes = _layout_axes(x, attrs, 2)
+    ac = bool(attrs.get("align_corners", True))
+    for ax, size in zip(axes, sizes):
+        x = _cubic_axis(x, ax, size, ac)
+    return {"Out": x}
+
+
+@register_op("nearest_interp")
+def _nearest_interp(ins, attrs):
+    x = ins["X"][0]
+    axes, sizes = _layout_axes(x, attrs, 2)
+    ac = bool(attrs.get("align_corners", True))
+    for ax, size in zip(axes, sizes):
+        x = _nearest_axis(x, ax, size, ac)
+    return {"Out": x}

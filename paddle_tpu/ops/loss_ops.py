@@ -173,3 +173,40 @@ def _cross_entropy2(ins, attrs):
     xent = -jnp.log(jnp.maximum(p, 1e-20))
     return {"Y": xent, "XShape": jnp.zeros_like(x),
             "MatchX": p}
+
+
+@register_op("bce_loss")
+def _bce_loss(ins, attrs):
+    # reference: bce_loss_op.cc — inputs are probabilities, not logits
+    x, label = ins["X"][0], ins["Label"][0]
+    x = jnp.clip(x, 1e-12, 1.0 - 1e-12)
+    out = -(label * jnp.log(x) + (1.0 - label) * jnp.log(1.0 - x))
+    return {"Out": out}
+
+
+@register_op("nll_loss")
+def _nll_loss(ins, attrs):
+    # reference: nll_loss_op.cc — X is log-probabilities [N, C] or
+    # [N, C, d1, d2]; Label int64; optional per-class Weight.
+    x, label = ins["X"][0], ins["Label"][0]
+    reduction = attrs.get("reduction", "mean")
+    ignore_index = int(attrs.get("ignore_index", -100))
+    c_axis = 1
+    lbl = label.astype(jnp.int32)
+    safe = jnp.where(lbl == ignore_index, 0, lbl)
+    picked = jnp.take_along_axis(x, safe[:, None] if x.ndim == 2
+                                 else safe[:, None, ...], c_axis)
+    picked = jnp.squeeze(picked, c_axis)
+    if ins.get("Weight"):
+        w = ins["Weight"][0][safe]
+    else:
+        w = jnp.ones_like(picked)
+    w = jnp.where(lbl == ignore_index, 0.0, w)
+    loss = -picked * w
+    if reduction == "none":
+        return {"Out": loss, "Total_weight": jnp.sum(w)}
+    total_w = jnp.sum(w)
+    if reduction == "sum":
+        return {"Out": jnp.sum(loss), "Total_weight": total_w}
+    return {"Out": jnp.sum(loss) / jnp.maximum(total_w, 1e-12),
+            "Total_weight": total_w}

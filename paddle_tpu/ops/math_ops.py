@@ -388,3 +388,38 @@ def _searchsorted(ins, attrs):
     side = "right" if attrs.get("right", False) else "left"
     return {"Out": jnp.searchsorted(sorted_seq.reshape(-1), values,
                                     side=side).astype(jnp.int64)}
+
+
+@register_op("minus")
+def _minus(ins, attrs):
+    return {"Out": ins["X"][0] - ins["Y"][0]}
+
+
+@register_op("l1_norm")
+def _l1_norm(ins, attrs):
+    # reference: l1_norm_op.cc — scalar sum of |x|
+    return {"Out": jnp.sum(jnp.abs(ins["X"][0]))}
+
+
+@register_op("frobenius_norm")
+def _frobenius_norm(ins, attrs):
+    x = ins["X"][0]
+    dims = attrs.get("dim", None) or tuple(range(x.ndim))
+    keep = attrs.get("keep_dim", False)
+    return {"Out": jnp.sqrt(jnp.sum(x * x, axis=tuple(dims),
+                                    keepdims=keep))}
+
+
+@register_op("dist")
+def _dist(ins, attrs):
+    # reference: dist_op.cc — p-norm of elementwise (X - Y), broadcasting
+    x, y = ins["X"][0], ins["Y"][0]
+    p = float(attrs.get("p", 2.0))
+    z = jnp.abs(x - y)
+    if p == float("inf"):
+        return {"Out": jnp.max(z)}
+    if p == float("-inf"):
+        return {"Out": jnp.min(z)}
+    if p == 0.0:
+        return {"Out": jnp.sum((z != 0).astype(x.dtype))}
+    return {"Out": jnp.power(jnp.sum(jnp.power(z, p)), 1.0 / p)}

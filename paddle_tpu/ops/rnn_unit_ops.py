@@ -1,0 +1,291 @@
+"""Recurrent unit operators (dynamic_lstm / dynamic_gru families).
+
+Reference parity:
+- `lstm` / `lstmp`: `paddle/fluid/operators/lstm_op.cc` + the gate kernel
+  `operators/math/detail/lstm_kernel.h:30-51` — packed gate layout along
+  the 4D axis is [candidate, input_gate, forget_gate, output_gate]
+  (value_in at offset 0, ig at D, fg at 2D, og at 3D), peephole weights
+  checkI/checkF applied with the *previous* cell state and checkO with the
+  *new* state; `lstmp` (`lstmp_op.cc`) adds a recurrent projection.
+- `lstm_unit`: `operators/lstm_unit_op.h:60-75` — X packs [i, f, o, g],
+  f gets `forget_bias`, g uses tanh.
+- `gru` / `gru_unit`: `operators/gru_op.cc:166-169` — gate layout
+  [update, reset, candidate]; h = (1-u)*h_prev + u*c_tilde by default and
+  h = u*h_prev + (1-u)*c_tilde when `origin_mode` (both ops default
+  origin_mode to False, `gru_unit_op.cc:132-138`).
+- `cudnn_lstm`: `operators/cudnn_lstm_op.cc` — multi-layer (optionally
+  bidirectional) LSTM over time-major [T, B, D] input. cuDNN's opaque
+  packed weight is replaced by a documented flat layout: per layer, per
+  direction: W_ih (4H×in), W_hh (4H×H), b_ih (4H), b_hh (4H) with cuDNN
+  gate order [i, f, g, o].
+
+TPU-native design: the input-to-gate matmul is hoisted out of the
+recurrence (one big MXU matmul over [B*T]), and the recurrence itself is
+a `lax.scan` whose body is a single [B,H]x[H,4H] matmul — the same shape
+XLA pipelines well on TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+_ACT = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "identity": lambda x: x,
+}
+
+# gru_unit_op.cc encodes activations as ints: identity=0 sigmoid=1 tanh=2
+# relu=3; other rnn ops use the string names.
+_ACT_ENUM = {0: "identity", 1: "sigmoid", 2: "tanh", 3: "relu"}
+
+
+def _act(attrs, key, default):
+    v = attrs.get(key, default)
+    if isinstance(v, int):
+        v = _ACT_ENUM[v]
+    return _ACT[v]
+
+
+def _seq_mask(ins, b, t):
+    if ins.get("Length"):
+        length = ins["Length"][0].reshape((-1,))
+        return (jnp.arange(t)[None, :] < length[:, None])  # [B, T]
+    return None
+
+
+def _lstm_body(ins, attrs, proj=False):
+    """Shared dynamic_lstm / lstmp recurrence over padded [B, T, 4D]."""
+    x = ins["Input"][0]                    # [B, T, 4D] = x @ W_x (pre-done)
+    w = ins["Weight"][0]                   # [R, 4D], R = P (lstmp) or D
+    bias = ins["Bias"][0].reshape((-1,))   # [4D] or [7D] w/ peepholes
+    b, t = x.shape[0], x.shape[1]
+    d = x.shape[2] // 4
+    use_peep = bool(attrs.get("use_peepholes", True)) and \
+        bias.shape[0] >= 7 * d
+    act_gate = _ACT[attrs.get("gate_activation", "sigmoid")]
+    act_cell = _ACT[attrs.get("cell_activation", "tanh")]
+    act_cand = _ACT[attrs.get("candidate_activation", "tanh")]
+    cell_clip = float(attrs.get("cell_clip", 0.0))
+    reverse = bool(attrs.get("is_reverse", False))
+
+    gates_x = x + bias[None, None, :4 * d]
+    if use_peep:
+        ck_i, ck_f, ck_o = (bias[4 * d:5 * d], bias[5 * d:6 * d],
+                            bias[6 * d:7 * d])
+    else:
+        ck_i = ck_f = ck_o = jnp.zeros((d,), x.dtype)
+
+    if proj:
+        w_proj = ins["ProjWeight"][0]      # [D, P]
+        p = w_proj.shape[1]
+        act_proj = _ACT[attrs.get("proj_activation", "identity")]
+        proj_clip = float(attrs.get("proj_clip", 0.0))
+        r0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((b, p), x.dtype)
+    else:
+        r0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((b, d), x.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((b, d), x.dtype)
+
+    mask = _seq_mask(ins, b, t)
+    xs = jnp.swapaxes(gates_x, 0, 1)       # [T, B, 4D]
+    ms = (jnp.swapaxes(mask, 0, 1)[..., None].astype(x.dtype)
+          if mask is not None else jnp.ones((t, 1, 1), x.dtype))
+    if reverse:
+        xs, ms = xs[::-1], ms[::-1]
+
+    def step(carry, inp):
+        r_prev, c_prev = carry
+        xg, m = inp
+        gates = xg + r_prev @ w
+        cand, ig, fg, og = jnp.split(gates, 4, axis=-1)
+        cand = act_cand(cand)
+        i = act_gate(ig + c_prev * ck_i)
+        f = act_gate(fg + c_prev * ck_f)
+        c = cand * i + c_prev * f
+        if cell_clip > 0.0:
+            c = jnp.clip(c, -cell_clip, cell_clip)
+        o = act_gate(og + c * ck_o)
+        h = o * act_cell(c)
+        if proj:
+            r = act_proj(h @ w_proj)
+            if proj_clip > 0.0:
+                r = jnp.clip(r, -proj_clip, proj_clip)
+        else:
+            r = h
+        # padded steps carry state through unchanged
+        r = m * r + (1.0 - m) * r_prev
+        c = m * c + (1.0 - m) * c_prev
+        return (r, c), (r, c, h)
+
+    (_, _), (rs, cs, hs) = lax.scan(step, (r0, c0), (xs, ms))
+    if reverse:
+        rs, cs, hs = rs[::-1], cs[::-1], hs[::-1]
+    rs = jnp.swapaxes(rs, 0, 1)
+    cs = jnp.swapaxes(cs, 0, 1)
+    if proj:
+        return {"Projection": rs, "Cell": cs,
+                "Hidden": jnp.swapaxes(hs, 0, 1)}
+    return {"Hidden": rs, "Cell": cs}
+
+
+@register_op("lstm")
+def _lstm(ins, attrs):
+    return _lstm_body(ins, attrs, proj=False)
+
+
+@register_op("lstmp")
+def _lstmp(ins, attrs):
+    return _lstm_body(ins, attrs, proj=True)
+
+
+@register_op("lstm_unit")
+def _lstm_unit(ins, attrs):
+    x, c_prev = ins["X"][0], ins["C_prev"][0]
+    fb = float(attrs.get("forget_bias", 0.0))
+    d = c_prev.shape[-1]
+    i = jax.nn.sigmoid(x[:, :d])
+    f = jax.nn.sigmoid(x[:, d:2 * d] + fb)
+    o = jax.nn.sigmoid(x[:, 2 * d:3 * d])
+    g = jnp.tanh(x[:, 3 * d:])
+    c = f * c_prev + i * g
+    return {"C": c, "H": o * jnp.tanh(c)}
+
+
+def _gru_gates(xg, h_prev, w_ur, w_c, act_gate, act_node, origin):
+    d = h_prev.shape[-1]
+    ur = act_gate(xg[..., :2 * d] + h_prev @ w_ur)
+    u, r = ur[..., :d], ur[..., d:]
+    cand = act_node(xg[..., 2 * d:] + (r * h_prev) @ w_c)
+    if origin:
+        h = u * h_prev + (1.0 - u) * cand
+    else:
+        h = (1.0 - u) * h_prev + u * cand
+    return h, u, r, cand
+
+
+@register_op("gru")
+def _gru(ins, attrs):
+    x = ins["Input"][0]                    # [B, T, 3D] = x @ W_x (pre-done)
+    w = ins["Weight"][0]                   # [D, 3D]: [:, :2D] u,r; [:, 2D:] c
+    b, t = x.shape[0], x.shape[1]
+    d = x.shape[2] // 3
+    bias = (ins["Bias"][0].reshape((-1,)) if ins.get("Bias")
+            else jnp.zeros((3 * d,), x.dtype))
+    act_gate = _ACT[attrs.get("gate_activation", "sigmoid")]
+    act_node = _ACT[attrs.get("activation", "tanh")]
+    origin = bool(attrs.get("origin_mode", False))
+    reverse = bool(attrs.get("is_reverse", False))
+    w_ur, w_c = w[:, :2 * d], w[:, 2 * d:]
+
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((b, d), x.dtype)
+    mask = _seq_mask(ins, b, t)
+    xs = jnp.swapaxes(x + bias[None, None, :], 0, 1)
+    ms = (jnp.swapaxes(mask, 0, 1)[..., None].astype(x.dtype)
+          if mask is not None else jnp.ones((t, 1, 1), x.dtype))
+    if reverse:
+        xs, ms = xs[::-1], ms[::-1]
+
+    def step(h_prev, inp):
+        xg, m = inp
+        h, u, r, cand = _gru_gates(xg, h_prev, w_ur, w_c, act_gate,
+                                   act_node, origin)
+        h = m * h + (1.0 - m) * h_prev
+        return h, (h, u * m, r * m, cand * m, r * h_prev * m)
+
+    _, (hs, us, rs, cands, rhp) = lax.scan(step, h0, (xs, ms))
+    if reverse:
+        hs, us, rs, cands, rhp = (hs[::-1], us[::-1], rs[::-1],
+                                  cands[::-1], rhp[::-1])
+    sw = lambda a: jnp.swapaxes(a, 0, 1)  # noqa: E731
+    return {"Hidden": sw(hs),
+            "BatchGate": jnp.concatenate([sw(us), sw(rs), sw(cands)], -1),
+            "BatchResetHiddenPrev": sw(rhp),
+            "BatchHidden": sw(hs)}
+
+
+@register_op("gru_unit")
+def _gru_unit(ins, attrs):
+    """One GRU step. Reference `gru_unit_op.cc`: Input [B,3D] (= x@W_x),
+    HiddenPrev [B,D], Weight [D,3D], optional Bias [1,3D]; origin_mode
+    defaults to False (h = (1-u)*h_prev + u*c) like the sequence op."""
+    xg = ins["Input"][0]
+    h_prev = ins["HiddenPrev"][0]
+    w = ins["Weight"][0]
+    d = h_prev.shape[-1]
+    if ins.get("Bias"):
+        xg = xg + ins["Bias"][0].reshape((-1,))[None, :]
+    act_gate = _act(attrs, "gate_activation", 1)
+    act_node = _act(attrs, "activation", 2)
+    origin = bool(attrs.get("origin_mode", False))
+    h, u, r, cand = _gru_gates(xg, h_prev, w[:, :2 * d], w[:, 2 * d:],
+                               act_gate, act_node, origin)
+    return {"Hidden": h, "Gate": jnp.concatenate([u, r, cand], -1),
+            "ResetHiddenPrev": r * h_prev}
+
+
+@register_op("cudnn_lstm")
+def _cudnn_lstm(ins, attrs):
+    """Multi-layer (bi)LSTM over time-major [T, B, D] input. Flat weight
+    layout per (layer, direction): W_ih (4H*in), W_hh (4H*H), b_ih (4H),
+    b_hh (4H), cuDNN gate order [i, f, g, o]."""
+    x = ins["Input"][0]                    # [T, B, D]
+    flat_w = ins["W"][0].reshape((-1,))
+    hidden = int(attrs["hidden_size"])
+    n_layers = int(attrs.get("num_layers", 1))
+    bidirec = bool(attrs.get("is_bidirec", False))
+    n_dir = 2 if bidirec else 1
+    t, b, d_in = x.shape
+    h = hidden
+
+    init_h = ins["InitH"][0].reshape((n_layers * n_dir, b, h)) \
+        if ins.get("InitH") else jnp.zeros((n_layers * n_dir, b, h), x.dtype)
+    init_c = ins["InitC"][0].reshape((n_layers * n_dir, b, h)) \
+        if ins.get("InitC") else jnp.zeros((n_layers * n_dir, b, h), x.dtype)
+
+    def run_dir(seq, w_ih, w_hh, b_ih, b_hh, h0, c0, reverse):
+        xs = seq[::-1] if reverse else seq
+        xp = jnp.einsum("tbd,gd->tbg", xs, w_ih) + b_ih + b_hh
+
+        def step(carry, xg):
+            hp, cp = carry
+            gates = xg + hp @ w_hh.T
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            c = jax.nn.sigmoid(f) * cp + jax.nn.sigmoid(i) * jnp.tanh(g)
+            hh = jax.nn.sigmoid(o) * jnp.tanh(c)
+            return (hh, c), hh
+
+        (hl, cl), ys = lax.scan(step, (h0, c0), xp)
+        return (ys[::-1] if reverse else ys), hl, cl
+
+    off = 0
+
+    def take(n, shape):
+        nonlocal off
+        v = flat_w[off:off + n].reshape(shape)
+        off += n
+        return v
+
+    out = x
+    last_h, last_c = [], []
+    for layer in range(n_layers):
+        d_cur = out.shape[-1]
+        outs = []
+        for di in range(n_dir):
+            w_ih = take(4 * h * d_cur, (4 * h, d_cur))
+            w_hh = take(4 * h * h, (4 * h, h))
+            b_ih = take(4 * h, (4 * h,))
+            b_hh = take(4 * h, (4 * h,))
+            idx = layer * n_dir + di
+            ys, hl, cl = run_dir(out, w_ih, w_hh, b_ih, b_hh,
+                                 init_h[idx], init_c[idx], reverse=di == 1)
+            outs.append(ys)
+            last_h.append(hl)
+            last_c.append(cl)
+        out = jnp.concatenate(outs, -1) if n_dir == 2 else outs[0]
+    # reference output slots: Out / last_h / last_c (cudnn_lstm_op.cc:98-104)
+    return {"Out": out, "last_h": jnp.stack(last_h),
+            "last_c": jnp.stack(last_c)}

@@ -1,0 +1,495 @@
+"""Golden + grad tests for the round-2b ops batch: interpolation family,
+RNN unit ops (dynamic_lstm/gru semantics), vision extras, and the small
+math/loss additions — OpTest pattern per SURVEY.md §4.1."""
+import numpy as np
+
+from op_test import OpTest
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+# -- interpolation ----------------------------------------------------------
+
+def _np_linear_resize_axis(x, axis, out, align_corners, align_mode):
+    in_size = x.shape[axis]
+    i = np.arange(out, dtype="float64")
+    if align_corners:
+        src = i * (in_size - 1.0) / max(out - 1.0, 1.0)
+    elif align_mode == 1:
+        src = i * in_size / out
+    else:
+        src = (i + 0.5) * in_size / out - 0.5
+    src = np.clip(src, 0, in_size - 1)
+    i0 = np.floor(src).astype(int)
+    i1 = np.minimum(i0 + 1, in_size - 1)
+    w1 = src - i0
+    g0 = np.take(x, i0, axis=axis)
+    g1 = np.take(x, i1, axis=axis)
+    shape = [1] * x.ndim
+    shape[axis] = out
+    return g0 * (1 - w1).reshape(shape) + g1 * w1.reshape(shape)
+
+
+class TestBilinearInterp(OpTest):
+    def test(self):
+        r = np.random.RandomState(0)
+        x = r.randn(2, 3, 5, 7).astype("float32")
+        for ac, am in [(True, 1), (False, 0), (False, 1)]:
+            self.op_type = "bilinear_interp"
+            self.inputs = {"X": x}
+            self.attrs = {"out_h": 9, "out_w": 4, "align_corners": ac,
+                          "align_mode": am}
+            e = _np_linear_resize_axis(x.astype("float64"), 2, 9, ac, am)
+            e = _np_linear_resize_axis(e, 3, 4, ac, am)
+            self.outputs = {"Out": e.astype("float32")}
+            self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestNearestInterp(OpTest):
+    def test(self):
+        r = np.random.RandomState(1)
+        x = r.randn(2, 2, 4, 4).astype("float32")
+        self.op_type = "nearest_interp"
+        self.inputs = {"X": x}
+        self.attrs = {"out_h": 8, "out_w": 6, "align_corners": False}
+        idh = np.floor(np.arange(8) * 4 / 8).astype(int)
+        idw = np.floor(np.arange(6) * 4 / 6).astype(int)
+        self.outputs = {"Out": x[:, :, idh][:, :, :, idw]}
+        self.check_output()
+
+
+class TestTrilinearInterp(OpTest):
+    def test(self):
+        r = np.random.RandomState(2)
+        x = r.randn(1, 2, 3, 4, 5).astype("float32")
+        self.op_type = "trilinear_interp"
+        self.inputs = {"X": x}
+        self.attrs = {"out_d": 6, "out_h": 2, "out_w": 7,
+                      "align_corners": True}
+        e = x.astype("float64")
+        for ax, o in ((2, 6), (3, 2), (4, 7)):
+            e = _np_linear_resize_axis(e, ax, o, True, 1)
+        self.outputs = {"Out": e.astype("float32")}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestBicubicUpscaleExact(OpTest):
+    def test(self):
+        # identity resize must reproduce the input exactly
+        r = np.random.RandomState(3)
+        x = r.randn(1, 1, 5, 5).astype("float32")
+        self.op_type = "bicubic_interp"
+        self.inputs = {"X": x}
+        self.attrs = {"out_h": 5, "out_w": 5, "align_corners": True}
+        self.outputs = {"Out": x}
+        self.check_output()
+        self.attrs = {"out_h": 10, "out_w": 10, "align_corners": False}
+        out = self._run_forward()["Out"][0]
+        assert out.shape == (1, 1, 10, 10)
+        self.check_grad_shapes_only = True
+
+
+class TestLinearInterp(OpTest):
+    def test(self):
+        r = np.random.RandomState(4)
+        x = r.randn(2, 3, 6).astype("float32")
+        self.op_type = "linear_interp"
+        self.inputs = {"X": x}
+        self.attrs = {"out_w": 11, "align_corners": True}
+        e = _np_linear_resize_axis(x.astype("float64"), 2, 11, True, 1)
+        self.outputs = {"Out": e.astype("float32")}
+        self.check_output()
+
+
+# -- rnn units --------------------------------------------------------------
+
+class TestLstmUnit(OpTest):
+    def test(self):
+        r = np.random.RandomState(5)
+        b, d = 4, 6
+        x = r.randn(b, 4 * d).astype("float32")
+        c_prev = r.randn(b, d).astype("float32")
+        self.op_type = "lstm_unit"
+        self.inputs = {"X": x, "C_prev": c_prev}
+        self.attrs = {"forget_bias": 0.5}
+        i = _sigmoid(x[:, :d])
+        f = _sigmoid(x[:, d:2 * d] + 0.5)
+        o = _sigmoid(x[:, 2 * d:3 * d])
+        g = np.tanh(x[:, 3 * d:])
+        c = f * c_prev + i * g
+        self.outputs = {"C": c, "H": o * np.tanh(c)}
+        self.check_output()
+        self.check_grad(["X", "C_prev"], "H")
+
+
+def _np_dynamic_lstm(x, w, bias, b, t, d, use_peep):
+    """Reference lstm_kernel.h recurrence: gates [cand, i, f, o]."""
+    ck_i = bias[4 * d:5 * d] if use_peep else np.zeros(d)
+    ck_f = bias[5 * d:6 * d] if use_peep else np.zeros(d)
+    ck_o = bias[6 * d:7 * d] if use_peep else np.zeros(d)
+    h = np.zeros((b, d))
+    c = np.zeros((b, d))
+    hs, cs = [], []
+    for step in range(t):
+        gates = x[:, step] + bias[None, :4 * d] + h @ w
+        cand = np.tanh(gates[:, :d])
+        i = _sigmoid(gates[:, d:2 * d] + c * ck_i)
+        f = _sigmoid(gates[:, 2 * d:3 * d] + c * ck_f)
+        c = cand * i + c * f
+        o = _sigmoid(gates[:, 3 * d:] + c * ck_o)
+        h = o * np.tanh(c)
+        hs.append(h)
+        cs.append(c)
+    return np.stack(hs, 1), np.stack(cs, 1)
+
+
+class TestDynamicLstm(OpTest):
+    def test(self):
+        r = np.random.RandomState(6)
+        b, t, d = 2, 3, 3
+        x = r.randn(b, t, 4 * d).astype("float32")
+        w = (r.randn(d, 4 * d) * 0.1).astype("float32")
+        bias = (r.randn(7 * d) * 0.1).astype("float32")
+        self.op_type = "lstm"
+        self.inputs = {"Input": x, "Weight": w, "Bias": bias}
+        self.attrs = {"use_peepholes": True}
+        hs, cs = _np_dynamic_lstm(x.astype("float64"), w.astype("float64"),
+                                  bias.astype("float64"), b, t, d, True)
+        self.outputs = {"Hidden": hs.astype("float32"),
+                        "Cell": cs.astype("float32")}
+        self.check_output(atol=1e-4)
+        self.check_grad(["Input", "Weight"], "Hidden")
+
+
+class TestDynamicGru(OpTest):
+    def test(self):
+        r = np.random.RandomState(7)
+        b, t, d = 2, 3, 2
+        x = r.randn(b, t, 3 * d).astype("float32")
+        w = (r.randn(d, 3 * d) * 0.2).astype("float32")
+        self.op_type = "gru"
+        self.inputs = {"Input": x, "Weight": w}
+        self.attrs = {"origin_mode": False}
+        h = np.zeros((b, d))
+        hs = []
+        for step in range(t):
+            xg = x[:, step].astype("float64")
+            ur = _sigmoid(xg[:, :2 * d] + h @ w[:, :2 * d])
+            u, rr = ur[:, :d], ur[:, d:]
+            cand = np.tanh(xg[:, 2 * d:] + (rr * h) @ w[:, 2 * d:])
+            h = (1 - u) * h + u * cand
+            hs.append(h)
+        self.outputs = {"Hidden": np.stack(hs, 1).astype("float32")}
+        self.check_output(atol=1e-4, no_check_set=(
+            "BatchGate", "BatchResetHiddenPrev", "BatchHidden"))
+        self.check_grad(["Input", "Weight"], "Hidden")
+
+
+class TestGruUnit(OpTest):
+    def test(self):
+        r = np.random.RandomState(8)
+        b, d = 3, 4
+        xg = r.randn(b, 3 * d).astype("float32")
+        h_prev = r.randn(b, d).astype("float32")
+        w = (r.randn(d, 3 * d) * 0.2).astype("float32")
+        self.op_type = "gru_unit"
+        self.inputs = {"Input": xg, "HiddenPrev": h_prev, "Weight": w}
+        self.attrs = {"origin_mode": True}
+        ur = _sigmoid(xg[:, :2 * d] + h_prev @ w[:, :2 * d])
+        u, rr = ur[:, :d], ur[:, d:]
+        cand = np.tanh(xg[:, 2 * d:] + (rr * h_prev) @ w[:, 2 * d:])
+        h = u * h_prev + (1 - u) * cand
+        self.outputs = {"Hidden": h}
+        self.check_output(atol=1e-4, no_check_set=("Gate",
+                                                   "ResetHiddenPrev"))
+        self.check_grad(["Input", "HiddenPrev", "Weight"], "Hidden")
+
+
+class TestCudnnLstmShapes(OpTest):
+    def test(self):
+        r = np.random.RandomState(9)
+        t, b, d, h, layers = 5, 2, 3, 4, 2
+        x = r.randn(t, b, d).astype("float32")
+        n_dir = 2
+        sz = 0
+        d_cur = d
+        for _ in range(layers):
+            sz += n_dir * (4 * h * d_cur + 4 * h * h + 8 * h)
+            d_cur = h * n_dir
+        w = (r.randn(sz) * 0.1).astype("float32")
+        self.op_type = "cudnn_lstm"
+        self.inputs = {"Input": x, "W": w}
+        self.attrs = {"hidden_size": h, "num_layers": layers,
+                      "is_bidirec": True}
+        outs = self._run_forward()
+        assert np.asarray(outs["Out"][0]).shape == (t, b, 2 * h)
+        assert np.asarray(outs["last_h"][0]).shape == (4, b, h)
+        assert np.all(np.isfinite(np.asarray(outs["Out"][0])))
+        self.check_grad(["Input"], "Out", max_relative_error=0.01)
+
+
+class TestLstmp(OpTest):
+    def test(self):
+        r = np.random.RandomState(10)
+        b, t, d, p = 1, 2, 3, 2
+        x = r.randn(b, t, 4 * d).astype("float32")
+        w = (r.randn(p, 4 * d) * 0.1).astype("float32")
+        wp = (r.randn(d, p) * 0.1).astype("float32")
+        bias = (r.randn(4 * d) * 0.1).astype("float32")
+        self.op_type = "lstmp"
+        self.inputs = {"Input": x, "Weight": w, "ProjWeight": wp,
+                       "Bias": bias}
+        self.attrs = {"use_peepholes": False}
+        outs = self._run_forward()
+        assert np.asarray(outs["Projection"][0]).shape == (b, t, p)
+        assert np.asarray(outs["Cell"][0]).shape == (b, t, d)
+        self.check_grad(["Input", "Weight", "ProjWeight"], "Projection")
+
+
+# -- vision extras ----------------------------------------------------------
+
+class TestUnpoolRoundTrip(OpTest):
+    def test(self):
+        r = np.random.RandomState(11)
+        x = r.randn(2, 3, 8, 8).astype("float32")
+        from paddle_tpu import ops as ops_lib
+        import jax.numpy as jnp
+        pooled = ops_lib.run_op(
+            "max_pool2d_with_index", {"X": [jnp.asarray(x)]},
+            {"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]})
+        out, mask = pooled["Out"][0], pooled["Mask"][0]
+        self.op_type = "unpool"
+        self.inputs = {"X": np.asarray(out), "Indices": np.asarray(mask)}
+        self.attrs = {"unpooled_height": 8, "unpooled_width": 8}
+        res = self._run_forward()["Out"][0]
+        res = np.asarray(res)
+        # every pooled max value must land back at its argmax position
+        assert res.shape == x.shape
+        assert np.isclose(res.max(), x.max())
+        assert np.count_nonzero(res) == 2 * 3 * 4 * 4
+
+
+class TestMaxPool3DWithIndex(OpTest):
+    def test(self):
+        r = np.random.RandomState(12)
+        x = r.randn(1, 2, 4, 4, 4).astype("float32")
+        self.op_type = "max_pool3d_with_index"
+        self.inputs = {"X": x}
+        self.attrs = {"ksize": [2, 2, 2], "strides": [2, 2, 2],
+                      "paddings": [0, 0, 0]}
+        e = x.reshape(1, 2, 2, 2, 2, 2, 2, 2).transpose(
+            0, 1, 2, 4, 6, 3, 5, 7).reshape(1, 2, 2, 2, 2, 8).max(-1)
+        self.outputs = {"Out": e}
+        self.check_output(no_check_set=("Mask",))
+
+
+class TestDepthwiseConv2DTranspose(OpTest):
+    def test(self):
+        r = np.random.RandomState(13)
+        x = r.randn(1, 3, 5, 5).astype("float32")
+        w = r.randn(3, 1, 3, 3).astype("float32")
+        self.op_type = "depthwise_conv2d_transpose"
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [2, 2], "paddings": [1, 1],
+                      "groups": 3}
+        # golden: per-channel scipy-style transposed conv
+        e = np.zeros((1, 3, 11, 11), "float64")
+        for c in range(3):
+            for i in range(5):
+                for j in range(5):
+                    e[0, c, i * 2:i * 2 + 3, j * 2:j * 2 + 3] += \
+                        x[0, c, i, j] * w[c, 0]
+        e = e[:, :, 1:-1, 1:-1]
+        self.outputs = {"Output": e.astype("float32")}
+        self.check_output(atol=1e-4)
+        self.check_grad(["Input", "Filter"], "Output")
+
+
+class TestConvShift(OpTest):
+    def test(self):
+        r = np.random.RandomState(14)
+        b, n, m = 2, 7, 3
+        x = r.randn(b, n).astype("float32")
+        y = r.randn(b, m).astype("float32")
+        self.op_type = "conv_shift"
+        self.inputs = {"X": x, "Y": y}
+        e = np.zeros((b, n))
+        for bb in range(b):
+            for j in range(n):
+                for k in range(m):
+                    e[bb, j] += x[bb, (j + k - m // 2) % n] * y[bb, k]
+        self.outputs = {"Out": e.astype("float32")}
+        self.check_output()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestDeformableConvIdentityOffsets(OpTest):
+    def test(self):
+        """Zero offsets + unit mask must equal a plain convolution."""
+        r = np.random.RandomState(15)
+        x = r.randn(1, 2, 4, 4).astype("float32")
+        w = r.randn(3, 2, 3, 3).astype("float32")
+        off = np.zeros((1, 2 * 9, 4, 4), "float32")
+        mask = np.ones((1, 9, 4, 4), "float32")
+        self.op_type = "deformable_conv"
+        self.inputs = {"Input": x, "Offset": off, "Mask": mask,
+                       "Filter": w}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 1,
+                      "deformable_groups": 1}
+        import jax.numpy as jnp
+        from paddle_tpu import ops as ops_lib
+        ref = ops_lib.run_op(
+            "conv2d", {"Input": [jnp.asarray(x)], "Filter": [jnp.asarray(w)]},
+            {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+             "groups": 1})["Output"][0]
+        self.outputs = {"Output": np.asarray(ref)}
+        self.check_output(atol=1e-4)
+        self.check_grad(["Input", "Filter"], "Output",
+                        max_relative_error=0.05)
+
+
+class TestPrRoiPoolConstant(OpTest):
+    def test(self):
+        """On a constant feature map every PrRoI bin must equal the
+        constant (the integral is exact)."""
+        x = np.full((1, 2, 8, 8), 3.5, "float32")
+        rois = np.array([[1.0, 1.0, 6.0, 6.0]], "float32")
+        self.op_type = "prroi_pool"
+        self.inputs = {"X": x, "ROIs": rois}
+        self.attrs = {"pooled_height": 2, "pooled_width": 2,
+                      "spatial_scale": 1.0}
+        self.outputs = {"Out": np.full((1, 2, 2, 2), 3.5, "float32")}
+        self.check_output(atol=1e-4)
+        # batched: second image is a different constant; BatchRoINums
+        # routes each ROI to its image (reference prroi_pool_op.h:282)
+        x2 = np.concatenate([x, np.full((1, 2, 8, 8), -1.25, "float32")])
+        rois2 = np.array([[1.0, 1.0, 6.0, 6.0],
+                          [1.0, 1.0, 6.0, 6.0]], "float32")
+        self.inputs = {"X": x2, "ROIs": rois2,
+                       "BatchRoINums": np.array([1, 1], "int64")}
+        self.outputs = {"Out": np.stack(
+            [np.full((2, 2, 2), 3.5, "float32"),
+             np.full((2, 2, 2), -1.25, "float32")])}
+        self.check_output(atol=1e-4)
+
+
+class TestPsRoiPool(OpTest):
+    def test(self):
+        r = np.random.RandomState(16)
+        oc, ph, pw = 2, 2, 2
+        x = r.randn(1, oc * ph * pw, 8, 8).astype("float32")
+        rois = np.array([[0.0, 0.0, 7.0, 7.0]], "float32")
+        self.op_type = "psroi_pool"
+        self.inputs = {"X": x, "ROIs": rois}
+        self.attrs = {"pooled_height": ph, "pooled_width": pw,
+                      "output_channels": oc, "spatial_scale": 1.0}
+        out = np.asarray(self._run_forward()["Out"][0])
+        assert out.shape == (1, oc, ph, pw)
+        xs = x.reshape(oc, ph, pw, 8, 8)
+        # bin (0,0) of channel k pools xs[k,0,0][:4,:4]
+        np.testing.assert_allclose(out[0, 1, 0, 0],
+                                   xs[1, 0, 0][:4, :4].mean(),
+                                   rtol=1e-4)
+
+
+class TestBilateralSlice(OpTest):
+    def test(self):
+        r = np.random.RandomState(17)
+        n, ci, h, w = 1, 2, 4, 4
+        co, gd, gh, gw = 1, 2, 2, 2
+        x = r.rand(n, ci, h, w).astype("float32")
+        grid = r.randn(n, co * (ci + 1), gd, gh, gw).astype("float32")
+        # keep guide*gd away from half-integers: the trilinear hat has a
+        # kink there and central differences would straddle it
+        guide = ((r.randint(0, gd, (n, h, w))
+                  + r.uniform(0.15, 0.35, (n, h, w))) / gd).astype("float32")
+        self.op_type = "bilateral_slice"
+        self.inputs = {"X": x, "Grid": grid, "Guide": guide}
+        self.attrs = {"has_offset": True}
+        out = np.asarray(self._run_forward()["Out"][0])
+        assert out.shape == (n, co, h, w)
+        assert np.all(np.isfinite(out))
+        self.check_grad(["X", "Grid", "Guide"], "Out",
+                        max_relative_error=0.05)
+
+
+# -- small math/loss additions ----------------------------------------------
+
+class TestMinus(OpTest):
+    def test(self):
+        r = np.random.RandomState(18)
+        x, y = r.randn(3, 4).astype("float32"), r.randn(3, 4).astype("float32")
+        self.op_type = "minus"
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x - y}
+        self.check_output()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestL1Norm(OpTest):
+    def test(self):
+        r = np.random.RandomState(19)
+        x = (np.where(r.rand(5, 3) < 0.5, -1.0, 1.0)
+             * r.uniform(0.5, 1.5, (5, 3))).astype("float32")
+        self.op_type = "l1_norm"
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.abs(x).sum()}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestFrobeniusNorm(OpTest):
+    def test(self):
+        r = np.random.RandomState(20)
+        x = r.randn(4, 5).astype("float32")
+        self.op_type = "frobenius_norm"
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [0, 1], "keep_dim": False}
+        self.outputs = {"Out": np.sqrt((x * x).sum())}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestDist(OpTest):
+    def test(self):
+        r = np.random.RandomState(21)
+        x = r.randn(3, 4).astype("float32")
+        y = r.randn(3, 4).astype("float32")
+        self.op_type = "dist"
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"p": 2.0}
+        self.outputs = {"Out": np.linalg.norm((x - y).ravel(), 2)}
+        self.check_output()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestBceLoss(OpTest):
+    def test(self):
+        r = np.random.RandomState(22)
+        x = r.uniform(0.05, 0.95, (6, 3)).astype("float32")
+        label = r.randint(0, 2, (6, 3)).astype("float32")
+        self.op_type = "bce_loss"
+        self.inputs = {"X": x, "Label": label}
+        self.outputs = {"Out": -(label * np.log(x)
+                                 + (1 - label) * np.log(1 - x))}
+        self.check_output(atol=1e-4)
+        self.check_grad(["X"], "Out")
+
+
+class TestNllLoss(OpTest):
+    def test(self):
+        r = np.random.RandomState(23)
+        x = np.log(r.dirichlet(np.ones(5), 8)).astype("float32")
+        label = r.randint(0, 5, (8,)).astype("int64")
+        self.op_type = "nll_loss"
+        self.inputs = {"X": x, "Label": label}
+        self.attrs = {"reduction": "mean"}
+        e = -x[np.arange(8), label].mean()
+        self.outputs = {"Out": np.float32(e)}
+        self.check_output(atol=1e-5, no_check_set=("Total_weight",))
+        self.check_grad(["X"], "Out")
