@@ -150,6 +150,8 @@ def _exec_op(op, env, key0, op_idx, amp_lists=None):
         return _exec_cond(op, env, key0, op_idx, amp_lists)
     if t == "switch_case":
         return _exec_switch_case(op, env, key0, op_idx, amp_lists)
+    if t == "conditional_block":
+        return _exec_conditional_block(op, env, key0, op_idx, amp_lists)
     opdef = ops_lib.get_op(t)
     ins = {}
     for slot, names in op.input_names.items():
@@ -289,6 +291,36 @@ def _exec_cond(op, env, key0, op_idx, amp_lists):
         _branch_fn(blk_t, env, key, out_names, amp_lists),
         _branch_fn(blk_f, env, key, out_names, amp_lists),
         None)
+    env.update(zip(out_names, outs))
+
+
+def _exec_conditional_block(op, env, key0, op_idx, amp_lists):
+    """Reference conditional_block_op.cc: run the sub-block iff Cond is
+    true. Functional form: lax.cond whose false branch returns the
+    enclosing env's values unchanged, so every carried write must
+    pre-exist (the same contract as While loop vars)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    prog = op.block.program
+    blk = prog.block(op.attrs["sub_block"])
+    out_names = _branch_out_names(op, env, [blk]) \
+        if "out_names" in op.attrs else _sub_block_carry(blk, env)
+    cond_vals = [env[n] for n in op.input_names.get("Cond", [])]
+    pred = jnp.all(cond_vals[0]) if cond_vals else jnp.bool_(True)
+    key = jax.random.fold_in(key0, op_idx)
+    true_fn = _branch_fn(blk, env, key, out_names, amp_lists)
+    # outputs first created INSIDE the block (no pre-existing env value)
+    # are zeros on the skip path, like an unexecuted reference scope
+    shapes = jax.eval_shape(true_fn, None)
+
+    def false_fn(_):
+        return tuple(
+            env[n] if n in env else jnp.zeros(s.shape, s.dtype)
+            for n, s in zip(out_names, shapes))
+
+    outs = lax.cond(pred, true_fn, false_fn, None)
     env.update(zip(out_names, outs))
 
 

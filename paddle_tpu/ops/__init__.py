@@ -26,3 +26,6 @@ from . import array_ops  # noqa: F401
 from . import interp_ops  # noqa: F401
 from . import rnn_unit_ops  # noqa: F401
 from . import vision_extra_ops  # noqa: F401
+from . import framework_ops  # noqa: F401
+from . import specialty_ops  # noqa: F401
+from . import ps_ops  # noqa: F401

@@ -1,0 +1,309 @@
+"""Framework-plumbing operators: checkpoint IO ops, debugging ops,
+tensor-array aliases, control-flow routing, selected-rows utilities,
+buffer coalescing, int8 (re)quantization.
+
+Reference parity: `paddle/fluid/operators/save_op.cc`, `load_op.cc`,
+`save_combine_op.cc`, `load_combine_op.cc`, `print_op.cc`,
+`py_func_op.cc`, `tensor_array_read_write_op.cc` (write_to_array /
+read_from_array), `multiplex_op.cc`, `controlflow/` select_input /
+select_output, `split_lod_tensor_op.cc` / `merge_lod_tensor_op.cc`,
+`coalesce_tensor_op.cc`, `shuffle_batch_op.cc`,
+`get_tensor_from_selected_rows_op.cc`, `merge_selected_rows_op.cc`,
+`split_selected_rows_op.cc`, `mkldnn/quantize_op.cc` family.
+
+TPU-native design: IO/debug/routing ops are host-side (`no_jit`) — they
+exist for program compatibility, not for the compiled hot path (XLA owns
+buffer packing, so `coalesce_tensor` is a functional concat that keeps
+the op contract without pretending to alias memory).
+"""
+from __future__ import annotations
+
+import os
+import struct
+from typing import Callable, Dict
+
+import numpy as np
+import jax.numpy as jnp
+
+from .registry import register_op
+
+# -- save / load ------------------------------------------------------------
+
+_MAGIC = b"PTPU0001"
+
+
+def _save_arrays(path: str, named: Dict[str, np.ndarray]):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<I", len(named)))
+        for name, arr in named.items():
+            nb = name.encode()
+            f.write(struct.pack("<I", len(nb)))
+            f.write(nb)
+            hdr = ("%s|%s" % (arr.dtype.str,
+                              ",".join(map(str, arr.shape)))).encode()
+            f.write(struct.pack("<I", len(hdr)))
+            f.write(hdr)
+            payload = np.ascontiguousarray(arr).tobytes()
+            f.write(struct.pack("<Q", len(payload)))
+            f.write(payload)
+
+
+def _load_arrays(path: str) -> Dict[str, np.ndarray]:
+    with open(path, "rb") as f:
+        assert f.read(8) == _MAGIC, "not a paddle_tpu checkpoint: %s" % path
+        (n,) = struct.unpack("<I", f.read(4))
+        out = {}
+        for _ in range(n):
+            (ln,) = struct.unpack("<I", f.read(4))
+            name = f.read(ln).decode()
+            (hl,) = struct.unpack("<I", f.read(4))
+            dtype_s, shape_s = f.read(hl).decode().split("|")
+            shape = tuple(int(s) for s in shape_s.split(",") if s)
+            (pl,) = struct.unpack("<Q", f.read(8))
+            out[name] = np.frombuffer(
+                f.read(pl), dtype=np.dtype(dtype_s)).reshape(shape).copy()
+    return out
+
+
+@register_op("save", no_jit=True)
+def _save(ins, attrs):
+    x = np.asarray(ins["X"][0])
+    if attrs.get("save_as_fp16", False):
+        x = x.astype("float16")
+    _save_arrays(attrs["file_path"], {attrs.get("var_name", "X"): x})
+    return {}
+
+
+@register_op("load", no_jit=True)
+def _load(ins, attrs):
+    named = _load_arrays(attrs["file_path"])
+    arr = next(iter(named.values()))
+    if attrs.get("load_as_fp16", False):
+        arr = arr.astype("float16")
+    return {"Out": jnp.asarray(arr)}
+
+
+@register_op("save_combine", no_jit=True)
+def _save_combine(ins, attrs):
+    names = attrs.get("var_names") or [
+        "X_%d" % i for i in range(len(ins["X"]))]
+    _save_arrays(attrs["file_path"],
+                 {n: np.asarray(v) for n, v in zip(names, ins["X"])})
+    return {}
+
+
+@register_op("load_combine", no_jit=True)
+def _load_combine(ins, attrs):
+    named = _load_arrays(attrs["file_path"])
+    return {"Out": [jnp.asarray(v) for v in named.values()]}
+
+
+# -- debug ops --------------------------------------------------------------
+
+@register_op("print", no_jit=True)
+def _print(ins, attrs):
+    x = ins["In"][0] if ins.get("In") else ins["X"][0]
+    arr = np.asarray(x)
+    msg = attrs.get("message", "")
+    first_n = attrs.get("summarize", 20)
+    flat = arr.reshape(-1)[:max(int(first_n), 0) or None]
+    print("%s dtype=%s shape=%s data=%s"
+          % (msg, arr.dtype, arr.shape, flat))
+    return {"Out": x}
+
+
+_PY_FUNCS: Dict[int, Callable] = {}
+
+
+def register_py_func(fn: Callable) -> int:
+    """Register a python callable; returns the id used by the py_func
+    op's `func_id` attr (reference: py_func_op.cc registers callables in
+    a python-side registry keyed by index)."""
+    fid = len(_PY_FUNCS)
+    _PY_FUNCS[fid] = fn
+    return fid
+
+
+@register_op("py_func", no_jit=True)
+def _py_func(ins, attrs):
+    fn = _PY_FUNCS[int(attrs["func_id"])]
+    args = [np.asarray(v) for v in ins.get("X", [])]
+    out = fn(*args)
+    if out is None:
+        return {"Out": []}
+    if not isinstance(out, (list, tuple)):
+        out = [out]
+    return {"Out": [jnp.asarray(np.asarray(o)) for o in out]}
+
+
+# -- tensor-array aliases ---------------------------------------------------
+
+def _alias(new, old):
+    from .registry import get_op
+    target = get_op(old)
+    register_op(new, needs_rng=target.needs_rng,
+                no_jit=target.no_jit)(target.compute)
+
+
+_alias("write_to_array", "array_write")
+_alias("read_from_array", "array_read")
+
+
+# -- routing ----------------------------------------------------------------
+
+@register_op("multiplex")
+def _multiplex(ins, attrs):
+    ids = ins["Ids"][0].reshape((-1,)).astype(jnp.int32)
+    stacked = jnp.stack(ins["X"], axis=0)        # [K, N, ...]
+    return {"Out": stacked[ids, jnp.arange(stacked.shape[1])]}
+
+
+@register_op("select_input", no_jit=True)
+def _select_input(ins, attrs):
+    mask = int(np.asarray(ins["Mask"][0]).reshape(()))
+    return {"Out": ins["X"][mask]}
+
+
+@register_op("select_output", no_jit=True)
+def _select_output(ins, attrs):
+    # routes X to output branch `mask`; other branches get empty
+    # placeholders (reference: controlflow/select_output_op.cc)
+    mask = int(np.asarray(ins["Mask"][0]).reshape(()))
+    n = int(attrs.get("n_outputs", 1))
+    x = ins["X"][0]
+    outs = [jnp.zeros((0,), x.dtype)] * n
+    outs[mask] = x
+    return {"Out": outs}
+
+
+@register_op("split_lod_tensor", no_jit=True)
+def _split_lod_tensor(ins, attrs):
+    x = np.asarray(ins["X"][0])
+    mask = np.asarray(ins["Mask"][0]).reshape(-1).astype(bool)
+    return {"OutTrue": jnp.asarray(x[mask]),
+            "OutFalse": jnp.asarray(x[~mask])}
+
+
+@register_op("merge_lod_tensor", no_jit=True)
+def _merge_lod_tensor(ins, attrs):
+    mask = np.asarray(ins["Mask"][0]).reshape(-1).astype(bool)
+    in_true = np.asarray(ins["InTrue"][0])
+    in_false = np.asarray(ins["InFalse"][0])
+    width = in_true.shape[1:] if in_true.size else in_false.shape[1:]
+    out = np.zeros((mask.shape[0],) + tuple(width), in_true.dtype
+                   if in_true.size else in_false.dtype)
+    out[mask] = in_true
+    out[~mask] = in_false
+    return {"Out": jnp.asarray(out)}
+
+
+@register_op("coalesce_tensor")
+def _coalesce_tensor(ins, attrs):
+    """Functional stand-in for the grad-fusion buffer: FusedOutput is the
+    concat of all inputs; Output passes the originals through. XLA owns
+    real buffer packing, so no aliasing is pretended."""
+    xs = ins["Input"]
+    flat = jnp.concatenate([x.reshape(-1) for x in xs]) if xs else \
+        jnp.zeros((0,), jnp.float32)
+    return {"Output": list(xs), "FusedOutput": flat}
+
+
+@register_op("shuffle_batch", needs_rng=True)
+def _shuffle_batch(ins, attrs):
+    import jax
+    x = ins["X"][0]
+    perm = jax.random.permutation(attrs["_rng_key"], x.shape[0])
+    return {"Out": x[perm], "ShuffleIdx": perm.astype(jnp.int64),
+            "SeedOut": jnp.zeros((1,), jnp.int64)}
+
+
+# -- selected-rows utilities ------------------------------------------------
+
+@register_op("get_tensor_from_selected_rows", no_jit=True)
+def _get_tensor_from_selected_rows(ins, attrs):
+    from ..core.selected_rows import SelectedRows
+    x = ins["X"][0]
+    if isinstance(x, SelectedRows):
+        return {"Out": jnp.asarray(np.asarray(x.values))}
+    return {"Out": x}
+
+
+@register_op("merge_selected_rows", no_jit=True)
+def _merge_selected_rows(ins, attrs):
+    from ..core.selected_rows import SelectedRows
+    x = ins["X"][0]
+    if isinstance(x, SelectedRows):
+        return {"Out": x.merge()}
+    return {"Out": x}
+
+
+@register_op("split_selected_rows", no_jit=True)
+def _split_selected_rows(ins, attrs):
+    """Shard a SelectedRows (or dense) by row ranges: height_sections
+    attr gives per-shard dense extents (reference:
+    split_selected_rows_op.cc, the PS param-send path)."""
+    from ..core.selected_rows import SelectedRows
+    sections = attrs["height_sections"]
+    x = ins["X"][0]
+    bounds = np.cumsum([0] + list(sections))
+    outs = []
+    if isinstance(x, SelectedRows):
+        rows = np.asarray(x.rows)
+        vals = np.asarray(x.values)
+        for i in range(len(sections)):
+            sel = (rows >= bounds[i]) & (rows < bounds[i + 1])
+            outs.append(SelectedRows(rows[sel] - bounds[i], vals[sel],
+                                     int(sections[i])))
+    else:
+        arr = np.asarray(x)
+        for i in range(len(sections)):
+            outs.append(jnp.asarray(arr[bounds[i]:bounds[i + 1]]))
+    return {"Out": outs}
+
+
+# -- int8 (re)quantization (reference: operators/mkldnn/quantize_op etc.) ---
+
+@register_op("quantize")
+def _quantize(ins, attrs):
+    x = ins["Input"][0]
+    scale = float(attrs.get("Scale", 1.0))
+    if attrs.get("is_negative_input", True):
+        q = jnp.clip(jnp.rint(x * scale), -128, 127).astype(jnp.int8)
+    else:
+        q = jnp.clip(jnp.rint(x * scale), 0, 255).astype(jnp.uint8)
+    return {"Output": q}
+
+
+@register_op("dequantize")
+def _dequantize(ins, attrs):
+    x = ins["Input"][0]
+    scale = float(attrs.get("Scale", 1.0))
+    return {"Output": x.astype(jnp.float32) / scale}
+
+
+@register_op("requantize")
+def _requantize(ins, attrs):
+    x = ins["Input"][0]
+    scale_in = float(attrs.get("Scale_in", 1.0))
+    scale_out = float(attrs.get("Scale_out", 1.0))
+    y = jnp.rint(x.astype(jnp.float32) * (scale_out / scale_in))
+    return {"Output": jnp.clip(y, -128, 127).astype(x.dtype)}
+
+
+@register_op("run_program", no_jit=True)
+def _run_program(ins, attrs):
+    """Execute a captured Program (run_program_op.cc — the dygraph-to-
+    static jit.save/load execution path). attrs: `program` (a
+    framework.Program), `feed_names`, `fetch_names`."""
+    from ..fluid.executor import Executor
+
+    program = attrs["program"]
+    feed_names = list(attrs.get("feed_names", []))
+    fetch_names = list(attrs.get("fetch_names", []))
+    feed = {n: np.asarray(v) for n, v in zip(feed_names, ins.get("X", []))}
+    outs = Executor().run(program, feed=feed, fetch_list=fetch_names)
+    return {"Out": [jnp.asarray(np.asarray(o)) for o in outs]}

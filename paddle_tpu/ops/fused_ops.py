@@ -194,7 +194,8 @@ def _fusion_lstm(ins, attrs):
     jit/refer/refer.h:170). Emits BOTH the hidden and cell
     sequences."""
     x = ins["X"][0]
-    wx = ins["WeightX"][0]
+    # WeightX optional: fused_embedding_fc_lstm feeds X already projected
+    wx = ins["WeightX"][0] if ins.get("WeightX") else None
     wh = ins["WeightH"][0]
     H = wh.shape[0]
     bias = ins["Bias"][0].reshape(-1)[:4 * H] if ins.get("Bias") else \
@@ -210,7 +211,7 @@ def _fusion_lstm(ins, attrs):
                           jnp.tanh)
     reverse = attrs.get("is_reverse", False)
 
-    xx = x @ wx + bias
+    xx = (x @ wx if wx is not None else x) + bias
     xs = jnp.swapaxes(xx, 0, 1)
     if reverse:
         xs = xs[::-1]

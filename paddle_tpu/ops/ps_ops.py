@@ -1,0 +1,187 @@
+"""Parameter-server and comm-bootstrap operator registrations.
+
+Reference parity: `paddle/fluid/operators/distributed_ops/` —
+`listen_and_serv` (`listen_and_serv_op.cc:336`),
+`distributed_lookup_table_op.cc`, `recv_save_op.cc`, and the pslib-style
+`pull_sparse`/`push_sparse`/`pull_box_sparse` family (`pull_sparse_op.cc`,
+`push_box_sparse_op.cc`); comm bootstrap ops from
+`operators/collective/c_gen_nccl_id_op.cc`, `c_comm_init_op.cc:35-56`,
+`c_comm_init_all_op.cc`, `distributed_ops/gen_nccl_id_op.cc`, and
+`split_byref_op.cc`.
+
+TPU-native design: the PS tier is the host-RPC machinery in
+`paddle_tpu/distributed/ps.py` (trainer `PSCommunicator`, server
+`ParameterServer`); these op registrations make programs that CONTAIN the
+ops executable — the executor's PS integration normally drives the
+communicator around the jitted step, so the ops delegate to the same
+machinery. The NCCL bootstrap ops are no-ops by design: mesh/axis setup
+replaces communicator construction (SURVEY.md §3C TPU mapping — ring_id
+maps to a named mesh axis at trace time, `parallel/env.py`), so the ops
+only validate and record the ring registration.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .registry import register_op
+from .framework_ops import _save_arrays
+
+# Process-global PS communicator installed by the executor/fleet runtime
+# when a transpiled trainer program runs (distributed/ps.py).
+_COMMUNICATOR = None
+
+
+def set_ps_communicator(comm):
+    global _COMMUNICATOR
+    _COMMUNICATOR = comm
+
+
+def get_ps_communicator():
+    return _COMMUNICATOR
+
+
+def _need_comm(op):
+    if _COMMUNICATOR is None:
+        raise RuntimeError(
+            "op %r needs an active parameter-server communicator; run the "
+            "program through fleet PS mode (DistributeTranspiler) so the "
+            "executor installs one (paddle_tpu/distributed/ps.py)" % op)
+    return _COMMUNICATOR
+
+
+@register_op("listen_and_serv", no_jit=True)
+def _listen_and_serv(ins, attrs):
+    """Blocking pserver loop. The transpiler-generated pserver program is
+    normally launched via distributed.ps.listen_and_serv directly; the op
+    form serves programs that embed it (reference pserver main program)."""
+    from ..distributed.ps import listen_and_serv as serve
+    serve(attrs["pserver_program"],
+          attrs.get("pserver_startup"),
+          endpoint=attrs.get("endpoint", "127.0.0.1:0"),
+          trainers=int(attrs.get("Fanin", attrs.get("trainers", 1))),
+          mode=attrs.get("mode", "sync"))
+    return {}
+
+
+@register_op("distributed_lookup_table", no_jit=True)
+def _distributed_lookup_table(ins, attrs):
+    """Pull embedding rows for Ids from the remote sharded table
+    (reference: distributed_lookup_table_op.cc + parameter_prefetch.cc).
+    Falls back to a local W input when no communicator is active (single
+    -process execution of a PS program)."""
+    ids = np.asarray(ins["Ids"][0]).astype(np.int64)
+    if ids.ndim > 1 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    table_name = attrs.get("table_name", "")
+    comm = _COMMUNICATOR
+    if comm is not None and table_name in comm.cfg.get("sparse_tables", {}):
+        meta = comm.cfg["sparse_tables"][table_name]
+        flat = ids.reshape(-1)
+        uniq, inverse = np.unique(flat, return_inverse=True)
+        (rows,) = comm._client(meta["endpoint"]).call(
+            "lookup_rows", table_name, uniq.astype(np.int64))
+        out = np.asarray(rows)[inverse].reshape(ids.shape + (-1,))
+    elif ins.get("W"):
+        out = np.asarray(ins["W"][0])[ids]
+    else:
+        raise RuntimeError(
+            "distributed_lookup_table: table %r is not configured on the "
+            "active PS communicator and no local W input was provided"
+            % table_name)
+    return {"Outputs": jnp.asarray(out.astype(np.float32))}
+
+
+@register_op("recv_save", no_jit=True)
+def _recv_save(ins, attrs):
+    """Fetch a remote param shard and save it to disk (recv_save_op.cc,
+    the pserver-side checkpoint path)."""
+    comm = _need_comm("recv_save")
+    pname = attrs["param_name"]
+    ep = comm.cfg["param_endpoint"].get(pname)
+    if ep is None:
+        raise KeyError("recv_save: param %r has no pserver" % pname)
+    (val,) = comm._client(ep).call("pull_dense", pname)
+    _save_arrays(attrs["file_path"], {pname: np.asarray(val)})
+    return {}
+
+
+def _pull_sparse(ins, attrs):
+    comm = _need_comm("pull_sparse")
+    table = attrs.get("table_name") or attrs.get("TableName", "")
+    meta = comm.cfg["sparse_tables"][table]
+    outs = []
+    for ids_arr in ins["Ids"]:
+        ids = np.asarray(ids_arr).astype(np.int64)
+        if ids.ndim > 1 and ids.shape[-1] == 1:
+            ids = ids[..., 0]
+        flat = ids.reshape(-1)
+        uniq, inverse = np.unique(flat, return_inverse=True)
+        (rows,) = comm._client(meta["endpoint"]).call(
+            "lookup_rows", table, uniq)
+        outs.append(jnp.asarray(
+            np.asarray(rows)[inverse].reshape(ids.shape + (-1,))
+            .astype(np.float32)))
+    return {"Out": outs}
+
+
+register_op("pull_sparse", no_jit=True)(_pull_sparse)
+register_op("pull_sparse_v2", no_jit=True)(_pull_sparse)
+register_op("pull_box_sparse", no_jit=True)(_pull_sparse)
+
+
+def _push_sparse(ins, attrs):
+    comm = _need_comm("push_sparse")
+    table = attrs.get("table_name") or attrs.get("TableName", "")
+    meta = comm.cfg["sparse_tables"][table]
+    for ids_arr, grad_arr in zip(ins["Ids"], ins.get("Grads", ins.get(
+            "Out@GRAD", []))):
+        ids = np.asarray(ids_arr).astype(np.int64).reshape(-1)
+        grads = np.asarray(grad_arr, dtype=np.float32)
+        grads = grads.reshape(ids.shape[0], -1)
+        uniq, inverse = np.unique(ids, return_inverse=True)
+        summed = np.zeros((len(uniq), grads.shape[1]), np.float32)
+        np.add.at(summed, inverse, grads)
+        comm._client(meta["endpoint"]).call(
+            "sparse_push", table, uniq, summed, comm.tid)
+    return {}
+
+
+register_op("push_sparse", no_jit=True)(_push_sparse)
+register_op("push_sparse_v2", no_jit=True)(_push_sparse)
+register_op("push_box_sparse", no_jit=True)(_push_sparse)
+register_op("push_box_extended_sparse", no_jit=True)(_push_sparse)
+
+
+@register_op("split_byref", no_jit=True)
+def _split_byref(ins, attrs):
+    """Row-section split of a dense tensor (split_byref_op.cc — the PS
+    send path splits a param into per-server sections; 'byref' aliasing
+    is meaningless under XLA so this is a plain split)."""
+    x = np.asarray(ins["X"][0])
+    sections = attrs["height_sections"]
+    bounds = np.cumsum([0] + list(sections))
+    return {"Out": [jnp.asarray(x[bounds[i]:bounds[i + 1]])
+                    for i in range(len(sections))]}
+
+
+# -- comm bootstrap (no-ops under the mesh model) ---------------------------
+
+def _comm_bootstrap(ins, attrs):
+    """c_gen_nccl_id / gen_nccl_id / c_comm_init / c_comm_init_all:
+    under XLA the communicator is the compiled collective over a named
+    mesh axis — bootstrap is `jax.distributed.initialize` + Mesh
+    construction at trace time. The ops validate the ring registration
+    so transpiled startup programs run unchanged."""
+    ring_id = int(attrs.get("ring_id", 0))
+    from ..parallel import env
+    if env.axis_name_for_ring(ring_id) is None:
+        # default registration: ring spans the data-parallel world
+        env.register_ring(ring_id, "dp", env.trainer_num())
+    return {}
+
+
+register_op("c_gen_nccl_id", no_jit=True)(_comm_bootstrap)
+register_op("gen_nccl_id", no_jit=True)(_comm_bootstrap)
+register_op("c_comm_init", no_jit=True)(_comm_bootstrap)
+register_op("c_comm_init_all", no_jit=True)(_comm_bootstrap)

@@ -92,7 +92,13 @@ def normalize_outs(outs) -> Dict[str, list]:
 def run_op(type_: str, ins: Dict[str, list], attrs: dict) -> Dict[str, list]:
     """Execute an op's compute function (inside or outside a trace)."""
     op = get_op(type_)
-    return normalize_outs(op.compute(ins, dict(attrs)))
+    attrs = dict(attrs)
+    if op.needs_rng and "_rng_key" not in attrs:
+        import jax
+
+        attrs["_rng_key"] = jax.random.PRNGKey(
+            np.random.randint(0, 2**31 - 1))
+    return normalize_outs(op.compute(ins, attrs))
 
 
 # ---------------------------------------------------------------------------

@@ -231,7 +231,10 @@ def _gru_unit(ins, attrs):
 def _cudnn_lstm(ins, attrs):
     """Multi-layer (bi)LSTM over time-major [T, B, D] input. Flat weight
     layout per (layer, direction): W_ih (4H*in), W_hh (4H*H), b_ih (4H),
-    b_hh (4H), cuDNN gate order [i, f, g, o]."""
+    b_hh (4H), cuDNN gate order [i, f, g, o]. Optional SequenceLength
+    [B] masks padded steps: the forward direction carries state through
+    padding, the reverse direction runs over each row's time-reversed
+    VALID region (cudnn_lstm_op.cc padded-batch contract)."""
     x = ins["Input"][0]                    # [T, B, D]
     flat_w = ins["W"][0].reshape((-1,))
     hidden = int(attrs["hidden_size"])
@@ -240,6 +243,24 @@ def _cudnn_lstm(ins, attrs):
     n_dir = 2 if bidirec else 1
     t, b, d_in = x.shape
     h = hidden
+    if ins.get("SequenceLength"):
+        seq_len = ins["SequenceLength"][0].reshape(-1).astype(jnp.int32)
+        step_mask = (jnp.arange(t)[:, None] < seq_len[None, :]) \
+            .astype(x.dtype)[:, :, None]                     # [T, B, 1]
+        # per-row time reversal of the valid region only
+        rev_idx = jnp.where(
+            jnp.arange(t)[:, None] < seq_len[None, :],
+            seq_len[None, :] - 1 - jnp.arange(t)[:, None],
+            jnp.arange(t)[:, None])                          # [T, B]
+    else:
+        seq_len = None
+        step_mask = jnp.ones((t, 1, 1), x.dtype)
+        rev_idx = None
+
+    def rev(seq):
+        if rev_idx is None:
+            return seq[::-1]
+        return jnp.take_along_axis(seq, rev_idx[:, :, None], 0)
 
     init_h = ins["InitH"][0].reshape((n_layers * n_dir, b, h)) \
         if ins.get("InitH") else jnp.zeros((n_layers * n_dir, b, h), x.dtype)
@@ -247,19 +268,22 @@ def _cudnn_lstm(ins, attrs):
         if ins.get("InitC") else jnp.zeros((n_layers * n_dir, b, h), x.dtype)
 
     def run_dir(seq, w_ih, w_hh, b_ih, b_hh, h0, c0, reverse):
-        xs = seq[::-1] if reverse else seq
+        xs = rev(seq) if reverse else seq
         xp = jnp.einsum("tbd,gd->tbg", xs, w_ih) + b_ih + b_hh
 
-        def step(carry, xg):
+        def step(carry, inp):
+            xg, m = inp
             hp, cp = carry
             gates = xg + hp @ w_hh.T
             i, f, g, o = jnp.split(gates, 4, axis=-1)
             c = jax.nn.sigmoid(f) * cp + jax.nn.sigmoid(i) * jnp.tanh(g)
             hh = jax.nn.sigmoid(o) * jnp.tanh(c)
+            hh = m * hh + (1.0 - m) * hp
+            c = m * c + (1.0 - m) * cp
             return (hh, c), hh
 
-        (hl, cl), ys = lax.scan(step, (h0, c0), xp)
-        return (ys[::-1] if reverse else ys), hl, cl
+        (hl, cl), ys = lax.scan(step, (h0, c0), (xp, step_mask))
+        return (rev(ys) if reverse else ys), hl, cl
 
     off = 0
 

@@ -1,0 +1,487 @@
+"""Golden + behavioral tests for the round-2c ops batch: framework/IO
+ops, CTR/specialty ops, candidate-sampling losses, CRF/CTC, yolov3_loss,
+conditional_block lowering, and PS op registrations."""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework
+from paddle_tpu import ops as ops_lib
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+# -- IO ops -----------------------------------------------------------------
+
+class TestSaveLoad(OpTest):
+    def test(self, tmp_path=None):
+        import tempfile
+        import os
+        d = tempfile.mkdtemp()
+        path = os.path.join(d, "ckpt", "w0")
+        r = np.random.RandomState(0)
+        x = r.randn(4, 6).astype("float32")
+        import jax.numpy as jnp
+        ops_lib.run_op("save", {"X": [jnp.asarray(x)]},
+                       {"file_path": path})
+        out = ops_lib.run_op("load", {}, {"file_path": path})["Out"][0]
+        np.testing.assert_array_equal(np.asarray(out), x)
+
+        ys = [r.randn(3).astype("float32"),
+              r.randint(0, 9, (2, 2)).astype("int64")]
+        ops_lib.run_op("save_combine",
+                       {"X": [jnp.asarray(y) for y in ys]},
+                       {"file_path": path + "_c",
+                        "var_names": ["a", "b"]})
+        outs = ops_lib.run_op("load_combine", {},
+                              {"file_path": path + "_c"})["Out"]
+        for got, e in zip(outs, ys):
+            np.testing.assert_array_equal(np.asarray(got), e)
+
+
+class TestPrintPyFunc(OpTest):
+    def test(self, capsys=None):
+        import jax.numpy as jnp
+        x = np.arange(6).astype("float32")
+        out = ops_lib.run_op("print", {"In": [jnp.asarray(x)]},
+                             {"message": "dbg"})["Out"][0]
+        np.testing.assert_array_equal(np.asarray(out), x)
+
+        from paddle_tpu.ops.framework_ops import register_py_func
+        fid = register_py_func(lambda a, b: a * 2 + b)
+        got = ops_lib.run_op(
+            "py_func",
+            {"X": [jnp.asarray(x), jnp.asarray(np.ones_like(x))]},
+            {"func_id": fid})["Out"][0]
+        np.testing.assert_allclose(np.asarray(got), x * 2 + 1)
+
+
+# -- routing ----------------------------------------------------------------
+
+class TestMultiplex(OpTest):
+    def test(self):
+        r = np.random.RandomState(1)
+        xs = [r.randn(5, 3).astype("float32") for _ in range(3)]
+        ids = r.randint(0, 3, (5, 1)).astype("int32")
+        self.op_type = "multiplex"
+        self.inputs = {"X": xs, "Ids": ids}
+        e = np.stack([xs[ids[i, 0]][i] for i in range(5)])
+        self.outputs = {"Out": e}
+        self.check_output()
+
+
+class TestSplitMergeLod(OpTest):
+    def test(self):
+        import jax.numpy as jnp
+        r = np.random.RandomState(2)
+        x = r.randn(6, 4).astype("float32")
+        mask = np.array([1, 0, 1, 1, 0, 1], "int32")
+        outs = ops_lib.run_op("split_lod_tensor",
+                              {"X": [jnp.asarray(x)],
+                               "Mask": [jnp.asarray(mask)]}, {})
+        t, f = np.asarray(outs["OutTrue"][0]), np.asarray(outs["OutFalse"][0])
+        np.testing.assert_array_equal(t, x[mask.astype(bool)])
+        merged = ops_lib.run_op(
+            "merge_lod_tensor",
+            {"InTrue": [jnp.asarray(t)], "InFalse": [jnp.asarray(f)],
+             "Mask": [jnp.asarray(mask)]}, {})["Out"][0]
+        np.testing.assert_array_equal(np.asarray(merged), x)
+
+
+class TestCoalesceShuffle(OpTest):
+    def test(self):
+        import jax.numpy as jnp
+        r = np.random.RandomState(3)
+        xs = [r.randn(2, 3).astype("float32"),
+              r.randn(4).astype("float32")]
+        outs = ops_lib.run_op("coalesce_tensor",
+                              {"Input": [jnp.asarray(v) for v in xs]},
+                              {})
+        fused = np.asarray(outs["FusedOutput"][0])
+        np.testing.assert_allclose(
+            fused, np.concatenate([v.ravel() for v in xs]))
+
+        x = np.arange(20).reshape(10, 2).astype("float32")
+        out = np.asarray(ops_lib.run_op(
+            "shuffle_batch", {"X": [jnp.asarray(x)]}, {})["Out"][0])
+        assert sorted(out[:, 0].tolist()) == x[:, 0].tolist()
+
+
+# -- specialty --------------------------------------------------------------
+
+class TestCvm(OpTest):
+    def test(self):
+        r = np.random.RandomState(4)
+        x = np.abs(r.randn(5, 6)).astype("float32")
+        self.op_type = "cvm"
+        self.inputs = {"X": x}
+        self.attrs = {"use_cvm": True}
+        show = np.log(x[:, :1] + 1)
+        click = np.log(x[:, 1:2] + 1) - show
+        self.outputs = {"Y": np.concatenate([show, click, x[:, 2:]], 1)}
+        self.check_output()
+        self.attrs = {"use_cvm": False}
+        self.outputs = {"Y": x[:, 2:]}
+        self.check_output()
+
+
+class TestBatchFc(OpTest):
+    def test(self):
+        r = np.random.RandomState(5)
+        x = r.randn(3, 4, 5).astype("float32")
+        w = r.randn(3, 5, 2).astype("float32")
+        b = r.randn(3, 2).astype("float32")
+        self.op_type = "batch_fc"
+        self.inputs = {"Input": x, "W": w, "Bias": b}
+        self.outputs = {"Out": np.einsum("sni,sio->sno", x, w)
+                        + b[:, None, :]}
+        self.check_output(atol=1e-4)
+        self.check_grad(["Input", "W", "Bias"], "Out")
+
+
+class TestHash(OpTest):
+    def test(self):
+        x = np.array([[1, 2], [1, 2], [3, 4]], "int64")
+        self.op_type = "hash"
+        self.inputs = {"X": x}
+        self.attrs = {"num_hash": 2, "mod_by": 1000}
+        out = np.asarray(self._run_forward()["Out"][0])
+        assert out.shape == (3, 2, 1)
+        # deterministic: identical rows hash identically
+        np.testing.assert_array_equal(out[0], out[1])
+        assert not np.array_equal(out[0], out[2])
+        assert out.min() >= 0 and out.max() < 1000
+
+
+class TestNce(OpTest):
+    def test(self):
+        r = np.random.RandomState(6)
+        n, d, c = 4, 8, 20
+        x = r.randn(n, d).astype("float32")
+        w = r.randn(c, d).astype("float32")
+        label = r.randint(0, c, (n, 1)).astype("int64")
+        import jax
+        self.op_type = "nce"
+        self.inputs = {"Input": x, "Weight": w, "Label": label}
+        # pin the sampling key so analytic and numeric grads see the
+        # same negatives
+        self.attrs = {"num_neg_samples": 5, "sampler": 1,
+                      "_rng_key": jax.random.PRNGKey(0)}
+        outs = self._run_forward()
+        cost = np.asarray(outs["Cost"][0])
+        assert cost.shape == (n, 1)
+        assert np.all(cost > 0)
+        self.check_grad(["Input", "Weight"], "Cost",
+                        max_relative_error=0.05)
+
+
+class TestSampleLogits(OpTest):
+    def test(self):
+        r = np.random.RandomState(7)
+        n, c = 4, 30
+        logits = r.randn(n, c).astype("float32")
+        labels = r.randint(0, c, (n, 1)).astype("int64")
+        self.op_type = "sample_logits"
+        self.inputs = {"Logits": logits, "Labels": labels}
+        self.attrs = {"num_samples": 8}
+        outs = self._run_forward()
+        sl = np.asarray(outs["SampledLogits"][0])
+        samples = np.asarray(outs["Samples"][0])
+        assert sl.shape == (n, 9)
+        # col 0 is the true class
+        np.testing.assert_array_equal(samples[:, 0], labels[:, 0])
+
+
+def _np_ctc_loss(logp, labels, blank):
+    """Brute-force CTC via dynamic programming in prob space."""
+    t, c = logp.shape
+    ext = [blank]
+    for l in labels:
+        ext += [l, blank]
+    s = len(ext)
+    alpha = np.zeros((t, s))
+    alpha[0, 0] = np.exp(logp[0, ext[0]])
+    if s > 1:
+        alpha[0, 1] = np.exp(logp[0, ext[1]])
+    for ti in range(1, t):
+        for si in range(s):
+            a = alpha[ti - 1, si]
+            if si >= 1:
+                a += alpha[ti - 1, si - 1]
+            if si >= 2 and ext[si] != blank and ext[si] != ext[si - 2]:
+                a += alpha[ti - 1, si - 2]
+            alpha[ti, si] = a * np.exp(logp[ti, ext[si]])
+    return -np.log(alpha[t - 1, s - 1] + alpha[t - 1, s - 2])
+
+
+class TestWarpCtc(OpTest):
+    def test(self):
+        r = np.random.RandomState(8)
+        b, t, c, l = 2, 6, 5, 2
+        logits = r.randn(b, t, c).astype("float32")
+        label = r.randint(1, c, (b, l)).astype("int32")
+        self.op_type = "warpctc"
+        self.inputs = {"Logits": logits, "Label": label}
+        self.attrs = {"blank": 0}
+        logp = logits - np.log(
+            np.exp(logits).sum(-1, keepdims=True))
+        e = np.stack([
+            [_np_ctc_loss(logp[i], label[i].tolist(), 0)]
+            for i in range(b)])
+        self.outputs = {"Loss": e.astype("float32")}
+        self.check_output(atol=1e-4)
+        self.check_grad(["Logits"], "Loss", max_relative_error=0.01)
+
+
+def _np_crf_nll(em, trans_full, labels):
+    k = em.shape[1]
+    start, stop, trans = trans_full[0], trans_full[1], trans_full[2:]
+    # logZ
+    alpha = start + em[0]
+    for t in range(1, em.shape[0]):
+        alpha = np.log(np.exp(
+            alpha[:, None] + trans).sum(0)) + em[t]
+    logz = np.log(np.exp(alpha + stop).sum())
+    score = start[labels[0]] + em[0, labels[0]]
+    for t in range(1, em.shape[0]):
+        score += trans[labels[t - 1], labels[t]] + em[t, labels[t]]
+    score += stop[labels[-1]]
+    return logz - score
+
+
+class TestLinearChainCrf(OpTest):
+    def test(self):
+        r = np.random.RandomState(9)
+        b, t, k = 2, 5, 4
+        em = r.randn(b, t, k).astype("float32")
+        trans = (r.randn(k + 2, k) * 0.3).astype("float32")
+        label = r.randint(0, k, (b, t)).astype("int64")
+        self.op_type = "linear_chain_crf"
+        self.inputs = {"Emission": em, "Transition": trans,
+                       "Label": label}
+        e = np.stack([[_np_crf_nll(em[i].astype("float64"),
+                                   trans.astype("float64"), label[i])]
+                      for i in range(b)])
+        self.outputs = {"LogLikelihood": e.astype("float32")}
+        self.check_output(
+            atol=1e-4,
+            no_check_set=("Alpha", "EmissionExps", "TransitionExps"))
+        self.check_grad(["Emission", "Transition"], "LogLikelihood",
+                        max_relative_error=0.01)
+
+
+class TestCrfDecoding(OpTest):
+    def test(self):
+        r = np.random.RandomState(10)
+        b, t, k = 2, 5, 3
+        em = r.randn(b, t, k).astype("float32")
+        trans = (r.randn(k + 2, k) * 0.3).astype("float32")
+        self.op_type = "crf_decoding"
+        self.inputs = {"Emission": em, "Transition": trans}
+        path = np.asarray(self._run_forward()["ViterbiPath"][0])
+        # brute force viterbi
+        start, stop, tr = trans[0], trans[1], trans[2:]
+        import itertools
+        for i in range(b):
+            best, best_s = None, -1e30
+            for cand in itertools.product(range(k), repeat=t):
+                s = start[cand[0]] + em[i, 0, cand[0]]
+                for j in range(1, t):
+                    s += tr[cand[j - 1], cand[j]] + em[i, j, cand[j]]
+                s += stop[cand[-1]]
+                if s > best_s:
+                    best, best_s = cand, s
+            np.testing.assert_array_equal(path[i], np.array(best))
+
+
+class TestYolov3Loss(OpTest):
+    def test(self):
+        r = np.random.RandomState(11)
+        n, h, w = 1, 4, 4
+        anchors = [10, 13, 16, 30, 33, 23]
+        mask = [0, 1, 2]
+        cnum = 3
+        x = (r.randn(n, 3 * (5 + cnum), h, w) * 0.2).astype("float32")
+        gtbox = np.array([[[0.4, 0.4, 0.3, 0.3],
+                           [0, 0, 0, 0]]], "float32")
+        gtlabel = np.array([[1, 0]], "int32")
+        self.op_type = "yolov3_loss"
+        self.inputs = {"X": x, "GTBox": gtbox, "GTLabel": gtlabel}
+        self.attrs = {"anchors": anchors, "anchor_mask": mask,
+                      "class_num": cnum, "ignore_thresh": 0.7,
+                      "downsample_ratio": 32,
+                      "use_label_smooth": False}
+        outs = self._run_forward()
+        loss = np.asarray(outs["Loss"][0])
+        gmm = np.asarray(outs["GTMatchMask"][0])
+        assert loss.shape == (n,)
+        assert np.isfinite(loss).all() and loss[0] > 0
+        assert gmm[0, 1] == -1  # invalid gt
+        assert 0 <= gmm[0, 0] < 3
+        self.check_grad(["X"], "Loss", max_relative_error=0.05)
+
+
+class TestFusionSquaredMatSub(OpTest):
+    def test(self):
+        r = np.random.RandomState(12)
+        x = r.randn(3, 4).astype("float32")
+        y = r.randn(4, 5).astype("float32")
+        self.op_type = "fusion_squared_mat_sub"
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"scalar": 0.5}
+        e = (np.square(x @ y) - np.square(x) @ np.square(y)) * 0.5
+        self.outputs = {"Out": e}
+        self.check_output(
+            atol=1e-4, no_check_set=("SquaredX", "SquaredY", "SquaredXY"))
+
+
+class TestFusionRepeatedFcRelu(OpTest):
+    def test(self):
+        r = np.random.RandomState(13)
+        x = r.randn(4, 6).astype("float32")
+        ws = [r.randn(6, 5).astype("float32"),
+              r.randn(5, 3).astype("float32")]
+        bs = [r.randn(5).astype("float32"), r.randn(3).astype("float32")]
+        self.op_type = "fusion_repeated_fc_relu"
+        self.inputs = {"X": x, "W": ws, "Bias": bs}
+        e = x
+        for wi, bi in zip(ws, bs):
+            e = np.maximum(e @ wi + bi, 0)
+        self.outputs = {"Out": e}
+        self.check_output(atol=1e-4)
+
+
+class TestRankAttention(OpTest):
+    def test(self):
+        r = np.random.RandomState(14)
+        n, d, p, mr = 3, 4, 2, 3
+        x = r.randn(n, d).astype("float32")
+        param = r.randn(mr * mr * d, p).astype("float32")
+        # instance 0: rank 1 with one pair (rank 2); instance 1: rank 2
+        # with two pairs; instance 2: invalid
+        ro = np.array([[1, 2, 0, 0, 0, 0, 0],
+                       [2, 1, 1, 3, 2, 0, 0],
+                       [0, 0, 0, 0, 0, 0, 0]], "int32")
+        self.op_type = "rank_attention"
+        self.inputs = {"X": x, "RankOffset": ro, "RankParam": param}
+        self.attrs = {"MaxRank": mr}
+        out = np.asarray(self._run_forward()["Out"][0])
+        assert out.shape == (n, p)
+        blocks = param.reshape(mr * mr, d, p)
+        e0 = x[0] @ blocks[(1 - 1) * mr + (2 - 1)]
+        np.testing.assert_allclose(out[0], e0, rtol=1e-4)
+        np.testing.assert_allclose(out[2], 0.0, atol=1e-6)
+
+
+class TestInplaceAbn(OpTest):
+    def test(self):
+        r = np.random.RandomState(15)
+        x = r.randn(2, 3, 4, 4).astype("float32")
+        scale = np.ones(3, "float32")
+        bias = np.zeros(3, "float32")
+        mean = np.zeros(3, "float32")
+        var = np.ones(3, "float32")
+        self.op_type = "inplace_abn"
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias,
+                       "Mean": mean, "Variance": var}
+        self.attrs = {"activation": "leaky_relu", "alpha": 0.1,
+                      "is_test": True}
+        bn = x  # mean 0 var 1 identity
+        e = np.where(bn >= 0, bn, 0.1 * bn)
+        outs = self._run_forward()
+        np.testing.assert_allclose(np.asarray(outs["Y"][0]), e, atol=1e-4)
+
+
+# -- conditional_block lowering --------------------------------------------
+
+class TestConditionalBlockLowering:
+    def test(self):
+        from paddle_tpu.fluid.layers import tensor as T
+
+        main, startup = framework.Program(), framework.Program()
+        with framework.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            acc = T.fill_constant([4], "float32", 0.0)
+            flag = fluid.layers.data("flag", shape=[1], dtype="bool")
+            prog = framework.default_main_program()
+            parent = prog.current_block()
+            sub = prog._create_block()
+            doubled = fluid.layers.elementwise_add(x, x)
+            T.assign(doubled, output=acc)
+            prog._rollback()
+            parent.append_op(
+                type="conditional_block",
+                inputs={"Cond": [flag]}, outputs={},
+                attrs={"sub_block": sub.idx})
+            exe = fluid.Executor()
+            exe.run(startup)
+            xv = np.arange(4).astype("float32")
+            on = exe.run(main, feed={
+                "x": xv, "flag": np.array([True])},
+                fetch_list=[acc])
+            off = exe.run(main, feed={
+                "x": xv, "flag": np.array([False])},
+                fetch_list=[acc])
+        np.testing.assert_allclose(np.asarray(on[0]), 2 * xv)
+        np.testing.assert_allclose(np.asarray(off[0]), 0.0)
+
+
+# -- PS op registration smoke ----------------------------------------------
+
+class TestPsOpsRegistered:
+    def test(self):
+        from paddle_tpu.ops.registry import has_op
+        for op in ("listen_and_serv", "distributed_lookup_table",
+                   "recv_save", "pull_sparse", "push_sparse",
+                   "pull_box_sparse", "split_byref", "c_gen_nccl_id",
+                   "c_comm_init", "c_comm_init_all", "run_program"):
+            assert has_op(op), op
+
+    def test_lookup_local_fallback(self):
+        import jax.numpy as jnp
+        r = np.random.RandomState(16)
+        w = r.randn(10, 3).astype("float32")
+        ids = np.array([[1], [7], [1]], "int64")
+        out = ops_lib.run_op(
+            "distributed_lookup_table",
+            {"Ids": [jnp.asarray(ids)], "W": [jnp.asarray(w)]},
+            {"table_name": ""})["Outputs"][0]
+        np.testing.assert_allclose(np.asarray(out), w[[1, 7, 1]])
+
+    def test_split_byref(self):
+        import jax.numpy as jnp
+        x = np.arange(12).reshape(6, 2).astype("float32")
+        outs = ops_lib.run_op("split_byref", {"X": [jnp.asarray(x)]},
+                              {"height_sections": [2, 4]})["Out"]
+        np.testing.assert_array_equal(np.asarray(outs[0]), x[:2])
+        np.testing.assert_array_equal(np.asarray(outs[1]), x[2:])
+
+    def test_comm_bootstrap_noop(self):
+        ops_lib.run_op("c_gen_nccl_id", {}, {"ring_id": 3})
+        ops_lib.run_op("c_comm_init", {}, {"ring_id": 3})
+
+
+class TestCudnnLstmSequenceLength(OpTest):
+    def test(self):
+        """A padded row must produce the same outputs as the same row in
+        an unpadded shorter batch."""
+        r = np.random.RandomState(17)
+        t, b, d, h = 6, 2, 3, 4
+        x = r.randn(t, b, d).astype("float32")
+        lens = np.array([6, 4], "int32")
+        x[4:, 1] = 0.0
+        sz = 2 * (4 * h * d + 4 * h * h + 8 * h)
+        w = (r.randn(sz) * 0.2).astype("float32")
+        self.op_type = "cudnn_lstm"
+        self.inputs = {"Input": x, "W": w,
+                       "SequenceLength": lens}
+        self.attrs = {"hidden_size": h, "num_layers": 1,
+                      "is_bidirec": True}
+        out = np.asarray(self._run_forward()["Out"][0])
+        # row 1 alone, truncated to its true length
+        self.inputs = {"Input": x[:4, 1:2], "W": w}
+        out1 = np.asarray(self._run_forward()["Out"][0])
+        np.testing.assert_allclose(out[:4, 1], out1[:, 0], atol=1e-5)
