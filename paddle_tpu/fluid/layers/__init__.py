@@ -9,6 +9,10 @@ from .rnn_decode import (  # noqa: F401
 )
 from . import learning_rate_scheduler  # noqa: F401
 from .nn import *  # noqa: F401,F403
+from .nn_extra import *  # noqa: F401,F403
+from . import nn_extra  # noqa: F401
+from . import detection  # noqa: F401
+from .detection import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .control_flow import (  # noqa: F401

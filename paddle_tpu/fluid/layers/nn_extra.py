@@ -1,0 +1,750 @@
+"""fluid.layers builders, second tranche (reference:
+`python/paddle/fluid/layers/nn.py` remainder): interpolation/resize
+wrappers, 3D conv/pool, vision rearrangement ops, RNN unit builders
+(dynamic_lstm/dynamic_gru families), candidate-sampling and structured
+losses, and misc helpers. Split from nn.py for maintainability; the
+public surface is identical (star-imported by layers/__init__)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import framework
+from ..param_attr import ParamAttr
+from ..layer_helper import LayerHelper, apply_op
+from ..initializer import ConstantInitializer
+
+__all__ = [
+    "interpolate", "resize_bilinear", "resize_trilinear", "resize_linear",
+    "resize_bicubic", "image_resize_short", "pool3d", "adaptive_pool3d",
+    "conv3d", "conv3d_transpose", "grid_sampler", "affine_grid",
+    "affine_channel", "lrn", "unfold", "space_to_depth",
+    "shuffle_channel", "temporal_shift", "pixel_shuffle", "maxout",
+    "selu", "softshrink", "hard_shrink", "tanh_shrink", "brelu",
+    "soft_relu", "thresholded_relu", "row_conv", "fsp_matrix", "hash",
+    "add_position_encoding", "similarity_focus", "random_crop",
+    "pad_constant_like", "continuous_value_model", "filter_by_instag",
+    "dynamic_lstm", "dynamic_lstmp", "dynamic_gru", "gru_unit",
+    "lstm_unit", "lstm", "nce", "sampled_softmax_with_cross_entropy",
+    "hsigmoid", "warpctc", "linear_chain_crf", "crf_decoding",
+    "im2sequence", "multiplex", "dice_loss", "log_loss", "npair_loss",
+    "rank_loss", "margin_rank_loss", "bpr_loss", "center_loss",
+    "teacher_student_sigmoid_loss", "sigmoid_focal_loss", "cos_sim",
+    "deformable_conv", "unpool", "spectral_norm", "sampling_id",
+    "py_func", "shard_index", "uniform_random_batch_size_like",
+]
+
+
+def _one(op, inputs, attrs, slot="Out", dtype=None, helper=None):
+    return apply_op(helper or op, op, inputs, attrs, [slot],
+                    out_dtype=dtype)[0]
+
+
+# -- interpolation ----------------------------------------------------------
+
+_RESAMPLE_OP = {"NEAREST": "nearest_interp", "BILINEAR": "bilinear_interp",
+                "TRILINEAR": "trilinear_interp", "BICUBIC": "bicubic_interp",
+                "LINEAR": "linear_interp"}
+
+
+def interpolate(input, out_shape=None, scale=None, name=None,
+                resample="BILINEAR", actual_shape=None, align_corners=True,
+                align_mode=1, data_format="NCHW"):
+    """reference layers/nn.py interpolate → the *_interp op family. The
+    OutSize tensor path is folded to static ints (XLA static shapes)."""
+    op_type = _RESAMPLE_OP[resample.upper()]
+    attrs = {"align_corners": align_corners, "align_mode": align_mode,
+             "data_layout": data_format}
+    shape = out_shape if out_shape is not None else actual_shape
+    if shape is not None:
+        dims = [int(d) for d in (
+            shape.tolist() if hasattr(shape, "tolist") else shape)]
+        keys = {1: ["out_w"], 2: ["out_h", "out_w"],
+                3: ["out_d", "out_h", "out_w"]}[len(dims)]
+        attrs.update(dict(zip(keys, dims)))
+    elif scale is not None:
+        attrs["scale"] = float(scale)
+    else:
+        raise ValueError("interpolate needs out_shape or scale")
+    return _one(op_type, {"X": [input]}, attrs)
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    actual_shape=None, align_corners=True, align_mode=1,
+                    data_format="NCHW"):
+    return interpolate(input, out_shape, scale, name, "BILINEAR",
+                       actual_shape, align_corners, align_mode,
+                       data_format)
+
+
+def resize_trilinear(input, out_shape=None, scale=None, name=None,
+                     actual_shape=None, align_corners=True, align_mode=1,
+                     data_format="NCDHW"):
+    return interpolate(input, out_shape, scale, name, "TRILINEAR",
+                       actual_shape, align_corners, align_mode,
+                       data_format)
+
+
+def resize_linear(input, out_shape=None, scale=None, name=None,
+                  align_corners=True, align_mode=1, data_format="NCW"):
+    return interpolate(input, out_shape, scale, name, "LINEAR", None,
+                       align_corners, align_mode, data_format)
+
+
+def resize_bicubic(input, out_shape=None, scale=None, name=None,
+                   align_corners=True, data_format="NCHW"):
+    return interpolate(input, out_shape, scale, name, "BICUBIC", None,
+                       align_corners, 0, data_format)
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    h, w = input.shape[2], input.shape[3]
+    short, long_ = (h, w) if h < w else (w, h)
+    ratio = out_short_len / float(short)
+    out_shape = ([out_short_len, int(long_ * ratio)] if h < w
+                 else [int(long_ * ratio), out_short_len])
+    return interpolate(input, out_shape=out_shape, resample=resample)
+
+
+# -- 3d conv/pool -----------------------------------------------------------
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           exclusive=True, name=None):
+    def _triple(v):
+        return [v] * 3 if isinstance(v, int) else list(v)
+    if global_pooling:
+        pool_size = list(input.shape[2:])
+        pool_padding = 0
+    return _one("pool3d", {"X": [input]},
+                {"ksize": _triple(pool_size),
+                 "pooling_type": pool_type,
+                 "strides": _triple(pool_stride),
+                 "paddings": _triple(pool_padding)})
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", name=None):
+    d, h, w = input.shape[2:]
+    ps = [pool_size] * 3 if isinstance(pool_size, int) else list(pool_size)
+    assert d % ps[0] == 0 and h % ps[1] == 0 and w % ps[2] == 0, \
+        "adaptive_pool3d needs divisible spatial dims"
+    k = [d // ps[0], h // ps[1], w // ps[2]]
+    return _one("pool3d", {"X": [input]},
+                {"ksize": k, "pooling_type": pool_type, "strides": k,
+                 "paddings": [0, 0, 0]})
+
+
+def _conv_nd(op_type, input, num_filters, filter_size, stride, padding,
+             dilation, groups, param_attr, bias_attr, act, name, nd,
+             transpose=False):
+    helper = LayerHelper(op_type, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+
+    def _tup(v):
+        return [v] * nd if isinstance(v, int) else list(v)
+
+    c_in = input.shape[1]
+    groups = groups or 1
+    if transpose:
+        w_shape = [c_in, num_filters // groups] + _tup(filter_size)
+    else:
+        w_shape = [num_filters, c_in // groups] + _tup(filter_size)
+    w = helper.create_parameter(helper.param_attr, shape=w_shape,
+                                dtype=input.dtype)
+    out = apply_op(helper, op_type,
+                   {"Input": [input], "Filter": [w]},
+                   {"strides": _tup(stride), "paddings": _tup(padding),
+                    "dilations": _tup(dilation), "groups": groups},
+                   ["Output"], out_dtype=input.dtype)[0]
+    if helper.bias_attr is not False:
+        b = helper.create_parameter(
+            helper.bias_attr, shape=[num_filters], dtype=input.dtype,
+            is_bias=True)
+        out = _one("elementwise_add", {"X": [out], "Y": [b]},
+                   {"axis": 1}, dtype=input.dtype, helper=helper)
+    return helper.append_activation(out)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=None, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None):
+    return _conv_nd("conv3d", input, num_filters, filter_size, stride,
+                    padding, dilation, groups, param_attr, bias_attr,
+                    act, name, 3)
+
+
+def conv3d_transpose(input, num_filters, output_size=None,
+                     filter_size=None, padding=0, stride=1, dilation=1,
+                     groups=None, param_attr=None, bias_attr=None,
+                     use_cudnn=True, act=None, name=None):
+    return _conv_nd("conv3d_transpose", input, num_filters, filter_size,
+                    stride, padding, dilation, groups, param_attr,
+                    bias_attr, act, name, 3, transpose=True)
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size,
+                    stride=1, padding=0, dilation=1, groups=None,
+                    deformable_groups=None, im2col_step=None,
+                    param_attr=None, bias_attr=None,
+                    modulated=True, name=None):
+    helper = LayerHelper("deformable_conv", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+
+    def _pair(v):
+        return [v] * 2 if isinstance(v, int) else list(v)
+
+    groups = groups or 1
+    c_in = input.shape[1]
+    w = helper.create_parameter(
+        helper.param_attr,
+        shape=[num_filters, c_in // groups] + _pair(filter_size),
+        dtype=input.dtype)
+    op = "deformable_conv" if modulated else "deformable_conv_v1"
+    ins = {"Input": [input], "Offset": [offset], "Filter": [w]}
+    if modulated and mask is not None:
+        ins["Mask"] = [mask]
+    return apply_op(helper, op, ins,
+                    {"strides": _pair(stride), "paddings": _pair(padding),
+                     "dilations": _pair(dilation), "groups": groups,
+                     "deformable_groups": deformable_groups or 1},
+                    ["Output"], out_dtype=input.dtype)[0]
+
+
+def unpool(input, indices, unpool_size=None, name=None):
+    oh, ow = unpool_size if unpool_size else (
+        input.shape[2] * 2, input.shape[3] * 2)
+    return _one("unpool", {"X": [input], "Indices": [indices]},
+                {"unpooled_height": oh, "unpooled_width": ow})
+
+
+# -- vision helpers ----------------------------------------------------------
+
+def grid_sampler(x, grid, name=None):
+    return _one("grid_sampler", {"X": [x], "Grid": [grid]}, {},
+                "Output")
+
+
+def affine_grid(theta, out_shape=None, name=None):
+    attrs = {}
+    if out_shape is not None and not isinstance(out_shape, framework.Variable):
+        attrs["output_shape"] = [int(v) for v in out_shape]
+    return _one("affine_grid", {"Theta": [theta]}, attrs, "Output")
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW",
+                   name=None, act=None):
+    out = _one("affine_channel",
+               {"X": [x], "Scale": [scale], "Bias": [bias]},
+               {"data_layout": data_layout})
+    helper = LayerHelper("affine_channel", act=act)
+    return helper.append_activation(out)
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None,
+        data_format="NCHW"):
+    return _one("lrn", {"X": [input]},
+                {"n": n, "k": k, "alpha": alpha, "beta": beta,
+                 "data_format": data_format})
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def _pair(v):
+        return [v] * 2 if isinstance(v, int) else list(v)
+    pads = _pair(paddings)
+    if len(pads) == 2:
+        pads = pads + pads
+    return _one("unfold", {"X": [x]},
+                {"kernel_sizes": _pair(kernel_sizes),
+                 "strides": _pair(strides), "paddings": pads,
+                 "dilations": _pair(dilations)}, "Y")
+
+
+def space_to_depth(x, blocksize, name=None):
+    return _one("space_to_depth", {"X": [x]}, {"blocksize": blocksize})
+
+
+def shuffle_channel(x, group, name=None):
+    return _one("shuffle_channel", {"X": [x]}, {"group": group})
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    return _one("temporal_shift", {"X": [x]},
+                {"seg_num": seg_num, "shift_ratio": shift_ratio})
+
+
+def pixel_shuffle(x, upscale_factor):
+    return _one("pixel_shuffle", {"X": [x]},
+                {"upscale_factor": upscale_factor})
+
+
+def maxout(x, groups, name=None, axis=1):
+    return _one("maxout", {"X": [x]}, {"groups": groups, "axis": axis})
+
+
+def _act_wrapper(op_type, attr_names=()):
+    def fn(x, *args, **kwargs):
+        attrs = {}
+        for i, a in enumerate(args):
+            attrs[attr_names[i]] = a
+        for k, v in kwargs.items():
+            if k in attr_names:
+                attrs[k] = v
+        return _one(op_type, {"X": [x]}, attrs)
+    fn.__name__ = op_type
+    return fn
+
+
+selu = _act_wrapper("selu", ("scale", "alpha"))
+softshrink = _act_wrapper("softshrink", ("lambda",))
+hard_shrink = _act_wrapper("hard_shrink", ("threshold",))
+tanh_shrink = _act_wrapper("tanh_shrink")
+brelu = _act_wrapper("brelu", ("t_min", "t_max"))
+soft_relu = _act_wrapper("soft_relu", ("threshold",))
+thresholded_relu = _act_wrapper("thresholded_relu", ("threshold",))
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    helper = LayerHelper("row_conv", param_attr=param_attr, act=act)
+    d = input.shape[-1]
+    w = helper.create_parameter(helper.param_attr,
+                                shape=[future_context_size + 1, d],
+                                dtype=input.dtype)
+    out = apply_op(helper, "row_conv",
+                   {"X": [input], "Filter": [w]}, {}, ["Out"],
+                   out_dtype=input.dtype)[0]
+    return helper.append_activation(out)
+
+
+def fsp_matrix(x, y):
+    return _one("fsp", {"X": [x], "Y": [y]}, {})
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    return _one("hash", {"X": [input]},
+                {"mod_by": hash_size, "num_hash": num_hash},
+                dtype="int64")
+
+
+def add_position_encoding(input, alpha, beta, name=None):
+    return _one("add_position_encoding", {"X": [input]},
+                {"alpha": alpha, "beta": beta})
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    return _one("similarity_focus", {"X": [input]},
+                {"axis": axis, "indexes": list(indexes)})
+
+
+def random_crop(x, shape, seed=None):
+    return _one("random_crop", {"X": [x]}, {"shape": list(shape)})
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    return _one("pad_constant_like", {"X": [x], "Y": [y]},
+                {"pad_value": pad_value})
+
+
+def continuous_value_model(input, cvm, use_cvm=True):
+    return _one("cvm", {"X": [input], "CVM": [cvm]},
+                {"use_cvm": use_cvm}, "Y")
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod, out_val_if_empty=0):
+    outs = apply_op("filter_by_instag", "filter_by_instag",
+                    {"Ins": [ins], "Ins_tag": [ins_tag],
+                     "Filter_tag": [filter_tag]},
+                    {"is_lod": is_lod}, ["Out", "LossWeight", "IndexMap"])
+    return outs[0], outs[1], outs[2]
+
+
+# -- rnn units --------------------------------------------------------------
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=True, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None):
+    """reference layers/nn.py dynamic_lstm: input [B, T, 4D] is the
+    pre-projected gate input; creates Weight [D, 4D] and Bias."""
+    helper = LayerHelper("lstm", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    d = size // 4
+    w = helper.create_parameter(helper.param_attr, shape=[d, 4 * d],
+                                dtype=dtype)
+    b_len = 7 * d if use_peepholes else 4 * d
+    b = helper.create_parameter(helper.bias_attr, shape=[1, b_len],
+                                dtype=dtype, is_bias=True)
+    ins = {"Input": [input], "Weight": [w], "Bias": [b]}
+    if h_0 is not None:
+        ins["H0"] = [h_0]
+    if c_0 is not None:
+        ins["C0"] = [c_0]
+    outs = apply_op(helper, "lstm", ins,
+                    {"use_peepholes": use_peepholes,
+                     "is_reverse": is_reverse,
+                     "gate_activation": gate_activation,
+                     "cell_activation": cell_activation,
+                     "candidate_activation": candidate_activation},
+                    ["Hidden", "Cell"], out_dtype=dtype)
+    return outs[0], outs[1]
+
+
+def dynamic_lstmp(input, size, proj_size, h_0=None, c_0=None,
+                  param_attr=None, bias_attr=None, use_peepholes=True,
+                  is_reverse=False, gate_activation="sigmoid",
+                  cell_activation="tanh", candidate_activation="tanh",
+                  proj_activation="tanh", dtype="float32", name=None):
+    helper = LayerHelper("lstmp", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    d = size // 4
+    w = helper.create_parameter(helper.param_attr,
+                                shape=[proj_size, 4 * d], dtype=dtype)
+    w_proj = helper.create_parameter(helper.param_attr,
+                                     shape=[d, proj_size], dtype=dtype)
+    b_len = 7 * d if use_peepholes else 4 * d
+    b = helper.create_parameter(helper.bias_attr, shape=[1, b_len],
+                                dtype=dtype, is_bias=True)
+    ins = {"Input": [input], "Weight": [w], "ProjWeight": [w_proj],
+           "Bias": [b]}
+    if h_0 is not None:
+        ins["H0"] = [h_0]
+    if c_0 is not None:
+        ins["C0"] = [c_0]
+    outs = apply_op(helper, "lstmp", ins,
+                    {"use_peepholes": use_peepholes,
+                     "is_reverse": is_reverse,
+                     "gate_activation": gate_activation,
+                     "cell_activation": cell_activation,
+                     "candidate_activation": candidate_activation,
+                     "proj_activation": proj_activation},
+                    ["Projection", "Cell"], out_dtype=dtype)
+    return outs[0], outs[1]
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None,
+                origin_mode=False):
+    helper = LayerHelper("gru", param_attr=param_attr,
+                         bias_attr=bias_attr)
+    dtype = input.dtype
+    w = helper.create_parameter(helper.param_attr, shape=[size, 3 * size],
+                                dtype=dtype)
+    b = helper.create_parameter(helper.bias_attr, shape=[1, 3 * size],
+                                dtype=dtype, is_bias=True)
+    ins = {"Input": [input], "Weight": [w], "Bias": [b]}
+    if h_0 is not None:
+        ins["H0"] = [h_0]
+    return apply_op(helper, "gru", ins,
+                    {"is_reverse": is_reverse,
+                     "gate_activation": gate_activation,
+                     "activation": candidate_activation,
+                     "origin_mode": origin_mode},
+                    ["Hidden", "BatchGate", "BatchResetHiddenPrev",
+                     "BatchHidden"], out_dtype=dtype)[0]
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid",
+             origin_mode=False):
+    helper = LayerHelper("gru_unit", param_attr=param_attr,
+                         bias_attr=bias_attr)
+    dtype = input.dtype
+    d = size // 3
+    w = helper.create_parameter(helper.param_attr, shape=[d, 3 * d],
+                                dtype=dtype)
+    b = helper.create_parameter(helper.bias_attr, shape=[1, 3 * d],
+                                dtype=dtype, is_bias=True)
+    outs = apply_op(helper, "gru_unit",
+                    {"Input": [input], "HiddenPrev": [hidden],
+                     "Weight": [w], "Bias": [b]},
+                    {"activation": activation,
+                     "gate_activation": gate_activation,
+                     "origin_mode": origin_mode},
+                    ["Hidden", "Gate", "ResetHiddenPrev"],
+                    out_dtype=dtype)
+    return outs[0], outs[2], outs[1]
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """reference layers/nn.py lstm_unit: fc([x, h]) → lstm_unit op."""
+    from .nn import fc
+    from .tensor import concat
+    d = cell_t_prev.shape[-1]
+    merged = concat([x_t, hidden_t_prev], axis=1)
+    gates = fc(merged, 4 * d, param_attr=param_attr, bias_attr=bias_attr)
+    outs = apply_op("lstm_unit", "lstm_unit",
+                    {"X": [gates], "C_prev": [cell_t_prev]},
+                    {"forget_bias": forget_bias}, ["C", "H"],
+                    out_dtype=x_t.dtype)
+    return outs[1], outs[0]
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1):
+    """reference layers/nn.py lstm (the cudnn_lstm builder): input
+    [T, B, D] time-major."""
+    helper = LayerHelper("cudnn_lstm", name=name)
+    d_in = input.shape[-1]
+    n_dir = 2 if is_bidirec else 1
+    sz = 0
+    d_cur = d_in
+    for _ in range(num_layers):
+        sz += n_dir * (4 * hidden_size * d_cur
+                       + 4 * hidden_size * hidden_size + 8 * hidden_size)
+        d_cur = hidden_size * n_dir
+    w = helper.create_parameter(
+        ParamAttr(initializer=default_initializer)
+        if default_initializer else None,
+        shape=[sz], dtype=input.dtype)
+    outs = apply_op(helper, "cudnn_lstm",
+                    {"Input": [input], "W": [w], "InitH": [init_h],
+                     "InitC": [init_c]},
+                    {"hidden_size": hidden_size, "num_layers": num_layers,
+                     "is_bidirec": is_bidirec},
+                    ["Out", "last_h", "last_c"], out_dtype=input.dtype)
+    return outs[0], outs[1], outs[2]
+
+
+# -- sampling / structured losses -------------------------------------------
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=None, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    helper = LayerHelper("nce", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dtype = input.dtype
+    d = input.shape[-1]
+    w = helper.create_parameter(helper.param_attr,
+                                shape=[num_total_classes, d], dtype=dtype)
+    b = helper.create_parameter(helper.bias_attr,
+                                shape=[num_total_classes], dtype=dtype,
+                                is_bias=True)
+    sampler_id = {"uniform": 0, "log_uniform": 1,
+                  "custom_dist": 2}[sampler]
+    outs = apply_op(helper, "nce",
+                    {"Input": [input], "Label": [label], "Weight": [w],
+                     "Bias": [b]},
+                    {"num_neg_samples": num_neg_samples or 10,
+                     "sampler": sampler_id, "seed": seed},
+                    ["Cost", "SampleLogits", "SampleLabels"],
+                    out_dtype=dtype)
+    return outs[0]
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                       num_true=1,
+                                       remove_accidental_hits=True,
+                                       use_customized_samples=False,
+                                       customized_samples=None,
+                                       customized_probabilities=None,
+                                       seed=0):
+    outs = apply_op("sampled_softmax_with_cross_entropy",
+                    "sampled_softmax_with_cross_entropy",
+                    {"Logits": [logits], "Label": [label]},
+                    {"num_samples": num_samples,
+                     "remove_accidental_hits": remove_accidental_hits,
+                     "seed": seed}, ["Loss", "Softmax"])
+    return outs[0]
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    helper = LayerHelper("hsigmoid", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dtype = input.dtype
+    d = input.shape[-1]
+    w = helper.create_parameter(helper.param_attr,
+                                shape=[num_classes - 1, d], dtype=dtype)
+    b = helper.create_parameter(helper.bias_attr,
+                                shape=[num_classes - 1], dtype=dtype,
+                                is_bias=True)
+    outs = apply_op(helper, "hsigmoid",
+                    {"X": [input], "W": [w], "Label": [label],
+                     "Bias": [b]},
+                    {"num_classes": num_classes}, ["Out", "PreOut"],
+                    out_dtype=dtype)
+    return outs[0]
+
+
+def warpctc(input, label, blank=0, norm_by_times=False,
+            input_length=None, label_length=None):
+    ins = {"Logits": [input], "Label": [label]}
+    if input_length is not None:
+        ins["LogitsLength"] = [input_length]
+    if label_length is not None:
+        ins["LabelLength"] = [label_length]
+    return _one("warpctc", ins,
+                {"blank": blank, "norm_by_times": norm_by_times},
+                "Loss")
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    helper = LayerHelper("linear_chain_crf", param_attr=param_attr)
+    k = input.shape[-1]
+    w = helper.create_parameter(helper.param_attr, shape=[k + 2, k],
+                                dtype=input.dtype)
+    ins = {"Emission": [input], "Transition": [w], "Label": [label]}
+    if length is not None:
+        ins["Length"] = [length]
+    outs = apply_op(helper, "linear_chain_crf", ins, {},
+                    ["LogLikelihood", "Alpha", "EmissionExps",
+                     "TransitionExps"], out_dtype=input.dtype)
+    return outs[0]
+
+
+def crf_decoding(input, param_attr, label=None, length=None):
+    helper = LayerHelper("crf_decoding", param_attr=param_attr)
+    # reuse the transition parameter created by linear_chain_crf via
+    # param_attr.name
+    from ..framework import default_main_program
+    name = param_attr.name if param_attr is not None and \
+        getattr(param_attr, "name", None) else None
+    blk = default_main_program().global_block()
+    if name and name in blk.vars:
+        w = blk.vars[name]
+    else:
+        k = input.shape[-1]
+        w = helper.create_parameter(helper.param_attr, shape=[k + 2, k],
+                                    dtype=input.dtype)
+    ins = {"Emission": [input], "Transition": [w]}
+    if label is not None:
+        ins["Label"] = [label]
+    if length is not None:
+        ins["Length"] = [length]
+    return apply_op(helper, "crf_decoding", ins, {}, ["ViterbiPath"],
+                    out_dtype="int64")[0]
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0,
+                input_image_size=None, out_stride=1, name=None):
+    def _pair(v):
+        return [v] * 2 if isinstance(v, int) else list(v)
+    pads = _pair(padding)
+    if len(pads) == 2:
+        pads = pads + pads
+    return _one("im2sequence", {"X": [input]},
+                {"kernels": _pair(filter_size),
+                 "strides": _pair(stride), "paddings": pads})
+
+
+def multiplex(inputs, index):
+    return _one("multiplex", {"X": list(inputs), "Ids": [index]}, {})
+
+
+# -- small losses ------------------------------------------------------------
+
+def dice_loss(input, label, epsilon=1e-5):
+    return _one("dice_loss", {"X": [input], "Label": [label]},
+                {"epsilon": epsilon})
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return _one("log_loss", {"Predicted": [input], "Labels": [label]},
+                {"epsilon": epsilon}, "Loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    return _one("npair_loss",
+                {"Anchor": [anchor], "Positive": [positive],
+                 "Labels": [labels]}, {"l2_reg": l2_reg})
+
+
+def rank_loss(label, left, right, name=None):
+    return _one("rank_loss",
+                {"Label": [label], "Left": [left], "Right": [right]}, {})
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    return _one("margin_rank_loss",
+                {"Label": [label], "X1": [left], "X2": [right]},
+                {"margin": margin})
+
+
+def bpr_loss(input, label, name=None):
+    return _one("bpr_loss", {"X": [input], "Label": [label]}, {})
+
+
+def center_loss(input, label, num_classes, alpha, param_attr=None,
+                update_center=True):
+    helper = LayerHelper("center_loss", param_attr=param_attr)
+    d = input.shape[-1]
+    centers = helper.create_parameter(
+        helper.param_attr, shape=[num_classes, d], dtype=input.dtype,
+        default_initializer=ConstantInitializer(0.0))
+    outs = apply_op(helper, "center_loss",
+                    {"X": [input], "Label": [label],
+                     "Centers": [centers]},
+                    {"cluster_num": num_classes, "alpha": alpha,
+                     "need_update": update_center},
+                    ["Loss", "SampleCenterDiff", "CentersOut"],
+                    out_dtype=input.dtype)
+    return outs[0]
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    return _one("teacher_student_sigmoid_loss",
+                {"X": [input], "Label": [label]},
+                {"soft_max_up_bound": soft_max_up_bound,
+                 "soft_max_lower_bound": soft_max_lower_bound}, "Y")
+
+
+def sigmoid_focal_loss(x, label, fg_num=None, gamma=2.0, alpha=0.25):
+    from .detection import sigmoid_focal_loss as _impl
+    return _impl(x, label, fg_num, gamma, alpha)
+
+
+def cos_sim(X, Y):
+    return _one("cos_sim", {"X": [X], "Y": [Y]}, {})
+
+
+# -- misc --------------------------------------------------------------------
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    helper = LayerHelper("spectral_norm", name=name)
+    h = weight.shape[dim]
+    w_dim = int(np.prod(weight.shape)) // h
+    from ..initializer import NormalInitializer
+    u = helper.create_parameter(None, shape=[h], dtype=weight.dtype,
+                                default_initializer=NormalInitializer())
+    v = helper.create_parameter(None, shape=[w_dim], dtype=weight.dtype,
+                                default_initializer=NormalInitializer())
+    return apply_op(helper, "spectral_norm",
+                    {"Weight": [weight], "U": [u], "V": [v]},
+                    {"dim": dim, "power_iters": power_iters, "eps": eps},
+                    ["Out"], out_dtype=weight.dtype)[0]
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32"):
+    return _one("sampling_id", {"X": [x]},
+                {"min": min, "max": max, "seed": seed}, dtype="int64")
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """reference layers/py_func: call a python function inside the
+    program. `out` gives the output var(s) template."""
+    from ...ops.framework_ops import register_py_func
+    fid = register_py_func(func)
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    got = apply_op("py_func", "py_func", {"X": list(xs)},
+                   {"func_id": fid}, {"Out": len(outs)})
+    return got if len(got) > 1 else got[0]
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    return _one("shard_index", {"X": [input]},
+                {"index_num": index_num, "nshards": nshards,
+                 "shard_id": shard_id, "ignore_value": ignore_value},
+                dtype="int64")
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32",
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):
+    return _one("uniform_random_batch_size_like", {"Input": [input]},
+                {"shape": list(shape), "input_dim_idx": input_dim_idx,
+                 "output_dim_idx": output_dim_idx, "min": min,
+                 "max": max, "seed": seed}, dtype=dtype)

@@ -123,3 +123,78 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                      {"causal": is_causal,
                       "attn_dropout_prob": dropout_p,
                       "is_test": not training}, ["Out"])[0]
+
+
+# -- reference functional/__init__.py alias surface (DEFINE_ALIAS names) ----
+# everything below re-exports the fluid.layers builders under the 2.0
+# namespace (reference: python/paddle/nn/functional/__init__.py).
+from ..fluid.layers.nn import (  # noqa: F401,E402
+    l2_normalize, label_smooth, pool2d, adaptive_pool2d,
+    elementwise_add,
+)
+from ..fluid.layers.nn_extra import (  # noqa: F401,E402
+    brelu, hard_shrink, maxout,
+)
+from ..fluid.layers.nn_extra import (  # noqa: F401,E402
+    interpolate, resize_bilinear, resize_trilinear, resize_bicubic,
+    image_resize_short, pool3d, adaptive_pool3d, grid_sampler,
+    affine_grid, affine_channel, lrn, unfold, space_to_depth,
+    shuffle_channel, temporal_shift, pixel_shuffle, selu, softshrink,
+    tanh_shrink, soft_relu, thresholded_relu, row_conv, fsp_matrix, hash,
+    add_position_encoding, similarity_focus, random_crop,
+    pad_constant_like, continuous_value_model, filter_by_instag,
+    warpctc, hsigmoid, sampled_softmax_with_cross_entropy,
+    dice_loss, log_loss, npair_loss, rank_loss, margin_rank_loss,
+    bpr_loss, center_loss, teacher_student_sigmoid_loss, cos_sim,
+    deformable_conv, unpool, conv3d, conv3d_transpose,
+)
+from ..fluid.layers.nn import (  # noqa: F401,E402
+    image_resize, resize_nearest,
+)
+from ..fluid.layers.detection import (  # noqa: F401,E402
+    anchor_generator, bipartite_match, box_clip, box_coder,
+    box_decoder_and_assign, collect_fpn_proposals, density_prior_box,
+    detection_output, distribute_fpn_proposals,
+    deformable_roi_pooling, generate_proposal_labels,
+    generate_proposals, iou_similarity, multiclass_nms,
+    polygon_box_transform, prior_box, prroi_pool, psroi_pool,
+    retinanet_detection_output, retinanet_target_assign, roi_align,
+    roi_pool, roi_perspective_transform, rpn_target_assign,
+    sigmoid_focal_loss, ssd_loss, target_assign, yolo_box, yolov3_loss,
+)
+from ..fluid.layers.loss import (  # noqa: F401,E402
+    huber_loss, smooth_l1,
+)
+from ..fluid.layers.learning_rate_scheduler import (  # noqa: F401,E402
+    cosine_decay, exponential_decay, inverse_time_decay, natural_exp_decay,
+    noam_decay, piecewise_decay, polynomial_decay,
+)
+from ..fluid.layers.learning_rate_scheduler import (  # noqa: F401,E402
+    linear_lr_warmup,
+)
+from ..fluid.layers.tensor import assign  # noqa: F401,E402
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    return _apply_op("diag_embed", "diag_embed", {"Input": [input]},
+                     {"offset": offset, "dim1": dim1, "dim2": dim2},
+                     ["Out"],
+                     out_dtype=getattr(input, "dtype", "float32"))[0]
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     dilation=1, groups=1, output_size=None, name=None):
+    def _pair(v):
+        return [v, v] if isinstance(v, int) else list(v)
+
+    out = _apply_op("conv2d_transpose", "conv2d_transpose",
+                    {"Input": [x], "Filter": [weight]},
+                    {"strides": _pair(stride), "paddings": _pair(padding),
+                     "dilations": _pair(dilation), "groups": groups},
+                    ["Output"],
+                    out_dtype=getattr(x, "dtype", "float32"))[0]
+    if bias is not None:
+        out = _apply_op("elementwise_add", "elementwise_add",
+                        {"X": [out], "Y": [bias]}, {"axis": 1}, ["Out"],
+                        out_dtype=getattr(x, "dtype", "float32"))[0]
+    return out

@@ -1,0 +1,248 @@
+"""paddle.nn layer classes, second tranche (reference:
+`python/paddle/nn/layer/` common/conv/loss/norm/extension): the 2.0
+class surface over the functional builders."""
+from __future__ import annotations
+
+from ..fluid.dygraph.layers import Layer
+from ..fluid.initializer import ConstantInitializer, NormalInitializer
+from . import functional as F
+
+__all__ = [
+    "BCELoss", "NLLLoss", "HSigmoid", "LogSoftmax", "Pad2D", "UpSample",
+    "Conv3D", "Conv3DTranspose", "RowConv", "SpectralNorm",
+    "BilinearTensorProduct", "InstanceNorm",
+]
+
+
+class BCELoss(Layer):
+    def __init__(self, weight=None, reduction="mean"):
+        super().__init__()
+        self._weight = weight
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        from ..fluid.layer_helper import apply_op
+        from .. import tensor as T
+
+        out = apply_op("bce_loss", "bce_loss",
+                       {"X": [input], "Label": [label]}, {}, ["Out"],
+                       out_dtype="float32")[0]
+        if self._weight is not None:
+            out = out * self._weight
+        if self._reduction == "mean":
+            return T.mean(out)
+        if self._reduction == "sum":
+            return T.sum(out)
+        return out
+
+
+class NLLLoss(Layer):
+    def __init__(self, weight=None, ignore_index=-100, reduction="mean"):
+        super().__init__()
+        self._weight = weight
+        self._ignore = ignore_index
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        from ..fluid.layer_helper import apply_op
+
+        ins = {"X": [input], "Label": [label]}
+        if self._weight is not None:
+            ins["Weight"] = [self._weight]
+        return apply_op("nll_loss", "nll_loss", ins,
+                        {"reduction": self._reduction,
+                         "ignore_index": self._ignore},
+                        ["Out", "Total_weight"],
+                        out_dtype="float32")[0]
+
+
+class HSigmoid(Layer):
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        self._num_classes = num_classes
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], attr=weight_attr,
+            default_initializer=NormalInitializer(scale=0.01))
+        self.bias = self.create_parameter(
+            [num_classes - 1], attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        from ..fluid.layer_helper import apply_op
+
+        return apply_op("hsigmoid", "hsigmoid",
+                        {"X": [input], "W": [self.weight],
+                         "Label": [label], "Bias": [self.bias]},
+                        {"num_classes": self._num_classes},
+                        ["Out", "PreOut"], out_dtype="float32")[0]
+
+
+class LogSoftmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return F.log_softmax(x, axis=self._axis)
+
+
+class Pad2D(Layer):
+    def __init__(self, paddings=0, mode="constant", pad_value=0.0,
+                 data_format="NCHW"):
+        super().__init__()
+        self._pad = ([paddings] * 4 if isinstance(paddings, int)
+                     else list(paddings))
+        self._mode = mode
+        self._value = pad_value
+
+    def forward(self, x):
+        return F.pad2d(x, paddings=self._pad, mode=self._mode,
+                       pad_value=self._value)
+
+
+class UpSample(Layer):
+    def __init__(self, out_shape=None, scale=None, resample="BILINEAR",
+                 actual_shape=None, align_corners=True, align_mode=1,
+                 data_format="NCHW"):
+        super().__init__()
+        self._args = (out_shape, scale, resample, align_corners,
+                      align_mode, data_format)
+
+    def forward(self, x):
+        out_shape, scale, resample, ac, am, fmt = self._args
+        return F.interpolate(x, out_shape=out_shape, scale=scale,
+                             resample=resample, align_corners=ac,
+                             align_mode=am, data_format=fmt)
+
+
+class _ConvNd(Layer):
+    _op = "conv3d"
+    _transpose = False
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        nd = 3
+        k = [kernel_size] * nd if isinstance(kernel_size, int) \
+            else list(kernel_size)
+        if self._transpose:
+            w_shape = [in_channels, out_channels // groups] + k
+        else:
+            w_shape = [out_channels, in_channels // groups] + k
+        self.weight = self.create_parameter(w_shape, attr=weight_attr)
+        self.bias = (self.create_parameter([out_channels], attr=bias_attr,
+                                           is_bias=True)
+                     if bias_attr is not False else None)
+        self._attrs = {"strides": [stride] * nd if isinstance(stride, int)
+                       else list(stride),
+                       "paddings": [padding] * nd
+                       if isinstance(padding, int) else list(padding),
+                       "dilations": [dilation] * nd
+                       if isinstance(dilation, int) else list(dilation),
+                       "groups": groups}
+
+    def forward(self, x):
+        from ..fluid.layer_helper import apply_op
+
+        out = apply_op(self._op, self._op,
+                       {"Input": [x], "Filter": [self.weight]},
+                       self._attrs, ["Output"],
+                       out_dtype=getattr(x, "dtype", "float32"))[0]
+        if self.bias is not None:
+            out = apply_op("elementwise_add", "elementwise_add",
+                           {"X": [out], "Y": [self.bias]}, {"axis": 1},
+                           ["Out"],
+                           out_dtype=getattr(x, "dtype", "float32"))[0]
+        return out
+
+
+class Conv3D(_ConvNd):
+    _op = "conv3d"
+
+
+class Conv3DTranspose(_ConvNd):
+    _op = "conv3d_transpose"
+    _transpose = True
+
+
+class RowConv(Layer):
+    def __init__(self, num_channels, future_context_size,
+                 param_attr=None, act=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [future_context_size + 1, num_channels], attr=param_attr)
+        self._act = act
+
+    def forward(self, x):
+        from ..fluid.layer_helper import apply_op
+
+        out = apply_op("row_conv", "row_conv",
+                       {"X": [x], "Filter": [self.weight]}, {}, ["Out"],
+                       out_dtype=getattr(x, "dtype", "float32"))[0]
+        if self._act == "relu":
+            out = F.relu(out)
+        return out
+
+
+class SpectralNorm(Layer):
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12):
+        super().__init__()
+        import numpy as np
+
+        h = weight_shape[dim]
+        w_dim = int(np.prod(weight_shape)) // h
+        self._attrs = {"dim": dim, "power_iters": power_iters, "eps": eps}
+        self.weight_u = self.create_parameter(
+            [h], default_initializer=NormalInitializer())
+        self.weight_v = self.create_parameter(
+            [w_dim], default_initializer=NormalInitializer())
+
+    def forward(self, weight):
+        from ..fluid.layer_helper import apply_op
+
+        return apply_op("spectral_norm", "spectral_norm",
+                        {"Weight": [weight], "U": [self.weight_u],
+                         "V": [self.weight_v]}, self._attrs, ["Out"],
+                        out_dtype=getattr(weight, "dtype", "float32"))[0]
+
+
+class BilinearTensorProduct(Layer):
+    def __init__(self, input1_dim, input2_dim, output_dim,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [output_dim, input1_dim, input2_dim], attr=weight_attr)
+        self.bias = self.create_parameter([1, output_dim], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x1, x2):
+        from ..fluid.layer_helper import apply_op
+
+        return apply_op("bilinear_tensor_product",
+                        "bilinear_tensor_product",
+                        {"X": [x1], "Y": [x2], "Weight": [self.weight],
+                         "Bias": [self.bias]}, {}, ["Out"],
+                        out_dtype=getattr(x1, "dtype", "float32"))[0]
+
+
+class InstanceNorm(Layer):
+    def __init__(self, num_features, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        self._eps = epsilon
+        self.scale = self.create_parameter(
+            [num_features], attr=weight_attr,
+            default_initializer=ConstantInitializer(1.0))
+        self.bias = self.create_parameter([num_features], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x):
+        from ..fluid.layer_helper import apply_op
+
+        return apply_op("instance_norm", "instance_norm",
+                        {"X": [x], "Scale": [self.scale],
+                         "Bias": [self.bias]},
+                        {"epsilon": self._eps}, ["Y"],
+                        out_dtype="float32")[0]

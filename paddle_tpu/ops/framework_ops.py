@@ -291,7 +291,8 @@ def _requantize(ins, attrs):
     scale_in = float(attrs.get("Scale_in", 1.0))
     scale_out = float(attrs.get("Scale_out", 1.0))
     y = jnp.rint(x.astype(jnp.float32) * (scale_out / scale_in))
-    return {"Output": jnp.clip(y, -128, 127).astype(x.dtype)}
+    info = jnp.iinfo(x.dtype)
+    return {"Output": jnp.clip(y, info.min, info.max).astype(x.dtype)}
 
 
 @register_op("run_program", no_jit=True)

@@ -157,12 +157,10 @@ register_op("push_box_extended_sparse", no_jit=True)(_push_sparse)
 def _split_byref(ins, attrs):
     """Row-section split of a dense tensor (split_byref_op.cc — the PS
     send path splits a param into per-server sections; 'byref' aliasing
-    is meaningless under XLA so this is a plain split)."""
-    x = np.asarray(ins["X"][0])
-    sections = attrs["height_sections"]
-    bounds = np.cumsum([0] + list(sections))
-    return {"Out": [jnp.asarray(x[bounds[i]:bounds[i + 1]])
-                    for i in range(len(sections))]}
+    is meaningless under XLA, and the dense/sparse section logic lives
+    in split_selected_rows)."""
+    from .registry import get_op as _get
+    return _get("split_selected_rows").compute(ins, attrs)
 
 
 # -- comm bootstrap (no-ops under the mesh model) ---------------------------

@@ -493,9 +493,12 @@ def _yolov3_loss(ins, attrs):
     cls = jnp.sum(_sce(cell[..., 5:], cls_target), -1)
     cls_loss = jnp.sum(cls * posf, 1)
 
-    # positive cells override the ignore mask with their score
-    obj_mask = obj_mask.at[bidx, mcl, gj, gi].set(
-        jnp.where(pos, gtscore, obj_mask[bidx, mcl, gj, gi]))
+    # positive cells override the ignore mask with their score; invalid
+    # gts are routed to an out-of-bounds batch index and dropped so they
+    # can't collide with a real positive at the same cell
+    bidx_pos = jnp.where(pos, bidx, n)
+    obj_mask = obj_mask.at[bidx_pos, mcl, gj, gi].set(gtscore,
+                                                      mode="drop")
     pobj = x[:, :, 4]
     obj_loss = jnp.sum(
         jnp.where(obj_mask > 0, _sce(pobj, 1.0) * obj_mask,
@@ -590,6 +593,11 @@ def _attention_lstm(ins, attrs):
     gate_act = _fused_act(attrs, "gate_activation", "sigmoid")
     cell_act = _fused_act(attrs, "cell_activation", "tanh")
     cand_act = _fused_act(attrs, "candidate_activation", "tanh")
+    if ins.get("Length"):
+        length = ins["Length"][0].reshape(-1)
+        pad_mask = (jnp.arange(t)[None, :] >= length[:, None])  # [B, T]
+    else:
+        pad_mask = None
 
     aw_x, aw_c = aw[:mdim], aw[mdim:]                  # split fc weight
 
@@ -600,6 +608,8 @@ def _attention_lstm(ins, attrs):
             e = a_scalar * e
         if a_scalar_b is not None:
             e = jax.nn.relu(a_scalar_b + e)
+        if pad_mask is not None:
+            e = jnp.where(pad_mask, _NEG, e)           # no mass on pads
         a = jax.nn.softmax(e, -1)                      # [B, T]
         ctx = jnp.einsum("bt,btm->bm", a, x)
         gates = jnp.concatenate([ctx, h], 1) @ lw + lb
@@ -650,14 +660,15 @@ def _fusion_repeated_fc_relu(ins, attrs):
 
 @register_op("fusion_seqpool_concat")
 def _fusion_seqpool_concat(ins, attrs):
+    from .registry import normalize_outs
     pooled = []
     lengths = ins.get("Length", [])
     for i, x in enumerate(ins["X"]):
         sub = {"X": [x]}
         if i < len(lengths):
             sub["Length"] = [lengths[i]]
-        pooled.append(get_op("sequence_pool").compute(
-            sub, {"pooltype": attrs.get("pooltype", "SUM")})["Out"][0])
+        pooled.append(normalize_outs(get_op("sequence_pool").compute(
+            sub, {"pooltype": attrs.get("pooltype", "SUM")}))["Out"][0])
     return {"Out": jnp.concatenate(pooled, -1)}
 
 

