@@ -167,25 +167,28 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
 
     iou = iou_similarity(gt_box, prior_box)
     matched, _ = bipartite_match(iou, match_type, neg_overlap)
-    # localization targets: encode matched gt against priors
+    # localization targets: box_coder encode gives [gt, priors, 4];
+    # target_assign picks row match[j] at column j -> [1, priors, 4]
     loc_tgt, loc_w = target_assign(
         box_coder(prior_box, prior_box_var, gt_box), matched,
         mismatch_value=0)
-    loc_diff = _nn.elementwise_sub(location, loc_tgt)
     loc_l = _nn.reduce_sum(
         _nn.elementwise_mul(
             apply_op("huber_loss", "huber_loss",
                      {"X": [location], "Y": [loc_tgt]},
-                     {"delta": 1.0}, ["Out"])[0], loc_w), dim=-1)
-    del loc_diff
+                     {"delta": 1.0}, ["Out"])[0],
+            loc_w), dim=-1)
     # conf targets: matched gt label else background
     cls_tgt, cls_w = target_assign(gt_label, matched,
                                    mismatch_value=background_label)
-    conf_l = _loss.softmax_with_cross_entropy(confidence, cls_tgt)
+    conf_l = _loss.softmax_with_cross_entropy(
+        confidence, _tensor.cast(cls_tgt, "int64"))
     total = _nn.elementwise_add(
-        _tensor.scale(loc_l, scale=loc_loss_weight),
-        _tensor.scale(_nn.reduce_sum(conf_l, dim=-1),
-                      scale=conf_loss_weight))
+        _tensor.scale(_nn.reduce_sum(loc_l, dim=-1),
+                      scale=loc_loss_weight),
+        _tensor.scale(_nn.reduce_sum(
+            _nn.reduce_sum(conf_l, dim=-1), dim=-1),
+            scale=conf_loss_weight))
     if normalize:
         denom = _nn.reduce_sum(loc_w)
         total = _nn.elementwise_div(
@@ -404,6 +407,13 @@ def sigmoid_focal_loss(x, label, fg_num=None, gamma=2.0, alpha=0.25):
 
 def box_decoder_and_assign(prior_box, prior_box_var, target_box,
                            box_score, box_clip_val=4.135, name=None):
-    decoded = box_coder(prior_box, prior_box_var, target_box,
-                        code_type="decode_center_size")
-    return decoded, decoded
+    """Reference box_decoder_and_assign_op.cc: decode the per-class box
+    deltas [N, C*4], then assign each row the slice of its highest-
+    scoring class."""
+    outs = apply_op("box_decoder_and_assign", "box_decoder_and_assign",
+                    {"PriorBox": [prior_box],
+                     "PriorBoxVar": [prior_box_var],
+                     "TargetBox": [target_box], "BoxScore": [box_score]},
+                    {"box_clip": box_clip_val},
+                    ["DecodeBox", "OutputAssignBox"])
+    return outs[0], outs[1]

@@ -232,6 +232,13 @@ def affine_grid(theta, out_shape=None, name=None):
 
 def affine_channel(x, scale=None, bias=None, data_layout="NCHW",
                    name=None, act=None):
+    from .tensor import fill_constant
+
+    c = x.shape[1 if data_layout == "NCHW" else -1]
+    if scale is None:
+        scale = fill_constant([c], x.dtype, 1.0)
+    if bias is None:
+        bias = fill_constant([c], x.dtype, 0.0)
     out = _one("affine_channel",
                {"X": [x], "Scale": [scale], "Bias": [bias]},
                {"data_layout": data_layout})

@@ -181,7 +181,12 @@ def _exec_op(op, env, key0, op_idx, amp_lists=None):
     if opdef.needs_rng:
         attrs["_rng_key"] = jax.random.fold_in(key0, op_idx)
     try:
-        outs = ops_lib.normalize_outs(opdef.compute(ins, attrs))
+        if opdef.no_jit and any(
+                isinstance(v, jax.core.Tracer)
+                for vs in ins.values() for v in vs):
+            outs = _host_callback_op(opdef, op, ins, attrs)
+        else:
+            outs = ops_lib.normalize_outs(opdef.compute(ins, attrs))
     except Exception as e:  # attach the op's python creation site
         from ..core.errors import attach_op_callstack
 
@@ -190,6 +195,55 @@ def _exec_op(op, env, key0, op_idx, amp_lists=None):
         vals = outs.get(slot, [])
         for n, v in zip(names, vals):
             env[n] = v
+
+
+def _host_callback_op(opdef, op, ins, attrs):
+    """Lower a host-side (`no_jit`) op inside a jitted block via
+    jax.pure_callback. Reference parity: CPU-only kernels (e.g.
+    bipartite_match_op.cc) run on host mid-graph with device transfers
+    inserted by PrepareData (operator.cc:1120); pure_callback is the XLA
+    equivalent. Output shapes are probed by running the op once at trace
+    time on zero-filled inputs — ops whose OUTPUT SHAPE depends on input
+    values (multiclass_nms-style) cannot run under jit, same as any XLA
+    program, and keep working eagerly. No gradient flows through the
+    callback (host ops produce matches/indices, not differentiable
+    values)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    slot_order = sorted(ins)
+    flat = [v for s in slot_order for v in ins[s]]
+    layout = [(s, len(ins[s])) for s in slot_order]
+
+    def rebuild(flat_vals):
+        d, i = {}, 0
+        for s, n in layout:
+            d[s] = list(flat_vals[i:i + n])
+            i += n
+        return d
+
+    probe = [np.zeros(v.shape, v.dtype) for v in flat]
+    # NOTE: under stackless tracing, jnp constants created inside compute
+    # come back as tracers — only .shape/.dtype may be read here.
+    probe_out = ops_lib.normalize_outs(
+        opdef.compute(rebuild(probe), dict(attrs)))
+    out_slots = [(s, len(vs)) for s, vs in sorted(probe_out.items())]
+    result_spec = [jax.ShapeDtypeStruct(tuple(v.shape), np.dtype(v.dtype))
+                   for _, vs in sorted(probe_out.items()) for v in vs]
+
+    def host_fn(*flat_vals):
+        outs = ops_lib.normalize_outs(opdef.compute(
+            rebuild([np.asarray(v) for v in flat_vals]), dict(attrs)))
+        return tuple(np.asarray(v) for _, vs in sorted(outs.items())
+                     for v in vs)
+
+    flat_out = jax.pure_callback(host_fn, tuple(result_spec), *flat)
+    outs, i = {}, 0
+    for s, n in out_slots:
+        outs[s] = [jnp.asarray(v) for v in flat_out[i:i + n]]
+        i += n
+    return outs
 
 
 def _run_ops(ops, env, key0, base_idx=0, amp_lists=None):
@@ -582,10 +636,47 @@ def compile_block(program, block, feed_specs, fetch_names, state_specs,
                              donate)
     else:
         jitted = jax.jit(fn, donate_argnums=(1,) if donate else ())
+        if _block_has_host_ops(block):
+            # no_jit ops lower to pure_callback under jit; backends
+            # without host-callback support (axon PJRT) get the unjitted
+            # fallback — same semantics, op-by-op dispatch like the
+            # reference's CPU-kernel placement
+            jitted = _jit_with_eager_fallback(jitted, fn)
 
     return LoweredFunction(jitted, feed_names, state_in, state_out,
                            state_mut, state_ro, fetch_names, mesh=mesh,
                            dp_axis=dp_axis)
+
+
+def _block_has_host_ops(block):
+    prog = block.program
+    def scan(blk):
+        for op in blk.ops:
+            if ops_lib.has_op(op.type) and ops_lib.get_op(op.type).no_jit:
+                return True
+            for bi in _sub_block_idxs(op):
+                if scan(prog.block(bi)):
+                    return True
+        return False
+    return scan(block)
+
+
+def _jit_with_eager_fallback(jitted, fn):
+    state = {"eager": False}
+
+    def call(*args, **kwargs):
+        if state["eager"]:
+            return fn(*args, **kwargs)
+        try:
+            return jitted(*args, **kwargs)
+        except Exception as e:  # noqa: BLE001 - backend capability probe
+            msg = str(e)
+            if "callback" in msg or "UNIMPLEMENTED" in msg:
+                state["eager"] = True
+                return fn(*args, **kwargs)
+            raise
+
+    return call
 
 
 def _default_mesh(dp_axis):

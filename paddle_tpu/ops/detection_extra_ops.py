@@ -80,14 +80,20 @@ def _bipartite_match(ins, attrs):
 @register_op("target_assign")
 def _target_assign(ins, attrs):
     """Assign per-prior targets from matched gt rows
-    (target_assign_op.cc): out[j] = X[match[j]] where matched, else
-    mismatch_value; weight 1 where matched else 0."""
-    x = ins["X"][0]                                    # [gt, dim] (one im)
+    (target_assign_op.h): with X [gt, M, K] (per-(gt,prior) encodings,
+    e.g. box_coder output) out[j] = X[match[j], j]; with X [gt, K]
+    (per-gt rows, e.g. labels) out[j] = X[match[j]]. Unmatched priors
+    get mismatch_value and weight 0."""
+    x = ins["X"][0]
     match = ins["MatchIndices"][0].astype(jnp.int32)   # [1, priors]
     mismatch = attrs.get("mismatch_value", 0)
     mi = match[0]
     matched = mi >= 0
-    gathered = jnp.take(x, jnp.maximum(mi, 0), axis=0)
+    safe = jnp.maximum(mi, 0)
+    if x.ndim >= 3:
+        gathered = x[safe, jnp.arange(mi.shape[0])]    # [priors, K]
+    else:
+        gathered = jnp.take(x, safe, axis=0)
     fill = jnp.full_like(gathered, mismatch)
     out = jnp.where(matched[:, None], gathered, fill)
     w = matched.astype(jnp.float32)[:, None]
@@ -119,14 +125,22 @@ def _rpn_target_assign(ins, attrs):
     pos_t = float(attrs.get("rpn_positive_overlap", 0.7))
     neg_t = float(attrs.get("rpn_negative_overlap", 0.3))
     rng = np.random.RandomState(int(attrs.get("seed", 0)))
-    iou = _np_iou_xyxy(gt, anchors)                    # [G, A]
-    best = iou.max(0)
-    arg = iou.argmax(0)
-    labels = np.full(anchors.shape[0], -1, "int32")
-    labels[best >= pos_t] = 1
-    labels[iou.argmax(1)] = 1                          # best per gt
-    labels[best < neg_t] = np.where(
-        labels[best < neg_t] == 1, 1, 0)
+    a_n = anchors.shape[0]
+    if len(gt) == 0:
+        # gt-free image: everything is background
+        iou = np.zeros((0, a_n))
+        best = np.zeros(a_n)
+        arg = np.zeros(a_n, int)
+        labels = np.zeros(a_n, "int32")
+    else:
+        iou = _np_iou_xyxy(gt, anchors)                # [G, A]
+        best = iou.max(0)
+        arg = iou.argmax(0)
+        labels = np.full(a_n, -1, "int32")
+        labels[best >= pos_t] = 1
+        labels[iou.argmax(1)] = 1                      # best per gt
+        labels[best < neg_t] = np.where(
+            labels[best < neg_t] == 1, 1, 0)
     fg_inds = np.nonzero(labels == 1)[0]
     n_fg = int(batch * fg_frac)
     if len(fg_inds) > n_fg:
@@ -312,8 +326,11 @@ def _retinanet_target_assign(ins, attrs):
     fg = np.nonzero(labels == 1)[0]
     bg = np.nonzero(labels == 0)[0]
     score_idx = np.concatenate([fg, bg])
-    tgt_lbl = np.where(labels[score_idx] == 1,
-                       gt_labels[arg[score_idx]], 0)[:, None]
+    if len(gt):
+        tgt_lbl = np.where(labels[score_idx] == 1,
+                           gt_labels[arg[score_idx]], 0)[:, None]
+    else:
+        tgt_lbl = np.zeros((len(score_idx), 1), gt_labels.dtype)
     return {"LocationIndex": jnp.asarray(fg.astype("int32")),
             "ScoreIndex": jnp.asarray(score_idx.astype("int32")),
             "TargetLabel": jnp.asarray(tgt_lbl.astype("int32")),
@@ -577,3 +594,38 @@ def _add_position_encoding(ins, attrs):
     if enc.shape[1] < d:
         enc = jnp.pad(enc, ((0, 0), (0, d - enc.shape[1])))
     return {"Out": alpha * x + beta * enc[None, :, :].astype(x.dtype)}
+
+
+@register_op("box_decoder_and_assign")
+def _box_decoder_and_assign(ins, attrs):
+    """box_decoder_and_assign_op.cc: decode per-class center-size deltas
+    TargetBox [N, C*4] against PriorBox [N, 4], clamp dw/dh at box_clip,
+    and assign each row its argmax-score class slice (background class 0
+    excluded from the argmax like the reference)."""
+    prior = ins["PriorBox"][0]                          # [N, 4]
+    pvar = ins["PriorBoxVar"][0]                        # [N, 4] or [4]
+    tb = ins["TargetBox"][0]                            # [N, C*4]
+    score = ins["BoxScore"][0]                          # [N, C]
+    clip = float(attrs.get("box_clip", 4.135))
+    n = tb.shape[0]
+    c = tb.shape[1] // 4
+    deltas = tb.reshape(n, c, 4)
+    if pvar.ndim == 1:
+        pvar = jnp.broadcast_to(pvar[None, :], (n, 4))
+    pw = prior[:, 2] - prior[:, 0] + 1.0
+    ph = prior[:, 3] - prior[:, 1] + 1.0
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    d = deltas * pvar[:, None, :]
+    dw = jnp.clip(d[..., 2], -clip, clip)
+    dh = jnp.clip(d[..., 3], -clip, clip)
+    cx = d[..., 0] * pw[:, None] + pcx[:, None]
+    cy = d[..., 1] * ph[:, None] + pcy[:, None]
+    w = jnp.exp(dw) * pw[:, None]
+    h = jnp.exp(dh) * ph[:, None]
+    dec = jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                     cx + w * 0.5 - 1.0, cy + h * 0.5 - 1.0], -1)
+    best = jnp.argmax(score[:, 1:], axis=1) + 1         # skip background
+    assigned = dec[jnp.arange(n), best]
+    return {"DecodeBox": dec.reshape(n, c * 4),
+            "OutputAssignBox": assigned}

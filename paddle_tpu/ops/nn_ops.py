@@ -550,11 +550,18 @@ def _sdpa(ins, attrs):
     is_test = attrs.get("is_test", False)
     drop_active = (not is_test) and p_drop > 0.0
 
-    if not drop_active and jax.default_backend() == "tpu":
-        return {"Out": _flash(q, k, v, key_bias=bias, causal=causal,
-                              sm_scale=sm_scale)}
-
     if not drop_active:
+        # Pallas flash only where its O(S) memory matters: below the
+        # threshold XLA's fused softmax-attention is faster on v5e
+        # (FLAGS_flash_attention_min_seq; measured: flash loses up to at
+        # least S=2048 forward, but avoids the S^2 score buffer).
+        from ..utils import flags as _flags
+        min_seq = int(_flags.get_flags(
+            ["FLAGS_flash_attention_min_seq"])
+            ["FLAGS_flash_attention_min_seq"])
+        if jax.default_backend() == "tpu" and k.shape[-2] >= min_seq:
+            return {"Out": _flash(q, k, v, key_bias=bias, causal=causal,
+                                  sm_scale=sm_scale)}
         return {"Out": _ref_attn(q, k, v, key_bias=bias, causal=causal,
                                  sm_scale=sm_scale)}
 

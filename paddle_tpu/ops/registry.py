@@ -116,6 +116,27 @@ def infer_outputs(type_: str, input_specs: Dict[str, list], attrs: dict):
     if op.infer_shape is not None:
         return op.infer_shape(input_specs, attrs)
 
+    if op.no_jit:
+        # host ops run numpy code that cannot be traced by eval_shape;
+        # probe shapes by executing once on zero-filled concrete inputs
+        from ..core.types import to_numpy_dtype, normalize_dtype
+
+        zeros = {
+            slot: [np.zeros([d if (d is not None and d >= 0)
+                             else _DYN_SENTINEL for d in shape],
+                            to_numpy_dtype(dtype))
+                   for shape, dtype in specs]
+            for slot, specs in input_specs.items()
+        }
+        run_attrs = dict(attrs)
+        if op.needs_rng:
+            run_attrs["_rng_key"] = jax.random.PRNGKey(0)
+        outs = normalize_outs(op.compute(zeros, run_attrs))
+        return {slot: [(tuple(np.asarray(v).shape),
+                        normalize_dtype(np.asarray(v).dtype))
+                       for v in vs]
+                for slot, vs in outs.items()}
+
     dyn_axes = set()
 
     def to_struct(spec):

@@ -31,6 +31,10 @@ _FLAGS: Dict[str, object] = {
     "FLAGS_seed": 0,
     # lowering controls (TPU-specific additions)
     "FLAGS_tpu_donate_buffers": True,
+    # Pallas flash attention engages only at/above this key length: the
+    # XLA fused path wins below it (measured on v5e: flash 13.6ms vs XLA
+    # 9.8ms even at S=2048 fwd); flash's win is O(S) memory at long seq.
+    "FLAGS_flash_attention_min_seq": 4096,
     "FLAGS_tpu_compile_cache_size": 128,
 }
 

@@ -120,8 +120,9 @@ def test_warpctc_crf_program():
 
 
 def test_detection_pipeline():
-    """prior_box → iou/bipartite/target_assign → ssd_loss composition,
-    and detection_output decode path."""
+    """prior_box shape contract, then the full ssd_loss composition
+    (iou → bipartite_match via host callback → target_assign → huber +
+    softmax conf) through the jitted Executor path."""
     r = np.random.RandomState(5)
     feat = r.randn(1, 8, 4, 4).astype("float32")
     img = r.randn(1, 3, 32, 32).astype("float32")
@@ -135,6 +136,82 @@ def test_detection_pipeline():
 
     boxes, variances = _run(build, {"f": feat, "im": img})
     assert boxes.shape[-1] == 4 and variances.shape == boxes.shape
+
+    n_priors, n_gt, n_cls = 6, 2, 3
+    loc = r.randn(1, n_priors, 4).astype("float32")
+    conf = r.randn(1, n_priors, n_cls).astype("float32")
+    gtb = np.array([[[0.1, 0.1, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9]]],
+                   "float32")[0]
+    gtl = np.array([[1], [2]], "int64")
+    priors = np.array([[0.1, 0.1, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9],
+                       [0.0, 0.0, 0.2, 0.2], [0.3, 0.3, 0.6, 0.6],
+                       [0.7, 0.1, 0.9, 0.3], [0.2, 0.6, 0.5, 0.9]],
+                      "float32")
+
+    def build_loss():
+        lv = fluid.layers.data("loc", shape=[n_priors, 4],
+                               dtype="float32")
+        cv = fluid.layers.data("conf", shape=[n_priors, n_cls],
+                               dtype="float32")
+        gb = fluid.layers.data("gtb", shape=[n_gt, 4], dtype="float32",
+                               append_batch_size=False)
+        gl = fluid.layers.data("gtl", shape=[n_gt, 1], dtype="int64",
+                               append_batch_size=False)
+        pb = fluid.layers.data("pb", shape=[n_priors, 4],
+                               dtype="float32", append_batch_size=False)
+        return fluid.layers.ssd_loss(lv, cv, gb, gl, pb)
+
+    (loss,) = _run(build_loss, {"loc": loc, "conf": conf, "gtb": gtb,
+                                "gtl": gtl, "pb": priors})
+    assert np.isfinite(loss).all()
+
+
+def test_zero_gt_target_assign_ops():
+    import jax.numpy as jnp
+    from paddle_tpu import ops as ops_lib
+
+    anchors = np.array([[0., 0., 10., 10.], [5., 5., 20., 20.]],
+                       "float32")
+    empty = np.zeros((0, 4), "float32")
+    out = ops_lib.run_op("rpn_target_assign",
+                         {"Anchor": [jnp.asarray(anchors)],
+                          "GtBoxes": [jnp.asarray(empty)]}, {})
+    assert np.asarray(out["LocationIndex"][0]).size == 0
+    out = ops_lib.run_op("retinanet_target_assign",
+                         {"Anchor": [jnp.asarray(anchors)],
+                          "GtBoxes": [jnp.asarray(empty)],
+                          "GtLabels": [jnp.asarray(
+                              np.zeros((0,), "int32"))]}, {})
+    assert np.all(np.asarray(out["TargetLabel"][0]) == 0)
+
+
+def test_box_decoder_and_assign_op():
+    import jax.numpy as jnp
+    from paddle_tpu import ops as ops_lib
+
+    prior = np.array([[0., 0., 10., 10.]], "float32")
+    pvar = np.array([[1., 1., 1., 1.]], "float32")
+    tb = np.zeros((1, 3 * 4), "float32")     # zero deltas: decode = prior
+    score = np.array([[0.1, 0.2, 0.7]], "float32")
+    out = ops_lib.run_op("box_decoder_and_assign",
+                         {"PriorBox": [jnp.asarray(prior)],
+                          "PriorBoxVar": [jnp.asarray(pvar)],
+                          "TargetBox": [jnp.asarray(tb)],
+                          "BoxScore": [jnp.asarray(score)]}, {})
+    assigned = np.asarray(out["OutputAssignBox"][0])
+    np.testing.assert_allclose(assigned[0], [0, 0, 10, 10], atol=1e-5)
+
+
+def test_affine_channel_defaults():
+    r = np.random.RandomState(9)
+    x = r.randn(1, 3, 4, 4).astype("float32")
+
+    def build():
+        inp = fluid.layers.data("x", shape=[3, 4, 4], dtype="float32")
+        return fluid.layers.affine_channel(inp)
+
+    (out,) = _run(build, {"x": x})
+    np.testing.assert_allclose(out, x, rtol=1e-5)
 
 
 def test_misc_wrappers():
