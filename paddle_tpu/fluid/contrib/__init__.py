@@ -1,2 +1,12 @@
+"""fluid.contrib (reference: `python/paddle/fluid/contrib/`)."""
 from . import mixed_precision  # noqa: F401
 from . import model_stats  # noqa: F401
+from . import slim  # noqa: F401
+from . import extend_optimizer  # noqa: F401
+from . import reader  # noqa: F401
+from . import decoder  # noqa: F401
+from . import memory_usage_calc  # noqa: F401
+from .memory_usage_calc import memory_usage  # noqa: F401
+from .extend_optimizer import (  # noqa: F401
+    extend_with_decoupled_weight_decay,
+)

@@ -1,0 +1,5 @@
+"""contrib.decoder (reference:
+`python/paddle/fluid/contrib/decoder/beam_search_decoder.py`)."""
+from .beam_search_decoder import (  # noqa: F401
+    InitState, StateCell, TrainingDecoder, BeamSearchDecoder,
+)

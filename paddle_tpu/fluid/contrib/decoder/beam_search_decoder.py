@@ -1,0 +1,218 @@
+"""Pure-python decoder API (reference:
+`contrib/decoder/beam_search_decoder.py:35` — InitState, StateCell,
+TrainingDecoder, BeamSearchDecoder).
+
+The reference builds these on DynamicRNN over LoD tensors; the
+TPU-native build keeps the same four-class API but runs the training
+decode as a python loop over padded [B, T, D] steps (unrolled at trace
+time, fused by XLA — same approach as layers/rnn_decode.py) and routes
+inference beam search through the jit-able `beam_search` op machinery.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from ...layer_helper import LayerHelper
+from ...layers import nn as nn_layers
+from ...layers import tensor as tensor_layers
+from ...layers import rnn_decode as _rnn_decode
+
+__all__ = ["InitState", "StateCell", "TrainingDecoder",
+           "BeamSearchDecoder"]
+
+
+class InitState:
+    """Initial decoder state (reference :43): either an explicit `init`
+    var or a zero-filled [batch_size, shape...] created from a boot var."""
+
+    def __init__(self, init=None, shape=None, value=0.0,
+                 init_boot=None, need_reorder=False, dtype="float32"):
+        if init is not None:
+            self._init = init
+        elif init_boot is not None:
+            self._init = tensor_layers.fill_constant_batch_size_like(
+                init_boot, [-1] + list(shape or [1]), dtype, value)
+        else:
+            raise ValueError(
+                "InitState needs `init` or `init_boot` (reference "
+                "beam_search_decoder.py:70)")
+        self._need_reorder = need_reorder
+
+    @property
+    def value(self):
+        return self._init
+
+    @property
+    def need_reorder(self):
+        return self._need_reorder
+
+
+class StateCell:
+    """Computation cell of one decoding step (reference :159): named
+    inputs + named states + an @state_updater that maps them to the new
+    states."""
+
+    def __init__(self, inputs: Dict, states: Dict[str, InitState],
+                 out_state: str, name=None):
+        self._inputs = dict(inputs)
+        self._init_states = dict(states)
+        self._out_state = out_state
+        self._cur_states = {k: v.value for k, v in states.items()}
+        self._updater: Optional[Callable] = None
+        self.name = name
+
+    def get_state(self, state_name):
+        return self._cur_states[state_name]
+
+    def get_input(self, input_name):
+        return self._inputs[input_name]
+
+    def set_state(self, state_name, state_value):
+        self._cur_states[state_name] = state_value
+
+    def state_updater(self, updater):
+        self._updater = updater
+        return updater
+
+    def compute_state(self, inputs: Dict):
+        self._inputs.update(inputs)
+        if self._updater is None:
+            raise RuntimeError("StateCell has no @state_updater")
+        self._updater(self)
+
+    def update_states(self):
+        # the reference commits pending state writes here; writes in
+        # this build are immediate, so nothing to flush
+        pass
+
+    def out_state(self):
+        return self._cur_states[self._out_state]
+
+    def reset(self):
+        self._cur_states = {k: v.value
+                            for k, v in self._init_states.items()}
+
+
+class TrainingDecoder:
+    """Teacher-forced decoding loop (reference :384): iterate the
+    StateCell over the target sequence's time axis and collect step
+    outputs into [B, T, D]."""
+
+    def __init__(self, state_cell: StateCell, name=None):
+        self._state_cell = state_cell
+        self._outputs: List = []
+        self._step_inputs: List = []
+        self._static_inputs: Dict = {}
+        self.name = name
+
+    @property
+    def state_cell(self):
+        return self._state_cell
+
+    class _Block:
+        def __init__(self, decoder):
+            self._d = decoder
+
+        def __enter__(self):
+            return self._d
+
+        def __exit__(self, *exc):
+            return False
+
+    def block(self):
+        return TrainingDecoder._Block(self)
+
+    def step_input(self, x):
+        """Register the [B, T, D] teacher sequence; returns it for use
+        inside the loop body builder."""
+        self._step_inputs.append(x)
+        return x
+
+    def static_input(self, x):
+        self._static_inputs[len(self._static_inputs)] = x
+        return x
+
+    def output(self, *outputs):
+        self._outputs.extend(outputs)
+
+    def decode(self, seq, step_fn, max_len=None):
+        """Run the loop: step_fn(cell, x_t) -> step output [B, D]; the
+        outputs stack to [B, T, D]. (The reference drives this through
+        DynamicRNN; here the loop unrolls at trace time.)"""
+        t = max_len or seq.shape[1]
+        outs = []
+        self._state_cell.reset()
+        for i in range(t):
+            x_t = nn_layers.squeeze(
+                nn_layers.slice(seq, axes=[1], starts=[i], ends=[i + 1]),
+                axes=[1])
+            outs.append(step_fn(self._state_cell, x_t))
+        stacked = nn_layers.stack(outs, axis=1)
+        self._outputs.append(stacked)
+        return stacked
+
+    def __call__(self):
+        if not self._outputs:
+            raise RuntimeError(
+                "TrainingDecoder has no outputs; run decode() first")
+        return self._outputs[-1] if len(self._outputs) == 1 \
+            else self._outputs
+
+
+class BeamSearchDecoder:
+    """Inference beam search (reference :525): wraps the modern
+    layers.rnn_decode BeamSearchDecoder/dynamic_decode machinery under
+    the contrib constructor signature."""
+
+    def __init__(self, state_cell: StateCell, init_ids, init_scores,
+                 target_dict_dim, word_dim, input_var_dict=None,
+                 topk_size=50, sparse_emb=True, max_len=100,
+                 beam_size=4, end_id=1, name=None):
+        self._state_cell = state_cell
+        self._target_dict_dim = target_dict_dim
+        self._word_dim = word_dim
+        self._max_len = max_len
+        self._beam_size = beam_size
+        self._end_id = end_id
+        self._start_id = 0
+        self.name = name
+        self._emb_name = (name or "contrib_bsd") + "_emb"
+        self._fc_name = (name or "contrib_bsd") + "_out_fc"
+
+    def decode(self, cell=None):
+        """Run dynamic_decode with a cell adapter over the StateCell's
+        updater. Returns (ids, scores)."""
+        sc = self._state_cell
+        emb_helper = LayerHelper(self._emb_name)
+        emb_w = emb_helper.create_parameter(
+            None, shape=[self._target_dict_dim, self._word_dim],
+            dtype="float32")
+        fc_helper = LayerHelper(self._fc_name)
+        out_state0 = sc._init_states[sc._out_state].value
+        d_model = int(out_state0.shape[-1])
+        out_w = fc_helper.create_parameter(
+            None, shape=[d_model, self._target_dict_dim],
+            dtype="float32")
+
+        input_names = [k for k in sc._inputs]
+        if len(input_names) != 1:
+            raise ValueError(
+                "contrib BeamSearchDecoder needs a StateCell with exactly "
+                "one input (got %r); multi-input cells must use "
+                "layers.dynamic_decode directly" % (input_names,))
+        in_name = input_names[0]
+
+        class _CellAdapter(_rnn_decode.RNNCell):
+            def call(self, inputs, states):
+                sc._cur_states[sc._out_state] = states
+                sc.compute_state({in_name: inputs})
+                new_state = sc.out_state()
+                return new_state, new_state
+
+        decoder = _rnn_decode.BeamSearchDecoder(
+            _CellAdapter(), start_token=self._start_id,
+            end_token=self._end_id, beam_size=self._beam_size,
+            embedding_fn=lambda ids: nn_layers.gather(emb_w, ids),
+            output_fn=lambda h: nn_layers.matmul(h, out_w))
+        return _rnn_decode.dynamic_decode(
+            decoder, inits=out_state0, max_step_num=self._max_len)

@@ -1,0 +1,66 @@
+"""contrib.extend_optimizer (reference:
+`contrib/extend_optimizer/extend_optimizer_with_weight_decay.py:20-110`):
+mix decoupled weight decay into any Optimizer class."""
+from __future__ import annotations
+
+from ...framework import default_main_program
+
+__all__ = ["extend_with_decoupled_weight_decay", "DecoupledWeightDecay"]
+
+
+class DecoupledWeightDecay:
+    """Mixin: after the base optimizer's update, subtract
+    coeff * lr * param (AdamW-style decay applied to the PARAM, not the
+    gradient)."""
+
+    def __init__(self, coeff=0.0, apply_decay_param_fun=None, **kwargs):
+        self._coeff = float(coeff)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        super().__init__(**kwargs)
+
+    def apply_gradients(self, params_grads):
+        from ...layers import nn as _nn
+
+        result = super().apply_gradients(params_grads)
+        if self._coeff == 0.0:
+            return result
+        block = default_main_program().global_block()
+        # decay scales with the CURRENT lr (schedules included):
+        # p <- p - coeff * lr * p, built from the lr graph variable
+        from ...layers import tensor as _tensor
+
+        lr_var = self._global_learning_rate()
+        for p, g in params_grads:
+            if g is None:
+                continue
+            if self._apply_decay_param_fun is not None and \
+                    not self._apply_decay_param_fun(p.name):
+                continue
+            decay = _tensor.scale(_nn.elementwise_mul(p, lr_var),
+                                  scale=self._coeff)
+            decayed = _nn.elementwise_sub(p, decay)
+            block.append_op(type="assign", inputs={"X": [decayed]},
+                            outputs={"Out": [p]}, attrs={})
+        return result
+
+    def __str__(self):
+        return "DecoupledWeightDecay(coeff=%s) + %s" % (
+            self._coeff, super().__str__()
+            if hasattr(super(), "__str__") else "")
+
+
+def extend_with_decoupled_weight_decay(base_optimizer):
+    """Returns a subclass of `base_optimizer` whose constructor takes an
+    extra `coeff` (weight decay) argument (reference :102)."""
+
+    class OptimizerWithDecoupledWeightDecay(DecoupledWeightDecay,
+                                            base_optimizer):
+        def __init__(self, weight_decay=0.0, apply_decay_param_fun=None,
+                     **kwargs):
+            super().__init__(coeff=weight_decay,
+                             apply_decay_param_fun=apply_decay_param_fun,
+                             **kwargs)
+
+    OptimizerWithDecoupledWeightDecay.__name__ = (
+        base_optimizer.__name__ + "WithDecoupledWeightDecay")
+    return OptimizerWithDecoupledWeightDecay

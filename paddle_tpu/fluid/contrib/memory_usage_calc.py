@@ -1,0 +1,36 @@
+"""Estimate a program's activation+parameter memory (reference:
+`contrib/memory_usage_calc.py:46` memory_usage(program, batch_size) →
+(lower MB, upper MB); the reference sums var bytes with a fixed
+uncertainty band — same contract here)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework import Program
+
+_DTYPE_BYTES = {
+    "float16": 2, "bfloat16": 2, "float32": 4, "float64": 8,
+    "int8": 1, "uint8": 1, "int16": 2, "int32": 4, "int64": 8,
+    "bool": 1,
+}
+
+
+def memory_usage(program, batch_size):
+    """Rough [lower, upper] MB estimate of the program's tensors with
+    dynamic (-1) dims filled by batch_size."""
+    if not isinstance(program, Program):
+        raise TypeError("memory_usage expects a Program, got %r"
+                        % type(program))
+    if batch_size <= 0:
+        raise ValueError("batch_size must be positive")
+    total = 0.0
+    for block in program.blocks:
+        for var in block.vars.values():
+            shape = [batch_size if (d is None or d < 0) else d
+                     for d in (var.shape or [])]
+            nbytes = _DTYPE_BYTES.get(str(var.dtype), 4)
+            total += float(np.prod(shape)) * nbytes if shape else nbytes
+    mb = total / (1024.0 * 1024.0)
+    # the reference reports a +-30% band (it cannot see XLA's buffer
+    # reuse; neither can we)
+    return mb * 0.7, mb * 1.3

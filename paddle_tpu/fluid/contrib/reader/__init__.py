@@ -1,0 +1,2 @@
+"""contrib.reader (reference: `contrib/reader/distributed_reader.py`)."""
+from .distributed_reader import distributed_batch_reader  # noqa: F401

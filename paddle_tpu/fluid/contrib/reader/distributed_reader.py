@@ -1,0 +1,21 @@
+"""Distributed batch reader (reference:
+`contrib/reader/distributed_reader.py:21`): each trainer keeps every
+num_trainers-th batch of the wrapped reader, offset by its trainer id
+(env contract PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["distributed_batch_reader"]
+
+
+def distributed_batch_reader(batch_reader):
+    trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    trainers = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+    def decorated():
+        for idx, batch in enumerate(batch_reader()):
+            if idx % trainers == trainer_id:
+                yield batch
+
+    return decorated

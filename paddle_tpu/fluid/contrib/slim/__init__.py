@@ -1,4 +1,7 @@
 """fluid.contrib.slim — model compression (reference:
-`python/paddle/fluid/contrib/slim/`). Quantization (QAT + PTQ) is
-implemented; pruning/NAS/distillation are descoped per SURVEY.md §7.9."""
+`python/paddle/fluid/contrib/slim/`): quantization (QAT + PTQ),
+magnitude/structure pruning, and distillation losses. NAS/searcher are
+descoped per SURVEY.md §7.9."""
 from . import quantization  # noqa: F401
+from . import prune  # noqa: F401
+from . import distillation  # noqa: F401

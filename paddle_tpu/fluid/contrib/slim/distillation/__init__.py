@@ -1,0 +1,5 @@
+"""slim.distillation — knowledge distillation losses (reference:
+`python/paddle/fluid/contrib/slim/distillation/distiller.py`)."""
+from .distiller import (  # noqa: F401
+    L2Distiller, FSPDistiller, SoftLabelDistiller, merge_teacher,
+)

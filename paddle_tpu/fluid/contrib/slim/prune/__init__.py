@@ -1,0 +1,7 @@
+"""slim.prune — magnitude/structure pruning (reference:
+`python/paddle/fluid/contrib/slim/prune/pruner.py` +
+`prune_strategy.py`)."""
+from .pruner import (  # noqa: F401
+    Pruner, StructurePruner, MagnitudePruner, prune_program,
+    sensitivity,
+)

@@ -635,30 +635,47 @@ def compile_block(program, block, feed_specs, fetch_names, state_specs,
                              feed_names, fetch_names, state_mut, state_ro,
                              donate)
     else:
-        jitted = jax.jit(fn, donate_argnums=(1,) if donate else ())
-        if _block_has_host_ops(block):
-            # no_jit ops lower to pure_callback under jit; backends
-            # without host-callback support (axon PJRT) get the unjitted
-            # fallback — same semantics, op-by-op dispatch like the
-            # reference's CPU-kernel placement
-            jitted = _jit_with_eager_fallback(jitted, fn)
+        host, dynamic = _block_host_op_kinds(block)
+        if dynamic:
+            # NMS-style host ops produce value-dependent output shapes —
+            # impossible under XLA (the trace-time shape probe would lie
+            # at runtime). The whole block runs unjitted, matching the
+            # reference's CPU placement of these kernels.
+            jitted = fn
+        else:
+            # donation is unsafe when an eager retry may rerun with the
+            # same buffers after a failed jitted call
+            jitted = jax.jit(
+                fn, donate_argnums=(1,) if (donate and not host) else ())
+            if host:
+                # no_jit ops lower to pure_callback under jit; backends
+                # without host-callback support (axon PJRT) get the
+                # unjitted fallback — same semantics, op-by-op dispatch
+                jitted = _jit_with_eager_fallback(jitted, fn)
 
     return LoweredFunction(jitted, feed_names, state_in, state_out,
                            state_mut, state_ro, fetch_names, mesh=mesh,
                            dp_axis=dp_axis)
 
 
-def _block_has_host_ops(block):
+def _block_host_op_kinds(block):
+    """(has_host_ops, has_dynamic_shape_ops) over the block incl.
+    sub-blocks."""
     prog = block.program
+    host = dynamic = False
+
     def scan(blk):
+        nonlocal host, dynamic
         for op in blk.ops:
-            if ops_lib.has_op(op.type) and ops_lib.get_op(op.type).no_jit:
-                return True
+            if ops_lib.has_op(op.type):
+                od = ops_lib.get_op(op.type)
+                host = host or od.no_jit
+                dynamic = dynamic or od.dynamic_shape
             for bi in _sub_block_idxs(op):
-                if scan(prog.block(bi)):
-                    return True
-        return False
-    return scan(block)
+                scan(prog.block(bi))
+
+    scan(block)
+    return host, dynamic
 
 
 def _jit_with_eager_fallback(jitted, fn):
@@ -671,7 +688,7 @@ def _jit_with_eager_fallback(jitted, fn):
             return jitted(*args, **kwargs)
         except Exception as e:  # noqa: BLE001 - backend capability probe
             msg = str(e)
-            if "callback" in msg or "UNIMPLEMENTED" in msg:
+            if "does not support host send/recv callbacks" in msg:
                 state["eager"] = True
                 return fn(*args, **kwargs)
             raise

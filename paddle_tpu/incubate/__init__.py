@@ -1,2 +1,3 @@
 """Incubating APIs (reference: `python/paddle/incubate/`)."""
 from .. import hapi  # noqa: F401
+from . import complex  # noqa: F401

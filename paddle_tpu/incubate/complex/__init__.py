@@ -1,0 +1,141 @@
+"""paddle.incubate.complex (reference:
+`python/paddle/incubate/complex/` — ComplexVariable in helper.py plus
+the tensor ops in tensor/math.py / manipulation.py / linalg.py).
+
+TPU-native design: the reference carries (real, imag) as two tensors
+through pairs of real ops; XLA supports complex64/128 natively, so
+ComplexVariable wraps ONE complex jax array and every op is a single
+complex primitive — half the HBM traffic and fusion-friendly. The
+public contract (construct from real/imag, .real/.imag accessors, the
+same function names) is unchanged."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "ComplexVariable", "to_complex",
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "matmul", "kron", "reshape", "transpose", "sum",
+    "trace",
+]
+
+
+class ComplexVariable:
+    """A complex tensor (reference helper.py ComplexVariable)."""
+
+    def __init__(self, real, imag=None):
+        import jax.numpy as jnp
+
+        if imag is None:
+            self._data = jnp.asarray(real)
+            if not jnp.iscomplexobj(self._data):
+                self._data = self._data.astype(jnp.complex64)
+        else:
+            self._data = (jnp.asarray(real)
+                          + 1j * jnp.asarray(imag)).astype(jnp.complex64)
+
+    @property
+    def real(self):
+        return self._data.real
+
+    @property
+    def imag(self):
+        return self._data.imag
+
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return str(self._data.dtype)
+
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def __repr__(self):
+        return "ComplexVariable(shape=%s)\n%s" % (self.shape,
+                                                  np.asarray(self._data))
+
+    # operator sugar
+    def __add__(self, other):
+        return elementwise_add(self, other)
+
+    def __sub__(self, other):
+        return elementwise_sub(self, other)
+
+    def __mul__(self, other):
+        return elementwise_mul(self, other)
+
+    def __truediv__(self, other):
+        return elementwise_div(self, other)
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+
+def to_complex(x):
+    return x._data if isinstance(x, ComplexVariable) else x
+
+
+def _wrap(v):
+    return ComplexVariable(v)
+
+
+def elementwise_add(x, y, axis=-1, name=None):
+    return _wrap(to_complex(x) + to_complex(y))
+
+
+def elementwise_sub(x, y, axis=-1, name=None):
+    return _wrap(to_complex(x) - to_complex(y))
+
+
+def elementwise_mul(x, y, axis=-1, name=None):
+    return _wrap(to_complex(x) * to_complex(y))
+
+
+def elementwise_div(x, y, axis=-1, name=None):
+    return _wrap(to_complex(x) / to_complex(y))
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0,
+           name=None):
+    import jax.numpy as jnp
+
+    a, b = to_complex(x), to_complex(y)
+    if transpose_x:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_y:
+        b = jnp.swapaxes(b, -1, -2)
+    return _wrap(alpha * (a @ b))
+
+
+def kron(x, y, name=None):
+    import jax.numpy as jnp
+
+    return _wrap(jnp.kron(to_complex(x), to_complex(y)))
+
+
+def reshape(x, shape, inplace=False, name=None):
+    return _wrap(to_complex(x).reshape(shape))
+
+
+def transpose(x, perm, name=None):
+    import jax.numpy as jnp
+
+    return _wrap(jnp.transpose(to_complex(x), perm))
+
+
+def sum(input, dim=None, keep_dim=False, name=None):
+    import jax.numpy as jnp
+
+    return _wrap(jnp.sum(to_complex(input),
+                         axis=tuple(dim) if isinstance(dim, (list, tuple))
+                         else dim, keepdims=keep_dim))
+
+
+def trace(input, offset=0, dim1=0, dim2=1, name=None):
+    import jax.numpy as jnp
+
+    return _wrap(jnp.trace(to_complex(input), offset=offset, axis1=dim1,
+                           axis2=dim2))

@@ -113,7 +113,8 @@ def _polygon_box_transform(ins, attrs):
     return {"Output": base - x}
 
 
-@register_op("rpn_target_assign", no_jit=True)
+@register_op("rpn_target_assign", no_jit=True,
+             dynamic_shape=True)
 def _rpn_target_assign(ins, attrs):
     """Sample anchors into fg/bg for RPN training
     (rpn_target_assign_op.cc): fg = IoU >= pos_thresh or argmax per gt;
@@ -180,7 +181,8 @@ def _decode_center(anchors, deltas, variances=None):
                      cx + w * 0.5 - 1.0, cy + h * 0.5 - 1.0], 1)
 
 
-@register_op("generate_proposals", no_jit=True)
+@register_op("generate_proposals", no_jit=True,
+             dynamic_shape=True)
 def _generate_proposals(ins, attrs):
     """RPN proposal generation (generate_proposals_op.cc): decode anchor
     deltas, clip, filter small, NMS, keep post_nms_topN."""
@@ -232,7 +234,8 @@ def _generate_proposals(ins, attrs):
             "RpnRoisNum": jnp.asarray(np.asarray(nums, "int32"))}
 
 
-@register_op("distribute_fpn_proposals", no_jit=True)
+@register_op("distribute_fpn_proposals", no_jit=True,
+             dynamic_shape=True)
 def _distribute_fpn_proposals(ins, attrs):
     """Route RoIs to FPN levels by scale (distribute_fpn_proposals_op.cc):
     level = floor(log2(sqrt(area)/224) + refer_level), clipped."""
@@ -259,7 +262,8 @@ def _distribute_fpn_proposals(ins, attrs):
             "RestoreIndex": jnp.asarray(restore.reshape(-1, 1))}
 
 
-@register_op("collect_fpn_proposals", no_jit=True)
+@register_op("collect_fpn_proposals", no_jit=True,
+             dynamic_shape=True)
 def _collect_fpn_proposals(ins, attrs):
     """Merge per-level RoIs back, keep top post_nms_topN by score
     (collect_fpn_proposals_op.cc)."""
@@ -270,7 +274,8 @@ def _collect_fpn_proposals(ins, attrs):
     return {"FpnRois": jnp.asarray(rois[keep].astype("float32"))}
 
 
-@register_op("retinanet_detection_output", no_jit=True)
+@register_op("retinanet_detection_output", no_jit=True,
+             dynamic_shape=True)
 def _retinanet_detection_output(ins, attrs):
     """Multi-level sigmoid-score decode + class-wise NMS
     (retinanet_detection_output_op.cc)."""
@@ -306,7 +311,8 @@ def _retinanet_detection_output(ins, attrs):
     return {"Out": jnp.asarray(final)}
 
 
-@register_op("retinanet_target_assign", no_jit=True)
+@register_op("retinanet_target_assign", no_jit=True,
+             dynamic_shape=True)
 def _retinanet_target_assign(ins, attrs):
     """Anchor→gt assignment for RetinaNet (retinanet_target_assign_op.cc):
     fg = IoU >= pos_thresh, bg = IoU < neg_thresh, rest ignored."""
@@ -343,7 +349,8 @@ def _retinanet_target_assign(ins, attrs):
                 np.asarray([max(len(fg), 1)], "int32"))}
 
 
-@register_op("generate_proposal_labels", no_jit=True)
+@register_op("generate_proposal_labels", no_jit=True,
+             dynamic_shape=True)
 def _generate_proposal_labels(ins, attrs):
     """Sample RoIs into labelled fg/bg training rois
     (generate_proposal_labels_op.cc, simplified single-image)."""

@@ -307,7 +307,8 @@ def _roi_pool(ins, attrs):
     return {"Out": out, "Argmax": jnp.zeros(out.shape, jnp.int64)}
 
 
-@register_op("multiclass_nms", no_jit=True)
+@register_op("multiclass_nms", no_jit=True,
+             dynamic_shape=True)
 def _multiclass_nms(ins, attrs):
     # host-side (dynamic output count; reference outputs a LoDTensor)
     boxes = np.asarray(ins["BBoxes"][0])

@@ -31,22 +31,25 @@ _DYN_SENTINEL = 97
 
 class OpDef:
     __slots__ = ("type", "compute", "needs_rng", "infer_shape", "n_outputs",
-                 "no_jit")
+                 "no_jit", "dynamic_shape")
 
     def __init__(self, type_: str, compute: Callable, needs_rng: bool = False,
                  infer_shape: Optional[Callable] = None,
-                 no_jit: bool = False):
+                 no_jit: bool = False, dynamic_shape: bool = False):
         self.type = type_
         self.compute = compute
         self.needs_rng = needs_rng
         self.infer_shape = infer_shape
-        # dynamic-output-shape ops run un-jitted on host (eager only)
+        # host-side op (numpy compute); lowers via pure_callback in jit
         self.no_jit = no_jit
+        # output SHAPE depends on input VALUES (NMS-style): cannot run
+        # under jit at all; the block executes unjitted instead
+        self.dynamic_shape = dynamic_shape
 
 
 def register_op(type_: str, needs_rng: bool = False,
                 infer_shape: Optional[Callable] = None,
-                no_jit: bool = False):
+                no_jit: bool = False, dynamic_shape: bool = False):
     """Decorator: register `compute(ins, attrs) -> {slot: [array, ...]}`.
 
     `ins` maps input slot name -> list of jax arrays (possibly empty).
@@ -56,7 +59,8 @@ def register_op(type_: str, needs_rng: bool = False,
 
     def deco(fn):
         _REGISTRY[type_] = OpDef(type_, fn, needs_rng=needs_rng,
-                                 infer_shape=infer_shape, no_jit=no_jit)
+                                 infer_shape=infer_shape, no_jit=no_jit,
+                                 dynamic_shape=dynamic_shape)
         return fn
 
     return deco
@@ -132,7 +136,17 @@ def infer_outputs(type_: str, input_specs: Dict[str, list], attrs: dict):
         if op.needs_rng:
             run_attrs["_rng_key"] = jax.random.PRNGKey(0)
         outs = normalize_outs(op.compute(zeros, run_attrs))
-        return {slot: [(tuple(np.asarray(v).shape),
+        had_dynamic = any(
+            d is None or d < 0
+            for specs in input_specs.values() for shape, _ in specs
+            for d in shape)
+
+        def undyn(shape):
+            # a sentinel-sized output dim came from a dynamic input dim
+            return tuple(-1 if (had_dynamic and d == _DYN_SENTINEL)
+                         else int(d) for d in shape)
+
+        return {slot: [(undyn(np.asarray(v).shape),
                         normalize_dtype(np.asarray(v).dtype))
                        for v in vs]
                 for slot, vs in outs.items()}

@@ -84,7 +84,8 @@ def _rank_attention(ins, attrs):
     return {"Out": out}
 
 
-@register_op("filter_by_instag", no_jit=True)
+@register_op("filter_by_instag", no_jit=True,
+             dynamic_shape=True)
 def _filter_by_instag(ins, attrs):
     x1 = np.asarray(ins["Ins"][0])
     tags = np.asarray(ins["Ins_tag"][0]).reshape(-1)

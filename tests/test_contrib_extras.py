@@ -1,0 +1,114 @@
+"""contrib extras: complex tensor API, memory_usage, decoupled weight
+decay, distributed reader (reference: `python/paddle/incubate/complex/`,
+`contrib/memory_usage_calc.py`, `contrib/extend_optimizer/`,
+`contrib/reader/distributed_reader.py`)."""
+import os
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework, contrib
+from paddle_tpu.incubate import complex as cpx
+
+
+def test_complex_ops():
+    a = cpx.ComplexVariable(np.ones((2, 2), "float32"),
+                            np.eye(2, dtype="float32"))
+    b = cpx.matmul(a, a)
+    e = (np.ones((2, 2)) + 1j * np.eye(2)) @ \
+        (np.ones((2, 2)) + 1j * np.eye(2))
+    np.testing.assert_allclose(b.numpy(), e, rtol=1e-5)
+    assert cpx.kron(a, a).shape == (4, 4)
+    np.testing.assert_allclose(cpx.trace(a).numpy(),
+                               np.trace(np.ones((2, 2)) + 1j * np.eye(2)),
+                               rtol=1e-5)
+    s = cpx.elementwise_add(a, a)
+    np.testing.assert_allclose(s.real, 2 * np.ones((2, 2)), rtol=1e-6)
+    t = cpx.transpose(cpx.reshape(a, [4, 1]), [1, 0])
+    assert t.shape == (1, 4)
+    d = cpx.elementwise_div(a, a)
+    np.testing.assert_allclose(d.numpy(), np.ones((2, 2)), rtol=1e-5)
+
+
+def test_memory_usage():
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data("x", shape=[64], dtype="float32")
+            fluid.layers.fc(x, 128)
+    lo, hi = contrib.memory_usage(main, batch_size=32)
+    assert 0 < lo < hi
+
+
+def test_decoupled_weight_decay_trains():
+    AdamW = contrib.extend_with_decoupled_weight_decay(
+        fluid.optimizer.AdamOptimizer)
+    r = np.random.RandomState(0)
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data("x", shape=[8], dtype="float32")
+            h = fluid.layers.fc(x, 4, name="fcw")
+            loss = fluid.layers.mean(fluid.layers.square(h))
+            opt = AdamW(weight_decay=0.1, learning_rate=1e-3)
+            opt.minimize(loss)
+            exe = fluid.Executor()
+            exe.run(startup)
+            from paddle_tpu.core.scope import global_scope
+            w0 = np.asarray(global_scope().find_var("fcw.w_0")).copy()
+            for _ in range(3):
+                exe.run(main, feed={"x": r.randn(16, 8).astype("float32")},
+                        fetch_list=[loss])
+            w1 = np.asarray(global_scope().find_var("fcw.w_0"))
+    # decay + loss gradient must shrink the weight norm
+    assert np.linalg.norm(w1) < np.linalg.norm(w0)
+
+
+def test_distributed_batch_reader():
+    os.environ["PADDLE_TRAINER_ID"] = "1"
+    os.environ["PADDLE_TRAINERS_NUM"] = "2"
+    try:
+        from paddle_tpu.fluid.contrib.reader import (
+            distributed_batch_reader)
+        r = distributed_batch_reader(lambda: iter(range(10)))
+        assert list(r()) == [1, 3, 5, 7, 9]
+    finally:
+        os.environ["PADDLE_TRAINER_ID"] = "0"
+        os.environ["PADDLE_TRAINERS_NUM"] = "1"
+
+
+def test_contrib_training_decoder_and_beam_search():
+    from paddle_tpu.fluid.contrib.decoder import (
+        InitState, StateCell, TrainingDecoder, BeamSearchDecoder)
+
+    r = np.random.RandomState(0)
+    b, t, d = 2, 4, 8
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            seq = fluid.layers.data("seq", shape=[t, d], dtype="float32")
+            boot = fluid.layers.data("boot", shape=[d], dtype="float32")
+            init = InitState(init=boot)
+            cell = StateCell(inputs={"x": None},
+                             states={"h": init}, out_state="h")
+
+            @cell.state_updater
+            def updater(c):
+                x = c.get_input("x")
+                h = c.get_state("h")
+                c.set_state("h", fluid.layers.tanh(
+                    fluid.layers.elementwise_add(x, h)))
+
+            dec = TrainingDecoder(cell)
+            with dec.block():
+                out = dec.decode(
+                    seq, lambda c, x_t: (c.compute_state({"x": x_t})
+                                         or c.out_state()))
+            exe = fluid.Executor()
+            exe.run(startup)
+            got = exe.run(main, feed={
+                "seq": r.randn(b, t, d).astype("float32"),
+                "boot": np.zeros((b, d), "float32")},
+                fetch_list=[out])
+    assert np.asarray(got[0]).shape == (b, t, d)
+    assert np.all(np.isfinite(np.asarray(got[0])))

@@ -1,0 +1,161 @@
+"""slim prune + distillation tests (reference test strategy:
+`contrib/slim/tests/test_*_strategy.py` run compression on a small net
+and check the effect end-to-end)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework
+from paddle_tpu.core.scope import global_scope
+from paddle_tpu.fluid.contrib.slim.prune import (
+    MagnitudePruner, StructurePruner, prune_program, sensitivity)
+from paddle_tpu.fluid.contrib.slim.distillation import (
+    L2Distiller, SoftLabelDistiller, FSPDistiller, merge_teacher)
+
+
+def _train_mlp(steps=20, seed=0):
+    r = np.random.RandomState(seed)
+    feats = r.randn(64, 16).astype("float32")
+    w = r.randn(16, 4).astype("float32")
+    labels = feats.dot(w).argmax(1)[:, None].astype("int64")
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data("x", shape=[16], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="int64")
+            h = fluid.layers.fc(x, 32, act="relu", name="fc1")
+            logits = fluid.layers.fc(h, 4, name="fc2")
+            loss = fluid.layers.mean(
+                fluid.layers.loss.softmax_with_cross_entropy(logits, y))
+            fluid.optimizer.SGDOptimizer(0.5).minimize(loss)
+            exe = fluid.Executor()
+            exe.run(startup)
+            for _ in range(steps):
+                out = exe.run(main, feed={"x": feats, "y": labels},
+                              fetch_list=[loss])
+    return main, exe, feats, labels, loss, float(np.asarray(out[0]))
+
+
+def test_magnitude_pruner_sparsity():
+    r = np.random.RandomState(1)
+    w = r.randn(32, 32).astype("float32")
+    pruned = MagnitudePruner(0.5).prune(w)
+    sparsity = 1 - np.count_nonzero(pruned) / pruned.size
+    assert abs(sparsity - 0.5) < 0.02
+    # survivors are the largest-magnitude entries
+    assert np.abs(pruned[pruned != 0]).min() >= \
+        np.abs(w).ravel()[np.argsort(np.abs(w).ravel())[
+            int(w.size * 0.5) - 1]]
+
+
+def test_structure_pruner_axes():
+    r = np.random.RandomState(2)
+    w = r.randn(8, 6).astype("float32")
+    p = StructurePruner({"*": 1}, {"*": "l1_norm"})
+    idx = p.cal_pruned_idx("w", w, 0.5)
+    assert len(idx) == 3
+    scores = np.abs(w).sum(0)
+    assert set(idx) == set(np.argsort(scores)[:3].tolist())
+    pruned = p.prune_tensor(w, idx, 1)
+    assert pruned.shape == (8, 3)
+    lazy = p.prune_tensor(w, idx, 1, lazy=True)
+    assert lazy.shape == w.shape and np.all(lazy[:, idx] == 0)
+
+
+def test_prune_program_keeps_accuracy_reasonable():
+    main, exe, feats, labels, loss, base_loss = _train_mlp()
+    with framework.program_guard(main):
+        stats = prune_program(main, global_scope(), {"*": 0.3})
+        assert stats and all(0.2 <= s <= 0.4 for s in stats.values())
+        out = exe.run(main, feed={"x": feats, "y": labels},
+                      fetch_list=[loss])
+    pruned_loss = float(np.asarray(out[0]))
+    # 30% magnitude pruning must not destroy the model
+    assert pruned_loss < base_loss * 10 + 1.0
+
+
+def test_sensitivity():
+    main, exe, feats, labels, loss, _ = _train_mlp(steps=10, seed=3)
+
+    with framework.program_guard(main):
+        params = [p.name for p in main.all_parameters()
+                  if p.name.endswith(".w") or "w_0" in p.name or
+                  p.name.startswith("fc")]
+        if not params:
+            params = [p.name for p in main.all_parameters()][:1]
+
+        def ev():
+            out = exe.run(main, feed={"x": feats, "y": labels},
+                          fetch_list=[loss])
+            return float(np.asarray(out[0]))
+
+        sens = sensitivity(main, global_scope(), params[:1], ev,
+                           ratios=(0.1, 0.9))
+    (name, by_ratio), = sens.items()
+    assert by_ratio[0.9] >= by_ratio[0.1] - 1e-3
+
+
+def test_soft_label_distillation_trains_student():
+    r = np.random.RandomState(4)
+    feats = r.randn(64, 8).astype("float32")
+
+    # teacher program: a fixed random projection
+    teacher, t_startup = framework.Program(), framework.Program()
+    with framework.program_guard(teacher, t_startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data("x", shape=[8], dtype="float32")
+            t_logits = fluid.layers.fc(x, 4, name="t_fc")
+            t_name = t_logits.name
+        exe = fluid.Executor()
+        exe.run(t_startup)
+
+    student, s_startup = framework.Program(), framework.Program()
+    with framework.program_guard(student, s_startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data("x", shape=[8], dtype="float32")
+            s_logits = fluid.layers.fc(x, 4, name="s_fc")
+            name_map = merge_teacher(teacher, student)
+            dist = SoftLabelDistiller(s_logits.name, name_map[t_name],
+                                      teacher_temperature=2.0,
+                                      student_temperature=2.0)
+            dloss = dist.distiller_loss(student)
+            fluid.optimizer.AdamOptimizer(5e-2).minimize(dloss)
+            exe.run(s_startup)
+            losses = []
+            for _ in range(25):
+                out = exe.run(student, feed={"x": feats},
+                              fetch_list=[dloss])
+                losses.append(float(np.asarray(out[0])))
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+def test_l2_and_fsp_distillers_build():
+    r = np.random.RandomState(5)
+    feats = r.randn(8, 3, 8, 8).astype("float32")
+
+    teacher, t_startup = framework.Program(), framework.Program()
+    with framework.program_guard(teacher, t_startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data("x", shape=[3, 8, 8], dtype="float32")
+            t1 = fluid.layers.conv2d(x, 4, 3, padding=1, name="tc1")
+            t2 = fluid.layers.conv2d(t1, 4, 3, padding=1, name="tc2")
+        exe = fluid.Executor()
+        exe.run(t_startup)
+
+    student, s_startup = framework.Program(), framework.Program()
+    with framework.program_guard(student, s_startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data("x", shape=[3, 8, 8], dtype="float32")
+            s1 = fluid.layers.conv2d(x, 4, 3, padding=1, name="sc1")
+            s2 = fluid.layers.conv2d(s1, 4, 3, padding=1, name="sc2")
+            exe.run(s_startup)
+            name_map = merge_teacher(teacher, student)
+            l2 = L2Distiller(s2.name, name_map[t2.name]).distiller_loss(
+                student)
+            fsp = FSPDistiller(
+                [(s1.name, s2.name)],
+                [(name_map[t1.name], name_map[t2.name])]).distiller_loss(
+                student)
+            out = exe.run(student, feed={"x": feats},
+                          fetch_list=[l2, fsp])
+    assert np.isfinite(np.asarray(out[0])).all()
+    assert np.isfinite(np.asarray(out[1])).all()
