@@ -322,3 +322,153 @@ def stat_names() -> List[str]:
     lib.ptq_stat_names(buf, n + 1)
     s = buf.value.decode()
     return s.split("\n") if s else []
+
+
+# ---------------------------------------------------------------------------
+# Profiler trace events (src/trace_events.cc; ref: platform/profiler.h +
+# tools/timeline.py) — native ring store + chrome-trace writer.
+# ---------------------------------------------------------------------------
+
+def _trace_lib():
+    lib = _load()
+    if not hasattr(lib, "_trace_bound"):
+        i32, i64 = ctypes.c_int32, ctypes.c_int64
+        lib.ptq_trace_enable.argtypes = [ctypes.c_int]
+        lib.ptq_trace_name_id.restype = i32
+        lib.ptq_trace_name_id.argtypes = [ctypes.c_char_p]
+        lib.ptq_trace_record.argtypes = [i32, i32, i64, i64]
+        lib.ptq_trace_count.restype = i64
+        lib.ptq_trace_export.restype = ctypes.c_int
+        lib.ptq_trace_export.argtypes = [ctypes.c_char_p,
+                                         ctypes.c_char_p]
+        lib.ptq_trace_stats.restype = i32
+        lib.ptq_trace_stats.argtypes = [ctypes.POINTER(i64),
+                                        ctypes.POINTER(i64),
+                                        ctypes.POINTER(i64), i32]
+        lib.ptq_trace_name_at.restype = ctypes.c_char_p
+        lib.ptq_trace_name_at.argtypes = [i32]
+        lib._trace_bound = True
+    return lib
+
+
+class NativeTrace:
+    """Event store + chrome-trace exporter backed by the C++ runtime."""
+
+    @staticmethod
+    def enable(on=True):
+        _trace_lib().ptq_trace_enable(1 if on else 0)
+
+    @staticmethod
+    def name_id(name: str) -> int:
+        return _trace_lib().ptq_trace_name_id(name.encode())
+
+    @staticmethod
+    def record(name_id: int, tid: int, start_us: int, dur_us: int):
+        _trace_lib().ptq_trace_record(name_id, tid, start_us, dur_us)
+
+    @staticmethod
+    def count() -> int:
+        return _trace_lib().ptq_trace_count()
+
+    @staticmethod
+    def reset():
+        _trace_lib().ptq_trace_reset()
+
+    @staticmethod
+    def export(path: str, process_name="paddle_tpu") -> int:
+        return _trace_lib().ptq_trace_export(path.encode(),
+                                             process_name.encode())
+
+    @staticmethod
+    def stats():
+        lib = _trace_lib()
+        n = lib.ptq_trace_stats(None, None, None, 0)
+        if n == 0:
+            return {}
+        i64 = ctypes.c_int64
+        counts = (i64 * n)()
+        totals = (i64 * n)()
+        maxes = (i64 * n)()
+        lib.ptq_trace_stats(counts, totals, maxes, n)
+        out = {}
+        for i in range(n):
+            name = lib.ptq_trace_name_at(i).decode()
+            out[name] = {"count": counts[i], "total_us": totals[i],
+                         "max_us": maxes[i]}
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Ragged <-> padded batching (src/ragged.cc; ref:
+# operators/math/sequence_padding.cc).
+# ---------------------------------------------------------------------------
+
+def _ragged_lib():
+    lib = _load()
+    if not hasattr(lib, "_ragged_bound"):
+        i64 = ctypes.c_int64
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.ptq_ragged_pad.restype = i64
+        lib.ptq_ragged_pad.argtypes = [u8p, ctypes.POINTER(i64), i64,
+                                       i64, i64, i64, u8p]
+        lib.ptq_ragged_unpad.restype = i64
+        lib.ptq_ragged_unpad.argtypes = [u8p, ctypes.POINTER(i64), i64,
+                                         i64, i64, i64, u8p]
+        lib.ptq_lod_to_lengths.argtypes = [ctypes.POINTER(i64), i64,
+                                           ctypes.POINTER(i64)]
+        lib._ragged_bound = True
+    return lib
+
+
+def _u8view(arr):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def ragged_pad(values: np.ndarray, lengths, max_len=None):
+    """Concatenated rows [total, width...] + per-item lengths ->
+    padded [batch, max_len, width...] (zero pad), via the native
+    single-memcpy-per-row kernel."""
+    lib = _ragged_lib()
+    values = np.ascontiguousarray(values)
+    lengths = np.ascontiguousarray(lengths, dtype=np.int64)
+    batch = len(lengths)
+    max_len = int(max_len if max_len is not None
+                  else (lengths.max() if batch else 0))
+    width_shape = values.shape[1:]
+    width = int(np.prod(width_shape)) if width_shape else 1
+    out = np.empty((batch, max_len) + tuple(width_shape), values.dtype)
+    lib.ptq_ragged_pad(
+        _u8view(values), lengths.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_int64)),
+        batch, max_len, width, values.dtype.itemsize, _u8view(out))
+    return out
+
+
+def ragged_unpad(padded: np.ndarray, lengths):
+    """Inverse of ragged_pad: padded [batch, max_len, width...] ->
+    concatenated [sum(min(len, max_len)), width...]."""
+    lib = _ragged_lib()
+    padded = np.ascontiguousarray(padded)
+    lengths = np.ascontiguousarray(lengths, dtype=np.int64)
+    batch, max_len = padded.shape[0], padded.shape[1]
+    width_shape = padded.shape[2:]
+    width = int(np.prod(width_shape)) if width_shape else 1
+    total = int(np.minimum(lengths, max_len).sum())
+    out = np.empty((total,) + tuple(width_shape), padded.dtype)
+    lib.ptq_ragged_unpad(
+        _u8view(padded), lengths.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_int64)),
+        batch, max_len, width, padded.dtype.itemsize, _u8view(out))
+    return out
+
+
+def lod_to_lengths(lod):
+    """Level-0 LoD offsets [0, n1, n1+n2, ...] -> per-item lengths."""
+    lib = _ragged_lib()
+    lod = np.ascontiguousarray(lod, dtype=np.int64)
+    batch = len(lod) - 1
+    out = np.empty((batch,), np.int64)
+    lib.ptq_lod_to_lengths(
+        lod.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), batch,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    return out

@@ -85,3 +85,27 @@ void ptq_stat_reset(const char* name);
 int64_t ptq_stat_names(char* buf, int64_t cap);
 
 }  // extern "C"
+
+// ---- profiler trace events (trace_events.cc; ref: platform/profiler.h
+// + tools/timeline.py chrome-trace conversion) ----
+void ptq_trace_enable(int enabled);
+int32_t ptq_trace_name_id(const char* name);
+void ptq_trace_record(int32_t name_id, int32_t tid, int64_t start_us,
+                      int64_t dur_us);
+int64_t ptq_trace_count(void);
+void ptq_trace_reset(void);
+int ptq_trace_export(const char* path, const char* process_name);
+int32_t ptq_trace_stats(int64_t* counts, int64_t* totals, int64_t* maxes,
+                        int32_t cap);
+const char* ptq_trace_name_at(int32_t id);
+
+// ---- ragged <-> padded batching (ragged.cc; ref:
+// operators/math/sequence_padding.cc) ----
+int64_t ptq_ragged_pad(const uint8_t* values, const int64_t* lengths,
+                       int64_t batch, int64_t max_len, int64_t width,
+                       int64_t elem_size, uint8_t* out);
+int64_t ptq_ragged_unpad(const uint8_t* padded, const int64_t* lengths,
+                         int64_t batch, int64_t max_len, int64_t width,
+                         int64_t elem_size, uint8_t* out);
+void ptq_lod_to_lengths(const int64_t* lod, int64_t batch,
+                        int64_t* lengths);

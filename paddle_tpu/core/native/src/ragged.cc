@@ -1,0 +1,64 @@
+// Ragged <-> padded batching kernels for the host data path.
+//
+// Reference parity: LoDTensor ragged batching (framework/lod_tensor.h:52
+// nested offsets) and the sequence-padding kernels
+// (operators/math/sequence_padding.cc PaddingLoDTensorFunctor /
+// UnpaddingLoDTensorFunctor). The TPU representation is dense padding +
+// explicit lengths (SURVEY.md §7 hard part (a)); these kernels do the
+// concatenated-rows -> [B, T_max, D] scatter (and the inverse gather)
+// in one memcpy pass per row instead of a python loop per element.
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// values: concatenated rows, total_rows x width elements of elem_size
+// bytes. lengths[b] rows belong to batch item b. out must hold
+// batch x max_len x width elements; it is zero-filled first (pad value
+// 0). Returns the max length actually seen (<= max_len used).
+int64_t ptq_ragged_pad(const uint8_t* values, const int64_t* lengths,
+                       int64_t batch, int64_t max_len, int64_t width,
+                       int64_t elem_size, uint8_t* out) {
+  const int64_t row_bytes = width * elem_size;
+  std::memset(out, 0, static_cast<size_t>(batch * max_len * row_bytes));
+  int64_t offset_rows = 0;
+  int64_t seen_max = 0;
+  for (int64_t b = 0; b < batch; ++b) {
+    const int64_t len = std::min<int64_t>(lengths[b], max_len);
+    seen_max = std::max(seen_max, lengths[b]);
+    std::memcpy(out + b * max_len * row_bytes,
+                values + offset_rows * row_bytes,
+                static_cast<size_t>(len * row_bytes));
+    offset_rows += lengths[b];
+  }
+  return seen_max;
+}
+
+// Inverse: gather the first lengths[b] rows of each padded batch item
+// back into a concatenated buffer. Returns total rows written.
+int64_t ptq_ragged_unpad(const uint8_t* padded, const int64_t* lengths,
+                         int64_t batch, int64_t max_len, int64_t width,
+                         int64_t elem_size, uint8_t* out) {
+  const int64_t row_bytes = width * elem_size;
+  int64_t offset_rows = 0;
+  for (int64_t b = 0; b < batch; ++b) {
+    const int64_t len = std::min<int64_t>(lengths[b], max_len);
+    std::memcpy(out + offset_rows * row_bytes,
+                padded + b * max_len * row_bytes,
+                static_cast<size_t>(len * row_bytes));
+    offset_rows += len;
+  }
+  return offset_rows;
+}
+
+// LoD offsets -> per-item lengths (reference lod_tensor.h level-0
+// offsets [0, n1, n1+n2, ...]).
+void ptq_lod_to_lengths(const int64_t* lod, int64_t batch,
+                        int64_t* lengths) {
+  for (int64_t b = 0; b < batch; ++b) {
+    lengths[b] = lod[b + 1] - lod[b];
+  }
+}
+
+}  // extern "C"

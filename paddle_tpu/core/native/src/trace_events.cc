@@ -1,0 +1,148 @@
+// Native profiler event recorder + chrome-trace exporter.
+//
+// Reference parity: paddle/fluid/platform/profiler.{h,cc} — RecordEvent
+// ring storage, EnableProfiler/DisableProfiler aggregation, and
+// tools/timeline.py's chrome://tracing JSON conversion (done here in
+// C++ so a million-event trace exports without a python loop).
+//
+// Model: a global lock-free-ish (mutex-sharded) event store; events are
+// (name_id, tid, start_us, dur_us). Names are interned once. Export
+// writes the standard chrome trace "traceEvents" array with "X"
+// (complete) events; stats aggregates count/total/max per name.
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Event {
+  int32_t name_id;
+  int32_t tid;
+  int64_t start_us;
+  int64_t dur_us;
+};
+
+struct TraceStore {
+  std::mutex mu;
+  std::vector<std::string> names;
+  std::map<std::string, int32_t> name_ids;
+  std::vector<Event> events;
+  bool enabled = false;
+};
+
+TraceStore& store() {
+  static TraceStore s;
+  return s;
+}
+
+}  // namespace
+
+extern "C" {
+
+void ptq_trace_enable(int enabled) {
+  TraceStore& s = store();
+  std::lock_guard<std::mutex> g(s.mu);
+  s.enabled = enabled != 0;
+}
+
+int32_t ptq_trace_name_id(const char* name) {
+  TraceStore& s = store();
+  std::lock_guard<std::mutex> g(s.mu);
+  auto it = s.name_ids.find(name);
+  if (it != s.name_ids.end()) return it->second;
+  int32_t id = static_cast<int32_t>(s.names.size());
+  s.names.emplace_back(name);
+  s.name_ids.emplace(name, id);
+  return id;
+}
+
+void ptq_trace_record(int32_t name_id, int32_t tid, int64_t start_us,
+                      int64_t dur_us) {
+  TraceStore& s = store();
+  std::lock_guard<std::mutex> g(s.mu);
+  if (!s.enabled) return;
+  s.events.push_back(Event{name_id, tid, start_us, dur_us});
+}
+
+int64_t ptq_trace_count() {
+  TraceStore& s = store();
+  std::lock_guard<std::mutex> g(s.mu);
+  return static_cast<int64_t>(s.events.size());
+}
+
+void ptq_trace_reset() {
+  TraceStore& s = store();
+  std::lock_guard<std::mutex> g(s.mu);
+  s.events.clear();
+}
+
+// Writes chrome://tracing JSON. Returns 0 on success.
+int ptq_trace_export(const char* path, const char* process_name) {
+  TraceStore& s = store();
+  std::lock_guard<std::mutex> g(s.mu);
+  FILE* f = std::fopen(path, "w");
+  if (!f) return -1;
+  std::fputs("{\"traceEvents\":[\n", f);
+  std::fprintf(f,
+               "{\"name\":\"process_name\",\"ph\":\"M\",\"pid\":0,"
+               "\"args\":{\"name\":\"%s\"}}",
+               process_name ? process_name : "paddle_tpu");
+  for (const Event& e : s.events) {
+    const std::string& name =
+        (e.name_id >= 0 &&
+         e.name_id < static_cast<int32_t>(s.names.size()))
+            ? s.names[e.name_id]
+            : "?";
+    // escape quotes/backslashes in the name
+    std::string esc;
+    esc.reserve(name.size());
+    for (char c : name) {
+      if (c == '"' || c == '\\') esc.push_back('\\');
+      esc.push_back(c);
+    }
+    std::fprintf(f,
+                 ",\n{\"name\":\"%s\",\"ph\":\"X\",\"pid\":0,"
+                 "\"tid\":%d,\"ts\":%lld,\"dur\":%lld}",
+                 esc.c_str(), e.tid,
+                 static_cast<long long>(e.start_us),
+                 static_cast<long long>(e.dur_us));
+  }
+  std::fputs("\n]}\n", f);
+  std::fclose(f);
+  return 0;
+}
+
+// Aggregated per-name stats. Caller passes arrays of capacity `cap`;
+// returns the number of distinct names. counts/totals/maxes are
+// per-name aggregates in name-id order; use ptq_trace_name_at to map
+// ids back to strings.
+int32_t ptq_trace_stats(int64_t* counts, int64_t* totals, int64_t* maxes,
+                        int32_t cap) {
+  TraceStore& s = store();
+  std::lock_guard<std::mutex> g(s.mu);
+  int32_t n = static_cast<int32_t>(s.names.size());
+  if (counts == nullptr) return n;
+  for (int32_t i = 0; i < n && i < cap; ++i) {
+    counts[i] = totals[i] = maxes[i] = 0;
+  }
+  for (const Event& e : s.events) {
+    if (e.name_id < 0 || e.name_id >= cap) continue;
+    counts[e.name_id] += 1;
+    totals[e.name_id] += e.dur_us;
+    if (e.dur_us > maxes[e.name_id]) maxes[e.name_id] = e.dur_us;
+  }
+  return n;
+}
+
+const char* ptq_trace_name_at(int32_t id) {
+  TraceStore& s = store();
+  std::lock_guard<std::mutex> g(s.mu);
+  if (id < 0 || id >= static_cast<int32_t>(s.names.size())) return "";
+  return s.names[id].c_str();
+}
+
+}  // extern "C"

@@ -200,6 +200,12 @@ class BeamSearchDecoder:
                 "contrib BeamSearchDecoder needs a StateCell with exactly "
                 "one input (got %r); multi-input cells must use "
                 "layers.dynamic_decode directly" % (input_names,))
+        if len(sc._init_states) != 1:
+            raise ValueError(
+                "contrib BeamSearchDecoder threads only one state "
+                "through the beam (got states %r); multi-state cells "
+                "(LSTM h+c) must use layers.dynamic_decode directly"
+                % (sorted(sc._init_states),))
         in_name = input_names[0]
 
         class _CellAdapter(_rnn_decode.RNNCell):

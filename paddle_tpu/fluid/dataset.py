@@ -160,9 +160,12 @@ class DatasetBase:
                 width = int(counts.max()) if counts.size else 0
                 if width > 0:
                     width = 1 << (width - 1).bit_length()
-                arr = np.zeros((n_examples, width), vals.dtype)
-                for i in range(n_examples):
-                    arr[i, :counts[i]] = vals[lod[i]:lod[i + 1]]
+                # native scatter: one memcpy per row (ragged.cc) instead
+                # of a python loop
+                from ..core.native import ragged_pad
+
+                arr = ragged_pad(vals.reshape(-1, 1), counts,
+                                 max_len=width)[..., 0]
                 out[name + ".lod"] = np.asarray(lod)
             else:
                 if counts.size and not (counts == counts[0]).all():

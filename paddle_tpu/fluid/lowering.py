@@ -688,7 +688,12 @@ def _jit_with_eager_fallback(jitted, fn):
             return jitted(*args, **kwargs)
         except Exception as e:  # noqa: BLE001 - backend capability probe
             msg = str(e)
-            if "does not support host send/recv callbacks" in msg:
+            cb = ("callback" in msg or "SendToHost" in msg
+                  or "RecvFromHost" in msg)
+            unsupported = ("UNIMPLEMENTED" in msg
+                           or "not supported" in msg
+                           or "does not support" in msg)
+            if cb and unsupported:
                 state["eager"] = True
                 return fn(*args, **kwargs)
             raise

@@ -20,6 +20,27 @@ _trace_events = []
 _trace_enabled = False
 
 
+_native_broken = False
+
+
+def _native_trace():
+    """The C++ event store (core/native/src/trace_events.cc) when the
+    native runtime builds; None otherwise (pure-python buffer is the
+    fallback). The .so builds lazily on first use, so the first call is
+    probed and any failure permanently disables the native path."""
+    global _native_broken
+    if _native_broken:
+        return None
+    try:
+        from ..core.native import NativeTrace
+
+        NativeTrace.count()   # forces the lazy build; cheap afterwards
+        return NativeTrace
+    except Exception:
+        _native_broken = True
+        return None
+
+
 class RecordEvent:
     """Host-side RAII event (reference: platform/profiler.h:126);
     also emits a device trace annotation when a jax trace is active."""
@@ -28,6 +49,7 @@ class RecordEvent:
         self.name = name
         self._t0 = None
         self._ann = None
+        self._nid = None
 
     def __enter__(self):
         self._t0 = time.perf_counter()
@@ -49,8 +71,16 @@ class RecordEvent:
         if _trace_enabled:
             import threading
 
-            _trace_events.append((self.name, self._t0 * 1e6, dt * 1e6,
-                                  threading.get_ident() % 100000))
+            tid = threading.get_ident() % 100000
+            nt = _native_trace()
+            if nt is not None:
+                if self._nid is None:
+                    self._nid = nt.name_id(self.name)
+                nt.record(self._nid, tid, int(self._t0 * 1e6),
+                          int(dt * 1e6))
+            else:
+                _trace_events.append((self.name, self._t0 * 1e6,
+                                      dt * 1e6, tid))
         if self._ann is not None:
             self._ann.__exit__(*a)
 
@@ -71,6 +101,9 @@ def profiler(state="All", sorted_key=None, profile_path="/tmp/profile",
         pass
     global _trace_enabled
     _trace_enabled = True
+    nt = _native_trace()
+    if nt is not None:
+        nt.enable(True)
     t0 = time.perf_counter()
     try:
         yield
@@ -104,6 +137,9 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
 def reset_profiler():
     _host_events.clear()
     del _trace_events[:]
+    nt = _native_trace()
+    if nt is not None:
+        nt.reset()
 
 
 def export_chrome_tracing(path):
@@ -112,11 +148,16 @@ def export_chrome_tracing(path):
     plus per-event complete ("ph":"X") entries)."""
     import json
 
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    nt = _native_trace()
+    if nt is not None and nt.count() > 0:
+        # the C++ writer streams the JSON (no python loop per event)
+        nt.export(path)
+        return path
     events = [{"name": name, "ph": "X", "pid": 0, "tid": tid,
                "ts": ts, "dur": dur, "cat": "host"}
               for name, ts, dur, tid in _trace_events]
     data = {"traceEvents": events, "displayTimeUnit": "ms"}
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path, "w") as f:
         json.dump(data, f)
     return path

@@ -29,10 +29,15 @@ class ComplexVariable:
         if imag is None:
             self._data = jnp.asarray(real)
             if not jnp.iscomplexobj(self._data):
-                self._data = self._data.astype(jnp.complex64)
+                wide = self._data.dtype == jnp.float64
+                self._data = self._data.astype(
+                    jnp.complex128 if wide else jnp.complex64)
         else:
-            self._data = (jnp.asarray(real)
-                          + 1j * jnp.asarray(imag)).astype(jnp.complex64)
+            r = jnp.asarray(real)
+            i = jnp.asarray(imag)
+            wide = (r.dtype == jnp.float64 or i.dtype == jnp.float64)
+            self._data = (r + 1j * i).astype(
+                jnp.complex128 if wide else jnp.complex64)
 
     @property
     def real(self):

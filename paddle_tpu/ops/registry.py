@@ -122,34 +122,47 @@ def infer_outputs(type_: str, input_specs: Dict[str, list], attrs: dict):
 
     if op.no_jit:
         # host ops run numpy code that cannot be traced by eval_shape;
-        # probe shapes by executing once on zero-filled concrete inputs
+        # probe shapes by executing on zero-filled concrete inputs. Dims
+        # that come from a dynamic (-1) input dim are found by probing
+        # TWICE with different sentinel extents: only dims that track the
+        # sentinel change are dynamic (an honest static dim of size 97
+        # stays put).
         from ..core.types import to_numpy_dtype, normalize_dtype
 
-        zeros = {
-            slot: [np.zeros([d if (d is not None and d >= 0)
-                             else _DYN_SENTINEL for d in shape],
-                            to_numpy_dtype(dtype))
-                   for shape, dtype in specs]
-            for slot, specs in input_specs.items()
-        }
-        run_attrs = dict(attrs)
-        if op.needs_rng:
-            run_attrs["_rng_key"] = jax.random.PRNGKey(0)
-        outs = normalize_outs(op.compute(zeros, run_attrs))
         had_dynamic = any(
             d is None or d < 0
             for specs in input_specs.values() for shape, _ in specs
             for d in shape)
 
-        def undyn(shape):
-            # a sentinel-sized output dim came from a dynamic input dim
-            return tuple(-1 if (had_dynamic and d == _DYN_SENTINEL)
-                         else int(d) for d in shape)
+        def probe(sentinel):
+            zeros = {
+                slot: [np.zeros([d if (d is not None and d >= 0)
+                                 else sentinel for d in shape],
+                                to_numpy_dtype(dtype))
+                       for shape, dtype in specs]
+                for slot, specs in input_specs.items()
+            }
+            run_attrs = dict(attrs)
+            if op.needs_rng:
+                run_attrs["_rng_key"] = jax.random.PRNGKey(0)
+            return normalize_outs(op.compute(zeros, run_attrs))
 
-        return {slot: [(undyn(np.asarray(v).shape),
-                        normalize_dtype(np.asarray(v).dtype))
-                       for v in vs]
-                for slot, vs in outs.items()}
+        outs = probe(_DYN_SENTINEL)
+        outs2 = probe(89) if had_dynamic else outs
+
+        result = {}
+        for slot, vs in outs.items():
+            specs = []
+            for v, v2 in zip(vs, outs2[slot]):
+                s1 = np.asarray(v).shape
+                s2 = np.asarray(v2).shape
+                shape = tuple(
+                    -1 if (len(s1) == len(s2) and a != b) else int(a)
+                    for a, b in zip(s1, s2)) if had_dynamic else                     tuple(int(d) for d in s1)
+                specs.append((shape,
+                              normalize_dtype(np.asarray(v).dtype)))
+            result[slot] = specs
+        return result
 
     dyn_axes = set()
 

@@ -388,14 +388,16 @@ def _where(ins, attrs):
     return {"Out": jnp.where(ins["Condition"][0], ins["X"][0], ins["Y"][0])}
 
 
-@register_op("where_index", no_jit=True)
+@register_op("where_index", no_jit=True,
+             dynamic_shape=True)
 def _where_index(ins, attrs):
     # dynamic output shape: only usable eagerly (outside jit)
     cond = np.asarray(ins["Condition"][0])
     return {"Out": jnp.asarray(np.argwhere(cond).astype(np.int64))}
 
 
-@register_op("masked_select", no_jit=True)
+@register_op("masked_select", no_jit=True,
+             dynamic_shape=True)
 def _masked_select(ins, attrs):
     x = np.asarray(ins["X"][0])
     mask = np.asarray(ins["Mask"][0])
@@ -446,7 +448,8 @@ def _flip(ins, attrs):
     return {"Out": jnp.flip(ins["X"][0], axis=tuple(attrs["axis"]))}
 
 
-@register_op("unique", no_jit=True)
+@register_op("unique", no_jit=True,
+             dynamic_shape=True)
 def _unique(ins, attrs):
     """Slots follow the 2.0 unique op: Index = inverse mapping (the
     fluid-era output), Indices = first-occurrence positions, Counts.
@@ -608,7 +611,8 @@ def _partial_concat(ins, attrs):
                                    axis=1)}
 
 
-@register_op("unique_with_counts", no_jit=True)
+@register_op("unique_with_counts", no_jit=True,
+             dynamic_shape=True)
 def _unique_with_counts(ins, attrs):
     x = np.asarray(ins["X"][0]).reshape(-1)
     out, index, inverse, counts = np.unique(

@@ -169,3 +169,49 @@ def test_stat_registry():
     assert "test.counter" in native.stat_names()
     native.stat_reset("test.counter")
     assert native.stat_get("test.counter") == 0
+
+
+def test_native_trace_events(tmp_path):
+    import json
+    from paddle_tpu.core.native import NativeTrace
+
+    NativeTrace.reset()
+    NativeTrace.enable(True)
+    nid = NativeTrace.name_id("kernel/matmul")
+    NativeTrace.record(nid, 3, 1000, 250)
+    NativeTrace.record(nid, 3, 2000, 150)
+    assert NativeTrace.count() == 2
+    path = str(tmp_path / "trace.json")
+    assert NativeTrace.export(path, "test_proc") == 0
+    data = json.load(open(path))
+    xs = [e for e in data["traceEvents"] if e["ph"] == "X"]
+    assert len(xs) == 2 and xs[0]["name"] == "kernel/matmul"
+    st = NativeTrace.stats()
+    assert st["kernel/matmul"]["count"] == 2
+    assert st["kernel/matmul"]["total_us"] == 400
+    assert st["kernel/matmul"]["max_us"] == 250
+    NativeTrace.enable(False)
+    NativeTrace.reset()
+
+
+def test_native_ragged_roundtrip():
+    from paddle_tpu.core.native import (ragged_pad, ragged_unpad,
+                                        lod_to_lengths)
+
+    r = np.random.RandomState(0)
+    vals = r.randn(10, 3).astype("float32")
+    lens = np.array([4, 0, 6], "int64")
+    p = ragged_pad(vals, lens)
+    assert p.shape == (3, 6, 3)
+    np.testing.assert_array_equal(p[0, :4], vals[:4])
+    assert np.all(p[0, 4:] == 0) and np.all(p[1] == 0)
+    np.testing.assert_array_equal(p[2], vals[4:])
+    u = ragged_unpad(p, lens)
+    np.testing.assert_array_equal(u, vals)
+    np.testing.assert_array_equal(lod_to_lengths([0, 4, 4, 10]),
+                                  lens)
+    # int64 payloads + explicit max_len truncation
+    iv = np.arange(8, dtype="int64")
+    p2 = ragged_pad(iv.reshape(-1, 1), [5, 3], max_len=4)[..., 0]
+    np.testing.assert_array_equal(p2[0], [0, 1, 2, 3])
+    np.testing.assert_array_equal(p2[1], [5, 6, 7, 0])

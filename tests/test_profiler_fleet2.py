@@ -26,6 +26,8 @@ def test_chrome_trace_export(tmp_path):
     names = [e["name"] for e in data["traceEvents"]]
     assert "step" in names and "forward" in names
     for e in data["traceEvents"]:
+        if e["ph"] == "M":   # metadata (process_name) records
+            continue
         assert e["ph"] == "X" and "ts" in e and "dur" in e
 
     rows = profiler.profiler_summary_rows()
