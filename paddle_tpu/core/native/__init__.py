@@ -431,6 +431,12 @@ def ragged_pad(values: np.ndarray, lengths, max_len=None):
     lib = _ragged_lib()
     values = np.ascontiguousarray(values)
     lengths = np.ascontiguousarray(lengths, dtype=np.int64)
+    if lengths.size and int(lengths.sum()) > values.shape[0]:
+        raise ValueError(
+            "ragged_pad: sum(lengths)=%d exceeds the %d rows in values"
+            % (int(lengths.sum()), values.shape[0]))
+    if lengths.size and int(lengths.min()) < 0:
+        raise ValueError("ragged_pad: negative length")
     batch = len(lengths)
     max_len = int(max_len if max_len is not None
                   else (lengths.max() if batch else 0))
@@ -450,6 +456,12 @@ def ragged_unpad(padded: np.ndarray, lengths):
     lib = _ragged_lib()
     padded = np.ascontiguousarray(padded)
     lengths = np.ascontiguousarray(lengths, dtype=np.int64)
+    if len(lengths) != padded.shape[0]:
+        raise ValueError(
+            "ragged_unpad: %d lengths for %d batch items"
+            % (len(lengths), padded.shape[0]))
+    if lengths.size and int(lengths.min()) < 0:
+        raise ValueError("ragged_unpad: negative length")
     batch, max_len = padded.shape[0], padded.shape[1]
     width_shape = padded.shape[2:]
     width = int(np.prod(width_shape)) if width_shape else 1

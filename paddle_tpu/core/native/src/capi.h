@@ -93,6 +93,7 @@ int32_t ptq_trace_name_id(const char* name);
 void ptq_trace_record(int32_t name_id, int32_t tid, int64_t start_us,
                       int64_t dur_us);
 int64_t ptq_trace_count(void);
+int64_t ptq_trace_dropped(void);
 void ptq_trace_reset(void);
 int ptq_trace_export(const char* path, const char* process_name);
 int32_t ptq_trace_stats(int64_t* counts, int64_t* totals, int64_t* maxes,

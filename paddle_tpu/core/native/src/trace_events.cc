@@ -5,10 +5,12 @@
 // tools/timeline.py's chrome://tracing JSON conversion (done here in
 // C++ so a million-event trace exports without a python loop).
 //
-// Model: a global lock-free-ish (mutex-sharded) event store; events are
-// (name_id, tid, start_us, dur_us). Names are interned once. Export
-// writes the standard chrome trace "traceEvents" array with "X"
-// (complete) events; stats aggregates count/total/max per name.
+// Model: a global mutex-guarded event store capped at kMaxEvents
+// (events beyond the cap are counted but dropped, like the reference's
+// bounded profiler storage); events are (name_id, tid, start_us,
+// dur_us). Names are interned once. Export writes the standard chrome
+// trace "traceEvents" array with "X" (complete) events; stats
+// aggregates count/total/max per name.
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -26,11 +28,14 @@ struct Event {
   int64_t dur_us;
 };
 
+constexpr size_t kMaxEvents = 4u << 20;  // ~100MB worst case
+
 struct TraceStore {
   std::mutex mu;
   std::vector<std::string> names;
   std::map<std::string, int32_t> name_ids;
   std::vector<Event> events;
+  int64_t dropped = 0;
   bool enabled = false;
 };
 
@@ -65,7 +70,17 @@ void ptq_trace_record(int32_t name_id, int32_t tid, int64_t start_us,
   TraceStore& s = store();
   std::lock_guard<std::mutex> g(s.mu);
   if (!s.enabled) return;
+  if (s.events.size() >= kMaxEvents) {
+    s.dropped += 1;
+    return;
+  }
   s.events.push_back(Event{name_id, tid, start_us, dur_us});
+}
+
+int64_t ptq_trace_dropped() {
+  TraceStore& s = store();
+  std::lock_guard<std::mutex> g(s.mu);
+  return s.dropped;
 }
 
 int64_t ptq_trace_count() {
@@ -78,6 +93,7 @@ void ptq_trace_reset() {
   TraceStore& s = store();
   std::lock_guard<std::mutex> g(s.mu);
   s.events.clear();
+  s.dropped = 0;
 }
 
 // Writes chrome://tracing JSON. Returns 0 on success.

@@ -40,8 +40,9 @@ class Conv2D(Layer):
                  padding=0, dilation=1, groups=1, param_attr=None,
                  bias_attr=None, use_cudnn=True, act=None, dtype="float32"):
         super().__init__()
-        if isinstance(filter_size, int):
-            filter_size = [filter_size, filter_size]
+        filter_size = ([filter_size, filter_size]
+                       if isinstance(filter_size, int)
+                       else list(filter_size))
         self._stride = ([stride, stride] if isinstance(stride, int)
                         else list(stride))
         self._padding = ([padding, padding] if isinstance(padding, int)
@@ -258,8 +259,9 @@ class Conv2DTranspose(Layer):
                  bias_attr=None, use_cudnn=True, act=None,
                  dtype="float32"):
         super().__init__()
-        if isinstance(filter_size, int):
-            filter_size = [filter_size, filter_size]
+        filter_size = ([filter_size, filter_size]
+                       if isinstance(filter_size, int)
+                       else list(filter_size))
         self._stride = ([stride, stride] if isinstance(stride, int)
                         else list(stride))
         self._padding = ([padding, padding] if isinstance(padding, int)

@@ -152,8 +152,9 @@ def export_chrome_tracing(path):
     nt = _native_trace()
     if nt is not None and nt.count() > 0:
         # the C++ writer streams the JSON (no python loop per event)
-        nt.export(path)
-        return path
+        if nt.export(path) == 0:
+            return path
+        raise OSError("chrome-trace export failed to open %r" % path)
     events = [{"name": name, "ph": "X", "pid": 0, "tid": tid,
                "ts": ts, "dur": dur, "cat": "host"}
               for name, ts, dur, tid in _trace_events]

@@ -18,3 +18,5 @@ __all__ = [
     "Model", "Input", "Callback", "ProgBarLogger", "ModelCheckpoint",
     "EarlyStopping", "LRScheduler", "Metric", "Accuracy", "datasets",
 ]
+from . import vision  # noqa: F401,E402
+from . import text  # noqa: F401,E402

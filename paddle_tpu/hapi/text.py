@@ -1,0 +1,237 @@
+"""hapi.text (reference: `python/paddle/incubate/hapi/text/text.py`,
+~3k LoC of RNN/seq2seq/CNN/transformer building blocks). The heavy
+machinery lives in `paddle_tpu.nn` (rnn/transformer) and
+`fluid.layers.rnn_decode`; this module provides the hapi-named surface
+over it plus the cells/conv-pool encoders the reference defines here."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..fluid.dygraph.layers import Layer
+from ..fluid.dygraph import nn as dnn
+from ..nn.rnn import LSTM, GRU  # noqa: F401 (re-exported hapi names)
+from ..nn.transformer import (  # noqa: F401
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+)
+from ..fluid.layers.rnn_decode import (  # noqa: F401
+    RNNCell, GRUCell as BasicGRUCell, BeamSearchDecoder, dynamic_decode,
+)
+
+__all__ = [
+    "RNNCell", "BasicLSTMCell", "BasicGRUCell", "RNN", "LSTM", "GRU",
+    "BidirectionalLSTM", "BidirectionalGRU", "Conv1dPoolLayer",
+    "CNNEncoder", "MultiHeadAttention", "TransformerEncoderLayer",
+    "TransformerEncoder", "BeamSearchDecoder", "DynamicDecode",
+]
+
+
+class BasicLSTMCell(RNNCell):
+    """reference text.py:186 — one LSTM step cell (i,f,o,g gates with
+    forget_bias), for use with RNN/dynamic_decode."""
+
+    def __init__(self, input_size, hidden_size, forget_bias=1.0,
+                 param_attr=None, name="basic_lstm_cell"):
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.forget_bias = float(forget_bias)
+        self._param_attr = param_attr
+        self._name = name
+        self._w = None
+        self._b = None
+
+    def call(self, inputs, states):
+        from ..fluid.layer_helper import LayerHelper, apply_op
+        from ..fluid.layers import nn as N
+        from ..fluid.layers import tensor as T
+
+        h, c = states
+        if self._w is None:
+            helper = LayerHelper(self._name, param_attr=self._param_attr)
+            self._w = helper.create_parameter(
+                helper.param_attr,
+                shape=[self.input_size + self.hidden_size,
+                       4 * self.hidden_size], dtype="float32")
+            self._b = helper.create_parameter(
+                None, shape=[4 * self.hidden_size], dtype="float32",
+                is_bias=True)
+        concat = T.concat([inputs, h], axis=1)
+        gates = N.elementwise_add(N.matmul(concat, self._w), self._b)
+        # lstm_unit packs [i, f, o, g] and adds forget_bias to f
+        outs = apply_op("lstm_unit", "lstm_unit",
+                        {"X": [gates], "C_prev": [c]},
+                        {"forget_bias": self.forget_bias}, ["C", "H"],
+                        out_dtype="float32")
+        new_c, new_h = outs[0], outs[1]
+        return new_h, (new_h, new_c)
+
+
+class RNN(Layer):
+    """reference text.py:476 — run a cell over [B, T, D]."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states):
+        from ..fluid.layers import nn as N
+        from ..fluid.layers import tensor as T
+
+        if self.time_major:
+            inputs = T.transpose(inputs, [1, 0, 2])
+        t = inputs.shape[1]
+        steps = range(t - 1, -1, -1) if self.is_reverse else range(t)
+        states = initial_states
+        outs = [None] * t
+        for i in steps:
+            x_t = N.squeeze(
+                N.slice(inputs, axes=[1], starts=[i], ends=[i + 1]),
+                axes=[1])
+            out, states = self.cell(x_t, states)
+            outs[i] = out
+        stacked = N.stack(outs, axis=1)
+        if self.time_major:
+            stacked = T.transpose(stacked, [1, 0, 2])
+        return stacked, states
+
+
+def _merge_directions(out, hidden_size, mode):
+    """Apply the reference merge_mode over the concat [..., 2H] output
+    (text.py BidirectionalRNN: concat | sum | ave | mul | zip)."""
+    if mode in (None, "concat"):
+        return out
+    from ..fluid.layers import nn as N
+
+    fwd = N.slice(out, axes=[out.ndim - 1], starts=[0],
+                  ends=[hidden_size])
+    bwd = N.slice(out, axes=[out.ndim - 1], starts=[hidden_size],
+                  ends=[2 * hidden_size])
+    if mode == "sum":
+        return N.elementwise_add(fwd, bwd)
+    if mode in ("ave", "average"):
+        from ..fluid.layers import tensor as T
+
+        return T.scale(N.elementwise_add(fwd, bwd), scale=0.5)
+    if mode == "mul":
+        return N.elementwise_mul(fwd, bwd)
+    raise ValueError("unsupported merge_mode %r" % mode)
+
+
+class BidirectionalLSTM(Layer):
+    """reference text.py:1144 — fwd + bwd LSTM; merge_mode selects how
+    the direction outputs combine (concat/sum/ave/mul)."""
+
+    def __init__(self, input_size, hidden_size, merge_mode="concat",
+                 num_layers=1):
+        super().__init__()
+        from ..nn.rnn import LSTM as _LSTM
+
+        self._impl = _LSTM(input_size, hidden_size,
+                           num_layers=num_layers,
+                           direction="bidirectional")
+        self._merge = merge_mode
+        self._hidden = hidden_size
+
+    def forward(self, inputs, initial_states=None):
+        out = self._impl(inputs, initial_states)
+        seq, states = out if isinstance(out, tuple) else (out, None)
+        seq = _merge_directions(seq, self._hidden, self._merge)
+        return (seq, states) if states is not None else seq
+
+
+class BidirectionalGRU(Layer):
+    def __init__(self, input_size, hidden_size, merge_mode="concat",
+                 num_layers=1):
+        super().__init__()
+        from ..nn.rnn import GRU as _GRU
+
+        self._impl = _GRU(input_size, hidden_size, num_layers=num_layers,
+                          direction="bidirectional")
+        self._merge = merge_mode
+        self._hidden = hidden_size
+
+    def forward(self, inputs, initial_states=None):
+        out = self._impl(inputs, initial_states)
+        seq, states = out if isinstance(out, tuple) else (out, None)
+        seq = _merge_directions(seq, self._hidden, self._merge)
+        return (seq, states) if states is not None else seq
+
+
+class Conv1dPoolLayer(Layer):
+    """reference text.py:1980 — Conv1D (as a 1-wide Conv2D) + Pool1D."""
+
+    def __init__(self, num_channels, num_filters, filter_size,
+                 pool_size, conv_stride=1, pool_stride=1, conv_padding=0,
+                 act=None, pool_type="max", global_pooling=False):
+        super().__init__()
+        self.conv = dnn.Conv2D(num_channels, num_filters,
+                               (filter_size, 1), stride=(conv_stride, 1),
+                               padding=(conv_padding, 0), act=act)
+        self._pool_args = (pool_size, pool_type, pool_stride,
+                           global_pooling)
+
+    def forward(self, x):
+        from ..fluid.layers import nn as N
+        from ..tensor import manipulation as M
+
+        # x [B, C, T] -> [B, C, T, 1]
+        x4 = M.unsqueeze(x, [3]) if x.ndim == 3 else x
+        c = self.conv(x4)
+        size, ptype, stride, global_p = self._pool_args
+        if global_p:
+            size = c.shape[2]
+            stride = 1
+        p = N.pool2d(c, pool_size=(size, 1), pool_type=ptype,
+                     pool_stride=(stride, 1))
+        p = M.squeeze(p, [3])
+        if global_p:
+            p = M.squeeze(p, [2])    # [B, C, 1] -> [B, C]
+        return p
+
+
+class CNNEncoder(Layer):
+    """reference text.py:2109 — parallel Conv1dPool branches concat'd
+    along channels (TextCNN)."""
+
+    def __init__(self, num_channels, num_filters, filter_size,
+                 pool_size=1, num_layers=1, conv_stride=1, pool_stride=1,
+                 act=None, pool_type="max", global_pooling=True):
+        super().__init__()
+        sizes = filter_size if isinstance(filter_size, (list, tuple)) \
+            else [filter_size]
+        chans = num_channels if isinstance(num_channels, (list, tuple)) \
+            else [num_channels] * len(sizes)
+        filts = num_filters if isinstance(num_filters, (list, tuple)) \
+            else [num_filters] * len(sizes)
+        self.branches = []
+        for i, (c, f, k) in enumerate(zip(chans, filts, sizes)):
+            br = Conv1dPoolLayer(c, f, k, pool_size,
+                                 conv_stride=conv_stride,
+                                 pool_stride=pool_stride, act=act,
+                                 pool_type=pool_type,
+                                 global_pooling=global_pooling)
+            self.add_sublayer("branch_%d" % i, br)
+            self.branches.append(br)
+
+    def forward(self, x):
+        from ..fluid.layers import tensor as T
+
+        outs = [br(x) for br in self.branches]
+        return T.concat(outs, axis=1) if len(outs) > 1 else outs[0]
+
+
+class DynamicDecode(Layer):
+    """reference text.py:1762 — Layer wrapper over dynamic_decode."""
+
+    def __init__(self, decoder, max_step_num=None, output_time_major=False,
+                 impute_finished=False, is_test=False,
+                 return_length=False):
+        super().__init__()
+        self.decoder = decoder
+        self.max_step_num = max_step_num
+
+    def forward(self, inits=None, **kwargs):
+        return dynamic_decode(self.decoder, inits=inits,
+                              max_step_num=self.max_step_num or 64,
+                              **kwargs)

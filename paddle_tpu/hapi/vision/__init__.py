@@ -1,0 +1,3 @@
+"""hapi.vision (reference: `python/paddle/incubate/hapi/vision/`)."""
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401

@@ -1,0 +1,203 @@
+"""Vision model zoo (reference:
+`python/paddle/incubate/hapi/vision/models/` — lenet.py, vgg.py,
+mobilenetv1.py, mobilenetv2.py, resnet.py). Dygraph Layers usable
+standalone or under hapi.Model; the static-graph ResNet builder lives in
+`paddle_tpu/models/resnet.py`."""
+from __future__ import annotations
+
+from ...fluid.dygraph.layers import Layer, Sequential
+from ...fluid.dygraph import nn as dnn
+
+__all__ = ["LeNet", "VGG", "vgg16", "MobileNetV1", "MobileNetV2",
+           "lenet", "mobilenet_v1", "mobilenet_v2"]
+
+
+class LeNet(Layer):
+    """reference lenet.py: conv(6)-pool-conv(16)-pool-fc(120)-fc(84)-fc."""
+
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.features = Sequential(
+            dnn.Conv2D(1, 6, 3, stride=1, padding=1, act="relu"),
+            dnn.Pool2D(2, pool_type="max", pool_stride=2),
+            dnn.Conv2D(6, 16, 5, stride=1, padding=0, act="relu"),
+            dnn.Pool2D(2, pool_type="max", pool_stride=2),
+        )
+        self.fc = Sequential(
+            dnn.Linear(400, 120), dnn.Linear(120, 84),
+            dnn.Linear(84, num_classes),
+        )
+
+    def forward(self, x):
+        from ...tensor import manipulation as M
+
+        x = self.features(x)
+        x = M.flatten(x, 1)
+        return self.fc(x)
+
+
+_VGG_CFG = {
+    11: [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    13: [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+         512, 512, "M"],
+    16: [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512,
+         "M", 512, 512, 512, "M"],
+    19: [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+         512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+class VGG(Layer):
+    """reference vgg.py: stacked 3x3 convs + maxpools + 3 fc."""
+
+    def __init__(self, depth=16, num_classes=1000, with_pool=True):
+        super().__init__()
+        layers = []
+        c_in = 3
+        for v in _VGG_CFG[depth]:
+            if v == "M":
+                layers.append(dnn.Pool2D(2, pool_type="max",
+                                         pool_stride=2))
+            else:
+                layers.append(dnn.Conv2D(c_in, v, 3, padding=1,
+                                         act="relu"))
+                c_in = v
+        self.features = Sequential(*layers)
+        self.classifier = Sequential(
+            dnn.Linear(512 * 7 * 7, 4096, act="relu"),
+            dnn.Linear(4096, 4096, act="relu"),
+            dnn.Linear(4096, num_classes),
+        )
+
+    def forward(self, x):
+        from ...tensor import manipulation as M
+
+        x = self.features(x)
+        x = M.flatten(x, 1)
+        return self.classifier(x)
+
+
+def vgg16(pretrained=False, num_classes=1000, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are not bundled; load a state dict")
+    return VGG(16, num_classes=num_classes, **kwargs)
+
+
+class _ConvBN(Layer):
+    def __init__(self, c_in, c_out, k, stride=1, padding=0, groups=1,
+                 act="relu"):
+        super().__init__()
+        self.conv = dnn.Conv2D(c_in, c_out, k, stride=stride,
+                               padding=padding, groups=groups,
+                               bias_attr=False)
+        self.bn = dnn.BatchNorm(c_out, act=act)
+
+    def forward(self, x):
+        return self.bn(self.conv(x))
+
+
+class MobileNetV1(Layer):
+    """reference mobilenetv1.py: depthwise-separable stacks."""
+
+    def __init__(self, num_classes=1000, scale=1.0):
+        super().__init__()
+
+        def c(ch):
+            return max(int(ch * scale), 8)
+
+        cfg = [  # (out, stride) per depthwise-separable block
+            (64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+            (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2),
+            (1024, 1),
+        ]
+        blocks = [_ConvBN(3, c(32), 3, stride=2, padding=1)]
+        c_in = c(32)
+        for out, stride in cfg:
+            blocks.append(_ConvBN(c_in, c_in, 3, stride=stride,
+                                  padding=1, groups=c_in))   # depthwise
+            blocks.append(_ConvBN(c_in, c(out), 1))          # pointwise
+            c_in = c(out)
+        self.features = Sequential(*blocks)
+        self.fc = dnn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        from ...tensor import manipulation as M
+        from ...fluid.layers import nn as N
+
+        x = self.features(x)
+        x = N.pool2d(x, pool_size=x.shape[2], pool_type="avg")
+        return self.fc(M.flatten(x, 1))
+
+
+class _InvertedResidual(Layer):
+    """reference mobilenetv2.py InvertedResidualUnit."""
+
+    def __init__(self, c_in, c_out, stride, expand):
+        super().__init__()
+        hidden = c_in * expand
+        self.use_res = stride == 1 and c_in == c_out
+        layers = []
+        if expand != 1:
+            layers.append(_ConvBN(c_in, hidden, 1, act="relu6"))
+        layers.append(_ConvBN(hidden, hidden, 3, stride=stride,
+                              padding=1, groups=hidden, act="relu6"))
+        layers.append(_ConvBN(hidden, c_out, 1, act=None))
+        self.blocks = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.blocks(x)
+        if self.use_res:
+            from ...fluid.layers import nn as N
+
+            out = N.elementwise_add(out, x)
+        return out
+
+
+class MobileNetV2(Layer):
+    def __init__(self, num_classes=1000, scale=1.0):
+        super().__init__()
+
+        def c(ch):
+            return max(int(ch * scale), 8)
+
+        cfg = [  # t (expand), c (out), n (repeats), s (stride)
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1),
+        ]
+        blocks = [_ConvBN(3, c(32), 3, stride=2, padding=1, act="relu6")]
+        c_in = c(32)
+        for t, out, n, s in cfg:
+            for i in range(n):
+                blocks.append(_InvertedResidual(
+                    c_in, c(out), s if i == 0 else 1, t))
+                c_in = c(out)
+        blocks.append(_ConvBN(c_in, c(1280), 1, act="relu6"))
+        self.features = Sequential(*blocks)
+        self.fc = dnn.Linear(c(1280), num_classes)
+
+    def forward(self, x):
+        from ...tensor import manipulation as M
+        from ...fluid.layers import nn as N
+
+        x = self.features(x)
+        x = N.pool2d(x, pool_size=x.shape[2], pool_type="avg")
+        return self.fc(M.flatten(x, 1))
+
+
+def lenet(num_classes=10, **kwargs):
+    return LeNet(num_classes=num_classes, **kwargs)
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are not bundled; load a state dict")
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are not bundled; load a state dict")
+    return MobileNetV2(scale=scale, **kwargs)

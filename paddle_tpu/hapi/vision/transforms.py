@@ -1,0 +1,242 @@
+"""Image transforms (reference:
+`python/paddle/incubate/hapi/vision/transforms/transforms.py`): numpy
+HWC(uint8/float) image pipeline for dataset preprocessing. Host-side by
+design — augmentation runs on CPU while the accelerator computes."""
+from __future__ import annotations
+
+import random as _random
+
+import numpy as np
+
+__all__ = [
+    "Compose", "Resize", "RandomResizedCrop", "CenterCrop",
+    "RandomHorizontalFlip", "RandomVerticalFlip", "Normalize", "Permute",
+    "GaussianNoise", "BrightnessTransform", "ContrastTransform",
+    "SaturationTransform", "HueTransform", "ColorJitter",
+]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, *data):
+        for t in self.transforms:
+            if isinstance(data, tuple) and len(data) > 1:
+                data = (t(data[0]),) + tuple(data[1:])
+            else:
+                data = t(data[0] if isinstance(data, tuple) else data)
+                data = (data,)
+        return data[0] if len(data) == 1 else data
+
+
+def _resize(img, size, interp="bilinear"):
+    h, w = img.shape[:2]
+    if isinstance(size, int):
+        if h < w:
+            oh, ow = size, int(size * w / h)
+        else:
+            oh, ow = int(size * h / w), size
+    else:
+        oh, ow = size
+    ys = np.clip((np.arange(oh) + 0.5) * h / oh - 0.5, 0, h - 1)
+    xs = np.clip((np.arange(ow) + 0.5) * w / ow - 0.5, 0, w - 1)
+    if interp == "nearest":
+        return img[np.round(ys).astype(int)][:, np.round(xs).astype(int)]
+    y0 = np.floor(ys).astype(int)
+    x0 = np.floor(xs).astype(int)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[:, None, None] if img.ndim == 3 else (ys - y0)[:, None]
+    wx = (xs - x0)[None, :, None] if img.ndim == 3 else (xs - x0)[None, :]
+    f = img.astype("float32")
+    top = f[y0][:, x0] * (1 - wx) + f[y0][:, x1] * wx
+    bot = f[y1][:, x0] * (1 - wx) + f[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    return out.astype(img.dtype) if img.dtype == np.uint8 else out
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = size
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        return _resize(img, self.size, self.interpolation)
+
+
+class RandomResizedCrop:
+    def __init__(self, output_size, scale=(0.08, 1.0),
+                 ratio=(3. / 4, 4. / 3)):
+        self.output_size = (output_size, output_size) \
+            if isinstance(output_size, int) else tuple(output_size)
+        self.scale = scale
+        self.ratio = ratio
+
+    def __call__(self, img):
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = _random.uniform(*self.scale) * area
+            ar = _random.uniform(*self.ratio)
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if cw <= w and ch <= h:
+                x = _random.randint(0, w - cw)
+                y = _random.randint(0, h - ch)
+                crop = img[y:y + ch, x:x + cw]
+                return _resize(crop, self.output_size)
+        return _resize(img, self.output_size)   # fallback: whole image
+
+
+class CenterCrop:
+    def __init__(self, output_size):
+        self.output_size = (output_size, output_size) \
+            if isinstance(output_size, int) else tuple(output_size)
+
+    def __call__(self, img):
+        h, w = img.shape[:2]
+        ch, cw = self.output_size
+        y = max((h - ch) // 2, 0)
+        x = max((w - cw) // 2, 0)
+        return img[y:y + ch, x:x + cw]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if _random.random() < self.prob:
+            return img[:, ::-1].copy()
+        return img
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if _random.random() < self.prob:
+            return img[::-1].copy()
+        return img
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean = np.asarray(mean, "float32")
+        self.std = np.asarray(std, "float32")
+
+    def __call__(self, img):
+        return (img.astype("float32") - self.mean) / self.std
+
+
+class Permute:
+    """HWC -> CHW (+ optional float conversion), reference Permute."""
+
+    def __init__(self, mode="CHW", to_rgb=True):
+        self.mode = mode
+
+    def __call__(self, img):
+        if img.ndim == 2:
+            img = img[:, :, None]
+        return np.ascontiguousarray(img.transpose(2, 0, 1))
+
+
+class GaussianNoise:
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean = mean
+        self.std = std
+
+    def __call__(self, img):
+        noise = np.random.normal(self.mean, self.std, img.shape)
+        return (img.astype("float32") + noise).astype("float32")
+
+
+class BrightnessTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        alpha = 1 + np.random.uniform(-self.value, self.value)
+        return np.clip(img.astype("float32") * alpha, 0,
+                       255 if img.dtype == np.uint8 else None) \
+            .astype(img.dtype)
+
+
+class ContrastTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        alpha = 1 + np.random.uniform(-self.value, self.value)
+        f = img.astype("float32")
+        mean = f.mean()
+        out = mean + alpha * (f - mean)
+        return np.clip(out, 0, 255 if img.dtype == np.uint8
+                       else None).astype(img.dtype)
+
+
+def _rgb_to_gray(f):
+    return (0.299 * f[..., 0] + 0.587 * f[..., 1]
+            + 0.114 * f[..., 2])[..., None]
+
+
+class SaturationTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        alpha = 1 + np.random.uniform(-self.value, self.value)
+        f = img.astype("float32")
+        gray = _rgb_to_gray(f)
+        out = gray + alpha * (f - gray)
+        return np.clip(out, 0, 255 if img.dtype == np.uint8
+                       else None).astype(img.dtype)
+
+
+class HueTransform:
+    """Channel-rotation hue jitter (reference HueTransform uses HSV;
+    the cheap YIQ rotation here matches its visual effect for small
+    values)."""
+
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        theta = np.random.uniform(-self.value, self.value) * np.pi
+        f = img.astype("float32")
+        cos, sin = np.cos(theta), np.sin(theta)
+        t_yiq = np.array([[0.299, 0.587, 0.114],
+                          [0.596, -0.274, -0.321],
+                          [0.211, -0.523, 0.311]], "float32")
+        t_rgb = np.linalg.inv(t_yiq)
+        rot = np.array([[1, 0, 0], [0, cos, -sin], [0, sin, cos]],
+                       "float32")
+        m = t_rgb @ rot @ t_yiq
+        out = f @ m.T
+        return np.clip(out, 0, 255 if img.dtype == np.uint8
+                       else None).astype(img.dtype)
+
+
+class ColorJitter:
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.transforms = [BrightnessTransform(brightness),
+                           ContrastTransform(contrast),
+                           SaturationTransform(saturation),
+                           HueTransform(hue)]
+
+    def __call__(self, img):
+        order = list(range(4))
+        _random.shuffle(order)
+        for i in order:
+            img = self.transforms[i](img)
+        return img

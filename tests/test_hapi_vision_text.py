@@ -1,0 +1,84 @@
+"""hapi.vision models/transforms + hapi.text building blocks
+(reference: incubate/hapi/vision + text test patterns: run a tiny batch
+through each model and check shapes/finite outputs)."""
+import numpy as np
+
+from paddle_tpu.fluid import dygraph
+from paddle_tpu.hapi.vision import models, transforms
+from paddle_tpu.hapi import text as htext
+
+
+def test_lenet_forward():
+    r = np.random.RandomState(0)
+    with dygraph.guard():
+        net = models.LeNet(num_classes=10)
+        x = dygraph.to_variable(r.randn(2, 1, 28, 28).astype("float32"))
+        out = net(x)
+        assert out.shape == (2, 10)
+        assert np.all(np.isfinite(out.numpy()))
+
+
+def test_mobilenet_v2_forward():
+    r = np.random.RandomState(1)
+    with dygraph.guard():
+        net = models.MobileNetV2(num_classes=7, scale=0.35)
+        x = dygraph.to_variable(r.randn(1, 3, 96, 96).astype("float32"))
+        out = net(x)
+        assert out.shape == (1, 7)
+        assert np.all(np.isfinite(out.numpy()))
+
+
+def test_mobilenet_v1_forward():
+    r = np.random.RandomState(2)
+    with dygraph.guard():
+        net = models.MobileNetV1(num_classes=5, scale=0.25)
+        x = dygraph.to_variable(r.randn(1, 3, 64, 64).astype("float32"))
+        out = net(x)
+        assert out.shape == (1, 5)
+
+
+def test_transforms_pipeline():
+    r = np.random.RandomState(3)
+    img = (r.rand(40, 60, 3) * 255).astype("uint8")
+    pipe = transforms.Compose([
+        transforms.Resize(32),
+        transforms.CenterCrop(28),
+        transforms.RandomHorizontalFlip(1.0),
+        transforms.ColorJitter(0.1, 0.1, 0.1, 0.05),
+        transforms.Normalize(mean=127.5, std=127.5),
+        transforms.Permute(),
+    ])
+    out = pipe(img)
+    assert out.shape == (3, 28, 28)
+    assert out.dtype == np.float32
+    assert -2 < out.min() and out.max() < 2
+
+    rrc = transforms.RandomResizedCrop(16)
+    assert rrc(img).shape[:2] == (16, 16)
+
+
+def test_text_cells_and_encoder():
+    r = np.random.RandomState(4)
+    with dygraph.guard():
+        # TextCNN encoder over [B, C, T]
+        enc = htext.CNNEncoder(num_channels=8, num_filters=6,
+                               filter_size=[2, 3], act="relu")
+        x = dygraph.to_variable(r.randn(2, 8, 12).astype("float32"))
+        out = enc(x)
+        assert out.shape == (2, 12)  # 6 filters x 2 branches
+
+        # BasicLSTMCell driven by the hapi RNN wrapper
+        cell = htext.BasicLSTMCell(input_size=5, hidden_size=4)
+        rnn = htext.RNN(cell)
+        seq = dygraph.to_variable(r.randn(2, 3, 5).astype("float32"))
+        h0 = dygraph.to_variable(np.zeros((2, 4), "float32"))
+        c0 = dygraph.to_variable(np.zeros((2, 4), "float32"))
+        outs, (h, c) = rnn(seq, (h0, c0))
+        assert outs.shape == (2, 3, 4)
+        assert h.shape == (2, 4) and c.shape == (2, 4)
+
+        # bidirectional wrappers delegate to nn.rnn
+        bi = htext.BidirectionalGRU(input_size=5, hidden_size=4)
+        out2 = bi(seq)
+        got = out2[0] if isinstance(out2, tuple) else out2
+        assert got.shape[-1] == 8
