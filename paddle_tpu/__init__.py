@@ -30,6 +30,19 @@ from .fluid import regularizer  # noqa: F401
 from .fluid import metrics  # noqa: F401
 
 from . import distributed  # noqa: F401
+from . import framework  # noqa: F401
+from . import imperative  # noqa: F401
+from . import metric  # noqa: F401
+from . import reader  # noqa: F401
+from . import dataset  # noqa: F401
+from . import compat  # noqa: F401
+from . import sysconfig  # noqa: F401
+from . import static  # noqa: F401
+from . import jit  # noqa: F401
+from .batch import batch  # noqa: F401
+from . import fleet  # noqa: F401
+from .incubate import complex  # noqa: F401
+from .framework.random import manual_seed  # noqa: F401
 from . import inference  # noqa: F401
 from . import parallel  # noqa: F401
 from . import nn  # noqa: F401

@@ -1,0 +1,40 @@
+"""paddle.compat (reference: `python/paddle/compat.py`): py2/py3 text
+shims kept for API compatibility."""
+from __future__ import annotations
+
+__all__ = ["to_text", "to_bytes", "round", "floor_division",
+           "get_exception_message"]
+
+import math as _math
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    if isinstance(obj, (list, set, tuple)):
+        t = type(obj)
+        return t(to_text(o, encoding) for o in obj)
+    if isinstance(obj, bytes):
+        return obj.decode(encoding)
+    return str(obj) if not isinstance(obj, str) else obj
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    if isinstance(obj, (list, set, tuple)):
+        t = type(obj)
+        return t(to_bytes(o, encoding) for o in obj)
+    if isinstance(obj, str):
+        return obj.encode(encoding)
+    return bytes(obj) if not isinstance(obj, bytes) else obj
+
+
+def round(x, d=0):
+    import builtins
+
+    return builtins.round(x, d)
+
+
+def floor_division(x, y):
+    return _math.floor(x / y)
+
+
+def get_exception_message(exc):
+    return str(exc)

@@ -338,6 +338,7 @@ def _trace_lib():
         lib.ptq_trace_name_id.argtypes = [ctypes.c_char_p]
         lib.ptq_trace_record.argtypes = [i32, i32, i64, i64]
         lib.ptq_trace_count.restype = i64
+        lib.ptq_trace_dropped.restype = i64
         lib.ptq_trace_export.restype = ctypes.c_int
         lib.ptq_trace_export.argtypes = [ctypes.c_char_p,
                                          ctypes.c_char_p]
@@ -371,13 +372,25 @@ class NativeTrace:
         return _trace_lib().ptq_trace_count()
 
     @staticmethod
+    def dropped() -> int:
+        """Events discarded beyond the store cap (truncated trace)."""
+        return _trace_lib().ptq_trace_dropped()
+
+    @staticmethod
     def reset():
         _trace_lib().ptq_trace_reset()
 
     @staticmethod
     def export(path: str, process_name="paddle_tpu") -> int:
-        return _trace_lib().ptq_trace_export(path.encode(),
-                                             process_name.encode())
+        lib = _trace_lib()
+        if lib.ptq_trace_dropped() > 0:
+            import warnings
+
+            warnings.warn(
+                "trace store overflowed: %d events were dropped; the "
+                "exported trace is truncated"
+                % lib.ptq_trace_dropped())
+        return lib.ptq_trace_export(path.encode(), process_name.encode())
 
     @staticmethod
     def stats():

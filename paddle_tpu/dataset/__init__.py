@@ -1,0 +1,14 @@
+"""paddle.dataset (reference: `python/paddle/dataset/` — mnist, cifar,
+imdb, imikolov, uci_housing, ... loaders exposed as reader creators).
+
+Zero-egress build: loaders read the reference on-disk formats from
+`~/.cache/paddle_tpu/dataset/<name>/` when files are present and
+otherwise fall back to DETERMINISTIC synthetic data with the same
+shapes/dtypes/vocabulary contract, so pipelines and tests run without
+downloads (the reference downloads from paddle's CDN at import time)."""
+from . import common  # noqa: F401
+from . import mnist  # noqa: F401
+from . import cifar  # noqa: F401
+from . import uci_housing  # noqa: F401
+from . import imdb  # noqa: F401
+from . import imikolov  # noqa: F401

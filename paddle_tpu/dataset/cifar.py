@@ -1,0 +1,47 @@
+"""CIFAR reader creators (reference: `python/paddle/dataset/cifar.py`
+train10/test10/train100/test100 yielding (3072-float image in [0,1],
+int label)); synthetic fallback keeps the contract without downloads."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train10", "test10", "train100", "test100"]
+
+
+def _synthetic(n, n_classes, seed):
+    r = np.random.RandomState(seed)
+    labels = r.randint(0, n_classes, n).astype("int64")
+    base = np.linspace(0, 1, 3072, dtype="float32")
+
+    def img(lbl, i):
+        rr = np.random.RandomState(int(lbl))
+        hue = rr.rand(3072).astype("float32")
+        noise = np.random.RandomState(seed + i).rand(3072) * 0.2
+        return np.clip(0.6 * hue + 0.3 * base + noise, 0, 1) \
+            .astype("float32")
+
+    for i, lbl in enumerate(labels):
+        yield img(lbl, i), int(lbl)
+
+
+def _creator(n, n_classes, seed):
+    def reader():
+        return _synthetic(n, n_classes, seed)
+
+    return reader
+
+
+def train10():
+    return _creator(1024, 10, 0)
+
+
+def test10():
+    return _creator(256, 10, 1)
+
+
+def train100():
+    return _creator(1024, 100, 2)
+
+
+def test100():
+    return _creator(256, 100, 3)

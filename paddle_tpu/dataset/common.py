@@ -1,0 +1,41 @@
+"""Dataset commons (reference: `python/paddle/dataset/common.py` —
+DATA_HOME, md5file, cached download paths). Zero-egress: `download`
+only resolves already-cached files and raises with instructions
+otherwise."""
+from __future__ import annotations
+
+import hashlib
+import os
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+
+
+def md5file(fname):
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def cache_path(module_name, filename):
+    d = os.path.join(DATA_HOME, module_name)
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, filename)
+
+
+def download(url, module_name, md5sum=None, save_name=None):
+    """Resolve a dataset file from the local cache. This build has no
+    network egress: if the file is absent, raise with the cache path so
+    the user can place it there (loaders fall back to synthetic data
+    before calling this)."""
+    filename = save_name or url.split("/")[-1]
+    path = cache_path(module_name, filename)
+    if os.path.exists(path):
+        if md5sum and md5file(path) != md5sum:
+            raise IOError("md5 mismatch for %s" % path)
+        return path
+    raise IOError(
+        "dataset file %r is not cached and downloads are disabled; place "
+        "it at %s" % (filename, path))

@@ -1,0 +1,35 @@
+"""IMDB sentiment reader creators (reference:
+`python/paddle/dataset/imdb.py`: word_dict() + train/test yielding
+(token-id list, 0/1 label)). Synthetic corpus with a class-correlated
+vocabulary keeps the contract without downloads."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["word_dict", "train", "test"]
+
+_VOCAB = 5149  # reference vocabulary size ballpark
+
+
+def word_dict():
+    return {("w%d" % i).encode(): i for i in range(_VOCAB)}
+
+
+def _gen(n, seed):
+    r = np.random.RandomState(seed)
+    pos_words = np.arange(10, _VOCAB // 2)
+    neg_words = np.arange(_VOCAB // 2, _VOCAB - 10)
+    for _ in range(n):
+        label = int(r.randint(0, 2))
+        pool = pos_words if label == 0 else neg_words
+        length = int(r.randint(8, 64))
+        ids = r.choice(pool, length).tolist()
+        yield ids, label
+
+
+def train(word_idx=None):
+    return lambda: _gen(512, 0)
+
+
+def test(word_idx=None):
+    return lambda: _gen(128, 1)

@@ -1,0 +1,34 @@
+"""imikolov (PTB) n-gram reader creators (reference:
+`python/paddle/dataset/imikolov.py`: build_dict + train/test yielding
+n-gram id tuples for word2vec). Synthetic Zipf text keeps the
+contract."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["build_dict", "train", "test"]
+
+_VOCAB = 2074
+
+
+def build_dict(min_word_freq=50):
+    return {("w%d" % i): i for i in range(_VOCAB)}
+
+
+def _gen(n_sent, n, seed):
+    r = np.random.RandomState(seed)
+    # Zipf-ish id stream: frequent low ids, like real text
+    for _ in range(n_sent):
+        length = int(r.randint(n + 1, 24))
+        ids = np.minimum(
+            r.zipf(1.3, length) - 1, _VOCAB - 1).astype(int).tolist()
+        for i in range(len(ids) - n + 1):
+            yield tuple(ids[i:i + n])
+
+
+def train(word_idx=None, n=5):
+    return lambda: _gen(256, n, 0)
+
+
+def test(word_idx=None, n=5):
+    return lambda: _gen(64, n, 1)

@@ -1,0 +1,84 @@
+"""MNIST reader creators (reference: `python/paddle/dataset/mnist.py`
+train()/test() yielding (784-float image in [-1,1], int label)). Reads
+idx files from the cache when present, else deterministic synthetic
+digits."""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test"]
+
+_N_SYN_TRAIN = 1024
+_N_SYN_TEST = 256
+
+
+def _read_idx_images(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        assert magic == 2051, path
+        data = np.frombuffer(f.read(n * rows * cols), np.uint8)
+    return data.reshape(n, rows * cols)
+
+
+def _read_idx_labels(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        assert magic == 2049, path
+        return np.frombuffer(f.read(n), np.uint8)
+
+
+def _cached(kind):
+    names = {
+        "train": ("train-images-idx3-ubyte.gz",
+                  "train-labels-idx1-ubyte.gz"),
+        "test": ("t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz"),
+    }[kind]
+    paths = [os.path.join(common.DATA_HOME, "mnist", n) for n in names]
+    alt = [p[:-3] for p in paths]  # non-gz variants
+    for cand in (paths, alt):
+        if all(os.path.exists(p) for p in cand):
+            return cand
+    return None
+
+
+def _synthetic(n, seed):
+    """Deterministic stand-in digits: blurred class-dependent strokes."""
+    r = np.random.RandomState(seed)
+    labels = r.randint(0, 10, n).astype("int64")
+    imgs = np.zeros((n, 28, 28), "float32")
+    for i, lbl in enumerate(labels):
+        rr = np.random.RandomState(1000 + int(lbl))
+        base = rr.rand(28, 28) > 0.82
+        imgs[i] = base * (0.6 + 0.4 * r.rand(28, 28))
+    return imgs.reshape(n, 784) * 2.0 - 1.0, labels
+
+
+def _creator(kind, n_syn, seed):
+    def reader():
+        cached = _cached(kind)
+        if cached is not None:
+            imgs = _read_idx_images(cached[0]).astype("float32")
+            imgs = imgs / 127.5 - 1.0
+            labels = _read_idx_labels(cached[1]).astype("int64")
+        else:
+            imgs, labels = _synthetic(n_syn, seed)
+        for img, lbl in zip(imgs, labels):
+            yield img, int(lbl)
+
+    return reader
+
+
+def train():
+    return _creator("train", _N_SYN_TRAIN, 0)
+
+
+def test():
+    return _creator("test", _N_SYN_TEST, 1)

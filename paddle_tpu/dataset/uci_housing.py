@@ -1,0 +1,32 @@
+"""UCI housing reader creators (reference:
+`python/paddle/dataset/uci_housing.py`: 13 normalized features +
+target). Deterministic synthetic regression data with a fixed linear
+ground truth keeps the fit/eval contract."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "feature_names"]
+
+feature_names = [
+    "CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE", "DIS", "RAD",
+    "TAX", "PTRATIO", "B", "LSTAT",
+]
+
+_W = np.linspace(-1.0, 1.0, 13).astype("float32")
+
+
+def _gen(n, seed):
+    r = np.random.RandomState(seed)
+    x = r.randn(n, 13).astype("float32")
+    y = x @ _W + 0.1 * r.randn(n).astype("float32")
+    for i in range(n):
+        yield x[i], np.asarray([y[i]], "float32")
+
+
+def train():
+    return lambda: _gen(404, 0)
+
+
+def test():
+    return lambda: _gen(102, 1)

@@ -1,0 +1,19 @@
+"""paddle.framework 2.0 namespace (reference:
+`python/paddle/framework/__init__.py`) — re-exports + seeding."""
+from ..fluid.executor import Executor  # noqa: F401
+from ..core.scope import global_scope  # noqa: F401
+from ..fluid.backward import append_backward, gradients  # noqa: F401
+from ..fluid.compiler import CompiledProgram  # noqa: F401
+from ..fluid.framework import (  # noqa: F401
+    default_main_program, default_startup_program, name_scope, Program,
+    program_guard, Variable,
+)
+from ..fluid.param_attr import ParamAttr  # noqa: F401
+from ..fluid.layers.tensor import (  # noqa: F401
+    create_global_var, create_parameter,
+)
+from ..core.place import (  # noqa: F401
+    CPUPlace, CUDAPlace, CUDAPinnedPlace, TPUPlace,
+)
+from . import random  # noqa: F401
+from .random import manual_seed  # noqa: F401

@@ -1,0 +1,18 @@
+"""Seeding (reference: `python/paddle/framework/random.py` manual_seed
+sets the global program RNG seed)."""
+from ..utils import flags as _flags
+
+__all__ = ["manual_seed"]
+
+
+def manual_seed(seed):
+    """Set the framework-wide RNG seed (dropout/init streams derive from
+    it; reference manual_seed sets Program.random_seed)."""
+    _flags.set_flags({"FLAGS_seed": int(seed)})
+    from ..fluid import framework as _fw
+
+    for prog in (_fw.default_main_program(),
+                 _fw.default_startup_program()):
+        if prog is not None:
+            prog.random_seed = int(seed)
+    return seed

@@ -206,32 +206,76 @@ class CNNEncoder(Layer):
             else [num_filters] * len(sizes)
         self.branches = []
         for i, (c, f, k) in enumerate(zip(chans, filts, sizes)):
-            br = Conv1dPoolLayer(c, f, k, pool_size,
-                                 conv_stride=conv_stride,
-                                 pool_stride=pool_stride, act=act,
-                                 pool_type=pool_type,
-                                 global_pooling=global_pooling)
-            self.add_sublayer("branch_%d" % i, br)
-            self.branches.append(br)
+            stack = []
+            c_in = c
+            # num_layers stacks convs before the (optionally global)
+            # pool, like the reference's layered Conv1dPoolLayer chains
+            for layer in range(num_layers):
+                last = layer == num_layers - 1
+                br = Conv1dPoolLayer(
+                    c_in, f, k, pool_size,
+                    conv_stride=conv_stride,
+                    pool_stride=pool_stride if last else 1,
+                    conv_padding=(0 if last else k // 2), act=act,
+                    pool_type=pool_type,
+                    global_pooling=global_pooling and last)
+                self.add_sublayer("branch_%d_%d" % (i, layer), br)
+                stack.append(br)
+                c_in = f
+            self.branches.append(stack)
 
     def forward(self, x):
         from ..fluid.layers import tensor as T
 
-        outs = [br(x) for br in self.branches]
+        outs = []
+        for stack in self.branches:
+            h = x
+            for br in stack:
+                h = br(h)
+            outs.append(h)
         return T.concat(outs, axis=1) if len(outs) > 1 else outs[0]
 
 
 class DynamicDecode(Layer):
-    """reference text.py:1762 — Layer wrapper over dynamic_decode."""
+    """reference text.py:1762 — Layer wrapper over dynamic_decode.
+    Decoding unrolls to max_step_num (static shapes under XLA), so a
+    None max_step_num is rejected rather than silently capped."""
 
     def __init__(self, decoder, max_step_num=None, output_time_major=False,
                  impute_finished=False, is_test=False,
                  return_length=False):
         super().__init__()
+        if max_step_num is None:
+            raise ValueError(
+                "DynamicDecode needs an explicit max_step_num: decoding "
+                "unrolls to a static step count under XLA")
+        if impute_finished:
+            raise NotImplementedError(
+                "impute_finished is not supported; finished beams carry "
+                "their end token (gather_tree finalization)")
         self.decoder = decoder
         self.max_step_num = max_step_num
+        self.output_time_major = output_time_major
+        self.return_length = return_length
 
     def forward(self, inits=None, **kwargs):
-        return dynamic_decode(self.decoder, inits=inits,
-                              max_step_num=self.max_step_num or 64,
-                              **kwargs)
+        out = dynamic_decode(self.decoder, inits=inits,
+                             max_step_num=self.max_step_num, **kwargs)
+        ids, scores = out if isinstance(out, tuple) else (out, None)
+        if self.output_time_major:
+            from ..fluid.layers import tensor as T
+
+            perm = list(range(ids.ndim))
+            perm[0], perm[1] = perm[1], perm[0]
+            ids = T.transpose(ids, perm)
+        if self.return_length:
+            from ..fluid.layers import nn as N
+            from ..fluid.layers import tensor as T
+
+            end_id = getattr(self.decoder, "end_token", 1)
+            lengths = N.reduce_sum(T.cast(
+                N.logical_not(N.equal(
+                    ids, T.fill_constant([1], "int64", end_id))),
+                "int64"), dim=-1)
+            return ids, scores, lengths
+        return (ids, scores) if scores is not None else ids

@@ -132,14 +132,21 @@ class Normalize:
 
 
 class Permute:
-    """HWC -> CHW (+ optional float conversion), reference Permute."""
+    """HWC -> CHW with optional BGR->RGB flip (reference Permute:
+    to_rgb=True reverses the channel order of 3-channel input)."""
 
     def __init__(self, mode="CHW", to_rgb=True):
+        if mode != "CHW":
+            raise ValueError("Permute only supports mode='CHW', got %r"
+                             % mode)
         self.mode = mode
+        self.to_rgb = to_rgb
 
     def __call__(self, img):
         if img.ndim == 2:
             img = img[:, :, None]
+        if self.to_rgb and img.shape[-1] == 3:
+            img = img[:, :, ::-1]
         return np.ascontiguousarray(img.transpose(2, 0, 1))
 
 
@@ -191,8 +198,8 @@ class SaturationTransform:
         self.value = value
 
     def __call__(self, img):
-        if self.value == 0:
-            return img
+        if self.value == 0 or img.ndim != 3 or img.shape[-1] != 3:
+            return img   # saturation is undefined for grayscale
         alpha = 1 + np.random.uniform(-self.value, self.value)
         f = img.astype("float32")
         gray = _rgb_to_gray(f)
@@ -210,8 +217,8 @@ class HueTransform:
         self.value = value
 
     def __call__(self, img):
-        if self.value == 0:
-            return img
+        if self.value == 0 or img.ndim != 3 or img.shape[-1] != 3:
+            return img   # hue rotation needs RGB channels
         theta = np.random.uniform(-self.value, self.value) * np.pi
         f = img.astype("float32")
         cos, sin = np.cos(theta), np.sin(theta)

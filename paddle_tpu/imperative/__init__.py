@@ -1,0 +1,16 @@
+"""paddle.imperative 2.0 namespace (reference:
+`python/paddle/imperative/__init__.py`) — dygraph re-exports."""
+from ..fluid.dygraph.base import (  # noqa: F401
+    guard, no_grad, to_variable, grad,
+)
+from ..fluid.framework import in_dygraph_mode as enabled  # noqa: F401
+from ..fluid.dygraph.checkpoint import (  # noqa: F401
+    load_dygraph as load, save_dygraph as save,
+)
+from ..fluid.dygraph.parallel import (  # noqa: F401
+    ParallelEnv, DataParallel, prepare_context,
+)
+from ..fluid.dygraph.jit import TracedLayer, declarative  # noqa: F401
+from ..fluid.dygraph.dygraph_to_static.program_translator import (  # noqa: F401,E501
+    ProgramTranslator,
+)

@@ -1,0 +1,15 @@
+"""paddle.metric 2.0 namespace (reference:
+`python/paddle/metric/`): streaming metric classes shared with
+fluid.metrics plus the hapi metric protocol."""
+from ..fluid.metrics import (  # noqa: F401
+    MetricBase, Accuracy, Precision, Recall, Auc, CompositeMetric,
+    ChunkEvaluator, EditDistance,
+)
+from ..hapi.metrics import Metric  # noqa: F401
+
+
+def accuracy(input, label, k=1):
+    """Functional accuracy (reference metric/metrics.py accuracy)."""
+    from ..fluid.layers import nn as N
+
+    return N.accuracy(input, label, k=k)

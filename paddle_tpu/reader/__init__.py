@@ -1,0 +1,193 @@
+"""Classic reader decorators (reference:
+`python/paddle/reader/decorator.py`): composable generators feeding the
+data pipeline. Host-side pure python — identical semantics."""
+from __future__ import annotations
+
+import itertools
+import random as _random
+from queue import Queue
+from threading import Thread
+
+__all__ = [
+    "cache", "map_readers", "buffered", "compose", "chain", "shuffle",
+    "firstn", "xmap_readers", "multiprocess_reader",
+]
+
+
+def cache(reader):
+    """Materialize the reader once; replay from memory afterwards."""
+    all_data = tuple(reader())
+
+    def __impl__():
+        for item in all_data:
+            yield item
+
+    return __impl__
+
+
+def map_readers(func, *readers):
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            for b in buf:
+                yield b
+
+    return data_reader
+
+
+def chain(*readers):
+    def reader():
+        for r in readers:
+            for e in r():
+                yield e
+
+    return reader
+
+
+def compose(*readers, **kwargs):
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        if isinstance(x, tuple):
+            return x
+        return (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if check_alignment:
+            for outputs in zip(*rs):
+                yield sum(list(map(make_tuple, outputs)), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                yield sum((make_tuple(o) for o in outputs
+                           if o is not None), ())
+
+    return reader
+
+
+def buffered(reader, size):
+    """Prefetch up to `size` items on a background thread."""
+
+    class _End:
+        pass
+
+    def data_reader():
+        r = reader()
+        q = Queue(maxsize=size)
+
+        def feed():
+            for d in r:
+                q.put(d)
+            q.put(_End)
+
+        t = Thread(target=feed)
+        t.daemon = True
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                break
+            yield e
+
+    return data_reader
+
+
+def firstn(reader, n):
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                break
+            yield item
+
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over a reader with worker threads (reference
+    xmap_readers; threads, not processes — the mappers here are numpy
+    transforms that release the GIL)."""
+
+    class _End:
+        pass
+
+    def data_reader():
+        in_q = Queue(buffer_size)
+        out_q = Queue(buffer_size)
+
+        def feed():
+            for i, d in enumerate(reader()):
+                in_q.put((i, d))
+            for _ in range(process_num):
+                in_q.put(_End)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is _End:
+                    out_q.put(_End)
+                    break
+                i, d = item
+                out_q.put((i, mapper(d)))
+
+        Thread(target=feed, daemon=True).start()
+        workers = [Thread(target=work, daemon=True)
+                   for _ in range(process_num)]
+        for w in workers:
+            w.start()
+        finished = 0
+        pending = {}
+        next_idx = 0
+        while finished < process_num:
+            item = out_q.get()
+            if item is _End:
+                finished += 1
+                continue
+            i, d = item
+            if not order:
+                yield d
+            else:
+                pending[i] = d
+                while next_idx in pending:
+                    yield pending.pop(next_idx)
+                    next_idx += 1
+        for i in sorted(pending):
+            yield pending[i]
+
+    return data_reader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Round-robin merge of multiple readers on threads (the reference
+    forks processes; mappers here are IO/numpy-bound so threads match
+    throughput without fork hazards under a live TPU client)."""
+
+    def reader():
+        its = [r() for r in readers]
+        alive = [True] * len(its)
+        while any(alive):
+            for i, it in enumerate(its):
+                if not alive[i]:
+                    continue
+                try:
+                    yield next(it)
+                except StopIteration:
+                    alive[i] = False
+
+    return reader

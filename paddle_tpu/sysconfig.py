@@ -1,0 +1,16 @@
+"""paddle.sysconfig (reference: `python/paddle/sysconfig.py`)."""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    """C header directory of the native runtime (capi.h)."""
+    return os.path.join(_ROOT, "core", "native", "src")
+
+
+def get_lib():
+    """Directory containing libpaddle_tpu_native.so."""
+    return os.path.join(_ROOT, "core", "native")

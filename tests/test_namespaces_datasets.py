@@ -1,0 +1,97 @@
+"""Top-level 2.0/classic namespace parity (reference:
+`python/paddle/__init__.py` module list) + classic reader/dataset
+behavior."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def test_top_level_namespaces():
+    for ns in ["reader", "dataset", "distributed", "tensor", "nn",
+               "fleet", "framework", "imperative", "optimizer", "metric",
+               "complex", "compat", "sysconfig", "static", "jit",
+               "incubate", "hapi"]:
+        assert hasattr(paddle, ns), ns
+    assert callable(paddle.batch)
+    assert callable(paddle.manual_seed)
+
+
+def test_reader_decorators():
+    base = lambda: iter(range(10))  # noqa: E731
+    assert list(paddle.reader.firstn(base, 3)()) == [0, 1, 2]
+    assert sorted(paddle.reader.shuffle(base, 5)()) == list(range(10))
+    assert list(paddle.reader.map_readers(
+        lambda a, b: a + b, base, base)()) == [2 * i for i in range(10)]
+    assert list(paddle.reader.chain(base, base)()) == \
+        list(range(10)) * 2
+    assert list(paddle.reader.buffered(base, 4)()) == list(range(10))
+    cached = paddle.reader.cache(base)
+    assert list(cached()) == list(range(10)) == list(cached())
+    mapped = paddle.reader.xmap_readers(lambda x: x * 3, base, 2, 4,
+                                        order=True)
+    assert list(mapped()) == [3 * i for i in range(10)]
+
+
+def test_batch():
+    batches = list(paddle.batch(lambda: iter(range(7)), 3)())
+    assert batches == [[0, 1, 2], [3, 4, 5], [6]]
+    batches = list(paddle.batch(lambda: iter(range(7)), 3,
+                                drop_last=True)())
+    assert batches == [[0, 1, 2], [3, 4, 5]]
+
+
+def test_dataset_mnist_contract():
+    r = paddle.dataset.mnist.train()
+    img, lbl = next(iter(r()))
+    assert img.shape == (784,) and img.dtype == np.float32
+    assert -1.0 <= img.min() and img.max() <= 1.0
+    assert 0 <= lbl < 10
+    # deterministic across instantiations
+    img2, lbl2 = next(iter(paddle.dataset.mnist.train()()))
+    np.testing.assert_array_equal(img, img2)
+    assert lbl == lbl2
+
+
+def test_dataset_uci_and_imdb():
+    x, y = next(iter(paddle.dataset.uci_housing.train()()))
+    assert x.shape == (13,) and y.shape == (1,)
+    ids, label = next(iter(paddle.dataset.imdb.train()()))
+    assert isinstance(ids, list) and label in (0, 1)
+    wd = paddle.dataset.imdb.word_dict()
+    assert len(wd) > 1000
+    grams = list(paddle.dataset.imikolov.train(n=5)())
+    assert all(len(g) == 5 for g in grams[:10])
+
+
+def test_uci_housing_trains():
+    """End-to-end: classic reader+batch feeding a static regression."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework
+
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data("x", shape=[13], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="float32")
+            pred = fluid.layers.fc(x, 1)
+            loss = fluid.layers.mean(
+                fluid.layers.loss.square_error_cost(pred, y))
+            fluid.optimizer.SGDOptimizer(0.01).minimize(loss)
+            exe = fluid.Executor()
+            exe.run(startup)
+            reader = paddle.batch(paddle.dataset.uci_housing.train(), 64)
+            losses = []
+            for epoch in range(3):
+                for batch in reader():
+                    xs = np.stack([b[0] for b in batch])
+                    ys = np.stack([b[1] for b in batch])
+                    out = exe.run(main, feed={"x": xs, "y": ys},
+                                  fetch_list=[loss])
+                losses.append(float(np.asarray(out[0]).ravel()[0]))
+    assert losses[-1] < losses[0]
+
+
+def test_metric_namespace():
+    m = paddle.metric.Accuracy()
+    assert hasattr(m, "update") or hasattr(m, "eval")
+    assert paddle.metric.Auc is not None
