@@ -27,13 +27,18 @@ def to_bytes(obj, encoding="utf-8", inplace=False):
 
 
 def round(x, d=0):
-    import builtins
-
-    return builtins.round(x, d)
+    """py2-style half-away-from-zero rounding returning float
+    (reference compat.py round)."""
+    p = 10 ** d
+    if x > 0:
+        return float(_math.floor((x * p) + 0.5)) / p
+    if x < 0:
+        return float(_math.ceil((x * p) - 0.5)) / p
+    return 0.0
 
 
 def floor_division(x, y):
-    return _math.floor(x / y)
+    return x // y
 
 
 def get_exception_message(exc):

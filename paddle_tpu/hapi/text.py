@@ -259,23 +259,12 @@ class DynamicDecode(Layer):
         self.return_length = return_length
 
     def forward(self, inits=None, **kwargs):
-        out = dynamic_decode(self.decoder, inits=inits,
-                             max_step_num=self.max_step_num, **kwargs)
-        ids, scores = out if isinstance(out, tuple) else (out, None)
-        if self.output_time_major:
-            from ..fluid.layers import tensor as T
-
-            perm = list(range(ids.ndim))
-            perm[0], perm[1] = perm[1], perm[0]
-            ids = T.transpose(ids, perm)
-        if self.return_length:
-            from ..fluid.layers import nn as N
-            from ..fluid.layers import tensor as T
-
-            end_id = getattr(self.decoder, "end_token", 1)
-            lengths = N.reduce_sum(T.cast(
-                N.logical_not(N.equal(
-                    ids, T.fill_constant([1], "int64", end_id))),
-                "int64"), dim=-1)
-            return ids, scores, lengths
-        return (ids, scores) if scores is not None else ids
+        # dynamic_decode natively supports both flags; constructor args
+        # win over accidental duplicates in **kwargs
+        kwargs.pop("output_time_major", None)
+        kwargs.pop("return_length", None)
+        return dynamic_decode(self.decoder, inits=inits,
+                              max_step_num=self.max_step_num,
+                              output_time_major=self.output_time_major,
+                              return_length=self.return_length,
+                              **kwargs)

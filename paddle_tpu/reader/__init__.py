@@ -91,11 +91,16 @@ def buffered(reader, size):
     def data_reader():
         r = reader()
         q = Queue(maxsize=size)
+        err = []
 
         def feed():
-            for d in r:
-                q.put(d)
-            q.put(_End)
+            try:
+                for d in r:
+                    q.put(d)
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                err.append(e)
+            finally:
+                q.put(_End)
 
         t = Thread(target=feed)
         t.daemon = True
@@ -105,6 +110,8 @@ def buffered(reader, size):
             if e is _End:
                 break
             yield e
+        if err:
+            raise err[0]
 
     return data_reader
 
@@ -131,20 +138,30 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         in_q = Queue(buffer_size)
         out_q = Queue(buffer_size)
 
+        errs = []
+
         def feed():
-            for i, d in enumerate(reader()):
-                in_q.put((i, d))
-            for _ in range(process_num):
-                in_q.put(_End)
+            try:
+                for i, d in enumerate(reader()):
+                    in_q.put((i, d))
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                errs.append(e)
+            finally:
+                for _ in range(process_num):
+                    in_q.put(_End)
 
         def work():
-            while True:
-                item = in_q.get()
-                if item is _End:
-                    out_q.put(_End)
-                    break
-                i, d = item
-                out_q.put((i, mapper(d)))
+            try:
+                while True:
+                    item = in_q.get()
+                    if item is _End:
+                        break
+                    i, d = item
+                    out_q.put((i, mapper(d)))
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                errs.append(e)
+            finally:
+                out_q.put(_End)
 
         Thread(target=feed, daemon=True).start()
         workers = [Thread(target=work, daemon=True)
@@ -169,6 +186,8 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                     next_idx += 1
         for i in sorted(pending):
             yield pending[i]
+        if errs:
+            raise errs[0]
 
     return data_reader
 

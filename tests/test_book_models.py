@@ -1,0 +1,131 @@
+"""End-to-end book-style model tests (reference:
+`python/paddle/fluid/tests/book/` — word2vec over imikolov n-grams,
+SE-block image classifier; the transformer beam-search decode round
+trip lives in test_models.py): train real small models via the public API and assert the
+loss drops / decode round-trips."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework
+
+
+def test_word2vec_trains():
+    """reference book/test_word2vec.py: n-gram embedding concat + fc."""
+    n = 5
+    emb_dim = 16
+    vocab = 200
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            words = [fluid.layers.data("w%d" % i, shape=[1],
+                                       dtype="int64")
+                     for i in range(n)]
+            embs = [fluid.layers.embedding(
+                w, size=[vocab, emb_dim],
+                param_attr=fluid.ParamAttr(name="shared_emb"))
+                for w in words[:-1]]
+            concat = fluid.layers.tensor.concat(embs, axis=1)
+            hidden = fluid.layers.fc(concat, 64, act="sigmoid")
+            logits = fluid.layers.fc(hidden, vocab)
+            loss = fluid.layers.mean(
+                fluid.layers.loss.softmax_with_cross_entropy(
+                    logits, words[-1]))
+            fluid.optimizer.AdamOptimizer(1e-2).minimize(loss)
+            exe = fluid.Executor()
+            exe.run(startup)
+
+            grams = [g for g in paddle.dataset.imikolov.train(n=n)()
+                     if max(g) < vocab][:256]
+            arr = np.asarray(grams, "int64")
+            feed = {("w%d" % i): arr[:, i:i + 1] for i in range(n)}
+            losses = []
+            for _ in range(15):
+                out = exe.run(main, feed=feed, fetch_list=[loss])
+                losses.append(float(np.asarray(out[0]).ravel()[0]))
+    assert losses[-1] < losses[0] * 0.9, (losses[0], losses[-1])
+
+
+def test_se_block_classifier_trains():
+    """SE-ResNeXt-style squeeze-excitation block (reference
+    book/test_image_classification + dist_se_resnext.py): conv -> SE
+    gate -> fc, trained a few steps."""
+    r = np.random.RandomState(1)
+    feats = r.randn(8, 3, 16, 16).astype("float32")
+    labels = r.randint(0, 4, (8, 1)).astype("int64")
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            img = fluid.layers.data("img", shape=[3, 16, 16],
+                                    dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="int64")
+            conv = fluid.layers.conv2d(img, 8, 3, padding=1, act="relu")
+            # squeeze-excitation: GAP -> fc(reduce) -> fc(expand) ->
+            # sigmoid channel gate
+            squeeze = fluid.layers.pool2d(conv, pool_size=16,
+                                          pool_type="avg")
+            sq = fluid.layers.fc(squeeze, 4, act="relu")
+            ex = fluid.layers.fc(sq, 8, act="sigmoid")
+            ex4 = fluid.layers.unsqueeze(
+                fluid.layers.unsqueeze(ex, [2]), [3])
+            gated = fluid.layers.elementwise_mul(conv, ex4)
+            pooled = fluid.layers.pool2d(gated, pool_size=16,
+                                         pool_type="avg")
+            logits = fluid.layers.fc(pooled, 4)
+            loss = fluid.layers.mean(
+                fluid.layers.loss.softmax_with_cross_entropy(logits, y))
+            fluid.optimizer.AdamOptimizer(5e-3).minimize(loss)
+            exe = fluid.Executor()
+            exe.run(startup)
+            losses = []
+            for _ in range(12):
+                out = exe.run(main, feed={"img": feats, "y": labels},
+                              fetch_list=[loss])
+                losses.append(float(np.asarray(out[0]).ravel()[0]))
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+def test_public_api_surface_locked():
+    """API conformance lock (reference §4.7: API.spec +
+    check_api_approvals.sh): the core public surface must keep these
+    names; removals break users and must be deliberate."""
+    core = {
+        "paddle_tpu": [
+            "CPUPlace", "TPUPlace", "CUDAPlace", "Program",
+            "program_guard", "Executor", "ParamAttr", "to_variable",
+            "no_grad", "grad", "nn", "tensor", "optimizer",
+            "distributed", "fleet", "static", "jit", "metric",
+            "reader", "dataset", "batch", "manual_seed", "Model",
+        ],
+        "paddle_tpu.fluid": [
+            "layers", "optimizer", "initializer", "regularizer", "clip",
+            "io", "metrics", "dygraph", "Executor", "CompiledProgram",
+            "DataFeeder", "ParamAttr", "default_main_program",
+            "default_startup_program",
+        ],
+        "paddle_tpu.fluid.layers": [
+            "fc", "conv2d", "conv3d", "batch_norm", "layer_norm",
+            "embedding", "dynamic_lstm", "dynamic_gru", "warpctc",
+            "linear_chain_crf", "crf_decoding", "nce", "hsigmoid",
+            "prior_box", "ssd_loss", "multiclass_nms", "roi_align",
+            "yolov3_loss", "interpolate", "resize_bilinear", "pool2d",
+            "pool3d", "softmax_with_cross_entropy", "cross_entropy",
+            "While", "while_loop", "cond", "case", "switch_case",
+            "beam_search", "dynamic_decode", "py_func",
+        ],
+        "paddle_tpu.nn": [
+            "Layer", "Linear", "Conv2D", "Conv3D", "BatchNorm",
+            "LayerNorm", "Embedding", "CrossEntropyLoss", "MSELoss",
+            "BCELoss", "NLLLoss", "HSigmoid", "Pad2D", "UpSample",
+            "functional", "initializer", "beam_search", "gather_tree",
+        ],
+    }
+    import importlib
+
+    missing = []
+    for mod_name, names in core.items():
+        mod = importlib.import_module(mod_name)
+        for n in names:
+            if not hasattr(mod, n):
+                missing.append("%s.%s" % (mod_name, n))
+    assert not missing, missing
