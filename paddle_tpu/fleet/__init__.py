@@ -196,14 +196,29 @@ class CollectiveOptimizer:
             warnings.warn("pipeline mode: fleet data-parallel transpile "
                           "skipped (pipeline engine owns the mesh).")
         else:
+            dgc_cfg = None
+            if getattr(st, "dgc", False):
+                cfgs = getattr(st, "dgc_configs", {}) or {}
+                sp = cfgs.get("sparsity", 0.75)
+                if isinstance(sp, (list, tuple)):
+                    sp = sp[-1]   # reference passes a rampup list
+                dgc_cfg = {
+                    "momentum": getattr(self._optimizer, "_momentum",
+                                        0.9),
+                    "sparsity": float(sp),
+                    "rampup_begin_step": float(
+                        cfgs.get("rampup_begin_step", 0)),
+                }
             transpile_collective(
                 loss.block.program,
                 k_steps_localsgd=(st.localsgd_configs["k_steps"]
-                                  if st.localsgd else 0))
+                                  if st.localsgd else 0),
+                dgc_cfg=dgc_cfg)
         return optimize_ops, params_grads
 
 
-def transpile_collective(program, nranks=None, k_steps_localsgd=0):
+def transpile_collective(program, nranks=None, k_steps_localsgd=0,
+                         dgc_cfg=None):
     """GradAllReduce program rewrite (reference: transpiler/collective.py:
     178-268). Marks the program DP over the local mesh, scales the loss
     cotangent 1/nranks, inserts c_allreduce_sum per gradient."""
@@ -235,8 +250,11 @@ def transpile_collective(program, nranks=None, k_steps_localsgd=0):
     bop.attrs["loss_scale"] = bop.attrs.get("loss_scale", 1.0) / nranks
 
     grad_names = list(bop.output_names.get("Grad", []))
+    dgc_cfg = dgc_cfg or getattr(program, "_dgc_cfg", None)
     ar_ops = []
     for g in grad_names:
+        if dgc_cfg is not None:
+            _insert_dgc(program, block, g, dgc_cfg, ar_ops)
         op = Operator(block, "c_allreduce_sum",
                       inputs={"X": [g]}, outputs={"Out": [g]},
                       attrs={"ring_id": 0, "use_calc_stream": True})
@@ -244,3 +262,36 @@ def transpile_collective(program, nranks=None, k_steps_localsgd=0):
     block.ops[bwd_idx + 1:bwd_idx + 1] = ar_ops
     program._version += 1
     return program
+
+
+def _insert_dgc(program, block, grad_name, cfg, ops_out):
+    """Plant the dgc op (momentum-corrected top-k sparsification,
+    reference `operators/dgc_op.cc`) before the grad's allreduce, with
+    persistable U/V residual accumulators and a step counter."""
+    gvar = block._find_var_recursive(grad_name)
+    shape = tuple(gvar.shape) if gvar is not None else None
+    from ..core.scope import global_scope
+    import jax.numpy as jnp
+
+    def state(name, sshape, value=0.0):
+        if name not in block.vars:
+            v = block.create_var(name=name, shape=sshape,
+                                 dtype="float32", persistable=True)
+            v.stop_gradient = True
+        if global_scope().find_var(name) is None:
+            global_scope().set_var(
+                name, jnp.full(sshape, value, jnp.float32))
+        return name
+
+    u = state(grad_name + "@DGC_U", shape)
+    v = state(grad_name + "@DGC_V", shape)
+    step = state(grad_name + "@DGC_STEP", (1,))
+    ops_out.append(Operator(
+        block, "dgc",
+        inputs={"Grad": [grad_name], "U": [u], "V": [v],
+                "Step": [step]},
+        outputs={"UOut": [u], "VOut": [v], "EncodeGrad": [grad_name],
+                 "StepOut": [step]},
+        attrs={"momentum": cfg.get("momentum", 0.9),
+               "sparsity": cfg.get("sparsity", 0.75),
+               "rampup_begin_step": cfg.get("rampup_begin_step", 0)}))

@@ -144,11 +144,6 @@ class _WarnOnlyMeta(MetaOptimizerBase):
 
 
 _WARN_ONLY = [
-    _WarnOnlyMeta("dgc",
-                  "DistributedStrategy.dgc: gradient compression is a "
-                  "GPU-bandwidth optimization; on TPU the dense psum over "
-                  "ICI is used instead (DGCMomentumOptimizer degrades to "
-                  "Momentum). Ignoring dgc."),
     _WarnOnlyMeta("a_sync",
                   "DistributedStrategy.a_sync: async parameter-server "
                   "mode is not wired through fleet yet; use "

@@ -145,5 +145,13 @@ def dynamic_decode(decoder, inits=None, max_step_num=20, output_time_major
     if not output_time_major:
         outs = tensor_layers.transpose(outs, [1, 0, 2])
     if return_length:
-        return outs, scores, None
+        # per-beam valid length: tokens before/at the first end token
+        # (reference dynamic_decode returns sequence_lengths)
+        end_id = getattr(decoder, "end_token", 1)
+        time_axis = 0 if output_time_major else 1
+        not_end = nn_layers.logical_not(nn_layers.equal(
+            outs, tensor_layers.fill_constant([1], outs.dtype, end_id)))
+        lengths = nn_layers.reduce_sum(
+            tensor_layers.cast(not_end, "int64"), dim=time_axis)
+        return outs, scores, lengths
     return outs, scores

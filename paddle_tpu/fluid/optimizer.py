@@ -690,16 +690,70 @@ class DpsgdOptimizer(Optimizer):
 
 
 class DGCMomentumOptimizer(MomentumOptimizer):
-    """API-parity stub: DGC top-k grad compression targets PCIe-bound GPU
-    clusters (reference: optimizers/dgc_momentum_op.cc); on TPU the ICI
-    fabric makes dense psum faster, so this degrades to Momentum
-    (SURVEY.md §2.3 marks DGC low-priority on TPU)."""
+    """Deep Gradient Compression momentum (reference:
+    `optimizers/dgc_momentum_op.cc` + `python optimizer.py:1149`): marks
+    the program so `fleet.transpile_collective` plants the `dgc` op
+    (momentum-corrected top-k sparsification with U/V residual
+    accumulators) before each gradient's allreduce. The local momentum
+    op still runs (reference dgc_momentum = momentum before
+    rampup_begin_step; afterwards the dgc op's own correction
+    dominates and the summed masked grads flow through it)."""
 
     def __init__(self, learning_rate, momentum, rampup_begin_step=0,
-                 **kwargs):
-        kwargs.pop("rampup_step", None)
-        kwargs.pop("sparsity", None)
-        super().__init__(learning_rate, momentum, **kwargs)
+                 rampup_step=1, sparsity=None, use_nesterov=False,
+                 num_trainers=None, regularization=None, grad_clip=None,
+                 name=None, **kwargs):
+        super().__init__(learning_rate, momentum,
+                         use_nesterov=use_nesterov,
+                         regularization=regularization,
+                         grad_clip=grad_clip, name=name, **kwargs)
+        sparsity = sparsity if sparsity else [0.75]
+        self._step_counter = None
+        self._dgc_cfg = {
+            "momentum": float(momentum),
+            "sparsity": float(sparsity[-1]
+                              if isinstance(sparsity, (list, tuple))
+                              else sparsity),
+            "rampup_begin_step": float(rampup_begin_step),
+        }
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        loss.block.program._dgc_cfg = self._dgc_cfg
+        return super().minimize(loss, startup_program, parameter_list,
+                                no_grad_set)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        from .layers import tensor as _tensor
+
+        p, g = param_and_grad
+        v = self._get_accumulator("velocity", p)
+        if self._step_counter is None:
+            self._step_counter = _tensor.create_global_var(
+                [1], 0.0, "float32", persistable=True,
+                name=unique_name("dgc_opt_step"))
+        out = block.append_op(
+            type="dgc_momentum",
+            inputs={"Param": [p], "Grad": [g], "Velocity": [v],
+                    "LearningRate": [
+                        self._create_param_lr(param_and_grad)],
+                    "CurrentStep": [self._step_counter]},
+            outputs={"ParamOut": [p], "VelocityOut": [v]},
+            attrs={"mu": self._momentum,
+                   "use_nesterov": self._use_nesterov,
+                   "rampup_begin_step":
+                       self._dgc_cfg["rampup_begin_step"]})
+        return out
+
+    def _finish_update(self, block, params_grads):
+        # bump the shared step counter once per executed step
+        if self._step_counter is not None:
+            block.append_op(
+                type="increment",
+                inputs={"X": [self._step_counter]},
+                outputs={"Out": [self._step_counter]},
+                attrs={"step": 1.0})
+        return super()._finish_update(block, params_grads)
 
 
 class ModelAverage(Optimizer):

@@ -316,35 +316,6 @@ from .. import tensor as _pt_tensor  # noqa: F401,E402
 from ..fluid import initializer as initializer  # noqa: F401,E402
 
 
-def gather_tree(ids, parents):
-    from ..fluid.layer_helper import apply_op
-
-    return apply_op("gather_tree", "gather_tree",
-                    {"Ids": [ids], "Parents": [parents]}, {}, ["Out"],
-                    out_dtype="int64")[0]
-
-
-def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
-                level=0, is_accumulated=True, name=None,
-                return_parent_idx=False):
-    from ..fluid.layer_helper import apply_op
-
-    outs = apply_op("beam_search", "beam_search",
-                    {"pre_ids": [pre_ids], "pre_scores": [pre_scores],
-                     "ids": [ids], "scores": [scores]},
-                    {"beam_size": beam_size, "end_id": end_id,
-                     "level": level, "is_accumulated": is_accumulated},
-                    ["selected_ids", "selected_scores", "parent_idx"])
-    if return_parent_idx:
-        return outs[0], outs[1], outs[2]
-    return outs[0], outs[1]
-
-
-def beam_search_decode(ids, scores, beam_size, end_id, name=None):
-    from ..fluid.layer_helper import apply_op
-
-    outs = apply_op("beam_search_decode", "beam_search_decode",
-                    {"Ids": [ids], "Scores": [scores]},
-                    {"beam_size": beam_size, "end_id": end_id},
-                    ["SentenceIds", "SentenceScores"])
-    return outs[0], outs[1]
+from ..fluid.layers import (  # noqa: F401,E402
+    beam_search, beam_search_decode, gather_tree,
+)

@@ -285,3 +285,30 @@ def _proximal_adagrad(ins, attrs):
     out = jnp.sign(prox) * jnp.maximum(
         jnp.abs(prox) - alr * l1, 0.0) / (1.0 + alr * l2)
     return {"ParamOut": out.astype(p.dtype), "MomentOut": m_out}
+
+
+@register_op("dgc_momentum")
+def _dgc_momentum(ins, attrs):
+    """Reference `optimizers/dgc_momentum_op.cc`: momentum update while
+    current_step < rampup_begin_step (dense warmup), plain SGD after
+    (the dgc op's own momentum correction takes over, so running
+    momentum here too would double-apply it)."""
+    p = ins["Param"][0]
+    g = ins["Grad"][0]
+    v = ins["Velocity"][0]
+    lr = ins["LearningRate"][0].reshape(())
+    step = ins["CurrentStep"][0].reshape(())
+    mu = attrs.get("mu", 0.9)
+    rampup = float(attrs.get("rampup_begin_step", 0.0))
+    use_nesterov = attrs.get("use_nesterov", False)
+
+    warm = step < rampup
+    v_new = mu * v + g
+    if use_nesterov:
+        p_momentum = p - lr * (g + mu * v_new)
+    else:
+        p_momentum = p - lr * v_new
+    p_sgd = p - lr * g
+    p_out = jnp.where(warm, p_momentum, p_sgd)
+    v_out = jnp.where(warm, v_new, v)
+    return {"ParamOut": p_out, "VelocityOut": v_out}

@@ -1,0 +1,224 @@
+"""Golden/behavioral tests for the specialty ops without coverage yet:
+tree_conv, var_conv_2d, pyramid_hash, attention_lstm,
+fused_embedding_fc_lstm, fusion_seqexpand_concat_fc, similarity_focus,
+add_position_encoding, roi_perspective_transform,
+deformable_psroi_pooling, sampled softmax, polygon_box_transform."""
+import numpy as np
+
+from op_test import OpTest
+from paddle_tpu import ops as ops_lib
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+class TestTreeConv(OpTest):
+    def test(self):
+        r = np.random.RandomState(0)
+        n, max_n, feat, out_c, k = 1, 4, 3, 2, 2
+        nodes = r.randn(n, max_n, feat).astype("float32")
+        # tree: 1 -> 2, 1 -> 3 (node 0 unused root placeholder)
+        edges = np.array([[[1, 2], [1, 3], [0, 0]]], "int32")
+        filt = r.randn(feat, 3, out_c, k).astype("float32")
+        self.op_type = "tree_conv"
+        self.inputs = {"NodesVector": nodes, "EdgeSet": edges,
+                       "Filter": filt}
+        out = np.asarray(self._run_forward()["Out"][0])
+        assert out.shape == (n, max_n, out_c * k)
+        assert np.all(np.isfinite(out))
+        # node with no children: only the self (top) term contributes
+        w_self = filt[:, 0] + 0.5 * filt[:, 1] + 0.5 * filt[:, 2]
+        e2 = np.tanh(np.einsum("f,fok->ok", nodes[0, 2], w_self))
+        np.testing.assert_allclose(out[0, 2], e2.reshape(-1), rtol=1e-4)
+
+
+class TestVarConv2d(OpTest):
+    def test(self):
+        r = np.random.RandomState(1)
+        x = r.randn(2, 6, 8).astype("float32")
+        w = r.randn(4, 9).astype("float32")
+        self.op_type = "var_conv_2d"
+        self.inputs = {"X": x, "W": w}
+        self.attrs = {"kernel_h": 3, "kernel_w": 3}
+        out = np.asarray(self._run_forward()["Out"][0])
+        assert out.shape == (2, 4, 6, 8)
+        # center pixel of a same-padded 3x3 conv over row 0
+        xp = np.pad(x[0], ((1, 1), (1, 1)))
+        patch = xp[3:6, 4:7].reshape(-1)
+        np.testing.assert_allclose(out[0, 1, 3, 4].item(),
+                                   float(w[1] @ patch), rtol=1e-4)
+
+
+class TestPyramidHash(OpTest):
+    def test(self):
+        r = np.random.RandomState(2)
+        x = r.randint(1, 1000, (3, 6)).astype("int64")
+        w = r.randn(128, 8).astype("float32")
+        self.op_type = "pyramid_hash"
+        self.inputs = {"X": x, "W": w}
+        self.attrs = {"num_emb": 8, "pyramid_layer": 2}
+        out = np.asarray(self._run_forward()["Out"][0])
+        assert out.shape == (3, 8)
+        out2 = np.asarray(self._run_forward()["Out"][0])
+        np.testing.assert_array_equal(out, out2)  # deterministic hash
+
+
+class TestAttentionLstm(OpTest):
+    def test(self):
+        r = np.random.RandomState(3)
+        b, t, m, d = 2, 4, 5, 3
+        x = r.randn(b, t, m).astype("float32")
+        aw = (r.randn(m + d, 1) * 0.3).astype("float32")
+        lw = (r.randn(m + d, 4 * d) * 0.3).astype("float32")
+        lb = np.zeros((4 * d,), "float32")
+        self.op_type = "attention_lstm"
+        self.inputs = {"X": x, "AttentionWeight": aw, "LSTMWeight": lw,
+                       "LSTMBias": lb}
+        outs = self._run_forward()
+        hid = np.asarray(outs["Hidden"][0])
+        assert hid.shape == (b, t, d)
+        assert np.all(np.isfinite(hid))
+        # padded rows must not receive attention mass
+        self.inputs["Length"] = np.array([4, 2], "int64")
+        hid2 = np.asarray(self._run_forward()["Hidden"][0])
+        assert np.all(np.isfinite(hid2))
+        self.check_grad(["X", "LSTMWeight"], "Hidden",
+                        max_relative_error=0.05)
+
+
+class TestFusedEmbeddingFcLstm(OpTest):
+    def test(self):
+        r = np.random.RandomState(4)
+        b, t, v, d = 2, 3, 20, 4
+        ids = r.randint(0, v, (b, t)).astype("int64")
+        emb = (r.randn(v, 4 * d) * 0.2).astype("float32")
+        wh = (r.randn(d, 4 * d) * 0.2).astype("float32")
+        bias = np.zeros((1, 4 * d), "float32")
+        self.op_type = "fused_embedding_fc_lstm"
+        self.inputs = {"Ids": ids, "Embeddings": emb, "WeightH": wh,
+                       "Bias": bias}
+        outs = self._run_forward()
+        hid = np.asarray(outs["Hidden"][0])
+        assert hid.shape == (b, t, d)
+        # golden: manual cand/i/f/o recurrence over the embedded gates
+        xx = emb[ids] + bias.reshape(-1)
+        h = np.zeros((b, d))
+        c = np.zeros((b, d))
+        for step in range(t):
+            proj = xx[:, step] + h @ wh
+            cand = np.tanh(proj[:, :d])
+            i = _sigmoid(proj[:, d:2 * d])
+            f = _sigmoid(proj[:, 2 * d:3 * d])
+            o = _sigmoid(proj[:, 3 * d:])
+            c = f * c + i * cand
+            h = o * np.tanh(c)
+        np.testing.assert_allclose(hid[:, -1], h, rtol=1e-4, atol=1e-5)
+
+
+class TestFusionSeqexpandConcatFc(OpTest):
+    def test(self):
+        r = np.random.RandomState(5)
+        b, t, d0, d1 = 2, 3, 4, 2
+        seq = r.randn(b, t, d0).astype("float32")
+        vec = r.randn(b, d1).astype("float32")
+        w = r.randn(d0 + d1, 5).astype("float32")
+        self.op_type = "fusion_seqexpand_concat_fc"
+        self.inputs = {"X": [seq, vec], "FCWeight": w}
+        self.attrs = {"fc_activation": "relu"}
+        out = np.asarray(self._run_forward()["Out"][0])
+        cat = np.concatenate(
+            [seq, np.tile(vec[:, None, :], (1, t, 1))], -1)
+        np.testing.assert_allclose(out, np.maximum(cat @ w, 0),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestSimilarityFocus(OpTest):
+    def test(self):
+        r = np.random.RandomState(6)
+        x = r.randn(1, 3, 4, 4).astype("float32")
+        self.op_type = "similarity_focus"
+        self.inputs = {"X": x}
+        self.attrs = {"axis": 1, "indexes": [1]}
+        out = np.asarray(self._run_forward()["Out"][0])
+        plane = x[0, 1]
+        rmax = plane == plane.max(1, keepdims=True)
+        cmax = plane == plane.max(0, keepdims=True)
+        e = (rmax | cmax).astype("float32")
+        for ch in range(3):
+            np.testing.assert_array_equal(out[0, ch], e)
+
+
+class TestAddPositionEncoding(OpTest):
+    def test(self):
+        r = np.random.RandomState(7)
+        x = r.randn(2, 6, 8).astype("float32")
+        self.op_type = "add_position_encoding"
+        self.inputs = {"X": x}
+        self.attrs = {"alpha": 0.5, "beta": 2.0}
+        out = np.asarray(self._run_forward()["Out"][0])
+        pos = np.arange(6, dtype="float64")[:, None]
+        freq = np.power(10000.0, -np.arange(4, dtype="float64") / 4)
+        ang = pos * freq[None, :]
+        enc = np.concatenate([np.sin(ang), np.cos(ang)], 1)
+        np.testing.assert_allclose(out, 0.5 * x + 2.0 * enc[None],
+                                   rtol=1e-4, atol=1e-5)
+        self.check_grad(["X"], "Out")
+
+
+class TestRoiPerspectiveTransform(OpTest):
+    def test(self):
+        """An axis-aligned quad must behave like a crop+resize: constant
+        regions map to the constant."""
+        x = np.full((1, 2, 12, 12), 2.5, "float32")
+        quad = np.array([[2., 2., 9., 2., 9., 9., 2., 9.]], "float32")
+        self.op_type = "roi_perspective_transform"
+        self.inputs = {"X": x, "ROIs": quad}
+        self.attrs = {"transformed_height": 4, "transformed_width": 4,
+                      "spatial_scale": 1.0}
+        out = np.asarray(self._run_forward()["Out"][0])
+        np.testing.assert_allclose(out, 2.5, rtol=1e-4)
+
+
+class TestDeformablePsroiPooling(OpTest):
+    def test(self):
+        """Zero offsets on a constant map: every bin equals the
+        constant."""
+        oc, ph, pw = 2, 2, 2
+        x = np.full((1, oc * ph * pw, 8, 8), 1.5, "float32")
+        rois = np.array([[0., 0., 8., 8.]], "float32")
+        self.op_type = "deformable_psroi_pooling"
+        self.inputs = {"Input": x, "ROIs": rois}
+        self.attrs = {"pooled_height": ph, "pooled_width": pw,
+                      "output_dim": oc, "spatial_scale": 1.0,
+                      "sample_per_part": 4}
+        out = np.asarray(self._run_forward()["Output"][0])
+        assert out.shape == (1, oc, ph, pw)
+        np.testing.assert_allclose(out, 1.5, rtol=1e-3)
+
+
+class TestPolygonBoxTransform(OpTest):
+    def test(self):
+        r = np.random.RandomState(8)
+        x = r.randn(1, 8, 2, 3).astype("float32")
+        self.op_type = "polygon_box_transform"
+        self.inputs = {"Input": x}
+        out = np.asarray(self._run_forward()["Output"][0])
+        gx = np.arange(3)[None, None, None, :]
+        gy = np.arange(2)[None, None, :, None]
+        is_x = (np.arange(8) % 2 == 0)[None, :, None, None]
+        base = np.where(is_x, 4.0 * gx, 4.0 * gy)
+        np.testing.assert_allclose(out, base - x, rtol=1e-5)
+
+
+class TestShardIndex(OpTest):
+    def test(self):
+        ids = np.array([[1], [5], [9], [3]], "int64")
+        self.op_type = "shard_index"
+        self.inputs = {"X": ids}
+        self.attrs = {"index_num": 12, "nshards": 3, "shard_id": 1,
+                      "ignore_value": -1}
+        out = np.asarray(self._run_forward()["Out"][0])
+        # shard 1 owns ids [4, 8): 5 -> 1; others -> ignore
+        e = np.array([[-1], [1], [-1], [-1]], "int64")
+        np.testing.assert_array_equal(out, e)

@@ -149,7 +149,7 @@ def test_fleet_unimplemented_knobs_warn():
     from paddle_tpu import fleet as fleet_mod
 
     strategy = fleet_mod.DistributedStrategy()
-    strategy.dgc = True
+    strategy.dgc = True     # implemented: plants dgc ops, no warning
     strategy.elastic = True
     opt = fleet_mod.CollectiveOptimizer(
         fluid.optimizer.SGDOptimizer(0.1), strategy)
@@ -159,8 +159,9 @@ def test_fleet_unimplemented_knobs_warn():
             x = fluid.layers.data(name="x", shape=[4], dtype="float32")
             y = fluid.layers.fc(input=x, size=2)
             loss = fluid.layers.mean(y)
-            with pytest.warns(UserWarning, match="dgc"):
+            with pytest.warns(UserWarning, match="elastic"):
                 opt.minimize(loss)
+    assert any(op.type == "dgc" for op in main.global_block().ops)
 
 
 def test_fleet_gradient_merge_wired():
@@ -184,3 +185,49 @@ def test_fleet_gradient_merge_wired():
             if op.type == "backward"]
     assert bops and bops[0].attrs.get("gradient_merge", {}).get(
         "k_steps") == 4
+
+
+def test_dgc_sparsifies_and_trains():
+    """Real DGC (reference dgc_op.cc): 8-way DP training with top-k
+    sparsified allreduce converges, and the residual accumulators hold
+    the unsent mass (nonzero V between steps)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework
+    from paddle_tpu import fleet
+    from paddle_tpu.core.scope import global_scope
+
+    r = np.random.RandomState(0)
+    feats = r.randn(64, 16).astype("float32")
+    w_true = r.randn(16, 4).astype("float32")
+    labels = feats.dot(w_true).argmax(1)[:, None].astype("int64")
+
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data("x", shape=[16], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="int64")
+            h = fluid.layers.fc(x, 32, act="relu", name="dgcfc1")
+            logits = fluid.layers.fc(h, 4, name="dgcfc2")
+            loss = fluid.layers.mean(
+                fluid.layers.loss.softmax_with_cross_entropy(logits, y))
+            opt = fluid.optimizer.DGCMomentumOptimizer(
+                learning_rate=0.3, momentum=0.9, rampup_begin_step=2,
+                sparsity=[0.8])
+            opt.minimize(loss)
+            fleet.transpile_collective(main, nranks=8)
+            exe = fluid.Executor()
+            exe.run(startup)
+            losses = []
+            for _ in range(20):
+                out = exe.run(main, feed={"x": feats, "y": labels},
+                              fetch_list=[loss])
+                losses.append(float(np.asarray(out[0]).ravel()[0]))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+    # after rampup the residual accumulators must carry unsent mass
+    v = global_scope().find_var("dgcfc1.w_0@GRAD@DGC_V")
+    assert v is not None
+    v = np.asarray(v)
+    assert np.count_nonzero(v) > 0
+    step = np.asarray(global_scope().find_var(
+        "dgcfc1.w_0@GRAD@DGC_STEP"))
+    assert step[0] == 20
