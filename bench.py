@@ -179,9 +179,72 @@ def _bench_child(platform: str, batch: int, steps: int, warmup: int) -> None:
     print(_RESULT_TAG + json.dumps(result), flush=True)
 
 
+def _bench_resnet_child(batch: int, steps: int, warmup: int) -> None:
+    """ResNet50 ImageNet training throughput (BASELINE.json config 2);
+    opt-in via `python bench.py --resnet` — the driver's headline metric
+    stays BERT."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework
+    from paddle_tpu.fluid.contrib import mixed_precision
+    from paddle_tpu.models import resnet as resnet_mod
+
+    main_p, startup_p = framework.Program(), framework.Program()
+    with framework.program_guard(main_p, startup_p):
+        with framework.unique_name_guard():
+            img = fluid.layers.data("image", shape=[3, 224, 224],
+                                    dtype="float32")
+            label = fluid.layers.data("label", shape=[1], dtype="int64")
+            logits = resnet_mod.resnet(img, class_dim=1000, depth=50)
+            loss = fluid.layers.mean(
+                fluid.layers.loss.softmax_with_cross_entropy(logits,
+                                                             label))
+            opt = mixed_precision.decorate(
+                fluid.optimizer.MomentumOptimizer(0.1, momentum=0.9),
+                use_dynamic_loss_scaling=False)
+            opt.minimize(loss)
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(startup_p)
+            r = np.random.RandomState(0)
+            feed = {
+                "image": r.randn(batch, 3, 224, 224).astype("float32"),
+                "label": r.randint(0, 1000, (batch, 1)).astype("int64"),
+            }
+            t0 = time.perf_counter()
+            out = exe.run(main_p, feed=feed, fetch_list=[loss])
+            np.asarray(out[0])
+            compile_time = time.perf_counter() - t0
+            for _ in range(max(warmup - 1, 0)):
+                out = exe.run(main_p, feed=feed, fetch_list=[loss])
+            np.asarray(out[0])
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                out = exe.run(main_p, feed=feed, fetch_list=[loss])
+            np.asarray(out[0])
+            dt = time.perf_counter() - t0
+    imgs_per_sec = batch * steps / dt
+    # widely reported V100 fp16 ResNet50 training: ~800-1000 img/s; use
+    # 900 as the per-chip baseline denominator
+    result = {
+        "metric": "resnet50_train_throughput",
+        "value": round(imgs_per_sec, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(imgs_per_sec / 900.0, 3),
+        "compile_time_s": round(compile_time, 1),
+        "batch": batch,
+        "loss": round(float(np.asarray(out[0]).reshape(-1)[0]), 4),
+    }
+    print(_RESULT_TAG + json.dumps(result), flush=True)
+
+
 if __name__ == "__main__":
     if len(sys.argv) >= 6 and sys.argv[1] == "--child":
         _bench_child(sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
                      int(sys.argv[5]))
+        sys.exit(0)
+    if len(sys.argv) >= 2 and sys.argv[1] == "--resnet":
+        batch = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+        _bench_resnet_child(batch, steps=8, warmup=2)
         sys.exit(0)
     sys.exit(main())

@@ -199,16 +199,12 @@ class CollectiveOptimizer:
             dgc_cfg = None
             if getattr(st, "dgc", False):
                 cfgs = getattr(st, "dgc_configs", {}) or {}
-                sp = cfgs.get("sparsity", 0.75)
-                if isinstance(sp, (list, tuple)):
-                    sp = sp[-1]   # reference passes a rampup list
-                dgc_cfg = {
-                    "momentum": getattr(self._optimizer, "_momentum",
-                                        0.9),
-                    "sparsity": float(sp),
-                    "rampup_begin_step": float(
-                        cfgs.get("rampup_begin_step", 0)),
-                }
+                from ..fluid.optimizer import normalize_dgc_cfg
+
+                dgc_cfg = normalize_dgc_cfg(
+                    getattr(self._optimizer, "_momentum", 0.9),
+                    cfgs.get("sparsity", 0.75),
+                    cfgs.get("rampup_begin_step", 0))
             transpile_collective(
                 loss.block.program,
                 k_steps_localsgd=(st.localsgd_configs["k_steps"]

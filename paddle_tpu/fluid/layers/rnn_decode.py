@@ -149,9 +149,24 @@ def dynamic_decode(decoder, inits=None, max_step_num=20, output_time_major
         # (reference dynamic_decode returns sequence_lengths)
         end_id = getattr(decoder, "end_token", 1)
         time_axis = 0 if output_time_major else 1
-        not_end = nn_layers.logical_not(nn_layers.equal(
-            outs, tensor_layers.fill_constant([1], outs.dtype, end_id)))
-        lengths = nn_layers.reduce_sum(
-            tensor_layers.cast(not_end, "int64"), dim=time_axis)
+        from .control_flow import equal, greater_than
+
+        # reference dynamic_decode counts the step emitting the end
+        # token: length = index of the first end token + 1 (whole T when
+        # no end token appears). cumsum of is-end along time marks
+        # positions strictly after the first end.
+        is_end = tensor_layers.cast(
+            equal(outs,
+                  tensor_layers.fill_constant([1], outs.dtype, end_id)),
+            "int64")
+        after_first_end = tensor_layers.cast(
+            greater_than(
+                tensor_layers.cumsum(is_end, axis=time_axis),
+                tensor_layers.fill_constant([1], "int64", 1)),
+            "int64")
+        t_extent = outs.shape[time_axis]
+        lengths = nn_layers.elementwise_sub(
+            tensor_layers.fill_constant([1], "int64", t_extent),
+            nn_layers.reduce_sum(after_first_end, dim=time_axis))
         return outs, scores, lengths
     return outs, scores

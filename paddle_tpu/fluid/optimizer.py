@@ -689,6 +689,19 @@ class DpsgdOptimizer(Optimizer):
                    "sigma": self._sigma})
 
 
+def normalize_dgc_cfg(momentum, sparsity, rampup_begin_step):
+    """Single home for the DGC config shape: the reference passes
+    sparsity as a rampup LIST; the final value is the steady-state
+    sparsity the dgc op runs at."""
+    if isinstance(sparsity, (list, tuple)):
+        sparsity = sparsity[-1]
+    return {
+        "momentum": float(momentum),
+        "sparsity": float(sparsity),
+        "rampup_begin_step": float(rampup_begin_step),
+    }
+
+
 class DGCMomentumOptimizer(MomentumOptimizer):
     """Deep Gradient Compression momentum (reference:
     `optimizers/dgc_momentum_op.cc` + `python optimizer.py:1149`): marks
@@ -707,15 +720,10 @@ class DGCMomentumOptimizer(MomentumOptimizer):
                          use_nesterov=use_nesterov,
                          regularization=regularization,
                          grad_clip=grad_clip, name=name, **kwargs)
-        sparsity = sparsity if sparsity else [0.75]
         self._step_counter = None
-        self._dgc_cfg = {
-            "momentum": float(momentum),
-            "sparsity": float(sparsity[-1]
-                              if isinstance(sparsity, (list, tuple))
-                              else sparsity),
-            "rampup_begin_step": float(rampup_begin_step),
-        }
+        self._dgc_cfg = normalize_dgc_cfg(
+            momentum, sparsity if sparsity else [0.75],
+            rampup_begin_step)
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):

@@ -94,3 +94,43 @@ def test_distributions_sample_static():
     arr = np.asarray(out[0])
     assert arr.shape == (1000,)
     assert abs(arr.mean()) < 0.2 and 0.8 < arr.std() < 1.2
+
+
+def test_dynamic_decode_return_length():
+    """return_length counts tokens through the first end token
+    (reference dynamic_decode sequence_lengths semantics)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework
+    from paddle_tpu.fluid.layers import rnn_decode
+
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            cell = rnn_decode.GRUCell(hidden_size=8)
+            decoder = rnn_decode.BeamSearchDecoder(
+                cell, start_token=0, end_token=1, beam_size=2,
+                embedding_fn=lambda ids: fluid.layers.one_hot(
+                    fluid.layers.unsqueeze(ids, [1]), depth=16),
+                output_fn=lambda h: fluid.layers.fc(
+                    h, 16, name="len_out_fc"))
+            init = fluid.layers.data("h0", shape=[2, 8],
+                                     dtype="float32",
+                                     append_batch_size=False)
+            outs = rnn_decode.dynamic_decode(
+                decoder, inits=init, max_step_num=5, return_length=True)
+            assert len(outs) == 3
+            ids, scores, lengths = outs
+            exe = fluid.Executor()
+            exe.run(startup)
+            got = exe.run(main,
+                          feed={"h0": np.zeros((2, 8), "float32")},
+                          fetch_list=[ids, lengths])
+    ids_v, len_v = np.asarray(got[0]), np.asarray(got[1])
+    b, t, beam = ids_v.shape
+    assert len_v.shape == (b, beam)
+    for bi in range(b):
+        for k in range(beam):
+            seq = ids_v[bi, :, k]
+            ends = np.nonzero(seq == 1)[0]
+            expect = (ends[0] + 1) if len(ends) else t
+            assert len_v[bi, k] == expect, (seq, len_v[bi, k])

@@ -1,0 +1,30 @@
+"""tools/op_bench.py micro-benchmark harness (reference:
+operators/benchmark/op_tester.cc tooling parity)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "tools"))
+
+
+def test_op_bench_runs():
+    from op_bench import bench_one
+
+    res = bench_one("relu", {"X": (32, 32)}, {}, repeat=5, warmup=1)
+    assert res["latency_us"] > 0 and res["compile_s"] >= 0
+
+    res = bench_one("dropout", {"X": (16, 16)},
+                    {"dropout_prob": 0.3}, repeat=3, warmup=1)
+    assert res["op"] == "dropout"
+
+
+def test_op_bench_cli_config(tmp_path):
+    from op_bench import _run_cli
+
+    cfg = tmp_path / "suite.txt"
+    cfg.write_text("# suite\n--op relu --shape X=8x8 --repeat 2\n"
+                   "--op softmax --shape X=4x16 --attr axis=-1 "
+                   "--repeat 2\n")
+    results = _run_cli(["--config", str(cfg)])
+    assert len(results) == 2
+    assert {r["op"] for r in results} == {"relu", "softmax"}

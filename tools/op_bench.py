@@ -1,0 +1,121 @@
+"""Per-op micro-benchmark harness (reference:
+`paddle/fluid/operators/benchmark/op_tester.cc` + op_tester_config.h —
+run one op from a config repeatedly and report latency).
+
+Usage:
+    python tools/op_bench.py --op matmul_v2 --shape X=256x256 Y=256x256 \
+        [--attr transpose_X=false] [--repeat 50] [--dtype float32]
+
+Runs the registered op through the same registry the executor uses,
+jitted once, and reports compile time + per-iteration latency. A config
+file (one CLI line per row, # comments) replays a suite:
+    python tools/op_bench.py --config configs.txt
+"""
+from __future__ import annotations
+
+import argparse
+import shlex
+import sys
+import time
+
+import numpy as np
+
+
+def _parse_shape(spec):
+    slot, dims = spec.split("=")
+    return slot, tuple(int(d) for d in dims.split("x"))
+
+
+def _parse_attr(spec):
+    k, v = spec.split("=", 1)
+    for conv in (int, float):
+        try:
+            return k, conv(v)
+        except ValueError:
+            pass
+    if v.lower() in ("true", "false"):
+        return k, v.lower() == "true"
+    return k, v
+
+
+def bench_one(op_type, shapes, attrs, dtype="float32", repeat=50,
+              warmup=5, seed=0):
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu  # noqa: F401 - registers ops
+    from paddle_tpu.ops import registry
+
+    opdef = registry.get_op(op_type)
+    r = np.random.RandomState(seed)
+    ins = {slot: [jnp.asarray(r.randn(*shape).astype(dtype))]
+           for slot, shape in shapes.items()}
+
+    run_attrs = dict(attrs)
+    if opdef.needs_rng:
+        run_attrs["_rng_key"] = jax.random.PRNGKey(seed)
+
+    if opdef.no_jit:
+        fn = lambda: registry.run_op(op_type, ins, run_attrs)  # noqa: E731
+        t0 = time.perf_counter()
+        out = fn()
+        compile_s = time.perf_counter() - t0
+    else:
+        slots = sorted(ins)
+
+        def compute(*flat):
+            d = {s: [v] for s, v in zip(slots, flat)}
+            return registry.normalize_outs(
+                opdef.compute(d, dict(run_attrs)))
+
+        jitted = jax.jit(compute)
+        flat = [ins[s][0] for s in slots]
+        t0 = time.perf_counter()
+        out = jitted(*flat)
+        jax.tree_util.tree_map(np.asarray, out)
+        compile_s = time.perf_counter() - t0
+        fn = lambda: jitted(*flat)  # noqa: E731
+
+    for _ in range(warmup):
+        out = fn()
+    jax.tree_util.tree_map(np.asarray, out)
+    t0 = time.perf_counter()
+    for _ in range(repeat):
+        out = fn()
+    jax.tree_util.tree_map(np.asarray, out)   # force completion
+    dt = (time.perf_counter() - t0) / repeat
+    return {"op": op_type, "latency_us": dt * 1e6,
+            "compile_s": compile_s, "repeat": repeat}
+
+
+def _run_cli(argv):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--op")
+    ap.add_argument("--shape", nargs="+", default=[])
+    ap.add_argument("--attr", nargs="*", default=[])
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--repeat", type=int, default=50)
+    ap.add_argument("--config")
+    args = ap.parse_args(argv)
+
+    if args.config:
+        results = []
+        for line in open(args.config):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            results.append(_run_cli(shlex.split(line)))
+        return results
+
+    shapes = dict(_parse_shape(s) for s in args.shape)
+    attrs = dict(_parse_attr(a) for a in args.attr)
+    res = bench_one(args.op, shapes, attrs, dtype=args.dtype,
+                    repeat=args.repeat)
+    print("%-24s %10.1f us/iter  (compile %.2fs, x%d)"
+          % (res["op"], res["latency_us"], res["compile_s"],
+             res["repeat"]))
+    return res
+
+
+if __name__ == "__main__":
+    _run_cli(sys.argv[1:])
