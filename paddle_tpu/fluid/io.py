@@ -276,3 +276,91 @@ def prune_program(program: Program, feed_names, fetch_names) -> Program:
 
 def get_program_persistable_vars(program):
     return [v for v in program.list_vars() if v.persistable]
+
+
+def get_parameter_value(para, executor=None):
+    """Numpy value of a Parameter from the global scope (reference:
+    io.py get_parameter_value)."""
+    import numpy as np
+
+    from ..core.scope import global_scope
+
+    v = global_scope().find_var(para.name)
+    if v is None:
+        raise ValueError(
+            "parameter %r is absent from the scope — run the startup "
+            "program" % para.name)
+    return np.asarray(v)
+
+
+def get_parameter_value_by_name(name, executor=None, program=None):
+    """reference: io.py get_parameter_value_by_name."""
+    program = program or framework.default_main_program()
+    var = program.global_block()._find_var_recursive(name)
+    if var is None:
+        raise ValueError("no parameter named %r in the program" % name)
+    return get_parameter_value(var, executor)
+
+
+def get_program_parameter(program):
+    """All Parameter vars of a program (reference: io.py
+    get_program_parameter)."""
+    return list(program.all_parameters())
+
+
+def is_belong_to_optimizer(var):
+    """Optimizer-state detection: accumulators are named
+    '<OptimizerClass>_<n>_<param>_<slot>_<n>' by
+    Optimizer._add_accumulator, so the unambiguous marker is the
+    'Optimizer_' class-name segment (plus the lr variable); user params
+    named 'linear'/'accum' etc. are NOT flagged."""
+    name = getattr(var, "name", "")
+    return bool(getattr(var, "persistable", False)) and (
+        "Optimizer_" in name or name.startswith("learning_rate"))
+
+
+def load_program_state(model_path, var_list=None):
+    """Load a `fluid.save` archive (params + optimizer state) into a
+    {name: ndarray} dict without touching the scope (reference: io.py
+    load_program_state)."""
+    names = set(v.name for v in var_list) if var_list else None
+
+    def filt(d):
+        return (d if names is None
+                else {k: v for k, v in d.items() if k in names})
+
+    if os.path.isdir(model_path):
+        return filt(_load_dict(model_path,
+                               sorted(names) if names else None))
+    d = os.path.dirname(model_path) or "."
+    f = os.path.basename(model_path)
+    state = {}
+    found = False
+    # merge both archives fluid.save writes, like load() does
+    for suffix in (".pdparams", ".pdopt", ""):
+        cand = f + suffix
+        if suffix == "" and found:
+            continue
+        if os.path.exists(os.path.join(d, cand)) and                 os.path.isfile(os.path.join(d, cand)):
+            state.update(filt(_load_dict(d, filename=cand)))
+            found = True
+    if not found:
+        raise IOError("no saved program state at %r" % model_path)
+    return state
+
+
+def set_program_state(program, state_dict):
+    """Bind a {name: ndarray} dict into the scope for the program's
+    persistable vars (reference: io.py set_program_state)."""
+    import jax.numpy as jnp
+
+    from ..core.scope import global_scope
+
+    unused = dict(state_dict)
+    for var in program.list_vars():
+        if not getattr(var, "persistable", False):
+            continue
+        if var.name in unused:
+            global_scope().set_var(var.name,
+                                   jnp.asarray(unused.pop(var.name)))
+    return unused

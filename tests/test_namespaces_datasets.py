@@ -95,3 +95,56 @@ def test_metric_namespace():
     m = paddle.metric.Accuracy()
     assert hasattr(m, "update") or hasattr(m, "eval")
     assert paddle.metric.Auc is not None
+
+
+def test_io_state_helpers(tmp_path):
+    """fluid.io get_parameter_value / load_program_state /
+    set_program_state round trip (reference io.py surface)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework, io as fio
+
+    r = np.random.RandomState(0)
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data("x", shape=[6], dtype="float32")
+            y = fluid.layers.fc(x, 3, name="iofc")
+            loss = fluid.layers.mean(y)
+            fluid.optimizer.AdamOptimizer(1e-3).minimize(loss)
+            exe = fluid.Executor()
+            exe.run(startup)
+
+            params = fio.get_program_parameter(main)
+            assert any(p.name == "iofc.w_0" for p in params)
+            w = fio.get_parameter_value_by_name("iofc.w_0",
+                                                program=main)
+            assert w.shape == (6, 3)
+
+            opt_state = [v for v in main.list_vars()
+                         if fio.is_belong_to_optimizer(v)]
+            assert opt_state, "adam moments should be flagged"
+            assert not fio.is_belong_to_optimizer(params[0])
+
+            path = str(tmp_path / "model")
+            fio.save(main, path)
+            state = fio.load_program_state(path)
+            assert "iofc.w_0" in state
+            # optimizer state merges in too (reference load_program_state)
+            assert any("Optimizer_" in k for k in state)
+            only_w = fio.load_program_state(path, var_list=[params[0]])
+            assert set(only_w) == {params[0].name}
+            # a user param named 'linear' must not be misflagged
+            class _V:
+                name = "linear.w_0"
+                persistable = True
+            assert not fio.is_belong_to_optimizer(_V())
+            # perturb then restore
+            from paddle_tpu.core.scope import global_scope
+            import jax.numpy as jnp
+            global_scope().set_var("iofc.w_0",
+                                   jnp.zeros((6, 3), jnp.float32))
+            left = fio.set_program_state(main, state)
+            w2 = fio.get_parameter_value_by_name("iofc.w_0",
+                                                 program=main)
+            np.testing.assert_allclose(w2, w)
+            assert "iofc.w_0" not in left
