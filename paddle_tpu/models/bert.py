@@ -141,23 +141,31 @@ def bert_encoder(src_ids, pos_ids, sent_ids, input_mask, cfg,
 def bert_pretrain_loss(cfg, seq_len, is_test=False):
     """Masked-LM + next-sentence pretraining loss over feed vars.
 
-    Masked positions are a dense [B, max_pred] index tensor with a weight
-    mask (padded, XLA-friendly — SURVEY.md §7 hard part (a))."""
+    Masked positions are a dense [B, max_pred] per-sequence index tensor
+    with a [B, max_pred] weight mask (padded slots get weight 0) —
+    XLA-friendly static shapes, SURVEY.md §7 hard part (a). The gather is
+    a batched take_along_axis on [B, S, H] (small per-row index space;
+    its vjp is a batched segment scatter), NOT a flat gather over
+    [B*S, H] whose backward scatter serializes on TPU. The vocab head is
+    the fused_linear_softmax_xent op, so [tokens, vocab] logits are never
+    materialized (round-2 profile: that buffer + its softmax were the
+    largest HBM cost in the step and the batch-512 OOM)."""
     src = layers.data(name="src_ids", shape=[seq_len], dtype="int64")
     pos = layers.data(name="pos_ids", shape=[seq_len], dtype="int64")
     sent = layers.data(name="sent_ids", shape=[seq_len], dtype="int64")
     mask = layers.data(name="input_mask", shape=[seq_len], dtype="float32")
-    mask_pos = layers.data(name="mask_pos", shape=[None], dtype="int64",
-                           append_batch_size=False)
-    mask_label = layers.data(name="mask_label", shape=[None],
-                             dtype="int64", append_batch_size=False)
+    mask_pos = layers.data(name="mask_pos", shape=[None], dtype="int64")
+    mask_label = layers.data(name="mask_label", shape=[None], dtype="int64")
+    mask_weight = layers.data(name="mask_weight", shape=[None],
+                              dtype="float32")
     nsp_label = layers.data(name="nsp_label", shape=[1], dtype="int64")
 
     seq_out = bert_encoder(src, pos, sent, mask, cfg, is_test=is_test)
 
-    # -- masked LM head (flattened gather of masked positions) --
-    flat = layers.reshape(seq_out, [-1, cfg.hidden_size])
-    picked = layers.gather(flat, mask_pos)
+    # -- masked LM head (batched take_along_axis of masked positions) --
+    idx = layers.reshape(mask_pos, [0, -1, 1])  # [B, P, 1]
+    picked = layers.take_along_axis(seq_out, idx, axis=1)  # [B, P, H]
+    picked = layers.reshape(picked, [-1, cfg.hidden_size])
     trans = layers.fc(input=picked, size=cfg.hidden_size, act="gelu",
                       param_attr=ParamAttr(name="mlm_trans.w",
                                            initializer=_init(cfg)),
@@ -165,13 +173,14 @@ def bert_pretrain_loss(cfg, seq_len, is_test=False):
     trans = layers.layer_norm(trans, begin_norm_axis=1,
                               param_attr=ParamAttr(name="mlm_ln.scale"),
                               bias_attr=ParamAttr(name="mlm_ln.bias"))
-    mlm_logits = layers.fc(input=trans, size=cfg.vocab_size,
-                           param_attr=ParamAttr(name="mlm_out.w",
-                                                initializer=_init(cfg)),
-                           bias_attr=ParamAttr(name="mlm_out.b"))
-    mlm_label2d = layers.reshape(mask_label, [-1, 1])
-    mlm_loss = layers.mean(
-        layers.softmax_with_cross_entropy(mlm_logits, mlm_label2d))
+    per_tok = layers.loss.fused_linear_softmax_xent(
+        trans, layers.reshape(mask_label, [-1, 1]), cfg.vocab_size,
+        param_attr=ParamAttr(name="mlm_out.w", initializer=_init(cfg)),
+        bias_attr=ParamAttr(name="mlm_out.b"))  # [B*P, 1]
+    w_flat = layers.reshape(mask_weight, [-1, 1])
+    denom = layers.scale(layers.reduce_sum(w_flat), bias=1e-6)
+    mlm_loss = layers.elementwise_div(
+        layers.reduce_sum(layers.elementwise_mul(per_tok, w_flat)), denom)
 
     # -- next sentence head over [CLS] --
     cls = layers.slice(seq_out, axes=[1], starts=[0], ends=[1])
@@ -189,7 +198,7 @@ def bert_pretrain_loss(cfg, seq_len, is_test=False):
 
     total = layers.elementwise_add(mlm_loss, nsp_loss)
     feeds = ["src_ids", "pos_ids", "sent_ids", "input_mask", "mask_pos",
-             "mask_label", "nsp_label"]
+             "mask_label", "mask_weight", "nsp_label"]
     return total, mlm_loss, nsp_loss, feeds
 
 

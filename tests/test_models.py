@@ -45,24 +45,28 @@ def test_resnet50_builds_and_steps(rng):
     assert np.isfinite(losses).all()
 
 
-def _bert_batch(rng, cfg, bsz, seq, n_mask):
+def _bert_batch(rng, cfg, bsz, seq, max_pred):
     src = rng.randint(0, cfg.vocab_size, (bsz, seq)).astype("int64")
     pos = np.tile(np.arange(seq), (bsz, 1)).astype("int64")
     sent = np.zeros((bsz, seq), "int64")
     mask = np.ones((bsz, seq), "float32")
-    mask_pos = rng.choice(bsz * seq, n_mask, replace=False).astype("int64")
-    mask_label = rng.randint(0, cfg.vocab_size, (n_mask,)).astype("int64")
+    mask_pos = np.stack([rng.choice(seq, max_pred, replace=False)
+                         for _ in range(bsz)]).astype("int64")
+    mask_label = rng.randint(0, cfg.vocab_size,
+                             (bsz, max_pred)).astype("int64")
+    mask_weight = np.ones((bsz, max_pred), "float32")
     nsp = rng.randint(0, 2, (bsz, 1)).astype("int64")
     return {"src_ids": src, "pos_ids": pos, "sent_ids": sent,
             "input_mask": mask, "mask_pos": mask_pos,
-            "mask_label": mask_label, "nsp_label": nsp}
+            "mask_label": mask_label, "mask_weight": mask_weight,
+            "nsp_label": nsp}
 
 
 def test_bert_tiny_trains(rng):
     cfg = bert.BertConfig.tiny()
     total, mlm, nsp, feeds = bert.build_bert_pretrain(
         cfg, seq_len=16, lr=1e-3)
-    batch = _bert_batch(rng, cfg, 4, 16, 8)
+    batch = _bert_batch(rng, cfg, 4, 16, 4)
     losses = _train(lambda s: batch, total, None, steps=10)
     assert losses[-1] < losses[0], losses
 

@@ -1,0 +1,87 @@
+"""Golden + grad tests for the fused_linear_softmax_xent op (the
+memory-fused large-vocab classifier head; see ops/fused_ops.py) and its
+integration in the BERT masked-LM head."""
+import numpy as np
+
+from op_test import OpTest
+
+
+def _ref_loss(x, w, b, label):
+    logits = x @ w + (b if b is not None else 0.0)
+    m = logits.max(-1, keepdims=True)
+    lse = m[:, 0] + np.log(np.exp(logits - m).sum(-1))
+    picked = logits[np.arange(x.shape[0]), label]
+    return (lse - picked)[:, None]
+
+
+class TestFusedLinearSoftmaxXent(OpTest):
+    op_type = "fused_linear_softmax_xent"
+
+    def _mk(self, n=6, h=5, v=13, seed=3):
+        r = np.random.RandomState(seed)
+        x = (r.rand(n, h).astype("float32") - 0.5) * 2
+        w = (r.rand(h, v).astype("float32") - 0.5) * 2
+        b = (r.rand(v).astype("float32") - 0.5)
+        label = r.randint(0, v, (n,)).astype("int64")
+        return x, w, b, label
+
+    def test_single_chunk(self):
+        x, w, b, label = self._mk()
+        self.inputs = {"X": x, "W": w, "Bias": b, "Label": label}
+        self.attrs = {"chunk_size": 16}
+        self.outputs = {"Loss": _ref_loss(x, w, b, label)}
+        self.check_output()
+
+    def test_multi_chunk_with_padding(self):
+        # v=13, chunk=4 -> 4 chunks, padded to 16: exercises the online
+        # logsumexp across chunks AND the -1e30 padded-column masking
+        x, w, b, label = self._mk()
+        self.inputs = {"X": x, "W": w, "Bias": b, "Label": label}
+        self.attrs = {"chunk_size": 4}
+        self.outputs = {"Loss": _ref_loss(x, w, b, label)}
+        self.check_output()
+
+    def test_no_bias(self):
+        x, w, _, label = self._mk()
+        self.inputs = {"X": x, "W": w, "Label": label}
+        self.attrs = {"chunk_size": 5}
+        self.outputs = {"Loss": _ref_loss(x, w, None, label)}
+        self.check_output()
+
+    def test_label_2d_and_leading_dims(self):
+        # x [B, P, H] with label [B, P, 1] must give loss [B, P, 1]
+        r = np.random.RandomState(5)
+        x = (r.rand(2, 3, 4).astype("float32") - 0.5)
+        w = (r.rand(4, 9).astype("float32") - 0.5)
+        b = np.zeros(9, "float32")
+        label = r.randint(0, 9, (2, 3, 1)).astype("int64")
+        ref = _ref_loss(x.reshape(-1, 4), w, b,
+                        label.reshape(-1)).reshape(2, 3, 1)
+        self.inputs = {"X": x, "W": w, "Bias": b, "Label": label}
+        self.attrs = {"chunk_size": 4}
+        self.outputs = {"Loss": ref}
+        self.check_output()
+
+    def test_grad_multi_chunk(self):
+        x, w, b, label = self._mk(n=4, h=3, v=11)
+        self.inputs = {"X": x, "W": w, "Bias": b, "Label": label}
+        self.attrs = {"chunk_size": 4}
+        self.check_grad(["X", "W", "Bias"], "Loss")
+
+    def test_matches_unfused_composite(self):
+        # parity with the unfused mul + softmax_with_cross_entropy chain
+        from paddle_tpu.ops.registry import get_op
+
+        x, w, b, label = self._mk(n=8, h=6, v=17, seed=11)
+        import jax.numpy as jnp
+
+        fused = get_op("fused_linear_softmax_xent").compute(
+            {"X": [jnp.asarray(x)], "W": [jnp.asarray(w)],
+             "Bias": [jnp.asarray(b)], "Label": [jnp.asarray(label)]},
+            {"chunk_size": 4})["Loss"]
+        logits = jnp.asarray(x @ w + b)
+        unfused = get_op("softmax_with_cross_entropy").compute(
+            {"Logits": [logits], "Label": [jnp.asarray(label[:, None])]},
+            {})["Loss"]
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(unfused),
+                                   rtol=2e-5, atol=2e-5)
