@@ -189,12 +189,31 @@ class CollectiveOptimizer:
         inner, self._applied_meta_list = compose(st, self._optimizer)
         optimize_ops, params_grads = inner.minimize(
             loss, startup_program, parameter_list, no_grad_set)
-        if st.pipeline:
-            # the pipeline engine owns the device mesh ('pp' axis); a
-            # simultaneous dp shard_map over the same program is not
-            # supported yet
-            warnings.warn("pipeline mode: fleet data-parallel transpile "
-                          "skipped (pipeline engine owns the mesh).")
+        pcfg = getattr(loss.block.program, "_pipeline_cfg", None)
+        if st.pipeline or pcfg is not None:
+            # dp x pp composition: the pipeline engine owns the mesh;
+            # fleet contributes the data-parallel degree (devices not
+            # consumed by pipeline stages become replicas of the whole
+            # pipeline — reference: fleet pipeline+collective mode,
+            # optimizer.py:3634 + transpiler/collective.py:178)
+            if pcfg is None:
+                warnings.warn("strategy.pipeline is set but the inner "
+                              "optimizer is not a PipelineOptimizer; no "
+                              "pipeline cut to replicate.")
+            else:
+                import jax
+
+                from ..parallel.pipeline import n_pipeline_stages
+
+                n_stages = n_pipeline_stages(loss.block.program)
+                n_dev = len(jax.devices())
+                dp = max(1, n_dev // n_stages)
+                if dp * n_stages != n_dev:
+                    warnings.warn(
+                        "pipeline dp x pp: %d devices not divisible by %d "
+                        "stages; using dp=%d over the first %d devices"
+                        % (n_dev, n_stages, dp, dp * n_stages))
+                pcfg["dp"] = dp
         else:
             dgc_cfg = None
             if getattr(st, "dgc", False):

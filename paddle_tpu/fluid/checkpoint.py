@@ -1,0 +1,285 @@
+"""Preemption-aware checkpoint / auto-resume.
+
+Reference parity: `python/paddle/fluid/incubate/fleet/collective/
+__init__.py:155-341` — numbered `__paddle_fleet_checkpoint__.N`
+directories holding persistables + a `fleet_train_status` JSON
+(epoch_no), atomic tmp-then-move publication, redundant-checkpoint
+retention, and load-latest on restart.
+
+TPU-native design (SURVEY.md §5: TPU pods are preemptible; periodic
+checkpoint + auto-resume replaces the reference's HDFS failover story):
+- the on-disk layout and TrainStatus contract match the reference, with
+  step_no added (TPU steps are the natural grain, not just epochs);
+- saving can be ASYNC: jax arrays are immutable, so snapshotting is a
+  ref-grab on the training thread; the device->host copy and file write
+  happen on a background worker, overlapping the next steps (the
+  reference blocks the trainer for the whole HDFS upload);
+- publication is atomic (`os.replace` of a tmp dir), so a preemption
+  mid-save can never leave a corrupt "latest" checkpoint.
+"""
+from __future__ import annotations
+
+import json
+import os
+import queue
+import shutil
+import threading
+
+import numpy as np
+
+from .io import _save_dict, _load_dict, is_persistable
+from ..core.scope import global_scope
+
+_CHECKPOINT_PREFIX = "__paddle_tpu_checkpoint__"
+_STATUS_FILE = "train_status.json"
+_PARAM_FILE = "persistables.pkl"
+
+__all__ = [
+    "TrainStatus", "save_checkpoint", "load_checkpoint",
+    "get_last_checkpoint_no", "clean_redundant_checkpoints",
+    "AsyncCheckpointer", "publish_checkpoint_dir", "read_status",
+    "latest_checkpoint_dir",
+]
+
+
+class TrainStatus:
+    """Progress marker stored with each checkpoint (reference:
+    collective/__init__.py:49 TrainStatus, epoch_no only; step_no and a
+    free-form extra dict added)."""
+
+    def __init__(self, epoch_no=-1, step_no=-1, extra=None):
+        self._epoch_no = int(epoch_no)
+        self._step_no = int(step_no)
+        self._extra = dict(extra or {})
+
+    @property
+    def epoch_no(self):
+        return self._epoch_no
+
+    @property
+    def step_no(self):
+        return self._step_no
+
+    @property
+    def extra(self):
+        return self._extra
+
+    def next(self):
+        """First epoch still to run (reference semantics: epoch_no is the
+        last COMPLETED epoch)."""
+        return self._epoch_no + 1
+
+    def __eq__(self, t):
+        return (isinstance(t, TrainStatus)
+                and self._epoch_no == t._epoch_no
+                and self._step_no == t._step_no)
+
+    def __ne__(self, t):
+        return not self == t
+
+    def _to_dict(self):
+        return {"epoch_no": self._epoch_no, "step_no": self._step_no,
+                "extra": self._extra}
+
+    @staticmethod
+    def _from_dict(d):
+        return TrainStatus(d.get("epoch_no", -1), d.get("step_no", -1),
+                           d.get("extra"))
+
+
+def _ckpt_dirs(root):
+    out = {}
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return out
+    for nm in names:
+        parts = nm.split(".")
+        if len(parts) != 2 or parts[0] != _CHECKPOINT_PREFIX:
+            continue
+        try:
+            out[int(parts[1])] = os.path.join(root, nm)
+        except ValueError:
+            continue
+    return out
+
+
+def get_last_checkpoint_no(root):
+    """Largest published checkpoint number under root, or -1."""
+    nos = _ckpt_dirs(root)
+    return max(nos) if nos else -1
+
+
+def clean_redundant_checkpoints(root, checkpoint_num=1):
+    """Keep the newest `checkpoint_num` numbered dirs (reference:
+    clean_redundant_checkpoints, collective/__init__.py:206)."""
+    checkpoint_num = max(int(checkpoint_num), 1)
+    dirs = _ckpt_dirs(root)
+    if not dirs:
+        return
+    cutoff = max(dirs) - checkpoint_num
+    for n, path in dirs.items():
+        if n <= cutoff:
+            shutil.rmtree(path, ignore_errors=True)
+
+
+def _snapshot(main_program, scope=None):
+    """Snapshot the program's persistables NOW as device-side COPIES
+    (async-dispatched HBM copy, ~ms): the executor donates state buffers
+    into the next step, so holding the original refs across steps would
+    read deleted arrays. The device->host transfer still happens on the
+    writer thread."""
+    import jax
+    import jax.numpy as jnp
+
+    from . import framework
+
+    program = main_program or framework.default_main_program()
+    scope = scope or global_scope()
+    snap = {}
+    for var in program.list_vars():
+        if is_persistable(var):
+            v = scope.find_var(var.name)
+            if v is None:
+                continue
+            snap[var.name] = (jnp.copy(v) if isinstance(v, jax.Array)
+                              else np.array(v, copy=True))
+    return snap
+
+
+def publish_checkpoint_dir(root, write_fn, train_status, checkpoint_num):
+    """Atomic numbered publication: `write_fn(tmp_dir)` materializes the
+    payload into a tmp dir, which is then os.replace'd to
+    `<root>/<prefix>.<N+1>` with the TrainStatus JSON beside it — a
+    preemption mid-save can never leave a corrupt latest checkpoint."""
+    os.makedirs(root, exist_ok=True)
+    n = get_last_checkpoint_no(root) + 1
+    real = os.path.join(root, "%s.%d" % (_CHECKPOINT_PREFIX, n))
+    tmp = real + ".tmp"
+    shutil.rmtree(tmp, ignore_errors=True)
+    os.makedirs(tmp)
+    write_fn(tmp)
+    with open(os.path.join(tmp, _STATUS_FILE), "w") as f:
+        json.dump(train_status._to_dict(), f)
+    os.replace(tmp, real)
+    if checkpoint_num:
+        clean_redundant_checkpoints(root, checkpoint_num)
+    return real
+
+
+def read_status(ckpt_dir):
+    """TrainStatus of one published checkpoint dir."""
+    with open(os.path.join(ckpt_dir, _STATUS_FILE)) as f:
+        return TrainStatus._from_dict(json.load(f))
+
+
+def latest_checkpoint_dir(root):
+    """Path of the newest published checkpoint under root, or None."""
+    n = get_last_checkpoint_no(root)
+    if n < 0:
+        return None
+    return os.path.join(root, "%s.%d" % (_CHECKPOINT_PREFIX, n))
+
+
+def _write_checkpoint(root, snap, train_status, checkpoint_num):
+    return publish_checkpoint_dir(
+        root,
+        lambda tmp: _save_dict(
+            tmp, {k: np.asarray(v) for k, v in snap.items()},
+            _PARAM_FILE),
+        train_status, checkpoint_num)
+
+
+def save_checkpoint(executor, path, train_status=None, main_program=None,
+                    checkpoint_num=3, scope=None):
+    """Synchronous numbered checkpoint of all persistables (parameters +
+    optimizer state + BN stats) with TrainStatus. Reference:
+    save_checkpoint collective/__init__.py:236."""
+    snap = _snapshot(main_program, scope)
+    return _write_checkpoint(path, snap, train_status or TrainStatus(),
+                             checkpoint_num)
+
+
+def load_checkpoint(executor, path, main_program=None, scope=None,
+                    ignore_empty=True):
+    """Restore the LATEST numbered checkpoint; returns its TrainStatus,
+    or None when no checkpoint exists (reference: load_checkpoint
+    collective/__init__.py:294)."""
+    import jax.numpy as jnp
+
+    from . import framework
+
+    n = get_last_checkpoint_no(path)
+    if n < 0:
+        if not ignore_empty:
+            raise RuntimeError("no checkpoint found under %r" % path)
+        return None
+    real = latest_checkpoint_dir(path)
+    program = main_program or framework.default_main_program()
+    scope = scope or global_scope()
+    names = [v.name for v in program.list_vars() if is_persistable(v)]
+    d = _load_dict(real, names, _PARAM_FILE)
+    missing = [nm for nm in names if nm not in d]
+    if missing:
+        raise RuntimeError("checkpoint %r is missing vars %s"
+                           % (real, missing))
+    for nm in names:
+        scope.set_var(nm, jnp.asarray(d[nm]))
+    with open(os.path.join(real, _STATUS_FILE)) as f:
+        return TrainStatus._from_dict(json.load(f))
+
+
+class AsyncCheckpointer:
+    """Background checkpoint writer: `save_async` snapshots the scope on
+    the caller's thread (ref-grab only) and returns immediately; a worker
+    thread pays the device->host copy and file IO. At most one write is
+    in flight; a save requested while busy replaces the pending one
+    (newest wins — preemption wants the most recent state, not a queue).
+    """
+
+    def __init__(self, path, main_program=None, checkpoint_num=3,
+                 scope=None):
+        self._path = path
+        self._program = main_program
+        self._checkpoint_num = checkpoint_num
+        self._scope = scope
+        self._pending: "queue.Queue" = queue.Queue(maxsize=1)
+        self._err = []
+        self._done = threading.Event()
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name="paddle_tpu-ckpt-writer")
+        self._worker.start()
+
+    def _run(self):
+        while True:
+            item = self._pending.get()
+            if item is None:
+                self._done.set()
+                return
+            snap, status = item
+            try:
+                _write_checkpoint(self._path, snap, status,
+                                  self._checkpoint_num)
+            except BaseException as e:  # noqa: BLE001 - surfaced in wait()
+                self._err.append(e)
+
+    def save_async(self, train_status):
+        snap = _snapshot(self._program, self._scope)
+        item = (snap, train_status)
+        while True:
+            try:
+                self._pending.put_nowait(item)
+                return
+            except queue.Full:
+                try:  # replace the stale pending save
+                    self._pending.get_nowait()
+                except queue.Empty:
+                    pass
+
+    def close(self):
+        """Flush pending saves and stop the worker; re-raises the first
+        background error."""
+        self._pending.put(None)
+        self._done.wait(timeout=120.0)
+        if self._err:
+            raise self._err[0]

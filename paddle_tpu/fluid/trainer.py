@@ -17,6 +17,7 @@ shared scope:
 from __future__ import annotations
 
 import queue
+import sys
 import threading
 
 import numpy as np
@@ -26,12 +27,32 @@ _SENTINEL = object()
 
 def train_from_dataset(executor, program, dataset, scope=None,
                        fetch_list=None, print_period=100,
-                       queue_size=4):
+                       queue_size=4, checkpoint_dir=None,
+                       checkpoint_every_n_steps=0, checkpoint_num=3):
+    """When checkpoint_dir is set, the latest checkpoint under it is
+    restored before training (auto-resume after preemption) and all
+    persistables + TrainStatus are saved asynchronously every
+    checkpoint_every_n_steps steps and at the end (fluid/checkpoint.py;
+    reference: fleet collective save_checkpoint/load_checkpoint,
+    incubate/fleet/collective/__init__.py:236-341)."""
     if dataset is None:
         raise ValueError("dataset is required")
     from . import framework
 
     program = program or framework.default_main_program()
+
+    ckpt = None
+    start_step = 0
+    if checkpoint_dir:
+        from . import checkpoint as ckpt_mod
+
+        status = ckpt_mod.load_checkpoint(executor, checkpoint_dir,
+                                          program, scope=scope)
+        if status is not None:
+            start_step = max(status.step_no, 0)
+        ckpt = ckpt_mod.AsyncCheckpointer(
+            checkpoint_dir, program, checkpoint_num=checkpoint_num,
+            scope=scope)
 
     q: "queue.Queue" = queue.Queue(maxsize=max(int(queue_size), 1))
     feeder_err = []
@@ -73,17 +94,23 @@ def train_from_dataset(executor, program, dataset, scope=None,
             feed = q.get()
             if feed is _SENTINEL:
                 break
+            it += 1
+            if it <= start_step:
+                continue  # already-trained steps of a resumed run
             # return_numpy=False keeps results device-resident: no host
             # sync per step, so the feeder and the next H2D overlap this
             # compute
             results = executor.run(program, feed=feed,
                                    fetch_list=fetch_list, scope=scope,
                                    return_numpy=False)
-            it += 1
             if print_period and fetch_list and it % print_period == 0:
                 vals = [np.asarray(v) for v in results]
                 print("step %d: %s" % (it, [float(np.ravel(v)[0])
                                             for v in vals]))
+            if (ckpt is not None and checkpoint_every_n_steps
+                    and it % checkpoint_every_n_steps == 0):
+                ckpt.save_async(ckpt_mod.TrainStatus(epoch_no=0,
+                                                     step_no=it))
     finally:
         # signal the feeder to stop (don't drain the whole dataset just
         # to surface a step error) and unblock any pending put
@@ -93,6 +120,22 @@ def train_from_dataset(executor, program, dataset, scope=None,
         except queue.Empty:
             pass
         t.join(timeout=5.0)
+        if ckpt is not None:
+            # only publish a final checkpoint when NEW steps ran: a
+            # resumed run over a shorter dataset must not regress the
+            # latest step_no below what the weights already contain
+            if it > start_step:
+                ckpt.save_async(ckpt_mod.TrainStatus(epoch_no=0,
+                                                     step_no=it))
+            # always flush + surface background write errors, even when
+            # a step raised — the pending snapshot is the freshest state
+            # (but never let a checkpoint IO error mask the step error)
+            step_error_in_flight = sys.exc_info()[0] is not None
+            try:
+                ckpt.close()
+            except Exception:  # noqa: BLE001
+                if not step_error_in_flight:
+                    raise
     if feeder_err:
         raise feeder_err[0]
     if results is not None:

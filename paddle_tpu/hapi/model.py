@@ -175,7 +175,14 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1,
             epochs=1, eval_freq=1, log_freq=10, save_dir=None,
             save_freq=1, verbose=2, drop_last=False, shuffle=True,
-            num_workers=0, callbacks=None):
+            num_workers=0, callbacks=None, auto_checkpoint_dir=None,
+            checkpoint_num=3):
+        """With auto_checkpoint_dir set, fit resumes from the latest
+        numbered checkpoint under it (params + optimizer state + the
+        completed-epoch TrainStatus) and publishes a new checkpoint
+        after every epoch — preemption-safe training (reference: fleet
+        collective save/load_checkpoint,
+        incubate/fleet/collective/__init__.py:236-341)."""
         loader = self._make_loader(train_data, batch_size, shuffle,
                                    drop_last, num_workers)
         steps = len(loader) if hasattr(loader, "__len__") else None
@@ -187,10 +194,19 @@ class Model:
             log_freq=log_freq, verbose=verbose, save_freq=save_freq,
             save_dir=save_dir, metrics=metric_names)
 
+        start_epoch = 0
+        if auto_checkpoint_dir:
+            from ..fluid import checkpoint as ckpt_mod
+
+            latest = ckpt_mod.latest_checkpoint_dir(auto_checkpoint_dir)
+            if latest is not None:
+                self.load(os.path.join(latest, "model"))
+                start_epoch = ckpt_mod.read_status(latest).next()
+
         self.stop_training = False
         cbks.on_train_begin({})
         history = []
-        for epoch in range(epochs):
+        for epoch in range(start_epoch, epochs):
             cbks.on_epoch_begin(epoch, {})
             for m in self._metrics:
                 m.reset()
@@ -204,6 +220,14 @@ class Model:
                 cbks.on_train_batch_end(step, logs)
             cbks.on_epoch_end(epoch, logs)
             history.append(dict(logs))
+
+            if auto_checkpoint_dir:
+                from ..fluid import checkpoint as ckpt_mod
+
+                ckpt_mod.publish_checkpoint_dir(
+                    auto_checkpoint_dir,
+                    lambda tmp: self.save(os.path.join(tmp, "model")),
+                    ckpt_mod.TrainStatus(epoch_no=epoch), checkpoint_num)
 
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
                 self.evaluate(eval_data, batch_size=batch_size,

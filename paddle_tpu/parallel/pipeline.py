@@ -34,6 +34,18 @@ def _stage_bounds(fwd_ops, cut_names):
     return lowering._split_at_checkpoints(fwd_ops, cut_names)
 
 
+def n_pipeline_stages(program):
+    """Actual stage count the engine will use for this program — derived
+    from the same op split as compile_pipeline (cut entries that induce
+    no boundary are deduped, so len(cut_names)+1 can overcount)."""
+    cfg = getattr(program, "_pipeline_cfg", None) or {}
+    cut_names = list(cfg.get("cut_names") or [])
+    ops = list(program.global_block().ops)
+    bwd = [i for i, op in enumerate(ops) if op.type == "backward"]
+    fwd_ops = ops[:bwd[0]] if bwd else ops
+    return len(_stage_bounds(fwd_ops, cut_names))
+
+
 def _stage_io(stage_ops_list, feed_names, state_names):
     """Per-stage (inputs, writes): inputs are names read before being
     produced within the stage."""
@@ -57,36 +69,62 @@ def _stage_io(stage_ops_list, feed_names, state_names):
 
 
 class _BoundarySpec:
-    """Packing layout of one pp edge: ordered (name, shape, dtype)."""
+    """Packing layout of one pp edge: dtype-tagged dual ring buffer.
+
+    Float-kind boundary values travel in an f32 lane (bf16/f16 -> f32 is
+    lossless), int/bool-kind values in an i32 lane (int64 is i32 under
+    the default x64-disabled config; bool round-trips) — v2 lifting of
+    the v1 float-only restriction (reference SectionWorker moved typed
+    LoDTensors between sections with no dtype limit,
+    `framework/section_worker.cc:82`)."""
 
     def __init__(self, entries):
-        self.entries = entries  # list of (name, shape, np.dtype)
-        self.sizes = [int(np.prod(s)) if s else 1 for _, s, _ in entries]
-        self.total = sum(self.sizes)
+        self.f_entries = [(n, s, d) for n, s, d in entries
+                          if np.issubdtype(d, np.floating)]
+        self.i_entries = [(n, s, d) for n, s, d in entries
+                          if not np.issubdtype(d, np.floating)]
+        self.f_sizes = [int(np.prod(s)) if s else 1
+                        for _, s, _ in self.f_entries]
+        self.i_sizes = [int(np.prod(s)) if s else 1
+                        for _, s, _ in self.i_entries]
+        self.f_total = sum(self.f_sizes)
+        self.i_total = sum(self.i_sizes)
 
-    def pack(self, env, total_padded):
+    @staticmethod
+    def _pack_lane(env, entries, sizes, total_padded, lane_dtype):
         import jax.numpy as jnp
 
-        if not self.entries:
-            return jnp.zeros((total_padded,), jnp.float32)
         parts = []
-        for (name, shape, dtype), size in zip(self.entries, self.sizes):
-            v = env[name]
-            parts.append(jnp.reshape(v, (-1,)).astype(jnp.float32))
-        flat = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
-        pad = total_padded - self.total
+        for (name, shape, dtype), size in zip(entries, sizes):
+            parts.append(jnp.reshape(env[name], (-1,)).astype(lane_dtype))
+        used = sum(sizes)
+        pad = total_padded - used
         if pad:
-            flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
-        return flat
+            parts.append(jnp.zeros((pad,), lane_dtype))
+        if not parts:
+            return jnp.zeros((total_padded,), lane_dtype)
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
 
-    def unpack(self, buf):
+    def pack(self, env, f_padded, i_padded):
         import jax.numpy as jnp
 
-        out, off = {}, 0
-        for (name, shape, dtype), size in zip(self.entries, self.sizes):
-            piece = buf[off:off + size]
-            out[name] = jnp.reshape(piece, shape).astype(dtype)
-            off += size
+        return (self._pack_lane(env, self.f_entries, self.f_sizes,
+                                f_padded, jnp.float32),
+                self._pack_lane(env, self.i_entries, self.i_sizes,
+                                i_padded, jnp.int32))
+
+    def unpack(self, bufs):
+        import jax.numpy as jnp
+
+        f_buf, i_buf = bufs
+        out = {}
+        for buf, entries, sizes in ((f_buf, self.f_entries, self.f_sizes),
+                                    (i_buf, self.i_entries, self.i_sizes)):
+            off = 0
+            for (name, shape, dtype), size in zip(entries, sizes):
+                piece = buf[off:off + size]
+                out[name] = jnp.reshape(piece, shape).astype(dtype)
+                off += size
         return out
 
 
@@ -104,6 +142,7 @@ def compile_pipeline(program, block, feed_specs, fetch_names, state_specs):
     cfg = program._pipeline_cfg
     cut_names: List[str] = list(cfg.get("cut_names") or [])
     n_micro = int(cfg.get("n_micro", 1))
+    dp = int(cfg.get("dp", 1))  # data-parallel replicas of the pipeline
 
     ops = list(block.ops)
     bwd_idxs = [i for i, op in enumerate(ops) if op.type == "backward"]
@@ -126,14 +165,22 @@ def compile_pipeline(program, block, feed_specs, fetch_names, state_specs):
     stage_base = [a for a, _ in bounds]
     stage_ins, stage_writes = _stage_io(stage_ops, feed_names, state_names)
 
-    # v1 restriction: no persistable writes inside forward sections
-    fwd_state_writes = sorted(
-        n for ws in stage_writes for n in ws
-        if (v := block._find_var_recursive(n)) is not None and v.persistable)
-    if fwd_state_writes:
-        raise NotImplementedError(
-            "pipeline mode does not support in-forward state updates "
-            "(e.g. batch_norm running stats): %s" % fwd_state_writes)
+    # v2: persistable writes inside forward sections (BN running stats)
+    # are carried through the scan on the owning stage and written back
+    # once per step. Each such var must have exactly one owning stage.
+    fwd_write_owner = {}  # var name -> owning stage
+    for s, ws in enumerate(stage_writes):
+        for n in sorted(ws):
+            v = block._find_var_recursive(n)
+            if v is None or not v.persistable:
+                continue
+            if n in fwd_write_owner:
+                raise NotImplementedError(
+                    "pipeline: state var %r is updated by two stages "
+                    "(%d and %d) — a cut must not split a stateful "
+                    "layer" % (n, fwd_write_owner[n], s))
+            fwd_write_owner[n] = s
+    fwd_write_names = sorted(fwd_write_owner)
 
     produced_upto = []  # names produced by stages <= s
     acc = set()
@@ -142,10 +189,11 @@ def compile_pipeline(program, block, feed_specs, fetch_names, state_specs):
         produced_upto.append(set(acc))
 
     batch0 = next(iter(feed_specs.values())).shape[0]
-    if batch0 % n_micro:
-        raise ValueError("batch size %d not divisible by num_microbatches "
-                         "%d" % (batch0, n_micro))
-    mb = batch0 // n_micro
+    if batch0 % (n_micro * dp):
+        raise ValueError(
+            "batch size %d not divisible by num_microbatches %d x "
+            "dp_degree %d" % (batch0, n_micro, dp))
+    mb = batch0 // (n_micro * dp)  # per-replica microbatch
 
     params_by_stage = []
     for s in range(S):
@@ -188,26 +236,28 @@ def compile_pipeline(program, block, feed_specs, fetch_names, state_specs):
         entries = []
         for n in carry:
             st = env_struct[n]
-            if not np.issubdtype(np.dtype(str(st.dtype)), np.floating):
-                raise NotImplementedError(
-                    "pipeline boundary value %r has non-float dtype %s"
-                    % (n, st.dtype))
             entries.append((n, tuple(st.shape), np.dtype(str(st.dtype))))
         edge_entry_lists.append(entries)
 
     edge_specs = [_BoundarySpec(e) for e in edge_entry_lists]
-    buf_elems = max([es.total for es in edge_specs] + [1])
+    f_buf_elems = max([es.f_total for es in edge_specs] + [1])
+    i_buf_elems = max([es.i_total for es in edge_specs] + [1])
 
     diff_names = [n for n in bop.attrs.get("diff_names", [])
                   if n in state_names]
 
-    # device mesh over the first S devices
+    # device mesh: (dp, pp) when data-parallel replicas of the pipeline
+    # were requested (fleet DP + PipelineOptimizer), else 1-D 'pp'
     devices = jax.devices()
-    if len(devices) < S:
+    if len(devices) < dp * S:
         raise RuntimeError(
-            "pipeline has %d stages but only %d devices" % (S,
-                                                            len(devices)))
-    mesh = Mesh(np.array(devices[:S]), ("pp",))
+            "pipeline needs dp x stages = %d x %d devices but only %d "
+            "available" % (dp, S, len(devices)))
+    if dp > 1:
+        mesh = Mesh(np.array(devices[:dp * S]).reshape(dp, S),
+                    ("dp", "pp"))
+    else:
+        mesh = Mesh(np.array(devices[:S]), ("pp",))
 
     from jax.sharding import PartitionSpec as P
 
@@ -217,10 +267,11 @@ def compile_pipeline(program, block, feed_specs, fetch_names, state_specs):
         env0.update(states_mut)
         key0 = jax.random.PRNGKey(seed)
 
-        # [n_micro, mb, ...] microbatched feeds
+        # [n_micro, dp*mb, ...] microbatched feeds; shard_map splits the
+        # second axis over 'dp' so each replica sees [n_micro, mb, ...]
         feeds_mb = {
             n: jnp.reshape(jnp.asarray(a),
-                           (n_micro, mb) + tuple(a.shape[1:]))
+                           (n_micro, dp * mb) + tuple(a.shape[1:]))
             for n, a in feeds.items()}
 
         params = {n: env0[n] for n in state_names if n in env0}
@@ -231,74 +282,129 @@ def compile_pipeline(program, block, feed_specs, fetch_names, state_specs):
         def device_step(diff_p, other_st, f_mb):
             stage = lax.axis_index("pp")
 
-            def fwd_loss(dp):
+            def fwd_loss(dparams):
                 st_all = dict(other_st)
-                st_all.update(dp)
+                st_all.update(dparams)
+                fst0 = {n: st_all[n] for n in fwd_write_names}
 
                 def pipe_body(carry, t):
-                    buf, loss_acc = carry
+                    buf, loss_acc, fst = carry
 
                     def make_branch(s):
-                        def br(b):
+                        def br(operand):
+                            b, fst_in = operand
                             mb_idx = jnp.clip(t - s, 0, n_micro - 1)
                             e = {}
                             for n in params_by_stage[s]:
                                 e[n] = st_all[n]
+                            # in-forward state (BN stats): read the
+                            # scan-carried value, not the step-start one
+                            for n in fwd_write_names:
+                                if n in e or fwd_write_owner[n] == s:
+                                    e[n] = fst_in[n]
                             for n in feeds_by_stage[s]:
                                 e[n] = f_mb[n][mb_idx]
                             if s > 0:
                                 e.update(edge_specs[s - 1].unpack(b))
                             key = jax.random.fold_in(key0, mb_idx)
                             run_stage(s, e, key)
-                            out_buf = edge_specs[s].pack(e, buf_elems) \
+                            out_buf = edge_specs[s].pack(
+                                e, f_buf_elems, i_buf_elems) \
                                 if s < S - 1 else \
-                                jnp.zeros((buf_elems,), jnp.float32)
+                                (jnp.zeros((f_buf_elems,), jnp.float32),
+                                 jnp.zeros((i_buf_elems,), jnp.int32))
                             if s == S - 1:
                                 l = jnp.mean(
                                     e[loss_name].astype(jnp.float32))
                             else:
                                 l = jnp.float32(0.0)
-                            return out_buf, l
+                            # state updates only count when a real
+                            # microbatch is flowing through this stage
+                            # (fill/drain replays must not touch stats)
+                            active = jnp.logical_and(t >= s,
+                                                     t - s < n_micro)
+                            fst_out = {}
+                            for n in fwd_write_names:
+                                if fwd_write_owner[n] == s:
+                                    fst_out[n] = jnp.where(
+                                        active, e[n].astype(
+                                            fst_in[n].dtype), fst_in[n])
+                                else:
+                                    fst_out[n] = fst_in[n]
+                            return out_buf, l, fst_out
 
                         return br
 
-                    out_buf, l = lax.switch(
-                        stage, [make_branch(s) for s in range(S)], buf)
+                    out_buf, l, fst = lax.switch(
+                        stage, [make_branch(s) for s in range(S)],
+                        (buf, fst))
                     valid = jnp.logical_and(stage == S - 1,
                                             t >= S - 1)
                     loss_acc = loss_acc + jnp.where(valid, l, 0.0)
                     if S > 1:
                         perm = [(i, (i + 1) % S) for i in range(S)]
-                        out_buf = lax.ppermute(out_buf, "pp", perm)
-                    return (out_buf, loss_acc), None
+                        out_buf = jax.tree.map(
+                            lambda x: lax.ppermute(x, "pp", perm),
+                            out_buf)
+                    return (out_buf, loss_acc, fst), None
 
-                buf0 = jnp.zeros((buf_elems,), jnp.float32)
-                (_, loss_acc), _ = lax.scan(
-                    pipe_body, (buf0, jnp.float32(0.0)),
+                buf0 = (jnp.zeros((f_buf_elems,), jnp.float32),
+                        jnp.zeros((i_buf_elems,), jnp.int32))
+                (_, loss_acc, fst_fin), _ = lax.scan(
+                    pipe_body, (buf0, jnp.float32(0.0), fst0),
                     jnp.arange(n_micro + S - 1))
                 # local mean-of-microbatch losses; nonzero only on the
                 # last stage. Do NOT psum here: psum's transpose is psum,
                 # so a collective inside the differentiated function would
                 # multiply every cotangent by the pp group size.
-                return loss_acc / n_micro
+                # The final in-forward state rides out as aux (BN stat
+                # updates are not a differentiable path — stop_gradient
+                # keeps the scan transpose clean).
+                aux = jax.tree.map(lax.stop_gradient, fst_fin)
+                return loss_acc / n_micro, aux
 
-            loss_local, grads = jax.value_and_grad(fwd_loss)(diff_p)
+            (loss_local, fst_fin), grads = jax.value_and_grad(
+                fwd_loss, has_aux=True)(diff_p)
             # each device now holds exactly its own stage's grads (the
             # ppermute transpose routed the last stage's cotangent back
             # through the ring); one psum replicates the full gradient
-            # and the scalar loss everywhere.
+            # and the scalar loss everywhere. With dp replicas, each
+            # replica's loss/grads are means over its batch shard, so a
+            # pmean over 'dp' gives the global-batch mean — the same
+            # GradAllReduce semantics as fleet's plain DP transpile.
             loss = lax.psum(loss_local, "pp")
             grads = jax.tree.map(lambda g: lax.psum(g, "pp"), grads)
-            return loss, grads
+            if dp > 1:
+                loss = lax.pmean(loss, "dp")
+                grads = jax.tree.map(lambda g: lax.pmean(g, "dp"), grads)
+            # in-forward state: only the owning stage's device holds a
+            # var's updated value, so broadcast each var's delta from its
+            # owner over the ring (non-owners contribute zero); with dp,
+            # replicas saw different batch shards — average their stats
+            # (local-BN semantics, like the reference's non-sync BN).
+            new_fst = {}
+            for n in fwd_write_names:
+                init = (dict(other_st, **diff_p))[n]
+                delta = jnp.where(stage == fwd_write_owner[n],
+                                  fst_fin[n].astype(jnp.float32)
+                                  - init.astype(jnp.float32), 0.0)
+                delta = lax.psum(delta, "pp")
+                if dp > 1:
+                    delta = lax.pmean(delta, "dp")
+                new_fst[n] = (init.astype(jnp.float32)
+                              + delta).astype(init.dtype)
+            return loss, grads, new_fst
 
+        feeds_spec = P(None, "dp") if dp > 1 else P()
         smapped = jax.shard_map(
             device_step, mesh=mesh,
-            in_specs=(P(), P(), P()),
-            out_specs=(P(), P()),
+            in_specs=(P(), P(), feeds_spec),
+            out_specs=(P(), P(), P()),
             check_vma=False)
-        loss, grads = smapped(diff_params, other_state, feeds_mb)
+        loss, grads, new_fst = smapped(diff_params, other_state, feeds_mb)
 
         env = dict(env0)
+        env.update(new_fst)  # in-forward state (BN stats) written back
         env.update(feeds)  # full-batch feeds stay visible downstream
         loss_var = block._find_var_recursive(loss_name)
         loss_shaped = jnp.reshape(

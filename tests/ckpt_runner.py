@@ -1,0 +1,95 @@
+"""Subprocess runner for the preemption/auto-resume test: trains a
+seeded MLP via train_from_dataset with per-step async checkpoints; when
+KILL_AFTER_STEP is set, simulates a preemption by hard-exiting mid-run.
+Prints "STEP <n> <loss>" lines for the parent to compare."""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import paddle_tpu.fluid as fluid  # noqa: E402
+from paddle_tpu.fluid import framework  # noqa: E402
+from paddle_tpu.fluid.trainer import train_from_dataset  # noqa: E402
+
+N_STEPS = 8
+
+
+class _FixedDataset:
+    """8 deterministic batches; the loop contract is just
+    _iter_batches()."""
+
+    def __init__(self):
+        r = np.random.RandomState(42)
+        self.batches = [
+            {"x": r.rand(16, 8).astype("float32"),
+             "label": r.randint(0, 4, (16, 1)).astype("int64")}
+            for _ in range(N_STEPS)]
+
+    def _iter_batches(self):
+        yield from self.batches
+
+
+class _PreemptingExecutor(fluid.Executor):
+    """Hard-exits after KILL_AFTER_STEP training steps — like a TPU-pod
+    preemption, which sends a grace signal and then kills the process;
+    the grace here is a short poll for the async writer to publish (the
+    atomic tmp->mv publish means a kill mid-write just discards the tmp
+    dir)."""
+
+    def __init__(self, place, ckpt_dir):
+        super().__init__(place)
+        self._steps_run = 0
+        self._ckpt_dir = ckpt_dir
+        self._kill_after = int(os.environ.get("KILL_AFTER_STEP", "0"))
+
+    def run(self, *args, **kwargs):
+        out = super().run(*args, **kwargs)
+        self._steps_run += 1
+        if self._kill_after and self._steps_run >= self._kill_after + 1:
+            # +1: the startup program run was counted too
+            import time
+
+            from paddle_tpu.fluid import checkpoint as ckpt_mod
+
+            deadline = time.time() + 15.0
+            while (time.time() < deadline
+                   and ckpt_mod.get_last_checkpoint_no(
+                       self._ckpt_dir) < 0):
+                time.sleep(0.1)
+            os._exit(9)
+        return out
+
+
+def main(ckpt_dir):
+    main_p, startup = framework.Program(), framework.Program()
+    main_p.random_seed = startup.random_seed = 77
+    with framework.program_guard(main_p, startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+            label = fluid.layers.data(name="label", shape=[1],
+                                      dtype="int64")
+            h = fluid.layers.fc(input=x, size=16, act="relu")
+            logits = fluid.layers.fc(input=h, size=4)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, label))
+            fluid.optimizer.SGDOptimizer(learning_rate=0.5).minimize(loss)
+
+            exe = _PreemptingExecutor(fluid.CPUPlace(), ckpt_dir)
+            exe.run(startup)
+
+            train_from_dataset(
+                exe, main_p, _FixedDataset(), fetch_list=[loss],
+                print_period=1, checkpoint_dir=ckpt_dir,
+                checkpoint_every_n_steps=1)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

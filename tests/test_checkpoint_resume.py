@@ -1,0 +1,111 @@
+"""Preemption-aware checkpoint / auto-resume (VERDICT r2 next #5;
+reference: fleet collective save/load_checkpoint,
+incubate/fleet/collective/__init__.py:155-341)."""
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import checkpoint as ckpt
+from paddle_tpu.fluid import framework
+
+_RUNNER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "ckpt_runner.py")
+
+
+def _build_mlp(seed=5):
+    x = fluid.layers.data(name="x", shape=[6], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(input=x, size=8, act="relu")
+    logits = fluid.layers.fc(input=h, size=3)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, label))
+    fluid.optimizer.AdamOptimizer(learning_rate=0.1).minimize(loss)
+    return loss
+
+
+def test_save_load_roundtrip_and_retention(tmp_path, rng):
+    root = str(tmp_path / "ckpts")
+    loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feed = {"x": rng.rand(8, 6).astype("float32"),
+            "label": rng.randint(0, 3, (8, 1)).astype("int64")}
+
+    losses = []
+    for step in range(5):
+        out = exe.run(feed=feed, fetch_list=[loss])
+        losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+        ckpt.save_checkpoint(exe, root,
+                             ckpt.TrainStatus(epoch_no=0, step_no=step),
+                             checkpoint_num=2)
+
+    # retention: only the newest 2 numbered dirs remain
+    nums = sorted(int(d.split(".")[1]) for d in os.listdir(root))
+    assert nums == [3, 4]
+    assert ckpt.get_last_checkpoint_no(root) == 4
+
+    # corrupt-latest protection: a stray tmp dir is ignored
+    os.makedirs(os.path.join(root, "__paddle_tpu_checkpoint__.9.tmp"))
+    assert ckpt.get_last_checkpoint_no(root) == 4
+
+    # mutate params, then restore: the next step must reproduce step 5's
+    # loss trajectory
+    out_drift = exe.run(feed=feed, fetch_list=[loss])
+    status = ckpt.load_checkpoint(exe, root)
+    assert status.step_no == 4 and status.epoch_no == 0
+    out = exe.run(feed=feed, fetch_list=[loss])
+    drift = float(np.asarray(out_drift[0]).reshape(-1)[0])
+    restored = float(np.asarray(out[0]).reshape(-1)[0])
+    assert restored == pytest.approx(drift, rel=1e-5)  # same params again
+
+
+def test_load_checkpoint_empty_dir(tmp_path):
+    _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    assert ckpt.load_checkpoint(exe, str(tmp_path / "nope")) is None
+    with pytest.raises(RuntimeError, match="no checkpoint"):
+        ckpt.load_checkpoint(exe, str(tmp_path / "nope"),
+                             ignore_empty=False)
+
+
+def _run_runner(ckpt_dir, kill_after=0):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    if kill_after:
+        env["KILL_AFTER_STEP"] = str(kill_after)
+    proc = subprocess.run(
+        [sys.executable, _RUNNER, ckpt_dir], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        timeout=420)
+    steps = {}
+    for m in re.finditer(r"step (\d+): \[([-\d.e]+)\]", proc.stdout):
+        steps[int(m.group(1))] = float(m.group(2))
+    return proc.returncode, steps, proc.stdout
+
+
+def test_kill_and_resume_matches_uninterrupted(tmp_path):
+    """The VERDICT done-criterion: train, hard-kill mid-run (simulated
+    preemption), restart with the same command; the resumed run's
+    per-step losses must match the uninterrupted run's."""
+    base_rc, base_steps, base_out = _run_runner(str(tmp_path / "a"))
+    assert base_rc == 0 and len(base_steps) == 8, base_out
+
+    dir_b = str(tmp_path / "b")
+    rc1, steps1, out1 = _run_runner(dir_b, kill_after=4)
+    assert rc1 == 9  # preempted
+    assert ckpt.get_last_checkpoint_no(dir_b) >= 0  # something published
+
+    rc2, steps2, out2 = _run_runner(dir_b)
+    assert rc2 == 0, out2
+    assert steps2, "resumed run executed no steps"
+    # the resumed run must pick up AFTER the published checkpoint, not
+    # from scratch
+    assert min(steps2) > 1
+    for step, loss_v in steps2.items():
+        assert loss_v == pytest.approx(base_steps[step], rel=1e-4), (
+            step, loss_v, base_steps[step], out2)

@@ -123,3 +123,44 @@ def test_save_load_optimizer_state(tmp_path, prepared_model):
     assert accs, "Adam accumulators missing"
     total = sum(float(np.abs(v.numpy()).sum()) for v in accs.values())
     assert total > 0.0
+
+
+def test_fit_auto_checkpoint_resume(tmp_path):
+    """fit(auto_checkpoint_dir=...) publishes a numbered checkpoint per
+    epoch and a fresh Model resumes from the last completed epoch
+    (VERDICT r2 next #5; reference: fleet collective checkpoints)."""
+    from paddle_tpu.fluid import checkpoint as ckpt
+
+    root = str(tmp_path / "auto")
+    data = SyntheticImages(num_samples=64)
+
+    m1 = make_model()
+    m1.prepare(optimizer=paddle.fluid.optimizer.AdamOptimizer(
+        learning_rate=1e-2),
+        loss_function=paddle.nn.CrossEntropyLoss(), metrics=Accuracy())
+    h1 = m1.fit(data, batch_size=32, epochs=2, verbose=0, shuffle=False,
+                auto_checkpoint_dir=root)
+    assert len(h1) == 2
+    latest = ckpt.latest_checkpoint_dir(root)
+    assert latest is not None
+    assert ckpt.read_status(latest).epoch_no == 1
+
+    # a NEW process/model pointed at the same dir resumes at epoch 2
+    m2 = make_model()
+    m2.prepare(optimizer=paddle.fluid.optimizer.AdamOptimizer(
+        learning_rate=1e-2),
+        loss_function=paddle.nn.CrossEntropyLoss(), metrics=Accuracy())
+    h2 = m2.fit(data, batch_size=32, epochs=4, verbose=0, shuffle=False,
+                auto_checkpoint_dir=root, checkpoint_num=2)
+    assert len(h2) == 2  # only epochs 2 and 3 ran
+    assert ckpt.read_status(ckpt.latest_checkpoint_dir(root)).epoch_no == 3
+
+    # retention kept the newest 2 numbered dirs
+    import os as _os
+
+    nums = sorted(int(d.split(".")[1]) for d in _os.listdir(root)
+                  if not d.endswith(".tmp"))
+    assert len(nums) == 2
+
+    # resumed training kept improving rather than restarting
+    assert h2[-1]["loss"] < h1[0]["loss"]

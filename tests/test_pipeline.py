@@ -93,31 +93,157 @@ def test_pipeline_single_stage_grad_accumulation():
     assert np.isfinite(float(np.asarray(out[0]).reshape(-1)[0]))
 
 
-def test_pipeline_rejects_bn_state_updates():
-    """v1 restriction is loud: in-forward state updates raise."""
+def test_fleet_dp_pipeline_matches_nonpipelined():
+    """Fleet DP x PipelineOptimizer (2 stages x 4 replicas on the 8-dev
+    mesh) matches the plain single-computation program: GPipe microbatch
+    accumulation is exact and the dp pmean reproduces the global-batch
+    mean (VERDICT r2 next #3)."""
+    from paddle_tpu import fleet
+    from paddle_tpu.core.scope import Scope
+
+    base = _run(pipeline=False, steps=5)
+
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = startup.random_seed = 5
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data(name="x", shape=[32], dtype="float32")
+            label = fluid.layers.data(name="label", shape=[1],
+                                      dtype="int64")
+            h1 = fluid.layers.fc(input=x, size=64, act="relu")
+            h2 = fluid.layers.fc(input=h1, size=64, act="relu")
+            logits = fluid.layers.fc(input=h2, size=10)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, label))
+            fleet.init(is_collective=True)
+            opt = fleet.distributed_optimizer(
+                fluid.optimizer.PipelineOptimizer(
+                    fluid.optimizer.SGDOptimizer(learning_rate=0.2),
+                    cut_list=[[h1]], num_microbatches=4))
+            opt.minimize(loss)
+    assert main._pipeline_cfg["dp"] == 4  # 8 devices / 2 stages
+
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    r = np.random.RandomState(3)
+    x_ = r.rand(32, 32).astype("float32")
+    y_ = r.randint(0, 10, (32, 1)).astype("int64")
+    losses = []
+    for _ in range(5):
+        out = exe.run(main, feed={"x": x_, "label": y_},
+                      fetch_list=[loss], scope=scope)
+        losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+    np.testing.assert_allclose(losses, base, rtol=2e-5, atol=2e-5)
+
+
+def _build_bn_net(cut, n_micro=2, lr=0.1):
+    """conv+BN ResNet-stem-style net; BN lives on stage 0 when cut."""
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = startup.random_seed = 11
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data(name="x", shape=[4, 8, 8],
+                                  dtype="float32")
+            label = fluid.layers.data(name="label", shape=[1],
+                                      dtype="int64")
+            h = fluid.layers.batch_norm(input=x, momentum=0.9)
+            h = fluid.layers.conv2d(h, num_filters=8, filter_size=3,
+                                    padding=1, act="relu")
+            cut_var = fluid.layers.pool2d(h, pool_size=2, pool_stride=2,
+                                          pool_type="avg")
+            flat = fluid.layers.reshape(cut_var, [-1, 8 * 4 * 4])
+            logits = fluid.layers.fc(input=flat, size=4)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, label))
+            opt = fluid.optimizer.PipelineOptimizer(
+                fluid.optimizer.SGDOptimizer(learning_rate=lr),
+                cut_list=[[cut_var]] if cut else [],
+                num_microbatches=n_micro)
+            opt.minimize(loss)
+    return main, startup, loss
+
+
+def _run_bn(cut, steps=4, n_micro=2):
+    from paddle_tpu.core.scope import Scope
+
+    main, startup, loss = _build_bn_net(cut, n_micro=n_micro)
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    r = np.random.RandomState(9)
+    x = (r.rand(8, 4, 8, 8) * 2).astype("float32")
+    y = r.randint(0, 4, (8, 1)).astype("int64")
+    losses = []
+    for _ in range(steps):
+        out = exe.run(main, feed={"x": x, "label": y},
+                      fetch_list=[loss], scope=scope)
+        losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+    # fetch BN running stats from the scope
+    bn_mean = bn_var = None
+    for name in scope.local_var_names():
+        if "batch_norm" in name and ".mean" in name:
+            bn_mean = np.asarray(scope.find_var(name))
+        if "batch_norm" in name and ".var" in name:
+            bn_var = np.asarray(scope.find_var(name))
+    return losses, bn_mean, bn_var, x
+
+
+def test_pipeline_bn_stats_v2():
+    """v2: BN running-stat updates inside pipeline stages are carried
+    through the scan and written back (VERDICT r2 next #4). Cut vs
+    no-cut pipelines are bit-equivalent (stage splitting never changes
+    math; both microbatch identically), and the running mean after one
+    step equals the numpy sequential per-microbatch update."""
+    base_losses, base_mean, base_var, _ = _run_bn(cut=False, steps=4)
+    pp_losses, pp_mean, pp_var, x = _run_bn(cut=True, steps=4)
+    np.testing.assert_allclose(pp_losses, base_losses, rtol=2e-5,
+                               atol=2e-5)
+    np.testing.assert_allclose(pp_mean, base_mean, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(pp_var, base_var, rtol=1e-5, atol=1e-6)
+    assert pp_losses[-1] < pp_losses[0]
+
+    # one-step numpy check of the sequential microbatch update:
+    # mb_k = rows [k*4:(k+1)*4]; mean <- 0.9*mean + 0.1*mu_k, twice
+    _, mean1, _, _ = _run_bn(cut=True, steps=1)
+    m = np.zeros(4)
+    for k in range(2):
+        mu = x[k * 4:(k + 1) * 4].mean(axis=(0, 2, 3))
+        m = 0.9 * m + 0.1 * mu
+    np.testing.assert_allclose(mean1, m, rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_typed_int_boundary():
+    """v2: non-float boundary values cross the cut in the i32 lane of
+    the dtype-tagged ring buffer (v1 raised on them)."""
     from paddle_tpu.core.scope import Scope
 
     main, startup = framework.Program(), framework.Program()
+    main.random_seed = startup.random_seed = 13
     with framework.program_guard(main, startup):
         with framework.unique_name_guard():
             x = fluid.layers.data(name="x", shape=[16], dtype="float32")
             label = fluid.layers.data(name="label", shape=[1],
                                       dtype="int64")
-            h = fluid.layers.fc(input=x, size=16)
-            h = fluid.layers.batch_norm(input=h)
-            cut = fluid.layers.fc(input=h, size=16, act="relu")
-            logits = fluid.layers.fc(input=cut, size=4)
+            h = fluid.layers.fc(input=x, size=12)
+            ids = fluid.layers.argmax(h, axis=1)  # int64 boundary
+            emb = fluid.layers.embedding(ids, size=[12, 8])
+            logits = fluid.layers.fc(input=emb, size=4)
             loss = fluid.layers.mean(
                 fluid.layers.softmax_with_cross_entropy(logits, label))
             opt = fluid.optimizer.PipelineOptimizer(
                 fluid.optimizer.SGDOptimizer(learning_rate=0.1),
-                cut_list=[[cut]], num_microbatches=2)
+                cut_list=[[ids]], num_microbatches=2)
             opt.minimize(loss)
     scope = Scope()
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(startup, scope=scope)
-    with pytest.raises(NotImplementedError, match="state update"):
-        exe.run(main,
-                feed={"x": np.zeros((8, 16), "float32"),
-                      "label": np.zeros((8, 1), "int64")},
-                fetch_list=[loss], scope=scope)
+    r = np.random.RandomState(3)
+    feed = {"x": r.rand(8, 16).astype("float32"),
+            "label": r.randint(0, 4, (8, 1)).astype("int64")}
+    losses = []
+    for _ in range(6):
+        out = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+        losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]  # embedding/fc on stage 1 still learn
