@@ -1,0 +1,57 @@
+"""CoNLL-2005 SRL reader creators (reference:
+`python/paddle/dataset/conll05.py`: get_dict() -> (word, verb, label)
+dicts, get_embedding() -> pretrained matrix, test() yielding the
+9-sequence SRL sample (word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2,
+pred, mark, labels)). Synthetic corpus keeps the contract without
+downloads."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["test", "get_dict", "get_embedding"]
+
+_WORDS = 4000
+_VERBS = 200
+# BIO labels over 5 argument types + O (reference label_dict shape)
+_LABELS = ["O"] + ["%s-A%d" % (p, i) for i in range(5)
+                   for p in ("B", "I")]
+_EMB_DIM = 32
+
+
+def get_dict():
+    word_dict = {("w%d" % i): i for i in range(_WORDS)}
+    verb_dict = {("v%d" % i): i for i in range(_VERBS)}
+    label_dict = {lbl: i for i, lbl in enumerate(_LABELS)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    r = np.random.RandomState(0)
+    return (r.rand(_WORDS, _EMB_DIM).astype("float32") - 0.5) * 0.1
+
+
+def _gen(n, seed):
+    r = np.random.RandomState(seed)
+    n_label = len(_LABELS)
+    for _ in range(n):
+        length = int(r.randint(5, 40))
+        words = r.randint(0, _WORDS, length).tolist()
+        pred_pos = int(r.randint(0, length))
+        pred = int(r.randint(0, _VERBS))
+
+        def ctx(off):
+            p = min(max(pred_pos + off, 0), length - 1)
+            return [words[p]] * length
+
+        mark = [1 if i == pred_pos else 0 for i in range(length)]
+        labels = r.randint(0, n_label, length).tolist()
+        yield (words, ctx(-2), ctx(-1), ctx(0), ctx(1), ctx(2),
+               [pred] * length, mark, labels)
+
+
+def test():
+    return lambda: _gen(64, 5)
+
+
+def fetch():
+    pass

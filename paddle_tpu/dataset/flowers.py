@@ -1,0 +1,49 @@
+"""Oxford-102 flowers reader creators (reference:
+`python/paddle/dataset/flowers.py`: train()/test()/valid() yielding
+(CHW float image, 0..101 label)). Synthetic images keep the contract
+without downloads."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "valid"]
+
+_CLASSES = 102
+_SHAPE = (3, 32, 32)  # small synthetic stand-in for the 224-crops
+
+
+def _gen(n, seed):
+    r = np.random.RandomState(seed)
+    for _ in range(n):
+        label = int(r.randint(0, _CLASSES))
+        img = r.rand(*_SHAPE).astype("float32")
+        img[label % 3] += 0.1  # weak class signal
+        yield img, label
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
+    def reader():
+        while True:
+            yield from _gen(256, 21)
+            if not cycle:
+                return
+
+    return reader
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
+    def reader():
+        while True:
+            yield from _gen(64, 22)
+            if not cycle:
+                return
+
+    return reader
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True):
+    return lambda: _gen(64, 23)
+
+
+def fetch():
+    pass

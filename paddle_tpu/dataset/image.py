@@ -1,0 +1,98 @@
+"""Image preprocessing utilities (reference:
+`python/paddle/dataset/image.py` — resize_short, to_chw, center_crop,
+random_crop, left_right_flip, simple_transform, load_and_transform).
+Pure-numpy implementations (the reference shells out to cv2; the math
+is identical up to interpolation kernel — nearest here). File decoding
+(load_image*) needs an image codec, which this zero-egress build does
+not ship: those raise with instructions, and every transform works on
+ndarrays."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "load_image_bytes", "load_image", "resize_short", "to_chw",
+    "center_crop", "random_crop", "left_right_flip", "simple_transform",
+    "load_and_transform", "batch_images_from_tar",
+]
+
+
+def load_image_bytes(bytes_, is_color=True):  # pragma: no cover
+    raise NotImplementedError(
+        "image decoding needs cv2/PIL, which this build does not ship; "
+        "decode to an ndarray yourself and use the transform functions")
+
+
+def load_image(file, is_color=True):
+    if str(file).endswith(".npy"):
+        return np.load(file)
+    return load_image_bytes(None, is_color)
+
+
+def batch_images_from_tar(data_file, dataset_name, img2label,
+                          num_per_batch=1024):  # pragma: no cover
+    raise NotImplementedError(
+        "tar batching needs image decoding; see load_image")
+
+
+def resize_short(im, size):
+    """Resize so the SHORTER edge equals `size` (nearest-neighbor).
+    im: HWC (or HW) ndarray."""
+    h, w = im.shape[:2]
+    if h < w:
+        nh, nw = size, max(int(round(w * size / h)), 1)
+    else:
+        nh, nw = max(int(round(h * size / w)), 1), size
+    ry = (np.arange(nh) * h // nh).clip(0, h - 1)
+    rx = (np.arange(nw) * w // nw).clip(0, w - 1)
+    return im[ry][:, rx]
+
+
+def to_chw(im, order=(2, 0, 1)):
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    y0 = max((h - size) // 2, 0)
+    x0 = max((w - size) // 2, 0)
+    return im[y0:y0 + size, x0:x0 + size]
+
+
+def random_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    y0 = np.random.randint(0, max(h - size, 0) + 1)
+    x0 = np.random.randint(0, max(w - size, 0) + 1)
+    return im[y0:y0 + size, x0:x0 + size]
+
+
+def left_right_flip(im, is_color=True):
+    return im[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train,
+                     is_color=True, mean=None):
+    """resize_short + (random crop + flip | center crop) + CHW + mean
+    subtraction (reference: image.py simple_transform)."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, is_color)
+        if np.random.randint(2) == 0:
+            im = left_right_flip(im, is_color)
+    else:
+        im = center_crop(im, crop_size, is_color)
+    if im.ndim == 3:
+        im = to_chw(im)
+    im = im.astype("float32")
+    if mean is not None:
+        mean = np.array(mean, dtype=np.float32)
+        if mean.ndim == 1 and im.ndim == 3:
+            mean = mean[:, None, None]
+        im -= mean
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    return simple_transform(load_image(filename, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)

@@ -1,0 +1,139 @@
+"""MovieLens-1M reader creators (reference:
+`python/paddle/dataset/movielens.py`: MovieInfo/UserInfo records;
+train()/test() yield [user_id, gender_id, age_id, job_id, movie_id,
+category_ids, title_ids, score]). Synthetic catalog keeps the contract
+without downloads."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "train", "test", "get_movie_title_dict", "max_movie_id",
+    "max_user_id", "max_job_id", "movie_categories", "user_info",
+    "movie_info", "age_table", "MovieInfo", "UserInfo",
+]
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+_N_MOVIES = 400
+_N_USERS = 600
+_N_JOBS = 21
+_CATEGORIES = ["Action", "Comedy", "Drama", "Horror", "Sci-Fi",
+               "Romance", "Thriller", "Animation"]
+_TITLE_WORDS = 512
+
+
+class MovieInfo:
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self):
+        return [self.index,
+                [_CATEGORIES.index(c) for c in self.categories],
+                [_title_dict()[w] for w in self.title.split()]]
+
+    def __repr__(self):
+        return "<MovieInfo id(%d), title(%s), categories(%s)>" % (
+            self.index, self.title, self.categories)
+
+
+class UserInfo:
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = age_table.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [self.index, 0 if self.is_male else 1, self.age,
+                self.job_id]
+
+    def __repr__(self):
+        return "<UserInfo id(%d), gender(%s), age(%d), job(%d)>" % (
+            self.index, "M" if self.is_male else "F",
+            age_table[self.age], self.job_id)
+
+
+_cache = {}
+
+
+def _title_dict():
+    if "titles" not in _cache:
+        _cache["titles"] = {("t%d" % i): i for i in range(_TITLE_WORDS)}
+    return _cache["titles"]
+
+
+def _catalog():
+    if "movies" in _cache:
+        return _cache["movies"], _cache["users"]
+    r = np.random.RandomState(7)
+    movies = {}
+    for i in range(1, _N_MOVIES + 1):
+        cats = [_CATEGORIES[j] for j in
+                r.choice(len(_CATEGORIES), int(r.randint(1, 3)),
+                         replace=False)]
+        title = " ".join("t%d" % t for t in
+                         r.randint(0, _TITLE_WORDS, int(r.randint(1, 5))))
+        movies[i] = MovieInfo(i, cats, title)
+    users = {}
+    for i in range(1, _N_USERS + 1):
+        users[i] = UserInfo(i, "M" if r.rand() < 0.5 else "F",
+                            age_table[int(r.randint(len(age_table)))],
+                            int(r.randint(0, _N_JOBS)))
+    _cache["movies"], _cache["users"] = movies, users
+    return movies, users
+
+
+def _gen(is_test, seed=3, n=2000, test_ratio=0.1):
+    movies, users = _catalog()
+    r = np.random.RandomState(seed)
+    for _ in range(n):
+        in_test = r.rand() < test_ratio
+        if in_test != is_test:
+            continue
+        u = users[int(r.randint(1, _N_USERS + 1))]
+        m = movies[int(r.randint(1, _N_MOVIES + 1))]
+        score = float(r.randint(1, 6))
+        yield u.value() + m.value() + [[score]]
+
+
+def train():
+    return lambda: _gen(False)
+
+
+def test():
+    return lambda: _gen(True)
+
+
+def get_movie_title_dict():
+    return _title_dict()
+
+
+def movie_categories():
+    return {c: i for i, c in enumerate(_CATEGORIES)}
+
+
+def max_movie_id():
+    return _N_MOVIES
+
+
+def max_user_id():
+    return _N_USERS
+
+
+def max_job_id():
+    return _N_JOBS - 1
+
+
+def movie_info():
+    return _catalog()[0]
+
+
+def user_info():
+    return _catalog()[1]
+
+
+def fetch():
+    pass

@@ -1,0 +1,64 @@
+"""MQ2007 learning-to-rank reader creators (reference:
+`python/paddle/dataset/mq2007.py`: train/test generators parameterized
+by format — pointwise (label, 46-dim feature), pairwise
+(high_feature, low_feature), listwise (labels, features)). Synthetic
+query groups keep the contract without downloads."""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+__all__ = ["train", "test"]
+
+_N_FEATURES = 46
+
+
+def _queries(n_queries, seed):
+    r = np.random.RandomState(seed)
+    for _ in range(n_queries):
+        n_docs = int(r.randint(4, 12))
+        labels = r.randint(0, 3, n_docs).astype("float64")
+        feats = r.rand(n_docs, _N_FEATURES)
+        # weak signal: first feature correlates with relevance
+        feats[:, 0] = labels / 2.0 + 0.1 * feats[:, 0]
+        yield labels, feats
+
+
+def gen_point(labels, feats):
+    for lbl, f in zip(labels, feats):
+        yield float(lbl), f.tolist()
+
+
+def gen_pair(labels, feats):
+    order = np.argsort(-labels)
+    for i in range(len(order)):
+        for j in range(i + 1, len(order)):
+            hi, lo = order[i], order[j]
+            if labels[hi] > labels[lo]:
+                yield (np.array([labels[hi]]), feats[hi].tolist(),
+                       feats[lo].tolist())
+
+
+def gen_list(labels, feats):
+    yield labels.tolist(), feats.tolist()
+
+
+def __reader__(n_queries=32, seed=61, format="pairwise"):
+    for labels, feats in _queries(n_queries, seed):
+        if format == "pointwise":
+            yield from gen_point(labels, feats)
+        elif format == "pairwise":
+            yield from gen_pair(labels, feats)
+        elif format == "listwise":
+            yield from gen_list(labels, feats)
+        else:
+            raise ValueError("format must be pointwise/pairwise/listwise")
+
+
+train = functools.partial(__reader__, n_queries=32, seed=61)
+test = functools.partial(__reader__, n_queries=8, seed=62)
+
+
+def fetch():
+    pass

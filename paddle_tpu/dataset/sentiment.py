@@ -1,0 +1,36 @@
+"""NLTK movie-reviews sentiment readers (reference:
+`python/paddle/dataset/sentiment.py`: get_word_dict(), train()/test()
+yielding (word-id list, 0/1 label)). Synthetic class-correlated corpus
+keeps the contract without NLTK downloads."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "get_word_dict"]
+
+_VOCAB = 2048
+
+
+def get_word_dict():
+    return {("s%d" % i): i for i in range(_VOCAB)}
+
+
+def _gen(n, seed):
+    r = np.random.RandomState(seed)
+    for _ in range(n):
+        label = int(r.randint(0, 2))
+        lo, hi = (4, _VOCAB // 2) if label == 0 else (_VOCAB // 2,
+                                                      _VOCAB - 4)
+        yield r.randint(lo, hi, int(r.randint(6, 48))).tolist(), label
+
+
+def train():
+    return lambda: _gen(400, 11)
+
+
+def test():
+    return lambda: _gen(100, 12)
+
+
+def fetch():
+    pass

@@ -1,0 +1,39 @@
+"""PASCAL VOC2012 segmentation reader creators (reference:
+`python/paddle/dataset/voc2012.py`: train()/test()/val() yielding
+(CHW uint8-range image, HW int32 label mask)). Synthetic masks keep the
+contract without downloads."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "val"]
+
+_CLASSES = 21
+_H = _W = 32
+
+
+def _gen(n, seed):
+    r = np.random.RandomState(seed)
+    for _ in range(n):
+        img = (r.rand(3, _H, _W) * 255).astype("float32")
+        label = np.zeros((_H, _W), "int32")
+        cls = int(r.randint(1, _CLASSES))
+        y0, x0 = r.randint(0, _H // 2), r.randint(0, _W // 2)
+        label[y0:y0 + _H // 2, x0:x0 + _W // 2] = cls
+        yield img, label
+
+
+def train():
+    return lambda: _gen(128, 31)
+
+
+def test():
+    return lambda: _gen(32, 32)
+
+
+def val():
+    return lambda: _gen(32, 33)
+
+
+def fetch():
+    pass

@@ -1,0 +1,59 @@
+"""WMT14 en-fr reader creators (reference:
+`python/paddle/dataset/wmt14.py`: train(dict_size)/test(dict_size)
+yielding (src_ids, trg_ids, trg_next_ids) with <s>/<e>/<unk> at ids
+0/1/2; get_dict(dict_size, reverse)). Synthetic parallel corpus keeps
+the contract without downloads."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "get_dict"]
+
+START = "<s>"
+END = "<e>"
+UNK = "<unk>"
+START_ID, END_ID, UNK_ID = 0, 1, 2
+
+
+def _dicts(dict_size):
+    src = {START: 0, END: 1, UNK: 2}
+    trg = {START: 0, END: 1, UNK: 2}
+    for i in range(3, dict_size):
+        src["en%d" % i] = i
+        trg["fr%d" % i] = i
+    return src, trg
+
+
+def _gen(n, seed, dict_size):
+    r = np.random.RandomState(seed)
+    for _ in range(n):
+        sl = int(r.randint(3, 30))
+        src = r.randint(3, dict_size, sl).tolist()
+        trg = [(t + 1) % (dict_size - 3) + 3 for t in src[::-1]]
+        trg_in = [START_ID] + trg
+        trg_next = trg + [END_ID]
+        yield src, trg_in, trg_next
+
+
+def train(dict_size):
+    return lambda: _gen(256, 41, dict_size)
+
+
+def test(dict_size):
+    return lambda: _gen(64, 42, dict_size)
+
+
+def gen(dict_size):
+    return lambda: _gen(64, 43, dict_size)
+
+
+def get_dict(dict_size, reverse=True):
+    src, trg = _dicts(dict_size)
+    if reverse:
+        src = {v: k for k, v in src.items()}
+        trg = {v: k for k, v in trg.items()}
+    return src, trg
+
+
+def fetch():
+    pass

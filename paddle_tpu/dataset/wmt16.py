@@ -1,0 +1,58 @@
+"""WMT16 en-de reader creators (reference:
+`python/paddle/dataset/wmt16.py`: train/test/validation(src_dict_size,
+trg_dict_size, src_lang) yielding (src_ids, trg_ids, trg_next_ids);
+get_dict(lang, dict_size, reverse)). Synthetic parallel corpus keeps
+the contract without downloads."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "validation", "get_dict"]
+
+_LANGS = ("en", "de")
+
+
+def _dict(lang, size):
+    d = {"<s>": 0, "<e>": 1, "<unk>": 2}
+    for i in range(3, size):
+        d["%s%d" % (lang, i)] = i
+    return d
+
+
+def _gen(n, seed, src_size, trg_size):
+    r = np.random.RandomState(seed)
+    for _ in range(n):
+        sl = int(r.randint(3, 24))
+        src = r.randint(3, src_size, sl).tolist()
+        trg = [(t * 2) % (trg_size - 3) + 3 for t in src]
+        yield src, [0] + trg, trg + [1]
+
+
+def _check_lang(src_lang):
+    if src_lang not in _LANGS:
+        raise ValueError("src_lang must be 'en' or 'de'")
+
+
+def train(src_dict_size, trg_dict_size, src_lang="en"):
+    _check_lang(src_lang)
+    return lambda: _gen(256, 51, src_dict_size, trg_dict_size)
+
+
+def test(src_dict_size, trg_dict_size, src_lang="en"):
+    _check_lang(src_lang)
+    return lambda: _gen(64, 52, src_dict_size, trg_dict_size)
+
+
+def validation(src_dict_size, trg_dict_size, src_lang="en"):
+    _check_lang(src_lang)
+    return lambda: _gen(64, 53, src_dict_size, trg_dict_size)
+
+
+def get_dict(lang, dict_size, reverse=False):
+    _check_lang(lang)
+    d = _dict(lang, dict_size)
+    return {v: k for k, v in d.items()} if reverse else d
+
+
+def fetch():
+    pass

@@ -1,7 +1,9 @@
 """fluid.contrib.slim — model compression (reference:
 `python/paddle/fluid/contrib/slim/`): quantization (QAT + PTQ),
-magnitude/structure pruning, and distillation losses. NAS/searcher are
-descoped per SURVEY.md §7.9."""
+magnitude/structure pruning, distillation losses, and NAS (SAController
+simulated-annealing searcher + SANAS loop over a SearchSpace)."""
 from . import quantization  # noqa: F401
 from . import prune  # noqa: F401
 from . import distillation  # noqa: F401
+from . import searcher  # noqa: F401
+from . import nas  # noqa: F401

@@ -1,0 +1,2 @@
+from .search_space import SearchSpace  # noqa: F401
+from .sa_nas import SANAS  # noqa: F401

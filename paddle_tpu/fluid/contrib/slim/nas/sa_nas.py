@@ -1,0 +1,45 @@
+"""SANAS: simulated-annealing architecture/compression search
+(reference: `python/paddle/fluid/contrib/slim/nas/light_nas_strategy.py`
+LightNASStrategy + the controller_server/search_agent socket pair).
+
+TPU-native design: the reference ran a socket ControllerServer so many
+GPU workers could pull tokens; here candidate evaluation is one jitted
+computation per candidate on the local chip, and multi-host search (if
+wanted) rides the existing jax.distributed / host_collectives tier
+rather than a bespoke socket protocol — so the search loop itself is a
+plain synchronous driver."""
+from __future__ import annotations
+
+from ..searcher.controller import SAController
+
+__all__ = ["SANAS"]
+
+
+class SANAS:
+    def __init__(self, search_space, reward_fn, reduce_rate=0.85,
+                 init_temperature=10.0, seed=None):
+        """search_space: a SearchSpace; reward_fn(net, tokens) -> float
+        (higher is better; fold FLOPs/latency penalties in here)."""
+        self._space = search_space
+        self._reward_fn = reward_fn
+        self._controller = SAController(
+            reduce_rate=reduce_rate, init_temperature=init_temperature,
+            seed=seed)
+        self.history = []  # [(tokens, reward)]
+
+    def search(self, max_iterations=20, constrain_func=None):
+        """Run the SA loop; returns (best_tokens, best_reward)."""
+        tokens = list(self._space.init_tokens())
+        self._controller.reset(self._space.range_table(), tokens,
+                               constrain_func)
+        net = self._space.create_net(tokens)
+        reward = float(self._reward_fn(net, tokens))
+        self._controller.update(tokens, reward)
+        self.history.append((tokens, reward))
+        for _ in range(int(max_iterations)):
+            tokens = self._controller.next_tokens()
+            net = self._space.create_net(tokens)
+            reward = float(self._reward_fn(net, tokens))
+            self._controller.update(tokens, reward)
+            self.history.append((tokens, reward))
+        return self._controller.best_tokens, self._controller.max_reward

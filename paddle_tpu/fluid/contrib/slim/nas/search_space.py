@@ -1,0 +1,24 @@
+"""Search-space contract for NAS (reference:
+`python/paddle/fluid/contrib/slim/nas/search_space.py`): a space maps a
+token vector to a candidate network plus a reward."""
+from __future__ import annotations
+
+__all__ = ["SearchSpace"]
+
+
+class SearchSpace:
+    """Subclass and implement the three hooks; `create_net` builds the
+    candidate (a program, a Layer, or any trainable object your
+    reward_fn understands) from the tokens."""
+
+    def init_tokens(self):
+        """Initial token vector."""
+        raise NotImplementedError("Abstract method.")
+
+    def range_table(self):
+        """list<int>: tokens[i] ranges over [0, range_table[i])."""
+        raise NotImplementedError("Abstract method.")
+
+    def create_net(self, tokens):
+        """Build the candidate network for `tokens`."""
+        raise NotImplementedError("Abstract method.")

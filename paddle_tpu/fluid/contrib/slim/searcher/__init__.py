@@ -1,0 +1,1 @@
+from .controller import EvolutionaryController, SAController  # noqa: F401

@@ -1,0 +1,88 @@
+"""Evolutionary search controllers (reference:
+`python/paddle/fluid/contrib/slim/searcher/controller.py` —
+EvolutionaryController ABC + SAController simulated annealing). The
+controller is pure host-side python; the candidate programs it scores
+run as ordinary jitted computations, so nothing here touches the device
+path."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+__all__ = ["EvolutionaryController", "SAController"]
+
+
+class EvolutionaryController:
+    """Abstract controller for evolutionary searching methods."""
+
+    def update(self, tokens, reward):
+        raise NotImplementedError("Abstract method.")
+
+    def reset(self, range_table, init_tokens, constrain_func=None):
+        raise NotImplementedError("Abstract method.")
+
+    def next_tokens(self):
+        raise NotImplementedError("Abstract method.")
+
+
+class SAController(EvolutionaryController):
+    """Simulated-annealing controller (reference: controller.py:58).
+    tokens[i] ranges over [0, range_table[i]); a worse candidate is
+    accepted with prob exp((reward - best)/T), T decaying by
+    reduce_rate per iteration."""
+
+    def __init__(self, range_table=None, reduce_rate=0.85,
+                 init_temperature=1024, max_iter_number=300, seed=None):
+        self._range_table = range_table
+        self._reduce_rate = reduce_rate
+        self._init_temperature = init_temperature
+        self._max_iter_number = max_iter_number
+        self._reward = -1
+        self._tokens = None
+        self._constrain_func = None
+        self._max_reward = -1
+        self._best_tokens = None
+        self._iter = 0
+        self._rng = np.random.RandomState(seed)
+
+    @property
+    def max_reward(self):
+        return self._max_reward
+
+    @property
+    def best_tokens(self):
+        return self._best_tokens
+
+    def reset(self, range_table, init_tokens, constrain_func=None):
+        self._range_table = list(range_table)
+        self._constrain_func = constrain_func
+        self._tokens = list(init_tokens)
+        self._iter = 0
+
+    def update(self, tokens, reward):
+        self._iter += 1
+        temperature = (self._init_temperature
+                       * self._reduce_rate ** self._iter)
+        if (reward > self._reward
+                or self._rng.random_sample()
+                <= math.exp(min((reward - self._reward) / temperature,
+                                50.0))):
+            self._reward = reward
+            self._tokens = list(tokens)
+        if reward > self._max_reward:
+            self._max_reward = reward
+            self._best_tokens = list(tokens)
+
+    def next_tokens(self):
+        """Mutate one random position of the current tokens; retry until
+        the constraint (if any) accepts the candidate."""
+        for _ in range(1000):
+            tokens = list(self._tokens)
+            pos = int(self._rng.randint(len(tokens)))
+            tokens[pos] = int(self._rng.randint(self._range_table[pos]))
+            if self._constrain_func is None or self._constrain_func(
+                    tokens):
+                return tokens
+        raise RuntimeError(
+            "SAController: constrain_func rejected 1000 candidates")

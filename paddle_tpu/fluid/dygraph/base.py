@@ -196,6 +196,15 @@ class Tensor:
     def __rtruediv__(self, o):
         return self._binary(o, "elementwise_div", reverse=True)
 
+    def __mod__(self, o):
+        return self._binary(o, "elementwise_mod")
+
+    def __floordiv__(self, o):
+        return self._binary(o, "elementwise_floordiv")
+
+    def __pow__(self, o):
+        return self._binary(o, "elementwise_pow")
+
     def __neg__(self):
         return trace_op("scale", {"X": [self]},
                         {"scale": -1.0, "bias": 0.0,

@@ -107,6 +107,141 @@ def _name(id_, ctx=None):
     return ast.Name(id=id_, ctx=ctx or ast.Load())
 
 
+def _assign_const(name, value):
+    return ast.Assign(targets=[_name(name, ast.Store())],
+                      value=ast.Constant(value=value))
+
+
+def _ends_with_return(stmts):
+    return bool(stmts) and isinstance(stmts[-1], ast.Return)
+
+
+class _HasLoopCtl(ast.NodeVisitor):
+    """break/continue at this loop's level (nested loops own theirs)."""
+
+    def __init__(self):
+        self.found = False
+
+    def visit_Break(self, node):
+        self.found = True
+
+    def visit_Continue(self, node):
+        self.found = True
+
+    def visit_While(self, node):
+        pass
+
+    def visit_For(self, node):
+        pass
+
+    def visit_FunctionDef(self, node):
+        pass
+
+
+def _has_loop_ctl(stmts):
+    v = _HasLoopCtl()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+class FlowNormalizer(ast.NodeTransformer):
+    """Pre-pass desugaring return-flow and loop break/continue into the
+    assign-and-branch shapes the main transformer lowers (reference:
+    return_transformer.py + break_continue_transformer.py, via flag
+    variables; here break/continue become guard flags and early returns
+    fold the remaining statements into the else branch — continuation
+    style — so tensor conditions reach lax.cond/while_loop instead of
+    raising python_only)."""
+
+    def __init__(self):
+        self._n = 0
+
+    def _fresh(self, base):
+        self._n += 1
+        return "__%s_%d" % (base, self._n)
+
+    # -- return-flow: fold statements after a returning `if` into its
+    # else branch, so `if c: return a` + rest becomes a both-return if
+    def _fold_returns(self, stmts, at_function_tail):
+        out = list(stmts)
+        for i, s in enumerate(out):
+            if not isinstance(s, ast.If):
+                continue
+            body_ret = _ends_with_return(s.body)
+            else_ret = _ends_with_return(s.orelse)
+            if not (body_ret or else_ret):
+                continue
+            rest = out[i + 1:]
+            if body_ret and not else_ret:
+                s.orelse = (s.orelse or []) + rest
+                if not _ends_with_return(s.orelse):
+                    if not at_function_tail:
+                        break  # can't prove the tail returns; leave it
+                    s.orelse.append(
+                        ast.Return(value=ast.Constant(value=None)))
+            elif else_ret and not body_ret:
+                s.body = s.body + rest
+                if not _ends_with_return(s.body):
+                    if not at_function_tail:
+                        break
+                    s.body.append(
+                        ast.Return(value=ast.Constant(value=None)))
+            elif rest:
+                break  # both branches return: rest is dead; leave as-is
+            s.body = self._fold_returns(s.body, at_function_tail)
+            s.orelse = self._fold_returns(s.orelse, at_function_tail)
+            return out[:i] + [s]
+        return out
+
+    def visit_FunctionDef(self, node):
+        self.generic_visit(node)
+        node.body = self._fold_returns(node.body, at_function_tail=True)
+        return node
+
+    # -- break/continue: guard-flag rewrite around the while body
+    def _rewrite_ctl(self, stmts, brk, cnt):
+        out = []
+        for i, s in enumerate(stmts):
+            if isinstance(s, ast.Break):
+                out.append(_assign_const(brk, True))
+                return out  # rest is unreachable
+            if isinstance(s, ast.Continue):
+                out.append(_assign_const(cnt, True))
+                return out
+            if isinstance(s, ast.If) and (_has_loop_ctl(s.body)
+                                          or _has_loop_ctl(s.orelse)):
+                s.body = self._rewrite_ctl(s.body, brk, cnt)
+                s.orelse = self._rewrite_ctl(s.orelse, brk, cnt)
+                out.append(s)
+                rest = self._rewrite_ctl(stmts[i + 1:], brk, cnt)
+                if rest:
+                    guard = ast.UnaryOp(
+                        op=ast.Not(),
+                        operand=ast.BoolOp(op=ast.Or(),
+                                           values=[_name(brk),
+                                                   _name(cnt)]))
+                    out.append(ast.If(test=guard, body=rest, orelse=[]))
+                return out
+            out.append(s)
+        return out
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or not _has_loop_ctl(node.body):
+            return node
+        brk, cnt = self._fresh("brk"), self._fresh("cnt")
+        body = [_assign_const(cnt, False)] + self._rewrite_ctl(
+            list(node.body), brk, cnt)
+        test = ast.BoolOp(
+            op=ast.And(),
+            values=[ast.UnaryOp(op=ast.Not(), operand=_name(brk)),
+                    node.test])
+        new_loop = ast.While(test=test, body=body, orelse=[])
+        return [_assign_const(brk, False), _assign_const(cnt, False),
+                new_loop]
+
+
 def _jst_call(fn_name, args):
     return ast.Call(
         func=ast.Attribute(value=_name(_JST), attr=fn_name,
@@ -118,13 +253,23 @@ def _out_tuple(names, ctx):
     return ast.Tuple(elts=[_name(n, ctx) for n in names], ctx=ctx)
 
 
+_GEN_PREFIX = "__d2s_"
+
+
+def _carryable(names):
+    """Drop transformer-generated helper names (branch/cond function
+    defs) — they are bound and called within one statement and must
+    never become if-merge outputs or loop-carried values."""
+    return [n for n in names if not n.startswith(_GEN_PREFIX)]
+
+
 class DygraphToStaticTransformer(ast.NodeTransformer):
     def __init__(self):
         self._counter = 0
 
     def _fresh(self, base):
         self._counter += 1
-        return "__%s_%d" % (base, self._counter)
+        return "%s%s_%d" % (_GEN_PREFIX, base, self._counter)
 
     # -- boolean operators --------------------------------------------------
     def visit_BoolOp(self, node):
@@ -148,14 +293,35 @@ class DygraphToStaticTransformer(ast.NodeTransformer):
     def visit_If(self, node):
         self.generic_visit(node)
         body, orelse = node.body, node.orelse
-        both_return = (
-            len(body) == 1 and isinstance(body[0], ast.Return) and
-            len(orelse) == 1 and isinstance(orelse[0], ast.Return))
-        if both_return:
-            return ast.Return(value=_jst_call("convert_ifelse", [
-                node.test,
-                ast.Lambda(args=_no_args(), body=body[0].value),
-                ast.Lambda(args=_no_args(), body=orelse[0].value)]))
+        if (body and isinstance(body[-1], ast.Return)
+                and orelse and isinstance(orelse[-1], ast.Return)):
+            # both branches END with return (FlowNormalizer folds early
+            # returns into this shape): continuation-style conversion —
+            # the whole if IS the function's return. Names a branch
+            # assigns become PARAMETERS (same reason as the merge path
+            # below: an assignment makes the name branch-local, so a
+            # read of the incoming value would raise UnboundLocalError)
+            names = sorted(set(_carryable(_assigned(body)))
+                           | set(_carryable(_assigned(orelse))))
+            args = ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg=n) for n in names],
+                vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+                defaults=[])
+            t_name, f_name = self._fresh("ret_t"), self._fresh("ret_f")
+            t_def = ast.FunctionDef(name=t_name, args=args,
+                                    body=body, decorator_list=[],
+                                    returns=None)
+            f_def = ast.FunctionDef(name=f_name, args=args,
+                                    body=orelse, decorator_list=[],
+                                    returns=None)
+            init = ast.Tuple(
+                elts=[_jst_call("try_get", [
+                    ast.Lambda(args=_no_args(), body=_name(n))])
+                    for n in names],
+                ctx=ast.Load())
+            ret = ast.Return(value=_jst_call("convert_ifelse", [
+                node.test, _name(t_name), _name(f_name), init]))
+            return [t_def, f_def, ret]
         if _has_ctl(body) or _has_ctl(orelse):
             # guard clauses (`if flag: return x`) keep python semantics;
             # python_only raises at capture time if the test is a tensor
@@ -163,7 +329,8 @@ class DygraphToStaticTransformer(ast.NodeTransformer):
                 node.test,
                 ast.Constant(value="if-with-return/break/continue")])
             return node
-        names = sorted(set(_assigned(body)) | set(_assigned(orelse)))
+        names = sorted(set(_carryable(_assigned(body)))
+                       | set(_carryable(_assigned(orelse))))
         t_name, f_name = self._fresh("true_fn"), self._fresh("false_fn")
         # branch functions take the pre-branch values as PARAMETERS —
         # python scoping would otherwise treat every assigned name as a
@@ -196,6 +363,26 @@ class DygraphToStaticTransformer(ast.NodeTransformer):
             assign = ast.Expr(value=call)
         return [t_def, f_def, assign]
 
+    # -- builtin calls: print / int / float / bool / len --------------------
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        if isinstance(node.func, ast.Name) and not node.keywords:
+            fid = node.func.id
+            if fid == "print":
+                return _jst_call("convert_print", node.args)
+            if fid in ("int", "float", "bool") and len(node.args) == 1:
+                return _jst_call("convert_cast",
+                                 [node.args[0], ast.Constant(value=fid)])
+            if fid == "len" and len(node.args) == 1:
+                return _jst_call("convert_len", node.args)
+        return node
+
+    # -- assert --------------------------------------------------------------
+    def visit_Assert(self, node):
+        self.generic_visit(node)
+        args = [node.test] + ([node.msg] if node.msg is not None else [])
+        return ast.Expr(value=_jst_call("convert_assert", args))
+
     # -- while ---------------------------------------------------------------
     def visit_While(self, node):
         self.generic_visit(node)
@@ -207,7 +394,7 @@ class DygraphToStaticTransformer(ast.NodeTransformer):
                 node.test,
                 ast.Constant(value="while-with-break/continue/else")])
             return node
-        names = sorted(set(_assigned(node.body)))
+        names = sorted(set(_carryable(_assigned(node.body))))
         if not names:
             raise NotImplementedError(
                 "@declarative: `while` body assigns no variables")
@@ -256,6 +443,7 @@ def _convert_cached(fn):
     tree = ast.parse(src)
     fd = tree.body[0]
     fd.decorator_list = []
+    tree = FlowNormalizer().visit(tree)
     tree = DygraphToStaticTransformer().visit(tree)
     ast.fix_missing_locations(tree)
     code = compile(tree, filename="<declarative:%s>" % fn.__qualname__,

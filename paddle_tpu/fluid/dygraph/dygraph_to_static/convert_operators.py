@@ -123,9 +123,20 @@ def convert_ifelse(pred, true_fn, false_fn, init_args=()):
             "symbolic `if` outside @declarative capture")
     from ...layers import control_flow as cf
 
-    out = cf.cond(_to_bool_var(pred),
-                  lambda: _unwrap_struct(true_fn(*init_args)),
-                  lambda: _unwrap_struct(false_fn(*init_args)))
+    def _coerce_out(o):
+        # python scalars leaving a traced branch must carry a stable
+        # dtype on BOTH sides (True in one branch, passthrough False in
+        # the other): _scalar_const types bools as bool[1], ints int32
+        if isinstance(o, (list, tuple)):
+            return type(o)(_coerce_out(e) for e in o)
+        if isinstance(o, (bool, int, float)):
+            return _scalar_const(o)
+        return o
+
+    out = cf.cond(
+        _to_bool_var(pred),
+        lambda: _coerce_out(_unwrap_struct(true_fn(*init_args))),
+        lambda: _coerce_out(_unwrap_struct(false_fn(*init_args))))
     return _wrap_struct(out)
 
 
@@ -165,13 +176,22 @@ def convert_while_loop(cond_fn, body_fn, loop_vars):
     return tuple(_wrap_struct(tuple(out)))
 
 
+def _coerce_bool(y):
+    """Python bool riding in a symbolic logical op -> bool constant var
+    (e.g. a loop-ctl flag that is tensor in one branch, python in the
+    other)."""
+    if _is_sym(y):
+        return y
+    from ...layers import tensor as static_t
+
+    return static_t.fill_constant([1], "bool", bool(y))
+
+
 def convert_logical_and(x_fn, y_fn):
     x = x_fn()
     if not _is_sym(x):
         return y_fn() if x else x
-    y = y_fn()
-    if not _is_sym(y):
-        raise TypeError("cannot mix symbolic and python bool in `and`")
+    y = _coerce_bool(y_fn())
     from ...layers import nn as static_nn
 
     return _wrap(static_nn.logical_and(_unwrap(x), _unwrap(y)))
@@ -181,9 +201,7 @@ def convert_logical_or(x_fn, y_fn):
     x = x_fn()
     if not _is_sym(x):
         return x if x else y_fn()
-    y = y_fn()
-    if not _is_sym(y):
-        raise TypeError("cannot mix symbolic and python bool in `or`")
+    y = _coerce_bool(y_fn())
     from ...layers import nn as static_nn
 
     return _wrap(static_nn.logical_or(_unwrap(x), _unwrap(y)))
@@ -214,3 +232,44 @@ def python_only(value, construct):
             "branches are a single `return`, or assign instead of "
             "returning/breaking" % construct)
     return value
+
+
+def convert_print(*args, **kwargs):
+    """`print(...)` in converted code (reference: print_transformer.py):
+    symbolic tensors become runtime print ops; pure-python calls keep
+    builtin print."""
+    if not any(_is_sym(a) for a in args):
+        return print(*args, **kwargs)
+    from ...layers import control_flow as cf
+
+    for a in args:
+        if _is_sym(a):
+            cf.Print(_unwrap(a), message="print:")
+        else:
+            print(a, end=" ")
+
+
+def convert_assert(cond, message=None):
+    """`assert cond[, msg]` (reference: assert_transformer.py): symbolic
+    conditions become a runtime assert op; python values assert now."""
+    if _is_sym(cond):
+        from ...layers import control_flow as cf
+
+        cf.Assert(_to_bool_var(cond),
+                  name=str(message) if message is not None else "")
+        return
+    assert cond, message
+
+
+_CAST_DTYPES = {"int": "int32", "float": "float32", "bool": "bool"}
+
+
+def convert_cast(x, kind):
+    """`int(x)` / `float(x)` / `bool(x)` on tensors (reference:
+    cast_transformer.py): lowers to a cast op; python values keep the
+    builtin conversion."""
+    if not _is_sym(x):
+        return {"int": int, "float": float, "bool": bool}[kind](x)
+    from ...layers import tensor as static_t
+
+    return _wrap(static_t.cast(_unwrap(x), _CAST_DTYPES[kind]))

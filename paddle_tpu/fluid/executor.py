@@ -53,7 +53,7 @@ class Executor:
         # fetch list internally (reference: send ops read the grad vars)
         ps_cfg = getattr(program, "_ps_cfg", None)
         n_user_fetches = len(fetch_names)
-        if ps_cfg is not None and ps_cfg["mode"] in ("sync", "async"):
+        if ps_cfg is not None and ps_cfg["mode"] in ("sync", "async", "half_async"):
             fetch_names = fetch_names + [
                 g for g in sorted(ps_cfg["grad_of"])
                 if g not in fetch_names]
@@ -128,7 +128,7 @@ class Executor:
 
         if ps_cfg is not None:
             comm = self._ps_communicator(program, ps_cfg, scope)
-            if ps_cfg["mode"] in ("sync", "async"):
+            if ps_cfg["mode"] in ("sync", "async", "half_async"):
                 sparse_gvals = {
                     w: np.asarray(fetches[fetch_names.index(m["grad"])])
                     for w, m in ps_cfg.get("sparse_tables", {}).items()}

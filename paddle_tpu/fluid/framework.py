@@ -168,6 +168,12 @@ class Variable:
     def __pow__(self, o):
         return self._binary(o, "elementwise_pow")
 
+    def __mod__(self, o):
+        return self._binary(o, "elementwise_mod")
+
+    def __floordiv__(self, o):
+        return self._binary(o, "elementwise_floordiv")
+
     def __neg__(self):
         from .layers import tensor as _t
 

@@ -285,3 +285,30 @@ def _compare(op_type, x, y, out):
     helper.append_op(type=op_type, inputs={"X": [x], "Y": [y]},
                      outputs={"Out": [out]})
     return out
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Host-side tensor print passthrough (reference: control_flow.py
+    Print -> print_op). Returns its input so it can be chained."""
+    helper = LayerHelper("print")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="print", inputs={"In": [input]}, outputs={"Out": [out]},
+        attrs={"message": message or "", "summarize": summarize,
+               "first_n": first_n, "print_phase": print_phase})
+    return out
+
+
+def Assert(cond, data=None, summarize=20, name=None):
+    """Runtime assertion op (reference: control_flow.py Assert ->
+    assert_op): raises AssertionError when `cond` is not all-true."""
+    helper = LayerHelper("assert")
+    inputs = {"Cond": [cond]}
+    if data:
+        inputs["Data"] = list(data)
+    helper.append_op(type="assert", inputs=inputs, outputs={},
+                     attrs={"summarize": summarize,
+                            "message": name or ""})

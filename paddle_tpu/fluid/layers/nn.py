@@ -617,6 +617,13 @@ def flatten(x, axis=1, name=None):
 
 def sequence_pool(input, pool_type, is_test=False, pad_value=0.0,
                   length=None):
+    if pool_type.upper() not in ("AVERAGE", "SUM", "SQRT", "LAST",
+                                 "FIRST", "MAX"):
+        # construction-time validation, matching the reference's InEnum
+        # (sequence_pool_op.cc:69)
+        raise ValueError("sequence_pool pool_type must be one of "
+                         "average/sum/sqrt/last/first/max, got %r"
+                         % (pool_type,))
     ins = {"X": [input]}
     if length is not None:
         ins["Length"] = [length]

@@ -36,7 +36,7 @@ from ..core.types import to_numpy_dtype
 _SKIP_OPS = frozenset({
     "feed", "fetch", "c_gen_nccl_id", "gen_nccl_id", "c_comm_init",
     "c_comm_init_all", "c_wait_compute", "c_wait_comm", "barrier",
-    "print", "nop",
+    "nop",
     # PS-mode markers: the host-side PSCommunicator performs the actual
     # RPC around each jitted step (distributed/ps.py)
     "send", "recv", "send_barrier", "fetch_barrier", "checkpoint_notify",
@@ -223,14 +223,27 @@ def _host_callback_op(opdef, op, ins, attrs):
             i += n
         return d
 
-    probe = [np.zeros(v.shape, v.dtype) for v in flat]
-    # NOTE: under stackless tracing, jnp constants created inside compute
-    # come back as tracers — only .shape/.dtype may be read here.
-    probe_out = ops_lib.normalize_outs(
-        opdef.compute(rebuild(probe), dict(attrs)))
-    out_slots = [(s, len(vs)) for s, vs in sorted(probe_out.items())]
-    result_spec = [jax.ShapeDtypeStruct(tuple(v.shape), np.dtype(v.dtype))
-                   for _, vs in sorted(probe_out.items()) for v in vs]
+    if opdef.infer_shape is not None:
+        # side-effecting host ops (print, assert) declare their output
+        # shapes so the zero-filled probe below — which would EXECUTE
+        # the side effect at trace time — is never run for them
+        spec_in = {s: [(tuple(v.shape), str(np.dtype(v.dtype)))
+                       for v in vs] for s, vs in ins.items()}
+        inferred = opdef.infer_shape(spec_in, dict(attrs))
+        out_slots = [(s, len(vs)) for s, vs in sorted(inferred.items())]
+        result_spec = [jax.ShapeDtypeStruct(tuple(shape), np.dtype(dt))
+                       for _, vs in sorted(inferred.items())
+                       for shape, dt in vs]
+    else:
+        probe = [np.zeros(v.shape, v.dtype) for v in flat]
+        # NOTE: under stackless tracing, jnp constants created inside
+        # compute come back as tracers — only .shape/.dtype may be read.
+        probe_out = ops_lib.normalize_outs(
+            opdef.compute(rebuild(probe), dict(attrs)))
+        out_slots = [(s, len(vs)) for s, vs in sorted(probe_out.items())]
+        result_spec = [
+            jax.ShapeDtypeStruct(tuple(v.shape), np.dtype(v.dtype))
+            for _, vs in sorted(probe_out.items()) for v in vs]
 
     def host_fn(*flat_vals):
         outs = ops_lib.normalize_outs(opdef.compute(
@@ -238,6 +251,22 @@ def _host_callback_op(opdef, op, ins, attrs):
         return tuple(np.asarray(v) for _, vs in sorted(outs.items())
                      for v in vs)
 
+    if op.type in ("print", "assert"):
+        # observable effects with passthrough-or-no outputs: a debug
+        # callback keeps the effect alive under jit AND autodiff
+        # (pure_callback with unused outputs is DCE-able; io_callback
+        # does not support vjp), and the outputs are synthesized as the
+        # identity of the inputs instead of round-tripping to host
+        def effect_fn(*flat_vals):
+            opdef.compute(
+                rebuild([np.asarray(v) for v in flat_vals]),
+                dict(attrs))
+
+        jax.debug.callback(effect_fn, *flat, ordered=True)
+        outs = {}
+        for s, n in out_slots:
+            outs[s] = list(flat[:n])  # print: Out = its input
+        return outs
     flat_out = jax.pure_callback(host_fn, tuple(result_spec), *flat)
     outs, i = {}, 0
     for s, n in out_slots:

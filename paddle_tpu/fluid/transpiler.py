@@ -45,6 +45,10 @@ class DistributeTranspilerConfig:
         self.geo_sgd_mode = False
         self.geo_sgd_need_push_nums = 100
         self.completely_not_async = False
+        # half-async communicator (reference: communicator.h:299
+        # HalfAsyncCommunicator): trainers enqueue grads and continue;
+        # a background thread merges + batch-sends and pulls params back
+        self.half_async = False
         self.mode = "pserver"
         self.print_log = False
         self.wait_port = True
@@ -77,6 +81,8 @@ class DistributeTranspiler:
         self._eplist = [e.strip() for e in pservers.split(",") if e.strip()]
         if self.config.geo_sgd_mode:
             self._mode = "geo"
+        elif self.config.half_async:
+            self._mode = "half_async"
         elif sync_mode:
             self._mode = "sync"
         else:
@@ -120,7 +126,7 @@ class DistributeTranspiler:
         # operators/distributed_ops/distributed_lookup_table_op.cc +
         # distributed/parameter_prefetch.cc)
         self._sparse_tables = {}
-        if self._mode in ("sync", "async"):
+        if self._mode in ("sync", "async", "half_async"):
             self._rewrite_sparse_lookups(block, bops[0])
 
         # trainer rewrite: optimizer ops for remote params are replaced by

@@ -109,15 +109,13 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     flash kernel on TPU (ops/nn_ops.py scaled_dot_product_attention)."""
     ins = {"Q": [query], "K": [key], "V": [value]}
     if attn_mask is not None:
-        shp = tuple(attn_mask.shape)
-        if len(shp) != 2:
-            raise NotImplementedError(
-                "scaled_dot_product_attention takes an additive KEY bias "
-                "of shape [batch, seq_k]; got mask shape %s. Full "
-                "[B,H,Sq,Sk] masks are not supported by the fused "
-                "kernel — fold them into is_causal or a key bias."
-                % (shp,))
-        ins["KeyBias"] = [attn_mask]
+        # paddle 2.x semantics: attn_mask is ALWAYS a full additive (or
+        # bool keep-) mask broadcastable to [B, H, Sq, Sk] — routed down
+        # the op's unfused XLA path. A [batch, seq_k] KEY bias (which
+        # rides the fused/flash path) is a different parameter: use
+        # fluid.layers.scaled_dot_product_attention(key_bias=...) —
+        # shape-guessing between the two here silently mis-broadcasts.
+        ins["Mask"] = [attn_mask]
     return _apply_op("scaled_dot_product_attention",
                      "scaled_dot_product_attention", ins,
                      {"causal": is_causal,

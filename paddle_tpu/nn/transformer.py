@@ -4,9 +4,11 @@ is the incubate transformer models). MXU note: attention and FFN are
 plain matmul chains — XLA fuses the bias/activation/dropout elementwise
 work into them; on real TPU configs the Pallas flash-attention kernel
 (ops/pallas/flash_attention.py) takes over via
-functional.scaled_dot_product_attention."""
+functional.scaled_dot_product_attention. With need_weights=True the
+unfused path runs instead (the prob matrix must exist to be returned)."""
 from __future__ import annotations
 
+import collections
 import math
 
 import numpy as np
@@ -21,6 +23,12 @@ __all__ = ["MultiHeadAttention", "TransformerEncoderLayer",
 
 
 class MultiHeadAttention(Layer):
+    # incremental-decoding caches (paddle 2.0 transformer.py Cache /
+    # StaticCache): Cache grows along seq_k each step (self-attention),
+    # StaticCache is precomputed once (cross-attention to the encoder)
+    Cache = collections.namedtuple("Cache", ["k", "v"])
+    StaticCache = collections.namedtuple("StaticCache", ["k", "v"])
+
     def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None,
                  vdim=None, need_weights=False, weight_attr=None,
                  bias_attr=None):
@@ -30,44 +38,89 @@ class MultiHeadAttention(Layer):
         self.num_heads = num_heads
         self.head_dim = embed_dim // num_heads
         self.dropout = dropout
-        if need_weights:
-            raise NotImplementedError(
-                "need_weights=True is not supported: the fused attention "
-                "path never materializes the [B,H,Sq,Sk] prob matrix "
-                "(that is the point of the flash kernel)")
+        self.need_weights = need_weights
         self.q_proj = Linear(embed_dim, embed_dim)
         self.k_proj = Linear(kdim or embed_dim, embed_dim)
         self.v_proj = Linear(vdim or embed_dim, embed_dim)
         self.out_proj = Linear(embed_dim, embed_dim)
 
+    def _heads(self, t):
+        b, s, _ = t._val.shape
+        return dy_base.trace_op(
+            "transpose2",
+            {"X": [dy_base.trace_op(
+                "reshape2", {"X": [t]},
+                {"shape": [b, s, self.num_heads, self.head_dim]},
+                ["Out", "XShape"])[0]]},
+            {"axis": [0, 2, 1, 3]}, ["Out", "XShape"])[0]
+
+    def gen_cache(self, key, value=None, type=None):
+        """Build an incremental-decoding cache (paddle 2.0
+        MultiHeadAttention.gen_cache). type=StaticCache: project the
+        encoder output once; otherwise start an empty growing Cache."""
+        if type is MultiHeadAttention.StaticCache or value is not None:
+            k = self._heads(self.k_proj(key))
+            v = self._heads(self.v_proj(value
+                                        if value is not None else key))
+            return MultiHeadAttention.StaticCache(k, v)
+        b = key._val.shape[0]
+        zeros = dy_base.to_variable(np.zeros(
+            (b, self.num_heads, 0, self.head_dim), "float32"))
+        return MultiHeadAttention.Cache(zeros, zeros)
+
+    def _attn_unfused(self, qh, kh, vh, attn_mask):
+        """Unfused attention that RETURNS the prob matrix."""
+        scores = dy_base.trace_op(
+            "matmul", {"X": [qh], "Y": [kh]},
+            {"transpose_X": False, "transpose_Y": True,
+             "alpha": 1.0 / math.sqrt(self.head_dim)}, ["Out"])[0]
+        if attn_mask is not None:
+            scores = dy_base.trace_op(
+                "elementwise_add", {"X": [scores], "Y": [attn_mask]},
+                {}, ["Out"])[0]
+        weights = dy_base.trace_op("softmax", {"X": [scores]},
+                                   {"axis": -1}, ["Out"])[0]
+        if self.dropout and self.training:
+            weights = dy_base.trace_op(
+                "dropout", {"X": [weights]},
+                {"dropout_prob": self.dropout,
+                 "dropout_implementation": "upscale_in_train",
+                 "is_test": False}, ["Out", "Mask"])[0]
+        ctx = dy_base.trace_op("matmul", {"X": [weights], "Y": [vh]},
+                               {"transpose_X": False,
+                                "transpose_Y": False, "alpha": 1.0},
+                               ["Out"])[0]
+        return ctx, weights
+
     def forward(self, query, key=None, value=None, attn_mask=None,
                 cache=None):
-        if cache is not None:
-            raise NotImplementedError(
-                "incremental decoding cache is not supported by the "
-                "fused attention path yet")
         key = query if key is None else key
         value = key if value is None else value
         q = self.q_proj(query)
-        k = self.k_proj(key)
-        v = self.v_proj(value)
+        qh = self._heads(q)
 
-        import jax.numpy as jnp
+        new_cache = None
+        if isinstance(cache, MultiHeadAttention.StaticCache):
+            kh, vh = cache.k, cache.v
+        else:
+            kh = self._heads(self.k_proj(key))
+            vh = self._heads(self.v_proj(value))
+            if isinstance(cache, MultiHeadAttention.Cache):
+                kh = dy_base.trace_op("concat",
+                                      {"X": [cache.k, kh]},
+                                      {"axis": 2}, ["Out"])[0]
+                vh = dy_base.trace_op("concat",
+                                      {"X": [cache.v, vh]},
+                                      {"axis": 2}, ["Out"])[0]
+                new_cache = MultiHeadAttention.Cache(kh, vh)
 
-        def heads(t):
-            b, s, _ = t._val.shape
-            return dy_base.trace_op(
-                "transpose2",
-                {"X": [dy_base.trace_op(
-                    "reshape2", {"X": [t]},
-                    {"shape": [b, s, self.num_heads, self.head_dim]},
-                    ["Out", "XShape"])[0]]},
-                {"axis": [0, 2, 1, 3]}, ["Out", "XShape"])[0]
-
-        qh, kh, vh = heads(q), heads(k), heads(v)
-        ctx = F.scaled_dot_product_attention(
-            qh, kh, vh, attn_mask=attn_mask,
-            dropout_p=self.dropout if self.training else 0.0)
+        if self.need_weights:
+            ctx, weights = self._attn_unfused(qh, kh, vh, attn_mask)
+        else:
+            ctx = F.scaled_dot_product_attention(
+                qh, kh, vh, attn_mask=attn_mask,
+                dropout_p=self.dropout if self.training else 0.0)
+            weights = None
         b, h, s, d = ctx._val.shape
         ctx = dy_base.trace_op("transpose2", {"X": [ctx]},
                                {"axis": [0, 2, 1, 3]},
@@ -75,7 +128,17 @@ class MultiHeadAttention(Layer):
         ctx = dy_base.trace_op("reshape2", {"X": [ctx]},
                                {"shape": [b, s, h * d]},
                                ["Out", "XShape"])[0]
-        return self.out_proj(ctx)
+        out = self.out_proj(ctx)
+        # paddle 2.0 return contract: out, +weights if requested,
+        # +cache if one was passed
+        outs = [out]
+        if self.need_weights:
+            outs.append(weights)
+        if cache is not None and new_cache is not None:
+            outs.append(new_cache)
+        elif isinstance(cache, MultiHeadAttention.StaticCache):
+            outs.append(cache)
+        return out if len(outs) == 1 else tuple(outs)
 
 
 class TransformerEncoderLayer(Layer):

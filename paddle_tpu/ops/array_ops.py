@@ -103,8 +103,8 @@ def _max_sequence_len(ins, attrs):
         return {"Out": jnp.max(ins["Length"][0]).astype(jnp.int64)}
     if ins.get("Lengths"):
         return {"Out": jnp.max(ins["Lengths"][0]).astype(jnp.int64)}
-    raise NotImplementedError(
-        "max_sequence_len needs a Length/Lengths input (feed "
+    raise ValueError(
+        "max_sequence_len: wire a Length/Lengths input (feed "
         "lod_rank_table's Lengths output); the rank-table order alone "
         "does not carry sequence lengths in the padded representation")
 

@@ -565,24 +565,47 @@ def _random_crop(ins, attrs):
     return {"Out": out, "SeedOut": jnp.zeros((1,), jnp.int64)}
 
 
-@register_op("similarity_focus")
+@register_op("similarity_focus", no_jit=True)
 def _similarity_focus(ins, attrs):
-    """similarity_focus_op.cc: for each selected channel, mark the
-    (h, w) argmax positions row/col-wise with 1."""
-    x = ins["X"][0]                                    # [N, C, H, W]
+    """similarity_focus_op.cc: for each selected slice along `axis`
+    (1, 2 or 3), greedily pick the largest values such that each row and
+    each column is used at most once (min(B, C) picks), mark those
+    positions 1, OR over indexes, broadcast back to x's shape. Host-side
+    (no_jit): the greedy selection is inherently sequential — the
+    reference ships only a CPU kernel for it too."""
+    import numpy as np
+
+    x = np.asarray(ins["X"][0])                        # [N, A, B, C]
     axis = int(attrs.get("axis", 1))
-    indexes = attrs.get("indexes", [0])
-    if axis != 1:
-        raise NotImplementedError(
-            "similarity_focus: only the channel axis (1) is supported")
-    mark = jnp.zeros((x.shape[0], 1) + x.shape[2:], x.dtype)
-    for ch in indexes:
-        plane = x[:, ch]                               # [N, H, W]
-        rmax = (plane == plane.max(2, keepdims=True))
-        cmax = (plane == plane.max(1, keepdims=True))
-        mark = jnp.maximum(mark,
-                           (rmax | cmax).astype(x.dtype)[:, None])
-    return {"Out": jnp.broadcast_to(mark, x.shape)}
+    indexes = list(attrs.get("indexes", [0]))
+    if axis not in (1, 2, 3):
+        raise ValueError(
+            "similarity_focus: axis must be 1, 2 or 3 (reference "
+            "similarity_focus_op.cc:28)")
+    perm = [0, axis] + [d for d in (1, 2, 3) if d != axis]
+    xt = np.transpose(x, perm)                         # [N, K, B, C]
+    n, _, b, c = xt.shape
+    mark = np.zeros((n, 1, b, c), x.dtype)
+    for bi in range(n):
+        for idx in indexes:
+            t = xt[bi, idx]
+            order = np.argsort(-t, axis=None, kind="stable")
+            used_r = np.zeros(b, bool)
+            used_c = np.zeros(c, bool)
+            picked = 0
+            for pos in order:
+                r, col = divmod(int(pos), c)
+                if used_r[r] or used_c[col]:
+                    continue
+                mark[bi, 0, r, col] = 1
+                used_r[r] = used_c[col] = True
+                picked += 1
+                if picked == min(b, c):
+                    break
+    out = np.broadcast_to(mark, xt.shape)
+    inv = np.argsort(perm)
+    return {"Out": jnp.asarray(np.ascontiguousarray(
+        np.transpose(out, inv)))}
 
 
 @register_op("add_position_encoding")

@@ -104,7 +104,11 @@ def _load_combine(ins, attrs):
 
 # -- debug ops --------------------------------------------------------------
 
-@register_op("print", no_jit=True)
+def _print_infer(ins, attrs):
+    return {"Out": list(ins.get("In") or ins["X"])}
+
+
+@register_op("print", no_jit=True, infer_shape=_print_infer)
 def _print(ins, attrs):
     x = ins["In"][0] if ins.get("In") else ins["X"][0]
     arr = np.asarray(x)
@@ -308,3 +312,24 @@ def _run_program(ins, attrs):
     feed = {n: np.asarray(v) for n, v in zip(feed_names, ins.get("X", []))}
     outs = Executor().run(program, feed=feed, fetch_list=fetch_names)
     return {"Out": [jnp.asarray(np.asarray(o)) for o in outs]}
+
+
+@register_op("assert", no_jit=True, infer_shape=lambda ins, attrs: {})
+def _assert(ins, attrs):
+    """Runtime assertion (reference: operators/assert_op.cc): raises
+    when the bool condition is not all-true; optional data tensors are
+    included in the message. Host-side (no_jit) like the reference's
+    CPU-only kernel."""
+    cond = np.asarray(ins["Cond"][0])
+    if not bool(cond.all()):
+        datas = [np.asarray(d) for d in ins.get("Data", [])]
+        summarize = int(attrs.get("summarize", -1))
+        parts = []
+        for d in datas:
+            flat = d.reshape(-1)
+            parts.append(str(flat[:summarize] if summarize > 0 else flat))
+        raise AssertionError(
+            attrs.get("message", "") or
+            "Assert failed%s" % ((": " + "; ".join(parts))
+                                 if parts else ""))
+    return {}

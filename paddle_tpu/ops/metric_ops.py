@@ -148,11 +148,21 @@ def _edit_distance(ins, attrs):
             "SequenceNum": np.asarray([hyp.shape[0]], np.int64)}
 
 
+# (num_tag_types, tag_begin, tag_inside, tag_end, tag_single) per scheme
+# — reference: chunk_eval_op.cc:119 InEnum + chunk_eval_op.h tag table
+_CHUNK_SCHEMES = {
+    "plain": (1, 0, -1, -1, -1),
+    "IOB": (2, 0, 1, -1, -1),
+    "IOE": (2, -1, 0, 1, -1),
+    "IOBES": (4, 0, 1, 2, 3),
+}
+
+
 @register_op("chunk_eval", no_jit=True)
 def _chunk_eval(ins, attrs):
     """Chunk-level precision/recall/F1 for sequence labeling
-    (reference: operators/metrics/chunk_eval_op.cc). Schemes: IOB
-    (default), IOE, plain; others raise."""
+    (reference: operators/chunk_eval_op.h GetSegments/ChunkBegin/
+    ChunkEnd state machine). Schemes: plain, IOB, IOE, IOBES."""
     import numpy as np
 
     inference = np.asarray(ins["Inference"][0])
@@ -160,9 +170,11 @@ def _chunk_eval(ins, attrs):
     num_chunk_types = attrs["num_chunk_types"]
     scheme = attrs.get("chunk_scheme", "IOB")
     excluded = set(attrs.get("excluded_chunk_types", []) or [])
-    if scheme not in ("IOB", "IOE", "plain"):
-        raise NotImplementedError(
-            "chunk_scheme %r not supported (IOB, IOE, plain)" % scheme)
+    if scheme not in _CHUNK_SCHEMES:
+        raise ValueError(
+            "chunk_scheme %r invalid: must be one of %s (reference "
+            "chunk_eval_op.cc:119)" % (scheme,
+                                       sorted(_CHUNK_SCHEMES)))
     # batched [B, T] input: segment per sequence (SeqLength bounds each
     # row; without it, the full row). 1-D input = one sequence.
     if inference.ndim == 1:
@@ -172,44 +184,49 @@ def _chunk_eval(ins, attrs):
         if ins.get("SeqLength") else np.full((inference.shape[0],),
                                              inference.shape[1])
 
+    n_tag, t_begin, t_inside, t_end, t_single = _CHUNK_SCHEMES[scheme]
+    other = num_chunk_types  # type id of the Outside label
+
+    def _chunk_end(pt, pty, t, ty):
+        # reference: chunk_eval_op.h:89 ChunkEnd
+        if pty == other:
+            return False
+        if ty == other or ty != pty:
+            return True
+        if pt == t_begin or pt == t_inside:
+            return t == t_begin or t == t_single
+        return pt == t_end or pt == t_single
+
+    def _chunk_begin(pt, pty, t, ty):
+        # reference: chunk_eval_op.h:102 ChunkBegin
+        if pty == other:
+            return ty != other
+        if ty == other:
+            return False
+        if ty != pty:
+            return True
+        if t == t_begin or t == t_single:
+            return True
+        if t == t_inside or t == t_end:
+            return pt == t_end or pt == t_single
+        return False
+
     def chunks(tags):
+        # reference: chunk_eval_op.h:41 GetSegments — one pass with the
+        # scheme-parameterized begin/end predicates
         out = []
-        start, ctype = None, None
-        for i, t in enumerate(tags):
-            t = int(t)
-            is_outside = (t >= num_chunk_types if scheme == "plain"
-                          else t >= num_chunk_types * 2)
-            if is_outside:
-                if start is not None:
-                    out.append((start, i, ctype))
-                start, ctype = None, None
-                continue
-            if scheme == "plain":
-                if ctype != t:
-                    if start is not None:
-                        out.append((start, i, ctype))
-                    start, ctype = i, t
-                continue
-            ct, mark = divmod(t, 2)  # IOB: mark=1 is I; IOE: mark=1 is E
-            if scheme == "IOB":
-                if mark == 0:  # B starts a chunk
-                    if start is not None:
-                        out.append((start, i, ctype))
-                    start, ctype = i, ct
-                elif start is None or ctype != ct:
-                    if start is not None:
-                        out.append((start, i, ctype))
-                    start, ctype = i, ct
-            else:  # IOE
-                if start is None or ctype != ct:
-                    if start is not None:
-                        out.append((start, i, ctype))
-                    start, ctype = i, ct
-                if mark == 1:  # E closes the chunk
-                    out.append((start, i + 1, ctype))
-                    start, ctype = None, None
-        if start is not None:
-            out.append((start, len(tags), ctype))
+        start, in_chunk = 0, False
+        tag, ty = -1, other
+        for i, lbl in enumerate(tags):
+            pt, pty = tag, ty
+            tag, ty = int(lbl) % n_tag, int(lbl) // n_tag
+            if in_chunk and _chunk_end(pt, pty, tag, ty):
+                out.append((start, i, pty))
+                in_chunk = False
+            if _chunk_begin(pt, pty, tag, ty):
+                start, in_chunk = i, True
+        if in_chunk:
+            out.append((start, len(tags), ty))
         return set(out)
 
     pred, gold = set(), set()

@@ -236,16 +236,23 @@ def _conv2d_transpose(ins, attrs):
     strides = _pair(attrs.get("strides", [1, 1]))
     paddings = _pair(attrs.get("paddings", [0, 0]))
     dilations = _pair(attrs.get("dilations", [1, 1]))
-    groups = attrs.get("groups", 1)
-    if groups != 1:
-        raise NotImplementedError("grouped conv2d_transpose")
+    groups = int(attrs.get("groups", 1))
     kh, kw = w.shape[2], w.shape[3]
+    if groups != 1:
+        # (in, out/g, kh, kw) -> (in/g, out, kh, kw) with the group index
+        # folded MAJOR into the O dim, matching XLA's feature_group_count
+        # contract (lhs group i consumes kernel O slice i)
+        cin, og = w.shape[0], w.shape[1]
+        w = (w.reshape(groups, cin // groups, og, kh, kw)
+             .transpose(1, 0, 2, 3, 4)
+             .reshape(cin // groups, groups * og, kh, kw))
     pads = [(dilations[0] * (kh - 1) - paddings[0],) * 2,
             (dilations[1] * (kw - 1) - paddings[1],) * 2]
     out = lax.conv_general_dilated(
         x, jnp.flip(w, (2, 3)), window_strides=(1, 1), padding=pads,
         lhs_dilation=tuple(strides), rhs_dilation=tuple(dilations),
-        dimension_numbers=("NCHW", "IOHW", "NCHW"))
+        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        feature_group_count=groups)
     return {"Output": out}
 
 
@@ -542,6 +549,8 @@ def _sdpa(ins, attrs):
     q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
     bias = ins.get("KeyBias", [None])
     bias = bias[0] if bias else None
+    mask = ins.get("Mask", [None])  # full additive mask, bcast to
+    mask = mask[0] if mask else None  # [B, H, Sq, Sk]
     causal = attrs.get("causal", False)
     sm_scale = attrs.get("sm_scale", None)
     if sm_scale is not None and sm_scale <= 0:
@@ -550,7 +559,7 @@ def _sdpa(ins, attrs):
     is_test = attrs.get("is_test", False)
     drop_active = (not is_test) and p_drop > 0.0
 
-    if not drop_active:
+    if not drop_active and mask is None:
         # Pallas flash only where its O(S) memory matters: below the
         # threshold XLA's fused softmax-attention is faster on v5e
         # (FLAGS_flash_attention_min_seq; measured: flash loses up to at
@@ -576,15 +585,28 @@ def _sdpa(ins, attrs):
                    preferred_element_type=jnp.float32) * scale
     if bias is not None:
         s = s + bias[:, None, None, :].astype(jnp.float32)
+    if mask is not None:
+        # [Sq,Sk] -> [1,1,Sq,Sk]; [B,Sq,Sk] -> [B,1,Sq,Sk] (head axis
+        # inserted at dim 1, NOT prepended — [1,B,Sq,Sk] would misalign
+        # batch with heads)
+        if mask.ndim == 2:
+            mask = mask[None, None]
+        elif mask.ndim == 3:
+            mask = mask[:, None]
+        if mask.dtype == jnp.bool_:  # True = attend (paddle semantics)
+            s = jnp.where(mask, s, -1e30)
+        else:
+            s = s + mask.astype(jnp.float32)
     if causal:
         Sq, Sk = s.shape[-2], s.shape[-1]
         rows = lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
         cols = lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1)
         s = jnp.where(rows >= cols, s, -1e30)
     probs = jax.nn.softmax(s, axis=-1)
-    keep = jax.random.bernoulli(attrs["_rng_key"], 1.0 - p_drop,
-                                probs.shape)
-    probs = jnp.where(keep, probs / (1.0 - p_drop), 0.0)
+    if drop_active:
+        keep = jax.random.bernoulli(attrs["_rng_key"], 1.0 - p_drop,
+                                    probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - p_drop), 0.0)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v,
                      preferred_element_type=jnp.float32)
     return {"Out": out.astype(q.dtype)}

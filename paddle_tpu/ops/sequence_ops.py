@@ -68,7 +68,9 @@ def _sequence_pool(ins, attrs):
     elif ptype == "FIRST":
         out = x[:, 0]
     else:
-        raise NotImplementedError(ptype)
+        # reference InEnum (sequence_pool_op.cc:69); layers.sequence_pool
+        # already validates at construction — this backstops direct op use
+        raise ValueError("sequence_pool: unknown pooltype %r" % (ptype,))
     return {"Out": out, "MaxIndex": jnp.zeros(out.shape, jnp.int32)}
 
 

@@ -20,7 +20,13 @@ STEPS = 5
 BATCH = 32  # global; each trainer sees half
 
 
-def build(seed=11):
+def _lr(mode):
+    """Stale-gradient modes need a cooler step size (standard async-SGD
+    practice; the sync/async tests keep the hot LR for exact parity)."""
+    return 0.1 if mode == "half_async" else LR
+
+
+def build(seed=11, mode="sync"):
     main, startup = framework.Program(), framework.Program()
     main.random_seed = startup.random_seed = seed
     with framework.program_guard(main, startup):
@@ -32,7 +38,7 @@ def build(seed=11):
             logits = fluid.layers.fc(input=h, size=4)
             loss = fluid.layers.mean(
                 fluid.layers.softmax_with_cross_entropy(logits, label))
-            opt = fluid.optimizer.SGDOptimizer(learning_rate=LR)
+            opt = fluid.optimizer.SGDOptimizer(learning_rate=_lr(mode))
             opt.minimize(loss)
     return main, startup, loss
 
@@ -62,7 +68,7 @@ def run_single():
 def run_pserver(endpoint, eplist, n_trainers, mode):
     from paddle_tpu.distributed.ps import listen_and_serv
 
-    main, startup, loss = build()
+    main, startup, loss = build(mode=mode)
     t = _transpiler(mode)
     t.transpile(0, program=main, pservers=eplist, trainers=n_trainers,
                 sync_mode=(mode == "sync"), startup_program=startup)
@@ -79,13 +85,15 @@ def _transpiler(mode):
     if mode == "geo":
         cfg.geo_sgd_mode = True
         cfg.geo_sgd_need_push_nums = 2
+    elif mode == "half_async":
+        cfg.half_async = True
     return fluid.DistributeTranspiler(config=cfg)
 
 
 def run_trainer(tid, eplist, n_trainers, mode):
     from paddle_tpu.core.scope import Scope
 
-    main, startup, loss = build()
+    main, startup, loss = build(mode=mode)
     t = _transpiler(mode)
     t.transpile(tid, program=main, pservers=eplist, trainers=n_trainers,
                 sync_mode=(mode == "sync"), startup_program=startup)

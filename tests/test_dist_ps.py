@@ -41,7 +41,7 @@ def _losses(out):
             if line.startswith("LOSS")]
 
 
-@pytest.mark.parametrize("mode", ["sync", "async", "geo"])
+@pytest.mark.parametrize("mode", ["sync", "async", "geo", "half_async"])
 def test_ps_2x2_localhost(mode):
     eps = "127.0.0.1:%d,127.0.0.1:%d" % (_free_port(), _free_port())
     ep_list = eps.split(",")

@@ -234,3 +234,149 @@ def test_declarative_while_bool_and_int_carry():
         n = paddle.to_tensor(np.array([3], "int32"))
         out = f(x, n)
         np.testing.assert_allclose(out.numpy(), np.full((2,), 3.0))
+
+
+# -- round-3 long-tail transformers (VERDICT r2 next #7; reference:
+# cast/print/assert/return_flow/break_continue transformers) ------------
+
+
+def test_d2s_early_return_tensor_cond():
+    """Early `return` guarded by a tensor condition: FlowNormalizer
+    folds the rest into the else branch -> lax.cond."""
+
+    @declarative
+    def f(x):
+        s = paddle.fluid.layers.reduce_sum(x)
+        if s > 10.0:
+            return s * 2.0
+        y = s + 1.0
+        return y * 3.0
+
+    with dygraph.guard():
+        lo = f(paddle.to_tensor(np.ones((2, 2), "float32")))  # s=4
+        hi = f(paddle.to_tensor(np.full((2, 2), 4.0, "float32")))  # s=16
+        np.testing.assert_allclose(lo.numpy(), (4 + 1) * 3, rtol=1e-5)
+        np.testing.assert_allclose(hi.numpy(), 32.0, rtol=1e-5)
+
+
+def test_d2s_nested_early_returns():
+    @declarative
+    def f(x):
+        s = paddle.fluid.layers.reduce_sum(x)
+        if s > 10.0:
+            if s > 100.0:
+                return s
+            return s * 2.0
+        return s * 3.0
+
+    with dygraph.guard():
+        a = f(paddle.to_tensor(np.full((2, 2), 50.0, "float32")))  # 200
+        b = f(paddle.to_tensor(np.full((2, 2), 5.0, "float32")))   # 20
+        c = f(paddle.to_tensor(np.ones((2, 2), "float32")))        # 4
+        np.testing.assert_allclose(a.numpy(), 200.0, rtol=1e-5)
+        np.testing.assert_allclose(b.numpy(), 40.0, rtol=1e-5)
+        np.testing.assert_allclose(c.numpy(), 12.0, rtol=1e-5)
+
+
+def test_d2s_break_continue_tensor_while():
+    """break/continue desugar to guard flags, so a tensor `while` with
+    them still lowers to lax.while_loop."""
+
+    @declarative
+    def f(x):
+        i = paddle.fluid.layers.fill_constant([1], "float32", 0.0)
+        acc = paddle.fluid.layers.fill_constant([1], "float32", 0.0)
+        while i < 10.0:
+            i = i + 1.0
+            if i > 6.0:
+                break
+            if i < 3.0:
+                continue
+            acc = acc + i
+        return acc, i
+
+    with dygraph.guard():
+        acc, i = f(paddle.to_tensor(np.zeros((1,), "float32")))
+        # i runs 1..6; continue skips 1,2; break fires at i=7 before add
+        assert float(acc.numpy()[0]) == 3 + 4 + 5 + 6
+        assert float(i.numpy()[0]) == 7.0
+
+
+def test_d2s_break_continue_python_loop():
+    @declarative
+    def f(x):
+        total = 0.0
+        k = 0
+        while k < 8:
+            k += 1
+            if k == 2:
+                continue
+            if k == 5:
+                break
+            total += k
+        return x + total
+
+    with dygraph.guard():
+        out = f(paddle.to_tensor(np.zeros((1,), "float32")))
+        assert float(out.numpy()[0]) == 1 + 3 + 4
+
+
+def test_d2s_cast_builtins():
+    @declarative
+    def f(x):
+        y = float(paddle.fluid.layers.reduce_sum(x))
+        z = int(y)
+        b = bool(z)
+        n = len(x)  # static shape[0] -> python int, usable as a scalar
+        return y, z, b, y * n
+
+    with dygraph.guard():
+        y, z, b, yn = f(paddle.to_tensor(np.full((3, 2), 1.5,
+                                                 "float32")))
+        assert float(y.numpy().ravel()[0]) == 9.0
+        assert np.asarray(z.numpy()).astype("int64").ravel()[0] == 9
+        assert bool(np.asarray(b.numpy()).ravel()[0]) is True
+        assert float(yn.numpy().ravel()[0]) == 27.0  # len(x) == 3
+
+
+def test_d2s_assert_and_print(capsys):
+    @declarative
+    def f(x):
+        s = paddle.fluid.layers.reduce_sum(x)
+        assert s > 0.0, "sum must be positive"
+        print(s)
+        return s * 2.0
+
+    with dygraph.guard():
+        out = f(paddle.to_tensor(np.ones((2, 2), "float32")))
+        assert float(out.numpy().ravel()[0]) == 8.0
+        import jax
+
+        jax.effects_barrier()  # debug-callback prints flush async
+        captured = capsys.readouterr().out
+        assert "data=" in captured  # runtime print op fired
+
+        # the executor wraps runtime op errors with the op callstack
+        # (core/errors.py attach_op_callstack), so the AssertionError
+        # surfaces as RuntimeError with the message preserved
+        with pytest.raises(Exception, match="sum must be positive"):
+            f(paddle.to_tensor(np.full((2, 2), -1.0, "float32")))
+
+
+def test_d2s_early_return_branch_reads_and_assigns():
+    """A returning branch that updates a name it also reads must get the
+    incoming value as a parameter (code-review r3 finding)."""
+
+    @declarative
+    def f(x):
+        s = paddle.fluid.layers.reduce_sum(x)
+        if s > 10.0:
+            s = s * 2.0
+            return s
+        return s + 1.0
+
+    with dygraph.guard():
+        hi = f(paddle.to_tensor(np.full((2, 2), 4.0, "float32")))
+        lo = f(paddle.to_tensor(np.ones((2, 2), "float32")))
+        np.testing.assert_allclose(hi.numpy(), 32.0, rtol=1e-5)
+        np.testing.assert_allclose(lo.numpy(), 5.0, rtol=1e-5)

@@ -148,3 +148,101 @@ def test_io_state_helpers(tmp_path):
                                                  program=main)
             np.testing.assert_allclose(w2, w)
             assert "iofc.w_0" not in left
+
+
+# -- round-3 dataset long tail (VERDICT r2 next #9) ---------------------
+
+
+def test_dataset_module_inventory_matches_reference():
+    import paddle_tpu.dataset as ds
+
+    for m in ("mnist", "cifar", "uci_housing", "imdb", "imikolov",
+              "conll05", "movielens", "mq2007", "sentiment", "flowers",
+              "voc2012", "wmt14", "wmt16", "image", "common"):
+        assert hasattr(ds, m), m
+
+
+def test_conll05_contract():
+    from paddle_tpu.dataset import conll05
+
+    w, v, l = conll05.get_dict()
+    emb = conll05.get_embedding()
+    assert emb.shape[0] == len(w)
+    sample = next(iter(conll05.test()()))
+    assert len(sample) == 9
+    length = len(sample[0])
+    assert all(len(s) == length for s in sample)
+    assert all(t < len(l) for t in sample[8])
+    assert sum(sample[7]) == 1  # exactly one predicate mark
+
+
+def test_movielens_contract():
+    from paddle_tpu.dataset import movielens
+
+    s = next(iter(movielens.train()()))
+    # [user_id, gender, age, job, movie_id, categories, title, [score]]
+    assert len(s) == 8
+    assert 1 <= s[0] <= movielens.max_user_id()
+    assert 1 <= s[4] <= movielens.max_movie_id()
+    assert isinstance(s[5], list) and isinstance(s[6], list)
+    assert 1.0 <= s[7][0] <= 5.0
+    assert movielens.max_job_id() == 20
+    info = movielens.movie_info()[1]
+    assert "MovieInfo" in repr(info)
+
+
+def test_mq2007_formats():
+    from paddle_tpu.dataset import mq2007
+
+    hi_lbl, hi, lo = next(iter(mq2007.train(format="pairwise")))
+    assert len(hi) == 46 and len(lo) == 46
+    lbl, feat = next(iter(mq2007.train(format="pointwise")))
+    assert isinstance(lbl, float) and len(feat) == 46
+    labels, feats = next(iter(mq2007.train(format="listwise")))
+    assert len(labels) == len(feats)
+
+
+def test_sentiment_flowers_voc_contract():
+    from paddle_tpu.dataset import sentiment, flowers, voc2012
+
+    ids, label = next(iter(sentiment.train()()))
+    assert label in (0, 1) and max(ids) < len(sentiment.get_word_dict())
+    img, lbl = next(iter(flowers.train()()))
+    assert img.shape[0] == 3 and 0 <= lbl < 102
+    img, mask = next(iter(voc2012.train()()))
+    assert img.shape[1:] == mask.shape and mask.max() < 21
+
+
+def test_wmt_contract():
+    from paddle_tpu.dataset import wmt14, wmt16
+
+    src, trg_in, trg_next = next(iter(wmt14.train(1000)()))
+    assert trg_in[0] == wmt14.START_ID
+    assert trg_next[-1] == wmt14.END_ID
+    assert trg_in[1:] == trg_next[:-1]
+    sd, td = wmt14.get_dict(1000, reverse=True)
+    assert sd[0] == "<s>" and len(sd) == 1000
+
+    src, trg_in, trg_next = next(iter(wmt16.train(800, 900, "en")()))
+    assert max(src) < 800 and max(trg_in) < 900
+    d = wmt16.get_dict("de", 900)
+    assert d["<s>"] == 0 and len(d) == 900
+
+
+def test_image_transforms():
+    import numpy as np
+
+    from paddle_tpu.dataset import image as img_mod
+
+    im = np.arange(40 * 60 * 3, dtype="float32").reshape(40, 60, 3)
+    r = img_mod.resize_short(im, 20)
+    assert min(r.shape[:2]) == 20 and r.shape[1] == 30
+    c = img_mod.center_crop(r, 16)
+    assert c.shape[:2] == (16, 16)
+    chw = img_mod.to_chw(c)
+    assert chw.shape == (3, 16, 16)
+    f = img_mod.left_right_flip(c)
+    assert np.array_equal(f[:, 0], c[:, -1])
+    out = img_mod.simple_transform(im, 24, 16, is_train=True,
+                                   mean=[1.0, 2.0, 3.0])
+    assert out.shape == (3, 16, 16) and out.dtype == np.float32

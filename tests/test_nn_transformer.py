@@ -40,3 +40,114 @@ def test_transformer_encoder_trains():
             losses.append(float(np.asarray(loss._val).reshape(-1)[0]))
         assert losses[-1] < losses[0], losses
         assert len(enc.parameters()) > 10
+
+
+def test_multihead_attention_need_weights():
+    """need_weights=True returns (out, probs) via the unfused path
+    (paddle 2.0 transformer.py contract); probs rows sum to 1."""
+    with dygraph.guard():
+        mha = nn.MultiHeadAttention(embed_dim=16, num_heads=4,
+                                    need_weights=True)
+        x = dygraph.to_variable(
+            np.random.RandomState(0).randn(2, 5, 16).astype("float32"))
+        out, w = mha(x)
+        assert tuple(out._val.shape) == (2, 5, 16)
+        assert tuple(w._val.shape) == (2, 4, 5, 5)
+        np.testing.assert_allclose(np.asarray(w._val).sum(-1),
+                                   np.ones((2, 4, 5)), rtol=1e-5)
+        # unfused path must agree with the fused one (no dropout)
+        mha.need_weights = False
+        fused = mha(x)
+        np.testing.assert_allclose(np.asarray(fused._val),
+                                   np.asarray(out._val), rtol=2e-5,
+                                   atol=2e-5)
+
+
+def test_multihead_attention_cache_decode():
+    """Incremental decoding with Cache: step-by-step causal decode must
+    equal the full-sequence causal pass (paddle 2.0 gen_cache/Cache)."""
+    r = np.random.RandomState(3)
+    seq = r.randn(1, 4, 16).astype("float32")
+    with dygraph.guard():
+        mha = nn.MultiHeadAttention(embed_dim=16, num_heads=4)
+        mha.eval()
+        # full causal pass: additive [Sq, Sk] lower-triangular mask
+        causal = np.triu(np.full((4, 4), -1e9, "float32"), k=1)
+        full = mha(dygraph.to_variable(seq),
+                   attn_mask=dygraph.to_variable(causal))
+        full_np = np.asarray(full._val)
+
+        cache = mha.gen_cache(dygraph.to_variable(seq[:, :1]))
+        steps = []
+        for t in range(4):
+            tok = dygraph.to_variable(seq[:, t:t + 1])
+            out, cache = mha(tok, tok, tok, cache=cache)
+            steps.append(np.asarray(out._val)[:, 0])
+        dec = np.stack(steps, axis=1)
+    np.testing.assert_allclose(dec, full_np, rtol=2e-4, atol=2e-4)
+
+
+def test_multihead_attention_static_cache():
+    """StaticCache: encoder K/V projected once for cross-attention."""
+    r = np.random.RandomState(5)
+    with dygraph.guard():
+        mha = nn.MultiHeadAttention(embed_dim=16, num_heads=4)
+        mha.eval()
+        enc = dygraph.to_variable(r.randn(2, 6, 16).astype("float32"))
+        q = dygraph.to_variable(r.randn(2, 3, 16).astype("float32"))
+        cache = mha.gen_cache(enc, enc,
+                              type=nn.MultiHeadAttention.StaticCache)
+        out, cache2 = mha(q, cache=cache)
+        ref = mha(q, enc, enc)
+        np.testing.assert_allclose(np.asarray(out._val),
+                                   np.asarray(ref._val), rtol=2e-5,
+                                   atol=2e-5)
+        assert cache2 is cache
+
+
+def test_sdpa_full_mask():
+    """functional.scaled_dot_product_attention accepts a broadcastable
+    [Sq, Sk] / [B, H, Sq, Sk] additive mask (unfused XLA path)."""
+    import paddle_tpu.nn.functional as F
+
+    r = np.random.RandomState(7)
+    with dygraph.guard():
+        q = dygraph.to_variable(r.randn(2, 4, 5, 8).astype("float32"))
+        k = dygraph.to_variable(r.randn(2, 4, 5, 8).astype("float32"))
+        v = dygraph.to_variable(r.randn(2, 4, 5, 8).astype("float32"))
+        causal = np.triu(np.full((5, 5), -1e9, "float32"), k=1)
+        masked = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=dygraph.to_variable(causal),
+            training=False)
+        ref = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                             training=False)
+        np.testing.assert_allclose(np.asarray(masked._val),
+                                   np.asarray(ref._val), rtol=2e-5,
+                                   atol=2e-5)
+
+
+def test_sdpa_batched_3d_mask_broadcast():
+    """[B, Sq, Sk] masks insert the head axis at dim 1 (code-review r3:
+    prepending would misalign batch with heads)."""
+    import paddle_tpu.nn.functional as F
+
+    r = np.random.RandomState(9)
+    B, H, S, D = 2, 4, 5, 8
+    with dygraph.guard():
+        q = dygraph.to_variable(r.randn(B, H, S, D).astype("float32"))
+        k = dygraph.to_variable(r.randn(B, H, S, D).astype("float32"))
+        v = dygraph.to_variable(r.randn(B, H, S, D).astype("float32"))
+        # per-batch masks: batch 0 causal, batch 1 unmasked
+        m3 = np.zeros((B, S, S), "float32")
+        m3[0] = np.triu(np.full((S, S), -1e9, "float32"), k=1)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=dygraph.to_variable(m3), training=False)
+        causal_all = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True, training=False)
+        plain = F.scaled_dot_product_attention(q, k, v, training=False)
+        np.testing.assert_allclose(np.asarray(out._val)[0],
+                                   np.asarray(causal_all._val)[0],
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(out._val)[1],
+                                   np.asarray(plain._val)[1],
+                                   rtol=2e-5, atol=2e-5)

@@ -135,6 +135,9 @@ class TestFusionSeqexpandConcatFc(OpTest):
 
 class TestSimilarityFocus(OpTest):
     def test(self):
+        """Reference (similarity_focus_op.cc): greedy largest-value picks
+        with each row/col used at most once, broadcast over the selected
+        axis."""
         r = np.random.RandomState(6)
         x = r.randn(1, 3, 4, 4).astype("float32")
         self.op_type = "similarity_focus"
@@ -142,11 +145,17 @@ class TestSimilarityFocus(OpTest):
         self.attrs = {"axis": 1, "indexes": [1]}
         out = np.asarray(self._run_forward()["Out"][0])
         plane = x[0, 1]
-        rmax = plane == plane.max(1, keepdims=True)
-        cmax = plane == plane.max(0, keepdims=True)
-        e = (rmax | cmax).astype("float32")
+        expect = np.zeros((4, 4), "float32")
+        used_r, used_c = set(), set()
+        for pos in np.argsort(-plane, axis=None):
+            rr, cc = divmod(int(pos), 4)
+            if rr in used_r or cc in used_c:
+                continue
+            expect[rr, cc] = 1
+            used_r.add(rr)
+            used_c.add(cc)
         for ch in range(3):
-            np.testing.assert_array_equal(out[0, ch], e)
+            np.testing.assert_array_equal(out[0, ch], expect)
 
 
 class TestAddPositionEncoding(OpTest):
