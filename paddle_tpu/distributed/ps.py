@@ -215,14 +215,23 @@ class PSCommunicator:
             self._ha_thread.start()
         self._ha_wake.set()
         with self._ha_cv:
-            # bounded staleness: at most max_merge rounds may be unsent
+            # bounded staleness: at most max_merge rounds may be unsent.
+            # A stalled sender must be an ERROR, not a silent fallback
+            # to unbounded staleness.
             deadline = 60.0
             while (my_round - self._ha_done_round > self._ha_max_merge
                    and not self._ha_err and deadline > 0):
                 self._ha_cv.wait(timeout=0.5)
                 deadline -= 0.5
+            stalled = (my_round - self._ha_done_round
+                       > self._ha_max_merge)
         if self._ha_err:
             raise self._ha_err[0]
+        if stalled:
+            raise RuntimeError(
+                "half-async sender stalled: round %d still unsent after "
+                "60s (done=%d, max_merge=%d) — pserver unreachable?"
+                % (my_round, self._ha_done_round, self._ha_max_merge))
 
     # -- dense sync/async --------------------------------------------------
     def step(self, grads: Dict[str, np.ndarray], scope):

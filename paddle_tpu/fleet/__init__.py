@@ -71,6 +71,53 @@ class DistributedStrategy:
     def forward_recompute(self, v):
         self.recompute = v
 
+    # -- serialization (reference: distributed_strategy.proto text
+    # format via save_to_prototxt/load_from_prototxt,
+    # fleet/base/distributed_strategy.py:57) ----------------------------
+    def _fields(self):
+        return {k: v for k, v in vars(self).items()
+                if not k.startswith("_")}
+
+    def save_to_prototxt(self, path):
+        """Text-format dump: scalar knobs as `name: value`, config dicts
+        as nested `name { key: value }` blocks — the same shape the
+        reference's protobuf text format has, so saved strategies are
+        human-diffable."""
+        lines = []
+        for k, v in sorted(self._fields().items()):
+            if isinstance(v, dict):
+                lines.append("%s {" % k)
+                for ck, cv in sorted(v.items()):
+                    lines.append("  %s: %r" % (ck, cv))
+                lines.append("}")
+            else:
+                lines.append("%s: %r" % (k, v))
+        with open(path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+
+    def load_from_prototxt(self, path):
+        import ast as _ast
+
+        with open(path) as f:
+            lines = [ln.rstrip() for ln in f if ln.strip()]
+        i = 0
+        while i < len(lines):
+            ln = lines[i].strip()
+            if ln.endswith("{"):
+                name = ln[:-1].strip()
+                block = {}
+                i += 1
+                while i < len(lines) and lines[i].strip() != "}":
+                    ck, cv = lines[i].strip().split(":", 1)
+                    block[ck.strip()] = _ast.literal_eval(cv.strip())
+                    i += 1
+                setattr(self, name, block)
+            else:
+                k, v = ln.split(":", 1)
+                setattr(self, k.strip(), _ast.literal_eval(v.strip()))
+            i += 1
+        return self
+
 
 class _Fleet:
     def __init__(self):

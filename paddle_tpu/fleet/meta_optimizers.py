@@ -170,10 +170,44 @@ _META_ORDER: List[MetaOptimizerBase] = _WARN_ONLY + [
     AMPMetaOptimizer(),
 ]
 
+# conflict table (reference: each meta-optimizer's _disable_strategy
+# zeroes knobs it cannot coexist with): winner knob -> knobs it
+# disables, with the why for the warning
+_CONFLICTS = [
+    ("lamb", "lars",
+     "lamb replaces the base optimizer; lars (a Momentum wrapper) "
+     "cannot also apply"),
+    ("localsgd", "dgc",
+     "localsgd averages parameters every k steps; dgc's sparse "
+     "momentum-corrected grads assume per-step dense allreduce"),
+    ("pipeline", "recompute",
+     "the GPipe engine owns the per-stage computation; recompute "
+     "checkpoints are not segmented across pipeline cuts yet"),
+    ("pipeline", "localsgd",
+     "pipeline grads psum over the ring every step; k-step parameter "
+     "averaging would diverge the stages"),
+]
+
+
+def resolve_conflicts(strategy):
+    """StrategyCompiler._disable_strategy pass: mutate the strategy so
+    conflicting knobs are turned off LOUDLY; returns disabled names."""
+    disabled = []
+    for winner, loser, why in _CONFLICTS:
+        if getattr(strategy, winner, False) and \
+                getattr(strategy, loser, False):
+            warnings.warn("DistributedStrategy: %s disabled because %s "
+                          "is set (%s)" % (loser, winner, why))
+            setattr(strategy, loser, False)
+            disabled.append(loser)
+    return disabled
+
 
 def compose(strategy, optimizer):
-    """StrategyCompiler: fold the applicable meta-optimizers over the
-    user optimizer; returns (wrapped_optimizer, applied_names)."""
+    """StrategyCompiler: resolve knob conflicts, then fold the
+    applicable meta-optimizers over the user optimizer; returns
+    (wrapped_optimizer, applied_names)."""
+    resolve_conflicts(strategy)
     applied = []
     for meta in _META_ORDER:
         if meta.can_apply(strategy, optimizer):

@@ -38,10 +38,10 @@ class SAController(EvolutionaryController):
         self._reduce_rate = reduce_rate
         self._init_temperature = init_temperature
         self._max_iter_number = max_iter_number
-        self._reward = -1
+        self._reward = -np.inf  # -inf, not -1: rewards may be negative
         self._tokens = None
         self._constrain_func = None
-        self._max_reward = -1
+        self._max_reward = -np.inf
         self._best_tokens = None
         self._iter = 0
         self._rng = np.random.RandomState(seed)
@@ -59,6 +59,11 @@ class SAController(EvolutionaryController):
         self._constrain_func = constrain_func
         self._tokens = list(init_tokens)
         self._iter = 0
+        # a reused controller must not carry the previous search's
+        # acceptance state or best
+        self._reward = -np.inf
+        self._max_reward = -np.inf
+        self._best_tokens = None
 
     def update(self, tokens, reward):
         self._iter += 1

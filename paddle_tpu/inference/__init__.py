@@ -119,6 +119,18 @@ class Config:
     def set_cpu_math_library_num_threads(self, n: int):
         self._cpu_math_threads = n
 
+    def pass_builder(self):
+        """Analysis pass control (reference:
+        analysis_predictor.cc:498 + pass_builder.h PaddlePassBuilder).
+        TPU-native: graph fusion/layout passes belong to XLA, so the
+        builder lists the LOGICAL pipeline stages this runtime applies
+        around the compiler; deleting a pass disables the matching
+        stage where one exists (ir_optim gates XLA optimization
+        itself via switch_ir_optim)."""
+        if not hasattr(self, "_pass_builder"):
+            self._pass_builder = PassStrategy()
+        return self._pass_builder
+
     def enable_profile(self):
         pass
 
@@ -127,6 +139,52 @@ class Config:
 
 
 AnalysisConfig = Config  # legacy name (reference: paddle_analysis_config.h)
+
+
+class PassStrategy:
+    """Reference: pass_builder.h — an ordered, editable pass list.
+    Stages marked (xla) are owned by the compiler (they run iff
+    ir_optim is on — switch_ir_optim is the real toggle for them);
+    `memory_optimize_pass` is a runtime stage whose deletion actually
+    disables buffer donation for this predictor. Deleting a
+    compiler-owned or load-time pass warns that it has no individual
+    effect."""
+
+    _RUNTIME = {"memory_optimize_pass"}
+    _DEFAULT = [
+        "infer_clean_graph_pass",          # feed/fetch pruning (load)
+        "constant_folding_pass",           # (xla)
+        "common_subexpression_elimination",  # (xla)
+        "operator_fusion_pass",            # (xla)
+        "layout_assignment_pass",          # (xla)
+        "memory_optimize_pass",            # buffer donation (runtime)
+    ]
+
+    def __init__(self):
+        self._passes = list(self._DEFAULT)
+
+    def all_passes(self):
+        return list(self._passes)
+
+    def delete_pass(self, name):
+        if name in self._passes and name not in self._RUNTIME:
+            import warnings
+
+            warnings.warn(
+                "pass %r is owned by the XLA pipeline (or applied at "
+                "model load); deleting it only edits the report — use "
+                "switch_ir_optim(False) to disable compiler "
+                "optimization as a whole" % (name,))
+        self._passes = [p for p in self._passes if p != name]
+
+    def insert_pass(self, idx, name):
+        self._passes.insert(int(idx), str(name))
+
+    def append_pass(self, name):
+        self._passes.append(str(name))
+
+    def memory_optim_enabled(self):
+        return "memory_optimize_pass" in self._passes
 
 
 class Tensor:
@@ -231,9 +289,34 @@ class Predictor:
             raise RuntimeError("inputs %s not set" % missing)
         from paddle_tpu.core.scope import scope_guard
 
-        with scope_guard(self._scope):
-            outs = self._exe.run(self._program, feed=dict(self._inputs),
-                                 fetch_list=self._fetch_names)
+        import contextlib
+
+        import jax
+
+        from paddle_tpu.utils.flags import get_flags, set_flags
+
+        # switch_ir_optim(False): run unoptimized — op-by-op eager
+        # dispatch instead of one fused XLA executable (the reference's
+        # no-IR-passes NaiveExecutor path, analysis_predictor.cc:498)
+        no_opt = (jax.disable_jit() if not self._config.ir_optim()
+                  else contextlib.nullcontext())
+        # memory_optimize_pass deleted (or memory optim disabled):
+        # buffer donation off for this predictor's compilations
+        donate_off = (
+            not self._config.pass_builder().memory_optim_enabled()
+            or not getattr(self._config, "_enable_memory_optim", True))
+        flag = "FLAGS_tpu_donate_buffers"
+        prev = get_flags([flag])[flag]
+        try:
+            if donate_off:
+                set_flags({flag: False})
+            with scope_guard(self._scope), no_opt:
+                outs = self._exe.run(self._program,
+                                     feed=dict(self._inputs),
+                                     fetch_list=self._fetch_names)
+        finally:
+            if donate_off:
+                set_flags({flag: prev})
         self._outputs = dict(zip(self._fetch_names,
                                  [np.asarray(o) for o in outs]))
         if inputs is not None:
@@ -248,6 +331,26 @@ class Predictor:
 
     def try_shrink_memory(self):
         pass
+
+    def get_optimization_report(self) -> Dict:
+        """Analysis report (reference: the AnalysisConfig summary +
+        argument dump, analysis_predictor.cc:498): what the pipeline
+        will do to this program and how big it is."""
+        block = self._program.global_block()
+        op_types: Dict[str, int] = {}
+        for op in block.ops:
+            op_types[op.type] = op_types.get(op.type, 0) + 1
+        return {
+            "num_ops": len(block.ops),
+            "op_types": op_types,
+            "num_feeds": len(self._feed_names),
+            "num_fetches": len(self._fetch_names),
+            "ir_optim": self._config.ir_optim(),
+            "passes": self._config.pass_builder().all_passes(),
+            "memory_optim": getattr(self._config,
+                                    "_enable_memory_optim", False),
+            "compiler": "xla",
+        }
 
 
 def create_predictor(config: Config) -> Predictor:

@@ -56,13 +56,18 @@ class MultiHeadAttention(Layer):
 
     def gen_cache(self, key, value=None, type=None):
         """Build an incremental-decoding cache (paddle 2.0
-        MultiHeadAttention.gen_cache). type=StaticCache: project the
-        encoder output once; otherwise start an empty growing Cache."""
-        if type is MultiHeadAttention.StaticCache or value is not None:
+        MultiHeadAttention.gen_cache contract): type=StaticCache
+        projects the encoder output once (cross-attention);
+        type=Cache (default) with a value means (key, value) are
+        ALREADY-projected head-shaped k/v to seed the cache with;
+        without a value an empty growing Cache starts."""
+        if type is MultiHeadAttention.StaticCache:
             k = self._heads(self.k_proj(key))
             v = self._heads(self.v_proj(value
                                         if value is not None else key))
             return MultiHeadAttention.StaticCache(k, v)
+        if value is not None:
+            return MultiHeadAttention.Cache(key, value)
         b = key._val.shape[0]
         zeros = dy_base.to_variable(np.zeros(
             (b, self.num_heads, 0, self.head_dim), "float32"))

@@ -20,6 +20,12 @@ STEPS = 5
 BATCH = 32  # global; each trainer sees half
 
 
+def _steps(mode):
+    """half_async learns through a 1-round staleness lag: give it more
+    steps so the trajectory dominates pull-timing jitter."""
+    return 12 if mode == "half_async" else STEPS
+
+
 def _lr(mode):
     """Stale-gradient modes need a cooler step size (standard async-SGD
     practice; the sync/async tests keep the hot LR for exact parity)."""
@@ -105,7 +111,7 @@ def run_trainer(tid, eplist, n_trainers, mode):
     half = BATCH // n_trainers
     xs = x[tid * half:(tid + 1) * half]
     ys = y[tid * half:(tid + 1) * half]
-    for _ in range(STEPS):
+    for _ in range(_steps(mode)):
         out = exe.run(main, feed={"x": xs, "label": ys},
                       fetch_list=[loss], scope=scope)
         print("LOSS %.6f" % float(np.asarray(out[0]).reshape(-1)[0]),

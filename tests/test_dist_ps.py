@@ -73,7 +73,7 @@ def test_ps_2x2_localhost(mode):
 
     all_ls = [_losses(out) for out in touts]
     for ls in all_ls:
-        assert len(ls) == 5, touts
+        assert len(ls) >= 5, touts
         assert np.isfinite(ls).all()
         assert ls[-1] < ls[0], ls
     if mode == "sync":

@@ -57,3 +57,32 @@ def test_two_predictors_are_isolated(tmp_path):
     o1 = p1.run([xs])[0]
     o2 = p2.run([xs])[0]
     np.testing.assert_allclose(o1, o2, rtol=1e-6)
+
+
+def test_analysis_pass_builder_and_report(tmp_path):
+    """Analysis tier (VERDICT r2 missing #9; reference:
+    analysis_predictor.cc:498 pass pipeline + AnalysisConfig)."""
+    from paddle_tpu import inference
+
+    xs, ref, _ = _export_model(tmp_path)
+    cfg = inference.Config(str(tmp_path))
+    pb = cfg.pass_builder()
+    assert "operator_fusion_pass" in pb.all_passes()
+    pb.delete_pass("operator_fusion_pass")
+    assert "operator_fusion_pass" not in pb.all_passes()
+
+    pred = inference.create_predictor(cfg)
+    rep = pred.get_optimization_report()
+    assert rep["num_ops"] > 0 and rep["compiler"] == "xla"
+    assert rep["ir_optim"] is True
+    assert "operator_fusion_pass" not in rep["passes"]
+
+    out_opt = pred.run([xs])[0]
+
+    # ir_optim off: same numerics through op-by-op eager dispatch
+    cfg2 = inference.Config(str(tmp_path))
+    cfg2.switch_ir_optim(False)
+    pred2 = inference.create_predictor(cfg2)
+    assert pred2.get_optimization_report()["ir_optim"] is False
+    out_eager = pred2.run([xs])[0]
+    np.testing.assert_allclose(out_opt, out_eager, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,94 @@
+"""Multi-level LoD (VERDICT r2 missing #8; reference:
+framework/lod_tensor.h:52 nested offset LoD +
+python/paddle/fluid/lod_tensor.py create_lod_tensor)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def test_single_level_create_and_offsets():
+    # reference doc example: two sentences of 2 and 3 words
+    t = fluid.create_lod_tensor(np.arange(5).reshape(5, 1), [[2, 3]],
+                                fluid.CPUPlace())
+    assert t.lod() == [[0, 2, 5]]
+    assert t.recursive_sequence_lengths() == [[2, 3]]
+    assert t.has_valid_recursive_sequence_lengths()
+    assert t.shape() == [5, 1]
+
+
+def test_two_level_paragraphs_sentences_words():
+    # 2 paragraphs: first has 2 sentences (3 + 1 words), second has 1
+    # sentence (2 words) -> 6 word rows total
+    data = np.arange(12, dtype="float32").reshape(6, 2)
+    t = fluid.create_lod_tensor(data, [[2, 1], [3, 1, 2]],
+                                fluid.CPUPlace())
+    assert t.lod() == [[0, 2, 3], [0, 3, 4, 6]]
+    assert t.lod_level() == 2
+    assert t.has_valid_recursive_sequence_lengths()
+    # offsets of level 0 partition level 1's sequences; level 1's
+    # offsets partition the payload rows
+    assert t.lod()[0][-1] == len(t.lod()[1]) - 1
+    assert t.lod()[1][-1] == data.shape[0]
+
+
+def test_invalid_lod_rejected():
+    with pytest.raises(AssertionError):
+        fluid.create_lod_tensor(np.zeros((5, 1)), [[2, 2]],
+                                fluid.CPUPlace())  # sums to 4, not 5
+    t = fluid.LoDTensor(np.zeros((4, 1)), [[0, 2, 5]])
+    assert not t.has_valid_recursive_sequence_lengths()
+    t2 = fluid.LoDTensor(np.zeros((5, 1)), [[0, 3, 2]])  # decreasing
+    assert not t2.has_valid_recursive_sequence_lengths()
+
+
+def test_nested_list_data():
+    # reference: list data converted row-wise with top-level check
+    t = fluid.create_lod_tensor([[1, 2], [3, 4, 5]], [[2, 3]],
+                                fluid.CPUPlace())
+    assert t.shape()[0] == 5
+    np.testing.assert_array_equal(np.asarray(t).ravel(),
+                                  [1, 2, 3, 4, 5])
+
+
+def test_padded_bridge_roundtrip():
+    data = np.arange(12, dtype="float32").reshape(6, 2)
+    t = fluid.create_lod_tensor(data, [[2, 1], [3, 1, 2]],
+                                fluid.CPUPlace())
+    padded, lens = t.to_padded(pad_value=-1.0)
+    assert padded.shape == (3, 3, 2)  # 3 sentences, max 3 words
+    np.testing.assert_array_equal(lens, [3, 1, 2])
+    assert padded[1, 1, 0] == -1.0  # padding
+    back = fluid.LoDTensor.from_padded(padded, lens, outer_lens=[2, 1])
+    np.testing.assert_array_equal(back.numpy(), data)
+    assert back.lod() == t.lod()
+
+
+def test_padded_feeds_sequence_op():
+    """The bridge layout drives the device-side sequence ops: pool the
+    WORDS of each sentence of a 2-level LoD batch."""
+    data = np.arange(12, dtype="float32").reshape(6, 2)
+    t = fluid.create_lod_tensor(data, [[2, 1], [3, 1, 2]],
+                                fluid.CPUPlace())
+    padded, lens = t.to_padded()
+
+    x = fluid.layers.data(name="lod_x", shape=[3, 2], dtype="float32")
+    length = fluid.layers.data(name="lod_len", shape=[1], dtype="int64")
+    pooled = fluid.layers.sequence_pool(x, "sum", length=length)
+    exe = fluid.Executor(fluid.CPUPlace())
+    out = exe.run(feed={"lod_x": padded,
+                        "lod_len": lens.reshape(-1, 1)},
+                  fetch_list=[pooled])
+    want = np.stack([data[0:3].sum(0), data[3:4].sum(0),
+                     data[4:6].sum(0)])
+    np.testing.assert_allclose(np.asarray(out[0]), want, rtol=1e-6)
+
+
+def test_create_random_int_lodtensor():
+    t = fluid.create_random_int_lodtensor([[2, 3]], [1],
+                                          fluid.CPUPlace(), 0, 9,
+                                          seed=0)
+    assert t.shape() == [5, 1]
+    assert t.recursive_sequence_lengths() == [[2, 3]]
+    a = np.asarray(t)
+    assert a.min() >= 0 and a.max() <= 9
