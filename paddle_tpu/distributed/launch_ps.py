@@ -1,0 +1,104 @@
+"""Parameter-server launcher (reference:
+`python/paddle/distributed/launch_ps.py`): spawns N pserver + M trainer
+processes of the user script on this host with the reference PS env
+contract — TRAINING_ROLE, PADDLE_PSERVERS_IP_PORT_LIST,
+PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ID, POD_IP/PADDLE_PORT — which
+fleet.PaddleCloudRoleMaker(is_collective=False) reads.
+
+Usage: python -m paddle_tpu.distributed.launch_ps \
+           --server_num 2 --worker_num 2 train.py [args...]
+       (or explicit --servers host:port,host:port --workers ...)
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _parse_args(argv):
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch_ps")
+    p.add_argument("--server_num", type=int, default=None)
+    p.add_argument("--worker_num", type=int, default=None)
+    p.add_argument("--servers", type=str, default="",
+                   help="comma-separated pserver host:port list")
+    p.add_argument("--workers", type=str, default="",
+                   help="comma-separated trainer host:port list")
+    p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def launch(argv=None):
+    args = _parse_args(argv if argv is not None else sys.argv[1:])
+    servers = [e for e in args.servers.split(",") if e]
+    workers = [e for e in args.workers.split(",") if e]
+    if not servers:
+        servers = ["127.0.0.1:%d" % _free_port()
+                   for _ in range(args.server_num or 2)]
+    if not workers:
+        workers = ["127.0.0.1:%d" % _free_port()
+                   for _ in range(args.worker_num or 2)]
+
+    base = dict(os.environ)
+    base["PADDLE_PSERVERS_IP_PORT_LIST"] = ",".join(servers)
+    base["PADDLE_TRAINERS_NUM"] = str(len(workers))
+    base["PADDLE_TRAINER_ENDPOINTS"] = ",".join(workers)
+
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+
+    def out(tag):
+        if args.log_dir:
+            return open(os.path.join(args.log_dir, tag + ".log"), "w")
+        return None
+
+    procs = []
+    cmd = [sys.executable, args.training_script] \
+        + args.training_script_args
+    for i, ep in enumerate(servers):
+        env = dict(base)
+        env["TRAINING_ROLE"] = "PSERVER"
+        ip, port = ep.rsplit(":", 1)
+        env["POD_IP"] = ip
+        env["PADDLE_PORT"] = port
+        env["PADDLE_CURRENT_ENDPOINT"] = ep
+        f = out("serverlog.%d" % i)
+        procs.append((subprocess.Popen(cmd, env=env, stdout=f,
+                                       stderr=f), f))
+    for i, ep in enumerate(workers):
+        env = dict(base)
+        env["TRAINING_ROLE"] = "TRAINER"
+        env["PADDLE_TRAINER_ID"] = str(i)
+        env["PADDLE_CURRENT_ENDPOINT"] = ep
+        f = out("workerlog.%d" % i)
+        procs.append((subprocess.Popen(cmd, env=env, stdout=f,
+                                       stderr=f), f))
+
+    rc = 0
+    try:
+        # trainers finishing ends the job; pservers are then reaped
+        for p, _ in procs[len(servers):]:
+            rc = p.wait() or rc
+    finally:
+        for p, f in procs:
+            if p.poll() is None:
+                p.terminate()
+            if f:
+                f.close()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

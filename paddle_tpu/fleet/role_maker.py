@@ -71,10 +71,23 @@ class PaddleCloudRoleMaker(RoleMakerBase):
         self.generate_role()
 
     def generate_role(self):
-        self._current_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
         eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
         self._worker_endpoints = eps.split(",") if eps else []
-        self._role = Role.WORKER
+        ps_eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        self._server_endpoints = ps_eps.split(",") if ps_eps else []
+        role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+        if role == "PSERVER" and not self._is_collective:
+            # reference role_maker.py:477: a pserver identifies itself
+            # by POD_IP:PADDLE_PORT within the server list
+            self._role = Role.SERVER
+            me = "%s:%s" % (os.environ.get("POD_IP", "127.0.0.1"),
+                            os.environ.get("PADDLE_PORT", "0"))
+            self._current_id = (self._server_endpoints.index(me)
+                                if me in self._server_endpoints else 0)
+        else:
+            self._role = Role.WORKER
+            self._current_id = int(os.environ.get("PADDLE_TRAINER_ID",
+                                                  "0"))
 
     def worker_num(self):
         return int(os.environ.get(

@@ -1,0 +1,4 @@
+from .fs import (  # noqa: F401
+    FS, LocalFS, HDFSClient, ExecuteError, FSFileExistsError,
+    FSFileNotExistsError, FSTimeOut,
+)

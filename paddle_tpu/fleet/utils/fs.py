@@ -1,0 +1,207 @@
+"""Checkpoint filesystem abstraction (reference:
+`python/paddle/fluid/incubate/fleet/utils/fs.py` FS/LocalFS +
+`hdfs.py` HDFSClient). TPU-native scope: pods checkpoint to
+local/NFS/GCS-fuse paths, so LocalFS is the real implementation;
+HDFSClient keeps the reference surface by shelling out to a `hadoop`
+binary when one exists and failing loudly otherwise (this build ships
+no Hadoop)."""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from pathlib import Path
+
+__all__ = ["FS", "LocalFS", "HDFSClient", "ExecuteError",
+           "FSFileExistsError", "FSFileNotExistsError", "FSTimeOut"]
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class FSTimeOut(Exception):
+    pass
+
+
+class FS:
+    def ls_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_file(self, fs_path):
+        raise NotImplementedError
+
+    def is_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_exist(self, fs_path):
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def mkdirs(self, fs_path):
+        raise NotImplementedError
+
+    def delete(self, fs_path):
+        raise NotImplementedError
+
+    def need_upload_download(self):
+        raise NotImplementedError
+
+    def rename(self, fs_src_path, fs_dst_path):
+        raise NotImplementedError
+
+    def mv(self, fs_src_path, fs_dst_path):
+        raise NotImplementedError
+
+    def list_dirs(self, fs_path):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    def ls_dir(self, fs_path):
+        return list(os.listdir(fs_path))
+
+    def mkdirs(self, fs_path):
+        if os.path.isfile(fs_path):
+            raise FSFileExistsError("%s is already a file" % fs_path)
+        os.makedirs(fs_path, exist_ok=True)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def delete(self, fs_path):
+        if not self.is_exist(fs_path):
+            return
+        if os.path.isfile(fs_path):
+            os.remove(fs_path)
+        else:
+            shutil.rmtree(fs_path)
+
+    def need_upload_download(self):
+        return False
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def touch(self, fs_path):
+        Path(fs_path).touch()
+
+    def mv(self, src_path, dst_path):
+        if not self.is_exist(src_path):
+            raise FSFileNotExistsError(src_path)
+        if self.is_exist(dst_path):
+            raise FSFileExistsError(dst_path)
+        self.rename(src_path, dst_path)
+
+    def list_dirs(self, fs_path):
+        if not self.is_exist(fs_path):
+            return []
+        return [d for d in os.listdir(fs_path)
+                if os.path.isdir(os.path.join(fs_path, d))]
+
+    # local fs: upload/download degenerate to copies
+    def upload(self, local_path, fs_path):
+        if os.path.isdir(local_path):
+            shutil.copytree(local_path, fs_path)
+        else:
+            shutil.copy2(local_path, fs_path)
+
+    download = upload
+
+
+class HDFSClient(FS):
+    """Shells out to `hadoop fs` (reference: hdfs.py HDFSClient's
+    java-client subprocess pattern). Constructing without a hadoop
+    binary on PATH raises immediately rather than failing at first
+    use."""
+
+    def __init__(self, hadoop_home=None, configs=None,
+                 time_out=5 * 60 * 1000, sleep_inter=1000):
+        self._hadoop = (os.path.join(hadoop_home, "bin", "hadoop")
+                        if hadoop_home else shutil.which("hadoop"))
+        if not self._hadoop or not os.path.exists(self._hadoop):
+            raise ExecuteError(
+                "no `hadoop` binary found (this build ships no Hadoop); "
+                "checkpoint to a local/NFS/GCS-fuse path with LocalFS "
+                "instead")
+        self._configs = configs or {}
+        self._timeout = time_out / 1000.0
+
+    def _run(self, *args):
+        cmd = [self._hadoop, "fs"]
+        for k, v in self._configs.items():
+            cmd += ["-D", "%s=%s" % (k, v)]
+        cmd += list(args)
+        p = subprocess.run(cmd, stdout=subprocess.PIPE,
+                           stderr=subprocess.STDOUT, text=True,
+                           timeout=self._timeout)
+        if p.returncode != 0:
+            raise ExecuteError("%r failed: %s" % (args, p.stdout[-500:]))
+        return p.stdout
+
+    def ls_dir(self, fs_path):
+        out = self._run("-ls", fs_path)
+        return [ln.split()[-1].rsplit("/", 1)[-1]
+                for ln in out.splitlines() if ln.startswith(("-", "d"))]
+
+    def list_dirs(self, fs_path):
+        out = self._run("-ls", fs_path)
+        return [ln.split()[-1].rsplit("/", 1)[-1]
+                for ln in out.splitlines() if ln.startswith("d")]
+
+    def is_exist(self, fs_path):
+        try:
+            self._run("-test", "-e", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_dir(self, fs_path):
+        try:
+            self._run("-test", "-d", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_file(self, fs_path):
+        return self.is_exist(fs_path) and not self.is_dir(fs_path)
+
+    def mkdirs(self, fs_path):
+        self._run("-mkdir", "-p", fs_path)
+
+    def delete(self, fs_path):
+        if self.is_exist(fs_path):
+            self._run("-rm", "-r", fs_path)
+
+    def mv(self, fs_src_path, fs_dst_path):
+        self._run("-mv", fs_src_path, fs_dst_path)
+
+    rename = mv
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path)
+
+    def need_upload_download(self):
+        return True

@@ -18,7 +18,7 @@ from __future__ import annotations
 from typing import Callable, List, Optional, Sequence
 
 from .. import framework
-from ..framework import Variable
+from ..framework import Variable, unique_name
 from ..layer_helper import LayerHelper
 from . import tensor as tensor_layers
 
@@ -312,3 +312,204 @@ def Assert(cond, data=None, summarize=20, name=None):
     helper.append_op(type="assert", inputs=inputs, outputs={},
                      attrs={"summarize": summarize,
                             "message": name or ""})
+
+
+class StaticRNN:
+    """Static-length RNN builder (reference: layers/control_flow.py
+    StaticRNN + operators/recurrent_op.cc). The user writes the step
+    body ONCE inside `with rnn.step():` over time-major [T, B, ...]
+    sequence inputs; the reference executes it via recurrent_op's
+    sub-block loop. TPU-native: the step body is captured as an op
+    template and UNROLLED at build time by cloning it per timestep with
+    name substitution — T is static here by definition (the reference
+    requires it too), unrolling gives XLA the whole computation to
+    fuse/pipeline, and the backward falls out of the ordinary
+    jax.vjp over the flattened program (no recurrent_grad op needed).
+    For data-dependent lengths use layers.while_loop / layers.rnn."""
+
+    BEFORE_RNN_BLOCK = 0
+    IN_RNN_BLOCK = 1
+    AFTER_RNN_BLOCK = 2
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self.status = StaticRNN.BEFORE_RNN_BLOCK
+        self.seq_len = None
+        self._step_inputs = []   # (seq var, t0 var)
+        self._mems = []          # {"pre": var, "update": name|None}
+        self._step_outputs = []  # t0 output vars
+        self._results = None
+        self._start_idx = None
+        # ops that SEED iteration 0 (t0 slices, memory init fills):
+        # they must not be re-cloned per timestep — a clone would remap
+        # their output names over the prev-iteration substitutions
+        self._seed_op_ids = set()
+
+    # -- step context ------------------------------------------------------
+    def step(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            self.status = StaticRNN.IN_RNN_BLOCK
+            self._start_idx = len(self.helper.main_block.ops)
+            try:
+                yield
+            finally:
+                self.status = StaticRNN.AFTER_RNN_BLOCK
+                self._complete()
+
+        return ctx()
+
+    def _assert_in_step(self, what):
+        if self.status != StaticRNN.IN_RNN_BLOCK:
+            raise ValueError("%s can only be invoked inside rnn.step()"
+                             % what)
+
+    def _slice_time(self, seq, t):
+        """seq [T, B, ...] -> [B, ...] at time t."""
+        block = self.helper.main_block
+        sl = block.create_var(
+            name=unique_name("srnn_slice"),
+            shape=(1,) + tuple(seq.shape[1:]), dtype=seq.dtype)
+        block.append_op(type="slice", inputs={"Input": [seq]},
+                        outputs={"Out": [sl]},
+                        attrs={"axes": [0], "starts": [t],
+                               "ends": [t + 1]})
+        out = block.create_var(name=unique_name("srnn_x"),
+                               shape=tuple(seq.shape[1:]),
+                               dtype=seq.dtype)
+        block.append_op(type="reshape2", inputs={"X": [sl]},
+                        outputs={"Out": [out], "XShape": [block.create_var(
+                            name=unique_name("srnn_xs"), shape=(),
+                            dtype=seq.dtype)]},
+                        attrs={"shape": [int(d) for d in seq.shape[1:]]})
+        return out
+
+    def step_input(self, x):
+        """Mark x [seq_len, batch, ...] as a sequence input; returns the
+        per-step [batch, ...] slice."""
+        self._assert_in_step("step_input")
+        if self.seq_len is None:
+            self.seq_len = int(x.shape[0])
+        elif self.seq_len != int(x.shape[0]):
+            raise ValueError("Static RNN only takes fixed seq_len: %d vs "
+                             "%d" % (self.seq_len, int(x.shape[0])))
+        n_before = len(self.helper.main_block.ops)
+        t0 = self._slice_time(x, 0)
+        for op in self.helper.main_block.ops[n_before:]:
+            self._seed_op_ids.add(id(op))
+        self._step_inputs.append((x, t0))
+        return t0
+
+    def memory(self, init=None, shape=None, batch_ref=None,
+               init_value=0.0, init_batch_dim_idx=0, ref_batch_dim_idx=1):
+        """Loop-carried state: init var, or zeros shaped like `shape`
+        with the batch dim taken from batch_ref (reference:
+        StaticRNN.memory)."""
+        self._assert_in_step("memory")
+        if init is None:
+            if shape is None or batch_ref is None:
+                raise ValueError(
+                    "memory needs an init var OR shape + batch_ref")
+            from . import tensor as t_layers
+
+            n_before = len(self.helper.main_block.ops)
+            feat = [int(d) for d in shape if int(d) != -1]
+            init = t_layers.fill_constant_batch_size_like(
+                batch_ref, shape=[-1] + feat, dtype=batch_ref.dtype,
+                value=init_value, input_dim_idx=0, output_dim_idx=0)
+            for op in self.helper.main_block.ops[n_before:]:
+                self._seed_op_ids.add(id(op))
+        self._mems.append({"pre": init, "update": None})
+        return init
+
+    def update_memory(self, mem, x):
+        self._assert_in_step("update_memory")
+        for m in self._mems:
+            if m["pre"].name == mem.name:
+                m["update"] = x.name
+                return
+        raise ValueError("update_memory: %r is not a memory of this RNN"
+                         % mem.name)
+
+    def step_output(self, o):
+        self._assert_in_step("step_output")
+        self._step_outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    # -- unrolling ---------------------------------------------------------
+    def _complete(self):
+        if self.seq_len is None:
+            raise ValueError("StaticRNN needs at least one step_input")
+        for m in self._mems:
+            if m["update"] is None:
+                raise ValueError("memory %r has no update_memory"
+                                 % m["pre"].name)
+        block = self.helper.main_block
+        template = [op for op in block.ops[self._start_idx:]
+                    if id(op) not in self._seed_op_ids]
+        prev = {m["pre"].name: m["update"] for m in self._mems}
+        outs_per_t = {o.name: [o.name] for o in self._step_outputs}
+
+        for t in range(1, self.seq_len):
+            mapping = {}
+            for seq, t0 in self._step_inputs:
+                mapping[t0.name] = self._slice_time(seq, t).name
+            for m in self._mems:
+                mapping[m["pre"].name] = prev[m["pre"].name]
+            for op in template:
+                if any(k in op.attrs for k in ("sub_block", "blocks")):
+                    raise NotImplementedError(
+                        "StaticRNN step body must not contain nested "
+                        "control-flow blocks")
+                ins = {}
+                for slot, names in op.input_names.items():
+                    ins[slot] = [mapping.get(n, n) for n in names]
+                outs = {}
+                for slot, names in op.output_names.items():
+                    mapped = []
+                    for n in names:
+                        v = block._find_var_recursive(n)
+                        if v is not None and v.persistable:
+                            mapped.append(n)  # params update in place
+                            continue
+                        fresh = unique_name("%s_t%d" % (n, t))
+                        nv = block.create_var(
+                            name=fresh,
+                            shape=v.shape if v is not None else (),
+                            dtype=v.dtype if v is not None
+                            else "float32")
+                        mapping[n] = fresh
+                        mapped.append(fresh)
+                    outs[slot] = mapped
+                block.append_op(type=op.type, inputs=ins, outputs=outs,
+                                attrs=dict(op.attrs))
+            for m in self._mems:
+                prev[m["pre"].name] = mapping.get(m["update"],
+                                                  m["update"])
+            for o in self._step_outputs:
+                outs_per_t[o.name].append(mapping.get(o.name, o.name))
+
+        # stack each step output over time: [T, B, ...]
+        results = []
+        for o in self._step_outputs:
+            out = block.create_var(
+                name=unique_name("srnn_out"),
+                shape=(self.seq_len,) + tuple(o.shape), dtype=o.dtype)
+            block.append_op(type="stack",
+                            inputs={"X": outs_per_t[o.name]},
+                            outputs={"Y": [out]}, attrs={"axis": 0})
+            results.append(out)
+        self._results = results
+
+    def __call__(self, *args, **kwargs):
+        if self.status != StaticRNN.AFTER_RNN_BLOCK:
+            raise ValueError("rnn() is only valid after the step block")
+        if not self._results:
+            raise ValueError("StaticRNN produced no step_output")
+        return (self._results[0] if len(self._results) == 1
+                else self._results)

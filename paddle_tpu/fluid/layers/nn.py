@@ -50,11 +50,17 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
     helper = LayerHelper("fc", input=input, param_attr=param_attr,
                          bias_attr=bias_attr, act=act, name=name)
     inputs = input if isinstance(input, (list, tuple)) else [input]
+    # one weight PER input; a named param_attr names only the first and
+    # the copies auto-name (reference: LayerHelper.multiple_param_attr —
+    # reusing the name would silently alias every input's weight)
+    param_attrs = helper.multiple_param_attr(len(inputs))
+    if not isinstance(param_attrs, (list, tuple)):
+        param_attrs = [param_attrs] * len(inputs)
     mul_results = []
-    for inp in inputs:
+    for inp, w_attr in zip(inputs, param_attrs):
         in_dim = int(np.prod(inp.shape[num_flatten_dims:]))
         w = helper.create_parameter(
-            helper.param_attr, shape=[in_dim, size], dtype=inp.dtype)
+            w_attr, shape=[in_dim, size], dtype=inp.dtype)
         out = _single("mul", {"X": [inp], "Y": [w]},
                       {"x_num_col_dims": num_flatten_dims,
                        "y_num_col_dims": 1}, dtype=inp.dtype, helper=helper)

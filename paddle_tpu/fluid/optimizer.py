@@ -764,8 +764,53 @@ class DGCMomentumOptimizer(MomentumOptimizer):
         return super()._finish_update(block, params_grads)
 
 
-class ModelAverage(Optimizer):
-    """Running average of params (reference: optimizer.py:3075)."""
+class _ParamSwapMixin:
+    """Shared apply()/restore() machinery: swap live parameter values in
+    the scope with computed replacements, host-side (the swap happens
+    between steps, so no jit interaction)."""
+
+    def _swap_in(self, replacements):
+        from ..core.scope import global_scope
+
+        scope = global_scope()
+        self._saved = {}
+        for name, new in replacements.items():
+            cur = scope.find_var(name)
+            if cur is None:
+                continue
+            self._saved[name] = cur
+            scope.set_var(name, np.asarray(new).astype(
+                np.asarray(cur).dtype))
+
+    def restore(self, executor=None):
+        from ..core.scope import global_scope
+
+        scope = global_scope()
+        for name, old in getattr(self, "_saved", {}).items():
+            scope.set_var(name, old)
+        self._saved = {}
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            self._swap_in(self._replacements())
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore(executor)
+
+        return ctx()
+
+
+class ModelAverage(Optimizer, _ParamSwapMixin):
+    """Windowed running average of params (reference: optimizer.py:3075).
+    Construct AFTER minimize(): accumulation ops (sum_1/2/3 rotation +
+    counters, vectorized with a masked rotate instead of the reference's
+    conditional blocks — XLA-friendly) are appended to the current main
+    program; apply() swaps params to the windowed average."""
 
     def __init__(self, average_window_rate, min_average_window=10000,
                  max_average_window=10000, **kwargs):
@@ -773,36 +818,148 @@ class ModelAverage(Optimizer):
         self.average_window = average_window_rate
         self.min_average_window = min_average_window
         self.max_average_window = max_average_window
+        self._accum = {}  # pname -> dict of accumulator var names
+        program = framework.default_main_program()
+        block = program.global_block()
+        helper = LayerHelper("model_average")
+        params = [p for p in block.all_parameters() if p.trainable]
+        for p in params:
+            acc = {}
+            for nm, init in (("sum_1", 0.0), ("sum_2", 0.0),
+                             ("sum_3", 0.0)):
+                v = helper.create_global_variable(
+                    name=unique_name("%s_%s" % (p.name, nm)),
+                    shape=list(p.shape), dtype=p.dtype, persistable=True)
+                helper.set_variable_initializer(
+                    v, ConstantInitializer(init))
+                acc[nm] = v
+            for nm in ("num_accumulates", "old_num_accumulates"):
+                v = helper.create_global_variable(
+                    name=unique_name("%s_%s" % (p.name, nm)),
+                    shape=[1], dtype="float32", persistable=True)
+                helper.set_variable_initializer(
+                    v, ConstantInitializer(0.0))
+                acc[nm] = v
+            self._accum[p.name] = {k: v.name for k, v in acc.items()}
+            self._append_accumulate(block, p, acc)
+
+    def _append_accumulate(self, block, p, acc):
+        def op(type_, ins, outs, attrs=None):
+            block.append_op(type=type_, inputs=ins, outputs=outs,
+                            attrs=attrs or {})
+
+        s1, s2, s3 = acc["sum_1"], acc["sum_2"], acc["sum_3"]
+        num, old = acc["num_accumulates"], acc["old_num_accumulates"]
+        # sum_1 += p ; num += 1
+        op("elementwise_add", {"X": [s1], "Y": [p]}, {"Out": [s1]},
+           {"axis": -1})
+        one = block.create_var(name=unique_name("ma_one"), shape=[1],
+                               dtype="float32")
+        op("fill_constant", {}, {"Out": [one]},
+           {"shape": [1], "dtype": "float32", "value": 1.0})
+        op("elementwise_add", {"X": [num], "Y": [one]}, {"Out": [num]},
+           {"axis": -1})
+        # masked rotate when num >= max_window:
+        #   sum_3 <- sum_2 ; sum_2 <- sum_1 ; sum_1 <- 0
+        #   old_num <- old_num + num ; num <- 0
+        thresh = block.create_var(name=unique_name("ma_thr"), shape=[1],
+                                  dtype="float32")
+        op("fill_constant", {}, {"Out": [thresh]},
+           {"shape": [1], "dtype": "float32",
+            "value": float(self.max_average_window)})
+        flag_b = block.create_var(name=unique_name("ma_flagb"),
+                                  shape=[1], dtype="bool")
+        op("greater_equal", {"X": [num], "Y": [thresh]},
+           {"Out": [flag_b]})
+        flag = block.create_var(name=unique_name("ma_flag"), shape=[1],
+                                dtype="float32")
+        op("cast", {"X": [flag_b]}, {"Out": [flag]},
+           {"in_dtype": "bool", "out_dtype": "float32"})
+        keep = block.create_var(name=unique_name("ma_keep"), shape=[1],
+                                dtype="float32")
+        op("scale", {"X": [flag]}, {"Out": [keep]},
+           {"scale": -1.0, "bias": 1.0, "bias_after_scale": True})
+
+        def blend(dst, a, b):
+            # dst = flag*a + keep*b  (elementwise, broadcasting [1])
+            ta = block.create_var(name=unique_name("ma_t"),
+                                  shape=p.shape, dtype=p.dtype)
+            tb = block.create_var(name=unique_name("ma_t"),
+                                  shape=p.shape, dtype=p.dtype)
+            op("elementwise_mul", {"X": [a], "Y": [flag]},
+               {"Out": [ta]}, {"axis": -1})
+            op("elementwise_mul", {"X": [b], "Y": [keep]},
+               {"Out": [tb]}, {"axis": -1})
+            op("elementwise_add", {"X": [ta], "Y": [tb]},
+               {"Out": [dst]}, {"axis": -1})
+
+        blend(s3, s2, s3)
+        blend(s2, s1, s2)
+        # sum_1 <- keep * sum_1
+        op("elementwise_mul", {"X": [s1], "Y": [keep]}, {"Out": [s1]},
+           {"axis": -1})
+        # old_num <- old_num + flag*num ; num <- keep*num
+        t = block.create_var(name=unique_name("ma_t"), shape=[1],
+                             dtype="float32")
+        op("elementwise_mul", {"X": [num], "Y": [flag]}, {"Out": [t]},
+           {"axis": -1})
+        op("elementwise_add", {"X": [old], "Y": [t]}, {"Out": [old]},
+           {"axis": -1})
+        op("elementwise_mul", {"X": [num], "Y": [keep]}, {"Out": [num]},
+           {"axis": -1})
 
     def minimize(self, *a, **k):
         raise NotImplementedError(
             "ModelAverage wraps an inner optimizer; use apply()")
 
-    def apply(self, executor=None, need_restore=True):
-        import contextlib
+    def _replacements(self):
+        from ..core.scope import global_scope
 
-        @contextlib.contextmanager
-        def ctx():
-            yield
+        scope = global_scope()
+        out = {}
+        for pname, acc in self._accum.items():
+            s = sum(np.asarray(scope.find_var(acc[k]))
+                    for k in ("sum_1", "sum_2", "sum_3"))
+            n = (float(np.asarray(scope.find_var(
+                acc["num_accumulates"])).ravel()[0])
+                + float(np.asarray(scope.find_var(
+                    acc["old_num_accumulates"])).ravel()[0]))
+            if n > 0:
+                out[pname] = s / n
+        return out
 
-        return ctx()
 
-    def restore(self, executor=None):
-        pass
-
-
-class ExponentialMovingAverage:
-    """EMA of params (reference: optimizer.py:3384)."""
+class ExponentialMovingAverage(_ParamSwapMixin):
+    """EMA of params (reference: optimizer.py:3384): update() appends
+    shadow-update ops; apply() swaps params to the bias-corrected EMAs
+    (EMA_t / (1 - decay^t)); restore() puts the originals back."""
 
     def __init__(self, decay=0.999, thres_steps=None, name=None):
         self._decay = decay
         self._name = name or "ema"
         self._shadow = {}
+        self._step_var = None
 
     def update(self):
         program = framework.default_main_program()
         block = program.global_block()
         helper = LayerHelper(self._name)
+        if self._step_var is None:
+            step = helper.create_global_variable(
+                name=unique_name(self._name + "_step"), shape=[1],
+                dtype="float32", persistable=True)
+            helper.set_variable_initializer(step,
+                                            ConstantInitializer(0.0))
+            self._step_var = step
+            one = block.create_var(name=unique_name("ema_one"),
+                                   shape=[1], dtype="float32")
+            block.append_op(type="fill_constant", inputs={},
+                            outputs={"Out": [one]},
+                            attrs={"shape": [1], "dtype": "float32",
+                                   "value": 1.0})
+            block.append_op(type="elementwise_add",
+                            inputs={"X": [step], "Y": [one]},
+                            outputs={"Out": [step]}, attrs={"axis": -1})
         for p in block.all_parameters():
             if not p.trainable:
                 continue
@@ -830,17 +987,23 @@ class ExponentialMovingAverage:
                             inputs={"X": [shadow], "Y": [tmp]},
                             outputs={"Out": [shadow]}, attrs={"axis": -1})
 
-    def apply(self, executor=None, need_restore=True):
-        import contextlib
+    def _replacements(self):
+        from ..core.scope import global_scope
 
-        @contextlib.contextmanager
-        def ctx():
-            yield
-
-        return ctx()
-
-    def restore(self, executor=None):
-        pass
+        scope = global_scope()
+        t = 0.0
+        if self._step_var is not None:
+            v = scope.find_var(self._step_var.name)
+            if v is not None:
+                t = float(np.asarray(v).ravel()[0])
+        # bias correction: EMA_t / (1 - decay^t) (reference docstring)
+        corr = 1.0 - self._decay ** t if t > 0 else 1.0
+        out = {}
+        for pname, shadow in self._shadow.items():
+            sv = scope.find_var(shadow.name)
+            if sv is not None:
+                out[pname] = np.asarray(sv) / max(corr, 1e-12)
+        return out
 
 
 class RecomputeOptimizer(Optimizer):

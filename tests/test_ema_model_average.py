@@ -1,0 +1,105 @@
+"""ExponentialMovingAverage + ModelAverage: real accumulate/apply/
+restore (reference: optimizer.py:3384 / :3075; the round-2 apply() was
+a no-op stub)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework
+
+
+def _build(rng, steps=5, after_minimize=None):
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1,
+                           param_attr=fluid.ParamAttr(name="w"))
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    extra = after_minimize() if after_minimize else None
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xs = rng.rand(16, 4).astype("float32")
+    ys = rng.rand(16, 1).astype("float32")
+    w_hist = []
+    from paddle_tpu.core.scope import global_scope
+
+    for _ in range(steps):
+        exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+        w_hist.append(np.asarray(global_scope().find_var("w")).copy())
+    return exe, extra, w_hist
+
+
+def test_ema_tracks_and_applies(rng):
+    from paddle_tpu.core.scope import global_scope
+
+    decay = 0.5
+    holder = {}
+
+    def mk():
+        ema = fluid.optimizer.ExponentialMovingAverage(decay)
+        ema.update()
+        holder["ema"] = ema
+        return ema
+
+    exe, ema, w_hist = _build(rng, steps=4, after_minimize=mk)
+
+    # expected shadow: ema_t = d*ema_{t-1} + (1-d)*w_t, bias-corrected
+    shadow = np.zeros_like(w_hist[0])
+    for w in w_hist:
+        shadow = decay * shadow + (1 - decay) * w
+    corrected = shadow / (1 - decay ** len(w_hist))
+
+    w_live = np.asarray(global_scope().find_var("w")).copy()
+    with ema.apply(exe):
+        w_applied = np.asarray(global_scope().find_var("w")).copy()
+        np.testing.assert_allclose(w_applied, corrected, rtol=1e-5,
+                                   atol=1e-6)
+    # restored after the context
+    np.testing.assert_allclose(
+        np.asarray(global_scope().find_var("w")), w_live, rtol=1e-7)
+
+
+def test_model_average_window(rng):
+    from paddle_tpu.core.scope import global_scope
+
+    holder = {}
+
+    def mk():
+        ma = fluid.optimizer.ModelAverage(
+            0.15, min_average_window=2, max_average_window=100)
+        holder["ma"] = ma
+        return ma
+
+    exe, ma, w_hist = _build(rng, steps=5, after_minimize=mk)
+    want = np.mean(w_hist, axis=0)  # window never filled: plain mean
+
+    w_live = np.asarray(global_scope().find_var("w")).copy()
+    with ma.apply(exe):
+        got = np.asarray(global_scope().find_var("w"))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(global_scope().find_var("w")), w_live, rtol=1e-7)
+
+
+def test_model_average_rotation(rng):
+    """max_average_window reached: sums rotate, average stays over the
+    recent window (reference sum_1/2/3 rotation)."""
+    from paddle_tpu.core.scope import global_scope
+
+    holder = {}
+
+    def mk():
+        ma = fluid.optimizer.ModelAverage(
+            0.15, min_average_window=1, max_average_window=3)
+        holder["ma"] = ma
+        return ma
+
+    exe, ma, w_hist = _build(rng, steps=7, after_minimize=mk)
+    with ma.apply(exe, need_restore=True):
+        got = np.asarray(global_scope().find_var("w"))
+    # rotation keeps between max_window and 3*max_window params in the
+    # sums; the exact set follows the rotation schedule — check that
+    # the average is over RECENT params only (closer to the tail mean
+    # than to the full-history mean) and finite
+    tail = np.mean(w_hist[-6:], axis=0)
+    assert np.isfinite(got).all()
+    np.testing.assert_allclose(got, tail, rtol=0.2, atol=0.05)

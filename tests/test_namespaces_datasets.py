@@ -246,3 +246,72 @@ def test_image_transforms():
     out = img_mod.simple_transform(im, 24, 16, is_train=True,
                                    mean=[1.0, 2.0, 3.0])
     assert out.shape == (3, 16, 16) and out.dtype == np.float32
+
+
+def test_fleet_utils_fs():
+    """LocalFS surface (reference: incubate/fleet/utils/fs.py) +
+    HDFSClient's loud no-hadoop failure."""
+    import tempfile
+
+    import pytest as _pytest
+
+    from paddle_tpu.fleet.utils import (LocalFS, HDFSClient,
+                                        ExecuteError,
+                                        FSFileExistsError)
+
+    fs = LocalFS()
+    d = tempfile.mkdtemp()
+    fs.mkdirs(d + "/a/b")
+    assert fs.is_dir(d + "/a") and not fs.need_upload_download()
+    fs.touch(d + "/a/x.txt")
+    assert fs.is_file(d + "/a/x.txt")
+    assert fs.list_dirs(d) == ["a"]
+    assert sorted(fs.ls_dir(d + "/a")) == ["b", "x.txt"]
+    fs.mv(d + "/a/x.txt", d + "/a/y.txt")
+    with _pytest.raises(FSFileExistsError):
+        fs.mv(d + "/a/y.txt", d + "/a/b")
+    fs.delete(d + "/a")
+    assert not fs.is_exist(d + "/a")
+
+    import shutil as _sh
+
+    if _sh.which("hadoop") is None:
+        with _pytest.raises(ExecuteError, match="hadoop"):
+            HDFSClient()
+
+
+def test_launch_ps_env_contract(tmp_path):
+    """launch_ps spawns pserver+trainer procs with the reference PS env
+    (reference: distributed/launch_ps.py), readable by
+    PaddleCloudRoleMaker(is_collective=False)."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "probe.py"
+    script.write_text(
+        "import os, sys\n"
+        "sys.path.insert(0, %r)\n"
+        "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+        "from paddle_tpu.fleet.role_maker import PaddleCloudRoleMaker\n"
+        "rm = PaddleCloudRoleMaker(is_collective=False)\n"
+        "print('ROLE', 'S' if rm.is_server() else 'W',\n"
+        "      rm.server_index() if rm.is_server() else rm.worker_index(),\n"
+        "      rm.server_num(), rm.worker_num())\n"
+        % repo)
+    logs = tmp_path / "logs"
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch_ps",
+         "--server_num", "2", "--worker_num", "2",
+         "--log_dir", str(logs), str(script)],
+        cwd=repo, env={**os.environ,
+                                        "JAX_PLATFORMS": "cpu"},
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        timeout=240)
+    assert proc.returncode == 0, proc.stdout
+    roles = []
+    for f in sorted(logs.iterdir()):
+        roles.append(f.read_text().strip())
+    assert sorted(roles) == ["ROLE S 0 2 2", "ROLE S 1 2 2",
+                             "ROLE W 0 2 2", "ROLE W 1 2 2"], roles
