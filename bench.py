@@ -80,6 +80,20 @@ def _child_env(platform: str) -> dict:
     return env
 
 
+def _parse_tagged(out):
+    """Last well-formed tagged result line in `out` (str or bytes)."""
+    if isinstance(out, bytes):
+        out = out.decode("utf-8", "replace")
+    result = None
+    for line in (out or "").splitlines():
+        if line.startswith(_RESULT_TAG):
+            try:
+                result = json.loads(line[len(_RESULT_TAG):])
+            except ValueError:
+                pass
+    return result
+
+
 def _run_attempt(platform, budget, batch, steps, warmup, idx, errors):
     """Run one bench child; return its parsed result dict or None."""
     try:
@@ -90,19 +104,23 @@ def _run_attempt(platform, budget, batch, steps, warmup, idx, errors):
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, timeout=budget)
         out = proc.stdout or ""
-        result = None
-        for line in out.splitlines():
-            if line.startswith(_RESULT_TAG):
-                result = json.loads(line[len(_RESULT_TAG):])
+        result = _parse_tagged(out)
         if proc.returncode == 0 and result is not None:
             return result
         errors.append("%s attempt %d rc=%d: %s"
                       % (platform, idx, proc.returncode,
                          out.strip().splitlines()[-1][-200:]
                          if out.strip() else "no output"))
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        # the child emits the BERT result line BEFORE the optional
+        # ResNet pass; if the parent kill lands during ResNet, the
+        # partial stdout still carries a complete tagged result
         errors.append("%s attempt %d: timeout after %ds"
                       % (platform, idx, budget))
+        result = _parse_tagged(e.output)
+        if result is not None:
+            errors[-1] += " (salvaged tagged result from partial stdout)"
+            return result
     except Exception as e:  # noqa: BLE001 - must always emit JSON
         errors.append("%s attempt %d: %r" % (platform, idx, e))
     return None

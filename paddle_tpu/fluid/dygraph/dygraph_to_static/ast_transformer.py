@@ -173,20 +173,26 @@ class FlowNormalizer(ast.NodeTransformer):
             if not (body_ret or else_ret):
                 continue
             rest = out[i + 1:]
+            # build the folded branch FIRST and only commit it to the
+            # node once the fold is certain: mutating s.orelse/s.body
+            # before a `break` would leave `rest` both inside the branch
+            # and in the returned tail — executing it twice (ADVICE r3)
             if body_ret and not else_ret:
-                s.orelse = (s.orelse or []) + rest
-                if not _ends_with_return(s.orelse):
+                folded = (s.orelse or []) + rest
+                if not _ends_with_return(folded):
                     if not at_function_tail:
                         break  # can't prove the tail returns; leave it
-                    s.orelse.append(
-                        ast.Return(value=ast.Constant(value=None)))
+                    folded = folded + [
+                        ast.Return(value=ast.Constant(value=None))]
+                s.orelse = folded
             elif else_ret and not body_ret:
-                s.body = s.body + rest
-                if not _ends_with_return(s.body):
+                folded = s.body + rest
+                if not _ends_with_return(folded):
                     if not at_function_tail:
                         break
-                    s.body.append(
-                        ast.Return(value=ast.Constant(value=None)))
+                    folded = folded + [
+                        ast.Return(value=ast.Constant(value=None))]
+                s.body = folded
             elif rest:
                 break  # both branches return: rest is dead; leave as-is
             s.body = self._fold_returns(s.body, at_function_tail)

@@ -859,9 +859,14 @@ class ModelAverage(Optimizer, _ParamSwapMixin):
            {"shape": [1], "dtype": "float32", "value": 1.0})
         op("elementwise_add", {"X": [num], "Y": [one]}, {"Out": [num]},
            {"axis": -1})
-        # masked rotate when num >= max_window:
-        #   sum_3 <- sum_2 ; sum_2 <- sum_1 ; sum_1 <- 0
-        #   old_num <- old_num + num ; num <- 0
+        # masked rotate when num >= max_window (reference
+        # average_accumulates_op.h:103-106):
+        #   sum_3 <- sum_1 + sum_2 ; sum_1 <- 0 ; sum_2 <- 0
+        #   old_num <- num (REPLACED, not accumulated) ; num <- 0
+        # old_num must be replaced: it counts only the windows whose
+        # sums are retained in sum_3; accumulating it would make the
+        # apply() denominator count discarded windows, decaying the
+        # averaged weights toward zero past 3 rotations.
         thresh = block.create_var(name=unique_name("ma_thr"), shape=[1],
                                   dtype="float32")
         op("fill_constant", {}, {"Out": [thresh]},
@@ -893,17 +898,26 @@ class ModelAverage(Optimizer, _ParamSwapMixin):
             op("elementwise_add", {"X": [ta], "Y": [tb]},
                {"Out": [dst]}, {"axis": -1})
 
-        blend(s3, s2, s3)
-        blend(s2, s1, s2)
-        # sum_1 <- keep * sum_1
+        s12 = block.create_var(name=unique_name("ma_s12"),
+                               shape=p.shape, dtype=p.dtype)
+        op("elementwise_add", {"X": [s1], "Y": [s2]}, {"Out": [s12]},
+           {"axis": -1})
+        blend(s3, s12, s3)
+        # sum_1 <- keep * sum_1 ; sum_2 <- keep * sum_2
         op("elementwise_mul", {"X": [s1], "Y": [keep]}, {"Out": [s1]},
            {"axis": -1})
-        # old_num <- old_num + flag*num ; num <- keep*num
-        t = block.create_var(name=unique_name("ma_t"), shape=[1],
-                             dtype="float32")
-        op("elementwise_mul", {"X": [num], "Y": [flag]}, {"Out": [t]},
+        op("elementwise_mul", {"X": [s2], "Y": [keep]}, {"Out": [s2]},
            {"axis": -1})
-        op("elementwise_add", {"X": [old], "Y": [t]}, {"Out": [old]},
+        # old_num <- flag*num + keep*old_num ; num <- keep*num
+        tn = block.create_var(name=unique_name("ma_t"), shape=[1],
+                              dtype="float32")
+        to = block.create_var(name=unique_name("ma_t"), shape=[1],
+                              dtype="float32")
+        op("elementwise_mul", {"X": [num], "Y": [flag]}, {"Out": [tn]},
+           {"axis": -1})
+        op("elementwise_mul", {"X": [old], "Y": [keep]}, {"Out": [to]},
+           {"axis": -1})
+        op("elementwise_add", {"X": [tn], "Y": [to]}, {"Out": [old]},
            {"axis": -1})
         op("elementwise_mul", {"X": [num], "Y": [keep]}, {"Out": [num]},
            {"axis": -1})

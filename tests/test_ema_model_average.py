@@ -80,6 +80,26 @@ def test_model_average_window(rng):
         np.asarray(global_scope().find_var("w")), w_live, rtol=1e-7)
 
 
+def _reference_model_average(w_hist, max_window):
+    """Exact reference rotation semantics
+    (average_accumulates_op.h:84-107): on window overflow
+    sum_3 <- sum_1+sum_2, sum_1=sum_2=0, old_num <- num (REPLACED)."""
+    s1 = np.zeros_like(w_hist[0])
+    s2 = np.zeros_like(w_hist[0])
+    s3 = np.zeros_like(w_hist[0])
+    num = old = 0
+    for w in w_hist:
+        s1 = s1 + w
+        num += 1
+        if num >= max_window:
+            s3 = s1 + s2
+            s1 = np.zeros_like(s1)
+            s2 = np.zeros_like(s2)
+            old = num
+            num = 0
+    return (s1 + s2 + s3) / (num + old)
+
+
 def test_model_average_rotation(rng):
     """max_average_window reached: sums rotate, average stays over the
     recent window (reference sum_1/2/3 rotation)."""
@@ -96,10 +116,31 @@ def test_model_average_rotation(rng):
     exe, ma, w_hist = _build(rng, steps=7, after_minimize=mk)
     with ma.apply(exe, need_restore=True):
         got = np.asarray(global_scope().find_var("w"))
-    # rotation keeps between max_window and 3*max_window params in the
-    # sums; the exact set follows the rotation schedule — check that
-    # the average is over RECENT params only (closer to the tail mean
-    # than to the full-history mean) and finite
-    tail = np.mean(w_hist[-6:], axis=0)
     assert np.isfinite(got).all()
-    np.testing.assert_allclose(got, tail, rtol=0.2, atol=0.05)
+    np.testing.assert_allclose(
+        got, _reference_model_average(w_hist, 3), rtol=1e-5, atol=1e-6)
+
+
+def test_model_average_many_rotations_exact(rng):
+    """4 rotations (ADVICE r3 high): old_num must be REPLACED on
+    rotation, not accumulated — accumulating counts discarded windows in
+    the apply() denominator and decays the averaged weights toward zero
+    for runs past 3*max_average_window steps. 10 steps / window 3 ⇒
+    expected average is exactly mean(w7..w10) = (sum_3 + sum_1)/(3+1)."""
+    from paddle_tpu.core.scope import global_scope
+
+    holder = {}
+
+    def mk():
+        ma = fluid.optimizer.ModelAverage(
+            0.15, min_average_window=1, max_average_window=3)
+        holder["ma"] = ma
+        return ma
+
+    exe, ma, w_hist = _build(rng, steps=10, after_minimize=mk)
+    with ma.apply(exe, need_restore=True):
+        got = np.asarray(global_scope().find_var("w"))
+    want = _reference_model_average(w_hist, 3)
+    np.testing.assert_allclose(want, np.mean(w_hist[-4:], axis=0),
+                               rtol=1e-6)  # sanity on the simulator
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)

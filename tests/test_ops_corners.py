@@ -58,6 +58,22 @@ def test_chunk_eval_iobes_single_splits_chunks():
     assert n_gold == 2 and n_pred == 2
 
 
+def test_chunk_eval_plain_merges_contiguous_runs():
+    """plain scheme is IO semantics (reference chunk_eval_op.h:142-147,
+    all tag ids -1): contiguous same-type tokens form ONE chunk, they do
+    not each open their own (ADVICE r3: a begin tag of 0 made every
+    token its own chunk because label % 1 == 0 always)."""
+    # types: 0 0 0 | O | 1 1  (num_chunk_types=2, Outside id = 2)
+    label = np.array([0, 0, 0, 2, 1, 1], "int64")
+    prec, rec, n_pred, n_gold = _chunk_f1(label, label, 2, "plain")
+    assert n_gold == 2 and n_pred == 2
+    assert prec == pytest.approx(1.0) and rec == pytest.approx(1.0)
+    # a type switch without an Outside gap also splits: 0 0 1 = 2 chunks
+    label2 = np.array([0, 0, 1], "int64")
+    _, _, n_pred2, n_gold2 = _chunk_f1(label2, label2, 2, "plain")
+    assert n_gold2 == 2 and n_pred2 == 2
+
+
 def test_chunk_eval_invalid_scheme():
     with pytest.raises(ValueError, match="chunk_scheme"):
         _chunk_f1(np.array([0], "int64"), np.array([0], "int64"), 1,

@@ -1,0 +1,136 @@
+"""Continuous opportunistic on-chip bench capture (VERDICT r3 #1).
+
+Rounds 1-3 all lost the end-of-round TPU-bench lottery: the axon tunnel
+flakes for hours at a time, and a one-shot attempt at round end ran into
+a dead window every time. This loop inverts the bet: started at round
+begin, it probes tunnel liveness every CYCLE seconds with a tiny-matmul
+child under a hard wall budget, and the moment a probe succeeds it runs
+the full ``bench.py`` (BERT then ResNet50), which refreshes
+``.bench_last_good.json``. One good tunnel window anywhere in the round
+now yields a fresh artifact.
+
+Probe design: the liveness child is a separate interpreter (the tunnel
+hang mode is an in-process PJRT call that never returns — it cannot be
+timed out from inside), runs a 512x512 matmul and forces the result to
+numpy (``block_until_ready`` does not reliably block through the
+tunnel), and must finish inside PROBE_BUDGET seconds.
+
+State is appended to ``.capture_log`` (one JSON line per event) so the
+builder can check progress without attaching to the process.
+
+Usage: python tools/capture_loop.py [--once]
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_LOG = os.path.join(_REPO, ".capture_log")
+_LAST_GOOD = os.path.join(_REPO, ".bench_last_good.json")
+
+PROBE_BUDGET = 75.0   # seconds for the tiny-matmul liveness child
+BENCH_BUDGET = 1800.0  # hard cap on one full bench.py run
+CYCLE = 1500.0         # seconds between probe attempts (~25 min)
+CYCLE_AFTER_SUCCESS = 3600.0  # relax after a fresh capture exists
+
+_PROBE_SRC = r"""
+import numpy as np, time, sys
+t0 = time.perf_counter()
+import jax, jax.numpy as jnp
+dev = jax.devices()[0]
+if dev.platform != "tpu":
+    print("PROBE_NOT_TPU", dev.platform); sys.exit(3)
+x = jnp.ones((512, 512), jnp.bfloat16)
+y = np.asarray(jax.jit(lambda a: a @ a)(x))
+print("PROBE_OK", round(time.perf_counter() - t0, 1), float(y[0, 0]))
+"""
+
+
+def _log(event: str, **kw) -> None:
+    rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+           "event": event}
+    rec.update(kw)
+    line = json.dumps(rec)
+    print(line, flush=True)
+    try:
+        with open(_LOG, "a") as f:
+            f.write(line + "\n")
+    except OSError:
+        pass
+
+
+def _probe() -> bool:
+    env = dict(os.environ)
+    # warm cache for the probe matmul too
+    env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(_REPO, ".jax_cache")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC], env=env, cwd=_REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            timeout=PROBE_BUDGET)
+        ok = proc.returncode == 0 and "PROBE_OK" in (proc.stdout or "")
+        tail = (proc.stdout or "").strip().splitlines()
+        _log("probe", ok=ok, tail=tail[-1][:200] if tail else "")
+        return ok
+    except subprocess.TimeoutExpired:
+        _log("probe", ok=False, tail="timeout %.0fs" % PROBE_BUDGET)
+        return False
+    except Exception as e:  # noqa: BLE001 - loop must never die
+        _log("probe", ok=False, tail=repr(e)[:200])
+        return False
+
+
+def _bench() -> bool:
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "bench.py")],
+            cwd=_REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, timeout=BENCH_BUDGET)
+        out = (proc.stdout or "").strip().splitlines()
+        last = out[-1] if out else ""
+        try:
+            res = json.loads(last)
+        except ValueError:
+            res = None
+        fresh = bool(res) and res.get("platform") == "tpu" \
+            and not res.get("stale")
+        _log("bench", fresh=fresh, dt=round(time.perf_counter() - t0, 1),
+             result=res if res else last[:300])
+        return fresh
+    except subprocess.TimeoutExpired:
+        _log("bench", fresh=False, dt=round(time.perf_counter() - t0, 1),
+             result="timeout")
+        return False
+    except Exception as e:  # noqa: BLE001
+        _log("bench", fresh=False, result=repr(e)[:200])
+        return False
+
+
+def _have_fresh_capture(max_age_h: float = 6.0) -> bool:
+    try:
+        with open(_LAST_GOOD) as f:
+            lg = json.load(f)
+        return (time.time() - float(lg["ts"])) < max_age_h * 3600.0
+    except (OSError, ValueError, KeyError):
+        return False
+
+
+def main() -> int:
+    once = "--once" in sys.argv
+    _log("start", once=once, pid=os.getpid())
+    while True:
+        captured = False
+        if _probe():
+            captured = _bench()
+        if once:
+            return 0 if captured else 1
+        time.sleep(CYCLE_AFTER_SUCCESS if _have_fresh_capture() else CYCLE)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
