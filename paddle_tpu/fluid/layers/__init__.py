@@ -53,7 +53,7 @@ from .loss import *  # noqa: F401,F403
 from .control_flow import (  # noqa: F401
     While, while_loop, cond, case, switch_case, increment,
     less_than, less_equal, greater_than, greater_equal, equal, not_equal,
-    Print, Assert, StaticRNN,
+    Print, Assert, StaticRNN, is_empty, reorder_lod_tensor_by_rank,
 )
 from .learning_rate_scheduler import (  # noqa: F401
     noam_decay, exponential_decay, natural_exp_decay, inverse_time_decay,

@@ -513,3 +513,26 @@ class StaticRNN:
             raise ValueError("StaticRNN produced no step_output")
         return (self._results[0] if len(self._results) == 1
                 else self._results)
+
+
+def is_empty(x, cond=None):
+    """True iff x has zero elements (reference: control_flow.py:3779 /
+    is_empty_op.h — always computed host-side there too; here shapes
+    are static so it is a trace-time constant)."""
+    helper = LayerHelper("is_empty")
+    out = cond if cond is not None else \
+        helper.create_variable_for_type_inference(dtype="bool")
+    helper.append_op(type="is_empty", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """Permute batch rows into the rank table's order (reference:
+    control_flow.py:3738 / reorder_lod_tensor_by_rank_op.cc)."""
+    helper = LayerHelper("reorder_lod_tensor_by_rank")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="reorder_lod_tensor_by_rank",
+                     inputs={"X": [x], "RankTable": [rank_table]},
+                     outputs={"Out": [out]}, attrs={})
+    return out

@@ -20,6 +20,8 @@ __all__ = [
     "generate_proposal_labels", "polygon_box_transform",
     "roi_perspective_transform", "deformable_roi_pooling",
     "sigmoid_focal_loss", "box_decoder_and_assign",
+    "multiclass_nms2", "locality_aware_nms", "matrix_nms",
+    "detection_map", "generate_mask_labels",
 ]
 
 
@@ -417,3 +419,117 @@ def box_decoder_and_assign(prior_box, prior_box_var, target_box,
                     {"box_clip": box_clip_val},
                     ["DecodeBox", "OutputAssignBox"])
     return outs[0], outs[1]
+
+
+def multiclass_nms2(bboxes, scores, score_threshold, nms_top_k,
+                    keep_top_k, nms_threshold=0.3, normalized=True,
+                    nms_eta=1.0, background_label=0, return_index=False,
+                    name=None):
+    """multiclass_nms with kept-box indices (reference:
+    layers/detection.py multiclass_nms2 / MultiClassNMS2 op)."""
+    outs = apply_op("multiclass_nms2", "multiclass_nms2",
+                    {"BBoxes": [bboxes], "Scores": [scores]},
+                    {"score_threshold": score_threshold,
+                     "nms_top_k": nms_top_k, "keep_top_k": keep_top_k,
+                     "nms_threshold": nms_threshold,
+                     "normalized": normalized, "nms_eta": nms_eta,
+                     "background_label": background_label},
+                    ["Out", "Index"])
+    return (outs[0], outs[1]) if return_index else outs[0]
+
+
+def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
+                       keep_top_k, nms_threshold=0.3, normalized=True,
+                       nms_eta=1.0, background_label=-1, name=None):
+    """EAST-style merge-then-suppress NMS (reference:
+    layers/detection.py:3397 / locality_aware_nms_op.cc)."""
+    return _one("locality_aware_nms",
+                {"BBoxes": [bboxes], "Scores": [scores]},
+                {"score_threshold": score_threshold,
+                 "nms_top_k": nms_top_k, "keep_top_k": keep_top_k,
+                 "nms_threshold": nms_threshold,
+                 "normalized": normalized, "nms_eta": nms_eta,
+                 "background_label": background_label})
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold,
+               nms_top_k, keep_top_k, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=False, name=None):
+    """Soft decay NMS (reference: layers/detection.py:3527 /
+    matrix_nms_op.cc)."""
+    outs = apply_op("matrix_nms", "matrix_nms",
+                    {"BBoxes": [bboxes], "Scores": [scores]},
+                    {"score_threshold": score_threshold,
+                     "post_threshold": post_threshold,
+                     "nms_top_k": nms_top_k, "keep_top_k": keep_top_k,
+                     "use_gaussian": use_gaussian,
+                     "gaussian_sigma": gaussian_sigma,
+                     "background_label": background_label,
+                     "normalized": normalized},
+                    ["Out", "Index", "RoisNum"])
+    res = [outs[0]]
+    if return_index:
+        res.append(outs[1])
+    if return_rois_num:
+        res.append(outs[2])
+    return res[0] if len(res) == 1 else tuple(res)
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.3, evaluate_difficult=True,
+                  has_state=None, input_states=None,
+                  out_states=None, ap_version="integral"):
+    """mAP metric op (reference: layers/detection.py:1223 /
+    detection_map_op.h). input_states/out_states follow the reference's
+    (pos_count, true_pos, false_pos) triple contract."""
+    from ..layer_helper import LayerHelper
+
+    helper = LayerHelper("detection_map")
+    ins = {"DetectRes": [detect_res], "Label": [label]}
+    if has_state is not None:
+        ins["HasState"] = [has_state]
+    if input_states is not None:
+        ins["PosCount"] = [input_states[0]]
+        ins["TruePos"] = [input_states[1]]
+        ins["FalsePos"] = [input_states[2]]
+    map_out = helper.create_variable_for_type_inference("float32")
+    # accumulators go INTO the caller's out_states vars so they can be
+    # fed back as next batch's input_states (streaming contract of the
+    # reference layer, detection.py:1223)
+    if out_states is not None:
+        acc_pc, acc_tp, acc_fp = out_states
+    else:
+        acc_pc = helper.create_variable_for_type_inference("int32")
+        acc_tp = helper.create_variable_for_type_inference("float32")
+        acc_fp = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="detection_map", inputs=ins,
+        outputs={"MAP": [map_out], "AccumPosCount": [acc_pc],
+                 "AccumTruePos": [acc_tp], "AccumFalsePos": [acc_fp]},
+        attrs={"class_num": class_num,
+               "background_label": background_label,
+               "overlap_threshold": overlap_threshold,
+               "evaluate_difficult": evaluate_difficult,
+               "ap_type": ap_version})
+    return map_out
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution,
+                         gt_segms_poly_lod=None, gt_segms_point_lod=None):
+    """Mask R-CNN mask targets (reference: layers/detection.py:2737 /
+    generate_mask_labels_op.cc). The two *_lod inputs carry the
+    polygon nesting offsets in the padded representation."""
+    ins = {"ImInfo": [im_info], "GtClasses": [gt_classes],
+           "IsCrowd": [is_crowd], "GtSegms": [gt_segms],
+           "Rois": [rois], "LabelsInt32": [labels_int32]}
+    if gt_segms_poly_lod is not None:
+        ins["GtSegmsPolyLod"] = [gt_segms_poly_lod]
+    if gt_segms_point_lod is not None:
+        ins["GtSegmsPointLod"] = [gt_segms_point_lod]
+    outs = apply_op("generate_mask_labels", "generate_mask_labels", ins,
+                    {"num_classes": num_classes,
+                     "resolution": resolution},
+                    ["MaskRois", "RoiHasMaskInt32", "MaskInt32"])
+    return outs[0], outs[1], outs[2]

@@ -16,7 +16,7 @@ __all__ = [
     "argmin", "argsort", "zeros", "ones", "zeros_like", "ones_like",
     "reverse", "range", "linspace", "reshape", "transpose", "scale",
     "shape", "cumsum", "increment", "eye", "diag", "tril", "triu",
-    "take_along_axis",
+    "take_along_axis", "tensor_array_to_tensor",
 ]
 
 
@@ -272,3 +272,14 @@ def tril(x, diagonal=0, name=None):
 def triu(x, diagonal=0, name=None):
     return _single("tril_triu", {"X": [x]},
                    {"diagonal": diagonal, "lower": False}, dtype=x.dtype)
+
+
+def tensor_array_to_tensor(input, axis=1, name=None, use_stack=False):
+    """Concat (or stack) every entry of a TensorArray along `axis`
+    (reference: tensor.py:362 / tensor_array_to_tensor_op.cc); also
+    returns the per-entry extents along that axis."""
+    outs = apply_op("tensor_array_to_tensor", "tensor_array_to_tensor",
+                    {"X": [input]},
+                    {"axis": axis, "use_stack": use_stack},
+                    ["Out", "OutIndex"])
+    return outs[0], outs[1]

@@ -80,6 +80,48 @@ def _lod_tensor_to_array(ins, attrs):
     return {"Out": x.reshape((t, -1) + x.shape[1:])}
 
 
+@register_op("tensor_array_to_tensor")
+def _tensor_array_to_tensor(ins, attrs):
+    """Concat/stack the TensorArray entries (reference:
+    tensor_array_to_tensor_op.cc:85). On the stacked [T, ...] buffer
+    representation every entry shares one shape, so concat along `axis`
+    is a moveaxis+reshape and stack is a moveaxis; OutIndex records each
+    entry's extent along axis, as the reference does."""
+    arr = ins["X"][0]  # stacked [T, ...]
+    axis = int(attrs.get("axis", 0))
+    use_stack = attrs.get("use_stack", False)
+    n = arr.shape[0]
+    entry_shape = arr.shape[1:]
+    if use_stack:
+        out = jnp.moveaxis(arr, 0, axis)
+        idx = jnp.ones((n,), jnp.int32)
+        return {"Out": out, "OutIndex": idx}
+    out = jnp.concatenate([arr[i] for i in range(n)], axis=axis)
+    idx = jnp.full((n,), entry_shape[axis], jnp.int32)
+    return {"Out": out, "OutIndex": idx}
+
+
+@register_op("reorder_lod_tensor_by_rank")
+def _reorder_lod_tensor_by_rank(ins, attrs):
+    """Permute batch rows into rank-table order (reference:
+    reorder_lod_tensor_by_rank_op.cc:69). Padded representation: the
+    rank table is the order index vector from lod_rank_table, so the
+    reorder is a gather over dim 0."""
+    x = ins["X"][0]
+    order = ins["RankTable"][0].reshape(-1).astype(jnp.int32)
+    return {"Out": jnp.take(x, order, axis=0)}
+
+
+@register_op("reorder_lod_tensor_by_rank_grad")
+def _reorder_lod_tensor_by_rank_grad(ins, attrs):
+    # restore original order: scatter rows back (inverse permutation)
+    g = ins["X"][0]
+    order = ins["RankTable"][0].reshape(-1).astype(jnp.int32)
+    inv = jnp.zeros_like(order).at[order].set(
+        jnp.arange(order.shape[0], dtype=jnp.int32))
+    return {"Out": jnp.take(g, inv, axis=0)}
+
+
 @register_op("lod_rank_table")
 def _lod_rank_table(ins, attrs):
     # rank table = sequence indices sorted by length desc; with padded

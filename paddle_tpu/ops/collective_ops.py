@@ -42,8 +42,13 @@ def _register_allreduce(suffix, monoid):
 _register_allreduce("sum", lambda x, ax: lax.psum(x, ax))
 _register_allreduce("max", lambda x, ax: lax.pmax(x, ax))
 _register_allreduce("min", lambda x, ax: lax.pmin(x, ax))
-_register_allreduce("prod", lambda x, ax: jnp.exp(
-    lax.psum(jnp.log(x), ax)))
+# prod: all_gather + product over the gathered axis. The previous
+# exp(psum(log(x))) NaN'd for any zero/negative element; the reference
+# kRedProd (c_allreduce_op.h:58-105, ncclProd) handles all reals. The
+# extra ICI bytes (N x data vs 1 x) are acceptable for this rarely-hot
+# op in exchange for exact all-reals semantics.
+_register_allreduce("prod", lambda x, ax: jnp.prod(
+    lax.all_gather(x, ax), axis=0))
 
 
 @register_op("c_broadcast")

@@ -659,3 +659,323 @@ def _box_decoder_and_assign(ins, attrs):
     assigned = dec[jnp.arange(n), best]
     return {"DecodeBox": dec.reshape(n, c * 4),
             "OutputAssignBox": assigned}
+
+
+@register_op("mine_hard_examples", no_jit=True, dynamic_shape=True)
+def _mine_hard_examples(ins, attrs):
+    """SSD hard-negative mining (reference:
+    mine_hard_examples_op.cc:52). max_negative: negatives are unmatched
+    priors under the dist threshold, capped at neg_pos_ratio * positives;
+    hard_example: every prior is a candidate ranked by (cls+loc) loss,
+    capped at sample_size, and unselected positives get their match
+    erased. Outputs NegIndices [K,1] with NegIndicesLod offsets [N+1]."""
+    cls_loss = np.asarray(ins["ClsLoss"][0])
+    loc_loss = np.asarray(ins["LocLoss"][0]) if ins.get("LocLoss") \
+        else None
+    match_indices = np.asarray(ins["MatchIndices"][0]).copy()
+    match_dist = np.asarray(ins["MatchDist"][0])
+    neg_pos_ratio = float(attrs.get("neg_pos_ratio", 1.0))
+    neg_dist_threshold = float(attrs.get("neg_dist_threshold", 0.5))
+    sample_size = int(attrs.get("sample_size", 0))
+    mining_type = attrs.get("mining_type", "max_negative")
+    batch, prior_num = match_indices.shape
+    neg_all, starts = [], [0]
+    for n in range(batch):
+        if mining_type == "max_negative":
+            eligible = [m for m in range(prior_num)
+                        if match_indices[n, m] == -1
+                        and match_dist[n, m] < neg_dist_threshold]
+        else:  # hard_example
+            eligible = list(range(prior_num))
+        losses = cls_loss[n]
+        if mining_type == "hard_example" and loc_loss is not None:
+            losses = losses + loc_loss[n]
+        eligible.sort(key=lambda m: -losses[m])
+        if mining_type == "max_negative":
+            num_pos = int((match_indices[n] != -1).sum())
+            neg_sel = min(int(num_pos * neg_pos_ratio), len(eligible))
+        else:
+            neg_sel = min(sample_size, len(eligible))
+        sel = set(eligible[:neg_sel])
+        if mining_type == "hard_example":
+            neg = []
+            for m in range(prior_num):
+                if match_indices[n, m] > -1:
+                    if m not in sel:
+                        match_indices[n, m] = -1
+                elif m in sel:
+                    neg.append(m)
+        else:
+            neg = sorted(sel)
+        neg_all.extend(neg)
+        starts.append(len(neg_all))
+    return {"NegIndices": np.asarray(neg_all, np.int32).reshape(-1, 1),
+            "NegIndicesLod": np.asarray(starts, np.int64),
+            "UpdatedMatchIndices": match_indices}
+
+
+def _map_clip01(b):
+    return np.clip(b, 0.0, 1.0)
+
+
+@register_op("detection_map", no_jit=True, dynamic_shape=True)
+def _detection_map(ins, attrs):
+    """Detection mAP metric (reference: detection_map_op.h:59).
+    DetectRes rows [label, score, x1, y1, x2, y2]; Label rows
+    [label, x1, y1, x2, y2] or [label, is_difficult, x1, y1, x2, y2].
+    Per-image segments ride the optional DetectResLod / LabelLod offset
+    inputs (LoD-as-input, padded-representation convention); without
+    them the whole tensor is one image. Streaming accumulation state
+    enters via PosCount/TruePos/FalsePos (+ *Lod offsets per class) when
+    HasState[0] != 0 and leaves via the Accum* outputs, exactly like the
+    reference's LoD-carried state."""
+    detect = np.asarray(ins["DetectRes"][0], np.float32)
+    label = np.asarray(ins["Label"][0], np.float32)
+    d_lod = np.asarray(ins["DetectResLod"][0]).reshape(-1).astype(int) \
+        if ins.get("DetectResLod") else np.asarray([0, len(detect)])
+    l_lod = np.asarray(ins["LabelLod"][0]).reshape(-1).astype(int) \
+        if ins.get("LabelLod") else np.asarray([0, len(label)])
+    overlap_threshold = float(attrs.get("overlap_threshold", 0.5))
+    evaluate_difficult = bool(attrs.get("evaluate_difficult", True))
+    ap_type = attrs.get("ap_type", "integral")
+    class_num = int(attrs["class_num"])
+    background = int(attrs.get("background_label", 0))
+
+    # per-class streaming state; defaultdicts so out-of-range /
+    # sentinel class ids (e.g. a -1 no-detection row) accumulate
+    # harmlessly instead of KeyError'ing (reference uses std::map)
+    import collections
+
+    pos_count = {}
+    true_pos = collections.defaultdict(list)
+    false_pos = collections.defaultdict(list)
+    has_state = (int(np.asarray(ins["HasState"][0]).reshape(-1)[0])
+                 if ins.get("HasState") else 0)
+    if has_state and ins.get("PosCount"):
+        pc = np.asarray(ins["PosCount"][0]).reshape(-1)
+        for c in range(min(class_num, len(pc))):
+            if pc[c]:
+                pos_count[c] = int(pc[c])
+        for key, slot in (("TruePos", true_pos), ("FalsePos", false_pos)):
+            if not ins.get(key):
+                continue
+            arr = np.asarray(ins[key][0], np.float32).reshape(-1, 2)
+            lod = np.asarray(ins[key + "Lod"][0]).reshape(-1).astype(int) \
+                if ins.get(key + "Lod") else np.asarray([0, len(arr)])
+            for c in range(len(lod) - 1):
+                for r in arr[lod[c]:lod[c + 1]]:
+                    slot[c].append((float(r[0]), int(r[1])))
+
+    n_img = len(l_lod) - 1
+    has_difficult = label.shape[1] == 6 if len(label) else False
+    for n in range(n_img):
+        gts = {}
+        for i in range(l_lod[n], l_lod[n + 1]):
+            row = label[i]
+            cls = int(row[0])
+            if has_difficult:
+                box, difficult = row[2:6], bool(abs(row[1]) > 1e-6)
+            else:
+                box, difficult = row[1:5], False
+            gts.setdefault(cls, []).append((box, difficult))
+        for cls, items in gts.items():
+            cnt = len(items) if evaluate_difficult else \
+                sum(1 for _, d in items if not d)
+            if cnt:
+                pos_count[cls] = pos_count.get(cls, 0) + cnt
+        dets = {}
+        for i in range(d_lod[n], d_lod[n + 1]):
+            row = detect[i]
+            dets.setdefault(int(row[0]), []).append(
+                (float(row[1]), row[2:6]))
+        for cls, preds in dets.items():
+            if cls not in gts:
+                for score, _ in preds:
+                    true_pos[cls].append((score, 0))
+                    false_pos[cls].append((score, 1))
+                continue
+            matched = gts[cls]
+            visited = [False] * len(matched)
+            preds = sorted(preds, key=lambda p: -p[0])
+            for score, box in preds:
+                box = _map_clip01(box)  # reference clips pred box only
+                overlaps = [_np_iou_xyxy(box[None], mb[None])[0, 0]
+                            for mb, _d in matched]
+                max_idx = int(np.argmax(overlaps)) if overlaps else 0
+                max_ov = overlaps[max_idx] if overlaps else -1.0
+                if max_ov > overlap_threshold:
+                    ok = evaluate_difficult or not matched[max_idx][1]
+                    if ok:
+                        if not visited[max_idx]:
+                            true_pos[cls].append((score, 1))
+                            false_pos[cls].append((score, 0))
+                            visited[max_idx] = True
+                        else:
+                            true_pos[cls].append((score, 0))
+                            false_pos[cls].append((score, 1))
+                else:
+                    true_pos[cls].append((score, 0))
+                    false_pos[cls].append((score, 1))
+
+    # mAP over classes with positives (reference CalcMAP)
+    mAP, count = 0.0, 0
+    for cls, num_pos in pos_count.items():
+        # the reference's literal code compares the POSITIVE COUNT to
+        # background_label (detection_map_op.h:423, an upstream quirk);
+        # we implement the documented intent: skip the background CLASS
+        if cls == background:
+            continue
+        tp = sorted(true_pos.get(cls, []), key=lambda p: -p[0])
+        fp = sorted(false_pos.get(cls, []), key=lambda p: -p[0])
+        if not tp:
+            count += 1
+            continue
+        tp_sum = np.cumsum([c for _, c in tp])
+        fp_sum = np.cumsum([c for _, c in fp])
+        precision = tp_sum / np.maximum(tp_sum + fp_sum, 1e-12)
+        recall = tp_sum / float(num_pos)
+        if ap_type == "11point":
+            max_precisions = np.zeros(11)
+            start_idx = len(tp_sum) - 1
+            for j in range(10, -1, -1):
+                for i in range(start_idx, -1, -1):
+                    if recall[i] < j / 10.0:
+                        start_idx = i
+                        if j > 0:
+                            max_precisions[j - 1] = max_precisions[j]
+                        break
+                    if max_precisions[j] < precision[i]:
+                        max_precisions[j] = precision[i]
+            mAP += max_precisions.sum() / 11.0
+            count += 1
+        else:  # integral
+            ap, prev_recall = 0.0, 0.0
+            for p, r in zip(precision, recall):
+                if abs(r - prev_recall) > 1e-6:
+                    ap += p * abs(r - prev_recall)
+                prev_recall = r
+            mAP += ap
+            count += 1
+    if count:
+        mAP /= count
+
+    out_pc = np.zeros((class_num, 1), np.int32)
+    for c, v in pos_count.items():
+        if 0 <= c < class_num:
+            out_pc[c, 0] = v
+    tp_rows, fp_rows = [], []
+    tp_lod, fp_lod = [0], [0]
+    for c in range(class_num):
+        for s, v in true_pos[c]:
+            tp_rows.append([s, v])
+        tp_lod.append(len(tp_rows))
+        for s, v in false_pos[c]:
+            fp_rows.append([s, v])
+        fp_lod.append(len(fp_rows))
+    return {"MAP": np.asarray([mAP], np.float32),
+            "AccumPosCount": out_pc,
+            "AccumTruePos": np.asarray(tp_rows, np.float32).reshape(-1, 2),
+            "AccumTruePosLod": np.asarray(tp_lod, np.int64),
+            "AccumFalsePos": np.asarray(fp_rows,
+                                        np.float32).reshape(-1, 2),
+            "AccumFalsePosLod": np.asarray(fp_lod, np.int64)}
+
+
+def _poly2mask_grid(xy, m):
+    """Even-odd rasterization of one polygon onto an [m, m] grid at
+    pixel centers (reference mask_util.cc:45 Poly2Mask uses the cocoapi
+    boundary-walk RLE; pixel-center crossing parity matches it for
+    well-formed polygons up to boundary-pixel rounding, documented)."""
+    px = np.arange(m) + 0.5
+    gx, gy = np.meshgrid(px, px)  # [row=y, col=x]
+    inside = np.zeros((m, m), bool)
+    x0, y0 = xy[:, 0], xy[:, 1]
+    x1, y1 = np.roll(x0, -1), np.roll(y0, -1)
+    for ax, ay, bx, by in zip(x0, y0, x1, y1):
+        if ay == by:
+            continue
+        cond = (ay > gy) != (by > gy)
+        xint = ax + (gy - ay) * (bx - ax) / (by - ay)
+        inside ^= cond & (gx < xint)
+    return inside
+
+
+@register_op("generate_mask_labels", no_jit=True, dynamic_shape=True)
+def _generate_mask_labels(ins, attrs):
+    """Mask R-CNN mask targets (reference:
+    generate_mask_labels_op.cc:139 SampleMaskForOneImage, single image):
+    each fg roi is matched to the gt mask whose polygon bbox overlaps it
+    most; that gt's polygons are rasterized w.r.t. the roi at
+    `resolution`; targets expand to [P, num_classes*M*M] with -1 ignore
+    elsewhere. Polygon structure rides GtSegmsPolyLod (per-gt poly
+    offsets) + GtSegmsPointLod (per-poly point offsets) over the flat
+    GtSegms [S, 2] points."""
+    im_info = np.asarray(ins["ImInfo"][0], np.float32).reshape(-1)
+    gt_classes = np.asarray(ins["GtClasses"][0]).reshape(-1).astype(int)
+    is_crowd = np.asarray(ins["IsCrowd"][0]).reshape(-1).astype(int)
+    segms = np.asarray(ins["GtSegms"][0], np.float32).reshape(-1, 2)
+    rois = np.asarray(ins["Rois"][0], np.float32).reshape(-1, 4)
+    labels = np.asarray(ins["LabelsInt32"][0]).reshape(-1).astype(int)
+    if not ins.get("GtSegmsPolyLod") or not ins.get("GtSegmsPointLod"):
+        raise ValueError(
+            "generate_mask_labels: wire GtSegmsPolyLod (per-gt polygon "
+            "offsets) and GtSegmsPointLod (per-polygon point offsets) — "
+            "the padded representation carries the reference's 3-level "
+            "GtSegms LoD through these inputs")
+    poly_lod = np.asarray(ins["GtSegmsPolyLod"][0]).reshape(-1).astype(int)
+    point_lod = np.asarray(
+        ins["GtSegmsPointLod"][0]).reshape(-1).astype(int)
+    num_classes = int(attrs["num_classes"])
+    m = int(attrs["resolution"])
+    im_scale = float(im_info[2]) if im_info.size >= 3 else 1.0
+
+    gt_polys = []  # per kept gt: list of [k,2] arrays
+    for i in range(len(gt_classes)):
+        if gt_classes[i] > 0 and is_crowd[i] == 0:
+            polys = []
+            for j in range(poly_lod[i], poly_lod[i + 1]):
+                polys.append(segms[point_lod[j]:point_lod[j + 1]])
+            gt_polys.append(polys)
+    fg_inds = [i for i in range(len(labels)) if labels[i] > 0]
+
+    if fg_inds and gt_polys:
+        boxes_from_polys = np.asarray(
+            [[min(p[:, 0].min() for p in ps),
+              min(p[:, 1].min() for p in ps),
+              max(p[:, 0].max() for p in ps),
+              max(p[:, 1].max() for p in ps)] for ps in gt_polys],
+            np.float32)
+        rois_fg = rois[fg_inds] / im_scale
+        overlaps = _np_iou_xyxy(rois_fg, boxes_from_polys)
+        fg_mask_inds = overlaps.argmax(axis=1)
+        masks = np.zeros((len(fg_inds), m * m), np.int32)
+        cls_labels = labels[np.asarray(fg_inds)]
+        for i, gt_i in enumerate(fg_mask_inds):
+            x0, y0, x1, y1 = rois_fg[i]
+            w = max(x1 - x0, 1.0)
+            h = max(y1 - y0, 1.0)
+            acc = np.zeros((m, m), bool)
+            for poly in gt_polys[gt_i]:
+                p = np.stack([(poly[:, 0] - x0) * m / w,
+                              (poly[:, 1] - y0) * m / h], axis=1)
+                acc |= _poly2mask_grid(p, m)
+            masks[i] = acc.astype(np.int32).reshape(-1)
+        roi_has_mask = list(fg_inds)
+        rois_out = rois_fg * im_scale
+    else:
+        # no fg: one bg roi with an all-ignore mask, class 0
+        bg = next((i for i in range(len(labels)) if labels[i] == 0), 0)
+        rois_out = rois[bg:bg + 1].copy() if len(rois) else \
+            np.zeros((1, 4), np.float32)
+        masks = np.full((1, m * m), -1, np.int32)
+        cls_labels = np.asarray([0])
+        roi_has_mask = [bg]
+
+    expand = np.full((len(masks), num_classes * m * m), -1, np.int32)
+    for i, cls in enumerate(np.asarray(cls_labels).reshape(-1)):
+        if cls > 0:
+            expand[i, cls * m * m:(cls + 1) * m * m] = masks[i]
+    return {"MaskRois": rois_out.astype(np.float32),
+            "RoiHasMaskInt32": np.asarray(
+                roi_has_mask, np.int32).reshape(-1, 1),
+            "MaskInt32": expand}

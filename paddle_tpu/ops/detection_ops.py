@@ -307,49 +307,226 @@ def _roi_pool(ins, attrs):
     return {"Out": out, "Argmax": jnp.zeros(out.shape, jnp.int64)}
 
 
+def _np_iou_pair(a, b):
+    x1 = max(a[0], b[0])
+    y1 = max(a[1], b[1])
+    x2 = min(a[2], b[2])
+    y2 = min(a[3], b[3])
+    inter = max(x2 - x1, 0) * max(y2 - y1, 0)
+    a0 = (a[2] - a[0]) * (a[3] - a[1])
+    a1 = (b[2] - b[0]) * (b[3] - b[1])
+    return inter / max(a0 + a1 - inter, 1e-10)
+
+
+def _greedy_select(order, iou_of, nms_threshold, eta):
+    """Greedy suppress-by-IoU with the reference's adaptive eta rule
+    (multiclass_nms_op.cc NMSFast): keep a candidate iff its IoU with
+    every kept box is <= the adaptive threshold."""
+    selected = []
+    adaptive = nms_threshold
+    for idx in order:
+        ok = True
+        for kept in selected:
+            if iou_of(idx, kept) > adaptive:
+                ok = False
+                break
+        if ok:
+            selected.append(int(idx))
+            if eta < 1.0 and adaptive > 0.5:
+                adaptive *= eta
+    return selected
+
+
+def _nms_one_batch(boxes_b, scores_b, attrs):
+    """Greedy per-class NMS for one image; returns (dets, box_indices)
+    sorted by score desc, keep_top_k applied (reference:
+    multiclass_nms_op.cc MultiClassNMS/MultiClassOutput)."""
+    score_threshold = attrs.get("score_threshold", 0.0)
+    nms_threshold = attrs.get("nms_threshold", 0.3)
+    nms_top_k = attrs.get("nms_top_k", 400)
+    keep_top_k = attrs.get("keep_top_k", 200)
+    background = attrs.get("background_label", 0)
+    eta = attrs.get("nms_eta", 1.0)
+    dets, det_idx = [], []
+    for cls in range(scores_b.shape[0]):
+        if cls == background:
+            continue
+        s = scores_b[cls]
+        keep = np.where(s > score_threshold)[0]
+        order = keep[np.argsort(-s[keep], kind="stable")][:nms_top_k]
+        selected = _greedy_select(
+            order, lambda i, k: _np_iou_pair(boxes_b[i], boxes_b[k]),
+            nms_threshold, eta)
+        for idx in selected:
+            dets.append([cls, s[idx]] + list(boxes_b[idx]))
+            det_idx.append(idx)
+    order = sorted(range(len(dets)), key=lambda i: -dets[i][1])
+    order = order[:keep_top_k] if keep_top_k > -1 else order
+    return ([dets[i] for i in order], [det_idx[i] for i in order])
+
+
 @register_op("multiclass_nms", no_jit=True,
              dynamic_shape=True)
 def _multiclass_nms(ins, attrs):
     # host-side (dynamic output count; reference outputs a LoDTensor)
     boxes = np.asarray(ins["BBoxes"][0])
     scores = np.asarray(ins["Scores"][0])
+    results = []
+    for b in range(boxes.shape[0]):
+        dets, _ = _nms_one_batch(boxes[b], scores[b], attrs)
+        results.append(np.asarray(dets, np.float32).reshape(-1, 6))
+    out = np.concatenate(results, axis=0) if results else \
+        np.zeros((0, 6), np.float32)
+    return {"Out": out}
+
+
+@register_op("multiclass_nms2", no_jit=True,
+             dynamic_shape=True)
+def _multiclass_nms2(ins, attrs):
+    """multiclass_nms + Index output: kept boxes' indices into the
+    flattened [N*M] box table (reference: multiclass_nms_op.cc:493
+    MultiClassNMS2Op, Index filled at :321 with start + idx)."""
+    boxes = np.asarray(ins["BBoxes"][0])
+    scores = np.asarray(ins["Scores"][0])
+    num_boxes = boxes.shape[1]
+    results, indices = [], []
+    for b in range(boxes.shape[0]):
+        dets, idx = _nms_one_batch(boxes[b], scores[b], attrs)
+        results.append(np.asarray(dets, np.float32).reshape(-1, 6))
+        indices.append(np.asarray(idx, np.int32) + b * num_boxes)
+    out = np.concatenate(results, axis=0) if results else \
+        np.zeros((0, 6), np.float32)
+    index = np.concatenate(indices, axis=0).reshape(-1, 1) if indices \
+        else np.zeros((0, 1), np.int32)
+    return {"Out": out, "Index": index}
+
+
+@register_op("locality_aware_nms", no_jit=True,
+             dynamic_shape=True)
+def _locality_aware_nms(ins, attrs):
+    """EAST-style NMS: consecutive overlapping boxes are first merged
+    score-weighted (reference: locality_aware_nms_op.cc:88
+    PolyWeightedMerge + :96 GetMaxScoreIndexWithLocalityAware), then
+    standard greedy NMS runs on the merged set. Quad (8-point) boxes use
+    their axis-aligned bbox for overlap (PolyIoU descope, documented)."""
+    boxes = np.asarray(ins["BBoxes"][0]).copy()
+    scores = np.asarray(ins["Scores"][0]).copy()
     score_threshold = attrs.get("score_threshold", 0.0)
     nms_threshold = attrs.get("nms_threshold", 0.3)
     nms_top_k = attrs.get("nms_top_k", 400)
     keep_top_k = attrs.get("keep_top_k", 200)
-    background = attrs.get("background_label", 0)
-    n = boxes.shape[0]
+    background = attrs.get("background_label", -1)
+    eta = attrs.get("nms_eta", 1.0)
+    box_size = boxes.shape[-1]
+
+    def aabb(v):
+        if box_size == 4:
+            return v
+        xs, ys = v[0::2], v[1::2]
+        return np.asarray([xs.min(), ys.min(), xs.max(), ys.max()])
+
     results = []
-    for b in range(n):
+    for b in range(boxes.shape[0]):
         dets = []
         for cls in range(scores.shape[1]):
             if cls == background:
                 continue
-            s = scores[b, cls]
-            keep = np.where(s > score_threshold)[0]
-            order = keep[np.argsort(-s[keep])][:nms_top_k]
-            bb = list(boxes[b, order])
-            ss = list(s[order])
-            while bb:
-                b0, s0 = bb.pop(0), ss.pop(0)
-                dets.append([cls, s0] + list(b0))
-                nbb, nss = [], []
-                for bi, si in zip(bb, ss):
-                    x1 = max(b0[0], bi[0])
-                    y1 = max(b0[1], bi[1])
-                    x2 = min(b0[2], bi[2])
-                    y2 = min(b0[3], bi[3])
-                    inter = max(x2 - x1, 0) * max(y2 - y1, 0)
-                    a0 = (b0[2] - b0[0]) * (b0[3] - b0[1])
-                    a1 = (bi[2] - bi[0]) * (bi[3] - bi[1])
-                    iou = inter / max(a0 + a1 - inter, 1e-10)
-                    if iou <= nms_threshold:
-                        nbb.append(bi)
-                        nss.append(si)
-                bb, ss = nbb, nss
+            bb = boxes[b].copy()
+            ss = scores[b, cls].copy()
+            # locality-aware pass: merge each box into the running
+            # anchor while they overlap; anchor score accumulates
+            index = -1
+            skip = np.ones(len(ss), bool)
+            for i in range(len(ss)):
+                if index > -1:
+                    iou = _np_iou_pair(aabb(bb[i]), aabb(bb[index]))
+                    if iou > nms_threshold:
+                        bb[index] = (bb[i] * ss[i] + bb[index]
+                                     * ss[index]) / (ss[i] + ss[index])
+                        ss[index] += ss[i]
+                    else:
+                        skip[index] = False
+                        index = i
+                else:
+                    index = i
+            if index > -1:
+                skip[index] = False
+            cand = [i for i in range(len(ss))
+                    if ss[i] > score_threshold and not skip[i]]
+            cand.sort(key=lambda i: -ss[i])
+            cand = cand[:nms_top_k] if nms_top_k > -1 else cand
+            selected = _greedy_select(
+                cand, lambda i, k: _np_iou_pair(aabb(bb[i]), aabb(bb[k])),
+                nms_threshold, eta)
+            for i in selected:
+                dets.append([cls, ss[i]] + list(bb[i]))
         dets.sort(key=lambda d: -d[1])
-        results.append(np.asarray(dets[:keep_top_k], np.float32).reshape(
-            -1, 6))
+        dets = dets[:keep_top_k] if keep_top_k > -1 else dets
+        results.append(np.asarray(dets, np.float32).reshape(
+            -1, 2 + box_size))
     out = np.concatenate(results, axis=0) if results else \
-        np.zeros((0, 6), np.float32)
+        np.zeros((0, 2 + box_size), np.float32)
     return {"Out": out}
+
+
+@register_op("matrix_nms", no_jit=True, dynamic_shape=True)
+def _matrix_nms(ins, attrs):
+    """Matrix NMS: soft decay by max-IoU statistics instead of hard
+    suppression (reference: matrix_nms_op.cc:95 NMSMatrix + :165
+    MatrixNMSKernel). Outputs Out [K, box_dim+2], Index [K,1] into the
+    flattened box table, RoisNum [N] per-image counts."""
+    boxes = np.asarray(ins["BBoxes"][0])
+    scores = np.asarray(ins["Scores"][0])
+    score_threshold = attrs.get("score_threshold", 0.0)
+    post_threshold = attrs.get("post_threshold", 0.0)
+    nms_top_k = attrs.get("nms_top_k", -1)
+    keep_top_k = attrs.get("keep_top_k", -1)
+    background = attrs.get("background_label", 0)
+    use_gaussian = attrs.get("use_gaussian", False)
+    sigma = attrs.get("gaussian_sigma", 2.0)
+    batch, _, num_boxes = scores.shape
+    box_dim = boxes.shape[-1]
+    all_out, all_idx, rois_num = [], [], []
+    for b in range(batch):
+        cand = []  # (decayed_score, cls, box_idx)
+        for cls in range(scores.shape[1]):
+            if cls == background:
+                continue
+            s = scores[b, cls]
+            perm = np.where(s > score_threshold)[0]
+            perm = perm[np.argsort(-s[perm], kind="stable")]
+            if nms_top_k > -1:
+                perm = perm[:nms_top_k]
+            m = len(perm)
+            if m == 0:
+                continue
+            ious = np.zeros((m, m), np.float32)
+            for i in range(1, m):
+                for j in range(i):
+                    ious[i, j] = _np_iou_pair(boxes[b, perm[i]],
+                                              boxes[b, perm[j]])
+            iou_max = np.zeros(m, np.float32)
+            for i in range(1, m):
+                iou_max[i] = ious[i, :i].max()
+            if s[perm[0]] > post_threshold:
+                cand.append((float(s[perm[0]]), cls, int(perm[0])))
+            for i in range(1, m):
+                if use_gaussian:
+                    decay = np.exp((iou_max[:i] ** 2 - ious[i, :i] ** 2)
+                                   * sigma)
+                else:
+                    decay = (1.0 - ious[i, :i]) / (1.0 - iou_max[:i])
+                ds = float(decay.min() * s[perm[i]])
+                if ds > post_threshold:
+                    cand.append((ds, cls, int(perm[i])))
+        cand.sort(key=lambda t: -t[0])
+        if keep_top_k > -1:
+            cand = cand[:keep_top_k]
+        rois_num.append(len(cand))
+        for ds, cls, idx in cand:
+            all_out.append([cls, ds] + list(boxes[b, idx]))
+            all_idx.append(b * num_boxes + idx)
+    out = np.asarray(all_out, np.float32).reshape(-1, box_dim + 2)
+    idx = np.asarray(all_idx, np.int32).reshape(-1, 1)
+    return {"Out": out, "Index": idx,
+            "RoisNum": np.asarray(rois_num, np.int32)}

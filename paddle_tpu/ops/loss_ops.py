@@ -22,6 +22,32 @@ def _hinge_loss(ins, attrs):
     return {"Loss": jnp.maximum(0.0, 1.0 - y * logits)}
 
 
+@register_op("modified_huber_loss")
+def _modified_huber_loss(ins, attrs):
+    """Reference: modified_huber_loss_op.h — labels in {0,1} scaled to
+    {-1,+1}; piecewise: -4v for v<-1, (1-v)^2 for v<1, else 0. The
+    IntermediateVal output (v = x*(2y-1)) feeds the reference's grad
+    kernel; jax.vjp differentiates through the jnp.where directly."""
+    x, y = ins["X"][0], ins["Y"][0]
+    v = x * (2.0 * y.astype(x.dtype) - 1.0)
+    loss = jnp.where(v < -1.0, -4.0 * v,
+                     jnp.where(v < 1.0, jnp.square(1.0 - v), 0.0))
+    return {"IntermediateVal": v, "Out": loss}
+
+
+@register_op("squared_l2_distance")
+def _squared_l2_distance(ins, attrs):
+    """Reference: squared_l2_distance_op.h — rows flattened to
+    [N, cols]; Y broadcasts when it has one row; Out[i] = sum((x-y)^2)
+    per row, sub_result cached for the grad kernel."""
+    x, y = ins["X"][0], ins["Y"][0]
+    xr = x.reshape(x.shape[0], -1)
+    yr = y.reshape(y.shape[0], -1)
+    sub = xr - yr  # [1, cols] Y broadcasts over rows
+    return {"sub_result": sub,
+            "Out": jnp.sum(jnp.square(sub), axis=1, keepdims=True)}
+
+
 @register_op("rank_loss")
 def _rank_loss(ins, attrs):
     # reference: rank_loss_op.cc — RankNet pairwise loss

@@ -312,3 +312,46 @@ def _dgc_momentum(ins, attrs):
     p_out = jnp.where(warm, p_momentum, p_sgd)
     v_out = jnp.where(warm, v_new, v)
     return {"ParamOut": p_out, "VelocityOut": v_out}
+
+
+@register_op("average_accumulates")
+def _average_accumulates(ins, attrs):
+    """Sliding-window parameter-sum accumulator for ModelAverage
+    (reference: average_accumulates_op.h:41). Per step: sum_1 += param,
+    counters ++; precision shuffle every 16384 updates folds sum_1 into
+    sum_2; when the window overflows (num_accumulates >= min_window and
+    >= min(max_window, num_updates*average_window)) rotate:
+    sum_3 <- sum_1+sum_2, zero sum_1/sum_2, old_num <- num (REPLACED),
+    num <- 0. Masked jnp.where keeps it one jittable computation."""
+    p = ins["Param"][0]
+    s1 = ins["in_sum_1"][0]
+    s2 = ins["in_sum_2"][0]
+    s3 = ins["in_sum_3"][0]
+    num = ins["in_num_accumulates"][0].reshape(()).astype(jnp.int64)
+    old = ins["in_old_num_accumulates"][0].reshape(()).astype(jnp.int64)
+    upd = ins["in_num_updates"][0].reshape(()).astype(jnp.int64)
+    avg_win = attrs.get("average_window", 0.0)
+    max_win = attrs.get("max_average_window", 2 ** 62)
+    min_win = attrs.get("min_average_window", 10000)
+    k_max_acc = 16384  # reference kMaxNumAccumulates
+
+    upd = upd + 1
+    num = num + 1
+    s1 = s1 + p
+    shuffle = (upd % k_max_acc) == 0
+    s2 = jnp.where(shuffle, s1 + s2, s2)
+    s1 = jnp.where(shuffle, jnp.zeros_like(s1), s1)
+
+    thresh = jnp.minimum(
+        jnp.int64(max_win),
+        (upd.astype(jnp.float32) * avg_win).astype(jnp.int64))
+    rotate = (num >= min_win) & (num >= thresh)
+    s3 = jnp.where(rotate, s1 + s2, s3)
+    s1 = jnp.where(rotate, jnp.zeros_like(s1), s1)
+    s2 = jnp.where(rotate, jnp.zeros_like(s2), s2)
+    old = jnp.where(rotate, num, old)
+    num = jnp.where(rotate, jnp.int64(0), num)
+    return {"out_sum_1": s1, "out_sum_2": s2, "out_sum_3": s3,
+            "out_num_accumulates": num.reshape((1,)),
+            "out_old_num_accumulates": old.reshape((1,)),
+            "out_num_updates": upd.reshape((1,))}

@@ -83,6 +83,84 @@ def _fake_dequantize_max_abs(ins, attrs):
     return {"Out": x.astype(jnp.float32) * scale / max_range}
 
 
+@register_op("fake_quantize_range_abs_max")
+def _fake_quantize_range_abs_max(ins, attrs):
+    """Window-max scale QAT quantizer (reference: fake_quantize_op.h:157
+    FakeQuantizeRangeAbsMaxKernel + fake_quantize_op.cc:123
+    FindRangeAbsMaxFunctor). The window buffer rides the InScales input /
+    OutScales output pair (the reference updates one variable in place)."""
+    x = ins["X"][0]
+    bits = attrs.get("bit_length", 8)
+    in_scale = ins["InScale"][0].reshape(())
+    if attrs.get("is_test", False):
+        return {"Out": _qdq(x, in_scale, bits),
+                "OutScale": jnp.reshape(in_scale, (1,))}
+    window = int(attrs.get("window_size", 10000))
+    it = jnp.reshape(ins["Iter"][0], ()).astype(jnp.int64) \
+        if ins.get("Iter") else jnp.int64(0)
+    prev = ins["InScales"][0] if ins.get("InScales") \
+        else jnp.zeros((window,), jnp.float32)
+    cur = jnp.max(jnp.abs(x)).astype(jnp.float32)
+    idx = (it % window).astype(jnp.int32)
+    removed = prev[idx]
+    arr = prev.at[idx].set(cur)
+    # recompute the window max only when the evicted slot WAS the max
+    # (reference: |removed - last| < 1e-6); scales are >= 0 so masking
+    # with 0 is a sound -inf substitute
+    # window now holds min(it+1, window) valid entries INCLUDING the
+    # slot just written with cur — excluding it would collapse the
+    # scale when the evicted slot was the previous max
+    size = jnp.clip(it + 1, 1, window)
+    mask = (jnp.arange(window) < size).astype(jnp.float32)
+    win_max = jnp.max(arr * mask)
+    scale = jnp.where(
+        in_scale < cur, cur,
+        jnp.where(jnp.abs(removed - in_scale) < 1e-6, win_max, in_scale))
+    return {"Out": _qdq(x, scale, bits),
+            "OutScale": jnp.reshape(scale, (1,)),
+            "OutScales": arr}
+
+
+@register_op("fake_channel_wise_dequantize_max_abs")
+def _fake_cw_dequantize_max_abs(ins, attrs):
+    """Reference: fake_dequantize_op.h:58 + .cc:37 — one scale tensor =
+    per-output-channel weight dequant (dim 0); two = activation path with
+    per-dim-1 scales plus a scalar scale."""
+    x = ins["X"][0].astype(jnp.float32)
+    scales = ins["Scales"]
+    quant_bits = attrs.get("quant_bits", [8])
+    if len(scales) == 1:
+        bnt = (1 << (int(quant_bits[0]) - 1)) - 1
+        s = scales[0].reshape((-1,) + (1,) * (x.ndim - 1))
+        return {"Out": x * s / bnt}
+    bnt0 = (1 << (int(quant_bits[0]) - 1)) - 1
+    bnt1 = (1 << (int(quant_bits[1]) - 1)) - 1
+    s0 = scales[0].reshape((1, -1) + (1,) * (x.ndim - 2))
+    s1 = scales[1].reshape(())
+    return {"Out": x * s0 * s1 / (bnt0 * bnt1)}
+
+
+@register_op("dequantize_abs_max")
+def _dequantize_abs_max(ins, attrs):
+    """int8 -> float via scalar scale (reference:
+    dequantize_abs_max_op.cc:23 DequantizeFunctor)."""
+    x = ins["X"][0]
+    scale = ins["Scale"][0].reshape(())
+    max_range = attrs.get("max_range", 127.0)
+    return {"Out": scale * x.astype(jnp.float32) / max_range}
+
+
+@register_op("dequantize_log")
+def _dequantize_log(ins, attrs):
+    """int8 -> float through a 128-entry log dictionary (reference:
+    dequantize_log_op.cc:24): negative codes mirror to -dict[x+128]."""
+    x = ins["X"][0].astype(jnp.int32)
+    table = ins["Dict"][0].reshape(-1)
+    neg = -table[jnp.clip(x + 128, 0, table.shape[0] - 1)]
+    pos = table[jnp.clip(x, 0, table.shape[0] - 1)]
+    return {"Out": jnp.where(x < 0, neg, pos)}
+
+
 @register_op("moving_average_abs_max_scale")
 def _ma_abs_max_scale(ins, attrs):
     x = ins["X"][0]

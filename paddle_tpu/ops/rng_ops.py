@@ -27,6 +27,19 @@ def _uniform_random(ins, attrs):
     return {"Out": out.astype(dtype)}
 
 
+@register_op("seed", no_jit=True)
+def _seed(ins, attrs):
+    """Emit a seed scalar: the fixed attr when nonzero, else a fresh
+    random draw (reference: seed_op.h:23 CPUSeedKernel; always host-side
+    there too — the output feeds dropout-style seed attrs)."""
+    import numpy as np_
+
+    user_seed = int(attrs.get("seed", 0))
+    val = user_seed if user_seed != 0 \
+        else int(np_.random.randint(0, 2**31 - 1))
+    return {"Out": np_.asarray([val], np_.int32)}
+
+
 @register_op("uniform_random_batch_size_like", needs_rng=True)
 def _uniform_random_bsl(ins, attrs):
     ref = ins["Input"][0]

@@ -77,6 +77,14 @@ def _shape(ins, attrs):
     return {"Out": jnp.asarray(np.asarray(x.shape, np.int32))}
 
 
+@register_op("is_empty")
+def _is_empty(ins, attrs):
+    # reference: is_empty_op.h:23 — Out[0] = numel(X) == 0. Shapes are
+    # static under XLA, so the answer is a trace-time constant.
+    x = ins["X"][0]
+    return {"Out": jnp.asarray([x.size == 0])}
+
+
 @register_op("reshape")
 def _reshape(ins, attrs):
     return {"Out": _do_reshape(ins["X"][0], attrs["shape"])}

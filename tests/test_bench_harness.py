@@ -17,6 +17,13 @@ import bench  # noqa: E402
 N_TPU = len(bench._ATTEMPTS)
 
 
+@pytest.fixture(autouse=True)
+def _no_backoff(monkeypatch):
+    # main()'s 15s/30s inter-attempt backoffs are real-tunnel behavior;
+    # with monkeypatched children they were 45s of pure sleep per test
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+
+
 @pytest.fixture
 def lastgood(tmp_path, monkeypatch):
     path = str(tmp_path / "last_good.json")

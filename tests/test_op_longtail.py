@@ -1,0 +1,402 @@
+"""Golden tests for the round-4 op long tail (VERDICT r3 missing #4):
+metric/loss ops, control/array utilities, the detection NMS family, and
+the quant variants — each checked against a numpy re-derivation of the
+reference kernel's semantics (reference files cited per test)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import run_op
+
+
+def _np(x):
+    return np.asarray(x)
+
+
+# ---------------------------------------------------------------- losses
+def test_modified_huber_loss():
+    # reference: modified_huber_loss_op.h ModifiedHuberLossForward
+    x = np.asarray([-3.0, -0.5, 0.2, 0.9, 2.0], "float32")
+    y = np.asarray([1.0, 0.0, 1.0, 1.0, 0.0], "float32")
+    out = run_op("modified_huber_loss",
+                 {"X": [jnp.asarray(x)], "Y": [jnp.asarray(y)]}, {})
+    v = x * (2 * y - 1)
+    want = np.where(v < -1, -4 * v, np.where(v < 1, (1 - v) ** 2, 0.0))
+    np.testing.assert_allclose(_np(out["Out"][0]), want, rtol=1e-6)
+    np.testing.assert_allclose(_np(out["IntermediateVal"][0]), v,
+                               rtol=1e-6)
+
+
+def test_squared_l2_distance_broadcast():
+    # reference: squared_l2_distance_op.h (Y row broadcasts)
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 3, 2).astype("float32")
+    y = rng.randn(1, 3, 2).astype("float32")
+    out = run_op("squared_l2_distance",
+                 {"X": [jnp.asarray(x)], "Y": [jnp.asarray(y)]}, {})
+    sub = x.reshape(4, -1) - y.reshape(1, -1)
+    np.testing.assert_allclose(_np(out["Out"][0]),
+                               (sub ** 2).sum(1, keepdims=True),
+                               rtol=1e-5)
+    np.testing.assert_allclose(_np(out["sub_result"][0]), sub, rtol=1e-6)
+
+
+# ------------------------------------------------------- array/control
+def test_is_empty():
+    # reference: is_empty_op.h — numel == 0
+    out = run_op("is_empty", {"X": [jnp.zeros((2, 3))]}, {})
+    assert _np(out["Out"][0]) == np.asarray([False])
+    out = run_op("is_empty", {"X": [jnp.zeros((0, 3))]}, {})
+    assert _np(out["Out"][0]) == np.asarray([True])
+
+
+def test_seed_op():
+    # reference: seed_op.h — fixed seed passes through, 0 draws random
+    out = run_op("seed", {}, {"seed": 42})
+    assert _np(out["Out"][0]) == np.asarray([42])
+    a = _np(run_op("seed", {}, {"seed": 0})["Out"][0])
+    assert a.dtype == np.int32 and a[0] > 0
+
+
+def test_tensor_array_to_tensor_concat_and_stack():
+    # reference: tensor_array_to_tensor_op.cc:85 (concat/stack + index)
+    arr = jnp.asarray(np.arange(24, dtype="float32").reshape(3, 2, 4))
+    out = run_op("tensor_array_to_tensor", {"X": [arr]},
+                 {"axis": 1, "use_stack": False})
+    want = np.concatenate([_np(arr)[i] for i in range(3)], axis=1)
+    np.testing.assert_allclose(_np(out["Out"][0]), want)
+    np.testing.assert_array_equal(_np(out["OutIndex"][0]), [4, 4, 4])
+
+    out = run_op("tensor_array_to_tensor", {"X": [arr]},
+                 {"axis": 1, "use_stack": True})
+    np.testing.assert_allclose(_np(out["Out"][0]),
+                               np.stack([_np(arr)[i] for i in range(3)],
+                                        axis=1))
+
+
+def test_reorder_lod_tensor_by_rank_roundtrip():
+    # reference: reorder_lod_tensor_by_rank_op.cc (+ grad restores)
+    x = jnp.asarray(np.arange(12, dtype="float32").reshape(4, 3))
+    order = jnp.asarray(np.asarray([2, 0, 3, 1], "int64"))
+    out = run_op("reorder_lod_tensor_by_rank",
+                 {"X": [x], "RankTable": [order]}, {})
+    np.testing.assert_allclose(_np(out["Out"][0]), _np(x)[[2, 0, 3, 1]])
+    back = run_op("reorder_lod_tensor_by_rank_grad",
+                  {"X": [out["Out"][0]], "RankTable": [order]}, {})
+    np.testing.assert_allclose(_np(back["Out"][0]), _np(x))
+
+
+def test_average_accumulates_rotation_replaces_old_num():
+    # reference: average_accumulates_op.h:84-107
+    shape = (2, 2)
+    s1 = jnp.zeros(shape)
+    s2 = jnp.zeros(shape)
+    s3 = jnp.zeros(shape)
+    num = jnp.asarray([0], "int64")
+    old = jnp.asarray([0], "int64")
+    upd = jnp.asarray([0], "int64")
+    rng = np.random.RandomState(3)
+    params = [rng.randn(*shape).astype("float32") for _ in range(10)]
+    for p in params:
+        out = run_op("average_accumulates",
+                     {"Param": [jnp.asarray(p)], "in_sum_1": [s1],
+                      "in_sum_2": [s2], "in_sum_3": [s3],
+                      "in_num_accumulates": [num],
+                      "in_old_num_accumulates": [old],
+                      "in_num_updates": [upd]},
+                     {"average_window": 1.0, "max_average_window": 3,
+                      "min_average_window": 3})
+        s1, s2, s3 = (out["out_sum_1"][0], out["out_sum_2"][0],
+                      out["out_sum_3"][0])
+        num, old, upd = (out["out_num_accumulates"][0],
+                         out["out_old_num_accumulates"][0],
+                         out["out_num_updates"][0])
+    # 10 steps, window 3: rotations at 3/6/9 -> s3 = p7+p8+p9,
+    # s1 = p10, old_num REPLACED with 3, num = 1
+    np.testing.assert_allclose(_np(s3), sum(params[6:9]), rtol=1e-5)
+    np.testing.assert_allclose(_np(s1), params[9], rtol=1e-6)
+    assert int(_np(old)[0]) == 3 and int(_np(num)[0]) == 1
+    avg = (_np(s1) + _np(s2) + _np(s3)) / (int(_np(num)[0])
+                                           + int(_np(old)[0]))
+    np.testing.assert_allclose(avg, np.mean(params[-4:], axis=0),
+                               rtol=1e-5)
+
+
+# ----------------------------------------------------------------- quant
+def test_fake_quantize_range_abs_max_window():
+    # reference: fake_quantize_op.cc:123 FindRangeAbsMaxFunctor
+    x1 = jnp.asarray(np.asarray([0.5, -2.0], "float32"))
+    out = run_op("fake_quantize_range_abs_max",
+                 {"X": [x1], "InScale": [jnp.asarray([0.0], "float32")],
+                  "Iter": [jnp.asarray([0], "int64")]},
+                 {"bit_length": 8, "window_size": 4})
+    # first step: scale = cur = 2.0
+    np.testing.assert_allclose(_np(out["OutScale"][0]), [2.0])
+    scales = out["OutScales"][0]
+    # a smaller batch keeps the window max
+    x2 = jnp.asarray(np.asarray([0.25], "float32"))
+    out2 = run_op("fake_quantize_range_abs_max",
+                  {"X": [x2], "InScale": [out["OutScale"][0]],
+                   "InScales": [scales],
+                   "Iter": [jnp.asarray([1], "int64")]},
+                  {"bit_length": 8, "window_size": 4})
+    np.testing.assert_allclose(_np(out2["OutScale"][0]), [2.0])
+    # quantization uses the window scale
+    q = _np(out2["Out"][0])
+    s = 2.0
+    want = np.clip(np.round(_np(x2) / s * 127), -127, 127) * s / 127
+    np.testing.assert_allclose(q, want, rtol=1e-6)
+    # is_test: InScale applies as-is
+    out3 = run_op("fake_quantize_range_abs_max",
+                  {"X": [x2], "InScale": [jnp.asarray([1.0], "float32")]},
+                  {"bit_length": 8, "is_test": True})
+    np.testing.assert_allclose(_np(out3["OutScale"][0]), [1.0])
+
+
+def test_fake_channel_wise_dequantize_max_abs():
+    # reference: fake_dequantize_op.cc:37 ChannelDequantizeFunctor
+    x = np.asarray([[127, -127], [64, 32]], "float32")
+    s = np.asarray([2.0, 4.0], "float32")
+    out = run_op("fake_channel_wise_dequantize_max_abs",
+                 {"X": [jnp.asarray(x)], "Scales": [jnp.asarray(s)]},
+                 {"quant_bits": [8]})
+    want = x * s[:, None] / 127.0
+    np.testing.assert_allclose(_np(out["Out"][0]), want, rtol=1e-6)
+    # two-scale activation path: scales[0] over dim 1 + scalar
+    s2 = np.asarray([3.0], "float32")
+    out = run_op("fake_channel_wise_dequantize_max_abs",
+                 {"X": [jnp.asarray(x)],
+                  "Scales": [jnp.asarray(s), jnp.asarray(s2)]},
+                 {"quant_bits": [8, 8]})
+    want = x * s[None, :] * 3.0 / (127.0 * 127.0)
+    np.testing.assert_allclose(_np(out["Out"][0]), want, rtol=1e-6)
+
+
+def test_dequantize_abs_max_and_log():
+    # reference: dequantize_abs_max_op.cc:23, dequantize_log_op.cc:24
+    x = np.asarray([127, -64, 0], "int8")
+    out = run_op("dequantize_abs_max",
+                 {"X": [jnp.asarray(x)],
+                  "Scale": [jnp.asarray([2.0], "float32")]},
+                 {"max_range": 127.0})
+    np.testing.assert_allclose(_np(out["Out"][0]),
+                               2.0 * x.astype("float32") / 127.0,
+                               rtol=1e-6)
+    table = np.linspace(0.0, 1.27, 128).astype("float32")
+    xq = np.asarray([3, -5, 0], "int8")
+    out = run_op("dequantize_log",
+                 {"X": [jnp.asarray(xq)], "Dict": [jnp.asarray(table)]},
+                 {})
+    want = np.asarray([table[3], -table[-5 + 128], table[0]],
+                      "float32")
+    np.testing.assert_allclose(_np(out["Out"][0]), want, rtol=1e-6)
+
+
+# ------------------------------------------------------------- detection
+def _boxes_scores():
+    boxes = np.asarray([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                         [20, 20, 30, 30], [40, 40, 50, 50]]],
+                       "float32")
+    scores = np.asarray([[  # [N=1, C=2, M=4]
+        [0.0, 0.0, 0.0, 0.0],               # class 0 = background
+        [0.9, 0.8, 0.7, 0.05]]], "float32")
+    return boxes, scores
+
+
+def test_multiclass_nms2_index():
+    # reference: multiclass_nms_op.cc:493 MultiClassNMS2 (+Index)
+    boxes, scores = _boxes_scores()
+    out = run_op("multiclass_nms2",
+                 {"BBoxes": [boxes], "Scores": [scores]},
+                 {"score_threshold": 0.1, "nms_threshold": 0.3,
+                  "nms_top_k": 10, "keep_top_k": 10,
+                  "background_label": 0})
+    got = _np(out["Out"][0])
+    idx = _np(out["Index"][0]).reshape(-1)
+    # box 1 suppressed by box 0 (IoU ~0.82); box 3 under score threshold
+    assert got.shape == (2, 6)
+    np.testing.assert_array_equal(idx, [0, 2])
+    np.testing.assert_allclose(got[:, 1], [0.9, 0.7])
+    # parity with multiclass_nms on Out
+    base = run_op("multiclass_nms",
+                  {"BBoxes": [boxes], "Scores": [scores]},
+                  {"score_threshold": 0.1, "nms_threshold": 0.3,
+                   "nms_top_k": 10, "keep_top_k": 10,
+                   "background_label": 0})
+    np.testing.assert_allclose(got, _np(base["Out"][0]))
+
+
+def test_matrix_nms_decay():
+    # reference: matrix_nms_op.cc:95 NMSMatrix (linear decay)
+    boxes, scores = _boxes_scores()
+    out = run_op("matrix_nms",
+                 {"BBoxes": [boxes], "Scores": [scores]},
+                 {"score_threshold": 0.1, "post_threshold": 0.0,
+                  "nms_top_k": -1, "keep_top_k": -1,
+                  "background_label": 0, "use_gaussian": False})
+    got = _np(out["Out"][0])
+    # nothing hard-suppressed: 3 detections, box 1 decayed by
+    # (1 - iou01) / (1 - 0) * 0.8
+    assert got.shape == (3, 6)
+    iou01 = 1.0 / (2 * 100.0 / 90.25 - 1.0)  # hand IoU of boxes 0,1
+    order = np.argsort(-got[:, 1])
+    np.testing.assert_allclose(got[:, 1].max(), 0.9)
+    decayed = 0.8 * (1.0 - iou01)
+    assert any(abs(got[i, 1] - decayed) < 1e-5 for i in range(3))
+    assert _np(out["RoisNum"][0]).tolist() == [3]
+
+
+def test_locality_aware_nms_merges():
+    # reference: locality_aware_nms_op.cc:88 PolyWeightedMerge — two
+    # consecutive overlapping boxes merge score-weighted, scores add
+    boxes = np.asarray([[[0, 0, 10, 10], [0, 0, 10, 10],
+                         [30, 30, 40, 40]]], "float32")
+    scores = np.asarray([[[0.6, 0.4, 0.8]]], "float32")  # [1, C=1, 3]
+    out = run_op("locality_aware_nms",
+                 {"BBoxes": [boxes], "Scores": [scores]},
+                 {"score_threshold": 0.01, "nms_threshold": 0.3,
+                  "nms_top_k": -1, "keep_top_k": -1,
+                  "background_label": -1})
+    got = _np(out["Out"][0])
+    assert got.shape == (2, 6)
+    merged = got[np.argmax(got[:, 1])]
+    np.testing.assert_allclose(merged[1], 1.0, rtol=1e-6)  # 0.6+0.4
+    np.testing.assert_allclose(merged[2:], [0, 0, 10, 10], atol=1e-5)
+
+
+def test_mine_hard_examples_max_negative():
+    # reference: mine_hard_examples_op.cc:52 (kMaxNegative)
+    cls_loss = np.asarray([[0.1, 0.9, 0.5, 0.3]], "float32")
+    match = np.asarray([[2, -1, -1, -1]], "int32")
+    dist = np.asarray([[0.8, 0.1, 0.2, 0.9]], "float32")
+    out = run_op("mine_hard_examples",
+                 {"ClsLoss": [cls_loss], "MatchIndices": [match],
+                  "MatchDist": [dist]},
+                 {"neg_pos_ratio": 2.0, "neg_dist_threshold": 0.5,
+                  "mining_type": "max_negative"})
+    # eligible: priors 1,2 (unmatched & dist<0.5); 1 positive * ratio 2
+    # keeps both, sorted index order
+    np.testing.assert_array_equal(
+        _np(out["NegIndices"][0]).reshape(-1), [1, 2])
+    np.testing.assert_array_equal(_np(out["NegIndicesLod"][0]), [0, 2])
+    np.testing.assert_array_equal(_np(out["UpdatedMatchIndices"][0]),
+                                  match)
+
+
+def test_mine_hard_examples_hard_example_erases_unselected():
+    # hard_example: top sample_size by loss; positives outside the
+    # selection get match erased
+    cls_loss = np.asarray([[0.1, 0.9, 0.5, 0.3]], "float32")
+    match = np.asarray([[2, -1, 0, -1]], "int32")
+    dist = np.zeros((1, 4), "float32")
+    out = run_op("mine_hard_examples",
+                 {"ClsLoss": [cls_loss], "MatchIndices": [match],
+                  "MatchDist": [dist]},
+                 {"sample_size": 2, "mining_type": "hard_example"})
+    # top-2 by loss: priors 1 (0.9) and 2 (0.5). Prior 2 is a positive
+    # -> stays matched, not a negative; prior 0 (positive, unselected)
+    # gets erased; negative list = [1]
+    np.testing.assert_array_equal(
+        _np(out["NegIndices"][0]).reshape(-1), [1])
+    upd = _np(out["UpdatedMatchIndices"][0])
+    assert upd[0, 0] == -1 and upd[0, 2] == 0
+
+
+def test_detection_map_integral_and_state():
+    # reference: detection_map_op.h:59 — one class, two images
+    # img0: 1 gt, detected correctly (score .9); img1: 1 gt, one hit
+    # (.8) one false positive (.7)
+    detect = np.asarray([
+        [1, 0.9, 0.1, 0.1, 0.4, 0.4],
+        [1, 0.8, 0.5, 0.5, 0.9, 0.9],
+        [1, 0.7, 0.0, 0.0, 0.05, 0.05],
+    ], "float32")
+    label = np.asarray([
+        [1, 0.1, 0.1, 0.4, 0.4],
+        [1, 0.5, 0.5, 0.9, 0.9],
+    ], "float32")
+    out = run_op("detection_map",
+                 {"DetectRes": [detect], "Label": [label],
+                  "DetectResLod": [np.asarray([0, 1, 3])],
+                  "LabelLod": [np.asarray([0, 1, 2])]},
+                 {"class_num": 2, "overlap_threshold": 0.5,
+                  "ap_type": "integral", "background_label": 0})
+    # precision at hits: 1/1 (r=.5), 1/1->2/2 (r=1.0), fp at .7
+    # integral AP = 1.0*(0.5) + 1.0*(0.5) = 1.0
+    np.testing.assert_allclose(_np(out["MAP"][0]), [1.0], atol=1e-6)
+    assert _np(out["AccumPosCount"][0])[1, 0] == 2
+    # feed the state back with one more image: a miss (fp only)
+    out2 = run_op(
+        "detection_map",
+        {"DetectRes": [np.asarray([[1, 0.95, 0, 0, 0.05, 0.05]],
+                                  "float32")],
+         "Label": [np.asarray([[1, 0.5, 0.5, 0.9, 0.9]], "float32")],
+         "HasState": [np.asarray([1], "int32")],
+         "PosCount": [out["AccumPosCount"][0]],
+         "TruePos": [out["AccumTruePos"][0]],
+         "TruePosLod": [out["AccumTruePosLod"][0]],
+         "FalsePos": [out["AccumFalsePos"][0]],
+         "FalsePosLod": [out["AccumFalsePosLod"][0]]},
+        {"class_num": 2, "overlap_threshold": 0.5,
+         "ap_type": "integral", "background_label": 0})
+    # now 3 positives, hits at ranks 2,3 of 4 detections
+    # precision: [0, 1/2, 2/3, 2/4], recall [0, 1/3, 2/3, 2/3]
+    want = 0.5 * (1 / 3) + (2 / 3) * (1 / 3)
+    np.testing.assert_allclose(_np(out2["MAP"][0]), [want], atol=1e-6)
+
+
+def test_detection_map_11point():
+    detect = np.asarray([[1, 0.9, 0.1, 0.1, 0.4, 0.4]], "float32")
+    label = np.asarray([[1, 0.1, 0.1, 0.4, 0.4]], "float32")
+    out = run_op("detection_map",
+                 {"DetectRes": [detect], "Label": [label]},
+                 {"class_num": 2, "overlap_threshold": 0.5,
+                  "ap_type": "11point", "background_label": 0})
+    # single perfect detection: precision 1 at recall 1 -> AP = 1
+    np.testing.assert_allclose(_np(out["MAP"][0]), [1.0], atol=1e-6)
+
+
+def test_generate_mask_labels_square_poly():
+    # reference: generate_mask_labels_op.cc:139 — one gt whose polygon
+    # is the left half of the roi; mask left half 1, right half 0
+    m = 8
+    poly = np.asarray([[0, 0], [5, 0], [5, 10], [0, 10]], "float32")
+    out = run_op(
+        "generate_mask_labels",
+        {"ImInfo": [np.asarray([[20, 20, 1.0]], "float32")],
+         "GtClasses": [np.asarray([1], "int32")],
+         "IsCrowd": [np.asarray([0], "int32")],
+         "GtSegms": [poly],
+         "GtSegmsPolyLod": [np.asarray([0, 1])],
+         "GtSegmsPointLod": [np.asarray([0, 4])],
+         "Rois": [np.asarray([[0, 0, 10, 10]], "float32")],
+         "LabelsInt32": [np.asarray([1], "int32")]},
+        {"num_classes": 3, "resolution": m})
+    mask = _np(out["MaskInt32"][0]).reshape(3, m, m)
+    # class 1 slot active, others ignore (-1)
+    assert (mask[0] == -1).all() and (mask[2] == -1).all()
+    got = mask[1]
+    assert (got[:, :3] == 1).all()      # left 3 cols well inside
+    assert (got[:, 5:] == 0).all()      # right cols outside
+    np.testing.assert_array_equal(
+        _np(out["RoiHasMaskInt32"][0]).reshape(-1), [0])
+    np.testing.assert_allclose(_np(out["MaskRois"][0]),
+                               [[0, 0, 10, 10]])
+
+
+def test_generate_mask_labels_no_fg():
+    m = 4
+    out = run_op(
+        "generate_mask_labels",
+        {"ImInfo": [np.asarray([[20, 20, 1.0]], "float32")],
+         "GtClasses": [np.asarray([1], "int32")],
+         "IsCrowd": [np.asarray([1], "int32")],   # crowd -> no gt mask
+         "GtSegms": [np.zeros((0, 2), "float32")],
+         "GtSegmsPolyLod": [np.asarray([0, 0])],
+         "GtSegmsPointLod": [np.asarray([0])],
+         "Rois": [np.asarray([[0, 0, 4, 4]], "float32")],
+         "LabelsInt32": [np.asarray([0], "int32")]},
+        {"num_classes": 2, "resolution": m})
+    assert (_np(out["MaskInt32"][0]) == -1).all()
+    assert _np(out["MaskRois"][0]).shape == (1, 4)
