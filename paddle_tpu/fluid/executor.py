@@ -74,6 +74,29 @@ class Executor:
         entry = self._cache.get(key) if use_program_cache else None
         if entry is not None:
             self._cache.move_to_end(key)
+        tail_n = None
+        if entry is None and use_program_cache:
+            # batch-tail bucketing (SURVEY §7 hard part (d); reference
+            # contract executor.cc:184 — any batch size runs without
+            # recompiling): if a cached bucket's batch is an integer
+            # multiple of this batch, replicate rows m times and run the
+            # CACHED executable. Row replication is exact for mean-type
+            # losses, their grads, and biased batch statistics (each row
+            # appears exactly m times), so the step matches the
+            # unbucketed one bit-for-bit up to fp reduction order; RNG
+            # ops sample per padded row (documented divergence).
+            # Non-divisible tails fall through to a one-time compile
+            # that the cache then amortizes across epochs.
+            hit = self._find_tail_bucket(program, feed_arrays,
+                                         fetch_names, scope)
+            if hit is not None:
+                bkey, m, tail_n, rep_names = hit
+                entry = self._cache[bkey]
+                self._cache.move_to_end(bkey)
+                feed_arrays = {
+                    n: (np.concatenate([a] * m, axis=0)
+                        if n in rep_names else a)
+                    for n, a in feed_arrays.items()}
         if entry is None:
             state_in, _ = lowering.analyze_block(
                 block, list(feed_arrays), fetch_names)
@@ -115,6 +138,18 @@ class Executor:
                                            np.uint32(seed % (2**31)))
         for n, v in new_states.items():
             scope.set_var(n, v)
+        if tail_n is not None:
+            # un-replicate batch-majored fetches (leading program dim -1
+            # marks the batch axis; fixed-shape fetches pass through)
+            sliced = []
+            for fname, v in zip(fetch_names, fetches):
+                fv = block._find_var_recursive(fname)
+                shp = tuple(getattr(fv, "shape", ()) or ()) if fv is not None \
+                    else ()
+                if shp[:1] == (-1,) and getattr(v, "ndim", 0) >= 1:
+                    v = v[:tail_n]
+                sliced.append(v)
+            fetches = sliced
 
         from ..utils.flags import get_flag
 
@@ -214,6 +249,101 @@ class Executor:
             sh = NamedSharding(entry.mesh, P(entry.dp_axis))
             out[n] = jax.device_put(a, sh)
         return out
+
+    def _find_tail_bucket(self, program, feed_arrays, fetch_names, scope):
+        """Most-recent cached entry whose batch is an integer multiple of
+        this feed's batch: returns (key, multiple, tail_batch,
+        names_to_replicate) or None. A feed participates either
+        identically (same shape, e.g. a constant side input) or
+        replicated (same trailing dims, bucket batch = m * tail batch,
+        one shared m). `.lod` offset feeds never bucket — offsets would
+        need rebuilding, and ragged data already buckets at the dataset
+        tier (fluid/dataset.py)."""
+        from ..utils.flags import get_flag
+
+        if not get_flag("FLAGS_batch_tail_bucketing", True):
+            return None
+        if not self._tail_bucket_safe(program):
+            return None
+        want_prefix = (program._uid, program._version)
+        want_suffix = (tuple(fetch_names), getattr(scope, "_uid", 0))
+        names = sorted(feed_arrays)
+        for key in reversed(self._cache):
+            if key[:2] != want_prefix or key[3:] != want_suffix:
+                continue
+            cached = {n: (shape, dt) for n, shape, dt in key[2]}
+            if sorted(cached) != names:
+                continue
+            m = None
+            rep = set()
+            ok = True
+            for n in names:
+                a = feed_arrays[n]
+                cshape, cdt = cached[n]
+                if cdt != str(a.dtype):
+                    ok = False
+                    break
+                if cshape == a.shape:
+                    continue  # constant side input
+                if (n.endswith(".lod") or not a.ndim
+                        or cshape[1:] != a.shape[1:] or not a.shape[0]
+                        or cshape[0] % a.shape[0]):
+                    ok = False
+                    break
+                this_m = cshape[0] // a.shape[0]
+                max_m = int(get_flag("FLAGS_batch_tail_max_multiple", 8)
+                            or 8)
+                # cap the replication factor: beyond it, compiling the
+                # tail's own executable is cheaper than permanently
+                # paying m-times the FLOPs per step
+                if this_m < 2 or this_m > max_m \
+                        or (m is not None and this_m != m):
+                    ok = False
+                    break
+                m = this_m
+                rep.add(n)
+            if ok and m is not None:
+                tails = {feed_arrays[n].shape[0] for n in rep}
+                if len(tails) == 1:  # one shared batch axis extent
+                    return key, m, tails.pop(), rep
+        return None
+
+    def _tail_bucket_safe(self, program):
+        """Row replication is exact only for replication-invariant
+        programs: a FORWARD op that sum/prod-collapses the batch axis
+        (reduce_sum over dim 0 / all dims on a batch-majored var) scales
+        by the multiple m, so such programs never bucket. Mean/max/min
+        collapses and the grad ops of a mean-type loss are invariant
+        (each row appears exactly m times and the 1/B normalization uses
+        the padded B)."""
+        cached = getattr(program, "_tail_bucket_safe_cache", None)
+        if cached is not None and cached[0] == program._version:
+            return cached[1]
+        unsafe_types = {"reduce_sum", "reduce_prod"}
+        safe = True
+        block = program.global_block()
+        for op in block.ops:
+            if op.type not in unsafe_types:
+                continue
+            dims = op.attrs.get("dim", op.attrs.get("axis", None))
+            if isinstance(dims, int):
+                dims = [dims]
+            if dims and 0 not in dims:
+                continue  # reduces non-batch axes only
+            for slot_vars in op.input_names.values():
+                for vn in slot_vars:
+                    v = block._find_var_recursive(vn)
+                    shp = tuple(getattr(v, "shape", ()) or ()) \
+                        if v is not None else ()
+                    if shp[:1] == (-1,):
+                        safe = False
+                        break
+                if not safe:
+                    break
+            if not safe:
+                break
+        program._tail_bucket_safe_cache = (program._version, safe)
+        return safe
 
     def _cache_key(self, program, feed_arrays, fetch_names, scope):
         feed_key = tuple(sorted(

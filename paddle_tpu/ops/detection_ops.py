@@ -441,8 +441,13 @@ def _locality_aware_nms(ins, attrs):
                 if index > -1:
                     iou = _np_iou_pair(aabb(bb[i]), aabb(bb[index]))
                     if iou > nms_threshold:
-                        bb[index] = (bb[i] * ss[i] + bb[index]
-                                     * ss[index]) / (ss[i] + ss[index])
+                        # score-weighted merge (PolyWeightedMerge); the
+                        # zero-sum guard avoids the reference's 0/0 NaN
+                        # when two zero-score (padded) boxes overlap
+                        tot = ss[i] + ss[index]
+                        if tot > 0:
+                            bb[index] = (bb[i] * ss[i]
+                                         + bb[index] * ss[index]) / tot
                         ss[index] += ss[i]
                     else:
                         skip[index] = False
@@ -500,14 +505,13 @@ def _matrix_nms(ins, attrs):
             m = len(perm)
             if m == 0:
                 continue
-            ious = np.zeros((m, m), np.float32)
-            for i in range(1, m):
-                for j in range(i):
-                    ious[i, j] = _np_iou_pair(boxes[b, perm[i]],
-                                              boxes[b, perm[j]])
-            iou_max = np.zeros(m, np.float32)
-            for i in range(1, m):
-                iou_max[i] = ious[i, :i].max()
+            from .detection_extra_ops import _np_iou_xyxy
+
+            sel = boxes[b, perm]
+            # strictly-lower-triangular pairwise IoU: row i holds
+            # iou(i, j<i); row max = reference iou_max[i] (IoUs >= 0)
+            ious = np.tril(_np_iou_xyxy(sel, sel), k=-1)
+            iou_max = ious.max(axis=1)
             if s[perm[0]] > post_threshold:
                 cand.append((float(s[perm[0]]), cls, int(perm[0])))
             for i in range(1, m):

@@ -331,7 +331,10 @@ def _average_accumulates(ins, attrs):
     old = ins["in_old_num_accumulates"][0].reshape(()).astype(jnp.int64)
     upd = ins["in_num_updates"][0].reshape(()).astype(jnp.int64)
     avg_win = attrs.get("average_window", 0.0)
-    max_win = attrs.get("max_average_window", 2 ** 62)
+    # int32-safe "unbounded" default: jnp would overflow on 2**62 with
+    # x64 disabled (the repo default)
+    max_win = min(int(attrs.get("max_average_window", 2 ** 31 - 1)),
+                  2 ** 31 - 1)
     min_win = attrs.get("min_average_window", 10000)
     k_max_acc = 16384  # reference kMaxNumAccumulates
 
@@ -343,8 +346,8 @@ def _average_accumulates(ins, attrs):
     s1 = jnp.where(shuffle, jnp.zeros_like(s1), s1)
 
     thresh = jnp.minimum(
-        jnp.int64(max_win),
-        (upd.astype(jnp.float32) * avg_win).astype(jnp.int64))
+        jnp.asarray(max_win, num.dtype),
+        (upd.astype(jnp.float32) * avg_win).astype(num.dtype))
     rotate = (num >= min_win) & (num >= thresh)
     s3 = jnp.where(rotate, s1 + s2, s3)
     s1 = jnp.where(rotate, jnp.zeros_like(s1), s1)

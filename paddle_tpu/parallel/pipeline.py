@@ -14,9 +14,11 @@ float32 ring buffer. Gradients come from `jax.grad` straight through the
 scanned ppermute loop (XLA transposes the permute), so microbatch gradient
 accumulation is exact GPipe: loss and grads match the non-pipelined program.
 
-Limitations (v1, documented): forward-section state updates (e.g. BN
-running stats) and non-float boundary activations are not supported in
-pipeline mode; gradients are produced for parameters (not leaf feeds).
+v2 capabilities: forward-section state updates (BN running stats) are
+carried per owning stage, and boundary activations may be float32 or
+int32 (dtype-tagged ring buffer). Remaining limits (documented cut
+constraints): a stateful var updated by two different stages raises, and
+gradients are produced for parameters (not leaf feeds).
 """
 from __future__ import annotations
 
