@@ -28,6 +28,7 @@ from ..parallel import env as penv
 from .role_maker import (  # noqa: F401
     RoleMakerBase, PaddleCloudRoleMaker, UserDefinedRoleMaker, Role,
 )
+from . import metrics  # noqa: F401  (fleet.metrics.* helpers)
 
 
 class DistributedStrategy:
@@ -78,43 +79,124 @@ class DistributedStrategy:
         return {k: v for k, v in vars(self).items()
                 if not k.startswith("_")}
 
+    @staticmethod
+    def _proto_scalar(v):
+        """One scalar in protobuf TEXT format (lowercase bools,
+        double-quoted strings with C escapes, plain numbers) — the
+        format the reference's protobuf-backed strategy writes
+        (distributed_strategy.proto:25-81)."""
+        if isinstance(v, bool):
+            return "true" if v else "false"
+        if isinstance(v, str):
+            return '"%s"' % (v.replace("\\", "\\\\").replace('"', '\\"')
+                             .replace("\n", "\\n"))
+        return repr(v)
+
     def save_to_prototxt(self, path):
-        """Text-format dump: scalar knobs as `name: value`, config dicts
-        as nested `name { key: value }` blocks — the same shape the
-        reference's protobuf text format has, so saved strategies are
-        human-diffable."""
+        """Real protobuf text format: scalar knobs as `name: value`,
+        lists as REPEATED `name: value` lines, config dicts as nested
+        `name { ... }` blocks. A prototxt written here parses with
+        protobuf's own text_format against the reference's
+        DistributedStrategy message field set, and vice versa."""
         lines = []
         for k, v in sorted(self._fields().items()):
             if isinstance(v, dict):
                 lines.append("%s {" % k)
                 for ck, cv in sorted(v.items()):
-                    lines.append("  %s: %r" % (ck, cv))
+                    if isinstance(cv, (list, tuple)):
+                        for item in cv:
+                            lines.append("  %s: %s"
+                                         % (ck, self._proto_scalar(item)))
+                    else:
+                        lines.append("  %s: %s"
+                                     % (ck, self._proto_scalar(cv)))
                 lines.append("}")
+            elif isinstance(v, (list, tuple)):
+                for item in v:
+                    lines.append("%s: %s" % (k, self._proto_scalar(item)))
             else:
-                lines.append("%s: %r" % (k, v))
+                lines.append("%s: %s" % (k, self._proto_scalar(v)))
         with open(path, "w") as f:
             f.write("\n".join(lines) + "\n")
 
-    def load_from_prototxt(self, path):
+    @staticmethod
+    def _parse_scalar(tok):
+        """Protobuf text scalar -> python; legacy round-2 files wrote
+        Python reprs (True, 'str'), still accepted as a fallback."""
         import ast as _ast
 
+        tok = tok.strip()
+        if tok == "true":
+            return True
+        if tok == "false":
+            return False
+        if tok.startswith('"') and tok.endswith('"'):
+            body = tok[1:-1]
+            # left-to-right unescape: replace-chains corrupt strings
+            # holding a literal backslash before 'n' (code-review r4)
+            out, i = [], 0
+            esc = {"n": "\n", '"': '"', "\\": "\\", "t": "\t"}
+            while i < len(body):
+                if body[i] == "\\" and i + 1 < len(body):
+                    out.append(esc.get(body[i + 1],
+                                       "\\" + body[i + 1]))
+                    i += 2
+                else:
+                    out.append(body[i])
+                    i += 1
+            return "".join(out)
+        try:
+            return _ast.literal_eval(tok)
+        except (ValueError, SyntaxError):
+            return tok  # bare enum-style token
+
+    def load_from_prototxt(self, path):
         with open(path) as f:
-            lines = [ln.rstrip() for ln in f if ln.strip()]
+            lines = [ln.rstrip() for ln in f
+                     if ln.strip() and not ln.strip().startswith("#")]
         i = 0
+        seen_scalars = set()
         while i < len(lines):
             ln = lines[i].strip()
             if ln.endswith("{"):
                 name = ln[:-1].strip()
-                block = {}
+                # merge into the default config dict: keys absent from
+                # the file keep their defaults (proto unset-field
+                # semantics), and a key whose DEFAULT is a list stays a
+                # list even with one occurrence (repeated field)
+                base = getattr(self, name, None)
+                block = dict(base) if isinstance(base, dict) else {}
+                repeated = {k for k, v in block.items()
+                            if isinstance(v, list)}
+                seen_block = set()
                 i += 1
                 while i < len(lines) and lines[i].strip() != "}":
                     ck, cv = lines[i].strip().split(":", 1)
-                    block[ck.strip()] = _ast.literal_eval(cv.strip())
+                    ck = ck.strip()
+                    val = self._parse_scalar(cv)
+                    if ck in seen_block:
+                        prev = block[ck]
+                        block[ck] = (prev if isinstance(prev, list)
+                                     else [prev]) + [val]
+                    else:
+                        block[ck] = [val] if ck in repeated else val
+                        seen_block.add(ck)
                     i += 1
                 setattr(self, name, block)
             else:
                 k, v = ln.split(":", 1)
-                setattr(self, k.strip(), _ast.literal_eval(v.strip()))
+                k = k.strip()
+                val = self._parse_scalar(v)
+                if k in seen_scalars:
+                    prev = getattr(self, k)
+                    setattr(self, k, (prev if isinstance(prev, list)
+                                      else [prev]) + [val])
+                elif isinstance(getattr(self, k, None), list):
+                    setattr(self, k, [val])  # repeated w/ 1 occurrence
+                    seen_scalars.add(k)
+                else:
+                    setattr(self, k, val)
+                    seen_scalars.add(k)
             i += 1
         return self
 

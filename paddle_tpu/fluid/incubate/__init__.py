@@ -1,0 +1,2 @@
+"""fluid.incubate namespace (reference: python/paddle/fluid/incubate)."""
+from . import data_generator  # noqa: F401

@@ -1,0 +1,202 @@
+"""User-facing dataset-file authoring API for dataset/PS training.
+
+Reference parity: `python/paddle/fluid/incubate/data_generator/
+__init__.py:1` — DataGenerator / MultiSlotDataGenerator /
+MultiSlotStringDataGenerator. A user subclass overrides
+`generate_sample(line)` (and optionally `generate_batch`); `run_from_
+stdin` / `run_from_memory` emit the MultiSlot text line format
+(`<ids_num> <id> ...` per slot) that the native feed parser consumes
+(core/native/src/data_feed.cc), so generator-authored files train
+through `Executor.train_from_dataset`.
+"""
+from __future__ import annotations
+
+import sys
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+
+class DataGenerator:
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+        self._line_limit = None
+
+    def _set_line_limit(self, line_limit):
+        if not isinstance(line_limit, int):
+            raise ValueError("line_limit %s must be in int type"
+                             % type(line_limit))
+        if line_limit < 1:
+            raise ValueError("line_limit can not less than 1")
+        self._line_limit = line_limit
+
+    def set_batch(self, batch_size):
+        """Batch size used by generate_batch grouping."""
+        self.batch_size_ = batch_size
+
+    # -- user overrides ---------------------------------------------------
+    def generate_sample(self, line):
+        """Override: map one raw input line (or None for run_from_memory)
+        to an iterator factory yielding [(slot_name, [values...]), ...]."""
+        raise NotImplementedError(
+            "generate_sample() must be overridden (return a local_iter "
+            "function yielding [(name, [feasign, ...]), ...])")
+
+    def generate_batch(self, samples):
+        """Override optionally: batch-level post-processing; default
+        passes every sample through unchanged."""
+
+        def local_iter():
+            for sample in samples:
+                yield sample
+
+        return local_iter
+
+    # -- drivers ----------------------------------------------------------
+    def _emit(self, sample, out):
+        out.write(self._gen_str(sample))
+
+    def _flush_batch(self, batch_samples, out):
+        batch_iter = self.generate_batch(batch_samples)
+        for sample in batch_iter():
+            if sample is not None:
+                self._emit(sample, out)
+
+    def run_from_memory(self, out=None):
+        """Drive generate_sample(None) until exhausted (debug/bench)."""
+        out = out or sys.stdout
+        batch = []
+        for sample in self.generate_sample(None)():
+            if sample is None:
+                continue
+            batch.append(sample)
+            if len(batch) == self.batch_size_:
+                self._flush_batch(batch, out)
+                batch = []
+        if batch:
+            self._flush_batch(batch, out)
+
+    def run_from_stdin(self, stdin=None, out=None):
+        """Per-line protocol the C++ pipe-command reader drives: each
+        stdin line maps through generate_sample to slot lines."""
+        stdin = stdin or sys.stdin
+        out = out or sys.stdout
+        batch = []
+        n = 0
+        for line in stdin:
+            for sample in self.generate_sample(line)():
+                if sample is None:
+                    continue
+                batch.append(sample)
+                if len(batch) == self.batch_size_:
+                    self._flush_batch(batch, out)
+                    batch = []
+            n += 1
+            if self._line_limit and n >= self._line_limit:
+                break
+        if batch:
+            self._flush_batch(batch, out)
+
+    def generate_file(self, in_path, out_path):
+        """Convenience wrapper: author `out_path` from raw `in_path`
+        (the subprocess-free equivalent of `cat in | python gen.py`)."""
+        with open(in_path) as fin, open(out_path, "w") as fout:
+            self.run_from_stdin(stdin=fin, out=fout)
+
+    def _gen_str(self, line):
+        raise NotImplementedError(
+            "use MultiSlotDataGenerator or MultiSlotStringDataGenerator")
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    def _gen_str(self, line):
+        """[(name, [v, ...]), ...] -> `n v1 .. vn` per slot, one sample
+        per line (reference: data_generator/__init__.py:283; consumed by
+        data_feed.cc's MultiSlot parser). Also accumulates _proto_info =
+        [(name, type), ...] and enforces a stable slot schema."""
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "the output of process() must be in list or tuple type"
+                "Examples: [('words', [1926, 08, 17]), ('label', [1])]")
+        output = ""
+        if self._proto_info is None:
+            self._proto_info = []
+            for item in line:
+                name, elements = item
+                if not isinstance(name, str):
+                    raise ValueError("name%s must be in str type"
+                                     % type(name))
+                if not isinstance(elements, list):
+                    raise ValueError("elements%s must be in list type"
+                                     % type(elements))
+                if not elements:
+                    raise ValueError(
+                        "the elements of each field can not be empty, "
+                        "you need padding it in process().")
+                self._proto_info.append((name, "uint64"))
+                if output:
+                    output += " "
+                output += str(len(elements))
+                for elem in elements:
+                    if isinstance(elem, float):
+                        self._proto_info[-1] = (name, "float")
+                    elif not isinstance(elem, int):
+                        raise ValueError(
+                            "the type of element%s must be in int or "
+                            "float" % type(elem))
+                    output += " " + str(elem)
+        else:
+            if len(line) != len(self._proto_info):
+                raise ValueError(
+                    "the complete field set of two given line are "
+                    "inconsistent.")
+            for index, item in enumerate(line):
+                name, elements = item
+                if name != self._proto_info[index][0]:
+                    raise ValueError(
+                        "the field name of two given line are not match: "
+                        "require<%s>, get<%s>."
+                        % (self._proto_info[index][0], name))
+                if output:
+                    output += " "
+                output += str(len(elements))
+                for elem in elements:
+                    if self._proto_info[index][1] != "float":
+                        if isinstance(elem, float):
+                            self._proto_info[index] = (name, "float")
+                        elif not isinstance(elem, int):
+                            raise ValueError(
+                                "the type of element%s must be in int "
+                                "or float" % type(elem))
+                    output += " " + str(elem)
+        return output + "\n"
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    def _gen_str(self, line):
+        """[(name, [str, ...]), ...] -> `n s1 .. sn` per slot
+        (reference: data_generator/__init__.py:242)."""
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "the output of process() must be in list or tuple type"
+                "Examples: [('words', ['1926', '08', '17']), "
+                "('label', ['1'])]")
+        output = ""
+        for item in line:
+            name, elements = item
+            if not isinstance(name, str):
+                raise ValueError("name%s must be in str type" % type(name))
+            if not isinstance(elements, list):
+                raise ValueError("elements%s must be in list type"
+                                 % type(elements))
+            if output:
+                output += " "
+            output += str(len(elements))
+            for elem in elements:
+                if not isinstance(elem, str):
+                    raise ValueError(
+                        "the type of element%s must be in str type"
+                        % type(elem))
+                output += " " + elem
+        return output + "\n"

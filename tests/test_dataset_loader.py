@@ -207,3 +207,91 @@ def test_generator_loader_prefetch():
     assert len(got) == 6
     assert set(got[0]) == {"a", "b"}
     assert got[3]["a"][0, 0] == 3
+
+
+def test_data_generator_authored_file_trains(tmp_path):
+    """incubate.data_generator (VERDICT r3 missing #6): a
+    MultiSlotDataGenerator-authored file feeds train_from_dataset
+    through the native MultiSlot parser (data_feed.cc)."""
+    import io
+
+    from paddle_tpu.fluid.incubate.data_generator import \
+        MultiSlotDataGenerator
+
+    class MyGen(MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def local_iter():
+                i = int(line.strip())
+                feats = [float(i + k * 0.1) for k in range(4)]
+                yield [("x", feats), ("y", [i % 10])]
+
+            return local_iter
+
+    raw = os.path.join(str(tmp_path), "raw.txt")
+    with open(raw, "w") as f:
+        for i in range(32):
+            f.write("%d\n" % i)
+    out_path = os.path.join(str(tmp_path), "slots.txt")
+    gen = MyGen()
+    gen.generate_file(raw, out_path)
+    # slot line format: "4 <f> <f> <f> <f> 1 <label>"
+    first = open(out_path).readline().split()
+    assert first[0] == "4" and first[5] == "1"
+    assert gen._proto_info == [("x", "float"), ("y", "uint64")]
+
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="int64")
+            h = fluid.layers.fc(x, 16, act="relu")
+            pred = fluid.layers.fc(h, 10)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(pred, y))
+            fluid.optimizer.SGDOptimizer(0.01).minimize(loss)
+
+            ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+            ds.set_batch_size(8)
+            ds.set_thread(1)
+            ds.set_filelist([out_path])
+            ds.set_use_var([x, y])
+
+            exe = fluid.Executor()
+            exe.run(startup)
+            out = exe.train_from_dataset(main, ds,
+                                         fetch_list=[loss.name])
+            assert np.isfinite(float(np.asarray(out[0]).reshape(-1)[0]))
+
+
+def test_multi_slot_string_data_generator_stdin(tmp_path):
+    import io
+
+    from paddle_tpu.fluid.incubate.data_generator import \
+        MultiSlotStringDataGenerator
+
+    class SGen(MultiSlotStringDataGenerator):
+        def generate_sample(self, line):
+            def local_iter():
+                toks = line.split()
+                yield [("words", toks), ("label", [toks[0]])]
+
+            return local_iter
+
+    g = SGen()
+    out = io.StringIO()
+    g.run_from_stdin(stdin=io.StringIO("7 8 9\n4 5\n"), out=out)
+    lines = out.getvalue().strip().splitlines()
+    assert lines[0] == "3 7 8 9 1 7"
+    assert lines[1] == "2 4 5 1 4"
+
+
+def test_data_generator_schema_mismatch_raises():
+    from paddle_tpu.fluid.incubate.data_generator import \
+        MultiSlotDataGenerator
+
+    g = MultiSlotDataGenerator()
+    g._gen_str([("a", [1]), ("b", [2])])
+    with pytest.raises(ValueError, match="not match"):
+        g._gen_str([("a", [1]), ("c", [2])])
+    with pytest.raises(ValueError, match="inconsistent"):
+        g._gen_str([("a", [1])])
