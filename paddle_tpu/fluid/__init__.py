@@ -46,6 +46,7 @@ from .reader import DataLoader, BatchSampler  # noqa: F401
 from . import dataset  # noqa: F401
 from .dataset import DatasetFactory, InMemoryDataset, QueueDataset  # noqa: F401
 from . import metrics  # noqa: F401
+from . import contrib  # noqa: F401
 from . import incubate  # noqa: F401
 from . import profiler  # noqa: F401
 from . import checkpoint  # noqa: F401

@@ -1,5 +1,6 @@
 """fluid.contrib (reference: `python/paddle/fluid/contrib/`)."""
 from . import mixed_precision  # noqa: F401
+from . import layers  # noqa: F401
 from . import model_stats  # noqa: F401
 from . import slim  # noqa: F401
 from . import extend_optimizer  # noqa: F401

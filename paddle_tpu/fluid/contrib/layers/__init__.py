@@ -1,0 +1,2 @@
+"""fluid.contrib.layers (reference: python/paddle/fluid/contrib/layers)."""
+from .nn import *  # noqa: F401,F403

@@ -1,0 +1,236 @@
+"""contrib layer builders (reference:
+`python/paddle/fluid/contrib/layers/nn.py`) — wrappers over the
+specialty/text-matching/TDM op family."""
+from __future__ import annotations
+
+from ...layer_helper import LayerHelper, apply_op
+
+__all__ = [
+    "fused_elemwise_activation", "var_conv_2d", "match_matrix_tensor",
+    "sequence_topk_avg_pooling", "tree_conv", "fused_embedding_seq_pool",
+    "multiclass_nms2", "search_pyramid_hash", "shuffle_batch",
+    "partial_concat", "partial_sum", "tdm_child", "tdm_sampler",
+    "rank_attention", "batch_fc",
+]
+
+
+def _one(op, inputs, attrs, slot="Out", dtype=None):
+    return apply_op(op, op, inputs, attrs, [slot], out_dtype=dtype)[0]
+
+
+def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
+                              save_intermediate_out=True):
+    return _one("fused_elemwise_activation", {"X": [x], "Y": [y]},
+                {"functor_list": list(functor_list), "axis": axis,
+                 "scale": scale})
+
+
+def var_conv_2d(input, row, col, input_channel, output_channel,
+                filter_size, stride=1, param_attr=None, act=None,
+                dtype="float32", name=None):
+    """Reference: contrib/layers/nn.py:106 — creates the
+    [output_channel, filter_size^2] filter parameter W."""
+    from ...initializer import XavierInitializer
+
+    helper = LayerHelper("var_conv_2d")
+    w = helper.create_parameter(
+        attr=param_attr,
+        shape=[output_channel, filter_size * filter_size], dtype=dtype,
+        default_initializer=XavierInitializer())
+    return _one("var_conv_2d",
+                {"X": [input], "ROW": [row], "COLUMN": [col], "W": [w]},
+                {"input_channel": input_channel,
+                 "output_channel": output_channel,
+                 "kernel_h": filter_size, "kernel_w": filter_size,
+                 "stride_h": stride, "stride_w": stride})
+
+
+def match_matrix_tensor(x, y, channel_num, act=None, param_attr=None,
+                        dtype="float32", name=None, x_lod=None,
+                        y_lod=None):
+    """Reference: contrib/layers/nn.py:223 — learns W [dim_in,
+    channel_num, dim_in]; returns (out, tmp). Padded-representation
+    note: ragged batches pass their sequence offsets through the
+    x_lod/y_lod vars (the reference carries them as LoD on x/y);
+    without them the whole batch is ONE sequence pair."""
+    from ...initializer import XavierInitializer
+
+    helper = LayerHelper("match_matrix_tensor")
+    dim_in = x.shape[-1]
+    w = helper.create_parameter(
+        attr=param_attr, shape=[dim_in, channel_num, dim_in],
+        dtype=dtype, default_initializer=XavierInitializer())
+    ins = {"X": [x], "Y": [y], "W": [w]}
+    if x_lod is not None:
+        ins["XLod"] = [x_lod]
+    if y_lod is not None:
+        ins["YLod"] = [y_lod]
+    outs = apply_op("match_matrix_tensor", "match_matrix_tensor",
+                    ins, {"dim_t": channel_num}, ["Out", "Tmp"])
+    return outs[0], outs[1]
+
+
+def sequence_topk_avg_pooling(input, row, col, topks, channel_num,
+                              x_lod=None):
+    """Reference: contrib/layers/nn.py:310. Padded-representation
+    note: the reference's ROW/COLUMN are LoDTensors whose LoD (not
+    data) carries the per-pair matrix extents; here `row`/`col` ARE
+    the offset vectors ([0, r0, r0+r1, ...]), and x_lod optionally
+    carries X's own offsets."""
+    ins = {"X": [input], "ROWLod": [row], "COLUMNLod": [col]}
+    if x_lod is not None:
+        ins["XLod"] = [x_lod]
+    outs = apply_op("sequence_topk_avg_pooling",
+                    "sequence_topk_avg_pooling", ins,
+                    {"topks": list(topks), "channel_num": channel_num},
+                    ["Out", "pos"])
+    return outs[0]
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+              name=None):
+    """Reference: contrib/layers/nn.py:378 — creates the
+    [feature, 3, output_size, num_filters] Filter parameter."""
+    from ...initializer import XavierInitializer
+
+    helper = LayerHelper("tree_conv")
+    feature = nodes_vector.shape[-1]
+    filt = helper.create_parameter(
+        attr=param_attr,
+        shape=[feature, 3, output_size, num_filters], dtype="float32",
+        default_initializer=XavierInitializer())
+    return _one("tree_conv",
+                {"NodesVector": [nodes_vector], "EdgeSet": [edge_set],
+                 "Filter": [filt]},
+                {"output_size": output_size, "num_filters": num_filters,
+                 "max_depth": max_depth, "act": act})
+
+
+def fused_embedding_seq_pool(input, size, is_sparse=False,
+                             padding_idx=None, combiner="sum",
+                             param_attr=None, dtype="float32"):
+    from ...initializer import XavierInitializer
+
+    helper = LayerHelper("fused_embedding_seq_pool")
+    w = helper.create_parameter(attr=param_attr, shape=list(size),
+                                dtype=dtype,
+                                default_initializer=XavierInitializer())
+    return _one("fused_embedding_seq_pool", {"Ids": [input], "W": [w]},
+                {"combiner": combiner, "is_sparse": is_sparse,
+                 "padding_idx": padding_idx
+                 if padding_idx is not None else -1})
+
+
+def multiclass_nms2(*args, **kwargs):
+    from ...layers.detection import multiclass_nms2 as _impl
+
+    return _impl(*args, **kwargs)
+
+
+def search_pyramid_hash(input, num_emb, space_len, pyramid_layer,
+                        rand_len, drop_out_percent, is_training,
+                        use_filter, white_list_len, black_list_len,
+                        seed, lr, param_attr=None, param_attr_wl=None,
+                        param_attr_bl=None, name=None,
+                        distribute_update_vars=None, dtype="float32"):
+    """Reference: contrib/layers/nn.py:645 (op name pyramid_hash) —
+    creates the [space_len + rand_len, rand_len] hash embedding W."""
+    from ...initializer import XavierInitializer
+
+    helper = LayerHelper("search_pyramid_hash")
+    w = helper.create_parameter(
+        attr=param_attr, shape=[space_len + rand_len, rand_len],
+        dtype=dtype, default_initializer=XavierInitializer())
+    return _one("pyramid_hash", {"X": [input], "W": [w]},
+                {"num_emb": num_emb, "space_len": space_len,
+                 "pyramid_layer": pyramid_layer, "rand_len": rand_len,
+                 "drop_out_percent": drop_out_percent,
+                 "is_training": is_training, "seed": seed, "lr": lr})
+
+
+def shuffle_batch(x, seed=None):
+    return _one("shuffle_batch", {"X": [x]},
+                {"startup_seed": seed if seed is not None else 0})
+
+
+def partial_concat(input, start_index=0, length=-1):
+    ins = input if isinstance(input, (list, tuple)) else [input]
+    return _one("partial_concat", {"X": list(ins)},
+                {"start_index": start_index, "length": length})
+
+
+def partial_sum(input, start_index=0, length=-1):
+    ins = input if isinstance(input, (list, tuple)) else [input]
+    return _one("partial_sum", {"X": list(ins)},
+                {"start_index": start_index, "length": length})
+
+
+def tdm_child(x, node_nums, child_nums, param_attr=None, dtype="int32"):
+    """Reference: contrib/layers/nn.py:942 — the tree-info table is a
+    (frozen) parameter of shape [node_nums, 3 + child_nums]."""
+    helper = LayerHelper("tdm_child")
+    tree_info = helper.create_parameter(
+        attr=param_attr, shape=[node_nums, 3 + child_nums],
+        dtype="int64")
+    tree_info.trainable = False
+    outs = apply_op("tdm_child", "tdm_child",
+                    {"X": [x], "TreeInfo": [tree_info]},
+                    {"child_nums": child_nums},
+                    ["Child", "LeafMask"])
+    return outs[0], outs[1]
+
+
+def tdm_sampler(x, neg_samples_num_list, layer_node_num_list,
+                leaf_node_num, tree_travel_attr=None, tree_layer_attr=None,
+                output_positive=True, output_list=True, seed=0,
+                tree_dtype="int64", dtype="int64"):
+    """Reference: contrib/layers/nn.py:1027 — Travel/Layer tables are
+    (frozen) parameters; layer_offset_lod derives from
+    layer_node_num_list."""
+    helper = LayerHelper("tdm_sampler")
+    layer_nums = len(neg_samples_num_list)
+    layer_offset = [0]
+    for n in layer_node_num_list:
+        layer_offset.append(layer_offset[-1] + int(n))
+    travel = helper.create_parameter(
+        attr=tree_travel_attr, shape=[leaf_node_num, layer_nums],
+        dtype="int64")
+    travel.trainable = False
+    layer = helper.create_parameter(
+        attr=tree_layer_attr, shape=[layer_offset[-1], 1], dtype="int64")
+    layer.trainable = False
+    outs = apply_op("tdm_sampler", "tdm_sampler",
+                    {"X": [x], "Travel": [travel], "Layer": [layer]},
+                    {"neg_samples_num_list": list(neg_samples_num_list),
+                     "layer_offset_lod": layer_offset,
+                     "output_positive": output_positive, "seed": seed},
+                    ["Out", "Labels", "Mask"])
+    return outs[0], outs[1], outs[2]
+
+
+def rank_attention(input, rank_offset, rank_param_shape,
+                   rank_param_attr=None, max_rank=3, max_size=0):
+    from ...initializer import XavierInitializer
+
+    helper = LayerHelper("rank_attention")
+    rank_param = helper.create_parameter(
+        attr=rank_param_attr, shape=rank_param_shape, dtype="float32",
+        default_initializer=XavierInitializer())
+    return _one("rank_attention",
+                {"X": [input], "RankOffset": [rank_offset],
+                 "RankParam": [rank_param]},
+                {"MaxRank": max_rank, "MaxSize": max_size})
+
+
+def batch_fc(input, param_size, param_attr, bias_size, bias_attr,
+             act=None):
+    helper = LayerHelper("batch_fc")
+    w = helper.create_parameter(attr=param_attr, shape=list(param_size),
+                                dtype="float32")
+    b = helper.create_parameter(attr=bias_attr, shape=list(bias_size),
+                                dtype="float32")
+    out = _one("batch_fc", {"Input": [input], "W": [w], "Bias": [b]}, {})
+    from ...layers import nn as _nn
+
+    return getattr(_nn, act)(out) if act else out

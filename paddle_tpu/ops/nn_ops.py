@@ -1103,3 +1103,33 @@ def _im2sequence(ins, attrs):
     patches = patches.transpose(0, 2, 4, 1, 3, 5).reshape(
         n * oh * ow, c * kh * kw)
     return {"Out": patches}
+
+
+@register_op("spp")
+def _spp(ins, attrs):
+    """Spatial pyramid pooling (reference: spp_op.h:26): levels
+    p=0..pyramid_height-1 pool to 2^p x 2^p bins with
+    kernel=ceil(dim/bins), pad=(kernel*bins-dim+1)//2, then flatten and
+    concat along channels. Composes the registered pool2d kernel —
+    XLA fuses the reduce_windows."""
+    x = ins["X"][0]
+    pyramid_height = int(attrs.get("pyramid_height", 1))
+    ptype = attrs.get("pooling_type", "max")
+    n, c, h, w = x.shape
+    import math as _math
+
+    from .registry import run_op as _run
+
+    outs = []
+    for p in range(pyramid_height):
+        bins = 2 ** p
+        kh = _math.ceil(h / bins)
+        kw = _math.ceil(w / bins)
+        ph = (kh * bins - h + 1) // 2
+        pw = (kw * bins - w + 1) // 2
+        lvl = _run("pool2d", {"X": [x]},
+                   {"pooling_type": ptype, "ksize": [kh, kw],
+                    "strides": [kh, kw], "paddings": [ph, pw],
+                    "exclusive": True})["Out"][0]
+        outs.append(lvl.reshape(n, c * bins * bins))
+    return {"Out": jnp.concatenate(outs, axis=1)}

@@ -785,3 +785,169 @@ def _pyramid_hash(ins, attrs):
                 cnt += 1
         out[bi] = acc / max(cnt, 1)
     return {"Out": jnp.asarray(out)}
+
+
+@register_op("match_matrix_tensor", no_jit=True, dynamic_shape=True)
+def _match_matrix_tensor(ins, attrs):
+    """Text-matching bilinear similarity (reference:
+    match_matrix_tensor_op.cc:168): per pair of ragged sequences,
+    out[b, t, i, j] = x_i^T W_t y_j, flattened to the LoD layout
+    [sum_b dim_t*len_l*len_r, 1]; Tmp caches x @ W for the grad kernel.
+    LoD offsets ride the XLod/YLod inputs (padded-representation
+    convention)."""
+    x = np.asarray(ins["X"][0], np.float32)
+    y = np.asarray(ins["Y"][0], np.float32)
+    w = np.asarray(ins["W"][0], np.float32)
+    dim_t = int(attrs.get("dim_t", w.shape[1]))
+    dim_in = x.shape[1]
+    x_lod = np.asarray(ins["XLod"][0]).reshape(-1).astype(int) \
+        if ins.get("XLod") else np.asarray([0, len(x)])
+    y_lod = np.asarray(ins["YLod"][0]).reshape(-1).astype(int) \
+        if ins.get("YLod") else np.asarray([0, len(y)])
+    # Tmp = x @ W  -> [total_l, dim_t * dim_in]
+    wt = w.reshape(dim_in, dim_t * dim_in)
+    tmp = x @ wt
+    out_chunks = []
+    for b in range(len(x_lod) - 1):
+        xl = tmp[x_lod[b]:x_lod[b + 1]].reshape(-1, dim_t, dim_in)
+        yr = y[y_lod[b]:y_lod[b + 1]]                 # [len_r, dim_in]
+        # [dim_t, len_l, len_r]
+        scores = np.einsum("ltd,rd->tlr", xl, yr)
+        out_chunks.append(scores.reshape(-1))
+    out = np.concatenate(out_chunks) if out_chunks else \
+        np.zeros((0,), np.float32)
+    return {"Out": out.reshape(-1, 1), "Tmp": tmp}
+
+
+@register_op("sequence_topk_avg_pooling", no_jit=True,
+             dynamic_shape=True)
+def _sequence_topk_avg_pooling(ins, attrs):
+    """Top-k average pooling over each row of per-pair match matrices
+    (reference: sequence_topk_avg_pooling_op.h:69): X holds
+    [channel, row, col] blocks per batch (LoD), out[row] gets, per
+    channel and per k in topks, the mean of that row's top-k values.
+    Short rows pad with the reference's TopKPosPaddingId=-1 semantics
+    (prefix sums repeat)."""
+    x = np.asarray(ins["X"][0], np.float32).reshape(-1)
+    topks = [int(k) for k in attrs["topks"]]
+    channel_num = int(attrs["channel_num"])
+    max_k = max(topks)
+    k_num = len(topks)
+    x_lod = np.asarray(ins["XLod"][0]).reshape(-1).astype(int) \
+        if ins.get("XLod") else np.asarray([0, x.size])
+    # offsets ride ROWLod/COLUMNLod; the ROW/COLUMN slots (reference
+    # LoDTensor inputs whose lod is the payload) are an accepted alias
+    row_lod = np.asarray(
+        (ins.get("ROWLod") or ins["ROW"])[0]).reshape(-1).astype(int)
+    col_lod = np.asarray(
+        (ins.get("COLUMNLod") or ins["COLUMN"])[0]).reshape(-1).astype(int)
+    total_rows = int(row_lod[-1])
+    out = np.zeros((total_rows, channel_num * k_num), np.float32)
+    pos = np.full((total_rows * channel_num * max_k,), -1, np.int32)
+    for b in range(len(row_lod) - 1):
+        row_size = row_lod[b + 1] - row_lod[b]
+        col_size = col_lod[b + 1] - col_lod[b]
+        feat = x[x_lod[b]:x_lod[b + 1]].reshape(
+            channel_num, row_size, col_size)
+        for j in range(channel_num):
+            for r in range(row_size):
+                row_data = feat[j, r]
+                k_real = min(max_k, col_size)
+                order = np.argsort(-row_data, kind="stable")[:k_real]
+                p0 = ((row_lod[b] + r) * channel_num + j) * max_k
+                pos[p0:p0 + k_real] = order
+                sums = np.zeros(max_k, np.float32)
+                run = 0.0
+                for k in range(max_k):
+                    if k < k_real:
+                        run += row_data[order[k]]
+                    sums[k] = run
+                for ki, tk in enumerate(topks):
+                    out[row_lod[b] + r, j * k_num + ki] = \
+                        sums[tk - 1] / tk
+    return {"Out": out, "pos": pos}
+
+
+@register_op("tdm_child")
+def _tdm_child(ins, attrs):
+    """Tree-based deep match: children of each node id (reference:
+    tdm_child_op.h:36). TreeInfo rows: [item_id, layer_id, ancestor,
+    child_0..child_n-1]; nodes without children (id 0 or child_0 == 0)
+    emit zeros; LeafMask marks children that are items (item_id != 0)."""
+    x = ins["X"][0].astype(jnp.int32)
+    info = ins["TreeInfo"][0].astype(jnp.int32)
+    child_nums = int(attrs.get("child_nums", info.shape[1] - 3))
+    flat = x.reshape(-1)
+    rows = info[flat]                                # [N, len]
+    children = rows[:, 3:3 + child_nums]             # [N, child_nums]
+    has_child = ((flat != 0) & (rows[:, 3] != 0))[:, None]
+    children = jnp.where(has_child, children, 0)
+    is_item = (info[children.reshape(-1), 0] != 0).reshape(
+        children.shape).astype(jnp.int32)
+    mask = jnp.where(has_child, is_item, 0)
+    out_shape = tuple(x.shape) + (child_nums,)
+    return {"Child": children.reshape(out_shape),
+            "LeafMask": mask.reshape(out_shape)}
+
+
+@register_op("tdm_sampler", no_jit=True)
+def _tdm_sampler(ins, attrs):
+    """Per-layer negative sampling along each item's tree path
+    (reference: tdm_sampler_op.h:39): for every input id, walk its
+    Travel path; per layer emit the positive (optional) plus
+    `neg_samples_num_list[layer]` rejection-sampled negatives drawn
+    uniformly from that layer (excluding the positive and duplicates);
+    padding positions (travel id 0) emit zeros with mask 0."""
+    x = np.asarray(ins["X"][0]).reshape(-1).astype(int)
+    travel = np.asarray(ins["Travel"][0]).astype(int)
+    layer = np.asarray(ins["Layer"][0]).reshape(-1).astype(int)
+    neg_nums = [int(v) for v in attrs["neg_samples_num_list"]]
+    layer_offset = [int(v) for v in attrs["layer_offset_lod"]]
+    output_positive = bool(attrs.get("output_positive", True))
+    seed = int(attrs.get("seed", 0))
+    rng = np.random.RandomState(seed if seed else None)
+    layer_nums = len(neg_nums)
+    res_len = sum(n + int(output_positive) for n in neg_nums)
+    n = x.size
+    out = np.zeros((n, res_len), np.int64)
+    labels = np.zeros((n, res_len), np.int64)
+    mask = np.ones((n, res_len), np.int64)
+    travel = travel.reshape(-1, layer_nums)
+    for i, input_id in enumerate(x):
+        offset = 0
+        for li in range(layer_nums):
+            sample_num = neg_nums[li]
+            node_nums = layer_offset[li + 1] - layer_offset[li]
+            if sample_num > node_nums - 1:
+                raise ValueError(
+                    "tdm_sampler: neg sample num %d at layer %d must "
+                    "be <= layer node count %d - 1 (positive included)"
+                    % (sample_num, li, node_nums))
+            positive = int(travel[input_id, li])
+            if positive == 0:  # padding path tail
+                width = sample_num + int(output_positive)
+                out[i, offset:offset + width] = 0
+                labels[i, offset:offset + width] = 0
+                mask[i, offset:offset + width] = 0
+                offset += width
+                continue
+            if output_positive:
+                out[i, offset] = positive
+                labels[i, offset] = 1
+                offset += 1
+            chosen = set()
+            for _ in range(sample_num):
+                while True:
+                    s = int(rng.randint(0, node_nums))
+                    if s in chosen:
+                        continue
+                    cand = int(layer[layer_offset[li] + s])
+                    if cand != positive:
+                        break
+                chosen.add(s)
+                out[i, offset] = cand
+                labels[i, offset] = 0
+                offset += 1
+    return {"Out": out.reshape(n * res_len, 1),
+            "Labels": labels.reshape(n * res_len, 1),
+            "Mask": mask.reshape(n * res_len, 1)}

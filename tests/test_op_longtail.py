@@ -400,3 +400,108 @@ def test_generate_mask_labels_no_fg():
         {"num_classes": 2, "resolution": m})
     assert (_np(out["MaskInt32"][0]) == -1).all()
     assert _np(out["MaskRois"][0]).shape == (1, 4)
+
+
+# ------------------------------------------------- specialty / tdm / spp
+def test_spp_pyramid_levels():
+    # reference: spp_op.h:26 — levels 1x1 and 2x2, max pooling
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 3, 4, 4).astype("float32")
+    out = run_op("spp", {"X": [jnp.asarray(x)]},
+                 {"pyramid_height": 2, "pooling_type": "max"})["Out"][0]
+    got = _np(out)
+    assert got.shape == (2, 3 * 1 + 3 * 4)
+    # level 0: global max per channel
+    np.testing.assert_allclose(got[:, :3], x.max(axis=(2, 3)), rtol=1e-6)
+    # level 1: 2x2 bins of 2x2 windows
+    want = x.reshape(2, 3, 2, 2, 2, 2).max(axis=(3, 5)).reshape(2, 12)
+    np.testing.assert_allclose(got[:, 3:], want, rtol=1e-6)
+
+
+def test_match_matrix_tensor_golden():
+    # reference: match_matrix_tensor_op.cc:168 — bilinear per (l, r)
+    rng = np.random.RandomState(6)
+    dim_in, dim_t = 3, 2
+    x = rng.randn(5, dim_in).astype("float32")   # seqs of len 2, 3
+    y = rng.randn(4, dim_in).astype("float32")   # seqs of len 1, 3
+    w = rng.randn(dim_in, dim_t, dim_in).astype("float32")
+    out = run_op("match_matrix_tensor",
+                 {"X": [x], "Y": [y], "W": [w],
+                  "XLod": [np.asarray([0, 2, 5])],
+                  "YLod": [np.asarray([0, 1, 4])]},
+                 {"dim_t": dim_t})
+    got = _np(out["Out"][0]).reshape(-1)
+    # batch 0: len_l=2, len_r=1 -> dim_t*2*1 = 4 values
+    want0 = np.einsum("ld,dte,re->tlr", x[:2], w, y[:1]).reshape(-1)
+    np.testing.assert_allclose(got[:4], want0, rtol=1e-5)
+    want1 = np.einsum("ld,dte,re->tlr", x[2:], w, y[1:]).reshape(-1)
+    np.testing.assert_allclose(got[4:], want1, rtol=1e-5)
+    assert got.shape[0] == 4 + dim_t * 3 * 3
+
+
+def test_sequence_topk_avg_pooling_golden():
+    # reference: sequence_topk_avg_pooling_op.h:69 — channel=1 batch=1,
+    # rows 2 cols 3, topks [1, 2]
+    feat = np.asarray([[3.0, 1.0, 2.0], [0.0, -1.0, 5.0]], "float32")
+    out = run_op(
+        "sequence_topk_avg_pooling",
+        {"X": [feat.reshape(-1)],
+         "XLod": [np.asarray([0, 6])],
+         "ROWLod": [np.asarray([0, 2])],
+         "COLUMNLod": [np.asarray([0, 3])]},
+        {"topks": [1, 2], "channel_num": 1})
+    got = _np(out["Out"][0])
+    # row 0: top1 = 3, top2 avg = (3+2)/2
+    np.testing.assert_allclose(got[0], [3.0, 2.5], rtol=1e-6)
+    np.testing.assert_allclose(got[1], [5.0, 2.5], rtol=1e-6)
+
+
+def test_tdm_child_golden():
+    # TreeInfo rows: [item_id, layer_id, ancestor, child0, child1]
+    info = np.asarray([
+        [0, 0, 0, 0, 0],    # node 0: padding
+        [0, 0, 0, 2, 3],    # node 1: root, children 2,3 (non-items)
+        [0, 1, 1, 4, 5],    # node 2: children 4,5
+        [0, 1, 1, 6, 0],    # node 3: child 6
+        [7, 2, 2, 0, 0],    # node 4: item (leaf)
+        [8, 2, 2, 0, 0],    # node 5: item
+        [9, 2, 3, 0, 0],    # node 6: item
+    ], "int64")
+    out = run_op("tdm_child",
+                 {"X": [jnp.asarray(np.asarray([[1], [2], [4]],
+                                               "int64"))],
+                  "TreeInfo": [jnp.asarray(info)]},
+                 {"child_nums": 2})
+    child = _np(out["Child"]).reshape(3, 2)
+    mask = _np(out["LeafMask"]).reshape(3, 2)
+    np.testing.assert_array_equal(child[0], [2, 3])
+    np.testing.assert_array_equal(mask[0], [0, 0])   # internal nodes
+    np.testing.assert_array_equal(child[1], [4, 5])
+    np.testing.assert_array_equal(mask[1], [1, 1])   # items
+    np.testing.assert_array_equal(child[2], [0, 0])  # leaf: no children
+    np.testing.assert_array_equal(mask[2], [0, 0])
+
+
+def test_tdm_sampler_layerwise():
+    # 2-layer tree: layer 0 nodes [1,2], layer 1 nodes [3,4,5,6]
+    # item 0 travels [1, 3]; item 1 travels [2, 6]
+    travel = np.asarray([[1, 3], [2, 6]], "int64")
+    layer = np.asarray([1, 2, 3, 4, 5, 6], "int64")
+    out = run_op("tdm_sampler",
+                 {"X": [np.asarray([[0], [1]], "int64")],
+                  "Travel": [travel], "Layer": [layer]},
+                 {"neg_samples_num_list": [1, 2],
+                  "layer_offset_lod": [0, 2, 6],
+                  "output_positive": True, "seed": 3})
+    o = _np(out["Out"][0]).reshape(2, 5)
+    lbl = _np(out["Labels"][0]).reshape(2, 5)
+    msk = _np(out["Mask"][0]).reshape(2, 5)
+    # layout per row: [pos_l0, neg_l0, pos_l1, neg_l1, neg_l1]
+    assert o[0, 0] == 1 and lbl[0, 0] == 1
+    assert o[0, 1] == 2 and lbl[0, 1] == 0  # only possible negative
+    assert o[0, 2] == 3 and lbl[0, 2] == 1
+    assert set(o[0, 3:]) <= {4, 5, 6} and len(set(o[0, 3:])) == 2
+    assert o[1, 0] == 2 and o[1, 2] == 6
+    assert (msk == 1).all()
+    # negatives never equal the positive on their layer
+    assert 3 not in o[0, 3:] and 6 not in o[1, 3:]

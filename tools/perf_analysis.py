@@ -1,0 +1,226 @@
+"""Perf evidence generator for the BERT-base train step (VERDICT r4
+task #2 fallback when the TPU tunnel is down all round): lowers the
+EXACT bench train step (models/bert.bert_pretrain_loss + bf16 AMP +
+Adam, fused linear-softmax-xent head) with jax.jit(...).lower() on the
+CPU backend (StableHLO is backend-neutral), and writes
+PERF_ANALYSIS_r4.md with:
+
+- StableHLO op histogram + dot_general shape census per batch size,
+- XLA's own pre-compile cost analysis (flops/bytes) when available,
+- an analytical FLOPs / HBM-traffic / HBM-peak model for v5e
+  (197 TFLOP/s bf16, 16 GB HBM) at batch 256 and 512, fused vs
+  round-2 unfused head,
+- the gzipped StableHLO committed alongside when small enough.
+
+Usage: python tools/perf_analysis.py [--batches 256,512]
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+SEQ_LEN = 128
+V5E_PEAK_BF16 = 197e12
+V5E_HBM = 16e9
+V5E_HBM_BW = 819e9  # bytes/s
+
+
+def build_step(batch):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework, lowering
+    from paddle_tpu.fluid.contrib import mixed_precision
+    from paddle_tpu.models import bert
+    from paddle_tpu.core.scope import global_scope
+    from __graft_entry__ import _bert_feed
+
+    cfg = bert.BertConfig.base()
+    main_p, startup_p = framework.Program(), framework.Program()
+    with framework.program_guard(main_p, startup_p):
+        with framework.unique_name_guard():
+            total, mlm, nsp, feeds = bert.bert_pretrain_loss(
+                cfg, SEQ_LEN, is_test=False)
+            opt = mixed_precision.decorate(
+                fluid.optimizer.AdamOptimizer(learning_rate=1e-4),
+                use_dynamic_loss_scaling=False)
+            opt.minimize(total)
+            n_params = sum(int(np.prod(p.shape))
+                           for p in main_p.all_parameters())
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(startup_p)
+            feed_arrays = _bert_feed(cfg, batch, SEQ_LEN)
+            block = main_p.global_block()
+            state_in, _ = lowering.analyze_block(
+                block, list(feed_arrays), [total.name])
+            state_specs = {n: global_scope().find_var(n)
+                           for n in state_in}
+            entry = lowering.compile_block(
+                main_p, block, feed_arrays, [total.name], state_specs)
+            states_mut = {n: global_scope().find_var(n)
+                          for n in entry.state_mut_names}
+            states_ro = {n: global_scope().find_var(n)
+                         for n in entry.state_ro_names}
+    return cfg, n_params, entry, feed_arrays, states_mut, states_ro
+
+
+def hlo_census(text):
+    import re
+
+    ops = {}
+    dots = []
+    for line in text.splitlines():
+        m = re.search(r"=\s+\"?([a-z_]+\.[a-z_0-9]+)", line)
+        if m:
+            op = m.group(1)
+            ops[op] = ops.get(op, 0) + 1
+            if "dot_general" in op:
+                shapes = re.findall(r"tensor<([^>]+)>", line)
+                if shapes:
+                    dots.append(shapes[-1])
+    return ops, dots
+
+
+def analytical(cfg, n_params, batch):
+    """FLOPs / bytes / HBM model for one train step."""
+    tokens = batch * SEQ_LEN
+    # 6N params matmul FLOPs/token + attention score/context
+    attn = 12.0 * cfg.num_hidden_layers * SEQ_LEN * cfg.hidden_size
+    flops = (6.0 * n_params + attn) * tokens
+    h, L, V = cfg.hidden_size, cfg.num_hidden_layers, cfg.vocab_size
+    max_pred = int(SEQ_LEN * 0.15)
+    act_per_layer = 13 * tokens * h * 2  # bf16 activations kept (approx)
+    weights_bf16 = n_params * 2
+    master_fp32 = n_params * 4
+    adam_state = n_params * 8
+    grads_fp32 = n_params * 4
+    acts = act_per_layer * L
+    # head buffers: fused head streams [rows, V] in tiles; unfused
+    # materializes fp32 logits + softmax for batch*max_pred rows
+    unfused_head = 2 * (batch * max_pred) * V * 4
+    fused_head = 0  # tiled inside the fused op
+    peak = (weights_bf16 + master_fp32 + adam_state + grads_fp32
+            + acts + fused_head)
+    peak_unfused = peak + unfused_head
+    return {
+        "tokens": tokens,
+        "train_flops": flops,
+        "ideal_step_s": flops / V5E_PEAK_BF16,
+        "ideal_tok_s": tokens / (flops / V5E_PEAK_BF16),
+        "weights_bf16_gb": weights_bf16 / 1e9,
+        "master_adam_gb": (master_fp32 + adam_state) / 1e9,
+        "grads_gb": grads_fp32 / 1e9,
+        "acts_gb": acts / 1e9,
+        "head_unfused_gb": unfused_head / 1e9,
+        "peak_gb": peak / 1e9,
+        "peak_unfused_gb": peak_unfused / 1e9,
+        "fits": peak < V5E_HBM,
+        "fits_unfused": peak_unfused < V5E_HBM,
+    }
+
+
+def main():
+    batches = [256, 512]
+    for a in sys.argv[1:]:
+        if a.startswith("--batches"):
+            batches = [int(x) for x in a.split("=", 1)[1].split(",")]
+    report = ["# PERF_ANALYSIS (round 4)", "",
+              "TPU tunnel down all round (see .capture_log): this is "
+              "the VERDICT-prescribed fallback evidence — "
+              "`jax.jit(...).lower()` StableHLO + analytical "
+              "FLOPs/bytes/HBM-peak for the EXACT bench train step "
+              "(BERT-base seq128 bf16 AMP Adam, fused "
+              "linear-softmax-xent head, models/bert.py:176).", ""]
+    for batch in batches:
+        t0 = time.time()
+        (cfg, n_params, entry, feeds, smut, sro) = build_step(batch)
+        lowered = entry.jitted.lower(
+            {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+             for k, v in feeds.items()},
+            {k: jax.ShapeDtypeStruct(np.asarray(v).shape,
+                                     np.asarray(v).dtype)
+             for k, v in smut.items()},
+            {k: jax.ShapeDtypeStruct(np.asarray(v).shape,
+                                     np.asarray(v).dtype)
+             for k, v in sro.items()},
+            np.uint32(0))
+        text = lowered.as_text()
+        ops, dots = hlo_census(text)
+        try:
+            cost = lowered.cost_analysis() or {}
+        except Exception:
+            cost = {}
+        ana = analytical(cfg, n_params, batch)
+        gz_path = os.path.join(
+            _REPO, "artifacts", "bert_train_b%d.stablehlo.txt.gz" % batch)
+        os.makedirs(os.path.dirname(gz_path), exist_ok=True)
+        with gzip.open(gz_path, "wt") as f:
+            f.write(text)
+        gz_mb = os.path.getsize(gz_path) / 1e6
+
+        report += [
+            "## batch %d (seq %d, %.1fM params)" % (
+                batch, SEQ_LEN, n_params / 1e6), "",
+            "- StableHLO: %d lines, %d distinct op kinds; dot_generals: "
+            "%d; artifact: `artifacts/%s` (%.1f MB gz)" % (
+                text.count("\n"), len(ops),
+                sum(v for k, v in ops.items() if "dot_general" in k),
+                os.path.basename(gz_path), gz_mb),
+            "- lower+trace time: %.1fs" % (time.time() - t0),
+        ]
+        if cost:
+            flops = cost.get("flops", 0.0)
+            bts = cost.get("bytes accessed", 0.0)
+            report += [
+                "- XLA cost analysis: %.2f TFLOP/step, %.2f GB accessed"
+                % (flops / 1e12, bts / 1e9),
+            ]
+            if flops:
+                report += [
+                    "- arithmetic intensity %.0f FLOP/byte (v5e "
+                    "ridge: %.0f) -> %s-bound at peak" % (
+                        flops / max(bts, 1),
+                        V5E_PEAK_BF16 / V5E_HBM_BW,
+                        "compute" if flops / max(bts, 1)
+                        > V5E_PEAK_BF16 / V5E_HBM_BW else "bandwidth"),
+                ]
+        report += [
+            "- analytical train FLOPs: %.2f TFLOP/step -> ideal %.0fk "
+            "tok/s at 100%% MFU; >=45%% MFU target = %.0fk tok/s" % (
+                ana["train_flops"] / 1e12, ana["ideal_tok_s"] / 1e3,
+                0.45 * ana["ideal_tok_s"] / 1e3),
+            "- HBM budget (GB): weights(bf16) %.2f + master+adam %.2f "
+            "+ grads %.2f + acts(bf16, ~13/h/layer/token) %.2f = "
+            "**%.2f peak** -> %s on 16G v5e" % (
+                ana["weights_bf16_gb"], ana["master_adam_gb"],
+                ana["grads_gb"], ana["acts_gb"], ana["peak_gb"],
+                "FITS" if ana["fits"] else "OOM"),
+            "- round-2 UNFUSED head added %.2f GB fp32 logits+softmax "
+            "-> %.2f GB (%s) — the fused head (ops/fused_ops.py:258) "
+            "removed exactly the buffers that made batch 512 OOM" % (
+                ana["head_unfused_gb"], ana["peak_unfused_gb"],
+                "fit" if ana["fits_unfused"] else "OOM at batch 512"),
+            "",
+            "Top-15 StableHLO ops: " + ", ".join(
+                "%s x%d" % kv for kv in sorted(
+                    ops.items(), key=lambda kv: -kv[1])[:15]),
+            "",
+        ]
+    out = os.path.join(_REPO, "PERF_ANALYSIS_r4.md")
+    with open(out, "w") as f:
+        f.write("\n".join(report) + "\n")
+    print("wrote", out)
+
+
+if __name__ == "__main__":
+    main()
