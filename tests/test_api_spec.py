@@ -1,0 +1,33 @@
+"""Public API surface lock (reference: paddle/fluid/API.spec +
+tools/check_api_approvals.sh — accidental signature breaks fail CI).
+If a change is intentional, regenerate with
+`python tools/print_signatures.py --write` and commit API.spec."""
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_api_spec_locked():
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    import print_signatures
+
+    current = print_signatures.collect()
+    with open(os.path.join(_REPO, "API.spec")) as f:
+        pinned = f.read().splitlines()
+    cur_set, pin_set = set(current), set(pinned)
+    removed = sorted(pin_set - cur_set)[:20]
+    added = sorted(cur_set - pin_set)[:20]
+    assert cur_set == pin_set, (
+        "public API surface drifted from API.spec.\n"
+        "removed/changed (%d): %s\nadded (%d): %s\n"
+        "If intentional: python tools/print_signatures.py --write"
+        % (len(pin_set - cur_set), removed,
+           len(cur_set - pin_set), added))
+
+
+def test_api_spec_has_no_import_errors():
+    with open(os.path.join(_REPO, "API.spec")) as f:
+        bad = [ln for ln in f if "IMPORT_ERROR" in ln]
+    assert not bad, bad

@@ -1,0 +1,120 @@
+"""Print the public python API surface in a stable, diffable form.
+
+Reference parity: `tools/print_signatures.py` + `paddle/fluid/API.spec`
++ `tools/check_api_approvals.sh` — the reference locks its public
+signature surface so accidental API breaks fail CI. Same mechanism
+here: this walks the public modules, emits one `qualname (ArgSpec(...))`
+line per function/method, and `API.spec` at the repo root pins the
+result (tests/test_api_spec.py compares).
+
+Usage:
+    python tools/print_signatures.py            # print to stdout
+    python tools/print_signatures.py --write    # refresh API.spec
+"""
+from __future__ import annotations
+
+import inspect
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+# the locked surface: the stable user-facing entry points. Submodules
+# whose membership is intentionally fluid (ops registry, internal
+# lowering) are not locked.
+_MODULES = [
+    "paddle_tpu.fluid",
+    "paddle_tpu.fluid.layers",
+    "paddle_tpu.fluid.layers.detection",
+    "paddle_tpu.fluid.layers.control_flow",
+    "paddle_tpu.fluid.layers.tensor",
+    "paddle_tpu.fluid.optimizer",
+    "paddle_tpu.fluid.initializer",
+    "paddle_tpu.fluid.io",
+    "paddle_tpu.fluid.dygraph",
+    "paddle_tpu.fluid.contrib.layers",
+    "paddle_tpu.fluid.incubate.data_generator",
+    "paddle_tpu.fleet",
+    "paddle_tpu.fleet.metrics",
+    "paddle_tpu.hapi.model",
+    "paddle_tpu.nn",
+    "paddle_tpu.tensor",
+]
+
+
+def _argspec(fn):
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return "ArgSpec(unknown)"
+    args, defaults, varargs, kw = [], [], None, None
+    for name, p in sig.parameters.items():
+        if p.kind == p.VAR_POSITIONAL:
+            varargs = name
+        elif p.kind == p.VAR_KEYWORD:
+            kw = name
+        else:
+            args.append(name)
+            if p.default is not p.empty:
+                defaults.append(repr(p.default))
+    return "ArgSpec(args=%s, varargs=%s, keywords=%s, defaults=(%s))" % (
+        args, varargs, kw, ", ".join(defaults))
+
+
+def _public_names(mod):
+    names = getattr(mod, "__all__", None)
+    if names is None:
+        names = [n for n in dir(mod) if not n.startswith("_")]
+    return sorted(set(names))
+
+
+def collect():
+    import importlib
+
+    lines = []
+    for mod_name in _MODULES:
+        try:
+            mod = importlib.import_module(mod_name)
+        except ImportError as e:
+            lines.append("%s IMPORT_ERROR %r" % (mod_name, e))
+            continue
+        for name in _public_names(mod):
+            obj = getattr(mod, name, None)
+            if obj is None or inspect.ismodule(obj):
+                continue
+            qual = "%s.%s" % (mod_name, name)
+            if inspect.isclass(obj):
+                lines.append("%s (class)" % qual)
+                for m_name in ("__init__",) + tuple(sorted(
+                        n for n in vars(obj) if not n.startswith("_"))):
+                    m = inspect.getattr_static(obj, m_name, None)
+                    if callable(m) or isinstance(m, (staticmethod,
+                                                     classmethod)):
+                        fn = getattr(obj, m_name)
+                        if callable(fn):
+                            lines.append("%s.%s (%s)" % (
+                                qual, m_name, _argspec(fn)))
+            elif callable(obj):
+                lines.append("%s (%s)" % (qual, _argspec(obj)))
+    return lines
+
+
+def main():
+    lines = collect()
+    text = "\n".join(lines) + "\n"
+    if "--write" in sys.argv:
+        with open(os.path.join(_REPO, "API.spec"), "w") as f:
+            f.write(text)
+        print("wrote API.spec (%d entries)" % len(lines))
+    else:
+        sys.stdout.write(text)
+
+
+if __name__ == "__main__":
+    main()
