@@ -226,11 +226,19 @@ def _bench_child(platform: str, batch: int, steps: int, warmup: int,
     main_p, startup_p = framework.Program(), framework.Program()
     with framework.program_guard(main_p, startup_p):
         with framework.unique_name_guard():
+            ckpts = []
             total, mlm, nsp, feeds = bert.bert_pretrain_loss(
-                cfg, SEQ_LEN, is_test=False)
+                cfg, SEQ_LEN, is_test=False, checkpoints_out=ckpts)
+            base_opt = fluid.optimizer.AdamOptimizer(learning_rate=1e-4)
+            if batch >= 384:
+                # PERF_ANALYSIS_r4: batch 512 activations (~15.7G bf16)
+                # exceed 16G HBM without remat; per-layer checkpointing
+                # trades ~1/3 more fwd FLOPs for the fit
+                rec = fluid.optimizer.RecomputeOptimizer(base_opt)
+                rec._set_checkpoints(ckpts)
+                base_opt = rec
             opt = mixed_precision.decorate(
-                fluid.optimizer.AdamOptimizer(learning_rate=1e-4),
-                use_dynamic_loss_scaling=False)
+                base_opt, use_dynamic_loss_scaling=False)
             opt.minimize(total)
 
             n_params = sum(

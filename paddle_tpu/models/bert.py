@@ -108,8 +108,11 @@ def encoder_layer(x, attn_bias, cfg, name, is_test=False):
 
 
 def bert_encoder(src_ids, pos_ids, sent_ids, input_mask, cfg,
-                 is_test=False):
-    """Returns [B, S, H] sequence output."""
+                 is_test=False, checkpoints_out=None):
+    """Returns [B, S, H] sequence output. When `checkpoints_out` is a
+    list, each encoder layer's output var is appended — the natural
+    remat segmentation for RecomputeOptimizer (PERF_ANALYSIS_r4:
+    batch 512 needs activation checkpointing to fit 16G HBM)."""
     emb = layers.embedding(src_ids, size=[cfg.vocab_size, cfg.hidden_size],
                            param_attr=ParamAttr(name="word_embedding",
                                                 initializer=_init(cfg)))
@@ -135,10 +138,13 @@ def bert_encoder(src_ids, pos_ids, sent_ids, input_mask, cfg,
     for i in range(cfg.num_hidden_layers):
         x = encoder_layer(x, attn_bias, cfg, "layer_%d" % i,
                           is_test=is_test)
+        if checkpoints_out is not None:
+            checkpoints_out.append(x)
     return x
 
 
-def bert_pretrain_loss(cfg, seq_len, is_test=False):
+def bert_pretrain_loss(cfg, seq_len, is_test=False,
+                       checkpoints_out=None):
     """Masked-LM + next-sentence pretraining loss over feed vars.
 
     Masked positions are a dense [B, max_pred] per-sequence index tensor
@@ -160,7 +166,8 @@ def bert_pretrain_loss(cfg, seq_len, is_test=False):
                               dtype="float32")
     nsp_label = layers.data(name="nsp_label", shape=[1], dtype="int64")
 
-    seq_out = bert_encoder(src, pos, sent, mask, cfg, is_test=is_test)
+    seq_out = bert_encoder(src, pos, sent, mask, cfg, is_test=is_test,
+                           checkpoints_out=checkpoints_out)
 
     # -- masked LM head (batched take_along_axis of masked positions) --
     idx = layers.reshape(mask_pos, [0, -1, 1])  # [B, P, 1]

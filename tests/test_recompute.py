@@ -119,3 +119,55 @@ def test_recompute_replays_forward_in_backward():
                                 np.uint32(0)).as_text()
         counts[recompute] = txt.count("dot_general")
     assert counts[True] > counts[False], counts
+
+
+def test_bert_recompute_checkpoints_loss_parity():
+    """The bench's big-batch path (bench.py: batch >= 384) wraps Adam
+    in RecomputeOptimizer with per-encoder-layer checkpoints collected
+    by models/bert — remat must not change the loss. Trains with
+    is_test=False so DROPOUT is live: the remat replay must redraw the
+    exact forward masks (per-op RNG keyed by base_idx in lowering's
+    checkpoint segments) or the 3-step trajectories diverge."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework
+    from paddle_tpu.models import bert
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.core import scope as scope_mod
+    from __graft_entry__ import _bert_feed
+
+    cfg = bert.BertConfig.tiny()
+    seq_len, batch = 32, 4
+    feed = _bert_feed(cfg, batch, seq_len)
+
+    def run(with_recompute):
+        main, startup = framework.Program(), framework.Program()
+        main.random_seed = startup.random_seed = 23
+        with framework.program_guard(main, startup):
+            with framework.unique_name_guard():
+                ckpts = []
+                total, _m, _n, _f = bert.bert_pretrain_loss(
+                    cfg, seq_len, is_test=False, checkpoints_out=ckpts)
+                opt = fluid.optimizer.AdamOptimizer(1e-4)
+                if with_recompute:
+                    assert len(ckpts) == cfg.num_hidden_layers
+                    rec = fluid.optimizer.RecomputeOptimizer(opt)
+                    rec._set_checkpoints(ckpts)
+                    opt = rec
+                opt.minimize(total)
+                scope = Scope()
+                with scope_mod.scope_guard(scope):
+                    exe = fluid.Executor(fluid.CPUPlace())
+                    exe.run(startup, scope=scope)
+                    losses = []
+                    for _ in range(3):
+                        out = exe.run(main, feed=feed,
+                                      fetch_list=[total], scope=scope)
+                        losses.append(float(np.asarray(
+                            out[0]).reshape(-1)[0]))
+        return losses
+
+    base = run(False)
+    remat = run(True)
+    np.testing.assert_allclose(remat, base, rtol=1e-5, atol=1e-6)

@@ -49,11 +49,16 @@ def build_step(batch):
     main_p, startup_p = framework.Program(), framework.Program()
     with framework.program_guard(main_p, startup_p):
         with framework.unique_name_guard():
+            ckpts = []
             total, mlm, nsp, feeds = bert.bert_pretrain_loss(
-                cfg, SEQ_LEN, is_test=False)
+                cfg, SEQ_LEN, is_test=False, checkpoints_out=ckpts)
+            base_opt = fluid.optimizer.AdamOptimizer(learning_rate=1e-4)
+            if batch >= 384:  # mirror bench.py's big-batch remat path
+                rec = fluid.optimizer.RecomputeOptimizer(base_opt)
+                rec._set_checkpoints(ckpts)
+                base_opt = rec
             opt = mixed_precision.decorate(
-                fluid.optimizer.AdamOptimizer(learning_rate=1e-4),
-                use_dynamic_loss_scaling=False)
+                base_opt, use_dynamic_loss_scaling=False)
             opt.minimize(total)
             n_params = sum(int(np.prod(p.shape))
                            for p in main_p.all_parameters())
@@ -91,12 +96,17 @@ def hlo_census(text):
     return ops, dots
 
 
-def analytical(cfg, n_params, batch):
-    """FLOPs / bytes / HBM model for one train step."""
+def analytical(cfg, n_params, batch, remat=False):
+    """FLOPs / bytes / HBM model for one train step. With remat (the
+    bench's batch >= 384 path) only per-layer boundary activations stay
+    resident plus one layer's internals during backward, and the
+    forward runs again inside the vjp (~+1/3 FLOPs)."""
     tokens = batch * SEQ_LEN
     # 6N params matmul FLOPs/token + attention score/context
     attn = 12.0 * cfg.num_hidden_layers * SEQ_LEN * cfg.hidden_size
     flops = (6.0 * n_params + attn) * tokens
+    if remat:
+        flops *= 4.0 / 3.0  # fwd replayed inside the backward
     h, L, V = cfg.hidden_size, cfg.num_hidden_layers, cfg.vocab_size
     max_pred = int(SEQ_LEN * 0.15)
     act_per_layer = 13 * tokens * h * 2  # bf16 activations kept (approx)
@@ -104,7 +114,11 @@ def analytical(cfg, n_params, batch):
     master_fp32 = n_params * 4
     adam_state = n_params * 8
     grads_fp32 = n_params * 4
-    acts = act_per_layer * L
+    if remat:
+        # boundaries (L x [tokens, h] bf16) + one live layer's internals
+        acts = L * tokens * h * 2 + act_per_layer
+    else:
+        acts = act_per_layer * L
     # head buffers: fused head streams [rows, V] in tiles; unfused
     # materializes fp32 logits + softmax for batch*max_pred rows
     unfused_head = 2 * (batch * max_pred) * V * 4
@@ -160,7 +174,7 @@ def main():
             cost = lowered.cost_analysis() or {}
         except Exception:
             cost = {}
-        ana = analytical(cfg, n_params, batch)
+        ana = analytical(cfg, n_params, batch, remat=batch >= 384)
         gz_path = os.path.join(
             _REPO, "artifacts", "bert_train_b%d.stablehlo.txt.gz" % batch)
         os.makedirs(os.path.dirname(gz_path), exist_ok=True)
@@ -169,8 +183,9 @@ def main():
         gz_mb = os.path.getsize(gz_path) / 1e6
 
         report += [
-            "## batch %d (seq %d, %.1fM params)" % (
-                batch, SEQ_LEN, n_params / 1e6), "",
+            "## batch %d (seq %d, %.1fM params%s)" % (
+                batch, SEQ_LEN, n_params / 1e6,
+                ", per-layer remat" if batch >= 384 else ""), "",
             "- StableHLO: %d lines, %d distinct op kinds; dot_generals: "
             "%d; artifact: `artifacts/%s` (%.1f MB gz)" % (
                 text.count("\n"), len(ops),
