@@ -101,6 +101,11 @@ def _bench() -> bool:
             and not res.get("stale")
         _log("bench", fresh=fresh, dt=round(time.perf_counter() - t0, 1),
              result=res if res else last[:300])
+        rn = res.get("resnet50") if fresh else None
+        if fresh and not (isinstance(rn, dict) and "value" in rn):
+            # missing OR an error placeholder from the child's optional
+            # pass: both mean config 2 still lacks a measurement
+            _resnet_fill()
         return fresh
     except subprocess.TimeoutExpired:
         _log("bench", fresh=False, dt=round(time.perf_counter() - t0, 1),
@@ -109,6 +114,39 @@ def _bench() -> bool:
     except Exception as e:  # noqa: BLE001
         _log("bench", fresh=False, result=repr(e)[:200])
         return False
+
+
+def _resnet_fill() -> None:
+    """BERT landed but the ResNet pass didn't fit the child's budget:
+    run the dedicated `bench.py --resnet` pass (BASELINE config 2 — has
+    never been measured on chip in any round) and merge its result into
+    .bench_last_good.json so the round artifact carries both."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "bench.py"),
+             "--resnet", "128"],
+            cwd=_REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, timeout=600)
+        sys.path.insert(0, _REPO)
+        from bench import _parse_tagged
+
+        res = _parse_tagged(proc.stdout)
+        ok = bool(res) and res.get("platform") == "tpu"
+        _log("resnet_fill", ok=ok,
+             result=res if res else (proc.stdout or "")[-200:])
+        if not ok:
+            return  # a CPU fallback must not pollute on-chip evidence
+        with open(_LAST_GOOD) as f:
+            lg = json.load(f)
+        lg["result"]["resnet50"] = res
+        # atomic replace: a kill mid-write must not corrupt the file
+        # the whole stale-fallback design depends on
+        tmp = _LAST_GOOD + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(lg, f, indent=1)
+        os.replace(tmp, _LAST_GOOD)
+    except Exception as e:  # noqa: BLE001
+        _log("resnet_fill", ok=False, result=repr(e)[:200])
 
 
 def _have_fresh_capture(max_age_h: float = 6.0) -> bool:
