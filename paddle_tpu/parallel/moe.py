@@ -1,0 +1,177 @@
+"""Mixture-of-Experts FFN with expert parallelism (the 'ep' mesh axis).
+
+The reference snapshot has no MoE (SURVEY §2.3: TP/SP/EP absent), but
+expert parallelism is first-class in the TPU-native design: this is the
+GSPMD dispatch pattern (Switch/GShard style) — build dense dispatch and
+combine tensors from top-1 gating with a static capacity, annotate the
+expert axis with `with_sharding_constraint(P("ep", ...))`, and let XLA
+insert the all-to-alls over ICI (the scaling-book recipe: pick a mesh,
+annotate shardings, let the compiler place collectives — no hand-written
+collective calls).
+
+Static shapes throughout (capacity-dropped tokens contribute zero), so
+one jitted computation covers every routing outcome. The auxiliary
+load-balance loss is the Switch Transformer one: E * mean_e(frac_tokens_e
+* mean_prob_e).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass
+class MoEConfig:
+    d_model: int = 64
+    d_ff: int = 128
+    n_experts: int = 4
+    capacity_factor: float = 1.5
+    dp: int = 1
+    ep: int = 1
+    aux_weight: float = 0.01
+
+    def mesh(self, devices=None):
+        import jax
+        from jax.sharding import Mesh
+
+        devices = devices if devices is not None else jax.devices()
+        n = self.dp * self.ep
+        assert len(devices) >= n, (len(devices), n)
+        arr = np.asarray(devices[:n]).reshape(self.dp, self.ep)
+        return Mesh(arr, ("dp", "ep"))
+
+
+def init_moe_params(cfg: MoEConfig, seed=0):
+    import jax
+
+    k = jax.random.PRNGKey(seed)
+    kg, k1, k2 = jax.random.split(k, 3)
+    scale = 1.0 / np.sqrt(cfg.d_model)
+    return {
+        "gate": jax.random.normal(kg, (cfg.d_model, cfg.n_experts),
+                                  "float32") * scale,
+        "w1": jax.random.normal(
+            k1, (cfg.n_experts, cfg.d_model, cfg.d_ff),
+            "float32") * scale,
+        "w2": jax.random.normal(
+            k2, (cfg.n_experts, cfg.d_ff, cfg.d_model),
+            "float32") * (1.0 / np.sqrt(cfg.d_ff)),
+    }
+
+
+def moe_param_specs(cfg: MoEConfig):
+    from jax.sharding import PartitionSpec as P
+
+    return {"gate": P(), "w1": P("ep", None, None),
+            "w2": P("ep", None, None)}
+
+
+def _capacity(cfg, tokens):
+    return max(1, int(np.ceil(tokens * cfg.capacity_factor
+                              / cfg.n_experts)))
+
+
+def moe_ffn(params, x, cfg: MoEConfig, mesh=None):
+    """x [B, S, d] -> (out [B, S, d], aux_loss scalar).
+
+    Top-1 routing with capacity C = ceil(B*S*cap/E): token t goes to
+    expert argmax(gate probs) if it is among the first C such tokens
+    (order = flattened token order), else it is dropped (output 0 for
+    the FFN branch — a residual add outside keeps the token alive).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    b, s, d = x.shape
+    e = cfg.n_experts
+    tokens = b * s
+    c = _capacity(cfg, tokens)
+    xt = x.reshape(tokens, d)
+
+    import jax
+
+    logits = xt @ params["gate"]                       # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                # [T]
+    onehot = jnp.eye(e, dtype=jnp.float32)[expert]     # [T, E]
+    gate_p = jnp.sum(probs * onehot, axis=-1)          # [T]
+    routed = onehot  # pre-capacity routing, for the aux loss
+
+    # position of each token within its expert's capacity buffer
+    pos = jnp.cumsum(onehot, axis=0) * onehot          # [T, E], 1-based
+    pos_in_e = jnp.sum(pos, axis=-1) - 1.0             # [T]
+    keep = pos_in_e < c
+    onehot = onehot * keep[:, None].astype(onehot.dtype)
+
+    # dispatch [T, E, C] / combine [T, E, C]
+    pos_oh = jnp.eye(c, dtype=jnp.float32)[
+        jnp.clip(pos_in_e, 0, c - 1).astype(jnp.int32)]  # [T, C]
+    dispatch = onehot[:, :, None] * pos_oh[:, None, :]
+    combine = dispatch * gate_p[:, None, None]
+
+    # expert buffers [E, C, d]; the 'ep' annotation makes XLA insert
+    # the token->expert all-to-all over ICI
+    exp_in = jnp.einsum("tec,td->ecd", dispatch, xt)
+    if mesh is not None:
+        exp_in = lax.with_sharding_constraint(
+            exp_in, NamedSharding(mesh, P("ep", None, None)))
+    h = jax.nn.relu(jnp.einsum("ecd,edf->ecf", exp_in, params["w1"]))
+    exp_out = jnp.einsum("ecf,efd->ecd", h, params["w2"])
+    if mesh is not None:
+        exp_out = lax.with_sharding_constraint(
+            exp_out, NamedSharding(mesh, P("ep", None, None)))
+
+    out = jnp.einsum("tec,ecd->td", combine, exp_out)
+
+    # Switch load-balance aux loss over the PRE-capacity routing: the
+    # masked counts saturate at C/T for every overflowing expert, which
+    # would zero the rebalance gradient exactly when it matters most
+    frac_tokens = jnp.mean(routed, axis=0)              # [E]
+    mean_prob = jnp.mean(probs, axis=0)                 # [E]
+    aux = e * jnp.sum(frac_tokens * mean_prob)
+    return out.reshape(b, s, d), aux
+
+
+def make_moe_train_step(cfg: MoEConfig, mesh):
+    """One SGD step of y = moe_ffn(x) + x regression to targets, jitted
+    over the (dp, ep) mesh: batch sharded on 'dp', experts on 'ep'."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    specs = moe_param_specs(cfg)
+
+    def loss_fn(params, x, y):
+        out, aux = moe_ffn(params, x, cfg, mesh=mesh)
+        mse = jnp.mean(jnp.square(out + x - y))
+        return mse + cfg.aux_weight * aux
+
+    def step(params, x, y, lr):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        new = jax.tree_util.tree_map(
+            lambda p, g: p - lr * g, params, grads)
+        return new, loss
+
+    in_shardings = (
+        {k: NamedSharding(mesh, s) for k, s in specs.items()},
+        NamedSharding(mesh, P("dp", None, None)),
+        NamedSharding(mesh, P("dp", None, None)),
+        NamedSharding(mesh, P()),
+    )
+    out_shardings = (
+        {k: NamedSharding(mesh, s) for k, s in specs.items()},
+        NamedSharding(mesh, P()),
+    )
+    return jax.jit(step, in_shardings=in_shardings,
+                   out_shardings=out_shardings)
+
+
+def shard_moe_params(params, cfg: MoEConfig, mesh):
+    import jax
+    from jax.sharding import NamedSharding
+
+    specs = moe_param_specs(cfg)
+    return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+            for k, v in params.items()}

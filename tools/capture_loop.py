@@ -29,6 +29,8 @@ import sys
 import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 _LOG = os.path.join(_REPO, ".capture_log")
 _LAST_GOOD = os.path.join(_REPO, ".bench_last_good.json")
 
@@ -127,7 +129,6 @@ def _resnet_fill() -> None:
              "--resnet", "128"],
             cwd=_REPO, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True, timeout=600)
-        sys.path.insert(0, _REPO)
         from bench import _parse_tagged
 
         res = _parse_tagged(proc.stdout)
