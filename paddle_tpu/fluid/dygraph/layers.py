@@ -182,6 +182,20 @@ class Layer:
         return outputs
 
     # -- attribute magic -----------------------------------------------------
+    def _purge_attr(self, name, keep=None):
+        """Drop `name` from __dict__ and every registry except `keep`:
+        re-binding an attribute to a different KIND (param <-> sublayer
+        <-> plain value/None) must not leave a stale entry that shadows
+        the new one (__getattr__ only fires when normal lookup misses)
+        or pollutes parameters()/state_dict."""
+        self.__dict__.pop(name, None)
+        for reg in ("_sub_layers", "_parameters", "_buffers"):
+            if reg == keep:
+                continue
+            d = self.__dict__.get(reg)
+            if d is not None:
+                d.pop(name, None)
+
     def __setattr__(self, name, value):
         if isinstance(value, base.Tensor) and value.persistable:
             params = self.__dict__.get("_parameters")
@@ -193,13 +207,18 @@ class Layer:
                     # attribute paths and must stay unique
                     buffers[name] = value
                     return
+                self._purge_attr(name, keep="_parameters")
                 params[name] = value
                 return
         if isinstance(value, Layer):
             subs = self.__dict__.get("_sub_layers")
             if subs is not None:
+                self._purge_attr(name, keep="_sub_layers")
                 subs[name] = value
                 return
+        # plain value (incl. None): a registered entry of any kind under
+        # this name is replaced (reference Layer semantics)
+        self._purge_attr(name)
         object.__setattr__(self, name, value)
 
     def __getattr__(self, name):
@@ -238,6 +257,18 @@ class Sequential(Layer):
         for l in self._sub_layers.values():
             x = l(x)
         return x
+
+    # reference Sequential supports len/iteration/indexing
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return Sequential(*list(self._sub_layers.values())[idx])
+        return list(self._sub_layers.values())[idx]
 
 
 class LayerList(Layer):

@@ -48,9 +48,11 @@ _VGG_CFG = {
 
 
 class VGG(Layer):
-    """reference vgg.py: stacked 3x3 convs + maxpools + 3 fc."""
+    """reference vgg.py: stacked 3x3 convs + maxpools + 3 fc;
+    batch_norm=True inserts BN after every conv (the *_bn variants)."""
 
-    def __init__(self, depth=16, num_classes=1000, with_pool=True):
+    def __init__(self, depth=16, num_classes=1000, with_pool=True,
+                 batch_norm=False):
         super().__init__()
         layers = []
         c_in = 3
@@ -58,6 +60,11 @@ class VGG(Layer):
             if v == "M":
                 layers.append(dnn.Pool2D(2, pool_type="max",
                                          pool_stride=2))
+            elif batch_norm:
+                layers.append(dnn.Conv2D(c_in, v, 3, padding=1,
+                                         act=None))
+                layers.append(dnn.BatchNorm(v, act="relu"))
+                c_in = v
             else:
                 layers.append(dnn.Conv2D(c_in, v, 3, padding=1,
                                          act="relu"))
@@ -77,11 +84,13 @@ class VGG(Layer):
         return self.classifier(x)
 
 
-def vgg16(pretrained=False, num_classes=1000, **kwargs):
+def vgg16(pretrained=False, batch_norm=False, num_classes=1000,
+          **kwargs):
     if pretrained:
         raise NotImplementedError(
             "pretrained weights are not bundled; load a state dict")
-    return VGG(16, num_classes=num_classes, **kwargs)
+    return VGG(16, num_classes=num_classes, batch_norm=batch_norm,
+               **kwargs)
 
 
 class _ConvBN(Layer):
@@ -201,3 +210,148 @@ def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
         raise NotImplementedError(
             "pretrained weights are not bundled; load a state dict")
     return MobileNetV2(scale=scale, **kwargs)
+
+
+class _BasicBlock(Layer):
+    """ResNet v1 basic block (reference resnet.py:74): two 3x3 conv-bn,
+    identity or 1x1-projection shortcut."""
+    expansion = 1
+
+    def __init__(self, c_in, c_out, stride=1):
+        super().__init__()
+        self.conv1 = _ConvBN(c_in, c_out, 3, stride=stride, padding=1)
+        self.conv2 = _ConvBN(c_out, c_out, 3, padding=1, act=None)
+        # NOTE: never pre-assign None — a plain-attr None in __dict__
+        # shadows the Layer registered later in _sub_layers
+        if stride != 1 or c_in != c_out:
+            self.short = _ConvBN(c_in, c_out, 1, stride=stride, act=None)
+
+    def forward(self, x):
+        y = self.conv2(self.conv1(x))
+        short = getattr(self, "short", None)
+        s = x if short is None else short(x)
+        from ...fluid import layers as L
+
+        return L.relu(L.elementwise_add(y, s))
+
+
+class _BottleneckBlock(Layer):
+    """ResNet v1 bottleneck (reference resnet.py:117): 1x1 reduce,
+    3x3, 1x1 expand (x4)."""
+    expansion = 4
+
+    def __init__(self, c_in, c_mid, stride=1):
+        super().__init__()
+        c_out = c_mid * 4
+        self.conv1 = _ConvBN(c_in, c_mid, 1)
+        self.conv2 = _ConvBN(c_mid, c_mid, 3, stride=stride, padding=1)
+        self.conv3 = _ConvBN(c_mid, c_out, 1, act=None)
+        if stride != 1 or c_in != c_out:
+            self.short = _ConvBN(c_in, c_out, 1, stride=stride, act=None)
+
+    def forward(self, x):
+        y = self.conv3(self.conv2(self.conv1(x)))
+        short = getattr(self, "short", None)
+        s = x if short is None else short(x)
+        from ...fluid import layers as L
+
+        return L.relu(L.elementwise_add(y, s))
+
+
+_RESNET_CFG = {
+    18: (_BasicBlock, [2, 2, 2, 2]),
+    34: (_BasicBlock, [3, 4, 6, 3]),
+    50: (_BottleneckBlock, [3, 4, 6, 3]),
+    101: (_BottleneckBlock, [3, 4, 23, 3]),
+    152: (_BottleneckBlock, [3, 8, 36, 3]),
+}
+
+
+class ResNet(Layer):
+    """Dygraph ResNet v1 (reference resnet.py:169): 7x7 stem, 4 stages,
+    global avg pool + fc. num_classes <= 0 skips the classifier head."""
+
+    def __init__(self, depth=50, num_classes=1000, with_pool=True):
+        super().__init__()
+        block, counts = _RESNET_CFG[depth]
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        self.stem = _ConvBN(3, 64, 7, stride=2, padding=3)
+        self.maxpool = dnn.Pool2D(3, pool_type="max", pool_stride=2,
+                                  pool_padding=1)
+        stages = []
+        c_in = 64
+        for i, (c_mid, n) in enumerate(zip([64, 128, 256, 512], counts)):
+            for j in range(n):
+                stride = 2 if (i > 0 and j == 0) else 1
+                stages.append(block(c_in, c_mid, stride=stride))
+                c_in = c_mid * block.expansion
+        self.stages = Sequential(*stages)
+        self.out_channels = c_in
+        if with_pool:
+            self.gap = dnn.Pool2D(pool_type="avg", global_pooling=True)
+        if num_classes > 0:
+            self.fc = dnn.Linear(c_in, num_classes)
+
+    def forward(self, x):
+        from ...tensor import manipulation as M
+
+        x = self.stages(self.maxpool(self.stem(x)))
+        if self.with_pool:
+            x = self.gap(x)
+        if self.num_classes > 0:
+            x = M.flatten(x, 1)
+            x = self.fc(x)
+        return x
+
+
+def _resnet(depth, pretrained, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are not bundled; load a state dict")
+    return ResNet(depth, **kwargs)
+
+
+def resnet18(pretrained=False, **kwargs):
+    return _resnet(18, pretrained, **kwargs)
+
+
+def resnet34(pretrained=False, **kwargs):
+    return _resnet(34, pretrained, **kwargs)
+
+
+def resnet50(pretrained=False, **kwargs):
+    return _resnet(50, pretrained, **kwargs)
+
+
+def resnet101(pretrained=False, **kwargs):
+    return _resnet(101, pretrained, **kwargs)
+
+
+def resnet152(pretrained=False, **kwargs):
+    return _resnet(152, pretrained, **kwargs)
+
+
+def vgg11(pretrained=False, batch_norm=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are not bundled; load a state dict")
+    return VGG(11, batch_norm=batch_norm, **kwargs)
+
+
+def vgg13(pretrained=False, batch_norm=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are not bundled; load a state dict")
+    return VGG(13, batch_norm=batch_norm, **kwargs)
+
+
+def vgg19(pretrained=False, batch_norm=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are not bundled; load a state dict")
+    return VGG(19, batch_norm=batch_norm, **kwargs)
+
+
+__all__ += ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
+            "resnet152", "vgg11", "vgg13", "vgg19"]
