@@ -205,6 +205,7 @@ class Layer:
                     # re-point the existing buffer slot rather than
                     # shadowing it in _parameters: state-dict keys are
                     # attribute paths and must stay unique
+                    self.__dict__.pop(name, None)
                     buffers[name] = value
                     return
                 self._purge_attr(name, keep="_parameters")

@@ -51,8 +51,7 @@ class VGG(Layer):
     """reference vgg.py: stacked 3x3 convs + maxpools + 3 fc;
     batch_norm=True inserts BN after every conv (the *_bn variants)."""
 
-    def __init__(self, depth=16, num_classes=1000, with_pool=True,
-                 batch_norm=False):
+    def __init__(self, depth=16, num_classes=1000, batch_norm=False):
         super().__init__()
         layers = []
         c_in = 3
@@ -84,13 +83,15 @@ class VGG(Layer):
         return self.classifier(x)
 
 
-def vgg16(pretrained=False, batch_norm=False, num_classes=1000,
-          **kwargs):
+def _vgg(depth, pretrained, batch_norm, **kwargs):
     if pretrained:
         raise NotImplementedError(
             "pretrained weights are not bundled; load a state dict")
-    return VGG(16, num_classes=num_classes, batch_norm=batch_norm,
-               **kwargs)
+    return VGG(depth, batch_norm=batch_norm, **kwargs)
+
+
+def vgg16(pretrained=False, batch_norm=False, **kwargs):
+    return _vgg(16, pretrained, batch_norm, **kwargs)
 
 
 class _ConvBN(Layer):
@@ -333,24 +334,15 @@ def resnet152(pretrained=False, **kwargs):
 
 
 def vgg11(pretrained=False, batch_norm=False, **kwargs):
-    if pretrained:
-        raise NotImplementedError(
-            "pretrained weights are not bundled; load a state dict")
-    return VGG(11, batch_norm=batch_norm, **kwargs)
+    return _vgg(11, pretrained, batch_norm, **kwargs)
 
 
 def vgg13(pretrained=False, batch_norm=False, **kwargs):
-    if pretrained:
-        raise NotImplementedError(
-            "pretrained weights are not bundled; load a state dict")
-    return VGG(13, batch_norm=batch_norm, **kwargs)
+    return _vgg(13, pretrained, batch_norm, **kwargs)
 
 
 def vgg19(pretrained=False, batch_norm=False, **kwargs):
-    if pretrained:
-        raise NotImplementedError(
-            "pretrained weights are not bundled; load a state dict")
-    return VGG(19, batch_norm=batch_norm, **kwargs)
+    return _vgg(19, pretrained, batch_norm, **kwargs)
 
 
 __all__ += ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",

@@ -1,0 +1,84 @@
+"""Ulysses-style (DeepSpeed-Ulysses) sequence parallelism: all-to-all
+head<->sequence re-sharding around exact attention.
+
+Complements ring attention (`parallel/ring_attention.py`) as the second
+long-context mode: instead of rotating KV shards around a ring, two
+`lax.all_to_all` hops over the 'sp' mesh axis convert the layout from
+sequence-sharded [B, S/P, H, D] to head-sharded [B, S, H/P, D], run
+EXACT full-sequence attention per head group (any kernel — XLA fused or
+Pallas flash), and convert back. Communication is 2 all-to-alls of
+activation size per layer (vs P-1 ppermute hops for ring), and the
+attention itself is unchanged — making this the better fit when
+head count >= mesh axis size and ICI all-to-all bandwidth is plentiful
+(the scaling-book tradeoff).
+
+The reference snapshot has no sequence parallelism of any kind
+(SURVEY §5 "Long-context: Absent"); both modes here are TPU-native
+additions for capability parity at scale.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _attention(q, k, v, causal, sm_scale):
+    """Exact attention; q,k,v [B, S, H, D] -> [B, S, H, D]. One golden
+    implementation only: wraps ops/pallas/flash_attention.py
+    reference_attention (which is [B, H, S, D]) with transposes so the
+    two can never drift numerically."""
+    from ..ops.pallas.flash_attention import reference_attention
+
+    out = reference_attention(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3), causal=causal, sm_scale=sm_scale)
+    return out.transpose(0, 2, 1, 3)
+
+
+def ulysses_attention(q, k, v, axis_name, causal=False, sm_scale=None):
+    """Call inside shard_map. q, k, v: [B, S_local, H, D] — this
+    device's SEQUENCE shard with the FULL head count H (H must divide
+    by the axis size). Returns [B, S_local, H, D]: the global-attention
+    output rows this device owns.
+    """
+    p = lax.axis_size(axis_name)
+    b, s_loc, h, d = q.shape
+    assert h % p == 0, (h, p)
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+
+    def seq_to_heads(t):
+        # [B, S/P, H, D] -> [B, S, H/P, D]: split heads over devices,
+        # gather the sequence
+        return lax.all_to_all(t, axis_name, split_axis=2,
+                              concat_axis=1, tiled=True)
+
+    def heads_to_seq(t):
+        return lax.all_to_all(t, axis_name, split_axis=1,
+                              concat_axis=2, tiled=True)
+
+    qf = seq_to_heads(q)
+    kf = seq_to_heads(k)
+    vf = seq_to_heads(v)
+    out = _attention(qf, kf, vf, causal, sm_scale)
+    return heads_to_seq(out)
+
+
+def ulysses_attention_sharded(q, k, v, mesh, seq_axis="sp",
+                              causal=False, sm_scale=None):
+    """pjit-level wrapper: q, k, v [B, S, H, D] with S sharded over
+    `seq_axis`; wraps ulysses_attention in shard_map and returns the
+    global output with the same sharding."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, seq_axis, None, None)
+
+    def fn(qq, kk, vv):
+        return ulysses_attention(qq, kk, vv, seq_axis, causal=causal,
+                                 sm_scale=sm_scale)
+
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)

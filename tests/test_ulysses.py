@@ -1,0 +1,74 @@
+"""Ulysses all-to-all sequence parallelism vs full attention on the
+8-device CPU mesh — forward and gradients (parallel/ulysses.py; the
+second long-context mode next to ring attention). Note the layout:
+ulysses uses [B, S, H, D]; the flash/ring reference uses [B, H, S, D].
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from paddle_tpu.ops.pallas.flash_attention import reference_attention
+from paddle_tpu.parallel.ulysses import (ulysses_attention,
+                                         ulysses_attention_sharded)
+
+
+def _mesh(n, name="sp"):
+    return Mesh(np.asarray(jax.devices()[:n]), (name,))
+
+
+def _rand(rng, *shape):
+    return jnp.asarray(rng.standard_normal(shape).astype("float32"))
+
+
+def _ref(q, k, v, causal):
+    # reference_attention takes [B, H, S, D]
+    out = reference_attention(q.transpose(0, 2, 1, 3),
+                              k.transpose(0, 2, 1, 3),
+                              v.transpose(0, 2, 1, 3), causal=causal)
+    return out.transpose(0, 2, 1, 3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("n_dev", [4, 8])
+def test_ulysses_matches_full_attention(causal, n_dev):
+    rng = np.random.default_rng(0)
+    b, s, h, d = 2, 64, 8, 16   # h divisible by both 4 and 8
+    q, k, v = (_rand(rng, b, s, h, d) for _ in range(3))
+    mesh = _mesh(n_dev)
+    out = ulysses_attention_sharded(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_ref(q, k, v, causal)),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_grads_match(causal):
+    rng = np.random.default_rng(1)
+    b, s, h, d = 1, 32, 4, 8
+    q, k, v = (_rand(rng, b, s, h, d) for _ in range(3))
+    w = _rand(rng, b, s, h, d)
+    mesh = _mesh(4)
+
+    def loss_u(q, k, v):
+        return jnp.sum(ulysses_attention_sharded(q, k, v, mesh,
+                                                 causal=causal) * w)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_ref(q, k, v, causal) * w)
+
+    g_u = jax.grad(loss_u, argnums=(0, 1, 2))(q, k, v)
+    g_r = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(g_u, g_r, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=2e-4, rtol=2e-4,
+                                   err_msg="d%s" % name)
+
+
+def test_ulysses_head_divisibility_enforced():
+    rng = np.random.default_rng(2)
+    q = k = v = _rand(rng, 1, 16, 3, 8)  # 3 heads on 4 devices
+    mesh = _mesh(4)
+    with pytest.raises(Exception):
+        np.asarray(ulysses_attention_sharded(q, k, v, mesh))
