@@ -49,6 +49,16 @@ class SPMDConfig:
     dtype: str = "bfloat16"     # compute dtype (params/opt state fp32)
     remat: bool = True          # jax.checkpoint each layer
     use_flash: bool = None      # Pallas flash attention (None = on TPU)
+    sp_mode: str = "megatron"   # "megatron" (SP over tp via gather/
+                                # scatter + sharded weights) or
+                                # "ulysses" (all-to-all head<->sequence
+                                # re-sharding, replicated weights)
+
+    def __post_init__(self):
+        if self.sp_mode not in ("megatron", "ulysses"):
+            raise ValueError(
+                "sp_mode must be 'megatron' or 'ulysses', got %r"
+                % (self.sp_mode,))
 
     @property
     def layers_per_stage(self):
@@ -78,11 +88,22 @@ def param_specs(cfg):
     from jax.sharding import PartitionSpec as P
 
     # stage-stacked layer params: leading 'pp' axis, then layers_per_stage
-    return {
-        "embed": P(None, None),
-        "pos": P(None, None),
-        "ln_f": {"scale": P(None), "bias": P(None)},
-        "layers": {
+    if cfg.sp_mode == "ulysses":
+        # Ulysses: weights REPLICATED over 'tp' (the axis carries only
+        # the sequence shards; attention re-shards via all-to-all), so
+        # their grads psum over 'tp' through _replicated_axes
+        layer_specs = {
+            "ln1_s": P("pp", None, None), "ln1_b": P("pp", None, None),
+            "wqkv": P("pp", None, None, None, None),
+            "wo": P("pp", None, None, None),
+            "ln2_s": P("pp", None, None), "ln2_b": P("pp", None, None),
+            "w1": P("pp", None, None, None),
+            "b1": P("pp", None, None),
+            "w2": P("pp", None, None, None),
+            "b2": P("pp", None, None),
+        }
+    else:
+        layer_specs = {
             "ln1_s": P("pp", None, None), "ln1_b": P("pp", None, None),
             "wqkv": P("pp", None, None, None, "tp"),
             "wo": P("pp", None, "tp", None),
@@ -91,7 +112,12 @@ def param_specs(cfg):
             "b1": P("pp", None, "tp"),
             "w2": P("pp", None, "tp", None),
             "b2": P("pp", None, None),
-        },
+        }
+    return {
+        "embed": P(None, None),
+        "pos": P(None, None),
+        "ln_f": {"scale": P(None), "bias": P(None)},
+        "layers": layer_specs,
     }
 
 
@@ -169,6 +195,9 @@ def _layer_fn(cfg, x_seq, lp, dropout_key):
         var = jnp.mean(jnp.square(xf - mu), -1, keepdims=True)
         return ((xf - mu) * lax.rsqrt(var + 1e-5) * s + b).astype(cdt)
 
+    if cfg.sp_mode == "ulysses":
+        return _layer_fn_ulysses(cfg, x_seq, lp, dropout_key, ln, cdt)
+
     # -- attention -----------------------------------------------------
     h = ln(x_seq, lp["ln1_s"], lp["ln1_b"])
     h_full = lax.all_gather(h, "tp", axis=1, tiled=True)  # [B, S, D]
@@ -212,6 +241,41 @@ def _layer_fn(cfg, x_seq, lp, dropout_key):
                                tiled=True)
     mlp_out = mlp_out + lp["b2"].astype(cdt)
     return x_seq + mlp_out
+
+
+def _layer_fn_ulysses(cfg, x_seq, lp, key, ln, cdt):
+    """Ulysses block on sequence-sharded x_seq [B, S/tp, D]: qkv and
+    mlp run LOCALLY on the shard with full-width (tp-replicated)
+    weights; only attention re-shards, via two all-to-alls
+    (parallel/ulysses.py). The 'tp' axis carries pure sequence
+    parallelism in this mode."""
+    import jax
+    import jax.numpy as jnp
+
+    from .ulysses import ulysses_attention
+
+    D = cfg.d_model
+    dh = cfg.d_head
+    B, S_loc, _ = x_seq.shape
+
+    h = ln(x_seq, lp["ln1_s"], lp["ln1_b"])
+    qkv = jnp.einsum("bsd,dke->bske", h,
+                     lp["wqkv"].astype(cdt))           # [B, S/tp, 3, D]
+    q, k_, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+    def to_heads(t):
+        return t.reshape(B, S_loc, cfg.n_heads, dh)
+
+    ctx = ulysses_attention(to_heads(q), to_heads(k_), to_heads(v),
+                            "tp", causal=True,
+                            sm_scale=1.0 / math.sqrt(dh),
+                            use_flash=bool(cfg.use_flash)).astype(cdt)
+    ctx = ctx.reshape(B, S_loc, D)
+    x_seq = x_seq + ctx @ lp["wo"].astype(cdt)
+
+    h = ln(x_seq, lp["ln2_s"], lp["ln2_b"])
+    a = jax.nn.gelu(h @ lp["w1"].astype(cdt) + lp["b1"].astype(cdt))
+    return x_seq + a @ lp["w2"].astype(cdt) + lp["b2"].astype(cdt)
 
 
 def _stage_fn(cfg, stage_params, x_seq, key):

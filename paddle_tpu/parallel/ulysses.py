@@ -25,20 +25,29 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _attention(q, k, v, causal, sm_scale):
-    """Exact attention; q,k,v [B, S, H, D] -> [B, S, H, D]. One golden
-    implementation only: wraps ops/pallas/flash_attention.py
-    reference_attention (which is [B, H, S, D]) with transposes so the
-    two can never drift numerically."""
-    from ..ops.pallas.flash_attention import reference_attention
+def _attention(q, k, v, causal, sm_scale, use_flash=False):
+    """Exact attention; q,k,v [B, S, H, D] -> [B, S, H, D]. Single
+    golden path: the [B, H, S, D] kernels from ops/pallas —
+    reference_attention (XLA) or the Pallas flash kernel for the long
+    sequences Ulysses targets (O(S) memory instead of the O(S^2) fp32
+    score matrix)."""
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    if use_flash:
+        from ..ops.pallas import flash_attention as _flash
 
-    out = reference_attention(
-        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-        v.transpose(0, 2, 1, 3), causal=causal, sm_scale=sm_scale)
+        out = _flash(qt, kt, vt, causal=causal, sm_scale=sm_scale)
+    else:
+        from ..ops.pallas.flash_attention import reference_attention
+
+        out = reference_attention(qt, kt, vt, causal=causal,
+                                  sm_scale=sm_scale)
     return out.transpose(0, 2, 1, 3)
 
 
-def ulysses_attention(q, k, v, axis_name, causal=False, sm_scale=None):
+def ulysses_attention(q, k, v, axis_name, causal=False, sm_scale=None,
+                      use_flash=False):
     """Call inside shard_map. q, k, v: [B, S_local, H, D] — this
     device's SEQUENCE shard with the FULL head count H (H must divide
     by the axis size). Returns [B, S_local, H, D]: the global-attention
@@ -63,12 +72,13 @@ def ulysses_attention(q, k, v, axis_name, causal=False, sm_scale=None):
     qf = seq_to_heads(q)
     kf = seq_to_heads(k)
     vf = seq_to_heads(v)
-    out = _attention(qf, kf, vf, causal, sm_scale)
+    out = _attention(qf, kf, vf, causal, sm_scale, use_flash=use_flash)
     return heads_to_seq(out)
 
 
 def ulysses_attention_sharded(q, k, v, mesh, seq_axis="sp",
-                              causal=False, sm_scale=None):
+                              causal=False, sm_scale=None,
+                              use_flash=False):
     """pjit-level wrapper: q, k, v [B, S, H, D] with S sharded over
     `seq_axis`; wraps ulysses_attention in shard_map and returns the
     global output with the same sharding."""
@@ -78,7 +88,7 @@ def ulysses_attention_sharded(q, k, v, mesh, seq_axis="sp",
 
     def fn(qq, kk, vv):
         return ulysses_attention(qq, kk, vv, seq_axis, causal=causal,
-                                 sm_scale=sm_scale)
+                                 sm_scale=sm_scale, use_flash=use_flash)
 
     return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)
