@@ -249,3 +249,58 @@ def _chunk_eval(ins, attrs):
             "NumInferChunks": np.asarray([len(pred)], np.int64),
             "NumLabelChunks": np.asarray([len(gold)], np.int64),
             "NumCorrectChunks": np.asarray([correct], np.int64)}
+
+
+@register_op("positive_negative_pair", no_jit=True)
+def _positive_negative_pair(ins, attrs):
+    """Ranking pair statistics per query (reference:
+    positive_negative_pair_op.h:25): for every same-query doc pair with
+    different labels, weight w = mean of the two doc weights; concordant
+    score/label ordering counts positive, discordant negative; equal
+    scores count neutral AND negative (the reference's ternary runs
+    after the neu += w — mirrored faithfully)."""
+    import numpy as np
+
+    score = np.asarray(ins["Score"][0], np.float64)
+    label = np.asarray(ins["Label"][0], np.float64).reshape(-1)
+    query = np.asarray(ins["QueryID"][0]).reshape(-1).astype(np.int64)
+    weight = np.asarray(ins["Weight"][0], np.float64).reshape(-1) \
+        if ins.get("Weight") else np.ones_like(label)
+    column = int(attrs.get("column", 0))
+    if score.ndim == 1:
+        score = score[:, None]
+    if column < 0:
+        column += score.shape[1]
+    s = score[:, column]
+    pos = neg = neu = 0.0
+    # reference requires ALL THREE accumulators together (&&); any
+    # partial set starts from zero rather than crashing
+    if (ins.get("AccumulatePositivePair")
+            and ins.get("AccumulateNegativePair")
+            and ins.get("AccumulateNeutralPair")):
+        pos = float(np.asarray(
+            ins["AccumulatePositivePair"][0]).reshape(-1)[0])
+        neg = float(np.asarray(
+            ins["AccumulateNegativePair"][0]).reshape(-1)[0])
+        neu = float(np.asarray(
+            ins["AccumulateNeutralPair"][0]).reshape(-1)[0])
+    by_query = {}
+    for i in range(len(label)):
+        by_query.setdefault(int(query[i]), []).append(i)
+    for idxs in by_query.values():
+        for a_pos in range(len(idxs)):
+            for b_pos in range(a_pos + 1, len(idxs)):
+                i, j = idxs[a_pos], idxs[b_pos]
+                if label[i] == label[j]:
+                    continue
+                w = (weight[i] + weight[j]) * 0.5
+                if s[i] == s[j]:
+                    neu += w
+                if (s[i] - s[j]) * (label[i] - label[j]) > 0.0:
+                    pos += w
+                else:
+                    neg += w
+    odt = np.asarray(ins["Score"][0]).dtype  # outputs use Score's T
+    return {"PositivePair": np.asarray([pos], odt),
+            "NegativePair": np.asarray([neg], odt),
+            "NeutralPair": np.asarray([neu], odt)}

@@ -358,3 +358,23 @@ def _average_accumulates(ins, attrs):
             "out_num_accumulates": num.reshape((1,)),
             "out_old_num_accumulates": old.reshape((1,)),
             "out_num_updates": upd.reshape((1,))}
+
+
+@register_op("dgc_clip_by_norm")
+def _dgc_clip_by_norm(ins, attrs):
+    """clip_by_norm gated on the DGC rampup step (reference:
+    dgc_clip_by_norm_op.h:23 — delegates to the registered clip_by_norm
+    exactly as the reference kernel inherits ClipByNormKernel; both
+    sides of the comparison truncate to int, mirroring the
+    static_cast<int> semantics)."""
+    from .math_ops import _clip_by_norm
+
+    x = ins["X"][0]
+    rampup = int(float(attrs.get("rampup_begin_step", 0.0)))
+    if rampup < 0:  # reference: negative rampup disables clipping
+        return {"Out": x}
+    step = ins["current_step"][0].reshape(()).astype(jnp.int32) \
+        if ins.get("current_step") else jnp.int32(0)
+    clipped = _clip_by_norm(
+        {"X": [x]}, {"max_norm": attrs.get("max_norm", 1.0)})["Out"]
+    return {"Out": jnp.where(step >= rampup, clipped, x)}

@@ -505,3 +505,70 @@ def test_tdm_sampler_layerwise():
     assert (msk == 1).all()
     # negatives never equal the positive on their layer
     assert 3 not in o[0, 3:] and 6 not in o[1, 3:]
+
+
+def test_positive_negative_pair():
+    # reference: positive_negative_pair_op.h — 1 query, 3 docs
+    score = np.asarray([[0.9], [0.5], [0.5]], "float32")
+    label = np.asarray([[2.0], [1.0], [0.0]], "float32")
+    query = np.asarray([[7], [7], [7]], "int64")
+    out = run_op("positive_negative_pair",
+                 {"Score": [score], "Label": [label],
+                  "QueryID": [query]}, {"column": 0})
+    # pairs: (0,1) concordant -> pos; (0,2) concordant -> pos;
+    # (1,2) equal scores, labels differ -> neutral AND negative
+    # (reference ternary quirk)
+    assert float(_np(out["PositivePair"][0])[0]) == 2.0
+    assert float(_np(out["NegativePair"][0])[0]) == 1.0
+    assert float(_np(out["NeutralPair"][0])[0]) == 1.0
+    # accumulation inputs carry forward
+    out2 = run_op("positive_negative_pair",
+                  {"Score": [score], "Label": [label],
+                   "QueryID": [query],
+                   "AccumulatePositivePair": [out["PositivePair"][0]],
+                   "AccumulateNegativePair": [out["NegativePair"][0]],
+                   "AccumulateNeutralPair": [out["NeutralPair"][0]]},
+                  {"column": 0})
+    assert float(_np(out2["PositivePair"][0])[0]) == 4.0
+
+
+def test_dgc_clip_by_norm_rampup_gate():
+    # reference: dgc_clip_by_norm_op.h — no clipping before rampup
+    x = jnp.asarray(np.asarray([3.0, 4.0], "float32"))  # norm 5
+    pre = run_op("dgc_clip_by_norm",
+                 {"X": [x], "current_step": [jnp.asarray([2.0])]},
+                 {"max_norm": 1.0, "rampup_begin_step": 10.0})
+    np.testing.assert_allclose(_np(pre["Out"][0]), [3.0, 4.0])
+    post = run_op("dgc_clip_by_norm",
+                  {"X": [x], "current_step": [jnp.asarray([20.0])]},
+                  {"max_norm": 1.0, "rampup_begin_step": 10.0})
+    np.testing.assert_allclose(_np(post["Out"][0]), [0.6, 0.8],
+                               rtol=1e-6)
+
+
+def test_dgc_clip_by_norm_int_truncation_and_negative_rampup():
+    # reference static_cast<int> semantics: step 10.0 vs rampup 10.7
+    # compares 10 >= 10 -> clips; negative rampup disables
+    x = jnp.asarray(np.asarray([3.0, 4.0], "float32"))
+    out = run_op("dgc_clip_by_norm",
+                 {"X": [x], "current_step": [jnp.asarray([10.0])]},
+                 {"max_norm": 1.0, "rampup_begin_step": 10.7})
+    np.testing.assert_allclose(_np(out["Out"][0]), [0.6, 0.8], rtol=1e-6)
+    out = run_op("dgc_clip_by_norm",
+                 {"X": [x], "current_step": [jnp.asarray([99.0])]},
+                 {"max_norm": 1.0, "rampup_begin_step": -1.0})
+    np.testing.assert_allclose(_np(out["Out"][0]), [3.0, 4.0])
+
+
+def test_positive_negative_pair_partial_accumulators_start_zero():
+    score = np.asarray([[0.9], [0.5]], "float32")
+    label = np.asarray([[1.0], [0.0]], "float32")
+    query = np.asarray([[1], [1]], "int64")
+    out = run_op("positive_negative_pair",
+                 {"Score": [score], "Label": [label], "QueryID": [query],
+                  "AccumulatePositivePair": [np.asarray([5.0],
+                                                        "float32")]},
+                 {"column": 0})
+    # partial accumulator set ignored (reference && semantics)
+    assert float(_np(out["PositivePair"][0])[0]) == 1.0
+    assert _np(out["PositivePair"][0]).dtype == np.float32
