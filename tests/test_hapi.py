@@ -164,3 +164,23 @@ def test_fit_auto_checkpoint_resume(tmp_path):
 
     # resumed training kept improving rather than restarting
     assert h2[-1]["loss"] < h1[0]["loss"]
+
+
+def test_fit_hapi_resnet18_zoo_model():
+    """The new dygraph zoo ResNet trains under hapi.Model.fit
+    (zoo + trainer composition, reference test_vision_models shape)."""
+    from paddle_tpu.hapi.vision.models import resnet18
+
+    net = resnet18(num_classes=4)
+    m = Model(net)
+    m.prepare(optimizer=paddle.fluid.optimizer.AdamOptimizer(1e-3),
+              loss_function=paddle.nn.CrossEntropyLoss(),
+              metrics=Accuracy())
+    rng = np.random.RandomState(0)
+    xs = rng.rand(16, 3, 32, 32).astype("float32")
+    ys = rng.randint(0, 4, (16, 1)).astype("int64")
+    hist = m.fit(TensorDataset(xs, ys), batch_size=8, epochs=2,
+                 verbose=0)
+    losses = hist["loss"] if isinstance(hist, dict) else None
+    ev = m.evaluate(TensorDataset(xs, ys), batch_size=8, verbose=0)
+    assert np.isfinite(list(ev.values())[0])
