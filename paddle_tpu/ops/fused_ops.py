@@ -315,3 +315,136 @@ def _fused_linear_softmax_xent(ins, attrs):
     (m, s, picked), _ = jax.lax.scan(jax.checkpoint(body), init, starts)
     loss = m + jnp.log(s) - picked
     return {"Loss": loss.reshape(lead_shape + (1,))}
+
+
+@register_op("fc")
+def _fc(ins, attrs):
+    """Fused FC (reference: fc_op.h:49): flatten Input at
+    in_num_col_dims, matmul W, optional Bias broadcast-add, optional
+    relu. padding_weights (cuDNN alignment trick) is meaningless under
+    XLA and rejected."""
+    if attrs.get("padding_weights", False):
+        raise NotImplementedError(
+            "fc padding_weights is a cuDNN alignment layout; XLA tiles "
+            "weights itself — store W unpadded")
+    x = ins["Input"][0]
+    w = ins["W"][0]
+    ncd = int(attrs.get("in_num_col_dims", 1))
+    lead = x.shape[:ncd]
+    x2 = x.reshape((-1, int(jnp.prod(jnp.asarray(x.shape[ncd:])))
+                    if x.ndim > ncd else x.shape[-1]))
+    out = x2 @ w
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0].reshape((1, -1))
+    if attrs.get("activation_type", "") == "relu":
+        out = jax.nn.relu(out)
+    return {"Out": out.reshape(lead + (w.shape[1],))}
+
+
+@register_op("conv2d_fusion")
+def _conv2d_fusion(ins, attrs):
+    """conv2d + bias + activation (+ residual) in one op (reference:
+    fused/conv2d_fusion_op.cc — a cuDNN fused kernel; XLA fuses this
+    composition automatically, so it is expressed as one)."""
+    if attrs.get("split_channels"):
+        raise NotImplementedError(
+            "conv2d_fusion split_channels (multi-output slice) is not "
+            "supported; emit separate conv2d ops — XLA fuses them")
+    conv_out = get_op("conv2d").compute(
+        {"Input": ins["Input"], "Filter": ins["Filter"]}, attrs)["Output"]
+    if ins.get("Bias"):
+        conv_out = conv_out + ins["Bias"][0].reshape(1, -1, 1, 1)
+    if ins.get("ResidualData"):
+        conv_out = conv_out + ins["ResidualData"][0]
+    act = attrs.get("activation", "relu")
+    if act == "relu":
+        conv_out = jax.nn.relu(conv_out)
+    elif act == "identity" or not act:
+        pass
+    else:
+        raise NotImplementedError("conv2d_fusion activation %r" % act)
+    return {"Output": conv_out}
+
+
+@register_op("fused_batch_norm_act")
+def _fused_batch_norm_act(ins, attrs):
+    """batch_norm + activation (reference: fused/fused_bn_activation_op
+    — a cuDNN fused kernel; composed here, XLA fuses)."""
+    outs = get_op("batch_norm").compute(ins, attrs)
+    act = attrs.get("act_type", "relu")
+    if act == "relu":
+        outs["Y"] = jax.nn.relu(outs["Y"])
+    elif act:
+        raise NotImplementedError("fused_batch_norm_act %r" % act)
+    return outs
+
+
+@register_op("fusion_seqpool_cvm_concat")
+def _fusion_seqpool_cvm_concat(ins, attrs):
+    """seqpool each input (SUM/AVERAGE/SQRT), apply the CVM transform
+    IN PLACE on the two leading slots (reference:
+    fused/fusion_seqpool_cvm_concat_op.cc:127-129 —
+    dst[0] = log(show+1), dst[1] = log(click+1) - log(show+1); the
+    reference supports only use_cvm=true here), concat along axis 1.
+    Composes the registered sequence_pool (Length slot convention);
+    XLA fuses the chain."""
+    pooled = []
+    lengths = ins.get("Length", [])
+    ptype = attrs.get("pooltype", "SUM")
+    for i, x in enumerate(ins["X"]):
+        sub = {"X": [x]}
+        if i < len(lengths):
+            sub["Length"] = [lengths[i]]
+        p = get_op("sequence_pool").compute(
+            sub, {"pooltype": ptype})["Out"]
+        if isinstance(p, (list, tuple)):
+            p = p[0]
+        show = jnp.log(p[:, :1] + 1.0)
+        click = jnp.log(p[:, 1:2] + 1.0) - show
+        pooled.append(jnp.concatenate([show, click, p[:, 2:]], axis=1))
+    return {"Out": jnp.concatenate(pooled, axis=1)}
+
+
+@register_op("fusion_transpose_flatten_concat")
+def _fusion_transpose_flatten_concat(ins, attrs):
+    """transpose(trans_axis) -> flatten(flatten_axis) -> concat
+    (reference: fused/fusion_transpose_flatten_concat_op.cc)."""
+    trans = tuple(attrs["trans_axis"])
+    flat_axis = int(attrs["flatten_axis"])
+    concat_axis = int(attrs["concat_axis"])
+    outs = []
+    for x in ins["X"]:
+        t = jnp.transpose(x, trans)
+        lead = 1
+        for d in t.shape[:flat_axis]:
+            lead *= d
+        outs.append(t.reshape(lead, -1))
+    return {"Out": jnp.concatenate(outs, axis=concat_axis)}
+
+
+@register_op("lookup_table_dequant", no_jit=True)
+def _lookup_table_dequant(ins, attrs):
+    """int8-quantized embedding lookup (reference:
+    lookup_table_dequant_op.h:40): each table row is [min, max,
+    packed bytes] in float32 slots — 4 uint8 codes per slot; out =
+    (max-min)/256 * code + min. padding_idx rows emit zeros."""
+    import numpy as np
+
+    ids = np.asarray(ins["Ids"][0]).reshape(-1).astype(np.int64)
+    table = np.asarray(ins["W"][0], np.float32)
+    padding_idx = int(attrs.get("padding_idx", -1))
+    quant_number = table.shape[1]
+    row_width = (quant_number - 2) * 4
+    rows = table[ids]                                   # [N, quant]
+    mins = rows[:, 0:1]
+    maxs = rows[:, 1:2]
+    scale = (maxs - mins) / 256.0
+    codes = rows[:, 2:].astype(np.float32).view(np.uint8).reshape(
+        len(ids), row_width)
+    out = scale * codes.astype(np.float32) + mins
+    if padding_idx != -1:
+        out[ids == padding_idx] = 0.0
+    # reference InferShape drops Ids' trailing 1:
+    # lookup_table_dequant_op.cc:61-71
+    shape = tuple(np.asarray(ins["Ids"][0]).shape)[:-1] + (row_width,)
+    return {"Out": out.reshape(shape)}

@@ -746,3 +746,27 @@ def _gaussian_random_bsl(ins, attrs):
     out = mean + std * _jax.random.normal(attrs["_rng_key"],
                                           tuple(shape))
     return {"Out": out.astype(dtype)}
+
+
+@register_op("fill")
+def _fill(ins, attrs):
+    """Out = reshape(value_list, shape) (reference: fill_op.h:43 — the
+    buffer is authored host-side from the attr then copied in)."""
+    from ..core.types import to_numpy_dtype
+
+    shape = tuple(int(d) for d in attrs["shape"])
+    dtype = to_numpy_dtype(attrs.get("dtype", "float32"))
+    vals = np.asarray(attrs["value"], np.float64).astype(dtype)
+    return {"Out": jnp.asarray(vals.reshape(shape))}
+
+
+@register_op("fill_zeros_like2")
+def _fill_zeros_like2(ins, attrs):
+    """fill_zeros_like with an explicit dtype attr (reference:
+    fill_zeros_like_op.cc FillZerosLike2)."""
+    from ..core.types import to_numpy_dtype
+
+    x = ins["X"][0]
+    dtype = attrs.get("dtype")
+    dt = to_numpy_dtype(dtype) if dtype is not None else x.dtype
+    return {"Out": jnp.zeros(x.shape, dt)}

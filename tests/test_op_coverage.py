@@ -1,0 +1,22 @@
+"""Zero genuine op absentees vs the reference's REGISTER_OPERATOR scan
+(tools/op_coverage.py) — round-3's VERDICT found ~20 this way; this
+test keeps the gap closed. Skips when the reference tree is absent."""
+import os
+
+import pytest
+
+
+def test_no_genuine_op_absentees():
+    if not os.path.isdir("/root/reference/paddle/fluid/operators"):
+        pytest.skip("reference tree not available")
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    import op_coverage
+
+    missing, n_ref, n_have = op_coverage.missing_ops()
+    assert not missing, (
+        "op absentees reopened vs reference scan: %s" % missing)
+    assert n_ref > 250 and n_have > 500  # scan sanity

@@ -2,6 +2,7 @@
 metric/loss ops, control/array utilities, the detection NMS family, and
 the quant variants — each checked against a numpy re-derivation of the
 reference kernel's semantics (reference files cited per test)."""
+import os
 import numpy as np
 import pytest
 
@@ -572,3 +573,112 @@ def test_positive_negative_pair_partial_accumulators_start_zero():
     # partial accumulator set ignored (reference && semantics)
     assert float(_np(out["PositivePair"][0])[0]) == 1.0
     assert _np(out["PositivePair"][0]).dtype == np.float32
+
+
+def test_fc_fused_op():
+    # reference: fc_op.h:49 — flatten + matmul + bias + relu
+    rng = np.random.RandomState(7)
+    x = rng.randn(2, 3, 4).astype("float32")
+    w = rng.randn(12, 5).astype("float32")
+    b = rng.randn(5).astype("float32")
+    out = run_op("fc", {"Input": [jnp.asarray(x)], "W": [jnp.asarray(w)],
+                        "Bias": [jnp.asarray(b)]},
+                 {"in_num_col_dims": 1, "activation_type": "relu"})
+    want = np.maximum(x.reshape(2, 12) @ w + b, 0.0)
+    np.testing.assert_allclose(_np(out["Out"][0]).reshape(2, 5), want,
+                               rtol=1e-5)
+    with pytest.raises(NotImplementedError, match="padding_weights"):
+        run_op("fc", {"Input": [jnp.asarray(x)], "W": [jnp.asarray(w)]},
+               {"padding_weights": True})
+
+
+def test_fill_and_fill_zeros_like2():
+    out = run_op("fill", {}, {"shape": [2, 2], "dtype": "int64",
+                              "value": [1.0, 2.0, 3.0, 4.0]})
+    np.testing.assert_array_equal(_np(out["Out"][0]), [[1, 2], [3, 4]])
+    out = run_op("fill_zeros_like2",
+                 {"X": [jnp.ones((2, 3), "float32")]},
+                 {"dtype": "int32"})
+    assert _np(out["Out"][0]).dtype == np.int32
+    assert (_np(out["Out"][0]) == 0).all()
+
+
+def test_conv2d_fusion_compose():
+    rng = np.random.RandomState(8)
+    x = rng.randn(1, 2, 5, 5).astype("float32")
+    w = rng.randn(3, 2, 3, 3).astype("float32")
+    b = rng.randn(3).astype("float32")
+    fused = run_op("conv2d_fusion",
+                   {"Input": [jnp.asarray(x)], "Filter": [jnp.asarray(w)],
+                    "Bias": [jnp.asarray(b)]},
+                   {"strides": [1, 1], "paddings": [1, 1],
+                    "activation": "relu"})["Output"][0]
+    base = run_op("conv2d",
+                  {"Input": [jnp.asarray(x)], "Filter": [jnp.asarray(w)]},
+                  {"strides": [1, 1], "paddings": [1, 1]})["Output"][0]
+    want = np.maximum(_np(base) + b.reshape(1, -1, 1, 1), 0.0)
+    np.testing.assert_allclose(_np(fused), want, rtol=1e-5, atol=1e-5)
+
+
+def test_fusion_transpose_flatten_concat():
+    rng = np.random.RandomState(9)
+    a = rng.randn(2, 3, 4).astype("float32")
+    b = rng.randn(2, 5, 4).astype("float32")
+    out = run_op("fusion_transpose_flatten_concat",
+                 {"X": [jnp.asarray(a), jnp.asarray(b)]},
+                 {"trans_axis": [0, 2, 1], "flatten_axis": 1,
+                  "concat_axis": 1})["Out"][0]
+    wa = a.transpose(0, 2, 1).reshape(2, -1)
+    wb = b.transpose(0, 2, 1).reshape(2, -1)
+    np.testing.assert_allclose(_np(out), np.concatenate([wa, wb], 1),
+                               rtol=1e-6)
+
+
+def test_lookup_table_dequant_golden():
+    # rows: [min, max, 4 packed uint8 codes per float32 slot]
+    codes = np.asarray([[0, 64, 128, 255], [10, 20, 30, 40]], np.uint8)
+    packed = codes.reshape(2, 4).view(np.float32)  # [2, 1]
+    table = np.concatenate(
+        [np.asarray([[-1.0], [0.0]], np.float32),   # mins
+         np.asarray([[1.0], [2.0]], np.float32),    # maxs
+         packed], axis=1)                           # [2, 3]
+    out = run_op("lookup_table_dequant",
+                 {"Ids": [np.asarray([[1], [0]], np.int64)],
+                  "W": [table]}, {})["Out"][0]
+    got = _np(out)
+    scale0 = (1.0 - (-1.0)) / 256.0
+    scale1 = (2.0 - 0.0) / 256.0
+    want_row1 = scale1 * codes[1].astype(np.float32) + 0.0
+    want_row0 = scale0 * codes[0].astype(np.float32) + (-1.0)
+    assert got.shape == (2, 4)  # Ids trailing 1 dropped (reference)
+    np.testing.assert_allclose(got[0], want_row1, rtol=1e-6)
+    np.testing.assert_allclose(got[1], want_row0, rtol=1e-6)
+
+
+def test_fusion_seqpool_cvm_concat():
+    """Reference fusion_seqpool_cvm_concat_op.cc:127-129: per pooled
+    row, slot0 -> log(show+1), slot1 -> log(click+1) - log(show+1)."""
+    x1 = np.asarray([[[1., 2., 3.], [4., 5., 6.]]], "float32")
+    x2 = np.asarray([[[10., 0., 1.], [7., 1., 2.]]], "float32")
+    cvm = np.asarray([[1.0, 0.5]], "float32")
+    out = run_op("fusion_seqpool_cvm_concat",
+                 {"X": [jnp.asarray(x1), jnp.asarray(x2)],
+                  "CVM": [jnp.asarray(cvm)]},
+                 {"pooltype": "SUM", "use_cvm": True})["Out"][0]
+
+    def cvm_t(row):
+        show = np.log(row[0] + 1.0)
+        click = np.log(row[1] + 1.0) - show
+        return np.concatenate([[show, click], row[2:]])
+
+    want = np.concatenate([cvm_t(x1.sum(1)[0]), cvm_t(x2.sum(1)[0])])
+    np.testing.assert_allclose(_np(out).reshape(-1), want, rtol=1e-5)
+
+    # AVERAGE pooltype honored through the composed sequence_pool
+    out_avg = run_op("fusion_seqpool_cvm_concat",
+                     {"X": [jnp.asarray(x1)], "CVM": [jnp.asarray(cvm)]},
+                     {"pooltype": "AVERAGE"})["Out"][0]
+    np.testing.assert_allclose(_np(out_avg).reshape(-1),
+                               cvm_t(x1.mean(1)[0]), rtol=1e-5)
+
+

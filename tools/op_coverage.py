@@ -1,0 +1,118 @@
+"""Op-coverage audit: reference REGISTER_OPERATOR scan vs the registry.
+
+Extracts every forward op type registered in the reference
+(`REGISTER_OPERATOR` / `REGISTER_OP_WITHOUT_GRADIENT` in
+/root/reference/paddle/fluid/operators/**.cc), subtracts the two
+DOCUMENTED exclusion lists below, and reports what's genuinely absent
+from `paddle_tpu.ops.registry`. Round-3's VERDICT found ~20 absentees
+this way; tests/test_op_coverage.py pins the count at zero so the gap
+cannot silently reopen.
+
+Usage: python tools/op_coverage.py [--ref /root/reference]
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# Lowered into the framework rather than the op registry: control flow
+# (traced to lax.while/cond), feed/fetch/readers (executor + DataLoader),
+# save/load (fluid.io), comm bootstrap + stream sync (mesh construction /
+# XLA dataflow), PS RPC ops (distributed/rpc.py tier), runtime queue
+# plumbing (pipeline engine owns its buffers).
+LOWERED = {
+    "while", "conditional_block", "conditional_block_infer", "feed",
+    "fetch", "recurrent", "read_from_array", "write_to_array",
+    "create_py_reader", "read", "double_buffer", "get_places",
+    "parallel_do", "save", "load", "save_combine", "load_combine",
+    "checkpoint_notify", "gen_nccl_id", "c_gen_nccl_id", "c_comm_init",
+    "c_comm_init_all", "c_sync_calc_stream", "c_sync_comm_stream",
+    "listen_and_serv", "send", "recv", "send_barrier", "fetch_barrier",
+    "fl_listen_and_serv", "distributed_notify", "prefetch",
+    "split_ids", "merge_ids", "split_byref", "ref_by_trainer_id",
+    "send_and_recv", "fake_init", "nop", "enqueue", "dequeue", "nccl",
+    "queue_generator", "cross_entropy_grad2", "create_custom_reader",
+    "delete_var", "rnn_memory_helper",
+}
+
+# Descoped subsystems (SURVEY.md §7.9): TensorRT/Lite engines, NVRTC
+# fusion_group, BoxPS/pslib massive-scale PS pulls.
+DESCOPED = {
+    "tensorrt_engine", "lite_engine", "fusion_group",
+    "pull_box_sparse", "pull_box_extended_sparse", "push_box_sparse",
+    "pull_sparse", "push_sparse", "pull_sparse_v2",
+    # pslib massive-scale PS tier (SURVEY §7.9)
+    "lookup_sparse_table", "push_dense",
+    # cuDNN-specific inception fusion: XLA fuses the unfused branch
+    # graph automatically; no separate kernel needed
+    "conv2d_inception_fusion",
+}
+
+# Renamed: reference name -> registry name.
+RENAMED = {"mul": "matmul", "hierarchical_sigmoid": "hsigmoid",
+           "merge_lod_tensor_infer": "merge_lod_tensor"}
+
+
+def reference_fwd_ops(ref_root):
+    pat = re.compile(
+        r"REGISTER_OPERATOR\(\s*([a-z0-9_]+)|"
+        r"REGISTER_OP_WITHOUT_GRADIENT\(\s*([a-z0-9_]+)")
+    ops = set()
+    base = os.path.join(ref_root, "paddle", "fluid", "operators")
+    for dirpath, _dirs, files in os.walk(base):
+        for fn in files:
+            if not fn.endswith(".cc"):
+                continue
+            try:
+                text = open(os.path.join(dirpath, fn)).read()
+            except OSError:
+                continue
+            for m in pat.finditer(text):
+                name = m.group(1) or m.group(2)
+                if name and not name.endswith("_grad"):
+                    ops.add(name)
+    return ops
+
+
+def missing_ops(ref_root="/root/reference"):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.ops.registry import registered_ops
+
+    ref = reference_fwd_ops(ref_root)
+    have = set(registered_ops())
+    covered = have | LOWERED | DESCOPED
+    covered |= {r for r, n in RENAMED.items() if n in have}
+    return sorted(ref - covered), len(ref), len(have)
+
+
+def main():
+    ref_root = "/root/reference"
+    args = sys.argv[1:]
+    for i, a in enumerate(args):
+        if a.startswith("--ref="):
+            ref_root = a.split("=", 1)[1]
+        elif a == "--ref" and i + 1 < len(args):
+            ref_root = args[i + 1]
+    missing, n_ref, n_have = missing_ops(ref_root)
+    print("reference forward op types: %d" % n_ref)
+    print("registry op types: %d" % n_have)
+    print("documented lowered: %d, descoped: %d, renamed: %d"
+          % (len(LOWERED), len(DESCOPED), len(RENAMED)))
+    if missing:
+        print("MISSING (%d):" % len(missing))
+        for m in missing:
+            print("  %s" % m)
+        return 1
+    print("missing: NONE")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
