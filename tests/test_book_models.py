@@ -129,3 +129,105 @@ def test_public_api_surface_locked():
             if not hasattr(mod, n):
                 missing.append("%s.%s" % (mod_name, n))
     assert not missing, missing
+
+
+def test_label_semantic_roles_crf_trains_and_decodes(rng):
+    """Book model: label_semantic_roles (reference:
+    tests/book/test_label_semantic_roles.py) — embeddings + fc emission
+    + linear_chain_crf training, crf_decoding inference, fed from the
+    paddle.dataset.conll05 reader shape."""
+    import paddle_tpu.dataset.conll05 as conll05
+
+    word_dict, verb_dict, label_dict = conll05.get_dict()
+    n_labels = len(label_dict)
+    seq_len, batch = 12, 8
+
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = startup.random_seed = 17
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            word = fluid.layers.data("word", shape=[seq_len],
+                                     dtype="int64")
+            label = fluid.layers.data("label", shape=[seq_len],
+                                      dtype="int64")
+            length = fluid.layers.data("length", shape=[1],
+                                       dtype="int64")
+            emb = fluid.layers.embedding(
+                word, size=[len(word_dict), 32])
+            hidden = fluid.layers.fc(emb, size=64, act="tanh",
+                                     num_flatten_dims=2)
+            emission = fluid.layers.fc(
+                hidden, size=n_labels, num_flatten_dims=2,
+                param_attr=fluid.ParamAttr(name="emission_fc.w"))
+            crf_cost = fluid.layers.linear_chain_crf(
+                emission, label,
+                param_attr=fluid.ParamAttr(name="crfw"),
+                length=length)
+            loss = fluid.layers.mean(crf_cost)
+            decode = fluid.layers.crf_decoding(
+                emission, param_attr=fluid.ParamAttr(name="crfw"),
+                length=length)
+            fluid.optimizer.SGDOptimizer(1e-2).minimize(loss)
+
+            exe = fluid.Executor()
+            exe.run(startup)
+            words = rng.randint(0, len(word_dict),
+                                (batch, seq_len)).astype("int64")
+            labels = rng.randint(0, n_labels,
+                                 (batch, seq_len)).astype("int64")
+            lens = np.full((batch, 1), seq_len, "int64")
+            losses = []
+            for _ in range(6):
+                out = exe.run(main,
+                              feed={"word": words, "label": labels,
+                                    "length": lens},
+                              fetch_list=[loss])
+                losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+            assert losses[-1] < losses[0], losses
+            path = exe.run(main,
+                           feed={"word": words, "label": labels,
+                                 "length": lens},
+                           fetch_list=[decode])[0]
+            path = np.asarray(path)
+            assert path.shape == (batch, seq_len)
+            assert (path >= 0).all() and (path < n_labels).all()
+
+
+def test_understand_sentiment_lstm_trains(rng):
+    """Book model: understand_sentiment (reference:
+    tests/book/test_understand_sentiment.py) — embedding + LSTM + pool
+    + softmax classifier over the paddle.dataset.imdb vocabulary."""
+    import paddle_tpu.dataset.imdb as imdb
+
+    word_dict = imdb.word_dict()
+    seq_len, batch = 16, 8
+
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = startup.random_seed = 19
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            data = fluid.layers.data("words", shape=[seq_len],
+                                     dtype="int64")
+            label = fluid.layers.data("label", shape=[1], dtype="int64")
+            emb = fluid.layers.embedding(
+                data, size=[len(word_dict), 32])
+            lstm_out, _cell = fluid.layers.dynamic_lstm(
+                fluid.layers.fc(emb, size=4 * 32, num_flatten_dims=2),
+                size=4 * 32)
+            pooled = fluid.layers.reduce_max(lstm_out, dim=1)
+            logits = fluid.layers.fc(pooled, size=2)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, label))
+            fluid.optimizer.AdamOptimizer(1e-2).minimize(loss)
+
+            exe = fluid.Executor()
+            exe.run(startup)
+            xs = rng.randint(0, len(word_dict),
+                             (batch, seq_len)).astype("int64")
+            ys = rng.randint(0, 2, (batch, 1)).astype("int64")
+            losses = []
+            for _ in range(8):
+                out = exe.run(main, feed={"words": xs, "label": ys},
+                              fetch_list=[loss])
+                losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+            assert losses[-1] < losses[0], losses
