@@ -682,3 +682,48 @@ def test_fusion_seqpool_cvm_concat():
                                cvm_t(x1.mean(1)[0]), rtol=1e-5)
 
 
+
+
+# ---------------------------------------------------- numeric gradients
+import sys as _sys
+
+_sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from op_test import OpTest  # noqa: E402
+
+
+class TestModifiedHuberLossGrad(OpTest):
+    op_type = "modified_huber_loss"
+
+    def test_grad(self):
+        r = np.random.RandomState(3)
+        # keep x away from the piecewise joints (+-1) so the central
+        # difference stays on one branch
+        x = (r.rand(24).astype("float32") * 3.0 - 1.5)
+        x = np.where(np.abs(np.abs(x) - 1.0) < 0.1, x + 0.25, x)
+        y = r.randint(0, 2, (24,)).astype("float32")
+        self.inputs = {"X": x.astype("float32"), "Y": y}
+        self.attrs = {}
+        self.check_grad(["X"], "Out")
+
+
+class TestSquaredL2DistanceGrad(OpTest):
+    op_type = "squared_l2_distance"
+
+    def test_grad(self):
+        r = np.random.RandomState(4)
+        self.inputs = {"X": r.rand(4, 6).astype("float32"),
+                       "Y": r.rand(4, 6).astype("float32")}
+        self.attrs = {}
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestFcGrad(OpTest):
+    op_type = "fc"
+
+    def test_grad(self):
+        r = np.random.RandomState(5)
+        self.inputs = {"Input": r.rand(3, 4).astype("float32"),
+                       "W": r.rand(4, 5).astype("float32"),
+                       "Bias": r.rand(5).astype("float32")}
+        self.attrs = {"in_num_col_dims": 1, "activation_type": ""}
+        self.check_grad(["Input", "W", "Bias"], "Out")
