@@ -179,7 +179,11 @@ class DistributedStrategy:
                         block[ck] = (prev if isinstance(prev, list)
                                      else [prev]) + [val]
                     else:
-                        block[ck] = [val] if ck in repeated else val
+                        # legacy repr files already encode lists as one
+                        # token; never double-wrap them
+                        block[ck] = (val if isinstance(val, list)
+                                     else [val] if ck in repeated
+                                     else val)
                         seen_block.add(ck)
                     i += 1
                 setattr(self, name, block)
@@ -192,7 +196,8 @@ class DistributedStrategy:
                     setattr(self, k, (prev if isinstance(prev, list)
                                       else [prev]) + [val])
                 elif isinstance(getattr(self, k, None), list):
-                    setattr(self, k, [val])  # repeated w/ 1 occurrence
+                    setattr(self, k,
+                            val if isinstance(val, list) else [val])
                     seen_scalars.add(k)
                 else:
                     setattr(self, k, val)

@@ -18,6 +18,15 @@ def _one(op, inputs, attrs, slot="Out", dtype=None):
     return apply_op(op, op, inputs, attrs, [slot], out_dtype=dtype)[0]
 
 
+def _apply_act(out, act):
+    """Reference contrib layers run helper.append_activation(out)."""
+    if not act:
+        return out
+    from ...layers import nn as _nn
+
+    return getattr(_nn, act)(out)
+
+
 def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
                               save_intermediate_out=True):
     return _one("fused_elemwise_activation", {"X": [x], "Y": [y]},
@@ -37,12 +46,13 @@ def var_conv_2d(input, row, col, input_channel, output_channel,
         attr=param_attr,
         shape=[output_channel, filter_size * filter_size], dtype=dtype,
         default_initializer=XavierInitializer())
-    return _one("var_conv_2d",
-                {"X": [input], "ROW": [row], "COLUMN": [col], "W": [w]},
-                {"input_channel": input_channel,
-                 "output_channel": output_channel,
-                 "kernel_h": filter_size, "kernel_w": filter_size,
-                 "stride_h": stride, "stride_w": stride})
+    out = _one("var_conv_2d",
+               {"X": [input], "ROW": [row], "COLUMN": [col], "W": [w]},
+               {"input_channel": input_channel,
+                "output_channel": output_channel,
+                "kernel_h": filter_size, "kernel_w": filter_size,
+                "stride_h": stride, "stride_w": stride})
+    return _apply_act(out, act)
 
 
 def match_matrix_tensor(x, y, channel_num, act=None, param_attr=None,
@@ -67,7 +77,7 @@ def match_matrix_tensor(x, y, channel_num, act=None, param_attr=None,
         ins["YLod"] = [y_lod]
     outs = apply_op("match_matrix_tensor", "match_matrix_tensor",
                     ins, {"dim_t": channel_num}, ["Out", "Tmp"])
-    return outs[0], outs[1]
+    return _apply_act(outs[0], act), outs[1]
 
 
 def sequence_topk_avg_pooling(input, row, col, topks, channel_num,

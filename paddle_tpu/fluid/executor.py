@@ -320,23 +320,38 @@ class Executor:
         if cached is not None and cached[0] == program._version:
             return cached[1]
         unsafe_types = {"reduce_sum", "reduce_prod"}
+        # streaming/counting metric ops: replicated rows inflate their
+        # per-row counts (histograms, Correct/Total, pair counts) m-fold
+        # — in fetches AND in scope-resident accumulator state
+        metric_types = {
+            "auc", "accuracy", "precision_recall", "mean_iou",
+            "detection_map", "positive_negative_pair", "chunk_eval",
+            "edit_distance",
+        }
         safe = True
-        block = program.global_block()
-        for op in block.ops:
-            if op.type not in unsafe_types:
-                continue
-            dims = op.attrs.get("dim", op.attrs.get("axis", None))
-            if isinstance(dims, int):
-                dims = [dims]
-            if dims and 0 not in dims:
-                continue  # reduces non-batch axes only
-            for slot_vars in op.input_names.values():
-                for vn in slot_vars:
-                    v = block._find_var_recursive(vn)
-                    shp = tuple(getattr(v, "shape", ()) or ()) \
-                        if v is not None else ()
-                    if shp[:1] == (-1,):
-                        safe = False
+        blocks = getattr(program, "blocks", None) or \
+            [program.global_block()]
+        for block in blocks:
+            for op in block.ops:
+                if op.type in metric_types:
+                    safe = False
+                    break
+                if op.type not in unsafe_types:
+                    continue
+                dims = op.attrs.get("dim", op.attrs.get("axis", None))
+                if isinstance(dims, int):
+                    dims = [dims]
+                if dims and 0 not in dims:
+                    continue  # reduces non-batch axes only
+                for slot_vars in op.input_names.values():
+                    for vn in slot_vars:
+                        v = block._find_var_recursive(vn)
+                        shp = tuple(getattr(v, "shape", ()) or ()) \
+                            if v is not None else ()
+                        if shp[:1] == (-1,):
+                            safe = False
+                            break
+                    if not safe:
                         break
                 if not safe:
                     break

@@ -493,20 +493,38 @@ def detection_map(detect_res, label, class_num, background_label=0,
         ins["PosCount"] = [input_states[0]]
         ins["TruePos"] = [input_states[1]]
         ins["FalsePos"] = [input_states[2]]
+        # the padded representation carries the reference's per-class
+        # LoD of the TruePos/FalsePos state as explicit offset vars
+        # (5-tuple states); without them the op cannot attribute state
+        # rows to classes
+        if len(input_states) >= 5:
+            ins["TruePosLod"] = [input_states[3]]
+            ins["FalsePosLod"] = [input_states[4]]
     map_out = helper.create_variable_for_type_inference("float32")
     # accumulators go INTO the caller's out_states vars so they can be
     # fed back as next batch's input_states (streaming contract of the
-    # reference layer, detection.py:1223)
-    if out_states is not None:
-        acc_pc, acc_tp, acc_fp = out_states
+    # reference layer, detection.py:1223). out_states is a 5-tuple:
+    # (pos_count, true_pos, false_pos, true_pos_lod, false_pos_lod).
+    if out_states is not None and len(out_states) >= 5:
+        acc_pc, acc_tp, acc_fp, acc_tpl, acc_fpl = out_states[:5]
+    elif out_states is not None:
+        raise ValueError(
+            "detection_map out_states must carry 5 vars (pos_count, "
+            "true_pos, false_pos, true_pos_lod, false_pos_lod): the "
+            "per-class lod offsets are part of the streaming state in "
+            "the padded representation")
     else:
         acc_pc = helper.create_variable_for_type_inference("int32")
         acc_tp = helper.create_variable_for_type_inference("float32")
         acc_fp = helper.create_variable_for_type_inference("float32")
+        acc_tpl = helper.create_variable_for_type_inference("int64")
+        acc_fpl = helper.create_variable_for_type_inference("int64")
     helper.append_op(
         type="detection_map", inputs=ins,
         outputs={"MAP": [map_out], "AccumPosCount": [acc_pc],
-                 "AccumTruePos": [acc_tp], "AccumFalsePos": [acc_fp]},
+                 "AccumTruePos": [acc_tp], "AccumFalsePos": [acc_fp],
+                 "AccumTruePosLod": [acc_tpl],
+                 "AccumFalsePosLod": [acc_fpl]},
         attrs={"class_num": class_num,
                "background_label": background_label,
                "overlap_threshold": overlap_threshold,

@@ -94,7 +94,9 @@ def _tensor_array_to_tensor(ins, attrs):
     entry_shape = arr.shape[1:]
     if use_stack:
         out = jnp.moveaxis(arr, 0, axis)
-        idx = jnp.ones((n,), jnp.int32)
+        # reference records each ENTRY's extent along `axis` in both
+        # modes (tensor_array_to_tensor_op.cc:115-118)
+        idx = jnp.full((n,), entry_shape[axis], jnp.int32)
         return {"Out": out, "OutIndex": idx}
     out = jnp.concatenate([arr[i] for i in range(n)], axis=axis)
     idx = jnp.full((n,), entry_shape[axis], jnp.int32)

@@ -30,15 +30,18 @@ import jax.numpy as jnp
 from .registry import register_op, get_op
 
 
-def _np_iou_xyxy(a, b):
-    """IoU matrix between [n,4] and [m,4] corner boxes (numpy)."""
-    area_a = np.maximum(a[:, 2] - a[:, 0], 0) * \
-        np.maximum(a[:, 3] - a[:, 1], 0)
-    area_b = np.maximum(b[:, 2] - b[:, 0], 0) * \
-        np.maximum(b[:, 3] - b[:, 1], 0)
+def _np_iou_xyxy(a, b, normalized=True):
+    """IoU matrix between [n,4] and [m,4] corner boxes (numpy).
+    normalized=False adds the reference's +1 to extents (integer pixel
+    coordinates, nms_util.h JaccardOverlap)."""
+    off = 0.0 if normalized else 1.0
+    area_a = np.maximum(a[:, 2] - a[:, 0] + off, 0) * \
+        np.maximum(a[:, 3] - a[:, 1] + off, 0)
+    area_b = np.maximum(b[:, 2] - b[:, 0] + off, 0) * \
+        np.maximum(b[:, 3] - b[:, 1] + off, 0)
     lt = np.maximum(a[:, None, :2], b[None, :, :2])
     rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
-    wh = np.maximum(rb - lt, 0)
+    wh = np.maximum(rb - lt + off, 0)
     inter = wh[..., 0] * wh[..., 1]
     return inter / np.maximum(area_a[:, None] + area_b[None, :] - inter,
                               1e-10)

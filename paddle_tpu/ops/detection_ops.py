@@ -307,15 +307,15 @@ def _roi_pool(ins, attrs):
     return {"Out": out, "Argmax": jnp.zeros(out.shape, jnp.int64)}
 
 
-def _np_iou_pair(a, b):
-    x1 = max(a[0], b[0])
-    y1 = max(a[1], b[1])
-    x2 = min(a[2], b[2])
-    y2 = min(a[3], b[3])
-    inter = max(x2 - x1, 0) * max(y2 - y1, 0)
-    a0 = (a[2] - a[0]) * (a[3] - a[1])
-    a1 = (b[2] - b[0]) * (b[3] - b[1])
-    return inter / max(a0 + a1 - inter, 1e-10)
+def _np_iou_pair(a, b, normalized=True):
+    """Single-pair IoU: delegates to the one vectorized implementation
+    (detection_extra_ops._np_iou_xyxy) so the normalized/+1 semantics
+    can never diverge between the NMS family members."""
+    from .detection_extra_ops import _np_iou_xyxy
+
+    return float(_np_iou_xyxy(np.asarray(a, np.float64)[None],
+                              np.asarray(b, np.float64)[None],
+                              normalized=normalized)[0, 0])
 
 
 def _greedy_select(order, iou_of, nms_threshold, eta):
@@ -347,6 +347,7 @@ def _nms_one_batch(boxes_b, scores_b, attrs):
     keep_top_k = attrs.get("keep_top_k", 200)
     background = attrs.get("background_label", 0)
     eta = attrs.get("nms_eta", 1.0)
+    normalized = attrs.get("normalized", True)
     dets, det_idx = [], []
     for cls in range(scores_b.shape[0]):
         if cls == background:
@@ -355,7 +356,8 @@ def _nms_one_batch(boxes_b, scores_b, attrs):
         keep = np.where(s > score_threshold)[0]
         order = keep[np.argsort(-s[keep], kind="stable")][:nms_top_k]
         selected = _greedy_select(
-            order, lambda i, k: _np_iou_pair(boxes_b[i], boxes_b[k]),
+            order, lambda i, k: _np_iou_pair(boxes_b[i], boxes_b[k],
+                                             normalized=normalized),
             nms_threshold, eta)
         for idx in selected:
             dets.append([cls, s[idx]] + list(boxes_b[idx]))
@@ -417,6 +419,7 @@ def _locality_aware_nms(ins, attrs):
     keep_top_k = attrs.get("keep_top_k", 200)
     background = attrs.get("background_label", -1)
     eta = attrs.get("nms_eta", 1.0)
+    normalized = attrs.get("normalized", True)
     box_size = boxes.shape[-1]
 
     def aabb(v):
@@ -439,7 +442,8 @@ def _locality_aware_nms(ins, attrs):
             skip = np.ones(len(ss), bool)
             for i in range(len(ss)):
                 if index > -1:
-                    iou = _np_iou_pair(aabb(bb[i]), aabb(bb[index]))
+                    iou = _np_iou_pair(aabb(bb[i]), aabb(bb[index]),
+                                       normalized=normalized)
                     if iou > nms_threshold:
                         # score-weighted merge (PolyWeightedMerge); the
                         # zero-sum guard avoids the reference's 0/0 NaN
@@ -461,7 +465,9 @@ def _locality_aware_nms(ins, attrs):
             cand.sort(key=lambda i: -ss[i])
             cand = cand[:nms_top_k] if nms_top_k > -1 else cand
             selected = _greedy_select(
-                cand, lambda i, k: _np_iou_pair(aabb(bb[i]), aabb(bb[k])),
+                cand,
+                lambda i, k: _np_iou_pair(aabb(bb[i]), aabb(bb[k]),
+                                          normalized=normalized),
                 nms_threshold, eta)
             for i in selected:
                 dets.append([cls, ss[i]] + list(bb[i]))
@@ -489,6 +495,7 @@ def _matrix_nms(ins, attrs):
     background = attrs.get("background_label", 0)
     use_gaussian = attrs.get("use_gaussian", False)
     sigma = attrs.get("gaussian_sigma", 2.0)
+    normalized = attrs.get("normalized", True)
     batch, _, num_boxes = scores.shape
     box_dim = boxes.shape[-1]
     all_out, all_idx, rois_num = [], [], []
@@ -510,7 +517,8 @@ def _matrix_nms(ins, attrs):
             sel = boxes[b, perm]
             # strictly-lower-triangular pairwise IoU: row i holds
             # iou(i, j<i); row max = reference iou_max[i] (IoUs >= 0)
-            ious = np.tril(_np_iou_xyxy(sel, sel), k=-1)
+            ious = np.tril(_np_iou_xyxy(sel, sel,
+                                        normalized=normalized), k=-1)
             iou_max = ious.max(axis=1)
             if s[perm[0]] > post_threshold:
                 cand.append((float(s[perm[0]]), cls, int(perm[0])))

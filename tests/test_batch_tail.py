@@ -176,3 +176,34 @@ def test_sum_loss_program_never_buckets(rng, monkeypatch):
     # and the sum-loss value is the true 4-row sum, not 2x it
     w = np.asarray(scope.find_var("fc_0.w_0"))
     assert np.isfinite(np.asarray(l4[0])).all()
+
+
+def test_streaming_metric_program_never_buckets(rng, monkeypatch):
+    """Programs with streaming/counting metric ops (auc histograms,
+    accuracy Correct/Total) must not bucket: replicated tail rows would
+    inflate counts m-fold (code-review r4 high)."""
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.core import scope as scope_mod
+
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    lbl = fluid.layers.data(name="lbl", shape=[1], dtype="int64")
+    logits = fluid.layers.fc(x, size=2)
+    probs = fluid.layers.softmax(logits)
+    topv, topi = fluid.layers.topk(probs, k=1)
+    acc = fluid.layers.accuracy(input=probs, label=lbl, k=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    calls = _count_compiles(monkeypatch)
+    scope = Scope()
+    with scope_mod.scope_guard(scope):
+        exe.run(fluid.default_startup_program(), scope=scope)
+        xs = rng.rand(12, 4).astype("float32")
+        ys = rng.randint(0, 2, (12, 1)).astype("int64")
+        a8 = exe.run(feed={"x": xs[:8], "lbl": ys[:8]},
+                     fetch_list=[acc], scope=scope)
+        a4 = exe.run(feed={"x": xs[8:], "lbl": ys[8:]},
+                     fetch_list=[acc], scope=scope)
+    # startup + batch-8 + tail-4: metric program COMPILED its tail
+    assert len(calls) == 3
+    # and the tail accuracy is over 4 rows (a fraction with denom 4)
+    assert abs(float(np.asarray(a4[0]).reshape(-1)[0]) * 4
+               - round(float(np.asarray(a4[0]).reshape(-1)[0]) * 4)) < 1e-5

@@ -727,3 +727,43 @@ class TestFcGrad(OpTest):
                        "Bias": r.rand(5).astype("float32")}
         self.attrs = {"in_num_col_dims": 1, "activation_type": ""}
         self.check_grad(["Input", "W", "Bias"], "Out")
+
+
+def test_nms_normalized_false_uses_pixel_extents():
+    """normalized=False adds the reference's +1 to box extents
+    (nms_util.h JaccardOverlap) — two abutting integer-coordinate boxes
+    overlap under pixel semantics but not under normalized."""
+    from paddle_tpu.ops.detection_extra_ops import _np_iou_xyxy
+
+    a = np.asarray([[0.0, 0.0, 9.0, 9.0]])
+    b = np.asarray([[9.0, 0.0, 18.0, 9.0]])  # shares the x=9 column
+    iou_norm = _np_iou_xyxy(a, b)[0, 0]
+    iou_px = _np_iou_xyxy(a, b, normalized=False)[0, 0]
+    assert iou_norm == 0.0
+    assert iou_px > 0.0  # the shared pixel column counts
+    # end-to-end: the same boxes suppress under pixel semantics at a
+    # low threshold but never under normalized
+    boxes = np.asarray([[[0, 0, 9, 9], [9, 0, 18, 9]]], "float32")
+    scores = np.asarray([[[0.0, 0.0], [0.9, 0.8]]], "float32")
+    kept_norm = run_op("multiclass_nms",
+                       {"BBoxes": [boxes], "Scores": [scores]},
+                       {"score_threshold": 0.1, "nms_threshold": 0.04,
+                        "nms_top_k": 10, "keep_top_k": 10,
+                        "background_label": 0,
+                        "normalized": True})["Out"][0]
+    kept_px = run_op("multiclass_nms",
+                     {"BBoxes": [boxes], "Scores": [scores]},
+                     {"score_threshold": 0.1, "nms_threshold": 0.04,
+                      "nms_top_k": 10, "keep_top_k": 10,
+                      "background_label": 0,
+                      "normalized": False})["Out"][0]
+    assert _np(kept_norm).shape[0] == 2   # disjoint: both kept
+    assert _np(kept_px).shape[0] == 1     # pixel overlap: one suppressed
+
+
+def test_tensor_array_to_tensor_stack_outindex():
+    arr = jnp.asarray(np.arange(12, dtype="float32").reshape(3, 2, 2))
+    out = run_op("tensor_array_to_tensor", {"X": [arr]},
+                 {"axis": 0, "use_stack": True})
+    # reference doc example: OutputIndex repeats each entry's extent
+    np.testing.assert_array_equal(_np(out["OutIndex"][0]), [2, 2, 2])

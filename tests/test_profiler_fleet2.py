@@ -294,3 +294,18 @@ def test_strategy_prototxt_single_checkpoint_stays_list(tmp_path):
     st4.save_to_prototxt(p3)
     st5 = DistributedStrategy().load_from_prototxt(p3)
     assert st5.amp_configs["custom"] == "dir\\name"
+
+
+def test_strategy_prototxt_legacy_list_not_double_wrapped(tmp_path):
+    """Round-2/3 legacy files wrote lists as Python reprs; loading must
+    not wrap them again (code-review r4: [['a']] broke recompute)."""
+    from paddle_tpu.fleet import DistributedStrategy
+
+    p = str(tmp_path / "legacy_list.prototxt")
+    with open(p, "w") as f:
+        f.write("recompute: True\n"
+                "recompute_configs {\n"
+                "  checkpoints: ['layer_1.out']\n"
+                "}\n")
+    st = DistributedStrategy().load_from_prototxt(p)
+    assert st.recompute_configs["checkpoints"] == ["layer_1.out"]
