@@ -158,8 +158,11 @@ def test_declarative_training_updates_params():
         def forward(self, x):
             return self.fc(x)
 
+    # seed BEFORE guard(): the Tracer draws its RNG seed counter from
+    # the global numpy state at construction, so seeding inside the
+    # guard leaves init history-dependent (xdist-order flake, run #7)
+    np.random.seed(7)
     with dygraph.guard():
-        np.random.seed(7)  # param init + tracer seed draw from global
         net = Net()
         opt = fluid.optimizer.SGDOptimizer(
             learning_rate=0.2, parameter_list=net.parameters())
