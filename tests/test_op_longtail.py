@@ -767,3 +767,55 @@ def test_tensor_array_to_tensor_stack_outindex():
                  {"axis": 0, "use_stack": True})
     # reference doc example: OutputIndex repeats each entry's extent
     np.testing.assert_array_equal(_np(out["OutIndex"][0]), [2, 2, 2])
+
+
+class TestSppGrad(OpTest):
+    op_type = "spp"
+
+    def test_grad_avg(self):
+        r = np.random.RandomState(11)
+        self.inputs = {"X": r.rand(1, 2, 4, 4).astype("float32")}
+        self.attrs = {"pyramid_height": 2, "pooling_type": "avg"}
+        self.check_grad(["X"], "Out")
+
+
+class TestFusedBatchNormActGrad(OpTest):
+    op_type = "fused_batch_norm_act"
+
+    def test_grad(self):
+        r = np.random.RandomState(12)
+        c = 3
+        self.inputs = {
+            "X": r.rand(2, c, 4, 4).astype("float32") + 0.5,
+            "Scale": r.rand(c).astype("float32") + 0.5,
+            "Bias": r.rand(c).astype("float32"),
+            "Mean": np.zeros(c, "float32"),
+            "Variance": np.ones(c, "float32"),
+        }
+        # grad-check WITHOUT the activation and in is_test mode:
+        # train-mode BN normalizes per batch, so d(sum Y)/dX is exactly
+        # zero (ill-conditioned for numeric diff), and the zero-mean
+        # output parks half the values on relu's kink; the relu forward
+        # composition is pinned separately below
+        self.attrs = {"epsilon": 1e-5, "momentum": 0.9, "act_type": "",
+                      "is_test": True}
+        self.check_grad(["X", "Scale", "Bias"], "Y")
+
+    def test_relu_forward(self):
+        import jax.numpy as jnp
+
+        r = np.random.RandomState(13)
+        c = 2
+        ins = {"X": [jnp.asarray(r.randn(2, c, 3, 3), "float32")],
+               "Scale": [jnp.ones(c, "float32")],
+               "Bias": [jnp.zeros(c, "float32")],
+               "Mean": [jnp.zeros(c, "float32")],
+               "Variance": [jnp.ones(c, "float32")]}
+        base = run_op("batch_norm", dict(ins),
+                      {"epsilon": 1e-5, "momentum": 0.9})["Y"][0]
+        fused = run_op("fused_batch_norm_act", dict(ins),
+                       {"epsilon": 1e-5, "momentum": 0.9,
+                        "act_type": "relu"})["Y"][0]
+        np.testing.assert_allclose(_np(fused),
+                                   np.maximum(_np(base), 0.0),
+                                   rtol=1e-6)
