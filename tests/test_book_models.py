@@ -231,3 +231,50 @@ def test_understand_sentiment_lstm_trains(rng):
                               fetch_list=[loss])
                 losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
             assert losses[-1] < losses[0], losses
+
+
+def test_recommender_system_trains(rng):
+    """Book model: recommender_system (reference:
+    tests/book/test_recommender_system.py) — user/movie embedding
+    towers, cos_sim match score scaled to the 1..5 rating range,
+    square loss; ids bounded by the paddle.dataset.movielens dicts."""
+    import paddle_tpu.dataset.movielens as movielens
+
+    n_users = movielens.max_user_id() + 1
+    n_movies = movielens.max_movie_id() + 1
+    batch = 16
+
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = startup.random_seed = 23
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            uid = fluid.layers.data("uid", shape=[1], dtype="int64")
+            mid = fluid.layers.data("mid", shape=[1], dtype="int64")
+            rating = fluid.layers.data("rating", shape=[1],
+                                       dtype="float32")
+            u_emb = fluid.layers.embedding(uid, size=[n_users, 32])
+            m_emb = fluid.layers.embedding(mid, size=[n_movies, 32])
+            u_vec = fluid.layers.fc(
+                fluid.layers.reshape(u_emb, [-1, 32]), size=32,
+                act="tanh")
+            m_vec = fluid.layers.fc(
+                fluid.layers.reshape(m_emb, [-1, 32]), size=32,
+                act="tanh")
+            sim = fluid.layers.cos_sim(u_vec, m_vec)
+            pred = fluid.layers.scale(sim, scale=5.0)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, rating))
+            fluid.optimizer.AdamOptimizer(5e-3).minimize(loss)
+
+            exe = fluid.Executor()
+            exe.run(startup)
+            uids = rng.randint(1, n_users, (batch, 1)).astype("int64")
+            mids = rng.randint(1, n_movies, (batch, 1)).astype("int64")
+            ratings = rng.randint(1, 6, (batch, 1)).astype("float32")
+            losses = []
+            for _ in range(10):
+                out = exe.run(main, feed={"uid": uids, "mid": mids,
+                                          "rating": ratings},
+                              fetch_list=[loss])
+                losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+            assert losses[-1] < losses[0], losses
