@@ -278,3 +278,62 @@ def test_recommender_system_trains(rng):
                               fetch_list=[loss])
                 losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
             assert losses[-1] < losses[0], losses
+
+
+def test_machine_translation_seq2seq_trains(rng):
+    """Book model: machine_translation (reference:
+    tests/book/test_machine_translation.py) — GRU encoder, teacher-
+    forced GRU decoder with additive attention context, softmax over
+    the target vocab; vocab sizes from paddle.dataset.wmt16 dicts."""
+    import paddle_tpu.dataset.wmt16 as wmt16
+
+    src_dict = wmt16.get_dict("en", 200)
+    trg_dict = wmt16.get_dict("de", 200)
+    src_vocab = len(src_dict)
+    trg_vocab = len(trg_dict)
+    seq, batch, hid = 8, 8, 32
+
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = startup.random_seed = 29
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            src = fluid.layers.data("src", shape=[seq], dtype="int64")
+            trg_in = fluid.layers.data("trg_in", shape=[seq],
+                                       dtype="int64")
+            trg_out = fluid.layers.data("trg_out", shape=[seq, 1],
+                                        dtype="int64")
+            s_emb = fluid.layers.embedding(src, size=[src_vocab, hid])
+            enc_in = fluid.layers.fc(s_emb, size=3 * hid,
+                                     num_flatten_dims=2)
+            enc = fluid.layers.dynamic_gru(enc_in, size=hid)  # [B,S,H]
+            t_emb = fluid.layers.embedding(trg_in,
+                                           size=[trg_vocab, hid])
+            dec_in = fluid.layers.fc(t_emb, size=3 * hid,
+                                     num_flatten_dims=2)
+            dec = fluid.layers.dynamic_gru(dec_in, size=hid)
+            # additive attention: scores [B, St, Ss] from decoder over
+            # encoder states; context concat -> vocab softmax
+            scores = fluid.layers.matmul(dec, enc, transpose_y=True)
+            attn = fluid.layers.softmax(scores)
+            ctx = fluid.layers.matmul(attn, enc)        # [B, St, H]
+            feat = fluid.layers.concat([dec, ctx], axis=2)
+            logits = fluid.layers.fc(feat, size=trg_vocab,
+                                     num_flatten_dims=2)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits,
+                                                        trg_out))
+            fluid.optimizer.AdamOptimizer(5e-3).minimize(loss)
+
+            exe = fluid.Executor()
+            exe.run(startup)
+            s = rng.randint(0, src_vocab, (batch, seq)).astype("int64")
+            ti = rng.randint(0, trg_vocab, (batch, seq)).astype("int64")
+            to = rng.randint(0, trg_vocab,
+                             (batch, seq, 1)).astype("int64")
+            losses = []
+            for _ in range(8):
+                out = exe.run(main, feed={"src": s, "trg_in": ti,
+                                          "trg_out": to},
+                              fetch_list=[loss])
+                losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+            assert losses[-1] < losses[0], losses
