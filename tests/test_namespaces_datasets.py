@@ -315,3 +315,42 @@ def test_launch_ps_env_contract(tmp_path):
         roles.append(f.read_text().strip())
     assert sorted(roles) == ["ROLE S 0 2 2", "ROLE S 1 2 2",
                              "ROLE W 0 2 2", "ROLE W 1 2 2"], roles
+
+
+def test_classic_reader_datafeeder_executor_pipeline():
+    """THE classic fluid idiom (reference book tests): paddle.batch over
+    a dataset reader -> DataFeeder.feed -> Executor.run, training a
+    regressor on uci_housing until the loss drops."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework
+
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = startup.random_seed = 31
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data("x", shape=[13], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="float32")
+            pred = fluid.layers.fc(x, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGDOptimizer(1e-3).minimize(loss)
+
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            feeder = fluid.DataFeeder(feed_list=[x, y],
+                                      place=fluid.CPUPlace())
+            reader = paddle.batch(
+                paddle.dataset.uci_housing.train(), batch_size=16)
+            first = last = None
+            for epoch in range(3):
+                for batch in reader():
+                    out = exe.run(main, feed=feeder.feed(batch),
+                                  fetch_list=[loss])
+                    val = float(np.asarray(out[0]).reshape(-1)[0])
+                    if first is None:
+                        first = val
+                    last = val
+            assert np.isfinite(last) and last < first, (first, last)
