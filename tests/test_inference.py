@@ -86,3 +86,33 @@ def test_analysis_pass_builder_and_report(tmp_path):
     assert pred2.get_optimization_report()["ir_optim"] is False
     out_eager = pred2.run([xs])[0]
     np.testing.assert_allclose(out_opt, out_eager, rtol=1e-5, atol=1e-6)
+
+
+def test_dygraph_zoo_model_to_predictor_roundtrip(tmp_path):
+    """Deploy path for the dygraph zoo: train-mode LeNet -> jit.save
+    (declarative trace + inference export) -> AnalysisPredictor run,
+    matching the eager forward (eval mode: dropout-free, BN absent)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.fluid import dygraph
+    from paddle_tpu.hapi.vision.models import LeNet
+
+    with dygraph.guard():
+        net = LeNet(num_classes=10)
+        net.eval()
+        x = np.random.RandomState(3).rand(2, 1, 28, 28).astype("float32")
+        want = None
+        # trace via TracedLayer off the eager forward
+        out, traced = dygraph.TracedLayer.trace(
+            net, [paddle.to_tensor(x)])
+        want = out.numpy()
+        d = str(tmp_path / "lenet_inf")
+        traced.save_inference_model(d)
+
+    cfg = inference.Config(d)
+    pred = inference.create_predictor(cfg)
+    in_names = pred.get_input_names()
+    h = pred.get_input_handle(in_names[0])
+    h.copy_from_cpu(x)
+    pred.run()
+    got = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
