@@ -109,3 +109,64 @@ def test_kill_and_resume_matches_uninterrupted(tmp_path):
     for step, loss_v in steps2.items():
         assert loss_v == pytest.approx(base_steps[step], rel=1e-4), (
             step, loss_v, base_steps[step], out2)
+
+
+def test_async_checkpointer_snapshot_consistency(tmp_path, rng):
+    """save_async snapshots the scope at CALL time (ref-grab of
+    immutable jax arrays); training that continues while the worker
+    writes must not leak into the checkpoint, and close() flushes."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.core import scope as scope_mod
+    from paddle_tpu.fluid import framework
+    from paddle_tpu.fluid.checkpoint import (AsyncCheckpointer,
+                                             TrainStatus,
+                                             load_checkpoint)
+
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = startup.random_seed = 37
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="float32")
+            pred = fluid.layers.fc(x, size=1,
+                                   param_attr=fluid.ParamAttr(name="w"))
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+
+            scope = Scope()
+            exe = fluid.Executor(fluid.CPUPlace())
+            with scope_mod.scope_guard(scope):
+                exe.run(startup, scope=scope)
+                xs = rng.rand(8, 4).astype("float32")
+                ys = rng.rand(8, 1).astype("float32")
+                ck = AsyncCheckpointer(str(tmp_path), main_program=main,
+                                       scope=scope)
+                for _ in range(3):
+                    exe.run(main, feed={"x": xs, "y": ys},
+                            fetch_list=[loss], scope=scope)
+                w_at_save = np.asarray(scope.find_var("w")).copy()
+                ck.save_async(TrainStatus(epoch_no=1, step_no=3))
+                # keep training while the writer works
+                for _ in range(3):
+                    exe.run(main, feed={"x": xs, "y": ys},
+                            fetch_list=[loss], scope=scope)
+                w_final = np.asarray(scope.find_var("w")).copy()
+                ck.close()
+                assert not np.allclose(w_at_save, w_final)
+
+            # restore into a FRESH scope: values == save-time snapshot
+            scope2 = Scope()
+            with scope_mod.scope_guard(scope2):
+                exe2 = fluid.Executor(fluid.CPUPlace())
+                exe2.run(startup, scope=scope2)
+                status = load_checkpoint(exe2, str(tmp_path),
+                                         main_program=main,
+                                         scope=scope2)
+                assert status is not None and status.epoch_no == 1
+                np.testing.assert_allclose(
+                    np.asarray(scope2.find_var("w")), w_at_save,
+                    rtol=1e-6)
