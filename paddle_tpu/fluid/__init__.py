@@ -47,6 +47,7 @@ from . import dataset  # noqa: F401
 from .dataset import DatasetFactory, InMemoryDataset, QueueDataset  # noqa: F401
 from . import metrics  # noqa: F401
 from . import contrib  # noqa: F401
+from . import install_check  # noqa: F401
 from . import incubate  # noqa: F401
 from . import profiler  # noqa: F401
 from . import checkpoint  # noqa: F401
