@@ -48,13 +48,14 @@ _LAST_GOOD = os.path.join(_REPO, ".bench_last_good.json")
 _COMPILE_CACHE = os.path.join(_REPO, ".jax_cache")
 
 # (platform, wall budget seconds, bert batch, steps, warmup)
-# batch 512 first: the fused_linear_softmax_xent head removed the
-# [tokens, vocab] fp32 logits/softmax buffers (~3.6G at 512) that made
-# it OOM in round 2; if it still doesn't fit, the 256 attempt follows
-# with a warm compile cache
+# batch 256 first: it is the round-2 comparable (83.3k tok/s @ 34% MFU,
+# pre-fused-head) and the single most valuable shape to land, so it
+# gets the first — and largest — budget, sized for a cold compile
+# through a flaky tunnel. 512 (fused head + per-layer remat, the
+# PERF_ANALYSIS_r4 fit) follows, then a small 128 salvage attempt.
 _ATTEMPTS = [
+    ("tpu", 900, BATCH, STEPS, WARMUP),
     ("tpu", 560, 2 * BATCH, STEPS, WARMUP),
-    ("tpu", 420, BATCH, STEPS, WARMUP),
     ("tpu", 300, 128, STEPS, WARMUP),
 ]
 _CPU_ATTEMPT = ("cpu", 420, 8, 2, 1)
@@ -94,6 +95,28 @@ def _parse_tagged(out):
     return result
 
 
+def _dump_child_log(platform, idx, out) -> None:
+    """Keep a failed child's full stdout (heartbeats included) on disk:
+    the tunnel hang mode gives no other post-mortem signal about which
+    phase (import / trace / compile / steps) the attempt died in."""
+    if isinstance(out, bytes):
+        out = out.decode("utf-8", "replace")
+    try:
+        with open(os.path.join(
+                _REPO, ".bench_child_fail_%s%d.log" % (platform, idx)),
+                "w") as f:
+            f.write(out or "")
+    except OSError:
+        pass
+
+
+def _hb(phase: str, t_start: float) -> None:
+    """Timestamped heartbeat line from the child (shows up in the
+    failure dump, answers 'where did the window die')."""
+    print("BENCH_HB %s t=%.1fs" % (phase, time.perf_counter() - t_start),
+          flush=True)
+
+
 def _run_attempt(platform, budget, batch, steps, warmup, idx, errors):
     """Run one bench child; return its parsed result dict or None."""
     try:
@@ -107,6 +130,7 @@ def _run_attempt(platform, budget, batch, steps, warmup, idx, errors):
         result = _parse_tagged(out)
         if proc.returncode == 0 and result is not None:
             return result
+        _dump_child_log(platform, idx, out)
         errors.append("%s attempt %d rc=%d: %s"
                       % (platform, idx, proc.returncode,
                          out.strip().splitlines()[-1][-200:]
@@ -119,8 +143,10 @@ def _run_attempt(platform, budget, batch, steps, warmup, idx, errors):
                       % (platform, idx, budget))
         result = _parse_tagged(e.output)
         if result is not None:
+            # salvage: the run produced the artifact — not a failure
             errors[-1] += " (salvaged tagged result from partial stdout)"
             return result
+        _dump_child_log(platform, idx, e.output)
     except Exception as e:  # noqa: BLE001 - must always emit JSON
         errors.append("%s attempt %d: %r" % (platform, idx, e))
     return None
@@ -134,6 +160,16 @@ def main() -> int:
         result = _run_attempt(platform, budget, batch, steps, warmup,
                               i, errors)
         if result is not None:
+            # a success supersedes any earlier attempts' failure dumps:
+            # leaving them around would misattribute "which phase died"
+            import glob
+
+            for p in glob.glob(os.path.join(
+                    _REPO, ".bench_child_fail_*.log")):
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
             if errors:
                 result["error"] = "; ".join(errors)[:500]
             try:
@@ -222,6 +258,7 @@ def _bench_child(platform: str, batch: int, steps: int, warmup: int,
     from paddle_tpu.fluid.contrib import mixed_precision
     from paddle_tpu.models import bert
 
+    _hb("imports_done", t_start)
     cfg = bert.BertConfig.base()
     main_p, startup_p = framework.Program(), framework.Program()
     with framework.program_guard(main_p, startup_p):
@@ -246,6 +283,7 @@ def _bench_child(platform: str, batch: int, steps: int, warmup: int,
 
             exe = fluid.Executor(fluid.TPUPlace())
             exe.run(startup_p)
+            _hb("startup_done", t_start)
 
             feed = _bert_feed(cfg, batch, SEQ_LEN)
 
@@ -253,10 +291,12 @@ def _bench_child(platform: str, batch: int, steps: int, warmup: int,
             out = exe.run(main_p, feed=feed, fetch_list=[total])
             np.asarray(out[0])
             compile_time = time.perf_counter() - t_compile0
+            _hb("compile_done", t_start)
 
             for _ in range(max(warmup - 1, 0)):
                 out = exe.run(main_p, feed=feed, fetch_list=[total])
             np.asarray(out[0])
+            _hb("warmup_done", t_start)
 
             t0 = time.perf_counter()
             for _ in range(steps):

@@ -35,8 +35,12 @@ _LOG = os.path.join(_REPO, ".capture_log")
 _LAST_GOOD = os.path.join(_REPO, ".bench_last_good.json")
 
 PROBE_BUDGET = 75.0   # seconds for the tiny-matmul liveness child
-BENCH_BUDGET = 1800.0  # hard cap on one full bench.py run
-CYCLE = 1500.0         # seconds between probe attempts (~25 min)
+BENCH_BUDGET = 2400.0  # hard cap on one full bench.py run
+# The 01:01Z window on 07-31 proved windows can be ~1 minute long: a
+# 25-min probe cycle would miss most of them. Probe cost is one python
+# import + a 512x512 matmul, so a tight cycle is cheap.
+CYCLE = 420.0          # seconds between probe attempts (~7 min)
+CYCLE_AFTER_FAIL = 60.0  # probe again fast when a window just flapped
 CYCLE_AFTER_SUCCESS = 3600.0  # relax after a fresh capture exists
 
 _PROBE_SRC = r"""
@@ -162,13 +166,26 @@ def _have_fresh_capture(max_age_h: float = 6.0) -> bool:
 def main() -> int:
     once = "--once" in sys.argv
     _log("start", once=once, pid=os.getpid())
+    fast_retries = 0
     while True:
         captured = False
-        if _probe():
+        probed = _probe()
+        if probed:
             captured = _bench()
         if once:
             return 0 if captured else 1
-        time.sleep(CYCLE_AFTER_SUCCESS if _have_fresh_capture() else CYCLE)
+        if _have_fresh_capture():
+            fast_retries = 0
+            time.sleep(CYCLE_AFTER_SUCCESS)
+        elif probed and not captured and fast_retries < 3:
+            # window flapped mid-bench: it may come back — retry fast,
+            # but capped: a probe-ok/bench-hang tunnel state must not
+            # turn into back-to-back 40-min bench runs forever
+            fast_retries += 1
+            time.sleep(CYCLE_AFTER_FAIL)
+        else:
+            fast_retries = 0
+            time.sleep(CYCLE)
 
 
 if __name__ == "__main__":
