@@ -5,3 +5,6 @@ from .place import (  # noqa: F401
 )
 from .scope import Scope, global_scope, scope_guard  # noqa: F401
 from .types import VarDesc, normalize_dtype, to_numpy_dtype  # noqa: F401
+from .crypto import (  # noqa: F401
+    AESCipher, Cipher, CipherFactory, CipherUtils,
+)

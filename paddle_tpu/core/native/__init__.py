@@ -497,3 +497,62 @@ def lod_to_lengths(lod):
         lod.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), batch,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
     return out
+
+
+# ---- model-file encryption (crypto.cc; ref: framework/io/crypto/
+# aes_cipher.h:48, cipher.h:24, bound in pybind/crypto.cc) ----
+
+def _crypto_lib():
+    lib = _load()
+    if not hasattr(lib, "_crypto_ready"):
+        i64 = ctypes.c_int64
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.ptq_crypto_gen_key.restype = ctypes.c_int
+        lib.ptq_crypto_gen_key.argtypes = [u8p, i64]
+        for fn in (lib.ptq_crypto_encrypt, lib.ptq_crypto_decrypt):
+            fn.restype = ctypes.c_int
+            fn.argtypes = [ctypes.c_char_p, i64, ctypes.c_char_p, i64,
+                           ctypes.POINTER(u8p), ctypes.POINTER(i64)]
+        lib.ptq_crypto_selftest.restype = ctypes.c_int
+        lib.ptq_crypto_selftest.argtypes = []
+        lib._crypto_ready = True
+    return lib
+
+
+def crypto_selftest() -> bool:
+    """FIPS-197 C.3 / FIPS-180-4 B.1 known-answer self-check."""
+    return _crypto_lib().ptq_crypto_selftest() == 0
+
+
+def crypto_gen_key(length: int = 32) -> bytes:
+    lib = _crypto_lib()
+    buf = (ctypes.c_uint8 * length)()
+    if lib.ptq_crypto_gen_key(buf, length) != PTQ_OK:
+        raise RuntimeError("key generation failed")
+    return bytes(buf)
+
+
+def _crypto_call(fn, key: bytes, data: bytes) -> bytes:
+    out = ctypes.POINTER(ctypes.c_uint8)()
+    out_len = ctypes.c_int64()
+    rc = fn(key, len(key), data, len(data),
+            ctypes.byref(out), ctypes.byref(out_len))
+    if rc == -1:
+        raise ValueError(
+            "decryption failed: wrong key or corrupted ciphertext")
+    if rc != PTQ_OK:
+        raise RuntimeError("crypto operation failed (rc=%d)" % rc)
+    try:
+        return ctypes.string_at(out, out_len.value)
+    finally:
+        _crypto_lib().ptq_buf_free(out)
+
+
+def crypto_encrypt(key: bytes, plaintext: bytes) -> bytes:
+    """Seals plaintext: AES-256-CTR + HMAC-SHA256 encrypt-then-MAC."""
+    return _crypto_call(_crypto_lib().ptq_crypto_encrypt, key, plaintext)
+
+
+def crypto_decrypt(key: bytes, sealed: bytes) -> bytes:
+    """Opens a sealed buffer; raises ValueError on tag mismatch."""
+    return _crypto_call(_crypto_lib().ptq_crypto_decrypt, key, sealed)

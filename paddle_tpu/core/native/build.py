@@ -15,7 +15,7 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "src")
 _SO = os.path.join(_DIR, "libpaddle_tpu_native.so")
 _SOURCES = ["channel.cc", "allocator.cc", "data_feed.cc", "monitor.cc",
-            "trace_events.cc", "ragged.cc"]
+            "trace_events.cc", "ragged.cc", "crypto.cc"]
 _lock = threading.Lock()
 
 

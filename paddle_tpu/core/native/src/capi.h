@@ -110,3 +110,18 @@ int64_t ptq_ragged_unpad(const uint8_t* padded, const int64_t* lengths,
                          int64_t elem_size, uint8_t* out);
 void ptq_lod_to_lengths(const int64_t* lod, int64_t batch,
                         int64_t* lengths);
+
+// ---- model-file encryption (crypto.cc; ref:
+// framework/io/crypto/aes_cipher.h:48, pybind/crypto.cc) ----
+// AES-256-CTR + HMAC-SHA256 encrypt-then-MAC. Sealed format:
+// "PTQE" | ver u8 | iv[16] | ciphertext | tag[32]. Buffers returned in
+// *out are library-owned; free with ptq_buf_free. decrypt returns -1
+// (bad tag) on wrong key or corruption.
+int ptq_crypto_gen_key(uint8_t* out, int64_t len);
+int ptq_crypto_encrypt(const uint8_t* key, int64_t keylen,
+                       const uint8_t* plain, int64_t len,
+                       uint8_t** out, int64_t* out_len);
+int ptq_crypto_decrypt(const uint8_t* key, int64_t keylen,
+                       const uint8_t* sealed, int64_t len,
+                       uint8_t** out, int64_t* out_len);
+int ptq_crypto_selftest(void);

@@ -1,0 +1,392 @@
+// Model-file encryption for checkpoint/inference artifacts.
+//
+// TPU-native counterpart of the reference's crypto tier
+// (paddle/fluid/framework/io/crypto/aes_cipher.h:48, cipher.h:24,
+// cipher_utils.h:23, bound to python in pybind/crypto.cc). The
+// reference wraps Crypto++ AES-GCM; this image has no crypto library,
+// so the primitives are implemented here from the public FIPS-197 /
+// FIPS-180-4 specs: AES-256 in CTR mode with an HMAC-SHA256
+// encrypt-then-MAC tag (equivalent confidentiality+integrity contract
+// to GCM, simpler to implement correctly without carry-less multiply).
+//
+// Wire format of a sealed buffer:
+//   magic "PTQE" | version u8=1 | iv[16] | ciphertext | hmac_tag[32]
+// The HMAC covers magic..ciphertext with a key derived from the user
+// key (HMAC key = SHA256(key || "ptq-mac")), so the encryption and MAC
+// keys differ even though the user supplies one key blob.
+#include <stdint.h>
+#include <string.h>
+#include <stdio.h>
+
+#include <stdlib.h>
+
+extern "C" {
+enum { PTQC_OK = 0, PTQC_BAD_TAG = -1, PTQC_ERR = -3 };
+void ptq_buf_free(uint8_t* buf);  // shared with capi (channel.cc)
+}
+
+namespace {
+
+// ---------------- SHA-256 (FIPS 180-4) ----------------
+
+struct Sha256 {
+  uint32_t h[8];
+  uint64_t len = 0;
+  uint8_t buf[64];
+  size_t fill = 0;
+
+  Sha256() {
+    static const uint32_t init[8] = {
+        0x6a09e667u, 0xbb67ae85u, 0x3c6ef372u, 0xa54ff53au,
+        0x510e527fu, 0x9b05688cu, 0x1f83d9abu, 0x5be0cd19u};
+    memcpy(h, init, sizeof(h));
+  }
+
+  static uint32_t rotr(uint32_t x, int n) {
+    return (x >> n) | (x << (32 - n));
+  }
+
+  void block(const uint8_t* p) {
+    static const uint32_t K[64] = {
+        0x428a2f98u, 0x71374491u, 0xb5c0fbcfu, 0xe9b5dba5u, 0x3956c25bu,
+        0x59f111f1u, 0x923f82a4u, 0xab1c5ed5u, 0xd807aa98u, 0x12835b01u,
+        0x243185beu, 0x550c7dc3u, 0x72be5d74u, 0x80deb1feu, 0x9bdc06a7u,
+        0xc19bf174u, 0xe49b69c1u, 0xefbe4786u, 0x0fc19dc6u, 0x240ca1ccu,
+        0x2de92c6fu, 0x4a7484aau, 0x5cb0a9dcu, 0x76f988dau, 0x983e5152u,
+        0xa831c66du, 0xb00327c8u, 0xbf597fc7u, 0xc6e00bf3u, 0xd5a79147u,
+        0x06ca6351u, 0x14292967u, 0x27b70a85u, 0x2e1b2138u, 0x4d2c6dfcu,
+        0x53380d13u, 0x650a7354u, 0x766a0abbu, 0x81c2c92eu, 0x92722c85u,
+        0xa2bfe8a1u, 0xa81a664bu, 0xc24b8b70u, 0xc76c51a3u, 0xd192e819u,
+        0xd6990624u, 0xf40e3585u, 0x106aa070u, 0x19a4c116u, 0x1e376c08u,
+        0x2748774cu, 0x34b0bcb5u, 0x391c0cb3u, 0x4ed8aa4au, 0x5b9cca4fu,
+        0x682e6ff3u, 0x748f82eeu, 0x78a5636fu, 0x84c87814u, 0x8cc70208u,
+        0x90befffau, 0xa4506cebu, 0xbef9a3f7u, 0xc67178f2u};
+    uint32_t w[64];
+    for (int i = 0; i < 16; ++i)
+      w[i] = (uint32_t(p[4 * i]) << 24) | (uint32_t(p[4 * i + 1]) << 16) |
+             (uint32_t(p[4 * i + 2]) << 8) | uint32_t(p[4 * i + 3]);
+    for (int i = 16; i < 64; ++i) {
+      uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+      uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3];
+    uint32_t e = h[4], f = h[5], g = h[6], hh = h[7];
+    for (int i = 0; i < 64; ++i) {
+      uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = hh + S1 + ch + K[i] + w[i];
+      uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+      uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+      uint32_t t2 = S0 + maj;
+      hh = g; g = f; f = e; e = d + t1;
+      d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  }
+
+  void update(const uint8_t* p, size_t n) {
+    len += n;
+    while (n) {
+      size_t take = 64 - fill < n ? 64 - fill : n;
+      memcpy(buf + fill, p, take);
+      fill += take; p += take; n -= take;
+      if (fill == 64) { block(buf); fill = 0; }
+    }
+  }
+
+  void final(uint8_t out[32]) {
+    uint64_t bits = len * 8;
+    uint8_t pad = 0x80;
+    update(&pad, 1);
+    uint8_t z = 0;
+    while (fill != 56) update(&z, 1);
+    uint8_t lenb[8];
+    for (int i = 0; i < 8; ++i) lenb[i] = uint8_t(bits >> (56 - 8 * i));
+    update(lenb, 8);
+    for (int i = 0; i < 8; ++i) {
+      out[4 * i] = uint8_t(h[i] >> 24);
+      out[4 * i + 1] = uint8_t(h[i] >> 16);
+      out[4 * i + 2] = uint8_t(h[i] >> 8);
+      out[4 * i + 3] = uint8_t(h[i]);
+    }
+  }
+};
+
+void sha256(const uint8_t* p, size_t n, uint8_t out[32]) {
+  Sha256 s;
+  s.update(p, n);
+  s.final(out);
+}
+
+// HMAC-SHA256 (FIPS 198-1)
+void hmac_sha256(const uint8_t* key, size_t keylen, const uint8_t* msg,
+                 size_t msglen, const uint8_t* msg2, size_t msg2len,
+                 uint8_t out[32]) {
+  uint8_t k[64] = {0};
+  if (keylen > 64) {
+    sha256(key, keylen, k);  // fold long keys, per spec
+  } else {
+    memcpy(k, key, keylen);
+  }
+  uint8_t ipad[64], opad[64];
+  for (int i = 0; i < 64; ++i) {
+    ipad[i] = k[i] ^ 0x36;
+    opad[i] = k[i] ^ 0x5c;
+  }
+  uint8_t inner[32];
+  Sha256 si;
+  si.update(ipad, 64);
+  si.update(msg, msglen);
+  if (msg2len) si.update(msg2, msg2len);
+  si.final(inner);
+  Sha256 so;
+  so.update(opad, 64);
+  so.update(inner, 32);
+  so.final(out);
+}
+
+// ---------------- AES-256 (FIPS 197), encrypt direction only ----------------
+// CTR mode needs only the forward cipher on the counter block.
+
+const uint8_t kSbox[256] = {
+    0x63, 0x7c, 0x77, 0x7b, 0xf2, 0x6b, 0x6f, 0xc5, 0x30, 0x01, 0x67, 0x2b,
+    0xfe, 0xd7, 0xab, 0x76, 0xca, 0x82, 0xc9, 0x7d, 0xfa, 0x59, 0x47, 0xf0,
+    0xad, 0xd4, 0xa2, 0xaf, 0x9c, 0xa4, 0x72, 0xc0, 0xb7, 0xfd, 0x93, 0x26,
+    0x36, 0x3f, 0xf7, 0xcc, 0x34, 0xa5, 0xe5, 0xf1, 0x71, 0xd8, 0x31, 0x15,
+    0x04, 0xc7, 0x23, 0xc3, 0x18, 0x96, 0x05, 0x9a, 0x07, 0x12, 0x80, 0xe2,
+    0xeb, 0x27, 0xb2, 0x75, 0x09, 0x83, 0x2c, 0x1a, 0x1b, 0x6e, 0x5a, 0xa0,
+    0x52, 0x3b, 0xd6, 0xb3, 0x29, 0xe3, 0x2f, 0x84, 0x53, 0xd1, 0x00, 0xed,
+    0x20, 0xfc, 0xb1, 0x5b, 0x6a, 0xcb, 0xbe, 0x39, 0x4a, 0x4c, 0x58, 0xcf,
+    0xd0, 0xef, 0xaa, 0xfb, 0x43, 0x4d, 0x33, 0x85, 0x45, 0xf9, 0x02, 0x7f,
+    0x50, 0x3c, 0x9f, 0xa8, 0x51, 0xa3, 0x40, 0x8f, 0x92, 0x9d, 0x38, 0xf5,
+    0xbc, 0xb6, 0xda, 0x21, 0x10, 0xff, 0xf3, 0xd2, 0xcd, 0x0c, 0x13, 0xec,
+    0x5f, 0x97, 0x44, 0x17, 0xc4, 0xa7, 0x7e, 0x3d, 0x64, 0x5d, 0x19, 0x73,
+    0x60, 0x81, 0x4f, 0xdc, 0x22, 0x2a, 0x90, 0x88, 0x46, 0xee, 0xb8, 0x14,
+    0xde, 0x5e, 0x0b, 0xdb, 0xe0, 0x32, 0x3a, 0x0a, 0x49, 0x06, 0x24, 0x5c,
+    0xc2, 0xd3, 0xac, 0x62, 0x91, 0x95, 0xe4, 0x79, 0xe7, 0xc8, 0x37, 0x6d,
+    0x8d, 0xd5, 0x4e, 0xa9, 0x6c, 0x56, 0xf4, 0xea, 0x65, 0x7a, 0xae, 0x08,
+    0xba, 0x78, 0x25, 0x2e, 0x1c, 0xa6, 0xb4, 0xc6, 0xe8, 0xdd, 0x74, 0x1f,
+    0x4b, 0xbd, 0x8b, 0x8a, 0x70, 0x3e, 0xb5, 0x66, 0x48, 0x03, 0xf6, 0x0e,
+    0x61, 0x35, 0x57, 0xb9, 0x86, 0xc1, 0x1d, 0x9e, 0xe1, 0xf8, 0x98, 0x11,
+    0x69, 0xd9, 0x8e, 0x94, 0x9b, 0x1e, 0x87, 0xe9, 0xce, 0x55, 0x28, 0xdf,
+    0x8c, 0xa1, 0x89, 0x0d, 0xbf, 0xe6, 0x42, 0x68, 0x41, 0x99, 0x2d, 0x0f,
+    0xb0, 0x54, 0xbb, 0x16};
+
+uint8_t xtime(uint8_t x) {
+  return uint8_t((x << 1) ^ ((x >> 7) * 0x1b));
+}
+
+struct Aes256 {
+  // 15 round keys of 16 bytes (Nr=14 for 256-bit keys)
+  uint8_t rk[15][16];
+
+  explicit Aes256(const uint8_t key[32]) {
+    // key expansion: Nk=8 words, 60 words total
+    uint8_t w[60][4];
+    memcpy(w, key, 32);
+    uint8_t rcon = 1;
+    for (int i = 8; i < 60; ++i) {
+      uint8_t t[4];
+      memcpy(t, w[i - 1], 4);
+      if (i % 8 == 0) {
+        // RotWord + SubWord + Rcon
+        uint8_t tmp = t[0];
+        t[0] = uint8_t(kSbox[t[1]] ^ rcon);
+        t[1] = kSbox[t[2]];
+        t[2] = kSbox[t[3]];
+        t[3] = kSbox[tmp];
+        rcon = xtime(rcon);
+      } else if (i % 8 == 4) {
+        for (int j = 0; j < 4; ++j) t[j] = kSbox[t[j]];
+      }
+      for (int j = 0; j < 4; ++j) w[i][j] = uint8_t(w[i - 8][j] ^ t[j]);
+    }
+    memcpy(rk, w, sizeof(rk));
+  }
+
+  void encrypt_block(const uint8_t in[16], uint8_t out[16]) const {
+    uint8_t s[16];
+    for (int i = 0; i < 16; ++i) s[i] = uint8_t(in[i] ^ rk[0][i]);
+    for (int round = 1; round <= 14; ++round) {
+      // SubBytes
+      for (int i = 0; i < 16; ++i) s[i] = kSbox[s[i]];
+      // ShiftRows (state is column-major: s[4c+r] is row r, col c)
+      uint8_t t[16];
+      for (int c = 0; c < 4; ++c)
+        for (int r = 0; r < 4; ++r)
+          t[4 * c + r] = s[4 * ((c + r) % 4) + r];
+      memcpy(s, t, 16);
+      if (round != 14) {
+        // MixColumns
+        for (int c = 0; c < 4; ++c) {
+          uint8_t* col = s + 4 * c;
+          uint8_t a0 = col[0], a1 = col[1], a2 = col[2], a3 = col[3];
+          uint8_t all = uint8_t(a0 ^ a1 ^ a2 ^ a3);
+          uint8_t b0 = uint8_t(a0 ^ all ^ xtime(uint8_t(a0 ^ a1)));
+          uint8_t b1 = uint8_t(a1 ^ all ^ xtime(uint8_t(a1 ^ a2)));
+          uint8_t b2 = uint8_t(a2 ^ all ^ xtime(uint8_t(a2 ^ a3)));
+          uint8_t b3 = uint8_t(a3 ^ all ^ xtime(uint8_t(a3 ^ a0)));
+          col[0] = b0; col[1] = b1; col[2] = b2; col[3] = b3;
+        }
+      }
+      for (int i = 0; i < 16; ++i) s[i] = uint8_t(s[i] ^ rk[round][i]);
+    }
+    memcpy(out, s, 16);
+  }
+};
+
+// CTR keystream: counter block = iv[0:12] || big-endian u32 counter.
+void aes256_ctr_xor(const Aes256& aes, const uint8_t iv[16],
+                    const uint8_t* in, uint8_t* out, size_t n) {
+  uint8_t ctr[16], ks[16];
+  memcpy(ctr, iv, 16);
+  uint32_t counter = (uint32_t(iv[12]) << 24) | (uint32_t(iv[13]) << 16) |
+                     (uint32_t(iv[14]) << 8) | uint32_t(iv[15]);
+  for (size_t off = 0; off < n; off += 16) {
+    ctr[12] = uint8_t(counter >> 24);
+    ctr[13] = uint8_t(counter >> 16);
+    ctr[14] = uint8_t(counter >> 8);
+    ctr[15] = uint8_t(counter);
+    aes.encrypt_block(ctr, ks);
+    size_t take = n - off < 16 ? n - off : 16;
+    for (size_t i = 0; i < take; ++i) out[off + i] = uint8_t(in[off + i] ^ ks[i]);
+    ++counter;
+  }
+}
+
+// MAC key differs from the cipher key: SHA256(key || "ptq-mac").
+void derive_mac_key(const uint8_t* key, size_t keylen, uint8_t out[32]) {
+  Sha256 s;
+  s.update(key, keylen);
+  const char* suffix = "ptq-mac";
+  s.update(reinterpret_cast<const uint8_t*>(suffix), 7);
+  s.final(out);
+}
+
+// Cipher key is always folded to 256 bits: SHA256(key || "ptq-enc").
+// This lets callers pass any key length (the reference supports 128/192/
+// 256-bit AES keys; folding keeps one code path with full entropy use).
+void derive_enc_key(const uint8_t* key, size_t keylen, uint8_t out[32]) {
+  Sha256 s;
+  s.update(key, keylen);
+  const char* suffix = "ptq-enc";
+  s.update(reinterpret_cast<const uint8_t*>(suffix), 7);
+  s.final(out);
+}
+
+const uint8_t kMagic[4] = {'P', 'T', 'Q', 'E'};
+const size_t kHeader = 5;   // magic + version byte
+const size_t kIv = 16;
+const size_t kTag = 32;
+
+bool fill_random(uint8_t* out, size_t n) {
+  FILE* f = fopen("/dev/urandom", "rb");
+  if (!f) return false;
+  size_t got = fread(out, 1, n, f);
+  fclose(f);
+  return got == n;
+}
+
+int ct_memcmp(const uint8_t* a, const uint8_t* b, size_t n) {
+  // constant-time compare: tag checks must not leak a prefix length
+  uint8_t d = 0;
+  for (size_t i = 0; i < n; ++i) d = uint8_t(d | (a[i] ^ b[i]));
+  return d != 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int ptq_crypto_gen_key(uint8_t* out, int64_t len) {
+  if (len <= 0) return PTQC_ERR;
+  return fill_random(out, size_t(len)) ? PTQC_OK : PTQC_ERR;
+}
+
+// Seals plain[0:len]; *out is a library-owned buffer (free with
+// ptq_buf_free) of *out_len = kHeader + 16 + len + 32 bytes.
+int ptq_crypto_encrypt(const uint8_t* key, int64_t keylen,
+                       const uint8_t* plain, int64_t len,
+                       uint8_t** out, int64_t* out_len) {
+  if (!key || keylen <= 0 || len < 0 || !out || !out_len) return PTQC_ERR;
+  // CTR counter is 32 bits over 16-byte blocks: past 64 GiB the
+  // keystream would repeat, silently destroying confidentiality
+  if (uint64_t(len) >= (uint64_t(1) << 36)) return PTQC_ERR;
+  size_t total = kHeader + kIv + size_t(len) + kTag;
+  uint8_t* buf = static_cast<uint8_t*>(malloc(total));
+  if (!buf) return PTQC_ERR;
+  memcpy(buf, kMagic, 4);
+  buf[4] = 1;  // version
+  uint8_t* iv = buf + kHeader;
+  if (!fill_random(iv, 12)) { free(buf); return PTQC_ERR; }
+  memset(iv + 12, 0, 4);  // counter starts at 0
+  uint8_t ek[32];
+  derive_enc_key(key, size_t(keylen), ek);
+  Aes256 aes(ek);
+  aes256_ctr_xor(aes, iv, plain, buf + kHeader + kIv, size_t(len));
+  uint8_t mk[32];
+  derive_mac_key(key, size_t(keylen), mk);
+  hmac_sha256(mk, 32, buf, kHeader + kIv + size_t(len), nullptr, 0,
+              buf + kHeader + kIv + size_t(len));
+  *out = buf;
+  *out_len = int64_t(total);
+  return PTQC_OK;
+}
+
+// Opens a sealed buffer; returns PTQC_BAD_TAG on wrong key/corruption.
+int ptq_crypto_decrypt(const uint8_t* key, int64_t keylen,
+                       const uint8_t* sealed, int64_t len,
+                       uint8_t** out, int64_t* out_len) {
+  if (!key || keylen <= 0 || !sealed || !out || !out_len) return PTQC_ERR;
+  // structural damage (truncation, bad magic/version) is reported the
+  // same way as a bad tag: "this is not an intact sealed buffer"
+  if (len < 0 || size_t(len) < kHeader + kIv + kTag) return PTQC_BAD_TAG;
+  if (memcmp(sealed, kMagic, 4) != 0 || sealed[4] != 1) return PTQC_BAD_TAG;
+  size_t clen = size_t(len) - kHeader - kIv - kTag;
+  uint8_t mk[32], want[32];
+  derive_mac_key(key, size_t(keylen), mk);
+  hmac_sha256(mk, 32, sealed, kHeader + kIv + clen, nullptr, 0, want);
+  if (ct_memcmp(want, sealed + kHeader + kIv + clen, kTag))
+    return PTQC_BAD_TAG;
+  uint8_t* buf = static_cast<uint8_t*>(malloc(clen ? clen : 1));
+  if (!buf) return PTQC_ERR;
+  uint8_t ek[32];
+  derive_enc_key(key, size_t(keylen), ek);
+  Aes256 aes(ek);
+  aes256_ctr_xor(aes, sealed + kHeader, sealed + kHeader + kIv, buf, clen);
+  *out = buf;
+  *out_len = int64_t(clen);
+  return PTQC_OK;
+}
+
+// Self-check against a FIPS-197 appendix C.3 vector (AES-256 raw block,
+// exercised by tests through this hook rather than exposing internals).
+int ptq_crypto_selftest() {
+  const uint8_t key[32] = {
+      0x00, 0x01, 0x02, 0x03, 0x04, 0x05, 0x06, 0x07,
+      0x08, 0x09, 0x0a, 0x0b, 0x0c, 0x0d, 0x0e, 0x0f,
+      0x10, 0x11, 0x12, 0x13, 0x14, 0x15, 0x16, 0x17,
+      0x18, 0x19, 0x1a, 0x1b, 0x1c, 0x1d, 0x1e, 0x1f};
+  const uint8_t pt[16] = {0x00, 0x11, 0x22, 0x33, 0x44, 0x55, 0x66, 0x77,
+                          0x88, 0x99, 0xaa, 0xbb, 0xcc, 0xdd, 0xee, 0xff};
+  const uint8_t want[16] = {0x8e, 0xa2, 0xb7, 0xca, 0x51, 0x67, 0x45, 0xbf,
+                            0xea, 0xfc, 0x49, 0x90, 0x4b, 0x49, 0x60, 0x89};
+  Aes256 aes(key);
+  uint8_t got[16];
+  aes.encrypt_block(pt, got);
+  if (memcmp(got, want, 16) != 0) return PTQC_ERR;
+  // SHA-256 of "abc" (FIPS 180-4 appendix B.1)
+  const uint8_t sha_want[32] = {
+      0xba, 0x78, 0x16, 0xbf, 0x8f, 0x01, 0xcf, 0xea,
+      0x41, 0x41, 0x40, 0xde, 0x5d, 0xae, 0x22, 0x23,
+      0xb0, 0x03, 0x61, 0xa3, 0x96, 0x17, 0x7a, 0x9c,
+      0xb4, 0x10, 0xff, 0x61, 0xf2, 0x00, 0x15, 0xad};
+  uint8_t sha_got[32];
+  sha256(reinterpret_cast<const uint8_t*>("abc"), 3, sha_got);
+  if (memcmp(sha_got, sha_want, 32) != 0) return PTQC_ERR;
+  return PTQC_OK;
+}
+
+}  // extern "C"

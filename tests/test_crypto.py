@@ -1,0 +1,124 @@
+"""Model-file encryption tests (reference: framework/io/crypto/
+aes_cipher_test.cc, cipher_utils_test.cc, pybind/crypto.cc surface)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.core.native as native
+from paddle_tpu.core.crypto import (
+    AESCipher, CipherFactory, CipherUtils,
+)
+
+
+def test_known_answer_selftest():
+    # FIPS-197 appendix C.3 AES-256 block + FIPS-180-4 B.1 SHA-256
+    assert native.crypto_selftest()
+
+
+def test_round_trip_bytes_and_str():
+    c = CipherFactory.create_cipher()
+    key = CipherUtils.gen_key(256)
+    for plain in (b"", b"x", b"paddle-tpu" * 1000, os.urandom(4097)):
+        sealed = c.encrypt(plain, key)
+        assert sealed != plain
+        assert c.decrypt(sealed, key) == plain
+    # str plaintext/key accepted (utf-8)
+    sealed = c.encrypt("hello 世界", "passphrase-key")
+    assert c.decrypt(sealed, "passphrase-key").decode("utf-8") == \
+        "hello 世界"
+
+
+def test_wrong_key_and_corruption_rejected():
+    c = AESCipher()
+    key = CipherUtils.gen_key(256)
+    sealed = bytearray(c.encrypt(b"secret weights", key))
+    with pytest.raises(ValueError):
+        c.decrypt(bytes(sealed), CipherUtils.gen_key(256))
+    # flip one ciphertext bit -> tag mismatch
+    sealed[25] ^= 1
+    with pytest.raises(ValueError):
+        c.decrypt(bytes(sealed), key)
+    # truncation / bad magic -> same ValueError contract as a bad tag
+    with pytest.raises(ValueError):
+        c.decrypt(bytes(sealed[:10]), key)
+    with pytest.raises(ValueError):
+        c.decrypt(b"NOPE" + bytes(sealed[4:]), key)
+
+
+def test_nondeterministic_iv():
+    c = AESCipher()
+    key = CipherUtils.gen_key(128)  # any byte length folds to 256
+    a = c.encrypt(b"same plaintext", key)
+    b = c.encrypt(b"same plaintext", key)
+    assert a != b  # fresh IV per seal
+    assert c.decrypt(a, key) == c.decrypt(b, key) == b"same plaintext"
+
+
+def test_key_file_and_config(tmp_path):
+    kf = str(tmp_path / "model.key")
+    key = CipherUtils.gen_key_to_file(256, kf)
+    assert CipherUtils.read_key_from_file(kf) == key
+    assert len(key) == 32
+
+    cfgf = str(tmp_path / "cipher.cfg")
+    with open(cfgf, "w") as f:
+        f.write("# model cipher\ncipher_name: AES_CTR_EtM(256)\n")
+    c = CipherFactory.create_cipher(cfgf)
+    assert c.decrypt(c.encrypt(b"abc", key), key) == b"abc"
+
+    with open(cfgf, "w") as f:
+        f.write("cipher_name: ROT13\n")
+    with pytest.raises(ValueError):
+        CipherFactory.create_cipher(cfgf)
+
+
+def test_file_round_trip(tmp_path):
+    c = AESCipher()
+    key = CipherUtils.gen_key(256)
+    path = str(tmp_path / "sealed.bin")
+    payload = os.urandom(100000)
+    c.encrypt_to_file(payload, key, path)
+    assert open(path, "rb").read()[:4] == b"PTQE"
+    assert c.decrypt_from_file(key, path) == payload
+
+
+def test_encrypted_inference_model_round_trip(tmp_path):
+    """End-to-end: save_inference_model -> encrypt artifacts -> decrypt
+    -> load -> identical predictions (the reference's model-protection
+    use case, incubate/hapi + crypto.cc)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework
+
+    main_p, startup_p = framework.Program(), framework.Program()
+    with framework.program_guard(main_p, startup_p):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(input=x, size=3, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup_p)
+    xd = np.random.RandomState(0).rand(5, 4).astype("float32")
+    want = np.asarray(
+        exe.run(main_p, feed={"x": xd}, fetch_list=[y])[0])
+
+    d = str(tmp_path / "model")
+    fluid.io.save_inference_model(d, ["x"], [y], exe, main_program=main_p)
+
+    cipher = fluid.core.CipherFactory.create_cipher()
+    key = fluid.core.CipherUtils.gen_key(256)
+    for root, _, files in os.walk(d):
+        for fn in files:
+            p = os.path.join(root, fn)
+            cipher.encrypt_to_file(open(p, "rb").read(), key, p)
+
+    # sealed artifacts are unreadable until decrypted
+    for root, _, files in os.walk(d):
+        for fn in files:
+            p = os.path.join(root, fn)
+            data = cipher.decrypt_from_file(key, p)
+            with open(p, "wb") as f:
+                f.write(data)
+
+    prog, feeds, fetches = fluid.io.load_inference_model(d, exe)
+    got = np.asarray(
+        exe.run(prog, feed={feeds[0]: xd}, fetch_list=fetches)[0])
+    np.testing.assert_allclose(got, want, rtol=1e-6)
