@@ -122,8 +122,7 @@ void sha256(const uint8_t* p, size_t n, uint8_t out[32]) {
 
 // HMAC-SHA256 (FIPS 198-1)
 void hmac_sha256(const uint8_t* key, size_t keylen, const uint8_t* msg,
-                 size_t msglen, const uint8_t* msg2, size_t msg2len,
-                 uint8_t out[32]) {
+                 size_t msglen, uint8_t out[32]) {
   uint8_t k[64] = {0};
   if (keylen > 64) {
     sha256(key, keylen, k);  // fold long keys, per spec
@@ -139,7 +138,6 @@ void hmac_sha256(const uint8_t* key, size_t keylen, const uint8_t* msg,
   Sha256 si;
   si.update(ipad, 64);
   si.update(msg, msglen);
-  if (msg2len) si.update(msg2, msg2len);
   si.final(inner);
   Sha256 so;
   so.update(opad, 64);
@@ -328,7 +326,7 @@ int ptq_crypto_encrypt(const uint8_t* key, int64_t keylen,
   aes256_ctr_xor(aes, iv, plain, buf + kHeader + kIv, size_t(len));
   uint8_t mk[32];
   derive_mac_key(key, size_t(keylen), mk);
-  hmac_sha256(mk, 32, buf, kHeader + kIv + size_t(len), nullptr, 0,
+  hmac_sha256(mk, 32, buf, kHeader + kIv + size_t(len),
               buf + kHeader + kIv + size_t(len));
   *out = buf;
   *out_len = int64_t(total);
@@ -347,7 +345,7 @@ int ptq_crypto_decrypt(const uint8_t* key, int64_t keylen,
   size_t clen = size_t(len) - kHeader - kIv - kTag;
   uint8_t mk[32], want[32];
   derive_mac_key(key, size_t(keylen), mk);
-  hmac_sha256(mk, 32, sealed, kHeader + kIv + clen, nullptr, 0, want);
+  hmac_sha256(mk, 32, sealed, kHeader + kIv + clen, want);
   if (ct_memcmp(want, sealed + kHeader + kIv + clen, kTag))
     return PTQC_BAD_TAG;
   uint8_t* buf = static_cast<uint8_t*>(malloc(clen ? clen : 1));

@@ -12,6 +12,7 @@ from .framework import (  # noqa: F401
     CPUPlace, CUDAPlace, CUDAPinnedPlace, TPUPlace,
     unique_name_guard,
 )
+from .. import core  # noqa: F401  (fluid.core.CipherUtils etc.)
 from ..core.scope import Scope, global_scope, scope_guard  # noqa: F401
 from ..core.lod import (  # noqa: F401
     LoDTensor, create_lod_tensor, create_random_int_lodtensor,
