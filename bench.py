@@ -350,24 +350,21 @@ def _bert_feed(cfg, batch, seq_len):
     return feed(cfg, batch, seq_len, max_pred=int(seq_len * 0.15))
 
 
-def _bench_resnet(batch: int, steps: int, warmup: int,
-                  platform: str, depth: int = 50, img: int = 224,
-                  class_dim: int = 1000) -> dict:
-    """ResNet50 ImageNet training throughput (BASELINE.json config 2).
-    depth/img/class_dim shrink only for the CPU smoke test — the bench
-    always runs the 50/224/1000 config."""
-    import numpy as np
-
+def build_resnet_train_program(depth: int = 50, img_size: int = 224,
+                               class_dim: int = 1000, seed: int = 11):
+    """The canonical ResNet train program (momentum + bf16 AMP, static
+    loss scaling). ONE definition shared by `_bench_resnet` and
+    `tools/perf_analysis.py` so the committed fallback analysis always
+    lowers exactly the program the bench runs. Seeded init keeps
+    attempts reproducible (unseeded init made the CPU smoke test
+    flaky-NaN at toy scale). Returns (main, startup, loss_var)."""
     import paddle_tpu.fluid as fluid
     from paddle_tpu.fluid import framework
     from paddle_tpu.fluid.contrib import mixed_precision
     from paddle_tpu.models import resnet as resnet_mod
 
-    img_size = img
     main_p, startup_p = framework.Program(), framework.Program()
-    # seeded init: attempts are reproducible and the CPU smoke test is
-    # deterministic (unseeded init made it flaky-NaN at toy scale)
-    main_p.random_seed = startup_p.random_seed = 11
+    main_p.random_seed = startup_p.random_seed = seed
     with framework.program_guard(main_p, startup_p):
         with framework.unique_name_guard():
             img = fluid.layers.data("image",
@@ -383,27 +380,43 @@ def _bench_resnet(batch: int, steps: int, warmup: int,
                 fluid.optimizer.MomentumOptimizer(0.1, momentum=0.9),
                 use_dynamic_loss_scaling=False)
             opt.minimize(loss)
-            exe = fluid.Executor(fluid.TPUPlace())
-            exe.run(startup_p)
-            r = np.random.RandomState(0)
-            feed = {
-                "image": r.randn(batch, 3, img_size,
-                                 img_size).astype("float32"),
-                "label": r.randint(0, class_dim,
-                                   (batch, 1)).astype("int64"),
-            }
-            t0 = time.perf_counter()
-            out = exe.run(main_p, feed=feed, fetch_list=[loss])
-            np.asarray(out[0])
-            compile_time = time.perf_counter() - t0
-            for _ in range(max(warmup - 1, 0)):
-                out = exe.run(main_p, feed=feed, fetch_list=[loss])
-            np.asarray(out[0])
-            t0 = time.perf_counter()
-            for _ in range(steps):
-                out = exe.run(main_p, feed=feed, fetch_list=[loss])
-            np.asarray(out[0])
-            dt = time.perf_counter() - t0
+    return main_p, startup_p, loss
+
+
+def _bench_resnet(batch: int, steps: int, warmup: int,
+                  platform: str, depth: int = 50, img: int = 224,
+                  class_dim: int = 1000) -> dict:
+    """ResNet50 ImageNet training throughput (BASELINE.json config 2).
+    depth/img/class_dim shrink only for the CPU smoke test — the bench
+    always runs the 50/224/1000 config."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+
+    img_size = img
+    main_p, startup_p, loss = build_resnet_train_program(
+        depth=depth, img_size=img_size, class_dim=class_dim)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup_p)
+    r = np.random.RandomState(0)
+    feed = {
+        "image": r.randn(batch, 3, img_size,
+                         img_size).astype("float32"),
+        "label": r.randint(0, class_dim,
+                           (batch, 1)).astype("int64"),
+    }
+    t0 = time.perf_counter()
+    out = exe.run(main_p, feed=feed, fetch_list=[loss])
+    np.asarray(out[0])
+    compile_time = time.perf_counter() - t0
+    for _ in range(max(warmup - 1, 0)):
+        out = exe.run(main_p, feed=feed, fetch_list=[loss])
+    np.asarray(out[0])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = exe.run(main_p, feed=feed, fetch_list=[loss])
+    np.asarray(out[0])
+    dt = time.perf_counter() - t0
     imgs_per_sec = batch * steps / dt
     # ~4.1 GFLOPs fwd per 224x224 image, x3 for training
     result = {

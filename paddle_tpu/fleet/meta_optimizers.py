@@ -149,12 +149,6 @@ _WARN_ONLY = [
                   "mode is not wired through fleet yet; use "
                   "fluid.transpiler.DistributeTranspiler for PS "
                   "training. Running collective (sync) instead."),
-    _WarnOnlyMeta("elastic",
-                  "DistributedStrategy.elastic is not implemented; "
-                  "ignoring."),
-    _WarnOnlyMeta("auto",
-                  "DistributedStrategy.auto (auto-parallel search) is "
-                  "not implemented; ignoring."),
     _WarnOnlyMeta("sync_batch_norm",
                   "DistributedStrategy.sync_batch_norm is not "
                   "implemented; BN stats stay per-replica."),

@@ -263,7 +263,16 @@ class AsyncCheckpointer:
             except BaseException as e:  # noqa: BLE001 - surfaced in wait()
                 self._err.append(e)
 
+    def check(self):
+        """Raise the first background write error, if any. Callers that
+        keep training between saves use this to fail loudly instead of
+        running for days on a checkpoint path that never works."""
+        if self._err:
+            raise RuntimeError(
+                "background checkpoint write failed") from self._err[0]
+
     def save_async(self, train_status):
+        self.check()
         snap = _snapshot(self._program, self._scope)
         item = (snap, train_status)
         while True:

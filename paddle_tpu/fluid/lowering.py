@@ -50,11 +50,12 @@ class LoweredFunction:
 
     __slots__ = ("jitted", "state_in_names", "state_out_names",
                  "state_mut_names", "state_ro_names",
-                 "fetch_names", "feed_names", "mesh", "dp_axis")
+                 "fetch_names", "feed_names", "mesh", "dp_axis",
+                 "auto_plan")
 
     def __init__(self, jitted, feed_names, state_in_names, state_out_names,
                  state_mut_names, state_ro_names, fetch_names, mesh=None,
-                 dp_axis=None):
+                 dp_axis=None, auto_plan=None):
         self.jitted = jitted
         self.feed_names = feed_names
         self.state_in_names = state_in_names
@@ -64,6 +65,7 @@ class LoweredFunction:
         self.fetch_names = fetch_names
         self.mesh = mesh
         self.dp_axis = dp_axis
+        self.auto_plan = auto_plan
 
 
 def _sub_block_idxs(op):
@@ -658,6 +660,36 @@ def compile_block(program, block, feed_specs, fetch_names, state_specs,
         from ..utils.flags import get_flag
 
         donate = bool(get_flag("FLAGS_tpu_donate_buffers", True))
+
+    ap_cfg = getattr(program, "_auto_parallel", None)
+    if ap_cfg is not None:
+        host, dynamic = _block_host_op_kinds(block)
+        if host or dynamic:
+            import warnings
+
+            warnings.warn(
+                "auto-parallel declined: the program contains host/"
+                "dynamic-shape ops that cannot run under a GSPMD-"
+                "partitioned jit; running single-device instead.")
+        else:
+            from ..parallel import auto_parallel as ap
+
+            persistable = set()
+            for n in state_in:
+                v = block._find_var_recursive(n)
+                if v is not None and getattr(v, "persistable", False):
+                    persistable.add(n)
+            plan = ap.search_plan(fn, feed_specs, state_mut, state_ro,
+                                  state_specs, persistable,
+                                  configs=ap_cfg)
+            program._auto_plan = plan
+            jitted = ap.compile_with_plan(fn, plan, feed_names,
+                                          state_mut, state_ro, state_out,
+                                          donate=donate)
+            return LoweredFunction(jitted, feed_names, state_in,
+                                   state_out, state_mut, state_ro,
+                                   fetch_names, mesh=plan.mesh,
+                                   dp_axis="dp", auto_plan=plan)
 
     if mesh is not None and getattr(program, "_data_parallel", False):
         jitted = _compile_dp(fn, mesh, dp_axis, program, block,

@@ -144,67 +144,50 @@ def analytical(cfg, n_params, batch, remat=False):
 
 
 def build_resnet_step(batch, img_size=224, class_dim=1000):
-    """Lowers the EXACT bench ResNet50 train step (bench._bench_resnet:
-    momentum + bf16 AMP, 224x224x1000) without running it."""
+    """Lowers the EXACT bench ResNet50 train step without running it —
+    the program comes from `bench.build_resnet_train_program` (one
+    shared definition; this module never rebuilds its own copy)."""
+    import bench
     import paddle_tpu.fluid as fluid
-    from paddle_tpu.fluid import framework, lowering
-    from paddle_tpu.fluid.contrib import mixed_precision
-    from paddle_tpu.models import resnet as resnet_mod
+    from paddle_tpu.fluid import lowering
     from paddle_tpu.core.scope import global_scope
 
-    main_p, startup_p = framework.Program(), framework.Program()
-    main_p.random_seed = startup_p.random_seed = 11
-    with framework.program_guard(main_p, startup_p):
-        with framework.unique_name_guard():
-            img = fluid.layers.data("image",
-                                    shape=[3, img_size, img_size],
-                                    dtype="float32")
-            label = fluid.layers.data("label", shape=[1], dtype="int64")
-            logits = resnet_mod.resnet(img, class_dim=class_dim,
-                                       depth=50)
-            loss = fluid.layers.mean(
-                fluid.layers.loss.softmax_with_cross_entropy(
-                    logits, label))
-            opt = mixed_precision.decorate(
-                fluid.optimizer.MomentumOptimizer(0.1, momentum=0.9),
-                use_dynamic_loss_scaling=False)
-            opt.minimize(loss)
-            n_params = sum(int(np.prod(p.shape))
-                           for p in main_p.all_parameters())
-            # per-image activation elements, summed from the block's own
-            # inferred var shapes (exact for this program, not a rule of
-            # thumb); batch dim in var shapes is -1
-            act_elems = 0
-            block = main_p.global_block()
-            param_names = {p.name for p in main_p.all_parameters()}
-            for name, var in block.vars.items():
-                shape = getattr(var, "shape", None)
-                if not shape or name in param_names:
-                    continue
-                if any(int(d) <= 0 for d in shape[1:]):
-                    continue
-                if int(shape[0]) in (-1, 0):
-                    act_elems += int(np.prod([int(d)
-                                              for d in shape[1:]]))
-            exe = fluid.Executor(fluid.TPUPlace())
-            exe.run(startup_p)
-            r = np.random.RandomState(0)
-            feed_arrays = {
-                "image": r.randn(batch, 3, img_size,
-                                 img_size).astype("float32"),
-                "label": r.randint(0, class_dim,
-                                   (batch, 1)).astype("int64"),
-            }
-            state_in, _ = lowering.analyze_block(
-                block, list(feed_arrays), [loss.name])
-            state_specs = {n: global_scope().find_var(n)
-                           for n in state_in}
-            entry = lowering.compile_block(
-                main_p, block, feed_arrays, [loss.name], state_specs)
-            states_mut = {n: global_scope().find_var(n)
-                          for n in entry.state_mut_names}
-            states_ro = {n: global_scope().find_var(n)
-                         for n in entry.state_ro_names}
+    main_p, startup_p, loss = bench.build_resnet_train_program(
+        img_size=img_size, class_dim=class_dim)
+    n_params = sum(int(np.prod(p.shape))
+                   for p in main_p.all_parameters())
+    # per-image activation elements, summed from the block's own
+    # inferred var shapes (exact for this program, not a rule of
+    # thumb); batch dim in var shapes is -1
+    act_elems = 0
+    block = main_p.global_block()
+    param_names = {p.name for p in main_p.all_parameters()}
+    for name, var in block.vars.items():
+        shape = getattr(var, "shape", None)
+        if not shape or name in param_names:
+            continue
+        if any(int(d) <= 0 for d in shape[1:]):
+            continue
+        if int(shape[0]) in (-1, 0):
+            act_elems += int(np.prod([int(d) for d in shape[1:]]))
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup_p)
+    r = np.random.RandomState(0)
+    feed_arrays = {
+        "image": r.randn(batch, 3, img_size,
+                         img_size).astype("float32"),
+        "label": r.randint(0, class_dim,
+                           (batch, 1)).astype("int64"),
+    }
+    state_in, _ = lowering.analyze_block(
+        block, list(feed_arrays), [loss.name])
+    state_specs = {n: global_scope().find_var(n) for n in state_in}
+    entry = lowering.compile_block(
+        main_p, block, feed_arrays, [loss.name], state_specs)
+    states_mut = {n: global_scope().find_var(n)
+                  for n in entry.state_mut_names}
+    states_ro = {n: global_scope().find_var(n)
+                 for n in entry.state_ro_names}
     return n_params, act_elems, entry, feed_arrays, states_mut, states_ro
 
 
@@ -246,6 +229,9 @@ def main():
         else:
             flag = a
             val = args[i + 1] if i + 1 < len(args) else ""
+            if not val or val.startswith("--"):
+                raise SystemExit("flag %s needs a value (e.g. %s=128,256)"
+                                 % (flag, flag))
             i += 1
         if flag == "--batches":
             batches = [int(x) for x in val.split(",") if x]
