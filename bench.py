@@ -47,18 +47,133 @@ _REPO = os.path.dirname(os.path.abspath(__file__))
 _LAST_GOOD = os.path.join(_REPO, ".bench_last_good.json")
 _COMPILE_CACHE = os.path.join(_REPO, ".jax_cache")
 
-# (platform, wall budget seconds, bert batch, steps, warmup)
-# batch 256 first: it is the round-2 comparable (83.3k tok/s @ 34% MFU,
-# pre-fused-head) and the single most valuable shape to land, so it
-# gets the first — and largest — budget, sized for a cold compile
-# through a flaky tunnel. 512 (fused head + per-layer remat, the
-# PERF_ANALYSIS_r4 fit) follows, then a small 128 salvage attempt.
-_ATTEMPTS = [
-    ("tpu", 900, BATCH, STEPS, WARMUP),
-    ("tpu", 560, 2 * BATCH, STEPS, WARMUP),
-    ("tpu", 300, 128, STEPS, WARMUP),
+# Staged schedule, sized for the observed tunnel behavior (round 4:
+# windows of ~1-2 minutes, hours apart — the 03:17Z window survived
+# imports+trace and died mid-compile while three long attempts burned
+# 29 min blocked on a dead tunnel):
+#   warm    — compile-only child; its one job is landing the executable
+#             in the persistent .jax_cache so a LATER short window can
+#             measure without paying XLA
+#   measure — full timed run; with a warm cache it fits a ~1-min window
+# Every stage is gated on a fresh ~45s liveness probe, so a dead tunnel
+# costs one probe, not the sum of all budgets. A failed warm skips its
+# batch's measure stage (it would recompile cold and cannot fit).
+# batch 256 first: the round-2 comparable (83.3k tok/s @ 34% MFU,
+# pre-fused-head); 512 (fused head + per-layer remat, the
+# PERF_ANALYSIS_r4 fit) follows, then a cold small-batch salvage.
+_STAGES = [
+    {"kind": "warm", "batch": BATCH, "budget": 480, "steps": 0,
+     "warmup": 0},
+    {"kind": "measure", "batch": BATCH, "budget": 180, "steps": STEPS,
+     "warmup": WARMUP},
+    {"kind": "warm", "batch": 2 * BATCH, "budget": 420, "steps": 0,
+     "warmup": 0},
+    {"kind": "measure", "batch": 2 * BATCH, "budget": 180,
+     "steps": STEPS, "warmup": WARMUP},
+    {"kind": "measure", "batch": 128, "budget": 300, "steps": STEPS,
+     "warmup": WARMUP},
 ]
 _CPU_ATTEMPT = ("cpu", 420, 8, 2, 1)
+
+# ONE probe definition (source + budget + runner) shared with
+# tools/capture_loop.py — two diverging copies previously meant a
+# 46-75s live-but-slow window could pass the loop's 75s probe and then
+# fail a tighter gate here. 75s was sized from observed real timings.
+_PROBE_BUDGET = 75.0
+_PROBE_SRC = r"""
+import numpy as np, time, sys
+t0 = time.perf_counter()
+import jax, jax.numpy as jnp
+dev = jax.devices()[0]
+if dev.platform != "tpu":
+    print("PROBE_NOT_TPU", dev.platform); sys.exit(3)
+x = jnp.ones((512, 512), jnp.bfloat16)
+y = np.asarray(jax.jit(lambda a: a @ a)(x))
+print("PROBE_OK", round(time.perf_counter() - t0, 1), float(y[0, 0]))
+"""
+
+_WARM_MARKER = os.path.join(_REPO, ".bench_warm.json")
+
+
+def _bench_fingerprint() -> str:
+    """Hash over the sources that define the bench program: a changed
+    program invalidates warm markers (the cached executable no longer
+    matches what a measure child would trace)."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for p in (os.path.abspath(__file__),
+              os.path.join(_REPO, "paddle_tpu", "models", "bert.py")):
+        try:
+            with open(p, "rb") as f:
+                h.update(f.read())
+        except OSError:
+            pass
+    return h.hexdigest()[:16]
+
+
+def _load_warm_batches() -> set:
+    """Batches whose executable a previous invocation already landed in
+    the persistent compile cache — their warm stages are skippable, so
+    a later short window goes straight to measuring."""
+    try:
+        with open(_WARM_MARKER) as f:
+            d = json.load(f)
+        if d.get("fingerprint") != _bench_fingerprint():
+            return set()
+        if not os.path.isdir(_COMPILE_CACHE) or \
+                not os.listdir(_COMPILE_CACHE):
+            return set()  # cache wiped: markers lie
+        return {int(b) for b in d.get("batches", [])}
+    except (OSError, ValueError):
+        return set()
+
+
+def _write_warm(batches: set) -> None:
+    try:
+        tmp = _WARM_MARKER + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"fingerprint": _bench_fingerprint(),
+                       "batches": sorted(batches),
+                       "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                            time.gmtime())}, f)
+        os.replace(tmp, _WARM_MARKER)
+    except OSError:
+        pass
+
+
+def _mark_warm(batch: int) -> None:
+    _write_warm(_load_warm_batches() | {int(batch)})
+
+
+def _unmark_warm(batch: int) -> None:
+    """A measure on a supposedly-warm batch failed: the marker lied
+    (cache evicted, or a lowering change the fingerprint doesn't cover)
+    — drop it so the next window re-warms instead of repeating a doomed
+    cold measure forever."""
+    _write_warm(_load_warm_batches() - {int(batch)})
+
+
+def _tunnel_alive(errors) -> bool:
+    """Tiny-matmul liveness probe in a child (the hang mode is an
+    in-process PJRT call that never returns — it cannot be timed out
+    from inside). Gates TPU stages."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC], env=_child_env("tpu"),
+            cwd=_REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, timeout=_PROBE_BUDGET)
+        if proc.returncode == 0 and "PROBE_OK" in (proc.stdout or ""):
+            return True
+        errors.append("probe: rc=%d %s"
+                      % (proc.returncode,
+                         (proc.stdout or "").strip()[-120:]))
+    except subprocess.TimeoutExpired:
+        errors.append("probe: tunnel dead (timeout %.0fs)"
+                      % _PROBE_BUDGET)
+    except Exception as e:  # noqa: BLE001
+        errors.append("probe: %r" % (e,))
+    return False
 
 _RESULT_TAG = "BENCH_RESULT_JSON:"
 
@@ -154,41 +269,79 @@ def _run_attempt(platform, budget, batch, steps, warmup, idx, errors):
 
 def main() -> int:
     errors = []
-    for i, (platform, budget, batch, steps, warmup) in enumerate(_ATTEMPTS):
-        if i > 0:
-            time.sleep(min(15.0 * i, 30.0))  # backoff before retry
-        result = _run_attempt(platform, budget, batch, steps, warmup,
-                              i, errors)
-        if result is not None:
-            # a success supersedes any earlier attempts' failure dumps:
-            # leaving them around would misattribute "which phase died"
-            import glob
+    result = None
+    skip_batches = set()
+    # warm markers persist across invocations: once an executable is in
+    # the compile cache, every later (short) window measures directly
+    already_warm = _load_warm_batches()
+    # a TPU child that just succeeded IS a liveness proof — don't spend
+    # window time re-probing after it. The caller may vouch for the
+    # first stage too (capture_loop probes right before invoking us).
+    live = os.environ.get("BENCH_ASSUME_LIVE") == "1"
+    for i, st in enumerate(_STAGES):
+        if st["batch"] in skip_batches:
+            continue
+        if st["kind"] == "warm" and st["batch"] in already_warm:
+            continue
+        if not live and not _tunnel_alive(errors):
+            # dead tunnel: stop burning stage budgets; the capture loop
+            # (tools/capture_loop.py) retries on its own cycle
+            break
+        r = _run_attempt("tpu", st["budget"], st["batch"], st["steps"],
+                         st["warmup"], i, errors)
+        live = r is not None
+        if st["kind"] == "warm":
+            if r is None:
+                # compile didn't land in the cache: its measure stage
+                # would recompile cold and cannot fit a short window
+                skip_batches.add(st["batch"])
+            else:
+                _mark_warm(st["batch"])
+            continue
+        if r is None and st["batch"] in already_warm:
+            # the marker promised a cached executable but the measure
+            # still failed: stop trusting it for this batch
+            _unmark_warm(st["batch"])
+        if r is not None and not r.get("warm"):
+            result = r
+            # a full measure also proves this batch's executable is
+            # cached for future invocations
+            _mark_warm(st["batch"])
+            break
+        if i + 1 < len(_STAGES):
+            live = False
+            time.sleep(10.0)  # brief backoff before the next stage
 
-            for p in glob.glob(os.path.join(
-                    _REPO, ".bench_child_fail_*.log")):
-                try:
-                    os.remove(p)
-                except OSError:
-                    pass
-            if errors:
-                result["error"] = "; ".join(errors)[:500]
+    if result is not None:
+        # a success supersedes any earlier attempts' failure dumps:
+        # leaving them around would misattribute "which phase died"
+        import glob
+
+        for p in glob.glob(os.path.join(
+                _REPO, ".bench_child_fail_*.log")):
             try:
-                with open(_LAST_GOOD, "w") as f:
-                    json.dump({"ts": time.time(),
-                               "iso": time.strftime(
-                                   "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-                               "result": result}, f, indent=1)
+                os.remove(p)
             except OSError:
                 pass
-            print(json.dumps(result))
-            return 0
+        if errors:
+            result["error"] = "; ".join(errors)[:500]
+        try:
+            with open(_LAST_GOOD, "w") as f:
+                json.dump({"ts": time.time(),
+                           "iso": time.strftime(
+                               "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                           "result": result}, f, indent=1)
+        except OSError:
+            pass
+        print(json.dumps(result))
+        return 0
 
-    # All TPU attempts failed. Run a CPU liveness probe, then emit the
+    # All TPU stages failed. Run a CPU liveness probe, then emit the
     # last-known-good TPU result stale-marked (or the CPU number if no
     # last-good exists).
     platform, budget, batch, steps, warmup = _CPU_ATTEMPT
     cpu_result = _run_attempt(platform, budget, batch, steps, warmup,
-                              len(_ATTEMPTS), errors)
+                              len(_STAGES), errors)
 
     last_good = None
     try:
@@ -292,6 +445,18 @@ def _bench_child(platform: str, batch: int, steps: int, warmup: int,
             np.asarray(out[0])
             compile_time = time.perf_counter() - t_compile0
             _hb("compile_done", t_start)
+
+            if steps == 0:
+                # warm stage: the executable is now in the persistent
+                # compile cache — that IS the result. A later ~1-min
+                # tunnel window can measure without paying XLA.
+                print(_RESULT_TAG + json.dumps({
+                    "warm": True, "platform": platform, "batch": batch,
+                    "compile_time_s": round(compile_time, 1),
+                    "loss": round(float(
+                        np.asarray(out[0]).reshape(-1)[0]), 4),
+                }), flush=True)
+                return
 
             for _ in range(max(warmup - 1, 0)):
                 out = exe.run(main_p, feed=feed, fetch_list=[total])

@@ -254,10 +254,13 @@ class Executor:
 
         from . import checkpoint as ckpt
 
-        ecfg["_resumed"] = True
         root = ecfg.get("checkpoint_dir") or "elastic_checkpoints"
+        # mark resumed only AFTER the load succeeds (or cleanly finds
+        # nothing): a transient load failure must stay retryable, not
+        # silently restart from init and rotate out the good checkpoints
         status = ckpt.load_checkpoint(self, root, main_program=program,
                                       scope=scope)
+        ecfg["_resumed"] = True
         if status is not None:
             ecfg["_step"] = status.step_no + 1
             logging.getLogger("paddle_tpu.elastic").info(

@@ -681,7 +681,8 @@ def compile_block(program, block, feed_specs, fetch_names, state_specs,
                     persistable.add(n)
             plan = ap.search_plan(fn, feed_specs, state_mut, state_ro,
                                   state_specs, persistable,
-                                  configs=ap_cfg)
+                                  configs=ap_cfg, state_out=state_out,
+                                  donate=donate)
             program._auto_plan = plan
             jitted = ap.compile_with_plan(fn, plan, feed_names,
                                           state_mut, state_ro, state_out,

@@ -126,13 +126,18 @@ def _score(compiled, mem_budget):
 
 
 def search_plan(fn, feed_specs, state_mut, state_ro, state_specs,
-                persistable, devices=None, configs=None):
+                persistable, devices=None, configs=None, state_out=None,
+                donate=True):
     """Enumerate (dp, tp) candidates, AOT-compile each, score with XLA's
     memory/cost analyses, return the winning AutoPlan.
 
     fn: the block function (feeds, states_mut, states_ro, seed).
     state_specs: name -> array/aval for every state var.
     persistable: set of parameter-like names eligible for tp splitting.
+    state_out/donate: passed so the scoring compile uses the SAME
+    out_shardings/donation as the final `compile_with_plan` jit — with
+    a jax compilation cache enabled, the winner's final compile is then
+    a cache hit instead of a second full XLA compile.
     """
     import jax
     from jax.sharding import NamedSharding
@@ -141,6 +146,11 @@ def search_plan(fn, feed_specs, state_mut, state_ro, state_specs,
     if devices is None:
         devices = jax.devices()
     ndev = int(configs.get("nranks", len(devices)))
+    if ndev > len(devices):
+        logger.warning(
+            "auto-parallel: nranks=%d exceeds the %d available devices; "
+            "clamping", ndev, len(devices))
+        ndev = len(devices)
     mem_budget = configs.get("mem_budget_mb")
     if mem_budget is not None:
         mem_budget = float(mem_budget) * (1 << 20)
@@ -161,34 +171,54 @@ def search_plan(fn, feed_specs, state_mut, state_ro, state_specs,
             report.append({"dp": dp, "tp": tp, "skip": "batch % dp != 0"})
             continue
         fspecs, sspecs = built
-        mesh = _mesh_for(dp, tp, devices)
-
-        def sh(spec):
-            return NamedSharding(mesh, spec)
-
-        from jax.sharding import PartitionSpec as P
-
-        in_sh = ({n: sh(fspecs[n]) for n in feed_specs},
-                 {n: sh(sspecs[n]) for n in state_mut},
-                 {n: sh(sspecs[n]) for n in state_ro},
-                 sh(P()))
         try:
-            compiled = jax.jit(fn, in_shardings=in_sh).lower(
+            mesh = _mesh_for(dp, tp, devices)
+
+            def sh(spec, _mesh=mesh):
+                return NamedSharding(_mesh, spec)
+
+            from jax.sharding import PartitionSpec as P
+
+            in_sh = ({n: sh(fspecs[n]) for n in feed_specs},
+                     {n: sh(sspecs[n]) for n in state_mut},
+                     {n: sh(sspecs[n]) for n in state_ro},
+                     sh(P()))
+            # identical out_shardings/donation to compile_with_plan:
+            # the winner's final jit compile becomes a cache hit when a
+            # jax compilation cache is enabled
+            out_sh = None
+            if state_out is not None:
+                out_sh = (sh(P()), {n: sh(sspecs.get(n, P()))
+                                    for n in state_out})
+            jit_kw = {"in_shardings": in_sh}
+            if out_sh is not None:
+                jit_kw["out_shardings"] = out_sh
+            if donate:
+                jit_kw["donate_argnums"] = (1,)
+            compiled = jax.jit(fn, **jit_kw).lower(
                 feed_avals, mut_avals, ro_avals, seed_aval).compile()
             t, peak = _score(compiled, mem_budget)
         except Exception as e:  # noqa: BLE001 - a candidate may not lower
             report.append({"dp": dp, "tp": tp,
                            "skip": "compile failed: %s" % str(e)[:120]})
             continue
-        report.append({"dp": dp, "tp": tp, "time_proxy": t,
-                       "peak_bytes_per_dev": int(peak)})
-        if best is None or t < best[0]:
+        entry = {"dp": dp, "tp": tp, "time_proxy": t,
+                 "peak_bytes_per_dev": int(peak)}
+        if t == float("inf"):
+            entry["skip"] = "exceeds mem_budget_mb"
+        report.append(entry)
+        if t < float("inf") and (best is None or t < best[0]):
             best = (t, dp, tp, fspecs, sspecs, mesh)
 
     if best is None:
+        # never fall back silently to an over-budget plan: the user set
+        # an explicit constraint, violating it would OOM at runtime with
+        # no hint the search dropped it
         raise RuntimeError(
-            "auto-parallel search found no feasible plan; candidates: %s"
-            % (report,))
+            "auto-parallel search found no feasible plan (all "
+            "candidates failed to compile or exceed mem_budget_mb); "
+            "raise the budget, lower min_shard_bytes, or add devices. "
+            "Candidates: %s" % (report,))
     _, dp, tp, fspecs, sspecs, mesh = best
     plan = AutoPlan(mesh, dp, tp, fspecs, sspecs, report)
     logger.info("auto-parallel: chose %s", plan.describe())

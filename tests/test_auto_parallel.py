@@ -121,3 +121,40 @@ def test_build_specs_rejects_indivisible_batch():
 def test_factorizations_order_prefers_dp():
     assert ap._factorizations(8)[0] == (8, 1)
     assert (1, 8) in ap._factorizations(8)
+
+
+def test_unsatisfiable_budget_raises_not_silently_overruns():
+    """When no candidate fits mem_budget_mb the search must fail loudly
+    — never hand back an over-budget plan that OOMs at runtime."""
+    st = fleet.DistributedStrategy()
+    st.auto = True
+    # 104 bytes/device: unsatisfiable even for this tiny model
+    st.auto_configs = {"mem_budget_mb": 0.0001}
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="x", shape=[-1, 32], dtype="float32")
+        y = fluid.data(name="y", shape=[-1, 1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1, act=None)
+        loss = fluid.layers.reduce_mean(fluid.layers.square(pred - y))
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        fleet.init()
+        opt = fleet.distributed_optimizer(opt, st)
+        opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xs = np.zeros((8, 32), np.float32)
+    ys = np.zeros((8, 1), np.float32)
+    with pytest.raises(RuntimeError, match="no feasible plan"):
+        exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss.name])
+
+
+def test_nranks_beyond_devices_is_clamped():
+    """auto_configs nranks larger than the host's device count must not
+    crash the search with a reshape error."""
+    st = fleet.DistributedStrategy()
+    st.auto = True
+    st.auto_configs = {"nranks": 64}
+    auto_losses, main = _train(st)
+    assert main._auto_plan.dp * main._auto_plan.tp <= 8
+    assert auto_losses[-1] < auto_losses[0]

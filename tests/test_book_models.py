@@ -10,6 +10,49 @@ import paddle_tpu.fluid as fluid
 from paddle_tpu.fluid import framework
 
 
+def test_fit_a_line_trains_and_infers(tmp_path):
+    """reference book/test_fit_a_line.py: linear regression over the
+    13-feature uci_housing rows, SGD + square_error_cost, then a
+    save/load_inference_model round trip on the trained predictor."""
+    rows = list(paddle.dataset.uci_housing.train()())[:128]
+    xs = np.asarray([r[0] for r in rows], "float32")
+    ys = np.asarray([r[1] for r in rows], "float32").reshape(-1, 1)
+
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data("x", shape=[13], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="float32")
+            pred = fluid.layers.fc(input=x, size=1, act=None)
+            loss = fluid.layers.mean(
+                fluid.layers.loss.square_error_cost(pred, y))
+            fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+            exe = fluid.Executor()
+            exe.run(startup)
+            losses = []
+            for _ in range(30):
+                out = exe.run(main, feed={"x": xs, "y": ys},
+                              fetch_list=[loss])
+                losses.append(float(np.asarray(out[0]).ravel()[0]))
+            assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+            d = str(tmp_path / "fit_a_line_model")
+            fluid.io.save_inference_model(d, ["x"], [pred], exe,
+                                          main_program=main)
+
+    # fresh executor + program: the exported predictor must stand alone
+    exe2 = fluid.Executor()
+    prog, feed_names, fetch_vars = fluid.io.load_inference_model(d, exe2)
+    assert feed_names == ["x"]
+    got = exe2.run(prog, feed={"x": xs[:8]}, fetch_list=fetch_vars)
+    pred_vals = np.asarray(got[0]).reshape(-1)
+    assert pred_vals.shape == (8,)
+    assert np.all(np.isfinite(pred_vals))
+    # the round-tripped model predicts in the ballpark of the targets
+    assert np.mean((pred_vals - ys[:8, 0]) ** 2) < losses[0], \
+        (pred_vals, ys[:8, 0])
+
+
 def test_word2vec_trains():
     """reference book/test_word2vec.py: n-gram embedding concat + fc."""
     n = 5

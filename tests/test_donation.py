@@ -1,0 +1,107 @@
+"""Buffer-donation audit (VERDICT r3 #2 follow-up: donation is the HBM
+lever that lets batch 512 fit).
+
+The executor's lowering donates the mutated-state argument
+(`lowering.py compile_block: donate_argnums=(1,)` behind
+FLAGS_tpu_donate_buffers), so XLA aliases every param/moment/BN-stat
+buffer and updates it in place. This pins the contract: the aliased
+byte count of a compiled train step equals the full mutated-state
+footprint — a regression here silently doubles HBM for weights+opt
+state."""
+import numpy as np
+
+import jax
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.core.scope import global_scope
+from paddle_tpu.fluid import framework, lowering
+
+
+def test_train_step_donates_all_mutated_state():
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data("x", shape=[16], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="float32")
+            h = fluid.layers.fc(x, 32, act="relu")
+            pred = fluid.layers.fc(h, 1)
+            loss = fluid.layers.mean(
+                fluid.layers.loss.square_error_cost(pred, y))
+            fluid.optimizer.AdamOptimizer(1e-3).minimize(loss)
+            exe = fluid.Executor()
+            exe.run(startup)
+
+            feed = {"x": np.zeros((4, 16), np.float32),
+                    "y": np.zeros((4, 1), np.float32)}
+            block = main.global_block()
+            state_in, _ = lowering.analyze_block(block, list(feed),
+                                                 [loss.name])
+            state_specs = {n: global_scope().find_var(n)
+                           for n in state_in}
+            entry = lowering.compile_block(main, block, feed,
+                                           [loss.name], state_specs)
+            smut = {n: global_scope().find_var(n)
+                    for n in entry.state_mut_names}
+            sro = {n: global_scope().find_var(n)
+                   for n in entry.state_ro_names}
+
+    def aval(v):
+        a = np.asarray(v)
+        return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+    comp = entry.jitted.lower(
+        {k: aval(v) for k, v in feed.items()},
+        {k: aval(v) for k, v in smut.items()},
+        {k: aval(v) for k, v in sro.items()},
+        jax.ShapeDtypeStruct((), np.uint32)).compile()
+    ma = comp.memory_analysis()
+    mut_bytes = sum(
+        int(np.prod(np.asarray(v).shape)) * np.asarray(v).dtype.itemsize
+        for v in smut.values())
+    assert mut_bytes > 0
+    # every mutated-state buffer must be aliased (donated): params,
+    # Adam moments, beta-power accumulators, learning rate
+    assert ma.alias_size_in_bytes >= mut_bytes, \
+        (ma.alias_size_in_bytes, mut_bytes)
+
+
+def test_donation_flag_disables_aliasing():
+    from paddle_tpu.utils.flags import get_flag, set_flags
+
+    old = get_flag("FLAGS_tpu_donate_buffers", True)
+    set_flags({"FLAGS_tpu_donate_buffers": False})
+    try:
+        main, startup = framework.Program(), framework.Program()
+        with framework.program_guard(main, startup):
+            with framework.unique_name_guard():
+                x = fluid.layers.data("x", shape=[8], dtype="float32")
+                pred = fluid.layers.fc(x, 1)
+                loss = fluid.layers.mean(pred)
+                fluid.optimizer.SGD(0.1).minimize(loss)
+                exe = fluid.Executor()
+                exe.run(startup)
+                feed = {"x": np.zeros((2, 8), np.float32)}
+                block = main.global_block()
+                state_in, _ = lowering.analyze_block(
+                    block, list(feed), [loss.name])
+                state_specs = {n: global_scope().find_var(n)
+                               for n in state_in}
+                entry = lowering.compile_block(main, block, feed,
+                                               [loss.name], state_specs)
+                smut = {n: global_scope().find_var(n)
+                        for n in entry.state_mut_names}
+                sro = {n: global_scope().find_var(n)
+                       for n in entry.state_ro_names}
+
+        def aval(v):
+            a = np.asarray(v)
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        comp = entry.jitted.lower(
+            {k: aval(v) for k, v in feed.items()},
+            {k: aval(v) for k, v in smut.items()},
+            {k: aval(v) for k, v in sro.items()},
+            jax.ShapeDtypeStruct((), np.uint32)).compile()
+        assert comp.memory_analysis().alias_size_in_bytes == 0
+    finally:
+        set_flags({"FLAGS_tpu_donate_buffers": old})

@@ -34,7 +34,12 @@ if _REPO not in sys.path:
 _LOG = os.path.join(_REPO, ".capture_log")
 _LAST_GOOD = os.path.join(_REPO, ".bench_last_good.json")
 
-PROBE_BUDGET = 75.0   # seconds for the tiny-matmul liveness child
+# probe source + budget live in bench.py (ONE definition — diverging
+# copies once let a slow-but-live window pass here and fail bench's
+# tighter gate)
+from bench import _PROBE_BUDGET as PROBE_BUDGET  # noqa: E402
+from bench import _PROBE_SRC  # noqa: E402
+
 BENCH_BUDGET = 2400.0  # hard cap on one full bench.py run
 # The 01:01Z window on 07-31 proved windows can be ~1 minute long: a
 # 25-min probe cycle would miss most of them. Probe cost is one python
@@ -42,18 +47,6 @@ BENCH_BUDGET = 2400.0  # hard cap on one full bench.py run
 CYCLE = 420.0          # seconds between probe attempts (~7 min)
 CYCLE_AFTER_FAIL = 60.0  # probe again fast when a window just flapped
 CYCLE_AFTER_SUCCESS = 3600.0  # relax after a fresh capture exists
-
-_PROBE_SRC = r"""
-import numpy as np, time, sys
-t0 = time.perf_counter()
-import jax, jax.numpy as jnp
-dev = jax.devices()[0]
-if dev.platform != "tpu":
-    print("PROBE_NOT_TPU", dev.platform); sys.exit(3)
-x = jnp.ones((512, 512), jnp.bfloat16)
-y = np.asarray(jax.jit(lambda a: a @ a)(x))
-print("PROBE_OK", round(time.perf_counter() - t0, 1), float(y[0, 0]))
-"""
 
 
 def _log(event: str, **kw) -> None:
@@ -93,9 +86,13 @@ def _probe() -> bool:
 def _bench() -> bool:
     t0 = time.perf_counter()
     try:
+        env = dict(os.environ)
+        # our probe JUST passed: vouch for liveness so bench goes
+        # straight into its first stage instead of re-probing
+        env["BENCH_ASSUME_LIVE"] = "1"
         proc = subprocess.run(
             [sys.executable, os.path.join(_REPO, "bench.py")],
-            cwd=_REPO, stdout=subprocess.PIPE,
+            cwd=_REPO, env=env, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True, timeout=BENCH_BUDGET)
         out = (proc.stdout or "").strip().splitlines()
         last = out[-1] if out else ""
