@@ -55,8 +55,8 @@ _COMPILE_CACHE = os.path.join(_REPO, ".jax_cache")
 #             in the persistent .jax_cache so a LATER short window can
 #             measure without paying XLA
 #   measure — full timed run; with a warm cache it fits a ~1-min window
-# Every stage is gated on a fresh ~45s liveness probe, so a dead tunnel
-# costs one probe, not the sum of all budgets. A failed warm skips its
+# Every stage is gated on a fresh liveness probe (_PROBE_BUDGET, 75s),
+# so a dead tunnel costs one probe, not the sum of all budgets. A failed warm skips its
 # batch's measure stage (it would recompile cold and cannot fit).
 # batch 256 first: the round-2 comparable (83.3k tok/s @ 34% MFU,
 # pre-fused-head); 512 (fused head + per-layer remat, the
@@ -154,26 +154,35 @@ def _unmark_warm(batch: int) -> None:
     _write_warm(_load_warm_batches() - {int(batch)})
 
 
-def _tunnel_alive(errors) -> bool:
-    """Tiny-matmul liveness probe in a child (the hang mode is an
-    in-process PJRT call that never returns — it cannot be timed out
-    from inside). Gates TPU stages."""
+def probe_tunnel():
+    """THE tiny-matmul liveness probe: one child-process runner (source,
+    env, budget) shared by bench's stage gate and tools/capture_loop.py
+    — runner divergence once let a window pass one gate and fail the
+    other. A child is required because the hang mode is an in-process
+    PJRT call that never returns and cannot be timed out from inside.
+    Returns (ok, tail)."""
     try:
         proc = subprocess.run(
             [sys.executable, "-c", _PROBE_SRC], env=_child_env("tpu"),
             cwd=_REPO, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True, timeout=_PROBE_BUDGET)
+        lines = (proc.stdout or "").strip().splitlines()
+        tail = lines[-1][:200] if lines else ""
         if proc.returncode == 0 and "PROBE_OK" in (proc.stdout or ""):
-            return True
-        errors.append("probe: rc=%d %s"
-                      % (proc.returncode,
-                         (proc.stdout or "").strip()[-120:]))
+            return True, tail
+        return False, "rc=%d %s" % (proc.returncode, tail)
     except subprocess.TimeoutExpired:
-        errors.append("probe: tunnel dead (timeout %.0fs)"
-                      % _PROBE_BUDGET)
+        return False, "timeout %.0fs" % _PROBE_BUDGET
     except Exception as e:  # noqa: BLE001
-        errors.append("probe: %r" % (e,))
-    return False
+        return False, repr(e)[:200]
+
+
+def _tunnel_alive(errors) -> bool:
+    """Probe gate for TPU stages."""
+    ok, tail = probe_tunnel()
+    if not ok:
+        errors.append("probe: tunnel dead (%s)" % tail)
+    return ok
 
 _RESULT_TAG = "BENCH_RESULT_JSON:"
 

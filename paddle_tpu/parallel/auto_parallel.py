@@ -115,8 +115,12 @@ def _mesh_for(dp, tp, devices, dp_axis="dp", tp_axis="mp"):
 
 def _score(compiled, mem_budget):
     ma = compiled.memory_analysis()
+    # donated (aliased) buffers appear in BOTH argument and output
+    # sizes but occupy one allocation — subtract the alias bytes or the
+    # whole mutated state (params + opt state) is double-counted
+    # against the budget
     peak = (ma.argument_size_in_bytes + ma.temp_size_in_bytes
-            + ma.output_size_in_bytes)
+            + ma.output_size_in_bytes - ma.alias_size_in_bytes)
     if mem_budget is not None and peak > mem_budget:
         return float("inf"), peak
     ca = compiled.cost_analysis() or {}

@@ -125,7 +125,7 @@ def test_dead_tunnel_skips_all_stages_and_emits_stale(lastgood,
            "platform": "cpu", "loss": 9.4, "steps_per_sec": 0.1}
 
     def dead(errors):
-        errors.append("probe: tunnel dead (timeout 45s)")
+        errors.append("probe: tunnel dead (timeout 75s)")
         return False
 
     monkeypatch.setattr(bench, "_tunnel_alive", dead)

@@ -34,11 +34,11 @@ if _REPO not in sys.path:
 _LOG = os.path.join(_REPO, ".capture_log")
 _LAST_GOOD = os.path.join(_REPO, ".bench_last_good.json")
 
-# probe source + budget live in bench.py (ONE definition — diverging
-# copies once let a slow-but-live window pass here and fail bench's
-# tighter gate)
+# the probe (source + env + budget + runner) lives in bench.py — ONE
+# definition; diverging copies once let a slow-but-live window pass
+# here and fail bench's tighter gate
 from bench import _PROBE_BUDGET as PROBE_BUDGET  # noqa: E402
-from bench import _PROBE_SRC  # noqa: E402
+from bench import probe_tunnel  # noqa: E402
 
 BENCH_BUDGET = 2400.0  # hard cap on one full bench.py run
 # The 01:01Z window on 07-31 proved windows can be ~1 minute long: a
@@ -63,24 +63,12 @@ def _log(event: str, **kw) -> None:
 
 
 def _probe() -> bool:
-    env = dict(os.environ)
-    # warm cache for the probe matmul too
-    env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(_REPO, ".jax_cache")
     try:
-        proc = subprocess.run(
-            [sys.executable, "-c", _PROBE_SRC], env=env, cwd=_REPO,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            timeout=PROBE_BUDGET)
-        ok = proc.returncode == 0 and "PROBE_OK" in (proc.stdout or "")
-        tail = (proc.stdout or "").strip().splitlines()
-        _log("probe", ok=ok, tail=tail[-1][:200] if tail else "")
-        return ok
-    except subprocess.TimeoutExpired:
-        _log("probe", ok=False, tail="timeout %.0fs" % PROBE_BUDGET)
-        return False
+        ok, tail = probe_tunnel()
     except Exception as e:  # noqa: BLE001 - loop must never die
-        _log("probe", ok=False, tail=repr(e)[:200])
-        return False
+        ok, tail = False, repr(e)[:200]
+    _log("probe", ok=ok, tail=tail)
+    return ok
 
 
 def _bench() -> bool:
