@@ -96,14 +96,22 @@ _WARM_MARKER = os.path.join(_REPO, ".bench_warm.json")
 
 
 def _bench_fingerprint() -> str:
-    """Hash over the sources that define the bench program: a changed
-    program invalidates warm markers (the cached executable no longer
-    matches what a measure child would trace)."""
+    """Hash over EVERY source the lowered bench program depends on —
+    the whole paddle_tpu package plus this file. The serialized export
+    bakes in the full StableHLO (lowering, optimizer, AMP semantics);
+    a narrower hash would let a measure child silently benchmark stale
+    code after an edit to e.g. fluid/optimizer.py."""
     import hashlib
 
     h = hashlib.sha256()
-    for p in (os.path.abspath(__file__),
-              os.path.join(_REPO, "paddle_tpu", "models", "bert.py")):
+    paths = [os.path.abspath(__file__)]
+    pkg = os.path.join(_REPO, "paddle_tpu")
+    for root, dirs, files in os.walk(pkg):
+        dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+        for fname in sorted(files):
+            if fname.endswith((".py", ".cc", ".h")):
+                paths.append(os.path.join(root, fname))
+    for p in paths:
         try:
             with open(p, "rb") as f:
                 h.update(f.read())
@@ -152,6 +160,144 @@ def _unmark_warm(batch: int) -> None:
     — drop it so the next window re-warms instead of repeating a doomed
     cold measure forever."""
     _write_warm(_load_warm_batches() - {int(batch)})
+
+
+def _export_path(platform: str, batch: int) -> str:
+    return os.path.join(_REPO, ".bench_export_%s_b%d.bin"
+                        % (platform, batch))
+
+
+def _save_export(entry, feed, platform: str, batch: int) -> None:
+    """Warm child: serialize the traced+lowered train step
+    (jax.export) so a later measure child can skip the ~60-90s fluid
+    retrace entirely — the persistent compile cache only skips XLA, not
+    tracing, and tracing alone can outlive a short tunnel window."""
+    import jax
+
+    from paddle_tpu.core.scope import global_scope
+    import numpy as np
+
+    def aval(v):
+        a = np.asarray(v)
+        return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+    favals = {k: aval(v) for k, v in feed.items()}
+    smut = {n: aval(global_scope().find_var(n))
+            for n in entry.state_mut_names}
+    sro = {n: aval(global_scope().find_var(n))
+           for n in entry.state_ro_names}
+    exp = jax.export.export(entry.jitted)(
+        favals, smut, sro, jax.ShapeDtypeStruct((), np.uint32))
+    path = _export_path(platform, batch)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(exp.serialize())
+    os.replace(tmp, path)
+    # the exact name partition of the exported callable: the measure
+    # child must NOT recompute it (any drift in the feed/state split
+    # makes the export invocation mismatch). Atomic like the .bin — a
+    # budget kill between the two writes must not leave a valid .bin
+    # beside a truncated .json.
+    meta_tmp = path + ".json.tmp"
+    with open(meta_tmp, "w") as f:
+        json.dump({"fingerprint": _bench_fingerprint(),
+                   "platform": platform, "batch": batch,
+                   "feed_names": list(entry.feed_names),
+                   "state_in": list(entry.state_in_names),
+                   "state_out": list(entry.state_out_names),
+                   "state_mut": list(entry.state_mut_names),
+                   "state_ro": list(entry.state_ro_names),
+                   "fetch_names": list(entry.fetch_names)}, f)
+    os.replace(meta_tmp, path + ".json")
+
+
+def _try_preload_export(exe, main_p, feed, fetch_names, platform: str,
+                        batch: int) -> bool:
+    """Measure child: if a fingerprint-matching export exists, seed the
+    executor's compile cache with a LoweredFunction wrapping the
+    deserialized module — exe.run then goes straight to execution (the
+    XLA compile of the deserialized module hits the persistent cache).
+    Returns True when preloaded."""
+    path = _export_path(platform, batch)
+    try:
+        with open(path + ".json") as f:
+            meta = json.load(f)
+        if meta.get("fingerprint") != _bench_fingerprint() \
+                or meta.get("batch") != batch:
+            return False
+        with open(path, "rb") as f:
+            blob = f.read()
+        import jax
+        import numpy as np
+
+        from paddle_tpu.core.scope import global_scope
+        from paddle_tpu.fluid import lowering
+
+        exp = jax.export.deserialize(bytearray(blob))
+        feed_arrays = {k: np.asarray(v) for k, v in feed.items()}
+        # use the saved partition verbatim — recomputing it here risks
+        # an invocation-structure mismatch with the exported callable
+        if sorted(meta["feed_names"]) != sorted(feed_arrays) or \
+                sorted(meta["fetch_names"]) != sorted(fetch_names):
+            return False
+        # donation is not carried by export: re-jit with the same
+        # donate_argnums so mutated state still aliases in place
+        jitted = jax.jit(exp.call, donate_argnums=(1,))
+        entry = lowering.LoweredFunction(
+            jitted, meta["feed_names"], meta["state_in"],
+            meta["state_out"], meta["state_mut"], meta["state_ro"],
+            meta["fetch_names"])
+        key = exe._cache_key(main_p, feed_arrays, list(fetch_names),
+                             global_scope())
+        exe._cache[key] = entry
+        return True
+    except Exception as e:  # noqa: BLE001 - fall back to a full trace
+        print("BENCH_EXPORT_PRELOAD_FAILED %r" % (e,), flush=True)
+        return False
+
+
+def _warm_compile(exe, main_p, feed, total, platform: str, batch: int,
+                  t_start: float) -> None:
+    """Warm stage body: lower the train step (no execution), export it,
+    then XLA-compile the DESERIALIZED module so the persistent cache
+    holds the exact key `_try_preload_export`'s jit produces in measure
+    children. One trace + one compile, same as the old warm path, but
+    the cache entry is the one that matters."""
+    import jax
+    import numpy as np
+
+    from paddle_tpu.core.scope import global_scope
+    from paddle_tpu.fluid import lowering
+
+    block = main_p.global_block()
+    feed_arrays = {k: np.asarray(v) for k, v in feed.items()}
+    state_in, _ = lowering.analyze_block(block, list(feed_arrays),
+                                         [total.name])
+    state_specs = {n: global_scope().find_var(n) for n in state_in}
+    entry = lowering.compile_block(main_p, block, feed_arrays,
+                                   [total.name], state_specs)
+    # the fluid trace + StableHLO lowering happen inside export
+    _save_export(entry, feed, platform, batch)
+    _hb("export_saved", t_start)
+
+    # compile through the IDENTICAL path a measure child takes (preload
+    # the export we just wrote, then one exe.run): compiling any other
+    # way (e.g. .lower(avals).compile()) lands a different cache key —
+    # aval-lowered vs called-with-arrays executables key differently —
+    # and the first measure would still cold-compile.
+    if not _try_preload_export(exe, main_p, feed, [total.name],
+                               platform, batch):
+        raise RuntimeError("warm: could not preload own export")
+    t0 = time.perf_counter()
+    out = exe.run(main_p, feed=feed, fetch_list=[total])
+    np.asarray(out[0])
+    compile_time = time.perf_counter() - t0
+    _hb("compile_done", t_start)
+    print(_RESULT_TAG + json.dumps({
+        "warm": True, "platform": platform, "batch": batch,
+        "compile_time_s": round(compile_time, 1),
+        "loss": round(float(np.asarray(out[0]).reshape(-1)[0]), 4),
+    }), flush=True)
 
 
 def probe_tunnel():
@@ -449,23 +595,26 @@ def _bench_child(platform: str, batch: int, steps: int, warmup: int,
 
             feed = _bert_feed(cfg, batch, SEQ_LEN)
 
+            if steps == 0:
+                # warm stage: trace + export the train step, then
+                # XLA-compile the DESERIALIZED form — the exact compile
+                # key every measure child's preloaded entry will hit.
+                # (Compiling via exe.run instead would land a different
+                # key, and the first measure would still cold-compile.)
+                _warm_compile(exe, main_p, feed, total, platform, batch,
+                              t_start)
+                return
+
+            preloaded = _try_preload_export(
+                exe, main_p, feed, [total.name], platform, batch)
+            if preloaded:
+                _hb("export_preloaded", t_start)
+
             t_compile0 = time.perf_counter()
             out = exe.run(main_p, feed=feed, fetch_list=[total])
             np.asarray(out[0])
             compile_time = time.perf_counter() - t_compile0
             _hb("compile_done", t_start)
-
-            if steps == 0:
-                # warm stage: the executable is now in the persistent
-                # compile cache — that IS the result. A later ~1-min
-                # tunnel window can measure without paying XLA.
-                print(_RESULT_TAG + json.dumps({
-                    "warm": True, "platform": platform, "batch": batch,
-                    "compile_time_s": round(compile_time, 1),
-                    "loss": round(float(
-                        np.asarray(out[0]).reshape(-1)[0]), 4),
-                }), flush=True)
-                return
 
             for _ in range(max(warmup - 1, 0)):
                 out = exe.run(main_p, feed=feed, fetch_list=[total])

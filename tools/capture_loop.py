@@ -13,7 +13,8 @@ Probe design: the liveness child is a separate interpreter (the tunnel
 hang mode is an in-process PJRT call that never returns — it cannot be
 timed out from inside), runs a 512x512 matmul and forces the result to
 numpy (``block_until_ready`` does not reliably block through the
-tunnel), and must finish inside PROBE_BUDGET seconds.
+tunnel), and must finish inside bench._PROBE_BUDGET seconds (the probe
+source, env, budget and runner all live in bench.probe_tunnel).
 
 State is appended to ``.capture_log`` (one JSON line per event) so the
 builder can check progress without attaching to the process.
@@ -37,7 +38,6 @@ _LAST_GOOD = os.path.join(_REPO, ".bench_last_good.json")
 # the probe (source + env + budget + runner) lives in bench.py — ONE
 # definition; diverging copies once let a slow-but-live window pass
 # here and fail bench's tighter gate
-from bench import _PROBE_BUDGET as PROBE_BUDGET  # noqa: E402
 from bench import probe_tunnel  # noqa: E402
 
 BENCH_BUDGET = 2400.0  # hard cap on one full bench.py run
