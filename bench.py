@@ -61,19 +61,36 @@ _COMPILE_CACHE = os.path.join(_REPO, ".jax_cache")
 # batch 256 first: the round-2 comparable (83.3k tok/s @ 34% MFU,
 # pre-fused-head); 512 (fused head + per-layer remat, the
 # PERF_ANALYSIS_r4 fit) follows, then a cold small-batch salvage.
+# ResNet50 (BASELINE config 2) has NEVER been measured on chip in any
+# round — it gets its own warm/measure pair right after the primary
+# BERT measurement rather than riding as an optional tail pass.
 _STAGES = [
-    {"kind": "warm", "batch": BATCH, "budget": 480, "steps": 0,
-     "warmup": 0},
-    {"kind": "measure", "batch": BATCH, "budget": 180, "steps": STEPS,
-     "warmup": WARMUP},
-    {"kind": "warm", "batch": 2 * BATCH, "budget": 420, "steps": 0,
-     "warmup": 0},
-    {"kind": "measure", "batch": 2 * BATCH, "budget": 180,
+    {"model": "bert", "kind": "warm", "batch": BATCH, "budget": 480,
+     "steps": 0, "warmup": 0},
+    {"model": "bert", "kind": "measure", "batch": BATCH, "budget": 180,
      "steps": STEPS, "warmup": WARMUP},
-    {"kind": "measure", "batch": 128, "budget": 300, "steps": STEPS,
-     "warmup": WARMUP},
+    {"model": "resnet", "kind": "warm", "batch": 128, "budget": 420,
+     "steps": 0, "warmup": 0},
+    {"model": "resnet", "kind": "measure", "batch": 128, "budget": 180,
+     "steps": 8, "warmup": 2},
+    {"model": "bert", "kind": "warm", "batch": 2 * BATCH, "budget": 420,
+     "steps": 0, "warmup": 0},
+    {"model": "bert", "kind": "measure", "batch": 2 * BATCH,
+     "budget": 180, "steps": STEPS, "warmup": WARMUP},
+    {"model": "bert", "kind": "measure", "batch": 128, "budget": 300,
+     "steps": STEPS, "warmup": WARMUP},
 ]
 _CPU_ATTEMPT = ("cpu", 420, 8, 2, 1)
+# cumulative cap on TPU stage budgets per invocation: whatever happens,
+# the CPU fallback (420s) + probes + emission must still fit inside
+# tools/capture_loop.py's BENCH_BUDGET kill timer
+_TPU_DEADLINE = 1800.0
+
+
+def _stage_key(st_or_model, batch=None) -> str:
+    if batch is None:
+        return "%s:%d" % (st_or_model["model"], st_or_model["batch"])
+    return "%s:%d" % (st_or_model, batch)
 
 # ONE probe definition (source + budget + runner) shared with
 # tools/capture_loop.py — two diverging copies previously meant a
@@ -121,9 +138,10 @@ def _bench_fingerprint() -> str:
 
 
 def _load_warm_batches() -> set:
-    """Batches whose executable a previous invocation already landed in
-    the persistent compile cache — their warm stages are skippable, so
-    a later short window goes straight to measuring."""
+    """'model:batch' keys whose executable a previous invocation
+    already landed in the persistent compile cache — their warm stages
+    are skippable, so a later short window goes straight to
+    measuring."""
     try:
         with open(_WARM_MARKER) as f:
             d = json.load(f)
@@ -132,7 +150,7 @@ def _load_warm_batches() -> set:
         if not os.path.isdir(_COMPILE_CACHE) or \
                 not os.listdir(_COMPILE_CACHE):
             return set()  # cache wiped: markers lie
-        return {int(b) for b in d.get("batches", [])}
+        return {str(b) for b in d.get("batches", [])}
     except (OSError, ValueError):
         return set()
 
@@ -150,24 +168,25 @@ def _write_warm(batches: set) -> None:
         pass
 
 
-def _mark_warm(batch: int) -> None:
-    _write_warm(_load_warm_batches() | {int(batch)})
+def _mark_warm(model: str, batch: int) -> None:
+    _write_warm(_load_warm_batches() | {_stage_key(model, batch)})
 
 
-def _unmark_warm(batch: int) -> None:
+def _unmark_warm(model: str, batch: int) -> None:
     """A measure on a supposedly-warm batch failed: the marker lied
     (cache evicted, or a lowering change the fingerprint doesn't cover)
     — drop it so the next window re-warms instead of repeating a doomed
     cold measure forever."""
-    _write_warm(_load_warm_batches() - {int(batch)})
+    _write_warm(_load_warm_batches() - {_stage_key(model, batch)})
 
 
-def _export_path(platform: str, batch: int) -> str:
-    return os.path.join(_REPO, ".bench_export_%s_b%d.bin"
-                        % (platform, batch))
+def _export_path(model: str, platform: str, batch: int) -> str:
+    return os.path.join(_REPO, ".bench_export_%s_%s_b%d.bin"
+                        % (model, platform, batch))
 
 
-def _save_export(entry, feed, platform: str, batch: int) -> None:
+def _save_export(entry, feed, model: str, platform: str,
+                 batch: int) -> None:
     """Warm child: serialize the traced+lowered train step
     (jax.export) so a later measure child can skip the ~60-90s fluid
     retrace entirely — the persistent compile cache only skips XLA, not
@@ -188,7 +207,7 @@ def _save_export(entry, feed, platform: str, batch: int) -> None:
            for n in entry.state_ro_names}
     exp = jax.export.export(entry.jitted)(
         favals, smut, sro, jax.ShapeDtypeStruct((), np.uint32))
-    path = _export_path(platform, batch)
+    path = _export_path(model, platform, batch)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         f.write(exp.serialize())
@@ -201,7 +220,7 @@ def _save_export(entry, feed, platform: str, batch: int) -> None:
     meta_tmp = path + ".json.tmp"
     with open(meta_tmp, "w") as f:
         json.dump({"fingerprint": _bench_fingerprint(),
-                   "platform": platform, "batch": batch,
+                   "model": model, "platform": platform, "batch": batch,
                    "feed_names": list(entry.feed_names),
                    "state_in": list(entry.state_in_names),
                    "state_out": list(entry.state_out_names),
@@ -211,19 +230,20 @@ def _save_export(entry, feed, platform: str, batch: int) -> None:
     os.replace(meta_tmp, path + ".json")
 
 
-def _try_preload_export(exe, main_p, feed, fetch_names, platform: str,
-                        batch: int) -> bool:
+def _try_preload_export(exe, main_p, feed, fetch_names, model: str,
+                        platform: str, batch: int) -> bool:
     """Measure child: if a fingerprint-matching export exists, seed the
     executor's compile cache with a LoweredFunction wrapping the
     deserialized module — exe.run then goes straight to execution (the
     XLA compile of the deserialized module hits the persistent cache).
     Returns True when preloaded."""
-    path = _export_path(platform, batch)
+    path = _export_path(model, platform, batch)
     try:
         with open(path + ".json") as f:
             meta = json.load(f)
         if meta.get("fingerprint") != _bench_fingerprint() \
-                or meta.get("batch") != batch:
+                or meta.get("batch") != batch \
+                or meta.get("model") != model:
             return False
         with open(path, "rb") as f:
             blob = f.read()
@@ -256,8 +276,8 @@ def _try_preload_export(exe, main_p, feed, fetch_names, platform: str,
         return False
 
 
-def _warm_compile(exe, main_p, feed, total, platform: str, batch: int,
-                  t_start: float) -> None:
+def _warm_compile(exe, main_p, feed, total, model: str, platform: str,
+                  batch: int, t_start: float) -> None:
     """Warm stage body: lower the train step (no execution), export it,
     then XLA-compile the DESERIALIZED module so the persistent cache
     holds the exact key `_try_preload_export`'s jit produces in measure
@@ -277,7 +297,7 @@ def _warm_compile(exe, main_p, feed, total, platform: str, batch: int,
     entry = lowering.compile_block(main_p, block, feed_arrays,
                                    [total.name], state_specs)
     # the fluid trace + StableHLO lowering happen inside export
-    _save_export(entry, feed, platform, batch)
+    _save_export(entry, feed, model, platform, batch)
     _hb("export_saved", t_start)
 
     # compile through the IDENTICAL path a measure child takes (preload
@@ -285,7 +305,7 @@ def _warm_compile(exe, main_p, feed, total, platform: str, batch: int,
     # way (e.g. .lower(avals).compile()) lands a different cache key —
     # aval-lowered vs called-with-arrays executables key differently —
     # and the first measure would still cold-compile.
-    if not _try_preload_export(exe, main_p, feed, [total.name],
+    if not _try_preload_export(exe, main_p, feed, [total.name], model,
                                platform, batch):
         raise RuntimeError("warm: could not preload own export")
     t0 = time.perf_counter()
@@ -387,12 +407,14 @@ def _hb(phase: str, t_start: float) -> None:
           flush=True)
 
 
-def _run_attempt(platform, budget, batch, steps, warmup, idx, errors):
+def _run_attempt(platform, budget, batch, steps, warmup, idx, errors,
+                 model="bert"):
     """Run one bench child; return its parsed result dict or None."""
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--child",
-             platform, str(batch), str(steps), str(warmup), str(budget)],
+             platform, str(batch), str(steps), str(warmup), str(budget),
+             model],
             env=_child_env(platform), cwd=_REPO,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, timeout=budget)
@@ -406,9 +428,9 @@ def _run_attempt(platform, budget, batch, steps, warmup, idx, errors):
                          out.strip().splitlines()[-1][-200:]
                          if out.strip() else "no output"))
     except subprocess.TimeoutExpired as e:
-        # the child emits the BERT result line BEFORE the optional
-        # ResNet pass; if the parent kill lands during ResNet, the
-        # partial stdout still carries a complete tagged result
+        # a child emits its tagged result line as soon as the timed
+        # steps finish; if the kill lands after that (device teardown,
+        # trailing IO), the partial stdout still carries it
         errors.append("%s attempt %d: timeout after %ds"
                       % (platform, idx, budget))
         result = _parse_tagged(e.output)
@@ -424,8 +446,9 @@ def _run_attempt(platform, budget, batch, steps, warmup, idx, errors):
 
 def main() -> int:
     errors = []
-    result = None
-    skip_batches = set()
+    result = None          # headline: the first successful BERT measure
+    resnet_result = None   # BASELINE config 2, rides as a sub-object
+    skip_keys = set()
     # warm markers persist across invocations: once an executable is in
     # the compile cache, every later (short) window measures directly
     already_warm = _load_warm_batches()
@@ -433,39 +456,78 @@ def main() -> int:
     # window time re-probing after it. The caller may vouch for the
     # first stage too (capture_loop probes right before invoking us).
     live = os.environ.get("BENCH_ASSUME_LIVE") == "1"
+    t_main0 = time.perf_counter()
     for i, st in enumerate(_STAGES):
-        if st["batch"] in skip_batches:
+        key = _stage_key(st)
+        if key in skip_keys:
             continue
-        if st["kind"] == "warm" and st["batch"] in already_warm:
+        if time.perf_counter() - t_main0 + st["budget"] > _TPU_DEADLINE:
+            # leave room for the CPU fallback + emission inside the
+            # caller's overall budget (capture_loop BENCH_BUDGET): a
+            # kill mid-fallback would lose this run's results entirely
+            errors.append("deadline: skipping %s stage %s" %
+                          (st["kind"], key))
             continue
+        if st["kind"] == "warm" and key in already_warm:
+            continue
+        if st["kind"] == "measure" and (
+                (st["model"] == "bert" and result is not None)
+                or (st["model"] == "resnet"
+                    and resnet_result is not None)):
+            continue
+        if result is not None and resnet_result is not None:
+            break
         if not live and not _tunnel_alive(errors):
             # dead tunnel: stop burning stage budgets; the capture loop
             # (tools/capture_loop.py) retries on its own cycle
             break
         r = _run_attempt("tpu", st["budget"], st["batch"], st["steps"],
-                         st["warmup"], i, errors)
+                         st["warmup"], i, errors, model=st["model"])
         live = r is not None
         if st["kind"] == "warm":
             if r is None:
                 # compile didn't land in the cache: its measure stage
                 # would recompile cold and cannot fit a short window
-                skip_batches.add(st["batch"])
+                skip_keys.add(key)
             else:
-                _mark_warm(st["batch"])
+                _mark_warm(st["model"], st["batch"])
             continue
-        if r is None and st["batch"] in already_warm:
+        if r is None and key in already_warm:
             # the marker promised a cached executable but the measure
             # still failed: stop trusting it for this batch
-            _unmark_warm(st["batch"])
+            _unmark_warm(st["model"], st["batch"])
         if r is not None and not r.get("warm"):
-            result = r
-            # a full measure also proves this batch's executable is
+            # a full measure also proves this key's executable is
             # cached for future invocations
-            _mark_warm(st["batch"])
-            break
+            _mark_warm(st["model"], st["batch"])
+            if st["model"] == "resnet":
+                resnet_result = r
+            else:
+                result = r
+            live = True
+            continue
         if i + 1 < len(_STAGES):
             live = False
             time.sleep(10.0)  # brief backoff before the next stage
+
+    if result is not None and resnet_result is not None:
+        result["resnet50"] = resnet_result
+
+    if result is None and resnet_result is not None:
+        # fresh ResNet number but no fresh BERT: attach it to the
+        # stale-BERT emission below AND persist it into last-good so
+        # the round artifact carries the first-ever on-chip ResNet
+        # measurement either way
+        try:
+            with open(_LAST_GOOD) as f:
+                lg = json.load(f)
+            lg["result"]["resnet50"] = resnet_result
+            tmp = _LAST_GOOD + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(lg, f, indent=1)
+            os.replace(tmp, _LAST_GOOD)
+        except (OSError, ValueError):
+            pass
 
     if result is not None:
         # a success supersedes any earlier attempts' failure dumps:
@@ -480,6 +542,18 @@ def main() -> int:
                 pass
         if errors:
             result["error"] = "; ".join(errors)[:500]
+        if "resnet50" not in result:
+            # carry forward a previously persisted on-chip ResNet
+            # number: overwriting last-good wholesale would erase the
+            # only config-2 evidence if this window's ResNet stage
+            # didn't land
+            try:
+                with open(_LAST_GOOD) as f:
+                    prev = json.load(f)["result"].get("resnet50")
+                if isinstance(prev, dict) and "value" in prev:
+                    result["resnet50"] = prev
+            except (OSError, ValueError, KeyError):
+                pass
         try:
             with open(_LAST_GOOD, "w") as f:
                 json.dump({"ts": time.time(),
@@ -512,6 +586,10 @@ def main() -> int:
         result["stale_age_h"] = round(
             (time.time() - float(last_good.get("ts", time.time())))
             / 3600.0, 2)
+        if resnet_result is not None:
+            # the BERT headline is stale but this round's window DID
+            # land a fresh on-chip ResNet number — carry it
+            result["resnet50"] = resnet_result
         if cpu_result is not None:
             result["cpu_fallback"] = {
                 k: cpu_result[k] for k in
@@ -523,16 +601,21 @@ def main() -> int:
 
     if cpu_result is not None:
         cpu_result["error"] = "; ".join(errors)[:1000]
+        if resnet_result is not None:
+            cpu_result["resnet50"] = resnet_result
         print(json.dumps(cpu_result))
         return 0
 
-    print(json.dumps({
+    final = {
         "metric": "bert_base_pretrain_throughput",
         "value": 0.0,
         "unit": "tokens/sec/chip",
         "vs_baseline": 0.0,
         "error": "; ".join(errors)[:1500],
-    }))
+    }
+    if resnet_result is not None:
+        final["resnet50"] = resnet_result
+    print(json.dumps(final))
     return 0
 
 
@@ -556,7 +639,7 @@ def _bert_flops_per_token(cfg, n_params, seq_len):
 
 
 def _bench_child(platform: str, batch: int, steps: int, warmup: int,
-                 budget: float) -> None:
+                 model: str = "bert") -> None:
     t_start = time.perf_counter()
     import numpy as np
 
@@ -567,6 +650,9 @@ def _bench_child(platform: str, batch: int, steps: int, warmup: int,
     from paddle_tpu.models import bert
 
     _hb("imports_done", t_start)
+    if model == "resnet":
+        _bench_child_resnet(platform, batch, steps, warmup, t_start)
+        return
     cfg = bert.BertConfig.base()
     main_p, startup_p = framework.Program(), framework.Program()
     with framework.program_guard(main_p, startup_p):
@@ -601,12 +687,13 @@ def _bench_child(platform: str, batch: int, steps: int, warmup: int,
                 # key every measure child's preloaded entry will hit.
                 # (Compiling via exe.run instead would land a different
                 # key, and the first measure would still cold-compile.)
-                _warm_compile(exe, main_p, feed, total, platform, batch,
-                              t_start)
+                _warm_compile(exe, main_p, feed, total, "bert",
+                              platform, batch, t_start)
                 return
 
             preloaded = _try_preload_export(
-                exe, main_p, feed, [total.name], platform, batch)
+                exe, main_p, feed, [total.name], "bert", platform,
+                batch)
             if preloaded:
                 _hb("export_preloaded", t_start)
 
@@ -646,22 +733,40 @@ def _bench_child(platform: str, batch: int, steps: int, warmup: int,
         result["mfu_pct"] = round(
             100.0 * flops_per_sec / TPU_PEAK_BF16_FLOPS, 2)
 
-    # Emit the BERT result NOW: if the optional ResNet pass below
-    # overruns the parent's wall budget and the child is killed, the
-    # parent's parser takes the last tagged line it saw, so the BERT
-    # number survives.
+    # ResNet now has its own warm/measure stages in _STAGES — the BERT
+    # measure child stays lean so it fits a short window.
     print(_RESULT_TAG + json.dumps(result), flush=True)
 
-    # ResNet50 (BASELINE.md config 2) if enough budget remains; TPU only
-    # (CPU conv at ImageNet shapes would blow the fallback budget).
-    remaining = budget - (time.perf_counter() - t_start)
-    if platform == "tpu" and remaining > 150.0:
-        try:
-            result["resnet50"] = _bench_resnet(
-                batch=128, steps=8, warmup=2, platform=platform)
-        except Exception as e:  # noqa: BLE001 - keep the BERT result
-            result["resnet50"] = {"error": repr(e)[:300]}
-        print(_RESULT_TAG + json.dumps(result), flush=True)
+
+def _bench_child_resnet(platform: str, batch: int, steps: int,
+                        warmup: int, t_start: float) -> None:
+    """ResNet50 stage child (BASELINE config 2 — never measured on chip
+    before round 4): same warm/export/preload protocol as BERT."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+
+    if steps == 0:
+        main_p, startup_p, loss = build_resnet_train_program()
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup_p)
+        _hb("startup_done", t_start)
+        r = np.random.RandomState(0)
+        feed = {
+            "image": r.randn(batch, 3, 224, 224).astype("float32"),
+            "label": r.randint(0, 1000, (batch, 1)).astype("int64"),
+        }
+        _warm_compile(exe, main_p, feed, loss, "resnet", platform,
+                      batch, t_start)
+        return
+
+    # ONE measurement protocol (_bench_resnet) for stage children, the
+    # --resnet CLI and capture_loop's fill pass — only the export
+    # preload differs
+    result = _bench_resnet(batch=batch, steps=steps, warmup=warmup,
+                           platform=platform, preload_export=True,
+                           t_start=t_start)
+    print(_RESULT_TAG + json.dumps(result), flush=True)
 
 
 def _bert_feed(cfg, batch, seq_len):
@@ -708,10 +813,13 @@ def build_resnet_train_program(depth: int = 50, img_size: int = 224,
 
 def _bench_resnet(batch: int, steps: int, warmup: int,
                   platform: str, depth: int = 50, img: int = 224,
-                  class_dim: int = 1000) -> dict:
+                  class_dim: int = 1000, preload_export: bool = False,
+                  t_start: float = None) -> dict:
     """ResNet50 ImageNet training throughput (BASELINE.json config 2).
     depth/img/class_dim shrink only for the CPU smoke test — the bench
-    always runs the 50/224/1000 config."""
+    always runs the 50/224/1000 config. preload_export: seed the
+    executor with the warm stage's serialized export (stage children),
+    skipping the fluid retrace."""
     import numpy as np
 
     import paddle_tpu.fluid as fluid
@@ -721,6 +829,8 @@ def _bench_resnet(batch: int, steps: int, warmup: int,
         depth=depth, img_size=img_size, class_dim=class_dim)
     exe = fluid.Executor(fluid.TPUPlace())
     exe.run(startup_p)
+    if t_start is not None:
+        _hb("startup_done", t_start)
     r = np.random.RandomState(0)
     feed = {
         "image": r.randn(batch, 3, img_size,
@@ -728,6 +838,10 @@ def _bench_resnet(batch: int, steps: int, warmup: int,
         "label": r.randint(0, class_dim,
                            (batch, 1)).astype("int64"),
     }
+    if preload_export and _try_preload_export(
+            exe, main_p, feed, [loss.name], "resnet", platform, batch):
+        if t_start is not None:
+            _hb("export_preloaded", t_start)
     t0 = time.perf_counter()
     out = exe.run(main_p, feed=feed, fetch_list=[loss])
     np.asarray(out[0])
@@ -760,9 +874,11 @@ def _bench_resnet(batch: int, steps: int, warmup: int,
 
 if __name__ == "__main__":
     if len(sys.argv) >= 6 and sys.argv[1] == "--child":
-        budget = float(sys.argv[6]) if len(sys.argv) > 6 else 1e9
+        # argv[6] (the stage budget) is enforced by the parent's
+        # subprocess timeout, not read here
+        model = sys.argv[7] if len(sys.argv) > 7 else "bert"
         _bench_child(sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
-                     int(sys.argv[5]), budget)
+                     int(sys.argv[5]), model)
         sys.exit(0)
     if len(sys.argv) >= 2 and sys.argv[1] == "--resnet":
         batch = int(sys.argv[2]) if len(sys.argv) > 2 else 128
