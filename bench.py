@@ -468,7 +468,14 @@ def main() -> int:
             errors.append("deadline: skipping %s stage %s" %
                           (st["kind"], key))
             continue
-        if st["kind"] == "warm" and key in already_warm:
+        if st["kind"] == "warm" and (
+                key in already_warm
+                or (st["model"] == "bert" and result is not None)
+                or (st["model"] == "resnet"
+                    and resnet_result is not None)):
+            # warm a batch only while its model still needs a measure:
+            # a 420s warm for a model this invocation already measured
+            # wastes scarce window time
             continue
         if st["kind"] == "measure" and (
                 (st["model"] == "bert" and result is not None)
@@ -526,7 +533,7 @@ def main() -> int:
             with open(tmp, "w") as f:
                 json.dump(lg, f, indent=1)
             os.replace(tmp, _LAST_GOOD)
-        except (OSError, ValueError):
+        except (OSError, ValueError, KeyError, TypeError):
             pass
 
     if result is not None:
@@ -555,11 +562,15 @@ def main() -> int:
             except (OSError, ValueError, KeyError):
                 pass
         try:
-            with open(_LAST_GOOD, "w") as f:
+            # atomic like every other marker: a kill mid-dump must not
+            # leave truncated JSON where the stale fallback looks
+            tmp = _LAST_GOOD + ".tmp"
+            with open(tmp, "w") as f:
                 json.dump({"ts": time.time(),
                            "iso": time.strftime(
                                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
                            "result": result}, f, indent=1)
+            os.replace(tmp, _LAST_GOOD)
         except OSError:
             pass
         print(json.dumps(result))
@@ -751,11 +762,7 @@ def _bench_child_resnet(platform: str, batch: int, steps: int,
         exe = fluid.Executor(fluid.TPUPlace())
         exe.run(startup_p)
         _hb("startup_done", t_start)
-        r = np.random.RandomState(0)
-        feed = {
-            "image": r.randn(batch, 3, 224, 224).astype("float32"),
-            "label": r.randint(0, 1000, (batch, 1)).astype("int64"),
-        }
+        feed = _resnet_feed(batch)
         _warm_compile(exe, main_p, feed, loss, "resnet", platform,
                       batch, t_start)
         return
@@ -776,6 +783,22 @@ def _bert_feed(cfg, batch, seq_len):
     from __graft_entry__ import _bert_feed as feed
 
     return feed(cfg, batch, seq_len, max_pred=int(seq_len * 0.15))
+
+
+def _resnet_feed(batch: int, img_size: int = 224,
+                 class_dim: int = 1000) -> dict:
+    """ONE seeded feed builder for warm and measure children: their
+    traced shapes/dtypes must agree or the export preload silently
+    misses."""
+    import numpy as np
+
+    r = np.random.RandomState(0)
+    return {
+        "image": r.randn(batch, 3, img_size,
+                         img_size).astype("float32"),
+        "label": r.randint(0, class_dim,
+                           (batch, 1)).astype("int64"),
+    }
 
 
 def build_resnet_train_program(depth: int = 50, img_size: int = 224,
@@ -831,13 +854,7 @@ def _bench_resnet(batch: int, steps: int, warmup: int,
     exe.run(startup_p)
     if t_start is not None:
         _hb("startup_done", t_start)
-    r = np.random.RandomState(0)
-    feed = {
-        "image": r.randn(batch, 3, img_size,
-                         img_size).astype("float32"),
-        "label": r.randint(0, class_dim,
-                           (batch, 1)).astype("int64"),
-    }
+    feed = _resnet_feed(batch, img_size, class_dim)
     if preload_export and _try_preload_export(
             exe, main_p, feed, [loss.name], "resnet", platform, batch):
         if t_start is not None:

@@ -372,7 +372,8 @@ class CollectiveOptimizer:
                 loss.block.program,
                 k_steps_localsgd=(st.localsgd_configs["k_steps"]
                                   if st.localsgd else 0),
-                dgc_cfg=dgc_cfg)
+                dgc_cfg=dgc_cfg,
+                sync_batch_norm=getattr(st, "sync_batch_norm", False))
         if getattr(st, "elastic", False):
             # preemption checkpoint/auto-resume every save_steps
             # (reference: elastic reserved at
@@ -384,10 +385,14 @@ class CollectiveOptimizer:
 
 
 def transpile_collective(program, nranks=None, k_steps_localsgd=0,
-                         dgc_cfg=None):
+                         dgc_cfg=None, sync_batch_norm=False):
     """GradAllReduce program rewrite (reference: transpiler/collective.py:
     178-268). Marks the program DP over the local mesh, scales the loss
-    cotangent 1/nranks, inserts c_allreduce_sum per gradient."""
+    cotangent 1/nranks, inserts c_allreduce_sum per gradient.
+    sync_batch_norm: rewrite batch_norm ops to the sync variant whose
+    moments pmean over the dp axis (reference sync_batch_norm_op.cu via
+    ncclAllReduce; here jax.vjp through lax.pmean gives the matching
+    synchronized backward for free)."""
     import jax
 
     if nranks is None:
@@ -402,6 +407,17 @@ def transpile_collective(program, nranks=None, k_steps_localsgd=0,
     program._mesh = mesh
     penv.set_global_mesh(mesh)
     penv.register_ring(0, "dp", nranks)
+
+    if sync_batch_norm:
+        n_swapped = 0
+        for bi in range(program.num_blocks):
+            for op in program.block(bi).ops:
+                if op.type == "batch_norm":
+                    op.type = "sync_batch_norm"
+                    op.attrs["axis_name"] = "dp"
+                    n_swapped += 1
+        if n_swapped:
+            program._version += 1
 
     block = program.global_block()
     bwd_idx = None

@@ -149,9 +149,6 @@ _WARN_ONLY = [
                   "mode is not wired through fleet yet; use "
                   "fluid.transpiler.DistributeTranspiler for PS "
                   "training. Running collective (sync) instead."),
-    _WarnOnlyMeta("sync_batch_norm",
-                  "DistributedStrategy.sync_batch_norm is not "
-                  "implemented; BN stats stay per-replica."),
 ]
 
 # application order matters: optimizer swaps first, then recompute /

@@ -151,7 +151,8 @@ def test_fleet_unimplemented_knobs_warn():
     strategy = fleet_mod.DistributedStrategy()
     strategy.dgc = True     # implemented: plants dgc ops, no warning
     strategy.elastic = True  # implemented since r4: marks the program
-    strategy.sync_batch_norm = True  # still warn-only
+    strategy.a_sync = True   # the one still-warn-only knob (PS mode
+    #                          lives behind the DistributeTranspiler)
     opt = fleet_mod.CollectiveOptimizer(
         fluid.optimizer.SGDOptimizer(0.1), strategy)
     main, startup = framework.Program(), framework.Program()
@@ -160,7 +161,7 @@ def test_fleet_unimplemented_knobs_warn():
             x = fluid.layers.data(name="x", shape=[4], dtype="float32")
             y = fluid.layers.fc(input=x, size=2)
             loss = fluid.layers.mean(y)
-            with pytest.warns(UserWarning, match="sync_batch_norm"):
+            with pytest.warns(UserWarning, match="a_sync"):
                 opt.minimize(loss)
     assert any(op.type == "dgc" for op in main.global_block().ops)
     # elastic no longer warns: it wires checkpoint/auto-resume instead

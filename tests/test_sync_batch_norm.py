@@ -1,0 +1,90 @@
+"""DistributedStrategy.sync_batch_norm — BN moments pmean'd over the
+dp axis (reference: sync_batch_norm_op.cu via ncclAllReduce; TPU-native:
+the sync_batch_norm op's lax.pmean inside the DP shard_map, with the
+synchronized backward falling out of jax.vjp through pmean)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import fleet
+from paddle_tpu.core.scope import Scope
+from paddle_tpu.fluid import framework
+
+
+def _train(sync_bn, dp, steps=4, batch=16, seed=3):
+    """Conv+BN classifier under fleet DP (or single-device when
+    dp=False); returns the per-step losses."""
+    rng = np.random.RandomState(0)
+    xs = rng.randn(batch, 4, 6, 6).astype(np.float32)
+    ys = rng.randint(0, 3, (batch, 1)).astype(np.int64)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with framework.unique_name_guard(), \
+            fluid.program_guard(main, startup):
+        main.random_seed = startup.random_seed = seed
+        x = fluid.layers.data(name="x", shape=[4, 6, 6],
+                              dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.conv2d(x, num_filters=8, filter_size=3,
+                                padding=1)
+        h = fluid.layers.batch_norm(h)
+        h = fluid.layers.relu(h)
+        logits = fluid.layers.fc(h, 3)
+        loss = fluid.layers.mean(
+            fluid.layers.loss.softmax_with_cross_entropy(logits, y))
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        if dp:
+            st = fleet.DistributedStrategy()
+            st.sync_batch_norm = sync_bn
+            fleet.init()
+            opt = fleet.distributed_optimizer(opt, st)
+        opt.minimize(loss)
+
+    if dp and sync_bn:
+        assert any(op.type == "sync_batch_norm"
+                   for op in main.global_block().ops)
+
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    losses = []
+    for _ in range(steps):
+        out, = exe.run(main, feed={"x": xs, "y": ys},
+                       fetch_list=[loss.name], scope=scope)
+        # DP fetch of a non-persistable var returns the per-device
+        # shard values; their mean is the global batch loss (each
+        # device averaged an equal 2-row shard)
+        losses.append(float(np.asarray(out).reshape(-1).mean()))
+    return losses
+
+
+def test_sync_bn_matches_full_batch_single_device():
+    """With synchronized moments, the 8-way DP run (2 rows/device) must
+    reproduce the single-device full-batch trajectory; per-replica BN
+    (sync off) must NOT — that divergence is exactly what the knob
+    fixes."""
+    ref = _train(sync_bn=False, dp=False)
+    synced = _train(sync_bn=True, dp=True)
+    unsynced = _train(sync_bn=False, dp=True)
+    np.testing.assert_allclose(synced, ref, rtol=2e-4, atol=2e-5)
+    assert not np.allclose(unsynced, ref, rtol=2e-4, atol=2e-5), (
+        "per-replica BN over 2-row shards cannot match full-batch "
+        "stats; if it does, the sync path is not being exercised")
+
+
+def test_sync_bn_off_leaves_ops_untouched():
+    main, startup = fluid.Program(), fluid.Program()
+    with framework.unique_name_guard(), \
+            fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4, 6, 6],
+                              dtype="float32")
+        h = fluid.layers.batch_norm(fluid.layers.conv2d(
+            x, num_filters=4, filter_size=3))
+        loss = fluid.layers.mean(h)
+        st = fleet.DistributedStrategy()
+        fleet.init()
+        opt = fleet.distributed_optimizer(
+            fluid.optimizer.SGD(learning_rate=0.1), st)
+        opt.minimize(loss)
+    types = [op.type for op in main.global_block().ops]
+    assert "batch_norm" in types and "sync_batch_norm" not in types

@@ -1,0 +1,105 @@
+"""Merge per-process chrome-trace profiles into one distributed
+timeline.
+
+Reference parity: `tools/timeline.py:32` converts each trainer's
+profiler.proto into chrome://tracing JSON and merges them with
+`--profile_path trainer1=file1,trainer2=file2,ps=file3`. TPU-native:
+`paddle_tpu.fluid.profiler` already writes chrome-trace JSON directly
+(`export_chrome_tracing`), so this tool only does the distributed
+merge — each input becomes its own process lane (stable pid + a
+process_name metadata event) so N trainers' steps line up on one
+timeline in chrome://tracing or Perfetto.
+
+Usage:
+    python tools/timeline.py \
+        --profile_path trainer0=/tmp/p0/paddle_tpu_trace.json,\
+trainer1=/tmp/p1/paddle_tpu_trace.json \
+        --timeline_path /tmp/merged.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def parse_profile_spec(spec: str):
+    """'name=path,name=path' -> [(name, path)]; bare paths get lane
+    names proc0, proc1, ..."""
+    out = []
+    for i, part in enumerate(p for p in spec.split(",") if p.strip()):
+        if "=" in part:
+            name, path = part.split("=", 1)
+        else:
+            name, path = "proc%d" % i, part
+        out.append((name.strip(), path.strip()))
+    if not out:
+        raise ValueError("empty --profile_path")
+    names = [n for n, _ in out]
+    if len(set(names)) != len(names):
+        raise ValueError("duplicate lane names in --profile_path: %s"
+                         % names)
+    return out
+
+
+def merge_traces(named_traces):
+    """[(name, trace_dict)] -> one chrome-trace dict. Each input's
+    events keep their relative pid/tid but move to a disjoint pid range
+    with a process_name metadata row, so lanes are labelled per
+    process."""
+    merged = []
+    for lane, (name, trace) in enumerate(named_traces):
+        # accept both chrome-trace shapes: {"traceEvents": [...]} and
+        # the bare JSON-array format some exporters emit
+        if isinstance(trace, list):
+            events = trace
+        elif isinstance(trace, dict):
+            events = trace.get("traceEvents") or []
+        else:
+            raise ValueError("unrecognized trace shape: %r"
+                             % type(trace).__name__)
+        base = lane * 1000
+        pids = set()
+        for ev in events:
+            if ev.get("ph") == "M" and ev.get("name") in (
+                    "process_name", "process_sort_index"):
+                # lane naming is this tool's job: per-process metadata
+                # from the single-process exporter would fight it
+                continue
+            ev = dict(ev)
+            ev["pid"] = base + int(ev.get("pid", 0))
+            pids.add(ev["pid"])
+            merged.append(ev)
+        for pid in sorted(pids):
+            merged.append({"name": "process_name", "ph": "M",
+                           "pid": pid, "tid": 0,
+                           "args": {"name": name}})
+            merged.append({"name": "process_sort_index", "ph": "M",
+                           "pid": pid, "tid": 0,
+                           "args": {"sort_index": lane}})
+    return {"traceEvents": merged, "displayTimeUnit": "ms"}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--profile_path", type=str, required=True,
+                    help="name=file[,name=file...] chrome-trace JSONs "
+                         "written by paddle_tpu's profiler")
+    ap.add_argument("--timeline_path", type=str, required=True,
+                    help="output merged chrome-trace JSON")
+    args = ap.parse_args(argv)
+
+    named = []
+    for name, path in parse_profile_spec(args.profile_path):
+        with open(path) as f:
+            named.append((name, json.load(f)))
+    out = merge_traces(named)
+    with open(args.timeline_path, "w") as f:
+        json.dump(out, f)
+    print("wrote %s (%d events from %d processes)"
+          % (args.timeline_path, len(out["traceEvents"]), len(named)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
