@@ -239,6 +239,8 @@ class _Fleet:
         return self._role_maker.worker_num() if self._role_maker else 1
 
     def is_worker(self):
+        if self._role_maker is not None:
+            return self._role_maker.is_worker()
         return True
 
     def worker_endpoints(self, to_string=False):
@@ -246,10 +248,21 @@ class _Fleet:
         return ",".join(eps) if to_string else eps
 
     def is_server(self):
-        return False
+        return (self._role_maker.is_server()
+                if self._role_maker is not None else False)
 
     def server_num(self):
-        return 0
+        return (self._role_maker.server_num()
+                if self._role_maker is not None else 0)
+
+    def server_index(self):
+        return (self._role_maker.server_index()
+                if self._role_maker is not None else 0)
+
+    def server_endpoints(self, to_string=False):
+        eps = (self._role_maker.get_pserver_endpoints()
+               if self._role_maker is not None else [])
+        return ",".join(eps) if to_string else eps
 
     def barrier_worker(self):
         pass
@@ -276,14 +289,55 @@ class _Fleet:
 
         return io.save_persistables(executor, dirname, main_program)
 
-    def init_worker(self):
-        pass
+    def init_worker(self, timeout=120.0):
+        """PS mode: block until every pserver port accepts connections
+        (reference: fleet_base init_worker -> wait_server_ready). A
+        real wait — relying on the RPC client's fixed 15s first-step
+        retry loses the race on slow hosts."""
+        if getattr(self, "_ps_transpiler", None) is None:
+            return
+        import socket
+        import time as _time
+
+        eps = (self._role_maker.get_pserver_endpoints()
+               if self._role_maker else [])
+        deadline = _time.monotonic() + timeout
+        for ep in eps:
+            host, port = ep.rsplit(":", 1)
+            while True:
+                try:
+                    with socket.create_connection((host, int(port)),
+                                                  timeout=2.0):
+                        break
+                except OSError:
+                    if _time.monotonic() > deadline:
+                        raise TimeoutError(
+                            "init_worker: pserver %s not reachable "
+                            "within %.0fs" % (ep, timeout))
+                    _time.sleep(0.25)
 
     def init_server(self, *a, **k):
-        pass
+        """PS mode: build this server's program pair from the transpile
+        stored by distributed_optimizer().minimize()."""
+        t = getattr(self, "_ps_transpiler", None)
+        if t is None:
+            return
+        ep = self._ps_my_endpoint
+        self._pserver_prog = t.get_pserver_program(ep)
+        self._pserver_startup = t.get_startup_program(
+            ep, self._pserver_prog)
 
     def run_server(self):
-        pass
+        """PS mode: serve until every trainer sent its completion
+        barrier (reference: listen_and_serv_op.cc:336 main loop)."""
+        if getattr(self, "_pserver_prog", None) is None:
+            return
+        from ..distributed.ps import listen_and_serv
+
+        listen_and_serv(self._pserver_prog, self._pserver_startup,
+                        endpoint=self._ps_my_endpoint,
+                        trainers=self._ps_n_trainers,
+                        mode=self._ps_mode)
 
 
 fleet = _Fleet()
@@ -351,6 +405,9 @@ class CollectiveOptimizer:
                         "stages; using dp=%d over the first %d devices"
                         % (n_dev, n_stages, dp, dp * n_stages))
                 pcfg["dp"] = dp
+        elif getattr(st, "a_sync", False) and self._transpile_ps(
+                loss, startup_program, st):
+            pass  # PS transpile done; programs rewritten in place
         elif getattr(st, "auto", False):
             # auto-parallel: no collective-op rewrite — mark the program
             # and let lowering run the dp x tp GSPMD sharding search
@@ -382,6 +439,58 @@ class CollectiveOptimizer:
             loss.block.program._elastic_cfg = dict(
                 getattr(st, "elastic_configs", {}) or {})
         return optimize_ops, params_grads
+
+    def _transpile_ps(self, loss, startup_program, st):
+        """Fleet 2.0 parameter-server mode (strategy.a_sync; reference:
+        fleet parameter_server runtime over the DistributeTranspiler):
+        rewrite the trainer program for PS training and stash the
+        transpile on the fleet singleton so init_server/run_server/
+        init_worker drive the existing PS tier (distributed/ps.py).
+        a_sync_configs: k_steps>0 selects geo-SGD with that push
+        interval; half_async=True the bounded-staleness mode; else pure
+        async. Returns False (caller falls back to collective) when no
+        pserver endpoints are configured."""
+        import warnings
+
+        from ..fluid import framework as fw
+        from ..fluid.transpiler import (DistributeTranspiler,
+                                        DistributeTranspilerConfig)
+
+        rm = fleet._role_maker
+        eps = rm.get_pserver_endpoints() if rm is not None else []
+        if not eps:
+            warnings.warn(
+                "DistributedStrategy.a_sync is set but no pserver "
+                "endpoints are configured (fleet.init with a PS role "
+                "maker, or PADDLE_PSERVERS_IP_PORT_LIST); running "
+                "collective (sync) instead.")
+            return False
+
+        cfg_map = dict(getattr(st, "a_sync_configs", {}) or {})
+        k_steps = int(cfg_map.get("k_steps", 0) or 0)
+        cfg = DistributeTranspilerConfig()
+        mode = "async"
+        if k_steps > 0:
+            cfg.geo_sgd_mode = True
+            cfg.geo_sgd_need_push_nums = k_steps
+            mode = "geo"
+        elif cfg_map.get("half_async"):
+            cfg.half_async = True
+            mode = "half_async"
+        t = DistributeTranspiler(config=cfg)
+        n_trainers = rm.worker_num()
+        tid = rm.worker_index() if rm.is_worker() else 0
+        t.transpile(tid, program=loss.block.program,
+                    pservers=",".join(eps), trainers=n_trainers,
+                    sync_mode=False,
+                    startup_program=(startup_program
+                                     or fw.default_startup_program()))
+        fleet._ps_transpiler = t
+        fleet._ps_mode = mode
+        fleet._ps_n_trainers = n_trainers
+        fleet._ps_my_endpoint = (eps[rm.server_index()]
+                                 if rm.is_server() else None)
+        return True
 
 
 def transpile_collective(program, nranks=None, k_steps_localsgd=0,

@@ -143,13 +143,10 @@ class _WarnOnlyMeta(MetaOptimizerBase):
         return optimizer
 
 
-_WARN_ONLY = [
-    _WarnOnlyMeta("a_sync",
-                  "DistributedStrategy.a_sync: async parameter-server "
-                  "mode is not wired through fleet yet; use "
-                  "fluid.transpiler.DistributeTranspiler for PS "
-                  "training. Running collective (sync) instead."),
-]
+# every knob is now either implemented or redirected with a loud
+# warning at its use site (a_sync falls back in fleet._transpile_ps
+# when no pserver endpoints exist); the list stays for future knobs
+_WARN_ONLY: List[MetaOptimizerBase] = []
 
 # application order matters: optimizer swaps first, then recompute /
 # gradient_merge wrap, pipeline cuts the program, AMP decorates last so
@@ -165,6 +162,13 @@ _META_ORDER: List[MetaOptimizerBase] = _WARN_ONLY + [
 # zeroes knobs it cannot coexist with): winner knob -> knobs it
 # disables, with the why for the warning
 _CONFLICTS = [
+    ("pipeline", "sync_batch_norm",
+     "the pipeline engine's minimize branch owns the program rewrite; "
+     "BN-stat synchronization over dp replicas of a pipeline is not "
+     "wired — stats stay per-replica"),
+    ("pipeline", "a_sync",
+     "pipeline training is collective-mode; the parameter-server "
+     "rewrite cannot compose with the stage cut"),
     ("lamb", "lars",
      "lamb replaces the base optimizer; lars (a Momentum wrapper) "
      "cannot also apply"),

@@ -1,0 +1,104 @@
+"""Fleet 2.0 parameter-server runner (strategy.a_sync through the
+public fleet API; reference: fleet parameter_server mode over the
+DistributeTranspiler — role makers, init_server/run_server,
+init_worker). Spawned as subprocesses by test_dist_ps.py.
+
+argv: pserver <server_idx> <pserver_eps> <n_trainers>
+      trainer <trainer_id> <pserver_eps> <n_trainers>
+Prints LOSS <v> per trainer step / SERVED when a pserver drains."""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu.fluid as fluid  # noqa: E402
+from paddle_tpu import fleet  # noqa: E402
+from paddle_tpu.fleet.role_maker import (  # noqa: E402
+    Role, UserDefinedRoleMaker)
+from paddle_tpu.fluid import framework  # noqa: E402
+
+LR = 0.5
+STEPS = 5
+BATCH = 32
+
+
+def build(seed=11):
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = startup.random_seed = seed
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+            label = fluid.layers.data(name="label", shape=[1],
+                                      dtype="int64")
+            h = fluid.layers.fc(input=x, size=32, act="relu")
+            logits = fluid.layers.fc(input=h, size=4)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, label))
+    return main, startup, loss
+
+
+def data():
+    r = np.random.RandomState(2)
+    x = r.rand(BATCH, 16).astype("float32")
+    w = r.randn(16, 4).astype("float32")
+    y = (x @ w).argmax(axis=1).reshape(-1, 1).astype("int64")
+    return x, y
+
+
+def _minimize(role, current_id, eps, n_trainers):
+    main, startup, loss = build()
+    rm = UserDefinedRoleMaker(current_id=current_id, role=role,
+                              worker_num=n_trainers,
+                              server_endpoints=eps.split(","))
+    fleet.init(rm, is_collective=False)
+    st = fleet.DistributedStrategy()
+    st.a_sync = True
+    opt = fleet.distributed_optimizer(
+        fluid.optimizer.SGDOptimizer(learning_rate=LR), st)
+    with framework.program_guard(main, startup):
+        opt.minimize(loss, startup_program=startup)
+    return main, startup, loss
+
+
+def run_pserver(idx, eps, n_trainers):
+    _minimize(Role.SERVER, idx, eps, n_trainers)
+    assert fleet.fleet.is_server()
+    fleet.fleet.init_server()
+    print("SERVING", flush=True)
+    fleet.fleet.run_server()
+    print("SERVED", flush=True)
+
+
+def run_trainer(tid, eps, n_trainers):
+    from paddle_tpu.core.scope import Scope
+
+    main, startup, loss = _minimize(Role.WORKER, tid, eps, n_trainers)
+    assert fleet.fleet.is_worker()
+    fleet.fleet.init_worker()  # waits for pserver ports
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    x, y = data()
+    half = BATCH // n_trainers
+    xs = x[tid * half:(tid + 1) * half]
+    ys = y[tid * half:(tid + 1) * half]
+    for _ in range(STEPS):
+        out = exe.run(main, feed={"x": xs, "label": ys},
+                      fetch_list=[loss], scope=scope)
+        print("LOSS %.6f" % float(np.asarray(out[0]).reshape(-1)[0]),
+              flush=True)
+    exe.close()  # complete() so the pservers drain and exit
+
+
+if __name__ == "__main__":
+    kind = sys.argv[1]
+    if kind == "pserver":
+        run_pserver(int(sys.argv[2]), sys.argv[3], int(sys.argv[4]))
+    elif kind == "trainer":
+        run_trainer(int(sys.argv[2]), sys.argv[3], int(sys.argv[4]))
+    else:
+        raise SystemExit("unknown role %r" % kind)

@@ -144,3 +144,46 @@ def test_heartbeat_monitor_detects_lost_worker():
     m.beat(0)  # recovery clears the flag
     t[0] = 8.0
     assert m.lost_workers() == []
+
+
+_FLEET_RUNNER = os.path.join(_DIR, "dist_fleet_ps_runner.py")
+
+
+def _spawn_fleet(args):
+    return subprocess.Popen([sys.executable, _FLEET_RUNNER] + args,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True,
+                            env=_env(), cwd=_DIR)
+
+
+def test_fleet_a_sync_ps_2x2_localhost():
+    """strategy.a_sync through the PUBLIC fleet API (role makers +
+    init_server/run_server/init_worker) — reference: fleet 2.0
+    parameter_server mode. 2 pservers + 2 trainers; every trainer's
+    loss must decrease on the learnable batch."""
+    eps = "127.0.0.1:%d,127.0.0.1:%d" % (_free_port(), _free_port())
+    n_trainers = 2
+
+    servers = [_spawn_fleet(["pserver", str(i), eps, str(n_trainers)])
+               for i in range(2)]
+    trainers = [_spawn_fleet(["trainer", str(i), eps, str(n_trainers)])
+                for i in range(n_trainers)]
+    touts = []
+    try:
+        for t in trainers:
+            out, _ = t.communicate(timeout=240)
+            assert t.returncode == 0, out
+            touts.append(out)
+        for s in servers:
+            out, _ = s.communicate(timeout=60)
+            assert s.returncode == 0, out
+            assert "SERVED" in out
+    finally:
+        for p in servers + trainers:
+            if p.poll() is None:
+                p.kill()
+
+    for out in touts:
+        ls = _losses(out)
+        assert len(ls) == 5, out
+        assert ls[-1] < ls[0], (ls, out)

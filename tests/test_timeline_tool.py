@@ -34,15 +34,32 @@ def test_merge_assigns_disjoint_labelled_lanes():
     merged = timeline.merge_traces([("trainer0", t0), ("trainer1", t1)])
     evs = merged["traceEvents"]
     xs = [e for e in evs if e.get("ph") == "X"]
-    assert {e["pid"] for e in xs} == {0, 1003}
+    # dense per-lane remap: lane 1's single pid lands at its lane base
+    assert {e["pid"] for e in xs} == {0, 1000}
     names = {(e["pid"], e["args"]["name"]) for e in evs
              if e["name"] == "process_name"}
-    assert (0, "trainer0") in names and (1003, "trainer1") in names
+    assert (0, "trainer0") in names and (1000, "trainer1") in names
     # sort hints land on the pids that actually carry events
     sorts = {e["pid"] for e in evs if e["name"] == "process_sort_index"}
-    assert sorts == {0, 1003}
+    assert sorts == {0, 1000}
     # originals untouched (merge copies events)
     assert all(e["pid"] == 3 for e in t1["traceEvents"])
+
+
+def test_merge_survives_os_pids():
+    """Real exporters emit OS pids (e.g. 7716): lanes must stay
+    disjoint — a fixed lane*1000 offset would collide 7716 with a
+    second lane's range."""
+    merged = timeline.merge_traces([
+        ("a", _trace(["op"], pid=7716)),
+        ("b", _trace(["op"], pid=3)),
+    ])
+    evs = merged["traceEvents"]
+    by_lane = {}
+    for e in evs:
+        if e["name"] == "process_name":
+            by_lane.setdefault(e["args"]["name"], set()).add(e["pid"])
+    assert by_lane["a"].isdisjoint(by_lane["b"]), by_lane
 
 
 def test_merge_accepts_bare_array_traces():

@@ -42,35 +42,48 @@ def parse_profile_spec(spec: str):
     return out
 
 
+def _lane_events(trace):
+    """Accept both chrome-trace shapes: {"traceEvents": [...]} and the
+    bare JSON-array format some exporters emit."""
+    if isinstance(trace, list):
+        return trace
+    if isinstance(trace, dict):
+        return trace.get("traceEvents") or []
+    raise ValueError("unrecognized trace shape: %r"
+                     % type(trace).__name__)
+
+
 def merge_traces(named_traces):
-    """[(name, trace_dict)] -> one chrome-trace dict. Each input's
-    events keep their relative pid/tid but move to a disjoint pid range
-    with a process_name metadata row, so lanes are labelled per
-    process."""
+    """[(name, trace_dict)] -> one chrome-trace dict. Each lane's pids
+    are densely remapped into a disjoint range (real exporters emit OS
+    pids like 7716, so a fixed lane*1000 offset would collide) with
+    process_name/sort metadata rows per labelled lane."""
+    lanes = [( name, _lane_events(trace)) for name, trace in
+             named_traces]
+
+    def is_proc_meta(ev):
+        # lane naming is this tool's job: per-process metadata from the
+        # single-process exporter would fight it
+        return ev.get("ph") == "M" and ev.get("name") in (
+            "process_name", "process_sort_index")
+
+    # stride sized to the largest lane so remapped ranges never overlap
+    stride = max([1000] + [
+        len({int(e.get("pid", 0)) for e in evs if not is_proc_meta(e)})
+        for _, evs in lanes])
+
     merged = []
-    for lane, (name, trace) in enumerate(named_traces):
-        # accept both chrome-trace shapes: {"traceEvents": [...]} and
-        # the bare JSON-array format some exporters emit
-        if isinstance(trace, list):
-            events = trace
-        elif isinstance(trace, dict):
-            events = trace.get("traceEvents") or []
-        else:
-            raise ValueError("unrecognized trace shape: %r"
-                             % type(trace).__name__)
-        base = lane * 1000
-        pids = set()
+    for lane, (name, events) in enumerate(lanes):
+        orig_pids = sorted({int(e.get("pid", 0)) for e in events
+                            if not is_proc_meta(e)})
+        remap = {p: lane * stride + i for i, p in enumerate(orig_pids)}
         for ev in events:
-            if ev.get("ph") == "M" and ev.get("name") in (
-                    "process_name", "process_sort_index"):
-                # lane naming is this tool's job: per-process metadata
-                # from the single-process exporter would fight it
+            if is_proc_meta(ev):
                 continue
             ev = dict(ev)
-            ev["pid"] = base + int(ev.get("pid", 0))
-            pids.add(ev["pid"])
+            ev["pid"] = remap[int(ev.get("pid", 0))]
             merged.append(ev)
-        for pid in sorted(pids):
+        for pid in sorted(remap.values()):
             merged.append({"name": "process_name", "ph": "M",
                            "pid": pid, "tid": 0,
                            "args": {"name": name}})
