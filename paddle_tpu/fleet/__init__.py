@@ -222,8 +222,17 @@ class _Fleet:
             is_collective=is_collective)
         self._is_collective = is_collective
         self._inited = True
+        # a re-init starts a fresh topology: drop any PS transpile
+        # stashed by a previous minimize so stale server state cannot
+        # leak across runs
+        self._ps_transpiler = None
+        self._pserver_prog = None
         # multi-host bootstrap over DCN (replaces nccl-id TCP exchange)
-        if self.worker_num() > 1:
+        # — collective mode only: PS processes must NOT join a jax
+        # distributed rendezvous (under launch_ps every role sees
+        # PADDLE_TRAINER_ENDPOINTS and pservers would deadlock in
+        # jax.distributed.initialize)
+        if is_collective and self.worker_num() > 1:
             from ..distributed import init_parallel_env
 
             init_parallel_env()
@@ -320,7 +329,7 @@ class _Fleet:
         """PS mode: build this server's program pair from the transpile
         stored by distributed_optimizer().minimize()."""
         t = getattr(self, "_ps_transpiler", None)
-        if t is None:
+        if t is None or not self.is_server():
             return
         ep = self._ps_my_endpoint
         self._pserver_prog = t.get_pserver_program(ep)
@@ -330,7 +339,8 @@ class _Fleet:
     def run_server(self):
         """PS mode: serve until every trainer sent its completion
         barrier (reference: listen_and_serv_op.cc:336 main loop)."""
-        if getattr(self, "_pserver_prog", None) is None:
+        if getattr(self, "_pserver_prog", None) is None \
+                or not self.is_server():
             return
         from ..distributed.ps import listen_and_serv
 

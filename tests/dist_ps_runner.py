@@ -32,7 +32,12 @@ def _lr(mode):
     return 0.1 if mode == "half_async" else LR
 
 
-def build(seed=11, mode="sync"):
+def build_net(seed=11):
+    """Model WITHOUT the optimizer — shared by this runner and the
+    fleet-API runners (dist_fleet_ps_runner / fleet_ps_env_runner),
+    which attach the optimizer through fleet.distributed_optimizer.
+    ONE copy so the loss-decrease assumptions (learnable labels, seed)
+    stay in sync across the whole PS test family."""
     main, startup = framework.Program(), framework.Program()
     main.random_seed = startup.random_seed = seed
     with framework.program_guard(main, startup):
@@ -44,6 +49,13 @@ def build(seed=11, mode="sync"):
             logits = fluid.layers.fc(input=h, size=4)
             loss = fluid.layers.mean(
                 fluid.layers.softmax_with_cross_entropy(logits, label))
+    return main, startup, loss
+
+
+def build(seed=11, mode="sync"):
+    main, startup, loss = build_net(seed)
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
             opt = fluid.optimizer.SGDOptimizer(learning_rate=_lr(mode))
             opt.minimize(loss)
     return main, startup, loss

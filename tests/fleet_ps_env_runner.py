@@ -1,0 +1,63 @@
+"""Role-AGNOSTIC fleet PS training script — the reference user
+workflow: one script launched for every role by
+`python -m paddle_tpu.distributed.launch_ps`, with
+PaddleCloudRoleMaker picking the role from TRAINING_ROLE/PADDLE_* env
+(reference: fleet parameter_server mode quickstart)."""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu.fluid as fluid  # noqa: E402
+from paddle_tpu import fleet  # noqa: E402
+from paddle_tpu.fleet.role_maker import PaddleCloudRoleMaker  # noqa: E402
+from paddle_tpu.fluid import framework  # noqa: E402
+
+# ONE model + dataset for the whole PS test family
+from dist_ps_runner import build_net, data  # noqa: E402
+
+STEPS = 5
+
+
+def main():
+    main_p, startup, loss = build_net(seed=11)
+    with framework.program_guard(main_p, startup):
+        with framework.unique_name_guard():
+            fleet.init(PaddleCloudRoleMaker(is_collective=False),
+                       is_collective=False)
+            st = fleet.DistributedStrategy()
+            st.a_sync = True
+            opt = fleet.distributed_optimizer(
+                fluid.optimizer.SGDOptimizer(learning_rate=0.5), st)
+            opt.minimize(loss, startup_program=startup)
+
+    if fleet.fleet.is_server():
+        fleet.fleet.init_server()
+        print("SERVING", flush=True)
+        fleet.fleet.run_server()
+        print("SERVED", flush=True)
+        return
+
+    fleet.fleet.init_worker()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    tid = fleet.fleet.worker_index()
+    n = fleet.fleet.worker_num()
+    x_all, y_all = data()
+    half = x_all.shape[0] // n
+    xs = x_all[tid * half:(tid + 1) * half]
+    ys = y_all[tid * half:(tid + 1) * half]
+    for _ in range(STEPS):
+        out = exe.run(main_p, feed={"x": xs, "label": ys},
+                      fetch_list=[loss])
+        print("LOSS %.6f" % float(np.asarray(out[0]).reshape(-1)[0]),
+              flush=True)
+    exe.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -187,3 +187,35 @@ def test_fleet_a_sync_ps_2x2_localhost():
         ls = _losses(out)
         assert len(ls) == 5, out
         assert ls[-1] < ls[0], (ls, out)
+
+
+def test_fleet_ps_via_launch_ps(tmp_path):
+    """The COMPLETE reference user workflow: one role-agnostic script
+    (PaddleCloudRoleMaker from env) for 2 servers + 2 trainers, spawned
+    by `paddle_tpu.distributed.launch_ps` — reference quickstart:
+    launch_ps.py + fleet parameter_server mode."""
+    from paddle_tpu.distributed import launch_ps
+
+    script = os.path.join(_DIR, "fleet_ps_env_runner.py")
+    logs = str(tmp_path / "logs")
+    servers = "127.0.0.1:%d,127.0.0.1:%d" % (_free_port(), _free_port())
+    env_backup = dict(os.environ)
+    clean = _env()  # snapshot BEFORE clear: keep PATH/HOME/... intact
+    try:
+        # full swap: update() without clear() would leave the
+        # accelerator-plugin vars in place and the spawned roles would
+        # hang on the tunnel
+        os.environ.clear()
+        os.environ.update(clean)
+        rc = launch_ps.launch([
+            "--servers", servers, "--worker_num", "2",
+            "--log_dir", logs, script])
+    finally:
+        os.environ.clear()
+        os.environ.update(env_backup)
+    assert rc == 0
+    for i in range(2):
+        with open(os.path.join(logs, "workerlog.%d.log" % i)) as f:
+            ls = _losses(f.read())
+        assert len(ls) == 5
+        assert ls[-1] < ls[0], ls

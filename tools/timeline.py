@@ -67,16 +67,16 @@ def merge_traces(named_traces):
         return ev.get("ph") == "M" and ev.get("name") in (
             "process_name", "process_sort_index")
 
-    # stride sized to the largest lane so remapped ranges never overlap
-    stride = max([1000] + [
-        len({int(e.get("pid", 0)) for e in evs if not is_proc_meta(e)})
-        for _, evs in lanes])
+    # one pid scan per lane; stride sized to the largest lane so
+    # remapped ranges never overlap
+    pid_sets = [sorted({int(e.get("pid", 0)) for e in evs
+                        if not is_proc_meta(e)}) for _, evs in lanes]
+    stride = max([1000] + [len(s) for s in pid_sets])
 
     merged = []
     for lane, (name, events) in enumerate(lanes):
-        orig_pids = sorted({int(e.get("pid", 0)) for e in events
-                            if not is_proc_meta(e)})
-        remap = {p: lane * stride + i for i, p in enumerate(orig_pids)}
+        remap = {p: lane * stride + i
+                 for i, p in enumerate(pid_sets[lane])}
         for ev in events:
             if is_proc_meta(ev):
                 continue
