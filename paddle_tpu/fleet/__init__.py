@@ -29,6 +29,7 @@ from .role_maker import (  # noqa: F401
     RoleMakerBase, PaddleCloudRoleMaker, UserDefinedRoleMaker, Role,
 )
 from . import metrics  # noqa: F401  (fleet.metrics.* helpers)
+from . import util  # noqa: F401  (fleet.util collective helpers)
 
 
 class DistributedStrategy:
@@ -227,6 +228,10 @@ class _Fleet:
         # leak across runs
         self._ps_transpiler = None
         self._pserver_prog = None
+        # wire fleet.util to this topology (reference: UtilFactory
+        # _set_role_maker at fleet init) — without it get_file_shard/
+        # print_on_rank silently behave single-worker
+        util._util._set_role_maker(self._role_maker)
         # multi-host bootstrap over DCN (replaces nccl-id TCP exchange)
         # — collective mode only: PS processes must NOT join a jax
         # distributed rendezvous (under launch_ps every role sees
@@ -282,6 +287,7 @@ class _Fleet:
     # -- optimizer ---------------------------------------------------------
     def distributed_optimizer(self, optimizer, strategy=None):
         self._strategy = strategy or DistributedStrategy()
+        util._util._set_strategy(self._strategy)
         return CollectiveOptimizer(optimizer, self._strategy)
 
     # -- checkpoint (reference: fleet/collective/__init__.py:236,294) ------

@@ -47,6 +47,12 @@ from .reader import DataLoader, BatchSampler  # noqa: F401
 from . import dataset  # noqa: F401
 from .dataset import DatasetFactory, InMemoryDataset, QueueDataset  # noqa: F401
 from . import metrics  # noqa: F401
+from . import average  # noqa: F401
+from . import evaluator  # noqa: F401
+from . import debugger  # noqa: F401
+from . import communicator  # noqa: F401
+from .data_feed_desc import DataFeedDesc  # noqa: F401
+from .input import embedding, one_hot  # noqa: F401
 from . import contrib  # noqa: F401
 from . import install_check  # noqa: F401
 from . import incubate  # noqa: F401

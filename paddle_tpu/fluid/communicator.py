@@ -1,0 +1,48 @@
+"""fluid.communicator — user handle on the trainer-side PS
+communicator (reference: `python/paddle/fluid/communicator.py:27`
+wrapping the C++ Communicator of `operators/distributed/
+communicator.h:176-395`). TPU-native: the real machinery is
+`distributed/ps.PSCommunicator`, created lazily by the Executor from
+the transpiled program's `_ps_cfg`; this class gives it the reference's
+start/stop lifecycle surface."""
+from __future__ import annotations
+
+
+class Communicator:
+    """Wraps the PS communicator of a transpiled trainer program.
+
+    `start()` materializes the communicator (half-async mode starts its
+    background merge-send thread); `stop()` flushes and joins it.
+    """
+
+    def __init__(self, program, mode=None, kwargs=None, envs=None):
+        cfg = getattr(program, "_ps_cfg", None)
+        if cfg is None:
+            raise ValueError(
+                "Communicator needs a program transpiled for PS "
+                "training (DistributeTranspiler / strategy.a_sync)")
+        self._program = program
+        self._mode = mode or cfg["mode"]
+        self._comm = None
+
+    def start(self):
+        from ..distributed.ps import PSCommunicator
+
+        if self._comm is None:
+            self._comm = PSCommunicator(self._program._ps_cfg)
+            # the executor reuses an existing communicator instance
+            # instead of building its own
+            self._program._ps_comm = self._comm
+
+    def stop(self):
+        if self._comm is not None:
+            # complete() is PSCommunicator's shutdown: flushes pending
+            # half-async rounds, joins the sender thread, and tells the
+            # pservers this trainer is done (same call the Executor's
+            # own close path makes)
+            self._comm.complete()
+            self._comm = None
+            self._program._ps_comm = None
+
+    def is_running(self):
+        return self._comm is not None

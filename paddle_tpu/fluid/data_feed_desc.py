@@ -1,0 +1,100 @@
+"""DataFeedDesc — training-data format descriptor (reference:
+`python/paddle/fluid/data_feed_desc.py:21` wrapping the
+`framework/data_feed.proto` text message). TPU-native: a small text
+parser/printer with the same accessor surface; `fluid.dataset` slot
+configuration is the consumer."""
+from __future__ import annotations
+
+
+class _Slot:
+    __slots__ = ("name", "type", "is_dense", "is_used")
+
+    def __init__(self, name="", type="uint64", is_dense=False,
+                 is_used=False):
+        self.name = name
+        self.type = type
+        self.is_dense = is_dense
+        self.is_used = is_used
+
+
+class DataFeedDesc:
+    """Parse a data_feed prototxt (name / batch_size /
+    multi_slot_desc{slots{...}}), expose the reference's mutators, and
+    print the message back out via `desc()`."""
+
+    def __init__(self, proto_file):
+        self.name = "MultiSlotDataFeed"
+        self.batch_size = 1
+        self._slots = []
+        self._slot_by_name = {}
+        with open(proto_file) as f:
+            self._parse(f.read())
+
+    def _parse(self, text):
+        cur = None
+        for raw in text.splitlines():
+            ln = raw.strip()
+            if not ln or ln.startswith("#"):
+                continue
+            if ln.startswith("slots") and ln.endswith("{"):
+                cur = _Slot()
+                continue
+            if ln == "}":
+                if cur is not None and cur.name:
+                    self._slots.append(cur)
+                    self._slot_by_name[cur.name] = cur
+                cur = None
+                continue
+            if ln.endswith("{"):
+                continue  # multi_slot_desc {
+            if ":" not in ln:
+                continue
+            k, v = ln.split(":", 1)
+            k, v = k.strip(), v.strip().strip('"')
+            if cur is not None:
+                if k == "name":
+                    cur.name = v
+                elif k == "type":
+                    cur.type = v
+                elif k == "is_dense":
+                    cur.is_dense = v == "true"
+                elif k == "is_used":
+                    cur.is_used = v == "true"
+            elif k == "name":
+                self.name = v
+            elif k == "batch_size":
+                self.batch_size = int(v)
+
+    # -- reference mutators (data_feed_desc.py:75-160) -----------------
+    def set_batch_size(self, batch_size):
+        self.batch_size = int(batch_size)
+
+    def set_dense_slots(self, dense_slots_name):
+        for n in dense_slots_name:
+            if n not in self._slot_by_name:
+                raise ValueError("slot %r not found" % n)
+            self._slot_by_name[n].is_dense = True
+
+    def set_use_slots(self, use_slots_name):
+        for n in use_slots_name:
+            if n not in self._slot_by_name:
+                raise ValueError("slot %r not found" % n)
+            self._slot_by_name[n].is_used = True
+
+    def slot_names(self):
+        return [s.name for s in self._slots]
+
+    def desc(self):
+        """The message back in protobuf text format."""
+        lines = ['name: "%s"' % self.name,
+                 "batch_size: %d" % self.batch_size,
+                 "multi_slot_desc {"]
+        for s in self._slots:
+            lines += ["  slots {",
+                      '    name: "%s"' % s.name,
+                      '    type: "%s"' % s.type,
+                      "    is_dense: %s" % str(s.is_dense).lower(),
+                      "    is_used: %s" % str(s.is_used).lower(),
+                      "  }"]
+        lines.append("}")
+        return "\n".join(lines) + "\n"

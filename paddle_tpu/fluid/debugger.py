@@ -1,0 +1,88 @@
+"""fluid.debugger — program pretty-printing + graphviz DOT export
+(reference: `python/paddle/fluid/debugger.py:112-285`: colored
+pseudo-code listing of a ProgramDesc and draw_block_graphviz). Works on
+this framework's Program/Block/Operator objects; the DOT writer is pure
+text (no graphviz binding needed to produce the .dot file)."""
+from __future__ import annotations
+
+
+def repr_var(var):
+    shape = tuple(getattr(var, "shape", ()) or ())
+    return "%s[%s]%s" % (getattr(var, "dtype", "?"),
+                         ",".join(str(d) for d in shape),
+                         " persist" if getattr(var, "persistable", False)
+                         else "")
+
+
+def repr_attr(name, value):
+    if isinstance(value, str):
+        return '%s="%s"' % (name, value)
+    return "%s=%s" % (name, value)
+
+
+def repr_op(op):
+    """One op as pseudo-code: outs = op_type(ins, attrs)."""
+    outs = ", ".join("%s=%s" % (k, list(v))
+                     for k, v in sorted(op.output_names.items()) if v)
+    ins = ", ".join("%s=%s" % (k, list(v))
+                    for k, v in sorted(op.input_names.items()) if v)
+    attrs = ", ".join(repr_attr(k, v)
+                      for k, v in sorted(op.attrs.items())
+                      if not k.startswith("op_"))
+    return "%s = %s(%s)%s" % (outs or "()", op.type, ins,
+                              " {%s}" % attrs if attrs else "")
+
+
+def pprint_block_codes(block, show_backward=False):
+    lines = ["block {"]
+    for name, var in sorted(block.vars.items()):
+        if not show_backward and name.endswith("@GRAD"):
+            continue
+        lines.append("  var %s : %s" % (name, repr_var(var)))
+    for op in block.ops:
+        if not show_backward and op.type.endswith("_grad"):
+            continue
+        lines.append("  " + repr_op(op))
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def pprint_program_codes(program, show_backward=False):
+    """The whole program as pseudo-code text (reference
+    debugger.py:112)."""
+    return "\n".join(pprint_block_codes(program.block(i), show_backward)
+                     for i in range(program.num_blocks))
+
+
+def draw_block_graphviz(block, highlights=None, path="./temp.dot"):
+    """Write the block's op/var dataflow as a graphviz DOT file
+    (reference debugger.py:229). Vars are ellipses, ops are boxes;
+    `highlights` names are filled red."""
+    highlights = set(highlights or [])
+
+    def esc(s):
+        return s.replace('"', r'\"')
+
+    lines = ["digraph G {", "  rankdir=TB;"]
+    seen_vars = set()
+    for name in block.vars:
+        seen_vars.add(name)
+        style = ' style=filled fillcolor="red"' \
+            if name in highlights else ""
+        lines.append('  "v_%s" [label="%s" shape=ellipse%s];'
+                     % (esc(name), esc(name), style))
+    for i, op in enumerate(block.ops):
+        lines.append('  "op_%d" [label="%s" shape=box '
+                     'style=filled fillcolor="lightgrey"];'
+                     % (i, esc(op.type)))
+        for n in op.input_arg_names:
+            if n in seen_vars:
+                lines.append('  "v_%s" -> "op_%d";' % (esc(n), i))
+        for n in op.output_arg_names:
+            if n in seen_vars:
+                lines.append('  "op_%d" -> "v_%s";' % (i, esc(n)))
+    lines.append("}")
+    text = "\n".join(lines) + "\n"
+    with open(path, "w") as f:
+        f.write(text)
+    return path

@@ -1,0 +1,132 @@
+"""fluid.evaluator — the DEPRECATED pre-metrics evaluator API
+(reference: `python/paddle/fluid/evaluator.py:45-299`, which warns and
+points at fluid.metrics). Kept for surface parity: each class wraps the
+corresponding streaming metric from `fluid.metrics` / metric ops, with
+the reference's deprecation warning."""
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+
+from . import metrics as _metrics
+
+
+def _warn(cls):
+    warnings.warn(
+        "The %s is deprecated, because maintain a modified program "
+        "inside evaluator cause bug easily, please use "
+        "fluid.metrics.%s instead." % (cls, cls), Warning)
+
+
+class Evaluator:
+    """Base class (reference evaluator.py:45): subclasses accumulate
+    over minibatches and expose eval()/reset()."""
+
+    def __init__(self, name, **kwargs):
+        _warn(self.__class__.__name__)
+        self.name = name
+
+    def reset(self):
+        raise NotImplementedError
+
+    def eval(self, executor=None, eval_program=None):
+        raise NotImplementedError
+
+
+class ChunkEvaluator(Evaluator):
+    """Streaming chunk F1 (reference evaluator.py:127); delegates to
+    metrics.ChunkEvaluator over per-batch (num_infer, num_label,
+    num_correct) chunk counts."""
+
+    def __init__(self, input=None, label=None, chunk_scheme=None,
+                 num_chunk_types=None, excluded_chunk_types=None):
+        super().__init__("chunk_eval")
+        self._m = _metrics.ChunkEvaluator()
+
+    def reset(self):
+        self._m.reset()
+
+    def update(self, num_infer_chunks, num_label_chunks,
+               num_correct_chunks):
+        self._m.update(num_infer_chunks, num_label_chunks,
+                       num_correct_chunks)
+
+    def eval(self, executor=None, eval_program=None):
+        return self._m.eval()
+
+
+class EditDistance(Evaluator):
+    """Streaming mean edit distance (reference evaluator.py:218)."""
+
+    def __init__(self, input=None, label=None, ignored_tokens=None):
+        super().__init__("edit_distance")
+        self._m = _metrics.EditDistance()
+
+    def reset(self):
+        self._m.reset()
+
+    def update(self, distances, seq_num):
+        self._m.update(distances, seq_num)
+
+    def eval(self, executor=None, eval_program=None):
+        return self._m.eval()
+
+
+class DetectionMAP(Evaluator):
+    """Streaming detection mAP (reference evaluator.py:299): feed each
+    batch's detections + ground truth; eval() runs the registered
+    `detection_map` op in accumulative mode."""
+
+    def __init__(self, input=None, gt_label=None, gt_box=None,
+                 gt_difficult=None, class_num=None,
+                 background_label=0, overlap_threshold=0.5,
+                 evaluate_difficult=True, ap_version="integral"):
+        super().__init__("detection_map")
+        self.class_num = class_num
+        self.background_label = background_label
+        self.overlap_threshold = overlap_threshold
+        self.evaluate_difficult = evaluate_difficult
+        self.ap_version = ap_version
+        self.reset()
+
+    def reset(self):
+        self._state = None
+
+    def update(self, detect_res, detect_lod, label, label_lod):
+        """One batch: detections [[label, score, x1,y1,x2,y2]...] with
+        lod offsets, labels [[label, x1,y1,x2,y2, difficult]...].
+        Runs the registered `detection_map` op INCREMENTALLY, threading
+        its Accum* state (reference detection_map_op.h accumulative
+        mode) — eval() is then O(1) and no batch is retained."""
+        from ..ops.registry import get_op
+
+        op = get_op("detection_map")
+        ins = {"DetectRes": [np.asarray(detect_res, np.float32)],
+               "DetectResLod": [np.asarray(detect_lod, np.int64)],
+               "Label": [np.asarray(label, np.float32)],
+               "LabelLod": [np.asarray(label_lod, np.int64)]}
+        if self._state is not None:
+            s = self._state
+            # op outputs are raw arrays (not slot lists): pass them
+            # whole — indexing [0] here would slice off the first row
+            # of each state tensor and silently drop prior batches
+            ins.update({
+                "HasState": [np.asarray([1], np.int32)],
+                "PosCount": [s["AccumPosCount"]],
+                "TruePos": [s["AccumTruePos"]],
+                "TruePosLod": [s["AccumTruePosLod"]],
+                "FalsePos": [s["AccumFalsePos"]],
+                "FalsePosLod": [s["AccumFalsePosLod"]],
+            })
+        self._state = op.compute(ins, {
+            "class_num": self.class_num,
+            "background_label": self.background_label,
+            "overlap_threshold": self.overlap_threshold,
+            "evaluate_difficult": self.evaluate_difficult,
+            "ap_type": self.ap_version})
+
+    def eval(self, executor=None, eval_program=None):
+        if self._state is None:
+            raise ValueError("no batches fed to DetectionMAP")
+        return float(np.asarray(self._state["MAP"]).reshape(-1)[0])

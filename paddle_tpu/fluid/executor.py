@@ -207,14 +207,21 @@ class Executor:
         if not hasattr(self, "_ps_comms"):
             self._ps_comms = {}
         key = program._uid
-        if key not in self._ps_comms:
+        # a user-started fluid.communicator.Communicator wins — even
+        # over a previously cached instance, so start()/stop()/start()
+        # cycles actually swap the communicator the steps use
+        comm = getattr(program, "_ps_comm", None) or \
+            self._ps_comms.get(key)
+        if comm is None:
             from ..distributed.ps import PSCommunicator
 
             comm = PSCommunicator(ps_cfg)
-            if scope is not None:
-                comm.init_params(scope)
-            self._ps_comms[key] = comm
-        return self._ps_comms[key]
+        if scope is not None and \
+                not getattr(comm, "_params_inited", False):
+            comm.init_params(scope)
+            comm._params_inited = True
+        self._ps_comms[key] = comm
+        return comm
 
     def _check_nan_inf(self, fetch_names, fetches, new_states):
         """FLAGS_check_nan_inf (reference: operator.cc:1020

@@ -1,0 +1,40 @@
+"""2.0-era input helpers (reference: `python/paddle/fluid/input.py`):
+`fluid.one_hot` and `fluid.embedding` — the v2 kernels with the newer
+shape contract (no trailing-1 dimension games; both append their new
+axis to the id tensor's own shape)."""
+from __future__ import annotations
+
+from .layer_helper import LayerHelper
+from .layers.nn import _single
+
+
+def one_hot(input, depth, allow_out_of_range=False):
+    """Append a depth axis to `input`'s shape (reference input.py:24:
+    [N_1,...,N_k] -> [N_1,...,N_k, depth]) — the one_hot_v2 kernel;
+    layers.one_hot keeps the fluid-1.x trailing-1 contract instead.
+
+    Deviation: with allow_out_of_range=False the reference raises on an
+    out-of-range id; a data-dependent raise is impossible inside an XLA
+    program, so out-of-range ids produce all-zero rows in both modes
+    (the allow_out_of_range=True behavior)."""
+    return _single("one_hot_v2", {"X": [input]},
+                   {"depth": depth,
+                    "allow_out_of_range": bool(allow_out_of_range)},
+                   dtype="float32")
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    """Embedding lookup appending emb_size to the id tensor's shape
+    (reference input.py:130, the lookup_table_v2 kernel — unlike
+    fluid.layers.embedding's v1 op, a trailing [..., 1] ids axis is
+    KEPT: ids [N, 1] -> out [N, 1, emb])."""
+    helper = LayerHelper("embedding", param_attr=param_attr)
+    w = helper.create_parameter(helper.param_attr, shape=list(size),
+                                dtype=dtype)
+    pad = -1 if padding_idx is None else (
+        padding_idx if padding_idx >= 0 else size[0] + padding_idx)
+    return _single("lookup_table_v2", {"W": [w], "Ids": [input]},
+                   {"padding_idx": pad, "is_sparse": is_sparse,
+                    "is_distributed": is_distributed},
+                   dtype=dtype, helper=helper)

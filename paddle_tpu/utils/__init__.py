@@ -1,1 +1,2 @@
 from . import flags  # noqa: F401
+from . import plot  # noqa: F401

@@ -1,0 +1,65 @@
+"""paddle.utils.plot — training-curve plotting helper (reference:
+`python/paddle/utils/plot.py:33` Ploter). Data collection always
+works; rendering needs matplotlib and is skipped (like the reference's
+DISABLE_PLOT path) when it is unavailable or disabled."""
+from __future__ import annotations
+
+import os
+
+
+class PlotData:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.step = []
+        self.value = []
+
+    def append(self, step, value):
+        self.step.append(step)
+        self.value.append(value)
+
+
+class Ploter:
+    """Collect (step, value) series per title; `plot()` renders via
+    matplotlib when present (reference plot.py:33)."""
+
+    def __init__(self, *args):
+        self.__args__ = args
+        self.__plot_data__ = {t: PlotData() for t in args}
+        self.__disable_plot__ = os.environ.get("DISABLE_PLOT")
+        if not self.__plot_is_disabled__():
+            try:
+                import matplotlib.pyplot as plt
+
+                self.plt = plt
+            except ImportError:
+                self.__disable_plot__ = "True"
+
+    def __plot_is_disabled__(self):
+        return self.__disable_plot__ == "True"
+
+    def append(self, title, step, value):
+        assert isinstance(title, str)
+        assert title in self.__plot_data__
+        self.__plot_data__[title].append(step, value)
+
+    def plot(self, path=None):
+        if self.__plot_is_disabled__():
+            return
+        titles = []
+        for title in self.__args__:
+            data = self.__plot_data__[title]
+            if len(data.step) > 0:
+                self.plt.plot(data.step, data.value)
+                titles.append(title)
+        self.plt.legend(titles, loc="upper left")
+        if path is None:
+            self.plt.show()
+        else:
+            self.plt.savefig(path)
+        self.plt.clf()
+
+    def reset(self):
+        for data in self.__plot_data__.values():
+            data.reset()

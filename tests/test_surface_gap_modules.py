@@ -1,0 +1,207 @@
+"""Round-4 surface-gap modules (module-tree sweep vs the reference):
+fluid.input (one_hot/embedding), fluid.average, fluid.DataFeedDesc,
+fluid.communicator, fluid.evaluator, fluid.debugger, fleet.util,
+paddle.utils.plot."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu import fleet
+from paddle_tpu.fluid import framework
+
+
+def test_fluid_one_hot_and_embedding_train():
+    """reference input.py:24,130 — the 2.0-era input helpers build and
+    run."""
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            ids = fluid.layers.data("ids", shape=[4], dtype="int64")
+            oh = fluid.one_hot(ids, depth=7)
+            emb = fluid.embedding(ids, size=[7, 5])
+            s = fluid.layers.reduce_sum(oh) + fluid.layers.reduce_sum(
+                emb)
+            exe = fluid.Executor()
+            exe.run(startup)
+            out = exe.run(main,
+                          feed={"ids": np.array([[1, 2, 3, 6]],
+                                                "int64")},
+                          fetch_list=[oh, emb, s])
+    oh_v, emb_v = np.asarray(out[0]), np.asarray(out[1])
+    assert oh_v.shape[-1] == 7
+    assert oh_v.sum() == 4  # one hot per id
+    assert emb_v.shape[-2:] == (4, 5)
+
+
+def test_fluid_embedding_keeps_trailing_ids_axis():
+    """The v2 contract: ids [N, 1] -> out [N, 1, emb] (the v1
+    layers.embedding squeezes to [N, emb])."""
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            ids = fluid.layers.data("ids", shape=[1], dtype="int64")
+            v2 = fluid.embedding(ids, size=[9, 6])
+            v1 = fluid.layers.embedding(ids, size=[9, 6])
+            exe = fluid.Executor()
+            exe.run(startup)
+            out = exe.run(main,
+                          feed={"ids": np.array([[1], [2], [3]],
+                                                "int64")},
+                          fetch_list=[v2, v1])
+    assert np.asarray(out[0]).shape == (3, 1, 6)
+    assert np.asarray(out[1]).shape == (3, 6)
+
+
+def test_weighted_average():
+    with pytest.warns(Warning, match="deprecated"):
+        avg = fluid.average.WeightedAverage()
+    avg.add(value=2.0, weight=1)
+    avg.add(value=4.0, weight=2)
+    np.testing.assert_allclose(avg.eval(), 10.0 / 3.0)
+    avg.reset()
+    with pytest.raises(ValueError):
+        avg.eval()
+    with pytest.raises(ValueError):
+        avg.add(value="nope", weight=1)
+
+
+def test_data_feed_desc_roundtrip(tmp_path):
+    proto = tmp_path / "data.proto"
+    proto.write_text(
+        'name: "MultiSlotDataFeed"\n'
+        "batch_size: 2\n"
+        "multi_slot_desc {\n"
+        "    slots {\n"
+        '         name: "words"\n'
+        '         type: "uint64"\n'
+        "         is_dense: false\n"
+        "         is_used: false\n"
+        "     }\n"
+        "     slots {\n"
+        '         name: "label"\n'
+        '         type: "uint64"\n'
+        "         is_dense: false\n"
+        "         is_used: false\n"
+        "    }\n"
+        "}\n")
+    d = fluid.DataFeedDesc(str(proto))
+    assert d.slot_names() == ["words", "label"]
+    d.set_batch_size(128)
+    d.set_dense_slots(["words"])
+    d.set_use_slots(["words", "label"])
+    text = d.desc()
+    assert "batch_size: 128" in text
+    assert "is_dense: true" in text
+    # the printed text parses back identically
+    proto2 = tmp_path / "data2.proto"
+    proto2.write_text(text)
+    d2 = fluid.DataFeedDesc(str(proto2))
+    assert d2.batch_size == 128
+    assert d2._slot_by_name["words"].is_dense
+    assert d2._slot_by_name["label"].is_used
+    with pytest.raises(ValueError):
+        d.set_use_slots(["nope"])
+
+
+def test_communicator_requires_ps_program():
+    main = framework.Program()
+    with pytest.raises(ValueError, match="transpiled"):
+        fluid.communicator.Communicator(main)
+
+
+def test_evaluator_chunk_and_edit_distance():
+    with pytest.warns(Warning, match="deprecated"):
+        ce = fluid.evaluator.ChunkEvaluator()
+    ce.update(10, 8, 6)
+    p, r, f1 = ce.eval()
+    np.testing.assert_allclose([p, r], [0.6, 0.75])
+    with pytest.warns(Warning, match="deprecated"):
+        ed = fluid.evaluator.EditDistance()
+    ed.update(np.array([1.0, 0.0, 3.0]), 3)
+    dist, err = ed.eval()
+    np.testing.assert_allclose([dist, err], [4.0 / 3.0, 2.0 / 3.0])
+
+
+def test_evaluator_detection_map_accumulates():
+    with pytest.warns(Warning, match="deprecated"):
+        m = fluid.evaluator.DetectionMAP(class_num=2)
+    det = [[1, 0.9, 0.1, 0.1, 0.4, 0.4],
+           [1, 0.8, 0.5, 0.5, 0.9, 0.9],
+           [1, 0.7, 0.0, 0.0, 0.05, 0.05]]
+    lab = [[1, 0.1, 0.1, 0.4, 0.4], [1, 0.5, 0.5, 0.9, 0.9]]
+    m.update(det, [0, 1, 3], lab, [0, 1, 2])
+    np.testing.assert_allclose(m.eval(), 1.0, atol=1e-6)
+    # a second batch (one FP det, one missed gt): the ACCUMULATED
+    # ranking is FP(.95), TP(.9), TP(.8), FP(.7) over 3 gts ->
+    # integral AP = (1/3)*(1/2) + (1/3)*(2/3) = 0.38888 — a
+    # last-batch-only evaluation would report 0.0 instead
+    m.update([[1, 0.95, 0, 0, 0.05, 0.05]], [0, 1],
+             [[1, 0.5, 0.5, 0.9, 0.9]], [0, 1])
+    np.testing.assert_allclose(m.eval(), 0.388888, atol=1e-4)
+
+
+def test_debugger_pprint_and_dot(tmp_path):
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            y = fluid.layers.fc(x, 3)
+            fluid.layers.mean(y)
+    code = fluid.debugger.pprint_program_codes(main)
+    assert "fc" in code or "mul" in code
+    assert "var x" in code
+    dot = tmp_path / "g.dot"
+    fluid.debugger.draw_block_graphviz(main.global_block(),
+                                       highlights=["x"],
+                                       path=str(dot))
+    text = dot.read_text()
+    assert text.startswith("digraph G {") and '"v_x"' in text
+    assert "fillcolor=\"red\"" in text  # highlight applied
+
+
+def test_fleet_util_single_process_identities():
+    u = fleet.util
+    a = np.arange(4.0)
+    np.testing.assert_array_equal(u.all_reduce(a), a)
+    assert [g.tolist() for g in u.all_gather(a)] == [a.tolist()]
+    u.barrier()  # no-op without a group
+    files = ["f%d" % i for i in range(7)]
+    assert u.get_file_shard(files) == files  # 1 worker -> all files
+
+
+def test_fleet_util_file_shard_split():
+    u = fleet.util
+
+    class RM:
+        def worker_num(self):
+            return 3
+
+        def worker_index(self):
+            return self._i
+
+    rm = RM()
+    u._set_role_maker(rm)
+    try:
+        files = ["f%d" % i for i in range(7)]
+        shards = []
+        for i in range(3):
+            rm._i = i
+            shards.append(u.get_file_shard(files))
+        assert [len(s) for s in shards] == [3, 2, 2]
+        assert sum(shards, []) == files
+        with pytest.raises(TypeError):
+            u.get_file_shard("not-a-list")
+    finally:
+        u._set_role_maker(None)
+
+
+def test_utils_plot_collects_without_matplotlib(monkeypatch):
+    monkeypatch.setenv("DISABLE_PLOT", "True")
+    p = paddle.utils.plot.Ploter("train", "test")
+    p.append("train", 0, 1.0)
+    p.append("train", 1, 0.5)
+    assert p.__plot_data__["train"].value == [1.0, 0.5]
+    p.plot()  # disabled: must be a no-op, not a crash
+    p.reset()
+    assert p.__plot_data__["train"].value == []
