@@ -266,6 +266,10 @@ class PSCommunicator:
                 self._geo_snapshots[pname] = np.asarray(merged).copy()
 
     def complete(self):
+        # a completed communicator is dead: its sender thread is joined
+        # and its clients closed — consumers (the Executor cache) must
+        # build a fresh one rather than step this instance again
+        self._completed = True
         if self._ha_thread is not None:
             # flush pending half-async grads, then stop the sender
             self._ha_stop.set()

@@ -21,8 +21,15 @@ class Communicator:
             raise ValueError(
                 "Communicator needs a program transpiled for PS "
                 "training (DistributeTranspiler / strategy.a_sync)")
+        if mode is not None and mode != cfg["mode"]:
+            # the mode is baked into the transpiled program; accepting
+            # a different one here would silently run the other mode
+            raise ValueError(
+                "Communicator mode %r does not match the program's "
+                "transpiled mode %r — re-transpile with the mode you "
+                "want" % (mode, cfg["mode"]))
         self._program = program
-        self._mode = mode or cfg["mode"]
+        self._mode = cfg["mode"]
         self._comm = None
 
     def start(self):

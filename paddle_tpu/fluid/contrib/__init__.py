@@ -2,6 +2,9 @@
 from . import mixed_precision  # noqa: F401
 from . import layers  # noqa: F401
 from . import model_stats  # noqa: F401
+from . import model_stats as model_stat  # noqa: F401  (reference name)
+from . import op_frequence  # noqa: F401
+from .op_frequence import op_freq_statistic  # noqa: F401
 from . import slim  # noqa: F401
 from . import extend_optimizer  # noqa: F401
 from . import reader  # noqa: F401

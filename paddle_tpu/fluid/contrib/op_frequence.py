@@ -1,0 +1,49 @@
+"""Op-frequency statistics (reference:
+`python/paddle/fluid/contrib/op_frequence.py:23`): per-op-type counts
+and adjacent-pair counts over a Program — the profiling aid used to
+pick fusion candidates."""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+from ..framework import Program
+
+
+def op_freq_statistic(program):
+    """Returns (uni_op_freq, adj_2_op_freq): single-op counts and
+    producer->consumer adjacent-pair counts ("a->b"), both sorted by
+    frequency descending (reference op_frequence.py:23)."""
+    if not isinstance(program, Program):
+        raise TypeError("The input type should be Program. "
+                        "But you passed in %s" % (type(program),))
+
+    uni_op_freq = OrderedDict()
+    adj_2_op_freq = OrderedDict()
+    parameters = {p.name for p in program.global_block().all_parameters()}
+
+    var_gen_op = {}
+    for op in program.global_block().ops:
+        # single-op counts (ops writing only parameters don't count,
+        # matching the reference's skip of param-init noise)
+        recorded = False
+        for var_name in op.output_arg_names:
+            if var_name in parameters:
+                continue
+            if not recorded:
+                uni_op_freq[op.type] = uni_op_freq.get(op.type, 0) + 1
+                recorded = True
+        # adjacent pairs: producer of each non-param input -> this op
+        for var_name in op.input_arg_names:
+            if var_name in parameters:
+                continue
+            if var_name in var_gen_op and var_gen_op[var_name]:
+                key = "%s->%s" % (var_gen_op[var_name][-1], op.type)
+                adj_2_op_freq[key] = adj_2_op_freq.get(key, 0) + 1
+        for var_name in op.output_arg_names:
+            var_gen_op.setdefault(var_name, []).append(op.type)
+
+    uni = OrderedDict(sorted(uni_op_freq.items(),
+                             key=lambda kv: -kv[1]))
+    adj = OrderedDict(sorted(adj_2_op_freq.items(),
+                             key=lambda kv: -kv[1]))
+    return uni, adj

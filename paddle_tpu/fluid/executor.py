@@ -210,8 +210,18 @@ class Executor:
         # a user-started fluid.communicator.Communicator wins — even
         # over a previously cached instance, so start()/stop()/start()
         # cycles actually swap the communicator the steps use
-        comm = getattr(program, "_ps_comm", None) or \
-            self._ps_comms.get(key)
+        user_comm = getattr(program, "_ps_comm", None)
+        cached = self._ps_comms.get(key)
+        if user_comm is not None and cached is not None \
+                and cached is not user_comm \
+                and not getattr(cached, "_completed", False):
+            # don't abandon the replaced instance mid-flight: its
+            # half-async sender thread would keep pushing stale grads
+            cached.complete()
+        comm = user_comm or cached
+        if comm is not None and getattr(comm, "_completed", False):
+            # stop()'d/closed communicators are dead — never step them
+            comm = None
         if comm is None:
             from ..distributed.ps import PSCommunicator
 

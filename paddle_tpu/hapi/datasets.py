@@ -108,3 +108,118 @@ class SyntheticImages(Dataset):
 
     def __len__(self):
         return len(self.images)
+
+
+# -- filesystem-backed image folders (reference: hapi/datasets/folder.py
+# :60 DatasetFolder, :197 ImageFolder) --------------------------------
+
+IMG_EXTENSIONS = ('.jpg', '.jpeg', '.png', '.ppm', '.bmp', '.pgm',
+                  '.tif', '.tiff', '.webp', '.npy')
+
+
+def has_valid_extension(filename, extensions):
+    """Case-insensitive suffix check (reference folder.py:24)."""
+    return str(filename).lower().endswith(tuple(extensions))
+
+
+def default_loader(path):
+    """.npy -> ndarray directly (zero-egress test convenience);
+    anything else via PIL (reference folder.py cv2/PIL loader)."""
+    if str(path).lower().endswith(".npy"):
+        return np.load(path)
+    from PIL import Image
+
+    with open(path, "rb") as f:
+        img = Image.open(f)
+        return img.convert("RGB")
+
+
+def make_dataset(dir, class_to_idx, extensions=None,
+                 is_valid_file=None):
+    """(path, class_index) samples under per-class subdirs (reference
+    folder.py:37)."""
+    if (extensions is None) == (is_valid_file is None):
+        raise ValueError("exactly one of extensions / is_valid_file "
+                         "must be given")
+    if is_valid_file is None:
+        def is_valid_file(p):
+            return has_valid_extension(p, extensions)
+    samples = []
+    for target in sorted(class_to_idx):
+        d = os.path.join(dir, target)
+        if not os.path.isdir(d):
+            continue
+        for root, _, fnames in sorted(os.walk(d)):
+            for fname in sorted(fnames):
+                path = os.path.join(root, fname)
+                if is_valid_file(path):
+                    samples.append((path, class_to_idx[target]))
+    return samples
+
+
+class DatasetFolder(Dataset):
+    """Generic folder-of-class-subfolders dataset (reference
+    folder.py:60): root/class_x/xxx.ext -> (sample, class_index)."""
+
+    def __init__(self, root, loader=None, extensions=None,
+                 transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or default_loader
+        if extensions is None and is_valid_file is None:
+            extensions = IMG_EXTENSIONS
+        classes = sorted(d.name for d in os.scandir(root) if d.is_dir())
+        if not classes:
+            raise RuntimeError("no class folders under %r" % root)
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = make_dataset(root, self.class_to_idx,
+                                    extensions, is_valid_file)
+        if not self.samples:
+            raise RuntimeError("found 0 files under %r" % root)
+        self.targets = [t for _, t in self.samples]
+
+    def __getitem__(self, index):
+        path, target = self.samples[index]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat folder of images, no labels (reference folder.py:197)."""
+
+    def __init__(self, root, loader=None, extensions=None,
+                 transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or default_loader
+        if extensions is None and is_valid_file is None:
+            extensions = IMG_EXTENSIONS
+
+        def valid(p):
+            return (is_valid_file(p) if is_valid_file is not None
+                    else has_valid_extension(p, extensions))
+
+        samples = []
+        for rootd, _, fnames in sorted(os.walk(root)):
+            for fname in sorted(fnames):
+                p = os.path.join(rootd, fname)
+                if valid(p):
+                    samples.append(p)
+        if not samples:
+            raise RuntimeError("found 0 files under %r" % root)
+        self.samples = samples
+
+    def __getitem__(self, index):
+        sample = self.loader(self.samples[index])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)

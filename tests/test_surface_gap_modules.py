@@ -205,3 +205,88 @@ def test_utils_plot_collects_without_matplotlib(monkeypatch):
     p.plot()  # disabled: must be a no-op, not a crash
     p.reset()
     assert p.__plot_data__["train"].value == []
+
+
+def test_op_freq_statistic():
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            h = fluid.layers.fc(x, 3, act="relu")
+            fluid.layers.mean(h)
+    uni, adj = fluid.contrib.op_freq_statistic(main)
+    assert uni.get("relu", 0) >= 1
+    assert any(k.endswith("->mean") for k in adj), adj
+    with pytest.raises(TypeError):
+        fluid.contrib.op_freq_statistic("not a program")
+    # reference-name alias for the model-stat module
+    assert fluid.contrib.model_stat is fluid.contrib.model_stats
+
+
+def test_dataset_folder_and_image_folder(tmp_path):
+    """reference hapi/datasets/folder.py:60,197 — filesystem-backed
+    datasets; .npy samples keep the test image-codec-free."""
+    from paddle_tpu.hapi import datasets
+
+    for cls in ("cat", "dog"):
+        d = tmp_path / "train" / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            np.save(str(d / ("%d.npy" % i)),
+                    np.full((4, 4), ord(cls[0]) + i, "float32"))
+    ds = datasets.DatasetFolder(str(tmp_path / "train"))
+    assert ds.classes == ["cat", "dog"]
+    assert len(ds) == 6
+    sample, target = ds[0]
+    assert sample.shape == (4, 4) and target == 0
+    assert ds.targets.count(1) == 3
+
+    flat = datasets.ImageFolder(str(tmp_path / "train"))
+    assert len(flat) == 6
+    (s0,) = flat[0]
+    assert s0.shape == (4, 4)
+
+    seen = datasets.DatasetFolder(
+        str(tmp_path / "train"),
+        transform=lambda a: a * 0 + 7)
+    np.testing.assert_array_equal(seen[2][0], np.full((4, 4), 7.0))
+
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(RuntimeError):
+        datasets.DatasetFolder(str(empty))  # no class subfolders
+
+
+def test_communicator_lifecycle(tmp_path):
+    """start/stop lifecycle semantics: stop() completes the instance
+    (dead - the executor must never step it again), mode mismatch is
+    rejected, restart builds a fresh communicator."""
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            y = fluid.layers.fc(x, 2)
+            loss = fluid.layers.mean(y)
+            fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    t = fluid.DistributeTranspiler()
+    t.transpile(0, program=main, pservers="127.0.0.1:1,127.0.0.1:2",
+                trainers=2, sync_mode=False, startup_program=startup)
+
+    with pytest.raises(ValueError, match="does not match"):
+        fluid.communicator.Communicator(main, mode="geo")
+
+    c = fluid.communicator.Communicator(main)
+    assert not c.is_running()
+    c.start()
+    assert c.is_running()
+    first = main._ps_comm
+    assert first is not None
+    c.stop()
+    assert not c.is_running()
+    assert main._ps_comm is None
+    assert getattr(first, "_completed", False) is True
+
+    c2 = fluid.communicator.Communicator(main)
+    c2.start()
+    assert main._ps_comm is not first  # fresh instance after restart
+    c2.stop()
