@@ -13,10 +13,12 @@ from .callbacks import (  # noqa: F401
 )
 from .metrics import Metric, Accuracy  # noqa: F401
 from . import datasets  # noqa: F401
+from .distributed import DistributedBatchSampler  # noqa: F401
 
 __all__ = [
     "Model", "Input", "Callback", "ProgBarLogger", "ModelCheckpoint",
     "EarlyStopping", "LRScheduler", "Metric", "Accuracy", "datasets",
+    "DistributedBatchSampler",
 ]
 from . import vision  # noqa: F401,E402
 from . import text  # noqa: F401,E402

@@ -1,0 +1,87 @@
+"""hapi.distributed — DistributedBatchSampler (reference:
+`python/paddle/incubate/hapi/distributed.py:36`): each rank iterates a
+disjoint, padded-to-even subset of the dataset so data-parallel hapi
+training sees the whole dataset exactly once per epoch across ranks.
+Rank/nranks come from the trainer env (`parallel/env.py`, the same
+PADDLE_* contract the launcher sets)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..fluid.reader import BatchSampler
+
+
+class DistributedBatchSampler(BatchSampler):
+    """Deterministic per-rank subsampling: indices are padded by
+    wrap-around to nranks*num_samples, optionally shuffled with the
+    epoch as the seed (identical permutation on every rank), then each
+    rank takes its interleaved batch-size slices (reference
+    distributed.py:107 _get_indices_by_batch_size)."""
+
+    def __init__(self, dataset, batch_size, shuffle=False,
+                 drop_last=False):
+        assert isinstance(batch_size, int) and batch_size > 0, \
+            "batch_size should be a positive integer"
+        assert isinstance(shuffle, bool), \
+            "shuffle should be a boolean value"
+        assert isinstance(drop_last, bool), \
+            "drop_last should be a boolean number"
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+
+        from ..parallel import env as penv
+
+        self.nranks = max(1, penv.trainer_num())
+        self.local_rank = penv.trainer_id()
+        self.epoch = 0
+        self.num_samples = int(
+            math.ceil(len(dataset) * 1.0 / self.nranks))
+        self.total_size = self.num_samples * self.nranks
+
+    def set_epoch(self, epoch):
+        """Pin the shuffle seed for resumable training (reference
+        contract: same epoch -> same permutation on every rank)."""
+        self.epoch = int(epoch)
+
+    def _local_indices(self):
+        n = len(self.dataset)
+        indices = list(range(n))
+        indices += indices[:self.total_size - n]  # wrap-around pad
+        assert len(indices) == self.total_size
+        if self.shuffle:
+            np.random.RandomState(self.epoch).shuffle(indices)
+            self.epoch += 1
+
+        out = []
+        last = self.total_size % (self.batch_size * self.nranks)
+        assert last % self.nranks == 0
+        last_local = last // self.nranks
+        for i in range(self.local_rank * self.batch_size,
+                       self.total_size - last,
+                       self.batch_size * self.nranks):
+            out.extend(indices[i:i + self.batch_size])
+        tail = indices[self.total_size - last:]
+        out.extend(tail[self.local_rank * last_local:
+                        (self.local_rank + 1) * last_local])
+        return out
+
+    def __iter__(self):
+        idx = self._local_indices()
+        batch = []
+        for i in idx:
+            batch.append(i)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) \
+            // self.batch_size

@@ -290,3 +290,43 @@ def test_communicator_lifecycle(tmp_path):
     c2.start()
     assert main._ps_comm is not first  # fresh instance after restart
     c2.stop()
+
+
+def test_distributed_batch_sampler_partitions_dataset(monkeypatch):
+    """reference hapi/distributed.py:36 — ranks see disjoint subsets
+    covering the (padded) dataset; same-epoch shuffles agree across
+    ranks."""
+    from paddle_tpu.hapi.distributed import DistributedBatchSampler
+    from paddle_tpu.parallel import env as penv
+
+    class DS:
+        def __len__(self):
+            return 10
+
+    monkeypatch.setattr(penv, "trainer_num", lambda: 4)
+    rank_batches = {}
+    for rank in range(4):
+        monkeypatch.setattr(penv, "trainer_id", lambda r=rank: r)
+        s = DistributedBatchSampler(DS(), batch_size=2)
+        rank_batches[rank] = [i for b in s for i in b]
+        assert len(s) == 2  # ceil(ceil(10/4)/2)
+    all_idx = sum(rank_batches.values(), [])
+    # 12 padded slots (10 + 2 wrap-around), each rank 3
+    assert len(all_idx) == 12
+    assert set(all_idx) == set(range(10))
+    assert all(len(v) == 3 for v in rank_batches.values())
+    # disjoint before padding: the two wrapped indices are 0 and 1
+    from collections import Counter
+
+    c = Counter(all_idx)
+    assert c[0] == 2 and c[1] == 2
+    assert all(c[i] == 1 for i in range(2, 10))
+
+    # same epoch -> identical permutation on every rank
+    monkeypatch.setattr(penv, "trainer_id", lambda: 0)
+    a = DistributedBatchSampler(DS(), batch_size=2, shuffle=True)
+    a.set_epoch(5)
+    seq_a = [i for b in a for i in b]
+    b_ = DistributedBatchSampler(DS(), batch_size=2, shuffle=True)
+    b_.set_epoch(5)
+    assert seq_a == [i for bb in b_ for i in bb]
