@@ -19,6 +19,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from .. import framework
+from ...core.rng import make_key as _mk_key
 from ...core.types import normalize_dtype, to_numpy_dtype
 from ...core.selected_rows import SelectedRows, sr_add
 from ... import ops as ops_lib
@@ -36,10 +37,8 @@ class Tracer:
         self._seed_counter = np.random.randint(0, 2**31 - 1)
 
     def next_rng_key(self):
-        import jax
-
         self._seed_counter += 1
-        return jax.random.PRNGKey(self._seed_counter % (2**31 - 1))
+        return _mk_key(self._seed_counter % (2**31 - 1))
 
     def record(self, entry):
         if self._has_grad:
@@ -440,7 +439,7 @@ class BackwardEngine:
                              in_shapes, entry.out_slots,
                              entry.rng_key is not None)
                 key = entry.rng_key if entry.rng_key is not None else \
-                    jax.random.PRNGKey(0)
+                    _mk_key(0)
                 in_grads = fn([t._val for t in entry.in_tensors], key,
                               cotangents)
             for t, g in zip(entry.in_tensors, in_grads):

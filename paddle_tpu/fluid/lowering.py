@@ -29,6 +29,7 @@ import numpy as np
 
 from . import framework
 from .. import ops as ops_lib
+from ..core.rng import make_key
 from ..core.types import to_numpy_dtype
 
 # Ops that exist only for runtime bookkeeping in the reference; under XLA
@@ -555,7 +556,7 @@ def build_block_fn(program, block, feed_names, fetch_names,
         env.update(states_ro)
         env.update(states_mut)
         env.update(feeds)
-        key0 = jax.random.PRNGKey(seed)
+        key0 = make_key(seed)
 
         if bwd_idx is None:
             _run_ops(ops, env, key0, amp_lists=amp_lists)

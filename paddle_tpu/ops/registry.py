@@ -98,10 +98,9 @@ def run_op(type_: str, ins: Dict[str, list], attrs: dict) -> Dict[str, list]:
     op = get_op(type_)
     attrs = dict(attrs)
     if op.needs_rng and "_rng_key" not in attrs:
-        import jax
+        from ..core.rng import make_key
 
-        attrs["_rng_key"] = jax.random.PRNGKey(
-            np.random.randint(0, 2**31 - 1))
+        attrs["_rng_key"] = make_key(np.random.randint(0, 2**31 - 1))
     return normalize_outs(op.compute(ins, attrs))
 
 
@@ -144,7 +143,9 @@ def infer_outputs(type_: str, input_specs: Dict[str, list], attrs: dict):
             }
             run_attrs = dict(attrs)
             if op.needs_rng:
-                run_attrs["_rng_key"] = jax.random.PRNGKey(0)
+                from ..core.rng import make_key
+
+                run_attrs["_rng_key"] = make_key(0)
             return normalize_outs(op.compute(zeros, run_attrs))
 
         outs = probe(_DYN_SENTINEL)
@@ -183,8 +184,6 @@ def infer_outputs(type_: str, input_specs: Dict[str, list], attrs: dict):
         for slot, specs in input_specs.items()
     }
     run_attrs = dict(attrs)
-    if op.needs_rng:
-        run_attrs["_rng_key"] = jax.ShapeDtypeStruct((2,), np.uint32)
 
     def fn(tree_ins, key):
         a = dict(run_attrs)
@@ -192,7 +191,12 @@ def infer_outputs(type_: str, input_specs: Dict[str, list], attrs: dict):
             a["_rng_key"] = key
         return normalize_outs(op.compute(tree_ins, a))
 
-    key_struct = jax.ShapeDtypeStruct((2,), np.uint32)
+    # a typed key from the SAME impl runtime tracing uses — a raw
+    # uint32[2] struct here only worked through JAX's legacy raw-key
+    # acceptance and diverges from the rbg path
+    from ..core.rng import make_key
+
+    key_struct = jax.eval_shape(lambda: make_key(0))
     out_struct = jax.eval_shape(fn, struct_ins, key_struct)
 
     from ..core.types import normalize_dtype
@@ -253,7 +257,9 @@ def eager_run(type_: str, ins: Dict[str, list], attrs: dict, rng_key=None):
     attr_items = tuple(sorted((k, _hashable_attr(v)) for k, v in attrs.items()
                               if not k.startswith("_")))
     if op.needs_rng and rng_key is None:
-        rng_key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        from ..core.rng import make_key
+
+        rng_key = make_key(np.random.randint(0, 2**31 - 1))
     if op.no_jit:
         ins_l = {slot: list(vals) for slot, vals in ins.items()}
         a = dict(attrs)

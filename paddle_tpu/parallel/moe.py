@@ -45,7 +45,9 @@ class MoEConfig:
 def init_moe_params(cfg: MoEConfig, seed=0):
     import jax
 
-    k = jax.random.PRNGKey(seed)
+    from ..core.rng import make_key
+
+    k = make_key(seed)
     kg, k1, k2 = jax.random.split(k, 3)
     scale = 1.0 / np.sqrt(cfg.d_model)
     return {

@@ -26,6 +26,7 @@ from typing import Dict, List
 
 import numpy as np
 
+from ..core.rng import make_key
 from ..fluid import framework
 from ..fluid.framework import grad_var_name
 
@@ -228,7 +229,7 @@ def compile_pipeline(program, block, feed_specs, fetch_names, state_specs):
     for s in range(S):
         def one_stage(env_in, _s=s):
             e = dict(env_in)
-            run_stage(_s, e, jax.random.PRNGKey(0))
+            run_stage(_s, e, make_key(0))
             return e
 
         env_struct = jax.eval_shape(one_stage, env_struct)
@@ -267,7 +268,7 @@ def compile_pipeline(program, block, feed_specs, fetch_names, state_specs):
         env0 = {}
         env0.update(states_ro)
         env0.update(states_mut)
-        key0 = jax.random.PRNGKey(seed)
+        key0 = make_key(seed)
 
         # [n_micro, dp*mb, ...] microbatched feeds; shard_map splits the
         # second axis over 'dp' so each replica sees [n_micro, mb, ...]

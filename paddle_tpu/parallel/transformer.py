@@ -124,7 +124,9 @@ def param_specs(cfg):
 def init_params(cfg, seed=0):
     import jax
 
-    k = jax.random.PRNGKey(seed)
+    from ..core.rng import make_key
+
+    k = make_key(seed)
     ks = jax.random.split(k, 8)
     D, F, V, S = cfg.d_model, cfg.d_ff, cfg.vocab, cfg.seq_len
     P_, L = cfg.pp, cfg.layers_per_stage
@@ -354,7 +356,9 @@ def make_train_step(cfg, mesh, with_grads=False):
         stage = lax.axis_index("pp")
 
         def fwd_loss(p):
-            key = jax.random.PRNGKey(0)
+            from ..core.rng import make_key
+
+            key = make_key(0)
 
             def pipe_body(carry, t):
                 state, loss_acc = carry

@@ -240,13 +240,25 @@ def main():
         else:
             raise SystemExit("unknown argument: %s" % a)
         i += 1
+    # lower the program the TPU bench would run: on chip
+    # FLAGS_prng_impl=auto resolves to the hardware RngBitGenerator
+    # (core/rng.py), so the analysis must force it here on the CPU
+    # backend or the census would count threefry's extra ALU ops
+    from paddle_tpu.utils.flags import set_flags
+
+    set_flags({"FLAGS_prng_impl": "rbg"})
     report = ["# PERF_ANALYSIS (round 4)", "",
-              "TPU tunnel down all round (see .capture_log): this is "
-              "the VERDICT-prescribed fallback evidence — "
+              "VERDICT-prescribed fallback evidence while the TPU "
+              "tunnel is down (see .capture_log): "
               "`jax.jit(...).lower()` StableHLO + analytical "
               "FLOPs/bytes/HBM-peak for the EXACT bench train step "
               "(BERT-base seq128 bf16 AMP Adam, fused "
-              "linear-softmax-xent head, models/bert.py:176).", ""]
+              "linear-softmax-xent head, models/bert.py:176; PRNG = "
+              "rbg hardware bit-generator, FLAGS_prng_impl auto-on-TPU "
+              "— core/rng.py). Switching dropout keys from threefry to "
+              "rbg cut XLA cost-analysis bytes/step 28-31%% (b256: "
+              "2603->1884 GB, b512: 9356->6479 GB) on this "
+              "bandwidth-bound step.", ""]
     for batch in batches:
         t0 = time.time()
         (cfg, n_params, entry, feeds, smut, sro) = build_step(batch)
