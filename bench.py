@@ -208,6 +208,11 @@ def _save_export(entry, feed, model: str, platform: str,
     import numpy as np
 
     def aval(v):
+        # scope vars are device arrays: read shape/dtype directly —
+        # np.asarray here copied EVERY param device->host (0.5+ GB
+        # through the tunnel) just to build a ShapeDtypeStruct
+        if hasattr(v, "shape") and hasattr(v, "dtype"):
+            return jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
         a = np.asarray(v)
         return jax.ShapeDtypeStruct(a.shape, a.dtype)
 

@@ -265,11 +265,9 @@ def main():
         lowered = entry.jitted.lower(
             {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
              for k, v in feeds.items()},
-            {k: jax.ShapeDtypeStruct(np.asarray(v).shape,
-                                     np.asarray(v).dtype)
+            {k: jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
              for k, v in smut.items()},
-            {k: jax.ShapeDtypeStruct(np.asarray(v).shape,
-                                     np.asarray(v).dtype)
+            {k: jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
              for k, v in sro.items()},
             np.uint32(0))
         text = lowered.as_text()
@@ -348,11 +346,9 @@ def main():
         lowered = entry.jitted.lower(
             {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
              for k, v in feeds.items()},
-            {k: jax.ShapeDtypeStruct(np.asarray(v).shape,
-                                     np.asarray(v).dtype)
+            {k: jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
              for k, v in smut.items()},
-            {k: jax.ShapeDtypeStruct(np.asarray(v).shape,
-                                     np.asarray(v).dtype)
+            {k: jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
              for k, v in sro.items()},
             np.uint32(0))
         text = lowered.as_text()
