@@ -51,7 +51,7 @@ from .detection import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .control_flow import (  # noqa: F401
-    While, while_loop, cond, case, switch_case, increment,
+    Scan, While, while_loop, cond, case, switch_case, increment,
     less_than, less_equal, greater_than, greater_equal, equal, not_equal,
     Print, Assert, StaticRNN, is_empty, reorder_lod_tensor_by_rank,
 )

@@ -238,16 +238,24 @@ def framework_attr_for(name):
 def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
                epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
                name=None):
+    """scale/shift accept a Variable to normalize with EXISTING affine
+    vars instead of creating parameters — the scan-over-layers body
+    passes per-iteration slices of stacked [L, H] scale/bias params
+    (layers.Scan)."""
     helper = LayerHelper("layer_norm", param_attr=param_attr,
                          bias_attr=bias_attr, act=act, name=name)
     norm_shape = [int(np.prod(input.shape[begin_norm_axis:]))]
     inputs = {"X": [input]}
-    if scale:
+    if isinstance(scale, Variable):
+        inputs["Scale"] = [scale]
+    elif scale:
         s = helper.create_parameter(
             helper.param_attr, shape=norm_shape, dtype=input.dtype,
             default_initializer=ConstantInitializer(1.0))
         inputs["Scale"] = [s]
-    if shift:
+    if isinstance(shift, Variable):
+        inputs["Bias"] = [shift]
+    elif shift:
         b = helper.create_parameter(helper.bias_attr, shape=norm_shape,
                                     dtype=input.dtype, is_bias=True)
         inputs["Bias"] = [b]

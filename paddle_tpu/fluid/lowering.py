@@ -88,7 +88,9 @@ def _op_reads_writes(op):
     prog = op.block.program
     for bi in _sub_block_idxs(op):
         blk = prog.block(bi)
-        produced_local = set()
+        # scan xs slices are produced by the loop machinery itself, not
+        # by any sub-block op — they are never external reads
+        produced_local = set(op.attrs.get("xs_slice", []))
         for sop in blk.ops:
             sr, sw = _op_reads_writes(sop)
             for n in sr:
@@ -149,6 +151,8 @@ def _exec_op(op, env, key0, op_idx, amp_lists=None):
         return
     if t == "while":
         return _exec_while(op, env, key0, op_idx, amp_lists)
+    if t == "scan":
+        return _exec_scan(op, env, key0, op_idx, amp_lists)
     if t == "cond":
         return _exec_cond(op, env, key0, op_idx, amp_lists)
     if t == "switch_case":
@@ -332,6 +336,53 @@ def _exec_while(op, env, key0, op_idx, amp_lists):
 
     init = (jnp.int32(0),) + tuple(env[n] for n in carry_names)
     final = lax.while_loop(cond_f, body_f, init)
+    env.update(zip(carry_names, final[1:]))
+
+
+def _exec_scan(op, env, key0, op_idx, amp_lists):
+    """`scan` op -> jax.lax.scan: fixed-trip loop whose body is traced
+    and compiled ONCE regardless of depth — the TPU-native way to build
+    deep identical-layer stacks (12-layer BERT encoder: one body in the
+    HLO instead of 12 clones). Carry contract is the While contract
+    (sub-block writes to pre-existing vars are threaded functionally);
+    per-iteration slices of the stacked inputs arrive as scan xs; with
+    attrs['remat'] the body is wrapped in jax.checkpoint, giving
+    activation recompute per layer without RecomputeOptimizer's
+    segment machinery. Reverse-mode grads fall out of the ordinary
+    jax.vjp over lax.scan (no recurrent_grad op — contrast
+    reference recurrent_op.cc's scope-mutation step loop)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    prog = op.block.program
+    sub = prog.block(op.attrs["sub_block"])
+    n = int(op.attrs["n"])
+    xs_stacked = list(op.attrs.get("xs_stacked", []))
+    xs_slice = list(op.attrs.get("xs_slice", []))
+    carry_names = _sub_block_carry(sub, env)
+    if not carry_names:
+        raise RuntimeError(
+            "scan: the body never rebinds a pre-existing var — every "
+            "iteration's results would be discarded. Rebind the carry "
+            "with layers.assign(new_val, output=carried_var).")
+    base_key = jax.random.fold_in(key0, op_idx)
+
+    def body(carry, xs):
+        it = carry[0]
+        e = dict(env)
+        e.update(zip(carry_names, carry[1:]))
+        e.update(zip(xs_slice, xs))
+        # per-iteration rng so dropout masks differ across layers
+        _run_ops(sub.ops, e, jax.random.fold_in(base_key, it),
+                 amp_lists=amp_lists)
+        return ((it + 1,) + tuple(e[nm] for nm in carry_names)), None
+
+    if op.attrs.get("remat"):
+        body = jax.checkpoint(body)
+    init = (jnp.int32(0),) + tuple(env[nm] for nm in carry_names)
+    xs = tuple(env[nm] for nm in xs_stacked)
+    final, _ = lax.scan(body, init, xs, length=n)
     env.update(zip(carry_names, final[1:]))
 
 

@@ -29,6 +29,10 @@ class FlattenLinear(paddle.nn.Layer):
 
 @pytest.fixture
 def prepared_model():
+    # layer init and DataLoader shuffling both draw from numpy's global
+    # RNG (dygraph tracer seed counter, reader.py np.random.shuffle);
+    # an unlucky draw made fit's 3-epoch loss-decrease assertion flaky
+    np.random.seed(1234)
     m = make_model()
     opt = paddle.fluid.optimizer.AdamOptimizer(learning_rate=1e-2)
     m.prepare(optimizer=opt,

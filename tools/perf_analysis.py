@@ -49,16 +49,14 @@ def build_step(batch):
     main_p, startup_p = framework.Program(), framework.Program()
     with framework.program_guard(main_p, startup_p):
         with framework.unique_name_guard():
-            ckpts = []
+            # mirror bench.py: scan-over-layers encoder, per-layer
+            # recompute inside the scan at batch >= 384
             total, mlm, nsp, feeds = bert.bert_pretrain_loss(
-                cfg, SEQ_LEN, is_test=False, checkpoints_out=ckpts)
-            base_opt = fluid.optimizer.AdamOptimizer(learning_rate=1e-4)
-            if batch >= 384:  # mirror bench.py's big-batch remat path
-                rec = fluid.optimizer.RecomputeOptimizer(base_opt)
-                rec._set_checkpoints(ckpts)
-                base_opt = rec
+                cfg, SEQ_LEN, is_test=False, scan_layers=True,
+                scan_remat=batch >= 384)
             opt = mixed_precision.decorate(
-                base_opt, use_dynamic_loss_scaling=False)
+                fluid.optimizer.AdamOptimizer(learning_rate=1e-4),
+                use_dynamic_loss_scaling=False)
             opt.minimize(total)
             n_params = sum(int(np.prod(p.shape))
                            for p in main_p.all_parameters())
