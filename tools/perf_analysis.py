@@ -297,18 +297,12 @@ def main():
             flops = cost.get("flops", 0.0)
             bts = cost.get("bytes accessed", 0.0)
             report += [
-                "- XLA cost analysis: %.2f TFLOP/step, %.2f GB accessed"
-                % (flops / 1e12, bts / 1e9),
+                "- XLA cost analysis: %.2f TFLOP/step, %.2f GB accessed "
+                "(NOTE: with the scan-over-layers encoder XLA counts "
+                "the scan BODY once, not x%d iterations — use the "
+                "analytical FLOPs below for per-step totals)"
+                % (flops / 1e12, bts / 1e9, cfg.num_hidden_layers),
             ]
-            if flops:
-                report += [
-                    "- arithmetic intensity %.0f FLOP/byte (v5e "
-                    "ridge: %.0f) -> %s-bound at peak" % (
-                        flops / max(bts, 1),
-                        V5E_PEAK_BF16 / V5E_HBM_BW,
-                        "compute" if flops / max(bts, 1)
-                        > V5E_PEAK_BF16 / V5E_HBM_BW else "bandwidth"),
-                ]
         report += [
             "- analytical train FLOPs: %.2f TFLOP/step -> ideal %.0fk "
             "tok/s at 100%% MFU; >=45%% MFU target = %.0fk tok/s" % (
