@@ -112,8 +112,12 @@ def _positional_encoding(max_len, d_model):
     return pe
 
 
-def encoder(src_ids, src_bias, cfg, is_test=False):
+def encoder(src_ids, src_bias, cfg, is_test=False, scan_layers=False,
+            scan_remat=False):
     x = _embed(src_ids, cfg.src_vocab, cfg, "src_word_emb")
+    if scan_layers:
+        return _scan_stack(x, cfg, "enc", is_test, self_bias=src_bias,
+                           remat=scan_remat)
     for i in range(cfg.n_layer):
         nm = "enc_%d" % i
         attn = _attention(x, x, src_bias, cfg, nm + "_selfattn", is_test)
@@ -123,8 +127,14 @@ def encoder(src_ids, src_bias, cfg, is_test=False):
     return x
 
 
-def decoder(tgt_ids, enc_out, self_bias, cross_bias, cfg, is_test=False):
+def decoder(tgt_ids, enc_out, self_bias, cross_bias, cfg, is_test=False,
+            scan_layers=False, scan_remat=False):
     x = _embed(tgt_ids, cfg.tgt_vocab, cfg, "tgt_word_emb")
+    if scan_layers:
+        x = _scan_stack(x, cfg, "dec", is_test, self_bias=self_bias,
+                        cross_kv=enc_out, cross_bias=cross_bias,
+                        remat=scan_remat)
+        return _proj(x, cfg.tgt_vocab, "dec_out_proj")
     for i in range(cfg.n_layer):
         nm = "dec_%d" % i
         attn = _attention(x, x, self_bias, cfg, nm + "_selfattn", is_test)
@@ -137,9 +147,115 @@ def decoder(tgt_ids, enc_out, self_bias, cross_bias, cfg, is_test=False):
     return _proj(x, cfg.tgt_vocab, "dec_out_proj")
 
 
+def _scan_stack(x, cfg, prefix, is_test, self_bias=None, cross_kv=None,
+                cross_bias=None, remat=False):
+    """Encoder/decoder layer stack as ONE layers.Scan over stacked
+    [L, ...] params (see models/bert._scan_encoder_stack). Stacked
+    names mirror the unrolled ones with the layer index replaced by
+    'stack' (enc_0_selfattn_q.w -> enc_stack_selfattn_q.w [L, d, d]),
+    so beam_search_decode can expand them back per layer."""
+    from ..fluid.layers import Scan
+
+    L, d, f = cfg.n_layer, cfg.d_model, cfg.d_ff
+    d_head = d // cfg.n_head
+    zeros = fluid.initializer.Constant(0.0)
+    ones = fluid.initializer.Constant(1.0)
+
+    def par(suffix, shape, init=None):
+        name = "%s_stack%s" % (prefix, suffix)
+        if init is None and len(shape) == 3:
+            # Xavier fan must come from the per-LAYER 2D slice, not the
+            # stacked 3D shape (which would under-scale the init ~16x
+            # vs the unrolled path this stack is weight-parity with)
+            init = fluid.initializer.Xavier(
+                uniform=True, fan_in=shape[1], fan_out=shape[2])
+        return layers.create_parameter(
+            shape=shape, dtype="float32", name=name,
+            attr=ParamAttr(name=name, initializer=init or _init()))
+
+    def attn_pack(kind):
+        return {p: (par("%s_%s.w" % (kind, p), [L, d, d]),
+                    par("%s_%s.b" % (kind, p), [L, d], zeros))
+                for p in ("q", "k", "v", "o")}
+
+    packs = {"_selfattn": attn_pack("_selfattn")}
+    lns = [("_ln0", par("_ln0.scale", [L, d], ones),
+            par("_ln0.bias", [L, d], zeros)),
+           ("_ln1", par("_ln1.scale", [L, d], ones),
+            par("_ln1.bias", [L, d], zeros))]
+    if cross_kv is not None:
+        packs["_crossattn"] = attn_pack("_crossattn")
+        lns.append(("_ln2", par("_ln2.scale", [L, d], ones),
+                    par("_ln2.bias", [L, d], zeros)))
+    w_f0 = par("_ffn_fc0.w", [L, d, f])
+    b_f0 = par("_ffn_fc0.b", [L, f], zeros)
+    w_f1 = par("_ffn_fc1.w", [L, f, d])
+    b_f1 = par("_ffn_fc1.b", [L, d], zeros)
+
+    scan = Scan(n=L, remat=remat)
+    with scan.block():
+        sl = {}
+        for kind, pk in packs.items():
+            sl[kind] = {p: (scan.slice_input(w), scan.slice_input(b))
+                        for p, (w, b) in pk.items()}
+        ln_sl = [(nm, scan.slice_input(s), scan.slice_input(b))
+                 for nm, s, b in lns]
+        f0w, f0b = scan.slice_input(w_f0), scan.slice_input(b_f0)
+        f1w, f1b = scan.slice_input(w_f1), scan.slice_input(b_f1)
+
+        def proj(inp, w, b):
+            return layers.elementwise_add(layers.matmul(inp, w), b)
+
+        def heads(t):
+            t = layers.reshape(t, [0, 0, cfg.n_head, d_head])
+            return layers.transpose(t, [0, 2, 1, 3])
+
+        # same hand-rolled softmax(QK^T+bias)V as the unrolled
+        # _attention (weight-parity contract); the fused
+        # scaled_dot_product_attention path only changes the lowering
+        # at seq >= FLAGS_flash_attention_min_seq (4096), far above
+        # WMT's max_len — below it XLA materializes scores either way
+        def attn(q_in, kv_in, bias, kind):
+            s = sl[kind]
+            q = heads(proj(q_in, *s["q"]))
+            k = heads(proj(kv_in, *s["k"]))
+            v = heads(proj(kv_in, *s["v"]))
+            scores = layers.matmul(q, k, transpose_y=True,
+                                   alpha=1.0 / math.sqrt(d_head))
+            if bias is not None:
+                scores = layers.elementwise_add(scores, bias)
+            probs = layers.softmax(scores)
+            if cfg.dropout and not is_test:
+                probs = layers.dropout(
+                    probs, cfg.dropout, is_test=is_test,
+                    dropout_implementation="upscale_in_train")
+            ctx = layers.transpose(layers.matmul(probs, v), [0, 2, 1, 3])
+            ctx = layers.reshape(ctx, [0, 0, d])
+            return proj(ctx, *s["o"])
+
+        def ln_i(inp, i):
+            _, s, b = ln_sl[i]
+            return layers.layer_norm(inp, begin_norm_axis=2, scale=s,
+                                     shift=b)
+
+        y = ln_i(layers.elementwise_add(
+            x, attn(x, x, self_bias, "_selfattn")), 0)
+        nxt = 1
+        if cross_kv is not None:
+            y = ln_i(layers.elementwise_add(
+                y, attn(y, cross_kv, cross_bias, "_crossattn")), 1)
+            nxt = 2
+        ffn = layers.elementwise_add(
+            layers.matmul(layers.relu(proj(y, f0w, f0b)), f1w), f1b)
+        new_x = ln_i(layers.elementwise_add(y, ffn), nxt)
+        layers.assign(new_x, output=x)
+    return x
+
+
 def build_transformer_train(cfg=None, src_len=32, tgt_len=32, lr=1e-3,
                             warmup=4000, label_smooth_eps=0.1,
-                            is_test=False):
+                            is_test=False, scan_layers=False,
+                            scan_remat=False):
     """Teacher-forced training graph. Returns (avg_loss, feeds)."""
     cfg = cfg or TransformerConfig()
     src = layers.data(name="src_ids", shape=[src_len], dtype="int64")
@@ -160,8 +276,10 @@ def build_transformer_train(cfg=None, src_len=32, tgt_len=32, lr=1e-3,
     self_bias = layers.elementwise_add(pad_bias, causal_var)
     cross_bias = src_bias
 
-    enc_out = encoder(src, src_bias, cfg, is_test)
-    logits = decoder(tgt, enc_out, self_bias, cross_bias, cfg, is_test)
+    enc_out = encoder(src, src_bias, cfg, is_test,
+                      scan_layers=scan_layers, scan_remat=scan_remat)
+    logits = decoder(tgt, enc_out, self_bias, cross_bias, cfg, is_test,
+                     scan_layers=scan_layers, scan_remat=scan_remat)
 
     if label_smooth_eps:
         oh = layers.one_hot(layers.unsqueeze(lbl, [2]), cfg.tgt_vocab)
@@ -189,10 +307,23 @@ def build_transformer_train(cfg=None, src_len=32, tgt_len=32, lr=1e-3,
 # ---------------------------------------------------------------------------
 
 def _np_params(scope, names):
+    """Collect params by their unrolled names; when a model was trained
+    with scan_layers=True the scope holds the stacked '<pre>_stack*'
+    arrays instead — expand slice [i] of the stacked array for the
+    per-layer name 'pre_i_rest'."""
+    import re
+
     out = {}
     for n in names:
         v = scope.find_var(n)
         if v is None:
+            m = re.match(r"^(enc|dec)_(\d+)(_.*)$", n)
+            if m:
+                stacked = scope.find_var(
+                    "%s_stack%s" % (m.group(1), m.group(3)))
+                if stacked is not None:
+                    out[n] = stacked[int(m.group(2))]
+                    continue
             raise RuntimeError("param %r missing from scope" % n)
         out[n] = v
     return out
