@@ -262,13 +262,19 @@ def prune_program(program: Program, feed_names, fetch_names) -> Program:
     block = pruned.global_block()
     needed = set(fetch_names)
     keep = []
+    # sub-block-aware reads/writes: control-flow ops (while/cond/scan)
+    # declare no outputs — their effect is writes inside the sub-block,
+    # which output_arg_names alone would miss, silently pruning the
+    # whole loop out of the inference program
+    from .lowering import _op_reads_writes
+
     for op in reversed(block.ops):
         if op.type == "backward":
             continue
-        out_names = set(op.output_arg_names)
-        if out_names & needed:
+        reads, writes = _op_reads_writes(op)
+        if set(writes) & needed:
             keep.append(op)
-            needed |= set(op.input_arg_names)
+            needed |= set(reads)
     block.ops = list(reversed(keep))
     pruned._version += 1
     return pruned

@@ -1,0 +1,89 @@
+"""Scan programs through the inference path: save_inference_model must
+serialize the scan op (sub_block + xs attrs), pruning must keep the
+sub-block and stacked params, and the loaded program must reproduce the
+trained model's outputs."""
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework
+from paddle_tpu.core.scope import global_scope
+
+
+def test_while_model_survives_pruning():
+    """Same prune bug class as scan: a While writes its results via the
+    sub-block, so output_arg_names-only pruning silently dropped it."""
+    H = 6
+    main, st = framework.Program(), framework.Program()
+    main.random_seed = st.random_seed = 2
+    with framework.program_guard(main, st):
+        with framework.unique_name_guard():
+            x = fluid.layers.data("x", shape=[H], dtype="float32")
+            h = fluid.layers.fc(x, size=H, act="tanh")
+            i = fluid.layers.fill_constant([1], "int64", 0)
+            n = fluid.layers.fill_constant([1], "int64", 3)
+            cond = fluid.layers.less_than(i, n)
+            w = fluid.layers.While(cond)
+            with w.block():
+                nh = fluid.layers.scale(h, scale=0.5)
+                fluid.layers.assign(nh, output=h)
+                fluid.layers.increment(i)
+                fluid.layers.assign(
+                    fluid.layers.less_than(i, n), output=cond)
+            out = fluid.layers.fc(h, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(st)
+    feed = {"x": np.ones((2, H), np.float32)}
+    ref = np.asarray(exe.run(main, feed=feed, fetch_list=[out])[0])
+
+    pruned = fluid.io.prune_program(main, ["x"], [out.name])
+    assert any(op.type == "while" for op in pruned.global_block().ops), \
+        "pruning dropped the while loop"
+    got = np.asarray(exe.run(pruned, feed=feed, fetch_list=[out])[0])
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_scan_model_inference_roundtrip():
+    L, H = 4, 8
+    main, st = framework.Program(), framework.Program()
+    main.random_seed = st.random_seed = 5
+    with framework.program_guard(main, st):
+        with framework.unique_name_guard():
+            x = fluid.layers.data("x", shape=[H], dtype="float32")
+            w = fluid.layers.create_parameter(
+                shape=[L, H, H], dtype="float32", name="inf.w",
+                default_initializer=fluid.initializer.TruncatedNormal(
+                    0.0, 0.2))
+            h = fluid.layers.fc(x, size=H)
+            scan = fluid.layers.Scan(n=L)
+            with scan.block():
+                wi = scan.slice_input(w)
+                nh = fluid.layers.tanh(fluid.layers.matmul(h, wi))
+                fluid.layers.assign(nh, output=h)
+            out = fluid.layers.fc(h, size=3, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(st)
+    feed = {"x": np.linspace(-1, 1, 2 * H).reshape(2, H).astype(
+        "float32")}
+    ref = np.asarray(exe.run(main, feed=feed, fetch_list=[out])[0])
+
+    d = tempfile.mkdtemp()
+    fluid.io.save_inference_model(d, ["x"], [out], exe,
+                                  main_program=main)
+    # fresh scope so the load really restores the stacked param
+    import paddle_tpu.core.scope as sm
+
+    old = sm._global_scope
+    sm._global_scope = sm.Scope()
+    try:
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        prog, feed_names, fetch_targets = fluid.io.load_inference_model(
+            d, exe2)
+        assert feed_names == ["x"]
+        got = np.asarray(exe2.run(prog, feed=feed,
+                                  fetch_list=fetch_targets)[0])
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    finally:
+        sm._global_scope = old
