@@ -482,7 +482,8 @@ def _exec_switch_case(op, env, key0, op_idx, amp_lists):
     env.update(zip(out_names, outs))
 
 
-def _run_gradient_merge(ops, bwd_idx, gm, env, key0, amp_lists):
+def _run_gradient_merge(ops, bwd_idx, gm, env, key0, amp_lists,
+                        sync_fn=None):
     """k-step gradient accumulation (reference: gradient_merge strategy,
     `framework/ir/multi_batch_merge_pass.cc` / fleet 2.0 GradientMerge
     meta-optimizer). Each step adds the fresh grads into persistable
@@ -518,6 +519,11 @@ def _run_gradient_merge(ops, bwd_idx, gm, env, key0, amp_lists):
         e = dict(env)
         for g, acc in acc_map.items():
             merged = e[acc] / k if avg else e[acc]
+            if sync_fn is not None:
+                # implicit-DP sync on the merged grad: one allreduce
+                # per k steps (the predicate is counter-driven, so
+                # every shard takes this branch together)
+                merged = sync_fn(merged)
             e[g] = merged.astype(e[g].dtype)
         _run_ops(post_ops, e, key0, base_idx=bwd_idx + 1,
                  amp_lists=amp_lists)
@@ -601,6 +607,35 @@ def build_block_fn(program, block, feed_names, fetch_names,
     bwd_idx = bwd_indices[0] if bwd_indices else None
     amp_lists = getattr(program, "_amp_lists", None) \
         if getattr(program, "_amp", False) else None
+    # Implicit DP grad sync (reference: multi_devices_graph_pass.cc:464
+    # inserts an AllReduceOpHandle per gradient for ParallelExecutor).
+    # The fleet transpiler emits explicit c_allreduce ops ON THE GRAD
+    # VARS after backward instead — when those are present the program
+    # owns its own sync and pmean-ing here would double-reduce. Only
+    # grad-consuming allreduces count: a forward collective (e.g. a
+    # globally averaged metric) must not disable the sync.
+    _post_ops = ops[bwd_idx + 1:] if bwd_idx is not None else []
+    _has_explicit_sync = any(
+        (op.type.startswith("c_allreduce") or op.type == "allreduce")
+        and any(n.endswith("@GRAD") for n in op.input_arg_names)
+        for op in _post_ops)
+    _implicit_dp = getattr(program, "_data_parallel", False) \
+        and not _has_explicit_sync
+    _dp_axis_name = getattr(program, "_dp_axis", "dp")
+
+    def _dp_pmean(g):
+        """pmean over the dp axis when implicit sync is on and the axis
+        is live (inside shard_map); identity otherwise."""
+        if not _implicit_dp:
+            return g
+        from ..parallel import env as penv
+
+        axes = penv.active_axes() or {}
+        if axes.get(_dp_axis_name, 1) > 1:
+            import jax as _jax
+
+            return _jax.lax.pmean(g, _dp_axis_name)
+        return g
 
     def fn(feeds: Dict, states_mut: Dict, states_ro: Dict, seed):
         env = {}
@@ -651,18 +686,23 @@ def build_block_fn(program, block, feed_names, fetch_names,
             ct = jnp.asarray(loss_scale, jnp.float32)
             grads = vjp_fn(ct)[0]
             env = dict(env_after)
+            gm = bop.attrs.get("gradient_merge")
+            if gm is None:
+                grads = {n: _dp_pmean(g) for n, g in grads.items()}
+            # under gradient merge, sync once on the MERGED grads at the
+            # k-step boundary instead of k per-micro-step allreduces
             for n in diff_names:
                 g = grads[n]
                 env[framework.grad_var_name(n)] = g.astype(env[n].dtype)
             loss_val = env[loss_name]
             env[framework.grad_var_name(loss_name)] = jnp.full(
                 loss_val.shape, loss_scale, loss_val.dtype)
-            gm = bop.attrs.get("gradient_merge")
             if gm is None:
                 _run_ops(ops[bwd_idx + 1:], env, key0,
                          base_idx=bwd_idx + 1, amp_lists=amp_lists)
             else:
-                _run_gradient_merge(ops, bwd_idx, gm, env, key0, amp_lists)
+                _run_gradient_merge(ops, bwd_idx, gm, env, key0,
+                                    amp_lists, sync_fn=_dp_pmean)
 
         fetches = []
         for n in fetch_names:
