@@ -89,6 +89,19 @@ class Scan:
         self._sub = None
         self._xs_stacked: List[Variable] = []
         self._xs_slice: List[Variable] = []
+        self._iter_var: Optional[Variable] = None
+
+    def iteration(self) -> Variable:
+        """[1] int64 var holding the current iteration index inside the
+        body — e.g. the scatter index for per-iteration slice updates of
+        stacked state (BN running stats in a scanned residual stage)."""
+        if self._sub is None:
+            raise ValueError(
+                "iteration() must be called inside `with scan.block():`")
+        if self._iter_var is None:
+            self._iter_var = self._sub.create_var(
+                name=unique_name("scan_iter"), shape=(1,), dtype="int64")
+        return self._iter_var
 
     def slice_input(self, stacked: Variable) -> Variable:
         """Declare `stacked` [n, ...] as a per-iteration input; returns
@@ -118,6 +131,7 @@ class Scan:
             prog = self._main
             self._sub = prog._create_block()
             self._xs_stacked, self._xs_slice = [], []
+            self._iter_var = None
             try:
                 yield self
             except BaseException:
@@ -137,7 +151,9 @@ class Scan:
                 attrs={"sub_block": sub.idx, "n": self.n,
                        "remat": self.remat,
                        "xs_stacked": [v.name for v in self._xs_stacked],
-                       "xs_slice": [v.name for v in self._xs_slice]})
+                       "xs_slice": [v.name for v in self._xs_slice],
+                       "iter_var": self._iter_var.name
+                       if self._iter_var is not None else ""})
 
         return ctx()
 

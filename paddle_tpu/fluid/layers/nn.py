@@ -90,7 +90,13 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
 def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
            groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
            act=None, name=None, data_format="NCHW"):
-    helper = LayerHelper("conv2d", param_attr=param_attr,
+    """param_attr may be a Variable: convolve with that EXISTING filter
+    instead of creating a parameter — the scan-over-blocks path passes
+    per-iteration slices of stacked [L, out, in, kh, kw] filters
+    (layers.Scan)."""
+    helper = LayerHelper("conv2d",
+                         param_attr=None if isinstance(param_attr, Variable)
+                         else param_attr,
                          bias_attr=bias_attr, act=act, name=name)
     num_channels = input.shape[1]
     if isinstance(filter_size, int):
@@ -98,9 +104,18 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
     filter_shape = [num_filters, num_channels // groups] + list(filter_size)
     fan_in = (num_channels // groups) * filter_size[0] * filter_size[1]
     std = (2.0 / fan_in) ** 0.5
-    w = helper.create_parameter(
-        helper.param_attr, shape=filter_shape, dtype=input.dtype,
-        default_initializer=NormalInitializer(0.0, std))
+    if isinstance(param_attr, Variable):
+        if tuple(int(d) for d in param_attr.shape) != tuple(filter_shape):
+            raise ValueError(
+                "conv2d: provided filter var %r has shape %s, expected "
+                "%s (pass the per-iteration slice, not the stack)"
+                % (param_attr.name, tuple(param_attr.shape),
+                   tuple(filter_shape)))
+        w = param_attr
+    else:
+        w = helper.create_parameter(
+            helper.param_attr, shape=filter_shape, dtype=input.dtype,
+            default_initializer=NormalInitializer(0.0, std))
     stride = [stride, stride] if isinstance(stride, int) else list(stride)
     padding = [padding, padding] if isinstance(padding, int) else list(padding)
     dilation = ([dilation, dilation] if isinstance(dilation, int)

@@ -88,9 +88,12 @@ def _op_reads_writes(op):
     prog = op.block.program
     for bi in _sub_block_idxs(op):
         blk = prog.block(bi)
-        # scan xs slices are produced by the loop machinery itself, not
-        # by any sub-block op — they are never external reads
+        # scan xs slices (and the iteration-index var) are produced by
+        # the loop machinery itself, not by any sub-block op — they are
+        # never external reads
         produced_local = set(op.attrs.get("xs_slice", []))
+        if op.attrs.get("iter_var"):
+            produced_local.add(op.attrs["iter_var"])
         for sop in blk.ops:
             sr, sw = _op_reads_writes(sop)
             for n in sr:
@@ -368,11 +371,15 @@ def _exec_scan(op, env, key0, op_idx, amp_lists):
             "with layers.assign(new_val, output=carried_var).")
     base_key = jax.random.fold_in(key0, op_idx)
 
+    iter_name = op.attrs.get("iter_var") or None
+
     def body(carry, xs):
         it = carry[0]
         e = dict(env)
         e.update(zip(carry_names, carry[1:]))
         e.update(zip(xs_slice, xs))
+        if iter_name:
+            e[iter_name] = jnp.reshape(it, (1,)).astype(jnp.int64)
         # per-iteration rng so dropout masks differ across layers
         _run_ops(sub.ops, e, jax.random.fold_in(base_key, it),
                  amp_lists=amp_lists)
