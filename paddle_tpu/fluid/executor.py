@@ -40,6 +40,11 @@ class Executor:
         from . import compiler
 
         if isinstance(program, compiler.CompiledProgram):
+            if getattr(program._build_strategy,
+                       "fuse_all_optimizer_ops", None):
+                from .fuse_optimizer import fuse_optimizer_ops
+
+                fuse_optimizer_ops(program._unwrap())
             program = program._unwrap()
         scope = scope or global_scope()
         feed = feed or {}

@@ -1,0 +1,151 @@
+"""fuse_optimizer_ops — the reference's fuse_optimizer_ops_pass family
+(framework/ir/fuse_optimizer_ops_pass/) as a program rewrite: N
+same-configured sgd/momentum/adam ops collapse into one fused_* op over
+the coalesced group. Losses must match the unfused program exactly
+step-for-step."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework
+from paddle_tpu.core import scope as scope_mod
+
+
+def _build(opt_name, seed=13):
+    main = framework.default_main_program()
+    st = framework.default_startup_program()
+    main.random_seed = st.random_seed = seed
+    x = fluid.layers.data("x", shape=[16], dtype="float32")
+    y = fluid.layers.data("y", shape=[1], dtype="int64")
+    h = fluid.layers.fc(x, size=32, act="relu")
+    h = fluid.layers.fc(h, size=32, act="relu")
+    logits = fluid.layers.fc(h, size=4)
+    loss = fluid.layers.mean(
+        fluid.layers.loss.softmax_with_cross_entropy(logits, y))
+    if opt_name == "sgd":
+        opt = fluid.optimizer.SGDOptimizer(0.1)
+    elif opt_name == "momentum":
+        opt = fluid.optimizer.MomentumOptimizer(0.05, momentum=0.9)
+    else:
+        opt = fluid.optimizer.AdamOptimizer(1e-2)
+    opt.minimize(loss)
+    return loss
+
+
+def _fresh():
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    scope_mod._global_scope = scope_mod.Scope()
+
+
+def _run_steps(loss, steps=5):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(framework.default_startup_program())
+    r = np.random.RandomState(0)
+    xs = r.randn(16, 16).astype("float32")
+    ys = r.randint(0, 4, (16, 1)).astype("int64")
+    return [float(np.asarray(exe.run(
+        feed={"x": xs, "y": ys}, fetch_list=[loss])[0]).ravel()[0])
+        for _ in range(steps)]
+
+
+@pytest.mark.parametrize("opt_name", ["sgd", "momentum", "adam"])
+def test_fused_matches_unfused(opt_name):
+    with framework.unique_name_guard():
+        loss = _build(opt_name)
+        base = _run_steps(loss)
+
+    _fresh()
+    with framework.unique_name_guard():
+        loss2 = _build(opt_name)
+        prog = framework.default_main_program()
+        n_before = len(prog.global_block().ops)
+        fused = fluid.fuse_optimizer_ops(prog)
+        n_after = len(prog.global_block().ops)
+        assert fused > 0, "nothing fused"
+        assert n_after == n_before - fused
+        assert any(op.type == "fused_" + opt_name
+                   for op in prog.global_block().ops)
+        got = _run_steps(loss2)
+    np.testing.assert_allclose(got, base, rtol=1e-6, atol=1e-7)
+
+
+def test_clone_for_test_drops_fused_ops():
+    """clone(for_test=True) must prune fused_* updates like the plain
+    optimizer ops — otherwise the inference clone reads @GRAD vars that
+    are never produced."""
+    _fresh()
+    with framework.unique_name_guard():
+        loss = _build("momentum")
+        prog = framework.default_main_program()
+        assert fluid.fuse_optimizer_ops(prog) > 0
+        test_p = prog.clone(for_test=True)
+        assert not any(op.type.startswith("fused_")
+                       for op in test_p.global_block().ops)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(framework.default_startup_program())
+        r = np.random.RandomState(0)
+        out = exe.run(test_p,
+                      feed={"x": r.randn(8, 16).astype("float32"),
+                            "y": r.randint(0, 4, (8, 1)).astype(
+                                "int64")},
+                      fetch_list=[loss])
+        assert np.isfinite(np.asarray(out[0])).all()
+
+
+def test_interleaved_grad_write_blocks_fusion():
+    """An op that rewrites a member's Grad between two group members
+    makes the group unfusable: the fused op planted at the last
+    position would read the mutated grad."""
+    from paddle_tpu.fluid.fuse_optimizer import fuse_optimizer_ops
+
+    _fresh()
+    with framework.unique_name_guard():
+        loss = _build("sgd")
+        prog = framework.default_main_program()
+        block = prog.global_block()
+        sgd_idxs = [i for i, op in enumerate(block.ops)
+                    if op.type == "sgd"]
+        assert len(sgd_idxs) >= 2
+        # mutate the FIRST sgd's grad between the first and last member
+        g_name = block.ops[sgd_idxs[0]].input_names["Grad"][0]
+        g_var = block._find_var_recursive(g_name)
+        from paddle_tpu.fluid.framework import Operator
+
+        scale_op = Operator(block, "scale", inputs={"X": [g_var]},
+                            outputs={"Out": [g_var]},
+                            attrs={"scale": 2.0, "bias": 0.0,
+                                   "bias_after_scale": True})
+        block.ops.insert(sgd_idxs[0] + 1, scale_op)
+        assert fuse_optimizer_ops(prog) == 0
+        assert not any(op.type.startswith("fused_") for op in block.ops)
+
+
+def test_fuse_is_idempotent():
+    _fresh()
+    with framework.unique_name_guard():
+        _build("momentum")
+        prog = framework.default_main_program()
+        assert fluid.fuse_optimizer_ops(prog) > 0
+        assert fluid.fuse_optimizer_ops(prog) == 0
+
+
+def test_build_strategy_drives_fusion():
+    _fresh()
+    with framework.unique_name_guard():
+        loss = _build("momentum")
+        prog = framework.default_main_program()
+        bs = fluid.BuildStrategy()
+        bs.fuse_all_optimizer_ops = True
+        compiled = fluid.CompiledProgram(prog, build_strategy=bs)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(framework.default_startup_program())
+        r = np.random.RandomState(0)
+        out = exe.run(compiled,
+                      feed={"x": r.randn(8, 16).astype("float32"),
+                            "y": r.randint(0, 4, (8, 1)).astype(
+                                "int64")},
+                      fetch_list=[loss])
+        assert np.isfinite(np.asarray(out[0])).all()
+        assert any(op.type == "fused_momentum"
+                   for op in prog.global_block().ops)
