@@ -460,7 +460,34 @@ def _run_attempt(platform, budget, batch, steps, warmup, idx, errors,
     return None
 
 
+_LOCK_PATH = os.path.join(_REPO, ".bench_lock")
+
+
+def _acquire_bench_lock(max_wait_s: float = 900.0):
+    """Serialize whole-bench invocations across processes: the driver's
+    end-of-round bench and tools/capture_loop.py's opportunistic bench
+    must not fight for the chip mid-window. Blocks up to max_wait_s
+    (an in-flight capture refreshes .bench_last_good.json, which the
+    later invocation then emits); proceeds anyway on timeout so a
+    crashed holder can never wedge the round artifact."""
+    import fcntl
+
+    f = open(_LOCK_PATH, "w")
+    t0 = time.perf_counter()
+    while True:
+        try:
+            fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            return f
+        except OSError:
+            if time.perf_counter() - t0 > max_wait_s:
+                print("BENCH_LOCK_TIMEOUT: proceeding unlocked",
+                      file=sys.stderr)
+                return f
+            time.sleep(10.0)
+
+
 def main() -> int:
+    _lock = _acquire_bench_lock()  # held for process lifetime
     errors = []
     result = None          # headline: the first successful BERT measure
     resnet_result = None   # BASELINE config 2, rides as a sub-object

@@ -58,6 +58,7 @@ def build_step(batch):
                 fluid.optimizer.AdamOptimizer(learning_rate=1e-4),
                 use_dynamic_loss_scaling=False)
             opt.minimize(total)
+            fluid.fuse_optimizer_ops(main_p)  # mirror bench.py exactly
             n_params = sum(int(np.prod(p.shape))
                            for p in main_p.all_parameters())
             exe = fluid.Executor(fluid.TPUPlace())
