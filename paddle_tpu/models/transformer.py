@@ -55,11 +55,12 @@ def _proj(x, size, name, act=None):
                      bias_attr=ParamAttr(name=name + ".b"))
 
 
-def _attention(q_in, kv_in, bias, cfg, name, is_test):
+def _attention_core(q, k, v, bias, cfg, is_test, out_proj):
+    """softmax(QK^T/sqrt(d_head)+bias)V over heads; q/k/v are already
+    [B, S, d_model] projections, out_proj maps the context back. ONE
+    copy of the weight-parity-critical math shared by the unrolled path
+    and the scan body."""
     d_head = cfg.d_model // cfg.n_head
-    q = _proj(q_in, cfg.d_model, name + "_q")
-    k = _proj(kv_in, cfg.d_model, name + "_k")
-    v = _proj(kv_in, cfg.d_model, name + "_v")
 
     def heads(t):
         t = layers.reshape(t, [0, 0, cfg.n_head, d_head])
@@ -76,7 +77,16 @@ def _attention(q_in, kv_in, bias, cfg, name, is_test):
                                dropout_implementation="upscale_in_train")
     ctx = layers.transpose(layers.matmul(probs, v), [0, 2, 1, 3])
     ctx = layers.reshape(ctx, [0, 0, cfg.d_model])
-    return _proj(ctx, cfg.d_model, name + "_o")
+    return out_proj(ctx)
+
+
+def _attention(q_in, kv_in, bias, cfg, name, is_test):
+    return _attention_core(
+        _proj(q_in, cfg.d_model, name + "_q"),
+        _proj(kv_in, cfg.d_model, name + "_k"),
+        _proj(kv_in, cfg.d_model, name + "_v"),
+        bias, cfg, is_test,
+        lambda ctx: _proj(ctx, cfg.d_model, name + "_o"))
 
 
 def _ln(x, name):
@@ -157,7 +167,6 @@ def _scan_stack(x, cfg, prefix, is_test, self_bias=None, cross_kv=None,
     from ..fluid.layers import Scan
 
     L, d, f = cfg.n_layer, cfg.d_model, cfg.d_ff
-    d_head = d // cfg.n_head
     zeros = fluid.initializer.Constant(0.0)
     ones = fluid.initializer.Constant(1.0)
 
@@ -206,32 +215,16 @@ def _scan_stack(x, cfg, prefix, is_test, self_bias=None, cross_kv=None,
         def proj(inp, w, b):
             return layers.elementwise_add(layers.matmul(inp, w), b)
 
-        def heads(t):
-            t = layers.reshape(t, [0, 0, cfg.n_head, d_head])
-            return layers.transpose(t, [0, 2, 1, 3])
-
-        # same hand-rolled softmax(QK^T+bias)V as the unrolled
-        # _attention (weight-parity contract); the fused
-        # scaled_dot_product_attention path only changes the lowering
-        # at seq >= FLAGS_flash_attention_min_seq (4096), far above
-        # WMT's max_len — below it XLA materializes scores either way
+        # _attention_core: ONE copy of the math (weight-parity with the
+        # unrolled path); the fused scaled_dot_product_attention path
+        # only changes the lowering at seq >=
+        # FLAGS_flash_attention_min_seq (4096), far above WMT's max_len
         def attn(q_in, kv_in, bias, kind):
             s = sl[kind]
-            q = heads(proj(q_in, *s["q"]))
-            k = heads(proj(kv_in, *s["k"]))
-            v = heads(proj(kv_in, *s["v"]))
-            scores = layers.matmul(q, k, transpose_y=True,
-                                   alpha=1.0 / math.sqrt(d_head))
-            if bias is not None:
-                scores = layers.elementwise_add(scores, bias)
-            probs = layers.softmax(scores)
-            if cfg.dropout and not is_test:
-                probs = layers.dropout(
-                    probs, cfg.dropout, is_test=is_test,
-                    dropout_implementation="upscale_in_train")
-            ctx = layers.transpose(layers.matmul(probs, v), [0, 2, 1, 3])
-            ctx = layers.reshape(ctx, [0, 0, d])
-            return proj(ctx, *s["o"])
+            return _attention_core(
+                proj(q_in, *s["q"]), proj(kv_in, *s["k"]),
+                proj(kv_in, *s["v"]), bias, cfg, is_test,
+                lambda ctx: proj(ctx, *s["o"]))
 
         def ln_i(inp, i):
             _, s, b = ln_sl[i]
@@ -329,21 +322,30 @@ def _np_params(scope, names):
     return out
 
 
+def layer_param_suffixes(pre):
+    """THE per-layer parameter suffix list for an encoder ('enc') or
+    decoder ('dec') layer — single source for the unrolled names
+    ('enc_3' + suffix), the scan-stacked names ('enc_stack' + suffix),
+    _np_params' expansion, and the tests' stacking helpers."""
+    kinds = ["_selfattn"] + (["_crossattn"] if pre == "dec" else [])
+    sufs = []
+    for a in kinds:
+        for p in ("_q", "_k", "_v", "_o"):
+            sufs += [a + p + ".w", a + p + ".b"]
+    for f in ("_ffn_fc0", "_ffn_fc1"):
+        sufs += [f + ".w", f + ".b"]
+    lns = ("_ln0", "_ln1") if pre == "enc" else ("_ln0", "_ln1", "_ln2")
+    for ln in lns:
+        sufs += [ln + ".scale", ln + ".bias"]
+    return sufs
+
+
 def _collect_param_names(cfg):
     names = ["src_word_emb", "tgt_word_emb"]
     for pre, n in (("enc", cfg.n_layer), ("dec", cfg.n_layer)):
         for i in range(n):
-            nm = "%s_%d" % (pre, i)
-            kinds = ["_selfattn"] + (["_crossattn"] if pre == "dec" else [])
-            for a in kinds:
-                for p in ("_q", "_k", "_v", "_o"):
-                    names += [nm + a + p + ".w", nm + a + p + ".b"]
-            for f in ("_ffn_fc0", "_ffn_fc1"):
-                names += [nm + f + ".w", nm + f + ".b"]
-            lns = ("_ln0", "_ln1") if pre == "enc" else ("_ln0", "_ln1",
-                                                         "_ln2")
-            for l in lns:
-                names += [nm + l + ".scale", nm + l + ".bias"]
+            names += ["%s_%d%s" % (pre, i, suf)
+                      for suf in layer_param_suffixes(pre)]
     names += ["dec_out_proj.w", "dec_out_proj.b"]
     return names
 

@@ -43,18 +43,7 @@ def _run(main, st, feed, fetch):
 def _stacked_from_unrolled(vals, cfg):
     out = {}
     for pre in ("enc", "dec"):
-        kinds = ["_selfattn"] + (["_crossattn"] if pre == "dec" else [])
-        suffixes = []
-        for a in kinds:
-            for p in ("_q", "_k", "_v", "_o"):
-                suffixes += [a + p + ".w", a + p + ".b"]
-        suffixes += ["_ffn_fc0.w", "_ffn_fc0.b", "_ffn_fc1.w",
-                     "_ffn_fc1.b"]
-        lns = ("_ln0", "_ln1") if pre == "enc" else ("_ln0", "_ln1",
-                                                     "_ln2")
-        for ln in lns:
-            suffixes += [ln + ".scale", ln + ".bias"]
-        for suf in suffixes:
+        for suf in T.layer_param_suffixes(pre):
             out["%s_stack%s" % (pre, suf)] = np.stack(
                 [vals["%s_%d%s" % (pre, i, suf)]
                  for i in range(cfg.n_layer)])
