@@ -130,6 +130,42 @@ def test_fuse_is_idempotent():
         assert fluid.fuse_optimizer_ops(prog) == 0
 
 
+def test_fused_under_data_parallel_matches_single():
+    """fuse_all_optimizer_ops x with_data_parallel: the implicit grad
+    pmean runs before the fused update reads the grads — losses match
+    the single-device fused run exactly."""
+    r = np.random.RandomState(1)
+    xs = r.randn(16, 16).astype("float32")
+    ys = r.randint(0, 4, (16, 1)).astype("int64")
+
+    _fresh()
+    with framework.unique_name_guard():
+        loss = _build("momentum")
+        prog = framework.default_main_program()
+        fluid.fuse_optimizer_ops(prog)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(framework.default_startup_program())
+        base = [float(np.asarray(exe.run(
+            feed={"x": xs, "y": ys}, fetch_list=[loss])[0]).ravel()[0])
+            for _ in range(4)]
+
+    _fresh()
+    with framework.unique_name_guard():
+        loss2 = _build("momentum")
+        prog2 = framework.default_main_program()
+        bs = fluid.BuildStrategy()
+        bs.fuse_all_optimizer_ops = True
+        compiled = fluid.CompiledProgram(
+            prog2, build_strategy=bs).with_data_parallel(
+                loss_name=loss2.name)
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        exe2.run(framework.default_startup_program())
+        dp = [float(np.asarray(exe2.run(
+            compiled, feed={"x": xs, "y": ys},
+            fetch_list=[loss2])[0]).mean()) for _ in range(4)]
+    np.testing.assert_allclose(base, dp, rtol=2e-4, atol=1e-5)
+
+
 def test_build_strategy_drives_fusion():
     _fresh()
     with framework.unique_name_guard():
