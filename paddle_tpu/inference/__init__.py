@@ -144,15 +144,17 @@ AnalysisConfig = Config  # legacy name (reference: paddle_analysis_config.h)
 class PassStrategy:
     """Reference: pass_builder.h — an ordered, editable pass list.
     Stages marked (xla) are owned by the compiler (they run iff
-    ir_optim is on — switch_ir_optim is the real toggle for them);
-    `memory_optimize_pass` is a runtime stage whose deletion actually
-    disables buffer donation for this predictor. Deleting a
-    compiler-owned or load-time pass warns that it has no individual
+    ir_optim is on — switch_ir_optim is the real toggle for them).
+    Two passes have REAL individual delete semantics:
+    `memory_optimize_pass` (disables buffer donation) and
+    `conv_bn_fuse_pass` (disables the load-time weight fold). Deleting
+    any other (compiler-owned) pass warns that it has no individual
     effect."""
 
-    _RUNTIME = {"memory_optimize_pass"}
+    _RUNTIME = {"memory_optimize_pass", "conv_bn_fuse_pass"}
     _DEFAULT = [
         "infer_clean_graph_pass",          # feed/fetch pruning (load)
+        "conv_bn_fuse_pass",               # weight fold (load; real)
         "constant_folding_pass",           # (xla)
         "common_subexpression_elimination",  # (xla)
         "operator_fusion_pass",            # (xla)
@@ -251,6 +253,14 @@ class Predictor:
                 model_filename=config.prog_file(),
                 params_filename=config.params_file())
         self._program = prog
+        self._conv_bn_fused = 0
+        if config.ir_optim() and "conv_bn_fuse_pass" in \
+                config.pass_builder().all_passes():
+            from .passes import conv_bn_fuse
+
+            self._conv_bn_fused = conv_bn_fuse(
+                prog, self._scope,
+                keep_names=[t.name for t in fetch_targets])
         self._feed_names = list(feed_names)
         self._fetch_targets = fetch_targets
         self._fetch_names = [t.name for t in fetch_targets]
@@ -346,6 +356,7 @@ class Predictor:
             "num_feeds": len(self._feed_names),
             "num_fetches": len(self._fetch_names),
             "ir_optim": self._config.ir_optim(),
+            "conv_bn_fused": self._conv_bn_fused,
             "passes": self._config.pass_builder().all_passes(),
             "memory_optim": getattr(self._config,
                                     "_enable_memory_optim", False),

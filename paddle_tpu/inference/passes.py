@@ -1,0 +1,106 @@
+"""Load-time inference optimization passes that need parameter VALUES
+(reference: `framework/ir/conv_bn_fuse_pass.cc`). These cannot be
+XLA-owned: to the compiler, parameters are runtime inputs, so the
+algebraic fold of a frozen batch_norm into conv weights is invisible to
+it — the fold must happen once at model-load with the scope in hand.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def conv_bn_fuse(program, scope, keep_names=()) -> int:
+    """Fold every frozen (is_test) batch_norm that solely consumes a
+    bias-free conv2d's output into the conv's weights + one channel
+    bias: w' = w * (gamma/sqrt(var+eps)) per out-channel,
+    b' = beta - mean * gamma/sqrt(var+eps). Removes the BN's
+    normalize/affine arithmetic from every inference step. Returns the
+    number of BN ops folded.
+
+    keep_names: externally observed vars (the predictor's fetch
+    targets) — a conv output or BN side output fetched by name must not
+    be rescaled/dropped, so those pairs are skipped."""
+    import jax.numpy as jnp
+
+    from ..fluid.framework import Operator
+
+    block = program.global_block()
+    ops = list(block.ops)
+    keep = set(keep_names)
+    consumers = {}
+    for i, op in enumerate(ops):
+        for n in op.input_arg_names:
+            consumers.setdefault(n, []).append(i)
+
+    fused = 0
+    for i, op in enumerate(ops):
+        if op.type != "conv2d":
+            continue
+        out = op.output_names["Output"][0]
+        if out in keep:
+            continue  # fetched pre-BN activation: fold would rescale it
+        cons = consumers.get(out, [])
+        if len(cons) != 1:
+            continue
+        # a weight-tied filter (shared by another conv) must not be
+        # rescaled in scope
+        if len(consumers.get(op.input_names["Filter"][0], [])) != 1:
+            continue
+        bn = ops[cons[0]]
+        if bn.type != "batch_norm":
+            continue
+        if not (bn.attrs.get("is_test")
+                or bn.attrs.get("use_global_stats")):
+            continue
+        if bn.input_names["X"][0] != out:
+            continue
+        # only the normalized output may have consumers — MeanOut-style
+        # side outputs must be dead or the rewrite would drop them.
+        # (MeanOut aliases the Mean INPUT var, so the BN op itself
+        # appears as a consumer — exclude it.)
+        bn_idx = cons[0]
+        side_names = [n for slot, names in bn.output_names.items()
+                      if slot != "Y" for n in names]
+        if any(c != bn_idx for n in side_names
+               for c in consumers.get(n, [])):
+            continue
+        if any(n in keep for n in side_names):
+            continue
+
+        w_name = op.input_names["Filter"][0]
+        vals = {}
+        missing = False
+        for slot in ("Scale", "Bias", "Mean", "Variance"):
+            v = scope.find_var(bn.input_names[slot][0])
+            if v is None:
+                missing = True
+                break
+            vals[slot] = np.asarray(v)
+        w_dev = scope.find_var(w_name)
+        if missing or w_dev is None:
+            continue
+        w = np.asarray(w_dev)
+        eps = float(bn.attrs.get("epsilon", 1e-5))
+        inv = vals["Scale"] / np.sqrt(vals["Variance"] + eps)
+        scope.set_var(w_name, jnp.asarray(
+            (w * inv[:, None, None, None]).astype(w.dtype)))
+        b_folded = (vals["Bias"] - vals["Mean"] * inv).astype("float32")
+        bias_name = w_name + "@bn_folded_bias"
+        bias_var = block.create_var(name=bias_name,
+                                    shape=(int(b_folded.shape[0]),),
+                                    dtype="float32")
+        bias_var.persistable = True
+        scope.set_var(bias_name, jnp.asarray(b_folded))
+
+        y_var = block._find_var_recursive(bn.output_names["Y"][0])
+        conv_out_var = block._find_var_recursive(out)
+        ops[cons[0]] = Operator(
+            block, "elementwise_add",
+            inputs={"X": [conv_out_var], "Y": [bias_var]},
+            outputs={"Out": [y_var]}, attrs={"axis": 1})
+        fused += 1
+
+    if fused:
+        block.ops = ops
+        program._version += 1
+    return fused

@@ -1,0 +1,127 @@
+"""conv_bn_fuse inference pass (reference: ir/conv_bn_fuse_pass.cc) —
+a frozen batch_norm folds into the preceding conv's weights + one
+channel bias at model load. XLA cannot do this (params are runtime
+inputs), so it is a real load-time pass with scope values. Output
+parity within fp tolerance; BN ops gone from the predictor program."""
+import tempfile
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework
+
+
+def _save_convbn_model(d):
+    main, st = framework.Program(), framework.Program()
+    main.random_seed = st.random_seed = 4
+    with framework.program_guard(main, st):
+        with framework.unique_name_guard():
+            img = fluid.layers.data("image", shape=[3, 16, 16],
+                                    dtype="float32")
+            h = fluid.layers.conv2d(img, 8, 3, padding=1,
+                                    bias_attr=False)
+            h = fluid.layers.batch_norm(h, act="relu", is_test=True)
+            h = fluid.layers.conv2d(h, 8, 3, padding=1, bias_attr=False)
+            h = fluid.layers.batch_norm(h, is_test=True)
+            h = fluid.layers.pool2d(h, pool_type="avg",
+                                    global_pooling=True)
+            out = fluid.layers.fc(h, size=5, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(st)
+    # make every BN stat/affine non-trivial so the parity assertion can
+    # catch fold-math bugs (sign of the mean term, wrong scale axis):
+    # perturb moving mean/var AND gamma/beta of the batch_norm layers
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.scope import global_scope
+
+    r = np.random.RandomState(0)
+    perturbed = 0
+    for name in list(global_scope().local_var_names()):
+        if not name.startswith("batch_norm"):
+            continue
+        v = global_scope().find_var(name)
+        if v is None or not hasattr(v, "shape"):
+            continue
+        if ".mean" in name:
+            new = r.randn(*v.shape).astype("float32") * 0.3
+        elif ".var" in name:
+            new = (np.abs(r.randn(*v.shape)) + 0.5).astype("float32")
+        elif ".w_" in name:  # gamma
+            new = (1.0 + 0.3 * r.randn(*v.shape)).astype("float32")
+        elif ".b_" in name:  # beta
+            new = (0.2 * r.randn(*v.shape)).astype("float32")
+        else:
+            continue
+        global_scope().set_var(name, jnp.asarray(new))
+        perturbed += 1
+    assert perturbed >= 8, perturbed  # 2 BN layers x 4 vars each
+    fluid.io.save_inference_model(d, ["image"], [out], exe,
+                                  main_program=main)
+
+
+def test_conv_bn_fold_output_parity_and_removal():
+    from paddle_tpu import inference
+
+    d = tempfile.mkdtemp()
+    _save_convbn_model(d)
+    x = np.random.RandomState(1).randn(2, 3, 16, 16).astype("float32")
+
+    def predict(ir_optim):
+        cfg = inference.Config(d)
+        cfg.switch_ir_optim(ir_optim)
+        pred = inference.create_predictor(cfg)
+        inp = pred.get_input_handle(pred.get_input_names()[0])
+        inp.copy_from_cpu(x)
+        pred.run()
+        out = pred.get_output_handle(pred.get_output_names()[0])
+        return pred, out.copy_to_cpu()
+
+    pred_ref, ref = predict(ir_optim=False)
+    assert pred_ref.get_optimization_report()["conv_bn_fused"] == 0
+
+    pred_opt, got = predict(ir_optim=True)
+    rep = pred_opt.get_optimization_report()
+    assert rep["conv_bn_fused"] == 2, rep
+    assert rep["op_types"].get("batch_norm", 0) == 0, rep["op_types"]
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_weight_tied_filter_blocks_fold():
+    """Two convs sharing one filter var: folding would rescale the
+    tied weights for BOTH convs — the pass must skip the pair."""
+    from paddle_tpu.inference.passes import conv_bn_fuse
+    from paddle_tpu.core.scope import global_scope
+
+    main, st = framework.Program(), framework.Program()
+    main.random_seed = st.random_seed = 4
+    with framework.program_guard(main, st):
+        with framework.unique_name_guard():
+            img = fluid.layers.data("image", shape=[3, 8, 8],
+                                    dtype="float32")
+            w = fluid.layers.create_parameter(
+                shape=[3, 3, 3, 3], dtype="float32", name="tied.w")
+            a = fluid.layers.conv2d(img, 3, 3, padding=1, param_attr=w,
+                                    bias_attr=False)
+            a = fluid.layers.batch_norm(a, is_test=True)
+            b = fluid.layers.conv2d(img, 3, 3, padding=1, param_attr=w,
+                                    bias_attr=False)
+            out = fluid.layers.elementwise_add(a, b)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(st)
+    assert conv_bn_fuse(main, global_scope()) == 0
+    assert any(op.type == "batch_norm"
+               for op in main.global_block().ops)
+
+
+def test_deleting_the_pass_disables_folding():
+    from paddle_tpu import inference
+
+    d = tempfile.mkdtemp()
+    _save_convbn_model(d)
+    cfg = inference.Config(d)
+    cfg.pass_builder().delete_pass("conv_bn_fuse_pass")
+    pred = inference.create_predictor(cfg)
+    rep = pred.get_optimization_report()
+    assert rep["conv_bn_fused"] == 0
+    assert rep["op_types"].get("batch_norm", 0) == 2
