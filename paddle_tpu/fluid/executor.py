@@ -39,13 +39,10 @@ class Executor:
         # CompiledProgram front (compiler.py) wraps a Program
         from . import compiler
 
-        if isinstance(program, compiler.CompiledProgram):
-            if getattr(program._build_strategy,
-                       "fuse_all_optimizer_ops", None):
-                from .fuse_optimizer import fuse_optimizer_ops
-
-                fuse_optimizer_ops(program._unwrap())
-            program = program._unwrap()
+        _compiled = program if isinstance(
+            program, compiler.CompiledProgram) else None
+        if _compiled is not None:
+            program = _compiled._unwrap()
         scope = scope or global_scope()
         feed = feed or {}
         fetch_list = fetch_list or []
@@ -53,6 +50,24 @@ class Executor:
         fetch_names = [
             f.name if isinstance(f, framework.Variable) else str(f)
             for f in fetch_list]
+
+        if _compiled is not None:
+            # BuildStrategy-driven fusion rewrites (first run decides:
+            # idempotent markers make later runs no-ops). Fetch names
+            # guard the passes from fusing away an observed var.
+            bsty = _compiled._build_strategy
+            if getattr(bsty, "fuse_all_optimizer_ops", None):
+                from .fuse_optimizer import fuse_optimizer_ops
+
+                fuse_optimizer_ops(program)
+            if getattr(bsty, "fuse_elewise_add_act_ops", None):
+                from .fusion_passes import fuse_elewise_add_act
+
+                fuse_elewise_add_act(program, keep_names=fetch_names)
+            if getattr(bsty, "fuse_bn_act_ops", None):
+                from .fusion_passes import fuse_bn_act
+
+                fuse_bn_act(program, keep_names=fetch_names)
 
         # PS mode: the communicator needs this step's grads — extend the
         # fetch list internally (reference: send ops read the grad vars)

@@ -1,0 +1,126 @@
+"""Training-graph fusion rewrites behind BuildStrategy knobs
+(reference: `framework/ir/fuse_elewise_add_act_pass.cc` and
+`ir/fuse_bn_act_pass.cc`). On TPU, XLA fuses these elementwise chains
+at compile time anyway — the rewrites shrink the traced program (fewer
+ops to trace + lower), and make the strategy knobs real rather than
+decorative. Both run BEFORE lowering, so autodiff is unaffected:
+jax.vjp differentiates the fused forward exactly like the composition.
+
+keep_names: vars observed externally (this run's fetch targets) — a
+fused-away intermediate that is fetched must stay producible, so such
+pairs are skipped. The rewrite is once-per-program (idempotent marker);
+a LATER run fetching an already-fused-away intermediate cannot be
+served — fetch-sensitive callers should fuse after deciding fetches,
+which Executor.run's wiring does for the first run.
+"""
+from __future__ import annotations
+
+from .framework import Operator
+
+_EW_ACTS = ("relu", "sigmoid", "tanh")
+
+
+def _fuse_pairs(program, marker, match_producer, match_consumer,
+                build_replacement, keep_names=()):
+    """Shared producer->sole-consumer pattern rewrite: for each op
+    where match_producer(op) and whose single output consumer satisfies
+    match_consumer, replace the producer with build_replacement(...)
+    and drop the consumer. Guards: the intermediate must not be
+    persistable or in keep_names."""
+    if getattr(program, marker, False):
+        return 0
+    block = program.global_block()
+    ops = list(block.ops)
+    keep = set(keep_names)
+    consumers = {}
+    for i, op in enumerate(ops):
+        for n in op.input_arg_names:
+            consumers.setdefault(n, []).append(i)
+
+    fused = 0
+    to_remove = set()
+    for i, op in enumerate(ops):
+        if i in to_remove or not match_producer(op):
+            continue
+        out = match_producer(op)  # the intermediate var name
+        if out in keep:
+            continue
+        v = block._find_var_recursive(out)
+        if v is not None and getattr(v, "persistable", False):
+            continue
+        cons = consumers.get(out, [])
+        if len(cons) != 1 or cons[0] in to_remove:
+            continue
+        act = ops[cons[0]]
+        if not match_consumer(act):
+            continue
+        replacement = build_replacement(block, op, act)
+        if replacement is None:
+            continue
+        ops[i] = replacement
+        to_remove.add(cons[0])
+        fused += 1
+    if fused:
+        block.ops = [op for k, op in enumerate(ops)
+                     if k not in to_remove]
+        program._version += 1
+    setattr(program, marker, True)
+    return fused
+
+
+def fuse_elewise_add_act(program, keep_names=()) -> int:
+    """[elementwise_add -> relu/sigmoid/tanh] pairs whose intermediate
+    is otherwise dead become one fused_elemwise_activation op
+    (functor_list=[act, "elementwise_add"], the reference's
+    outer-first convention). Returns pairs fused."""
+
+    def build(block, op, act):
+        x = block._find_var_recursive(op.input_names["X"][0])
+        y = block._find_var_recursive(op.input_names["Y"][0])
+        inter = block._find_var_recursive(op.output_names["Out"][0])
+        act_out = block._find_var_recursive(act.output_names["Out"][0])
+        if x is None or y is None or act_out is None:
+            return None
+        return Operator(
+            block, "fused_elemwise_activation",
+            inputs={"X": [x], "Y": [y]},
+            outputs={"Out": [act_out], "IntermediateOut": [inter]},
+            attrs={"functor_list": [act.type, "elementwise_add"],
+                   "axis": op.attrs.get("axis", -1)})
+
+    return _fuse_pairs(
+        program, "_ew_act_fused",
+        lambda op: (op.output_names["Out"][0]
+                    if op.type == "elementwise_add" else None),
+        lambda act: act.type in _EW_ACTS,
+        build, keep_names)
+
+
+def fuse_bn_act(program, keep_names=()) -> int:
+    """[batch_norm -> relu] with a solely-consumed Y folds the
+    activation into the batch_norm op (attrs['fused_act']); the BN's
+    normalized output is renamed to the relu's output so downstream
+    consumers are untouched. Returns pairs fused."""
+
+    def build(block, op, act):
+        act_out = block._find_var_recursive(act.output_names["Out"][0])
+        if act_out is None:
+            return None
+        inputs = {slot: [block._find_var_recursive(n) for n in names]
+                  for slot, names in op.input_names.items() if names}
+        outputs = {slot: [block._find_var_recursive(n) for n in names]
+                   for slot, names in op.output_names.items() if names}
+        outputs["Y"] = [act_out]
+        attrs = {k: v for k, v in op.attrs.items()
+                 if not k.startswith("_")}
+        attrs["fused_act"] = "relu"
+        return Operator(block, "batch_norm", inputs=inputs,
+                        outputs=outputs, attrs=attrs)
+
+    return _fuse_pairs(
+        program, "_bn_act_fused",
+        lambda op: (op.output_names["Y"][0]
+                    if op.type == "batch_norm"
+                    and not op.attrs.get("fused_act") else None),
+        lambda act: act.type == "relu",
+        build, keep_names)

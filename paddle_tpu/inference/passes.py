@@ -49,6 +49,10 @@ def conv_bn_fuse(program, scope, keep_names=()) -> int:
         bn = ops[cons[0]]
         if bn.type != "batch_norm":
             continue
+        if bn.attrs.get("fused_act"):
+            # a fuse_bn_act-folded relu rides on this BN: replacing it
+            # with a bias add would silently drop the activation
+            continue
         if not (bn.attrs.get("is_test")
                 or bn.attrs.get("use_global_stats")):
             continue

@@ -348,6 +348,9 @@ def _batch_norm(ins, attrs):
     y = (x.astype(jnp.float32) - use_mean.reshape(cshape)) \
         * inv.reshape(cshape) * scale.astype(jnp.float32).reshape(cshape) \
         + bias.astype(jnp.float32).reshape(cshape)
+    if attrs.get("fused_act") == "relu":
+        # fuse_bn_act_pass folded a trailing relu into this op
+        y = jnp.maximum(y, 0.0)
     return {"Y": y.astype(x.dtype), "MeanOut": mean_out,
             "VarianceOut": var_out, "SavedMean": saved_mean,
             "SavedVariance": saved_var}

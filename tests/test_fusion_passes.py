@@ -1,0 +1,143 @@
+"""fuse_elewise_add_act / fuse_bn_act BuildStrategy knobs as real
+rewrites (reference: ir/fuse_elewise_add_act_pass.cc,
+ir/fuse_bn_act_pass.cc). Training parity must be exact: the rewrites
+run before lowering, so jax.vjp differentiates the fused forward like
+the composition."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework
+from paddle_tpu.core import scope as scope_mod
+
+
+def _fresh():
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    scope_mod._global_scope = scope_mod.Scope()
+
+
+def _build_residual_conv(seed=9):
+    main = framework.default_main_program()
+    st = framework.default_startup_program()
+    main.random_seed = st.random_seed = seed
+    img = fluid.layers.data("image", shape=[3, 8, 8], dtype="float32")
+    y = fluid.layers.data("y", shape=[1], dtype="int64")
+    h = fluid.layers.conv2d(img, 4, 3, padding=1, bias_attr=False)
+    h = fluid.layers.batch_norm(h)
+    h = fluid.layers.relu(h)          # bn -> relu pair
+    res = fluid.layers.conv2d(h, 4, 3, padding=1, bias_attr=False)
+    h = fluid.layers.relu(fluid.layers.elementwise_add(h, res))  # add->relu
+    h = fluid.layers.pool2d(h, pool_type="avg", global_pooling=True)
+    logits = fluid.layers.fc(h, size=3)
+    loss = fluid.layers.mean(
+        fluid.layers.loss.softmax_with_cross_entropy(logits, y))
+    fluid.optimizer.MomentumOptimizer(0.02, momentum=0.9).minimize(loss)
+    return loss
+
+
+def _steps(loss, compiled=None, n=4):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(framework.default_startup_program())
+    r = np.random.RandomState(0)
+    feed = {"image": r.randn(8, 3, 8, 8).astype("float32"),
+            "y": r.randint(0, 3, (8, 1)).astype("int64")}
+    tgt = compiled if compiled is not None else \
+        framework.default_main_program()
+    return [float(np.asarray(exe.run(tgt, feed=feed,
+                                     fetch_list=[loss])[0]).ravel()[0])
+            for _ in range(n)]
+
+
+def test_fusion_passes_training_parity():
+    _fresh()
+    with framework.unique_name_guard():
+        loss = _build_residual_conv()
+        base = _steps(loss)
+
+    _fresh()
+    with framework.unique_name_guard():
+        loss2 = _build_residual_conv()
+        prog = framework.default_main_program()
+        from paddle_tpu.fluid.fusion_passes import (fuse_bn_act,
+                                                    fuse_elewise_add_act)
+
+        n_ew = fuse_elewise_add_act(prog)
+        n_bn = fuse_bn_act(prog)
+        assert n_ew >= 1 and n_bn >= 1, (n_ew, n_bn)
+        types = [op.type for op in prog.global_block().ops]
+        assert "fused_elemwise_activation" in types
+        assert any(op.type == "batch_norm" and op.attrs.get("fused_act")
+                   for op in prog.global_block().ops)
+        got = _steps(loss2)
+    np.testing.assert_allclose(got, base, rtol=1e-5, atol=1e-6)
+
+
+def test_fetched_intermediate_blocks_fusion():
+    """Fetching the BN pre-activation (or the add's intermediate) must
+    keep those vars producible — the pass skips such pairs."""
+    _fresh()
+    with framework.unique_name_guard():
+        main = framework.default_main_program()
+        st = framework.default_startup_program()
+        main.random_seed = st.random_seed = 9
+        img = fluid.layers.data("image", shape=[3, 8, 8],
+                                dtype="float32")
+        h = fluid.layers.conv2d(img, 4, 3, padding=1, bias_attr=False)
+        pre_act = fluid.layers.batch_norm(h)
+        out = fluid.layers.relu(pre_act)
+        loss = fluid.layers.mean(out)
+        bs = fluid.BuildStrategy()
+        bs.fuse_bn_act_ops = True
+        compiled = fluid.CompiledProgram(main, build_strategy=bs)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(st)
+        r = np.random.RandomState(0)
+        feed = {"image": r.randn(2, 3, 8, 8).astype("float32")}
+        # fetching the pre-activation: fusion must be skipped and BOTH
+        # fetches must come back
+        pre, got = exe.run(compiled, feed=feed,
+                           fetch_list=[pre_act, loss])
+        assert np.isfinite(np.asarray(pre)).all()
+        assert np.isfinite(np.asarray(got)).all()
+        assert not any(op.attrs.get("fused_act")
+                       for op in main.global_block().ops
+                       if op.type == "batch_norm")
+
+
+def test_conv_bn_fuse_skips_relu_fused_bn():
+    """inference conv_bn_fuse must not fold a BN carrying a fused relu
+    (the fold would drop the activation)."""
+    from paddle_tpu.fluid.fusion_passes import fuse_bn_act
+    from paddle_tpu.inference.passes import conv_bn_fuse
+    from paddle_tpu.core.scope import global_scope
+
+    _fresh()
+    with framework.unique_name_guard():
+        main = framework.default_main_program()
+        st = framework.default_startup_program()
+        img = fluid.layers.data("image", shape=[3, 8, 8],
+                                dtype="float32")
+        h = fluid.layers.conv2d(img, 4, 3, padding=1, bias_attr=False)
+        h = fluid.layers.batch_norm(h, is_test=True)
+        fluid.layers.relu(h)
+        assert fuse_bn_act(main) == 1
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(st)
+        assert conv_bn_fuse(main, global_scope()) == 0
+
+
+def test_build_strategy_knobs_drive_fusion():
+    _fresh()
+    with framework.unique_name_guard():
+        loss = _build_residual_conv()
+        prog = framework.default_main_program()
+        bs = fluid.BuildStrategy()
+        bs.fuse_elewise_add_act_ops = True
+        bs.fuse_bn_act_ops = True
+        compiled = fluid.CompiledProgram(prog, build_strategy=bs)
+        ls = _steps(loss, compiled=compiled)
+        assert np.isfinite(ls).all()
+        types = [op.type for op in prog.global_block().ops]
+        assert "fused_elemwise_activation" in types
+        assert any(op.type == "batch_norm" and op.attrs.get("fused_act")
+                   for op in prog.global_block().ops)
