@@ -108,6 +108,7 @@ def barrier(group=0):
 
 from . import launch  # noqa: F401,E402
 from .launch import ParallelEnvArgs  # noqa: F401,E402
+from .sharded_checkpoint import ShardedCheckpointManager  # noqa: F401,E402
 
 
 def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):

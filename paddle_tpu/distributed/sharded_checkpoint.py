@@ -1,0 +1,80 @@
+"""Sharded (multi-host/multi-device) checkpointing over orbax —
+SURVEY.md §5's prescribed TPU mapping for the reference's checkpoint
+subsystem ("orbax-style sharded checkpoint"): each device writes its
+own parameter shards, restore re-lays arrays out on the live mesh.
+Complements fluid.io save/load_persistables (single-host, whole arrays,
+reference io.py:598/902 semantics) for the SPMD trainer path
+(parallel/transformer.py) where params are sharded over a Mesh and
+gathering them to one host would not scale.
+
+API mirrors the fleet checkpoint idiom (numbered steps + retention,
+incubate/fleet/collective/__init__.py:155-341 in the reference):
+
+    mgr = ShardedCheckpointManager(dir, max_to_keep=3)
+    mgr.save(step, {"params": params, "opt": opt_state})
+    tree = mgr.restore(template={"params": params, "opt": opt_state})
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+
+class ShardedCheckpointManager:
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._dir = os.path.abspath(directory)
+        self._mgr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True))
+
+    def save(self, step: int, pytree: Any, wait: bool = True) -> None:
+        """Write `pytree` (arbitrarily nested dict/list of jax arrays,
+        sharded or not) as checkpoint `step`; retention prunes old
+        steps past max_to_keep."""
+        import orbax.checkpoint as ocp
+
+        self._mgr.save(int(step), args=ocp.args.StandardSave(pytree))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return list(self._mgr.all_steps())
+
+    def restore(self, step: Optional[int] = None,
+                template: Any = None) -> Any:
+        """Read checkpoint `step` (default: latest). With `template`
+        (a pytree of arrays or ShapeDtypeStructs carrying shardings),
+        restored arrays land DIRECTLY in that layout on the live mesh —
+        no host gather."""
+        import jax
+        import orbax.checkpoint as ocp
+
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                "no checkpoints under %s" % self._dir)
+        if template is None:
+            return self._mgr.restore(int(step))
+
+        def absify(a):
+            if isinstance(a, jax.ShapeDtypeStruct):
+                return a
+            if not hasattr(a, "shape"):
+                return a  # plain python scalar leaf: restore as-is
+            return jax.ShapeDtypeStruct(
+                a.shape, a.dtype,
+                sharding=getattr(a, "sharding", None))
+
+        abstract = jax.tree_util.tree_map(absify, template)
+        return self._mgr.restore(
+            int(step), args=ocp.args.StandardRestore(abstract))
+
+    def close(self) -> None:
+        self._mgr.close()

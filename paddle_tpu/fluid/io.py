@@ -4,9 +4,10 @@
 
 TPU-native: persistables are device arrays in the Scope; save pulls them to
 host and writes one file per var (or a combined pickle), load device_puts
-them back. Formats are numpy-based, self-describing, and sharding-agnostic
-(multi-host sharded checkpoint via orbax arrives with the distributed
-trainer).
+them back. Formats are numpy-based, self-describing, and sharding-agnostic.
+For mesh-sharded SPMD state use
+`paddle_tpu.distributed.ShardedCheckpointManager` (orbax-backed: per-shard
+writes, restore lands directly in the live mesh layout).
 """
 from __future__ import annotations
 

@@ -1,0 +1,121 @@
+"""ShardedCheckpointManager (orbax-backed, SURVEY §5 TPU mapping for
+checkpoint/resume): mesh-sharded SPMD trainer state round-trips with
+shardings preserved, retention prunes old steps, and resumed training
+continues bit-identically."""
+import tempfile
+
+import numpy as np
+import pytest
+
+
+def _mesh_and_params():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = np.array(jax.devices()).reshape(4, 2)
+    mesh = Mesh(devs, ("dp", "tp"))
+    r = np.random.RandomState(0)
+    w = jnp.asarray(r.randn(8, 16).astype("float32"))
+    b = jnp.asarray(r.randn(16).astype("float32"))
+    w = jax.device_put(w, NamedSharding(mesh, P(None, "tp")))
+    b = jax.device_put(b, NamedSharding(mesh, P("tp")))
+    step = jax.device_put(jnp.int32(3), NamedSharding(mesh, P()))
+    return mesh, {"w": w, "b": b, "step": step}
+
+
+def test_sharded_roundtrip_preserves_sharding():
+    import jax
+
+    from paddle_tpu.distributed import ShardedCheckpointManager
+
+    mesh, tree = _mesh_and_params()
+    d = tempfile.mkdtemp()
+    mgr = ShardedCheckpointManager(d, max_to_keep=2)
+    mgr.save(0, tree)
+    assert mgr.latest_step() == 0
+
+    restored = mgr.restore(template=tree)
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.asarray(tree["w"]))
+    np.testing.assert_array_equal(np.asarray(restored["b"]),
+                                  np.asarray(tree["b"]))
+    assert int(restored["step"]) == 3
+    # layout landed back on the live mesh, not gathered to one device
+    assert restored["w"].sharding == tree["w"].sharding
+    assert restored["b"].sharding == tree["b"].sharding
+    mgr.close()
+
+
+def test_scalar_leaves_roundtrip():
+    """Plain python scalars in the state tree (lr, epoch) must survive
+    the save -> restore(template) round trip."""
+    from paddle_tpu.distributed import ShardedCheckpointManager
+
+    _, tree = _mesh_and_params()
+    tree = dict(tree, lr=0.05, epoch=2)
+    d = tempfile.mkdtemp()
+    mgr = ShardedCheckpointManager(d)
+    mgr.save(0, tree)
+    restored = mgr.restore(template=tree)
+    assert float(restored["lr"]) == 0.05
+    assert int(restored["epoch"]) == 2
+    mgr.close()
+
+
+def test_retention_prunes_old_steps():
+    from paddle_tpu.distributed import ShardedCheckpointManager
+
+    _, tree = _mesh_and_params()
+    d = tempfile.mkdtemp()
+    mgr = ShardedCheckpointManager(d, max_to_keep=2)
+    for s in (1, 2, 3):
+        mgr.save(s, tree)
+    assert mgr.latest_step() == 3
+    assert set(mgr.all_steps()) == {2, 3}
+    mgr.close()
+
+
+def test_resume_training_continues_identically():
+    """Save mid-run, keep training; reload and retrain from the
+    checkpoint: the loss tails must match exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed import ShardedCheckpointManager
+
+    mesh, tree = _mesh_and_params()
+    x = jnp.asarray(np.random.RandomState(1).randn(4, 8)
+                    .astype("float32"))
+
+    @jax.jit
+    def step(params):
+        def loss_fn(p):
+            return jnp.mean((x @ p["w"] + p["b"]) ** 2)
+
+        l, g = jax.value_and_grad(loss_fn)(
+            {"w": params["w"], "b": params["b"]})
+        return l, {"w": params["w"] - 0.05 * g["w"],
+                   "b": params["b"] - 0.05 * g["b"],
+                   "step": params["step"] + 1}
+
+    d = tempfile.mkdtemp()
+    mgr = ShardedCheckpointManager(d)
+    p = tree
+    for _ in range(3):
+        _, p = step(p)
+    mgr.save(int(p["step"]), p)
+    tail_a = []
+    q = p
+    for _ in range(3):
+        l, q = step(q)
+        tail_a.append(float(l))
+
+    restored = mgr.restore(template=tree)
+    tail_b = []
+    q2 = restored
+    for _ in range(3):
+        l, q2 = step(q2)
+        tail_b.append(float(l))
+    np.testing.assert_array_equal(tail_a, tail_b)
+    mgr.close()
