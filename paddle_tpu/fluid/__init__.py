@@ -10,8 +10,11 @@ from .framework import (  # noqa: F401
     default_main_program, default_startup_program, name_scope,
     device_guard, in_dygraph_mode, cpu_places, cuda_places, tpu_places,
     CPUPlace, CUDAPlace, CUDAPinnedPlace, TPUPlace,
-    unique_name_guard,
+    unique_name_guard, require_version, is_compiled_with_cuda,
+    load_op_library, ComplexVariable,
 )
+from . import unique_name  # noqa: F401
+from .parallel_executor import ParallelExecutor  # noqa: F401
 from .. import core  # noqa: F401  (fluid.core.CipherUtils etc.)
 from ..core.scope import Scope, global_scope, scope_guard  # noqa: F401
 from ..core.lod import (  # noqa: F401
@@ -77,6 +80,7 @@ from ..utils.flags import get_flags, set_flags  # noqa: F401,E402
 from . import transpiler  # noqa: F401,E402
 from .transpiler import (  # noqa: F401,E402
     DistributeTranspiler, DistributeTranspilerConfig,
+    memory_optimize, release_memory,
 )
 
 # composite network builders (reference: python/paddle/fluid/nets.py)

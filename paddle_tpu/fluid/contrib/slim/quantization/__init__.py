@@ -1,5 +1,6 @@
 from .quantization_pass import (  # noqa: F401
     QuantizationTransformPass, QuantizationFreezePass,
+    OutScaleForTrainingPass, OutScaleForInferencePass,
 )
 from .post_training_quantization import (  # noqa: F401
     PostTrainingQuantization,

@@ -69,6 +69,20 @@ class Executor:
 
                 fuse_bn_act(program, keep_names=fetch_names)
 
+        # the fusion passes run once, keyed to the FIRST run's fetch
+        # list; a later run fetching a since-fused-away intermediate
+        # must get an error naming the responsible knob, not lowering's
+        # generic "never computed"
+        fused_away = getattr(program, "_fused_away_vars", {})
+        for n in fetch_names:
+            if n in fused_away:
+                raise RuntimeError(
+                    "fetch var %r was removed from this program by the "
+                    "BuildStrategy.%s fusion pass (applied on the "
+                    "program's first run, which did not fetch it). "
+                    "Fetch it on the first run, disable the knob, or "
+                    "rebuild the program." % (n, fused_away[n]))
+
         # PS mode: the communicator needs this step's grads — extend the
         # fetch list internally (reference: send ops read the grad vars)
         ps_cfg = getattr(program, "_ps_cfg", None)

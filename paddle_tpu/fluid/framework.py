@@ -31,13 +31,14 @@ from ..core.place import (  # noqa: F401  (re-exported)
 
 
 class _UniqueNameGenerator:
-    def __init__(self):
+    def __init__(self, prefix=None):
         self.ids = collections.defaultdict(int)
+        self.prefix = prefix or ""
 
     def __call__(self, key):
         tmp = self.ids[key]
         self.ids[key] += 1
-        return "_".join([key, str(tmp)])
+        return self.prefix + "_".join([key, str(tmp)])
 
 
 _name_generator = _UniqueNameGenerator()
@@ -51,7 +52,7 @@ def unique_name(key: str) -> str:
 def unique_name_guard(prefix: str = ""):
     global _name_generator
     old = _name_generator
-    _name_generator = _UniqueNameGenerator()
+    _name_generator = _UniqueNameGenerator(prefix)
     try:
         yield
     finally:
@@ -63,6 +64,91 @@ GRAD_SUFFIX = "@GRAD"
 
 def grad_var_name(name: str) -> str:
     return name + GRAD_SUFFIX
+
+
+def require_version(min_version, max_version=None):
+    """Raise unless the installed framework version is within
+    [min_version, max_version] (max_version None = no upper bound).
+    Reference: `python/paddle/fluid/framework.py:73`. Version strings
+    are dotted integers, short forms zero-extended ('1.4' == '1.4.0')."""
+    if not isinstance(min_version, str):
+        raise TypeError("min_version must be str, got %s"
+                        % type(min_version))
+    if not isinstance(max_version, (str, type(None))):
+        raise TypeError("max_version must be str or None, got %s"
+                        % type(max_version))
+
+    def parse(v):
+        parts = v.split(".")
+        if not parts or not all(p.isdigit() for p in parts):
+            raise ValueError(
+                "version must be dotted integers like '1.4.0', got %r"
+                % v)
+        nums = [int(p) for p in parts]
+        return tuple(nums + [0] * (4 - len(nums)))
+
+    from .. import __version__
+
+    installed = parse(__version__)
+    if installed < parse(min_version):
+        raise Exception(
+            "installed version %s is below the required minimum %s"
+            % (__version__, min_version))
+    if max_version is not None and installed > parse(max_version):
+        raise Exception(
+            "installed version %s is above the required maximum %s"
+            % (__version__, max_version))
+
+
+def is_compiled_with_cuda() -> bool:
+    """Always False: this build targets TPU via XLA (reference:
+    `framework.py:151`); scripts use it to pick CUDAPlace vs CPUPlace."""
+    return False
+
+
+def load_op_library(lib_filename):
+    """Load a shared library of custom operators (reference:
+    `framework.py:5395` loads a .so of REGISTER_OPERATOR ops). Here
+    custom op *kernels* are Python entries in the op registry
+    (paddle_tpu.ops.register_op); a native .so may still carry
+    C-ABI helpers, which this loads via ctypes. The library's
+    `paddle_tpu_register_ops` hook is invoked when exported."""
+    import ctypes
+
+    lib = ctypes.CDLL(lib_filename)
+    hook = getattr(lib, "paddle_tpu_register_ops", None)
+    if hook is not None:
+        hook()
+    return lib
+
+
+class ComplexVariable:
+    """Pair of real/imag Variables — the reference's dygraph-only
+    complex-number carrier (`framework.py:1691`). Arithmetic composes
+    the underlying ops; kept minimal (the TPU-native path represents
+    complex data as paired reals end to end)."""
+
+    def __init__(self, real, imag):
+        self.real = real
+        self.imag = imag
+
+    @property
+    def shape(self):
+        return self.real.shape
+
+    @property
+    def dtype(self):
+        return self.real.dtype
+
+    def numpy(self):
+        import numpy as np
+
+        return (np.asarray(self.real.numpy())
+                + 1j * np.asarray(self.imag.numpy()))
+
+    def __repr__(self):
+        return "ComplexVariable(real=%r, imag=%r)" % (self.real,
+                                                      self.imag)
 
 
 # ---------------------------------------------------------------------------
@@ -518,6 +604,11 @@ class Program:
 _IS_TEST_OPS = {
     "dropout": ("is_test",),
     "batch_norm": ("is_test",),
+    # QAT: eval/inference clones must stop mutating calibration state
+    "fake_quantize_moving_average_abs_max": ("is_test",),
+    "fake_quantize_dequantize_moving_average_abs_max": ("is_test",),
+    "fake_quantize_range_abs_max": ("is_test",),
+    "moving_average_abs_max_scale": ("is_test",),
 }
 
 # ---------------------------------------------------------------------------

@@ -20,6 +20,8 @@ from __future__ import annotations
 
 from typing import Dict, List, Tuple
 
+from . import lowering
+
 # op type -> (input slots to coalesce, output slots produced per member)
 _FUSABLE: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
     "sgd": (("Param", "Grad"), ("ParamOut",)),
@@ -46,6 +48,10 @@ def fuse_optimizer_ops(program) -> int:
         return 0
     block = program.global_block()
     ops = list(block.ops)
+    # one recursive (reads, writes) walk per op, shared by every group's
+    # interference scan below (groups typically span the whole tail)
+    rw = [lowering._op_reads_writes(op) for op in ops]
+    rw = [(set(r), set(w)) for r, w in rw]
 
     groups: Dict[tuple, List[int]] = {}
     for i, op in enumerate(ops):
@@ -87,11 +93,14 @@ def fuse_optimizer_ops(program) -> int:
             op = ops[j]
             if id(op) in member_ids:
                 continue
-            touched = set(op.input_arg_names) | set(op.output_arg_names)
-            if touched & written:
+            # recursive touch sets: a control-flow op whose sub-block
+            # reads/writes group vars is interference too (ADVICE r4 —
+            # input/output_arg_names don't surface sub-block accesses)
+            reads_j, writes_j = rw[j]
+            if (reads_j | writes_j) & written:
                 safe = False
                 break
-            if set(op.output_arg_names) & member_reads:
+            if writes_j & member_reads:
                 safe = False
                 break
         if not safe:

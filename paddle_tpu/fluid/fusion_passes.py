@@ -29,12 +29,20 @@ def _fuse_pairs(program, marker, match_producer, match_consumer,
     persistable or in keep_names."""
     if getattr(program, marker, False):
         return 0
+    from . import lowering
+
     block = program.global_block()
     ops = list(block.ops)
     keep = set(keep_names)
+    # consumer map via lowering's recursive read analysis: a var read
+    # only inside a while/cond/scan sub-block is still a consumer
+    # (control-flow ops don't declare enclosing-env reads as op inputs
+    # — ADVICE r4: input_arg_names alone left sub-block-read Y's
+    # silently unproduced after fuse_bn_act renamed them)
     consumers = {}
     for i, op in enumerate(ops):
-        for n in op.input_arg_names:
+        reads, _ = lowering._op_reads_writes(op)
+        for n in set(reads):
             consumers.setdefault(n, []).append(i)
 
     fused = 0
@@ -106,6 +114,12 @@ def fuse_bn_act(program, keep_names=()) -> int:
         act_out = block._find_var_recursive(act.output_names["Out"][0])
         if act_out is None:
             return None
+        # the BN's original Y name disappears from the program: record
+        # it so a LATER run fetching it gets a descriptive error naming
+        # the knob instead of lowering's generic "never computed"
+        dropped = block.program._fused_away_vars = getattr(
+            block.program, "_fused_away_vars", {})
+        dropped[op.output_names["Y"][0]] = "fuse_bn_act_ops"
         inputs = {slot: [block._find_var_recursive(n) for n in names]
                   for slot, names in op.input_names.items() if names}
         outputs = {slot: [block._find_var_recursive(n) for n in names]

@@ -92,15 +92,17 @@ class Scan:
         self._iter_var: Optional[Variable] = None
 
     def iteration(self) -> Variable:
-        """[1] int64 var holding the current iteration index inside the
+        """[1] int32 var holding the current iteration index inside the
         body — e.g. the scatter index for per-iteration slice updates of
-        stacked state (BN running stats in a scanned residual stage)."""
+        stacked state (BN running stats in a scanned residual stage).
+        int32 is JAX's canonical index dtype (int64 would truncate
+        under default config and warn on every trace)."""
         if self._sub is None:
             raise ValueError(
                 "iteration() must be called inside `with scan.block():`")
         if self._iter_var is None:
             self._iter_var = self._sub.create_var(
-                name=unique_name("scan_iter"), shape=(1,), dtype="int64")
+                name=unique_name("scan_iter"), shape=(1,), dtype="int32")
         return self._iter_var
 
     def slice_input(self, stacked: Variable) -> Variable:

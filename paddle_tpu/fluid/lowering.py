@@ -379,7 +379,7 @@ def _exec_scan(op, env, key0, op_idx, amp_lists):
         e.update(zip(carry_names, carry[1:]))
         e.update(zip(xs_slice, xs))
         if iter_name:
-            e[iter_name] = jnp.reshape(it, (1,)).astype(jnp.int64)
+            e[iter_name] = jnp.reshape(it, (1,)).astype(jnp.int32)
         # per-iteration rng so dropout masks differ across layers
         _run_ops(sub.ops, e, jax.random.fold_in(base_key, it),
                  amp_lists=amp_lists)

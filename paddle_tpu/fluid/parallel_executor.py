@@ -1,0 +1,64 @@
+"""fluid.ParallelExecutor — legacy data-parallel executor, as a compat
+class over CompiledProgram.with_data_parallel.
+
+Reference parity: `python/paddle/fluid/parallel_executor.py:29`
+(ParallelExecutor.__init__/run/drop_local_exe_scopes). TPU-native: the
+reference's SSA-graph multi-device executor collapsed into XLA — the
+class builds the same CompiledProgram DP path `Executor.run` serves
+(shard_map over the device mesh), so the legacy idiom
+``fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name)`` runs
+unmodified. Its `run` keeps the legacy contract: fetch_list FIRST,
+feed/feed_dict keywords, per-run fetch targets.
+"""
+from __future__ import annotations
+
+from . import framework
+from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy
+from .executor import Executor
+
+
+class ParallelExecutor:
+    def __init__(self, use_cuda, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None):
+        from ..core.scope import global_scope
+
+        self._places = (framework.cuda_places() if use_cuda
+                        else framework.cpu_places())
+        self._scope = scope if scope is not None else global_scope()
+        main_program = (main_program if main_program is not None
+                        else framework.default_main_program())
+        self._build_strategy = build_strategy or BuildStrategy()
+        if num_trainers != 1:
+            self._build_strategy.num_trainers = num_trainers
+            self._build_strategy.trainer_id = trainer_id
+        self._exec_strategy = exec_strategy or ExecutionStrategy()
+        share = getattr(share_vars_from, "_compiled_program", None) \
+            if share_vars_from is not None else None
+        self._compiled_program = CompiledProgram(
+            main_program, build_strategy=self._build_strategy
+        ).with_data_parallel(
+            loss_name=loss_name, exec_strategy=self._exec_strategy,
+            share_vars_from=share)
+        self._exe = Executor(self._places[0])
+
+    def run(self, fetch_list, feed=None, feed_dict=None,
+            return_numpy=True):
+        """Legacy argument order: fetch_list positionally first;
+        feed_dict is the deprecated alias for feed."""
+        if feed is None:
+            feed = feed_dict
+        return self._exe.run(self._compiled_program, feed=feed,
+                             fetch_list=fetch_list,
+                             scope=self._scope,
+                             return_numpy=return_numpy)
+
+    def drop_local_exe_scopes(self):
+        """Reference drops the per-place local scopes between
+        iterations; the XLA path holds no per-place scopes, so there is
+        nothing to free — kept for API compatibility."""
+
+    @property
+    def device_count(self):
+        return len(self._places)

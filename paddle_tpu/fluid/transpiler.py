@@ -305,3 +305,27 @@ class DistributeTranspiler:
                                       in op.output_names.items()},
                              attrs=dict(op.attrs))
         return prog
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=True):
+    """Deprecated no-op (reference:
+    `transpiler/memory_optimization_transpiler.py:18` — already a
+    warn-and-return there; buffer reuse is owned by the runtime, here by
+    XLA's buffer assignment + donation)."""
+    import warnings
+
+    warnings.warn(
+        "paddle_tpu.fluid.memory_optimize is deprecated and does "
+        "nothing: XLA buffer assignment (plus executor donation) owns "
+        "memory reuse.", DeprecationWarning, stacklevel=2)
+
+
+def release_memory(input_program, skip_opt_set=None):
+    """Deprecated no-op twin of memory_optimize (reference:
+    `memory_optimization_transpiler.py:44`)."""
+    import warnings
+
+    warnings.warn(
+        "paddle_tpu.fluid.release_memory is deprecated and does "
+        "nothing.", DeprecationWarning, stacklevel=2)

@@ -1,0 +1,49 @@
+"""fluid.unique_name — the public unique-name namespace.
+
+Reference parity: `python/paddle/fluid/unique_name.py` (generate /
+generate_with_ignorable_key / switch / guard; `fluid.unique_name.guard()`
+is the idiom in virtually every reference multi-program script). The
+generator state is the SAME one `framework.unique_name` /
+`framework.unique_name_guard` use, so the two surfaces compose.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from . import framework
+
+UniqueNameGenerator = framework._UniqueNameGenerator
+
+
+def generate(key: str) -> str:
+    """Unique name with `key` as prefix, e.g. fc_0, fc_1, ..."""
+    return framework.unique_name(key)
+
+
+def generate_with_ignorable_key(key: str) -> str:
+    """Names for intermediate vars the user never addresses (the
+    reference tags them with a special prefix so save/load skips them;
+    the tag is preserved for that contract)."""
+    return framework.unique_name("_generated_var_" + key)
+
+
+def switch(new_generator=None):
+    """Replace the global generator; returns the previous one."""
+    old = framework._name_generator
+    framework._name_generator = (new_generator if new_generator
+                                 is not None else UniqueNameGenerator())
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    """Fresh (or given) generator within the `with` scope — keeps name
+    counters of independently built programs from colliding."""
+    if isinstance(new_generator, str):
+        # reference accepts a string prefix here
+        new_generator = UniqueNameGenerator(new_generator)
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

@@ -22,14 +22,20 @@ def conv_bn_fuse(program, scope, keep_names=()) -> int:
     be rescaled/dropped, so those pairs are skipped."""
     import jax.numpy as jnp
 
+    from ..fluid import lowering
     from ..fluid.framework import Operator
 
     block = program.global_block()
     ops = list(block.ops)
     keep = set(keep_names)
+    # recursive read analysis: a conv output also read inside a
+    # while/cond/scan body must count as a second consumer, or its
+    # weights get rescaled in scope while the sub-block still reads the
+    # pre-BN-fold activation (ADVICE r4)
     consumers = {}
     for i, op in enumerate(ops):
-        for n in op.input_arg_names:
+        reads, _ = lowering._op_reads_writes(op)
+        for n in set(reads):
             consumers.setdefault(n, []).append(i)
 
     fused = 0

@@ -562,20 +562,32 @@ def _sdpa(ins, attrs):
     is_test = attrs.get("is_test", False)
     drop_active = (not is_test) and p_drop > 0.0
 
-    if not drop_active and mask is None:
+    if mask is None:
         # Pallas flash only where its O(S) memory matters: below the
         # threshold XLA's fused softmax-attention is faster on v5e
         # (FLAGS_flash_attention_min_seq; measured: flash loses up to at
         # least S=2048 forward, but avoids the S^2 score buffer).
+        # Dropout-active training takes this path too: the kernel
+        # applies prob-dropout in-VMEM (mask regenerated in backward
+        # from the seed — no S^2 mask buffer in HBM).
         from ..utils import flags as _flags
         min_seq = int(_flags.get_flags(
             ["FLAGS_flash_attention_min_seq"])
             ["FLAGS_flash_attention_min_seq"])
         if jax.default_backend() == "tpu" and k.shape[-2] >= min_seq:
+            seed = None
+            if drop_active:
+                seed = jax.random.randint(
+                    attrs["_rng_key"], (1,), 0, 2 ** 31 - 1,
+                    dtype=jnp.int32)
             return {"Out": _flash(q, k, v, key_bias=bias, causal=causal,
-                                  sm_scale=sm_scale)}
-        return {"Out": _ref_attn(q, k, v, key_bias=bias, causal=causal,
-                                 sm_scale=sm_scale)}
+                                  sm_scale=sm_scale,
+                                  dropout_p=p_drop if drop_active
+                                  else 0.0,
+                                  dropout_seed=seed)}
+        if not drop_active:
+            return {"Out": _ref_attn(q, k, v, key_bias=bias,
+                                     causal=causal, sm_scale=sm_scale)}
 
     # Unfused path with dropout on probs (matches layers.softmax+dropout).
     # MXU note: keep the matmul inputs in their compute dtype (bf16 under

@@ -41,6 +41,42 @@ _NEG_INF = -1e30
 _LANES = 128  # VREG lane count: scratch stats are replicated across lanes
 
 
+def _dropout_mask(seed, bh, row0, col0, block_q, block_k, p_drop):
+    """Per-element keep/scale mask for attention-prob dropout, from a
+    counter-based hash (murmur3 finalizer over the GLOBAL (row, col,
+    batch*head, seed) coordinates). Deterministic per coordinate, so the
+    backward kernels regenerate the identical mask regardless of grid
+    iteration order, with no O(S^2) HBM mask buffer — the whole point of
+    the flash recipe. Plain uint32 vector ops: lowers under Mosaic and
+    the interpreter alike (pltpu.prng_* has no CPU interpret rule
+    here)."""
+    # every operand must be uint32 BEFORE arithmetic: row0/col0/bh are
+    # traced int32 (program_id), and int32+uint32 promotion would make
+    # the multiplies signed and the shifts arithmetic
+    row0 = jnp.asarray(row0).astype(jnp.uint32)
+    col0 = jnp.asarray(col0).astype(jnp.uint32)
+    rows = row0 + lax.broadcasted_iota(jnp.uint32, (block_q, block_k), 0)
+    cols = col0 + lax.broadcasted_iota(jnp.uint32, (block_q, block_k), 1)
+    x = (rows * jnp.uint32(0x9E3779B1)) ^ (cols * jnp.uint32(0x85EBCA77))
+    x = x ^ (jnp.asarray(bh).astype(jnp.uint32)
+             * jnp.uint32(0xC2B2AE3D)) ^ seed.astype(jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    thresh = jnp.uint32(min(int(p_drop * 4294967296.0), 0xFFFFFFFF))
+    return jnp.where(x >= thresh, 1.0 / (1.0 - p_drop),
+                     0.0).astype(jnp.float32)
+
+
+def _seed_spec():
+    # scalar dropout seed rides in SMEM (full-array spec; one int32)
+    if _HAS_PLTPU:
+        return pl.BlockSpec(memory_space=pltpu.SMEM)
+    return pl.BlockSpec(memory_space=pl.MemorySpace.ANY)  # pragma: no cover
+
+
 def _interpret_default() -> bool:
     # Real Mosaic kernels only lower for TPU; interpret everywhere else
     # (CPU tests, GPU installs).
@@ -70,9 +106,10 @@ def _vmem(shape, dtype):
 # Forward kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, sm_scale, causal,
-                block_q, block_k):
+                block_q, block_k, p_drop):
+    bh = pl.program_id(0)
     iq = pl.program_id(1)
     ik = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -108,8 +145,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         m_next = jnp.maximum(m_prev, m_curr)             # [bq, LANES]
         p = jnp.exp(s - m_next[:, :1])                   # [bq, bk]
         alpha = jnp.exp(m_prev - m_next)                 # [bq, LANES]
+        # l accumulates the PRE-dropout sums: the softmax denominator is
+        # over the full probs; dropout only zeroes/rescales the numerator
+        # (out = dropout(softmax(s)) @ v)
         l_scr[:] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         m_scr[:] = m_next
+        if p_drop > 0.0:
+            p = p * _dropout_mask(seed_ref[0].astype(jnp.uint32), bh,
+                                  iq * block_q, ik * block_k,
+                                  block_q, block_k, p_drop)
         pv = lax.dot_general(p, v_ref[0].astype(jnp.float32),
                              (((1,), (0,)), ((), ())),
                              preferred_element_type=jnp.float32)
@@ -125,8 +169,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         lse_ref[0] = m_row + jnp.log(l_safe)                # [bq, 1]
 
 
-def _fwd_call(q, k, v, key_bias, sm_scale, causal, block_q, block_k,
-              interpret):
+def _fwd_call(q, k, v, key_bias, seed, sm_scale, causal, block_q,
+              block_k, p_drop, interpret):
     BH, S, D = q.shape
     Sk = k.shape[1]
     nq, nk = S // block_q, Sk // block_k
@@ -138,26 +182,27 @@ def _fwd_call(q, k, v, key_bias, sm_scale, causal, block_q, block_k,
         pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
     ]
     args = [q, k, v]
-    if key_bias is not None:
+    has_bias = key_bias is not None
+    has_drop = p_drop > 0.0
+    if has_bias:
         # [BH, 1, Sk]: lane-layout so (1, bk) broadcasts over score rows
         in_specs.append(
             pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j)))
         args.append(key_bias)
+    if has_drop:
+        in_specs.append(_seed_spec())
+        args.append(seed)
 
-    if key_bias is not None:
-        def kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
-                   m_scr, l_scr, acc_scr):
-            return _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref,
-                               lse_ref, m_scr, l_scr, acc_scr,
-                               sm_scale=sm_scale, causal=causal,
-                               block_q=block_q, block_k=block_k)
-    else:
-        def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                   m_scr, l_scr, acc_scr):
-            return _fwd_kernel(q_ref, k_ref, v_ref, None, o_ref,
-                               lse_ref, m_scr, l_scr, acc_scr,
-                               sm_scale=sm_scale, causal=causal,
-                               block_q=block_q, block_k=block_k)
+    def kernel(*refs):
+        ins = refs[:len(args)]
+        bias_ref = ins[3] if has_bias else None
+        seed_ref = ins[3 + int(has_bias)] if has_drop else None
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = refs[len(args):]
+        return _fwd_kernel(ins[0], ins[1], ins[2], bias_ref, seed_ref,
+                           o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                           sm_scale=sm_scale, causal=causal,
+                           block_q=block_q, block_k=block_k,
+                           p_drop=p_drop)
 
     o, lse = pl.pallas_call(
         kernel,
@@ -188,8 +233,9 @@ def _fwd_call(q, k, v, key_bias, sm_scale, causal, block_q, block_k,
 # ---------------------------------------------------------------------------
 
 def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
-                    bias_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
-                    sm_scale, causal, block_q, block_k):
+                    bias_ref, seed_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, sm_scale, causal, block_q, block_k, p_drop):
+    bh = pl.program_id(0)
     ik = pl.program_id(1)
     iq = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -218,13 +264,25 @@ def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
         p = jnp.exp(s - lse_ref[0])                      # [bq, bk]
-        # dv += p^T @ do
+        # with dropout: O = (P∘M) @ V, so dV = (P∘M)^T @ dO and
+        # dP = (dO @ V^T)∘M; delta = rowsum(dO∘O) is unchanged because
+        # rowsum((P∘M)∘dZ) = rowsum(dO∘O) still holds with Z = P∘M
+        if p_drop > 0.0:
+            mask = _dropout_mask(seed_ref[0].astype(jnp.uint32), bh,
+                                 iq * block_q, ik * block_k,
+                                 block_q, block_k, p_drop)
+            z = p * mask
+        else:
+            z = p
+        # dv += (p∘M)^T @ do
         dv_scr[:] = dv_scr[:] + lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            z, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        # dp = do @ v^T ; ds = p * (dp - delta)
+        # dp = do @ v^T ; ds = p * (dp∘M - delta)
         dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
+        if p_drop > 0.0:
+            dp = dp * mask
         ds = p * (dp - delta_ref[0]) * sm_scale
         # dk += ds^T @ q
         dk_scr[:] = dk_scr[:] + lax.dot_general(
@@ -238,8 +296,9 @@ def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
 
 
 def _bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
-                   bias_ref, dq_ref, dq_scr, *,
-                   sm_scale, causal, block_q, block_k):
+                   bias_ref, seed_ref, dq_ref, dq_scr, *,
+                   sm_scale, causal, block_q, block_k, p_drop):
+    bh = pl.program_id(0)
     iq = pl.program_id(1)
     ik = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -269,6 +328,10 @@ def _bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
         p = jnp.exp(s - lse_ref[0])
         dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
+        if p_drop > 0.0:
+            dp = dp * _dropout_mask(
+                seed_ref[0].astype(jnp.uint32), bh, iq * block_q,
+                ik * block_k, block_q, block_k, p_drop)
         ds = p * (dp - delta_ref[0]) * sm_scale
         dq_scr[:] = dq_scr[:] + lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
@@ -279,8 +342,8 @@ def _bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _bwd_call(q, k, v, key_bias, o, lse, do, sm_scale, causal,
-              block_q, block_k, interpret):
+def _bwd_call(q, k, v, key_bias, seed, o, lse, do, sm_scale, causal,
+              block_q, block_k, p_drop, interpret):
     BH, S, D = q.shape
     Sk = k.shape[1]
     nq, nk = S // block_q, Sk // block_k
@@ -288,19 +351,18 @@ def _bwd_call(q, k, v, key_bias, o, lse, do, sm_scale, causal,
                     axis=-1, keepdims=True)                   # [BH, S, 1]
 
     has_bias = key_bias is not None
+    has_drop = p_drop > 0.0
 
     def dkv_kernel(*refs):
-        if has_bias:
-            (q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, bias_ref,
-             dk_ref, dv_ref, dk_scr, dv_scr) = refs
-        else:
-            (q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
-             dk_ref, dv_ref, dk_scr, dv_scr) = refs
-            bias_ref = None
-        _bwd_dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
-                        bias_ref, dk_ref, dv_ref, dk_scr, dv_scr,
-                        sm_scale=sm_scale, causal=causal,
-                        block_q=block_q, block_k=block_k)
+        n_in = 6 + int(has_bias) + int(has_drop)
+        ins = refs[:n_in]
+        bias_ref = ins[6] if has_bias else None
+        seed_ref = ins[6 + int(has_bias)] if has_drop else None
+        dk_ref, dv_ref, dk_scr, dv_scr = refs[n_in:]
+        _bwd_dkv_kernel(ins[0], ins[1], ins[2], ins[3], ins[4], ins[5],
+                        bias_ref, seed_ref, dk_ref, dv_ref, dk_scr,
+                        dv_scr, sm_scale=sm_scale, causal=causal,
+                        block_q=block_q, block_k=block_k, p_drop=p_drop)
 
     in_specs = [
         pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),  # q
@@ -315,6 +377,9 @@ def _bwd_call(q, k, v, key_bias, o, lse, do, sm_scale, causal,
         in_specs.append(
             pl.BlockSpec((1, 1, block_k), lambda b, j, i: (b, 0, j)))
         args.append(key_bias)
+    if has_drop:
+        in_specs.append(_seed_spec())
+        args.append(seed)
 
     dk, dv = pl.pallas_call(
         dkv_kernel,
@@ -337,17 +402,15 @@ def _bwd_call(q, k, v, key_bias, o, lse, do, sm_scale, causal,
     )(*args)
 
     def dq_kernel(*refs):
-        if has_bias:
-            (q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, bias_ref,
-             dq_ref, dq_scr) = refs
-        else:
-            (q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
-             dq_ref, dq_scr) = refs
-            bias_ref = None
-        _bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
-                       bias_ref, dq_ref, dq_scr,
+        n_in = 6 + int(has_bias) + int(has_drop)
+        ins = refs[:n_in]
+        bias_ref = ins[6] if has_bias else None
+        seed_ref = ins[6 + int(has_bias)] if has_drop else None
+        dq_ref, dq_scr = refs[n_in:]
+        _bwd_dq_kernel(ins[0], ins[1], ins[2], ins[3], ins[4], ins[5],
+                       bias_ref, seed_ref, dq_ref, dq_scr,
                        sm_scale=sm_scale, causal=causal,
-                       block_q=block_q, block_k=block_k)
+                       block_q=block_q, block_k=block_k, p_drop=p_drop)
 
     in_specs_q = [
         pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),  # q
@@ -360,6 +423,8 @@ def _bwd_call(q, k, v, key_bias, o, lse, do, sm_scale, causal,
     if has_bias:
         in_specs_q.append(
             pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j)))
+    if has_drop:
+        in_specs_q.append(_seed_spec())
 
     dq = pl.pallas_call(
         dq_kernel,
@@ -389,42 +454,60 @@ def _pad_to(x, axis, mult, value=0.0):
     return jnp.pad(x, widths, constant_values=value)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash_core(q, k, v, key_bias, sm_scale, causal, block_q, block_k):
-    o, _ = _fwd_call(q, k, v, key_bias, sm_scale, causal, block_q,
-                     block_k, _interpret_default())
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_core(q, k, v, key_bias, seed, sm_scale, causal, block_q,
+                block_k, p_drop):
+    o, _ = _fwd_call(q, k, v, key_bias, seed, sm_scale, causal, block_q,
+                     block_k, p_drop, _interpret_default())
     return o
 
 
-def _flash_core_fwd(q, k, v, key_bias, sm_scale, causal, block_q, block_k):
-    o, lse = _fwd_call(q, k, v, key_bias, sm_scale, causal, block_q,
-                       block_k, _interpret_default())
-    return o, (q, k, v, key_bias, o, lse)
+def _flash_core_fwd(q, k, v, key_bias, seed, sm_scale, causal, block_q,
+                    block_k, p_drop):
+    o, lse = _fwd_call(q, k, v, key_bias, seed, sm_scale, causal,
+                       block_q, block_k, p_drop, _interpret_default())
+    return o, (q, k, v, key_bias, seed, o, lse)
 
 
-def _flash_core_bwd(sm_scale, causal, block_q, block_k, res, do):
-    q, k, v, key_bias, o, lse = res
-    dq, dk, dv = _bwd_call(q, k, v, key_bias, o, lse, do, sm_scale,
-                           causal, block_q, block_k, _interpret_default())
+def _flash_core_bwd(sm_scale, causal, block_q, block_k, p_drop, res, do):
+    q, k, v, key_bias, seed, o, lse = res
+    dq, dk, dv = _bwd_call(q, k, v, key_bias, seed, o, lse, do, sm_scale,
+                           causal, block_q, block_k, p_drop,
+                           _interpret_default())
     dbias = None if key_bias is None else jnp.zeros_like(key_bias)
-    return dq, dk, dv, dbias
+    return dq, dk, dv, dbias, None
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
 def flash_attention(q, k, v, key_bias=None, causal=False, sm_scale=None,
-                    block_q=128, block_k=128):
+                    block_q=128, block_k=128, dropout_p=0.0,
+                    dropout_seed=None):
     """Blockwise (flash) attention.
 
     q: [B, H, Sq, D]; k, v: [B, H, Sk, D]; key_bias: optional [B, Sk]
     additive bias on keys (e.g. `(mask - 1) * 1e4` padding bias;
     non-differentiable). Returns [B, H, Sq, D] in q.dtype.
+
+    dropout_p > 0 applies upscale-in-train dropout to the normalized
+    attention probs INSIDE the kernel (mask regenerated from
+    (dropout_seed, coordinates) in backward — no O(S^2) mask buffer),
+    so dropout-active pretraining can run the flash path. dropout_seed:
+    int32 scalar (traced is fine), required when dropout_p > 0.
     """
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(D)
+    dropout_p = float(dropout_p)
+    if not 0.0 <= dropout_p < 1.0:
+        raise ValueError("dropout_p must be in [0, 1): %r" % dropout_p)
+    seed = None
+    if dropout_p > 0.0:
+        if dropout_seed is None:
+            raise ValueError("dropout_p > 0 requires dropout_seed")
+        seed = jnp.reshape(dropout_seed, (1,)).astype(jnp.int32)
 
     block_q = min(block_q, -(-Sq // 8) * 8)
     block_k = min(block_k, -(-Sk // 8) * 8)
@@ -443,8 +526,8 @@ def flash_attention(q, k, v, key_bias=None, causal=False, sm_scale=None,
         # one bias row per (b, h) program, lane-layout [BH, 1, Sk]
         bias = jnp.repeat(bias, H, axis=0)[:, None, :]
 
-    o = _flash_core(qf, kf, vf, bias, float(sm_scale), bool(causal),
-                    int(block_q), int(block_k))
+    o = _flash_core(qf, kf, vf, bias, seed, float(sm_scale),
+                    bool(causal), int(block_q), int(block_k), dropout_p)
     return o[:, :Sq, :].reshape(B, H, Sq, D)
 
 

@@ -167,6 +167,10 @@ def _ma_abs_max_scale(ins, attrs):
     rate = attrs.get("moving_rate", 0.9)
     in_scale = ins["InScale"][0].reshape(()) if ins.get("InScale") \
         else jnp.float32(0.0)
+    if attrs.get("is_test", False):
+        # eval/inference must not mutate the calibration state
+        # (reference: moving_average_abs_max_scale_op is_test branch)
+        return {"Out": x, "OutScale": jnp.reshape(in_scale, (1,))}
     cur = jnp.max(jnp.abs(x))
     scale = jnp.where(in_scale > 0, rate * in_scale + (1 - rate) * cur,
                       cur)

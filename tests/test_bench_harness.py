@@ -9,6 +9,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.dist
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 import bench  # noqa: E402
@@ -90,26 +92,37 @@ def _resnet_result(v=1500.0):
             "platform": "tpu", "mfu_pct": 9.4}
 
 
+def _longctx_result(v=50000.0):
+    return {"metric": "bert_longctx4096_pretrain_throughput", "value": v,
+            "unit": "tokens/sec/chip", "platform": "tpu",
+            "seq_len": 4096, "mfu_pct": 33.0}
+
+
 def test_warm_then_measure_writes_last_good(lastgood, monkeypatch,
                                             capsys):
     first = bench._STAGES[0]
     fake, calls = _fake_attempts([_warm_result(first["batch"]),
                                   _tpu_result(),
                                   _warm_result(128),
-                                  _resnet_result()])
+                                  _resnet_result(),
+                                  _warm_result(bench.LONGCTX_BATCH),
+                                  _longctx_result()])
     monkeypatch.setattr(bench, "_run_attempt", fake)
     assert bench.main() == 0
     out = json.loads(capsys.readouterr().out.strip())
     assert out["platform"] == "tpu" and "stale" not in out
     assert "warm" not in out  # the warm tag must never be the headline
-    # BOTH baseline configs land: BERT headline + ResNet sub-object
+    # ALL configs land: BERT headline + ResNet + longctx sub-objects
     assert out["resnet50"]["value"] == 1500.0
+    assert out["longctx"]["seq_len"] == 4096
     saved = json.load(open(lastgood))
     assert saved["result"]["value"] == 83000.0 and saved["ts"] > 0
     assert saved["result"]["resnet50"]["value"] == 1500.0
+    assert saved["result"]["longctx"]["value"] == 50000.0
     # warm ran steps=0, measure ran real steps
     assert calls[0][2] == 0 and calls[1][2] > 0
     assert calls[2][3] == "resnet" and calls[3][3] == "resnet"
+    assert calls[4][3] == "longctx" and calls[5][3] == "longctx"
 
 
 def test_fresh_resnet_rides_stale_bert(lastgood, monkeypatch, capsys):
@@ -321,7 +334,9 @@ def test_probe_skipped_after_successful_stage(lastgood, monkeypatch,
     fake, calls = _fake_attempts([_warm_result(first["batch"]),
                                   _tpu_result(),
                                   _warm_result(128),
-                                  _resnet_result()])
+                                  _resnet_result(),
+                                  _warm_result(bench.LONGCTX_BATCH),
+                                  _longctx_result()])
     monkeypatch.setattr(bench, "_run_attempt", fake)
     assert bench.main() == 0
     capsys.readouterr()
@@ -344,7 +359,9 @@ def test_assume_live_env_skips_first_probe(lastgood, monkeypatch,
     fake, _ = _fake_attempts([_warm_result(first["batch"]),
                               _tpu_result(),
                               _warm_result(128),
-                              _resnet_result()])
+                              _resnet_result(),
+                              _warm_result(bench.LONGCTX_BATCH),
+                              _longctx_result()])
     monkeypatch.setattr(bench, "_run_attempt", fake)
     assert bench.main() == 0
     capsys.readouterr()

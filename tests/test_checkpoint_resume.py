@@ -9,6 +9,8 @@ import sys
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.dist
+
 import paddle_tpu.fluid as fluid
 from paddle_tpu.fluid import checkpoint as ckpt
 from paddle_tpu.fluid import framework

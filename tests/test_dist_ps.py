@@ -9,6 +9,8 @@ import sys
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.dist
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _RUNNER = os.path.join(_DIR, "dist_ps_runner.py")
 

@@ -5,6 +5,10 @@ Reference: `framework/distributed_strategy.proto:301` reserves `elastic`
 step of the marked program: async numbered checkpoints every
 `save_steps`, and transparent restore from the latest checkpoint before
 the first step after a restart."""
+import pytest
+
+pytestmark = pytest.mark.dist
+
 import numpy as np
 
 import paddle_tpu.fluid as fluid

@@ -1,6 +1,10 @@
 """Gloo-equivalent host collectives (reference:
 fleet/gloo_wrapper.h:106 Barrier/AllReduce + HdfsStore rendezvous) and
 dataset global shuffle across 2 real processes."""
+import pytest
+
+pytestmark = pytest.mark.dist
+
 import os
 import socket
 import subprocess

@@ -2,6 +2,10 @@
 "hosts" (one CPU device each) that form a global mesh via
 jax.distributed; Fleet DP training matches single-process losses
 (reference: test_dist_base.py:696 nccl2-mode cluster tests)."""
+import pytest
+
+pytestmark = pytest.mark.dist
+
 import os
 import socket
 import subprocess

@@ -7,6 +7,8 @@ import os
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.dist
+
 import paddle_tpu.fluid as fluid
 from paddle_tpu.fluid import framework, profiler
 

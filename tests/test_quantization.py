@@ -112,3 +112,126 @@ def test_ptq_calibration():
     out = exe.run(qprog, feed={"x": x2, "label": y},
                   fetch_list=[loss], scope=scope)
     assert np.isfinite(float(np.asarray(out[0]).reshape(-1)[0]))
+
+
+def _conv_fc_net():
+    """conv (per-channel quantizable) + fc classifier on 8x8 images."""
+    img = fluid.layers.data(name="img", shape=[1, 8, 8],
+                            dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    conv = fluid.layers.conv2d(img, num_filters=4, filter_size=3,
+                               padding=1, act="relu")
+    pool = fluid.layers.pool2d(conv, pool_size=2, pool_stride=2,
+                               pool_type="max")
+    logits = fluid.layers.fc(input=pool, size=4)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, label))
+    return img, label, logits, loss
+
+
+def _img_data(n=64):
+    r = np.random.RandomState(3)
+    x = r.rand(n, 1, 8, 8).astype("float32")
+    y = (x.mean(axis=(1, 2, 3), keepdims=False) * 4).astype(
+        "int64").clip(0, 3).reshape(n, 1)
+    return x, y
+
+
+def test_qat_freeze_export_roundtrip(tmp_path):
+    """VERDICT r4 #5: per-channel QAT -> OutScale tracking -> freeze
+    (int8-grid weights in scope, out_threshold attrs) ->
+    save_inference_model -> load -> int8-simulated accuracy within
+    tolerance of fp32. Reference:
+    contrib/slim/quantization/quantization_pass.py:119 (Transform),
+    :700 (Freeze)."""
+    from paddle_tpu.core.scope import Scope, scope_guard
+    from paddle_tpu.fluid.contrib.slim.quantization import (
+        OutScaleForInferencePass, OutScaleForTrainingPass,
+        QuantizationFreezePass, QuantizationTransformPass)
+
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = startup.random_seed = 11
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            img, label, logits, loss = _conv_fc_net()
+            QuantizationTransformPass(
+                weight_quantize_type="channel_wise_abs_max",
+                activation_quantize_type="moving_average_abs_max",
+            ).apply(main, startup)
+            OutScaleForTrainingPass().apply(main, startup)
+            test_prog = main.clone(for_test=True)
+            fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+
+    # per-channel transform: the conv weight quantizer is channel-wise
+    cw = [op for op in main.global_block().ops
+          if op.type == "fake_channel_wise_quantize_abs_max"]
+    assert cw and cw[0].attrs["quant_axis"] == 0
+    scale_var = main.global_block()._find_var_recursive(
+        cw[0].output_names["OutScale"][0])
+    assert tuple(scale_var.shape) == (4,)  # one scale per out channel
+
+    scope = Scope()
+    x, y = _img_data()
+    with scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup, scope=scope)
+        for _ in range(30):
+            exe.run(main, feed={"img": x, "label": y},
+                    fetch_list=[loss], scope=scope)
+
+        fp32_logits = np.asarray(exe.run(
+            test_prog, feed={"img": x, "label": y},
+            fetch_list=[logits], scope=scope)[0])
+
+        # freeze: weights snap to the int8 grid in scope; trackers
+        # become out_threshold attrs
+        QuantizationFreezePass(
+            scope=scope,
+            weight_quantize_type="channel_wise_abs_max",
+        ).apply(test_prog, scope=scope)
+        OutScaleForInferencePass().apply(test_prog, scope=scope)
+
+        frozen_ops = test_prog.global_block().ops
+        assert not any(o.type == "fake_channel_wise_quantize_abs_max"
+                       for o in frozen_ops)  # weight q-ops removed
+        conv_ops = [o for o in frozen_ops if o.type == "conv2d"]
+        assert conv_ops[0].attrs["quantization_type"] == \
+            "qat_with_weight_quantize"
+        assert len(conv_ops[0].attrs["weight_quant_scale"]) == 4
+        assert any("out_threshold" in o.attrs for o in frozen_ops)
+        # scale propagation: max-pool inherits its input's threshold
+        pools = [o for o in frozen_ops if o.type == "pool2d"]
+        assert pools and "out_threshold" in pools[0].attrs
+
+        # conv weights in scope now sit ON the int8 grid per channel
+        wname = conv_ops[0].input_names["Filter"][0]
+        w = np.asarray(scope.find_var(wname))
+        s = np.array(conv_ops[0].attrs["weight_quant_scale"]).reshape(
+            4, 1, 1, 1)
+        steps = w * 127.0 / np.maximum(s, 1e-8)
+        np.testing.assert_allclose(steps, np.round(steps), atol=1e-4)
+
+        q_logits = np.asarray(exe.run(
+            test_prog, feed={"img": x, "label": y},
+            fetch_list=[logits], scope=scope)[0])
+        fp32_acc = float((fp32_logits.argmax(1) ==
+                          y.reshape(-1)).mean())
+        q_acc = float((q_logits.argmax(1) == y.reshape(-1)).mean())
+        assert q_acc >= fp32_acc - 0.05, (fp32_acc, q_acc)
+
+        # round trip through save/load_inference_model
+        d = str(tmp_path / "qmodel")
+        fluid.io.save_inference_model(d, ["img"], [logits], exe,
+                                      main_program=test_prog)
+        prog2, feed_names, fetch_targets = \
+            fluid.io.load_inference_model(d, exe)
+        out2 = np.asarray(exe.run(
+            prog2, feed={"img": x}, fetch_list=fetch_targets,
+            scope=scope)[0])
+        np.testing.assert_allclose(out2, q_logits, atol=1e-5,
+                                   rtol=1e-5)
+        # the frozen attrs survive serialization
+        ops2 = prog2.global_block().ops
+        assert any(o.attrs.get("quantization_type") ==
+                   "qat_with_weight_quantize" for o in ops2)
+        assert any("out_threshold" in o.attrs for o in ops2)
