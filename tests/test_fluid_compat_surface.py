@@ -120,3 +120,10 @@ def test_complex_variable_dygraph():
         np.testing.assert_allclose(
             c.numpy(), np.array([1 + 3j, 2 + 4j]))
         assert "ComplexVariable" in repr(c)
+
+
+def test_framework_unique_name_guard_prefix():
+    # the framework-level guard must honor prefix like
+    # fluid.unique_name.guard does (the two surfaces share state)
+    with framework.unique_name_guard("fw_"):
+        assert framework.unique_name("t").startswith("fw_t_")

@@ -141,3 +141,30 @@ def test_build_strategy_knobs_drive_fusion():
         assert "fused_elemwise_activation" in types
         assert any(op.type == "batch_norm" and op.attrs.get("fused_act")
                    for op in prog.global_block().ops)
+
+
+def test_fetch_after_fusion_names_the_knob():
+    """A later run fetching a fuse_bn_act-removed intermediate must get
+    an error naming BuildStrategy.fuse_bn_act_ops, not lowering's
+    generic 'never computed' (ADVICE r4)."""
+    import pytest
+
+    _fresh()
+    main = framework.default_main_program()
+    st = framework.default_startup_program()
+    main.random_seed = st.random_seed = 3
+    img = fluid.layers.data("image", shape=[3, 8, 8], dtype="float32")
+    h = fluid.layers.conv2d(img, 4, 3, padding=1, bias_attr=False)
+    bn = fluid.layers.batch_norm(h)
+    out = fluid.layers.relu(bn)
+    total = fluid.layers.reduce_sum(out)
+
+    bs = fluid.BuildStrategy()
+    bs.fuse_bn_act_ops = True
+    cp = fluid.CompiledProgram(main, build_strategy=bs)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(st)
+    feed = {"image": np.zeros((2, 3, 8, 8), "float32")}
+    exe.run(cp, feed=feed, fetch_list=[total])  # first run fuses
+    with pytest.raises(RuntimeError, match="fuse_bn_act_ops"):
+        exe.run(cp, feed=feed, fetch_list=[bn])
