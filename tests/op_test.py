@@ -51,12 +51,19 @@ class OpTest:
                     err_msg="%s output %s" % (self.op_type, slot))
 
     # -- gradient ----------------------------------------------------------
+    @staticmethod
+    def _matches_output(output_name, slot, i, n_vals):
+        """ONE matching rule for every loss-summation path (analytic f,
+        jitted numeric, exact host numeric): bare slot name, or the
+        indexed 'Slot[i]' form for multi-value slots."""
+        nm = slot if n_vals == 1 else "%s[%d]" % (slot, i)
+        return output_name in (slot, nm)
+
     def _loss_of(self, outs, output_name):
         total = None
         for slot, vals in outs.items():
             for i, v in enumerate(vals):
-                nm = slot if len(vals) == 1 else "%s[%d]" % (slot, i)
-                if output_name in (slot, nm):
+                if self._matches_output(output_name, slot, i, len(vals)):
                     s = np.sum(np.asarray(v, dtype="float64"))
                     total = s if total is None else total + s
         assert total is not None, "output %r not found" % output_name
@@ -74,14 +81,16 @@ class OpTest:
         def f(check_vals):
             ins = {s: list(vs) for s, vs in raw.items()}
             for slot, v in check_vals.items():
-                ins[slot] = [v]
+                # replace only element 0 — multi-array slots keep their
+                # remaining members, same as the numeric paths
+                ins[slot] = [v] + list(raw[slot])[1:]
             outs = ops_lib.run_op(self.op_type, ins, self.attrs)
             total = None
             for slot, vals in outs.items():
-                if slot != output_name:
-                    continue
-                for v in vals:
-                    if jnp.issubdtype(v.dtype, jnp.floating):
+                for i, v in enumerate(vals):
+                    if self._matches_output(output_name, slot, i,
+                                            len(vals)) and \
+                            jnp.issubdtype(v.dtype, jnp.floating):
                         s = jnp.sum(v.astype(jnp.float32))
                         total = s if total is None else total + s
             return total
@@ -91,28 +100,79 @@ class OpTest:
 
         for slot in inputs_to_check:
             a = np.asarray(analytic[slot], dtype="float64")
-            n = self._numeric_grad(slot, output_name, delta)
-            denom = np.maximum(np.maximum(np.abs(a), np.abs(n)), 1e-3)
-            rel = np.abs(a - n) / denom
-            rel = np.where(np.abs(a - n) < 1e-4, 0.0, rel)  # fp-noise floor
+            n = rel = None
+            try:
+                # fast path: ONE jitted scalar loss, every perturbation
+                # a cached-executable call — the eager per-element loop
+                # re-dispatched recurrent ops (lstm/gru scans) from
+                # python twice per element and dominated suite wall
+                # clock (452s for one attention_lstm test)
+                n = self._numeric_grad(slot, output_name, delta,
+                                       jit=True)
+                rel = self._grad_rel_err(a, n)
+            except Exception:  # noqa: BLE001 - op not jittable as-is
+                rel = None
+            if rel is None or rel.max() > 0.5 * max_relative_error:
+                # exact f64 fallback decides every non-clear case: the
+                # f32 jitted sums carry cancellation noise that could
+                # otherwise nudge a genuinely-failing gradient under
+                # tolerance, so a fast-path PASS is only trusted with
+                # 2x margin
+                n = self._numeric_grad(slot, output_name, delta)
+                rel = self._grad_rel_err(a, n)
             assert rel.max() <= max_relative_error, (
                 "%s grad wrt %s: max rel err %.4g\nanalytic=%s\nnumeric=%s"
                 % (self.op_type, slot, rel.max(), a.ravel()[:8],
                    n.ravel()[:8]))
 
-    def _numeric_grad(self, slot, output_name, delta):
+    @staticmethod
+    def _grad_rel_err(a, n):
+        denom = np.maximum(np.maximum(np.abs(a), np.abs(n)), 1e-3)
+        rel = np.abs(a - n) / denom
+        return np.where(np.abs(a - n) < 1e-4, 0.0, rel)  # fp-noise floor
+
+    def _numeric_grad(self, slot, output_name, delta, jit=False):
         base = {s: [np.asarray(a, dtype="float32") for a in _as_list(v)]
                 for s, v in self.inputs.items()}
         x = base[slot][0]
+        run = self._run_forward
+        loss_of = self._loss_of
+        if jit:
+            import jax
+            import jax.numpy as jnp
+
+            others = {s: [jnp.asarray(a) for a in vs]
+                      for s, vs in base.items()}
+
+            @jax.jit
+            def jloss(xp):
+                ins = {s: list(vs) for s, vs in others.items()}
+                ins[slot] = [xp] + list(others[slot])[1:]
+                outs = ops_lib.run_op(self.op_type, ins, self.attrs)
+                total = None
+                for oslot, vals in outs.items():
+                    for i, v in enumerate(vals):
+                        if self._matches_output(output_name, oslot, i,
+                                                len(vals)) and \
+                                jnp.issubdtype(v.dtype, jnp.floating):
+                            s = jnp.sum(v.astype(jnp.float32))
+                            total = s if total is None else total + s
+                return total
+
+            def run(b):  # noqa: ARG001 - closure reads mutated x
+                return jloss(jnp.asarray(x))
+
+            def loss_of(out, _name):
+                return float(out)
         grad = np.zeros_like(x, dtype="float64")
         it = np.nditer(x, flags=["multi_index"])
         while not it.finished:
             idx = it.multi_index
             orig = x[idx]
             x[idx] = orig + delta
-            hi = self._loss_of(self._run_forward(base), output_name)
+            hi = loss_of(run(base), output_name)
             x[idx] = orig - delta
-            lo = self._loss_of(self._run_forward(base), output_name)
+            lo = loss_of(run(base), output_name)
             x[idx] = orig
             grad[idx] = (hi - lo) / (2 * delta)
             it.iternext()
