@@ -43,8 +43,11 @@ from bench import probe_tunnel  # noqa: E402
 BENCH_BUDGET = 2400.0  # hard cap on one full bench.py run
 # The 01:01Z window on 07-31 proved windows can be ~1 minute long: a
 # 25-min probe cycle would miss most of them. Probe cost is one python
-# import + a 512x512 matmul, so a tight cycle is cheap.
-CYCLE = 420.0          # seconds between probe attempts (~7 min)
+# import + a 512x512 matmul, so a tight cycle is cheap. NOTE the
+# effective period is CYCLE + 75s (a dead-tunnel probe burns its full
+# budget): 150s sleep = ~3:45 between probes, catching ~80% of 3-min
+# windows vs ~36% at the old 420s.
+CYCLE = 150.0          # seconds between probe attempts
 CYCLE_AFTER_FAIL = 60.0  # probe again fast when a window just flapped
 CYCLE_AFTER_SUCCESS = 3600.0  # relax after a fresh capture exists
 
