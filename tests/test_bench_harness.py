@@ -98,6 +98,27 @@ def _longctx_result(v=50000.0):
             "seq_len": 4096, "mfu_pct": 33.0}
 
 
+def _cpu_stub(v=44.0):
+    return {"metric": "bert_base_pretrain_throughput", "value": v,
+            "unit": "tokens/sec/chip", "vs_baseline": round(v / 25000, 3),
+            "platform": "cpu"}
+
+
+def _results_only_model(model, result_fn):
+    """Per-stage fake results where only `model`'s stages succeed:
+    other models' warms fail (so their measures are skipped) and their
+    warm-less measures run cold and fail — the failed-warm-skips-measure
+    call-ordering contract in bench.main()."""
+    results = []
+    for s in bench._STAGES:
+        if s["model"] == model:
+            results.append(_warm_result(s["batch"])
+                           if s["kind"] == "warm" else result_fn())
+        elif s["kind"] == "warm" or bench._stage_key(s) not in _WARM_KEYS:
+            results.append(None)
+    return results
+
+
 def test_warm_then_measure_writes_last_good(lastgood, monkeypatch,
                                             capsys):
     first = bench._STAGES[0]
@@ -132,13 +153,7 @@ def test_fresh_resnet_rides_stale_bert(lastgood, monkeypatch, capsys):
     with open(lastgood, "w") as f:
         json.dump({"ts": 1000.0, "iso": "2026-07-30T07:50:00Z",
                    "result": _tpu_result()}, f)
-    results = []
-    for s in bench._STAGES:
-        if s["model"] == "resnet":
-            results.append(_warm_result(128) if s["kind"] == "warm"
-                           else _resnet_result())
-        elif s["kind"] == "warm" or bench._stage_key(s) not in _WARM_KEYS:
-            results.append(None)
+    results = _results_only_model("resnet", _resnet_result)
     results.append(None)  # cpu fallback
     fake, calls = _fake_attempts(results)
     monkeypatch.setattr(bench, "_run_attempt", fake)
@@ -397,3 +412,36 @@ def test_bench_resnet_path_runs_on_cpu():
     import numpy as np
 
     assert np.isfinite(res["loss"])
+
+
+def test_fresh_longctx_rides_stale_bert(lastgood, monkeypatch, capsys):
+    """BERT (and ResNet) stages fail but the longctx pair lands: the
+    stale-BERT emission must carry the fresh on-chip longctx number and
+    persist it into last-good (same contract as the ResNet leg)."""
+    with open(lastgood, "w") as f:
+        json.dump({"ts": 1000.0, "iso": "2026-07-30T07:50:00Z",
+                   "result": _tpu_result()}, f)
+    results = _results_only_model("longctx", _longctx_result)
+    results.append(None)  # cpu fallback
+    fake, calls = _fake_attempts(results)
+    monkeypatch.setattr(bench, "_run_attempt", fake)
+    assert bench.main() == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["stale"] is True and out["value"] == 83000.0
+    assert out["longctx"]["value"] == 50000.0
+    saved = json.load(open(lastgood))
+    assert saved["result"]["longctx"]["value"] == 50000.0
+
+
+def test_fresh_longctx_rides_cpu_fallback_without_last_good(
+        lastgood, monkeypatch, capsys):
+    """No last-good exists and only longctx lands: the CPU-fallback
+    emission must still carry the scarce on-chip longctx number."""
+    results = _results_only_model("longctx", _longctx_result)
+    results.append(_cpu_stub())
+    fake, _ = _fake_attempts(results)
+    monkeypatch.setattr(bench, "_run_attempt", fake)
+    assert bench.main() == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["platform"] == "cpu"
+    assert out["longctx"]["value"] == 50000.0
