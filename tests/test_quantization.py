@@ -235,3 +235,85 @@ def test_qat_freeze_export_roundtrip(tmp_path):
         assert any(o.attrs.get("quantization_type") ==
                    "qat_with_weight_quantize" for o in ops2)
         assert any("out_threshold" in o.attrs for o in ops2)
+
+
+def test_out_scale_inference_requires_scope():
+    from paddle_tpu.fluid.contrib.slim.quantization import (
+        OutScaleForInferencePass)
+
+    with pytest.raises(ValueError, match="scope"):
+        OutScaleForInferencePass().apply(framework.Program())
+
+
+def test_out_scale_tracker_frozen_in_test_clone():
+    """clone(for_test=True) must stop the moving-average trackers from
+    mutating calibration state: eval batches with different magnitudes
+    may not drift the out_threshold the freeze will bake."""
+    import jax.numpy as jnp
+    import paddle_tpu.ops as ops_lib
+
+    # op level: is_test returns InScale untouched
+    out = ops_lib.run_op(
+        "moving_average_abs_max_scale",
+        {"X": [jnp.asarray(np.full((4,), 100.0, "float32"))],
+         "InScale": [jnp.asarray([2.0], "float32")]},
+        {"is_test": True})
+    assert float(np.asarray(out["OutScale"][0])[0]) == 2.0
+
+    # program level: the tracker op is in _IS_TEST_OPS so the clone
+    # carries is_test=True
+    from paddle_tpu.fluid.contrib.slim.quantization import (
+        OutScaleForTrainingPass)
+
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+            h = fluid.layers.fc(input=x, size=4, act="relu")
+            OutScaleForTrainingPass().apply(main, startup)
+    test_prog = main.clone(for_test=True)
+    trackers = [op for op in test_prog.global_block().ops
+                if op.type == "moving_average_abs_max_scale"]
+    assert trackers
+    assert all(op.attrs.get("is_test") for op in trackers)
+
+
+def test_freeze_bakes_static_scale_for_abs_max_activations():
+    """abs_max activation quantizers have no state input; freeze must
+    bake the last calibrated OutScale from scope as static_scale, or
+    'frozen' inference silently keeps dynamic per-batch scales."""
+    from paddle_tpu.core.scope import Scope, scope_guard
+    from paddle_tpu.fluid.contrib.slim.quantization import (
+        QuantizationFreezePass, QuantizationTransformPass)
+
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = startup.random_seed = 2
+    with framework.program_guard(main, startup):
+        with framework.unique_name_guard():
+            x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            h = fluid.layers.fc(input=x, size=1)
+            loss = fluid.layers.mean(fluid.layers.square(h - y))
+            QuantizationTransformPass(
+                activation_quantize_type="abs_max").apply(main, startup)
+            fluid.optimizer.SGDOptimizer(0.01).minimize(loss)
+    scope = Scope()
+    with scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup, scope=scope)
+        r = np.random.RandomState(0)
+        xv = r.rand(4, 8).astype("float32")
+        exe.run(main, feed={"x": xv, "y": r.rand(4, 1).astype(
+            "float32")}, fetch_list=[loss], scope=scope)
+        QuantizationFreezePass(scope=scope).apply(main)
+        acts = [op for op in main.global_block().ops
+                if op.type == "fake_quantize_abs_max"]
+        assert acts
+        for op in acts:
+            assert op.attrs.get("is_test") is True
+            assert op.attrs.get("static_scale", 0.0) > 0.0
+        # the input quantizer's baked scale is the batch abs-max of x
+        in_ops = [op for op in acts
+                  if op.input_names["X"][0] == "x"]
+        assert in_ops and abs(in_ops[0].attrs["static_scale"]
+                              - float(np.abs(xv).max())) < 1e-5
