@@ -28,3 +28,34 @@ def test_op_bench_cli_config(tmp_path):
     results = _run_cli(["--config", str(cfg)])
     assert len(results) == 2
     assert {r["op"] for r in results} == {"relu", "softmax"}
+
+
+def test_attn_ab_crossover_logic():
+    """tools/attn_ab.py crossover: smallest seq from which flash wins
+    everywhere; XLA-OOM counts as a win only when flash ran; a seq
+    where flash itself failed voids any claim."""
+    from attn_ab import crossover_min_seq
+
+    # clean crossover at 2048
+    assert crossover_min_seq([
+        (512, {"flash": 9, "flash_dropout": 10, "xla": 5}),
+        (1024, {"flash": 12, "flash_dropout": 13, "xla": 11}),
+        (2048, {"flash": 14, "flash_dropout": 15, "xla": 20}),
+        (4096, {"flash": 30, "flash_dropout": 31, "xla": 90}),
+    ]) == 2048
+    # a later loss voids an earlier win
+    assert crossover_min_seq([
+        (1024, {"flash": 1, "flash_dropout": 1, "xla": 2}),
+        (2048, {"flash": 9, "flash_dropout": 9, "xla": 5}),
+    ]) is None
+    # XLA OOM with flash measured: flash wins by default
+    assert crossover_min_seq([
+        (2048, {"flash": 9, "flash_dropout": 9, "xla": 5}),
+        (4096, {"flash": 30, "flash_dropout": 31}),
+    ]) == 4096
+    # both failed at a length: no claim from that length
+    assert crossover_min_seq([
+        (2048, {"flash": 4, "flash_dropout": 4, "xla": 5}),
+        (4096, {}),
+    ]) is None
+    assert crossover_min_seq([]) is None

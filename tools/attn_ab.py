@@ -86,6 +86,18 @@ def main(seqs) -> int:
                       flush=True)
         results.append((S, per_impl))
 
+    crossover = crossover_min_seq(results)
+    print(json.dumps({"crossover_min_seq": crossover,
+                      "note": "set FLAGS_flash_attention_min_seq to "
+                              "this (utils/flags.py:45)"}))
+    return 0
+
+
+def crossover_min_seq(results):
+    """Smallest measured seq from which flash wins at EVERY measured
+    length (both dropout settings); an XLA OOM counts as a flash win
+    only when flash itself produced numbers there. results:
+    [(seq, {impl: ms}), ...] ascending."""
     crossover = None
     for S, r_ in results:
         flash_ok = "flash" in r_ and "flash_dropout" in r_
@@ -100,12 +112,10 @@ def main(seqs) -> int:
             crossover = crossover or S
         else:
             crossover = None  # must win at every longer seq too
-    print(json.dumps({"crossover_min_seq": crossover,
-                      "note": "set FLAGS_flash_attention_min_seq to "
-                              "this (utils/flags.py:45)"}))
-    return 0
+    return crossover
 
 
 if __name__ == "__main__":
-    seqs = [int(a) for a in sys.argv[1:]] or [512, 1024, 2048, 4096]
+    seqs = sorted(int(a) for a in sys.argv[1:]) \
+        or [512, 1024, 2048, 4096]
     sys.exit(main(seqs))
