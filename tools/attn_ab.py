@@ -116,6 +116,6 @@ def crossover_min_seq(results):
 
 
 if __name__ == "__main__":
-    seqs = sorted(int(a) for a in sys.argv[1:]) \
+    seqs = sorted({int(a) for a in sys.argv[1:]}) \
         or [512, 1024, 2048, 4096]
     sys.exit(main(seqs))
