@@ -35,6 +35,19 @@ def init_parallel_env(backend="xla"):
     if nhosts > 1 and penv.trainer_endpoints():
         coord = penv.trainer_endpoints()[0]
         try:
+            # CPU backend: cross-process collectives (multihost
+            # device_put, psum over DCN) need the gloo transport; the
+            # default CPU backend refuses multiprocess computations.
+            # Read the platform from config/env only — probing the
+            # backend here would initialize it BEFORE distributed init.
+            platforms = (getattr(jax.config, "jax_platforms", None)
+                         or os.environ.get("JAX_PLATFORMS", ""))
+            if platforms and "cpu" in str(platforms):
+                try:
+                    jax.config.update(
+                        "jax_cpu_collectives_implementation", "gloo")
+                except Exception:  # noqa: BLE001 - knob absent: ignore
+                    pass
             jax.distributed.initialize(
                 coordinator_address=coord,
                 num_processes=nhosts,
@@ -75,8 +88,10 @@ def _eager_collective(x, fn_name, **kw):
             out = ops_lib.run_op(fn_name, {"X": [v]}, kw)
             return out["Out"][0]
 
-    smapped = jax.shard_map(inner, mesh=mesh, in_specs=P("dp"),
-                            out_specs=P("dp"), check_vma=False)
+    from ..parallel.env import shard_map_compat
+
+    smapped = shard_map_compat(inner, mesh=mesh, in_specs=P("dp"),
+                               out_specs=P("dp"), check_vma=False)
     out = jax.jit(smapped)(val)
     if hasattr(x, "_assign_raw"):
         x._assign_raw(out)
@@ -106,6 +121,7 @@ def barrier(group=0):
     pass
 
 
+from . import faults  # noqa: F401,E402
 from . import launch  # noqa: F401,E402
 from .launch import ParallelEnvArgs  # noqa: F401,E402
 from .sharded_checkpoint import ShardedCheckpointManager  # noqa: F401,E402

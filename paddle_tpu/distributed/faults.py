@@ -1,0 +1,200 @@
+"""Deterministic fault injection for the distributed host tier.
+
+At pod scale worker preemption and flaky host networking are the common
+case, not the exception (PAPERS.md: "Exploring the limits of Concurrency
+in ML Training on Google TPUs" treats restart/resume as table stakes) —
+so the retry / liveness / supervised-restart paths in rpc.py,
+host_collectives.py and launch.py must be testable on a CPU-only box.
+This module injects faults at the RPC socket layer:
+
+    drop   — close the socket and raise ConnectionError (a mid-stream
+             TCP drop; the peer sees the close too)
+    delay  — sleep `delay_ms` before the socket op (slow network)
+    kill   — os._exit(exit_code): a preempted / OOM-killed worker
+
+Injection points (where rpc.py calls back into this module):
+
+    side=client point=send   before the request bytes leave the client
+    side=client point=recv   after send, before the response is read —
+                             the request may already be APPLIED
+                             server-side, so this is the point that
+                             exercises idempotent retry/dedup
+    side=server point=send   before the server writes a response
+    side=server point=recv   before the server reads the next request
+                             (the method is not parsed yet at this
+                             point, so `method=` filters never match
+                             server/recv — filter by side/point only)
+
+Faults fire deterministically on a per-injector event counter filtered
+by side/point/method: `every=N` fires on every Nth matching event,
+`at=N` fires exactly once on the Nth. Two ways to arm:
+
+    # in-process (tests):
+    with faults.inject("drop", side="client", point="recv", every=3):
+        ...
+
+    # across process boundaries (launch/subprocess tests):
+    PADDLE_FAULTS="drop:side=client,point=recv,every=3;kill:at=40"
+
+The env spec is parsed once, lazily, on the first RPC socket op of the
+process. `faults.reset()` clears both injectors and counters.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import List, Optional
+
+__all__ = ["FaultInjector", "inject", "install", "reset", "on_message",
+           "parse_spec", "FaultError"]
+
+
+class FaultError(ConnectionError):
+    """Injected connection drop — a subclass of ConnectionError so the
+    client retry path treats it exactly like a real mid-stream drop."""
+
+
+class FaultInjector:
+    """One armed fault: fires on matching (side, point, method) events
+    according to its deterministic counter."""
+
+    KINDS = ("drop", "delay", "kill")
+
+    def __init__(self, kind, side=None, point=None, method=None,
+                 every=None, at=None, delay_ms=50, exit_code=137):
+        if kind not in self.KINDS:
+            raise ValueError("unknown fault kind %r (want one of %s)"
+                             % (kind, "/".join(self.KINDS)))
+        if (every is None) == (at is None):
+            raise ValueError("exactly one of every=/at= is required")
+        self.kind = kind
+        self.side = side          # "client" | "server" | None (both)
+        self.point = point        # "send" | "recv" | None (both)
+        self.method = method      # rpc method name | None (all)
+        self.every = int(every) if every is not None else None
+        self.at = int(at) if at is not None else None
+        self.delay_ms = float(delay_ms)
+        self.exit_code = int(exit_code)
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def _matches(self, side, point, method):
+        return ((self.side is None or self.side == side)
+                and (self.point is None or self.point == point)
+                and (self.method is None or self.method == method))
+
+    def fire(self, side, point, method, sock):
+        if not self._matches(side, point, method):
+            return
+        with self._lock:
+            self._count += 1
+            n = self._count
+        hit = (self.every is not None and n % self.every == 0) \
+            or (self.at is not None and n == self.at)
+        if not hit:
+            return
+        if self.kind == "delay":
+            import time
+
+            time.sleep(self.delay_ms / 1000.0)
+            return
+        if self.kind == "kill":
+            os._exit(self.exit_code)
+        # drop: close our end so the peer observes the drop too, then
+        # raise into the caller's socket op
+        if sock is not None:
+            with contextlib.suppress(OSError):
+                sock.close()
+        raise FaultError(
+            "fault-injected connection drop (%s/%s event #%d)"
+            % (side, point, n))
+
+    def __repr__(self):
+        trig = ("every=%d" % self.every if self.every is not None
+                else "at=%d" % self.at)
+        return "FaultInjector(%s, side=%s, point=%s, method=%s, %s)" % (
+            self.kind, self.side, self.point, self.method, trig)
+
+
+_lock = threading.Lock()
+_injectors: List[FaultInjector] = []
+_env_loaded = False
+
+
+def parse_spec(spec: str) -> List[FaultInjector]:
+    """Parse "kind:k=v,k=v;kind:k=v" into injectors.
+
+    Example: "drop:side=client,point=recv,every=3;kill:at=40"
+    """
+    out = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        kind, _, rest = part.partition(":")
+        kw = {}
+        for item in rest.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            k, _, v = item.partition("=")
+            kw[k.strip()] = v.strip()
+        for intkey in ("every", "at", "exit_code"):
+            if intkey in kw:
+                kw[intkey] = int(kw[intkey])
+        if "delay_ms" in kw:
+            kw["delay_ms"] = float(kw["delay_ms"])
+        out.append(FaultInjector(kind.strip(), **kw))
+    return out
+
+
+def install(injector: FaultInjector) -> FaultInjector:
+    with _lock:
+        _injectors.append(injector)
+    return injector
+
+
+def reset():
+    """Clear every armed injector (incl. env-armed) and re-arm from the
+    env on the next socket op only if PADDLE_FAULTS is still set."""
+    global _env_loaded
+    with _lock:
+        _injectors.clear()
+        _env_loaded = False
+
+
+def _load_env_once():
+    global _env_loaded
+    if _env_loaded:
+        return
+    with _lock:
+        if _env_loaded:
+            return
+        spec = os.environ.get("PADDLE_FAULTS", "")
+        if spec:
+            _injectors.extend(parse_spec(spec))
+        _env_loaded = True
+
+
+def on_message(side, point, method=None, sock=None):
+    """rpc.py hook: called before every socket send/recv. No-op unless
+    injectors are armed (env or ctx manager)."""
+    _load_env_once()
+    if not _injectors:
+        return
+    for inj in list(_injectors):
+        inj.fire(side, point, method, sock)
+
+
+@contextlib.contextmanager
+def inject(kind, **kw):
+    """Arm one injector for the duration of a with-block (in-process
+    tests; subprocesses use PADDLE_FAULTS)."""
+    inj = install(FaultInjector(kind, **kw))
+    try:
+        yield inj
+    finally:
+        with _lock:
+            if inj in _injectors:
+                _injectors.remove(inj)

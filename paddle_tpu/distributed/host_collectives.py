@@ -8,9 +8,23 @@ TPU-native scope: device collectives ride ICI via XLA; this tier exists
 for HOST coordination (dataset shuffle, role-maker barriers) where the
 accelerator isn't involved. Rendezvous is rank-0-hosts-a-store over the
 same binary RPC as the PS tier (distributed/rpc.py) instead of HDFS.
+
+Fault tolerance (see distributed/README.md for the env knobs):
+
+- every rank heartbeats the rank-0 store (own socket, so a minutes-long
+  blocked gather on the main client never starves liveness); a blocked
+  `hc_gather`/`hc_get` fails FAST with "waiting on ranks {3,5} (last
+  heartbeat 42s ago)" once a waited-on rank misses its liveness window,
+  instead of hanging to the full PADDLE_HC_TIMEOUT_S;
+- the store RELEASES each collective's blobs once every rank has
+  fetched them, so long runs with per-step barriers/allreduces stay
+  bounded (the seed leaked every contributed blob for the run's life);
+- the RPC layer underneath retries dropped connections with idempotent
+  request dedup, so a mid-collective TCP drop is invisible here.
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Dict, List, Optional
@@ -20,23 +34,120 @@ import numpy as np
 from .rpc import RpcClient, RpcServer, _Stop
 
 
+def _env_f(name, default):
+    return float(os.environ.get(name, default))
+
+
 class _StoreState:
     """Rank-0 store: keyed blobs + counting barriers. Wait timeout is
     configurable (PADDLE_HC_TIMEOUT_S env or ctor arg) — dataset-sized
-    collectives legitimately wait minutes for slow ranks."""
+    collectives legitimately wait minutes for slow ranks. Liveness is
+    separate: a rank that stops heartbeating for PADDLE_HC_LIVENESS_S
+    fails waiters immediately."""
 
-    def __init__(self, world_size, timeout_s=None):
-        import os
-
+    def __init__(self, world_size, timeout_s=None, heartbeat_s=None,
+                 liveness_s=None):
         self.world = int(world_size)
         self.timeout_s = float(
             timeout_s if timeout_s is not None
-            else os.environ.get("PADDLE_HC_TIMEOUT_S", 600))
+            else _env_f("PADDLE_HC_TIMEOUT_S", 600))
+        self.heartbeat_s = float(
+            heartbeat_s if heartbeat_s is not None
+            else _env_f("PADDLE_HC_HEARTBEAT_S", 2.0))
+        self.liveness_s = float(
+            liveness_s if liveness_s is not None
+            else _env_f("PADDLE_HC_LIVENESS_S",
+                        max(15.0, 5 * self.heartbeat_s)))
+        # a rank that has NEVER beaten is judged against the (longer)
+        # join window, not liveness_s: cold jax imports / container
+        # start skew legitimately delay the first heartbeat well past
+        # the steady-state liveness window
+        self.join_s = _env_f("PADDLE_HC_JOIN_S",
+                             max(120.0, 4 * self.liveness_s))
         self._kv: Dict[str, object] = {}
         self._counts: Dict[str, int] = {}
+        # key -> ranks that have fetched this collective's result; the
+        # last fetch releases the blobs (fix for the seed's unbounded
+        # _kv growth across barriers/allreduces)
+        self._fetched: Dict[str, set] = {}
+        # rank -> last heartbeat (pre-seeded so a rank that dies before
+        # its FIRST beat is still detected — via join_s, not liveness_s)
+        now = time.monotonic()
+        self._beats: Dict[int, float] = {
+            r: now for r in range(self.world)}
+        self._seen: set = set()  # ranks that have actually beaten
+        # ranks that LEFT cleanly (group shutdown): instantly dead for
+        # a wait that NAMES them (a gather part, a broadcast root), and
+        # excluded from anonymous waits (hc_take / generic hc_get)
+        # unless no possible sender remains
+        self._left: set = set()
         self._cv = threading.Condition()
 
+    # -- liveness --------------------------------------------------------
+    def _wait_or_fail(self, pred, desc_fn, waiting_ranks_fn):
+        """Wait (under self._cv) until pred(); fail fast if any rank we
+        are waiting on misses its liveness window; TimeoutError at the
+        full timeout_s as before. desc_fn is CALLED at raise time so
+        the message carries the contribution count as of the failure,
+        not as of wait entry."""
+        deadline = time.monotonic() + self.timeout_s
+        while not pred():
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(desc_fn())
+            if self.heartbeat_s > 0:
+                waiting = waiting_ranks_fn()
+                now = time.monotonic()
+                dead = sorted(
+                    r for r in waiting
+                    if r in self._left
+                    or now - self._beats.get(r, now)
+                    > (self.liveness_s if r in self._seen
+                       else self.join_s))
+                if dead:
+                    stale = max(now - self._beats[r] for r in dead)
+                    raise RuntimeError(
+                        "%s: waiting on ranks {%s} (last heartbeat "
+                        "%.0fs ago)" % (desc_fn(),
+                                        ",".join(map(str, dead)), stale))
+            self._cv.wait(timeout=min(0.5, remaining))
+
+    def _release_after_fetch(self, key, rank, blob_keys):
+        """Record that `rank` fetched collective `key`; the last rank's
+        fetch drops the blobs + bookkeeping. Exactly-once per rank: the
+        RPC dedup layer never re-invokes the handler for a retried
+        request, so the count can't be inflated by reconnects."""
+        got = self._fetched.setdefault(key, set())
+        got.add(int(rank))
+        if len(got) >= self.world:
+            for bk in blob_keys:
+                self._kv.pop(bk, None)
+            self._counts.pop(key, None)
+            self._fetched.pop(key, None)
+
+    def _stale_ranks(self):
+        """Ranks presumed DEAD (crashed): stale heartbeat and no clean
+        leave. Used as the waiting set where the actual waited-on rank
+        is unknown (hc_take, generic hc_get) — a rank that finished and
+        shut down cleanly must not poison unrelated waits there."""
+        now = time.monotonic()
+        return [r for r in range(self.world)
+                if r not in self._left
+                and now - self._beats.get(r, now) > self.liveness_s]
+
+    # -- dispatch --------------------------------------------------------
     def handle(self, method, args):
+        if method == "hc_beat":
+            with self._cv:
+                r = int(args[0])
+                self._beats[r] = time.monotonic()
+                self._seen.add(r)
+            return []
+        if method == "hc_leave":
+            with self._cv:
+                self._left.add(int(args[0]))
+                self._cv.notify_all()  # waiters re-check liveness
+            return []
         if method == "hc_put":
             key, val = args[0], args[1]
             with self._cv:
@@ -45,26 +156,41 @@ class _StoreState:
                 self._cv.notify_all()
             return []
         if method == "hc_get":
+            # optional 3rd arg: calling rank — enables blob release once
+            # all ranks have fetched; optional 4th arg: the rank whose
+            # put this get is waiting on (broadcast root), so the
+            # fast-fail names the actual straggler instead of blaming
+            # any stale rank
             key, need = args[0], int(args[1])
+            rank = int(args[2]) if len(args) > 2 else None
+            src = int(args[3]) if len(args) > 3 else None
             with self._cv:
-                self._cv.wait_for(
+                self._wait_or_fail(
                     lambda: self._counts.get(key, 0) >= need,
-                    timeout=self.timeout_s)
-                if self._counts.get(key, 0) < need:
-                    raise TimeoutError("hc_get %s: %d/%d contributions"
-                                       % (key, self._counts.get(key, 0),
-                                          need))
-                return [self._kv[key]]
+                    lambda: "hc_get %s (%d/%d contributions)"
+                    % (key, self._counts.get(key, 0), need),
+                    (lambda: [src]) if src is not None
+                    else self._stale_ranks)
+                val = self._kv[key]
+                if rank is not None:
+                    self._release_after_fetch(key, rank, [key])
+                return [val]
         if method == "hc_take":
             # blocking fetch that REMOVES the blob: point-to-point
             # exchange keys pass through the store exactly once, so the
             # store's peak memory stays bounded by in-flight data
             key = args[0]
             with self._cv:
-                self._cv.wait_for(lambda: key in self._kv,
-                                  timeout=self.timeout_s)
-                if key not in self._kv:
-                    raise TimeoutError("hc_take %s" % key)
+                # the intended sender is unknown; fail fast on crashed
+                # ranks, and on cleanly-left ranks only once every
+                # OTHER rank has left (the caller is the sole survivor,
+                # so nobody can ever put this key)
+                self._wait_or_fail(
+                    lambda: key in self._kv,
+                    lambda: "hc_take %s" % key,
+                    lambda: (sorted(self._left)
+                             if len(self._left) >= self.world - 1
+                             else self._stale_ranks()))
                 val = self._kv.pop(key)
                 self._counts.pop(key, None)
                 return [val]
@@ -73,18 +199,30 @@ class _StoreState:
             with self._cv:
                 self._kv["%s/%d" % (key, rank)] = val
                 self._counts[key] = self._counts.get(key, 0) + 1
+                self._beats[rank] = time.monotonic()
+                self._seen.add(rank)
                 self._cv.notify_all()
             return []
         if method == "hc_gather":
             key = args[0]
+            rank = int(args[1]) if len(args) > 1 else None
+            part_keys = ["%s/%d" % (key, r) for r in range(self.world)]
             with self._cv:
-                self._cv.wait_for(
+                self._wait_or_fail(
                     lambda: self._counts.get(key, 0) >= self.world,
-                    timeout=self.timeout_s)
-                if self._counts.get(key, 0) < self.world:
-                    raise TimeoutError("hc_gather %s" % key)
-                return [self._kv["%s/%d" % (key, r)]
-                        for r in range(self.world)]
+                    lambda: "hc_gather %s (%d/%d contributions)"
+                    % (key, self._counts.get(key, 0), self.world),
+                    lambda: [r for r in range(self.world)
+                             if part_keys[r] not in self._kv])
+                out = [self._kv[pk] for pk in part_keys]
+                if rank is not None:
+                    self._release_after_fetch(key, rank, part_keys)
+                return out
+        if method == "hc_stats":
+            # introspection for tests/debugging: live blob + key counts
+            with self._cv:
+                return [len(self._kv), len(self._counts),
+                        len(self._fetched)]
         if method == "hc_shutdown":
             raise _Stop()
         raise ValueError("unknown host-collective method %r" % method)
@@ -92,21 +230,51 @@ class _StoreState:
 
 class HostCollectiveGroup:
     """Gloo-equivalent group. rank 0 hosts the store; everyone (incl.
-    rank 0) talks to it through the same client path."""
+    rank 0) talks to it through the same client path. A background
+    heartbeat thread (own socket — the main client can legitimately
+    block for minutes inside a gather) keeps this rank live in the
+    store; set PADDLE_HC_HEARTBEAT_S=0 to disable."""
 
     def __init__(self, rank, world_size, store_endpoint,
-                 timeout_s=None):
+                 timeout_s=None, heartbeat_s=None):
         self.rank = int(rank)
         self.world = int(world_size)
         self._seq = 0
         self._server: Optional[RpcServer] = None
+        self._heartbeat_s = float(
+            heartbeat_s if heartbeat_s is not None
+            else _env_f("PADDLE_HC_HEARTBEAT_S", 2.0))
         host, port = store_endpoint.rsplit(":", 1)
         if self.rank == 0:
-            state = _StoreState(world_size, timeout_s=timeout_s)
+            state = _StoreState(world_size, timeout_s=timeout_s,
+                                heartbeat_s=self._heartbeat_s)
             self._server = RpcServer(host, int(port), state.handle)
             self._server.start()
             port = self._server.port
         self._client = RpcClient("%s:%s" % (host, port))
+        self._hb_stop = threading.Event()
+        self._hb_client: Optional[RpcClient] = None
+        self._hb_thread: Optional[threading.Thread] = None
+        if self._heartbeat_s > 0:
+            # liveness-only traffic: one retry, never the full cycle —
+            # a dead store must not wedge each 2s tick for ~45s
+            self._hb_client = RpcClient("%s:%s" % (host, port),
+                                        call_retries=1)
+            try:
+                self._hb_client.call("hc_beat", self.rank)
+            except Exception:  # noqa: BLE001 - liveness only
+                pass
+            self._hb_thread = threading.Thread(
+                target=self._hb_loop, daemon=True,
+                name="paddle_tpu-hc-heartbeat-%d" % self.rank)
+            self._hb_thread.start()
+
+    def _hb_loop(self):
+        while not self._hb_stop.wait(self._heartbeat_s):
+            try:
+                self._hb_client.call("hc_beat", self.rank)
+            except Exception:  # noqa: BLE001 - store may be shutting down
+                pass
 
     def _key(self, tag):
         self._seq += 1
@@ -116,13 +284,13 @@ class HostCollectiveGroup:
         key = self._key("barrier")
         self._client.call("hc_put_part", key, self.rank,
                           np.zeros((1,), np.int8))
-        self._client.call("hc_gather", key)
+        self._client.call("hc_gather", key, self.rank)
 
     def all_reduce(self, array, op="sum"):
         key = self._key("allreduce")
         self._client.call("hc_put_part", key, self.rank,
                           np.ascontiguousarray(array))
-        parts = self._client.call("hc_gather", key)
+        parts = self._client.call("hc_gather", key, self.rank)
         stack = np.stack([np.asarray(p) for p in parts])
         if op == "sum":
             return stack.sum(axis=0)
@@ -139,7 +307,7 @@ class HostCollectiveGroup:
         self._client.call("hc_put_part", key, self.rank,
                           np.ascontiguousarray(array))
         return [np.asarray(p) for p in
-                self._client.call("hc_gather", key)]
+                self._client.call("hc_gather", key, self.rank)]
 
     def put(self, key, array):
         """Point-to-point send half (paired with take)."""
@@ -154,16 +322,33 @@ class HostCollectiveGroup:
         key = self._key("bcast")
         if self.rank == root:
             self._client.call("hc_put", key, np.ascontiguousarray(array))
-        (val,) = self._client.call("hc_get", key, 1)
+        (val,) = self._client.call("hc_get", key, 1, self.rank, root)
         return np.asarray(val)
 
+    def store_stats(self):
+        """(n_blobs, n_counts, n_pending_fetch) on the rank-0 store —
+        lets tests assert the leak fix holds."""
+        return tuple(int(x) for x in self._client.call("hc_stats"))
+
     def shutdown(self):
+        self._hb_stop.set()
+        # teardown is best-effort: don't let the full retry cycle
+        # stall shutdown when the store host is already gone
+        self._client._call_retries = min(self._client._call_retries, 1)
+        try:
+            # clean leave: this rank stops heartbeating but must not be
+            # mistaken for a crash by waits that don't involve it
+            self._client.call("hc_leave", self.rank)
+        except Exception:  # noqa: BLE001 - store may already be down
+            pass
         try:
             if self.rank == 0 and self._server is not None:
                 self._client.call("hc_shutdown")
         except Exception:  # noqa: BLE001
             pass
         self._client.close()
+        if self._hb_client is not None:
+            self._hb_client.close()
         if self._server is not None:
             self._server.shutdown()
 

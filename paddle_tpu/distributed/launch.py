@@ -7,8 +7,24 @@ process per HOST, keeping the same PADDLE_* env contract:
   PADDLE_TRAINER_ID, PADDLE_CURRENT_ENDPOINT, PADDLE_TRAINERS_NUM,
   PADDLE_TRAINER_ENDPOINTS.
 
+Supervision (pod-scale preemption is the common case, not the
+exception):
+  - FAIL FAST: the first worker that exits non-zero terminates the rest
+    of the cohort — a half-dead cohort otherwise hangs in collectives
+    until the full store timeout;
+  - the launcher exits with the FIRST non-zero return code (lowest
+    trainer id among the failures observed in a poll cycle),
+    deterministically, not the last one seen;
+  - `--max_restarts N` restarts the whole cohort up to N times after a
+    failure; composed with the elastic checkpoint-resume path
+    (fleet.DistributedStrategy.elastic), a preempted run resumes from
+    the latest intact checkpoint. PADDLE_RESTART_NUM carries the attempt
+    number into the workers. Log files reopen in append mode across
+    restarts so no attempt's output is lost;
+  - SIGINT and SIGTERM both tear the cohort down (exit 128+signum).
+
 Usage: python -m paddle_tpu.distributed.launch --hosts h1:port,h2:port
-       train.py [args...]
+       [--max_restarts N] train.py [args...]
 """
 from __future__ import annotations
 
@@ -17,6 +33,7 @@ import os
 import signal
 import subprocess
 import sys
+import time
 
 
 class ParallelEnvArgs:
@@ -37,9 +54,86 @@ def _parse_args(argv):
                    help="index of this host in --hosts (default: derive "
                         "from matching local address or 0)")
     p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="restart the whole cohort up to N times after a "
+                        "worker failure (composes with elastic "
+                        "checkpoint-resume)")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
+
+
+def _spawn_cohort(args, endpoints, local_ids, restart_no):
+    procs, logs = [], []
+    for tid in local_ids:
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(tid),
+            "PADDLE_CURRENT_ENDPOINT": endpoints[tid],
+            "PADDLE_TRAINERS_NUM": str(len(endpoints)),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            "PADDLE_RESTART_NUM": str(restart_no),
+        })
+        cmd = [sys.executable, "-u", args.training_script] \
+            + args.training_script_args
+        out = None
+        if args.log_dir:
+            # append across restarts: attempt 0's tail is the evidence
+            # for WHY the cohort restarted
+            out = open(os.path.join(args.log_dir, "workerlog.%d" % tid),
+                       "a" if restart_no else "w")
+        logs.append(out)
+        procs.append(subprocess.Popen(cmd, env=env, stdout=out,
+                                      stderr=subprocess.STDOUT
+                                      if out else None))
+    return procs, logs
+
+
+def _terminate_all(procs, grace_s=10.0):
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+    deadline = time.monotonic() + grace_s
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.wait(timeout=max(0.0, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+                p.wait()
+
+
+def _supervise(procs, local_ids, stop_sig):
+    """Poll until all workers exit or one fails. Returns the first
+    non-zero return code (lowest trainer id among the failures seen in
+    the poll cycle that detected the fault), or 0."""
+    while True:
+        if stop_sig["sig"] is not None:
+            _terminate_all(procs)
+            return 128 + stop_sig["sig"]
+        failed = [(tid, p.returncode) for tid, p in zip(local_ids, procs)
+                  if p.poll() is not None and p.returncode != 0]
+        if failed:
+            # fail fast: a half-dead cohort hangs in collectives.
+            # Popen reports a signal death as -N; exit statuses are
+            # 0..255, so surface it as the conventional 128+N
+            bad_tid, bad_rc = failed[0]
+            if bad_rc < 0:
+                bad_rc = 128 - bad_rc
+            sys.stderr.write(
+                "paddle_tpu.launch: worker %d exited with %d; "
+                "terminating cohort\n" % (bad_tid, bad_rc))
+            _terminate_all(procs)
+            return bad_rc
+        if all(p.poll() is not None for p in procs):
+            return 0
+        time.sleep(0.1)
 
 
 def launch(argv=None):
@@ -51,43 +145,45 @@ def launch(argv=None):
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
 
-    procs = []
     # On a single-host invocation with multiple endpoints we spawn them all
     # locally (test/dev mode, mirrors multi-process-on-localhost testing —
     # SURVEY.md §4.5). On real clusters each host runs launch with its
     # --host_id.
-    local_ids = range(nhosts) if args.host_id is None and nhosts > 1 and \
-        all(e.split(":")[0] in ("127.0.0.1", "localhost")
-            for e in endpoints) else [host_id]
+    local_ids = list(range(nhosts)) if args.host_id is None and \
+        nhosts > 1 and all(e.split(":")[0] in ("127.0.0.1", "localhost")
+                           for e in endpoints) else [host_id]
 
-    for tid in local_ids:
-        env = dict(os.environ)
-        env.update({
-            "PADDLE_TRAINER_ID": str(tid),
-            "PADDLE_CURRENT_ENDPOINT": endpoints[tid],
-            "PADDLE_TRAINERS_NUM": str(nhosts),
-            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
-        })
-        cmd = [sys.executable, "-u", args.training_script] \
-            + args.training_script_args
-        if args.log_dir:
-            out = open(os.path.join(args.log_dir,
-                                    "workerlog.%d" % tid), "w")
-        else:
-            out = None
-        procs.append(subprocess.Popen(cmd, env=env, stdout=out,
-                                      stderr=subprocess.STDOUT
-                                      if out else None))
+    stop_sig = {"sig": None}
+    live_procs = []
 
-    def _term(signum, frame):
-        for p in procs:
-            p.terminate()
+    def _sig(signum, frame):
+        stop_sig["sig"] = signum
+        for p in live_procs:
+            if p.poll() is None:
+                try:
+                    p.terminate()
+                except OSError:
+                    pass
 
-    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+
     rc = 0
-    for p in procs:
-        p.wait()
-        rc = rc or p.returncode
+    for attempt in range(max(args.max_restarts, 0) + 1):
+        procs, logs = _spawn_cohort(args, endpoints, local_ids, attempt)
+        live_procs[:] = procs
+        try:
+            rc = _supervise(procs, local_ids, stop_sig)
+        finally:
+            for f in logs:
+                if f:
+                    f.close()
+        if rc == 0 or stop_sig["sig"] is not None:
+            break
+        if attempt < max(args.max_restarts, 0):
+            sys.stderr.write(
+                "paddle_tpu.launch: cohort failed (rc=%d); restart "
+                "%d/%d\n" % (rc, attempt + 1, args.max_restarts))
     sys.exit(rc)
 
 

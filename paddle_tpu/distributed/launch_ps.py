@@ -92,9 +92,25 @@ def launch(argv=None):
         for p, _ in procs[len(servers):]:
             rc = p.wait() or rc
     finally:
-        for p, f in procs:
-            if p.poll() is None:
-                p.terminate()
+        # grace window before reaping: a pserver that is already
+        # exiting cleanly (trainers sent complete(), or a short probe
+        # script still flushing its log) must not be terminated
+        # mid-write, which truncates its log and discards its rc
+        import time
+
+        from .launch import _terminate_all
+
+        deadline = time.monotonic() + 5.0
+        for p, _ in procs:
+            remaining = deadline - time.monotonic()
+            if p.poll() is None and remaining > 0:
+                try:
+                    p.wait(timeout=remaining)
+                except subprocess.TimeoutExpired:
+                    pass
+        # terminate (then kill) whatever is still running
+        _terminate_all([p for p, _ in procs], grace_s=5.0)
+        for _, f in procs:
             if f:
                 f.close()
     return rc

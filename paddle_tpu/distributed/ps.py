@@ -15,6 +15,14 @@ applies updates by executing the transpiled update program through the
 normal fluid Executor (REAL optimizer ops, not a re-implementation), with
 sync mode aggregating all trainers' grads behind a barrier whose action
 runs the update exactly once per global step.
+
+Fault tolerance: the RPC layer (distributed/rpc.py) reconnects dropped
+client connections and dedups retried requests per (client_id, seq), so
+a retried `send_grads_batch`/`sparse_push` after a mid-stream drop is
+applied to the tables exactly once, and a retried `send_barrier` never
+double-arrives at the sync barrier. The barrier itself is bounded by
+PADDLE_PS_BARRIER_TIMEOUT_S and reports heartbeat-lost trainers instead
+of hanging forever on a dead worker.
 """
 from __future__ import annotations
 
@@ -399,9 +407,42 @@ class ParameterServer:
         self._completed: set = set()
         self._barrier = threading.Barrier(self.trainers,
                                           action=self._apply_sync)
+        # sync barrier must not hang forever on a dead trainer: bound
+        # the wait and report lost workers (heartbeat monitor) instead
+        import os
+
+        self._barrier_timeout_s = float(
+            os.environ.get("PADDLE_PS_BARRIER_TIMEOUT_S", 600))
+        self._barrier_reset_lock = threading.Lock()
+        # trainers that reached the CURRENT barrier round: the break
+        # diagnostic names who never arrived. Heartbeat ages can't
+        # attribute the break — waiters stop beating while blocked, so
+        # by break time every healthy waiter looks stale too.
+        self._barrier_arrived: set = set()
+        self._barrier_last_missing: list = []
+        self._barrier_action_failed = False
 
     # sync: barrier action runs in exactly one thread
     def _apply_sync(self):
+        try:
+            self._apply_sync_inner()
+        except BaseException:
+            # the flag — not an empty missing-set, which a straggler
+            # arriving mid-break can also produce — is what marks this
+            # round as an action failure for the other waiters
+            with self._barrier_reset_lock:
+                self._barrier_action_failed = True
+            raise
+        with self._barrier_reset_lock:
+            self._barrier_arrived.clear()
+            # a successful round also clears any stale failure flag
+            # (world=1: an action failure propagates to the sole waiter
+            # without entering the BrokenBarrierError handler that
+            # normally consumes the flag, so the retry's handler reads
+            # it once; it must not outlive that)
+            self._barrier_action_failed = False
+
+    def _apply_sync_inner(self):
         with self._lock:
             feed = {}
             for gname, pname in self.grad_of.items():
@@ -475,7 +516,45 @@ class ParameterServer:
                 return [np.asarray(self.scope.find_var(p))
                         for p in args]
         if method == "send_barrier":
-            self._barrier.wait()
+            tid = int(args[0])
+            self.heartbeat.beat(tid)
+            with self._barrier_reset_lock:
+                self._barrier_arrived.add(tid)
+            try:
+                self._barrier.wait(timeout=self._barrier_timeout_s)
+            except threading.BrokenBarrierError:
+                # reset so later steps can still synchronize once the
+                # straggler returns — a broken Barrier otherwise rejects
+                # every future wait() for the rest of the run. Reset
+                # exactly ONCE per broken round (every waiter lands
+                # here; a late second reset() would break a fresh round
+                # a recovering trainer already re-entered), and capture
+                # the never-arrived set before clearing it.
+                with self._barrier_reset_lock:
+                    if self._barrier.broken:
+                        self._barrier_last_missing = sorted(
+                            set(range(self.trainers))
+                            - self._barrier_arrived)
+                        self._barrier_arrived.clear()
+                        self._barrier.reset()
+                    missing = list(self._barrier_last_missing)
+                    action_failed = self._barrier_action_failed
+                    self._barrier_action_failed = False
+                if action_failed:
+                    # the thread that ran the action got the real error
+                    raise RuntimeError(
+                        "sync barrier broken: the aggregated update "
+                        "failed — see the pserver log / the co-trainer "
+                        "that received the original error")
+                if missing:
+                    raise RuntimeError(
+                        "sync barrier timed out after %.0fs: trainers "
+                        "%s never arrived"
+                        % (self._barrier_timeout_s, missing))
+                raise RuntimeError(
+                    "sync barrier broken while this trainer was "
+                    "arriving (another round timed out concurrently); "
+                    "retry the step")
             return []
         if method == "get_param":
             with self._lock:

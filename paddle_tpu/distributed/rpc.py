@@ -9,28 +9,56 @@ tensors travel as raw ndarray bytes with a tiny header — no pickle, no
 third-party deps.
 
 Wire format per message (little-endian):
-  [u64 total_len][u8 n_fields] then per field:
+  [u64 total_len][u16 n_fields] then per field:
   [u8 kind][u64 len][payload]  (u64 frames: multi-GB dataset blobs must
   not overflow the length prefix)
     kind 0: utf-8 string
     kind 1: ndarray — payload is [u8 dtype_len][dtype str][u8 ndim]
             [u64 x ndim shape][raw bytes]
     kind 2: int64
-A request is (method:str, *fields); the response is a plain field list
-(first field "ok" or "err:<msg>").
+
+Fault tolerance (pod-scale preemption/flaky-networking is the common
+case, not the exception — see PAPERS.md on TPU concurrency limits):
+
+- every request travels in an envelope ["__rq1__", client_id, seq,
+  method, *args]; `seq` increments per client, so the server can
+  DEDUPLICATE a retried request after a mid-stream drop. The handler for
+  a given (client_id, seq) runs EXACTLY ONCE; a duplicate waits for the
+  original invocation and returns its cached response. A retried
+  `send_grads_batch` is therefore never double-applied to PS tables.
+- `RpcClient.call` transparently reconnects with exponential backoff on
+  any connection drop (env knobs: PADDLE_RPC_RETRIES, PADDLE_RPC_BACKOFF_S,
+  PADDLE_RPC_BACKOFF_MAX_S) and re-sends the SAME envelope.
+- error responses carry the exception type and the full server-side
+  traceback — ["exc", type, msg, traceback] — surfaced client-side as
+  RpcRemoteError (legacy "err:<msg>" responses are still understood).
+- the socket layer calls into distributed/faults.py before every
+  send/recv so drops/delays/kills are injectable deterministically
+  (PADDLE_FAULTS env or faults.inject ctx manager).
+- `RpcServer.shutdown()` is idempotent and thread-safe, including when
+  invoked from one of the server's own handler threads.
 """
 from __future__ import annotations
 
+import os
 import socket
 import socketserver
 import struct
 import threading
-from typing import List, Tuple
+import time
+import traceback
+import uuid
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from . import faults
+
+_U16 = struct.Struct("<H")
 _U32 = struct.Struct("<I")
 _U64 = struct.Struct("<Q")
+
+_ENVELOPE = "__rq1__"
 
 
 def _enc_field(buf: bytearray, v):
@@ -59,8 +87,14 @@ def _enc_field(buf: bytearray, v):
 
 
 def encode(fields) -> bytes:
+    # u16 field count: a batched send_grads_batch carries 2 fields per
+    # hosted table plus the envelope — a u8 silently capped the PS tier
+    # at ~125 params per server
+    if len(fields) > 0xFFFF:
+        raise ValueError("rpc message has %d fields (max 65535); batch "
+                         "smaller" % len(fields))
     body = bytearray()
-    body.append(len(fields))
+    body += _U16.pack(len(fields))
     for f in fields:
         _enc_field(body, f)
     return _U64.pack(len(body)) + bytes(body)
@@ -100,8 +134,8 @@ def _dec_field(mv, off):
 
 def decode(body: bytes) -> List:
     mv = memoryview(body)
-    n = mv[0]
-    off = 1
+    (n,) = _U16.unpack_from(mv, 0)
+    off = 2
     out = []
     for _ in range(n):
         v, off = _dec_field(mv, off)
@@ -129,29 +163,54 @@ def write_msg(sock, fields):
     sock.sendall(encode(fields))
 
 
+class RpcRemoteError(RuntimeError):
+    """A handler raised on the server; carries the remote exception type
+    and full server-side traceback instead of a bare message string."""
+
+    def __init__(self, method, remote_type, remote_msg, remote_tb=""):
+        self.method = method
+        self.remote_type = remote_type
+        self.remote_msg = remote_msg
+        self.remote_traceback = remote_tb
+        msg = "rpc %s failed: %s: %s" % (method, remote_type, remote_msg)
+        if remote_tb:
+            msg += "\n--- remote traceback ---\n%s" % remote_tb.rstrip()
+        super().__init__(msg)
+
+
+class _Stop(Exception):
+    """Raised by a handler to acknowledge then stop the server."""
+
+
 class RpcServer:
-    """Threaded TCP server dispatching (method, *args) -> fields."""
+    """Threaded TCP server dispatching (method, *args) -> fields.
+
+    Enveloped requests are deduplicated per (client_id, seq): the handler
+    runs exactly once; a retried duplicate (client reconnected after a
+    drop) waits for the original invocation and is answered from its
+    cached response, so side-effecting methods are never double-applied.
+    """
 
     def __init__(self, host, port, handler):
         outer = self
+        self._handler = handler
 
         class _H(socketserver.BaseRequestHandler):
             def handle(self):
                 sock = self.request
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                threading.current_thread()._rpc_server = outer
                 try:
                     while True:
+                        faults.on_message("server", "recv", sock=sock)
                         fields = read_msg(sock)
-                        method = fields[0]
-                        try:
-                            resp = handler(method, fields[1:])
-                            write_msg(sock, ["ok"] + list(resp or []))
-                        except _Stop:
-                            write_msg(sock, ["ok"])
+                        resp, stop, method = outer._dispatch(fields)
+                        faults.on_message("server", "send", method=method,
+                                          sock=sock)
+                        write_msg(sock, resp)
+                        if stop:
                             outer._stop_evt.set()
                             return
-                        except Exception as e:  # noqa: BLE001
-                            write_msg(sock, ["err:%s" % e])
                 except (ConnectionError, OSError):
                     return
 
@@ -164,7 +223,114 @@ class RpcServer:
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)
         self._stop_evt = threading.Event()
+        # (client_id) -> {"seq", "resp", "stop", "cv"}; all entries share
+        # _dedup_lock through their per-entry Conditions
+        self._dedup: Dict[str, dict] = {}
+        self._dedup_lock = threading.Lock()
+        self._shutdown_lock = threading.Lock()
+        self._closed = False
 
+    # -- request dedup ---------------------------------------------------
+    def _dispatch(self, fields) -> Tuple[List, bool, Optional[str]]:
+        if fields and fields[0] == _ENVELOPE:
+            cid, seq, method = fields[1], int(fields[2]), fields[3]
+            args = fields[4:]
+        else:  # bare legacy frame: no retry/dedup semantics
+            cid, seq, method = None, None, fields[0]
+            args = fields[1:]
+        if cid is None:
+            resp, stop = self._execute(method, args)
+            return resp, stop, method
+        if method == "__rpc_bye__":
+            # clean client close: evict its dedup entry so the cached
+            # last response (possibly a gather-sized blob) is released
+            with self._dedup_lock:
+                self._dedup.pop(cid, None)
+            return ["ok"], False, method
+
+        with self._dedup_lock:
+            ent = self._dedup.get(cid)
+            if ent is None:
+                self._evict_completed_locked()
+                ent = self._dedup[cid] = {
+                    "seq": -1, "resp": None, "stop": False, "ts": 0.0,
+                    "cv": threading.Condition(self._dedup_lock)}
+            ent["ts"] = time.monotonic()
+            if seq <= ent["seq"]:
+                if seq < ent["seq"]:
+                    # a client never has two requests in flight, so a
+                    # seq older than the newest is a protocol bug
+                    return (["exc", "RuntimeError",
+                             "stale duplicate request seq=%d (server at "
+                             "seq=%d)" % (seq, ent["seq"]), ""],
+                            False, method)
+                # duplicate of the in-flight/completed newest request:
+                # wait for the original handler invocation, answer from
+                # its cached response — NEVER re-invoke the handler
+                while (ent["seq"] == seq and ent["resp"] is None
+                       and not self._closed):
+                    ent["cv"].wait(timeout=0.5)
+                if ent["seq"] == seq and ent["resp"] is not None:
+                    return ent["resp"], ent["stop"], method
+                return (["exc", "ConnectionError",
+                         "server shutting down", ""], False, method)
+            # new request: claim the slot before executing so a racing
+            # duplicate blocks instead of double-invoking the handler
+            ent["seq"], ent["resp"], ent["stop"] = seq, None, False
+
+        resp, stop = self._execute(method, args)
+        with self._dedup_lock:
+            if ent["seq"] == seq:
+                ent["resp"], ent["stop"] = resp, stop
+                ent["cv"].notify_all()
+        return resp, stop, method
+
+    _DEDUP_MAX_CLIENTS = 1024
+
+    @staticmethod
+    def _dedup_idle_evict_s():
+        """Minimum idle age before a completed dedup entry may be
+        evicted: must exceed the worst-case client retry span (each
+        attempt pays up to reconnect-timeout + backoff), or an evicted
+        entry's late retry would re-execute a side-effecting request.
+        Derived from the same env knobs the clients read."""
+        retries = int(os.environ.get("PADDLE_RPC_RETRIES", 8))
+        reconnect = float(
+            os.environ.get("PADDLE_RPC_RECONNECT_TIMEOUT_S", 5.0))
+        backoff_max = float(
+            os.environ.get("PADDLE_RPC_BACKOFF_MAX_S", 2.0))
+        return max(60.0, 2.0 * retries * (reconnect + backoff_max))
+
+    def _evict_completed_locked(self):
+        """Bound the dedup table against client churn (crashed clients
+        never say goodbye): once over the cap, drop entries that are
+        completed AND idle well past the retry window — evicting a
+        recently-active client would let its in-flight retry re-execute
+        a side-effecting request, breaking the exactly-once guarantee.
+        If everything is recent, correctness wins and the table may
+        temporarily exceed the cap. Called with _dedup_lock held."""
+        if len(self._dedup) < self._DEDUP_MAX_CLIENTS:
+            return
+        now = time.monotonic()
+        min_idle = self._dedup_idle_evict_s()
+        for old_cid in list(self._dedup):
+            if len(self._dedup) < self._DEDUP_MAX_CLIENTS:
+                break
+            e = self._dedup[old_cid]
+            if e["resp"] is not None and now - e["ts"] > min_idle:
+                del self._dedup[old_cid]
+
+    def _execute(self, method, args) -> Tuple[List, bool]:
+        try:
+            resp = self._handler(method, args)
+            return ["ok"] + list(resp or []), False
+        except _Stop:
+            return ["ok"], True
+        except Exception as e:  # noqa: BLE001
+            return (["exc", type(e).__name__, str(e),
+                     traceback.format_exc()], False)
+
+    # -- lifecycle -------------------------------------------------------
     def start(self):
         self._thread.start()
 
@@ -172,48 +338,160 @@ class RpcServer:
         self._stop_evt.wait(timeout)
 
     def shutdown(self):
-        self._server.shutdown()
-        self._server.server_close()
+        """Idempotent + thread-safe. Safe to call from one of this
+        server's own handler threads (hc_shutdown / `complete` paths):
+        socketserver.shutdown() joins the serve_forever loop, and a
+        handler thread holding resources the loop waits on would
+        deadlock — so from a handler thread the blocking part runs on a
+        one-shot helper thread instead."""
+        with self._shutdown_lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._stop_evt.set()
+        with self._dedup_lock:
+            for ent in self._dedup.values():
+                ent["cv"].notify_all()
 
+        def _do():
+            self._server.shutdown()
+            self._server.server_close()
 
-class _Stop(Exception):
-    """Raised by a handler to acknowledge then stop the server."""
+        if getattr(threading.current_thread(), "_rpc_server", None) is self:
+            t = threading.Thread(target=_do, daemon=True,
+                                 name="rpc-shutdown-helper")
+            t.start()
+        else:
+            _do()
 
 
 class RpcClient:
-    def __init__(self, endpoint: str, timeout=60.0, retries=60):
+    """RPC client with transparent reconnect + idempotent retry.
+
+    Each instance owns a stable client_id and a per-request sequence
+    number. On a connection drop (send or recv side) the client
+    reconnects with exponential backoff and re-sends the SAME envelope;
+    the server's dedup layer guarantees the handler ran exactly once and
+    replays the response if the original completed while the wire was
+    down. Application-level errors (["exc", ...]) are NOT retried.
+    """
+
+    def __init__(self, endpoint: str, timeout=60.0, retries=60,
+                 client_id: Optional[str] = None,
+                 call_retries: Optional[int] = None):
         host, port = endpoint.rsplit(":", 1)
+        self._endpoint = endpoint
+        self._addr = (host, int(port))
+        self._timeout = timeout
+        self._connect_retries = int(retries)
+        self._cid = client_id or uuid.uuid4().hex
+        self._seq = 0
+        self._sock = None
+        self._lock = threading.Lock()
+        # call_retries=0/1 suits fire-and-forget control paths
+        # (heartbeats, teardown): their failures are swallowed anyway,
+        # so burning the full retry cycle only stalls shutdown
+        self._call_retries = int(
+            call_retries if call_retries is not None
+            else os.environ.get("PADDLE_RPC_RETRIES", 8))
+        self._backoff_s = float(
+            os.environ.get("PADDLE_RPC_BACKOFF_S", 0.05))
+        self._backoff_max_s = float(
+            os.environ.get("PADDLE_RPC_BACKOFF_MAX_S", 2.0))
+        # retry reconnects use a SHORT connect timeout: a blackholed
+        # (preempted, no RST) server would otherwise stall every
+        # attempt for the full initial-connect timeout, turning a
+        # dead-host error into ~retries x 60s of silence
+        self._reconnect_timeout_s = float(
+            os.environ.get("PADDLE_RPC_RECONNECT_TIMEOUT_S", 5.0))
+        self._connect()
+
+    def _connect(self):
         last = None
-        for _ in range(retries):
+        for _ in range(self._connect_retries):
             try:
                 self._sock = socket.create_connection(
-                    (host, int(port)), timeout=timeout)
+                    self._addr, timeout=self._timeout)
                 break
             except OSError as e:
                 last = e
-                import time
-
                 time.sleep(0.25)
         else:
             raise ConnectionError("cannot reach pserver %s: %s"
-                                  % (endpoint, last))
+                                  % (self._endpoint, last))
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         # blocking after connect: barrier/collective waits legitimately
         # exceed any fixed recv timeout (first-step compiles, slow ranks);
         # the SERVER side owns wait timeouts and always answers
         self._sock.settimeout(None)
-        self._lock = threading.Lock()
+
+    def _drop_sock(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
 
     def call(self, method: str, *args) -> List:
         with self._lock:
-            write_msg(self._sock, [method] + list(args))
-            resp = read_msg(self._sock)
+            self._seq += 1
+            payload = [_ENVELOPE, self._cid, self._seq, method] + list(args)
+            resp = self._call_with_retry(method, payload)
+        if resp and resp[0] == "exc":
+            raise RpcRemoteError(method, resp[1], resp[2],
+                                 resp[3] if len(resp) > 3 else "")
         if isinstance(resp[0], str) and resp[0].startswith("err:"):
             raise RuntimeError("rpc %s failed: %s" % (method, resp[0][4:]))
         return resp[1:]
 
+    def _call_with_retry(self, method, payload):
+        attempt = 0
+        while True:
+            try:
+                if self._sock is None:
+                    # fast single-attempt reconnect here; backoff between
+                    # whole attempts is handled below
+                    self._sock = socket.create_connection(
+                        self._addr,
+                        timeout=min(self._reconnect_timeout_s,
+                                    self._timeout))
+                    self._sock.setsockopt(socket.IPPROTO_TCP,
+                                          socket.TCP_NODELAY, 1)
+                    self._sock.settimeout(None)
+                faults.on_message("client", "send", method=method,
+                                  sock=self._sock)
+                write_msg(self._sock, payload)
+                faults.on_message("client", "recv", method=method,
+                                  sock=self._sock)
+                return read_msg(self._sock)
+            except (ConnectionError, OSError) as e:
+                self._drop_sock()
+                attempt += 1
+                if attempt > self._call_retries:
+                    raise ConnectionError(
+                        "rpc %s to %s failed after %d retries: %s"
+                        % (method, self._endpoint, self._call_retries,
+                           e)) from e
+                time.sleep(min(self._backoff_s * (2 ** (attempt - 1)),
+                               self._backoff_max_s))
+
     def close(self):
+        # best-effort goodbye so the server drops this client's dedup
+        # entry (it pins the last response blob otherwise); never block
+        # a shutdown path on it
         try:
-            self._sock.close()
+            if self._sock is not None:
+                with self._lock:
+                    self._seq += 1
+                    self._sock.settimeout(2.0)
+                    write_msg(self._sock, [_ENVELOPE, self._cid,
+                                           self._seq, "__rpc_bye__"])
+                    read_msg(self._sock)
+        except Exception:  # noqa: BLE001 - server may already be gone
+            pass
+        try:
+            if self._sock is not None:
+                self._sock.close()
         except OSError:
             pass

@@ -51,15 +51,52 @@ class ShardedCheckpointManager:
         """Read checkpoint `step` (default: latest). With `template`
         (a pytree of arrays or ShapeDtypeStructs carrying shardings),
         restored arrays land DIRECTLY in that layout on the live mesh —
-        no host gather."""
+        no host gather.
+
+        Crash safety: a mid-save kill can leave a partial/truncated step
+        dir that still LOOKS published. When `step` is not given, the
+        newest step is validated by actually restoring it; on failure we
+        warn and fall back to the next-newest INTACT step (a resumed run
+        repeats a few steps instead of dying — or worse, training from
+        scratch). An explicitly requested `step` never falls back.
+
+        A template that mismatches the on-disk schema (resized layer,
+        different mesh) fails EVERY step the same way; the final error
+        chains the newest failure — read it before suspecting disk
+        corruption.
+
+        Multi-host caveat: validation is per-process. If only ONE
+        host's shard of the newest step is corrupt, hosts could pick
+        different steps (or stall inside the sharded restore); on
+        multi-host topologies, agree on the step first (e.g. min over
+        an allreduce of each host's newest-intact step) and pass it
+        explicitly (ROADMAP "Open items")."""
+        steps = sorted(self.all_steps(), reverse=True)
+        if step is not None:
+            return self._restore_step(int(step), template)
+        if not steps:
+            raise FileNotFoundError(
+                "no checkpoints under %s" % self._dir)
+        last_err: Optional[BaseException] = None
+        for s in steps:
+            try:
+                return self._restore_step(int(s), template)
+            except Exception as e:  # noqa: BLE001 - corrupt/partial step
+                last_err = e
+                import logging
+
+                logging.getLogger("paddle_tpu.checkpoint").warning(
+                    "checkpoint step %d under %s is corrupt or "
+                    "incomplete (%s: %s); falling back to the previous "
+                    "step", s, self._dir, type(e).__name__, e)
+        raise RuntimeError(
+            "no intact checkpoint under %s (tried steps %s); newest "
+            "failure: %s" % (self._dir, steps, last_err)) from last_err
+
+    def _restore_step(self, step: int, template: Any = None) -> Any:
         import jax
         import orbax.checkpoint as ocp
 
-        if step is None:
-            step = self.latest_step()
-        if step is None:
-            raise FileNotFoundError(
-                "no checkpoints under %s" % self._dir)
         if template is None:
             return self._mgr.restore(int(step))
 

@@ -202,31 +202,73 @@ def save_checkpoint(executor, path, train_status=None, main_program=None,
 
 def load_checkpoint(executor, path, main_program=None, scope=None,
                     ignore_empty=True):
-    """Restore the LATEST numbered checkpoint; returns its TrainStatus,
-    or None when no checkpoint exists (reference: load_checkpoint
-    collective/__init__.py:294)."""
-    import jax.numpy as jnp
+    """Restore the LATEST intact numbered checkpoint; returns its
+    TrainStatus, or None when no checkpoint exists (reference:
+    load_checkpoint collective/__init__.py:294).
 
+    Crash safety: publication is atomic (tmp-then-os.replace), but disk
+    faults or a kill inside the payload write of a FUTURE publisher can
+    still leave the newest dir unreadable. Rather than dying — or
+    silently restarting from scratch — restore falls back to the next
+    newest checkpoint that loads cleanly, logging what was skipped.
+    The fallback decision is per-process: multi-trainer jobs reading a
+    shared checkpoint dir should verify all ranks resumed the same
+    step_no (a host-collective allreduce of step_no) before training
+    on (ROADMAP "Open items")."""
     from . import framework
 
-    n = get_last_checkpoint_no(path)
-    if n < 0:
+    dirs = _ckpt_dirs(path)
+    if not dirs:
         if not ignore_empty:
             raise RuntimeError("no checkpoint found under %r" % path)
         return None
-    real = latest_checkpoint_dir(path)
     program = main_program or framework.default_main_program()
     scope = scope or global_scope()
     names = [v.name for v in program.list_vars() if is_persistable(v)]
+    last_err = None
+    for n in sorted(dirs, reverse=True):
+        try:
+            return _load_one_checkpoint(dirs[n], names, scope)
+        except _SchemaMismatch:
+            # the PROGRAM disagrees with the checkpoint (e.g. a newly
+            # added persistable): every older checkpoint is equally
+            # mismatched — surface the actionable error immediately
+            # instead of reading gigabytes of doomed fallbacks
+            raise
+        except Exception as e:  # noqa: BLE001 - corrupt/partial dir
+            last_err = e
+            import logging
+
+            logging.getLogger("paddle_tpu.checkpoint").warning(
+                "checkpoint %s is unreadable (%s: %s); falling back to "
+                "the previous one", dirs[n], type(e).__name__, e)
+    raise RuntimeError(
+        "no intact checkpoint under %r (tried %s)"
+        % (path, [dirs[n] for n in sorted(dirs, reverse=True)])
+    ) from last_err
+
+
+class _SchemaMismatch(RuntimeError):
+    """Checkpoint readable but var set disagrees with the program —
+    not corruption, so the fallback loop must not retry older dirs."""
+
+
+def _load_one_checkpoint(real, names, scope):
+    """Load one published dir into scope; raises on ANY defect (missing
+    vars, truncated pickle, bad status JSON) WITHOUT mutating the scope,
+    so a fallback to an older checkpoint starts clean."""
+    import jax.numpy as jnp
+
     d = _load_dict(real, names, _PARAM_FILE)
     missing = [nm for nm in names if nm not in d]
     if missing:
-        raise RuntimeError("checkpoint %r is missing vars %s"
-                           % (real, missing))
+        raise _SchemaMismatch("checkpoint %r is missing vars %s"
+                              % (real, missing))
+    with open(os.path.join(real, _STATUS_FILE)) as f:
+        status = TrainStatus._from_dict(json.load(f))
     for nm in names:
         scope.set_var(nm, jnp.asarray(d[nm]))
-    with open(os.path.join(real, _STATUS_FILE)) as f:
-        return TrainStatus._from_dict(json.load(f))
+    return status
 
 
 class AsyncCheckpointer:

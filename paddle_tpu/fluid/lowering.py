@@ -912,7 +912,9 @@ def _compile_dp(fn, mesh, dp_axis, program, block, feed_names, fetch_names,
     # state_out names are discovered inside fn; all replicated
     fetch_specs = [out_spec_for_fetch(n) for n in fetch_names]
 
-    smapped = jax.shard_map(
+    from ..parallel.env import shard_map_compat
+
+    smapped = shard_map_compat(
         wrapped, mesh=mesh,
         in_specs=(feed_specs, state_specs_mut, state_specs_ro, P()),
         out_specs=(fetch_specs, P()),

@@ -59,6 +59,34 @@ def active_axes() -> Optional[dict]:
     return getattr(_tls, "axes", None)
 
 
+def axis_size_compat(axis_name):
+    """`lax.axis_size` across jax versions: 0.4.x lacks it; psum of a
+    literal 1 over the axis constant-folds to the axis size at trace
+    time, so there is no runtime collective either way."""
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def shard_map_compat(f, mesh, in_specs, out_specs, check_vma=False):
+    """jax.shard_map across jax versions: the top-level `jax.shard_map`
+    (with `check_vma`) only exists in newer jax; 0.4.x ships it as
+    `jax.experimental.shard_map.shard_map` with the equivalent knob
+    named `check_rep`. Every shard_map call in the tree routes through
+    here so version skew breaks exactly one spot."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)
+
+
 def axis_name_for_ring(ring_id: int) -> Optional[str]:
     axes = active_axes()
     if not axes:

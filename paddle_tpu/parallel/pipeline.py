@@ -399,7 +399,9 @@ def compile_pipeline(program, block, feed_specs, fetch_names, state_specs):
             return loss, grads, new_fst
 
         feeds_spec = P(None, "dp") if dp > 1 else P()
-        smapped = jax.shard_map(
+        from .env import shard_map_compat
+
+        smapped = shard_map_compat(
             device_step, mesh=mesh,
             in_specs=(P(), P(), feeds_spec),
             out_specs=(P(), P(), P()),

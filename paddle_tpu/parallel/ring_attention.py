@@ -33,7 +33,9 @@ def ring_attention(q, k, v, axis_name, causal=False, sm_scale=None):
     device's sequence shard. Returns [B, H, S_local, D] in q.dtype: the
     rows of the GLOBAL attention output owned by this device.
     """
-    n = lax.axis_size(axis_name)
+    from .env import axis_size_compat
+
+    n = axis_size_compat(axis_name)
     idx = lax.axis_index(axis_name)
     B, H, S_loc, D = q.shape
     if sm_scale is None:
@@ -99,5 +101,7 @@ def ring_attention_sharded(q, k, v, mesh, seq_axis="sp", causal=False,
     spec = P(None, None, seq_axis, None)
     fn = functools.partial(ring_attention, axis_name=seq_axis,
                            causal=causal, sm_scale=sm_scale)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+    from .env import shard_map_compat
+
+    return shard_map_compat(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                            out_specs=spec, check_vma=False)(q, k, v)

@@ -432,7 +432,9 @@ def make_train_step(cfg, mesh, with_grads=False):
         return new_p, new_m, new_v, loss, grads
 
     data_spec = P(None, "dp", None)
-    smapped = jax.shard_map(
+    from .env import shard_map_compat
+
+    smapped = shard_map_compat(
         device_step, mesh=mesh,
         in_specs=(specs, specs, specs, data_spec, data_spec, P()),
         out_specs=(specs, specs, specs, P(), specs),

@@ -53,7 +53,9 @@ def ulysses_attention(q, k, v, axis_name, causal=False, sm_scale=None,
     by the axis size). Returns [B, S_local, H, D]: the global-attention
     output rows this device owns.
     """
-    p = lax.axis_size(axis_name)
+    from .env import axis_size_compat
+
+    p = axis_size_compat(axis_name)
     b, s_loc, h, d = q.shape
     assert h % p == 0, (h, p)
     if sm_scale is None:
@@ -90,5 +92,7 @@ def ulysses_attention_sharded(q, k, v, mesh, seq_axis="sp",
         return ulysses_attention(qq, kk, vv, seq_axis, causal=causal,
                                  sm_scale=sm_scale, use_flash=use_flash)
 
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+    from .env import shard_map_compat
+
+    return shard_map_compat(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                            out_specs=spec, check_vma=False)(q, k, v)

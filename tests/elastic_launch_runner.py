@@ -1,0 +1,108 @@
+"""Worker script for the supervised-launch + elastic-resume test
+(spawned via `python -m paddle_tpu.distributed.launch --max_restarts`).
+
+Trains 8 deterministic steps with DistributedStrategy.elastic
+checkpointing (save_steps=2). In crash mode, the FIRST attempt
+(PADDLE_RESTART_NUM=0) flushes the async checkpointer and dies hard
+(os._exit) right after step index 4 — simulating a preempted worker
+whose last published checkpoint is step 3. The supervised restart
+(attempt 1) auto-resumes from that checkpoint and finishes steps 4..7.
+
+argv: <checkpoint_root> [crash]
+Prints one line per completed step: LOSS <step> <value>.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu.fluid as fluid  # noqa: E402
+from paddle_tpu import fleet  # noqa: E402
+from paddle_tpu.core.scope import Scope  # noqa: E402
+from paddle_tpu.fluid import checkpoint as ckpt  # noqa: E402
+from paddle_tpu.fluid import framework  # noqa: E402
+
+STEPS = 8
+CRASH_AFTER_STEP = 4
+
+
+def build(root):
+    main, startup = fluid.Program(), fluid.Program()
+    with framework.unique_name_guard(), \
+            fluid.program_guard(main, startup):
+        main.random_seed = startup.random_seed = 5
+        x = fluid.data(name="x", shape=[-1, 16], dtype="float32")
+        y = fluid.data(name="y", shape=[-1, 1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=24, act="tanh")
+        pred = fluid.layers.fc(input=h, size=1, act=None)
+        loss = fluid.layers.reduce_mean(fluid.layers.square(pred - y))
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        st = fleet.DistributedStrategy()
+        st.elastic = True
+        st.elastic_configs = {"checkpoint_dir": root, "save_steps": 2,
+                              "max_checkpoints": 2}
+        fleet.init()
+        opt = fleet.distributed_optimizer(opt, st)
+        opt.minimize(loss)
+    return main, startup, loss.name
+
+
+def data():
+    rng = np.random.RandomState(3)
+    xs = rng.randn(STEPS, 8, 16).astype(np.float32)
+    w = rng.randn(16, 1).astype(np.float32)
+    return xs, np.tanh(xs @ w)
+
+
+def main():
+    root = sys.argv[1]
+    attempt = int(os.environ.get("PADDLE_RESTART_NUM", "0"))
+    crash = len(sys.argv) > 2 and sys.argv[2] == "crash" and attempt == 0
+
+    prog, startup, loss_name = build(root)
+    xs, ys = data()
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    # resume params AND the data cursor from the SAME checkpoint via
+    # the fallback-aware loader (reading the newest dir's status
+    # directly could disagree with the executor's fallback restore if
+    # the newest payload is corrupt), then mark the program resumed so
+    # the executor glue doesn't restore a second time
+    status = ckpt.load_checkpoint(exe, root, main_program=prog,
+                                  scope=scope)
+    ecfg = prog._elastic_cfg
+    ecfg["_resumed"] = True
+    start = 0
+    if status is not None:
+        start = status.step_no + 1
+        ecfg["_step"] = start
+    for i in range(start, STEPS):
+        v, = exe.run(prog, feed={"x": xs[i], "y": ys[i]},
+                     fetch_list=[loss_name], scope=scope)
+        print("LOSS %d %.6f" % (i, float(np.asarray(v).reshape(-1)[0])),
+              flush=True)
+        if crash and i == CRASH_AFTER_STEP:
+            # flush the async writer (a real preemption's SIGTERM grace
+            # window), then die WITHOUT cleanup
+            cp = prog._elastic_cfg.get("_ckpt")
+            if cp is not None:
+                cp.close()
+            os._exit(17)
+    # flush the last pending save, then exit WITHOUT running interpreter
+    # teardown: jax's CPU runtime intermittently aborts ("terminate
+    # called without an active exception") while daemon threads die at
+    # exit, which would turn a fully-successful run into rc=-6
+    cp = prog._elastic_cfg.get("_ckpt")
+    if cp is not None:
+        cp.close()
+    sys.stdout.flush()
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -66,6 +66,36 @@ def test_save_load_roundtrip_and_retention(tmp_path, rng):
     assert restored == pytest.approx(drift, rel=1e-5)  # same params again
 
 
+def test_load_checkpoint_falls_back_past_corrupt_latest(tmp_path, rng):
+    """Crash safety: when the newest numbered dir is unreadable (disk
+    fault / partial payload), load_checkpoint restores the next-newest
+    intact checkpoint instead of dying, and raises only when NO dir is
+    intact."""
+    root = str(tmp_path / "ckpts")
+    loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feed = {"x": rng.rand(8, 6).astype("float32"),
+            "label": rng.randint(0, 3, (8, 1)).astype("int64")}
+    for step in range(3):
+        exe.run(feed=feed, fetch_list=[loss])
+        ckpt.save_checkpoint(exe, root,
+                             ckpt.TrainStatus(epoch_no=0, step_no=step),
+                             checkpoint_num=3)
+
+    latest = ckpt.latest_checkpoint_dir(root)
+    with open(os.path.join(latest, "persistables.pkl"), "wb") as f:
+        f.write(b"\x00truncated")
+    status = ckpt.load_checkpoint(exe, root)
+    assert status.step_no == 1  # newest INTACT checkpoint
+
+    for d in os.listdir(root):
+        with open(os.path.join(root, d, "persistables.pkl"), "wb") as f:
+            f.write(b"\x00truncated")
+    with pytest.raises(RuntimeError, match="no intact checkpoint"):
+        ckpt.load_checkpoint(exe, root)
+
+
 def test_load_checkpoint_empty_dir(tmp_path):
     _build_mlp()
     exe = fluid.Executor(fluid.CPUPlace())

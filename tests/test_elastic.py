@@ -96,3 +96,93 @@ def test_elastic_off_leaves_program_unmarked(tmp_path):
     main, _, _ = _build_and_minimize(seed=9, elastic=False,
                                      root=str(tmp_path))
     assert getattr(main, "_elastic_cfg", None) is None
+
+
+# -- supervised launch: fail-fast + restart-with-resume ---------------------
+
+import os as _os
+import subprocess as _sp
+import sys as _sys
+
+_DIR = _os.path.dirname(_os.path.abspath(__file__))
+_REPO = _os.path.dirname(_DIR)
+
+
+def _launch_env():
+    env = {k: v for k, v in _os.environ.items()
+           if not k.startswith(("PALLAS_AXON", "AXON_", "TPU_"))}
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.pop("PADDLE_FAULTS", None)
+    return env
+
+
+def _loss_lines(text):
+    return [ln for ln in text.splitlines() if ln.startswith("LOSS")]
+
+
+def test_launch_fail_fast_exits_with_first_nonzero_rc(tmp_path):
+    """First worker failure terminates the rest of the cohort and the
+    launcher exits with THAT code — not the last seen, and not after the
+    healthy worker's full (long) runtime."""
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, sys, time\n"
+        "tid = int(os.environ['PADDLE_TRAINER_ID'])\n"
+        "if tid == 1:\n"
+        "    sys.exit(7)\n"
+        "time.sleep(120)\n")
+    import time
+
+    t0 = time.monotonic()
+    proc = _sp.run(
+        [_sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--hosts", "127.0.0.1:6701,127.0.0.1:6702",
+         "--log_dir", str(tmp_path / "logs"), str(script)],
+        env=_launch_env(), cwd=_REPO, stdout=_sp.PIPE,
+        stderr=_sp.STDOUT, text=True, timeout=90)
+    dt = time.monotonic() - t0
+    assert proc.returncode == 7, proc.stdout
+    assert dt < 60, "fail-fast took %.0fs (healthy worker sleeps 120s)" \
+        % dt
+    assert "worker 1 exited with 7" in proc.stdout
+
+
+def test_supervised_restart_resumes_from_elastic_checkpoint(tmp_path):
+    """--max_restarts composes with the elastic checkpoint-resume path:
+    attempt 0 is killed hard after step 4 (last published checkpoint:
+    step 3), the restarted attempt resumes at step 4 and the combined
+    trajectory matches an uninterrupted run."""
+    runner = _os.path.join(_DIR, "elastic_launch_runner.py")
+    ref_root = str(tmp_path / "ref_ckpt")
+    ref = _sp.run([_sys.executable, runner, ref_root],
+                  env=_launch_env(), cwd=_REPO, stdout=_sp.PIPE,
+                  stderr=_sp.STDOUT, text=True, timeout=240)
+    assert ref.returncode == 0, ref.stdout
+    ref_losses = _loss_lines(ref.stdout)
+    assert len(ref_losses) == 8
+
+    root = str(tmp_path / "crash_ckpt")
+    log_dir = str(tmp_path / "logs")
+    proc = _sp.run(
+        [_sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--hosts", "127.0.0.1:6703", "--log_dir", log_dir,
+         "--max_restarts", "1", runner, root, "crash"],
+        env=_launch_env(), cwd=_REPO, stdout=_sp.PIPE,
+        stderr=_sp.STDOUT, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout
+    assert "restart 1/1" in proc.stdout, proc.stdout
+
+    log = open(_os.path.join(log_dir, "workerlog.0")).read()
+    got = _loss_lines(log)
+    # attempt 0 printed steps 0..4 then died; attempt 1 resumed from the
+    # step-3 checkpoint and reran 4..7 (log is append mode)
+    assert [ln.split()[1] for ln in got] == \
+        ["0", "1", "2", "3", "4", "4", "5", "6", "7"], log
+    # last occurrence per step: attempt 1's rerun of step 4 onwards
+    resumed = {ln.split()[1]: float(ln.split()[2]) for ln in got}
+    expected = {ln.split()[1]: float(ln.split()[2])
+                for ln in ref_losses}
+    for step in ("4", "5", "6", "7"):
+        np.testing.assert_allclose(resumed[step], expected[step],
+                                   rtol=1e-4, atol=1e-5)

@@ -23,6 +23,7 @@ def _impl_flag():
 @pytest.mark.parametrize("impl", ["threefry2x32", "rbg"])
 def test_export_roundtrip_with_dropout(_impl_flag, impl):
     import jax
+    import jax.export  # noqa: F401 - 0.4.x needs the explicit submodule import
 
     set_flags({"FLAGS_prng_impl": impl})
     main, startup = framework.Program(), framework.Program()

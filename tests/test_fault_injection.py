@@ -1,0 +1,389 @@
+"""Fault-tolerant distributed runtime: deterministic fault injection
+(distributed/faults.py) exercising RPC reconnect + idempotent retry,
+rank liveness fast-fail, store blob release, and the RpcServer shutdown
+race — all on CPU, no accelerator involved."""
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.faults
+
+from paddle_tpu.distributed import faults
+from paddle_tpu.distributed.rpc import (RpcClient, RpcRemoteError,
+                                        RpcServer)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_DIR)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _env(extra):
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PALLAS_AXON", "AXON_", "TPU_"))}
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.pop("PADDLE_FAULTS", None)
+    env.update(extra)
+    return env
+
+
+# -- injector unit behavior -------------------------------------------------
+
+def test_parse_spec_roundtrip():
+    injs = faults.parse_spec(
+        "drop:side=client,point=recv,every=3;"
+        "kill:at=40,exit_code=9;delay:every=2,delay_ms=1")
+    assert [i.kind for i in injs] == ["drop", "kill", "delay"]
+    assert injs[0].every == 3 and injs[0].side == "client"
+    assert injs[1].at == 40 and injs[1].exit_code == 9
+    assert injs[2].delay_ms == 1.0
+    with pytest.raises(ValueError):
+        faults.parse_spec("drop:every=1,at=2")  # both triggers
+    with pytest.raises(ValueError):
+        faults.parse_spec("explode:every=1")  # unknown kind
+
+
+def test_injector_counts_only_matching_events():
+    inj = faults.FaultInjector("drop", side="client", point="recv",
+                               method="put", every=2)
+    inj.fire("server", "recv", "put", None)   # wrong side: no count
+    inj.fire("client", "send", "put", None)   # wrong point: no count
+    inj.fire("client", "recv", "get", None)   # wrong method: no count
+    inj.fire("client", "recv", "put", None)   # 1st match: no fire
+    with pytest.raises(ConnectionError):
+        inj.fire("client", "recv", "put", None)  # 2nd match: fires
+
+
+def test_wire_format_roundtrips_large_batches():
+    """u16 field count: a batched send_grads_batch for a model with
+    hundreds of params per pserver must fit in one message (the u8
+    count capped it at ~125 params and overflowed with a bare
+    ValueError)."""
+    from paddle_tpu.distributed.rpc import decode, encode
+
+    fields = ["send_grads_batch", 7, 150]
+    for i in range(150):
+        fields += ["param_%d" % i, np.full((3,), i, np.float32)]
+    body = encode(fields)[8:]  # strip the u64 length prefix
+    out = decode(body)
+    assert out[0] == "send_grads_batch" and out[2] == 150
+    assert len(out) == len(fields)
+    np.testing.assert_array_equal(out[-1], fields[-1])
+    with pytest.raises(ValueError, match="max 65535"):
+        encode(list(range(70000)))
+
+
+# -- RPC reconnect + exactly-once retry -------------------------------------
+
+def _counting_server():
+    seen = []
+
+    def handler(method, args):
+        if method == "incr":
+            seen.append(int(args[0]))
+            return [len(seen)]
+        if method == "boom":
+            raise KeyError("table row missing")
+        return list(args)
+
+    srv = RpcServer("127.0.0.1", 0, handler)
+    srv.start()
+    return srv, seen
+
+
+def test_client_reconnects_and_handler_runs_exactly_once():
+    """Drop the connection on every 3rd response read: the request was
+    already APPLIED server-side, so the blind-retry failure mode is a
+    double-apply. The envelope dedup must keep the handler at exactly
+    one invocation per call."""
+    srv, seen = _counting_server()
+    cli = RpcClient("127.0.0.1:%d" % srv.port)
+    try:
+        with faults.inject("drop", side="client", point="recv", every=3):
+            for i in range(20):
+                (n,) = cli.call("incr", i)
+                assert n == i + 1  # replayed response, not re-applied
+        assert seen == list(range(20))
+    finally:
+        cli.close()
+        srv.shutdown()
+
+
+def test_close_evicts_server_dedup_entry():
+    """A clean client close must release the server-side dedup entry
+    (it pins the client's last response blob otherwise)."""
+    srv, _ = _counting_server()
+    cli = RpcClient("127.0.0.1:%d" % srv.port)
+    try:
+        cli.call("incr", 0)
+        assert cli._cid in srv._dedup
+        cli.close()
+        deadline = time.monotonic() + 5
+        while cli._cid in srv._dedup and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert cli._cid not in srv._dedup
+    finally:
+        srv.shutdown()
+
+
+def test_client_retries_send_side_drops_too():
+    srv, seen = _counting_server()
+    cli = RpcClient("127.0.0.1:%d" % srv.port)
+    try:
+        with faults.inject("drop", side="client", point="send", every=4):
+            for i in range(12):
+                cli.call("incr", i)
+        assert seen == list(range(12))
+    finally:
+        cli.close()
+        srv.shutdown()
+
+
+def test_retry_budget_exhaustion_raises_connection_error(monkeypatch):
+    monkeypatch.setenv("PADDLE_RPC_RETRIES", "2")
+    monkeypatch.setenv("PADDLE_RPC_BACKOFF_S", "0.01")
+    srv, _ = _counting_server()
+    cli = RpcClient("127.0.0.1:%d" % srv.port)
+    try:
+        with faults.inject("drop", side="client", point="send", every=1):
+            with pytest.raises(ConnectionError, match="after 2 retries"):
+                cli.call("incr", 0)
+    finally:
+        cli.close()
+        srv.shutdown()
+
+
+def test_remote_errors_carry_type_and_traceback():
+    srv, _ = _counting_server()
+    cli = RpcClient("127.0.0.1:%d" % srv.port)
+    try:
+        with pytest.raises(RpcRemoteError) as ei:
+            cli.call("boom")
+        e = ei.value
+        assert e.remote_type == "KeyError"
+        assert "table row missing" in e.remote_msg
+        assert "KeyError" in e.remote_traceback
+        assert "remote traceback" in str(e)
+        # the connection survives an application error (no retry storm)
+        assert cli.call("echo", 7) == [7]
+    finally:
+        cli.close()
+        srv.shutdown()
+
+
+# -- RpcServer shutdown race (satellite regression) -------------------------
+
+def test_server_shutdown_idempotent_and_safe_from_handler_thread():
+    done = threading.Event()
+
+    def handler(method, args):
+        if method == "die":
+            srv.shutdown()  # from THIS server's own handler thread
+            done.set()
+            return []
+        return []
+
+    srv = RpcServer("127.0.0.1", 0, handler)
+    srv.start()
+    cli = RpcClient("127.0.0.1:%d" % srv.port)
+    cli.call("die")
+    assert done.wait(timeout=10), "handler-thread shutdown deadlocked"
+    # idempotent: repeated + concurrent shutdowns are no-ops
+    srv.shutdown()
+    srv.shutdown()
+    cli.close()
+
+
+def test_ps_sync_barrier_breaks_with_missing_ranks_and_recovers(
+        monkeypatch):
+    """A sync barrier stuck on a dead trainer must (a) time out naming
+    the ranks that never arrived — heartbeat ages can't attribute it,
+    every blocked waiter looks stale — and (b) reset so a later round
+    with all trainers present still synchronizes."""
+    monkeypatch.setenv("PADDLE_PS_BARRIER_TIMEOUT_S", "1")
+    from paddle_tpu.distributed.ps import ParameterServer
+    from paddle_tpu.fluid import framework as fw
+
+    ps = ParameterServer(fw.Program(), None, trainers=2, mode="sync")
+    try:
+        with pytest.raises(RuntimeError, match=r"trainers \[1\] never "
+                                               r"arrived"):
+            ps.handle("send_barrier", [0])
+        # recovery: both trainers arrive -> the reset barrier releases
+        results = []
+        ts = [threading.Thread(
+            target=lambda t=t: results.append(
+                ps.handle("send_barrier", [t]))) for t in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert results == [[], []]
+    finally:
+        ps.heartbeat.stop()
+
+
+# -- host-collective liveness + blob release --------------------------------
+
+def test_store_liveness_fast_fail_names_missing_ranks(monkeypatch):
+    """A barrier blocked on a dead rank must fail in ~liveness_s with
+    the missing rank ids + heartbeat age, not hang to the full
+    PADDLE_HC_TIMEOUT_S."""
+    monkeypatch.setenv("PADDLE_HC_HEARTBEAT_S", "0.2")
+    monkeypatch.setenv("PADDLE_HC_LIVENESS_S", "1.0")
+    # rank 1 never connects, so it is judged by the JOIN window (which
+    # defaults to minutes to tolerate cold starts) — shrink it
+    monkeypatch.setenv("PADDLE_HC_JOIN_S", "1.0")
+    monkeypatch.setenv("PADDLE_HC_TIMEOUT_S", "120")
+    from paddle_tpu.distributed.host_collectives import \
+        HostCollectiveGroup
+
+    g0 = HostCollectiveGroup(0, 2, "127.0.0.1:0")  # rank 1 never joins
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(RpcRemoteError) as ei:
+            g0.barrier()
+        dt = time.monotonic() - t0
+        assert dt < 30, "fast-fail took %.0fs (liveness window 1s)" % dt
+        assert "waiting on ranks {1}" in ei.value.remote_msg
+        assert "last heartbeat" in ei.value.remote_msg
+    finally:
+        g0.shutdown()
+
+
+def test_store_releases_blobs_after_each_collective(monkeypatch):
+    """Seed leaked every contributed blob for the life of the run:
+    _kv/_counts must drain once all ranks fetched (memory stays bounded
+    across per-step barriers/allreduces)."""
+    monkeypatch.setenv("PADDLE_HC_HEARTBEAT_S", "0.2")
+    from paddle_tpu.distributed.host_collectives import \
+        HostCollectiveGroup
+
+    g0 = HostCollectiveGroup(0, 2, "127.0.0.1:0")
+    ep = "127.0.0.1:%d" % g0._server.port
+    g1 = HostCollectiveGroup(1, 2, ep)
+    out = {}
+
+    def run(g, r):
+        for _ in range(5):
+            g.barrier()
+            out[(r, "sum")] = g.all_reduce(np.asarray([1.0 + r]))[0]
+            out[(r, "b")] = int(g.broadcast(np.asarray([9 + r]),
+                                            root=0)[0])
+
+    ts = [threading.Thread(target=run, args=(g, r))
+          for r, g in ((0, g0), (1, g1))]
+    try:
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in ts)
+        assert out[(0, "sum")] == out[(1, "sum")] == 3.0
+        assert out[(0, "b")] == out[(1, "b")] == 9
+        assert g0.store_stats() == (0, 0, 0), \
+            "store still holds blobs: kv/counts/fetched=%s" \
+            % (g0.store_stats(),)
+    finally:
+        g1.shutdown()
+        g0.shutdown()
+
+
+# -- end-to-end: collectives + PS train loop under injected drops -----------
+
+@pytest.mark.dist
+def test_two_rank_collectives_identical_under_injected_drops():
+    """Acceptance: with fault injection dropping the store connection
+    every N messages, a 2-rank host-collective run completes with
+    results identical to the no-fault run."""
+    script = textwrap.dedent("""
+        import sys, numpy as np
+        sys.path.insert(0, %r)
+        from paddle_tpu.distributed.host_collectives import \\
+            HostCollectiveGroup
+        rank = int(sys.argv[1])
+        g = HostCollectiveGroup(rank, 2, "127.0.0.1:" + sys.argv[2])
+        for i in range(6):
+            g.barrier()
+            s = g.all_reduce(np.asarray([1.0 + rank, float(i)]))
+            print("SUM", i, s.tolist(), flush=True)
+        g.barrier()
+        g.shutdown()
+    """ % _REPO)
+
+    def run(fault_spec):
+        port = str(_free_port())
+        extra = {"PADDLE_FAULTS": fault_spec} if fault_spec else {}
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", script, str(r), port],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=_env(extra)) for r in range(2)]
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            assert p.returncode == 0, out
+            outs.append(sorted(ln for ln in out.splitlines()
+                               if ln.startswith("SUM")))
+        return outs
+
+    clean = run(None)
+    faulty = run("drop:side=client,point=recv,method=hc_gather,every=4")
+    assert clean == faulty
+    assert len(clean[0]) == 6
+
+
+@pytest.mark.dist
+def test_ps_sync_train_loop_identical_under_injected_drops():
+    """Acceptance: a REAL sync PS train loop (fluid Executor +
+    transpiled programs, dist_ps_runner) with the trainer connection
+    dropped every N messages produces bit-identical losses to the
+    no-fault run — retried grad pushes are never double-applied."""
+    runner = os.path.join(_DIR, "dist_ps_runner.py")
+
+    def run(fault_spec):
+        eps = "127.0.0.1:%d" % _free_port()
+        extra = {"PADDLE_FAULTS": fault_spec} if fault_spec else {}
+        server = subprocess.Popen(
+            [sys.executable, runner, "pserver", eps, eps, "1", "sync"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=_env({}), cwd=_DIR)
+        trainer = subprocess.Popen(
+            [sys.executable, runner, "trainer", "0", eps, "1", "sync"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=_env(extra), cwd=_DIR)
+        try:
+            tout, _ = trainer.communicate(timeout=240)
+            assert trainer.returncode == 0, tout
+            sout, _ = server.communicate(timeout=60)
+            assert server.returncode == 0, sout
+        finally:
+            for p in (server, trainer):
+                if p.poll() is None:
+                    p.kill()
+        return [ln for ln in tout.splitlines() if ln.startswith("LOSS")]
+
+    clean = run(None)
+    faulty = run("drop:side=client,point=recv,every=5")
+    assert len(clean) == 5
+    assert clean == faulty

@@ -170,6 +170,7 @@ def test_fit_auto_checkpoint_resume(tmp_path):
     assert h2[-1]["loss"] < h1[0]["loss"]
 
 
+@pytest.mark.slow
 def test_fit_hapi_resnet18_zoo_model():
     """The new dygraph zoo ResNet trains under hapi.Model.fit
     (zoo + trainer composition, reference test_vision_models shape)."""

@@ -2,6 +2,7 @@
 (reference: incubate/hapi/vision + text test patterns: run a tiny batch
 through each model and check shapes/finite outputs)."""
 import numpy as np
+import pytest
 
 from paddle_tpu.fluid import dygraph
 from paddle_tpu.hapi.vision import models, transforms
@@ -104,6 +105,7 @@ def test_layer_setattr_none_then_sublayer_not_shadowed():
     assert m.short is None and "short" not in m._sub_layers
 
 
+@pytest.mark.slow
 def test_hapi_resnet_vgg_variants_forward_backward(rng):
     import numpy as np
 

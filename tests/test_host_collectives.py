@@ -51,6 +51,15 @@ def test_host_collectives_two_processes():
         b = g.broadcast(np.asarray([42 + rank]), root=0)
         print("BCAST", int(b[0]))
         g.barrier()
+        # leak regression: every collective's blobs must be released
+        # once both ranks fetched. rank1 signals its last fetch is done
+        # via a point-to-point key (hc_take pops it), THEN rank0 reads
+        # the store stats — deterministic, no sleep.
+        if rank == 1:
+            g.put("drained", np.ones((1,), np.int8))
+        else:
+            g.take("drained")
+            print("STATS", g.store_stats())
         g.shutdown()
     """ % (_REPO, port))
     procs = [subprocess.Popen([sys.executable, "-c", script, str(r)],
@@ -67,6 +76,8 @@ def test_host_collectives_two_processes():
         assert "SUM [3.0, 4.0]" in out, out
         assert "GATHER [0, 10]" in out, out
         assert "BCAST 42" in out, out
+    # rank0 printed the store stats after both ranks drained
+    assert "STATS (0, 0, 0)" in outs[0] + outs[1], outs
 
 
 def test_dataset_global_shuffle_two_processes(tmp_path):

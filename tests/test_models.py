@@ -25,6 +25,7 @@ def test_mnist_conv_trains(rng):
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_resnet18_trains(rng):
     loss, acc, _ = resnet.build_resnet_train(
         image_shape=(3, 32, 32), class_dim=10, depth=18, lr=0.05)
@@ -35,6 +36,7 @@ def test_resnet18_trains(rng):
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_resnet50_builds_and_steps(rng):
     loss, acc, _ = resnet.build_resnet_train(
         image_shape=(3, 64, 64), class_dim=10, depth=50, lr=0.01)
@@ -62,6 +64,7 @@ def _bert_batch(rng, cfg, bsz, seq, max_pred):
             "nsp_label": nsp}
 
 
+@pytest.mark.slow
 def test_bert_tiny_trains(rng):
     cfg = bert.BertConfig.tiny()
     total, mlm, nsp, feeds = bert.build_bert_pretrain(
@@ -71,6 +74,7 @@ def test_bert_tiny_trains(rng):
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_transformer_tiny_trains_and_decodes(rng):
     cfg = transformer.TransformerConfig.tiny()
     loss, feeds = transformer.build_transformer_train(

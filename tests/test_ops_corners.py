@@ -9,6 +9,7 @@ import jax.numpy as jnp
 from paddle_tpu.ops.registry import get_op
 
 
+@pytest.mark.slow
 def test_conv2d_transpose_groups_matches_torch():
     import torch
 

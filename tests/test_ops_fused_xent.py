@@ -2,6 +2,7 @@
 memory-fused large-vocab classifier head; see ops/fused_ops.py) and its
 integration in the BERT masked-LM head."""
 import numpy as np
+import pytest
 
 from op_test import OpTest
 
@@ -62,6 +63,7 @@ class TestFusedLinearSoftmaxXent(OpTest):
         self.outputs = {"Loss": ref}
         self.check_output()
 
+    @pytest.mark.slow
     def test_grad_multi_chunk(self):
         x, w, b, label = self._mk(n=4, h=3, v=11)
         self.inputs = {"X": x, "W": w, "Bias": b, "Label": label}

@@ -2,6 +2,7 @@
 RNN unit ops (dynamic_lstm/gru semantics), vision extras, and the small
 math/loss additions — OpTest pattern per SURVEY.md §4.1."""
 import numpy as np
+import pytest
 
 from op_test import OpTest
 
@@ -397,6 +398,7 @@ class TestPsRoiPool(OpTest):
                                    rtol=1e-4)
 
 
+@pytest.mark.slow
 class TestBilateralSlice(OpTest):
     def test(self):
         r = np.random.RandomState(17)

@@ -217,6 +217,7 @@ def _np_ctc_loss(logp, labels, blank):
     return -np.log(alpha[t - 1, s - 1] + alpha[t - 1, s - 2])
 
 
+@pytest.mark.slow
 class TestWarpCtc(OpTest):
     def test(self):
         r = np.random.RandomState(8)
@@ -252,6 +253,7 @@ def _np_crf_nll(em, trans_full, labels):
     return logz - score
 
 
+@pytest.mark.slow
 class TestLinearChainCrf(OpTest):
     def test(self):
         r = np.random.RandomState(9)
@@ -297,6 +299,7 @@ class TestCrfDecoding(OpTest):
             np.testing.assert_array_equal(path[i], np.array(best))
 
 
+@pytest.mark.slow
 class TestYolov3Loss(OpTest):
     def test(self):
         r = np.random.RandomState(11)

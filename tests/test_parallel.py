@@ -121,8 +121,11 @@ def test_collective_ops_in_shard_map():
                 return ops_lib.run_op(op, {"X": [v]},
                                       dict(attrs, ring_id=0))["Out"][0]
 
-        f = jax.jit(jax.shard_map(inner, mesh=mesh, in_specs=P("dp"),
-                                  out_specs=P("dp"), check_vma=False))
+        from paddle_tpu.parallel.env import shard_map_compat
+
+        f = jax.jit(shard_map_compat(inner, mesh=mesh, in_specs=P("dp"),
+                                     out_specs=P("dp"),
+                                     check_vma=False))
         return np.asarray(f(x))
 
     np.testing.assert_allclose(
@@ -148,8 +151,11 @@ def test_collective_ops_in_shard_map():
                 return ops_lib.run_op(op, {"X": [v]},
                                       dict(attrs, ring_id=0))["Out"][0]
 
-        f = jax.jit(jax.shard_map(inner, mesh=mesh, in_specs=P("dp"),
-                                  out_specs=P("dp"), check_vma=False))
+        from paddle_tpu.parallel.env import shard_map_compat
+
+        f = jax.jit(shard_map_compat(inner, mesh=mesh, in_specs=P("dp"),
+                                     out_specs=P("dp"),
+                                     check_vma=False))
         return np.asarray(f(x2))
 
     got = run2("c_reducescatter", nranks=8)
@@ -168,8 +174,11 @@ def test_collective_ops_in_shard_map():
                 return ops_lib.run_op("c_allreduce_prod", {"X": [s]},
                                       {"ring_id": 0})["Out"][0]
 
-        f = jax.jit(jax.shard_map(inner, mesh=mesh, in_specs=P("dp"),
-                                  out_specs=P("dp"), check_vma=False))
+        from paddle_tpu.parallel.env import shard_map_compat
+
+        f = jax.jit(shard_map_compat(inner, mesh=mesh, in_specs=P("dp"),
+                                     out_specs=P("dp"),
+                                     check_vma=False))
         return np.asarray(f(v))
 
     np.testing.assert_allclose(

@@ -13,6 +13,7 @@ import paddle_tpu.fluid as fluid
 from paddle_tpu.fluid import framework, profiler
 
 
+@pytest.mark.slow
 def test_chrome_trace_export(tmp_path):
     profiler.reset_profiler()
     with profiler.profiler(sorted_key="total",

@@ -3,6 +3,7 @@ path — lowering splits the forward at checkpoint vars and wraps each
 segment in jax.checkpoint (reference: backward.py:629 recompute
 segments + optimizer.py:4485 RecomputeOptimizer)."""
 import numpy as np
+import pytest
 
 import paddle_tpu.fluid as fluid
 from paddle_tpu.fluid import framework, lowering
@@ -121,6 +122,7 @@ def test_recompute_replays_forward_in_backward():
     assert counts[True] > counts[False], counts
 
 
+@pytest.mark.slow
 def test_bert_recompute_checkpoints_loss_parity():
     """The bench's big-batch path (bench.py: batch >= 384) wraps Adam
     in RecomputeOptimizer with per-encoder-layer checkpoints collected

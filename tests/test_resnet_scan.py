@@ -6,6 +6,8 @@ moves every stacked slice; BN stats update per row."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # two ~1min compiles; excluded from tier-1
+
 import paddle_tpu.fluid as fluid
 from paddle_tpu.fluid import framework
 from paddle_tpu.core.scope import global_scope

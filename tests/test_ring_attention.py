@@ -65,7 +65,9 @@ def test_composes_with_dp_axis():
     q, k, v = (_rand(rng, B, H, S, D) for _ in range(3))
     mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4), ("dp", "sp"))
     spec = P("dp", None, "sp", None)
-    fn = jax.shard_map(
+    from paddle_tpu.parallel.env import shard_map_compat
+
+    fn = shard_map_compat(
         functools.partial(ring_attention, axis_name="sp", causal=True),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)

@@ -124,6 +124,7 @@ def _stack_unrolled_into_scan(vals, cfg):
 
 
 @pytest.mark.parametrize("remat", [False, True])
+@pytest.mark.slow
 def test_scan_bert_forward_parity_with_unrolled(remat):
     """Same parameter values => identical loss (is_test kills dropout).
     Also proves remat does not change the math."""
@@ -162,6 +163,7 @@ def test_scan_bert_forward_parity_with_unrolled(remat):
     np.testing.assert_allclose(loss_s, loss_u, rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_scan_bert_train_decreases_and_per_layer_dropout_differs():
     cfg = bert.BertConfig.tiny()
     SEQ, B = 32, 4

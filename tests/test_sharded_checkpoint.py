@@ -76,6 +76,58 @@ def test_retention_prunes_old_steps():
     mgr.close()
 
 
+def test_restore_falls_back_past_corrupt_latest_step(tmp_path):
+    """A mid-save kill can leave a partial/truncated latest step dir:
+    default restore must validate it and fall back to the newest INTACT
+    step instead of dying (or training from scratch). An explicitly
+    requested step still raises."""
+    import glob
+    import os
+
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed import ShardedCheckpointManager
+
+    _, tree = _mesh_and_params()
+    d = str(tmp_path)
+    mgr = ShardedCheckpointManager(d, max_to_keep=3)
+    mgr.save(1, dict(tree, step=jnp.int32(1)))
+    mgr.save(2, dict(tree, step=jnp.int32(2)))
+    # simulate the truncation a kill mid-save leaves behind
+    step_dir = os.path.join(d, "2")
+    files = [p for p in glob.glob(os.path.join(step_dir, "**"),
+                                  recursive=True) if os.path.isfile(p)]
+    assert files, "expected orbax files under %s" % step_dir
+    for p in files:
+        open(p, "w").close()
+
+    restored = mgr.restore(template=tree)
+    assert int(restored["step"]) == 1
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.asarray(tree["w"]))
+    with pytest.raises(Exception):
+        mgr.restore(step=2, template=tree)  # explicit step: no fallback
+    mgr.close()
+
+
+def test_restore_raises_when_no_step_is_intact(tmp_path):
+    import glob
+    import os
+
+    from paddle_tpu.distributed import ShardedCheckpointManager
+
+    _, tree = _mesh_and_params()
+    d = str(tmp_path)
+    mgr = ShardedCheckpointManager(d)
+    mgr.save(1, tree)
+    for p in glob.glob(os.path.join(d, "1", "**"), recursive=True):
+        if os.path.isfile(p):
+            open(p, "w").close()
+    with pytest.raises(RuntimeError, match="no intact checkpoint"):
+        mgr.restore(template=tree)
+    mgr.close()
+
+
 def test_resume_training_continues_identically():
     """Save mid-run, keep training; reload and retrain from the
     checkpoint: the loss tails must match exactly."""

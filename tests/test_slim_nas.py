@@ -2,6 +2,7 @@
 (VERDICT r2 next #8; reference: slim/searcher/controller.py SAController
 + slim/nas/ LightNAS)."""
 import numpy as np
+import pytest
 
 import paddle_tpu.fluid as fluid
 from paddle_tpu.fluid import framework
@@ -74,6 +75,7 @@ def _train_reward(widths, steps=6):
     return -final - 1e-4 * flops
 
 
+@pytest.mark.slow
 def test_sanas_width_search_improves():
     space = _WidthSpace()
     nas = SANAS(space, lambda net, tokens: _train_reward(net),

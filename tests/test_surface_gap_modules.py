@@ -257,6 +257,7 @@ def test_dataset_folder_and_image_folder(tmp_path):
         datasets.DatasetFolder(str(empty))  # no class subfolders
 
 
+@pytest.mark.slow
 def test_communicator_lifecycle(tmp_path):
     """start/stop lifecycle semantics: stop() completes the instance
     (dead - the executor must never step it again), mode mismatch is

@@ -50,6 +50,7 @@ def _stacked_from_unrolled(vals, cfg):
     return out
 
 
+@pytest.mark.slow
 def test_transformer_scan_forward_parity():
     cfg = T.TransformerConfig.tiny()
     S, B = 12, 2
@@ -89,6 +90,7 @@ def test_scan_stack_init_scale_matches_unrolled():
     assert 0.5 < ratio < 2.0, ratio
 
 
+@pytest.mark.slow
 def test_transformer_scan_trains():
     cfg = T.TransformerConfig.tiny()
     S, B = 12, 4
