@@ -288,12 +288,19 @@ def _try_preload_export(exe, main_p, feed, fetch_names, model: str,
                 sorted(meta["fetch_names"]) != sorted(fetch_names):
             return False
         # donation is not carried by export: re-jit with the same
-        # donate_argnums so mutated state still aliases in place
-        jitted = jax.jit(exp.call, donate_argnums=(1,))
+        # donate_argnums the executor would use (mutated state aliases
+        # in place; feed buffers too when FLAGS_tpu_donate_feed_buffers)
+        from paddle_tpu.utils.flags import get_flag
+
+        donate = bool(get_flag("FLAGS_tpu_donate_buffers", True))
+        feed_donate = donate and bool(
+            get_flag("FLAGS_tpu_donate_feed_buffers", True))
+        jitted = jax.jit(exp.call, donate_argnums=lowering._donate_argnums(
+            donate, feed_donate))
         entry = lowering.LoweredFunction(
             jitted, meta["feed_names"], meta["state_in"],
             meta["state_out"], meta["state_mut"], meta["state_ro"],
-            meta["fetch_names"])
+            meta["fetch_names"], feed_donate=feed_donate)
         key = exe._cache_key(main_p, feed_arrays, list(fetch_names),
                              global_scope())
         exe._cache[key] = entry
@@ -789,11 +796,16 @@ def _bench_child(platform: str, batch: int, steps: int, warmup: int,
             np.asarray(out[0])
             _hb("warmup_done", t_start)
 
+            from paddle_tpu.fluid import profiler as _prof
+
+            _prof.reset_step_phases()
             t0 = time.perf_counter()
             for _ in range(steps):
                 out = exe.run(main_p, feed=feed, fetch_list=[total])
             np.asarray(out[0])  # block on the final step
             dt = time.perf_counter() - t0
+            phases = _prof.step_phase_summary()
+            print("BENCH " + _prof.step_phase_line(), flush=True)
 
     tokens_per_sec = batch * seq_len * steps / dt
     flops_per_sec = (_bert_flops_per_token(cfg, n_params, seq_len)
@@ -811,6 +823,9 @@ def _bench_child(platform: str, batch: int, steps: int, warmup: int,
         "batch": batch,
         "seq_len": seq_len,
         "loss": round(float(np.asarray(out[0]).reshape(-1)[0]), 4),
+        # host-side step-phase breakdown (fluid/profiler.py): how much
+        # of each step the host spent feeding / dispatching / blocked
+        "phases": phases,
     }
     if model != "longctx":
         # no V100 baseline exists for the seq-4096 config (a 32 GB V100
@@ -952,11 +967,16 @@ def _bench_resnet(batch: int, steps: int, warmup: int,
     for _ in range(max(warmup - 1, 0)):
         out = exe.run(main_p, feed=feed, fetch_list=[loss])
     np.asarray(out[0])
+    from paddle_tpu.fluid import profiler as _prof
+
+    _prof.reset_step_phases()
     t0 = time.perf_counter()
     for _ in range(steps):
         out = exe.run(main_p, feed=feed, fetch_list=[loss])
     np.asarray(out[0])
     dt = time.perf_counter() - t0
+    phases = _prof.step_phase_summary()
+    print("BENCH " + _prof.step_phase_line(), flush=True)
     imgs_per_sec = batch * steps / dt
     # ~4.1 GFLOPs fwd per 224x224 image, x3 for training
     result = {
@@ -968,6 +988,7 @@ def _bench_resnet(batch: int, steps: int, warmup: int,
         "compile_time_s": round(compile_time, 1),
         "batch": batch,
         "loss": round(float(np.asarray(out[0]).reshape(-1)[0]), 4),
+        "phases": phases,
     }
     if platform == "tpu":
         result["mfu_pct"] = round(

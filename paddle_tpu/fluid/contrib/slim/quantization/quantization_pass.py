@@ -23,9 +23,12 @@ _WEIGHT_SLOTS = {"conv2d": "Filter", "depthwise_conv2d": "Filter",
 _INPUT_SLOTS = {"conv2d": "Input", "depthwise_conv2d": "Input",
                 "mul": "X", "matmul": "X", "matmul_v2": "X"}
 # channel axis of the weight tensor (conv filters are [oc, ic, kh, kw];
-# mul/matmul weights are [in, out] — per-OUT-channel is axis 1).
-# Reference: QuantizationTransformPass's quant_axis handling
-# (`contrib/slim/quantization/quantization_pass.py:119`).
+# mul/matmul weights are [in, out] — per-OUT-channel is axis 1). This
+# goes beyond the reference, whose per-channel path covers only 4-D
+# conv filters (always dim 0, no quant_axis attr in this version):
+# per-out-channel quantization of mul/matmul weights is an extension.
+# Custom `quantizable_op_type` entries outside this table default to
+# axis 0 via `.get(op.type, 0)`.
 _W_QUANT_AXIS = {"conv2d": 0, "depthwise_conv2d": 0, "mul": 1,
                  "matmul": 1, "matmul_v2": 1}
 # ops whose output scale equals their input scale: OutScaleForInference
@@ -61,9 +64,14 @@ class QuantizationTransformPass:
         for op in list(block.ops):
             if op.type in self._ops and not op.attrs.get(self._skip) \
                     and not op.attrs.get("skip_quant"):
+                # custom quantizable_op_type outside the builtin five:
+                # default to the generic X (activation) / Y (weight)
+                # slots and per-channel axis 0
                 for slot, maker in (
-                        (_INPUT_SLOTS[op.type], self._quant_act),
-                        (_WEIGHT_SLOTS[op.type], self._quant_weight)):
+                        (_INPUT_SLOTS.get(op.type, "X"),
+                         self._quant_act),
+                        (_WEIGHT_SLOTS.get(op.type, "Y"),
+                         self._quant_weight)):
                     names = op.input_names.get(slot)
                     if not names:
                         continue
@@ -77,7 +85,8 @@ class QuantizationTransformPass:
                         if maker is self._quant_weight:
                             quantized_acts[key] = maker(
                                 block, startup, src, v, new_ops,
-                                quant_axis=_W_QUANT_AXIS[op.type])
+                                quant_axis=_W_QUANT_AXIS.get(
+                                    op.type, 0))
                         else:
                             quantized_acts[key] = maker(
                                 block, startup, src, v, new_ops)

@@ -284,6 +284,12 @@ def raw_op(op_type, ins_raw: Dict[str, list], attrs, out_slots,
 
 
 def wrap_raw(arr):
+    # an Executor LazyFetch handle stays device-resident: unwrap the
+    # raw jax Array rather than forcing a host materialization here
+    from ..executor import LazyFetch
+
+    if isinstance(arr, LazyFetch):
+        arr = arr.value
     return Tensor(arr, stop_gradient=True)
 
 
@@ -525,6 +531,12 @@ class no_grad:
 def to_variable(value, name=None, zero_copy=None):
     if isinstance(value, Tensor):
         return value
+    from ...reader.prefetcher import is_on_device
+
+    if is_on_device(value):
+        # already a device array (e.g. from reader.prefetch_to_device):
+        # wrap without the host round-trip np.asarray would force
+        return Tensor(value, name=name, stop_gradient=True)
     return Tensor(np.asarray(value), name=name,
                   stop_gradient=True)
 

@@ -216,6 +216,9 @@ class ConcreteProgram:
     def __init__(self, main, startup, feed_names, fetch_vars, template,
                  ctx, kw_feed_keys=()):
         self.main = main
+        # feeds here are the caller's eager Tensor buffers, re-fed every
+        # forward: never donate them (lowering._feed_donate opt-out)
+        main._feed_donate = False
         self.startup = startup
         self.feed_names = feed_names
         self.fetch_vars = fetch_vars

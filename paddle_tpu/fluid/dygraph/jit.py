@@ -118,6 +118,9 @@ class _LoadedLayer(Layer):
         self._exe = Executor()
         (self._program, self._feed_names,
          self._fetch_vars) = io.load_inference_model(model_path, self._exe)
+        # forward() re-feeds caller-owned eager tensor buffers: never
+        # donate them (lowering._feed_donate opt-out)
+        self._program._feed_donate = False
 
     def forward(self, *inputs):
         feed = {}

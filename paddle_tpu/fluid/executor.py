@@ -11,6 +11,7 @@ feed/fetch are the only host<->HBM transfers per step.
 """
 from __future__ import annotations
 
+import time as _time
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -18,6 +19,59 @@ import numpy as np
 from . import framework, lowering
 from ..core.scope import Scope, global_scope
 from ..core.types import to_numpy_dtype
+from ..reader.prefetcher import is_donatable, is_on_device, \
+    mark_donatable
+
+
+class LazyFetch:
+    """Device-resident fetch handle (`Executor.run(...,
+    return_numpy=False)`): the host does NOT block on the step that
+    produced it. Materialize explicitly with `.numpy()` (or implicitly
+    via `np.asarray` / `float`); `.value` is the raw device array;
+    `.block_until_ready()` waits without copying. Every host
+    materialization is accounted to the profiler's `sync` step phase,
+    so deferred-fetch loops show exactly when they blocked."""
+
+    __slots__ = ("_v",)
+
+    def __init__(self, v):
+        self._v = v
+
+    @property
+    def value(self):
+        return self._v
+
+    @property
+    def shape(self):
+        return tuple(self._v.shape)
+
+    @property
+    def dtype(self):
+        return self._v.dtype
+
+    def block_until_ready(self):
+        import jax
+
+        jax.block_until_ready(self._v)
+        return self
+
+    def numpy(self):
+        from . import profiler as _prof
+
+        t0 = _time.perf_counter()
+        out = Executor._fetch_to_numpy(self._v)
+        _prof.record_step_phase("sync", _time.perf_counter() - t0, t0)
+        return out
+
+    def __array__(self, dtype=None, copy=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self.numpy().reshape(-1)[0])
+
+    def __repr__(self):
+        return "LazyFetch(shape=%s, dtype=%s)" % (self.shape, self.dtype)
 
 
 class Executor:
@@ -35,6 +89,41 @@ class Executor:
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             feed_var_name="feed", fetch_var_name="fetch",
             return_numpy=True, use_program_cache=True):
+        """One step. Per-step wall time is split into the profiler's
+        step phases (feed / dispatch / sync / host, plus compile on a
+        cache miss) so infeed/compute overlap is measurable — see
+        fluid/profiler.py step_phase_summary."""
+        from . import profiler as _prof
+
+        t_step = _time.perf_counter()
+        ph = {"feed": 0.0, "dispatch": 0.0, "sync": 0.0, "compile": 0.0}
+        try:
+            return self._run_impl(program, feed, fetch_list, scope,
+                                  return_numpy, use_program_cache, ph)
+        finally:
+            total = _time.perf_counter() - t_step
+            if ph["dispatch"] > 0.0:
+                # a run that failed before dispatching is not a step:
+                # recording it would inflate the summary's per-step
+                # denominator and skew every average
+                for name in ("feed", "dispatch", "sync"):
+                    _prof.record_step_phase(name, ph[name])
+                if ph["compile"]:
+                    _prof.record_step_phase("compile", ph["compile"])
+                _prof.record_step_phase(
+                    "host", max(0.0, total - sum(ph.values())))
+
+    def _run_impl(self, program, feed, fetch_list, scope, return_numpy,
+                  use_program_cache, ph):
+        from . import profiler as _prof
+
+        def _mark(name, t0):
+            # accumulate into this step's phase AND emit the live
+            # chrome-trace span at its real start time
+            d = _time.perf_counter() - t0
+            ph[name] += d
+            _prof.record_step_trace(name, t0, d)
+
         program = program or framework.default_main_program()
         # CompiledProgram front (compiler.py) wraps a Program
         from . import compiler
@@ -103,7 +192,9 @@ class Executor:
             self._elastic_resume(program, ecfg, scope)
 
         block = program.global_block()
+        _t = _time.perf_counter()
         feed_arrays = self._prepare_feed(block, feed)
+        _mark("feed", _t)
         if ps_cfg is not None and ps_cfg.get("sparse_tables"):
             # distributed_lookup_table: fetch this batch's unique rows
             # into the @PREFETCH/@REMAP feeds before compiling/running
@@ -134,10 +225,11 @@ class Executor:
                 entry = self._cache[bkey]
                 self._cache.move_to_end(bkey)
                 feed_arrays = {
-                    n: (np.concatenate([a] * m, axis=0)
+                    n: (self._replicate_rows(a, m)
                         if n in rep_names else a)
                     for n, a in feed_arrays.items()}
         if entry is None:
+            _t = _time.perf_counter()
             state_in, _ = lowering.analyze_block(
                 block, list(feed_arrays), fetch_names)
             state_specs = {}
@@ -168,14 +260,19 @@ class Executor:
                             or 128)
                 while len(self._cache) > limit:
                     self._cache.popitem(last=False)
+            _mark("compile", _t)
 
         states_mut = {n: scope.find_var(n) for n in entry.state_mut_names}
         states_ro = {n: scope.find_var(n) for n in entry.state_ro_names}
         seed = framework._global_seed_and_bump(program)
+        _t = _time.perf_counter()
         feeds_dev = self._shard_feeds(entry, feed_arrays)
+        _mark("feed", _t)
+        _t = _time.perf_counter()
         fetches, new_states = entry.jitted(feeds_dev, states_mut,
                                            states_ro,
                                            np.uint32(seed % (2**31)))
+        _mark("dispatch", _t)
         for n, v in new_states.items():
             scope.set_var(n, v)
         if ecfg is not None:
@@ -196,31 +293,42 @@ class Executor:
         from ..utils.flags import get_flag
 
         if get_flag("FLAGS_check_nan_inf"):
+            _t = _time.perf_counter()
             self._check_nan_inf(fetch_names, fetches, new_states)
+            _mark("sync", _t)
         if get_flag("FLAGS_benchmark"):
             # per-step device sync (reference: operator.cc:997)
             import jax
 
+            _t = _time.perf_counter()
             jax.block_until_ready(fetches)
+            _mark("sync", _t)
 
         if ps_cfg is not None:
             comm = self._ps_communicator(program, ps_cfg, scope)
             if ps_cfg["mode"] in ("sync", "async", "half_async"):
+                # the communicator pushes THIS step's grads over RPC —
+                # a required host sync, kept on every step
+                _t = _time.perf_counter()
                 sparse_gvals = {
                     w: np.asarray(fetches[fetch_names.index(m["grad"])])
                     for w, m in ps_cfg.get("sparse_tables", {}).items()}
-                if sparse_gvals:
-                    comm.push_sparse(sparse_gvals)
                 gvals = {}
                 for g, p in ps_cfg["grad_of"].items():
                     gvals[p] = np.asarray(fetches[fetch_names.index(g)])
+                _mark("sync", _t)
+                if sparse_gvals:
+                    comm.push_sparse(sparse_gvals)
                 comm.step(gvals, scope)
             else:
                 comm.step({}, scope)
             fetches = fetches[:n_user_fetches]
         if return_numpy:
-            return [self._fetch_to_numpy(v) for v in fetches]
-        return list(fetches)
+            _t = _time.perf_counter()
+            out = [self._fetch_to_numpy(v) for v in fetches]
+            _mark("sync", _t)
+            return out
+        return [LazyFetch(v) for v in fetches]
 
     @staticmethod
     def _fetch_to_numpy(v):
@@ -285,16 +393,45 @@ class Executor:
 
     # -- helpers -----------------------------------------------------------
     def _prepare_feed(self, block, feed) -> Dict[str, np.ndarray]:
+        """Feed normalization. Fast path: values already on device
+        (jax Arrays, e.g. from reader.prefetch_to_device) pass through
+        without a host round-trip — dtype casts happen device-side."""
         out = {}
         for name, value in feed.items():
-            arr = np.asarray(value)
             v = block._find_var_recursive(name)
-            if v is not None:
-                want = to_numpy_dtype(v.dtype)
-                if arr.dtype != want:
-                    arr = arr.astype(want)
+            want = to_numpy_dtype(v.dtype) if v is not None else None
+            if is_on_device(value):
+                if want is not None:
+                    import jax
+
+                    # compare against the backend's canonical dtype:
+                    # with x64 disabled an int64 var holds int32 on
+                    # device, and casting back up would only warn
+                    want_dev = jax.dtypes.canonicalize_dtype(want)
+                    if value.dtype != want_dev:
+                        # astype allocates a fresh executor-owned array
+                        # — keep it donatable so the step can alias it
+                        value = value.astype(want_dev)
+                        mark_donatable(value)
+                out[name] = value
+                continue
+            arr = np.asarray(value)
+            if want is not None and arr.dtype != want:
+                arr = arr.astype(want)
             out[name] = arr
         return out
+
+    @staticmethod
+    def _replicate_rows(a, m):
+        """Batch-tail bucketing row replication; device arrays
+        replicate on device (no host round-trip)."""
+        if is_on_device(a):
+            import jax.numpy as jnp
+
+            out = jnp.concatenate([a] * m, axis=0)
+            mark_donatable(out)  # fresh executor-owned buffer
+            return out
+        return np.concatenate([a] * m, axis=0)
 
     # -- elastic training (strategy.elastic; reference reserves the knob
     # at distributed_strategy.proto:301 — here it is the preemption
@@ -348,10 +485,27 @@ class Executor:
         cp.save_async(ckpt.TrainStatus(epoch_no=0, step_no=step))
 
     def _shard_feeds(self, entry, feed_arrays):
+        """Issue (non-blocking) H2D transfers for host arrays; arrays
+        already on device pass straight through — the prefetcher put
+        them against the program's sharding, so the step consumes them
+        without re-putting. When the compiled step donates its feed
+        buffers (entry.feed_donate), on-device arrays NOT produced by
+        the prefetcher are defensively copied device-side first:
+        donation would otherwise invalidate a buffer the caller (e.g. a
+        dygraph tensor feeding a static subgraph) still holds."""
         import jax
 
+        def guard(a):
+            if entry.feed_donate and not is_donatable(a):
+                import jax.numpy as jnp
+
+                return jnp.copy(a)
+            return a
+
         if entry.mesh is None:
-            return {n: jax.numpy.asarray(a) for n, a in feed_arrays.items()}
+            return {n: (guard(a) if is_on_device(a)
+                        else jax.numpy.asarray(a))
+                    for n, a in feed_arrays.items()}
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         plan = getattr(entry, "auto_plan", None)
@@ -359,7 +513,13 @@ class Executor:
         for n, a in feed_arrays.items():
             spec = plan.feed_specs.get(n, P()) if plan is not None \
                 else P(entry.dp_axis)
-            out[n] = jax.device_put(a, NamedSharding(entry.mesh, spec))
+            target = NamedSharding(entry.mesh, spec)
+            if is_on_device(a):
+                if getattr(a, "sharding", None) == target:
+                    out[n] = guard(a)
+                    continue
+                a = guard(a)  # reshard below may alias the input
+            out[n] = jax.device_put(a, target)
         return out
 
     def _find_tail_bucket(self, program, feed_arrays, fetch_names, scope):
@@ -479,6 +639,108 @@ class Executor:
         # alias a stale compiled executable
         return (program._uid, program._version, feed_key,
                 tuple(fetch_names), getattr(scope, "_uid", 0))
+
+    def feed_sharding(self, program=None):
+        """The sharding this program's compiled step expects for its
+        feeds — hand it to `reader.prefetch_to_device` so prefetched
+        batches land pre-sharded on the right devices. Returns None for
+        single-device programs, one NamedSharding for data-parallel
+        programs (batch axis over the mesh), or a name->sharding dict
+        when an auto-parallel plan exists."""
+        from . import compiler
+
+        program = program or framework.default_main_program()
+        if isinstance(program, compiler.CompiledProgram):
+            program = program._unwrap()
+        plan = getattr(program, "_auto_plan", None)
+        if plan is not None:
+            from jax.sharding import NamedSharding
+
+            return {n: NamedSharding(plan.mesh, s)
+                    for n, s in plan.feed_specs.items()}
+        mesh = getattr(program, "_mesh", None)
+        dp_axis = getattr(program, "_dp_axis", "dp")
+        if mesh is None and getattr(program, "_data_parallel", False):
+            mesh = lowering._default_mesh(dp_axis)
+            program._mesh = mesh
+        if mesh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(mesh, P(dp_axis))
+
+    def donation_report(self, program=None, feed=None, fetch_list=None,
+                        scope=None):
+        """Donation audit via compiled-memory analysis of the EXECUTOR
+        path's cached executable (run the program once first so the
+        entry exists): verifies FLAGS_tpu_donate_buffers actually
+        aliases params/opt-state — and, with
+        FLAGS_tpu_donate_feed_buffers, how many feed bytes alias too.
+        Returns {mut_bytes, feed_bytes, alias_bytes, aliases_state,
+        feed_donate} or None when the entry isn't jit-lowered (eager
+        fallback / unknown program)."""
+        import jax
+
+        program = program or framework.default_main_program()
+        from . import compiler
+
+        if isinstance(program, compiler.CompiledProgram):
+            program = program._unwrap()
+        scope = scope or global_scope()
+        fetch_names = [
+            f.name if isinstance(f, framework.Variable) else str(f)
+            for f in (fetch_list or [])]
+        feed_arrays = self._prepare_feed(program.global_block(),
+                                         feed or {})
+        key = self._cache_key(program, feed_arrays, fetch_names, scope)
+        entry = self._cache.get(key)
+        if entry is None:
+            # dtype-canonicalization can make a host-numpy feed key
+            # miss an entry compiled from prefetched device feeds
+            # (int64 -> int32 with x64 off): fall back to any cached
+            # entry of this program with the same feed names + shapes
+            want_shapes = {n: tuple(a.shape)
+                           for n, a in feed_arrays.items()}
+            for k in reversed(self._cache):
+                if k[:2] == key[:2] and k[3:] == key[3:] and \
+                        {n: tuple(s) for n, s, _ in k[2]} == want_shapes:
+                    key, entry = k, self._cache[k]
+                    break
+        if entry is None or not hasattr(entry.jitted, "lower"):
+            return None
+
+        def aval(v):
+            if hasattr(v, "shape") and hasattr(v, "dtype"):
+                return jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
+            a = np.asarray(v)
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        # feed avals from the CACHED key (the dtypes that executable
+        # was actually compiled for), not from this call's arrays
+        favals = {n: jax.ShapeDtypeStruct(tuple(s), np.dtype(dt))
+                  for n, s, dt in key[2]}
+        smut = {n: aval(scope.find_var(n))
+                for n in entry.state_mut_names}
+        sro = {n: aval(scope.find_var(n)) for n in entry.state_ro_names}
+        comp = entry.jitted.lower(
+            favals, smut, sro,
+            jax.ShapeDtypeStruct((), np.uint32)).compile()
+        ma = comp.memory_analysis()
+
+        def nbytes(avals):
+            return sum(int(np.prod(v.shape or (1,))) *
+                       np.dtype(v.dtype).itemsize for v in avals.values())
+
+        mut_bytes = nbytes(smut)
+        feed_bytes = nbytes(favals)
+        alias_bytes = int(getattr(ma, "alias_size_in_bytes", 0))
+        return {
+            "mut_bytes": mut_bytes,
+            "feed_bytes": feed_bytes,
+            "alias_bytes": alias_bytes,
+            "aliases_state": alias_bytes >= mut_bytes,
+            "feed_donate": bool(entry.feed_donate),
+        }
 
     def close(self):
         for comm in getattr(self, "_ps_comms", {}).values():

@@ -47,16 +47,19 @@ _SKIP_OPS = frozenset({
 class LoweredFunction:
     """A compiled block: callable (feeds, states_mut, states_ro, seed) ->
     (fetches, states'). states_mut (rebound by the block: params, moments,
-    running stats) are donated so XLA updates them in place on HBM."""
+    running stats) are donated so XLA updates them in place on HBM;
+    feed_donate records whether the feed argument is donated too
+    (FLAGS_tpu_donate_feed_buffers) — the executor then guards
+    caller-owned device arrays before the call."""
 
     __slots__ = ("jitted", "state_in_names", "state_out_names",
                  "state_mut_names", "state_ro_names",
                  "fetch_names", "feed_names", "mesh", "dp_axis",
-                 "auto_plan")
+                 "auto_plan", "feed_donate")
 
     def __init__(self, jitted, feed_names, state_in_names, state_out_names,
                  state_mut_names, state_ro_names, fetch_names, mesh=None,
-                 dp_axis=None, auto_plan=None):
+                 dp_axis=None, auto_plan=None, feed_donate=False):
         self.jitted = jitted
         self.feed_names = feed_names
         self.state_in_names = state_in_names
@@ -67,6 +70,7 @@ class LoweredFunction:
         self.mesh = mesh
         self.dp_axis = dp_axis
         self.auto_plan = auto_plan
+        self.feed_donate = feed_donate
 
 
 def _sub_block_idxs(op):
@@ -759,6 +763,19 @@ def compile_block(program, block, feed_specs, fetch_names, state_specs,
         from ..utils.flags import get_flag
 
         donate = bool(get_flag("FLAGS_tpu_donate_buffers", True))
+    from ..utils.flags import get_flag as _gf
+
+    # feed-buffer donation: the executor device_puts a FRESH buffer per
+    # step (or consumes a single-use prefetched one), so XLA may reuse
+    # feed HBM for scratch/outputs instead of holding both live.
+    # Programs whose feeds are ALWAYS caller-owned device arrays
+    # (dygraph-to-static subgraphs, jit.load) set _feed_donate=False:
+    # donation would buy nothing there (the caller's buffer stays live)
+    # while the executor's defensive copy would cost one device copy
+    # per feed per step
+    feed_donate = donate and \
+        bool(_gf("FLAGS_tpu_donate_feed_buffers", True)) and \
+        getattr(program, "_feed_donate", True)
 
     ap_cfg = getattr(program, "_auto_parallel", None)
     if ap_cfg is not None:
@@ -794,7 +811,7 @@ def compile_block(program, block, feed_specs, fetch_names, state_specs,
     if mesh is not None and getattr(program, "_data_parallel", False):
         jitted = _compile_dp(fn, mesh, dp_axis, program, block,
                              feed_names, fetch_names, state_mut, state_ro,
-                             donate)
+                             donate, feed_donate)
     else:
         host, dynamic = _block_host_op_kinds(block)
         if dynamic:
@@ -803,11 +820,14 @@ def compile_block(program, block, feed_specs, fetch_names, state_specs,
             # at runtime). The whole block runs unjitted, matching the
             # reference's CPU placement of these kernels.
             jitted = fn
+            feed_donate = False
         else:
             # donation is unsafe when an eager retry may rerun with the
             # same buffers after a failed jitted call
+            feed_donate = feed_donate and not host
             jitted = jax.jit(
-                fn, donate_argnums=(1,) if (donate and not host) else ())
+                fn, donate_argnums=_donate_argnums(
+                    donate and not host, feed_donate))
             if host:
                 # no_jit ops lower to pure_callback under jit; backends
                 # without host-callback support (axon PJRT) get the
@@ -816,7 +836,7 @@ def compile_block(program, block, feed_specs, fetch_names, state_specs,
 
     return LoweredFunction(jitted, feed_names, state_in, state_out,
                            state_mut, state_ro, fetch_names, mesh=mesh,
-                           dp_axis=dp_axis)
+                           dp_axis=dp_axis, feed_donate=feed_donate)
 
 
 def _block_host_op_kinds(block):
@@ -873,6 +893,30 @@ def _jit_with_eager_fallback(jitted, fn):
     return call
 
 
+# Donated feed buffers that cannot alias an output are simply freed
+# after use by XLA — expected, not a bug — but jax warns "Some donated
+# buffers were not usable" for them. Filter at MODULE IMPORT, exactly
+# once per process: installing lazily at first compile put the filter
+# inside whatever warnings.catch_warnings scope happened to be active
+# (pytest wraps every test in one), where it silently evaporated. The
+# filter also mutes that warning for state donation; the repo does not
+# rely on it to catch aliasing regressions — `Executor.donation_report`
+# and tests/test_donation.py assert the aliased byte count directly.
+import warnings as _warnings
+
+_warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable")
+
+
+def _donate_argnums(state_donate, feed_donate):
+    """jit donate_argnums for (feeds, states_mut, states_ro, seed)."""
+    if feed_donate and state_donate:
+        return (0, 1)
+    if state_donate:
+        return (1,)
+    return ()
+
+
 def _default_mesh(dp_axis):
     import jax
     from jax.sharding import Mesh
@@ -882,7 +926,7 @@ def _default_mesh(dp_axis):
 
 
 def _compile_dp(fn, mesh, dp_axis, program, block, feed_names, fetch_names,
-                state_mut, state_ro, donate):
+                state_mut, state_ro, donate, feed_donate=False):
     """Data-parallel lowering: shard_map over the mesh; feeds sharded on
     axis 0, state replicated. Collective ops inside see the live axis and
     emit psum over ICI (reference flow: transpiler/collective.py:178-268 +
@@ -919,4 +963,5 @@ def _compile_dp(fn, mesh, dp_axis, program, block, feed_names, fetch_names,
         in_specs=(feed_specs, state_specs_mut, state_specs_ro, P()),
         out_specs=(fetch_specs, P()),
         check_vma=False)
-    return jax.jit(smapped, donate_argnums=(1,) if donate else ())
+    return jax.jit(smapped,
+                   donate_argnums=_donate_argnums(donate, feed_donate))

@@ -19,6 +19,89 @@ _host_events = defaultdict(lambda: [0, 0.0, 0.0])  # name -> [count, total_s, ma
 _trace_events = []
 _trace_enabled = False
 
+# -- step-phase counters (async pipeline observability) ---------------------
+# Every Executor.run splits its wall time into four phases:
+#   feed     — host-side feed prep + H2D issue (zero-ish when batches
+#              arrive pre-transferred from reader/prefetcher.py)
+#   dispatch — handing the jitted step to the runtime (async: returns
+#              while the device still computes)
+#   sync     — host blocked on device results (FLAGS_benchmark's
+#              per-step block, return_numpy materialization, deferred
+#              LazyFetch/hapi log-step syncs)
+#   host     — everything else on the host between steps (cache lookup,
+#              python overhead, PS bookkeeping)
+# In a well-overlapped pipeline feed+sync+host ≈ 0 at steady state and
+# dispatch-to-dispatch time ≈ device compute time.
+STEP_PHASES = ("feed", "dispatch", "sync", "host")
+_step_phases = defaultdict(lambda: [0, 0.0, 0.0])  # -> [count, total_s, max_s]
+
+
+def record_step_phase(name, dt, t0=None):
+    """Accumulate `dt` seconds into step-phase counter `name`; also
+    emits a chrome-trace event ("phase/<name>") when tracing is live."""
+    ev = _step_phases[name]
+    ev[0] += 1
+    ev[1] += dt
+    ev[2] = max(ev[2], dt)
+    record_step_trace(name, t0, dt)
+
+
+def record_step_trace(name, t0, dt):
+    """Trace-only phase event (no counter): the executor calls this at
+    each timed segment with the segment's real start time, so a live
+    trace shows phase/<name> spans where they actually happened; the
+    per-step counter aggregation rides separately in run()'s finally."""
+    if _trace_enabled and t0 is not None:
+        import threading
+
+        _trace_events.append(("phase/" + name, t0 * 1e6, dt * 1e6,
+                              threading.get_ident() % 100000))
+
+
+def reset_step_phases():
+    _step_phases.clear()
+
+
+def step_phase_summary(reset=False):
+    """Per-step timing breakdown: {"steps": N, "feed_ms": avg, ...,
+    "total_ms": sum of avgs}. `steps` = number of dispatches; phase
+    averages are totals over that denominator, so rarely-firing phases
+    (a deferred sync every log_freq steps) amortize correctly."""
+    steps = _step_phases["dispatch"][0] if "dispatch" in _step_phases \
+        else 0
+    denom = max(steps, 1)
+    out = {"steps": steps}
+    total = 0.0
+    for name in STEP_PHASES:
+        avg_ms = _step_phases[name][1] * 1e3 / denom \
+            if name in _step_phases else 0.0
+        out[name + "_ms"] = round(avg_ms, 3)
+        total += avg_ms
+    out["total_ms"] = round(total, 3)
+    if "compile" in _step_phases:
+        # cache-miss compiles ride outside the steady-state total so
+        # they never pollute host_ms, but the summary still shows them
+        out["compile_ms"] = round(
+            _step_phases["compile"][1] * 1e3 / denom, 3)
+    if reset:
+        reset_step_phases()
+    return out
+
+
+def step_phase_line():
+    """ONE human-readable summary line (bench.py prints it)."""
+    s = step_phase_summary()
+    return ("step phases: %d steps, feed %.2fms dispatch %.2fms "
+            "sync %.2fms host %.2fms (host total %.2fms/step)"
+            % (s["steps"], s["feed_ms"], s["dispatch_ms"], s["sync_ms"],
+               s["host_ms"], s["total_ms"]))
+
+
+def event_count(name):
+    """Host-event fire count (RecordEvent name) — lets tests assert sync
+    cadence (e.g. hapi's deferred-fetch 'hapi/loss_sync')."""
+    return _host_events[name][0] if name in _host_events else 0
+
 
 _native_broken = False
 
@@ -136,6 +219,7 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
 
 def reset_profiler():
     _host_events.clear()
+    _step_phases.clear()
     del _trace_events[:]
     nt = _native_trace()
     if nt is not None:

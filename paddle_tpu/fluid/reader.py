@@ -7,6 +7,12 @@ prefetches batches through the C++ native blocking channel
 (paddle_tpu.core.native.NativeChannel — the analogue of the reference's
 lod_tensor_blocking_queue) on a background thread, and map-style loading
 fans out to multiprocess workers like the reference's _DataLoaderIter.
+With `use_double_buffer` and an accelerator place, the double buffer now
+extends past the host channel into HBM: a second stage
+(reader/prefetcher.py) issues non-blocking `jax.device_put`s
+`FLAGS_tpu_prefetch_depth` batches ahead, so the consuming step finds
+its feeds already on device (reference analogue:
+`operators/reader/buffered_reader.cc`'s device-side copy stream).
 """
 from __future__ import annotations
 
@@ -113,9 +119,21 @@ class _GeneratorLoader(DataLoaderBase):
         return self
 
     # -- iteration ---------------------------------------------------------
-    def __iter__(self):
-        if self._batch_reader is None:
-            raise RuntimeError("DataLoader: no generator set")
+    def _device_buffered(self):
+        """True when the host double buffer should extend to HBM: the
+        loader targets an accelerator place (host numpy stays the
+        contract for CPU places — dygraph consumers expect it)."""
+        if not self._use_double_buffer:
+            return False
+        places = self._places
+        if places is None:
+            return False
+        from ..core.place import CUDAPlace, TPUPlace
+
+        seq = places if isinstance(places, (list, tuple)) else [places]
+        return any(isinstance(p, (TPUPlace, CUDAPlace)) for p in seq)
+
+    def _host_iter(self):
         q = _PrefetchQueue(self._capacity)
 
         def produce():
@@ -141,6 +159,20 @@ class _GeneratorLoader(DataLoaderBase):
                 yield dict(zip(feed_names, item))
             else:
                 yield item
+
+    def __iter__(self):
+        if self._batch_reader is None:
+            raise RuntimeError("DataLoader: no generator set")
+        if not self._device_buffered():
+            yield from self._host_iter()
+            return
+        from ..reader.prefetcher import prefetch_to_device
+
+        pf = prefetch_to_device(self._host_iter())
+        try:
+            yield from pf
+        finally:
+            pf.close()  # early break drains in-flight device buffers
 
     def start(self):
         pass

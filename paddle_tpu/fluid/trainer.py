@@ -6,23 +6,22 @@
 TPU-native: the per-thread Hogwild op loop is replaced by ONE compiled
 train step; throughput comes from overlap, not host threads racing on a
 shared scope:
-- a feeder thread parses/prepares batches into a bounded queue while the
-  device computes (the reference's DataFeed channel);
+- the device prefetcher (reader/prefetcher.py) parses/prepares batches
+  AND issues their non-blocking H2D transfers on a background thread
+  while the device computes, `FLAGS_tpu_prefetch_depth` batches deep —
+  batch N+1 is already in HBM (sharded against the program's mesh for
+  data-parallel programs) when step N retires, so `Executor.run`'s
+  on-device fast path never re-puts it (the reference's
+  double-buffered reader, extended past the host channel);
 - steps run with device-resident results (no per-step host sync) — jax's
-  async dispatch queues step N+1's transfer while step N executes, so
-  feeding, H2D copy and compute pipeline like the reference's
-  double-buffered reader. Fetched values materialize on host only every
-  `print_period` steps and at the end.
+  async dispatch keeps the queue full. Fetched values materialize on
+  host only every `print_period` steps and at the end.
 """
 from __future__ import annotations
 
-import queue
 import sys
-import threading
 
 import numpy as np
-
-_SENTINEL = object()
 
 
 def train_from_dataset(executor, program, dataset, scope=None,
@@ -54,49 +53,28 @@ def train_from_dataset(executor, program, dataset, scope=None,
             checkpoint_dir, program, checkpoint_num=checkpoint_num,
             scope=scope)
 
-    q: "queue.Queue" = queue.Queue(maxsize=max(int(queue_size), 1))
-    feeder_err = []
-    stop = threading.Event()
+    from ..reader.prefetcher import prefetch_to_device
 
-    def _feeder():
-        try:
-            for feed in dataset._iter_batches():
-                while not stop.is_set():
-                    try:
-                        q.put(feed, timeout=0.2)
-                        break
-                    except queue.Full:
-                        continue
-                if stop.is_set():
-                    return
-        except BaseException as e:  # noqa: BLE001 - surface in main thread
-            feeder_err.append(e)
-        finally:
-            # the sentinel must not be dropped on a full queue (the
-            # consumer would hang at end-of-dataset); retry like the
-            # data puts, bailing only when the consumer said stop
-            while True:
-                try:
-                    q.put(_SENTINEL, timeout=0.2)
-                    break
-                except queue.Full:
-                    if stop.is_set():
-                        break
+    # the prefetcher replaces the old host-only feeder queue: same
+    # bounded-depth background thread, but batches leave it already ON
+    # DEVICE (sharded for data-parallel programs), so the H2D DMA for
+    # batch N+1 rides under step N's compute. Already-trained steps of
+    # a resumed run are skipped HOST-side, before the prefetcher —
+    # paying an H2D transfer per discarded batch would be pure waste
+    import itertools
 
-    t = threading.Thread(target=_feeder, daemon=True,
-                         name="paddle_tpu-data-feeder")
-    t.start()
+    batches = dataset._iter_batches()
+    if start_step:
+        batches = itertools.islice(batches, start_step, None)
+    depth = max(int(queue_size), 1)
+    pf = prefetch_to_device(batches, size=depth,
+                            sharding=executor.feed_sharding(program))
 
-    it = 0
+    it = start_step
     results = None
     try:
-        while True:
-            feed = q.get()
-            if feed is _SENTINEL:
-                break
+        for feed in pf:
             it += 1
-            if it <= start_step:
-                continue  # already-trained steps of a resumed run
             # return_numpy=False keeps results device-resident: no host
             # sync per step, so the feeder and the next H2D overlap this
             # compute
@@ -112,14 +90,9 @@ def train_from_dataset(executor, program, dataset, scope=None,
                 ckpt.save_async(ckpt_mod.TrainStatus(epoch_no=0,
                                                      step_no=it))
     finally:
-        # signal the feeder to stop (don't drain the whole dataset just
-        # to surface a step error) and unblock any pending put
-        stop.set()
-        try:
-            q.get_nowait()
-        except queue.Empty:
-            pass
-        t.join(timeout=5.0)
+        # stop the producer + drain in-flight device buffers (don't run
+        # the whole dataset just to surface a step error)
+        pf.close()
         if ckpt is not None:
             # only publish a final checkpoint when NEW steps ran: a
             # resumed run over a shorter dataset must not regress the
@@ -136,8 +109,6 @@ def train_from_dataset(executor, program, dataset, scope=None,
             except Exception:  # noqa: BLE001
                 if not step_error_in_flight:
                     raise
-    if feeder_err:
-        raise feeder_err[0]
     if results is not None:
         return [np.asarray(v) for v in results]
     return None

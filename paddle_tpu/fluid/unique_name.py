@@ -21,9 +21,15 @@ def generate(key: str) -> str:
 
 
 def generate_with_ignorable_key(key: str) -> str:
-    """Names for intermediate vars the user never addresses (the
-    reference tags them with a special prefix so save/load skips them;
-    the tag is preserved for that contract)."""
+    """Names for intermediate vars the user never addresses.
+
+    Intentional deviation from the reference: this version's static
+    path returns `generator(key)` with NO prefix (reference
+    unique_name.py:126); here the `_generated_var_` tag (the
+    reference's DYGRAPH-side convention) is applied unconditionally so
+    save/load and debug dumps can always recognize ignorable vars.
+    Generated names therefore differ from reference static programs —
+    tests/test_fluid_compat_surface.py pins the prefixed behavior."""
     return framework.unique_name("_generated_var_" + key)
 
 

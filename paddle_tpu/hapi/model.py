@@ -57,6 +57,69 @@ def _as_variables(arrays):
     return out
 
 
+class _DeferredLogs(dict):
+    """Per-step logs for the deferred-fetch fit loop. Reading any
+    metric key ("loss", metric names — anything but "step") forces the
+    pending device->host sync first, so a callback that consumes
+    per-step losses in on_train_batch_end sees fresh, correct values
+    (it simply pays the sync it asked for). The default ProgBarLogger
+    reads logs only every log_freq steps — exactly where fit flushes
+    anyway — so the deferred path keeps its ceil(steps/log_freq) sync
+    bound. (fit additionally disables deferral outright when
+    user-supplied callbacks are present, since C-level reads like
+    dict(logs) bypass these overrides.)"""
+
+    def __init__(self, model, pending):
+        super().__init__()
+        self._model = model
+        self._pending = pending  # SHARED list with the fit loop
+
+    def _flush(self):
+        if self._pending:
+            losses = self._model._sync_losses(self._pending)
+            del self._pending[:]
+            super().update(self._model._merge_logs(losses))
+
+    def __getitem__(self, k):
+        if k != "step":
+            self._flush()
+        return super().__getitem__(k)
+
+    def __contains__(self, k):
+        if k != "step":
+            self._flush()
+        return super().__contains__(k)
+
+    def get(self, k, default=None):
+        if k != "step":
+            self._flush()
+        return super().get(k, default)
+
+    def items(self):
+        self._flush()
+        return super().items()
+
+    def values(self):
+        self._flush()
+        return super().values()
+
+    def keys(self):
+        self._flush()
+        return super().keys()
+
+    def __iter__(self):
+        self._flush()
+        return super().__iter__()
+
+    def __len__(self):
+        self._flush()
+        return super().__len__()
+
+    def copy(self):
+        self._flush()
+        return dict(super().items())
+
+
 class Model:
     """Wraps a dygraph `Layer` network with train/eval/predict loops."""
 
@@ -117,18 +180,57 @@ class Model:
             total = total + x
         return total
 
-    def train_batch(self, inputs, labels=None):
+    def _train_batch_device(self, inputs, labels=None):
+        """One train step with everything left device-resident: returns
+        (loss_tensor, outputs, labels) without a host sync, so the
+        dispatch queue never drains between logged steps (fit defers the
+        materialization to every log_freq steps). Wraps the dygraph
+        data-parallel idiom when the network is a DataParallel layer
+        (scale_loss -> backward -> apply_collective_grads)."""
         assert self._optimizer is not None, "call prepare() first"
+        from ..fluid.dygraph.parallel import DataParallel
+
         with self._dygraph_guard():
             self.network.train()
             inputs = _as_variables(_to_list(inputs))
             labels = _as_variables(_to_list(labels))
             outputs = _to_list(self.network(*inputs))
             loss = self._compute_loss(outputs, labels)
-            loss.backward()
+            if isinstance(self.network, DataParallel):
+                self.network.scale_loss(loss).backward()
+                self.network.apply_collective_grads()
+            else:
+                loss.backward()
             self._optimizer.minimize(
                 loss, parameter_list=self.network.parameters())
             self.network.clear_gradients()
+        return loss, outputs, labels
+
+    def _sync_losses(self, pending):
+        """Materialize a buffer of deferred (loss, outputs, labels)
+        triples: ONE host sync point (profiler event 'hapi/loss_sync' +
+        sync step phase), metric updates in step order. Returns the last
+        step's loss value list."""
+        from ..fluid import profiler
+
+        losses = None
+        with profiler.RecordEvent("hapi/loss_sync"):
+            import time as _time
+
+            t0 = _time.perf_counter()
+            for loss, outputs, labels in pending:
+                if outputs is not None:
+                    for m in self._metrics:
+                        m.update(*_to_list(
+                            m.compute(outputs[0], *labels)))
+                losses = [float(np.asarray(
+                    loss.numpy()).reshape(-1)[0])]
+            profiler.record_step_phase(
+                "sync", _time.perf_counter() - t0, t0)
+        return losses
+
+    def train_batch(self, inputs, labels=None):
+        loss, outputs, labels = self._train_batch_device(inputs, labels)
         metrics = []
         for m in self._metrics:
             res = m.update(*_to_list(m.compute(outputs[0], *labels)))
@@ -203,6 +305,25 @@ class Model:
                 self.load(os.path.join(latest, "model"))
                 start_epoch = ckpt_mod.read_status(latest).next()
 
+        from ..utils.flags import get_flag
+
+        # deferred fetches: keep per-step losses/metric inputs on device
+        # and sync to host only every log_freq steps (+ epoch end), so
+        # between logged steps the host never blocks the dispatch queue.
+        # The computation is identical — only WHEN the host blocks moves
+        # — so losses match the synchronous path bit for bit. Deferral
+        # engages only when every callback is a known built-in (they
+        # read logs at log_freq cadence); user callbacks may read logs
+        # every step through paths _DeferredLogs cannot intercept
+        # (dict(logs), json), so they get the synchronous contract.
+        from .callbacks import (
+            EarlyStopping, ModelCheckpoint, ProgBarLogger,
+        )
+
+        defer = bool(get_flag("FLAGS_tpu_deferred_fetch", True)) and \
+            all(isinstance(c, (ProgBarLogger, ModelCheckpoint,
+                               EarlyStopping))
+                for c in getattr(cbks, "callbacks", []))
         self.stop_training = False
         cbks.on_train_begin({})
         history = []
@@ -210,14 +331,29 @@ class Model:
             cbks.on_epoch_begin(epoch, {})
             for m in self._metrics:
                 m.reset()
-            logs = {}
+            pending = []
+            logs = _DeferredLogs(self, pending) if defer else {}
             for step, batch in enumerate(loader):
                 cbks.on_train_batch_begin(step, {})
                 inputs, labels = self._split_batch(batch)
-                losses, _ = self.train_batch(inputs, labels)
-                logs = self._merge_logs(losses)
+                if defer:
+                    loss, outs, lbls = self._train_batch_device(
+                        inputs, labels)
+                    if not self._metrics:
+                        # no metric consumers: keep only the scalar
+                        # loss handle — buffering outputs/labels for
+                        # log_freq steps would pin HBM for nothing
+                        outs = lbls = None
+                    pending.append((loss, outs, lbls))
+                    if (step + 1) % max(log_freq, 1) == 0:
+                        logs._flush()
+                else:
+                    losses, _ = self.train_batch(inputs, labels)
+                    logs = self._merge_logs(losses)
                 logs["step"] = step
                 cbks.on_train_batch_end(step, logs)
+            if defer:
+                logs._flush()  # epoch tail shorter than log_freq
             cbks.on_epoch_end(epoch, logs)
             history.append(dict(logs))
 

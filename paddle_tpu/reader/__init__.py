@@ -8,9 +8,14 @@ import random as _random
 from queue import Queue
 from threading import Thread
 
+from .prefetcher import (  # noqa: F401
+    DevicePrefetcher, is_on_device, prefetch_to_device,
+)
+
 __all__ = [
     "cache", "map_readers", "buffered", "compose", "chain", "shuffle",
     "firstn", "xmap_readers", "multiprocess_reader",
+    "prefetch_to_device", "DevicePrefetcher", "is_on_device",
 ]
 
 

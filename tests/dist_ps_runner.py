@@ -130,11 +130,26 @@ def run_trainer(tid, eplist, n_trainers, mode):
     half = BATCH // n_trainers
     xs = x[tid * half:(tid + 1) * half]
     ys = y[tid * half:(tid + 1) * half]
-    for _ in range(_steps(mode)):
-        out = exe.run(main, feed={"x": xs, "label": ys},
-                      fetch_list=[loss], scope=scope)
-        print("LOSS %.6f" % float(np.asarray(out[0]).reshape(-1)[0]),
-              flush=True)
+    if os.environ.get("PADDLE_PS_TEST_PREFETCH") == "1":
+        # async-pipeline variant: feeds arrive pre-transferred on
+        # device + LazyFetch results — the PS push path keeps its
+        # required per-step grad sync, losses must match exactly
+        from paddle_tpu.reader import prefetch_to_device
+
+        pf = prefetch_to_device(
+            ({"x": xs, "label": ys} for _ in range(_steps(mode))),
+            size=2)
+        for feed in pf:
+            out = exe.run(main, feed=feed, fetch_list=[loss],
+                          scope=scope, return_numpy=False)
+            print("LOSS %.6f" % float(out[0]), flush=True)
+    else:
+        for _ in range(_steps(mode)):
+            out = exe.run(main, feed={"x": xs, "label": ys},
+                          fetch_list=[loss], scope=scope)
+            print("LOSS %.6f"
+                  % float(np.asarray(out[0]).reshape(-1)[0]),
+                  flush=True)
     exe.close()  # sends complete() so pservers exit
 
 

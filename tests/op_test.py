@@ -112,7 +112,10 @@ class OpTest:
                 rel = self._grad_rel_err(a, n)
             except Exception:  # noqa: BLE001 - op not jittable as-is
                 rel = None
-            if rel is None or rel.max() > 0.5 * max_relative_error:
+            # NaN-safe gate: a NaN in the jitted-f32 rel error must
+            # route to the exact fallback too (`NaN > x` is False, so
+            # the positive comparison would skip it and hard-fail)
+            if rel is None or not (rel.max() <= 0.5 * max_relative_error):
                 # exact f64 fallback decides every non-clear case: the
                 # f32 jitted sums carry cancellation noise that could
                 # otherwise nudge a genuinely-failing gradient under

@@ -31,11 +31,14 @@ def _env():
     return env
 
 
-def _spawn(args):
+def _spawn(args, extra_env=None):
+    env = _env()
+    if extra_env:
+        env.update(extra_env)
     return subprocess.Popen([sys.executable, _RUNNER] + args,
                             stdout=subprocess.PIPE,
                             stderr=subprocess.STDOUT, text=True,
-                            env=_env(), cwd=_DIR)
+                            env=env, cwd=_DIR)
 
 
 def _losses(out):
@@ -86,6 +89,41 @@ def test_ps_2x2_localhost(mode):
         # full-batch loss at every step (fp tolerance only).
         avg = np.mean(all_ls, axis=0)
         np.testing.assert_allclose(avg, base, rtol=1e-4, atol=1e-4)
+
+
+def test_ps_sync_prefetch_parity():
+    """Async input pipeline in PS mode: trainers feeding prefetched
+    on-device batches + LazyFetch results produce EXACTLY the same
+    per-step losses as the plain synchronous trainers (the PS push
+    path keeps its required per-step grad sync either way)."""
+    n_trainers = 2
+
+    def cohort(extra_env=None):
+        eps = "127.0.0.1:%d" % _free_port()
+        servers = [_spawn(["pserver", ep, eps, str(n_trainers), "sync"])
+                   for ep in eps.split(",")]
+        trainers = [
+            _spawn(["trainer", str(i), eps, str(n_trainers), "sync"],
+                   extra_env=extra_env)
+            for i in range(n_trainers)]
+        touts = []
+        try:
+            for t in trainers:
+                out, _ = t.communicate(timeout=240)
+                assert t.returncode == 0, out
+                touts.append(out)
+            for s in servers:
+                out, _ = s.communicate(timeout=60)
+                assert s.returncode == 0, out
+        finally:
+            for p in servers + trainers:
+                if p.poll() is None:
+                    p.kill()
+        return [_losses(out) for out in touts]
+
+    plain = cohort()
+    prefetched = cohort({"PADDLE_PS_TEST_PREFETCH": "1"})
+    assert plain == prefetched, (plain, prefetched)
 
 
 def test_ps_distributed_lookup_table_sync():
