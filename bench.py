@@ -707,6 +707,44 @@ def _enable_compile_cache():
         pass
 
 
+def _attach_collectives(result, exe, program, feed, fetch_list):
+    """Per-collective byte census of the step that just ran (lowered
+    StableHLO; Executor.collective_report) — offline ICI evidence for
+    the sharded weight update: with FLAGS_tpu_sharded_weight_update the
+    grad exchange shows as reduce_scatter at ~half the replicated
+    allreduce's ring bytes, the other half moving to the param
+    all_gather. Single-chip steps have no collectives and add nothing."""
+    if getattr(program, "_mesh", None) is None or \
+            not getattr(program, "_data_parallel", False):
+        # single-chip step: provably no collectives — don't pay a full
+        # retrace + StableHLO dump just to parse zero matches
+        return
+    try:
+        col = exe.collective_report(program, feed=feed,
+                                    fetch_list=fetch_list)
+    except Exception as e:  # noqa: BLE001 - census is evidence, not gating
+        print("BENCH collective census failed: %r" % (e,), flush=True)
+        return
+    if col and col.get("total_ici_bytes", 0) > 0:
+        result["collectives"] = col
+        print("BENCH collectives: " + ", ".join(
+            "%s x%d %.1fMB" % (k, v["count"], v["ici_bytes"] / 1e6)
+            for k, v in col.items() if isinstance(v, dict)),
+            flush=True)
+    if col and col.get("reduce_scatter"):
+        # ZeRO-1 active: also report the per-replica optimizer-state
+        # footprint (donation_report compiles via AOT — only pay that
+        # when there is sharding to prove)
+        rep = exe.donation_report(program, feed=feed,
+                                  fetch_list=fetch_list)
+        if rep and rep.get("opt_state_sharded_vars"):
+            result["opt_state_sharding"] = {
+                "vars": rep["opt_state_sharded_vars"],
+                "logical_bytes": rep["opt_state_logical_bytes"],
+                "per_replica_bytes": rep["opt_state_per_replica_bytes"],
+            }
+
+
 def _bert_flops_per_token(cfg, n_params, seq_len):
     """Training FLOPs/token: 6*N for the param matmuls plus the
     attention score/context matmuls (12*L*S*H per token: QK^T and AV are
@@ -827,6 +865,7 @@ def _bench_child(platform: str, batch: int, steps: int, warmup: int,
         # of each step the host spent feeding / dispatching / blocked
         "phases": phases,
     }
+    _attach_collectives(result, exe, main_p, feed, [total])
     if model != "longctx":
         # no V100 baseline exists for the seq-4096 config (a 32 GB V100
         # cannot hold the unfused step) — longctx reports absolute
@@ -990,6 +1029,7 @@ def _bench_resnet(batch: int, steps: int, warmup: int,
         "loss": round(float(np.asarray(out[0]).reshape(-1)[0]), 4),
         "phases": phases,
     }
+    _attach_collectives(result, exe, main_p, feed, [loss])
     if platform == "tpu":
         result["mfu_pct"] = round(
             100.0 * 3 * 4.1e9 * imgs_per_sec / TPU_PEAK_BF16_FLOPS, 2)

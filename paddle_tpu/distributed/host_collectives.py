@@ -24,6 +24,7 @@ Fault tolerance (see distributed/README.md for the env knobs):
 """
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 import time
@@ -280,17 +281,33 @@ class HostCollectiveGroup:
         self._seq += 1
         return "%s#%d" % (tag, self._seq)
 
+    @contextlib.contextmanager
+    def _comm_phase(self):
+        """Account host-collective wall time to the profiler's `comm`
+        step phase (the executor keeps `host` disjoint from it), so a
+        step blocked on cross-rank coordination shows as comm, not as
+        anonymous host time."""
+        from ..fluid import profiler as _prof
+
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            _prof.record_step_phase("comm", time.perf_counter() - t0, t0)
+
     def barrier(self):
         key = self._key("barrier")
-        self._client.call("hc_put_part", key, self.rank,
-                          np.zeros((1,), np.int8))
-        self._client.call("hc_gather", key, self.rank)
+        with self._comm_phase():
+            self._client.call("hc_put_part", key, self.rank,
+                              np.zeros((1,), np.int8))
+            self._client.call("hc_gather", key, self.rank)
 
     def all_reduce(self, array, op="sum"):
         key = self._key("allreduce")
-        self._client.call("hc_put_part", key, self.rank,
-                          np.ascontiguousarray(array))
-        parts = self._client.call("hc_gather", key, self.rank)
+        with self._comm_phase():
+            self._client.call("hc_put_part", key, self.rank,
+                              np.ascontiguousarray(array))
+            parts = self._client.call("hc_gather", key, self.rank)
         stack = np.stack([np.asarray(p) for p in parts])
         if op == "sum":
             return stack.sum(axis=0)
@@ -304,10 +321,11 @@ class HostCollectiveGroup:
 
     def all_gather(self, array) -> List[np.ndarray]:
         key = self._key("allgather")
-        self._client.call("hc_put_part", key, self.rank,
-                          np.ascontiguousarray(array))
-        return [np.asarray(p) for p in
-                self._client.call("hc_gather", key, self.rank)]
+        with self._comm_phase():
+            self._client.call("hc_put_part", key, self.rank,
+                              np.ascontiguousarray(array))
+            parts = self._client.call("hc_gather", key, self.rank)
+        return [np.asarray(p) for p in parts]
 
     def put(self, key, array):
         """Point-to-point send half (paired with take)."""

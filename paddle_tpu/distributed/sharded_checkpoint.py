@@ -19,6 +19,84 @@ from __future__ import annotations
 import os
 from typing import Any, Optional
 
+import numpy as np
+
+_AGREE_GROUP = None  # lazily built once per process (PADDLE_CKPT_AGREE)
+
+
+def _env_agree_group():
+    """The process-wide host-collective group used for checkpoint-step
+    agreement, built once from the PADDLE_* launch env when
+    PADDLE_CKPT_AGREE=1 (opt-in: creating a second store client inside
+    an arbitrary single-purpose process must not be able to wedge it).
+    None on single-host launches."""
+    global _AGREE_GROUP
+    if os.environ.get("PADDLE_CKPT_AGREE", "0") != "1":
+        return None
+    if _AGREE_GROUP is None:
+        from .host_collectives import group_from_env
+
+        _AGREE_GROUP = group_from_env()
+    return _AGREE_GROUP
+
+
+def agree_newest_intact(candidates, try_load, group, what="checkpoint",
+                        fatal=()):
+    """Cross-rank agreement on the newest checkpoint step EVERY rank can
+    restore (ROADMAP open item: one corrupt shard must not silently
+    diverge replicas). Protocol, per round:
+
+      1. allreduce-MIN over each rank's newest remaining candidate —
+         a rank that never saw step s cannot be out-voted into it;
+      2. every rank that has the agreed step tries to load it;
+      3. allreduce-MIN over the per-rank success bit — only a
+         unanimously intact step wins; otherwise everyone discards
+         candidates >= s and the next round starts.
+
+    `candidates`: this rank's step numbers, NEWEST FIRST (empty is
+    allowed: the rank contributes -1 and the whole group fails loudly
+    and consistently instead of one rank silently training from
+    scratch). `try_load`: callable(step) -> loaded result (raises on a
+    corrupt/partial step). `fatal`: exception types that mean the
+    PROGRAM disagrees with the on-disk schema — every older step is
+    equally doomed, so after the lockstep ok-vote (which keeps the
+    other ranks out of a blocked gather) the error re-raises instead
+    of grinding through every fallback. Returns (step, result). Raises
+    RuntimeError when no step is intact on every rank."""
+    remaining = sorted(set(int(c) for c in candidates), reverse=True)
+    fatal = tuple(fatal)
+    last_err = None
+    while True:
+        my = remaining[0] if remaining else -1
+        s = int(group.all_reduce(
+            np.asarray([my], np.int64), op="min")[0])
+        if s < 0:
+            raise RuntimeError(
+                "no %s step is intact on every rank (rank %d tried %s)"
+                % (what, group.rank, sorted(set(candidates),
+                                            reverse=True))) from last_err
+        ok, result, fatal_err = 0, None, None
+        if s in remaining:
+            try:
+                result = try_load(s)
+                ok = 1
+            except fatal as e:  # empty tuple catches nothing
+                fatal_err = e
+            except Exception as e:  # noqa: BLE001 - corrupt/partial step
+                last_err = e
+        ok_all = int(group.all_reduce(
+            np.asarray([ok], np.int64), op="min")[0])
+        if fatal_err is not None:
+            raise fatal_err
+        if ok_all:
+            return s, result
+        import logging
+
+        logging.getLogger("paddle_tpu.checkpoint").warning(
+            "%s step %d rejected by cross-rank agreement (intact here: "
+            "%s); falling back past it", what, s, bool(ok))
+        remaining = [c for c in remaining if c < s]
+
 
 class ShardedCheckpointManager:
     def __init__(self, directory: str, max_to_keep: int = 3):
@@ -47,7 +125,7 @@ class ShardedCheckpointManager:
         return list(self._mgr.all_steps())
 
     def restore(self, step: Optional[int] = None,
-                template: Any = None) -> Any:
+                template: Any = None, group: Any = None) -> Any:
         """Read checkpoint `step` (default: latest). With `template`
         (a pytree of arrays or ShapeDtypeStructs carrying shardings),
         restored arrays land DIRECTLY in that layout on the live mesh —
@@ -65,15 +143,33 @@ class ShardedCheckpointManager:
         chains the newest failure — read it before suspecting disk
         corruption.
 
-        Multi-host caveat: validation is per-process. If only ONE
-        host's shard of the newest step is corrupt, hosts could pick
-        different steps (or stall inside the sharded restore); on
-        multi-host topologies, agree on the step first (e.g. min over
-        an allreduce of each host's newest-intact step) and pass it
-        explicitly (ROADMAP "Open items")."""
+        Multi-host: per-process validation alone could pick DIFFERENT
+        steps per host when only one host's shard of the newest step is
+        corrupt. Pass a `group` (distributed.host_collectives
+        HostCollectiveGroup) — or launch with PADDLE_CKPT_AGREE=1 to
+        build one from the PADDLE_* env — and the ranks agree on the
+        newest step EVERY rank can restore (allreduce-min protocol,
+        `agree_newest_intact`) before any rank trains on."""
         steps = sorted(self.all_steps(), reverse=True)
         if step is not None:
             return self._restore_step(int(step), template)
+        if group is None:
+            group = _env_agree_group()
+        if group is not None:
+            # an empty-dir rank still joins the protocol (see
+            # agree_newest_intact): all-empty raises consistently
+            # everywhere; some-empty fails loudly on every rank rather
+            # than deadlocking the others in the store gather
+            newest = steps[0] if steps else -1
+            global_newest = int(group.all_reduce(
+                np.asarray([newest], np.int64), op="max")[0])
+            if global_newest < 0:
+                raise FileNotFoundError(
+                    "no checkpoints under %s (on any rank)" % self._dir)
+            _, result = agree_newest_intact(
+                steps, lambda s: self._restore_step(int(s), template),
+                group, what="sharded checkpoint")
+            return result
         if not steps:
             raise FileNotFoundError(
                 "no checkpoints under %s" % self._dir)

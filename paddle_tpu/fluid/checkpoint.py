@@ -134,6 +134,8 @@ def _snapshot(main_program, scope=None):
 
     from . import framework
 
+    from ..parallel.sharded_update import unshard_scope_value
+
     program = main_program or framework.default_main_program()
     scope = scope or global_scope()
     snap = {}
@@ -141,6 +143,13 @@ def _snapshot(main_program, scope=None):
         if is_persistable(var):
             v = scope.find_var(var.name)
             if v is None:
+                continue
+            # ZeRO-1 state lives as flat dp-sharded buffers; checkpoint
+            # it at its logical shape so restores work regardless of
+            # the flag/mesh the resuming run uses
+            logical = unshard_scope_value(program, var.name, v)
+            if logical is not v:
+                snap[var.name] = np.asarray(logical)
                 continue
             snap[var.name] = (jnp.copy(v) if isinstance(v, jax.Array)
                               else np.array(v, copy=True))
@@ -201,7 +210,7 @@ def save_checkpoint(executor, path, train_status=None, main_program=None,
 
 
 def load_checkpoint(executor, path, main_program=None, scope=None,
-                    ignore_empty=True):
+                    ignore_empty=True, group=None):
     """Restore the LATEST intact numbered checkpoint; returns its
     TrainStatus, or None when no checkpoint exists (reference:
     load_checkpoint collective/__init__.py:294).
@@ -211,20 +220,49 @@ def load_checkpoint(executor, path, main_program=None, scope=None,
     still leave the newest dir unreadable. Rather than dying — or
     silently restarting from scratch — restore falls back to the next
     newest checkpoint that loads cleanly, logging what was skipped.
-    The fallback decision is per-process: multi-trainer jobs reading a
-    shared checkpoint dir should verify all ranks resumed the same
-    step_no (a host-collective allreduce of step_no) before training
-    on (ROADMAP "Open items")."""
+
+    Multi-trainer jobs (per-rank checkpoint dirs or shards): pass a
+    host-collective `group` — or launch with PADDLE_CKPT_AGREE=1 to
+    build one from the PADDLE_* env — and the ranks agree on the newest
+    checkpoint number EVERY rank can load (allreduce-min protocol,
+    distributed.sharded_checkpoint.agree_newest_intact), so one rank's
+    corrupt newest dir can't silently diverge the replicas."""
     from . import framework
 
     dirs = _ckpt_dirs(path)
+    program = main_program or framework.default_main_program()
+    scope = scope or global_scope()
+    names = [v.name for v in program.list_vars() if is_persistable(v)]
+    if group is None:
+        from ..distributed.sharded_checkpoint import _env_agree_group
+
+        group = _env_agree_group()
+    if group is not None:
+        from ..distributed.sharded_checkpoint import agree_newest_intact
+
+        # a rank with an EMPTY dir must still join the protocol: an
+        # early return here would leave the other ranks blocked in the
+        # store's gather and this rank silently training from scratch.
+        # All-empty -> every rank agrees there is nothing to restore;
+        # some-empty -> agree_newest_intact fails loudly on every rank
+        # (its allreduce-min sees the empty rank's -1).
+        newest = max(dirs) if dirs else -1
+        global_newest = int(group.all_reduce(
+            np.asarray([newest], np.int64), op="max")[0])
+        if global_newest < 0:
+            if not ignore_empty:
+                raise RuntimeError(
+                    "no checkpoint found under %r (on any rank)" % path)
+            return None
+        _, status = agree_newest_intact(
+            list(dirs), lambda n: _load_one_checkpoint(
+                dirs[int(n)], names, scope),
+            group, what="fluid checkpoint", fatal=(_SchemaMismatch,))
+        return status
     if not dirs:
         if not ignore_empty:
             raise RuntimeError("no checkpoint found under %r" % path)
         return None
-    program = main_program or framework.default_main_program()
-    scope = scope or global_scope()
-    names = [v.name for v in program.list_vars() if is_persistable(v)]
     last_err = None
     for n in sorted(dirs, reverse=True):
         try:

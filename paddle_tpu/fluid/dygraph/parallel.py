@@ -95,15 +95,28 @@ class DataParallel(Layer):
         # grads on global arrays are already reduced by XLA when the batch
         # axis is sharded; explicit coalesce+allreduce (parallel.py:344-369)
         # is unnecessary on a single host. Multi-host: psum via mesh.
+        # With FLAGS_tpu_sharded_weight_update, this is where the eager
+        # path re-lays gradients out dim-0-sharded over the mesh (the
+        # ZeRO-1 reduce-scatter analogue): the optimizer step that
+        # follows then runs GSPMD-partitioned against the equally
+        # sharded accumulators — per-replica update FLOPs and moment
+        # HBM ~1/N, math unchanged (XLA all-gathers the params where
+        # the next replicated forward consumes them).
         mesh = penv.global_mesh()
-        if mesh is None or self._nranks <= 1:
+        if mesh is None:
             return
         import jax
 
+        from ...core.selected_rows import SelectedRows
+        from ...parallel.sharded_update import eager_accumulator_sharding
+
         for p in self._layers.parameters():
-            if p._grad is not None:
-                # grads are global arrays; ensure replicated sum semantics
-                p._grad = p._grad  # already global-summed under jit/mesh
+            g = p._grad
+            if g is None or isinstance(g, SelectedRows):
+                continue
+            sh = eager_accumulator_sharding(tuple(g.shape))
+            if sh is not None and getattr(g, "sharding", None) != sh:
+                p._grad = jax.device_put(g, sh)
 
     def parameters(self, include_sublayers=True):
         return self._layers.parameters(include_sublayers)

@@ -97,6 +97,7 @@ class Executor:
 
         t_step = _time.perf_counter()
         ph = {"feed": 0.0, "dispatch": 0.0, "sync": 0.0, "compile": 0.0}
+        comm0 = _prof.step_phase_total("comm")
         try:
             return self._run_impl(program, feed, fetch_list, scope,
                                   return_numpy, use_program_cache, ph)
@@ -110,8 +111,13 @@ class Executor:
                     _prof.record_step_phase(name, ph[name])
                 if ph["compile"]:
                     _prof.record_step_phase("compile", ph["compile"])
+                # host-collective time recorded DURING this step (PS
+                # barriers, cross-rank agreement) already counted
+                # itself into the comm phase — keep host disjoint
+                comm_dt = _prof.step_phase_total("comm") - comm0
                 _prof.record_step_phase(
-                    "host", max(0.0, total - sum(ph.values())))
+                    "host",
+                    max(0.0, total - sum(ph.values()) - comm_dt))
 
     def _run_impl(self, program, feed, fetch_list, scope, return_numpy,
                   use_program_cache, ph):
@@ -264,6 +270,21 @@ class Executor:
 
         states_mut = {n: scope.find_var(n) for n in entry.state_mut_names}
         states_ro = {n: scope.find_var(n) for n in entry.state_ro_names}
+        if entry.sharded_state:
+            # ZeRO-1 layout: sharded optimizer state lives in the scope
+            # as flat (padded,) buffers NamedSharding'd over the dp axis
+            # — convert once (startup-initialized / checkpoint-restored
+            # values arrive at their logical shapes)
+            from ..parallel import sharded_update as _su
+
+            for n, info in entry.sharded_state.items():
+                v = states_mut.get(n)
+                if v is not None and \
+                        tuple(getattr(v, "shape", ())) != (info.padded,):
+                    v = _su.to_sharded_global(v, info, entry.mesh,
+                                              entry.dp_axis)
+                    states_mut[n] = v
+                    scope.set_var(n, v)
         seed = framework._global_seed_and_bump(program)
         _t = _time.perf_counter()
         feeds_dev = self._shard_feeds(entry, feed_arrays)
@@ -669,16 +690,11 @@ class Executor:
 
         return NamedSharding(mesh, P(dp_axis))
 
-    def donation_report(self, program=None, feed=None, fetch_list=None,
-                        scope=None):
-        """Donation audit via compiled-memory analysis of the EXECUTOR
-        path's cached executable (run the program once first so the
-        entry exists): verifies FLAGS_tpu_donate_buffers actually
-        aliases params/opt-state — and, with
-        FLAGS_tpu_donate_feed_buffers, how many feed bytes alias too.
-        Returns {mut_bytes, feed_bytes, alias_bytes, aliases_state,
-        feed_donate} or None when the entry isn't jit-lowered (eager
-        fallback / unknown program)."""
+    def _cached_lowerable(self, program, feed, fetch_list, scope):
+        """(entry, lowered) for the EXECUTOR path's cached executable of
+        this (program, feed shapes, fetch list) — run the program once
+        first so the entry exists. None when the entry isn't jit-lowered
+        (eager fallback / unknown program)."""
         import jax
 
         program = program or framework.default_main_program()
@@ -722,10 +738,29 @@ class Executor:
         smut = {n: aval(scope.find_var(n))
                 for n in entry.state_mut_names}
         sro = {n: aval(scope.find_var(n)) for n in entry.state_ro_names}
-        comp = entry.jitted.lower(
-            favals, smut, sro,
-            jax.ShapeDtypeStruct((), np.uint32)).compile()
-        ma = comp.memory_analysis()
+        lowered = entry.jitted.lower(
+            favals, smut, sro, jax.ShapeDtypeStruct((), np.uint32))
+        return entry, lowered, smut, favals
+
+    def donation_report(self, program=None, feed=None, fetch_list=None,
+                        scope=None):
+        """Donation audit via compiled-memory analysis of the EXECUTOR
+        path's cached executable (run the program once first so the
+        entry exists): verifies FLAGS_tpu_donate_buffers actually
+        aliases params/opt-state — and, with
+        FLAGS_tpu_donate_feed_buffers, how many feed bytes alias too.
+        With the sharded weight update active, also reports the ZeRO-1
+        optimizer-state footprint: `opt_state_sharded_vars`,
+        `opt_state_logical_bytes` (what the replicated path would hold
+        PER replica) vs `opt_state_per_replica_bytes` (~1/N of it).
+        Returns {mut_bytes, feed_bytes, alias_bytes, aliases_state,
+        feed_donate, ...} or None when the entry isn't jit-lowered
+        (eager fallback / unknown program)."""
+        got = self._cached_lowerable(program, feed, fetch_list, scope)
+        if got is None:
+            return None
+        entry, lowered, smut, favals = got
+        ma = lowered.compile().memory_analysis()
 
         def nbytes(avals):
             return sum(int(np.prod(v.shape or (1,))) *
@@ -734,13 +769,54 @@ class Executor:
         mut_bytes = nbytes(smut)
         feed_bytes = nbytes(favals)
         alias_bytes = int(getattr(ma, "alias_size_in_bytes", 0))
-        return {
+        sharded = entry.sharded_state or {}
+        ndev = 1
+        if entry.mesh is not None:
+            ndev = int(np.prod(
+                [entry.mesh.shape[a] for a in entry.mesh.axis_names]))
+        if sharded:
+            # XLA's alias_size_in_bytes is PER DEVICE; a sharded state
+            # var occupies only padded/N bytes there — shrink the
+            # donation target accordingly so the audit compares like
+            # with like
+            for info in sharded.values():
+                if info.name in smut:
+                    mut_bytes -= (info.padded - info.padded // ndev) \
+                        * info.dtype.itemsize
+        out = {
             "mut_bytes": mut_bytes,
             "feed_bytes": feed_bytes,
             "alias_bytes": alias_bytes,
             "aliases_state": alias_bytes >= mut_bytes,
             "feed_donate": bool(entry.feed_donate),
         }
+        out["opt_state_sharded_vars"] = len(sharded)
+        if sharded:
+            out["opt_state_logical_bytes"] = sum(
+                info.numel * info.dtype.itemsize
+                for info in sharded.values())
+            out["opt_state_per_replica_bytes"] = sum(
+                (info.padded // ndev) * info.dtype.itemsize
+                for info in sharded.values())
+        return out
+
+    def collective_report(self, program=None, feed=None, fetch_list=None,
+                          scope=None):
+        """Per-collective byte accounting for the cached executable
+        (run the program once first): parses the lowered StableHLO for
+        all_reduce / reduce_scatter / all_gather ops and models ring
+        ICI bytes — offline evidence that the sharded weight update
+        actually halves the grad+param exchange (see
+        lowering.collective_byte_census). None when not jit-lowered."""
+        got = self._cached_lowerable(program, feed, fetch_list, scope)
+        if got is None:
+            return None
+        entry, lowered, _, _ = got
+        ndev = 1
+        if entry.mesh is not None:
+            ndev = int(np.prod([entry.mesh.shape[a]
+                                for a in entry.mesh.axis_names]))
+        return lowering.collective_byte_census(lowered.as_text(), ndev)
 
     def close(self):
         for comm in getattr(self, "_ps_comms", {}).values():

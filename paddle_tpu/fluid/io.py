@@ -59,12 +59,17 @@ def _load_dict(dirname, names=None, filename=None):
 
 
 def _collect(program, predicate, scope):
+    from ..parallel.sharded_update import unshard_scope_value
+
     vals = {}
     for var in program.list_vars():
         if predicate(var):
             v = scope.find_var(var.name)
             if v is not None:
-                vals[var.name] = np.asarray(v)
+                # ZeRO-1 optimizer state is scope-resident as a flat
+                # dp-sharded buffer; persist the logical-shape view
+                vals[var.name] = np.asarray(
+                    unshard_scope_value(program, var.name, v))
     return vals
 
 
@@ -78,6 +83,8 @@ def is_parameter(var):
 
 def save_vars(executor, dirname, main_program=None, vars=None,
               predicate=None, filename=None):
+    from ..parallel.sharded_update import unshard_scope_value
+
     program = main_program or framework.default_main_program()
     scope = global_scope()
     if vars is not None:
@@ -86,7 +93,8 @@ def save_vars(executor, dirname, main_program=None, vars=None,
             name = v.name if isinstance(v, Variable) else v
             val = scope.find_var(name)
             if val is not None:
-                d[name] = np.asarray(val)
+                d[name] = np.asarray(
+                    unshard_scope_value(program, name, val))
     else:
         d = _collect(program, predicate or is_persistable, scope)
     _save_dict(dirname, d, filename)

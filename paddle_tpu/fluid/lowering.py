@@ -55,11 +55,12 @@ class LoweredFunction:
     __slots__ = ("jitted", "state_in_names", "state_out_names",
                  "state_mut_names", "state_ro_names",
                  "fetch_names", "feed_names", "mesh", "dp_axis",
-                 "auto_plan", "feed_donate")
+                 "auto_plan", "feed_donate", "sharded_state")
 
     def __init__(self, jitted, feed_names, state_in_names, state_out_names,
                  state_mut_names, state_ro_names, fetch_names, mesh=None,
-                 dp_axis=None, auto_plan=None, feed_donate=False):
+                 dp_axis=None, auto_plan=None, feed_donate=False,
+                 sharded_state=None):
         self.jitted = jitted
         self.feed_names = feed_names
         self.state_in_names = state_in_names
@@ -71,6 +72,10 @@ class LoweredFunction:
         self.dp_axis = dp_axis
         self.auto_plan = auto_plan
         self.feed_donate = feed_donate
+        # {name: parallel.sharded_update.ShardInfo} when the compiled
+        # step keeps optimizer state sharded over the dp axis (ZeRO-1);
+        # the executor lays those scope arrays out as flat 1/N buffers
+        self.sharded_state = sharded_state
 
 
 def _sub_block_idxs(op):
@@ -606,10 +611,19 @@ def _diffable(block, name, env):
 
 
 def build_block_fn(program, block, feed_names, fetch_names,
-                   state_in, state_out):
-    """Build the pure python fn to be jitted."""
+                   state_in, state_out, shard_plan=None):
+    """Build the pure python fn to be jitted. With `shard_plan` (a
+    parallel.sharded_update.ShardedUpdatePlan; only under _compile_dp),
+    optimizer-bound gradients are reduce-scattered instead of pmean'd,
+    the post-backward section runs on flat 1/N shards, and updated
+    params are all-gathered back — ZeRO-1 weight-update sharding."""
     import jax
     import jax.numpy as jnp
+
+    if shard_plan is not None:
+        from ..parallel import sharded_update as _su
+    else:
+        _su = None
 
     ops = list(block.ops)
     bwd_indices = [i for i, op in enumerate(ops) if op.type == "backward"]
@@ -654,6 +668,10 @@ def build_block_fn(program, block, feed_names, fetch_names,
         env.update(states_mut)
         env.update(feeds)
         key0 = make_key(seed)
+        if shard_plan is not None:
+            # sharded optimizer state arrives as raw (padded/N,) vecs
+            # from shard_map; wrap with the logical shapes
+            _su.wrap_sharded_state(env, shard_plan)
 
         if bwd_idx is None:
             _run_ops(ops, env, key0, amp_lists=amp_lists)
@@ -699,7 +717,18 @@ def build_block_fn(program, block, feed_names, fetch_names,
             env = dict(env_after)
             gm = bop.attrs.get("gradient_merge")
             if gm is None:
-                grads = {n: _dp_pmean(g) for n, g in grads.items()}
+                if shard_plan is not None and _implicit_dp:
+                    # ZeRO-1: optimizer-bound grads are reduce-scattered
+                    # (pmean semantics -> /N); everything else keeps the
+                    # replicated pmean (e.g. a fetched grad)
+                    grads = {
+                        n: (_su.reduce_scatter_mean(g, shard_plan)
+                            if framework.grad_var_name(n)
+                            in shard_plan.grad_names
+                            else _dp_pmean(g))
+                        for n, g in grads.items()}
+                else:
+                    grads = {n: _dp_pmean(g) for n, g in grads.items()}
             # under gradient merge, sync once on the MERGED grads at the
             # k-step boundary instead of k per-micro-step allreduces
             for n in diff_names:
@@ -709,8 +738,13 @@ def build_block_fn(program, block, feed_names, fetch_names,
             env[framework.grad_var_name(loss_name)] = jnp.full(
                 loss_val.shape, loss_scale, loss_val.dtype)
             if gm is None:
-                _run_ops(ops[bwd_idx + 1:], env, key0,
-                         base_idx=bwd_idx + 1, amp_lists=amp_lists)
+                if shard_plan is not None:
+                    _su.run_sharded_post_ops(
+                        ops[bwd_idx + 1:], env, key0, bwd_idx + 1,
+                        amp_lists, shard_plan, block)
+                else:
+                    _run_ops(ops[bwd_idx + 1:], env, key0,
+                             base_idx=bwd_idx + 1, amp_lists=amp_lists)
             else:
                 _run_gradient_merge(ops, bwd_idx, gm, env, key0,
                                     amp_lists, sync_fn=_dp_pmean)
@@ -719,8 +753,15 @@ def build_block_fn(program, block, feed_names, fetch_names,
         for n in fetch_names:
             if n not in env:
                 raise RuntimeError("fetch var %r was never computed" % n)
-            fetches.append(env[n])
-        new_states = {n: env[n] for n in state_out if n in env}
+            v = env[n]
+            if shard_plan is not None and isinstance(v, _su.ShardVal):
+                v = _su.gather_full(v, shard_plan)  # fetched as full
+            fetches.append(v)
+        if shard_plan is None:
+            new_states = {n: env[n] for n in state_out if n in env}
+        else:
+            new_states = {n: _su.unwrap_out(n, env[n], shard_plan)
+                          for n in state_out if n in env}
         return fetches, new_states
 
     return fn
@@ -746,18 +787,37 @@ def compile_block(program, block, feed_specs, fetch_names, state_specs,
             "variables %s are read by the program but absent from the scope "
             "— run the startup program (or feed them)" % (missing,))
 
-    fn = build_block_fn(program, block, feed_names, fetch_names,
-                        state_in, state_out)
-
-    state_out_set = set(state_out)
-    state_mut = [n for n in state_in if n in state_out_set]
-    state_ro = [n for n in state_in if n not in state_out_set]
-
     mesh = getattr(program, "_mesh", None)
     dp_axis = getattr(program, "_dp_axis", "dp")
     if getattr(program, "_data_parallel", False) and mesh is None:
         mesh = _default_mesh(dp_axis)
         program._mesh = mesh
+
+    # ZeRO-1 sharded weight update (FLAGS_tpu_sharded_weight_update):
+    # plan once per program; None = keep the replicated update
+    shard_plan = None
+    if mesh is not None and getattr(program, "_data_parallel", False) \
+            and getattr(program, "_auto_parallel", None) is None \
+            and not getattr(program, "_pipeline_cfg", None):
+        from ..parallel import sharded_update as _su
+
+        ndev = int(mesh.shape[dp_axis]) if dp_axis in mesh.shape else 1
+        shard_plan = _su.plan_sharded_update(program, block, ndev,
+                                             dp_axis)
+    program._shard_plan = shard_plan
+
+    fn = build_block_fn(program, block, feed_names, fetch_names,
+                        state_in, state_out, shard_plan=shard_plan)
+
+    state_out_set = set(state_out)
+    state_mut = [n for n in state_in if n in state_out_set]
+    state_ro = [n for n in state_in if n not in state_out_set]
+    if shard_plan is not None:
+        # a would-be-sharded state var must flow in AND out of the step;
+        # anything else degrades to the replicated layout
+        for n in list(shard_plan.sharded_state):
+            if n not in state_mut:
+                del shard_plan.sharded_state[n]
 
     if donate is None:  # None = follow the global flag
         from ..utils.flags import get_flag
@@ -811,7 +871,7 @@ def compile_block(program, block, feed_specs, fetch_names, state_specs,
     if mesh is not None and getattr(program, "_data_parallel", False):
         jitted = _compile_dp(fn, mesh, dp_axis, program, block,
                              feed_names, fetch_names, state_mut, state_ro,
-                             donate, feed_donate)
+                             donate, feed_donate, shard_plan=shard_plan)
     else:
         host, dynamic = _block_host_op_kinds(block)
         if dynamic:
@@ -836,7 +896,10 @@ def compile_block(program, block, feed_specs, fetch_names, state_specs,
 
     return LoweredFunction(jitted, feed_names, state_in, state_out,
                            state_mut, state_ro, fetch_names, mesh=mesh,
-                           dp_axis=dp_axis, feed_donate=feed_donate)
+                           dp_axis=dp_axis, feed_donate=feed_donate,
+                           sharded_state=(dict(shard_plan.sharded_state)
+                                          if shard_plan is not None
+                                          else None))
 
 
 def _block_host_op_kinds(block):
@@ -925,12 +988,94 @@ def _default_mesh(dp_axis):
     return Mesh(devs, (dp_axis,))
 
 
+# -- per-collective byte accounting (offline ICI evidence) -------------------
+
+_COLLECTIVE_OPS = ("all_reduce", "reduce_scatter", "all_gather",
+                   "all_to_all", "collective_permute")
+
+_DTYPE_BYTES = {"f64": 8, "f32": 4, "f16": 2, "bf16": 2, "f8": 1,
+                "i64": 8, "ui64": 8, "i32": 4, "ui32": 4, "i16": 2,
+                "ui16": 2, "i8": 1, "ui8": 1, "i1": 1}
+
+
+def _tensor_bytes(type_str):
+    """bytes of one `tensor<AxBx...xDT>` type string (0 if unparsable)."""
+    inner = type_str.strip()
+    parts = inner.split("x")
+    dt = parts[-1]
+    size = _DTYPE_BYTES.get(dt)
+    if size is None:
+        return 0
+    n = 1
+    for d in parts[:-1]:
+        try:
+            n *= int(d)
+        except ValueError:
+            return 0
+    return n * size
+
+
+def collective_byte_census(stablehlo_text, ndev=1):
+    """Per-collective accounting from a lowered StableHLO module:
+    {op: {count, tensor_bytes, ici_bytes}} + totals. `tensor_bytes`
+    sums the RESULT tensor sizes; `ici_bytes` models ring-algorithm
+    wire bytes on an N-device ring (all_reduce 2(N-1)/N of the full
+    tensor, reduce_scatter (N-1)x its 1/N result, all_gather (N-1)/N of
+    its full result) — the quantity the sharded weight update halves on
+    the grad+param exchange."""
+    import re
+
+    ndev = max(int(ndev), 1)
+    out = {op: {"count": 0, "tensor_bytes": 0, "ici_bytes": 0}
+           for op in _COLLECTIVE_OPS}
+    open_pat = re.compile(
+        r"\"?(?:stablehlo|mhlo)\.(%s)\"?" % "|".join(_COLLECTIVE_OPS))
+    ret_pat = re.compile(r"->\s*(?:tuple<)?tensor<([^>]+)>")
+    hits = []
+    pending = None  # region-bearing ops (all_reduce/reduce_scatter):
+    # the `-> tensor<...>` result type lands on the region's CLOSING
+    # line, several lines below the op itself
+    for line in stablehlo_text.splitlines():
+        m = open_pat.search(line)
+        r = ret_pat.search(line)
+        if m and r:
+            hits.append((m.group(1), r.group(1)))
+        elif m:
+            pending = m.group(1)
+        elif pending and r and line.lstrip().startswith("})"):
+            hits.append((pending, r.group(1)))
+            pending = None
+    for op, ttype in hits:
+        b = _tensor_bytes(ttype)
+        rec = out[op]
+        rec["count"] += 1
+        rec["tensor_bytes"] += b
+        if op == "all_reduce":
+            rec["ici_bytes"] += int(2 * (ndev - 1) / ndev * b)
+        elif op == "reduce_scatter":
+            rec["ici_bytes"] += (ndev - 1) * b
+        elif op == "all_gather":
+            rec["ici_bytes"] += int((ndev - 1) / ndev * b)
+        else:
+            rec["ici_bytes"] += b
+    out = {k: v for k, v in out.items() if v["count"]}
+    out["total_ici_bytes"] = sum(v["ici_bytes"] for v in out.values())
+    out["total_tensor_bytes"] = sum(
+        v["tensor_bytes"] for v in out.values() if isinstance(v, dict))
+    out["ndev"] = ndev
+    return out
+
+
 def _compile_dp(fn, mesh, dp_axis, program, block, feed_names, fetch_names,
-                state_mut, state_ro, donate, feed_donate=False):
+                state_mut, state_ro, donate, feed_donate=False,
+                shard_plan=None):
     """Data-parallel lowering: shard_map over the mesh; feeds sharded on
     axis 0, state replicated. Collective ops inside see the live axis and
     emit psum over ICI (reference flow: transpiler/collective.py:178-268 +
-    c_allreduce kernels -> here SURVEY.md §3C TPU mapping)."""
+    c_allreduce kernels -> here SURVEY.md §3C TPU mapping). With a
+    shard_plan, optimizer-state vars get P(dp_axis) in/out specs — their
+    scope arrays are flat buffers sharded over the mesh, so per-replica
+    optimizer HBM is ~1/N across steps (ZeRO-1)."""
     import jax
     from jax.sharding import PartitionSpec as P
 
@@ -938,13 +1083,22 @@ def _compile_dp(fn, mesh, dp_axis, program, block, feed_names, fetch_names,
 
     ndev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
     axes = {a: mesh.shape[a] for a in mesh.axis_names}
+    sharded_names = frozenset(shard_plan.sharded_state) \
+        if shard_plan is not None else frozenset()
 
     def wrapped(feeds, states_mut, states_ro, seed):
         with penv.collective_scope(axes):
-            return fn(feeds, states_mut, states_ro, seed)
+            fetches, new_states = fn(feeds, states_mut, states_ro, seed)
+        # split state outs by layout: shard_map needs distinct out
+        # specs for replicated vs dp-sharded state
+        rep = {n: v for n, v in new_states.items()
+               if n not in sharded_names}
+        sh = {n: v for n, v in new_states.items() if n in sharded_names}
+        return fetches, rep, sh
 
     feed_specs = {n: P(dp_axis) for n in feed_names}
-    state_specs_mut = {n: P() for n in state_mut}
+    state_specs_mut = {n: (P(dp_axis) if n in sharded_names else P())
+                       for n in state_mut}
     state_specs_ro = {n: P() for n in state_ro}
 
     def out_spec_for_fetch(n):
@@ -953,7 +1107,8 @@ def _compile_dp(fn, mesh, dp_axis, program, block, feed_names, fetch_names,
             return P()
         return P(dp_axis)
 
-    # state_out names are discovered inside fn; all replicated
+    # state_out names are discovered inside fn; replicated except the
+    # plan's sharded optimizer state
     fetch_specs = [out_spec_for_fetch(n) for n in fetch_names]
 
     from ..parallel.env import shard_map_compat
@@ -961,7 +1116,13 @@ def _compile_dp(fn, mesh, dp_axis, program, block, feed_names, fetch_names,
     smapped = shard_map_compat(
         wrapped, mesh=mesh,
         in_specs=(feed_specs, state_specs_mut, state_specs_ro, P()),
-        out_specs=(fetch_specs, P()),
+        out_specs=(fetch_specs, P(), P(dp_axis)),
         check_vma=False)
-    return jax.jit(smapped,
+
+    def merged(feeds, states_mut, states_ro, seed):
+        fetches, rep, sh = smapped(feeds, states_mut, states_ro, seed)
+        rep.update(sh)
+        return fetches, rep
+
+    return jax.jit(merged,
                    donate_argnums=_donate_argnums(donate, feed_donate))

@@ -108,6 +108,20 @@ class Optimizer:
                 "%s_%s" % (param.name, name), None)
             if loaded is not None:
                 var._assign_raw(jnp.asarray(loaded))
+            # eager ZeRO-1 (FLAGS_tpu_sharded_weight_update + an active
+            # mesh): accumulators live dim-0-sharded over the mesh from
+            # creation; GSPMD partitions the eager update so per-replica
+            # optimizer-state HBM is ~1/N — same math, XLA re-gathers
+            # params wherever a replicated consumer needs them
+            from ..parallel.sharded_update import \
+                eager_accumulator_sharding
+
+            sh = eager_accumulator_sharding(
+                tuple(var._value().shape))
+            if sh is not None:
+                import jax
+
+                var._assign_raw(jax.device_put(var._value(), sh))
             accs[param.name] = var
             return var
         helper = LayerHelper(self._name)

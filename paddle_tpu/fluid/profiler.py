@@ -20,11 +20,17 @@ _trace_events = []
 _trace_enabled = False
 
 # -- step-phase counters (async pipeline observability) ---------------------
-# Every Executor.run splits its wall time into four phases:
+# Every Executor.run splits its wall time into these phases:
 #   feed     — host-side feed prep + H2D issue (zero-ish when batches
 #              arrive pre-transferred from reader/prefetcher.py)
 #   dispatch — handing the jitted step to the runtime (async: returns
 #              while the device still computes)
+#   comm     — host blocked on cross-HOST collective coordination
+#              (host_collectives barrier/allreduce/allgather: PS sync
+#              barriers, checkpoint-step agreement, fleet metrics).
+#              Device-tier ICI collective time is invisible to the host
+#              (XLA overlaps it with compute) — for ICI evidence use
+#              Executor.collective_report's per-collective byte census.
 #   sync     — host blocked on device results (FLAGS_benchmark's
 #              per-step block, return_numpy materialization, deferred
 #              LazyFetch/hapi log-step syncs)
@@ -32,7 +38,7 @@ _trace_enabled = False
 #              python overhead, PS bookkeeping)
 # In a well-overlapped pipeline feed+sync+host ≈ 0 at steady state and
 # dispatch-to-dispatch time ≈ device compute time.
-STEP_PHASES = ("feed", "dispatch", "sync", "host")
+STEP_PHASES = ("feed", "dispatch", "comm", "sync", "host")
 _step_phases = defaultdict(lambda: [0, 0.0, 0.0])  # -> [count, total_s, max_s]
 
 
@@ -56,6 +62,13 @@ def record_step_trace(name, t0, dt):
 
         _trace_events.append(("phase/" + name, t0 * 1e6, dt * 1e6,
                               threading.get_ident() % 100000))
+
+
+def step_phase_total(name):
+    """Accumulated seconds in one phase counter (0.0 when unseen) —
+    the executor snapshots `comm` around each step so host time stays
+    disjoint from collective time recorded by host_collectives."""
+    return _step_phases[name][1] if name in _step_phases else 0.0
 
 
 def reset_step_phases():
@@ -92,9 +105,10 @@ def step_phase_line():
     """ONE human-readable summary line (bench.py prints it)."""
     s = step_phase_summary()
     return ("step phases: %d steps, feed %.2fms dispatch %.2fms "
-            "sync %.2fms host %.2fms (host total %.2fms/step)"
-            % (s["steps"], s["feed_ms"], s["dispatch_ms"], s["sync_ms"],
-               s["host_ms"], s["total_ms"]))
+            "comm %.2fms sync %.2fms host %.2fms "
+            "(host total %.2fms/step)"
+            % (s["steps"], s["feed_ms"], s["dispatch_ms"], s["comm_ms"],
+               s["sync_ms"], s["host_ms"], s["total_ms"]))
 
 
 def event_count(name):

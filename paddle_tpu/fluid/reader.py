@@ -230,6 +230,8 @@ class DataLoader:
         self._num_workers = max(0, int(num_workers))
         self._timeout = timeout
         self._worker_init_fn = worker_init_fn
+        self._places = places
+        self._use_buffer_reader = use_buffer_reader
 
     def _batches(self):
         if self._batch_sampler is not None:
@@ -245,13 +247,43 @@ class DataLoader:
                 continue
             yield sel
 
-    def __iter__(self):
+    def _device_buffered(self):
+        """Map-style analogue of _GeneratorLoader._device_buffered: with
+        `use_buffer_reader` (the default) and an accelerator place, the
+        buffer reader extends past host numpy into HBM — batches arrive
+        as pre-put jax arrays (reader/prefetcher.py issues the async
+        device_puts) and the dygraph train loops consume them without a
+        host round-trip (hapi _as_variables / to_variable pass device
+        arrays through)."""
+        if not self._use_buffer_reader:
+            return False
+        places = self._places
+        if places is None:
+            return False
+        from ..core.place import CUDAPlace, TPUPlace
+
+        seq = places if isinstance(places, (list, tuple)) else [places]
+        return any(isinstance(p, (TPUPlace, CUDAPlace)) for p in seq)
+
+    def _iter_host(self):
         if self._num_workers == 0:
             collate = self._collate or _default_collate
             for sel in self._batches():
                 yield collate([self._dataset[int(j)] for j in sel])
             return
         yield from self._iter_multiprocess()
+
+    def __iter__(self):
+        if not self._device_buffered():
+            yield from self._iter_host()
+            return
+        from ..reader.prefetcher import prefetch_to_device
+
+        pf = prefetch_to_device(self._iter_host())
+        try:
+            yield from pf
+        finally:
+            pf.close()  # early break drains in-flight device buffers
 
     def _iter_multiprocess(self):
         """Fan out to worker processes; results are reordered so batch

@@ -48,10 +48,17 @@ def _to_list(x):
 
 
 def _as_variables(arrays):
+    from ..reader.prefetcher import is_on_device
+
     out = []
     for a in arrays:
         if isinstance(a, dy_base.Tensor):
             out.append(a)
+        elif is_on_device(a):
+            # pre-put device batch (DataLoader use_buffer_reader /
+            # reader.prefetch_to_device): wrap without the host
+            # round-trip np.asarray would force
+            out.append(dy_base.to_variable(a))
         else:
             out.append(dy_base.to_variable(np.asarray(a)))
     return out
@@ -237,7 +244,12 @@ class Model:
             metrics.append(res)
         return ([float(np.asarray(loss.numpy()).reshape(-1)[0])], metrics)
 
-    def eval_batch(self, inputs, labels=None):
+    def _eval_batch_device(self, inputs, labels=None):
+        """One eval step with everything left device-resident (the
+        evaluate() analogue of _train_batch_device): returns
+        (loss_tensor_or_None, outputs, labels) without a host sync, so
+        deferred eval loops never drain the dispatch queue between
+        logged steps."""
         with self._dygraph_guard():
             self.network.eval()
             with dy_base.no_grad():
@@ -246,6 +258,10 @@ class Model:
                 outputs = _to_list(self.network(*inputs))
                 loss = self._compute_loss(outputs, labels) \
                     if labels else None
+        return loss, outputs, labels
+
+    def eval_batch(self, inputs, labels=None):
+        loss, outputs, labels = self._eval_batch_device(inputs, labels)
         metrics = []
         for m in self._metrics:
             res = m.update(*_to_list(m.compute(outputs[0], *labels)))
@@ -254,13 +270,39 @@ class Model:
             if loss is not None else []
         return (lv, metrics)
 
-    def test_batch(self, inputs):
+    def _sync_eval(self, pending):
+        """Materialize deferred eval steps: ONE host sync point
+        (profiler event 'hapi/loss_sync' + sync step phase), metric
+        updates in step order. Returns the per-step loss values."""
+        from ..fluid import profiler
+
+        losses = []
+        with profiler.RecordEvent("hapi/loss_sync"):
+            import time as _time
+
+            t0 = _time.perf_counter()
+            for loss, outputs, labels in pending:
+                if outputs is not None:
+                    for m in self._metrics:
+                        m.update(*_to_list(
+                            m.compute(outputs[0], *labels)))
+                if loss is not None:
+                    losses.append(float(np.asarray(
+                        loss.numpy()).reshape(-1)[0]))
+            profiler.record_step_phase(
+                "sync", _time.perf_counter() - t0, t0)
+        return losses
+
+    def _test_batch_device(self, inputs):
         with self._dygraph_guard():
             self.network.eval()
             with dy_base.no_grad():
                 inputs = _as_variables(_to_list(inputs))
                 outputs = _to_list(self.network(*inputs))
-        return [o.numpy() for o in outputs]
+        return outputs
+
+    def test_batch(self, inputs):
+        return [o.numpy() for o in self._test_batch_device(inputs)]
 
     predict_batch = test_batch
 
@@ -305,25 +347,16 @@ class Model:
                 self.load(os.path.join(latest, "model"))
                 start_epoch = ckpt_mod.read_status(latest).next()
 
-        from ..utils.flags import get_flag
-
         # deferred fetches: keep per-step losses/metric inputs on device
         # and sync to host only every log_freq steps (+ epoch end), so
         # between logged steps the host never blocks the dispatch queue.
         # The computation is identical — only WHEN the host blocks moves
         # — so losses match the synchronous path bit for bit. Deferral
-        # engages only when every callback is a known built-in (they
-        # read logs at log_freq cadence); user callbacks may read logs
-        # every step through paths _DeferredLogs cannot intercept
-        # (dict(logs), json), so they get the synchronous contract.
-        from .callbacks import (
-            EarlyStopping, ModelCheckpoint, ProgBarLogger,
-        )
-
-        defer = bool(get_flag("FLAGS_tpu_deferred_fetch", True)) and \
-            all(isinstance(c, (ProgBarLogger, ModelCheckpoint,
-                               EarlyStopping))
-                for c in getattr(cbks, "callbacks", []))
+        # engages only under _defer_ok's built-in-callback gate (user
+        # callbacks may read logs every step through paths _DeferredLogs
+        # cannot intercept, e.g. dict(logs), so they keep the
+        # synchronous contract).
+        defer = self._defer_ok(cbks)
         self.stop_training = False
         cbks.on_train_begin({})
         history = []
@@ -383,25 +416,65 @@ class Model:
                 logs[n] = float(v)
         return logs
 
+    def _defer_ok(self, cbks):
+        """Deferred fetches engage only under the known built-in
+        callbacks (same contract as fit): they read logs at log_freq /
+        end-of-loop cadence, so batching the host syncs is invisible.
+        User callbacks keep the synchronous per-step contract."""
+        from ..utils.flags import get_flag
+
+        from .callbacks import (
+            EarlyStopping, ModelCheckpoint, ProgBarLogger,
+        )
+
+        return bool(get_flag("FLAGS_tpu_deferred_fetch", True)) and \
+            all(isinstance(c, (ProgBarLogger, ModelCheckpoint,
+                               EarlyStopping))
+                for c in getattr(cbks, "callbacks", []))
+
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None):
+        """Deferred-fetch eval (ROADMAP open item): per-step losses and
+        metric inputs stay device-resident and sync to host only every
+        `log_freq` steps (+ loop end), exactly like fit's train loop —
+        the computation is identical, only WHEN the host blocks moves,
+        so losses/metrics match the synchronous path bit for bit."""
         loader = self._make_loader(eval_data, batch_size, False, False,
                                    num_workers)
         cbks = callbacks if callbacks is not None else config_callbacks(
             None, model=self, steps=len(loader) if hasattr(
                 loader, "__len__") else None,
             log_freq=log_freq, verbose=verbose, mode="eval")
+        defer = self._defer_ok(cbks)
         for m in self._metrics:
             m.reset()
         cbks.on_eval_begin({})
         losses = []
+        pending = []
         for step, batch in enumerate(loader):
             cbks.on_eval_batch_begin(step, {})
             inputs, labels = self._split_batch(batch)
-            lv, _ = self.eval_batch(inputs, labels)
-            if lv:
-                losses.append(lv[0])
-            cbks.on_eval_batch_end(step, {"loss": lv})
+            if defer:
+                loss_t, outs, lbls = self._eval_batch_device(inputs,
+                                                             labels)
+                if not self._metrics:
+                    # no metric consumers: keep only the scalar loss
+                    # handle — buffering outputs/labels for log_freq
+                    # steps would pin HBM for nothing (same guard as
+                    # fit's train loop)
+                    outs = lbls = None
+                pending.append((loss_t, outs, lbls))
+                if (step + 1) % max(log_freq, 1) == 0:
+                    losses.extend(self._sync_eval(pending))
+                    del pending[:]
+                cbks.on_eval_batch_end(step, {"step": step})
+            else:
+                lv, _ = self.eval_batch(inputs, labels)
+                if lv:
+                    losses.append(lv[0])
+                cbks.on_eval_batch_end(step, {"loss": lv})
+        if pending:
+            losses.extend(self._sync_eval(pending))  # loop tail
         result = {}
         if losses:
             result["loss"] = [float(np.mean(losses))]
@@ -415,21 +488,47 @@ class Model:
 
     def predict(self, test_data, batch_size=1, num_workers=0,
                 stack_outputs=False, callbacks=None):
+        """Deferred-fetch predict: per-step outputs stay device-resident
+        and materialize in log_freq-sized windows (the fit default, 10),
+        so the dispatch queue never drains between steps; outputs are
+        identical to the synchronous path."""
         loader = self._make_loader(test_data, batch_size, False, False,
                                    num_workers)
         cbks = callbacks if callbacks is not None else config_callbacks(
             None, model=self, verbose=0, mode="predict")
+        defer = self._defer_ok(cbks)
         cbks.on_predict_begin({})
         outputs = None
+        pending = []
+
+        def flush():
+            from ..fluid import profiler
+
+            with profiler.RecordEvent("hapi/loss_sync"):
+                for outs in pending:
+                    for i, o in enumerate(outs):
+                        outputs[i].append(o.numpy())
+            del pending[:]
+
         for step, batch in enumerate(loader):
             cbks.on_predict_batch_begin(step, {})
             inputs, _ = self._split_batch(batch)
-            outs = self.test_batch(inputs)
-            if outputs is None:
-                outputs = [[] for _ in outs]
-            for i, o in enumerate(outs):
-                outputs[i].append(o)
+            if defer:
+                outs = self._test_batch_device(inputs)
+                if outputs is None:
+                    outputs = [[] for _ in outs]
+                pending.append(outs)
+                if (step + 1) % 10 == 0:  # fit's log_freq default
+                    flush()
+            else:
+                outs = self.test_batch(inputs)
+                if outputs is None:
+                    outputs = [[] for _ in outs]
+                for i, o in enumerate(outs):
+                    outputs[i].append(o)
             cbks.on_predict_batch_end(step, {})
+        if pending:
+            flush()
         cbks.on_predict_end({})
         if outputs is None:
             return []

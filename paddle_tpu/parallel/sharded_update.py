@@ -1,0 +1,717 @@
+"""Cross-replica sharded weight update (ZeRO-1 over ICI).
+
+"Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+Training" (Xu et al., 2020): a data-parallel step's update side is fully
+redundant — every replica allreduces whole gradients, then runs the
+identical optimizer step over the full parameter set with a full copy
+of the optimizer moments. The sharded form is bit-for-bit the same
+math with strictly less memory and communication:
+
+    reduce-scatter grads  ->  update the local 1/N shard of params +
+    moments               ->  all-gather the updated params.
+
+An allreduce IS reduce-scatter + all-gather, so moving the all-gather
+after the optimizer (and onto the params instead of the grads) costs no
+extra ICI bytes while the optimizer FLOPs and the moment/master-state
+HBM drop to 1/N per replica.
+
+Engages under `FLAGS_tpu_sharded_weight_update` (default on) for
+data-parallel programs lowered through `fluid/lowering._compile_dp`:
+
+- `plan_sharded_update` scans the post-backward section at program
+  granularity. If every optimizer op is a supported type and every op
+  touching an optimizer-bound gradient is shard-aware (clip, l2 decay,
+  global-norm plumbing, the fleet transpiler's c_allreduce_sum), it
+  returns a plan; anything unexpected returns None and the program
+  falls back to today's replicated update — never a wrong answer.
+- Values are sharded at FLAT-BUFFER granularity: each tensor is
+  flattened, zero-padded to a multiple of N, and each replica owns a
+  contiguous 1/N slice — uneven parameter sizes never fragment the
+  layout. `ShardVal` (a registered pytree) carries the local slice plus
+  the logical shape so shard-aware ops can slice replicated operands to
+  match.
+- Optimizer state (moments, velocities, ...) is sharded ACROSS steps:
+  `fluid/lowering._compile_dp` gives those state vars
+  `PartitionSpec(dp_axis)` in/out specs and the executor lays the scope
+  arrays out as `NamedSharding(mesh, P(dp))` flat buffers, so per-
+  replica optimizer HBM is ~1/N from the first step on.
+- Elementwise optimizers (sgd/momentum/adam/... and the fused_* group
+  kernels) run their REGISTERED compute on the flat shards unchanged —
+  elementwise updates are concat/split-stable. LAMB and LARS need their
+  trust-ratio/local-lr norms over the FULL parameter: those norms are
+  computed as a psum of local partial sums over the dp axis.
+- Global-norm gradient clipping (squared_l2_norm -> sum -> sqrt) and
+  clip_by_norm likewise psum their local partial sums, so clipping
+  matches the replicated path up to fp reduction order.
+
+Dygraph/eager path: there is no program to rewrite, but the same 1/N
+state win is available through GSPMD — `eager_accumulator_sharding`
+returns a `NamedSharding` that lays optimizer accumulators (and, via
+`DataParallel.apply_collective_grads`, gradients) out sharded over the
+global mesh; XLA partitions the eager update and inserts the
+all-gather where the replicated param is next needed.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, FrozenSet, Optional, Tuple
+
+import numpy as np
+
+_log = logging.getLogger("paddle_tpu.sharded_update")
+
+# Optimizer ops whose update math is purely elementwise over the
+# flattened group: running the registered compute on a contiguous flat
+# SHARD of every operand is exactly the shard of the full update.
+# (Per-parameter scalars — beta pows, LearningRate — stay replicated;
+# the generic numel<=1 rule below passes them through whole.)
+_ELEMENTWISE_OPT = frozenset({
+    "sgd", "momentum", "adam", "adamw", "adamax", "adagrad",
+    "decayed_adagrad", "adadelta", "rmsprop", "ftrl",
+    "fused_sgd", "fused_momentum", "fused_adam",
+})
+# Norm-coupled optimizers: the update needs ||param|| / ||update|| over
+# the FULL tensor — computed with a psum over shard-local partial sums.
+_NORM_OPT = frozenset({"lamb", "lars_momentum"})
+SUPPORTED_OPT = _ELEMENTWISE_OPT | _NORM_OPT
+
+# input slots that carry PARAM-SHAPED tensors and therefore live in
+# shard space inside the update (everything else — LearningRate, beta
+# pows, step counters — is replicated hyper-state, passed whole). Slot
+# identity, NOT tensor size, decides: a (1,)-element bias is still a
+# param whose grad arrives as a shard on every device, so its update
+# must run shard-wise and its output must gather — a size heuristic
+# would "replicate" it and apply the update on device 0 only.
+_TENSOR_IN_SLOTS = frozenset({
+    "Param", "Grad", "Velocity", "Moment", "Moment1", "Moment2",
+    "InfNorm", "AvgSquaredGrad", "AvgSquaredUpdate", "MeanSquare",
+    "MeanGrad", "SquaredAccumulator", "LinearAccumulator",
+})
+_TENSOR_OUT_SLOTS = frozenset({
+    "ParamOut", "VelocityOut", "MomentOut", "Moment1Out", "Moment2Out",
+    "InfNormOut", "AvgSquaredGradOut", "AvgSquaredUpdateOut",
+    "MeanSquareOut", "MeanGradOut", "SquaredAccumOut", "LinearAccumOut",
+})
+
+# param-shaped state slots per optimizer type: these become sharded
+# scope state (flat 1/N buffers per replica across steps).
+_OPT_STATE_SLOTS: Dict[str, Tuple[str, ...]] = {
+    "sgd": (), "fused_sgd": (),
+    "momentum": ("Velocity",), "fused_momentum": ("Velocity",),
+    "lars_momentum": ("Velocity",),
+    "adam": ("Moment1", "Moment2"), "adamw": ("Moment1", "Moment2"),
+    "lamb": ("Moment1", "Moment2"), "fused_adam": ("Moment1", "Moment2"),
+    "adamax": ("Moment", "InfNorm"),
+    "adagrad": ("Moment",), "decayed_adagrad": ("Moment",),
+    "adadelta": ("AvgSquaredGrad", "AvgSquaredUpdate"),
+    "rmsprop": ("MeanSquare", "Moment", "MeanGrad"),
+    "ftrl": ("SquaredAccumulator", "LinearAccumulator"),
+}
+
+# shard-aware non-optimizer ops (the post-backward vocabulary emitted by
+# clip.py / regularizer.py): elementwise ops run on the flat shards;
+# full reductions psum their local partials.
+_EW_UNARY = frozenset({"scale", "clip", "cast", "sign", "abs", "square",
+                       "sqrt"})
+_EW_BINARY = frozenset({"elementwise_add", "elementwise_sub",
+                        "elementwise_mul", "elementwise_div",
+                        "elementwise_max", "elementwise_min"})
+_NORM_REDUCE = frozenset({"squared_l2_norm"})
+
+
+class ShardVal:
+    """A value sharded at flat-buffer granularity: `vec` is this
+    replica's contiguous 1/N slice of the zero-padded flat buffer;
+    `shape` is the full logical shape. Registered as a jax pytree so it
+    flows through vjp aux / lax.cond untouched."""
+
+    __slots__ = ("vec", "shape")
+
+    def __init__(self, vec, shape):
+        self.vec = vec
+        self.shape = tuple(shape)
+
+    @property
+    def dtype(self):
+        return self.vec.dtype
+
+    def astype(self, dtype):
+        return ShardVal(self.vec.astype(dtype), self.shape)
+
+    def __repr__(self):
+        return "ShardVal(shape=%s, shard=%s, dtype=%s)" % (
+            self.shape, tuple(self.vec.shape), self.vec.dtype)
+
+
+def _register_pytree():
+    import jax
+
+    jax.tree_util.register_pytree_node(
+        ShardVal,
+        lambda sv: ((sv.vec,), sv.shape),
+        lambda shape, children: ShardVal(children[0], shape))
+
+
+_register_pytree()
+
+
+class ShardInfo:
+    """Static layout of one sharded state var."""
+
+    __slots__ = ("name", "shape", "dtype", "numel", "padded")
+
+    def __init__(self, name, shape, dtype, ndev):
+        self.name = name
+        self.shape = tuple(int(d) for d in shape)
+        self.dtype = np.dtype(dtype)
+        self.numel = int(np.prod(self.shape)) if self.shape else 1
+        self.padded = -(-self.numel // ndev) * ndev  # ceil to N
+
+    def unshard(self, value):
+        """Global (padded,) flat array -> logical-shape numpy array
+        (checkpoint/io save path)."""
+        arr = np.asarray(value)
+        if arr.shape == self.shape:
+            return arr
+        return arr.reshape(-1)[:self.numel].reshape(self.shape)
+
+
+class ShardedUpdatePlan:
+    __slots__ = ("axis", "ndev", "grad_names", "rs_targets",
+                 "sharded_state", "explicit_sync", "opt_op_ids")
+
+    def __init__(self, axis, ndev, grad_names, rs_targets, sharded_state,
+                 explicit_sync, opt_op_ids):
+        self.axis = axis
+        self.ndev = ndev
+        # grads reduce-scattered right at the vjp output (implicit DP)
+        self.grad_names: FrozenSet[str] = frozenset(grad_names)
+        # grads whose explicit c_allreduce_sum lowers to psum_scatter
+        self.rs_targets: FrozenSet[str] = frozenset(rs_targets)
+        self.sharded_state: Dict[str, ShardInfo] = dict(sharded_state)
+        self.explicit_sync = explicit_sync
+        self.opt_op_ids = frozenset(opt_op_ids)
+
+
+def enabled() -> bool:
+    from ..utils.flags import get_flag
+
+    return bool(get_flag("FLAGS_tpu_sharded_weight_update", True))
+
+
+# ---------------------------------------------------------------------------
+# planning
+# ---------------------------------------------------------------------------
+
+def plan_sharded_update(program, block, ndev, dp_axis) -> \
+        Optional[ShardedUpdatePlan]:
+    """Feasibility scan over the post-backward section. Returns a plan,
+    or None when the program must keep the replicated update (not
+    data-parallel / flag off / gradient merge / an unsupported op
+    touches an optimizer-bound gradient or a would-be-sharded state
+    var). Falling back is always safe — it is exactly today's path."""
+    from ..fluid import lowering
+
+    if not enabled() or ndev <= 1:
+        return None
+    ops = list(block.ops)
+    bwd_idx = None
+    for i, op in enumerate(ops):
+        if op.type == "backward":
+            bwd_idx = i
+            break
+    if bwd_idx is None:
+        return None
+    bop = ops[bwd_idx]
+    if bop.attrs.get("gradient_merge") is not None:
+        # gradient merge syncs ONCE per k steps on the merged grads and
+        # runs the whole post section under lax.cond; sharding inside
+        # that is future work (documented in parallel/README.md)
+        return None
+    post = ops[bwd_idx + 1:]
+
+    opt_ops = []
+    for op in post:
+        if "ParamOut" not in op.output_names:
+            continue
+        if op.type not in SUPPORTED_OPT:
+            _log.debug("sharded update declined: optimizer op %r is not "
+                       "shard-aware", op.type)
+            return None
+        opt_ops.append(op)
+    if not opt_ops:
+        return None
+
+    opt_grads = set()
+    for op in opt_ops:
+        gs = op.input_names.get("Grad", [])
+        if not gs:
+            return None
+        opt_grads.update(gs)
+
+    # explicit-sync detection must mirror lowering.build_block_fn: when
+    # the program carries its own grad allreduces, the vjp output is NOT
+    # pmean'd and the c_allreduce_sum op is the reduce-scatter point.
+    explicit = any(
+        (op.type.startswith("c_allreduce") or op.type == "allreduce")
+        and any(n.endswith("@GRAD") for n in op.input_arg_names)
+        for op in post)
+    rs_targets = set()
+    if explicit:
+        for op in post:
+            if op.type == "c_allreduce_sum" and \
+                    set(op.input_names.get("X", [])) & opt_grads:
+                xs = op.input_names["X"]
+                outs = op.output_names.get("Out", [])
+                if len(xs) != 1 or outs != xs:
+                    return None
+                rs_targets.add(xs[0])
+            elif (op.type.startswith("c_allreduce")
+                  or op.type == "allreduce") and \
+                    set(op.input_arg_names) & opt_grads:
+                return None  # non-sum reduction on an optimizer grad
+        if rs_targets != opt_grads:
+            # some optimizer grad is never allreduced: the program owns
+            # its sync and chose not to — don't invent one
+            return None
+
+    # candidate sharded state: param-shaped optimizer accumulators,
+    # owned by exactly one optimizer op
+    owner: Dict[str, object] = {}
+    sharded_state: Dict[str, ShardInfo] = {}
+    for op in opt_ops:
+        for slot in _OPT_STATE_SLOTS.get(op.type, ()):
+            for n in op.input_names.get(slot, []):
+                v = block._find_var_recursive(n)
+                shape = tuple(getattr(v, "shape", ()) or ())
+                if not shape or any(int(d) <= 0 for d in shape) or \
+                        int(np.prod(shape)) <= 1:
+                    continue  # scalar-ish state stays replicated
+                if n in owner and owner[n] is not op:
+                    owner[n] = None  # shared across opt ops: degrade
+                    continue
+                owner[n] = op
+                dtype = str(getattr(v, "dtype", "float32"))
+                sharded_state[n] = ShardInfo(n, shape, dtype, ndev)
+    # any touch of a candidate state var OUTSIDE its owning optimizer op
+    # (a forward reader, a fetch-side op, EMA/ModelAverage plumbing)
+    # degrades that var to replicated — correctness first
+    if sharded_state:
+        for op in ops:
+            reads, writes = lowering._op_reads_writes(op)
+            for n in set(reads) | set(writes):
+                if n in sharded_state and owner.get(n) is not op:
+                    del sharded_state[n]
+                    owner[n] = None
+    # taint walk: every op consuming a sharded gradient must be
+    # shard-aware, with outputs (un)tainted per the table below
+    tainted = set(opt_grads) if not explicit else set()
+    opt_ids = {id(op) for op in opt_ops}
+    for op in post:
+        reads, writes = lowering._op_reads_writes(op)
+        reads, writes = set(reads), set(writes)
+        if id(op) in opt_ids:
+            if not set(op.input_names.get("Grad", [])) <= tainted:
+                return None
+            tainted -= writes  # ParamOut/state outs leave shard space
+            continue
+        if op.type == "c_allreduce_sum" and \
+                set(op.input_names.get("X", [])) & rs_targets:
+            tainted |= set(op.output_names.get("Out", []))
+            continue
+        tin = reads & tainted
+        if not tin:
+            tainted -= writes  # full overwrite of a tainted name
+            continue
+        if op.type in _EW_BINARY:
+            # shard-space binary ops support same-shape or scalar
+            # operands only; a middle-axis broadcast (paddle `axis`
+            # attr with mismatched ranks) has no flat-shard analogue —
+            # decline the whole program rather than raise at trace
+            shapes = []
+            for slot in ("X", "Y"):
+                for n in op.input_names.get(slot, []):
+                    v = block._find_var_recursive(n)
+                    shp = tuple(getattr(v, "shape", ()) or ())
+                    if shp:
+                        shapes.append(int(np.prod(shp)))
+            if len(shapes) == 2 and shapes[0] != shapes[1] \
+                    and 1 not in shapes:
+                _log.debug("sharded update declined: broadcast "
+                           "%s over sharded grads", op.type)
+                return None
+        if op.type in _EW_UNARY or op.type in _EW_BINARY \
+                or op.type == "sum":
+            tainted |= writes  # elementwise: outputs stay sharded
+        elif op.type in _NORM_REDUCE or op.type == "clip_by_norm":
+            tainted -= writes
+            if op.type == "clip_by_norm":
+                tainted |= writes
+        else:
+            _log.debug("sharded update declined: op %r reads sharded "
+                       "grads %s", op.type, sorted(tin))
+            return None
+    return ShardedUpdatePlan(
+        dp_axis, ndev,
+        grad_names=(set() if explicit else opt_grads),
+        rs_targets=rs_targets, sharded_state=sharded_state,
+        explicit_sync=explicit, opt_op_ids=opt_ids)
+
+
+# ---------------------------------------------------------------------------
+# shard-space primitives (trace-time; run inside shard_map)
+# ---------------------------------------------------------------------------
+
+def _flat_pad(x, ndev):
+    import jax.numpy as jnp
+
+    v = jnp.reshape(x, (-1,))
+    padded = -(-v.shape[0] // ndev) * ndev
+    if padded != v.shape[0]:
+        v = jnp.pad(v, (0, padded - v.shape[0]))
+    return v
+
+
+def shard_slice(x_full, plan):
+    """This replica's contiguous slice of the padded flat buffer of a
+    REPLICATED full tensor (params entering the optimizer)."""
+    from jax import lax
+
+    vec = _flat_pad(x_full, plan.ndev)
+    size = vec.shape[0] // plan.ndev
+    idx = lax.axis_index(plan.axis)
+    return lax.dynamic_slice(vec, (idx * size,), (size,))
+
+
+def reduce_scatter_sum(g, plan):
+    """psum_scatter the padded flat gradient: each replica receives the
+    cross-replica SUM of its 1/N slice — half the ICI bytes of the
+    allreduce it replaces (the all-gather half moves to the params)."""
+    from jax import lax
+
+    vec = _flat_pad(g, plan.ndev)
+    return ShardVal(lax.psum_scatter(vec, plan.axis, tiled=True),
+                    tuple(g.shape))
+
+
+def reduce_scatter_mean(g, plan):
+    sv = reduce_scatter_sum(g, plan)
+    return ShardVal(sv.vec / plan.ndev, sv.shape)
+
+
+def gather_full(sv: ShardVal, plan):
+    """all_gather a ShardVal back to its replicated logical form (the
+    updated params; also any sharded value that is fetched)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    full = lax.all_gather(sv.vec, plan.axis, tiled=True)
+    numel = int(np.prod(sv.shape)) if sv.shape else 1
+    return jnp.reshape(full[:numel], sv.shape)
+
+
+def wrap_sharded_state(env, plan):
+    """Wrap incoming sharded state (raw (padded/N,) vecs from shard_map)
+    into ShardVals carrying their logical shapes."""
+    for n, info in plan.sharded_state.items():
+        v = env.get(n)
+        if v is not None and not isinstance(v, ShardVal):
+            env[n] = ShardVal(v, info.shape)
+
+
+def unwrap_out(name, v, plan):
+    """fn-exit normalization: sharded state leaves as its raw vec (the
+    shard_map out spec is P(dp)); any other ShardVal is gathered."""
+    if not isinstance(v, ShardVal):
+        return v
+    if name in plan.sharded_state:
+        return v.vec
+    return gather_full(v, plan)
+
+
+# ---------------------------------------------------------------------------
+# shard-aware op execution
+# ---------------------------------------------------------------------------
+
+def _psum(x, plan):
+    from jax import lax
+
+    return lax.psum(x, plan.axis)
+
+
+def _zero_pad_slots(vec, shape, plan):
+    """Re-zero this shard's padding slots. Elementwise ops with a
+    broadcast scalar operand (e.g. `grad + l2_tmp` on a tiny param, or
+    `clip(min=...)` with a positive floor) would otherwise write
+    nonzero values into the zero padding — and the padding feeds the
+    psum'd global-norm partial sums and persists in sharded state."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    numel = int(np.prod(shape)) if shape else 1
+    size = int(vec.shape[0])
+    if size * plan.ndev == numel:
+        return vec  # no padding anywhere
+    pos = lax.axis_index(plan.axis) * size + jnp.arange(size)
+    return jnp.where(pos < numel, vec, jnp.zeros_like(vec))
+
+
+def _operand(v, like_shape, plan):
+    """Align one operand with a sharded partner: ShardVal -> its vec;
+    scalars broadcast; a replicated tensor of the partner's logical
+    shape is sliced to the matching shard."""
+    import jax.numpy as jnp
+
+    if isinstance(v, ShardVal):
+        return v.vec
+    arr = jnp.asarray(v)
+    if arr.size <= 1:
+        return jnp.reshape(arr, ())
+    if tuple(arr.shape) == tuple(like_shape) or \
+            arr.size == int(np.prod(like_shape)):
+        return shard_slice(arr, plan)
+    raise RuntimeError(
+        "sharded update: operand of shape %s cannot align with sharded "
+        "value of logical shape %s" % (tuple(arr.shape), like_shape))
+
+
+def _exec_optimizer_op(op, env, plan, block):
+    from .. import ops as ops_lib
+
+    ins = {}
+    for slot, names in op.input_names.items():
+        if not names:
+            continue
+        vals = []
+        for n in names:
+            v = env[n]
+            if isinstance(v, ShardVal):
+                vals.append(v.vec)
+            elif slot in _TENSOR_IN_SLOTS:
+                vals.append(shard_slice(v, plan))
+            else:
+                vals.append(v)  # replicated hyper-state (lr, beta pows)
+        ins[slot] = vals
+    attrs = dict(op.attrs)
+    if op.type in _NORM_OPT:
+        outs = _sharded_norm_opt(op.type, ins, attrs, plan)
+    else:
+        outs = ops_lib.normalize_outs(
+            ops_lib.get_op(op.type).compute(ins, attrs))
+    for slot, names in op.output_names.items():
+        vals = outs.get(slot, [])
+        for n, v in zip(names, vals):
+            if slot not in _TENSOR_OUT_SLOTS:
+                env[n] = v  # replicated scalar state (beta pows, ...)
+                continue
+            if n in plan.sharded_state:
+                env[n] = ShardVal(v, plan.sharded_state[n].shape)
+                continue
+            # an updated param shard (or a degraded-to-replicated state
+            # var): all-gather back to the replicated logical form the
+            # next forward expects
+            var = block._find_var_recursive(n)
+            shape = tuple(getattr(var, "shape", ()) or ())
+            env[n] = gather_full(ShardVal(v, shape), plan)
+
+
+def _sharded_norm_opt(op_type, ins, attrs, plan):
+    """LAMB / LARS on flat shards: identical math to
+    ops/optimizer_ops.py, with the trust-ratio / local-lr norms psum'd
+    over the dp axis (zero padding contributes zero to every norm)."""
+    import jax.numpy as jnp
+
+    p, g = ins["Param"][0], ins["Grad"][0]
+    lr = jnp.reshape(ins["LearningRate"][0], ()).astype(jnp.float32)
+    if op_type == "lamb":
+        m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+        b1p_in, b2p_in = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+        b1p = jnp.reshape(b1p_in, ()).astype(jnp.float32)
+        b2p = jnp.reshape(b2p_in, ()).astype(jnp.float32)
+        b1 = attrs.get("beta1", 0.9)
+        b2 = attrs.get("beta2", 0.999)
+        eps = attrs.get("epsilon", 1e-6)
+        wd = attrs.get("weight_decay", 0.01)
+        gf = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        m1o = b1 * m1 + (1 - b1) * gf
+        m2o = b2 * m2 + (1 - b2) * jnp.square(gf)
+        m1hat = m1o / (1 - b1p * b1)
+        m2hat = m2o / (1 - b2p * b2)
+        r = m1hat / (jnp.sqrt(m2hat) + eps) + wd * pf
+        # FULL-tensor norms from shard-local partial sums — this psum is
+        # the mandatory LAMB trust-ratio exchange (one scalar per param)
+        p_sq = _psum(jnp.sum(jnp.square(pf)), plan)
+        r_sq = _psum(jnp.sum(jnp.square(r)), plan)
+        p_norm, r_norm = jnp.sqrt(p_sq), jnp.sqrt(r_sq)
+        trust = jnp.where((p_norm > 0) & (r_norm > 0),
+                          p_norm / r_norm, 1.0)
+        p_out = pf - lr * trust * r
+        return {"ParamOut": [p_out.astype(p.dtype)],
+                "Moment1Out": [m1o], "Moment2Out": [m2o],
+                "Beta1PowOut": [b1p_in * b1],
+                "Beta2PowOut": [b2p_in * b2]}
+    # lars_momentum
+    v = ins["Velocity"][0]
+    mu = attrs.get("mu", 0.9)
+    coeff = attrs.get("lars_coeff", 0.001)
+    wd = attrs.get("lars_weight_decay", 0.0005)
+    eps = attrs.get("epsilon", 0.0)
+    pf, gf = p.astype(jnp.float32), g.astype(jnp.float32)
+    p_norm = jnp.sqrt(_psum(jnp.sum(jnp.square(pf)), plan))
+    g_norm = jnp.sqrt(_psum(jnp.sum(jnp.square(gf)), plan))
+    local_lr = jnp.where(
+        (p_norm > 0) & (g_norm > 0),
+        lr * coeff * p_norm / (g_norm + wd * p_norm + eps), lr)
+    v_out = mu * v.astype(jnp.float32) + local_lr * (gf + wd * pf)
+    p_out = pf - v_out
+    return {"ParamOut": [p_out.astype(p.dtype)],
+            "VelocityOut": [v_out.astype(v.dtype)]}
+
+
+def exec_sharded_op(op, env, plan, block) -> bool:
+    """Execute `op` in shard space when it involves sharded values.
+    Returns False when the op has no sharded operands (caller runs the
+    normal interpreter)."""
+    import jax.numpy as jnp
+    from .. import ops as ops_lib
+
+    t = op.type
+    if id(op) in plan.opt_op_ids:
+        _exec_optimizer_op(op, env, plan, block)
+        return True
+    if t == "c_allreduce_sum":
+        xs = op.input_names.get("X", [])
+        if len(xs) == 1 and xs[0] in plan.rs_targets and \
+                not isinstance(env[xs[0]], ShardVal):
+            env[op.output_names["Out"][0]] = \
+                reduce_scatter_sum(env[xs[0]], plan)
+            return True
+        return False
+
+    in_vals = {slot: [env[n] for n in names]
+               for slot, names in op.input_names.items() if names}
+    sharded_ins = [v for vs in in_vals.values() for v in vs
+                   if isinstance(v, ShardVal)]
+    if not sharded_ins:
+        return False
+    shape = sharded_ins[0].shape
+
+    if t in _EW_UNARY:
+        vec = _operand(in_vals["X"][0], shape, plan)
+        out = ops_lib.normalize_outs(ops_lib.get_op(t).compute(
+            {"X": [vec]}, dict(op.attrs)))["Out"][0]
+        env[op.output_names["Out"][0]] = ShardVal(
+            _zero_pad_slots(out, shape, plan), shape)
+        return True
+    if t in _EW_BINARY:
+        xv = _operand(in_vals["X"][0], shape, plan)
+        yv = _operand(in_vals["Y"][0], shape, plan)
+        out = ops_lib.normalize_outs(ops_lib.get_op(t).compute(
+            {"X": [xv], "Y": [yv]}, dict(op.attrs)))["Out"][0]
+        env[op.output_names["Out"][0]] = ShardVal(
+            _zero_pad_slots(out, shape, plan), shape)
+        return True
+    if t == "sum":
+        vecs = [_operand(v, shape, plan) for v in in_vals["X"]]
+        out = vecs[0]
+        for v in vecs[1:]:
+            out = out + v
+        env[op.output_names["Out"][0]] = ShardVal(
+            _zero_pad_slots(out, shape, plan), shape)
+        return True
+    if t in _NORM_REDUCE:  # squared_l2_norm -> replicated (1,) scalar
+        vec = _operand(in_vals["X"][0], shape, plan)
+        sq = _psum(jnp.sum(jnp.square(vec.astype(jnp.float32))), plan)
+        env[op.output_names["Out"][0]] = jnp.reshape(sq, (1,))
+        return True
+    if t == "clip_by_norm":
+        vec = _operand(in_vals["X"][0], shape, plan)
+        max_norm = op.attrs.get("max_norm", 1.0)
+        sq = _psum(jnp.sum(jnp.square(vec.astype(jnp.float32))), plan)
+        norm = jnp.sqrt(sq)
+        scale = jnp.where(norm > max_norm, max_norm / norm, 1.0)
+        env[op.output_names["Out"][0]] = ShardVal(
+            vec * scale.astype(vec.dtype), shape)
+        return True
+    raise RuntimeError(
+        "sharded update: op %r reached execution with sharded operands "
+        "but no shard-aware rule — plan_sharded_update should have "
+        "declined this program" % t)
+
+
+def run_sharded_post_ops(post_ops, env, key0, base_idx, amp_lists, plan,
+                         block):
+    """The post-backward section in shard space: shard-aware ops run on
+    the flat 1/N slices; everything else (lr schedules, counters, ...)
+    runs through the normal interpreter on replicated values."""
+    from ..fluid import lowering
+
+    for i, op in enumerate(post_ops):
+        if exec_sharded_op(op, env, plan, block):
+            continue
+        lowering._exec_op(op, env, key0, base_idx + i,
+                          amp_lists=amp_lists)
+
+
+# ---------------------------------------------------------------------------
+# executor-side layout helpers (host side, outside shard_map)
+# ---------------------------------------------------------------------------
+
+def to_sharded_global(value, info: ShardInfo, mesh, axis):
+    """Lay one scope state array out as the sharded flat buffer the
+    compiled step expects: flatten, zero-pad to N*S, device_put with
+    NamedSharding(mesh, P(axis)). Called once per var (later steps see
+    the (padded,) shape and pass through)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    arr = np.asarray(value)
+    flat = arr.reshape(-1)
+    if flat.shape[0] != info.padded:
+        flat = np.pad(flat, (0, info.padded - flat.shape[0]))
+    return jax.device_put(flat, NamedSharding(mesh, P(axis)))
+
+
+def unshard_scope_value(program, name, value):
+    """io/checkpoint save path: if `name` is sharded optimizer state of
+    `program`, return its logical-shape numpy value; otherwise the value
+    unchanged. Keeps .pdparams/persistables files layout-stable whether
+    or not the sharded update was active."""
+    plan = getattr(program, "_shard_plan", None)
+    if plan is None:
+        return value
+    info = plan.sharded_state.get(name)
+    if info is None:
+        return value
+    return info.unshard(value)
+
+
+# ---------------------------------------------------------------------------
+# eager (dygraph) path: GSPMD layout hints
+# ---------------------------------------------------------------------------
+
+def eager_accumulator_sharding(shape):
+    """NamedSharding for a dygraph optimizer accumulator (or gradient)
+    of `shape`, sharding dim 0 over the global mesh's first axis — or
+    None when the flag is off, no mesh is active, or dim 0 does not
+    divide evenly (jax.device_put rejects uneven shardings — unlike
+    jit outputs — so indivisible tensors stay replicated; the static
+    path's flat-buffer padding does not apply to eager arrays). XLA
+    partitions the eager update against the sharded layout and
+    re-gathers params where a replicated consumer needs them."""
+    if not enabled():
+        return None
+    from . import env as penv
+
+    mesh = penv.global_mesh()
+    if mesh is None:
+        return None
+    axis = mesh.axis_names[0]
+    n = int(mesh.shape[axis])
+    if n <= 1 or not shape or int(shape[0]) < n \
+            or int(shape[0]) % n != 0:
+        return None
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(axis))
