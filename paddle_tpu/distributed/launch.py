@@ -21,7 +21,11 @@ exception):
     the latest intact checkpoint. PADDLE_RESTART_NUM carries the attempt
     number into the workers. Log files reopen in append mode across
     restarts so no attempt's output is lost;
-  - SIGINT and SIGTERM both tear the cohort down (exit 128+signum).
+  - SIGINT and SIGTERM both tear the cohort down (exit 128+signum);
+  - supervised workers default PADDLE_CKPT_AGREE=1: multi-host
+    checkpoint restore agrees cross-rank on the newest step EVERY rank
+    can read (allreduce-min), so a restarted cohort never diverges on
+    one rank's corrupt shard. Export PADDLE_CKPT_AGREE=0 to opt out.
 
 Usage: python -m paddle_tpu.distributed.launch --hosts h1:port,h2:port
        [--max_restarts N] train.py [args...]
@@ -63,17 +67,31 @@ def _parse_args(argv):
     return p.parse_args(argv)
 
 
+def _worker_env(endpoints, tid, restart_no, base_env=None):
+    """The PADDLE_* contract for one supervised worker. Cross-rank
+    checkpoint-step agreement (PADDLE_CKPT_AGREE, see
+    distributed/sharded_checkpoint.agree_newest_intact) is ON by
+    default for supervised cohorts — a restarted cohort must not let
+    one rank's corrupt newest shard silently diverge the replicas; the
+    protocol is fault-injection tested and a no-op for single-worker
+    cohorts (group_from_env returns None at world size 1). An explicit
+    PADDLE_CKPT_AGREE=0 in the launcher's environment is respected."""
+    env = dict(os.environ if base_env is None else base_env)
+    env.setdefault("PADDLE_CKPT_AGREE", "1")
+    env.update({
+        "PADDLE_TRAINER_ID": str(tid),
+        "PADDLE_CURRENT_ENDPOINT": endpoints[tid],
+        "PADDLE_TRAINERS_NUM": str(len(endpoints)),
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+        "PADDLE_RESTART_NUM": str(restart_no),
+    })
+    return env
+
+
 def _spawn_cohort(args, endpoints, local_ids, restart_no):
     procs, logs = [], []
     for tid in local_ids:
-        env = dict(os.environ)
-        env.update({
-            "PADDLE_TRAINER_ID": str(tid),
-            "PADDLE_CURRENT_ENDPOINT": endpoints[tid],
-            "PADDLE_TRAINERS_NUM": str(len(endpoints)),
-            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
-            "PADDLE_RESTART_NUM": str(restart_no),
-        })
+        env = _worker_env(endpoints, tid, restart_no)
         cmd = [sys.executable, "-u", args.training_script] \
             + args.training_script_args
         out = None

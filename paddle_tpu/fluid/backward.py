@@ -26,6 +26,25 @@ def _collect_forward_used_names(block, upto_idx):
     return used
 
 
+def _grad_topo_index(block, upto_idx, names):
+    """For each name, the index of the LAST forward op that reads it
+    (looking through control-flow sub-blocks). The vjp produces
+    gradients by walking the forward in reverse, so a var with a LARGER
+    last-use index gets its gradient EARLIER in the backward section —
+    this is the production order the bucketed gradient collectives
+    (parallel/sharded_update.plan_buckets) sort by, letting each
+    bucket's reduce-scatter issue while the rest of backward computes."""
+    from .lowering import _op_reads_writes
+
+    want = set(names)
+    last = {}
+    for i, op in enumerate(block.ops[:upto_idx]):
+        for n in _op_reads_writes(op)[0]:
+            if n in want:
+                last[n] = i
+    return last
+
+
 def append_backward(loss, parameter_list=None, no_grad_set=None,
                     callbacks=None, checkpoints=None):
     """Append the backward section for `loss`; returns [(param, grad)]."""
@@ -79,6 +98,10 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
         "diff_names": diff_names,
         "loss_scale": 1.0,
         "_is_backward": True,
+        # grad production order for bucketed collectives (see
+        # _grad_topo_index): larger index = grad materializes earlier
+        # in the backward sweep
+        "grad_topo": _grad_topo_index(block, upto, diff_names),
     }
     # recompute segments (reference backward.py:629): checkpoint names
     # recorded on the backward op; lowering splits the forward at each
@@ -114,5 +137,7 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
         type="backward", inputs={"Loss": [loss]},
         outputs={"Grad": [g.name for g in grads]},
         attrs={"loss_name": loss.name, "diff_names": diff_names,
-               "loss_scale": 1.0, "_is_backward": True})
+               "loss_scale": 1.0, "_is_backward": True,
+               "grad_topo": _grad_topo_index(block, len(block.ops),
+                                             diff_names)})
     return grads

@@ -760,7 +760,7 @@ class Executor:
         if got is None:
             return None
         entry, lowered, smut, favals = got
-        ma = lowered.compile().memory_analysis()
+        ma = self._aot_compile(entry, lowered, smut).memory_analysis()
 
         def nbytes(avals):
             return sum(int(np.prod(v.shape or (1,))) *
@@ -798,7 +798,44 @@ class Executor:
             out["opt_state_per_replica_bytes"] = sum(
                 (info.padded // ndev) * info.dtype.itemsize
                 for info in sharded.values())
+        plan = self._shard_plan_of(program)
+        if plan is not None and getattr(plan, "buckets", ()):
+            # bucketed grad exchange: the transient per-replica shard
+            # buffers are one per bucket — SUM over buckets (there is
+            # no single flat shard buffer whose scope var could be
+            # read), logical = the pre-scatter padded grads
+            out["grad_bucket_count"] = len(plan.buckets)
+            out["grad_bucket_logical_bytes"] = sum(
+                b.nbytes for b in plan.buckets)
+            out["grad_bucket_per_replica_bytes"] = sum(
+                b.shard_numel(ndev) * b.dtype.itemsize
+                for b in plan.buckets)
         return out
+
+    @staticmethod
+    def _aot_compile(entry, lowered, smut):
+        """AOT-compile once per cache entry: donation_report and
+        overlap_report both need the compiled artifact, and XLA does
+        not memoize Lowered.compile() — without this, every report
+        call recompiles the whole module. Keyed on the live state
+        avals: a checkpoint restore writes LOGICAL-shaped arrays back
+        into scope (the next step reconverts), so `lowered` can differ
+        from the memoized compile — recompile rather than hand back a
+        stale artifact."""
+        key = tuple(sorted((n, tuple(a.shape), str(a.dtype))
+                           for n, a in smut.items()))
+        if entry.aot_compiled is None or entry.aot_compiled[0] != key:
+            entry.aot_compiled = (key, lowered.compile())
+        return entry.aot_compiled[1]
+
+    @staticmethod
+    def _shard_plan_of(program):
+        program = program or framework.default_main_program()
+        from . import compiler
+
+        if isinstance(program, compiler.CompiledProgram):
+            program = program._unwrap()
+        return getattr(program, "_shard_plan", None)
 
     def collective_report(self, program=None, feed=None, fetch_list=None,
                           scope=None):
@@ -807,7 +844,11 @@ class Executor:
         all_reduce / reduce_scatter / all_gather ops and models ring
         ICI bytes — offline evidence that the sharded weight update
         actually halves the grad+param exchange (see
-        lowering.collective_byte_census). None when not jit-lowered."""
+        lowering.collective_byte_census). With bucketed collectives
+        (FLAGS_tpu_comm_bucket_mb > 0) the census also carries the
+        per-bucket byte breakdown — per-replica totals SUM the buckets
+        (there is no single flat shard buffer to read). None when not
+        jit-lowered."""
         got = self._cached_lowerable(program, feed, fetch_list, scope)
         if got is None:
             return None
@@ -816,7 +857,43 @@ class Executor:
         if entry.mesh is not None:
             ndev = int(np.prod([entry.mesh.shape[a]
                                 for a in entry.mesh.axis_names]))
-        return lowering.collective_byte_census(lowered.as_text(), ndev)
+        census = lowering.collective_byte_census(lowered.as_text(), ndev)
+        plan = self._shard_plan_of(program)
+        if plan is not None and getattr(plan, "buckets", ()):
+            # the cap the plan was built under, not the live flag (a
+            # flag change after compile must not contradict `buckets`)
+            census["bucket_cap_mb"] = getattr(
+                plan, "bucket_cap", 0) / float(1 << 20)
+            census["buckets"] = [{
+                "index": b.index,
+                "grads": len(b.entries),
+                "dtype": str(b.dtype),
+                "bytes": b.nbytes,
+                "shard_bytes": b.shard_numel(ndev) * b.dtype.itemsize,
+            } for b in plan.buckets]
+            census["bucket_bytes_total"] = sum(
+                b.nbytes for b in plan.buckets)
+        return census
+
+    def overlap_report(self, program=None, feed=None, fetch_list=None,
+                       scope=None):
+        """Collective/compute overlap audit of the cached executable's
+        OPTIMIZED (scheduled) HLO — can the grad reduce-scatters start
+        while backward compute is still outstanding, or are they fenced
+        at the end? See lowering.collective_overlap_audit for the
+        model; `tools/perf_analysis.py --overlap-audit` drives this on
+        the BERT-tiny program and bench.py emits it as "overlap". None
+        when not jit-lowered."""
+        got = self._cached_lowerable(program, feed, fetch_list, scope)
+        if got is None:
+            return None
+        entry, lowered, smut, _ = got
+        rep = lowering.collective_overlap_audit(
+            self._aot_compile(entry, lowered, smut).as_text())
+        plan = self._shard_plan_of(program)
+        if plan is not None:
+            rep["n_buckets"] = len(getattr(plan, "buckets", ()))
+        return rep
 
     def close(self):
         for comm in getattr(self, "_ps_comms", {}).values():

@@ -55,7 +55,8 @@ class LoweredFunction:
     __slots__ = ("jitted", "state_in_names", "state_out_names",
                  "state_mut_names", "state_ro_names",
                  "fetch_names", "feed_names", "mesh", "dp_axis",
-                 "auto_plan", "feed_donate", "sharded_state")
+                 "auto_plan", "feed_donate", "sharded_state",
+                 "aot_compiled")
 
     def __init__(self, jitted, feed_names, state_in_names, state_out_names,
                  state_mut_names, state_ro_names, fetch_names, mesh=None,
@@ -76,6 +77,10 @@ class LoweredFunction:
         # step keeps optimizer state sharded over the dp axis (ZeRO-1);
         # the executor lays those scope arrays out as flat 1/N buffers
         self.sharded_state = sharded_state
+        # memoized AOT-compiled artifact for the report surfaces
+        # (donation_report / overlap_report) — one XLA compile serves
+        # every audit of this executable instead of one per call
+        self.aot_compiled = None
 
 
 def _sub_block_idxs(op):
@@ -499,15 +504,27 @@ def _exec_switch_case(op, env, key0, op_idx, amp_lists):
 
 
 def _run_gradient_merge(ops, bwd_idx, gm, env, key0, amp_lists,
-                        sync_fn=None):
+                        sync_fn=None, shard_plan=None, block=None):
     """k-step gradient accumulation (reference: gradient_merge strategy,
     `framework/ir/multi_batch_merge_pass.cc` / fleet 2.0 GradientMerge
     meta-optimizer). Each step adds the fresh grads into persistable
     accumulators; the optimizer section runs under lax.cond only on every
     k-th step (with the averaged accumulated grads), then the
-    accumulators reset to zero. Off steps leave params/moments untouched."""
+    accumulators reset to zero. Off steps leave params/moments untouched.
+
+    With a `shard_plan` (ZeRO-1 + gradient merge), the once-per-k sync
+    on the MERGED grads is a (bucketed) reduce-scatter instead of an
+    allreduce, and the post section inside the cond runs on flat 1/N
+    shards — the merged-grad update path is sharded too. Sharded
+    optimizer state is a ShardVal on BOTH branches (skip passes the
+    incoming shard through), so the cond's pytrees agree; any other
+    shard-space value is gathered back to its replicated form before
+    leaving the branch."""
     import jax.numpy as jnp
     from jax import lax
+
+    if shard_plan is not None:
+        from ..parallel import sharded_update as _su
 
     k = int(gm["k_steps"])
     avg = bool(gm.get("avg", True))
@@ -533,18 +550,48 @@ def _run_gradient_merge(ops, bwd_idx, gm, env, key0, amp_lists,
 
     def apply_branch(_):
         e = dict(env)
-        for g, acc in acc_map.items():
-            merged = e[acc] / k if avg else e[acc]
-            if sync_fn is not None:
-                # implicit-DP sync on the merged grad: one allreduce
-                # per k steps (the predicate is counter-driven, so
-                # every shard takes this branch together)
-                merged = sync_fn(merged)
-            e[g] = merged.astype(e[g].dtype)
-        _run_ops(post_ops, e, key0, base_idx=bwd_idx + 1,
-                 amp_lists=amp_lists)
+        if shard_plan is not None:
+            # sharded merged-grad sync: reduce-scatter (per-bucket when
+            # FLAGS_tpu_comm_bucket_mb > 0) ONCE per k steps — the
+            # predicate is counter-driven, so every shard takes this
+            # branch together and the collectives stay uniform
+            gdict = {g: (e[acc] / k if avg else e[acc])
+                     for g, acc in acc_map.items()
+                     if g in shard_plan.grad_names}
+            scattered = _su.bucketed_reduce_scatter(
+                gdict, shard_plan, mean=True)
+            for g, acc in acc_map.items():
+                if g in scattered:
+                    e[g] = scattered[g].astype(e[g].dtype)
+                else:
+                    merged = e[acc] / k if avg else e[acc]
+                    if sync_fn is not None:
+                        merged = sync_fn(merged)
+                    e[g] = merged.astype(e[g].dtype)
+            _su.run_sharded_post_ops(post_ops, e, key0, bwd_idx + 1,
+                                     amp_lists, shard_plan, block)
+        else:
+            for g, acc in acc_map.items():
+                merged = e[acc] / k if avg else e[acc]
+                if sync_fn is not None:
+                    # implicit-DP sync on the merged grad: one allreduce
+                    # per k steps (the predicate is counter-driven, so
+                    # every shard takes this branch together)
+                    merged = sync_fn(merged)
+                e[g] = merged.astype(e[g].dtype)
+            _run_ops(post_ops, e, key0, base_idx=bwd_idx + 1,
+                     amp_lists=amp_lists)
         for acc in acc_map.values():
             e[acc] = jnp.zeros_like(e[acc])
+        if shard_plan is not None:
+            # branch-exit normalization: sharded state stays a ShardVal
+            # (the skip branch passes the incoming shard through, so
+            # pytrees agree); every other shard-space value gathers back
+            return tuple(
+                (_su.gather_full(e[n], shard_plan)
+                 if isinstance(e[n], _su.ShardVal)
+                 and n not in shard_plan.sharded_state else e[n])
+                for n in out_names)
         return tuple(e[n] for n in out_names)
 
     def skip_branch(_):
@@ -718,15 +765,36 @@ def build_block_fn(program, block, feed_names, fetch_names,
             gm = bop.attrs.get("gradient_merge")
             if gm is None:
                 if shard_plan is not None and _implicit_dp:
-                    # ZeRO-1: optimizer-bound grads are reduce-scattered
-                    # (pmean semantics -> /N); everything else keeps the
-                    # replicated pmean (e.g. a fetched grad)
-                    grads = {
-                        n: (_su.reduce_scatter_mean(g, shard_plan)
-                            if framework.grad_var_name(n)
-                            in shard_plan.grad_names
-                            else _dp_pmean(g))
-                        for n, g in grads.items()}
+                    if shard_plan.buckets:
+                        # bucketed, backward-ordered collectives
+                        # (FLAGS_tpu_comm_bucket_mb): one psum_scatter
+                        # per bucket, each depending only on its own
+                        # grads — XLA's latency-hiding scheduler can
+                        # start early buckets' ring transfers while the
+                        # rest of the backward still computes
+                        gnames = {n: framework.grad_var_name(n)
+                                  for n in grads}
+                        gdict = {gn: grads[n]
+                                 for n, gn in gnames.items()
+                                 if gn in shard_plan.grad_names}
+                        scattered = _su.bucketed_reduce_scatter(
+                            gdict, shard_plan, mean=True)
+                        grads = {
+                            n: (scattered[gn] if gn in scattered
+                                else _dp_pmean(grads[n]))
+                            for n, gn in gnames.items()}
+                    else:
+                        # ZeRO-1 per-variable collectives (the exact
+                        # FLAGS_tpu_comm_bucket_mb=0 lowering):
+                        # optimizer-bound grads reduce-scattered (pmean
+                        # semantics -> /N); everything else keeps the
+                        # replicated pmean (e.g. a fetched grad)
+                        grads = {
+                            n: (_su.reduce_scatter_mean(g, shard_plan)
+                                if framework.grad_var_name(n)
+                                in shard_plan.grad_names
+                                else _dp_pmean(g))
+                            for n, g in grads.items()}
                 else:
                     grads = {n: _dp_pmean(g) for n, g in grads.items()}
             # under gradient merge, sync once on the MERGED grads at the
@@ -747,7 +815,8 @@ def build_block_fn(program, block, feed_names, fetch_names,
                              base_idx=bwd_idx + 1, amp_lists=amp_lists)
             else:
                 _run_gradient_merge(ops, bwd_idx, gm, env, key0,
-                                    amp_lists, sync_fn=_dp_pmean)
+                                    amp_lists, sync_fn=_dp_pmean,
+                                    shard_plan=shard_plan, block=block)
 
         fetches = []
         for n in fetch_names:
@@ -1064,6 +1133,190 @@ def collective_byte_census(stablehlo_text, ndev=1):
         v["tensor_bytes"] for v in out.values() if isinstance(v, dict))
     out["ndev"] = ndev
     return out
+
+
+# -- collective/compute overlap audit (offline scheduling evidence) ---------
+
+# opcodes that are pure data movement / bookkeeping: never "backward
+# compute" even when they carry vjp metadata
+_NONCOMPUTE_OPCODES = frozenset({
+    "parameter", "constant", "iota", "tuple", "get-tuple-element",
+    "bitcast", "copy", "copy-start", "copy-done", "reshape", "transpose",
+    "broadcast", "slice", "dynamic-slice", "dynamic-update-slice",
+    "concatenate", "pad", "convert", "partition-id", "replica-id",
+    "after-all", "opt-barrier", "all-reduce", "all-reduce-start",
+    "all-reduce-done", "reduce-scatter", "reduce-scatter-start",
+    "reduce-scatter-done", "all-gather", "all-gather-start",
+    "all-gather-done", "all-to-all", "collective-permute",
+    "collective-permute-start", "collective-permute-done",
+})
+
+_AUDIT_COLLECTIVES = ("reduce-scatter", "all-reduce", "all-gather")
+
+_HLO_SHAPE_RE = None
+
+# optimized-HLO dtype spellings (s32/u32/pred — NOT the StableHLO
+# i32/ui32/i1 of _DTYPE_BYTES, which parses lowered-but-unoptimized
+# module text)
+_HLO_DTYPE_BYTES = {"f64": 8, "f32": 4, "f16": 2, "bf16": 2,
+                    "f8e4m3fn": 1, "f8e5m2": 1,
+                    "s64": 8, "u64": 8, "s32": 4, "u32": 4, "s16": 2,
+                    "u16": 2, "s8": 1, "u8": 1, "pred": 1}
+
+
+def _hlo_result_bytes(result_type):
+    """bytes of an HLO instruction's result-type text — SUMS every
+    `dt[d1,d2,...]` shape so tuple results (async `-start` ops,
+    combiner-merged multi-operand collectives) count whole, not just
+    their first element (0 if unparsable)."""
+    global _HLO_SHAPE_RE
+    import re
+
+    if _HLO_SHAPE_RE is None:
+        _HLO_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+    total = 0
+    for m in _HLO_SHAPE_RE.finditer(result_type):
+        size = _HLO_DTYPE_BYTES.get(m.group(1))
+        if size is None:
+            continue
+        n = 1
+        for d in m.group(2).split(","):
+            if d:
+                n *= int(d)
+        total += n * size
+    return total
+
+
+def _parse_hlo_module(optimized_hlo):
+    """One pass over an optimized HLO dump. Returns (entry, regions):
+    `entry` is the ENTRY computation as an ordered list of (name,
+    opcode, operand_names, metadata_op_name, result_bytes) — with
+    `is_scheduled=true` (every compiled module) the textual order IS
+    the schedule; `regions` lists collectives living in NON-entry
+    computations (lax.cond / while bodies — gradient merge traces its
+    bucketed merged-grad scatters inside the HLO conditional's branch
+    computation), fenced by construction: a conditional executes as
+    one unit in the entry schedule, so nothing inside it can overlap
+    entry backward compute — but the audit must still SEE them rather
+    than report 'no collectives' for the gm-sharded path."""
+    import re
+
+    name_re = re.compile(r"^\s+(?:ROOT\s+)?%([^\s=]+)\s*=\s*(.*)$")
+    opcode_re = re.compile(r"([a-z][a-z0-9\-]*)\(")
+    opname_re = re.compile(r'op_name="([^"]*)"')
+    entry, regions = [], []
+    comp = None  # None = between computations; "" = ENTRY
+    for line in optimized_hlo.splitlines():
+        if line.startswith("ENTRY "):
+            comp = ""
+            continue
+        if line.startswith("%"):  # non-entry computation header
+            comp = line.split(" ", 1)[0].lstrip("%")
+            continue
+        if line.startswith("}"):
+            comp = None
+            continue
+        if comp is None:
+            continue
+        m = name_re.match(line)
+        if m is None:
+            continue
+        name, rhs = m.group(1), m.group(2)
+        om = opcode_re.search(rhs)
+        if om is None:
+            continue
+        opcode = om.group(1)
+        # the result type is everything before the opcode name; operand
+        # references appear after its open paren (computation refs like
+        # to_apply=%region also match but never resolve to entry names)
+        nbytes = _hlo_result_bytes(rhs[:om.start()])
+        if comp == "":
+            operands = re.findall(r"%([\w.\-]+)", rhs[om.end():])
+            nm = opname_re.search(rhs)
+            entry.append((name, opcode, operands,
+                          nm.group(1) if nm else "", nbytes))
+        else:
+            kind = opcode[:-6] if opcode.endswith("-start") else opcode
+            if kind in _AUDIT_COLLECTIVES:
+                regions.append({"kind": kind, "name": name,
+                                "computation": comp, "bytes": nbytes})
+    return entry, regions
+
+
+def _is_backward_opname(op_name):
+    """vjp-generated ops: jax scopes the transpose of the forward trace
+    as ".../transpose(jvp(f))/..." (sub-jits) or a bare ".../transpose"
+    path component (inline primitives like the dot_general grads)."""
+    if "transpose(" in op_name:
+        return True
+    return any(part == "transpose" for part in op_name.split("/"))
+
+
+def collective_overlap_audit(optimized_hlo):
+    """Scheduling audit over an optimized (scheduled) HLO dump: can the
+    grad collectives overlap backward compute, or are they fenced at
+    the end of the backward pass?
+
+    For every reduce-scatter / all-reduce / all-gather in the entry
+    schedule, `ready` is the dataflow-ready position (max schedule
+    position of its operands) — the earliest point the transfer could
+    start — and `backward_after` counts backward-compute instructions
+    (vjp-metadata ops that are not pure data movement) scheduled after
+    it: the compute a latency-hiding scheduler can run DURING the
+    transfer. `combined` models XLA's collective combiner merging all
+    same-kind collectives into one (what the per-variable lowering
+    degenerates to on real ICI without
+    --xla_*_combine_threshold_bytes): its ready position is the max
+    over members, so the single-buffer exchange shows backward_after=0
+    — nothing left to hide behind. The bucketed lowering
+    (FLAGS_tpu_comm_bucket_mb > 0) is the point of this audit: early
+    buckets' reduce-scatters must show backward_after > 0."""
+    instrs, region_collectives = _parse_hlo_module(optimized_hlo)
+    pos = {name: i for i, (name, _, _, _, _) in enumerate(instrs)}
+    backward = [i for i, (_, opc, _, op_name, _) in enumerate(instrs)
+                if op_name and _is_backward_opname(op_name)
+                and opc not in _NONCOMPUTE_OPCODES]
+    final_backward = max(backward) if backward else -1
+    collectives = []
+    for i, (name, opc, operands, _, nbytes) in enumerate(instrs):
+        kind = opc[:-6] if opc.endswith("-start") else opc
+        if kind not in _AUDIT_COLLECTIVES:
+            continue
+        ready = max([pos[o] for o in operands if o in pos] or [-1])
+        after = sum(1 for b in backward if b > ready)
+        collectives.append({
+            "kind": kind, "name": name, "pos": i, "ready": ready,
+            "backward_after": after, "bytes": nbytes,
+            "starts_before_final_backward": ready < final_backward,
+        })
+    combined = {}
+    for kind in _AUDIT_COLLECTIVES:
+        members = [c for c in collectives if c["kind"] == kind]
+        if not members:
+            continue
+        ready = max(c["ready"] for c in members)
+        combined[kind] = {
+            "count": len(members),
+            "ready": ready,
+            "backward_after": sum(1 for b in backward if b > ready),
+            "bytes": sum(c["bytes"] for c in members),
+        }
+    return {
+        "is_scheduled": "is_scheduled=true" in
+                        optimized_hlo[:optimized_hlo.find("\n")],
+        "n_instructions": len(instrs),
+        "n_backward_compute": len(backward),
+        "final_backward_pos": final_backward,
+        "collectives": collectives,
+        "overlappable_reduce_scatters": sum(
+            1 for c in collectives
+            if c["kind"] == "reduce-scatter" and c["backward_after"] > 0),
+        "combined": combined,
+        # collectives inside cond/while region computations (gradient
+        # merge): fenced by construction — a conditional executes as
+        # one unit, nothing inside can overlap the entry schedule
+        "region_collectives": region_collectives,
+    }
 
 
 def _compile_dp(fn, mesh, dp_axis, program, block, feed_names, fetch_names,

@@ -46,7 +46,14 @@ def _losses(out):
             if line.startswith("LOSS")]
 
 
-@pytest.mark.parametrize("mode", ["sync", "async", "geo", "half_async"])
+@pytest.mark.parametrize("mode", [
+    "sync", "async",
+    # geo / half_async exercise alternate push schedules over the same
+    # PS wire protocol; ~20s each, so they ride in the slow lane to
+    # keep the default run inside the tier-1 budget (sync + async stay)
+    pytest.param("geo", marks=pytest.mark.slow),
+    pytest.param("half_async", marks=pytest.mark.slow),
+])
 def test_ps_2x2_localhost(mode):
     eps = "127.0.0.1:%d,127.0.0.1:%d" % (_free_port(), _free_port())
     ep_list = eps.split(",")

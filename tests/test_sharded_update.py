@@ -320,15 +320,16 @@ def test_single_element_param_stays_replica_consistent():
 
 
 def test_unsupported_program_falls_back():
-    """A post-backward op the planner can't shard (here: gradient
-    merge) keeps the replicated update rather than failing."""
+    """An optimizer op the planner can't shard (dpsgd: per-element rng
+    noise has no flat-shard rule) keeps the replicated update rather
+    than failing. (Gradient merge — the old exemplar here — is now
+    planned and sharded: tests/test_comm_overlap.py.)"""
     _fresh()
     set_flags({"FLAGS_tpu_sharded_weight_update": True})
     x, y = _batch()
     with framework.unique_name_guard():
         loss = _mlp_loss()
-        opt = O.GradientMergeOptimizer(
-            O.SGDOptimizer(learning_rate=0.1), k_steps=2)
+        opt = O.DpsgdOptimizer(learning_rate=0.1)
         opt.minimize(loss)
         prog = fluid.default_main_program()
         fluid.CompiledProgram(prog).with_data_parallel(

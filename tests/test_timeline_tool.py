@@ -71,6 +71,8 @@ def test_merge_accepts_bare_array_traces():
     assert {e["pid"] for e in xs} == {0, 1000}
 
 
+@pytest.mark.slow  # ~14s (spins the real profiler twice); the pure
+# merge logic above covers the default run
 def test_cli_merges_real_profiler_output(tmp_path):
     """End to end: two profiler-written traces -> one merged file."""
     from paddle_tpu.fluid import profiler as prof

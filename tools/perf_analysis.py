@@ -14,6 +14,7 @@ PERF_ANALYSIS_r4.md with:
 
 Usage: python tools/perf_analysis.py [--batches 256,512]
        python tools/perf_analysis.py --sharded-diff
+       python tools/perf_analysis.py --overlap-audit [--bucket-mb 0.25]
 
 `--sharded-diff` is the offline check for the ZeRO-1 sharded weight
 update (FLAGS_tpu_sharded_weight_update): it lowers the SAME
@@ -24,6 +25,20 @@ ICI bytes ~halve and the optimizer state ~1/N, and writes
 artifacts/sharded_update_diff.json — the no-chip evidence the
 acceptance criteria call for. Exits nonzero when the reduction does
 not hold.
+
+`--overlap-audit` is the offline scheduling check for the bucketed,
+backward-ordered grad collectives (FLAGS_tpu_comm_bucket_mb): it
+compiles the SAME data-parallel BERT-tiny train step with bucketing on
+(--bucket-mb, default 0.25 MB for the tiny model) and off (cap 0: the
+per-variable single-exchange lowering), parses the OPTIMIZED scheduled
+HLO (lowering.collective_overlap_audit), and asserts that >= 2 bucket
+reduce-scatters have their dataflow-ready point BEFORE the final
+backward compute op (transfer can overlap the remaining backward)
+while the cap=0 lowering, under the collective-combiner model that
+governs real-ICI behavior, has NOTHING schedulable after its combined
+exchange (backward_after == 0 — the fully exposed collective gap this
+PR closes). Writes artifacts/overlap_audit.json; exits nonzero when
+the overlap is not there.
 """
 from __future__ import annotations
 
@@ -33,7 +48,7 @@ import sys
 import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
-if "--sharded-diff" in sys.argv and \
+if ("--sharded-diff" in sys.argv or "--overlap-audit" in sys.argv) and \
         "xla_force_host_platform_device_count" not in \
         os.environ.get("XLA_FLAGS", ""):
     # the diff needs a multi-device mesh; must be set pre-jax-import
@@ -240,38 +255,11 @@ def sharded_update_diff(batch=16, seq_len=32):
     sharded form shows the expected reductions, 1 otherwise."""
     import json
 
-    import paddle_tpu.fluid as fluid
-    from paddle_tpu.core import scope as scope_mod
-    from paddle_tpu.fluid import framework
-    from paddle_tpu.models import bert
-    from paddle_tpu.utils.flags import set_flags
-    from __graft_entry__ import _bert_feed
-
-    cfg = bert.BertConfig.tiny()
-
     def one(flag):
-        framework.switch_main_program(framework.Program())
-        framework.switch_startup_program(framework.Program())
-        scope_mod._global_scope = scope_mod.Scope()
-        set_flags({"FLAGS_tpu_sharded_weight_update": flag})
-        with framework.unique_name_guard():
-            framework.default_main_program().random_seed = 7
-            framework.default_startup_program().random_seed = 7
-            total, _, _, _ = bert.bert_pretrain_loss(
-                cfg, seq_len, is_test=False)
-            fluid.optimizer.AdamOptimizer(
-                learning_rate=1e-3).minimize(total)
-            prog = fluid.default_main_program()
-            fluid.CompiledProgram(prog).with_data_parallel(
-                loss_name=total.name)
-            exe = fluid.Executor(fluid.TPUPlace())
-            exe.run(fluid.default_startup_program())
-            feed = _bert_feed(cfg, batch, seq_len)
-            exe.run(prog, feed=feed, fetch_list=[total])
-            col = exe.collective_report(prog, feed=feed,
-                                        fetch_list=[total])
-            don = exe.donation_report(prog, feed=feed,
-                                      fetch_list=[total])
+        exe, prog, feed, total = _bert_tiny_step(
+            batch, seq_len, {"FLAGS_tpu_sharded_weight_update": flag})
+        col = exe.collective_report(prog, feed=feed, fetch_list=[total])
+        don = exe.donation_report(prog, feed=feed, fetch_list=[total])
         return col, don
 
     col_off, don_off = one(False)
@@ -312,12 +300,107 @@ def sharded_update_diff(batch=16, seq_len=32):
     return 0 if ok else 1
 
 
+def _bert_tiny_step(batch, seq_len, flags):
+    """One compiled data-parallel BERT-tiny Adam step under `flags`;
+    returns the serving Executor + program + feed (for the report
+    APIs). Fresh programs/scope per call so flag changes recompile."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.core import scope as scope_mod
+    from paddle_tpu.fluid import framework
+    from paddle_tpu.models import bert
+    from paddle_tpu.utils.flags import set_flags
+    from __graft_entry__ import _bert_feed
+
+    cfg = bert.BertConfig.tiny()
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    scope_mod._global_scope = scope_mod.Scope()
+    set_flags(flags)
+    with framework.unique_name_guard():
+        framework.default_main_program().random_seed = 7
+        framework.default_startup_program().random_seed = 7
+        total, _, _, _ = bert.bert_pretrain_loss(
+            cfg, seq_len, is_test=False)
+        fluid.optimizer.AdamOptimizer(
+            learning_rate=1e-3).minimize(total)
+        prog = fluid.default_main_program()
+        fluid.CompiledProgram(prog).with_data_parallel(
+            loss_name=total.name)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(fluid.default_startup_program())
+        feed = _bert_feed(cfg, batch, seq_len)
+        exe.run(prog, feed=feed, fetch_list=[total])
+    return exe, prog, feed, total
+
+
+def overlap_audit(bucket_mb=0.25, batch=16, seq_len=32):
+    """Compile the DP BERT-tiny step bucketed (bucket_mb) and
+    single-exchange (cap 0); audit the optimized HLO schedules; write
+    artifacts/overlap_audit.json. Returns 0 when >= 2 bucket
+    reduce-scatters can overlap backward compute AND the cap=0 lowering
+    has zero overlap under the collective-combiner model, 1 otherwise."""
+    import json
+
+    def one(mb):
+        exe, prog, feed, total = _bert_tiny_step(
+            batch, seq_len,
+            {"FLAGS_tpu_sharded_weight_update": True,
+             "FLAGS_tpu_comm_bucket_mb": mb})
+        rep = exe.overlap_report(prog, feed=feed, fetch_list=[total])
+        col = exe.collective_report(prog, feed=feed, fetch_list=[total])
+        return rep, col
+
+    rep_b, col_b = one(bucket_mb)
+    rep_0, col_0 = one(0.0)
+    rs_combined0 = rep_0["combined"].get("reduce-scatter", {})
+    out = {
+        "model": "bert-tiny b%d s%d" % (batch, seq_len),
+        "bucket_mb": bucket_mb,
+        "bucketed": {"overlap": rep_b, "collectives": col_b},
+        "single_exchange": {"overlap": rep_0, "collectives": col_0},
+    }
+    path = os.path.join(_REPO, "artifacts", "overlap_audit.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2, sort_keys=True)
+    n_over = rep_b["overlappable_reduce_scatters"]
+    ok = (n_over >= 2
+          and rep_b.get("n_buckets", 0) >= 2
+          and rep_b["is_scheduled"]
+          and rs_combined0.get("backward_after", -1) == 0)
+    rs_list = [c for c in rep_b["collectives"]
+               if c["kind"] == "reduce-scatter"]
+    print("overlap audit (%s): %d buckets -> %d/%d reduce-scatters "
+          "ready before the final backward op (backward ops left to "
+          "hide behind: %s); cap=0 combined exchange has %d backward "
+          "ops after it; %s; wrote %s"
+          % (out["model"], rep_b.get("n_buckets", 0), n_over,
+             len(rs_list),
+             [c["backward_after"] for c in rs_list],
+             rs_combined0.get("backward_after", -1),
+             "OK" if ok else "OVERLAP NOT MET", path))
+    return 0 if ok else 1
+
+
 def main():
     batches = [256, 512]
     resnet_batches = [128, 256]
     args = sys.argv[1:]
     if "--sharded-diff" in args:
         raise SystemExit(sharded_update_diff())
+    if "--overlap-audit" in args:
+        mb = 0.25
+        for i, a in enumerate(args):
+            if not a.startswith("--bucket-mb"):
+                continue
+            val = (a.split("=", 1)[1] if "=" in a
+                   else args[i + 1] if i + 1 < len(args) else "")
+            try:
+                mb = float(val)
+            except ValueError:
+                raise SystemExit(
+                    "usage: --bucket-mb <float MB> (got %r)" % (val,))
+        raise SystemExit(overlap_audit(bucket_mb=mb))
     i = 0
     while i < len(args):
         a = args[i]
