@@ -707,6 +707,30 @@ def _enable_compile_cache():
         pass
 
 
+def _attach_static_checks(result, program):
+    """tpu-lint summary of the program that just ran (paddle_tpu/
+    analysis): zero errors is the standing claim — any benched program
+    whose collective schedule / donation contract / hot-loop hygiene /
+    shard plan regresses shows up here alongside "overlap" and
+    "collectives" in the round artifact. Evidence, not gating."""
+    try:
+        from paddle_tpu import analysis
+
+        findings = analysis.run_static_checks(program)
+        s = analysis.summarize(findings)
+        result["static_checks"] = {
+            "errors": s["errors"],
+            "warnings": s["warnings"],
+            "by_checker": s["by_checker"],
+            # cap the embedded detail; the CLI writes the full report
+            "findings": s["findings"][:20],
+        }
+        print("BENCH static checks: %d error(s), %d warning(s)"
+              % (s["errors"], s["warnings"]), flush=True)
+    except Exception as e:  # noqa: BLE001 - evidence, not gating
+        print("BENCH static checks failed: %r" % (e,), flush=True)
+
+
 def _attach_collectives(result, exe, program, feed, fetch_list):
     """Per-collective byte census of the step that just ran (lowered
     StableHLO; Executor.collective_report) — offline ICI evidence for
@@ -901,6 +925,7 @@ def _bench_child(platform: str, batch: int, steps: int, warmup: int,
         "phases": phases,
     }
     _attach_collectives(result, exe, main_p, feed, [total])
+    _attach_static_checks(result, main_p)
     if model != "longctx":
         # no V100 baseline exists for the seq-4096 config (a 32 GB V100
         # cannot hold the unfused step) — longctx reports absolute
@@ -1065,6 +1090,7 @@ def _bench_resnet(batch: int, steps: int, warmup: int,
         "phases": phases,
     }
     _attach_collectives(result, exe, main_p, feed, [loss])
+    _attach_static_checks(result, main_p)
     if platform == "tpu":
         result["mfu_pct"] = round(
             100.0 * 3 * 4.1e9 * imgs_per_sec / TPU_PEAK_BF16_FLOPS, 2)

@@ -1,0 +1,103 @@
+"""tpu-lint: static SPMD program verification over the fluid IR + HLO.
+
+Every failure class the runtime guards against dynamically — a
+rank-divergent collective schedule that hangs the pod mid-run, a
+donated buffer read after aliasing, a host sync serializing the async
+step pipeline, a sharding plan whose padding leaks into optimizer state
+— is detectable STATICALLY from the Program IR (and, for collectives,
+the lowered StableHLO), before a single chip cycle is spent. On-chip
+validation windows are scarce; these checkers turn "hangs 40 minutes
+into a tunnel session" into "fails in CI in 4 seconds".
+
+Five checkers (see README.md in this directory for the full catalog):
+
+1. ``collective-divergence`` — per-rank programs (and branch regions)
+   must emit identical collective schedules (collectives.py).
+2. ``donation-safety`` — no op holds a feed/state buffer past its
+   donated in-place rebind (donation.py).
+3. ``host-sync`` — fetch/RPC/host-callback ops inside while/scan
+   bodies defeat the async pipeline (host_sync.py).
+4. ``zero1-invariants`` — shard-plan padding zeroing, bucket dtype
+   homogeneity, checkpoint save/restore layout (sharding.py).
+5. ``dtype-contract`` — declared vs computed out dtype/shape, silent
+   fp64 promotions (contracts.py).
+
+Surfaces: ``tools/tpu_lint.py`` (CLI, JSON artifact, --fail-on),
+``FLAGS_tpu_static_checks={off,warn,error}`` (Executor compile-time
+hook), and ``bench.py``'s ``"static_checks"`` summary block.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Optional
+
+from .findings import (Finding, SEVERITIES, format_finding,  # noqa: F401
+                       sort_findings, summarize, worst_severity)
+from .collectives import (IR_COLLECTIVE_OPS,  # noqa: F401
+                          check_branch_uniformity,
+                          check_collective_divergence,
+                          check_hlo_divergence, collective_schedule,
+                          hlo_collective_schedule)
+from .donation import (check_donation_safety,  # noqa: F401
+                       cross_check_donation_report)
+from .host_sync import check_host_sync  # noqa: F401
+from .sharding import check_shard_plan  # noqa: F401
+from .contracts import check_dtype_shape_contracts  # noqa: F401
+
+__all__ = [
+    "Finding", "SEVERITIES", "CHECKERS", "format_finding",
+    "sort_findings", "summarize", "worst_severity",
+    "IR_COLLECTIVE_OPS", "collective_schedule",
+    "check_branch_uniformity", "check_collective_divergence",
+    "hlo_collective_schedule", "check_hlo_divergence",
+    "check_donation_safety", "cross_check_donation_report",
+    "check_host_sync", "check_shard_plan",
+    "check_dtype_shape_contracts", "run_static_checks",
+]
+
+#: checker registry: name -> "does it run in the single-program pass"
+CHECKERS = ("collective-divergence", "donation-safety", "host-sync",
+            "zero1-invariants", "dtype-contract")
+
+
+def run_static_checks(program, feed_names=None, fetch_names=None,
+                      checkers: Optional[Iterable[str]] = None,
+                      rank_programs=None, rank_labels=None,
+                      donation_report=None) -> List[Finding]:
+    """Run the selected checkers over one program (plus, when
+    ``rank_programs`` is given, the cross-rank collective-divergence
+    pass over the whole set). Returns severity-sorted findings.
+
+    ``donation_report``: an ``Executor.donation_report`` dict of the
+    same program, reconciled against the static donation verdict.
+    """
+    sel = set(checkers) if checkers is not None else set(CHECKERS)
+    unknown = sel - set(CHECKERS)
+    if unknown:
+        raise ValueError("unknown checker(s) %s; have %s"
+                         % (sorted(unknown), list(CHECKERS)))
+    findings: List[Finding] = []
+    if "collective-divergence" in sel:
+        findings += check_branch_uniformity(program)
+        if rank_programs:
+            progs = list(rank_programs)
+            labels = list(rank_labels) if rank_labels else None
+            if program not in progs:
+                progs = [program] + progs
+                if labels is not None and len(labels) == len(progs) - 1:
+                    # the caller labeled only rank_programs; label the
+                    # prepended reference program too so a divergence
+                    # at the last rank doesn't index past the list
+                    labels = ["main"] + labels
+            findings += check_collective_divergence(progs, labels=labels)
+    if "donation-safety" in sel:
+        dfs = check_donation_safety(program, feed_names=feed_names,
+                                    fetch_names=fetch_names)
+        findings += dfs
+        findings += cross_check_donation_report(dfs, donation_report)
+    if "host-sync" in sel:
+        findings += check_host_sync(program)
+    if "zero1-invariants" in sel:
+        findings += check_shard_plan(program)
+    if "dtype-contract" in sel:
+        findings += check_dtype_shape_contracts(program)
+    return sort_findings(findings)

@@ -1,0 +1,272 @@
+"""Checker 1 — SPMD collective-divergence.
+
+A pod-scale SPMD program deadlocks when any two ranks disagree on the
+collective schedule: opcode, dtype, payload shape, ring, or order
+(Kumar et al. 1909.09756 — mismatched per-rank collective schedules are
+the dominant debugging cost of scaling on TPU pods; the hang surfaces
+minutes into a run with zero diagnostics). Both failure shapes are
+provable statically:
+
+- **cross-rank**: the N fleet/PS-transpiled per-rank programs must emit
+  identical collective schedules (`check_collective_divergence`), and
+  the same holds for N lowered StableHLO modules
+  (`check_hlo_divergence` over `hlo_collective_schedule`).
+- **intra-program**: a collective under a data-dependent branch
+  (`cond` / `switch_case` / `conditional_block`) executes on the ranks
+  whose predicate picks that branch and not on the others — unless
+  every branch emits the SAME schedule, the program deadlocks the
+  moment the predicate diverges (`check_branch_uniformity`). Feeds are
+  sharded per-rank, so any predicate computed from data can diverge.
+
+The gradient-merge lax.cond is NOT flagged: its predicate is driven by
+a replicated step counter (every rank takes the same branch by
+construction — see fluid/lowering._run_gradient_merge), and it never
+appears as an IR branch op (it lives in the backward op's attrs).
+
+Collectives inside `while`/`scan` bodies are part of every rank's
+schedule (the trip count is static/uniform) and are recorded inline.
+"""
+from __future__ import annotations
+
+import re
+from typing import List
+
+from .findings import Finding
+
+#: IR op types that lower to an ICI collective (ops/collective_ops.py)
+#: or a host-tier barrier every rank must reach together.
+IR_COLLECTIVE_OPS = frozenset({
+    "c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
+    "c_allreduce_prod", "allreduce", "c_broadcast", "broadcast",
+    "c_allgather", "c_reducescatter", "c_reduce_sum", "alltoall",
+    "c_concat", "c_split", "c_embedding", "sync_batch_norm", "barrier",
+})
+
+_LOOP_OPS = ("while", "scan")
+
+
+def _first_payload(op, block):
+    """(dtype, shape) of the op's first input var (the collective
+    payload); (None, None) when the var is not declared."""
+    for names in op.input_names.values():
+        for n in names:
+            v = block._find_var_recursive(n)
+            if v is not None:
+                return str(v.dtype), tuple(int(d) for d in v.shape)
+            return None, None
+    return None, None
+
+
+def _record(op, block, block_idx, op_idx, path, region):
+    dtype, shape = _first_payload(op, block)
+    return {
+        "kind": op.type,
+        "dtype": dtype,
+        "shape": shape,
+        "ring_id": op.attrs.get("ring_id", 0),
+        "var": (op.input_arg_names or [None])[0],
+        "block_idx": block_idx,
+        "op_idx": op_idx,
+        "path": path,
+        # `region` strips op indices so two ranks whose surrounding
+        # non-collective op counts differ still compare equal when the
+        # control-flow nesting agrees
+        "region": region,
+    }
+
+
+def _schedule_key(rec):
+    return (rec["kind"], rec["dtype"], rec["shape"], rec["ring_id"],
+            rec["region"])
+
+
+def collective_schedule(program, block=None, _path="", _region=""):
+    """Ordered collective records of a Program's global block, descending
+    into every control-flow sub-block (loop bodies inline; branch
+    regions tagged so `cond.true/` vs top-level never compare equal)."""
+    block = block if block is not None else program.global_block()
+    out: List[dict] = []
+    for op_idx, op in enumerate(block.ops):
+        t = op.type
+        if t in IR_COLLECTIVE_OPS:
+            out.append(_record(op, block, block.idx, op_idx, _path,
+                               _region))
+            continue
+        if t in _LOOP_OPS:
+            sub = program.block(op.attrs["sub_block"])
+            out.extend(collective_schedule(
+                program, sub,
+                _path + "%s[%d]/" % (t, op_idx),
+                _region + t + "/"))
+        elif t == "cond":
+            for tag, attr in (("true", "sub_block_t"),
+                              ("false", "sub_block_f")):
+                sub = program.block(op.attrs[attr])
+                out.extend(collective_schedule(
+                    program, sub,
+                    _path + "cond[%d].%s/" % (op_idx, tag),
+                    _region + "cond.%s/" % tag))
+        elif t == "switch_case":
+            for bi, sub_idx in enumerate(op.attrs["sub_blocks"]):
+                sub = program.block(sub_idx)
+                out.extend(collective_schedule(
+                    program, sub,
+                    _path + "switch[%d].%d/" % (op_idx, bi),
+                    _region + "switch.%d/" % bi))
+        elif t == "conditional_block":
+            sub = program.block(op.attrs["sub_block"])
+            out.extend(collective_schedule(
+                program, sub,
+                _path + "condblock[%d]/" % op_idx,
+                _region + "condblock/"))
+    return out
+
+
+def _branch_schedules(program, op):
+    """Per-branch collective key sequences of one branch op (the
+    implicit skip path of a conditional_block is an empty branch)."""
+    if op.type == "cond":
+        subs = [("true", op.attrs["sub_block_t"]),
+                ("false", op.attrs["sub_block_f"])]
+    elif op.type == "switch_case":
+        subs = [("branch %d" % i, b)
+                for i, b in enumerate(op.attrs["sub_blocks"])]
+    elif op.type == "conditional_block":
+        subs = [("body", op.attrs["sub_block"]), ("skip", None)]
+    else:
+        return None
+    out = []
+    for tag, sub_idx in subs:
+        if sub_idx is None:
+            out.append((tag, []))
+            continue
+        sub = program.block(sub_idx)
+        recs = collective_schedule(program, sub)
+        # keep the branch-relative region tag (as _schedule_key does
+        # for the cross-rank pass): a collective inside a while body
+        # repeats per iteration, so it must NOT compare equal to a
+        # bare one in the other branch. Loop trip counts themselves
+        # stay unmodeled — nesting inequality is the conservative cut.
+        out.append((tag, [(_r["kind"], _r["dtype"], _r["shape"],
+                           _r["ring_id"], _r["region"])
+                          for _r in recs]))
+    return out
+
+
+def check_branch_uniformity(program, block=None, _findings=None):
+    """Error for every branch op whose branches emit different
+    collective schedules: the predicate only needs to diverge once
+    across ranks for the pod to deadlock on the missing collective."""
+    findings = _findings if _findings is not None else []
+    block = block if block is not None else program.global_block()
+    for op_idx, op in enumerate(block.ops):
+        branches = _branch_schedules(program, op)
+        if branches is not None:
+            base_tag, base = branches[0]
+            for tag, sched in branches[1:]:
+                if sched == base:
+                    continue
+                findings.append(Finding(
+                    "collective-divergence", "error",
+                    "collective schedule differs across branches of "
+                    "this %s (%s emits %d collective(s), %s emits %d): "
+                    "a rank-divergent predicate deadlocks the pod on "
+                    "the unmatched collective. Hoist the collective "
+                    "out of the branch or make every branch emit the "
+                    "identical schedule." % (
+                        op.type, base_tag, len(base), tag, len(sched)),
+                    block_idx=block.idx, op_idx=op_idx, op_type=op.type,
+                    var=(op.input_arg_names or [None])[0]))
+                break
+        # recurse so nested branch ops (a cond inside a scan body) are
+        # audited at any depth
+        for attr in ("sub_block", "sub_block_t", "sub_block_f"):
+            if attr in op.attrs:
+                check_branch_uniformity(
+                    program, program.block(op.attrs[attr]), findings)
+        for sub_idx in op.attrs.get("sub_blocks", []):
+            check_branch_uniformity(program, program.block(sub_idx),
+                                    findings)
+    return findings
+
+
+def check_collective_divergence(programs, labels=None):
+    """Compare the per-rank collective schedules of N fleet/PS-
+    transpiled programs; one error per diverging rank, located at the
+    first record that disagrees with rank 0."""
+    if len(programs) < 2:
+        return []
+    labels = labels or list(range(len(programs)))
+    schedules = [collective_schedule(p) for p in programs]
+    return _diff_schedules(schedules, labels, _schedule_key,
+                           lambda rec: dict(
+                               block_idx=rec["block_idx"],
+                               op_idx=rec["op_idx"],
+                               op_type=rec["kind"], var=rec["var"]))
+
+
+def _diff_schedules(schedules, labels, key_fn, loc_fn):
+    findings = []
+    base = [key_fn(r) for r in schedules[0]]
+    for rank in range(1, len(schedules)):
+        keys = [key_fn(r) for r in schedules[rank]]
+        if keys == base:
+            continue
+        pos = next((i for i, (a, b) in enumerate(zip(base, keys))
+                    if a != b), min(len(base), len(keys)))
+        if pos < len(schedules[rank]):
+            rec = schedules[rank][pos]
+        else:  # this rank's schedule is a strict prefix of rank 0's:
+            # anchor the location at rank 0's extra record, but the
+            # finding still names the DIVERGING rank
+            rec = schedules[0][pos]
+        expect = base[pos] if pos < len(base) else "<end of schedule>"
+        got = keys[pos] if pos < len(keys) else "<end of schedule>"
+        findings.append(Finding(
+            "collective-divergence", "error",
+            "rank %s diverges from rank %s at collective #%d: rank %s "
+            "emits %s, rank %s emits %s — on real ICI every rank must "
+            "issue the identical collective sequence or the pod hangs."
+            % (labels[rank], labels[0], pos, labels[0], expect,
+               labels[rank], got),
+            rank=labels[rank], **loc_fn(rec)))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# lowered-HLO level: the same check over StableHLO module text
+# ---------------------------------------------------------------------------
+
+_HLO_GROUPS = re.compile(r"replica_groups\s*=\s*dense<([^>]*)>")
+
+
+def hlo_collective_schedule(stablehlo_text):
+    """Ordered collective records from a lowered StableHLO module:
+    [{kind, type, replica_groups}] — textual order IS program order.
+    The line-scan state machine is `lowering._hlo_collective_hits`, the
+    SAME parser `collective_byte_census` uses (region-bearing ops carry
+    their result type + attrs on the region's closing line); this layer
+    only adds the replica_groups pick-off."""
+    from ..fluid.lowering import _hlo_collective_hits
+
+    out = []
+    for kind, ttype, open_line, close_line in \
+            _hlo_collective_hits(stablehlo_text):
+        g = _HLO_GROUPS.search(open_line) or _HLO_GROUPS.search(close_line)
+        out.append({"kind": kind, "type": ttype,
+                    "replica_groups": g.group(1).strip() if g else ""})
+    return out
+
+
+def check_hlo_divergence(stablehlo_texts, labels=None):
+    """Cross-rank divergence over N lowered StableHLO modules (the
+    post-lowering twin of check_collective_divergence)."""
+    if len(stablehlo_texts) < 2:
+        return []
+    labels = labels or list(range(len(stablehlo_texts)))
+    schedules = [hlo_collective_schedule(t) for t in stablehlo_texts]
+    return _diff_schedules(
+        schedules, labels,
+        lambda rec: (rec["kind"], rec.get("type"),
+                     rec.get("replica_groups")),
+        lambda rec: dict(op_type=rec["kind"]))

@@ -1,0 +1,170 @@
+"""Checker 5 — per-op dtype/shape contract check.
+
+The block's declared var dtypes/shapes are the program's CONTRACT: the
+executor sizes feed buffers, the checkpoint layer sizes restores, and
+the sharded-update planner sizes shard layouts from them. The actual
+values come from each op's registered compute (`ops/registry.py`) at
+trace time. This checker replays compile-time inference
+(`ops_lib.infer_outputs` — the same jax.eval_shape path
+`Block._infer_op_shapes` uses at build time) for every registered op
+and diffs the inferred output dtype/shape against the declaration, so
+drift introduced AFTER append_op (a transpiler rewriting input slots, a
+pass mutating attrs, a hand-edited var) surfaces before it becomes a
+runtime shape error — or worse, doesn't.
+
+Special attention to **silent fp64 promotion**: an op whose inferred
+output is float64 while no input is float64 doubles the payload bytes
+of everything downstream (and fp64 runs on TPU's slow path); it almost
+always means a python float leaked into a jnp op under x64. Flagged
+even when the declaration agrees.
+
+All findings are warnings: a drifted declaration is usually a latent
+bug, but the traced value (not the declaration) is what actually runs,
+so nothing here is a proven wrong answer.
+
+Skipped by design: `no_jit` host ops (their shape probe EXECUTES the
+compute — printing, saving files...), `dynamic_shape` ops (the contract
+is value-dependent), framework pseudo-ops (feed/fetch/backward/control
+flow — not registered), and ops whose inference raises (same contract
+as Block._infer_op_shapes: leave declared shapes alone).
+"""
+from __future__ import annotations
+
+from typing import List
+
+from .findings import Finding
+
+
+def _shapes_conflict(declared, inferred):
+    """True when two shape tuples disagree on a STATIC dim (-1 on
+    either side is a wildcard)."""
+    if len(declared) != len(inferred):
+        # rank drift, except the common scalar () vs (1,) looseness the
+        # builder layer tolerates everywhere; -1 stays a wildcard here
+        # too (a declared (-1,) against an inferred (8, 1) is not drift)
+        flat_d = [d for d in declared if d != 1]
+        flat_i = [d for d in inferred if d != 1]
+        if len(flat_d) != len(flat_i):
+            return True
+        return any(a != b for a, b in zip(flat_d, flat_i)
+                   if int(a) >= 0 and int(b) >= 0)
+    return any(a != b for a, b in zip(declared, inferred)
+               if int(a) >= 0 and int(b) >= 0)
+
+
+def _is_f64_request(attr_value):
+    """True for attr values that name the float64 dtype (strings and
+    numpy dtypes only — float VALUES like a 2.0 scale are not dtype
+    requests)."""
+    import numpy as np
+
+    if isinstance(attr_value, str):
+        return attr_value in ("float64", "double", "fp64")
+    return isinstance(attr_value, np.dtype) and \
+        attr_value == np.dtype("float64")
+
+
+def check_dtype_shape_contracts(program) -> List[Finding]:
+    from .. import ops as ops_lib
+
+    findings: List[Finding] = []
+    for block in program.blocks:
+        for op_idx, op in enumerate(block.ops):
+            if not ops_lib.has_op(op.type):
+                continue  # framework pseudo-op (feed/fetch/backward/...)
+            opdef = ops_lib.get_op(op.type)
+            if opdef.no_jit or opdef.dynamic_shape:
+                continue
+            in_specs = {}
+            missing = False
+            any_f64_in = False
+            for slot, names in op.input_names.items():
+                if not names:
+                    continue
+                specs = []
+                for n in names:
+                    v = block._find_var_recursive(n)
+                    if v is None:
+                        missing = True
+                        break
+                    dt = str(v.dtype)
+                    any_f64_in = any_f64_in or dt == "float64"
+                    specs.append((tuple(v.shape), dt))
+                if missing:
+                    break
+                in_specs[slot] = specs
+            if missing:
+                continue
+            if not any_f64_in:
+                f64_attrs = [k for k, v in op.attrs.items()
+                             if _is_f64_request(v)]
+                if f64_attrs:
+                    # the request itself is the leak: under the default
+                    # x64-off config jax truncates it to f32 at trace
+                    # time (so declaration AND compute agree on f32 and
+                    # no drift would ever fire) — the op still asked
+                    # for a dtype the program doesn't get
+                    findings.append(Finding(
+                        "dtype-contract", "warning",
+                        "silent fp64 promotion: op requests float64 "
+                        "via attr(s) %s from non-float64 inputs — 2x "
+                        "payload bytes downstream and TPU's slow path "
+                        "when x64 is on, a silent truncation to f32 "
+                        "when off; a python-side float64 likely "
+                        "leaked into the op." % (f64_attrs,),
+                        block_idx=block.idx, op_idx=op_idx,
+                        op_type=op.type,
+                        var=(op.output_arg_names or [None])[0]))
+            try:
+                out_specs = ops_lib.infer_outputs(op.type, in_specs,
+                                                  dict(op.attrs))
+            except Exception:  # noqa: BLE001 - same contract as append_op
+                continue
+            for slot, names in op.output_names.items():
+                specs = out_specs.get(slot, [])
+                for n, spec in zip(names, specs):
+                    v = block._find_var_recursive(n)
+                    if v is None:
+                        continue
+                    inf_shape = tuple(spec[0])
+                    inf_dtype = str(spec[1])
+                    decl_dtype = str(v.dtype)
+                    loc = dict(block_idx=block.idx, op_idx=op_idx,
+                               op_type=op.type, var=n)
+                    if not any_f64_in and "float64" in (inf_dtype,
+                                                       decl_dtype):
+                        # inferred f64 only appears with x64 enabled;
+                        # a DECLARED f64 out from non-f64 inputs is the
+                        # same leak seen from the contract side (under
+                        # the default x64-off config it silently
+                        # truncates to f32 at trace time)
+                        findings.append(Finding(
+                            "dtype-contract", "warning",
+                            "silent fp64 promotion: output %r is "
+                            "float64 (declared %s, computed %s) from "
+                            "non-float64 inputs — 2x the payload "
+                            "bytes downstream and TPU's slow path "
+                            "when x64 is on, a silent truncation to "
+                            "f32 when off; a python float likely "
+                            "leaked into the op." % (
+                                n, decl_dtype, inf_dtype),
+                            **loc))
+                    elif inf_dtype != decl_dtype:
+                        findings.append(Finding(
+                            "dtype-contract", "warning",
+                            "out var %r declares dtype %s but the "
+                            "registered compute produces %s — the "
+                            "declaration (what feeds/checkpoints/"
+                            "shard planning size against) has "
+                            "drifted from the traced value." % (
+                                n, decl_dtype, inf_dtype),
+                            **loc))
+                    decl_shape = tuple(v.shape)
+                    if _shapes_conflict(decl_shape, inf_shape):
+                        findings.append(Finding(
+                            "dtype-contract", "warning",
+                            "out var %r declares shape %s but the "
+                            "registered compute produces %s." % (
+                                n, decl_shape, inf_shape),
+                            **loc))
+    return findings

@@ -1,0 +1,169 @@
+"""Checker 2 — donation use-after-donate.
+
+The lowered step donates its feed buffers (FLAGS_tpu_donate_feed_
+buffers) and its mutable-state buffers (FLAGS_tpu_donate_buffers) into
+XLA, which may alias them into outputs/scratch — the HBM win the async
+pipeline depends on. The contract that makes donation safe: nothing
+holds a reference to a donated buffer past the op that overwrites it
+in place.
+
+The reads/writes walk proves exactly that. Buffer-HOLDING readers —
+`fetch` ops (the fetched device array outlives the step, handed to the
+caller / a LazyFetch) and `send` ops (the PS push reads the buffer
+asynchronously over RPC) — that observe a donated var BEFORE an op
+rebinds it in place are read-after-donate errors: once XLA aliases the
+incoming buffer into the rebinding op's output, the held reference
+observes the UPDATED bytes, not the value at the fetch point. The
+classic instance is fetching a parameter "before" its optimizer update:
+the reference framework's memory-reuse pass had to exempt fetch-list
+vars for the same reason (transpiler/memory_optimization_transpiler.py
+skip_opt_set).
+
+Ordinary reads-after-rebind are fine (the SSA env hands them the new
+value); reads before the first rebind are fine (the buffer is still
+intact at that point in the schedule).
+
+`cross_check_donation_report` closes the loop against the DYNAMIC
+audit: `Executor.donation_report` proves (per compiled executable) that
+donation actually aliased the mutable state; a clean static verdict
+plus a non-aliasing executable means donation silently disengaged —
+worth a warning, not an error (it is a lost optimization, not a wrong
+answer).
+"""
+from __future__ import annotations
+
+from typing import List
+
+from .findings import Finding
+
+#: op types that hold a reference to their input buffer beyond their
+#: own execution (the fetched array is returned to the caller; the
+#: send payload is read by the host RPC thread after dispatch)
+BUFFER_HOLDING_OPS = frozenset({"fetch", "send"})
+
+
+def _donation_flags(program):
+    from ..utils.flags import get_flag
+
+    donate = bool(get_flag("FLAGS_tpu_donate_buffers", True))
+    feed_donate = donate and \
+        bool(get_flag("FLAGS_tpu_donate_feed_buffers", True)) and \
+        getattr(program, "_feed_donate", True)
+    return donate, feed_donate
+
+
+def check_donation_safety(program, feed_names=None, fetch_names=None):
+    """Reads/writes walk over the global block proving no buffer-holding
+    op consumes a feed/state buffer before an in-place rebind of it."""
+    from ..fluid import lowering
+
+    block = program.global_block()
+    donate, feed_donate = _donation_flags(program)
+    if not donate:
+        return []
+    if feed_names is None:
+        feed_names = [v.name for v in block.vars.values()
+                      if getattr(v, "is_data", False)]
+    fetch_names = list(fetch_names or [])
+
+    state_in, state_out = lowering.analyze_block(
+        block, list(feed_names), fetch_names)
+    state_out_set = set(state_out)
+    donated = {n for n in state_in if n in state_out_set}
+    feed_set = set(feed_names)
+    if feed_donate:
+        donated |= feed_set
+
+    findings: List[Finding] = []
+    held = {}  # var -> (block_idx, op_idx, op_type) of the holder
+    warned_feed = set()
+    flagged = set()  # one finding per var (loop replays re-trip it)
+    for op_idx, op in enumerate(block.ops):
+        for kind, name, actor, b_idx, o_idx in \
+                _op_events(op, program, block.idx, op_idx):
+            if kind == "hold":
+                if name in donated and name not in flagged:
+                    held.setdefault(name, (b_idx, o_idx, actor))
+                continue
+            if name in held and name in donated:
+                flagged.add(name)
+                h_blk, h_idx, h_type = held.pop(name)
+                findings.append(Finding(
+                    "donation-safety", "error",
+                    "read-after-donate: block %d op %d (%s) rebinds "
+                    "donated buffer %r in place, but block %d op %d "
+                    "(%s) already holds a reference to it — under "
+                    "buffer donation the held reference observes the "
+                    "UPDATED buffer, not the value at its read point. "
+                    "Move the %s after the rebind, copy the value "
+                    "first, or disable donation for this program." % (
+                        b_idx, o_idx, actor, name, h_blk, h_idx,
+                        h_type, h_type),
+                    block_idx=b_idx, op_idx=o_idx,
+                    op_type=actor, var=name))
+            if name in feed_set and feed_donate and \
+                    name not in warned_feed and actor != "feed":
+                warned_feed.add(name)
+                findings.append(Finding(
+                    "donation-safety", "warning",
+                    "the program overwrites feed var %r; with feed-"
+                    "buffer donation the caller's array is consumed by "
+                    "this step and the original feed value is "
+                    "unrecoverable after block %d op %d (%s)." % (
+                        name, b_idx, o_idx, actor),
+                    block_idx=b_idx, op_idx=o_idx,
+                    op_type=actor, var=name))
+    return findings
+
+
+def _op_events(op, program, block_idx, op_idx):
+    """Ordered ('hold'|'write', var, actor_op_type, block_idx, op_idx)
+    events of one op — each event carries the TRUE coordinates of the
+    op that produced it, so a finding anchored on a nested fetch/rebind
+    names the sub-block op, not the enclosing while/cond. Descends into
+    control-flow sub-blocks so a fetch/send buried in a loop or branch
+    body still registers its hold. A while/scan body's event list is
+    replayed twice: iteration i+1's writes land after iteration i's
+    holds, so a fetch-then-rebind INSIDE one loop body — a real
+    per-iteration hazard — is seen even though a single linear pass
+    would order the write first."""
+    from ..fluid.lowering import _sub_block_idxs
+
+    events = []
+    if op.type in BUFFER_HOLDING_OPS:
+        for n in op.input_arg_names:
+            events.append(("hold", n, op.type, block_idx, op_idx))
+    else:
+        for n in op.output_arg_names:
+            events.append(("write", n, op.type, block_idx, op_idx))
+    sub = []
+    for bi in _sub_block_idxs(op):
+        for sidx, sop in enumerate(program.block(bi).ops):
+            sub.extend(_op_events(sop, program, bi, sidx))
+    if op.type in ("while", "scan") and sub:
+        sub = sub + sub  # second iteration
+    events.extend(sub)
+    return events
+
+
+def cross_check_donation_report(findings, report) -> List[Finding]:
+    """Reconcile the static verdict with `Executor.donation_report`
+    (the compiled-memory-analysis audit of the SAME program): a clean
+    static pass whose executable did not alias its donated state means
+    donation disengaged — HBM holds both the old and new copies."""
+    if report is None:
+        return []
+    has_error = any(f.severity == "error" and
+                    f.checker == "donation-safety" for f in findings)
+    out: List[Finding] = []
+    if not has_error and report.get("mut_bytes", 0) > 0 and \
+            not report.get("aliases_state", False):
+        out.append(Finding(
+            "donation-safety", "warning",
+            "static analysis found no donation hazard, but the "
+            "compiled executable aliased only %d of %d donated state "
+            "bytes (donation_report.aliases_state=False) — donation "
+            "disengaged at compile time, so HBM holds duplicate state "
+            "copies." % (report.get("alias_bytes", 0),
+                         report.get("mut_bytes", 0))))
+    return out

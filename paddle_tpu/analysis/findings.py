@@ -1,0 +1,112 @@
+"""Structured findings for the tpu-lint static checkers.
+
+Every checker emits `Finding` records instead of raising: a finding
+carries the checker name, a severity, a human message, and the op/var
+location it anchors to, so the three surfaces (CLI, Executor hook,
+bench summary) can render/aggregate them uniformly and the seeded-
+defect fixtures can assert exact locations.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Optional
+
+#: severity order, worst first. `error` findings are provable-deadlock /
+#: wrong-answer classes (a rank-divergent collective schedule, a
+#: read-after-donate); `warning` is perf or likely-bug (a host callback
+#: in a hot loop, a dtype contract drift); `info` is context only.
+SEVERITIES = ("error", "warning", "info")
+
+_RANK = {s: i for i, s in enumerate(SEVERITIES)}
+
+
+class Finding:
+    """One static-analysis result with an op/var location."""
+
+    __slots__ = ("checker", "severity", "message", "block_idx", "op_idx",
+                 "op_type", "var", "rank")
+
+    def __init__(self, checker: str, severity: str, message: str,
+                 block_idx: Optional[int] = None,
+                 op_idx: Optional[int] = None,
+                 op_type: Optional[str] = None,
+                 var: Optional[str] = None,
+                 rank: Optional[object] = None):
+        if severity not in SEVERITIES:
+            raise ValueError("unknown severity %r" % (severity,))
+        self.checker = checker
+        self.severity = severity
+        self.message = message
+        self.block_idx = block_idx
+        self.op_idx = op_idx
+        self.op_type = op_type
+        self.var = var
+        self.rank = rank  # rank label for cross-rank divergence findings
+
+    @property
+    def location(self) -> str:
+        parts = []
+        if self.rank is not None:
+            parts.append("rank %s" % (self.rank,))
+        if self.block_idx is not None:
+            loc = "block %d" % self.block_idx
+            if self.op_idx is not None:
+                loc += " op %d" % self.op_idx
+            if self.op_type:
+                loc += " (%s)" % self.op_type
+            parts.append(loc)
+        if self.var:
+            parts.append("var %r" % self.var)
+        return ", ".join(parts)
+
+    def to_dict(self) -> dict:
+        return {
+            "checker": self.checker,
+            "severity": self.severity,
+            "message": self.message,
+            "block_idx": self.block_idx,
+            "op_idx": self.op_idx,
+            "op_type": self.op_type,
+            "var": self.var,
+            "rank": self.rank,
+        }
+
+    def __repr__(self):
+        return "Finding(%s)" % format_finding(self)
+
+
+def format_finding(f: Finding) -> str:
+    loc = f.location
+    return "[%s] %s%s: %s" % (
+        f.severity, f.checker, " @ " + loc if loc else "", f.message)
+
+
+def sort_findings(findings: Iterable[Finding]) -> List[Finding]:
+    """Worst severity first, then program order."""
+    return sorted(findings, key=lambda f: (
+        _RANK[f.severity],
+        f.block_idx if f.block_idx is not None else -1,
+        f.op_idx if f.op_idx is not None else -1))
+
+
+def worst_severity(findings: Iterable[Finding]) -> Optional[str]:
+    worst = None
+    for f in findings:
+        if worst is None or _RANK[f.severity] < _RANK[worst]:
+            worst = f.severity
+    return worst
+
+
+def summarize(findings: Iterable[Finding]) -> dict:
+    findings = sort_findings(findings)
+    by_checker: dict = {}
+    for f in findings:
+        c = by_checker.setdefault(f.checker,
+                                  {"error": 0, "warning": 0, "info": 0})
+        c[f.severity] += 1
+    return {
+        "errors": sum(1 for f in findings if f.severity == "error"),
+        "warnings": sum(1 for f in findings if f.severity == "warning"),
+        "infos": sum(1 for f in findings if f.severity == "info"),
+        "by_checker": by_checker,
+        "findings": [f.to_dict() for f in findings],
+    }

@@ -1,0 +1,102 @@
+"""Checker 3 — host syncs in hot loops (perf lint).
+
+TPU throughput lives or dies on keeping the device queue full (Wang et
+al. 2011.03641): the PR-2 async pipeline exists so the host never
+blocks mid-step. A device->host sync INSIDE a while/scan body defeats
+it once per ITERATION, not once per step — a 12-layer scanned encoder
+with a fetch in the body syncs 12x per step and serializes the entire
+loop around host round-trips.
+
+Severities:
+
+- `fetch` / PS RPC markers (`send`, `recv`, `*_barrier`,
+  `checkpoint_notify`) inside a loop body — **error**: a forced host
+  sync (or a host RPC) every iteration; nothing downstream can hide it.
+- a registered `no_jit` host op inside a loop body — **warning**: it
+  lowers to a per-iteration `jax.pure_callback` (device->host->device
+  round-trip inside the compiled loop); it works, but the loop's
+  schedule fences on the callback.
+- a `dynamic_shape` op inside a loop body — **error**: value-dependent
+  output shapes cannot lower under jit at all, so the WHOLE block falls
+  back to op-by-op eager execution (fluid/lowering.compile_block)...
+  every step.
+- a `dynamic_shape` op outside any loop — **warning**: same eager
+  fallback, flagged once so the perf cliff is visible.
+
+Branch bodies (`cond`/`switch_case`/`conditional_block`) do not loop by
+themselves, but a host op inside a branch inside a scan still fires per
+iteration — the walk tracks loop depth through every sub-block kind at
+any nesting (the `_block_host_op_kinds` contract, unit-tested in
+tests/test_tpu_lint.py).
+"""
+from __future__ import annotations
+
+from typing import List
+
+from .findings import Finding
+
+_LOOP_OPS = {"while", "scan"}
+_RPC_MARKER_OPS = frozenset({"send", "recv", "send_barrier",
+                             "fetch_barrier", "checkpoint_notify",
+                             "barrier"})
+
+
+def check_host_sync(program) -> List[Finding]:
+    from .. import ops as ops_lib
+    from ..fluid.lowering import _sub_block_idxs
+
+    findings: List[Finding] = []
+
+    def scan(block, loop_path):
+        in_loop = bool(loop_path)
+        loop_desc = "/".join(loop_path)
+        for op_idx, op in enumerate(block.ops):
+            t = op.type
+            loc = dict(block_idx=block.idx, op_idx=op_idx, op_type=t,
+                       var=(op.input_arg_names or [None])[0])
+            if in_loop and t == "fetch":
+                findings.append(Finding(
+                    "host-sync", "error",
+                    "fetch inside a %s body forces a device->host sync "
+                    "every iteration, serializing the loop and "
+                    "defeating the prefetch pipeline — fetch after the "
+                    "loop, or carry the value out as loop state."
+                    % loop_desc, **loc))
+            elif in_loop and t in _RPC_MARKER_OPS:
+                findings.append(Finding(
+                    "host-sync", "error",
+                    "host RPC op %r inside a %s body runs a host "
+                    "round-trip every iteration — move the PS "
+                    "push/pull outside the loop." % (t, loop_desc),
+                    **loc))
+            elif ops_lib.has_op(t):
+                od = ops_lib.get_op(t)
+                if od.dynamic_shape:
+                    if in_loop:
+                        findings.append(Finding(
+                            "host-sync", "error",
+                            "dynamic-shape op %r inside a %s body "
+                            "cannot lower under jit — the WHOLE block "
+                            "falls back to op-by-op eager execution "
+                            "every step." % (t, loop_desc), **loc))
+                    else:
+                        findings.append(Finding(
+                            "host-sync", "warning",
+                            "dynamic-shape op %r forces the whole "
+                            "block to run unjitted (op-by-op eager "
+                            "dispatch) — a silent perf cliff on TPU."
+                            % t, **loc))
+                elif od.no_jit and in_loop:
+                    findings.append(Finding(
+                        "host-sync", "warning",
+                        "host op %r inside a %s body lowers to a "
+                        "per-iteration jax.pure_callback (device->"
+                        "host->device round-trip inside the compiled "
+                        "loop) — hoist it out of the hot loop."
+                        % (t, loop_desc), **loc))
+            for sub_idx in _sub_block_idxs(op):
+                scan(program.block(sub_idx),
+                     loop_path + [t] if t in _LOOP_OPS else loop_path)
+
+    scan(program.global_block(), [])
+    return findings

@@ -1,0 +1,192 @@
+"""Checker 4 — ZeRO-1 sharded-update planner invariants.
+
+`parallel/sharded_update.plan_sharded_update` proves a program's
+post-backward section safe to run on flat 1/N shards and falls back to
+the replicated update when it can't. This checker independently
+re-verifies the invariants a PLAN asserts — so a plan built before a
+later program mutation (a pass inserting ops after planning, a var
+reshaped under the plan's feet, a hand-built plan in a test) is caught
+before it silently corrupts padding or deadlocks a bucket collective:
+
+- **padding provably zeroed**: every op that consumes a sharded
+  gradient between its reduce-scatter and the optimizer op must be in
+  the shard-aware vocabulary whose execution re-zeros the flat-buffer
+  padding slots (`sharded_update._zero_pad_slots`); anything else can
+  write nonzero values into the padding, which feeds the psum'd
+  global-norm partial sums and PERSISTS in sharded optimizer state.
+- **bucket dtype homogeneity**: one bucket = one collective; entries of
+  different dtypes cannot share it (plan_buckets never mixes them — a
+  mixed bucket means the plan was tampered with or mis-built, and the
+  runtime dtype-split fallback would emit a DIFFERENT collective count
+  than other ranks planned).
+- **bucket/shard layout**: every entry's padded length must cover its
+  numel and divide by ndev, or shard slices misalign across replicas.
+- **checkpoint save/restore layout consistency**: sharded state saves
+  at its LOGICAL shape (`unshard_scope_value`) and restores by
+  re-sharding against the plan's ShardInfo — the plan's recorded
+  logical shape must still match the block var's declared shape, and
+  its padded length must be exactly ceil(numel/ndev)*ndev, or a
+  restored checkpoint reshapes into garbage.
+- **reduce-scatter coverage** (explicit-sync programs): every optimizer
+  gradient must be reduce-scattered before its optimizer op consumes
+  it; a grad that never syncs applies a PER-RANK update to replicated
+  params — silent divergence, not a deadlock.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from .findings import Finding
+
+
+def check_shard_plan(program, plan=None) -> List[Finding]:
+    from ..fluid import lowering
+    from ..parallel import sharded_update as su
+
+    plan = plan if plan is not None else getattr(program, "_shard_plan",
+                                                 None)
+    if plan is None:
+        return []
+    block = program.global_block()
+    findings: List[Finding] = []
+
+    # -- bucket invariants -------------------------------------------------
+    for b in plan.buckets:
+        dtypes = sorted({str(e.dtype) for e in b.entries})
+        if len(dtypes) > 1:
+            findings.append(Finding(
+                "zero1-invariants", "error",
+                "grad bucket %d mixes dtypes %s — one collective "
+                "cannot carry both; the runtime per-dtype split would "
+                "emit a different collective count than other ranks "
+                "planned (deadlock on real ICI)." % (b.index, dtypes),
+                var="bucket%d" % b.index))
+        for e in b.entries:
+            if e.padded < e.numel or e.padded % plan.ndev:
+                findings.append(Finding(
+                    "zero1-invariants", "error",
+                    "bucket %d entry %r: padded length %d does not "
+                    "cover numel %d in ndev=%d slices — replica shard "
+                    "slices would misalign." % (
+                        b.index, e.grad, e.padded, e.numel, plan.ndev),
+                    var=e.grad))
+
+    # -- sharded-state layout vs checkpoint save/restore -------------------
+    for n, info in plan.sharded_state.items():
+        numel = int(np.prod(info.shape)) if info.shape else 1
+        want_padded = -(-numel // plan.ndev) * plan.ndev
+        if info.numel != numel or info.padded != want_padded:
+            findings.append(Finding(
+                "zero1-invariants", "error",
+                "sharded state %r: ShardInfo records numel=%d "
+                "padded=%d but logical shape %s implies numel=%d "
+                "padded=%d (ndev=%d) — a checkpoint restore would "
+                "re-shard against the wrong layout." % (
+                    n, info.numel, info.padded, info.shape, numel,
+                    want_padded, plan.ndev),
+                var=n))
+        v = block._find_var_recursive(n)
+        declared = tuple(int(d) for d in v.shape) if v is not None \
+            else None
+        if declared != info.shape:
+            findings.append(Finding(
+                "zero1-invariants", "error",
+                "sharded state %r: plan logical shape %s != block var "
+                "shape %s — checkpoint SAVE (logical, "
+                "unshard_scope_value) and RESTORE (re-sharded against "
+                "the plan) would disagree on the layout." % (
+                    n, info.shape, declared),
+                var=n))
+
+    # -- padding-zeroing taint walk over the post-backward section ---------
+    ops = list(block.ops)
+    bwd_idx = next((i for i, op in enumerate(ops)
+                    if op.type == "backward"), None)
+    if bwd_idx is None:
+        return findings
+    post = ops[bwd_idx + 1:]
+    rezeroing = su._EW_UNARY | su._EW_BINARY | {"sum"}
+    untainting = su._NORM_REDUCE
+    # implicit-sync grads enter shard space AT the vjp output; explicit-
+    # sync grads at their c_allreduce_sum op
+    tainted = set(plan.grad_names)
+    seen_scattered = set(plan.grad_names)
+    for i, op in enumerate(post):
+        op_idx = bwd_idx + 1 + i
+        reads, writes = lowering._op_reads_writes(op)
+        reads, writes = set(reads), set(writes)
+        is_opt = "ParamOut" in op.output_names and \
+            op.type in su.SUPPORTED_OPT
+        if is_opt:
+            for g in op.input_names.get("Grad", []):
+                if g not in seen_scattered:
+                    findings.append(Finding(
+                        "zero1-invariants", "error",
+                        "optimizer op consumes gradient %r that is "
+                        "never reduce-scattered on this path — a "
+                        "per-rank update of replicated params "
+                        "silently diverges the replicas." % g,
+                        block_idx=block.idx, op_idx=op_idx,
+                        op_type=op.type, var=g))
+            tainted -= writes
+            continue
+        if op.type == "c_allreduce_sum":
+            xs = set(op.input_names.get("X", []))
+            if xs & plan.rs_targets:
+                outs = set(op.output_names.get("Out", []))
+                tainted |= outs
+                seen_scattered |= outs
+                continue
+        tin = reads & tainted
+        if not tin:
+            tainted -= writes
+            continue
+        if op.type in su._EW_BINARY:
+            # mirror the planner's decline rule (sharded_update):
+            # broadcasting mismatched NON-scalar operands over a
+            # sharded grad has no flat-shard analogue — an op like
+            # this after planning mis-broadcasts (or raises) at
+            # shard-space trace time
+            numels = []
+            for slot in ("X", "Y"):
+                for n in op.input_names.get(slot, []):
+                    v = block._find_var_recursive(n)
+                    shp = tuple(getattr(v, "shape", ()) or ())
+                    if shp:
+                        numels.append(int(np.prod(shp)))
+            if len(numels) == 2 and numels[0] != numels[1] \
+                    and 1 not in numels:
+                findings.append(Finding(
+                    "zero1-invariants", "error",
+                    "op %r broadcasts mismatched non-scalar operands "
+                    "(numels %s) over sharded gradient(s) %s — no "
+                    "flat-shard analogue exists; the planner declines "
+                    "such programs, so this op was inserted after "
+                    "planning." % (op.type, numels, sorted(tin)),
+                    block_idx=block.idx, op_idx=op_idx,
+                    op_type=op.type, var=sorted(tin)[0]))
+                tainted |= writes
+                continue
+        if op.type in rezeroing:
+            tainted |= writes  # exec re-zeros padding (_zero_pad_slots)
+        elif op.type in untainting:
+            tainted -= writes  # replicated scalar out (psum'd partials)
+        elif op.type == "clip_by_norm":
+            tainted |= writes
+        else:
+            findings.append(Finding(
+                "zero1-invariants", "error",
+                "op %r consumes sharded gradient(s) %s without a "
+                "shard-aware re-zeroing rule — flat-buffer padding "
+                "slots are not provably zeroed before the optimizer "
+                "op (nonzero padding feeds psum'd norm partials and "
+                "persists in sharded optimizer state). The planner "
+                "should have declined this program; it was likely "
+                "mutated after planning." % (
+                    op.type, sorted(tin)),
+                block_idx=block.idx, op_idx=op_idx, op_type=op.type,
+                var=sorted(tin)[0]))
+            tainted |= writes  # keep walking for further findings
+    return findings

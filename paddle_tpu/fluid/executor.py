@@ -236,6 +236,12 @@ class Executor:
                     for n, a in feed_arrays.items()}
         if entry is None:
             _t = _time.perf_counter()
+            # tpu-lint, pre-compile leg (FLAGS_tpu_static_checks): the
+            # IR-only checkers need nothing from XLA, so in error mode
+            # a known-bad program is rejected BEFORE paying the
+            # (potentially tens of seconds) compile below
+            self._static_checks(program, feed_arrays, fetch_names,
+                                checkers=self._PRE_COMPILE_CHECKERS)
             state_in, _ = lowering.analyze_block(
                 block, list(feed_arrays), fetch_names)
             state_specs = {}
@@ -260,6 +266,14 @@ class Executor:
                     warnings.warn(
                         "feed variables never read by the program: %s"
                         % unused)
+            # tpu-lint, post-compile leg: zero1-invariants verifies the
+            # ShardedUpdatePlan that compile_block just attached
+            # (program._shard_plan), so it cannot run in the fail-fast
+            # leg above. MUST run before the entry is cached: in error
+            # mode a caught-and-retried run would otherwise cache-hit
+            # past the check and dispatch the known-bad program
+            self._static_checks(program, feed_arrays, fetch_names,
+                                checkers=("zero1-invariants",))
             if use_program_cache:
                 self._cache[key] = entry
                 limit = int(get_flag("FLAGS_tpu_compile_cache_size", 128)
@@ -350,6 +364,46 @@ class Executor:
             _mark("sync", _t)
             return out
         return [LazyFetch(v) for v in fetches]
+
+    #: checkers that need nothing from compile_block (no shard plan),
+    #: run before the XLA compile so error mode fails fast
+    _PRE_COMPILE_CHECKERS = ("collective-divergence", "donation-safety",
+                             "host-sync", "dtype-contract")
+
+    @staticmethod
+    def _static_checks(program, feed_arrays, fetch_names, checkers=None):
+        """Opt-in compile-time tpu-lint (paddle_tpu/analysis):
+        FLAGS_tpu_static_checks="warn" surfaces every finding as a
+        python warning; "error" raises on error-severity findings
+        (collective divergence, read-after-donate, fetch-in-loop,
+        shard-plan violations) BEFORE the first dispatch — the IR-only
+        checkers even before the XLA compile. Runs only on
+        compile-cache misses — steady-state steps never pay."""
+        from ..utils.flags import get_flag
+
+        mode = str(get_flag("FLAGS_tpu_static_checks", "off")
+                   or "off").lower()
+        if mode not in ("warn", "error"):
+            return
+        from .. import analysis
+
+        findings = analysis.run_static_checks(
+            program, feed_names=list(feed_arrays),
+            fetch_names=list(fetch_names), checkers=checkers)
+        if not findings:
+            return
+        import warnings
+
+        for f in findings:
+            warnings.warn("tpu-lint: " + analysis.format_finding(f))
+        errors = [f for f in findings if f.severity == "error"]
+        if mode == "error" and errors:
+            raise RuntimeError(
+                "FLAGS_tpu_static_checks=error: %d static-check "
+                "error(s) in this program:\n%s" % (
+                    len(errors), "\n".join(
+                        "  " + analysis.format_finding(f)
+                        for f in errors)))
 
     @staticmethod
     def _fetch_to_numpy(v):

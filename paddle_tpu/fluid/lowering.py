@@ -1084,6 +1084,34 @@ def _tensor_bytes(type_str):
     return n * size
 
 
+def _hlo_collective_hits(stablehlo_text, op_names=_COLLECTIVE_OPS):
+    """Ordered `(kind, result_type, open_line, result_line)` hits of
+    the collective ops in one StableHLO module text — textual order IS
+    program order. Region-bearing ops (all_reduce/reduce_scatter) carry
+    their `-> tensor<...>` result type (and the rest of their attrs) on
+    the region's CLOSING line, several lines below the op itself.
+    Shared by `collective_byte_census` and the divergence checker's
+    `analysis.hlo_collective_schedule` so the two never drift."""
+    import re
+
+    open_pat = re.compile(
+        r"\"?(?:stablehlo|mhlo)\.(%s)\"?" % "|".join(op_names))
+    ret_pat = re.compile(r"->\s*(?:tuple<)?tensor<([^>]+)>")
+    hits = []
+    pending = None
+    for line in stablehlo_text.splitlines():
+        m = open_pat.search(line)
+        r = ret_pat.search(line)
+        if m and r:
+            hits.append((m.group(1), r.group(1), line, line))
+        elif m:
+            pending = (m.group(1), line)
+        elif pending and r and line.lstrip().startswith("})"):
+            hits.append((pending[0], r.group(1), pending[1], line))
+            pending = None
+    return hits
+
+
 def collective_byte_census(stablehlo_text, ndev=1):
     """Per-collective accounting from a lowered StableHLO module:
     {op: {count, tensor_bytes, ici_bytes}} + totals. `tensor_bytes`
@@ -1092,29 +1120,10 @@ def collective_byte_census(stablehlo_text, ndev=1):
     tensor, reduce_scatter (N-1)x its 1/N result, all_gather (N-1)/N of
     its full result) — the quantity the sharded weight update halves on
     the grad+param exchange."""
-    import re
-
     ndev = max(int(ndev), 1)
     out = {op: {"count": 0, "tensor_bytes": 0, "ici_bytes": 0}
            for op in _COLLECTIVE_OPS}
-    open_pat = re.compile(
-        r"\"?(?:stablehlo|mhlo)\.(%s)\"?" % "|".join(_COLLECTIVE_OPS))
-    ret_pat = re.compile(r"->\s*(?:tuple<)?tensor<([^>]+)>")
-    hits = []
-    pending = None  # region-bearing ops (all_reduce/reduce_scatter):
-    # the `-> tensor<...>` result type lands on the region's CLOSING
-    # line, several lines below the op itself
-    for line in stablehlo_text.splitlines():
-        m = open_pat.search(line)
-        r = ret_pat.search(line)
-        if m and r:
-            hits.append((m.group(1), r.group(1)))
-        elif m:
-            pending = m.group(1)
-        elif pending and r and line.lstrip().startswith("})"):
-            hits.append((pending, r.group(1)))
-            pending = None
-    for op, ttype in hits:
+    for op, ttype, _, _ in _hlo_collective_hits(stablehlo_text):
         b = _tensor_bytes(ttype)
         rec = out[op]
         rec["count"] += 1

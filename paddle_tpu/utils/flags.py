@@ -82,6 +82,14 @@ _FLAGS: Dict[str, object] = {
     # 9.8ms even at S=2048 fwd); flash's win is O(S) memory at long seq.
     "FLAGS_flash_attention_min_seq": 4096,
     "FLAGS_tpu_compile_cache_size": 128,
+    # tpu-lint static SPMD verifier (paddle_tpu/analysis): run the
+    # collective-divergence / donation-safety / host-sync /
+    # zero1-invariants / dtype-contract checkers at compile time (each
+    # cache-missing Executor.run). "off" = never; "warn" = emit one
+    # python warning per finding; "error" = warn AND raise when any
+    # error-severity finding exists — the program never dispatches.
+    # Steady-state steps (cache hits) never pay for this.
+    "FLAGS_tpu_static_checks": "off",
 }
 
 

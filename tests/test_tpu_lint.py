@@ -1,0 +1,744 @@
+"""tpu-lint: the static SPMD verifier (paddle_tpu/analysis).
+
+Seeded-defect fixtures — each checker must trip with the expected
+severity AND op/var location (the checkers themselves are the
+regression surface): a rank-divergent collective schedule (checker 1),
+a read-after-donate (checker 2), a fetch inside a scan body (checker
+3), a non-zeroed padding slot / tampered shard layout (checker 4), a
+drifted dtype contract + silent fp64 promotion (checker 5). Plus: the
+`FLAGS_tpu_static_checks` Executor compile-time hook (error raises
+BEFORE dispatch, warn warns, clean programs pass under =error), the
+`collective_byte_census` region coverage for switch_case /
+conditional_block collectives, the `_block_host_op_kinds` any-depth
+recursion contract, and the exemplar lint-regression harness
+(tools/tpu_lint.py: BERT-tiny DP step, resnet scan, 2-rank sync-PS —
+zero errors, standing).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import analysis
+from paddle_tpu.fluid import framework, lowering
+from paddle_tpu.fluid.framework import Operator
+from paddle_tpu.utils.flags import get_flag, set_flags
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _restore_flags():
+    keys = ("FLAGS_tpu_donate_buffers", "FLAGS_tpu_donate_feed_buffers",
+            "FLAGS_tpu_static_checks", "FLAGS_tpu_sharded_weight_update",
+            "FLAGS_tpu_comm_bucket_mb")
+    old = {k: get_flag(k) for k in keys}
+    yield
+    set_flags(old)
+
+
+def _mlp_loss(width=8, classes=4):
+    img = fluid.layers.data(name="img", shape=[width], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(input=img, size=8, act="relu")
+    logits = fluid.layers.fc(input=h, size=classes)
+    return fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, label))
+
+
+def _batch(width=8, n=16):
+    r = np.random.RandomState(0)
+    return {"img": r.rand(n, width).astype("float32"),
+            "label": r.randint(0, 4, (n, 1)).astype("int64")}
+
+
+def _bwd_idx(block):
+    return next(i for i, op in enumerate(block.ops)
+                if op.type == "backward")
+
+
+# ---------------------------------------------------------------------------
+# checker 1 — collective divergence
+# ---------------------------------------------------------------------------
+
+def _transpiled_program(extra_allreduce=False):
+    from paddle_tpu.fleet import transpile_collective
+
+    p, st = framework.Program(), framework.Program()
+    with framework.program_guard(p, st):
+        loss = _mlp_loss()
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    transpile_collective(p, nranks=2)
+    if extra_allreduce:
+        # the classic rank-conditional bug: one rank emits an extra
+        # collective the others never post — a deadlock on real ICI
+        g = p.global_block()
+        g.ops.append(Operator(
+            g, "c_allreduce_sum", inputs={"X": [loss.name]},
+            outputs={"Out": [loss.name]}, attrs={"ring_id": 0}))
+    return p, loss
+
+
+def test_collective_schedule_records_transpiled_allreduces():
+    prog, _ = _transpiled_program()
+    sched = analysis.collective_schedule(prog)
+    grads = [op for op in prog.global_block().ops
+             if op.type == "c_allreduce_sum"]
+    assert len(sched) == len(grads) >= 2
+    assert all(r["kind"] == "c_allreduce_sum" and r["ring_id"] == 0
+               for r in sched)
+    # records carry the op location the finding would anchor to
+    assert all(r["block_idx"] == 0 and r["op_idx"] >= 0 for r in sched)
+
+
+def test_cross_rank_divergence_trips_with_location():
+    p0, _ = _transpiled_program()
+    p1, _ = _transpiled_program(extra_allreduce=True)
+    fs = analysis.check_collective_divergence([p0, p1],
+                                              labels=["r0", "r1"])
+    assert len(fs) == 1
+    f = fs[0]
+    assert f.severity == "error" and f.checker == "collective-divergence"
+    assert f.rank == "r1" and f.op_type == "c_allreduce_sum"
+    assert "diverges" in f.message
+    # identical ranks: clean
+    assert not analysis.check_collective_divergence([p0, p0])
+    # strict-prefix direction (r1 is MISSING the extra collective):
+    # the finding still names the diverging rank, not the reference
+    fs = analysis.check_collective_divergence([p1, p0],
+                                              labels=["r0", "r1"])
+    assert len(fs) == 1 and fs[0].rank == "r1"
+    assert "<end of schedule>" in fs[0].message
+
+
+def test_branch_collective_divergence():
+    from paddle_tpu.fluid.layers.collective import _c_allreduce
+
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    pred = fluid.layers.reduce_mean(x) > 0.0
+    fluid.layers.cond(pred,
+                      lambda: _c_allreduce(x, reduce_type="sum"),
+                      lambda: x)
+    prog = fluid.default_main_program()
+    fs = analysis.check_branch_uniformity(prog)
+    assert len(fs) == 1 and fs[0].severity == "error"
+    assert fs[0].op_type == "cond" and fs[0].block_idx == 0
+
+
+def test_branch_collective_nesting_divergence():
+    """A collective inside a while body in one branch repeats per
+    iteration; a bare one in the other branch fires once — flattening
+    the loop away would compare them equal (deadlock-class false
+    negative), so the branch keys must keep the region nesting."""
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    prog = fluid.default_main_program()
+    blk = prog.global_block()
+    t_blk = prog._create_block()
+    w_body = prog._create_block()
+    w_body.append_op(type="c_allreduce_sum", inputs={"X": [x.name]},
+                     outputs={"Out": [x.name]}, attrs={"ring_id": 0})
+    prog._rollback()
+    t_blk.append_op(type="while", inputs={}, outputs={},
+                    attrs={"sub_block": w_body.idx})
+    prog._rollback()
+    f_blk = prog._create_block()
+    f_blk.append_op(type="c_allreduce_sum", inputs={"X": [x.name]},
+                    outputs={"Out": [x.name]}, attrs={"ring_id": 0})
+    prog._rollback()
+    blk.append_op(type="cond", inputs={}, outputs={},
+                  attrs={"sub_block_t": t_blk.idx,
+                         "sub_block_f": f_blk.idx})
+    fs = analysis.check_branch_uniformity(prog)
+    assert len(fs) == 1 and fs[0].severity == "error"
+    assert fs[0].op_type == "cond"
+
+
+def test_branch_identical_schedules_clean():
+    from paddle_tpu.fluid.layers.collective import _c_allreduce
+
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    pred = fluid.layers.reduce_mean(x) > 0.0
+    fluid.layers.cond(pred,
+                      lambda: _c_allreduce(x, reduce_type="sum"),
+                      lambda: _c_allreduce(x * 2.0, reduce_type="sum"))
+    assert not analysis.check_branch_uniformity(
+        fluid.default_main_program())
+
+
+_HLO_A = """\
+module {
+  %0 = "stablehlo.all_reduce"(%arg0) ({
+    ^bb0(%a: tensor<f32>, %b: tensor<f32>):
+    "stablehlo.return"(%a) : (tensor<f32>) -> ()
+  }) {replica_groups = dense<[[0, 1]]>} : (tensor<8xf32>) -> tensor<8xf32>
+  %1 = "stablehlo.all_gather"(%0) {replica_groups = dense<[[0, 1]]>} : (tensor<4xf32>) -> tensor<8xf32>
+}
+"""
+
+
+def test_hlo_schedule_and_cross_rank_divergence():
+    sched = analysis.hlo_collective_schedule(_HLO_A)
+    assert [r["kind"] for r in sched] == ["all_reduce", "all_gather"]
+    assert sched[0]["type"] == "8xf32"
+    assert "0, 1" in sched[0]["replica_groups"]
+    assert not analysis.check_hlo_divergence([_HLO_A, _HLO_A])
+    # rank 1 lowered to a different schedule (missing the gather)
+    hlo_b = _HLO_A.replace("all_gather", "all_reduce")
+    fs = analysis.check_hlo_divergence([_HLO_A, hlo_b],
+                                       labels=["r0", "r1"])
+    assert len(fs) == 1 and fs[0].severity == "error"
+
+
+# ---------------------------------------------------------------------------
+# checker 2 — donation use-after-donate
+# ---------------------------------------------------------------------------
+
+def _seeded_read_after_donate():
+    """A fetch op holds the param's buffer BEFORE its in-place sgd
+    rebind: under state-buffer donation the fetched array observes the
+    updated bytes."""
+    loss = _mlp_loss()
+    fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    prog = fluid.default_main_program()
+    blk = prog.global_block()
+    w = prog.all_parameters()[0].name
+    blk.ops.insert(_bwd_idx(blk) + 1, Operator(
+        blk, "fetch", inputs={"X": [w]}, outputs={}, attrs={}))
+    return prog, loss, w
+
+
+def test_read_after_donate_trips_at_the_rebinding_op():
+    prog, _, w = _seeded_read_after_donate()
+    fs = analysis.check_donation_safety(prog)
+    errs = [f for f in fs if f.severity == "error"]
+    assert len(errs) == 1
+    f = errs[0]
+    assert f.checker == "donation-safety" and f.var == w
+    assert f.op_type == "sgd"  # located at the donated (in-place) use
+    assert "read-after-donate" in f.message
+    # donation off: the buffer is never aliased — no hazard
+    set_flags({"FLAGS_tpu_donate_buffers": False})
+    assert not analysis.check_donation_safety(prog)
+
+
+def test_read_after_donate_inside_loop_body():
+    """A fetch buried in a scan body holding a donated param that the
+    body rebinds per iteration: iteration i's held buffer is clobbered
+    by iteration i+1's in-place update — the walk must descend into
+    sub-blocks (and replay loop bodies) to see it."""
+    H = 4
+    x = fluid.layers.data(name="x", shape=[H], dtype="float32")
+    w = fluid.layers.create_parameter(shape=[H, H], dtype="float32",
+                                      name="loop.w")
+    h = fluid.layers.fc(x, size=H)
+    scan = fluid.layers.Scan(n=2)
+    with scan.block():
+        sub = fluid.default_main_program().current_block()
+        sub.append_op(type="fetch", inputs={"X": [w]}, outputs={},
+                      attrs={})
+        nh = fluid.layers.relu(fluid.layers.matmul(h, w))
+        sub.append_op(type="scale", inputs={"X": [w]},
+                      outputs={"Out": [w]}, attrs={"scale": 0.5})
+        fluid.layers.assign(nh, output=h)
+    fluid.layers.mean(h)
+    prog = fluid.default_main_program()
+    fs = analysis.check_donation_safety(prog)
+    errs = [f for f in fs if f.severity == "error"]
+    assert len(errs) == 1 and errs[0].var == "loop.w"
+    assert errs[0].op_type == "scale"  # the rebinding actor, in-loop
+    # the location names the sub-block op, not the enclosing scan
+    sub_idx = errs[0].block_idx
+    assert sub_idx >= 1
+    assert prog.block(sub_idx).ops[errs[0].op_idx].type == "scale"
+
+
+def test_executor_hook_error_does_not_cache_the_bad_entry():
+    """A caught-and-retried run must re-check, not cache-hit past the
+    lint and dispatch the known-bad program."""
+    prog, loss, _ = _seeded_read_after_donate()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    set_flags({"FLAGS_tpu_static_checks": "error"})
+    for _ in range(2):  # the second run is the regression
+        with pytest.raises(RuntimeError, match="read-after-donate"):
+            exe.run(prog, feed=_batch(), fetch_list=[loss])
+
+
+def test_feed_overwrite_warning():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    prog = fluid.default_main_program()
+    blk = prog.global_block()
+    blk.append_op(type="scale", inputs={"X": [x]}, outputs={"Out": [x]},
+                  attrs={"scale": 2.0})
+    fs = analysis.check_donation_safety(prog, feed_names=["x"])
+    assert [f.severity for f in fs] == ["warning"]
+    assert fs[0].var == "x" and "overwrites feed var" in fs[0].message
+
+
+def test_cross_check_donation_report():
+    report = {"mut_bytes": 1024, "alias_bytes": 0,
+              "aliases_state": False}
+    fs = analysis.cross_check_donation_report([], report)
+    assert len(fs) == 1 and fs[0].severity == "warning"
+    assert "disengaged" in fs[0].message
+    ok = {"mut_bytes": 1024, "alias_bytes": 1024, "aliases_state": True}
+    assert not analysis.cross_check_donation_report([], ok)
+    assert not analysis.cross_check_donation_report([], None)
+
+
+def test_cross_check_against_live_donation_report():
+    """The dynamic side of the cross-check: a clean program's compiled
+    executable really does alias its donated state."""
+    loss = _mlp_loss()
+    fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    prog = fluid.default_main_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feed = _batch()
+    exe.run(prog, feed=feed, fetch_list=[loss])
+    rep = exe.donation_report(prog, feed=feed, fetch_list=[loss])
+    assert rep is not None and rep["aliases_state"]
+    fs = analysis.check_donation_safety(prog, feed_names=list(feed),
+                                        fetch_names=[loss.name])
+    assert not fs
+    assert not analysis.cross_check_donation_report(fs, rep)
+
+
+# ---------------------------------------------------------------------------
+# checker 3 — host sync in hot loops
+# ---------------------------------------------------------------------------
+
+def _seeded_fetch_in_scan():
+    H = 4
+    x = fluid.layers.data(name="x", shape=[H], dtype="float32")
+    w = fluid.layers.create_parameter(shape=[2, H, H], dtype="float32",
+                                      name="lint.w")
+    h = fluid.layers.fc(x, size=H)
+    scan = fluid.layers.Scan(n=2)
+    with scan.block():
+        wi = scan.slice_input(w)
+        nh = fluid.layers.relu(fluid.layers.matmul(h, wi))
+        sub = fluid.default_main_program().current_block()
+        sub.append_op(type="fetch", inputs={"X": [nh]}, outputs={},
+                      attrs={})
+        fluid.layers.Print(nh)
+        fluid.layers.assign(nh, output=h)
+    return fluid.default_main_program(), h
+
+
+def test_fetch_in_scan_body_is_an_error_print_a_warning():
+    prog, _ = _seeded_fetch_in_scan()
+    fs = analysis.check_host_sync(prog)
+    fetch = [f for f in fs if f.op_type == "fetch"]
+    assert len(fetch) == 1 and fetch[0].severity == "error"
+    assert fetch[0].block_idx == 1  # inside the scan sub-block
+    assert "every iteration" in fetch[0].message
+    prints = [f for f in fs if f.op_type == "print"]
+    assert len(prints) == 1 and prints[0].severity == "warning"
+    assert "pure_callback" in prints[0].message
+
+
+def test_rpc_marker_in_while_body_is_an_error():
+    one = fluid.layers.fill_constant([1], "int64", 1)
+    i = fluid.layers.fill_constant([1], "int64", 0)
+    n = fluid.layers.fill_constant([1], "int64", 3)
+    c = fluid.layers.less_than(i, n)
+    w = fluid.layers.While(c)
+    with w.block():
+        sub = fluid.default_main_program().current_block()
+        sub.append_op(type="send", inputs={"X": [i]}, outputs={},
+                      attrs={"endpoints": ["127.0.0.1:6174"]})
+        fluid.layers.assign(i + one, output=i)
+        fluid.layers.less_than(i, n, cond=c)
+    fs = analysis.check_host_sync(fluid.default_main_program())
+    send = [f for f in fs if f.op_type == "send"]
+    assert len(send) == 1 and send[0].severity == "error"
+
+
+def test_dynamic_shape_op_severity_by_loop_depth():
+    prog = fluid.default_main_program()
+    blk = prog.global_block()
+    x = fluid.layers.data(name="x", shape=[4, 6], dtype="float32")
+    blk.append_op(type="multiclass_nms",
+                  inputs={"BBoxes": [x], "Scores": [x]},
+                  outputs={"Out": [blk.create_var(
+                      name="nms.out", shape=(-1, 6),
+                      dtype="float32")]},
+                  attrs={})
+    fs = analysis.check_host_sync(prog)
+    assert [f.severity for f in fs] == ["warning"]
+    assert "unjitted" in fs[0].message
+    # the same op inside a scan body: the whole block goes eager
+    # EVERY step — error
+    sub = prog._create_block()
+    sub.append_op(type="multiclass_nms",
+                  inputs={"BBoxes": [x], "Scores": [x]},
+                  outputs={"Out": [sub.create_var(
+                      name="nms.out2", shape=(-1, 6),
+                      dtype="float32")]},
+                  attrs={})
+    prog._rollback()
+    blk.append_op(type="scan", inputs={}, outputs={},
+                  attrs={"sub_block": sub.idx, "n": 2})
+    fs = analysis.check_host_sync(prog)
+    assert sorted(f.severity for f in fs) == ["error", "warning"]
+
+
+def test_block_host_op_kinds_recurses_to_any_depth():
+    """Satellite audit of lowering._block_host_op_kinds: a host op
+    buried inside a cond inside a while must still be found (checker 3
+    and the jit/eager lowering split both depend on it)."""
+    one = fluid.layers.fill_constant([1], "int64", 1)
+    i = fluid.layers.fill_constant([1], "int64", 0)
+    n = fluid.layers.fill_constant([1], "int64", 3)
+    c = fluid.layers.less_than(i, n)
+    w = fluid.layers.While(c)
+    with w.block():
+        pred = fluid.layers.less_than(i, one)
+        fluid.layers.cond(pred,
+                          lambda: fluid.layers.Print(i),
+                          lambda: i)
+        fluid.layers.assign(i + one, output=i)
+        fluid.layers.less_than(i, n, cond=c)
+    block = fluid.default_main_program().global_block()
+    host, dynamic = lowering._block_host_op_kinds(block)
+    assert host and not dynamic
+    # and the checker locates it at depth 2 (while -> cond branch)
+    fs = analysis.check_host_sync(fluid.default_main_program())
+    prints = [f for f in fs if f.op_type == "print"]
+    assert prints and prints[0].severity == "warning"
+    assert prints[0].block_idx >= 2
+
+
+# ---------------------------------------------------------------------------
+# checker 4 — ZeRO-1 planner invariants
+# ---------------------------------------------------------------------------
+
+def _planned_dp_program():
+    from paddle_tpu.parallel import sharded_update as su
+
+    loss = _mlp_loss()
+    fluid.optimizer.AdamOptimizer(1e-3).minimize(loss)
+    prog = fluid.default_main_program()
+    fluid.CompiledProgram(prog).with_data_parallel(loss_name=loss.name)
+    plan = su.plan_sharded_update(prog, prog.global_block(), 8, "dp")
+    assert plan is not None
+    prog._shard_plan = plan
+    return prog, plan
+
+
+def test_valid_plan_is_clean():
+    prog, _ = _planned_dp_program()
+    assert not analysis.check_shard_plan(prog)
+
+
+def test_non_zeroed_padding_slot_trips():
+    """An op without a shard-aware re-zeroing rule inserted AFTER
+    planning: its output can carry nonzero values in the flat-buffer
+    padding slots straight into the optimizer."""
+    prog, plan = _planned_dp_program()
+    blk = prog.global_block()
+    g = sorted(plan.grad_names)[0]
+    idx = _bwd_idx(blk) + 1
+    blk.ops.insert(idx, Operator(
+        blk, "elementwise_pow", inputs={"X": [g], "Y": [g]},
+        outputs={"Out": [g]}, attrs={}))
+    fs = analysis.check_shard_plan(prog)
+    assert len(fs) == 1
+    f = fs[0]
+    assert f.severity == "error" and f.op_type == "elementwise_pow"
+    assert f.op_idx == idx and f.var == g
+    assert "not provably zeroed" in f.message
+
+
+def test_broadcasting_elementwise_after_planning_trips():
+    """The planner DECLINES programs whose elementwise binary ops
+    broadcast mismatched non-scalar operands over a sharded grad (no
+    flat-shard analogue); the checker must mirror that rule, or a
+    program mutated this way after planning lints clean and then
+    mis-broadcasts at shard-space trace time."""
+    prog, plan = _planned_dp_program()
+    blk = prog.global_block()
+    g = next(n for n in sorted(plan.grad_names)
+             if int(np.prod(blk._find_var_recursive(n).shape)) > 8)
+    vec = blk.create_var(name="lint.bcast.vec", shape=(8,),
+                         dtype="float32")
+    idx = _bwd_idx(blk) + 1
+    blk.ops.insert(idx, Operator(
+        blk, "elementwise_mul", inputs={"X": [g], "Y": [vec.name]},
+        outputs={"Out": [g]}, attrs={"axis": 0}))
+    fs = analysis.check_shard_plan(prog)
+    assert len(fs) == 1
+    f = fs[0]
+    assert f.severity == "error" and f.op_type == "elementwise_mul"
+    assert f.op_idx == idx and f.var == g
+    assert "no flat-shard analogue" in f.message
+    # and the planner really does decline the mutated program
+    from paddle_tpu.parallel import sharded_update as su
+    assert su.plan_sharded_update(prog, blk, 8, "dp") is None
+
+
+def test_tampered_shard_layout_trips():
+    prog, plan = _planned_dp_program()
+    name, info = sorted(plan.sharded_state.items())[0]
+    info.shape = tuple(d + 1 for d in info.shape)
+    fs = analysis.check_shard_plan(prog)
+    assert any(f.severity == "error" and f.var == name
+               and "save" in f.message.lower() for f in fs)
+
+
+def test_mixed_dtype_bucket_trips():
+    from paddle_tpu.parallel.sharded_update import (BucketEntry,
+                                                    GradBucket)
+
+    prog, plan = _planned_dp_program()
+    e32 = BucketEntry("g32", "p32", "p32", (8,), "float32", 8, 0)
+    e16 = BucketEntry("g16", "p16", "p16", (8,), "bfloat16", 8, 1)
+    plan.buckets = (GradBucket(0, [e32, e16]),)
+    fs = analysis.check_shard_plan(prog)
+    assert any(f.severity == "error" and "mixes dtypes" in f.message
+               for f in fs)
+
+
+def test_misaligned_bucket_padding_trips():
+    from paddle_tpu.parallel.sharded_update import (BucketEntry,
+                                                    GradBucket)
+
+    prog, plan = _planned_dp_program()
+    e = BucketEntry("g", "p", "p", (9,), "float32", 8, 0)
+    e.padded = 9  # not a multiple of ndev=8
+    plan.buckets = (GradBucket(0, [e]),)
+    fs = analysis.check_shard_plan(prog)
+    assert any(f.severity == "error" and "misalign" in f.message
+               for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# checker 5 — dtype/shape contracts
+# ---------------------------------------------------------------------------
+
+def test_dtype_contract_drift_and_fp64_promotion():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.scale(x, scale=2.0)
+    prog = fluid.default_main_program()
+    assert not analysis.check_dtype_shape_contracts(prog)
+    # drift the declaration after the op was appended
+    prog.global_block()._find_var_recursive(y.name).dtype = "float16"
+    fs = analysis.check_dtype_shape_contracts(prog)
+    assert [f.severity for f in fs] == ["warning"]
+    assert fs[0].var == y.name and "drifted" in fs[0].message
+    prog.global_block()._find_var_recursive(y.name).dtype = "float32"
+    # fp64 computed from non-fp64 inputs: flagged even when declared
+    fluid.layers.cast(y, "float64")
+    fs = analysis.check_dtype_shape_contracts(prog)
+    assert any("fp64 promotion" in f.message and f.op_type == "cast"
+               for f in fs)
+
+
+def test_shape_contract_drift():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.scale(x, scale=2.0)
+    prog = fluid.default_main_program()
+    v = prog.global_block()._find_var_recursive(y.name)
+    v.shape = (-1, 5)
+    fs = analysis.check_dtype_shape_contracts(prog)
+    assert any(f.var == y.name and "shape" in f.message for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# orchestrator + Executor hook
+# ---------------------------------------------------------------------------
+
+def test_run_static_checks_rejects_unknown_checker():
+    with pytest.raises(ValueError, match="unknown checker"):
+        analysis.run_static_checks(fluid.default_main_program(),
+                                   checkers=["bogus"])
+
+
+def test_run_static_checks_labels_cover_prepended_program():
+    """A caller labeling only rank_programs must still get a Finding
+    (naming the diverging rank), not an IndexError, when the LAST rank
+    diverges from the prepended reference program."""
+    p0, _ = _transpiled_program()
+    p1, _ = _transpiled_program()
+    p2, _ = _transpiled_program(extra_allreduce=True)
+    fs = analysis.run_static_checks(
+        p0, checkers=["collective-divergence"],
+        rank_programs=[p1, p2], rank_labels=["rank1", "rank2"])
+    errs = [f for f in fs if f.severity == "error"]
+    assert len(errs) == 1 and errs[0].rank == "rank2"
+
+
+def test_executor_hook_error_raises_before_dispatch():
+    prog, loss, _ = _seeded_read_after_donate()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    set_flags({"FLAGS_tpu_static_checks": "error"})
+    with pytest.raises(RuntimeError, match="read-after-donate"):
+        exe.run(prog, feed=_batch(), fetch_list=[loss])
+
+
+def test_executor_hook_error_raises_before_the_xla_compile(monkeypatch):
+    """IR-only findings must reject the program BEFORE the (potentially
+    tens of seconds) compile_block call, not after it."""
+    from paddle_tpu.fluid import lowering as lowering_mod
+
+    prog, loss, _ = _seeded_read_after_donate()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    set_flags({"FLAGS_tpu_static_checks": "error"})
+
+    def boom(*a, **k):
+        raise AssertionError("compile_block ran before the lint")
+
+    monkeypatch.setattr(lowering_mod, "compile_block", boom)
+    with pytest.raises(RuntimeError, match="read-after-donate"):
+        exe.run(prog, feed=_batch(), fetch_list=[loss])
+
+
+def test_executor_hook_warn_mode_warns_and_runs():
+    prog, loss, _ = _seeded_read_after_donate()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    set_flags({"FLAGS_tpu_static_checks": "warn"})
+    with pytest.warns(UserWarning, match="tpu-lint"):
+        out = exe.run(prog, feed=_batch(), fetch_list=[loss])
+    assert np.isfinite(np.asarray(out[0])).all()
+
+
+def test_executor_hook_clean_program_passes_under_error():
+    """The acceptance contract: ordinary tier-1 programs lint clean
+    under FLAGS_tpu_static_checks=error — the flag costs nothing."""
+    set_flags({"FLAGS_tpu_static_checks": "error"})
+    loss = _mlp_loss()
+    fluid.optimizer.AdamOptimizer(1e-3).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    out = exe.run(fluid.default_main_program(), feed=_batch(),
+                  fetch_list=[loss])
+    assert np.isfinite(np.asarray(out[0])).all()
+
+
+# ---------------------------------------------------------------------------
+# collective_byte_census region coverage (switch_case/conditional_block)
+# ---------------------------------------------------------------------------
+
+def _dp_mark(prog, nranks=8):
+    import jax
+    from jax.sharding import Mesh
+
+    from paddle_tpu.parallel import env as penv
+
+    mesh = Mesh(np.array(jax.devices()[:nranks]), ("dp",))
+    prog._data_parallel = True
+    prog._mesh = mesh
+    penv.set_global_mesh(mesh)
+    penv.register_ring(0, "dp", nranks)
+
+
+def test_census_counts_switch_case_region_collectives():
+    """lax.switch branches live in non-entry StableHLO regions; the
+    census must count their all_reduces (previously only the gm
+    lax.cond path was regression-tested)."""
+    from paddle_tpu.fluid.layers.collective import _c_allreduce
+
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    idx = fluid.layers.data(name="idx", shape=[1], dtype="int32")
+    out = fluid.layers.switch_case(
+        idx,
+        [lambda: _c_allreduce(x, reduce_type="sum"),
+         lambda: _c_allreduce(x * 2.0, reduce_type="sum")],
+        default=lambda: _c_allreduce(x * 3.0, reduce_type="sum"))
+    loss = fluid.layers.mean(out)
+    prog = fluid.default_main_program()
+    _dp_mark(prog)
+    exe = fluid.Executor(fluid.TPUPlace())
+    feed = {"x": np.ones((8, 4), np.float32),
+            "idx": np.zeros((8, 1), np.int32)}
+    exe.run(prog, feed=feed, fetch_list=[loss])
+    col = exe.collective_report(prog, feed=feed, fetch_list=[loss])
+    assert col is not None
+    # one psum per traced branch (2 keyed + default), each inside its
+    # switch region
+    assert col["all_reduce"]["count"] == 3
+    assert col["all_reduce"]["tensor_bytes"] > 0
+
+
+def test_census_counts_conditional_block_region_collectives():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.scale(x, scale=2.0)
+    c = fluid.layers.reduce_mean(x) > 0.0
+    prog = fluid.default_main_program()
+    blk = prog.global_block()
+    sub = prog._create_block()
+    sub.append_op(type="c_allreduce_sum", inputs={"X": [y]},
+                  outputs={"Out": [y]}, attrs={"ring_id": 0})
+    prog._rollback()
+    blk.append_op(type="conditional_block", inputs={"Cond": [c]},
+                  outputs={}, attrs={"sub_block": sub.idx})
+    loss = fluid.layers.mean(y)
+    _dp_mark(prog)
+    exe = fluid.Executor(fluid.TPUPlace())
+    feed = {"x": np.ones((8, 4), np.float32)}
+    exe.run(prog, feed=feed, fetch_list=[loss])
+    col = exe.collective_report(prog, feed=feed, fetch_list=[loss])
+    assert col is not None
+    assert col.get("all_reduce", {}).get("count", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# exemplar lint-regression harness (tools/tpu_lint.py)
+# ---------------------------------------------------------------------------
+
+def _import_tpu_lint():
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    try:
+        import tpu_lint
+    finally:
+        sys.path.pop(0)
+    return tpu_lint
+
+
+def test_exemplar_programs_lint_clean():
+    """The standing regression: BERT-tiny DP step, resnet scan, and
+    the 2-rank fleet-transpiled sync-PS programs all lint with zero
+    errors across every checker."""
+    tpu_lint = _import_tpu_lint()
+    results = tpu_lint.lint_exemplars()
+    assert set(results) == {"bert_tiny", "resnet_scan",
+                            "fleet_ps_2rank"}
+    for name, (findings, summary) in results.items():
+        errs = [analysis.format_finding(f) for f in findings
+                if f.severity == "error"]
+        assert not errs, (name, errs)
+
+
+@pytest.mark.slow
+def test_cli_end_to_end(tmp_path):
+    out = tmp_path / "static_checks.json"
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "tpu_lint.py"),
+         "--fail-on", "error", "--out", str(out)],
+        capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    report = json.loads(out.read_text())
+    assert report["ok"] and report["total_errors"] == 0
+    assert set(report["programs"]) == {"bert_tiny", "resnet_scan",
+                                       "fleet_ps_2rank"}
+    assert "tpu-lint:" in r.stdout
+
+
+@pytest.mark.slow
+def test_perf_analysis_lint_alias(tmp_path):
+    out = tmp_path / "static_checks.json"
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(_REPO, "tools", "perf_analysis.py"),
+         "--lint", "--out", str(out), "--json"],
+        capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert json.loads(out.read_text())["ok"]

@@ -15,6 +15,12 @@ PERF_ANALYSIS_r4.md with:
 Usage: python tools/perf_analysis.py [--batches 256,512]
        python tools/perf_analysis.py --sharded-diff
        python tools/perf_analysis.py --overlap-audit [--bucket-mb 0.25]
+       python tools/perf_analysis.py --lint [tpu_lint args...]
+
+`--lint` is a thin alias onto tools/tpu_lint.py (the tpu-lint static
+SPMD verifier, paddle_tpu/analysis) so one tool drives every audit:
+remaining args pass through (e.g. `--lint --fail-on warning --json`);
+writes artifacts/static_checks.json.
 
 `--sharded-diff` is the offline check for the ZeRO-1 sharded weight
 update (FLAGS_tpu_sharded_weight_update): it lowers the SAME
@@ -386,6 +392,14 @@ def main():
     batches = [256, 512]
     resnet_batches = [128, 256]
     args = sys.argv[1:]
+    if "--lint" in args:
+        # alias into the tpu-lint static verifier; tools/ is not a
+        # package, so import by path alongside this file
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import tpu_lint
+
+        raise SystemExit(tpu_lint.main(
+            [a for a in args if a != "--lint"]))
     if "--sharded-diff" in args:
         raise SystemExit(sharded_update_diff())
     if "--overlap-audit" in args:

@@ -42,6 +42,9 @@ _MODULES = [
     "paddle_tpu.fluid.incubate.data_generator",
     "paddle_tpu.fleet",
     "paddle_tpu.fleet.metrics",
+    # tpu-lint static verifier: checkers + Finding are a public,
+    # CI-relied-on surface (tools/tpu_lint.py, FLAGS_tpu_static_checks)
+    "paddle_tpu.analysis",
     "paddle_tpu.hapi.model",
     "paddle_tpu.nn",
     "paddle_tpu.tensor",
