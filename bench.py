@@ -804,6 +804,73 @@ def _attach_collectives(result, exe, program, feed, fetch_list):
                      [c["backward_after"] for c in rs]), flush=True)
 
 
+def _attach_precision(result, exe, program, feed, fetch_list):
+    """Mixed-precision evidence block for the step that just ran: the
+    AMP policy it lowered under (compute dtype, level, list sizes), the
+    live-param vs fp32-master HBM split (ZeRO-sharded masters are ~1/N
+    per replica — Executor.donation_report param_* fields), the ZeRO-2
+    peak-grad model, and the loss-scale state for fp16 runs (bf16 needs
+    none by design). Evidence, not gating."""
+    if not getattr(program, "_amp", False):
+        return
+    try:
+        import numpy as np
+
+        lists = getattr(program, "_amp_lists", None)
+        masters = dict(getattr(program, "_amp_master_of", None) or {})
+        block = {
+            "amp_dtype": str(getattr(program, "_amp_dtype", "bfloat16")),
+            "level": "O2" if masters else "O1",
+            "master_weights": len(masters),
+            "white_list_ops": len(lists.white_list) if lists else 0,
+            "black_list_ops": len(lists.black_list) if lists else 0,
+        }
+        rep = exe.donation_report(program, feed=feed,
+                                  fetch_list=fetch_list)
+        for k in ("param_bf16_bytes", "param_master_bytes",
+                  "param_fp32_replicated_bytes", "param_masters_sharded",
+                  "grad_peak_per_replica_bytes",
+                  "grad_replicated_peak_bytes"):
+            if rep and k in rep:
+                block[k] = rep[k]
+        bop = next((op for op in program.global_block().ops
+                    if op.type == "backward"), None)
+        dls = bop.attrs.get("dynamic_loss_scaling") if bop is not None \
+            else None
+        if dls:
+            from paddle_tpu.core.scope import global_scope
+
+            def read(name):
+                v = global_scope().find_var(name)
+                return (float(np.asarray(v).reshape(-1)[0])
+                        if v is not None else None)
+
+            block["loss_scaling"] = {
+                "current": read(dls["scale"]),
+                "good_steps": read(dls["good"]),
+                "bad_steps": read(dls["bad"]),
+                "incr_every_n_steps": dls["incr_every_n_steps"],
+                "decr_every_n_nan_or_inf": dls["decr_every_n_nan_or_inf"],
+            }
+        else:
+            block["loss_scaling"] = None
+        result["precision"] = block
+        msg = ("BENCH precision: %s level=%s masters=%d"
+               % (block["amp_dtype"], block["level"],
+                  block["master_weights"]))
+        if "param_bf16_bytes" in block:
+            msg += (", param %s MB live + %s MB master/replica (fp32 "
+                    "DP would be %s MB)"
+                    % tuple(round(block[k] / 1e6, 2) for k in
+                            ("param_bf16_bytes", "param_master_bytes",
+                             "param_fp32_replicated_bytes")))
+        if block["loss_scaling"]:
+            msg += ", loss_scale=%s" % block["loss_scaling"]["current"]
+        print(msg, flush=True)
+    except Exception as e:  # noqa: BLE001 - evidence, not gating
+        print("BENCH precision block failed: %r" % (e,), flush=True)
+
+
 def _bert_flops_per_token(cfg, n_params, seq_len):
     """Training FLOPs/token: 6*N for the param matmuls plus the
     attention score/context matmuls (12*L*S*H per token: QK^T and AV are
@@ -925,6 +992,7 @@ def _bench_child(platform: str, batch: int, steps: int, warmup: int,
         "phases": phases,
     }
     _attach_collectives(result, exe, main_p, feed, [total])
+    _attach_precision(result, exe, main_p, feed, [total])
     _attach_static_checks(result, main_p)
     if model != "longctx":
         # no V100 baseline exists for the seq-4096 config (a 32 GB V100
@@ -1090,6 +1158,7 @@ def _bench_resnet(batch: int, steps: int, warmup: int,
         "phases": phases,
     }
     _attach_collectives(result, exe, main_p, feed, [loss])
+    _attach_precision(result, exe, main_p, feed, [loss])
     _attach_static_checks(result, main_p)
     if platform == "tpu":
         result["mfu_pct"] = round(

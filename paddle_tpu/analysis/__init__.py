@@ -9,7 +9,7 @@ the lowered StableHLO), before a single chip cycle is spent. On-chip
 validation windows are scarce; these checkers turn "hangs 40 minutes
 into a tunnel session" into "fails in CI in 4 seconds".
 
-Five checkers (see README.md in this directory for the full catalog):
+Six checkers (see README.md in this directory for the full catalog):
 
 1. ``collective-divergence`` — per-rank programs (and branch regions)
    must emit identical collective schedules (collectives.py).
@@ -19,8 +19,11 @@ Five checkers (see README.md in this directory for the full catalog):
    bodies defeat the async pipeline (host_sync.py).
 4. ``zero1-invariants`` — shard-plan padding zeroing, bucket dtype
    homogeneity, checkpoint save/restore layout (sharding.py).
-5. ``dtype-contract`` — declared vs computed out dtype/shape, silent
-   fp64 promotions (contracts.py).
+5. ``zero2-lifetimes`` — no op reads a FULL gradient after its bucket
+   reduce-scattered; buckets flush whole, fetches of scattered grads
+   flagged (sharding.py).
+6. ``dtype-contract`` — declared vs computed out dtype/shape, silent
+   fp64 promotions, redundant AMP cast round-trips (contracts.py).
 
 Surfaces: ``tools/tpu_lint.py`` (CLI, JSON artifact, --fail-on),
 ``FLAGS_tpu_static_checks={off,warn,error}`` (Executor compile-time
@@ -40,7 +43,8 @@ from .collectives import (IR_COLLECTIVE_OPS,  # noqa: F401
 from .donation import (check_donation_safety,  # noqa: F401
                        cross_check_donation_report)
 from .host_sync import check_host_sync  # noqa: F401
-from .sharding import check_shard_plan  # noqa: F401
+from .sharding import (check_shard_plan,  # noqa: F401
+                       check_zero2_lifetimes)
 from .contracts import check_dtype_shape_contracts  # noqa: F401
 
 __all__ = [
@@ -50,13 +54,13 @@ __all__ = [
     "check_branch_uniformity", "check_collective_divergence",
     "hlo_collective_schedule", "check_hlo_divergence",
     "check_donation_safety", "cross_check_donation_report",
-    "check_host_sync", "check_shard_plan",
+    "check_host_sync", "check_shard_plan", "check_zero2_lifetimes",
     "check_dtype_shape_contracts", "run_static_checks",
 ]
 
 #: checker registry: name -> "does it run in the single-program pass"
 CHECKERS = ("collective-divergence", "donation-safety", "host-sync",
-            "zero1-invariants", "dtype-contract")
+            "zero1-invariants", "zero2-lifetimes", "dtype-contract")
 
 
 def run_static_checks(program, feed_names=None, fetch_names=None,
@@ -98,6 +102,9 @@ def run_static_checks(program, feed_names=None, fetch_names=None,
         findings += check_host_sync(program)
     if "zero1-invariants" in sel:
         findings += check_shard_plan(program)
+    if "zero2-lifetimes" in sel:
+        findings += check_zero2_lifetimes(program,
+                                          fetch_names=fetch_names)
     if "dtype-contract" in sel:
         findings += check_dtype_shape_contracts(program)
     return sort_findings(findings)

@@ -27,6 +27,17 @@ compute — printing, saving files...), `dynamic_shape` ops (the contract
 is value-dependent), framework pseudo-ops (feed/fetch/backward/control
 flow — not registered), and ops whose inference raises (same contract
 as Block._infer_op_shapes: leave declared shapes alone).
+
+AMP awareness (programs marked by `mixed_precision.decorate`): the AMP
+pass inserts its casts at TRACE time, invisible to declarations — so a
+float32<->compute-dtype disagreement is the policy working, not drift,
+and is suppressed; likewise the fp64-promotion check never fires on
+white-listed ops (they run in the 16-bit dtype at runtime, where an
+inferred f64 cannot occur). New ``redundant-cast`` warnings flag cast
+round-trips the AMP pass should have elided: an explicit
+``cast(cast(x, f32), bf16)`` chain whose intermediate has no other
+reader, and an up-cast to fp32 feeding ONLY white-list ops (the policy
+re-casts those inputs straight back down).
 """
 from __future__ import annotations
 
@@ -64,10 +75,29 @@ def _is_f64_request(attr_value):
         attr_value == np.dtype("float64")
 
 
+def _amp_policy_of(program):
+    """(amp_lists, low_dtype_name) for AMP programs, else (None, None)."""
+    if not getattr(program, "_amp", False):
+        return None, None
+    lists = getattr(program, "_amp_lists", None)
+    if lists is None:
+        return None, None
+    return lists, str(getattr(program, "_amp_dtype", "bfloat16"))
+
+
 def check_dtype_shape_contracts(program) -> List[Finding]:
     from .. import ops as ops_lib
 
+    amp_lists, amp_low = _amp_policy_of(program)
+
+    def amp_mixed_ok(a, b):
+        # under AMP the trace-time casts make EITHER side of the
+        # f32<->compute-dtype pair a legitimate declaration
+        return amp_lists is not None and {str(a), str(b)} == \
+            {"float32", amp_low}
+
     findings: List[Finding] = []
+    findings += _check_redundant_casts(program, amp_lists, amp_low)
     for block in program.blocks:
         for op_idx, op in enumerate(block.ops):
             if not ops_lib.has_op(op.type):
@@ -95,7 +125,12 @@ def check_dtype_shape_contracts(program) -> List[Finding]:
                 in_specs[slot] = specs
             if missing:
                 continue
-            if not any_f64_in:
+            amp_white = amp_lists is not None and \
+                op.type in amp_lists.white_list
+            if not any_f64_in and not amp_white:
+                # white-listed ops under AMP run in the 16-bit compute
+                # dtype at runtime — a promotion to f64 cannot occur
+                # there, so the check would only mis-flag them
                 f64_attrs = [k for k, v in op.attrs.items()
                              if _is_f64_request(v)]
                 if f64_attrs:
@@ -131,8 +166,8 @@ def check_dtype_shape_contracts(program) -> List[Finding]:
                     decl_dtype = str(v.dtype)
                     loc = dict(block_idx=block.idx, op_idx=op_idx,
                                op_type=op.type, var=n)
-                    if not any_f64_in and "float64" in (inf_dtype,
-                                                       decl_dtype):
+                    if not any_f64_in and not amp_white and \
+                            "float64" in (inf_dtype, decl_dtype):
                         # inferred f64 only appears with x64 enabled;
                         # a DECLARED f64 out from non-f64 inputs is the
                         # same leak seen from the contract side (under
@@ -149,7 +184,8 @@ def check_dtype_shape_contracts(program) -> List[Finding]:
                             "leaked into the op." % (
                                 n, decl_dtype, inf_dtype),
                             **loc))
-                    elif inf_dtype != decl_dtype:
+                    elif inf_dtype != decl_dtype and \
+                            not amp_mixed_ok(inf_dtype, decl_dtype):
                         findings.append(Finding(
                             "dtype-contract", "warning",
                             "out var %r declares dtype %s but the "
@@ -167,4 +203,98 @@ def check_dtype_shape_contracts(program) -> List[Finding]:
                             "registered compute produces %s." % (
                                 n, decl_shape, inf_shape),
                             **loc))
+    return findings
+
+
+def _itemsize(dtype_name):
+    try:
+        from ..core.types import to_numpy_dtype
+        import numpy as np
+
+        return np.dtype(to_numpy_dtype(dtype_name)).itemsize
+    except Exception:  # noqa: BLE001 - unknown dtype name: no opinion
+        return 0
+
+
+def _check_redundant_casts(program, amp_lists, amp_low) -> List[Finding]:
+    """redundant-cast: cast round-trips the AMP pass should have elided.
+
+    (a) ``z = cast(y, D)`` where ``y = cast(x, _)`` with x's dtype == D,
+        y at least as wide as D (the LOSSLESS direction — bf16 -> fp32
+        -> bf16 is an identity; fp32 -> bf16 -> fp32 is an intended
+        truncation) and y has no other reader: the pair burns two
+        converts and an HBM round-trip of the full tensor for nothing.
+    (b) AMP programs only: ``y = cast(x, float32)`` where x is the
+        16-bit compute dtype and EVERY reader of y is a white-list op —
+        the trace-time policy casts white-list inputs straight back
+        down, so the explicit up-cast round-trips by construction.
+    """
+    from ..fluid import lowering
+
+    findings: List[Finding] = []
+    for block in program.blocks:
+        readers: dict = {}  # var -> [ops reading it]
+        for op in block.ops:
+            # _op_reads_writes descends into while/scan/cond bodies: a
+            # sub-block read of the cast intermediate must count, or
+            # both warnings below fire on casts a loop body depends on
+            # (the reader recorded is the ENCLOSING control-flow op,
+            # which is never white-listed — conservative for rule (b))
+            for n in set(lowering._op_reads_writes(op)[0]):
+                readers.setdefault(n, []).append(op)
+        cast_src: dict = {}  # var -> source dtype of the cast chain
+        producer: dict = {}  # var -> last writer op type
+        for op_idx, op in enumerate(block.ops):
+            if op.type != "cast":
+                for n in op.output_arg_names:
+                    cast_src.pop(n, None)
+                    producer[n] = op.type
+                continue
+            x = (op.input_names.get("X") or [None])[0]
+            out = (op.output_names.get("Out") or [None])[0]
+            if x is None or out is None:
+                continue
+            xv = block._find_var_recursive(x)
+            out_dt = str(op.attrs.get("out_dtype", ""))
+            # the dtype BEFORE the producer cast (its input's dtype) —
+            # a round trip closes when this cast restores it
+            src_dt = cast_src.get(x)
+            loc = dict(block_idx=block.idx, op_idx=op_idx,
+                       op_type=op.type, var=out)
+            x_dt = str(getattr(xv, "dtype", "")) if xv is not None \
+                else ""
+            if producer.get(x) == "cast" and src_dt and \
+                    src_dt == out_dt and \
+                    _itemsize(x_dt) >= _itemsize(out_dt) and \
+                    len(readers.get(x, [])) == 1:
+                findings.append(Finding(
+                    "dtype-contract", "warning",
+                    "redundant-cast: %r round-trips %s -> %s -> %s "
+                    "through single-use intermediate %r — the pair is "
+                    "an identity the AMP pass should have elided." % (
+                        out, src_dt,
+                        str(getattr(xv, "dtype", "?")) if xv is not None
+                        else "?", out_dt, x),
+                    **loc))
+            elif amp_lists is not None and out_dt == "float32" and \
+                    str(getattr(xv, "dtype", "")) == amp_low and \
+                    out not in (getattr(amp_lists, "black_varnames",
+                                        None) or ()):
+                # a black-named var is PINNED to fp32 — the policy
+                # skips the down-cast for it, so this up-cast is
+                # load-bearing, not redundant
+                outs_readers = readers.get(out, [])
+                if outs_readers and all(
+                        r.type in amp_lists.white_list
+                        for r in outs_readers):
+                    findings.append(Finding(
+                        "dtype-contract", "warning",
+                        "redundant-cast: %r up-casts %s -> float32 but "
+                        "every reader is a white-list op — the AMP "
+                        "policy casts those inputs straight back to "
+                        "%s; drop the explicit cast." % (
+                            out, amp_low, amp_low),
+                        **loc))
+            producer[out] = "cast"
+            cast_src[out] = str(op.attrs.get("in_dtype", "") or x_dt)
     return findings

@@ -1,4 +1,13 @@
-"""Checker 4 — ZeRO-1 sharded-update planner invariants.
+"""Checkers 4 & 6 — ZeRO sharded-update invariants.
+
+Checker 4 (``zero1-invariants``): re-verifies a ShardedUpdatePlan's
+padding/bucket/checkpoint-layout invariants. Checker 6
+(``zero2-lifetimes``, `check_zero2_lifetimes`): statically proves the
+ZeRO-2 gradient-lifetime contract — after a gradient's (bucket)
+reduce-scatter only its 1/N shard may stay live until the optimizer
+consumes it; any op that would force the full gradient back
+(a non-shard-aware reader triggers an all_gather) resurrects the
+replicated peak-grad footprint.
 
 `parallel/sharded_update.plan_sharded_update` proves a program's
 post-backward section safe to run on flat 1/N shards and falls back to
@@ -143,32 +152,23 @@ def check_shard_plan(program, plan=None) -> List[Finding]:
         if not tin:
             tainted -= writes
             continue
-        if op.type in su._EW_BINARY:
-            # mirror the planner's decline rule (sharded_update):
-            # broadcasting mismatched NON-scalar operands over a
-            # sharded grad has no flat-shard analogue — an op like
-            # this after planning mis-broadcasts (or raises) at
+        if op.type in su._EW_BINARY and su.broadcast_mismatch(op, block):
+            # the planner's decline rule, shared verbatim
+            # (su.broadcast_mismatch): mismatched NON-scalar operands
+            # over a sharded grad have no flat-shard analogue — an op
+            # like this after planning mis-broadcasts (or raises) at
             # shard-space trace time
-            numels = []
-            for slot in ("X", "Y"):
-                for n in op.input_names.get(slot, []):
-                    v = block._find_var_recursive(n)
-                    shp = tuple(getattr(v, "shape", ()) or ())
-                    if shp:
-                        numels.append(int(np.prod(shp)))
-            if len(numels) == 2 and numels[0] != numels[1] \
-                    and 1 not in numels:
-                findings.append(Finding(
-                    "zero1-invariants", "error",
-                    "op %r broadcasts mismatched non-scalar operands "
-                    "(numels %s) over sharded gradient(s) %s — no "
-                    "flat-shard analogue exists; the planner declines "
-                    "such programs, so this op was inserted after "
-                    "planning." % (op.type, numels, sorted(tin)),
-                    block_idx=block.idx, op_idx=op_idx,
-                    op_type=op.type, var=sorted(tin)[0]))
-                tainted |= writes
-                continue
+            findings.append(Finding(
+                "zero1-invariants", "error",
+                "op %r broadcasts mismatched non-scalar operands "
+                "over sharded gradient(s) %s — no flat-shard "
+                "analogue exists; the planner declines such "
+                "programs, so this op was inserted after "
+                "planning." % (op.type, sorted(tin)),
+                block_idx=block.idx, op_idx=op_idx,
+                op_type=op.type, var=sorted(tin)[0]))
+            tainted |= writes
+            continue
         if op.type in rezeroing:
             tainted |= writes  # exec re-zeros padding (_zero_pad_slots)
         elif op.type in untainting:
@@ -189,4 +189,127 @@ def check_shard_plan(program, plan=None) -> List[Finding]:
                 block_idx=block.idx, op_idx=op_idx, op_type=op.type,
                 var=sorted(tin)[0]))
             tainted |= writes  # keep walking for further findings
+    return findings
+
+
+def check_zero2_lifetimes(program, plan=None,
+                          fetch_names=None) -> List[Finding]:
+    """Checker 6 — ZeRO-2 sharded gradient lifetimes.
+
+    The runtime contract: a gradient's FULL buffer lives only from its
+    materialization in the backward sweep to its (bucket)
+    reduce-scatter; from the scatter to the owning optimizer op only
+    the 1/N shard is live, and full-size buffers die bucket-by-bucket.
+    This checker proves it statically:
+
+    - **no full-grad resurrection** (error): every post-backward op
+      reading a scattered gradient must be in the shard-aware
+      vocabulary (or the owning optimizer op) — anything else would
+      all_gather the full grad back, returning peak grad HBM to the
+      replicated footprint. Mirrors the planner's decline rule, so a
+      violation means the program mutated after planning.
+    - **fetch gathers** (warning): fetching a scattered grad var
+      materializes the full buffer on every replica.
+    - **bucket lifetime ordering** (warning, explicit-sync bucketed
+      programs): an op reading a grad whose bucket is still PENDING
+      forces a partial early flush — the bucket's full grads die in
+      pieces and the single-collective batching is lost for it.
+    """
+    from ..fluid import lowering
+    from ..parallel import sharded_update as su
+
+    plan = plan if plan is not None else getattr(program, "_shard_plan",
+                                                 None)
+    if plan is None:
+        return []
+    scattered = set(plan.grad_names) | set(plan.rs_targets)
+    if not scattered:
+        return []
+    block = program.global_block()
+    findings: List[Finding] = []
+    ops = list(block.ops)
+    bwd_idx = next((i for i, op in enumerate(ops)
+                    if op.type == "backward"), None)
+    if bwd_idx is None:
+        return []
+    post = ops[bwd_idx + 1:]
+    vocab = (su._EW_UNARY | su._EW_BINARY | su._NORM_REDUCE
+             | {"sum", "clip_by_norm"})
+    # implicit-sync grads are shards from the vjp boundary on;
+    # explicit-sync grads become shards at their c_allreduce_sum op
+    live_shard = set(plan.grad_names)
+    pending: dict = {}  # bucket index -> pending grad names
+    for i, op in enumerate(post):
+        op_idx = bwd_idx + 1 + i
+        reads, writes = lowering._op_reads_writes(op)
+        reads, writes = set(reads), set(writes)
+        if op.type == "c_allreduce_sum":
+            xs = op.input_names.get("X", [])
+            if len(xs) == 1 and xs[0] in plan.rs_targets:
+                g = xs[0]
+                b = plan.bucket_of.get(g)
+                if b is not None:
+                    pend = pending.setdefault(b.index, set())
+                    pend.add(g)
+                    if len(pend) == len(b.entries):
+                        live_shard |= pending.pop(b.index)
+                else:
+                    live_shard.add(g)
+                continue
+        if pending:
+            for bi in [bi for bi, names in pending.items()
+                       if reads & names]:
+                flushed = pending.pop(bi)
+                live_shard |= flushed
+                findings.append(Finding(
+                    "zero2-lifetimes", "warning",
+                    "op %r reads grad(s) %s while bucket %d is still "
+                    "pending — the bucket reduce-scatters early "
+                    "(partial), so its full-size grads die in pieces "
+                    "instead of at one collective; peak grad HBM and "
+                    "collective count grow for this bucket." % (
+                        op.type, sorted(reads & flushed), bi),
+                    block_idx=block.idx, op_idx=op_idx,
+                    op_type=op.type, var=sorted(reads & flushed)[0]))
+        tin = reads & live_shard
+        if not tin:
+            live_shard -= writes  # full overwrite: the shard is gone
+            continue
+        if id(op) in plan.opt_op_ids:
+            continue  # the shard's intended consumer
+        if op.type in su._EW_BINARY and su.broadcast_mismatch(op, block):
+            # the planner's decline rule, shared verbatim
+            # (su.broadcast_mismatch): a mis-broadcast in shard space
+            # cannot preserve the 1/N lifetime
+            findings.append(Finding(
+                "zero2-lifetimes", "error",
+                "op %r broadcasts mismatched non-scalar operands "
+                "over scattered gradient(s) %s — no flat-shard "
+                "analogue exists, so the 1/N lifetime cannot be "
+                "preserved; the planner declines such programs, this "
+                "op was inserted after planning." % (
+                    op.type, sorted(tin)),
+                block_idx=block.idx, op_idx=op_idx,
+                op_type=op.type, var=sorted(tin)[0]))
+            continue
+        if op.type in vocab:
+            continue  # shard-space rule exists; the shard stays 1/N
+        findings.append(Finding(
+            "zero2-lifetimes", "error",
+            "op %r reads gradient(s) %s AFTER their reduce-scatter "
+            "without a shard-space rule — execution would all_gather "
+            "the full gradient back, returning peak grad HBM to the "
+            "replicated footprint (ZeRO-2 lifetime violated; the "
+            "planner declines such programs, so this op was inserted "
+            "after planning)." % (op.type, sorted(tin)),
+            block_idx=block.idx, op_idx=op_idx, op_type=op.type,
+            var=sorted(tin)[0]))
+    for g in (fetch_names or []):
+        if g in scattered:
+            findings.append(Finding(
+                "zero2-lifetimes", "warning",
+                "fetch of scattered gradient %r gathers the FULL "
+                "buffer on every replica — drop it from the fetch "
+                "list to keep the ZeRO-2 grad footprint at 1/N." % g,
+                var=g))
     return findings

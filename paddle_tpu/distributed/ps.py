@@ -93,9 +93,14 @@ class PSCommunicator:
     def _pull_batched(self, scope, clients=None):
         client = clients or self._client
         for ep, names in sorted(self._groups().items()):
-            vals = client(ep).call("get_params_batch", *names)
+            c = client(ep)
+            vals = c.call("get_params_batch", *names)
             for pname, val in zip(names, vals):
                 scope.set_var(pname, val)
+            # acked-release: the params-sized reply is applied — free
+            # the server's retained dedup blob now instead of pinning
+            # it until this trainer's next RPC (next step's push)
+            c.ack_last()
 
     def init_params(self, scope):
         """Seed the pserver tables with this trainer's initial params

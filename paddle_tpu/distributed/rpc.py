@@ -247,6 +247,26 @@ class RpcServer:
             with self._dedup_lock:
                 self._dedup.pop(cid, None)
             return ["ok"], False, method
+        if method == "__rpc_ack__":
+            # acked-release: the client confirms it APPLIED the
+            # response to the named seq, so the retained blob (a
+            # params-sized get_params_batch reply pinned per trainer
+            # between steps otherwise) can be freed NOW. The seq marker
+            # stays for dedup; a tiny tombstone replaces the payload —
+            # safe because a client only acks after receiving, so no
+            # retry of that seq can ever need the cached bytes again.
+            try:
+                acked = int(args[0])
+            except (IndexError, TypeError, ValueError):
+                return (["exc", "ValueError",
+                         "__rpc_ack__ needs the acked seq", ""],
+                        False, method)
+            with self._dedup_lock:
+                ent = self._dedup.get(cid)
+                if ent is not None and ent["seq"] == acked \
+                        and ent["resp"] is not None:
+                    ent["resp"] = ["ok"]
+            return ["ok"], False, method
 
         with self._dedup_lock:
             ent = self._dedup.get(cid)
@@ -475,6 +495,30 @@ class RpcClient:
                            e)) from e
                 time.sleep(min(self._backoff_s * (2 ** (attempt - 1)),
                                self._backoff_max_s))
+
+    def ack_last(self):
+        """Acked-release: tell the server the LAST call's response has
+        been applied, so it frees the retained dedup blob immediately
+        instead of pinning ~response-sized bytes until this client's
+        next request. Best-effort and cheap (one tiny round trip on the
+        live socket, no retry): if it's lost, the next real request
+        frees the blob anyway."""
+        with self._lock:
+            acked = self._seq
+            self._seq += 1
+            payload = [_ENVELOPE, self._cid, self._seq, "__rpc_ack__",
+                       acked]
+            try:
+                if self._sock is None:
+                    return
+                faults.on_message("client", "send",
+                                  method="__rpc_ack__", sock=self._sock)
+                write_msg(self._sock, payload)
+                faults.on_message("client", "recv",
+                                  method="__rpc_ack__", sock=self._sock)
+                read_msg(self._sock)
+            except (ConnectionError, OSError):
+                self._drop_sock()
 
     def close(self):
         # best-effort goodbye so the server drops this client's dedup

@@ -1,2 +1,5 @@
 from .decorator import decorate, OptimizerWithMixedPrecision  # noqa: F401
 from .fp16_lists import AutoMixedPrecisionLists  # noqa: F401
+from .fp16_utils import (EagerMasterWeightOptimizer,  # noqa: F401
+                         master_name, rewrite_master_weights,
+                         wire_dynamic_loss_scaling)

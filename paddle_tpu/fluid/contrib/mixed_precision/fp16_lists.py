@@ -2,8 +2,11 @@
 `python/paddle/fluid/contrib/mixed_precision/fp16_lists.py:28`).
 
 On TPU the 16-bit type is bfloat16: same exponent range as fp32, so the
-white list can be broader and dynamic loss scaling is unnecessary (kept as
-API no-ops)."""
+white list can be broader and dynamic loss scaling is unnecessary (it IS
+wired — lowering._run_loss_scaled_post — for `amp_dtype="float16"`).
+How the lists drive the trace-time cast policy, the fp32 master-weight
+layout and its ZeRO sharding: `paddle_tpu/parallel/README.md`
+("Mixed precision & ZeRO-2")."""
 from __future__ import annotations
 
 # MXU-bound ops: run in bf16

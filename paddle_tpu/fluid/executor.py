@@ -266,14 +266,16 @@ class Executor:
                     warnings.warn(
                         "feed variables never read by the program: %s"
                         % unused)
-            # tpu-lint, post-compile leg: zero1-invariants verifies the
-            # ShardedUpdatePlan that compile_block just attached
-            # (program._shard_plan), so it cannot run in the fail-fast
-            # leg above. MUST run before the entry is cached: in error
-            # mode a caught-and-retried run would otherwise cache-hit
-            # past the check and dispatch the known-bad program
+            # tpu-lint, post-compile leg: zero1-invariants and
+            # zero2-lifetimes verify the ShardedUpdatePlan that
+            # compile_block just attached (program._shard_plan), so
+            # they cannot run in the fail-fast leg above. MUST run
+            # before the entry is cached: in error mode a caught-and-
+            # retried run would otherwise cache-hit past the check and
+            # dispatch the known-bad program
             self._static_checks(program, feed_arrays, fetch_names,
-                                checkers=("zero1-invariants",))
+                                checkers=("zero1-invariants",
+                                          "zero2-lifetimes"))
             if use_program_cache:
                 self._cache[key] = entry
                 limit = int(get_flag("FLAGS_tpu_compile_cache_size", 128)
@@ -864,6 +866,46 @@ class Executor:
             out["grad_bucket_per_replica_bytes"] = sum(
                 b.shard_numel(ndev) * b.dtype.itemsize
                 for b in plan.buckets)
+            # ZeRO-2 gradient-lifetime model: full-size grad buffers die
+            # bucket-by-bucket (each bucket's only full-value consumer
+            # is its own reduce-scatter, verified statically by the
+            # zero2-lifetimes checker), so at most ONE bucket's full
+            # grads coexist with the accumulated 1/N shards — vs the
+            # replicated path where every full grad is live at once
+            out["grad_peak_per_replica_bytes"] = (
+                max(b.nbytes for b in plan.buckets)
+                + out["grad_bucket_per_replica_bytes"])
+            out["grad_replicated_peak_bytes"] = \
+                out["grad_bucket_logical_bytes"]
+        # mixed precision (AMP level O2): live params in the 16-bit
+        # compute dtype + fp32 masters — ZeRO-sharded masters cost
+        # padded/N fp32 bytes per replica, so per-replica param state is
+        # ~(2 + 4/N) bytes/elem vs fp32 DP's 4 (halved for N >= 4)
+        prog = program or framework.default_main_program()
+        from . import compiler as _compiler
+
+        if isinstance(prog, _compiler.CompiledProgram):
+            prog = prog._unwrap()
+        amp_masters = dict(getattr(prog, "_amp_master_of", None) or {})
+        if amp_masters:
+            block = prog.global_block()
+            p_bytes = m_rep = m_logical = 0
+            for p, m in amp_masters.items():
+                pv = block._find_var_recursive(p)
+                if pv is None:
+                    continue
+                numel = int(np.prod(tuple(pv.shape) or (1,)))
+                p_bytes += numel * np.dtype(
+                    to_numpy_dtype(pv.dtype)).itemsize
+                m_logical += numel * 4
+                info = sharded.get(m)
+                m_rep += ((info.padded // ndev) * 4 if info is not None
+                          else numel * 4)
+            out["param_bf16_bytes"] = p_bytes
+            out["param_master_bytes"] = m_rep
+            out["param_fp32_replicated_bytes"] = m_logical
+            out["param_masters_sharded"] = sum(
+                1 for m in amp_masters.values() if m in sharded)
         return out
 
     @staticmethod

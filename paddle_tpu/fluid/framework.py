@@ -553,6 +553,9 @@ class Program:
         if getattr(self, "_amp", False):
             p._amp = self._amp
             p._amp_lists = self._amp_lists
+            p._amp_dtype = getattr(self, "_amp_dtype", "bfloat16")
+            if getattr(self, "_amp_master_of", None):
+                p._amp_master_of = dict(self._amp_master_of)
         p.blocks = []
         for b in self.blocks:
             nb = Block(p, b.idx, b.parent_idx)

@@ -34,9 +34,33 @@ def _save_dict(dirname, d, filename=None):
             pickle.dump(d, f, protocol=2)
     else:
         for name, arr in d.items():
+            arr = np.asarray(arr)
             safe = name.replace("/", "%2F")
-            np.save(os.path.join(dirname, safe + ".npy"), arr,
-                    allow_pickle=False)
+            path = os.path.join(dirname, safe + ".npy")
+            sidecar = os.path.join(dirname, safe + ".dtype")
+            if arr.dtype.kind == "V":
+                # ml_dtypes extension types (bf16 AMP params): the npy
+                # descr degrades them to raw void on reload — store the
+                # bit pattern as uintN with the true dtype in a sidecar
+                np.save(path, arr.view("u%d" % arr.dtype.itemsize),
+                        allow_pickle=False)
+                with open(sidecar, "w") as f:
+                    f.write(str(arr.dtype))
+            else:
+                np.save(path, arr, allow_pickle=False)
+                if os.path.exists(sidecar):
+                    os.remove(sidecar)
+
+
+def _np_load(path):
+    arr = np.load(path)
+    sidecar = path[:-4] + ".dtype"
+    if os.path.exists(sidecar):
+        from ..core.types import to_numpy_dtype
+
+        with open(sidecar) as f:
+            arr = arr.view(to_numpy_dtype(f.read().strip()))
+    return arr
 
 
 def _load_dict(dirname, names=None, filename=None):
@@ -49,11 +73,11 @@ def _load_dict(dirname, names=None, filename=None):
             safe = name.replace("/", "%2F")
             p = os.path.join(dirname, safe + ".npy")
             if os.path.exists(p):
-                out[name] = np.load(p)
+                out[name] = _np_load(p)
     else:
         for fn in os.listdir(dirname):
             if fn.endswith(".npy"):
-                out[fn[:-4].replace("%2F", "/")] = np.load(
+                out[fn[:-4].replace("%2F", "/")] = _np_load(
                     os.path.join(dirname, fn))
     return out
 

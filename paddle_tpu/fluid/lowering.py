@@ -189,18 +189,10 @@ def _exec_op(op, env, key0, op_idx, amp_lists=None):
             attach_op_callstack(NotFoundError(
                 "op %s: input var %s not materialized (feed it or run "
                 "the startup program)" % (t, e)), op)
-    # bf16 AMP policy (reference: fp16_utils.py cast insertion; here the
+    # AMP policy (reference: fp16_utils.py cast insertion; here the
     # casts are applied at trace time and fused by XLA)
     if amp_lists is not None:
-        def cast_ins(src, dst):
-            return {s: [v.astype(dst)
-                        if hasattr(v, "dtype") and v.dtype == src else v
-                        for v in vs] for s, vs in ins.items()}
-
-        if t in amp_lists.white_list:
-            ins = cast_ins(jnp.float32, jnp.bfloat16)
-        elif t in amp_lists.black_list:
-            ins = cast_ins(jnp.bfloat16, jnp.float32)
+        ins = _apply_amp_casts(t, op, ins, amp_lists)
     attrs = dict(op.attrs)
     if opdef.needs_rng:
         attrs["_rng_key"] = jax.random.fold_in(key0, op_idx)
@@ -219,6 +211,76 @@ def _exec_op(op, env, key0, op_idx, amp_lists=None):
         vals = outs.get(slot, [])
         for n, v in zip(names, vals):
             env[n] = v
+
+
+class _AmpTracePolicy:
+    """The AMP lowering 'pass', trace-time form: per-op white/black-list
+    casts at list boundaries (white-list matmul/conv inputs drop to the
+    16-bit compute dtype for the MXU; black-list softmax/norm/reduce
+    inputs lift back to fp32), applied as the block traces so XLA fuses
+    every inserted convert. Parameterized by `program._amp_dtype`
+    (bf16 default, fp16 with loss scaling) and honoring the lists'
+    `black_varnames` (vars pinned to fp32 by name). Gray-list ops
+    follow their inputs — no casts — exactly the reference policy."""
+
+    __slots__ = ("lists", "low")
+
+    def __init__(self, lists, dtype_name):
+        import jax.numpy as jnp
+
+        self.lists = lists
+        self.low = jnp.float16 if str(dtype_name) == "float16" \
+            else jnp.bfloat16
+
+    # duck-type the raw AutoMixedPrecisionLists surface for callers
+    # that inspect the policy (analysis/contracts.py, tests)
+    @property
+    def white_list(self):
+        return self.lists.white_list
+
+    @property
+    def black_list(self):
+        return self.lists.black_list
+
+
+def _amp_trace_policy(program):
+    """program -> _AmpTracePolicy (or None when AMP is off)."""
+    if not getattr(program, "_amp", False):
+        return None
+    lists = getattr(program, "_amp_lists", None)
+    if lists is None:
+        return None
+    return _AmpTracePolicy(lists,
+                           getattr(program, "_amp_dtype", "bfloat16"))
+
+
+def _apply_amp_casts(t, op, ins, amp):
+    """Insert the list-boundary casts for one op's inputs (see
+    _AmpTracePolicy). `amp` may be an _AmpTracePolicy or a raw
+    AutoMixedPrecisionLists (legacy callers: bf16, no black vars)."""
+    import jax.numpy as jnp
+
+    lists = amp.lists if isinstance(amp, _AmpTracePolicy) else amp
+    low = amp.low if isinstance(amp, _AmpTracePolicy) else jnp.bfloat16
+    black_vars = getattr(lists, "black_varnames", None) or ()
+
+    def cast_ins(src, dst):
+        out = {}
+        for s, vs in ins.items():
+            names = op.input_names.get(s, [])
+            out[s] = [
+                v.astype(dst)
+                if hasattr(v, "dtype") and v.dtype == src
+                and (i >= len(names) or names[i] not in black_vars)
+                else v
+                for i, v in enumerate(vs)]
+        return out
+
+    if t in lists.white_list:
+        return cast_ins(jnp.float32, low)
+    if t in lists.black_list:
+        return cast_ins(low, jnp.float32)
+    return ins
 
 
 def _host_callback_op(opdef, op, ins, attrs):
@@ -604,6 +666,155 @@ def _run_gradient_merge(ops, bwd_idx, gm, env, key0, amp_lists,
                                      env[counter_n].dtype)
 
 
+def _amp_found_inf(grads, axis_name):
+    """Global non-finite indicator over this step's (synced) gradients.
+    Counted on each replica's LOCAL values — under ZeRO the 1/N shard
+    vecs, 1/N the work of a full-tensor scan — then psum'd over the dp
+    axis when live: the `lax.cond` that skips the weight update must
+    see a replica-UNIFORM predicate (an overflow lands in exactly one
+    replica's shard slots; without the psum the other replicas would
+    run the update branch and its all-gathers alone — deadlock)."""
+    import jax.numpy as jnp
+
+    from ..parallel import env as penv
+    from ..parallel import sharded_update as _su
+
+    total = jnp.zeros((), jnp.float32)
+    for g in grads.values():
+        v = g.vec if isinstance(g, _su.ShardVal) else g
+        total = total + jnp.sum(
+            (~jnp.isfinite(v.astype(jnp.float32))).astype(jnp.float32))
+    axes = penv.active_axes() or {}
+    if axes.get(axis_name, 1) > 1:
+        import jax
+
+        total = jax.lax.psum(total, axis_name)
+    return total > 0
+
+
+def _amp_unscale(g, scale):
+    """grad / loss_scale, computed in fp32 (an fp16 division would
+    re-lose the low bits the scaling protected) then cast back."""
+    import jax.numpy as jnp
+
+    from ..parallel import sharded_update as _su
+
+    if isinstance(g, _su.ShardVal):
+        return _su.ShardVal(_amp_unscale(g.vec, scale), g.shape)
+    return (g.astype(jnp.float32) / scale).astype(g.dtype)
+
+
+def _run_loss_scaled_post(ops, bwd_idx, dls, env, key0, amp_lists,
+                          shard_plan, block, found_inf,
+                          fetch_names=()):
+    """fp16 dynamic loss scaling (reference: decorator.py's
+    amp_check_finite_and_scale + update_loss_scaling op pair). The whole
+    post-backward section — optimizer update, clip, lr schedule —
+    runs under ``lax.cond`` on the psum'd finite check: an overflow step
+    leaves params/moments/counters untouched (the reference's
+    found_inf short-circuit inside each optimizer kernel). The scale
+    state machine updates OUTSIDE the cond with plain arithmetic:
+
+      clean step:    good += 1; good == incr_every_n_steps
+                     -> scale *= incr_ratio, good = 0
+      overflow step: bad += 1, good = 0; bad == decr_every_n_nan_or_inf
+                     -> scale *= decr_ratio, bad = 0
+
+    ZeRO interplay mirrors _run_gradient_merge's branch normalization:
+    values that are ShardVals on BOTH sides (sharded opt state / fp32
+    masters, and the scattered grads themselves — their shards pass
+    through, honoring the ZeRO-2 lifetime) stay sharded; a value the
+    apply branch shards but the skip branch holds full (an updated
+    param not covered by the deferred per-bucket gathers) gathers at
+    branch exit so the cond's pytrees agree."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..parallel import sharded_update as _su
+
+    post_ops = ops[bwd_idx + 1:]
+    out_names, seen = [], set()
+    post_writes = set()
+    for op in post_ops:
+        for n in _op_reads_writes(op)[1]:
+            post_writes.add(n)
+            if n in env and n not in seen:
+                out_names.append(n)
+                seen.add(n)
+    # post-CREATED vars that are fetched (a regularizer term, the
+    # global grad norm): they exist only inside the branch, so they
+    # must ride the cond outputs or the fetch loop never sees them —
+    # on a skipped (overflow) step they read as zeros, like an
+    # unexecuted reference scope (the conditional_block contract)
+    created = [n for n in fetch_names
+               if n in post_writes and n not in env and n not in seen]
+    out_names.extend(created)
+
+    def _norm(n, v):
+        """Align a branch output with the skip side's type: the apply
+        branch may promote a rebound var's dtype (fp16 grad * fp32
+        clip scale -> fp32) — the cond's pytrees must agree, and the
+        optimizer already consumed the full-precision value INSIDE the
+        branch, so the exit cast costs no update precision."""
+        ref = env.get(n)
+        if isinstance(v, _su.ShardVal):
+            if shard_plan is not None and \
+                    not isinstance(ref, _su.ShardVal):
+                v = _su.gather_full(v, shard_plan)
+            elif isinstance(ref, _su.ShardVal):
+                return v.astype(ref.dtype) \
+                    if v.dtype != ref.dtype else v
+        if ref is not None and hasattr(ref, "dtype") \
+                and hasattr(v, "astype") and v.dtype != ref.dtype:
+            v = v.astype(ref.dtype)
+        return v
+
+    def apply_branch(_):
+        e = dict(env)
+        if shard_plan is not None:
+            _su.run_sharded_post_ops(post_ops, e, key0, bwd_idx + 1,
+                                     amp_lists, shard_plan, block)
+        else:
+            _run_ops(post_ops, e, key0, base_idx=bwd_idx + 1,
+                     amp_lists=amp_lists)
+        return tuple(_norm(n, e[n]) for n in out_names)
+
+    shapes = jax.eval_shape(apply_branch, None) if created else None
+
+    def skip_branch(_):
+        return tuple(
+            env[n] if n in env
+            else jnp.zeros(shapes[i].shape, shapes[i].dtype)
+            for i, n in enumerate(out_names))
+
+    outs = lax.cond(found_inf, skip_branch, apply_branch, None)
+    env.update(zip(out_names, outs))
+
+    scale_n, good_n, bad_n = dls["scale"], dls["good"], dls["bad"]
+    scale = jnp.reshape(env[scale_n], ()).astype(jnp.float32)
+    good = jnp.reshape(env[good_n], ()).astype(jnp.int32)
+    bad = jnp.reshape(env[bad_n], ()).astype(jnp.int32)
+    new_good = jnp.where(found_inf, 0, good + 1)
+    new_bad = jnp.where(found_inf, bad + 1, 0)
+    grow = jnp.logical_and(
+        jnp.logical_not(found_inf),
+        new_good >= int(dls.get("incr_every_n_steps", 1000)))
+    shrink = jnp.logical_and(
+        found_inf,
+        new_bad >= int(dls.get("decr_every_n_nan_or_inf", 2)))
+    new_scale = jnp.where(
+        shrink, scale * jnp.float32(dls.get("decr_ratio", 0.8)),
+        jnp.where(grow, scale * jnp.float32(dls.get("incr_ratio", 2.0)),
+                  scale))
+    new_good = jnp.where(grow, 0, new_good)
+    new_bad = jnp.where(shrink, 0, new_bad)
+    for name, val in ((scale_n, new_scale), (good_n, new_good),
+                      (bad_n, new_bad)):
+        env[name] = jnp.reshape(val, env[name].shape).astype(
+            env[name].dtype)
+
+
 def _split_at_checkpoints(ops, ckpt_names):
     """Segment boundaries for activation recompute: a segment ends right
     after the (last) op that writes each checkpoint variable. Returns a
@@ -677,8 +888,7 @@ def build_block_fn(program, block, feed_names, fetch_names,
     if len(bwd_indices) > 1:
         raise NotImplementedError("multiple backward sections in one block")
     bwd_idx = bwd_indices[0] if bwd_indices else None
-    amp_lists = getattr(program, "_amp_lists", None) \
-        if getattr(program, "_amp", False) else None
+    amp_lists = _amp_trace_policy(program)
     # Implicit DP grad sync (reference: multi_devices_graph_pass.cc:464
     # inserts an AllReduceOpHandle per gradient for ParallelExecutor).
     # The fleet transpiler emits explicit c_allreduce ops ON THE GRAD
@@ -728,6 +938,35 @@ def build_block_fn(program, block, feed_names, fetch_names,
             loss_name = bop.attrs["loss_name"]
             requested = bop.attrs.get("diff_names", [])
             loss_scale = bop.attrs.get("loss_scale", 1.0)
+            gm = bop.attrs.get("gradient_merge")
+            # fp16 loss scaling: dynamic (scale state machine under
+            # lax.cond) or static (constant factor, no skip). The
+            # merged-grad cond owns the cadence under gradient merge,
+            # so dls never combines with it (decorator warns).
+            dls = bop.attrs.get("dynamic_loss_scaling") \
+                if gm is None else None
+            static_ls = bop.attrs.get("static_loss_scaling") \
+                if gm is None else None
+            if (dls is not None or static_ls) and _has_explicit_sync:
+                # explicit-sync (fleet-transpiled) programs sum grads
+                # via c_allreduce_sum ops INSIDE the post section: the
+                # finite check here would see pre-sum local values
+                # (overflow introduced by the N-way fp16 sum escapes
+                # the skip-cond) and the unscale — dynamic OR static —
+                # would flush small grads back to zero before the sum,
+                # the protection inverted. Disable rather than
+                # mis-protect; say so loudly once (the dynamic scale
+                # state then passes through each step unchanged).
+                import warnings
+
+                warnings.warn(
+                    "fp16 loss scaling is not wired for explicit-sync "
+                    "(fleet-transpiled) gradient programs; training "
+                    "proceeds UNSCALED — expect fp16 gradient "
+                    "underflow. Use bfloat16 (no scaling needed) or "
+                    "implicit DP sync.")
+                dls = None
+                static_ls = None
             diff_names = [n for n in requested
                           if n in env and _diffable(block, n, env)]
 
@@ -760,9 +999,18 @@ def build_block_fn(program, block, feed_names, fetch_names,
             diff_in = {n: env[n] for n in diff_names}
             _, vjp_fn, env_after = jax.vjp(fseg, diff_in, has_aux=True)
             ct = jnp.asarray(loss_scale, jnp.float32)
+            amp_scale = None
+            if dls is not None:
+                # scale the cotangent by the LIVE scale state so fp16
+                # backward intermediates stay representable
+                amp_scale = jnp.reshape(env[dls["scale"]],
+                                        ()).astype(jnp.float32)
+                ct = ct * amp_scale
+            elif static_ls:
+                amp_scale = jnp.asarray(static_ls, jnp.float32)
+                ct = ct * amp_scale
             grads = vjp_fn(ct)[0]
             env = dict(env_after)
-            gm = bop.attrs.get("gradient_merge")
             if gm is None:
                 if shard_plan is not None and _implicit_dp:
                     if shard_plan.buckets:
@@ -797,16 +1045,32 @@ def build_block_fn(program, block, feed_names, fetch_names,
                             for n, g in grads.items()}
                 else:
                     grads = {n: _dp_pmean(g) for n, g in grads.items()}
+            # dynamic loss scaling: the finite check runs on the SYNCED
+            # (scattered) values each replica will actually consume,
+            # psum'd over the dp axis so the update-skip predicate is
+            # replica-uniform (a collective inside a divergent cond
+            # would deadlock the mesh)
+            found_inf = None
+            if dls is not None:
+                found_inf = _amp_found_inf(
+                    {n: grads[n] for n in diff_names}, _dp_axis_name)
             # under gradient merge, sync once on the MERGED grads at the
             # k-step boundary instead of k per-micro-step allreduces
             for n in diff_names:
                 g = grads[n]
+                if amp_scale is not None:
+                    g = _amp_unscale(g, amp_scale)
                 env[framework.grad_var_name(n)] = g.astype(env[n].dtype)
             loss_val = env[loss_name]
             env[framework.grad_var_name(loss_name)] = jnp.full(
                 loss_val.shape, loss_scale, loss_val.dtype)
             if gm is None:
-                if shard_plan is not None:
+                if dls is not None:
+                    _run_loss_scaled_post(ops, bwd_idx, dls, env, key0,
+                                          amp_lists, shard_plan, block,
+                                          found_inf,
+                                          fetch_names=fetch_names)
+                elif shard_plan is not None:
                     _su.run_sharded_post_ops(
                         ops[bwd_idx + 1:], env, key0, bwd_idx + 1,
                         amp_lists, shard_plan, block)

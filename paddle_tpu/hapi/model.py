@@ -137,6 +137,7 @@ class Model:
         self._optimizer = None
         self._loss_function = None
         self._metrics: List[Metric] = []
+        self._amp_level = "O0"
         self.stop_training = False
         # own tracer, activated only inside batch methods — fit() must not
         # flip the process-global dygraph mode for unrelated static code
@@ -154,7 +155,32 @@ class Model:
             framework._switch_tracer(old)
 
     # -- setup -------------------------------------------------------------
-    def prepare(self, optimizer=None, loss_function=None, metrics=None):
+    def prepare(self, optimizer=None, loss_function=None, metrics=None,
+                amp_level=None):
+        """`amp_level`: None/'O0' = fp32 (default); 'O1' = the network's
+        float32 parameters are cast to bfloat16 for forward/backward
+        (activation memory and MXU throughput win, updates in bf16);
+        'O2' = 'O1' plus fp32 MASTER weights — the optimizer updates an
+        fp32 copy per parameter and the live bf16 param is re-derived
+        from it each step, so update precision never degrades to bf16
+        round-off (contrib.mixed_precision.EagerMasterWeightOptimizer;
+        the static-graph analogue is mixed_precision.decorate, whose
+        masters additionally live ZeRO-sharded — see
+        paddle_tpu/parallel/README.md "Mixed precision & ZeRO-2")."""
+        level = str(amp_level).upper() if amp_level else "O0"
+        if level not in ("O0", "O1", "O2"):
+            raise ValueError(
+                "amp_level must be one of None/'O0'/'O1'/'O2', got %r"
+                % (amp_level,))
+        self._amp_level = level
+        if level in ("O1", "O2"):
+            self._amp_cast_params()
+            if level == "O2" and optimizer is not None:
+                from ..fluid.contrib.mixed_precision import \
+                    EagerMasterWeightOptimizer
+
+                if not isinstance(optimizer, EagerMasterWeightOptimizer):
+                    optimizer = EagerMasterWeightOptimizer(optimizer)
         self._optimizer = optimizer
         self._loss_function = loss_function
         self._metrics = _to_list(metrics)
@@ -162,6 +188,23 @@ class Model:
             assert isinstance(m, Metric), (
                 "metrics must be hapi.Metric instances, got %r" % (m,))
         return self
+
+    def _amp_cast_params(self):
+        """Cast the network's TRAINABLE fp32 params to bf16 (amp_level
+        O1/O2). Non-trainable statistics (BatchNorm running
+        mean/variance) stay fp32 — their momentum update accumulates,
+        and bf16's 8-bit mantissa would degrade eval-mode normalization
+        (the static-graph policy black-lists batch_norm for the same
+        reason). Re-applied after load(): set_dict restores the
+        checkpoint's (fp32) dtypes."""
+        import jax.numpy as jnp
+
+        for p in self.network.parameters():
+            if not getattr(p, "trainable", True):
+                continue
+            val = p._value()
+            if val.dtype == jnp.float32:
+                p._assign_raw(val.astype(jnp.bfloat16))
 
     def parameters(self):
         return self.network.parameters()
@@ -562,6 +605,13 @@ class Model:
         with open(path + ".pdparams", "rb") as f:
             state = pickle.load(f)
         self.network.set_dict(state)
+        if self._amp_level in ("O1", "O2"):
+            # set_dict restores the checkpoint's dtypes (an fp32 save
+            # would silently turn AMP off — the eager master wrapper
+            # skips fp32 params); re-apply the compute-dtype cast. The
+            # wrapper's per-object liveness tracking re-seeds its fp32
+            # masters from the loaded values on the next step.
+            self._amp_cast_params()
         opt_path = path + ".pdopt"
         if not reset_optimizer and self._optimizer is not None and \
                 os.path.exists(opt_path):

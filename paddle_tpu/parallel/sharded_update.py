@@ -238,7 +238,8 @@ def bucket_cap_bytes() -> int:
     return int(mb * (1 << 20)) if mb > 0 else 0
 
 
-def plan_buckets(opt_ops, block, ndev, grad_topo, cap_bytes):
+def plan_buckets(opt_ops, block, ndev, grad_topo, cap_bytes,
+                 out_alias=None):
     """Partition optimizer-bound grads into size-bounded buckets ordered
     by BACKWARD production order: a gradient whose parameter is used
     LATER in the forward materializes EARLIER in the vjp sweep, so
@@ -248,7 +249,14 @@ def plan_buckets(opt_ops, block, ndev, grad_topo, cap_bytes):
     (an oversize param gets its own bucket, still padded per-entry to
     1/N divisibility); grads of different dtypes (fp32 vs bf16) never
     share a bucket; every entry keeps its own per-var zero-padding so
-    the per-replica layout matches the unbucketed lowering exactly."""
+    the per-replica layout matches the unbucketed lowering exactly.
+
+    `out_alias` (AMP master weights): {master_name: live_param_name}.
+    The optimizer op's Param/ParamOut slots name the fp32 MASTER then,
+    but the gradient arrives (and scatters) at the LIVE param's 16-bit
+    dtype and the deferrable all-gather output is the live param — so
+    shape/dtype/param_out resolve through the alias."""
+    alias = out_alias or {}
     entries = []
     seen = set()
     for seq, op in enumerate(opt_ops):
@@ -261,12 +269,13 @@ def plan_buckets(opt_ops, block, ndev, grad_topo, cap_bytes):
             seen.add(g)
             p = params[i] if i < len(params) else g
             po = pouts[i] if i < len(pouts) else p
-            v = block._find_var_recursive(p)
+            live = alias.get(p, p)
+            v = block._find_var_recursive(live)
             shape = tuple(getattr(v, "shape", ()) or ())
             dtype = str(getattr(v, "dtype", "float32"))
             entries.append(BucketEntry(
-                g, p, po, shape, dtype, ndev,
-                int(grad_topo.get(p, -1))))
+                g, p, alias.get(po, po), shape, dtype, ndev,
+                int(grad_topo.get(alias.get(p, p), -1))))
     # backward production order: descending last forward use; ties keep
     # reversed appearance order (optimizer sections follow param
     # creation order, which follows the forward)
@@ -291,11 +300,11 @@ class ShardedUpdatePlan:
     __slots__ = ("axis", "ndev", "grad_names", "rs_targets",
                  "sharded_state", "explicit_sync", "opt_op_ids",
                  "buckets", "bucket_of", "defer_gather",
-                 "gradient_merge", "bucket_cap")
+                 "gradient_merge", "bucket_cap", "master_of")
 
     def __init__(self, axis, ndev, grad_names, rs_targets, sharded_state,
                  explicit_sync, opt_op_ids, buckets=(), defer_gather=(),
-                 gradient_merge=False, bucket_cap=0):
+                 gradient_merge=False, bucket_cap=0, master_of=None):
         self.axis = axis
         self.ndev = ndev
         # grads reduce-scattered right at the vjp output (implicit DP)
@@ -319,6 +328,10 @@ class ShardedUpdatePlan:
         # the byte cap the buckets were planned under — report surfaces
         # read this, NOT the live flag (which may have changed since)
         self.bucket_cap = int(bucket_cap)
+        # AMP fp32 master weights sharded by this plan:
+        # {live_param_name: master_var_name} (masters also appear in
+        # sharded_state with their fp32 ShardInfo)
+        self.master_of: Dict[str, str] = dict(master_of or {})
 
 
 def enabled() -> bool:
@@ -331,15 +344,61 @@ def enabled() -> bool:
 # planning
 # ---------------------------------------------------------------------------
 
+def broadcast_mismatch(op, block):
+    """True when an elementwise binary op broadcasts mismatched
+    NON-scalar operands — which has no flat-shard analogue (a middle-
+    axis broadcast cannot be expressed on contiguous 1/N slices). THE
+    single definition of the decline rule: the planner (below) and both
+    tpu-lint shard checkers (`analysis/sharding.py` zero1/zero2) call
+    this, so the rule cannot drift between planner and verifier."""
+    numels = []
+    for slot in ("X", "Y"):
+        for n in op.input_names.get(slot, []):
+            v = block._find_var_recursive(n)
+            shp = tuple(getattr(v, "shape", ()) or ())
+            if shp:
+                numels.append(int(np.prod(shp)))
+    return (len(numels) == 2 and numels[0] != numels[1]
+            and 1 not in numels)
+
+
+def _record_fallback(program, reason, var=None, op_type=None,
+                     kind="declined"):
+    """Structured per-program fallback trail: why the planner declined
+    (kind='declined' — the whole program keeps the replicated update)
+    or degraded one var to the replicated layout (kind='state_degraded').
+    `tools/perf_analysis.py --sharded-diff` reports these instead of
+    silence; tests assert on them."""
+    lst = getattr(program, "_sharded_update_fallback", None)
+    if lst is None:
+        lst = []
+        program._sharded_update_fallback = lst
+    lst.append({"kind": kind, "reason": reason, "var": var,
+                "op": op_type})
+    _log.debug("sharded update %s: %s (var=%s op=%s)", kind, reason,
+               var, op_type)
+
+
 def plan_sharded_update(program, block, ndev, dp_axis) -> \
         Optional[ShardedUpdatePlan]:
     """Feasibility scan over the post-backward section. Returns a plan,
     or None when the program must keep the replicated update (not
-    data-parallel / flag off / gradient merge / an unsupported op
-    touches an optimizer-bound gradient or a would-be-sharded state
-    var). Falling back is always safe — it is exactly today's path."""
+    data-parallel / flag off / an unsupported op touches an
+    optimizer-bound gradient or a would-be-sharded state var). Falling
+    back is always safe — it is exactly today's path — and never
+    silent: every decline/degrade is recorded on
+    ``program._sharded_update_fallback`` (see _record_fallback).
+
+    AMP master weights (`mixed_precision.decorate` at level O2): the
+    optimizer ops' Param/ParamOut slots name fp32 ``@MASTER`` vars;
+    those masters become sharded state (P(dp) flat buffers across
+    steps, like the moments), their only reader outside the owning
+    optimizer op — the trailing ``__amp_param_cast__`` op — runs in
+    shard space, and the resulting 16-bit live-param shard is what the
+    (deferred, per-bucket) all-gather carries."""
     from ..fluid import lowering
 
+    program._sharded_update_fallback = []
     if not enabled() or ndev <= 1:
         return None
     ops = list(block.ops)
@@ -359,8 +418,8 @@ def plan_sharded_update(program, block, ndev, dp_axis) -> \
         if "ParamOut" not in op.output_names:
             continue
         if op.type not in SUPPORTED_OPT:
-            _log.debug("sharded update declined: optimizer op %r is not "
-                       "shard-aware", op.type)
+            _record_fallback(program, "optimizer op is not shard-aware",
+                             op_type=op.type)
             return None
         opt_ops.append(op)
     if not opt_ops:
@@ -370,8 +429,24 @@ def plan_sharded_update(program, block, ndev, dp_axis) -> \
     for op in opt_ops:
         gs = op.input_names.get("Grad", [])
         if not gs:
+            _record_fallback(program,
+                             "optimizer op without a Grad slot",
+                             op_type=op.type)
             return None
         opt_grads.update(gs)
+
+    # AMP fp32 master weights: {master_name: live_param_name} — the
+    # trailing __amp_param_cast__ ops are each master's one sanctioned
+    # reader outside its optimizer op
+    amp_masters = dict(getattr(program, "_amp_master_of", None) or {})
+    param_of = {m: p for p, m in amp_masters.items()}
+    cast_of: Dict[str, tuple] = {}  # master -> (cast op, live param out)
+    for op in post:
+        if op.type == "cast" and op.attrs.get("__amp_param_cast__"):
+            xs = op.input_names.get("X", [])
+            outs = op.output_names.get("Out", [])
+            if len(xs) == 1 and xs[0] in param_of and outs:
+                cast_of[xs[0]] = (op, outs[0])
 
     # explicit-sync detection must mirror lowering.build_block_fn: when
     # the program carries its own grad allreduces, the vjp output is NOT
@@ -380,11 +455,6 @@ def plan_sharded_update(program, block, ndev, dp_axis) -> \
         (op.type.startswith("c_allreduce") or op.type == "allreduce")
         and any(n.endswith("@GRAD") for n in op.input_arg_names)
         for op in post)
-    if gradient_merge and explicit:
-        # merged-grad sharding is proven for the implicit-sync path
-        # only; a program carrying its own allreduces under the merge
-        # cond keeps the replicated update
-        return None
     rs_targets = set()
     if explicit:
         for op in post:
@@ -393,45 +463,78 @@ def plan_sharded_update(program, block, ndev, dp_axis) -> \
                 xs = op.input_names["X"]
                 outs = op.output_names.get("Out", [])
                 if len(xs) != 1 or outs != xs:
+                    _record_fallback(
+                        program, "c_allreduce_sum is not a single "
+                        "in-place grad sync", op_type=op.type,
+                        var=(xs or [None])[0])
                     return None
                 rs_targets.add(xs[0])
             elif (op.type.startswith("c_allreduce")
                   or op.type == "allreduce") and \
                     set(op.input_arg_names) & opt_grads:
-                return None  # non-sum reduction on an optimizer grad
+                _record_fallback(
+                    program, "non-sum reduction on an optimizer "
+                    "gradient", op_type=op.type)
+                return None
         if rs_targets != opt_grads:
             # some optimizer grad is never allreduced: the program owns
             # its sync and chose not to — don't invent one
+            _record_fallback(
+                program, "optimizer grad(s) never allreduced by the "
+                "explicit sync",
+                var=",".join(sorted(opt_grads - rs_targets)[:3]))
             return None
 
-    # candidate sharded state: param-shaped optimizer accumulators,
-    # owned by exactly one optimizer op
+    # candidate sharded state: param-shaped optimizer accumulators
+    # (and AMP fp32 masters), owned by exactly one optimizer op
     owner: Dict[str, object] = {}
     sharded_state: Dict[str, ShardInfo] = {}
+
+    def consider(n, op):
+        v = block._find_var_recursive(n)
+        shape = tuple(getattr(v, "shape", ()) or ())
+        if not shape or any(int(d) <= 0 for d in shape) or \
+                int(np.prod(shape)) <= 1:
+            return  # scalar-ish state stays replicated
+        if n in owner and owner[n] is not op:
+            # shared across opt ops: degrade — drop it from the
+            # candidate set too, or the outside-reader loop below
+            # re-records the same var under the wrong reason
+            owner[n] = None
+            sharded_state.pop(n, None)
+            _record_fallback(program, "state shared across optimizer "
+                             "ops", var=n, op_type=op.type,
+                             kind="state_degraded")
+            return
+        owner[n] = op
+        dtype = str(getattr(v, "dtype", "float32"))
+        sharded_state[n] = ShardInfo(n, shape, dtype, ndev)
+
     for op in opt_ops:
         for slot in _OPT_STATE_SLOTS.get(op.type, ()):
             for n in op.input_names.get(slot, []):
-                v = block._find_var_recursive(n)
-                shape = tuple(getattr(v, "shape", ()) or ())
-                if not shape or any(int(d) <= 0 for d in shape) or \
-                        int(np.prod(shape)) <= 1:
-                    continue  # scalar-ish state stays replicated
-                if n in owner and owner[n] is not op:
-                    owner[n] = None  # shared across opt ops: degrade
-                    continue
-                owner[n] = op
-                dtype = str(getattr(v, "dtype", "float32"))
-                sharded_state[n] = ShardInfo(n, shape, dtype, ndev)
+                consider(n, op)
+        for n in op.input_names.get("Param", []):
+            if n in param_of and n in cast_of:
+                consider(n, op)  # fp32 master: sharded across steps
     # any touch of a candidate state var OUTSIDE its owning optimizer op
     # (a forward reader, a fetch-side op, EMA/ModelAverage plumbing)
-    # degrades that var to replicated — correctness first
+    # degrades that var to replicated — correctness first. The one
+    # exception: a master's own __amp_param_cast__ op, which is proven
+    # shard-aware (cast is in _EW_UNARY).
     if sharded_state:
+        allowed_extra = {m: id(cop) for m, (cop, _) in cast_of.items()}
         for op in ops:
             reads, writes = lowering._op_reads_writes(op)
             for n in set(reads) | set(writes):
-                if n in sharded_state and owner.get(n) is not op:
+                if n in sharded_state and owner.get(n) is not op \
+                        and allowed_extra.get(n) != id(op):
                     del sharded_state[n]
                     owner[n] = None
+                    _record_fallback(
+                        program, "state read/written outside its "
+                        "owning optimizer op", var=n, op_type=op.type,
+                        kind="state_degraded")
     # taint walk: every op consuming a sharded gradient must be
     # shard-aware, with outputs (un)tainted per the table below
     tainted = set(opt_grads) if not explicit else set()
@@ -452,23 +555,16 @@ def plan_sharded_update(program, block, ndev, dp_axis) -> \
         if not tin:
             tainted -= writes  # full overwrite of a tainted name
             continue
-        if op.type in _EW_BINARY:
+        if op.type in _EW_BINARY and broadcast_mismatch(op, block):
             # shard-space binary ops support same-shape or scalar
             # operands only; a middle-axis broadcast (paddle `axis`
             # attr with mismatched ranks) has no flat-shard analogue —
             # decline the whole program rather than raise at trace
-            shapes = []
-            for slot in ("X", "Y"):
-                for n in op.input_names.get(slot, []):
-                    v = block._find_var_recursive(n)
-                    shp = tuple(getattr(v, "shape", ()) or ())
-                    if shp:
-                        shapes.append(int(np.prod(shp)))
-            if len(shapes) == 2 and shapes[0] != shapes[1] \
-                    and 1 not in shapes:
-                _log.debug("sharded update declined: broadcast "
-                           "%s over sharded grads", op.type)
-                return None
+            _record_fallback(
+                program, "broadcast over sharded grads has no "
+                "flat-shard analogue", op_type=op.type,
+                var=sorted(tin)[0])
+            return None
         if op.type in _EW_UNARY or op.type in _EW_BINARY \
                 or op.type == "sum":
             tainted |= writes  # elementwise: outputs stay sharded
@@ -477,38 +573,52 @@ def plan_sharded_update(program, block, ndev, dp_axis) -> \
             if op.type == "clip_by_norm":
                 tainted |= writes
         else:
-            _log.debug("sharded update declined: op %r reads sharded "
-                       "grads %s", op.type, sorted(tin))
+            _record_fallback(
+                program, "op reads sharded grads without a shard-aware "
+                "rule", op_type=op.type, var=sorted(tin)[0])
             return None
     # bucketed collectives: group optimizer-bound grads by backward
     # production order under the byte cap; 0 = per-var (PR-3) lowering
+    out_alias = {m: live for m, (_, live) in cast_of.items()}
     cap = bucket_cap_bytes()
     buckets = ()
     if cap > 0:
         buckets = plan_buckets(opt_ops, block, ndev,
-                               bop.attrs.get("grad_topo", {}) or {}, cap)
+                               bop.attrs.get("grad_topo", {}) or {}, cap,
+                               out_alias=out_alias)
     # params whose all-gather can defer to the end of the post section
-    # (emitted per-bucket): nothing after the owning optimizer op reads
-    # them, so the only consumers are the next step's forward
+    # (emitted per-bucket): nothing after the owning optimizer op (or,
+    # for AMP masters, the master's live-param cast) reads them, so the
+    # only consumers are the next step's forward
     defer = set()
     if buckets:
         # one read-set pass over the post section (not per-ParamOut)
         last_read = {}
+        pos_of = {}
         for i, op in enumerate(post):
+            pos_of[id(op)] = i
             for n in lowering._op_reads_writes(op)[0]:
                 last_read[n] = i
-        opt_pos = {id(op): i for i, op in enumerate(post)}
         for op in opt_ops:
             for po in op.output_names.get("ParamOut", []):
-                if last_read.get(po, -1) <= opt_pos[id(op)]:
-                    defer.add(po)
+                target, produced_at = po, pos_of[id(op)]
+                if po in cast_of:
+                    cop, live = cast_of[po]
+                    # the deferrable output is the 16-bit live param
+                    # the cast derives from the updated master shard
+                    target, produced_at = live, pos_of[id(cop)]
+                if last_read.get(target, -1) <= produced_at:
+                    defer.add(target)
+    master_of = {live: m for m, (_, live) in cast_of.items()
+                 if m in sharded_state}
     return ShardedUpdatePlan(
         dp_axis, ndev,
         grad_names=(set() if explicit else opt_grads),
         rs_targets=rs_targets, sharded_state=sharded_state,
         explicit_sync=explicit, opt_op_ids=opt_ids,
         buckets=buckets, defer_gather=defer,
-        gradient_merge=gradient_merge, bucket_cap=cap)
+        gradient_merge=gradient_merge, bucket_cap=cap,
+        master_of=master_of)
 
 
 # ---------------------------------------------------------------------------

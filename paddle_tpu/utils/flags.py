@@ -82,9 +82,18 @@ _FLAGS: Dict[str, object] = {
     # 9.8ms even at S=2048 fwd); flash's win is O(S) memory at long seq.
     "FLAGS_flash_attention_min_seq": 4096,
     "FLAGS_tpu_compile_cache_size": 128,
+    # Mixed-precision override for mixed_precision.decorate()'d
+    # programs: "" follows the decorate(amp_level=...) argument;
+    # "O0" is the kill switch (decorated programs lower exactly like
+    # undecorated fp32 ones); "O1" = white/black-list cast policy only;
+    # "O2" = policy + 16-bit live params with ZeRO-sharded fp32 master
+    # weights (param HBM and param all-gather ICI bytes ~halve). See
+    # paddle_tpu/parallel/README.md "Mixed precision & ZeRO-2".
+    "FLAGS_tpu_amp_level": "",
     # tpu-lint static SPMD verifier (paddle_tpu/analysis): run the
     # collective-divergence / donation-safety / host-sync /
-    # zero1-invariants / dtype-contract checkers at compile time (each
+    # zero1-invariants / zero2-lifetimes / dtype-contract checkers at
+    # compile time (each
     # cache-missing Executor.run). "off" = never; "warn" = emit one
     # python warning per finding; "error" = warn AND raise when any
     # error-severity finding exists — the program never dispatches.

@@ -434,6 +434,55 @@ def test_explicit_sync_buckets_parity():
     assert _identical(base, got)
 
 
+def test_gradient_merge_with_explicit_sync_now_planned():
+    """ROADMAP carried-over gap, closed: a fleet-transpiled program
+    (explicit c_allreduce_sum grad sync) under GradientMergeOptimizer
+    now PLANS — the once-per-k merged-grad sync reduce-scatters through
+    the pending-bucket path inside the lax.cond apply branch —
+    bit-identical to the replicated gm+explicit path, per-var and
+    bucketed."""
+    from paddle_tpu import fleet
+
+    def run(flag, bucket_mb):
+        _fresh()
+        set_flags({"FLAGS_tpu_sharded_weight_update": flag,
+                   "FLAGS_tpu_comm_bucket_mb": bucket_mb})
+        r = np.random.RandomState(0)
+        x = r.rand(16, 8).astype("float32")
+        y = r.rand(16, 1).astype("float32")
+        with framework.unique_name_guard():
+            framework.default_main_program().random_seed = 11
+            framework.default_startup_program().random_seed = 11
+            xv = fluid.data(name="x", shape=[-1, 8], dtype="float32")
+            yv = fluid.data(name="y", shape=[-1, 1], dtype="float32")
+            pred = fluid.layers.fc(input=xv, size=3)
+            pred = fluid.layers.fc(input=pred, size=1)
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.square(pred - yv))
+            fleet.init()
+            gm = O.GradientMergeOptimizer(
+                O.AdamOptimizer(learning_rate=0.05), k_steps=2)
+            fleet.distributed_optimizer(gm).minimize(loss)
+            prog = fluid.default_main_program()
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            losses = [exe.run(prog, feed={"x": x, "y": y},
+                              fetch_list=[loss])[0].copy()
+                      for _ in range(6)]
+            plan = getattr(prog, "_shard_plan", None)
+        return losses, plan
+
+    base, p_off = run(False, 0.0)
+    assert p_off is None
+    for mb in (0.0, 1000.0):
+        got, plan = run(True, mb)
+        assert plan is not None, "gm+explicit must plan now"
+        assert plan.explicit_sync and plan.gradient_merge
+        assert bool(plan.buckets) == (mb > 0)
+        assert plan.sharded_state, "moments must stay sharded"
+        assert _identical(base, got), mb
+
+
 # ---------------------------------------------------------------------------
 # launch supervisor: PADDLE_CKPT_AGREE default (satellite)
 # ---------------------------------------------------------------------------

@@ -147,6 +147,65 @@ def test_close_evicts_server_dedup_entry():
         srv.shutdown()
 
 
+def test_ack_last_releases_retained_blob_but_keeps_dedup():
+    """Acked-release (ROADMAP carried-over item): after the client acks
+    the applied seq, the server frees the retained response blob (a
+    params-sized get_params_batch reply pinned per trainer between
+    steps otherwise) while the seq marker stays for dedup — and later
+    calls still dedup/replay correctly."""
+    srv, seen = _counting_server()
+    cli = RpcClient("127.0.0.1:%d" % srv.port)
+    try:
+        big = b"x" * (1 << 20)
+        (echo,) = cli.call("echo", big)
+        assert bytes(np.asarray(echo).tobytes()) == big
+
+        def blob_bytes(resp):
+            return sum(int(getattr(f, "nbytes", 0)) for f in resp)
+
+        ent = srv._dedup[cli._cid]
+        acked_seq = ent["seq"]
+        assert blob_bytes(ent["resp"]) >= len(big), "blob retained pre-ack"
+        cli.ack_last()
+        ent = srv._dedup[cli._cid]
+        assert ent["seq"] == acked_seq, "seq marker must survive the ack"
+        assert blob_bytes(ent["resp"]) < len(big), "blob must be freed"
+        # exactly-once semantics are untouched for later calls
+        with faults.inject("drop", side="client", point="recv", every=2):
+            for i in range(6):
+                (n,) = cli.call("incr", i)
+                assert n == i + 1
+        assert seen == list(range(6))
+    finally:
+        cli.close()
+        srv.shutdown()
+
+
+def test_ack_of_stale_seq_is_a_noop():
+    """An ack for anything but the newest completed seq (a late or
+    confused client) must not disturb the dedup entry."""
+    srv, _ = _counting_server()
+    cli = RpcClient("127.0.0.1:%d" % srv.port)
+    try:
+        cli.call("echo", b"first")
+        cli.call("echo", b"payload")  # newest completed seq is 2
+        ent = srv._dedup[cli._cid]
+        resp_before = ent["resp"]
+        # hand-roll an ack for the STALE seq 1
+        from paddle_tpu.distributed.rpc import (_ENVELOPE, read_msg,
+                                                write_msg)
+
+        with cli._lock:
+            cli._seq += 1
+            write_msg(cli._sock, [_ENVELOPE, cli._cid, cli._seq,
+                                  "__rpc_ack__", 1])
+            read_msg(cli._sock)
+        assert srv._dedup[cli._cid]["resp"] is resp_before
+    finally:
+        cli.close()
+        srv.shutdown()
+
+
 def test_client_retries_send_side_drops_too():
     srv, seen = _counting_server()
     cli = RpcClient("127.0.0.1:%d" % srv.port)

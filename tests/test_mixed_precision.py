@@ -1,0 +1,648 @@
+"""Mixed precision at scale: bf16 compute + ZeRO-sharded fp32 master
+weights, fp16 dynamic loss scaling, and ZeRO-2 sharded gradient
+lifetimes.
+
+Machinery: fluid/contrib/mixed_precision (decorate, master rewrite,
+loss-scale wiring), fluid/lowering (_apply_amp_casts,
+_run_loss_scaled_post), parallel/sharded_update (master planning,
+16-bit bucketed grads + deferred 16-bit param gathers), executor
+donation_report param_*/grad_peak_* fields. Reference: Xu et al.
+arXiv:2004.13336 (cross-replica weight-update sharding), Wang et al.
+arXiv:2011.03641 (HBM headroom as the binding constraint).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework
+from paddle_tpu.fluid.contrib import mixed_precision
+from paddle_tpu.utils.flags import get_flag, set_flags
+
+O = fluid.optimizer
+
+
+@pytest.fixture(autouse=True)
+def _restore_flags():
+    old = {k: get_flag(k) for k in ("FLAGS_tpu_sharded_weight_update",
+                                    "FLAGS_tpu_comm_bucket_mb",
+                                    "FLAGS_tpu_amp_level")}
+    yield
+    set_flags(old)
+
+
+def _fresh():
+    from paddle_tpu.core import scope as scope_mod
+
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    scope_mod._global_scope = scope_mod.Scope()
+
+
+def _batch():
+    r = np.random.RandomState(0)
+    return (r.rand(64, 32).astype("float32"),
+            r.randint(0, 4, (64, 1)).astype("int64"))
+
+
+def _mlp_loss(hidden=31):
+    framework.default_main_program().random_seed = 1234
+    framework.default_startup_program().random_seed = 1234
+    img = fluid.layers.data(name="img", shape=[32], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    # 31-wide: not divisible by any mesh size — every master/moment
+    # flat buffer is padded
+    h = fluid.layers.fc(input=img, size=hidden, act="relu")
+    logits = fluid.layers.fc(input=h, size=4)
+    return fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, label))
+
+
+def _train(opt_fn, flag, ndev=8, bucket_mb=0.0, steps=4, clip=False,
+           decorate_kw=None):
+    """Losses of `steps` identical-feed steps of the AMP-decorated MLP;
+    returns (losses, exe, prog, loss, plan, opt)."""
+    import jax
+
+    _fresh()
+    set_flags({"FLAGS_tpu_sharded_weight_update": flag,
+               "FLAGS_tpu_comm_bucket_mb": bucket_mb})
+    x, y = _batch()
+    with framework.unique_name_guard():
+        loss = _mlp_loss()
+        if clip:
+            fluid.clip.set_gradient_clip(
+                fluid.clip.GradientClipByGlobalNorm(0.5))
+        opt = mixed_precision.decorate(opt_fn(), **(decorate_kw or {}))
+        opt.minimize(loss)
+        fluid.clip._clip_attr.clear()
+        prog = fluid.default_main_program()
+        fluid.CompiledProgram(prog).with_data_parallel(
+            loss_name=loss.name)
+        if ndev != 8:
+            from jax.sharding import Mesh
+
+            prog._mesh = Mesh(np.array(jax.devices()[:ndev]), ("dp",))
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        losses = [float(exe.run(prog, feed={"img": x, "label": y},
+                                fetch_list=[loss])[0].mean())
+                  for _ in range(steps)]
+        plan = getattr(prog, "_shard_plan", None)
+    return losses, exe, prog, loss, plan, opt
+
+
+# ---------------------------------------------------------------------------
+# master-weight parity (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name,opt_fn,ndev", [
+    ("sgd_2dev", lambda: O.SGDOptimizer(learning_rate=0.1), 2),
+    ("momentum_4dev",
+     lambda: O.MomentumOptimizer(learning_rate=0.1, momentum=0.9), 4),
+    ("adam_8dev", lambda: O.AdamOptimizer(learning_rate=0.01), 8),
+])
+def test_sharded_master_parity_bit_identical(name, opt_fn, ndev):
+    """bf16 compute + fp32 masters: the ZeRO-sharded master update is
+    bit-identical to the unsharded (replicated) fp32-master reference
+    given the same bf16 grads, on 2/4/8-device meshes."""
+    l_rep, *_ = _train(opt_fn, False, ndev=ndev)
+    l_sh, _, _, _, plan, _ = _train(opt_fn, True, ndev=ndev)
+    assert plan is not None and plan.master_of, \
+        "masters did not shard: %s" % (plan,)
+    assert l_rep == l_sh, (name, l_rep, l_sh)
+
+
+def test_sharded_master_parity_with_clip_and_buckets():
+    """Global-norm clip runs on the 16-bit grad shards (psum'd
+    partials) and bucketed scatters stay bit-identical to per-var."""
+    adam = lambda: O.AdamOptimizer(learning_rate=0.01)  # noqa: E731
+    l_rep, *_ = _train(adam, False, clip=True)
+    l_pv, *_ = _train(adam, True, clip=True)
+    l_bk, _, _, _, plan, _ = _train(adam, True, clip=True,
+                                    bucket_mb=1000.0)
+    assert plan.buckets and plan.master_of
+    assert l_rep == l_pv == l_bk
+
+
+# ---------------------------------------------------------------------------
+# layout + HBM evidence (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+def test_params_live_bf16_with_sharded_masters():
+    """Scope params are bf16; fp32 masters live as dp-sharded flat
+    buffers; donation_report shows per-replica param bytes ~halved
+    (2 + 4/N bytes/elem vs fp32 DP's 4) and the 16-bit all-gather."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.scope import global_scope
+
+    x, y = _batch()
+    adam = lambda: O.AdamOptimizer(learning_rate=0.01)  # noqa: E731
+    # ~0.001 MB cap: the MLP's grads split into several buckets, so the
+    # ZeRO-2 peak model (max bucket + shards) beats all-grads-at-once
+    _, exe, prog, loss, plan, _ = _train(adam, True, bucket_mb=0.001)
+    for p in prog.all_parameters():
+        v = global_scope().find_var(p.name)
+        assert v.dtype == jnp.bfloat16, (p.name, v.dtype)
+    # masters are sharded state: flat (padded,) buffers, P(dp)
+    assert plan.master_of
+    for pname, m in plan.master_of.items():
+        info = plan.sharded_state[m]
+        v = global_scope().find_var(m)
+        assert tuple(v.shape) == (info.padded,)
+        assert "dp" in str(getattr(v, "sharding", ""))
+        assert info.dtype == np.dtype("float32")
+    rep = exe.donation_report(prog, feed={"img": x, "label": y},
+                              fetch_list=[loss])
+    assert rep["param_masters_sharded"] == len(plan.master_of)
+    per_replica = rep["param_bf16_bytes"] + rep["param_master_bytes"]
+    # 8-way mesh: 2 + 4/8 = 2.5 bytes/elem vs 4 -> ~0.63x (+ padding)
+    assert per_replica < 0.75 * rep["param_fp32_replicated_bytes"], rep
+    assert rep["aliases_state"], rep
+    # ZeRO-2 grad-lifetime model: peak grad HBM ~ max bucket + shards
+    # — strictly below every-full-grad-at-once when grads split into
+    # multiple buckets (full buffers die bucket-by-bucket)
+    assert len(plan.buckets) >= 2
+    assert rep["grad_peak_per_replica_bytes"] == \
+        max(b.nbytes for b in plan.buckets) + \
+        rep["grad_bucket_per_replica_bytes"]
+    assert rep["grad_peak_per_replica_bytes"] < \
+        rep["grad_replicated_peak_bytes"] + \
+        rep["grad_bucket_per_replica_bytes"]
+
+
+def test_collective_bytes_halve_vs_fp32():
+    """The 16-bit grads/params halve BOTH collective legs' ICI bytes
+    relative to the fp32 ZeRO run of the same model."""
+    x, y = _batch()
+    adam = lambda: O.AdamOptimizer(learning_rate=0.01)  # noqa: E731
+
+    def census(amp):
+        _fresh()
+        set_flags({"FLAGS_tpu_sharded_weight_update": True,
+                   "FLAGS_tpu_comm_bucket_mb": 0.0})
+        with framework.unique_name_guard():
+            loss = _mlp_loss()
+            opt = mixed_precision.decorate(adam()) if amp else adam()
+            opt.minimize(loss)
+            prog = fluid.default_main_program()
+            fluid.CompiledProgram(prog).with_data_parallel(
+                loss_name=loss.name)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            exe.run(prog, feed={"img": x, "label": y},
+                    fetch_list=[loss])
+            return exe.collective_report(
+                prog, feed={"img": x, "label": y}, fetch_list=[loss])
+
+    c32 = census(False)
+    c16 = census(True)
+    assert c16["reduce_scatter"]["ici_bytes"] * 2 == \
+        c32["reduce_scatter"]["ici_bytes"]
+    assert c16["all_gather"]["ici_bytes"] * 2 == \
+        c32["all_gather"]["ici_bytes"]
+
+
+def test_amp_off_is_untouched_and_kill_switch():
+    """Undecorated fp32 programs lower with zero bf16 anywhere; the
+    FLAGS_tpu_amp_level=O0 kill switch makes a decorated program lower
+    identically to the undecorated one (byte-for-byte HLO)."""
+    x, y = _batch()
+    adam = lambda: O.AdamOptimizer(learning_rate=0.01)  # noqa: E731
+
+    def text(decorated, level=""):
+        _fresh()
+        set_flags({"FLAGS_tpu_sharded_weight_update": True,
+                   "FLAGS_tpu_comm_bucket_mb": 0.0,
+                   "FLAGS_tpu_amp_level": level})
+        with framework.unique_name_guard():
+            loss = _mlp_loss()
+            opt = mixed_precision.decorate(adam()) if decorated \
+                else adam()
+            opt.minimize(loss)
+            prog = fluid.default_main_program()
+            fluid.CompiledProgram(prog).with_data_parallel(
+                loss_name=loss.name)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            exe.run(prog, feed={"img": x, "label": y},
+                    fetch_list=[loss])
+            got = exe._cached_lowerable(prog, {"img": x, "label": y},
+                                        [loss], None)
+            return got[1].as_text(), prog
+
+    t_plain, prog_plain = text(False)
+    assert "bf16" not in t_plain
+    assert not getattr(prog_plain, "_amp", False)
+    t_killed, prog_killed = text(True, level="O0")
+    assert t_killed == t_plain, "O0 kill switch must reproduce the " \
+        "undecorated HLO byte-for-byte"
+    assert not getattr(prog_killed, "_amp_master_of", None)
+    t_amp, _ = text(True)
+    assert "bf16" in t_amp
+
+
+# ---------------------------------------------------------------------------
+# checkpoint save/restore (tentpole d)
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_roundtrip_unshards_masters(tmp_path):
+    """Masters save at their LOGICAL fp32 shapes (unshard_scope_value,
+    same path as the moments); params save bf16; a reload + continued
+    training matches an uninterrupted run bit-for-bit."""
+    import ml_dtypes
+
+    adam = lambda: O.AdamOptimizer(learning_rate=0.01)  # noqa: E731
+    x, y = _batch()
+    l_ref, *_ = _train(adam, True, steps=4)
+    _, exe, prog, loss, plan, _ = _train(adam, True, steps=2)
+    fluid.io.save_persistables(exe, str(tmp_path), main_program=prog)
+    pname, m = next(iter(plan.master_of.items()))
+    saved_m = np.load(os.path.join(str(tmp_path),
+                                   m.replace("/", "%2F") + ".npy"))
+    info = plan.sharded_state[m]
+    assert tuple(saved_m.shape) == info.shape, \
+        "master must persist at its LOGICAL fp32 shape"
+    assert saved_m.dtype == np.float32
+    # bf16 params persist with their true dtype (npy descr degrades
+    # ml_dtypes to raw void; io writes a .dtype sidecar)
+    saved_p = fluid.io._load_dict(str(tmp_path), [pname])[pname]
+    assert saved_p.dtype == ml_dtypes.bfloat16
+    fluid.io.load_persistables(exe, str(tmp_path), main_program=prog)
+    l_cont = [float(exe.run(prog, feed={"img": x, "label": y},
+                            fetch_list=[loss])[0].mean())
+              for _ in range(2)]
+    assert l_ref[2:] == l_cont
+
+
+# ---------------------------------------------------------------------------
+# fp16 dynamic loss scaling (satellite: state-machine tests)
+# ---------------------------------------------------------------------------
+
+def _fp16_setup(init_scaling, incr_every=2, decr_every=1, steps=0,
+                ndev=8):
+    from paddle_tpu.core.scope import global_scope
+
+    _fresh()
+    set_flags({"FLAGS_tpu_sharded_weight_update": True,
+               "FLAGS_tpu_comm_bucket_mb": 0.0})
+    r = np.random.RandomState(0)
+    x = r.rand(64, 32).astype("float32")
+    y = r.randint(0, 4, (64, 1)).astype("int64")
+    with framework.unique_name_guard():
+        loss = _mlp_loss(hidden=16)
+        opt = mixed_precision.decorate(
+            O.SGDOptimizer(learning_rate=0.1), amp_dtype="float16",
+            init_loss_scaling=init_scaling,
+            incr_every_n_steps=incr_every,
+            decr_every_n_nan_or_inf=decr_every, incr_ratio=2.0,
+            decr_ratio=0.5)
+        opt.minimize(loss)
+        prog = fluid.default_main_program()
+        fluid.CompiledProgram(prog).with_data_parallel(
+            loss_name=loss.name)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        dls = opt._scale_state
+        assert dls is not None
+
+        def step():
+            exe.run(prog, feed={"img": x, "label": y},
+                    fetch_list=[loss])
+
+        def read(name):
+            return float(np.asarray(
+                global_scope().find_var(name)).reshape(-1)[0])
+
+        def master():
+            # layout-agnostic read: logical before the first compile,
+            # flat dp-sharded (padded) after
+            m = sorted(opt.get_master_weights().values())[0]
+            mv = prog.global_block()._find_var_recursive(m)
+            numel = int(np.prod(mv.shape))
+            v = np.asarray(global_scope().find_var(m))
+            return v.reshape(-1)[:numel].copy()
+
+        for _ in range(steps):
+            step()
+    return step, read, master, dls, opt, exe, prog
+
+
+def test_fp16_overflow_skips_update_and_decays_scale():
+    """A scale large enough to overflow fp16 grads: the whole weight
+    update (master included) is SKIPPED under the lax.cond, the bad
+    counter trips and the scale decays by decr_ratio; once the scale
+    has decayed into range, updates apply again."""
+    step, read, master, dls, opt, _, _ = _fp16_setup(2.**20)
+    p0 = master()
+    s0 = read(dls["scale"])
+    step()
+    assert read(dls["scale"]) == s0 * 0.5, "overflow must decay"
+    np.testing.assert_array_equal(p0, master())  # update skipped
+    # keep stepping until the scale is in range: update applies
+    for _ in range(8):
+        step()
+        if not np.array_equal(p0, master()):
+            break
+    assert not np.array_equal(p0, master()), \
+        "update never resumed after the scale decayed into range"
+    assert opt.get_loss_scaling() < 2.**20
+
+
+def test_fp16_scale_growth_every_n_clean_steps():
+    """incr_every_n_steps=2 clean steps double the scale; the good
+    counter resets after each growth."""
+    step, read, master, dls, *_ = _fp16_setup(2.**4, incr_every=2)
+    s0 = read(dls["scale"])
+    p0 = master()
+    step()
+    assert read(dls["scale"]) == s0
+    assert read(dls["good"]) == 1
+    assert not np.array_equal(p0, master()), "clean step must update"
+    step()
+    assert read(dls["scale"]) == s0 * 2
+    assert read(dls["good"]) == 0
+    step()
+    assert read(dls["scale"]) == s0 * 2
+    assert read(dls["good"]) == 1
+
+
+def test_fp16_scale_state_survives_checkpoint(tmp_path):
+    """The scale/good/bad state persists through save_persistables +
+    load_persistables like any optimizer state: a restored run resumes
+    the state machine exactly where it left off."""
+    step, read, _, dls, _, exe, prog = _fp16_setup(2.**4, incr_every=3,
+                                                   steps=2)
+    want = {k: read(dls[k]) for k in ("scale", "good", "bad")}
+    assert want["good"] == 2
+    fluid.io.save_persistables(exe, str(tmp_path), main_program=prog)
+    step()  # mutate past the snapshot
+    assert read(dls["good"]) != want["good"]
+    fluid.io.load_persistables(exe, str(tmp_path), main_program=prog)
+    got = {k: read(dls[k]) for k in ("scale", "good", "bad")}
+    assert got == want
+    step()  # third clean step after restore -> growth fires
+    assert read(dls["scale"]) == want["scale"] * 2
+    assert read(dls["good"]) == 0
+
+
+def test_fp16_dynamic_scaling_sharded_parity():
+    """With an in-range scale, fp16 dynamic-loss-scaled training is
+    bit-identical between the sharded and replicated master update."""
+    kw = dict(decorate_kw=dict(amp_dtype="float16",
+                               init_loss_scaling=2.**8,
+                               incr_every_n_steps=3))
+    sgd = lambda: O.SGDOptimizer(learning_rate=0.1)  # noqa: E731
+    l_rep, *_ = _train(sgd, False, **kw)
+    l_sh, _, _, _, plan, _ = _train(sgd, True, **kw)
+    assert plan is not None and plan.master_of
+    assert l_rep == l_sh
+
+
+def test_fp16_dls_with_global_norm_clip_and_aux_fetch():
+    """Two cond-typing regressions: (a) global-norm clip promotes the
+    rebound fp16 grads to fp32 inside the apply branch — the branch
+    exit must re-align dtypes with the skip side or lax.cond rejects
+    the mismatched pytrees; (b) a post-section-CREATED var (the global
+    grad norm) must ride the cond outputs to stay fetchable — zeros on
+    a skipped step, the real value on an applied one."""
+    from paddle_tpu.fluid.framework import grad_var_name
+
+    _fresh()
+    set_flags({"FLAGS_tpu_sharded_weight_update": True,
+               "FLAGS_tpu_comm_bucket_mb": 0.0})
+    x, y = _batch()
+    with framework.unique_name_guard():
+        loss = _mlp_loss(hidden=16)
+        fluid.clip.set_gradient_clip(
+            fluid.clip.GradientClipByGlobalNorm(1.0))
+        opt = mixed_precision.decorate(
+            O.SGDOptimizer(learning_rate=0.1), amp_dtype="float16",
+            init_loss_scaling=2.**8, incr_every_n_steps=100)
+        opt.minimize(loss)
+        fluid.clip._clip_attr.clear()
+        prog = fluid.default_main_program()
+        fluid.CompiledProgram(prog).with_data_parallel(
+            loss_name=loss.name)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        # a clipped (rebound, dtype-promoted inside the branch) grad
+        # var fetches fine, as does a post-CREATED intermediate
+        gname = grad_var_name(prog.all_parameters()[0].name)
+        post = prog.global_block().ops
+        bwd = next(i for i, op in enumerate(post)
+                   if op.type == "backward")
+        created = next(
+            n for op in post[bwd + 1:]
+            for n in op.output_arg_names
+            if prog.global_block()._find_var_recursive(n) is not None
+            and "sqrt" in op.type)
+        outs = [exe.run(prog, feed={"img": x, "label": y},
+                        fetch_list=[loss, gname, created])
+                for _ in range(3)]
+        for o in outs:
+            assert np.isfinite(np.asarray(o[0])).all()
+            # the global norm: one live positive value (replicated
+            # per-shard by the non-persistable fetch spec)
+            norm = np.unique(np.asarray(o[2]))
+            assert norm.size == 1 and norm[0] > 0, norm
+
+
+def test_fp16_dls_disabled_under_explicit_sync_with_warning():
+    """Explicit-sync (fleet) programs sum grads inside the post
+    section: the finite check would see pre-sum values and the unscale
+    would run pre-sum — mis-protection. The lowering must disable dls
+    LOUDLY and pass the scale state through unchanged."""
+    import warnings as _w
+
+    from paddle_tpu import fleet
+    from paddle_tpu.core.scope import global_scope
+
+    _fresh()
+    set_flags({"FLAGS_tpu_sharded_weight_update": True,
+               "FLAGS_tpu_comm_bucket_mb": 0.0})
+    x, y = _batch()
+    with framework.unique_name_guard():
+        loss = _mlp_loss(hidden=16)
+        opt = mixed_precision.decorate(
+            O.SGDOptimizer(learning_rate=0.1), amp_dtype="float16",
+            init_loss_scaling=2.**10)
+        opt.minimize(loss)
+        prog = fluid.default_main_program()
+        fleet.transpile_collective(prog)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            exe.run(prog, feed={"img": x, "label": y},
+                    fetch_list=[loss])
+        assert any("explicit-sync" in str(w.message) for w in rec), \
+            [str(w.message) for w in rec]
+        dls = opt._scale_state
+        exe.run(prog, feed={"img": x, "label": y}, fetch_list=[loss])
+        scale = float(np.asarray(
+            global_scope().find_var(dls["scale"])).reshape(-1)[0])
+        assert scale == 2.**10, "scale state must pass through unchanged"
+
+
+# ---------------------------------------------------------------------------
+# planner fallback reasons (satellite: ZeRO-1 gap surfacing)
+# ---------------------------------------------------------------------------
+
+def test_fallback_reasons_are_structured_not_silent():
+    """An unplannable program (dpsgd has no flat-shard rule) records a
+    structured per-var reason on program._sharded_update_fallback
+    instead of falling back silently."""
+    _fresh()
+    set_flags({"FLAGS_tpu_sharded_weight_update": True})
+    x, y = _batch()
+    with framework.unique_name_guard():
+        loss = _mlp_loss()
+        O.DpsgdOptimizer(learning_rate=0.1).minimize(loss)
+        prog = fluid.default_main_program()
+        fluid.CompiledProgram(prog).with_data_parallel(
+            loss_name=loss.name)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        exe.run(prog, feed={"img": x, "label": y}, fetch_list=[loss])
+        assert getattr(prog, "_shard_plan", None) is None
+        fb = getattr(prog, "_sharded_update_fallback", None)
+        assert fb, "decline must be recorded"
+        assert fb[0]["kind"] == "declined"
+        assert fb[0]["op"] == "dpsgd"
+        assert "shard-aware" in fb[0]["reason"]
+
+
+# ---------------------------------------------------------------------------
+# hapi dygraph surface (Model.prepare(amp_level=...))
+# ---------------------------------------------------------------------------
+
+def test_hapi_amp_level_o2_masters():
+    """prepare(amp_level='O2'): network params live bf16, the eager
+    wrapper keeps fp32 masters, and training converges on a toy fit."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.fluid.dygraph import Linear
+    from paddle_tpu.hapi.model import Model
+
+    r = np.random.RandomState(3)
+    x = r.rand(64, 16).astype("float32")
+    y = r.randint(0, 4, (64, 1)).astype("int64")
+    net = Linear(16, 4)
+    m = Model(net)
+    m.prepare(
+        O.SGDOptimizer(learning_rate=0.5,
+                       parameter_list=net.parameters()),
+        loss_function=lambda pred, label: fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(pred, label)),
+        amp_level="O2")
+    from paddle_tpu.fluid.contrib.mixed_precision import \
+        EagerMasterWeightOptimizer
+
+    assert isinstance(m._optimizer, EagerMasterWeightOptimizer)
+    for p in net.parameters():
+        assert p._value().dtype == jnp.bfloat16, p.name
+    losses = [m.train_batch([x], [y])[0][0] for _ in range(12)]
+    assert losses[-1] < losses[0]
+    for p in net.parameters():
+        assert p._value().dtype == jnp.bfloat16  # live stays bf16
+        master = m._optimizer._masters[p.name]
+        assert master.dtype == jnp.float32
+        np.testing.assert_array_equal(
+            np.asarray(p._value()),
+            np.asarray(master.astype(jnp.bfloat16)))
+
+
+def test_hapi_amp_master_invalidated_on_external_reassignment():
+    """Regression: after Model.load (or any external _assign_raw) the
+    eager wrapper must re-seed its fp32 master from the NEW live value
+    — a stale cached master would silently overwrite the loaded
+    weights on the next step."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.fluid.dygraph import Linear
+    from paddle_tpu.hapi.model import Model
+
+    r = np.random.RandomState(3)
+    x = r.rand(32, 8).astype("float32")
+    y = r.randint(0, 2, (32, 1)).astype("int64")
+    net = Linear(8, 2)
+    m = Model(net)
+    m.prepare(
+        O.SGDOptimizer(learning_rate=0.1,
+                       parameter_list=net.parameters()),
+        loss_function=lambda pred, label: fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(pred, label)),
+        amp_level="O2")
+    for _ in range(3):
+        m.train_batch([x], [y])  # masters cached
+    # external same-shape reassignment (what Model.load does)
+    loaded = jnp.asarray(
+        r.rand(*net.parameters()[0].shape).astype("float32")
+    ).astype(jnp.bfloat16)
+    net.parameters()[0]._assign_raw(loaded)
+    m.train_batch([x], [y])
+    new_master = m._optimizer._masters[net.parameters()[0].name]
+    # one SGD step from the LOADED value, not from the stale master:
+    # the loaded weights moved by at most lr*|grad|, not back to the
+    # pre-load trajectory
+    drift = np.abs(np.asarray(new_master, np.float32)
+                   - np.asarray(loaded, np.float32))
+    assert float(drift.max()) < 0.2, \
+        "master was not re-seeded from the externally assigned value"
+
+
+def test_hapi_amp_skips_bn_stats_and_survives_load(tmp_path):
+    """Regression pair: (a) BatchNorm running mean/variance
+    (non-trainable) stay fp32 under amp_level — their momentum update
+    accumulates and bf16 resolution would degrade eval statistics;
+    (b) Model.load re-applies the compute-dtype cast (set_dict restores
+    the checkpoint's fp32 dtypes, which would silently turn AMP and
+    the master wrapper off)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.fluid.dygraph import BatchNorm, Linear, Sequential
+    from paddle_tpu.hapi.model import Model
+
+    r = np.random.RandomState(3)
+    x = r.rand(32, 8).astype("float32")
+    y = r.randint(0, 2, (32, 1)).astype("int64")
+
+    def build():
+        net = Sequential(Linear(8, 8), BatchNorm(8), Linear(8, 2))
+        m = Model(net)
+        m.prepare(
+            O.SGDOptimizer(learning_rate=0.1,
+                           parameter_list=net.parameters()),
+            loss_function=lambda p, l: fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(p, l)),
+            amp_level="O2")
+        return m, net
+
+    m, net = build()
+    stats = [p for p in net.parameters()
+             if not getattr(p, "trainable", True)]
+    assert stats, "BatchNorm must expose running stats"
+    for p in stats:
+        assert p._value().dtype == jnp.float32, p.name
+    m.train_batch([x], [y])
+    path = str(tmp_path / "ckpt")
+    m.save(path)
+    m2, net2 = build()
+    m2.load(path)
+    for p in net2.parameters():
+        want = jnp.bfloat16 if getattr(p, "trainable", True) \
+            else jnp.float32
+        assert p._value().dtype == want, (p.name, p._value().dtype)
+    m2.train_batch([x], [y])
+    assert m2._optimizer._masters, "masters must re-engage after load"
+
+
+def test_hapi_amp_level_validation():
+    from paddle_tpu.fluid.dygraph import Linear
+    from paddle_tpu.hapi.model import Model
+
+    with pytest.raises(ValueError):
+        Model(Linear(4, 2)).prepare(amp_level="O3")

@@ -11,8 +11,12 @@ BEFORE dispatch, warn warns, clean programs pass under =error), the
 `collective_byte_census` region coverage for switch_case /
 conditional_block collectives, the `_block_host_op_kinds` any-depth
 recursion contract, and the exemplar lint-regression harness
-(tools/tpu_lint.py: BERT-tiny DP step, resnet scan, 2-rank sync-PS —
-zero errors, standing).
+(tools/tpu_lint.py: BERT-tiny DP step — plain and bf16 AMP + ZeRO-2
+bucketed masters — resnet scan, 2-rank sync-PS — zero errors,
+standing). Checker 6 (zero2-lifetimes) seeded defects: a full-grad
+read after scatter, a fetch of a scattered grad, an early-flushed
+pending bucket; dtype-contract gains redundant-cast round-trip
+fixtures and the AMP-policy suppressions.
 """
 import json
 import os
@@ -518,6 +522,108 @@ def test_misaligned_bucket_padding_trips():
 
 
 # ---------------------------------------------------------------------------
+# checker 6 — ZeRO-2 gradient lifetimes
+# ---------------------------------------------------------------------------
+
+def test_zero2_valid_plan_is_clean():
+    prog, _ = _planned_dp_program()
+    assert not analysis.check_zero2_lifetimes(prog)
+
+
+def test_zero2_full_grad_read_after_scatter_trips():
+    """An op without a shard-space rule reading a scattered gradient
+    (inserted after planning) would all_gather the full buffer back —
+    the ZeRO-2 lifetime violation, located at the offending op."""
+    prog, plan = _planned_dp_program()
+    blk = prog.global_block()
+    g = sorted(plan.grad_names)[0]
+    out = blk.create_var(name="lint.zero2.out", shape=(1,),
+                         dtype="float32")
+    idx = _bwd_idx(blk) + 1
+    blk.ops.insert(idx, Operator(
+        blk, "elementwise_pow", inputs={"X": [g], "Y": [g]},
+        outputs={"Out": [out.name]}, attrs={}))
+    fs = [f for f in analysis.check_zero2_lifetimes(prog)]
+    assert len(fs) == 1
+    f = fs[0]
+    assert f.severity == "error" and f.op_type == "elementwise_pow"
+    assert f.op_idx == idx and f.var == g
+    assert "all_gather the full gradient" in f.message
+
+
+def test_zero2_broadcasting_elementwise_after_planning_trips():
+    """The elementwise vocabulary is shard-safe only for same-shape /
+    scalar operands — a post-planning broadcast over a scattered grad
+    must trip here too (mirrors the planner's and checker 4's decline),
+    or a standalone zero2 run would bless a program whose shard-space
+    lowering mis-broadcasts."""
+    prog, plan = _planned_dp_program()
+    blk = prog.global_block()
+    g = next(n for n in sorted(plan.grad_names)
+             if int(np.prod(blk._find_var_recursive(n).shape)) > 8)
+    vec = blk.create_var(name="lint.zero2.bcast", shape=(8,),
+                         dtype="float32")
+    idx = _bwd_idx(blk) + 1
+    blk.ops.insert(idx, Operator(
+        blk, "elementwise_mul", inputs={"X": [g], "Y": [vec.name]},
+        outputs={"Out": [g]}, attrs={"axis": 0}))
+    fs = analysis.check_zero2_lifetimes(prog)
+    assert len(fs) == 1
+    f = fs[0]
+    assert f.severity == "error" and f.op_type == "elementwise_mul"
+    assert f.op_idx == idx and f.var == g
+    assert "no flat-shard analogue" in f.message
+
+
+def test_zero2_fetch_of_scattered_grad_warns():
+    prog, plan = _planned_dp_program()
+    g = sorted(plan.grad_names)[0]
+    fs = analysis.check_zero2_lifetimes(prog, fetch_names=[g])
+    assert len(fs) == 1
+    assert fs[0].severity == "warning" and fs[0].var == g
+    assert "gathers the FULL buffer" in fs[0].message
+
+
+def test_zero2_pending_bucket_early_flush_warns():
+    """Explicit-sync bucketed programs: an op reading a grad whose
+    bucket is still pending forces a partial early flush — the bucket's
+    full grads die in pieces."""
+    from paddle_tpu import fleet
+    from paddle_tpu.parallel import sharded_update as su
+
+    loss = _mlp_loss()
+    fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    prog = fluid.default_main_program()
+    fleet.transpile_collective(prog, nranks=8)
+    blk = prog.global_block()
+    set_flags({"FLAGS_tpu_comm_bucket_mb": 1000.0,
+               "FLAGS_tpu_sharded_weight_update": True})
+    plan = su.plan_sharded_update(prog, blk, 8, "dp")
+    assert plan is not None and plan.explicit_sync and plan.buckets
+    prog._shard_plan = plan
+    assert not analysis.check_zero2_lifetimes(prog)  # contiguous: clean
+    # wedge a reader of the FIRST allreduced grad between the pending
+    # c_allreduce_sum ops
+    ar_idx = [i for i, op in enumerate(blk.ops)
+              if op.type == "c_allreduce_sum"]
+    assert len(ar_idx) >= 2
+    first_g = blk.ops[ar_idx[0]].input_names["X"][0]
+    out = blk.create_var(name="lint.zero2.flush", shape=(1,),
+                         dtype="float32")
+    blk.ops.insert(ar_idx[0] + 1, Operator(
+        blk, "squared_l2_norm", inputs={"X": [first_g]},
+        outputs={"Out": [out.name]}, attrs={}))
+    fs = analysis.check_zero2_lifetimes(prog)
+    wedge = [f for f in fs if f.op_idx == ar_idx[0] + 1]
+    assert wedge and wedge[0].severity == "warning"
+    assert wedge[0].var == first_g
+    assert "reduce-scatters early" in wedge[0].message
+    # the remaining grads then flush partially at the optimizer's own
+    # read — the checker mirrors the runtime and flags that too
+    assert all(f.severity == "warning" for f in fs)
+
+
+# ---------------------------------------------------------------------------
 # checker 5 — dtype/shape contracts
 # ---------------------------------------------------------------------------
 
@@ -547,6 +653,84 @@ def test_shape_contract_drift():
     v.shape = (-1, 5)
     fs = analysis.check_dtype_shape_contracts(prog)
     assert any(f.var == y.name and "shape" in f.message for f in fs)
+
+
+def _mark_amp(prog, dtype="bfloat16"):
+    from paddle_tpu.fluid.contrib.mixed_precision import \
+        AutoMixedPrecisionLists
+
+    prog._amp = True
+    prog._amp_lists = AutoMixedPrecisionLists()
+    prog._amp_dtype = dtype
+    return prog
+
+
+def test_redundant_cast_round_trip_warns():
+    """cast(cast(x bf16 -> f32) -> bf16) with a single-use intermediate
+    is an identity round-trip the AMP pass should have elided."""
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    a = fluid.layers.cast(x, "bfloat16")
+    b = fluid.layers.cast(a, "float32")
+    c = fluid.layers.cast(b, "bfloat16")
+    prog = fluid.default_main_program()
+    fs = [f for f in analysis.check_dtype_shape_contracts(prog)
+          if "redundant-cast" in f.message]
+    assert len(fs) == 1
+    f = fs[0]
+    assert f.severity == "warning" and f.var == c.name
+    assert "identity" in f.message
+    # a consumer of the fp32 intermediate legitimizes the chain
+    fluid.layers.scale(b, scale=2.0)
+    fs = [f for f in analysis.check_dtype_shape_contracts(prog)
+          if "redundant-cast" in f.message and f.var == c.name]
+    assert not fs
+
+
+def test_redundant_upcast_into_white_list_warns_under_amp():
+    """AMP: an explicit bf16 -> fp32 cast whose every reader is a
+    white-list op round-trips by construction (the policy casts those
+    inputs straight back down)."""
+    x = fluid.layers.data(name="x", shape=[4, 4], dtype="bfloat16")
+    y = fluid.layers.cast(x, "float32")
+    fluid.layers.mul(y, y)
+    prog = _mark_amp(fluid.default_main_program())
+    fs = [f for f in analysis.check_dtype_shape_contracts(prog)
+          if "redundant-cast" in f.message]
+    assert len(fs) == 1 and fs[0].var == y.name
+    assert "white-list" in fs[0].message
+    # without the AMP marking there is no policy to re-cast: clean
+    prog._amp = False
+    assert not [f for f in analysis.check_dtype_shape_contracts(prog)
+                if "redundant-cast" in f.message]
+
+
+def test_amp_policy_suppresses_mixed_dtype_drift_and_fp64_flag():
+    """The trace-time AMP casts make a f32<->bf16 declaration
+    disagreement legitimate (suppressed under _amp, a warning without
+    it); the fp64-promotion check never fires on white-listed ops."""
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.scale(x, scale=2.0)
+    prog = fluid.default_main_program()
+    prog.global_block()._find_var_recursive(y.name).dtype = "bfloat16"
+    fs = analysis.check_dtype_shape_contracts(prog)
+    assert any(f.var == y.name and "drifted" in f.message for f in fs)
+    _mark_amp(prog)
+    assert not analysis.check_dtype_shape_contracts(prog)
+    # white-listed op requesting f64 via attrs: mis-flag without the
+    # policy, clean with it (the op runs in bf16 under AMP)
+    from paddle_tpu.fluid.framework import Operator
+
+    blk = prog.global_block()
+    out = blk.create_var(name="amp.f64.out", shape=(4,),
+                         dtype="float32")
+    blk.ops.append(Operator(
+        blk, "mul", inputs={"X": [x.name], "Y": [x.name]},
+        outputs={"Out": [out.name]}, attrs={"dtype": "float64"}))
+    assert not [f for f in analysis.check_dtype_shape_contracts(prog)
+                if "fp64" in f.message]
+    prog._amp = False
+    assert [f for f in analysis.check_dtype_shape_contracts(prog)
+            if "fp64" in f.message and f.op_type == "mul"]
 
 
 # ---------------------------------------------------------------------------
@@ -704,13 +888,14 @@ def _import_tpu_lint():
 
 
 def test_exemplar_programs_lint_clean():
-    """The standing regression: BERT-tiny DP step, resnet scan, and
-    the 2-rank fleet-transpiled sync-PS programs all lint with zero
-    errors across every checker."""
+    """The standing regression: BERT-tiny DP step (plain AND bf16 AMP
+    + ZeRO-2 bucketed masters), resnet scan, and the 2-rank
+    fleet-transpiled sync-PS programs all lint with zero errors across
+    every checker."""
     tpu_lint = _import_tpu_lint()
     results = tpu_lint.lint_exemplars()
-    assert set(results) == {"bert_tiny", "resnet_scan",
-                            "fleet_ps_2rank"}
+    assert set(results) == {"bert_tiny", "bert_tiny_amp",
+                            "resnet_scan", "fleet_ps_2rank"}
     for name, (findings, summary) in results.items():
         errs = [analysis.format_finding(f) for f in findings
                 if f.severity == "error"]
@@ -727,8 +912,8 @@ def test_cli_end_to_end(tmp_path):
     assert r.returncode == 0, r.stdout + r.stderr
     report = json.loads(out.read_text())
     assert report["ok"] and report["total_errors"] == 0
-    assert set(report["programs"]) == {"bert_tiny", "resnet_scan",
-                                       "fleet_ps_2rank"}
+    assert set(report["programs"]) == {"bert_tiny", "bert_tiny_amp",
+                                       "resnet_scan", "fleet_ps_2rank"}
     assert "tpu-lint:" in r.stdout
 
 

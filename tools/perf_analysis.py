@@ -266,10 +266,15 @@ def sharded_update_diff(batch=16, seq_len=32):
             batch, seq_len, {"FLAGS_tpu_sharded_weight_update": flag})
         col = exe.collective_report(prog, feed=feed, fetch_list=[total])
         don = exe.donation_report(prog, feed=feed, fetch_list=[total])
-        return col, don
+        # structured per-var fallback trail: why the planner declined /
+        # degraded anything (empty = the whole update is sharded) —
+        # surfaced here instead of silence (ROADMAP ZeRO-1 gap item)
+        fallback = list(getattr(prog, "_sharded_update_fallback",
+                                None) or [])
+        return col, don, fallback
 
-    col_off, don_off = one(False)
-    col_on, don_on = one(True)
+    col_off, don_off, _ = one(False)
+    col_on, don_on, fallback = one(True)
     grad_off = col_off.get("all_reduce", {}).get("ici_bytes", 0)
     grad_on = col_on.get("reduce_scatter", {}).get("ici_bytes", 0)
     out = {
@@ -285,6 +290,7 @@ def sharded_update_diff(batch=16, seq_len=32):
                 don_on.get("opt_state_logical_bytes"),
             "sharded_per_replica":
                 don_on.get("opt_state_per_replica_bytes")},
+        "fallback_reasons": fallback,
     }
     path = os.path.join(_REPO, "artifacts", "sharded_update_diff.json")
     os.makedirs(os.path.dirname(path), exist_ok=True)
@@ -303,6 +309,13 @@ def sharded_update_diff(batch=16, seq_len=32):
              out["opt_state_bytes"]["replicated_per_replica"],
              out["opt_state_bytes"]["sharded_per_replica"],
              "OK" if ok else "REDUCTION NOT MET", path))
+    if fallback:
+        print("sharded-update fallback reasons (%d):" % len(fallback))
+        for f in fallback:
+            print("  [%s] %s (var=%s op=%s)"
+                  % (f["kind"], f["reason"], f["var"], f["op"]))
+    else:
+        print("sharded-update fallback reasons: none (fully planned)")
     return 0 if ok else 1
 
 

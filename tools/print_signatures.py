@@ -45,6 +45,9 @@ _MODULES = [
     # tpu-lint static verifier: checkers + Finding are a public,
     # CI-relied-on surface (tools/tpu_lint.py, FLAGS_tpu_static_checks)
     "paddle_tpu.analysis",
+    # AMP: decorate()/master-weight rewrites are the bench's and the
+    # perf-analysis tooling's entry into mixed precision — lock them
+    "paddle_tpu.fluid.contrib.mixed_precision",
     "paddle_tpu.hapi.model",
     "paddle_tpu.nn",
     "paddle_tpu.tensor",

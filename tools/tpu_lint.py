@@ -8,6 +8,11 @@ Exemplars (each is a program the bench / tier-1 suite actually runs):
 - ``bert_tiny``     — the data-parallel BERT-tiny Adam train step
                       (with the ZeRO-1 shard plan attached, so the
                       zero1-invariants checker has a plan to verify);
+- ``bert_tiny_amp`` — the SAME model under bf16 AMP with ZeRO-sharded
+                      fp32 master weights and bucketed (ZeRO-2) grad
+                      collectives — the zero2-lifetimes leg plus the
+                      AMP-aware dtype-contract checks, zero errors
+                      required;
 - ``resnet_scan``   — ResNet50 with scan_stages (deep control-flow
                       nesting: host-sync + contract checkers descend
                       through the scan sub-blocks);
@@ -75,6 +80,45 @@ def build_bert_tiny():
     return prog, None
 
 
+def build_bert_tiny_amp():
+    """BERT-tiny with bf16 AMP + ZeRO-sharded fp32 master weights +
+    bucketed (ZeRO-2) gradient collectives: live params bf16, every
+    optimizer op updates a ``@MASTER`` shard, grads bucket under a
+    0.25 MB cap — the mixed-precision plan the zero1-invariants,
+    zero2-lifetimes and (AMP-aware) dtype-contract checkers verify.
+    Zero errors required."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework
+    from paddle_tpu.fluid.contrib import mixed_precision
+    from paddle_tpu.models import bert
+    from paddle_tpu.parallel import sharded_update as su
+    from paddle_tpu.utils.flags import get_flag, set_flags
+
+    _fresh()
+    with framework.unique_name_guard():
+        cfg = bert.BertConfig.tiny()
+        framework.default_main_program().random_seed = 7
+        total, _, _, _ = bert.bert_pretrain_loss(cfg, 32, is_test=False)
+        opt = mixed_precision.decorate(
+            fluid.optimizer.AdamOptimizer(learning_rate=1e-3))
+        opt.minimize(total)
+        prog = fluid.default_main_program()
+        fluid.CompiledProgram(prog).with_data_parallel(
+            loss_name=total.name)
+        old = get_flag("FLAGS_tpu_comm_bucket_mb")
+        try:
+            set_flags({"FLAGS_tpu_comm_bucket_mb": 0.25})
+            prog._shard_plan = su.plan_sharded_update(
+                prog, prog.global_block(), NDEV, "dp")
+        finally:
+            set_flags({"FLAGS_tpu_comm_bucket_mb": old})
+        plan = prog._shard_plan
+        assert plan is not None and plan.master_of and plan.buckets, \
+            "AMP+ZeRO-2 exemplar failed to plan (fallback: %s)" % (
+                getattr(prog, "_sharded_update_fallback", None),)
+    return prog, None
+
+
 def build_resnet_scan():
     """ResNet50 momentum step with scan_stages (32x32, 10 classes —
     the IR is what the checkers walk; image size only scales FLOPs)."""
@@ -126,6 +170,7 @@ def build_fleet_ps_2rank():
 
 EXEMPLARS = {
     "bert_tiny": build_bert_tiny,
+    "bert_tiny_amp": build_bert_tiny_amp,
     "resnet_scan": build_resnet_scan,
     "fleet_ps_2rank": build_fleet_ps_2rank,
 }
