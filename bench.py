@@ -707,168 +707,19 @@ def _enable_compile_cache():
         pass
 
 
-def _attach_static_checks(result, program):
-    """tpu-lint summary of the program that just ran (paddle_tpu/
-    analysis): zero errors is the standing claim — any benched program
-    whose collective schedule / donation contract / hot-loop hygiene /
-    shard plan regresses shows up here alongside "overlap" and
-    "collectives" in the round artifact. Evidence, not gating."""
+def _attach_blocks(result, exe, program, feed, fetch_list):
+    """Attach every evidence block of the step that just ran — phases,
+    collectives / opt_state_sharding / overlap (when data-parallel),
+    precision (when AMP), static_checks, telemetry — assembled by the
+    ONE registry-backed publisher (paddle_tpu/observability/publish.py)
+    instead of per-block ad-hoc code here. Evidence, not gating."""
     try:
-        from paddle_tpu import analysis
+        from paddle_tpu.observability import publish
 
-        findings = analysis.run_static_checks(program)
-        s = analysis.summarize(findings)
-        result["static_checks"] = {
-            "errors": s["errors"],
-            "warnings": s["warnings"],
-            "by_checker": s["by_checker"],
-            # cap the embedded detail; the CLI writes the full report
-            "findings": s["findings"][:20],
-        }
-        print("BENCH static checks: %d error(s), %d warning(s)"
-              % (s["errors"], s["warnings"]), flush=True)
+        result.update(publish.bench_blocks(exe, program, feed,
+                                           fetch_list))
     except Exception as e:  # noqa: BLE001 - evidence, not gating
-        print("BENCH static checks failed: %r" % (e,), flush=True)
-
-
-def _attach_collectives(result, exe, program, feed, fetch_list):
-    """Per-collective byte census of the step that just ran (lowered
-    StableHLO; Executor.collective_report) — offline ICI evidence for
-    the sharded weight update: with FLAGS_tpu_sharded_weight_update the
-    grad exchange shows as reduce_scatter at ~half the replicated
-    allreduce's ring bytes, the other half moving to the param
-    all_gather. Single-chip steps have no collectives and add nothing."""
-    if getattr(program, "_mesh", None) is None or \
-            not getattr(program, "_data_parallel", False):
-        # single-chip step: provably no collectives — don't pay a full
-        # retrace + StableHLO dump just to parse zero matches
-        return
-    try:
-        col = exe.collective_report(program, feed=feed,
-                                    fetch_list=fetch_list)
-    except Exception as e:  # noqa: BLE001 - census is evidence, not gating
-        print("BENCH collective census failed: %r" % (e,), flush=True)
-        return
-    if col and col.get("total_ici_bytes", 0) > 0:
-        result["collectives"] = col
-        print("BENCH collectives: " + ", ".join(
-            "%s x%d %.1fMB" % (k, v["count"], v["ici_bytes"] / 1e6)
-            for k, v in col.items() if isinstance(v, dict)),
-            flush=True)
-    if col and col.get("reduce_scatter"):
-        # ZeRO-1 active: also report the per-replica optimizer-state
-        # footprint (donation_report compiles via AOT — only pay that
-        # when there is sharding to prove)
-        rep = exe.donation_report(program, feed=feed,
-                                  fetch_list=fetch_list)
-        if rep and rep.get("opt_state_sharded_vars"):
-            result["opt_state_sharding"] = {
-                "vars": rep["opt_state_sharded_vars"],
-                "logical_bytes": rep["opt_state_logical_bytes"],
-                "per_replica_bytes": rep["opt_state_per_replica_bytes"],
-            }
-        # bucketed-collective overlap audit of the optimized schedule
-        # (FLAGS_tpu_comm_bucket_mb): how many grad reduce-scatters are
-        # dataflow-ready before the final backward compute op — the
-        # transfers a latency-hiding scheduler can overlap. Emitted
-        # whenever ZeRO-1 is active so the live tunnel round captures
-        # it with zero extra code.
-        try:
-            ov = exe.overlap_report(program, feed=feed,
-                                    fetch_list=fetch_list)
-        except Exception as e:  # noqa: BLE001 - evidence, not gating
-            print("BENCH overlap audit failed: %r" % (e,), flush=True)
-            ov = None
-        region = (ov or {}).get("region_collectives") or []
-        if ov and (ov.get("collectives") or region):
-            rs = [c for c in ov["collectives"]
-                  if c["kind"] == "reduce-scatter"]
-            result["overlap"] = {
-                "n_buckets": ov.get("n_buckets", 0),
-                "n_backward_compute": ov["n_backward_compute"],
-                "overlappable_reduce_scatters":
-                    ov["overlappable_reduce_scatters"],
-                "reduce_scatters": [
-                    {k: c[k] for k in ("pos", "ready", "backward_after",
-                                       "bytes")} for c in rs],
-                "combined": ov["combined"],
-                # gradient merge traces its collectives inside the
-                # lax.cond region — fenced, but visible
-                "region_collectives": region,
-            }
-            print("BENCH overlap: %d/%d reduce-scatters ready before "
-                  "the final backward op (buckets=%d, backward left "
-                  "behind each: %s)"
-                  % (ov["overlappable_reduce_scatters"], len(rs),
-                     ov.get("n_buckets", 0),
-                     [c["backward_after"] for c in rs]), flush=True)
-
-
-def _attach_precision(result, exe, program, feed, fetch_list):
-    """Mixed-precision evidence block for the step that just ran: the
-    AMP policy it lowered under (compute dtype, level, list sizes), the
-    live-param vs fp32-master HBM split (ZeRO-sharded masters are ~1/N
-    per replica — Executor.donation_report param_* fields), the ZeRO-2
-    peak-grad model, and the loss-scale state for fp16 runs (bf16 needs
-    none by design). Evidence, not gating."""
-    if not getattr(program, "_amp", False):
-        return
-    try:
-        import numpy as np
-
-        lists = getattr(program, "_amp_lists", None)
-        masters = dict(getattr(program, "_amp_master_of", None) or {})
-        block = {
-            "amp_dtype": str(getattr(program, "_amp_dtype", "bfloat16")),
-            "level": "O2" if masters else "O1",
-            "master_weights": len(masters),
-            "white_list_ops": len(lists.white_list) if lists else 0,
-            "black_list_ops": len(lists.black_list) if lists else 0,
-        }
-        rep = exe.donation_report(program, feed=feed,
-                                  fetch_list=fetch_list)
-        for k in ("param_bf16_bytes", "param_master_bytes",
-                  "param_fp32_replicated_bytes", "param_masters_sharded",
-                  "grad_peak_per_replica_bytes",
-                  "grad_replicated_peak_bytes"):
-            if rep and k in rep:
-                block[k] = rep[k]
-        bop = next((op for op in program.global_block().ops
-                    if op.type == "backward"), None)
-        dls = bop.attrs.get("dynamic_loss_scaling") if bop is not None \
-            else None
-        if dls:
-            from paddle_tpu.core.scope import global_scope
-
-            def read(name):
-                v = global_scope().find_var(name)
-                return (float(np.asarray(v).reshape(-1)[0])
-                        if v is not None else None)
-
-            block["loss_scaling"] = {
-                "current": read(dls["scale"]),
-                "good_steps": read(dls["good"]),
-                "bad_steps": read(dls["bad"]),
-                "incr_every_n_steps": dls["incr_every_n_steps"],
-                "decr_every_n_nan_or_inf": dls["decr_every_n_nan_or_inf"],
-            }
-        else:
-            block["loss_scaling"] = None
-        result["precision"] = block
-        msg = ("BENCH precision: %s level=%s masters=%d"
-               % (block["amp_dtype"], block["level"],
-                  block["master_weights"]))
-        if "param_bf16_bytes" in block:
-            msg += (", param %s MB live + %s MB master/replica (fp32 "
-                    "DP would be %s MB)"
-                    % tuple(round(block[k] / 1e6, 2) for k in
-                            ("param_bf16_bytes", "param_master_bytes",
-                             "param_fp32_replicated_bytes")))
-        if block["loss_scaling"]:
-            msg += ", loss_scale=%s" % block["loss_scaling"]["current"]
-        print(msg, flush=True)
-    except Exception as e:  # noqa: BLE001 - evidence, not gating
-        print("BENCH precision block failed: %r" % (e,), flush=True)
+        print("BENCH block assembly failed: %r" % (e,), flush=True)
 
 
 def _bert_flops_per_token(cfg, n_params, seq_len):
@@ -968,8 +819,6 @@ def _bench_child(platform: str, batch: int, steps: int, warmup: int,
                 out = exe.run(main_p, feed=feed, fetch_list=[total])
             np.asarray(out[0])  # block on the final step
             dt = time.perf_counter() - t0
-            phases = _prof.step_phase_summary()
-            print("BENCH " + _prof.step_phase_line(), flush=True)
 
     tokens_per_sec = batch * seq_len * steps / dt
     flops_per_sec = (_bert_flops_per_token(cfg, n_params, seq_len)
@@ -987,13 +836,10 @@ def _bench_child(platform: str, batch: int, steps: int, warmup: int,
         "batch": batch,
         "seq_len": seq_len,
         "loss": round(float(np.asarray(out[0]).reshape(-1)[0]), 4),
-        # host-side step-phase breakdown (fluid/profiler.py): how much
-        # of each step the host spent feeding / dispatching / blocked
-        "phases": phases,
     }
-    _attach_collectives(result, exe, main_p, feed, [total])
-    _attach_precision(result, exe, main_p, feed, [total])
-    _attach_static_checks(result, main_p)
+    # phases / collectives / overlap / precision / static_checks /
+    # telemetry blocks, all read back from the metrics registry
+    _attach_blocks(result, exe, main_p, feed, [total])
     if model != "longctx":
         # no V100 baseline exists for the seq-4096 config (a 32 GB V100
         # cannot hold the unfused step) — longctx reports absolute
@@ -1142,8 +988,6 @@ def _bench_resnet(batch: int, steps: int, warmup: int,
         out = exe.run(main_p, feed=feed, fetch_list=[loss])
     np.asarray(out[0])
     dt = time.perf_counter() - t0
-    phases = _prof.step_phase_summary()
-    print("BENCH " + _prof.step_phase_line(), flush=True)
     imgs_per_sec = batch * steps / dt
     # ~4.1 GFLOPs fwd per 224x224 image, x3 for training
     result = {
@@ -1155,11 +999,8 @@ def _bench_resnet(batch: int, steps: int, warmup: int,
         "compile_time_s": round(compile_time, 1),
         "batch": batch,
         "loss": round(float(np.asarray(out[0]).reshape(-1)[0]), 4),
-        "phases": phases,
     }
-    _attach_collectives(result, exe, main_p, feed, [loss])
-    _attach_precision(result, exe, main_p, feed, [loss])
-    _attach_static_checks(result, main_p)
+    _attach_blocks(result, exe, main_p, feed, [loss])
     if platform == "tpu":
         result["mfu_pct"] = round(
             100.0 * 3 * 4.1e9 * imgs_per_sec / TPU_PEAK_BF16_FLOPS, 2)

@@ -30,6 +30,7 @@ from .fluid import regularizer  # noqa: F401
 from .fluid import metrics  # noqa: F401
 
 from . import distributed  # noqa: F401
+from . import observability  # noqa: F401
 from . import framework  # noqa: F401
 from . import imperative  # noqa: F401
 from . import metric  # noqa: F401

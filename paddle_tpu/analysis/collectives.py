@@ -44,6 +44,43 @@ IR_COLLECTIVE_OPS = frozenset({
 
 _LOOP_OPS = ("while", "scan")
 
+#: host-TIER collective ops: their communicator is a HostCollectiveGroup
+#: (rank set over TCP), not an ICI ring — membership lives in op attrs,
+#: not in ring_id
+HOST_TIER_OPS = frozenset({"barrier"})
+
+
+def group_membership(op):
+    """Communicator-membership signature of one collective op, beyond
+    `ring_id`: two ranks can agree on every ring_id and still deadlock
+    when the GROUPS behind the id differ — a host-tier barrier whose
+    `HostCollectiveGroup` spans 2 ranks on one rank and 3 on another
+    waits forever on the phantom member. Reads the attrs the host tier
+    and transpilers stamp (`group_world`/`group_ranks`/`endpoints` for
+    HostCollectiveGroup membership, `nranks` for sized device
+    collectives); None when the op carries no membership info (the
+    pre-existing ring_id-only comparison still applies)."""
+    attrs = op.attrs
+    world = attrs.get("group_world")
+    ranks = attrs.get("group_ranks")
+    endpoints = attrs.get("endpoints")
+    nranks = attrs.get("nranks")
+    if world is None and ranks is None and endpoints is None \
+            and nranks is None:
+        return None
+    sig = []
+    if world is not None:
+        sig.append(("world", int(world)))
+    if ranks is not None:
+        sig.append(("ranks", tuple(int(r) for r in ranks)))
+    if endpoints is not None:
+        eps = (endpoints.split(",") if isinstance(endpoints, str)
+               else list(endpoints))
+        sig.append(("endpoints", tuple(str(e) for e in eps)))
+    if nranks is not None:
+        sig.append(("nranks", int(nranks)))
+    return tuple(sig)
+
 
 def _first_payload(op, block):
     """(dtype, shape) of the op's first input var (the collective
@@ -64,6 +101,10 @@ def _record(op, block, block_idx, op_idx, path, region):
         "dtype": dtype,
         "shape": shape,
         "ring_id": op.attrs.get("ring_id", 0),
+        # communicator membership (HostCollectiveGroup rank set /
+        # nranks) — ring_id alone cannot distinguish two differently
+        # sized groups behind the same id
+        "group": group_membership(op),
         "var": (op.input_arg_names or [None])[0],
         "block_idx": block_idx,
         "op_idx": op_idx,
@@ -77,7 +118,7 @@ def _record(op, block, block_idx, op_idx, path, region):
 
 def _schedule_key(rec):
     return (rec["kind"], rec["dtype"], rec["shape"], rec["ring_id"],
-            rec["region"])
+            rec["group"], rec["region"])
 
 
 def collective_schedule(program, block=None, _path="", _region=""):
@@ -147,9 +188,7 @@ def _branch_schedules(program, op):
         # repeats per iteration, so it must NOT compare equal to a
         # bare one in the other branch. Loop trip counts themselves
         # stay unmodeled — nesting inequality is the conservative cut.
-        out.append((tag, [(_r["kind"], _r["dtype"], _r["shape"],
-                           _r["ring_id"], _r["region"])
-                          for _r in recs]))
+        out.append((tag, [_schedule_key(_r) for _r in recs]))
     return out
 
 

@@ -94,12 +94,29 @@ class FaultInjector:
             or (self.at is not None and n == self.at)
         if not hit:
             return
+        self._telemetry_event(side, point, method, n)
         if self.kind == "delay":
             import time
 
             time.sleep(self.delay_ms / 1000.0)
             return
         if self.kind == "kill":
+            # a preempted worker leaves a postmortem: dump the flight
+            # recorder (last N steps + events, the fatal event on top)
+            # before the hard exit — same evidence a real OOM-kill's
+            # SIGTERM grace window would leave
+            try:
+                from ..observability import flight
+
+                # the fault event itself is already in the ring via
+                # _telemetry_event above; dump names it fatal
+                flight.dump("fault-kill", fatal_event={
+                    "kind": "event", "event": "fault",
+                    "fault": "kill", "side": side or "",
+                    "point": point or "", "method": method or "",
+                    "exit_code": self.exit_code, "n": n})
+            except Exception:  # noqa: BLE001 - the kill must proceed
+                pass
             os._exit(self.exit_code)
         # drop: close our end so the peer observes the drop too, then
         # raise into the caller's socket op
@@ -109,6 +126,19 @@ class FaultInjector:
         raise FaultError(
             "fault-injected connection drop (%s/%s event #%d)"
             % (side, point, n))
+
+    def _telemetry_event(self, side, point, method, n):
+        """Every FIRED fault lands in the telemetry stream (drop/delay
+        too — a run whose losses wobble under injected drops should
+        show WHEN the drops fired)."""
+        try:
+            from ..observability.registry import registry
+
+            registry().event("fault", fault=self.kind,
+                             side=side or "", point=point or "",
+                             method=method or "", n=n)
+        except Exception:  # noqa: BLE001 - injection must still fire
+            pass
 
     def __repr__(self):
         trig = ("every=%d" % self.every if self.every is not None

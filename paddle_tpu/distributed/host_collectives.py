@@ -282,29 +282,44 @@ class HostCollectiveGroup:
         return "%s#%d" % (tag, self._seq)
 
     @contextlib.contextmanager
-    def _comm_phase(self):
+    def _comm_phase(self, op=None, key=None):
         """Account host-collective wall time to the profiler's `comm`
         step phase (the executor keeps `host` disjoint from it), so a
         step blocked on cross-rank coordination shows as comm, not as
-        anonymous host time."""
+        anonymous host time. A completed collective also lands a
+        telemetry "collective" event carrying its cross-rank `key`
+        (ranks issue collectives in lockstep, so key N completes at
+        ~the same wall instant everywhere — tools/timeline.py uses
+        these as clock-sync anchors when merging per-rank JSONL)."""
         from ..fluid import profiler as _prof
 
         t0 = time.perf_counter()
+        ok = False
         try:
             yield
+            ok = True
         finally:
-            _prof.record_step_phase("comm", time.perf_counter() - t0, t0)
+            dt = time.perf_counter() - t0
+            _prof.record_step_phase("comm", dt, t0)
+            if ok and op is not None:
+                try:
+                    from ..observability.registry import registry
+
+                    registry().event("collective", op=op, key=key,
+                                     dur_ms=round(dt * 1e3, 4))
+                except Exception:  # noqa: BLE001 - telemetry only
+                    pass
 
     def barrier(self):
         key = self._key("barrier")
-        with self._comm_phase():
+        with self._comm_phase("barrier", key):
             self._client.call("hc_put_part", key, self.rank,
                               np.zeros((1,), np.int8))
             self._client.call("hc_gather", key, self.rank)
 
     def all_reduce(self, array, op="sum"):
         key = self._key("allreduce")
-        with self._comm_phase():
+        with self._comm_phase("allreduce", key):
             self._client.call("hc_put_part", key, self.rank,
                               np.ascontiguousarray(array))
             parts = self._client.call("hc_gather", key, self.rank)
@@ -321,7 +336,7 @@ class HostCollectiveGroup:
 
     def all_gather(self, array) -> List[np.ndarray]:
         key = self._key("allgather")
-        with self._comm_phase():
+        with self._comm_phase("allgather", key):
             self._client.call("hc_put_part", key, self.rank,
                               np.ascontiguousarray(array))
             parts = self._client.call("hc_gather", key, self.rank)

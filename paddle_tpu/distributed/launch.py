@@ -67,7 +67,8 @@ def _parse_args(argv):
     return p.parse_args(argv)
 
 
-def _worker_env(endpoints, tid, restart_no, base_env=None):
+def _worker_env(endpoints, tid, restart_no, base_env=None,
+                telemetry_dir=None):
     """The PADDLE_* contract for one supervised worker. Cross-rank
     checkpoint-step agreement (PADDLE_CKPT_AGREE, see
     distributed/sharded_checkpoint.agree_newest_intact) is ON by
@@ -75,9 +76,16 @@ def _worker_env(endpoints, tid, restart_no, base_env=None):
     one rank's corrupt newest shard silently diverge the replicas; the
     protocol is fault-injection tested and a no-op for single-worker
     cohorts (group_from_env returns None at world size 1). An explicit
-    PADDLE_CKPT_AGREE=0 in the launcher's environment is respected."""
+    PADDLE_CKPT_AGREE=0 in the launcher's environment is respected.
+
+    `telemetry_dir` (derived from --log_dir unless the launcher's own
+    env already sets FLAGS_tpu_telemetry_dir) turns on each worker's
+    observability sink + flight recorder, so a failed cohort leaves
+    per-rank postmortems the supervisor can collect."""
     env = dict(os.environ if base_env is None else base_env)
     env.setdefault("PADDLE_CKPT_AGREE", "1")
+    if telemetry_dir:
+        env.setdefault("FLAGS_tpu_telemetry_dir", telemetry_dir)
     env.update({
         "PADDLE_TRAINER_ID": str(tid),
         "PADDLE_CURRENT_ENDPOINT": endpoints[tid],
@@ -88,10 +96,67 @@ def _worker_env(endpoints, tid, restart_no, base_env=None):
     return env
 
 
+def _telemetry_dir_for(args):
+    """Where the workers' observability sink + flight dumps live: an
+    explicit FLAGS_tpu_telemetry_dir in the launcher env wins;
+    otherwise <log_dir>/telemetry; None without either (workers then
+    run with telemetry off, dumps land in their CWD on a fault kill)."""
+    explicit = os.environ.get("FLAGS_tpu_telemetry_dir")
+    if explicit:
+        return explicit
+    if args.log_dir:
+        return os.path.join(args.log_dir, "telemetry")
+    return None
+
+
+def _collect_flight_dumps(args, attempt):
+    """Before a cohort restart (and after a final failure), move every
+    per-rank flight-recorder dump AND telemetry JSONL stream into
+    <log_dir>/postmortem/attempt<K>/ — the restart's fresh workers
+    overwrite flightrec.rank<R>.json and would otherwise APPEND
+    attempt K+1's step records (with a reset step counter) into
+    attempt K's telemetry.rank<R>.jsonl, silently mixing two training
+    attempts in one stream. The next attempt starts with a clean dir;
+    run tools/perf_analysis.py --stragglers against the postmortem
+    subdir to analyze a failed attempt."""
+    import shutil
+
+    tdir = _telemetry_dir_for(args)
+    if not tdir or not os.path.isdir(tdir):
+        return []
+    dest_root = args.log_dir or tdir
+    dest = os.path.join(dest_root, "postmortem", "attempt%d" % attempt)
+    collected = []
+    for fname in sorted(os.listdir(tdir)):
+        is_dump = fname.startswith("flightrec.rank") and \
+            fname.endswith(".json")
+        is_jsonl = fname.startswith("telemetry.rank") and \
+            fname.endswith(".jsonl")
+        if not (is_dump or is_jsonl):
+            continue
+        os.makedirs(dest, exist_ok=True)
+        try:
+            shutil.move(os.path.join(tdir, fname),
+                        os.path.join(dest, fname))
+            if is_dump:
+                collected.append(os.path.join(dest, fname))
+        except OSError:
+            pass
+    if collected:
+        sys.stderr.write(
+            "paddle_tpu.launch: collected %d flight-recorder dump(s) "
+            "into %s\n" % (len(collected), dest))
+    return collected
+
+
 def _spawn_cohort(args, endpoints, local_ids, restart_no):
     procs, logs = [], []
+    tdir = _telemetry_dir_for(args)
+    if tdir:
+        os.makedirs(tdir, exist_ok=True)
     for tid in local_ids:
-        env = _worker_env(endpoints, tid, restart_no)
+        env = _worker_env(endpoints, tid, restart_no,
+                          telemetry_dir=tdir)
         cmd = [sys.executable, "-u", args.training_script] \
             + args.training_script_args
         out = None
@@ -198,6 +263,10 @@ def launch(argv=None):
                     f.close()
         if rc == 0 or stop_sig["sig"] is not None:
             break
+        # secure this attempt's per-rank flight-recorder dumps before
+        # the restarted cohort overwrites them (and keep the final
+        # failed attempt's evidence too when restarts are exhausted)
+        _collect_flight_dumps(args, attempt)
         if attempt < max(args.max_restarts, 0):
             sys.stderr.write(
                 "paddle_tpu.launch: cohort failed (rc=%d); restart "

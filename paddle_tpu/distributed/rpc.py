@@ -61,6 +61,18 @@ _U64 = struct.Struct("<Q")
 _ENVELOPE = "__rq1__"
 
 
+def _telemetry():
+    """The observability registry, or None very early in interpreter
+    life (the RPC layer must work before — and after — everything
+    else)."""
+    try:
+        from ..observability.registry import registry
+
+        return registry()
+    except Exception:  # noqa: BLE001 - telemetry never gates RPC
+        return None
+
+
 def _enc_field(buf: bytearray, v):
     if isinstance(v, str):
         b = v.encode("utf-8")
@@ -287,6 +299,9 @@ class RpcServer:
                 # duplicate of the in-flight/completed newest request:
                 # wait for the original handler invocation, answer from
                 # its cached response — NEVER re-invoke the handler
+                reg = _telemetry()
+                if reg is not None:
+                    reg.inc("rpc.dedup_hit")
                 while (ent["seq"] == seq and ent["resp"] is None
                        and not self._closed):
                     ent["cv"].wait(timeout=0.5)
@@ -488,11 +503,22 @@ class RpcClient:
             except (ConnectionError, OSError) as e:
                 self._drop_sock()
                 attempt += 1
+                reg = _telemetry()
                 if attempt > self._call_retries:
+                    if reg is not None:
+                        reg.inc("rpc.giveup")
+                        reg.event("rpc_giveup", method=method,
+                                  endpoint=self._endpoint,
+                                  attempts=attempt,
+                                  error=str(e)[:200])
                     raise ConnectionError(
                         "rpc %s to %s failed after %d retries: %s"
                         % (method, self._endpoint, self._call_retries,
                            e)) from e
+                if reg is not None:
+                    reg.inc("rpc.retry")
+                    reg.event("rpc_retry", method=method,
+                              endpoint=self._endpoint, attempt=attempt)
                 time.sleep(min(self._backoff_s * (2 ** (attempt - 1)),
                                self._backoff_max_s))
 
@@ -503,6 +529,9 @@ class RpcClient:
         next request. Best-effort and cheap (one tiny round trip on the
         live socket, no retry): if it's lost, the next real request
         frees the blob anyway."""
+        reg = _telemetry()
+        if reg is not None:
+            reg.inc("rpc.ack")
         with self._lock:
             acked = self._seq
             self._seq += 1

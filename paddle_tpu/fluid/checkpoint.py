@@ -173,6 +173,14 @@ def publish_checkpoint_dir(root, write_fn, train_status, checkpoint_num):
     os.replace(tmp, real)
     if checkpoint_num:
         clean_redundant_checkpoints(root, checkpoint_num)
+    try:
+        from ..observability.registry import registry
+
+        registry().event("checkpoint", action="save", path=real,
+                         step_no=int(getattr(train_status, "step_no",
+                                             -1) or -1))
+    except Exception:  # noqa: BLE001 - telemetry only
+        pass
     return real
 
 
@@ -306,6 +314,14 @@ def _load_one_checkpoint(real, names, scope):
         status = TrainStatus._from_dict(json.load(f))
     for nm in names:
         scope.set_var(nm, jnp.asarray(d[nm]))
+    try:
+        from ..observability.registry import registry
+
+        registry().event("checkpoint", action="restore", path=real,
+                         step_no=int(getattr(status, "step_no", -1)
+                                     or -1))
+    except Exception:  # noqa: BLE001 - telemetry only
+        pass
     return status
 
 

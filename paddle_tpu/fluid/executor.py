@@ -115,9 +115,25 @@ class Executor:
                 # barriers, cross-rank agreement) already counted
                 # itself into the comm phase — keep host disjoint
                 comm_dt = _prof.step_phase_total("comm") - comm0
-                _prof.record_step_phase(
-                    "host",
-                    max(0.0, total - sum(ph.values()) - comm_dt))
+                host_dt = max(0.0, total - sum(ph.values()) - comm_dt)
+                _prof.record_step_phase("host", host_dt)
+                # one per-step telemetry record (observability registry:
+                # JSONL sink + flight-recorder ring + capture poll);
+                # a few dict ops when telemetry is idle
+                from .. import observability as _obs
+
+                _obs.on_executor_step({
+                    "feed_ms": ph["feed"] * 1e3,
+                    "dispatch_ms": ph["dispatch"] * 1e3,
+                    "comm_ms": comm_dt * 1e3,
+                    "sync_ms": ph["sync"] * 1e3,
+                    "host_ms": host_dt * 1e3,
+                    "compile_ms": ph["compile"] * 1e3,
+                    "total_ms": total * 1e3,
+                    # epoch-domain step START (t_step is perf_counter
+                    # time — unusable next to the event records' epoch
+                    # ts in the same JSONL stream)
+                }, ts=_time.time() - total)
 
     def _run_impl(self, program, feed, fetch_list, scope, return_numpy,
                   use_program_cache, ph):

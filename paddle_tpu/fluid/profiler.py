@@ -10,8 +10,18 @@ from __future__ import annotations
 
 import contextlib
 import os
+import threading
 import time
 from collections import defaultdict
+
+# ONE lock for every counter table below: the counters are mutated from
+# the main step loop AND background threads (the device prefetcher's
+# producer, host-collective heartbeat/RPC handler threads, hapi's
+# deferred-sync path) — the unlocked read-modify-write on the
+# defaultdict's [count, total, max] lists lost updates under
+# concurrency. Accumulation is a few arithmetic ops; one uncontended
+# lock acquisition per event is noise next to a dispatched step.
+_lock = threading.Lock()
 
 _host_events = defaultdict(lambda: [0, 0.0, 0.0])  # name -> [count, total_s, max_s]
 
@@ -44,11 +54,14 @@ _step_phases = defaultdict(lambda: [0, 0.0, 0.0])  # -> [count, total_s, max_s]
 
 def record_step_phase(name, dt, t0=None):
     """Accumulate `dt` seconds into step-phase counter `name`; also
-    emits a chrome-trace event ("phase/<name>") when tracing is live."""
-    ev = _step_phases[name]
-    ev[0] += 1
-    ev[1] += dt
-    ev[2] = max(ev[2], dt)
+    emits a chrome-trace event ("phase/<name>") when tracing is live.
+    Thread-safe: callers include the prefetcher's producer thread and
+    RPC handler threads, concurrent with the main step loop."""
+    with _lock:
+        ev = _step_phases[name]
+        ev[0] += 1
+        ev[1] += dt
+        ev[2] = max(ev[2], dt)
     record_step_trace(name, t0, dt)
 
 
@@ -58,21 +71,22 @@ def record_step_trace(name, t0, dt):
     trace shows phase/<name> spans where they actually happened; the
     per-step counter aggregation rides separately in run()'s finally."""
     if _trace_enabled and t0 is not None:
-        import threading
-
-        _trace_events.append(("phase/" + name, t0 * 1e6, dt * 1e6,
-                              threading.get_ident() % 100000))
+        with _lock:
+            _trace_events.append(("phase/" + name, t0 * 1e6, dt * 1e6,
+                                  threading.get_ident() % 100000))
 
 
 def step_phase_total(name):
     """Accumulated seconds in one phase counter (0.0 when unseen) —
     the executor snapshots `comm` around each step so host time stays
     disjoint from collective time recorded by host_collectives."""
-    return _step_phases[name][1] if name in _step_phases else 0.0
+    with _lock:
+        return _step_phases[name][1] if name in _step_phases else 0.0
 
 
 def reset_step_phases():
-    _step_phases.clear()
+    with _lock:
+        _step_phases.clear()
 
 
 def step_phase_summary(reset=False):
@@ -80,24 +94,25 @@ def step_phase_summary(reset=False):
     "total_ms": sum of avgs}. `steps` = number of dispatches; phase
     averages are totals over that denominator, so rarely-firing phases
     (a deferred sync every log_freq steps) amortize correctly."""
-    steps = _step_phases["dispatch"][0] if "dispatch" in _step_phases \
-        else 0
-    denom = max(steps, 1)
-    out = {"steps": steps}
-    total = 0.0
-    for name in STEP_PHASES:
-        avg_ms = _step_phases[name][1] * 1e3 / denom \
-            if name in _step_phases else 0.0
-        out[name + "_ms"] = round(avg_ms, 3)
-        total += avg_ms
-    out["total_ms"] = round(total, 3)
-    if "compile" in _step_phases:
-        # cache-miss compiles ride outside the steady-state total so
-        # they never pollute host_ms, but the summary still shows them
-        out["compile_ms"] = round(
-            _step_phases["compile"][1] * 1e3 / denom, 3)
-    if reset:
-        reset_step_phases()
+    with _lock:
+        steps = _step_phases["dispatch"][0] if "dispatch" in _step_phases \
+            else 0
+        denom = max(steps, 1)
+        out = {"steps": steps}
+        total = 0.0
+        for name in STEP_PHASES:
+            avg_ms = _step_phases[name][1] * 1e3 / denom \
+                if name in _step_phases else 0.0
+            out[name + "_ms"] = round(avg_ms, 3)
+            total += avg_ms
+        out["total_ms"] = round(total, 3)
+        if "compile" in _step_phases:
+            # cache-miss compiles ride outside the steady-state total so
+            # they never pollute host_ms, but the summary still shows them
+            out["compile_ms"] = round(
+                _step_phases["compile"][1] * 1e3 / denom, 3)
+        if reset:
+            _step_phases.clear()
     return out
 
 
@@ -114,7 +129,8 @@ def step_phase_line():
 def event_count(name):
     """Host-event fire count (RecordEvent name) — lets tests assert sync
     cadence (e.g. hapi's deferred-fetch 'hapi/loss_sync')."""
-    return _host_events[name][0] if name in _host_events else 0
+    with _lock:
+        return _host_events[name][0] if name in _host_events else 0
 
 
 _native_broken = False
@@ -161,13 +177,12 @@ class RecordEvent:
 
     def __exit__(self, *a):
         dt = time.perf_counter() - self._t0
-        ev = _host_events[self.name]
-        ev[0] += 1
-        ev[1] += dt
-        ev[2] = max(ev[2], dt)
+        with _lock:
+            ev = _host_events[self.name]
+            ev[0] += 1
+            ev[1] += dt
+            ev[2] = max(ev[2], dt)
         if _trace_enabled:
-            import threading
-
             tid = threading.get_ident() % 100000
             nt = _native_trace()
             if nt is not None:
@@ -176,8 +191,9 @@ class RecordEvent:
                 nt.record(self._nid, tid, int(self._t0 * 1e6),
                           int(dt * 1e6))
             else:
-                _trace_events.append((self.name, self._t0 * 1e6,
-                                      dt * 1e6, tid))
+                with _lock:
+                    _trace_events.append((self.name, self._t0 * 1e6,
+                                          dt * 1e6, tid))
         if self._ann is not None:
             self._ann.__exit__(*a)
 
@@ -232,9 +248,10 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
 
 
 def reset_profiler():
-    _host_events.clear()
-    _step_phases.clear()
-    del _trace_events[:]
+    with _lock:
+        _host_events.clear()
+        _step_phases.clear()
+        del _trace_events[:]
     nt = _native_trace()
     if nt is not None:
         nt.reset()
@@ -253,9 +270,11 @@ def export_chrome_tracing(path):
         if nt.export(path) == 0:
             return path
         raise OSError("chrome-trace export failed to open %r" % path)
+    with _lock:
+        trace_events = list(_trace_events)
     events = [{"name": name, "ph": "X", "pid": 0, "tid": tid,
                "ts": ts, "dur": dur, "cat": "host"}
-              for name, ts, dur, tid in _trace_events]
+              for name, ts, dur, tid in trace_events]
     data = {"traceEvents": events, "displayTimeUnit": "ms"}
     with open(path, "w") as f:
         json.dump(data, f)
@@ -264,8 +283,10 @@ def export_chrome_tracing(path):
 
 def profiler_summary_rows():
     """Per-event (name, calls, total_ms, avg_ms, max_ms) rows."""
+    with _lock:
+        host_events = {k: list(v) for k, v in _host_events.items()}
     rows = []
-    for name, (cnt, total, mx) in sorted(_host_events.items(),
+    for name, (cnt, total, mx) in sorted(host_events.items(),
                                          key=lambda kv: -kv[1][1]):
         rows.append((name, cnt, total * 1e3, total * 1e3 / max(cnt, 1),
                      mx * 1e3))

@@ -275,9 +275,24 @@ class Model:
                             m.compute(outputs[0], *labels)))
                 losses = [float(np.asarray(
                     loss.numpy()).reshape(-1)[0])]
-            profiler.record_step_phase(
-                "sync", _time.perf_counter() - t0, t0)
+            dt = _time.perf_counter() - t0
+            profiler.record_step_phase("sync", dt, t0)
+            self._telemetry_sync_event("train", len(pending), dt)
         return losses
+
+    @staticmethod
+    def _telemetry_sync_event(mode, n_steps, dt):
+        """Deferred-fetch sync cadence into the telemetry stream: how
+        many device-resident steps each hapi host sync drained, and
+        what it cost — the log_freq-vs-sync tradeoff becomes visible
+        in the per-rank JSONL instead of only in profiler counters."""
+        try:
+            from ..observability.registry import registry
+
+            registry().event("hapi_sync", mode=mode, n_steps=n_steps,
+                             dur_ms=round(dt * 1e3, 4))
+        except Exception:  # noqa: BLE001 - telemetry never gates a sync
+            pass
 
     def train_batch(self, inputs, labels=None):
         loss, outputs, labels = self._train_batch_device(inputs, labels)
@@ -332,8 +347,9 @@ class Model:
                 if loss is not None:
                     losses.append(float(np.asarray(
                         loss.numpy()).reshape(-1)[0]))
-            profiler.record_step_phase(
-                "sync", _time.perf_counter() - t0, t0)
+            dt = _time.perf_counter() - t0
+            profiler.record_step_phase("sync", dt, t0)
+            self._telemetry_sync_event("eval", len(pending), dt)
         return losses
 
     def _test_batch_device(self, inputs):

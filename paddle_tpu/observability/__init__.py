@@ -1,0 +1,72 @@
+"""Unified telemetry for the TPU runtime (see README.md here).
+
+One registry (`registry()`) that every perf/fault surface publishes
+into — step phases, RPC retry/dedup counters, host-collective
+completions, fault injection, checkpoint save/restore, the AMP
+loss-scale state machine — with:
+
+- a per-step JSONL timeseries sink (`FLAGS_tpu_telemetry_dir`, atomic
+  rotation) whose record shapes are locked by
+  tools/telemetry_schema.json;
+- cross-rank window aggregation + straggler naming over the existing
+  host-collective tier (aggregate.py; bench "telemetry" block,
+  tools/perf_analysis.py --stragglers);
+- a black-box flight recorder dumped atomically on crash / SIGTERM /
+  `PADDLE_FAULTS` kill, collected per-rank by the launch supervisor
+  before a --max_restarts cohort restart (flight.py);
+- an on-demand jax.profiler capture hook — trigger file or SIGUSR2 —
+  for pulling xplane traces out of a LIVE run (capture.py).
+
+bench.py's evidence blocks (phases / collectives / overlap / precision
+/ static_checks / telemetry) are assembled from this registry by
+publish.bench_blocks — one assembly point instead of per-block ad-hoc
+code.
+"""
+from __future__ import annotations
+
+from .registry import (MetricsRegistry, registry,  # noqa: F401
+                       reset_registry, configure)
+from .flight import (FlightRecorder, recorder as flight_recorder,  # noqa: F401,E501
+                     dump as dump_flight_recorder,
+                     install as install_flight_recorder)
+from .capture import (CaptureController,  # noqa: F401
+                      controller as capture_controller,
+                      install as install_capture)
+from .aggregate import (window_summary, allgather_window,  # noqa: F401
+                        aggregate_summaries, straggler_report,
+                        load_telemetry_dir)
+from .schema import (load_schema, validate_record,  # noqa: F401
+                     validate_records)
+from . import publish  # noqa: F401
+
+__all__ = [
+    "MetricsRegistry", "registry", "reset_registry", "configure",
+    "FlightRecorder", "flight_recorder", "dump_flight_recorder",
+    "install_flight_recorder",
+    "CaptureController", "capture_controller", "install_capture",
+    "window_summary", "allgather_window", "aggregate_summaries",
+    "straggler_report", "load_telemetry_dir",
+    "load_schema", "validate_record", "validate_records",
+    "on_executor_step",
+]
+
+_armed = False
+
+
+def on_executor_step(phases_ms: dict, ts=None) -> None:
+    """Executor step epilogue (fluid/executor.py run()'s finally):
+    record the step, arm the crash/capture hooks once a telemetry dir
+    is configured, and poll the capture trigger. Never raises — a
+    telemetry failure must not take down the step loop."""
+    global _armed
+    try:
+        reg = registry()
+        reg.record_step(phases_ms, ts=ts)
+        if reg.telemetry_dir and not _armed:
+            _armed = True
+            install_flight_recorder()
+            install_capture()
+        if reg.telemetry_dir:
+            capture_controller().poll()
+    except Exception:  # noqa: BLE001 - telemetry must never kill a step
+        pass

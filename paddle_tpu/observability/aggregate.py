@@ -1,0 +1,205 @@
+"""Cross-rank telemetry aggregation + straggler detection.
+
+Finding the real bottleneck at pod scale is a STRAGGLER problem, not a
+single-rank profiling problem (Kumar et al. 1909.09756; Wang et al.
+2011.03641): one slow host drags every collective, and per-rank reports
+in N separate logs never say which one. This module gives the two
+views:
+
+- **online** (opt-in, end-of-window): each rank summarizes its step
+  records (`window_summary`) and the ranks exchange summaries over the
+  existing host-collective tier (`allgather_window` — JSON bytes over
+  `HostCollectiveGroup.all_gather`, no new protocol), producing
+  min/mean/max/p99 per phase and a straggler report that NAMES the
+  slowest rank (`aggregate_summaries`). Surfaced in bench.py's
+  `telemetry` block.
+- **offline**: `load_telemetry_dir` reads the per-rank JSONL files the
+  registry sink wrote and `straggler_report` aligns step records
+  across ranks — `tools/perf_analysis.py --stragglers`.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .registry import STEP_FIELDS
+
+__all__ = ["window_summary", "allgather_window", "aggregate_summaries",
+           "straggler_report", "load_telemetry_dir"]
+
+_PHASES = tuple(f for f in STEP_FIELDS if f != "compile_ms")
+
+
+def _percentile(vals: List[float], q: float) -> Optional[float]:
+    if not vals:
+        return None
+    vals = sorted(vals)
+    idx = min(len(vals) - 1, max(0, int(round(q * (len(vals) - 1)))))
+    return vals[idx]
+
+
+def window_summary(reg=None, records: Optional[List[dict]] = None,
+                   drain: bool = True) -> dict:
+    """One rank's end-of-window summary of its step records: per-phase
+    mean/max + step-total p99 — the fixed-size payload of the
+    cross-rank exchange. `records` overrides the registry window
+    (offline use)."""
+    if records is None:
+        from .registry import registry
+
+        reg = reg or registry()
+        records = reg.drain_window() if drain else reg.peek_window()
+        rank = reg.rank
+    else:
+        rank = records[0]["rank"] if records else 0
+    out = {"rank": rank, "steps": len(records)}
+    for f in _PHASES:
+        vals = [r[f] for r in records if f in r]
+        out[f + "_mean"] = (round(sum(vals) / len(vals), 4)
+                            if vals else 0.0)
+        out[f + "_max"] = round(max(vals), 4) if vals else 0.0
+    totals = [r.get("total_ms", 0.0) for r in records]
+    out["total_ms_p99"] = round(_percentile(totals, 0.99) or 0.0, 4)
+    return out
+
+
+def allgather_window(group, summary: Optional[dict] = None) -> List[dict]:
+    """Exchange per-rank window summaries over the host-collective tier
+    (one allgather of JSON bytes); returns every rank's summary. The
+    group is the same `HostCollectiveGroup` PS barriers and checkpoint
+    agreement already ride."""
+    if summary is None:
+        summary = window_summary()
+    blob = np.frombuffer(
+        json.dumps(summary, sort_keys=True).encode("utf-8"), np.uint8)
+    parts = group.all_gather(blob)
+    return [json.loads(bytes(bytearray(np.asarray(p))).decode("utf-8"))
+            for p in parts]
+
+
+def aggregate_summaries(summaries: List[dict]) -> dict:
+    """Cross-rank view over per-rank window summaries: per-phase
+    min/mean/max/p99 of the rank MEANS, plus the straggler verdict —
+    the slowest rank by mean step total and its slack vs the fastest.
+    p99 over rank means is the cross-RANK tail (meaningful at pod
+    scale; with 2 ranks it equals the max)."""
+    if not summaries:
+        return {"ranks": 0, "per_phase": {}, "straggler": None}
+    per_phase = {}
+    for f in _PHASES:
+        means = [float(s.get(f + "_mean", 0.0)) for s in summaries]
+        per_phase[f] = {
+            "min": round(min(means), 4),
+            "mean": round(sum(means) / len(means), 4),
+            "max": round(max(means), 4),
+            "p99": round(_percentile(means, 0.99), 4),
+        }
+    totals = {int(s["rank"]): float(s.get("total_ms_mean", 0.0))
+              for s in summaries}
+    slow_rank = max(totals, key=lambda r: totals[r])
+    fast_rank = min(totals, key=lambda r: totals[r])
+    # which phase explains the slack: largest mean delta slow vs fast
+    slow = next(s for s in summaries if int(s["rank"]) == slow_rank)
+    fast = next(s for s in summaries if int(s["rank"]) == fast_rank)
+    blame, blame_ms = None, 0.0
+    for f in _PHASES:
+        if f == "total_ms":
+            continue
+        d = float(slow.get(f + "_mean", 0.0)) \
+            - float(fast.get(f + "_mean", 0.0))
+        if d > blame_ms:
+            blame, blame_ms = f, d
+    return {
+        "ranks": len(summaries),
+        "steps": int(summaries[0].get("steps", 0)),
+        "per_phase": per_phase,
+        "straggler": {
+            "rank": slow_rank,
+            "total_ms_mean": round(totals[slow_rank], 4),
+            "fastest_rank": fast_rank,
+            "fastest_total_ms_mean": round(totals[fast_rank], 4),
+            "slack_ms": round(totals[slow_rank] - totals[fast_rank], 4),
+            "blame_phase": blame,
+            "blame_ms": round(blame_ms, 4),
+        },
+    }
+
+
+# -- offline: per-rank JSONL files --------------------------------------
+
+_RANK_FILE = re.compile(
+    r"^telemetry\.rank(\d+)(?:\.g\d+)?\.jsonl$")
+
+
+def load_telemetry_dir(directory: str) -> Dict[int, List[dict]]:
+    """{rank: [records]} from a telemetry dir (active + rotated
+    generations, records in file order; generations sort before the
+    active file because rotation renames, so re-sort by ts)."""
+    by_rank: Dict[int, List[dict]] = {}
+    for fname in sorted(os.listdir(directory)):
+        m = _RANK_FILE.match(fname)
+        if not m:
+            continue
+        rank = int(m.group(1))
+        with open(os.path.join(directory, fname)) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    by_rank.setdefault(rank, []).append(json.loads(line))
+                except ValueError:
+                    continue  # torn final line of a killed writer
+    for recs in by_rank.values():
+        recs.sort(key=lambda r: r.get("ts", 0.0))
+    return by_rank
+
+
+def straggler_report(by_rank: Dict[int, List[dict]],
+                     window: int = 32) -> dict:
+    """Offline straggler analysis over per-rank step records: align
+    records by step number, find the slowest rank per `window`-step
+    window, and name the overall offender (most windows lost). Ranks
+    whose record sets are ragged (a dead rank's tail) align on the
+    common prefix."""
+    steps_by_rank = {
+        r: {int(rec["step"]): rec for rec in recs
+            if rec.get("kind") == "step"}
+        for r, recs in by_rank.items()}
+    steps_by_rank = {r: d for r, d in steps_by_rank.items() if d}
+    if len(steps_by_rank) < 2:
+        return {"ranks": len(steps_by_rank), "windows": [],
+                "by_rank": {}, "straggler": None}
+    common = set.intersection(
+        *[set(d) for d in steps_by_rank.values()])
+    windows = []
+    lost: Dict[int, int] = {r: 0 for r in steps_by_rank}
+    ordered = sorted(common)
+    for w0 in range(0, len(ordered), window):
+        chunk = ordered[w0:w0 + window]
+        per_rank = {
+            r: sum(d[s].get("total_ms", 0.0) for s in chunk) / len(chunk)
+            for r, d in steps_by_rank.items()}
+        slow = max(per_rank, key=lambda r: per_rank[r])
+        fast = min(per_rank, key=lambda r: per_rank[r])
+        lost[slow] += 1
+        windows.append({
+            "steps": [chunk[0], chunk[-1]],
+            "slowest_rank": slow,
+            "slowest_total_ms_mean": round(per_rank[slow], 4),
+            "fastest_rank": fast,
+            "slack_ms": round(per_rank[slow] - per_rank[fast], 4),
+        })
+    offender = max(lost, key=lambda r: lost[r]) if windows else None
+    return {
+        "ranks": len(steps_by_rank),
+        "common_steps": len(common),
+        "window": window,
+        "windows": windows,
+        "by_rank": {r: n for r, n in sorted(lost.items())},
+        "straggler": offender,
+    }

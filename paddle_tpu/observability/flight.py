@@ -1,0 +1,238 @@
+"""Black-box flight recorder: a bounded in-memory ring of the last N
+step records plus RPC / collective / fault / checkpoint events, dumped
+ATOMICALLY when the process dies abnormally.
+
+Why: when PR 1's launch supervisor restarts a cohort after a preempted
+or fault-killed rank, the dead rank's last seconds are otherwise gone —
+the workerlog shows where stdout stopped, not what the step loop was
+doing. The recorder is always armed (the registry fans every record
+into it; a deque append is noise), so the dump costs nothing until the
+moment it is the only evidence left.
+
+Dump triggers:
+  - unhandled exception   (sys.excepthook chain — original hook still
+    runs, so tracebacks print exactly as before)
+  - SIGTERM               (handler chains to any previous handler;
+    default behavior — process death — is preserved via re-raise)
+  - `PADDLE_FAULTS` kill  (distributed/faults.py calls `on_fatal`
+    right before its os._exit — an injected preemption leaves the same
+    postmortem a real one would)
+  - explicit `dump(reason)`
+
+The dump (`flightrec.rank<R>.json` in the telemetry dir, else CWD) is
+written tmp-then-os.replace, so the launch supervisor's collector never
+reads a torn file. The supervisor copies per-rank dumps into
+`<log_dir>/postmortem/attempt<K>/` before a --max_restarts cohort
+restart (distributed/launch.py).
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Optional
+
+__all__ = ["FlightRecorder", "recorder", "configure", "install",
+           "dump", "on_fatal"]
+
+
+class FlightRecorder:
+    """Bounded ring of step records + events. `capacity` bounds step
+    records; events keep 4x that (they are smaller and chattier)."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        if capacity is None:
+            from ..utils.flags import get_flag
+
+            capacity = int(
+                get_flag("FLAGS_tpu_flight_recorder_steps", 64) or 64)
+        self.capacity = max(1, int(capacity))
+        self._steps = deque(maxlen=self.capacity)
+        self._events = deque(maxlen=4 * self.capacity)
+        self._lock = threading.Lock()
+        self._dumped = False
+
+    def record(self, rec: dict) -> None:
+        with self._lock:
+            if rec.get("kind") == "step":
+                self._steps.append(rec)
+            else:
+                self._events.append(rec)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"steps": list(self._steps),
+                    "events": list(self._events)}
+
+    def _default_path(self) -> str:
+        from .registry import registry
+
+        reg = registry()
+        base = reg.telemetry_dir or os.getcwd()
+        return os.path.join(base, "flightrec.rank%d.json" % reg.rank)
+
+    def dump(self, reason: str, fatal_event: Optional[dict] = None,
+             path: Optional[str] = None, once: bool = True) -> Optional[str]:
+        """Write the postmortem atomically; returns the path (None when
+        suppressed by `once` after a prior dump, or on IO failure —
+        this runs on dying processes and must never raise)."""
+        with self._lock:
+            if once and self._dumped:
+                return None
+            self._dumped = True
+            steps = list(self._steps)
+            events = list(self._events)
+        try:
+            from .registry import registry
+
+            reg = registry()
+            doc = {
+                "reason": str(reason),
+                "fatal_event": fatal_event,
+                "rank": reg.rank,
+                "pid": os.getpid(),
+                "ts": time.time(),
+                "n_steps": len(steps),
+                "steps": steps,
+                "events": events,
+                "metrics": reg.snapshot(),
+            }
+            path = path or self._default_path()
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            tmp = "%s.tmp.%d" % (path, os.getpid())
+            with open(tmp, "w") as f:
+                json.dump(doc, f, sort_keys=True)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            return path
+        except Exception:  # noqa: BLE001 - dying process, best effort
+            return None
+
+
+# -- process-global recorder ---------------------------------------------
+
+_lock = threading.Lock()
+_recorder: Optional[FlightRecorder] = None
+
+
+def recorder() -> FlightRecorder:
+    global _recorder
+    if _recorder is None:
+        with _lock:
+            if _recorder is None:
+                _recorder = FlightRecorder()
+    return _recorder
+
+
+def configure(capacity: Optional[int] = None) -> FlightRecorder:
+    """Re-size the ring (tests / entry points). The old ring's contents
+    are carried over up to the new capacity."""
+    global _recorder
+    with _lock:
+        old = _recorder
+        _recorder = FlightRecorder(capacity)
+        if old is not None:
+            snap = old.snapshot()
+            for rec in snap["steps"] + snap["events"]:
+                _recorder.record(rec)
+    return _recorder
+
+
+def dump(reason: str, fatal_event: Optional[dict] = None,
+         path: Optional[str] = None) -> Optional[str]:
+    return recorder().dump(reason, fatal_event=fatal_event, path=path)
+
+
+def on_fatal(reason: str, fatal_event: Optional[dict] = None) -> None:
+    """Last-gasp hook for paths that bypass interpreter shutdown
+    (faults.py's kill os._exit): record the fatal event into the ring,
+    then dump. Never raises."""
+    try:
+        if fatal_event is not None:
+            rec = dict(fatal_event)
+            rec.setdefault("kind", "event")
+            rec.setdefault("ts", time.time())
+            recorder().record(rec)
+        recorder().dump(reason, fatal_event=fatal_event)
+    except Exception:  # noqa: BLE001 - dying process
+        pass
+
+
+# -- crash / signal installation -----------------------------------------
+
+_hook_installed = False
+_sig_installed = False
+
+
+def install() -> bool:
+    """Arm the excepthook + SIGTERM dump triggers (idempotent
+    per-trigger). Signal handlers only install from the main thread
+    (signal module restriction) — a first call from a background
+    thread arms the excepthook only, and a LATER main-thread call
+    still gets to arm the signal handler. Returns True once the signal
+    handler has landed."""
+    global _hook_installed, _sig_installed
+    with _lock:
+        need_hook = not _hook_installed
+        _hook_installed = True
+
+    if need_hook:
+        prev_hook = sys.excepthook
+
+        def _hook(exc_type, exc, tb):
+            try:
+                on_fatal("unhandled-exception", {
+                    "kind": "event", "event": "crash",
+                    "type": getattr(exc_type, "__name__", str(exc_type)),
+                    "message": str(exc)[:500],
+                    "traceback": "".join(
+                        traceback.format_exception(
+                            exc_type, exc, tb))[-4000:],
+                })
+            finally:
+                prev_hook(exc_type, exc, tb)
+
+        sys.excepthook = _hook
+
+    if _sig_installed:
+        return True
+    if threading.current_thread() is not threading.main_thread():
+        return False
+    try:
+        prev_term = signal.getsignal(signal.SIGTERM)
+
+        def _on_term(signum, frame):
+            on_fatal("sigterm", {"kind": "event", "event": "signal",
+                                 "signum": int(signum)})
+            if callable(prev_term):
+                prev_term(signum, frame)
+            elif prev_term is signal.SIG_IGN:
+                # the process had SIGTERM explicitly ignored: keep
+                # ignoring — dumping must not turn an ignore into death
+                return
+            else:
+                # restore default disposition and re-deliver so the
+                # exit status stays 128+SIGTERM for the supervisor
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        signal.signal(signal.SIGTERM, _on_term)
+        with _lock:
+            _sig_installed = True
+        return True
+    except (ValueError, OSError):  # non-main thread race / exotic host
+        return False
+
+
+def _reset_for_tests() -> None:
+    global _recorder, _hook_installed, _sig_installed
+    with _lock:
+        _recorder = None
+        _hook_installed = False
+        _sig_installed = False

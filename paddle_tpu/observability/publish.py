@@ -1,0 +1,269 @@
+"""Publishers: the existing perf/precision/lint surfaces -> the metrics
+registry -> bench.py's result blocks.
+
+Before this module, bench.py assembled each evidence block by hand
+(`_attach_collectives` / `_attach_precision` / `_attach_static_checks`
+plus an inline phases read) — four ad-hoc code paths no other tool
+could reuse. Now each surface publishes THROUGH the registry
+(`registry().publish_block`) and `bench_blocks()` is the one assembly
+point: bench.py, tests and any future tool read identical dicts from
+`registry().blocks()`.
+
+Block producers (each returns the block dict or None, prints the same
+one-line BENCH summary bench.py always printed, and publishes):
+
+    phases_block()                      "phases"
+    collectives_blocks(exe, p, f, fl)   "collectives",
+                                        "opt_state_sharding", "overlap"
+    precision_block(exe, p, f, fl)      "precision"
+    static_checks_block(p)              "static_checks"
+    telemetry_block(group=None)         "telemetry" (registry counters,
+                                        straggler report when a
+                                        host-collective group is given)
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .registry import registry
+
+__all__ = ["phases_block", "collectives_blocks", "precision_block",
+           "static_checks_block", "telemetry_block", "bench_blocks"]
+
+
+def phases_block() -> dict:
+    """Host step-phase breakdown (fluid/profiler.py) as the "phases"
+    block; per-phase averages also land as registry gauges."""
+    from ..fluid import profiler as _prof
+
+    block = _prof.step_phase_summary()
+    reg = registry()
+    for k, v in block.items():
+        if isinstance(v, (int, float)):
+            reg.set_gauge("phases." + k, v)
+    reg.publish_block("phases", block)
+    print("BENCH " + _prof.step_phase_line(), flush=True)
+    return block
+
+
+def collectives_blocks(exe, program, feed, fetch_list) -> dict:
+    """Per-collective byte census + (when ZeRO-1 is active) the
+    opt-state sharding footprint and the bucketed-overlap audit of the
+    optimized schedule. Single-chip programs provably have no
+    collectives and pay nothing. Returns {} or up to three blocks."""
+    out = {}
+    if getattr(program, "_mesh", None) is None or \
+            not getattr(program, "_data_parallel", False):
+        return out
+    reg = registry()
+    try:
+        col = exe.collective_report(program, feed=feed,
+                                    fetch_list=fetch_list)
+    except Exception as e:  # noqa: BLE001 - evidence, not gating
+        print("BENCH collective census failed: %r" % (e,), flush=True)
+        return out
+    if col and col.get("total_ici_bytes", 0) > 0:
+        out["collectives"] = col
+        reg.publish_block("collectives", col)
+        reg.set_gauge("collectives.total_ici_bytes",
+                      col["total_ici_bytes"])
+        print("BENCH collectives: " + ", ".join(
+            "%s x%d %.1fMB" % (k, v["count"], v["ici_bytes"] / 1e6)
+            for k, v in col.items() if isinstance(v, dict)),
+            flush=True)
+    if col and col.get("reduce_scatter"):
+        # ZeRO-1 active: also report the per-replica optimizer-state
+        # footprint (donation_report compiles via AOT — only pay that
+        # when there is sharding to prove)
+        rep = exe.donation_report(program, feed=feed,
+                                  fetch_list=fetch_list)
+        if rep and rep.get("opt_state_sharded_vars"):
+            oss = {
+                "vars": rep["opt_state_sharded_vars"],
+                "logical_bytes": rep["opt_state_logical_bytes"],
+                "per_replica_bytes": rep["opt_state_per_replica_bytes"],
+            }
+            out["opt_state_sharding"] = oss
+            reg.publish_block("opt_state_sharding", oss)
+        # bucketed-collective overlap audit (FLAGS_tpu_comm_bucket_mb):
+        # how many grad reduce-scatters are dataflow-ready before the
+        # final backward compute op — the transfers a latency-hiding
+        # scheduler can overlap
+        try:
+            ov = exe.overlap_report(program, feed=feed,
+                                    fetch_list=fetch_list)
+        except Exception as e:  # noqa: BLE001 - evidence, not gating
+            print("BENCH overlap audit failed: %r" % (e,), flush=True)
+            ov = None
+        region = (ov or {}).get("region_collectives") or []
+        if ov and (ov.get("collectives") or region):
+            rs = [c for c in ov["collectives"]
+                  if c["kind"] == "reduce-scatter"]
+            ovb = {
+                "n_buckets": ov.get("n_buckets", 0),
+                "n_backward_compute": ov["n_backward_compute"],
+                "overlappable_reduce_scatters":
+                    ov["overlappable_reduce_scatters"],
+                "reduce_scatters": [
+                    {k: c[k] for k in ("pos", "ready", "backward_after",
+                                       "bytes")} for c in rs],
+                "combined": ov["combined"],
+                # gradient merge traces its collectives inside the
+                # lax.cond region — fenced, but visible
+                "region_collectives": region,
+            }
+            out["overlap"] = ovb
+            reg.publish_block("overlap", ovb)
+            print("BENCH overlap: %d/%d reduce-scatters ready before "
+                  "the final backward op (buckets=%d, backward left "
+                  "behind each: %s)"
+                  % (ov["overlappable_reduce_scatters"], len(rs),
+                     ov.get("n_buckets", 0),
+                     [c["backward_after"] for c in rs]), flush=True)
+    return out
+
+
+def precision_block(exe, program, feed, fetch_list) -> Optional[dict]:
+    """Mixed-precision evidence: the AMP policy the step lowered under,
+    the live-param vs fp32-master HBM split, the ZeRO-2 peak-grad
+    model, and the fp16 loss-scale state machine's live state (read
+    from scope; also published as gauges so the telemetry timeseries
+    tracks scale decay/growth across a run)."""
+    if not getattr(program, "_amp", False):
+        return None
+    try:
+        import numpy as np
+
+        reg = registry()
+        lists = getattr(program, "_amp_lists", None)
+        masters = dict(getattr(program, "_amp_master_of", None) or {})
+        block = {
+            "amp_dtype": str(getattr(program, "_amp_dtype", "bfloat16")),
+            "level": "O2" if masters else "O1",
+            "master_weights": len(masters),
+            "white_list_ops": len(lists.white_list) if lists else 0,
+            "black_list_ops": len(lists.black_list) if lists else 0,
+        }
+        rep = exe.donation_report(program, feed=feed,
+                                  fetch_list=fetch_list)
+        for k in ("param_bf16_bytes", "param_master_bytes",
+                  "param_fp32_replicated_bytes", "param_masters_sharded",
+                  "grad_peak_per_replica_bytes",
+                  "grad_replicated_peak_bytes"):
+            if rep and k in rep:
+                block[k] = rep[k]
+        bop = next((op for op in program.global_block().ops
+                    if op.type == "backward"), None)
+        dls = bop.attrs.get("dynamic_loss_scaling") if bop is not None \
+            else None
+        if dls:
+            from ..core.scope import global_scope
+
+            def read(name):
+                v = global_scope().find_var(name)
+                return (float(np.asarray(v).reshape(-1)[0])
+                        if v is not None else None)
+
+            block["loss_scaling"] = {
+                "current": read(dls["scale"]),
+                "good_steps": read(dls["good"]),
+                "bad_steps": read(dls["bad"]),
+                "incr_every_n_steps": dls["incr_every_n_steps"],
+                "decr_every_n_nan_or_inf": dls["decr_every_n_nan_or_inf"],
+            }
+            for k in ("current", "good_steps", "bad_steps"):
+                if block["loss_scaling"][k] is not None:
+                    reg.set_gauge("amp.loss_scale." + k,
+                                  block["loss_scaling"][k])
+        else:
+            block["loss_scaling"] = None
+        reg.set_gauge("amp.level", block["level"])
+        reg.publish_block("precision", block)
+        msg = ("BENCH precision: %s level=%s masters=%d"
+               % (block["amp_dtype"], block["level"],
+                  block["master_weights"]))
+        if "param_bf16_bytes" in block:
+            msg += (", param %s MB live + %s MB master/replica (fp32 "
+                    "DP would be %s MB)"
+                    % tuple(round(block[k] / 1e6, 2) for k in
+                            ("param_bf16_bytes", "param_master_bytes",
+                             "param_fp32_replicated_bytes")))
+        if block["loss_scaling"]:
+            msg += ", loss_scale=%s" % block["loss_scaling"]["current"]
+        print(msg, flush=True)
+        return block
+    except Exception as e:  # noqa: BLE001 - evidence, not gating
+        print("BENCH precision block failed: %r" % (e,), flush=True)
+        return None
+
+
+def static_checks_block(program) -> Optional[dict]:
+    """tpu-lint summary of the program that just ran: zero errors is
+    the standing claim. Evidence, not gating."""
+    try:
+        from .. import analysis
+
+        findings = analysis.run_static_checks(program)
+        s = analysis.summarize(findings)
+        block = {
+            "errors": s["errors"],
+            "warnings": s["warnings"],
+            "by_checker": s["by_checker"],
+            # cap the embedded detail; the CLI writes the full report
+            "findings": s["findings"][:20],
+        }
+        reg = registry()
+        reg.set_gauge("static_checks.errors", s["errors"])
+        reg.set_gauge("static_checks.warnings", s["warnings"])
+        reg.publish_block("static_checks", block)
+        print("BENCH static checks: %d error(s), %d warning(s)"
+              % (s["errors"], s["warnings"]), flush=True)
+        return block
+    except Exception as e:  # noqa: BLE001 - evidence, not gating
+        print("BENCH static checks failed: %r" % (e,), flush=True)
+        return None
+
+
+def telemetry_block(group=None) -> dict:
+    """Registry roll-up: counters, step count, JSONL sink location —
+    and, when a host-collective `group` spans the run's ranks, the
+    end-of-window cross-rank aggregation + straggler verdict."""
+    from . import aggregate
+
+    reg = registry()
+    snap = reg.snapshot()
+    block = {
+        "rank": snap["rank"],
+        "steps": snap["steps"],
+        "counters": snap["counters"],
+        "telemetry_dir": snap["telemetry_dir"],
+        "jsonl": reg.jsonl_path,
+        "step_total_ms": snap["histograms"].get("step.total_ms"),
+    }
+    if group is not None:
+        summaries = aggregate.allgather_window(
+            group, aggregate.window_summary(reg))
+        block["cross_rank"] = aggregate.aggregate_summaries(summaries)
+        st = block["cross_rank"]["straggler"]
+        if st is not None:
+            print("BENCH straggler: rank %d (%.2fms/step mean, "
+                  "+%.2fms vs rank %d; blame=%s)"
+                  % (st["rank"], st["total_ms_mean"], st["slack_ms"],
+                     st["fastest_rank"], st["blame_phase"]), flush=True)
+    reg.publish_block("telemetry", block)
+    return block
+
+
+def bench_blocks(exe, program, feed, fetch_list, group=None) -> dict:
+    """Everything bench.py attaches to a measured child's result, read
+    back from the ONE registry: {"phases": ..., "collectives": ...,
+    "opt_state_sharding": ..., "overlap": ..., "precision": ...,
+    "static_checks": ..., "telemetry": ...} (absent blocks omitted)."""
+    reg = registry()
+    reg.clear_blocks()  # one program's evidence per assembly
+    phases_block()
+    collectives_blocks(exe, program, feed, fetch_list)
+    precision_block(exe, program, feed, fetch_list)
+    static_checks_block(program)
+    telemetry_block(group=group)
+    return reg.blocks()

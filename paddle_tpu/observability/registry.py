@@ -1,0 +1,414 @@
+"""Structured metrics registry: counters / gauges / histograms tagged
+by (rank, step), a per-step record stream, and a JSONL timeseries sink.
+
+Every perf/fault surface in the runtime publishes HERE instead of into
+its own ad-hoc report dict: the executor's step phases, the RPC layer's
+retry/reconnect/dedup counters, host-collective completions, fault
+injection, checkpoint save/restore, and the AMP loss-scale state
+machine (via observability/publish.py). One registry means one JSONL
+schema (tools/telemetry_schema.json), one flight-recorder feed, and one
+place for bench.py / tools/perf_analysis.py to read.
+
+Cost model: the in-memory registry is always on — one lock, a dict
+update and a deque append per step are noise next to a dispatched XLA
+step. The on-disk JSONL sink engages only when `FLAGS_tpu_telemetry_dir`
+is set (or `configure(telemetry_dir=...)` is called); files rotate
+atomically (os.replace to a numbered generation) past
+`FLAGS_tpu_telemetry_rotate_mb`.
+
+Record shapes (the schema the sink emits, locked by
+tools/telemetry_schema.json):
+
+    step  {"kind": "step", "rank": R, "step": N, "ts": epoch_s,
+           "feed_ms": .., "dispatch_ms": .., "comm_ms": ..,
+           "sync_ms": .., "host_ms": .., "compile_ms": ..,
+           "total_ms": ..}
+    event {"kind": "event", "event": "<type>", "rank": R, "step": N,
+           "ts": epoch_s, ...free-form detail fields...}
+
+`step` numbers are the registry's own dispatch counter (monotonic per
+process); `rank` comes from PADDLE_TRAINER_ID. Event types in use:
+"collective" (host tier, carries the cross-rank `key` the timeline
+merge uses as a clock-sync anchor), "rpc_retry", "rpc_giveup", "fault",
+"checkpoint", "crash", "signal", "capture".
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+__all__ = ["MetricsRegistry", "registry", "reset_registry", "configure"]
+
+#: step-phase keys a step record carries (mirrors profiler.STEP_PHASES
+#: plus the cache-miss compile phase)
+STEP_FIELDS = ("feed_ms", "dispatch_ms", "comm_ms", "sync_ms",
+               "host_ms", "compile_ms", "total_ms")
+
+
+def _env_rank() -> int:
+    try:
+        return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    except ValueError:
+        return 0
+
+
+class Counter:
+    """Monotonic count (+ last-touched step). Mutations go through the
+    owning registry's lock."""
+
+    __slots__ = ("name", "value", "step")
+
+    def __init__(self, name):
+        self.name = name
+        self.value = 0
+        self.step = -1
+
+
+class Gauge:
+    """Last-written value (+ the step it was written at)."""
+
+    __slots__ = ("name", "value", "step")
+
+    def __init__(self, name):
+        self.name = name
+        self.value = None
+        self.step = -1
+
+
+class Histogram:
+    """Streaming count/sum/min/max plus a bounded ring of the most
+    recent observations for percentile estimates (p50/p99 over the last
+    `keep` values — a straggler window, not the whole run)."""
+
+    __slots__ = ("name", "count", "total", "min", "max", "_ring")
+
+    def __init__(self, name, keep=512):
+        self.name = name
+        self.count = 0
+        self.total = 0.0
+        self.min = None
+        self.max = None
+        self._ring = deque(maxlen=keep)
+
+    def _observe(self, v):
+        v = float(v)
+        self.count += 1
+        self.total += v
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+        self._ring.append(v)
+
+    def percentile(self, q) -> Optional[float]:
+        if not self._ring:
+            return None
+        vals = sorted(self._ring)
+        idx = min(len(vals) - 1, max(0, int(round(q * (len(vals) - 1)))))
+        return vals[idx]
+
+    def summary(self) -> dict:
+        return {
+            "count": self.count,
+            "mean": self.total / self.count if self.count else None,
+            "min": self.min, "max": self.max,
+            "p50": self.percentile(0.50),
+            "p99": self.percentile(0.99),
+        }
+
+
+class _JsonlSink:
+    """Append-only JSONL writer with atomic generation rotation: when
+    the active file passes `rotate_bytes` it is os.replace'd (atomic on
+    POSIX) to `<stem>.g<N>.jsonl` and a fresh active file starts, so a
+    reader/collector never observes a half-renamed file."""
+
+    def __init__(self, directory, rank, rotate_bytes):
+        self._dir = directory
+        self._rank = int(rank)
+        self._rotate = int(rotate_bytes)
+        self._gen = 0
+        self._f = None
+        # publishers write from many threads (RPC handlers, heartbeat,
+        # prefetcher); the rotation close/reopen must not race a
+        # concurrent write into a closed file or torn line
+        self._wlock = threading.Lock()
+        os.makedirs(directory, exist_ok=True)
+
+    @property
+    def path(self) -> str:
+        return os.path.join(self._dir,
+                            "telemetry.rank%d.jsonl" % self._rank)
+
+    def _rotated_path(self, gen) -> str:
+        return os.path.join(self._dir,
+                            "telemetry.rank%d.g%03d.jsonl"
+                            % (self._rank, gen))
+
+    def write(self, record: dict) -> None:
+        line = json.dumps(record, sort_keys=True)
+        with self._wlock:
+            if self._f is None:
+                self._f = open(self.path, "a")
+            self._f.write(line + "\n")
+            self._f.flush()
+            if self._rotate > 0 and self._f.tell() >= self._rotate:
+                self._f.close()
+                self._f = None
+                self._gen += 1
+                os.replace(self.path, self._rotated_path(self._gen))
+
+    def close(self) -> None:
+        with self._wlock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+class MetricsRegistry:
+    """One process's telemetry state. Thread-safe: the prefetcher's
+    producer thread, RPC handler threads and the heartbeat thread all
+    publish concurrently with the main step loop."""
+
+    def __init__(self, rank=None, telemetry_dir=None, rotate_mb=None):
+        from ..utils.flags import get_flag
+
+        self.rank = _env_rank() if rank is None else int(rank)
+        self._lock = threading.RLock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._hists: Dict[str, Histogram] = {}
+        self._step = 0          # dispatch counter (monotonic)
+        # step records since the last drain_window(); bounded so a run
+        # that never aggregates (no group, no bench) can't grow it
+        # without limit — aggregation windows are meant to be drained
+        # every O(100) steps anyway
+        self._window = deque(maxlen=4096)
+        self._blocks: Dict[str, dict] = {}  # published bench blocks
+        if telemetry_dir is None:
+            telemetry_dir = str(
+                get_flag("FLAGS_tpu_telemetry_dir", "") or "")
+        self._dir = telemetry_dir or None
+        if rotate_mb is None:
+            # no `or`-defaulting: an explicit 0 means rotation OFF
+            flag = get_flag("FLAGS_tpu_telemetry_rotate_mb", 64.0)
+            rotate_mb = 64.0 if flag is None else float(flag)
+        self._rotate_bytes = int(rotate_mb * 1e6)
+        self._sink: Optional[_JsonlSink] = None
+        if self._dir:
+            self._sink = _JsonlSink(self._dir, self.rank,
+                                    self._rotate_bytes)
+
+    # -- configuration ---------------------------------------------------
+    @property
+    def telemetry_dir(self) -> Optional[str]:
+        return self._dir
+
+    @property
+    def jsonl_path(self) -> Optional[str]:
+        return self._sink.path if self._sink is not None else None
+
+    def set_telemetry_dir(self, directory) -> None:
+        with self._lock:
+            if self._sink is not None:
+                self._sink.close()
+            self._dir = directory or None
+            self._sink = (_JsonlSink(directory, self.rank,
+                                     self._rotate_bytes)
+                          if directory else None)
+
+    # -- metric accessors -------------------------------------------------
+    def counter(self, name) -> Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(name)
+            return c
+
+    def inc(self, name, n=1) -> int:
+        with self._lock:
+            c = self.counter(name)
+            c.value += int(n)
+            c.step = self._step
+            return c.value
+
+    def gauge(self, name) -> Gauge:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(name)
+            return g
+
+    def set_gauge(self, name, value) -> None:
+        with self._lock:
+            g = self.gauge(name)
+            g.value = value
+            g.step = self._step
+
+    def histogram(self, name) -> Histogram:
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = Histogram(name)
+            return h
+
+    def observe(self, name, value) -> None:
+        with self._lock:
+            self.histogram(name)._observe(value)
+
+    # -- the step / event record stream -----------------------------------
+    def record_step(self, phases_ms: dict, ts=None) -> dict:
+        """One completed executor step: `phases_ms` maps phase name (no
+        _ms suffix needed) -> milliseconds. Returns the record."""
+        with self._lock:
+            self._step += 1
+            rec = {"kind": "step", "rank": self.rank,
+                   "step": self._step,
+                   "ts": float(ts if ts is not None else time.time())}
+            total = 0.0
+            for f in STEP_FIELDS:
+                if f == "total_ms":
+                    continue
+                v = phases_ms.get(f, phases_ms.get(f[:-3]))
+                if v is None and f != "compile_ms":
+                    v = 0.0
+                if v is not None:
+                    v = round(float(v), 4)
+                    rec[f] = v
+                    if f != "compile_ms":
+                        total += v
+            rec["total_ms"] = round(
+                float(phases_ms.get("total_ms", total)), 4)
+            self._window.append(rec)
+            for f, v in rec.items():
+                if isinstance(v, float) and f.endswith("_ms"):
+                    self.histogram("step." + f)._observe(v)
+            sink = self._sink
+        self._fanout(rec, sink)
+        return rec
+
+    def event(self, etype, **fields) -> dict:
+        """One telemetry event ("collective", "rpc_retry", "fault",
+        "checkpoint", ...). Free-form detail fields ride along; values
+        must be JSON-encodable."""
+        with self._lock:
+            rec = {"kind": "event", "event": str(etype),
+                   "rank": self.rank, "step": self._step,
+                   "ts": time.time()}
+            rec.update(fields)
+            c = self.counter("event." + etype)
+            c.value += 1
+            c.step = self._step
+            sink = self._sink
+        self._fanout(rec, sink)
+        return rec
+
+    def _fanout(self, rec, sink) -> None:
+        """Deliver a record to the flight recorder (always) and the
+        JSONL sink (when configured). Outside the lock: the sink does
+        file IO and the flight ring has its own lock."""
+        from . import flight
+
+        flight.recorder().record(rec)
+        if sink is not None:
+            try:
+                sink.write(rec)
+            except Exception:  # noqa: BLE001 - a full disk / closed-file
+                pass  # race must never kill the publishing code path
+
+    @property
+    def step(self) -> int:
+        return self._step
+
+    # -- window drain (cross-rank aggregation) ----------------------------
+    def drain_window(self) -> List[dict]:
+        """Step records accumulated since the last drain (the per-rank
+        payload of an end-of-window allgather — see aggregate.py)."""
+        with self._lock:
+            out = list(self._window)
+            self._window.clear()
+            return out
+
+    def peek_window(self) -> List[dict]:
+        with self._lock:
+            return list(self._window)
+
+    # -- bench blocks ------------------------------------------------------
+    def publish_block(self, name, block) -> None:
+        """Publish one named bench evidence block ("phases",
+        "collectives", "overlap", "precision", ...); bench.py emits
+        `blocks()` instead of assembling its own dicts."""
+        with self._lock:
+            self._blocks[str(name)] = block
+
+    def blocks(self) -> Dict[str, dict]:
+        with self._lock:
+            return dict(self._blocks)
+
+    def clear_blocks(self) -> None:
+        with self._lock:
+            self._blocks.clear()
+
+    # -- snapshot ---------------------------------------------------------
+    def snapshot(self) -> dict:
+        """Everything, JSON-encodable: counters, gauges, histogram
+        summaries, step count — the `telemetry` bench block's payload
+        and the flight-recorder dump's metrics section."""
+        with self._lock:
+            return {
+                "rank": self.rank,
+                "steps": self._step,
+                "counters": {n: c.value
+                             for n, c in sorted(self._counters.items())},
+                "gauges": {n: g.value
+                           for n, g in sorted(self._gauges.items())},
+                "histograms": {n: h.summary()
+                               for n, h in sorted(self._hists.items())},
+                "telemetry_dir": self._dir,
+            }
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sink is not None:
+                self._sink.close()
+
+
+# -- process-global singleton -------------------------------------------
+
+_global_lock = threading.Lock()
+_global: Optional[MetricsRegistry] = None
+
+
+def registry() -> MetricsRegistry:
+    """THE process registry (created lazily from FLAGS/env)."""
+    global _global
+    if _global is None:
+        with _global_lock:
+            if _global is None:
+                _global = MetricsRegistry()
+    return _global
+
+
+def configure(telemetry_dir=None, rank=None,
+              flight_steps=None) -> MetricsRegistry:
+    """(Re)build the global registry with explicit settings — tests and
+    entry points that learn their rank/dir after import time.
+    `flight_steps` re-sizes the flight-recorder ring too."""
+    global _global
+    with _global_lock:
+        _global = MetricsRegistry(rank=rank, telemetry_dir=telemetry_dir)
+    if flight_steps is not None:
+        from . import flight
+
+        flight.configure(capacity=flight_steps)
+    return _global
+
+
+def reset_registry() -> None:
+    global _global
+    with _global_lock:
+        if _global is not None:
+            _global.close()
+        _global = None

@@ -1,0 +1,81 @@
+"""Telemetry JSONL schema validation (self-contained subset validator —
+no jsonschema dependency; the checked-in contract lives at
+tools/telemetry_schema.json and CI asserts every sink record against
+it, so a field rename or type drift fails a test instead of silently
+breaking tools/perf_analysis.py --stragglers and tools/timeline.py).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import List
+
+__all__ = ["load_schema", "validate_record", "validate_records",
+           "default_schema_path"]
+
+
+def default_schema_path() -> str:
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(repo, "tools", "telemetry_schema.json")
+
+
+def load_schema(path=None) -> dict:
+    with open(path or default_schema_path()) as f:
+        return json.load(f)
+
+
+def _type_ok(value, tname) -> bool:
+    if tname == "string":
+        return isinstance(value, str)
+    if tname == "integer":
+        return isinstance(value, int) and not isinstance(value, bool)
+    if tname == "number":
+        return (isinstance(value, (int, float))
+                and not isinstance(value, bool))
+    if tname == "boolean":
+        return isinstance(value, bool)
+    return True  # "any"
+
+
+def validate_record(record: dict, schema: dict) -> List[str]:
+    """Problems with one record (empty list = valid): unknown kind,
+    missing required fields, wrong field types, and — for kinds with
+    "allow_extra": false — fields outside the contract."""
+    problems = []
+    if not isinstance(record, dict):
+        return ["record is %s, not an object" % type(record).__name__]
+    kind = record.get("kind")
+    spec = schema.get("kinds", {}).get(kind)
+    if spec is None:
+        return ["unknown record kind %r (schema knows %s)"
+                % (kind, sorted(schema.get("kinds", {})))]
+    for f in spec.get("required", []):
+        if f not in record:
+            problems.append("%s record missing required field %r"
+                            % (kind, f))
+    types = spec.get("types", {})
+    for f, v in record.items():
+        if f in types and not _type_ok(v, types[f]):
+            problems.append(
+                "%s.%s is %s, schema wants %s"
+                % (kind, f, type(v).__name__, types[f]))
+    if not spec.get("allow_extra", True):
+        known = set(spec.get("required", [])) | set(
+            spec.get("optional", []))
+        for f in record:
+            if f not in known:
+                problems.append("%s record has unknown field %r"
+                                % (kind, f))
+    return problems
+
+
+def validate_records(records, schema=None) -> List[str]:
+    """Problems across a record iterable, each prefixed with its
+    index."""
+    schema = schema or load_schema()
+    out = []
+    for i, rec in enumerate(records):
+        for p in validate_record(rec, schema):
+            out.append("record %d: %s" % (i, p))
+    return out

@@ -99,6 +99,21 @@ _FLAGS: Dict[str, object] = {
     # error-severity finding exists — the program never dispatches.
     # Steady-state steps (cache hits) never pay for this.
     "FLAGS_tpu_static_checks": "off",
+    # Unified telemetry (paddle_tpu/observability): directory for the
+    # per-step JSONL timeseries sink, flight-recorder dumps and
+    # on-demand jax.profiler captures. "" disables the on-disk sink;
+    # the in-memory registry + flight-recorder ring always run (their
+    # cost is a dict update + deque append per step). The supervised
+    # launcher defaults this to <log_dir>/telemetry for its workers.
+    "FLAGS_tpu_telemetry_dir": "",
+    # flight recorder: how many of the most recent STEP records the
+    # in-memory ring retains (events keep 4x this); the dump written on
+    # crash/SIGTERM/fault-kill carries exactly this window
+    "FLAGS_tpu_flight_recorder_steps": 64,
+    # JSONL sink rotation threshold: when the active telemetry file
+    # exceeds this many MB it is atomically renamed to a numbered
+    # generation and a fresh file starts
+    "FLAGS_tpu_telemetry_rotate_mb": 64.0,
 }
 
 

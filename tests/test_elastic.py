@@ -186,3 +186,95 @@ def test_supervised_restart_resumes_from_elastic_checkpoint(tmp_path):
     for step in ("4", "5", "6", "7"):
         np.testing.assert_allclose(resumed[step], expected[step],
                                    rtol=1e-4, atol=1e-5)
+
+
+# -- supervisor-collected flight-recorder postmortem ------------------------
+
+def test_supervisor_collects_flight_dump_of_fault_killed_rank(tmp_path):
+    """Acceptance (observability): a PADDLE_FAULTS kill on ONE rank of
+    a supervised 2-worker cohort leaves a flight-recorder dump that the
+    launch supervisor collects into <log_dir>/postmortem/attempt0/
+    BEFORE the --max_restarts cohort restart; the dump parses, names
+    the fatal fault event, and carries the rank's last step records
+    intact. The restarted cohort completes clean (rc=0)."""
+    import json as _json
+
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, sys\n"
+        "sys.path.insert(0, %r)\n"
+        "tid = int(os.environ['PADDLE_TRAINER_ID'])\n"
+        "attempt = int(os.environ.get('PADDLE_RESTART_NUM', '0'))\n"
+        "if tid == 1 and attempt == 0:\n"
+        "    # the designated victim: die at its 3rd collective send\n"
+        "    os.environ['PADDLE_FAULTS'] = \\\n"
+        "        'kill:side=client,point=send,method=hc_put_part,at=3'\n"
+        "import numpy as np\n"
+        "import paddle_tpu.fluid as fluid\n"
+        "from paddle_tpu.fluid import framework\n"
+        "from paddle_tpu.distributed.host_collectives import \\\n"
+        "    group_from_env\n"
+        "os.environ.setdefault('PADDLE_HC_LIVENESS_S', '4')\n"
+        "os.environ.setdefault('PADDLE_HC_HEARTBEAT_S', '0.5')\n"
+        "g = group_from_env()\n"
+        "main, startup = fluid.Program(), fluid.Program()\n"
+        "with framework.program_guard(main, startup):\n"
+        "    x = fluid.data(name='x', shape=[-1, 8], dtype='float32')\n"
+        "    loss = fluid.layers.reduce_mean(\n"
+        "        fluid.layers.fc(input=x, size=4))\n"
+        "    fluid.optimizer.SGD(0.1).minimize(loss)\n"
+        "exe = fluid.Executor(fluid.CPUPlace())\n"
+        "exe.run(startup)\n"
+        "feed = {'x': np.ones((2, 8), 'float32')}\n"
+        "for i in range(6):\n"
+        "    exe.run(main, feed=feed, fetch_list=[loss])\n"
+        "    g.barrier()\n"
+        "g.shutdown()\n"
+        "sys.stdout.flush()\n"
+        "os._exit(0)\n" % _REPO)
+    log_dir = str(tmp_path / "logs")
+    proc = _sp.run(
+        [_sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--hosts", "127.0.0.1:6711,127.0.0.1:6712",
+         "--log_dir", log_dir, "--max_restarts", "1", str(script)],
+        env=_launch_env(), cwd=_REPO, stdout=_sp.PIPE,
+        stderr=_sp.STDOUT, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stdout
+    assert "restart 1/1" in proc.stdout, proc.stdout
+    assert "collected" in proc.stdout and "flight-recorder" \
+        in proc.stdout, proc.stdout
+
+    # the victim's dump was secured under postmortem/attempt0 before
+    # the restart (the restarted cohort overwrites the telemetry dir)
+    dump_path = _os.path.join(log_dir, "postmortem", "attempt0",
+                              "flightrec.rank1.json")
+    assert _os.path.exists(dump_path), proc.stdout
+    doc = _json.load(open(dump_path))
+    assert doc["reason"] == "fault-kill"
+    assert doc["fatal_event"]["event"] == "fault"
+    assert doc["fatal_event"]["fault"] == "kill"
+    assert doc["rank"] == 1
+    # rank 1 died at its 3rd barrier: startup + 3 train steps recorded,
+    # in order, with the step-phase split intact
+    steps = [s["step"] for s in doc["steps"]]
+    assert doc["n_steps"] >= 3 and steps == sorted(steps)
+    assert all("total_ms" in s for s in doc["steps"])
+    # the collective events before death rode along in the ring
+    assert any(e.get("event") == "collective" for e in doc["events"])
+    # the JSONL streams moved with the dumps, so attempt 1 started a
+    # FRESH stream (no silent cross-attempt append with a reset step
+    # counter) and attempt 0's records stay analyzable per-attempt
+    att0 = _os.path.join(log_dir, "postmortem", "attempt0")
+    assert _os.path.exists(_os.path.join(
+        att0, "telemetry.rank1.jsonl")), _os.listdir(att0)
+    tdir = _os.path.join(log_dir, "telemetry")
+    assert _os.path.isdir(tdir)
+    fresh = [f for f in _os.listdir(tdir) if f.endswith(".jsonl")]
+    assert fresh, "restarted cohort must write its own stream"
+    for f in fresh:
+        recs = [_json.loads(ln) for ln in
+                open(_os.path.join(tdir, f)) if ln.strip()]
+        steps = [r["step"] for r in recs if r["kind"] == "step"]
+        # a fresh stream restarts at step 1 — proof attempt 1 did not
+        # append into attempt 0's file
+        assert steps and steps[0] == 1, (f, steps[:3])

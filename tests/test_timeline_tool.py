@@ -71,6 +71,109 @@ def test_merge_accepts_bare_array_traces():
     assert {e["pid"] for e in xs} == {0, 1000}
 
 
+# -- telemetry JSONL merge (paddle_tpu/observability sink) ------------------
+
+def _write_jsonl(path, records):
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+def _step(rank, step, ts, total_ms=10.0):
+    return {"kind": "step", "rank": rank, "step": step, "ts": ts,
+            "feed_ms": 1.0, "dispatch_ms": 5.0, "comm_ms": 0.0,
+            "sync_ms": 2.0, "host_ms": 2.0, "total_ms": total_ms}
+
+
+def _coll(rank, step, ts, key):
+    return {"kind": "event", "event": "collective", "rank": rank,
+            "step": step, "ts": ts, "op": "barrier", "key": key,
+            "dur_ms": 1.0}
+
+
+def _telemetry_dir(tmp_path, skew=5.0):
+    """Two ranks, rank 1's wall clock `skew` seconds AHEAD; shared
+    barrier keys anchor the correction."""
+    d = tmp_path / "telemetry"
+    d.mkdir()
+    r0 = [_step(0, 1, 100.0), _coll(0, 1, 100.01, "barrier#1"),
+          _step(0, 2, 101.0), _coll(0, 2, 101.01, "barrier#2")]
+    r1 = [_step(1, 1, 100.0 + skew),
+          _coll(1, 1, 100.01 + skew, "barrier#1"),
+          _step(1, 2, 101.0 + skew),
+          _coll(1, 2, 101.01 + skew, "barrier#2")]
+    _write_jsonl(d / "telemetry.rank0.jsonl", r0)
+    _write_jsonl(d / "telemetry.rank1.jsonl", r1)
+    return str(d)
+
+
+def test_clock_offsets_from_barrier_anchors(tmp_path):
+    from paddle_tpu.observability.aggregate import load_telemetry_dir
+
+    by_rank = load_telemetry_dir(_telemetry_dir(tmp_path, skew=5.0))
+    offs = timeline.clock_offsets(by_rank)
+    assert offs[0] == 0.0
+    # rank 1 reads 5s ahead; the correction shifts it back
+    assert abs(offs[1] - (-5.0)) < 1e-6
+    # a rank sharing no keys with the reference: offset 0, not a crash
+    by_rank[2] = [_coll(2, 1, 50.0, "other#1")]
+    assert timeline.clock_offsets(by_rank)[2] == 0.0
+
+
+def test_telemetry_lane_events_shapes():
+    evs = timeline.telemetry_lane_events(
+        [_step(0, 1, 100.0, total_ms=20.0),
+         _coll(0, 1, 100.05, "barrier#1"),
+         {"kind": "event", "event": "fault", "rank": 0, "step": 1,
+          "ts": 100.06, "fault": "kill"}], offset_s=-5.0)
+    step_ev = next(e for e in evs if e["name"] == "step")
+    assert step_ev["ph"] == "X" and step_ev["dur"] == 20e3
+    assert step_ev["ts"] == (100.0 - 5.0) * 1e6
+    assert step_ev["args"]["total_ms"] == 20.0
+    coll = next(e for e in evs if e["name"] == "collective/barrier")
+    assert coll["ph"] == "X" and coll["dur"] == 1e3
+    # the recorded ts is the COMPLETION instant: span ends there
+    assert abs((coll["ts"] + coll["dur"]) - (100.05 - 5.0) * 1e6) < 1
+    fault = next(e for e in evs if e["name"] == "fault")
+    assert fault["ph"] == "i"  # no duration: instant marker
+
+
+def test_cli_merges_telemetry_without_profiles(tmp_path):
+    d = _telemetry_dir(tmp_path, skew=2.0)
+    out = tmp_path / "merged.json"
+    rc = timeline.main(["--telemetry", d,
+                        "--timeline_path", str(out)])
+    assert rc == 0
+    data = json.load(open(out))
+    lanes = {e["args"]["name"] for e in data["traceEvents"]
+             if e["name"] == "process_name"}
+    assert lanes == {"telemetry-rank0", "telemetry-rank1"}
+    # clock correction: the two ranks' step-1 events land at the SAME
+    # corrected instant despite the 2s file skew
+    t0, t1 = [next(e["ts"] for e in data["traceEvents"]
+                   if e.get("name") == "step"
+                   and e.get("args", {}).get("rank") == r
+                   and e["args"]["step"] == 1) for r in (0, 1)]
+    assert abs(t0 - t1) < 1e3  # < 1ms after correcting a 2s skew
+    # and both lane kinds coexist with --profile_path inputs
+    prof = tmp_path / "p0.json"
+    with open(prof, "w") as f:
+        json.dump(_trace(["fc"]), f)
+    rc = timeline.main(["--profile_path", "t0=%s" % prof,
+                        "--telemetry", d,
+                        "--timeline_path", str(out)])
+    assert rc == 0
+    data = json.load(open(out))
+    lanes = {e["args"]["name"] for e in data["traceEvents"]
+             if e["name"] == "process_name"}
+    assert lanes == {"t0", "telemetry-rank0", "telemetry-rank1"}
+
+
+def test_cli_requires_some_input(tmp_path, capsys):
+    with pytest.raises(SystemExit):
+        timeline.main(["--timeline_path", str(tmp_path / "o.json")])
+
+
 @pytest.mark.slow  # ~14s (spins the real profiler twice); the pure
 # merge logic above covers the default run
 def test_cli_merges_real_profiler_output(tmp_path):

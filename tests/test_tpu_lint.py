@@ -119,6 +119,58 @@ def test_cross_rank_divergence_trips_with_location():
     assert "<end of schedule>" in fs[0].message
 
 
+def _program_with_barrier(group_world, group_ranks, nranks=None):
+    """A transpiled DP program plus one host-tier barrier whose
+    HostCollectiveGroup membership lives in op attrs."""
+    p, loss = _transpiled_program()
+    g = p.global_block()
+    attrs = {"ring_id": 0, "group_world": group_world,
+             "group_ranks": list(group_ranks)}
+    if nranks is not None:
+        attrs["nranks"] = nranks
+    g.ops.append(Operator(g, "barrier", inputs={"X": [loss.name]},
+                          outputs={}, attrs=attrs))
+    return p
+
+
+def test_divergent_host_group_membership_trips():
+    """Seeded defect: two ranks agree on every opcode/dtype/shape AND
+    ring_id, but the HostCollectiveGroup behind the barrier spans 2
+    ranks on one and 3 on the other — rank 0 waits forever on the
+    phantom member. ring_id-only comparison called this clean (the
+    carried-over false negative); membership modeling must trip it."""
+    p0 = _program_with_barrier(2, [0, 1])
+    p1 = _program_with_barrier(3, [0, 1, 2])
+    fs = analysis.check_collective_divergence([p0, p1],
+                                              labels=["r0", "r1"])
+    assert len(fs) == 1
+    f = fs[0]
+    assert f.severity == "error" and f.checker == "collective-divergence"
+    assert f.rank == "r1" and f.op_type == "barrier"
+    # identical membership: clean
+    assert not analysis.check_collective_divergence(
+        [p0, _program_with_barrier(2, [0, 1])])
+    # membership signature is part of the schedule record itself
+    rec = analysis.collective_schedule(p0)[-1]
+    assert rec["kind"] == "barrier"
+    assert ("world", 2) in rec["group"] and \
+        ("ranks", (0, 1)) in rec["group"]
+
+
+def test_divergent_nranks_membership_trips():
+    """Same ring_id, different `nranks` on a sized device collective
+    (a c_allgather transpiled against different world sizes) must
+    diverge too; ops without any membership attrs keep the
+    pre-existing ring_id-only behavior (group=None)."""
+    p0 = _program_with_barrier(2, [0, 1], nranks=2)
+    p1 = _program_with_barrier(2, [0, 1], nranks=4)
+    fs = analysis.check_collective_divergence([p0, p1])
+    assert len(fs) == 1 and fs[0].severity == "error"
+    plain, _ = _transpiled_program()
+    assert all(r["group"] is None
+               for r in analysis.collective_schedule(plain))
+
+
 def test_branch_collective_divergence():
     from paddle_tpu.fluid.layers.collective import _c_allreduce
 

@@ -16,6 +16,18 @@ Usage: python tools/perf_analysis.py [--batches 256,512]
        python tools/perf_analysis.py --sharded-diff
        python tools/perf_analysis.py --overlap-audit [--bucket-mb 0.25]
        python tools/perf_analysis.py --lint [tpu_lint args...]
+       python tools/perf_analysis.py --stragglers \
+           --telemetry-dir DIR [--window 32]
+
+`--stragglers` is the offline cross-rank straggler analysis over the
+per-rank telemetry JSONL a run wrote (paddle_tpu/observability;
+FLAGS_tpu_telemetry_dir): step records are aligned by step number
+across ranks, each --window-step window names its slowest rank, and
+the report ends with the overall offender + per-phase min/mean/max —
+the "which host is dragging the pod" answer 1909.09756 calls the
+dominant debugging cost at scale. Exits 0 with the report on stdout
+(JSON after the human lines); exits 2 when the dir has fewer than 2
+ranks of step records.
 
 `--lint` is a thin alias onto tools/tpu_lint.py (the tpu-lint static
 SPMD verifier, paddle_tpu/analysis) so one tool drives every audit:
@@ -401,10 +413,73 @@ def overlap_audit(bucket_mb=0.25, batch=16, seq_len=32):
     return 0 if ok else 1
 
 
+def stragglers(telemetry_dir, window=32):
+    """Offline straggler report over a telemetry dir's per-rank JSONL
+    (see module docstring). Returns the process exit code."""
+    import json
+
+    from paddle_tpu.observability import aggregate
+
+    by_rank = aggregate.load_telemetry_dir(telemetry_dir)
+    steps = {r: sum(1 for rec in recs if rec.get("kind") == "step")
+             for r, recs in by_rank.items()}
+    print("telemetry dir %s: %d rank(s), step records per rank: %s"
+          % (telemetry_dir, len(by_rank),
+             {r: n for r, n in sorted(steps.items())}))
+    report = aggregate.straggler_report(by_rank, window=window)
+    if report["ranks"] < 2:
+        print("need >= 2 ranks of step records for a cross-rank "
+              "straggler report")
+        return 2
+    for w in report["windows"]:
+        print("steps %d..%d: slowest rank %d (%.2fms/step mean, "
+              "+%.2fms vs rank %d)"
+              % (w["steps"][0], w["steps"][1], w["slowest_rank"],
+                 w["slowest_total_ms_mean"], w["slack_ms"],
+                 w["fastest_rank"]))
+    print("straggler: rank %s (slowest in %d/%d windows)"
+          % (report["straggler"], report["by_rank"].get(
+              report["straggler"], 0), len(report["windows"])))
+    # cross-rank per-phase spread over the whole run's step records
+    summaries = [aggregate.window_summary(records=[
+        rec for rec in recs if rec.get("kind") == "step"])
+        for recs in by_rank.values()]
+    agg = aggregate.aggregate_summaries(summaries)
+    print(json.dumps({"stragglers": report, "cross_rank": agg},
+                     indent=1, sort_keys=True))
+    return 0
+
+
 def main():
     batches = [256, 512]
     resnet_batches = [128, 256]
     args = sys.argv[1:]
+    if "--stragglers" in args:
+        tdir, window = None, 32
+        rest = [a for a in args if a != "--stragglers"]
+        i = 0
+        while i < len(rest):
+            a = rest[i]
+            if "=" in a:
+                flag, val = a.split("=", 1)
+            else:
+                flag = a
+                val = rest[i + 1] if i + 1 < len(rest) else ""
+                if not val or val.startswith("--"):
+                    raise SystemExit("flag %s needs a value" % flag)
+                i += 1
+            if flag == "--telemetry-dir":
+                tdir = val
+            elif flag == "--window":
+                window = int(val)
+            else:
+                raise SystemExit("unknown --stragglers argument: %s"
+                                 % flag)
+            i += 1
+        if not tdir:
+            raise SystemExit(
+                "usage: --stragglers --telemetry-dir DIR [--window N]")
+        raise SystemExit(stragglers(tdir, window=window))
     if "--lint" in args:
         # alias into the tpu-lint static verifier; tools/ is not a
         # package, so import by path alongside this file

@@ -45,6 +45,11 @@ _MODULES = [
     # tpu-lint static verifier: checkers + Finding are a public,
     # CI-relied-on surface (tools/tpu_lint.py, FLAGS_tpu_static_checks)
     "paddle_tpu.analysis",
+    # unified telemetry: registry / flight recorder / aggregation /
+    # capture are relied on by bench.py, tools/perf_analysis.py
+    # --stragglers, tools/timeline.py --telemetry and the launcher's
+    # postmortem collection — lock the surface
+    "paddle_tpu.observability",
     # AMP: decorate()/master-weight rewrites are the bench's and the
     # perf-analysis tooling's entry into mixed precision — lock them
     "paddle_tpu.fluid.contrib.mixed_precision",

@@ -10,17 +10,33 @@ merge — each input becomes its own process lane (stable pid + a
 process_name metadata event) so N trainers' steps line up on one
 timeline in chrome://tracing or Perfetto.
 
+It also merges the observability telemetry stream
+(paddle_tpu/observability, FLAGS_tpu_telemetry_dir): `--telemetry DIR`
+reads the per-rank `telemetry.rank<R>.jsonl` files and adds one lane
+per rank — step records as duration events (per-step phase breakdown in
+args), collective/rpc/fault/checkpoint events as duration or instant
+events. Per-rank wall clocks are OFFSET-CORRECTED before merging:
+host-collective completions carry a cross-rank `key` (ranks leave
+barrier/gather N at ~the same instant), so the median per-key delta
+against the reference rank aligns the lanes even when hosts' clocks
+drift (`clock_offsets`).
+
 Usage:
     python tools/timeline.py \
         --profile_path trainer0=/tmp/p0/paddle_tpu_trace.json,\
 trainer1=/tmp/p1/paddle_tpu_trace.json \
+        [--telemetry /tmp/run/telemetry] \
         --timeline_path /tmp/merged.json
 """
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 
 def parse_profile_spec(spec: str):
@@ -93,19 +109,109 @@ def merge_traces(named_traces):
     return {"traceEvents": merged, "displayTimeUnit": "ms"}
 
 
+# ---------------------------------------------------------------------------
+# telemetry JSONL lanes (paddle_tpu/observability sink)
+# ---------------------------------------------------------------------------
+
+def clock_offsets(by_rank):
+    """{rank: offset_seconds} aligning each rank's wall clock to the
+    reference (lowest) rank. Anchors: "collective" events — the store
+    releases a gather to every rank at once, so the SAME `key`
+    completes at ~the same instant on every rank; the median per-key
+    delta is robust to the odd slow release. Ranks sharing no keys
+    with the reference get offset 0."""
+    def anchors(recs):
+        return {r["key"]: float(r["ts"]) for r in recs
+                if r.get("kind") == "event"
+                and r.get("event") == "collective" and r.get("key")}
+
+    if not by_rank:
+        return {}
+    ref_rank = min(by_rank)
+    ref = anchors(by_rank[ref_rank])
+    out = {}
+    for rank, recs in by_rank.items():
+        if rank == ref_rank:
+            out[rank] = 0.0
+            continue
+        deltas = sorted(ref[k] - t for k, t in anchors(recs).items()
+                        if k in ref)
+        out[rank] = deltas[len(deltas) // 2] if deltas else 0.0
+    return out
+
+
+def telemetry_lane_events(records, offset_s=0.0):
+    """One rank's JSONL records -> chrome-trace events (ts in us,
+    clock-corrected). Steps become duration events spanning the step's
+    wall time with the phase split in args; events with a duration
+    (collectives) are spans, the rest are instants."""
+    evs = []
+    for rec in records:
+        ts_us = (float(rec.get("ts", 0.0)) + offset_s) * 1e6
+        if rec.get("kind") == "step":
+            dur = float(rec.get("total_ms", 0.0)) * 1e3
+            evs.append({"name": "step", "ph": "X", "pid": 0, "tid": 0,
+                        "ts": ts_us, "dur": max(dur, 1.0),
+                        "cat": "telemetry",
+                        "args": {k: v for k, v in rec.items()
+                                 if k not in ("kind", "ts")}})
+        elif rec.get("kind") == "event":
+            name = rec.get("event", "event")
+            for detail in ("op", "method", "action"):
+                if rec.get(detail):
+                    name = "%s/%s" % (name, rec[detail])
+                    break
+            args = {k: v for k, v in rec.items()
+                    if k not in ("kind", "ts")}
+            dur_ms = rec.get("dur_ms")
+            if isinstance(dur_ms, (int, float)) and dur_ms > 0:
+                # the recorded ts is the COMPLETION instant
+                evs.append({"name": name, "ph": "X", "pid": 0,
+                            "tid": 1, "ts": ts_us - dur_ms * 1e3,
+                            "dur": dur_ms * 1e3, "cat": "telemetry",
+                            "args": args})
+            else:
+                evs.append({"name": name, "ph": "i", "pid": 0,
+                            "tid": 1, "ts": ts_us, "s": "t",
+                            "cat": "telemetry", "args": args})
+    return evs
+
+
+def telemetry_lanes(telemetry_dir):
+    """[(lane_name, trace_dict)] — one clock-corrected lane per rank,
+    ready for merge_traces alongside --profile_path lanes."""
+    from paddle_tpu.observability.aggregate import load_telemetry_dir
+
+    by_rank = load_telemetry_dir(telemetry_dir)
+    offsets = clock_offsets(by_rank)
+    return [("telemetry-rank%d" % rank,
+             {"traceEvents": telemetry_lane_events(
+                 recs, offsets.get(rank, 0.0))})
+            for rank, recs in sorted(by_rank.items())]
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--profile_path", type=str, required=True,
+    ap.add_argument("--profile_path", type=str, default=None,
                     help="name=file[,name=file...] chrome-trace JSONs "
                          "written by paddle_tpu's profiler")
+    ap.add_argument("--telemetry", type=str, default=None,
+                    help="telemetry dir (FLAGS_tpu_telemetry_dir) whose "
+                         "per-rank JSONL streams merge in as extra "
+                         "lanes, clock-offset-corrected")
     ap.add_argument("--timeline_path", type=str, required=True,
                     help="output merged chrome-trace JSON")
     args = ap.parse_args(argv)
+    if not args.profile_path and not args.telemetry:
+        ap.error("need --profile_path and/or --telemetry")
 
     named = []
-    for name, path in parse_profile_spec(args.profile_path):
-        with open(path) as f:
-            named.append((name, json.load(f)))
+    if args.profile_path:
+        for name, path in parse_profile_spec(args.profile_path):
+            with open(path) as f:
+                named.append((name, json.load(f)))
+    if args.telemetry:
+        named.extend(telemetry_lanes(args.telemetry))
     out = merge_traces(named)
     with open(args.timeline_path, "w") as f:
         json.dump(out, f)
