@@ -24,6 +24,14 @@ Injection points (where rpc.py calls back into this module):
                              (the method is not parsed yet at this
                              point, so `method=` filters never match
                              server/recv — filter by side/point only)
+    side=ckpt   point=write  inside a checkpoint save, after the
+                             payload is written but BEFORE the step is
+                             published (fluid publish_checkpoint_dir's
+                             tmp-dir; ShardedCheckpointManager.save's
+                             uncommitted orbax step) — a kill here is a
+                             preemption mid-save, the newest-intact
+                             restore fallback's worst case
+                             (method= fluid_publish | sharded_save)
 
 Faults fire deterministically on a per-injector event counter filtered
 by side/point/method: `every=N` fires on every Nth matching event,

@@ -225,6 +225,28 @@ class _StoreState:
                 return [len(self._kv), len(self._counts),
                         len(self._fetched)]
         if method == "hc_shutdown":
+            # don't tear the store down under ranks still DRAINING
+            # their last collective: a rank whose response was dropped
+            # mid-read (injected or real) retries the fetch, and the
+            # dedup replay needs the store alive — rank 0 finishing
+            # first must not turn that retry into ConnectionRefused.
+            # Wait (bounded) until every rank left cleanly or went
+            # heartbeat-stale; crashed ranks never hold shutdown
+            # hostage.
+            with self._cv:
+                deadline = time.monotonic() + min(10.0, self.timeout_s)
+
+                def _drained():
+                    now = time.monotonic()
+                    return all(
+                        r in self._left
+                        or now - self._beats.get(r, now)
+                        > (self.liveness_s if r in self._seen
+                           else self.join_s)
+                        for r in range(self.world))
+
+                while not _drained() and time.monotonic() < deadline:
+                    self._cv.wait(timeout=0.2)
             raise _Stop()
         raise ValueError("unknown host-collective method %r" % method)
 

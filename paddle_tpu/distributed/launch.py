@@ -21,6 +21,23 @@ exception):
     the latest intact checkpoint. PADDLE_RESTART_NUM carries the attempt
     number into the workers. Log files reopen in append mode across
     restarts so no attempt's output is lost;
+  - `--min_ranks M` makes those restarts ELASTIC: when a worker dies
+    for good, the surviving cohort relaunches at the SMALLER world size
+    N' (>= M) instead of requiring all N back — failed endpoints drop
+    out, survivors get contiguous ranks 0..N'-1, and the rendezvous
+    (host-collective store on endpoints[0] port+1, PS barriers, device
+    mesh) rebuilds from the fresh PADDLE_* env. Restore then re-shards
+    everything laid out P(dp) over N: checkpoints hold LOGICAL shapes
+    (parallel/sharded_update.unshard_scope_value), so the resumed
+    cohort's executor re-pads/re-shards ZeRO-1 moments, ZeRO-2 bucket
+    plans and AMP fp32 masters for N' (bit-identical to a replicated
+    update at any world size), and reader.resharding recomputes the
+    per-rank sample assignment. Each transition lands an
+    `elastic_transition` telemetry event (old/new world, reassignment
+    map, recovery wall time) in <telemetry_dir>/telemetry.supervisor.jsonl.
+    Elastic shrink needs the supervisor to own the whole cohort (the
+    all-localhost multi-endpoint mode); per-host launchers fall back to
+    fixed-world restarts;
   - SIGINT and SIGTERM both tear the cohort down (exit 128+signum);
   - supervised workers default PADDLE_CKPT_AGREE=1: multi-host
     checkpoint restore agrees cross-rank on the newest step EVERY rank
@@ -62,6 +79,11 @@ def _parse_args(argv):
                    help="restart the whole cohort up to N times after a "
                         "worker failure (composes with elastic "
                         "checkpoint-resume)")
+    p.add_argument("--min_ranks", type=int, default=0,
+                   help="elastic world-size policy: a restart may drop "
+                        "dead workers and relaunch the survivors at any "
+                        "world size >= M (0 = fixed world: all N must "
+                        "come back)")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -146,7 +168,86 @@ def _collect_flight_dumps(args, attempt):
         sys.stderr.write(
             "paddle_tpu.launch: collected %d flight-recorder dump(s) "
             "into %s\n" % (len(collected), dest))
+    _write_postmortem_index(os.path.join(dest_root, "postmortem"))
     return collected
+
+
+def _write_postmortem_index(pm_root):
+    """Refresh <log_dir>/postmortem/index.json: one entry per per-rank
+    flight dump across EVERY attempt (attempt, rank, reason, fatal
+    event, last recorded step), newest attempt first — so a
+    multi-restart failure is triaged from one file instead of N x K
+    dumps (ROADMAP carried-over observability item). Written atomically;
+    unreadable dumps get an "error" entry rather than poisoning the
+    index."""
+    import json
+    import re
+
+    if not os.path.isdir(pm_root):
+        return None
+    att_re = re.compile(r"^attempt(\d+)$")
+    dump_re = re.compile(r"^flightrec\.rank(\d+)\.json$")
+    dumps = []
+    for aname in sorted(os.listdir(pm_root)):
+        m = att_re.match(aname)
+        if not m:
+            continue
+        attempt = int(m.group(1))
+        adir = os.path.join(pm_root, aname)
+        for fname in sorted(os.listdir(adir)):
+            dm = dump_re.match(fname)
+            if not dm:
+                continue
+            entry = {"attempt": attempt, "rank": int(dm.group(1)),
+                     "path": os.path.join(aname, fname)}
+            try:
+                with open(os.path.join(adir, fname)) as f:
+                    doc = json.load(f)
+                entry["reason"] = doc.get("reason")
+                entry["fatal_event"] = doc.get("fatal_event")
+                entry["n_steps"] = doc.get("n_steps")
+                steps = doc.get("steps") or []
+                entry["last_step"] = steps[-1].get("step") if steps \
+                    else None
+            except (OSError, ValueError) as e:
+                entry["error"] = "%s: %s" % (type(e).__name__, e)
+            dumps.append(entry)
+    dumps.sort(key=lambda d: (-d["attempt"], d["rank"]))
+    index = {"attempts": 1 + max((d["attempt"] for d in dumps),
+                                 default=-1),
+             "dumps": dumps}
+    path = os.path.join(pm_root, "index.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(index, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def _supervisor_event(args, etype, **fields):
+    """Append one telemetry event record to the supervisor's OWN stream
+    (<telemetry_dir>/telemetry.supervisor.jsonl, same "event" schema as
+    the workers' registry sink — tools/telemetry_schema.json). Written
+    directly rather than through observability.registry: the supervisor
+    must stay a subprocess babysitter and not import the jax stack. The
+    stream is NOT collected into postmortem/ between attempts — it is
+    the one place the whole run's elastic seams live."""
+    import json
+
+    tdir = _telemetry_dir_for(args)
+    if not tdir:
+        return None
+    rec = {"kind": "event", "event": str(etype), "rank": -1, "step": 0,
+           "ts": time.time()}
+    rec.update(fields)
+    try:
+        os.makedirs(tdir, exist_ok=True)
+        with open(os.path.join(tdir, "telemetry.supervisor.jsonl"),
+                  "a") as f:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+    except OSError:
+        return None
+    return rec
 
 
 def _spawn_cohort(args, endpoints, local_ids, restart_no):
@@ -193,13 +294,17 @@ def _terminate_all(procs, grace_s=10.0):
 
 
 def _supervise(procs, local_ids, stop_sig):
-    """Poll until all workers exit or one fails. Returns the first
-    non-zero return code (lowest trainer id among the failures seen in
-    the poll cycle that detected the fault), or 0."""
+    """Poll until all workers exit or one fails. Returns (rc,
+    failed_tids): rc is the first non-zero return code (lowest trainer
+    id among the failures seen in the poll cycle that detected the
+    fault), 0 on clean completion; failed_tids names the workers that
+    died ON THEIR OWN in that cycle — the elastic policy treats them as
+    lost machines (survivors are terminated by the fail-fast teardown
+    and are NOT in the list)."""
     while True:
         if stop_sig["sig"] is not None:
             _terminate_all(procs)
-            return 128 + stop_sig["sig"]
+            return 128 + stop_sig["sig"], []
         failed = [(tid, p.returncode) for tid, p in zip(local_ids, procs)
                   if p.poll() is not None and p.returncode != 0]
         if failed:
@@ -213,28 +318,28 @@ def _supervise(procs, local_ids, stop_sig):
                 "paddle_tpu.launch: worker %d exited with %d; "
                 "terminating cohort\n" % (bad_tid, bad_rc))
             _terminate_all(procs)
-            return bad_rc
+            return bad_rc, [tid for tid, _ in failed]
         if all(p.poll() is not None for p in procs):
-            return 0
+            return 0, []
         time.sleep(0.1)
+
+
+def _owns_whole_cohort(args, endpoints):
+    """True when THIS launcher supervises every worker (the
+    all-localhost multi-endpoint test/dev mode) — the precondition for
+    elastic world-size shrink: a per-host launcher only sees its own
+    workers and cannot reassign the global rank set."""
+    return args.host_id is None and len(endpoints) > 1 and all(
+        e.split(":")[0] in ("127.0.0.1", "localhost") for e in endpoints)
 
 
 def launch(argv=None):
     args = _parse_args(argv if argv is not None else sys.argv[1:])
     endpoints = args.hosts.split(",")
-    nhosts = len(endpoints)
     host_id = args.host_id if args.host_id is not None else 0
 
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
-
-    # On a single-host invocation with multiple endpoints we spawn them all
-    # locally (test/dev mode, mirrors multi-process-on-localhost testing —
-    # SURVEY.md §4.5). On real clusters each host runs launch with its
-    # --host_id.
-    local_ids = list(range(nhosts)) if args.host_id is None and \
-        nhosts > 1 and all(e.split(":")[0] in ("127.0.0.1", "localhost")
-                           for e in endpoints) else [host_id]
 
     stop_sig = {"sig": None}
     live_procs = []
@@ -251,26 +356,81 @@ def launch(argv=None):
     signal.signal(signal.SIGTERM, _sig)
     signal.signal(signal.SIGINT, _sig)
 
+    if args.min_ranks > 0 and not _owns_whole_cohort(args, endpoints):
+        sys.stderr.write(
+            "paddle_tpu.launch: --min_ranks needs the supervisor to own "
+            "the whole cohort (all-localhost endpoints, no --host_id); "
+            "falling back to fixed-world restarts\n")
+
+    max_r = max(args.max_restarts, 0)
     rc = 0
-    for attempt in range(max(args.max_restarts, 0) + 1):
+    pending_evt, t_fail = None, None
+    for attempt in range(max_r + 1):
+        # On a single-host invocation with multiple endpoints we spawn
+        # them all locally (test/dev mode, mirrors
+        # multi-process-on-localhost testing — SURVEY.md §4.5). On real
+        # clusters each host runs launch with its --host_id.
+        # Recomputed per attempt: an elastic shrink changes the world.
+        local_ids = list(range(len(endpoints))) \
+            if _owns_whole_cohort(args, endpoints) else [host_id]
         procs, logs = _spawn_cohort(args, endpoints, local_ids, attempt)
+        if pending_evt is not None:
+            # recovery wall time = failure detection -> shrunk cohort
+            # respawned (the workers' own restore/re-compile time shows
+            # up in their step records, stitched by the seam event)
+            pending_evt["recovery_s"] = round(
+                time.monotonic() - t_fail, 4)
+            _supervisor_event(args, "elastic_transition", **pending_evt)
+            pending_evt = None
         live_procs[:] = procs
         try:
-            rc = _supervise(procs, local_ids, stop_sig)
+            rc, failed_tids = _supervise(procs, local_ids, stop_sig)
         finally:
             for f in logs:
                 if f:
                     f.close()
         if rc == 0 or stop_sig["sig"] is not None:
             break
+        t_fail = time.monotonic()
         # secure this attempt's per-rank flight-recorder dumps before
         # the restarted cohort overwrites them (and keep the final
         # failed attempt's evidence too when restarts are exhausted)
         _collect_flight_dumps(args, attempt)
-        if attempt < max(args.max_restarts, 0):
-            sys.stderr.write(
-                "paddle_tpu.launch: cohort failed (rc=%d); restart "
-                "%d/%d\n" % (rc, attempt + 1, args.max_restarts))
+        if attempt >= max_r:
+            break
+        if args.min_ranks > 0 and failed_tids \
+                and _owns_whole_cohort(args, endpoints):
+            survivors = [ep for tid, ep in enumerate(endpoints)
+                         if tid not in set(failed_tids)]
+            if len(survivors) < args.min_ranks:
+                sys.stderr.write(
+                    "paddle_tpu.launch: only %d endpoint(s) left after "
+                    "dropping ranks %s — below --min_ranks %d; giving "
+                    "up\n" % (len(survivors), sorted(failed_tids),
+                              args.min_ranks))
+                break
+            if len(survivors) < len(endpoints):
+                reassignment = {
+                    old: new for new, old in enumerate(
+                        tid for tid in range(len(endpoints))
+                        if tid not in set(failed_tids))}
+                pending_evt = dict(
+                    old_world=len(endpoints),
+                    new_world=len(survivors),
+                    failed_ranks=sorted(failed_tids),
+                    reassignment={str(o): n
+                                  for o, n in reassignment.items()},
+                    attempt=attempt + 1)
+                sys.stderr.write(
+                    "paddle_tpu.launch: elastic shrink %d -> %d ranks "
+                    "(dropped %s; reassignment %s)\n"
+                    % (len(endpoints), len(survivors),
+                       sorted(failed_tids),
+                       {o: n for o, n in sorted(reassignment.items())}))
+                endpoints = survivors
+        sys.stderr.write(
+            "paddle_tpu.launch: cohort failed (rc=%d); restart "
+            "%d/%d\n" % (rc, attempt + 1, args.max_restarts))
     sys.exit(rc)
 
 

@@ -8,6 +8,15 @@ fleet.PaddleCloudRoleMaker(is_collective=False) reads.
 Usage: python -m paddle_tpu.distributed.launch_ps \
            --server_num 2 --worker_num 2 train.py [args...]
        (or explicit --servers host:port,host:port --workers ...)
+
+Server-role supervision (`--max_restarts N`, composing with the pserver
+checkpoint/restore in distributed/ps.py): a pserver that dies mid-run
+is restarted IN PLACE on its original endpoint up to N times while the
+trainers keep running — their RPC clients retry with jittered backoff
+and reconnect to the reborn server, whose tables + dedup markers come
+back from the newest intact snapshot (PADDLE_PS_CKPT_DIR, exported
+per-server as <--ps_ckpt_dir>/server<i>), so retried requests are never
+double-applied. PADDLE_RESTART_NUM carries the server's attempt number.
 """
 from __future__ import annotations
 
@@ -35,6 +44,13 @@ def _parse_args(argv):
     p.add_argument("--workers", type=str, default="",
                    help="comma-separated trainer host:port list")
     p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="restart a dead pserver in place up to N times "
+                        "(trainers keep running; composes with "
+                        "--ps_ckpt_dir table/dedup restore)")
+    p.add_argument("--ps_ckpt_dir", type=str, default=None,
+                   help="root for per-server state snapshots; exported "
+                        "as PADDLE_PS_CKPT_DIR=<dir>/server<i>")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -50,6 +66,17 @@ def launch(argv=None):
     if not workers:
         workers = ["127.0.0.1:%d" % _free_port()
                    for _ in range(args.worker_num or 2)]
+
+    if args.max_restarts > 0 and not args.ps_ckpt_dir \
+            and not os.environ.get("PADDLE_PS_CKPT_DIR"):
+        # a restarted stateless pserver reboots with EMPTY tables and a
+        # fresh dedup table while the trainers keep running — silent
+        # state loss. Restart supervision without snapshots is almost
+        # certainly a mistake; refuse to be quiet about it.
+        sys.stderr.write(
+            "paddle_tpu.launch_ps: WARNING --max_restarts without "
+            "--ps_ckpt_dir/PADDLE_PS_CKPT_DIR: a restarted pserver "
+            "loses its tables, pending grads and dedup markers\n")
 
     base = dict(os.environ)
     base["PADDLE_PSERVERS_IP_PORT_LIST"] = ",".join(servers)
@@ -67,16 +94,31 @@ def launch(argv=None):
     procs = []
     cmd = [sys.executable, args.training_script] \
         + args.training_script_args
-    for i, ep in enumerate(servers):
+
+    def server_env(i, ep, restart_no=0):
         env = dict(base)
         env["TRAINING_ROLE"] = "PSERVER"
         ip, port = ep.rsplit(":", 1)
         env["POD_IP"] = ip
         env["PADDLE_PORT"] = port
         env["PADDLE_CURRENT_ENDPOINT"] = ep
-        f = out("serverlog.%d" % i)
-        procs.append((subprocess.Popen(cmd, env=env, stdout=f,
-                                       stderr=f), f))
+        env["PADDLE_RESTART_NUM"] = str(restart_no)
+        if args.ps_ckpt_dir:
+            env["PADDLE_PS_CKPT_DIR"] = os.path.join(
+                args.ps_ckpt_dir, "server%d" % i)
+        return env
+
+    def spawn_server(i, ep, restart_no=0):
+        # append across restarts: attempt 0's tail is the evidence for
+        # WHY the server restarted
+        f = out("serverlog.%d" % i) if restart_no == 0 else (
+            open(os.path.join(args.log_dir, "serverlog.%d.log" % i),
+                 "a") if args.log_dir else None)
+        return (subprocess.Popen(cmd, env=server_env(i, ep, restart_no),
+                                 stdout=f, stderr=f), f)
+
+    for i, ep in enumerate(servers):
+        procs.append(spawn_server(i, ep))
     for i, ep in enumerate(workers):
         env = dict(base)
         env["TRAINING_ROLE"] = "TRAINER"
@@ -86,10 +128,34 @@ def launch(argv=None):
         procs.append((subprocess.Popen(cmd, env=env, stdout=f,
                                        stderr=f), f))
 
+    restarts_left = [max(args.max_restarts, 0)] * len(servers)
     rc = 0
     try:
-        # trainers finishing ends the job; pservers are then reaped
-        for p, _ in procs[len(servers):]:
+        # trainers finishing ends the job; pservers are then reaped.
+        # While trainers run, a pserver that dies is restarted in place
+        # (same endpoint, bumped PADDLE_RESTART_NUM) while the trainer
+        # RPC clients retry against the endpoint with jittered backoff.
+        import time as _time
+
+        trainer_procs = [p for p, _ in procs[len(servers):]]
+        while any(p.poll() is None for p in trainer_procs):
+            for i in range(len(servers)):
+                p, f = procs[i]
+                if p.poll() is None or p.returncode == 0 \
+                        or restarts_left[i] <= 0:
+                    continue
+                restarts_left[i] -= 1
+                attempt = max(args.max_restarts, 0) - restarts_left[i]
+                sys.stderr.write(
+                    "paddle_tpu.launch_ps: pserver %d exited with %d; "
+                    "restart %d/%d\n" % (i, p.returncode, attempt,
+                                         max(args.max_restarts, 0)))
+                if f:
+                    f.close()
+                procs[i] = spawn_server(i, servers[i],
+                                        restart_no=attempt)
+            _time.sleep(0.1)
+        for p in trainer_procs:
             rc = p.wait() or rc
     finally:
         # grace window before reaping: a pserver that is already

@@ -23,15 +23,33 @@ applied to the tables exactly once, and a retried `send_barrier` never
 double-arrives at the sync barrier. The barrier itself is bounded by
 PADDLE_PS_BARRIER_TIMEOUT_S and reports heartbeat-lost trainers instead
 of hanging forever on a dead worker.
+
+Server-role checkpoint/restore (PADDLE_PS_CKPT_DIR; the trainer role
+got this in PR 1): with a checkpoint dir set, the server persists its
+tables + pending (un-applied) grads + per-client applied-seq dedup
+markers ATOMICALLY after every PADDLE_PS_CKPT_EVERY-th state mutation,
+and `listen_and_serv` restores the newest intact snapshot on startup.
+Because the marker for a request is persisted in the same atomic write
+as the mutation it acknowledges — and BEFORE the response leaves the
+server — a trainer's retry after a server death+restart is answered
+from the restored marker instead of being re-applied: exactly-once
+survives the server role dying, not just the wire dropping. The launch
+supervisors (launch_ps --max_restarts) restart a dead pserver in place
+while the trainers' RPC clients retry with jittered backoff.
+PADDLE_PS_CKPT_EVERY > 1 trades that exactness for less write traffic
+(a crash may then replay up to N-1 mutations).
 """
 from __future__ import annotations
 
+import os
+import pickle
 import threading
 from typing import Dict, List, Optional
 
 import numpy as np
 
-from .rpc import RpcClient, RpcServer, _Stop
+from .rpc import (RpcClient, RpcServer, _Stop, current_request_ctx,
+                  decode as _rpc_decode, encode as _rpc_encode)
 
 
 class PSCommunicator:
@@ -361,9 +379,15 @@ class HeartBeatMonitor:
 
 
 class ParameterServer:
-    """listen_and_serv state: tables + aggregation + update execution."""
+    """listen_and_serv state: tables + aggregation + update execution.
 
-    def __init__(self, pserver_prog, startup_prog, trainers, mode):
+    With `ckpt_dir` set, every state mutation (or every `ckpt_every`-th
+    one) atomically persists tables + pending grads + applied-seq dedup
+    markers, and a restarted server restores the newest intact snapshot
+    — see the module docstring for the exactly-once argument."""
+
+    def __init__(self, pserver_prog, startup_prog, trainers, mode,
+                 ckpt_dir=None, ckpt_every=1):
         from ..core.scope import Scope
         from ..fluid.executor import Executor
         from ..fluid.framework import CPUPlace
@@ -426,6 +450,193 @@ class ParameterServer:
         self._barrier_arrived: set = set()
         self._barrier_last_missing: list = []
         self._barrier_action_failed = False
+        # -- server-role checkpoint state (PADDLE_PS_CKPT_DIR) --------
+        self._ckpt_dir = ckpt_dir or None
+        self._ckpt_every = max(int(ckpt_every or 1), 1)
+        self._mutations = 0
+        # cid -> (seq, wire-resp fields) of the newest APPLIED
+        # side-effecting request per client, maintained under the same
+        # lock as the mutation it marks — the persisted form of the RPC
+        # dedup table (read-only methods never enter: they are safe to
+        # re-execute after a restore)
+        self._applied: Dict[str, tuple] = {}
+        # tid -> (cid, seq) of trainers blocked in the CURRENT sync
+        # barrier round: the barrier ACTION persists all of them in one
+        # atomic write (once the aggregated update ran, every waiter's
+        # send_barrier is applied, whether or not its response ever
+        # reaches the trainer)
+        self._barrier_inflight: Dict[int, tuple] = {}
+
+    # -- server-role checkpoint/restore ---------------------------------
+    _CKPT_PREFIX = "ps_state"
+    _CKPT_KEEP = 2
+
+    def _record_applied(self, resp_fields=(), stop=False):
+        """Mark the request the current handler thread is executing as
+        APPLIED (call while holding the lock that guards the mutation
+        it acknowledges), then maybe persist. `resp_fields` is what the
+        retried request should be answered with after a restore — the
+        wire form is ["ok", *resp_fields]. `stop=True` (the final
+        `complete`) makes the restored dedup replay ALSO stop the
+        reborn server, so a trainer retrying it doesn't leave the
+        server serving forever."""
+        ctx = current_request_ctx()
+        if ctx is not None:
+            cid, seq = ctx
+            self._applied[cid] = (int(seq),
+                                  ["ok"] + [np.asarray(f) if
+                                            isinstance(f, np.ndarray)
+                                            else f
+                                            for f in resp_fields],
+                                  bool(stop))
+        self._maybe_persist()
+
+    def _maybe_persist(self):
+        if not self._ckpt_dir:
+            return
+        self._mutations += 1
+        if self._mutations % self._ckpt_every:
+            return
+        self._persist()
+
+    def _snapshot_state(self) -> dict:
+        tables = {}
+        for name in self.scope.local_var_names():
+            v = self.scope.find_var(name)
+            if v is None:
+                continue
+            try:
+                tables[name] = np.asarray(v)
+            except Exception:  # noqa: BLE001 - non-array metadata var
+                continue
+        return {
+            "version": 1,
+            "tables": tables,
+            "pending": {p: dict(t) for p, t in self._pending.items()},
+            "pending_sparse": {p: dict(t) for p, t in
+                               self._pending_sparse.items()},
+            "inited": sorted(self._inited),
+            "completed": sorted(self._completed),
+            # wire-encode resp fields (body only, no frame length: the
+            # restore side feeds rpc.decode directly) so the pickle
+            # holds flat bytes
+            "applied": {cid: (int(seq), _rpc_encode(resp)[8:], stop)
+                        for cid, (seq, resp, stop)
+                        in self._applied.items()},
+        }
+
+    def _persist(self):
+        """One atomic numbered snapshot (tmp + os.replace — a kill
+        mid-write can never leave a corrupt newest snapshot), retention
+        pruning past _CKPT_KEEP. Caller holds the lock guarding the
+        mutation being acknowledged."""
+        os.makedirs(self._ckpt_dir, exist_ok=True)
+        nos = self._ckpt_nos(self._ckpt_dir)
+        n = (max(nos) if nos else -1) + 1
+        path = os.path.join(self._ckpt_dir,
+                            "%s.%d.pkl" % (self._CKPT_PREFIX, n))
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(self._snapshot_state(), f,
+                        protocol=pickle.HIGHEST_PROTOCOL)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        for old in nos:
+            if old <= n - self._CKPT_KEEP:
+                try:
+                    os.remove(os.path.join(
+                        self._ckpt_dir,
+                        "%s.%d.pkl" % (self._CKPT_PREFIX, old)))
+                except OSError:
+                    pass
+        try:
+            from ..observability.registry import registry
+
+            registry().event("checkpoint", action="save", role="pserver",
+                             path=path, step_no=n)
+        except Exception:  # noqa: BLE001 - telemetry only
+            pass
+
+    @classmethod
+    def _ckpt_nos(cls, directory) -> List[int]:
+        out = []
+        try:
+            names = os.listdir(directory)
+        except OSError:
+            return out
+        for nm in names:
+            parts = nm.split(".")
+            if len(parts) != 3 or parts[0] != cls._CKPT_PREFIX \
+                    or parts[2] != "pkl":
+                continue
+            try:
+                out.append(int(parts[1]))
+            except ValueError:
+                continue
+        return out
+
+    def restore_from_checkpoint(self):
+        """Load the newest INTACT snapshot under ckpt_dir into tables /
+        pending / markers; returns the {cid: [seq, resp_bytes]} dedup
+        snapshot for RpcServer.dedup_restore, or None when there is
+        nothing (or no dir). Corrupt/partial newest snapshots (a kill
+        mid-write before the atomic replace is impossible, but disk
+        faults are not) fall back to the previous one, matching the
+        trainer-side newest-intact restore semantics."""
+        if not self._ckpt_dir:
+            return None
+        last_err = None
+        for n in sorted(self._ckpt_nos(self._ckpt_dir), reverse=True):
+            path = os.path.join(self._ckpt_dir,
+                                "%s.%d.pkl" % (self._CKPT_PREFIX, n))
+            try:
+                with open(path, "rb") as f:
+                    state = pickle.load(f)
+                if state.get("version") != 1:
+                    raise ValueError("unknown ps snapshot version %r"
+                                     % state.get("version"))
+            except Exception as e:  # noqa: BLE001 - corrupt snapshot
+                last_err = e
+                import logging
+
+                logging.getLogger("paddle_tpu.ps").warning(
+                    "pserver snapshot %s unreadable (%s: %s); falling "
+                    "back", path, type(e).__name__, e)
+                continue
+            with self._lock:
+                for name, val in state["tables"].items():
+                    self.scope.set_var(name, val)
+                self._pending = {p: dict(t)
+                                 for p, t in state["pending"].items()}
+                self._pending_sparse = {
+                    p: dict(t)
+                    for p, t in state["pending_sparse"].items()}
+                self._inited = set(state["inited"])
+                self._completed = set(state["completed"])
+                # carry the markers forward: the NEXT snapshot must
+                # still contain them, or a second restart would lose
+                # exactly-once for requests applied before the first
+                self._applied = {
+                    cid: (seq, _rpc_decode(bytes(resp_bytes)),
+                          bool(stop))
+                    for cid, (seq, resp_bytes, stop)
+                    in state["applied"].items()}
+            try:
+                from ..observability.registry import registry
+
+                registry().event("checkpoint", action="restore",
+                                 role="pserver", path=path, step_no=n)
+            except Exception:  # noqa: BLE001 - telemetry only
+                pass
+            return {cid: [seq, resp_bytes, bool(stop)]
+                    for cid, (seq, resp_bytes, stop)
+                    in state["applied"].items()}
+        if last_err is not None:
+            raise RuntimeError(
+                "no intact pserver snapshot under %r" % self._ckpt_dir
+            ) from last_err
+        return None
 
     # sync: barrier action runs in exactly one thread
     def _apply_sync(self):
@@ -468,6 +679,22 @@ class ParameterServer:
                     np.concatenate([rv[0] for rv in per_t.values()]),
                     np.concatenate([rv[1] for rv in per_t.values()])
                     / self.trainers)
+            # once the aggregated update ran, EVERY waiter's
+            # send_barrier is applied — persist all their markers in
+            # the same atomic snapshot as the updated tables, so a
+            # server death after this point answers retried barriers
+            # from the marker instead of re-forming a half-round
+            self._record_barrier_applied()
+
+    def _record_barrier_applied(self):
+        """Mark every trainer blocked in the current barrier round as
+        applied (called from the barrier action, self._lock held)."""
+        with self._barrier_reset_lock:
+            inflight = dict(self._barrier_inflight)
+            self._barrier_inflight.clear()
+        for _tid, (cid, seq) in inflight.items():
+            self._applied[cid] = (int(seq), ["ok"], False)
+        self._maybe_persist()
 
     def _apply_sparse(self, pname, rows, values):
         # sparse SGD row update (reference: sgd_op.h SelectedRows branch)
@@ -488,6 +715,7 @@ class ParameterServer:
                 if pname not in self._inited:
                     self.scope.set_var(pname, val)
                     self._inited.add(pname)
+                self._record_applied()
             return []
         if method == "heartbeat":
             self.heartbeat.beat(int(args[0]))
@@ -498,9 +726,11 @@ class ParameterServer:
             if self.mode in ("async", "half_async"):
                 with self._lock:
                     self._apply_one(pname, grad)
+                    self._record_applied()
             else:
                 with self._lock:
                     self._pending.setdefault(pname, {})[tid] = grad
+                    self._record_applied()
             return []
         if method == "send_grads_batch":
             # one RPC carrying every table this server hosts (VERDICT r2
@@ -515,6 +745,7 @@ class ParameterServer:
                         self._apply_one(pname, grad)
                     else:
                         self._pending.setdefault(pname, {})[tid] = grad
+                self._record_applied()
             return []
         if method == "get_params_batch":
             with self._lock:
@@ -525,6 +756,11 @@ class ParameterServer:
             self.heartbeat.beat(tid)
             with self._barrier_reset_lock:
                 self._barrier_arrived.add(tid)
+                # the barrier ACTION persists this marker once the
+                # aggregated update has run (_record_barrier_applied)
+                ctx = current_request_ctx()
+                if ctx is not None:
+                    self._barrier_inflight[tid] = ctx
             try:
                 self._barrier.wait(timeout=self._barrier_timeout_s)
             except threading.BrokenBarrierError:
@@ -541,6 +777,7 @@ class ParameterServer:
                             set(range(self.trainers))
                             - self._barrier_arrived)
                         self._barrier_arrived.clear()
+                        self._barrier_inflight.clear()
                         self._barrier.reset()
                     missing = list(self._barrier_last_missing)
                     action_failed = self._barrier_action_failed
@@ -578,10 +815,12 @@ class ParameterServer:
             if self.mode in ("async", "half_async"):
                 with self._lock:
                     self._apply_sparse(pname, rows, values)
+                    self._record_applied()
             else:
                 with self._lock:
                     self._pending_sparse.setdefault(pname, {})[tid] = (
                         rows, values)
+                    self._record_applied()
             return []
         if method == "sparse_grad_sgd":
             # direct sparse SGD row update (reference: sgd_op.h sparse
@@ -593,30 +832,64 @@ class ParameterServer:
                 table = np.asarray(self.scope.find_var(pname)).copy()
                 np.subtract.at(table, rows, lr * values)
                 self.scope.set_var(pname, table)
+                self._record_applied()
             return []
         if method == "geo_delta":
             pname, delta = args[0], args[1]
             with self._lock:
                 table = np.asarray(self.scope.find_var(pname)) + delta
                 self.scope.set_var(pname, table)
+                # a retried geo_delta after a restore must get the SAME
+                # merged table back, not a re-merge of its delta
+                self._record_applied([table])
                 return [table]
         if method == "complete":
-            self._completed.add(int(args[0]))
-            if len(self._completed) >= self.trainers:
+            with self._lock:
+                self._completed.add(int(args[0]))
+                stop = len(self._completed) >= self.trainers
+                # the final complete's marker carries stop=True: a
+                # server killed between this persist and the response
+                # must STOP again when the trainer's retry replays it
+                self._record_applied(stop=stop)
+            if stop:
                 raise _Stop()
             return []
         raise ValueError("unknown rpc method %r" % method)
 
 
 def listen_and_serv(pserver_prog, pserver_startup=None,
-                    endpoint="127.0.0.1:0", trainers=1, mode="sync"):
+                    endpoint="127.0.0.1:0", trainers=1, mode="sync",
+                    ckpt_dir=None, ckpt_every=None):
     """Run the pserver loop until every trainer calls complete().
-    Returns after serving (reference: listen_and_serv_op.cc:336)."""
+    Returns after serving (reference: listen_and_serv_op.cc:336).
+
+    `ckpt_dir` (default: PADDLE_PS_CKPT_DIR env) turns on server-role
+    checkpointing: tables + pending grads + dedup markers persist
+    atomically every `ckpt_every` mutations (PADDLE_PS_CKPT_EVERY,
+    default 1 = exactly-once across a server death), and a restarted
+    server restores the newest intact snapshot — including the
+    per-client applied-seq markers, so trainers' retried requests are
+    never double-applied."""
     host, port = endpoint.rsplit(":", 1)
+    if ckpt_dir is None:
+        ckpt_dir = os.environ.get("PADDLE_PS_CKPT_DIR") or None
+    if ckpt_every is None:
+        ckpt_every = int(os.environ.get("PADDLE_PS_CKPT_EVERY", "1"))
     server_state = ParameterServer(pserver_prog, pserver_startup,
-                                   trainers, mode)
+                                   trainers, mode, ckpt_dir=ckpt_dir,
+                                   ckpt_every=ckpt_every)
+    dedup = server_state.restore_from_checkpoint()
     srv = RpcServer(host, int(port), server_state.handle)
+    if dedup:
+        srv.dedup_restore(dedup)
     srv.start()
+    if len(server_state._completed) >= server_state.trainers:
+        # the old server died after the LAST trainer's complete was
+        # applied+persisted: every trainer already has (or is retrying,
+        # and its retry's responses are swallowed best-effort) its
+        # answer — don't serve forever waiting for completes that will
+        # never come
+        srv._stop_evt.set()
     try:
         server_state.served_port = srv.port
         srv.wait_stopped()

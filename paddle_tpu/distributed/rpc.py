@@ -28,7 +28,16 @@ case, not the exception — see PAPERS.md on TPU concurrency limits):
   `send_grads_batch` is therefore never double-applied to PS tables.
 - `RpcClient.call` transparently reconnects with exponential backoff on
   any connection drop (env knobs: PADDLE_RPC_RETRIES, PADDLE_RPC_BACKOFF_S,
-  PADDLE_RPC_BACKOFF_MAX_S) and re-sends the SAME envelope.
+  PADDLE_RPC_BACKOFF_MAX_S) and re-sends the SAME envelope. Each sleep
+  is jittered (PADDLE_RPC_BACKOFF_JITTER, default 0.5, 0 disables):
+  after a pserver restart EVERY trainer's retry clock fires at the same
+  exponential instants otherwise, and the synchronized thundering herd
+  re-drops half the reconnects it is trying to heal.
+- the server's per-(client_id, seq) dedup table can be snapshotted and
+  restored (`dedup_snapshot`/`dedup_restore`) so a stateful server (the
+  PS tier) can carry exactly-once across its own death+restart: a
+  request applied before the crash is answered from the restored
+  marker instead of being re-applied.
 - error responses carry the exception type and the full server-side
   traceback — ["exc", type, msg, traceback] — surfaced client-side as
   RpcRemoteError (legacy "err:<msg>" responses are still understood).
@@ -59,6 +68,19 @@ _U32 = struct.Struct("<I")
 _U64 = struct.Struct("<Q")
 
 _ENVELOPE = "__rq1__"
+
+#: per-thread (client_id, seq) of the request the current RpcServer
+#: handler thread is executing — stateful handlers (the PS tier's
+#: checkpoint) read it to persist "this request was applied" markers
+#: atomically with their own state mutation
+_request_ctx = threading.local()
+
+
+def current_request_ctx():
+    """(client_id, seq) of the enveloped request the calling handler
+    thread is executing, or None outside a handler / for bare legacy
+    frames."""
+    return getattr(_request_ctx, "ctx", None)
 
 
 def _telemetry():
@@ -313,7 +335,11 @@ class RpcServer:
             # duplicate blocks instead of double-invoking the handler
             ent["seq"], ent["resp"], ent["stop"] = seq, None, False
 
-        resp, stop = self._execute(method, args)
+        _request_ctx.ctx = (cid, seq)
+        try:
+            resp, stop = self._execute(method, args)
+        finally:
+            _request_ctx.ctx = None
         with self._dedup_lock:
             if ent["seq"] == seq:
                 ent["resp"], ent["stop"] = resp, stop
@@ -364,6 +390,49 @@ class RpcServer:
         except Exception as e:  # noqa: BLE001
             return (["exc", type(e).__name__, str(e),
                      traceback.format_exc()], False)
+
+    # -- dedup persistence (pserver checkpoint/restore) ------------------
+    def dedup_snapshot(self, markers=None):
+        """Persistable view of the dedup table: {cid: [seq, resp_bytes]}
+        with resp wire-encoded (rpc.encode — the resp is already a list
+        of wire-type fields). Only COMPLETED entries are included: an
+        in-flight request's mutation may not have happened yet, and
+        marking it applied would drop it on restore. `markers` (a
+        {cid: (seq, resp_fields)} dict a stateful handler maintains
+        under ITS OWN state lock) overrides/extends — that map, not
+        this racy table walk, is what carries exactly-once across a
+        server restart; the table walk is a best-effort extra."""
+        out = {}
+        with self._dedup_lock:
+            for cid, ent in self._dedup.items():
+                if ent["resp"] is not None:
+                    # body only (strip the u64 frame length): decode()
+                    # takes the unframed field list
+                    out[cid] = [int(ent["seq"]),
+                                encode(ent["resp"])[8:],
+                                bool(ent["stop"])]
+        for cid, marker in (markers or {}).items():
+            seq, resp = marker[0], marker[1]
+            stop = bool(marker[2]) if len(marker) > 2 else False
+            out[cid] = [int(seq), encode(list(resp))[8:], stop]
+        return out
+
+    def dedup_restore(self, snapshot):
+        """Pre-seed the dedup table from a `dedup_snapshot` taken by a
+        previous incarnation of this server: a client retrying a
+        request the old server applied-and-checkpointed is answered
+        from the restored marker instead of re-invoking the handler.
+        A marker's `stop` bit survives too — a replayed final shutdown
+        request stops the reborn server again instead of leaving it
+        serving forever."""
+        with self._dedup_lock:
+            for cid, marker in (snapshot or {}).items():
+                seq, resp_bytes = marker[0], marker[1]
+                stop = bool(marker[2]) if len(marker) > 2 else False
+                self._dedup[cid] = {
+                    "seq": int(seq), "resp": decode(bytes(resp_bytes)),
+                    "stop": stop, "ts": time.monotonic(),
+                    "cv": threading.Condition(self._dedup_lock)}
 
     # -- lifecycle -------------------------------------------------------
     def start(self):
@@ -433,6 +502,14 @@ class RpcClient:
             os.environ.get("PADDLE_RPC_BACKOFF_S", 0.05))
         self._backoff_max_s = float(
             os.environ.get("PADDLE_RPC_BACKOFF_MAX_S", 2.0))
+        # jitter fraction: each backoff sleep is scaled by a uniform
+        # draw from [1-j, 1+j] (clamped to >=0). Pure exponential
+        # backoff synchronizes the whole cohort's retry clocks after a
+        # pserver restart — N trainers reconnect in the same instant,
+        # and the herd re-drops connections a spread-out retry would
+        # have healed. 0 disables (deterministic tests).
+        self._backoff_jitter = min(1.0, max(0.0, float(
+            os.environ.get("PADDLE_RPC_BACKOFF_JITTER", 0.5))))
         # retry reconnects use a SHORT connect timeout: a blackholed
         # (preempted, no RST) server would otherwise stall every
         # attempt for the full initial-connect timeout, turning a
@@ -519,8 +596,18 @@ class RpcClient:
                     reg.inc("rpc.retry")
                     reg.event("rpc_retry", method=method,
                               endpoint=self._endpoint, attempt=attempt)
-                time.sleep(min(self._backoff_s * (2 ** (attempt - 1)),
-                               self._backoff_max_s))
+                time.sleep(self._backoff_sleep_s(attempt))
+
+    def _backoff_sleep_s(self, attempt):
+        """Capped exponential backoff with multiplicative jitter."""
+        base = min(self._backoff_s * (2 ** (attempt - 1)),
+                   self._backoff_max_s)
+        if self._backoff_jitter <= 0.0:
+            return base
+        import random
+
+        return base * random.uniform(1.0 - self._backoff_jitter,
+                                     1.0 + self._backoff_jitter)
 
     def ack_last(self):
         """Acked-release: tell the server the LAST call's response has

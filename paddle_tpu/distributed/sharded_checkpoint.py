@@ -115,6 +115,14 @@ class ShardedCheckpointManager:
         import orbax.checkpoint as ocp
 
         self._mgr.save(int(step), args=ocp.args.StandardSave(pytree))
+        # injection point for the preemption-mid-save tests: orbax
+        # commits asynchronously (save() returns with the step still an
+        # uncommitted *.orbax-checkpoint-tmp-* dir), so a PADDLE_FAULTS
+        # kill here deterministically leaves a half-written step that
+        # all_steps()/restore() must never surface
+        from . import faults
+
+        faults.on_message("ckpt", "write", method="sharded_save")
         if wait:
             self._mgr.wait_until_finished()
 

@@ -170,6 +170,13 @@ def publish_checkpoint_dir(root, write_fn, train_status, checkpoint_num):
     write_fn(tmp)
     with open(os.path.join(tmp, _STATUS_FILE), "w") as f:
         json.dump(train_status._to_dict(), f)
+    # injection point for the preemption-mid-save tests: a PADDLE_FAULTS
+    # kill here (payload written, publication pending) leaves only the
+    # .tmp dir, which _ckpt_dirs never lists — restore must fall back
+    # to the previous published step, never see a half-written one
+    from ..distributed import faults
+
+    faults.on_message("ckpt", "write", method="fluid_publish")
     os.replace(tmp, real)
     if checkpoint_num:
         clean_redundant_checkpoints(root, checkpoint_num)

@@ -34,7 +34,7 @@ from .capture import (CaptureController,  # noqa: F401
                       install as install_capture)
 from .aggregate import (window_summary, allgather_window,  # noqa: F401
                         aggregate_summaries, straggler_report,
-                        load_telemetry_dir)
+                        load_telemetry_dir, OnlineAggregator)
 from .schema import (load_schema, validate_record,  # noqa: F401
                      validate_records)
 from . import publish  # noqa: F401
@@ -45,12 +45,31 @@ __all__ = [
     "install_flight_recorder",
     "CaptureController", "capture_controller", "install_capture",
     "window_summary", "allgather_window", "aggregate_summaries",
-    "straggler_report", "load_telemetry_dir",
+    "straggler_report", "load_telemetry_dir", "OnlineAggregator",
     "load_schema", "validate_record", "validate_records",
-    "on_executor_step",
+    "on_executor_step", "enable_online_stragglers",
+    "disable_online_stragglers",
 ]
 
 _armed = False
+_online = None  # OnlineAggregator armed by enable_online_stragglers
+
+
+def enable_online_stragglers(group, window=None) -> OnlineAggregator:
+    """Arm the cadenced cross-rank straggler exchange: every
+    `window` steps (default FLAGS_tpu_telemetry_window) the executor
+    step epilogue allgathers window summaries over `group` (a
+    HostCollectiveGroup) and publishes a `straggler_window` event
+    naming the slow rank. All ranks must arm it and step in lockstep —
+    the exchange is a collective."""
+    global _online
+    _online = OnlineAggregator(group, window=window)
+    return _online
+
+
+def disable_online_stragglers() -> None:
+    global _online
+    _online = None
 
 
 def on_executor_step(phases_ms: dict, ts=None) -> None:
@@ -68,5 +87,7 @@ def on_executor_step(phases_ms: dict, ts=None) -> None:
             install_capture()
         if reg.telemetry_dir:
             capture_controller().poll()
+        if _online is not None:
+            _online.maybe_tick()
     except Exception:  # noqa: BLE001 - telemetry must never kill a step
         pass

@@ -12,7 +12,11 @@ views:
   `HostCollectiveGroup.all_gather`, no new protocol), producing
   min/mean/max/p99 per phase and a straggler report that NAMES the
   slowest rank (`aggregate_summaries`). Surfaced in bench.py's
-  `telemetry` block.
+  `telemetry` block — and, on a CADENCE, by `OnlineAggregator`:
+  `observability.enable_online_stragglers(group)` makes the executor
+  step epilogue run the exchange every `FLAGS_tpu_telemetry_window`
+  steps and publish a `straggler_window` event, so a live (elastic)
+  run shows degradation before it dies instead of only end-of-run.
 - **offline**: `load_telemetry_dir` reads the per-rank JSONL files the
   registry sink wrote and `straggler_report` aligns step records
   across ranks — `tools/perf_analysis.py --stragglers`.
@@ -29,7 +33,8 @@ import numpy as np
 from .registry import STEP_FIELDS
 
 __all__ = ["window_summary", "allgather_window", "aggregate_summaries",
-           "straggler_report", "load_telemetry_dir"]
+           "straggler_report", "load_telemetry_dir",
+           "OnlineAggregator"]
 
 _PHASES = tuple(f for f in STEP_FIELDS if f != "compile_ms")
 
@@ -127,6 +132,80 @@ def aggregate_summaries(summaries: List[dict]) -> dict:
             "blame_ms": round(blame_ms, 4),
         },
     }
+
+
+class OnlineAggregator:
+    """Cadenced online straggler exchange: every `window` steps (default
+    FLAGS_tpu_telemetry_window) the ranks drain their step-record
+    windows, allgather the summaries over the host tier, and the
+    aggregate — straggler rank, slack, blame phase — lands in the
+    registry as a `straggler_window` event (+ `straggler.slack_ms`
+    gauge) on every rank.
+
+    The exchange is a COLLECTIVE: arm it (observability.
+    enable_online_stragglers) only on cohorts whose ranks step in
+    lockstep (DP/fleet), or rank A's step-32 allgather waits on rank
+    B's. An exchange failure (a rank died mid-window) DISARMS the
+    aggregator after one warning event: retrying the collective every
+    window would stall each survivor's step loop for the full dead-rank
+    detection wait, over and over — the straggler view degrades, the
+    step loop must not."""
+
+    def __init__(self, group, window=None, reg=None):
+        from ..utils.flags import get_flag
+
+        self.group = group
+        self.window = int(window if window is not None
+                          else get_flag("FLAGS_tpu_telemetry_window", 32)
+                          or 32)
+        self.window = max(self.window, 1)
+        self._reg = reg
+        self.last = None          # newest aggregate (None before one)
+        self.dead = False         # a failed exchange disarms for good
+
+    def _registry(self):
+        if self._reg is not None:
+            return self._reg
+        from .registry import registry
+
+        return registry()
+
+    def maybe_tick(self) -> Optional[dict]:
+        """Executor step epilogue hook: run the exchange iff the
+        registry's dispatch counter just completed a window (no-op once
+        a failed exchange disarmed the aggregator)."""
+        if self.dead:
+            return None
+        reg = self._registry()
+        if reg.step <= 0 or reg.step % self.window:
+            return None
+        return self.tick()
+
+    def tick(self) -> Optional[dict]:
+        if self.dead:
+            return None
+        reg = self._registry()
+        try:
+            summaries = allgather_window(
+                self.group, window_summary(reg=reg))
+            agg = aggregate_summaries(summaries)
+        except Exception as e:  # noqa: BLE001 - a dead rank mid-window
+            self.dead = True
+            reg.event("straggler_window", error=str(e)[:200])
+            return None
+        self.last = agg
+        s = agg.get("straggler") or {}
+        reg.event("straggler_window",
+                  window=self.window,
+                  ranks=int(agg.get("ranks", 0)),
+                  straggler_rank=int(s.get("rank", -1)),
+                  slack_ms=float(s.get("slack_ms", 0.0)),
+                  blame_phase=str(s.get("blame_phase") or ""),
+                  total_ms_mean=float(s.get("total_ms_mean", 0.0)))
+        reg.set_gauge("straggler.slack_ms", float(s.get("slack_ms",
+                                                        0.0)))
+        reg.set_gauge("straggler.rank", int(s.get("rank", -1)))
+        return agg
 
 
 # -- offline: per-rank JSONL files --------------------------------------

@@ -128,6 +128,12 @@ class _JsonlSink:
     def __init__(self, directory, rank, rotate_bytes):
         self._dir = directory
         self._rank = int(rank)
+        # NOTE the naming convention telemetry.rank<R>.jsonl is shared
+        # with the launch supervisor's own stream
+        # (telemetry.supervisor.jsonl, written directly by launch.py —
+        # the supervisor must not import the jax stack) and with
+        # aggregate.load_telemetry_dir's file regex
+        self._stream = "rank%d" % self._rank
         self._rotate = int(rotate_bytes)
         self._gen = 0
         self._f = None
@@ -140,12 +146,12 @@ class _JsonlSink:
     @property
     def path(self) -> str:
         return os.path.join(self._dir,
-                            "telemetry.rank%d.jsonl" % self._rank)
+                            "telemetry.%s.jsonl" % self._stream)
 
     def _rotated_path(self, gen) -> str:
         return os.path.join(self._dir,
-                            "telemetry.rank%d.g%03d.jsonl"
-                            % (self._rank, gen))
+                            "telemetry.%s.g%03d.jsonl"
+                            % (self._stream, gen))
 
     def write(self, record: dict) -> None:
         line = json.dumps(record, sort_keys=True)

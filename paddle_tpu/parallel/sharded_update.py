@@ -1069,12 +1069,25 @@ def to_sharded_global(value, info: ShardInfo, mesh, axis):
     """Lay one scope state array out as the sharded flat buffer the
     compiled step expects: flatten, zero-pad to N*S, device_put with
     NamedSharding(mesh, P(axis)). Called once per var (later steps see
-    the (padded,) shape and pass through)."""
+    the (padded,) shape and pass through).
+
+    Elastic restart (N' != N): a checkpoint normally restores LOGICAL
+    shapes (unshard_scope_value on the save path), but a scope value
+    can also arrive as the PREVIOUS world's flat buffer — 1-D, padded
+    for old N, so longer than this plan's logical numel. Only that
+    shape is trimmed (a flat value longer than the logical size can
+    only be old padding; a logical value has exactly `numel`
+    elements) before re-padding for the new mesh, so the
+    moments/masters land bit-identical on N' devices. A
+    MULTI-dimensional oversized value is a genuine plan/value mismatch
+    and still fails loudly in np.pad below."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     arr = np.asarray(value)
     flat = arr.reshape(-1)
+    if arr.ndim == 1 and flat.shape[0] > info.numel:
+        flat = flat[:info.numel]  # strip the old world's padding
     if flat.shape[0] != info.padded:
         flat = np.pad(flat, (0, info.padded - flat.shape[0]))
     return jax.device_put(flat, NamedSharding(mesh, P(axis)))

@@ -11,11 +11,17 @@ from threading import Thread
 from .prefetcher import (  # noqa: F401
     DevicePrefetcher, is_on_device, prefetch_to_device,
 )
+from .resharding import (  # noqa: F401
+    rank_slice, resume_sample_offset, shard_batch, shard_batches,
+    skip_steps,
+)
 
 __all__ = [
     "cache", "map_readers", "buffered", "compose", "chain", "shuffle",
     "firstn", "xmap_readers", "multiprocess_reader",
     "prefetch_to_device", "DevicePrefetcher", "is_on_device",
+    "rank_slice", "shard_batch", "shard_batches",
+    "resume_sample_offset", "skip_steps",
 ]
 
 

@@ -114,6 +114,13 @@ _FLAGS: Dict[str, object] = {
     # exceeds this many MB it is atomically renamed to a numbered
     # generation and a fresh file starts
     "FLAGS_tpu_telemetry_rotate_mb": 64.0,
+    # online straggler cadence: with observability.
+    # enable_online_stragglers(group) armed, the ranks exchange window
+    # summaries (one host-tier allgather) every this-many steps and the
+    # straggler verdict lands as a "straggler_window" event — a live
+    # elastic run shows degradation BEFORE it dies, instead of only in
+    # the end-of-run report
+    "FLAGS_tpu_telemetry_window": 32,
 }
 
 

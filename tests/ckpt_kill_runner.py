@@ -1,0 +1,75 @@
+"""Subprocess runner for the preemption-mid-save tests: dies via
+PADDLE_FAULTS kill at the ckpt/write injection point DURING its second
+checkpoint save, leaving a genuinely half-written newest step on disk
+(fluid: the un-published .tmp payload dir; sharded: orbax's uncommitted
+*.orbax-checkpoint-tmp-* step). The parent test then asserts the
+newest-intact restore fallback never surfaces the half-written step.
+
+argv: <fluid|sharded> <root>
+Arms its own PADDLE_FAULTS (kill at the 2nd ckpt/write event: save #1
+publishes cleanly, save #2 dies mid-write) unless the env already set
+one. Prints SAVED0 after the first (intact) save.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "PADDLE_FAULTS", "kill:side=ckpt,point=write,at=2,exit_code=9")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def run_fluid(root):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.fluid import checkpoint as ckpt
+    from paddle_tpu.fluid import framework
+
+    main, startup = fluid.Program(), fluid.Program()
+    with framework.unique_name_guard(), \
+            fluid.program_guard(main, startup):
+        main.random_seed = startup.random_seed = 3
+        x = fluid.data(name="x", shape=[-1, 4], dtype="float32")
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.fc(input=x, size=2))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    ckpt.save_checkpoint(exe, root,
+                         ckpt.TrainStatus(epoch_no=0, step_no=0),
+                         main_program=main, scope=scope)
+    print("SAVED0", flush=True)
+    # train one step so the second snapshot differs, then die mid-save
+    exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+            fetch_list=[loss], scope=scope)
+    ckpt.save_checkpoint(exe, root,
+                         ckpt.TrainStatus(epoch_no=0, step_no=1),
+                         main_program=main, scope=scope)
+    print("UNREACHABLE", flush=True)
+
+
+def run_sharded(root):
+    from paddle_tpu.distributed.sharded_checkpoint import \
+        ShardedCheckpointManager
+
+    mgr = ShardedCheckpointManager(root, max_to_keep=3)
+    # ~4MB payload: orbax's async commit comfortably outlives the
+    # os._exit fired at the ckpt/write hook right after save() returns
+    tree = {"w": np.full((1 << 20,), 1.0, np.float32),
+            "step": np.asarray([0], np.int64)}
+    mgr.save(0, tree, wait=True)
+    print("SAVED0", flush=True)
+    tree2 = {"w": np.full((1 << 20,), 2.0, np.float32),
+             "step": np.asarray([1], np.int64)}
+    mgr.save(1, tree2, wait=True)
+    print("UNREACHABLE", flush=True)
+
+
+if __name__ == "__main__":
+    mode, root = sys.argv[1], sys.argv[2]
+    (run_fluid if mode == "fluid" else run_sharded)(root)
+    sys.exit(3)  # the kill must have fired during the second save

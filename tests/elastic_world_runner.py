@@ -1,0 +1,142 @@
+"""Worker script for the ELASTIC world-size restart acceptance test
+(spawned via `python -m paddle_tpu.distributed.launch --max_restarts
+--min_ranks`).
+
+Data-parallel training over the HOST collective tier: every rank
+computes loss+grads on ITS slice of one fixed GLOBAL batch per step
+(reader.resharding.shard_batch — the slice map recomputes itself from
+the live (rank, world)), the cohort allreduce-means loss+grads in one
+host-tier collective, and the SGD update applies host-side so params
+stay bit-identical on every rank at every world size. Rank 0 publishes
+a fluid checkpoint every `save_every` steps; every rank restores
+through the group-agreed newest-intact path on (re)start and skips the
+already-trained global steps.
+
+In kill mode the designated victim rank of attempt 0 arms a
+PADDLE_FAULTS kill at its Nth host-collective send — a lost machine.
+The supervisor then relaunches the SURVIVORS at world N-1 with
+reassigned contiguous ranks; because the global batch is fixed, resume
+offset and re-sharded sample assignment make the post-resume trajectory
+bit-identical to an uninterrupted N-1-rank run restored from the same
+checkpoint.
+
+argv: <ckpt_root> <total_steps> <save_every> [<kill_rank> <kill_at>]
+Prints per completed step (rank 0): LOSS <step> <%.17g global loss>.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("PADDLE_HC_LIVENESS_S", "4")
+os.environ.setdefault("PADDLE_HC_HEARTBEAT_S", "0.5")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+GLOBAL_BATCH = 12  # divisible by 4, 3 and 2: exact mean-of-means
+LR = 0.1
+
+
+def build():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework
+
+    main, startup = fluid.Program(), fluid.Program()
+    with framework.unique_name_guard(), \
+            fluid.program_guard(main, startup):
+        main.random_seed = startup.random_seed = 7
+        x = fluid.data(name="x", shape=[-1, 16], dtype="float32")
+        y = fluid.data(name="y", shape=[-1, 1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=24, act="tanh")
+        pred = fluid.layers.fc(input=h, size=1, act=None)
+        loss = fluid.layers.reduce_mean(fluid.layers.square(pred - y))
+        # backward ONLY: grads are exchanged over the host tier and the
+        # SGD update applies host-side, identically on every rank
+        pg = fluid.optimizer.SGDOptimizer(
+            learning_rate=LR).backward(loss)
+    names = [(p.name, g.name) for p, g in pg]
+    return main, startup, loss.name, names
+
+
+def data(total_steps):
+    rng = np.random.RandomState(3)
+    xs = rng.randn(total_steps, GLOBAL_BATCH, 16).astype(np.float32)
+    w = rng.randn(16, 1).astype(np.float32)
+    return xs, np.tanh(xs @ w)
+
+
+def main():
+    root, total, save_every = (sys.argv[1], int(sys.argv[2]),
+                               int(sys.argv[3]))
+    kill_rank = int(sys.argv[4]) if len(sys.argv) > 4 else -1
+    kill_at = int(sys.argv[5]) if len(sys.argv) > 5 else 0
+
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    attempt = int(os.environ.get("PADDLE_RESTART_NUM", "0"))
+    if attempt == 0 and rank == kill_rank and kill_at > 0:
+        # the designated victim: a lost machine, not a graceful exit
+        os.environ["PADDLE_FAULTS"] = (
+            "kill:side=client,point=send,method=hc_put_part,at=%d"
+            % kill_at)
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.distributed.host_collectives import group_from_env
+    from paddle_tpu.fluid import checkpoint as ckpt
+    from paddle_tpu.reader import resharding
+
+    group = group_from_env()
+    prog, startup, loss_name, pg_names = build()
+    xs, ys = data(total)
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+
+    status = ckpt.load_checkpoint(exe, root, main_program=prog,
+                                  scope=scope, group=group)
+    start = status.step_no + 1 if status is not None else 0
+    print("RESUME %d world=%d rank=%d attempt=%d"
+          % (start, world, rank, attempt), flush=True)
+
+    fetch = [loss_name] + [g for _, g in pg_names]
+    for i in range(start, total):
+        feed = resharding.shard_batch({"x": xs[i], "y": ys[i]},
+                                      rank, world)
+        out = exe.run(prog, feed=feed, fetch_list=fetch, scope=scope)
+        vals = [np.asarray(v) for v in out]
+        # ONE collective per step: flat-concat loss+grads, allreduce
+        # the mean (equal shards, so mean-of-means == global mean)
+        flat = np.concatenate([v.reshape(-1).astype(np.float64)
+                               for v in vals])
+        if group is not None:
+            flat = group.all_reduce(flat, op="mean")
+        loss_g, off = float(flat[0]), 1
+        for (pname, _), v in zip(pg_names, vals[1:]):
+            n = v.size
+            g_mean = flat[off:off + n].reshape(v.shape)
+            off += n
+            w = np.asarray(scope.find_var(pname), np.float64)
+            scope.set_var(pname,
+                          (w - LR * g_mean).astype(np.float32))
+        if rank == 0:
+            print("LOSS %d %.17g" % (i, loss_g), flush=True)
+            if save_every and i % save_every == save_every - 1:
+                ckpt.save_checkpoint(
+                    exe, root, ckpt.TrainStatus(epoch_no=0, step_no=i),
+                    main_program=prog, checkpoint_num=10, scope=scope)
+        if group is not None:
+            # lockstep: nobody starts step i+1 before rank 0 published
+            # step i's checkpoint (also the kill's injection point)
+            group.barrier()
+    if group is not None:
+        group.shutdown()
+    sys.stdout.flush()
+    # exit WITHOUT interpreter teardown: jax's CPU runtime intermittently
+    # aborts while daemon threads die at exit (see elastic_launch_runner)
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

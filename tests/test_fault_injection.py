@@ -446,3 +446,333 @@ def test_ps_sync_train_loop_identical_under_injected_drops():
     faulty = run("drop:side=client,point=recv,every=5")
     assert len(clean) == 5
     assert clean == faulty
+
+
+# -- reconnect backoff jitter (elastic satellite) ---------------------------
+
+def test_backoff_jitter_spreads_retry_sleeps(monkeypatch):
+    """Pure exponential backoff synchronizes the cohort's retry clocks
+    after a pserver restart (thundering herd); each sleep must jitter
+    within [1-j, 1+j] of the capped exponential base, and j=0 must stay
+    exactly deterministic."""
+    monkeypatch.setenv("PADDLE_RPC_BACKOFF_S", "0.1")
+    monkeypatch.setenv("PADDLE_RPC_BACKOFF_MAX_S", "0.8")
+    monkeypatch.setenv("PADDLE_RPC_BACKOFF_JITTER", "0.5")
+    srv, _ = _counting_server()
+    try:
+        cli = RpcClient("127.0.0.1:%d" % srv.port)
+        base2 = 0.2   # 0.1 * 2^(2-1)
+        draws = {cli._backoff_sleep_s(2) for _ in range(64)}
+        assert all(0.1 - 1e-9 <= d <= 0.3 + 1e-9 for d in draws), draws
+        assert len(draws) > 1, "jitter must actually vary the sleeps"
+        assert any(abs(d - base2) > 0.01 for d in draws)
+        # the exponential stays capped under jitter's upper bound
+        assert all(d <= 0.8 * 1.5 + 1e-9
+                   for d in (cli._backoff_sleep_s(30)
+                             for _ in range(16)))
+        cli.close()
+        monkeypatch.setenv("PADDLE_RPC_BACKOFF_JITTER", "0")
+        cli2 = RpcClient("127.0.0.1:%d" % srv.port)
+        assert cli2._backoff_sleep_s(2) == base2
+        assert cli2._backoff_sleep_s(30) == 0.8
+        cli2.close()
+    finally:
+        srv.shutdown()
+
+
+# -- preemption DURING a checkpoint save (elastic satellite) ----------------
+
+def _run_ckpt_kill(mode, root):
+    # cwd = the checkpoint parent: the fault-kill's flight dump lands
+    # there instead of polluting the repo root
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_DIR, "ckpt_kill_runner.py"),
+         mode, root],
+        env=_env({}), cwd=os.path.dirname(root), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, timeout=240)
+    assert proc.returncode == 9, proc.stdout  # the injected kill's rc
+    assert "SAVED0" in proc.stdout
+    assert "UNREACHABLE" not in proc.stdout
+    return proc.stdout
+
+
+def test_fluid_restore_never_sees_half_written_step_dir(tmp_path):
+    """PADDLE_FAULTS kill DURING the second fluid checkpoint save
+    (payload written, publication pending): the .tmp dir is left on
+    disk, and the newest-intact fallback restores checkpoint 0 without
+    ever surfacing the half-written step."""
+    root = str(tmp_path / "ck")
+    _run_ckpt_kill("fluid", root)
+    from paddle_tpu.fluid import checkpoint as ckpt
+
+    leftovers = sorted(os.listdir(root))
+    assert any(n.endswith(".tmp") for n in leftovers), leftovers
+    assert ckpt.get_last_checkpoint_no(root) == 0
+    latest = ckpt.latest_checkpoint_dir(root)
+    assert latest and not latest.endswith(".tmp")
+    assert ckpt.read_status(latest).step_no == 0
+
+
+def test_sharded_restore_never_sees_half_written_step_dir(tmp_path):
+    """Same for the orbax-backed manager: the kill fires after save()
+    issued the async write (step dir uncommitted on disk);
+    all_steps()/restore() must surface only step 0."""
+    root = str(tmp_path / "sck")
+    _run_ckpt_kill("sharded", root)
+    leftovers = sorted(os.listdir(root))
+    assert any("tmp" in n for n in leftovers), \
+        "the kill must leave an uncommitted step: %s" % leftovers
+    from paddle_tpu.distributed.sharded_checkpoint import \
+        ShardedCheckpointManager
+
+    mgr = ShardedCheckpointManager(root)
+    try:
+        assert mgr.all_steps() == [0]
+        got = mgr.restore(template={
+            "w": np.zeros((1 << 20,), np.float32),
+            "step": np.zeros((1,), np.int64)})
+        assert float(np.asarray(got["w"])[0]) == 1.0
+        assert int(np.asarray(got["step"])[0]) == 0
+    finally:
+        mgr.close()
+
+
+# -- pserver checkpoint/restore: exactly-once across a server death ---------
+
+def test_pserver_checkpoint_restores_tables_and_dedup(tmp_path):
+    """The server role's elastic story (ROADMAP carried-over item): a
+    server that dies after applying-and-persisting a request comes back
+    with its tables AND per-client applied-seq markers; the client's
+    RETRY of that request is answered from the restored marker — never
+    re-applied — while a genuinely new request executes normally."""
+    from paddle_tpu.distributed.ps import ParameterServer
+    from paddle_tpu.fluid import framework as fw
+
+    ckpt_dir = str(tmp_path / "ps_ckpt")
+    ps1 = ParameterServer(fw.Program(), None, trainers=1, mode="async",
+                          ckpt_dir=ckpt_dir, ckpt_every=1)
+    srv1 = RpcServer("127.0.0.1", 0, ps1.handle)
+    srv1.start()
+    cli = RpcClient("127.0.0.1:%d" % srv1.port)
+    try:
+        table0 = np.arange(12, dtype=np.float32).reshape(4, 3)
+        cli.call("init_param", "w", table0)
+        rows = np.asarray([1, 3], np.int64)
+        vals = np.ones((2, 3), np.float32)
+        cli.call("sparse_grad_sgd", "w", rows, vals, 0.5)
+        applied = np.asarray(ps1.scope.find_var("w")).copy()
+        assert not np.array_equal(applied, table0)
+        retry_seq = cli._seq  # the request whose response could be lost
+    finally:
+        srv1.shutdown()
+        ps1.heartbeat.stop()
+
+    # the reborn server restores tables + dedup markers from disk
+    ps2 = ParameterServer(fw.Program(), None, trainers=1, mode="async",
+                          ckpt_dir=ckpt_dir, ckpt_every=1)
+    dedup = ps2.restore_from_checkpoint()
+    assert dedup and cli._cid in dedup
+    np.testing.assert_array_equal(
+        np.asarray(ps2.scope.find_var("w")), applied)
+    srv2 = RpcServer("127.0.0.1", 0, ps2.handle)
+    srv2.dedup_restore(dedup)
+    srv2.start()
+    try:
+        from paddle_tpu.distributed.rpc import (_ENVELOPE, read_msg,
+                                                write_msg)
+
+        # the client never got its response: re-send the SAME envelope
+        s = socket.create_connection(("127.0.0.1", srv2.port))
+        try:
+            write_msg(s, [_ENVELOPE, cli._cid, retry_seq,
+                          "sparse_grad_sgd", "w", rows, vals, 0.5])
+            resp = read_msg(s)
+            assert resp and resp[0] == "ok", resp
+            # the retry was answered from the marker, NOT re-applied
+            np.testing.assert_array_equal(
+                np.asarray(ps2.scope.find_var("w")), applied)
+            # a NEW request still executes normally
+            write_msg(s, [_ENVELOPE, cli._cid, retry_seq + 1,
+                          "sparse_grad_sgd", "w", rows, vals, 0.5])
+            resp2 = read_msg(s)
+            assert resp2 and resp2[0] == "ok", resp2
+            assert not np.array_equal(
+                np.asarray(ps2.scope.find_var("w")), applied)
+        finally:
+            s.close()
+    finally:
+        srv2.shutdown()
+        ps2.heartbeat.stop()
+        cli.close()
+
+
+def test_pserver_restored_complete_marker_still_stops_the_server(
+        tmp_path):
+    """A server killed between applying the LAST trainer's `complete`
+    and answering it must not serve forever after restart: the
+    restored marker carries the stop bit, so the trainer's retried
+    `complete` is answered from dedup AND stops the reborn server —
+    and a restore whose completed-set is already full releases
+    wait_stopped immediately."""
+    from paddle_tpu.distributed.ps import ParameterServer
+    from paddle_tpu.fluid import framework as fw
+    from paddle_tpu.distributed.rpc import (_ENVELOPE, read_msg,
+                                            write_msg)
+
+    ckpt_dir = str(tmp_path / "ps_ckpt")
+    ps1 = ParameterServer(fw.Program(), None, trainers=1, mode="async",
+                          ckpt_dir=ckpt_dir, ckpt_every=1)
+    srv1 = RpcServer("127.0.0.1", 0, ps1.handle)
+    srv1.start()
+    cli = RpcClient("127.0.0.1:%d" % srv1.port)
+    try:
+        cli.call("complete", 0)  # applied + persisted (stop marker)
+        last_seq = cli._seq
+    finally:
+        srv1.shutdown()
+        ps1.heartbeat.stop()
+
+    ps2 = ParameterServer(fw.Program(), None, trainers=1, mode="async",
+                          ckpt_dir=ckpt_dir, ckpt_every=1)
+    dedup = ps2.restore_from_checkpoint()
+    try:
+        assert ps2._completed == {0}
+        assert dedup[cli._cid][2] is True, "stop bit must persist"
+        srv2 = RpcServer("127.0.0.1", 0, ps2.handle)
+        srv2.dedup_restore(dedup)
+        srv2.start()
+        # the retried complete replays from the marker AND stops the
+        # reborn server (the hang the review caught)
+        s = socket.create_connection(("127.0.0.1", srv2.port))
+        try:
+            write_msg(s, [_ENVELOPE, cli._cid, last_seq,
+                          "complete", 0])
+            assert read_msg(s)[0] == "ok"
+        finally:
+            s.close()
+        srv2._stop_evt.wait(timeout=10)
+        assert srv2._stop_evt.is_set()
+        srv2.shutdown()
+    finally:
+        ps2.heartbeat.stop()
+        cli.close()
+
+
+def test_pserver_restore_falls_back_past_corrupt_snapshot(tmp_path):
+    """Newest-intact semantics for the server snapshots too: a torn
+    newest file (disk fault) falls back to the previous one."""
+    from paddle_tpu.distributed.ps import ParameterServer
+    from paddle_tpu.fluid import framework as fw
+
+    ckpt_dir = str(tmp_path / "ps_ckpt")
+    ps1 = ParameterServer(fw.Program(), None, trainers=1, mode="async",
+                          ckpt_dir=ckpt_dir, ckpt_every=1)
+    srv1 = RpcServer("127.0.0.1", 0, ps1.handle)
+    srv1.start()
+    cli = RpcClient("127.0.0.1:%d" % srv1.port)
+    try:
+        cli.call("init_param", "w", np.zeros((2, 2), np.float32))
+        cli.call("sparse_grad_sgd", "w",
+                 np.asarray([0], np.int64),
+                 np.ones((1, 2), np.float32), 1.0)
+        good = np.asarray(ps1.scope.find_var("w")).copy()
+    finally:
+        cli.close()
+        srv1.shutdown()
+        ps1.heartbeat.stop()
+    snaps = sorted(os.listdir(ckpt_dir))
+    assert len(snaps) == 2, snaps
+    with open(os.path.join(ckpt_dir, snaps[-1]), "wb") as f:
+        f.write(b"torn write")
+    ps2 = ParameterServer(fw.Program(), None, trainers=1, mode="async",
+                          ckpt_dir=ckpt_dir, ckpt_every=1)
+    try:
+        assert ps2.restore_from_checkpoint() is not None
+        # the corrupt newest snapshot fell back to snapshot 0 (the
+        # state right after init_param: zeros)
+        np.testing.assert_array_equal(
+            np.asarray(ps2.scope.find_var("w")),
+            np.zeros((2, 2), np.float32))
+        assert not np.array_equal(
+            np.asarray(ps2.scope.find_var("w")), good)
+    finally:
+        ps2.heartbeat.stop()
+
+
+# -- acceptance: pserver killed mid-run, restarted by the supervisor --------
+
+@pytest.mark.dist
+@pytest.mark.slow
+def test_ps_sync_pserver_killed_and_restarted_identical(tmp_path):
+    """Acceptance (server-role elastic): a sync-PS cohort whose ONE
+    pserver is PADDLE_FAULTS-killed mid-run and restarted by the
+    launch_ps supervisor — restoring tables + dedup markers from its
+    snapshots — completes with per-step losses IDENTICAL to the
+    no-fault run (extends PR 1's exactly-once acceptance to the server
+    role)."""
+    script = tmp_path / "role.py"
+    script.write_text(
+        "import os, sys\n"
+        "sys.path.insert(0, %r)\n"
+        "sys.path.insert(0, %r)\n"
+        "import dist_ps_runner as R\n"
+        "role = os.environ['TRAINING_ROLE']\n"
+        "eps = os.environ['PADDLE_PSERVERS_IP_PORT_LIST']\n"
+        "n = int(os.environ['PADDLE_TRAINERS_NUM'])\n"
+        "if role == 'PSERVER':\n"
+        "    if int(os.environ.get('PADDLE_RESTART_NUM', '0')) > 0:\n"
+        "        os.environ.pop('PADDLE_FAULTS', None)\n"
+        "    R.run_pserver(os.environ['PADDLE_CURRENT_ENDPOINT'],\n"
+        "                  eps, n, 'sync')\n"
+        "else:\n"
+        "    os.environ.pop('PADDLE_FAULTS', None)\n"
+        "    R.run_trainer(int(os.environ['PADDLE_TRAINER_ID']),\n"
+        "                  eps, n, 'sync')\n"
+        % (_DIR, _REPO))
+
+    from paddle_tpu.distributed import launch_ps
+
+    def run(tag, fault_spec, max_restarts):
+        logs = str(tmp_path / ("logs_" + tag))
+        server_ep = "127.0.0.1:%d" % _free_port()
+        env_backup = dict(os.environ)
+        clean = _env({})
+        clean["PADDLE_RPC_RETRIES"] = "60"  # ride out the jax restart
+        # the killed server's flight dump must land here, not in CWD
+        clean["FLAGS_tpu_telemetry_dir"] = str(
+            tmp_path / ("telemetry_" + tag))
+        if fault_spec:
+            clean["PADDLE_FAULTS"] = fault_spec
+        argv = ["--servers", server_ep, "--worker_num", "2",
+                "--log_dir", logs,
+                "--ps_ckpt_dir", str(tmp_path / ("ps_state_" + tag)),
+                str(script)]
+        if max_restarts:
+            argv = ["--max_restarts", str(max_restarts)] + argv
+        try:
+            os.environ.clear()
+            os.environ.update(clean)
+            rc = launch_ps.launch(argv)
+        finally:
+            os.environ.clear()
+            os.environ.update(env_backup)
+        assert rc == 0, open(
+            os.path.join(logs, "workerlog.0.log")).read()
+        out = []
+        for i in range(2):
+            with open(os.path.join(logs,
+                                   "workerlog.%d.log" % i)) as f:
+                out.append([ln for ln in f.read().splitlines()
+                            if ln.startswith("LOSS")])
+        return out, logs
+
+    clean_losses, _ = run("clean", None, 0)
+    # the kill lands mid-run on the server's Nth socket recv event
+    faulty_losses, logs = run(
+        "kill", "kill:side=server,point=recv,at=25", 2)
+    with open(os.path.join(logs, "serverlog.0.log")) as f:
+        slog = f.read()
+    assert slog.count("SERVING") >= 2, \
+        "server was not restarted by the supervisor:\n" + slog
+    assert all(len(ls) == 5 for ls in clean_losses), clean_losses
+    assert clean_losses == faulty_losses, (clean_losses, faulty_losses)

@@ -375,6 +375,104 @@ def test_drain_window_resets():
     assert reg.step == 2  # the monotonic counter survives the drain
 
 
+def test_online_aggregator_ticks_on_cadence_and_names_straggler():
+    """Online straggler allgather on a CADENCE (carried-over ROADMAP
+    item): two ranks with their own registries exchange window
+    summaries every `window` steps over a real host-collective group;
+    each rank gets a straggler_window event naming the heavy rank after
+    every window — live degradation visibility, not just end-of-run."""
+    from paddle_tpu.distributed.host_collectives import \
+        HostCollectiveGroup
+    from paddle_tpu.observability.registry import MetricsRegistry
+
+    g0 = HostCollectiveGroup(0, 2, "127.0.0.1:0")
+    g1 = HostCollectiveGroup(1, 2,
+                             "127.0.0.1:%d" % g0._server.port)
+    regs = [MetricsRegistry(rank=r) for r in range(2)]
+    aggs = [aggregate.OnlineAggregator(g, window=4, reg=reg)
+            for g, reg in zip((g0, g1), regs)]
+    errs = []
+
+    def run(r):
+        try:
+            for _ in range(8):
+                regs[r].record_step(_step_phases(
+                    total_ms=30.0 if r == 1 else 5.0,
+                    dispatch_ms=25.0 if r == 1 else 5.0))
+                aggs[r].maybe_tick()
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+    try:
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        assert not errs, errs
+        for r, reg in enumerate(regs):
+            assert reg.counter("event.straggler_window").value == 2, \
+                "rank %d: expected 2 window exchanges over 8 steps" % r
+            assert reg.gauge("straggler.rank").value == 1
+            assert reg.gauge("straggler.slack_ms").value == 25.0
+            agg = aggs[r].last
+            assert agg["straggler"]["rank"] == 1
+            assert agg["straggler"]["blame_phase"] == "dispatch_ms"
+        # the drain is real: the second window summarized only its own
+        # 4 steps
+        assert aggs[0].last["steps"] == 4
+    finally:
+        g1.shutdown()
+        g0.shutdown()
+
+
+def test_online_aggregator_wired_into_executor_epilogue():
+    """observability.enable_online_stragglers arms the cadence in the
+    executor step epilogue (on_executor_step) against the GLOBAL
+    registry; a world-1 duck-typed group keeps it in-process."""
+
+    class _SoloGroup:
+        def all_gather(self, blob):
+            return [np.asarray(blob)]
+
+    reg = obs.configure(rank=0)
+    try:
+        agg = obs.enable_online_stragglers(_SoloGroup(), window=3)
+        for _ in range(7):
+            obs.on_executor_step(_step_phases(total_ms=8.0))
+        assert reg.counter("event.straggler_window").value == 2
+        assert agg.last is not None and agg.last["ranks"] == 1
+        assert reg.step == 7
+    finally:
+        obs.disable_online_stragglers()
+
+
+def test_online_aggregator_disarms_after_exchange_failure():
+    """A dead rank mid-window must degrade the straggler view, not the
+    step loop: the failed exchange lands ONE warning event and DISARMS
+    the aggregator — re-running the collective every window would
+    stall each survivor for the full dead-rank detection wait, over
+    and over."""
+
+    class _BrokenGroup:
+        calls = 0
+
+        def all_gather(self, blob):
+            _BrokenGroup.calls += 1
+            raise ConnectionError("peer gone")
+
+    from paddle_tpu.observability.registry import MetricsRegistry
+
+    reg = MetricsRegistry(rank=0)
+    agg = aggregate.OnlineAggregator(_BrokenGroup(), window=2, reg=reg)
+    for _ in range(6):
+        reg.record_step(_step_phases())
+        agg.maybe_tick()  # must not raise
+    assert agg.last is None and agg.dead
+    assert _BrokenGroup.calls == 1, "disarm must stop the collective"
+    assert reg.counter("event.straggler_window").value == 1  # one warn
+
+
 def test_perf_analysis_stragglers_cli_logic(tmp_path, capsys):
     reg = obs.configure(telemetry_dir=str(tmp_path), rank=0)
     for _ in range(8):

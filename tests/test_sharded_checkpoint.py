@@ -47,6 +47,51 @@ def test_sharded_roundtrip_preserves_sharding():
     mgr.close()
 
 
+def test_restore_relays_out_on_a_different_world_size():
+    """Elastic restart (N' != N): a checkpoint written by a 4-device dp
+    mesh restores DIRECTLY into a template laid out on a 2-device mesh
+    (and vice versa back to 4) — orbax re-lays shards out against the
+    template's shardings, values exactly preserved. This is the
+    SPMD-trainer half of the world-size-change story (the ZeRO flat
+    buffers re-shard via the executor's scope conversion; see
+    tests/test_elastic.py)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.distributed import ShardedCheckpointManager
+
+    def tree_on(ndev):
+        mesh = Mesh(np.array(jax.devices()[:ndev]), ("dp",))
+        r = np.random.RandomState(7)
+        w = jnp.asarray(r.randn(8, 16).astype("float32"))
+        b = jnp.asarray(r.randn(16).astype("float32"))
+        return {
+            "w": jax.device_put(w, NamedSharding(mesh, P("dp"))),
+            "b": jax.device_put(b, NamedSharding(mesh, P())),
+        }
+
+    d = tempfile.mkdtemp()
+    mgr = ShardedCheckpointManager(d, max_to_keep=2)
+    four = tree_on(4)
+    mgr.save(0, four)
+
+    two = tree_on(2)
+    restored = mgr.restore(template=two)
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.asarray(four["w"]))
+    assert restored["w"].sharding == two["w"].sharding
+    assert len(restored["w"].sharding.device_set) == 2
+
+    # shrink persists: a checkpoint SAVED at 2 grows back to 4
+    mgr.save(1, restored)
+    regrown = mgr.restore(template=four)
+    np.testing.assert_array_equal(np.asarray(regrown["w"]),
+                                  np.asarray(four["w"]))
+    assert len(regrown["w"].sharding.device_set) == 4
+    mgr.close()
+
+
 def test_scalar_leaves_roundtrip():
     """Plain python scalars in the state tree (lr, epoch) must survive
     the save -> restore(template) round trip."""

@@ -18,6 +18,15 @@ Usage: python tools/perf_analysis.py [--batches 256,512]
        python tools/perf_analysis.py --lint [tpu_lint args...]
        python tools/perf_analysis.py --stragglers \
            --telemetry-dir DIR [--window 32]
+       python tools/perf_analysis.py --elastic --log-dir DIR
+
+`--elastic` reports the elastic-restart seams of a supervised run
+(distributed/launch.py --min_ranks): every `elastic_transition` event
+the supervisor published (old/new world, failed ranks, rank
+reassignment map, recovery wall time) plus the per-attempt postmortem
+index, from <log_dir>/telemetry/telemetry.supervisor.jsonl and
+<log_dir>/postmortem/index.json. Exits 0 when transitions were found,
+1 on a fixed-world run, 2 when the dir is missing.
 
 `--stragglers` is the offline cross-rank straggler analysis over the
 per-rank telemetry JSONL a run wrote (paddle_tpu/observability;
@@ -450,10 +459,92 @@ def stragglers(telemetry_dir, window=32):
     return 0
 
 
+def elastic_report(log_dir=None, telemetry_dir=None):
+    """Elastic-restart recovery report: the supervisor's
+    `elastic_transition` events (telemetry.supervisor.jsonl — old/new
+    world, reassignment map, recovery wall time) stitched with the
+    per-attempt postmortem index, so one command answers "what did the
+    run lose at each seam". Returns the process exit code."""
+    import json
+
+    if telemetry_dir is None and log_dir:
+        telemetry_dir = os.path.join(log_dir, "telemetry")
+    if not telemetry_dir or not os.path.isdir(telemetry_dir):
+        print("no telemetry dir at %r" % telemetry_dir)
+        return 2
+    sup = os.path.join(telemetry_dir, "telemetry.supervisor.jsonl")
+    transitions = []
+    if os.path.exists(sup):
+        with open(sup) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn final line of a killed writer
+                if rec.get("event") == "elastic_transition":
+                    transitions.append(rec)
+    index = None
+    pm_index = os.path.join(os.path.dirname(telemetry_dir),
+                            "postmortem", "index.json")
+    if log_dir:
+        pm_index = os.path.join(log_dir, "postmortem", "index.json")
+    if os.path.exists(pm_index):
+        with open(pm_index) as f:
+            index = json.load(f)
+    if not transitions:
+        print("no elastic_transition events under %s (fixed-world run, "
+              "or the supervisor ran without --min_ranks)"
+              % telemetry_dir)
+    for t in transitions:
+        print("attempt %s: world %s -> %s, dropped ranks %s, "
+              "reassignment %s, recovery %.2fs"
+              % (t.get("attempt"), t.get("old_world"),
+                 t.get("new_world"), t.get("failed_ranks"),
+                 t.get("reassignment"), float(t.get("recovery_s",
+                                                    0.0))))
+    if transitions:
+        total = sum(float(t.get("recovery_s", 0.0)) for t in transitions)
+        print("total supervisor recovery wall time: %.2fs over %d "
+              "transition(s)" % (total, len(transitions)))
+    print(json.dumps({"transitions": transitions,
+                      "postmortem_index": index},
+                     indent=1, sort_keys=True))
+    return 0 if transitions else 1
+
+
 def main():
     batches = [256, 512]
     resnet_batches = [128, 256]
     args = sys.argv[1:]
+    if "--elastic" in args:
+        ldir, tdir = None, None
+        rest = [a for a in args if a != "--elastic"]
+        i = 0
+        while i < len(rest):
+            a = rest[i]
+            if "=" in a:
+                flag, val = a.split("=", 1)
+            else:
+                flag = a
+                val = rest[i + 1] if i + 1 < len(rest) else ""
+                if not val or val.startswith("--"):
+                    raise SystemExit("flag %s needs a value" % flag)
+                i += 1
+            if flag == "--log-dir":
+                ldir = val
+            elif flag == "--telemetry-dir":
+                tdir = val
+            else:
+                raise SystemExit("unknown --elastic argument: %s" % flag)
+            i += 1
+        if not (ldir or tdir):
+            raise SystemExit(
+                "usage: --elastic --log-dir DIR | --telemetry-dir DIR")
+        raise SystemExit(elastic_report(log_dir=ldir,
+                                        telemetry_dir=tdir))
     if "--stragglers" in args:
         tdir, window = None, 32
         rest = [a for a in args if a != "--stragglers"]
