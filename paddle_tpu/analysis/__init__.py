@@ -38,6 +38,7 @@ from .findings import (Finding, SEVERITIES, format_finding,  # noqa: F401
 from .collectives import (IR_COLLECTIVE_OPS,  # noqa: F401
                           check_branch_uniformity,
                           check_collective_divergence,
+                          check_hierarchical_groups,
                           check_hlo_divergence, collective_schedule,
                           hlo_collective_schedule)
 from .donation import (check_donation_safety,  # noqa: F401
@@ -53,6 +54,7 @@ __all__ = [
     "IR_COLLECTIVE_OPS", "collective_schedule",
     "check_branch_uniformity", "check_collective_divergence",
     "hlo_collective_schedule", "check_hlo_divergence",
+    "check_hierarchical_groups",
     "check_donation_safety", "cross_check_donation_report",
     "check_host_sync", "check_shard_plan", "check_zero2_lifetimes",
     "check_dtype_shape_contracts", "run_static_checks",

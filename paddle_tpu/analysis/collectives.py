@@ -28,7 +28,6 @@ schedule (the trip count is static/uniform) and are recorded inline.
 """
 from __future__ import annotations
 
-import re
 from typing import List
 
 from .findings import Finding
@@ -276,25 +275,93 @@ def _diff_schedules(schedules, labels, key_fn, loc_fn):
 # lowered-HLO level: the same check over StableHLO module text
 # ---------------------------------------------------------------------------
 
-_HLO_GROUPS = re.compile(r"replica_groups\s*=\s*dense<([^>]*)>")
-
-
 def hlo_collective_schedule(stablehlo_text):
     """Ordered collective records from a lowered StableHLO module:
-    [{kind, type, replica_groups}] — textual order IS program order.
-    The line-scan state machine is `lowering._hlo_collective_hits`, the
-    SAME parser `collective_byte_census` uses (region-bearing ops carry
-    their result type + attrs on the region's closing line); this layer
-    only adds the replica_groups pick-off."""
-    from ..fluid.lowering import _hlo_collective_hits
+    [{kind, type, replica_groups, groups}] — textual order IS program
+    order. The line-scan state machine is
+    `lowering._hlo_collective_hits`, the SAME parser
+    `collective_byte_census` uses (region-bearing ops carry their
+    result type + attrs on the region's closing line); this layer only
+    adds the replica_groups pick-off (`groups` is the parsed tuple of
+    member tuples, None when absent)."""
+    from ..fluid.lowering import _hlo_collective_hits, \
+        parse_replica_groups, replica_groups_raw
 
     out = []
     for kind, ttype, open_line, close_line in \
             _hlo_collective_hits(stablehlo_text):
-        g = _HLO_GROUPS.search(open_line) or _HLO_GROUPS.search(close_line)
         out.append({"kind": kind, "type": ttype,
-                    "replica_groups": g.group(1).strip() if g else ""})
+                    "replica_groups": replica_groups_raw(
+                        open_line, close_line) or "",
+                    "groups": parse_replica_groups(open_line,
+                                                   close_line)})
     return out
+
+
+def check_hierarchical_groups(stablehlo_text, ici_size, ndev=None,
+                              label=None):
+    """Two-level replica_groups audit of one lowered module on a
+    hybrid (dcn, ici) mesh whose pods are contiguous device blocks of
+    `ici_size`: every collective's group set must be one of the three
+    legal hierarchical shapes —
+
+    - **intra-pod** (ici): every group lies inside one pod,
+    - **cross-pod** (dcn): every group takes at most ONE member per
+      pod (the shard exchange between pods),
+    - **global**: one group spanning the whole world (a flat
+      collective — legal, e.g. the AMP found_inf psum over both axes).
+
+    Anything else is an error: a NON-UNIFORM pod split (groups of
+    unequal sizes — some ranks wait on a collective their peers never
+    join: deadlock) or a MIXED-axis collective (a group spanning pods
+    with several members inside one pod — neither tier's ring; on real
+    hardware it serializes full gradient bytes over the slow DCN link
+    and the per-pod schedules disagree)."""
+    findings: List[Finding] = []
+    sched = hlo_collective_schedule(stablehlo_text)
+    ici_size = int(ici_size)
+    if ici_size <= 1:
+        return findings
+    world = int(ndev) if ndev else max(
+        (d + 1 for rec in sched for g in (rec["groups"] or ())
+         for d in g), default=0)
+    where = " [%s]" % label if label else ""
+    for pos, rec in enumerate(sched):
+        groups = rec["groups"]
+        if not groups:
+            continue  # no membership info: ring-implicit collective
+        sizes = {len(g) for g in groups}
+        if len(sizes) > 1:
+            findings.append(Finding(
+                "collective-divergence", "error",
+                "collective #%d (%s)%s: NON-UNIFORM pod split — "
+                "replica_groups %s have unequal sizes %s; the ranks "
+                "in the smaller group complete while the larger "
+                "group's members wait on phantom peers (deadlock on "
+                "real DCN+ICI)." % (pos, rec["kind"], where,
+                                    rec["replica_groups"],
+                                    sorted(sizes)),
+                op_type=rec["kind"]))
+            continue
+        if len(groups) == 1 and world and len(groups[0]) == world:
+            continue  # global (flat) collective: legal
+        intra = all(len({d // ici_size for d in g}) == 1
+                    for g in groups)
+        cross = all(len({d // ici_size for d in g}) == len(g)
+                    for g in groups)
+        if not intra and not cross:
+            findings.append(Finding(
+                "collective-divergence", "error",
+                "collective #%d (%s)%s: WRONG-AXIS (mixed) "
+                "replica_groups %s — a group spans pods while "
+                "holding several members of one pod, so it is "
+                "neither an intra-pod (ici) nor a one-member-per-pod "
+                "cross-pod (dcn) collective; it would serialize full "
+                "payload bytes over the slow DCN link and the "
+                "per-pod schedules disagree." % (
+                    pos, rec["kind"], where, rec["replica_groups"]),
+                op_type=rec["kind"]))
+    return findings
 
 
 def check_hlo_divergence(stablehlo_texts, labels=None):

@@ -54,16 +54,31 @@ def _donation_flags(program):
 
 def check_donation_safety(program, feed_names=None, fetch_names=None):
     """Reads/writes walk over the global block proving no buffer-holding
-    op consumes a feed/state buffer before an in-place rebind of it."""
+    op consumes a feed/state buffer before an in-place rebind of it.
+
+    Dygraph-to-static / jit.load programs (`program._feed_donate` is
+    False — their feeds are CALLER-OWNED eager tensors re-fed every
+    call) get the same walk: the state-donation hazards are identical,
+    and their real feed list rides on ``program._feed_names`` (set by
+    ConcreteProgram/_LoadedLayer) because those feed vars are not
+    ``is_data``-marked, so the default discovery below would miss them
+    — previously this whole path had no static coverage. Additionally,
+    a program op that REBINDS a caller-owned feed var is flagged as a
+    warning: without donation the write is SSA-internal, so the
+    caller's eager tensor silently keeps its OLD value — an
+    eager/static state-coherence surprise, not a memory hazard."""
     from ..fluid import lowering
 
     block = program.global_block()
     donate, feed_donate = _donation_flags(program)
     if not donate:
         return []
+    caller_owned = getattr(program, "_feed_donate", True) is False
     if feed_names is None:
-        feed_names = [v.name for v in block.vars.values()
-                      if getattr(v, "is_data", False)]
+        feed_names = getattr(program, "_feed_names", None)
+        if feed_names is None:
+            feed_names = [v.name for v in block.vars.values()
+                          if getattr(v, "is_data", False)]
     fetch_names = list(fetch_names or [])
 
     state_in, state_out = lowering.analyze_block(
@@ -111,6 +126,23 @@ def check_donation_safety(program, feed_names=None, fetch_names=None):
                     "this step and the original feed value is "
                     "unrecoverable after block %d op %d (%s)." % (
                         name, b_idx, o_idx, actor),
+                    block_idx=b_idx, op_idx=o_idx,
+                    op_type=actor, var=name))
+            if name in feed_set and caller_owned and \
+                    name not in warned_feed and actor != "feed":
+                # dygraph-to-static: the caller re-feeds its OWN eager
+                # tensor every call; an in-program rebind of that feed
+                # is SSA-internal, so the eager side never sees it
+                warned_feed.add(name)
+                findings.append(Finding(
+                    "donation-safety", "warning",
+                    "dygraph-to-static program rebinds caller-owned "
+                    "feed var %r at block %d op %d (%s): the write "
+                    "stays internal to the traced step — the caller's "
+                    "eager tensor keeps its old value, an eager/"
+                    "static coherence surprise. Return the new value "
+                    "as an output instead of assigning into the "
+                    "input." % (name, b_idx, o_idx, actor),
                     block_idx=b_idx, op_idx=o_idx,
                     op_type=actor, var=name))
     return findings

@@ -303,6 +303,39 @@ class HostCollectiveGroup:
         self._seq += 1
         return "%s#%d" % (tag, self._seq)
 
+    def _comm_lane(self):
+        """"dcn" | "ici" lane of this group's collectives on a
+        multi-pod launch, or None when no pod topology is declared
+        (FLAGS_tpu_dcn_replicas / PADDLE_NUM_PODS unset/1 — the flat
+        pre-hybrid reading, no extra counters). Pod of rank r =
+        r // (global_world / num_pods), the launcher's contiguous-block
+        assignment; a group spanning two pods coordinates over the
+        slow DCN link, one confined to a single pod (a sub-world group
+        smaller than a pod) stays "ici". Today's full-world groups
+        therefore classify as "dcn" whenever pods > 1 — cross-rank
+        host coordination IS cross-pod traffic there."""
+        lane = getattr(self, "_comm_lane_cached", False)
+        if lane is not False:
+            return lane
+        from ..parallel import env as penv
+
+        npods = penv.dcn_replicas()
+        if npods <= 1 or self.world <= 1:
+            lane = None
+        else:
+            # pod size derives from the GLOBAL launch world (this
+            # group may span a subset of it), never less than 1
+            try:
+                gw = int(os.environ.get("PADDLE_TRAINERS_NUM", "0")
+                         or 0) or self.world
+            except ValueError:
+                gw = self.world
+            per_pod = max(1, gw // npods)
+            pods = {r // per_pod for r in range(self.world)}
+            lane = "dcn" if len(pods) > 1 else "ici"
+        self._comm_lane_cached = lane
+        return lane
+
     @contextlib.contextmanager
     def _comm_phase(self, op=None, key=None):
         """Account host-collective wall time to the profiler's `comm`
@@ -323,6 +356,14 @@ class HostCollectiveGroup:
         finally:
             dt = time.perf_counter() - t0
             _prof.record_step_phase("comm", dt, t0)
+            # multi-pod launches (PADDLE_NUM_PODS > 1): break the comm
+            # phase down by interconnect lane — a group whose rank set
+            # spans two pods coordinates over the slow DCN link; a
+            # within-pod group stays on the fast tier. Counter-only
+            # (no second trace span — it is the SAME wall time).
+            lane = self._comm_lane()
+            if lane is not None:
+                _prof.record_step_phase("comm_" + lane, dt)
             if ok and op is not None:
                 try:
                     from ..observability.registry import registry

@@ -37,7 +37,15 @@ exception):
     map, recovery wall time) in <telemetry_dir>/telemetry.supervisor.jsonl.
     Elastic shrink needs the supervisor to own the whole cohort (the
     all-localhost multi-endpoint mode); per-host launchers fall back to
-    fixed-world restarts;
+    fixed-world restarts. With `--num_pods K` (or PADDLE_NUM_PODS) the
+    ranks partition into K contiguous pods (PADDLE_POD_ID exported;
+    hybrid DCN+ICI meshes and the comm-lane telemetry read the
+    topology) and the shrink is POD-AWARE: pods stay rectangular
+    (every pod lost the same rank count) or the next cohort falls back
+    to a flat single-pod world keeping every survivor — the
+    elastic_transition event names which (`pod_topology`:
+    "rectangular" | "flat_fallback") — never a lopsided topology that
+    wedges the hybrid-mesh rendezvous;
   - SIGINT and SIGTERM both tear the cohort down (exit 128+signum);
   - supervised workers default PADDLE_CKPT_AGREE=1: multi-host
     checkpoint restore agrees cross-rank on the newest step EVERY rank
@@ -84,13 +92,76 @@ def _parse_args(argv):
                         "dead workers and relaunch the survivors at any "
                         "world size >= M (0 = fixed world: all N must "
                         "come back)")
+    p.add_argument("--num_pods", type=int, default=0,
+                   help="multi-pod topology: partition the ranks into K "
+                        "contiguous pods (PADDLE_NUM_PODS/PADDLE_POD_ID "
+                        "exported to workers; hybrid DCN+ICI meshes and "
+                        "the comm-lane telemetry read them). 0 = the "
+                        "PADDLE_NUM_PODS env, else flat. Elastic "
+                        "shrink keeps pods RECTANGULAR (equal-size) or "
+                        "falls back to a flat world — never a wedged "
+                        "rendezvous")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
 
 
+def _launch_num_pods(args, world):
+    """The effective pod count for a cohort of `world` ranks:
+    --num_pods, else PADDLE_NUM_PODS, else 1 (flat). A count that does
+    not divide the world cannot form rectangular pods — warn and run
+    flat rather than hand the workers a lopsided topology."""
+    npods = args.num_pods
+    if not npods:
+        try:
+            npods = int(os.environ.get("PADDLE_NUM_PODS", "1") or 1)
+        except ValueError:
+            npods = 1
+    if npods <= 1:
+        return 1
+    if world % npods:
+        sys.stderr.write(
+            "paddle_tpu.launch: %d rank(s) not divisible into %d "
+            "pods; running a flat (single-pod) world\n"
+            % (world, npods))
+        return 1
+    return npods
+
+
+def _pod_shrink(endpoints, failed_tids, npods):
+    """Pod-aware elastic shrink decision. Returns (survivor_endpoints,
+    new_npods, pod_event_fields): the surviving endpoints in rank
+    order, the pod count of the NEXT cohort, and the fields the
+    elastic_transition event carries. Pods stay RECTANGULAR — every
+    pod the same size, the invariant a hybrid (dcn, ici) mesh needs —
+    when each pod lost the same number of ranks; otherwise the next
+    cohort falls back to a flat (npods=1) world with every survivor,
+    and the event names the fallback. Never returns a lopsided
+    topology (the wedged-rendezvous failure mode)."""
+    failed = set(failed_tids)
+    survivors = [ep for tid, ep in enumerate(endpoints)
+                 if tid not in failed]
+    if npods <= 1:
+        return survivors, 1, {}
+    per_pod = len(endpoints) // npods
+    counts = [0] * npods
+    for tid in range(len(endpoints)):
+        if tid not in failed:
+            counts[tid // per_pod] += 1
+    rectangular = len(set(counts)) == 1 and counts[0] > 0
+    if rectangular:
+        return survivors, npods, {
+            "pods_old": npods, "pods_new": npods,
+            "pod_topology": "rectangular",
+            "ranks_per_pod": counts[0]}
+    return survivors, 1, {
+        "pods_old": npods, "pods_new": 1,
+        "pod_topology": "flat_fallback",
+        "pod_survivor_counts": counts}
+
+
 def _worker_env(endpoints, tid, restart_no, base_env=None,
-                telemetry_dir=None):
+                telemetry_dir=None, npods=1):
     """The PADDLE_* contract for one supervised worker. Cross-rank
     checkpoint-step agreement (PADDLE_CKPT_AGREE, see
     distributed/sharded_checkpoint.agree_newest_intact) is ON by
@@ -115,6 +186,19 @@ def _worker_env(endpoints, tid, restart_no, base_env=None,
         "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
         "PADDLE_RESTART_NUM": str(restart_no),
     })
+    if npods > 1:
+        # multi-pod topology: contiguous rank blocks per pod. Workers
+        # read these into hybrid (dcn, ici) meshes
+        # (parallel/env.dcn_replicas) and the comm-lane telemetry
+        env.update({
+            "PADDLE_NUM_PODS": str(npods),
+            "PADDLE_POD_ID": str(tid // (len(endpoints) // npods)),
+        })
+    else:
+        # an elastic flat fallback must not leak the OLD topology into
+        # the shrunk cohort through the inherited environment
+        env.pop("PADDLE_NUM_PODS", None)
+        env.pop("PADDLE_POD_ID", None)
     return env
 
 
@@ -250,14 +334,14 @@ def _supervisor_event(args, etype, **fields):
     return rec
 
 
-def _spawn_cohort(args, endpoints, local_ids, restart_no):
+def _spawn_cohort(args, endpoints, local_ids, restart_no, npods=1):
     procs, logs = [], []
     tdir = _telemetry_dir_for(args)
     if tdir:
         os.makedirs(tdir, exist_ok=True)
     for tid in local_ids:
         env = _worker_env(endpoints, tid, restart_no,
-                          telemetry_dir=tdir)
+                          telemetry_dir=tdir, npods=npods)
         cmd = [sys.executable, "-u", args.training_script] \
             + args.training_script_args
         out = None
@@ -365,6 +449,7 @@ def launch(argv=None):
     max_r = max(args.max_restarts, 0)
     rc = 0
     pending_evt, t_fail = None, None
+    npods = _launch_num_pods(args, len(endpoints))
     for attempt in range(max_r + 1):
         # On a single-host invocation with multiple endpoints we spawn
         # them all locally (test/dev mode, mirrors
@@ -373,7 +458,8 @@ def launch(argv=None):
         # Recomputed per attempt: an elastic shrink changes the world.
         local_ids = list(range(len(endpoints))) \
             if _owns_whole_cohort(args, endpoints) else [host_id]
-        procs, logs = _spawn_cohort(args, endpoints, local_ids, attempt)
+        procs, logs = _spawn_cohort(args, endpoints, local_ids, attempt,
+                                    npods=npods)
         if pending_evt is not None:
             # recovery wall time = failure detection -> shrunk cohort
             # respawned (the workers' own restore/re-compile time shows
@@ -400,8 +486,8 @@ def launch(argv=None):
             break
         if args.min_ranks > 0 and failed_tids \
                 and _owns_whole_cohort(args, endpoints):
-            survivors = [ep for tid, ep in enumerate(endpoints)
-                         if tid not in set(failed_tids)]
+            survivors, new_npods, pod_fields = _pod_shrink(
+                endpoints, failed_tids, npods)
             if len(survivors) < args.min_ranks:
                 sys.stderr.write(
                     "paddle_tpu.launch: only %d endpoint(s) left after "
@@ -420,14 +506,19 @@ def launch(argv=None):
                     failed_ranks=sorted(failed_tids),
                     reassignment={str(o): n
                                   for o, n in reassignment.items()},
-                    attempt=attempt + 1)
+                    attempt=attempt + 1, **pod_fields)
                 sys.stderr.write(
                     "paddle_tpu.launch: elastic shrink %d -> %d ranks "
-                    "(dropped %s; reassignment %s)\n"
+                    "(dropped %s; reassignment %s%s)\n"
                     % (len(endpoints), len(survivors),
                        sorted(failed_tids),
-                       {o: n for o, n in sorted(reassignment.items())}))
+                       {o: n for o, n in sorted(reassignment.items())},
+                       ("; pods %d -> %d (%s)" % (
+                           npods, new_npods,
+                           pod_fields.get("pod_topology"))
+                        if npods > 1 else "")))
                 endpoints = survivors
+                npods = new_npods
         sys.stderr.write(
             "paddle_tpu.launch: cohort failed (rc=%d); restart "
             "%d/%d\n" % (rc, attempt + 1, args.max_restarts))

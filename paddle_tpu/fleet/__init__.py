@@ -35,8 +35,13 @@ from . import util  # noqa: F401  (fleet.util collective helpers)
 class DistributedStrategy:
     """Strategy knobs (reference: `framework/distributed_strategy.proto:25`
     backing `fleet/base/distributed_strategy.py:57`). Knobs that exist to
-    work around GPU limits (fuse_all_reduce, nccl_comm_num, hierarchical
-    allreduce) are accepted but XLA's collective scheduler owns them."""
+    work around GPU limits (fuse_all_reduce, nccl_comm_num) are accepted
+    but XLA's collective scheduler owns them.
+    `use_hierarchical_allreduce` + `hierarchical_allreduce_inter_nranks`
+    are REAL now: they set `FLAGS_tpu_dcn_replicas` (unless already
+    set), factoring the dp world into a hybrid (dcn, ici) mesh whose
+    grad syncs lower hierarchically — see parallel/README.md
+    "Hierarchical collectives"."""
 
     def __init__(self):
         self.amp = False
@@ -441,6 +446,20 @@ class CollectiveOptimizer:
                     getattr(self._optimizer, "_momentum", 0.9),
                     cfgs.get("sparsity", 0.75),
                     cfgs.get("rampup_begin_step", 0))
+            if getattr(st, "use_hierarchical_allreduce", False) and \
+                    int(getattr(st,
+                                "hierarchical_allreduce_inter_nranks",
+                                1) or 1) > 1:
+                # the reference's GPU hierarchical-allreduce knob maps
+                # onto the REAL hybrid (dcn, ici) mesh now:
+                # inter_nranks = the cross-pod (dcn) degree. Same
+                # precedence as the launcher env — an explicit
+                # FLAGS_tpu_dcn_replicas wins.
+                from ..utils.flags import get_flag, set_flags
+
+                if not int(get_flag("FLAGS_tpu_dcn_replicas", 0) or 0):
+                    set_flags({"FLAGS_tpu_dcn_replicas": int(
+                        st.hierarchical_allreduce_inter_nranks)})
             transpile_collective(
                 loss.block.program,
                 k_steps_localsgd=(st.localsgd_configs["k_steps"]
@@ -526,20 +545,37 @@ def transpile_collective(program, nranks=None, k_steps_localsgd=0,
         return program
     from jax.sharding import Mesh
 
-    mesh = Mesh(np.array(jax.devices()[:nranks]), ("dp",))
+    # hybrid multi-pod factorization (FLAGS_tpu_dcn_replicas /
+    # PADDLE_NUM_PODS > 1): the dp world becomes a (dcn, ici) mesh and
+    # ring 0 spans the axis PAIR — grad c_allreduce_sum ops lower
+    # hierarchically through the sharded-update plan (reduce-scatter
+    # over ici, cross-pod psum over dcn) or, unplanned, as a psum over
+    # both axes. Flat default unchanged byte-for-byte.
+    mesh = penv.create_hybrid_mesh(nranks=nranks)
+    if mesh is not None:
+        program._dp_axis = penv.ICI_AXIS
+        program._dcn_axis = penv.DCN_AXIS
+        penv.register_ring(0, (penv.DCN_AXIS, penv.ICI_AXIS), nranks)
+    else:
+        mesh = Mesh(np.array(jax.devices()[:nranks]), ("dp",))
+        program._dp_axis = "dp"
+        penv.register_ring(0, "dp", nranks)
     program._data_parallel = True
-    program._dp_axis = "dp"
     program._mesh = mesh
     penv.set_global_mesh(mesh)
-    penv.register_ring(0, "dp", nranks)
 
     if sync_batch_norm:
+        # the moments must sync over the WHOLE dp world: on a hybrid
+        # mesh that is the (dcn, ici) axis pair — "dp" would be an
+        # unbound axis name inside the shard_map and crash the step
+        bn_axis = (penv.DCN_AXIS, penv.ICI_AXIS) \
+            if program._dp_axis == penv.ICI_AXIS else program._dp_axis
         n_swapped = 0
         for bi in range(program.num_blocks):
             for op in program.block(bi).ops:
                 if op.type == "batch_norm":
                     op.type = "sync_batch_norm"
-                    op.attrs["axis_name"] = "dp"
+                    op.attrs["axis_name"] = bn_axis
                     n_swapped += 1
         if n_swapped:
             program._version += 1

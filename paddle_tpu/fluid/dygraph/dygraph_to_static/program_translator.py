@@ -217,8 +217,13 @@ class ConcreteProgram:
                  ctx, kw_feed_keys=()):
         self.main = main
         # feeds here are the caller's eager Tensor buffers, re-fed every
-        # forward: never donate them (lowering._feed_donate opt-out)
+        # forward: never donate them (lowering._feed_donate opt-out).
+        # The feed list also rides on the program so tpu-lint's
+        # donation checker audits the dygraph-to-static path with the
+        # REAL feed set (these vars are not `is_data`-marked, so the
+        # checker's default feed discovery would miss them)
         main._feed_donate = False
+        main._feed_names = list(feed_names)
         self.startup = startup
         self.feed_names = feed_names
         self.fetch_vars = fetch_vars

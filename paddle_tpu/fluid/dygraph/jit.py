@@ -119,8 +119,11 @@ class _LoadedLayer(Layer):
         (self._program, self._feed_names,
          self._fetch_vars) = io.load_inference_model(model_path, self._exe)
         # forward() re-feeds caller-owned eager tensor buffers: never
-        # donate them (lowering._feed_donate opt-out)
+        # donate them (lowering._feed_donate opt-out); the feed list
+        # rides along for tpu-lint's donation checker (see
+        # ConcreteProgram)
         self._program._feed_donate = False
+        self._program._feed_names = list(self._feed_names)
 
     def forward(self, *inputs):
         feed = {}

@@ -98,6 +98,8 @@ class Executor:
         t_step = _time.perf_counter()
         ph = {"feed": 0.0, "dispatch": 0.0, "sync": 0.0, "compile": 0.0}
         comm0 = _prof.step_phase_total("comm")
+        lanes0 = {ln: _prof.step_phase_total(ln)
+                  for ln in ("comm_ici", "comm_dcn")}
         try:
             return self._run_impl(program, feed, fetch_list, scope,
                                   return_numpy, use_program_cache, ph)
@@ -122,7 +124,7 @@ class Executor:
                 # a few dict ops when telemetry is idle
                 from .. import observability as _obs
 
-                _obs.on_executor_step({
+                rec = {
                     "feed_ms": ph["feed"] * 1e3,
                     "dispatch_ms": ph["dispatch"] * 1e3,
                     "comm_ms": comm_dt * 1e3,
@@ -130,10 +132,18 @@ class Executor:
                     "host_ms": host_dt * 1e3,
                     "compile_ms": ph["compile"] * 1e3,
                     "total_ms": total * 1e3,
-                    # epoch-domain step START (t_step is perf_counter
-                    # time — unusable next to the event records' epoch
-                    # ts in the same JSONL stream)
-                }, ts=_time.time() - total)
+                }
+                # multi-pod comm lanes: the slice of comm_ms spent on
+                # cross-pod (dcn) vs intra-pod (ici) host coordination
+                # — present only when a pod topology recorded any
+                for ln, t0v in lanes0.items():
+                    lane_dt = _prof.step_phase_total(ln) - t0v
+                    if lane_dt > 0.0:
+                        rec[ln + "_ms"] = lane_dt * 1e3
+                # epoch-domain step START (t_step is perf_counter
+                # time — unusable next to the event records' epoch
+                # ts in the same JSONL stream)
+                _obs.on_executor_step(rec, ts=_time.time() - total)
 
     def _run_impl(self, program, feed, fetch_list, scope, return_numpy,
                   use_program_cache, ph):
@@ -602,10 +612,12 @@ class Executor:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         plan = getattr(entry, "auto_plan", None)
+        data_spec = lowering.data_partition_spec(entry.mesh,
+                                                 entry.dp_axis)
         out = {}
         for n, a in feed_arrays.items():
             spec = plan.feed_specs.get(n, P()) if plan is not None \
-                else P(entry.dp_axis)
+                else data_spec
             target = NamedSharding(entry.mesh, spec)
             if is_on_device(a):
                 if getattr(a, "sharding", None) == target:
@@ -754,13 +766,20 @@ class Executor:
         mesh = getattr(program, "_mesh", None)
         dp_axis = getattr(program, "_dp_axis", "dp")
         if mesh is None and getattr(program, "_data_parallel", False):
-            mesh = lowering._default_mesh(dp_axis)
+            # same construction compile_block will use — a prefetcher
+            # asking for the sharding BEFORE the first compile must not
+            # pin a flat mesh on a program the dcn flag would factor
+            from ..parallel import env as penv
+
+            mesh = penv.create_hybrid_mesh() or \
+                lowering._default_mesh(dp_axis)
             program._mesh = mesh
         if mesh is None:
             return None
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from jax.sharding import NamedSharding
 
-        return NamedSharding(mesh, P(dp_axis))
+        return NamedSharding(mesh,
+                             lowering.data_partition_spec(mesh, dp_axis))
 
     def _cached_lowerable(self, program, feed, fetch_list, scope):
         """(entry, lowered) for the EXECUTOR path's cached executable of
@@ -842,10 +861,10 @@ class Executor:
         feed_bytes = nbytes(favals)
         alias_bytes = int(getattr(ma, "alias_size_in_bytes", 0))
         sharded = entry.sharded_state or {}
-        ndev = 1
-        if entry.mesh is not None:
-            ndev = int(np.prod(
-                [entry.mesh.shape[a] for a in entry.mesh.axis_names]))
+        # shard granularity: the dp axis size — on a hybrid (dcn, ici)
+        # mesh that is the INTRA-POD ici size (each pod holds a full
+        # copy of the 1/ici shards), not the whole world
+        ndev = self._shard_count(entry)
         if sharded:
             # XLA's alias_size_in_bytes is PER DEVICE; a sharded state
             # var occupies only padded/N bytes there — shrink the
@@ -925,6 +944,17 @@ class Executor:
         return out
 
     @staticmethod
+    def _shard_count(entry):
+        """ZeRO shard granularity of a cached entry: the dp-axis size
+        (= intra-pod ici size on a hybrid mesh), 1 off-mesh."""
+        if entry.mesh is None:
+            return 1
+        if entry.dp_axis in entry.mesh.shape:
+            return int(entry.mesh.shape[entry.dp_axis])
+        return int(np.prod(
+            [entry.mesh.shape[a] for a in entry.mesh.axis_names]))
+
+    @staticmethod
     def _aot_compile(entry, lowered, smut):
         """AOT-compile once per cache entry: donation_report and
         overlap_report both need the compiled artifact, and XLA does
@@ -969,8 +999,14 @@ class Executor:
         if entry.mesh is not None:
             ndev = int(np.prod([entry.mesh.shape[a]
                                 for a in entry.mesh.axis_names]))
-        census = lowering.collective_byte_census(lowered.as_text(), ndev)
+        from ..parallel import env as penv
+
+        hier = penv.mesh_hierarchy(entry.mesh)
+        census = lowering.collective_byte_census(
+            lowered.as_text(), ndev,
+            ici_size=(hier[3] if hier is not None else None))
         plan = self._shard_plan_of(program)
+        shards = self._shard_count(entry)
         if plan is not None and getattr(plan, "buckets", ()):
             # the cap the plan was built under, not the live flag (a
             # flag change after compile must not contradict `buckets`)
@@ -981,7 +1017,7 @@ class Executor:
                 "grads": len(b.entries),
                 "dtype": str(b.dtype),
                 "bytes": b.nbytes,
-                "shard_bytes": b.shard_numel(ndev) * b.dtype.itemsize,
+                "shard_bytes": b.shard_numel(shards) * b.dtype.itemsize,
             } for b in plan.buckets]
             census["bucket_bytes_total"] = sum(
                 b.nbytes for b in plan.buckets)

@@ -666,29 +666,34 @@ def _run_gradient_merge(ops, bwd_idx, gm, env, key0, amp_lists,
                                      env[counter_n].dtype)
 
 
-def _amp_found_inf(grads, axis_name):
+def _amp_found_inf(grads, axis_names):
     """Global non-finite indicator over this step's (synced) gradients.
     Counted on each replica's LOCAL values — under ZeRO the 1/N shard
     vecs, 1/N the work of a full-tensor scan — then psum'd over the dp
-    axis when live: the `lax.cond` that skips the weight update must
-    see a replica-UNIFORM predicate (an overflow lands in exactly one
-    replica's shard slots; without the psum the other replicas would
-    run the update branch and its all-gathers alone — deadlock)."""
+    axis/axes when live: the `lax.cond` that skips the weight update
+    must see a replica-UNIFORM predicate (an overflow lands in exactly
+    one replica's shard slots; without the psum the other replicas
+    would run the update branch and its all-gathers alone — deadlock).
+    On a hybrid mesh `axis_names` is the (ici, dcn) pair: the count
+    psums over both so every pod agrees."""
     import jax.numpy as jnp
 
     from ..parallel import env as penv
     from ..parallel import sharded_update as _su
 
+    if isinstance(axis_names, str):
+        axis_names = (axis_names,)
     total = jnp.zeros((), jnp.float32)
     for g in grads.values():
         v = g.vec if isinstance(g, _su.ShardVal) else g
         total = total + jnp.sum(
             (~jnp.isfinite(v.astype(jnp.float32))).astype(jnp.float32))
     axes = penv.active_axes() or {}
-    if axes.get(axis_name, 1) > 1:
-        import jax
+    for axis_name in axis_names:
+        if axis_name is not None and axes.get(axis_name, 1) > 1:
+            import jax
 
-        total = jax.lax.psum(total, axis_name)
+            total = jax.lax.psum(total, axis_name)
     return total > 0
 
 
@@ -904,20 +909,44 @@ def build_block_fn(program, block, feed_names, fetch_names,
     _implicit_dp = getattr(program, "_data_parallel", False) \
         and not _has_explicit_sync
     _dp_axis_name = getattr(program, "_dp_axis", "dp")
+    # hybrid (dcn, ici) mesh: _dp_axis is the intra-pod ici axis and
+    # _dcn_axis the cross-pod one; a full-tensor sync lowers
+    # hierarchically (psum over ici, then the pod partials over dcn)
+    # so its association matches the scatter path's — the pairing that
+    # keeps the sharded update bit-identical to this reference
+    _dcn_axis_name = getattr(program, "_dcn_axis", None)
 
-    def _dp_pmean(g):
-        """pmean over the dp axis when implicit sync is on and the axis
-        is live (inside shard_map); identity otherwise."""
-        if not _implicit_dp:
-            return g
+    def _dp_sync_axes():
         from ..parallel import env as penv
 
         axes = penv.active_axes() or {}
-        if axes.get(_dp_axis_name, 1) > 1:
-            import jax as _jax
+        return tuple(a for a in (_dp_axis_name, _dcn_axis_name)
+                     if a is not None and axes.get(a, 1) > 1)
 
+    def _dp_pmean(g):
+        """pmean over the dp axis when implicit sync is on and the axis
+        is live (inside shard_map); identity otherwise. On a hybrid
+        mesh: hierarchical psum (ici, then dcn) / world."""
+        if not _implicit_dp:
+            return g
+        live = _dp_sync_axes()
+        if not live:
+            return g
+        import jax as _jax
+
+        if _dcn_axis_name is None:
+            # flat dp: keep the exact pre-hybrid lowering
             return _jax.lax.pmean(g, _dp_axis_name)
-        return g
+        from ..parallel import env as penv
+
+        axes = penv.active_axes() or {}
+        total = g
+        world = 1
+        for a in live:
+            total = _jax.lax.psum(total, a)
+            world *= axes[a]
+        return total / world
+
 
     def fn(feeds: Dict, states_mut: Dict, states_ro: Dict, seed):
         env = {}
@@ -1053,7 +1082,8 @@ def build_block_fn(program, block, feed_names, fetch_names,
             found_inf = None
             if dls is not None:
                 found_inf = _amp_found_inf(
-                    {n: grads[n] for n in diff_names}, _dp_axis_name)
+                    {n: grads[n] for n in diff_names},
+                    (_dp_axis_name, _dcn_axis_name))
             # under gradient merge, sync once on the MERGED grads at the
             # k-step boundary instead of k per-micro-step allreduces
             for n in diff_names:
@@ -1120,11 +1150,26 @@ def compile_block(program, block, feed_specs, fetch_names, state_specs,
             "variables %s are read by the program but absent from the scope "
             "— run the startup program (or feed them)" % (missing,))
 
+    from ..parallel import env as penv
+
     mesh = getattr(program, "_mesh", None)
-    dp_axis = getattr(program, "_dp_axis", "dp")
     if getattr(program, "_data_parallel", False) and mesh is None:
-        mesh = _default_mesh(dp_axis)
+        # FLAGS_tpu_dcn_replicas / PADDLE_NUM_PODS > 1 factors the dp
+        # world into a hybrid (dcn, ici) mesh; otherwise the flat
+        # single-axis mesh, byte-for-byte the pre-hybrid lowering
+        mesh = penv.create_hybrid_mesh() or \
+            _default_mesh(getattr(program, "_dp_axis", "dp"))
         program._mesh = mesh
+    # derive the axis roles from the mesh itself, so a hand-built
+    # hybrid mesh (tests: program._mesh = Mesh(devs.reshape(2, 2),
+    # ("dcn", "ici"))) lowers hierarchically without extra marking
+    hier = penv.mesh_hierarchy(mesh)
+    if hier is not None:
+        program._dp_axis = hier[1]   # shard axis = intra-pod ici
+        program._dcn_axis = hier[0]
+    else:
+        program._dcn_axis = None
+    dp_axis = getattr(program, "_dp_axis", "dp")
 
     # ZeRO-1 sharded weight update (FLAGS_tpu_sharded_weight_update):
     # plan once per program; None = keep the replicated update
@@ -1135,8 +1180,10 @@ def compile_block(program, block, feed_specs, fetch_names, state_specs,
         from ..parallel import sharded_update as _su
 
         ndev = int(mesh.shape[dp_axis]) if dp_axis in mesh.shape else 1
-        shard_plan = _su.plan_sharded_update(program, block, ndev,
-                                             dp_axis)
+        shard_plan = _su.plan_sharded_update(
+            program, block, ndev, dp_axis,
+            dcn_axis=(hier[0] if hier is not None else None),
+            dcn_size=(hier[2] if hier is not None else 1))
     program._shard_plan = shard_plan
 
     fn = build_block_fn(program, block, feed_names, fetch_names,
@@ -1321,6 +1368,21 @@ def _default_mesh(dp_axis):
     return Mesh(devs, (dp_axis,))
 
 
+def data_partition_spec(mesh, dp_axis="dp"):
+    """PartitionSpec of a data (batch-sharded) tensor on `mesh`: dim 0
+    over the whole dp world — both axes of a hybrid (dcn, ici) mesh,
+    the single axis otherwise. The one spec feeds/prefetched batches
+    and non-persistable fetches share."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel import env as penv
+
+    hier = penv.mesh_hierarchy(mesh)
+    if hier is not None:
+        return P((hier[0], hier[1]))
+    return P(dp_axis)
+
+
 # -- per-collective byte accounting (offline ICI evidence) -------------------
 
 _COLLECTIVE_OPS = ("all_reduce", "reduce_scatter", "all_gather",
@@ -1376,35 +1438,129 @@ def _hlo_collective_hits(stablehlo_text, op_names=_COLLECTIVE_OPS):
     return hits
 
 
-def collective_byte_census(stablehlo_text, ndev=1):
+_HLO_GROUPS_RE = None
+
+
+def replica_groups_raw(open_line, close_line=""):
+    """The raw text of one collective's `replica_groups = dense<...>`
+    attribute, or None when absent. Region-bearing ops carry their
+    attrs on the region's CLOSING line, so both lines are scanned.
+    THE one replica_groups grammar — `parse_replica_groups` and the
+    divergence checker's schedule records both read through here, so
+    the two can never drift."""
+    global _HLO_GROUPS_RE
+    import re
+
+    if _HLO_GROUPS_RE is None:
+        _HLO_GROUPS_RE = re.compile(
+            r"replica_groups\s*=\s*dense<([^>]*)>")
+    m = _HLO_GROUPS_RE.search(open_line) or \
+        (_HLO_GROUPS_RE.search(close_line) if close_line else None)
+    return m.group(1).strip() if m is not None else None
+
+
+def parse_replica_groups(open_line, close_line=""):
+    """`replica_groups` of one StableHLO collective as a tuple of
+    member tuples, or None when absent / unparsable."""
+    import re
+
+    body = replica_groups_raw(open_line, close_line)
+    if not body:
+        return None
+    try:
+        if "[" not in body:  # dense<0> scalar form
+            return ((int(body),),)
+        groups = []
+        for grp in re.findall(r"\[([^\[\]]*)\]", body):
+            grp = grp.strip()
+            groups.append(tuple(int(t) for t in grp.split(",")) if grp
+                          else ())
+        return tuple(g for g in groups if g) or None
+    except ValueError:
+        return None
+
+
+def classify_replica_groups(groups, ici_size):
+    """"ici" | "dcn" lane of one collective's replica_groups on a
+    hybrid mesh whose pods are contiguous device blocks of `ici_size`
+    (the create_hybrid_mesh CPU/emulation layout): a collective whose
+    every group stays inside one pod rides the fast intra-pod ICI; any
+    group spanning two pods crosses the slow DCN link. None when the
+    groups are unknown (caller treats the collective as ici — the
+    flat-mesh reading)."""
+    if not groups or not ici_size or ici_size <= 1:
+        return None
+    for g in groups:
+        pods = {d // ici_size for d in g}
+        if len(pods) > 1:
+            return "dcn"
+    return "ici"
+
+
+def _ring_wire_bytes(op, b, n):
+    """Ring-algorithm wire bytes of one collective over `n`
+    participants: all_reduce 2(N-1)/N of the full tensor,
+    reduce_scatter (N-1)x its 1/N result, all_gather (N-1)/N of its
+    full result; data-movement ops move their payload once."""
+    n = max(int(n), 1)
+    if op == "all_reduce":
+        return int(2 * (n - 1) / n * b)
+    if op == "reduce_scatter":
+        return (n - 1) * b
+    if op == "all_gather":
+        return int((n - 1) / n * b)
+    return b
+
+
+def collective_byte_census(stablehlo_text, ndev=1, ici_size=None):
     """Per-collective accounting from a lowered StableHLO module:
     {op: {count, tensor_bytes, ici_bytes}} + totals. `tensor_bytes`
     sums the RESULT tensor sizes; `ici_bytes` models ring-algorithm
-    wire bytes on an N-device ring (all_reduce 2(N-1)/N of the full
-    tensor, reduce_scatter (N-1)x its 1/N result, all_gather (N-1)/N of
-    its full result) — the quantity the sharded weight update halves on
-    the grad+param exchange."""
+    wire bytes over each collective's replica_groups participants
+    (falling back to the `ndev`-device ring when groups are absent) —
+    the quantity the sharded weight update halves on the grad+param
+    exchange.
+
+    `ici_size` (hybrid multi-pod mesh): additionally split the census
+    into `lanes` — "ici" (intra-pod) vs "dcn" (cross-pod, the slow
+    link that bounds grad-sync time at multi-pod scale) — with a
+    per-collective byte list per lane, so the hierarchical lowering's
+    claim (cross-pod bytes = flat-allreduce bytes / ici_size per
+    bucket) is checkable from the census alone."""
     ndev = max(int(ndev), 1)
     out = {op: {"count": 0, "tensor_bytes": 0, "ici_bytes": 0}
            for op in _COLLECTIVE_OPS}
-    for op, ttype, _, _ in _hlo_collective_hits(stablehlo_text):
+    lanes = {ln: {"count": 0, "tensor_bytes": 0, "wire_bytes": 0,
+                  "per_collective": []}
+             for ln in ("ici", "dcn")}
+    for op, ttype, open_line, close_line in \
+            _hlo_collective_hits(stablehlo_text):
         b = _tensor_bytes(ttype)
+        groups = parse_replica_groups(open_line, close_line)
+        n = max((len(g) for g in groups), default=ndev) if groups \
+            else ndev
         rec = out[op]
         rec["count"] += 1
         rec["tensor_bytes"] += b
-        if op == "all_reduce":
-            rec["ici_bytes"] += int(2 * (ndev - 1) / ndev * b)
-        elif op == "reduce_scatter":
-            rec["ici_bytes"] += (ndev - 1) * b
-        elif op == "all_gather":
-            rec["ici_bytes"] += int((ndev - 1) / ndev * b)
-        else:
-            rec["ici_bytes"] += b
+        rec["ici_bytes"] += _ring_wire_bytes(op, b, n)
+        if ici_size:
+            lane = classify_replica_groups(groups, ici_size) or "ici"
+            lrec = lanes[lane]
+            lrec["count"] += 1
+            lrec["tensor_bytes"] += b
+            lrec["wire_bytes"] += _ring_wire_bytes(op, b, n)
+            lrec["per_collective"].append(
+                {"kind": op, "tensor_bytes": b, "participants": n})
     out = {k: v for k, v in out.items() if v["count"]}
     out["total_ici_bytes"] = sum(v["ici_bytes"] for v in out.values())
     out["total_tensor_bytes"] = sum(
         v["tensor_bytes"] for v in out.values() if isinstance(v, dict))
     out["ndev"] = ndev
+    if ici_size:
+        out["lanes"] = lanes
+        out["ici_size"] = int(ici_size)
+        out["dcn_size"] = ndev // int(ici_size)
+        out["dcn_bytes_total"] = lanes["dcn"]["wire_bytes"]
     return out
 
 
@@ -1611,6 +1767,12 @@ def _compile_dp(fn, mesh, dp_axis, program, block, feed_names, fetch_names,
     axes = {a: mesh.shape[a] for a in mesh.axis_names}
     sharded_names = frozenset(shard_plan.sharded_state) \
         if shard_plan is not None else frozenset()
+    # hybrid (dcn, ici) mesh: data (batch) shards over BOTH axes —
+    # row-major, so device (pod p, chip j) holds the same batch slice
+    # as flat device p*ici+j — while sharded opt-state stays P(ici)
+    # only (each pod holds a full copy of the 1/ici shards)
+    hier = penv.mesh_hierarchy(mesh)
+    data_axes = (hier[0], hier[1]) if hier is not None else dp_axis
 
     def wrapped(feeds, states_mut, states_ro, seed):
         with penv.collective_scope(axes):
@@ -1622,7 +1784,7 @@ def _compile_dp(fn, mesh, dp_axis, program, block, feed_names, fetch_names,
         sh = {n: v for n, v in new_states.items() if n in sharded_names}
         return fetches, rep, sh
 
-    feed_specs = {n: P(dp_axis) for n in feed_names}
+    feed_specs = {n: P(data_axes) for n in feed_names}
     state_specs_mut = {n: (P(dp_axis) if n in sharded_names else P())
                        for n in state_mut}
     state_specs_ro = {n: P() for n in state_ro}
@@ -1631,7 +1793,7 @@ def _compile_dp(fn, mesh, dp_axis, program, block, feed_names, fetch_names,
         v = block._find_var_recursive(n)
         if v is not None and v.persistable:
             return P()
-        return P(dp_axis)
+        return P(data_axes)
 
     # state_out names are discovered inside fn; replicated except the
     # plan's sharded optimizer state

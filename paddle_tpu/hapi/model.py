@@ -236,10 +236,20 @@ class Model:
         dispatch queue never drains between logged steps (fit defers the
         materialization to every log_freq steps). Wraps the dygraph
         data-parallel idiom when the network is a DataParallel layer
-        (scale_loss -> backward -> apply_collective_grads)."""
+        (scale_loss -> backward -> apply_collective_grads).
+
+        Each step publishes ONE record into the metrics registry
+        (observability.on_executor_step — the same step stream
+        Executor.run feeds), so dygraph fit/evaluate runs show up in
+        `tools/perf_analysis.py --stragglers` and the
+        `tools/timeline.py --telemetry` merge instead of being
+        invisible to the telemetry tier."""
         assert self._optimizer is not None, "call prepare() first"
+        import time as _time
+
         from ..fluid.dygraph.parallel import DataParallel
 
+        t0 = _time.perf_counter()
         with self._dygraph_guard():
             self.network.train()
             inputs = _as_variables(_to_list(inputs))
@@ -254,7 +264,22 @@ class Model:
             self._optimizer.minimize(
                 loss, parameter_list=self.network.parameters())
             self.network.clear_gradients()
+        self._publish_step_record(_time.perf_counter() - t0)
         return loss, outputs, labels
+
+    @staticmethod
+    def _publish_step_record(dt):
+        """One dygraph train step -> one registry step record. The
+        eager step is dispatch-dominated (no executor feed/compile
+        phases to split); host syncs ride separately through
+        _sync_losses' sync-phase accounting. Never raises."""
+        try:
+            from .. import observability as _obs
+
+            _obs.on_executor_step({"dispatch_ms": dt * 1e3,
+                                   "total_ms": dt * 1e3})
+        except Exception:  # noqa: BLE001 - telemetry never gates a step
+            pass
 
     def _sync_losses(self, pending):
         """Materialize a buffer of deferred (loss, outputs, labels)
@@ -307,7 +332,11 @@ class Model:
         evaluate() analogue of _train_batch_device): returns
         (loss_tensor_or_None, outputs, labels) without a host sync, so
         deferred eval loops never drain the dispatch queue between
-        logged steps."""
+        logged steps. Publishes a step record like the train path, so
+        evaluate() runs show up in the telemetry stream too."""
+        import time as _time
+
+        t0 = _time.perf_counter()
         with self._dygraph_guard():
             self.network.eval()
             with dy_base.no_grad():
@@ -316,6 +345,7 @@ class Model:
                 outputs = _to_list(self.network(*inputs))
                 loss = self._compute_loss(outputs, labels) \
                     if labels else None
+        self._publish_step_record(_time.perf_counter() - t0)
         return loss, outputs, labels
 
     def eval_batch(self, inputs, labels=None):

@@ -36,7 +36,9 @@ __all__ = ["window_summary", "allgather_window", "aggregate_summaries",
            "straggler_report", "load_telemetry_dir",
            "OnlineAggregator"]
 
-_PHASES = tuple(f for f in STEP_FIELDS if f != "compile_ms")
+_PHASES = tuple(f for f in STEP_FIELDS
+                if f not in ("compile_ms", "comm_ici_ms",
+                             "comm_dcn_ms"))
 
 
 def _percentile(vals: List[float], q: float) -> Optional[float]:

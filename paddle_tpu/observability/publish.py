@@ -15,6 +15,8 @@ one-line BENCH summary bench.py always printed, and publishes):
     phases_block()                      "phases"
     collectives_blocks(exe, p, f, fl)   "collectives",
                                         "opt_state_sharding", "overlap"
+    hierarchy_block(exe, p, f, fl)      "hierarchy" (hybrid multi-pod
+                                        mesh: dcn/ici lane census)
     precision_block(exe, p, f, fl)      "precision"
     static_checks_block(p)              "static_checks"
     telemetry_block(group=None)         "telemetry" (registry counters,
@@ -27,8 +29,9 @@ from typing import Optional
 
 from .registry import registry
 
-__all__ = ["phases_block", "collectives_blocks", "precision_block",
-           "static_checks_block", "telemetry_block", "bench_blocks"]
+__all__ = ["phases_block", "collectives_blocks", "hierarchy_block",
+           "precision_block", "static_checks_block", "telemetry_block",
+           "bench_blocks"]
 
 
 def phases_block() -> dict:
@@ -69,7 +72,8 @@ def collectives_blocks(exe, program, feed, fetch_list) -> dict:
                       col["total_ici_bytes"])
         print("BENCH collectives: " + ", ".join(
             "%s x%d %.1fMB" % (k, v["count"], v["ici_bytes"] / 1e6)
-            for k, v in col.items() if isinstance(v, dict)),
+            for k, v in col.items()
+            if isinstance(v, dict) and "ici_bytes" in v),
             flush=True)
     if col and col.get("reduce_scatter"):
         # ZeRO-1 active: also report the per-replica optimizer-state
@@ -121,6 +125,58 @@ def collectives_blocks(exe, program, feed, fetch_list) -> dict:
                      ov.get("n_buckets", 0),
                      [c["backward_after"] for c in rs]), flush=True)
     return out
+
+
+def hierarchy_block(exe, program, feed, fetch_list) -> Optional[dict]:
+    """Hierarchical DCN+ICI collective evidence (hybrid multi-pod
+    mesh): the census's ici/dcn lane split, the cross-pod bytes per
+    grad-sync collective, and the modeled flat-allreduce baseline —
+    cross-pod (dcn) bytes should be flat bytes / ici_size per bucket.
+    None for flat (single-axis) meshes."""
+    from ..parallel import env as penv
+
+    hier = penv.mesh_hierarchy(getattr(program, "_mesh", None))
+    if hier is None or not getattr(program, "_data_parallel", False):
+        return None
+    try:
+        col = exe.collective_report(program, feed=feed,
+                                    fetch_list=fetch_list)
+    except Exception as e:  # noqa: BLE001 - evidence, not gating
+        print("BENCH hierarchy census failed: %r" % (e,), flush=True)
+        return None
+    if not col or "lanes" not in col:
+        return None
+    lanes = col["lanes"]
+    dcn_grad = [c for c in lanes["dcn"]["per_collective"]
+                if c["kind"] == "all_reduce"]
+    # what ONE flat allreduce of the same grads would move cross-pod:
+    # each dcn collective carries a 1/ici shard, so flat = shard * ici
+    flat_bytes = sum(c["tensor_bytes"] for c in dcn_grad) * hier[3]
+    block = {
+        "dcn_replicas": hier[2],
+        "ici_size": hier[3],
+        "ici": {k: lanes["ici"][k]
+                for k in ("count", "tensor_bytes", "wire_bytes")},
+        "dcn": {k: lanes["dcn"][k]
+                for k in ("count", "tensor_bytes", "wire_bytes")},
+        "dcn_grad_sync_bytes": sum(
+            c["tensor_bytes"] for c in dcn_grad),
+        "flat_allreduce_bytes": flat_bytes,
+        "dcn_reduction_factor": hier[3],
+        "per_collective_dcn": lanes["dcn"]["per_collective"],
+    }
+    reg = registry()
+    reg.set_gauge("hierarchy.dcn_bytes", block["dcn_grad_sync_bytes"])
+    reg.set_gauge("hierarchy.dcn_replicas", hier[2])
+    reg.publish_block("hierarchy", block)
+    print("BENCH hierarchy: %dx%d (dcn x ici) mesh, cross-pod grad "
+          "sync %.1f KB vs %.1f KB flat (1/%d per bucket), dcn "
+          "collectives x%d ici x%d"
+          % (hier[2], hier[3],
+             block["dcn_grad_sync_bytes"] / 1e3, flat_bytes / 1e3,
+             hier[3], lanes["dcn"]["count"], lanes["ici"]["count"]),
+          flush=True)
+    return block
 
 
 def precision_block(exe, program, feed, fetch_list) -> Optional[dict]:
@@ -263,6 +319,7 @@ def bench_blocks(exe, program, feed, fetch_list, group=None) -> dict:
     reg.clear_blocks()  # one program's evidence per assembly
     phases_block()
     collectives_blocks(exe, program, feed, fetch_list)
+    hierarchy_block(exe, program, feed, fetch_list)
     precision_block(exe, program, feed, fetch_list)
     static_checks_block(program)
     telemetry_block(group=group)

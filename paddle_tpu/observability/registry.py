@@ -44,9 +44,16 @@ from typing import Dict, List, Optional
 __all__ = ["MetricsRegistry", "registry", "reset_registry", "configure"]
 
 #: step-phase keys a step record carries (mirrors profiler.STEP_PHASES
-#: plus the cache-miss compile phase)
+#: plus the cache-miss compile phase and the hybrid-mesh comm lanes)
 STEP_FIELDS = ("feed_ms", "dispatch_ms", "comm_ms", "sync_ms",
-               "host_ms", "compile_ms", "total_ms")
+               "host_ms", "compile_ms", "comm_ici_ms", "comm_dcn_ms",
+               "total_ms")
+
+#: optional fields that ride OUTSIDE the step total: compile happens
+#: off the steady state; the comm lanes are a BREAKDOWN of comm_ms
+#: (intra-pod vs cross-pod host coordination on a multi-pod launch),
+#: not an addition to it
+_AUX_FIELDS = frozenset({"compile_ms", "comm_ici_ms", "comm_dcn_ms"})
 
 
 def _env_rank() -> int:
@@ -278,12 +285,12 @@ class MetricsRegistry:
                 if f == "total_ms":
                     continue
                 v = phases_ms.get(f, phases_ms.get(f[:-3]))
-                if v is None and f != "compile_ms":
+                if v is None and f not in _AUX_FIELDS:
                     v = 0.0
                 if v is not None:
                     v = round(float(v), 4)
                     rec[f] = v
-                    if f != "compile_ms":
+                    if f not in _AUX_FIELDS:
                         total += v
             rec["total_ms"] = round(
                 float(phases_ms.get("total_ms", total)), 4)

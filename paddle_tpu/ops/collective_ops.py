@@ -29,6 +29,35 @@ def _axis_for(attrs):
     return env.axis_name_for_ring(ring_id)
 
 
+def _seq_reduce(fn, x, axis):
+    """psum/pmax/pmin over `axis`; a TUPLE axis — ring 0 on a hybrid
+    (dcn, ici) mesh spans the pair — reduces HIERARCHICALLY, minor
+    (intra-pod ici) axis first then cross-pod dcn: two collectives
+    whose replica_groups and fp association match the sharded-update
+    lowering (parallel/README.md "Hierarchical collectives"), so
+    replicated and ZeRO runs stay bit-identical on hybrid meshes and
+    only the pod-partial bytes cross the DCN link."""
+    if isinstance(axis, tuple):
+        for a in reversed(axis):
+            x = fn(x, a)
+        return x
+    return fn(x, axis)
+
+
+def _linear_axis_index(axis):
+    """Replica's linear index over a single axis or a (major, minor)
+    axis tuple (row-major, matching the hybrid mesh device order)."""
+    if isinstance(axis, tuple):
+        from ..parallel import env
+
+        axes = env.active_axes() or {}
+        idx = lax.axis_index(axis[0])
+        for a in axis[1:]:
+            idx = idx * axes.get(a, 1) + lax.axis_index(a)
+        return idx
+    return lax.axis_index(axis)
+
+
 def _register_allreduce(suffix, monoid):
     @register_op("c_allreduce_" + suffix)
     def _c_allreduce(ins, attrs, _monoid=monoid):
@@ -39,9 +68,9 @@ def _register_allreduce(suffix, monoid):
         return {"Out": _monoid(x, axis)}
 
 
-_register_allreduce("sum", lambda x, ax: lax.psum(x, ax))
-_register_allreduce("max", lambda x, ax: lax.pmax(x, ax))
-_register_allreduce("min", lambda x, ax: lax.pmin(x, ax))
+_register_allreduce("sum", lambda x, ax: _seq_reduce(lax.psum, x, ax))
+_register_allreduce("max", lambda x, ax: _seq_reduce(lax.pmax, x, ax))
+_register_allreduce("min", lambda x, ax: _seq_reduce(lax.pmin, x, ax))
 # prod: all_gather + product over the gathered axis. The previous
 # exp(psum(log(x))) NaN'd for any zero/negative element; the reference
 # kRedProd (c_allreduce_op.h:58-105, ncclProd) handles all reals. The
@@ -58,9 +87,9 @@ def _c_broadcast(ins, attrs):
     if axis is None:
         return {"Out": x}
     root = attrs.get("root", 0)
-    idx = lax.axis_index(axis)
+    idx = _linear_axis_index(axis)
     masked = jnp.where(idx == root, x, jnp.zeros_like(x))
-    return {"Out": lax.psum(masked, axis)}
+    return {"Out": _seq_reduce(lax.psum, masked, axis)}
 
 
 @register_op("c_allgather")
@@ -89,8 +118,8 @@ def _c_reduce_sum(ins, attrs):
         return {"Out": x}
     # reduce-to-root: root keeps the sum, others keep their input (the
     # reference only defines the root's output).
-    total = lax.psum(x, axis)
-    idx = lax.axis_index(axis)
+    total = _seq_reduce(lax.psum, x, axis)
+    idx = _linear_axis_index(axis)
     return {"Out": jnp.where(idx == attrs.get("root_id", 0), total, x)}
 
 
@@ -126,7 +155,7 @@ def _c_split(ins, attrs):
     from ..parallel import env
 
     n = env.axis_size_for_ring(attrs.get("ring_id", 0))
-    idx = lax.axis_index(axis)
+    idx = _linear_axis_index(axis)
     piece = x.shape[-1] // n
     return {"Out": lax.dynamic_slice_in_dim(x, idx * piece, piece, x.ndim - 1)}
 
@@ -142,7 +171,7 @@ def _c_embedding(ins, attrs):
     out = jnp.take(w, jnp.clip(local_ids, 0, w.shape[0] - 1), axis=0)
     out = jnp.where(valid[..., None], out, jnp.zeros_like(out))
     if axis is not None:
-        out = lax.psum(out, axis)
+        out = _seq_reduce(lax.psum, out, axis)
     return {"Out": out}
 
 
@@ -172,8 +201,8 @@ def _legacy_allreduce(ins, attrs):
         return {"Out": x}
     fns = {0: lax.psum, 1: lax.pmax, 2: lax.pmin}
     if red in fns:
-        return {"Out": fns[red](x, axis)}
-    return {"Out": jnp.exp(lax.psum(jnp.log(x), axis))}
+        return {"Out": _seq_reduce(fns[red], x, axis)}
+    return {"Out": jnp.exp(_seq_reduce(lax.psum, jnp.log(x), axis))}
 
 
 @register_op("broadcast")

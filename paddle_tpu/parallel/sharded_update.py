@@ -300,13 +300,26 @@ class ShardedUpdatePlan:
     __slots__ = ("axis", "ndev", "grad_names", "rs_targets",
                  "sharded_state", "explicit_sync", "opt_op_ids",
                  "buckets", "bucket_of", "defer_gather",
-                 "gradient_merge", "bucket_cap", "master_of")
+                 "gradient_merge", "bucket_cap", "master_of",
+                 "dcn_axis", "dcn_size")
 
     def __init__(self, axis, ndev, grad_names, rs_targets, sharded_state,
                  explicit_sync, opt_op_ids, buckets=(), defer_gather=(),
-                 gradient_merge=False, bucket_cap=0, master_of=None):
+                 gradient_merge=False, bucket_cap=0, master_of=None,
+                 dcn_axis=None, dcn_size=1):
+        # `axis`/`ndev` are the SHARD axis and granularity: the whole
+        # dp world for a flat mesh, the intra-pod ici axis/size for a
+        # hybrid (dcn, ici) mesh — shards stay laid out within the pod
+        # (opt-state is replicated across pods), so the flat-buffer
+        # padding/slicing layout is untouched by the hierarchy.
         self.axis = axis
         self.ndev = ndev
+        # hierarchical lowering (multi-pod): after the intra-pod
+        # reduce-scatter each 1/ndev shard psum's across pods over
+        # `dcn_axis` — only 1/ici_size of the gradient bytes cross the
+        # slow DCN link. None/1 = flat (single-level) collectives.
+        self.dcn_axis = dcn_axis
+        self.dcn_size = int(dcn_size or 1)
         # grads reduce-scattered right at the vjp output (implicit DP)
         self.grad_names: FrozenSet[str] = frozenset(grad_names)
         # grads whose explicit c_allreduce_sum lowers to psum_scatter
@@ -332,6 +345,12 @@ class ShardedUpdatePlan:
         # {live_param_name: master_var_name} (masters also appear in
         # sharded_state with their fp32 ShardInfo)
         self.master_of: Dict[str, str] = dict(master_of or {})
+
+    @property
+    def world(self) -> int:
+        """Total data-parallel replica count: the /N of a pmean-style
+        sync divides by THIS (ndev * dcn_size), not the shard count."""
+        return self.ndev * self.dcn_size
 
 
 def enabled() -> bool:
@@ -379,8 +398,8 @@ def _record_fallback(program, reason, var=None, op_type=None,
                var, op_type)
 
 
-def plan_sharded_update(program, block, ndev, dp_axis) -> \
-        Optional[ShardedUpdatePlan]:
+def plan_sharded_update(program, block, ndev, dp_axis, dcn_axis=None,
+                        dcn_size=1) -> Optional[ShardedUpdatePlan]:
     """Feasibility scan over the post-backward section. Returns a plan,
     or None when the program must keep the replicated update (not
     data-parallel / flag off / an unsupported op touches an
@@ -581,6 +600,25 @@ def plan_sharded_update(program, block, ndev, dp_axis) -> \
     # production order under the byte cap; 0 = per-var (PR-3) lowering
     out_alias = {m: live for m, (_, live) in cast_of.items()}
     cap = bucket_cap_bytes()
+    world = ndev * int(dcn_size or 1)
+    if cap > 0 and getattr(program, "_amp", False) \
+            and (world & (world - 1)) != 0 and _cpu_backend():
+        # AMP x BUCKETED collectives drift one bf16 ulp off the
+        # per-variable lowering on the CPU backend at world sizes
+        # where the /N mean rounds in bf16 (e.g. ndev=3): the batched
+        # scatter's /N + cast fusion regroups one FMA contraction that
+        # optimization_barrier cannot pin on the CPU pipeline (the
+        # PR-4 caveat; invisible at power-of-two worlds where /N is
+        # exact). Per-variable AMP is bit-identical at every N — so
+        # gate bucketing off rather than ship a drifting lowering;
+        # real TPU fusion honors the barriers and keeps its buckets.
+        _record_fallback(
+            program, "bucketing disabled: AMP at non-power-of-two "
+            "world %d on the CPU backend drifts 1 bf16 ulp (the /N "
+            "mean rounds; CPU fusion regroups past the optimization "
+            "barriers) — per-variable collectives are exact" % world,
+            kind="buckets_disabled")
+        cap = 0
     buckets = ()
     if cap > 0:
         buckets = plan_buckets(opt_ops, block, ndev,
@@ -618,7 +656,16 @@ def plan_sharded_update(program, block, ndev, dp_axis) -> \
         explicit_sync=explicit, opt_op_ids=opt_ids,
         buckets=buckets, defer_gather=defer,
         gradient_merge=gradient_merge, bucket_cap=cap,
-        master_of=master_of)
+        master_of=master_of, dcn_axis=dcn_axis, dcn_size=dcn_size)
+
+
+def _cpu_backend() -> bool:
+    import jax
+
+    try:
+        return jax.default_backend() == "cpu"
+    except Exception:  # noqa: BLE001 - backend probe only
+        return False
 
 
 # ---------------------------------------------------------------------------
@@ -646,20 +693,37 @@ def shard_slice(x_full, plan):
     return lax.dynamic_slice(vec, (idx * size,), (size,))
 
 
+def _cross_pod_sum(vec, plan):
+    """Hierarchical step 2: psum an intra-pod shard across pods over
+    the dcn axis — the ONLY collective that touches the slow DCN link,
+    carrying 1/ici_size of the gradient bytes. Identity on flat
+    (single-level) plans."""
+    if plan.dcn_axis is None or plan.dcn_size <= 1:
+        return vec
+    from jax import lax
+
+    return lax.psum(vec, plan.dcn_axis)
+
+
 def reduce_scatter_sum(g, plan):
     """psum_scatter the padded flat gradient: each replica receives the
     cross-replica SUM of its 1/N slice — half the ICI bytes of the
-    allreduce it replaces (the all-gather half moves to the params)."""
+    allreduce it replaces (the all-gather half moves to the params).
+    On a hybrid (dcn, ici) mesh this is the hierarchical pair: scatter
+    over the intra-pod ici axis, then psum the 1/ici shards across
+    pods over dcn (cross-pod bytes = flat-allreduce bytes / ici)."""
     from jax import lax
 
     vec = _flat_pad(g, plan.ndev)
-    return ShardVal(lax.psum_scatter(vec, plan.axis, tiled=True),
-                    tuple(g.shape))
+    return ShardVal(
+        _cross_pod_sum(lax.psum_scatter(vec, plan.axis, tiled=True),
+                       plan),
+        tuple(g.shape))
 
 
 def reduce_scatter_mean(g, plan):
     sv = reduce_scatter_sum(g, plan)
-    return ShardVal(sv.vec / plan.ndev, sv.shape)
+    return ShardVal(sv.vec / plan.world, sv.shape)
 
 
 def _bucket_replica_major(vecs, ndev):
@@ -703,9 +767,13 @@ def bucket_reduce_scatter(bucket, grads, plan, mean):
             _flat_pad(grads[e.grad], plan.ndev) for e in run))
         buf = jnp.reshape(_bucket_replica_major(list(vecs), plan.ndev),
                           (-1,))
-        sc = lax.psum_scatter(buf, plan.axis, tiled=True)
+        # hierarchical (hybrid mesh): ONE intra-pod scatter + ONE
+        # cross-pod psum of the 1/ici shard per bucket — the bucket's
+        # DCN bytes are its flat-allreduce bytes / ici_size
+        sc = _cross_pod_sum(
+            lax.psum_scatter(buf, plan.axis, tiled=True), plan)
         if mean:
-            sc = sc / plan.ndev
+            sc = sc / plan.world
         off = 0
         pieces = []
         for e in run:
@@ -1127,7 +1195,11 @@ def eager_accumulator_sharding(shape):
     mesh = penv.global_mesh()
     if mesh is None:
         return None
-    axis = mesh.axis_names[0]
+    # hybrid (dcn, ici) mesh: accumulators shard over the intra-pod
+    # ici axis (replicated across pods), mirroring the static plan's
+    # shards-stay-within-the-pod layout
+    axis = penv.ICI_AXIS if penv.ICI_AXIS in mesh.axis_names \
+        else mesh.axis_names[0]
     n = int(mesh.shape[axis])
     if n <= 1 or not shape or int(shape[0]) < n \
             or int(shape[0]) % n != 0:

@@ -77,6 +77,19 @@ _FLAGS: Dict[str, object] = {
     # per-variable deferred param gathers (emitted adjacent, in bucket
     # groups) DO combine into one collective per bucket.
     "FLAGS_tpu_comm_bucket_mb": 25.0,
+    # Hierarchical DCN+ICI collectives on a hybrid multi-pod mesh
+    # (Kumar et al. 1909.09756; t5x create_hybrid_device_mesh idiom):
+    # > 1 factors the dp axis into a 2-D (dcn, ici) mesh — grad syncs
+    # lower as reduce-scatter inside the pod over ICI, cross-pod
+    # exchange of only the 1/ici_size shards over DCN, deferred
+    # all-gather inside the pod. 0/1 (default; PADDLE_NUM_PODS env is
+    # the launch-time alias) keeps the flat single-axis dp mesh
+    # byte-for-byte. The value must divide the device count or the
+    # mesh falls back to flat with a warning. On CPU this emulates
+    # pods as contiguous device blocks so tier-1 can verify the
+    # lowering without chips. See paddle_tpu/parallel/README.md
+    # "Hierarchical collectives".
+    "FLAGS_tpu_dcn_replicas": 0,
     # Pallas flash attention engages only at/above this key length: the
     # XLA fused path wins below it (measured on v5e: flash 13.6ms vs XLA
     # 9.8ms even at S=2048 fwd); flash's win is O(S) memory at long seq.

@@ -533,6 +533,84 @@ def test_launch_elastic_shrink_drops_dead_rank_and_reassigns(tmp_path):
     assert tschema.validate_record(ev, tschema.load_schema()) == []
 
 
+def test_launch_pod_aware_shrink_flat_fallback_and_rectangular(
+        tmp_path):
+    """Pod-aware elastic shrink (hybrid multi-pod topology): a 2x2
+    cohort (--num_pods 2) losing ONE rank cannot stay rectangular
+    (pods 1 vs 2) — the restart falls back to a FLAT 3-rank world,
+    the elastic_transition event names the fallback
+    (pod_topology=flat_fallback), and the shrunk workers see NO stale
+    PADDLE_NUM_PODS/PADDLE_POD_ID. Losing one rank in EACH pod
+    re-forms as a legal 1-per-pod 2-pod world. Never a wedged
+    rendezvous either way."""
+    import json as _json
+
+    def run(kill_tids, ports):
+        script = tmp_path / ("worker_%s.py" % "_".join(
+            str(t) for t in kill_tids))
+        script.write_text(
+            "import os, sys, time\n"
+            "tid = int(os.environ['PADDLE_TRAINER_ID'])\n"
+            "attempt = int(os.environ.get('PADDLE_RESTART_NUM', '0'))\n"
+            "print('WORLD', os.environ['PADDLE_TRAINERS_NUM'],\n"
+            "      'RANK', tid, 'ATTEMPT', attempt,\n"
+            "      'PODS', os.environ.get('PADDLE_NUM_PODS', '-'),\n"
+            "      'POD', os.environ.get('PADDLE_POD_ID', '-'),\n"
+            "      flush=True)\n"
+            "if attempt == 0:\n"
+            "    if tid in (%s,):\n"
+            "        sys.exit(7)\n"
+            "    time.sleep(30)\n"
+            % ",".join(str(t) for t in kill_tids))
+        log_dir = str(tmp_path / ("logs_%s" % "_".join(
+            str(t) for t in kill_tids)))
+        proc = _sp.run(
+            [_sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--hosts", ",".join("127.0.0.1:%d" % p for p in ports),
+             "--log_dir", log_dir, "--max_restarts", "1",
+             "--min_ranks", "2", "--num_pods", "2", str(script)],
+            env=_launch_env(), cwd=_REPO, stdout=_sp.PIPE,
+            stderr=_sp.STDOUT, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stdout
+        sup = _os.path.join(log_dir, "telemetry",
+                            "telemetry.supervisor.jsonl")
+        recs = [_json.loads(ln) for ln in open(sup) if ln.strip()]
+        evs = [r for r in recs
+               if r.get("event") == "elastic_transition"]
+        assert len(evs) == 1
+        logs = {tid: open(_os.path.join(
+            log_dir, "workerlog.%d" % tid)).read()
+            for tid in range(len(ports))
+            if _os.path.exists(_os.path.join(log_dir,
+                                             "workerlog.%d" % tid))}
+        return proc.stdout, evs[0], logs
+
+    # attempt 0 runs 2 pods x 2 ranks (contiguous blocks)
+    out, ev, logs = run([1], [6731, 6732, 6733, 6734])
+    assert "WORLD 4 RANK 0 ATTEMPT 0 PODS 2 POD 0" in logs[0]
+    assert "WORLD 4 RANK 3 ATTEMPT 0 PODS 2 POD 1" in logs[3]
+    # lopsided survivors (1 vs 2): flat fallback keeping all three
+    assert ev["old_world"] == 4 and ev["new_world"] == 3
+    assert ev["pod_topology"] == "flat_fallback"
+    assert ev["pods_old"] == 2 and ev["pods_new"] == 1
+    assert ev["pod_survivor_counts"] == [1, 2]
+    assert "pods 2 -> 1 (flat_fallback)" in out
+    assert "WORLD 3 RANK 0 ATTEMPT 1 PODS - POD -" in logs[0]
+
+    # one rank lost in EACH pod: re-forms rectangular at 1 rank/pod
+    out, ev, logs = run([1, 2], [6741, 6742, 6743, 6744])
+    assert ev["new_world"] == 2
+    assert ev["pod_topology"] == "rectangular"
+    assert ev["pods_old"] == ev["pods_new"] == 2
+    assert ev["ranks_per_pod"] == 1
+    assert "WORLD 2 RANK 0 ATTEMPT 1 PODS 2 POD 0" in logs[0]
+    # the restarted cohort logs under its NEW contiguous rank ids
+    assert "WORLD 2 RANK 1 ATTEMPT 1 PODS 2 POD 1" in logs[1]
+    from paddle_tpu.observability import schema as tschema
+
+    assert tschema.validate_record(ev, tschema.load_schema()) == []
+
+
 def test_launch_elastic_gives_up_below_min_ranks(tmp_path):
     """Survivor count below --min_ranks must NOT relaunch a too-small
     cohort: the launcher exits with the failure rc."""

@@ -40,10 +40,10 @@ def _fresh():
     scope_mod._global_scope = scope_mod.Scope()
 
 
-def _batch():
+def _batch(n=64):
     r = np.random.RandomState(0)
-    return (r.rand(64, 32).astype("float32"),
-            r.randint(0, 4, (64, 1)).astype("int64"))
+    return (r.rand(n, 32).astype("float32"),
+            r.randint(0, 4, (n, 1)).astype("int64"))
 
 
 def _mlp_loss(hidden=31):
@@ -60,7 +60,7 @@ def _mlp_loss(hidden=31):
 
 
 def _train(opt_fn, flag, ndev=8, bucket_mb=0.0, steps=4, clip=False,
-           decorate_kw=None):
+           decorate_kw=None, batch_n=64):
     """Losses of `steps` identical-feed steps of the AMP-decorated MLP;
     returns (losses, exe, prog, loss, plan, opt)."""
     import jax
@@ -68,7 +68,7 @@ def _train(opt_fn, flag, ndev=8, bucket_mb=0.0, steps=4, clip=False,
     _fresh()
     set_flags({"FLAGS_tpu_sharded_weight_update": flag,
                "FLAGS_tpu_comm_bucket_mb": bucket_mb})
-    x, y = _batch()
+    x, y = _batch(batch_n)
     with framework.unique_name_guard():
         loss = _mlp_loss()
         if clip:
@@ -112,6 +112,37 @@ def test_sharded_master_parity_bit_identical(name, opt_fn, ndev):
     assert plan is not None and plan.master_of, \
         "masters did not shard: %s" % (plan,)
     assert l_rep == l_sh, (name, l_rep, l_sh)
+
+
+def test_amp_bucketing_gated_off_at_non_power_of_two_world():
+    """ROADMAP carried numerics item (found by PR 9's elastic-shrink
+    tests): AMP x BUCKETED grad collectives drift one bf16 ulp off the
+    per-variable lowering on the CPU backend at world sizes where the
+    /N mean rounds in bf16 (ndev=3) — the batched scatter's /N + cast
+    fusion regroups one FMA contraction past the optimization barriers
+    (the PR-4 CPU-fusion caveat, invisible at power-of-two worlds
+    where /N is exact). The planner now gates bucketing OFF for AMP
+    programs at non-power-of-two worlds on the CPU backend, records a
+    structured `buckets_disabled` fallback reason, and the per-var
+    lowering it degrades to is bit-identical at every N. Power-of-two
+    worlds keep their buckets."""
+    adam = lambda: O.AdamOptimizer(learning_rate=0.01)  # noqa: E731
+    l_rep, *_ = _train(adam, False, ndev=3, batch_n=48)
+    l_sh, _, prog, _, plan, _ = _train(adam, True, ndev=3,
+                                       bucket_mb=1000.0, batch_n=48)
+    assert plan is not None and not plan.buckets, \
+        "bucketing engaged at ndev=3 under AMP on CPU"
+    fb = [f for f in (getattr(prog, "_sharded_update_fallback", None)
+                      or []) if f["kind"] == "buckets_disabled"]
+    assert fb and "bf16 ulp" in fb[0]["reason"], fb
+    assert l_rep == l_sh, (l_rep, l_sh)
+    # power-of-two world: the gate stays out of the way
+    _, _, prog4, _, plan4, _ = _train(adam, True, ndev=4,
+                                      bucket_mb=1000.0, batch_n=48)
+    assert plan4 is not None and plan4.buckets
+    assert not [f for f in (getattr(prog4, "_sharded_update_fallback",
+                                    None) or [])
+                if f["kind"] == "buckets_disabled"]
 
 
 def test_sharded_master_parity_with_clip_and_buckets():

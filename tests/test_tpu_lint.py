@@ -946,7 +946,7 @@ def test_exemplar_programs_lint_clean():
     every checker."""
     tpu_lint = _import_tpu_lint()
     results = tpu_lint.lint_exemplars()
-    assert set(results) == {"bert_tiny", "bert_tiny_amp",
+    assert set(results) == {"bert_tiny", "bert_tiny_amp", "mlp_hier",
                             "resnet_scan", "fleet_ps_2rank"}
     for name, (findings, summary) in results.items():
         errs = [analysis.format_finding(f) for f in findings
@@ -965,7 +965,8 @@ def test_cli_end_to_end(tmp_path):
     report = json.loads(out.read_text())
     assert report["ok"] and report["total_errors"] == 0
     assert set(report["programs"]) == {"bert_tiny", "bert_tiny_amp",
-                                       "resnet_scan", "fleet_ps_2rank"}
+                                       "mlp_hier", "resnet_scan",
+                                       "fleet_ps_2rank"}
     assert "tpu-lint:" in r.stdout
 
 

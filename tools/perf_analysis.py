@@ -15,10 +15,20 @@ PERF_ANALYSIS_r4.md with:
 Usage: python tools/perf_analysis.py [--batches 256,512]
        python tools/perf_analysis.py --sharded-diff
        python tools/perf_analysis.py --overlap-audit [--bucket-mb 0.25]
+       python tools/perf_analysis.py --hierarchy [--dcn 2]
        python tools/perf_analysis.py --lint [tpu_lint args...]
        python tools/perf_analysis.py --stragglers \
            --telemetry-dir DIR [--window 32]
        python tools/perf_analysis.py --elastic --log-dir DIR
+
+`--hierarchy` is the offline evidence for the hierarchical DCN+ICI
+grad collectives (FLAGS_tpu_dcn_replicas, hybrid multi-pod mesh): it
+lowers the SAME data-parallel BERT-tiny train step flat and on an
+emulated (dcn x ici) CPU hybrid mesh, splits the collective byte
+census into ici/dcn lanes (lowering.collective_byte_census), asserts
+every cross-pod grad-sync collective carries exactly 1/ici_size of
+the flat-allreduce bytes, and writes artifacts/hierarchy_diff.json.
+Exits nonzero when the cross-pod reduction does not hold.
 
 `--elastic` reports the elastic-restart seams of a supervised run
 (distributed/launch.py --min_ranks): every `elastic_transition` event
@@ -75,7 +85,8 @@ import sys
 import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
-if ("--sharded-diff" in sys.argv or "--overlap-audit" in sys.argv) and \
+if ("--sharded-diff" in sys.argv or "--overlap-audit" in sys.argv
+        or "--hierarchy" in sys.argv) and \
         "xla_force_host_platform_device_count" not in \
         os.environ.get("XLA_FLAGS", ""):
     # the diff needs a multi-device mesh; must be set pre-jax-import
@@ -373,6 +384,65 @@ def _bert_tiny_step(batch, seq_len, flags):
     return exe, prog, feed, total
 
 
+def hierarchy_diff(dcn=2, batch=16, seq_len=32, bucket_mb=0.25):
+    """Lower the DP BERT-tiny train step flat and on an emulated
+    (dcn x ici) hybrid CPU mesh; split the census into ici/dcn lanes
+    and check the hierarchical contract — every cross-pod grad-sync
+    collective carries flat-allreduce bytes / ici_size — then write
+    artifacts/hierarchy_diff.json. Returns 0 when the cross-pod
+    reduction holds, 1 otherwise."""
+    import json
+
+    def one(dcn_flag):
+        exe, prog, feed, total = _bert_tiny_step(
+            batch, seq_len,
+            {"FLAGS_tpu_sharded_weight_update": True,
+             "FLAGS_tpu_comm_bucket_mb": bucket_mb,
+             "FLAGS_tpu_dcn_replicas": dcn_flag})
+        col = exe.collective_report(prog, feed=feed, fetch_list=[total])
+        return col, prog
+
+    col_flat, _ = one(0)
+    col_h, prog_h = one(dcn)
+    hier = col_h.get("lanes") is not None
+    ici_size = col_h.get("ici_size", 0)
+    dcn_grad = [c for c in
+                col_h.get("lanes", {}).get("dcn",
+                                           {}).get("per_collective", [])
+                if c["kind"] == "all_reduce"]
+    dcn_bytes = sum(c["tensor_bytes"] for c in dcn_grad)
+    # flat baseline: the bucketed reduce_scatter inputs (= what one
+    # flat allreduce of the same grads would carry cross-pod)
+    flat_bytes = sum(b["bytes"] for b in col_h.get("buckets", []))
+    out = {
+        "model": "bert-tiny b%d s%d" % (batch, seq_len),
+        "dcn_replicas": dcn,
+        "ici_size": ici_size,
+        "flat": {"collectives": col_flat},
+        "hierarchical": {"collectives": col_h},
+        "cross_pod_grad_bytes": dcn_bytes,
+        "flat_allreduce_bytes": flat_bytes,
+        "per_bucket_ok": [
+            {"dcn_collective_bytes": c["tensor_bytes"],
+             "participants": c["participants"]} for c in dcn_grad],
+    }
+    path = os.path.join(_REPO, "artifacts", "hierarchy_diff.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2, sort_keys=True)
+    ok = (hier and ici_size > 1 and dcn_grad and flat_bytes > 0
+          and dcn_bytes * ici_size == flat_bytes
+          and all(c["participants"] == dcn for c in dcn_grad))
+    print("hierarchy diff (%s): %dx%d (dcn x ici) mesh, cross-pod "
+          "grad sync %d bytes vs %d flat (exactly 1/%d: %s); %d dcn "
+          "collective(s); wrote %s"
+          % (out["model"], dcn, ici_size, dcn_bytes, flat_bytes,
+             max(ici_size, 1),
+             "yes" if dcn_bytes * max(ici_size, 1) == flat_bytes
+             else "NO", len(dcn_grad), path))
+    return 0 if ok else 1
+
+
 def overlap_audit(bucket_mb=0.25, batch=16, seq_len=32):
     """Compile the DP BERT-tiny step bucketed (bucket_mb) and
     single-exchange (cap 0); audit the optimized HLO schedules; write
@@ -594,6 +664,18 @@ def main():
                 raise SystemExit(
                     "usage: --bucket-mb <float MB> (got %r)" % (val,))
         raise SystemExit(overlap_audit(bucket_mb=mb))
+    if "--hierarchy" in args:
+        dcn = 2
+        for i, a in enumerate(args):
+            if not a.startswith("--dcn"):
+                continue
+            val = (a.split("=", 1)[1] if "=" in a
+                   else args[i + 1] if i + 1 < len(args) else "")
+            try:
+                dcn = int(val)
+            except ValueError:
+                raise SystemExit("usage: --dcn <int> (got %r)" % (val,))
+        raise SystemExit(hierarchy_diff(dcn=dcn))
     i = 0
     while i < len(args):
         a = args[i]

@@ -53,6 +53,10 @@ _MODULES = [
     # AMP: decorate()/master-weight rewrites are the bench's and the
     # perf-analysis tooling's entry into mixed precision — lock them
     "paddle_tpu.fluid.contrib.mixed_precision",
+    # hybrid multi-pod meshes: create_hybrid_mesh / dcn_replicas /
+    # mesh_hierarchy are the hierarchical-collectives entry every
+    # layer (fleet, lowering, launcher, bench) builds on — lock them
+    "paddle_tpu.parallel.env",
     "paddle_tpu.hapi.model",
     "paddle_tpu.nn",
     "paddle_tpu.tensor",

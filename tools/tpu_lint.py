@@ -141,6 +141,63 @@ def build_resnet_scan():
     return prog, None
 
 
+def build_mlp_hier():
+    """Data-parallel MLP Adam step on an emulated 2x2 hybrid
+    (dcn, ici) CPU mesh with bucketed HIERARCHICAL collectives
+    (FLAGS_tpu_dcn_replicas): the IR checkers verify the dcn-aware
+    shard plan, and lint_exemplars adds the HLO-level two-level
+    replica_groups audit (analysis.check_hierarchical_groups) over the
+    actually-lowered module — zero errors is the standing claim for
+    the hierarchical exemplar."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework
+    from paddle_tpu.utils.flags import get_flag, set_flags
+
+    _fresh()
+    with framework.unique_name_guard():
+        framework.default_main_program().random_seed = 7
+        framework.default_startup_program().random_seed = 7
+        img = fluid.layers.data(name="img", shape=[16],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1],
+                                  dtype="int64")
+        h = fluid.layers.fc(input=img, size=15, act="relu")
+        logits = fluid.layers.fc(input=h, size=4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.AdamOptimizer(1e-3).minimize(loss)
+        prog = fluid.default_main_program()
+        fluid.CompiledProgram(prog).with_data_parallel(
+            loss_name=loss.name)
+        import jax
+        from jax.sharding import Mesh
+
+        prog._mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                          ("dcn", "ici"))
+        old = get_flag("FLAGS_tpu_comm_bucket_mb")
+        try:
+            set_flags({"FLAGS_tpu_comm_bucket_mb": 0.001})
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            r = np.random.RandomState(0)
+            feed = {"img": r.rand(16, 16).astype("float32"),
+                    "label": r.randint(0, 4, (16, 1)).astype("int64")}
+            exe.run(prog, feed=feed, fetch_list=[loss])
+            got = exe._cached_lowerable(prog, feed, [loss], None)
+        finally:
+            set_flags({"FLAGS_tpu_comm_bucket_mb": old})
+        assert getattr(prog, "_shard_plan", None) is not None \
+            and prog._shard_plan.dcn_axis is not None, \
+            "hierarchical exemplar failed to plan (fallback: %s)" % (
+                getattr(prog, "_sharded_update_fallback", None),)
+        # stash the lowered module for the HLO-level hierarchy audit
+        prog._lint_hlo = got[1].as_text() if got is not None else None
+        prog._lint_ici_size = 2
+    return prog, None
+
+
 def build_fleet_ps_2rank():
     """One MLP classifier transpiled for 2 sync-PS trainers: returns
     (rank-0 program, [rank-1 program]) for the cross-rank pass."""
@@ -171,6 +228,7 @@ def build_fleet_ps_2rank():
 EXEMPLARS = {
     "bert_tiny": build_bert_tiny,
     "bert_tiny_amp": build_bert_tiny_amp,
+    "mlp_hier": build_mlp_hier,
     "resnet_scan": build_resnet_scan,
     "fleet_ps_2rank": build_fleet_ps_2rank,
 }
@@ -190,6 +248,12 @@ def lint_exemplars(names=None):
                       for i in range(1 + len(rank_programs))]
         findings = analysis.run_static_checks(
             prog, rank_programs=rank_programs, rank_labels=labels)
+        if getattr(prog, "_lint_hlo", None):
+            # hybrid-mesh exemplars: the HLO-level two-level
+            # replica_groups audit over the lowered module
+            findings = analysis.sort_findings(
+                findings + analysis.check_hierarchical_groups(
+                    prog._lint_hlo, prog._lint_ici_size, label=name))
         out[name] = (findings, analysis.summarize(findings))
     return out
 
