@@ -710,8 +710,9 @@ def _enable_compile_cache():
 def _attach_blocks(result, exe, program, feed, fetch_list):
     """Attach every evidence block of the step that just ran — phases,
     collectives / opt_state_sharding / overlap (when data-parallel),
-    precision (when AMP), static_checks, telemetry — assembled by the
-    ONE registry-backed publisher (paddle_tpu/observability/publish.py)
+    precision (when AMP), attribution (per-op HBM blame + provenance
+    coverage), static_checks, telemetry — assembled by the ONE
+    registry-backed publisher (paddle_tpu/observability/publish.py)
     instead of per-block ad-hoc code here. Evidence, not gating."""
     try:
         from paddle_tpu.observability import publish

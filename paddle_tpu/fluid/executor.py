@@ -238,6 +238,7 @@ class Executor:
         if entry is not None:
             self._cache.move_to_end(key)
         tail_n = None
+        fresh_compile = False
         if entry is None and use_program_cache:
             # batch-tail bucketing (SURVEY §7 hard part (d); reference
             # contract executor.cc:184 — any batch size runs without
@@ -277,6 +278,7 @@ class Executor:
                     state_specs[n] = v
             entry = lowering.compile_block(
                 program, block, feed_arrays, fetch_names, state_specs)
+            fresh_compile = True
             from ..utils.flags import get_flag
 
             if get_flag("FLAGS_enable_unused_var_check"):
@@ -327,14 +329,43 @@ class Executor:
                                               entry.dp_axis)
                     states_mut[n] = v
                     scope.set_var(n, v)
+        if fresh_compile:
+            # OOM pre-flight (FLAGS_tpu_hbm_budget_mb, off by default):
+            # reject a program whose modeled HBM peak exceeds the
+            # budget BEFORE the first dispatch, naming the consumers.
+            # A failed gate EVICTS the just-cached entry — same
+            # invariant as the post-compile static checks: a caught-
+            # and-retried run must re-enter the gate, not cache-hit
+            # past it and dispatch the known-over-budget program
+            try:
+                self._hbm_preflight(program, entry, feed_arrays,
+                                    states_mut, states_ro, scope)
+            except Exception:
+                self._cache.pop(key, None)
+                raise
         seed = framework._global_seed_and_bump(program)
         _t = _time.perf_counter()
         feeds_dev = self._shard_feeds(entry, feed_arrays)
         _mark("feed", _t)
         _t = _time.perf_counter()
-        fetches, new_states = entry.jitted(feeds_dev, states_mut,
-                                           states_ro,
-                                           np.uint32(seed % (2**31)))
+        try:
+            fetches, new_states = entry.jitted(feeds_dev, states_mut,
+                                               states_ro,
+                                               np.uint32(seed % (2**31)))
+        except Exception as e:
+            from ..observability import attribution as _attr
+
+            if _attr.is_resource_exhausted(e):
+                # OOM forensics: land the attributed memory breakdown
+                # in the flight-recorder dump so the postmortem answers
+                # "what was resident" without a repro; the original
+                # error still propagates
+                _attr.record_oom_forensics(
+                    program, block, self._shard_plan_of(program),
+                    self._shard_count(entry), feed_arrays,
+                    list(entry.state_mut_names)
+                    + list(entry.state_ro_names), scope, e)
+            raise
         _mark("dispatch", _t)
         for n, v in new_states.items():
             scope.set_var(n, v)
@@ -782,10 +813,11 @@ class Executor:
                              lowering.data_partition_spec(mesh, dp_axis))
 
     def _cached_lowerable(self, program, feed, fetch_list, scope):
-        """(entry, lowered) for the EXECUTOR path's cached executable of
-        this (program, feed shapes, fetch list) — run the program once
-        first so the entry exists. None when the entry isn't jit-lowered
-        (eager fallback / unknown program)."""
+        """(entry, lowered, mut_avals, feed_avals, ro_avals) for the
+        EXECUTOR path's cached executable of this (program, feed
+        shapes, fetch list) — run the program once first so the entry
+        exists. None when the entry isn't jit-lowered (eager fallback /
+        unknown program)."""
         import jax
 
         program = program or framework.default_main_program()
@@ -815,23 +847,37 @@ class Executor:
                     break
         if entry is None or not hasattr(entry.jitted, "lower"):
             return None
-
-        def aval(v):
-            if hasattr(v, "shape") and hasattr(v, "dtype"):
-                return jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
-            a = np.asarray(v)
-            return jax.ShapeDtypeStruct(a.shape, a.dtype)
-
         # feed avals from the CACHED key (the dtypes that executable
         # was actually compiled for), not from this call's arrays
         favals = {n: jax.ShapeDtypeStruct(tuple(s), np.dtype(dt))
                   for n, s, dt in key[2]}
-        smut = {n: aval(scope.find_var(n))
+        smut = {n: self._aval_of(scope.find_var(n))
                 for n in entry.state_mut_names}
-        sro = {n: aval(scope.find_var(n)) for n in entry.state_ro_names}
-        lowered = entry.jitted.lower(
+        sro = {n: self._aval_of(scope.find_var(n))
+               for n in entry.state_ro_names}
+        return (entry, self._lower_entry(entry, favals, smut, sro),
+                smut, favals, sro)
+
+    @staticmethod
+    def _aval_of(v):
+        """value (device array / numpy / python scalar) -> its jit
+        argument aval."""
+        import jax
+
+        if hasattr(v, "shape") and hasattr(v, "dtype"):
+            return jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
+        a = np.asarray(v)
+        return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+    @staticmethod
+    def _lower_entry(entry, favals, smut, sro):
+        """THE (feeds, states_mut, states_ro, seed) lowering call every
+        report/pre-flight path shares — one place to change if the jit
+        argument shape ever grows."""
+        import jax
+
+        return entry.jitted.lower(
             favals, smut, sro, jax.ShapeDtypeStruct((), np.uint32))
-        return entry, lowered, smut, favals
 
     def donation_report(self, program=None, feed=None, fetch_list=None,
                         scope=None):
@@ -850,7 +896,13 @@ class Executor:
         got = self._cached_lowerable(program, feed, fetch_list, scope)
         if got is None:
             return None
-        entry, lowered, smut, favals = got
+        return self._donation_report_from(program, *got[:4])
+
+    def _donation_report_from(self, program, entry, lowered, smut,
+                              favals):
+        """donation_report's body for callers that already hold the
+        (entry, lowered, avals) tuple — attribution_report reuses this
+        instead of paying a second full trace/lower of the module."""
         ma = self._aot_compile(entry, lowered, smut).memory_analysis()
 
         def nbytes(avals):
@@ -994,7 +1046,7 @@ class Executor:
         got = self._cached_lowerable(program, feed, fetch_list, scope)
         if got is None:
             return None
-        entry, lowered, _, _ = got
+        entry, lowered = got[0], got[1]
         ndev = 1
         if entry.mesh is not None:
             ndev = int(np.prod([entry.mesh.shape[a]
@@ -1023,6 +1075,100 @@ class Executor:
                 b.nbytes for b in plan.buckets)
         return census
 
+    def attribution_report(self, program=None, feed=None,
+                           fetch_list=None, scope=None, topk=10):
+        """Per-op HBM attribution of the cached executable (run the
+        program once first): decomposes the compiled step's
+        memory_analysis() peak into buffer classes (feed / param /
+        master / opt_state / grad_bucket / state_other / activation)
+        per framework op and layer via the provenance markers the
+        lowering stamped (FLAGS_tpu_op_provenance), maps every
+        collective in the lowered module back to its fluid op / bucket
+        / gradient, and cross-checks the class totals against
+        donation_report EXACTLY. See
+        paddle_tpu/observability/attribution.py; bench.py emits this as
+        the "attribution" block and `tools/perf_analysis.py
+        --attribution` writes artifacts/attribution.json. None when not
+        jit-lowered."""
+        got = self._cached_lowerable(program, feed, fetch_list, scope)
+        if got is None:
+            return None
+        entry, lowered, smut, favals, sro = got
+        from ..observability import attribution as _attr
+
+        prog = program or framework.default_main_program()
+        from . import compiler as _compiler
+
+        if isinstance(prog, _compiler.CompiledProgram):
+            prog = prog._unwrap()
+        compiled = self._aot_compile(entry, lowered, smut)
+        state_avals = dict(smut)
+        state_avals.update(sro)
+        # flat jit argument order (feeds, mut state, ro state, seed;
+        # dict pytrees flatten sorted by key) — seeds the optimized
+        # HLO pass's parameter->var inheritance
+        arg_names = (sorted(favals) + sorted(smut) + sorted(sro)
+                     + ["<seed>"])
+        rep = _attr.build_report(
+            prog, prog.global_block(), self._shard_plan_of(program),
+            self._shard_count(entry), favals, state_avals,
+            ma=compiled.memory_analysis(),
+            optimized_hlo=compiled.as_text(),
+            stablehlo_asm=_attr.stablehlo_debug_asm(lowered),
+            topk=topk, arg_names=arg_names)
+        rep["cross_check"] = _attr.cross_check_donation(
+            rep, self._donation_report_from(program, entry, lowered,
+                                            smut, favals))
+        return rep
+
+    def _hbm_preflight(self, program, entry, feed_arrays, states_mut,
+                       states_ro, scope):
+        """OOM pre-flight (FLAGS_tpu_hbm_budget_mb; runs once per fresh
+        compile, BEFORE the first dispatch): AOT-compile the entry,
+        model peak HBM (memory_analysis + the input pipeline's
+        prefetched feed buffers) and raise a structured
+        HbmBudgetExceeded naming the top consumers when it exceeds the
+        budget — a pre-dispatch failure with a named culprit instead of
+        an opaque RESOURCE_EXHAUSTED mid-run."""
+        from ..observability import attribution as _attr
+
+        budget = _attr.budget_bytes()
+        if budget is None or not hasattr(entry.jitted, "lower"):
+            return
+        favals = {n: self._aval_of(a) for n, a in feed_arrays.items()}
+        smut = {n: self._aval_of(v) for n, v in states_mut.items()}
+        sro = {n: self._aval_of(v) for n, v in states_ro.items()}
+        lowered = self._lower_entry(entry, favals, smut, sro)
+        ma = self._aot_compile(entry, lowered, smut).memory_analysis()
+        feed_bytes = sum(
+            int(np.prod(a.shape or (1,))) * np.dtype(a.dtype).itemsize
+            for a in favals.values())
+        predicted = _attr.predicted_peak_bytes(ma, feed_bytes)
+        if predicted <= budget:
+            return
+        prog = program
+        from . import compiler as _compiler
+
+        if isinstance(prog, _compiler.CompiledProgram):
+            prog = prog._unwrap()
+        breakdown = _attr.static_breakdown(
+            prog, prog.global_block(), self._shard_plan_of(program),
+            self._shard_count(entry), feed_arrays=feed_arrays,
+            state_names=list(states_mut) + list(states_ro),
+            scope=scope)
+        top = breakdown["top_consumers"]
+        from .. import observability as _obs
+
+        try:
+            _obs.registry().event(
+                "hbm_preflight", verdict="exceeded",
+                predicted_bytes=int(predicted),
+                budget_bytes=int(budget),
+                top_consumer=top[0]["name"] if top else None)
+        except Exception:  # noqa: BLE001 - telemetry is best-effort
+            pass
+        raise _attr.HbmBudgetExceeded(predicted, budget, top)
+
     def overlap_report(self, program=None, feed=None, fetch_list=None,
                        scope=None):
         """Collective/compute overlap audit of the cached executable's
@@ -1035,7 +1181,7 @@ class Executor:
         got = self._cached_lowerable(program, feed, fetch_list, scope)
         if got is None:
             return None
-        entry, lowered, smut, _ = got
+        entry, lowered, smut = got[0], got[1], got[2]
         rep = lowering.collective_overlap_audit(
             self._aot_compile(entry, lowered, smut).as_text())
         plan = self._shard_plan_of(program)

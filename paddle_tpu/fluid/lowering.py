@@ -23,6 +23,7 @@ inside the block is SSA-ified by the name->value environment.
 """
 from __future__ import annotations
 
+import contextlib
 from typing import Dict, List
 
 import numpy as np
@@ -159,13 +160,32 @@ def analyze_block(block, feed_names, fetch_names):
     return state_in, state_out
 
 
+def _prov_scope(op, op_idx):
+    """Provenance stamp of one traced op (FLAGS_tpu_op_provenance; see
+    observability/attribution.py): a jax.named_scope whose marker rides
+    the name stack into the StableHLO debug locations AND the optimized
+    HLO's op_name metadata — zero runtime cost, one context manager per
+    op at trace time. Control-flow sub-block ops nest inside their
+    parent op's scope; the innermost marker is the true source."""
+    from ..observability import attribution as _attr
+
+    return _attr.op_scope(op, op_idx)
+
+
 def _exec_op(op, env, key0, op_idx, amp_lists=None):
+    t = op.type
+    if t in _SKIP_OPS:
+        return
+    with _prov_scope(op, op_idx):
+        return _exec_op_stamped(op, env, key0, op_idx,
+                                amp_lists=amp_lists)
+
+
+def _exec_op_stamped(op, env, key0, op_idx, amp_lists=None):
     import jax
     import jax.numpy as jnp
 
     t = op.type
-    if t in _SKIP_OPS:
-        return
     if t == "while":
         return _exec_while(op, env, key0, op_idx, amp_lists)
     if t == "scan":
@@ -628,7 +648,7 @@ def _run_gradient_merge(ops, bwd_idx, gm, env, key0, amp_lists,
                 else:
                     merged = e[acc] / k if avg else e[acc]
                     if sync_fn is not None:
-                        merged = sync_fn(merged)
+                        merged = sync_fn(merged, g)
                     e[g] = merged.astype(e[g].dtype)
             _su.run_sharded_post_ops(post_ops, e, key0, bwd_idx + 1,
                                      amp_lists, shard_plan, block)
@@ -639,7 +659,7 @@ def _run_gradient_merge(ops, bwd_idx, gm, env, key0, amp_lists,
                     # implicit-DP sync on the merged grad: one allreduce
                     # per k steps (the predicate is counter-driven, so
                     # every shard takes this branch together)
-                    merged = sync_fn(merged)
+                    merged = sync_fn(merged, g)
                 e[g] = merged.astype(e[g].dtype)
             _run_ops(post_ops, e, key0, base_idx=bwd_idx + 1,
                      amp_lists=amp_lists)
@@ -650,7 +670,7 @@ def _run_gradient_merge(ops, bwd_idx, gm, env, key0, amp_lists,
             # (the skip branch passes the incoming shard through, so
             # pytrees agree); every other shard-space value gathers back
             return tuple(
-                (_su.gather_full(e[n], shard_plan)
+                (_su.gather_full(e[n], shard_plan, name=n)
                  if isinstance(e[n], _su.ShardVal)
                  and n not in shard_plan.sharded_state else e[n])
                 for n in out_names)
@@ -689,11 +709,14 @@ def _amp_found_inf(grads, axis_names):
         total = total + jnp.sum(
             (~jnp.isfinite(v.astype(jnp.float32))).astype(jnp.float32))
     axes = penv.active_axes() or {}
-    for axis_name in axis_names:
-        if axis_name is not None and axes.get(axis_name, 1) > 1:
-            import jax
+    from ..observability import attribution as _attr
 
-            total = jax.lax.psum(total, axis_name)
+    with _attr.marker_scope(_attr.amp_marker("found_inf")):
+        for axis_name in axis_names:
+            if axis_name is not None and axes.get(axis_name, 1) > 1:
+                import jax
+
+                total = jax.lax.psum(total, axis_name)
     return total > 0
 
 
@@ -766,7 +789,7 @@ def _run_loss_scaled_post(ops, bwd_idx, dls, env, key0, amp_lists,
         if isinstance(v, _su.ShardVal):
             if shard_plan is not None and \
                     not isinstance(ref, _su.ShardVal):
-                v = _su.gather_full(v, shard_plan)
+                v = _su.gather_full(v, shard_plan, name=n)
             elif isinstance(ref, _su.ShardVal):
                 return v.astype(ref.dtype) \
                     if v.dtype != ref.dtype else v
@@ -923,10 +946,12 @@ def build_block_fn(program, block, feed_names, fetch_names,
         return tuple(a for a in (_dp_axis_name, _dcn_axis_name)
                      if a is not None and axes.get(a, 1) > 1)
 
-    def _dp_pmean(g):
+    def _dp_pmean(g, name=None):
         """pmean over the dp axis when implicit sync is on and the axis
         is live (inside shard_map); identity otherwise. On a hybrid
-        mesh: hierarchical psum (ici, then dcn) / world."""
+        mesh: hierarchical psum (ici, then dcn) / world. `name` stamps
+        the emitted collective with a grad-sync provenance marker so
+        the census maps it back to its gradient."""
         if not _implicit_dp:
             return g
         live = _dp_sync_axes()
@@ -934,18 +959,22 @@ def build_block_fn(program, block, feed_names, fetch_names,
             return g
         import jax as _jax
 
-        if _dcn_axis_name is None:
-            # flat dp: keep the exact pre-hybrid lowering
-            return _jax.lax.pmean(g, _dp_axis_name)
-        from ..parallel import env as penv
+        from ..observability import attribution as _attr
 
-        axes = penv.active_axes() or {}
-        total = g
-        world = 1
-        for a in live:
-            total = _jax.lax.psum(total, a)
-            world *= axes[a]
-        return total / world
+        with _attr.marker_scope(_attr.grad_sync_marker(name)) \
+                if name else contextlib.nullcontext():
+            if _dcn_axis_name is None:
+                # flat dp: keep the exact pre-hybrid lowering
+                return _jax.lax.pmean(g, _dp_axis_name)
+            from ..parallel import env as penv
+
+            axes = penv.active_axes() or {}
+            total = g
+            world = 1
+            for a in live:
+                total = _jax.lax.psum(total, a)
+                world *= axes[a]
+            return total / world
 
 
     def fn(feeds: Dict, states_mut: Dict, states_ro: Dict, seed):
@@ -1058,7 +1087,7 @@ def build_block_fn(program, block, feed_names, fetch_names,
                             gdict, shard_plan, mean=True)
                         grads = {
                             n: (scattered[gn] if gn in scattered
-                                else _dp_pmean(grads[n]))
+                                else _dp_pmean(grads[n], gn))
                             for n, gn in gnames.items()}
                     else:
                         # ZeRO-1 per-variable collectives (the exact
@@ -1067,13 +1096,17 @@ def build_block_fn(program, block, feed_names, fetch_names,
                         # semantics -> /N); everything else keeps the
                         # replicated pmean (e.g. a fetched grad)
                         grads = {
-                            n: (_su.reduce_scatter_mean(g, shard_plan)
+                            n: (_su.reduce_scatter_mean(
+                                g, shard_plan,
+                                name=framework.grad_var_name(n))
                                 if framework.grad_var_name(n)
                                 in shard_plan.grad_names
-                                else _dp_pmean(g))
+                                else _dp_pmean(
+                                    g, framework.grad_var_name(n)))
                             for n, g in grads.items()}
                 else:
-                    grads = {n: _dp_pmean(g) for n, g in grads.items()}
+                    grads = {n: _dp_pmean(g, framework.grad_var_name(n))
+                             for n, g in grads.items()}
             # dynamic loss scaling: the finite check runs on the SYNCED
             # (scattered) values each replica will actually consume,
             # psum'd over the dp axis so the update-skip predicate is
@@ -1086,11 +1119,18 @@ def build_block_fn(program, block, feed_names, fetch_names,
                     (_dp_axis_name, _dcn_axis_name))
             # under gradient merge, sync once on the MERGED grads at the
             # k-step boundary instead of k per-micro-step allreduces
+            from ..observability import attribution as _attr
+
             for n in diff_names:
-                g = grads[n]
-                if amp_scale is not None:
-                    g = _amp_unscale(g, amp_scale)
-                env[framework.grad_var_name(n)] = g.astype(env[n].dtype)
+                gn = framework.grad_var_name(n)
+                # stamp the grad post-processing (unscale + dtype cast)
+                # with the gradient's provenance so its converts blame
+                # the right var in the attribution report
+                with _attr.marker_scope(_attr.grad_sync_marker(gn)):
+                    g = grads[n]
+                    if amp_scale is not None:
+                        g = _amp_unscale(g, amp_scale)
+                    env[gn] = g.astype(env[n].dtype)
             loss_val = env[loss_name]
             env[framework.grad_var_name(loss_name)] = jnp.full(
                 loss_val.shape, loss_scale, loss_val.dtype)
@@ -1118,7 +1158,8 @@ def build_block_fn(program, block, feed_names, fetch_names,
                 raise RuntimeError("fetch var %r was never computed" % n)
             v = env[n]
             if shard_plan is not None and isinstance(v, _su.ShardVal):
-                v = _su.gather_full(v, shard_plan)  # fetched as full
+                # fetched as full
+                v = _su.gather_full(v, shard_plan, name=n)
             fetches.append(v)
         if shard_plan is None:
             new_states = {n: env[n] for n in state_out if n in env}
@@ -1616,6 +1657,16 @@ def _hlo_result_bytes(result_type):
     return total
 
 
+import re as _re
+
+#: the optimized-HLO instruction grammar, shared with
+#: observability/attribution.py's activation-provenance walker so the
+#: two parsers can never drift on the dump format
+_HLO_INSTR_RE = _re.compile(r"^\s+(?:ROOT\s+)?%([^\s=]+)\s*=\s*(.*)$")
+_HLO_OPCODE_RE = _re.compile(r"([a-z][a-z0-9\-]*)\(")
+_HLO_OPNAME_RE = _re.compile(r'op_name="([^"]*)"')
+
+
 def _parse_hlo_module(optimized_hlo):
     """One pass over an optimized HLO dump. Returns (entry, regions):
     `entry` is the ENTRY computation as an ordered list of (name,
@@ -1630,9 +1681,9 @@ def _parse_hlo_module(optimized_hlo):
     than report 'no collectives' for the gm-sharded path."""
     import re
 
-    name_re = re.compile(r"^\s+(?:ROOT\s+)?%([^\s=]+)\s*=\s*(.*)$")
-    opcode_re = re.compile(r"([a-z][a-z0-9\-]*)\(")
-    opname_re = re.compile(r'op_name="([^"]*)"')
+    name_re = _HLO_INSTR_RE
+    opcode_re = _HLO_OPCODE_RE
+    opname_re = _HLO_OPNAME_RE
     entry, regions = [], []
     comp = None  # None = between computations; "" = ENTRY
     for line in optimized_hlo.splitlines():

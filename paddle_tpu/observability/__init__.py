@@ -37,6 +37,7 @@ from .aggregate import (window_summary, allgather_window,  # noqa: F401
                         load_telemetry_dir, OnlineAggregator)
 from .schema import (load_schema, validate_record,  # noqa: F401
                      validate_records)
+from . import attribution  # noqa: F401
 from . import publish  # noqa: F401
 
 __all__ = [
@@ -72,15 +73,39 @@ def disable_online_stragglers() -> None:
     _online = None
 
 
+def _hbm_step_fields() -> dict:
+    """Live device HBM as per-step record fields + registry gauges
+    (`core.memory.memory_stats` via PJRT): empty on backends that do
+    not report memory stats (CPU meshes usually don't)."""
+    try:
+        from ..core import memory
+
+        stats = memory.memory_stats()
+    except Exception:  # noqa: BLE001 - stats are best-effort
+        return {}
+    out = {}
+    if "bytes_in_use" in stats:
+        out["hbm_bytes_in_use"] = int(stats["bytes_in_use"])
+    if "peak_bytes_in_use" in stats:
+        out["hbm_peak_bytes_in_use"] = int(stats["peak_bytes_in_use"])
+    return out
+
+
 def on_executor_step(phases_ms: dict, ts=None) -> None:
     """Executor step epilogue (fluid/executor.py run()'s finally):
-    record the step, arm the crash/capture hooks once a telemetry dir
-    is configured, and poll the capture trigger. Never raises — a
-    telemetry failure must not take down the step loop."""
+    record the step (with the live-HBM gauges when the device reports
+    them — they land in the JSONL stream and tools/timeline.py renders
+    them as a chrome-trace counter lane), arm the crash/capture hooks
+    once a telemetry dir is configured, and poll the capture trigger.
+    Never raises — a telemetry failure must not take down the step
+    loop."""
     global _armed
     try:
         reg = registry()
-        reg.record_step(phases_ms, ts=ts)
+        hbm = _hbm_step_fields()
+        for k, v in hbm.items():
+            reg.set_gauge("hbm." + k[len("hbm_"):], v)
+        reg.record_step(phases_ms, ts=ts, extra=hbm)
         if reg.telemetry_dir and not _armed:
             _armed = True
             install_flight_recorder()

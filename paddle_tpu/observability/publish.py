@@ -18,6 +18,8 @@ one-line BENCH summary bench.py always printed, and publishes):
     hierarchy_block(exe, p, f, fl)      "hierarchy" (hybrid multi-pod
                                         mesh: dcn/ici lane census)
     precision_block(exe, p, f, fl)      "precision"
+    attribution_block(exe, p, f, fl)    "attribution" (per-op HBM
+                                        blame + provenance coverage)
     static_checks_block(p)              "static_checks"
     telemetry_block(group=None)         "telemetry" (registry counters,
                                         straggler report when a
@@ -30,8 +32,8 @@ from typing import Optional
 from .registry import registry
 
 __all__ = ["phases_block", "collectives_blocks", "hierarchy_block",
-           "precision_block", "static_checks_block", "telemetry_block",
-           "bench_blocks"]
+           "precision_block", "attribution_block",
+           "static_checks_block", "telemetry_block", "bench_blocks"]
 
 
 def phases_block() -> dict:
@@ -253,6 +255,52 @@ def precision_block(exe, program, feed, fetch_list) -> Optional[dict]:
         return None
 
 
+def attribution_block(exe, program, feed, fetch_list) -> Optional[dict]:
+    """Per-op HBM attribution evidence (Executor.attribution_report /
+    observability/attribution.py): the buffer-class totals, the
+    provenance coverage of the modeled peak, the top consumers, and
+    the collective->provenance round-trip tally. None when the entry
+    is not jit-lowered."""
+    try:
+        rep = exe.attribution_report(program, feed=feed,
+                                     fetch_list=fetch_list)
+    except Exception as e:  # noqa: BLE001 - evidence, not gating
+        print("BENCH attribution failed: %r" % (e,), flush=True)
+        return None
+    if not rep:
+        return None
+    mem = rep.get("memory", {})
+    colls = rep.get("collectives", {})
+    block = {
+        "classes": rep.get("classes", {}),
+        "coverage": mem.get("coverage"),
+        "peak_model_bytes": mem.get("peak_model_bytes"),
+        "attributed_bytes": mem.get("attributed_bytes"),
+        "top_consumers": rep.get("top_consumers", []),
+        "collectives_mapped": colls.get("mapped", 0),
+        "collectives_total": colls.get("count", 0),
+        "cross_check_ok": rep.get("cross_check", {}).get("ok"),
+    }
+    reg = registry()
+    if mem.get("coverage") is not None:
+        reg.set_gauge("attribution.coverage", mem["coverage"])
+    if mem.get("peak_model_bytes"):
+        reg.set_gauge("attribution.peak_model_bytes",
+                      mem["peak_model_bytes"])
+    reg.publish_block("attribution", block)
+    top = block["top_consumers"][:1]
+    print("BENCH attribution: %.0f%% of %.2f MB peak attributed "
+          "(%d/%d collectives mapped, cross-check %s)%s"
+          % (100.0 * (block["coverage"] or 0.0),
+             (block["peak_model_bytes"] or 0) / 1e6,
+             block["collectives_mapped"], block["collectives_total"],
+             "ok" if block["cross_check_ok"] else "FAILED",
+             ", top: %s %.2f MB" % (top[0]["name"],
+                                    top[0]["bytes"] / 1e6)
+             if top else ""), flush=True)
+    return block
+
+
 def static_checks_block(program) -> Optional[dict]:
     """tpu-lint summary of the program that just ran: zero errors is
     the standing claim. Evidence, not gating."""
@@ -321,6 +369,7 @@ def bench_blocks(exe, program, feed, fetch_list, group=None) -> dict:
     collectives_blocks(exe, program, feed, fetch_list)
     hierarchy_block(exe, program, feed, fetch_list)
     precision_block(exe, program, feed, fetch_list)
+    attribution_block(exe, program, feed, fetch_list)
     static_checks_block(program)
     telemetry_block(group=group)
     return reg.blocks()

@@ -272,14 +272,21 @@ class MetricsRegistry:
             self.histogram(name)._observe(value)
 
     # -- the step / event record stream -----------------------------------
-    def record_step(self, phases_ms: dict, ts=None) -> dict:
+    def record_step(self, phases_ms: dict, ts=None, extra=None) -> dict:
         """One completed executor step: `phases_ms` maps phase name (no
-        _ms suffix needed) -> milliseconds. Returns the record."""
+        _ms suffix needed) -> milliseconds. `extra` merges additional
+        schema-known numeric fields into the record (the live-HBM
+        gauges — see tools/telemetry_schema.json step.optional).
+        Returns the record."""
         with self._lock:
             self._step += 1
             rec = {"kind": "step", "rank": self.rank,
                    "step": self._step,
                    "ts": float(ts if ts is not None else time.time())}
+            for k, v in (extra or {}).items():
+                if isinstance(v, (int, float)) and \
+                        not isinstance(v, bool):
+                    rec[k] = v
             total = 0.0
             for f in STEP_FIELDS:
                 if f == "total_ms":

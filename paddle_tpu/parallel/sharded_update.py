@@ -705,24 +705,33 @@ def _cross_pod_sum(vec, plan):
     return lax.psum(vec, plan.dcn_axis)
 
 
-def reduce_scatter_sum(g, plan):
+def reduce_scatter_sum(g, plan, name=None):
     """psum_scatter the padded flat gradient: each replica receives the
     cross-replica SUM of its 1/N slice — half the ICI bytes of the
     allreduce it replaces (the all-gather half moves to the params).
     On a hybrid (dcn, ici) mesh this is the hierarchical pair: scatter
     over the intra-pod ici axis, then psum the 1/ici shards across
-    pods over dcn (cross-pod bytes = flat-allreduce bytes / ici)."""
+    pods over dcn (cross-pod bytes = flat-allreduce bytes / ici).
+    `name` stamps the collective with a grad-sync provenance marker
+    (observability/attribution.py) so the census maps it back to its
+    gradient."""
+    import contextlib
+
     from jax import lax
 
+    from ..observability import attribution as _attr
+
     vec = _flat_pad(g, plan.ndev)
-    return ShardVal(
-        _cross_pod_sum(lax.psum_scatter(vec, plan.axis, tiled=True),
-                       plan),
-        tuple(g.shape))
+    with _attr.marker_scope(_attr.grad_sync_marker(name)) \
+            if name else contextlib.nullcontext():
+        return ShardVal(
+            _cross_pod_sum(lax.psum_scatter(vec, plan.axis, tiled=True),
+                           plan),
+            tuple(g.shape))
 
 
-def reduce_scatter_mean(g, plan):
-    sv = reduce_scatter_sum(g, plan)
+def reduce_scatter_mean(g, plan, name=None):
+    sv = reduce_scatter_sum(g, plan, name=name)
     return ShardVal(sv.vec / plan.world, sv.shape)
 
 
@@ -757,30 +766,40 @@ def bucket_reduce_scatter(bucket, grads, plan, mean):
     def flush():
         if not run:
             return
-        # optimization barriers on BOTH sides of the batched collective
-        # keep every producer (grad+pad) and consumer (optimizer update)
-        # fusion the same standalone shape as in the per-variable
-        # lowering — XLA would otherwise fuse the concatenate/slices
-        # into them and regroup FMA contractions ~1 ulp off the
-        # unbucketed path, breaking the bit-identical contract
-        vecs = lax.optimization_barrier(tuple(
-            _flat_pad(grads[e.grad], plan.ndev) for e in run))
-        buf = jnp.reshape(_bucket_replica_major(list(vecs), plan.ndev),
-                          (-1,))
-        # hierarchical (hybrid mesh): ONE intra-pod scatter + ONE
-        # cross-pod psum of the 1/ici shard per bucket — the bucket's
-        # DCN bytes are its flat-allreduce bytes / ici_size
-        sc = _cross_pod_sum(
-            lax.psum_scatter(buf, plan.axis, tiled=True), plan)
-        if mean:
-            sc = sc / plan.world
-        off = 0
-        pieces = []
-        for e in run:
-            size = e.padded // plan.ndev
-            pieces.append(lax.slice(sc, (off,), (off + size,)))
-            off += size
-        pieces = lax.optimization_barrier(tuple(pieces))
+        # the bucket provenance marker wraps the WHOLE batched exchange
+        # (pads, replica-major concat, collectives, slices) so every
+        # byte of the transient bucket buffer blames the bucket in the
+        # attribution report (observability/attribution.py)
+        from ..observability import attribution as _attr
+
+        with _attr.marker_scope(
+                _attr.bucket_marker(bucket.index, "scatter")):
+            # optimization barriers on BOTH sides of the batched
+            # collective keep every producer (grad+pad) and consumer
+            # (optimizer update) fusion the same standalone shape as in
+            # the per-variable lowering — XLA would otherwise fuse the
+            # concatenate/slices into them and regroup FMA contractions
+            # ~1 ulp off the unbucketed path, breaking the
+            # bit-identical contract
+            vecs = lax.optimization_barrier(tuple(
+                _flat_pad(grads[e.grad], plan.ndev) for e in run))
+            buf = jnp.reshape(
+                _bucket_replica_major(list(vecs), plan.ndev), (-1,))
+            # hierarchical (hybrid mesh): ONE intra-pod scatter + ONE
+            # cross-pod psum of the 1/ici shard per bucket — the
+            # bucket's DCN bytes are its flat-allreduce bytes /
+            # ici_size
+            sc = _cross_pod_sum(
+                lax.psum_scatter(buf, plan.axis, tiled=True), plan)
+            if mean:
+                sc = sc / plan.world
+            off = 0
+            pieces = []
+            for e in run:
+                size = e.padded // plan.ndev
+                pieces.append(lax.slice(sc, (off,), (off + size,)))
+                off += size
+            pieces = lax.optimization_barrier(tuple(pieces))
         for e, vec in zip(run, pieces):
             out[e.grad] = ShardVal(vec, e.shape)
         del run[:]
@@ -804,8 +823,8 @@ def bucketed_reduce_scatter(grads, plan, mean=True):
         out.update(bucket_reduce_scatter(bucket, grads, plan, mean))
     for n, g in grads.items():
         if n not in out:
-            out[n] = (reduce_scatter_mean(g, plan) if mean
-                      else reduce_scatter_sum(g, plan))
+            out[n] = (reduce_scatter_mean(g, plan, name=n) if mean
+                      else reduce_scatter_sum(g, plan, name=n))
     return out
 
 
@@ -823,24 +842,36 @@ def bucketed_gather_deferred(env, plan):
     pipeline) — a collective operand, by contrast, pins each update
     fusion to exactly the per-variable lowering's shape, keeping
     bucketed runs bit-identical to FLAGS_tpu_comm_bucket_mb=0."""
+    from ..observability import attribution as _attr
+
     for bucket in reversed(plan.buckets):
         # entries are stored in backward production order; reverse
         # within the bucket too so emission is strictly forward order
-        for e in reversed(bucket.entries):
-            if e.param_out in plan.defer_gather and \
-                    isinstance(env.get(e.param_out), ShardVal):
-                env[e.param_out] = gather_full(env[e.param_out], plan)
+        with _attr.marker_scope(
+                _attr.bucket_marker(bucket.index, "gather")):
+            for e in reversed(bucket.entries):
+                if e.param_out in plan.defer_gather and \
+                        isinstance(env.get(e.param_out), ShardVal):
+                    env[e.param_out] = gather_full(env[e.param_out],
+                                                   plan)
 
 
-def gather_full(sv: ShardVal, plan):
+def gather_full(sv: ShardVal, plan, name=None):
     """all_gather a ShardVal back to its replicated logical form (the
-    updated params; also any sharded value that is fetched)."""
+    updated params; also any sharded value that is fetched). `name`
+    stamps the collective with a gather provenance marker."""
+    import contextlib
+
     import jax.numpy as jnp
     from jax import lax
 
-    full = lax.all_gather(sv.vec, plan.axis, tiled=True)
-    numel = int(np.prod(sv.shape)) if sv.shape else 1
-    return jnp.reshape(full[:numel], sv.shape)
+    from ..observability import attribution as _attr
+
+    with _attr.marker_scope(_attr.gather_marker(name)) \
+            if name else contextlib.nullcontext():
+        full = lax.all_gather(sv.vec, plan.axis, tiled=True)
+        numel = int(np.prod(sv.shape)) if sv.shape else 1
+        return jnp.reshape(full[:numel], sv.shape)
 
 
 def wrap_sharded_state(env, plan):
@@ -859,7 +890,7 @@ def unwrap_out(name, v, plan):
         return v
     if name in plan.sharded_state:
         return v.vec
-    return gather_full(v, plan)
+    return gather_full(v, plan, name=name)
 
 
 # ---------------------------------------------------------------------------
@@ -1025,7 +1056,7 @@ def exec_sharded_op(op, env, plan, block) -> bool:
         if len(xs) == 1 and xs[0] in plan.rs_targets and \
                 not isinstance(env[xs[0]], ShardVal):
             env[op.output_names["Out"][0]] = \
-                reduce_scatter_sum(env[xs[0]], plan)
+                reduce_scatter_sum(env[xs[0]], plan, name=xs[0])
             return True
         return False
 
@@ -1119,7 +1150,12 @@ def run_sharded_post_ops(post_ops, env, key0, base_idx, amp_lists, plan,
                 for bidx in [bi for bi, vals in pending.items()
                              if reads & set(vals)]:
                     _flush(bidx)
-        if exec_sharded_op(op, env, plan, block):
+        # the shard-space interpreter bypasses lowering._exec_op, so it
+        # stamps its own per-op provenance scope (the _exec_op fallback
+        # below stamps itself)
+        with lowering._prov_scope(op, base_idx + i):
+            handled = exec_sharded_op(op, env, plan, block)
+        if handled:
             continue
         lowering._exec_op(op, env, key0, base_idx + i,
                           amp_lists=amp_lists)

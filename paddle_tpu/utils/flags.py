@@ -127,6 +127,22 @@ _FLAGS: Dict[str, object] = {
     # exceeds this many MB it is atomically renamed to a numbered
     # generation and a fresh file starts
     "FLAGS_tpu_telemetry_rotate_mb": 64.0,
+    # per-op provenance stamping (observability/attribution.py): every
+    # traced fluid op (and every grad-sync / bucket / gather collective)
+    # carries a jax.named_scope marker into the lowered StableHLO debug
+    # locations and the optimized HLO op_name metadata, so HBM and
+    # device-time blame can name the framework op / layer / bucket.
+    # Costs one python context manager per op at TRACE time only.
+    "FLAGS_tpu_op_provenance": True,
+    # OOM pre-flight (Executor): when nonzero, every freshly compiled
+    # program's modeled HBM peak (memory_analysis + prefetched feed
+    # buffers) is checked BEFORE the first dispatch and a structured
+    # HbmBudgetExceeded error naming the top consumers is raised when
+    # it exceeds the budget. > 0 = explicit budget in MB; < 0 (or
+    # "auto") = the device's own bytes_limit from
+    # core.memory.memory_stats(); 0 = off (the default — arming the
+    # gate AOT-compiles each fresh entry once more).
+    "FLAGS_tpu_hbm_budget_mb": 0.0,
     # online straggler cadence: with observability.
     # enable_online_stragglers(group) armed, the ranks exchange window
     # summaries (one host-tier allgather) every this-many steps and the
@@ -137,6 +153,11 @@ _FLAGS: Dict[str, object] = {
 }
 
 
+#: numeric flags that also accept a symbolic string value from the env
+#: (FLAGS_tpu_hbm_budget_mb="auto" = the device's own bytes_limit)
+_SYMBOLIC_VALUE_FLAGS = frozenset({"FLAGS_tpu_hbm_budget_mb"})
+
+
 def _ingest_env():
     for k in list(_FLAGS):
         if k in os.environ:
@@ -144,10 +165,20 @@ def _ingest_env():
             cur = _FLAGS[k]
             if isinstance(cur, bool):
                 _FLAGS[k] = v.lower() in ("1", "true", "yes")
-            elif isinstance(cur, int):
-                _FLAGS[k] = int(v)
-            elif isinstance(cur, float):
-                _FLAGS[k] = float(v)
+            elif isinstance(cur, (int, float)):
+                # numeric flags that also accept SYMBOLIC values keep
+                # the raw string when it doesn't parse; every other
+                # numeric flag keeps the loud import-time error — a
+                # typo'd FLAGS_tpu_telemetry_rotate_mb=64M must not
+                # silently disable telemetry
+                try:
+                    _FLAGS[k] = (int(v) if isinstance(cur, int)
+                                 else float(v))
+                except ValueError:
+                    if k in _SYMBOLIC_VALUE_FLAGS:
+                        _FLAGS[k] = v
+                    else:
+                        raise
             else:
                 _FLAGS[k] = v
 

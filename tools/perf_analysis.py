@@ -16,10 +16,29 @@ Usage: python tools/perf_analysis.py [--batches 256,512]
        python tools/perf_analysis.py --sharded-diff
        python tools/perf_analysis.py --overlap-audit [--bucket-mb 0.25]
        python tools/perf_analysis.py --hierarchy [--dcn 2]
+       python tools/perf_analysis.py --attribution [--bucket-mb 0.25]
        python tools/perf_analysis.py --lint [tpu_lint args...]
        python tools/perf_analysis.py --stragglers \
-           --telemetry-dir DIR [--window 32]
+           --telemetry-dir DIR [--window 32] [--xplane-dir DIR]
        python tools/perf_analysis.py --elastic --log-dir DIR
+
+`--attribution` is the offline evidence for per-op resource
+attribution (observability/attribution.py): it compiles the DP
+BERT-tiny train step with ZeRO-1 + AMP-O2 masters + bucketed
+collectives on the emulated CPU mesh, asserts that >= 90% of the
+compiled `memory_analysis()` peak attributes to named framework
+ops/classes, that the class totals match `donation_report` EXACTLY,
+that every collective in the lowered module maps back to a fluid op /
+bucket / gradient, and that `FLAGS_tpu_hbm_budget_mb` set below the
+predicted peak fails PRE-dispatch with a structured error naming the
+top consumers. Writes artifacts/attribution.json; exits nonzero when
+any of those do not hold.
+
+`--stragglers --xplane-dir DIR` additionally folds the profiler op
+durations of a capture window (the trace.json.gz inside a PR 7
+capture.py xplane dir) back through the provenance markers to
+per-layer / per-bucket device time — the blame one level below the
+phase verdict.
 
 `--hierarchy` is the offline evidence for the hierarchical DCN+ICI
 grad collectives (FLAGS_tpu_dcn_replicas, hybrid multi-pod mesh): it
@@ -86,7 +105,8 @@ import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 if ("--sharded-diff" in sys.argv or "--overlap-audit" in sys.argv
-        or "--hierarchy" in sys.argv) and \
+        or "--hierarchy" in sys.argv or "--attribution" in sys.argv) \
+        and \
         "xla_force_host_platform_device_count" not in \
         os.environ.get("XLA_FLAGS", ""):
     # the diff needs a multi-device mesh; must be set pre-jax-import
@@ -351,10 +371,14 @@ def sharded_update_diff(batch=16, seq_len=32):
     return 0 if ok else 1
 
 
-def _bert_tiny_step(batch, seq_len, flags):
+def _bert_tiny_step(batch, seq_len, flags, amp=False, run=True):
     """One compiled data-parallel BERT-tiny Adam step under `flags`;
     returns the serving Executor + program + feed (for the report
-    APIs). Fresh programs/scope per call so flag changes recompile."""
+    APIs). Fresh programs/scope per call so flag changes recompile.
+    `amp`: mixed_precision.decorate the optimizer (O2 masters, static
+    scaling — the bench's AMP shape). `run=False` skips the train-step
+    dispatch (the OOM pre-flight leg needs a program that FAILS before
+    its first dispatch)."""
     import paddle_tpu.fluid as fluid
     from paddle_tpu.core import scope as scope_mod
     from paddle_tpu.fluid import framework
@@ -372,15 +396,21 @@ def _bert_tiny_step(batch, seq_len, flags):
         framework.default_startup_program().random_seed = 7
         total, _, _, _ = bert.bert_pretrain_loss(
             cfg, seq_len, is_test=False)
-        fluid.optimizer.AdamOptimizer(
-            learning_rate=1e-3).minimize(total)
+        opt = fluid.optimizer.AdamOptimizer(learning_rate=1e-3)
+        if amp:
+            from paddle_tpu.fluid.contrib import mixed_precision
+
+            opt = mixed_precision.decorate(
+                opt, use_dynamic_loss_scaling=False)
+        opt.minimize(total)
         prog = fluid.default_main_program()
         fluid.CompiledProgram(prog).with_data_parallel(
             loss_name=total.name)
         exe = fluid.Executor(fluid.TPUPlace())
         exe.run(fluid.default_startup_program())
         feed = _bert_feed(cfg, batch, seq_len)
-        exe.run(prog, feed=feed, fetch_list=[total])
+        if run:
+            exe.run(prog, feed=feed, fetch_list=[total])
     return exe, prog, feed, total
 
 
@@ -490,6 +520,116 @@ def overlap_audit(bucket_mb=0.25, batch=16, seq_len=32):
              rs_combined0.get("backward_after", -1),
              "OK" if ok else "OVERLAP NOT MET", path))
     return 0 if ok else 1
+
+
+def attribution_audit(batch=16, seq_len=32, bucket_mb=0.25):
+    """The acceptance audit for per-op resource attribution: BERT-tiny
+    DP + ZeRO-1 + AMP-O2 masters + bucketed collectives on the emulated
+    CPU mesh. Asserts (1) >= 90% of the compiled memory_analysis()
+    peak attributes to named framework ops/classes, (2) the class
+    totals match donation_report EXACTLY, (3) every collective in the
+    lowered module maps to a fluid op / bucket / gradient, and (4)
+    FLAGS_tpu_hbm_budget_mb set below the predicted peak fails
+    PRE-dispatch with a structured HbmBudgetExceeded naming the top
+    consumers. Writes artifacts/attribution.json; returns the process
+    exit code."""
+    import json
+
+    from paddle_tpu.observability.attribution import HbmBudgetExceeded
+    from paddle_tpu.utils.flags import set_flags
+
+    exe, prog, feed, total = _bert_tiny_step(
+        batch, seq_len,
+        {"FLAGS_tpu_sharded_weight_update": True,
+         "FLAGS_tpu_comm_bucket_mb": bucket_mb},
+        amp=True)
+    rep = exe.attribution_report(prog, feed=feed, fetch_list=[total])
+    mem = rep.get("memory", {})
+    colls = rep.get("collectives", {})
+    cross = rep.get("cross_check", {})
+    coverage = float(mem.get("coverage") or 0.0)
+    mapped_ok = colls.get("count", 0) > 0 and \
+        colls.get("mapped") == colls.get("count")
+
+    # OOM pre-flight: a budget below the predicted peak must fail the
+    # NEXT program before its first dispatch, naming the consumers
+    budget_mb = max(mem.get("peak_model_bytes", 0) / 1e6 / 2.0, 0.001)
+    preflight = {"budget_mb": budget_mb, "raised": False}
+    try:
+        exe2, prog2, feed2, total2 = _bert_tiny_step(
+            batch, seq_len,
+            {"FLAGS_tpu_sharded_weight_update": True,
+             "FLAGS_tpu_comm_bucket_mb": bucket_mb},
+            amp=True, run=False)
+        set_flags({"FLAGS_tpu_hbm_budget_mb": budget_mb})
+        try:
+            exe2.run(prog2, feed=feed2, fetch_list=[total2])
+        except HbmBudgetExceeded as e:
+            preflight.update({
+                "raised": True,
+                "predicted_bytes": e.predicted_bytes,
+                "budget_bytes": e.budget_bytes,
+                "top_consumers": e.top_consumers,
+            })
+    finally:
+        set_flags({"FLAGS_tpu_hbm_budget_mb": 0})
+
+    out = {
+        "model": "bert-tiny b%d s%d (DP + ZeRO-1 + AMP-O2 + buckets)"
+                 % (batch, seq_len),
+        "bucket_mb": bucket_mb,
+        "ndev": rep.get("ndev"),
+        "classes": rep.get("classes"),
+        "memory": mem,
+        "coverage": coverage,
+        "collectives": {"count": colls.get("count"),
+                        "mapped": colls.get("mapped")},
+        "cross_check": cross,
+        "top_consumers": rep.get("top_consumers"),
+        "activation_by_layer":
+            rep.get("activation", {}).get("by_layer"),
+        "preflight": preflight,
+    }
+    path = os.path.join(_REPO, "artifacts", "attribution.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2, sort_keys=True)
+    ok = (coverage >= 0.90 and cross.get("ok") and mapped_ok
+          and preflight["raised"]
+          and bool(preflight.get("top_consumers")))
+    print("attribution audit (%s): %.0f%% of %.2f MB peak attributed, "
+          "cross-check %s, %s/%s collectives mapped, pre-flight %s; "
+          "%s; wrote %s"
+          % (out["model"], 100.0 * coverage,
+             mem.get("peak_model_bytes", 0) / 1e6,
+             "ok" if cross.get("ok") else "FAILED",
+             colls.get("mapped"), colls.get("count"),
+             "raised pre-dispatch" if preflight["raised"]
+             else "DID NOT RAISE",
+             "OK" if ok else "ATTRIBUTION NOT MET", path))
+    return 0 if ok else 1
+
+
+def xplane_blame(xplane_dir):
+    """Fold a capture window's device op durations through the
+    provenance markers: the per-layer / per-bucket device-time blame
+    (--stragglers --xplane-dir). Returns the attribution dict."""
+    from paddle_tpu.observability import attribution as attr
+
+    events = attr.load_trace_events(xplane_dir)
+    t = attr.time_attribution(events)
+    if not t["total_us"]:
+        print("xplane dir %s: no duration events found" % xplane_dir)
+        return t
+    print("device-time attribution over %s (%.1f ms total, %.0f%% "
+          "matched to provenance markers):"
+          % (xplane_dir, t["total_us"] / 1e3,
+             100.0 * t["matched_us"] / max(t["total_us"], 1)))
+    for layer, us in list(t["by_layer"].items())[:10]:
+        print("  layer %-28s %10.1f us" % (layer, us))
+    for b, us in t["by_bucket"].items():
+        print("  bucket %-27d %10.1f us" % (b, us))
+    return t
 
 
 def stragglers(telemetry_dir, window=32):
@@ -616,7 +756,7 @@ def main():
         raise SystemExit(elastic_report(log_dir=ldir,
                                         telemetry_dir=tdir))
     if "--stragglers" in args:
-        tdir, window = None, 32
+        tdir, window, xdir = None, 32, None
         rest = [a for a in args if a != "--stragglers"]
         i = 0
         while i < len(rest):
@@ -633,14 +773,22 @@ def main():
                 tdir = val
             elif flag == "--window":
                 window = int(val)
+            elif flag == "--xplane-dir":
+                xdir = val
             else:
                 raise SystemExit("unknown --stragglers argument: %s"
                                  % flag)
             i += 1
         if not tdir:
             raise SystemExit(
-                "usage: --stragglers --telemetry-dir DIR [--window N]")
-        raise SystemExit(stragglers(tdir, window=window))
+                "usage: --stragglers --telemetry-dir DIR [--window N] "
+                "[--xplane-dir DIR]")
+        rc = stragglers(tdir, window=window)
+        if xdir:
+            # per-layer / per-bucket device-time blame from a capture
+            # window's trace, one level below the phase verdict
+            xplane_blame(xdir)
+        raise SystemExit(rc)
     if "--lint" in args:
         # alias into the tpu-lint static verifier; tools/ is not a
         # package, so import by path alongside this file
@@ -651,19 +799,27 @@ def main():
             [a for a in args if a != "--lint"]))
     if "--sharded-diff" in args:
         raise SystemExit(sharded_update_diff())
-    if "--overlap-audit" in args:
-        mb = 0.25
-        for i, a in enumerate(args):
+
+    def _parse_bucket_mb(argv, default=0.25):
+        mb = default
+        for i, a in enumerate(argv):
             if not a.startswith("--bucket-mb"):
                 continue
             val = (a.split("=", 1)[1] if "=" in a
-                   else args[i + 1] if i + 1 < len(args) else "")
+                   else argv[i + 1] if i + 1 < len(argv) else "")
             try:
                 mb = float(val)
             except ValueError:
                 raise SystemExit(
                     "usage: --bucket-mb <float MB> (got %r)" % (val,))
-        raise SystemExit(overlap_audit(bucket_mb=mb))
+        return mb
+
+    if "--attribution" in args:
+        raise SystemExit(attribution_audit(
+            bucket_mb=_parse_bucket_mb(args)))
+    if "--overlap-audit" in args:
+        raise SystemExit(overlap_audit(
+            bucket_mb=_parse_bucket_mb(args)))
     if "--hierarchy" in args:
         dcn = 2
         for i, a in enumerate(args):

@@ -50,6 +50,11 @@ _MODULES = [
     # --stragglers, tools/timeline.py --telemetry and the launcher's
     # postmortem collection — lock the surface
     "paddle_tpu.observability",
+    # per-op resource attribution: provenance markers, the HBM/time
+    # blame report builders and the OOM pre-flight error are relied on
+    # by the lowering, Executor.attribution_report, bench.py's
+    # "attribution" block and perf_analysis --attribution — lock them
+    "paddle_tpu.observability.attribution",
     # AMP: decorate()/master-weight rewrites are the bench's and the
     # perf-analysis tooling's entry into mixed precision — lock them
     "paddle_tpu.fluid.contrib.mixed_precision",

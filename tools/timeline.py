@@ -15,7 +15,9 @@ It also merges the observability telemetry stream
 reads the per-rank `telemetry.rank<R>.jsonl` files and adds one lane
 per rank — step records as duration events (per-step phase breakdown in
 args), collective/rpc/fault/checkpoint events as duration or instant
-events. Per-rank wall clocks are OFFSET-CORRECTED before merging:
+events, and the live-HBM gauge fields (`hbm_bytes_in_use` /
+`hbm_peak_bytes_in_use`, published by the executor step epilogue) as a
+chrome-trace counter ("ph": "C") lane. Per-rank wall clocks are OFFSET-CORRECTED before merging:
 host-collective completions carry a cross-rank `key` (ranks leave
 barrier/gather N at ~the same instant), so the median per-key delta
 against the reference rank aligns the lanes even when hosts' clocks
@@ -155,6 +157,21 @@ def telemetry_lane_events(records, offset_s=0.0):
                         "cat": "telemetry",
                         "args": {k: v for k, v in rec.items()
                                  if k not in ("kind", "ts")}})
+            # live-HBM gauge (observability step epilogue) as a
+            # chrome-trace COUNTER lane: each args key renders as its
+            # own stacked series in chrome://tracing / Perfetto. The
+            # sample is taken in the step EPILOGUE, so it stamps at
+            # the step's END (ts + total), not its start — the spike a
+            # step's dispatch allocates must line up with THAT step's
+            # span, not the previous one's
+            if "hbm_bytes_in_use" in rec:
+                cargs = {"bytes_in_use": rec["hbm_bytes_in_use"]}
+                if "hbm_peak_bytes_in_use" in rec:
+                    cargs["peak_bytes_in_use"] = \
+                        rec["hbm_peak_bytes_in_use"]
+                evs.append({"name": "hbm", "ph": "C", "pid": 0,
+                            "tid": 0, "ts": ts_us + dur,
+                            "cat": "telemetry", "args": cargs})
         elif rec.get("kind") == "event":
             name = rec.get("event", "event")
             for detail in ("op", "method", "action"):
