@@ -40,7 +40,8 @@ from .collectives import (IR_COLLECTIVE_OPS,  # noqa: F401
                           check_collective_divergence,
                           check_hierarchical_groups,
                           check_hlo_divergence, collective_schedule,
-                          hlo_collective_schedule)
+                          hlo_collective_schedule,
+                          runtime_schedule_key)
 from .donation import (check_donation_safety,  # noqa: F401
                        cross_check_donation_report)
 from .host_sync import check_host_sync  # noqa: F401
@@ -54,7 +55,7 @@ __all__ = [
     "IR_COLLECTIVE_OPS", "collective_schedule",
     "check_branch_uniformity", "check_collective_divergence",
     "hlo_collective_schedule", "check_hlo_divergence",
-    "check_hierarchical_groups",
+    "check_hierarchical_groups", "runtime_schedule_key",
     "check_donation_safety", "cross_check_donation_report",
     "check_host_sync", "check_shard_plan", "check_zero2_lifetimes",
     "check_dtype_shape_contracts", "run_static_checks",

@@ -120,6 +120,31 @@ def _schedule_key(rec):
             rec["group"], rec["region"])
 
 
+def runtime_schedule_key(kind, dtype=None, shape=None, world=None,
+                         ranks=None, ring_id=0, region=""):
+    """The RUNTIME twin of `_schedule_key`: the in-flight collective
+    trace (observability/watchdog.py) keys every host-collective /
+    RPC-barrier record with this function, so the static divergence
+    checker and the runtime desync analyzer can never disagree on what
+    "the same collective" means. The group signature mirrors
+    `group_membership`'s attr encoding (`("world", N)` for a
+    HostCollectiveGroup sized N, `("ranks", (...))` for an explicit
+    member set); dtype/shape are the payload's, None when the op
+    carries none (a barrier's token payload is implementation detail —
+    record it anyway when known, exactly as the static pass reads the
+    op's first input var)."""
+    sig = []
+    if world is not None:
+        sig.append(("world", int(world)))
+    if ranks is not None:
+        sig.append(("ranks", tuple(int(r) for r in ranks)))
+    group = tuple(sig) if sig else None
+    return (str(kind),
+            None if dtype is None else str(dtype),
+            None if shape is None else tuple(int(d) for d in shape),
+            int(ring_id), group, str(region))
+
+
 def collective_schedule(program, block=None, _path="", _region=""):
     """Ordered collective records of a Program's global block, descending
     into every control-flow sub-block (loop bodies inline; branch

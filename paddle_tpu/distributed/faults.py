@@ -11,6 +11,15 @@ This module injects faults at the RPC socket layer:
              TCP drop; the peer sees the close too)
     delay  — sleep `delay_ms` before the socket op (slow network)
     kill   — os._exit(exit_code): a preempted / OOM-killed worker
+    stall  — hold the socket op FOREVER (unlike the bounded delay): an
+             alive-but-wedged rank — the process keeps running (and
+             heartbeating), the op never completes. The deterministic
+             trigger for hang-watchdog / desync tests
+             (observability/watchdog.py). The stalled thread parks on
+             a module Event that `reset()` releases (raising
+             FaultError into the op), so in-process tests can unstick
+             it; a subprocess stays wedged until its supervisor kills
+             it, exactly like the real failure.
 
 Injection points (where rpc.py calls back into this module):
 
@@ -67,7 +76,7 @@ class FaultInjector:
     """One armed fault: fires on matching (side, point, method) events
     according to its deterministic counter."""
 
-    KINDS = ("drop", "delay", "kill")
+    KINDS = ("drop", "delay", "kill", "stall")
 
     def __init__(self, kind, side=None, point=None, method=None,
                  every=None, at=None, delay_ms=50, exit_code=137):
@@ -108,6 +117,15 @@ class FaultInjector:
 
             time.sleep(self.delay_ms / 1000.0)
             return
+        if self.kind == "stall":
+            # alive-but-wedged: park this thread on the release event
+            # (set only by reset()) — the process lives on, heartbeats
+            # keep flowing on their own sockets, but THIS op never
+            # completes. That is the hang the watchdog exists to catch.
+            _stall_release.wait()
+            raise FaultError(
+                "fault-injected stall released (%s/%s event #%d)"
+                % (side, point, n))
         if self.kind == "kill":
             # a preempted worker leaves a postmortem: dump the flight
             # recorder (last N steps + events, the fatal event on top)
@@ -158,6 +176,9 @@ class FaultInjector:
 _lock = threading.Lock()
 _injectors: List[FaultInjector] = []
 _env_loaded = False
+#: stalled threads park here; reset() sets it (releasing them with a
+#: FaultError) and re-arms a fresh event for the next test
+_stall_release = threading.Event()
 
 
 def parse_spec(spec: str) -> List[FaultInjector]:
@@ -195,11 +216,16 @@ def install(injector: FaultInjector) -> FaultInjector:
 
 def reset():
     """Clear every armed injector (incl. env-armed) and re-arm from the
-    env on the next socket op only if PADDLE_FAULTS is still set."""
-    global _env_loaded
+    env on the next socket op only if PADDLE_FAULTS is still set. Also
+    releases any thread parked in a `stall` fault (it raises FaultError
+    into its socket op)."""
+    global _env_loaded, _stall_release
     with _lock:
         _injectors.clear()
         _env_loaded = False
+        old = _stall_release
+        _stall_release = threading.Event()
+    old.set()
 
 
 def _load_env_once():

@@ -337,7 +337,7 @@ class HostCollectiveGroup:
         return lane
 
     @contextlib.contextmanager
-    def _comm_phase(self, op=None, key=None):
+    def _comm_phase(self, op=None, key=None, payload=None):
         """Account host-collective wall time to the profiler's `comm`
         step phase (the executor keeps `host` disjoint from it), so a
         step blocked on cross-rank coordination shows as comm, not as
@@ -345,15 +345,42 @@ class HostCollectiveGroup:
         telemetry "collective" event carrying its cross-rank `key`
         (ranks issue collectives in lockstep, so key N completes at
         ~the same wall instant everywhere — tools/timeline.py uses
-        these as clock-sync anchors when merging per-rank JSONL)."""
+        these as clock-sync anchors when merging per-rank JSONL).
+
+        Yields an in-flight trace token (observability/watchdog.py —
+        the NCCL-flight-recorder idiom): enqueue is recorded here, the
+        collective body marks `arrived()` once this rank's part landed
+        in the store, and completion/failure is recorded on exit. The
+        hang watchdog and the offline desync analyzer read that table;
+        a wedged rank's token still in state "inflight" is the one
+        that never arrived."""
         from ..fluid import profiler as _prof
 
+        tok = None
+        if op is not None:
+            try:
+                from ..observability import watchdog as _wd
+
+                _wd.maybe_install()
+                tok = _wd.trace().begin(
+                    op, key, tier="host", world=self.world,
+                    rank=self.rank,
+                    dtype=None if payload is None else payload.dtype,
+                    shape=None if payload is None else payload.shape,
+                    nbytes=None if payload is None else payload.nbytes)
+            except Exception:  # noqa: BLE001 - tracing never gates comm
+                tok = None
         t0 = time.perf_counter()
         ok = False
         try:
-            yield
+            yield tok
             ok = True
         finally:
+            if tok is not None:
+                try:
+                    tok.done(ok)
+                except Exception:  # noqa: BLE001
+                    pass
             dt = time.perf_counter() - t0
             _prof.record_step_phase("comm", dt, t0)
             # multi-pod launches (PADDLE_NUM_PODS > 1): break the comm
@@ -375,16 +402,20 @@ class HostCollectiveGroup:
 
     def barrier(self):
         key = self._key("barrier")
-        with self._comm_phase("barrier", key):
+        with self._comm_phase("barrier", key) as tok:
             self._client.call("hc_put_part", key, self.rank,
                               np.zeros((1,), np.int8))
+            if tok is not None:
+                tok.arrived()
             self._client.call("hc_gather", key, self.rank)
 
     def all_reduce(self, array, op="sum"):
         key = self._key("allreduce")
-        with self._comm_phase("allreduce", key):
-            self._client.call("hc_put_part", key, self.rank,
-                              np.ascontiguousarray(array))
+        buf = np.ascontiguousarray(array)
+        with self._comm_phase("allreduce", key, payload=buf) as tok:
+            self._client.call("hc_put_part", key, self.rank, buf)
+            if tok is not None:
+                tok.arrived()
             parts = self._client.call("hc_gather", key, self.rank)
         stack = np.stack([np.asarray(p) for p in parts])
         if op == "sum":
@@ -399,9 +430,11 @@ class HostCollectiveGroup:
 
     def all_gather(self, array) -> List[np.ndarray]:
         key = self._key("allgather")
-        with self._comm_phase("allgather", key):
-            self._client.call("hc_put_part", key, self.rank,
-                              np.ascontiguousarray(array))
+        buf = np.ascontiguousarray(array)
+        with self._comm_phase("allgather", key, payload=buf) as tok:
+            self._client.call("hc_put_part", key, self.rank, buf)
+            if tok is not None:
+                tok.arrived()
             parts = self._client.call("hc_gather", key, self.rank)
         return [np.asarray(p) for p in parts]
 
@@ -416,9 +449,16 @@ class HostCollectiveGroup:
 
     def broadcast(self, array, root=0):
         key = self._key("bcast")
-        if self.rank == root:
-            self._client.call("hc_put", key, np.ascontiguousarray(array))
-        (val,) = self._client.call("hc_get", key, 1, self.rank, root)
+        buf = np.ascontiguousarray(array)
+        with self._comm_phase("broadcast", key, payload=buf) as tok:
+            if self.rank == root:
+                self._client.call("hc_put", key, buf)
+            if tok is not None:
+                # the root's contribution is its put; a non-root has
+                # nothing to contribute — only the blocking get remains
+                tok.arrived()
+            (val,) = self._client.call("hc_get", key, 1, self.rank,
+                                       root)
         return np.asarray(val)
 
     def store_stats(self):

@@ -92,6 +92,16 @@ def _parse_args(argv):
                         "dead workers and relaunch the survivors at any "
                         "world size >= M (0 = fixed world: all N must "
                         "come back)")
+    p.add_argument("--hang_timeout", type=float, default=None,
+                   help="runtime hang escalation: export "
+                        "FLAGS_tpu_hang_timeout_s=S to the workers "
+                        "(arming their in-process watchdogs) and watch "
+                        "their telemetry streams for `hang` events / "
+                        "heartbeat silence; an alive-but-wedged cohort "
+                        "is dumped, killed and routed through the "
+                        "--min_ranks elastic restart with the desync "
+                        "verdict attached. Default: the "
+                        "PADDLE_HANG_TIMEOUT_S env, else 0 (off)")
     p.add_argument("--num_pods", type=int, default=0,
                    help="multi-pod topology: partition the ranks into K "
                         "contiguous pods (PADDLE_NUM_PODS/PADDLE_POD_ID "
@@ -161,7 +171,7 @@ def _pod_shrink(endpoints, failed_tids, npods):
 
 
 def _worker_env(endpoints, tid, restart_no, base_env=None,
-                telemetry_dir=None, npods=1):
+                telemetry_dir=None, npods=1, hang_timeout_s=0.0):
     """The PADDLE_* contract for one supervised worker. Cross-rank
     checkpoint-step agreement (PADDLE_CKPT_AGREE, see
     distributed/sharded_checkpoint.agree_newest_intact) is ON by
@@ -179,6 +189,13 @@ def _worker_env(endpoints, tid, restart_no, base_env=None,
     env.setdefault("PADDLE_CKPT_AGREE", "1")
     if telemetry_dir:
         env.setdefault("FLAGS_tpu_telemetry_dir", telemetry_dir)
+    if hang_timeout_s and hang_timeout_s > 0:
+        # one knob arms both tiers: the workers' in-process watchdogs
+        # (stack + in-flight dumps, `hang`/`heartbeat` events) and the
+        # supervisor's escalation watch. An explicit value in the
+        # launcher's env wins.
+        env.setdefault("FLAGS_tpu_hang_timeout_s",
+                       repr(float(hang_timeout_s)))
     env.update({
         "PADDLE_TRAINER_ID": str(tid),
         "PADDLE_CURRENT_ENDPOINT": endpoints[tid],
@@ -334,6 +351,147 @@ def _supervisor_event(args, etype, **fields):
     return rec
 
 
+class _HangWatch:
+    """Supervisor-side hang detection over the workers' telemetry
+    streams — plain file tailing, no jax imports, no RPC to the wedged
+    cohort.
+
+    Primary signal: a worker watchdog (FLAGS_tpu_hang_timeout_s, armed
+    by --hang_timeout) publishes a `hang` event into its JSONL sink
+    the moment a collective is stuck past the timeout; this watch
+    tails `telemetry.rank*.jsonl` incrementally and fires on the first
+    one. Fallback: every stream silent (no bytes appended — armed
+    watchdogs heartbeat, so silence means the PROCESS is wedged before
+    its watchdog could arm, or telemetry died with it) for
+    4x the timeout after at least one record was seen."""
+
+    STALE_FACTOR = 4.0
+
+    def __init__(self, telemetry_dir, timeout_s, poll_every_s=0.5):
+        self.dir = telemetry_dir
+        self.timeout_s = float(timeout_s)
+        self._poll_every = float(poll_every_s)
+        self._last_poll = 0.0
+        self._offsets = {}        # fname -> bytes already scanned
+        self._last_growth = None  # monotonic ts of last appended byte
+        self._seen_any = False
+        self._hang_events = []    # parsed worker hang event records
+
+    def _rank_files(self):
+        try:
+            return [f for f in sorted(os.listdir(self.dir))
+                    if f.startswith("telemetry.rank")
+                    and f.endswith(".jsonl")]
+        except OSError:
+            return []
+
+    def poll(self):
+        """None, or a detection dict {"via": "hang-event"|"silence",
+        "ranks": [ranks that reported], "events": [...]}."""
+        now = time.monotonic()
+        if now - self._last_poll < self._poll_every:
+            return None
+        self._last_poll = now
+        if self._last_growth is None:
+            self._last_growth = now
+        import json
+
+        grew = False
+        for fname in self._rank_files():
+            path = os.path.join(self.dir, fname)
+            off = self._offsets.get(fname, 0)
+            try:
+                size = os.path.getsize(path)
+                if size < off:
+                    # rotation: the active file was os.replace'd to a
+                    # .gN generation and restarted at 0 — a stale
+                    # offset would both hide new hang events and let
+                    # the silence fallback kill a healthy cohort
+                    off = self._offsets[fname] = 0
+                if size <= off:
+                    continue
+                with open(path) as f:
+                    f.seek(off)
+                    chunk = f.read(size - off)
+            except OSError:
+                continue
+            # only complete lines; a torn tail re-reads next poll
+            consumed = chunk.rfind("\n") + 1
+            self._offsets[fname] = off + consumed
+            grew = grew or consumed > 0
+            self._seen_any = self._seen_any or consumed > 0
+            for line in chunk[:consumed].splitlines():
+                if '"event": "hang"' not in line:
+                    continue
+                try:
+                    self._hang_events.append(json.loads(line))
+                except ValueError:
+                    continue
+        if grew:
+            self._last_growth = now
+        if self._hang_events:
+            return {"via": "hang-event",
+                    "ranks": sorted({int(e.get("rank", -1))
+                                     for e in self._hang_events}),
+                    "events": list(self._hang_events)}
+        if self._seen_any and \
+                now - self._last_growth > self.STALE_FACTOR \
+                * self.timeout_s:
+            return {"via": "silence", "ranks": [], "events": []}
+        return None
+
+
+def _hang_verdict(telemetry_dir):
+    """Cross-rank desync verdict over the worker watchdogs' flight
+    dumps (observability/watchdog.py's pure-JSON analyzer — the same
+    code `perf_analysis --hang-report` runs, so supervisor and offline
+    tooling can never disagree). Returns the verdict dict, or None
+    when the dumps are unreadable/absent."""
+    try:
+        from ..observability.watchdog import (analyze_hang,
+                                              load_hang_bundle)
+
+        docs = load_hang_bundle(telemetry_dir)
+        if not docs:
+            return None
+        return analyze_hang(docs)
+    except Exception as e:  # noqa: BLE001 - escalation must proceed
+        sys.stderr.write("paddle_tpu.launch: hang verdict failed: "
+                         "%s\n" % e)
+        return None
+
+
+def _wait_for_hang_dumps(telemetry_dir, world, grace_s):
+    """Give every rank's watchdog a beat to land its flight dump
+    before the cohort is killed (they all fire within ~a tick of each
+    other; the kill itself would suppress nothing — the dump is
+    written first — but collecting a complete bundle beats a partial
+    one)."""
+    deadline = time.monotonic() + grace_s
+    while time.monotonic() < deadline:
+        try:
+            dumps = [f for f in os.listdir(telemetry_dir)
+                     if f.startswith("flightrec.rank")
+                     and f.endswith(".json")]
+        except OSError:
+            dumps = []
+        if len(dumps) >= world:
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def _hang_timeout_for(args):
+    """--hang_timeout, else PADDLE_HANG_TIMEOUT_S, else 0 (off)."""
+    if args.hang_timeout is not None:
+        return max(0.0, float(args.hang_timeout))
+    try:
+        return max(0.0, float(
+            os.environ.get("PADDLE_HANG_TIMEOUT_S", "0") or 0))
+    except ValueError:
+        return 0.0
+
+
 def _spawn_cohort(args, endpoints, local_ids, restart_no, npods=1):
     procs, logs = [], []
     tdir = _telemetry_dir_for(args)
@@ -341,7 +499,8 @@ def _spawn_cohort(args, endpoints, local_ids, restart_no, npods=1):
         os.makedirs(tdir, exist_ok=True)
     for tid in local_ids:
         env = _worker_env(endpoints, tid, restart_no,
-                          telemetry_dir=tdir, npods=npods)
+                          telemetry_dir=tdir, npods=npods,
+                          hang_timeout_s=_hang_timeout_for(args))
         cmd = [sys.executable, "-u", args.training_script] \
             + args.training_script_args
         out = None
@@ -377,18 +536,27 @@ def _terminate_all(procs, grace_s=10.0):
                 p.wait()
 
 
-def _supervise(procs, local_ids, stop_sig):
+#: conventional exit code for a hang-escalated cohort kill (the shell
+#: `timeout` convention; distinguishes "wedged, supervisor killed it"
+#: from a worker's own failure in logs and restart accounting)
+HANG_RC = 124
+
+
+def _supervise(procs, local_ids, stop_sig, hang_watch=None):
     """Poll until all workers exit or one fails. Returns (rc,
-    failed_tids): rc is the first non-zero return code (lowest trainer
-    id among the failures seen in the poll cycle that detected the
-    fault), 0 on clean completion; failed_tids names the workers that
-    died ON THEIR OWN in that cycle — the elastic policy treats them as
-    lost machines (survivors are terminated by the fail-fast teardown
-    and are NOT in the list)."""
+    failed_tids, hang): rc is the first non-zero return code (lowest
+    trainer id among the failures seen in the poll cycle that detected
+    the fault), 0 on clean completion; failed_tids names the workers
+    that died ON THEIR OWN in that cycle — the elastic policy treats
+    them as lost machines (survivors are terminated by the fail-fast
+    teardown and are NOT in the list). `hang` is None, or the
+    _HangWatch detection dict for an alive-but-wedged cohort (rc is
+    HANG_RC there; the guilty rank comes from the desync verdict over
+    the collected dumps, not from this loop)."""
     while True:
         if stop_sig["sig"] is not None:
             _terminate_all(procs)
-            return 128 + stop_sig["sig"], []
+            return 128 + stop_sig["sig"], [], None
         failed = [(tid, p.returncode) for tid, p in zip(local_ids, procs)
                   if p.poll() is not None and p.returncode != 0]
         if failed:
@@ -402,9 +570,35 @@ def _supervise(procs, local_ids, stop_sig):
                 "paddle_tpu.launch: worker %d exited with %d; "
                 "terminating cohort\n" % (bad_tid, bad_rc))
             _terminate_all(procs)
-            return bad_rc, [tid for tid, _ in failed]
+            return bad_rc, [tid for tid, _ in failed], None
         if all(p.poll() is not None for p in procs):
-            return 0, []
+            return 0, [], None
+        if hang_watch is not None:
+            hang = hang_watch.poll()
+            if hang is not None:
+                sys.stderr.write(
+                    "paddle_tpu.launch: cohort alive but wedged "
+                    "(detected via %s%s); collecting dumps and "
+                    "terminating\n"
+                    % (hang["via"],
+                       ", hang reported by rank(s) %s" % hang["ranks"]
+                       if hang["ranks"] else ""))
+                # let every rank's watchdog land its stack + in-flight
+                # dump before the kill (they fire within ~a tick of
+                # each other); SIGTERM dumps are once-suppressed after
+                # a watchdog dump, so what's on disk IS the evidence
+                _wait_for_hang_dumps(
+                    hang_watch.dir, len(procs),
+                    grace_s=min(10.0, max(
+                        1.0, hang_watch.timeout_s)))
+                # re-poll after the grace: the first detection froze
+                # `ranks` at whichever rank's event landed first, and
+                # the fallback blame must not punish ranks for losing
+                # a reporting-order race
+                hang_watch._last_poll = 0.0
+                hang = hang_watch.poll() or hang
+                _terminate_all(procs)
+                return HANG_RC, [], hang
         time.sleep(0.1)
 
 
@@ -445,6 +639,13 @@ def launch(argv=None):
             "paddle_tpu.launch: --min_ranks needs the supervisor to own "
             "the whole cohort (all-localhost endpoints, no --host_id); "
             "falling back to fixed-world restarts\n")
+    if _hang_timeout_for(args) > 0 and not _telemetry_dir_for(args):
+        sys.stderr.write(
+            "paddle_tpu.launch: --hang_timeout needs a telemetry dir "
+            "(--log_dir or FLAGS_tpu_telemetry_dir) for supervisor-"
+            "side detection; workers still arm their in-process "
+            "watchdogs (dumps land in their CWD) but hang ESCALATION "
+            "is off\n")
 
     max_r = max(args.max_restarts, 0)
     rc = 0
@@ -469,8 +670,13 @@ def launch(argv=None):
             _supervisor_event(args, "elastic_transition", **pending_evt)
             pending_evt = None
         live_procs[:] = procs
+        tdir = _telemetry_dir_for(args)
+        hang_timeout = _hang_timeout_for(args)
+        hang_watch = (_HangWatch(tdir, hang_timeout)
+                      if hang_timeout > 0 and tdir else None)
         try:
-            rc, failed_tids = _supervise(procs, local_ids, stop_sig)
+            rc, failed_tids, hang = _supervise(procs, local_ids,
+                                               stop_sig, hang_watch)
         finally:
             for f in logs:
                 if f:
@@ -478,6 +684,48 @@ def launch(argv=None):
         if rc == 0 or stop_sig["sig"] is not None:
             break
         t_fail = time.monotonic()
+        hang_fields = {}
+        if hang is not None:
+            # name the guilty rank BEFORE the dumps move: the desync
+            # verdict over the per-rank in-flight tables (the same
+            # analyzer perf_analysis --hang-report runs offline)
+            verdict = _hang_verdict(tdir)
+            guilty = list((verdict or {}).get("guilty_ranks") or [])
+            if verdict is None and hang["ranks"]:
+                # NO verdict at all (dumps missing/torn): fall back to
+                # blaming the ranks that never published a hang event
+                # — a fully wedged process (stuck before its watchdog
+                # armed) can't report. A verdict that EXISTS but names
+                # nobody ("indeterminate": every rank arrived, the
+                # store/wire itself wedged) is respected: no machine
+                # is dropped on a guess.
+                reporters = set(hang["ranks"])
+                guilty = [tid for tid in local_ids
+                          if tid not in reporters]
+            failed_tids = guilty
+            hang_fields = {
+                "hang": True,
+                "hang_via": hang["via"],
+                "hang_collective": (verdict or {}).get("collective"),
+                "hang_op": (verdict or {}).get("op"),
+                "hang_verdict": (verdict or {}).get("verdict"),
+                "hang_guilty_ranks": guilty,
+            }
+            _supervisor_event(
+                args, "hang",
+                stalled_s=max([float(e.get("stalled_s", 0.0))
+                               for e in hang["events"]] or [0.0]),
+                inflight_n=max([int(e.get("inflight_n", 0))
+                                for e in hang["events"]] or [0]),
+                via=hang["via"], attempt=attempt,
+                collective=hang_fields["hang_collective"] or "",
+                verdict=hang_fields["hang_verdict"] or "",
+                guilty_ranks=guilty)
+            sys.stderr.write(
+                "paddle_tpu.launch: hang verdict: %s (collective %s, "
+                "guilty rank(s) %s)\n"
+                % (hang_fields["hang_verdict"],
+                   hang_fields["hang_collective"], guilty or "none"))
         # secure this attempt's per-rank flight-recorder dumps before
         # the restarted cohort overwrites them (and keep the final
         # failed attempt's evidence too when restarts are exhausted)
@@ -506,7 +754,11 @@ def launch(argv=None):
                     failed_ranks=sorted(failed_tids),
                     reassignment={str(o): n
                                   for o, n in reassignment.items()},
-                    attempt=attempt + 1, **pod_fields)
+                    attempt=attempt + 1, **pod_fields,
+                    # a hang-escalated shrink carries its desync
+                    # verdict: WHY this rank was dropped, stitched to
+                    # the postmortem bundle the dumps moved into
+                    **hang_fields)
                 sys.stderr.write(
                     "paddle_tpu.launch: elastic shrink %d -> %d ranks "
                     "(dropped %s; reassignment %s%s)\n"

@@ -95,6 +95,29 @@ def _telemetry():
         return None
 
 
+#: RPC methods that are cross-rank BARRIERS (the PS sync barrier —
+#: every trainer must arrive or everyone blocks): these record into
+#: the in-flight collective trace (observability/watchdog.py) exactly
+#: like host-tier collectives, so a hang inside the PS tier gets the
+#: same enqueue/complete forensics as one inside a HostCollectiveGroup
+_BARRIER_METHODS = frozenset({"send_barrier"})
+
+
+def _inflight_begin(method, endpoint):
+    """In-flight trace token for a barrier-like RPC, or None (tracing
+    never gates the RPC path)."""
+    if method not in _BARRIER_METHODS:
+        return None
+    try:
+        from ..observability import watchdog as _wd
+
+        return _wd.trace().begin("rpc_" + method,
+                                 "%s@%s" % (method, endpoint),
+                                 tier="rpc")
+    except Exception:  # noqa: BLE001
+        return None
+
+
 def _enc_field(buf: bytearray, v):
     if isinstance(v, str):
         b = v.encode("utf-8")
@@ -546,10 +569,25 @@ class RpcClient:
             self._sock = None
 
     def call(self, method: str, *args) -> List:
+        tok = _inflight_begin(method, self._endpoint)
         with self._lock:
             self._seq += 1
             payload = [_ENVELOPE, self._cid, self._seq, method] + list(args)
-            resp = self._call_with_retry(method, payload)
+            try:
+                resp = self._call_with_retry(method, payload, tok=tok)
+            except BaseException:
+                if tok is not None:
+                    tok.done(ok=False)
+                raise
+        if tok is not None:
+            # either error shape raises below ("exc" envelope or the
+            # legacy "err:" string): the barrier did NOT complete —
+            # the trace must not retire it as done
+            failed = bool(resp) and (
+                resp[0] == "exc"
+                or (isinstance(resp[0], str)
+                    and resp[0].startswith("err:")))
+            tok.done(ok=not failed)
         if resp and resp[0] == "exc":
             raise RpcRemoteError(method, resp[1], resp[2],
                                  resp[3] if len(resp) > 3 else "")
@@ -557,7 +595,7 @@ class RpcClient:
             raise RuntimeError("rpc %s failed: %s" % (method, resp[0][4:]))
         return resp[1:]
 
-    def _call_with_retry(self, method, payload):
+    def _call_with_retry(self, method, payload, tok=None):
         attempt = 0
         while True:
             try:
@@ -574,6 +612,10 @@ class RpcClient:
                 faults.on_message("client", "send", method=method,
                                   sock=self._sock)
                 write_msg(self._sock, payload)
+                if tok is not None:
+                    # the request bytes left: this rank ARRIVED at the
+                    # barrier; what remains is waiting on its peers
+                    tok.arrived()
                 faults.on_message("client", "recv", method=method,
                                   sock=self._sock)
                 return read_msg(self._sock)

@@ -94,7 +94,11 @@ class Executor:
         cache miss) so infeed/compute overlap is measurable — see
         fluid/profiler.py step_phase_summary."""
         from . import profiler as _prof
+        from .. import observability as _obs
 
+        # hang forensics: stamp "inside a step" on the armed watchdog
+        # (FLAGS_tpu_hang_timeout_s); a bare global check when off
+        _obs.on_step_begin()
         t_step = _time.perf_counter()
         ph = {"feed": 0.0, "dispatch": 0.0, "sync": 0.0, "compile": 0.0}
         comm0 = _prof.step_phase_total("comm")

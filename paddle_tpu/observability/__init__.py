@@ -37,18 +37,28 @@ from .aggregate import (window_summary, allgather_window,  # noqa: F401
                         load_telemetry_dir, OnlineAggregator)
 from .schema import (load_schema, validate_record,  # noqa: F401
                      validate_records)
+from .watchdog import (InflightTrace, HangWatchdog,  # noqa: F401
+                       trace as inflight_trace,
+                       watchdog as hang_watchdog,
+                       install as install_watchdog,
+                       thread_stacks, analyze_hang, load_hang_bundle,
+                       hang_report)
 from . import attribution  # noqa: F401
 from . import publish  # noqa: F401
+from . import watchdog  # noqa: F401
 
 __all__ = [
     "MetricsRegistry", "registry", "reset_registry", "configure",
     "FlightRecorder", "flight_recorder", "dump_flight_recorder",
     "install_flight_recorder",
     "CaptureController", "capture_controller", "install_capture",
+    "InflightTrace", "HangWatchdog", "inflight_trace",
+    "hang_watchdog", "install_watchdog", "thread_stacks",
+    "analyze_hang", "load_hang_bundle", "hang_report",
     "window_summary", "allgather_window", "aggregate_summaries",
     "straggler_report", "load_telemetry_dir", "OnlineAggregator",
     "load_schema", "validate_record", "validate_records",
-    "on_executor_step", "enable_online_stragglers",
+    "on_executor_step", "on_step_begin", "enable_online_stragglers",
     "disable_online_stragglers",
 ]
 
@@ -91,14 +101,27 @@ def _hbm_step_fields() -> dict:
     return out
 
 
+def on_step_begin() -> None:
+    """Executor step prologue: stamp "the main thread is inside a
+    step" on the armed hang watchdog, so a hang dump can say whether
+    the wedge is mid-step or between steps. A no-op global check when
+    FLAGS_tpu_hang_timeout_s is unset."""
+    try:
+        watchdog.note_step_begin()
+    except Exception:  # noqa: BLE001 - telemetry must never kill a step
+        pass
+
+
 def on_executor_step(phases_ms: dict, ts=None) -> None:
     """Executor step epilogue (fluid/executor.py run()'s finally):
     record the step (with the live-HBM gauges when the device reports
     them — they land in the JSONL stream and tools/timeline.py renders
     them as a chrome-trace counter lane), arm the crash/capture hooks
-    once a telemetry dir is configured, and poll the capture trigger.
-    Never raises — a telemetry failure must not take down the step
-    loop."""
+    once a telemetry dir is configured, arm + feed the hang watchdog
+    (FLAGS_tpu_hang_timeout_s; a completed step epilogue IS the
+    "progress" signal that keeps it quiet), and poll the capture
+    trigger. Never raises — a telemetry failure must not take down the
+    step loop."""
     global _armed
     try:
         reg = registry()
@@ -110,6 +133,8 @@ def on_executor_step(phases_ms: dict, ts=None) -> None:
             _armed = True
             install_flight_recorder()
             install_capture()
+        watchdog.maybe_install()
+        watchdog.note_progress("step")
         if reg.telemetry_dir:
             capture_controller().poll()
         if _online is not None:

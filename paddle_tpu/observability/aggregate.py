@@ -216,25 +216,52 @@ _RANK_FILE = re.compile(
     r"^telemetry\.rank(\d+)(?:\.g\d+)?\.jsonl$")
 
 
-def load_telemetry_dir(directory: str) -> Dict[int, List[dict]]:
+def load_telemetry_dir(directory: str,
+                       errors: Optional[List[dict]] = None
+                       ) -> Dict[int, List[dict]]:
     """{rank: [records]} from a telemetry dir (active + rotated
     generations, records in file order; generations sort before the
-    active file because rotation renames, so re-sort by ts)."""
+    active file because rotation renames, so re-sort by ts).
+
+    Undecodable lines are SKIPPED, never fatal: a killed rank (the
+    exact artifact a hang escalation or preemption leaves) tears its
+    final JSONL line mid-write, and the postmortem analysis must read
+    past it. Pass `errors` (a list) to collect
+    {"file", "line_no", "rank", "final_line", "snippet"} per skipped
+    line — tools/perf_analysis.py --stragglers reports them so a torn
+    MIDDLE line (real corruption, not a kill artifact) stays
+    visible."""
     by_rank: Dict[int, List[dict]] = {}
     for fname in sorted(os.listdir(directory)):
         m = _RANK_FILE.match(fname)
         if not m:
             continue
         rank = int(m.group(1))
+        file_errors: List[dict] = []
+        n_lines = 0
         with open(os.path.join(directory, fname)) as f:
-            for line in f:
+            # streamed, not readlines(): generations run to the 64MB
+            # rotation threshold each — don't materialize them to
+            # learn which line was last
+            for i, line in enumerate(f):
+                n_lines = i + 1
                 line = line.strip()
                 if not line:
                     continue
                 try:
-                    by_rank.setdefault(rank, []).append(json.loads(line))
+                    by_rank.setdefault(rank, []).append(
+                        json.loads(line))
                 except ValueError:
+                    if errors is not None:
+                        file_errors.append({
+                            "file": fname, "line_no": i + 1,
+                            "rank": rank, "final_line": False,
+                            "snippet": line[:120]})
                     continue  # torn final line of a killed writer
+        for e in file_errors:
+            e["final_line"] = e["line_no"] == n_lines
+        if errors is not None:
+            errors.extend(file_errors)
     for recs in by_rank.values():
         recs.sort(key=lambda r: r.get("ts", 0.0))
     return by_rank

@@ -111,6 +111,20 @@ class CaptureController:
         else:
             self.start()
 
+    def capture_for(self, duration_s: float) -> Optional[str]:
+        """Bounded capture window: start a trace now and stop it after
+        `duration_s` on a one-shot timer thread — the hang watchdog's
+        "photograph the wedged window" hook (the wedged step loop can't
+        reach the usual trigger-file poll). Returns the trace dir, or
+        None when a trace is already running / no telemetry dir."""
+        out = self.start()
+        if out is None:
+            return None
+        t = threading.Timer(max(0.05, float(duration_s)), self.stop)
+        t.daemon = True
+        t.start()
+        return out
+
     # -- step-loop poll ----------------------------------------------------
     def poll(self, now: Optional[float] = None) -> None:
         """Called from the executor's step epilogue: throttled

@@ -77,10 +77,13 @@ class FlightRecorder:
         return os.path.join(base, "flightrec.rank%d.json" % reg.rank)
 
     def dump(self, reason: str, fatal_event: Optional[dict] = None,
-             path: Optional[str] = None, once: bool = True) -> Optional[str]:
+             path: Optional[str] = None, once: bool = True,
+             extra: Optional[dict] = None) -> Optional[str]:
         """Write the postmortem atomically; returns the path (None when
         suppressed by `once` after a prior dump, or on IO failure —
-        this runs on dying processes and must never raise)."""
+        this runs on dying processes and must never raise). `extra`
+        merges additional top-level sections (the hang watchdog passes
+        its all-thread stacks this way)."""
         with self._lock:
             if once and self._dumped:
                 return None
@@ -102,6 +105,20 @@ class FlightRecorder:
                 "events": events,
                 "metrics": reg.snapshot(),
             }
+            try:
+                # EVERY postmortem carries the in-flight collective
+                # table (watchdog.py's always-on trace): a SIGTERM'd or
+                # fault-killed rank shows which collective it died
+                # inside, not just its last step record — the desync
+                # analyzer (perf_analysis --hang-report) aligns these
+                # across ranks
+                from . import watchdog as _wd
+
+                doc.setdefault("inflight", _wd.trace().snapshot())
+            except Exception:  # noqa: BLE001 - forensics, best effort
+                pass
+            if extra:
+                doc.update(extra)
             path = path or self._default_path()
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             tmp = "%s.tmp.%d" % (path, os.getpid())
@@ -145,8 +162,10 @@ def configure(capacity: Optional[int] = None) -> FlightRecorder:
 
 
 def dump(reason: str, fatal_event: Optional[dict] = None,
-         path: Optional[str] = None) -> Optional[str]:
-    return recorder().dump(reason, fatal_event=fatal_event, path=path)
+         path: Optional[str] = None,
+         extra: Optional[dict] = None) -> Optional[str]:
+    return recorder().dump(reason, fatal_event=fatal_event, path=path,
+                           extra=extra)
 
 
 def on_fatal(reason: str, fatal_event: Optional[dict] = None) -> None:

@@ -40,8 +40,10 @@ def _type_ok(value, tname) -> bool:
 
 def validate_record(record: dict, schema: dict) -> List[str]:
     """Problems with one record (empty list = valid): unknown kind,
-    missing required fields, wrong field types, and — for kinds with
-    "allow_extra": false — fields outside the contract."""
+    missing required fields, wrong field types, for kinds with
+    "allow_extra": false — fields outside the contract, and — for
+    event types the schema's per-event "events" section names (hang,
+    heartbeat) — that type's own required detail fields."""
     problems = []
     if not isinstance(record, dict):
         return ["record is %s, not an object" % type(record).__name__]
@@ -54,6 +56,14 @@ def validate_record(record: dict, schema: dict) -> List[str]:
         if f not in record:
             problems.append("%s record missing required field %r"
                             % (kind, f))
+    espec = spec.get("events", {}).get(record.get("event")) \
+        if kind == "event" else None
+    if espec:
+        for f in espec.get("required", []):
+            if f not in record:
+                problems.append(
+                    "%s event missing its required field %r"
+                    % (record["event"], f))
     types = spec.get("types", {})
     for f, v in record.items():
         if f in types and not _type_ok(v, types[f]):

@@ -143,6 +143,21 @@ _FLAGS: Dict[str, object] = {
     # core.memory.memory_stats(); 0 = off (the default — arming the
     # gate AOT-compiles each fresh entry once more).
     "FLAGS_tpu_hbm_budget_mb": 0.0,
+    # runtime hang watchdog (observability/watchdog.py): when > 0, a
+    # daemon thread fires once a collective has been in flight this
+    # many seconds with neither a step epilogue nor a collective
+    # completion advancing meanwhile — all-thread stacks + the
+    # in-flight collective table dump through the flight recorder, a
+    # "hang" event lands in the telemetry stream (the launch
+    # supervisor tails it for escalation), and a periodic "heartbeat"
+    # event proves alive-but-wedged vs dead. 0 (the default) arms
+    # NOTHING: step path, HLO and telemetry stream are byte-identical
+    # to a watchdog-less build.
+    "FLAGS_tpu_hang_timeout_s": 0.0,
+    # with the watchdog armed: also pull a capture.py xplane trace of
+    # this many seconds of the wedged window when a hang fires
+    # (0 = no capture)
+    "FLAGS_tpu_hang_capture_s": 0.0,
     # online straggler cadence: with observability.
     # enable_online_stragglers(group) armed, the ranks exchange window
     # summaries (one host-tier allgather) every this-many steps and the

@@ -1,0 +1,84 @@
+"""Worker script for the hang-watchdog acceptance test (spawned via
+`python -m paddle_tpu.distributed.launch --hang_timeout --min_ranks
+--max_restarts`).
+
+A tiny supervised train loop over the HOST collective tier: every rank
+runs `total_steps` executor steps with a cohort barrier after each. In
+stall mode the designated victim rank of attempt 0 arms a
+PADDLE_FAULTS `stall` at its Nth host-collective contribution
+(`hc_put_part` client send) — an alive-but-wedged machine: the process
+keeps running and heartbeating, but its barrier part never leaves, so
+the whole cohort blocks inside the barrier with no error and no crash.
+
+The launcher's --hang_timeout exports FLAGS_tpu_hang_timeout_s, so
+every rank's in-process watchdog dumps all-thread stacks + the
+in-flight collective table and publishes a `hang` event; the
+supervisor escalates (dumps into postmortem/, cohort killed, guilty
+rank dropped through the --min_ranks elastic restart) and the
+surviving attempt completes rc=0.
+
+argv: <total_steps> [<stall_rank> <stall_at>]
+Prints one `DONE rank=R world=W attempt=K` line on completion.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("PADDLE_HC_HEARTBEAT_S", "0.5")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    total = int(sys.argv[1])
+    stall_rank = int(sys.argv[2]) if len(sys.argv) > 2 else -1
+    stall_at = int(sys.argv[3]) if len(sys.argv) > 3 else 0
+
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    attempt = int(os.environ.get("PADDLE_RESTART_NUM", "0"))
+    if attempt == 0 and rank == stall_rank and stall_at > 0:
+        # the designated victim: wedge (not die) inside its Nth
+        # barrier contribution — the send never happens, the process
+        # stays alive and heartbeating
+        os.environ["PADDLE_FAULTS"] = (
+            "stall:side=client,point=send,method=hc_put_part,at=%d"
+            % stall_at)
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.distributed.host_collectives import group_from_env
+    from paddle_tpu.fluid import framework
+
+    group = group_from_env()
+    main_p, startup = fluid.Program(), fluid.Program()
+    with framework.unique_name_guard(), \
+            fluid.program_guard(main_p, startup):
+        main_p.random_seed = startup.random_seed = 7
+        x = fluid.data(name="x", shape=[-1, 8], dtype="float32")
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.fc(input=x, size=4, act="tanh"))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"x": np.ones((2, 8), np.float32)}
+    for i in range(total):
+        exe.run(main_p, feed=feed, fetch_list=[loss])
+        if group is not None:
+            # the stall's injection point: the victim wedges inside
+            # barrier contribution #stall_at and never returns
+            group.barrier()
+    print("DONE rank=%d world=%d attempt=%d" % (rank, world, attempt),
+          flush=True)
+    if group is not None:
+        group.shutdown()
+    sys.stdout.flush()
+    # exit WITHOUT interpreter teardown: jax's CPU runtime
+    # intermittently aborts while daemon threads die at exit (see
+    # elastic_launch_runner)
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -120,6 +120,25 @@ def test_clock_offsets_from_barrier_anchors(tmp_path):
     assert timeline.clock_offsets(by_rank)[2] == 0.0
 
 
+def test_broadcast_events_do_not_anchor_clock_offsets(tmp_path):
+    """Broadcast completion instants differ by real execution lag (the
+    root returns right after its put; each non-root whenever IT
+    arrives) — they must not enter the anchor pool, or a straggler's
+    lag would be misread as clock skew."""
+    def bcast(rank, ts, key):
+        return {"kind": "event", "event": "collective", "rank": rank,
+                "step": 1, "ts": ts, "op": "broadcast", "key": key,
+                "dur_ms": 0.5}
+
+    by_rank = {
+        0: [_coll(0, 1, 100.0, "barrier#1"), bcast(0, 100.5, "bcast#2")],
+        # rank 1's bcast completed 3s later (straggler lag, same clock)
+        1: [_coll(1, 1, 100.0, "barrier#1"), bcast(1, 103.5, "bcast#2")]}
+    offs = timeline.clock_offsets(by_rank)
+    # only the barrier anchors: zero skew, NOT the 3s bcast lag
+    assert offs[1] == 0.0, offs
+
+
 def test_telemetry_lane_events_shapes():
     evs = timeline.telemetry_lane_events(
         [_step(0, 1, 100.0, total_ms=20.0),
@@ -136,6 +155,50 @@ def test_telemetry_lane_events_shapes():
     assert abs((coll["ts"] + coll["dur"]) - (100.05 - 5.0) * 1e6) < 1
     fault = next(e for e in evs if e["name"] == "fault")
     assert fault["ph"] == "i"  # no duration: instant marker
+
+
+def test_hang_event_renders_as_wedged_window_span():
+    """A watchdog `hang` event (ts = detection instant, stalled_s =
+    how long the collective already sat) renders as a span COVERING
+    the wedged window, ending at the event — beside the step /
+    collective lanes it blocked."""
+    evs = timeline.telemetry_lane_events(
+        [{"kind": "event", "event": "hang", "rank": 0, "step": 3,
+          "ts": 110.0, "stalled_s": 2.5, "inflight_n": 1,
+          "op": "barrier", "key": "barrier#3"}], offset_s=-5.0)
+    hang = next(e for e in evs if e["name"].startswith("hang"))
+    assert hang["ph"] == "X" and hang["cat"] == "hang"
+    assert hang["dur"] == 2.5e6
+    assert abs((hang["ts"] + hang["dur"]) - (110.0 - 5.0) * 1e6) < 1
+    assert hang["args"]["key"] == "barrier#3"
+
+
+def test_heartbeat_gaps_synthesized_from_cadence():
+    """heartbeat events tick on a fixed cadence; a gap well past the
+    median interval becomes a `heartbeat-gap` span covering exactly
+    the silent stretch (a stopped process — GC storm, swap, SIGSTOP),
+    clock-offset-corrected like every other lane event."""
+    def beat(ts):
+        return {"kind": "event", "event": "heartbeat", "rank": 0,
+                "step": 1, "ts": ts, "up_s": ts - 100.0}
+
+    recs = [beat(t) for t in
+            (100.0, 101.0, 102.0, 103.0, 110.0, 111.0, 112.0)]
+    gaps = timeline.heartbeat_gap_events(recs, offset_s=-5.0)
+    (gap,) = gaps
+    assert gap["name"] == "heartbeat-gap" and gap["ph"] == "X"
+    assert gap["ts"] == (103.0 - 5.0) * 1e6
+    assert gap["dur"] == 7.0 * 1e6
+    assert gap["args"]["gap_s"] == 7.0
+    # heartbeats also still render (as instants) in the full lane,
+    # and the gap rides along
+    evs = timeline.telemetry_lane_events(recs)
+    assert sum(1 for e in evs if e["name"] == "heartbeat") == 7
+    assert sum(1 for e in evs if e["name"] == "heartbeat-gap") == 1
+    # steady cadence or too few beats: no gap invented
+    assert timeline.heartbeat_gap_events(
+        [beat(t) for t in (100.0, 101.0, 102.0)]) == []
+    assert timeline.heartbeat_gap_events([beat(100.0)]) == []
 
 
 def test_cli_merges_telemetry_without_profiles(tmp_path):

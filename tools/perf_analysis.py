@@ -21,6 +21,21 @@ Usage: python tools/perf_analysis.py [--batches 256,512]
        python tools/perf_analysis.py --stragglers \
            --telemetry-dir DIR [--window 32] [--xplane-dir DIR]
        python tools/perf_analysis.py --elastic --log-dir DIR
+       python tools/perf_analysis.py --hang-report \
+           --telemetry-dir DIR | --log-dir DIR [--attempt K]
+
+`--hang-report` is the offline desync analyzer for a hang postmortem
+(observability/watchdog.py): it aligns the per-rank in-flight
+collective tables of a bundle's flightrec.rank*.json dumps by
+collective key (the SAME schedule-key grammar the tpu-lint divergence
+checker uses — the static and runtime checkers cannot disagree on what
+"the same collective" means) and names the rank that never arrived —
+state "inflight" (began, never contributed), or absent (stalled before
+reaching it) — or the mismatched membership, as a structured verdict.
+Point it at a telemetry dir with fresh dumps or at a collected
+`<log_dir>/postmortem/attempt<K>` bundle (`--log-dir` picks the newest
+attempt unless `--attempt` says otherwise). Exits 0 with a verdict,
+1 when the bundle shows no hang, 2 when the dir has no dumps.
 
 `--attribution` is the offline evidence for per-op resource
 attribution (observability/attribution.py): it compiles the DP
@@ -634,17 +649,26 @@ def xplane_blame(xplane_dir):
 
 def stragglers(telemetry_dir, window=32):
     """Offline straggler report over a telemetry dir's per-rank JSONL
-    (see module docstring). Returns the process exit code."""
+    (see module docstring). Returns the process exit code. Torn JSONL
+    lines (the final-line artifact a killed rank leaves) are skipped
+    and REPORTED, never a traceback."""
     import json
 
     from paddle_tpu.observability import aggregate
 
-    by_rank = aggregate.load_telemetry_dir(telemetry_dir)
+    torn = []
+    by_rank = aggregate.load_telemetry_dir(telemetry_dir, errors=torn)
     steps = {r: sum(1 for rec in recs if rec.get("kind") == "step")
              for r, recs in by_rank.items()}
     print("telemetry dir %s: %d rank(s), step records per rank: %s"
           % (telemetry_dir, len(by_rank),
              {r: n for r, n in sorted(steps.items())}))
+    for t in torn:
+        print("skipped torn JSONL line: %s:%d%s (%r...)"
+              % (t["file"], t["line_no"],
+                 " [final line — a killed writer's artifact]"
+                 if t["final_line"] else " [MID-FILE: corruption?]",
+                 t["snippet"][:60]))
     report = aggregate.straggler_report(by_rank, window=window)
     if report["ranks"] < 2:
         print("need >= 2 ranks of step records for a cross-rank "
@@ -667,6 +691,40 @@ def stragglers(telemetry_dir, window=32):
     print(json.dumps({"stragglers": report, "cross_rank": agg},
                      indent=1, sort_keys=True))
     return 0
+
+
+def hang_report_cli(telemetry_dir=None, log_dir=None, attempt=None):
+    """Offline hang/desync diagnosis over a postmortem bundle (see
+    module docstring). Returns the process exit code."""
+    import json
+
+    from paddle_tpu.observability import watchdog as wd
+
+    directory = telemetry_dir
+    if directory is None and log_dir:
+        pm = os.path.join(log_dir, "postmortem")
+        if attempt is not None:
+            directory = os.path.join(pm, "attempt%d" % attempt)
+        else:
+            attempts = sorted(
+                (d for d in os.listdir(pm)
+                 if d.startswith("attempt")),
+                key=lambda d: int(d[len("attempt"):])
+            ) if os.path.isdir(pm) else []
+            directory = os.path.join(pm, attempts[-1]) if attempts \
+                else os.path.join(log_dir, "telemetry")
+    if not directory or not os.path.isdir(directory):
+        print("no postmortem bundle at %r" % directory)
+        return 2
+    rep = wd.hang_report(directory)
+    if not rep["n_docs"]:
+        print("no flightrec.rank*.json dumps under %s" % directory)
+        return 2
+    for line in rep["lines"]:
+        print(line)
+    print(json.dumps({"hang": rep["verdict"]}, indent=1,
+                     sort_keys=True))
+    return 0 if rep["verdict"]["verdict"] != "no-hang" else 1
 
 
 def elastic_report(log_dir=None, telemetry_dir=None):
@@ -725,69 +783,73 @@ def elastic_report(log_dir=None, telemetry_dir=None):
     return 0 if transitions else 1
 
 
+def _parse_mode_flags(mode, argv, spec):
+    """One parser for the `--mode --flag VALUE|--flag=VALUE ...`
+    subcommand shape --stragglers / --elastic / --hang-report all
+    share: `spec` maps accepted flag name -> converter. Returns
+    {flag: converted value}; unknown flags and missing values are
+    loud SystemExits."""
+    out = {}
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if "=" in a:
+            flag, val = a.split("=", 1)
+        else:
+            flag = a
+            val = argv[i + 1] if i + 1 < len(argv) else ""
+            if not val or val.startswith("--"):
+                raise SystemExit("flag %s needs a value" % flag)
+            i += 1
+        if flag not in spec:
+            raise SystemExit("unknown %s argument: %s" % (mode, flag))
+        out[flag] = spec[flag](val)
+        i += 1
+    return out
+
+
 def main():
     batches = [256, 512]
     resnet_batches = [128, 256]
     args = sys.argv[1:]
+    if "--hang-report" in args:
+        kv = _parse_mode_flags(
+            "--hang-report", [a for a in args if a != "--hang-report"],
+            {"--telemetry-dir": str, "--log-dir": str,
+             "--attempt": int})
+        if not (kv.get("--telemetry-dir") or kv.get("--log-dir")):
+            raise SystemExit(
+                "usage: --hang-report --telemetry-dir DIR | "
+                "--log-dir DIR [--attempt K]")
+        raise SystemExit(hang_report_cli(
+            telemetry_dir=kv.get("--telemetry-dir"),
+            log_dir=kv.get("--log-dir"),
+            attempt=kv.get("--attempt")))
     if "--elastic" in args:
-        ldir, tdir = None, None
-        rest = [a for a in args if a != "--elastic"]
-        i = 0
-        while i < len(rest):
-            a = rest[i]
-            if "=" in a:
-                flag, val = a.split("=", 1)
-            else:
-                flag = a
-                val = rest[i + 1] if i + 1 < len(rest) else ""
-                if not val or val.startswith("--"):
-                    raise SystemExit("flag %s needs a value" % flag)
-                i += 1
-            if flag == "--log-dir":
-                ldir = val
-            elif flag == "--telemetry-dir":
-                tdir = val
-            else:
-                raise SystemExit("unknown --elastic argument: %s" % flag)
-            i += 1
-        if not (ldir or tdir):
+        kv = _parse_mode_flags(
+            "--elastic", [a for a in args if a != "--elastic"],
+            {"--log-dir": str, "--telemetry-dir": str})
+        if not (kv.get("--log-dir") or kv.get("--telemetry-dir")):
             raise SystemExit(
                 "usage: --elastic --log-dir DIR | --telemetry-dir DIR")
-        raise SystemExit(elastic_report(log_dir=ldir,
-                                        telemetry_dir=tdir))
+        raise SystemExit(elastic_report(
+            log_dir=kv.get("--log-dir"),
+            telemetry_dir=kv.get("--telemetry-dir")))
     if "--stragglers" in args:
-        tdir, window, xdir = None, 32, None
-        rest = [a for a in args if a != "--stragglers"]
-        i = 0
-        while i < len(rest):
-            a = rest[i]
-            if "=" in a:
-                flag, val = a.split("=", 1)
-            else:
-                flag = a
-                val = rest[i + 1] if i + 1 < len(rest) else ""
-                if not val or val.startswith("--"):
-                    raise SystemExit("flag %s needs a value" % flag)
-                i += 1
-            if flag == "--telemetry-dir":
-                tdir = val
-            elif flag == "--window":
-                window = int(val)
-            elif flag == "--xplane-dir":
-                xdir = val
-            else:
-                raise SystemExit("unknown --stragglers argument: %s"
-                                 % flag)
-            i += 1
-        if not tdir:
+        kv = _parse_mode_flags(
+            "--stragglers", [a for a in args if a != "--stragglers"],
+            {"--telemetry-dir": str, "--window": int,
+             "--xplane-dir": str})
+        if not kv.get("--telemetry-dir"):
             raise SystemExit(
                 "usage: --stragglers --telemetry-dir DIR [--window N] "
                 "[--xplane-dir DIR]")
-        rc = stragglers(tdir, window=window)
-        if xdir:
+        rc = stragglers(kv["--telemetry-dir"],
+                        window=kv.get("--window", 32))
+        if kv.get("--xplane-dir"):
             # per-layer / per-bucket device-time blame from a capture
             # window's trace, one level below the phase verdict
-            xplane_blame(xdir)
+            xplane_blame(kv["--xplane-dir"])
         raise SystemExit(rc)
     if "--lint" in args:
         # alias into the tpu-lint static verifier; tools/ is not a

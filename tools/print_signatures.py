@@ -55,6 +55,11 @@ _MODULES = [
     # by the lowering, Executor.attribution_report, bench.py's
     # "attribution" block and perf_analysis --attribution — lock them
     "paddle_tpu.observability.attribution",
+    # runtime hang watchdog: the in-flight collective trace, the
+    # watchdog thread and the desync analyzer are relied on by the
+    # host-collective/RPC tiers, the launch supervisor's hang
+    # escalation and perf_analysis --hang-report — lock them
+    "paddle_tpu.observability.watchdog",
     # AMP: decorate()/master-weight rewrites are the bench's and the
     # perf-analysis tooling's entry into mixed precision — lock them
     "paddle_tpu.fluid.contrib.mixed_precision",

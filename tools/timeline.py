@@ -123,9 +123,15 @@ def clock_offsets(by_rank):
     delta is robust to the odd slow release. Ranks sharing no keys
     with the reference get offset 0."""
     def anchors(recs):
+        # broadcast is excluded: the store hands the root its value
+        # back immediately and each non-root whenever IT arrives, so
+        # bcast completion instants differ by real execution lag, not
+        # clock skew — only gather-released collectives (the store
+        # releases every rank at the LAST arrival) anchor the merge
         return {r["key"]: float(r["ts"]) for r in recs
                 if r.get("kind") == "event"
-                and r.get("event") == "collective" and r.get("key")}
+                and r.get("event") == "collective" and r.get("key")
+                and r.get("op") != "broadcast"}
 
     if not by_rank:
         return {}
@@ -180,8 +186,21 @@ def telemetry_lane_events(records, offset_s=0.0):
                     break
             args = {k: v for k, v in rec.items()
                     if k not in ("kind", "ts")}
+            stalled_s = rec.get("stalled_s")
             dur_ms = rec.get("dur_ms")
-            if isinstance(dur_ms, (int, float)) and dur_ms > 0:
+            if rec.get("event") == "hang" and \
+                    isinstance(stalled_s, (int, float)) \
+                    and stalled_s > 0:
+                # the watchdog fires AT detection time, after the
+                # collective sat stalled for stalled_s: render the
+                # whole wedged window as a span ending at the event,
+                # so the stall lines up under the step/collective
+                # lanes it blocked
+                evs.append({"name": name, "ph": "X", "pid": 0,
+                            "tid": 1, "ts": ts_us - stalled_s * 1e6,
+                            "dur": stalled_s * 1e6, "cat": "hang",
+                            "args": args})
+            elif isinstance(dur_ms, (int, float)) and dur_ms > 0:
                 # the recorded ts is the COMPLETION instant
                 evs.append({"name": name, "ph": "X", "pid": 0,
                             "tid": 1, "ts": ts_us - dur_ms * 1e3,
@@ -191,6 +210,35 @@ def telemetry_lane_events(records, offset_s=0.0):
                 evs.append({"name": name, "ph": "i", "pid": 0,
                             "tid": 1, "ts": ts_us, "s": "t",
                             "cat": "telemetry", "args": args})
+    evs.extend(heartbeat_gap_events(records, offset_s))
+    return evs
+
+
+def heartbeat_gap_events(records, offset_s=0.0, factor=3.0):
+    """Synthesized "heartbeat-gap" chrome-trace spans: the watchdog's
+    `heartbeat` events tick on a fixed cadence, so a gap well past the
+    nominal interval (> `factor` x the median delta) is a window where
+    the PROCESS itself stopped running — GC storm, swap, SIGSTOP, a
+    wedged interpreter — rendered as a span covering exactly the
+    silent stretch. Needs >= 3 beats to estimate the cadence."""
+    beats = sorted(float(r.get("ts", 0.0)) for r in records
+                   if r.get("kind") == "event"
+                   and r.get("event") == "heartbeat")
+    if len(beats) < 3:
+        return []
+    deltas = sorted(b - a for a, b in zip(beats, beats[1:]))
+    nominal = deltas[len(deltas) // 2]
+    if nominal <= 0:
+        return []
+    evs = []
+    for a, b in zip(beats, beats[1:]):
+        if b - a > factor * nominal:
+            evs.append({
+                "name": "heartbeat-gap", "ph": "X", "pid": 0,
+                "tid": 1, "ts": (a + offset_s) * 1e6,
+                "dur": (b - a) * 1e6, "cat": "hang",
+                "args": {"gap_s": round(b - a, 3),
+                         "nominal_s": round(nominal, 3)}})
     return evs
 
 
