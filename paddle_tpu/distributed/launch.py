@@ -171,7 +171,8 @@ def _pod_shrink(endpoints, failed_tids, npods):
 
 
 def _worker_env(endpoints, tid, restart_no, base_env=None,
-                telemetry_dir=None, npods=1, hang_timeout_s=0.0):
+                telemetry_dir=None, npods=1, hang_timeout_s=0.0,
+                compile_cache_dir=None):
     """The PADDLE_* contract for one supervised worker. Cross-rank
     checkpoint-step agreement (PADDLE_CKPT_AGREE, see
     distributed/sharded_checkpoint.agree_newest_intact) is ON by
@@ -189,6 +190,12 @@ def _worker_env(endpoints, tid, restart_no, base_env=None,
     env.setdefault("PADDLE_CKPT_AGREE", "1")
     if telemetry_dir:
         env.setdefault("FLAGS_tpu_telemetry_dir", telemetry_dir)
+    if compile_cache_dir:
+        # persistent compilation cache shared across the cohort AND
+        # across restarts/elastic transitions: a relaunched worker
+        # deserializes its XLA executables instead of recompiling, so
+        # recovery is coordination-bound, not compile-bound
+        env.setdefault("FLAGS_tpu_compile_cache_dir", compile_cache_dir)
     if hang_timeout_s and hang_timeout_s > 0:
         # one knob arms both tiers: the workers' in-process watchdogs
         # (stack + in-flight dumps, `hang`/`heartbeat` events) and the
@@ -229,6 +236,21 @@ def _telemetry_dir_for(args):
         return explicit
     if args.log_dir:
         return os.path.join(args.log_dir, "telemetry")
+    return None
+
+
+def _compile_cache_dir_for(args):
+    """Where the workers' persistent compilation cache lives: an
+    explicit FLAGS_tpu_compile_cache_dir in the launcher env wins;
+    otherwise <log_dir>/compile_cache; None without either (workers
+    then run with the persistent tier off). NOT collected into
+    postmortem/ between attempts — surviving restarts is its entire
+    point."""
+    explicit = os.environ.get("FLAGS_tpu_compile_cache_dir")
+    if explicit:
+        return explicit
+    if args.log_dir:
+        return os.path.join(args.log_dir, "compile_cache")
     return None
 
 
@@ -349,6 +371,103 @@ def _supervisor_event(args, etype, **fields):
     except OSError:
         return None
     return rec
+
+
+class _TransitionWatch:
+    """Defers one elastic_transition event until the respawned cohort's
+    FIRST step records land in the workers' telemetry streams, so
+    `recovery_s` splits into its two real components:
+
+      coordination_s  failure detection -> shrunk cohort respawned
+                      (the supervisor's own work: teardown, rank
+                      reassignment, rendezvous env rebuild)
+      compile_s       the new cohort's first-step compile (max over
+                      ranks of the first step record's compile_ms) —
+                      the part the persistent compilation cache
+                      (FLAGS_tpu_compile_cache_dir) collapses from
+                      minutes to ~0
+
+    recovery_s = coordination_s + compile_s. Workers that emit no
+    telemetry (plain scripts) leave compile_s absent and recovery_s =
+    coordination_s — exactly the event shape shipped before the split.
+    The event is emitted ONCE: when every rank's first step arrived,
+    or at flush() (cohort exit / next failure / supervisor teardown),
+    whichever comes first."""
+
+    def __init__(self, telemetry_dir, fields, world, emit,
+                 poll_every_s=0.25):
+        self.dir = telemetry_dir
+        self.fields = dict(fields)
+        self.world = int(world)
+        self._emit = emit
+        self._poll_every = float(poll_every_s)
+        self._last_poll = 0.0
+        self._offsets = {}
+        self._first_compile_ms = {}  # rank -> first step's compile_ms
+        self.done = False
+        if not telemetry_dir:
+            self.flush()
+
+    def poll(self):
+        if self.done:
+            return
+        now = time.monotonic()
+        if now - self._last_poll < self._poll_every:
+            return
+        self._last_poll = now
+        import json
+
+        try:
+            fnames = [f for f in sorted(os.listdir(self.dir))
+                      if f.startswith("telemetry.rank")
+                      and f.endswith(".jsonl")]
+        except OSError:
+            return
+        for fname in fnames:
+            path = os.path.join(self.dir, fname)
+            off = self._offsets.get(fname, 0)
+            try:
+                size = os.path.getsize(path)
+                if size <= off:
+                    continue
+                with open(path) as f:
+                    f.seek(off)
+                    chunk = f.read(size - off)
+            except OSError:
+                continue
+            consumed = chunk.rfind("\n") + 1
+            self._offsets[fname] = off + consumed
+            for line in chunk[:consumed].splitlines():
+                if '"kind": "step"' not in line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                rank = int(rec.get("rank", -1))
+                if rank in self._first_compile_ms:
+                    continue
+                self._first_compile_ms[rank] = float(
+                    rec.get("compile_ms", 0.0))
+        if len(self._first_compile_ms) >= self.world:
+            self.flush()
+
+    def flush(self):
+        """Emit with whatever arrived (all exit paths call this —
+        the seam event must never be lost to a fast-exiting or
+        telemetry-less cohort)."""
+        if self.done:
+            return
+        self.done = True
+        fields = dict(self.fields)
+        coord = float(fields.get("coordination_s", 0.0))
+        if self._first_compile_ms:
+            fields["compile_s"] = round(
+                max(self._first_compile_ms.values()) / 1e3, 4)
+            fields["recovery_s"] = round(coord + fields["compile_s"], 4)
+        else:
+            fields["recovery_s"] = round(coord, 4)
+        self._emit(fields)
 
 
 class _HangWatch:
@@ -497,10 +616,14 @@ def _spawn_cohort(args, endpoints, local_ids, restart_no, npods=1):
     tdir = _telemetry_dir_for(args)
     if tdir:
         os.makedirs(tdir, exist_ok=True)
+    ccdir = _compile_cache_dir_for(args)
+    if ccdir:
+        os.makedirs(ccdir, exist_ok=True)
     for tid in local_ids:
         env = _worker_env(endpoints, tid, restart_no,
                           telemetry_dir=tdir, npods=npods,
-                          hang_timeout_s=_hang_timeout_for(args))
+                          hang_timeout_s=_hang_timeout_for(args),
+                          compile_cache_dir=ccdir)
         cmd = [sys.executable, "-u", args.training_script] \
             + args.training_script_args
         out = None
@@ -542,7 +665,8 @@ def _terminate_all(procs, grace_s=10.0):
 HANG_RC = 124
 
 
-def _supervise(procs, local_ids, stop_sig, hang_watch=None):
+def _supervise(procs, local_ids, stop_sig, hang_watch=None,
+               trans_watch=None):
     """Poll until all workers exit or one fails. Returns (rc,
     failed_tids, hang): rc is the first non-zero return code (lowest
     trainer id among the failures seen in the poll cycle that detected
@@ -554,6 +678,10 @@ def _supervise(procs, local_ids, stop_sig, hang_watch=None):
     HANG_RC there; the guilty rank comes from the desync verdict over
     the collected dumps, not from this loop)."""
     while True:
+        if trans_watch is not None and not trans_watch.done:
+            # the pending elastic_transition is waiting for the new
+            # cohort's first step records (its compile_s half)
+            trans_watch.poll()
         if stop_sig["sig"] is not None:
             _terminate_all(procs)
             return 128 + stop_sig["sig"], [], None
@@ -661,23 +789,40 @@ def launch(argv=None):
             if _owns_whole_cohort(args, endpoints) else [host_id]
         procs, logs = _spawn_cohort(args, endpoints, local_ids, attempt,
                                     npods=npods)
+        tdir = _telemetry_dir_for(args)
+        trans_watch = None
         if pending_evt is not None:
-            # recovery wall time = failure detection -> shrunk cohort
-            # respawned (the workers' own restore/re-compile time shows
-            # up in their step records, stitched by the seam event)
-            pending_evt["recovery_s"] = round(
+            # coordination wall time = failure detection -> shrunk
+            # cohort respawned. The event itself is DEFERRED until the
+            # new cohort's first step records land, so it can report
+            # compile_s (the recompile the persistent compilation
+            # cache is supposed to collapse) separately — see
+            # _TransitionWatch; a telemetry-less cohort emits
+            # immediately with coordination time only
+            pending_evt["coordination_s"] = round(
                 time.monotonic() - t_fail, 4)
-            _supervisor_event(args, "elastic_transition", **pending_evt)
+            trans_watch = _TransitionWatch(
+                tdir, pending_evt, len(endpoints),
+                emit=lambda fields: _supervisor_event(
+                    args, "elastic_transition", **fields))
             pending_evt = None
         live_procs[:] = procs
-        tdir = _telemetry_dir_for(args)
         hang_timeout = _hang_timeout_for(args)
         hang_watch = (_HangWatch(tdir, hang_timeout)
                       if hang_timeout > 0 and tdir else None)
         try:
             rc, failed_tids, hang = _supervise(procs, local_ids,
-                                               stop_sig, hang_watch)
+                                               stop_sig, hang_watch,
+                                               trans_watch)
         finally:
+            if trans_watch is not None and not trans_watch.done:
+                # cohort ended (clean exit, failure, or signal) before
+                # every rank's first step arrived: tail once more, then
+                # emit with what there is — the seam event must land
+                # before the telemetry files move to postmortem/
+                trans_watch._last_poll = 0.0
+                trans_watch.poll()
+                trans_watch.flush()
             for f in logs:
                 if f:
                     f.close()

@@ -267,53 +267,10 @@ class Executor:
                     for n, a in feed_arrays.items()}
         if entry is None:
             _t = _time.perf_counter()
-            # tpu-lint, pre-compile leg (FLAGS_tpu_static_checks): the
-            # IR-only checkers need nothing from XLA, so in error mode
-            # a known-bad program is rejected BEFORE paying the
-            # (potentially tens of seconds) compile below
-            self._static_checks(program, feed_arrays, fetch_names,
-                                checkers=self._PRE_COMPILE_CHECKERS)
-            state_in, _ = lowering.analyze_block(
-                block, list(feed_arrays), fetch_names)
-            state_specs = {}
-            for n in state_in:
-                v = scope.find_var(n)
-                if v is not None:
-                    state_specs[n] = v
-            entry = lowering.compile_block(
-                program, block, feed_arrays, fetch_names, state_specs)
+            entry = self._compile_and_cache(program, block, feed_arrays,
+                                            fetch_names, scope, key,
+                                            use_program_cache)
             fresh_compile = True
-            from ..utils.flags import get_flag
-
-            if get_flag("FLAGS_enable_unused_var_check"):
-                # reference: framework/unused_var_check.cc (op inputs
-                # declared but never read); block-level equivalent here
-                import warnings
-
-                used = set()
-                for op in block.ops:
-                    used.update(lowering._op_reads_writes(op)[0])
-                unused = [n for n in feed_arrays if n not in used]
-                if unused:
-                    warnings.warn(
-                        "feed variables never read by the program: %s"
-                        % unused)
-            # tpu-lint, post-compile leg: zero1-invariants and
-            # zero2-lifetimes verify the ShardedUpdatePlan that
-            # compile_block just attached (program._shard_plan), so
-            # they cannot run in the fail-fast leg above. MUST run
-            # before the entry is cached: in error mode a caught-and-
-            # retried run would otherwise cache-hit past the check and
-            # dispatch the known-bad program
-            self._static_checks(program, feed_arrays, fetch_names,
-                                checkers=("zero1-invariants",
-                                          "zero2-lifetimes"))
-            if use_program_cache:
-                self._cache[key] = entry
-                limit = int(get_flag("FLAGS_tpu_compile_cache_size", 128)
-                            or 128)
-                while len(self._cache) > limit:
-                    self._cache.popitem(last=False)
             _mark("compile", _t)
 
         states_mut = {n: scope.find_var(n) for n in entry.state_mut_names}
@@ -347,10 +304,26 @@ class Executor:
             except Exception:
                 self._cache.pop(key, None)
                 raise
+        if fresh_compile:
+            # persistent compile-cache tier
+            # (FLAGS_tpu_compile_cache_dir): fingerprint the lowered
+            # StableHLO at the exact avals the dispatch below will use
+            # and look up the cross-process index — the lowering also
+            # warms jax's trace cache, so the first dispatch re-pays
+            # (at most) the backend compile the persistent tier
+            # eliminates. No-op when the tier is off.
+            _t = _time.perf_counter()
+            self._cc_classify(entry, feed_arrays, states_mut, states_ro)
+            _mark("compile", _t)
         seed = framework._global_seed_and_bump(program)
         _t = _time.perf_counter()
         feeds_dev = self._shard_feeds(entry, feed_arrays)
         _mark("feed", _t)
+        cc_snap = None
+        if fresh_compile and entry.cc_fingerprint is not None:
+            from . import compile_cache as _cc
+
+            cc_snap = (_cc.jax_stats(), _time.time())
         _t = _time.perf_counter()
         try:
             fetches, new_states = entry.jitted(feeds_dev, states_mut,
@@ -371,6 +344,15 @@ class Executor:
                     + list(entry.state_ro_names), scope, e)
             raise
         _mark("dispatch", _t)
+        if cc_snap is not None:
+            # hit/miss verdict + compile_cache event; the measured
+            # backend-compile seconds move from the dispatch phase into
+            # compile_ms, so a warm restart's first step shows
+            # compile_ms ~ 0 where a cold one shows the full XLA cost
+            self._cc_finish(entry, ph, cc_snap)
+        if fresh_compile:
+            self._maybe_elastic_warmup(program, entry, feed_arrays,
+                                       fetch_names, scope)
         for n, v in new_states.items():
             scope.set_var(n, v)
         if ecfg is not None:
@@ -467,6 +449,471 @@ class Executor:
                     len(errors), "\n".join(
                         "  " + analysis.format_finding(f)
                         for f in errors)))
+
+    def _compile_and_cache(self, program, block, feed_arrays,
+                           fetch_names, scope, key, use_program_cache):
+        """The fresh-compile path shared by run() and warmup():
+        pre-compile static checks -> compile_block -> post-compile
+        checks -> LRU insert. Evicted entries drop their AOT-compiled
+        artifacts EAGERLY (a dead in-memory entry must not pin
+        compiled XLA executables in host RAM); the persistent tier
+        (FLAGS_tpu_compile_cache_dir) survives eviction, so a
+        re-admitted program is a persistent-cache hit, not a fresh
+        compile."""
+        from . import compile_cache as _cc
+
+        _cc.ensure()
+        # tpu-lint, pre-compile leg (FLAGS_tpu_static_checks): the
+        # IR-only checkers need nothing from XLA, so in error mode
+        # a known-bad program is rejected BEFORE paying the
+        # (potentially tens of seconds) compile below
+        self._static_checks(program, feed_arrays, fetch_names,
+                            checkers=self._PRE_COMPILE_CHECKERS)
+        state_in, _ = lowering.analyze_block(
+            block, list(feed_arrays), fetch_names)
+        state_specs = {}
+        for n in state_in:
+            v = scope.find_var(n)
+            if v is not None:
+                state_specs[n] = v
+        entry = lowering.compile_block(
+            program, block, feed_arrays, fetch_names, state_specs)
+        from ..utils.flags import get_flag
+
+        if get_flag("FLAGS_enable_unused_var_check"):
+            # reference: framework/unused_var_check.cc (op inputs
+            # declared but never read); block-level equivalent here
+            import warnings
+
+            used = set()
+            for op in block.ops:
+                used.update(lowering._op_reads_writes(op)[0])
+            unused = [n for n in feed_arrays if n not in used]
+            if unused:
+                warnings.warn(
+                    "feed variables never read by the program: %s"
+                    % unused)
+        # tpu-lint, post-compile leg: zero1-invariants and
+        # zero2-lifetimes verify the ShardedUpdatePlan that
+        # compile_block just attached (program._shard_plan), so
+        # they cannot run in the fail-fast leg above. MUST run
+        # before the entry is cached: in error mode a caught-and-
+        # retried run would otherwise cache-hit past the check and
+        # dispatch the known-bad program
+        self._static_checks(program, feed_arrays, fetch_names,
+                            checkers=("zero1-invariants",
+                                      "zero2-lifetimes"))
+        if use_program_cache:
+            self._cache[key] = entry
+            limit = int(get_flag("FLAGS_tpu_compile_cache_size", 128)
+                        or 128)
+            while len(self._cache) > limit:
+                _, evicted = self._cache.popitem(last=False)
+                evicted.aot_compiled = None
+        return entry
+
+    # -- persistent compile cache (fluid/compile_cache) -----------------
+    def _cc_classify(self, entry, feed_arrays, states_mut, states_ro):
+        """Persistent-tier classification of a fresh compile: lower
+        the entry at the avals the dispatch will use, fingerprint the
+        canonicalized StableHLO + mesh topology + lowering-relevant
+        flags + jax version, and look up the cross-process index.
+        Leaves cc_fingerprint None (classification off) when the tier
+        is disabled or the entry is not jit-lowered."""
+        from . import compile_cache as _cc
+
+        if not _cc.enabled() or not hasattr(entry.jitted, "lower"):
+            return
+        try:
+            favals = {n: self._aval_of(a)
+                      for n, a in feed_arrays.items()}
+            smut = {n: self._aval_of(v)
+                    for n, v in states_mut.items()}
+            sro = {n: self._aval_of(v)
+                   for n, v in states_ro.items()}
+            lowered = self._lower_entry(entry, favals, smut, sro)
+            fp = _cc.fingerprint(lowered.as_text(), entry.mesh)
+            entry.cc_fingerprint = fp
+            entry.cc_prev = _cc.index_lookup(fp)
+        except Exception:  # noqa: BLE001 - classification is telemetry
+            entry.cc_fingerprint = None
+
+    def _cc_finish(self, entry, ph, cc_snap, source="step"):
+        """Close out a classified fresh compile after its first
+        dispatch: re-attribute the measured backend-compile seconds
+        from the dispatch phase into compile_ms, decide hit/miss, emit
+        the `compile_cache` telemetry event, and write the index
+        sentinel the next process's classification reads."""
+        from . import compile_cache as _cc
+
+        before, t0 = cc_snap
+        d = _cc.stats_delta(before)
+        comp_s = max(0.0, d["backend_compile_s"])
+        if ph is not None and comp_s > 0.0 and ph["dispatch"] > 0.0:
+            moved = min(comp_s, ph["dispatch"])
+            # keep dispatch strictly positive: a zeroed dispatch would
+            # drop the whole step from the phase summary
+            ph["dispatch"] = max(ph["dispatch"] - moved, 1e-9)
+            ph["compile"] += moved
+        prev = entry.cc_prev
+        hit = prev is not None or d["persistent_hits"] > 0
+        saved_ms = max(0.0, d["saved_s"] * 1e3)
+        nbytes = 0
+        if prev is not None:
+            saved_ms = max(saved_ms,
+                           float(prev.get("compile_ms", 0.0))
+                           - comp_s * 1e3)
+            nbytes = int(prev.get("bytes", 0))
+        elif not hit:
+            nbytes = _cc.new_entry_bytes(t0)
+        _cc.record_event("hit" if hit else "miss",
+                         entry.cc_fingerprint,
+                         compile_ms=comp_s * 1e3, saved_ms=saved_ms,
+                         nbytes=nbytes, source=source)
+        if prev is None and entry.cc_fingerprint:
+            _cc.index_store(entry.cc_fingerprint,
+                            {"compile_ms": round(comp_s * 1e3, 3),
+                             "bytes": nbytes,
+                             "mesh": _cc.mesh_signature(entry.mesh)})
+
+    # -- AOT warmup (pre-compile before traffic / before failure) --------
+    def warmup(self, program=None, shapes=None, meshes=None,
+               fetch_list=None, scope=None, background=False):
+        """Pre-compile this program BEFORE traffic or a failure pays
+        the cost (ROADMAP direction 4; see paddle_tpu/parallel/README
+        "Compilation cache & warmup"). For every feed-shape bucket in
+        `shapes` (a list of dicts: feed name -> concrete shape tuple,
+        example array, or jax.ShapeDtypeStruct) the program is
+        compiled and ONE discarded step executes on state COPIES — so
+        both jax's in-process executable cache and the persistent tier
+        (FLAGS_tpu_compile_cache_dir) are warm, and the first real
+        step of that shape dispatches with compile_ms ~ 0 — without
+        mutating any scope state or the program's RNG stream.
+
+        `meshes` additionally pre-populates the persistent tier for
+        OTHER mesh topologies: "elastic" enumerates the likely N'
+        shrink variants (parallel.env.elastic_mesh_variants), or pass
+        explicit Mesh objects / device counts. Variant compiles run
+        against a CLONE of the program and never touch the live
+        program or the in-memory entry cache.
+
+        background=True runs the whole warmup in a daemon thread (the
+        elastic-variant recipe: schedule after the first step) and
+        returns the Thread; its `.warmup_report` lands on completion.
+        Foreground calls return the report dict: {"compiled": [...],
+        "cached": [...], "skipped": [...]}."""
+        from . import compiler
+
+        program = program or framework.default_main_program()
+        if isinstance(program, compiler.CompiledProgram):
+            program = program._unwrap()
+        scope = scope or global_scope()
+        fetch_names = [
+            f.name if isinstance(f, framework.Variable) else str(f)
+            for f in (fetch_list or [])]
+        if background:
+            import threading
+
+            def _bg():
+                t.warmup_report = self._warmup_impl(
+                    program, shapes, meshes, fetch_names, scope,
+                    in_background=True)
+
+            t = threading.Thread(target=_bg, daemon=True,
+                                 name="paddle-tpu-warmup")
+            t.warmup_report = None
+            t.start()
+            return t
+        return self._warmup_impl(program, shapes, meshes, fetch_names,
+                                 scope)
+
+    def _warmup_impl(self, program, shapes, meshes, fetch_names, scope,
+                     in_background=False, skip_base=False):
+        from . import compile_cache as _cc
+
+        _cc.ensure()
+        report = {"compiled": [], "cached": [], "skipped": []}
+        buckets = []
+        for s in (shapes or []):
+            try:
+                buckets.append(self._warmup_feed_arrays(
+                    program.global_block(), s))
+            except Exception as e:  # noqa: BLE001 - best-effort API
+                report["skipped"].append(
+                    {"shapes": {k: repr(v) for k, v in s.items()},
+                     "error": "%s: %s" % (type(e).__name__, e)})
+        if not skip_base:
+            for feed_arrays in buckets:
+                # background warmup must not mutate the in-memory LRU
+                # under the stepping main thread — persistent-tier
+                # population only there
+                self._warmup_one(program, feed_arrays, fetch_names,
+                                 scope, report,
+                                 use_cache=not in_background)
+        if meshes is None:
+            return report
+        if not buckets:
+            # no explicit shapes: reuse the feed buckets of this
+            # program's already-compiled in-memory entries (the shapes
+            # real traffic ran), so the runbook's post-first-step
+            # `exe.warmup(meshes="elastic")` pre-populates the N'
+            # variants without restating the batch geometry
+            buckets = self._buckets_from_cache(program)
+        if not buckets:
+            report["skipped"].append(
+                {"reason": "mesh variants need `shapes` (or a prior "
+                           "run of this program to borrow them from)"})
+            return report
+        for ndev, mesh in self._warmup_meshes(program, meshes):
+            if mesh is None:
+                report["skipped"].append(
+                    {"mesh_devices": ndev,
+                     "reason": "exceeds the local device count"})
+                continue
+            clone = self._mesh_variant_program(program, mesh)
+            if clone is None:
+                report["skipped"].append(
+                    {"mesh": _cc.mesh_signature(mesh),
+                     "reason": "program not cloneable"})
+                continue
+            total = int(np.prod([mesh.shape[a]
+                                 for a in mesh.axis_names]))
+            for feed_arrays in buckets:
+                bad = [n for n, a in feed_arrays.items()
+                       if getattr(a, "ndim", 0) >= 1
+                       and a.shape[0] % total]
+                if bad:
+                    report["skipped"].append({
+                        "mesh_devices": ndev, "feeds": sorted(bad),
+                        "reason": "batch not divisible by %d devices"
+                                  % total})
+                    continue
+                self._warmup_one(clone, feed_arrays, fetch_names,
+                                 scope, report, use_cache=False,
+                                 variant=ndev)
+        return report
+
+    def _warmup_one(self, program, feed_arrays, fetch_names, scope,
+                    report, use_cache=True, variant=None):
+        import jax
+
+        from . import compile_cache as _cc
+
+        desc = {"feed_shapes": {n: tuple(a.shape)
+                                for n, a in sorted(
+                                    feed_arrays.items())}}
+        if variant is not None:
+            desc["mesh_devices"] = variant
+        try:
+            key = self._cache_key(program, feed_arrays, fetch_names,
+                                  scope)
+            if use_cache:
+                entry = self._cache.get(key)
+                if entry is not None:
+                    self._cache.move_to_end(key)
+                    report["cached"].append(desc)
+                    return entry
+            t0 = _time.perf_counter()
+            entry = self._compile_and_cache(
+                program, program.global_block(), feed_arrays,
+                fetch_names, scope, key, use_cache)
+            if not hasattr(entry.jitted, "lower"):
+                desc["reason"] = "not jit-compiled (host/dynamic ops)"
+                report["skipped"].append(desc)
+                return entry
+            # one DISCARDED step on state copies: lands the executable
+            # in jax's in-process cache AND the persistent tier without
+            # touching scope state or the program's RNG stream (the
+            # jitted step donates its state args — hence the copies)
+            # variant meshes get HOST copies: live state committed to
+            # the full mesh cannot feed a jit over a different device
+            # set ("incompatible devices"), while host arrays place
+            # implicitly onto whatever mesh the variant uses
+            host = variant is not None
+            states_mut = {n: self._copy_state(scope.find_var(n),
+                                              host=host)
+                          for n in entry.state_mut_names}
+            states_ro = ({n: self._copy_state(scope.find_var(n),
+                                              host=True)
+                          for n in entry.state_ro_names}
+                         if host else
+                         {n: scope.find_var(n)
+                          for n in entry.state_ro_names})
+            if entry.sharded_state:
+                from ..parallel import sharded_update as _su
+
+                for n, info in entry.sharded_state.items():
+                    v = states_mut.get(n)
+                    if v is not None and tuple(
+                            getattr(v, "shape", ())) != (info.padded,):
+                        states_mut[n] = _su.to_sharded_global(
+                            v, info, entry.mesh, entry.dp_axis)
+            # same gate invariant as run(): a warmup-cached entry must
+            # not let the first real run cache-hit past the HBM
+            # pre-flight (FLAGS_tpu_hbm_budget_mb; no-op when unset) —
+            # an over-budget bucket is evicted and reported skipped
+            try:
+                self._hbm_preflight(program, entry, feed_arrays,
+                                    states_mut, states_ro, scope)
+            except Exception:
+                if use_cache:
+                    self._cache.pop(key, None)
+                raise
+            self._cc_classify(entry, feed_arrays, states_mut,
+                              states_ro)
+            cc_snap = (_cc.jax_stats(), _time.time())
+            feeds_dev = self._shard_feeds(entry, feed_arrays)
+            out = entry.jitted(feeds_dev, states_mut, states_ro,
+                               np.uint32(0))
+            jax.block_until_ready(out)
+            del out, states_mut
+            if entry.cc_fingerprint is not None:
+                self._cc_finish(entry, None, cc_snap, source="warmup")
+            desc["warmup_ms"] = round(
+                (_time.perf_counter() - t0) * 1e3, 3)
+            report["compiled"].append(desc)
+            return entry
+        except Exception as e:  # noqa: BLE001 - warmup is best-effort
+            desc["error"] = "%s: %s" % (type(e).__name__, e)
+            report["skipped"].append(desc)
+            return None
+
+    def _warmup_feed_arrays(self, block, spec):
+        """A zero-filled feed dict from one warmup bucket spec: values
+        are concrete shape tuples (dtype from the program var),
+        example arrays, or ShapeDtypeStructs."""
+        out = {}
+        for name, v in spec.items():
+            if hasattr(v, "shape") and hasattr(v, "dtype"):
+                out[name] = np.zeros(tuple(v.shape), np.dtype(v.dtype))
+                continue
+            shape = tuple(int(d) for d in v)
+            if any(d < 0 for d in shape):
+                raise ValueError(
+                    "warmup shapes must be concrete (got %r for %r) — "
+                    "pass the real bucket batch, not -1"
+                    % (shape, name))
+            var = block._find_var_recursive(name)
+            dtype = np.dtype(to_numpy_dtype(var.dtype)) \
+                if var is not None else np.dtype("float32")
+            out[name] = np.zeros(shape, dtype)
+        return out
+
+    def _buckets_from_cache(self, program):
+        """Zero-filled feed dicts rebuilt from this program's cached
+        in-memory entries' feed keys — the shapes real traffic already
+        ran (mesh-variant warmup borrows them when the caller passes
+        no explicit `shapes`)."""
+        buckets = []
+        seen = set()
+        for k in self._cache:
+            if k[0] != program._uid or k[2] in seen:
+                continue
+            seen.add(k[2])
+            buckets.append({n: np.zeros(tuple(shape), np.dtype(dt))
+                            for n, shape, dt in k[2]})
+        return buckets
+
+    @staticmethod
+    def _warmup_meshes(program, meshes):
+        """[(ndev, Mesh)] to pre-populate: "elastic" enumerates likely
+        shrink variants from the program's current mesh; explicit Mesh
+        objects and integer device counts pass through. An integer
+        exceeding the local device count yields (n, None) so the
+        caller reports it skipped instead of silently dropping it."""
+        from ..parallel import env as penv
+
+        if isinstance(meshes, str):
+            if meshes != "elastic":
+                raise ValueError("meshes: Mesh list, int list, or "
+                                 "'elastic' (got %r)" % (meshes,))
+            return penv.elastic_mesh_variants(
+                getattr(program, "_mesh", None))
+        out = []
+        for m in meshes:
+            if isinstance(m, int):
+                out.append((m, penv.mesh_for_world(
+                    m, dp_axis=getattr(program, "_dp_axis", "dp"))))
+            else:
+                out.append((int(np.prod([m.shape[a]
+                                         for a in m.axis_names])), m))
+        return out
+
+    @staticmethod
+    def _mesh_variant_program(program, mesh):
+        """A clone of `program` pinned to `mesh`, for persistent-tier
+        pre-population of another topology: the clone has its own _uid
+        (separate in-memory key space) and grows its own shard plan;
+        the live program's mesh/plan are never touched."""
+        try:
+            # clone() carries _data_parallel / _dp_axis / AMP marks;
+            # only the mesh is overridden
+            clone = program.clone()
+        except Exception:  # noqa: BLE001 - exotic program front
+            return None
+        clone._mesh = mesh
+        return clone
+
+    @staticmethod
+    def _copy_state(v, host=False):
+        if v is None:
+            return None
+        if is_on_device(v):
+            if host:
+                return np.asarray(Executor._fetch_to_numpy(v))
+            import jax.numpy as jnp
+
+            return jnp.array(v, copy=True)
+        return np.array(v, copy=True)
+
+    def _maybe_elastic_warmup(self, program, entry, feed_arrays,
+                              fetch_names, scope):
+        """FLAGS_tpu_warmup_elastic_variants > 0: after the FIRST step
+        of a data-parallel program, pre-compile the likely elastic N'
+        mesh variants in a background daemon thread, so a future
+        shrink's executables are already in the persistent tier before
+        any rank dies. At most once per program."""
+        from ..utils.flags import get_flag
+
+        from . import compile_cache as _cc
+
+        try:
+            limit = int(get_flag("FLAGS_tpu_warmup_elastic_variants", 0)
+                        or 0)
+        except (TypeError, ValueError):
+            limit = 0
+        if limit <= 0 or not _cc.enabled() or entry.mesh is None \
+                or not getattr(program, "_data_parallel", False) \
+                or not hasattr(entry.jitted, "lower"):
+            return
+        started = getattr(self, "_elastic_warmed", None)
+        if started is None:
+            started = self._elastic_warmed = set()
+        if program._uid in started:
+            return
+        started.add(program._uid)
+        from ..parallel import env as penv
+
+        import jax
+
+        variants = penv.elastic_mesh_variants(entry.mesh, limit=limit)
+        if not variants:
+            return
+        shapes = [{n: jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+                   for n, a in feed_arrays.items()}]
+        import threading
+
+        def _bg():
+            t.warmup_report = self._warmup_impl(
+                program, shapes, [m for _, m in variants],
+                list(fetch_names), scope, in_background=True,
+                skip_base=True)
+
+        t = threading.Thread(target=_bg, daemon=True,
+                             name="paddle-tpu-elastic-warmup")
+        t.warmup_report = None
+        t.start()
+        self._elastic_warmup_thread = t
 
     @staticmethod
     def _fetch_to_numpy(v):

@@ -57,7 +57,7 @@ class LoweredFunction:
                  "state_mut_names", "state_ro_names",
                  "fetch_names", "feed_names", "mesh", "dp_axis",
                  "auto_plan", "feed_donate", "sharded_state",
-                 "aot_compiled")
+                 "aot_compiled", "cc_fingerprint", "cc_prev")
 
     def __init__(self, jitted, feed_names, state_in_names, state_out_names,
                  state_mut_names, state_ro_names, fetch_names, mesh=None,
@@ -82,6 +82,11 @@ class LoweredFunction:
         # (donation_report / overlap_report) — one XLA compile serves
         # every audit of this executable instead of one per call
         self.aot_compiled = None
+        # persistent compile-cache classification (fluid/compile_cache,
+        # FLAGS_tpu_compile_cache_dir): the program fingerprint and the
+        # prior compile's index sentinel (None = first-ever compile)
+        self.cc_fingerprint = None
+        self.cc_prev = None
 
 
 def _sub_block_idxs(op):
@@ -1244,6 +1249,14 @@ def compile_block(program, block, feed_specs, fetch_names, state_specs,
         from ..utils.flags import get_flag
 
         donate = bool(get_flag("FLAGS_tpu_donate_buffers", True))
+        if donate:
+            # persistent compile cache on the CPU backend: deserialized
+            # aliased executables are unsafe (state outputs corrupt
+            # intermittently — see compile_cache.donation_safe) — drop
+            # donation rather than risk silent state corruption
+            from . import compile_cache as _ccache
+
+            donate = _ccache.donation_safe()
     from ..utils.flags import get_flag as _gf
 
     # feed-buffer donation: the executor device_puts a FRESH buffer per

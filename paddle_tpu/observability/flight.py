@@ -73,7 +73,18 @@ class FlightRecorder:
         from .registry import registry
 
         reg = registry()
-        base = reg.telemetry_dir or os.getcwd()
+        base = reg.telemetry_dir
+        if not base:
+            # the registry may predate a later FLAGS_tpu_telemetry_dir
+            # (tests / tools that set flags after import): honor the
+            # LIVE flag before falling back to CWD — a dump belongs in
+            # the telemetry dir whenever one is configured, not
+            # wherever the process happened to be launched (stray
+            # flightrec.rank0.json files polluting the working tree)
+            from ..utils.flags import get_flag
+
+            base = str(get_flag("FLAGS_tpu_telemetry_dir", "") or "")
+        base = base or os.getcwd()
         return os.path.join(base, "flightrec.rank%d.json" % reg.rank)
 
     def dump(self, reason: str, fatal_event: Optional[dict] = None,

@@ -21,6 +21,9 @@ one-line BENCH summary bench.py always printed, and publishes):
     attribution_block(exe, p, f, fl)    "attribution" (per-op HBM
                                         blame + provenance coverage)
     static_checks_block(p)              "static_checks"
+    compile_cache_block()               "compile_cache" (persistent
+                                        compile-cache hit/miss roll-up
+                                        + on-disk tier inventory)
     telemetry_block(group=None)         "telemetry" (registry counters,
                                         straggler report when a
                                         host-collective group is given)
@@ -33,7 +36,8 @@ from .registry import registry
 
 __all__ = ["phases_block", "collectives_blocks", "hierarchy_block",
            "precision_block", "attribution_block",
-           "static_checks_block", "telemetry_block", "bench_blocks"]
+           "static_checks_block", "compile_cache_block",
+           "telemetry_block", "bench_blocks"]
 
 
 def phases_block() -> dict:
@@ -328,6 +332,54 @@ def static_checks_block(program) -> Optional[dict]:
         return None
 
 
+def compile_cache_block() -> Optional[dict]:
+    """Persistent compile-cache evidence (fluid/compile_cache,
+    FLAGS_tpu_compile_cache_dir): the process's hit/miss tally at the
+    framework fingerprint granularity, compile milliseconds paid vs
+    saved, and the on-disk tier inventory. None when the tier is off
+    AND no compile was ever classified — cold-start cost only shows up
+    once there is something to show."""
+    try:
+        from ..fluid import compile_cache as cc
+
+        st = cc.stats()
+    except Exception as e:  # noqa: BLE001 - evidence, not gating
+        print("BENCH compile_cache block failed: %r" % (e,), flush=True)
+        return None
+    if not st["enabled"] and not (st["hits"] or st["misses"]):
+        return None
+    block = {
+        "enabled": st["enabled"],
+        "dir": st["dir"],
+        "hits": st["hits"],
+        "misses": st["misses"],
+        "hit_rate": st["hit_rate"],
+        "warmups": st["warmups"],
+        "compile_ms_total": round(st["compile_ms_total"], 3),
+        "saved_ms_total": round(st["saved_ms_total"], 3),
+        "persistent_entries": st["persistent_entries"],
+        "persistent_bytes": st["persistent_bytes"],
+        "index_entries": st["index_entries"],
+        "jax_backend_compiles": st["jax"]["backend_compiles"],
+        "jax_persistent_hits": st["jax"]["persistent_hits"],
+    }
+    reg = registry()
+    if st["hit_rate"] is not None:
+        reg.set_gauge("compile_cache.hit_rate", st["hit_rate"])
+    reg.set_gauge("compile_cache.persistent_bytes",
+                  st["persistent_bytes"])
+    reg.publish_block("compile_cache", block)
+    print("BENCH compile_cache: %d hit(s) / %d miss(es), %.1fs "
+          "compiled, %.1fs saved, %d entries (%.1f MB) at %s"
+          % (block["hits"], block["misses"],
+             block["compile_ms_total"] / 1e3,
+             block["saved_ms_total"] / 1e3,
+             block["persistent_entries"],
+             block["persistent_bytes"] / 1e6,
+             block["dir"] or "<off>"), flush=True)
+    return block
+
+
 def telemetry_block(group=None) -> dict:
     """Registry roll-up: counters, step count, JSONL sink location —
     and, when a host-collective `group` spans the run's ranks, the
@@ -371,5 +423,6 @@ def bench_blocks(exe, program, feed, fetch_list, group=None) -> dict:
     precision_block(exe, program, feed, fetch_list)
     attribution_block(exe, program, feed, fetch_list)
     static_checks_block(program)
+    compile_cache_block()
     telemetry_block(group=group)
     return reg.blocks()

@@ -232,6 +232,70 @@ def mesh_hierarchy(mesh):
     return (DCN_AXIS, ICI_AXIS, dcn, ici)
 
 
+def mesh_for_world(nranks, dcn=None, dp_axis="dp", devices=None):
+    """A device mesh for a hypothetical world of `nranks` of this
+    process's devices: the hybrid (dcn, ici) factorization when the
+    requested pod count divides it, else a flat 1-D mesh over the
+    first `nranks` devices. None when nranks exceeds the local device
+    count. Used by Executor.warmup(meshes=[...]) to pre-populate the
+    persistent compile cache for other world sizes."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    nranks = int(nranks)
+    if nranks < 1 or nranks > len(devices):
+        return None
+    devs = list(devices[:nranks])
+    if dcn is None:
+        dcn = dcn_replicas()
+    if dcn > 1 and nranks % dcn == 0:
+        return Mesh(np.array(devs).reshape(dcn, nranks // dcn),
+                    (DCN_AXIS, ICI_AXIS))
+    return Mesh(np.array(devs), (dp_axis,))
+
+
+def elastic_mesh_variants(mesh=None, min_ranks=1, limit=4,
+                          devices=None):
+    """The device meshes an elastic shrink would rebuild, most likely
+    first: for a base mesh of N devices, the N' = N-1 .. max(min_ranks,
+    1) variants (at most `limit`). Pod-aware, mirroring the launch
+    supervisor's _pod_shrink policy: a hybrid (dcn, ici) base keeps
+    dcn fixed and shrinks ici while N' stays rectangular (divisible by
+    dcn), else that N' falls back to the flat single-axis world.
+    Returns [(n, Mesh)]; `Executor.warmup(meshes="elastic")` (and the
+    FLAGS_tpu_warmup_elastic_variants background hook) pre-compiles
+    against these so a future shrink's recompile is already in the
+    persistent compile cache before the failure happens."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = (list(mesh.devices.flat) if mesh is not None
+                   else jax.devices())
+    n = len(devices)
+    hier = mesh_hierarchy(mesh)
+    dp_axis = "dp"
+    if mesh is not None and hier is None:
+        names = tuple(getattr(mesh, "axis_names", ()) or ())
+        if len(names) == 1:
+            dp_axis = names[0]
+    out = []
+    for n2 in range(n - 1, max(int(min_ranks), 1) - 1, -1):
+        if len(out) >= int(limit):
+            break
+        devs = np.array(devices[:n2])
+        if hier is not None and n2 % hier[2] == 0:
+            out.append((n2, Mesh(devs.reshape(hier[2], n2 // hier[2]),
+                                 (hier[0], hier[1]))))
+        else:
+            out.append((n2, Mesh(devs, (dp_axis,))))
+    return out
+
+
 # -- launch env contract (reference: distributed/utils.py:356-360) ----------
 
 def trainer_id() -> int:

@@ -95,6 +95,25 @@ _FLAGS: Dict[str, object] = {
     # 9.8ms even at S=2048 fwd); flash's win is O(S) memory at long seq.
     "FLAGS_flash_attention_min_seq": 4096,
     "FLAGS_tpu_compile_cache_size": 128,
+    # Persistent, cross-process compilation cache (fluid/compile_cache):
+    # a directory (conventionally inside the checkpoint/telemetry root;
+    # the launch supervisor exports <log_dir>/compile_cache to every
+    # worker and across restarts) where compiled XLA executables
+    # persist via jax.experimental.compilation_cache, keyed by
+    # (lowered-StableHLO fingerprint, mesh topology, lowering-relevant
+    # FLAGS_tpu_* set, jax/backend version). A restarted (or elastic
+    # N') cohort then resumes in seconds instead of re-paying the full
+    # compile, and every fresh compile lands a `compile_cache`
+    # hit/miss telemetry event. "" (default) disables the persistent
+    # tier entirely — byte-identical behavior to a cache-less build.
+    "FLAGS_tpu_compile_cache_dir": "",
+    # After the first data-parallel step of a program, pre-compile this
+    # many likely elastic N' mesh variants in a background thread
+    # (Executor.warmup machinery over parallel.env.
+    # elastic_mesh_variants) so a future shrink's recompile is already
+    # in the persistent cache before the failure happens. Requires
+    # FLAGS_tpu_compile_cache_dir; 0 (default) = off.
+    "FLAGS_tpu_warmup_elastic_variants": 0,
     # Mixed-precision override for mixed_precision.decorate()'d
     # programs: "" follows the decorate(amp_level=...) argument;
     # "O0" is the kill switch (decorated programs lower exactly like

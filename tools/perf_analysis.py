@@ -693,6 +693,134 @@ def stragglers(telemetry_dir, window=32):
     return 0
 
 
+def compile_cache_report(telemetry_dir=None, log_dir=None,
+                         cache_dir=None):
+    """Compile-cache effectiveness report over a run's telemetry:
+    aggregates the per-compile `compile_cache` events (hit rate,
+    compile seconds actually paid vs compile seconds the persistent
+    tier saved, per-rank breakdown), folds in the supervisor's
+    elastic_transition coordination_s/compile_s split when present,
+    and inventories the on-disk cache. Returns the process exit
+    code."""
+    import json
+
+    from paddle_tpu.observability import aggregate
+
+    if telemetry_dir is None and log_dir:
+        telemetry_dir = os.path.join(log_dir, "telemetry")
+    if cache_dir is None and log_dir:
+        cand = os.path.join(log_dir, "compile_cache")
+        cache_dir = cand if os.path.isdir(cand) else None
+    if not telemetry_dir or not os.path.isdir(telemetry_dir):
+        print("no telemetry dir at %r" % telemetry_dir)
+        return 2
+    by_rank = aggregate.load_telemetry_dir(telemetry_dir)
+    events = []
+    for recs in by_rank.values():
+        events.extend(r for r in recs
+                      if r.get("event") == "compile_cache")
+    # postmortem subdirs hold earlier attempts' streams (the launch
+    # supervisor moves them between restarts) — a warm-restart proof
+    # needs the cold attempt's misses next to the warm attempt's hits
+    pm_root = os.path.join(os.path.dirname(telemetry_dir.rstrip("/")),
+                           "postmortem")
+    if log_dir:
+        pm_root = os.path.join(log_dir, "postmortem")
+    attempts = {}
+    if os.path.isdir(pm_root):
+        for aname in sorted(os.listdir(pm_root)):
+            adir = os.path.join(pm_root, aname)
+            if not (aname.startswith("attempt")
+                    and os.path.isdir(adir)):
+                continue
+            arecs = aggregate.load_telemetry_dir(adir)
+            aevs = [r for recs in arecs.values() for r in recs
+                    if r.get("event") == "compile_cache"]
+            if aevs:
+                attempts[aname] = aevs
+                events.extend(aevs)
+    if not events:
+        print("no compile_cache events under %s (persistent tier off — "
+              "set FLAGS_tpu_compile_cache_dir, or launch with "
+              "--log_dir)" % telemetry_dir)
+        return 1
+    hits = [e for e in events if e.get("status") == "hit"]
+    misses = [e for e in events if e.get("status") == "miss"]
+    paid_s = sum(float(e.get("compile_ms", 0.0)) for e in events) / 1e3
+    saved_s = sum(float(e.get("saved_ms", 0.0)) for e in hits) / 1e3
+    miss_bytes = sum(int(e.get("bytes", 0)) for e in misses)
+    by_rank_tally = {}
+    for e in events:
+        t = by_rank_tally.setdefault(int(e.get("rank", -1)),
+                                     {"hits": 0, "misses": 0})
+        t["hits" if e.get("status") == "hit" else "misses"] += 1
+    print("compile cache: %d hit(s) / %d miss(es) (hit rate %.0f%%), "
+          "%.2fs compile paid, %.2fs compile saved, %.2f MB written "
+          "on misses"
+          % (len(hits), len(misses),
+             100.0 * len(hits) / max(len(events), 1), paid_s, saved_s,
+             miss_bytes / 1e6))
+    for r, t in sorted(by_rank_tally.items()):
+        print("  rank %d: %d hit(s) / %d miss(es)"
+              % (r, t["hits"], t["misses"]))
+    for aname, aevs in sorted(attempts.items()):
+        ah = sum(1 for e in aevs if e.get("status") == "hit")
+        print("  %s: %d hit(s) / %d miss(es)"
+              % (aname, ah, len(aevs) - ah))
+    transitions = []
+    sup = os.path.join(telemetry_dir, "telemetry.supervisor.jsonl")
+    if os.path.exists(sup):
+        with open(sup) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("event") == "elastic_transition":
+                    transitions.append(rec)
+    for t in transitions:
+        print("elastic transition %s -> %s: coordination %.2fs + "
+              "compile %s = recovery %.2fs"
+              % (t.get("old_world"), t.get("new_world"),
+                 float(t.get("coordination_s",
+                             t.get("recovery_s", 0.0))),
+                 ("%.2fs" % t["compile_s"]) if "compile_s" in t
+                 else "<no worker telemetry>",
+                 float(t.get("recovery_s", 0.0))))
+    inventory = None
+    if cache_dir and os.path.isdir(cache_dir):
+        files = [f for f in os.listdir(cache_dir)
+                 if os.path.isfile(os.path.join(cache_dir, f))]
+        inventory = {
+            "dir": cache_dir,
+            "entries": len(files),
+            "bytes": sum(os.path.getsize(os.path.join(cache_dir, f))
+                         for f in files),
+            "index_entries": len(os.listdir(
+                os.path.join(cache_dir, "index")))
+            if os.path.isdir(os.path.join(cache_dir, "index")) else 0,
+        }
+        print("on-disk cache %s: %d entries, %.2f MB, %d index "
+              "sentinel(s)"
+              % (inventory["dir"], inventory["entries"],
+                 inventory["bytes"] / 1e6, inventory["index_entries"]))
+    print(json.dumps({
+        "hits": len(hits), "misses": len(misses),
+        "hit_rate": len(hits) / max(len(events), 1),
+        "compile_paid_s": round(paid_s, 3),
+        "compile_saved_s": round(saved_s, 3),
+        "miss_bytes": miss_bytes,
+        "by_rank": by_rank_tally,
+        "attempts": {a: len(v) for a, v in attempts.items()},
+        "transitions": transitions,
+        "cache": inventory,
+    }, indent=1, sort_keys=True))
+    return 0
+
+
 def hang_report_cli(telemetry_dir=None, log_dir=None, attempt=None):
     """Offline hang/desync diagnosis over a postmortem bundle (see
     module docstring). Returns the process exit code."""
@@ -825,6 +953,20 @@ def main():
             telemetry_dir=kv.get("--telemetry-dir"),
             log_dir=kv.get("--log-dir"),
             attempt=kv.get("--attempt")))
+    if "--compile-cache" in args:
+        kv = _parse_mode_flags(
+            "--compile-cache",
+            [a for a in args if a != "--compile-cache"],
+            {"--telemetry-dir": str, "--log-dir": str,
+             "--cache-dir": str})
+        if not (kv.get("--telemetry-dir") or kv.get("--log-dir")):
+            raise SystemExit(
+                "usage: --compile-cache --telemetry-dir DIR | "
+                "--log-dir DIR [--cache-dir DIR]")
+        raise SystemExit(compile_cache_report(
+            telemetry_dir=kv.get("--telemetry-dir"),
+            log_dir=kv.get("--log-dir"),
+            cache_dir=kv.get("--cache-dir")))
     if "--elastic" in args:
         kv = _parse_mode_flags(
             "--elastic", [a for a in args if a != "--elastic"],

@@ -127,6 +127,18 @@ def collect():
                                 qual, m_name, _argspec(fn)))
             elif callable(obj):
                 lines.append("%s (%s)" % (qual, _argspec(obj)))
+    # the FLAGS_* surface (paddle_tpu/utils/flags._FLAGS): a flag
+    # rename/removal breaks users exactly like a function signature
+    # would — lock the names. Values deliberately not pinned: flags
+    # ingest FLAGS_* environment variables at import, so defaults are
+    # environment-dependent by design.
+    try:
+        from paddle_tpu.utils import flags as _flags
+
+        for name in sorted(_flags._FLAGS):
+            lines.append("paddle_tpu.utils.flags.%s (flag)" % name)
+    except ImportError as e:
+        lines.append("paddle_tpu.utils.flags IMPORT_ERROR %r" % (e,))
     return lines
 
 
