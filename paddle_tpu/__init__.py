@@ -45,6 +45,7 @@ from . import fleet  # noqa: F401
 from .incubate import complex  # noqa: F401
 from .framework.random import manual_seed  # noqa: F401
 from . import inference  # noqa: F401
+from . import serving  # noqa: F401
 from . import parallel  # noqa: F401
 from . import nn  # noqa: F401
 from . import tensor  # noqa: F401

@@ -43,7 +43,8 @@ from typing import Dict, Optional
 __all__ = ["cache_dir", "enabled", "donation_safe", "ensure", "disable",
            "lowering_flags", "fingerprint", "index_lookup",
            "index_store", "install_listeners", "jax_stats",
-           "stats_delta", "record_event", "stats"]
+           "stats_delta", "record_event", "stats",
+           "classified_compile"]
 
 #: flags whose value shapes the lowered computation — part of the
 #: fingerprint, so flipping any of them can never alias a stale
@@ -391,6 +392,52 @@ def new_entry_bytes(since_ts: float) -> int:
     except OSError:
         return 0
     return total
+
+
+def classified_compile(lowered, mesh=None, extra=None, source="aot"):
+    """Compile a `jax.stages.Lowered` while classifying it against the
+    persistent tier — the generic twin of the Executor's per-entry
+    classification, used by non-Program compile paths (the serving
+    engine's decode/prefill step buckets, `source="serving_decode"` /
+    `"serving_prefill"`; `tools/perf_analysis.py --compile-cache`
+    breaks its report down by this source tag).
+
+    Returns (compiled, info) where info is None when the tier is off,
+    else {"status": "hit"|"miss", "fingerprint", "compile_ms",
+    "saved_ms"}. The jax-stat delta is THREAD-LOCAL (jax_stats), so
+    concurrent warmups classify independently. Classification errors
+    degrade to an unclassified compile — never a failed one."""
+    ensure()
+    if not enabled():
+        return lowered.compile(), None
+    try:
+        fp = fingerprint(lowered.as_text(), mesh, extra=extra)
+        prev = index_lookup(fp)
+    except Exception:  # noqa: BLE001 - classification is telemetry
+        return lowered.compile(), None
+    before, t0 = jax_stats(), time.time()
+    compiled = lowered.compile()
+    d = stats_delta(before)
+    comp_ms = max(0.0, d["backend_compile_s"]) * 1e3
+    hit = prev is not None or d["persistent_hits"] > 0
+    saved_ms = max(0.0, d["saved_s"] * 1e3)
+    nbytes = 0
+    if prev is not None:
+        saved_ms = max(saved_ms,
+                       float(prev.get("compile_ms", 0.0)) - comp_ms)
+        nbytes = int(prev.get("bytes", 0))
+    elif not hit:
+        nbytes = new_entry_bytes(t0)
+    status = "hit" if hit else "miss"
+    record_event(status, fp, compile_ms=comp_ms, saved_ms=saved_ms,
+                 nbytes=nbytes, source=source)
+    if prev is None:
+        index_store(fp, {"compile_ms": round(comp_ms, 3),
+                         "bytes": nbytes, "source": str(source),
+                         "mesh": mesh_signature(mesh)})
+    return compiled, {"status": status, "fingerprint": fp,
+                      "compile_ms": round(comp_ms, 3),
+                      "saved_ms": round(saved_ms, 3)}
 
 
 # -- telemetry -------------------------------------------------------------

@@ -11,6 +11,14 @@ capture) are XLA's job — the loaded program lowers to ONE compiled
 computation cached by input shapes; "zero-copy" tensors hold numpy on the
 host side and jax device arrays after run. MKLDNN/TensorRT/GPU knobs are
 accepted as no-ops so reference configs port unchanged.
+
+This is the per-call, load-and-run surface. For PERSISTENT serving —
+continuous batching across concurrent requests, a paged KV cache, and
+AOT-warmed decode-step buckets — see ``paddle_tpu.serving``
+(serving/README.md); `Predictor.warmup(shapes=...)` pre-compiles this
+predictor's own input-shape buckets through the same persistent
+compile cache (FLAGS_tpu_compile_cache_dir) so a serving process
+restart answers its first request without paying XLA compilation.
 """
 from __future__ import annotations
 
@@ -335,6 +343,26 @@ class Predictor:
 
     # legacy alias
     zero_copy_run = run
+
+    def warmup(self, shapes, meshes=None, background=False):
+        """AOT-compile this predictor's program for the given
+        input-shape buckets BEFORE traffic (PR 13 machinery:
+        `Executor.warmup` + the FLAGS_tpu_compile_cache_dir persistent
+        tier). `shapes` is a list of dicts mapping input name ->
+        concrete shape tuple / example array / ShapeDtypeStruct; each
+        bucket executes one discarded run on state copies, so the
+        first real request of that shape dispatches with
+        compile_ms ~ 0 — and a RESTARTED serving process warms
+        all-hit from the persistent tier. Returns the warmup report
+        ({"compiled": [...], "cached": [...], "skipped": [...]}), or
+        the background Thread when background=True."""
+        from paddle_tpu.core.scope import scope_guard
+
+        with scope_guard(self._scope):
+            return self._exe.warmup(
+                self._program, shapes, meshes=meshes,
+                fetch_list=self._fetch_targets, scope=self._scope,
+                background=background)
 
     def clear_intermediate_tensor(self):
         pass

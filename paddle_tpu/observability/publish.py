@@ -24,6 +24,11 @@ one-line BENCH summary bench.py always printed, and publishes):
     compile_cache_block()               "compile_cache" (persistent
                                         compile-cache hit/miss roll-up
                                         + on-disk tier inventory)
+    serving_block()                     "serving" (inference engine:
+                                        tokens/sec, request p50/p99,
+                                        queue depth, KV occupancy —
+                                        from the serving.* metrics an
+                                        Engine/trace run published)
     telemetry_block(group=None)         "telemetry" (registry counters,
                                         straggler report when a
                                         host-collective group is given)
@@ -37,7 +42,7 @@ from .registry import registry
 __all__ = ["phases_block", "collectives_blocks", "hierarchy_block",
            "precision_block", "attribution_block",
            "static_checks_block", "compile_cache_block",
-           "telemetry_block", "bench_blocks"]
+           "serving_block", "telemetry_block", "bench_blocks"]
 
 
 def phases_block() -> dict:
@@ -377,6 +382,60 @@ def compile_cache_block() -> Optional[dict]:
              block["persistent_entries"],
              block["persistent_bytes"] / 1e6,
              block["dir"] or "<off>"), flush=True)
+    return block
+
+
+def serving_block() -> Optional[dict]:
+    """Serving-engine evidence (paddle_tpu/serving): tokens/sec and
+    request-level p50/p99 latency under the trace the registry just
+    measured, queue-depth distribution, KV-page occupancy peak, bucket
+    AOT coverage. Assembled ENTIRELY from the serving.* metrics the
+    Engine and trace runner published — bench.py --serving, the tier-1
+    leg and any future tool read the identical dict. None when no
+    Engine ran in this process."""
+    reg = registry()
+    snap = reg.snapshot()
+    counters = snap["counters"]
+    hists = snap["histograms"]
+    gauges = snap["gauges"]
+    if not counters.get("serving.steps"):
+        return None
+    lat = hists.get("serving.request_latency_ms") or {}
+    ttft = hists.get("serving.ttft_ms") or {}
+    qd = hists.get("serving.queue_depth") or {}
+    block = {
+        "steps": counters.get("serving.steps", 0),
+        "requests_submitted": counters.get(
+            "serving.requests_submitted", 0),
+        "requests_finished": counters.get(
+            "serving.requests_finished", 0),
+        "requests_cancelled": counters.get(
+            "serving.requests_cancelled", 0),
+        "tokens_generated": counters.get(
+            "serving.tokens_generated", 0),
+        "tokens_per_sec": gauges.get("serving.tokens_per_sec"),
+        "latency_ms": {k: lat.get(k)
+                       for k in ("p50", "p99", "mean", "max")},
+        "ttft_ms": {k: ttft.get(k) for k in ("p50", "p99")},
+        "queue_depth": {k: qd.get(k) for k in ("mean", "max")},
+        "kv_pages_total": gauges.get("serving.kv_pages_total"),
+        "kv_peak_pages_in_use": gauges.get(
+            "serving.kv_peak_pages_in_use"),
+        "kv_occupancy": gauges.get("serving.kv_occupancy"),
+        "buckets_compiled": gauges.get("serving.buckets_compiled"),
+    }
+    reg.publish_block("serving", block)
+    print("BENCH serving: %.1f tok/s, %d req (%d finished / %d "
+          "cancelled), latency p50=%.1fms p99=%.1fms, queue mean=%.1f "
+          "max=%s, kv peak=%s"
+          % (block["tokens_per_sec"] or 0.0,
+             block["requests_submitted"], block["requests_finished"],
+             block["requests_cancelled"],
+             block["latency_ms"]["p50"] or 0.0,
+             block["latency_ms"]["p99"] or 0.0,
+             qd.get("mean") or 0.0, qd.get("max"),
+             "%s/%s pages" % (block["kv_peak_pages_in_use"],
+                              block["kv_pages_total"])), flush=True)
     return block
 
 

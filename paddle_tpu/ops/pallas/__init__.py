@@ -5,3 +5,5 @@ registry — the TPU-native counterpart of the reference's hand-written
 CUDA in `paddle/fluid/operators/fused/` and `operators/math/`.
 """
 from .flash_attention import flash_attention, reference_attention  # noqa: F401
+from .ragged_paged_attention import (  # noqa: F401
+    ragged_paged_attention, ragged_paged_attention_reference)

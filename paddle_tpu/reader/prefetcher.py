@@ -225,6 +225,16 @@ def prefetch_to_device(iterator: Iterable, size: Optional[int] = None,
     return DevicePrefetcher(iterator, size=size, sharding=sharding)
 
 
+def device_put_batch(value, sharding=None):
+    """Issue one non-blocking host->device transfer of a batch (dict /
+    list / array), marking fresh buffers donatable — the prefetcher's
+    own put path exposed for single-batch producers (the serving
+    engine's request-ingress packing: the packed prefill/decode bucket
+    is uploaded while the previous step's compute is still in flight,
+    and the jitted step may reuse its HBM)."""
+    return _device_put(value, sharding)
+
+
 def is_on_device(value) -> bool:
     """True when `value` is a jax Array already resident on device (the
     executor's feed fast path skips device_put for these). numpy arrays

@@ -1,0 +1,41 @@
+"""Inference serving runtime: continuous batching over a paged KV
+cache with ragged paged attention (see serving/README.md).
+
+The training-shaped stack ends at `paddle_tpu.inference`'s
+AnalysisPredictor surface — load a saved program, run it per call.
+Serving "heavy traffic from millions of users" (ROADMAP north star)
+needs the opposite shape: a PERSISTENT engine that keeps model +
+KV state resident, admits and retires requests between decode steps
+(continuous batching), allocates KV memory in fixed-size HBM pages
+per sequence (block tables), and dispatches every step at one of a
+finite set of AOT-compiled bucket shapes so first traffic — and every
+serving restart through the PR 13 persistent compile cache — pays
+zero XLA compiles.
+
+    from paddle_tpu import serving
+
+    engine = serving.Engine(serving.TinyDecoderLM(), config=
+                            serving.EngineConfig.from_flags())
+    engine.warmup()                      # AOT: all buckets compiled
+    req = engine.submit([1, 2, 3], max_new_tokens=16)
+    thread_or_loop: engine.step()        # continuous batching
+    for tok in req.stream(): ...
+
+Attention runs through `paddle_tpu.ops.pallas.ragged_paged_attention`
+(one kernel for mixed prefill/decode batches through the block table;
+Pallas on TPU, jittable pure-JAX reference on CPU tier-1).
+"""
+from .engine import Engine, EngineConfig  # noqa: F401
+from .kv_cache import KVCacheConfig, PagedKVCache  # noqa: F401
+from .model import (TinyDecoderLM, TinyLMConfig,  # noqa: F401
+                    dense_decode_reference)
+from .scheduler import (BucketPlan, Request,  # noqa: F401
+                        RequestState, Scheduler)
+from .trace import run_trace, synthetic_trace  # noqa: F401
+
+__all__ = [
+    "Engine", "EngineConfig", "KVCacheConfig", "PagedKVCache",
+    "TinyDecoderLM", "TinyLMConfig", "dense_decode_reference",
+    "BucketPlan", "Request", "RequestState", "Scheduler",
+    "run_trace", "synthetic_trace",
+]

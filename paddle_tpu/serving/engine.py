@@ -1,0 +1,379 @@
+"""serving.Engine — the persistent inference runtime front end.
+
+One Engine owns: a model (the `ServingModel` duck type — see
+serving/model.py), the paged KV cache, the continuous-batching
+scheduler, and the per-bucket AOT executables. Callers interact
+through three thread-safe verbs:
+
+    req = engine.submit(prompt_ids, max_new_tokens=32)   # enqueue
+    for tok in req.stream(): ...                         # consume
+    req.cancel()                                         # evict
+
+and the engine advances by `step()` (or `run_until_idle()`); each step
+retires/admits between decode steps and issues at most one prefill and
+one decode dispatch, both at fixed bucket shapes.
+
+Hot-loop contract: the per-token loop is host-side around fully
+compiled fixed-shape steps — no data-dependent shapes, no fetch inside
+a device loop (the tpu-lint `serving_decode` exemplar pins the
+IR-level claim); the only per-STEP host sync is the sampled-token
+harvest (a `LazyFetch` materialization, accounted to the profiler's
+sync phase), which EOS detection and streaming need.
+
+Telemetry (PR 7 registry): request-level p50/p99 latency and TTFT
+histograms, queue-depth and KV-occupancy gauges, tokens/sec counters,
+plus `serving_request` / `serving_step` events (schema-locked in
+tools/telemetry_schema.json). The bench `serving` block
+(observability/publish.serving_block) assembles from these.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .aot import BucketCompiler
+from .kv_cache import PagedKVCache
+from .scheduler import BucketPlan, Request, RequestState, Scheduler
+
+__all__ = ["EngineConfig", "Engine"]
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    """Serving knobs; defaults read the FLAGS_tpu_serving_* surface
+    (see serving/README.md for the full table)."""
+
+    num_pages: int = 512
+    page_size: int = 16
+    max_seqs: int = 8
+    max_queue: int = 0
+    max_context: Optional[int] = None  # None = the model's max_seq
+    attention_impl: str = "auto"
+    step_event_every: int = 1
+
+    @staticmethod
+    def from_flags(**overrides) -> "EngineConfig":
+        from ..utils.flags import get_flag
+
+        kw = dict(
+            num_pages=int(get_flag("FLAGS_tpu_serving_num_pages", 512)),
+            page_size=int(get_flag("FLAGS_tpu_serving_page_size", 16)),
+            max_seqs=int(get_flag("FLAGS_tpu_serving_max_seqs", 8)),
+            max_queue=int(get_flag("FLAGS_tpu_serving_max_queue", 0)),
+            attention_impl=str(get_flag(
+                "FLAGS_tpu_serving_attention_impl", "auto") or "auto"),
+        )
+        kw.update(overrides)
+        return EngineConfig(**kw)
+
+
+class Engine:
+    """Continuous-batching serving engine over a paged KV cache."""
+
+    def __init__(self, model, params=None, config: Optional[
+            EngineConfig] = None, seed: int = 0):
+        import jax
+
+        self.config = config or EngineConfig.from_flags()
+        self.model = model
+        model_impl = getattr(model, "attention_impl", None) or "auto"
+        if self.config.attention_impl != "auto":
+            if model_impl not in ("auto", self.config.attention_impl):
+                raise ValueError(
+                    "EngineConfig.attention_impl=%r conflicts with "
+                    "model.attention_impl=%r (the jitted step is "
+                    "shared per model — use one impl per model "
+                    "instance)" % (self.config.attention_impl,
+                                   model_impl))
+            model.attention_impl = self.config.attention_impl
+        self.params = params if params is not None else \
+            model.init_params(seed)
+        # the TRUE per-request bound is the model's max_seq; pages
+        # round UP to whole pages, so the pool bound can be looser
+        max_ctx = min(self.config.max_context or model.config.max_seq,
+                      model.config.max_seq)
+        pages_per_seq = -(-int(max_ctx) // self.config.page_size)
+        self.kv = PagedKVCache(model.kv_cache_spec(
+            self.config.num_pages, self.config.page_size,
+            pages_per_seq))
+        self.plan = BucketPlan.from_flags(
+            self.config.max_seqs, self.kv.config.max_context)
+        self.scheduler = Scheduler(self.kv, self.plan,
+                                   self.config.max_seqs,
+                                   self.config.max_queue,
+                                   max_context=max_ctx)
+        self.pages = self.kv.init_device_state()
+        self._lock = threading.RLock()
+        self._steps = 0
+        self._tokens_generated = 0
+        self._t_started = time.time()
+        self._closed = False
+
+        # donation of the page state into the step is gated exactly
+        # like the executor's: the persistent tier's deserialized
+        # executables corrupt donated outputs on XLA:CPU (PR 13)
+        from ..fluid import compile_cache as cc
+        from ..utils.flags import get_flag
+
+        donate = bool(get_flag("FLAGS_tpu_donate_buffers", True)) and \
+            cc.donation_safe()
+
+        # memoized on the model object: two engines over the SAME model
+        # (a restart, the sequential-reference twin in tests) share
+        # jax's in-process executable cache instead of re-tracing.
+        # Keyed on (donate, attention_impl): forward() closes over the
+        # impl at trace time, so a stale memo would silently serve the
+        # wrong attention path
+        memo_key = (donate, getattr(model, "attention_impl", "auto"))
+        self._jitted = getattr(model, "_serving_jitted", None)
+        if self._jitted is None or \
+                getattr(model, "_serving_jitted_key", None) != memo_key:
+            def _step(params, pages, tokens, block_tables,
+                      context_lens, q_lens, _model=model):
+                return _model.forward(params, tokens, pages,
+                                      block_tables, context_lens,
+                                      q_lens)
+
+            self._jitted = jax.jit(
+                _step, donate_argnums=(1,) if donate else ())
+            model._serving_jitted = self._jitted
+            model._serving_jitted_key = memo_key
+        self._compiler = BucketCompiler(self._jitted,
+                                        self.kv.config.pages_per_seq)
+
+    # -- public verbs ------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int = 16,
+               eos_id: Optional[int] = None, tenant: str = "") -> Request:
+        """Enqueue one generation request (thread-safe). Raises when
+        the prompt exceeds max context or the bounded queue is full
+        (FLAGS_tpu_serving_max_queue)."""
+        with self._lock:
+            # inside the lock: a submit racing close() must not land a
+            # request no step() will ever retire (its stream would
+            # never close)
+            if self._closed:
+                raise RuntimeError("engine is closed")
+            req = self.scheduler.new_request(prompt, max_new_tokens,
+                                             eos_id=eos_id, tenant=tenant)
+        self._reg_safe(lambda r: r.inc("serving.requests_submitted"))
+        return req
+
+    def cancel(self, request: Request) -> None:
+        """Cancel a request: its stream closes and its KV pages free at
+        the next step boundary (immediate when it is still queued)."""
+        request.cancel()
+
+    def warmup(self) -> dict:
+        """AOT-compile every scheduler bucket through the persistent
+        compile cache (PR 13) before first traffic — a restarted
+        serving process reports all-hit here. Returns the
+        BucketCompiler report plus the bucket list."""
+        with self._lock:
+            report = self._compiler.warmup(self.plan.all_buckets(),
+                                           self.params, self.pages)
+        report["buckets"] = [list(b)
+                             for b in self.plan.all_buckets()]
+        self._reg_safe(lambda r: r.set_gauge(
+            "serving.buckets_compiled",
+            len(self._compiler.compiled_buckets)))
+        return report
+
+    def step(self) -> dict:
+        """One engine iteration: retire -> admit -> prefill dispatch ->
+        decode dispatch -> telemetry. Returns step stats."""
+        if self._closed:
+            raise RuntimeError("engine is closed")
+        t0 = time.perf_counter()
+        with self._lock:
+            for req in self.scheduler.retire():
+                self._publish_request(req)
+            self.scheduler.admit()
+            prefill_stats = self._run_prefill()
+            decode_stats = self._run_decode()
+            for req in self.scheduler.retire():
+                self._publish_request(req)
+            self._steps += 1
+            stats = {
+                "step": self._steps,
+                "queue_depth": self.scheduler.queue_depth,
+                "running": len(self.scheduler.running),
+                "kv_pages_in_use": self.kv.pages_in_use,
+                **prefill_stats, **decode_stats,
+                "step_ms": round((time.perf_counter() - t0) * 1e3, 3),
+            }
+        self._publish_step(stats)
+        return stats
+
+    def run_until_idle(self, max_steps: int = 100000) -> int:
+        """Step until every submitted request finished (trace runner /
+        tests). Returns the number of steps taken."""
+        n = 0
+        while not self.scheduler.idle and n < max_steps:
+            self.step()
+            n += 1
+        return n
+
+    def close(self) -> None:
+        """Cancel everything in flight and release the pool."""
+        with self._lock:
+            for req in list(self.scheduler.queued) + \
+                    list(self.scheduler.running.values()):
+                req.cancel()
+            # retire() drains cancelled queued requests too, so the
+            # queue is empty here and every request got its one
+            # serving_request event
+            for req in self.scheduler.retire():
+                self._publish_request(req)
+            self._closed = True
+
+    # -- dispatch ----------------------------------------------------------
+    def _dispatch(self, bucket: Tuple[int, int], group, tokens, ctx,
+                  qlens) -> np.ndarray:
+        """Pack one bucket, upload it through the PR 2 device-put path,
+        run the AOT executable, and harvest the sampled tokens via
+        LazyFetch (ONE per-step host sync, profiler-accounted)."""
+        from ..fluid.executor import LazyFetch
+        from ..reader.prefetcher import device_put_batch
+
+        B, T = bucket
+        npages = self.kv.config.pages_per_seq
+        tables = np.zeros((B, npages), np.int32)
+        for b, req in enumerate(group):
+            row = self.kv.block_table(req.request_id)
+            tables[b, :len(row)] = row
+        feed = device_put_batch({
+            "tokens": tokens.astype(np.int32),
+            "tables": tables,
+            "ctx": ctx.astype(np.int32),
+            "qlens": qlens.astype(np.int32),
+        })
+        next_tok, _logits, self.pages = self._compiler(
+            bucket, self.params, self.pages, feed["tokens"],
+            feed["tables"], feed["ctx"], feed["qlens"])
+        return LazyFetch(next_tok).numpy()
+
+    def _run_prefill(self) -> dict:
+        group, B, T = self.scheduler.prefill_group()
+        if not group:
+            return {"n_prefill": 0, "prefill_tokens": 0}
+        tokens = np.zeros((B, T), np.int32)
+        ctx = np.zeros((B,), np.int32)
+        qlens = np.zeros((B,), np.int32)
+        chunks = []
+        for b, req in enumerate(group):
+            chunk = min(T, req.prompt_len - req.prefilled)
+            tokens[b, :chunk] = req.prompt[req.prefilled:
+                                           req.prefilled + chunk]
+            qlens[b] = chunk
+            ctx[b] = req.prefilled + chunk
+            chunks.append(chunk)
+        toks = self._dispatch((B, T), group, tokens, ctx, qlens)
+        for b, req in enumerate(group):
+            req.prefilled += chunks[b]
+            req.context_len = req.prefilled
+            if req.prefilled >= req.prompt_len:
+                # final chunk: its last-row logits ARE the first
+                # generated token
+                req.state = RequestState.RUNNING
+                req.last_token = int(toks[b])
+                req._emit(req.last_token)
+                self._tokens_generated += 1
+                self.scheduler.finish_if_done(req)
+        return {"n_prefill": len(group),
+                "prefill_tokens": int(sum(chunks))}
+
+    def _run_decode(self) -> dict:
+        group, B = self.scheduler.decode_group()
+        if not group:
+            return {"n_decode": 0}
+        tokens = np.zeros((B, 1), np.int32)
+        ctx = np.zeros((B,), np.int32)
+        qlens = np.zeros((B,), np.int32)
+        for b, req in enumerate(group):
+            tokens[b, 0] = req.last_token
+            ctx[b] = req.context_len + 1  # incl. the token written now
+            qlens[b] = 1
+        toks = self._dispatch((B, 1), group, tokens, ctx, qlens)
+        for b, req in enumerate(group):
+            req.context_len += 1
+            req.last_token = int(toks[b])
+            req._emit(req.last_token)
+            self._tokens_generated += 1
+            self.scheduler.finish_if_done(req)
+        return {"n_decode": len(group)}
+
+    # -- telemetry ---------------------------------------------------------
+    def _reg_safe(self, fn) -> None:
+        try:
+            from ..observability import registry
+
+            fn(registry())
+        except Exception:  # noqa: BLE001 - telemetry must never gate
+            pass
+
+    def _publish_request(self, req: Request) -> None:
+        def pub(reg):
+            now = req.t_finish or time.time()
+            latency_ms = (now - req.t_submit) * 1e3
+            ttft_ms = ((req.t_first_token - req.t_submit) * 1e3
+                       if req.t_first_token else None)
+            status = req.state
+            reg.inc("serving.requests_" + status)
+            reg.inc("serving.tokens_generated",
+                    len(req.output_tokens))
+            reg.observe("serving.request_latency_ms", latency_ms)
+            if ttft_ms is not None:
+                reg.observe("serving.ttft_ms", ttft_ms)
+            fields = dict(status=status,
+                          latency_ms=round(latency_ms, 3),
+                          output_tokens=len(req.output_tokens),
+                          prompt_tokens=req.prompt_len,
+                          request=int(req.request_id))
+            if ttft_ms is not None:
+                fields["ttft_ms"] = round(ttft_ms, 3)
+            if req.tenant:
+                fields["tenant"] = req.tenant
+            reg.event("serving_request", **fields)
+
+        self._reg_safe(pub)
+
+    def _publish_step(self, stats: dict) -> None:
+        def pub(reg):
+            reg.inc("serving.steps")
+            reg.set_gauge("serving.queue_depth", stats["queue_depth"])
+            reg.set_gauge("serving.running", stats["running"])
+            reg.observe("serving.queue_depth", stats["queue_depth"])
+            reg.observe("serving.step_ms", stats["step_ms"])
+            if stats.get("n_decode"):
+                reg.observe("serving.decode_batch", stats["n_decode"])
+            every = max(1, int(self.config.step_event_every))
+            if self._steps % every == 0:
+                reg.event("serving_step",
+                          running=stats["running"],
+                          queue_depth=stats["queue_depth"],
+                          kv_blocks_in_use=stats["kv_pages_in_use"],
+                          n_prefill=stats.get("n_prefill", 0),
+                          n_decode=stats.get("n_decode", 0))
+
+        self._reg_safe(pub)
+
+    # -- introspection -----------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            up = max(1e-9, time.time() - self._t_started)
+            return {
+                "steps": self._steps,
+                "queue_depth": self.scheduler.queue_depth,
+                "running": len(self.scheduler.running),
+                "tokens_generated": self._tokens_generated,
+                "tokens_per_sec": self._tokens_generated / up,
+                "kv_pages_in_use": self.kv.pages_in_use,
+                "kv_occupancy": round(self.kv.occupancy, 4),
+                "kv_peak_pages": self.kv.peak_pages_in_use,
+                "buckets_compiled": [
+                    list(b) for b in self._compiler.compiled_buckets],
+            }

@@ -1,0 +1,172 @@
+"""Paged KV-cache manager: fixed-size HBM pages + per-sequence block
+tables.
+
+The device side is two arrays per decoder layer —
+``k_pages``/``v_pages`` of shape [num_pages, page_size, kv_heads,
+head_dim] — updated *functionally* inside the jitted serving step
+(scatter-with-drop, see serving/model.py), so the whole cache rides
+through XLA like any other carried state and is donated back into the
+step where donation is safe.
+
+The host side (this module) is pure bookkeeping: a free list, one
+block table per live sequence, and an occupancy gauge. Allocation is
+worst-case at admission — ``ceil((prompt + max_new) / page_size)``
+pages reserved up front — so a running request can never strand
+mid-decode on an empty pool; the trade is admission-time backpressure
+(`alloc` returns None and the scheduler keeps the request queued)
+instead of mid-flight eviction. `free` (request finished or cancelled)
+returns every page to the pool immediately.
+
+Occupancy telemetry (PR 7 registry): gauges
+``serving.kv_pages_in_use`` / ``serving.kv_pages_total`` /
+``serving.kv_occupancy`` refresh on every alloc/free; the bench
+``serving`` block reads the peak.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+__all__ = ["KVCacheConfig", "PagedKVCache"]
+
+
+@dataclass(frozen=True)
+class KVCacheConfig:
+    """Shape of the paged pool. ``pages_per_seq`` bounds one sequence's
+    block table (max context = pages_per_seq * page_size) and is the
+    static gather width of every attention call — fixed per engine, so
+    per-row attention math is identical no matter how the batch was
+    packed."""
+
+    num_pages: int
+    page_size: int
+    pages_per_seq: int
+    num_layers: int
+    num_kv_heads: int
+    head_dim: int
+    dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.num_pages < 1 or self.page_size < 1:
+            raise ValueError("need num_pages >= 1 and page_size >= 1")
+        if self.pages_per_seq < 1:
+            raise ValueError("pages_per_seq must be >= 1")
+
+    @property
+    def max_context(self) -> int:
+        return self.pages_per_seq * self.page_size
+
+    def pages_for(self, tokens: int) -> int:
+        return -(-int(tokens) // self.page_size)
+
+
+@dataclass
+class _SeqAlloc:
+    pages: List[int]
+    reserved: int  # worst-case pages reserved at admission
+    table: List[int] = field(default_factory=list)
+
+
+class PagedKVCache:
+    """Host-side page accounting for one engine. Not thread-safe by
+    itself — the Engine serializes scheduler mutations under its own
+    lock."""
+
+    def __init__(self, config: KVCacheConfig):
+        self.config = config
+        self._free: List[int] = list(range(config.num_pages))
+        self._seqs: Dict[int, _SeqAlloc] = {}
+        self._peak_in_use = 0
+        self._publish()
+
+    # -- pool state --------------------------------------------------------
+    @property
+    def pages_in_use(self) -> int:
+        return self.config.num_pages - len(self._free)
+
+    @property
+    def pages_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def occupancy(self) -> float:
+        return self.pages_in_use / float(self.config.num_pages)
+
+    @property
+    def peak_pages_in_use(self) -> int:
+        return self._peak_in_use
+
+    def can_admit(self, total_tokens: int) -> bool:
+        """Would `alloc` for a request of `total_tokens` worst-case
+        tokens succeed right now?"""
+        return self.config.pages_for(total_tokens) <= len(self._free)
+
+    # -- per-sequence lifecycle -------------------------------------------
+    def alloc(self, seq_id: int, total_tokens: int) -> Optional[List[int]]:
+        """Reserve pages for a sequence whose context will never exceed
+        `total_tokens` (prompt + max_new). Returns the page list (the
+        block table prefix, in order) or None when the pool cannot
+        cover it — the admission-backpressure signal."""
+        if seq_id in self._seqs:
+            raise ValueError("seq %r already allocated" % (seq_id,))
+        if total_tokens > self.config.max_context:
+            raise ValueError(
+                "request needs %d tokens > max_context %d "
+                "(pages_per_seq * page_size)"
+                % (total_tokens, self.config.max_context))
+        n = self.config.pages_for(total_tokens)
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        self._seqs[seq_id] = _SeqAlloc(pages=pages, reserved=n)
+        self._peak_in_use = max(self._peak_in_use, self.pages_in_use)
+        self._publish()
+        return list(pages)
+
+    def free(self, seq_id: int) -> int:
+        """Return a sequence's pages to the pool (request finished or
+        cancelled — cancel-time eviction is immediate). Returns the
+        number of pages released; unknown ids are a no-op (retire and
+        cancel may race benignly)."""
+        alloc = self._seqs.pop(seq_id, None)
+        if alloc is None:
+            return 0
+        self._free.extend(alloc.pages)
+        self._publish()
+        return len(alloc.pages)
+
+    def block_table(self, seq_id: int) -> List[int]:
+        """The sequence's page ids in context order, padded by the
+        caller to pages_per_seq (pad entries must be valid page
+        indices — the engine uses 0)."""
+        return list(self._seqs[seq_id].pages)
+
+    def live_seqs(self) -> List[int]:
+        return list(self._seqs)
+
+    # -- device state ------------------------------------------------------
+    def init_device_state(self):
+        """Fresh zeroed device pages: a list of (k_pages, v_pages) per
+        layer, each [num_pages, page_size, kv_heads, head_dim]."""
+        import jax.numpy as jnp
+
+        c = self.config
+        shape = (c.num_pages, c.page_size, c.num_kv_heads, c.head_dim)
+        return [(jnp.zeros(shape, c.dtype), jnp.zeros(shape, c.dtype))
+                for _ in range(c.num_layers)]
+
+    # -- telemetry ---------------------------------------------------------
+    def _publish(self) -> None:
+        try:
+            from ..observability import registry
+
+            reg = registry()
+            reg.set_gauge("serving.kv_pages_in_use", self.pages_in_use)
+            reg.set_gauge("serving.kv_pages_total",
+                          self.config.num_pages)
+            reg.set_gauge("serving.kv_occupancy",
+                          round(self.occupancy, 4))
+            reg.set_gauge("serving.kv_peak_pages_in_use",
+                          self._peak_in_use)
+        except Exception:  # noqa: BLE001 - telemetry must never gate
+            pass

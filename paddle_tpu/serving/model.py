@@ -1,0 +1,223 @@
+"""Decode-step model functions over the paged KV cache.
+
+`TinyDecoderLM` is a small pre-LN transformer LM written directly
+against the paged cache: one `forward` serves BOTH prefill (T > 1
+query tokens per sequence) and decode (T == 1) — the new tokens' K/V
+scatter into the sequence's pages first (invalid rows dropped), then
+`ragged_paged_attention` attends through the block table. Every shape
+is static per (batch, T) bucket, so each bucket is one AOT-compiled
+executable and the decode loop contains no data-dependent shapes and
+no host syncs.
+
+This is the serving runtime's built-in model for tests and the bench
+trace — the Engine itself only needs the `ServingModel` duck type:
+``init_params(seed)``, ``forward(params, tokens, pages, block_tables,
+context_lens, q_lens)`` returning ``(next_tokens, last_logits,
+new_pages)``, and the ``kv_cache_spec(...)`` geometry hook.
+
+`dense_decode_reference` greedy-decodes one prompt with dense causal
+attention and NO paging/engine at all — the independent golden the
+engine's token streams are checked against (fp32 tolerance; the
+bit-identical claim is batched-vs-sequential through the SAME engine
+math).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+import numpy as np
+
+from .kv_cache import KVCacheConfig
+
+__all__ = ["TinyLMConfig", "TinyDecoderLM", "dense_decode_reference"]
+
+
+@dataclass(frozen=True)
+class TinyLMConfig:
+    vocab: int = 64
+    embed: int = 32
+    layers: int = 2
+    heads: int = 2          # query heads
+    kv_heads: int = 2       # Hq % Hkv == 0 (GQA groups = Hq // Hkv)
+    head_dim: int = 16
+    ffn: int = 64
+    max_seq: int = 64
+
+    def __post_init__(self):
+        if self.heads % self.kv_heads:
+            raise ValueError("heads %d not a multiple of kv_heads %d"
+                             % (self.heads, self.kv_heads))
+
+
+class TinyDecoderLM:
+    """Functional model: params are a plain dict pytree, `forward` is
+    pure (jit/AOT-compiled per bucket by the engine)."""
+
+    def __init__(self, config: Optional[TinyLMConfig] = None,
+                 attention_impl: str = "auto"):
+        self.config = config or TinyLMConfig()
+        self.attention_impl = attention_impl
+
+    def kv_cache_spec(self, num_pages: int, page_size: int,
+                      pages_per_seq: int) -> KVCacheConfig:
+        c = self.config
+        return KVCacheConfig(
+            num_pages=num_pages, page_size=page_size,
+            pages_per_seq=pages_per_seq, num_layers=c.layers,
+            num_kv_heads=c.kv_heads, head_dim=c.head_dim)
+
+    # -- params ------------------------------------------------------------
+    def init_params(self, seed: int = 0) -> dict:
+        import jax
+        import jax.numpy as jnp
+
+        c = self.config
+        key = jax.random.PRNGKey(seed)
+        ks = jax.random.split(key, 2 + 6 * c.layers)
+
+        def init(k, shape, scale=0.02):
+            return (scale * jax.random.normal(k, shape)).astype(
+                jnp.float32)
+
+        params = {
+            "emb": init(ks[0], (c.vocab, c.embed)),
+            "pos": init(ks[1], (c.max_seq, c.embed)),
+            "lnf_g": jnp.ones((c.embed,), jnp.float32),
+            "lnf_b": jnp.zeros((c.embed,), jnp.float32),
+            "layers": [],
+        }
+        hq, hkv, d = c.heads, c.kv_heads, c.head_dim
+        for i in range(c.layers):
+            a = ks[2 + 6 * i: 2 + 6 * (i + 1)]
+            params["layers"].append({
+                "ln1_g": jnp.ones((c.embed,), jnp.float32),
+                "ln1_b": jnp.zeros((c.embed,), jnp.float32),
+                "wq": init(a[0], (c.embed, hq * d)),
+                "wk": init(a[1], (c.embed, hkv * d)),
+                "wv": init(a[2], (c.embed, hkv * d)),
+                "wo": init(a[3], (hq * d, c.embed)),
+                "ln2_g": jnp.ones((c.embed,), jnp.float32),
+                "ln2_b": jnp.zeros((c.embed,), jnp.float32),
+                "w1": init(a[4], (c.embed, c.ffn)),
+                "w2": init(a[5], (c.ffn, c.embed)),
+            })
+        return params
+
+    # -- the (pre|de)fill step --------------------------------------------
+    def forward(self, params, tokens, pages, block_tables, context_lens,
+                q_lens):
+        """One serving step over a fixed-shape bucket.
+
+        tokens [S, T] int32; pages: list of (k_pages, v_pages) per
+        layer; block_tables [S, pages_per_seq] int32; context_lens [S]
+        int32 (INCLUDING this call's q_lens tokens); q_lens [S] int32
+        (0 = inactive slot: nothing written, zero logits, token 0).
+
+        Returns (next_tokens [S] int32 — greedy argmax at each
+        sequence's last valid row, last_logits [S, vocab] f32,
+        new_pages)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ..ops.pallas import ragged_paged_attention
+
+        c = self.config
+        S, T = tokens.shape
+        num_pages, page_size = pages[0][0].shape[:2]
+
+        def ln(x, g, b):
+            mu = jnp.mean(x, axis=-1, keepdims=True)
+            var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+            return (x - mu) * lax.rsqrt(var + 1e-6) * g + b
+
+        rowi = lax.broadcasted_iota(jnp.int32, (S, T), 1)
+        pos = (context_lens - q_lens)[:, None] + rowi      # [S, T]
+        valid = rowi < q_lens[:, None]
+        pos_c = jnp.clip(pos, 0, c.max_seq - 1)
+        # invalid rows write to page index num_pages -> scatter-dropped
+        page_of = jnp.take_along_axis(
+            block_tables, jnp.clip(pos_c // page_size, 0,
+                                   block_tables.shape[1] - 1), axis=1)
+        page_ids = jnp.where(valid, page_of, num_pages)
+        slot_ids = pos_c % page_size
+
+        x = params["emb"][tokens] + params["pos"][pos_c]   # [S, T, E]
+        new_pages: List = []
+        for layer, (k_pages, v_pages) in zip(params["layers"], pages):
+            h = ln(x, layer["ln1_g"], layer["ln1_b"])
+            q = (h @ layer["wq"]).reshape(S, T, c.heads, c.head_dim)
+            k = (h @ layer["wk"]).reshape(S, T, c.kv_heads, c.head_dim)
+            v = (h @ layer["wv"]).reshape(S, T, c.kv_heads, c.head_dim)
+            k_pages = k_pages.at[page_ids, slot_ids].set(
+                k.astype(k_pages.dtype), mode="drop")
+            v_pages = v_pages.at[page_ids, slot_ids].set(
+                v.astype(v_pages.dtype), mode="drop")
+            new_pages.append((k_pages, v_pages))
+            attn = ragged_paged_attention(
+                q, k_pages, v_pages, block_tables, context_lens,
+                q_lens, impl=self.attention_impl)
+            x = x + attn.reshape(S, T, c.heads * c.head_dim) @ layer["wo"]
+            h2 = ln(x, layer["ln2_g"], layer["ln2_b"])
+            x = x + jnp.maximum(h2 @ layer["w1"], 0.0) @ layer["w2"]
+
+        x = ln(x, params["lnf_g"], params["lnf_b"])
+        logits = x @ params["emb"].T                       # [S, T, V]
+        last = jnp.clip(q_lens - 1, 0, T - 1)
+        last_logits = jnp.take_along_axis(
+            logits, last[:, None, None], axis=1)[:, 0]     # [S, V]
+        active = (q_lens > 0)[:, None]
+        last_logits = jnp.where(active, last_logits, 0.0)
+        next_tokens = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+        return next_tokens, last_logits, new_pages
+
+
+def dense_decode_reference(model: TinyDecoderLM, params, prompt,
+                           max_new_tokens: int,
+                           eos_id: Optional[int] = None) -> List[int]:
+    """Greedy-decode ONE prompt with dense causal attention and no
+    paging — full-context logits recomputed per token (O(T^2); golden
+    only). Matches the serving semantics: first generated token comes
+    from the last prompt position."""
+    import jax.numpy as jnp
+
+    from ..ops.pallas import reference_attention
+
+    c = model.config
+
+    def logits_for(ids: np.ndarray) -> np.ndarray:
+        T = len(ids)
+        x = params["emb"][jnp.asarray(ids)] + params["pos"][:T]
+
+        def ln(x, g, b):
+            mu = jnp.mean(x, axis=-1, keepdims=True)
+            var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+            return (x - mu) / jnp.sqrt(var + 1e-6) * g + b
+
+        for layer in params["layers"]:
+            h = ln(x, layer["ln1_g"], layer["ln1_b"])
+            q = (h @ layer["wq"]).reshape(T, c.heads, c.head_dim)
+            k = (h @ layer["wk"]).reshape(T, c.kv_heads, c.head_dim)
+            v = (h @ layer["wv"]).reshape(T, c.kv_heads, c.head_dim)
+            g = c.heads // c.kv_heads
+            k = jnp.repeat(k, g, axis=1)
+            v = jnp.repeat(v, g, axis=1)
+            o = reference_attention(
+                q.transpose(1, 0, 2)[None], k.transpose(1, 0, 2)[None],
+                v.transpose(1, 0, 2)[None], causal=True)
+            x = x + o[0].transpose(1, 0, 2).reshape(
+                T, c.heads * c.head_dim) @ layer["wo"]
+            h2 = ln(x, layer["ln2_g"], layer["ln2_b"])
+            x = x + jnp.maximum(h2 @ layer["w1"], 0.0) @ layer["w2"]
+        x = ln(x, params["lnf_g"], params["lnf_b"])
+        return np.asarray(x[-1] @ params["emb"].T)
+
+    ids = list(int(t) for t in np.asarray(prompt).reshape(-1))
+    out: List[int] = []
+    for _ in range(int(max_new_tokens)):
+        tok = int(np.argmax(logits_for(np.asarray(ids, np.int32))))
+        out.append(tok)
+        if eos_id is not None and tok == eos_id:
+            break
+        ids.append(tok)
+    return out

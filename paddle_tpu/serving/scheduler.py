@@ -1,0 +1,311 @@
+"""Continuous (in-flight) batching scheduler.
+
+Requests are admitted and retired BETWEEN decode steps — the engine
+never drains its batch to refill it. Each engine step:
+
+1. retire finished/cancelled requests (KV pages freed immediately);
+2. admit queued requests FCFS while a slot (< max_seqs) and worst-case
+   KV pages are available — otherwise the queue backpressures;
+3. prefill admitted-but-unprefilled requests in prompt-length-bucketed
+   chunks (prompts longer than the largest bucket prefill in several
+   chunks through the same unified step);
+4. decode every running request in one fixed-shape bucket (the
+   smallest configured batch bucket >= n, inactive slots padded with
+   q_len = 0).
+
+Every dispatch shape is therefore drawn from the finite bucket set —
+the set `Engine.warmup()` AOT-compiles through the persistent compile
+cache, so a serving restart is all-hit before first traffic.
+"""
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["Request", "RequestState", "BucketPlan", "Scheduler"]
+
+_STREAM_END = object()
+
+
+class RequestState:
+    QUEUED = "queued"
+    PREFILL = "prefill"    # admitted; pages reserved; prompt not fully in
+    RUNNING = "running"    # decoding
+    FINISHED = "finished"
+    CANCELLED = "cancelled"
+
+
+@dataclass
+class Request:
+    """One generation request. The engine owns all mutation; consumers
+    read the stream via `next_token()` / `stream()` / `result()`."""
+
+    request_id: int
+    prompt: np.ndarray                     # [prompt_len] int32
+    max_new_tokens: int
+    eos_id: Optional[int] = None
+    tenant: str = ""
+    state: str = RequestState.QUEUED
+    output_tokens: List[int] = field(default_factory=list)
+    # engine-side sequence bookkeeping
+    context_len: int = 0                   # tokens whose KV is cached
+    prefilled: int = 0                     # prompt tokens consumed
+    last_token: Optional[int] = None       # next decode input
+    t_submit: float = field(default_factory=time.time)
+    t_first_token: Optional[float] = None
+    t_finish: Optional[float] = None
+    _stream: "_queue.Queue" = field(default_factory=_queue.Queue,
+                                    repr=False)
+    _cancel: threading.Event = field(default_factory=threading.Event,
+                                     repr=False)
+
+    @property
+    def prompt_len(self) -> int:
+        return int(self.prompt.shape[0])
+
+    @property
+    def done(self) -> bool:
+        return self.state in (RequestState.FINISHED,
+                              RequestState.CANCELLED)
+
+    # -- consumer surface --------------------------------------------------
+    def cancel(self) -> None:
+        """Request cancellation; the engine retires the request (and
+        frees its KV pages) at the next step boundary."""
+        self._cancel.set()
+
+    def next_token(self, timeout: Optional[float] = None):
+        """Blocking stream read: the next generated token id, or None
+        at end of stream."""
+        item = self._stream.get(timeout=timeout)
+        return None if item is _STREAM_END else item
+
+    def stream(self, timeout: Optional[float] = None):
+        """Iterate generated tokens as they land (ends on finish or
+        cancel)."""
+        while True:
+            tok = self.next_token(timeout=timeout)
+            if tok is None:
+                return
+            yield tok
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Drain the stream and return the full output token list."""
+        for _ in self.stream(timeout=timeout):
+            pass
+        return list(self.output_tokens)
+
+    # -- engine-side helpers ----------------------------------------------
+    def _emit(self, token: int) -> None:
+        self.output_tokens.append(int(token))
+        if self.t_first_token is None:
+            self.t_first_token = time.time()
+        self._stream.put(int(token))
+
+    def _close_stream(self) -> None:
+        self._stream.put(_STREAM_END)
+
+
+@dataclass(frozen=True)
+class BucketPlan:
+    """The finite dispatch-shape set: decode buckets are batch sizes at
+    T=1; prefill buckets are (batch, chunk-token) pairs."""
+
+    decode_batches: Tuple[int, ...]
+    prefill_tokens: Tuple[int, ...]
+    prefill_batch: int
+
+    @staticmethod
+    def from_flags(max_seqs: int,
+                   max_context: Optional[int] = None) -> "BucketPlan":
+        from ..utils.flags import get_flag
+
+        def parse(name, default):
+            raw = str(get_flag(name, default) or default)
+            vals = sorted({int(v) for v in raw.split(",") if v.strip()})
+            if not vals or min(vals) < 1:
+                raise ValueError("%s must list positive ints, got %r"
+                                 % (name, raw))
+            return vals
+
+        decode = [b for b in parse("FLAGS_tpu_serving_decode_buckets",
+                                   "2,4,8") if b <= max_seqs]
+        if not decode or max(decode) < max_seqs:
+            decode.append(max_seqs)
+        # min bucket >= 2: XLA:CPU's batch-1 gemv rounds differently
+        # from the same row in a batched gemm; the bit-identical
+        # batched-vs-sequential contract needs uniform per-row math
+        decode = sorted({max(2, b) for b in decode})
+        prefill = parse("FLAGS_tpu_serving_prefill_buckets", "16,64")
+        if max_context:
+            # a chunk can never exceed the engine's max context; keep
+            # at least one bucket (clamped) so short-context engines
+            # don't compile dead shapes
+            kept = [t for t in prefill if t <= max_context]
+            prefill = kept or [int(max_context)]
+        return BucketPlan(decode_batches=tuple(decode),
+                         prefill_tokens=tuple(prefill),
+                         prefill_batch=max(2, min(4, max_seqs)))
+
+    def decode_bucket(self, n: int) -> int:
+        for b in self.decode_batches:
+            if b >= n:
+                return b
+        return self.decode_batches[-1]
+
+    def prefill_bucket(self, chunk_len: int) -> int:
+        for t in self.prefill_tokens:
+            if t >= chunk_len:
+                return t
+        return self.prefill_tokens[-1]
+
+    @property
+    def max_prefill_chunk(self) -> int:
+        return self.prefill_tokens[-1]
+
+    def all_buckets(self) -> List[Tuple[int, int]]:
+        """Every (batch, T) dispatch shape the engine can issue — the
+        warmup set."""
+        out = [(b, 1) for b in self.decode_batches]
+        out.extend((self.prefill_batch, t) for t in self.prefill_tokens)
+        return out
+
+
+class Scheduler:
+    """Queue + running-set bookkeeping. All methods are called by the
+    engine under its lock; the only cross-thread surface is `submit`'s
+    queue append (also engine-locked)."""
+
+    def __init__(self, kv_cache, plan: BucketPlan, max_seqs: int,
+                 max_queue: int = 0, max_context: Optional[int] = None):
+        self.kv = kv_cache
+        self.plan = plan
+        self.max_seqs = int(max_seqs)
+        self.max_queue = int(max_queue)
+        # the TRUE per-request context bound: the model's max_seq can
+        # be tighter than the page-rounded pool bound (pages_per_seq *
+        # page_size rounds UP) — admitting past it would clip
+        # positions in the model and silently collide KV slots
+        self.max_context = min(int(max_context), kv_cache.config.
+                               max_context) if max_context else \
+            kv_cache.config.max_context
+        self.queued: deque = deque()
+        self.running: Dict[int, Request] = {}  # admitted (prefill+decode)
+        self._ids = itertools.count()
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.queued)
+
+    def new_request(self, prompt, max_new_tokens, eos_id=None,
+                    tenant="") -> Request:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        total = prompt.size + int(max_new_tokens)
+        if total > self.max_context:
+            raise ValueError(
+                "prompt %d + max_new %d exceeds max context %d"
+                % (prompt.size, max_new_tokens, self.max_context))
+        if self.max_queue and len(self.queued) >= self.max_queue:
+            raise RuntimeError(
+                "serving queue full (%d) — FLAGS_tpu_serving_max_queue"
+                % self.max_queue)
+        req = Request(request_id=next(self._ids), prompt=prompt,
+                      max_new_tokens=int(max_new_tokens), eos_id=eos_id,
+                      tenant=str(tenant))
+        self.queued.append(req)
+        return req
+
+    # -- step phases -------------------------------------------------------
+    def admit(self) -> List[Request]:
+        """FCFS admission: reserve worst-case KV pages; stop at the
+        first request the pool or the slot budget cannot take (strict
+        FCFS — later smaller requests do not jump the queue)."""
+        admitted = []
+        while self.queued and len(self.running) < self.max_seqs:
+            req = self.queued[0]
+            pages = self.kv.alloc(
+                req.request_id, req.prompt_len + req.max_new_tokens)
+            if pages is None:
+                break  # admission backpressure: pool exhausted
+            self.queued.popleft()
+            req.state = RequestState.PREFILL
+            self.running[req.request_id] = req
+            admitted.append(req)
+        return admitted
+
+    def prefill_group(self) -> Tuple[List[Request], int, int]:
+        """The next prefill dispatch: up to prefill_batch requests with
+        prompt tokens still to consume, chunked to one (batch, T)
+        bucket. Returns ([], 0, 0) when nothing needs prefill."""
+        pending = [r for r in self.running.values()
+                   if r.state == RequestState.PREFILL
+                   and not r._cancel.is_set()]
+        if not pending:
+            return [], 0, 0
+        pending.sort(key=lambda r: r.request_id)
+        group = pending[:self.plan.prefill_batch]
+        chunk = min(self.plan.max_prefill_chunk,
+                    max(r.prompt_len - r.prefilled for r in group))
+        return group, self.plan.prefill_batch, \
+            self.plan.prefill_bucket(chunk)
+
+    def decode_group(self) -> Tuple[List[Request], int]:
+        """Every running (fully prefilled, uncancelled) request plus
+        the bucket to pad to."""
+        group = [r for r in self.running.values()
+                 if r.state == RequestState.RUNNING
+                 and not r._cancel.is_set()]
+        group.sort(key=lambda r: r.request_id)
+        if not group:
+            return [], 0
+        return group, self.plan.decode_bucket(len(group))
+
+    def retire(self) -> List[Request]:
+        """Drop finished/cancelled requests from the running set and
+        free their pages (cancel eviction is immediate). Cancelled
+        requests still sitting in the QUEUE drain here too — retire()
+        is the one place whose return the engine publishes, so every
+        cancellation produces exactly one serving_request event."""
+        out = []
+        for req in [r for r in self.queued if r._cancel.is_set()]:
+            self.queued.remove(req)
+            self._finish(req, RequestState.CANCELLED)
+            out.append(req)
+        for rid in list(self.running):
+            req = self.running[rid]
+            if req._cancel.is_set() and not req.done:
+                self._finish(req, RequestState.CANCELLED)
+            if req.done:
+                del self.running[rid]
+                self.kv.free(rid)
+                out.append(req)
+        return out
+
+    def _finish(self, req: Request, state: str) -> None:
+        req.state = state
+        req.t_finish = time.time()
+        req._close_stream()
+
+    def finish_if_done(self, req: Request) -> bool:
+        """Apply the stop conditions after a token landed."""
+        if req._cancel.is_set():
+            return False  # retire() handles cancellation
+        hit_eos = (req.eos_id is not None and req.output_tokens
+                   and req.output_tokens[-1] == req.eos_id)
+        if hit_eos or len(req.output_tokens) >= req.max_new_tokens:
+            self._finish(req, RequestState.FINISHED)
+            return True
+        return False
+
+    @property
+    def idle(self) -> bool:
+        return not self.queued and not self.running

@@ -184,6 +184,38 @@ _FLAGS: Dict[str, object] = {
     # elastic run shows degradation BEFORE it dies, instead of only in
     # the end-of-run report
     "FLAGS_tpu_telemetry_window": 32,
+    # -- inference serving runtime (paddle_tpu/serving) ----------------
+    # tokens per KV-cache page (HBM block). Pages are the allocation
+    # unit of the paged KV cache: every live request owns
+    # ceil(context/page_size) pages named by its block table.
+    "FLAGS_tpu_serving_page_size": 16,
+    # total pages in the KV pool (capacity = num_pages * page_size
+    # cached tokens across all live requests). Admission backpressures
+    # when a request's worst-case page need exceeds the free pool.
+    "FLAGS_tpu_serving_num_pages": 512,
+    # max concurrently running requests (decode batch upper bound)
+    "FLAGS_tpu_serving_max_seqs": 8,
+    # decode-step batch buckets (comma-separated, ascending): each
+    # engine step pads the running set up to the smallest bucket >= n,
+    # so every decode dispatch is one of these AOT-compiled fixed
+    # shapes. The minimum bucket is clamped to >= 2: XLA:CPU's
+    # batch-1 matmul (gemv) rounds differently from the same row
+    # inside a larger batch, and the bit-identical
+    # batched-vs-sequential decoding contract needs every bucket to
+    # produce identical per-row results.
+    "FLAGS_tpu_serving_decode_buckets": "2,4,8",
+    # prefill token buckets (comma-separated, ascending): prompt
+    # chunks are padded to the smallest bucket >= the chunk length;
+    # prompts longer than the largest bucket prefill in chunks.
+    "FLAGS_tpu_serving_prefill_buckets": "16,64",
+    # ragged paged attention implementation: "auto" = Pallas kernel on
+    # TPU, jittable pure-JAX reference elsewhere (the Pallas
+    # interpreter is grid-sequential — parity-test only);
+    # "kernel" / "reference" force one side.
+    "FLAGS_tpu_serving_attention_impl": "auto",
+    # submit() backpressure: max queued (not yet admitted) requests;
+    # 0 = unbounded (submit never blocks the caller)
+    "FLAGS_tpu_serving_max_queue": 0,
 }
 
 

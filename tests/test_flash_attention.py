@@ -204,3 +204,48 @@ def test_dropout_zero_p_matches_plain():
     np.testing.assert_allclose(a, b)
     with pytest.raises(ValueError):
         flash_attention(q, k, v, dropout_p=0.5)  # seed required
+
+
+# -- decode shapes (serving): q_len=1 and ragged batches --------------------
+
+def test_decode_q_len_1_matches_reference():
+    """The serving decode shape: ONE query row against a long cached
+    context (q block pads 1 -> 8 internally; the kernel must not read
+    garbage from the padded rows)."""
+    rng = np.random.default_rng(12)
+    q, k, v = _rand_qkv(rng, 2, 2, 1, 256, 64)
+    out = flash_attention(q, k, v)
+    ref = reference_attention(q, k, v)
+    assert out.shape == (2, 2, 1, 64)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_decode_ragged_batch_via_key_bias():
+    """A ragged decode batch: every row q_len=1 but each sequence has
+    a different live context length, expressed as the additive key
+    padding bias (the pre-paging serving idiom) — rows must match the
+    per-sequence dense truth, dead keys contribute nothing."""
+    rng = np.random.default_rng(13)
+    B, H, Sk, D = 3, 2, 192, 64
+    q, k, v = _rand_qkv(rng, B, H, 1, Sk, D)
+    lens = [192, 7, 64]
+    mask = np.zeros((B, Sk), np.float32)
+    for b, n in enumerate(lens):
+        mask[b, :n] = 1.0
+    bias = jnp.asarray((mask - 1.0) * 1e4)
+    out = np.asarray(flash_attention(q, k, v, key_bias=bias))
+    for b, n in enumerate(lens):
+        ref = reference_attention(q[b:b + 1], k[b:b + 1, :, :n],
+                                  v[b:b + 1, :, :n])
+        np.testing.assert_allclose(out[b], np.asarray(ref)[0],
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_decode_q_len_1_unaligned_context():
+    """q_len=1 with a context that is not a multiple of the k block
+    (the auto-pad path must mask the padded tail keys)."""
+    rng = np.random.default_rng(14)
+    q, k, v = _rand_qkv(rng, 1, 2, 1, 100, 64)
+    out = flash_attention(q, k, v, block_k=64)
+    ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)

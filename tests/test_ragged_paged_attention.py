@@ -1,0 +1,166 @@
+"""Golden tests for the ragged paged attention kernel (Pallas
+interpreter on the CPU test mesh) and its pure-JAX reference, against
+dense `reference_attention` semantics on mixed-length batches —
+including q_len=1 decode rows, GQA head groups, page-boundary
+crossings and inactive (q_len=0) batch slots."""
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas import (ragged_paged_attention,
+                                   ragged_paged_attention_reference,
+                                   reference_attention)
+
+PAGE = 8
+
+
+def _paged_setup(rng, seqs, Q, Hq, Hkv, D, npages, num_pages=None):
+    """Build a paged cache holding `seqs` = [(ctx_len, q_len), ...]:
+    per-seq contiguous K/V of ctx_len tokens scattered into randomly
+    ordered pages, plus the dense copies for the golden check."""
+    S = len(seqs)
+    P = num_pages or (S * npages + 3)
+    k_pages = rng.standard_normal((P, PAGE, Hkv, D)).astype(np.float32)
+    v_pages = rng.standard_normal((P, PAGE, Hkv, D)).astype(np.float32)
+    tables = np.zeros((S, npages), np.int32)
+    perm = rng.permutation(P - 1) + 1  # page 0 stays a pad target
+    dense_k = np.zeros((S, npages * PAGE, Hkv, D), np.float32)
+    dense_v = np.zeros_like(dense_k)
+    next_free = 0
+    for s, (ctx, _) in enumerate(seqs):
+        used = -(-ctx // PAGE)
+        for j in range(npages):
+            if j < used:
+                tables[s, j] = perm[next_free]
+                next_free += 1
+        dense_k[s] = k_pages[tables[s]].reshape(-1, Hkv, D)
+        dense_v[s] = v_pages[tables[s]].reshape(-1, Hkv, D)
+    q = rng.standard_normal((S, Q, Hq, D)).astype(np.float32)
+    ctx_lens = np.array([c for c, _ in seqs], np.int32)
+    q_lens = np.array([q_ for _, q_ in seqs], np.int32)
+    return (jnp.asarray(q), jnp.asarray(k_pages), jnp.asarray(v_pages),
+            jnp.asarray(tables), jnp.asarray(ctx_lens),
+            jnp.asarray(q_lens), dense_k, dense_v)
+
+
+def _dense_golden(q, dense_k, dense_v, ctx_lens, q_lens):
+    """Per-sequence dense causal attention over the real context via
+    the flash module's golden `reference_attention`."""
+    S, Q, Hq, D = q.shape
+    Hkv = dense_k.shape[2]
+    G = Hq // Hkv
+    out = np.zeros((S, Q, Hq, D), np.float32)
+    for s in range(S):
+        ctx, ql = int(ctx_lens[s]), int(q_lens[s])
+        if ql == 0:
+            continue
+        k = np.repeat(dense_k[s, :ctx], G, axis=1)  # [ctx, Hq, D]
+        v = np.repeat(dense_v[s, :ctx], G, axis=1)
+        qs = np.asarray(q)[s, :ql]                  # [ql, Hq, D]
+        # absolute positions: the causal mask of a [ctx, ctx] problem
+        # restricted to the last ql query rows
+        full_q = np.zeros((ctx, Hq, D), np.float32)
+        full_q[ctx - ql:] = qs
+        o = reference_attention(
+            jnp.asarray(full_q.transpose(1, 0, 2)[None]),
+            jnp.asarray(k.transpose(1, 0, 2)[None]),
+            jnp.asarray(v.transpose(1, 0, 2)[None]),
+            causal=True, sm_scale=1.0 / math.sqrt(D))
+        out[s, :ql] = np.asarray(o)[0].transpose(1, 0, 2)[ctx - ql:]
+    return out
+
+
+@pytest.mark.parametrize("impl", ["reference", "kernel"])
+def test_mixed_prefill_decode_matches_dense(impl):
+    """One call over a batch mixing a long prefill, a mid prefill, a
+    q_len=1 decode and a page-boundary-straddling decode."""
+    rng = np.random.default_rng(0)
+    seqs = [(24, 24), (13, 13), (17, 1), (8, 1)]  # (ctx, q_len)
+    q, kp, vp, tbl, ctx, ql, dk, dv = _paged_setup(
+        rng, seqs, Q=24, Hq=2, Hkv=2, D=16, npages=4)
+    out = ragged_paged_attention(q, kp, vp, tbl, ctx, ql, impl=impl)
+    golden = _dense_golden(q, dk, dv, ctx, ql)
+    valid = np.zeros(out.shape, bool)
+    for s, (c, n) in enumerate(seqs):
+        valid[s, :n] = True
+    np.testing.assert_allclose(np.asarray(out)[valid], golden[valid],
+                               atol=2e-5, rtol=2e-5)
+    # rows past q_lens are defined zeros (padded bucket slots)
+    assert not np.asarray(out)[~valid].any()
+
+
+@pytest.mark.parametrize("impl", ["reference", "kernel"])
+def test_decode_only_bucket(impl):
+    """Pure decode (Q=1) at ragged context lengths, including a
+    context that exactly fills its last page."""
+    rng = np.random.default_rng(1)
+    seqs = [(PAGE * 3, 1), (5, 1), (PAGE, 1), (PAGE + 1, 1)]
+    q, kp, vp, tbl, ctx, ql, dk, dv = _paged_setup(
+        rng, seqs, Q=1, Hq=4, Hkv=4, D=8, npages=4)
+    out = ragged_paged_attention(q, kp, vp, tbl, ctx, ql, impl=impl)
+    golden = _dense_golden(q, dk, dv, ctx, ql)
+    np.testing.assert_allclose(np.asarray(out), golden,
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("impl", ["reference", "kernel"])
+def test_gqa_grouped_heads(impl):
+    """Hq=4 query heads over Hkv=2 kv heads: head h reads kv head
+    h // 2 (verified against the repeated-kv dense golden)."""
+    rng = np.random.default_rng(2)
+    seqs = [(10, 3), (20, 1)]
+    q, kp, vp, tbl, ctx, ql, dk, dv = _paged_setup(
+        rng, seqs, Q=3, Hq=4, Hkv=2, D=16, npages=3)
+    out = ragged_paged_attention(q, kp, vp, tbl, ctx, ql, impl=impl)
+    golden = _dense_golden(q, dk, dv, ctx, ql)
+    valid = np.zeros(out.shape, bool)
+    valid[0, :3] = True
+    valid[1, :1] = True
+    np.testing.assert_allclose(np.asarray(out)[valid], golden[valid],
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("impl", ["reference", "kernel"])
+def test_inactive_slot_returns_zeros(impl):
+    """q_lens == 0 (an inactive bucket slot): zero output, no NaN —
+    the contract the engine's padded decode buckets rely on."""
+    rng = np.random.default_rng(3)
+    seqs = [(12, 1), (0, 0)]
+    q, kp, vp, tbl, ctx, ql, dk, dv = _paged_setup(
+        rng, seqs, Q=1, Hq=2, Hkv=2, D=8, npages=2)
+    out = np.asarray(ragged_paged_attention(q, kp, vp, tbl, ctx, ql,
+                                            impl=impl))
+    assert np.isfinite(out).all()
+    assert not out[1].any()
+    golden = _dense_golden(q, dk, dv, ctx, ql)
+    np.testing.assert_allclose(out[0], golden[0], atol=2e-5, rtol=2e-5)
+
+
+def test_kernel_matches_reference_exactly_shaped():
+    """Kernel vs pure-JAX reference at identical inputs (fp32
+    tolerance; the two implement one contract)."""
+    rng = np.random.default_rng(4)
+    seqs = [(30, 7), (3, 2), (16, 1)]
+    q, kp, vp, tbl, ctx, ql, _, _ = _paged_setup(
+        rng, seqs, Q=7, Hq=2, Hkv=1, D=32, npages=4)
+    a = ragged_paged_attention(q, kp, vp, tbl, ctx, ql, impl="kernel")
+    b = ragged_paged_attention(q, kp, vp, tbl, ctx, ql,
+                               impl="reference")
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_arg_validation():
+    rng = np.random.default_rng(5)
+    q, kp, vp, tbl, ctx, ql, _, _ = _paged_setup(
+        rng, [(8, 1)], Q=1, Hq=3, Hkv=2, D=8, npages=2)
+    with pytest.raises(ValueError, match="multiple"):
+        ragged_paged_attention(q, kp, vp, tbl, ctx, ql)
+    q2 = jnp.zeros((1, 1, 2, 8), jnp.float32)
+    with pytest.raises(ValueError, match="context_lens"):
+        ragged_paged_attention(q2, kp, vp, tbl,
+                               jnp.zeros((2,), jnp.int32), None)
+    with pytest.raises(ValueError, match="impl"):
+        ragged_paged_attention(q2, kp, vp, tbl, ctx, impl="nope")

@@ -1,0 +1,453 @@
+"""Serving runtime tests (paddle_tpu/serving): paged KV cache
+accounting, continuous-batching correctness — token streams
+BIT-IDENTICAL to sequential per-request decoding and exact against the
+dense no-paging reference — block-table edge cases (page-boundary
+crossing, chunked prefill), full-pool admission backpressure, cancel
+eviction, AOT warmup all-hit through the persistent compile cache,
+the registry-assembled bench ``serving`` block, telemetry schema
+validity of serving_request/serving_step, and the tpu-lint
+serving_decode exemplar's deliberate-defect twin (a fetch seeded into
+the decode scan must fire the host-sync checker)."""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu import serving
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_DIR)
+
+MODEL_CFG = serving.TinyLMConfig(vocab=48, embed=24, layers=2, heads=2,
+                                 kv_heads=2, head_dim=8, ffn=48,
+                                 max_seq=48)
+#: ONE model instance per run: engines over it share the jitted step,
+#: so the many-engine tests don't recompile per engine
+_MODEL = serving.TinyDecoderLM(MODEL_CFG)
+_PARAMS = None
+
+
+def _params():
+    global _PARAMS
+    if _PARAMS is None:
+        _PARAMS = _MODEL.init_params(seed=3)
+    return _PARAMS
+
+
+def _engine(**over):
+    cfg = dict(num_pages=96, page_size=4, max_seqs=6)
+    cfg.update(over)
+    return serving.Engine(_MODEL, params=_params(),
+                          config=serving.EngineConfig(**cfg))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    obs.reset_registry()
+    yield
+    obs.reset_registry()
+
+
+# -- paged KV cache ---------------------------------------------------------
+
+def test_kv_cache_alloc_free_occupancy():
+    cfg = serving.KVCacheConfig(num_pages=10, page_size=4,
+                                pages_per_seq=5, num_layers=1,
+                                num_kv_heads=1, head_dim=8)
+    kv = serving.PagedKVCache(cfg)
+    assert kv.pages_free == 10 and kv.occupancy == 0.0
+    p0 = kv.alloc(0, 9)             # ceil(9/4) = 3 pages
+    assert len(p0) == 3 and kv.pages_in_use == 3
+    p1 = kv.alloc(1, 4)             # exactly one page boundary
+    assert len(p1) == 1
+    assert set(p0).isdisjoint(p1)
+    assert kv.block_table(0) == p0
+    assert kv.peak_pages_in_use == 4
+    assert kv.free(0) == 3
+    assert kv.pages_in_use == 1 and kv.free(0) == 0  # idempotent
+    with pytest.raises(ValueError, match="already"):
+        kv.alloc(1, 2)
+    with pytest.raises(ValueError, match="max_context"):
+        kv.alloc(2, 21)             # > pages_per_seq * page_size
+
+
+def test_kv_cache_admission_backpressure():
+    cfg = serving.KVCacheConfig(num_pages=4, page_size=4,
+                                pages_per_seq=4, num_layers=1,
+                                num_kv_heads=1, head_dim=8)
+    kv = serving.PagedKVCache(cfg)
+    assert kv.alloc(0, 12) is not None      # 3 of 4 pages
+    assert not kv.can_admit(8)
+    assert kv.alloc(1, 8) is None           # pool can't cover 2 pages
+    assert kv.alloc(2, 4) is not None       # but 1 page still fits
+    kv.free(0)
+    assert kv.can_admit(8)
+
+
+# -- engine correctness -----------------------------------------------------
+
+def test_single_request_matches_dense_reference():
+    """Engine greedy stream == dense (no paging, no engine) decode,
+    including EOS stop."""
+    eng = _engine()
+    r = np.random.RandomState(0)
+    prompt = r.randint(0, 48, size=7).astype(np.int32)
+    req = eng.submit(prompt, max_new_tokens=10)
+    eng.run_until_idle()
+    ref = serving.dense_decode_reference(_MODEL, _params(), prompt, 10)
+    assert req.output_tokens == ref
+    # EOS: pick the first generated token as eos -> stream stops at 1
+    eos = ref[0]
+    eng2 = _engine()
+    req2 = eng2.submit(prompt, max_new_tokens=10, eos_id=eos)
+    eng2.run_until_idle()
+    assert req2.output_tokens == [eos]
+    assert req2.state == serving.RequestState.FINISHED
+
+
+def test_continuous_batching_bit_identical_to_sequential():
+    """THE acceptance property: staggered concurrent requests through
+    the continuous-batching engine produce token streams bit-identical
+    to decoding each request alone (fresh engine, same weights)."""
+    r = np.random.RandomState(1)
+    prompts = [r.randint(0, 48, size=n).astype(np.int32)
+               for n in (5, 17, 3, 9, 21, 2, 7)]
+    maxnew = [6, 9, 4, 12, 5, 8, 7]
+    arrive = [0, 0, 1, 2, 2, 5, 7]
+
+    eng = _engine()
+    reqs, i, step = [], 0, 0
+    while i < len(prompts) or not eng.scheduler.idle:
+        while i < len(prompts) and arrive[i] <= step:
+            reqs.append(eng.submit(prompts[i], max_new_tokens=maxnew[i]))
+            i += 1
+        eng.step()
+        step += 1
+    batched = [list(q.output_tokens) for q in reqs]
+    assert all(len(b) == m for b, m in zip(batched, maxnew))
+
+    sequential = []
+    for p, m in zip(prompts, maxnew):
+        e = _engine()
+        q = e.submit(p, max_new_tokens=m)
+        e.run_until_idle()
+        sequential.append(list(q.output_tokens))
+    assert batched == sequential
+
+
+def test_page_boundary_crossing_and_chunked_prefill():
+    """A prompt longer than the largest prefill bucket (16 here, after
+    the max-context clamp) prefills in chunks, and decode repeatedly
+    crosses page boundaries (page_size=4) — stream still exact vs the
+    dense reference."""
+    eng = _engine()
+    assert eng.plan.max_prefill_chunk == 16
+    r = np.random.RandomState(2)
+    prompt = r.randint(0, 48, size=21).astype(np.int32)  # 2 chunks
+    req = eng.submit(prompt, max_new_tokens=13)          # crosses pages
+    eng.run_until_idle()
+    ref = serving.dense_decode_reference(_MODEL, _params(), prompt, 13)
+    assert req.output_tokens == ref
+    assert eng.kv.pages_in_use == 0  # retired -> freed
+
+
+def test_full_pool_admission_backpressure():
+    """Pool sized for ~1 request: later submissions queue (depth gauge
+    rises) and admit only as earlier requests retire; all finish with
+    the same streams they'd produce alone."""
+    eng = _engine(num_pages=6, max_seqs=6)  # 6*4 = 24 tokens of pool
+    r = np.random.RandomState(3)
+    prompts = [r.randint(0, 48, size=8).astype(np.int32)
+               for _ in range(3)]
+    reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]  # 4 pages
+    depth_seen = 0
+    steps = 0
+    while not eng.scheduler.idle and steps < 200:
+        stats = eng.step()
+        depth_seen = max(depth_seen, stats["queue_depth"])
+        assert eng.kv.pages_in_use <= 6
+        steps += 1
+    assert depth_seen >= 1  # backpressure actually engaged
+    assert all(q.state == serving.RequestState.FINISHED for q in reqs)
+    solo = []
+    for p in prompts:
+        e = _engine()
+        q = e.submit(p, max_new_tokens=8)
+        e.run_until_idle()
+        solo.append(list(q.output_tokens))
+    assert [list(q.output_tokens) for q in reqs] == solo
+
+
+def test_cancel_evicts_pages_mid_decode():
+    eng = _engine()
+    r = np.random.RandomState(4)
+    keep = eng.submit(r.randint(0, 48, size=6).astype(np.int32),
+                      max_new_tokens=20)
+    kill = eng.submit(r.randint(0, 48, size=6).astype(np.int32),
+                      max_new_tokens=20)
+    for _ in range(3):
+        eng.step()
+    assert kill.output_tokens  # decoding underway
+    in_use_before = eng.kv.pages_in_use
+    eng.cancel(kill)
+    eng.step()  # retire happens at the step boundary
+    assert kill.state == serving.RequestState.CANCELLED
+    assert eng.kv.pages_in_use < in_use_before
+    got = list(kill.stream())  # stream closed, yields the partial set
+    assert got == kill.output_tokens
+    eng.run_until_idle()
+    assert keep.state == serving.RequestState.FINISHED
+    assert len(keep.output_tokens) == 20
+    assert eng.kv.pages_in_use == 0
+    # the cancelled request's telemetry says cancelled
+    snap = obs.registry().snapshot()
+    assert snap["counters"]["serving.requests_cancelled"] == 1
+
+
+def test_submit_validation_and_queue_bound():
+    eng = _engine(max_queue=1)
+    with pytest.raises(ValueError, match="empty"):
+        eng.submit(np.zeros((0,), np.int32))
+    with pytest.raises(ValueError, match="max context"):
+        eng.submit(np.zeros((40,), np.int32), max_new_tokens=40)
+    eng.submit(np.zeros((4,), np.int32), max_new_tokens=2)
+    with pytest.raises(RuntimeError, match="queue full"):
+        eng.submit(np.zeros((4,), np.int32), max_new_tokens=2)
+    eng.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        eng.submit(np.zeros((4,), np.int32))
+
+
+def test_over_length_request_rejected_at_model_max_seq():
+    """Page rounding makes the pool bound looser than the model's
+    max_seq (ceil(20/8)*8 = 24): admission must reject against the
+    MODEL bound, or positions would clip and KV slots collide."""
+    model = serving.TinyDecoderLM(serving.TinyLMConfig(
+        vocab=32, embed=16, layers=1, heads=2, kv_heads=2, head_dim=8,
+        ffn=32, max_seq=20))
+    eng = serving.Engine(model, config=serving.EngineConfig(
+        num_pages=16, page_size=8, max_seqs=2))
+    assert eng.kv.config.max_context == 24  # pool bound, rounded up
+    with pytest.raises(ValueError, match="max context"):
+        eng.submit(np.zeros((15,), np.int32), max_new_tokens=7)  # 22>20
+    eng.submit(np.zeros((15,), np.int32), max_new_tokens=5)      # ==20
+
+
+def test_cancel_while_queued_publishes_event():
+    """A request cancelled BEFORE admission still produces its
+    serving_request event and the cancelled counter — submitted ==
+    finished + cancelled must reconcile for the bench block."""
+    eng = _engine(max_seqs=2)
+    a = eng.submit(np.arange(4, dtype=np.int32), max_new_tokens=6)
+    b = eng.submit(np.arange(5, dtype=np.int32), max_new_tokens=6)
+    c = eng.submit(np.arange(6, dtype=np.int32), max_new_tokens=6)
+    eng.step()  # a, b admitted; c queued behind max_seqs
+    assert c.state == serving.RequestState.QUEUED
+    eng.cancel(c)
+    eng.step()
+    assert c.state == serving.RequestState.CANCELLED
+    eng.run_until_idle()
+    reg = obs.registry()
+    snap = reg.snapshot()["counters"]
+    assert snap["serving.requests_submitted"] == 3
+    assert snap["serving.requests_finished"] == 2
+    assert snap["serving.requests_cancelled"] == 1
+    assert snap["event.serving_request"] == 3
+    assert a.state == b.state == serving.RequestState.FINISHED
+
+
+def test_attention_impl_conflict_raises():
+    model = serving.TinyDecoderLM(serving.TinyLMConfig(
+        vocab=32, embed=16, layers=1, heads=2, kv_heads=2, head_dim=8,
+        ffn=32, max_seq=16), attention_impl="reference")
+    with pytest.raises(ValueError, match="conflicts"):
+        serving.Engine(model, config=serving.EngineConfig(
+            num_pages=8, page_size=4, max_seqs=2,
+            attention_impl="kernel"))
+
+
+def test_close_cancels_everything():
+    eng = _engine()
+    a = eng.submit(np.arange(5, dtype=np.int32), max_new_tokens=30)
+    b = eng.submit(np.arange(4, dtype=np.int32), max_new_tokens=30)
+    eng.step()
+    eng.close()
+    assert a.state == serving.RequestState.CANCELLED
+    assert b.state == serving.RequestState.CANCELLED
+    assert eng.kv.pages_in_use == 0
+    assert a.result() == a.output_tokens  # streams closed, no hang
+
+
+# -- AOT warmup through the persistent compile cache ------------------------
+
+def test_warmup_all_hit_on_restart(tmp_path):
+    """Cold engine warmup: every bucket a classified MISS; a second
+    engine (the restarted serving process) warms ALL-HIT from the
+    fingerprint index — with serving_decode/serving_prefill sources."""
+    from paddle_tpu.fluid import compile_cache as cc
+    from paddle_tpu.utils.flags import get_flag, set_flags
+
+    old = get_flag("FLAGS_tpu_compile_cache_dir")
+    set_flags({"FLAGS_tpu_compile_cache_dir": str(tmp_path / "cc")})
+    cc._reset_for_tests()
+    try:
+        model = serving.TinyDecoderLM(serving.TinyLMConfig(
+            vocab=32, embed=16, layers=1, heads=2, kv_heads=2,
+            head_dim=8, ffn=32, max_seq=16))
+        cfg = serving.EngineConfig(num_pages=16, page_size=4,
+                                   max_seqs=2)
+        cold = serving.Engine(model, config=cfg, seed=0).warmup()
+        assert cold["misses"] == len(cold["buckets"])
+        assert cold["hits"] == 0 and cold["unclassified"] == 0
+        warm = serving.Engine(serving.TinyDecoderLM(model.config),
+                              config=cfg, seed=0).warmup()
+        assert warm["hits"] == len(warm["buckets"])
+        assert warm["misses"] == 0
+        reg = obs.registry()
+        assert reg.counter("event.compile_cache").value >= \
+            2 * len(cold["buckets"])
+    finally:
+        cc.disable()
+        set_flags({"FLAGS_tpu_compile_cache_dir": old})
+        cc._reset_for_tests()
+
+
+# -- bench block + telemetry ------------------------------------------------
+
+def test_serving_bench_block_assembled_from_registry(tmp_path):
+    """Tier-1 CI leg: the synthetic multi-tenant trace runs, the
+    ``serving`` block is ASSEMBLED FROM THE REGISTRY (block dict ==
+    registry().blocks()["serving"]), and it carries tokens/sec +
+    p50/p99 + queue depth."""
+    from paddle_tpu.observability import publish
+
+    reg = obs.configure(telemetry_dir=str(tmp_path), rank=0)
+    eng = _engine(max_seqs=4)
+    trace = serving.synthetic_trace(n_requests=10, n_tenants=3, seed=7,
+                                    vocab=48, prompt_range=(3, 14),
+                                    output_range=(3, 8))
+    summary = serving.run_trace(eng, trace, warmup=False)
+    assert summary["finished"] == 10
+    block = publish.serving_block()
+    assert block is not None
+    assert reg.blocks()["serving"] == block
+    assert block["tokens_per_sec"] == summary["tokens_per_sec"] > 0
+    assert block["requests_finished"] == 10
+    assert block["latency_ms"]["p50"] is not None
+    assert block["latency_ms"]["p99"] >= block["latency_ms"]["p50"]
+    assert block["queue_depth"]["max"] is not None
+    assert block["tokens_generated"] == summary["tokens_generated"]
+
+
+def test_serving_block_none_without_engine():
+    from paddle_tpu.observability import publish
+
+    assert publish.serving_block() is None
+
+
+def test_serving_events_schema_valid(tmp_path):
+    """Every record the engine writes — serving_request /
+    serving_step / steps — validates against the locked telemetry
+    schema, and the per-event required fields are present."""
+    obs.configure(telemetry_dir=str(tmp_path), rank=0)
+    eng = _engine(max_seqs=4)
+    reqs = [eng.submit(np.arange(1 + i, dtype=np.int32) % 48,
+                       max_new_tokens=3, tenant="t%d" % (i % 2))
+            for i in range(3)]
+    eng.run_until_idle()
+    eng.cancel(reqs[0])  # already finished: no-op event-wise
+    recs = []
+    for name in os.listdir(tmp_path):
+        if name.endswith(".jsonl"):
+            with open(os.path.join(tmp_path, name)) as f:
+                recs.extend(json.loads(ln) for ln in f if ln.strip())
+    assert recs
+    problems = obs.validate_records(recs, obs.load_schema(
+        os.path.join(_REPO, "tools", "telemetry_schema.json")))
+    assert problems == []
+    kinds = {}
+    for r in recs:
+        if r.get("kind") == "event":
+            kinds.setdefault(r["event"], []).append(r)
+    assert len(kinds.get("serving_request", [])) == 3
+    assert kinds["serving_step"]
+    req_ev = kinds["serving_request"][0]
+    assert req_ev["status"] == "finished"
+    assert req_ev["output_tokens"] == 3
+    st_ev = kinds["serving_step"][0]
+    assert {"running", "queue_depth", "kv_blocks_in_use"} <= set(st_ev)
+
+
+def test_bench_serving_leg_inprocess():
+    """bench.py's --serving leg returns the registry-assembled block
+    and a tokens/sec headline (run in-process, tiny trace). The leg
+    arms the repo-local compile cache — restore the flag/jax config so
+    later tests keep their donation behavior."""
+    from paddle_tpu.fluid import compile_cache as cc
+    from paddle_tpu.utils.flags import get_flag, set_flags
+
+    sys.path.insert(0, _REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    old = get_flag("FLAGS_tpu_compile_cache_dir")
+    try:
+        out = bench._bench_serving(n_requests=4, seed=1)
+    finally:
+        cc.disable()
+        set_flags({"FLAGS_tpu_compile_cache_dir": old})
+        cc._reset_for_tests()
+    assert out["metric"] == "serving_tokens_per_sec"
+    assert out["value"] > 0
+    assert out["serving"]["requests_submitted"] == 4
+    assert out["serving"] == obs.registry().blocks()["serving"]
+
+
+# -- lint: the decode loop has no per-token host sync -----------------------
+
+def _tpu_lint():
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    try:
+        import tpu_lint
+    finally:
+        sys.path.pop(0)
+    return tpu_lint
+
+
+def test_serving_decode_exemplar_lints_clean():
+    from paddle_tpu import analysis
+
+    tpu_lint = _tpu_lint()
+    prog, _ = tpu_lint.build_serving_decode()
+    findings = analysis.run_static_checks(prog)
+    s = analysis.summarize(findings)
+    assert s["errors"] == 0, s["findings"]
+    assert s["warnings"] == 0, s["findings"]
+
+
+def test_fetch_in_decode_scan_fires_host_sync_error():
+    """The deliberate-defect twin: seed a fetch INTO the decode scan
+    body — the PR 5 host-sync checker must fire an ERROR anchored at
+    the sub-block op (a per-token host sync would serialize the whole
+    decode loop)."""
+    from paddle_tpu import analysis
+
+    tpu_lint = _tpu_lint()
+    prog, _ = tpu_lint.build_serving_decode()
+    scan_op = next(op for op in prog.global_block().ops
+                   if op.type == "scan")
+    sub = prog.block(scan_op.attrs["sub_block"])
+    victim = sub.ops[0].output_arg_names[0]
+    sub.append_op(type="fetch", inputs={"X": [victim]}, outputs={},
+                  attrs={})
+    findings = analysis.run_static_checks(prog)
+    errs = [f for f in findings
+            if f.checker == "host-sync" and f.severity == "error"]
+    assert errs, findings
+    assert errs[0].op_type == "fetch"
+    assert errs[0].block_idx == sub.idx  # anchored inside the loop body
+    assert "every iteration" in errs[0].message

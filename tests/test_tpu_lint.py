@@ -941,13 +941,14 @@ def _import_tpu_lint():
 
 def test_exemplar_programs_lint_clean():
     """The standing regression: BERT-tiny DP step (plain AND bf16 AMP
-    + ZeRO-2 bucketed masters), resnet scan, and the 2-rank
-    fleet-transpiled sync-PS programs all lint with zero errors across
-    every checker."""
+    + ZeRO-2 bucketed masters), resnet scan, the serving decode loop,
+    and the 2-rank fleet-transpiled sync-PS programs all lint with
+    zero errors across every checker."""
     tpu_lint = _import_tpu_lint()
     results = tpu_lint.lint_exemplars()
     assert set(results) == {"bert_tiny", "bert_tiny_amp", "mlp_hier",
-                            "resnet_scan", "fleet_ps_2rank"}
+                            "resnet_scan", "serving_decode",
+                            "fleet_ps_2rank"}
     for name, (findings, summary) in results.items():
         errs = [analysis.format_finding(f) for f in findings
                 if f.severity == "error"]

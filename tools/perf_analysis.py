@@ -763,6 +763,25 @@ def compile_cache_report(telemetry_dir=None, log_dir=None,
     for r, t in sorted(by_rank_tally.items()):
         print("  rank %d: %d hit(s) / %d miss(es)"
               % (r, t["hits"], t["misses"]))
+    # by-source classification: training steps vs executor warmups vs
+    # the serving engine's AOT-compiled decode/prefill step buckets
+    # (source serving_decode / serving_prefill — an all-hit serving
+    # restart shows up here as "serving_decode: N hit / 0 miss")
+    by_source = {}
+    for e in events:
+        t = by_source.setdefault(str(e.get("source", "step")),
+                                 {"hits": 0, "misses": 0})
+        t["hits" if e.get("status") == "hit" else "misses"] += 1
+    if len(by_source) > 1 or any(
+            s.startswith("serving") for s in by_source):
+        for s, t in sorted(by_source.items()):
+            print("  source %s: %d hit(s) / %d miss(es)"
+                  % (s, t["hits"], t["misses"]))
+        sd = by_source.get("serving_decode")
+        if sd:
+            print("  serving decode buckets: %s"
+                  % ("all-hit (warm restart)" if not sd["misses"]
+                     else "%d cold compile(s)" % sd["misses"]))
     for aname, aevs in sorted(attempts.items()):
         ah = sum(1 for e in aevs if e.get("status") == "hit")
         print("  %s: %d hit(s) / %d miss(es)"
@@ -814,6 +833,7 @@ def compile_cache_report(telemetry_dir=None, log_dir=None,
         "compile_saved_s": round(saved_s, 3),
         "miss_bytes": miss_bytes,
         "by_rank": by_rank_tally,
+        "by_source": by_source,
         "attempts": {a: len(v) for a, v in attempts.items()},
         "transitions": transitions,
         "cache": inventory,

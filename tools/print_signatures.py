@@ -67,6 +67,11 @@ _MODULES = [
     # mesh_hierarchy are the hierarchical-collectives entry every
     # layer (fleet, lowering, launcher, bench) builds on — lock them
     "paddle_tpu.parallel.env",
+    # inference serving runtime: Engine/KV-cache/scheduler/trace are
+    # the serving front end bench.py --serving, the tier-1 serving
+    # legs and tools/perf_analysis.py --compile-cache build on — lock
+    # the surface
+    "paddle_tpu.serving",
     "paddle_tpu.hapi.model",
     "paddle_tpu.nn",
     "paddle_tpu.tensor",

@@ -16,6 +16,11 @@ Exemplars (each is a program the bench / tier-1 suite actually runs):
 - ``resnet_scan``   — ResNet50 with scan_stages (deep control-flow
                       nesting: host-sync + contract checkers descend
                       through the scan sub-blocks);
+- ``serving_decode``— the serving engine's greedy decode loop as a
+                      scan (paddle_tpu/serving): the host-sync checker
+                      proves NO per-token fetch/RPC/dynamic-shape op
+                      in the body — the IR-level half of the serving
+                      hot-loop contract;
 - ``fleet_ps_2rank``— the SAME model transpiled for 2 sync-PS
                       trainers; both rank programs are linted AND
                       cross-compared by the collective-divergence
@@ -198,6 +203,41 @@ def build_mlp_hier():
     return prog, None
 
 
+def build_serving_decode():
+    """The serving engine's per-token decode loop expressed in Program
+    IR: a greedy decode scan (hidden-state recurrence -> logits ->
+    on-device argmax, token and state carried as loop state) with NO
+    fetch / host RPC / dynamic-shape op in the body — the PR 5
+    host-sync-in-hot-loop checker proves the loop never syncs per
+    token. Zero errors is the standing claim (the deliberate-defect
+    twin — a fetch seeded INTO the scan body — lives in
+    tests/test_serving.py and must fire checker 3)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework
+
+    HID, VOCAB, STEPS = 16, 32, 8
+    _fresh()
+    with framework.unique_name_guard():
+        h0 = fluid.layers.data(name="h0", shape=[HID],
+                               dtype="float32")
+        w = fluid.layers.create_parameter(
+            shape=[HID, HID], dtype="float32", name="dec.w")
+        emb = fluid.layers.create_parameter(
+            shape=[HID, VOCAB], dtype="float32", name="dec.emb")
+        h = fluid.layers.fc(input=h0, size=HID)
+        scan = fluid.layers.Scan(n=STEPS)
+        with scan.block():
+            nh = fluid.layers.tanh(fluid.layers.matmul(h, w))
+            logits = fluid.layers.matmul(nh, emb)
+            # greedy sampling stays ON DEVICE: the token feeds nothing
+            # host-side inside the loop
+            fluid.layers.argmax(logits, axis=1)
+            fluid.layers.assign(nh, output=h)
+        fluid.layers.matmul(h, emb)
+        prog = fluid.default_main_program()
+    return prog, None
+
+
 def build_fleet_ps_2rank():
     """One MLP classifier transpiled for 2 sync-PS trainers: returns
     (rank-0 program, [rank-1 program]) for the cross-rank pass."""
@@ -230,6 +270,7 @@ EXEMPLARS = {
     "bert_tiny_amp": build_bert_tiny_amp,
     "mlp_hier": build_mlp_hier,
     "resnet_scan": build_resnet_scan,
+    "serving_decode": build_serving_decode,
     "fleet_ps_2rank": build_fleet_ps_2rank,
 }
 
