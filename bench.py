@@ -1024,6 +1024,64 @@ def _bench_resnet(batch: int, steps: int, warmup: int,
     return result
 
 
+def _bench_embedding(steps: int = 16, batch: int = 256,
+                     vocab: int = 20000, arch: str = "wide_deep") -> dict:
+    """Embedding bench leg (`python bench.py --embedding`): train the
+    CTR model (wide&deep or dlrm_tiny) data-parallel with every slot
+    table vocab-sharded by paddle_tpu/embedding and emit the
+    registry-assembled "embedding" block — per-replica state bytes vs
+    logical, modeled touched-rows sync bytes vs the dense reference's
+    vocab-sized allreduce. A second model family with a fundamentally
+    different comm signature from BERT/ResNet."""
+    _enable_compile_cache()
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework
+    from paddle_tpu.models import ctr
+
+    cfg = ctr.CTRConfig(vocab_sizes=(vocab, vocab // 2, vocab // 4,
+                                     vocab // 8),
+                        embed_dim=32, arch=arch)
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    with framework.unique_name_guard():
+        framework.default_main_program().random_seed = 7
+        framework.default_startup_program().random_seed = 7
+        loss, _, _ = ctr.build_ctr_train(cfg)
+        main_p = fluid.default_main_program()
+        fluid.CompiledProgram(main_p).with_data_parallel(
+            loss_name=loss.name)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        losses = []
+        t0 = time.perf_counter()
+        for i in range(steps):
+            feed = ctr.synthetic_batch(cfg, batch, seed=i)
+            losses.append(float(exe.run(
+                main_p, feed=feed, fetch_list=[loss])[0].mean()))
+        dt = time.perf_counter() - t0
+        plan = getattr(main_p, "_sparse_plan", None)
+        result = {
+            "metric": "ctr_examples_per_sec",
+            "value": round(steps * batch / dt, 2),
+            "unit": "examples/sec",
+            "arch": arch,
+            "steps": steps,
+            "batch": batch,
+            "loss_first": losses[0],
+            "loss_last": losses[-1],
+            "tables_sharded": len(plan.tables) if plan else 0,
+        }
+        import jax
+
+        result["platform"] = jax.devices()[0].platform
+        # bench_blocks assembles (and publishes) the "embedding" block
+        # along with every other evidence block — one call, one print
+        _attach_blocks(result, exe, main_p, feed, [loss])
+    return result
+
+
 def _bench_serving(n_requests: int = 24, seed: int = 0) -> dict:
     """Serving bench leg (`python bench.py --serving`): replay the
     synthetic multi-tenant request trace through a serving.Engine
@@ -1059,6 +1117,21 @@ if __name__ == "__main__":
     if len(sys.argv) >= 2 and sys.argv[1] == "--serving":
         n = int(sys.argv[2]) if len(sys.argv) > 2 else 24
         print(_RESULT_TAG + json.dumps(_bench_serving(n)))
+        sys.exit(0)
+    if len(sys.argv) >= 2 and sys.argv[1] == "--embedding":
+        # the vocab-sharded engine needs a multi-device mesh; on a
+        # CPU-only box emulate 8 devices (pre-jax-import, like
+        # tools/tpu_lint.py) — real TPU topologies pass through
+        if "xla_force_host_platform_device_count" not in \
+                os.environ.get("XLA_FLAGS", "") and \
+                os.environ.get("JAX_PLATFORMS", "cpu") == "cpu":
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8").strip()
+        steps = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+        arch = sys.argv[3] if len(sys.argv) > 3 else "wide_deep"
+        print(_RESULT_TAG + json.dumps(
+            _bench_embedding(steps=steps, arch=arch)))
         sys.exit(0)
     if len(sys.argv) >= 6 and sys.argv[1] == "--child":
         # argv[6] (the stage budget) is enforced by the parent's

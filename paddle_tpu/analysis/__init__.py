@@ -46,7 +46,7 @@ from .donation import (check_donation_safety,  # noqa: F401
                        cross_check_donation_report)
 from .host_sync import check_host_sync  # noqa: F401
 from .sharding import (check_shard_plan,  # noqa: F401
-                       check_zero2_lifetimes)
+                       check_sparse_update, check_zero2_lifetimes)
 from .contracts import check_dtype_shape_contracts  # noqa: F401
 
 __all__ = [
@@ -57,13 +57,15 @@ __all__ = [
     "hlo_collective_schedule", "check_hlo_divergence",
     "check_hierarchical_groups", "runtime_schedule_key",
     "check_donation_safety", "cross_check_donation_report",
-    "check_host_sync", "check_shard_plan", "check_zero2_lifetimes",
-    "check_dtype_shape_contracts", "run_static_checks",
+    "check_host_sync", "check_shard_plan", "check_sparse_update",
+    "check_zero2_lifetimes", "check_dtype_shape_contracts",
+    "run_static_checks",
 ]
 
 #: checker registry: name -> "does it run in the single-program pass"
 CHECKERS = ("collective-divergence", "donation-safety", "host-sync",
-            "zero1-invariants", "zero2-lifetimes", "dtype-contract")
+            "zero1-invariants", "zero2-lifetimes", "sparse-update",
+            "dtype-contract")
 
 
 def run_static_checks(program, feed_names=None, fetch_names=None,
@@ -108,6 +110,9 @@ def run_static_checks(program, feed_names=None, fetch_names=None,
     if "zero2-lifetimes" in sel:
         findings += check_zero2_lifetimes(program,
                                           fetch_names=fetch_names)
+    if "sparse-update" in sel:
+        findings += check_sparse_update(program,
+                                        fetch_names=fetch_names)
     if "dtype-contract" in sel:
         findings += check_dtype_shape_contracts(program)
     return sort_findings(findings)

@@ -150,9 +150,32 @@ def collective_schedule(program, block=None, _path="", _region=""):
     into every control-flow sub-block (loop bodies inline; branch
     regions tagged so `cond.true/` vs top-level never compare equal)."""
     block = block if block is not None else program.global_block()
+    # vocab-sharded embedding lookups (paddle_tpu/embedding): a PLANNED
+    # lookup op emits all_gather(ids) + psum_scatter per step (and the
+    # backward's tap gathers) — ranks disagreeing on WHICH tables shard
+    # (different flags, different plans) deadlock exactly like any
+    # other schedule divergence, so planned sites join the vocabulary
+    splan = getattr(program, "_sparse_plan", None)
+    site_of = splan.site_of if splan is not None else {}
     out: List[dict] = []
     for op_idx, op in enumerate(block.ops):
         t = op.type
+        site = site_of.get(id(op))
+        if site is not None:
+            info = splan.tables[site.table].info
+            out.append({
+                "kind": "sparse_lookup",
+                "dtype": str(info.dtype),
+                "shape": tuple(info.shape),
+                "ring_id": 0,
+                "group": (("shards", int(splan.ndev)),),
+                "var": site.table,
+                "block_idx": block.idx,
+                "op_idx": op_idx,
+                "path": _path,
+                "region": _region,
+            })
+            continue
         if t in IR_COLLECTIVE_OPS:
             out.append(_record(op, block, block.idx, op_idx, _path,
                                _region))

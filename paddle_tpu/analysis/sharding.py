@@ -122,8 +122,17 @@ def check_shard_plan(program, plan=None) -> List[Finding]:
     # sync grads at their c_allreduce_sum op
     tainted = set(plan.grad_names)
     seen_scattered = set(plan.grad_names)
+    # row-sparse taint vocabulary: optimizer ops owned by the sparse-
+    # embedding plan consume SelectedRows grads with their OWN schedule
+    # (gathered taps -> owning-shard scatter-add) — never a flat-shard
+    # reduce-scatter; checker 7 (`sparse-update`) verifies them
+    splan = getattr(program, "_sparse_plan", None)
+    sparse_opt_ids = frozenset(splan.opt_op_ids) \
+        if splan is not None else frozenset()
     for i, op in enumerate(post):
         op_idx = bwd_idx + 1 + i
+        if id(op) in sparse_opt_ids:
+            continue
         reads, writes = lowering._op_reads_writes(op)
         reads, writes = set(reads), set(writes)
         is_opt = "ParamOut" in op.output_names and \
@@ -312,4 +321,107 @@ def check_zero2_lifetimes(program, plan=None,
                 "buffer on every replica — drop it from the fetch "
                 "list to keep the ZeRO-2 grad footprint at 1/N." % g,
                 var=g))
+    return findings
+
+
+def check_sparse_update(program, plan=None,
+                        fetch_names=None) -> List[Finding]:
+    """Checker 7 — row-sparse embedding-update invariants
+    (``sparse-update``; paddle_tpu/embedding).
+
+    Independently re-verifies a SparseTablePlan after any later
+    program mutation, mirroring the zero1 checker's role for the ZeRO
+    plan:
+
+    - **exclusive touch** (error): a planned table, its SelectedRows
+      gradient, or a per-row moment read/written by any op outside the
+      sanctioned lookup/optimizer set would consume an engine value
+      without a sparse-aware rule — trace-time crash at best, silent
+      densification at worst.
+    - **optimizer rule exists** (error): the bound optimizer op must
+      be one of the row-sparse vocabulary (sgd / momentum / adagrad /
+      adam / adamw).
+    - **row layout** (error): each row-sharded var's padded_rows must
+      cover the vocab in ndev equal blocks and match the block var's
+      declared shape, or a checkpoint save (logical,
+      unshard_scope_value) and restore (re-sharded) disagree.
+    - **fetch of a SelectedRows grad** (warning): densifies to the
+      full (vocab, dim) buffer on every replica.
+    """
+    from ..embedding.planner import SPARSE_OPT_TYPES
+    from ..fluid import lowering
+
+    plan = plan if plan is not None else getattr(program,
+                                                 "_sparse_plan", None)
+    if plan is None:
+        return []
+    block = program.global_block()
+    findings: List[Finding] = []
+    site_ids = set(plan.site_of)
+    # one reads/writes pass (recursive sub-block descent) per op, not
+    # per (table, op) pair — this runs in the executor's post-compile
+    # leg on every fresh compile
+    rw_of = {id(op): lowering._op_reads_writes(op)
+             for op in block.ops}
+    for tname, t in plan.tables.items():
+        if t.opt_type is not None and t.opt_type not in SPARSE_OPT_TYPES:
+            findings.append(Finding(
+                "sparse-update", "error",
+                "table %r is bound to optimizer %r, which has no "
+                "row-sparse rule — the engine would raise at trace "
+                "time." % (tname, t.opt_type), var=tname,
+                op_type=t.opt_type))
+        owned = {tname: "table",
+                 **{sv: "per-row state" for sv in t.row_state.values()}}
+        if t.grad is not None:
+            owned[t.grad] = "SelectedRows gradient"
+        sanctioned = {s.op_id for s in t.sites}
+        if t.opt_op_id is not None:
+            sanctioned.add(t.opt_op_id)
+        for op_idx, op in enumerate(block.ops):
+            if id(op) in sanctioned or id(op) in site_ids \
+                    or op.type == "backward":
+                continue
+            reads, writes = rw_of[id(op)]
+            hit = (set(reads) | set(writes)) & set(owned)
+            for n in sorted(hit):
+                findings.append(Finding(
+                    "sparse-update", "error",
+                    "op %r touches %s %r of vocab-sharded table %r "
+                    "outside its sanctioned lookup/optimizer ops — "
+                    "no sparse-aware rule exists (the planner "
+                    "declines such programs; this op was inserted "
+                    "after planning)." % (op.type, owned[n], n,
+                                          tname),
+                    block_idx=block.idx, op_idx=op_idx,
+                    op_type=op.type, var=n))
+    for n, info in plan.state_vars.items():
+        want = -(-info.vocab // plan.ndev) * plan.ndev
+        if info.padded_rows != want or info.padded_rows % plan.ndev:
+            findings.append(Finding(
+                "sparse-update", "error",
+                "row-sharded var %r: padded_rows=%d does not cover "
+                "vocab %d in ndev=%d equal blocks (want %d) — shard "
+                "blocks would misalign and a checkpoint restore "
+                "re-shards into garbage." % (
+                    n, info.padded_rows, info.vocab, plan.ndev, want),
+                var=n))
+        v = block._find_var_recursive(n)
+        declared = tuple(int(d) for d in v.shape) if v is not None \
+            else None
+        if declared != info.shape:
+            findings.append(Finding(
+                "sparse-update", "error",
+                "row-sharded var %r: plan logical shape %s != block "
+                "var shape %s — checkpoint save (logical) and "
+                "restore (re-sharded) would disagree." % (
+                    n, info.shape, declared), var=n))
+    for g in (fetch_names or []):
+        if g in plan.grad_of:
+            findings.append(Finding(
+                "sparse-update", "warning",
+                "fetch of SelectedRows gradient %r densifies to the "
+                "full (vocab, dim) buffer on every replica — drop it "
+                "to keep collective bytes proportional to touched "
+                "rows." % g, var=g))
     return findings

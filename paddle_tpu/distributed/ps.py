@@ -822,6 +822,35 @@ class ParameterServer:
                         rows, values)
                     self._record_applied()
             return []
+        if method == "write_rows":
+            # exact row write (embedding cold-tier demotion: an evicted
+            # row's current device value + moments land back in the
+            # authoritative table). Rides the RPC envelope's
+            # (client_id, seq) dedup via _record_applied, so a server
+            # death between the write and its ack can never double-
+            # apply a retried demotion — exactly-once, the same
+            # contract as every other mutation here. Every target —
+            # including the moment side-tables `name#slot`, which
+            # have no program var — must be seeded via init_param
+            # first (RowCache.seed_ps does); a row write cannot
+            # invent the table's full shape.
+            pname, rows, values, tid = (args[0],
+                                        np.asarray(args[1]).astype(
+                                            np.int64),
+                                        np.asarray(args[2]),
+                                        int(args[3]))
+            self.heartbeat.beat(tid)
+            with self._lock:
+                cur = self.scope.find_var(pname)
+                if cur is None:
+                    raise ValueError(
+                        "write_rows: table %r was never initialized "
+                        "(seed it with init_param first)" % pname)
+                table = np.asarray(cur).copy()
+                table[rows] = values.astype(table.dtype)
+                self.scope.set_var(pname, table)
+                self._record_applied()
+            return []
         if method == "sparse_grad_sgd":
             # direct sparse SGD row update (reference: sgd_op.h sparse
             # SelectedRows path; avoids densifying the whole table)

@@ -1,0 +1,58 @@
+"""Vocab-sharded sparse-embedding engine — device-tier tables over ICI
+with a parameter-server cold tier (the recommender workload).
+
+The reference's marquee production workload is Downpour-style sparse PS
+training (SelectedRows grads, DownpourWorker, distributed lookup_table
+— PAPER.md §6). Our dense TPU path replicated every embedding table on
+every replica and synced a dense vocab-sized gradient per step; at
+recommender vocabularies (millions of rows) neither the table nor the
+gradient fits, and the collective bytes scale with VOCAB instead of
+with the rows a batch actually touches.
+
+This package makes `lookup_table` / `lookup_table_v2` / `embedding`
+ops over large tables a first-class SPMD citizen:
+
+- **Vocab sharding** (`planner.plan_sparse_tables`): tables marked
+  `is_sparse=True` (or larger than
+  `FLAGS_tpu_embedding_shard_min_rows`) shard on the vocab axis as
+  `P(ici)` — each replica owns a contiguous block of rows, replicated
+  across dcn pods like ZeRO-1 state. Per-replica table (and per-row
+  moment) HBM is ~1/N.
+- **Lookup lowering** (`engine`): the forward becomes all_gather(ids
+  over the shard axis) → mask-local-gather on the owned rows → ONE
+  psum_scatter back to each replica's batch slice. Collective bytes
+  are proportional to the touched rows (batch), never the vocab.
+  Exactly the schedule tpu-lint's collective vocabulary models for
+  `c_embedding`.
+- **Sparse backward**: the table never enters `jax.vjp` — a zero
+  "tap" on each lookup output collects the output cotangent, and the
+  update applies a unique-id scatter-add ON THE OWNING SHARD ONLY,
+  running the optimizer's REGISTERED compute (sgd / momentum /
+  adagrad / adam / adamw) on the touched rows with per-row moments
+  sharded alongside the table rows. No dense vocab-sized gradient or
+  moment is ever materialized.
+- **Cold tier** (`cold.RowCache`): tables bigger than HBM keep their
+  authoritative rows on the PR-9 checkpointed pserver; a host-side
+  row-cache manager faults rows (and their moments) in on demand,
+  admits by touch frequency, evicts LRU, and demotes dirty rows back
+  over the exactly-once RPC envelope — a pserver kill/restart never
+  double-applies or loses a row.
+
+See README.md in this directory for the sharding layout, the
+bit-parity contract vs the replicated dense reference, and the knob
+table.
+"""
+from __future__ import annotations
+
+from .planner import (LookupSite, RowShardInfo, SparseTablePlan,  # noqa: F401
+                      TableInfo, SPARSE_OPT_TYPES, plan_sparse_tables)
+from .engine import (SparseRowGrad, TableShard,  # noqa: F401
+                     check_oov_feeds, to_row_sharded_global)
+from .cold import RowCache  # noqa: F401
+
+__all__ = [
+    "LookupSite", "RowShardInfo", "SparseTablePlan", "TableInfo",
+    "SPARSE_OPT_TYPES", "plan_sparse_tables", "SparseRowGrad",
+    "TableShard", "check_oov_feeds", "to_row_sharded_global",
+    "RowCache",
+]

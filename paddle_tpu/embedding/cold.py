@@ -1,0 +1,421 @@
+"""Cold tier: PS-backed row cache for tables bigger than HBM.
+
+The device trains a CAPPED slot table of ``capacity`` rows; the
+authoritative full table (and its per-row optimizer moments) lives on
+the PR-9 checkpointed parameter server. A host-side
+:class:`RowCache` owns the id→slot mapping:
+
+- **fault-in**: before a step, every id the batch touches that is not
+  resident is fetched from the PS (`lookup_rows`, one RPC per table
+  per step) into a free — or evicted — slot;
+- **admission by touch frequency**: a row is *admitted* (protected)
+  once it has been touched ``admit_after`` times; eviction prefers
+  never-admitted rows, then LRU among the admitted — one-hit wonders
+  can't flush the working set;
+- **demotion**: an evicted row's CURRENT device values (param + every
+  moment) are written back with `write_rows` — an exact row write
+  behind the RPC envelope's (client_id, seq) dedup, so a pserver kill
+  between the write and its ack can never double-apply or lose the
+  row (exactly-once, the PR-1/PR-9 contract);
+- **prefetch**: `prefetch(ids)` starts the next batch's fault-in on a
+  background thread while the current step computes, mirroring the
+  reader prefetcher's overlap.
+
+Because a row travels with its moments and the slot-table update math
+is slot-index-independent, a capped run is BIT-IDENTICAL to the
+uncapped run — the acceptance test trains a CTR model both ways and
+compares losses exactly.
+
+Telemetry: ``embedding.resident_rows`` / ``embedding.hit_rate``
+gauges, ``embedding.evicted_rows`` counter, and schema-locked
+``embedding_fetch`` / ``embedding_evict`` events
+(tools/telemetry_schema.json).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class RowCache:
+    """Host-side row-cache manager for ONE logical table.
+
+    `table` names the PS-side value table; each moment table is stored
+    beside it as ``<table>#<slot>`` (e.g. ``emb#Moment``). The device
+    slot table (and its moment slot tables) live in `scope` under
+    their program var names and hold `capacity` rows.
+    """
+
+    def __init__(self, client, table, vocab, dim, capacity,
+                 scope=None, var_name=None, moment_vars=None,
+                 admit_after=2, dtype=np.float32, trainer_id=0,
+                 padding_idx=None):
+        if capacity > vocab:
+            capacity = vocab
+        self.client = client
+        self.table = table
+        self.vocab = int(vocab)
+        self.dim = int(dim)
+        self.capacity = int(capacity)
+        self.scope = scope
+        self.var_name = var_name or table
+        # program moment var name -> PS table suffix (slot name)
+        self.moment_vars: Dict[str, str] = dict(moment_vars or {})
+        self.admit_after = max(int(admit_after), 1)
+        self.dtype = np.dtype(dtype)
+        self.trainer_id = int(trainer_id)
+        self._slot_of: "OrderedDict[int, int]" = OrderedDict()  # id->slot, LRU order
+        self._id_of: Dict[int, int] = {}
+        self._free: List[int] = list(range(self.capacity))
+        self._touches: Dict[int, int] = {}
+        self._admitted: set = set()
+        self._hits = 0
+        self._misses = 0
+        self._evicted = 0
+        self._pending = None  # in-flight prefetch Thread
+        self._staged: Dict[int, dict] = {}  # id -> {ps_table: row}
+        self._lock = threading.Lock()
+        # padding_idx: once ids are translated, the program's
+        # padding_idx lives in SLOT space — reserve that slot for the
+        # padding id alone (no real row may ever occupy it, or its
+        # lookups would read zeros and its grads drop). The padding
+        # row's VALUE still faults in from the PS like any other row
+        # (the dense reference keeps the row's bits too; only the
+        # lookup masks it), and it is never an eviction victim.
+        self.padding_idx = None
+        if padding_idx is not None and \
+                0 <= int(padding_idx) < self.capacity:
+            self.padding_idx = int(padding_idx)
+            self._free.remove(self.padding_idx)
+
+    # -- stats ----------------------------------------------------------
+    @property
+    def resident_rows(self) -> int:
+        return len(self._slot_of)
+
+    @property
+    def hit_rate(self) -> float:
+        n = self._hits + self._misses
+        return (self._hits / n) if n else 1.0
+
+    def stats(self) -> dict:
+        return {"table": self.table, "resident_rows": self.resident_rows,
+                "capacity": self.capacity, "hits": self._hits,
+                "misses": self._misses, "hit_rate": self.hit_rate,
+                "evicted_rows": self._evicted}
+
+    # -- PS helpers -----------------------------------------------------
+    def _ps_tables(self):
+        yield self.table, self.var_name
+        for var, suffix in self.moment_vars.items():
+            yield "%s#%s" % (self.table, suffix), var
+
+    def seed_ps(self, init_value, moment_init=None):
+        """Seed the PS-side authoritative tables (first write wins
+        server-side, so concurrent trainers agree)."""
+        self.client.call("init_param", self.table,
+                         np.asarray(init_value, self.dtype))
+        for var, suffix in self.moment_vars.items():
+            mv = None if moment_init is None else moment_init.get(var)
+            if mv is None:
+                mv = np.zeros((self.vocab, self.dim), self.dtype)
+            self.client.call("init_param", "%s#%s" % (self.table, suffix),
+                             np.asarray(mv, self.dtype))
+
+    # -- slot management ------------------------------------------------
+    def _victims(self, n, keep=()) -> List[int]:
+        """Pick n eviction victims: never-admitted rows first (in LRU
+        order), then LRU among the admitted. Rows in `keep` (the
+        current batch's resident ids) are never victims."""
+        keep = set(keep)
+        if self.padding_idx is not None:
+            keep.add(self.padding_idx)
+        out = []
+        for rid in list(self._slot_of):
+            if len(out) >= n:
+                break
+            if rid not in self._admitted and rid not in keep:
+                out.append(rid)
+        if len(out) < n:
+            for rid in list(self._slot_of):
+                if len(out) >= n:
+                    break
+                if rid in self._admitted and rid not in out \
+                        and rid not in keep:
+                    out.append(rid)
+        return out
+
+    def _read_device_rows(self, slots):
+        """Current device values of `slots` for the value table and
+        every moment table (the demotion payload)."""
+        idx = np.asarray(slots, np.int64)
+        out = {}
+        for ps_name, var in self._ps_tables():
+            buf = self.scope.find_var(var)
+            out[ps_name] = np.asarray(buf)[idx].astype(self.dtype)
+        return out
+
+    def _write_device_rows(self, slots, rows_by_ps):
+        """Install fetched rows into the device slot tables (one
+        scatter per table; sharded scope arrays keep their layout via
+        a re-put under the same sharding)."""
+        import jax
+        import jax.numpy as jnp
+
+        idx = np.asarray(slots, np.int64)
+        for ps_name, var in self._ps_tables():
+            buf = self.scope.find_var(var)
+            new_rows = np.asarray(rows_by_ps[ps_name])
+            sharding = getattr(buf, "sharding", None)
+            arr = jnp.asarray(buf).at[idx].set(
+                jnp.asarray(new_rows, dtype=jnp.asarray(buf).dtype))
+            if sharding is not None and hasattr(sharding, "mesh"):
+                arr = jax.device_put(arr, sharding)
+            self.scope.set_var(var, arr)
+
+    def _demote(self, ids: List[int]):
+        if not ids:
+            return
+        slots = [self._slot_of[i] for i in ids]
+        payload = self._read_device_rows(slots)
+        rows = np.asarray(ids, np.int64)
+        for ps_name, _var in self._ps_tables():
+            self.client.call("write_rows", ps_name, rows,
+                             payload[ps_name], self.trainer_id)
+        for i in ids:
+            s = self._slot_of.pop(i)
+            self._id_of.pop(s, None)
+            self._admitted.discard(i)
+            # demoted rows re-earn admission from zero: keeps the
+            # touch-counter map O(resident), not O(every id ever seen)
+            self._touches.pop(i, None)
+            if s != self.padding_idx:
+                # the padding slot stays reserved — a real row must
+                # never land where the program masks lookups to zero
+                self._free.append(s)
+        self._evicted += len(ids)
+        _telemetry_event("embedding_evict", table=self.table,
+                         rows_evicted=len(ids))
+
+    def _lookup_ps_rows(self, missing: List[int]) -> Dict:
+        """The PS round-trip for `missing` rows of every table — pure
+        network, no device access (safe off-thread)."""
+        rows = np.asarray(missing, np.int64)
+        fetched = {}
+        for ps_name, _var in self._ps_tables():
+            (vals,) = self.client.call("lookup_rows", ps_name, rows)
+            fetched[ps_name] = np.asarray(vals)
+        return fetched
+
+    def _fault_in(self, missing: List[int], keep=()):
+        t0 = time.perf_counter()
+        # the padding id owns its reserved slot; everyone else draws
+        # from the free list
+        need_free = sum(1 for i in missing if i != self.padding_idx)
+        if need_free > len(self._free):
+            self._demote(self._victims(need_free - len(self._free),
+                                       keep=keep))
+        if need_free > len(self._free):
+            raise ValueError(
+                "RowCache(%r): a batch touches %d rows not resident "
+                "but only %d slots can be freed (capacity %d, %d "
+                "rows the same batch also needs) — raise the "
+                "capacity above the per-batch unique-id count"
+                % (self.table, need_free, len(self._free),
+                   self.capacity, len(set(keep))))
+        slots = [self.padding_idx if i == self.padding_idx
+                 else self._free.pop() for i in missing]
+        need = len(missing)
+        # rows the prefetcher already pulled skip the PS round-trip;
+        # the rest fetch now. A staged row may be STALE if it was
+        # resident (and trained) after staging — the staging path only
+        # pulls rows that were neither resident nor pending demotion,
+        # and ids stage at most one batch ahead, so a staged row was
+        # authoritative-on-PS the whole time.
+        staged_hits = [i for i in missing if i in self._staged]
+        to_fetch = [i for i in missing if i not in self._staged]
+        fetched = {ps: [] for ps, _ in self._ps_tables()}
+        if to_fetch:
+            live = self._lookup_ps_rows(to_fetch)
+        by_id = {}
+        for k, i in enumerate(to_fetch):
+            by_id[i] = {ps: live[ps][k] for ps in fetched}
+        for i in staged_hits:
+            by_id[i] = self._staged.pop(i)
+        payload = {ps: np.stack([by_id[i][ps] for i in missing])
+                   for ps in fetched}
+        self._write_device_rows(slots, payload)
+        for i, s in zip(missing, slots):
+            self._slot_of[i] = s
+            self._id_of[s] = i
+        _telemetry_event(
+            "embedding_fetch", table=self.table, rows_fetched=need,
+            hit_rate=round(self.hit_rate, 4),
+            dur_ms=(time.perf_counter() - t0) * 1e3)
+
+    # -- public API -----------------------------------------------------
+    def translate(self, ids) -> np.ndarray:
+        """ids (any shape, global row ids) -> slot ids of the same
+        shape, faulting missing rows in from the PS. Feed the result
+        in place of the raw ids."""
+        with self._lock:
+            self._join_pending()
+            return self._translate_locked(ids)
+
+    def _translate_locked(self, ids):
+        a = np.asarray(ids)
+        flat = a.reshape(-1).astype(np.int64)
+        uniq = np.unique(flat)
+        oov = uniq[(uniq < 0) | (uniq >= self.vocab)]
+        if len(oov):
+            # the cold tier owns the OOV contract for its LOGICAL
+            # table (the executor's host-side pre-check only sees the
+            # translated SLOT ids, where our drop sentinel is
+            # deliberately out of range): honor the same
+            # FLAGS_tpu_static_checks split — error raises naming the
+            # logical table, warn warns, off maps to the drop slot
+            # (zeros, gradient discarded)
+            from ..utils.flags import get_flag
+
+            mode = str(get_flag("FLAGS_tpu_static_checks", "off")
+                       or "off").lower()
+            msg = ("RowCache(%r): batch carries out-of-range id(s) "
+                   "(min=%d max=%d, vocab=%d)"
+                   % (self.table, int(uniq.min()), int(uniq.max()),
+                      self.vocab))
+            if mode == "error":
+                raise ValueError(msg)
+            if mode == "warn":
+                import warnings
+
+                warnings.warn("tpu-lint: " + msg)
+        uniq = uniq[(uniq >= 0) & (uniq < self.vocab)]
+        missing = [int(i) for i in uniq if int(i) not in self._slot_of]
+        hits = len(uniq) - len(missing)
+        self._hits += hits
+        self._misses += len(missing)
+        # effective capacity: the reserved padding slot serves only
+        # the padding id — a batch without it has one fewer slot
+        cap = self.capacity
+        if self.padding_idx is not None and \
+                self.padding_idx not in uniq:
+            cap -= 1
+        if len(uniq) > cap:
+            raise ValueError(
+                "RowCache(%r): batch touches %d unique rows > "
+                "usable capacity %d — every batch id must be "
+                "resident for its step" % (self.table, len(uniq),
+                                           cap))
+        if missing:
+            resident = [int(i) for i in uniq
+                        if int(i) in self._slot_of]
+            self._fault_in(missing, keep=resident)
+        slots_of_uniq = np.empty((len(uniq),), np.int64)
+        for k, i in enumerate(uniq):
+            i = int(i)
+            self._slot_of.move_to_end(i)
+            c = self._touches.get(i, 0) + 1
+            self._touches[i] = c
+            if c >= self.admit_after:
+                self._admitted.add(i)
+            slots_of_uniq[k] = self._slot_of[i]
+        # O(batch log batch) id -> slot mapping (never a vocab-sized
+        # buffer: the whole design promises touched-rows scaling).
+        # Out-of-range ids map to slot `capacity` — past the slot
+        # table, so the sharded lookup masks them to zeros and their
+        # grads drop, never aliasing another row's slot.
+        if len(uniq):
+            pos = np.clip(np.searchsorted(uniq, flat), 0,
+                          len(uniq) - 1)
+            valid = (flat >= 0) & (flat < self.vocab) \
+                & (uniq[pos] == flat)
+            out = np.where(valid, slots_of_uniq[pos], self.capacity)
+        else:
+            out = np.full(flat.shape, self.capacity, np.int64)
+        _set_gauges(self)
+        return out.reshape(a.shape)
+
+    def prefetch(self, ids):
+        """Start the NEXT batch's PS row fetch on a background thread
+        — overlaps the round-trip with the current step's compute (the
+        reader-prefetcher idiom). ONLY the network pull runs off-
+        thread: slot assignment, eviction and device writes stay
+        synchronous inside `translate` (a background device read would
+        race the jitted step's donated buffers). Fetched rows stage in
+        `_staged` until their `translate` installs them."""
+        with self._lock:
+            self._join_pending()
+            a = np.asarray(ids).reshape(-1).astype(np.int64)
+            uniq = np.unique(a)
+            uniq = uniq[(uniq >= 0) & (uniq < self.vocab)]
+            want = [int(i) for i in uniq
+                    if int(i) not in self._slot_of
+                    and int(i) not in self._staged]
+            if not want:
+                return
+
+            def work():
+                fetched = self._lookup_ps_rows(want)
+                with self._lock:
+                    for k, i in enumerate(want):
+                        # a row that became resident since staging was
+                        # trained on device: its PS copy is stale
+                        if i not in self._slot_of:
+                            self._staged[i] = {
+                                ps: fetched[ps][k] for ps in fetched}
+
+            th = threading.Thread(target=work, daemon=True)
+            # start BEFORE publishing: a concurrent translate joining
+            # an unstarted thread would RuntimeError
+            th.start()
+            self._pending = th
+
+    def _join_pending(self):
+        if self._pending is None:
+            return
+        th = self._pending
+        self._pending = None
+        # the worker also takes self._lock: release around the join
+        self._lock.release()
+        try:
+            th.join()
+        finally:
+            self._lock.acquire()
+
+    def flush(self):
+        """Demote EVERY resident row back to the PS (end of training /
+        before a checkpoint of the authoritative table)."""
+        with self._lock:
+            self._join_pending()
+            self._demote(list(self._slot_of))
+
+    def ps_table(self) -> np.ndarray:
+        """The authoritative full table as the PS currently holds it
+        (call flush() first for an exact device-state snapshot)."""
+        (v,) = self.client.call("get_param", self.table)
+        return np.asarray(v)
+
+
+def _telemetry_event(etype, **fields):
+    try:
+        from ..observability.registry import registry
+
+        registry().event(etype, **fields)
+    except Exception:  # noqa: BLE001 - telemetry only
+        pass
+
+
+def _set_gauges(cache: RowCache):
+    try:
+        from ..observability.registry import registry
+
+        reg = registry()
+        reg.set_gauge("embedding.resident_rows", cache.resident_rows)
+        reg.set_gauge("embedding.hit_rate", round(cache.hit_rate, 4))
+        reg.set_gauge("embedding.evicted_rows", cache._evicted)
+    except Exception:  # noqa: BLE001 - telemetry only
+        pass

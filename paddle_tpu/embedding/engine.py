@@ -1,0 +1,487 @@
+"""Trace-time execution rules for vocab-sharded embedding tables.
+
+Runs inside the lowered (shard_map'd) step function. The table arrives
+from shard_map as this replica's local ``(padded_rows/N, dim)`` row
+block and is wrapped in a :class:`TableShard`; the lookup, the
+gradient collectives and the row-sparse optimizer update all operate
+on that wrapper, so any op WITHOUT a sparse-aware rule that touches an
+engine value fails loudly at trace time (the runtime twin of the
+``sparse-update`` tpu-lint checker).
+
+Bit-parity contract vs the replicated dense reference
+-----------------------------------------------------
+
+- Forward: each id is owned by exactly one shard; the psum_scatter
+  adds N-1 exact zeros to the true row, so the looked-up vectors are
+  bit-identical to a dense `jnp.take`.
+- Backward: the dense path scatter-adds each replica's contributions
+  locally (batch order) and then psums the per-replica partials
+  (replica order, hierarchically ici-then-dcn on a hybrid mesh) and
+  divides by the world. The sparse path reproduces EXACTLY that
+  association: per-replica-slice scatter-adds into the compacted
+  unique-row buffer, folded left-to-right within the pod and then
+  across pods, divided by the world once at the end.
+- Update: the optimizer's REGISTERED compute runs on the gathered
+  touched rows — the same op graph the dense update applies to those
+  rows. Untouched rows do not move (exact for sgd/adagrad whose
+  zero-grad update is the identity; lazy semantics for
+  momentum/adam's state decay — the reference SelectedRows contract).
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+from typing import Dict, Optional
+
+import numpy as np
+
+from .planner import ROW_OUT_OF, SparseTablePlan
+
+_ACTIVE: contextvars.ContextVar = contextvars.ContextVar(
+    "paddle_tpu_sparse_plan", default=None)
+
+
+class TableShard:
+    """This replica's contiguous row block of a vocab-sharded var (the
+    table itself or one of its per-row moments). ``rows`` is the local
+    ``(padded_rows/N, dim)`` array; ``info`` the RowShardInfo."""
+
+    __slots__ = ("rows", "info")
+
+    def __init__(self, rows, info):
+        self.rows = rows
+        self.info = info
+
+    @property
+    def dtype(self):
+        return self.rows.dtype
+
+    def __repr__(self):
+        return "TableShard(%r, local=%s of %s)" % (
+            self.info.name, tuple(self.rows.shape), self.info.shape)
+
+
+class SparseRowGrad:
+    """A table gradient in SelectedRows form: the GLOBAL batch's ids
+    and per-position output cotangents, gathered over the data axes.
+    ``ids``/``vals`` have leading dim world*B; the /world mean is
+    applied after per-row aggregation (matching pmean's sum-then-
+    divide)."""
+
+    __slots__ = ("ids", "vals", "world", "table", "site_sizes")
+
+    def __init__(self, ids, vals, world, table, site_sizes=None):
+        self.ids = ids
+        self.vals = vals
+        self.world = int(world)
+        self.table = table
+        # per-replica flat element count per lookup site: the dense
+        # vjp accumulates one scatter PARTIAL per site — aggregation
+        # reproduces that by folding per-(replica, site) partials
+        self.site_sizes = tuple(site_sizes or
+                                (int(ids.shape[0]) // max(world, 1),))
+
+    def __repr__(self):
+        return "SparseRowGrad(%r, %d positions)" % (
+            self.table, int(self.ids.shape[0]))
+
+
+def _register_pytrees():
+    import jax
+
+    jax.tree_util.register_pytree_node(
+        TableShard,
+        lambda t: ((t.rows,), t.info),
+        lambda info, ch: TableShard(ch[0], info))
+    jax.tree_util.register_pytree_node(
+        SparseRowGrad,
+        lambda g: ((g.ids, g.vals), (g.world, g.table, g.site_sizes)),
+        lambda aux, ch: SparseRowGrad(ch[0], ch[1], aux[0], aux[1],
+                                      aux[2]))
+
+
+_register_pytrees()
+
+
+def active_plan(plan: SparseTablePlan):
+    """Context manager installing `plan` for the duration of one step
+    function's trace (contextvar: safe under concurrent background
+    warmup traces)."""
+    @contextlib.contextmanager
+    def _cm():
+        tok = _ACTIVE.set(plan)
+        try:
+            yield
+        finally:
+            _ACTIVE.reset(tok)
+
+    return _cm()
+
+
+def current_plan() -> Optional[SparseTablePlan]:
+    return _ACTIVE.get()
+
+
+# ---------------------------------------------------------------------------
+# fn-entry / fn-exit plumbing (called from fluid/lowering.build_block_fn)
+# ---------------------------------------------------------------------------
+
+def wrap_tables(env, plan: SparseTablePlan):
+    """Wrap incoming row-sharded state (raw local (rows/N, dim) arrays
+    from shard_map) into TableShards carrying their layout."""
+    for n, info in plan.state_vars.items():
+        v = env.get(n)
+        if v is not None and not isinstance(v, TableShard):
+            env[n] = TableShard(v, info)
+
+
+def unwrap_state(name, v, plan: SparseTablePlan):
+    """fn-exit: row-sharded state leaves as its raw local rows (the
+    shard_map out spec is P(axis) on dim 0)."""
+    if isinstance(v, TableShard) and name in plan.state_vars:
+        return v.rows
+    return v
+
+
+def gather_full(v: TableShard, plan: SparseTablePlan):
+    """all_gather a TableShard back to its replicated LOGICAL form
+    (fetches only — vocab-sized on every replica by definition)."""
+    from jax import lax
+
+    full = lax.all_gather(v.rows, plan.axis, tiled=True)
+    return full[:v.info.vocab]
+
+
+def tap_specs(plan: SparseTablePlan, env) -> Dict[str, object]:
+    """The zero taps injected as extra vjp diff vars: one per lookup
+    site of a trainable table, shaped like the site's OUTPUT (local
+    batch x dim). Their cotangents are the per-position output grads
+    the sparse update consumes — the table itself never enters vjp."""
+    import jax.numpy as jnp
+
+    out = {}
+    for t in plan.tables.values():
+        if t.grad is None:
+            continue
+        for s in t.sites:
+            ids = env.get(s.ids)
+            if ids is None:
+                continue
+            shp = tuple(ids.shape)
+            if s.v1 and len(shp) > 1 and shp[-1] == 1:
+                shp = shp[:-1]
+            out[s.tap] = jnp.zeros(shp + (t.info.dim,),
+                                   t.info.dtype)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# op execution rules
+# ---------------------------------------------------------------------------
+
+def maybe_exec(op, env) -> bool:
+    """Execute `op` under the active sparse plan when it involves
+    engine values. Returns False when the op is none of the engine's
+    business (caller runs the normal interpreter)."""
+    plan = _ACTIVE.get()
+    if plan is None:
+        return False
+    t = op.type
+    if t in ("lookup_table", "lookup_table_v2", "embedding"):
+        ws = op.input_names.get("W", [])
+        if ws and isinstance(env.get(ws[0]), TableShard):
+            _exec_lookup(op, env, plan)
+            return True
+    hit = []
+    for names in op.input_names.values():
+        for n in names:
+            v = env.get(n)
+            if isinstance(v, (TableShard, SparseRowGrad)):
+                hit.append(n)
+    if not hit:
+        return False
+    if id(op) in plan.opt_op_ids:
+        _exec_sparse_opt(op, env, plan)
+        return True
+    raise RuntimeError(
+        "vocab-sharded embedding: op %r consumes engine value(s) %s "
+        "without a sparse-aware rule — the planner sanctions only the "
+        "table's lookup and optimizer ops (tpu-lint checker "
+        "'sparse-update' catches this statically; the program was "
+        "likely mutated after planning)" % (t, sorted(set(hit))))
+
+
+def _shard_coords(info, plan):
+    from jax import lax
+
+    rows_local = info.rows_local
+    start = lax.axis_index(plan.axis) * rows_local
+    return rows_local, start
+
+
+def _exec_lookup(op, env, plan: SparseTablePlan):
+    """mask-local-gather -> one psum_scatter: ids all_gather over the
+    shard axis (intra-pod; the table is replicated across pods), each
+    shard looks up the rows it owns, and the psum_scatter returns each
+    replica the summed full rows of ITS batch slice — N-1 exact zeros
+    plus the owning shard's row, so values match dense `take` bit for
+    bit. Wire bytes scale with the batch, never the vocab."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    tshard: TableShard = env[op.input_names["W"][0]]
+    info = tshard.info
+    site = plan.site_of.get(id(op))
+    ids = env[op.input_names["Ids"][0]]
+    squeeze = op.type == "lookup_table" and ids.ndim > 1 \
+        and ids.shape[-1] == 1
+    if squeeze:
+        ids = ids.reshape(ids.shape[:-1])
+    out_shape = tuple(ids.shape) + (info.dim,)
+    flat = ids.reshape(-1).astype(jnp.int32)
+    ids_g = lax.all_gather(flat, plan.axis, tiled=True)
+    rows_local, start = _shard_coords(info, plan)
+    local = ids_g - start
+    pad = int(op.attrs.get("padding_idx", -1))
+    valid = (ids_g >= 0) & (ids_g < info.vocab) \
+        & (local >= 0) & (local < rows_local)
+    if pad >= 0:
+        valid = valid & (ids_g != pad)
+    part = jnp.take(tshard.rows,
+                    jnp.clip(local, 0, rows_local - 1), axis=0)
+    part = jnp.where(valid[:, None], part, jnp.zeros_like(part))
+    out = lax.psum_scatter(part, plan.axis, tiled=True)
+    out = out.reshape(out_shape)
+    if site is not None and site.tap in env:
+        out = out + env[site.tap]
+    env[op.output_names["Out"][0]] = out
+
+
+def install_sparse_grads(env, tap_grads, plan: SparseTablePlan):
+    """Post-vjp: turn each trainable table's tap cotangents into ONE
+    SparseRowGrad — local site (ids, dloss/dout) pairs concatenated,
+    then all_gathered over the data axes (shard axis first, then dcn:
+    row-major, the feed layout) so every replica holds the GLOBAL
+    batch's contributions. Wire bytes scale with touched rows. Each
+    site's padding_idx positions are masked to id -1 (dropped at
+    apply), matching the dense path's zeroed-where cotangent."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    for tname, t in plan.tables.items():
+        if t.grad is None:
+            continue
+        ids_parts, val_parts = [], []
+        for s in t.sites:
+            g = tap_grads.get(s.tap)
+            if g is None:
+                continue
+            ids = env[s.ids]
+            if s.v1 and ids.ndim > 1 and ids.shape[-1] == 1:
+                ids = ids.reshape(ids.shape[:-1])
+            flat = ids.reshape(-1).astype(jnp.int32)
+            vals = g.reshape(-1, t.info.dim)
+            if s.padding_idx >= 0:
+                flat = jnp.where(flat == s.padding_idx,
+                                 jnp.int32(-1), flat)
+            ids_parts.append(flat)
+            val_parts.append(vals)
+        if not ids_parts:
+            continue
+        ids_loc = jnp.concatenate(ids_parts) if len(ids_parts) > 1 \
+            else ids_parts[0]
+        vals_loc = jnp.concatenate(val_parts) if len(val_parts) > 1 \
+            else val_parts[0]
+        ids_g = lax.all_gather(ids_loc, plan.axis, tiled=True)
+        vals_g = lax.all_gather(vals_loc, plan.axis, tiled=True)
+        if plan.dcn_axis is not None and plan.dcn_size > 1:
+            ids_g = lax.all_gather(ids_g, plan.dcn_axis, tiled=True)
+            vals_g = lax.all_gather(vals_g, plan.dcn_axis, tiled=True)
+        env[t.grad] = SparseRowGrad(
+            ids_g, vals_g, plan.world, tname,
+            site_sizes=tuple(int(v.shape[0]) for v in val_parts))
+
+
+def _aggregate_rows(ids_g, vals_g, plan: SparseTablePlan,
+                    site_sizes=None):
+    """Compact the gathered contributions into per-unique-row mean
+    gradients, reproducing the dense path's fp association exactly:
+
+    1. stable-sort ids; duplicate contributions of a row keep global
+       batch order among themselves;
+    2. scatter-add each (replica, lookup-site) slice into its own
+       compacted partial (XLA scatter applies updates in index order —
+       batch order; the dense vjp likewise accumulates one scatter
+       partial PER SITE);
+    3. fold the site partials per replica, the replica partials
+       left-to-right within the pod, then across pods (the
+       hierarchical psum association), and divide by the world once
+       (pmean's sum-then-divide).
+
+    Returns (unique_rows (M,), row_grads (M, dim)); slots past the
+    unique count carry id -1 and are dropped at apply."""
+    import jax.numpy as jnp
+
+    m = int(ids_g.shape[0])
+    world = plan.world
+    b = m // world
+    site_sizes = tuple(site_sizes or (b,))
+    order = jnp.argsort(ids_g, stable=True)
+    sids = jnp.take(ids_g, order)
+    newseg = jnp.concatenate(
+        [jnp.ones((1,), bool), sids[1:] != sids[:-1]])
+    slot_sorted = (jnp.cumsum(newseg) - 1).astype(jnp.int32)
+    slot_of_pos = jnp.zeros((m,), jnp.int32).at[order].set(slot_sorted)
+    unique_rows = jnp.full((m,), -1, ids_g.dtype).at[slot_sorted].set(
+        sids)
+    dim = int(vals_g.shape[1])
+    f32 = jnp.float32
+
+    def replica_partial(r):
+        out = None
+        off = r * b
+        for sz in site_sizes:
+            sl = slice(off, off + sz)
+            part = jnp.zeros((m, dim), f32).at[slot_of_pos[sl]].add(
+                vals_g[sl].astype(f32))
+            out = part if out is None else out + part
+            off += sz
+        return out
+
+    pod_totals = []
+    for d in range(plan.dcn_size):
+        pod = None
+        for j in range(plan.ndev):
+            part = replica_partial(d * plan.ndev + j)
+            pod = part if pod is None else pod + part
+        pod_totals.append(pod)
+    total = pod_totals[0]
+    for p in pod_totals[1:]:
+        total = total + p
+    return unique_rows, total / world
+
+
+def _exec_sparse_opt(op, env, plan: SparseTablePlan):
+    """Row-sparse optimizer update on the owning shard only: aggregate
+    the SparseRowGrad to unique rows, gather the touched param/moment
+    rows, run the optimizer's REGISTERED compute on them (the same op
+    graph as the dense update, restricted to the touched rows), and
+    scatter the results back — out-of-shard / padding / unoccupied
+    slots drop. Replicated hyper-state (LearningRate, beta pows)
+    passes through whole and its outputs rebind normally."""
+    import jax.numpy as jnp
+    from .. import ops as ops_lib
+
+    t = plan.tables[plan.grad_of[op.input_names["Grad"][0]]]
+    grad: SparseRowGrad = env[t.grad]
+    tshard: TableShard = env[t.name]
+    info = tshard.info
+    rows_local, start = _shard_coords(info, plan)
+    unique_rows, row_grads = _aggregate_rows(
+        grad.ids, grad.vals, plan, site_sizes=grad.site_sizes)
+    local = unique_rows - start
+    valid = (unique_rows >= 0) & (unique_rows < info.vocab) \
+        & (local >= 0) & (local < rows_local)
+    safe = jnp.clip(local, 0, rows_local - 1)
+    # OOB index for invalid slots: scatter mode="drop" discards them
+    drop_idx = jnp.where(valid, local, rows_local)
+
+    row_state_vars = dict(t.row_state)
+    ins = {}
+    for slot, names in op.input_names.items():
+        if not names:
+            continue
+        if slot == "Grad":
+            ins[slot] = [row_grads.astype(info.dtype)]
+        elif slot == "Param":
+            ins[slot] = [jnp.take(tshard.rows, safe, axis=0)]
+        elif slot in row_state_vars:
+            ins[slot] = [jnp.take(env[names[0]].rows, safe, axis=0)]
+        else:
+            ins[slot] = [env[n] for n in names]
+    outs = ops_lib.normalize_outs(
+        ops_lib.get_op(op.type).compute(ins, dict(op.attrs)))
+    for slot, names in op.output_names.items():
+        vals = outs.get(slot, [])
+        src_slot = ROW_OUT_OF.get(slot)
+        for n, v in zip(names, vals):
+            if n in plan.state_vars and src_slot is not None:
+                buf = env[n].rows if isinstance(env.get(n), TableShard) \
+                    else env[n]
+                new = buf.at[drop_idx].set(
+                    v.astype(buf.dtype), mode="drop")
+                env[n] = TableShard(new, plan.state_vars[n])
+            else:
+                env[n] = v  # replicated hyper-state (beta pows, ...)
+    # the SelectedRows grad stays bound: nothing else consumes it
+    # (planner proof), but a debug fetch densifies it at fn exit
+
+
+def densify(grad: SparseRowGrad, plan: SparseTablePlan):
+    """Debug form of a SparseRowGrad: the dense LOGICAL (vocab, dim)
+    mean gradient (what the replicated reference would feed its
+    optimizer). Vocab-sized by definition — never on the train path."""
+    import jax.numpy as jnp
+
+    t = plan.tables[grad.table]
+    unique_rows, row_grads = _aggregate_rows(
+        grad.ids, grad.vals, plan, site_sizes=grad.site_sizes)
+    valid = (unique_rows >= 0) & (unique_rows < t.info.vocab)
+    idx = jnp.where(valid, unique_rows, t.info.vocab)
+    dense = jnp.zeros((t.info.vocab, t.info.dim), jnp.float32)
+    return dense.at[idx].add(row_grads, mode="drop")
+
+
+# ---------------------------------------------------------------------------
+# host-side layout + feed checks (executor)
+# ---------------------------------------------------------------------------
+
+def to_row_sharded_global(value, info, mesh, axis):
+    """Lay one table/moment scope array out as the row-sharded global
+    buffer the compiled step expects: pad the vocab axis to N*rows and
+    device_put with NamedSharding(mesh, P(axis)) — dim 0 sharded over
+    the (intra-pod) axis, replicated across dcn pods.
+
+    Elastic restart (N' != N): a value arriving as the PREVIOUS
+    world's padded buffer (more rows than the logical vocab) trims the
+    stale padding before re-padding, so the rows land bit-identical on
+    the new mesh."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    arr = np.asarray(value)
+    if arr.ndim != 2 or arr.shape[1] != info.dim:
+        raise ValueError(
+            "row-sharded var %r: scope value shape %s does not match "
+            "logical %s" % (info.name, arr.shape, info.shape))
+    if arr.shape[0] > info.vocab:
+        arr = arr[:info.vocab]  # strip the old world's padding rows
+    if arr.shape[0] < info.padded_rows:
+        arr = np.pad(arr, ((0, info.padded_rows - arr.shape[0]),
+                           (0, 0)))
+    return jax.device_put(arr, NamedSharding(mesh, P(axis)))
+
+
+def check_oov_feeds(plan: SparseTablePlan, feed_arrays):
+    """Host-side out-of-range-id pre-check (engaged by the executor
+    when FLAGS_tpu_static_checks != off): an id outside [0, vocab)
+    raises with the table/feed named, instead of the dense path's
+    silent clipped gather (or the sharded path's silent zero row).
+    padding_idx is exempt — it is in-range by construction."""
+    for t in plan.tables.values():
+        for s in t.sites:
+            a = feed_arrays.get(s.ids)
+            if a is None:
+                continue
+            ids = np.asarray(a).reshape(-1)
+            if ids.size == 0:
+                continue
+            lo, hi = int(ids.min()), int(ids.max())
+            if lo < 0 or hi >= t.info.vocab:
+                raise ValueError(
+                    "embedding %r: feed %r carries out-of-range id(s) "
+                    "(min=%d max=%d, vocab=%d) — the dense lookup "
+                    "would silently gather a clipped row "
+                    "(FLAGS_tpu_static_checks=off restores that "
+                    "behavior; the sharded lookup returns zeros)"
+                    % (t.name, s.ids, lo, hi, t.info.vocab))

@@ -1,0 +1,414 @@
+"""Feasibility planning for vocab-sharded embedding tables.
+
+`plan_sparse_tables` scans a data-parallel program for lookup ops over
+large tables and proves, per table, that the whole lifecycle — forward
+lookup, gradient, optimizer update — can run on vocab shards with a
+row-sparse update. Anything unprovable degrades THAT TABLE to today's
+replicated dense path (never a wrong answer), with a structured reason
+on ``program._sparse_embedding_fallback`` mirroring the ZeRO planner's
+``_sharded_update_fallback`` trail.
+
+A table is planned when ALL of:
+
+- its lookup op(s) sit in the top-level forward section and the op is
+  marked ``is_sparse=True`` (the reference's SelectedRows trigger) or
+  the vocab meets ``FLAGS_tpu_embedding_shard_min_rows``;
+- every ``Ids`` input is a feed (the executor's OOV pre-check and the
+  cold tier's id translation both key on feeds);
+- the table var is touched ONLY by its lookup ops and (for training
+  programs) exactly one supported optimizer op, whose per-row state
+  (Velocity / Moment / Moment1+2) is touched only by that op;
+- the table's gradient is consumed ONLY by that optimizer op (a
+  global-norm clip reading every grad, for example, declines the
+  table — a dense vocab-sized norm partial would defeat the point);
+- the program is plain implicit-sync DP: AMP, fp16 loss scaling,
+  gradient merge and fleet explicit-sync programs decline (each is a
+  recorded reason, not a crash).
+
+The plan's row layout: vocab rows zero-pad to a multiple of the shard
+count and each replica owns a contiguous ``padded_rows/N`` block —
+`P(axis)` on dim 0, replicated across dcn pods on a hybrid mesh,
+exactly the ZeRO "state lives within the pod" rule.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+_log = logging.getLogger("paddle_tpu.embedding")
+
+#: lookup op types the engine lowers (c_embedding keeps its own
+#: model-parallel lowering in ops/collective_ops.py)
+LOOKUP_OPS = ("lookup_table", "lookup_table_v2", "embedding")
+
+#: optimizer ops with a row-sparse execution rule: their registered
+#: computes are elementwise over rows, so running them on the touched
+#: rows IS the dense update restricted to those rows (lazy semantics
+#: for momentum-style state on untouched rows — the reference's
+#: SelectedRows/lazy_mode contract)
+SPARSE_OPT_TYPES = frozenset({"sgd", "momentum", "adagrad", "adam",
+                              "adamw"})
+
+#: per-row (param-shaped) state slots per optimizer type
+_ROW_STATE_SLOTS: Dict[str, Tuple[str, ...]] = {
+    "sgd": (),
+    "momentum": ("Velocity",),
+    "adagrad": ("Moment",),
+    "adam": ("Moment1", "Moment2"),
+    "adamw": ("Moment1", "Moment2"),
+}
+
+#: output slot -> the input slot whose rows it rebinds (scatter target)
+ROW_OUT_OF = {"ParamOut": "Param", "VelocityOut": "Velocity",
+              "MomentOut": "Moment", "Moment1Out": "Moment1",
+              "Moment2Out": "Moment2"}
+
+
+class RowShardInfo:
+    """Static layout of one row-sharded (vocab-axis) var: the scope
+    holds a ``(padded_rows, dim)`` buffer NamedSharding'd ``P(axis)``
+    on dim 0; each replica owns ``padded_rows/ndev`` contiguous rows."""
+
+    __slots__ = ("name", "shape", "dtype", "ndev", "padded_rows")
+
+    def __init__(self, name, shape, dtype, ndev):
+        self.name = name
+        self.shape = tuple(int(d) for d in shape)  # logical (vocab, dim)
+        self.dtype = np.dtype(dtype)
+        self.ndev = int(ndev)
+        self.padded_rows = -(-self.shape[0] // self.ndev) * self.ndev
+
+    @property
+    def vocab(self):
+        return self.shape[0]
+
+    @property
+    def dim(self):
+        return self.shape[1]
+
+    @property
+    def device_shape(self):
+        return (self.padded_rows, self.dim)
+
+    @property
+    def rows_local(self):
+        return self.padded_rows // self.ndev
+
+    def unshard(self, value):
+        """Global (padded_rows, dim) array -> logical-shape numpy array
+        (checkpoint/io save path)."""
+        arr = np.asarray(value)
+        if arr.shape == self.shape:
+            return arr
+        return arr[:self.vocab]
+
+
+class LookupSite:
+    """One lookup op over a planned table."""
+
+    __slots__ = ("op_id", "table", "ids", "out", "tap", "padding_idx",
+                 "v1")
+
+    def __init__(self, op_id, table, ids, out, padding_idx, v1):
+        self.op_id = op_id
+        self.table = table
+        self.ids = ids
+        self.out = out
+        # the zero "tap" added to the lookup output: its vjp cotangent
+        # IS the output gradient, so the table never enters jax.vjp
+        self.tap = out + "@EMB_TAP"
+        self.padding_idx = int(padding_idx)
+        self.v1 = bool(v1)  # lookup_table v1: ids carry a trailing [1]
+
+
+class TableInfo:
+    """One planned vocab-sharded table (+ its sparse-update binding)."""
+
+    __slots__ = ("name", "info", "sites", "grad", "opt_op_id",
+                 "opt_type", "row_state", "lr_name")
+
+    def __init__(self, name, info, sites, grad=None, opt_op_id=None,
+                 opt_type=None, row_state=None, lr_name=None):
+        self.name = name
+        self.info = info  # RowShardInfo of the table itself
+        self.sites: Tuple[LookupSite, ...] = tuple(sites)
+        self.grad = grad  # grad var name (None: forward-only program)
+        self.opt_op_id = opt_op_id
+        self.opt_type = opt_type
+        # per-row optimizer state: {input slot: var name}
+        self.row_state: Dict[str, str] = dict(row_state or {})
+        self.lr_name = lr_name
+
+
+class SparseTablePlan:
+    __slots__ = ("axis", "ndev", "dcn_axis", "dcn_size", "tables",
+                 "state_vars", "site_of", "tap_names", "opt_op_ids",
+                 "grad_of")
+
+    def __init__(self, axis, ndev, dcn_axis, dcn_size, tables):
+        self.axis = axis
+        self.ndev = int(ndev)
+        self.dcn_axis = dcn_axis
+        self.dcn_size = int(dcn_size or 1)
+        self.tables: Dict[str, TableInfo] = dict(tables)
+        # every row-sharded scope var (tables + per-row moments)
+        self.state_vars: Dict[str, RowShardInfo] = {}
+        self.site_of: Dict[int, LookupSite] = {}
+        self.opt_op_ids = set()
+        self.grad_of: Dict[str, str] = {}  # grad var -> table name
+        for t in self.tables.values():
+            self.state_vars[t.name] = t.info
+            for sv in t.row_state.values():
+                self.state_vars[sv] = RowShardInfo(
+                    sv, t.info.shape, t.info.dtype, self.ndev)
+            for s in t.sites:
+                self.site_of[s.op_id] = s
+            if t.opt_op_id is not None:
+                self.opt_op_ids.add(t.opt_op_id)
+            if t.grad is not None:
+                self.grad_of[t.grad] = t.name
+        self.tap_names = frozenset(
+            s.tap for t in self.tables.values() for s in t.sites
+            if t.grad is not None)
+
+    @property
+    def world(self) -> int:
+        return self.ndev * self.dcn_size
+
+    def table_of_grad(self, grad_name) -> Optional[TableInfo]:
+        tn = self.grad_of.get(grad_name)
+        return self.tables.get(tn) if tn else None
+
+    def prune(self, state_mut, state_ro=()) -> "SparseTablePlan":
+        """Drop tables whose vars don't flow through the compiled step
+        as scope state (a var optimized away / shadowed). Returns self
+        when nothing changes; None when no table survives."""
+        keep = {}
+        live = set(state_mut) | set(state_ro)
+        for n, t in self.tables.items():
+            vars_ = [t.name] + list(t.row_state.values())
+            if all(v in live for v in vars_):
+                keep[n] = t
+        if len(keep) == len(self.tables):
+            return self
+        if not keep:
+            return None
+        return SparseTablePlan(self.axis, self.ndev, self.dcn_axis,
+                               self.dcn_size, keep)
+
+
+def enabled() -> bool:
+    from ..utils.flags import get_flag
+
+    return bool(get_flag("FLAGS_tpu_sparse_embedding", True))
+
+
+def _record_fallback(program, reason, table=None, op_type=None):
+    lst = getattr(program, "_sparse_embedding_fallback", None)
+    if lst is None:
+        lst = []
+        program._sparse_embedding_fallback = lst
+    lst.append({"reason": reason, "table": table, "op": op_type})
+    _log.debug("sparse embedding declined: %s (table=%s op=%s)",
+               reason, table, op_type)
+
+
+def _min_rows() -> int:
+    from ..utils.flags import get_flag
+
+    return int(get_flag("FLAGS_tpu_embedding_shard_min_rows", 0) or 0)
+
+
+def plan_sparse_tables(program, block, ndev, dp_axis, dcn_axis=None,
+                       dcn_size=1,
+                       feed_names=()) -> Optional[SparseTablePlan]:
+    """Scan `block` for vocab-shardable tables. Returns a plan covering
+    every provable table, or None (flag off / nothing shardable /
+    program-wide decline). Per-table declines degrade only that table."""
+    from ..fluid import lowering
+
+    program._sparse_embedding_fallback = []
+    if not enabled() or ndev <= 1:
+        return None
+    ops = list(block.ops)
+    bwd_idx = next((i for i, op in enumerate(ops)
+                    if op.type == "backward"), None)
+    fwd = ops if bwd_idx is None else ops[:bwd_idx]
+    post = [] if bwd_idx is None else ops[bwd_idx + 1:]
+
+    # candidate lookup sites in the top-level forward section
+    min_rows = _min_rows()
+    feed_set = set(feed_names)
+    sites_of: Dict[str, List[LookupSite]] = {}
+    declined: set = set()
+    for op in fwd:
+        if op.type not in LOOKUP_OPS:
+            continue
+        ws = op.input_names.get("W", [])
+        if not ws:
+            continue
+        w = ws[0]
+        v = block._find_var_recursive(w)
+        shape = tuple(int(d) for d in (getattr(v, "shape", ()) or ()))
+        if v is None or not getattr(v, "persistable", False) \
+                or len(shape) != 2 or any(d <= 0 for d in shape):
+            continue
+        marked = bool(op.attrs.get("is_sparse"))
+        big = min_rows > 0 and shape[0] >= min_rows
+        if not (marked or big):
+            continue
+        if str(getattr(v, "dtype", "float32")) != "float32":
+            _record_fallback(program, "non-fp32 table", table=w)
+            declined.add(w)
+            continue
+        ids = op.input_names.get("Ids", [None])[0]
+        if ids not in feed_set:
+            _record_fallback(
+                program, "Ids is not a feed (OOV pre-check and cold-"
+                "tier id translation key on feeds)", table=w,
+                op_type=op.type)
+            declined.add(w)
+            continue
+        sites_of.setdefault(w, []).append(LookupSite(
+            id(op), w, ids, op.output_names["Out"][0],
+            op.attrs.get("padding_idx", -1),
+            v1=(op.type == "lookup_table")))
+    for w in declined:
+        sites_of.pop(w, None)
+    if not sites_of:
+        return None
+
+    # program-wide declines (whole plan): the tap-based backward only
+    # composes with plain implicit-sync DP today
+    if post:
+        bop = ops[bwd_idx]
+        if getattr(program, "_amp", False):
+            _record_fallback(program, "AMP programs keep the dense "
+                             "embedding path")
+            return None
+        if bop.attrs.get("gradient_merge") is not None:
+            _record_fallback(program, "gradient merge accumulates "
+                             "dense grads across steps")
+            return None
+        if bop.attrs.get("dynamic_loss_scaling") is not None or \
+                bop.attrs.get("static_loss_scaling"):
+            _record_fallback(program, "fp16 loss scaling is not wired "
+                             "for sparse taps")
+            return None
+        if any((op.type.startswith("c_allreduce")
+                or op.type == "allreduce")
+               and any(n.endswith("@GRAD")
+                       for n in op.input_arg_names)
+               for op in post):
+            _record_fallback(program, "explicit-sync (fleet) grad "
+                             "programs own their allreduce schedule")
+            return None
+
+    # per-table lifecycle proof
+    site_op_ids = {s.op_id for ss in sites_of.values() for s in ss}
+    tables: Dict[str, TableInfo] = {}
+    for w, sites in sorted(sites_of.items()):
+        v = block._find_var_recursive(w)
+        info = RowShardInfo(w, v.shape, str(v.dtype), ndev)
+        # the table's optimizer op (training programs)
+        opt_op = None
+        ok = True
+        for op in post:
+            if op.input_names.get("Param", [None])[0] == w:
+                if opt_op is not None:
+                    _record_fallback(program, "table updated by more "
+                                     "than one optimizer op", table=w,
+                                     op_type=op.type)
+                    ok = False
+                    break
+                opt_op = op
+        if not ok:
+            continue
+        if opt_op is None and post:
+            # trainable table never optimized: keep it dense (frozen
+            # tables would work sharded, but a missing optimizer op
+            # usually means stop_gradient — not worth a special case)
+            if not getattr(v, "stop_gradient", False):
+                _record_fallback(program, "no optimizer op binds the "
+                                 "table", table=w)
+                continue
+        grad = None
+        opt_type = None
+        row_state: Dict[str, str] = {}
+        lr_name = None
+        if opt_op is not None:
+            if opt_op.type not in SPARSE_OPT_TYPES:
+                _record_fallback(program, "optimizer %r has no row-"
+                                 "sparse rule" % opt_op.type, table=w,
+                                 op_type=opt_op.type)
+                continue
+            gs = opt_op.input_names.get("Grad", [])
+            if len(gs) != 1:
+                _record_fallback(program, "optimizer op without a "
+                                 "single Grad slot", table=w,
+                                 op_type=opt_op.type)
+                continue
+            grad = gs[0]
+            opt_type = opt_op.type
+            lr_name = opt_op.input_names.get("LearningRate",
+                                             [None])[0]
+            bad_state = False
+            for slot in _ROW_STATE_SLOTS[opt_op.type]:
+                names = opt_op.input_names.get(slot, [])
+                if len(names) != 1:
+                    bad_state = True
+                    break
+                sv = block._find_var_recursive(names[0])
+                sshape = tuple(int(d) for d in
+                               (getattr(sv, "shape", ()) or ()))
+                if sshape != info.shape:
+                    _record_fallback(
+                        program, "per-row state %r is not table-"
+                        "shaped" % names[0], table=w,
+                        op_type=opt_op.type)
+                    bad_state = True
+                    break
+                row_state[slot] = names[0]
+            if bad_state:
+                continue
+        # exclusive-touch proof: the table, its grad and its per-row
+        # state may be read/written only by the sanctioned ops
+        owned = {w: "table", **{sv: "state"
+                                for sv in row_state.values()}}
+        if grad is not None:
+            owned[grad] = "grad"
+        sanctioned = set(s.op_id for s in sites)
+        if opt_op is not None:
+            sanctioned.add(id(opt_op))
+        conflict = None
+        for op in ops:
+            if id(op) in sanctioned:
+                continue
+            if op.type == "backward":
+                # the backward pseudo-op declares every grad as its
+                # output; the tap machinery supersedes it for sparse
+                # tables (the table never enters vjp)
+                continue
+            if id(op) in site_op_ids:
+                continue  # another table's lookup never touches ours
+            reads, writes = lowering._op_reads_writes(op)
+            hit = (set(reads) | set(writes)) & set(owned)
+            if hit:
+                conflict = (sorted(hit)[0], op.type)
+                break
+        if conflict is not None:
+            _record_fallback(
+                program, "%s %r is touched outside its lookup/"
+                "optimizer ops" % (owned[conflict[0]], conflict[0]),
+                table=w, op_type=conflict[1])
+            continue
+        tables[w] = TableInfo(w, info, sites, grad=grad,
+                              opt_op_id=(id(opt_op) if opt_op is not None
+                                         else None),
+                              opt_type=opt_type, row_state=row_state,
+                              lr_name=lr_name)
+    if not tables:
+        return None
+    return SparseTablePlan(dp_axis, ndev, dcn_axis, dcn_size, tables)

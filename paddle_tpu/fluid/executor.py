@@ -290,6 +290,24 @@ class Executor:
                                               entry.dp_axis)
                     states_mut[n] = v
                     scope.set_var(n, v)
+        if entry.sparse_tables:
+            # vocab-sharded embedding layout: tables + per-row moments
+            # live in the scope as (padded_rows, dim) buffers
+            # NamedSharding'd P(axis) on the vocab axis — convert once
+            # (logical-shape values from startup/checkpoint restore, or
+            # a stale world's padding after an elastic N' restart)
+            from ..embedding import engine as _emb
+
+            for n, info in entry.sparse_tables.items():
+                for d in (states_mut, states_ro):
+                    v = d.get(n)
+                    if v is not None and tuple(getattr(v, "shape", ())) \
+                            != info.device_shape:
+                        v = _emb.to_row_sharded_global(
+                            v, info, entry.mesh, entry.dp_axis)
+                        d[n] = v
+                        scope.set_var(n, v)
+        self._check_sparse_ids(program, feed_arrays)
         if fresh_compile:
             # OOM pre-flight (FLAGS_tpu_hbm_budget_mb, off by default):
             # reject a program whose modeled HBM peak exceeds the
@@ -410,6 +428,35 @@ class Executor:
             return out
         return [LazyFetch(v) for v in fetches]
 
+    @staticmethod
+    def _check_sparse_ids(program, feed_arrays):
+        """Host-side OOV pre-check for vocab-sharded embedding feeds:
+        an out-of-range id raises (FLAGS_tpu_static_checks=error) or
+        warns (=warn) with the table/feed named BEFORE the dispatch —
+        the same fatal/non-fatal split as every other checker behind
+        the flag — instead of the dense path's silent clipped gather.
+        O(batch) numpy per step, only for programs that actually
+        carry a sparse plan."""
+        plan = getattr(program, "_sparse_plan", None)
+        if plan is None:
+            return
+        from ..utils.flags import get_flag
+
+        mode = str(get_flag("FLAGS_tpu_static_checks", "off")
+                   or "off").lower()
+        if mode not in ("warn", "error"):
+            return
+        from ..embedding import engine as _emb
+
+        try:
+            _emb.check_oov_feeds(plan, feed_arrays)
+        except ValueError as e:
+            if mode == "error":
+                raise
+            import warnings
+
+            warnings.warn("tpu-lint: " + str(e))
+
     #: checkers that need nothing from compile_block (no shard plan),
     #: run before the XLA compile so error mode fails fast
     _PRE_COMPILE_CHECKERS = ("collective-divergence", "donation-safety",
@@ -502,7 +549,8 @@ class Executor:
         # dispatch the known-bad program
         self._static_checks(program, feed_arrays, fetch_names,
                             checkers=("zero1-invariants",
-                                      "zero2-lifetimes"))
+                                      "zero2-lifetimes",
+                                      "sparse-update"))
         if use_program_cache:
             self._cache[key] = entry
             limit = int(get_flag("FLAGS_tpu_compile_cache_size", 128)
@@ -748,6 +796,17 @@ class Executor:
                             getattr(v, "shape", ())) != (info.padded,):
                         states_mut[n] = _su.to_sharded_global(
                             v, info, entry.mesh, entry.dp_axis)
+            if entry.sparse_tables:
+                from ..embedding import engine as _emb
+
+                for n, info in entry.sparse_tables.items():
+                    for d in (states_mut, states_ro):
+                        v = d.get(n)
+                        if v is not None and tuple(
+                                getattr(v, "shape", ())) \
+                                != info.device_shape:
+                            d[n] = _emb.to_row_sharded_global(
+                                v, info, entry.mesh, entry.dp_axis)
             # same gate invariant as run(): a warmup-cached entry must
             # not let the first real run cache-hit past the HBM
             # pre-flight (FLAGS_tpu_hbm_budget_mb; no-op when unset) —

@@ -57,12 +57,13 @@ class LoweredFunction:
                  "state_mut_names", "state_ro_names",
                  "fetch_names", "feed_names", "mesh", "dp_axis",
                  "auto_plan", "feed_donate", "sharded_state",
-                 "aot_compiled", "cc_fingerprint", "cc_prev")
+                 "sparse_tables", "aot_compiled", "cc_fingerprint",
+                 "cc_prev")
 
     def __init__(self, jitted, feed_names, state_in_names, state_out_names,
                  state_mut_names, state_ro_names, fetch_names, mesh=None,
                  dp_axis=None, auto_plan=None, feed_donate=False,
-                 sharded_state=None):
+                 sharded_state=None, sparse_tables=None):
         self.jitted = jitted
         self.feed_names = feed_names
         self.state_in_names = state_in_names
@@ -78,6 +79,11 @@ class LoweredFunction:
         # step keeps optimizer state sharded over the dp axis (ZeRO-1);
         # the executor lays those scope arrays out as flat 1/N buffers
         self.sharded_state = sharded_state
+        # {name: embedding.RowShardInfo} when the step keeps embedding
+        # tables (+ per-row moments) vocab-sharded over the dp axis;
+        # the executor lays those scope arrays out as row-sharded
+        # (padded_rows, dim) buffers (paddle_tpu/embedding)
+        self.sparse_tables = sparse_tables
         # memoized AOT-compiled artifact for the report surfaces
         # (donation_report / overlap_report) — one XLA compile serves
         # every audit of this executable instead of one per call
@@ -201,6 +207,15 @@ def _exec_op_stamped(op, env, key0, op_idx, amp_lists=None):
         return _exec_switch_case(op, env, key0, op_idx, amp_lists)
     if t == "conditional_block":
         return _exec_conditional_block(op, env, key0, op_idx, amp_lists)
+    # vocab-sharded embedding engine (paddle_tpu/embedding): under an
+    # active sparse plan, lookup ops over TableShards and the sparse
+    # optimizer ops route to the engine's trace rules; any OTHER op
+    # touching an engine value fails loudly (no-op when no plan is
+    # active — a single contextvar read)
+    from ..embedding import engine as _emb_engine
+
+    if _emb_engine.maybe_exec(op, env):
+        return
     opdef = ops_lib.get_op(t)
     ins = {}
     for slot, names in op.input_names.items():
@@ -902,12 +917,20 @@ def _diffable(block, name, env):
 
 
 def build_block_fn(program, block, feed_names, fetch_names,
-                   state_in, state_out, shard_plan=None):
+                   state_in, state_out, shard_plan=None,
+                   sparse_plan=None):
     """Build the pure python fn to be jitted. With `shard_plan` (a
     parallel.sharded_update.ShardedUpdatePlan; only under _compile_dp),
     optimizer-bound gradients are reduce-scattered instead of pmean'd,
     the post-backward section runs on flat 1/N shards, and updated
-    params are all-gathered back — ZeRO-1 weight-update sharding."""
+    params are all-gathered back — ZeRO-1 weight-update sharding.
+
+    With `sparse_plan` (an embedding.SparseTablePlan), vocab-sharded
+    tables arrive as row shards, lookups lower through the sparse
+    engine, and each table's gradient is collected via a zero "tap"
+    diff var (the table itself never enters jax.vjp — no dense
+    vocab-sized cotangent exists) and applied as a row-sparse update
+    on the owning shard."""
     import jax
     import jax.numpy as jnp
 
@@ -915,6 +938,10 @@ def build_block_fn(program, block, feed_names, fetch_names,
         from ..parallel import sharded_update as _su
     else:
         _su = None
+    if sparse_plan is not None:
+        from ..embedding import engine as _emb
+    else:
+        _emb = None
 
     ops = list(block.ops)
     bwd_indices = [i for i, op in enumerate(ops) if op.type == "backward"]
@@ -983,6 +1010,15 @@ def build_block_fn(program, block, feed_names, fetch_names,
 
 
     def fn(feeds: Dict, states_mut: Dict, states_ro: Dict, seed):
+        if sparse_plan is None:
+            return _fn_body(feeds, states_mut, states_ro, seed)
+        # install the sparse plan for this trace (contextvar — the
+        # engine's per-op routing in _exec_op_stamped reads it; safe
+        # under concurrent background-warmup traces)
+        with _emb.active_plan(sparse_plan):
+            return _fn_body(feeds, states_mut, states_ro, seed)
+
+    def _fn_body(feeds: Dict, states_mut: Dict, states_ro: Dict, seed):
         env = {}
         env.update(states_ro)
         env.update(states_mut)
@@ -992,6 +1028,10 @@ def build_block_fn(program, block, feed_names, fetch_names,
             # sharded optimizer state arrives as raw (padded/N,) vecs
             # from shard_map; wrap with the logical shapes
             _su.wrap_sharded_state(env, shard_plan)
+        if sparse_plan is not None:
+            # vocab-sharded tables + per-row moments arrive as raw
+            # local (rows/N, dim) blocks from shard_map; wrap them
+            _emb.wrap_tables(env, sparse_plan)
 
         if bwd_idx is None:
             _run_ops(ops, env, key0, amp_lists=amp_lists)
@@ -1030,8 +1070,20 @@ def build_block_fn(program, block, feed_names, fetch_names,
                     "implicit DP sync.")
                 dls = None
                 static_ls = None
+            tap_names = frozenset()
+            if sparse_plan is not None:
+                # vocab-sharded tables never enter vjp: their grads
+                # arrive through the lookup-output taps instead (no
+                # dense vocab-sized cotangent is ever built)
+                requested = [n for n in requested
+                             if n not in sparse_plan.tables]
             diff_names = [n for n in requested
                           if n in env and _diffable(block, n, env)]
+            if sparse_plan is not None:
+                taps = _emb.tap_specs(sparse_plan, env)
+                env.update(taps)
+                tap_names = frozenset(taps)
+                diff_names = diff_names + sorted(taps)
 
             ckpt_names = list(bop.attrs.get("checkpoints", []) or [])
             segments = None
@@ -1074,6 +1126,13 @@ def build_block_fn(program, block, feed_names, fetch_names,
                 ct = ct * amp_scale
             grads = vjp_fn(ct)[0]
             env = dict(env_after)
+            tap_grads = {}
+            if sparse_plan is not None:
+                # tap cotangents stay LOCAL (per-replica batch slice):
+                # the cross-replica combine happens inside the sparse
+                # engine's gathered scatter-add, never via pmean
+                tap_grads = {n: grads.pop(n) for n in list(grads)
+                             if n in tap_names}
             if gm is None:
                 if shard_plan is not None and _implicit_dp:
                     if shard_plan.buckets:
@@ -1120,13 +1179,15 @@ def build_block_fn(program, block, feed_names, fetch_names,
             found_inf = None
             if dls is not None:
                 found_inf = _amp_found_inf(
-                    {n: grads[n] for n in diff_names},
+                    {n: grads[n] for n in diff_names if n in grads},
                     (_dp_axis_name, _dcn_axis_name))
             # under gradient merge, sync once on the MERGED grads at the
             # k-step boundary instead of k per-micro-step allreduces
             from ..observability import attribution as _attr
 
             for n in diff_names:
+                if n in tap_names:
+                    continue  # tap cotangents feed the sparse engine
                 gn = framework.grad_var_name(n)
                 # stamp the grad post-processing (unscale + dtype cast)
                 # with the gradient's provenance so its converts blame
@@ -1136,6 +1197,11 @@ def build_block_fn(program, block, feed_names, fetch_names,
                     if amp_scale is not None:
                         g = _amp_unscale(g, amp_scale)
                     env[gn] = g.astype(env[n].dtype)
+            if sparse_plan is not None:
+                # one SelectedRows-form gradient per table: site
+                # (ids, dOut) pairs gathered over the data axes —
+                # collective bytes proportional to touched rows
+                _emb.install_sparse_grads(env, tap_grads, sparse_plan)
             loss_val = env[loss_name]
             env[framework.grad_var_name(loss_name)] = jnp.full(
                 loss_val.shape, loss_scale, loss_val.dtype)
@@ -1165,12 +1231,24 @@ def build_block_fn(program, block, feed_names, fetch_names,
             if shard_plan is not None and isinstance(v, _su.ShardVal):
                 # fetched as full
                 v = _su.gather_full(v, shard_plan, name=n)
+            if sparse_plan is not None:
+                if isinstance(v, _emb.TableShard):
+                    # fetched tables gather back to the logical shape
+                    v = _emb.gather_full(v, sparse_plan)
+                elif isinstance(v, _emb.SparseRowGrad):
+                    # debug fetch: the dense logical mean gradient
+                    v = _emb.densify(v, sparse_plan)
             fetches.append(v)
-        if shard_plan is None:
-            new_states = {n: env[n] for n in state_out if n in env}
-        else:
-            new_states = {n: _su.unwrap_out(n, env[n], shard_plan)
-                          for n in state_out if n in env}
+
+        def _out_val(n):
+            v = env[n]
+            if sparse_plan is not None:
+                v = _emb.unwrap_state(n, v, sparse_plan)
+            if shard_plan is not None:
+                v = _su.unwrap_out(n, v, shard_plan)
+            return v
+
+        new_states = {n: _out_val(n) for n in state_out if n in env}
         return fetches, new_states
 
     return fn
@@ -1217,6 +1295,23 @@ def compile_block(program, block, feed_specs, fetch_names, state_specs,
         program._dcn_axis = None
     dp_axis = getattr(program, "_dp_axis", "dp")
 
+    # vocab-sharded sparse embedding tables (FLAGS_tpu_sparse_embedding,
+    # paddle_tpu/embedding): planned FIRST so the ZeRO planner below
+    # leaves the sparse tables' optimizer ops/moments to the engine
+    sparse_plan = None
+    if mesh is not None and getattr(program, "_data_parallel", False) \
+            and getattr(program, "_auto_parallel", None) is None \
+            and not getattr(program, "_pipeline_cfg", None):
+        from ..embedding import planner as _emb_planner
+
+        ndev = int(mesh.shape[dp_axis]) if dp_axis in mesh.shape else 1
+        sparse_plan = _emb_planner.plan_sparse_tables(
+            program, block, ndev, dp_axis,
+            dcn_axis=(hier[0] if hier is not None else None),
+            dcn_size=(hier[2] if hier is not None else 1),
+            feed_names=feed_names)
+    program._sparse_plan = sparse_plan
+
     # ZeRO-1 sharded weight update (FLAGS_tpu_sharded_weight_update):
     # plan once per program; None = keep the replicated update
     shard_plan = None
@@ -1232,12 +1327,19 @@ def compile_block(program, block, feed_specs, fetch_names, state_specs,
             dcn_size=(hier[2] if hier is not None else 1))
     program._shard_plan = shard_plan
 
-    fn = build_block_fn(program, block, feed_names, fetch_names,
-                        state_in, state_out, shard_plan=shard_plan)
-
     state_out_set = set(state_out)
     state_mut = [n for n in state_in if n in state_out_set]
     state_ro = [n for n in state_in if n not in state_out_set]
+    if sparse_plan is not None:
+        # every row-sharded var must flow through the step as scope
+        # state (tables of a forward-only program ride state_ro)
+        sparse_plan = sparse_plan.prune(state_mut, state_ro)
+        program._sparse_plan = sparse_plan
+
+    fn = build_block_fn(program, block, feed_names, fetch_names,
+                        state_in, state_out, shard_plan=shard_plan,
+                        sparse_plan=sparse_plan)
+
     if shard_plan is not None:
         # a would-be-sharded state var must flow in AND out of the step;
         # anything else degrades to the replicated layout
@@ -1333,6 +1435,9 @@ def compile_block(program, block, feed_specs, fetch_names, state_specs,
                            dp_axis=dp_axis, feed_donate=feed_donate,
                            sharded_state=(dict(shard_plan.sharded_state)
                                           if shard_plan is not None
+                                          else None),
+                           sparse_tables=(dict(sparse_plan.state_vars)
+                                          if sparse_plan is not None
                                           else None))
 
 
@@ -1829,8 +1934,16 @@ def _compile_dp(fn, mesh, dp_axis, program, block, feed_names, fetch_names,
 
     ndev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
     axes = {a: mesh.shape[a] for a in mesh.axis_names}
-    sharded_names = frozenset(shard_plan.sharded_state) \
-        if shard_plan is not None else frozenset()
+    # vocab-sharded embedding tables + per-row moments share the
+    # dp-axis in/out spec with the ZeRO flat buffers: P(dp_axis) on a
+    # (padded_rows, dim) buffer shards dim 0 over the (intra-pod)
+    # axis and replicates across dcn pods — the same layout rule
+    sparse_plan = getattr(program, "_sparse_plan", None)
+    row_sharded = frozenset(sparse_plan.state_vars) \
+        if sparse_plan is not None else frozenset()
+    sharded_names = (frozenset(shard_plan.sharded_state)
+                     if shard_plan is not None else frozenset()) \
+        | row_sharded
     # hybrid (dcn, ici) mesh: data (batch) shards over BOTH axes —
     # row-major, so device (pod p, chip j) holds the same batch slice
     # as flat device p*ici+j — while sharded opt-state stays P(ici)
@@ -1851,9 +1964,16 @@ def _compile_dp(fn, mesh, dp_axis, program, block, feed_names, fetch_names,
     feed_specs = {n: P(data_axes) for n in feed_names}
     state_specs_mut = {n: (P(dp_axis) if n in sharded_names else P())
                        for n in state_mut}
-    state_specs_ro = {n: P() for n in state_ro}
+    # forward-only programs hold their sparse tables as read-only
+    # state — still row-sharded
+    state_specs_ro = {n: (P(dp_axis) if n in row_sharded else P())
+                      for n in state_ro}
 
     def out_spec_for_fetch(n):
+        if sparse_plan is not None and (
+                n in row_sharded or n in sparse_plan.grad_of):
+            # gathered table / densified SelectedRows grad: replicated
+            return P()
         v = block._find_var_recursive(n)
         if v is not None and v.persistable:
             return P()

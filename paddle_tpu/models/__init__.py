@@ -4,8 +4,11 @@
 2. ResNet-{18,34,50,101,152} (resnet.py) — ImageNet classification
 3. BERT-base pretraining (bert.py)  — MLM + NSP
 4. Transformer WMT en-de (transformer.py) — + jittable beam search
+5. CTR wide&deep / DLRM-tiny (ctr.py) — the sparse-embedding
+   recommender family (vocab-sharded tables, paddle_tpu/embedding)
 """
 from . import mnist  # noqa: F401
 from . import resnet  # noqa: F401
 from . import bert  # noqa: F401
 from . import transformer  # noqa: F401
+from . import ctr  # noqa: F401

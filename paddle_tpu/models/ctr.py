@@ -1,0 +1,134 @@
+"""CTR / recommender models — the sparse-embedding workload family.
+
+Two classic click-through-rate architectures over categorical slot ids
+plus dense features (reference: PaddleRec's wide_deep and the DLRM
+interaction idiom; the paper's Downpour-style "millions of users"
+workload class):
+
+- ``wide_deep``: a linear "wide" head over per-slot 1-d embeddings
+  plus a "deep" MLP over the concatenated slot embeddings and dense
+  features (Cheng et al. 2016).
+- ``dlrm_tiny``: bottom MLP over dense features, pairwise dot-product
+  interaction between the slot embeddings and the bottom output, top
+  MLP over [bottom, interactions] (Naumann et al. 2019, scaled to
+  tier-1 size).
+
+Every slot embedding is built with ``is_sparse=True`` so the
+vocab-sharded engine (paddle_tpu/embedding) plans it on data-parallel
+meshes: tables shard P(ici) on the vocab axis, lookups lower to
+all_gather(ids) -> mask-local-gather -> one psum_scatter, and the
+backward applies row-sparse updates on the owning shard — a second
+model family with a fundamentally different comm signature from
+BERT/ResNet (collective bytes ∝ touched rows, not params).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import fluid
+from ..fluid import layers
+
+
+class CTRConfig:
+    """Tiny tier-1 defaults; scale vocab_sizes up for bench runs."""
+
+    def __init__(self, vocab_sizes=(200, 120, 80, 50), embed_dim=8,
+                 dense_dim=4, hidden=(32, 16), arch="wide_deep",
+                 padding_idx=0):
+        self.vocab_sizes = tuple(int(v) for v in vocab_sizes)
+        self.embed_dim = int(embed_dim)
+        self.dense_dim = int(dense_dim)
+        self.hidden = tuple(int(h) for h in hidden)
+        self.arch = arch
+        self.padding_idx = padding_idx
+
+    @property
+    def slot_names(self):
+        return ["slot_%d" % i for i in range(len(self.vocab_sizes))]
+
+    @property
+    def feed_names(self):
+        return self.slot_names + ["dense", "label"]
+
+
+def _inputs(cfg: CTRConfig):
+    slots = [layers.data(name=n, shape=[1], dtype="int64")
+             for n in cfg.slot_names]
+    dense = layers.data(name="dense", shape=[cfg.dense_dim],
+                        dtype="float32")
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    return slots, dense, label
+
+
+def _slot_embeddings(cfg: CTRConfig, slots, dim, prefix):
+    embs = []
+    for i, (s, v) in enumerate(zip(slots, cfg.vocab_sizes)):
+        embs.append(layers.embedding(
+            s, size=[v, dim], is_sparse=True,
+            padding_idx=cfg.padding_idx,
+            param_attr=fluid.ParamAttr(name="%s_emb_%d" % (prefix, i))))
+    return embs
+
+
+def build_ctr_train(cfg: CTRConfig = None, lr=0.05, optimizer="adagrad"):
+    """Build the train program in the CURRENT default programs.
+    Returns (loss, auc_input_sigmoid, feed_names)."""
+    cfg = cfg or CTRConfig()
+    slots, dense, label = _inputs(cfg)
+    if cfg.arch == "dlrm_tiny":
+        embs = _slot_embeddings(cfg, slots, cfg.embed_dim, "dlrm")
+        bot = dense
+        for h in cfg.hidden:
+            bot = layers.fc(input=bot, size=h, act="relu")
+        bot = layers.fc(input=bot, size=cfg.embed_dim, act="relu")
+        feats = embs + [bot]
+        # pairwise dot interactions (the DLRM second-order term)
+        inter = []
+        for i in range(len(feats)):
+            for j in range(i + 1, len(feats)):
+                inter.append(layers.reduce_sum(
+                    feats[i] * feats[j], dim=1, keep_dim=True))
+        top = layers.concat([bot] + inter, axis=1)
+        for h in cfg.hidden:
+            top = layers.fc(input=top, size=h, act="relu")
+        logit = layers.fc(input=top, size=1)
+    else:  # wide_deep
+        wide_embs = _slot_embeddings(cfg, slots, 1, "wide")
+        deep_embs = _slot_embeddings(cfg, slots, cfg.embed_dim, "deep")
+        wide = layers.concat(wide_embs + [dense], axis=1)
+        wide_logit = layers.fc(input=wide, size=1)
+        deep = layers.concat(deep_embs + [dense], axis=1)
+        for h in cfg.hidden:
+            deep = layers.fc(input=deep, size=h, act="relu")
+        deep_logit = layers.fc(input=deep, size=1)
+        logit = wide_logit + deep_logit
+    labelf = layers.cast(label, "float32")
+    loss = layers.mean(layers.sigmoid_cross_entropy_with_logits(
+        logit, labelf))
+    prob = layers.sigmoid(logit)
+    O = fluid.optimizer
+    opt = {"sgd": lambda: O.SGDOptimizer(learning_rate=lr),
+           "adagrad": lambda: O.AdagradOptimizer(learning_rate=lr),
+           "adam": lambda: O.AdamOptimizer(learning_rate=lr),
+           }[optimizer]()
+    opt.minimize(loss)
+    return loss, prob, cfg.feed_names
+
+
+def synthetic_batch(cfg: CTRConfig, batch, seed=0, zipf=1.3):
+    """One synthetic CTR batch: Zipf-skewed slot ids (recommender id
+    popularity is long-tailed — the skew is what gives the cold tier
+    a working set), uniform dense features, and a label correlated
+    with the ids so training actually reduces loss."""
+    r = np.random.RandomState(seed)
+    feed = {}
+    score = np.zeros((batch,), np.float64)
+    for name, v in zip(cfg.slot_names, cfg.vocab_sizes):
+        ids = r.zipf(zipf, size=(batch,)) % (v - 1) + 1  # skip padding 0
+        feed[name] = ids.reshape(batch, 1).astype("int64")
+        score += (ids % 7) / 7.0
+    feed["dense"] = r.rand(batch, cfg.dense_dim).astype("float32")
+    score = score / len(cfg.vocab_sizes) + 0.2 * r.randn(batch)
+    feed["label"] = (score > np.median(score)).astype(
+        "int64").reshape(batch, 1)
+    return feed

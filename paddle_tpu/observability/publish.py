@@ -40,7 +40,7 @@ from typing import Optional
 from .registry import registry
 
 __all__ = ["phases_block", "collectives_blocks", "hierarchy_block",
-           "precision_block", "attribution_block",
+           "precision_block", "embedding_block", "attribution_block",
            "static_checks_block", "compile_cache_block",
            "serving_block", "telemetry_block", "bench_blocks"]
 
@@ -439,6 +439,88 @@ def serving_block() -> Optional[dict]:
     return block
 
 
+def embedding_block(exe, program, feed, fetch_list) -> Optional[dict]:
+    """Vocab-sharded embedding evidence (paddle_tpu/embedding): the
+    per-table shard layout and per-replica HBM (table + per-row
+    moments at padded_rows/N vs the replicated logical bytes), the
+    MODELED per-step collective bytes of the sparse schedule (ids
+    all_gathers + the lookup psum_scatter + tap gathers — all
+    proportional to TOUCHED ROWS) against the dense reference's
+    vocab-sized grad allreduce, and — when a cold-tier RowCache
+    published this process — its resident-rows / hit-rate / evicted
+    gauges. None when the program carries no sparse plan."""
+    if program is not None and hasattr(program, "_unwrap"):
+        program = program._unwrap()
+    plan = getattr(program, "_sparse_plan", None)
+    if plan is None:
+        return None
+    reg = registry()
+    batch_rows = 0
+    for t in plan.tables.values():
+        for s in t.sites:
+            a = (feed or {}).get(s.ids)
+            if a is not None:
+                import numpy as _np
+
+                batch_rows += int(_np.asarray(a).size)
+    tables = {}
+    logical_bytes = replica_bytes = dense_sync_bytes = 0
+    sparse_sync_bytes = 0
+    total_sites = max(sum(len(t.sites)
+                          for t in plan.tables.values()), 1)
+    for name, t in plan.tables.items():
+        info = t.info
+        itemsize = info.dtype.itemsize
+        n_state = 1 + len(t.row_state)  # table + per-row moments
+        t_logical = info.vocab * info.dim * itemsize * n_state
+        t_replica = info.rows_local * info.dim * itemsize * n_state
+        logical_bytes += t_logical
+        replica_bytes += t_replica
+        # dense reference: one vocab-sized fp32 grad allreduce/table
+        dense_sync_bytes += 2 * info.vocab * info.dim * itemsize
+        # sparse schedule per step: ids gather (int32) + (batch, dim)
+        # psum_scatter forward + (batch, dim) tap gather backward
+        site_rows = batch_rows // total_sites
+        sparse_sync_bytes += len(t.sites) * site_rows * (
+            4 + 2 * info.dim * itemsize)
+        tables[name] = {
+            "vocab": info.vocab, "dim": info.dim,
+            "padded_rows": info.padded_rows,
+            "rows_per_replica": info.rows_local,
+            "sites": len(t.sites), "optimizer": t.opt_type,
+            "row_state_vars": sorted(t.row_state.values()),
+        }
+    snap = reg.snapshot()
+    gauges = snap["gauges"]
+    block = {
+        "tables": tables,
+        "shards": plan.ndev,
+        "dcn_replicas": plan.dcn_size,
+        "state_logical_bytes": logical_bytes,
+        "state_per_replica_bytes": replica_bytes,
+        "modeled_sparse_sync_bytes_per_step": sparse_sync_bytes,
+        "modeled_dense_sync_bytes_per_step": dense_sync_bytes,
+        "touched_rows_per_step": batch_rows,
+    }
+    if gauges.get("embedding.resident_rows") is not None:
+        block["row_cache"] = {
+            "resident_rows": gauges.get("embedding.resident_rows"),
+            "hit_rate": gauges.get("embedding.hit_rate"),
+            "evicted_rows": gauges.get("embedding.evicted_rows"),
+        }
+    reg.publish_block("embedding", block)
+    print("BENCH embedding: %d table(s) sharded %d-way, state "
+          "%.2fMB -> %.2fMB/replica, sync bytes/step %.3fMB sparse "
+          "vs %.3fMB dense (%d touched rows)%s"
+          % (len(tables), plan.ndev, logical_bytes / 1e6,
+             replica_bytes / 1e6, sparse_sync_bytes / 1e6,
+             dense_sync_bytes / 1e6, batch_rows,
+             (", cache hit %.1f%%" % (100 * (block["row_cache"]
+                                             ["hit_rate"] or 0))
+              if "row_cache" in block else "")), flush=True)
+    return block
+
+
 def telemetry_block(group=None) -> dict:
     """Registry roll-up: counters, step count, JSONL sink location —
     and, when a host-collective `group` spans the run's ranks, the
@@ -480,6 +562,7 @@ def bench_blocks(exe, program, feed, fetch_list, group=None) -> dict:
     collectives_blocks(exe, program, feed, fetch_list)
     hierarchy_block(exe, program, feed, fetch_list)
     precision_block(exe, program, feed, fetch_list)
+    embedding_block(exe, program, feed, fetch_list)
     attribution_block(exe, program, feed, fetch_list)
     static_checks_block(program)
     compile_cache_block()

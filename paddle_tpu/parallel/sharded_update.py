@@ -432,9 +432,19 @@ def plan_sharded_update(program, block, ndev, dp_axis, dcn_axis=None,
     gradient_merge = bop.attrs.get("gradient_merge") is not None
     post = ops[bwd_idx + 1:]
 
+    # optimizer ops owned by the sparse-embedding engine (vocab-sharded
+    # tables, paddle_tpu/embedding): their row-sparse update runs in
+    # table-shard space with its own plan — this planner neither claims
+    # their grads/moments nor declines the program over them
+    _sparse_plan = getattr(program, "_sparse_plan", None)
+    sparse_opt_ids = frozenset(_sparse_plan.opt_op_ids) \
+        if _sparse_plan is not None else frozenset()
+
     opt_ops = []
     for op in post:
         if "ParamOut" not in op.output_names:
+            continue
+        if id(op) in sparse_opt_ids:
             continue
         if op.type not in SUPPORTED_OPT:
             _record_fallback(program, "optimizer op is not shard-aware",
@@ -1203,12 +1213,18 @@ def unshard_scope_value(program, name, value):
     unchanged. Keeps .pdparams/persistables files layout-stable whether
     or not the sharded update was active."""
     plan = getattr(program, "_shard_plan", None)
-    if plan is None:
-        return value
-    info = plan.sharded_state.get(name)
-    if info is None:
-        return value
-    return info.unshard(value)
+    if plan is not None:
+        info = plan.sharded_state.get(name)
+        if info is not None:
+            return info.unshard(value)
+    # vocab-sharded embedding tables + per-row moments save at their
+    # logical (vocab, dim) shapes too (paddle_tpu/embedding)
+    splan = getattr(program, "_sparse_plan", None)
+    if splan is not None:
+        rinfo = splan.state_vars.get(name)
+        if rinfo is not None:
+            return rinfo.unshard(value)
+    return value
 
 
 # ---------------------------------------------------------------------------

@@ -60,6 +60,21 @@ _FLAGS: Dict[str, object] = {
     # HLO); programs the planner can't prove shardable fall back
     # automatically. See paddle_tpu/parallel/README.md.
     "FLAGS_tpu_sharded_weight_update": True,
+    # Vocab-sharded sparse embedding engine (paddle_tpu/embedding): on
+    # a data-parallel mesh, lookup_table/embedding ops marked
+    # is_sparse=True shard their tables on the vocab axis (P(ici),
+    # replicated across dcn pods like ZeRO state) — the lookup lowers
+    # to all_gather(ids) -> mask-local-gather -> one psum_scatter, the
+    # backward applies row-sparse scatter-add updates on the owning
+    # shard with per-row moments sharded alongside, and no dense
+    # vocab-sized grad or moment is ever materialized. Off = today's
+    # replicated dense table; unprovable tables degrade per-table with
+    # a recorded reason (program._sparse_embedding_fallback).
+    "FLAGS_tpu_sparse_embedding": True,
+    # Also shard UNMARKED tables whose vocab meets this row count
+    # (0 = only is_sparse-marked tables shard). Lets an existing model
+    # opt in without touching its embedding() calls.
+    "FLAGS_tpu_embedding_shard_min_rows": 0,
     # Bucketed, backward-ordered gradient collectives (Kumar et al.
     # 2019, arXiv:1909.09756 §4 "overlapping gradient summation with
     # backprop"): optimizer-bound grads are grouped into size-bounded

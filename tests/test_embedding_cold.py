@@ -1,0 +1,382 @@
+"""Cold tier: PS-backed row cache (paddle_tpu/embedding/cold.py) —
+fault-in/eviction mechanics, admission-by-touch-frequency, the
+capped==uncapped training contract, exactly-once across a pserver
+kill/restart, and schema-valid telemetry events."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.distributed.rpc import RpcClient, RpcServer
+from paddle_tpu.fluid import framework
+from paddle_tpu.utils.flags import get_flag, set_flags
+
+VOCAB, DIM = 64, 8
+
+
+@pytest.fixture(autouse=True)
+def _flags():
+    old = {k: get_flag(k) for k in
+           ("FLAGS_tpu_sparse_embedding", "FLAGS_tpu_comm_bucket_mb")}
+    yield
+    set_flags(old)
+
+
+def _fresh():
+    from paddle_tpu.core import scope as scope_mod
+
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    scope_mod._global_scope = scope_mod.Scope()
+
+
+def _scope():
+    from paddle_tpu.core import scope as scope_mod
+
+    return scope_mod._global_scope
+
+
+def _ps(tmp_path=None, trainers=1):
+    from paddle_tpu.distributed.ps import ParameterServer
+    from paddle_tpu.fluid import framework as fw
+
+    ps = ParameterServer(fw.Program(), None, trainers=trainers,
+                         mode="async",
+                         ckpt_dir=(str(tmp_path) if tmp_path else None),
+                         ckpt_every=1)
+    srv = RpcServer("127.0.0.1", 0, ps.handle)
+    srv.start()
+    return ps, srv, RpcClient("127.0.0.1:%d" % srv.port)
+
+
+class _HostScope:
+    """Dict-backed scope stand-in for cache unit tests."""
+
+    def __init__(self, **vars_):
+        self._v = dict(vars_)
+
+    def find_var(self, n):
+        return self._v.get(n)
+
+    def set_var(self, n, v):
+        self._v[n] = v
+
+
+def _cache(client, capacity, scope=None, admit_after=2):
+    from paddle_tpu.embedding import RowCache
+
+    scope = scope or _HostScope(
+        emb=np.zeros((capacity, DIM), np.float32),
+        emb_m=np.zeros((capacity, DIM), np.float32))
+    c = RowCache(client, "emb", VOCAB, DIM, capacity, scope=scope,
+                 var_name="emb", moment_vars={"emb_m": "Moment"},
+                 admit_after=admit_after)
+    return c, scope
+
+
+def test_fault_in_eviction_and_roundtrip(tmp_path):
+    ps, srv, cli = _ps()
+    try:
+        c, scope = _cache(cli, capacity=8)
+        full = np.arange(VOCAB * DIM, dtype=np.float32).reshape(
+            VOCAB, DIM)
+        c.seed_ps(full)
+        slots = c.translate(np.array([3, 5, 3, 9]))
+        assert slots.shape == (4,)
+        assert slots[0] == slots[2]  # duplicate id, same slot
+        # out-of-range ids map PAST the slot table (the sharded lookup
+        # masks them to zeros) — never onto another row's slot
+        oov = c.translate(np.array([3, -1, VOCAB + 7]))
+        assert oov[0] == slots[0]
+        assert oov[1] == c.capacity and oov[2] == c.capacity
+        # faulted rows carry the authoritative values
+        dev = np.asarray(scope.find_var("emb"))
+        np.testing.assert_array_equal(dev[slots[1]], full[5])
+        assert c.resident_rows == 3 and c._misses == 3
+        # second touch: all hits
+        c.translate(np.array([3, 5, 9]))
+        assert c._misses == 3 and c._hits >= 3
+        # capacity pressure: 8 resident max, evictions demote EXACT
+        # device values (here: mutate a device row first)
+        dev = np.asarray(scope.find_var("emb")).copy()
+        dev[slots[0]] = 42.0
+        scope.set_var("emb", dev)
+        c.translate(np.arange(10, 16))  # 6 new ids > free slots
+        assert c.resident_rows <= 8
+        assert c._evicted > 0
+        c.flush()
+        got = c.ps_table()
+        np.testing.assert_array_equal(got[3], np.full((DIM,), 42.0))
+        # untouched rows keep their seed values
+        np.testing.assert_array_equal(got[60], full[60])
+    finally:
+        srv.shutdown()
+        ps.heartbeat.stop()
+
+
+def test_admission_by_touch_frequency():
+    ps, srv, cli = _ps()
+    try:
+        c, _ = _cache(cli, capacity=4, admit_after=2)
+        c.seed_ps(np.zeros((VOCAB, DIM), np.float32))
+        c.translate(np.array([1, 2]))
+        c.translate(np.array([1, 2]))  # rows 1,2 admitted (2 touches)
+        c.translate(np.array([3, 4]))  # one-hit wonders
+        # 1 free slot short: the never-admitted rows evict FIRST
+        c.translate(np.array([5, 6, 7]))
+        resident = set(c._slot_of)
+        # both one-hit wonders went first; the LRU admitted row (1)
+        # paid the third slot — 2 (equally admitted, same recency
+        # class) survives
+        assert 3 not in resident and 4 not in resident
+        assert 2 in resident, resident
+        assert c._evicted >= 3
+    finally:
+        srv.shutdown()
+        ps.heartbeat.stop()
+
+
+def test_prefetch_overlaps_and_matches_sync(tmp_path):
+    ps, srv, cli = _ps()
+    try:
+        c, scope = _cache(cli, capacity=16)
+        full = np.random.RandomState(0).rand(
+            VOCAB, DIM).astype(np.float32)
+        c.seed_ps(full)
+        ids = np.array([7, 11, 13])
+        c.prefetch(ids)
+        slots = c.translate(ids)  # joins the background fault-in
+        dev = np.asarray(scope.find_var("emb"))
+        for i, s in zip(ids, slots):
+            np.testing.assert_array_equal(dev[s], full[i])
+    finally:
+        srv.shutdown()
+        ps.heartbeat.stop()
+
+
+def test_telemetry_events_schema_valid():
+    from paddle_tpu.observability import flight, schema
+    from paddle_tpu.observability.registry import registry
+
+    ps, srv, cli = _ps()
+    try:
+        reg = registry()
+        c, _ = _cache(cli, capacity=4)
+        c.seed_ps(np.zeros((VOCAB, DIM), np.float32))
+        c.translate(np.array([1, 2, 3]))
+        c.translate(np.array([9, 10, 11]))  # forces evictions
+        # events fan out through the flight recorder ring (and the
+        # JSONL sink when FLAGS_tpu_telemetry_dir is set)
+        recs = [r for r in flight.recorder().snapshot()["events"]
+                if r.get("event") in ("embedding_fetch",
+                                      "embedding_evict")]
+        fetches = [r for r in recs if r["event"] == "embedding_fetch"]
+        evicts = [r for r in recs if r["event"] == "embedding_evict"]
+        assert fetches and evicts
+        problems = schema.validate_records(recs)
+        assert not problems, problems
+        assert sum(r["rows_fetched"] for r in fetches) >= 6
+        assert sum(r["rows_evicted"] for r in evicts) >= 2
+        assert reg.gauge("embedding.resident_rows").value <= 4
+    finally:
+        srv.shutdown()
+        ps.heartbeat.stop()
+
+
+# -- the acceptance leg: capped table trains to the SAME loss ---------------
+
+def _ctr_step_fn(cap_vocab):
+    """One-table CTR-ish model whose embedding var holds `cap_vocab`
+    rows (the device slot table for capped runs)."""
+    framework.default_main_program().random_seed = 11
+    framework.default_startup_program().random_seed = 11
+    ids = fluid.layers.data(name="ids", shape=[1], dtype="int64")
+    dense = fluid.layers.data(name="dense", shape=[4], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    emb = fluid.layers.embedding(
+        ids, size=[cap_vocab, DIM], is_sparse=True, padding_idx=0,
+        param_attr=fluid.ParamAttr(name="ctr_emb"))
+    h = fluid.layers.concat([emb, dense], axis=1)
+    h = fluid.layers.fc(input=h, size=16, act="relu")
+    logits = fluid.layers.fc(input=h, size=2)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, label))
+    fluid.optimizer.AdagradOptimizer(learning_rate=0.1).minimize(loss)
+    return loss
+
+
+def _batches(steps, batch=32, seed=5):
+    r = np.random.RandomState(seed)
+    out = []
+    for _ in range(steps):
+        ids = r.randint(0, VOCAB, (batch, 1))
+        ids[:3] = 0  # padding positions in every batch
+        out.append({
+            "ids": ids.astype("int64"),
+            "dense": r.rand(batch, 4).astype("float32"),
+            "label": r.randint(0, 2, (batch, 1)).astype("int64")})
+    return out
+
+
+def _moment_name(prog):
+    return next(n for n in (v.name for v in
+                            prog.global_block().vars.values())
+                if "ctr_emb" in n and "moment" in n)
+
+
+def test_capped_trains_to_same_loss_as_uncapped():
+    """A table capped below its full size (40 of 64 rows resident)
+    trains BIT-IDENTICALLY to the uncapped run: rows fault in on
+    demand with their moments, evictions demote exact values, and the
+    slot-table update math is slot-index-independent."""
+    import jax
+
+    from paddle_tpu.embedding import RowCache
+
+    steps = 6
+    batches = _batches(steps)
+    ndev = 4
+
+    # uncapped reference (vocab-sized table, raw ids)
+    _fresh()
+    set_flags({"FLAGS_tpu_sparse_embedding": True,
+               "FLAGS_tpu_comm_bucket_mb": 0.0})
+    with framework.unique_name_guard():
+        loss = _ctr_step_fn(VOCAB)
+        prog = fluid.default_main_program()
+        fluid.CompiledProgram(prog).with_data_parallel(
+            loss_name=loss.name)
+        prog._mesh = jax.sharding.Mesh(
+            np.array(jax.devices()[:ndev]), ("dp",))
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        init_full = np.asarray(_scope().find_var("ctr_emb")).copy()
+        ref_losses = [float(exe.run(prog, feed=b,
+                                    fetch_list=[loss])[0].mean())
+                      for b in batches]
+        from paddle_tpu.parallel.sharded_update import \
+            unshard_scope_value
+
+        ref_table = np.asarray(unshard_scope_value(
+            prog, "ctr_emb", _scope().find_var("ctr_emb"))).copy()
+
+    # capped run: 40-slot device table, authoritative rows on the PS
+    cap = 40
+    ps, srv, cli = _ps()
+    try:
+        _fresh()
+        with framework.unique_name_guard():
+            loss = _ctr_step_fn(cap)
+            prog = fluid.default_main_program()
+            fluid.CompiledProgram(prog).with_data_parallel(
+                loss_name=loss.name)
+            prog._mesh = jax.sharding.Mesh(
+                np.array(jax.devices()[:ndev]), ("dp",))
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            mname = _moment_name(prog)
+            cache = RowCache(cli, "ctr_emb", VOCAB, DIM, cap,
+                             scope=_scope(), var_name="ctr_emb",
+                             moment_vars={mname: "Moment"},
+                             padding_idx=0)
+            # authoritative init = the SAME initial table the uncapped
+            # run drew (its first `cap` rows seeded the device table)
+            cache.seed_ps(init_full)
+            _scope().set_var("ctr_emb",
+                             np.zeros((cap, DIM), np.float32))
+            cap_losses = []
+            for i, b in enumerate(batches):
+                feed = dict(b)
+                feed["ids"] = cache.translate(b["ids"])
+                if i + 1 < len(batches):
+                    # overlap the NEXT batch's PS round-trip with this
+                    # step's compute (the reader-prefetcher idiom)
+                    cache.prefetch(batches[i + 1]["ids"])
+                cap_losses.append(float(exe.run(
+                    prog, feed=feed, fetch_list=[loss])[0].mean()))
+            assert cache._evicted > 0, "capacity never pressured"
+            cache.flush()
+            cap_table = cache.ps_table()
+    finally:
+        srv.shutdown()
+        ps.heartbeat.stop()
+
+    assert cap_losses == ref_losses
+    np.testing.assert_array_equal(cap_table, ref_table)
+
+
+# -- exactly-once across a pserver kill/restart ------------------------------
+
+def test_cold_rows_survive_pserver_kill_and_dedup(tmp_path):
+    """A demotion applied-and-persisted before the server dies is
+    answered from the restored dedup marker on retry (never
+    re-applied... write_rows is an exact write, but the marker proves
+    the envelope short-circuits), and the reborn server serves the
+    demoted rows — the cache keeps working across the restart."""
+    import socket
+
+    from paddle_tpu.distributed.rpc import (_ENVELOPE, read_msg,
+                                            write_msg)
+
+    ps1, srv1, cli = _ps(tmp_path)
+    full = np.random.RandomState(1).rand(VOCAB, DIM).astype(np.float32)
+    try:
+        c, scope = _cache(cli, capacity=4)
+        c.seed_ps(full)
+        c.translate(np.array([1, 2, 3, 4]))
+        dev = np.asarray(scope.find_var("emb")).copy()
+        dev[:] = 7.5
+        scope.set_var("emb", dev)
+        c.translate(np.array([9, 10, 11, 12]))  # demotes rows 1..4
+        assert c._evicted >= 4
+        # flush so the LAST rpc is a marked write_rows (lookup_rows is
+        # read-only and records no dedup marker) — retry_seq below
+        # must name an APPLIED mutation
+        c.flush()
+        retry_seq = cli._seq
+        rows_after = np.asarray(ps1.scope.find_var("emb")).copy()
+        np.testing.assert_array_equal(rows_after[1],
+                                      np.full((DIM,), 7.5))
+    finally:
+        srv1.shutdown()
+        ps1.heartbeat.stop()
+
+    # reborn server: tables + dedup markers restore from disk
+    from paddle_tpu.distributed.ps import ParameterServer
+    from paddle_tpu.fluid import framework as fw
+
+    ps2 = ParameterServer(fw.Program(), None, trainers=1, mode="async",
+                          ckpt_dir=str(tmp_path), ckpt_every=1)
+    dedup = ps2.restore_from_checkpoint()
+    assert dedup and cli._cid in dedup
+    np.testing.assert_array_equal(
+        np.asarray(ps2.scope.find_var("emb")), rows_after)
+    srv2 = RpcServer("127.0.0.1", 0, ps2.handle)
+    srv2.dedup_restore(dedup)
+    srv2.start()
+    try:
+        # the lost-response retry of the LAST demotion short-circuits
+        # at the marker
+        s = socket.create_connection(("127.0.0.1", srv2.port))
+        try:
+            write_msg(s, [_ENVELOPE, cli._cid, retry_seq, "write_rows",
+                          "emb", np.asarray([1], np.int64),
+                          np.zeros((1, DIM), np.float32), 0])
+            resp = read_msg(s)
+            assert resp and resp[0] == "ok", resp
+            # NOT re-applied: row 1 keeps its demoted 7.5s, not zeros
+            np.testing.assert_array_equal(
+                np.asarray(ps2.scope.find_var("emb"))[1],
+                np.full((DIM,), 7.5))
+        finally:
+            s.close()
+        # a fresh cache against the reborn server reads demoted rows
+        cli2 = RpcClient("127.0.0.1:%d" % srv2.port)
+        c2, scope2 = _cache(cli2, capacity=8)
+        slots = c2.translate(np.array([1, 9]))
+        dev2 = np.asarray(scope2.find_var("emb"))
+        np.testing.assert_array_equal(dev2[slots[0]],
+                                      np.full((DIM,), 7.5))
+        np.testing.assert_array_equal(dev2[slots[1]], full[9])
+    finally:
+        srv2.shutdown()
+        ps2.heartbeat.stop()

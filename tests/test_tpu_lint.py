@@ -679,6 +679,118 @@ def test_zero2_pending_bucket_early_flush_warns():
 # checker 5 — dtype/shape contracts
 # ---------------------------------------------------------------------------
 
+def _planned_sparse_program(opt="adagrad"):
+    from paddle_tpu.embedding import plan_sparse_tables
+
+    ids = fluid.layers.data(name="ids", shape=[1], dtype="int64")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    emb = fluid.layers.embedding(
+        ids, size=[37, 8], is_sparse=True,
+        param_attr=fluid.ParamAttr(name="lint_emb"))
+    logits = fluid.layers.fc(input=emb, size=4)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, label))
+    O = fluid.optimizer
+    {"adagrad": lambda: O.AdagradOptimizer(0.1),
+     "sgd": lambda: O.SGDOptimizer(0.1)}[opt]().minimize(loss)
+    prog = fluid.default_main_program()
+    fluid.CompiledProgram(prog).with_data_parallel(loss_name=loss.name)
+    plan = plan_sparse_tables(prog, prog.global_block(), 8, "dp",
+                              feed_names=["ids", "label"])
+    assert plan is not None and "lint_emb" in plan.tables, \
+        getattr(prog, "_sparse_embedding_fallback", None)
+    prog._sparse_plan = plan
+    return prog, plan
+
+
+def test_sparse_update_valid_plan_is_clean():
+    prog, _ = _planned_sparse_program()
+    assert not analysis.check_sparse_update(prog)
+
+
+def test_sparse_grad_consumed_by_foreign_op_trips():
+    """A non-shard-aware op reading the table's SelectedRows gradient
+    (inserted after planning) = error, located at the offending op —
+    the static twin of the engine's trace-time raise."""
+    prog, plan = _planned_sparse_program()
+    blk = prog.global_block()
+    g = sorted(plan.grad_of)[0]
+    out = blk.create_var(name="lint.sparse.out", shape=(37, 8),
+                         dtype="float32")
+    idx = _bwd_idx(blk) + 1
+    blk.ops.insert(idx, Operator(
+        blk, "elementwise_mul", inputs={"X": [g], "Y": [g]},
+        outputs={"Out": [out.name]}, attrs={}))
+    fs = analysis.check_sparse_update(prog)
+    assert len(fs) == 1
+    f = fs[0]
+    assert f.severity == "error" and f.op_type == "elementwise_mul"
+    assert f.op_idx == idx and f.var == g
+    assert "no sparse-aware rule" in f.message
+
+
+def test_sparse_table_touched_outside_engine_trips():
+    prog, plan = _planned_sparse_program()
+    blk = prog.global_block()
+    out = blk.create_var(name="lint.sparse.scale", shape=(37, 8),
+                         dtype="float32")
+    blk.ops.insert(0, Operator(
+        blk, "scale", inputs={"X": ["lint_emb"]},
+        outputs={"Out": [out.name]}, attrs={"scale": 2.0}))
+    fs = analysis.check_sparse_update(prog)
+    assert any(f.severity == "error" and f.var == "lint_emb"
+               and f.op_type == "scale" for f in fs)
+
+
+def test_sparse_tampered_row_layout_trips():
+    prog, plan = _planned_sparse_program()
+    info = plan.tables["lint_emb"].info
+    info.padded_rows = info.padded_rows + 1  # no longer ndev-aligned
+    fs = analysis.check_sparse_update(prog)
+    assert any(f.severity == "error" and f.var == "lint_emb"
+               and "misalign" in f.message for f in fs)
+
+
+def test_sparse_fetch_of_selectedrows_grad_warns():
+    prog, plan = _planned_sparse_program()
+    g = sorted(plan.grad_of)[0]
+    fs = analysis.check_sparse_update(prog, fetch_names=[g])
+    assert len(fs) == 1
+    assert fs[0].severity == "warning" and fs[0].var == g
+    assert "densifies" in fs[0].message
+
+
+def test_rank_divergent_table_shard_schedule_trips():
+    """Rank 0 shards the table (sparse plan), rank 1 does not (e.g. a
+    per-rank flag skew): their collective schedules diverge at the
+    lookup — the deadlock class the divergence checker exists for."""
+    prog0, _ = _planned_sparse_program()
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    with framework.unique_name_guard():
+        prog1, _ = _planned_sparse_program()
+    prog1._sparse_plan = None  # rank 1 "planned" nothing
+    recs = analysis.collective_schedule(prog0)
+    assert any(r["kind"] == "sparse_lookup" and r["var"] == "lint_emb"
+               for r in recs)
+    fs = analysis.check_collective_divergence([prog0, prog1])
+    assert any(f.severity == "error" for f in fs), fs
+
+
+def test_zero1_skips_engine_owned_optimizer_ops():
+    """The sparse table's optimizer op consumes a SelectedRows grad
+    with its OWN schedule — the zero1 checker must not flag it as
+    'never reduce-scattered' (the taint-vocabulary extension)."""
+    from paddle_tpu.parallel import sharded_update as su
+
+    prog, _ = _planned_sparse_program()
+    prog._shard_plan = su.plan_sharded_update(
+        prog, prog.global_block(), 8, "dp")
+    assert prog._shard_plan is not None  # fc params still plan dense
+    assert not analysis.check_shard_plan(prog)
+    assert not analysis.check_zero2_lifetimes(prog)
+
+
 def test_dtype_contract_drift_and_fp64_promotion():
     x = fluid.layers.data(name="x", shape=[4], dtype="float32")
     y = fluid.layers.scale(x, scale=2.0)
@@ -947,8 +1059,8 @@ def test_exemplar_programs_lint_clean():
     tpu_lint = _import_tpu_lint()
     results = tpu_lint.lint_exemplars()
     assert set(results) == {"bert_tiny", "bert_tiny_amp", "mlp_hier",
-                            "resnet_scan", "serving_decode",
-                            "fleet_ps_2rank"}
+                            "embedding_ctr", "resnet_scan",
+                            "serving_decode", "fleet_ps_2rank"}
     for name, (findings, summary) in results.items():
         errs = [analysis.format_finding(f) for f in findings
                 if f.severity == "error"]
@@ -966,7 +1078,8 @@ def test_cli_end_to_end(tmp_path):
     report = json.loads(out.read_text())
     assert report["ok"] and report["total_errors"] == 0
     assert set(report["programs"]) == {"bert_tiny", "bert_tiny_amp",
-                                       "mlp_hier", "resnet_scan",
+                                       "mlp_hier", "embedding_ctr",
+                                       "resnet_scan", "serving_decode",
                                        "fleet_ps_2rank"}
     assert "tpu-lint:" in r.stdout
 

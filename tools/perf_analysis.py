@@ -14,6 +14,7 @@ PERF_ANALYSIS_r4.md with:
 
 Usage: python tools/perf_analysis.py [--batches 256,512]
        python tools/perf_analysis.py --sharded-diff
+       python tools/perf_analysis.py --embedding
        python tools/perf_analysis.py --overlap-audit [--bucket-mb 0.25]
        python tools/perf_analysis.py --hierarchy [--dcn 2]
        python tools/perf_analysis.py --attribution [--bucket-mb 0.25]
@@ -97,6 +98,14 @@ artifacts/sharded_update_diff.json — the no-chip evidence the
 acceptance criteria call for. Exits nonzero when the reduction does
 not hold.
 
+`--embedding` is the same-shape check for the vocab-sharded embedding
+engine (FLAGS_tpu_sparse_embedding, paddle_tpu/embedding): it lowers
+a CTR wide&deep train step with the engine off and on, asserts NO
+sharded-path collective carries a vocab-sized payload (bytes scale
+with touched rows) and the per-replica table+moment bytes are exactly
+1/N, runs a Zipf-skewed cold-tier RowCache simulation for the
+hit-rate/eviction numbers, and writes artifacts/embedding_diff.json.
+
 `--overlap-audit` is the offline scheduling check for the bucketed,
 backward-ordered grad collectives (FLAGS_tpu_comm_bucket_mb): it
 compiles the SAME data-parallel BERT-tiny train step with bucketing on
@@ -120,7 +129,8 @@ import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 if ("--sharded-diff" in sys.argv or "--overlap-audit" in sys.argv
-        or "--hierarchy" in sys.argv or "--attribution" in sys.argv) \
+        or "--hierarchy" in sys.argv or "--attribution" in sys.argv
+        or "--embedding" in sys.argv) \
         and \
         "xla_force_host_platform_device_count" not in \
         os.environ.get("XLA_FLAGS", ""):
@@ -319,6 +329,144 @@ def analytical_resnet(batch, n_params, act_elems):
         "peak_gb": peak / 1e9,
         "fits": peak < V5E_HBM,
     }
+
+
+def embedding_diff(batch=64, vocab=4096, dim=16, steps=3):
+    """Lower a CTR train step with the vocab-sharded embedding engine
+    off/on; diff the measured collective bytes (census) and the
+    per-replica table+moment bytes, then run a small cold-tier
+    simulation (in-process pserver + RowCache over Zipf-skewed
+    batches) for the row-cache hit rate; write
+    artifacts/embedding_diff.json. Returns 0 when the sharded form
+    shows touched-rows (not vocab) collective scaling and ~1/N state,
+    1 otherwise."""
+    import json
+
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework
+    from paddle_tpu.models import ctr
+    from paddle_tpu.utils.flags import set_flags
+
+    cfg = ctr.CTRConfig(vocab_sizes=(vocab, vocab // 2),
+                        embed_dim=dim, arch="wide_deep")
+
+    def one(flag):
+        from paddle_tpu.core import scope as scope_mod
+
+        framework.switch_main_program(framework.Program())
+        framework.switch_startup_program(framework.Program())
+        scope_mod._global_scope = scope_mod.Scope()
+        set_flags({"FLAGS_tpu_sparse_embedding": flag})
+        with framework.unique_name_guard():
+            framework.default_main_program().random_seed = 7
+            framework.default_startup_program().random_seed = 7
+            loss, _, _ = ctr.build_ctr_train(cfg)
+            prog = fluid.default_main_program()
+            fluid.CompiledProgram(prog).with_data_parallel(
+                loss_name=loss.name)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            feed = ctr.synthetic_batch(cfg, batch)
+            exe.run(prog, feed=feed, fetch_list=[loss])
+            col = exe.collective_report(prog, feed=feed,
+                                        fetch_list=[loss])
+            plan = getattr(prog, "_sparse_plan", None)
+            fallback = list(getattr(prog,
+                                    "_sparse_embedding_fallback",
+                                    None) or [])
+        return col, plan, fallback
+
+    col_off, _, _ = one(False)
+    col_on, plan, fallback = one(True)
+    itemsize = 4
+    n_tables = len(plan.tables) if plan else 0
+    state_logical = state_replica = 0
+    for t in (plan.tables.values() if plan else ()):
+        n_state = 1 + len(t.row_state)
+        state_logical += t.info.vocab * t.info.dim * itemsize * n_state
+        state_replica += (t.info.rows_local * t.info.dim * itemsize
+                          * n_state)
+    biggest_on = max(
+        (v["tensor_bytes"] / max(v["count"], 1)
+         for k, v in col_on.items()
+         if isinstance(v, dict) and "tensor_bytes" in v), default=0)
+    vocab_grad_bytes = min(
+        t.info.vocab * t.info.dim * itemsize
+        for t in plan.tables.values()) if plan else 0
+
+    # cold-tier hit-rate simulation: Zipf-skewed ids against a capped
+    # RowCache over an in-process pserver
+    from paddle_tpu.distributed.ps import ParameterServer
+    from paddle_tpu.distributed.rpc import RpcClient, RpcServer
+    from paddle_tpu.embedding import RowCache
+    from paddle_tpu.fluid import framework as fw
+
+    ps = ParameterServer(fw.Program(), None, trainers=1, mode="async")
+    srv = RpcServer("127.0.0.1", 0, ps.handle)
+    srv.start()
+    try:
+        cli = RpcClient("127.0.0.1:%d" % srv.port)
+
+        cap = batch + 32  # small enough that the tail evicts
+
+        class _HostScope:
+            def __init__(self):
+                self._v = {"t": np.zeros((cap, dim), np.float32)}
+
+            def find_var(self, n):
+                return self._v.get(n)
+
+            def set_var(self, n, v):
+                self._v[n] = v
+
+        cache = RowCache(cli, "t", vocab, dim, cap,
+                         scope=_HostScope(), var_name="t")
+        cache.seed_ps(np.zeros((vocab, dim), np.float32))
+        r = np.random.RandomState(0)
+        for _ in range(12):
+            ids = r.zipf(1.3, size=(batch,)) % vocab
+            cache.translate(ids)
+        cache_stats = cache.stats()
+    finally:
+        srv.shutdown()
+        ps.heartbeat.stop()
+
+    out = {
+        "model": "ctr wide_deep b%d vocab%d" % (batch, vocab),
+        "ndev": col_on.get("ndev"),
+        "tables_sharded": n_tables,
+        "replicated": {"collectives": col_off},
+        "sharded": {"collectives": col_on},
+        "state_bytes": {"logical": state_logical,
+                        "per_replica": state_replica},
+        "largest_sharded_collective_bytes": biggest_on,
+        "smallest_vocab_grad_bytes": vocab_grad_bytes,
+        "row_cache": cache_stats,
+        "fallback_reasons": fallback,
+    }
+    path = os.path.join(_REPO, "artifacts", "embedding_diff.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2, sort_keys=True)
+    ndev = max(int(col_on.get("ndev") or 1), 1)
+    ok = (n_tables == 2 * len(cfg.vocab_sizes)
+          and state_replica * ndev == state_logical
+          # no sharded-path collective carries a vocab-sized payload
+          and biggest_on < vocab_grad_bytes
+          and 0.0 < cache_stats["hit_rate"] < 1.0
+          and cache_stats["evicted_rows"] > 0)
+    print("embedding diff: %d tables sharded %d-way, state %.2fMB -> "
+          "%.2fMB/replica, largest sharded collective %.1fKB (vocab "
+          "grad would be >= %.1fKB), cold-tier hit rate %.1f%% "
+          "(%d evicted) -> %s; wrote %s"
+          % (n_tables, ndev, state_logical / 1e6, state_replica / 1e6,
+             biggest_on / 1e3, vocab_grad_bytes / 1e3,
+             100 * cache_stats["hit_rate"],
+             cache_stats["evicted_rows"],
+             "OK" if ok else "MISMATCH", path))
+    return 0 if ok else 1
 
 
 def sharded_update_diff(batch=16, seq_len=32):
@@ -1023,6 +1171,8 @@ def main():
             [a for a in args if a != "--lint"]))
     if "--sharded-diff" in args:
         raise SystemExit(sharded_update_diff())
+    if "--embedding" in args:
+        raise SystemExit(embedding_diff())
 
     def _parse_bucket_mb(argv, default=0.25):
         mb = default

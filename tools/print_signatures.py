@@ -72,6 +72,11 @@ _MODULES = [
     # legs and tools/perf_analysis.py --compile-cache build on — lock
     # the surface
     "paddle_tpu.serving",
+    # vocab-sharded embedding engine: planner/engine/row-cache are the
+    # recommender workload's entry (bench.py --embedding,
+    # perf_analysis --embedding, the tpu-lint embedding_ctr exemplar
+    # and the sparse-update checker) — lock the surface
+    "paddle_tpu.embedding",
     "paddle_tpu.hapi.model",
     "paddle_tpu.nn",
     "paddle_tpu.tensor",

@@ -16,6 +16,12 @@ Exemplars (each is a program the bench / tier-1 suite actually runs):
 - ``resnet_scan``   — ResNet50 with scan_stages (deep control-flow
                       nesting: host-sync + contract checkers descend
                       through the scan sub-blocks);
+- ``embedding_ctr`` — the wide&deep CTR train step with every slot
+                      table vocab-sharded by the sparse-embedding
+                      engine (paddle_tpu/embedding): sparse-update
+                      row-layout/exclusive-touch invariants, the
+                      zero1 sparse-op skip, and `sparse_lookup`
+                      divergence records;
 - ``serving_decode``— the serving engine's greedy decode loop as a
                       scan (paddle_tpu/serving): the host-sync checker
                       proves NO per-token fetch/RPC/dynamic-shape op
@@ -238,6 +244,37 @@ def build_serving_decode():
     return prog, None
 
 
+def build_embedding_ctr():
+    """Data-parallel wide&deep CTR train step with every slot table
+    vocab-sharded by the sparse-embedding engine
+    (paddle_tpu/embedding): the sparse-update checker verifies the
+    row layouts + exclusive-touch invariants, the zero1 checker skips
+    the engine-owned optimizer ops, and the divergence vocabulary
+    records one `sparse_lookup` per planned site. Zero errors is the
+    standing claim (the deliberate-defect twins live in
+    tests/test_tpu_lint.py)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.embedding import plan_sparse_tables
+    from paddle_tpu.fluid import framework
+    from paddle_tpu.models import ctr
+
+    _fresh()
+    with framework.unique_name_guard():
+        framework.default_main_program().random_seed = 7
+        cfg = ctr.CTRConfig()
+        loss, _, feeds = ctr.build_ctr_train(cfg)
+        prog = fluid.default_main_program()
+        fluid.CompiledProgram(prog).with_data_parallel(
+            loss_name=loss.name)
+        prog._sparse_plan = plan_sparse_tables(
+            prog, prog.global_block(), NDEV, "dp", feed_names=feeds)
+        assert prog._sparse_plan is not None and \
+            len(prog._sparse_plan.tables) == 2 * len(cfg.vocab_sizes), \
+            "embedding_ctr exemplar failed to plan (fallback: %s)" % (
+                getattr(prog, "_sparse_embedding_fallback", None),)
+    return prog, None
+
+
 def build_fleet_ps_2rank():
     """One MLP classifier transpiled for 2 sync-PS trainers: returns
     (rank-0 program, [rank-1 program]) for the cross-rank pass."""
@@ -269,6 +306,7 @@ EXEMPLARS = {
     "bert_tiny": build_bert_tiny,
     "bert_tiny_amp": build_bert_tiny_amp,
     "mlp_hier": build_mlp_hier,
+    "embedding_ctr": build_embedding_ctr,
     "resnet_scan": build_resnet_scan,
     "serving_decode": build_serving_decode,
     "fleet_ps_2rank": build_fleet_ps_2rank,
