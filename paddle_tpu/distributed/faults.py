@@ -20,6 +20,13 @@ This module injects faults at the RPC socket layer:
              FaultError into the op), so in-process tests can unstick
              it; a subprocess stays wedged until its supervisor kills
              it, exactly like the real failure.
+    preempt — deliver a preemption NOTICE (distributed/preemption.py)
+             with a `grace_s` window and let the op proceed untouched:
+             the process keeps running toward the next step boundary,
+             where ElasticWorld.sync() turns the notice into a
+             group-agreed live resize. The deterministic trigger for
+             the zero-downtime elasticity tests — unlike `kill`, the
+             rank is warned, not lost.
 
 Injection points (where rpc.py calls back into this module):
 
@@ -76,10 +83,11 @@ class FaultInjector:
     """One armed fault: fires on matching (side, point, method) events
     according to its deterministic counter."""
 
-    KINDS = ("drop", "delay", "kill", "stall")
+    KINDS = ("drop", "delay", "kill", "stall", "preempt")
 
     def __init__(self, kind, side=None, point=None, method=None,
-                 every=None, at=None, delay_ms=50, exit_code=137):
+                 every=None, at=None, delay_ms=50, exit_code=137,
+                 grace_s=None):
         if kind not in self.KINDS:
             raise ValueError("unknown fault kind %r (want one of %s)"
                              % (kind, "/".join(self.KINDS)))
@@ -93,6 +101,7 @@ class FaultInjector:
         self.at = int(at) if at is not None else None
         self.delay_ms = float(delay_ms)
         self.exit_code = int(exit_code)
+        self.grace_s = float(grace_s) if grace_s is not None else None
         self._count = 0
         self._lock = threading.Lock()
 
@@ -112,6 +121,15 @@ class FaultInjector:
         if not hit:
             return
         self._telemetry_event(side, point, method, n)
+        if self.kind == "preempt":
+            # a WARNED rank, not a lost one: record the pending notice
+            # and let the socket op proceed — consumption happens at
+            # the next step boundary (preemption.ElasticWorld.sync)
+            from . import preemption
+
+            preemption.deliver_notice(grace_s=self.grace_s,
+                                      source="fault")
+            return
         if self.kind == "delay":
             import time
 
@@ -204,6 +222,8 @@ def parse_spec(spec: str) -> List[FaultInjector]:
                 kw[intkey] = int(kw[intkey])
         if "delay_ms" in kw:
             kw["delay_ms"] = float(kw["delay_ms"])
+        if "grace_s" in kw:
+            kw["grace_s"] = float(kw["grace_s"])
         out.append(FaultInjector(kind.strip(), **kw))
     return out
 

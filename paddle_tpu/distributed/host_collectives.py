@@ -176,6 +176,15 @@ class _StoreState:
                 if rank is not None:
                     self._release_after_fetch(key, rank, [key])
                 return [val]
+        if method == "hc_peek":
+            # non-blocking probe: [val] when the key is present, []
+            # otherwise. The preemption notice path polls this at step
+            # boundaries — a poll must never wait on anything.
+            key = args[0]
+            with self._cv:
+                if key in self._kv:
+                    return [self._kv[key]]
+                return []
         if method == "hc_take":
             # blocking fetch that REMOVES the blob: point-to-point
             # exchange keys pass through the store exactly once, so the
@@ -259,9 +268,14 @@ class HostCollectiveGroup:
     store; set PADDLE_HC_HEARTBEAT_S=0 to disable."""
 
     def __init__(self, rank, world_size, store_endpoint,
-                 timeout_s=None, heartbeat_s=None):
+                 timeout_s=None, heartbeat_s=None, generation=0):
         self.rank = int(rank)
         self.world = int(world_size)
+        # elastic generation: bumped by a live mesh resize
+        # (distributed/preemption.py). Tags collective schedule keys so
+        # the desync analyzer never aliases a pre-resize barrier with a
+        # post-resize one that happens to share (op, world, seq).
+        self.generation = int(generation)
         self._seq = 0
         self._server: Optional[RpcServer] = None
         self._heartbeat_s = float(
@@ -367,7 +381,9 @@ class HostCollectiveGroup:
                     rank=self.rank,
                     dtype=None if payload is None else payload.dtype,
                     shape=None if payload is None else payload.shape,
-                    nbytes=None if payload is None else payload.nbytes)
+                    nbytes=None if payload is None else payload.nbytes,
+                    region=("gen%d" % self.generation
+                            if self.generation else None))
             except Exception:  # noqa: BLE001 - tracing never gates comm
                 tok = None
         t0 = time.perf_counter()
@@ -461,12 +477,20 @@ class HostCollectiveGroup:
                                        root)
         return np.asarray(val)
 
+    def peek(self, key) -> Optional[np.ndarray]:
+        """Non-blocking probe: the blob under `key`, or None. Leaves
+        the blob in the store (take() is the consuming read)."""
+        vals = self._client.call("hc_peek", key)
+        if not vals:
+            return None
+        return np.asarray(vals[0])
+
     def store_stats(self):
         """(n_blobs, n_counts, n_pending_fetch) on the rank-0 store —
         lets tests assert the leak fix holds."""
         return tuple(int(x) for x in self._client.call("hc_stats"))
 
-    def shutdown(self):
+    def _detach(self):
         self._hb_stop.set()
         # teardown is best-effort: don't let the full retry cycle
         # stall shutdown when the store host is already gone
@@ -477,14 +501,29 @@ class HostCollectiveGroup:
             self._client.call("hc_leave", self.rank)
         except Exception:  # noqa: BLE001 - store may already be down
             pass
+
+    def _close_clients(self):
+        self._client.close()
+        if self._hb_client is not None:
+            self._hb_client.close()
+
+    def leave(self):
+        """Detach this rank from the group WITHOUT tearing the store
+        down: stop heartbeating, mark a clean leave, close sockets.
+        The live-resize seam (distributed/preemption.py) uses this on
+        survivors — the old rank-0 store must stay up until every old
+        member has left, then rank 0's shutdown() drains it."""
+        self._detach()
+        self._close_clients()
+
+    def shutdown(self):
+        self._detach()
         try:
             if self.rank == 0 and self._server is not None:
                 self._client.call("hc_shutdown")
         except Exception:  # noqa: BLE001
             pass
-        self._client.close()
-        if self._hb_client is not None:
-            self._hb_client.close()
+        self._close_clients()
         if self._server is not None:
             self._server.shutdown()
 

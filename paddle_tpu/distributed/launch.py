@@ -277,7 +277,12 @@ def _collect_flight_dumps(args, attempt):
             fname.endswith(".json")
         is_jsonl = fname.startswith("telemetry.rank") and \
             fname.endswith(".jsonl")
-        if not (is_dump or is_jsonl):
+        # preempt markers ride along: launch() has already read them
+        # by the time dumps are collected, and a restarted attempt
+        # must start marker-clean
+        is_marker = fname.startswith("preempted.rank") and \
+            fname.endswith(".json")
+        if not (is_dump or is_jsonl or is_marker):
             continue
         os.makedirs(dest, exist_ok=True)
         try:
@@ -293,6 +298,17 @@ def _collect_flight_dumps(args, attempt):
             "into %s\n" % (len(collected), dest))
     _write_postmortem_index(os.path.join(dest_root, "postmortem"))
     return collected
+
+
+def _preempt_marker_ranks(tdir):
+    """Ranks with a preempt marker (preempted.rank<R>.json) in the
+    telemetry dir: they left on a preemption notice — possibly with
+    exit 0 — and must be treated as lost by the restart shrink."""
+    if not tdir:
+        return []
+    from .preemption import read_preempt_markers
+
+    return sorted({int(m["rank"]) for m in read_preempt_markers(tdir)})
 
 
 def _write_postmortem_index(pm_root):
@@ -694,11 +710,22 @@ def _supervise(procs, local_ids, stop_sig, hang_watch=None,
             bad_tid, bad_rc = failed[0]
             if bad_rc < 0:
                 bad_rc = 128 - bad_rc
+            # DEGRADE_RC = a SURVIVOR whose live-resize seam failed,
+            # loudly requesting the cohort-restart fallback — its
+            # machine is healthy, so it must NOT be dropped by the
+            # shrink (the preempt markers name who actually left)
+            from .preemption import DEGRADE_RC
+
+            lost = [tid for tid, rc_ in failed if rc_ != DEGRADE_RC]
+            degraded = [tid for tid, rc_ in failed if rc_ == DEGRADE_RC]
             sys.stderr.write(
-                "paddle_tpu.launch: worker %d exited with %d; "
-                "terminating cohort\n" % (bad_tid, bad_rc))
+                "paddle_tpu.launch: worker %d exited with %d%s; "
+                "terminating cohort\n"
+                % (bad_tid, bad_rc,
+                   " (live-resize degrade from worker(s) %s)"
+                   % degraded if degraded else ""))
             _terminate_all(procs)
-            return bad_rc, [tid for tid, _ in failed], None
+            return bad_rc, lost, None
         if all(p.poll() is not None for p in procs):
             return 0, [], None
         if hang_watch is not None:
@@ -871,6 +898,17 @@ def launch(argv=None):
                 "guilty rank(s) %s)\n"
                 % (hang_fields["hang_verdict"],
                    hang_fields["hang_collective"], guilty or "none"))
+        # preempt markers: ranks that left via a preemption notice
+        # (live seam, or the doomed half of a degraded one) exited 0 —
+        # the restart shrink must drop them exactly like crashed ranks
+        # (distributed/preemption.py writes the marker FIRST in the
+        # seam, so it survives any later seam failure)
+        preempt_ranks = _preempt_marker_ranks(tdir)
+        if preempt_ranks:
+            failed_tids = sorted(set(failed_tids) | set(preempt_ranks))
+            sys.stderr.write(
+                "paddle_tpu.launch: preempt marker(s) for rank(s) %s — "
+                "included in the shrink\n" % preempt_ranks)
         # secure this attempt's per-rank flight-recorder dumps before
         # the restarted cohort overwrites them (and keep the final
         # failed attempt's evidence too when restarts are exhausted)
@@ -893,13 +931,26 @@ def launch(argv=None):
                     old: new for new, old in enumerate(
                         tid for tid in range(len(endpoints))
                         if tid not in set(failed_tids))}
+                from .preemption import DEGRADE_RC as _DEGRADE_RC
+
+                degrade_fields = {}
+                if preempt_ranks:
+                    degrade_fields["preempted_ranks"] = preempt_ranks
+                if rc == _DEGRADE_RC:
+                    # the live seam failed mid-recovery and a survivor
+                    # demanded this restart — record the degradation so
+                    # perf_analysis --elastic shows live-vs-restart
+                    # honestly
+                    degrade_fields["degraded_from_live"] = True
                 pending_evt = dict(
                     old_world=len(endpoints),
                     new_world=len(survivors),
+                    mode="restart",
                     failed_ranks=sorted(failed_tids),
                     reassignment={str(o): n
                                   for o, n in reassignment.items()},
                     attempt=attempt + 1, **pod_fields,
+                    **degrade_fields,
                     # a hang-escalated shrink carries its desync
                     # verdict: WHY this rank was dropped, stitched to
                     # the postmortem bundle the dumps moved into

@@ -1,0 +1,506 @@
+"""Preemption notices + zero-downtime live mesh resize.
+
+Preemptible TPU capacity delivers an advance *notice* (SIGTERM with a
+grace window, or a scheduler RPC) before reclaiming a host. Every fault
+path before this module was restart-shaped: the launch supervisor
+killed the whole cohort and relaunched at N' (launch.py, PR 9), paying
+process teardown + rendezvous even though the compile half of recovery
+is already ~free (pre-warmed N' executables). This module treats the
+notice as a LIVE event instead:
+
+- notice delivery: `install_sigterm()` turns the FIRST SIGTERM into a
+  pending notice (the second one falls through to the previous handler
+  — the flight recorder's dump-then-die); `post_notice()` delivers the
+  same thing over the PR 1 RPC envelope via the host-collective store;
+  `faults.py` kind "preempt" injects one deterministically at rank R /
+  step K.
+- group agreement: `ElasticWorld.sync()` runs at step boundaries — it
+  polls the store for RPC notices and allreduce-maxes a doomed-rank
+  bitmap so every rank agrees on WHO leaves at the SAME step.
+- the seam: `ElasticWorld.resize()` — the doomed rank writes an atomic
+  preempt marker (the degrade-to-restart breadcrumb), the group takes
+  its snapshot callback (checkpoint-on-signal), barriers, then the old
+  store is drained; the doomed rank flight-dumps and exits 0 (exit 0
+  is NOT a failure to the supervisor — survivors keep running) while
+  survivors rebuild a fresh HostCollectiveGroup over the shrunk
+  endpoint list on a generation-bumped store port and re-export the
+  PADDLE_* env so every downstream consumer (mesh build, reader
+  resharding, checkpoint manager) sees the new world.
+- degrade loudly: any failure inside the seam raises LiveResizeError;
+  the runner exits with DEGRADE_RC, which the supervisor treats as
+  "survivor requesting cohort restart" — the PR 9 path — never a hang.
+
+The device-tier half (unshard + mesh swap + re-shard in place) lives in
+`Executor.live_resize`; this module owns the host-coordination half.
+See distributed/README.md ("Live resize") for the runbook.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "DEGRADE_RC", "PREEMPT_MARKER_FMT",
+    "PreemptNotice", "LiveResizeError",
+    "default_grace_s", "deliver_notice", "pending_notice",
+    "clear_notice", "install_sigterm", "post_notice",
+    "write_preempt_marker", "read_preempt_markers",
+    "ElasticWorld",
+]
+
+# a survivor that failed the live seam exits with this rc to request a
+# cohort restart (launch.py treats it as degrade, not as the guilty
+# rank); distinct from HANG_RC (124) and real crashes
+DEGRADE_RC = 98
+
+# atomic per-rank breadcrumb in the telemetry dir: written by the
+# doomed rank BEFORE the seam can fail, read by the launch supervisor
+# on the degraded path so the restart shrink drops the preempted rank
+# even when it exited 0
+PREEMPT_MARKER_FMT = "preempted.rank%d.json"
+
+# store key carrying an RPC-delivered notice for rank R
+_NOTICE_KEY_FMT = "preempt/%d"
+
+
+def default_grace_s() -> float:
+    """The grace window (seconds) between notice and reclaim;
+    PADDLE_PREEMPT_GRACE_S env, default 30 — the order of real TPU
+    preemption notices."""
+    try:
+        return float(os.environ.get("PADDLE_PREEMPT_GRACE_S", 30.0))
+    except ValueError:
+        return 30.0
+
+
+class PreemptNotice:
+    """One delivered preemption notice: this process must be gone by
+    `deadline` (monotonic epoch seconds)."""
+
+    __slots__ = ("rank", "grace_s", "source", "ts")
+
+    def __init__(self, rank, grace_s, source, ts=None):
+        self.rank = int(rank)
+        self.grace_s = float(grace_s)
+        self.source = str(source)  # "sigterm" | "rpc" | "fault"
+        self.ts = float(ts if ts is not None else time.time())
+
+    @property
+    def deadline(self) -> float:
+        return self.ts + self.grace_s
+
+    def remaining_s(self) -> float:
+        return max(0.0, self.deadline - time.time())
+
+    def as_dict(self) -> dict:
+        return {"rank": self.rank, "grace_s": self.grace_s,
+                "source": self.source, "ts": self.ts}
+
+    def __repr__(self):
+        return ("PreemptNotice(rank=%d, grace_s=%g, source=%r, "
+                "remaining=%.1fs)" % (self.rank, self.grace_s,
+                                      self.source, self.remaining_s()))
+
+
+class LiveResizeError(RuntimeError):
+    """The live seam failed (second fault mid-recovery, rendezvous
+    timeout). The runner must exit DEGRADE_RC so the supervisor falls
+    back to the cohort-restart path instead of hanging."""
+
+
+_lock = threading.Lock()
+_pending: Optional[PreemptNotice] = None
+
+
+def deliver_notice(grace_s=None, source="rpc",
+                   rank=None) -> PreemptNotice:
+    """Record a preemption notice for THIS process (first notice wins —
+    a SIGTERM racing an RPC notice must not shorten or extend the
+    already-armed grace window) and publish the `preempt_notice`
+    telemetry event. Never kills anything: consumption happens at the
+    next step boundary via ElasticWorld.sync()."""
+    global _pending
+    if rank is None:
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    notice = PreemptNotice(
+        rank, default_grace_s() if grace_s is None else grace_s, source)
+    with _lock:
+        if _pending is not None:
+            return _pending
+        _pending = notice
+    try:
+        from ..observability.registry import registry
+
+        registry().event("preempt_notice", grace_s=notice.grace_s,
+                         source=notice.source)
+    except Exception:  # noqa: BLE001 - telemetry never gates the notice
+        pass
+    return notice
+
+
+def pending_notice() -> Optional[PreemptNotice]:
+    with _lock:
+        return _pending
+
+
+def clear_notice() -> None:
+    global _pending
+    with _lock:
+        _pending = None
+
+
+_prev_sigterm = None
+_sigterm_installed = False
+
+
+def install_sigterm(grace_s=None) -> bool:
+    """Arm SIGTERM-as-notice: the first SIGTERM records a pending
+    notice and returns (the process keeps training toward the seam);
+    a second SIGTERM chains to the previously-installed handler — the
+    flight recorder's dump-then-redeliver — so an impatient reclaimer
+    still gets a postmortem and a dead process. Main thread only
+    (signal module constraint); returns False when it can't install."""
+    global _prev_sigterm, _sigterm_installed
+    if _sigterm_installed:
+        return True
+
+    def _handler(signum, frame):
+        if pending_notice() is None:
+            deliver_notice(grace_s=grace_s, source="sigterm")
+            return
+        if callable(_prev_sigterm):
+            _prev_sigterm(signum, frame)
+        else:  # SIG_DFL: restore and re-deliver
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    try:
+        _prev_sigterm = signal.signal(signal.SIGTERM, _handler)
+    except ValueError:  # not the main thread
+        return False
+    _sigterm_installed = True
+    return True
+
+
+def post_notice(store_endpoint, target_rank, grace_s=None) -> None:
+    """Deliver a preemption notice to `target_rank` over the PR 1 RPC
+    envelope: drop a grace-window blob under the rank's notice key on
+    the host-collective store. The target's next ElasticWorld.sync()
+    peek picks it up. Usable from any process that can reach the store
+    (an external scheduler shim, a test)."""
+    from .rpc import RpcClient
+
+    grace = default_grace_s() if grace_s is None else float(grace_s)
+    client = RpcClient(store_endpoint)
+    try:
+        client.call("hc_put", _NOTICE_KEY_FMT % int(target_rank),
+                    np.asarray([grace], np.float64))
+    finally:
+        client.close()
+
+
+# -- degrade-to-restart breadcrumbs -------------------------------------
+
+
+def _telemetry_dir() -> str:
+    try:
+        from ..utils.flags import get_flag
+
+        base = str(get_flag("FLAGS_tpu_telemetry_dir", "") or "")
+    except Exception:  # noqa: BLE001
+        base = ""
+    return base or os.getcwd()
+
+
+def write_preempt_marker(rank, step=None, grace_s=None, source=None,
+                         extra=None) -> Optional[str]:
+    """Atomically write the doomed rank's preempt marker into the
+    telemetry dir (tmp + fsync + rename, same discipline as the flight
+    recorder). Written FIRST in the seam so the supervisor can tell
+    'preempted, exited 0' from 'healthy, exited 0' even when the live
+    path degrades right after. Returns the path, or None on IO failure
+    (best-effort: a dying rank must never raise here)."""
+    doc = {"rank": int(rank), "ts": time.time()}
+    if step is not None:
+        doc["step"] = int(step)
+    if grace_s is not None:
+        doc["grace_s"] = float(grace_s)
+    if source is not None:
+        doc["source"] = str(source)
+    if extra:
+        doc.update(extra)
+    try:
+        path = os.path.join(_telemetry_dir(),
+                            PREEMPT_MARKER_FMT % int(rank))
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = "%s.tmp.%d" % (path, os.getpid())
+        with open(tmp, "w") as f:
+            json.dump(doc, f, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return path
+    except Exception:  # noqa: BLE001 - breadcrumb, best effort
+        return None
+
+
+def read_preempt_markers(dirpath) -> List[dict]:
+    """All preempt markers in `dirpath`, sorted by rank. Unreadable or
+    malformed markers are skipped (a half-written tmp never matches the
+    marker name, so rename atomicity keeps this clean)."""
+    out = []
+    try:
+        names = sorted(os.listdir(dirpath))
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith("preempted.rank")
+                and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(dirpath, name)) as f:
+                doc = json.load(f)
+            if isinstance(doc, dict) and "rank" in doc:
+                out.append(doc)
+        except Exception:  # noqa: BLE001
+            continue
+    out.sort(key=lambda d: int(d.get("rank", 0)))
+    return out
+
+
+# -- the live seam ------------------------------------------------------
+
+
+class ElasticWorld:
+    """Host-coordination state machine for live shrink.
+
+    Owns the HostCollectiveGroup across resizes: `sync()` at every step
+    boundary turns per-rank notices into a group-agreed doomed set;
+    `resize()` executes the seam. The registry's rank (telemetry stream
+    identity) deliberately stays the ORIGINAL launch rank across a
+    resize — only the collective rank moves."""
+
+    def __init__(self, group, endpoints, generation=0):
+        self.group = group
+        self.endpoints = [str(e) for e in endpoints]
+        self.generation = int(generation)
+        # the rank THIS process was launched as: the supervisor's tid
+        # space — preempt markers must speak it, not the post-resize
+        # contiguous rank
+        self.launch_rank = int(os.environ.get("PADDLE_LAUNCH_RANK",
+                                              group.rank))
+        os.environ.setdefault("PADDLE_LAUNCH_RANK",
+                              str(self.launch_rank))
+        if len(self.endpoints) != group.world:
+            raise ValueError(
+                "endpoints (%d) != group world (%d)"
+                % (len(self.endpoints), group.world))
+
+    @property
+    def rank(self) -> int:
+        return self.group.rank
+
+    @property
+    def world(self) -> int:
+        return self.group.world
+
+    @classmethod
+    def from_env(cls) -> Optional["ElasticWorld"]:
+        """Build from the PADDLE_* launch env; None for world <= 1
+        (a solo process has nobody to agree a seam with)."""
+        from .host_collectives import group_from_env
+
+        group = group_from_env()
+        if group is None:
+            return None
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+        return cls(group, eps)
+
+    # -- agreement -------------------------------------------------------
+    def poll_notice(self) -> Optional[PreemptNotice]:
+        """Local-first notice check: an already-delivered notice
+        (SIGTERM / fault injection), else a store peek for an
+        RPC-delivered one. Non-blocking."""
+        notice = pending_notice()
+        if notice is not None:
+            return notice
+        try:
+            val = self.group.peek(_NOTICE_KEY_FMT % self.rank)
+        except Exception:  # noqa: BLE001 - store may be resizing
+            val = None
+        if val is None:
+            return None
+        return deliver_notice(grace_s=float(np.asarray(val).ravel()[0]),
+                              source="rpc", rank=self.rank)
+
+    def sync(self) -> List[int]:
+        """Step-boundary agreement: allreduce-max a doomed-rank bitmap
+        so every rank leaves the SAME step with the SAME doomed set
+        (possibly empty). Costs one small host allreduce per step."""
+        bitmap = np.zeros((self.world,), np.int8)
+        if self.poll_notice() is not None:
+            bitmap[self.rank] = 1
+        agreed = self.group.all_reduce(bitmap, op="max")
+        return [r for r in range(self.world) if int(agreed[r]) > 0]
+
+    # -- the seam --------------------------------------------------------
+    def resize(self, doomed: Sequence[int],
+               snapshot: Optional[Callable[[List[int]], None]] = None,
+               step: Optional[int] = None) -> dict:
+        """Execute the live seam for an agreed doomed set.
+
+        Every rank: doomed ranks drop their preempt markers first (the
+        degrade breadcrumb must exist before anything can fail), the
+        `snapshot` callback runs (group-agreed checkpoint-on-signal —
+        reuse the ShardedCheckpointManager's intact-step protocol
+        here), a barrier proves it landed everywhere, then the old
+        group is torn down (old rank 0 drains the store; everyone else
+        leaves cleanly).
+
+        Doomed ranks flight-dump ("preempt") and get role="doomed"
+        back — the caller must exit 0 within the grace window (exit 0
+        keeps the supervisor's fail-fast from killing survivors).
+
+        Survivors rebuild: new endpoint list minus the doomed ranks,
+        new contiguous rank, a fresh store on a generation-bumped port
+        (old port + 1 + generation — never collides with a store still
+        draining), a rendezvous barrier, and the PADDLE_* env
+        re-exported for downstream consumers. Returns the seam report
+        (role, new rank/world, span timings) and publishes the
+        `live_resize` + `elastic_transition(mode=live)` events.
+
+        Any failure raises LiveResizeError — exit DEGRADE_RC then.
+        """
+        doomed = sorted(set(int(r) for r in doomed))
+        if not doomed:
+            raise ValueError("resize with an empty doomed set")
+        if len(doomed) >= self.world:
+            raise LiveResizeError("all %d ranks doomed" % self.world)
+        t0 = time.monotonic()
+        notice = pending_notice()
+        notice_s = (max(0.0, time.time() - notice.ts)
+                    if notice is not None else 0.0)
+        old_world, old_rank = self.world, self.rank
+        am_doomed = old_rank in doomed
+        try:
+            if am_doomed:
+                write_preempt_marker(
+                    self.launch_rank, step=step,
+                    grace_s=notice.grace_s if notice else None,
+                    source=notice.source if notice else None,
+                    extra={"group_rank": old_rank})
+            if snapshot is not None:
+                snapshot(list(doomed))
+            t_snap = time.monotonic()
+            # the barrier is the group's agreement that every rank's
+            # snapshot part is durably on disk — after it, survivors
+            # may proceed even if the doomed rank is reclaimed early
+            self.group.barrier()
+            if am_doomed:
+                try:
+                    from ..observability import flight as _flight
+
+                    _flight.dump("preempt", fatal_event={
+                        "notice": notice.as_dict() if notice else None,
+                        "step": step, "doomed": doomed})
+                except Exception:  # noqa: BLE001 - forensics only
+                    pass
+                if old_rank == 0:
+                    self.group.shutdown()
+                else:
+                    self.group.leave()
+                report = {"role": "doomed", "old_world": old_world,
+                          "new_world": old_world - len(doomed),
+                          "old_rank": old_rank, "doomed": doomed,
+                          "step": step}
+                clear_notice()
+                return report
+            # ---- survivor path ----
+            if old_rank == 0:
+                self.group.shutdown()  # drains: waits for leaves
+            else:
+                self.group.leave()
+            t_down = time.monotonic()
+            new_eps = [ep for r, ep in enumerate(self.endpoints)
+                       if r not in doomed]
+            new_rank = new_eps.index(self.endpoints[old_rank])
+            new_world = len(new_eps)
+            self.generation += 1
+            host, port = new_eps[0].rsplit(":", 1)
+            store_ep = "%s:%d" % (host,
+                                  int(port) + 1 + self.generation)
+            from .host_collectives import HostCollectiveGroup
+
+            group = HostCollectiveGroup(new_rank, new_world, store_ep,
+                                        generation=self.generation)
+            # rendezvous proof: the first post-seam collective must
+            # complete before we declare the seam done (the RPC
+            # client's reconnect backoff absorbs survivors racing the
+            # new store's bind)
+            group.barrier()
+            t_up = time.monotonic()
+            self.group = group
+            self.endpoints = new_eps
+            os.environ["PADDLE_TRAINER_ID"] = str(new_rank)
+            os.environ["PADDLE_TRAINERS_NUM"] = str(new_world)
+            os.environ["PADDLE_TRAINER_ENDPOINTS"] = ",".join(new_eps)
+            report = {
+                "role": "survivor", "old_world": old_world,
+                "new_world": new_world, "old_rank": old_rank,
+                "new_rank": new_rank, "doomed": doomed, "step": step,
+                "generation": self.generation,
+                "notice_s": round(notice_s, 6),
+                "snapshot_s": round(t_snap - t0, 6),
+                "rebuild_s": round(t_up - t_snap, 6),
+                "teardown_s": round(t_down - t_snap, 6),
+                "coordination_s": round(t_up - t0, 6),
+            }
+            self._emit(report)
+            clear_notice()
+            return report
+        except LiveResizeError:
+            raise
+        except Exception as e:
+            try:
+                from ..observability.registry import registry
+
+                registry().event(
+                    "live_resize", old_world=old_world,
+                    new_world=old_world - len(doomed),
+                    coordination_s=round(time.monotonic() - t0, 6),
+                    mode="live", status="degraded", error=repr(e))
+            except Exception:  # noqa: BLE001
+                pass
+            raise LiveResizeError(
+                "live seam failed (%s: %s) — degrade to cohort "
+                "restart (exit %d)" % (type(e).__name__, e,
+                                       DEGRADE_RC)) from e
+
+    def _emit(self, report) -> None:
+        try:
+            from ..observability.registry import registry
+
+            reg = registry()
+            reg.event(
+                "live_resize", old_world=report["old_world"],
+                new_world=report["new_world"], mode="live",
+                status="ok", generation=report["generation"],
+                notice_s=report["notice_s"],
+                snapshot_s=report["snapshot_s"],
+                rebuild_s=report["rebuild_s"],
+                coordination_s=report["coordination_s"])
+            reg.event(
+                "elastic_transition", old_world=report["old_world"],
+                new_world=report["new_world"], mode="live",
+                coordination_s=report["coordination_s"])
+        except Exception:  # noqa: BLE001 - telemetry only
+            pass
+
+    def shutdown(self) -> None:
+        self.group.shutdown()

@@ -212,6 +212,19 @@ class EagerMasterWeightOptimizer:
             if m is None or tuple(m.shape) != tuple(val.shape) \
                     or self._last_live.get(p.name) is not val:
                 m = val.astype(jnp.float32)
+                # masters shard over the mesh like the eager optimizer
+                # accumulators (P(ici) dim-0, divisibility-gated):
+                # FLAGS_tpu_sharded_update + an active global mesh move
+                # the fp32 copy's memory off every replica, and XLA
+                # partitions the master update against the layout
+                from ....parallel.sharded_update import \
+                    eager_accumulator_sharding
+
+                sh = eager_accumulator_sharding(tuple(m.shape))
+                if sh is not None:
+                    import jax
+
+                    m = jax.device_put(m, sh)
             swapped.append((p, val.dtype))
             p._assign_raw(m)
         try:

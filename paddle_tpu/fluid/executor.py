@@ -974,6 +974,97 @@ class Executor:
         t.start()
         self._elastic_warmup_thread = t
 
+    def live_resize(self, program, mesh=None, ndev=None, scope=None):
+        """In-place device-tier mesh resize — the survivor half of the
+        zero-downtime elasticity seam (distributed/preemption.py): no
+        process exit, no checkpoint round-trip.
+
+        Rewrites every sharded state var of `program` back to its
+        logical host shape (parallel.sharded_update.
+        reshard_scope_to_logical: ZeRO-1 moments, ZeRO-2 masters,
+        row-sharded embedding tables), materializes every OTHER
+        device-resident scope var to host numpy (a jax array committed
+        to the old mesh's devices would fail the new mesh's dispatch
+        with incompatible-devices — replicated params included), evicts
+        the program's in-memory cache entries, and swaps
+        ``program._mesh`` to the new topology. The next run() re-plans
+        and re-shards exactly like an elastic cold restart restoring
+        from a checkpoint — same `to_sharded_global` stale-padding trim,
+        same pre-warmed N' executables (warmup(meshes="elastic") /
+        FLAGS_tpu_warmup_elastic_variants) — so post-seam losses are
+        bit-identical to that restart.
+
+        Pass the target as a `mesh` or a device count `ndev`
+        (parallel.env.mesh_for_world builds the hybrid or flat mesh).
+        Publishes `live_resize` + `elastic_transition(mode=live)`
+        events; returns the seam report dict."""
+        import time as _time
+
+        import jax
+
+        from . import compiler
+        from ..core.scope import global_scope
+        from ..parallel import env as penv
+        from ..parallel import sharded_update as _su
+
+        t0 = _time.perf_counter()
+        if isinstance(program, compiler.CompiledProgram):
+            program = program._unwrap()
+        scope = scope or global_scope()
+        old_mesh = getattr(program, "_mesh", None)
+        old_ndev = (int(np.prod(list(old_mesh.shape.values())))
+                    if old_mesh is not None else 1)
+        if mesh is None:
+            if ndev is None:
+                raise ValueError("live_resize needs mesh= or ndev=")
+            mesh = penv.mesh_for_world(
+                int(ndev), dp_axis=getattr(program, "_dp_axis", "dp"))
+            if mesh is None:
+                raise ValueError(
+                    "no mesh for ndev=%d (local devices: %d)"
+                    % (int(ndev), len(jax.devices())))
+        new_ndev = int(np.prod(list(mesh.shape.values())))
+        # 1) sharded state -> logical host numpy (moments, masters,
+        #    embedding tables drop the old world's padded layout)
+        n_state = _su.reshard_scope_to_logical(program, scope)
+        # 2) every remaining device-resident scope var -> host numpy:
+        #    committed-to-old-devices arrays (replicated params, BN
+        #    stats) must not reach the new mesh's dispatch
+        n_moved = 0
+        for name in scope.local_var_names():
+            v = scope.find_var(name)
+            if v is not None and is_on_device(v):
+                scope.set_var(name, np.asarray(self._fetch_to_numpy(v)))
+                n_moved += 1
+        # 3) drop the old topology's in-memory executables (the
+        #    persistent tier keeps the new world's warmed variants)
+        n_evicted = 0
+        for k in [k for k in self._cache if k[0] == program._uid]:
+            self._cache.pop(k, None)
+            n_evicted += 1
+        # 4) swap the mesh; next run() re-plans against it
+        program._mesh = mesh
+        report = {
+            "old_world": old_ndev, "new_world": new_ndev,
+            "n_state": n_state, "n_host_moved": n_moved,
+            "n_evicted": n_evicted,
+            "coordination_s": round(_time.perf_counter() - t0, 6),
+        }
+        try:
+            from ..observability.registry import registry
+
+            reg = registry()
+            reg.event("live_resize", old_world=old_ndev,
+                      new_world=new_ndev, mode="live", status="ok",
+                      coordination_s=report["coordination_s"],
+                      rebuild_s=report["coordination_s"])
+            reg.event("elastic_transition", old_world=old_ndev,
+                      new_world=new_ndev, mode="live",
+                      coordination_s=report["coordination_s"])
+        except Exception:  # noqa: BLE001 - telemetry only
+            pass
+        return report
+
     @staticmethod
     def _fetch_to_numpy(v):
         """Multi-host: a fetch sharded over remote processes is not fully

@@ -61,14 +61,16 @@ __all__ = [
 ]
 
 
-def _schedule_key(op, dtype=None, shape=None, world=None, ranks=None):
+def _schedule_key(op, dtype=None, shape=None, world=None, ranks=None,
+                  region=None):
     """The shared static/runtime collective identity (lazy import: the
     analyzer must stay importable on a process that never builds
     programs)."""
     from ..analysis.collectives import runtime_schedule_key
 
     return runtime_schedule_key(op, dtype=dtype, shape=shape,
-                                world=world, ranks=ranks)
+                                world=world, ranks=ranks,
+                                region=region or "")
 
 
 class InflightToken:
@@ -118,10 +120,13 @@ class InflightTrace:
     # -- lifecycle ---------------------------------------------------------
     def begin(self, op, key, tier="host", world=None, rank=None,
               dtype=None, shape=None, nbytes=None,
-              ranks=None) -> InflightToken:
+              ranks=None, region=None) -> InflightToken:
         """Record one collective enqueue; returns the token its caller
         marks `arrived()` / closes through. `key` is the cross-rank
-        collective id ("barrier#12" — lockstep ranks agree on it)."""
+        collective id ("barrier#12" — lockstep ranks agree on it).
+        `region` tags the schedule key's region slot — a live mesh
+        resize passes its elastic generation ("gen1") so pre- and
+        post-seam collectives never alias in the desync analyzer."""
         entry = {
             "seq": 0,  # patched under the lock below
             "op": str(op),
@@ -137,7 +142,8 @@ class InflightTrace:
             # per-collective path must not pay a serialization round
             # trip
             "schedule_key": _schedule_key(op, dtype=dtype, shape=shape,
-                                          world=world, ranks=ranks),
+                                          world=world, ranks=ranks,
+                                          region=region),
             "state": "inflight",
             "ts_begin": time.time(),
         }

@@ -1227,6 +1227,37 @@ def unshard_scope_value(program, name, value):
     return value
 
 
+def reshard_scope_to_logical(program, scope) -> int:
+    """Live-resize seam (Executor.live_resize): rewrite every sharded
+    state var of `program` in `scope` back to its LOGICAL shape as host
+    numpy — ZeRO-1 moments / ZeRO-2 masters drop their flat padded
+    device layout, row-sharded embedding tables and per-row moments
+    drop their padded-vocab layout. After the mesh swap, the next run's
+    to_sharded_global / TableShard build re-lays them out for the NEW
+    world (the flat-buffer trim above strips any stale padding), so the
+    resume is bit-identical to a checkpoint round-trip without touching
+    disk. Returns the number of vars rewritten."""
+    n = 0
+    plan = getattr(program, "_shard_plan", None)
+    if plan is not None:
+        for name, info in plan.sharded_state.items():
+            v = scope.find_var(name)
+            if v is None:
+                continue
+            logical = info.unshard(v)
+            scope.set_var(name, np.asarray(logical))
+            n += 1
+    splan = getattr(program, "_sparse_plan", None)
+    if splan is not None:
+        for name, rinfo in splan.state_vars.items():
+            v = scope.find_var(name)
+            if v is None:
+                continue
+            scope.set_var(name, np.asarray(rinfo.unshard(v)))
+            n += 1
+    return n
+
+
 # ---------------------------------------------------------------------------
 # eager (dygraph) path: GSPMD layout hints
 # ---------------------------------------------------------------------------

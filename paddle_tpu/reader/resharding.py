@@ -32,7 +32,23 @@ from typing import Dict, Iterable, Iterator, Tuple
 import numpy as np
 
 __all__ = ["rank_slice", "shard_batch", "shard_batches",
-           "resume_sample_offset", "skip_steps"]
+           "resume_sample_offset", "skip_steps", "survivor_rank"]
+
+
+def survivor_rank(old_rank: int, doomed) -> int:
+    """This rank's NEW contiguous rank after the doomed ranks leave a
+    live resize (distributed/preemption.ElasticWorld), or -1 for a
+    doomed rank. Survivors keep their relative order — the same
+    reassignment rule as the launch supervisor's restart shrink, so
+    `shard_batch(batch, survivor_rank(r, doomed), world - len(doomed))`
+    continues the global sample stream with no sample dropped or
+    double-trained across the seam (mid-epoch data continuity: the
+    resume cursor is a GLOBAL step count, unchanged by the seam)."""
+    old_rank = int(old_rank)
+    doomed = {int(r) for r in doomed}
+    if old_rank in doomed:
+        return -1
+    return old_rank - sum(1 for r in doomed if r < old_rank)
 
 
 def rank_slice(n: int, rank: int, world: int) -> Tuple[int, int]:

@@ -112,6 +112,7 @@ class Engine:
         self._tokens_generated = 0
         self._t_started = time.time()
         self._closed = False
+        self._draining = False
 
         # donation of the page state into the step is gated exactly
         # like the executor's: the persistent tier's deserialized
@@ -157,6 +158,10 @@ class Engine:
             # never close)
             if self._closed:
                 raise RuntimeError("engine is closed")
+            if self._draining:
+                raise RuntimeError(
+                    "engine is draining (preemption notice) — "
+                    "resubmit on the survivor")
             req = self.scheduler.new_request(prompt, max_new_tokens,
                                              eos_id=eos_id, tenant=tenant)
         self._reg_safe(lambda r: r.inc("serving.requests_submitted"))
@@ -216,6 +221,83 @@ class Engine:
             self.step()
             n += 1
         return n
+
+    def drain(self, grace_s: Optional[float] = None) -> dict:
+        """Preemption-notice drain: stop admission, keep stepping so
+        in-flight requests COMPLETE within the grace window, and export
+        a migration manifest for whatever could not finish in time.
+
+        Each manifest entry re-prefills on the survivor engine via
+        `adopt()`: the new prompt is the original prompt PLUS the
+        tokens already generated here, with the remaining token budget
+        — under greedy decoding the chunked-prefill path's final-chunk
+        logits reproduce the continuation bit-identically (the tpu-lint
+        serving_decode exemplar's batched-vs-sequential contract), so a
+        migrated stream is the uninterrupted stream, split in two.
+        Requests that could not finish retire as `cancelled` HERE (one
+        serving_request event each, as always); `already_emitted` tells
+        the caller how many tokens the consumer already saw.
+
+        Returns {"completed", "migrated": [entries...], "drain_s"} and
+        publishes a `serving_drain` event. Idempotent admission stop:
+        submit() raises while draining or after close()."""
+        from ..distributed.preemption import default_grace_s
+
+        grace = default_grace_s() if grace_s is None else float(grace_s)
+        t0 = time.perf_counter()
+        with self._lock:
+            self._draining = True
+            inflight = list(self.scheduler.queued) + \
+                list(self.scheduler.running.values())
+        deadline = t0 + grace
+        while not self.scheduler.idle \
+                and time.perf_counter() < deadline:
+            self.step()
+        manifest = []
+        with self._lock:
+            for req in inflight:
+                if req.state == RequestState.FINISHED:
+                    continue
+                remaining = int(req.max_new_tokens) - \
+                    len(req.output_tokens)
+                if req.state == RequestState.CANCELLED \
+                        or remaining <= 0:
+                    continue
+                manifest.append({
+                    "prompt": [int(t) for t in req.prompt]
+                    + [int(t) for t in req.output_tokens],
+                    "max_new_tokens": remaining,
+                    "eos_id": req.eos_id,
+                    "tenant": req.tenant,
+                    "already_emitted": len(req.output_tokens),
+                })
+                req.cancel()
+            for req in self.scheduler.retire():
+                self._publish_request(req)
+        completed = sum(1 for r in inflight
+                        if r.state == RequestState.FINISHED)
+        drain_s = round(time.perf_counter() - t0, 6)
+        self._reg_safe(lambda reg: reg.event(
+            "serving_drain", completed=completed,
+            migrated=len(manifest), grace_s=grace, dur_ms=round(
+                drain_s * 1e3, 3)))
+        return {"completed": completed, "migrated": manifest,
+                "drain_s": drain_s}
+
+    def adopt(self, manifest) -> list:
+        """Survivor half of a drained migration: resubmit every
+        manifest entry (continuation prompts re-prefill through the
+        chunked path). Returns the new Request list, aligned with the
+        manifest order; entry `already_emitted` tokens of each stream
+        were already delivered by the drained engine."""
+        out = []
+        for entry in manifest:
+            out.append(self.submit(
+                np.asarray(entry["prompt"], np.int32),
+                max_new_tokens=int(entry["max_new_tokens"]),
+                eos_id=entry.get("eos_id"),
+                tenant=entry.get("tenant", "")))
+        return out
 
     def close(self) -> None:
         """Cancel everything in flight and release the pool."""

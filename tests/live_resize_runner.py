@@ -1,0 +1,193 @@
+"""Worker script for the ZERO-DOWNTIME live-resize acceptance tests
+(spawned via `python -m paddle_tpu.distributed.launch --max_restarts
+--min_ranks`).
+
+Same host-tier data-parallel trainer as elastic_world_runner.py (one
+fixed GLOBAL batch per step, one allreduce-mean of loss+grads, host-side
+SGD so params stay bit-identical on every rank at every world size) —
+but the seam is LIVE, not a restart: the designated victim rank arms a
+PADDLE_FAULTS `preempt` notice at its Nth host-collective send, every
+rank's step boundary runs ElasticWorld.sync() to agree on the doomed
+set, and the cohort executes ElasticWorld.resize() in place — the
+doomed rank checkpoints-and-exits-0 inside its grace window while the
+survivors rebuild the collective group and keep training WITHOUT a
+process restart. The supervisor never sees a failure.
+
+In degrade mode a SECOND victim arms a silent kill (exit_code=0 — a
+machine reclaimed with no warning) timed to land inside the seam's
+agreement barrier: the survivors' rebuild fails fast on the stale
+heartbeat, raises LiveResizeError, and every survivor exits DEGRADE_RC
+— the loud request for the PR 9 cohort-restart fallback (the preempt
+marker written FIRST in the seam tells the shrink who actually left).
+
+argv: <ckpt_root> <total_steps> <save_every>
+      [<preempt_rank> <preempt_at> [<degrade_rank> <degrade_at>]]
+Prints per completed step (rank 0): LOSS <step> <%.17g global loss>;
+RESIZED/PREEMPTED lines mark the seam.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("PADDLE_HC_LIVENESS_S", "4")
+os.environ.setdefault("PADDLE_HC_HEARTBEAT_S", "0.5")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+GLOBAL_BATCH = 12  # divisible by 4, 3 and 2: exact mean-of-means
+LR = 0.1
+
+
+def build():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework
+
+    main, startup = fluid.Program(), fluid.Program()
+    with framework.unique_name_guard(), \
+            fluid.program_guard(main, startup):
+        main.random_seed = startup.random_seed = 7
+        x = fluid.data(name="x", shape=[-1, 16], dtype="float32")
+        y = fluid.data(name="y", shape=[-1, 1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=24, act="tanh")
+        pred = fluid.layers.fc(input=h, size=1, act=None)
+        loss = fluid.layers.reduce_mean(fluid.layers.square(pred - y))
+        pg = fluid.optimizer.SGDOptimizer(
+            learning_rate=LR).backward(loss)
+    names = [(p.name, g.name) for p, g in pg]
+    return main, startup, loss.name, names
+
+
+def data(total_steps):
+    rng = np.random.RandomState(3)
+    xs = rng.randn(total_steps, GLOBAL_BATCH, 16).astype(np.float32)
+    w = rng.randn(16, 1).astype(np.float32)
+    return xs, np.tanh(xs @ w)
+
+
+def main():
+    root, total, save_every = (sys.argv[1], int(sys.argv[2]),
+                               int(sys.argv[3]))
+    preempt_rank = int(sys.argv[4]) if len(sys.argv) > 4 else -1
+    preempt_at = int(sys.argv[5]) if len(sys.argv) > 5 else 0
+    degrade_rank = int(sys.argv[6]) if len(sys.argv) > 6 else -1
+    degrade_at = int(sys.argv[7]) if len(sys.argv) > 7 else 0
+
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    attempt = int(os.environ.get("PADDLE_RESTART_NUM", "0"))
+    if attempt == 0 and rank == preempt_rank and preempt_at > 0:
+        # the warned victim: a notice, not a lost machine
+        os.environ["PADDLE_FAULTS"] = (
+            "preempt:side=client,point=send,method=hc_put_part,at=%d"
+            % preempt_at)
+    if attempt == 0 and rank == degrade_rank and degrade_at > 0:
+        # fault-during-recovery: a SECOND machine reclaimed silently
+        # (exit 0, no marker) mid-seam — the live path must degrade to
+        # the cohort restart, never hang
+        os.environ["PADDLE_FAULTS"] = (
+            "kill:side=client,point=send,method=hc_put_part,at=%d,"
+            "exit_code=0" % degrade_at)
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.distributed import preemption
+    from paddle_tpu.distributed.host_collectives import group_from_env
+    from paddle_tpu.fluid import checkpoint as ckpt
+    from paddle_tpu.reader import resharding
+
+    preemption.install_sigterm()
+    group = group_from_env()
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+    ew = preemption.ElasticWorld(group, eps) if group is not None \
+        else None
+    prog, startup, loss_name, pg_names = build()
+    xs, ys = data(total)
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+
+    status = ckpt.load_checkpoint(exe, root, main_program=prog,
+                                  scope=scope, group=group)
+    start = status.step_no + 1 if status is not None else 0
+    world = group.world if group is not None else 1
+    print("RESUME %d world=%d rank=%d attempt=%d"
+          % (start, world, rank, attempt), flush=True)
+
+    fetch = [loss_name] + [g for _, g in pg_names]
+    i = start
+    while i < total:
+        rank = group.rank if group is not None else 0
+        world = group.world if group is not None else 1
+        feed = resharding.shard_batch({"x": xs[i], "y": ys[i]},
+                                      rank, world)
+        out = exe.run(prog, feed=feed, fetch_list=fetch, scope=scope)
+        vals = [np.asarray(v) for v in out]
+        flat = np.concatenate([v.reshape(-1).astype(np.float64)
+                               for v in vals])
+        if group is not None:
+            flat = group.all_reduce(flat, op="mean")
+        loss_g, off = float(flat[0]), 1
+        for (pname, _), v in zip(pg_names, vals[1:]):
+            n = v.size
+            g_mean = flat[off:off + n].reshape(v.shape)
+            off += n
+            w = np.asarray(scope.find_var(pname), np.float64)
+            scope.set_var(pname,
+                          (w - LR * g_mean).astype(np.float32))
+        if rank == 0:
+            print("LOSS %d %.17g" % (i, loss_g), flush=True)
+            if save_every and i % save_every == save_every - 1:
+                ckpt.save_checkpoint(
+                    exe, root, ckpt.TrainStatus(epoch_no=0, step_no=i),
+                    main_program=prog, checkpoint_num=10, scope=scope)
+        if group is not None:
+            group.barrier()
+        # -- the step boundary IS the seam: agree, then resize live --
+        if ew is not None:
+            doomed = ew.sync()
+            if doomed:
+                step_now = i
+
+                def snapshot(doomed_ranks):
+                    # checkpoint-on-signal: the group-agreed snapshot
+                    # every post-seam consumer resumes from (old rank 0
+                    # holds the replicated params — host-tier DP)
+                    if ew.rank == 0:
+                        ckpt.save_checkpoint(
+                            exe, root,
+                            ckpt.TrainStatus(epoch_no=0,
+                                             step_no=step_now),
+                            main_program=prog, checkpoint_num=10,
+                            scope=scope)
+
+                try:
+                    report = ew.resize(doomed, snapshot=snapshot,
+                                       step=i)
+                except preemption.LiveResizeError as e:
+                    print("DEGRADE step=%d: %s" % (i, e), flush=True)
+                    sys.stdout.flush()
+                    os._exit(preemption.DEGRADE_RC)
+                if report["role"] == "doomed":
+                    print("PREEMPTED rank=%d step=%d"
+                          % (report["old_rank"], i), flush=True)
+                    sys.stdout.flush()
+                    os._exit(0)
+                group = ew.group
+                print("RESIZED step=%d world=%d rank=%d "
+                      "coordination_s=%.6f"
+                      % (i, report["new_world"], report["new_rank"],
+                         report["coordination_s"]), flush=True)
+        i += 1
+    if ew is not None:
+        ew.shutdown()
+    elif group is not None:
+        group.shutdown()
+    sys.stdout.flush()
+    # exit WITHOUT interpreter teardown: jax's CPU runtime intermittently
+    # aborts while daemon threads die at exit (see elastic_launch_runner)
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1023,23 +1023,13 @@ def hang_report_cli(telemetry_dir=None, log_dir=None, attempt=None):
     return 0 if rep["verdict"]["verdict"] != "no-hang" else 1
 
 
-def elastic_report(log_dir=None, telemetry_dir=None):
-    """Elastic-restart recovery report: the supervisor's
-    `elastic_transition` events (telemetry.supervisor.jsonl — old/new
-    world, reassignment map, recovery wall time) stitched with the
-    per-attempt postmortem index, so one command answers "what did the
-    run lose at each seam". Returns the process exit code."""
+def _iter_jsonl_events(path, wanted):
+    """Yield event records of the `wanted` types from one JSONL
+    stream, skipping torn lines."""
     import json
 
-    if telemetry_dir is None and log_dir:
-        telemetry_dir = os.path.join(log_dir, "telemetry")
-    if not telemetry_dir or not os.path.isdir(telemetry_dir):
-        print("no telemetry dir at %r" % telemetry_dir)
-        return 2
-    sup = os.path.join(telemetry_dir, "telemetry.supervisor.jsonl")
-    transitions = []
-    if os.path.exists(sup):
-        with open(sup) as f:
+    try:
+        with open(path) as f:
             for line in f:
                 line = line.strip()
                 if not line:
@@ -1048,35 +1038,107 @@ def elastic_report(log_dir=None, telemetry_dir=None):
                     rec = json.loads(line)
                 except ValueError:
                     continue  # torn final line of a killed writer
-                if rec.get("event") == "elastic_transition":
-                    transitions.append(rec)
+                if rec.get("event") in wanted:
+                    yield rec
+    except OSError:
+        return
+
+
+def elastic_report(log_dir=None, telemetry_dir=None):
+    """Elastic recovery report, both seam shapes side by side:
+
+    - restart-shaped: the supervisor's `elastic_transition` events
+      (telemetry.supervisor.jsonl — old/new world, reassignment map,
+      recovery wall time) stitched with the per-attempt postmortem
+      index;
+    - live-shaped: the WORKERS' `elastic_transition(mode=live)` +
+      `live_resize` events (telemetry.rank*.jsonl, current dir and
+      postmortem attempts), each split into its
+      notice -> snapshot -> rebuild -> resume spans.
+
+    One command answers "what did the run lose at each seam — and did
+    it pay a restart or a live resize for it". Returns the process
+    exit code."""
+    import glob as _glob
+    import json
+
+    if telemetry_dir is None and log_dir:
+        telemetry_dir = os.path.join(log_dir, "telemetry")
+    if not telemetry_dir or not os.path.isdir(telemetry_dir):
+        print("no telemetry dir at %r" % telemetry_dir)
+        return 2
+    sup = os.path.join(telemetry_dir, "telemetry.supervisor.jsonl")
+    transitions = list(_iter_jsonl_events(sup, ("elastic_transition",)))
+    # live seams are worker-emitted: scan per-rank streams in the
+    # telemetry dir and every postmortem attempt bundle
+    pm_root = os.path.join(log_dir, "postmortem") if log_dir \
+        else os.path.join(os.path.dirname(telemetry_dir), "postmortem")
+    rank_streams = sorted(
+        _glob.glob(os.path.join(telemetry_dir, "telemetry.rank*.jsonl"))
+        + _glob.glob(os.path.join(pm_root, "attempt*",
+                                  "telemetry.rank*.jsonl")))
+    live, seen = [], set()
+    for path in rank_streams:
+        for rec in _iter_jsonl_events(
+                path, ("elastic_transition", "live_resize")):
+            if rec.get("event") == "elastic_transition" \
+                    and rec.get("mode") != "live":
+                continue
+            # every survivor emits the same seam: dedup on the seam
+            # identity, keep one representative per event type
+            k = (rec["event"], rec.get("old_world"),
+                 rec.get("new_world"), rec.get("generation"),
+                 rec.get("status"))
+            if k in seen:
+                continue
+            seen.add(k)
+            rec["_stream"] = os.path.relpath(
+                path, log_dir or telemetry_dir)
+            live.append(rec)
     index = None
-    pm_index = os.path.join(os.path.dirname(telemetry_dir),
-                            "postmortem", "index.json")
-    if log_dir:
-        pm_index = os.path.join(log_dir, "postmortem", "index.json")
+    pm_index = os.path.join(pm_root, "index.json")
     if os.path.exists(pm_index):
         with open(pm_index) as f:
             index = json.load(f)
-    if not transitions:
+    if not transitions and not live:
         print("no elastic_transition events under %s (fixed-world run, "
               "or the supervisor ran without --min_ranks)"
               % telemetry_dir)
     for t in transitions:
-        print("attempt %s: world %s -> %s, dropped ranks %s, "
-              "reassignment %s, recovery %.2fs"
+        degraded = " [degraded from live seam]" \
+            if t.get("degraded_from_live") else ""
+        print("attempt %s: restart world %s -> %s, dropped ranks %s, "
+              "reassignment %s, recovery %.2fs%s"
               % (t.get("attempt"), t.get("old_world"),
                  t.get("new_world"), t.get("failed_ranks"),
                  t.get("reassignment"), float(t.get("recovery_s",
-                                                    0.0))))
+                                                    0.0)),
+                 degraded))
+    for t in (r for r in live if r.get("event") == "live_resize"):
+        spans = " -> ".join(
+            "%s %.3fs" % (name, float(t.get(name + "_s", 0.0)))
+            for name in ("notice", "snapshot", "rebuild")
+            if (name + "_s") in t)
+        print("live seam: world %s -> %s (%s), coordination %.3fs%s"
+              % (t.get("old_world"), t.get("new_world"),
+                 t.get("status", "ok"),
+                 float(t.get("coordination_s", 0.0)),
+                 (" [%s]" % spans) if spans else ""))
     if transitions:
         total = sum(float(t.get("recovery_s", 0.0)) for t in transitions)
         print("total supervisor recovery wall time: %.2fs over %d "
-              "transition(s)" % (total, len(transitions)))
-    print(json.dumps({"transitions": transitions,
+              "restart transition(s)" % (total, len(transitions)))
+    if live:
+        lr = [r for r in live if r.get("event") == "live_resize"
+              and r.get("status") == "ok"]
+        if lr:
+            total = sum(float(t.get("coordination_s", 0.0)) for t in lr)
+            print("total live coordination wall time: %.3fs over %d "
+                  "live seam(s)" % (total, len(lr)))
+    print(json.dumps({"transitions": transitions, "live": live,
                       "postmortem_index": index},
                      indent=1, sort_keys=True))
-    return 0 if transitions else 1
+    return 0 if (transitions or live) else 1
 
 
 def _parse_mode_flags(mode, argv, spec):

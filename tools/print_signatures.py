@@ -67,6 +67,11 @@ _MODULES = [
     # mesh_hierarchy are the hierarchical-collectives entry every
     # layer (fleet, lowering, launcher, bench) builds on — lock them
     "paddle_tpu.parallel.env",
+    # zero-downtime elasticity: preemption notices, the preempt fault
+    # kind's delivery path and the ElasticWorld live-resize seam are
+    # relied on by the launch supervisor's degrade fallback, worker
+    # runners and perf_analysis --elastic — lock the surface
+    "paddle_tpu.distributed.preemption",
     # inference serving runtime: Engine/KV-cache/scheduler/trace are
     # the serving front end bench.py --serving, the tier-1 serving
     # legs and tools/perf_analysis.py --compile-cache build on — lock
