@@ -347,29 +347,46 @@ def hlo_collective_schedule(stablehlo_text):
 
 
 def check_hierarchical_groups(stablehlo_text, ici_size, ndev=None,
-                              label=None):
+                              label=None, mp_size=1):
     """Two-level replica_groups audit of one lowered module on a
     hybrid (dcn, ici) mesh whose pods are contiguous device blocks of
-    `ici_size`: every collective's group set must be one of the three
-    legal hierarchical shapes —
+    `ici_size` (times `mp_size` when the model axis is factored in:
+    mesh (dcn, ici, model), model INNERMOST, so a pod block holds
+    ici_size * mp_size flat devices): every collective's group set
+    must be one of the legal hierarchical shapes —
 
-    - **intra-pod** (ici): every group lies inside one pod,
+    - **intra-pod** (ici / model): every group lies inside one pod,
     - **cross-pod** (dcn): every group takes at most ONE member per
       pod (the shard exchange between pods),
     - **global**: one group spanning the whole world (a flat
-      collective — legal, e.g. the AMP found_inf psum over both axes).
+      collective — legal, e.g. the AMP found_inf psum over all axes).
+
+    When mp_size > 1, intra-pod groups are audited one level further
+    down — inside a pod a group must be one of:
+
+    - **model-axis** (tp): confined to ONE aligned model block (all
+      members share d // mp — the TP all-reduce between the replica's
+      model shards),
+    - **replica-axis** (ici grad-sync): one member per model block
+      (devices agreeing on the model coordinate hold the SAME data
+      shard, so averaging over them is legal),
+    - **full pod**: spans the whole pod block.
 
     Anything else is an error: a NON-UNIFORM pod split (groups of
     unequal sizes — some ranks wait on a collective their peers never
-    join: deadlock) or a MIXED-axis collective (a group spanning pods
+    join: deadlock), a MIXED-axis collective (a group spanning pods
     with several members inside one pod — neither tier's ring; on real
     hardware it serializes full gradient bytes over the slow DCN link
-    and the per-pod schedules disagree)."""
+    and the per-pod schedules disagree), or a MODEL/REPLICA-mixed
+    group (partially spanning model blocks — it would average DISTINCT
+    tensor-parallel shards together, silently corrupting params)."""
     findings: List[Finding] = []
     sched = hlo_collective_schedule(stablehlo_text)
     ici_size = int(ici_size)
-    if ici_size <= 1:
+    mp = max(int(mp_size or 1), 1)
+    if ici_size <= 1 and mp <= 1:
         return findings
+    pod = max(ici_size, 1) * mp
     world = int(ndev) if ndev else max(
         (d + 1 for rec in sched for g in (rec["groups"] or ())
          for d in g), default=0)
@@ -393,9 +410,9 @@ def check_hierarchical_groups(stablehlo_text, ici_size, ndev=None,
             continue
         if len(groups) == 1 and world and len(groups[0]) == world:
             continue  # global (flat) collective: legal
-        intra = all(len({d // ici_size for d in g}) == 1
+        intra = all(len({d // pod for d in g}) == 1
                     for g in groups)
-        cross = all(len({d // ici_size for d in g}) == len(g)
+        cross = all(len({d // pod for d in g}) == len(g)
                     for g in groups)
         if not intra and not cross:
             findings.append(Finding(
@@ -409,6 +426,26 @@ def check_hierarchical_groups(stablehlo_text, ici_size, ndev=None,
                 "per-pod schedules disagree." % (
                     pos, rec["kind"], where, rec["replica_groups"]),
                 op_type=rec["kind"]))
+            continue
+        if intra and mp > 1:
+            for g in groups:
+                mblocks = {d // mp for d in g}
+                if (len(mblocks) == 1 or len(mblocks) == len(g)
+                        or len(g) == pod):
+                    continue
+                findings.append(Finding(
+                    "collective-divergence", "error",
+                    "collective #%d (%s)%s: MODEL/REPLICA-mixed "
+                    "replica_groups %s on a model-parallel mesh "
+                    "(mp=%d) — a group partially spans model blocks "
+                    "(neither confined to one block, nor one member "
+                    "per block, nor the full pod); it would average "
+                    "DISTINCT tensor-parallel shards together, "
+                    "silently corrupting model-sharded params." % (
+                        pos, rec["kind"], where, rec["replica_groups"],
+                        mp),
+                    op_type=rec["kind"]))
+                break
     return findings
 
 

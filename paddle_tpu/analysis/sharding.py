@@ -50,16 +50,108 @@ import numpy as np
 from .findings import Finding
 
 
+def _check_model_sharded(program) -> List[Finding]:
+    """Model-sharded (tensor-parallel) vocabulary walk — the zero1
+    checker's extension for `FLAGS_tpu_model_parallel` programs.
+
+    Inside shard_map a TP'd param, its AMP fp32 master, its optimizer
+    moments and its gradient are all the LOCAL model shard; devices on
+    the `model` axis hold DISTINCT values. Any post-backward op outside
+    the TP planner's shard-space vocabulary (an optimizer update, the
+    AMP master cast, elementwise decay arithmetic) silently computes on
+    one shard as if it were the whole tensor — a norm mixes partial
+    sums across distinct shards, a collective averages shards together.
+    `plan_tensor_parallel` DECLINES such params at planning time, so a
+    violation here means the program mutated after planning (the same
+    contract the ZeRO padding walk enforces)."""
+    from ..fluid import framework, lowering
+    from ..parallel import sharded_update as su
+    from ..parallel import tensor_parallel as tp
+
+    tpp = getattr(program, "_tp_plan", None)
+    if tpp is None or not getattr(tpp, "var_dims", None):
+        return []
+    block = program.global_block()
+    findings: List[Finding] = []
+    # the model-sharded vocabulary: params + masters + moments (the
+    # plan's var_dims) plus the params' gradients
+    sharded = set(tpp.var_dims)
+    sharded |= {framework.grad_var_name(n) for n in tpp.params}
+    ops = list(block.ops)
+    bwd_idx = next((i for i, op in enumerate(ops)
+                    if op.type == "backward"), None)
+    if bwd_idx is None:
+        return findings
+    ew = su._EW_UNARY | su._EW_BINARY | {"sum"}
+    for i, op in enumerate(ops[bwd_idx + 1:]):
+        op_idx = bwd_idx + 1 + i
+        t = op.type
+        reads, writes = lowering._op_reads_writes(op)
+        hit = (set(reads) | set(writes)) & sharded
+        if not hit:
+            continue
+        if "ParamOut" in op.output_names:
+            if t in tp._NORM_OPTS:
+                findings.append(Finding(
+                    "zero1-invariants", "error",
+                    "optimizer %r folds a full-tensor norm (trust "
+                    "ratio) into the update of model-sharded %s — its "
+                    "psum runs over the data axes only, so each model "
+                    "member scales by a PARTIAL norm; the TP planner "
+                    "declines such params, this op was inserted after "
+                    "planning." % (t, sorted(hit)),
+                    block_idx=block.idx, op_idx=op_idx, op_type=t,
+                    var=sorted(hit)[0]))
+            continue
+        if t in tp._NORM_READERS:
+            findings.append(Finding(
+                "zero1-invariants", "error",
+                "op %r computes a global norm over model-sharded %s — "
+                "each model member holds a DISTINCT shard, so the "
+                "norm needs a model-axis psum the shard-space "
+                "interpreter does not emit; the TP planner declines "
+                "such params, this op was inserted after "
+                "planning." % (t, sorted(hit)),
+                block_idx=block.idx, op_idx=op_idx, op_type=t,
+                var=sorted(hit)[0]))
+            continue
+        if t == "cast" and op.attrs.get("__amp_param_cast__"):
+            continue
+        if t in ew:
+            continue
+        if t.startswith("c_allreduce") or t == "allreduce":
+            findings.append(Finding(
+                "zero1-invariants", "error",
+                "collective %r over model-sharded %s — model members "
+                "hold DISTINCT shards that must never be averaged "
+                "together (grad sync belongs on the (dcn, replica) "
+                "axes); the TP planner declines explicit-sync "
+                "programs for such params." % (t, sorted(hit)),
+                block_idx=block.idx, op_idx=op_idx, op_type=t,
+                var=sorted(hit)[0]))
+            continue
+        findings.append(Finding(
+            "zero1-invariants", "error",
+            "op %r touches model-sharded %s without a shard-space "
+            "rule — inside shard_map the value is one model member's "
+            "LOCAL block, not the logical tensor; the TP planner "
+            "declines such programs, so this op was inserted after "
+            "planning." % (t, sorted(hit)),
+            block_idx=block.idx, op_idx=op_idx, op_type=t,
+            var=sorted(hit)[0]))
+    return findings
+
+
 def check_shard_plan(program, plan=None) -> List[Finding]:
     from ..fluid import lowering
     from ..parallel import sharded_update as su
 
     plan = plan if plan is not None else getattr(program, "_shard_plan",
                                                  None)
+    findings: List[Finding] = _check_model_sharded(program)
     if plan is None:
-        return []
+        return findings
     block = program.global_block()
-    findings: List[Finding] = []
 
     # -- bucket invariants -------------------------------------------------
     for b in plan.buckets:
@@ -84,29 +176,65 @@ def check_shard_plan(program, plan=None) -> List[Finding]:
 
     # -- sharded-state layout vs checkpoint save/restore -------------------
     for n, info in plan.sharded_state.items():
+        mp = max(int(getattr(info, "mp", 1) or 1), 1)
+        tp_dim = getattr(info, "tp_dim", None)
+        # a non-TP var's shape IS its logical shape (audit the live
+        # field, not the ctor-time copy, so post-planning tampering
+        # trips); a TP var's .shape is the per-model-member local block
+        logical = tuple(getattr(info, "logical_shape", info.shape)) \
+            if tp_dim is not None else tuple(info.shape)
+        # info.shape is the PER-MODEL-MEMBER local shape when the var
+        # is tensor-parallel (tp_dim set); the flat ZeRO layout (numel,
+        # padded, shard slices) is all in local terms
         numel = int(np.prod(info.shape)) if info.shape else 1
         want_padded = -(-numel // plan.ndev) * plan.ndev
         if info.numel != numel or info.padded != want_padded:
             findings.append(Finding(
                 "zero1-invariants", "error",
                 "sharded state %r: ShardInfo records numel=%d "
-                "padded=%d but logical shape %s implies numel=%d "
+                "padded=%d but its (local) shape %s implies numel=%d "
                 "padded=%d (ndev=%d) — a checkpoint restore would "
                 "re-shard against the wrong layout." % (
                     n, info.numel, info.padded, info.shape, numel,
                     want_padded, plan.ndev),
                 var=n))
+        if tp_dim is not None:
+            bad = (mp <= 1 or not (0 <= tp_dim < len(logical))
+                   or logical[tp_dim] % mp != 0)
+            if not bad:
+                want_local = list(logical)
+                want_local[tp_dim] //= mp
+                bad = tuple(want_local) != tuple(info.shape)
+            if bad:
+                findings.append(Finding(
+                    "zero1-invariants", "error",
+                    "model-sharded state %r: local shape %s does not "
+                    "derive from logical shape %s by dividing dim %d "
+                    "over mp=%d — the model-major flat layout "
+                    "(to_sharded_global / unshard) would reassemble "
+                    "the wrong tensor on restore." % (
+                        n, info.shape, logical, tp_dim, mp),
+                    var=n))
+            if mp != max(int(getattr(plan, "mp_size", 1) or 1), 1):
+                findings.append(Finding(
+                    "zero1-invariants", "error",
+                    "model-sharded state %r records mp=%d but the "
+                    "plan's mp_size is %s — the flat buffer's "
+                    "model-major segmentation would disagree with "
+                    "the mesh's model axis." % (
+                        n, mp, getattr(plan, "mp_size", 1)),
+                    var=n))
         v = block._find_var_recursive(n)
         declared = tuple(int(d) for d in v.shape) if v is not None \
             else None
-        if declared != info.shape:
+        if declared != logical:
             findings.append(Finding(
                 "zero1-invariants", "error",
                 "sharded state %r: plan logical shape %s != block var "
                 "shape %s — checkpoint SAVE (logical, "
                 "unshard_scope_value) and RESTORE (re-sharded against "
                 "the plan) would disagree on the layout." % (
-                    n, info.shape, declared),
+                    n, logical, declared),
                 var=n))
 
     # -- padding-zeroing taint walk over the post-backward section ---------

@@ -318,23 +318,28 @@ class HostCollectiveGroup:
         return "%s#%d" % (tag, self._seq)
 
     def _comm_lane(self):
-        """"dcn" | "ici" lane of this group's collectives on a
-        multi-pod launch, or None when no pod topology is declared
-        (FLAGS_tpu_dcn_replicas / PADDLE_NUM_PODS unset/1 — the flat
-        pre-hybrid reading, no extra counters). Pod of rank r =
-        r // (global_world / num_pods), the launcher's contiguous-block
-        assignment; a group spanning two pods coordinates over the
-        slow DCN link, one confined to a single pod (a sub-world group
-        smaller than a pod) stays "ici". Today's full-world groups
-        therefore classify as "dcn" whenever pods > 1 — cross-rank
-        host coordination IS cross-pod traffic there."""
+        """"dcn" | "ici" | "mp" lane of this group's collectives on a
+        multi-pod / model-parallel launch, or None when no hierarchy is
+        declared (FLAGS_tpu_dcn_replicas / PADDLE_NUM_PODS and
+        PADDLE_MP_DEGREE unset/1 — the flat pre-hybrid reading, no
+        extra counters). Pod of rank r = r // (global_world /
+        num_pods), the launcher's contiguous-block assignment; a group
+        spanning two pods coordinates over the slow DCN link, one
+        confined to a single pod stays "ici" — unless the model axis
+        is live and the group stays inside one aligned mp-block (all
+        ranks share r // mp: same pod, same replica — model is
+        INNERMOST in the (dcn, replica, model) factorization), which
+        is tensor-parallel coordination: lane "mp". Today's full-world
+        groups therefore classify as "dcn" whenever pods > 1 —
+        cross-rank host coordination IS cross-pod traffic there."""
         lane = getattr(self, "_comm_lane_cached", False)
         if lane is not False:
             return lane
         from ..parallel import env as penv
 
         npods = penv.dcn_replicas()
-        if npods <= 1 or self.world <= 1:
+        mp = penv.model_parallel_degree()
+        if (npods <= 1 and mp <= 1) or self.world <= 1:
             lane = None
         else:
             # pod size derives from the GLOBAL launch world (this
@@ -344,9 +349,15 @@ class HostCollectiveGroup:
                          or 0) or self.world
             except ValueError:
                 gw = self.world
-            per_pod = max(1, gw // npods)
-            pods = {r // per_pod for r in range(self.world)}
-            lane = "dcn" if len(pods) > 1 else "ici"
+            per_pod = max(1, gw // max(npods, 1))
+            ranks = range(self.world)
+            pods = {r // per_pod for r in ranks}
+            if len(pods) > 1:
+                lane = "dcn"
+            elif mp > 1 and len({r // mp for r in ranks}) == 1:
+                lane = "mp"
+            else:
+                lane = "ici"
         self._comm_lane_cached = lane
         return lane
 

@@ -111,6 +111,17 @@ def _parse_args(argv):
                         "shrink keeps pods RECTANGULAR (equal-size) or "
                         "falls back to a flat world — never a wedged "
                         "rendezvous")
+    p.add_argument("--mp_degree", type=int, default=0,
+                   help="tensor (model) parallel degree: factor each "
+                        "worker's intra-pod device tier into (replica, "
+                        "model) — PADDLE_MP_DEGREE exported to workers; "
+                        "hybrid (dcn, replica, model) meshes "
+                        "(parallel/env.create_hybrid_mesh) and the "
+                        "comm-lane telemetry read it. 0 = the "
+                        "PADDLE_MP_DEGREE env, else 1 (no model axis). "
+                        "Must divide each worker's local device count "
+                        "or the worker falls back to a flat mesh with "
+                        "a warning")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -136,6 +147,21 @@ def _launch_num_pods(args, world):
             % (world, npods))
         return 1
     return npods
+
+
+def _launch_mp_degree(args):
+    """The effective model-parallel degree: --mp_degree, else
+    PADDLE_MP_DEGREE, else 1 (no model axis). Divisibility against each
+    worker's LOCAL device count is the worker's own check
+    (parallel/env.create_hybrid_mesh warns and runs flat) — the
+    launcher only resolves and exports the knob."""
+    mp = getattr(args, "mp_degree", 0)
+    if not mp:
+        try:
+            mp = int(os.environ.get("PADDLE_MP_DEGREE", "1") or 1)
+        except ValueError:
+            mp = 1
+    return mp if mp > 1 else 1
 
 
 def _pod_shrink(endpoints, failed_tids, npods):
@@ -172,7 +198,7 @@ def _pod_shrink(endpoints, failed_tids, npods):
 
 def _worker_env(endpoints, tid, restart_no, base_env=None,
                 telemetry_dir=None, npods=1, hang_timeout_s=0.0,
-                compile_cache_dir=None):
+                compile_cache_dir=None, mp_degree=1):
     """The PADDLE_* contract for one supervised worker. Cross-rank
     checkpoint-step agreement (PADDLE_CKPT_AGREE, see
     distributed/sharded_checkpoint.agree_newest_intact) is ON by
@@ -223,6 +249,14 @@ def _worker_env(endpoints, tid, restart_no, base_env=None,
         # the shrunk cohort through the inherited environment
         env.pop("PADDLE_NUM_PODS", None)
         env.pop("PADDLE_POD_ID", None)
+    if mp_degree > 1:
+        # model-parallel degree: each worker factors its intra-pod
+        # device tier into (replica, model) —
+        # parallel/env.create_hybrid_mesh and the comm-lane telemetry
+        # read it (same contract as the pod vars above)
+        env["PADDLE_MP_DEGREE"] = str(mp_degree)
+    else:
+        env.pop("PADDLE_MP_DEGREE", None)
     return env
 
 
@@ -639,7 +673,8 @@ def _spawn_cohort(args, endpoints, local_ids, restart_no, npods=1):
         env = _worker_env(endpoints, tid, restart_no,
                           telemetry_dir=tdir, npods=npods,
                           hang_timeout_s=_hang_timeout_for(args),
-                          compile_cache_dir=ccdir)
+                          compile_cache_dir=ccdir,
+                          mp_degree=_launch_mp_degree(args))
         cmd = [sys.executable, "-u", args.training_script] \
             + args.training_script_args
         out = None

@@ -103,7 +103,7 @@ class Executor:
         ph = {"feed": 0.0, "dispatch": 0.0, "sync": 0.0, "compile": 0.0}
         comm0 = _prof.step_phase_total("comm")
         lanes0 = {ln: _prof.step_phase_total(ln)
-                  for ln in ("comm_ici", "comm_dcn")}
+                  for ln in ("comm_ici", "comm_dcn", "comm_mp")}
         try:
             return self._run_impl(program, feed, fetch_list, scope,
                                   return_numpy, use_program_cache, ph)
@@ -284,8 +284,12 @@ class Executor:
 
             for n, info in entry.sharded_state.items():
                 v = states_mut.get(n)
+                # model-sharded ZeRO vars: the device layout is the
+                # model-major concat of mp per-member padded flats
+                expect = (info.padded * info.mp,) \
+                    if info.tp_dim is not None else (info.padded,)
                 if v is not None and \
-                        tuple(getattr(v, "shape", ())) != (info.padded,):
+                        tuple(getattr(v, "shape", ())) != expect:
                     v = _su.to_sharded_global(v, info, entry.mesh,
                                               entry.dp_axis)
                     states_mut[n] = v
@@ -792,8 +796,10 @@ class Executor:
 
                 for n, info in entry.sharded_state.items():
                     v = states_mut.get(n)
+                    expect = (info.padded * info.mp,) \
+                        if info.tp_dim is not None else (info.padded,)
                     if v is not None and tuple(
-                            getattr(v, "shape", ())) != (info.padded,):
+                            getattr(v, "shape", ())) != expect:
                         states_mut[n] = _su.to_sharded_global(
                             v, info, entry.mesh, entry.dp_axis)
             if entry.sparse_tables:
@@ -1657,7 +1663,8 @@ class Executor:
         hier = penv.mesh_hierarchy(entry.mesh)
         census = lowering.collective_byte_census(
             lowered.as_text(), ndev,
-            ici_size=(hier[3] if hier is not None else None))
+            ici_size=(hier[3] if hier is not None else None),
+            mp_size=(hier.mp_size if hier is not None else None))
         plan = self._shard_plan_of(program)
         shards = self._shard_count(entry)
         if plan is not None and getattr(plan, "buckets", ()):

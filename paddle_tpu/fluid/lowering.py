@@ -237,7 +237,16 @@ def _exec_op_stamped(op, env, key0, op_idx, amp_lists=None):
     if opdef.needs_rng:
         attrs["_rng_key"] = jax.random.fold_in(key0, op_idx)
     try:
-        if opdef.no_jit and any(
+        # tensor parallelism (parallel/tensor_parallel.py): under an
+        # active TP plan, an op consuming a model-sharded weight lowers
+        # to the local partial compute + its model-axis collective —
+        # same contextvar routing as the sparse engine above
+        from ..parallel import tensor_parallel as _tp_engine
+
+        tp_outs = _tp_engine.maybe_compute(op, ins, attrs)
+        if tp_outs is not None:
+            outs = ops_lib.normalize_outs(tp_outs)
+        elif opdef.no_jit and any(
                 isinstance(v, jax.core.Tracer)
                 for vs in ins.values() for v in vs):
             outs = _host_callback_op(opdef, op, ins, attrs)
@@ -918,7 +927,7 @@ def _diffable(block, name, env):
 
 def build_block_fn(program, block, feed_names, fetch_names,
                    state_in, state_out, shard_plan=None,
-                   sparse_plan=None):
+                   sparse_plan=None, tp_plan=None):
     """Build the pure python fn to be jitted. With `shard_plan` (a
     parallel.sharded_update.ShardedUpdatePlan; only under _compile_dp),
     optimizer-bound gradients are reduce-scattered instead of pmean'd,
@@ -930,7 +939,16 @@ def build_block_fn(program, block, feed_names, fetch_names,
     engine, and each table's gradient is collected via a zero "tap"
     diff var (the table itself never enters jax.vjp — no dense
     vocab-sized cotangent exists) and applied as a row-sparse update
-    on the owning shard."""
+    on the owning shard.
+
+    With `tp_plan` (a parallel.tensor_parallel.TensorParallelPlan),
+    model-sharded weights arrive as local blocks, their consuming ops
+    lower through the TP engine's collectives on the `model` axis, and
+    grad sync stays on the (dcn, replica) data axes — model members
+    hold DISTINCT weight shards whose grads must never be averaged
+    over `model`, while devices agreeing on the model coordinate hold
+    the SAME shard, which is exactly the group the (dcn, ici)
+    pmean/reduce-scatter already syncs."""
     import jax
     import jax.numpy as jnp
 
@@ -942,6 +960,10 @@ def build_block_fn(program, block, feed_names, fetch_names,
         from ..embedding import engine as _emb
     else:
         _emb = None
+    if tp_plan is not None:
+        from ..parallel import tensor_parallel as _tp
+    else:
+        _tp = None
 
     ops = list(block.ops)
     bwd_indices = [i for i, op in enumerate(ops) if op.type == "backward"]
@@ -970,6 +992,11 @@ def build_block_fn(program, block, feed_names, fetch_names,
     # so its association matches the scatter path's — the pairing that
     # keeps the sharded update bit-identical to this reference
     _dcn_axis_name = getattr(program, "_dcn_axis", None)
+    # tensor parallelism: the model axis never joins the grad sync, but
+    # the AMP found_inf predicate must still psum over it — model
+    # members hold DIFFERENT grad shards, and a lax.cond predicate that
+    # differs across mesh members would deadlock the collectives inside
+    _model_axis_name = tp_plan.model_axis if tp_plan is not None else None
 
     def _dp_sync_axes():
         from ..parallel import env as penv
@@ -1010,12 +1037,16 @@ def build_block_fn(program, block, feed_names, fetch_names,
 
 
     def fn(feeds: Dict, states_mut: Dict, states_ro: Dict, seed):
-        if sparse_plan is None:
+        if sparse_plan is None and tp_plan is None:
             return _fn_body(feeds, states_mut, states_ro, seed)
-        # install the sparse plan for this trace (contextvar — the
-        # engine's per-op routing in _exec_op_stamped reads it; safe
+        # install the sparse/TP plans for this trace (contextvars — the
+        # engines' per-op routing in _exec_op_stamped reads them; safe
         # under concurrent background-warmup traces)
-        with _emb.active_plan(sparse_plan):
+        with contextlib.ExitStack() as stack:
+            if sparse_plan is not None:
+                stack.enter_context(_emb.active_plan(sparse_plan))
+            if tp_plan is not None:
+                stack.enter_context(_tp.active_plan(tp_plan))
             return _fn_body(feeds, states_mut, states_ro, seed)
 
     def _fn_body(feeds: Dict, states_mut: Dict, states_ro: Dict, seed):
@@ -1180,7 +1211,7 @@ def build_block_fn(program, block, feed_names, fetch_names,
             if dls is not None:
                 found_inf = _amp_found_inf(
                     {n: grads[n] for n in diff_names if n in grads},
-                    (_dp_axis_name, _dcn_axis_name))
+                    (_dp_axis_name, _dcn_axis_name, _model_axis_name))
             # under gradient merge, sync once on the MERGED grads at the
             # k-step boundary instead of k per-micro-step allreduces
             from ..observability import attribution as _attr
@@ -1295,36 +1326,28 @@ def compile_block(program, block, feed_specs, fetch_names, state_specs,
         program._dcn_axis = None
     dp_axis = getattr(program, "_dp_axis", "dp")
 
-    # vocab-sharded sparse embedding tables (FLAGS_tpu_sparse_embedding,
-    # paddle_tpu/embedding): planned FIRST so the ZeRO planner below
-    # leaves the sparse tables' optimizer ops/moments to the engine
-    sparse_plan = None
+    # ONE planner owns axis assignment (parallel/planner.py): sparse
+    # tables → replica rows, tensor parallel → the model axis (via the
+    # logical-axis rules), ZeRO-1 flat buffers → the replica axis with
+    # TP-local shapes. Planned together so the engines compose instead
+    # of colliding, and so the structured-decline trail
+    # (program._sharded_update_fallback) covers all three.
+    sparse_plan = tp_plan = shard_plan = None
     if mesh is not None and getattr(program, "_data_parallel", False) \
             and getattr(program, "_auto_parallel", None) is None \
             and not getattr(program, "_pipeline_cfg", None):
-        from ..embedding import planner as _emb_planner
+        from ..parallel import planner as _planner
 
-        ndev = int(mesh.shape[dp_axis]) if dp_axis in mesh.shape else 1
-        sparse_plan = _emb_planner.plan_sparse_tables(
-            program, block, ndev, dp_axis,
-            dcn_axis=(hier[0] if hier is not None else None),
-            dcn_size=(hier[2] if hier is not None else 1),
-            feed_names=feed_names)
+        pplan = _planner.plan_parallel(program, block, mesh, dp_axis,
+                                       feed_names=feed_names,
+                                       fetch_names=fetch_names)
+        sparse_plan = pplan.sparse_plan
+        tp_plan = pplan.tp_plan
+        shard_plan = pplan.shard_plan
     program._sparse_plan = sparse_plan
-
-    # ZeRO-1 sharded weight update (FLAGS_tpu_sharded_weight_update):
-    # plan once per program; None = keep the replicated update
-    shard_plan = None
-    if mesh is not None and getattr(program, "_data_parallel", False) \
-            and getattr(program, "_auto_parallel", None) is None \
-            and not getattr(program, "_pipeline_cfg", None):
-        from ..parallel import sharded_update as _su
-
-        ndev = int(mesh.shape[dp_axis]) if dp_axis in mesh.shape else 1
-        shard_plan = _su.plan_sharded_update(
-            program, block, ndev, dp_axis,
-            dcn_axis=(hier[0] if hier is not None else None),
-            dcn_size=(hier[2] if hier is not None else 1))
+    program._tp_plan = tp_plan
+    program._model_axis = tp_plan.model_axis if tp_plan is not None \
+        else None
     program._shard_plan = shard_plan
 
     state_out_set = set(state_out)
@@ -1338,7 +1361,7 @@ def compile_block(program, block, feed_specs, fetch_names, state_specs,
 
     fn = build_block_fn(program, block, feed_names, fetch_names,
                         state_in, state_out, shard_plan=shard_plan,
-                        sparse_plan=sparse_plan)
+                        sparse_plan=sparse_plan, tp_plan=tp_plan)
 
     if shard_plan is not None:
         # a would-be-sharded state var must flow in AND out of the step;
@@ -1391,10 +1414,18 @@ def compile_block(program, block, feed_specs, fetch_names, state_specs,
                 v = block._find_var_recursive(n)
                 if v is not None and getattr(v, "persistable", False):
                     persistable.add(n)
+            # the unified planner owns axis assignment for the GSPMD
+            # search too: candidate specs shard each param at the dim
+            # the axis rules assign, not a blanket "last axis"
+            from ..parallel import planner as _planner
+
+            tp_dims = _planner.param_tp_dims(
+                program, block, feed_names=feed_names,
+                fetch_names=fetch_names)
             plan = ap.search_plan(fn, feed_specs, state_mut, state_ro,
                                   state_specs, persistable,
                                   configs=ap_cfg, state_out=state_out,
-                                  donate=donate)
+                                  donate=donate, tp_dims=tp_dims)
             program._auto_plan = plan
             jitted = ap.compile_with_plan(fn, plan, feed_names,
                                           state_mut, state_ro, state_out,
@@ -1407,7 +1438,8 @@ def compile_block(program, block, feed_specs, fetch_names, state_specs,
     if mesh is not None and getattr(program, "_data_parallel", False):
         jitted = _compile_dp(fn, mesh, dp_axis, program, block,
                              feed_names, fetch_names, state_mut, state_ro,
-                             donate, feed_donate, shard_plan=shard_plan)
+                             donate, feed_donate, shard_plan=shard_plan,
+                             tp_plan=tp_plan, state_out=state_out)
     else:
         host, dynamic = _block_host_op_kinds(block)
         if dynamic:
@@ -1639,20 +1671,30 @@ def parse_replica_groups(open_line, close_line=""):
         return None
 
 
-def classify_replica_groups(groups, ici_size):
-    """"ici" | "dcn" lane of one collective's replica_groups on a
-    hybrid mesh whose pods are contiguous device blocks of `ici_size`
-    (the create_hybrid_mesh CPU/emulation layout): a collective whose
-    every group stays inside one pod rides the fast intra-pod ICI; any
-    group spanning two pods crosses the slow DCN link. None when the
+def classify_replica_groups(groups, ici_size, mp_size=1):
+    """"ici" | "dcn" | "mp" lane of one collective's replica_groups on
+    a hybrid mesh whose pods are contiguous device blocks (the
+    create_hybrid_mesh CPU/emulation layout): a collective whose every
+    group stays inside one pod rides the fast intra-pod ICI; any group
+    spanning two pods crosses the slow DCN link. With a model axis
+    (`mp_size` > 1, the (dcn, replica, model) factorization where
+    model is INNERMOST — flat device d has model coord d % mp), a pod
+    is `ici_size * mp_size` devices, and a group confined to one
+    aligned mp-block (all members share d // mp — same pod, same
+    replica) is a tensor-parallel exchange: lane "mp". None when the
     groups are unknown (caller treats the collective as ici — the
     flat-mesh reading)."""
-    if not groups or not ici_size or ici_size <= 1:
+    mp = max(int(mp_size or 1), 1)
+    if not groups or ((not ici_size or ici_size <= 1) and mp <= 1):
         return None
+    pod = max(int(ici_size or 1), 1) * mp
     for g in groups:
-        pods = {d // ici_size for d in g}
+        pods = {d // pod for d in g}
         if len(pods) > 1:
             return "dcn"
+    if mp > 1 and any(len(g) > 1 for g in groups) and \
+            all(len({d // mp for d in g}) == 1 for g in groups):
+        return "mp"
     return "ici"
 
 
@@ -1671,7 +1713,8 @@ def _ring_wire_bytes(op, b, n):
     return b
 
 
-def collective_byte_census(stablehlo_text, ndev=1, ici_size=None):
+def collective_byte_census(stablehlo_text, ndev=1, ici_size=None,
+                           mp_size=None):
     """Per-collective accounting from a lowered StableHLO module:
     {op: {count, tensor_bytes, ici_bytes}} + totals. `tensor_bytes`
     sums the RESULT tensor sizes; `ici_bytes` models ring-algorithm
@@ -1685,13 +1728,21 @@ def collective_byte_census(stablehlo_text, ndev=1, ici_size=None):
     link that bounds grad-sync time at multi-pod scale) — with a
     per-collective byte list per lane, so the hierarchical lowering's
     claim (cross-pod bytes = flat-allreduce bytes / ici_size per
-    bucket) is checkable from the census alone."""
+    bucket) is checkable from the census alone.
+
+    `mp_size` (tensor parallelism): a third lane, "mp", for
+    model-axis collectives — groups confined to one aligned mp-block
+    — reported beside ici/dcn as `mp_bytes_total`, so the TP
+    contract (grad-sync bytes confined to the (dcn, replica) axes,
+    per-chip param bytes ∝ 1/mp) is checkable from the census too."""
     ndev = max(int(ndev), 1)
+    mp = max(int(mp_size or 1), 1)
     out = {op: {"count": 0, "tensor_bytes": 0, "ici_bytes": 0}
            for op in _COLLECTIVE_OPS}
+    lane_names = ("ici", "dcn", "mp") if mp > 1 else ("ici", "dcn")
     lanes = {ln: {"count": 0, "tensor_bytes": 0, "wire_bytes": 0,
                   "per_collective": []}
-             for ln in ("ici", "dcn")}
+             for ln in lane_names}
     for op, ttype, open_line, close_line in \
             _hlo_collective_hits(stablehlo_text):
         b = _tensor_bytes(ttype)
@@ -1702,8 +1753,9 @@ def collective_byte_census(stablehlo_text, ndev=1, ici_size=None):
         rec["count"] += 1
         rec["tensor_bytes"] += b
         rec["ici_bytes"] += _ring_wire_bytes(op, b, n)
-        if ici_size:
-            lane = classify_replica_groups(groups, ici_size) or "ici"
+        if ici_size or mp > 1:
+            lane = classify_replica_groups(groups, ici_size, mp) \
+                or "ici"
             lrec = lanes[lane]
             lrec["count"] += 1
             lrec["tensor_bytes"] += b
@@ -1715,11 +1767,14 @@ def collective_byte_census(stablehlo_text, ndev=1, ici_size=None):
     out["total_tensor_bytes"] = sum(
         v["tensor_bytes"] for v in out.values() if isinstance(v, dict))
     out["ndev"] = ndev
-    if ici_size:
+    if ici_size or mp > 1:
         out["lanes"] = lanes
-        out["ici_size"] = int(ici_size)
-        out["dcn_size"] = ndev // int(ici_size)
+        out["ici_size"] = int(ici_size or 1)
+        out["dcn_size"] = ndev // (int(ici_size or 1) * mp)
         out["dcn_bytes_total"] = lanes["dcn"]["wire_bytes"]
+        if mp > 1:
+            out["mp_size"] = mp
+            out["mp_bytes_total"] = lanes["mp"]["wire_bytes"]
     return out
 
 
@@ -1919,14 +1974,22 @@ def collective_overlap_audit(optimized_hlo):
 
 def _compile_dp(fn, mesh, dp_axis, program, block, feed_names, fetch_names,
                 state_mut, state_ro, donate, feed_donate=False,
-                shard_plan=None):
+                shard_plan=None, tp_plan=None, state_out=None):
     """Data-parallel lowering: shard_map over the mesh; feeds sharded on
     axis 0, state replicated. Collective ops inside see the live axis and
     emit psum over ICI (reference flow: transpiler/collective.py:178-268 +
     c_allreduce kernels -> here SURVEY.md §3C TPU mapping). With a
     shard_plan, optimizer-state vars get P(dp_axis) in/out specs — their
     scope arrays are flat buffers sharded over the mesh, so per-replica
-    optimizer HBM is ~1/N across steps (ZeRO-1)."""
+    optimizer HBM is ~1/N across steps (ZeRO-1).
+
+    With a tp_plan, state splits into FOUR layouts: replicated P();
+    ZeRO flat buffers P(dp); ZeRO flat buffers of model-sharded vars
+    P((model, dp)) — the model-major concat of per-member local flats;
+    and model-sharded params P(model @ their tp_dim) — the scope keeps
+    LOGICAL shapes, shard_map hands each device its local block
+    (save-logical / restore-sharded falls out of the specs, no
+    checkpoint special-casing)."""
     import jax
     from jax.sharding import PartitionSpec as P
 
@@ -1944,30 +2007,68 @@ def _compile_dp(fn, mesh, dp_axis, program, block, feed_names, fetch_names,
     sharded_names = (frozenset(shard_plan.sharded_state)
                      if shard_plan is not None else frozenset()) \
         | row_sharded
-    # hybrid (dcn, ici) mesh: data (batch) shards over BOTH axes —
+    # hybrid (dcn, ici) mesh: data (batch) shards over BOTH data axes —
     # row-major, so device (pod p, chip j) holds the same batch slice
     # as flat device p*ici+j — while sharded opt-state stays P(ici)
-    # only (each pod holds a full copy of the 1/ici shards)
+    # only (each pod holds a full copy of the 1/ici shards). The model
+    # axis NEVER carries data: its mp members duplicate the batch slice
+    # and hold distinct weight shards instead.
     hier = penv.mesh_hierarchy(mesh)
     data_axes = (hier[0], hier[1]) if hier is not None else dp_axis
+    mp_axis = tp_plan.model_axis if tp_plan is not None else None
+    # ZeRO'd vars that are ALSO model-sharded ride P((model, dp)) flat
+    # buffers; model-sharded vars NOT in ZeRO state (live params, or
+    # moments when the ZeRO planner declined) keep logical shapes in
+    # scope with P(model @ tp_dim)
+    zero_tp = frozenset(
+        n for n, info in shard_plan.sharded_state.items()
+        if info.tp_dim is not None) if shard_plan is not None \
+        else frozenset()
+    tp_only = frozenset(tp_plan.var_dims) - sharded_names \
+        if tp_plan is not None else frozenset()
+
+    def tp_spec(n):
+        return tp_plan.spec_for(n)
 
     def wrapped(feeds, states_mut, states_ro, seed):
         with penv.collective_scope(axes):
             fetches, new_states = fn(feeds, states_mut, states_ro, seed)
         # split state outs by layout: shard_map needs distinct out
-        # specs for replicated vs dp-sharded state
-        rep = {n: v for n, v in new_states.items()
-               if n not in sharded_names}
-        sh = {n: v for n, v in new_states.items() if n in sharded_names}
-        return fetches, rep, sh
+        # specs for replicated vs dp-sharded vs model-sharded state
+        rep, sh, sh_ztp, sh_tp = {}, {}, {}, {}
+        for n, v in new_states.items():
+            if n in zero_tp:
+                sh_ztp[n] = v
+            elif n in sharded_names:
+                sh[n] = v
+            elif n in tp_only:
+                sh_tp[n] = v
+            else:
+                rep[n] = v
+        return fetches, rep, sh, sh_ztp, sh_tp
 
     feed_specs = {n: P(data_axes) for n in feed_names}
-    state_specs_mut = {n: (P(dp_axis) if n in sharded_names else P())
-                       for n in state_mut}
-    # forward-only programs hold their sparse tables as read-only
-    # state — still row-sharded
-    state_specs_ro = {n: (P(dp_axis) if n in row_sharded else P())
+
+    def state_spec(n):
+        if n in zero_tp:
+            return P((mp_axis, dp_axis))
+        if n in sharded_names:
+            return P(dp_axis)
+        if n in tp_only:
+            return tp_spec(n)
+        return P()
+
+    state_specs_mut = {n: state_spec(n) for n in state_mut}
+    # forward-only programs hold their sparse tables (and model-sharded
+    # params) as read-only state — still sharded
+    state_specs_ro = {n: state_spec(n) if n in tp_only
+                      else (P(dp_axis) if n in row_sharded else P())
                       for n in state_ro}
+    # out specs for the model-sharded group need the per-name tp_dim, so
+    # the names must be static: state_out is the traced fn's exact
+    # new_states key set
+    out_names = state_out if state_out is not None else state_mut
+    tp_out_specs = {n: tp_spec(n) for n in out_names if n in tp_only}
 
     def out_spec_for_fetch(n):
         if sparse_plan is not None and (
@@ -1988,12 +2089,17 @@ def _compile_dp(fn, mesh, dp_axis, program, block, feed_names, fetch_names,
     smapped = shard_map_compat(
         wrapped, mesh=mesh,
         in_specs=(feed_specs, state_specs_mut, state_specs_ro, P()),
-        out_specs=(fetch_specs, P(), P(dp_axis)),
+        out_specs=(fetch_specs, P(), P(dp_axis),
+                   P((mp_axis, dp_axis)) if mp_axis is not None
+                   else P(dp_axis), tp_out_specs),
         check_vma=False)
 
     def merged(feeds, states_mut, states_ro, seed):
-        fetches, rep, sh = smapped(feeds, states_mut, states_ro, seed)
+        fetches, rep, sh, sh_ztp, sh_tp = smapped(
+            feeds, states_mut, states_ro, seed)
         rep.update(sh)
+        rep.update(sh_ztp)
+        rep.update(sh_tp)
         return fetches, rep
 
     return jax.jit(merged,

@@ -111,10 +111,10 @@ def step_phase_summary(reset=False):
             # they never pollute host_ms, but the summary still shows them
             out["compile_ms"] = round(
                 _step_phases["compile"][1] * 1e3 / denom, 3)
-        for lane in ("comm_ici", "comm_dcn"):
+        for lane in ("comm_ici", "comm_dcn", "comm_mp"):
             # hybrid-mesh comm lanes (host_collectives._comm_phase on a
-            # PADDLE_NUM_PODS launch): a BREAKDOWN of comm_ms by
-            # interconnect tier, never added to the total
+            # PADDLE_NUM_PODS / PADDLE_MP_DEGREE launch): a BREAKDOWN
+            # of comm_ms by interconnect tier, never added to the total
             if lane in _step_phases:
                 out[lane + "_ms"] = round(
                     _step_phases[lane][1] * 1e3 / denom, 3)

@@ -38,7 +38,7 @@ __all__ = ["window_summary", "allgather_window", "aggregate_summaries",
 
 _PHASES = tuple(f for f in STEP_FIELDS
                 if f not in ("compile_ms", "comm_ici_ms",
-                             "comm_dcn_ms"))
+                             "comm_dcn_ms", "comm_mp_ms"))
 
 
 def _percentile(vals: List[float], q: float) -> Optional[float]:

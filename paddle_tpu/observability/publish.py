@@ -40,9 +40,10 @@ from typing import Optional
 from .registry import registry
 
 __all__ = ["phases_block", "collectives_blocks", "hierarchy_block",
-           "precision_block", "embedding_block", "attribution_block",
-           "static_checks_block", "compile_cache_block",
-           "serving_block", "telemetry_block", "bench_blocks"]
+           "model_parallel_block", "precision_block", "embedding_block",
+           "attribution_block", "static_checks_block",
+           "compile_cache_block", "serving_block", "telemetry_block",
+           "bench_blocks"]
 
 
 def phases_block() -> dict:
@@ -186,6 +187,65 @@ def hierarchy_block(exe, program, feed, fetch_list) -> Optional[dict]:
           % (hier[2], hier[3],
              block["dcn_grad_sync_bytes"] / 1e3, flat_bytes / 1e3,
              hier[3], lanes["dcn"]["count"], lanes["ici"]["count"]),
+          flush=True)
+    return block
+
+
+def model_parallel_block(exe, program, feed, fetch_list) \
+        -> Optional[dict]:
+    """Tensor-parallel (model-axis) evidence: the TP plan's axis
+    assignment (which params shard, at which dim), the per-chip param
+    element reduction (∝ 1/mp for the sharded set), the structured
+    decline trail (kind="tp_declined" entries the planner recorded),
+    and the census's `mp` collective lane. None when no TP plan is
+    attached (mp=1 — the flat/hierarchical lowering, byte-for-byte)."""
+    import numpy as np
+
+    tpp = getattr(program, "_tp_plan", None)
+    if tpp is None:
+        return None
+    logical_elems = int(sum(int(np.prod(s)) for s in
+                            tpp.logical_shapes.values()))
+    local_elems = int(sum(int(np.prod(s)) for s in
+                          tpp.local_shapes.values()))
+    trail = getattr(program, "_sharded_update_fallback", None) or []
+    declined = [dict(e) for e in trail
+                if e.get("kind") == "tp_declined"]
+    block = {
+        "mp_degree": tpp.mp,
+        "model_axis": tpp.model_axis,
+        "sharded_params": {
+            n: {"tp_dim": p.tp_dim, "kind": p.kind,
+                "logical_shape": list(p.logical_shape),
+                "local_shape": list(p.local_shape)}
+            for n, p in sorted(tpp.params.items())},
+        "sharded_vars": len(tpp.var_dims),
+        "logical_param_elems": logical_elems,
+        "local_param_elems": local_elems,
+        "tp_declined": declined,
+    }
+    try:
+        col = exe.collective_report(program, feed=feed,
+                                    fetch_list=fetch_list)
+    except Exception as e:  # noqa: BLE001 - evidence, not gating
+        print("BENCH model_parallel census failed: %r" % (e,),
+              flush=True)
+        col = None
+    if col:
+        block["mp_bytes_total"] = int(col.get("mp_bytes_total", 0))
+        lanes = col.get("lanes") or {}
+        if "mp" in lanes:
+            block["mp_collectives"] = {
+                k: lanes["mp"][k]
+                for k in ("count", "tensor_bytes", "wire_bytes")}
+    reg = registry()
+    reg.set_gauge("model_parallel.mp_degree", tpp.mp)
+    reg.set_gauge("model_parallel.sharded_params", len(tpp.params))
+    reg.publish_block("model_parallel", block)
+    print("BENCH model_parallel: mp=%d sharded=%d declined=%d "
+          "param elems %d -> %d per chip, mp lane bytes=%s"
+          % (tpp.mp, len(tpp.params), len(declined), logical_elems,
+             local_elems, block.get("mp_bytes_total", "n/a")),
           flush=True)
     return block
 
@@ -561,6 +621,7 @@ def bench_blocks(exe, program, feed, fetch_list, group=None) -> dict:
     phases_block()
     collectives_blocks(exe, program, feed, fetch_list)
     hierarchy_block(exe, program, feed, fetch_list)
+    model_parallel_block(exe, program, feed, fetch_list)
     precision_block(exe, program, feed, fetch_list)
     embedding_block(exe, program, feed, fetch_list)
     attribution_block(exe, program, feed, fetch_list)

@@ -47,13 +47,14 @@ __all__ = ["MetricsRegistry", "registry", "reset_registry", "configure"]
 #: plus the cache-miss compile phase and the hybrid-mesh comm lanes)
 STEP_FIELDS = ("feed_ms", "dispatch_ms", "comm_ms", "sync_ms",
                "host_ms", "compile_ms", "comm_ici_ms", "comm_dcn_ms",
-               "total_ms")
+               "comm_mp_ms", "total_ms")
 
 #: optional fields that ride OUTSIDE the step total: compile happens
 #: off the steady state; the comm lanes are a BREAKDOWN of comm_ms
-#: (intra-pod vs cross-pod host coordination on a multi-pod launch),
-#: not an addition to it
-_AUX_FIELDS = frozenset({"compile_ms", "comm_ici_ms", "comm_dcn_ms"})
+#: (intra-pod vs cross-pod vs model-axis host coordination on a
+#: multi-pod / PADDLE_MP_DEGREE launch), not an addition to it
+_AUX_FIELDS = frozenset({"compile_ms", "comm_ici_ms", "comm_dcn_ms",
+                         "comm_mp_ms"})
 
 
 def _env_rank() -> int:

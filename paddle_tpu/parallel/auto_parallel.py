@@ -80,9 +80,16 @@ def _aval(x):
 
 def build_specs(feed_specs, state_specs, persistable, dp, tp,
                 dp_axis="dp", tp_axis="mp",
-                min_shard_bytes=_MIN_SHARD_BYTES):
+                min_shard_bytes=_MIN_SHARD_BYTES, tp_dims=None):
     """Per-var PartitionSpecs for one (dp, tp) candidate, or None when
-    the candidate cannot shard the feeds' batch axis evenly."""
+    the candidate cannot shard the feeds' batch axis evenly.
+
+    tp_dims: optional {name: dim} from the unified planner's axis rules
+    (parallel/planner.param_tp_dims) — when a var has an assigned dim it
+    is sharded THERE instead of the blanket last-axis heuristic, so the
+    GSPMD search and the shard_map TP engine agree on axis assignment.
+    The divisibility and min-size gates still apply either way.
+    """
     from jax.sharding import PartitionSpec as P
 
     feeds = {}
@@ -94,13 +101,19 @@ def build_specs(feed_specs, state_specs, persistable, dp, tp,
             feeds[n] = P(dp_axis)
         else:
             feeds[n] = P()
+    tp_dims = tp_dims or {}
     states = {}
     for n, v in state_specs.items():
         a = _aval(v)
         nbytes = math.prod(a.shape) * a.dtype.itemsize if a.ndim else 0
+        dim = tp_dims.get(n)
+        if dim is None or not (-a.ndim <= dim < a.ndim):
+            dim = a.ndim - 1
         if (tp > 1 and n in persistable and a.ndim >= 2
-                and a.shape[-1] % tp == 0 and nbytes >= min_shard_bytes):
-            states[n] = P(*([None] * (a.ndim - 1) + [tp_axis]))
+                and a.shape[dim] % tp == 0 and nbytes >= min_shard_bytes):
+            spec = [None] * a.ndim
+            spec[dim] = tp_axis
+            states[n] = P(*spec)
         else:
             states[n] = P()
     return feeds, states
@@ -133,13 +146,15 @@ def _score(compiled, mem_budget):
 
 def search_plan(fn, feed_specs, state_mut, state_ro, state_specs,
                 persistable, devices=None, configs=None, state_out=None,
-                donate=True):
+                donate=True, tp_dims=None):
     """Enumerate (dp, tp) candidates, AOT-compile each, score with XLA's
     memory/cost analyses, return the winning AutoPlan.
 
     fn: the block function (feeds, states_mut, states_ro, seed).
     state_specs: name -> array/aval for every state var.
     persistable: set of parameter-like names eligible for tp splitting.
+    tp_dims: optional {name: dim} axis assignments from the unified
+    planner (see build_specs) — overrides the last-axis heuristic.
     state_out/donate: passed so the scoring compile uses the SAME
     out_shardings/donation as the final `compile_with_plan` jit — with
     a jax compilation cache enabled, the winner's final compile is then
@@ -172,7 +187,7 @@ def search_plan(fn, feed_specs, state_mut, state_ro, state_specs,
     best = None
     for dp, tp in _factorizations(ndev)[:max_cand]:
         built = build_specs(feed_specs, state_specs, persistable, dp, tp,
-                            min_shard_bytes=min_shard)
+                            min_shard_bytes=min_shard, tp_dims=tp_dims)
         if built is None:
             report.append({"dp": dp, "tp": tp, "skip": "batch % dp != 0"})
             continue

@@ -1,0 +1,171 @@
+"""Logical-axis rules: named param axes resolved to mesh axes.
+
+The t5x/flaxformer idiom (SNIPPETS.md [1]/[2]): params carry *logical*
+axis names — ``('embed', 'mlp')`` for an FC weight, ``('vocab',
+'embed')`` for an embedding table — and a small rules table maps each
+logical name to a mesh axis (or None = replicate).  The trainer never
+hard-codes "shard dim 1 of fc weights over `model`"; it asks the rules.
+This file is that vocabulary for the fluid lowering:
+
+* :class:`AxisNames` — a tuple subclass jax pytree utilities treat as a
+  LEAF, so axis metadata can ride inside param pytrees untouched.
+* :data:`DEFAULT_RULES` — the Megatron-style column-parallel assignment
+  used by the TP planner (`parallel/tensor_parallel.py`): contraction
+  dims (``embed``, ``kv``) replicate, output dims (``mlp``, ``heads``,
+  ``joined_kv``, ``vocab``) shard over ``model``.
+* :func:`logical_to_mesh_axes` — resolve names → ``PartitionSpec``.
+* :func:`logical_axes_for_param` — infer the logical names of a fluid
+  param from the ops that consume it (matmul/fc weight, embedding
+  table), since fluid programs carry no flax-style metadata.
+* :func:`with_sharding_constraint` — the GSPMD-path helper: a trace-time
+  ``lax.with_sharding_constraint`` that degrades to identity on CPU or
+  outside a jit/mesh context, so the same lowering code runs everywhere.
+
+The manual shard_map TP engine and the GSPMD/auto_parallel path both
+resolve through this ONE table, which is what makes the planner the
+single owner of axis assignment.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+from . import env as penv
+
+__all__ = [
+    "AxisNames", "LogicalAxisRules", "DEFAULT_RULES", "default_rules",
+    "logical_to_mesh_axes", "mesh_dim_for", "logical_axes_for_param",
+    "with_sharding_constraint",
+]
+
+#: rules table type: sequence of (logical_axis_name, mesh_axis_or_None).
+LogicalAxisRules = Sequence[Tuple[str, Optional[str]]]
+
+
+class AxisNames(tuple):
+    """Tuple of logical axis names, one per tensor dim.
+
+    A separate class so jax pytree utilities can distinguish it from a
+    tuple that should be treated as a pytree, treating it as a leaf
+    (the t5x trick) — axis metadata survives tree_map untouched.
+    """
+
+    def __new__(cls, *names):
+        return tuple.__new__(cls, names)
+
+    def __repr__(self):
+        return "AxisNames%s" % (tuple.__repr__(self),)
+
+
+try:  # register as a pytree leaf (idempotent across reimports)
+    import jax
+
+    jax.tree_util.register_pytree_node(
+        AxisNames,
+        lambda x: ((), tuple(x)),
+        lambda names, _: AxisNames(*names))
+except (ImportError, ValueError):
+    pass
+
+#: the column-parallel assignment: every eligible weight shards its
+#: OUTPUT dim over `model` and keeps its contraction dim replicated, so
+#: each output element's dot product stays whole on one chip (forward
+#: bit-identical to single-device) and the only model-axis collective
+#: is the Megatron all-reduce assembling the output columns.  `vocab`
+#: shards so the embedding table splits rows (vocab-parallel lookup).
+DEFAULT_RULES: LogicalAxisRules = (
+    ("batch", None),        # data dims never shard over model
+    ("seq", None),
+    ("embed", None),        # contraction dim of fc/matmul — replicate
+    ("mlp", penv.MODEL_AXIS),        # ffn hidden (fc out dim)
+    ("heads", penv.MODEL_AXIS),      # attention heads (QKV out dim)
+    ("kv", None),           # per-head depth — rides with `heads`
+    ("joined_kv", penv.MODEL_AXIS),  # fused heads*kv projection dim
+    ("vocab", penv.MODEL_AXIS),      # embedding rows / logits dim
+)
+
+
+def default_rules() -> LogicalAxisRules:
+    """The active rules table (one hook for future per-program rules)."""
+    return DEFAULT_RULES
+
+
+def mesh_dim_for(logical_name, rules=None):
+    """Mesh axis assigned to one logical axis name, or None."""
+    for name, mesh_axis in (rules if rules is not None
+                            else default_rules()):
+        if name == logical_name:
+            return mesh_axis
+    return None
+
+
+def logical_to_mesh_axes(axis_names, rules=None):
+    """Resolve per-dim logical names to a ``PartitionSpec``.
+
+    A logical name missing from the rules (or mapped to None)
+    replicates that dim.  Raises if two dims resolve to the same mesh
+    axis — a spec like P('model', 'model') is never valid.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    if axis_names is None:
+        return P()
+    resolved = tuple(mesh_dim_for(n, rules) for n in axis_names)
+    used = [a for a in resolved if a is not None]
+    if len(used) != len(set(used)):
+        raise ValueError(
+            "logical axes %r resolve to a duplicate mesh axis via %r"
+            % (tuple(axis_names), tuple(rules or default_rules())))
+    return P(*resolved)
+
+
+# fluid programs carry no flax-style param metadata, so the planner
+# infers logical names from how an op consumes the param.  op_type ->
+# {input slot: AxisNames for a 2-D weight}.  The TP planner only
+# shards 2-D weights (and embedding tables); anything else declines.
+_CONSUMER_AXES = {
+    "mul": {"Y": AxisNames("embed", "mlp")},
+    "matmul": {"Y": AxisNames("embed", "mlp")},
+    "matmul_v2": {"Y": AxisNames("embed", "mlp")},
+    "lookup_table": {"W": AxisNames("vocab", "embed")},
+    "lookup_table_v2": {"W": AxisNames("vocab", "embed")},
+    "embedding": {"W": AxisNames("vocab", "embed")},
+}
+
+
+def logical_axes_for_param(op_type, slot, ndim=2):
+    """Logical axis names a param plays when `op_type` consumes it at
+    input `slot`, or None when that consumption has no TP rule."""
+    names = _CONSUMER_AXES.get(op_type, {}).get(slot)
+    if names is None or len(names) != ndim:
+        return None
+    return names
+
+
+def with_sharding_constraint(x, axis_names, rules=None, mesh=None):
+    """Trace-time GSPMD sharding hint, t5x-style: no-op on CPU, outside
+    a tracing context, or when no (global or passed) mesh carries the
+    resolved axes — so the fluid lowering can stamp constraints
+    unconditionally and stay correct on every backend.  Under the
+    manual shard_map path this is always a no-op (shard_map owns the
+    layout); it only bites on the auto_parallel/GSPMD jit path."""
+    import jax
+    import jax.core as jcore
+    from jax import lax
+    from jax.sharding import NamedSharding
+
+    if jax.devices()[0].platform == "cpu":
+        return x
+    if not isinstance(x, jcore.Tracer):
+        return x
+    mesh = mesh if mesh is not None else penv.global_mesh()
+    if mesh is None:
+        return x
+    spec = logical_to_mesh_axes(axis_names, rules)
+    mesh_names = set(getattr(mesh, "axis_names", ()) or ())
+    flat = []
+    for a in spec:
+        if a is not None:
+            flat.extend(a if isinstance(a, (tuple, list)) else (a,))
+    if not all(a in mesh_names for a in flat):
+        return x
+    return lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

@@ -15,10 +15,11 @@ import threading
 from typing import Dict, Optional
 
 __all__ = [
-    "DCN_AXIS", "ICI_AXIS", "set_global_mesh", "global_mesh",
-    "register_ring", "ring_info", "collective_scope", "active_axes",
-    "axis_size_compat", "shard_map_compat", "axis_name_for_ring",
-    "axis_size_for_ring", "dcn_replicas", "create_hybrid_mesh",
+    "DCN_AXIS", "ICI_AXIS", "MODEL_AXIS", "set_global_mesh",
+    "global_mesh", "register_ring", "ring_info", "collective_scope",
+    "active_axes", "axis_size_compat", "shard_map_compat",
+    "axis_name_for_ring", "axis_size_for_ring", "dcn_replicas",
+    "model_parallel_degree", "create_hybrid_mesh", "MeshHierarchy",
     "mesh_hierarchy", "trainer_id", "trainer_num",
     "trainer_endpoints", "current_endpoint",
 ]
@@ -112,6 +113,11 @@ def axis_name_for_ring(ring_id: int):
                 return next(iter(axes))
             if set(axes) == {DCN_AXIS, ICI_AXIS}:
                 return (DCN_AXIS, ICI_AXIS)
+            # tensor-parallel factorization: ring 0 is still the DATA
+            # world — the (dcn, replica) pair. The model axis never
+            # joins a dp ring (its collectives are the TP engine's).
+            if set(axes) == {DCN_AXIS, ICI_AXIS, MODEL_AXIS}:
+                return (DCN_AXIS, ICI_AXIS)
         return None
     name = info[0]
     if isinstance(name, (tuple, list)):
@@ -146,8 +152,13 @@ def axis_size_for_ring(ring_id: int) -> int:
 
 #: mesh axis names of the hybrid factorization; DCN_AXIS is the major
 #: (slow, cross-pod) axis, ICI_AXIS the minor (fast, intra-pod) one.
+#: With FLAGS_tpu_model_parallel > 1 the intra-pod tier factors once
+#: more into (replica, model): ICI_AXIS keeps its name but becomes the
+#: data-parallel REPLICA axis, and MODEL_AXIS is the new innermost
+#: (fastest-hop) axis tensor-parallel params shard over.
 DCN_AXIS = "dcn"
 ICI_AXIS = "ici"
+MODEL_AXIS = "model"
 
 
 def dcn_replicas(default=1) -> int:
@@ -170,15 +181,46 @@ def dcn_replicas(default=1) -> int:
         return default
 
 
-def create_hybrid_mesh(nranks=None, dcn=None, devices=None):
-    """A 2-D (dcn, ici) `jax.sharding.Mesh` over `nranks` devices, or
-    None when the factorization does not apply (dcn <= 1, or dcn does
-    not divide the world — the caller falls back to the flat 1-D mesh,
-    never a wrong mesh). On real multi-pod TPU the device order comes
-    from `mesh_utils.create_hybrid_device_mesh` (DCN-connectivity
-    aware); on CPU/emulation (and single-slice TPU) the devices
-    reshape row-major into (dcn, ici) — pod p owns the contiguous
-    device block [p*ici, (p+1)*ici)."""
+def model_parallel_degree(default=1) -> int:
+    """The requested tensor-parallel (model) degree:
+    `FLAGS_tpu_model_parallel` when set (> 0), else the
+    `PADDLE_MP_DEGREE` launch env (exported by `launch --mp_degree`),
+    else `default` (1 = no tensor parallelism — today's lowering,
+    byte-for-byte)."""
+    from ..utils.flags import get_flag
+
+    v = get_flag("FLAGS_tpu_model_parallel", 0)
+    try:
+        v = int(v or 0)
+    except (TypeError, ValueError):
+        v = 0
+    if v > 0:
+        return v
+    try:
+        return int(os.environ.get("PADDLE_MP_DEGREE", "") or default)
+    except ValueError:
+        return default
+
+
+def create_hybrid_mesh(nranks=None, dcn=None, mp=None, devices=None):
+    """The hybrid `jax.sharding.Mesh` over `nranks` devices, or None
+    when no factorization applies (the caller falls back to the flat
+    1-D mesh, never a wrong mesh).
+
+    Without tensor parallelism (mp <= 1): the 2-D (dcn, ici) mesh when
+    dcn > 1 divides the world, else None — byte-for-byte the
+    pre-model-parallel behavior. With `FLAGS_tpu_model_parallel` /
+    `PADDLE_MP_DEGREE` > 1: the intra-pod tier factors into
+    (replica, model), giving a 3-D (dcn, ici, model) mesh — `model` is
+    the INNERMOST axis, so on the row-major CPU/emulation layout a
+    model group is a contiguous device block riding the fastest ICI
+    hops (the Megatron/t5x placement). The dcn axis is kept even at
+    dcn == 1 so every consumer reads one mesh shape.
+
+    On real multi-pod TPU the device order comes from
+    `mesh_utils.create_hybrid_device_mesh` (DCN-connectivity aware);
+    on CPU/emulation (and single-slice TPU) the devices reshape
+    row-major — pod p owns the contiguous block [p*ici, (p+1)*ici)."""
     import warnings
 
     import jax
@@ -191,7 +233,33 @@ def create_hybrid_mesh(nranks=None, dcn=None, devices=None):
         devices = devices[:nranks]
     n = len(devices)
     dcn = int(dcn if dcn is not None else dcn_replicas())
-    if dcn <= 1 or n <= 1:
+    mp = int(mp if mp is not None else model_parallel_degree())
+    dcn = max(dcn, 1)
+    if n <= 1:
+        return None
+    if mp > 1:
+        if n % (dcn * mp) != 0:
+            warnings.warn(
+                "hybrid mesh: %d device(s) not divisible by "
+                "dcn=%d x mp=%d; falling back to the flat dp mesh"
+                % (n, dcn, mp))
+            return None
+        replica = n // (dcn * mp)
+        dev_arr = None
+        if devices[0].platform == "tpu":
+            try:
+                from jax.experimental import mesh_utils
+
+                dev_arr = mesh_utils.create_hybrid_device_mesh(
+                    (1, replica, mp), (dcn, 1, 1), devices=devices)
+            except Exception as e:  # noqa: BLE001 - single-slice
+                warnings.warn(
+                    "create_hybrid_device_mesh failed (%s); using "
+                    "row-major pod blocks" % (e,))
+        if dev_arr is None:
+            dev_arr = np.array(devices).reshape(dcn, replica, mp)
+        return Mesh(dev_arr, (DCN_AXIS, ICI_AXIS, MODEL_AXIS))
+    if dcn <= 1:
         return None
     if n % dcn != 0:
         warnings.warn(
@@ -216,10 +284,68 @@ def create_hybrid_mesh(nranks=None, dcn=None, devices=None):
     return Mesh(dev_arr, (DCN_AXIS, ICI_AXIS))
 
 
+class MeshHierarchy(tuple):
+    """The `mesh_hierarchy()` result: indexes like the legacy 4-tuple
+    `(dcn_axis, dp_axis, dcn_size, dp_size)` every existing consumer
+    unpacks, plus the tensor-parallel factorization as attributes —
+    `model_axis` (None when mp == 1) and `mp_size`. One predicate,
+    every layer."""
+
+    __slots__ = ()
+    model_axis = None
+    mp_size = 1
+
+    def __new__(cls, dcn_axis, dp_axis, dcn_size, dp_size,
+                model_axis=None, mp_size=1):
+        if model_axis is not None and int(mp_size) > 1:
+            cls = _MeshHierarchyTP
+        self = tuple.__new__(cls, (dcn_axis, dp_axis, int(dcn_size),
+                                   int(dp_size)))
+        if cls is _MeshHierarchyTP:
+            self._model_axis = model_axis
+            self._mp_size = int(mp_size)
+        return self
+
+    @property
+    def dcn_axis(self):
+        return self[0]
+
+    @property
+    def dp_axis(self):
+        return self[1]
+
+    @property
+    def dcn_size(self):
+        return self[2]
+
+    @property
+    def dp_size(self):
+        return self[3]
+
+
+class _MeshHierarchyTP(MeshHierarchy):
+    # no __slots__: variable-length tuple subtypes cannot carry slots,
+    # so the TP variant pays one instance dict for its two attributes.
+
+    @property
+    def model_axis(self):
+        return self._model_axis
+
+    @property
+    def mp_size(self):
+        return self._mp_size
+
+
 def mesh_hierarchy(mesh):
-    """(dcn_axis, ici_axis, dcn_size, ici_size) of a hybrid mesh, or
-    None for a flat (single-axis / non-hybrid) mesh. The one predicate
-    every layer uses to decide hierarchical vs flat lowering."""
+    """`MeshHierarchy` of a hybrid mesh — indexes like the legacy
+    `(dcn_axis, ici_axis, dcn_size, ici_size)` tuple, with
+    `.model_axis`/`.mp_size` carrying the tensor-parallel
+    factorization — or None for a flat (single-axis / non-hybrid)
+    mesh. The one predicate every layer uses to decide hierarchical vs
+    flat lowering: a mesh with a model axis is ALWAYS hierarchical
+    (even at dcn == 1 — the data axes still need naming), a 2-D
+    (dcn, ici) mesh only when dcn > 1 (byte-for-byte the pre-TP
+    contract)."""
     if mesh is None:
         return None
     names = tuple(getattr(mesh, "axis_names", ()) or ())
@@ -227,9 +353,13 @@ def mesh_hierarchy(mesh):
         return None
     dcn = int(mesh.shape[DCN_AXIS])
     ici = int(mesh.shape[ICI_AXIS])
+    if MODEL_AXIS in names and int(mesh.shape[MODEL_AXIS]) > 1:
+        return MeshHierarchy(DCN_AXIS, ICI_AXIS, dcn, ici,
+                             model_axis=MODEL_AXIS,
+                             mp_size=int(mesh.shape[MODEL_AXIS]))
     if dcn <= 1:
         return None
-    return (DCN_AXIS, ICI_AXIS, dcn, ici)
+    return MeshHierarchy(DCN_AXIS, ICI_AXIS, dcn, ici)
 
 
 def mesh_for_world(nranks, dcn=None, dp_axis="dp", devices=None):
@@ -251,6 +381,12 @@ def mesh_for_world(nranks, dcn=None, dp_axis="dp", devices=None):
     devs = list(devices[:nranks])
     if dcn is None:
         dcn = dcn_replicas()
+    dcn = max(int(dcn), 1)
+    mp = model_parallel_degree()
+    if mp > 1 and nranks % (dcn * mp) == 0 and nranks > 1:
+        return Mesh(
+            np.array(devs).reshape(dcn, nranks // (dcn * mp), mp),
+            (DCN_AXIS, ICI_AXIS, MODEL_AXIS))
     if dcn > 1 and nranks % dcn == 0:
         return Mesh(np.array(devs).reshape(dcn, nranks // dcn),
                     (DCN_AXIS, ICI_AXIS))
@@ -264,7 +400,10 @@ def elastic_mesh_variants(mesh=None, min_ranks=1, limit=4,
     1) variants (at most `limit`). Pod-aware, mirroring the launch
     supervisor's _pod_shrink policy: a hybrid (dcn, ici) base keeps
     dcn fixed and shrinks ici while N' stays rectangular (divisible by
-    dcn), else that N' falls back to the flat single-axis world.
+    dcn), else that N' falls back to the flat single-axis world. A
+    tensor-parallel (dcn, ici, model) base keeps BOTH dcn and the
+    model degree fixed — a TP group is indivisible — and shrinks the
+    replica axis while N' % (dcn * mp) == 0.
     Returns [(n, Mesh)]; `Executor.warmup(meshes="elastic")` (and the
     FLAGS_tpu_warmup_elastic_variants background hook) pre-compiles
     against these so a future shrink's recompile is already in the
@@ -288,7 +427,14 @@ def elastic_mesh_variants(mesh=None, min_ranks=1, limit=4,
         if len(out) >= int(limit):
             break
         devs = np.array(devices[:n2])
-        if hier is not None and n2 % hier[2] == 0:
+        if (hier is not None and hier.model_axis is not None
+                and n2 % (hier[2] * hier.mp_size) == 0 and n2 > 1):
+            mp = hier.mp_size
+            out.append((n2, Mesh(
+                devs.reshape(hier[2], n2 // (hier[2] * mp), mp),
+                (hier[0], hier[1], hier.model_axis))))
+        elif (hier is not None and hier[2] > 1
+                and n2 % hier[2] == 0):
             out.append((n2, Mesh(devs.reshape(hier[2], n2 // hier[2]),
                                  (hier[0], hier[1]))))
         else:

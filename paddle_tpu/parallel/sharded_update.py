@@ -156,23 +156,53 @@ _register_pytree()
 
 
 class ShardInfo:
-    """Static layout of one sharded state var."""
+    """Static layout of one sharded state var.
 
-    __slots__ = ("name", "shape", "dtype", "numel", "padded")
+    Tensor parallelism (`tp_dim` is not None, mp > 1): the var is ALSO
+    model-sharded, and every in-body quantity — `shape`, `numel`,
+    `padded` — describes one model member's LOCAL block (logical shape
+    with `tp_dim` divided by mp); `logical_shape` keeps the full shape
+    for the host-side save/restore paths. The ZeRO flat buffer then
+    lives at P((model, dp)): the global 1-D value is the model-major
+    concatenation of the mp per-member padded flats, and inside
+    shard_map each device sees the same (padded/ndev,) slice semantics
+    as the non-TP lowering — TP composes with ZeRO by construction
+    rather than by special cases."""
 
-    def __init__(self, name, shape, dtype, ndev):
+    __slots__ = ("name", "shape", "dtype", "numel", "padded",
+                 "tp_dim", "mp", "logical_shape")
+
+    def __init__(self, name, shape, dtype, ndev, tp_dim=None, mp=1):
         self.name = name
-        self.shape = tuple(int(d) for d in shape)
+        self.logical_shape = tuple(int(d) for d in shape)
+        self.mp = int(mp or 1)
+        self.tp_dim = tp_dim if self.mp > 1 else None
+        if self.tp_dim is not None:
+            local = list(self.logical_shape)
+            local[self.tp_dim] //= self.mp
+            self.shape = tuple(local)
+        else:
+            self.shape = self.logical_shape
         self.dtype = np.dtype(dtype)
         self.numel = int(np.prod(self.shape)) if self.shape else 1
         self.padded = -(-self.numel // ndev) * ndev  # ceil to N
 
     def unshard(self, value):
-        """Global (padded,) flat array -> logical-shape numpy array
-        (checkpoint/io save path)."""
+        """Global flat array -> logical-shape numpy array (checkpoint/io
+        save path). TP vars arrive as the (mp * padded,) model-major
+        concat; each member's segment is trimmed of its padding and the
+        local blocks concatenate back along `tp_dim`. Padding lengths
+        come from the VALUE (segment length = len/mp), not this plan's
+        `padded`, so an elastic restore can unshard the previous
+        world's buffer too."""
         arr = np.asarray(value)
-        if arr.shape == self.shape:
+        if arr.shape == self.logical_shape:
             return arr
+        if self.tp_dim is not None and arr.ndim == 1:
+            segs = arr.reshape(self.mp, -1)[:, :self.numel]
+            return np.concatenate(
+                [seg.reshape(self.shape) for seg in segs],
+                axis=self.tp_dim)
         return arr.reshape(-1)[:self.numel].reshape(self.shape)
 
 
@@ -239,7 +269,7 @@ def bucket_cap_bytes() -> int:
 
 
 def plan_buckets(opt_ops, block, ndev, grad_topo, cap_bytes,
-                 out_alias=None):
+                 out_alias=None, tp_local=None):
     """Partition optimizer-bound grads into size-bounded buckets ordered
     by BACKWARD production order: a gradient whose parameter is used
     LATER in the forward materializes EARLIER in the vjp sweep, so
@@ -255,8 +285,14 @@ def plan_buckets(opt_ops, block, ndev, grad_topo, cap_bytes,
     The optimizer op's Param/ParamOut slots name the fp32 MASTER then,
     but the gradient arrives (and scatters) at the LIVE param's 16-bit
     dtype and the deferrable all-gather output is the live param — so
-    shape/dtype/param_out resolve through the alias."""
+    shape/dtype/param_out resolve through the alias.
+
+    `tp_local` (tensor parallelism): {var name: local shape} for
+    model-sharded params — their gradients materialize at the LOCAL
+    block shape inside shard_map, so bucket slots are sized from it,
+    not the block's logical shape."""
     alias = out_alias or {}
+    tp_local = tp_local or {}
     entries = []
     seen = set()
     for seq, op in enumerate(opt_ops):
@@ -271,7 +307,8 @@ def plan_buckets(opt_ops, block, ndev, grad_topo, cap_bytes,
             po = pouts[i] if i < len(pouts) else p
             live = alias.get(p, p)
             v = block._find_var_recursive(live)
-            shape = tuple(getattr(v, "shape", ()) or ())
+            shape = tp_local.get(
+                live, tuple(getattr(v, "shape", ()) or ()))
             dtype = str(getattr(v, "dtype", "float32"))
             entries.append(BucketEntry(
                 g, p, alias.get(po, po), shape, dtype, ndev,
@@ -301,12 +338,14 @@ class ShardedUpdatePlan:
                  "sharded_state", "explicit_sync", "opt_op_ids",
                  "buckets", "bucket_of", "defer_gather",
                  "gradient_merge", "bucket_cap", "master_of",
-                 "dcn_axis", "dcn_size")
+                 "dcn_axis", "dcn_size", "mp_axis", "mp_size",
+                 "tp_local")
 
     def __init__(self, axis, ndev, grad_names, rs_targets, sharded_state,
                  explicit_sync, opt_op_ids, buckets=(), defer_gather=(),
                  gradient_merge=False, bucket_cap=0, master_of=None,
-                 dcn_axis=None, dcn_size=1):
+                 dcn_axis=None, dcn_size=1, mp_axis=None, mp_size=1,
+                 tp_local=None):
         # `axis`/`ndev` are the SHARD axis and granularity: the whole
         # dp world for a flat mesh, the intra-pod ici axis/size for a
         # hybrid (dcn, ici) mesh — shards stay laid out within the pod
@@ -345,11 +384,21 @@ class ShardedUpdatePlan:
         # {live_param_name: master_var_name} (masters also appear in
         # sharded_state with their fp32 ShardInfo)
         self.master_of: Dict[str, str] = dict(master_of or {})
+        # tensor parallelism (mp_size > 1): the model axis the TP
+        # engine's collectives run on, and {var: LOCAL shape} for every
+        # model-sharded var crossing this plan (live params, masters) —
+        # the shape the shard-space interpreter sees inside shard_map.
+        # The ZeRO shard axis stays `axis` (replica): TP and ZeRO shard
+        # ORTHOGONAL mesh axes and never collide.
+        self.mp_axis = mp_axis
+        self.mp_size = int(mp_size or 1)
+        self.tp_local: Dict[str, tuple] = dict(tp_local or {})
 
     @property
     def world(self) -> int:
         """Total data-parallel replica count: the /N of a pmean-style
-        sync divides by THIS (ndev * dcn_size), not the shard count."""
+        sync divides by THIS (ndev * dcn_size), not the shard count —
+        and never by mp (model members hold the SAME batch)."""
         return self.ndev * self.dcn_size
 
 
@@ -399,7 +448,8 @@ def _record_fallback(program, reason, var=None, op_type=None,
 
 
 def plan_sharded_update(program, block, ndev, dp_axis, dcn_axis=None,
-                        dcn_size=1) -> Optional[ShardedUpdatePlan]:
+                        dcn_size=1, tp_plan=None,
+                        sparse_plan=None) -> Optional[ShardedUpdatePlan]:
     """Feasibility scan over the post-backward section. Returns a plan,
     or None when the program must keep the replicated update (not
     data-parallel / flag off / an unsupported op touches an
@@ -414,10 +464,26 @@ def plan_sharded_update(program, block, ndev, dp_axis, dcn_axis=None,
     steps, like the moments), their only reader outside the owning
     optimizer op — the trailing ``__amp_param_cast__`` op — runs in
     shard space, and the resulting 16-bit live-param shard is what the
-    (deferred, per-bucket) all-gather carries."""
+    (deferred, per-bucket) all-gather carries.
+
+    `tp_plan` (parallel/tensor_parallel.py, the unified planner): for
+    model-sharded params, grads/moments/masters materialize at their
+    LOCAL block shapes inside shard_map — every ShardInfo and bucket
+    slot here is sized from the TP plan's var_dims, so ZeRO's flat
+    buffers shard the replica axis of exactly the bytes each model
+    member owns (per-chip optimizer state ∝ 1/(mp · ndev))."""
     from ..fluid import lowering
 
-    program._sharded_update_fallback = []
+    tp_dims = tp_plan.var_dims if tp_plan is not None else {}
+    tp_mp = tp_plan.mp if tp_plan is not None else 1
+
+    # reset the fallback trail but keep the TP planner's structured
+    # declines: the unified planner (parallel/planner.py) runs tensor
+    # parallel BEFORE ZeRO in the same compile, and --sharded-diff must
+    # surface both engines' reasons
+    program._sharded_update_fallback = [
+        e for e in (getattr(program, "_sharded_update_fallback", None)
+                    or []) if str(e.get("kind", "")).startswith("tp_")]
     if not enabled() or ndev <= 1:
         return None
     ops = list(block.ops)
@@ -436,7 +502,8 @@ def plan_sharded_update(program, block, ndev, dp_axis, dcn_axis=None,
     # tables, paddle_tpu/embedding): their row-sparse update runs in
     # table-shard space with its own plan — this planner neither claims
     # their grads/moments nor declines the program over them
-    _sparse_plan = getattr(program, "_sparse_plan", None)
+    _sparse_plan = sparse_plan if sparse_plan is not None \
+        else getattr(program, "_sparse_plan", None)
     sparse_opt_ids = frozenset(_sparse_plan.opt_op_ids) \
         if _sparse_plan is not None else frozenset()
 
@@ -537,7 +604,8 @@ def plan_sharded_update(program, block, ndev, dp_axis, dcn_axis=None,
             return
         owner[n] = op
         dtype = str(getattr(v, "dtype", "float32"))
-        sharded_state[n] = ShardInfo(n, shape, dtype, ndev)
+        sharded_state[n] = ShardInfo(n, shape, dtype, ndev,
+                                     tp_dim=tp_dims.get(n), mp=tp_mp)
 
     for op in opt_ops:
         for slot in _OPT_STATE_SLOTS.get(op.type, ()):
@@ -631,9 +699,12 @@ def plan_sharded_update(program, block, ndev, dp_axis, dcn_axis=None,
         cap = 0
     buckets = ()
     if cap > 0:
-        buckets = plan_buckets(opt_ops, block, ndev,
-                               bop.attrs.get("grad_topo", {}) or {}, cap,
-                               out_alias=out_alias)
+        buckets = plan_buckets(
+            opt_ops, block, ndev,
+            bop.attrs.get("grad_topo", {}) or {}, cap,
+            out_alias=out_alias,
+            tp_local=(tp_plan.local_shapes if tp_plan is not None
+                      else None))
     # params whose all-gather can defer to the end of the post section
     # (emitted per-bucket): nothing after the owning optimizer op (or,
     # for AMP masters, the master's live-param cast) reads them, so the
@@ -666,7 +737,11 @@ def plan_sharded_update(program, block, ndev, dp_axis, dcn_axis=None,
         explicit_sync=explicit, opt_op_ids=opt_ids,
         buckets=buckets, defer_gather=defer,
         gradient_merge=gradient_merge, bucket_cap=cap,
-        master_of=master_of, dcn_axis=dcn_axis, dcn_size=dcn_size)
+        master_of=master_of, dcn_axis=dcn_axis, dcn_size=dcn_size,
+        mp_axis=(tp_plan.model_axis if tp_plan is not None else None),
+        mp_size=tp_mp,
+        tp_local=(tp_plan.local_shapes if tp_plan is not None
+                  else None))
 
 
 def _cpu_backend() -> bool:
@@ -982,7 +1057,9 @@ def _exec_optimizer_op(op, env, plan, block):
                 env[n] = ShardVal(v, plan.sharded_state[n].shape)
                 continue
             var = block._find_var_recursive(n)
-            shape = tuple(getattr(var, "shape", ()) or ())
+            # a model-sharded param's in-body shape is its LOCAL block
+            shape = plan.tp_local.get(
+                n, tuple(getattr(var, "shape", ()) or ()))
             if n in plan.defer_gather:
                 # deferred: stays a shard until the end of the post
                 # section, where bucketed_gather_deferred emits ONE
@@ -1194,11 +1271,34 @@ def to_sharded_global(value, info: ShardInfo, mesh, axis):
     elements) before re-padding for the new mesh, so the
     moments/masters land bit-identical on N' devices. A
     MULTI-dimensional oversized value is a genuine plan/value mismatch
-    and still fails loudly in np.pad below."""
+    and still fails loudly in np.pad below.
+
+    Tensor parallelism (info.tp_dim set): the logical value splits into
+    mp local blocks along tp_dim; each flattens and zero-pads
+    independently and the model-major concat lands at
+    P((model, axis)) — every device holds the 1/ndev ZeRO slice of ITS
+    model member's local flat, so restoring a checkpoint re-plans the
+    layout for whatever (replica, model) factorization is live
+    (save-logical / restore-sharded)."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     arr = np.asarray(value)
+    if info.tp_dim is not None:
+        if arr.ndim == 1:
+            # previous world's TP flat buffer: per-member segments,
+            # each trimmed of old padding (segment len = len/mp)
+            blocks = [seg[:info.numel]
+                      for seg in arr.reshape(info.mp, -1)]
+        else:
+            blocks = [b.reshape(-1) for b in
+                      np.split(arr, info.mp, axis=info.tp_dim)]
+        flat = np.concatenate([
+            np.pad(b, (0, info.padded - b.shape[0])) for b in blocks])
+        from . import env as penv
+
+        return jax.device_put(
+            flat, NamedSharding(mesh, P((penv.MODEL_AXIS, axis))))
     flat = arr.reshape(-1)
     if arr.ndim == 1 and flat.shape[0] > info.numel:
         flat = flat[:info.numel]  # strip the old world's padding

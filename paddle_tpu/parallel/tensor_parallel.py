@@ -1,0 +1,591 @@
+"""Tensor (model) parallelism: the trace engine for the `model` axis.
+
+With `FLAGS_tpu_model_parallel` / `PADDLE_MP_DEGREE` > 1 the hybrid
+mesh factors its intra-pod tier into (replica, model)
+(`parallel/env.create_hybrid_mesh`), and this module owns everything
+that touches the new innermost axis:
+
+* :func:`plan_tensor_parallel` — the feasibility scan.  Eligible params
+  are found by resolving each op's weight-slot consumption through the
+  logical-axis rules (`parallel/axis_rules.py`, the t5x idiom): fc /
+  matmul weights carry ``('embed', 'mlp')`` and shard their OUT dim
+  (column-parallel, the Megatron layout), embedding tables carry
+  ``('vocab', 'embed')`` and shard their row dim (vocab-parallel).  A
+  param the planner cannot shard — non-divisible sharded dim, a
+  transposed or >2-D weight, an op without a TP rule consuming it, a
+  norm computed over it — is DECLINED with a structured reason on
+  ``program._sharded_update_fallback`` (kind="tp_declined", surfaced by
+  ``tools/perf_analysis.py --sharded-diff``) and stays replicated;
+  the rest of the program still shards.
+
+* :func:`maybe_compute` — the per-op trace hook
+  (`fluid/lowering._exec_op_stamped`, mirroring the sparse-embedding
+  engine's contextvar routing).  Inside shard_map a TP'd weight arrives
+  as its LOCAL block; the hook computes the local partial product and
+  assembles the full activation with an explicit model-axis collective.
+  Two Megatron operators, written as custom_vjps so the backward is
+  exact by construction (no reliance on jax's psum transpose under
+  ``check_vma=False``):
+
+    - ``_copy_to_model`` (Megatron "f"): identity forward, psum over
+      `model` backward — the activation's cotangent sums the per-member
+      partials, so dX is exact while dW stays the local shard.
+    - ``_assemble_cols`` / the vocab-parallel lookup's psum (Megatron
+      "g"): collective forward, slice/identity backward — every
+      member's downstream cotangent is replicated (all post-TP compute
+      is), so no second reduction is owed.
+
+  Forward numerics: column-parallel keeps each output element's whole
+  contraction on one chip, so the assembled activations are
+  BIT-IDENTICAL to the single-device reference; only dX's psum
+  reassociates the backward sum (see parallel/README.md "Tensor
+  parallelism" for the documented ulp contract).
+
+Gradient sync stays on the (dcn, replica) data axes untouched: model
+members hold DISTINCT shards whose grads must not be averaged over
+`model`, and devices that agree on the model coordinate hold the SAME
+shard — exactly the (dcn, ici) pmean/reduce-scatter group the DP
+lowering already uses.
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import logging
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from . import axis_rules
+from . import env as penv
+from . import sharded_update as _su
+
+__all__ = [
+    "TPParam", "TensorParallelPlan", "plan_tensor_parallel",
+    "active_plan", "current_plan", "maybe_compute",
+]
+
+_log = logging.getLogger("paddle_tpu.tensor_parallel")
+
+# op types whose weight-slot consumption the engine can execute in
+# model-shard space (must stay in sync with the handlers below and the
+# axis_rules consumer table)
+_MATMUL_OPS = frozenset({"mul", "matmul", "matmul_v2"})
+_LOOKUP_OPS = frozenset({"lookup_table", "lookup_table_v2", "embedding"})
+
+# norm-computing post-backward vocabulary: a global norm over a
+# model-sharded param/grad would need a model-axis psum the shard-space
+# interpreter doesn't emit — decline the param instead of mis-scaling
+_NORM_READERS = frozenset({"squared_l2_norm", "clip_by_norm",
+                           "clip_by_global_norm"})
+# optimizers whose update mixes a full-tensor norm into every element
+# (trust ratio): their psum runs over the ZeRO dp axis only, so a TP'd
+# param would fold partial norms — decline
+_NORM_OPTS = frozenset({"lamb", "lars_momentum"})
+
+
+class TPParam:
+    """Static layout of one model-sharded param."""
+
+    __slots__ = ("name", "tp_dim", "logical_shape", "local_shape",
+                 "axis_names", "kind")
+
+    def __init__(self, name, tp_dim, logical_shape, mp, axis_names,
+                 kind):
+        self.name = name
+        self.tp_dim = int(tp_dim)
+        self.logical_shape = tuple(int(d) for d in logical_shape)
+        ls = list(self.logical_shape)
+        ls[self.tp_dim] //= int(mp)
+        self.local_shape = tuple(ls)
+        self.axis_names = axis_names
+        self.kind = kind  # "matmul" | "lookup"
+
+    def __repr__(self):
+        return "TPParam(%s dim=%d %s->%s)" % (
+            self.name, self.tp_dim, self.logical_shape,
+            self.local_shape)
+
+
+class TensorParallelPlan:
+    """The model-axis assignment for one program: which scope vars are
+    model-sharded, at which dim, and how their consuming ops lower."""
+
+    __slots__ = ("model_axis", "mp", "params", "var_dims",
+                 "logical_shapes", "local_shapes", "weight_of")
+
+    def __init__(self, model_axis, mp, params, var_dims,
+                 logical_shapes, weight_of):
+        self.model_axis = model_axis
+        self.mp = int(mp)
+        self.params: Dict[str, TPParam] = dict(params)
+        # EVERY model-sharded scope var (params + AMP fp32 masters +
+        # optimizer moments) -> its sharded dim. The one vocabulary the
+        # ZeRO planner, _compile_dp's specs, the checkpoint layer and
+        # tpu-lint's taint walk read.
+        self.var_dims: Dict[str, int] = dict(var_dims)
+        self.logical_shapes: Dict[str, Tuple[int, ...]] = \
+            dict(logical_shapes)
+        self.local_shapes: Dict[str, Tuple[int, ...]] = {}
+        for n, d in self.var_dims.items():
+            ls = list(self.logical_shapes[n])
+            ls[d] //= self.mp
+            self.local_shapes[n] = tuple(ls)
+        # op id -> weight var name it consumes (trace-time routing)
+        self.weight_of: Dict[int, str] = dict(weight_of)
+
+    def spec_for(self, name):
+        """PartitionSpec of one model-sharded scope var (model at its
+        tp_dim, every other dim replicated — the dp/ZeRO layout of
+        sharded state rides the flat-vec path instead)."""
+        from jax.sharding import PartitionSpec as P
+
+        d = self.var_dims[name]
+        axes = [None] * len(self.logical_shapes[name])
+        axes[d] = self.model_axis
+        return P(*axes)
+
+    def describe(self) -> str:
+        return "TensorParallelPlan(mp=%d, params=%s)" % (
+            self.mp, sorted(self.params))
+
+
+# ---------------------------------------------------------------------------
+# planning
+# ---------------------------------------------------------------------------
+
+def _decline(program, reason, var=None, op_type=None):
+    _su._record_fallback(program, reason, var=var, op_type=op_type,
+                         kind="tp_declined")
+
+
+def plan_tensor_parallel(program, block, mp, model_axis,
+                         feed_names=(), fetch_names=(),
+                         sparse_plan=None) -> \
+        Optional[TensorParallelPlan]:
+    """Feasibility scan: resolve every weight-slot consumption through
+    the axis rules, keep the params every consumer agrees to shard and
+    whose sharded dim divides by `mp`, decline the rest with structured
+    reasons. Returns None (flat/DP lowering, byte-for-byte) when mp <= 1
+    or no param shards."""
+    from ..fluid import framework, lowering
+
+    if mp is None or int(mp) <= 1:
+        return None
+    mp = int(mp)
+    feed_names = set(feed_names)
+    fetch_names = set(fetch_names)
+    sparse_vars = set()
+    if sparse_plan is not None:
+        sparse_vars = set(sparse_plan.state_vars) | \
+            set(getattr(sparse_plan, "tables", ()) or ())
+
+    ops = list(block.ops)
+    bwd_idx = next((i for i, op in enumerate(ops)
+                    if op.type == "backward"), None)
+    fwd_ops = ops if bwd_idx is None else ops[:bwd_idx]
+    post = [] if bwd_idx is None else ops[bwd_idx + 1:]
+
+    # -- candidate discovery: rules-table weight slots in the forward --
+    cand: Dict[str, dict] = {}
+    declined = set()
+
+    def _drop(n, reason, op_type=None):
+        if n not in declined:
+            _decline(program, reason, var=n, op_type=op_type)
+        declined.add(n)
+        cand.pop(n, None)
+
+    for op in fwd_ops:
+        t = op.type
+        if t not in _MATMUL_OPS and t not in _LOOKUP_OPS:
+            continue
+        slot = "Y" if t in _MATMUL_OPS else "W"
+        names = op.input_names.get(slot, [])
+        if len(names) != 1:
+            continue
+        n = names[0]
+        if n in declined or n in sparse_vars or n in feed_names:
+            continue
+        v = block._find_var_recursive(n)
+        if v is None or not getattr(v, "persistable", False):
+            continue
+        shape = tuple(int(d) for d in (getattr(v, "shape", ()) or ()))
+        names_for = axis_rules.logical_axes_for_param(t, slot,
+                                                      len(shape))
+        if names_for is None:
+            _drop(n, "weight is not 2-D — no TP rule for its rank",
+                  op_type=t)
+            continue
+        if t in _MATMUL_OPS:
+            if op.attrs.get("transpose_Y", False) or \
+                    op.attrs.get("trans_y", False):
+                _drop(n, "transposed weight consumption has no "
+                      "column-parallel lowering", op_type=t)
+                continue
+            if t == "mul" and op.attrs.get("y_num_col_dims", 1) != 1:
+                _drop(n, "mul with y_num_col_dims != 1 folds the "
+                      "would-be-sharded dim into the contraction",
+                      op_type=t)
+                continue
+            kind = "matmul"
+        else:
+            kind = "lookup"
+        # dim whose logical name resolves to the model axis
+        tp_dim = next(
+            (i for i, a in enumerate(names_for)
+             if axis_rules.mesh_dim_for(a) == model_axis), None)
+        if tp_dim is None:
+            continue  # rules replicate this consumption
+        if shape[tp_dim] % mp != 0:
+            _drop(n, "sharded dim %d (%d) is not divisible by mp=%d "
+                  "(uneven heads/hidden)" % (tp_dim, shape[tp_dim], mp),
+                  op_type=t)
+            continue
+        ent = cand.get(n)
+        if ent is not None:
+            if ent["tp_dim"] != tp_dim or ent["kind"] != kind:
+                _drop(n, "mixed consumption: two ops demand different "
+                      "shard layouts", op_type=t)
+            continue
+        cand[n] = {"tp_dim": tp_dim, "shape": shape, "kind": kind,
+                   "axis_names": names_for}
+
+    if not cand:
+        return None
+
+    # -- consumption audit: every other touch must be TP-compatible --
+    amp_masters = dict(getattr(program, "_amp_master_of", None) or {})
+    master_of = {p: m for p, m in amp_masters.items()}  # param->master
+    param_of_master = {m: p for p, m in amp_masters.items()}
+    grad_of = {framework.grad_var_name(n): n for n in cand}
+
+    def _tp_names_touched(op):
+        reads, writes = lowering._op_reads_writes(op)
+        touched = set()
+        for n in set(reads) | set(writes):
+            if n in cand:
+                touched.add(n)
+            elif n in grad_of:
+                touched.add(grad_of[n])
+            elif n in param_of_master and param_of_master[n] in cand:
+                touched.add(param_of_master[n])
+        return touched
+
+    for op in fwd_ops:
+        t = op.type
+        for n in list(_tp_names_touched(op)):
+            if n not in cand:
+                continue
+            if t in _MATMUL_OPS and \
+                    op.input_names.get("Y", [None])[0] == n:
+                continue
+            if t in _LOOKUP_OPS and \
+                    op.input_names.get("W", [None])[0] == n:
+                continue
+            _drop(n, "op without a TP rule consumes the model-sharded "
+                  "param", op_type=t)
+    for op in post:
+        t = op.type
+        touched = _tp_names_touched(op)
+        if not touched:
+            continue
+        if "ParamOut" in op.output_names:  # an optimizer update
+            if t in _NORM_OPTS:
+                for n in list(touched):
+                    _drop(n, "optimizer %r folds a full-tensor norm "
+                          "into a model-sharded update" % t, op_type=t)
+            continue
+        if t in _NORM_READERS:
+            for n in list(touched):
+                _drop(n, "global norm over a model-sharded tensor "
+                      "(grad clip) is not model-aware", op_type=t)
+            continue
+        if t == "cast" and op.attrs.get("__amp_param_cast__"):
+            continue  # master -> live cast is elementwise
+        if t in _su._EW_UNARY or t in _su._EW_BINARY or t == "sum":
+            continue  # elementwise regularizer/decay arithmetic
+        if t.startswith("c_allreduce") or t == "allreduce":
+            for n in list(touched):
+                _drop(n, "explicit-sync collective on a model-sharded "
+                      "gradient", op_type=t)
+            continue
+        for n in list(touched):
+            _drop(n, "post-backward op without a shard-space rule "
+                  "touches the model-sharded param", op_type=t)
+
+    for n in list(cand):
+        if n in fetch_names:
+            _drop(n, "param fetched directly (fetch specs are "
+                  "replicated)")
+
+    if not cand:
+        return None
+
+    params = {n: TPParam(n, e["tp_dim"], e["shape"], mp,
+                         e["axis_names"], e["kind"])
+              for n, e in cand.items()}
+
+    # -- the axis-assignment vocabulary: params + masters + moments --
+    var_dims: Dict[str, int] = {n: p.tp_dim for n, p in params.items()}
+    logical_shapes = {n: p.logical_shape for n, p in params.items()}
+    for p, m in master_of.items():
+        if p in params:
+            var_dims[m] = params[p].tp_dim
+            logical_shapes[m] = params[p].logical_shape
+    for op in post:
+        pslot = op.input_names.get("Param", [])
+        if not pslot or "ParamOut" not in op.output_names:
+            continue
+        pname = pslot[0]
+        live = param_of_master.get(pname, pname)
+        if live not in params:
+            continue
+        tp_dim = params[live].tp_dim
+        for slot in _su._OPT_STATE_SLOTS.get(op.type, ()):
+            for sn in op.input_names.get(slot, []):
+                sv = block._find_var_recursive(sn)
+                sshape = tuple(int(d) for d in
+                               (getattr(sv, "shape", ()) or ()))
+                if sshape == params[live].logical_shape:
+                    var_dims[sn] = tp_dim
+                    logical_shapes[sn] = sshape
+
+    weight_of = {}
+    for op in fwd_ops:
+        t = op.type
+        if t in _MATMUL_OPS:
+            n = op.input_names.get("Y", [None])[0]
+        elif t in _LOOKUP_OPS:
+            n = op.input_names.get("W", [None])[0]
+        else:
+            continue
+        if n in params:
+            weight_of[id(op)] = n
+
+    plan = TensorParallelPlan(model_axis, mp, params, var_dims,
+                              logical_shapes, weight_of)
+    _log.info("tensor parallel: %s", plan.describe())
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# trace-time execution (inside shard_map)
+# ---------------------------------------------------------------------------
+
+_ACTIVE: contextvars.ContextVar = contextvars.ContextVar(
+    "paddle_tpu_tp_plan", default=None)
+
+
+@contextlib.contextmanager
+def active_plan(plan):
+    """Install `plan` for the current trace (contextvar, safe under
+    concurrent background-warmup traces)."""
+    token = _ACTIVE.set(plan)
+    try:
+        yield
+    finally:
+        _ACTIVE.reset(token)
+
+
+def current_plan() -> Optional[TensorParallelPlan]:
+    return _ACTIVE.get()
+
+
+def _model_axis_live(plan):
+    axes = penv.active_axes() or {}
+    return axes.get(plan.model_axis, 1) > 1
+
+
+def _marker(kind, name):
+    from ..observability import attribution as _attr
+
+    mk = getattr(_attr, "marker_scope", None)
+    if mk is None:
+        return contextlib.nullcontext()
+    return _attr.marker_scope("tp/%s/%s" % (kind, name))
+
+
+# -- Megatron operator f: identity forward, psum(model) backward ------------
+
+def _make_copy_to_model(axis_name):
+    import functools
+
+    import jax
+    from jax import lax
+
+    @functools.partial(jax.custom_vjp)
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, ct):
+        return (lax.psum(ct, axis_name),)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+# -- Megatron operator g: assemble output columns, slice backward -----------
+
+def _make_assemble_cols(axis_name, mp):
+    """local (..., n/mp) -> full (..., n): all_gather over `model` with
+    the shards concatenated along the last dim. Backward slices the
+    (replicated) cotangent back to this member's columns — exact, with
+    no dependence on jax's collective transpose rules."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def _gather(local):
+        ag = lax.all_gather(local, axis_name)  # (mp, ..., n/mp)
+        full = jnp.moveaxis(ag, 0, -2)         # (..., mp, n/mp)
+        return jnp.reshape(
+            full, full.shape[:-2] + (mp * local.shape[-1],))
+
+    @functools.partial(jax.custom_vjp)
+    def g(local):
+        return _gather(local)
+
+    def fwd(local):
+        return _gather(local), local.shape[-1]
+
+    def bwd(n_local, ct):
+        idx = lax.axis_index(axis_name)
+        start = [0] * ct.ndim
+        start[-1] = idx * n_local
+        sizes = list(ct.shape)
+        sizes[-1] = n_local
+        return (lax.dynamic_slice(ct, tuple(start), tuple(sizes)),)
+
+    g.defvjp(fwd, bwd)
+    return g
+
+
+# -- vocab-parallel embedding lookup ----------------------------------------
+
+def _make_vocab_lookup(axis_name, padding_idx, wshape, wdtype,
+                       ids_shape):
+    """(w_local (v/mp, d), ids) -> full (..., d): masked local lookup,
+    psum'd over `model` (rows are disjoint, so the sum IS the
+    scatter). Backward scatter-adds the (replicated) cotangent into
+    this member's rows only — the exact local shard gradient. The
+    local weight/ids shapes and the weight dtype are trace-time
+    statics (custom_vjp residuals must be jax types), so they ride in
+    the closure, not the residual tuple."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    vloc = int(wshape[0])
+
+    def _masked(ids):
+        offset = lax.axis_index(axis_name) * vloc
+        lids = ids.astype(jnp.int32) - offset
+        mask = (lids >= 0) & (lids < vloc)
+        if padding_idx is not None and padding_idx >= 0:
+            mask = mask & (ids != padding_idx)
+        safe = jnp.clip(lids, 0, vloc - 1)
+        return safe, mask
+
+    @functools.partial(jax.custom_vjp)
+    def lookup(w, ids):
+        safe, mask = _masked(ids)
+        local = jnp.take(w, safe, axis=0) * \
+            mask[..., None].astype(w.dtype)
+        return lax.psum(local, axis_name)
+
+    def fwd(w, ids):
+        safe, mask = _masked(ids)
+        local = jnp.take(w, safe, axis=0) * \
+            mask[..., None].astype(w.dtype)
+        return lax.psum(local, axis_name), (safe, mask)
+
+    def bwd(res, ct):
+        safe, mask = res
+        ctm = ct.astype(wdtype) * mask[..., None].astype(wdtype)
+        dw = jnp.zeros(wshape, wdtype).at[safe].add(ctm)
+        dids = np.zeros(ids_shape, dtype=jax.dtypes.float0)
+        return (dw, dids)
+
+    lookup.defvjp(fwd, bwd)
+    return lookup
+
+
+# ---------------------------------------------------------------------------
+# per-op handlers
+# ---------------------------------------------------------------------------
+
+def maybe_compute(op, ins, attrs):
+    """Trace hook for `lowering._exec_op_stamped`: when an active plan
+    owns `op`'s weight and the model axis is live, compute the op in
+    model-shard space and return its outs dict; None otherwise (the
+    normal interpreter runs — including outside shard_map, where the
+    scope still holds logical full params)."""
+    plan = current_plan()
+    if plan is None:
+        return None
+    name = plan.weight_of.get(id(op))
+    if name is None:
+        return None
+    if not _model_axis_live(plan):
+        return None
+    tp = plan.params[name]
+    if tp.kind == "lookup":
+        return _tp_lookup(plan, tp, op, ins, attrs)
+    return _tp_matmul(plan, tp, op, ins, attrs)
+
+
+def _tp_matmul(plan, tp, op, ins, attrs):
+    """Column-parallel fc/matmul: X replicated, Y's OUT dim sharded.
+    out_local = X @ Y_local keeps each output element's contraction
+    whole; `_assemble_cols` concatenates the members' column blocks —
+    the Megatron tensor-parallel exchange on the `model` axis."""
+    import jax.numpy as jnp
+
+    x, w = ins["X"][0], ins["Y"][0]
+    t = op.type
+    with _marker("matmul", tp.name):
+        x = _make_copy_to_model(plan.model_axis)(x)
+        if t == "mul":
+            xn = attrs.get("x_num_col_dims", 1)
+            x2 = x.reshape((int(np.prod(x.shape[:xn])), -1))
+            out_local = x2 @ w
+            out_local = out_local.reshape(
+                tuple(x.shape[:xn]) + (w.shape[-1],))
+        else:
+            if t == "matmul":
+                if attrs.get("transpose_X", False):
+                    x = jnp.swapaxes(x, -1, -2)
+                if x.ndim == 1:
+                    x = x[None, :]
+            elif attrs.get("trans_x", False):
+                x = jnp.swapaxes(x, -1, -2)
+            out_local = jnp.matmul(x, w)
+            if t == "matmul":
+                alpha = attrs.get("alpha", 1.0)
+                if alpha != 1.0:
+                    out_local = out_local * alpha
+        out = _make_assemble_cols(plan.model_axis, plan.mp)(out_local)
+    return {"Out": [out]}
+
+
+def _tp_lookup(plan, tp, op, ins, attrs):
+    """Vocab-parallel embedding: the table's rows shard over `model`;
+    each member looks up only the ids it owns and the psum assembles
+    the full activations (disjoint rows — the sum is the scatter)."""
+    w, ids = ins["W"][0], ins["Ids"][0]
+    if op.type == "lookup_table" and ids.ndim > 1 and \
+            ids.shape[-1] == 1:
+        ids = ids.reshape(ids.shape[:-1])
+    with _marker("lookup", tp.name):
+        out = _make_vocab_lookup(
+            plan.model_axis, attrs.get("padding_idx", -1),
+            tuple(w.shape), w.dtype, tuple(ids.shape))(w, ids)
+    return {"Out": [out]}

@@ -84,40 +84,58 @@ class SPMDConfig:
 # parameters + shardings
 # ---------------------------------------------------------------------------
 
-def param_specs(cfg):
-    from jax.sharding import PartitionSpec as P
+#: per-param logical axis names (one name per tensor dim), t5x-style —
+#: the specs below are RESOLVED through parallel/axis_rules, never
+#: hard-coded, so this trainer reads the same axis-assignment idiom the
+#: fluid TP planner owns.  Layer params carry a leading 'stage' (pp)
+#: dim and a 'layers' (layers_per_stage) dim from the GPipe stacking.
+_LAYER_AXIS_NAMES = {
+    "ln1_s": ("stage", "layers", "embed"),
+    "ln1_b": ("stage", "layers", "embed"),
+    "wqkv": ("stage", "layers", "embed", "qkv", "joined_kv"),
+    "wo": ("stage", "layers", "joined_kv", "embed"),
+    "ln2_s": ("stage", "layers", "embed"),
+    "ln2_b": ("stage", "layers", "embed"),
+    "w1": ("stage", "layers", "embed", "mlp"),
+    "b1": ("stage", "layers", "mlp"),
+    "w2": ("stage", "layers", "mlp", "embed"),
+    "b2": ("stage", "layers", "embed"),
+}
 
-    # stage-stacked layer params: leading 'pp' axis, then layers_per_stage
-    if cfg.sp_mode == "ulysses":
-        # Ulysses: weights REPLICATED over 'tp' (the axis carries only
-        # the sequence shards; attention re-shards via all-to-all), so
-        # their grads psum over 'tp' through _replicated_axes
-        layer_specs = {
-            "ln1_s": P("pp", None, None), "ln1_b": P("pp", None, None),
-            "wqkv": P("pp", None, None, None, None),
-            "wo": P("pp", None, None, None),
-            "ln2_s": P("pp", None, None), "ln2_b": P("pp", None, None),
-            "w1": P("pp", None, None, None),
-            "b1": P("pp", None, None),
-            "w2": P("pp", None, None, None),
-            "b2": P("pp", None, None),
-        }
-    else:
-        layer_specs = {
-            "ln1_s": P("pp", None, None), "ln1_b": P("pp", None, None),
-            "wqkv": P("pp", None, None, None, "tp"),
-            "wo": P("pp", None, "tp", None),
-            "ln2_s": P("pp", None, None), "ln2_b": P("pp", None, None),
-            "w1": P("pp", None, None, "tp"),
-            "b1": P("pp", None, "tp"),
-            "w2": P("pp", None, "tp", None),
-            "b2": P("pp", None, None),
-        }
+
+def _transformer_rules(cfg):
+    """This trainer's LogicalAxisRules: the Megatron column/row-parallel
+    assignment over the local ("dp", "pp", "tp") mesh names.  Under
+    Ulysses the weight axes REPLICATE (the tp axis carries only the
+    sequence shards; attention re-shards via all-to-all), so their
+    grads psum over 'tp' through _replicated_axes."""
+    tp = None if cfg.sp_mode == "ulysses" else "tp"
+    return (
+        ("stage", "pp"),
+        ("layers", None),
+        ("embed", None),        # contraction dim — replicate
+        ("qkv", None),          # the q/k/v selector dim
+        ("joined_kv", tp),      # fused heads*kv projection dim
+        ("mlp", tp),            # ffn hidden dim
+        ("vocab", None),        # the embed table stays replicated here
+        ("seq", None),
+        ("batch", None),
+    )
+
+
+def param_specs(cfg):
+    from . import axis_rules
+
+    rules = _transformer_rules(cfg)
+
+    def res(names):
+        return axis_rules.logical_to_mesh_axes(names, rules)
+
     return {
-        "embed": P(None, None),
-        "pos": P(None, None),
-        "ln_f": {"scale": P(None), "bias": P(None)},
-        "layers": layer_specs,
+        "embed": res(("vocab", "embed")),
+        "pos": res(("seq", "embed")),
+        "ln_f": {"scale": res(("embed",)), "bias": res(("embed",))},
+        "layers": {n: res(a) for n, a in _LAYER_AXIS_NAMES.items()},
     }
 
 

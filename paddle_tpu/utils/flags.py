@@ -105,6 +105,19 @@ _FLAGS: Dict[str, object] = {
     # lowering without chips. See paddle_tpu/parallel/README.md
     # "Hierarchical collectives".
     "FLAGS_tpu_dcn_replicas": 0,
+    # Tensor (model) parallelism on the hybrid mesh: > 1 factors the
+    # intra-pod ici axis into (replica, model) — a 3-D
+    # (dcn, replica, model) mesh where eligible params (fc/matmul
+    # weights, embedding tables) shard over the innermost `model` axis
+    # via the t5x logical-axis rules (parallel/axis_rules.py) and the
+    # tensor-parallel all-reduces ride the fastest ICI hops, while
+    # grad sync / ZeRO-1 moments / AMP fp32 masters stay on the
+    # (dcn, replica) data axes. 0/1 (default; PADDLE_MP_DEGREE env and
+    # launch --mp_degree are the launch-time aliases) keeps today's
+    # flat/hierarchical lowering byte-for-byte. The value must divide
+    # the device count or the mesh falls back to flat with a warning.
+    # See paddle_tpu/parallel/README.md "Tensor parallelism".
+    "FLAGS_tpu_model_parallel": 0,
     # Pallas flash attention engages only at/above this key length: the
     # XLA fused path wins below it (measured on v5e: flash 13.6ms vs XLA
     # 9.8ms even at S=2048 fwd); flash's win is O(S) memory at long seq.

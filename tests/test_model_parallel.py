@@ -1,0 +1,392 @@
+"""Tensor parallelism on the hybrid mesh (FLAGS_tpu_model_parallel /
+PADDLE_MP_DEGREE): the intra-pod ici tier factors into
+(replica, model) and ONE planner (parallel/planner.plan_parallel)
+assigns every axis — weight out-dims / vocab rows shard over `model`
+via the logical-axis rules (parallel/axis_rules), ZeRO-1 moments, AMP
+fp32 masters and grad buckets shard over the replica axis at TP-LOCAL
+shapes, grad sync stays confined to the (dcn, replica) pair.
+
+Numerics contract (parallel/README.md "Tensor parallelism"): the TP
+forward is bit-identical to single-device — column-parallel partials
+are assembled by all_gather (a reordering-free concat) and
+vocab-parallel lookups psum DISJOINT row blocks. Only the activation
+gradient's model-axis psum reassociates a sum, so losses match the
+single-device trajectory within a small fp32 relative bound (~1e-7
+per step observed; asserted at rtol 2e-5 over multi-step training).
+At mp=1 the factorization short-circuits everywhere: the lowered HLO
+is byte-for-byte the pre-TP module.
+
+Machinery under test: parallel/env.create_hybrid_mesh 3-D mesh +
+mesh_hierarchy, parallel/tensor_parallel (plan + shard_map
+primitives), parallel/planner, parallel/sharded_update TP-local
+layout, fluid/lowering (_compile_dp four-group state split, census
+"mp" lane), fluid/checkpoint save-logical/restore-sharded,
+observability/publish.model_parallel_block.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import analysis
+from paddle_tpu.core.scope import Scope
+from paddle_tpu.fluid import checkpoint as ckpt
+from paddle_tpu.fluid import framework
+from paddle_tpu.parallel import env as penv
+from paddle_tpu.utils.flags import get_flag, set_flags
+
+O = fluid.optimizer
+
+
+@pytest.fixture(autouse=True)
+def _restore_flags():
+    keys = ("FLAGS_tpu_sharded_weight_update", "FLAGS_tpu_comm_bucket_mb",
+            "FLAGS_tpu_dcn_replicas", "FLAGS_tpu_model_parallel")
+    old = {k: get_flag(k) for k in keys}
+    yield
+    set_flags(old)
+
+
+def _fresh():
+    from paddle_tpu.core import scope as scope_mod
+
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    scope_mod._global_scope = scope_mod.Scope()
+
+
+def _batch():
+    r = np.random.RandomState(3)
+    # batch 16: divisible by every data world used here (4, 2, 1)
+    return (r.randint(0, 64, size=(16, 8)).astype("int64"),
+            r.randint(0, 4, (16, 1)).astype("int64"))
+
+
+def _set_mesh(prog, ndev, dcn, mp):
+    import jax
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()[:ndev])
+    if mp > 1:
+        # model INNERMOST: a model group is a contiguous fastest-hop
+        # block, and the dcn axis is kept even at dcn == 1 (one mesh
+        # shape for every consumer) — mirrors create_hybrid_mesh
+        prog._mesh = Mesh(devs.reshape(dcn, ndev // (dcn * mp), mp),
+                          ("dcn", "ici", "model"))
+    elif dcn > 1:
+        prog._mesh = Mesh(devs.reshape(dcn, ndev // dcn),
+                          ("dcn", "ici"))
+    else:
+        prog._mesh = Mesh(devs, ("dp",))
+
+
+def _train(ndev, dcn=1, mp=1, zero1=False, amp=False, bucket_mb=0.0,
+           steps=4, fc1=16):
+    """Embedding (vocab-parallel) + 2 fc (column-parallel) classifier
+    trained `steps` identical-feed Adam steps on an `ndev`-device mesh
+    factored (dcn, ici, model). Returns (losses, exe, prog, loss)."""
+    _fresh()
+    set_flags({"FLAGS_tpu_sharded_weight_update": zero1,
+               "FLAGS_tpu_comm_bucket_mb": bucket_mb,
+               "FLAGS_tpu_dcn_replicas": 0,
+               "FLAGS_tpu_model_parallel": 0})
+    ids_np, y = _batch()
+    with framework.unique_name_guard():
+        framework.default_main_program().random_seed = 1234
+        framework.default_startup_program().random_seed = 1234
+        ids = fluid.data(name="ids", shape=[-1, 8], dtype="int64")
+        label = fluid.data(name="label", shape=[-1, 1], dtype="int64")
+        emb = fluid.embedding(ids, size=(64, 16),
+                              param_attr=fluid.ParamAttr(name="tp.emb"))
+        pooled = fluid.layers.reduce_mean(emb, dim=1)
+        h = fluid.layers.fc(input=pooled, size=fc1, act="relu")
+        logits = fluid.layers.fc(input=h, size=4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        opt = O.AdamOptimizer(learning_rate=0.01)
+        if amp:
+            from paddle_tpu.fluid.contrib import mixed_precision
+
+            opt = mixed_precision.decorate(opt)
+        opt.minimize(loss)
+        prog = fluid.default_main_program()
+        fluid.CompiledProgram(prog).with_data_parallel(
+            loss_name=loss.name)
+        _set_mesh(prog, ndev, dcn, mp)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        losses = [float(np.mean(np.asarray(exe.run(
+            prog, feed={"ids": ids_np, "label": y},
+            fetch_list=[loss])[0]))) for _ in range(steps)]
+    return losses, exe, prog, loss
+
+
+# ---------------------------------------------------------------------------
+# parity matrix: mp=2 / mp=4 / dp x mp / dcn x dp x mp vs single-device
+# ---------------------------------------------------------------------------
+
+def test_tp_parity_matrix_vs_single_device():
+    """The acceptance matrix: every TP factorization tracks the
+    single-device trajectory within the documented bound (only the
+    activation-grad psum reassociates; everything else is
+    bit-preserving concat/disjoint-psum)."""
+    base, *_ = _train(1)
+    matrix = [(8, 1, 2), (8, 1, 4), (4, 1, 2), (8, 2, 2)]
+    for ndev, dcn, mp in matrix:
+        got, _, prog, _ = _train(ndev, dcn, mp)
+        np.testing.assert_allclose(
+            got, base, rtol=2e-5, atol=0,
+            err_msg="ndev=%d dcn=%d mp=%d diverged from single-device"
+            % (ndev, dcn, mp))
+        tpp = prog._tp_plan
+        assert tpp is not None and tpp.mp == mp
+        # all three weights shard: the embedding table vocab-parallel
+        # (dim 0), both fc weights column-parallel (dim 1)
+        dims = {n: p.tp_dim for n, p in tpp.params.items()}
+        assert dims.pop("tp.emb") == 0
+        assert len(dims) == 2 and set(dims.values()) == {1}
+        hier = penv.mesh_hierarchy(prog._mesh)
+        assert hier.model_axis == "model" and hier.mp_size == mp
+
+
+def test_tp_zero1_sharded_matches_replicated_bit_identical():
+    """The ZeRO guarantee survives TP: on the SAME (dcn, ici, model)
+    mesh the replica-sharded update (moments + buckets at TP-LOCAL
+    shapes) is bit-identical to the replicated update — sharding never
+    changes the math, now three-axis."""
+    rep, *_ = _train(8, 1, 2, zero1=False)
+    sh, _, prog, _ = _train(8, 1, 2, zero1=True, bucket_mb=0.001)
+    assert rep == sh, (rep, sh)
+    plan = prog._shard_plan
+    assert plan is not None and plan.sharded_state and plan.buckets
+    # TP'd vars ride the flat ZeRO layout at their LOCAL block shapes
+    tp_infos = {n: i for n, i in plan.sharded_state.items()
+                if getattr(i, "tp_dim", None) is not None}
+    assert tp_infos, "no TP-local sharded state in the ZeRO plan"
+    for n, info in tp_infos.items():
+        logical = list(info.logical_shape)
+        logical[info.tp_dim] //= info.mp
+        assert tuple(logical) == tuple(info.shape), (n, info)
+
+
+def test_tp_amp_o2_masters_plan_and_parity():
+    """ZeRO-1 + AMP-O2 + bucketed overlap all PLAN on a TP'd program
+    (fp32 masters shard over the replica axis at TP-local shapes) and
+    the sharded run stays bit-identical to replicated on the same
+    mesh."""
+    rep, *_ = _train(8, 1, 2, zero1=False, amp=True)
+    sh, _, prog, _ = _train(8, 1, 2, zero1=True, amp=True,
+                            bucket_mb=0.25)
+    assert rep == sh, (rep, sh)
+    plan = prog._shard_plan
+    assert plan is not None and plan.master_of and plan.buckets
+    assert prog._tp_plan is not None and prog._tp_plan.params
+    trail = getattr(prog, "_sharded_update_fallback", []) or []
+    unexplained = [e for e in trail
+                   if e.get("kind") not in ("tp_declined",)]
+    assert not unexplained, unexplained
+
+
+# ---------------------------------------------------------------------------
+# mp=1 byte-for-byte + structured declines
+# ---------------------------------------------------------------------------
+
+def test_mp1_hlo_byte_identical():
+    """FLAGS_tpu_model_parallel=1 short-circuits everywhere: the
+    lowered module is byte-for-byte the flag-unset module."""
+    ids_np, y = _batch()
+
+    def lowered(mp_flag):
+        losses, exe, prog, loss = _train(4)
+        set_flags({"FLAGS_tpu_model_parallel": mp_flag})
+        got = exe._cached_lowerable(
+            prog, {"ids": ids_np, "label": y}, [loss], None)
+        assert got is not None
+        return losses, got[1].as_text()
+
+    l0, hlo0 = lowered(0)
+    l1, hlo1 = lowered(1)
+    assert hlo0 == hlo1
+    assert l0 == l1
+
+
+def test_tp_structured_decline_records_reason():
+    """A weight whose sharded dim does not divide by mp is DECLINED
+    with a structured reason on the fallback trail (kind=tp_declined)
+    — and the program still trains, tracking single-device."""
+    base, *_ = _train(1, fc1=15)
+    got, _, prog, _ = _train(8, 1, 2, fc1=15)
+    np.testing.assert_allclose(got, base, rtol=2e-5, atol=0)
+    tpp = prog._tp_plan
+    assert tpp is not None and "tp.emb" in tpp.params
+    declined = [e for e in getattr(prog, "_sharded_update_fallback", [])
+                if e.get("kind") == "tp_declined"]
+    assert declined, "decline must be recorded on the trail"
+    assert any("divisible" in e.get("reason", "") for e in declined)
+    assert all(e["var"] not in tpp.params for e in declined
+               if e.get("var"))
+
+
+# ---------------------------------------------------------------------------
+# census: per-chip param bytes ∝ 1/mp, grad sync confined to data axes
+# ---------------------------------------------------------------------------
+
+def test_census_mp_lane_and_param_bytes():
+    _, exe, prog, loss = _train(8, 1, 2, zero1=True, bucket_mb=0.001)
+    ids_np, y = _batch()
+    col = exe.collective_report(prog, feed={"ids": ids_np, "label": y},
+                                fetch_list=[loss])
+    assert col["mp_size"] == 2 and col["ici_size"] == 4
+    assert col["mp_bytes_total"] == \
+        col["lanes"]["mp"]["wire_bytes"] > 0
+    # TP collectives (forward gathers + backward psums) ride the mp
+    # lane; grad sync stays on the data lanes
+    kinds = {c["kind"] for c in col["lanes"]["mp"]["per_collective"]}
+    assert kinds & {"all_gather", "all_reduce"}
+    assert all(c["participants"] == 2
+               for c in col["lanes"]["mp"]["per_collective"])
+    # per-chip param storage halves for every sharded var
+    tpp = prog._tp_plan
+    for n, p in tpp.params.items():
+        assert int(np.prod(p.local_shape)) * 2 == \
+            int(np.prod(p.logical_shape)), (n, p)
+    # the lowered module passes the model-axis replica_groups grammar
+    got = exe._cached_lowerable(prog, {"ids": ids_np, "label": y},
+                                [loss], None)
+    hlo = got[1].as_text()
+    assert analysis.check_hierarchical_groups(
+        hlo, 4, ndev=8, mp_size=2) == []
+
+
+def test_bench_model_parallel_block():
+    from paddle_tpu.observability import publish
+
+    _, exe, prog, loss = _train(8, 1, 2)
+    ids_np, y = _batch()
+    feed = {"ids": ids_np, "label": y}
+    block = publish.model_parallel_block(exe, prog, feed, [loss])
+    assert block is not None and block["mp_degree"] == 2
+    assert block["model_axis"] == "model"
+    assert "tp.emb" in block["sharded_params"]
+    assert block["sharded_params"]["tp.emb"]["tp_dim"] == 0
+    assert block["local_param_elems"] * 2 == \
+        block["logical_param_elems"]
+    assert block.get("mp_bytes_total", 0) > 0
+    # registry-assembled: the bench harness picks the block up
+    blocks = publish.bench_blocks(exe, prog, feed, [loss])
+    assert "model_parallel" in blocks and \
+        blocks["model_parallel"]["mp_degree"] == 2
+    # and at mp=1 the block is absent, not zero-filled
+    _, exe1, prog1, loss1 = _train(4)
+    assert publish.model_parallel_block(
+        exe1, prog1, feed, [loss1]) is None
+
+
+# ---------------------------------------------------------------------------
+# elastic: checkpoint restores into a DIFFERENT world, TP re-planned
+# ---------------------------------------------------------------------------
+
+def test_elastic_restore_replans_tp_layout(tmp_path):
+    """Checkpoints save model-sharded state at LOGICAL shapes, so an
+    N=8 (mp=2) run restores into an N'=4 (mp=2) world: the planner
+    re-plans the TP layout for the new mesh and the sharded
+    continuation is bit-identical to the replicated continuation
+    restored from the same checkpoint."""
+    root = str(tmp_path / "tp_ckpt")
+    _, exe8, prog8, _ = _train(8, 1, 2, zero1=True, bucket_mb=0.001,
+                               steps=2)
+    assert prog8._tp_plan is not None
+    ckpt.save_checkpoint(exe8, root,
+                         ckpt.TrainStatus(epoch_no=0, step_no=1),
+                         main_program=prog8)
+
+    def _continue(ndev, mp, zero1):
+        losses, exe, prog, loss = _train(ndev, 1, mp, zero1=zero1,
+                                         bucket_mb=0.001, steps=0)
+        scope = Scope()
+        exe.run(framework.default_startup_program(), scope=scope)
+        status = ckpt.load_checkpoint(exe, root, main_program=prog,
+                                      scope=scope)
+        assert status is not None
+        ids_np, y = _batch()
+        out = [float(np.mean(np.asarray(exe.run(
+            prog, feed={"ids": ids_np, "label": y}, fetch_list=[loss],
+            scope=scope)[0]))) for _ in range(3)]
+        return out, prog
+
+    sharded, p_s = _continue(4, 2, True)
+    replicated, _ = _continue(4, 2, False)
+    assert sharded == replicated, (sharded, replicated)
+    tpp = p_s._tp_plan
+    assert tpp is not None and tpp.mp == 2 and "tp.emb" in tpp.params
+    plan = p_s._shard_plan
+    assert plan is not None and plan.ndev == 2
+    assert all(i.padded % plan.ndev == 0
+               for i in plan.sharded_state.values())
+
+
+# ---------------------------------------------------------------------------
+# flag / env / launch wiring
+# ---------------------------------------------------------------------------
+
+def test_flag_builds_tp_mesh_through_compile(monkeypatch):
+    """FLAGS_tpu_model_parallel=2 alone (no hand-built mesh) factors
+    the 8-device world into the (1, 4, 2) mesh — the flag/env contract
+    the compile path reads through create_hybrid_mesh."""
+    monkeypatch.delenv("PADDLE_MP_DEGREE", raising=False)
+    set_flags({"FLAGS_tpu_model_parallel": 2,
+               "FLAGS_tpu_dcn_replicas": 0})
+    mesh = penv.create_hybrid_mesh()
+    assert mesh is not None and mesh.axis_names == \
+        ("dcn", "ici", "model")
+    assert dict(mesh.shape) == {"dcn": 1, "ici": 4, "model": 2}
+    hier = penv.mesh_hierarchy(mesh)
+    assert hier.mp_size == 2 and hier.model_axis == "model"
+    assert hier[0] == "dcn" and hier[1] == "ici"
+    # 2 pods x mp=2: replica axis halves, model group survives
+    set_flags({"FLAGS_tpu_dcn_replicas": 2})
+    mesh2 = penv.create_hybrid_mesh()
+    assert dict(mesh2.shape) == {"dcn": 2, "ici": 2, "model": 2}
+    # a world the factorization cannot tile falls back to flat (None)
+    assert penv.create_hybrid_mesh(nranks=6, dcn=1, mp=4) is None
+
+
+def test_model_parallel_degree_flag_and_env(monkeypatch):
+    set_flags({"FLAGS_tpu_model_parallel": 0})
+    monkeypatch.setenv("PADDLE_MP_DEGREE", "4")
+    assert penv.model_parallel_degree() == 4
+    set_flags({"FLAGS_tpu_model_parallel": 2})  # flag wins over env
+    assert penv.model_parallel_degree() == 2
+    monkeypatch.delenv("PADDLE_MP_DEGREE")
+    set_flags({"FLAGS_tpu_model_parallel": 0})
+    assert penv.model_parallel_degree() == 1
+
+
+def test_launch_worker_env_exports_mp_degree():
+    from paddle_tpu.distributed import launch
+
+    eps = ["h:1", "h:2", "h:3", "h:4"]
+    env = launch._worker_env(eps, 0, 0, base_env={}, mp_degree=2)
+    assert env["PADDLE_MP_DEGREE"] == "2"
+    env1 = launch._worker_env(eps, 0, 0,
+                              base_env={"PADDLE_MP_DEGREE": "8"})
+    assert "PADDLE_MP_DEGREE" not in env1
+
+
+def test_elastic_mesh_variants_keep_tp_group_indivisible():
+    """An elastic shrink of a (dcn, ici, model) base keeps BOTH the
+    pod count and the model degree fixed: N' must divide by dcn*mp,
+    else that N' falls back to the flat world."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()[:8]).reshape(1, 4, 2)
+    base = Mesh(devs, ("dcn", "ici", "model"))
+    variants = dict(penv.elastic_mesh_variants(base, min_ranks=4))
+    assert set(variants) == {7, 6, 5, 4}
+    assert variants[6].axis_names == ("dcn", "ici", "model")
+    assert dict(variants[6].shape) == {"dcn": 1, "ici": 3, "model": 2}
+    assert variants[4].axis_names == ("dcn", "ici", "model")
+    assert dict(variants[4].shape) == {"dcn": 1, "ici": 2, "model": 2}
+    # odd worlds cannot hold a 2-way TP group: flat fallback
+    assert variants[7].axis_names == ("dp",)
+    assert variants[5].axis_names == ("dp",)

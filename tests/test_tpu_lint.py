@@ -574,6 +574,143 @@ def test_misaligned_bucket_padding_trips():
 
 
 # ---------------------------------------------------------------------------
+# checker 4 extension — model-sharded (tensor-parallel) vocabulary
+# ---------------------------------------------------------------------------
+
+def _planned_tp_program():
+    """MLP Adam step planned by the ONE parallel planner on a
+    (1, 4, 2) (dcn, ici, model) mesh: both fc weights column-parallel
+    over `model`, ZeRO state over the replica axis."""
+    import jax
+    from jax.sharding import Mesh
+
+    from paddle_tpu.parallel import env as penv
+    from paddle_tpu.parallel import planner
+
+    loss = _mlp_loss()
+    fluid.optimizer.AdamOptimizer(1e-3).minimize(loss)
+    prog = fluid.default_main_program()
+    fluid.CompiledProgram(prog).with_data_parallel(loss_name=loss.name)
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(1, 4, 2),
+                ("dcn", "ici", "model"))
+    pplan = planner.plan_parallel(prog, prog.global_block(), mesh,
+                                  penv.ICI_AXIS)
+    prog._mesh = mesh
+    prog._tp_plan = pplan.tp_plan
+    prog._shard_plan = pplan.shard_plan
+    assert pplan.tp_plan is not None and pplan.tp_plan.params
+    return prog, pplan.tp_plan
+
+
+def test_model_sharded_plan_is_clean():
+    prog, _ = _planned_tp_program()
+    assert not analysis.check_shard_plan(prog)
+
+
+def test_model_sharded_norm_reader_trips():
+    """A global-norm reader over a model-sharded grad inserted after
+    planning: each model member holds a DISTINCT shard, so the norm
+    would mix partial sums without a model-axis psum."""
+    prog, tpp = _planned_tp_program()
+    blk = prog.global_block()
+    g = sorted(tpp.params)[0] + "@GRAD"
+    out = blk.create_var(name="lint.tp.norm", shape=(1,),
+                         dtype="float32")
+    idx = _bwd_idx(blk) + 1
+    blk.ops.insert(idx, Operator(
+        blk, "squared_l2_norm", inputs={"X": [g]},
+        outputs={"Out": [out.name]}, attrs={}))
+    fs = analysis.check_shard_plan(prog)
+    errs = [f for f in fs if f.severity == "error"]
+    assert len(errs) == 1
+    f = errs[0]
+    assert f.checker == "zero1-invariants"
+    assert f.op_type == "squared_l2_norm" and f.op_idx == idx
+    assert f.var == g and "model-sharded" in f.message
+
+
+def test_model_sharded_collective_trips():
+    """A raw allreduce over a model-sharded grad would average
+    DISTINCT shards together — grad sync belongs on (dcn, replica)."""
+    prog, tpp = _planned_tp_program()
+    blk = prog.global_block()
+    g = sorted(tpp.params)[0] + "@GRAD"
+    idx = _bwd_idx(blk) + 1
+    blk.ops.insert(idx, Operator(
+        blk, "c_allreduce_sum", inputs={"X": [g]},
+        outputs={"Out": [g]}, attrs={"ring_id": 0}))
+    fs = analysis.check_shard_plan(prog)
+    errs = [f for f in fs if f.severity == "error"]
+    # the classic ZeRO padding walk flags the same op (no re-zeroing
+    # rule) — BOTH findings must land, on the same op
+    assert errs and all(f.op_type == "c_allreduce_sum" for f in errs)
+    assert any("DISTINCT shards" in f.message for f in errs)
+
+
+def test_model_sharded_unknown_op_trips():
+    """Any op outside the shard-space vocabulary touching a TP'd var
+    post-backward: inside shard_map the value is one member's LOCAL
+    block, not the logical tensor."""
+    prog, tpp = _planned_tp_program()
+    blk = prog.global_block()
+    p = sorted(tpp.params)[0]
+    out = blk.create_var(name="lint.tp.mm", shape=(8, 8),
+                         dtype="float32")
+    idx = _bwd_idx(blk) + 1
+    blk.ops.insert(idx, Operator(
+        blk, "matmul", inputs={"X": [p], "Y": [p]},
+        outputs={"Out": [out.name]}, attrs={}))
+    fs = analysis.check_shard_plan(prog)
+    errs = [f for f in fs if f.severity == "error"]
+    assert len(errs) == 1
+    assert errs[0].op_type == "matmul"
+    assert "without a shard-space rule" in errs[0].message
+
+
+def test_model_sharded_tp_local_layout_tamper_trips():
+    """A TP'd ShardInfo whose local shape no longer derives from
+    (logical_shape, tp_dim, mp) would make the model-major flat
+    restore reassemble a wrong tensor."""
+    prog, tpp = _planned_tp_program()
+    plan = prog._shard_plan
+    name, info = next((n, i) for n, i in plan.sharded_state.items()
+                      if getattr(i, "tp_dim", None) is not None)
+    info.tp_dim = len(info.logical_shape)  # out of range
+    fs = analysis.check_shard_plan(prog)
+    assert any(f.severity == "error" and f.var == name
+               and "reassemble" in f.message for f in fs)
+
+
+def test_hierarchical_groups_model_axis_grammar():
+    """check_hierarchical_groups on a model-parallel mesh (ici=2,
+    mp=2, pod=4): within-pod groups must be one model block, one
+    member per model block, or the full pod — a partial span would
+    average DISTINCT TP shards."""
+    tmpl = ('%%0 = "stablehlo.all_reduce"(%%a) {replica_groups = '
+            'dense<%s> : tensor<%s>} : '
+            '(tensor<4xf32>) -> tensor<4xf32>')
+    legal = [
+        ("[[0, 1], [2, 3]]", "2x2xi64"),    # model blocks
+        ("[[0, 2], [1, 3]]", "2x2xi64"),    # replica axis
+        ("[[0, 1, 2, 3]]", "1x4xi64"),      # full pod
+    ]
+    for groups, shape in legal:
+        hlo = tmpl % (groups, shape)
+        assert analysis.check_hierarchical_groups(
+            hlo, 2, ndev=8, mp_size=2) == [], groups
+    mixed = tmpl % ("[[0, 1, 2], [1, 2, 3]]", "2x3xi64")
+    fs = analysis.check_hierarchical_groups(mixed, 2, ndev=8,
+                                            mp_size=2)
+    assert len(fs) == 1 and fs[0].severity == "error"
+    assert "MODEL/REPLICA-mixed" in fs[0].message
+    # mp grammar applies on the single-pod (dcn=1) TP mesh too: the
+    # world is exactly one pod, no cross-pod tier to hide behind
+    fs1 = analysis.check_hierarchical_groups(mixed, 2, ndev=4,
+                                             mp_size=2)
+    assert any("MODEL/REPLICA-mixed" in f.message for f in fs1)
+
+
+# ---------------------------------------------------------------------------
 # checker 6 — ZeRO-2 gradient lifetimes
 # ---------------------------------------------------------------------------
 
@@ -1058,7 +1195,8 @@ def test_exemplar_programs_lint_clean():
     zero errors across every checker."""
     tpu_lint = _import_tpu_lint()
     results = tpu_lint.lint_exemplars()
-    assert set(results) == {"bert_tiny", "bert_tiny_amp", "mlp_hier",
+    assert set(results) == {"bert_tiny", "bert_tiny_amp",
+                            "bert_tiny_tp", "mlp_hier",
                             "embedding_ctr", "resnet_scan",
                             "serving_decode", "fleet_ps_2rank"}
     for name, (findings, summary) in results.items():
@@ -1078,8 +1216,9 @@ def test_cli_end_to_end(tmp_path):
     report = json.loads(out.read_text())
     assert report["ok"] and report["total_errors"] == 0
     assert set(report["programs"]) == {"bert_tiny", "bert_tiny_amp",
-                                       "mlp_hier", "embedding_ctr",
-                                       "resnet_scan", "serving_decode",
+                                       "bert_tiny_tp", "mlp_hier",
+                                       "embedding_ctr", "resnet_scan",
+                                       "serving_decode",
                                        "fleet_ps_2rank"}
     assert "tpu-lint:" in r.stdout
 

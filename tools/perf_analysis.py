@@ -492,6 +492,43 @@ def sharded_update_diff(batch=16, seq_len=32):
     col_on, don_on, fallback = one(True)
     grad_off = col_off.get("all_reduce", {}).get("ici_bytes", 0)
     grad_on = col_on.get("reduce_scatter", {}).get("ici_bytes", 0)
+
+    # third leg: the tensor-parallel planner on the same model (ZeRO-1
+    # stays on; mp=2 over the intra-pod tier). Every weight the TP
+    # planner touches is either PLANNED (model-sharded) or DECLINED
+    # with a structured kind="tp_declined" reason — "unexplained" =
+    # a weight-slot candidate that is neither, which should be empty
+    exe_tp, prog_tp, feed_tp, total_tp = _bert_tiny_step(
+        batch, seq_len, {"FLAGS_tpu_sharded_weight_update": True,
+                         "FLAGS_tpu_model_parallel": 2})
+    tpp = getattr(prog_tp, "_tp_plan", None)
+    trail_tp = list(getattr(prog_tp, "_sharded_update_fallback",
+                            None) or [])
+    tp_declined = [e for e in trail_tp
+                   if e.get("kind") == "tp_declined"]
+    blk = prog_tp.global_block()
+    cand = set()
+    for op in blk.ops:
+        slot = ("Y" if op.type in ("mul", "matmul", "matmul_v2")
+                else "W" if op.type in ("lookup_table",
+                                        "lookup_table_v2", "embedding")
+                else None)
+        if slot is None:
+            continue
+        for n in op.input_names.get(slot, []):
+            v = blk._find_var_recursive(n)
+            if v is not None and getattr(v, "persistable", False):
+                cand.add(n)
+    explained = set(getattr(tpp, "params", None) or ()) | \
+        {e.get("var") for e in tp_declined}
+    unexplained = sorted(cand - explained)
+    mp_block = {
+        "mp_degree": 2,
+        "sharded_params": sorted(getattr(tpp, "params", None) or ()),
+        "tp_declined": tp_declined,
+        "unexplained_params": unexplained,
+    }
+
     out = {
         "model": "bert-tiny b%d s%d" % (batch, seq_len),
         "ndev": col_off.get("ndev"),
@@ -506,6 +543,7 @@ def sharded_update_diff(batch=16, seq_len=32):
             "sharded_per_replica":
                 don_on.get("opt_state_per_replica_bytes")},
         "fallback_reasons": fallback,
+        "model_parallel": mp_block,
     }
     path = os.path.join(_REPO, "artifacts", "sharded_update_diff.json")
     os.makedirs(os.path.dirname(path), exist_ok=True)
@@ -516,7 +554,9 @@ def sharded_update_diff(batch=16, seq_len=32):
           and don_on.get("opt_state_sharded_vars", 0) > 0
           and don_on["opt_state_per_replica_bytes"]
           <= 0.2 * don_on["opt_state_logical_bytes"]
-          and don_on.get("aliases_state"))
+          and don_on.get("aliases_state")
+          and mp_block["sharded_params"]
+          and not unexplained)
     print("sharded-update diff (%s): grad ICI %d -> %d bytes "
           "(%.2fx), opt state/replica %s -> %s bytes; %s; wrote %s"
           % (out["model"], grad_off, grad_on,
@@ -531,6 +571,14 @@ def sharded_update_diff(batch=16, seq_len=32):
                   % (f["kind"], f["reason"], f["var"], f["op"]))
     else:
         print("sharded-update fallback reasons: none (fully planned)")
+    print("tensor-parallel (mp=2): %d sharded, %d declined, "
+          "%d unexplained%s"
+          % (len(mp_block["sharded_params"]), len(tp_declined),
+             len(unexplained),
+             " <- " + ", ".join(unexplained) if unexplained else ""))
+    for f in tp_declined:
+        print("  [tp_declined] %s (var=%s op=%s)"
+              % (f["reason"], f["var"], f["op"]))
     return 0 if ok else 1
 
 

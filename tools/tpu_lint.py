@@ -13,6 +13,13 @@ Exemplars (each is a program the bench / tier-1 suite actually runs):
                       collectives — the zero2-lifetimes leg plus the
                       AMP-aware dtype-contract checks, zero errors
                       required;
+- ``bert_tiny_tp``  — the SAME AMP+ZeRO model 2-way TENSOR-PARALLEL
+                      on a (dcn, ici, model) mesh: the one planner
+                      assigns every axis (params over `model`, ZeRO
+                      state + masters over the replica axis), and the
+                      model-sharded zero1-invariants leg proves no
+                      unguarded norm/optimizer/collective reads a TP
+                      shard as if it were the full tensor;
 - ``resnet_scan``   — ResNet50 with scan_stages (deep control-flow
                       nesting: host-sync + contract checkers descend
                       through the scan sub-blocks);
@@ -127,6 +134,60 @@ def build_bert_tiny_amp():
         assert plan is not None and plan.master_of and plan.buckets, \
             "AMP+ZeRO-2 exemplar failed to plan (fallback: %s)" % (
                 getattr(prog, "_sharded_update_fallback", None),)
+    return prog, None
+
+
+def build_bert_tiny_tp():
+    """BERT-tiny under bf16 AMP + ZeRO with 2-way TENSOR PARALLELISM
+    on the (dcn, ici, model) mesh: `parallel.planner.plan_parallel`
+    owns every axis — weight out-dims / vocab rows shard over `model`
+    (via the logical-axis rules), fp32 masters + moments + buckets
+    over the replica (ici) axis at TP-LOCAL shapes. The model-sharded
+    zero1-invariants leg then proves no norm reader, fused optimizer
+    or raw collective consumes a TP shard as the full tensor. Zero
+    errors required."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework
+    from paddle_tpu.fluid.contrib import mixed_precision
+    from paddle_tpu.models import bert
+    from paddle_tpu.parallel import env as penv
+    from paddle_tpu.parallel import planner
+    from paddle_tpu.utils.flags import get_flag, set_flags
+
+    _fresh()
+    with framework.unique_name_guard():
+        cfg = bert.BertConfig.tiny()
+        framework.default_main_program().random_seed = 7
+        total, _, _, _ = bert.bert_pretrain_loss(cfg, 32, is_test=False)
+        opt = mixed_precision.decorate(
+            fluid.optimizer.AdamOptimizer(learning_rate=1e-3))
+        opt.minimize(total)
+        prog = fluid.default_main_program()
+        fluid.CompiledProgram(prog).with_data_parallel(
+            loss_name=total.name)
+        old = {k: get_flag(k) for k in ("FLAGS_tpu_comm_bucket_mb",
+                                        "FLAGS_tpu_model_parallel")}
+        try:
+            set_flags({"FLAGS_tpu_comm_bucket_mb": 0.25,
+                       "FLAGS_tpu_model_parallel": 2})
+            mesh = penv.create_hybrid_mesh(nranks=NDEV)
+            pplan = planner.plan_parallel(
+                prog, prog.global_block(), mesh, penv.ICI_AXIS)
+        finally:
+            set_flags(old)
+        prog._mesh = mesh
+        prog._sparse_plan = pplan.sparse_plan
+        prog._tp_plan = pplan.tp_plan
+        prog._model_axis = pplan.tp_plan.model_axis \
+            if pplan.tp_plan is not None else None
+        prog._shard_plan = pplan.shard_plan
+        assert pplan.tp_plan is not None and pplan.tp_plan.params, \
+            "TP exemplar failed to plan the model axis (trail: %s)" % (
+                getattr(prog, "_sharded_update_fallback", None),)
+        plan = pplan.shard_plan
+        assert plan is not None and plan.master_of and plan.buckets, \
+            "AMP+ZeRO exemplar failed to plan under TP (fallback: %s)" \
+            % (getattr(prog, "_sharded_update_fallback", None),)
     return prog, None
 
 
@@ -305,6 +366,7 @@ def build_fleet_ps_2rank():
 EXEMPLARS = {
     "bert_tiny": build_bert_tiny,
     "bert_tiny_amp": build_bert_tiny_amp,
+    "bert_tiny_tp": build_bert_tiny_tp,
     "mlp_hier": build_mlp_hier,
     "embedding_ctr": build_embedding_ctr,
     "resnet_scan": build_resnet_scan,
