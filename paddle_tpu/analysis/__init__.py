@@ -23,7 +23,12 @@ Six checkers (see README.md in this directory for the full catalog):
    reduce-scattered; buckets flush whole, fetches of scattered grads
    flagged (sharding.py).
 6. ``dtype-contract`` — declared vs computed out dtype/shape, silent
-   fp64 promotions, redundant AMP cast round-trips (contracts.py).
+   fp64 promotions, redundant AMP cast round-trips, plus quantized
+   programs: fp8 delayed-scaling state ownership (reads/writes outside
+   the backward op's Fp8ScaleState slots and save/load = ERROR), fp8
+   white-list sites missing wired scale state = ERROR, and slim/PTQ
+   fake-quant ops missing their calibrated scale input = ERROR
+   (contracts.py).
 
 Surfaces: ``tools/tpu_lint.py`` (CLI, JSON artifact, --fail-on),
 ``FLAGS_tpu_static_checks={off,warn,error}`` (Executor compile-time
@@ -47,7 +52,8 @@ from .donation import (check_donation_safety,  # noqa: F401
 from .host_sync import check_host_sync  # noqa: F401
 from .sharding import (check_shard_plan,  # noqa: F401
                        check_sparse_update, check_zero2_lifetimes)
-from .contracts import check_dtype_shape_contracts  # noqa: F401
+from .contracts import (check_dtype_shape_contracts,  # noqa: F401
+                        check_quantization_contracts)
 
 __all__ = [
     "Finding", "SEVERITIES", "CHECKERS", "format_finding",
@@ -59,7 +65,7 @@ __all__ = [
     "check_donation_safety", "cross_check_donation_report",
     "check_host_sync", "check_shard_plan", "check_sparse_update",
     "check_zero2_lifetimes", "check_dtype_shape_contracts",
-    "run_static_checks",
+    "check_quantization_contracts", "run_static_checks",
 ]
 
 #: checker registry: name -> "does it run in the single-program pass"
@@ -115,4 +121,8 @@ def run_static_checks(program, feed_names=None, fetch_names=None,
                                         fetch_names=fetch_names)
     if "dtype-contract" in sel:
         findings += check_dtype_shape_contracts(program)
+        # quantized programs: fp8 scale-state ownership + site wiring,
+        # PTQ calibrated-scale presence (ERROR severity — wrong math,
+        # not drifted declarations)
+        findings += check_quantization_contracts(program)
     return sort_findings(findings)

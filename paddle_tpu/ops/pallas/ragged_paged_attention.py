@@ -60,12 +60,22 @@ from .flash_attention import (_HAS_PLTPU, _LANES, _NEG_INF,
 __all__ = ["ragged_paged_attention", "ragged_paged_attention_reference"]
 
 
-def _check_args(q, k_pages, v_pages, block_tables, context_lens, q_lens):
+def _check_args(q, k_pages, v_pages, block_tables, context_lens, q_lens,
+                k_scale=None, v_scale=None):
     S, Q, Hq, D = q.shape
     P, page_size, Hkv, Dk = k_pages.shape
     if v_pages.shape != k_pages.shape:
         raise ValueError("k_pages %s != v_pages %s"
                          % (k_pages.shape, v_pages.shape))
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("pass both k_scale and v_scale or neither")
+    if k_scale is not None:
+        if k_scale.shape != (P, page_size) or \
+                v_scale.shape != (P, page_size):
+            raise ValueError(
+                "k_scale/v_scale must be [num_pages, page_size] = %s, "
+                "got %s / %s" % ((P, page_size), k_scale.shape,
+                                 v_scale.shape))
     if Dk != D:
         raise ValueError("head_dim mismatch: q %d vs pages %d" % (D, Dk))
     if Hq % Hkv != 0:
@@ -87,13 +97,21 @@ def _check_args(q, k_pages, v_pages, block_tables, context_lens, q_lens):
 
 def ragged_paged_attention_reference(q, k_pages, v_pages, block_tables,
                                      context_lens, q_lens=None, *,
-                                     sm_scale=None):
+                                     sm_scale=None, k_scale=None,
+                                     v_scale=None):
     """Gather-then-mask reference with the exact kernel semantics.
 
     Fixed shapes throughout (the gather spans the FULL block table, not
     the batch's max context), so per-row results are independent of how
     the batch was packed — the property the serving engine's
-    bit-identical continuous-batching contract rests on."""
+    bit-identical continuous-batching contract rests on.
+
+    `k_scale`/`v_scale` ([num_pages, page_size] fp32, both or neither)
+    dequantize int8 pages in-flight: the gathered slot values are
+    multiplied by their per-slot abs-max scale before the attention
+    math, so quantized pages never materialize densely outside f32
+    registers. With scales absent the computation is byte-identical to
+    the pre-quantization reference."""
     q = jnp.asarray(q)
     k_pages = jnp.asarray(k_pages)
     v_pages = jnp.asarray(v_pages)
@@ -101,7 +119,12 @@ def ragged_paged_attention_reference(q, k_pages, v_pages, block_tables,
     context_lens = jnp.asarray(context_lens, jnp.int32)
     if q_lens is not None:
         q_lens = jnp.asarray(q_lens, jnp.int32)
-    _check_args(q, k_pages, v_pages, block_tables, context_lens, q_lens)
+    if k_scale is not None:
+        k_scale = jnp.asarray(k_scale, jnp.float32)
+    if v_scale is not None:
+        v_scale = jnp.asarray(v_scale, jnp.float32)
+    _check_args(q, k_pages, v_pages, block_tables, context_lens, q_lens,
+                k_scale, v_scale)
     S, Q, Hq, D = q.shape
     P, page_size, Hkv, _ = k_pages.shape
     G = Hq // Hkv
@@ -115,6 +138,11 @@ def ragged_paged_attention_reference(q, k_pages, v_pages, block_tables,
     # [S, kvmax, Hkv, D] — every sequence's pages, in table order
     k = k_pages[block_tables].reshape(S, kvmax, Hkv, D)
     v = v_pages[block_tables].reshape(S, kvmax, Hkv, D)
+    if k_scale is not None:
+        ks = k_scale[block_tables].reshape(S, kvmax)[:, :, None, None]
+        vs = v_scale[block_tables].reshape(S, kvmax)[:, :, None, None]
+        k = k.astype(jnp.float32) * ks
+        v = v.astype(jnp.float32) * vs
 
     qf = q.astype(jnp.float32).reshape(S, Q, Hkv, G, D)
     s = jnp.einsum("sqhgd,skhd->shgqk", qf, k.astype(jnp.float32),
@@ -143,11 +171,13 @@ def ragged_paged_attention_reference(q, k_pages, v_pages, block_tables,
 
 def _rpa_kernel(tbl_ref, ctx_ref, qlen_ref, q_ref, k_ref, v_ref, o_ref,
                 m_scr, l_scr, acc_scr, *, sm_scale, page_size, q_rows,
-                gq_rows):
+                gq_rows, ks_ref=None, vs_ref=None):
     """Grid (S, Hkv, pages_per_seq); innermost page dim is sequential
     and carries the online-softmax (m, l, acc) state in VMEM scratch.
     The q block is the GQA-packed [G*Q, D] row block for (seq, kv
-    head); row r maps to query group g = r // Q, row i = r % Q."""
+    head); row r maps to query group g = r // Q, row i = r % Q.
+    `ks_ref`/`vs_ref` (quantized pool only) hold the page's per-slot
+    fp32 scales; int8 K/V dequantize in VMEM right after the load."""
     s_idx = pl.program_id(0)
     j = pl.program_id(2)
     npages = pl.num_programs(2)
@@ -165,6 +195,8 @@ def _rpa_kernel(tbl_ref, ctx_ref, qlen_ref, q_ref, k_ref, v_ref, o_ref,
     def _body():
         q = q_ref[0, 0].astype(jnp.float32)          # [GQ, D]
         k = k_ref[0, 0].astype(jnp.float32)          # [page, D]
+        if ks_ref is not None:
+            k = k * ks_ref[0][:, None]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
         s = s * sm_scale                             # [GQ, page]
@@ -186,8 +218,10 @@ def _rpa_kernel(tbl_ref, ctx_ref, qlen_ref, q_ref, k_ref, v_ref, o_ref,
         alpha = jnp.exp(m_prev - m_next)
         l_scr[:] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         m_scr[:] = m_next
-        pv = lax.dot_general(p, v_ref[0, 0].astype(jnp.float32),
-                             (((1,), (0,)), ((), ())),
+        v = v_ref[0, 0].astype(jnp.float32)
+        if vs_ref is not None:
+            v = v * vs_ref[0][:, None]
+        pv = lax.dot_general(p, v, (((1,), (0,)), ((), ())),
                              preferred_element_type=jnp.float32)
         acc_scr[:] = acc_scr[:] * alpha[:, :1] + pv
 
@@ -198,31 +232,57 @@ def _rpa_kernel(tbl_ref, ctx_ref, qlen_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
 
 
+def _rpa_kernel_quant(tbl_ref, ctx_ref, qlen_ref, q_ref, k_ref, v_ref,
+                      ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr,
+                      **kw):
+    """Operand-order adapter for the quantized pool: pallas passes the
+    two scale blocks positionally after v; the body is `_rpa_kernel`."""
+    _rpa_kernel(tbl_ref, ctx_ref, qlen_ref, q_ref, k_ref, v_ref, o_ref,
+                m_scr, l_scr, acc_scr, ks_ref=ks_ref, vs_ref=vs_ref,
+                **kw)
+
+
 def _rpa_call_impl(q_packed, k_heads, v_heads, block_tables,
-                   context_lens, q_lens, *, sm_scale, q_rows, interpret):
-    """q_packed: [S, Hkv, G*Q, D]; k_heads/v_heads: [Hkv, P, page, D].
+                   context_lens, q_lens, *, sm_scale, q_rows, interpret,
+                   k_scale=None, v_scale=None):
+    """q_packed: [S, Hkv, G*Q, D]; k_heads/v_heads: [Hkv, P, page, D];
+    k_scale/v_scale (optional): [P, page] fp32 per-slot dequant scales.
     Returns [S, Hkv, G*Q, D]."""
     S, Hkv, GQ, D = q_packed.shape
     _, P, page_size, _ = k_heads.shape
     npages = block_tables.shape[1]
+    quant = k_scale is not None
 
     kernel = functools.partial(
-        _rpa_kernel, sm_scale=sm_scale, page_size=page_size,
-        q_rows=q_rows, gq_rows=GQ)
+        _rpa_kernel_quant if quant else _rpa_kernel,
+        sm_scale=sm_scale, page_size=page_size, q_rows=q_rows,
+        gq_rows=GQ)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, GQ, D),
+                     lambda s, h, j, tbl, ctx, ql: (s, h, 0, 0)),
+        pl.BlockSpec((1, 1, page_size, D),
+                     lambda s, h, j, tbl, ctx, ql:
+                     (h, tbl[s, j], 0, 0)),
+        pl.BlockSpec((1, 1, page_size, D),
+                     lambda s, h, j, tbl, ctx, ql:
+                     (h, tbl[s, j], 0, 0)),
+    ]
+    operands = [block_tables, context_lens, q_lens, q_packed, k_heads,
+                v_heads]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, page_size),
+                         lambda s, h, j, tbl, ctx, ql: (tbl[s, j], 0)),
+            pl.BlockSpec((1, page_size),
+                         lambda s, h, j, tbl, ctx, ql: (tbl[s, j], 0)),
+        ]
+        operands += [k_scale, v_scale]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(S, Hkv, npages),
-        in_specs=[
-            pl.BlockSpec((1, 1, GQ, D),
-                         lambda s, h, j, tbl, ctx, ql: (s, h, 0, 0)),
-            pl.BlockSpec((1, 1, page_size, D),
-                         lambda s, h, j, tbl, ctx, ql:
-                         (h, tbl[s, j], 0, 0)),
-            pl.BlockSpec((1, 1, page_size, D),
-                         lambda s, h, j, tbl, ctx, ql:
-                         (h, tbl[s, j], 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, GQ, D), lambda s, h, j, tbl, ctx, ql: (s, h, 0, 0)),
         scratch_shapes=[
@@ -237,19 +297,26 @@ def _rpa_call_impl(q_packed, k_heads, v_heads, block_tables,
         out_shape=jax.ShapeDtypeStruct((S, Hkv, GQ, D), q_packed.dtype),
         interpret=interpret,
         compiler_params=_compiler_params(),
-    )(block_tables, context_lens, q_lens, q_packed, k_heads, v_heads)
+    )(*operands)
 
 
 def ragged_paged_attention(q, k_pages, v_pages, block_tables,
                            context_lens, q_lens=None, *, sm_scale=None,
-                           impl="auto", interpret=None):
+                           impl="auto", interpret=None, k_scale=None,
+                           v_scale=None):
     """Paged attention over mixed-length sequences through a block
     table (see module docstring for the argument contract).
 
     impl: "kernel" = the Pallas kernel (Mosaic on TPU, interpreter
     elsewhere), "reference" = the jittable pure-JAX gather reference,
     "auto" = kernel on TPU, reference on CPU/GPU — the interpreter is
-    grid-sequential and only meant for kernel parity tests."""
+    grid-sequential and only meant for kernel parity tests.
+
+    k_scale/v_scale ([num_pages, page_size] fp32, both or neither):
+    per-slot dequantization scales for int8 pages — kernel and
+    reference multiply each slot's K/V by its scale in f32 before the
+    attention math. Omitting them keeps the float paths byte-identical
+    to the pre-quantization op."""
     q = jnp.asarray(q)
     k_pages = jnp.asarray(k_pages)
     v_pages = jnp.asarray(v_pages)
@@ -257,7 +324,12 @@ def ragged_paged_attention(q, k_pages, v_pages, block_tables,
     context_lens = jnp.asarray(context_lens, jnp.int32)
     if q_lens is not None:
         q_lens = jnp.asarray(q_lens, jnp.int32)
-    _check_args(q, k_pages, v_pages, block_tables, context_lens, q_lens)
+    if k_scale is not None:
+        k_scale = jnp.asarray(k_scale, jnp.float32)
+    if v_scale is not None:
+        v_scale = jnp.asarray(v_scale, jnp.float32)
+    _check_args(q, k_pages, v_pages, block_tables, context_lens, q_lens,
+                k_scale, v_scale)
     if impl not in ("auto", "kernel", "reference"):
         raise ValueError("impl must be auto|kernel|reference, got %r"
                          % (impl,))
@@ -272,7 +344,7 @@ def ragged_paged_attention(q, k_pages, v_pages, block_tables,
     if not use_kernel:
         return ragged_paged_attention_reference(
             q, k_pages, v_pages, block_tables, context_lens, q_lens,
-            sm_scale=sm_scale)
+            sm_scale=sm_scale, k_scale=k_scale, v_scale=v_scale)
 
     S, Q, Hq, D = q.shape
     P, page_size, Hkv, _ = k_pages.shape
@@ -292,6 +364,7 @@ def ragged_paged_attention(q, k_pages, v_pages, block_tables,
     v_heads = v_pages.transpose(2, 0, 1, 3)
     o = _rpa_call_impl(q_packed, k_heads, v_heads, block_tables,
                        context_lens, q_lens, sm_scale=float(sm_scale),
-                       q_rows=Q, interpret=bool(interpret))
+                       q_rows=Q, interpret=bool(interpret),
+                       k_scale=k_scale, v_scale=v_scale)
     return o.reshape(S, Hkv, G, Q, D).transpose(0, 3, 1, 2, 4) \
         .reshape(S, Q, Hq, D)

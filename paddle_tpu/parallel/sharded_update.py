@@ -433,10 +433,12 @@ def broadcast_mismatch(op, block):
 def _record_fallback(program, reason, var=None, op_type=None,
                      kind="declined"):
     """Structured per-program fallback trail: why the planner declined
-    (kind='declined' — the whole program keeps the replicated update)
-    or degraded one var to the replicated layout (kind='state_degraded').
-    `tools/perf_analysis.py --sharded-diff` reports these instead of
-    silence; tests assert on them."""
+    (kind='declined' — the whole program keeps the replicated update),
+    degraded one var to the replicated layout (kind='state_degraded'),
+    or never ran at all because the pipeline engine owns the program
+    partition (kind='pipeline_bypassed', recorded at the compile_block
+    dispatch). `tools/perf_analysis.py --sharded-diff` reports these
+    instead of silence; tests assert on them."""
     lst = getattr(program, "_sharded_update_fallback", None)
     if lst is None:
         lst = []

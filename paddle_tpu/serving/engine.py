@@ -54,6 +54,8 @@ class EngineConfig:
     max_context: Optional[int] = None  # None = the model's max_seq
     attention_impl: str = "auto"
     step_event_every: int = 1
+    kv_dtype: str = "float32"          # "float32" | "bfloat16" | "int8"
+    quantize_weights: bool = False     # PTQ int8 params at init
 
     @staticmethod
     def from_flags(**overrides) -> "EngineConfig":
@@ -66,6 +68,10 @@ class EngineConfig:
             max_queue=int(get_flag("FLAGS_tpu_serving_max_queue", 0)),
             attention_impl=str(get_flag(
                 "FLAGS_tpu_serving_attention_impl", "auto") or "auto"),
+            kv_dtype=str(get_flag(
+                "FLAGS_tpu_serving_kv_dtype", "float32") or "float32"),
+            quantize_weights=bool(get_flag(
+                "FLAGS_tpu_serving_quantize_weights", False)),
         )
         kw.update(overrides)
         return EngineConfig(**kw)
@@ -92,6 +98,21 @@ class Engine:
             model.attention_impl = self.config.attention_impl
         self.params = params if params is not None else \
             model.init_params(seed)
+        if self.config.quantize_weights:
+            from .quantize import quantize_weights_int8, weight_bytes
+
+            dense_bytes = weight_bytes(self.params)
+            self.params = quantize_weights_int8(self.params)
+            try:
+                from ..observability import registry
+
+                reg = registry()
+                reg.set_gauge("serving.weight_bytes_dense", dense_bytes)
+                reg.set_gauge("serving.weight_bytes",
+                              weight_bytes(self.params))
+                reg.set_gauge("serving.weights_quantized", 1)
+            except Exception:  # noqa: BLE001 - telemetry never gates
+                pass
         # the TRUE per-request bound is the model's max_seq; pages
         # round UP to whole pages, so the pool bound can be looser
         max_ctx = min(self.config.max_context or model.config.max_seq,
@@ -99,7 +120,7 @@ class Engine:
         pages_per_seq = -(-int(max_ctx) // self.config.page_size)
         self.kv = PagedKVCache(model.kv_cache_spec(
             self.config.num_pages, self.config.page_size,
-            pages_per_seq))
+            pages_per_seq, dtype=self.config.kv_dtype))
         self.plan = BucketPlan.from_flags(
             self.config.max_seqs, self.kv.config.max_context)
         self.scheduler = Scheduler(self.kv, self.plan,
@@ -126,10 +147,12 @@ class Engine:
         # memoized on the model object: two engines over the SAME model
         # (a restart, the sequential-reference twin in tests) share
         # jax's in-process executable cache instead of re-tracing.
-        # Keyed on (donate, attention_impl): forward() closes over the
-        # impl at trace time, so a stale memo would silently serve the
-        # wrong attention path
-        memo_key = (donate, getattr(model, "attention_impl", "auto"))
+        # Keyed on (donate, attention_impl, kv_dtype): forward() closes
+        # over the impl at trace time, so a stale memo would silently
+        # serve the wrong attention path; the page dtype changes the
+        # carried pytree structure (int8 pools carry scale arrays)
+        memo_key = (donate, getattr(model, "attention_impl", "auto"),
+                    self.config.kv_dtype)
         self._jitted = getattr(model, "_serving_jitted", None)
         if self._jitted is None or \
                 getattr(model, "_serving_jitted_key", None) != memo_key:
@@ -434,12 +457,17 @@ class Engine:
                 reg.observe("serving.decode_batch", stats["n_decode"])
             every = max(1, int(self.config.step_event_every))
             if self._steps % every == 0:
+                kvc = self.kv.config
                 reg.event("serving_step",
                           running=stats["running"],
                           queue_depth=stats["queue_depth"],
                           kv_blocks_in_use=stats["kv_pages_in_use"],
                           n_prefill=stats.get("n_prefill", 0),
-                          n_decode=stats.get("n_decode", 0))
+                          n_decode=stats.get("n_decode", 0),
+                          kv_page_dtype=kvc.dtype,
+                          kv_page_bytes=stats["kv_pages_in_use"]
+                          * kvc.page_bytes,
+                          resident_batch=kvc.resident_batch)
 
         self._reg_safe(pub)
 
@@ -456,6 +484,10 @@ class Engine:
                 "kv_pages_in_use": self.kv.pages_in_use,
                 "kv_occupancy": round(self.kv.occupancy, 4),
                 "kv_peak_pages": self.kv.peak_pages_in_use,
+                "kv_page_dtype": self.kv.config.dtype,
+                "kv_page_bytes": self.kv.config.page_bytes,
+                "kv_pool_bytes": self.kv.config.pool_bytes,
+                "kv_resident_batch": self.kv.config.resident_batch,
                 "buckets_compiled": [
                     list(b) for b in self._compiler.compiled_buckets],
             }

@@ -29,6 +29,12 @@ from typing import Dict, List, Optional
 
 __all__ = ["KVCacheConfig", "PagedKVCache"]
 
+#: page value dtypes the pool understands -> bytes per stored element.
+#: "int8" pages additionally carry TWO per-slot fp32 abs-max scales
+#: (one for K, one for V) in separate [num_pages, page_size] arrays —
+#: the quantization grain is one written token row per kv page slot.
+_ELEM_BYTES = {"float32": 4, "bfloat16": 2, "float16": 2, "int8": 1}
+
 
 @dataclass(frozen=True)
 class KVCacheConfig:
@@ -36,7 +42,16 @@ class KVCacheConfig:
     block table (max context = pages_per_seq * page_size) and is the
     static gather width of every attention call — fixed per engine, so
     per-row attention math is identical no matter how the batch was
-    packed."""
+    packed.
+
+    ``dtype`` is the stored page value dtype. "int8" switches the pool
+    to quantized pages: per-layer device state grows per-slot fp32
+    scale arrays, the model quantizes K/V on write (abs-max over the
+    token row) and attention dequantizes through the same block table.
+    Admission math is unchanged — pages are pages — but one page costs
+    `page_bytes` HBM, so a FIXED byte budget holds ~2x the pages (and
+    resident batch) of bfloat16, ~4x of float32 (`pages_for_budget`).
+    """
 
     num_pages: int
     page_size: int
@@ -51,13 +66,52 @@ class KVCacheConfig:
             raise ValueError("need num_pages >= 1 and page_size >= 1")
         if self.pages_per_seq < 1:
             raise ValueError("pages_per_seq must be >= 1")
+        if self.dtype not in _ELEM_BYTES:
+            raise ValueError(
+                "kv page dtype must be one of %s, got %r"
+                % (sorted(_ELEM_BYTES), self.dtype))
 
     @property
     def max_context(self) -> int:
         return self.pages_per_seq * self.page_size
 
+    @property
+    def quantized(self) -> bool:
+        return self.dtype == "int8"
+
+    @property
+    def elem_bytes(self) -> int:
+        return _ELEM_BYTES[self.dtype]
+
+    @property
+    def page_bytes(self) -> int:
+        """HBM bytes ONE page costs across all layers: K + V values
+        plus, when int8, the two per-slot fp32 scale arrays."""
+        per_slot = 2 * self.num_kv_heads * self.head_dim * \
+            self.elem_bytes
+        if self.quantized:
+            per_slot += 2 * 4  # k/v per-slot fp32 abs-max scales
+        return self.num_layers * self.page_size * per_slot
+
+    @property
+    def pool_bytes(self) -> int:
+        """Total device bytes of the pool (`init_device_state`)."""
+        return self.num_pages * self.page_bytes
+
+    @property
+    def resident_batch(self) -> int:
+        """How many max-context sequences the pool can hold at once —
+        the effective resident batch at worst-case admission."""
+        return self.num_pages // self.pages_per_seq
+
     def pages_for(self, tokens: int) -> int:
         return -(-int(tokens) // self.page_size)
+
+    def pages_for_budget(self, budget_bytes: int) -> int:
+        """Pages a fixed HBM byte budget covers at THIS dtype — the
+        admission-doubling arithmetic: under one budget an int8 pool
+        admits ~2x the bfloat16 resident batch."""
+        return int(budget_bytes) // self.page_bytes
 
 
 @dataclass
@@ -146,12 +200,24 @@ class PagedKVCache:
 
     # -- device state ------------------------------------------------------
     def init_device_state(self):
-        """Fresh zeroed device pages: a list of (k_pages, v_pages) per
-        layer, each [num_pages, page_size, kv_heads, head_dim]."""
+        """Fresh zeroed device pages. Float dtypes: a list of
+        (k_pages, v_pages) per layer, each [num_pages, page_size,
+        kv_heads, head_dim] — structurally IDENTICAL to the pre-quant
+        pool, so float serving paths are untouched. int8: 4-tuples
+        (k_pages, v_pages, k_scale, v_scale) with int8 value arrays
+        and [num_pages, page_size] fp32 per-slot scales (identity 1.0
+        until a row is written)."""
         import jax.numpy as jnp
 
         c = self.config
         shape = (c.num_pages, c.page_size, c.num_kv_heads, c.head_dim)
+        if c.quantized:
+            sshape = (c.num_pages, c.page_size)
+            return [(jnp.zeros(shape, jnp.int8),
+                     jnp.zeros(shape, jnp.int8),
+                     jnp.ones(sshape, jnp.float32),
+                     jnp.ones(sshape, jnp.float32))
+                    for _ in range(c.num_layers)]
         return [(jnp.zeros(shape, c.dtype), jnp.zeros(shape, c.dtype))
                 for _ in range(c.num_layers)]
 
@@ -168,5 +234,14 @@ class PagedKVCache:
                           round(self.occupancy, 4))
             reg.set_gauge("serving.kv_peak_pages_in_use",
                           self._peak_in_use)
+            reg.set_gauge("serving.kv_page_dtype", self.config.dtype)
+            reg.set_gauge("serving.kv_page_bytes",
+                          self.config.page_bytes)
+            reg.set_gauge("serving.kv_bytes_in_use",
+                          self.pages_in_use * self.config.page_bytes)
+            reg.set_gauge("serving.kv_pool_bytes",
+                          self.config.pool_bytes)
+            reg.set_gauge("serving.kv_resident_batch",
+                          self.config.resident_batch)
         except Exception:  # noqa: BLE001 - telemetry must never gate
             pass

@@ -60,12 +60,13 @@ class TinyDecoderLM:
         self.attention_impl = attention_impl
 
     def kv_cache_spec(self, num_pages: int, page_size: int,
-                      pages_per_seq: int) -> KVCacheConfig:
+                      pages_per_seq: int,
+                      dtype: str = "float32") -> KVCacheConfig:
         c = self.config
         return KVCacheConfig(
             num_pages=num_pages, page_size=page_size,
             pages_per_seq=pages_per_seq, num_layers=c.layers,
-            num_kv_heads=c.kv_heads, head_dim=c.head_dim)
+            num_kv_heads=c.kv_heads, head_dim=c.head_dim, dtype=dtype)
 
     # -- params ------------------------------------------------------------
     def init_params(self, seed: int = 0) -> dict:
@@ -110,9 +111,18 @@ class TinyDecoderLM:
         """One serving step over a fixed-shape bucket.
 
         tokens [S, T] int32; pages: list of (k_pages, v_pages) per
-        layer; block_tables [S, pages_per_seq] int32; context_lens [S]
-        int32 (INCLUDING this call's q_lens tokens); q_lens [S] int32
-        (0 = inactive slot: nothing written, zero logits, token 0).
+        layer — or (k_pages, v_pages, k_scale, v_scale) 4-tuples from
+        an int8 pool (KVCacheConfig dtype="int8"), in which case new
+        K/V rows quantize on write (per-token-row abs-max / 127, the
+        scale scattered alongside) and attention dequantizes through
+        the same block table; block_tables [S, pages_per_seq] int32;
+        context_lens [S] int32 (INCLUDING this call's q_lens tokens);
+        q_lens [S] int32 (0 = inactive slot: nothing written, zero
+        logits, token 0).
+
+        Weights may be serving/quantize.py int8 entries — they
+        dequantize on use, so a `quantize_weights_int8` params pytree
+        drops in without touching the engine.
 
         Returns (next_tokens [S] int32 — greedy argmax at each
         sequence's last valid row, last_logits [S, vocab] f32,
@@ -121,6 +131,7 @@ class TinyDecoderLM:
         from jax import lax
 
         from ..ops.pallas import ragged_paged_attention
+        from .quantize import maybe_dequantize as _dq
 
         c = self.config
         S, T = tokens.shape
@@ -142,27 +153,59 @@ class TinyDecoderLM:
         page_ids = jnp.where(valid, page_of, num_pages)
         slot_ids = pos_c % page_size
 
-        x = params["emb"][tokens] + params["pos"][pos_c]   # [S, T, E]
+        emb = _dq(params["emb"])
+        x = emb[tokens] + params["pos"][pos_c]             # [S, T, E]
         new_pages: List = []
-        for layer, (k_pages, v_pages) in zip(params["layers"], pages):
+        for layer, entry in zip(params["layers"], pages):
             h = ln(x, layer["ln1_g"], layer["ln1_b"])
-            q = (h @ layer["wq"]).reshape(S, T, c.heads, c.head_dim)
-            k = (h @ layer["wk"]).reshape(S, T, c.kv_heads, c.head_dim)
-            v = (h @ layer["wv"]).reshape(S, T, c.kv_heads, c.head_dim)
-            k_pages = k_pages.at[page_ids, slot_ids].set(
-                k.astype(k_pages.dtype), mode="drop")
-            v_pages = v_pages.at[page_ids, slot_ids].set(
-                v.astype(v_pages.dtype), mode="drop")
-            new_pages.append((k_pages, v_pages))
-            attn = ragged_paged_attention(
-                q, k_pages, v_pages, block_tables, context_lens,
-                q_lens, impl=self.attention_impl)
-            x = x + attn.reshape(S, T, c.heads * c.head_dim) @ layer["wo"]
+            q = (h @ _dq(layer["wq"])).reshape(
+                S, T, c.heads, c.head_dim)
+            k = (h @ _dq(layer["wk"])).reshape(
+                S, T, c.kv_heads, c.head_dim)
+            v = (h @ _dq(layer["wv"])).reshape(
+                S, T, c.kv_heads, c.head_dim)
+            if len(entry) == 4:
+                # int8 pool: per-token-row abs-max quantize-on-write
+                k_pages, v_pages, k_scale, v_scale = entry
+                ks = jnp.max(jnp.abs(k), axis=(2, 3)) / 127.0  # [S, T]
+                vs = jnp.max(jnp.abs(v), axis=(2, 3)) / 127.0
+                ks = jnp.where(ks > 0, ks, 1.0)
+                vs = jnp.where(vs > 0, vs, 1.0)
+                kq = jnp.clip(jnp.round(k / ks[:, :, None, None]),
+                              -127, 127).astype(jnp.int8)
+                vq = jnp.clip(jnp.round(v / vs[:, :, None, None]),
+                              -127, 127).astype(jnp.int8)
+                k_pages = k_pages.at[page_ids, slot_ids].set(
+                    kq, mode="drop")
+                v_pages = v_pages.at[page_ids, slot_ids].set(
+                    vq, mode="drop")
+                k_scale = k_scale.at[page_ids, slot_ids].set(
+                    ks.astype(jnp.float32), mode="drop")
+                v_scale = v_scale.at[page_ids, slot_ids].set(
+                    vs.astype(jnp.float32), mode="drop")
+                new_pages.append((k_pages, v_pages, k_scale, v_scale))
+                attn = ragged_paged_attention(
+                    q, k_pages, v_pages, block_tables, context_lens,
+                    q_lens, impl=self.attention_impl,
+                    k_scale=k_scale, v_scale=v_scale)
+            else:
+                k_pages, v_pages = entry
+                k_pages = k_pages.at[page_ids, slot_ids].set(
+                    k.astype(k_pages.dtype), mode="drop")
+                v_pages = v_pages.at[page_ids, slot_ids].set(
+                    v.astype(v_pages.dtype), mode="drop")
+                new_pages.append((k_pages, v_pages))
+                attn = ragged_paged_attention(
+                    q, k_pages, v_pages, block_tables, context_lens,
+                    q_lens, impl=self.attention_impl)
+            x = x + attn.reshape(
+                S, T, c.heads * c.head_dim) @ _dq(layer["wo"])
             h2 = ln(x, layer["ln2_g"], layer["ln2_b"])
-            x = x + jnp.maximum(h2 @ layer["w1"], 0.0) @ layer["w2"]
+            x = x + jnp.maximum(
+                h2 @ _dq(layer["w1"]), 0.0) @ _dq(layer["w2"])
 
         x = ln(x, params["lnf_g"], params["lnf_b"])
-        logits = x @ params["emb"].T                       # [S, T, V]
+        logits = x @ emb.T                                 # [S, T, V]
         last = jnp.clip(q_lens - 1, 0, T - 1)
         last_logits = jnp.take_along_axis(
             logits, last[:, None, None], axis=1)[:, 0]     # [S, V]
@@ -182,12 +225,14 @@ def dense_decode_reference(model: TinyDecoderLM, params, prompt,
     import jax.numpy as jnp
 
     from ..ops.pallas import reference_attention
+    from .quantize import maybe_dequantize as _dq
 
     c = model.config
 
     def logits_for(ids: np.ndarray) -> np.ndarray:
         T = len(ids)
-        x = params["emb"][jnp.asarray(ids)] + params["pos"][:T]
+        emb = _dq(params["emb"])
+        x = emb[jnp.asarray(ids)] + params["pos"][:T]
 
         def ln(x, g, b):
             mu = jnp.mean(x, axis=-1, keepdims=True)
@@ -196,9 +241,11 @@ def dense_decode_reference(model: TinyDecoderLM, params, prompt,
 
         for layer in params["layers"]:
             h = ln(x, layer["ln1_g"], layer["ln1_b"])
-            q = (h @ layer["wq"]).reshape(T, c.heads, c.head_dim)
-            k = (h @ layer["wk"]).reshape(T, c.kv_heads, c.head_dim)
-            v = (h @ layer["wv"]).reshape(T, c.kv_heads, c.head_dim)
+            q = (h @ _dq(layer["wq"])).reshape(T, c.heads, c.head_dim)
+            k = (h @ _dq(layer["wk"])).reshape(
+                T, c.kv_heads, c.head_dim)
+            v = (h @ _dq(layer["wv"])).reshape(
+                T, c.kv_heads, c.head_dim)
             g = c.heads // c.kv_heads
             k = jnp.repeat(k, g, axis=1)
             v = jnp.repeat(v, g, axis=1)
@@ -206,11 +253,12 @@ def dense_decode_reference(model: TinyDecoderLM, params, prompt,
                 q.transpose(1, 0, 2)[None], k.transpose(1, 0, 2)[None],
                 v.transpose(1, 0, 2)[None], causal=True)
             x = x + o[0].transpose(1, 0, 2).reshape(
-                T, c.heads * c.head_dim) @ layer["wo"]
+                T, c.heads * c.head_dim) @ _dq(layer["wo"])
             h2 = ln(x, layer["ln2_g"], layer["ln2_b"])
-            x = x + jnp.maximum(h2 @ layer["w1"], 0.0) @ layer["w2"]
+            x = x + jnp.maximum(
+                h2 @ _dq(layer["w1"]), 0.0) @ _dq(layer["w2"])
         x = ln(x, params["lnf_g"], params["lnf_b"])
-        return np.asarray(x[-1] @ params["emb"].T)
+        return np.asarray(x[-1] @ emb.T)
 
     ids = list(int(t) for t in np.asarray(prompt).reshape(-1))
     out: List[int] = []

@@ -1,0 +1,98 @@
+"""Post-training int8 weight quantization for the serving runtime.
+
+The training-side blueprint is
+`fluid/contrib/slim/quantization/post_training_quantization.py`
+(abs-max calibration over a Program); this module is its
+serving-native counterpart for the functional param pytrees the
+Engine carries: selected weight tensors are replaced IN the pytree by
+``{"q": int8 array, "qscale": fp32 per-channel scale}`` dicts, and the
+model dequantizes on use (`maybe_dequantize`) — so the tensor lives in
+HBM (and travels through donation/AOT warmup) at one byte per element
+plus a per-channel scale, a ~4x reduction against fp32 params.
+
+Scheme: per-channel abs-max along the LAST axis (the output channels
+of every ``[in, out]`` matmul weight), `scale = amax / 127` kept with
+``keepdims`` so dequantization is a single broadcast multiply:
+
+    w ~= q.astype(f32) * qscale          # exact where representable
+
+Values of the form ``n * amax / 127`` (n integer, |n| <= 127)
+round-trip bit-exactly; everything else carries at most half-step
+error ``amax / 254`` per element.
+
+The quantized entry is a plain dict of ARRAYS — no string tags — so it
+stays a valid jax pytree under `jax.jit`/AOT lowering; detection is
+structural (the ``qscale`` key).
+"""
+from __future__ import annotations
+
+__all__ = ["quantize_tensor", "is_quantized", "maybe_dequantize",
+           "quantize_weights_int8", "weight_bytes",
+           "DEFAULT_WEIGHT_KEYS"]
+
+#: param-dict keys `quantize_weights_int8` converts by default: every
+#: matmul weight of TinyDecoderLM plus the (tied) embedding matrix.
+#: LayerNorm gains/biases and the positional table stay fp32 — tiny,
+#: and the sensitive tail of the numerics.
+DEFAULT_WEIGHT_KEYS = ("wq", "wk", "wv", "wo", "w1", "w2", "emb")
+
+
+def quantize_tensor(w):
+    """Abs-max per-channel int8 quantization of one weight tensor
+    (channel = last axis). Returns the ``{"q", "qscale"}`` entry."""
+    import jax.numpy as jnp
+
+    w = jnp.asarray(w)
+    axes = tuple(range(w.ndim - 1))
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axes,
+                   keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, jnp.float32(1.0))
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale),
+                 -127, 127).astype(jnp.int8)
+    return {"q": q, "qscale": scale.astype(jnp.float32)}
+
+
+def is_quantized(w) -> bool:
+    return isinstance(w, dict) and "qscale" in w
+
+
+def maybe_dequantize(w):
+    """f32 view of a (possibly quantized) weight entry; identity on
+    plain arrays, so unquantized params trace exactly as before."""
+    import jax.numpy as jnp
+
+    if is_quantized(w):
+        return w["q"].astype(jnp.float32) * w["qscale"]
+    return w
+
+
+def quantize_weights_int8(params, keys=DEFAULT_WEIGHT_KEYS):
+    """Walk a param pytree (nested dict/list) and quantize every
+    matrix stored under one of `keys`. Returns a NEW pytree; the input
+    is not mutated. Already-quantized entries pass through."""
+    keys = set(keys)
+
+    def walk(node, name=None):
+        if is_quantized(node):
+            return node
+        if isinstance(node, dict):
+            return {k: walk(v, k) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            out = [walk(v, name) for v in node]
+            return out if isinstance(node, list) else tuple(out)
+        if name in keys and getattr(node, "ndim", 0) >= 2:
+            return quantize_tensor(node)
+        return node
+
+    return walk(params)
+
+
+def weight_bytes(params) -> int:
+    """Device bytes of a param pytree — quantized entries count their
+    int8 payload plus the fp32 scales. The quant bench block's weight
+    lane reads this before/after `quantize_weights_int8`."""
+    import jax
+
+    return int(sum(x.size * x.dtype.itemsize
+                   for x in jax.tree_util.tree_leaves(params)
+                   if hasattr(x, "dtype")))

@@ -150,6 +150,14 @@ _FLAGS: Dict[str, object] = {
     # weights (param HBM and param all-gather ICI bytes ~halve). See
     # paddle_tpu/parallel/README.md "Mixed precision & ZeRO-2".
     "FLAGS_tpu_amp_level": "",
+    # Mixed-precision dtype override for decorate()'d programs: ""
+    # follows the decorate(amp_dtype=...) argument; "bfloat16" is the
+    # fp8 kill switch (a program decorated with amp_dtype="float8_e4m3"
+    # lowers EXACTLY like the bf16 one — byte-identical HLO, no scaling
+    # state); "float8_e4m3" force-enables the fp8 tier (bf16 carrier
+    # compute + e4m3 matmul operands / e5m2 grads with per-tensor
+    # delayed scaling). See parallel/README.md "Quantization tier".
+    "FLAGS_tpu_amp_dtype": "",
     # tpu-lint static SPMD verifier (paddle_tpu/analysis): run the
     # collective-divergence / donation-safety / host-sync /
     # zero1-invariants / zero2-lifetimes / dtype-contract checkers at
@@ -244,6 +252,18 @@ _FLAGS: Dict[str, object] = {
     # submit() backpressure: max queued (not yet admitted) requests;
     # 0 = unbounded (submit never blocks the caller)
     "FLAGS_tpu_serving_max_queue": 0,
+    # KV-cache page dtype: "float32" (exact; the pre-quantization
+    # lowering, byte-identical), "bfloat16", or "int8" (per-slot
+    # abs-max scales ride separate (num_pages, page_size) fp32 arrays;
+    # attention dequantizes in-kernel). int8 pages quarter the KV HBM
+    # bytes vs fp32 (half vs bf16), so the same page pool admits ~2x
+    # the resident batch. See serving/README.md "Quantization tier".
+    "FLAGS_tpu_serving_kv_dtype": "float32",
+    # post-training int8 weight quantization at Engine construction:
+    # selected matmul weights (serving/quantize.DEFAULT_WEIGHT_KEYS)
+    # are replaced by int8 payloads + per-channel fp32 abs-max scales
+    # and dequantized on use — ~4x fewer weight HBM bytes vs fp32.
+    "FLAGS_tpu_serving_quantize_weights": False,
 }
 
 

@@ -247,3 +247,43 @@ def test_pipeline_typed_int_boundary():
         losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]  # embedding/fc on stage 1 still learn
+
+
+def test_pipeline_bypass_records_structured_decline():
+    """The unified planner (sparse/TP/ZeRO-1) never runs for
+    _pipeline_cfg programs — the pipeline engine owns the partition.
+    That bypass must be a STRUCTURED decline on the program's fallback
+    trail (kind="pipeline_bypassed", surfaced by perf_analysis
+    --sharded-diff), recorded exactly once even across recompiles."""
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.utils.flags import get_flag, set_flags
+
+    old = get_flag("FLAGS_tpu_sharded_weight_update")
+    set_flags({"FLAGS_tpu_sharded_weight_update": True})
+    try:
+        main, startup, loss = _build(pipeline=True, n_micro=2)
+        scope = Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup, scope=scope)
+        r = np.random.RandomState(3)
+        feed = {"x": r.rand(32, 32).astype("float32"),
+                "label": r.randint(0, 10, (32, 1)).astype("int64")}
+        for _ in range(2):
+            exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+        # force a second compile of the same program (fresh fetch set)
+        exe.run(main, feed=feed, fetch_list=[], scope=scope)
+        trail = [e for e in (getattr(main, "_sharded_update_fallback",
+                                     None) or [])
+                 if e.get("kind") == "pipeline_bypassed"]
+        assert len(trail) == 1, trail
+        assert "plan_parallel" in trail[0]["reason"]
+        # the plain (non-pipeline) program records no such decline
+        main2, startup2, loss2 = _build(pipeline=False)
+        scope2 = Scope()
+        exe.run(startup2, scope=scope2)
+        exe.run(main2, feed=feed, fetch_list=[loss2], scope=scope2)
+        assert not [e for e in (getattr(
+            main2, "_sharded_update_fallback", None) or [])
+            if e.get("kind") == "pipeline_bypassed"]
+    finally:
+        set_flags({"FLAGS_tpu_sharded_weight_update": old})

@@ -378,7 +378,10 @@ def test_serving_events_schema_valid(tmp_path):
     assert req_ev["status"] == "finished"
     assert req_ev["output_tokens"] == 3
     st_ev = kinds["serving_step"][0]
-    assert {"running", "queue_depth", "kv_blocks_in_use"} <= set(st_ev)
+    assert {"running", "queue_depth", "kv_blocks_in_use",
+            "kv_page_dtype", "kv_page_bytes",
+            "resident_batch"} <= set(st_ev)
+    assert st_ev["kv_page_dtype"] == "float32"
 
 
 def test_bench_serving_leg_inprocess():
@@ -405,6 +408,237 @@ def test_bench_serving_leg_inprocess():
     assert out["value"] > 0
     assert out["serving"]["requests_submitted"] == 4
     assert out["serving"] == obs.registry().blocks()["serving"]
+
+
+# -- quantization tier: int8 KV pages + PTQ weights -------------------------
+
+def test_int8_attention_bounded_error_and_kernel_parity():
+    """int8 pages with per-slot scales: the reference attention stays
+    within bounded error of the float pages, and the Pallas kernel
+    (interpret mode on CPU) matches the quantized reference."""
+    from paddle_tpu.ops.pallas.ragged_paged_attention import (
+        ragged_paged_attention, ragged_paged_attention_reference)
+
+    r = np.random.RandomState(0)
+    S, Q, Hq, Hkv, D = 3, 4, 4, 2, 16
+    P, page, npp = 8, 8, 4
+    q = r.standard_normal((S, Q, Hq, D)).astype(np.float32)
+    kf = r.standard_normal((P, page, Hkv, D)).astype(np.float32)
+    vf = r.standard_normal((P, page, Hkv, D)).astype(np.float32)
+    tbl = r.randint(0, P, (S, npp)).astype(np.int32)
+    ctx = np.array([page * 2, 5, page * 4], np.int32)
+    ql = np.array([2, 4, 1], np.int32)
+    s_k = np.maximum(np.abs(kf).max(axis=(2, 3)), 1e-9) / 127.0
+    s_v = np.maximum(np.abs(vf).max(axis=(2, 3)), 1e-9) / 127.0
+    kq = np.clip(np.round(kf / s_k[:, :, None, None]), -127,
+                 127).astype(np.int8)
+    vq = np.clip(np.round(vf / s_v[:, :, None, None]), -127,
+                 127).astype(np.int8)
+
+    o_f = ragged_paged_attention_reference(q, kf, vf, tbl, ctx, ql)
+    o_q = ragged_paged_attention_reference(q, kq, vq, tbl, ctx, ql,
+                                           k_scale=s_k, v_scale=s_v)
+    err = float(np.max(np.abs(np.asarray(o_f) - np.asarray(o_q))))
+    assert err < 0.05, err
+    o_ker = ragged_paged_attention(q, kq, vq, tbl, ctx, ql,
+                                   impl="kernel", k_scale=s_k,
+                                   v_scale=s_v)
+    d = float(np.max(np.abs(np.asarray(o_ker) - np.asarray(o_q))))
+    assert d < 1e-5, d
+    # scale arrays are both-or-neither
+    with pytest.raises(ValueError, match="k_scale"):
+        ragged_paged_attention_reference(q, kq, vq, tbl, ctx, ql,
+                                         k_scale=s_k)
+
+
+def test_int8_page_roundtrip_bit_exact():
+    """Values of the form n * stored_scale (n integer in [-127, 127])
+    survive the quantize -> dequantize page round-trip bit-exactly."""
+    r = np.random.RandomState(1)
+    P, page, Hkv, D = 8, 8, 2, 16
+    sex = np.full((P, page), 2.0 / 127.0, np.float32)
+    n = r.randint(-127, 128, (P, page, Hkv, D))
+    kex = n.astype(np.float32) * sex[:, :, None, None]
+    kq = np.clip(np.round(kex / sex[:, :, None, None]), -127,
+                 127).astype(np.int8)
+    rt = kq.astype(np.float32) * sex[:, :, None, None]
+    assert np.array_equal(rt, kex)
+
+
+def test_int8_page_byte_census_and_admission():
+    """page_bytes: int8 pages cost elem bytes + per-slot fp32 scales —
+    under a FIXED pool byte budget that admits ~2x the bf16 resident
+    batch (~4x fp32). Device state: int8 layers are 4-tuples
+    (k, v, k_scale, v_scale); float layers stay 2-tuples (the
+    byte-identity of the unquantized path is structural)."""
+    import jax.numpy as jnp
+
+    kw = dict(num_pages=16, page_size=8, pages_per_seq=4, num_layers=2,
+              num_kv_heads=2, head_dim=16)
+    c32 = serving.KVCacheConfig(dtype="float32", **kw)
+    c16 = serving.KVCacheConfig(dtype="bfloat16", **kw)
+    c8 = serving.KVCacheConfig(dtype="int8", **kw)
+    # per slot: 2 (k+v) * Hkv * D * elem_bytes (+ 2*4 scale when int8)
+    assert c32.page_bytes == 2 * 8 * (2 * 2 * 16 * 4)
+    assert c16.page_bytes == 2 * 8 * (2 * 2 * 16 * 2)
+    assert c8.page_bytes == 2 * 8 * (2 * 2 * 16 * 1 + 2 * 4)
+    budget = c32.pool_bytes
+    p32, p16, p8 = (c.pages_for_budget(budget) for c in (c32, c16, c8))
+    assert p16 == 2 * p32
+    assert p8 >= 1.75 * p16          # ~2x minus the scale overhead
+    assert c8.resident_batch == kw["num_pages"] // kw["pages_per_seq"]
+    st8 = serving.PagedKVCache(c8).init_device_state()
+    assert len(st8[0]) == 4
+    assert st8[0][0].dtype == jnp.int8
+    assert st8[0][2].shape == (16, 8)
+    assert st8[0][2].dtype == jnp.float32
+    st32 = serving.PagedKVCache(c32).init_device_state()
+    assert len(st32[0]) == 2
+    with pytest.raises(ValueError, match="dtype"):
+        serving.KVCacheConfig(dtype="int4", **kw)
+
+
+def test_int8_engine_batched_bit_identical_and_stats():
+    """Continuous batching over int8 KV pages is bit-identical to
+    sequential decoding at the same page dtype, and the engine stats /
+    serving_step telemetry carry the quantization-tier fields."""
+    r = np.random.RandomState(2)
+    prompts = [r.randint(0, 48, size=n).astype(np.int32)
+               for n in (5, 9, 3, 12)]
+
+    def run(batched):
+        eng = _engine(kv_dtype="int8")
+        outs = []
+        if batched:
+            reqs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+            eng.run_until_idle()
+            outs = [list(q.output_tokens) for q in reqs]
+        else:
+            for p in prompts:
+                q = eng.submit(p, max_new_tokens=6)
+                eng.run_until_idle()
+                outs.append(list(q.output_tokens))
+        stats = eng.stats()
+        eng.close()
+        return outs, stats
+
+    batched, stats = run(True)
+    sequential, _ = run(False)
+    assert batched == sequential
+    assert stats["kv_page_dtype"] == "int8"
+    kvc = serving.KVCacheConfig(num_pages=96, page_size=4,
+                                pages_per_seq=12, num_layers=2,
+                                num_kv_heads=2, head_dim=8,
+                                dtype="int8")
+    assert stats["kv_page_bytes"] == kvc.page_bytes
+    # pages_per_seq = ceil(max_seq 48 / page_size 4) = 12
+    assert stats["kv_resident_batch"] == 96 // 12
+    snap = obs.registry().snapshot()
+    assert snap["gauges"].get("serving.kv_page_dtype") == "int8"
+
+
+def test_int8_engine_step_events_schema_valid(tmp_path):
+    """serving_step records from an int8 engine validate against the
+    locked schema and carry kv_page_dtype='int8'."""
+    obs.configure(telemetry_dir=str(tmp_path), rank=0)
+    eng = _engine(kv_dtype="int8", max_seqs=4)
+    eng.submit(np.arange(1, 6, dtype=np.int32), max_new_tokens=3)
+    eng.run_until_idle()
+    eng.close()
+    recs = []
+    for name in os.listdir(tmp_path):
+        if name.endswith(".jsonl"):
+            with open(os.path.join(tmp_path, name)) as f:
+                recs.extend(json.loads(ln) for ln in f if ln.strip())
+    problems = obs.validate_records(recs, obs.load_schema(
+        os.path.join(_REPO, "tools", "telemetry_schema.json")))
+    assert problems == []
+    steps = [r for r in recs if r.get("kind") == "event"
+             and r.get("event") == "serving_step"]
+    assert steps and steps[0]["kv_page_dtype"] == "int8"
+    assert steps[0]["kv_page_bytes"] >= 0
+    assert steps[0]["resident_batch"] > 0
+
+
+def test_ptq_weights_roundtrip_and_engine_golden():
+    """Post-training int8 weight quantization: ~4x byte reduction over
+    the quantized subset, identity on unquantized leaves, and the
+    quantized-weight engine decodes bit-identically to the dense
+    reference run on the SAME quantized params (batched == sequential
+    included)."""
+    from paddle_tpu.serving.quantize import (is_quantized,
+                                             maybe_dequantize,
+                                             quantize_tensor,
+                                             quantize_weights_int8)
+
+    params = _params()
+    qparams = quantize_weights_int8(params)
+
+    def census(dense, quant):
+        if is_quantized(quant):
+            return (int(np.asarray(dense).nbytes),
+                    int(np.asarray(quant["q"]).nbytes)
+                    + int(np.asarray(quant["qscale"]).nbytes))
+        if isinstance(dense, dict):
+            pairs = [census(dense[k], quant[k]) for k in dense]
+        elif isinstance(dense, (list, tuple)):
+            pairs = [census(d, q) for d, q in zip(dense, quant)]
+        else:
+            return (0, 0)
+        return (sum(a for a, _ in pairs), sum(b for _, b in pairs))
+
+    dense_b, quant_b = census(params, qparams)
+    assert dense_b > 0
+    assert quant_b * 3.5 <= dense_b
+    # per-tensor: abs-max per output channel, bounded dequant error
+    w = np.asarray(params["layers"][0]["wq"])
+    qt = quantize_tensor(w)
+    assert np.asarray(qt["q"]).dtype == np.int8
+    err = np.max(np.abs(np.asarray(maybe_dequantize(qt)) - w))
+    assert err <= np.abs(w).max() / 127.0 * 0.5 + 1e-7
+    # identity on plain arrays: unquantized traces are unchanged
+    assert maybe_dequantize(w) is w
+
+    r = np.random.RandomState(3)
+    prompts = [r.randint(0, 48, size=n).astype(np.int32)
+               for n in (4, 8, 3)]
+
+    def run(batched):
+        eng = serving.Engine(_MODEL, params=_params(),
+                             config=serving.EngineConfig(
+                                 num_pages=96, page_size=4, max_seqs=6,
+                                 kv_dtype="int8",
+                                 quantize_weights=True))
+        outs = []
+        if batched:
+            reqs = [eng.submit(p, max_new_tokens=5) for p in prompts]
+            eng.run_until_idle()
+            outs = [list(q.output_tokens) for q in reqs]
+        else:
+            for p in prompts:
+                q = eng.submit(p, max_new_tokens=5)
+                eng.run_until_idle()
+                outs.append(list(q.output_tokens))
+        eng.close()
+        return outs
+
+    batched = run(True)
+    assert batched == run(False)
+    golden = serving.dense_decode_reference(_MODEL, qparams,
+                                            prompts[0], 5)
+    assert batched[0] == golden
+
+
+def test_float_kv_state_structurally_unchanged():
+    """Kill-switch guarantee: at the default float page dtype the
+    device state, engine stats and step records are EXACTLY the
+    pre-quantization shapes — 2-tuple layers, no scale arrays."""
+    eng = _engine()
+    cfg = eng.kv.config
+    assert cfg.dtype == "float32" and not cfg.quantized
+    layers = serving.PagedKVCache(cfg).init_device_state()
+    assert all(len(entry) == 2 for entry in layers)
+    eng.close()
 
 
 # -- lint: the decode loop has no per-token host sync -----------------------

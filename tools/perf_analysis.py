@@ -14,6 +14,7 @@ PERF_ANALYSIS_r4.md with:
 
 Usage: python tools/perf_analysis.py [--batches 256,512]
        python tools/perf_analysis.py --sharded-diff
+       python tools/perf_analysis.py --quant
        python tools/perf_analysis.py --embedding
        python tools/perf_analysis.py --overlap-audit [--bucket-mb 0.25]
        python tools/perf_analysis.py --hierarchy [--dcn 2]
@@ -97,6 +98,18 @@ ICI bytes ~halve and the optimizer state ~1/N, and writes
 artifacts/sharded_update_diff.json — the no-chip evidence the
 acceptance criteria call for. Exits nonzero when the reduction does
 not hold.
+
+`--quant` is the offline evidence for the quantization tier (fp8
+training + int8 serving): it lowers the DP BERT-tiny step under
+`decorate(amp_dtype="float8_e4m3")`, asserts the StableHLO carries
+f8e4m3/f8e5m2 converts while `FLAGS_tpu_amp_dtype="bfloat16"`
+reproduces the plain-bf16 lowering byte-for-byte, records the measured
+fp8 scale-state bytes beside the MODELED (labeled) e4m3 operand /
+e5m2 grad-wire lanes, then runs the int8 serving census — KV page
+bytes per dtype, resident-batch admission under a fixed pool budget
+(~2x bf16), PTQ weight bytes over the quantized subset (~4x), and the
+int8-engine batched==sequential identity. Writes
+artifacts/quant_diff.json; exits nonzero when any claim fails.
 
 `--embedding` is the same-shape check for the vocab-sharded embedding
 engine (FLAGS_tpu_sparse_embedding, paddle_tpu/embedding): it lowers
@@ -529,6 +542,43 @@ def sharded_update_diff(batch=16, seq_len=32):
         "unexplained_params": unexplained,
     }
 
+    # fourth leg: a PipelineOptimizer program under the same ZeRO flag.
+    # The pipeline engine owns the program partition, so plan_parallel
+    # never runs — that bypass must be a structured
+    # kind="pipeline_bypassed" decline on the trail, not silence
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.core import scope as scope_mod
+    from paddle_tpu.fluid import framework
+    from paddle_tpu.utils.flags import set_flags
+
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    scope_mod._global_scope = scope_mod.Scope()
+    set_flags({"FLAGS_tpu_sharded_weight_update": True})
+    with framework.unique_name_guard():
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1],
+                                  dtype="int64")
+        h = fluid.layers.fc(input=x, size=16, act="relu")
+        logits = fluid.layers.fc(input=h, size=4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.PipelineOptimizer(
+            fluid.optimizer.SGDOptimizer(learning_rate=0.1),
+            cut_list=[[h]], num_microbatches=2).minimize(loss)
+        prog_pp = fluid.default_main_program()
+        exe_pp = fluid.Executor(fluid.TPUPlace())
+        exe_pp.run(fluid.default_startup_program())
+        r = np.random.RandomState(0)
+        exe_pp.run(prog_pp,
+                   feed={"x": r.rand(8, 16).astype("float32"),
+                         "label": r.randint(0, 4, (8, 1)).astype(
+                             "int64")},
+                   fetch_list=[loss])
+    pp_trail = [dict(e) for e in
+                (getattr(prog_pp, "_sharded_update_fallback", None)
+                 or []) if e.get("kind") == "pipeline_bypassed"]
+
     out = {
         "model": "bert-tiny b%d s%d" % (batch, seq_len),
         "ndev": col_off.get("ndev"),
@@ -544,6 +594,7 @@ def sharded_update_diff(batch=16, seq_len=32):
                 don_on.get("opt_state_per_replica_bytes")},
         "fallback_reasons": fallback,
         "model_parallel": mp_block,
+        "pipeline": {"bypassed": pp_trail},
     }
     path = os.path.join(_REPO, "artifacts", "sharded_update_diff.json")
     os.makedirs(os.path.dirname(path), exist_ok=True)
@@ -556,7 +607,8 @@ def sharded_update_diff(batch=16, seq_len=32):
           <= 0.2 * don_on["opt_state_logical_bytes"]
           and don_on.get("aliases_state")
           and mp_block["sharded_params"]
-          and not unexplained)
+          and not unexplained
+          and len(pp_trail) == 1)
     print("sharded-update diff (%s): grad ICI %d -> %d bytes "
           "(%.2fx), opt state/replica %s -> %s bytes; %s; wrote %s"
           % (out["model"], grad_off, grad_on,
@@ -579,17 +631,203 @@ def sharded_update_diff(batch=16, seq_len=32):
     for f in tp_declined:
         print("  [tp_declined] %s (var=%s op=%s)"
               % (f["reason"], f["var"], f["op"]))
+    print("pipeline bypass: %d structured decline(s)%s"
+          % (len(pp_trail),
+             " <- " + pp_trail[0]["reason"] if pp_trail
+             else " (MISSING — the bypass was silent)"))
     return 0 if ok else 1
 
 
-def _bert_tiny_step(batch, seq_len, flags, amp=False, run=True):
+def quant_diff(batch=8, seq_len=32):
+    """Offline evidence for the quantization tier (fp8 training + int8
+    serving). Training lane: lowers the DP BERT-tiny step under
+    ``decorate(amp_dtype="float8_e4m3")`` (ZeRO-1 + 0.25 MB buckets),
+    asserts the lowered StableHLO actually carries f8e4m3/f8e5m2
+    converts, that the ``FLAGS_tpu_amp_dtype="bfloat16"`` kill switch
+    reproduces the plain-bf16 lowering BYTE-FOR-BYTE, and records the
+    measured scale-state footprint beside the MODELED (labeled) e4m3
+    operand / e5m2 grad-wire byte lanes from donation_report /
+    collective_report. Serving lane: the int8 KV page byte census vs
+    f32/bf16 at fixed geometry, the resident-batch admission a fixed
+    pool budget buys per dtype, the PTQ weight census over the
+    quantized subset, and the int8-engine batched==sequential identity.
+    Writes artifacts/quant_diff.json; exits nonzero when any reduction
+    or identity does not hold."""
+    import json
+
+    base_flags = {"FLAGS_tpu_sharded_weight_update": True,
+                  "FLAGS_tpu_comm_bucket_mb": 0.25,
+                  "FLAGS_tpu_amp_dtype": ""}
+
+    def hlo_of(exe, prog, feed, total):
+        got = exe._cached_lowerable(prog, feed, [total], None)
+        return got[1].as_text()
+
+    # fp8 lowering
+    exe8, prog8, feed8, total8 = _bert_tiny_step(
+        batch, seq_len, dict(base_flags), amp=True,
+        amp_dtype="float8_e4m3")
+    hlo8 = hlo_of(exe8, prog8, feed8, total8)
+    don8 = exe8.donation_report(prog8, feed=feed8, fetch_list=[total8])
+    col8 = exe8.collective_report(prog8, feed=feed8,
+                                  fetch_list=[total8])
+    # plain bf16 baseline
+    exeb, progb, feedb, totalb = _bert_tiny_step(
+        batch, seq_len, dict(base_flags), amp=True)
+    hlob = hlo_of(exeb, progb, feedb, totalb)
+    # kill switch: fp8-decorated program under the bf16 flag override
+    ks_flags = dict(base_flags)
+    ks_flags["FLAGS_tpu_amp_dtype"] = "bfloat16"
+    exek, progk, feedk, totalk = _bert_tiny_step(
+        batch, seq_len, ks_flags, amp=True, amp_dtype="float8_e4m3")
+    hlok = hlo_of(exek, progk, feedk, totalk)
+    from paddle_tpu.utils.flags import set_flags
+
+    set_flags({"FLAGS_tpu_amp_dtype": ""})
+
+    low = hlo8.lower()
+    has_e4m3 = "f8e4m3" in low
+    has_e5m2 = "f8e5m2" in low
+    kill_exact = hlok == hlob
+    wire = (col8 or {}).get("fp8_wire") or {}
+    fp8 = {
+        "sites": {"inputs": don8.get("fp8_site_inputs", 0),
+                  "grads": don8.get("fp8_site_grads", 0)},
+        "state_bytes": don8.get("fp8_state_bytes", 0),
+        "operand_bytes": {
+            "carrier_measured": don8.get("fp8_operand_carrier_bytes"),
+            "e4m3_modeled": don8.get("fp8_operand_bytes_modeled")},
+        "grad_wire": wire,
+        "hlo_has_e4m3_convert": has_e4m3,
+        "hlo_has_e5m2_convert": has_e5m2,
+        "kill_switch_hlo_byte_identical": kill_exact,
+    }
+
+    # -- int8 serving lane -------------------------------------------
+    import numpy as np
+    from paddle_tpu.serving.engine import Engine, EngineConfig
+    from paddle_tpu.serving.kv_cache import KVCacheConfig
+    from paddle_tpu.serving.model import TinyDecoderLM, TinyLMConfig
+    from paddle_tpu.serving.quantize import (is_quantized,
+                                             quantize_weights_int8)
+
+    geom = dict(num_pages=64, page_size=8, pages_per_seq=4,
+                num_layers=2, num_kv_heads=2, head_dim=16)
+    cfgs = {d: KVCacheConfig(dtype=d, **geom)
+            for d in ("float32", "bfloat16", "int8")}
+    budget = cfgs["float32"].pool_bytes
+    pages = {d: c.pages_for_budget(budget) for d, c in cfgs.items()}
+    page_bytes = {d: c.page_bytes for d, c in cfgs.items()}
+
+    mcfg = TinyLMConfig()
+    model = TinyDecoderLM(mcfg, attention_impl="reference")
+    params = model.init_params(0)
+    qparams = quantize_weights_int8(params)
+
+    def subset(dense, quant):
+        """(dense_bytes, quant_bytes) over the tensors PTQ replaced."""
+        if is_quantized(quant):
+            return (int(np.asarray(dense).nbytes),
+                    int(np.asarray(quant["q"]).nbytes)
+                    + int(np.asarray(quant["qscale"]).nbytes))
+        if isinstance(dense, dict):
+            pairs = [subset(dense[k], quant[k]) for k in dense]
+        elif isinstance(dense, (list, tuple)):
+            pairs = [subset(d, q) for d, q in zip(dense, quant)]
+        else:
+            return (0, 0)
+        return (sum(p[0] for p in pairs), sum(p[1] for p in pairs))
+
+    w_dense, w_quant = subset(params, qparams)
+
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(1, mcfg.vocab, n)) for n in (5, 9, 3)]
+
+    def run_engine(batched):
+        m = TinyDecoderLM(mcfg, attention_impl="reference")
+        eng = Engine(m, params=m.init_params(0),
+                     config=EngineConfig.from_flags(
+                         num_pages=64, page_size=8, max_seqs=4,
+                         kv_dtype="int8", quantize_weights=True))
+        outs = []
+        if batched:
+            reqs = [eng.submit(np.asarray(p, np.int32),
+                               max_new_tokens=6) for p in prompts]
+            eng.run_until_idle()
+            outs = [list(r.output_tokens) for r in reqs]
+        else:
+            for p in prompts:
+                r = eng.submit(np.asarray(p, np.int32),
+                               max_new_tokens=6)
+                eng.run_until_idle()
+                outs.append(list(r.output_tokens))
+        eng.close()
+        return outs
+
+    batched_eq_sequential = run_engine(True) == run_engine(False)
+    int8_serving = {
+        "kv_page_bytes": page_bytes,
+        "pool_budget_bytes": budget,
+        "resident_pages_at_budget": pages,
+        "admission_ratio_int8_vs_bf16":
+            pages["int8"] / max(pages["bfloat16"], 1),
+        "weight_bytes_quantized_subset": {
+            "dense": w_dense, "int8_plus_scales": w_quant},
+        "engine_batched_eq_sequential": batched_eq_sequential,
+    }
+
+    out = {
+        "model": "bert-tiny b%d s%d / tiny-lm serving" % (batch,
+                                                          seq_len),
+        "ndev": (col8 or {}).get("ndev"),
+        "fp8_training": fp8,
+        "int8_serving": int8_serving,
+    }
+    path = os.path.join(_REPO, "artifacts", "quant_diff.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2, sort_keys=True)
+    carrier = don8.get("fp8_operand_carrier_bytes") or 0
+    modeled = don8.get("fp8_operand_bytes_modeled") or 0
+    ok = (fp8["sites"]["inputs"] > 0 and fp8["sites"]["grads"] > 0
+          and fp8["state_bytes"] > 0
+          and has_e4m3 and has_e5m2 and kill_exact
+          and modeled > 0 and carrier >= 2 * modeled
+          and wire.get("grad_sync_wire_bytes_e5m2", 0) > 0
+          and wire.get("grad_sync_wire_bytes_e5m2", 0)
+          == wire.get("grad_sync_wire_bytes", -1)
+          // max(wire.get("carrier_itemsize", 1), 1)
+          and page_bytes["int8"] < page_bytes["bfloat16"]
+          < page_bytes["float32"]
+          and pages["int8"] >= 1.6 * pages["bfloat16"]
+          and w_quant * 3.5 <= w_dense
+          and batched_eq_sequential)
+    print("quant diff: fp8 %d+%d sites (state %dB), e4m3/e5m2 "
+          "converts %s/%s, kill-switch HLO identical=%s, operand "
+          "%d -> %d B (modeled); int8 pages %s B (f32/bf16/int8 "
+          "admission %s), PTQ weights %d -> %d B (%.2fx), "
+          "batched==sequential=%s -> %s; wrote %s"
+          % (fp8["sites"]["inputs"], fp8["sites"]["grads"],
+             fp8["state_bytes"], has_e4m3, has_e5m2, kill_exact,
+             carrier, modeled,
+             [page_bytes[d] for d in ("float32", "bfloat16", "int8")],
+             [pages[d] for d in ("float32", "bfloat16", "int8")],
+             w_dense, w_quant, w_dense / max(w_quant, 1),
+             batched_eq_sequential,
+             "OK" if ok else "MISMATCH", path))
+    return 0 if ok else 1
+
+
+def _bert_tiny_step(batch, seq_len, flags, amp=False, run=True,
+                    amp_dtype=None):
     """One compiled data-parallel BERT-tiny Adam step under `flags`;
     returns the serving Executor + program + feed (for the report
     APIs). Fresh programs/scope per call so flag changes recompile.
     `amp`: mixed_precision.decorate the optimizer (O2 masters, static
-    scaling — the bench's AMP shape). `run=False` skips the train-step
-    dispatch (the OOM pre-flight leg needs a program that FAILS before
-    its first dispatch)."""
+    scaling — the bench's AMP shape); `amp_dtype` selects the decorate
+    tier (e.g. "float8_e4m3" for the fp8 qdq lowering). `run=False`
+    skips the train-step dispatch (the OOM pre-flight leg needs a
+    program that FAILS before its first dispatch)."""
     import paddle_tpu.fluid as fluid
     from paddle_tpu.core import scope as scope_mod
     from paddle_tpu.fluid import framework
@@ -611,8 +849,9 @@ def _bert_tiny_step(batch, seq_len, flags, amp=False, run=True):
         if amp:
             from paddle_tpu.fluid.contrib import mixed_precision
 
+            kw = {"amp_dtype": amp_dtype} if amp_dtype else {}
             opt = mixed_precision.decorate(
-                opt, use_dynamic_loss_scaling=False)
+                opt, use_dynamic_loss_scaling=False, **kw)
         opt.minimize(total)
         prog = fluid.default_main_program()
         fluid.CompiledProgram(prog).with_data_parallel(
@@ -1281,6 +1520,8 @@ def main():
             [a for a in args if a != "--lint"]))
     if "--sharded-diff" in args:
         raise SystemExit(sharded_update_diff())
+    if "--quant" in args:
+        raise SystemExit(quant_diff())
     if "--embedding" in args:
         raise SystemExit(embedding_diff())
 
