@@ -1099,8 +1099,13 @@ def _bench_serving(n_requests: int = 24, seed: int = 0) -> dict:
     model = serving.TinyDecoderLM(serving.TinyLMConfig())
     engine = serving.Engine(model, config=serving.EngineConfig.from_flags(
         num_pages=256, page_size=8, max_seqs=8))
+    # per-tenant system prompts exercise the prefix-cache lane, and a
+    # priority class skew exercises the preemption path when the pool
+    # is tight — the block's reuse ratio / preemption fields go live
     trace = serving.synthetic_trace(n_requests=n_requests, seed=seed,
-                                    vocab=model.config.vocab)
+                                    vocab=model.config.vocab,
+                                    system_prompt_range=(12, 20),
+                                    tenant_priorities=(1, 0, 0))
     summary = serving.run_trace(engine, trace)
     block = publish.serving_block()
     return {

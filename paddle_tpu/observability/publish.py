@@ -509,11 +509,28 @@ def serving_block() -> Optional[dict]:
         "kv_page_bytes": gauges.get("serving.kv_page_bytes"),
         "kv_pool_bytes": gauges.get("serving.kv_pool_bytes"),
         "kv_resident_batch": gauges.get("serving.kv_resident_batch"),
+        # prefix-cache / preemption lane: prompt tokens the cache
+        # covered (never prefilled), the prefill tokens actually
+        # dispatched, their reuse ratio, copy-on-write page copies,
+        # cached-tier occupancy/evictions, and priority preemptions
+        "prefix_cache": gauges.get("serving.kv_prefix_cache"),
+        "prefix_hit_tokens": counters.get(
+            "serving.prefix_hit_tokens", 0),
+        "prefill_tokens": counters.get("serving.prefill_tokens", 0),
+        "prefix_reuse_ratio": round(
+            counters.get("serving.prefix_hit_tokens", 0)
+            / max(1, counters.get("serving.prefix_hit_tokens", 0)
+                  + counters.get("serving.prefill_tokens", 0)), 4),
+        "kv_pages_cached": gauges.get("serving.kv_pages_cached"),
+        "kv_cow_copies": gauges.get("serving.kv_cow_copies"),
+        "kv_prefix_evictions": gauges.get("serving.kv_evictions"),
+        "preemptions": counters.get("serving.preemptions", 0),
     }
     reg.publish_block("serving", block)
     print("BENCH serving: %.1f tok/s, %d req (%d finished / %d "
           "cancelled), latency p50=%.1fms p99=%.1fms, queue mean=%.1f "
-          "max=%s, kv peak=%s (%s pages, %s B/page)"
+          "max=%s, kv peak=%s (%s pages, %s B/page), prefix reuse=%s "
+          "(%s hit tok, %s cow), preemptions=%s"
           % (block["tokens_per_sec"] or 0.0,
              block["requests_submitted"], block["requests_finished"],
              block["requests_cancelled"],
@@ -523,7 +540,10 @@ def serving_block() -> Optional[dict]:
              "%s/%s" % (block["kv_peak_pages_in_use"],
                         block["kv_pages_total"]),
              block["kv_page_dtype"] or "float32",
-             block["kv_page_bytes"]), flush=True)
+             block["kv_page_bytes"],
+             block["prefix_reuse_ratio"],
+             block["prefix_hit_tokens"], block["kv_cow_copies"],
+             block["preemptions"]), flush=True)
     return block
 
 

@@ -28,7 +28,7 @@ Pallas on TPU, jittable pure-JAX reference on CPU tier-1).
 from .engine import Engine, EngineConfig  # noqa: F401
 from .kv_cache import KVCacheConfig, PagedKVCache  # noqa: F401
 from .model import (TinyDecoderLM, TinyLMConfig,  # noqa: F401
-                    dense_decode_reference)
+                    dense_decode_reference, sample_tokens)
 from .scheduler import (BucketPlan, Request,  # noqa: F401
                         RequestState, Scheduler)
 from .trace import run_trace, synthetic_trace  # noqa: F401
@@ -36,6 +36,7 @@ from .trace import run_trace, synthetic_trace  # noqa: F401
 __all__ = [
     "Engine", "EngineConfig", "KVCacheConfig", "PagedKVCache",
     "TinyDecoderLM", "TinyLMConfig", "dense_decode_reference",
-    "BucketPlan", "Request", "RequestState", "Scheduler",
+    "sample_tokens", "BucketPlan", "Request", "RequestState",
+    "Scheduler",
     "run_trace", "synthetic_trace",
 ]

@@ -21,7 +21,10 @@ __all__ = ["BucketCompiler"]
 class BucketCompiler:
     """Holds the jitted step function and its per-bucket AOT
     executables. `step` signature: (params, pages, tokens [B, T],
-    block_tables [B, NP], context_lens [B], q_lens [B])."""
+    block_tables [B, NP], context_lens [B], q_lens [B], temps [B] f32,
+    top_ks [B], top_ps [B] f32, seeds [B], steps [B]) — the trailing
+    five are the per-row sampling operands (model.sample_tokens);
+    greedy rows ride the same executable with temperature 0."""
 
     def __init__(self, jitted_step, pages_per_seq: int):
         self._jitted = jitted_step
@@ -34,11 +37,16 @@ class BucketCompiler:
         import jax.numpy as jnp
 
         B, T = bucket
-        i32 = jnp.int32
+        i32, f32 = jnp.int32, jnp.float32
         return (jax.ShapeDtypeStruct((B, T), i32),
                 jax.ShapeDtypeStruct((B, self._pages_per_seq), i32),
                 jax.ShapeDtypeStruct((B,), i32),
-                jax.ShapeDtypeStruct((B,), i32))
+                jax.ShapeDtypeStruct((B,), i32),
+                jax.ShapeDtypeStruct((B,), f32),   # temperatures
+                jax.ShapeDtypeStruct((B,), i32),   # top_ks
+                jax.ShapeDtypeStruct((B,), f32),   # top_ps
+                jax.ShapeDtypeStruct((B,), i32),   # seeds
+                jax.ShapeDtypeStruct((B,), i32))   # stream indices
 
     def compile_bucket(self, bucket: Tuple[int, int], params, pages,
                        source: Optional[str] = None):
@@ -80,13 +88,14 @@ class BucketCompiler:
         return report
 
     def __call__(self, bucket: Tuple[int, int], params, pages, tokens,
-                 block_tables, context_lens, q_lens):
+                 block_tables, context_lens, q_lens, temps, top_ks,
+                 top_ps, seeds, steps):
         """Dispatch one bucket: the AOT executable when warmed, else
         the jitted function (jax compiles + caches by shape)."""
         fn = self._compiled.get((int(bucket[0]), int(bucket[1])),
                                 self._jitted)
         return fn(params, pages, tokens, block_tables, context_lens,
-                  q_lens)
+                  q_lens, temps, top_ks, top_ps, seeds, steps)
 
     @property
     def compiled_buckets(self):

@@ -56,6 +56,8 @@ class EngineConfig:
     step_event_every: int = 1
     kv_dtype: str = "float32"          # "float32" | "bfloat16" | "int8"
     quantize_weights: bool = False     # PTQ int8 params at init
+    prefix_cache: bool = True          # share/COW prompt-prefix pages
+    aging_steps: int = 32              # priority aging (0 disables)
 
     @staticmethod
     def from_flags(**overrides) -> "EngineConfig":
@@ -72,6 +74,10 @@ class EngineConfig:
                 "FLAGS_tpu_serving_kv_dtype", "float32") or "float32"),
             quantize_weights=bool(get_flag(
                 "FLAGS_tpu_serving_quantize_weights", False)),
+            prefix_cache=bool(get_flag(
+                "FLAGS_tpu_serving_prefix_cache", True)),
+            aging_steps=int(get_flag(
+                "FLAGS_tpu_serving_aging_steps", 32)),
         )
         kw.update(overrides)
         return EngineConfig(**kw)
@@ -120,13 +126,15 @@ class Engine:
         pages_per_seq = -(-int(max_ctx) // self.config.page_size)
         self.kv = PagedKVCache(model.kv_cache_spec(
             self.config.num_pages, self.config.page_size,
-            pages_per_seq, dtype=self.config.kv_dtype))
+            pages_per_seq, dtype=self.config.kv_dtype),
+            prefix_cache=self.config.prefix_cache)
         self.plan = BucketPlan.from_flags(
             self.config.max_seqs, self.kv.config.max_context)
         self.scheduler = Scheduler(self.kv, self.plan,
                                    self.config.max_seqs,
                                    self.config.max_queue,
-                                   max_context=max_ctx)
+                                   max_context=max_ctx,
+                                   aging_steps=self.config.aging_steps)
         self.pages = self.kv.init_device_state()
         self._lock = threading.RLock()
         self._steps = 0
@@ -143,6 +151,8 @@ class Engine:
 
         donate = bool(get_flag("FLAGS_tpu_donate_buffers", True)) and \
             cc.donation_safe()
+        self._donate = donate
+        self._copy_fn = None  # lazy-jitted COW page copier
 
         # memoized on the model object: two engines over the SAME model
         # (a restart, the sequential-reference twin in tests) share
@@ -157,10 +167,12 @@ class Engine:
         if self._jitted is None or \
                 getattr(model, "_serving_jitted_key", None) != memo_key:
             def _step(params, pages, tokens, block_tables,
-                      context_lens, q_lens, _model=model):
-                return _model.forward(params, tokens, pages,
-                                      block_tables, context_lens,
-                                      q_lens)
+                      context_lens, q_lens, temps, top_ks, top_ps,
+                      seeds, steps, _model=model):
+                return _model.forward(
+                    params, tokens, pages, block_tables, context_lens,
+                    q_lens,
+                    sampling=(temps, top_ks, top_ps, seeds, steps))
 
             self._jitted = jax.jit(
                 _step, donate_argnums=(1,) if donate else ())
@@ -171,10 +183,22 @@ class Engine:
 
     # -- public verbs ------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 16,
-               eos_id: Optional[int] = None, tenant: str = "") -> Request:
+               eos_id: Optional[int] = None, tenant: str = "",
+               priority: int = 0, temperature: float = 0.0,
+               top_k: int = 0, top_p: float = 1.0, seed: int = 0,
+               sample_step_offset: int = 0) -> Request:
         """Enqueue one generation request (thread-safe). Raises when
         the prompt exceeds max context or the bounded queue is full
-        (FLAGS_tpu_serving_max_queue)."""
+        (FLAGS_tpu_serving_max_queue).
+
+        `priority` is the scheduling class (higher preempts strictly
+        lower — see scheduler.Scheduler.admit). `temperature` > 0
+        samples via a per-request `seed` folded with the token index
+        (temperature 0 = greedy argmax, the default); `top_k` /
+        `top_p` filter the distribution first. `sample_step_offset`
+        is the drain/adopt continuation hook: tokens the stream
+        already emitted elsewhere, so a migrated sampled stream keeps
+        drawing the same per-index keys."""
         with self._lock:
             # inside the lock: a submit racing close() must not land a
             # request no step() will ever retire (its stream would
@@ -185,8 +209,11 @@ class Engine:
                 raise RuntimeError(
                     "engine is draining (preemption notice) — "
                     "resubmit on the survivor")
-            req = self.scheduler.new_request(prompt, max_new_tokens,
-                                             eos_id=eos_id, tenant=tenant)
+            req = self.scheduler.new_request(
+                prompt, max_new_tokens, eos_id=eos_id, tenant=tenant,
+                priority=priority, temperature=temperature,
+                top_k=top_k, top_p=top_p, seed=seed,
+                sample_step_offset=sample_step_offset)
         self._reg_safe(lambda r: r.inc("serving.requests_submitted"))
         return req
 
@@ -219,22 +246,58 @@ class Engine:
         with self._lock:
             for req in self.scheduler.retire():
                 self._publish_request(req)
-            self.scheduler.admit()
+            admitted, preempted = self.scheduler.admit()
+            # copy-on-write boundary pages queued at admission MUST be
+            # materialized before any dispatch of this step can write
+            self._apply_cow_copies()
             prefill_stats = self._run_prefill()
             decode_stats = self._run_decode()
             for req in self.scheduler.retire():
                 self._publish_request(req)
             self._steps += 1
+            hit = sum(self.kv.seq_cached_tokens(r.request_id)
+                      for r in admitted)
             stats = {
                 "step": self._steps,
                 "queue_depth": self.scheduler.queue_depth,
                 "running": len(self.scheduler.running),
                 "kv_pages_in_use": self.kv.pages_in_use,
+                "kv_pages_cached": self.kv.pages_cached,
+                "prefix_hit_tokens": hit,
+                "n_preempted": len(preempted),
                 **prefill_stats, **decode_stats,
                 "step_ms": round((time.perf_counter() - t0) * 1e3, 3),
             }
+        for req in preempted:
+            self._publish_preemption(req)
+        if hit:
+            self._reg_safe(lambda r: r.inc(
+                "serving.prefix_hit_tokens", hit))
         self._publish_step(stats)
         return stats
+
+    def _apply_cow_copies(self) -> None:
+        """Materialize pending copy-on-write pages: one jitted
+        row-copy per (src, dst) pair over every per-layer array — int8
+        pools copy the per-slot scale arrays alongside the values
+        because the copier walks the whole page tuple. Admission-time,
+        outside the decode hot loop."""
+        copies = self.kv.take_pending_copies()
+        if not copies:
+            return
+        import jax
+        import jax.numpy as jnp
+
+        if self._copy_fn is None:
+            def _copy(pages, src, dst):
+                return [tuple(a.at[dst].set(a[src]) for a in entry)
+                        for entry in pages]
+
+            self._copy_fn = jax.jit(
+                _copy, donate_argnums=(0,) if self._donate else ())
+        for src, dst in copies:
+            self.pages = self._copy_fn(self.pages, jnp.int32(src),
+                                       jnp.int32(dst))
 
     def run_until_idle(self, max_steps: int = 100000) -> int:
         """Step until every submitted request finished (trace runner /
@@ -293,6 +356,15 @@ class Engine:
                     "eos_id": req.eos_id,
                     "tenant": req.tenant,
                     "already_emitted": len(req.output_tokens),
+                    "priority": req.priority,
+                    "temperature": req.temperature,
+                    "top_k": req.top_k,
+                    "top_p": req.top_p,
+                    "seed": req.seed,
+                    # the adopter's streams keep drawing per-index
+                    # sampling keys where this engine stopped
+                    "sample_step_offset": req.sample_step_offset
+                    + len(req.output_tokens),
                 })
                 req.cancel()
             for req in self.scheduler.retire():
@@ -319,7 +391,15 @@ class Engine:
                 np.asarray(entry["prompt"], np.int32),
                 max_new_tokens=int(entry["max_new_tokens"]),
                 eos_id=entry.get("eos_id"),
-                tenant=entry.get("tenant", "")))
+                tenant=entry.get("tenant", ""),
+                priority=int(entry.get("priority", 0)),
+                temperature=float(entry.get("temperature", 0.0)),
+                top_k=int(entry.get("top_k", 0)),
+                top_p=float(entry.get("top_p", 1.0)),
+                seed=int(entry.get("seed", 0)),
+                sample_step_offset=int(entry.get(
+                    "sample_step_offset",
+                    entry.get("already_emitted", 0)))))
         return out
 
     def close(self) -> None:
@@ -347,18 +427,35 @@ class Engine:
         B, T = bucket
         npages = self.kv.config.pages_per_seq
         tables = np.zeros((B, npages), np.int32)
+        temps = np.zeros((B,), np.float32)
+        top_ks = np.zeros((B,), np.int32)
+        top_ps = np.ones((B,), np.float32)
+        seeds = np.zeros((B,), np.int32)
+        steps = np.zeros((B,), np.int32)
         for b, req in enumerate(group):
             row = self.kv.block_table(req.request_id)
             tables[b, :len(row)] = row
+            temps[b] = req.temperature
+            top_ks[b] = req.top_k
+            top_ps[b] = req.top_p
+            seeds[b] = req.seed
+            # the token this dispatch emits is stream index
+            # len(output_tokens); offset carries indices a previous
+            # engine already emitted (drain/adopt)
+            steps[b] = req.sample_step_offset + len(req.output_tokens)
         feed = device_put_batch({
             "tokens": tokens.astype(np.int32),
             "tables": tables,
             "ctx": ctx.astype(np.int32),
             "qlens": qlens.astype(np.int32),
+            "temps": temps, "top_ks": top_ks, "top_ps": top_ps,
+            "seeds": seeds, "steps": steps,
         })
         next_tok, _logits, self.pages = self._compiler(
             bucket, self.params, self.pages, feed["tokens"],
-            feed["tables"], feed["ctx"], feed["qlens"])
+            feed["tables"], feed["ctx"], feed["qlens"],
+            feed["temps"], feed["top_ks"], feed["top_ps"],
+            feed["seeds"], feed["steps"])
         return LazyFetch(next_tok).numpy()
 
     def _run_prefill(self) -> dict:
@@ -370,9 +467,14 @@ class Engine:
         qlens = np.zeros((B,), np.int32)
         chunks = []
         for b, req in enumerate(group):
-            chunk = min(T, req.prompt_len - req.prefilled)
-            tokens[b, :chunk] = req.prompt[req.prefilled:
-                                           req.prefilled + chunk]
+            # full_prompt: the original prompt, or prompt + generated
+            # tokens when re-prefilling after a preemption; prefilled
+            # starts at the prefix-cache hit, so fully cached chunks
+            # are never dispatched
+            prompt = req.full_prompt
+            chunk = min(T, req.prefill_len - req.prefilled)
+            tokens[b, :chunk] = prompt[req.prefilled:
+                                       req.prefilled + chunk]
             qlens[b] = chunk
             ctx[b] = req.prefilled + chunk
             chunks.append(chunk)
@@ -380,16 +482,20 @@ class Engine:
         for b, req in enumerate(group):
             req.prefilled += chunks[b]
             req.context_len = req.prefilled
-            if req.prefilled >= req.prompt_len:
+            if req.prefilled >= req.prefill_len:
                 # final chunk: its last-row logits ARE the first
-                # generated token
+                # generated token. Index the now-complete prompt's
+                # pages for future prefix sharing.
+                self.kv.register_prefix(req.request_id,
+                                        req.full_prompt)
                 req.state = RequestState.RUNNING
                 req.last_token = int(toks[b])
                 req._emit(req.last_token)
                 self._tokens_generated += 1
                 self.scheduler.finish_if_done(req)
-        return {"n_prefill": len(group),
-                "prefill_tokens": int(sum(chunks))}
+        n_tok = int(sum(chunks))
+        self._reg_safe(lambda r: r.inc("serving.prefill_tokens", n_tok))
+        return {"n_prefill": len(group), "prefill_tokens": n_tok}
 
     def _run_decode(self) -> dict:
         group, B = self.scheduler.decode_group()
@@ -442,9 +548,24 @@ class Engine:
                 fields["ttft_ms"] = round(ttft_ms, 3)
             if req.tenant:
                 fields["tenant"] = req.tenant
+            if req.priority:
+                fields["priority"] = req.priority
+            if req.prefix_hit_tokens:
+                fields["prefix_hit_tokens"] = req.prefix_hit_tokens
+            if req.preemptions:
+                fields["preemptions"] = req.preemptions
             reg.event("serving_request", **fields)
 
         self._reg_safe(pub)
+
+    def _publish_preemption(self, req: Request) -> None:
+        self._reg_safe(lambda reg: (
+            reg.inc("serving.preemptions"),
+            reg.event("serving_preempt",
+                      request=int(req.request_id),
+                      priority=int(req.priority),
+                      output_tokens=len(req.output_tokens),
+                      preemptions=int(req.preemptions))))
 
     def _publish_step(self, stats: dict) -> None:
         def pub(reg):
@@ -467,7 +588,12 @@ class Engine:
                           kv_page_dtype=kvc.dtype,
                           kv_page_bytes=stats["kv_pages_in_use"]
                           * kvc.page_bytes,
-                          resident_batch=kvc.resident_batch)
+                          resident_batch=kvc.resident_batch,
+                          kv_pages_cached=stats.get(
+                              "kv_pages_cached", 0),
+                          prefix_hit_tokens=stats.get(
+                              "prefix_hit_tokens", 0),
+                          n_preempted=stats.get("n_preempted", 0))
 
         self._reg_safe(pub)
 
@@ -482,8 +608,14 @@ class Engine:
                 "tokens_generated": self._tokens_generated,
                 "tokens_per_sec": self._tokens_generated / up,
                 "kv_pages_in_use": self.kv.pages_in_use,
+                "kv_pages_cached": self.kv.pages_cached,
                 "kv_occupancy": round(self.kv.occupancy, 4),
                 "kv_peak_pages": self.kv.peak_pages_in_use,
+                "prefix_cache": self.kv.prefix_cache,
+                "prefix_hit_tokens": self.kv.prefix_hit_tokens,
+                "cow_copies": self.kv.cow_copies,
+                "prefix_evictions": self.kv.evictions,
+                "preemptions": self.scheduler.preemption_count,
                 "kv_page_dtype": self.kv.config.dtype,
                 "kv_page_bytes": self.kv.config.page_bytes,
                 "kv_pool_bytes": self.kv.config.pool_bytes,

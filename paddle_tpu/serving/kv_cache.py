@@ -15,17 +15,37 @@ pages reserved up front — so a running request can never strand
 mid-decode on an empty pool; the trade is admission-time backpressure
 (`alloc` returns None and the scheduler keeps the request queued)
 instead of mid-flight eviction. `free` (request finished or cancelled)
-returns every page to the pool immediately.
+releases every reference immediately.
+
+Prefix cache (``FLAGS_tpu_serving_prefix_cache``): pages are
+refcounted and content-indexed. The index maps
+``(parent_key, token_tuple) -> page`` — a hash CHAIN at page
+granularity, so a page's identity covers its whole prefix, not just
+its own tokens. `alloc(..., prompt=...)` walks the chain: fully
+matched pages are SHARED (refcount bumped, zero new pages — admission
+is prefix-aware), and a partially matched boundary page is
+copy-on-write: the reader gets a fresh page plus a pending device copy
+(`take_pending_copies`), because its first divergent write lands in
+the very next dispatch. int8 pools copy the per-slot scale arrays
+alongside the values — the copy helper works on the whole per-layer
+tuple. Refcount-0 pages that are still indexed park in a CACHED tier
+(LRU); admission pressure evicts them (leaves before ancestors —
+evicting an ancestor cascades, since the chain below it becomes
+unreachable). Sharing is pure block-table indirection: the attention
+kernel is untouched.
 
 Occupancy telemetry (PR 7 registry): gauges
 ``serving.kv_pages_in_use`` / ``serving.kv_pages_total`` /
-``serving.kv_occupancy`` refresh on every alloc/free; the bench
-``serving`` block reads the peak.
+``serving.kv_occupancy`` refresh on every alloc/free and count
+PHYSICAL pages once, no matter how many block tables reference them;
+``serving.kv_pages_cached`` is the parked tier. The bench ``serving``
+block reads the peak.
 """
 from __future__ import annotations
 
+from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 __all__ = ["KVCacheConfig", "PagedKVCache"]
 
@@ -118,6 +138,7 @@ class KVCacheConfig:
 class _SeqAlloc:
     pages: List[int]
     reserved: int  # worst-case pages reserved at admission
+    cached_tokens: int = 0  # prompt tokens covered by the prefix cache
     table: List[int] = field(default_factory=list)
 
 
@@ -126,21 +147,53 @@ class PagedKVCache:
     itself — the Engine serializes scheduler mutations under its own
     lock."""
 
-    def __init__(self, config: KVCacheConfig):
+    def __init__(self, config: KVCacheConfig,
+                 prefix_cache: Optional[bool] = None):
+        if prefix_cache is None:
+            from ..utils.flags import get_flag
+
+            prefix_cache = bool(get_flag(
+                "FLAGS_tpu_serving_prefix_cache", True))
         self.config = config
+        self.prefix_cache = bool(prefix_cache)
         self._free: List[int] = list(range(config.num_pages))
+        self._ref: List[int] = [0] * config.num_pages
         self._seqs: Dict[int, _SeqAlloc] = {}
+        # prefix index: (parent_key, token_tuple) -> page. Keys chain
+        # through FULL pages (a page's key embeds its whole prefix);
+        # sub-page tails register as leaf entries with < page_size
+        # tokens. One key per page and one page per key.
+        self._index: Dict[tuple, int] = {}
+        self._page_key: Dict[int, tuple] = {}
+        self._children: Dict[tuple, List[int]] = {}
+        # refcount-0 pages still worth matching, LRU order (front =
+        # evict first); free() parks leaves before their ancestors
+        self._cached: "OrderedDict[int, None]" = OrderedDict()
+        self._pending_copies: List[Tuple[int, int]] = []
         self._peak_in_use = 0
+        self._prefix_hit_tokens = 0
+        self._cow_copies = 0
+        self._evictions = 0
         self._publish()
 
     # -- pool state --------------------------------------------------------
     @property
     def pages_in_use(self) -> int:
-        return self.config.num_pages - len(self._free)
+        """PHYSICAL pages referenced by at least one live sequence —
+        a page shared by N block tables counts once, and parked
+        (cached-tier) pages do not count at all."""
+        return self.config.num_pages - len(self._free) - \
+            len(self._cached)
 
     @property
     def pages_free(self) -> int:
         return len(self._free)
+
+    @property
+    def pages_cached(self) -> int:
+        """Refcount-0 pages parked in the prefix cache (reclaimable
+        under admission pressure)."""
+        return len(self._cached)
 
     @property
     def occupancy(self) -> float:
@@ -150,17 +203,146 @@ class PagedKVCache:
     def peak_pages_in_use(self) -> int:
         return self._peak_in_use
 
-    def can_admit(self, total_tokens: int) -> bool:
+    @property
+    def prefix_hit_tokens(self) -> int:
+        """Cumulative prompt tokens admissions covered from the cache
+        (tokens that will never be prefilled)."""
+        return self._prefix_hit_tokens
+
+    @property
+    def cow_copies(self) -> int:
+        return self._cow_copies
+
+    @property
+    def evictions(self) -> int:
+        return self._evictions
+
+    def can_admit(self, total_tokens: int, prompt=None) -> bool:
         """Would `alloc` for a request of `total_tokens` worst-case
-        tokens succeed right now?"""
-        return self.config.pages_for(total_tokens) <= len(self._free)
+        tokens succeed right now? Prefix-aware: a cached prefix costs
+        zero new pages, and the parked tier is reclaimable."""
+        matched, shared, cow_src = self._match_prefix(prompt)
+        need = self.config.pages_for(total_tokens) - len(shared)
+        keep = set(shared)
+        if cow_src is not None:
+            keep.add(cow_src)
+        evictable = sum(1 for p in self._cached if p not in keep)
+        return need <= len(self._free) + evictable
+
+    # -- prefix index ------------------------------------------------------
+    def _match_prefix(self, prompt):
+        """Longest indexed prefix of `prompt`: (matched_tokens,
+        fully-shared pages in context order, copy-on-write source page
+        or None). Matching is capped at len(prompt) - 1 — the final
+        prompt position must be recomputed so the final prefill chunk
+        has logits to emit the first token from."""
+        if not self.prefix_cache or prompt is None:
+            return 0, [], None
+        toks = [int(t) for t in prompt]
+        P = len(toks)
+        if P < 2:
+            return 0, [], None
+        ps = self.config.page_size
+        full: List[int] = []
+        key = None
+        pos = 0
+        while pos + ps <= P:
+            k = (key, tuple(toks[pos:pos + ps]))
+            page = self._index.get(k)
+            if page is None:
+                break
+            full.append(page)
+            key = k
+            pos += ps
+        partial = None  # (page, tokens)
+        if pos < P:
+            for t in range(min(P - pos, ps - 1), 0, -1):
+                page = self._index.get((key, tuple(toks[pos:pos + t])))
+                if page is not None:
+                    partial = (page, t)
+                    break
+        matched = min(pos + (partial[1] if partial else 0), P - 1)
+        shared = [pg for i, pg in enumerate(full)
+                  if (i + 1) * ps <= matched]
+        cow_src = None
+        if matched > len(shared) * ps:
+            # the page covering [len(shared)*ps, matched): either the
+            # full page the P-1 cap landed inside, or the partial leaf
+            cow_src = full[len(shared)] if len(shared) < len(full) \
+                else partial[0]
+        return matched, shared, cow_src
+
+    def _drop_index(self, page: int) -> None:
+        """Remove a page's index entry. The chain below it becomes
+        unreachable (descendant keys embed this key), so cascade:
+        descendants lose their entries too, and any of them idling in
+        the cached tier go straight back to the free list."""
+        key = self._page_key.pop(page, None)
+        if key is None:
+            return
+        self._index.pop(key, None)
+        for child in self._children.pop(key, []):
+            self._drop_index(child)
+            if child in self._cached:
+                del self._cached[child]
+                self._free.append(child)
+
+    def register_prefix(self, seq_id: int, prompt) -> int:
+        """Index a fully prefilled prompt's pages for future sharing:
+        full pages chain, a sub-page tail registers as a leaf. Content
+        that is already indexed (including pages this sequence itself
+        shares) is left to the existing owner. Returns the number of
+        pages newly indexed."""
+        alloc = self._seqs.get(seq_id)
+        if not self.prefix_cache or alloc is None:
+            return 0
+        ps = self.config.page_size
+        toks = [int(t) for t in prompt]
+        P = len(toks)
+        key = None
+        registered = 0
+        for i in range(self.config.pages_for(P)):
+            pos = i * ps
+            t = min(ps, P - pos)
+            k = (key, tuple(toks[pos:pos + t]))
+            page = alloc.pages[i]
+            if k not in self._index and page not in self._page_key:
+                self._index[k] = page
+                self._page_key[page] = k
+                self._children.setdefault(key, []).append(page)
+                registered += 1
+            if t < ps:
+                break  # sub-page tails are leaves: no chain below
+            key = k
+        return registered
+
+    def seq_cached_tokens(self, seq_id: int) -> int:
+        """Prompt tokens of `seq_id` covered by the prefix cache at
+        admission (prefill starts after them)."""
+        alloc = self._seqs.get(seq_id)
+        return alloc.cached_tokens if alloc else 0
+
+    def take_pending_copies(self) -> List[Tuple[int, int]]:
+        """Drain the (src_page, dst_page) copy-on-write list. The
+        engine MUST apply these to the device pool before its next
+        dispatch — source content is only guaranteed until the next
+        write step. int8 pools copy the per-slot scales alongside."""
+        out, self._pending_copies = self._pending_copies, []
+        return out
 
     # -- per-sequence lifecycle -------------------------------------------
-    def alloc(self, seq_id: int, total_tokens: int) -> Optional[List[int]]:
+    def alloc(self, seq_id: int, total_tokens: int,
+              prompt=None) -> Optional[List[int]]:
         """Reserve pages for a sequence whose context will never exceed
         `total_tokens` (prompt + max_new). Returns the page list (the
         block table prefix, in order) or None when the pool cannot
-        cover it — the admission-backpressure signal."""
+        cover it — the admission-backpressure signal.
+
+        With `prompt` and the prefix cache on, admission is
+        prefix-aware: fully matched pages are shared instead of
+        allocated, a partially matched boundary page is queued as a
+        copy-on-write (`take_pending_copies`), and parked refcount-0
+        pages are evicted LRU-first to make room before giving up."""
         if seq_id in self._seqs:
             raise ValueError("seq %r already allocated" % (seq_id,))
         if total_tokens > self.config.max_context:
@@ -168,24 +350,61 @@ class PagedKVCache:
                 "request needs %d tokens > max_context %d "
                 "(pages_per_seq * page_size)"
                 % (total_tokens, self.config.max_context))
-        n = self.config.pages_for(total_tokens)
-        if n > len(self._free):
+        matched, shared, cow_src = self._match_prefix(prompt)
+        n_new = self.config.pages_for(total_tokens) - len(shared)
+        keep = set(shared)
+        if cow_src is not None:
+            keep.add(cow_src)
+        evictable = sum(1 for p in self._cached if p not in keep)
+        if n_new > len(self._free) + evictable:
             return None
-        pages = [self._free.pop() for _ in range(n)]
-        self._seqs[seq_id] = _SeqAlloc(pages=pages, reserved=n)
+        for p in shared:
+            self._ref[p] += 1
+            self._cached.pop(p, None)
+        if cow_src is not None and cow_src in self._cached:
+            self._cached.move_to_end(cow_src)  # hot: evict last
+        while len(self._free) < n_new:
+            victim = next(p for p in self._cached if p not in keep)
+            del self._cached[victim]
+            self._free.append(victim)
+            self._drop_index(victim)
+            self._evictions += 1
+        new_pages = [self._free.pop() for _ in range(n_new)]
+        for p in new_pages:
+            self._ref[p] = 1
+        pages = shared + new_pages
+        if cow_src is not None:
+            # boundary page: reader copies, then overwrites from its
+            # divergence point — the owner's page is never touched
+            self._pending_copies.append((cow_src, new_pages[0]))
+            self._cow_copies += 1
+        self._seqs[seq_id] = _SeqAlloc(
+            pages=pages, reserved=self.config.pages_for(total_tokens),
+            cached_tokens=matched)
+        self._prefix_hit_tokens += matched
         self._peak_in_use = max(self._peak_in_use, self.pages_in_use)
         self._publish()
         return list(pages)
 
     def free(self, seq_id: int) -> int:
-        """Return a sequence's pages to the pool (request finished or
-        cancelled — cancel-time eviction is immediate). Returns the
-        number of pages released; unknown ids are a no-op (retire and
+        """Drop a sequence's references (request finished, cancelled or
+        preempted — eviction of the reference is immediate). Pages
+        whose refcount hits 0 return to the free list, unless they are
+        prefix-indexed: those park in the cached tier, leaves ahead of
+        their ancestors in eviction order. Returns the number of
+        references released; unknown ids are a no-op (retire and
         cancel may race benignly)."""
         alloc = self._seqs.pop(seq_id, None)
         if alloc is None:
             return 0
-        self._free.extend(alloc.pages)
+        for p in reversed(alloc.pages):  # leaves park LRU-first
+            self._ref[p] -= 1
+            if self._ref[p] > 0:
+                continue
+            if p in self._page_key:
+                self._cached[p] = None
+            else:
+                self._free.append(p)
         self._publish()
         return len(alloc.pages)
 
@@ -243,5 +462,12 @@ class PagedKVCache:
                           self.config.pool_bytes)
             reg.set_gauge("serving.kv_resident_batch",
                           self.config.resident_batch)
+            reg.set_gauge("serving.kv_prefix_cache",
+                          int(self.prefix_cache))
+            reg.set_gauge("serving.kv_pages_cached", len(self._cached))
+            reg.set_gauge("serving.kv_prefix_hit_tokens",
+                          self._prefix_hit_tokens)
+            reg.set_gauge("serving.kv_cow_copies", self._cow_copies)
+            reg.set_gauge("serving.kv_evictions", self._evictions)
         except Exception:  # noqa: BLE001 - telemetry must never gate
             pass

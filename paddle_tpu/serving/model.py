@@ -30,7 +30,53 @@ import numpy as np
 
 from .kv_cache import KVCacheConfig
 
-__all__ = ["TinyLMConfig", "TinyDecoderLM", "dense_decode_reference"]
+__all__ = ["TinyLMConfig", "TinyDecoderLM", "dense_decode_reference",
+           "sample_tokens"]
+
+
+def sample_tokens(last_logits, temps, top_ks, top_ps, seeds, steps):
+    """Per-row token selection beyond greedy argmax: temperature /
+    top-k / top-p sampling via `jax.random.categorical`, batch-size
+    independent by construction.
+
+    last_logits [S, V] f32; temps [S] f32 (0 = greedy argmax for that
+    row); top_ks [S] i32 (0 = no top-k filter); top_ps [S] f32 (1 = no
+    nucleus filter); seeds [S] i32 per-request keys; steps [S] i32 the
+    stream index of the token being drawn.
+
+    The key is `fold_in(PRNGKey(seed), step)` — a pure function of
+    (request seed, token index), NEVER of the batch packing — and
+    every other op is row-wise (sorts, softmax, a vmapped
+    categorical), so a sampled stream is reproducible per seed and
+    bit-identical whether decoded batched, sequentially, preempted or
+    migrated. Rows with temps == 0 return the argmax, making greedy a
+    special case of one code path."""
+    import jax
+    import jax.numpy as jnp
+
+    V = last_logits.shape[-1]
+    greedy = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+    t = jnp.where(temps > 0, temps, 1.0)[:, None]
+    scaled = last_logits / t
+    order = jnp.argsort(-scaled, axis=-1)        # desc, stable on ties
+    ranks = jnp.argsort(order, axis=-1)          # rank of each vocab id
+    k_eff = jnp.where(top_ks > 0, jnp.minimum(top_ks, V), V)[:, None]
+    keep_k = ranks < k_eff
+    sorted_probs = jax.nn.softmax(
+        jnp.take_along_axis(scaled, order, axis=-1), axis=-1)
+    # exclusive cumulative mass < p keeps the smallest prefix whose
+    # mass reaches p (the top-1 row always survives)
+    excl = jnp.cumsum(sorted_probs, axis=-1) - sorted_probs
+    p_eff = jnp.where((top_ps > 0) & (top_ps < 1), top_ps, 1.0)[:, None]
+    keep_p = jnp.take_along_axis(excl < p_eff, ranks, axis=-1)
+    masked = jnp.where(keep_k & keep_p, scaled, -jnp.inf)
+
+    def draw(seed, step, row):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+        return jax.random.categorical(key, row)
+
+    sampled = jax.vmap(draw)(seeds, steps, masked).astype(jnp.int32)
+    return jnp.where(temps > 0, sampled, greedy)
 
 
 @dataclass(frozen=True)
@@ -107,7 +153,7 @@ class TinyDecoderLM:
 
     # -- the (pre|de)fill step --------------------------------------------
     def forward(self, params, tokens, pages, block_tables, context_lens,
-                q_lens):
+                q_lens, sampling=None):
         """One serving step over a fixed-shape bucket.
 
         tokens [S, T] int32; pages: list of (k_pages, v_pages) per
@@ -124,8 +170,13 @@ class TinyDecoderLM:
         dequantize on use, so a `quantize_weights_int8` params pytree
         drops in without touching the engine.
 
-        Returns (next_tokens [S] int32 — greedy argmax at each
-        sequence's last valid row, last_logits [S, vocab] f32,
+        `sampling`, when given, is the per-row operand 5-tuple
+        (temps [S] f32, top_ks [S] i32, top_ps [S] f32, seeds [S]
+        i32, steps [S] i32) routed to `sample_tokens`; None keeps the
+        legacy pure-greedy selection (identical to temps == 0).
+
+        Returns (next_tokens [S] int32 — greedy argmax or sampled at
+        each sequence's last valid row, last_logits [S, vocab] f32,
         new_pages)."""
         import jax.numpy as jnp
         from jax import lax
@@ -211,17 +262,26 @@ class TinyDecoderLM:
             logits, last[:, None, None], axis=1)[:, 0]     # [S, V]
         active = (q_lens > 0)[:, None]
         last_logits = jnp.where(active, last_logits, 0.0)
-        next_tokens = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+        if sampling is not None:
+            next_tokens = sample_tokens(last_logits, *sampling)
+        else:
+            next_tokens = jnp.argmax(
+                last_logits, axis=-1).astype(jnp.int32)
         return next_tokens, last_logits, new_pages
 
 
 def dense_decode_reference(model: TinyDecoderLM, params, prompt,
                            max_new_tokens: int,
-                           eos_id: Optional[int] = None) -> List[int]:
-    """Greedy-decode ONE prompt with dense causal attention and no
-    paging — full-context logits recomputed per token (O(T^2); golden
-    only). Matches the serving semantics: first generated token comes
-    from the last prompt position."""
+                           eos_id: Optional[int] = None,
+                           temperature: float = 0.0, top_k: int = 0,
+                           top_p: float = 1.0,
+                           seed: int = 0) -> List[int]:
+    """Decode ONE prompt with dense causal attention and no paging —
+    full-context logits recomputed per token (O(T^2); golden only).
+    Matches the serving semantics: first generated token comes from
+    the last prompt position, and `temperature`/`top_k`/`top_p`/`seed`
+    select tokens through the SAME `sample_tokens` key schedule the
+    engine uses (token index n draws fold_in(PRNGKey(seed), n))."""
     import jax.numpy as jnp
 
     from ..ops.pallas import reference_attention
@@ -262,8 +322,18 @@ def dense_decode_reference(model: TinyDecoderLM, params, prompt,
 
     ids = list(int(t) for t in np.asarray(prompt).reshape(-1))
     out: List[int] = []
-    for _ in range(int(max_new_tokens)):
-        tok = int(np.argmax(logits_for(np.asarray(ids, np.int32))))
+    for n in range(int(max_new_tokens)):
+        lg = logits_for(np.asarray(ids, np.int32))
+        if temperature > 0:
+            tok = int(np.asarray(sample_tokens(
+                jnp.asarray(lg, jnp.float32)[None],
+                jnp.asarray([temperature], jnp.float32),
+                jnp.asarray([top_k], jnp.int32),
+                jnp.asarray([top_p], jnp.float32),
+                jnp.asarray([seed], jnp.int32),
+                jnp.asarray([n], jnp.int32)))[0])
+        else:
+            tok = int(np.argmax(lg))
         out.append(tok)
         if eos_id is not None and tok == eos_id:
             break

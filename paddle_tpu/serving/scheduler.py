@@ -4,8 +4,14 @@ Requests are admitted and retired BETWEEN decode steps — the engine
 never drains its batch to refill it. Each engine step:
 
 1. retire finished/cancelled requests (KV pages freed immediately);
-2. admit queued requests FCFS while a slot (< max_seqs) and worst-case
-   KV pages are available — otherwise the queue backpressures;
+2. admit queued requests in effective-priority order (class priority
+   plus an aging boost — FLAGS_tpu_serving_aging_steps — so a low
+   class cannot starve in the queue) while a slot (< max_seqs) and
+   worst-case KV pages are available; a blocked request whose CLASS
+   outranks running work preempts: the victim's pages are freed and
+   it re-queues marked for prefill-recompute (prompt + tokens so far
+   re-prefill through the prefix cache — bit-identical continuation,
+   same invariance the drain/adopt path rides);
 3. prefill admitted-but-unprefilled requests in prompt-length-bucketed
    chunks (prompts longer than the largest bucket prefill in several
    chunks through the same unified step);
@@ -52,12 +58,28 @@ class Request:
     max_new_tokens: int
     eos_id: Optional[int] = None
     tenant: str = ""
+    priority: int = 0                      # higher = more urgent class
+    # sampling: temperature 0 = greedy argmax (the default); > 0
+    # samples via a per-request key folded with the token index, so a
+    # stream is reproducible per seed no matter how it was batched,
+    # preempted or migrated
+    temperature: float = 0.0
+    top_k: int = 0                         # 0 = no top-k filter
+    top_p: float = 1.0                     # 1.0 = no nucleus filter
+    seed: int = 0
+    sample_step_offset: int = 0            # tokens emitted pre-adopt
     state: str = RequestState.QUEUED
     output_tokens: List[int] = field(default_factory=list)
     # engine-side sequence bookkeeping
     context_len: int = 0                   # tokens whose KV is cached
     prefilled: int = 0                     # prompt tokens consumed
     last_token: Optional[int] = None       # next decode input
+    prefix_hit_tokens: int = 0             # prompt tokens cache covered
+    preemptions: int = 0
+    # set on preemption: prompt + tokens so far, the prefill-recompute
+    # input (None = never preempted, prefill the original prompt)
+    resume_prompt: Optional[np.ndarray] = None
+    enqueued_step: int = 0                 # for the aging boost
     t_submit: float = field(default_factory=time.time)
     t_first_token: Optional[float] = None
     t_finish: Optional[float] = None
@@ -69,6 +91,21 @@ class Request:
     @property
     def prompt_len(self) -> int:
         return int(self.prompt.shape[0])
+
+    @property
+    def full_prompt(self) -> np.ndarray:
+        """What prefill actually consumes: the original prompt, or —
+        after a preemption — prompt + already-generated tokens."""
+        return self.prompt if self.resume_prompt is None \
+            else self.resume_prompt
+
+    @property
+    def prefill_len(self) -> int:
+        return int(self.full_prompt.shape[0])
+
+    @property
+    def sampled(self) -> bool:
+        return self.temperature > 0.0
 
     @property
     def done(self) -> bool:
@@ -184,11 +221,23 @@ class Scheduler:
     queue append (also engine-locked)."""
 
     def __init__(self, kv_cache, plan: BucketPlan, max_seqs: int,
-                 max_queue: int = 0, max_context: Optional[int] = None):
+                 max_queue: int = 0, max_context: Optional[int] = None,
+                 aging_steps: Optional[int] = None):
+        if aging_steps is None:
+            from ..utils.flags import get_flag
+
+            aging_steps = int(get_flag(
+                "FLAGS_tpu_serving_aging_steps", 32))
         self.kv = kv_cache
         self.plan = plan
         self.max_seqs = int(max_seqs)
         self.max_queue = int(max_queue)
+        # starvation guard: a queued request's effective priority rises
+        # one class per `aging_steps` admission rounds waited (<= 0
+        # disables aging). Aging orders the QUEUE only — preemption
+        # eligibility stays raw-class-strict, so an aged low class can
+        # outwait higher classes but never evicts them.
+        self.aging_steps = int(aging_steps)
         # the TRUE per-request context bound: the model's max_seq can
         # be tighter than the page-rounded pool bound (pages_per_seq *
         # page_size rounds UP) — admitting past it would clip
@@ -199,16 +248,24 @@ class Scheduler:
         self.queued: deque = deque()
         self.running: Dict[int, Request] = {}  # admitted (prefill+decode)
         self._ids = itertools.count()
+        self._step = 0           # admission rounds, the aging clock
+        self.preemption_count = 0
 
     @property
     def queue_depth(self) -> int:
         return len(self.queued)
 
     def new_request(self, prompt, max_new_tokens, eos_id=None,
-                    tenant="") -> Request:
+                    tenant="", priority=0, temperature=0.0, top_k=0,
+                    top_p=1.0, seed=0,
+                    sample_step_offset=0) -> Request:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
+        if temperature < 0.0:
+            raise ValueError("temperature must be >= 0")
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError("top_p must be in (0, 1]")
         total = prompt.size + int(max_new_tokens)
         if total > self.max_context:
             raise ValueError(
@@ -220,27 +277,93 @@ class Scheduler:
                 % self.max_queue)
         req = Request(request_id=next(self._ids), prompt=prompt,
                       max_new_tokens=int(max_new_tokens), eos_id=eos_id,
-                      tenant=str(tenant))
+                      tenant=str(tenant), priority=int(priority),
+                      temperature=float(temperature), top_k=int(top_k),
+                      top_p=float(top_p), seed=int(seed),
+                      sample_step_offset=int(sample_step_offset),
+                      enqueued_step=self._step)
         self.queued.append(req)
         return req
 
     # -- step phases -------------------------------------------------------
-    def admit(self) -> List[Request]:
-        """FCFS admission: reserve worst-case KV pages; stop at the
-        first request the pool or the slot budget cannot take (strict
-        FCFS — later smaller requests do not jump the queue)."""
-        admitted = []
-        while self.queued and len(self.running) < self.max_seqs:
-            req = self.queued[0]
-            pages = self.kv.alloc(
-                req.request_id, req.prompt_len + req.max_new_tokens)
-            if pages is None:
-                break  # admission backpressure: pool exhausted
-            self.queued.popleft()
+    def effective_priority(self, req: Request) -> int:
+        """Class priority plus the aging boost (queue ordering only)."""
+        if self.aging_steps <= 0:
+            return req.priority
+        return req.priority + \
+            (self._step - req.enqueued_step) // self.aging_steps
+
+    def admit(self) -> Tuple[List[Request], List[Request]]:
+        """Priority admission: reserve worst-case KV pages (the prefix
+        cache discounts a cached prompt prefix to zero new pages) in
+        effective-priority order, stopping at the first request that
+        cannot be taken — no queue jumping past a blocked higher
+        class. A blocked request preempts strictly-lower-CLASS running
+        work: lowest class, latest admitted first; victims' pages free
+        immediately and they re-queue marked for prefill-recompute.
+        Returns (admitted, preempted)."""
+        self._step += 1
+        admitted: List[Request] = []
+        preempted: List[Request] = []
+        order = sorted(self.queued, key=lambda r: (
+            -self.effective_priority(r), r.request_id))
+        for req in order:
+            if req._cancel.is_set():
+                continue  # retire() publishes the cancellation
+            total = req.prefill_len + req.max_new_tokens - \
+                len(req.output_tokens)
+            while not (len(self.running) < self.max_seqs and
+                       self.kv.can_admit(total, prompt=req.full_prompt)):
+                victim = self._pick_victim(req)
+                if victim is None:
+                    break
+                self._preempt(victim)
+                preempted.append(victim)
+            if not (len(self.running) < self.max_seqs and
+                    self.kv.alloc(req.request_id, total,
+                                  prompt=req.full_prompt) is not None):
+                break  # admission backpressure
+            self.queued.remove(req)
             req.state = RequestState.PREFILL
+            cached = self.kv.seq_cached_tokens(req.request_id)
+            req.prefilled = cached
+            req.context_len = cached
+            req.prefix_hit_tokens += cached
             self.running[req.request_id] = req
             admitted.append(req)
-        return admitted
+        return admitted, preempted
+
+    def _pick_victim(self, req: Request) -> Optional[Request]:
+        """The running request a blocked `req` may evict: strictly
+        lower RAW class (aging never licenses eviction), lowest class
+        first, latest-admitted first within a class."""
+        victims = [r for r in self.running.values()
+                   if r.priority < req.priority and not r.done]
+        if not victims:
+            return None
+        victims.sort(key=lambda r: (r.priority, -r.request_id))
+        return victims[0]
+
+    def _preempt(self, victim: Request) -> None:
+        """Evict a running sequence: pages free now, the request
+        re-queues marked for prefill-recompute — its next admission
+        prefills prompt + tokens-so-far (warm through the prefix
+        cache), which under the chunked-prefill invariance reproduces
+        the stream bit-identically."""
+        del self.running[victim.request_id]
+        self.kv.free(victim.request_id)
+        victim.state = RequestState.QUEUED
+        victim.resume_prompt = np.concatenate(
+            [victim.prompt,
+             np.asarray(victim.output_tokens, np.int32)]) \
+            if victim.output_tokens else victim.prompt
+        victim.prefilled = 0
+        victim.context_len = 0
+        victim.last_token = None
+        victim.preemptions += 1
+        victim.enqueued_step = self._step
+        self.preemption_count += 1
+        self.queued.append(victim)
 
     def prefill_group(self) -> Tuple[List[Request], int, int]:
         """The next prefill dispatch: up to prefill_batch requests with
@@ -254,7 +377,7 @@ class Scheduler:
         pending.sort(key=lambda r: r.request_id)
         group = pending[:self.plan.prefill_batch]
         chunk = min(self.plan.max_prefill_chunk,
-                    max(r.prompt_len - r.prefilled for r in group))
+                    max(r.prefill_len - r.prefilled for r in group))
         return group, self.plan.prefill_batch, \
             self.plan.prefill_bucket(chunk)
 

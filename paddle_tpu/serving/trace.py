@@ -26,32 +26,50 @@ class TraceRequest:
     tenant: str
     prompt: np.ndarray
     max_new_tokens: int
+    priority: int = 0
 
 
 def synthetic_trace(n_requests: int = 24, n_tenants: int = 3,
                     seed: int = 0, vocab: int = 64,
                     prompt_range=(4, 24), output_range=(4, 16),
-                    arrival_every=(0, 3)) -> List[TraceRequest]:
+                    arrival_every=(0, 3), system_prompt_range=(0, 0),
+                    tenant_priorities=None) -> List[TraceRequest]:
     """Deterministic multi-tenant trace: tenant t's requests arrive
     every ~arrival_every steps with tenant-skewed prompt/output
     lengths (tenant 0 short-prompt chatty, last tenant long-prompt
-    batchy — the mix continuous batching exists for)."""
+    batchy — the mix continuous batching exists for).
+
+    `system_prompt_range` (lo, hi) prepends one fixed per-tenant
+    system prompt of a seeded length in [lo, hi] to every request of
+    that tenant — the repeated prefix the serving prefix cache exists
+    for ((0, 0) = no system prompts, the pre-prefix-cache trace).
+    `prompt_range` then sizes the unique per-request remainder.
+    `tenant_priorities` (len n_tenants) assigns scheduling classes per
+    tenant (default all 0)."""
     r = np.random.RandomState(seed)
+    n_tenants = int(n_tenants)
+    sys_lo, sys_hi = system_prompt_range
+    sys_prompts = [
+        r.randint(0, vocab, size=int(r.randint(sys_lo, sys_hi + 1))
+                  if sys_hi > 0 else 0).astype(np.int32)
+        for _ in range(n_tenants)]
+    prios = list(tenant_priorities) if tenant_priorities else \
+        [0] * n_tenants
     out: List[TraceRequest] = []
     step = 0
     for i in range(int(n_requests)):
-        t = i % int(n_tenants)
+        t = i % n_tenants
         skew = (t + 1) / float(n_tenants)
         lo, hi = prompt_range
         plen = int(lo + (hi - lo) * skew * r.uniform(0.5, 1.0))
         olo, ohi = output_range
         olen = int(r.randint(olo, ohi + 1))
         step += int(r.randint(arrival_every[0], arrival_every[1] + 1))
+        body = r.randint(0, vocab, size=max(1, plen)).astype(np.int32)
         out.append(TraceRequest(
             arrival_step=step, tenant="tenant%d" % t,
-            prompt=r.randint(0, vocab, size=max(1, plen)).astype(
-                np.int32),
-            max_new_tokens=max(1, olen)))
+            prompt=np.concatenate([sys_prompts[t], body]),
+            max_new_tokens=max(1, olen), priority=int(prios[t])))
     return out
 
 
@@ -75,7 +93,7 @@ def run_trace(engine, trace: List[TraceRequest],
             tr = pending[i]
             requests.append(engine.submit(
                 tr.prompt, max_new_tokens=tr.max_new_tokens,
-                tenant=tr.tenant))
+                tenant=tr.tenant, priority=tr.priority))
             i += 1
         engine.step()
         step += 1
@@ -91,6 +109,9 @@ def run_trace(engine, trace: List[TraceRequest],
         "tokens_generated": tokens,
         "wall_s": round(wall_s, 4),
         "tokens_per_sec": round(tokens / wall_s, 3),
+        "prefix_hit_tokens": engine.kv.prefix_hit_tokens,
+        "cow_copies": engine.kv.cow_copies,
+        "preemptions": engine.scheduler.preemption_count,
     }
     try:
         from ..observability import registry

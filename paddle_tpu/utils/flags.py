@@ -264,6 +264,18 @@ _FLAGS: Dict[str, object] = {
     # are replaced by int8 payloads + per-channel fp32 abs-max scales
     # and dequantized on use — ~4x fewer weight HBM bytes vs fp32.
     "FLAGS_tpu_serving_quantize_weights": False,
+    # prefix caching: refcounted KV pages content-indexed at page
+    # granularity; admission shares fully-matched prompt-prefix pages
+    # (zero new pages, zero prefill for them), copy-on-writes the
+    # boundary page, and parks refcount-0 indexed pages in an LRU
+    # cached tier evicted under admission pressure. Decoded tokens are
+    # bit-identical with the cache on or off (tier-1 enforced).
+    "FLAGS_tpu_serving_prefix_cache": True,
+    # priority-aging starvation guard: a queued request gains one
+    # effective priority class per this many admission rounds waited
+    # (queue ORDER only — preemption eligibility stays raw-class
+    # strict). 0 disables aging.
+    "FLAGS_tpu_serving_aging_steps": 32,
 }
 
 

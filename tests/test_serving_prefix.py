@@ -1,0 +1,672 @@
+"""Prefix caching + priority scheduling tests (paddle_tpu/serving):
+refcounted copy-on-write KV pages — content-indexed prefix chain,
+physical-once occupancy, cached-tier parking/LRU eviction with
+cascade — temperature/top-k/top-p sampling through the per-request
+folded key schedule, priority classes with aging and preemption
+(recompute bit-identity), drain/adopt continuation across the new
+request state, int8 pages x prefix sharing (scales travel with the
+COW copy), telemetry schema validity of serving_preempt, the bench
+``serving`` block's prefix/preemption lane, and the
+`perf_analysis --serving` gate in-process."""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu import serving
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_DIR)
+
+MODEL_CFG = serving.TinyLMConfig(vocab=48, embed=24, layers=2, heads=2,
+                                 kv_heads=2, head_dim=8, ffn=48,
+                                 max_seq=48)
+#: ONE model instance per run: engines over it share the jitted step
+_MODEL = serving.TinyDecoderLM(MODEL_CFG)
+_PARAMS = None
+
+
+def _params():
+    global _PARAMS
+    if _PARAMS is None:
+        _PARAMS = _MODEL.init_params(seed=3)
+    return _PARAMS
+
+
+def _engine(**over):
+    cfg = dict(num_pages=96, page_size=4, max_seqs=6)
+    cfg.update(over)
+    return serving.Engine(_MODEL, params=_params(),
+                          config=serving.EngineConfig(**cfg))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    obs.reset_registry()
+    yield
+    obs.reset_registry()
+
+
+def _kv(num_pages=12, page_size=4, pages_per_seq=6, **over):
+    kw = dict(num_pages=num_pages, page_size=page_size,
+              pages_per_seq=pages_per_seq, num_layers=1,
+              num_kv_heads=1, head_dim=8)
+    kw.update(over)
+    return serving.PagedKVCache(serving.KVCacheConfig(**kw),
+                                prefix_cache=True)
+
+
+# -- kv cache: prefix index, sharing, COW -----------------------------------
+
+def test_prefix_share_full_pages_physical_once():
+    """Fully matched prompt pages are SHARED (refcount bump, zero new
+    pages) and pages_in_use counts physical pages once."""
+    kv = _kv()
+    a = list(range(16))
+    p0 = kv.alloc(0, 20, prompt=a)          # 16 prompt + 4 new -> 5 pg
+    assert kv.register_prefix(0, a) == 4    # 4 full prompt pages
+    assert kv.pages_in_use == 5
+    # same first 8 tokens, divergent third page: 2 pages shared
+    b = a[:8] + [40, 41, 42, 43, 44, 45, 46, 47]
+    p1 = kv.alloc(1, 20, prompt=b)
+    assert p1[:2] == p0[:2]                 # block-table indirection
+    assert set(p1[2:]).isdisjoint(p0)
+    assert kv.seq_cached_tokens(1) == 8
+    assert kv.seq_cached_tokens(0) == 0     # cold first arrival
+    assert kv.prefix_hit_tokens == 8
+    # physical once: 5 + 3 private new pages, shared pair NOT recounted
+    assert kv.pages_in_use == 8
+    assert kv.peak_pages_in_use == 8
+    assert kv.take_pending_copies() == []   # clean page-grid split
+    kv.free(1)
+    assert kv.pages_in_use == 5             # owner's refs keep pages 0/1
+
+
+def test_identical_prompt_caps_at_last_position_and_cows():
+    """An IDENTICAL prompt matches only to len(prompt)-1 — the final
+    position must recompute so the last chunk emits first-token
+    logits — turning the last full page into a copy-on-write."""
+    kv = _kv()
+    a = list(range(16))
+    p0 = kv.alloc(0, 20, prompt=a)
+    kv.register_prefix(0, a)
+    p1 = kv.alloc(1, 20, prompt=list(a))
+    assert kv.seq_cached_tokens(1) == 15    # P - 1 cap
+    assert p1[:3] == p0[:3]
+    assert p1[3] != p0[3]
+    assert kv.take_pending_copies() == [(p0[3], p1[3])]
+    assert kv.cow_copies == 1
+
+
+def test_partial_leaf_match_and_cow():
+    """A sub-page prompt tail registers as a LEAF entry; a longer
+    prompt extending it shares the full pages and COWs the leaf."""
+    kv = _kv()
+    a = list(range(14))                     # 3 full pages + 2-token tail
+    p0 = kv.alloc(0, 16, prompt=a)
+    assert kv.register_prefix(0, a) == 4
+    ext = a + [40, 41]
+    p1 = kv.alloc(1, 20, prompt=ext)
+    assert kv.seq_cached_tokens(1) == 14
+    assert p1[:3] == p0[:3]
+    assert kv.take_pending_copies() == [(p0[3], p1[3])]
+    # but a DIFFERENT tail shares only the full pages, no COW
+    other = a[:12] + [45, 46, 47]
+    p2 = kv.alloc(2, 20, prompt=other)
+    assert kv.seq_cached_tokens(2) == 12
+    assert p2[:3] == p0[:3] and kv.take_pending_copies() == []
+
+
+def test_free_parks_indexed_pages_and_revives():
+    """free() parks refcount-0 indexed pages in the cached tier
+    instead of the free list; a warm re-arrival revives the SAME
+    physical pages."""
+    kv = _kv()
+    a = list(range(16))
+    p0 = kv.alloc(0, 20, prompt=a)
+    kv.register_prefix(0, a)
+    kv.free(0)
+    assert kv.pages_in_use == 0             # parked pages don't count
+    assert kv.pages_cached == 4             # the 4 indexed prompt pages
+    assert kv.pages_free == 12 - 4
+    p1 = kv.alloc(1, 20, prompt=a[:8] + [40] * 8)
+    assert p1[:2] == p0[:2]                 # revived, same page ids
+    assert kv.pages_cached == 2             # the other two still parked
+
+
+def test_eviction_lru_leaves_first_with_cascade():
+    """Admission pressure evicts parked pages LRU-first (leaves park
+    ahead of ancestors); dropping an ANCESTOR's index entry cascades —
+    the chain below it is unreachable, so parked descendants free."""
+    kv = _kv(num_pages=6, pages_per_seq=6)
+    a = list(range(16))
+    p0 = kv.alloc(0, 16, prompt=a)          # all 4 pages are prompt
+    kv.register_prefix(0, a)
+    kv.free(0)
+    assert kv.pages_cached == 4 and kv.pages_free == 2
+    # 3 pages needed, 2 free: one parked page (the LEAF) evicts
+    assert kv.can_admit(12)
+    kv.alloc(1, 12, prompt=[40] * 12)
+    assert kv.evictions == 1
+    assert kv.pages_cached == 3
+    # the surviving ancestor chain still matches its 3 full pages
+    matched, shared, cow = kv._match_prefix(a)
+    assert (matched, shared, cow) == (12, p0[:3], None)
+    kv.free(1)
+    # drop the chain ROOT's index entry: the whole chain below is
+    # unreachable, so its parked pages go straight to the free list
+    kv._drop_index(kv._index[(None, tuple(a[:4]))])
+    assert kv._index == {} and kv._page_key == {}
+    assert kv.pages_cached == 1             # the root, now unindexed
+    assert kv.pages_free == 5
+    # an unindexed parked page is still reclaimable under pressure
+    assert kv.can_admit(24)
+    assert kv.alloc(2, 24) is not None
+    assert kv.pages_cached == 0 and kv.pages_in_use == 6
+
+
+def test_eviction_never_touches_kept_shared_pages():
+    """Eviction to make room skips the pages the incoming request is
+    about to share — a hit must not evict its own prefix."""
+    kv = _kv(num_pages=6, pages_per_seq=6)
+    a = list(range(16))
+    p0 = kv.alloc(0, 16, prompt=a)
+    kv.register_prefix(0, a)
+    kv.free(0)                              # 4 parked, 2 free
+    # needs 4 pages, shares 2: 2 new from free list, no eviction
+    p1 = kv.alloc(1, 16, prompt=a[:8] + [40] * 8)
+    assert p1[:2] == p0[:2] and kv.evictions == 0
+    # a cold 6-page request now must evict every reclaimable page
+    kv.free(1)
+    assert kv.can_admit(24, prompt=[41] * 24)
+    kv.alloc(2, 24, prompt=[41] * 24)
+    assert kv.pages_cached == 0 and kv.pages_in_use == 6
+
+
+def test_prefix_cache_off_is_legacy_behavior():
+    kv = serving.PagedKVCache(serving.KVCacheConfig(
+        num_pages=8, page_size=4, pages_per_seq=4, num_layers=1,
+        num_kv_heads=1, head_dim=8), prefix_cache=False)
+    a = list(range(8))
+    p0 = kv.alloc(0, 8, prompt=a)
+    assert kv.register_prefix(0, a) == 0
+    p1 = kv.alloc(1, 8, prompt=a)
+    assert set(p0).isdisjoint(p1)           # nothing shared
+    kv.free(0)
+    assert kv.pages_cached == 0             # nothing parked
+    assert kv.prefix_hit_tokens == 0 and kv.cow_copies == 0
+
+
+# -- engine: prefix hits, greedy + sampled identity -------------------------
+
+def _staggered(eng, prompts, max_new=6, **submit_kw):
+    """Submit each prompt 2 engine steps after the previous one (a
+    same-step cold wave shares nothing — registration happens at
+    prefill completion), then run to drain."""
+    reqs = []
+    for p in prompts:
+        reqs.append(eng.submit(np.asarray(p, np.int32),
+                               max_new_tokens=max_new, **submit_kw))
+        eng.step()
+        eng.step()
+    eng.run_until_idle()
+    outs = [list(r.output_tokens) for r in reqs]
+    eng.close()
+    return outs
+
+
+def test_engine_prefix_hits_and_greedy_identity():
+    """Staggered shared-prefix requests: the cache-on engine skips the
+    cached chunks (prefix_hit_tokens > 0) and still decodes
+    BIT-IDENTICALLY to the cache-off engine."""
+    r = np.random.RandomState(0)
+    sys_p = list(r.randint(0, 48, size=14))
+    prompts = [sys_p + list(r.randint(0, 48, size=4)) for _ in range(4)]
+
+    eng_on = _engine(prefix_cache=True)
+    on = _staggered(eng_on, prompts)
+    hits = eng_on.kv.prefix_hit_tokens
+    eng_off = _engine(prefix_cache=False)
+    off = _staggered(eng_off, prompts)
+    assert on == off
+    assert hits >= 3 * 12                   # 3 warm arrivals, 3 pages
+    assert eng_off.kv.prefix_hit_tokens == 0
+    # stats surface the lane
+    assert eng_on.stats()["prefix_cache"] is True
+    assert eng_on.stats()["prefix_hit_tokens"] == hits
+
+
+def test_engine_identical_prompts_cow_identity():
+    """Repeated IDENTICAL prompts (the P-1 cap makes the last page a
+    COW) decode identically to the cache-off engine — the copied page
+    content, not the shared original, feeds the divergent writes."""
+    r = np.random.RandomState(5)
+    prompt = list(r.randint(0, 48, size=16))
+    eng_on = _engine(prefix_cache=True)
+    on = _staggered(eng_on, [prompt] * 3)
+    assert eng_on.kv.cow_copies >= 2
+    eng_off = _engine(prefix_cache=False)
+    assert on == _staggered(eng_off, [prompt] * 3)
+    assert on[0] == on[1] == on[2]          # greedy determinism
+
+
+def test_sampled_identity_cache_on_vs_off_and_reproducible():
+    """Sampled streams (temperature/top-k/top-p) are bit-identical
+    cache on vs off, reproducible per seed, and seed-sensitive."""
+    r = np.random.RandomState(7)
+    sys_p = list(r.randint(0, 48, size=12))
+    prompts = [sys_p + list(r.randint(0, 48, size=3)) for _ in range(3)]
+    kw = dict(max_new=8, temperature=0.8, top_k=12, top_p=0.9)
+
+    on = _staggered(_engine(prefix_cache=True), prompts, seed=11, **kw)
+    off = _staggered(_engine(prefix_cache=False), prompts, seed=11,
+                     **kw)
+    again = _staggered(_engine(prefix_cache=True), prompts, seed=11,
+                       **kw)
+    other = _staggered(_engine(prefix_cache=True), prompts, seed=12,
+                       **kw)
+    assert on == off == again
+    assert on != other                      # the seed is load-bearing
+
+
+def test_sampled_batched_eq_sequential_and_matches_reference():
+    """Batch-size independence of the sampling key schedule: batched
+    streams == sequential streams == the dense no-paging reference at
+    the same (seed, temperature, top_k, top_p)."""
+    r = np.random.RandomState(9)
+    prompts = [list(r.randint(0, 48, size=n)) for n in (5, 9, 3)]
+    kw = dict(temperature=0.7, top_k=10, top_p=0.85)
+
+    eng = _engine()
+    reqs = [eng.submit(np.asarray(p, np.int32), max_new_tokens=6,
+                       seed=20 + i, **kw)
+            for i, p in enumerate(prompts)]
+    eng.run_until_idle()
+    batched = [list(q.output_tokens) for q in reqs]
+    eng.close()
+
+    sequential = []
+    for i, p in enumerate(prompts):
+        e = _engine()
+        q = e.submit(np.asarray(p, np.int32), max_new_tokens=6,
+                     seed=20 + i, **kw)
+        e.run_until_idle()
+        sequential.append(list(q.output_tokens))
+        e.close()
+    assert batched == sequential
+    ref = [serving.dense_decode_reference(
+        _MODEL, _params(), np.asarray(p, np.int32), 6, seed=20 + i,
+        temperature=0.7, top_k=10, top_p=0.85)
+        for i, p in enumerate(prompts)]
+    assert batched == ref
+
+
+def test_top_k_one_is_greedy_and_validation():
+    r = np.random.RandomState(11)
+    prompt = np.asarray(r.randint(0, 48, size=7), np.int32)
+    eng = _engine()
+    greedy = eng.submit(prompt, max_new_tokens=8)
+    k1 = eng.submit(prompt, max_new_tokens=8, temperature=1.3,
+                    top_k=1, seed=99)
+    eng.run_until_idle()
+    assert k1.output_tokens == greedy.output_tokens
+    with pytest.raises(ValueError, match="temperature"):
+        eng.submit(prompt, temperature=-0.1)
+    with pytest.raises(ValueError, match="top_p"):
+        eng.submit(prompt, top_p=0.0)
+    eng.close()
+
+
+# -- int8 pages x prefix sharing --------------------------------------------
+
+def test_int8_prefix_sharing_bit_identity():
+    """int8 KV pages + prefix cache: shared and COW'd pages carry
+    their per-slot scales — streams stay bit-identical to the int8
+    cache-off engine (a dropped scale would skew dequantization)."""
+    r = np.random.RandomState(13)
+    sys_p = list(r.randint(0, 48, size=14))
+    prompts = [sys_p + list(r.randint(0, 48, size=3))
+               for _ in range(3)] + [sys_p + [1, 2]] * 2  # COW pair
+    on_e = _engine(kv_dtype="int8", prefix_cache=True)
+    on = _staggered(on_e, prompts)
+    assert on_e.kv.prefix_hit_tokens > 0 and on_e.kv.cow_copies >= 1
+    off = _staggered(_engine(kv_dtype="int8", prefix_cache=False),
+                     prompts)
+    assert on == off
+    # golden: cache-on int8 stream == the dense reference path is
+    # pinned by test_serving's int8 goldens; here the admission byte
+    # math must be UNCHANGED by the prefix machinery
+    c8 = serving.KVCacheConfig(num_pages=96, page_size=4,
+                               pages_per_seq=12, num_layers=2,
+                               num_kv_heads=2, head_dim=8, dtype="int8")
+    assert c8.pages_for_budget(c8.pool_bytes) == 96
+
+
+def test_int8_cow_copies_scale_slots_on_device():
+    """The COW copier walks the whole per-layer tuple: after
+    _apply_cow_copies, the destination page's VALUE arrays and both
+    per-slot SCALE arrays equal the source page row-for-row."""
+    eng = _engine(kv_dtype="int8")
+    r = np.random.RandomState(15)
+    prompt = np.asarray(r.randint(0, 48, size=14), np.int32)
+    req = eng.submit(prompt, max_new_tokens=4)
+    eng.run_until_idle()
+    assert req.state == serving.RequestState.FINISHED
+    # identical re-arrival: full pages share, the leaf page COWs
+    pages = eng.kv.alloc(999, 18, prompt=list(prompt))
+    assert pages is not None
+    copies = list(eng.kv._pending_copies)
+    assert len(copies) == 1
+    eng._apply_cow_copies()
+    src, dst = copies[0]
+    for entry in eng.pages:                 # (k, v, k_scale, v_scale)
+        assert len(entry) == 4
+        for arr in entry:
+            np.testing.assert_array_equal(np.asarray(arr[src]),
+                                          np.asarray(arr[dst]))
+    eng.kv.free(999)
+    eng.close()
+
+
+# -- priority, aging, preemption --------------------------------------------
+
+def test_preempted_request_resumes_bit_identical():
+    """THE preemption contract: a victim evicted mid-decode, then
+    re-admitted (prefill-recompute of prompt + tokens so far), emits
+    the SAME stream as the never-preempted run."""
+    r = np.random.RandomState(17)
+    p_victim = np.asarray(r.randint(0, 48, size=8), np.int32)
+    p_rival = np.asarray(r.randint(0, 48, size=8), np.int32)
+    geom = dict(num_pages=8, page_size=4, max_seqs=4)
+
+    eng = _engine(**geom)
+    victim = eng.submit(p_victim, max_new_tokens=12, priority=0)
+    for _ in range(4):
+        eng.step()
+    assert victim.output_tokens             # mid-decode
+    rival = eng.submit(p_rival, max_new_tokens=12, priority=5)
+    eng.run_until_idle()
+    assert eng.scheduler.preemption_count == 1
+    assert victim.preemptions == 1 and rival.preemptions == 0
+    assert victim.state == serving.RequestState.FINISHED
+
+    base = _engine(**geom)
+    q = base.submit(p_victim, max_new_tokens=12)
+    base.run_until_idle()
+    assert victim.output_tokens == q.output_tokens
+    qr = base.submit(p_rival, max_new_tokens=12)
+    base.run_until_idle()
+    assert rival.output_tokens == qr.output_tokens
+    snap = obs.registry().snapshot()["counters"]
+    assert snap["serving.preemptions"] == 1
+    assert snap["event.serving_preempt"] == 1
+    eng.close()
+    base.close()
+
+
+def test_aging_orders_queue_but_never_licenses_eviction():
+    """The starvation guard: an aged low class sorts ahead of a
+    younger higher class, and because admission never jumps past a
+    blocked head-of-queue, the higher class cannot leapfrog it — yet
+    aging never licenses eviction (preemption stays raw-class)."""
+    kv = _kv(num_pages=4, pages_per_seq=4)  # 16-token pool
+    plan = serving.BucketPlan.from_flags(2)
+    sched = serving.Scheduler(kv, plan, max_seqs=2, aging_steps=2)
+    blocker = sched.new_request([1] * 8, 8)  # 4 pages: whole pool
+    admitted, _ = sched.admit()
+    assert admitted == [blocker]
+    old = sched.new_request([5] * 4, 4, priority=0)   # 2 pages
+    for _ in range(6):                      # old starves 6 rounds
+        assert sched.admit() == ([], [])
+    young = sched.new_request([6] * 4, 4, priority=1)
+    assert sched.effective_priority(old) >= 3
+    assert sched.effective_priority(young) == 1
+    # without the aging boost young would sort first and PREEMPT the
+    # class-0 blocker; aged `old` heads the queue instead, and since
+    # class 0 evicts nobody, the round breaks — no queue jumping
+    admitted, preempted = sched.admit()
+    assert admitted == [] and preempted == []
+    assert blocker.request_id in sched.running
+    assert sched._pick_victim(old) is None  # aging != eviction rights
+    assert sched._pick_victim(young) is blocker
+    # blocker retires: the aged request admits FIRST, young alongside
+    del sched.running[blocker.request_id]
+    kv.free(blocker.request_id)
+    admitted, preempted = sched.admit()
+    assert admitted == [old, young] and preempted == []
+    # aging disabled: the boost vanishes from the ordering key
+    sched.aging_steps = 0
+    assert sched.effective_priority(old) == 0
+
+
+def test_preemption_victim_order_lowest_class_latest_first():
+    kv = _kv(num_pages=8, pages_per_seq=4)
+    plan = serving.BucketPlan.from_flags(4)
+    sched = serving.Scheduler(kv, plan, max_seqs=4, aging_steps=0)
+    a = sched.new_request([1] * 8, 8, priority=1)   # 4 pages
+    b = sched.new_request([2] * 8, 8, priority=0)   # 4 pages
+    admitted, _ = sched.admit()
+    assert admitted == [a, b]
+    hi = sched.new_request([3] * 8, 8, priority=2)
+    admitted, preempted = sched.admit()
+    # lowest class evicts first — b, not the higher-class a
+    assert preempted == [b] and admitted == [hi]
+    assert b.resume_prompt is not None and b.state == "queued"
+    assert a.request_id in sched.running
+
+
+# -- drain / adopt across the new state -------------------------------------
+
+def _run_counting_prefill(eng, max_steps=400):
+    """Step to idle, returning total prefill tokens dispatched."""
+    total = 0
+    n = 0
+    while not eng.scheduler.idle and n < max_steps:
+        total += eng.step().get("prefill_tokens", 0)
+        n += 1
+    return total
+
+
+def test_drain_adopt_warm_adopter_fewer_prefill_tokens():
+    """A drained sampled+greedy mix migrates; the adopter reproduces
+    the uninterrupted streams, and a WARM adopter (same prompt already
+    served there) prefills fewer tokens than a cold one."""
+    r = np.random.RandomState(19)
+    prompt = np.asarray(r.randint(0, 48, size=18), np.int32)
+
+    base = _engine()
+    full = base.submit(prompt, max_new_tokens=10)
+    base.run_until_idle()
+    base.close()
+
+    def drained_manifest():
+        src = _engine()
+        req = src.submit(prompt, max_new_tokens=10)
+        for _ in range(4):
+            src.step()
+        assert 0 < len(req.output_tokens) < 10
+        out = src.drain(grace_s=0.0)
+        emitted = list(req.output_tokens)
+        src.close()
+        return out, emitted
+
+    # cold adopter
+    out, emitted = drained_manifest()
+    assert len(out["migrated"]) == 1
+    entry = out["migrated"][0]
+    assert entry["already_emitted"] == len(emitted)
+    cold = _engine()
+    [cont] = cold.adopt(out["migrated"])
+    cold_prefill = _run_counting_prefill(cold)
+    assert emitted + cont.output_tokens == full.output_tokens
+    assert cold.kv.prefix_hit_tokens == 0
+    cold.close()
+
+    # warm adopter: the same prompt was served here before the adopt
+    out, emitted = drained_manifest()
+    warm = _engine()
+    pre = warm.submit(prompt, max_new_tokens=4)
+    warm.run_until_idle()
+    assert pre.output_tokens == full.output_tokens[:4]
+    [cont] = warm.adopt(out["migrated"])
+    warm_prefill = _run_counting_prefill(warm)
+    assert emitted + cont.output_tokens == full.output_tokens
+    assert warm.kv.prefix_hit_tokens >= 16  # prompt pages were cached
+    assert warm_prefill < cold_prefill
+    warm.close()
+
+
+def test_drain_adopt_sampled_stream_continues_key_schedule():
+    """sample_step_offset rides the manifest: the adopter's draws use
+    the ORIGINAL stream indices, so drained-then-adopted sampled
+    output == the uninterrupted sampled stream."""
+    r = np.random.RandomState(21)
+    prompt = np.asarray(r.randint(0, 48, size=9), np.int32)
+    kw = dict(max_new_tokens=10, temperature=0.9, top_k=14,
+              top_p=0.92, seed=31)
+
+    base = _engine()
+    full = base.submit(prompt, **kw)
+    base.run_until_idle()
+    base.close()
+
+    src = _engine()
+    req = src.submit(prompt, **kw)
+    for _ in range(4):
+        src.step()
+    emitted = list(req.output_tokens)
+    assert 0 < len(emitted) < 10
+    out = src.drain(grace_s=0.0)
+    src.close()
+    entry = out["migrated"][0]
+    assert entry["sample_step_offset"] == len(emitted)
+    assert entry["temperature"] == 0.9 and entry["seed"] == 31
+
+    dst = _engine()
+    [cont] = dst.adopt(out["migrated"])
+    dst.run_until_idle()
+    assert emitted + cont.output_tokens == full.output_tokens
+    dst.close()
+
+
+def test_preempted_mid_decode_drains_cleanly():
+    """A victim sitting re-queued after preemption drains into a
+    manifest whose prompt already carries its generated tokens; the
+    adopter completes the stream bit-identically."""
+    r = np.random.RandomState(23)
+    p_victim = np.asarray(r.randint(0, 48, size=8), np.int32)
+    p_rival = np.asarray(r.randint(0, 48, size=8), np.int32)
+    geom = dict(num_pages=8, page_size=4, max_seqs=4)
+
+    base = _engine(**geom)
+    full = base.submit(p_victim, max_new_tokens=12)
+    base.run_until_idle()
+    base.close()
+
+    eng = _engine(**geom)
+    victim = eng.submit(p_victim, max_new_tokens=12, priority=0)
+    for _ in range(4):
+        eng.step()
+    eng.submit(p_rival, max_new_tokens=12, priority=5)
+    eng.step()                              # rival preempts victim
+    assert victim.state == serving.RequestState.QUEUED
+    assert victim.preemptions == 1
+    out = eng.drain(grace_s=0.0)
+    eng.close()
+    entry = next(e for e in out["migrated"]
+                 if e["already_emitted"] == len(victim.output_tokens)
+                 and e["prompt"][:8] == [int(t) for t in p_victim])
+    assert entry["prompt"] == [int(t) for t in p_victim] + \
+        victim.output_tokens
+
+    dst = _engine(**geom)
+    [cont] = dst.adopt([entry])
+    dst.run_until_idle()
+    assert victim.output_tokens + cont.output_tokens == \
+        full.output_tokens
+    dst.close()
+
+
+# -- telemetry, bench block, perf gate --------------------------------------
+
+def test_preempt_events_schema_valid(tmp_path):
+    """serving_preempt records validate against the locked schema and
+    carry the per-event required fields."""
+    obs.configure(telemetry_dir=str(tmp_path), rank=0)
+    r = np.random.RandomState(25)
+    eng = _engine(num_pages=8, page_size=4, max_seqs=4)
+    eng.submit(np.asarray(r.randint(0, 48, size=8), np.int32),
+               max_new_tokens=12, priority=0)
+    for _ in range(3):
+        eng.step()
+    eng.submit(np.asarray(r.randint(0, 48, size=8), np.int32),
+               max_new_tokens=12, priority=3)
+    eng.run_until_idle()
+    eng.close()
+    recs = []
+    for name in os.listdir(tmp_path):
+        if name.endswith(".jsonl"):
+            with open(os.path.join(tmp_path, name)) as f:
+                recs.extend(json.loads(ln) for ln in f if ln.strip())
+    problems = obs.validate_records(recs, obs.load_schema(
+        os.path.join(_REPO, "tools", "telemetry_schema.json")))
+    assert problems == []
+    pre = [x for x in recs if x.get("kind") == "event"
+           and x.get("event") == "serving_preempt"]
+    assert len(pre) == 1
+    assert pre[0]["priority"] == 0 and pre[0]["preemptions"] == 1
+    steps = [x for x in recs if x.get("kind") == "event"
+             and x.get("event") == "serving_step"]
+    assert any(x.get("n_preempted") for x in steps)
+    # the evicted-then-finished victim's request event says so
+    req_ev = [x for x in recs if x.get("event") == "serving_request"]
+    assert any(x.get("preemptions") == 1 for x in req_ev)
+
+
+def test_serving_block_prefix_preemption_lane(tmp_path):
+    """The bench ``serving`` block carries the prefix/preemption lane:
+    reuse ratio consistent with its own counters, cached-tier and COW
+    gauges present."""
+    from paddle_tpu.observability import publish
+
+    obs.configure(telemetry_dir=str(tmp_path), rank=0)
+    eng = _engine(max_seqs=4)
+    trace = serving.synthetic_trace(
+        n_requests=8, n_tenants=2, seed=5, vocab=48,
+        prompt_range=(2, 6), output_range=(3, 5),
+        arrival_every=(1, 3), system_prompt_range=(10, 14),
+        tenant_priorities=(1, 0))
+    summary = serving.run_trace(eng, trace, warmup=False)
+    assert summary["prefix_hit_tokens"] > 0
+    block = publish.serving_block()
+    assert block["prefix_cache"] == 1
+    assert block["prefix_hit_tokens"] == eng.kv.prefix_hit_tokens
+    assert block["prefill_tokens"] > 0
+    hit, pre = block["prefix_hit_tokens"], block["prefill_tokens"]
+    assert block["prefix_reuse_ratio"] == round(
+        hit / max(1, hit + pre), 4)
+    assert block["prefix_reuse_ratio"] > 0
+    assert block["kv_cow_copies"] == eng.kv.cow_copies
+    assert block["preemptions"] == eng.scheduler.preemption_count
+    eng.close()
+
+
+@pytest.mark.slow
+def test_perf_analysis_serving_gate_inprocess():
+    """The CI gate itself: >= 2x prefill reduction with identical
+    outputs, plus the preemption identity — exit 0."""
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    try:
+        import perf_analysis
+    finally:
+        sys.path.pop(0)
+    assert perf_analysis.serving_prefix_diff() == 0
+    path = os.path.join(_REPO, "artifacts", "serving_prefix_diff.json")
+    with open(path) as f:
+        report = json.load(f)
+    assert report["outputs_identical"] is True
+    assert report["prefill_reduction_x"] >= 2.0
+    assert report["preemption"]["preempted_eq_baseline"] is True
