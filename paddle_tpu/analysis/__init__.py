@@ -33,6 +33,17 @@ Six checkers (see README.md in this directory for the full catalog):
 Surfaces: ``tools/tpu_lint.py`` (CLI, JSON artifact, --fail-on),
 ``FLAGS_tpu_static_checks={off,warn,error}`` (Executor compile-time
 hook), and ``bench.py``'s ``"static_checks"`` summary block.
+
+Beyond the per-program IR checkers there is a PROTOCOL tier
+(protocol.py + proto_models.py): an explicit-state interleaving
+checker that drives the REAL host-protocol implementations — RPC
+envelope retry/dedupe, PS exactly-once apply across kill/restart, the
+elastic preemption seam, serving drain->adopt and the paged-KV page
+ledger — through every reachable message/crash/preemption
+interleaving up to a schedule budget, checking exactly-once, seam
+agreement, drain conservation, page conservation and deadlock-freedom
+at every state. Violations surface as ``Finding``s with compact
+REPLAYABLE traces (``tools/tpu_lint.py --protocol``).
 """
 from __future__ import annotations
 
@@ -54,6 +65,9 @@ from .sharding import (check_shard_plan,  # noqa: F401
                        check_sparse_update, check_zero2_lifetimes)
 from .contracts import (check_dtype_shape_contracts,  # noqa: F401
                         check_quantization_contracts)
+from .protocol import (ExploreResult, ProtocolModel,  # noqa: F401
+                       explore, format_trace, parse_trace, replay,
+                       run_protocol_checks)
 
 __all__ = [
     "Finding", "SEVERITIES", "CHECKERS", "format_finding",
@@ -66,6 +80,8 @@ __all__ = [
     "check_host_sync", "check_shard_plan", "check_sparse_update",
     "check_zero2_lifetimes", "check_dtype_shape_contracts",
     "check_quantization_contracts", "run_static_checks",
+    "ProtocolModel", "ExploreResult", "explore", "replay",
+    "format_trace", "parse_trace", "run_protocol_checks",
 ]
 
 #: checker registry: name -> "does it run in the single-program pass"

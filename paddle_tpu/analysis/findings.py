@@ -20,17 +20,27 @@ _RANK = {s: i for i, s in enumerate(SEVERITIES)}
 
 
 class Finding:
-    """One static-analysis result with an op/var location."""
+    """One static-analysis result with an op/var location.
+
+    Protocol-tier findings (analysis/protocol.py) reuse the same record
+    with a SCHEDULE location instead of an op location: ``trace`` is the
+    compact replayable schedule (the trace seed — feed it back to
+    ``protocol.replay`` verbatim), ``op_idx`` is the step index within
+    that trace the violation was observed at, ``op_type`` the action
+    label and ``var`` the acting actor. Same contract as op/var: the
+    seeded-defect fixtures assert the exact location.
+    """
 
     __slots__ = ("checker", "severity", "message", "block_idx", "op_idx",
-                 "op_type", "var", "rank")
+                 "op_type", "var", "rank", "trace")
 
     def __init__(self, checker: str, severity: str, message: str,
                  block_idx: Optional[int] = None,
                  op_idx: Optional[int] = None,
                  op_type: Optional[str] = None,
                  var: Optional[str] = None,
-                 rank: Optional[object] = None):
+                 rank: Optional[object] = None,
+                 trace: Optional[str] = None):
         if severity not in SEVERITIES:
             raise ValueError("unknown severity %r" % (severity,))
         self.checker = checker
@@ -41,6 +51,7 @@ class Finding:
         self.op_type = op_type
         self.var = var
         self.rank = rank  # rank label for cross-rank divergence findings
+        self.trace = trace  # replayable schedule (protocol tier only)
 
     @property
     def location(self) -> str:
@@ -54,12 +65,23 @@ class Finding:
             if self.op_type:
                 loc += " (%s)" % self.op_type
             parts.append(loc)
+        if self.trace is not None and self.block_idx is None:
+            # protocol-tier location: actor + step index into the trace
+            if self.var:
+                parts.append("actor %r" % self.var)
+            if self.op_idx is not None:
+                loc = "step %d" % self.op_idx
+                if self.op_type:
+                    loc += " (%s)" % self.op_type
+                parts.append(loc)
+            parts.append("trace %r" % self.trace)
+            return ", ".join(parts)
         if self.var:
             parts.append("var %r" % self.var)
         return ", ".join(parts)
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "checker": self.checker,
             "severity": self.severity,
             "message": self.message,
@@ -69,6 +91,9 @@ class Finding:
             "var": self.var,
             "rank": self.rank,
         }
+        if self.trace is not None:
+            out["trace"] = self.trace
+        return out
 
     def __repr__(self):
         return "Finding(%s)" % format_finding(self)

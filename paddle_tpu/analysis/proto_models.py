@@ -1,0 +1,1163 @@
+"""Protocol models: the REAL host-protocol code behind a simulated
+transport, explored by analysis/protocol.py.
+
+Each model here wraps production objects — an `RpcServer` dedup table,
+a PS-style stateful handler, three `ElasticWorld`s, a `Scheduler` +
+`PagedKVCache` pair — and exposes the nondeterminism the real world
+injects (delivery order, duplication, delayed retries, crash points,
+notice timing) as explicit checker-owned actions. The code under test
+is NOT reimplemented: `rpc_envelope` and `ps_apply` run the real
+`RpcServer._dispatch` state machine via `RpcServer.dispatch_only`,
+`elastic_seam` runs real `ElasticWorld.sync()`/`resize()` over a
+simulated store/group, `serving_drain` drives the real `Scheduler` and
+the real `drain_manifest_entry`/`adopt_submit_kwargs` manifest
+contract, and `kv_pages` mutates a real `PagedKVCache` and audits it
+with its own `check_invariants()`.
+
+`PROTOCOLS` is the shipped registry (all must explore clean at any
+budget); `MUTANTS` holds one seeded defect per invariant class for the
+regression harness (tests/test_proto_check.py) — each must be caught
+with a replayable trace.
+
+Determinism contract: a model is a pure function of its action
+sequence — no wall clock in decisions, fresh id counters per reset,
+stable action argument encoding — because the engine replays prefixes
+on fresh instances at every backtrack.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import shutil
+import tempfile
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .protocol import Action, ProtocolModel
+
+__all__ = ["PROTOCOLS", "MUTANTS",
+           "RpcEnvelopeModel", "PsApplyModel", "ElasticSeamModel",
+           "ServingDrainModel", "KvPagesModel"]
+
+
+# =======================================================================
+# 1. rpc_envelope — retry/dedupe of the PR 1 envelope
+# =======================================================================
+
+class RpcEnvelopeModel(ProtocolModel):
+    """One client, two sequential enveloped requests, a lossy network.
+
+    The server is the REAL `RpcServer` (socketless `dispatch_only`);
+    the checker owns delivery order, drops, duplication and the
+    client's timeout-retry. Invariants: exactly-once (the handler never
+    runs twice for one (cid, seq)), response correctness (an accepted
+    response is the canonical one for its seq), and quiescence (the
+    retry discipline must drain every schedule — a dropped request
+    with no retry path deadlocks, which is what the no_retry mutant
+    seeds)."""
+
+    name = "rpc_envelope"
+    N_REQUESTS = 2
+    MAX_DROPS = 2      # total lost messages (requests + responses)
+    MAX_DUPS = 1       # network-duplicated request copies
+    MAX_RETRIES = 2    # per-seq client retransmissions
+    client_retries = True  # mutant hook: False = fire-and-forget client
+
+    def reset(self) -> None:
+        from ..distributed import rpc
+
+        self._rpc = rpc
+        self.applied: List[tuple] = []   # (cid, seq) per handler run
+
+        def handler(method, args):
+            self.applied.append(rpc.current_request_ctx())
+            return ["v%d" % int(args[0])]
+
+        self.server = rpc.RpcServer.dispatch_only(handler)
+        self.cid = "c0"
+        self.next_seq = 0          # next request the client will send
+        self.outstanding: Optional[int] = None
+        self.acked: List[tuple] = []   # (seq, resp fields) accepted
+        self.req_net: List[tuple] = []   # in-flight (msg_id, seq)
+        self.resp_net: List[tuple] = []  # (msg_id, seq, resp tuple)
+        self.drops = 0
+        self.dups = 0
+        self.retries = [0] * self.N_REQUESTS
+        self._next_mid = 0
+
+    def _mid(self) -> int:
+        self._next_mid += 1
+        return self._next_mid
+
+    def done(self) -> bool:
+        return (self.next_seq >= self.N_REQUESTS
+                and self.outstanding is None
+                and not self.req_net and not self.resp_net)
+
+    def actions(self) -> List[Action]:
+        acts: List[Action] = []
+        if self.outstanding is None and self.next_seq < self.N_REQUESTS:
+            acts.append(("client", "send"))
+        if self.outstanding is not None and self.client_retries \
+                and self.retries[self.outstanding] < self.MAX_RETRIES \
+                and not any(s == self.outstanding
+                            for _, s in self.req_net) \
+                and not any(s == self.outstanding
+                            for _, s, _r in self.resp_net):
+            acts.append(("client", "retry"))
+        for mid, seq in self.req_net:
+            acts.append(("net", "deliver", mid))
+            if self.drops < self.MAX_DROPS:
+                acts.append(("net", "drop", mid))
+            if self.dups < self.MAX_DUPS:
+                acts.append(("net", "dup", mid))
+        for mid, seq, _resp in self.resp_net:
+            acts.append(("net", "rdeliver", mid))
+            if self.drops < self.MAX_DROPS:
+                acts.append(("net", "rdrop", mid))
+        return acts
+
+    def step(self, action: Action) -> None:
+        actor, label = action[0], action[1]
+        if label == "send":
+            self.outstanding = self.next_seq
+            self.req_net.append((self._mid(), self.next_seq))
+        elif label == "retry":
+            self.retries[self.outstanding] += 1
+            self.req_net.append((self._mid(), self.outstanding))
+        elif label == "deliver":
+            mid = action[2]
+            i = next(k for k, m in enumerate(self.req_net)
+                     if m[0] == mid)
+            _, seq = self.req_net.pop(i)
+            fields = [self._rpc._ENVELOPE, self.cid, seq, "bump", seq]
+            resp, _stop, _m = self.server._dispatch(fields)
+            self.resp_net.append((self._mid(), seq, tuple(resp)))
+        elif label == "drop":
+            mid = action[2]
+            self.req_net = [m for m in self.req_net if m[0] != mid]
+            self.drops += 1
+        elif label == "dup":
+            mid = action[2]
+            seq = next(s for m, s in self.req_net if m == mid)
+            self.req_net.append((self._mid(), seq))
+            self.dups += 1
+        elif label == "rdeliver":
+            mid = action[2]
+            i = next(k for k, m in enumerate(self.resp_net)
+                     if m[0] == mid)
+            _, seq, resp = self.resp_net.pop(i)
+            if seq == self.outstanding and resp and resp[0] == "ok":
+                self.acked.append((seq, resp))
+                self.outstanding = None
+                self.next_seq = seq + 1
+            # anything else is a stale/duplicate response: discarded
+        elif label == "rdrop":
+            mid = action[2]
+            self.resp_net = [m for m in self.resp_net if m[0] != mid]
+            self.drops += 1
+        else:
+            raise ValueError("unknown action %r" % (action,))
+
+    def invariants(self) -> List[Tuple[str, str]]:
+        out: List[Tuple[str, str]] = []
+        seen: Dict[tuple, int] = {}
+        for ctx in self.applied:
+            seen[ctx] = seen.get(ctx, 0) + 1
+        for ctx, n in sorted(seen.items()):
+            if n > 1:
+                out.append((
+                    "exactly-once",
+                    "handler ran %d times for (cid=%s, seq=%d) — a "
+                    "retried envelope was double-applied"
+                    % (n, ctx[0], ctx[1])))
+        for seq, resp in self.acked:
+            want = ("ok", "v%d" % seq)
+            if tuple(resp) != want:
+                out.append((
+                    "response-integrity",
+                    "client accepted %r for seq %d (want %r)"
+                    % (resp, seq, want)))
+        return out
+
+    def fingerprint(self):
+        dedup = tuple(sorted(
+            (cid, ent["seq"],
+             tuple(ent["resp"]) if ent["resp"] is not None else None,
+             ent["stop"])
+            for cid, ent in self.server._dedup.items()))
+        return ("rpc", self.next_seq, self.outstanding,
+                tuple(sorted(s for _, s in self.req_net)),
+                tuple(sorted((s, r) for _, s, r in self.resp_net)),
+                self.drops, self.dups, tuple(self.retries),
+                tuple(self.applied), tuple(self.acked), dedup)
+
+
+class RpcNoRetryMutant(RpcEnvelopeModel):
+    """Seeded defect (quiescence class): a fire-and-forget client.
+    After the network drops its only copy, nobody can make progress —
+    the checker must surface the deadlock with the drop in the trace."""
+
+    name = "rpc_envelope__no_retry"
+    client_retries = False
+
+
+# =======================================================================
+# 2. ps_apply — exactly-once apply across server kill/restart
+# =======================================================================
+
+class PsApplyModel(ProtocolModel):
+    """A stateful PS-style server: each request adds 1 to a table and
+    records an applied-marker, both persisted ATOMICALLY (the
+    `ps._record_applied` + `_maybe_persist` discipline). A crash
+    restores the last checkpoint and rebuilds the REAL RpcServer dedup
+    table from the restored markers via `dedup_restore`, exactly like
+    `ps.PServer` restart.
+
+    Invariant (checked at every state): the table equals the number of
+    applies the marker map accounts for — mutation and marker can never
+    diverge, in memory or across a restart. The non_atomic mutant
+    persists the table with a STALE marker map; a crash then resurrects
+    a table that remembers the apply while the dedup tier forgot it,
+    and the client's retry double-applies."""
+
+    name = "ps_apply"
+    N_REQUESTS = 2
+    MAX_CRASHES = 2
+    MAX_RETRIES = 3
+    atomic_persist = True  # mutant hook
+
+    def reset(self) -> None:
+        from ..distributed import rpc
+
+        self._rpc = rpc
+        self.table = 0
+        self.markers: Dict[str, tuple] = {}  # cid -> (seq, resp, stop)
+        self.checkpoint = (0, {})            # durable (table, markers)
+
+        def handler(method, args):
+            cid, seq = rpc.current_request_ctx()
+            prev = dict(self.markers)
+            self.table += 1
+            resp = ("ok", "v%d" % int(seq))
+            self.markers[cid] = (int(seq), resp, False)
+            # the atomic persist: mutation + marker in ONE checkpoint
+            # (tmp+fsync+rename in the real tier). The mutant persists
+            # the mutated table against the PRE-mutation marker map.
+            self.checkpoint = (
+                self.table,
+                dict(self.markers) if self.atomic_persist else prev)
+            return ["v%d" % int(seq)]
+
+        self._handler = handler
+        self.server = rpc.RpcServer.dispatch_only(handler)
+        self.cid = "trainer0"
+        self.next_seq = 0
+        self.outstanding: Optional[int] = None
+        self.acked: List[tuple] = []
+        self.req_net: List[tuple] = []   # (msg_id, seq)
+        self.resp_net: List[tuple] = []  # (msg_id, seq, resp)
+        self.crashes = 0
+        self.retries = [0] * self.N_REQUESTS
+        self._next_mid = 0
+
+    def _mid(self) -> int:
+        self._next_mid += 1
+        return self._next_mid
+
+    def done(self) -> bool:
+        return (self.next_seq >= self.N_REQUESTS
+                and self.outstanding is None
+                and not self.req_net and not self.resp_net)
+
+    def actions(self) -> List[Action]:
+        acts: List[Action] = []
+        if self.outstanding is None and self.next_seq < self.N_REQUESTS:
+            acts.append(("client", "send"))
+        if self.outstanding is not None \
+                and self.retries[self.outstanding] < self.MAX_RETRIES \
+                and not any(s == self.outstanding
+                            for _, s in self.req_net) \
+                and not any(s == self.outstanding
+                            for _, s, _r in self.resp_net):
+            acts.append(("client", "retry"))
+        for mid, _seq in self.req_net:
+            acts.append(("net", "deliver", mid))
+        for mid, _seq, _resp in self.resp_net:
+            acts.append(("net", "rdeliver", mid))
+        if self.crashes < self.MAX_CRASHES and not self.done():
+            acts.append(("server", "crash"))
+        return acts
+
+    def step(self, action: Action) -> None:
+        label = action[1]
+        if label == "send":
+            self.outstanding = self.next_seq
+            self.req_net.append((self._mid(), self.next_seq))
+        elif label == "retry":
+            self.retries[self.outstanding] += 1
+            self.req_net.append((self._mid(), self.outstanding))
+        elif label == "deliver":
+            mid = action[2]
+            i = next(k for k, m in enumerate(self.req_net)
+                     if m[0] == mid)
+            _, seq = self.req_net.pop(i)
+            fields = [self._rpc._ENVELOPE, self.cid, seq, "inc", seq]
+            resp, _stop, _m = self.server._dispatch(fields)
+            self.resp_net.append((self._mid(), seq, tuple(resp)))
+        elif label == "rdeliver":
+            mid = action[2]
+            i = next(k for k, m in enumerate(self.resp_net)
+                     if m[0] == mid)
+            _, seq, resp = self.resp_net.pop(i)
+            if seq == self.outstanding and resp and resp[0] == "ok":
+                self.acked.append((seq, resp))
+                self.outstanding = None
+                self.next_seq = seq + 1
+        elif label == "crash":
+            # kill -9 + restart: volatile state (table, markers, dedup,
+            # in-flight responses) is rebuilt from the checkpoint; the
+            # restored markers re-seed the REAL dedup table exactly as
+            # ps.PServer does on restore
+            self.crashes += 1
+            self.table = self.checkpoint[0]
+            self.markers = dict(self.checkpoint[1])
+            self.resp_net = []
+            self.server = self._rpc.RpcServer.dispatch_only(
+                self._handler)
+            snap = {cid: [seq, self._rpc.encode(list(resp))[8:], stop]
+                    for cid, (seq, resp, stop) in self.markers.items()}
+            self.server.dedup_restore(snap)
+        else:
+            raise ValueError("unknown action %r" % (action,))
+
+    def invariants(self) -> List[Tuple[str, str]]:
+        out: List[Tuple[str, str]] = []
+        accounted = sum(seq + 1 for seq, _r, _s in
+                        self.markers.values())
+        if self.table != accounted:
+            out.append((
+                "exactly-once",
+                "table=%d but applied-markers account for %d applies "
+                "— mutation and marker diverged (a retried seq will "
+                "double-apply or a committed apply was lost)"
+                % (self.table, accounted)))
+        for seq, resp in self.acked:
+            want = ("ok", "v%d" % seq)
+            if tuple(resp) != want:
+                out.append((
+                    "response-integrity",
+                    "client accepted %r for seq %d (want %r)"
+                    % (resp, seq, want)))
+        return out
+
+    def fingerprint(self):
+        dedup = tuple(sorted(
+            (cid, ent["seq"],
+             tuple(ent["resp"]) if ent["resp"] is not None else None)
+            for cid, ent in self.server._dedup.items()))
+        return ("ps", self.table, tuple(sorted(self.markers.items())),
+                self.checkpoint[0],
+                tuple(sorted(self.checkpoint[1].items())),
+                self.next_seq, self.outstanding,
+                tuple(sorted(s for _, s in self.req_net)),
+                tuple(sorted((s, r) for _, s, r in self.resp_net)),
+                self.crashes, tuple(self.retries), dedup)
+
+
+class PsNonAtomicPersistMutant(PsApplyModel):
+    """Seeded defect (exactly-once class): the table is persisted with
+    a STALE marker map (marker write not atomic with the mutation).
+    Crash + restore resurrects the apply without its marker; the
+    checker must catch table/marker divergence at the crash state."""
+
+    name = "ps_apply__non_atomic_persist"
+    atomic_persist = False
+
+
+# =======================================================================
+# 3. elastic_seam — doomed-set agreement + generation bump
+# =======================================================================
+
+_ELASTIC_ENV_KEYS = ("PADDLE_LAUNCH_RANK", "PADDLE_TRAINER_ID",
+                     "PADDLE_TRAINERS_NUM", "PADDLE_TRAINER_ENDPOINTS")
+
+_elastic_tmpdir: Optional[str] = None
+
+
+def _elastic_dir() -> str:
+    """One scratch telemetry dir for every elastic-model instance
+    (markers are cleared per reset; tempfile names would otherwise leak
+    nondeterminism into nothing, but one dir keeps the FS quiet)."""
+    global _elastic_tmpdir
+    if _elastic_tmpdir is None or not os.path.isdir(_elastic_tmpdir):
+        _elastic_tmpdir = tempfile.mkdtemp(prefix="proto_elastic_")
+    return _elastic_tmpdir
+
+
+class _SimStore:
+    """The host-collective store as a dict — notice keys only."""
+
+    def __init__(self):
+        self.kv: Dict[str, object] = {}
+
+
+class _SimGroup:
+    """The HostCollectiveGroup surface ElasticWorld touches, minus the
+    sockets. `all_reduce(op="max")` returns the model-precomputed
+    agreed bitmap (two-pass trick: the model polls every rank first,
+    computes the true elementwise max, then replays each rank's real
+    sync() against it) — unless `local_only`, the seeded agreement
+    defect, where each rank sees only its own bitmap."""
+
+    def __init__(self, rank, world, store, local_only=False):
+        self.rank = int(rank)
+        self.world = int(world)
+        self.store = store
+        self.local_only = bool(local_only)
+        self.reduce_hint = None
+
+    def peek(self, key):
+        return self.store.kv.get(key)
+
+    def barrier(self):
+        return None
+
+    def all_reduce(self, arr, op="sum"):
+        a = np.asarray(arr)
+        if op == "max" and not self.local_only \
+                and self.reduce_hint is not None:
+            return np.maximum(a, self.reduce_hint)
+        return a.copy()
+
+    def leave(self):
+        return None
+
+    def shutdown(self):
+        return None
+
+
+class ElasticSeamModel(ProtocolModel):
+    """Three REAL `ElasticWorld`s over a simulated store/group. The
+    checker owns notice timing (which rank, when) and the per-rank
+    order the seam executes in. Because `preemption._pending` and the
+    PADDLE_* env are process-global (one-rank-per-process in
+    production), every rank action runs inside a context swap that
+    gives rank r its own pending-notice slot and env.
+
+    Invariants: seam agreement (every rank's sync() returns the SAME
+    doomed set — the skip_agreement mutant breaks exactly this),
+    post-seam consistency (survivor reports agree on generation /
+    new_world / doomed; new_world arithmetic holds), and the doomed
+    rank's preempt marker exists (the degrade breadcrumb)."""
+
+    name = "elastic_seam"
+    WORLD = 3
+    MAX_NOTICES = 2
+    MAX_ROUNDS = 2
+    skip_agreement = False  # mutant hook
+
+    def reset(self) -> None:
+        from ..distributed import preemption
+        from ..distributed import host_collectives
+        from ..observability import flight
+        from ..utils import flags
+
+        self._P = preemption
+        self._hc = host_collectives
+        self._flight = flight
+        self._flags = flags
+        # swap globals for the model's lifetime; close() restores
+        self._saved_pending = preemption._pending
+        preemption._pending = None
+        self._saved_env = {k: os.environ.get(k)
+                           for k in _ELASTIC_ENV_KEYS}
+        self._saved_group_cls = host_collectives.HostCollectiveGroup
+        host_collectives.HostCollectiveGroup = self._make_group
+        self._saved_dump = flight.dump
+        flight.dump = lambda *a, **k: None
+        self._saved_dir = flags.get_flag("FLAGS_tpu_telemetry_dir", "")
+        self.dir = _elastic_dir()
+        flags.set_flags({"FLAGS_tpu_telemetry_dir": self.dir})
+        for name in os.listdir(self.dir):
+            if name.startswith("preempted.rank"):
+                os.unlink(os.path.join(self.dir, name))
+
+        self.endpoints = ["127.0.0.1:71%02d" % r
+                          for r in range(self.WORLD)]
+        self.store = _SimStore()
+        self.stores: Dict[str, _SimStore] = {}
+        self.worlds: Dict[int, object] = {}
+        self.pending: Dict[int, object] = {}
+        self.env: Dict[int, dict] = {}
+        for r in range(self.WORLD):
+            self.env[r] = {
+                "PADDLE_LAUNCH_RANK": str(r),
+                "PADDLE_TRAINER_ID": str(r),
+                "PADDLE_TRAINERS_NUM": str(self.WORLD),
+                "PADDLE_TRAINER_ENDPOINTS": ",".join(self.endpoints),
+            }
+            self.pending[r] = None
+            with self._rank_ctx(r):
+                group = _SimGroup(r, self.WORLD, self.store,
+                                  local_only=self.skip_agreement)
+                self.worlds[r] = preemption.ElasticWorld(
+                    group, self.endpoints)
+        self.live = list(range(self.WORLD))
+        self.noticed: List[int] = []
+        self.rounds_left = self.MAX_ROUNDS
+        self.round_doomed: Optional[Dict[int, tuple]] = None
+        self.agreed: Optional[tuple] = None
+        self.resized: List[int] = []
+        self.reports: Dict[int, dict] = {}
+        self.snapshots: Dict[int, tuple] = {}
+        self.seam_done = False
+
+    def close(self) -> None:
+        self._P._pending = self._saved_pending
+        for k, v in self._saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        self._hc.HostCollectiveGroup = self._saved_group_cls
+        self._flight.dump = self._saved_dump
+        self._flags.set_flags(
+            {"FLAGS_tpu_telemetry_dir": self._saved_dir})
+
+    def _make_group(self, rank, world, store_endpoint, generation=0):
+        """What survivors rebuild through inside resize() — shared
+        store per generation-bumped endpoint."""
+        store = self.stores.setdefault(str(store_endpoint), _SimStore())
+        return _SimGroup(rank, world, store,
+                         local_only=self.skip_agreement)
+
+    @contextlib.contextmanager
+    def _rank_ctx(self, r):
+        """Make the process-global notice slot + PADDLE_* env belong to
+        rank r for the duration (one-rank-per-process emulation)."""
+        saved_pending = self._P._pending
+        saved_env = {k: os.environ.get(k) for k in _ELASTIC_ENV_KEYS}
+        self._P._pending = self.pending[r]
+        for k in _ELASTIC_ENV_KEYS:
+            v = self.env[r].get(k)
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        try:
+            yield
+        finally:
+            self.pending[r] = self._P._pending
+            self.env[r] = {k: os.environ.get(k)
+                           for k in _ELASTIC_ENV_KEYS}
+            self._P._pending = saved_pending
+            for k, v in saved_env.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    def done(self) -> bool:
+        return self.agreed is None
+
+    def actions(self) -> List[Action]:
+        acts: List[Action] = []
+        if self.agreed is None:
+            if not self.seam_done \
+                    and len(self.noticed) < self.MAX_NOTICES:
+                # rank 0 stays (someone must survive to rebuild)
+                for r in range(1, self.WORLD):
+                    if r not in self.noticed:
+                        acts.append(("sched", "notice", r))
+            if self.rounds_left > 0:
+                acts.append(("world", "round"))
+        else:
+            for r in self.live:
+                if r not in self.resized:
+                    acts.append(("rank%d" % r, "resize", r))
+        return acts
+
+    def step(self, action: Action) -> None:
+        label = action[1]
+        if label == "notice":
+            r = action[2]
+            self.noticed.append(r)
+            # the RPC-delivered path: post_notice() drops a grace blob
+            # under the rank's store key; sync()'s peek finds it
+            self.store.kv["preempt/%d" % r] = np.asarray(
+                [30.0], np.float64)
+        elif label == "round":
+            self._round()
+        elif label == "resize":
+            self._resize(action[2])
+        else:
+            raise ValueError("unknown action %r" % (action,))
+
+    def _round(self) -> None:
+        self.rounds_left -= 1
+        world = self.worlds[self.live[0]].world
+        # pass A: poll every rank (idempotent: first notice wins) so
+        # the TRUE allreduce-max bitmap is known before any rank syncs
+        bitmap = np.zeros((world,), np.int8)
+        for r in self.live:
+            with self._rank_ctx(r):
+                notice = self.worlds[r].poll_notice()
+            if notice is not None:
+                bitmap[self.worlds[r].rank] = 1
+        # pass B: each rank's REAL sync() against the agreed max
+        doomed_by_rank: Dict[int, tuple] = {}
+        for r in self.live:
+            group = self.worlds[r].group
+            group.reduce_hint = bitmap
+            try:
+                with self._rank_ctx(r):
+                    doomed_by_rank[r] = tuple(self.worlds[r].sync())
+            finally:
+                group.reduce_hint = None
+        self.round_doomed = doomed_by_rank
+        views = set(doomed_by_rank.values())
+        if len(views) == 1:
+            agreed = views.pop()
+            if agreed:
+                # doomed sets are in CURRENT group-rank space == live
+                # original-rank space pre-resize (contiguous there)
+                self.agreed = agreed
+                self.resized = []
+                self.reports = {}
+                self.snapshots = {}
+
+    def _resize(self, r: int) -> None:
+        with self._rank_ctx(r):
+            report = self.worlds[r].resize(
+                list(self.agreed),
+                snapshot=lambda d, _r=r: self.snapshots.__setitem__(
+                    _r, tuple(d)),
+                step=7)
+        self.reports[r] = report
+        self.resized.append(r)
+        if len(self.resized) == len(self.live):
+            doomed = set(self.agreed)
+            self.live = [x for x in self.live if x not in doomed]
+            self.agreed = None
+            self.seam_done = True
+
+    def invariants(self) -> List[Tuple[str, str]]:
+        out: List[Tuple[str, str]] = []
+        if self.round_doomed is not None:
+            views = {r: d for r, d in self.round_doomed.items()}
+            if len(set(views.values())) > 1:
+                out.append((
+                    "seam-agreement",
+                    "ranks disagree on the doomed set after sync(): %s"
+                    % ({("rank%d" % r): list(d)
+                        for r, d in sorted(views.items())},)))
+        if self.seam_done and self.reports:
+            from ..distributed.preemption import read_preempt_markers
+
+            survivors = {r: rep for r, rep in self.reports.items()
+                         if rep.get("role") == "survivor"}
+            doomed = {r: rep for r, rep in self.reports.items()
+                      if rep.get("role") == "doomed"}
+            for key in ("generation", "new_world", "doomed"):
+                vals = {repr(rep.get(key))
+                        for rep in survivors.values()}
+                if len(vals) > 1:
+                    out.append((
+                        "seam-agreement",
+                        "survivor reports disagree on %r: %s"
+                        % (key, sorted(vals))))
+            for r, rep in survivors.items():
+                if rep["new_world"] != rep["old_world"] - \
+                        len(rep["doomed"]):
+                    out.append((
+                        "seam-agreement",
+                        "rank%d: new_world %d != old_world %d - "
+                        "len(doomed) %d"
+                        % (r, rep["new_world"], rep["old_world"],
+                           len(rep["doomed"]))))
+            marker_ranks = {int(d["rank"]) for d in
+                            read_preempt_markers(self.dir)}
+            for r in doomed:
+                if self.worlds[r].launch_rank not in marker_ranks:
+                    out.append((
+                        "seam-agreement",
+                        "doomed rank%d left no preempt marker — the "
+                        "degrade-to-restart breadcrumb is missing"
+                        % r))
+        return out
+
+    def fingerprint(self):
+        return ("elastic", tuple(self.live), tuple(self.noticed),
+                self.rounds_left, self.agreed, tuple(self.resized),
+                self.seam_done,
+                tuple(sorted(self.store.kv)),
+                tuple((r, self.pending[r] is not None)
+                      for r in sorted(self.pending)),
+                tuple((r, self.worlds[r].generation,
+                       self.worlds[r].world)
+                      for r in sorted(self.worlds) if r in self.live))
+
+
+class ElasticLocalDecisionMutant(ElasticSeamModel):
+    """Seeded defect (seam-agreement class): sync()'s allreduce is
+    replaced by each rank's LOCAL bitmap — the noticed rank thinks it
+    is leaving, nobody else does, and the group splits."""
+
+    name = "elastic_seam__local_decision"
+    skip_agreement = True
+
+
+# =======================================================================
+# 4. serving_drain — drain -> adopt manifest conservation
+# =======================================================================
+
+class ServingDrainModel(ProtocolModel):
+    """A primary and a survivor serving stack — REAL `Scheduler` +
+    `PagedKVCache` pairs, with the engine's step choreography reduced
+    to its scheduler/KV interactions (deterministic token function, no
+    device). Drain uses the REAL `drain_manifest_entry` /
+    `adopt_submit_kwargs` contract — the model explores the exact
+    entry shape production exports.
+
+    The checker owns submit timing, step count before the preemption
+    notice lands, user cancellation and the drain point. Invariants:
+    drain/adopt conservation (every submitted request retires exactly
+    once: finished on the primary, user-cancelled, or migrated AND
+    finished on the survivor — the skip_prefill mutant vanishes a
+    mid-prefill request), token conservation + sampling-key continuity
+    across the seam, no double-publish, and both pools'
+    `check_invariants()` at every state."""
+
+    name = "serving_drain"
+    #: (prompt tokens, max_new): the 5-token prompt spans two 4-token
+    #: prefill chunks, so drain-during-PREFILL is reachable
+    SCRIPT = (((1, 2, 3, 4, 5), 2), ((1, 2), 2))
+    MAX_CANCELS = 1
+    migrate_prefill = True  # mutant hook
+
+    def reset(self) -> None:
+        self.kv1, self.sched1 = self._make_stack()
+        self.kv2, self.sched2 = self._make_stack()
+        self.reqs: Dict[int, object] = {}      # script idx -> Request
+        self.script_of: Dict[int, int] = {}    # request_id -> idx
+        self.adopted: Dict[int, object] = {}   # idx -> survivor Request
+        self.entries: Dict[int, dict] = {}     # idx -> manifest entry
+        self.user_cancelled: List[int] = []
+        self.drained = False
+        self.cancels = 0
+        self.published: List[tuple] = []       # (engine, request_id)
+
+    def _make_stack(self):
+        from ..serving.kv_cache import KVCacheConfig, PagedKVCache
+        from ..serving.scheduler import BucketPlan, Scheduler
+
+        cfg = KVCacheConfig(num_pages=4, page_size=4, pages_per_seq=2,
+                            num_layers=1, num_kv_heads=1, head_dim=1)
+        kv = PagedKVCache(cfg, prefix_cache=True, cached_pages=0)
+        plan = BucketPlan(decode_batches=(2,), prefill_tokens=(4,),
+                          prefill_batch=2)
+        sched = Scheduler(kv, plan, max_seqs=2, max_queue=0,
+                          max_context=None, aging_steps=0)
+        return kv, sched
+
+    def _terminal(self) -> bool:
+        return self.drained and self.sched1.idle and self.sched2.idle
+
+    def done(self) -> bool:
+        return self._terminal()
+
+    def actions(self) -> List[Action]:
+        acts: List[Action] = []
+        if not self.drained:
+            for i in range(len(self.SCRIPT)):
+                if i not in self.reqs:
+                    acts.append(("user", "submit", i))
+            if self.cancels < self.MAX_CANCELS:
+                for i, req in sorted(self.reqs.items()):
+                    if not req.done and i not in self.user_cancelled:
+                        acts.append(("user", "cancel", i))
+            if not self.sched1.idle:
+                acts.append(("eng1", "step"))
+            if self.reqs:
+                acts.append(("eng1", "drain"))
+        elif not self.sched2.idle:
+            acts.append(("eng2", "step"))
+        return acts
+
+    def step(self, action: Action) -> None:
+        from ..serving.scheduler import RequestState
+
+        label = action[1]
+        if label == "submit":
+            i = action[2]
+            prompt, max_new = self.SCRIPT[i]
+            req = self.sched1.new_request(
+                np.asarray(prompt, np.int32), max_new)
+            self.reqs[i] = req
+            self.script_of[req.request_id] = i
+        elif label == "cancel":
+            i = action[2]
+            self.cancels += 1
+            self.user_cancelled.append(i)
+            self.reqs[i].cancel()
+        elif label == "step":
+            if action[0] == "eng1":
+                self._engine_step(self.sched1, self.kv1, "eng1")
+            else:
+                self._engine_step(self.sched2, self.kv2, "eng2")
+        elif label == "drain":
+            self._drain(RequestState)
+        else:
+            raise ValueError("unknown action %r" % (action,))
+
+    def _engine_step(self, sched, kv, which) -> None:
+        """Engine.step's scheduler choreography: retire/publish, admit,
+        apply COW copies, one prefill chunk OR one decode token per
+        running request, finish checks, retire/publish."""
+        from ..serving.scheduler import RequestState
+
+        for req in sched.retire():
+            self.published.append((which, req.request_id))
+        sched.admit()
+        kv.take_pending_copies()  # engine applies before dispatch
+        group, _b, chunk = sched.prefill_group()
+        if group:
+            for req in group:
+                take = min(chunk, req.prefill_len - req.prefilled)
+                req.prefilled += take
+                req.context_len = req.prefilled
+                if req.prefilled >= req.prefill_len:
+                    kv.register_prefix(
+                        req.request_id,
+                        [int(t) for t in req.full_prompt])
+                    req.state = RequestState.RUNNING
+                    self._emit(sched, req)
+        else:
+            dgroup, _bkt = sched.decode_group()
+            for req in dgroup:
+                self._emit(sched, req)
+        for req in sched.retire():
+            self.published.append((which, req.request_id))
+
+    @staticmethod
+    def _emit(sched, req) -> None:
+        tok = 100 + len(req.output_tokens)  # deterministic "model"
+        req._emit(tok)
+        req.last_token = tok
+        req.context_len += 1
+        sched.finish_if_done(req)
+
+    def _drain(self, RequestState) -> None:
+        """Engine.drain's manifest construction (grace window elapsed —
+        the checker's step actions already explored early/late drains)
+        followed by the survivor's adopt()."""
+        from ..serving.engine import (adopt_submit_kwargs,
+                                      drain_manifest_entry)
+
+        # the engine's step loop retires cancelled work before the
+        # manifest walk; keep that ordering
+        for req in self.sched1.retire():
+            self.published.append(("eng1", req.request_id))
+        inflight = list(self.sched1.queued) + \
+            list(self.sched1.running.values())
+        manifest: List[Tuple[int, dict]] = []
+        for req in inflight:
+            if req.state == RequestState.FINISHED:
+                continue
+            remaining = int(req.max_new_tokens) - \
+                len(req.output_tokens)
+            if req.state == RequestState.CANCELLED or remaining <= 0:
+                continue
+            if self.migrate_prefill \
+                    or req.state == RequestState.RUNNING:
+                manifest.append((self.script_of[req.request_id],
+                                 drain_manifest_entry(req)))
+            req.cancel()
+        for req in self.sched1.retire():
+            self.published.append(("eng1", req.request_id))
+        self.drained = True
+        for i, entry in manifest:
+            self.entries[i] = entry
+            self.adopted[i] = self.sched2.new_request(
+                np.asarray(entry["prompt"], np.int32),
+                **adopt_submit_kwargs(entry))
+
+    def invariants(self) -> List[Tuple[str, str]]:
+        from ..serving.scheduler import RequestState
+
+        out: List[Tuple[str, str]] = []
+        for which, kv in (("primary", self.kv1),
+                          ("survivor", self.kv2)):
+            for v in kv.check_invariants():
+                out.append(("kv-conservation",
+                            "%s pool: %s" % (which, v)))
+        for i, req in sorted(self.reqs.items()):
+            _prompt, max_new = self.SCRIPT[i]
+            if len(req.output_tokens) > max_new:
+                out.append((
+                    "drain-conservation",
+                    "request %d emitted %d tokens > max_new %d"
+                    % (i, len(req.output_tokens), max_new)))
+        dup = {p for p in self.published
+               if self.published.count(p) > 1}
+        if dup:
+            out.append(("drain-conservation",
+                        "requests published twice: %s" % sorted(dup)))
+        if not self._terminal():
+            return out
+        for i, req in sorted(self.reqs.items()):
+            _prompt, max_new = self.SCRIPT[i]
+            finished1 = req.state == RequestState.FINISHED
+            cancelled = i in self.user_cancelled
+            migrated = i in self.adopted
+            finished2 = migrated and \
+                self.adopted[i].state == RequestState.FINISHED
+            accounts = int(finished1) + int(cancelled) + int(migrated)
+            if accounts == 0:
+                out.append((
+                    "drain-conservation",
+                    "request %d vanished: not finished, not "
+                    "user-cancelled, not in the drain manifest "
+                    "(state=%s)" % (i, req.state)))
+                continue
+            if accounts > 1:
+                out.append((
+                    "drain-conservation",
+                    "request %d retired more than once (finished=%s "
+                    "cancelled=%s migrated=%s)"
+                    % (i, finished1, cancelled, migrated)))
+            if migrated and not finished2:
+                out.append((
+                    "drain-conservation",
+                    "migrated request %d never finished on the "
+                    "survivor (state=%s)"
+                    % (i, self.adopted[i].state)))
+            if migrated and finished2:
+                entry = self.entries[i]
+                total = entry["already_emitted"] + \
+                    len(self.adopted[i].output_tokens)
+                if total != max_new:
+                    out.append((
+                        "drain-conservation",
+                        "request %d token conservation broken: "
+                        "%d emitted pre-drain + %d post-adopt != "
+                        "max_new %d"
+                        % (i, entry["already_emitted"],
+                           len(self.adopted[i].output_tokens),
+                           max_new)))
+                if self.adopted[i].sample_step_offset != \
+                        entry["already_emitted"]:
+                    out.append((
+                        "drain-conservation",
+                        "request %d sampling-key discontinuity: "
+                        "survivor offset %d != %d tokens already "
+                        "emitted"
+                        % (i, self.adopted[i].sample_step_offset,
+                           entry["already_emitted"])))
+        return out
+
+    def _fp_stack(self, sched, kv):
+        reqs = tuple(
+            (r.request_id, r.state, r.prefilled,
+             len(r.output_tokens), r._cancel.is_set())
+            for r in (list(sched.queued)
+                      + sorted(sched.running.values(),
+                               key=lambda x: x.request_id)))
+        return (reqs, tuple(kv._free), tuple(kv._cached),
+                tuple(kv._ref), frozenset(kv._index.items()))
+
+    def fingerprint(self):
+        return ("serving", tuple(sorted(self.reqs)),
+                tuple(self.user_cancelled), self.drained, self.cancels,
+                tuple((i, r.state, len(r.output_tokens))
+                      for i, r in sorted(self.reqs.items())),
+                tuple((i, r.state, len(r.output_tokens))
+                      for i, r in sorted(self.adopted.items())),
+                self._fp_stack(self.sched1, self.kv1),
+                self._fp_stack(self.sched2, self.kv2))
+
+
+class DrainSkipsPrefillMutant(ServingDrainModel):
+    """Seeded defect (drain-conservation class): the drain manifest
+    only exports RUNNING requests — a request caught mid-prefill (or
+    still queued) at the notice is silently dropped instead of
+    migrated. The checker must catch the vanished request with the
+    submit/step/drain schedule in the trace."""
+
+    name = "serving_drain__skip_prefill"
+    migrate_prefill = False
+
+
+# =======================================================================
+# 5. kv_pages — share / COW / park / evict conservation
+# =======================================================================
+
+class KvPagesModel(ProtocolModel):
+    """A REAL `PagedKVCache` (6 pages of 2 tokens, prefix cache on,
+    parked-tier budget 2) driven through admission scripts chosen to
+    force every sharing shape: full-page chain sharing, a sub-page
+    partial leaf, a copy-on-write boundary, parking, and both eviction
+    paths (admission pressure + the cached-pages budget).
+
+    The checker owns admission order, write/COW-apply interleaving and
+    free timing. Invariants: the cache's own `check_invariants()`
+    (page conservation, refcounts vs block tables, index bijection,
+    COW targets) at every state, the parked-tier budget bound, and the
+    COW hazard rule — a write may only land once the pending device
+    copies are applied (writes are gated on that here; the eviction
+    mutant instead corrupts the index/free-list partition, which
+    `check_invariants` must catch)."""
+
+    name = "kv_pages"
+    #: (prompt, max_new): [1,2,3] registers a full page + a partial
+    #: leaf; [1,2,3,4] then shares the full page and COWs the leaf;
+    #: [1,2] re-shares the full chain head
+    SCRIPT = (((1, 2, 3), 1), ((1, 2, 3, 4), 1), ((1, 2), 1))
+    CACHED_BUDGET = 2
+    evict_drops_index = False  # mutant hook
+
+    def reset(self) -> None:
+        from ..serving.kv_cache import KVCacheConfig, PagedKVCache
+
+        cfg = KVCacheConfig(num_pages=6, page_size=2, pages_per_seq=3,
+                            num_layers=1, num_kv_heads=1, head_dim=1)
+        self.kv = PagedKVCache(cfg, prefix_cache=True,
+                               cached_pages=self.CACHED_BUDGET)
+        self.allocated: List[int] = []
+        self.written: Dict[int, int] = {}
+        self.registered: List[int] = []
+        self.freed: List[int] = []
+        self.hazards: List[str] = []
+
+    def done(self) -> bool:
+        return len(self.freed) == len(self.SCRIPT)
+
+    def _total(self, i: int) -> int:
+        prompt, max_new = self.SCRIPT[i]
+        return len(prompt) + max_new
+
+    def actions(self) -> List[Action]:
+        acts: List[Action] = []
+        pending = len(self.kv._pending_copies) > 0
+        for i in range(len(self.SCRIPT)):
+            prompt, _mn = self.SCRIPT[i]
+            if i not in self.allocated:
+                if self.kv.can_admit(self._total(i),
+                                     prompt=list(prompt)):
+                    acts.append(("seq%d" % i, "alloc", i))
+                continue
+            if i in self.freed:
+                continue
+            if not pending and self.written[i] < len(prompt):
+                acts.append(("seq%d" % i, "write", i))
+            if i not in self.registered \
+                    and self.written[i] >= len(prompt):
+                acts.append(("seq%d" % i, "register", i))
+            acts.append(("seq%d" % i, "free", i))
+        if pending:
+            acts.append(("engine", "apply_cow"))
+        return acts
+
+    def step(self, action: Action) -> None:
+        label, i = action[1], action[2] if len(action) > 2 else None
+        if label == "alloc":
+            prompt, _mn = self.SCRIPT[i]
+            pages = self.kv.alloc(i, self._total(i),
+                                  prompt=list(prompt))
+            if pages is None:
+                # can_admit gated the action; a refusal here is a
+                # planner/alloc disagreement worth surfacing
+                self.hazards.append(
+                    "alloc(%d) refused after can_admit said yes" % i)
+                return
+            self.allocated.append(i)
+            self.written[i] = self.kv.seq_cached_tokens(i)
+        elif label == "write":
+            # one page worth of prefill writes; gated on an empty
+            # pending-copy list (the engine applies COW copies before
+            # every dispatch — writing first clobbers the shared src)
+            for src, dst in self.kv._pending_copies:
+                if dst in self.kv._seqs[i].pages:
+                    self.hazards.append(
+                        "seq %d wrote page %d before its COW copy "
+                        "from %d was applied" % (i, dst, src))
+            prompt, _mn = self.SCRIPT[i]
+            ps = self.kv.config.page_size
+            self.written[i] = min(len(prompt), self.written[i] + ps)
+        elif label == "register":
+            prompt, _mn = self.SCRIPT[i]
+            self.kv.register_prefix(i, list(prompt))
+            self.registered.append(i)
+        elif label == "free":
+            self.kv.free(i)
+            self.freed.append(i)
+            if self.evict_drops_index and self.kv._cached:
+                # MUTANT: a parked page is reclaimed without
+                # _drop_index — its stale index entry now points at a
+                # free-list page a future admission would share
+                victim = next(iter(self.kv._cached))
+                del self.kv._cached[victim]
+                self.kv._free.append(victim)
+        elif label == "apply_cow":
+            self.kv.take_pending_copies()
+        else:
+            raise ValueError("unknown action %r" % (action,))
+
+    def invariants(self) -> List[Tuple[str, str]]:
+        out = [("kv-conservation", v)
+               for v in self.kv.check_invariants()]
+        if self.kv.pages_cached > self.CACHED_BUDGET:
+            out.append((
+                "kv-conservation",
+                "parked tier holds %d pages > budget %d"
+                % (self.kv.pages_cached, self.CACHED_BUDGET)))
+        for h in self.hazards:
+            out.append(("cow-hazard", h))
+        return out
+
+    def fingerprint(self):
+        return ("kv", tuple(self.allocated),
+                tuple(sorted(self.written.items())),
+                tuple(self.registered), tuple(self.freed),
+                tuple(self.kv._free), tuple(self.kv._cached),
+                tuple(self.kv._ref),
+                frozenset(self.kv._index.items()),
+                tuple(self.kv._pending_copies))
+
+
+class KvEvictLeavesIndexMutant(KvPagesModel):
+    """Seeded defect (kv-conservation class): the parked-tier eviction
+    forgets `_drop_index`, leaving a stale prefix-index entry pointing
+    at a free-list page. `check_invariants()` must catch the
+    partition/index breach on the first post-eviction state."""
+
+    name = "kv_pages__evict_leaves_index"
+    evict_drops_index = True
+
+
+# =======================================================================
+# registries
+# =======================================================================
+
+#: the shipped protocol tier: every model here must explore clean
+PROTOCOLS: "OrderedDict[str, type]" = OrderedDict([
+    ("rpc_envelope", RpcEnvelopeModel),
+    ("ps_apply", PsApplyModel),
+    ("elastic_seam", ElasticSeamModel),
+    ("serving_drain", ServingDrainModel),
+    ("kv_pages", KvPagesModel),
+])
+
+#: one seeded defect per invariant class (tests/test_proto_check.py):
+#: quiescence, exactly-once, seam agreement, drain conservation, KV
+#: page conservation
+MUTANTS: "OrderedDict[str, type]" = OrderedDict([
+    ("rpc_envelope__no_retry", RpcNoRetryMutant),
+    ("ps_apply__non_atomic_persist", PsNonAtomicPersistMutant),
+    ("elastic_seam__local_decision", ElasticLocalDecisionMutant),
+    ("serving_drain__skip_prefill", DrainSkipsPrefillMutant),
+    ("kv_pages__evict_leaves_index", KvEvictLeavesIndexMutant),
+])

@@ -279,6 +279,9 @@ class RpcServer:
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)
+        self._init_dispatch_state()
+
+    def _init_dispatch_state(self):
         self._stop_evt = threading.Event()
         # (client_id) -> {"seq", "resp", "stop", "cv"}; all entries share
         # _dedup_lock through their per-entry Conditions
@@ -286,6 +289,21 @@ class RpcServer:
         self._dedup_lock = threading.Lock()
         self._shutdown_lock = threading.Lock()
         self._closed = False
+
+    @classmethod
+    def dispatch_only(cls, handler):
+        """A socketless RpcServer: full envelope/dedup/snapshot semantics
+        with `_dispatch(fields)` called directly instead of over TCP.
+        This is what analysis/proto_models.py model-checks — the dedup
+        state machine itself, with the checker (not the kernel's socket
+        scheduler) choosing every delivery/retry/crash interleaving."""
+        self = cls.__new__(cls)
+        self._handler = handler
+        self._server = None
+        self._thread = None
+        self.port = None
+        self._init_dispatch_state()
+        return self
 
     # -- request dedup ---------------------------------------------------
     def _dispatch(self, fields) -> Tuple[List, bool, Optional[str]]:
@@ -479,6 +497,9 @@ class RpcServer:
         with self._dedup_lock:
             for ent in self._dedup.values():
                 ent["cv"].notify_all()
+
+        if self._server is None:  # dispatch_only: no socket to close
+            return
 
         def _do():
             self._server.shutdown()

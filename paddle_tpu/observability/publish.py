@@ -404,12 +404,39 @@ def static_checks_block(program) -> Optional[dict]:
             # cap the embedded detail; the CLI writes the full report
             "findings": s["findings"][:20],
         }
+        try:
+            # the protocol tier (analysis/protocol.py): a reduced-
+            # budget interleaving sweep over the host protocols; the
+            # full-budget sweep is `tools/tpu_lint.py --protocol`
+            pf, prep = analysis.run_protocol_checks(budget=200)
+            block["protocol"] = {
+                "budget": prep["budget"],
+                "errors": prep["errors"],
+                "ok": prep["ok"],
+                "models": {n: {"schedules": m["schedules"],
+                               "states": m["states"],
+                               "errors": m["errors"],
+                               "truncated": m["truncated"]}
+                           for n, m in prep["models"].items()},
+                "findings": [f.to_dict() for f in pf[:20]],
+            }
+        except Exception as e:  # noqa: BLE001 - evidence, not gating
+            block["protocol"] = {"error": repr(e)}
         reg = registry()
         reg.set_gauge("static_checks.errors", s["errors"])
         reg.set_gauge("static_checks.warnings", s["warnings"])
+        if "errors" in block["protocol"]:
+            reg.set_gauge("static_checks.protocol_errors",
+                          block["protocol"]["errors"])
         reg.publish_block("static_checks", block)
-        print("BENCH static checks: %d error(s), %d warning(s)"
-              % (s["errors"], s["warnings"]), flush=True)
+        print("BENCH static checks: %d error(s), %d warning(s); "
+              "protocol tier: %s"
+              % (s["errors"], s["warnings"],
+                 "%d error(s) over %d model(s)"
+                 % (block["protocol"].get("errors", -1),
+                    len(block["protocol"].get("models", {})))
+                 if "errors" in block["protocol"] else "unavailable"),
+              flush=True)
         return block
     except Exception as e:  # noqa: BLE001 - evidence, not gating
         print("BENCH static checks failed: %r" % (e,), flush=True)

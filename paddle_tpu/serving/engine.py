@@ -39,7 +39,8 @@ from .aot import BucketCompiler
 from .kv_cache import PagedKVCache
 from .scheduler import BucketPlan, Request, RequestState, Scheduler
 
-__all__ = ["EngineConfig", "Engine"]
+__all__ = ["EngineConfig", "Engine", "drain_manifest_entry",
+           "adopt_submit_kwargs"]
 
 
 @dataclass(frozen=True)
@@ -58,6 +59,9 @@ class EngineConfig:
     quantize_weights: bool = False     # PTQ int8 params at init
     prefix_cache: bool = True          # share/COW prompt-prefix pages
     aging_steps: int = 32              # priority aging (0 disables)
+    cached_pages: object = None        # prefix-cache budget: pages, or
+    #                                    "64mb"-style byte strings; None
+    #                                    reads the flag, 0 = unbounded
 
     @staticmethod
     def from_flags(**overrides) -> "EngineConfig":
@@ -78,9 +82,53 @@ class EngineConfig:
                 "FLAGS_tpu_serving_prefix_cache", True)),
             aging_steps=int(get_flag(
                 "FLAGS_tpu_serving_aging_steps", 32)),
+            cached_pages=get_flag("FLAGS_tpu_serving_cached_pages", 0),
         )
         kw.update(overrides)
         return EngineConfig(**kw)
+
+
+def drain_manifest_entry(req) -> dict:
+    """One drain() manifest entry for an unfinished request: the
+    continuation prompt is the original prompt PLUS the tokens already
+    generated, with the remaining budget — the survivor's re-prefill
+    reproduces the stream bit-identically (see Engine.drain). Shared by
+    Engine.drain and the analysis/proto_models serving_drain model so
+    the checker explores the EXACT entry shape production exports."""
+    return {
+        "prompt": [int(t) for t in req.prompt]
+        + [int(t) for t in req.output_tokens],
+        "max_new_tokens": int(req.max_new_tokens)
+        - len(req.output_tokens),
+        "eos_id": req.eos_id,
+        "tenant": req.tenant,
+        "already_emitted": len(req.output_tokens),
+        "priority": req.priority,
+        "temperature": req.temperature,
+        "top_k": req.top_k,
+        "top_p": req.top_p,
+        "seed": req.seed,
+        # the adopter's streams keep drawing per-index sampling keys
+        # where this engine stopped
+        "sample_step_offset": req.sample_step_offset
+        + len(req.output_tokens),
+    }
+
+
+def adopt_submit_kwargs(entry) -> dict:
+    """submit() kwargs for one manifest entry — the adopt() half of the
+    same shared contract (prompt arrives as the positional arg)."""
+    return dict(
+        max_new_tokens=int(entry["max_new_tokens"]),
+        eos_id=entry.get("eos_id"),
+        tenant=entry.get("tenant", ""),
+        priority=int(entry.get("priority", 0)),
+        temperature=float(entry.get("temperature", 0.0)),
+        top_k=int(entry.get("top_k", 0)),
+        top_p=float(entry.get("top_p", 1.0)),
+        seed=int(entry.get("seed", 0)),
+        sample_step_offset=int(entry.get(
+            "sample_step_offset", entry.get("already_emitted", 0))))
 
 
 class Engine:
@@ -127,7 +175,8 @@ class Engine:
         self.kv = PagedKVCache(model.kv_cache_spec(
             self.config.num_pages, self.config.page_size,
             pages_per_seq, dtype=self.config.kv_dtype),
-            prefix_cache=self.config.prefix_cache)
+            prefix_cache=self.config.prefix_cache,
+            cached_pages=self.config.cached_pages)
         self.plan = BucketPlan.from_flags(
             self.config.max_seqs, self.kv.config.max_context)
         self.scheduler = Scheduler(self.kv, self.plan,
@@ -349,23 +398,7 @@ class Engine:
                 if req.state == RequestState.CANCELLED \
                         or remaining <= 0:
                     continue
-                manifest.append({
-                    "prompt": [int(t) for t in req.prompt]
-                    + [int(t) for t in req.output_tokens],
-                    "max_new_tokens": remaining,
-                    "eos_id": req.eos_id,
-                    "tenant": req.tenant,
-                    "already_emitted": len(req.output_tokens),
-                    "priority": req.priority,
-                    "temperature": req.temperature,
-                    "top_k": req.top_k,
-                    "top_p": req.top_p,
-                    "seed": req.seed,
-                    # the adopter's streams keep drawing per-index
-                    # sampling keys where this engine stopped
-                    "sample_step_offset": req.sample_step_offset
-                    + len(req.output_tokens),
-                })
+                manifest.append(drain_manifest_entry(req))
                 req.cancel()
             for req in self.scheduler.retire():
                 self._publish_request(req)
@@ -389,17 +422,7 @@ class Engine:
         for entry in manifest:
             out.append(self.submit(
                 np.asarray(entry["prompt"], np.int32),
-                max_new_tokens=int(entry["max_new_tokens"]),
-                eos_id=entry.get("eos_id"),
-                tenant=entry.get("tenant", ""),
-                priority=int(entry.get("priority", 0)),
-                temperature=float(entry.get("temperature", 0.0)),
-                top_k=int(entry.get("top_k", 0)),
-                top_p=float(entry.get("top_p", 1.0)),
-                seed=int(entry.get("seed", 0)),
-                sample_step_offset=int(entry.get(
-                    "sample_step_offset",
-                    entry.get("already_emitted", 0)))))
+                **adopt_submit_kwargs(entry)))
         return out
 
     def close(self) -> None:

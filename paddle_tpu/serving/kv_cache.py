@@ -31,8 +31,16 @@ alongside the values — the copy helper works on the whole per-layer
 tuple. Refcount-0 pages that are still indexed park in a CACHED tier
 (LRU); admission pressure evicts them (leaves before ancestors —
 evicting an ancestor cascades, since the chain below it becomes
-unreachable). Sharing is pure block-table indirection: the attention
-kernel is untouched.
+unreachable). ``FLAGS_tpu_serving_cached_pages`` bounds the parked
+tier (pages, or "64mb"-style byte budgets; 0 = the whole free pool is
+eligible): free() evicts leaves-first down to budget and counts the
+evictions separately (``serving.kv_budget_evictions``). Sharing is
+pure block-table indirection: the attention kernel is untouched.
+
+``check_invariants()`` is the structural audit (page conservation,
+refcounts vs block tables, index bijection, COW targets) — the serving
+tests and the analysis/proto_models protocol checker call it after
+every mutation.
 
 Occupancy telemetry (PR 7 registry): gauges
 ``serving.kv_pages_in_use`` / ``serving.kv_pages_total`` /
@@ -134,6 +142,48 @@ class KVCacheConfig:
         return int(budget_bytes) // self.page_bytes
 
 
+#: byte-suffix multipliers for FLAGS_tpu_serving_cached_pages string
+#: values ("64mb", "2gb", ...)
+_BYTE_SUFFIXES = (("gb", 1 << 30), ("mb", 1 << 20), ("kb", 1 << 10),
+                  ("b", 1))
+
+
+def _parse_cached_budget(value, page_bytes: int) -> Optional[int]:
+    """FLAGS_tpu_serving_cached_pages -> parked-tier page budget.
+    0/None/"" = unbounded (the PR 19 behavior: the whole free pool is
+    eligible). A plain integer counts PAGES; a string with a b/kb/mb/gb
+    suffix is a BYTE budget, floored to whole pages at this pool's
+    page_bytes — so one flag value means the same HBM spend across
+    dtypes (an int8 pool parks ~4x the float32 pages)."""
+    if value is None:
+        return None
+    if isinstance(value, str):
+        text = value.strip().lower()
+        if not text:
+            return None
+        for suffix, mult in _BYTE_SUFFIXES:
+            if text.endswith(suffix):
+                num = text[:-len(suffix)].strip()
+                try:
+                    budget_bytes = float(num) * mult
+                except ValueError:
+                    raise ValueError(
+                        "bad cached-pages budget %r (want pages or "
+                        "<n><b|kb|mb|gb>)" % (value,))
+                return max(0, int(budget_bytes) // int(page_bytes))
+        try:
+            value = int(text)
+        except ValueError:
+            raise ValueError(
+                "bad cached-pages budget %r (want pages or "
+                "<n><b|kb|mb|gb>)" % (value,))
+    pages = int(value)
+    if pages < 0:
+        raise ValueError("cached-pages budget must be >= 0, got %d"
+                         % pages)
+    return None if pages == 0 else pages
+
+
 @dataclass
 class _SeqAlloc:
     pages: List[int]
@@ -148,14 +198,21 @@ class PagedKVCache:
     lock."""
 
     def __init__(self, config: KVCacheConfig,
-                 prefix_cache: Optional[bool] = None):
+                 prefix_cache: Optional[bool] = None,
+                 cached_pages=None):
         if prefix_cache is None:
             from ..utils.flags import get_flag
 
             prefix_cache = bool(get_flag(
                 "FLAGS_tpu_serving_prefix_cache", True))
+        if cached_pages is None:
+            from ..utils.flags import get_flag
+
+            cached_pages = get_flag("FLAGS_tpu_serving_cached_pages", 0)
         self.config = config
         self.prefix_cache = bool(prefix_cache)
+        self.cached_pages_budget = _parse_cached_budget(
+            cached_pages, config.page_bytes)
         self._free: List[int] = list(range(config.num_pages))
         self._ref: List[int] = [0] * config.num_pages
         self._seqs: Dict[int, _SeqAlloc] = {}
@@ -174,6 +231,7 @@ class PagedKVCache:
         self._prefix_hit_tokens = 0
         self._cow_copies = 0
         self._evictions = 0
+        self._budget_evictions = 0
         self._publish()
 
     # -- pool state --------------------------------------------------------
@@ -216,6 +274,13 @@ class PagedKVCache:
     @property
     def evictions(self) -> int:
         return self._evictions
+
+    @property
+    def budget_evictions(self) -> int:
+        """Parked pages evicted by the cached_pages budget alone (a
+        subset of `evictions`; admission-pressure evictions are the
+        rest)."""
+        return self._budget_evictions
 
     def can_admit(self, total_tokens: int, prompt=None) -> bool:
         """Would `alloc` for a request of `total_tokens` worst-case
@@ -405,8 +470,25 @@ class PagedKVCache:
                 self._cached[p] = None
             else:
                 self._free.append(p)
+        self._enforce_cached_budget()
         self._publish()
         return len(alloc.pages)
+
+    def _enforce_cached_budget(self) -> None:
+        """Shrink the parked tier to `cached_pages_budget` pages,
+        evicting from the LRU front — free() parks a sequence's leaves
+        before its ancestors, so leaves go first and `_drop_index`'s
+        descendant cascade stays small."""
+        budget = self.cached_pages_budget
+        if budget is None:
+            return
+        while len(self._cached) > budget:
+            victim = next(iter(self._cached))
+            del self._cached[victim]
+            self._free.append(victim)
+            self._drop_index(victim)
+            self._evictions += 1
+            self._budget_evictions += 1
 
     def block_table(self, seq_id: int) -> List[int]:
         """The sequence's page ids in context order, padded by the
@@ -416,6 +498,87 @@ class PagedKVCache:
 
     def live_seqs(self) -> List[int]:
         return list(self._seqs)
+
+    # -- invariants --------------------------------------------------------
+    def check_invariants(self) -> List[str]:
+        """Structural page-conservation audit; returns violation
+        strings (empty = healthy). The serving tests and the
+        analysis/proto_models kv_pages model call this after every
+        mutation, so the scattered implicit assertions live in ONE
+        place:
+
+        - partition: free + cached + referenced == num_pages, with no
+          page in two tiers and no duplicates inside a tier
+        - refcounts: referenced pages carry ref == #block tables
+          holding them; free/cached pages carry ref == 0; never
+          negative
+        - index: _index and _page_key are inverse bijections; an
+          indexed page is never on the free list; every cached page is
+          indexed (else it could never be matched again)
+        - pending COW copies target freshly allocated (ref == 1,
+          unindexed) destination pages
+        """
+        out: List[str] = []
+        n = self.config.num_pages
+        free, cached = list(self._free), list(self._cached)
+        refed = [p for p in range(n) if self._ref[p] > 0]
+        if len(set(free)) != len(free):
+            out.append("free list has duplicate pages")
+        for name, tier in (("free", set(free)), ("cached", set(cached)),
+                           ("referenced", set(refed))):
+            bad = [p for p in tier if not 0 <= p < n]
+            if bad:
+                out.append("%s tier holds out-of-range pages %s"
+                           % (name, bad))
+        for a, b, pages in (("free", "cached",
+                             set(free) & set(cached)),
+                            ("free", "referenced",
+                             set(free) & set(refed)),
+                            ("cached", "referenced",
+                             set(cached) & set(refed))):
+            if pages:
+                out.append("pages %s are both %s and %s"
+                           % (sorted(pages), a, b))
+        if len(free) + len(cached) + len(refed) != n \
+                and not out:  # overlap/dup already reported above
+            out.append(
+                "page conservation broken: free=%d + cached=%d + "
+                "referenced=%d != total=%d"
+                % (len(free), len(cached), len(refed), n))
+        neg = [p for p in range(n) if self._ref[p] < 0]
+        if neg:
+            out.append("negative refcounts on pages %s" % (neg,))
+        holds: Dict[int, int] = {}
+        for alloc in self._seqs.values():
+            for p in alloc.pages:
+                holds[p] = holds.get(p, 0) + 1
+        for p in range(n):
+            if self._ref[p] != holds.get(p, 0):
+                out.append(
+                    "page %d refcount %d != %d block-table references"
+                    % (p, self._ref[p], holds.get(p, 0)))
+        for key, page in self._index.items():
+            if self._page_key.get(page) != key:
+                out.append("index entry %r -> page %d not mirrored in "
+                           "_page_key" % (key, page))
+        for page, key in self._page_key.items():
+            if self._index.get(key) != page:
+                out.append("_page_key entry page %d -> %r not mirrored "
+                           "in _index" % (page, key))
+            if page in set(free):
+                out.append("indexed page %d is on the free list" % page)
+        for p in cached:
+            if p not in self._page_key:
+                out.append("cached page %d is not prefix-indexed "
+                           "(unmatchable, leaks the page)" % p)
+        for src, dst in self._pending_copies:
+            # dst ref 0 is benign (freed before the engine drained the
+            # copy list); writing a SHARED or indexed page never is
+            if self._ref[dst] > 1 or dst in self._page_key:
+                out.append(
+                    "pending COW copy %d->%d targets a shared or "
+                    "indexed destination" % (src, dst))
+        return out
 
     # -- device state ------------------------------------------------------
     def init_device_state(self):
@@ -469,5 +632,10 @@ class PagedKVCache:
                           self._prefix_hit_tokens)
             reg.set_gauge("serving.kv_cow_copies", self._cow_copies)
             reg.set_gauge("serving.kv_evictions", self._evictions)
+            reg.set_gauge("serving.kv_budget_evictions",
+                          self._budget_evictions)
+            reg.set_gauge("serving.kv_cached_pages_budget",
+                          -1 if self.cached_pages_budget is None
+                          else self.cached_pages_budget)
         except Exception:  # noqa: BLE001 - telemetry must never gate
             pass

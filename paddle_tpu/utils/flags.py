@@ -276,12 +276,21 @@ _FLAGS: Dict[str, object] = {
     # (queue ORDER only — preemption eligibility stays raw-class
     # strict). 0 disables aging.
     "FLAGS_tpu_serving_aging_steps": 32,
+    # parked prefix-cache tier budget: max refcount-0 pages kept
+    # indexed for future sharing. 0 = unbounded (whole free pool
+    # eligible). An int counts PAGES; strings take byte suffixes
+    # ("64mb", "2gb") floored to whole pages at the pool's page_bytes.
+    # free() evicts leaves-first down to budget
+    # (serving.kv_budget_evictions counts them).
+    "FLAGS_tpu_serving_cached_pages": 0,
 }
 
 
 #: numeric flags that also accept a symbolic string value from the env
-#: (FLAGS_tpu_hbm_budget_mb="auto" = the device's own bytes_limit)
-_SYMBOLIC_VALUE_FLAGS = frozenset({"FLAGS_tpu_hbm_budget_mb"})
+#: (FLAGS_tpu_hbm_budget_mb="auto" = the device's own bytes_limit;
+#: FLAGS_tpu_serving_cached_pages="64mb" = byte-suffixed budgets)
+_SYMBOLIC_VALUE_FLAGS = frozenset({"FLAGS_tpu_hbm_budget_mb",
+                                   "FLAGS_tpu_serving_cached_pages"})
 
 
 def _ingest_env():

@@ -200,6 +200,97 @@ def test_prefix_cache_off_is_legacy_behavior():
     assert kv.prefix_hit_tokens == 0 and kv.cow_copies == 0
 
 
+# -- kv cache: cached-pages budget + the page-ledger invariants -------------
+
+def _kv_budget(budget, **over):
+    kw = dict(num_pages=12, page_size=4, pages_per_seq=6,
+              num_layers=1, num_kv_heads=1, head_dim=8)
+    kw.update(over)
+    return serving.PagedKVCache(serving.KVCacheConfig(**kw),
+                                prefix_cache=True, cached_pages=budget)
+
+
+def test_cached_pages_budget_caps_parked_tier_leaves_first():
+    """FLAGS_tpu_serving_cached_pages: a budget on the PARKED tier —
+    free() evicts down to the cap leaves-first (LRU front), and
+    `budget_evictions` tallies separately from admission pressure."""
+    kv = _kv_budget(2)
+    a = list(range(16))
+    p0 = kv.alloc(0, 16, prompt=a)
+    kv.register_prefix(0, a)
+    kv.free(0)                              # 4 would park; budget is 2
+    assert kv.pages_cached == 2
+    assert kv.budget_evictions == 2 and kv.evictions == 2
+    assert kv.check_invariants() == []
+    # leaves evicted first: the ROOT side of the chain survives and
+    # still serves warm hits
+    matched, shared, cow = kv._match_prefix(a)
+    assert (matched, shared) == (8, p0[:2]) and cow is None
+    # admission-pressure evictions keep counting in the base counter
+    kv.alloc(1, 24, prompt=[40] * 24)
+    assert kv.budget_evictions == 2         # unchanged
+
+
+def test_cached_pages_budget_byte_string_and_unbounded():
+    cfg = serving.KVCacheConfig(num_pages=12, page_size=4,
+                                pages_per_seq=6, num_layers=1,
+                                num_kv_heads=1, head_dim=8)
+    kv = serving.PagedKVCache(cfg, prefix_cache=True,
+                              cached_pages="64kb")
+    assert kv.cached_pages_budget == (64 << 10) // cfg.page_bytes
+    assert serving.PagedKVCache(
+        cfg, prefix_cache=True, cached_pages=0).cached_pages_budget \
+        is None                             # 0 = unbounded (default)
+    with pytest.raises(ValueError):
+        serving.PagedKVCache(cfg, prefix_cache=True, cached_pages="-1")
+
+
+def test_cached_pages_flag_reaches_engine_config():
+    from paddle_tpu.utils.flags import get_flag, set_flags
+
+    old = get_flag("FLAGS_tpu_serving_cached_pages")
+    try:
+        set_flags({"FLAGS_tpu_serving_cached_pages": 3})
+        assert serving.EngineConfig.from_flags().cached_pages == 3
+    finally:
+        set_flags({"FLAGS_tpu_serving_cached_pages": old})
+
+
+def test_check_invariants_clean_through_share_cow_park_evict():
+    """The page-ledger audit (satellite of the protocol tier's
+    kv_pages model) holds after EVERY mutation of a full share -> COW
+    -> park -> evict -> revive workout."""
+    kv = _kv(num_pages=6, pages_per_seq=6)
+    a = list(range(16))
+    assert kv.check_invariants() == []
+    kv.alloc(0, 16, prompt=a)
+    kv.register_prefix(0, a)
+    assert kv.check_invariants() == []
+    kv.alloc(1, 16, prompt=list(a))         # identical prompt -> COW
+    assert kv.check_invariants() == []
+    kv.take_pending_copies()
+    kv.free(0)
+    assert kv.check_invariants() == []
+    kv.free(1)
+    kv.alloc(2, 24, prompt=[41] * 24)       # evicts the parked chain
+    assert kv.check_invariants() == []
+
+
+def test_check_invariants_catches_seeded_ledger_corruption():
+    kv = _kv()
+    a = list(range(16))
+    kv.alloc(0, 16, prompt=a)
+    kv.register_prefix(0, a)
+    kv.free(0)
+    # seed the defect the kv_pages__evict_leaves_index mutant ships:
+    # un-park a page without dropping its prefix-index entry
+    victim = next(iter(kv._cached))
+    del kv._cached[victim]
+    kv._free.append(victim)
+    probs = kv.check_invariants()
+    assert probs and any("free list" in p for p in probs)
+
+
 # -- engine: prefix hits, greedy + sampled identity -------------------------
 
 def _staggered(eng, prompts, max_new=6, **submit_kw):

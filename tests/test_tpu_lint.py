@@ -1202,7 +1202,7 @@ def test_exemplar_programs_lint_clean(tmp_path):
     assert set(report["programs"]) == {
         "bert_tiny", "bert_tiny_amp", "bert_tiny_fp8", "bert_tiny_tp",
         "mlp_hier", "embedding_ctr", "resnet_scan", "serving_decode",
-        "fleet_ps_2rank"}
+        "serving_decode_sampled", "fleet_ps_2rank"}
     assert rc == 0 and report["ok"] and report["total_errors"] == 0, \
         report
 
@@ -1221,6 +1221,7 @@ def test_cli_end_to_end(tmp_path):
                                        "bert_tiny_fp8", "bert_tiny_tp",
                                        "mlp_hier", "embedding_ctr",
                                        "resnet_scan", "serving_decode",
+                                       "serving_decode_sampled",
                                        "fleet_ps_2rank"}
     assert "tpu-lint:" in r.stdout
 

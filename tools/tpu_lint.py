@@ -34,6 +34,13 @@ Exemplars (each is a program the bench / tier-1 suite actually runs):
                       proves NO per-token fetch/RPC/dynamic-shape op
                       in the body — the IR-level half of the serving
                       hot-loop contract;
+- ``serving_decode_sampled`` — the SAME decode loop under SAMPLED
+                      decoding (temperature scale -> softmax -> top-p
+                      nucleus filter -> on-device ``sampling_id``):
+                      the RNG key is threaded by the lowering from
+                      ``program.random_seed`` + op index, so the
+                      sampled path stays as device-resident as the
+                      greedy one — zero host-sync errors required;
 - ``fleet_ps_2rank``— the SAME model transpiled for 2 sync-PS
                       trainers; both rank programs are linted AND
                       cross-compared by the collective-divergence
@@ -42,11 +49,25 @@ Exemplars (each is a program the bench / tier-1 suite actually runs):
 Usage:
     python tools/tpu_lint.py [--fail-on {warning,error}] [--json]
                              [--out PATH] [--exemplar NAME[,NAME...]]
+    python tools/tpu_lint.py --protocol [--protocol-budget N]
+                             [--protocol-model NAME[,NAME...]]
+                             [--fail-on {warning,error}] [--json]
+                             [--out PATH]
 
 Writes ``artifacts/static_checks.json`` (or --out) always; exits
 nonzero when findings at/above --fail-on severity exist (default:
 error). ``tools/perf_analysis.py --lint`` is a thin alias onto this
 entry point so one tool drives all audits.
+
+``--protocol`` switches from the IR exemplars to the PROTOCOL tier:
+the explicit-state interleaving checker (analysis/protocol.py) drives
+the real host-protocol implementations — RPC envelope retry/dedupe,
+PS exactly-once apply across kill/restart, the elastic preemption
+seam, serving drain->adopt and the paged-KV page ledger — through
+every reachable interleaving up to ``--protocol-budget`` schedules
+per model (default 1000) and reports invariant violations / deadlocks
+as findings with replayable traces. Writes
+``artifacts/protocol_checks.json`` (or --out).
 """
 from __future__ import annotations
 
@@ -349,6 +370,58 @@ def build_serving_decode():
     return prog, None
 
 
+def build_serving_decode_sampled():
+    """The serving engine's SAMPLED decode loop (temperature + top-p)
+    as a scan: temperature scale -> softmax -> top-p nucleus filter
+    (sort descending, cumulative mass, where-mask) -> on-device
+    ``sampling_id``. ``sampling_id`` is a needs_rng op — the lowering
+    threads a jax PRNG key folded from ``program.random_seed`` and the
+    op's position, so sampling needs NO per-token host round-trip and
+    the host-sync checker must find the body exactly as clean as the
+    greedy exemplar's. Zero errors is the standing claim."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework
+
+    HID, VOCAB, STEPS = 16, 32, 8
+    TEMPERATURE, TOP_P = 0.8, 0.9
+    _fresh()
+    with framework.unique_name_guard():
+        framework.default_main_program().random_seed = 11
+        h0 = fluid.layers.data(name="h0", shape=[HID],
+                               dtype="float32")
+        w = fluid.layers.create_parameter(
+            shape=[HID, HID], dtype="float32", name="sdec.w")
+        emb = fluid.layers.create_parameter(
+            shape=[HID, VOCAB], dtype="float32", name="sdec.emb")
+        h = fluid.layers.fc(input=h0, size=HID)
+        scan = fluid.layers.Scan(n=STEPS)
+        with scan.block():
+            nh = fluid.layers.tanh(fluid.layers.matmul(h, w))
+            logits = fluid.layers.matmul(nh, emb)
+            probs = fluid.layers.softmax(
+                fluid.layers.scale(logits, scale=1.0 / TEMPERATURE))
+            # top-p nucleus filter, all on device: sort descending,
+            # exclusive cumulative mass, zero out the tail past TOP_P
+            sorted_probs, _order = fluid.layers.argsort(
+                probs, axis=-1, descending=True)
+            cum = fluid.layers.cumsum(sorted_probs, axis=-1,
+                                      exclusive=True)
+            keep = fluid.layers.less_than(
+                cum, fluid.layers.scale(fluid.layers.ones_like(cum),
+                                        scale=TOP_P))
+            filtered = fluid.layers.where(
+                keep, sorted_probs,
+                fluid.layers.zeros_like(sorted_probs))
+            # categorical draw over the nucleus (the lowering
+            # re-normalizes via log + categorical); the sampled rank
+            # stays on device, state carries through `h`
+            fluid.layers.sampling_id(filtered)
+            fluid.layers.assign(nh, output=h)
+        fluid.layers.matmul(h, emb)
+        prog = fluid.default_main_program()
+    return prog, None
+
+
 def build_embedding_ctr():
     """Data-parallel wide&deep CTR train step with every slot table
     vocab-sharded by the sparse-embedding engine
@@ -416,6 +489,7 @@ EXEMPLARS = {
     "embedding_ctr": build_embedding_ctr,
     "resnet_scan": build_resnet_scan,
     "serving_decode": build_serving_decode,
+    "serving_decode_sampled": build_serving_decode_sampled,
     "fleet_ps_2rank": build_fleet_ps_2rank,
 }
 
@@ -444,13 +518,60 @@ def lint_exemplars(names=None):
     return out
 
 
+def _main_protocol(fail_on, as_json, out_path, budget, models):
+    """The --protocol leg: run the explicit-state interleaving checker
+    over the registered host-protocol models and report violations /
+    deadlocks as findings with replayable traces."""
+    from paddle_tpu import analysis
+
+    try:
+        findings, report = analysis.run_protocol_checks(
+            budget=budget, models=models)
+    except ValueError as e:  # unknown --protocol-model: usage error
+        raise SystemExit(str(e))
+    summary = analysis.summarize(findings)
+    report["fail_on"] = fail_on
+    report["total_errors"] = summary["errors"]
+    report["total_warnings"] = summary["warnings"]
+    report["ok"] = not (summary["errors"] or
+                        (fail_on == "warning" and summary["warnings"]))
+    report["findings"] = [f.to_dict() for f in findings]
+    if out_path is None:
+        out_path = os.path.join(_REPO, "artifacts",
+                                "protocol_checks.json")
+    os.makedirs(os.path.dirname(os.path.abspath(out_path)),
+                exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+
+    if as_json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        for name, m in report["models"].items():
+            print("== %s: %d schedule(s), %d state(s), %d error(s)%s"
+                  % (name, m["schedules"], m["states"], m["errors"],
+                     " [truncated]" if m["truncated"] else ""))
+        for fnd in findings:
+            print("   " + analysis.format_finding(fnd))
+        print("tpu-lint --protocol: %d model(s), %d error(s), "
+              "%d warning(s); %s; wrote %s"
+              % (len(report["models"]), summary["errors"],
+                 summary["warnings"],
+                 "OK" if report["ok"] else "FAIL (--fail-on %s)"
+                 % fail_on, out_path))
+    return 0 if report["ok"] else 1
+
+
 def main(argv=None):
     from paddle_tpu import analysis
 
     argv = list(sys.argv[1:] if argv is None else argv)
     fail_on = "error"
     as_json = "--json" in argv
-    out_path = os.path.join(_REPO, "artifacts", "static_checks.json")
+    protocol = "--protocol" in argv
+    proto_budget = 1000
+    proto_models = None
+    out_path = None
     names = None
 
     def value_of(flag, a, i):
@@ -471,6 +592,8 @@ def main(argv=None):
         fail_val, i = value_of("--fail-on", a, i)
         out_val, i = value_of("--out", a, i)
         ex_val, i = value_of("--exemplar", a, i)
+        budget_val, i = value_of("--protocol-budget", a, i)
+        model_val, i = value_of("--protocol-model", a, i)
         if fail_val is not None:
             if fail_val not in ("warning", "error"):
                 raise SystemExit(
@@ -485,10 +608,25 @@ def main(argv=None):
             if unknown:
                 raise SystemExit("unknown exemplar(s) %s; have %s"
                                  % (sorted(unknown), list(EXEMPLARS)))
-        elif a != "--json":
+        elif budget_val is not None:
+            try:
+                proto_budget = int(budget_val)
+            except ValueError:
+                raise SystemExit("--protocol-budget takes an integer, "
+                                 "got %r" % (budget_val,))
+        elif model_val is not None:
+            proto_models = [n for n in model_val.split(",") if n]
+        elif a not in ("--json", "--protocol"):
             raise SystemExit(__doc__.split("Usage:")[1])
         i += 1
 
+    if protocol:
+        return _main_protocol(fail_on, as_json, out_path,
+                              proto_budget, proto_models)
+
+    if out_path is None:
+        out_path = os.path.join(_REPO, "artifacts",
+                                "static_checks.json")
     results = lint_exemplars(names)
     total_err = sum(s["errors"] for _, s in results.values())
     total_warn = sum(s["warnings"] for _, s in results.values())
